@@ -1,0 +1,27 @@
+"""memgraph_tpu — a TPU-native graph database framework.
+
+A ground-up re-design of the capabilities of the reference in-memory graph
+database (openCypher engine, MVCC storage, Bolt serving, durability,
+replication/HA, streaming, query modules) with the analytics layer executing
+as JAX/XLA kernels on TPU.
+
+Architecture (host vs device split, see SURVEY.md §7):
+  - Host (Python/C++): Cypher parse/plan, MVCC point reads/writes, Bolt
+    protocol, durability, replication control plane.
+  - Device (JAX/XLA/pallas): whole-graph analytics (PageRank, Katz, label
+    propagation, WCC, node2vec, kNN vector search) over immutable CSR
+    snapshots, sharded across a `jax.sharding.Mesh` for multi-chip.
+
+Package layout:
+  utils/     — interning, temporal types, points, scheduler, settings
+  storage/   — MVCC graph storage engine, indexes, constraints, durability
+  query/     — openCypher frontend, planner, Volcano executor, functions
+  ops/       — TPU kernels over CSR device graphs
+  parallel/  — device meshes, edge partitioning, shard_map collectives
+  models/    — embedding/GNN-style model families (node2vec, ...)
+  server/    — Bolt wire protocol server
+  dbms/      — multi-tenancy
+  replication/ — WAL shipping control plane
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,72 @@
+"""Framework-wide exception hierarchy.
+
+Mirrors the error taxonomy the reference surfaces to clients (storage errors
+at /root/reference/src/storage/v2/storage.hpp, query exceptions at
+/root/reference/src/query/exceptions.hpp) without copying its structure.
+"""
+
+
+class MemgraphTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+# --- storage-level -----------------------------------------------------------
+
+class StorageError(MemgraphTpuError):
+    pass
+
+
+class SerializationError(StorageError):
+    """Write-write conflict between concurrent transactions (optimistic MVCC)."""
+
+
+class ConstraintViolation(StorageError):
+    def __init__(self, message, constraint=None):
+        super().__init__(message)
+        self.constraint = constraint
+
+
+class DurabilityError(StorageError):
+    pass
+
+
+# --- query-level -------------------------------------------------------------
+
+class QueryException(MemgraphTpuError):
+    pass
+
+
+class SyntaxException(QueryException):
+    """Cypher lexical/grammatical error. Client code: Memgraph.ClientError."""
+
+
+class SemanticException(QueryException):
+    """Valid syntax, invalid meaning (unbound symbol, bad aggregation, ...)."""
+
+
+class TypeException(QueryException):
+    """Runtime type mismatch in expression evaluation."""
+
+
+class ArithmeticException(QueryException):
+    pass
+
+
+class ProfileException(QueryException):
+    pass
+
+
+class HintedAbortError(QueryException):
+    """Query killed (timeout / TERMINATE TRANSACTIONS / shutdown)."""
+
+
+class TransactionException(QueryException):
+    pass
+
+
+class ProcedureException(QueryException):
+    """Error raised from a CALLed query module procedure."""
+
+
+class AuthException(MemgraphTpuError):
+    pass

@@ -1,0 +1,294 @@
+"""Label and label+property indexes.
+
+Capability map to the reference's storage/v2/indices/: LabelIndex and
+LabelPropertyIndex (incl. composite properties and range scans) with
+MVCC-correct reads — index entries are inserted eagerly at mutation time and
+*revalidated against the reader's snapshot* at scan time; stale entries are
+swept by GC. Per-index counts feed the planner's cost model
+(plan/cost_estimator analog).
+
+Ordered range scans use bisect over a sorted (order_key, gid) list that is
+maintained incrementally; point lookups use hash buckets.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+
+from .ordering import order_key
+
+
+class LabelIndex:
+    """label_id -> insertion-ordered dict of candidate vertices."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._index: dict[int, dict] = {}
+
+    def create(self, label_id: int, vertices) -> None:
+        with self._lock:
+            bucket = self._index.setdefault(label_id, {})
+        for v in vertices:
+            if label_id in v.labels and not v.deleted:
+                bucket[v.gid] = v
+
+    def drop(self, label_id: int) -> bool:
+        with self._lock:
+            return self._index.pop(label_id, None) is not None
+
+    def has(self, label_id: int) -> bool:
+        return label_id in self._index
+
+    def labels(self) -> list[int]:
+        return list(self._index)
+
+    def add(self, label_id: int, vertex) -> None:
+        bucket = self._index.get(label_id)
+        if bucket is not None:
+            bucket[vertex.gid] = vertex
+
+    def candidates(self, label_id: int):
+        bucket = self._index.get(label_id)
+        if bucket is None:
+            return None
+        return list(bucket.values())
+
+    def approx_count(self, label_id: int) -> int:
+        bucket = self._index.get(label_id)
+        return len(bucket) if bucket is not None else 0
+
+    def remove_entry(self, label_id: int, vertex) -> None:
+        bucket = self._index.get(label_id)
+        if bucket is not None:
+            bucket.pop(vertex.gid, None)
+
+    def sweep(self) -> int:
+        """Drop entries for settled vertices that no longer carry the label."""
+        removed = 0
+        with self._lock:
+            for label_id, bucket in self._index.items():
+                stale = [gid for gid, v in bucket.items()
+                         if v.delta is None
+                         and (v.deleted or label_id not in v.labels)]
+                for gid in stale:
+                    del bucket[gid]
+                removed += len(stale)
+        return removed
+
+
+class LabelPropertyIndex:
+    """(label_id, (prop_id, ...)) -> sorted entries for range scans.
+
+    Composite keys supported, as in the reference's composite label+property
+    indexes. Entries are (sort_key, gid, vertex, values) kept sorted so range
+    scans are bisect + slice.
+
+    MVCC discipline (same as the reference's skip-list indexes): entries are
+    **add-only** — a property change *adds* an entry under the new key and
+    keeps the old one, because concurrent snapshot readers may still need to
+    find the vertex under its old value. Scans revalidate every candidate
+    against the reader's snapshot; stale entries are swept by GC once the
+    vertex's delta chain is fully collected (no reader can need them).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # key -> {"sorted": list[(key_tuple, gid, vertex, values)],
+        #         "by_gid": dict[gid, set[key_tuple]]}
+        self._index: dict[tuple[int, tuple[int, ...]], dict] = {}
+
+    @staticmethod
+    def _entry_key(values) -> tuple:
+        return tuple(order_key(v) for v in values)
+
+    def create(self, label_id: int, prop_ids: tuple[int, ...], vertices) -> None:
+        with self._lock:
+            slot = self._index.setdefault((label_id, prop_ids),
+                                          {"sorted": [], "by_gid": {}})
+        for v in vertices:
+            self.maybe_add(label_id, prop_ids, v)
+        # created concurrently with writes in principle; final sort for safety
+        slot["sorted"].sort(key=lambda e: (e[0], e[1]))
+
+    def drop(self, label_id: int, prop_ids: tuple[int, ...]) -> bool:
+        with self._lock:
+            return self._index.pop((label_id, prop_ids), None) is not None
+
+    def has(self, label_id: int, prop_ids: tuple[int, ...]) -> bool:
+        return (label_id, prop_ids) in self._index
+
+    def keys(self) -> list[tuple[int, tuple[int, ...]]]:
+        return list(self._index)
+
+    def relevant_to(self, label_id: int):
+        """All composite keys on this label (for planner rewrites)."""
+        return [k for k in self._index if k[0] == label_id]
+
+    def maybe_add(self, label_id: int, prop_ids: tuple[int, ...], vertex) -> None:
+        """Insert vertex if it currently has the label and all properties."""
+        slot = self._index.get((label_id, prop_ids))
+        if slot is None:
+            return
+        if label_id not in vertex.labels or vertex.deleted:
+            return
+        values = []
+        for pid in prop_ids:
+            if pid not in vertex.properties:
+                return
+            values.append(vertex.properties[pid])
+        self._insert(slot, vertex, values)
+
+    def _insert(self, slot, vertex, values) -> None:
+        key = self._entry_key(values)
+        with self._lock:
+            keys = slot["by_gid"].setdefault(vertex.gid, set())
+            if key in keys:
+                return
+            keys.add(key)
+            bisect.insort(slot["sorted"], (key, vertex.gid, vertex, tuple(values)),
+                          key=lambda e: (e[0], e[1]))
+
+    def update_on_change(self, vertex) -> None:
+        """Add entries for the vertex's current state (add-only, see class doc)."""
+        for (label_id, prop_ids) in list(self._index):
+            self.maybe_add(label_id, prop_ids, vertex)
+
+    def remove_entry(self, vertex) -> None:
+        """Drop every entry for a dead (GC'd) vertex."""
+        with self._lock:
+            for slot in self._index.values():
+                if slot["by_gid"].pop(vertex.gid, None) is not None:
+                    slot["sorted"] = [e for e in slot["sorted"]
+                                      if e[1] != vertex.gid]
+
+    def sweep(self) -> int:
+        """Drop stale entries for settled vertices (delta chain fully GC'd).
+
+        Called from storage GC. A settled vertex has exactly one visible
+        state, so any entry whose key no longer matches it is unreachable.
+        """
+        removed = 0
+        with self._lock:
+            for (label_id, prop_ids), slot in self._index.items():
+                keep = []
+                by_gid: dict[int, set] = {}
+                for entry in slot["sorted"]:
+                    key, gid, vertex, values = entry
+                    if vertex.delta is None:
+                        stale = (vertex.deleted
+                                 or label_id not in vertex.labels
+                                 or any(p not in vertex.properties
+                                        for p in prop_ids)
+                                 or self._entry_key(
+                                     [vertex.properties[p] for p in prop_ids])
+                                 != key)
+                        if stale:
+                            removed += 1
+                            continue
+                    keep.append(entry)
+                    by_gid.setdefault(gid, set()).add(key)
+                slot["sorted"] = keep
+                slot["by_gid"] = by_gid
+        return removed
+
+    # --- scans --------------------------------------------------------------
+
+    def candidates_equal(self, label_id, prop_ids, values):
+        slot = self._index.get((label_id, prop_ids))
+        if slot is None:
+            return None
+        key = self._entry_key(values)
+        lo = bisect.bisect_left(slot["sorted"], (key,), key=lambda e: (e[0],))
+        out = []
+        for entry in slot["sorted"][lo:]:
+            if entry[0] != key:
+                break
+            out.append(entry[2])
+        return out
+
+    def candidates_range(self, label_id, prop_ids, lower=None, upper=None,
+                         lower_inclusive=True, upper_inclusive=True):
+        """Range over the FIRST property of the composite key."""
+        slot = self._index.get((label_id, prop_ids))
+        if slot is None:
+            return None
+        entries = slot["sorted"]
+        lo_i, hi_i = 0, len(entries)
+        if lower is not None:
+            k = (order_key(lower),)
+            lo_i = (bisect.bisect_left(entries, k, key=lambda e: (e[0][0],))
+                    if lower_inclusive else
+                    bisect.bisect_right(entries, k, key=lambda e: (e[0][0],)))
+        if upper is not None:
+            k = (order_key(upper),)
+            hi_i = (bisect.bisect_right(entries, k, key=lambda e: (e[0][0],))
+                    if upper_inclusive else
+                    bisect.bisect_left(entries, k, key=lambda e: (e[0][0],)))
+        return [e[2] for e in entries[lo_i:hi_i]]
+
+    def candidates_all(self, label_id, prop_ids):
+        slot = self._index.get((label_id, prop_ids))
+        if slot is None:
+            return None
+        return [e[2] for e in slot["sorted"]]
+
+    def approx_count(self, label_id, prop_ids) -> int:
+        slot = self._index.get((label_id, prop_ids))
+        return len(slot["sorted"]) if slot is not None else 0
+
+
+class EdgeTypeIndex:
+    """edge_type_id -> dict of candidate edges (reference: indices/edge_type_index)."""
+
+    def __init__(self) -> None:
+        self._index: dict[int, dict] = {}
+
+    def create(self, edge_type_id: int, edges) -> None:
+        bucket = self._index.setdefault(edge_type_id, {})
+        for e in edges:
+            if e.edge_type == edge_type_id and not e.deleted:
+                bucket[e.gid] = e
+
+    def drop(self, edge_type_id: int) -> bool:
+        return self._index.pop(edge_type_id, None) is not None
+
+    def has(self, edge_type_id: int) -> bool:
+        return edge_type_id in self._index
+
+    def types(self) -> list[int]:
+        return list(self._index)
+
+    def add(self, edge) -> None:
+        bucket = self._index.get(edge.edge_type)
+        if bucket is not None:
+            bucket[edge.gid] = edge
+
+    def candidates(self, edge_type_id: int):
+        bucket = self._index.get(edge_type_id)
+        if bucket is None:
+            return None
+        return list(bucket.values())
+
+    def approx_count(self, edge_type_id: int) -> int:
+        bucket = self._index.get(edge_type_id)
+        return len(bucket) if bucket is not None else 0
+
+    def remove_entry(self, edge) -> None:
+        bucket = self._index.get(edge.edge_type)
+        if bucket is not None:
+            bucket.pop(edge.gid, None)
+
+
+class Indices:
+    """Bundle owned by the storage engine."""
+
+    def __init__(self) -> None:
+        self.label = LabelIndex()
+        self.label_property = LabelPropertyIndex()
+        self.edge_type = EdgeTypeIndex()
+        # vector / text / point indexes attach here (separate modules)
+        self.vector = None
+        self.text = None
+        self.point = None

@@ -1,0 +1,56 @@
+"""In-memory vertex and edge records.
+
+The reference packs Vertex into 80 bytes with small_vectors and a tagged delta
+pointer (storage/v2/vertex.hpp:32-73). In the Python host layer we keep the
+same *shape* — gid, labels, properties, adjacency, delta head, per-object
+lock — with __slots__ for density. Adjacency entries are
+(edge_type_id, other_vertex, edge) triples, mirroring the reference's
+(EdgeType, Vertex*, EdgeRef) tuples so edge objects are only touched when
+edge properties are needed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .delta import Delta
+
+
+class Vertex:
+    __slots__ = ("gid", "labels", "properties", "in_edges", "out_edges",
+                 "deleted", "delta", "lock")
+
+    def __init__(self, gid: int, delta: Optional[Delta] = None) -> None:
+        self.gid = gid
+        self.labels: set[int] = set()
+        self.properties: dict[int, object] = {}
+        # entries: (edge_type_id, other_vertex, edge)
+        self.in_edges: list[tuple] = []
+        self.out_edges: list[tuple] = []
+        self.deleted = False
+        self.delta = delta
+        self.lock = threading.Lock()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Vertex(gid={self.gid}, labels={self.labels}, deleted={self.deleted})"
+
+
+class Edge:
+    __slots__ = ("gid", "edge_type", "from_vertex", "to_vertex", "properties",
+                 "deleted", "delta", "lock")
+
+    def __init__(self, gid: int, edge_type: int, from_vertex: Vertex,
+                 to_vertex: Vertex, delta: Optional[Delta] = None) -> None:
+        self.gid = gid
+        self.edge_type = edge_type
+        self.from_vertex = from_vertex
+        self.to_vertex = to_vertex
+        self.properties: dict[int, object] = {}
+        self.deleted = False
+        self.delta = delta
+        self.lock = threading.Lock()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"Edge(gid={self.gid}, type={self.edge_type}, "
+                f"{self.from_vertex.gid}->{self.to_vertex.gid})")

@@ -1,0 +1,840 @@
+"""The in-memory MVCC storage engine.
+
+Re-design of the reference's InMemoryStorage
+(/root/reference/src/storage/v2/inmemory/storage.hpp:109): optimistic MVCC
+with undo-delta chains (mvcc.py), commit serialization under an engine lock,
+abort via reverse-undo, and epoch-style GC that truncates delta chains older
+than the oldest active transaction. Two storage modes:
+
+  IN_MEMORY_TRANSACTIONAL — full MVCC (default)
+  IN_MEMORY_ANALYTICAL    — no MVCC/WAL, direct mutation, bulk-load fast path
+
+TPU-first twist: the engine keeps a monotonically bumped `topology_version`
+so the device CSR snapshot cache (memgraph_tpu.ops.csr) knows when graph
+topology changed and a re-export is needed.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from ..exceptions import ConstraintViolation, SerializationError, StorageError
+from ..utils.ids import NameIdMapper
+from .common import (TRANSACTION_ID_START, Gid, IsolationLevel, StorageMode,
+                     View)
+from .constraints import Constraints
+from .delta import CommitInfo, DeltaAction
+from .indexes import Indices
+from .mvcc import (materialize_edge, materialize_vertex, prepare_for_write,
+                   push_delta)
+from .objects import Edge, Vertex
+
+
+@dataclass
+class StorageConfig:
+    storage_mode: StorageMode = StorageMode.IN_MEMORY_TRANSACTIONAL
+    isolation_level: IsolationLevel = IsolationLevel.SNAPSHOT_ISOLATION
+    gc_interval_sec: float = 30.0
+    durability_dir: Optional[str] = None
+    wal_enabled: bool = False
+    snapshot_on_exit: bool = False
+    properties_on_edges: bool = True
+
+
+class _Namer:
+    """Adapter giving constraints readable names in error messages."""
+
+    def __init__(self, storage: "InMemoryStorage") -> None:
+        self._s = storage
+
+    def label(self, label_id: int) -> str:
+        return self._s.label_mapper.id_to_name(label_id)
+
+    def prop(self, prop_id: int) -> str:
+        return self._s.property_mapper.id_to_name(prop_id)
+
+
+class Transaction:
+    __slots__ = ("id", "start_ts", "commit_info", "deltas", "isolation",
+                 "storage", "touched_vertices", "touched_edges")
+
+    def __init__(self, txn_id: int, start_ts: int, isolation: IsolationLevel,
+                 storage: "InMemoryStorage") -> None:
+        self.id = txn_id
+        self.start_ts = start_ts
+        self.commit_info = CommitInfo(txn_id)
+        self.deltas = []
+        self.isolation = isolation
+        self.storage = storage
+        self.touched_vertices: dict[int, Vertex] = {}
+        self.touched_edges: dict[int, Edge] = {}
+
+    def effective_start_ts(self) -> int:
+        if self.isolation is IsolationLevel.SNAPSHOT_ISOLATION:
+            return self.start_ts
+        # READ_COMMITTED / READ_UNCOMMITTED see the latest committed state
+        return self.storage.latest_commit_ts()
+
+
+class VertexAccessor:
+    """Transactional view over one vertex. Cheap to construct."""
+
+    __slots__ = ("vertex", "_acc")
+
+    def __init__(self, vertex: Vertex, acc: "Accessor") -> None:
+        self.vertex = vertex
+        self._acc = acc
+
+    # --- identity -----------------------------------------------------------
+
+    @property
+    def gid(self) -> Gid:
+        return self.vertex.gid
+
+    def __eq__(self, other):
+        return isinstance(other, VertexAccessor) and other.vertex is self.vertex
+
+    def __hash__(self):
+        return hash(("v", self.vertex.gid))
+
+    # --- reads --------------------------------------------------------------
+
+    def _state(self, view: View):
+        return self._acc._vertex_state(self.vertex, view)
+
+    def is_visible(self, view: View = View.OLD) -> bool:
+        st = self._state(view)
+        return st.exists and not st.deleted
+
+    def labels(self, view: View = View.NEW) -> list[int]:
+        return sorted(self._state(view).labels)
+
+    def has_label(self, label_id: int, view: View = View.NEW) -> bool:
+        return label_id in self._state(view).labels
+
+    def properties(self, view: View = View.NEW) -> dict[int, object]:
+        return dict(self._state(view).properties)
+
+    def get_property(self, prop_id: int, view: View = View.NEW):
+        return self._state(view).properties.get(prop_id)
+
+    def in_edges(self, view: View = View.NEW, edge_types=None,
+                 from_vertex=None) -> list["EdgeAccessor"]:
+        st = self._state(view)
+        out = []
+        for (etype, other, edge) in st.in_edges:
+            if edge_types is not None and etype not in edge_types:
+                continue
+            if from_vertex is not None and other is not from_vertex.vertex:
+                continue
+            ea = EdgeAccessor(edge, self._acc)
+            if ea.is_visible(view):
+                out.append(ea)
+        return out
+
+    def out_edges(self, view: View = View.NEW, edge_types=None,
+                  to_vertex=None) -> list["EdgeAccessor"]:
+        st = self._state(view)
+        out = []
+        for (etype, other, edge) in st.out_edges:
+            if edge_types is not None and etype not in edge_types:
+                continue
+            if to_vertex is not None and other is not to_vertex.vertex:
+                continue
+            ea = EdgeAccessor(edge, self._acc)
+            if ea.is_visible(view):
+                out.append(ea)
+        return out
+
+    def in_degree(self, view: View = View.NEW) -> int:
+        return len(self.in_edges(view))
+
+    def out_degree(self, view: View = View.NEW) -> int:
+        return len(self.out_edges(view))
+
+    # --- writes -------------------------------------------------------------
+
+    def add_label(self, label_id: int) -> bool:
+        return self._acc._vertex_add_label(self.vertex, label_id)
+
+    def remove_label(self, label_id: int) -> bool:
+        return self._acc._vertex_remove_label(self.vertex, label_id)
+
+    def set_property(self, prop_id: int, value) -> object:
+        return self._acc._vertex_set_property(self.vertex, prop_id, value)
+
+
+class EdgeAccessor:
+    __slots__ = ("edge", "_acc")
+
+    def __init__(self, edge: Edge, acc: "Accessor") -> None:
+        self.edge = edge
+        self._acc = acc
+
+    @property
+    def gid(self) -> Gid:
+        return self.edge.gid
+
+    @property
+    def edge_type(self) -> int:
+        return self.edge.edge_type
+
+    def __eq__(self, other):
+        return isinstance(other, EdgeAccessor) and other.edge is self.edge
+
+    def __hash__(self):
+        return hash(("e", self.edge.gid))
+
+    def from_vertex(self) -> VertexAccessor:
+        return VertexAccessor(self.edge.from_vertex, self._acc)
+
+    def to_vertex(self) -> VertexAccessor:
+        return VertexAccessor(self.edge.to_vertex, self._acc)
+
+    def _state(self, view: View):
+        return self._acc._edge_state(self.edge, view)
+
+    def is_visible(self, view: View = View.OLD) -> bool:
+        st = self._state(view)
+        return st.exists and not st.deleted
+
+    def properties(self, view: View = View.NEW) -> dict[int, object]:
+        return dict(self._state(view).properties)
+
+    def get_property(self, prop_id: int, view: View = View.NEW):
+        return self._state(view).properties.get(prop_id)
+
+    def set_property(self, prop_id: int, value) -> object:
+        return self._acc._edge_set_property(self.edge, prop_id, value)
+
+
+class Accessor:
+    """One transaction's handle on the storage (reference: Storage::Accessor).
+
+    Usable as a context manager; __exit__ aborts if not committed.
+    """
+
+    def __init__(self, storage: "InMemoryStorage",
+                 isolation: IsolationLevel) -> None:
+        self.storage = storage
+        self.txn = storage._begin_transaction(isolation)
+        self._finished = False
+        self._analytical = storage.config.storage_mode is StorageMode.IN_MEMORY_ANALYTICAL
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def __enter__(self) -> "Accessor":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if not self._finished:
+            self.abort()
+
+    def commit(self) -> None:
+        if self._finished:
+            raise StorageError("transaction already finished")
+        try:
+            self.storage._commit(self.txn)
+        except Exception:
+            # constraint violation etc. → roll back so objects aren't left owned
+            self.storage._abort(self.txn)
+            self._finished = True
+            raise
+        self._finished = True
+
+    def abort(self) -> None:
+        if self._finished:
+            return
+        self.storage._abort(self.txn)
+        self._finished = True
+
+    # --- object creation / deletion -----------------------------------------
+
+    def create_vertex(self, gid: Optional[Gid] = None) -> VertexAccessor:
+        storage = self.storage
+        with storage._gid_lock:
+            if gid is None:
+                gid = storage._next_vertex_gid
+                storage._next_vertex_gid += 1
+            else:
+                if gid in storage._vertices:
+                    raise StorageError(f"vertex with gid {gid} already exists")
+                storage._next_vertex_gid = max(storage._next_vertex_gid, gid + 1)
+        vertex = Vertex(gid)
+        if not self._analytical:
+            push_delta(vertex, self.txn, DeltaAction.DELETE_OBJECT, None)
+        storage._vertices[gid] = vertex
+        self.txn.touched_vertices[gid] = vertex
+        storage._bump_topology()
+        return VertexAccessor(vertex, self)
+
+    def delete_vertex(self, va: VertexAccessor, detach: bool = False):
+        """Delete a vertex; with detach=True also deletes incident edges.
+
+        Returns (deleted_vertex_accessor, deleted_edge_accessors) or raises.
+        """
+        vertex = va.vertex
+        deleted_edges: list[EdgeAccessor] = []
+        with vertex.lock:
+            if not self._analytical:
+                prepare_for_write(vertex, self.txn)
+            if vertex.deleted:
+                return None, []
+            in_list = list(vertex.in_edges)
+            out_list = list(vertex.out_edges)
+        if in_list or out_list:
+            if not detach:
+                raise StorageError(
+                    "Vertex has edges and cannot be deleted without DETACH")
+            for (etype, other, edge) in out_list:
+                ea = EdgeAccessor(edge, self)
+                if ea.is_visible(View.NEW):
+                    self.delete_edge(ea)
+                    deleted_edges.append(ea)
+            for (etype, other, edge) in in_list:
+                ea = EdgeAccessor(edge, self)
+                if ea.is_visible(View.NEW):
+                    self.delete_edge(ea)
+                    deleted_edges.append(ea)
+        with vertex.lock:
+            if not self._analytical:
+                prepare_for_write(vertex, self.txn)
+                push_delta(vertex, self.txn, DeltaAction.RECREATE_OBJECT, None)
+            vertex.deleted = True
+        self.txn.touched_vertices[vertex.gid] = vertex
+        self.storage._bump_topology()
+        return va, deleted_edges
+
+    def create_edge(self, from_va: VertexAccessor, to_va: VertexAccessor,
+                    edge_type: int, gid: Optional[Gid] = None) -> EdgeAccessor:
+        storage = self.storage
+        from_v, to_v = from_va.vertex, to_va.vertex
+        with storage._gid_lock:
+            if gid is None:
+                gid = storage._next_edge_gid
+                storage._next_edge_gid += 1
+            else:
+                if gid in storage._edges:
+                    raise StorageError(f"edge with gid {gid} already exists")
+                storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+        edge = Edge(gid, edge_type, from_v, to_v)
+
+        # lock both endpoints in gid order to avoid deadlock
+        first, second = (from_v, to_v) if from_v.gid <= to_v.gid else (to_v, from_v)
+        first.lock.acquire()
+        if second is not first:
+            second.lock.acquire()
+        try:
+            if not self._analytical:
+                prepare_for_write(from_v, self.txn)
+                if to_v is not from_v:
+                    prepare_for_write(to_v, self.txn)
+            if from_v.deleted or to_v.deleted:
+                raise StorageError("cannot create edge on a deleted vertex")
+            out_entry = (edge_type, to_v, edge)
+            in_entry = (edge_type, from_v, edge)
+            if not self._analytical:
+                push_delta(edge, self.txn, DeltaAction.DELETE_OBJECT, None)
+                push_delta(from_v, self.txn, DeltaAction.REMOVE_OUT_EDGE,
+                           out_entry)
+                push_delta(to_v, self.txn, DeltaAction.REMOVE_IN_EDGE, in_entry)
+            from_v.out_edges.append(out_entry)
+            to_v.in_edges.append(in_entry)
+        finally:
+            if second is not first:
+                second.lock.release()
+            first.lock.release()
+        storage._edges[gid] = edge
+        storage.indices.edge_type.add(edge)
+        self.txn.touched_edges[gid] = edge
+        self.txn.touched_vertices[from_v.gid] = from_v
+        self.txn.touched_vertices[to_v.gid] = to_v
+        storage._bump_topology()
+        return EdgeAccessor(edge, self)
+
+    def delete_edge(self, ea: EdgeAccessor):
+        edge = ea.edge
+        from_v, to_v = edge.from_vertex, edge.to_vertex
+        with edge.lock:
+            if not self._analytical:
+                prepare_for_write(edge, self.txn)
+            if edge.deleted:
+                return None
+            if not self._analytical:
+                push_delta(edge, self.txn, DeltaAction.RECREATE_OBJECT, None)
+            edge.deleted = True
+        out_entry = (edge.edge_type, to_v, edge)
+        in_entry = (edge.edge_type, from_v, edge)
+        with from_v.lock:
+            if not self._analytical:
+                prepare_for_write(from_v, self.txn)
+                push_delta(from_v, self.txn, DeltaAction.ADD_OUT_EDGE, out_entry)
+            try:
+                from_v.out_edges.remove(out_entry)
+            except ValueError:
+                pass
+        with to_v.lock:
+            if not self._analytical:
+                prepare_for_write(to_v, self.txn)
+                push_delta(to_v, self.txn, DeltaAction.ADD_IN_EDGE, in_entry)
+            try:
+                to_v.in_edges.remove(in_entry)
+            except ValueError:
+                pass
+        self.txn.touched_edges[edge.gid] = edge
+        self.txn.touched_vertices[from_v.gid] = from_v
+        self.txn.touched_vertices[to_v.gid] = to_v
+        self.storage._bump_topology()
+        return ea
+
+    # --- vertex mutations (called through VertexAccessor) -------------------
+
+    def _vertex_add_label(self, vertex: Vertex, label_id: int) -> bool:
+        with vertex.lock:
+            if not self._analytical:
+                prepare_for_write(vertex, self.txn)
+            if vertex.deleted:
+                raise StorageError("cannot modify a deleted vertex")
+            if label_id in vertex.labels:
+                return False
+            if not self._analytical:
+                push_delta(vertex, self.txn, DeltaAction.REMOVE_LABEL, label_id)
+            vertex.labels.add(label_id)
+        self.storage.indices.label.add(label_id, vertex)
+        self.storage.indices.label_property.update_on_change(vertex)
+        self.txn.touched_vertices[vertex.gid] = vertex
+        return True
+
+    def _vertex_remove_label(self, vertex: Vertex, label_id: int) -> bool:
+        with vertex.lock:
+            if not self._analytical:
+                prepare_for_write(vertex, self.txn)
+            if vertex.deleted:
+                raise StorageError("cannot modify a deleted vertex")
+            if label_id not in vertex.labels:
+                return False
+            if not self._analytical:
+                push_delta(vertex, self.txn, DeltaAction.ADD_LABEL, label_id)
+            vertex.labels.discard(label_id)
+        self.storage.indices.label_property.update_on_change(vertex)
+        self.txn.touched_vertices[vertex.gid] = vertex
+        return True
+
+    def _vertex_set_property(self, vertex: Vertex, prop_id: int, value):
+        with vertex.lock:
+            if not self._analytical:
+                prepare_for_write(vertex, self.txn)
+            if vertex.deleted:
+                raise StorageError("cannot modify a deleted vertex")
+            old = vertex.properties.get(prop_id)
+            if not self._analytical:
+                push_delta(vertex, self.txn, DeltaAction.SET_PROPERTY,
+                           (prop_id, old))
+            if value is None:
+                vertex.properties.pop(prop_id, None)
+            else:
+                vertex.properties[prop_id] = value
+        self.storage.indices.label_property.update_on_change(vertex)
+        self.txn.touched_vertices[vertex.gid] = vertex
+        return old
+
+    def _edge_set_property(self, edge: Edge, prop_id: int, value):
+        if not self.storage.config.properties_on_edges:
+            raise StorageError("properties on edges are disabled")
+        with edge.lock:
+            if not self._analytical:
+                prepare_for_write(edge, self.txn)
+            if edge.deleted:
+                raise StorageError("cannot modify a deleted edge")
+            old = edge.properties.get(prop_id)
+            if not self._analytical:
+                push_delta(edge, self.txn, DeltaAction.SET_PROPERTY,
+                           (prop_id, old))
+            if value is None:
+                edge.properties.pop(prop_id, None)
+            else:
+                edge.properties[prop_id] = value
+        self.txn.touched_edges[edge.gid] = edge
+        return old
+
+    # --- reads --------------------------------------------------------------
+
+    def _vertex_state(self, vertex: Vertex, view: View):
+        txn = self.txn
+        if (txn.isolation is IsolationLevel.READ_UNCOMMITTED
+                or self._analytical):
+            from .delta import MaterializedState
+            with vertex.lock:
+                return MaterializedState(
+                    exists=True, deleted=vertex.deleted,
+                    labels=set(vertex.labels),
+                    properties=dict(vertex.properties),
+                    in_edges=list(vertex.in_edges),
+                    out_edges=list(vertex.out_edges))
+        return materialize_vertex(vertex, txn, view)
+
+    def _edge_state(self, edge: Edge, view: View):
+        txn = self.txn
+        if (txn.isolation is IsolationLevel.READ_UNCOMMITTED
+                or self._analytical):
+            from .delta import MaterializedState
+            with edge.lock:
+                return MaterializedState(
+                    exists=True, deleted=edge.deleted,
+                    properties=dict(edge.properties))
+        return materialize_edge(edge, txn, view)
+
+    def find_vertex(self, gid: Gid, view: View = View.NEW) -> Optional[VertexAccessor]:
+        vertex = self.storage._vertices.get(gid)
+        if vertex is None:
+            return None
+        va = VertexAccessor(vertex, self)
+        return va if va.is_visible(view) else None
+
+    def find_edge(self, gid: Gid, view: View = View.NEW) -> Optional[EdgeAccessor]:
+        edge = self.storage._edges.get(gid)
+        if edge is None:
+            return None
+        ea = EdgeAccessor(edge, self)
+        return ea if ea.is_visible(view) else None
+
+    def vertices(self, view: View = View.OLD) -> Iterator[VertexAccessor]:
+        for vertex in list(self.storage._vertices.values()):
+            va = VertexAccessor(vertex, self)
+            if va.is_visible(view):
+                yield va
+
+    def edges(self, view: View = View.OLD) -> Iterator[EdgeAccessor]:
+        for edge in list(self.storage._edges.values()):
+            ea = EdgeAccessor(edge, self)
+            if ea.is_visible(view):
+                yield ea
+
+    def vertices_by_label(self, label_id: int,
+                          view: View = View.OLD) -> Iterator[VertexAccessor]:
+        candidates = self.storage.indices.label.candidates(label_id)
+        if candidates is None:
+            # no index: full scan filter (planner avoids this when possible)
+            for va in self.vertices(view):
+                if va.has_label(label_id, view):
+                    yield va
+            return
+        for vertex in candidates:
+            va = VertexAccessor(vertex, self)
+            if va.is_visible(view) and va.has_label(label_id, view):
+                yield va
+
+    def vertices_by_label_property_value(self, label_id: int,
+                                         prop_ids: tuple[int, ...], values,
+                                         view: View = View.OLD):
+        candidates = self.storage.indices.label_property.candidates_equal(
+            label_id, prop_ids, values)
+        if candidates is None:
+            for va in self.vertices_by_label(label_id, view):
+                props = va.properties(view)
+                if all(props.get(p) == v and props.get(p) is not None
+                       for p, v in zip(prop_ids, values)):
+                    yield va
+            return
+        for vertex in candidates:
+            va = VertexAccessor(vertex, self)
+            if not va.is_visible(view) or not va.has_label(label_id, view):
+                continue
+            props = va.properties(view)
+            if all(props.get(p) == v for p, v in zip(prop_ids, values)):
+                yield va
+
+    def vertices_by_label_property_range(self, label_id: int,
+                                         prop_ids: tuple[int, ...],
+                                         lower=None, upper=None,
+                                         lower_inclusive=True,
+                                         upper_inclusive=True,
+                                         view: View = View.OLD):
+        from .ordering import order_key
+        candidates = self.storage.indices.label_property.candidates_range(
+            label_id, prop_ids, lower, upper, lower_inclusive, upper_inclusive)
+        if candidates is None:
+            candidates = []
+            for va in self.vertices_by_label(label_id, view):
+                candidates.append(va.vertex)
+        seen: set[int] = set()  # add-only index can hold several keys per gid
+        for vertex in candidates:
+            if vertex.gid in seen:
+                continue
+            seen.add(vertex.gid)
+            va = VertexAccessor(vertex, self)
+            if not va.is_visible(view) or not va.has_label(label_id, view):
+                continue
+            val = va.get_property(prop_ids[0], view)
+            if val is None:
+                continue
+            k = order_key(val)
+            if lower is not None:
+                lk = order_key(lower)
+                if k < lk or (k == lk and not lower_inclusive):
+                    continue
+            if upper is not None:
+                uk = order_key(upper)
+                if k > uk or (k == uk and not upper_inclusive):
+                    continue
+            yield va
+
+    def edges_by_type(self, edge_type_id: int,
+                      view: View = View.OLD) -> Iterator[EdgeAccessor]:
+        candidates = self.storage.indices.edge_type.candidates(edge_type_id)
+        if candidates is None:
+            for ea in self.edges(view):
+                if ea.edge_type == edge_type_id:
+                    yield ea
+            return
+        for edge in candidates:
+            ea = EdgeAccessor(edge, self)
+            if ea.is_visible(view):
+                yield ea
+
+    # --- counts for the planner ---------------------------------------------
+
+    def approx_vertex_count(self, label_id=None, prop_ids=None) -> int:
+        if label_id is None:
+            return len(self.storage._vertices)
+        if prop_ids is None:
+            if self.storage.indices.label.has(label_id):
+                return self.storage.indices.label.approx_count(label_id)
+            return len(self.storage._vertices)
+        return self.storage.indices.label_property.approx_count(label_id, prop_ids)
+
+    def approx_edge_count(self) -> int:
+        return len(self.storage._edges)
+
+
+class InMemoryStorage:
+    """The storage engine. Owns objects, indexes, constraints, mappers."""
+
+    def __init__(self, config: Optional[StorageConfig] = None) -> None:
+        self.config = config or StorageConfig()
+        self.label_mapper = NameIdMapper()
+        self.property_mapper = NameIdMapper()
+        self.edge_type_mapper = NameIdMapper()
+        self.indices = Indices()
+        self.constraints = Constraints()
+        self.namer = _Namer(self)
+
+        self._vertices: dict[Gid, Vertex] = {}
+        self._edges: dict[Gid, Edge] = {}
+        self._next_vertex_gid = 0
+        self._next_edge_gid = 0
+        self._gid_lock = threading.Lock()
+
+        self._timestamp = 1  # commit timestamps; 0 reserved
+        self._next_txn_id = TRANSACTION_ID_START + 1
+        self._engine_lock = threading.Lock()
+        self._active_txns: dict[int, Transaction] = {}
+
+        self._topology_version = 0
+        self.wal_sink: Optional[Callable] = None  # set by durability wiring
+        self.on_commit_hooks: list[Callable] = []  # triggers, replication
+
+    # --- transactions -------------------------------------------------------
+
+    def access(self, isolation: Optional[IsolationLevel] = None) -> Accessor:
+        return Accessor(self, isolation or self.config.isolation_level)
+
+    def _begin_transaction(self, isolation: IsolationLevel) -> Transaction:
+        with self._engine_lock:
+            txn_id = self._next_txn_id
+            self._next_txn_id += 1
+            start_ts = self._timestamp
+            txn = Transaction(txn_id, start_ts, isolation, self)
+            self._active_txns[txn_id] = txn
+            return txn
+
+    def latest_commit_ts(self) -> int:
+        return self._timestamp
+
+    def _commit(self, txn: Transaction) -> None:
+        storage_mode = self.config.storage_mode
+        if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or not txn.deltas:
+            with self._engine_lock:
+                self._active_txns.pop(txn.id, None)
+            return
+
+        touched = list(txn.touched_vertices.values())
+        # existence + type constraints against the transaction's NEW state
+        for v in touched:
+            if not v.deleted:
+                self.constraints.existence.validate_vertex(
+                    v.labels, v.properties, self.namer)
+                self.constraints.type.validate_vertex(
+                    v.labels, v.properties, self.namer)
+
+        with self._engine_lock:
+            registrations = self.constraints.unique.validate_commit(
+                [v for v in touched], self.namer)
+            self._timestamp += 1
+            commit_ts = self._timestamp
+            if self.wal_sink is not None:
+                self.wal_sink(txn, commit_ts)
+            # visibility flip: all the txn's deltas share this CommitInfo
+            txn.commit_info.timestamp = commit_ts
+            self.constraints.unique.apply_registrations(registrations)
+            self._active_txns.pop(txn.id, None)
+        for hook in self.on_commit_hooks:
+            hook(txn, commit_ts)
+
+    def _abort(self, txn: Transaction) -> None:
+        # undo in reverse; our deltas are contiguous at each object's head
+        from .delta import DeltaAction as A
+        for delta in reversed(txn.deltas):
+            obj = delta.obj
+            with obj.lock:
+                a = delta.action
+                if a is A.DELETE_OBJECT:
+                    obj.deleted = True  # created in this txn → now dead, GC removes
+                elif a is A.RECREATE_OBJECT:
+                    obj.deleted = False
+                elif a is A.ADD_LABEL:
+                    obj.labels.add(delta.payload)
+                elif a is A.REMOVE_LABEL:
+                    obj.labels.discard(delta.payload)
+                elif a is A.SET_PROPERTY:
+                    pid, prev = delta.payload
+                    if prev is None:
+                        obj.properties.pop(pid, None)
+                    else:
+                        obj.properties[pid] = prev
+                elif a is A.ADD_IN_EDGE:
+                    obj.in_edges.append(delta.payload)
+                elif a is A.REMOVE_IN_EDGE:
+                    try:
+                        obj.in_edges.remove(delta.payload)
+                    except ValueError:
+                        pass
+                elif a is A.ADD_OUT_EDGE:
+                    obj.out_edges.append(delta.payload)
+                elif a is A.REMOVE_OUT_EDGE:
+                    try:
+                        obj.out_edges.remove(delta.payload)
+                    except ValueError:
+                        pass
+                assert obj.delta is delta, "abort: delta chain corrupted"
+                obj.delta = delta.next
+        for v in txn.touched_vertices.values():
+            self.indices.label_property.update_on_change(v)
+        with self._engine_lock:
+            self._active_txns.pop(txn.id, None)
+        self._bump_topology()
+
+    # --- GC -----------------------------------------------------------------
+
+    def oldest_active_start_ts(self) -> int:
+        with self._engine_lock:
+            if not self._active_txns:
+                return self._timestamp + 1
+            return min(t.start_ts for t in self._active_txns.values())
+
+    def collect_garbage(self) -> dict:
+        """Truncate delta chains invisible to every active txn; drop dead objects.
+
+        Reference analog: InMemoryStorage::CollectGarbage
+        (inmemory/storage.cpp:573) + skip-list GC.
+        """
+        oldest = self.oldest_active_start_ts()
+        stats = {"deltas_freed": 0, "vertices_freed": 0, "edges_freed": 0}
+
+        def truncate(obj) -> None:
+            with obj.lock:
+                delta = obj.delta
+                prev = None
+                while delta is not None:
+                    ts = delta.commit_info.timestamp
+                    if ts < TRANSACTION_ID_START and ts < oldest:
+                        # this and everything older is invisible to all readers
+                        n = 0
+                        d = delta
+                        while d is not None:
+                            n += 1
+                            d = d.next
+                        stats["deltas_freed"] += n
+                        if prev is None:
+                            obj.delta = None
+                        else:
+                            prev.next = None
+                        return
+                    prev = delta
+                    delta = delta.next
+
+        dead_vertices = []
+        for gid, v in list(self._vertices.items()):
+            truncate(v)
+            if v.deleted and v.delta is None:
+                dead_vertices.append((gid, v))
+        dead_edges = []
+        for gid, e in list(self._edges.items()):
+            truncate(e)
+            if e.deleted and e.delta is None:
+                dead_edges.append((gid, e))
+
+        for gid, v in dead_vertices:
+            for label_id in list(v.labels):
+                self.indices.label.remove_entry(label_id, v)
+            self.indices.label_property.remove_entry(v)
+            self._vertices.pop(gid, None)
+            stats["vertices_freed"] += 1
+        for gid, e in dead_edges:
+            self.indices.edge_type.remove_entry(e)
+            self._edges.pop(gid, None)
+            stats["edges_freed"] += 1
+        stats["index_entries_swept"] = (self.indices.label.sweep()
+                                        + self.indices.label_property.sweep())
+        return stats
+
+    # --- schema operations (run outside transactions, like the reference's
+    #     unique-accessor index/constraint DDL) ------------------------------
+
+    def create_label_index(self, label_id: int) -> None:
+        self.indices.label.create(label_id, self._vertices.values())
+
+    def create_label_property_index(self, label_id: int,
+                                    prop_ids: tuple[int, ...]) -> None:
+        self.indices.label_property.create(label_id, prop_ids,
+                                           self._vertices.values())
+
+    def create_edge_type_index(self, edge_type_id: int) -> None:
+        self.indices.edge_type.create(edge_type_id, self._edges.values())
+
+    def create_existence_constraint(self, label_id: int, prop_id: int) -> None:
+        self.constraints.existence.create(label_id, prop_id,
+                                          self._vertices.values(), self.namer)
+
+    def create_unique_constraint(self, label_id: int,
+                                 prop_ids: tuple[int, ...]) -> None:
+        self.constraints.unique.create(label_id, prop_ids,
+                                       self._vertices.values(), self.namer)
+
+    def create_type_constraint(self, label_id: int, prop_id: int,
+                               type_name: str) -> None:
+        self.constraints.type.create(label_id, prop_id, type_name,
+                                     self._vertices.values(), self.namer)
+
+    # --- TPU snapshot cache signal ------------------------------------------
+
+    def _bump_topology(self) -> None:
+        self._topology_version += 1
+
+    @property
+    def topology_version(self) -> int:
+        return self._topology_version
+
+    # --- info ---------------------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "vertex_count": len(self._vertices),
+            "edge_count": len(self._edges),
+            "average_degree": (2 * len(self._edges) / len(self._vertices)
+                               if self._vertices else 0.0),
+            "storage_mode": self.config.storage_mode.value,
+            "isolation_level": self.config.isolation_level.value,
+        }

@@ -1,0 +1,299 @@
+"""Index, constraint, and property-codec tests (reference: tests/unit/storage_v2_indices.cpp etc.)."""
+
+import pytest
+
+from memgraph_tpu.exceptions import ConstraintViolation
+from memgraph_tpu.storage import InMemoryStorage, View
+from memgraph_tpu.storage.property_store import (decode_properties,
+                                                 encode_properties)
+from memgraph_tpu.utils.point import Point
+from memgraph_tpu.utils.temporal import Date, Duration, LocalDateTime, LocalTime
+
+
+def _mk_people(storage, n=10):
+    person = storage.label_mapper.name_to_id("Person")
+    age = storage.property_mapper.name_to_id("age")
+    acc = storage.access()
+    gids = []
+    for i in range(n):
+        v = acc.create_vertex()
+        v.add_label(person)
+        v.set_property(age, i)
+        gids.append(v.gid)
+    acc.commit()
+    return person, age, gids
+
+
+def test_label_index_scan(storage):
+    person, age, gids = _mk_people(storage)
+    storage.create_label_index(person)
+    acc = storage.access()
+    found = [v.gid for v in acc.vertices_by_label(person)]
+    assert sorted(found) == sorted(gids)
+    acc.abort()
+
+
+def test_label_index_tracks_new_vertices(storage):
+    person, age, gids = _mk_people(storage)
+    storage.create_label_index(person)
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.add_label(person)
+    acc.commit()
+    acc2 = storage.access()
+    assert len(list(acc2.vertices_by_label(person))) == 11
+    acc2.abort()
+
+
+def test_label_index_mvcc_filtering(storage):
+    person, age, gids = _mk_people(storage, 3)
+    storage.create_label_index(person)
+    # uncommitted label-add must not leak into other transactions' scans
+    t1 = storage.access()
+    v = t1.create_vertex()
+    v.add_label(person)
+    t2 = storage.access()
+    assert len(list(t2.vertices_by_label(person))) == 3
+    t2.abort()
+    t1.abort()
+    t3 = storage.access()
+    assert len(list(t3.vertices_by_label(person))) == 3
+    t3.abort()
+
+
+def test_label_property_index_equal_and_range(storage):
+    person, age, gids = _mk_people(storage, 10)
+    storage.create_label_property_index(person, (age,))
+    acc = storage.access()
+    eq = list(acc.vertices_by_label_property_value(person, (age,), [5]))
+    assert len(eq) == 1 and eq[0].get_property(age) == 5
+    rng = list(acc.vertices_by_label_property_range(
+        person, (age,), lower=3, upper=7, upper_inclusive=False))
+    assert sorted(v.get_property(age) for v in rng) == [3, 4, 5, 6]
+    acc.abort()
+
+
+def test_label_property_index_updates_on_set(storage):
+    person, age, gids = _mk_people(storage, 3)
+    storage.create_label_property_index(person, (age,))
+    acc = storage.access()
+    v = acc.find_vertex(gids[0])
+    v.set_property(age, 100)
+    acc.commit()
+    acc2 = storage.access()
+    got = list(acc2.vertices_by_label_property_value(person, (age,), [100]))
+    assert [x.gid for x in got] == [gids[0]]
+    assert list(acc2.vertices_by_label_property_value(person, (age,), [0])) == []
+    acc2.abort()
+
+
+def test_index_scan_sees_old_value_during_concurrent_write(storage):
+    """Regression: an uncommitted property write must not hide the vertex
+    from concurrent snapshot readers scanning the index under the old value."""
+    person, age, gids = _mk_people(storage, 5)
+    storage.create_label_property_index(person, (age,))
+    t1 = storage.access()
+    t2 = storage.access()
+    v1 = next(iter(t1.vertices_by_label_property_value(person, (age,), [3])))
+    v1.set_property(age, 99)
+    # t2's snapshot predates the write: must still find the vertex at 3
+    found = list(t2.vertices_by_label_property_value(person, (age,), [3]))
+    assert [v.gid for v in found] == [v1.gid]
+    # and t1 itself finds it under the new value
+    found_new = list(t1.vertices_by_label_property_value(person, (age,), [99],
+                                                         view=View.NEW))
+    assert [v.gid for v in found_new] == [v1.gid]
+    t1.commit()
+    t2.abort()
+    # after commit + GC sweep the stale entry disappears
+    storage.collect_garbage()
+    slot = storage.indices.label_property._index[(person, (age,))]
+    assert len(slot["sorted"]) == 5
+
+
+def test_composite_index(storage):
+    person = storage.label_mapper.name_to_id("Person")
+    a = storage.property_mapper.name_to_id("a")
+    b = storage.property_mapper.name_to_id("b")
+    acc = storage.access()
+    for i in range(4):
+        v = acc.create_vertex()
+        v.add_label(person)
+        v.set_property(a, i % 2)
+        v.set_property(b, i)
+    acc.commit()
+    storage.create_label_property_index(person, (a, b))
+    acc2 = storage.access()
+    got = list(acc2.vertices_by_label_property_value(person, (a, b), [1, 3]))
+    assert len(got) == 1
+    assert got[0].get_property(b) == 3
+    acc2.abort()
+
+
+def test_existence_constraint(storage):
+    person = storage.label_mapper.name_to_id("Person")
+    name = storage.property_mapper.name_to_id("name")
+    storage.create_existence_constraint(person, name)
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.add_label(person)
+    with pytest.raises(ConstraintViolation):
+        acc.commit()
+    # violating txn was rolled back
+    acc2 = storage.access()
+    assert list(acc2.vertices()) == []
+    acc2.abort()
+
+
+def test_unique_constraint(storage):
+    person = storage.label_mapper.name_to_id("Person")
+    email = storage.property_mapper.name_to_id("email")
+    storage.create_unique_constraint(person, (email,))
+    acc = storage.access()
+    v1 = acc.create_vertex()
+    v1.add_label(person)
+    v1.set_property(email, "a@x.com")
+    acc.commit()
+
+    acc2 = storage.access()
+    v2 = acc2.create_vertex()
+    v2.add_label(person)
+    v2.set_property(email, "a@x.com")
+    with pytest.raises(ConstraintViolation):
+        acc2.commit()
+
+    # different value passes
+    acc3 = storage.access()
+    v3 = acc3.create_vertex()
+    v3.add_label(person)
+    v3.set_property(email, "b@x.com")
+    acc3.commit()
+
+
+def test_unique_constraint_existing_violation(storage):
+    person = storage.label_mapper.name_to_id("Person")
+    email = storage.property_mapper.name_to_id("email")
+    acc = storage.access()
+    for _ in range(2):
+        v = acc.create_vertex()
+        v.add_label(person)
+        v.set_property(email, "dup@x.com")
+    acc.commit()
+    with pytest.raises(ConstraintViolation):
+        storage.create_unique_constraint(person, (email,))
+
+
+def test_unique_constraint_released_on_delete(storage):
+    person = storage.label_mapper.name_to_id("Person")
+    email = storage.property_mapper.name_to_id("email")
+    storage.create_unique_constraint(person, (email,))
+    acc = storage.access()
+    v1 = acc.create_vertex()
+    v1.add_label(person)
+    v1.set_property(email, "a@x.com")
+    gid = v1.gid
+    acc.commit()
+
+    d = storage.access()
+    d.delete_vertex(d.find_vertex(gid))
+    d.commit()
+
+    acc2 = storage.access()
+    v2 = acc2.create_vertex()
+    v2.add_label(person)
+    v2.set_property(email, "a@x.com")
+    acc2.commit()  # should not raise
+
+
+def test_unique_constraint_same_transaction(storage):
+    """Two vertices with the same unique key in ONE transaction must fail."""
+    person = storage.label_mapper.name_to_id("Person")
+    email = storage.property_mapper.name_to_id("email")
+    storage.create_unique_constraint(person, (email,))
+    acc = storage.access()
+    for _ in range(2):
+        v = acc.create_vertex()
+        v.add_label(person)
+        v.set_property(email, "dup@x.com")
+    with pytest.raises(ConstraintViolation):
+        acc.commit()
+
+
+def test_unique_constraint_numeric_equality(storage):
+    """1 and 1.0 are the same Cypher value → unique violation."""
+    person = storage.label_mapper.name_to_id("Person")
+    score = storage.property_mapper.name_to_id("score")
+    storage.create_unique_constraint(person, (score,))
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.add_label(person)
+    v.set_property(score, 1)
+    acc.commit()
+    acc2 = storage.access()
+    v2 = acc2.create_vertex()
+    v2.add_label(person)
+    v2.set_property(score, 1.0)
+    with pytest.raises(ConstraintViolation):
+        acc2.commit()
+
+
+def test_range_scan_no_duplicates_after_update(storage):
+    """A vertex whose indexed value changed must appear once in a range scan."""
+    person, age, gids = _mk_people(storage, 3)
+    storage.create_label_property_index(person, (age,))
+    acc = storage.access()
+    acc.find_vertex(gids[0]).set_property(age, 5)  # 0 -> 5, both in range
+    acc.commit()
+    r = storage.access()
+    got = [v.gid for v in r.vertices_by_label_property_range(
+        person, (age,), lower=0, upper=10)]
+    assert sorted(got) == sorted(gids)
+    r.abort()
+
+
+def test_explicit_gid_collision(storage):
+    from memgraph_tpu.exceptions import StorageError
+    acc = storage.access()
+    acc.create_vertex(gid=7)
+    acc.commit()
+    acc2 = storage.access()
+    with pytest.raises(StorageError):
+        acc2.create_vertex(gid=7)
+    acc2.abort()
+
+
+def test_property_codec_roundtrip():
+    props = {
+        0: None, 1: True, 2: False, 3: 42, 4: -7, 5: 2 ** 70,
+        6: 3.14159, 7: "héllo wörld", 8: b"\x00\x01\xff",
+        9: [1, "two", [3.0, None]], 10: {"k": 1, "nested": {"a": [True]}},
+        11: Date.parse("2024-02-29"), 12: LocalTime.parse("13:37:00.123456"),
+        13: LocalDateTime.parse("2024-06-15T08:30:00"),
+        14: Duration.from_parts(days=2, hours=3, seconds=1.5),
+        15: Point.from_map({"x": 1.0, "y": 2.0}),
+        16: Point.from_map({"longitude": 16.0, "latitude": 45.0}),
+    }
+    data = encode_properties(props)
+    out = decode_properties(data)
+    assert out == props
+
+
+def test_property_codec_deterministic():
+    a = encode_properties({2: "x", 1: [1, 2]})
+    b = encode_properties({1: [1, 2], 2: "x"})
+    assert a == b
+
+
+def test_edge_type_index(storage):
+    knows = storage.edge_type_mapper.name_to_id("KNOWS")
+    likes = storage.edge_type_mapper.name_to_id("LIKES")
+    acc = storage.access()
+    a, b = acc.create_vertex(), acc.create_vertex()
+    acc.create_edge(a, b, knows)
+    acc.create_edge(b, a, likes)
+    acc.commit()
+    storage.create_edge_type_index(knows)
+    acc2 = storage.access()
+    es = list(acc2.edges_by_type(knows))
+    assert len(es) == 1 and es[0].edge_type == knows
+    acc2.abort()
