@@ -1,0 +1,170 @@
+"""North-star benchmark: PageRank edges/sec on a 10M-edge graph (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  value       = TPU PageRank throughput in edges/sec (n_edges * iterations /
+                wall seconds, compile excluded, fixed iteration count)
+  vs_baseline = speedup over the CPU baseline: scipy.sparse CSR power
+                iteration on this host — the same sparse-matvec formulation
+                the reference's C++ pagerank module implements
+                (/root/reference/mage/cpp/pagerank_module), measured on the
+                same graph with the same iteration count.
+
+Also verifies top-100 rank parity between the TPU and CPU implementations
+(the BASELINE.json acceptance criterion) and reports CALL-to-first-record
+latency through the module/CSR-cache path on a smaller stored graph.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_NODES = 1_000_000
+N_EDGES = 10_000_000
+ITERATIONS = 50
+DAMPING = 0.85
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def generate_graph(n_nodes=N_NODES, n_edges=N_EDGES, seed=7):
+    """Skewed random digraph: power-law-ish in-degree via squared sampling
+    (supernode skew stresses the segment reductions, SURVEY.md §7)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
+    # bias destinations toward low ids → heavy-tail in-degree
+    dst = (rng.random(n_edges) ** 2 * n_nodes).astype(np.int64)
+    return src, dst
+
+
+def cpu_pagerank(src, dst, n_nodes, iterations=ITERATIONS, damping=DAMPING):
+    """Baseline: scipy CSR power iteration (the C++ module's formulation)."""
+    import scipy.sparse as sp
+    w = np.ones(len(src), dtype=np.float64)
+    deg = np.bincount(src, minlength=n_nodes).astype(np.float64)
+    inv_deg = np.where(deg > 0, 1.0 / np.maximum(deg, 1), 0.0)
+    # column-normalized adjacency: rank flows src -> dst
+    mat = sp.csr_matrix((w * inv_deg[src], (dst, src)),
+                        shape=(n_nodes, n_nodes))
+    dangling = deg == 0
+    rank = np.full(n_nodes, 1.0 / n_nodes)
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        dm = rank[dangling].sum()
+        rank = (1 - damping) / n_nodes + damping * (mat @ rank + dm / n_nodes)
+    elapsed = time.perf_counter() - t0
+    return rank, elapsed
+
+
+def tpu_pagerank(graph, iterations=ITERATIONS, damping=DAMPING):
+    from memgraph_tpu.ops.pagerank import _pagerank_kernel
+    import jax.numpy as jnp
+
+    def run(d):
+        return _pagerank_kernel(graph.src_idx, graph.col_idx, graph.weights,
+                                jnp.int32(graph.n_nodes), graph.n_pad,
+                                jnp.float32(d), iterations,
+                                jnp.float32(0.0))  # tol=0 → fixed iterations
+
+    # compile + warm up (excluded from timing); host-transfer forces
+    # completion — block_until_ready is unreliable on the tunneled platform
+    rank, err, iters = run(damping)
+    _ = float(rank[0])
+    t0 = time.perf_counter()
+    rank, err, iters = run(damping)
+    _ = float(rank[0])  # host sync
+    elapsed = time.perf_counter() - t0
+    assert int(iters) == iterations, f"expected {iterations}, ran {int(iters)}"
+    return np.asarray(rank[:graph.n_nodes]), elapsed
+
+
+def call_to_first_record_latency():
+    """End-to-end module-path latency on a 100k-edge stored graph."""
+    from memgraph_tpu.storage import InMemoryStorage, StorageConfig, StorageMode
+    from memgraph_tpu.ops.csr import GraphCache
+    from memgraph_tpu.ops.pagerank import pagerank
+
+    storage = InMemoryStorage(StorageConfig(
+        storage_mode=StorageMode.IN_MEMORY_ANALYTICAL))
+    rng = np.random.default_rng(3)
+    n, e = 20_000, 100_000
+    acc = storage.access()
+    et = storage.edge_type_mapper.name_to_id("E")
+    vs = [acc.create_vertex() for _ in range(n)]
+    for s, d in zip(rng.integers(0, n, e), rng.integers(0, n, e)):
+        acc.create_edge(vs[s], vs[d], et)
+    acc.commit()
+
+    cache = GraphCache()
+    acc = storage.access()
+    t0 = time.perf_counter()
+    g = cache.get(acc)
+    ranks, _, _ = pagerank(g, max_iterations=100, tol=1e-6)
+    first = (int(g.node_gids[0]), float(ranks[0]))
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    g = cache.get(acc)
+    ranks, _, _ = pagerank(g, max_iterations=100, tol=1e-6)
+    ranks[0].block_until_ready()
+    warm = time.perf_counter() - t0
+    acc.abort()
+    return cold, warm
+
+
+def main():
+    import jax
+    log(f"devices: {jax.devices()}")
+
+    from memgraph_tpu.ops import csr
+
+    log(f"generating {N_EDGES:,}-edge graph ...")
+    src, dst = generate_graph()
+
+    log("building CSR ...")
+    t0 = time.perf_counter()
+    graph = csr.from_coo(src, dst, n_nodes=N_NODES).to_device()
+    log(f"  export+transfer: {time.perf_counter() - t0:.2f}s "
+        f"(n_pad={graph.n_pad:,}, e_pad={graph.e_pad:,})")
+
+    log("TPU pagerank ...")
+    tpu_ranks, tpu_time = tpu_pagerank(graph)
+    tpu_eps = N_EDGES * ITERATIONS / tpu_time
+    log(f"  {tpu_time:.3f}s for {ITERATIONS} iterations -> {tpu_eps:,.0f} edges/s")
+
+    log("CPU baseline (scipy CSR power iteration) ...")
+    cpu_ranks, cpu_time = cpu_pagerank(src, dst, N_NODES)
+    cpu_eps = N_EDGES * ITERATIONS / cpu_time
+    log(f"  {cpu_time:.3f}s -> {cpu_eps:,.0f} edges/s")
+
+    # acceptance: top-100 rank parity
+    top_tpu = set(np.argsort(-tpu_ranks)[:100].tolist())
+    top_cpu = set(np.argsort(-cpu_ranks)[:100].tolist())
+    overlap = len(top_tpu & top_cpu)
+    log(f"top-100 overlap: {overlap}/100")
+
+    cold, warm = call_to_first_record_latency()
+    log(f"CALL-to-first-record: cold={cold * 1e3:.1f}ms warm={warm * 1e3:.1f}ms")
+
+    result = {
+        "metric": "pagerank_edges_per_sec_10M",
+        "value": round(tpu_eps, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(tpu_eps / cpu_eps, 3),
+        "extra": {
+            "tpu_seconds_50iter": round(tpu_time, 4),
+            "cpu_seconds_50iter": round(cpu_time, 4),
+            "top100_overlap": overlap,
+            "call_to_first_record_cold_ms": round(cold * 1e3, 1),
+            "call_to_first_record_warm_ms": round(warm * 1e3, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

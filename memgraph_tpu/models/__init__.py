@@ -1,0 +1,12 @@
+"""Model families built on the graph framework.
+
+TPU-native counterparts of the reference's ML-flavored MAGE modules
+(/root/reference/mage/python/): node2vec embeddings (node2vec.py), with
+link-prediction / node-classification heads reusing the same embedding
+machinery. Training is ordinary JAX: jitted steps, optax optimizers,
+shardable over a (data, model) mesh.
+"""
+
+from .node2vec import Node2Vec, Node2VecConfig
+
+__all__ = ["Node2Vec", "Node2VecConfig"]

@@ -1,0 +1,132 @@
+"""node2vec: biased random walks + skip-gram with negative sampling.
+
+Counterpart of /root/reference/mage/python/node2vec.py (gensim Word2Vec on
+host walks) and node2vec_online — redesigned for TPU: walks are sampled on
+device (ops/walks.py), and the skip-gram objective trains embedding tables
+with a jitted optax step. The tables shard over a (data, model) mesh:
+batch across `data`, embedding dimension across `model` — the layout
+`dryrun_multichip` validates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.csr import DeviceGraph
+from ..ops.walks import random_walks, walks_to_skipgram_pairs
+
+
+@dataclass
+class Node2VecConfig:
+    embedding_dim: int = 128
+    walk_length: int = 20
+    walks_per_node: int = 4
+    window: int = 5
+    negatives: int = 5
+    p: float = 1.0
+    q: float = 1.0
+    learning_rate: float = 0.01
+    epochs: int = 3
+    batch_size: int = 8192
+    seed: int = 0
+
+
+def init_params(n_nodes_pad: int, dim: int, key):
+    k1, k2 = jax.random.split(key)
+    scale = 1.0 / jnp.sqrt(dim)
+    return {
+        "in": jax.random.normal(k1, (n_nodes_pad, dim), jnp.float32) * scale,
+        "out": jax.random.normal(k2, (n_nodes_pad, dim), jnp.float32) * scale,
+    }
+
+
+def sgns_loss(params, centers, contexts, negatives):
+    """Skip-gram negative-sampling loss; -1 ids mask out padding pairs."""
+    mask = ((centers >= 0) & (contexts >= 0)).astype(jnp.float32)
+    c = jnp.maximum(centers, 0)
+    t = jnp.maximum(contexts, 0)
+    e_c = params["in"][c]                        # (B, D)
+    e_t = params["out"][t]                       # (B, D)
+    e_n = params["out"][negatives]               # (B, K, D)
+    pos = jnp.sum(e_c * e_t, axis=-1)
+    neg = jnp.einsum("bd,bkd->bk", e_c, e_n)
+    pos_loss = jax.nn.softplus(-pos)
+    neg_loss = jnp.sum(jax.nn.softplus(neg), axis=-1)
+    return jnp.sum((pos_loss + neg_loss) * mask) / jnp.maximum(mask.sum(), 1.0)
+
+
+@partial(jax.jit, static_argnames=("optimizer",))
+def train_step(params, opt_state, centers, contexts, negatives, optimizer):
+    loss, grads = jax.value_and_grad(sgns_loss)(params, centers, contexts,
+                                                negatives)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+class Node2Vec:
+    """End-to-end node2vec trainer over a DeviceGraph."""
+
+    def __init__(self, config: Node2VecConfig | None = None):
+        self.config = config or Node2VecConfig()
+
+    def fit(self, graph: DeviceGraph, verbose: bool = False) -> np.ndarray:
+        cfg = self.config
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pk = jax.random.split(key)
+        params = init_params(graph.n_pad, cfg.embedding_dim, pk)
+        optimizer = optax.adam(cfg.learning_rate)
+        opt_state = optimizer.init(params)
+
+        starts = jnp.tile(jnp.arange(graph.n_nodes, dtype=jnp.int32),
+                          cfg.walks_per_node)
+        for epoch in range(cfg.epochs):
+            key, wk, sk = jax.random.split(key, 3)
+            walks = random_walks(graph, starts, cfg.walk_length, key=wk,
+                                 p=cfg.p, q=cfg.q)
+            pairs = walks_to_skipgram_pairs(walks, cfg.window)
+            pairs = jax.random.permutation(sk, pairs, axis=0)
+            n_pairs = pairs.shape[0]
+            B = cfg.batch_size
+            n_batches = max(n_pairs // B, 1)
+            for b in range(n_batches):
+                batch = pairs[b * B:(b + 1) * B]
+                if batch.shape[0] < B:  # keep shapes static for jit
+                    pad = jnp.full((B - batch.shape[0], 2), -1, batch.dtype)
+                    batch = jnp.concatenate([batch, pad])
+                key, nk = jax.random.split(key)
+                negs = jax.random.randint(nk, (B, cfg.negatives), 0,
+                                          graph.n_nodes)
+                params, opt_state, loss = train_step(
+                    params, opt_state, batch[:, 0], batch[:, 1], negs,
+                    optimizer)
+            if verbose:
+                print(f"epoch {epoch}: loss={float(loss):.4f}")
+        return np.asarray(params["in"][:graph.n_nodes])
+
+
+def build_sharded_train_step(mesh, optimizer):
+    """Jitted train step with explicit shardings for dryrun_multichip:
+    embedding tables sharded over the `model` axis (tensor parallel),
+    batch over `data` (data parallel)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    param_sharding = {"in": NamedSharding(mesh, P(None, "model")),
+                      "out": NamedSharding(mesh, P(None, "model"))}
+    batch_sharding = NamedSharding(mesh, P("data"))
+
+    @partial(jax.jit, static_argnames=())
+    def step(params, opt_state, centers, contexts, negatives):
+        loss, grads = jax.value_and_grad(sgns_loss)(params, centers,
+                                                    contexts, negatives)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, param_sharding, batch_sharding

@@ -1,0 +1,15 @@
+"""TPU analytics kernels over immutable CSR graph snapshots.
+
+This is the TPU-native analog of the reference's MAGE algorithm layer
+(/root/reference/mage/cpp, mage/cpp/cugraph_module/algorithms/*.cu): instead
+of C++/CUDA modules walking an adjacency-list snapshot, the graph is exported
+once into device-resident CSR arrays (csr.py) and algorithms run as jitted
+XLA programs built from segment reductions (`jax.ops.segment_sum`-style),
+`lax.while_loop` iteration, and MXU matmuls for the dense paths (kNN,
+embeddings). Static shapes throughout: edge/vertex arrays are padded to
+bucketed sizes so recompilation is amortized across graph mutations.
+"""
+
+from .csr import DeviceGraph, export_csr, GraphCache
+
+__all__ = ["DeviceGraph", "export_csr", "GraphCache"]

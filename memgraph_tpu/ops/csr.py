@@ -1,0 +1,245 @@
+"""Graph snapshot → device CSR export.
+
+The seam between the MVCC host store and the TPU kernels, playing the role
+the reference's `mg_graph::Graph` snapshot plays for MAGE modules
+(/root/reference/include/mg_utils.hpp:128-170 builds an adjacency-list copy
+by iterating the mgp_graph view): here the snapshot is a set of padded,
+immutable device arrays in CSR form.
+
+Design points for XLA (SURVEY.md §7 "hard parts"):
+  - **Static shapes**: `n_nodes`/`n_edges` are padded up to bucket sizes
+    (powers of two by default) so repeated exports of a mutating graph hit
+    the same compiled kernels. Padding edges point at a sink row whose
+    weight is 0 and whose src degree is 0, so segment reductions ignore them.
+  - **Dense ids**: storage gids are compacted to [0, n); the mapping back to
+    gids rides along host-side for result streaming.
+  - **Topology cache**: exports are cached per (storage, topology_version,
+    weight_property) so repeated CALLs don't re-export an unchanged graph —
+    the staleness contract matches the reference's "online" modules, which
+    also compute over their own snapshot of the graph.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..storage.common import View
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (compilation-amortizing bucket)."""
+    n = max(n, minimum)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass(frozen=True)
+class DeviceGraph:
+    """Immutable CSR snapshot. Arrays may live on device (jax) or host (np).
+
+    row_ptr:    (n_pad+1,) int32 — CSR offsets over *sorted-by-src* edges
+    col_idx:    (e_pad,)   int32 — destination node per edge
+    src_idx:    (e_pad,)   int32 — source node per edge (COO mirror; segment
+                                   reductions by destination need it)
+    weights:    (e_pad,)   float32 — edge weight (1.0 default, 0.0 padding)
+    out_degree: (n_pad,)   float32 — true out-degrees (0 for padding rows)
+    n_nodes / n_edges: true counts;  n_pad / e_pad: padded counts
+    node_gids:  (n_nodes,) int64 host array — dense index -> storage gid
+    """
+
+    row_ptr: object
+    col_idx: object
+    src_idx: object
+    weights: object
+    out_degree: object
+    n_nodes: int
+    n_edges: int
+    n_pad: int
+    e_pad: int
+    node_gids: np.ndarray
+    gid_to_idx: dict = field(repr=False, hash=False, compare=False)
+
+    def to_device(self) -> "DeviceGraph":
+        import jax.numpy as jnp
+        return DeviceGraph(
+            row_ptr=jnp.asarray(self.row_ptr),
+            col_idx=jnp.asarray(self.col_idx),
+            src_idx=jnp.asarray(self.src_idx),
+            weights=jnp.asarray(self.weights),
+            out_degree=jnp.asarray(self.out_degree),
+            n_nodes=self.n_nodes, n_edges=self.n_edges,
+            n_pad=self.n_pad, e_pad=self.e_pad,
+            node_gids=self.node_gids, gid_to_idx=self.gid_to_idx)
+
+
+def from_coo(src: np.ndarray, dst: np.ndarray,
+             weights: Optional[np.ndarray] = None,
+             n_nodes: Optional[int] = None,
+             node_gids: Optional[np.ndarray] = None,
+             pad: bool = True) -> DeviceGraph:
+    """Build a host-side DeviceGraph from COO edge arrays (dense node ids)."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    n_edges = len(src)
+    if n_nodes is None:
+        n_nodes = int(max(src.max(initial=-1), dst.max(initial=-1)) + 1)
+    if weights is None:
+        weights = np.ones(n_edges, dtype=np.float32)
+    else:
+        weights = np.asarray(weights, dtype=np.float32)
+
+    n_pad = _bucket(n_nodes + 1) if pad else n_nodes + 1
+    e_pad = _bucket(n_edges) if pad else max(n_edges, 1)
+    # padding edges: sink->sink self loops with zero weight; the sink is the
+    # extra padding row n_nodes (guaranteed to exist since n_pad >= n_nodes+1)
+    sink = n_nodes
+
+    # lexicographic (src, dst) order: rows contiguous AND sorted by dst, so
+    # device-side edge-membership queries can binary-search within a row
+    order = np.lexsort((dst, src))
+    s_sorted = src[order]
+    d_sorted = dst[order]
+    w_sorted = weights[order]
+
+    src_full = np.full(e_pad, sink, dtype=np.int32)
+    dst_full = np.full(e_pad, sink, dtype=np.int32)
+    w_full = np.zeros(e_pad, dtype=np.float32)
+    src_full[:n_edges] = s_sorted
+    dst_full[:n_edges] = d_sorted
+    w_full[:n_edges] = w_sorted
+
+    counts = np.bincount(s_sorted, minlength=n_pad).astype(np.int64)
+    row_ptr = np.zeros(n_pad + 1, dtype=np.int32)
+    np.cumsum(counts, out=row_ptr[1:])
+
+    out_degree = np.zeros(n_pad, dtype=np.float32)
+    out_degree[:n_nodes] = np.bincount(
+        src, minlength=n_nodes).astype(np.float32)[:n_nodes]
+
+    if node_gids is None:
+        node_gids = np.arange(n_nodes, dtype=np.int64)
+    gid_to_idx = {int(g): i for i, g in enumerate(node_gids)}
+
+    return DeviceGraph(row_ptr=row_ptr, col_idx=dst_full, src_idx=src_full,
+                       weights=w_full, out_degree=out_degree,
+                       n_nodes=n_nodes, n_edges=n_edges,
+                       n_pad=n_pad, e_pad=e_pad,
+                       node_gids=np.asarray(node_gids, dtype=np.int64),
+                       gid_to_idx=gid_to_idx)
+
+
+def export_csr(accessor, weight_property: Optional[int] = None,
+               label_filter: Optional[int] = None,
+               edge_type_filter: Optional[set] = None,
+               view: View = View.OLD,
+               pad: bool = True,
+               to_device: bool = True) -> DeviceGraph:
+    """Export the accessor's visible graph as CSR arrays.
+
+    Fast path: objects with no delta chain are read directly (no MVCC
+    materialization); only objects with version chains pay the walk.
+    """
+    storage = accessor.storage
+    txn = accessor.txn
+
+    node_gids = []
+    gid_to_idx: dict[int, int] = {}
+    for vertex in list(storage._vertices.values()):
+        if vertex.delta is None:
+            if vertex.deleted:
+                continue
+            if label_filter is not None and label_filter not in vertex.labels:
+                continue
+        else:
+            from ..storage.storage import VertexAccessor
+            va = VertexAccessor(vertex, accessor)
+            if not va.is_visible(view):
+                continue
+            if label_filter is not None and not va.has_label(label_filter, view):
+                continue
+        gid_to_idx[vertex.gid] = len(node_gids)
+        node_gids.append(vertex.gid)
+
+    srcs, dsts, ws = [], [], []
+    has_w = weight_property is not None
+    for edge in list(storage._edges.values()):
+        if edge.delta is None:
+            if edge.deleted:
+                continue
+            props = edge.properties if has_w else None
+        else:
+            from ..storage.storage import EdgeAccessor
+            ea = EdgeAccessor(edge, accessor)
+            if not ea.is_visible(view):
+                continue
+            props = ea.properties(view) if has_w else None
+        if edge_type_filter is not None and edge.edge_type not in edge_type_filter:
+            continue
+        si = gid_to_idx.get(edge.from_vertex.gid)
+        di = gid_to_idx.get(edge.to_vertex.gid)
+        if si is None or di is None:
+            continue
+        srcs.append(si)
+        dsts.append(di)
+        if has_w:
+            w = props.get(weight_property) if props else None
+            ws.append(float(w) if isinstance(w, (int, float))
+                      and not isinstance(w, bool) else 1.0)
+
+    g = from_coo(np.asarray(srcs, dtype=np.int64),
+                 np.asarray(dsts, dtype=np.int64),
+                 np.asarray(ws, dtype=np.float32) if has_w else None,
+                 n_nodes=len(node_gids),
+                 node_gids=np.asarray(node_gids, dtype=np.int64),
+                 pad=pad)
+    return g.to_device() if to_device else g
+
+
+class GraphCache:
+    """Per-storage cache of device CSR snapshots keyed by topology version.
+
+    The framework-level staleness contract: a cached snapshot is valid while
+    `storage.topology_version` is unchanged; any commit that touches
+    topology (or properties, conservatively) bumps the version.
+
+    Keyed on the storage object itself via a WeakKeyDictionary so snapshots
+    die with their storage (no id()-recycling hazard, no leak).
+    """
+
+    def __init__(self) -> None:
+        import weakref
+        self._lock = threading.Lock()
+        self._cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    def get(self, accessor, weight_property=None, label_filter=None,
+            edge_type_filter=None) -> DeviceGraph:
+        storage = accessor.storage
+        etf = (tuple(sorted(edge_type_filter))
+               if edge_type_filter is not None else None)
+        key = (storage.topology_version, weight_property, label_filter, etf)
+        with self._lock:
+            per_storage = self._cache.get(storage)
+            hit = per_storage.get(key) if per_storage else None
+        if hit is not None:
+            return hit
+        g = export_csr(accessor, weight_property=weight_property,
+                       label_filter=label_filter,
+                       edge_type_filter=edge_type_filter)
+        with self._lock:
+            # keep current-version variants (e.g. other weight properties),
+            # drop stale versions
+            per = self._cache.get(storage) or {}
+            per = {k: v for k, v in per.items() if k[0] == key[0]}
+            per[key] = g
+            self._cache[storage] = per
+        return g
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache = __import__("weakref").WeakKeyDictionary()
+
+
+GLOBAL_GRAPH_CACHE = GraphCache()

@@ -1,0 +1,104 @@
+"""Katz centrality on TPU.
+
+Counterpart of /root/reference/query_modules/katz_centrality_module/ and
+mage/cpp/cugraph_module/algorithms/katz.cu: fixed-point iteration
+x_{t+1} = alpha * A^T x_t + beta, expressed as gather + segment-sum, with an
+L-infinity convergence check. Converges for alpha < 1/lambda_max(A).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .csr import DeviceGraph
+
+
+@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
+def _katz_kernel(src, dst, weights, n_nodes, n_pad: int, alpha, beta,
+                 max_iterations: int, tol, normalized):
+    valid_f = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes).astype(jnp.float32)
+    x0 = jnp.zeros(n_pad, dtype=jnp.float32)
+
+    def body(carry):
+        x, _, it = carry
+        acc = jax.ops.segment_sum(x[src] * weights, dst, num_segments=n_pad)
+        new_x = valid_f * (alpha * acc + beta)
+        err = jnp.max(jnp.abs(new_x - x))
+        return new_x, err, it + 1
+
+    def cond(carry):
+        _, err, it = carry
+        return (err > tol) & (it < max_iterations)
+
+    x, err, iters = jax.lax.while_loop(
+        cond, body, (x0, jnp.float32(jnp.inf), jnp.int32(0)))
+    norm = jnp.sqrt(jnp.sum(x * x))
+    x = jnp.where(normalized, x / jnp.maximum(norm, 1e-30), x)
+    return x, err, iters
+
+
+def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
+                    max_iterations: int = 100, tol: float = 1e-6,
+                    normalized: bool = False):
+    """Returns (centralities[:n_nodes], error, iterations)."""
+    x, err, iters = _katz_kernel(
+        graph.src_idx, graph.col_idx, graph.weights,
+        jnp.int32(graph.n_nodes), graph.n_pad,
+        jnp.float32(alpha), jnp.float32(beta), max_iterations,
+        jnp.float32(tol), jnp.bool_(normalized))
+    return x[:graph.n_nodes], float(err), int(iters)
+
+
+@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
+def _hits_kernel(src, dst, weights, n_nodes, n_pad: int,
+                 max_iterations: int, tol):
+    valid_f = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes).astype(jnp.float32)
+    hub0 = valid_f
+    auth0 = valid_f
+
+    def body(carry):
+        hub, auth, _, it = carry
+        new_auth = jax.ops.segment_sum(hub[src] * weights, dst,
+                                       num_segments=n_pad) * valid_f
+        new_auth = new_auth / jnp.maximum(jnp.sqrt(jnp.sum(new_auth ** 2)), 1e-30)
+        new_hub = jax.ops.segment_sum(new_auth[dst] * weights, src,
+                                      num_segments=n_pad) * valid_f
+        new_hub = new_hub / jnp.maximum(jnp.sqrt(jnp.sum(new_hub ** 2)), 1e-30)
+        err = jnp.max(jnp.abs(new_auth - auth)) + jnp.max(jnp.abs(new_hub - hub))
+        return new_hub, new_auth, err, it + 1
+
+    def cond(carry):
+        _, _, err, it = carry
+        return (err > tol) & (it < max_iterations)
+
+    hub, auth, err, iters = jax.lax.while_loop(
+        cond, body, (hub0, auth0, jnp.float32(jnp.inf), jnp.int32(0)))
+    return hub, auth, err, iters
+
+
+def hits(graph: DeviceGraph, max_iterations: int = 100, tol: float = 1e-6):
+    """HITS hubs/authorities (analog of cugraph_module/algorithms/hits.cu)."""
+    hub, auth, err, iters = _hits_kernel(
+        graph.src_idx, graph.col_idx, graph.weights,
+        jnp.int32(graph.n_nodes), graph.n_pad, max_iterations,
+        jnp.float32(tol))
+    return hub[:graph.n_nodes], auth[:graph.n_nodes], float(err), int(iters)
+
+
+def degree_centrality(graph: DeviceGraph, direction: str = "total"):
+    """Degree centrality (analog of mage/cpp/degree_centrality_module)."""
+    n_pad = graph.n_pad
+    mask = (jnp.arange(graph.e_pad) < graph.n_edges).astype(jnp.float32)
+    out_deg = jax.ops.segment_sum(mask, graph.src_idx, num_segments=n_pad)
+    in_deg = jax.ops.segment_sum(mask, graph.col_idx, num_segments=n_pad)
+    denom = jnp.maximum(graph.n_nodes - 1, 1)
+    if direction == "in":
+        d = in_deg
+    elif direction == "out":
+        d = out_deg
+    else:
+        d = in_deg + out_deg
+    return (d / denom)[:graph.n_nodes]

@@ -1,0 +1,133 @@
+"""Vector similarity search on TPU: brute-force and IVF kNN.
+
+TPU-native replacement for the reference's usearch-backed HNSW vector index
+(/root/reference/src/storage/v2/indices/vector_index.cpp uses
+usearch/index_dense.hpp): instead of a pointer-chasing graph index — hostile
+to the MXU — similarity search is a dense matmul (scores = Q @ X^T in
+bfloat16 with float32 accumulation) + `lax.top_k`. Brute force on TPU beats
+HNSW-on-CPU well past 10M vectors; the IVF variant (coarse k-means
+quantizer + probed cells) covers the larger regime.
+
+Metrics match the reference's vector-index options: cosine, l2sq (squared
+euclidean), dot (inner product).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "use_bf16"))
+def knn(corpus, queries, k: int, metric: str = "cosine",
+        use_bf16: bool = True, valid_count=None):
+    """Top-k nearest rows of `corpus` (n, d) for each of `queries` (q, d).
+
+    Returns (scores (q, k), indices (q, k)); higher score = closer.
+    `valid_count`: rows >= valid_count are padding and never returned.
+    """
+    x = corpus
+    qv = queries
+    if metric == "cosine":
+        x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        qv = qv / jnp.maximum(jnp.linalg.norm(qv, axis=1, keepdims=True), 1e-12)
+    if use_bf16:
+        scores = jax.lax.dot_general(
+            qv.astype(jnp.bfloat16), x.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        scores = qv @ x.T
+    if metric == "l2sq":
+        # -||q - x||^2 = 2 q·x - ||x||^2 - ||q||^2 ; drop the per-query term
+        xsq = jnp.sum(corpus.astype(jnp.float32) ** 2, axis=1)
+        scores = 2.0 * scores - xsq[None, :]
+    if valid_count is not None:
+        col = jnp.arange(corpus.shape[0])
+        scores = jnp.where(col[None, :] < valid_count, scores, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    return top_scores, top_idx
+
+
+@partial(jax.jit, static_argnames=("n_clusters", "iters"))
+def kmeans_fit(points, key, n_clusters: int, iters: int = 10):
+    """Light k-means for the IVF coarse quantizer (and the kmeans module —
+    analog of mage/python/kmeans.py). Returns (centroids, assignment)."""
+    n = points.shape[0]
+    init_idx = jax.random.choice(key, n, shape=(n_clusters,), replace=False)
+    cent0 = points[init_idx]
+
+    def step(cent, _):
+        d = (jnp.sum(points ** 2, axis=1, keepdims=True)
+             - 2.0 * points @ cent.T + jnp.sum(cent ** 2, axis=1)[None, :])
+        assign = jnp.argmin(d, axis=1)
+        one_hot = jax.nn.one_hot(assign, n_clusters, dtype=points.dtype)
+        sums = one_hot.T @ points
+        counts = jnp.sum(one_hot, axis=0)[:, None]
+        new_cent = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), cent)
+        return new_cent, None
+
+    cent, _ = jax.lax.scan(step, cent0, None, length=iters)
+    d = (jnp.sum(points ** 2, axis=1, keepdims=True)
+         - 2.0 * points @ cent.T + jnp.sum(cent ** 2, axis=1)[None, :])
+    return cent, jnp.argmin(d, axis=1)
+
+
+class IvfIndex:
+    """IVF-flat index: coarse k-means cells, search probes the closest cells.
+
+    Host-side bookkeeping + device kernels; rebuildable from the storage's
+    vector columns. For most graph workloads brute-force `knn` is faster on
+    TPU; IVF exists for the >10M-vector regime.
+    """
+
+    def __init__(self, points, n_clusters: int = 64, seed: int = 0):
+        import numpy as np
+        points = jnp.asarray(points, dtype=jnp.float32)
+        self.points = points
+        n_clusters = max(1, min(n_clusters, points.shape[0]))
+        key = jax.random.PRNGKey(seed)
+        self.centroids, assign = kmeans_fit(points, key, n_clusters)
+        assign = np.asarray(assign)
+        order = np.argsort(assign, kind="stable")
+        self.order = jnp.asarray(order)
+        self.sorted_points = points[self.order]
+        counts = np.bincount(assign, minlength=n_clusters)
+        self.cell_start = jnp.asarray(
+            np.concatenate([[0], np.cumsum(counts)]).astype(np.int32))
+        self.n_clusters = n_clusters
+
+    def search(self, queries, k: int, n_probe: int = 8,
+               metric: str = "cosine"):
+        """Probe the n_probe nearest cells per query; exact within cells."""
+        queries = jnp.asarray(queries, dtype=jnp.float32)
+        # rank cells by centroid similarity, then score only their members
+        _, cell_idx = knn(self.centroids, queries, k=min(n_probe,
+                                                         self.n_clusters),
+                          metric=metric, use_bf16=False)
+        import numpy as np
+        cell_idx = np.asarray(cell_idx)
+        start = np.asarray(self.cell_start)
+        out_scores, out_ids = [], []
+        for qi in range(queries.shape[0]):
+            member_rows = np.concatenate([
+                np.arange(start[c], start[c + 1]) for c in cell_idx[qi]
+            ]) if cell_idx.shape[1] else np.empty(0, np.int64)
+            if len(member_rows) == 0:
+                out_scores.append(np.full(k, -np.inf, np.float32))
+                out_ids.append(np.full(k, -1, np.int64))
+                continue
+            cand = self.sorted_points[jnp.asarray(member_rows)]
+            kk = min(k, len(member_rows))
+            s, i = knn(cand, queries[qi:qi + 1], k=kk, metric=metric,
+                       use_bf16=False)
+            ids = np.asarray(self.order)[member_rows[np.asarray(i[0])]]
+            s = np.asarray(s[0])
+            if kk < k:
+                s = np.pad(s, (0, k - kk), constant_values=-np.inf)
+                ids = np.pad(ids, (0, k - kk), constant_values=-1)
+            out_scores.append(s)
+            out_ids.append(ids)
+        return np.stack(out_scores), np.stack(out_ids)

@@ -1,0 +1,116 @@
+"""Batched random walks on device (node2vec and friends).
+
+Counterpart of /root/reference/mage/python/node2vec.py +
+query_modules/node2vec_online_module/: instead of per-walk host loops, all B
+walks advance one step per `lax.scan` iteration — a (B,) gather into CSR plus
+vectorized sampling. Second-order (p, q) bias uses rejection sampling
+(the alias-free formulation used by large-scale walk engines), with edge
+membership tested by binary search inside the CSR row (rows are sorted by
+destination — csr.py exports in (src, dst) lexicographic order).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .csr import DeviceGraph
+
+
+def _row_degree(row_ptr, v):
+    return row_ptr[v + 1] - row_ptr[v]
+
+
+def _sample_neighbor(row_ptr, col_idx, v, u):
+    """Uniform neighbor of v (u ~ U[0,1)); returns v itself if no neighbors."""
+    deg = _row_degree(row_ptr, v)
+    off = jnp.minimum((u * deg.astype(jnp.float32)).astype(jnp.int32),
+                      jnp.maximum(deg - 1, 0))
+    nxt = col_idx[row_ptr[v] + off]
+    return jnp.where(deg > 0, nxt, v)
+
+
+def _has_edge(row_ptr, col_idx, v, t):
+    """Binary search for edge v->t (rows sorted by destination).
+
+    Fixed-iteration lower_bound (32 steps cover any e_pad < 2^32) so the
+    loop unrolls/pipelines cleanly under vmap."""
+    lo = row_ptr[v]
+    hi = row_ptr[v + 1]
+
+    def body(_, c):
+        lo, hi = c
+        mid = (lo + hi) // 2
+        go_right = col_idx[mid] < t
+        active = lo < hi
+        return (jnp.where(active & go_right, mid + 1, lo),
+                jnp.where(active & ~go_right, mid, hi))
+
+    lo, _ = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    safe = jnp.minimum(lo, col_idx.shape[0] - 1)
+    return (lo < row_ptr[v + 1]) & (col_idx[safe] == t)
+
+
+@partial(jax.jit, static_argnames=("length", "n_pad"))
+def _walk_kernel(row_ptr, col_idx, starts, key, length: int, n_pad: int,
+                 p, q):
+    """(B, length+1) node2vec walks. p = return parameter, q = in-out.
+    p = q = 1 reduces to uniform DeepWalk sampling (fast path taken by the
+    same code: the rejection test always accepts)."""
+    B = starts.shape[0]
+    max_prob = jnp.maximum(1.0, jnp.maximum(1.0 / p, 1.0 / q))
+
+    def step(carry, key_step):
+        cur, prev = carry
+        k1, k2, k3 = jax.random.split(key_step, 3)
+        u1 = jax.random.uniform(k1, (B,))
+        cand = jax.vmap(_sample_neighbor, in_axes=(None, None, 0, 0))(
+            row_ptr, col_idx, cur, u1)
+        # rejection test for 2nd-order bias
+        back = cand == prev
+        connected = jax.vmap(_has_edge, in_axes=(None, None, 0, 0))(
+            row_ptr, col_idx, prev, cand)
+        alpha = jnp.where(back, 1.0 / p, jnp.where(connected, 1.0, 1.0 / q))
+        accept = jax.random.uniform(k2, (B,)) <= alpha / max_prob
+        # on reject, resample uniformly (single retry keeps shapes static;
+        # bias error is negligible for p,q in the usual [0.25, 4] range)
+        u2 = jax.random.uniform(k3, (B,))
+        cand2 = jax.vmap(_sample_neighbor, in_axes=(None, None, 0, 0))(
+            row_ptr, col_idx, cur, u2)
+        nxt = jnp.where(accept, cand, cand2)
+        return (nxt, cur), nxt
+
+    keys = jax.random.split(key, length)
+    (_, _), path = jax.lax.scan(step, (starts, starts), keys)
+    return jnp.concatenate([starts[None, :], path], axis=0).T
+
+
+def random_walks(graph: DeviceGraph, starts, length: int, key=None,
+                 p: float = 1.0, q: float = 1.0):
+    """Batched (possibly node2vec-biased) random walks.
+
+    starts: (B,) dense node indices. Returns (B, length+1) int32 walks;
+    walks stall (self-repeat) at sink nodes, matching common practice.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    starts = jnp.asarray(starts, dtype=jnp.int32)
+    return _walk_kernel(graph.row_ptr, graph.col_idx, starts, key, length,
+                        graph.n_pad, jnp.float32(p), jnp.float32(q))
+
+
+@partial(jax.jit, static_argnames=("window",))
+def walks_to_skipgram_pairs(walks, window: int = 5):
+    """Expand walks (B, L) into (center, context) pairs within `window`,
+    flattened to ((2*window)*B*L, 2) with -1 padding where out of range."""
+    B, L = walks.shape
+    pairs = []
+    for off in range(1, window + 1):
+        left = jnp.stack([walks[:, off:], walks[:, :-off]], axis=-1)
+        right = jnp.stack([walks[:, :-off], walks[:, off:]], axis=-1)
+        pad = jnp.full((B, off, 2), -1, dtype=walks.dtype)
+        pairs.append(jnp.concatenate([left, pad], axis=1))
+        pairs.append(jnp.concatenate([right, pad], axis=1))
+    return jnp.concatenate(pairs, axis=1).reshape(-1, 2)

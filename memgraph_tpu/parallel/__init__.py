@@ -1,0 +1,17 @@
+"""Multi-chip execution: device meshes, edge partitioning, sharded kernels.
+
+The reference scales out by replication only (SURVEY.md §2.4.8 — no graph
+sharding). The TPU build goes further: whole-graph analytics shard across a
+`jax.sharding.Mesh`, with 1D *edge partitioning* (each device owns a
+contiguous edge block; the vertex state vector is replicated) and XLA
+collectives (`psum`) combining per-shard segment reductions over ICI. This
+is the graph analog of data parallelism: the "sequence" axis is the edge
+axis (SURVEY.md §5 long-context mapping).
+"""
+
+from .mesh import make_mesh, device_count
+from .distributed import (shard_graph, ShardedGraph, pagerank_sharded,
+                          sssp_sharded, wcc_sharded)
+
+__all__ = ["make_mesh", "device_count", "shard_graph", "ShardedGraph",
+           "pagerank_sharded", "sssp_sharded", "wcc_sharded"]

@@ -1,0 +1,27 @@
+"""Device mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_name: str = "edges") -> Mesh:
+    """1D mesh over the first n_devices devices (edge-partition axis)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def make_mesh_2d(data: int, model: int,
+                 axis_names: tuple[str, str] = ("data", "model")) -> Mesh:
+    """2D mesh (data x model) for embedding-training workloads."""
+    devs = np.array(jax.devices()[:data * model]).reshape(data, model)
+    return Mesh(devs, axis_names)

@@ -85,7 +85,10 @@ class _UniqueSlot:
         if old_key == new_key:
             return
         if old_key is not None:
-            self.by_key.pop(old_key, None)
+            # same-commit handover may have already reassigned the key to
+            # another gid — only release it if we still own it
+            if self.by_key.get(old_key) == gid:
+                self.by_key.pop(old_key)
             del self.by_gid[gid]
         if new_key is not None:
             self.by_key[new_key] = gid
@@ -160,12 +163,24 @@ class UniqueConstraints:
         """
         registrations = []
         for (label_id, prop_ids), slot in self._maps.items():
-            pending: dict[bytes, int] = {}
+            # first pass: keys this commit releases (old owner loses the key),
+            # so a same-transaction handover (delete A, create B with A's
+            # value) validates correctly
+            new_keys: dict[int, bytes | None] = {}
+            released: set[bytes] = set()
             for v in touched_vertices:
                 new_key = self._vertex_key(v, label_id, prop_ids)
+                new_keys[v.gid] = new_key
+                old_key = slot.by_gid.get(v.gid)
+                if old_key is not None and old_key != new_key:
+                    released.add(old_key)
+            pending: dict[bytes, int] = {}
+            for v in touched_vertices:
+                new_key = new_keys[v.gid]
                 if new_key is not None:
                     owner = slot.by_key.get(new_key)
-                    if owner is not None and owner != v.gid:
+                    if (owner is not None and owner != v.gid
+                            and new_key not in released):
                         raise ConstraintViolation(
                             self._message(label_id, prop_ids, namer),
                             constraint=("unique", label_id, prop_ids))

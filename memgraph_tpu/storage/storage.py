@@ -236,13 +236,17 @@ class Accessor:
         if self._finished:
             raise StorageError("transaction already finished")
         try:
-            self.storage._commit(self.txn)
+            commit_ts = self.storage._commit(self.txn)
         except Exception:
             # constraint violation etc. → roll back so objects aren't left owned
             self.storage._abort(self.txn)
             self._finished = True
             raise
         self._finished = True
+        # hooks run strictly after the commit is final: a failing hook must
+        # never trigger rollback of already-visible data
+        for hook in self.storage.on_commit_hooks:
+            hook(self.txn, commit_ts)
 
     def abort(self) -> None:
         if self._finished:
@@ -653,12 +657,12 @@ class InMemoryStorage:
     def latest_commit_ts(self) -> int:
         return self._timestamp
 
-    def _commit(self, txn: Transaction) -> None:
+    def _commit(self, txn: Transaction) -> int:
         storage_mode = self.config.storage_mode
         if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or not txn.deltas:
             with self._engine_lock:
                 self._active_txns.pop(txn.id, None)
-            return
+                return self._timestamp
 
         touched = list(txn.touched_vertices.values())
         # existence + type constraints against the transaction's NEW state
@@ -680,8 +684,9 @@ class InMemoryStorage:
             txn.commit_info.timestamp = commit_ts
             self.constraints.unique.apply_registrations(registrations)
             self._active_txns.pop(txn.id, None)
-        for hook in self.on_commit_hooks:
-            hook(txn, commit_ts)
+        # committed state changed → device snapshot caches must re-export
+        self._bump_topology()
+        return commit_ts
 
     def _abort(self, txn: Transaction) -> None:
         # undo in reverse; our deltas are contiguous at each object's head

@@ -1,0 +1,138 @@
+"""Storage → CSR export tests: MVCC-consistent snapshots, cache behavior."""
+
+import numpy as np
+
+from memgraph_tpu.ops.csr import GraphCache, export_csr
+from memgraph_tpu.ops.pagerank import pagerank
+
+
+def _build(storage, edges, n):
+    t = storage.edge_type_mapper.name_to_id("E")
+    acc = storage.access()
+    vs = [acc.create_vertex() for _ in range(n)]
+    for (a, b) in edges:
+        acc.create_edge(vs[a], vs[b], t)
+    acc.commit()
+    return [v.gid for v in vs]
+
+
+def test_export_basic(storage):
+    gids = _build(storage, [(0, 1), (1, 2), (2, 0), (0, 2)], 3)
+    acc = storage.access()
+    g = export_csr(acc, to_device=False)
+    acc.abort()
+    assert g.n_nodes == 3 and g.n_edges == 4
+    assert list(g.node_gids) == gids
+    edge_set = {(int(s), int(d)) for s, d in
+                zip(g.src_idx[:4], g.col_idx[:4])}
+    assert edge_set == {(0, 1), (1, 2), (2, 0), (0, 2)}
+
+
+def test_export_skips_uncommitted(storage):
+    _build(storage, [(0, 1)], 2)
+    writer = storage.access()
+    v = writer.create_vertex()
+    writer.create_edge(writer.find_vertex(0), v,
+                       storage.edge_type_mapper.name_to_id("E"))
+    reader = storage.access()
+    g = export_csr(reader, to_device=False)
+    reader.abort()
+    writer.abort()
+    assert g.n_nodes == 2 and g.n_edges == 1
+
+
+def test_export_weight_property(storage):
+    t = storage.edge_type_mapper.name_to_id("E")
+    wprop = storage.property_mapper.name_to_id("w")
+    acc = storage.access()
+    a, b = acc.create_vertex(), acc.create_vertex()
+    e = acc.create_edge(a, b, t)
+    e.set_property(wprop, 2.5)
+    acc.commit()
+    acc2 = storage.access()
+    g = export_csr(acc2, weight_property=wprop, to_device=False)
+    acc2.abort()
+    assert float(g.weights[0]) == 2.5
+
+
+def test_export_deleted_vertices_excluded(storage):
+    gids = _build(storage, [(0, 1), (1, 2)], 3)
+    d = storage.access()
+    d.delete_vertex(d.find_vertex(gids[2]), detach=True)
+    d.commit()
+    acc = storage.access()
+    g = export_csr(acc, to_device=False)
+    acc.abort()
+    assert g.n_nodes == 2 and g.n_edges == 1
+
+
+def test_graph_cache_invalidation(storage):
+    _build(storage, [(0, 1), (1, 0)], 2)
+    cache = GraphCache()
+    acc = storage.access()
+    g1 = cache.get(acc)
+    g2 = cache.get(acc)
+    assert g1 is g2  # same topology version → cache hit
+    acc.abort()
+    w = storage.access()
+    w.create_vertex()
+    w.commit()
+    acc2 = storage.access()
+    g3 = cache.get(acc2)
+    acc2.abort()
+    assert g3 is not g1
+    assert g3.n_nodes == 3
+
+
+def test_cache_invalidated_by_commit_not_mutation(storage):
+    """Regression: a cached snapshot taken while a writer is active must be
+    replaced once that writer commits."""
+    _build(storage, [(0, 1)], 2)
+    cache = GraphCache()
+    writer = storage.access()
+    writer.create_vertex()  # uncommitted
+    reader = storage.access()
+    g1 = cache.get(reader)  # excludes uncommitted vertex
+    assert g1.n_nodes == 2
+    reader.abort()
+    writer.commit()
+    reader2 = storage.access()
+    g2 = cache.get(reader2)
+    reader2.abort()
+    assert g2.n_nodes == 3
+
+
+def test_export_concurrent_writer_no_crash(storage):
+    """Export while another thread mutates must not crash on dict resize."""
+    import threading
+    _build(storage, [(0, 1), (1, 0)], 2)
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            acc = storage.access()
+            acc.create_vertex()
+            acc.commit()
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(20):
+            acc = storage.access()
+            export_csr(acc, to_device=False)
+            acc.abort()
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_pagerank_from_storage(storage):
+    # star graph: hub 0 pointed at by 1..4
+    _build(storage, [(1, 0), (2, 0), (3, 0), (4, 0)], 5)
+    acc = storage.access()
+    g = export_csr(acc)
+    acc.abort()
+    ranks, _, _ = pagerank(g, tol=1e-10)
+    ranks = np.asarray(ranks)
+    assert ranks[0] == ranks.max()
+    assert abs(ranks.sum() - 1.0) < 1e-4

@@ -237,6 +237,54 @@ def test_unique_constraint_numeric_equality(storage):
         acc2.commit()
 
 
+def test_unique_constraint_same_txn_handover(storage):
+    """Delete old owner + create new vertex with the same unique value in ONE
+    transaction must commit cleanly (key handover)."""
+    person = storage.label_mapper.name_to_id("Person")
+    email = storage.property_mapper.name_to_id("email")
+    storage.create_unique_constraint(person, (email,))
+    acc = storage.access()
+    a = acc.create_vertex()
+    a.add_label(person)
+    a.set_property(email, "x@x.com")
+    acc.commit()
+
+    t = storage.access()
+    t.delete_vertex(t.find_vertex(a.gid))
+    b = t.create_vertex()
+    b.add_label(person)
+    b.set_property(email, "x@x.com")
+    t.commit()  # must not raise
+
+    # new owner holds the key: another duplicate still fails
+    t2 = storage.access()
+    c = t2.create_vertex()
+    c.add_label(person)
+    c.set_property(email, "x@x.com")
+    with pytest.raises(ConstraintViolation):
+        t2.commit()
+
+
+def test_commit_hook_failure_does_not_rollback(storage):
+    prop = storage.property_mapper.name_to_id("x")
+
+    def bad_hook(txn, commit_ts):
+        raise RuntimeError("sink exploded")
+
+    storage.on_commit_hooks.append(bad_hook)
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.set_property(prop, 1)
+    gid = v.gid
+    with pytest.raises(RuntimeError):
+        acc.commit()
+    storage.on_commit_hooks.clear()
+    # the commit itself survived the hook failure
+    check = storage.access()
+    assert check.find_vertex(gid).get_property(prop) == 1
+    check.abort()
+
+
 def test_range_scan_no_duplicates_after_update(storage):
     """A vertex whose indexed value changed must appear once in a range scan."""
     person, age, gids = _mk_people(storage, 3)
