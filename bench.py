@@ -66,7 +66,9 @@ def tpu_pagerank(graph, iterations=ITERATIONS, damping=DAMPING):
     import jax.numpy as jnp
 
     def run(d):
-        return _pagerank_kernel(graph.src_idx, graph.col_idx, graph.weights,
+        # CSC ((dst, src)-sorted) arrays — the kernel's required order
+        return _pagerank_kernel(graph.csc_src, graph.csc_dst,
+                                graph.csc_weights,
                                 jnp.int32(graph.n_nodes), graph.n_pad,
                                 jnp.float32(d), iterations,
                                 jnp.float32(0.0))  # tol=0 → fixed iterations

@@ -24,7 +24,8 @@ def _katz_kernel(src, dst, weights, n_nodes, n_pad: int, alpha, beta,
 
     def body(carry):
         x, _, it = carry
-        acc = jax.ops.segment_sum(x[src] * weights, dst, num_segments=n_pad)
+        acc = jax.ops.segment_sum(x[src] * weights, dst, num_segments=n_pad,
+                                  indices_are_sorted=True)
         new_x = valid_f * (alpha * acc + beta)
         err = jnp.max(jnp.abs(new_x - x))
         return new_x, err, it + 1
@@ -45,7 +46,7 @@ def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
                     normalized: bool = False):
     """Returns (centralities[:n_nodes], error, iterations)."""
     x, err, iters = _katz_kernel(
-        graph.src_idx, graph.col_idx, graph.weights,
+        graph.csc_src, graph.csc_dst, graph.csc_weights,
         jnp.int32(graph.n_nodes), graph.n_pad,
         jnp.float32(alpha), jnp.float32(beta), max_iterations,
         jnp.float32(tol), jnp.bool_(normalized))
@@ -53,19 +54,23 @@ def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _hits_kernel(src, dst, weights, n_nodes, n_pad: int,
-                 max_iterations: int, tol):
+def _hits_kernel(src, dst, weights, csrc, cdst, cweights, n_nodes,
+                 n_pad: int, max_iterations: int, tol):
     valid_f = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes).astype(jnp.float32)
     hub0 = valid_f
     auth0 = valid_f
 
     def body(carry):
         hub, auth, _, it = carry
-        new_auth = jax.ops.segment_sum(hub[src] * weights, dst,
-                                       num_segments=n_pad) * valid_f
+        # src here is CSR order (sorted by src) → both reductions sorted:
+        # auth by dst uses the CSC mirror passed as (csrc, cdst)
+        new_auth = jax.ops.segment_sum(hub[csrc] * cweights, cdst,
+                                       num_segments=n_pad,
+                                       indices_are_sorted=True) * valid_f
         new_auth = new_auth / jnp.maximum(jnp.sqrt(jnp.sum(new_auth ** 2)), 1e-30)
         new_hub = jax.ops.segment_sum(new_auth[dst] * weights, src,
-                                      num_segments=n_pad) * valid_f
+                                      num_segments=n_pad,
+                                      indices_are_sorted=True) * valid_f
         new_hub = new_hub / jnp.maximum(jnp.sqrt(jnp.sum(new_hub ** 2)), 1e-30)
         err = jnp.max(jnp.abs(new_auth - auth)) + jnp.max(jnp.abs(new_hub - hub))
         return new_hub, new_auth, err, it + 1
@@ -83,6 +88,7 @@ def hits(graph: DeviceGraph, max_iterations: int = 100, tol: float = 1e-6):
     """HITS hubs/authorities (analog of cugraph_module/algorithms/hits.cu)."""
     hub, auth, err, iters = _hits_kernel(
         graph.src_idx, graph.col_idx, graph.weights,
+        graph.csc_src, graph.csc_dst, graph.csc_weights,
         jnp.int32(graph.n_nodes), graph.n_pad, max_iterations,
         jnp.float32(tol))
     return hub[:graph.n_nodes], auth[:graph.n_nodes], float(err), int(iters)

@@ -61,9 +61,11 @@ def shard_graph(graph: DeviceGraph, mesh: Mesh,
                 [arr, np.full(new_e - len(arr), fill, dtype=arr.dtype)])
         return arr
 
-    src = pad_to(graph.src_idx, sink)
-    dst = pad_to(graph.col_idx, sink)
-    w = pad_to(graph.weights, 0.0)
+    # CSC ((dst, src)-sorted) order: per-shard contiguous blocks stay
+    # dst-sorted, so local segment reductions take the fast sorted lowering
+    src = pad_to(graph.csc_src, sink)
+    dst = pad_to(graph.csc_dst, sink)
+    w = pad_to(graph.csc_weights, 0.0)
 
     sharding = NamedSharding(mesh, P(axis))
     return ShardedGraph(
@@ -91,11 +93,14 @@ def _pagerank_sharded_fn(mesh: Mesh, axis: str, n_pad: int,
 
         rank0 = valid_f / n_f
 
+        edge_mult = w_blk * inv_wsum[src_blk]  # hoisted per-edge multiplier
+
         def body(carry):
             rank, _, it = carry
-            contrib = rank[src_blk] * w_blk * inv_wsum[src_blk]
+            contrib = rank[src_blk] * edge_mult
             acc_local = jax.ops.segment_sum(contrib, dst_blk,
-                                            num_segments=n_pad)
+                                            num_segments=n_pad,
+                                            indices_are_sorted=True)
             acc = jax.lax.psum(acc_local, axis)          # ← ICI collective
             dangling_mass = jnp.sum(rank * dangling_f)
             new_rank = valid_f * ((1.0 - damping) / n_f
