@@ -69,6 +69,7 @@ def tpu_pagerank(graph, iterations=ITERATIONS, damping=DAMPING):
         # CSC ((dst, src)-sorted) arrays — the kernel's required order
         return _pagerank_kernel(graph.csc_src, graph.csc_dst,
                                 graph.csc_weights,
+                                graph.src_idx, graph.weights,
                                 jnp.int32(graph.n_nodes), graph.n_pad,
                                 jnp.float32(d), iterations,
                                 jnp.float32(0.0))  # tol=0 → fixed iterations

@@ -1,0 +1,232 @@
+"""Users, roles, permissions (AuthN/AuthZ).
+
+Capability map to the reference's auth layer (/root/reference/src/auth/):
+users with salted-hash passwords (PBKDF2 — the stdlib-available equivalent
+of the reference's bcrypt, auth/crypto.cpp), roles, per-privilege
+GRANT/DENY, durable via JSON (kvstore analog lands with durability dir).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import threading
+from dataclasses import dataclass, field
+
+from ..exceptions import AuthException
+
+PRIVILEGES = [
+    "CREATE", "DELETE", "MATCH", "MERGE", "SET", "REMOVE", "INDEX", "STATS",
+    "CONSTRAINT", "DUMP", "REPLICATION", "DURABILITY", "READ_FILE",
+    "FREE_MEMORY", "TRIGGER", "CONFIG", "AUTH", "STREAM", "MODULE_READ",
+    "MODULE_WRITE", "WEBSOCKET", "TRANSACTION_MANAGEMENT", "STORAGE_MODE",
+    "MULTI_DATABASE_EDIT", "MULTI_DATABASE_USE", "COORDINATOR",
+]
+
+
+def _hash_password(password: str, salt: bytes | None = None) -> str:
+    if salt is None:
+        salt = secrets.token_bytes(16)
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"), salt,
+                                 100_000)
+    return salt.hex() + "$" + digest.hex()
+
+
+def _verify_password(password: str, stored: str) -> bool:
+    try:
+        salt_hex, digest_hex = stored.split("$", 1)
+    except ValueError:
+        return False
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"),
+                                 bytes.fromhex(salt_hex), 100_000)
+    return secrets.compare_digest(digest.hex(), digest_hex)
+
+
+@dataclass
+class Role:
+    name: str
+    granted: set = field(default_factory=set)
+    denied: set = field(default_factory=set)
+
+
+@dataclass
+class User:
+    name: str
+    password_hash: str | None = None
+    roles: list[str] = field(default_factory=list)
+    granted: set = field(default_factory=set)
+    denied: set = field(default_factory=set)
+
+
+class Auth:
+    def __init__(self, storage_path: str | None = None) -> None:
+        self._lock = threading.Lock()
+        self._users: dict[str, User] = {}
+        self._roles: dict[str, Role] = {}
+        self._path = storage_path
+        if storage_path and os.path.exists(storage_path):
+            self._load()
+
+    # --- users --------------------------------------------------------------
+
+    def create_user(self, name: str, password: str | None = None) -> None:
+        with self._lock:
+            if name in self._users:
+                raise AuthException(f"user {name!r} already exists")
+            self._users[name] = User(
+                name, _hash_password(password) if password else None)
+            self._save()
+
+    def drop_user(self, name: str) -> None:
+        with self._lock:
+            if name not in self._users:
+                raise AuthException(f"user {name!r} does not exist")
+            del self._users[name]
+            self._save()
+
+    def set_password(self, name: str, password: str | None) -> None:
+        with self._lock:
+            user = self._users.get(name)
+            if user is None:
+                raise AuthException(f"user {name!r} does not exist")
+            user.password_hash = _hash_password(password) if password else None
+            self._save()
+
+    def authenticate(self, name: str, password: str) -> bool:
+        with self._lock:
+            if not self._users:
+                return True  # no users defined → open instance (reference behavior)
+            user = self._users.get(name)
+            if user is None:
+                return False
+            if user.password_hash is None:
+                return True
+            return _verify_password(password, user.password_hash)
+
+    def users(self) -> list[str]:
+        with self._lock:
+            return sorted(self._users)
+
+    # --- roles / privileges -------------------------------------------------
+
+    def create_role(self, name: str) -> None:
+        with self._lock:
+            if name in self._roles:
+                raise AuthException(f"role {name!r} already exists")
+            self._roles[name] = Role(name)
+            self._save()
+
+    def drop_role(self, name: str) -> None:
+        with self._lock:
+            self._roles.pop(name, None)
+            for user in self._users.values():
+                if name in user.roles:
+                    user.roles.remove(name)
+            self._save()
+
+    def set_role(self, user: str, role: str) -> None:
+        with self._lock:
+            if user not in self._users:
+                raise AuthException(f"user {user!r} does not exist")
+            if role not in self._roles:
+                raise AuthException(f"role {role!r} does not exist")
+            if role not in self._users[user].roles:
+                self._users[user].roles.append(role)
+            self._save()
+
+    def grant(self, name: str, privileges: list[str]) -> None:
+        self._change_privileges(name, privileges, "grant")
+
+    def deny(self, name: str, privileges: list[str]) -> None:
+        self._change_privileges(name, privileges, "deny")
+
+    def revoke(self, name: str, privileges: list[str]) -> None:
+        self._change_privileges(name, privileges, "revoke")
+
+    def _change_privileges(self, name, privileges, action) -> None:
+        privileges = [p.upper() for p in privileges]
+        for p in privileges:
+            if p != "ALL" and p not in PRIVILEGES:
+                raise AuthException(f"unknown privilege {p}")
+        with self._lock:
+            target = self._users.get(name) or self._roles.get(name)
+            if target is None:
+                raise AuthException(f"user or role {name!r} does not exist")
+            plist = PRIVILEGES if "ALL" in privileges else privileges
+            for p in plist:
+                if action == "grant":
+                    target.granted.add(p)
+                    target.denied.discard(p)
+                elif action == "deny":
+                    target.denied.add(p)
+                    target.granted.discard(p)
+                else:
+                    target.granted.discard(p)
+                    target.denied.discard(p)
+            self._save()
+
+    def has_privilege(self, user_name: str, privilege: str) -> bool:
+        with self._lock:
+            if not self._users:
+                return True
+            user = self._users.get(user_name)
+            if user is None:
+                return False
+            if privilege in user.denied:
+                return False
+            if privilege in user.granted:
+                return True
+            for role_name in user.roles:
+                role = self._roles.get(role_name)
+                if role is None:
+                    continue
+                if privilege in role.denied:
+                    return False
+                if privilege in role.granted:
+                    return True
+            return False
+
+    # --- durability ---------------------------------------------------------
+
+    def _save(self) -> None:
+        if not self._path:
+            return
+        data = {
+            "users": [{"name": u.name, "password_hash": u.password_hash,
+                       "roles": u.roles, "granted": sorted(u.granted),
+                       "denied": sorted(u.denied)}
+                      for u in self._users.values()],
+            "roles": [{"name": r.name, "granted": sorted(r.granted),
+                       "denied": sorted(r.denied)}
+                      for r in self._roles.values()],
+        }
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path)
+
+    def _load(self) -> None:
+        with open(self._path) as f:
+            data = json.load(f)
+        for u in data.get("users", []):
+            self._users[u["name"]] = User(
+                u["name"], u.get("password_hash"), u.get("roles", []),
+                set(u.get("granted", [])), set(u.get("denied", [])))
+        for r in data.get("roles", []):
+            self._roles[r["name"]] = Role(
+                r["name"], set(r.get("granted", [])),
+                set(r.get("denied", [])))
+
+
+_GLOBAL_AUTH: Auth | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_auth() -> Auth:
+    global _GLOBAL_AUTH
+    with _GLOBAL_LOCK:
+        if _GLOBAL_AUTH is None:
+            _GLOBAL_AUTH = Auth()
+        return _GLOBAL_AUTH

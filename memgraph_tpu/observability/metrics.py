@@ -1,0 +1,62 @@
+"""Counters / gauges / histograms registry.
+
+Counterpart of the reference's metrics layer
+(/root/reference/src/metrics/prometheus_metrics.hpp): named counters with
+types, snapshot for SHOW METRICS INFO, Prometheus text exposition for the
+monitoring endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = defaultdict(int)
+        self._gauges: dict[str, float] = {}
+        self._histograms: dict[str, list] = defaultdict(list)
+
+    def increment(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += delta
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._histograms[name]
+            h.append(value)
+            if len(h) > 10_000:
+                del h[: len(h) // 2]
+
+    def snapshot(self) -> list[tuple[str, str, float]]:
+        with self._lock:
+            out = [(n, "Counter", float(v))
+                   for n, v in sorted(self._counters.items())]
+            out += [(n, "Gauge", float(v))
+                    for n, v in sorted(self._gauges.items())]
+            for n, values in sorted(self._histograms.items()):
+                if not values:
+                    continue
+                s = sorted(values)
+                for q, suffix in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                    idx = min(int(q * len(s)), len(s) - 1)
+                    out.append((f"{n}_{suffix}", "Histogram", float(s[idx])))
+            return out
+
+    def prometheus_text(self) -> str:
+        lines = []
+        for name, kind, value in self.snapshot():
+            metric = name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE {metric} "
+                         f"{'counter' if kind == 'Counter' else 'gauge'}")
+            lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"
+
+
+global_metrics = Metrics()

@@ -125,14 +125,16 @@ def from_coo(src: np.ndarray, dst: np.ndarray,
     dst_full[:n_edges] = d_sorted
     w_full[:n_edges] = w_sorted
 
-    # CSC mirror: (dst, src)-sorted for sorted-segment reductions by dst
-    corder = np.lexsort((src, dst))
+    # CSC mirror: (dst, src)-sorted. Reuse the (src, dst)-sorted arrays with
+    # one single-key stable sort — stability preserves the src order within
+    # equal dst, giving (dst, src) lexicographic order at half the sort cost.
+    corder = np.argsort(d_sorted, kind="stable")
     csc_src = np.full(e_pad, sink, dtype=np.int32)
     csc_dst = np.full(e_pad, sink, dtype=np.int32)
     csc_w = np.zeros(e_pad, dtype=np.float32)
-    csc_src[:n_edges] = src[corder]
-    csc_dst[:n_edges] = dst[corder]
-    csc_w[:n_edges] = weights[corder]
+    csc_src[:n_edges] = s_sorted[corder]
+    csc_dst[:n_edges] = d_sorted[corder]
+    csc_w[:n_edges] = w_sorted[corder]
 
     counts = np.bincount(s_sorted, minlength=n_pad).astype(np.int64)
     row_ptr = np.zeros(n_pad + 1, dtype=np.int32)

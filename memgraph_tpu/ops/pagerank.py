@@ -24,9 +24,10 @@ from .csr import DeviceGraph
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _pagerank_kernel(src, dst, weights, n_nodes, n_pad: int,
-                     damping, max_iterations: int, tol):
-    """src/dst/weights must be in CSC ((dst, src)-sorted) order.
+def _pagerank_kernel(src, dst, weights, csr_src, csr_weights, n_nodes,
+                     n_pad: int, damping, max_iterations: int, tol):
+    """src/dst/weights in CSC ((dst, src)-sorted) order; csr_src/csr_weights
+    are the same edges in CSR order (src sorted) for the out-weight sums.
 
     TPU tuning (profiled on v5e): destination-sorted indices let XLA lower
     segment_sum without general scatter (~3x/iteration), and the per-edge
@@ -37,8 +38,9 @@ def _pagerank_kernel(src, dst, weights, n_nodes, n_pad: int,
     valid = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes)
     valid_f = valid.astype(jnp.float32)
 
-    # per-source total outgoing weight (0 ⇒ dangling)
-    wsum = jax.ops.segment_sum(weights, src, num_segments=n_pad)
+    # per-source total outgoing weight (0 ⇒ dangling); CSR order is sorted
+    wsum = jax.ops.segment_sum(csr_weights, csr_src, num_segments=n_pad,
+                               indices_are_sorted=True)
     inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
     dangling = valid & (wsum <= 0)
     dangling_f = dangling.astype(jnp.float32)
@@ -71,21 +73,24 @@ def pagerank(graph: DeviceGraph, damping: float = 0.85,
     """Returns (ranks[:n_nodes], error, iterations)."""
     rank, err, iters = _pagerank_kernel(
         graph.csc_src, graph.csc_dst, graph.csc_weights,
+        graph.src_idx, graph.weights,
         jnp.int32(graph.n_nodes), graph.n_pad,
         jnp.float32(damping), max_iterations, jnp.float32(tol))
     return rank[:graph.n_nodes], float(err), int(iters)
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _personalized_kernel(src, dst, weights, n_nodes, n_pad: int,
-                         personalization, damping, max_iterations: int, tol):
+def _personalized_kernel(src, dst, weights, csr_src, csr_weights, n_nodes,
+                         n_pad: int, personalization, damping,
+                         max_iterations: int, tol):
     """src/dst/weights in CSC order (see _pagerank_kernel)."""
     valid = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes)
     valid_f = valid.astype(jnp.float32)
     p = personalization * valid_f
     p = p / jnp.maximum(jnp.sum(p), 1e-30)
 
-    wsum = jax.ops.segment_sum(weights, src, num_segments=n_pad)
+    wsum = jax.ops.segment_sum(csr_weights, csr_src, num_segments=n_pad,
+                               indices_are_sorted=True)
     inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
     dangling_f = (valid & (wsum <= 0)).astype(jnp.float32)
     edge_mult = weights * inv_wsum[src]
@@ -122,6 +127,7 @@ def personalized_pagerank(graph: DeviceGraph, source_nodes,
     p = p.at[jnp.asarray(source_nodes, dtype=jnp.int32)].set(1.0)
     rank, err, iters = _personalized_kernel(
         graph.csc_src, graph.csc_dst, graph.csc_weights,
+        graph.src_idx, graph.weights,
         jnp.int32(graph.n_nodes), graph.n_pad, p,
         jnp.float32(damping), max_iterations, jnp.float32(tol))
     return rank[:graph.n_nodes], float(err), int(iters)

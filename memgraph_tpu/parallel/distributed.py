@@ -139,8 +139,11 @@ def _min_propagate_sharded_fn(mesh: Mesh, axis: str, n_pad: int,
     def step(src_blk, dst_blk, w_blk, init):
         def body(carry):
             val, _, it = carry
+            # dst_blk is per-block sorted (CSC shards) → sorted lowering;
+            # the backward reduction keys on src which is unsorted under CSC
             cand_local = jax.ops.segment_min(val[src_blk] + w_blk, dst_blk,
-                                             num_segments=n_pad)
+                                             num_segments=n_pad,
+                                             indices_are_sorted=True)
             if undirected:
                 back = jax.ops.segment_min(val[dst_blk] + w_blk, src_blk,
                                            num_segments=n_pad)
@@ -191,7 +194,8 @@ def _wcc_sharded_fn(mesh: Mesh, axis: str, n_pad: int, max_iterations: int):
         def body(carry):
             comp, _, it = carry
             fwd = jax.ops.segment_min(comp[src_blk], dst_blk,
-                                      num_segments=n_pad)
+                                      num_segments=n_pad,
+                                      indices_are_sorted=True)
             bwd = jax.ops.segment_min(comp[dst_blk], src_blk,
                                       num_segments=n_pad)
             cand = jax.lax.pmin(jnp.minimum(fwd, bwd), axis)

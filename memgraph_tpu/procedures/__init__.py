@@ -1,0 +1,22 @@
+"""Builtin query modules (the MAGE-equivalent algorithm surface).
+
+Counterparts of /root/reference/query_modules/ and /root/reference/mage/:
+the same `CALL module.proc() YIELD ...` API, with the compute running as
+TPU kernels over CSR device snapshots instead of C++ loops over adjacency
+lists. Reference-named modules (pagerank, katz_centrality,
+community_detection, ...) plus explicitly-TPU variants (pagerank_tpu, ...)
+that expose device knobs.
+"""
+
+_LOADED = False
+
+
+def load_builtin_modules() -> None:
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from . import graph_algorithms  # noqa: F401 — registers on import
+    from . import vector_search     # noqa: F401
+    from . import node2vec_module   # noqa: F401
+    from . import utility_modules   # noqa: F401

@@ -1,0 +1,303 @@
+"""Centrality / community / component / path modules on TPU.
+
+API parity with the reference's modules:
+  pagerank.get            (query_modules/pagerank_module/pagerank_online_module.cpp)
+  pagerank.stream-free static variant (mage/cpp/pagerank_module)
+  katz_centrality.get     (query_modules/katz_centrality_module/)
+  community_detection.get (query_modules/community_detection_module/)
+  weakly_connected_components.get / wcc.get (mage/cpp/connectivity_module)
+  strongly_connected_components.get
+  degree_centrality.get   (mage/cpp/degree_centrality_module)
+  betweenness_centrality.get (sampled Brandes via multi-source BFS)
+  hits.get                (cugraph_module/algorithms/hits.cu analog)
+  bfs.get / sssp.get path utilities
+
+All `*_tpu` aliases expose the same procedures for explicit dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import mgp
+
+
+def _rank_results(ctx, graph, values, field_name):
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, field_name: float(values[i])}
+
+
+def _pagerank_impl(ctx, max_iterations=100, damping_factor=0.85,
+                   stop_epsilon=1e-5, weight_property=None):
+    from ..ops.pagerank import pagerank
+    graph = ctx.device_graph(weight_property=weight_property)
+    if graph.n_nodes == 0:
+        return
+    ranks, _, _ = pagerank(graph, damping=float(damping_factor),
+                           max_iterations=int(max_iterations),
+                           tol=float(stop_epsilon))
+    ranks = np.asarray(ranks)
+    yield from _rank_results(ctx, graph, ranks, "rank")
+
+
+for _name in ("pagerank.get", "pagerank_tpu.get", "pagerank_online.get"):
+    mgp.read_proc(_name,
+                  opt_args=[("max_iterations", "INTEGER", 100),
+                            ("damping_factor", "FLOAT", 0.85),
+                            ("stop_epsilon", "FLOAT", 1e-5),
+                            ("weight_property", "STRING", None)],
+                  results=[("node", "NODE"), ("rank", "FLOAT")])(_pagerank_impl)
+
+
+@mgp.read_proc("pagerank.personalized",
+               args=[("source_nodes", "LIST")],
+               opt_args=[("max_iterations", "INTEGER", 100),
+                         ("damping_factor", "FLOAT", 0.85)],
+               results=[("node", "NODE"), ("rank", "FLOAT")])
+def personalized_pagerank(ctx, source_nodes, max_iterations=100,
+                          damping_factor=0.85):
+    from ..ops.pagerank import personalized_pagerank as ppr
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    sources = [graph.gid_to_idx[v.gid] for v in source_nodes
+               if v is not None and v.gid in graph.gid_to_idx]
+    if not sources:
+        return
+    ranks, _, _ = ppr(graph, sources, damping=float(damping_factor),
+                      max_iterations=int(max_iterations))
+    yield from _rank_results(ctx, graph, np.asarray(ranks), "rank")
+
+
+def _katz_impl(ctx, alpha=0.2, epsilon=1e-2):
+    from ..ops.katz import katz_centrality
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    xs, _, _ = katz_centrality(graph, alpha=float(alpha), tol=float(epsilon),
+                               max_iterations=500)
+    yield from _rank_results(ctx, graph, np.asarray(xs), "rank")
+
+
+for _name in ("katz_centrality.get", "katz_centrality_tpu.get",
+              "katz_centrality_online.get"):
+    mgp.read_proc(_name,
+                  opt_args=[("alpha", "FLOAT", 0.2),
+                            ("epsilon", "FLOAT", 1e-2)],
+                  results=[("node", "NODE"), ("rank", "FLOAT")])(_katz_impl)
+
+
+def _community_impl(ctx, max_iterations=30, weight_property=None):
+    from ..ops.labelprop import label_propagation
+    graph = ctx.device_graph(weight_property=weight_property)
+    if graph.n_nodes == 0:
+        return
+    labels, _ = label_propagation(graph, max_iterations=int(max_iterations))
+    labels = np.asarray(labels)
+    # compact community ids to 1..k (reference convention: ids start at 1)
+    uniq = {int(l): i + 1 for i, l in enumerate(sorted(set(labels.tolist())))}
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "community_id": uniq[int(labels[i])]}
+
+
+for _name in ("community_detection.get", "community_detection_tpu.get",
+              "community_detection_online.get", "label_propagation.get"):
+    mgp.read_proc(_name,
+                  opt_args=[("max_iterations", "INTEGER", 30),
+                            ("weight_property", "STRING", None)],
+                  results=[("node", "NODE"),
+                           ("community_id", "INTEGER")])(_community_impl)
+
+
+def _wcc_impl(ctx):
+    from ..ops.components import weakly_connected_components
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    comp, _ = weakly_connected_components(graph)
+    comp = np.asarray(comp)
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "component_id": int(comp[i])}
+
+
+for _name in ("weakly_connected_components.get", "wcc.get",
+              "connectivity.get", "wcc_tpu.get"):
+    mgp.read_proc(_name,
+                  results=[("node", "NODE"),
+                           ("component_id", "INTEGER")])(_wcc_impl)
+
+
+@mgp.read_proc("strongly_connected_components.get",
+               results=[("node", "NODE"), ("component_id", "INTEGER")])
+def scc_get(ctx):
+    from ..ops.components import strongly_connected_components
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    comp = np.asarray(strongly_connected_components(graph))
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "component_id": int(comp[i])}
+
+
+@mgp.read_proc("degree_centrality.get",
+               opt_args=[("type", "STRING", "undirected")],
+               results=[("node", "NODE"), ("degree", "FLOAT")])
+def degree_get(ctx, type="undirected"):
+    from ..ops.katz import degree_centrality
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    direction = {"in": "in", "out": "out"}.get(str(type).lower(), "total")
+    degs = np.asarray(degree_centrality(graph, direction))
+    yield from _rank_results(ctx, graph, degs, "degree")
+
+
+@mgp.read_proc("hits.get",
+               opt_args=[("max_iterations", "INTEGER", 100),
+                         ("tolerance", "FLOAT", 1e-6)],
+               results=[("node", "NODE"), ("hub", "FLOAT"),
+                        ("authority", "FLOAT")])
+def hits_get(ctx, max_iterations=100, tolerance=1e-6):
+    from ..ops.katz import hits
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    hub, auth, _, _ = hits(graph, max_iterations=int(max_iterations),
+                           tol=float(tolerance))
+    hub, auth = np.asarray(hub), np.asarray(auth)
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "hub": float(hub[i]),
+                   "authority": float(auth[i])}
+
+
+@mgp.read_proc("betweenness_centrality.get",
+               opt_args=[("normalized", "BOOLEAN", True),
+                         ("directed", "BOOLEAN", True),
+                         ("num_samples", "INTEGER", 64)],
+               results=[("node", "NODE"),
+                        ("betweenness_centrality", "FLOAT")])
+def betweenness_get(ctx, normalized=True, directed=True, num_samples=64):
+    """Sampled Brandes: pivots' BFS distances on device, dependency
+    accumulation per pivot (reference: mage/cpp/betweenness_centrality_module;
+    the sampling approach matches its online variant's spirit)."""
+    from ..ops.traversal import multi_source_sssp
+    graph = ctx.device_graph()
+    n = graph.n_nodes
+    if n == 0:
+        return
+    rng = np.random.default_rng(0)
+    k = min(int(num_samples), n)
+    pivots = rng.choice(n, size=k, replace=False)
+    dist = np.asarray(multi_source_sssp(graph, pivots, weighted=False,
+                                        directed=bool(directed)))
+    # host-side dependency accumulation over the (small) pivot set
+    src = np.asarray(graph.src_idx)[:graph.n_edges]
+    dst = np.asarray(graph.col_idx)[:graph.n_edges]
+    bc = np.zeros(n, dtype=np.float64)
+    for pi in range(k):
+        d = dist[pi]
+        finite = np.isfinite(d)
+        # count shortest paths via BFS layers
+        sigma = np.zeros(n)
+        sigma[pivots[pi]] = 1.0
+        maxd = int(d[finite].max()) if finite.any() else 0
+        for level in range(1, maxd + 1):
+            on_edge = finite[src] & finite[dst] & \
+                (d[src] == level - 1) & (d[dst] == level)
+            np.add.at(sigma, dst[on_edge], sigma[src[on_edge]])
+        delta = np.zeros(n)
+        for level in range(maxd, 0, -1):
+            on_edge = finite[src] & finite[dst] & \
+                (d[src] == level - 1) & (d[dst] == level)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                contrib = np.where(sigma[dst[on_edge]] > 0,
+                                   sigma[src[on_edge]] / sigma[dst[on_edge]]
+                                   * (1.0 + delta[dst[on_edge]]), 0.0)
+            np.add.at(delta, src[on_edge], contrib)
+        delta[pivots[pi]] = 0.0
+        bc += delta
+    bc *= n / max(k, 1)  # scale sample to population
+    if normalized and n > 2:
+        scale = 1.0 / ((n - 1) * (n - 2))
+        if not directed:
+            scale *= 2.0
+        bc *= scale
+    if not directed:
+        bc /= 2.0
+    for i in range(n):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "betweenness_centrality": float(bc[i])}
+
+
+@mgp.read_proc("bfs.get",
+               args=[("source", "NODE")],
+               opt_args=[("directed", "BOOLEAN", True)],
+               results=[("node", "NODE"), ("level", "INTEGER")])
+def bfs_get(ctx, source, directed=True):
+    from ..ops.traversal import bfs_levels
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0 or source is None:
+        return
+    sidx = graph.gid_to_idx.get(source.gid)
+    if sidx is None:
+        return
+    levels, _ = bfs_levels(graph, sidx, directed=bool(directed))
+    levels = np.asarray(levels)
+    for i in range(graph.n_nodes):
+        if levels[i] >= 0:
+            node = ctx.vertex_by_index(graph, i)
+            if node is not None:
+                yield {"node": node, "level": int(levels[i])}
+
+
+@mgp.read_proc("sssp.get",
+               args=[("source", "NODE")],
+               opt_args=[("weight_property", "STRING", "weight")],
+               results=[("node", "NODE"), ("distance", "FLOAT")])
+def sssp_get(ctx, source, weight_property="weight"):
+    from ..ops.traversal import sssp
+    graph = ctx.device_graph(weight_property=weight_property)
+    if graph.n_nodes == 0 or source is None:
+        return
+    sidx = graph.gid_to_idx.get(source.gid)
+    if sidx is None:
+        return
+    dist, _ = sssp(graph, sidx, weighted=True, directed=True)
+    dist = np.asarray(dist)
+    for i in range(graph.n_nodes):
+        if np.isfinite(dist[i]):
+            node = ctx.vertex_by_index(graph, i)
+            if node is not None:
+                yield {"node": node, "distance": float(dist[i])}
+
+
+@mgp.read_proc("graph_util.khop",
+               args=[("sources", "LIST"), ("hops", "INTEGER")],
+               opt_args=[("directed", "BOOLEAN", False)],
+               results=[("node", "NODE")])
+def khop_get(ctx, sources, hops, directed=False):
+    from ..ops.traversal import khop_neighborhood
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    idxs = [graph.gid_to_idx[v.gid] for v in sources
+            if v is not None and v.gid in graph.gid_to_idx]
+    if not idxs:
+        return
+    mask = np.asarray(khop_neighborhood(graph, idxs, int(hops),
+                                        directed=bool(directed)))
+    for i in np.nonzero(mask)[0]:
+        node = ctx.vertex_by_index(graph, int(i))
+        if node is not None:
+            yield {"node": node}

@@ -1,0 +1,94 @@
+"""node2vec module: device random walks + skip-gram embeddings.
+
+Counterpart of /root/reference/mage/python/node2vec.py and
+query_modules/node2vec_online_module/: walks sampled on TPU
+(ops/walks.py), embeddings trained with the optax skip-gram trainer
+(models/node2vec.py), streamed back as node -> vector rows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import mgp
+
+
+@mgp.read_proc("node2vec.get_embeddings",
+               opt_args=[("dimensions", "INTEGER", 128),
+                         ("walk_length", "INTEGER", 20),
+                         ("walks_per_node", "INTEGER", 4),
+                         ("p", "FLOAT", 1.0),
+                         ("q", "FLOAT", 1.0),
+                         ("window", "INTEGER", 5),
+                         ("epochs", "INTEGER", 3),
+                         ("learning_rate", "FLOAT", 0.01)],
+               results=[("node", "NODE"), ("embedding", "LIST")])
+def get_embeddings(ctx, dimensions=128, walk_length=20, walks_per_node=4,
+                   p=1.0, q=1.0, window=5, epochs=3, learning_rate=0.01):
+    from ..models.node2vec import Node2Vec, Node2VecConfig
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    cfg = Node2VecConfig(
+        embedding_dim=int(dimensions), walk_length=int(walk_length),
+        walks_per_node=int(walks_per_node), p=float(p), q=float(q),
+        window=int(window), epochs=int(epochs),
+        learning_rate=float(learning_rate))
+    emb = Node2Vec(cfg).fit(graph)
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "embedding": [float(x) for x in emb[i]]}
+
+
+@mgp.write_proc("node2vec.set_embeddings",
+                opt_args=[("property", "STRING", "embedding"),
+                          ("dimensions", "INTEGER", 128),
+                          ("walk_length", "INTEGER", 20),
+                          ("walks_per_node", "INTEGER", 4),
+                          ("epochs", "INTEGER", 3)],
+                results=[("nodes_updated", "INTEGER")])
+def set_embeddings(ctx, property="embedding", dimensions=128, walk_length=20,
+                   walks_per_node=4, epochs=3):
+    from ..models.node2vec import Node2Vec, Node2VecConfig
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        yield {"nodes_updated": 0}
+        return
+    cfg = Node2VecConfig(embedding_dim=int(dimensions),
+                         walk_length=int(walk_length),
+                         walks_per_node=int(walks_per_node),
+                         epochs=int(epochs))
+    emb = Node2Vec(cfg).fit(graph)
+    pid = ctx.storage.property_mapper.name_to_id(str(property))
+    updated = 0
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            node.set_property(pid, [float(x) for x in emb[i]])
+            updated += 1
+    yield {"nodes_updated": updated}
+
+
+@mgp.read_proc("node2vec.random_walks",
+               args=[("start_nodes", "LIST")],
+               opt_args=[("length", "INTEGER", 10),
+                         ("p", "FLOAT", 1.0), ("q", "FLOAT", 1.0),
+                         ("seed", "INTEGER", 0)],
+               results=[("walk", "LIST")])
+def random_walks_proc(ctx, start_nodes, length=10, p=1.0, q=1.0, seed=0):
+    import jax
+    from ..ops.walks import random_walks
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    starts = [graph.gid_to_idx[v.gid] for v in start_nodes
+              if v is not None and v.gid in graph.gid_to_idx]
+    if not starts:
+        return
+    walks = np.asarray(random_walks(graph, starts, int(length),
+                                    key=jax.random.PRNGKey(int(seed)),
+                                    p=float(p), q=float(q)))
+    for row in walks:
+        nodes = ctx.vertices_by_indices(graph, row)
+        yield {"walk": [n for n in nodes if n is not None]}

@@ -1,0 +1,68 @@
+"""Utility modules: mg.procedures, graph stats, kmeans.
+
+Counterparts of the reference's introspection/utility modules
+(mage/cpp/{meta,util}_module, query_modules/schema.cpp surface, and
+mage/python/kmeans.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import mgp
+
+
+@mgp.read_proc("mg.procedures",
+               results=[("name", "STRING"), ("signature", "STRING"),
+                        ("is_write", "BOOLEAN")])
+def mg_procedures(ctx):
+    from ..query.procedures.registry import global_registry
+    for proc in global_registry.all_procedures():
+        args = ", ".join(f"{n} :: {t}" for n, t in proc.args)
+        opts = ", ".join(f"{n} = {d!r} :: {t}"
+                         for n, t, d in proc.opt_args)
+        res = ", ".join(f"{n} :: {t}" for n, t in proc.results)
+        sig = f"{proc.name}({', '.join(x for x in (args, opts) if x)}) " \
+              f":: ({res})"
+        yield {"name": proc.name, "signature": sig,
+               "is_write": proc.is_write}
+
+
+@mgp.read_proc("graph_util.stats",
+               results=[("num_nodes", "INTEGER"), ("num_edges", "INTEGER"),
+                        ("avg_degree", "FLOAT"), ("num_components", "INTEGER")])
+def graph_stats(ctx):
+    from ..ops.components import weakly_connected_components
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        yield {"num_nodes": 0, "num_edges": 0, "avg_degree": 0.0,
+               "num_components": 0}
+        return
+    comp, _ = weakly_connected_components(graph)
+    n_comp = len(set(np.asarray(comp).tolist()))
+    yield {"num_nodes": graph.n_nodes, "num_edges": graph.n_edges,
+           "avg_degree": 2.0 * graph.n_edges / graph.n_nodes,
+           "num_components": n_comp}
+
+
+@mgp.read_proc("kmeans.get_clusters",
+               args=[("property", "STRING"), ("n_clusters", "INTEGER")],
+               opt_args=[("iterations", "INTEGER", 10),
+                         ("seed", "INTEGER", 0)],
+               results=[("node", "NODE"), ("cluster_id", "INTEGER")])
+def kmeans_clusters(ctx, property, n_clusters, iterations=10, seed=0):
+    import jax
+    import jax.numpy as jnp
+    from ..ops.knn import kmeans_fit
+    from .vector_search import _embedding_matrix
+    matrix, gids = _embedding_matrix(ctx, str(property))
+    if matrix is None:
+        return
+    k = max(1, min(int(n_clusters), matrix.shape[0]))
+    _, assign = kmeans_fit(matrix, jax.random.PRNGKey(int(seed)), k,
+                           iters=int(iterations))
+    assign = np.asarray(assign)
+    for gid, cluster in zip(gids, assign):
+        node = ctx.accessor.find_vertex(gid, ctx.view)
+        if node is not None:
+            yield {"node": node, "cluster_id": int(cluster)}

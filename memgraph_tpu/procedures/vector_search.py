@@ -1,0 +1,123 @@
+"""Vector search module: brute-force/IVF kNN over node embedding properties.
+
+Counterpart of /root/reference/query_modules/vector_search_module.cpp (which
+fronts the usearch HNSW index): here search IS the index — batched MXU
+matmul + top_k over a device-resident embedding matrix, cached per
+(storage, topology_version, property).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import mgp
+
+_CACHE_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _embedding_matrix(ctx, property_name: str):
+    """(matrix (n, d) jnp array, gids list) for nodes carrying the property."""
+    import jax.numpy as jnp
+    storage = ctx.storage
+    key = (id(storage), storage.topology_version, property_name)
+    with _CACHE_LOCK:
+        hit = _CACHE.get(key)
+    if hit is not None:
+        return hit
+    pid = storage.property_mapper.maybe_name_to_id(property_name)
+    vectors = []
+    gids = []
+    if pid is not None:
+        for va in ctx.accessor.vertices(ctx.view):
+            vec = va.get_property(pid, ctx.view)
+            if isinstance(vec, (list, tuple)) and vec and \
+                    all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                        for x in vec):
+                vectors.append(vec)
+                gids.append(va.gid)
+    if vectors:
+        matrix = jnp.asarray(np.asarray(vectors, dtype=np.float32))
+    else:
+        matrix = None
+    result = (matrix, gids)
+    with _CACHE_LOCK:
+        stale = [k for k in _CACHE if k[0] == id(storage) and k != key]
+        for k in stale:
+            del _CACHE[k]
+        _CACHE[key] = result
+    return result
+
+
+@mgp.read_proc("vector_search.search",
+               args=[("property", "STRING"), ("query", "LIST"),
+                     ("limit", "INTEGER")],
+               opt_args=[("metric", "STRING", "cosine")],
+               results=[("node", "NODE"), ("similarity", "FLOAT")])
+def search(ctx, property, query, limit, metric="cosine"):
+    from ..ops.knn import knn
+    import jax.numpy as jnp
+    matrix, gids = _embedding_matrix(ctx, property)
+    if matrix is None:
+        return
+    q = jnp.asarray(np.asarray([query], dtype=np.float32))
+    k = min(int(limit), len(gids))
+    scores, idx = knn(matrix, q, k=k, metric=str(metric))
+    scores = np.asarray(scores[0])
+    idx = np.asarray(idx[0])
+    for score, i in zip(scores, idx):
+        node = ctx.accessor.find_vertex(gids[int(i)], ctx.view)
+        if node is not None:
+            yield {"node": node, "similarity": float(score)}
+
+
+@mgp.read_proc("vector_search.show_index_info",
+               results=[("index_name", "STRING"), ("label", "STRING"),
+                        ("property", "STRING"), ("dimension", "INTEGER"),
+                        ("size", "INTEGER")])
+def show_index_info(ctx):
+    with _CACHE_LOCK:
+        items = list(_CACHE.items())
+    for (sid, ver, prop), (matrix, gids) in items:
+        if sid != id(ctx.storage):
+            continue
+        yield {"index_name": f"vector::{prop}", "label": "*",
+               "property": prop,
+               "dimension": int(matrix.shape[1]) if matrix is not None else 0,
+               "size": len(gids)}
+
+
+@mgp.read_proc("knn.get",
+               args=[("node", "NODE"), ("property", "STRING"),
+                     ("k", "INTEGER")],
+               opt_args=[("metric", "STRING", "cosine")],
+               results=[("neighbor", "NODE"), ("similarity", "FLOAT")])
+def knn_get(ctx, node, property, k, metric="cosine"):
+    """k nearest neighbors of an existing node by embedding similarity
+    (counterpart of mage/cpp/knn_module)."""
+    from ..ops.knn import knn
+    import jax.numpy as jnp
+    matrix, gids = _embedding_matrix(ctx, property)
+    if matrix is None or node is None:
+        return
+    try:
+        row = gids.index(node.gid)
+    except ValueError:
+        return
+    q = matrix[row:row + 1]
+    kk = min(int(k) + 1, len(gids))
+    scores, idx = knn(matrix, q, k=kk, metric=str(metric))
+    scores = np.asarray(scores[0])
+    idx = np.asarray(idx[0])
+    emitted = 0
+    for score, i in zip(scores, idx):
+        if int(i) == row:
+            continue
+        if emitted >= int(k):
+            break
+        nb = ctx.accessor.find_vertex(gids[int(i)], ctx.view)
+        if nb is not None:
+            emitted += 1
+            yield {"neighbor": nb, "similarity": float(score)}

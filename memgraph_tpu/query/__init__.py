@@ -1,0 +1,13 @@
+"""openCypher query engine (host side).
+
+Re-design of the reference's query layer (/root/reference/src/query/):
+hand-written lexer + recursive-descent parser producing an AST (the
+reference uses ANTLR — frontend/opencypher/grammar/), symbol analysis,
+a rule-based planner with index rewrites (query/plan/), and a Volcano
+pull-based executor (query/plan/operator.hpp) — with the analytics regime
+delegated to the TPU ops layer through the procedure registry.
+"""
+
+from .interpreter import Interpreter, InterpreterContext
+
+__all__ = ["Interpreter", "InterpreterContext"]

@@ -1,0 +1,109 @@
+"""DUMP DATABASE — stream the graph as cypherl statements.
+
+Counterpart of /root/reference/src/query/dump.cpp: emits index/constraint
+DDL, CREATE statements for vertices (keyed by an internal id property) and
+edges, then drops the helper index.
+"""
+
+from __future__ import annotations
+
+from ..storage.common import View
+from ..utils.point import Point
+from ..utils.temporal import (Date, Duration, LocalDateTime, LocalTime,
+                              ZonedDateTime)
+
+INTERNAL_ID = "__mg_id__"
+
+
+def _escape_string(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def _escape_name(name: str) -> str:
+    if name.isidentifier():
+        return name
+    return "`" + name.replace("`", "``") + "`"
+
+
+def value_to_cypher(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, str):
+        return _escape_string(v)
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(value_to_cypher(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ", ".join(f"{_escape_name(k)}: {value_to_cypher(x)}"
+                               for k, x in v.items()) + "}"
+    if isinstance(v, Date):
+        return f'DATE("{v}")'
+    if isinstance(v, LocalTime):
+        return f'LOCALTIME("{v}")'
+    if isinstance(v, LocalDateTime):
+        return f'LOCALDATETIME("{v}")'
+    if isinstance(v, ZonedDateTime):
+        return f'DATETIME("{v}")'
+    if isinstance(v, Duration):
+        return f'DURATION("{v}")'
+    if isinstance(v, Point):
+        inner = ", ".join(f"{k}: {value_to_cypher(val)}"
+                          for k, val in v.to_map().items())
+        return f"POINT({{{inner}}})"
+    raise TypeError(f"cannot dump value of type {type(v)!r}")
+
+
+def dump_database(accessor):
+    """Yield cypherl lines reproducing the accessor's visible graph."""
+    storage = accessor.storage
+    lm = storage.label_mapper
+    pm = storage.property_mapper
+    tm = storage.edge_type_mapper
+
+    # DDL first
+    for lid in storage.indices.label.labels():
+        yield f"CREATE INDEX ON :{_escape_name(lm.id_to_name(lid))};"
+    for (lid, pids) in storage.indices.label_property.keys():
+        props = ", ".join(_escape_name(pm.id_to_name(p)) for p in pids)
+        yield (f"CREATE INDEX ON :{_escape_name(lm.id_to_name(lid))}"
+               f"({props});")
+    for (lid, pid) in storage.constraints.existence.all():
+        yield (f"CREATE CONSTRAINT ON (u:{_escape_name(lm.id_to_name(lid))}) "
+               f"ASSERT EXISTS (u.{_escape_name(pm.id_to_name(pid))});")
+    for (lid, pids) in storage.constraints.unique.all():
+        props = ", ".join(f"u.{_escape_name(pm.id_to_name(p))}" for p in pids)
+        yield (f"CREATE CONSTRAINT ON (u:{_escape_name(lm.id_to_name(lid))}) "
+               f"ASSERT {props} IS UNIQUE;")
+
+    yield f"CREATE INDEX ON :__mg_vertex__({INTERNAL_ID});"
+
+    for va in accessor.vertices(View.OLD):
+        labels = "".join(f":{_escape_name(lm.id_to_name(l))}"
+                         for l in va.labels(View.OLD))
+        props = va.properties(View.OLD)
+        items = [f"{INTERNAL_ID}: {va.gid}"]
+        items += [f"{_escape_name(pm.id_to_name(k))}: {value_to_cypher(v)}"
+                  for k, v in sorted(props.items())]
+        yield (f"CREATE (:__mg_vertex__{labels} "
+               f"{{{', '.join(items)}}});")
+
+    for ea in accessor.edges(View.OLD):
+        props = ea.properties(View.OLD)
+        prop_str = ""
+        if props:
+            items = [f"{_escape_name(pm.id_to_name(k))}: {value_to_cypher(v)}"
+                     for k, v in sorted(props.items())]
+            prop_str = " {" + ", ".join(items) + "}"
+        yield (f"MATCH (u:__mg_vertex__), (v:__mg_vertex__) "
+               f"WHERE u.{INTERNAL_ID} = {ea.from_vertex().gid} AND "
+               f"v.{INTERNAL_ID} = {ea.to_vertex().gid} "
+               f"CREATE (u)-[:{_escape_name(tm.id_to_name(ea.edge_type))}"
+               f"{prop_str}]->(v);")
+
+    yield f"DROP INDEX ON :__mg_vertex__({INTERNAL_ID});"
+    yield f"MATCH (u) REMOVE u:__mg_vertex__, u.{INTERNAL_ID};"

@@ -1,0 +1,372 @@
+"""openCypher AST.
+
+Lean dataclass tree mirroring the shape of the reference's AST
+(/root/reference/src/query/frontend/ast/ast.hpp, 4.5k lines) at the altitude
+this engine needs: expressions, patterns, clauses, queries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+# --- expressions -------------------------------------------------------------
+
+class Expr:
+    __slots__ = ()
+
+
+@dataclass
+class Literal(Expr):
+    value: object
+
+
+@dataclass
+class Parameter(Expr):
+    name: str
+
+
+@dataclass
+class Identifier(Expr):
+    name: str
+
+
+@dataclass
+class PropertyLookup(Expr):
+    expr: Expr
+    prop: str
+
+
+@dataclass
+class LabelsTest(Expr):
+    expr: Expr
+    labels: list[str]
+
+
+@dataclass
+class Unary(Expr):
+    op: str  # '-', '+', 'NOT'
+    expr: Expr
+
+
+@dataclass
+class Binary(Expr):
+    op: str  # '+','-','*','/','%','^','=','<>','<','>','<=','>=',
+             # 'AND','OR','XOR','IN','STARTS WITH','ENDS WITH','CONTAINS','=~'
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class IsNull(Expr):
+    expr: Expr
+    negated: bool
+
+
+@dataclass
+class Subscript(Expr):
+    expr: Expr
+    index: Expr
+
+
+@dataclass
+class Slice(Expr):
+    expr: Expr
+    lo: Optional[Expr]
+    hi: Optional[Expr]
+
+
+@dataclass
+class ListLiteral(Expr):
+    items: list[Expr]
+
+
+@dataclass
+class MapLiteral(Expr):
+    items: dict[str, Expr]
+
+
+@dataclass
+class FunctionCall(Expr):
+    name: str            # lowercased, may be namespaced "ns.fn"
+    args: list[Expr]
+    distinct: bool = False
+
+
+@dataclass
+class CountStar(Expr):
+    pass
+
+
+@dataclass
+class CaseExpr(Expr):
+    test: Optional[Expr]               # CASE <test> WHEN ... (simple form)
+    whens: list[tuple[Expr, Expr]]
+    default: Optional[Expr]
+
+
+@dataclass
+class ListComprehension(Expr):
+    var: str
+    list_expr: Expr
+    where: Optional[Expr]
+    projection: Optional[Expr]
+
+
+@dataclass
+class Quantifier(Expr):
+    kind: str  # 'ALL','ANY','NONE','SINGLE'
+    var: str
+    list_expr: Expr
+    where: Expr
+
+
+@dataclass
+class Reduce(Expr):
+    acc: str
+    init: Expr
+    var: str
+    list_expr: Expr
+    expr: Expr
+
+
+@dataclass
+class PatternExpr(Expr):
+    """Pattern used as predicate/expression: exists((n)-[]->(m)))."""
+    pattern: "Pattern"
+    exists_form: bool = True
+
+
+# --- patterns ----------------------------------------------------------------
+
+@dataclass
+class NodePattern:
+    variable: Optional[str]
+    labels: list[str]
+    properties: object = None     # dict[str, Expr] | Parameter | None
+
+
+@dataclass
+class EdgePattern:
+    variable: Optional[str]
+    types: list[str]
+    direction: str                # 'out' (->), 'in' (<-), 'both' (--)
+    properties: object = None
+    var_length: bool = False
+    min_hops: Optional[Expr] = None
+    max_hops: Optional[Expr] = None
+
+
+@dataclass
+class Pattern:
+    """Alternating [Node, Edge, Node, Edge, Node...] chain."""
+    variable: Optional[str]
+    elements: list
+
+
+# --- clauses -----------------------------------------------------------------
+
+class Clause:
+    __slots__ = ()
+
+
+@dataclass
+class Match(Clause):
+    patterns: list[Pattern]
+    where: Optional[Expr] = None
+    optional: bool = False
+
+
+@dataclass
+class Create(Clause):
+    patterns: list[Pattern]
+
+
+@dataclass
+class Merge(Clause):
+    pattern: Pattern
+    on_create: list = field(default_factory=list)   # list[SetItem]
+    on_match: list = field(default_factory=list)
+
+
+@dataclass
+class SetItem:
+    kind: str      # 'prop' (n.p = e), 'var_assign' (n = expr),
+                   # 'var_update' (n += expr), 'label' (n:Label:...)
+    target: Expr   # PropertyLookup or Identifier
+    value: object  # Expr or list[str] for labels
+
+
+@dataclass
+class SetClause(Clause):
+    items: list[SetItem]
+
+
+@dataclass
+class RemoveItem:
+    kind: str      # 'prop' or 'label'
+    target: Expr
+    labels: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Remove(Clause):
+    items: list[RemoveItem]
+
+
+@dataclass
+class Delete(Clause):
+    exprs: list[Expr]
+    detach: bool = False
+
+
+@dataclass
+class SortItem:
+    expr: Expr
+    ascending: bool = True
+
+
+@dataclass
+class ReturnBody:
+    distinct: bool
+    items: list[tuple[Expr, Optional[str]]]   # (expr, alias)
+    star: bool
+    order_by: list[SortItem] = field(default_factory=list)
+    skip: Optional[Expr] = None
+    limit: Optional[Expr] = None
+
+
+@dataclass
+class Return(Clause):
+    body: ReturnBody
+
+
+@dataclass
+class With(Clause):
+    body: ReturnBody
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Unwind(Clause):
+    expr: Expr
+    variable: str
+
+
+@dataclass
+class CallProcedure(Clause):
+    name: str
+    args: list[Expr]
+    yields: list[tuple[str, Optional[str]]]   # (field, alias)
+    yield_star: bool = False
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Foreach(Clause):
+    variable: str
+    expr: Expr
+    updates: list[Clause]
+
+
+# --- queries -----------------------------------------------------------------
+
+@dataclass
+class SingleQuery:
+    clauses: list[Clause]
+
+
+@dataclass
+class CypherQuery:
+    query: SingleQuery
+    unions: list[tuple[bool, SingleQuery]] = field(default_factory=list)
+    # [(all?, query)]
+    explain: bool = False
+    profile: bool = False
+
+
+# --- administrative / DDL queries -------------------------------------------
+
+@dataclass
+class IndexQuery:
+    action: str                     # 'create' | 'drop'
+    kind: str                       # 'label' | 'label_property' | 'edge_type'
+    label: Optional[str]
+    properties: list[str] = field(default_factory=list)
+    edge_type: Optional[str] = None
+
+
+@dataclass
+class ConstraintQuery:
+    action: str                     # 'create' | 'drop'
+    kind: str                       # 'exists' | 'unique' | 'type'
+    label: str
+    properties: list[str]
+    data_type: Optional[str] = None
+
+
+@dataclass
+class InfoQuery:
+    kind: str   # 'storage' | 'index' | 'constraint' | 'build' | 'metrics'
+
+
+@dataclass
+class TransactionQuery:
+    action: str  # 'begin' | 'commit' | 'rollback'
+    metadata: Optional[dict] = None
+
+
+@dataclass
+class ShowTransactionsQuery:
+    pass
+
+
+@dataclass
+class TerminateTransactionsQuery:
+    ids: list[Expr] = field(default_factory=list)
+
+
+@dataclass
+class SnapshotQuery:
+    action: str  # 'create' | 'recover' | 'show'
+
+
+@dataclass
+class DumpQuery:
+    pass
+
+
+@dataclass
+class AnalyzeGraphQuery:
+    action: str = "analyze"  # 'analyze' | 'delete'
+    labels: list[str] = field(default_factory=list)
+
+
+@dataclass
+class IsolationLevelQuery:
+    level: str
+    scope: str  # 'global' | 'session' | 'next'
+
+
+@dataclass
+class StorageModeQuery:
+    mode: str   # 'IN_MEMORY_ANALYTICAL' | 'IN_MEMORY_TRANSACTIONAL'
+
+
+@dataclass
+class TriggerQuery:
+    action: str                     # 'create' | 'drop' | 'show'
+    name: Optional[str] = None
+    event: Optional[str] = None     # e.g. 'CREATE' / 'UPDATE' / 'DELETE' / None
+    phase: Optional[str] = None     # 'BEFORE' | 'AFTER'
+    statement: Optional[str] = None
+
+
+@dataclass
+class AuthQuery:
+    action: str                     # create_user/drop_user/set_password/...
+    user: Optional[str] = None
+    password: Optional[object] = None
+    role: Optional[str] = None
+    privileges: list[str] = field(default_factory=list)

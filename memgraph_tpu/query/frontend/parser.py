@@ -1,0 +1,1098 @@
+"""openCypher recursive-descent parser.
+
+Grammar shape follows the openCypher specification (the reference parses
+with ANTLR against frontend/opencypher/grammar/Cypher.g4 plus extensions in
+MemgraphCypher.g4); this is a fresh hand-written implementation covering the
+query surface the engine executes: reading/writing clauses, expressions with
+full precedence, patterns incl. variable-length edges, CALL ... YIELD,
+UNION, DDL (indexes/constraints), transactions, EXPLAIN/PROFILE, and the
+admin/info query families.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...exceptions import SyntaxException
+from . import ast as A
+from .lexer import T, Token, tokenize
+
+
+def parse(text: str):
+    """Parse one statement (trailing ';' tolerated). Returns an AST root:
+    CypherQuery | IndexQuery | ConstraintQuery | InfoQuery | ... """
+    return Parser(tokenize(text)).parse_statement()
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]) -> None:
+        self.toks = tokens
+        self.i = 0
+
+    # --- token helpers ------------------------------------------------------
+
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def peek(self, k=1) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def advance(self) -> Token:
+        tok = self.toks[self.i]
+        if tok.type != T.EOF:
+            self.i += 1
+        return tok
+
+    def at(self, type_: str) -> bool:
+        return self.cur.type == type_
+
+    def at_kw(self, *names: str) -> bool:
+        return self.cur.is_kw(*names)
+
+    def accept(self, type_: str) -> Optional[Token]:
+        if self.cur.type == type_:
+            return self.advance()
+        return None
+
+    def accept_kw(self, *names: str) -> Optional[Token]:
+        if self.cur.is_kw(*names):
+            return self.advance()
+        return None
+
+    def expect(self, type_: str) -> Token:
+        if self.cur.type != type_:
+            self.error(f"expected {type_!r}, got {self._desc(self.cur)}")
+        return self.advance()
+
+    def expect_kw(self, *names: str) -> Token:
+        if not self.cur.is_kw(*names):
+            self.error(f"expected {'/'.join(names)}, got {self._desc(self.cur)}")
+        return self.advance()
+
+    @staticmethod
+    def _desc(tok: Token) -> str:
+        if tok.type == T.EOF:
+            return "end of input"
+        return repr(tok.value if tok.value is not None else tok.type)
+
+    def error(self, msg: str):
+        tok = self.cur
+        raise SyntaxException(f"line {tok.line}:{tok.col} {msg}")
+
+    def name_token(self) -> str:
+        """Identifier or any keyword used as a name (Cypher allows both)."""
+        if self.at(T.IDENT):
+            return self.advance().value
+        if self.cur.type == T.KEYWORD:
+            return self.advance().value.lower()
+        self.error(f"expected a name, got {self._desc(self.cur)}")
+
+    # --- statement dispatch -------------------------------------------------
+
+    def parse_statement(self):
+        explain = profile = False
+        if self.accept_kw("EXPLAIN"):
+            explain = True
+        elif self.accept_kw("PROFILE"):
+            profile = True
+
+        node = self._dispatch()
+        if isinstance(node, A.CypherQuery):
+            node.explain = explain
+            node.profile = profile
+        elif explain or profile:
+            self.error("EXPLAIN/PROFILE is only supported for Cypher queries")
+        self.accept(";")
+        if not self.at(T.EOF):
+            self.error(f"unexpected input after statement: {self._desc(self.cur)}")
+        return node
+
+    def _dispatch(self):
+        if self.at_kw("CREATE"):
+            nxt = self.peek()
+            if nxt.is_kw("INDEX"):
+                return self.parse_create_index()
+            if nxt.is_kw("EDGE"):
+                return self.parse_create_edge_index()
+            if nxt.is_kw("CONSTRAINT"):
+                return self.parse_constraint("create")
+            if nxt.is_kw("SNAPSHOT"):
+                self.advance(); self.advance()
+                return A.SnapshotQuery("create")
+            if nxt.is_kw("TRIGGER"):
+                return self.parse_create_trigger()
+            if nxt.is_kw("USER"):
+                return self.parse_auth()
+            return self.parse_cypher_query()
+        if self.at_kw("DROP"):
+            nxt = self.peek()
+            if nxt.is_kw("INDEX"):
+                return self.parse_drop_index()
+            if nxt.is_kw("EDGE"):
+                return self.parse_drop_edge_index()
+            if nxt.is_kw("CONSTRAINT"):
+                return self.parse_constraint("drop")
+            if nxt.is_kw("TRIGGER"):
+                self.advance(); self.advance()
+                return A.TriggerQuery("drop", name=self.name_token())
+            if nxt.is_kw("USER"):
+                return self.parse_auth()
+            self.error("unsupported DROP statement")
+        if self.at_kw("SHOW"):
+            return self.parse_show()
+        if self.at_kw("BEGIN"):
+            self.advance()
+            return A.TransactionQuery("begin")
+        if self.at_kw("COMMIT"):
+            self.advance()
+            return A.TransactionQuery("commit")
+        if self.at_kw("ROLLBACK"):
+            self.advance()
+            return A.TransactionQuery("rollback")
+        if self.at_kw("TERMINATE"):
+            self.advance()
+            self.expect_kw("TRANSACTIONS")
+            ids = [self.parse_expression()]
+            while self.accept(","):
+                ids.append(self.parse_expression())
+            return A.TerminateTransactionsQuery(ids)
+        if self.at_kw("RECOVER"):
+            self.advance()
+            self.expect_kw("SNAPSHOT")
+            return A.SnapshotQuery("recover")
+        if self.at_kw("DUMP"):
+            self.advance()
+            self.expect_kw("DATABASE")
+            return A.DumpQuery()
+        if self.at_kw("ANALYZE"):
+            self.advance()
+            self.expect_kw("GRAPH")
+            labels = []
+            if self.accept_kw("ON"):
+                self.expect_kw("LABELS")
+                labels.append(self._colon_label())
+                while self.accept(","):
+                    labels.append(self._colon_label())
+            action = "analyze"
+            if self.accept_kw("DELETE"):
+                self.expect_kw("STATS")
+                action = "delete"
+            return A.AnalyzeGraphQuery(action, labels)
+        if self.at_kw("SET"):
+            nxt = self.peek()
+            if nxt.is_kw("GLOBAL", "SESSION", "NEXT"):
+                return self.parse_isolation_or_storage()
+            if nxt.is_kw("STORAGE"):
+                return self.parse_isolation_or_storage()
+            if nxt.is_kw("PASSWORD"):
+                return self.parse_auth()
+            return self.parse_cypher_query()
+        return self.parse_cypher_query()
+
+    def _colon_label(self) -> str:
+        self.expect(":")
+        return self.name_token()
+
+    # --- DDL ---------------------------------------------------------------
+
+    def parse_create_index(self) -> A.IndexQuery:
+        self.expect_kw("CREATE")
+        self.expect_kw("INDEX")
+        self.expect_kw("ON")
+        label = self._colon_label()
+        props: list[str] = []
+        if self.accept("("):
+            props.append(self.name_token())
+            while self.accept(","):
+                props.append(self.name_token())
+            self.expect(")")
+        kind = "label_property" if props else "label"
+        return A.IndexQuery("create", kind, label, props)
+
+    def parse_drop_index(self) -> A.IndexQuery:
+        self.expect_kw("DROP")
+        self.expect_kw("INDEX")
+        self.expect_kw("ON")
+        label = self._colon_label()
+        props: list[str] = []
+        if self.accept("("):
+            props.append(self.name_token())
+            while self.accept(","):
+                props.append(self.name_token())
+            self.expect(")")
+        kind = "label_property" if props else "label"
+        return A.IndexQuery("drop", kind, label, props)
+
+    def parse_create_edge_index(self) -> A.IndexQuery:
+        self.expect_kw("CREATE")
+        self.expect_kw("EDGE")
+        self.expect_kw("INDEX")
+        self.expect_kw("ON")
+        self.expect(":")
+        etype = self.name_token()
+        return A.IndexQuery("create", "edge_type", None, [], etype)
+
+    def parse_drop_edge_index(self) -> A.IndexQuery:
+        self.expect_kw("DROP")
+        self.expect_kw("EDGE")
+        self.expect_kw("INDEX")
+        self.expect_kw("ON")
+        self.expect(":")
+        etype = self.name_token()
+        return A.IndexQuery("drop", "edge_type", None, [], etype)
+
+    def parse_constraint(self, action: str) -> A.ConstraintQuery:
+        self.advance()  # CREATE/DROP
+        self.expect_kw("CONSTRAINT")
+        self.expect_kw("ON")
+        self.expect("(")
+        var = self.name_token()
+        self.expect(":")
+        label = self.name_token()
+        self.expect(")")
+        self.expect_kw("ASSERT")
+        if self.accept_kw("EXISTS"):
+            self.expect("(")
+            self._qualified_prop(var)
+            prop = self._last_prop
+            self.expect(")")
+            return A.ConstraintQuery(action, "exists", label, [prop])
+        # n.a IS UNIQUE / n.a, n.b IS UNIQUE / n.a IS TYPED STRING
+        props = [self._qualified_prop(var)]
+        while self.accept(","):
+            props.append(self._qualified_prop(var))
+        self.expect_kw("IS")
+        if self.accept_kw("UNIQUE"):
+            return A.ConstraintQuery(action, "unique", label, props)
+        self.expect_kw("TYPED")
+        type_name = self.name_token()
+        return A.ConstraintQuery(action, "type", label, props, type_name)
+
+    _last_prop: str = ""
+
+    def _qualified_prop(self, var: str) -> str:
+        name = self.name_token()
+        if name != var:
+            self.error(f"unknown variable {name!r} in constraint")
+        self.expect(".")
+        self._last_prop = self.name_token()
+        return self._last_prop
+
+    def parse_show(self):
+        self.expect_kw("SHOW")
+        if self.accept_kw("INDEX"):
+            self.expect_kw("INFO")
+            return A.InfoQuery("index")
+        if self.accept_kw("CONSTRAINT"):
+            self.expect_kw("INFO")
+            return A.InfoQuery("constraint")
+        if self.accept_kw("STORAGE"):
+            self.expect_kw("INFO")
+            return A.InfoQuery("storage")
+        if self.accept_kw("BUILD"):
+            self.expect_kw("INFO")
+            return A.InfoQuery("build")
+        if self.accept_kw("METRICS"):
+            self.accept_kw("INFO")
+            return A.InfoQuery("metrics")
+        if self.accept_kw("TRANSACTIONS"):
+            return A.ShowTransactionsQuery()
+        if self.accept_kw("SNAPSHOT"):  # SHOW SNAPSHOTS
+            return A.SnapshotQuery("show")
+        if self.accept_kw("TRIGGERS"):
+            return A.TriggerQuery("show")
+        if self.accept_kw("DATABASE"):
+            return A.InfoQuery("database")
+        if self.accept_kw("SCHEMA"):
+            self.expect_kw("INFO")
+            return A.InfoQuery("schema")
+        self.error("unsupported SHOW statement")
+
+    def parse_isolation_or_storage(self):
+        self.expect_kw("SET")
+        if self.accept_kw("STORAGE"):
+            self.expect_kw("MODE")
+            if self.accept_kw("IN_MEMORY_ANALYTICAL"):
+                return A.StorageModeQuery("IN_MEMORY_ANALYTICAL")
+            tok = self.advance()
+            mode = str(tok.value).upper()
+            if mode == "ANALYTICAL":
+                mode = "IN_MEMORY_ANALYTICAL"
+            elif mode == "TRANSACTIONAL":
+                mode = "IN_MEMORY_TRANSACTIONAL"
+            return A.StorageModeQuery(mode)
+        scope_tok = self.expect_kw("GLOBAL", "SESSION", "NEXT")
+        scope = scope_tok.value.lower()
+        self.expect_kw("TRANSACTION")
+        self.expect_kw("ISOLATION")
+        self.expect_kw("LEVEL")
+        if self.accept_kw("SNAPSHOT"):
+            self.expect_kw("ISOLATION")
+            return A.IsolationLevelQuery("SNAPSHOT_ISOLATION", scope)
+        self.expect_kw("READ")
+        if self.accept_kw("COMMITTED"):
+            return A.IsolationLevelQuery("READ_COMMITTED", scope)
+        self.expect_kw("UNCOMMITTED")
+        return A.IsolationLevelQuery("READ_UNCOMMITTED", scope)
+
+    def parse_create_trigger(self) -> A.TriggerQuery:
+        self.expect_kw("CREATE")
+        self.expect_kw("TRIGGER")
+        name = self.name_token()
+        event = None
+        if self.accept_kw("ON"):
+            parts = []
+            while self.cur.type == T.KEYWORD and self.cur.value in (
+                    "CREATE", "UPDATE", "DELETE", "VERTICES", "EDGES"):
+                parts.append(self.advance().value)
+            event = " ".join(parts) if parts else None
+        phase_tok = self.expect_kw("BEFORE", "AFTER")
+        self.expect_kw("COMMIT")
+        self.expect_kw("EXECUTE")
+        # statement: rest of the input until EOF/';'
+        start = self.cur.pos
+        # capture raw text from token stream positions
+        depth = 0
+        last = self.cur
+        while not self.at(T.EOF) and not (self.at(";") and depth == 0):
+            last = self.advance()
+        raw_end = last.pos + (len(str(last.value)) if last.value else 1)
+        statement = self._source_slice(start)
+        return A.TriggerQuery("create", name=name, event=event,
+                              phase=phase_tok.value, statement=statement)
+
+    _source: str = ""
+
+    def _source_slice(self, start: int) -> str:
+        # Parser doesn't retain source by default; tokenizer pos is enough
+        # only if the caller provided it. parse() wires it below.
+        return self._source[start:].rstrip("; \n\t") if self._source else ""
+
+    def parse_auth(self) -> A.AuthQuery:
+        first = self.advance()  # CREATE/DROP/SET
+        if first.value == "SET":
+            self.expect_kw("PASSWORD")
+            self.expect_kw("TO")
+            pw = self.parse_expression()
+            return A.AuthQuery("set_password", password=pw)
+        self.expect_kw("USER")
+        user = self.name_token()
+        if first.value == "DROP":
+            return A.AuthQuery("drop_user", user=user)
+        pw = None
+        if self.accept_kw("ID"):
+            pass
+        if self.accept(T.IDENT):
+            pass
+        if self.accept_kw("PASSWORD") or (self.at(T.IDENT)
+                                          and self.cur.value == "IDENTIFIED"):
+            pw = self.parse_expression()
+        return A.AuthQuery("create_user", user=user, password=pw)
+
+    # --- Cypher query -------------------------------------------------------
+
+    def parse_cypher_query(self) -> A.CypherQuery:
+        first = self.parse_single_query()
+        unions = []
+        while self.at_kw("UNION"):
+            self.advance()
+            union_all = bool(self.accept_kw("ALL"))
+            unions.append((union_all, self.parse_single_query()))
+        return A.CypherQuery(first, unions)
+
+    def parse_single_query(self) -> A.SingleQuery:
+        clauses: list[A.Clause] = []
+        while True:
+            clause = self.try_parse_clause()
+            if clause is None:
+                break
+            clauses.append(clause)
+        if not clauses:
+            self.error("expected a query clause")
+        return A.SingleQuery(clauses)
+
+    def try_parse_clause(self) -> Optional[A.Clause]:
+        if self.at_kw("MATCH"):
+            return self.parse_match(optional=False)
+        if self.at_kw("OPTIONAL"):
+            self.advance()
+            self.expect_kw("MATCH")
+            return self.parse_match(optional=True, consumed=True)
+        if self.at_kw("CREATE"):
+            self.advance()
+            return A.Create(self.parse_pattern_list())
+        if self.at_kw("MERGE"):
+            return self.parse_merge()
+        if self.at_kw("SET"):
+            self.advance()
+            return A.SetClause(self.parse_set_items())
+        if self.at_kw("REMOVE"):
+            return self.parse_remove()
+        if self.at_kw("DELETE"):
+            self.advance()
+            return self.parse_delete(detach=False)
+        if self.at_kw("DETACH"):
+            self.advance()
+            self.expect_kw("DELETE")
+            return self.parse_delete(detach=True)
+        if self.at_kw("RETURN"):
+            self.advance()
+            return A.Return(self.parse_return_body())
+        if self.at_kw("WITH"):
+            self.advance()
+            body = self.parse_return_body()
+            where = None
+            if self.accept_kw("WHERE"):
+                where = self.parse_expression()
+            return A.With(body, where)
+        if self.at_kw("UNWIND"):
+            self.advance()
+            expr = self.parse_expression()
+            self.expect_kw("AS")
+            var = self.name_token()
+            return A.Unwind(expr, var)
+        if self.at_kw("CALL"):
+            return self.parse_call()
+        if self.at_kw("FOREACH"):
+            return self.parse_foreach()
+        return None
+
+    def parse_match(self, optional: bool, consumed=False) -> A.Match:
+        if not consumed:
+            self.expect_kw("MATCH")
+        patterns = self.parse_pattern_list()
+        where = None
+        if self.accept_kw("WHERE"):
+            where = self.parse_expression()
+        return A.Match(patterns, where, optional)
+
+    def parse_merge(self) -> A.Merge:
+        self.expect_kw("MERGE")
+        pattern = self.parse_pattern()
+        on_create, on_match = [], []
+        while self.at_kw("ON"):
+            self.advance()
+            which = self.expect_kw("CREATE", "MATCH").value
+            self.expect_kw("SET")
+            items = self.parse_set_items()
+            (on_create if which == "CREATE" else on_match).extend(items)
+        return A.Merge(pattern, on_create, on_match)
+
+    def parse_set_items(self) -> list[A.SetItem]:
+        items = [self.parse_set_item()]
+        while self.accept(","):
+            items.append(self.parse_set_item())
+        return items
+
+    def parse_set_item(self) -> A.SetItem:
+        target = self.parse_expression(no_top_equals=True)
+        if self.accept("="):
+            value = self.parse_expression()
+            if isinstance(target, A.PropertyLookup):
+                return A.SetItem("prop", target, value)
+            if isinstance(target, A.Identifier):
+                return A.SetItem("var_assign", target, value)
+            self.error("invalid SET target")
+        if self.accept("+="):
+            value = self.parse_expression()
+            return A.SetItem("var_update", target, value)
+        if isinstance(target, A.LabelsTest):
+            return A.SetItem("label", target.expr, target.labels)
+        self.error("invalid SET item")
+
+    def parse_remove(self) -> A.Remove:
+        self.expect_kw("REMOVE")
+        items = [self.parse_remove_item()]
+        while self.accept(","):
+            items.append(self.parse_remove_item())
+        return A.Remove(items)
+
+    def parse_remove_item(self) -> A.RemoveItem:
+        expr = self.parse_expression(no_top_equals=True)
+        if isinstance(expr, A.PropertyLookup):
+            return A.RemoveItem("prop", expr)
+        if isinstance(expr, A.LabelsTest):
+            return A.RemoveItem("label", expr.expr, expr.labels)
+        self.error("invalid REMOVE item")
+
+    def parse_delete(self, detach: bool) -> A.Delete:
+        exprs = [self.parse_expression()]
+        while self.accept(","):
+            exprs.append(self.parse_expression())
+        return A.Delete(exprs, detach)
+
+    def parse_return_body(self) -> A.ReturnBody:
+        distinct = bool(self.accept_kw("DISTINCT"))
+        star = False
+        items: list[tuple[A.Expr, Optional[str]]] = []
+        if self.accept("*"):
+            star = True
+            while self.accept(","):
+                items.append(self.parse_return_item())
+        else:
+            items.append(self.parse_return_item())
+            while self.accept(","):
+                items.append(self.parse_return_item())
+        order_by: list[A.SortItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.parse_sort_item())
+            while self.accept(","):
+                order_by.append(self.parse_sort_item())
+        skip = limit = None
+        if self.accept_kw("SKIP"):
+            skip = self.parse_expression()
+        if self.accept_kw("LIMIT"):
+            limit = self.parse_expression()
+        return A.ReturnBody(distinct, items, star, order_by, skip, limit)
+
+    def parse_return_item(self):
+        expr = self.parse_expression()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.name_token()
+        return (expr, alias)
+
+    def parse_sort_item(self) -> A.SortItem:
+        expr = self.parse_expression()
+        asc = True
+        if self.accept_kw("ASC", "ASCENDING"):
+            asc = True
+        elif self.accept_kw("DESC", "DESCENDING"):
+            asc = False
+        return A.SortItem(expr, asc)
+
+    def parse_call(self) -> A.CallProcedure:
+        self.expect_kw("CALL")
+        parts = [self.name_token()]
+        while self.accept("."):
+            parts.append(self.name_token())
+        name = ".".join(parts)
+        args: list[A.Expr] = []
+        if self.accept("("):
+            if not self.at(")"):
+                args.append(self.parse_expression())
+                while self.accept(","):
+                    args.append(self.parse_expression())
+            self.expect(")")
+        yields: list[tuple[str, Optional[str]]] = []
+        yield_star = False
+        where = None
+        if self.accept_kw("YIELD"):
+            if self.accept("*"):
+                yield_star = True
+            else:
+                yields.append(self.parse_yield_item())
+                while self.accept(","):
+                    yields.append(self.parse_yield_item())
+            if self.accept_kw("WHERE"):
+                where = self.parse_expression()
+        return A.CallProcedure(name, args, yields, yield_star, where)
+
+    def parse_yield_item(self):
+        field = self.name_token()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.name_token()
+        return (field, alias)
+
+    def parse_foreach(self) -> A.Foreach:
+        self.expect_kw("FOREACH")
+        self.expect("(")
+        var = self.name_token()
+        self.expect_kw("IN")
+        expr = self.parse_expression()
+        self.expect("|")
+        updates: list[A.Clause] = []
+        while not self.at(")"):
+            clause = self.try_parse_clause()
+            if clause is None:
+                self.error("expected an update clause in FOREACH")
+            updates.append(clause)
+        self.expect(")")
+        return A.Foreach(var, expr, updates)
+
+    # --- patterns -----------------------------------------------------------
+
+    def parse_pattern_list(self) -> list[A.Pattern]:
+        patterns = [self.parse_pattern()]
+        while self.accept(","):
+            patterns.append(self.parse_pattern())
+        return patterns
+
+    def parse_pattern(self) -> A.Pattern:
+        variable = None
+        if self.at(T.IDENT) and self.peek().type == "=":
+            variable = self.advance().value
+            self.advance()  # '='
+        elements = [self.parse_node_pattern()]
+        while self.at("-") or self.at("<-") or self.at("--") or self.at("<"):
+            edge = self.parse_edge_pattern()
+            node = self.parse_node_pattern()
+            elements.append(edge)
+            elements.append(node)
+        return A.Pattern(variable, elements)
+
+    def parse_node_pattern(self) -> A.NodePattern:
+        self.expect("(")
+        variable = None
+        labels: list[str] = []
+        props = None
+        if self.at(T.IDENT) or (self.cur.type == T.KEYWORD
+                                and not self.at(")")
+                                and self.peek().type in (":", ")", "{")):
+            variable = self.name_token()
+        while self.accept(":"):
+            labels.append(self.name_token())
+        if self.at("{") or self.at(T.PARAM):
+            props = self.parse_map_or_param()
+        self.expect(")")
+        return A.NodePattern(variable, labels, props)
+
+    def parse_edge_pattern(self) -> A.EdgePattern:
+        # arrows: -[..]-> | <-[..]- | -[..]- | --> | <-- | --
+        direction = "both"
+        if self.accept("<-"):
+            direction = "in"
+            left_consumed = True
+        elif self.accept("<"):
+            self.expect("-")
+            direction = "in"
+        elif self.accept("--"):
+            # bare '--' or '-->' handled below
+            if self.accept(">"):
+                return A.EdgePattern(None, [], "out")
+            return A.EdgePattern(None, [], "both")
+        else:
+            self.expect("-")
+
+        variable = None
+        types: list[str] = []
+        props = None
+        var_length = False
+        min_hops = max_hops = None
+        if self.accept("["):
+            if self.at(T.IDENT) and self.peek().type in (":", "]", "*", "{"):
+                variable = self.advance().value
+            if self.accept(":"):
+                types.append(self.name_token())
+                while self.accept("|"):
+                    self.accept(":")
+                    types.append(self.name_token())
+            if self.accept("*"):
+                var_length = True
+                from .lexer import T as TT
+                if self.at(TT.INT):
+                    min_hops = A.Literal(self.advance().value)
+                    if self.accept(".."):
+                        if self.at(TT.INT):
+                            max_hops = A.Literal(self.advance().value)
+                    else:
+                        max_hops = min_hops
+                elif self.accept(".."):
+                    if self.at(TT.INT):
+                        max_hops = A.Literal(self.advance().value)
+                elif self.at(T.FLOAT):
+                    # "*1.5" is invalid; but "*1..2" lexes as INT '..' INT
+                    self.error("invalid variable-length bounds")
+            if self.at("{") or self.at(T.PARAM):
+                props = self.parse_map_or_param()
+            self.expect("]")
+        # closing arrow
+        if direction == "in":
+            self.expect("-")
+            if self.accept(">"):
+                direction = "both" if False else "both"  # <-[]-> treated as both
+                direction = "both"
+        else:
+            if self.accept("->"):
+                direction = "out"
+            elif self.accept("-"):
+                if self.accept(">"):
+                    direction = "out"
+                else:
+                    direction = "both"
+            elif self.accept(">"):
+                direction = "out"
+            else:
+                self.error("malformed relationship pattern")
+        return A.EdgePattern(variable, types, direction, props, var_length,
+                             min_hops, max_hops)
+
+    def parse_map_or_param(self):
+        if self.at(T.PARAM):
+            return A.Parameter(self.advance().value)
+        self.expect("{")
+        out: dict[str, A.Expr] = {}
+        if not self.at("}"):
+            while True:
+                key = self.name_token() if not self.at(T.STRING) else self.advance().value
+                self.expect(":")
+                out[key] = self.parse_expression()
+                if not self.accept(","):
+                    break
+        self.expect("}")
+        return out
+
+    # --- expressions (precedence climbing) ---------------------------------
+
+    def parse_expression(self, no_top_equals: bool = False) -> A.Expr:
+        if no_top_equals:
+            return self._parse_or_stop_equals()
+        return self.parse_or()
+
+    def _parse_or_stop_equals(self) -> A.Expr:
+        # For SET items: parse a primary+postfix chain only (target position)
+        return self.parse_postfix(self.parse_primary())
+
+    def parse_or(self) -> A.Expr:
+        left = self.parse_xor()
+        while self.at_kw("OR"):
+            self.advance()
+            left = A.Binary("OR", left, self.parse_xor())
+        return left
+
+    def parse_xor(self) -> A.Expr:
+        left = self.parse_and()
+        while self.at_kw("XOR"):
+            self.advance()
+            left = A.Binary("XOR", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> A.Expr:
+        left = self.parse_not()
+        while self.at_kw("AND"):
+            self.advance()
+            left = A.Binary("AND", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> A.Expr:
+        if self.accept_kw("NOT"):
+            return A.Unary("NOT", self.parse_not())
+        return self.parse_comparison()
+
+    _CMP = ("=", "<>", "<", ">", "<=", ">=")
+
+    def parse_comparison(self) -> A.Expr:
+        left = self.parse_additive()
+        # chained comparisons: a < b < c → (a<b) AND (b<c)
+        comparisons = []
+        while self.cur.type in self._CMP:
+            op = self.advance().type
+            right = self.parse_additive()
+            comparisons.append((op, right))
+        if not comparisons:
+            return self._parse_special_predicates(left)
+        result = None
+        prev = left
+        for op, right in comparisons:
+            cmp_node = A.Binary(op, prev, right)
+            result = cmp_node if result is None else A.Binary("AND", result,
+                                                              cmp_node)
+            prev = right
+        return result
+
+    def _parse_special_predicates(self, left: A.Expr) -> A.Expr:
+        while True:
+            if self.at_kw("IS"):
+                save = self.i
+                self.advance()
+                if self.accept_kw("NULL"):
+                    left = A.IsNull(left, negated=False)
+                    continue
+                if self.accept_kw("NOT"):
+                    if self.accept_kw("NULL"):
+                        left = A.IsNull(left, negated=True)
+                        continue
+                self.i = save
+                break
+            if self.at_kw("IN"):
+                self.advance()
+                left = A.Binary("IN", left, self.parse_additive())
+                continue
+            if self.at_kw("STARTS"):
+                self.advance()
+                self.expect_kw("WITH")
+                left = A.Binary("STARTS WITH", left, self.parse_additive())
+                continue
+            if self.at_kw("ENDS"):
+                self.advance()
+                self.expect_kw("WITH")
+                left = A.Binary("ENDS WITH", left, self.parse_additive())
+                continue
+            if self.at_kw("CONTAINS"):
+                self.advance()
+                left = A.Binary("CONTAINS", left, self.parse_additive())
+                continue
+            if self.at("=~"):
+                self.advance()
+                left = A.Binary("=~", left, self.parse_additive())
+                continue
+            break
+        return left
+
+    def parse_additive(self) -> A.Expr:
+        left = self.parse_multiplicative()
+        while self.at("+") or self.at("-"):
+            op = self.advance().type
+            left = A.Binary(op, left, self.parse_multiplicative())
+        return left
+
+    def parse_multiplicative(self) -> A.Expr:
+        left = self.parse_power()
+        while self.at("*") or self.at("/") or self.at("%"):
+            op = self.advance().type
+            left = A.Binary(op, left, self.parse_power())
+        return left
+
+    def parse_power(self) -> A.Expr:
+        left = self.parse_unary()
+        if self.at("^"):
+            self.advance()
+            return A.Binary("^", left, self.parse_power())  # right-assoc
+        return left
+
+    def parse_unary(self) -> A.Expr:
+        if self.at("-"):
+            self.advance()
+            return A.Unary("-", self.parse_unary())
+        if self.at("+"):
+            self.advance()
+            return A.Unary("+", self.parse_unary())
+        return self.parse_postfix(self.parse_primary())
+
+    def parse_postfix(self, expr: A.Expr) -> A.Expr:
+        while True:
+            if self.at("."):
+                self.advance()
+                expr = A.PropertyLookup(expr, self.name_token())
+                continue
+            if self.at("["):
+                self.advance()
+                if self.accept(".."):
+                    hi = None if self.at("]") else self.parse_expression()
+                    self.expect("]")
+                    expr = A.Slice(expr, None, hi)
+                    continue
+                index = None if self.at("..") else self.parse_expression()
+                if self.accept(".."):
+                    hi = None if self.at("]") else self.parse_expression()
+                    self.expect("]")
+                    expr = A.Slice(expr, index, hi)
+                    continue
+                self.expect("]")
+                expr = A.Subscript(expr, index)
+                continue
+            if self.at(":") and isinstance(expr, (A.Identifier,
+                                                  A.PropertyLookup,
+                                                  A.FunctionCall,
+                                                  A.LabelsTest)):
+                # labels test: n:Person:Employee
+                labels = []
+                while self.accept(":"):
+                    labels.append(self.name_token())
+                if isinstance(expr, A.LabelsTest):
+                    expr.labels.extend(labels)
+                else:
+                    expr = A.LabelsTest(expr, labels)
+                continue
+            break
+        return expr
+
+    def parse_primary(self) -> A.Expr:
+        tok = self.cur
+        if tok.type == T.INT or tok.type == T.FLOAT or tok.type == T.STRING:
+            self.advance()
+            return A.Literal(tok.value)
+        if tok.type == T.PARAM:
+            self.advance()
+            return A.Parameter(tok.value)
+        if tok.is_kw("TRUE"):
+            self.advance()
+            return A.Literal(True)
+        if tok.is_kw("FALSE"):
+            self.advance()
+            return A.Literal(False)
+        if tok.is_kw("NULL"):
+            self.advance()
+            return A.Literal(None)
+        if tok.is_kw("COUNT") and self.peek().type == "(" \
+                and self.peek(2).type == "*":
+            self.advance(); self.advance(); self.advance()
+            self.expect(")")
+            return A.CountStar()
+        if tok.is_kw("CASE"):
+            return self.parse_case()
+        if tok.is_kw("EXISTS"):
+            self.advance()
+            self.expect("(")
+            if self.at("("):
+                pattern = self.parse_pattern()
+                self.expect(")")
+                return A.PatternExpr(pattern)
+            expr = self.parse_expression()
+            self.expect(")")
+            return A.IsNull(expr, negated=True)
+        if tok.is_kw("ALL", "ANY", "NONE", "SINGLE") and self.peek().type == "(":
+            kind = self.advance().value
+            self.expect("(")
+            var = self.name_token()
+            self.expect_kw("IN")
+            lst = self.parse_expression()
+            self.expect_kw("WHERE")
+            where = self.parse_expression()
+            self.expect(")")
+            return A.Quantifier(kind, var, lst, where)
+        if (tok.type == T.IDENT and tok.value.lower() == "reduce"
+                and self.peek().type == "("):
+            self.advance()
+            self.expect("(")
+            acc = self.name_token()
+            self.expect("=")
+            init = self.parse_expression()
+            self.expect(",")
+            var = self.name_token()
+            self.expect_kw("IN")
+            lst = self.parse_expression()
+            self.expect("|")
+            expr = self.parse_expression()
+            self.expect(")")
+            return A.Reduce(acc, init, var, lst, expr)
+        if tok.is_kw("COALESCE") and self.peek().type == "(":
+            self.advance()
+            return self._finish_function_call("coalesce")
+        if tok.type == "(":
+            # sub-expression OR a pattern expression like (n)-[:X]->(m)
+            save = self.i
+            try:
+                pattern = self.parse_pattern()
+                if (len(pattern.elements) > 1
+                        and (self.at(T.EOF) or not self.at("("))):
+                    return A.PatternExpr(pattern, exists_form=False)
+                raise SyntaxException("not a pattern")
+            except SyntaxException:
+                self.i = save
+            self.advance()
+            expr = self.parse_expression()
+            self.expect(")")
+            return expr
+        if tok.type == "[":
+            return self.parse_list_or_comprehension()
+        if tok.type == "{":
+            items = self.parse_map_or_param()
+            return A.MapLiteral(items)
+        if tok.type == T.IDENT or tok.type == T.KEYWORD:
+            # function call or identifier (possibly namespaced)
+            if self.peek().type == "(" or (self.peek().type == "."
+                                           and self._looks_like_ns_call()):
+                return self.parse_function_or_ident()
+            name = self.name_token()
+            return A.Identifier(name)
+        self.error(f"unexpected token {self._desc(tok)} in expression")
+
+    def _looks_like_ns_call(self) -> bool:
+        """ident '.' ident ... '(' — namespaced function call."""
+        k = self.i
+        toks = self.toks
+        if toks[k].type not in (T.IDENT, T.KEYWORD):
+            return False
+        k += 1
+        saw_dot = False
+        while (k + 1 < len(toks) and toks[k].type == "."
+               and toks[k + 1].type in (T.IDENT, T.KEYWORD)):
+            saw_dot = True
+            k += 2
+        return saw_dot and k < len(toks) and toks[k].type == "("
+
+    def parse_function_or_ident(self) -> A.Expr:
+        parts = [self.name_token()]
+        while self.at(".") and self.peek().type in (T.IDENT, T.KEYWORD):
+            # only consume dots that lead to '(' eventually
+            if not self._dots_lead_to_call():
+                break
+            self.advance()
+            parts.append(self.name_token())
+        name = ".".join(parts)
+        if self.at("("):
+            return self._finish_function_call(name.lower())
+        if len(parts) == 1:
+            return A.Identifier(parts[0])
+        # ident.prop fallback
+        expr: A.Expr = A.Identifier(parts[0])
+        for p in parts[1:]:
+            expr = A.PropertyLookup(expr, p)
+        return expr
+
+    def _dots_lead_to_call(self) -> bool:
+        k = self.i
+        toks = self.toks
+        while (k + 1 < len(toks) and toks[k].type == "."
+               and toks[k + 1].type in (T.IDENT, T.KEYWORD)):
+            k += 2
+        return k < len(toks) and toks[k].type == "("
+
+    def _finish_function_call(self, name: str) -> A.FunctionCall:
+        self.expect("(")
+        distinct = bool(self.accept_kw("DISTINCT"))
+        args: list[A.Expr] = []
+        if not self.at(")"):
+            if self.accept("*"):
+                self.expect(")")
+                if name == "count":
+                    return A.CountStar()
+                self.error(f"'*' argument not supported for {name}()")
+            args.append(self.parse_expression())
+            while self.accept(","):
+                args.append(self.parse_expression())
+        self.expect(")")
+        return A.FunctionCall(name, args, distinct)
+
+    def parse_case(self) -> A.CaseExpr:
+        self.expect_kw("CASE")
+        test = None
+        if not self.at_kw("WHEN"):
+            test = self.parse_expression()
+        whens: list[tuple[A.Expr, A.Expr]] = []
+        while self.accept_kw("WHEN"):
+            cond = self.parse_expression()
+            self.expect_kw("THEN")
+            whens.append((cond, self.parse_expression()))
+        default = None
+        if self.accept_kw("ELSE"):
+            default = self.parse_expression()
+        self.expect_kw("END")
+        if not whens:
+            self.error("CASE requires at least one WHEN")
+        return A.CaseExpr(test, whens, default)
+
+    def parse_list_or_comprehension(self) -> A.Expr:
+        self.expect("[")
+        if self.at("]"):
+            self.advance()
+            return A.ListLiteral([])
+        # lookahead: ident IN → comprehension
+        if (self.cur.type in (T.IDENT,) and self.peek().is_kw("IN")):
+            var = self.advance().value
+            self.advance()  # IN
+            lst = self.parse_expression()
+            where = None
+            proj = None
+            if self.accept_kw("WHERE"):
+                where = self.parse_expression()
+            if self.accept("|"):
+                proj = self.parse_expression()
+            self.expect("]")
+            return A.ListComprehension(var, lst, where, proj)
+        items = [self.parse_expression()]
+        while self.accept(","):
+            items.append(self.parse_expression())
+        self.expect("]")
+        return A.ListLiteral(items)
+
+
+def parse_with_source(text: str):
+    """parse() variant that retains source for trigger statements."""
+    p = Parser(tokenize(text))
+    p._source = text
+    return p.parse_statement()

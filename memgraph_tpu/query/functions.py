@@ -1,0 +1,603 @@
+"""Builtin scalar/list/string/temporal/spatial function library.
+
+Counterpart of the reference's ~190 builtins
+(/root/reference/src/query/interpret/awesome_memgraph_functions.cpp).
+Each function takes (evaluator, args) and follows openCypher null
+propagation unless noted. Aggregates live in the executor, not here.
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random
+import re
+import uuid as _uuid
+
+from ..exceptions import TypeException
+from ..storage.common import View
+from ..storage.storage import EdgeAccessor, VertexAccessor
+from ..utils.point import Point
+from ..utils.temporal import (Date, Duration, LocalDateTime, LocalTime,
+                              ZonedDateTime)
+from . import values as V
+from .values import Path
+
+FUNCTIONS: dict = {}
+
+
+def register(name, min_args=None, max_args=None, propagate_null=True):
+    def deco(fn):
+        def wrapper(ev, args):
+            if min_args is not None and len(args) < min_args:
+                raise TypeException(f"{name}() requires at least {min_args} argument(s)")
+            if max_args is not None and len(args) > max_args:
+                raise TypeException(f"{name}() takes at most {max_args} argument(s)")
+            if propagate_null and any(a is None for a in args):
+                return None
+            return fn(ev, args)
+        FUNCTIONS[name] = wrapper
+        return fn
+    return deco
+
+
+def _num(name, v):
+    if not V.is_numeric(v):
+        raise TypeException(f"{name}() requires a number, got {V.type_name(v)}")
+    return v
+
+
+def _str(name, v):
+    if not isinstance(v, str):
+        raise TypeException(f"{name}() requires a string, got {V.type_name(v)}")
+    return v
+
+
+def _list(name, v):
+    if not isinstance(v, (list, tuple)):
+        raise TypeException(f"{name}() requires a list, got {V.type_name(v)}")
+    return v
+
+
+# --- scalar ------------------------------------------------------------------
+
+@register("coalesce", 1, propagate_null=False)
+def fn_coalesce(ev, args):
+    for a in args:
+        if a is not None:
+            return a
+    return None
+
+
+@register("id", 1, 1)
+def fn_id(ev, args):
+    v = args[0]
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        return v.gid
+    raise TypeException("id() requires a node or relationship")
+
+
+@register("type", 1, 1)
+def fn_type(ev, args):
+    v = args[0]
+    if isinstance(v, EdgeAccessor):
+        return ev.ctx.storage.edge_type_mapper.id_to_name(v.edge_type)
+    raise TypeException("type() requires a relationship")
+
+
+@register("labels", 1, 1)
+def fn_labels(ev, args):
+    v = args[0]
+    if not isinstance(v, VertexAccessor):
+        raise TypeException("labels() requires a node")
+    mapper = ev.ctx.storage.label_mapper
+    return [mapper.id_to_name(l) for l in v.labels(ev.ctx.view)]
+
+
+@register("properties", 1, 1)
+def fn_properties(ev, args):
+    v = args[0]
+    if isinstance(v, dict):
+        return dict(v)
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        mapper = ev.ctx.storage.property_mapper
+        return {mapper.id_to_name(k): val
+                for k, val in v.properties(ev.ctx.view).items()}
+    raise TypeException("properties() requires a node, relationship or map")
+
+
+@register("keys", 1, 1)
+def fn_keys(ev, args):
+    v = args[0]
+    if isinstance(v, dict):
+        return list(v.keys())
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        mapper = ev.ctx.storage.property_mapper
+        return [mapper.id_to_name(k) for k in v.properties(ev.ctx.view)]
+    raise TypeException("keys() requires a node, relationship or map")
+
+
+@register("startnode", 1, 1)
+def fn_startnode(ev, args):
+    if not isinstance(args[0], EdgeAccessor):
+        raise TypeException("startNode() requires a relationship")
+    return args[0].from_vertex()
+
+
+@register("endnode", 1, 1)
+def fn_endnode(ev, args):
+    if not isinstance(args[0], EdgeAccessor):
+        raise TypeException("endNode() requires a relationship")
+    return args[0].to_vertex()
+
+
+@register("degree", 1, 1)
+def fn_degree(ev, args):
+    v = args[0]
+    if not isinstance(v, VertexAccessor):
+        raise TypeException("degree() requires a node")
+    return v.in_degree(ev.ctx.view) + v.out_degree(ev.ctx.view)
+
+
+@register("indegree", 1, 1)
+def fn_indegree(ev, args):
+    if not isinstance(args[0], VertexAccessor):
+        raise TypeException("inDegree() requires a node")
+    return args[0].in_degree(ev.ctx.view)
+
+
+@register("outdegree", 1, 1)
+def fn_outdegree(ev, args):
+    if not isinstance(args[0], VertexAccessor):
+        raise TypeException("outDegree() requires a node")
+    return args[0].out_degree(ev.ctx.view)
+
+
+@register("timestamp", 0, 0, propagate_null=False)
+def fn_timestamp(ev, args):
+    import time
+    return int(time.time() * 1_000_000)
+
+
+@register("valuetype", 1, 1, propagate_null=False)
+def fn_valuetype(ev, args):
+    return V.type_name(args[0])
+
+
+@register("tointeger", 1, 1)
+def fn_tointeger(ev, args):
+    v = args[0]
+    if isinstance(v, bool):
+        return 1 if v else 0
+    if isinstance(v, int):
+        return v
+    if isinstance(v, float):
+        return int(v)
+    if isinstance(v, str):
+        try:
+            return int(float(v)) if ("." in v or "e" in v.lower()) else int(v, 0)
+        except ValueError:
+            return None
+    raise TypeException(f"toInteger() can't convert {V.type_name(v)}")
+
+
+@register("tofloat", 1, 1)
+def fn_tofloat(ev, args):
+    v = args[0]
+    if V.is_numeric(v):
+        return float(v)
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    raise TypeException(f"toFloat() can't convert {V.type_name(v)}")
+
+
+@register("toboolean", 1, 1)
+def fn_toboolean(ev, args):
+    v = args[0]
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int):
+        return v != 0
+    if isinstance(v, str):
+        low = v.strip().lower()
+        if low == "true":
+            return True
+        if low == "false":
+            return False
+        return None
+    raise TypeException(f"toBoolean() can't convert {V.type_name(v)}")
+
+
+@register("tostring", 1, 1)
+def fn_tostring(ev, args):
+    v = args[0]
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if V.is_numeric(v):
+        if isinstance(v, float) and v.is_integer():
+            return f"{v:.1f}"
+        return str(v)
+    return str(v)
+
+
+# --- math --------------------------------------------------------------------
+
+def _math1(name, fn):
+    @register(name, 1, 1)
+    def f(ev, args, _fn=fn, _name=name):
+        return _fn(_num(_name, args[0]))
+    return f
+
+
+_math1("abs", abs)
+_math1("ceil", lambda v: float(math.ceil(v)))
+_math1("floor", lambda v: float(math.floor(v)))
+_math1("sqrt", lambda v: math.sqrt(v) if v >= 0 else math.nan)
+_math1("exp", math.exp)
+_math1("log", lambda v: math.log(v) if v > 0 else math.nan)
+_math1("log10", lambda v: math.log10(v) if v > 0 else math.nan)
+_math1("log2", lambda v: math.log2(v) if v > 0 else math.nan)
+_math1("sin", math.sin)
+_math1("cos", math.cos)
+_math1("tan", math.tan)
+_math1("cot", lambda v: 1.0 / math.tan(v) if math.tan(v) != 0 else math.inf)
+_math1("asin", lambda v: math.asin(v) if -1 <= v <= 1 else math.nan)
+_math1("acos", lambda v: math.acos(v) if -1 <= v <= 1 else math.nan)
+_math1("atan", math.atan)
+_math1("sign", lambda v: (v > 0) - (v < 0))
+_math1("degrees", math.degrees)
+_math1("radians", math.radians)
+
+
+@register("round", 1, 2)
+def fn_round(ev, args):
+    v = _num("round", args[0])
+    digits = 0
+    if len(args) == 2:
+        digits = int(_num("round", args[1]))
+    # half away from zero (Cypher), not banker's rounding
+    scale = 10 ** digits
+    return float(math.floor(abs(v) * scale + 0.5) / scale * ((v > 0) - (v < 0))
+                 if v != 0 else 0.0)
+
+
+@register("atan2", 2, 2)
+def fn_atan2(ev, args):
+    return math.atan2(_num("atan2", args[0]), _num("atan2", args[1]))
+
+
+@register("pi", 0, 0, propagate_null=False)
+def fn_pi(ev, args):
+    return math.pi
+
+
+@register("e", 0, 0, propagate_null=False)
+def fn_e(ev, args):
+    return math.e
+
+
+@register("rand", 0, 0, propagate_null=False)
+def fn_rand(ev, args):
+    return _random.random()
+
+
+@register("random", 0, 0, propagate_null=False)
+def fn_random(ev, args):
+    return _random.random()
+
+
+# --- strings -----------------------------------------------------------------
+
+@register("tolower", 1, 1)
+def fn_tolower(ev, args):
+    return _str("toLower", args[0]).lower()
+
+
+@register("toupper", 1, 1)
+def fn_toupper(ev, args):
+    return _str("toUpper", args[0]).upper()
+
+
+@register("trim", 1, 1)
+def fn_trim(ev, args):
+    return _str("trim", args[0]).strip()
+
+
+@register("ltrim", 1, 1)
+def fn_ltrim(ev, args):
+    return _str("lTrim", args[0]).lstrip()
+
+
+@register("rtrim", 1, 1)
+def fn_rtrim(ev, args):
+    return _str("rTrim", args[0]).rstrip()
+
+
+@register("reverse", 1, 1)
+def fn_reverse(ev, args):
+    v = args[0]
+    if isinstance(v, str):
+        return v[::-1]
+    if isinstance(v, (list, tuple)):
+        return list(reversed(v))
+    raise TypeException("reverse() requires a string or list")
+
+
+@register("left", 2, 2)
+def fn_left(ev, args):
+    s = _str("left", args[0])
+    n = int(_num("left", args[1]))
+    if n < 0:
+        raise TypeException("left() requires a non-negative length")
+    return s[:n]
+
+
+@register("right", 2, 2)
+def fn_right(ev, args):
+    s = _str("right", args[0])
+    n = int(_num("right", args[1]))
+    if n < 0:
+        raise TypeException("right() requires a non-negative length")
+    return s[len(s) - min(n, len(s)):]
+
+
+@register("substring", 2, 3)
+def fn_substring(ev, args):
+    s = _str("substring", args[0])
+    start = int(_num("substring", args[1]))
+    if len(args) == 3:
+        length = int(_num("substring", args[2]))
+        return s[start:start + length]
+    return s[start:]
+
+
+@register("split", 2, 2)
+def fn_split(ev, args):
+    return _str("split", args[0]).split(_str("split", args[1]))
+
+
+@register("replace", 3, 3)
+def fn_replace(ev, args):
+    return _str("replace", args[0]).replace(_str("replace", args[1]),
+                                            _str("replace", args[2]))
+
+
+@register("size", 1, 1)
+def fn_size(ev, args):
+    v = args[0]
+    if isinstance(v, str) or isinstance(v, (list, tuple)):
+        return len(v)
+    if isinstance(v, dict):
+        return len(v)
+    if isinstance(v, Path):
+        return len(v)
+    raise TypeException(f"size() not supported for {V.type_name(v)}")
+
+
+@register("length", 1, 1)
+def fn_length(ev, args):
+    v = args[0]
+    if isinstance(v, Path):
+        return len(v)
+    if isinstance(v, (str, list, tuple)):
+        return len(v)
+    raise TypeException("length() requires a path, string or list")
+
+
+@register("chartoascii", 1, 1)
+def fn_chartoascii(ev, args):
+    s = _str("charToAscii", args[0])
+    if not s:
+        raise TypeException("charToAscii() requires a non-empty string")
+    return ord(s[0])
+
+
+@register("asciitochar", 1, 1)
+def fn_asciitochar(ev, args):
+    return chr(int(_num("asciiToChar", args[0])))
+
+
+# --- lists -------------------------------------------------------------------
+
+@register("range", 2, 3)
+def fn_range(ev, args):
+    lo = int(_num("range", args[0]))
+    hi = int(_num("range", args[1]))
+    step = int(_num("range", args[2])) if len(args) == 3 else 1
+    if step == 0:
+        raise TypeException("range() step must not be zero")
+    if step > 0:
+        return list(range(lo, hi + 1, step))
+    return list(range(lo, hi - 1, step))
+
+
+@register("head", 1, 1)
+def fn_head(ev, args):
+    lst = _list("head", args[0])
+    return lst[0] if lst else None
+
+
+@register("last", 1, 1)
+def fn_last(ev, args):
+    lst = _list("last", args[0])
+    return lst[-1] if lst else None
+
+
+@register("tail", 1, 1)
+def fn_tail(ev, args):
+    return list(_list("tail", args[0])[1:])
+
+
+@register("nodes", 1, 1)
+def fn_nodes(ev, args):
+    if not isinstance(args[0], Path):
+        raise TypeException("nodes() requires a path")
+    return args[0].vertices()
+
+
+@register("relationships", 1, 1)
+def fn_relationships(ev, args):
+    if not isinstance(args[0], Path):
+        raise TypeException("relationships() requires a path")
+    return args[0].edges()
+
+
+@register("uniformsample", 2, 2)
+def fn_uniformsample(ev, args):
+    lst = _list("uniformSample", args[0])
+    n = int(_num("uniformSample", args[1]))
+    if not lst or n <= 0:
+        return []
+    return [_random.choice(lst) for _ in range(n)]
+
+
+# --- temporal ----------------------------------------------------------------
+
+@register("date", 0, 1, propagate_null=False)
+def fn_date(ev, args):
+    if not args or args[0] is None:
+        return Date.today()
+    v = args[0]
+    if isinstance(v, str):
+        return Date.parse(v)
+    if isinstance(v, dict):
+        return Date.from_parts(int(v.get("year", 1970)),
+                               int(v.get("month", 1)), int(v.get("day", 1)))
+    if isinstance(v, Date):
+        return v
+    if isinstance(v, LocalDateTime):
+        return v.date()
+    raise TypeException("date() argument must be a string or map")
+
+
+@register("localtime", 0, 1, propagate_null=False)
+def fn_localtime(ev, args):
+    if not args or args[0] is None:
+        import datetime
+        return LocalTime(datetime.datetime.now().time())
+    v = args[0]
+    if isinstance(v, str):
+        return LocalTime.parse(v)
+    if isinstance(v, dict):
+        return LocalTime.from_parts(
+            int(v.get("hour", 0)), int(v.get("minute", 0)),
+            int(v.get("second", 0)), int(v.get("millisecond", 0)),
+            int(v.get("microsecond", 0)))
+    if isinstance(v, LocalTime):
+        return v
+    if isinstance(v, LocalDateTime):
+        return v.local_time()
+    raise TypeException("localTime() argument must be a string or map")
+
+
+@register("localdatetime", 0, 1, propagate_null=False)
+def fn_localdatetime(ev, args):
+    if not args or args[0] is None:
+        return LocalDateTime.now()
+    v = args[0]
+    if isinstance(v, str):
+        return LocalDateTime.parse(v)
+    if isinstance(v, dict):
+        return LocalDateTime.from_parts(
+            int(v.get("year", 1970)), int(v.get("month", 1)),
+            int(v.get("day", 1)), int(v.get("hour", 0)),
+            int(v.get("minute", 0)), int(v.get("second", 0)),
+            int(v.get("millisecond", 0)), int(v.get("microsecond", 0)))
+    if isinstance(v, LocalDateTime):
+        return v
+    raise TypeException("localDateTime() argument must be a string or map")
+
+
+@register("datetime", 0, 1, propagate_null=False)
+def fn_datetime(ev, args):
+    if not args or args[0] is None:
+        return ZonedDateTime.now()
+    v = args[0]
+    if isinstance(v, str):
+        return ZonedDateTime.parse(v)
+    if isinstance(v, ZonedDateTime):
+        return v
+    raise TypeException("datetime() argument must be a string")
+
+
+@register("duration", 1, 1)
+def fn_duration(ev, args):
+    v = args[0]
+    if isinstance(v, str):
+        return Duration.parse(v)
+    if isinstance(v, dict):
+        return Duration.from_parts(
+            days=v.get("day", v.get("days", 0)),
+            hours=v.get("hour", v.get("hours", 0)),
+            minutes=v.get("minute", v.get("minutes", 0)),
+            seconds=v.get("second", v.get("seconds", 0)),
+            milliseconds=v.get("millisecond", v.get("milliseconds", 0)),
+            microseconds=v.get("microsecond", v.get("microseconds", 0)))
+    if isinstance(v, Duration):
+        return v
+    raise TypeException("duration() argument must be a string or map")
+
+
+# --- spatial -----------------------------------------------------------------
+
+@register("point", 1, 1)
+def fn_point(ev, args):
+    if not isinstance(args[0], dict):
+        raise TypeException("point() requires a map")
+    return Point.from_map(args[0])
+
+
+@register("point.distance", 2, 2)
+def fn_point_distance(ev, args):
+    a, b = args
+    if not isinstance(a, Point) or not isinstance(b, Point):
+        raise TypeException("point.distance() requires two points")
+    return a.distance(b)
+
+
+@register("distance", 2, 2)
+def fn_distance(ev, args):
+    return fn_point_distance(ev, args)
+
+
+@register("point.withinbbox", 3, 3)
+def fn_point_withinbbox(ev, args):
+    p, lo, hi = args
+    if not all(isinstance(x, Point) for x in (p, lo, hi)):
+        raise TypeException("point.withinbbox() requires three points")
+    ok = lo.x <= p.x <= hi.x and lo.y <= p.y <= hi.y
+    if p.crs.dims == 3 and lo.z is not None and hi.z is not None:
+        ok = ok and lo.z <= p.z <= hi.z
+    return ok
+
+
+# --- ids / misc --------------------------------------------------------------
+
+@register("randomuuid", 0, 0, propagate_null=False)
+def fn_randomuuid(ev, args):
+    return str(_uuid.uuid4())
+
+
+@register("uuid", 0, 0, propagate_null=False)
+def fn_uuid(ev, args):
+    return str(_uuid.uuid4())
+
+
+@register("tobytestring", 1, 1)
+def fn_tobytestring(ev, args):
+    s = _str("toByteString", args[0])
+    if s.startswith("0x") or s.startswith("0X"):
+        return bytes.fromhex(s[2:])
+    return s.encode("utf-8")
+
+
+@register("frombytestring", 1, 1)
+def fn_frombytestring(ev, args):
+    v = args[0]
+    if not isinstance(v, bytes):
+        raise TypeException("fromByteString() requires bytes")
+    return v.decode("utf-8", errors="replace")

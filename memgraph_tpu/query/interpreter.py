@@ -1,0 +1,586 @@
+"""Query interpreter: Prepare/Pull lifecycle over the storage engine.
+
+Counterpart of the reference's Interpreter
+(/root/reference/src/query/interpreter.cpp — Prepare at :9802, PullPlan
+streaming at :3240): parses (with an AST/plan cache keyed by query text),
+dispatches across query classes (Cypher, DDL, transactions, admin), plans,
+and streams results batch-by-batch so Bolt's PULL n maps directly onto
+`Interpreter.pull`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from ..exceptions import (HintedAbortError, QueryException, SemanticException,
+                          TransactionException)
+from ..storage.common import IsolationLevel, StorageMode, View
+from ..storage.storage import InMemoryStorage
+from .frontend import ast as A
+from .frontend.parser import parse_with_source
+from .plan.operators import ExecutionContext, LogicalOperator, Produce
+from .plan.planner import Planner
+from .plan.profile import attach_profiling, profile_rows
+from .plan.pretty_print import plan_to_rows
+
+
+class InterpreterContext:
+    """Shared, process-wide interpreter state (reference:
+    InterpreterContext, interpreter.hpp)."""
+
+    def __init__(self, storage: InMemoryStorage, config: Optional[dict] = None):
+        self.storage = storage
+        self.config = config or {}
+        self._plan_cache_lock = threading.Lock()
+        self._plan_cache: dict[str, tuple] = {}
+        self._ast_cache: dict[str, object] = {}
+        self.running_queries: dict[int, dict] = {}
+        self._next_query_id = 0
+        self._query_id_lock = threading.Lock()
+        self.triggers = None       # wired by trigger store
+        self.auth = None           # wired by auth subsystem
+        self.metrics = None
+
+    def next_query_id(self) -> int:
+        with self._query_id_lock:
+            self._next_query_id += 1
+            return self._next_query_id
+
+    def cached_parse(self, text: str):
+        key = text.strip()
+        hit = self._ast_cache.get(key)
+        if hit is not None:
+            return hit
+        node = parse_with_source(text)
+        # only cache cacheable query classes (parameters keep text stable)
+        if len(self._ast_cache) < 1024:
+            self._ast_cache[key] = node
+        return node
+
+    def cached_plan(self, text: str, query: A.CypherQuery):
+        key = text.strip()
+        with self._plan_cache_lock:
+            hit = self._plan_cache.get(key)
+        if hit is not None:
+            return hit
+        planner = Planner(self.storage)
+        import copy
+        plan, columns = planner.plan_query(copy.deepcopy(query))
+        with self._plan_cache_lock:
+            if len(self._plan_cache) < 256:
+                self._plan_cache[key] = (plan, columns)
+        return plan, columns
+
+    def invalidate_plans(self) -> None:
+        with self._plan_cache_lock:
+            self._plan_cache.clear()
+
+
+@dataclass
+class PreparedQuery:
+    columns: list[str]
+    qid: int
+    summary_type: str = "r"   # 'r' read, 'w' write, 'rw', 's' schema
+
+
+class Interpreter:
+    """One per client session (reference: one per Bolt session)."""
+
+    def __init__(self, context: InterpreterContext) -> None:
+        self.ctx = context
+        self.session_isolation: Optional[IsolationLevel] = None
+        self.next_isolation: Optional[IsolationLevel] = None
+        self._explicit_accessor = None
+        self._in_explicit_txn = False
+        self._stream: Optional[Iterator] = None
+        self._stream_accessor = None
+        self._stream_owns_txn = False
+        self._prepared: Optional[PreparedQuery] = None
+        self._exec_ctx: Optional[ExecutionContext] = None
+        self._profile_plan = None
+        self._profile_start = None
+        self._abort_flag = threading.Event()
+        self._current_query_info = None
+
+    # --- public API ---------------------------------------------------------
+
+    def prepare(self, text: str, parameters: Optional[dict] = None
+                ) -> PreparedQuery:
+        parameters = parameters or {}
+        node = self.ctx.cached_parse(text)
+
+        if isinstance(node, A.TransactionQuery):
+            return self._prepare_transaction(node)
+        if isinstance(node, A.CypherQuery):
+            return self._prepare_cypher(text, node, parameters)
+        if isinstance(node, A.IndexQuery):
+            return self._prepare_generator(self._run_index_query(node),
+                                           ["status"], "s")
+        if isinstance(node, A.ConstraintQuery):
+            return self._prepare_generator(self._run_constraint_query(node),
+                                           ["status"], "s")
+        if isinstance(node, A.InfoQuery):
+            return self._prepare_info(node)
+        if isinstance(node, A.ShowTransactionsQuery):
+            rows = self._show_transactions()
+            return self._prepare_generator(
+                iter(rows), ["transaction_id", "query", "username"], "r")
+        if isinstance(node, A.TerminateTransactionsQuery):
+            return self._prepare_terminate(node, parameters)
+        if isinstance(node, A.SnapshotQuery):
+            return self._prepare_snapshot(node)
+        if isinstance(node, A.DumpQuery):
+            from .dump import dump_database
+            acc = self.ctx.storage.access()
+            def gen():
+                try:
+                    for line in dump_database(acc):
+                        yield [line]
+                finally:
+                    acc.abort()
+            return self._prepare_generator(gen(), ["QUERY"], "r")
+        if isinstance(node, A.AnalyzeGraphQuery):
+            return self._prepare_generator(
+                iter([["Graph analyzed (index statistics refreshed)"]]),
+                ["status"], "s")
+        if isinstance(node, A.IsolationLevelQuery):
+            return self._prepare_isolation(node)
+        if isinstance(node, A.StorageModeQuery):
+            return self._prepare_storage_mode(node)
+        if isinstance(node, A.TriggerQuery):
+            return self._prepare_trigger(node)
+        if isinstance(node, A.AuthQuery):
+            return self._prepare_auth(node)
+        raise SemanticException(
+            f"unsupported query type {type(node).__name__}")
+
+    def pull(self, n: int = -1) -> tuple[list[list], bool, dict]:
+        """Pull up to n rows (n<0 = all). Returns (rows, has_more, summary)."""
+        if self._stream is None:
+            raise QueryException("no query prepared")
+        rows: list[list] = []
+        has_more = False
+        try:
+            while n < 0 or len(rows) < n:
+                try:
+                    rows.append(next(self._stream))
+                except StopIteration:
+                    break
+            else:
+                # check if exhausted
+                try:
+                    rows.append(next(self._stream))
+                    has_more = True
+                except StopIteration:
+                    has_more = False
+            if has_more and n >= 0 and len(rows) > n:
+                # put back overflow row
+                overflow = rows.pop()
+                self._stream = _chain_front(overflow, self._stream)
+        except Exception:
+            self._cleanup_stream(error=True)
+            raise
+        summary = {}
+        if not has_more:
+            summary = self._finish_stream()
+        return rows, has_more, summary
+
+    def abort(self) -> None:
+        """Kill the current query/transaction (TERMINATE/reset)."""
+        self._abort_flag.set()
+        self._cleanup_stream(error=True)
+        if self._explicit_accessor is not None:
+            self._explicit_accessor.abort()
+            self._explicit_accessor = None
+            self._in_explicit_txn = False
+
+    # --- transactions -------------------------------------------------------
+
+    def _prepare_transaction(self, node: A.TransactionQuery) -> PreparedQuery:
+        if node.action == "begin":
+            if self._in_explicit_txn:
+                raise TransactionException(
+                    "nested transactions are not supported")
+            self._explicit_accessor = self.ctx.storage.access(
+                self._pick_isolation())
+            self._in_explicit_txn = True
+            return self._prepare_generator(iter([]), [], "w")
+        if node.action == "commit":
+            if not self._in_explicit_txn:
+                raise TransactionException("no transaction to commit")
+            try:
+                self._explicit_accessor.commit()
+            finally:
+                self._explicit_accessor = None
+                self._in_explicit_txn = False
+            return self._prepare_generator(iter([]), [], "w")
+        if node.action == "rollback":
+            if not self._in_explicit_txn:
+                raise TransactionException("no transaction to rollback")
+            self._explicit_accessor.abort()
+            self._explicit_accessor = None
+            self._in_explicit_txn = False
+            return self._prepare_generator(iter([]), [], "w")
+        raise SemanticException(f"unknown transaction action {node.action}")
+
+    def _pick_isolation(self) -> IsolationLevel:
+        if self.next_isolation is not None:
+            level = self.next_isolation
+            self.next_isolation = None
+            return level
+        if self.session_isolation is not None:
+            return self.session_isolation
+        return self.ctx.storage.config.isolation_level
+
+    # --- cypher -------------------------------------------------------------
+
+    def _prepare_cypher(self, text: str, query: A.CypherQuery,
+                        parameters: dict) -> PreparedQuery:
+        strip = text.strip()
+        if query.explain or query.profile:
+            # strip the EXPLAIN/PROFILE keyword for plan-cache keying
+            strip = strip.split(None, 1)[1] if " " in strip else strip
+        plan, columns = self.ctx.cached_plan(strip, query)
+
+        if query.explain:
+            rows = [[line] for line in plan_to_rows(plan)]
+            return self._prepare_generator(iter(rows), ["QUERY PLAN"], "r")
+
+        if self._in_explicit_txn:
+            accessor = self._explicit_accessor
+            owns = False
+        else:
+            accessor = self.ctx.storage.access(self._pick_isolation())
+            owns = True
+
+        self._abort_flag = threading.Event()
+        timeout = self.ctx.config.get("execution_timeout_sec", 600.0)
+        deadline = time.monotonic() + timeout if timeout else None
+        abort_flag = self._abort_flag
+
+        def timeout_checker():
+            if abort_flag.is_set():
+                raise HintedAbortError("transaction was asked to abort")
+            if deadline is not None and time.monotonic() > deadline:
+                raise HintedAbortError(
+                    f"query exceeded timeout of {timeout}s")
+
+        exec_ctx = ExecutionContext(accessor, parameters,
+                                    View.NEW, self.ctx, timeout_checker)
+        self._exec_ctx = exec_ctx
+
+        if query.profile:
+            plan, collector = attach_profiling(plan)
+            self._profile_plan = (plan, collector)
+            self._profile_start = time.perf_counter()
+            rows_iter = self._profile_rows_iter(plan, exec_ctx, columns)
+            columns_out = ["OPERATOR", "ACTUAL HITS", "RELATIVE TIME",
+                           "ABSOLUTE TIME"]
+            self._install_stream(rows_iter, accessor, owns)
+            return self._finish_prepare(columns_out, "r")
+
+        qinfo = {"query": text, "start": time.time(),
+                 "interpreter": self}
+        qid = self.ctx.next_query_id()
+        self.ctx.running_queries[qid] = qinfo
+        self._current_query_info = qid
+
+        def rows_iter():
+            try:
+                for frame in plan.cursor(exec_ctx):
+                    row = frame.get("__row__", {})
+                    yield [row.get(c) for c in columns]
+            finally:
+                self.ctx.running_queries.pop(qid, None)
+
+        self._install_stream(rows_iter(), accessor, owns)
+        return self._finish_prepare(columns, "rw")
+
+    def _profile_rows_iter(self, plan, exec_ctx, columns):
+        # drain fully, then emit the profile tree
+        for _ in plan.cursor(exec_ctx):
+            pass
+        total = time.perf_counter() - self._profile_start
+        plan_obj, collector = self._profile_plan
+        yield from profile_rows(plan_obj, collector, total)
+
+    def _install_stream(self, iterator, accessor, owns_txn):
+        self._stream = iterator
+        self._stream_accessor = accessor
+        self._stream_owns_txn = owns_txn
+
+    def _finish_prepare(self, columns, summary_type) -> PreparedQuery:
+        self._prepared = PreparedQuery(columns, 0, summary_type)
+        return self._prepared
+
+    def _finish_stream(self) -> dict:
+        summary = {}
+        if self._exec_ctx is not None:
+            summary["stats"] = dict(self._exec_ctx.stats)
+        if self._stream_owns_txn and self._stream_accessor is not None:
+            self._stream_accessor.commit()
+        self._stream = None
+        self._stream_accessor = None
+        self._stream_owns_txn = False
+        self._exec_ctx = None
+        return summary
+
+    def _cleanup_stream(self, error: bool = False) -> None:
+        if self._stream_owns_txn and self._stream_accessor is not None:
+            self._stream_accessor.abort()
+        self._stream = None
+        self._stream_accessor = None
+        self._stream_owns_txn = False
+        self._exec_ctx = None
+
+    # --- convenience (tests, embedded use) ----------------------------------
+
+    def execute(self, text: str, parameters: Optional[dict] = None):
+        """Prepare + pull everything. Returns (columns, rows, summary)."""
+        prepared = self.prepare(text, parameters)
+        rows, _, summary = self.pull(-1)
+        return prepared.columns, rows, summary
+
+    # --- DDL ----------------------------------------------------------------
+
+    def _run_index_query(self, node: A.IndexQuery):
+        storage = self.ctx.storage
+        if self._in_explicit_txn:
+            raise TransactionException(
+                "index operations are not allowed in explicit transactions")
+        if node.kind == "label":
+            lid = storage.label_mapper.name_to_id(node.label)
+            if node.action == "create":
+                storage.create_label_index(lid)
+            else:
+                storage.indices.label.drop(lid)
+        elif node.kind == "label_property":
+            lid = storage.label_mapper.name_to_id(node.label)
+            pids = tuple(storage.property_mapper.name_to_id(p)
+                         for p in node.properties)
+            if node.action == "create":
+                storage.create_label_property_index(lid, pids)
+            else:
+                storage.indices.label_property.drop(lid, pids)
+        elif node.kind == "edge_type":
+            tid = storage.edge_type_mapper.name_to_id(node.edge_type)
+            if node.action == "create":
+                storage.create_edge_type_index(tid)
+            else:
+                storage.indices.edge_type.drop(tid)
+        self.ctx.invalidate_plans()
+        yield [f"Index {node.action}d."]
+
+    def _run_constraint_query(self, node: A.ConstraintQuery):
+        storage = self.ctx.storage
+        if self._in_explicit_txn:
+            raise TransactionException(
+                "constraint operations are not allowed in explicit "
+                "transactions")
+        lid = storage.label_mapper.name_to_id(node.label)
+        pids = [storage.property_mapper.name_to_id(p)
+                for p in node.properties]
+        if node.kind == "exists":
+            if node.action == "create":
+                storage.create_existence_constraint(lid, pids[0])
+            else:
+                storage.constraints.existence.drop(lid, pids[0])
+        elif node.kind == "unique":
+            if node.action == "create":
+                storage.create_unique_constraint(lid, tuple(pids))
+            else:
+                storage.constraints.unique.drop(lid, tuple(pids))
+        elif node.kind == "type":
+            if node.action == "create":
+                storage.create_type_constraint(lid, pids[0], node.data_type)
+            else:
+                storage.constraints.type.drop(lid, pids[0])
+        yield [f"Constraint {node.action}d."]
+
+    # --- info / admin -------------------------------------------------------
+
+    def _prepare_info(self, node: A.InfoQuery) -> PreparedQuery:
+        storage = self.ctx.storage
+        if node.kind == "storage":
+            info = storage.info()
+            rows = [[k, v] for k, v in sorted(info.items())]
+            return self._prepare_generator(iter(rows),
+                                           ["storage info", "value"], "r")
+        if node.kind == "index":
+            rows = []
+            lm, pm = storage.label_mapper, storage.property_mapper
+            for lid in storage.indices.label.labels():
+                rows.append(["label", lm.id_to_name(lid), None,
+                             storage.indices.label.approx_count(lid)])
+            for (lid, pids) in storage.indices.label_property.keys():
+                rows.append(["label+property", lm.id_to_name(lid),
+                             [pm.id_to_name(p) for p in pids],
+                             storage.indices.label_property.approx_count(
+                                 lid, pids)])
+            for tid in storage.indices.edge_type.types():
+                rows.append(["edge-type",
+                             storage.edge_type_mapper.id_to_name(tid), None,
+                             storage.indices.edge_type.approx_count(tid)])
+            return self._prepare_generator(
+                iter(rows), ["index type", "label", "property", "count"], "r")
+        if node.kind == "constraint":
+            rows = []
+            lm, pm = storage.label_mapper, storage.property_mapper
+            for (lid, pid) in storage.constraints.existence.all():
+                rows.append(["exists", lm.id_to_name(lid),
+                             pm.id_to_name(pid)])
+            for (lid, pids) in storage.constraints.unique.all():
+                rows.append(["unique", lm.id_to_name(lid),
+                             [pm.id_to_name(p) for p in pids]])
+            for (lid, pid, tname) in storage.constraints.type.all():
+                rows.append([f"data_type({tname})", lm.id_to_name(lid),
+                             pm.id_to_name(pid)])
+            return self._prepare_generator(
+                iter(rows), ["constraint type", "label", "properties"], "r")
+        if node.kind == "build":
+            from .. import __version__
+            rows = [["version", __version__], ["build_type", "Release"],
+                    ["backend", "jax/XLA (TPU)"]]
+            return self._prepare_generator(iter(rows),
+                                           ["build info", "value"], "r")
+        if node.kind == "metrics":
+            from ..observability.metrics import global_metrics
+            rows = [[name, str(kind), value]
+                    for name, kind, value in global_metrics.snapshot()]
+            return self._prepare_generator(iter(rows),
+                                           ["name", "type", "value"], "r")
+        if node.kind == "schema":
+            rows = self._schema_info_rows()
+            return self._prepare_generator(iter(rows),
+                                           ["kind", "name", "count"], "r")
+        if node.kind == "database":
+            rows = [["memgraph"]]
+            return self._prepare_generator(iter(rows), ["Name"], "r")
+        raise SemanticException(f"unknown info query {node.kind}")
+
+    def _schema_info_rows(self):
+        storage = self.ctx.storage
+        label_counts: dict[int, int] = {}
+        edge_counts: dict[int, int] = {}
+        acc = storage.access()
+        try:
+            for va in acc.vertices():
+                for l in va.labels():
+                    label_counts[l] = label_counts.get(l, 0) + 1
+            for ea in acc.edges():
+                edge_counts[ea.edge_type] = edge_counts.get(
+                    ea.edge_type, 0) + 1
+        finally:
+            acc.abort()
+        rows = [["node_label", storage.label_mapper.id_to_name(l), c]
+                for l, c in sorted(label_counts.items())]
+        rows += [["edge_type", storage.edge_type_mapper.id_to_name(t), c]
+                 for t, c in sorted(edge_counts.items())]
+        return rows
+
+    def _show_transactions(self):
+        rows = []
+        for qid, info in list(self.ctx.running_queries.items()):
+            rows.append([str(qid), info.get("query", ""),
+                         info.get("username", "")])
+        return rows
+
+    def _prepare_terminate(self, node: A.TerminateTransactionsQuery,
+                           parameters) -> PreparedQuery:
+        from .plan.operators import ExecutionContext
+        acc = self.ctx.storage.access()
+        ctx = ExecutionContext(acc, parameters)
+        results = []
+        try:
+            for expr in node.ids:
+                tid = ctx.evaluator.eval(expr, {})
+                killed = False
+                info = self.ctx.running_queries.get(
+                    int(tid) if str(tid).isdigit() else -1)
+                if info is not None:
+                    interp = info.get("interpreter")
+                    if interp is not None and interp is not self:
+                        interp._abort_flag.set()
+                        killed = True
+                results.append([str(tid), killed])
+        finally:
+            acc.abort()
+        return self._prepare_generator(iter(results),
+                                       ["transaction_id", "killed"], "w")
+
+    def _prepare_snapshot(self, node: A.SnapshotQuery) -> PreparedQuery:
+        from ..storage.durability.snapshot import (create_snapshot,
+                                                   list_snapshots)
+        storage = self.ctx.storage
+        if node.action == "create":
+            path = create_snapshot(storage)
+            return self._prepare_generator(iter([[str(path)]]),
+                                           ["snapshot"], "s")
+        if node.action == "show":
+            rows = [[str(p), ts] for p, ts in list_snapshots(storage)]
+            return self._prepare_generator(iter(rows),
+                                           ["path", "timestamp"], "r")
+        if node.action == "recover":
+            from ..storage.durability.recovery import recover_latest_snapshot
+            recover_latest_snapshot(storage)
+            self.ctx.invalidate_plans()
+            return self._prepare_generator(iter([["Snapshot recovered."]]),
+                                           ["status"], "s")
+        raise SemanticException(f"unknown snapshot action {node.action}")
+
+    def _prepare_isolation(self, node: A.IsolationLevelQuery) -> PreparedQuery:
+        level = IsolationLevel(node.level)
+        if node.scope == "global":
+            self.ctx.storage.config.isolation_level = level
+        elif node.scope == "session":
+            self.session_isolation = level
+        else:
+            self.next_isolation = level
+        return self._prepare_generator(iter([]), [], "s")
+
+    def _prepare_storage_mode(self, node: A.StorageModeQuery) -> PreparedQuery:
+        self.ctx.storage.config.storage_mode = StorageMode(node.mode)
+        return self._prepare_generator(iter([]), [], "s")
+
+    def _prepare_trigger(self, node: A.TriggerQuery) -> PreparedQuery:
+        from .triggers import global_trigger_store
+        store = global_trigger_store(self.ctx)
+        if node.action == "create":
+            store.create(node.name, node.event, node.phase, node.statement)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "drop":
+            store.drop(node.name)
+            return self._prepare_generator(iter([]), [], "s")
+        rows = [[t.name, t.event or "ANY", t.phase, t.statement]
+                for t in store.all()]
+        return self._prepare_generator(
+            iter(rows), ["trigger name", "event", "phase", "statement"], "r")
+
+    def _prepare_auth(self, node: A.AuthQuery) -> PreparedQuery:
+        from ..auth.auth import global_auth
+        auth = global_auth()
+        if node.action == "create_user":
+            pw = None
+            if node.password is not None and isinstance(node.password,
+                                                        A.Literal):
+                pw = node.password.value
+            auth.create_user(node.user, pw)
+        elif node.action == "drop_user":
+            auth.drop_user(node.user)
+        return self._prepare_generator(iter([]), [], "s")
+
+    # --- helpers ------------------------------------------------------------
+
+    def _prepare_generator(self, iterator, columns, summary_type
+                           ) -> PreparedQuery:
+        self._install_stream(iterator, None, False)
+        self._prepared = PreparedQuery(columns, 0, summary_type)
+        return self._prepared
+
+
+def _chain_front(first_row, rest):
+    yield first_row
+    yield from rest

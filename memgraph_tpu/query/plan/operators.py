@@ -1,0 +1,1029 @@
+"""Volcano-style pull operators (generator cursors).
+
+Counterpart of the reference's ~80 pull operators
+(/root/reference/src/query/plan/operator.hpp:331-3189). Each logical
+operator exposes `cursor(ctx)` returning an iterator of frames (dicts);
+the chain streams row-by-row so LIMIT short-circuits and Bolt can pull
+incrementally — the same contract as the reference's Cursor::Pull
+(operator.hpp:79). PROFILE wraps cursors with counters (profile.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ...exceptions import (HintedAbortError, QueryException, SemanticException,
+                           TypeException)
+from ...storage.common import View
+from ...storage.ordering import order_key
+from ...storage.storage import EdgeAccessor, VertexAccessor
+from ..eval import EvalContext, Evaluator
+from ..frontend import ast as A
+from .. import values as V
+from ..values import Path
+
+
+class ExecutionContext:
+    """Per-execution state shared by all cursors."""
+
+    def __init__(self, accessor, parameters=None, view=View.NEW,
+                 interpreter_context=None, timeout_checker=None):
+        self.accessor = accessor
+        self.parameters = parameters or {}
+        self.view = view
+        self.eval_ctx = EvalContext(accessor, self.parameters, view)
+        self.evaluator = Evaluator(self.eval_ctx)
+        self.interpreter_context = interpreter_context
+        self.timeout_checker = timeout_checker
+        self.stats = {"nodes_created": 0, "nodes_deleted": 0,
+                      "relationships_created": 0, "relationships_deleted": 0,
+                      "properties_set": 0, "labels_added": 0,
+                      "labels_removed": 0}
+
+    def check_abort(self):
+        if self.timeout_checker is not None:
+            self.timeout_checker()
+
+    @property
+    def storage(self):
+        return self.accessor.storage
+
+
+class LogicalOperator:
+    """Base: single-input operators hold `input` (no default here — a base
+    class attribute would leak a dataclass default into every subclass)."""
+
+    def cursor(self, ctx: ExecutionContext):
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def children(self) -> list:
+        child = getattr(self, "input", None)
+        return [child] if child is not None else []
+
+
+class Once(LogicalOperator):
+    input = None
+
+    def cursor(self, ctx):
+        yield {}
+
+
+@dataclass
+class ScanAll(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            for va in ctx.accessor.vertices(ctx.view):
+                new = dict(frame)
+                new[self.symbol] = va
+                yield new
+
+
+@dataclass
+class ScanAllByLabel(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    label: str
+
+    def cursor(self, ctx):
+        lid = ctx.storage.label_mapper.maybe_name_to_id(self.label)
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if lid is None:
+                continue
+            for va in ctx.accessor.vertices_by_label(lid, ctx.view):
+                new = dict(frame)
+                new[self.symbol] = va
+                yield new
+
+
+@dataclass
+class ScanAllByLabelPropertyValue(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    label: str
+    properties: list[str]
+    value_exprs: list[A.Expr]
+
+    def cursor(self, ctx):
+        storage = ctx.storage
+        lid = storage.label_mapper.maybe_name_to_id(self.label)
+        pids = [storage.property_mapper.maybe_name_to_id(p)
+                for p in self.properties]
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if lid is None or any(p is None for p in pids):
+                continue
+            values = [ctx.evaluator.eval(e, frame) for e in self.value_exprs]
+            if any(v is None for v in values):
+                continue  # = null never matches
+            for va in ctx.accessor.vertices_by_label_property_value(
+                    lid, tuple(pids), values, ctx.view):
+                new = dict(frame)
+                new[self.symbol] = va
+                yield new
+
+
+@dataclass
+class ScanAllByLabelPropertyRange(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    label: str
+    prop: str
+    lower: Optional[A.Expr]
+    upper: Optional[A.Expr]
+    lower_inclusive: bool = True
+    upper_inclusive: bool = True
+
+    def cursor(self, ctx):
+        storage = ctx.storage
+        lid = storage.label_mapper.maybe_name_to_id(self.label)
+        pid = storage.property_mapper.maybe_name_to_id(self.prop)
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if lid is None or pid is None:
+                continue
+            lo = ctx.evaluator.eval(self.lower, frame) \
+                if self.lower is not None else None
+            hi = ctx.evaluator.eval(self.upper, frame) \
+                if self.upper is not None else None
+            if (self.lower is not None and lo is None) or \
+                    (self.upper is not None and hi is None):
+                continue
+            for va in ctx.accessor.vertices_by_label_property_range(
+                    lid, (pid,), lo, hi, self.lower_inclusive,
+                    self.upper_inclusive, ctx.view):
+                new = dict(frame)
+                new[self.symbol] = va
+                yield new
+
+
+@dataclass
+class ScanAllById(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    id_expr: A.Expr
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            gid = ctx.evaluator.eval(self.id_expr, frame)
+            if not isinstance(gid, int) or isinstance(gid, bool):
+                continue
+            va = ctx.accessor.find_vertex(gid, ctx.view)
+            if va is not None:
+                new = dict(frame)
+                new[self.symbol] = va
+                yield new
+
+
+@dataclass
+class Expand(LogicalOperator):
+    """Expand one hop from `from_symbol`; binds edge_symbol/to_symbol.
+
+    direction: 'out' | 'in' | 'both'. If to_symbol is already bound, acts
+    as an edge test between the two bound nodes. `prev_edge_symbols` holds
+    edge symbols of the same MATCH for relationship-uniqueness filtering
+    (reference: EdgeUniquenessFilter, plan/operator.hpp).
+    """
+    input: LogicalOperator
+    from_symbol: str
+    edge_symbol: str
+    to_symbol: str
+    direction: str
+    edge_types: list[str]
+    prev_edge_symbols: list[str] = field(default_factory=list)
+
+    def _type_ids(self, ctx):
+        if not self.edge_types:
+            return None
+        ids = set()
+        for t in self.edge_types:
+            tid = ctx.storage.edge_type_mapper.maybe_name_to_id(t)
+            if tid is not None:
+                ids.add(tid)
+        return ids
+
+    def cursor(self, ctx):
+        type_ids = self._type_ids(ctx)
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if self.edge_types and not type_ids:
+                continue
+            from_v = frame.get(self.from_symbol)
+            if from_v is None:
+                continue
+            to_bound = self.to_symbol in frame
+            used = {frame[s].gid for s in self.prev_edge_symbols
+                    if isinstance(frame.get(s), EdgeAccessor)}
+            for ea, other in self._edges(ctx, from_v, type_ids):
+                if ea.gid in used:
+                    continue
+                if to_bound:
+                    bound = frame[self.to_symbol]
+                    if not isinstance(bound, VertexAccessor) or \
+                            bound.gid != other.gid:
+                        continue
+                    new = dict(frame)
+                    new[self.edge_symbol] = ea
+                    yield new
+                else:
+                    new = dict(frame)
+                    new[self.edge_symbol] = ea
+                    new[self.to_symbol] = other
+                    yield new
+
+    def _edges(self, ctx, from_v, type_ids):
+        view = ctx.view
+        if self.direction in ("out", "both"):
+            for ea in from_v.out_edges(view, type_ids):
+                yield ea, ea.to_vertex()
+        if self.direction in ("in", "both"):
+            for ea in from_v.in_edges(view, type_ids):
+                if self.direction == "both" and \
+                        ea.from_vertex().gid == from_v.gid and \
+                        ea.to_vertex().gid == from_v.gid:
+                    continue  # self-loop already produced by the out pass
+                yield ea, ea.from_vertex()
+
+
+@dataclass
+class ExpandVariable(LogicalOperator):
+    """Variable-length expansion (DFS enumeration with hop bounds).
+
+    Binds edge_symbol to the list of edges. Counterpart of the reference's
+    ExpandVariable (plan/operator.hpp:1140).
+    """
+    input: LogicalOperator
+    from_symbol: str
+    edge_symbol: str
+    to_symbol: str
+    direction: str
+    edge_types: list[str]
+    min_hops: int = 1
+    max_hops: int = -1          # -1 = unbounded
+    prev_edge_symbols: list[str] = field(default_factory=list)
+
+    def cursor(self, ctx):
+        type_ids = Expand._type_ids(self, ctx)
+        max_hops = self.max_hops if self.max_hops >= 0 else 1 << 30
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if self.edge_types and not type_ids:
+                continue
+            from_v = frame.get(self.from_symbol)
+            if from_v is None:
+                continue
+            to_bound = self.to_symbol in frame
+            used = {frame[s].gid for s in self.prev_edge_symbols
+                    if isinstance(frame.get(s), EdgeAccessor)}
+
+            def dfs(node, path_edges, used_gids):
+                depth = len(path_edges)
+                if depth >= self.min_hops:
+                    if to_bound:
+                        bound = frame[self.to_symbol]
+                        if isinstance(bound, VertexAccessor) and \
+                                bound.gid == node.gid:
+                            yield path_edges, node
+                    else:
+                        yield path_edges, node
+                if depth >= max_hops:
+                    return
+                for ea, other in Expand._edges(self, ctx, node, type_ids):
+                    if ea.gid in used_gids:
+                        continue
+                    yield from dfs(other, path_edges + [ea],
+                                   used_gids | {ea.gid})
+
+            if self.min_hops == 0:
+                # zero-length: from == to
+                if to_bound:
+                    bound = frame[self.to_symbol]
+                    if isinstance(bound, VertexAccessor) and \
+                            bound.gid == from_v.gid:
+                        new = dict(frame)
+                        new[self.edge_symbol] = []
+                        yield new
+                else:
+                    new = dict(frame)
+                    new[self.edge_symbol] = []
+                    new[self.to_symbol] = from_v
+                    yield new
+            start = max(self.min_hops, 1)
+            for path_edges, end in dfs(from_v, [], set(used)):
+                if len(path_edges) < start:
+                    continue
+                new = dict(frame)
+                new[self.edge_symbol] = list(path_edges)
+                if not to_bound:
+                    new[self.to_symbol] = end
+                yield new
+
+
+@dataclass
+class ConstructNamedPath(LogicalOperator):
+    """Bind a path variable from matched pattern symbols."""
+    input: LogicalOperator
+    path_symbol: str
+    element_symbols: list[str]   # node, edge, node, edge, ...
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            items = []
+            ok = True
+            for i, sym in enumerate(self.element_symbols):
+                v = frame.get(sym)
+                if v is None:
+                    ok = False
+                    break
+                if isinstance(v, list):      # variable-length edge list
+                    if items:
+                        last_node = items[-1]
+                        for ea in v:
+                            nxt = ea.to_vertex() \
+                                if ea.from_vertex().gid == last_node.gid \
+                                else ea.from_vertex()
+                            items.append(ea)
+                            items.append(nxt)
+                            last_node = nxt
+                    continue
+                if items and isinstance(v, VertexAccessor) and \
+                        isinstance(items[-1], VertexAccessor):
+                    if items[-1].gid == v.gid:
+                        continue  # var-length already appended the end node
+                items.append(v)
+            new = dict(frame)
+            new[self.path_symbol] = Path(items) if ok else None
+            yield new
+
+
+@dataclass
+class Filter(LogicalOperator):
+    input: LogicalOperator
+    expr: A.Expr
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if ctx.evaluator.eval(self.expr, frame) is True:
+                yield frame
+
+
+@dataclass
+class Produce(LogicalOperator):
+    input: LogicalOperator
+    items: list[tuple[A.Expr, str]]   # (expr, output name)
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            out = dict(frame)
+            row = {}
+            for expr, name in self.items:
+                value = ctx.evaluator.eval(expr, frame)
+                row[name] = value
+                out[name] = value
+            out["__row__"] = row
+            yield out
+
+
+@dataclass
+class CreateNode(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    labels: list[str]
+    properties: object           # dict[str, Expr] | A.Parameter | None
+
+    def cursor(self, ctx):
+        storage = ctx.storage
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            va = ctx.accessor.create_vertex()
+            ctx.stats["nodes_created"] += 1
+            for label in self.labels:
+                va.add_label(storage.label_mapper.name_to_id(label))
+                ctx.stats["labels_added"] += 1
+            props = _eval_prop_map(ctx, self.properties, frame)
+            for key, value in props.items():
+                if value is not None:
+                    va.set_property(
+                        storage.property_mapper.name_to_id(key), value)
+                    ctx.stats["properties_set"] += 1
+            new = dict(frame)
+            new[self.symbol] = va
+            yield new
+
+
+@dataclass
+class CreateExpand(LogicalOperator):
+    """Create an edge (and possibly the other endpoint node)."""
+    input: LogicalOperator
+    from_symbol: str
+    edge_symbol: str
+    to_symbol: str
+    direction: str               # 'out' | 'in' (creation needs a direction)
+    edge_type: str
+    edge_properties: object
+    create_to_node: bool
+    to_labels: list[str] = field(default_factory=list)
+    to_properties: object = None
+
+    def cursor(self, ctx):
+        storage = ctx.storage
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            from_v = frame[self.from_symbol]
+            if not isinstance(from_v, VertexAccessor):
+                raise QueryException("CREATE edge endpoint is not a node")
+            new = dict(frame)
+            if self.create_to_node:
+                to_v = ctx.accessor.create_vertex()
+                ctx.stats["nodes_created"] += 1
+                for label in self.to_labels:
+                    to_v.add_label(storage.label_mapper.name_to_id(label))
+                    ctx.stats["labels_added"] += 1
+                props = _eval_prop_map(ctx, self.to_properties, frame)
+                for key, value in props.items():
+                    if value is not None:
+                        to_v.set_property(
+                            storage.property_mapper.name_to_id(key), value)
+                        ctx.stats["properties_set"] += 1
+                new[self.to_symbol] = to_v
+            else:
+                to_v = frame[self.to_symbol]
+                if not isinstance(to_v, VertexAccessor):
+                    raise QueryException("CREATE edge endpoint is not a node")
+            tid = storage.edge_type_mapper.name_to_id(self.edge_type)
+            if self.direction == "in":
+                ea = ctx.accessor.create_edge(to_v, from_v, tid)
+            else:
+                ea = ctx.accessor.create_edge(from_v, to_v, tid)
+            ctx.stats["relationships_created"] += 1
+            props = _eval_prop_map(ctx, self.edge_properties, frame)
+            for key, value in props.items():
+                if value is not None:
+                    ea.set_property(storage.property_mapper.name_to_id(key),
+                                    value)
+                    ctx.stats["properties_set"] += 1
+            new[self.edge_symbol] = ea
+            yield new
+
+
+def _eval_prop_map(ctx, properties, frame) -> dict:
+    if properties is None:
+        return {}
+    if isinstance(properties, A.Parameter):
+        value = ctx.evaluator.eval(properties, frame)
+        if not isinstance(value, dict):
+            raise TypeException("property parameter must be a map")
+        return value
+    return {k: ctx.evaluator.eval(e, frame) for k, e in properties.items()}
+
+
+@dataclass
+class SetProperty(LogicalOperator):
+    input: LogicalOperator
+    target: A.PropertyLookup
+    value: A.Expr
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            obj = ctx.evaluator.eval(self.target.expr, frame)
+            value = ctx.evaluator.eval(self.value, frame)
+            if obj is None:
+                yield frame
+                continue
+            if not isinstance(obj, (VertexAccessor, EdgeAccessor)):
+                raise TypeException("SET property on a non-graph value")
+            pid = ctx.storage.property_mapper.name_to_id(self.target.prop)
+            obj.set_property(pid, value)
+            ctx.stats["properties_set"] += 1
+            yield frame
+
+
+@dataclass
+class SetProperties(LogicalOperator):
+    """n = {..} (replace) or n += {..} (update)."""
+    input: LogicalOperator
+    symbol: str
+    value: A.Expr
+    update: bool
+
+    def cursor(self, ctx):
+        storage = ctx.storage
+        for frame in self.input.cursor(ctx):
+            obj = frame.get(self.symbol)
+            if obj is None:
+                yield frame
+                continue
+            if not isinstance(obj, (VertexAccessor, EdgeAccessor)):
+                raise TypeException("SET properties on a non-graph value")
+            value = ctx.evaluator.eval(self.value, frame)
+            if isinstance(value, (VertexAccessor, EdgeAccessor)):
+                value = {storage.property_mapper.id_to_name(k): v
+                         for k, v in value.properties(ctx.view).items()}
+            if not isinstance(value, dict):
+                raise TypeException("SET expects a map")
+            if not self.update:
+                for pid in list(obj.properties(ctx.view)):
+                    obj.set_property(pid, None)
+            for key, v in value.items():
+                obj.set_property(storage.property_mapper.name_to_id(key), v)
+                ctx.stats["properties_set"] += 1
+            yield frame
+
+
+@dataclass
+class SetLabels(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    labels: list[str]
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            obj = frame.get(self.symbol)
+            if obj is None:
+                yield frame
+                continue
+            if not isinstance(obj, VertexAccessor):
+                raise TypeException("SET label on a non-node value")
+            for label in self.labels:
+                if obj.add_label(ctx.storage.label_mapper.name_to_id(label)):
+                    ctx.stats["labels_added"] += 1
+            yield frame
+
+
+@dataclass
+class RemoveProperty(LogicalOperator):
+    input: LogicalOperator
+    target: A.PropertyLookup
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            obj = ctx.evaluator.eval(self.target.expr, frame)
+            if obj is None:
+                yield frame
+                continue
+            if not isinstance(obj, (VertexAccessor, EdgeAccessor)):
+                raise TypeException("REMOVE property on a non-graph value")
+            pid = ctx.storage.property_mapper.maybe_name_to_id(self.target.prop)
+            if pid is not None:
+                obj.set_property(pid, None)
+                ctx.stats["properties_set"] += 1
+            yield frame
+
+
+@dataclass
+class RemoveLabels(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    labels: list[str]
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            obj = frame.get(self.symbol)
+            if obj is None:
+                yield frame
+                continue
+            if not isinstance(obj, VertexAccessor):
+                raise TypeException("REMOVE label on a non-node value")
+            for label in self.labels:
+                lid = ctx.storage.label_mapper.maybe_name_to_id(label)
+                if lid is not None and obj.remove_label(lid):
+                    ctx.stats["labels_removed"] += 1
+            yield frame
+
+
+@dataclass
+class Delete(LogicalOperator):
+    input: LogicalOperator
+    exprs: list[A.Expr]
+    detach: bool
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            for expr in self.exprs:
+                value = ctx.evaluator.eval(expr, frame)
+                self._delete_value(ctx, value)
+            yield frame
+
+    def _delete_value(self, ctx, value):
+        if value is None:
+            return
+        if isinstance(value, VertexAccessor):
+            if value.is_visible(View.NEW):
+                _, deleted_edges = ctx.accessor.delete_vertex(
+                    value, detach=self.detach)
+                ctx.stats["nodes_deleted"] += 1
+                ctx.stats["relationships_deleted"] += len(deleted_edges)
+        elif isinstance(value, EdgeAccessor):
+            if value.is_visible(View.NEW):
+                ctx.accessor.delete_edge(value)
+                ctx.stats["relationships_deleted"] += 1
+        elif isinstance(value, Path):
+            for ea in value.edges():
+                self._delete_value(ctx, ea)
+            for va in value.vertices():
+                self._delete_value(ctx, va)
+        elif isinstance(value, (list, tuple)):
+            for item in value:
+                self._delete_value(ctx, item)
+        else:
+            raise TypeException(
+                f"DELETE on {V.type_name(value)} is not supported")
+
+
+class Argument(LogicalOperator):
+    """Subplan leaf: yields the frame installed by _run_subplan (the cached
+    plan itself stays immutable, so concurrent executions can share it —
+    same role as the reference/Neo4j 'Argument' operator)."""
+
+    input = None
+
+    def cursor(self, ctx):
+        yield dict(ctx._argument_frame)
+
+
+def _run_subplan(subplan: LogicalOperator, ctx, frame) -> list:
+    """Execute a subplan (leaf: Argument) against one input frame.
+
+    Materializes the result list so ctx._argument_frame is never observed
+    by a suspended generator after it changes.
+    """
+    prev = getattr(ctx, "_argument_frame", None)
+    ctx._argument_frame = frame
+    try:
+        return list(subplan.cursor(ctx))
+    finally:
+        ctx._argument_frame = prev
+
+
+@dataclass
+class Optional_(LogicalOperator):
+    """OPTIONAL MATCH: run subplan per input row; null-fill on no match."""
+    input: LogicalOperator
+    subplan: LogicalOperator
+    optional_symbols: list[str]
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            subs = _run_subplan(self.subplan, ctx, frame)
+            if subs:
+                yield from subs
+            else:
+                new = dict(frame)
+                for sym in self.optional_symbols:
+                    new[sym] = None
+                yield new
+
+    def children(self):
+        return [self.input, self.subplan]
+
+
+@dataclass
+class Merge(LogicalOperator):
+    """MERGE: try match subplan; else run create subplan. ON CREATE/ON MATCH
+    handled by Set* operators appended to the respective subplans."""
+    input: LogicalOperator
+    match_plan: LogicalOperator
+    create_plan: LogicalOperator
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            subs = _run_subplan(self.match_plan, ctx, frame)
+            if subs:
+                yield from subs
+            else:
+                yield from _run_subplan(self.create_plan, ctx, frame)
+
+    def children(self):
+        return [self.input, self.match_plan, self.create_plan]
+
+
+AGGREGATE_FUNCTIONS = {"count", "sum", "avg", "min", "max", "collect",
+                       "stdev", "stdevp", "project"}
+
+
+@dataclass
+class Aggregate(LogicalOperator):
+    """Hash aggregation. group_by: (expr, name); aggregations:
+    (kind, expr|None, distinct, output name)."""
+    input: LogicalOperator
+    group_by: list[tuple[A.Expr, str]]
+    aggregations: list[tuple[str, Optional[A.Expr], bool, str]]
+    remember: list[str] = field(default_factory=list)
+
+    def cursor(self, ctx):
+        groups: dict = {}
+        order: list = []
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            key_vals = [ctx.evaluator.eval(e, frame) for e, _ in self.group_by]
+            key = tuple(V.hashable_key(v) for v in key_vals)
+            if key not in groups:
+                state = {
+                    "key_vals": key_vals,
+                    "frame": {s: frame.get(s) for s in self.remember},
+                    "aggs": [_AggState(kind, distinct)
+                             for kind, _, distinct, _ in self.aggregations],
+                }
+                groups[key] = state
+                order.append(key)
+            state = groups[key]
+            for (kind, expr, distinct, _), agg in zip(self.aggregations,
+                                                      state["aggs"]):
+                value = (ctx.evaluator.eval(expr, frame)
+                         if expr is not None else "__row__")
+                agg.update(value)
+        if not groups and not self.group_by:
+            # aggregation over empty input yields one row of neutral values
+            state = {"key_vals": [], "frame": {},
+                     "aggs": [_AggState(kind, distinct)
+                              for kind, _, distinct, _ in self.aggregations]}
+            groups[()] = state
+            order.append(())
+        for key in order:
+            state = groups[key]
+            new = dict(state["frame"])
+            for (_, name), val in zip(self.group_by, state["key_vals"]):
+                new[name] = val
+            for (_, _, _, name), agg in zip(self.aggregations, state["aggs"]):
+                new[name] = agg.result()
+            yield new
+
+
+class _AggState:
+    __slots__ = ("kind", "distinct", "seen", "count", "total", "minv",
+                 "maxv", "items", "m2", "mean")
+
+    def __init__(self, kind, distinct):
+        self.kind = kind
+        self.distinct = distinct
+        self.seen = set() if distinct else None
+        self.count = 0
+        self.total = 0
+        self.minv = None
+        self.maxv = None
+        self.items = []
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def update(self, value):
+        kind = self.kind
+        if kind == "count" and value == "__row__":
+            self.count += 1
+            return
+        if value is None:
+            return
+        if self.distinct:
+            key = V.hashable_key(value)
+            if key in self.seen:
+                return
+            self.seen.add(key)
+        self.count += 1
+        if kind == "count":
+            return
+        if kind == "collect":
+            self.items.append(value)
+            return
+        if kind == "project":
+            self.items.append(value)
+            return
+        if kind in ("sum", "avg"):
+            from ...utils.temporal import Duration
+            if not (V.is_numeric(value) or isinstance(value, Duration)):
+                raise TypeException(f"{kind}() requires numeric input")
+            self.total = value if self.count == 1 else self.total + value
+            return
+        if kind in ("stdev", "stdevp"):
+            if not V.is_numeric(value):
+                raise TypeException(f"{kind}() requires numeric input")
+            delta = value - self.mean
+            self.mean += delta / self.count
+            self.m2 += delta * (value - self.mean)
+            return
+        if kind == "min":
+            if self.minv is None or V.cypher_lt(value, self.minv) is True:
+                self.minv = value
+            return
+        if kind == "max":
+            if self.maxv is None or V.cypher_lt(self.maxv, value) is True:
+                self.maxv = value
+            return
+        raise SemanticException(f"unknown aggregate {kind}")
+
+    def result(self):
+        kind = self.kind
+        if kind == "count":
+            return self.count
+        if kind == "collect":
+            return self.items
+        if kind == "project":
+            # graph projection: collect of paths/nodes into a map
+            return {"nodes": [x for x in self.items
+                              if isinstance(x, VertexAccessor)],
+                    "edges": [x for x in self.items
+                              if isinstance(x, EdgeAccessor)]}
+        if kind == "sum":
+            return self.total if self.count else 0
+        if kind == "avg":
+            return (self.total / self.count) if self.count else None
+        if kind == "min":
+            return self.minv
+        if kind == "max":
+            return self.maxv
+        if kind == "stdev":
+            if self.count < 2:
+                return 0.0 if self.count else None
+            return (self.m2 / (self.count - 1)) ** 0.5
+        if kind == "stdevp":
+            if not self.count:
+                return None
+            return (self.m2 / self.count) ** 0.5
+        raise SemanticException(f"unknown aggregate {kind}")
+
+
+@dataclass
+class OrderBy(LogicalOperator):
+    input: LogicalOperator
+    items: list[tuple[A.Expr, bool]]   # (expr, ascending)
+
+    def cursor(self, ctx):
+        rows = []
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            keys = []
+            for expr, asc in self.items:
+                k = order_key(ctx.evaluator.eval(expr, frame))
+                keys.append((k, asc))
+            rows.append((keys, frame))
+
+        import functools
+
+        def compare(a, b):
+            for (ka, asc), (kb, _) in zip(a[0], b[0]):
+                if ka < kb:
+                    return -1 if asc else 1
+                if ka > kb:
+                    return 1 if asc else -1
+            return 0
+
+        rows.sort(key=functools.cmp_to_key(compare))
+        for _, frame in rows:
+            yield frame
+
+
+@dataclass
+class Skip(LogicalOperator):
+    input: LogicalOperator
+    expr: A.Expr
+
+    def cursor(self, ctx):
+        n = ctx.evaluator.eval(self.expr, {})
+        if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+            raise TypeException("SKIP must be a non-negative integer")
+        yield from itertools.islice(self.input.cursor(ctx), n, None)
+
+
+@dataclass
+class Limit(LogicalOperator):
+    input: LogicalOperator
+    expr: A.Expr
+
+    def cursor(self, ctx):
+        n = ctx.evaluator.eval(self.expr, {})
+        if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+            raise TypeException("LIMIT must be a non-negative integer")
+        yield from itertools.islice(self.input.cursor(ctx), n)
+
+
+@dataclass
+class Distinct(LogicalOperator):
+    input: LogicalOperator
+    symbols: list[str]
+
+    def cursor(self, ctx):
+        seen = set()
+        for frame in self.input.cursor(ctx):
+            key = tuple(V.hashable_key(frame.get(s)) for s in self.symbols)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield frame
+
+
+@dataclass
+class Unwind(LogicalOperator):
+    input: LogicalOperator
+    expr: A.Expr
+    symbol: str
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            value = ctx.evaluator.eval(self.expr, frame)
+            if value is None:
+                continue
+            if not isinstance(value, (list, tuple)):
+                raise TypeException("UNWIND requires a list")
+            for item in value:
+                new = dict(frame)
+                new[self.symbol] = item
+                yield new
+
+
+@dataclass
+class CallProcedureOp(LogicalOperator):
+    input: LogicalOperator
+    proc_name: str
+    args: list[A.Expr]
+    result_fields: list[str]
+    output_symbols: list[str]
+
+    def cursor(self, ctx):
+        from ..procedures.registry import global_registry
+        proc = global_registry.find(self.proc_name)
+        if proc is None:
+            raise SemanticException(f"unknown procedure: {self.proc_name}")
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            args = [ctx.evaluator.eval(e, frame) for e in self.args]
+            for record in proc.call(ctx, args):
+                new = dict(frame)
+                for fieldname, sym in zip(self.result_fields,
+                                          self.output_symbols):
+                    if fieldname not in record:
+                        raise SemanticException(
+                            f"procedure {self.proc_name} did not yield "
+                            f"{fieldname!r}")
+                    new[sym] = record[fieldname]
+                yield new
+
+
+@dataclass
+class Union(LogicalOperator):
+    left: LogicalOperator
+    right: LogicalOperator
+    symbols: list[str]
+    distinct: bool
+
+    input: None = None
+
+    def cursor(self, ctx):
+        seen = set()
+        for plan in (self.left, self.right):
+            for frame in plan.cursor(ctx):
+                row = frame.get("__row__", {})
+                out = {s: row.get(s) for s in self.symbols}
+                if self.distinct:
+                    key = tuple(V.hashable_key(out[s]) for s in self.symbols)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                yield {**out, "__row__": out}
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class Foreach(LogicalOperator):
+    input: LogicalOperator
+    symbol: str
+    list_expr: A.Expr
+    update_plan: LogicalOperator
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            lst = ctx.evaluator.eval(self.list_expr, frame)
+            if lst is not None:
+                if not isinstance(lst, (list, tuple)):
+                    raise TypeException("FOREACH requires a list")
+                for item in lst:
+                    inner = dict(frame)
+                    inner[self.symbol] = item
+                    for _ in _run_subplan(self.update_plan, ctx, inner):
+                        pass
+            yield frame
+
+    def children(self):
+        return [self.input, self.update_plan]
+
+
+@dataclass
+class Accumulate(LogicalOperator):
+    """Materialize all input rows before streaming (write barrier between
+    updating clauses and RETURN — reference: Accumulate operator)."""
+    input: LogicalOperator
+
+    def cursor(self, ctx):
+        rows = list(self.input.cursor(ctx))
+        yield from rows

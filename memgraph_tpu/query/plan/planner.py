@@ -1,0 +1,731 @@
+"""Rule-based query planner: clause chain → operator tree.
+
+Counterpart of the reference's RuleBasedPlanner + rewrite passes
+(/root/reference/src/query/plan/rule_based_planner.cpp,
+plan/rewrite/index_lookup.hpp): pattern matching compiles to
+Scan→Expand→Filter chains, with index-backed scan selection driven by
+pattern property maps, WHERE equality/range predicates, and index
+statistics (approx counts) for choosing the cheapest start.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from ...exceptions import SemanticException
+from ..frontend import ast as A
+from . import operators as Op
+
+_ANON = itertools.count()
+
+
+def _anon(prefix="anon"):
+    return f"__{prefix}{next(_ANON)}__"
+
+
+def collect_aggregations(expr: A.Expr, out: list) -> None:
+    """Find aggregate FunctionCall/CountStar nodes within an expression."""
+    if isinstance(expr, A.CountStar):
+        out.append(expr)
+        return
+    if isinstance(expr, A.FunctionCall) and \
+            expr.name in Op.AGGREGATE_FUNCTIONS:
+        out.append(expr)
+        return
+    for child in _children_exprs(expr):
+        collect_aggregations(child, out)
+
+
+def _children_exprs(expr):
+    if isinstance(expr, A.Unary):
+        return [expr.expr]
+    if isinstance(expr, A.Binary):
+        return [expr.left, expr.right]
+    if isinstance(expr, (A.PropertyLookup, A.LabelsTest, A.IsNull)):
+        return [expr.expr]
+    if isinstance(expr, A.Subscript):
+        return [expr.expr, expr.index]
+    if isinstance(expr, A.Slice):
+        return [e for e in (expr.expr, expr.lo, expr.hi) if e is not None]
+    if isinstance(expr, A.ListLiteral):
+        return expr.items
+    if isinstance(expr, A.MapLiteral):
+        return list(expr.items.values())
+    if isinstance(expr, A.FunctionCall):
+        return expr.args
+    if isinstance(expr, A.CaseExpr):
+        out = [e for e in (expr.test, expr.default) if e is not None]
+        for c, r in expr.whens:
+            out.extend((c, r))
+        return out
+    if isinstance(expr, A.ListComprehension):
+        return [e for e in (expr.list_expr, expr.where, expr.projection)
+                if e is not None]
+    if isinstance(expr, A.Quantifier):
+        return [expr.list_expr, expr.where]
+    if isinstance(expr, A.Reduce):
+        return [expr.init, expr.list_expr, expr.expr]
+    return []
+
+
+def expr_symbols(expr: A.Expr, out: set) -> set:
+    """Free identifiers referenced by an expression (over-approximate)."""
+    if isinstance(expr, A.Identifier):
+        out.add(expr.name)
+    for child in _children_exprs(expr):
+        expr_symbols(child, out)
+    return out
+
+
+def _split_and(expr: Optional[A.Expr]) -> list:
+    if expr is None:
+        return []
+    if isinstance(expr, A.Binary) and expr.op == "AND":
+        return _split_and(expr.left) + _split_and(expr.right)
+    return [expr]
+
+
+class Planner:
+    """Plans one SingleQuery clause chain."""
+
+    def __init__(self, storage) -> None:
+        self.storage = storage
+
+    # --- public -------------------------------------------------------------
+
+    def plan_query(self, query: A.CypherQuery):
+        plan, columns = self.plan_single(query.query)
+        for union_all, sub in query.unions:
+            sub_plan, sub_cols = self.plan_single(sub)
+            if [c for c in sub_cols] != [c for c in columns]:
+                raise SemanticException(
+                    "UNION queries must return the same column names")
+            plan = Op.Union(plan, sub_plan, columns, distinct=not union_all)
+        return plan, columns
+
+    def plan_single(self, single: A.SingleQuery):
+        plan: Op.LogicalOperator = Op.Once()
+        bound: set[str] = set()
+        columns: list[str] = []
+        clauses = single.clauses
+        has_update = False
+        produced = False
+
+        for ci, clause in enumerate(clauses):
+            if isinstance(clause, A.Match):
+                plan = self.plan_match(clause, plan, bound)
+            elif isinstance(clause, A.Create):
+                has_update = True
+                plan = self.plan_create(clause, plan, bound)
+            elif isinstance(clause, A.Merge):
+                has_update = True
+                plan = self.plan_merge(clause, plan, bound)
+            elif isinstance(clause, A.SetClause):
+                has_update = True
+                plan = self.plan_set_items(clause.items, plan, bound)
+            elif isinstance(clause, A.Remove):
+                has_update = True
+                plan = self.plan_remove(clause, plan)
+            elif isinstance(clause, A.Delete):
+                has_update = True
+                plan = Op.Delete(plan, clause.exprs, clause.detach)
+            elif isinstance(clause, A.Unwind):
+                plan = Op.Unwind(plan, clause.expr, clause.variable)
+                bound.add(clause.variable)
+            elif isinstance(clause, A.CallProcedure):
+                plan = self.plan_call(clause, plan, bound)
+                if ci == len(clauses) - 1 and (clause.yields
+                                               or clause.yield_star):
+                    # standalone CALL ... YIELD: surface yielded columns
+                    names = [a or f for f, a in clause.yields] \
+                        if clause.yields else self._call_fields(clause)
+                    items = [(A.Identifier(n), n) for n in names]
+                    plan = Op.Produce(plan, items)
+                    columns = names
+                    produced = True
+            elif isinstance(clause, A.With):
+                plan, columns = self.plan_projection(
+                    clause.body, plan, bound, has_update, is_with=True,
+                    where=clause.where)
+                has_update = False
+                bound = set(columns)
+            elif isinstance(clause, A.Return):
+                plan, columns = self.plan_projection(
+                    clause.body, plan, bound, has_update, is_with=False)
+                produced = True
+            elif isinstance(clause, A.Foreach):
+                has_update = True
+                plan = self.plan_foreach(clause, plan, bound)
+            else:
+                raise SemanticException(
+                    f"unsupported clause {type(clause).__name__}")
+
+        if not produced and not has_update and not any(
+                isinstance(c, A.CallProcedure) for c in clauses):
+            raise SemanticException("query must end with RETURN or an update")
+        return plan, columns
+
+    def _call_fields(self, clause: A.CallProcedure) -> list[str]:
+        from ..procedures.registry import global_registry
+        proc = global_registry.find(clause.name)
+        if proc is None:
+            raise SemanticException(f"unknown procedure: {clause.name}")
+        return [f for f, _ in proc.results]
+
+    # --- MATCH --------------------------------------------------------------
+
+    def plan_match(self, match: A.Match, plan, bound: set):
+        where_parts = _split_and(match.where)
+        if match.optional:
+            sub_bound = set(bound)
+            subplan = self.plan_pattern_chain(
+                match.patterns, Op.Argument(), sub_bound, where_parts,
+                outer_bound=bound)
+            new_syms = sorted(sub_bound - bound)
+            plan = Op.Optional_(plan, subplan, new_syms)
+            bound.update(sub_bound)
+            return plan
+        plan = self.plan_pattern_chain(match.patterns, plan, bound,
+                                       where_parts, outer_bound=None)
+        return plan
+
+    def plan_pattern_chain(self, patterns, plan, bound: set, where_parts,
+                           outer_bound):
+        pending = list(where_parts)
+        edge_syms_in_match: list[str] = []
+        for pattern in patterns:
+            plan = self.plan_pattern(pattern, plan, bound, pending,
+                                     edge_syms_in_match)
+        # leftover predicates apply once everything is bound
+        for pred in pending:
+            plan = Op.Filter(plan, pred)
+        return plan
+
+    def plan_pattern(self, pattern: A.Pattern, plan, bound: set, pending,
+                     edge_syms_in_match):
+        elements = pattern.elements
+        nodes = elements[0::2]
+        edges = elements[1::2]
+        # name anonymous symbols
+        node_syms = []
+        for node in nodes:
+            sym = node.variable or _anon("node")
+            node.variable = sym
+            node_syms.append(sym)
+        edge_syms = []
+        for edge in edges:
+            sym = edge.variable or _anon("edge")
+            edge.variable = sym
+            edge_syms.append(sym)
+
+        # choose a start node among unbound ones (index-driven)
+        start_idx = self._choose_start(nodes, bound, pending)
+        plan = self._plan_node_scan(nodes[start_idx], plan, bound, pending)
+
+        # expand left and right from the start
+        # process edges in order: right side first (start→end), then left
+        for i in range(start_idx, len(edges)):
+            plan = self._plan_expand(edges[i], nodes[i], nodes[i + 1],
+                                     "fwd", plan, bound, pending,
+                                     edge_syms_in_match)
+        for i in range(start_idx - 1, -1, -1):
+            plan = self._plan_expand(edges[i], nodes[i], nodes[i + 1],
+                                     "bwd", plan, bound, pending,
+                                     edge_syms_in_match)
+
+        if pattern.variable:
+            syms = []
+            for i, node in enumerate(nodes):
+                syms.append(node.variable)
+                if i < len(edges):
+                    syms.append(edges[i].variable)
+            # interleave properly: node, edge, node, ...
+            interleaved = []
+            for i in range(len(edges)):
+                interleaved.append(nodes[i].variable)
+                interleaved.append(edges[i].variable)
+            interleaved.append(nodes[-1].variable)
+            plan = Op.ConstructNamedPath(plan, pattern.variable, interleaved)
+            bound.add(pattern.variable)
+        return plan
+
+    def _choose_start(self, nodes, bound: set, pending) -> int:
+        # already-bound node → cheapest start (no scan at all)
+        for i, node in enumerate(nodes):
+            if node.variable in bound:
+                return i
+        best = (float("inf"), 0)
+        for i, node in enumerate(nodes):
+            cost = self._scan_cost(node, pending)
+            if cost < best[0]:
+                best = (cost, i)
+        return best[1]
+
+    def _scan_cost(self, node: A.NodePattern, pending) -> float:
+        indices = self.storage.indices
+        mapper = self.storage.label_mapper
+        pmapper = self.storage.property_mapper
+        total = max(len(self.storage._vertices), 1)
+        best = float(total) * 2  # ScanAll penalty
+        for label in node.labels:
+            lid = mapper.maybe_name_to_id(label)
+            if lid is None:
+                return 0.0  # label unknown → zero results
+            eq_props = self._equality_props(node, pending)
+            for (ilabel, iprops) in indices.label_property.relevant_to(lid):
+                if all(pmapper.id_to_name(p) in eq_props for p in iprops):
+                    best = min(best, indices.label_property.approx_count(
+                        ilabel, iprops) / max(len(iprops), 1))
+            if indices.label.has(lid):
+                best = min(best, float(indices.label.approx_count(lid)))
+            else:
+                best = min(best, float(total))
+        return best
+
+    def _equality_props(self, node: A.NodePattern, pending) -> set:
+        """Property names fixed by the pattern map or WHERE n.p = <expr>."""
+        out = set()
+        if isinstance(node.properties, dict):
+            out.update(node.properties.keys())
+        for pred in pending:
+            if isinstance(pred, A.Binary) and pred.op == "=":
+                for lhs, rhs in ((pred.left, pred.right),
+                                 (pred.right, pred.left)):
+                    if (isinstance(lhs, A.PropertyLookup)
+                            and isinstance(lhs.expr, A.Identifier)
+                            and lhs.expr.name == node.variable):
+                        out.add(lhs.prop)
+        return out
+
+    def _plan_node_scan(self, node: A.NodePattern, plan, bound: set, pending):
+        sym = node.variable
+        if sym in bound:
+            return self._apply_node_filters(node, plan, bound, pending,
+                                            skip_scan_filters=False)
+        indices = self.storage.indices
+        mapper = self.storage.label_mapper
+        pmapper = self.storage.property_mapper
+        scan = None
+        used_label = None
+        used_props: set = set()
+
+        eq_map = {}  # prop name -> value expr
+        if isinstance(node.properties, dict):
+            eq_map.update(node.properties)
+        where_eq = {}
+        range_preds = {}
+        for pred in pending:
+            if isinstance(pred, A.Binary) and pred.op in (
+                    "=", "<", ">", "<=", ">="):
+                for lhs, rhs, op in ((pred.left, pred.right, pred.op),
+                                     (pred.right, pred.left,
+                                      _flip(pred.op))):
+                    if (isinstance(lhs, A.PropertyLookup)
+                            and isinstance(lhs.expr, A.Identifier)
+                            and lhs.expr.name == sym
+                            and not (expr_symbols(rhs, set()) - bound)):
+                        if op == "=":
+                            where_eq.setdefault(lhs.prop, (rhs, pred))
+                        else:
+                            range_preds.setdefault(lhs.prop, []).append(
+                                (op, rhs, pred))
+
+        for label in node.labels:
+            lid = mapper.maybe_name_to_id(label)
+            if lid is None:
+                continue
+            # equality composite index
+            for (ilabel, iprops) in sorted(
+                    indices.label_property.relevant_to(lid),
+                    key=lambda k: -len(k[1])):
+                names = [pmapper.id_to_name(p) for p in iprops]
+                if all(n in eq_map or n in where_eq for n in names):
+                    exprs = []
+                    consumed = []
+                    for n in names:
+                        if n in eq_map:
+                            exprs.append(eq_map[n])
+                        else:
+                            rhs, pred = where_eq[n]
+                            exprs.append(rhs)
+                            consumed.append(pred)
+                    scan = Op.ScanAllByLabelPropertyValue(
+                        plan, sym, label, names, exprs)
+                    for pred in consumed:
+                        if pred in pending:
+                            pending.remove(pred)
+                    used_label = label
+                    used_props = set(names) & set(eq_map)
+                    break
+                if len(iprops) == 1 and names[0] in range_preds:
+                    lo = hi = None
+                    lo_inc = hi_inc = True
+                    consumed = []
+                    for op, rhs, pred in range_preds[names[0]]:
+                        if op in (">", ">="):
+                            lo, lo_inc = rhs, op == ">="
+                        else:
+                            hi, hi_inc = rhs, op == "<="
+                        consumed.append(pred)
+                    scan = Op.ScanAllByLabelPropertyRange(
+                        plan, sym, label, names[0], lo, hi, lo_inc, hi_inc)
+                    for pred in consumed:
+                        if pred in pending:
+                            pending.remove(pred)
+                    used_label = label
+                    break
+            if scan is not None:
+                break
+            if indices.label.has(lid):
+                scan = Op.ScanAllByLabel(plan, sym, label)
+                used_label = label
+                break
+        if scan is None:
+            if node.labels:
+                scan = Op.ScanAllByLabel(plan, sym, node.labels[0])
+                used_label = node.labels[0]
+            else:
+                scan = Op.ScanAll(plan, sym)
+        bound.add(sym)
+        return self._apply_node_filters(node, scan, bound, pending,
+                                        used_label=used_label,
+                                        used_props=used_props)
+
+    def _apply_node_filters(self, node: A.NodePattern, plan, bound: set,
+                            pending, used_label=None, used_props=(),
+                            skip_scan_filters=True):
+        sym = node.variable
+        ident = A.Identifier(sym)
+        remaining_labels = [l for l in node.labels if l != used_label]
+        if remaining_labels:
+            plan = Op.Filter(plan, A.LabelsTest(ident, remaining_labels))
+        if isinstance(node.properties, dict):
+            for key, expr in node.properties.items():
+                if key in used_props:
+                    continue
+                plan = Op.Filter(plan, A.Binary(
+                    "=", A.PropertyLookup(ident, key), expr))
+        elif isinstance(node.properties, A.Parameter):
+            plan = Op.Filter(plan, _param_props_predicate(sym,
+                                                          node.properties))
+        # apply any pending predicate that is now fully bound
+        plan = self._apply_ready_predicates(plan, bound, pending)
+        return plan
+
+    def _apply_ready_predicates(self, plan, bound: set, pending):
+        ready = []
+        for pred in pending:
+            syms = expr_symbols(pred, set())
+            if syms and syms <= bound:
+                ready.append(pred)
+        for pred in ready:
+            pending.remove(pred)
+            plan = Op.Filter(plan, pred)
+        return plan
+
+    def _plan_expand(self, edge: A.EdgePattern, left_node, right_node,
+                     chain_dir, plan, bound: set, pending,
+                     edge_syms_in_match):
+        if chain_dir == "fwd":
+            from_node, to_node = left_node, right_node
+            direction = edge.direction
+        else:
+            from_node, to_node = right_node, left_node
+            direction = {"out": "in", "in": "out",
+                         "both": "both"}[edge.direction]
+        from_sym = from_node.variable
+        to_sym = to_node.variable
+        edge_sym = edge.variable
+
+        if edge.var_length:
+            min_h = edge.min_hops.value if edge.min_hops else 1
+            max_h = edge.max_hops.value if edge.max_hops else -1
+            plan = Op.ExpandVariable(plan, from_sym, edge_sym, to_sym,
+                                     direction, edge.types, min_h, max_h,
+                                     list(edge_syms_in_match))
+        else:
+            plan = Op.Expand(plan, from_sym, edge_sym, to_sym, direction,
+                             edge.types, list(edge_syms_in_match))
+        edge_syms_in_match.append(edge_sym)
+        newly_bound = to_sym not in bound
+        bound.add(edge_sym)
+        bound.add(to_sym)
+        # edge property filters
+        if isinstance(edge.properties, dict) and not edge.var_length:
+            ident = A.Identifier(edge_sym)
+            for key, expr in edge.properties.items():
+                plan = Op.Filter(plan, A.Binary(
+                    "=", A.PropertyLookup(ident, key), expr))
+        if newly_bound:
+            plan = self._apply_node_filters(to_node, plan, bound, pending)
+        else:
+            plan = self._apply_ready_predicates(plan, bound, pending)
+        return plan
+
+    # --- CREATE / MERGE -----------------------------------------------------
+
+    def plan_create(self, create: A.Create, plan, bound: set):
+        for pattern in create.patterns:
+            plan = self._plan_create_pattern(pattern, plan, bound)
+        return plan
+
+    def _plan_create_pattern(self, pattern: A.Pattern, plan, bound: set):
+        elements = pattern.elements
+        nodes = elements[0::2]
+        edges = elements[1::2]
+        for node in nodes:
+            node.variable = node.variable or _anon("node")
+        for edge in edges:
+            edge.variable = edge.variable or _anon("edge")
+
+        first = nodes[0]
+        if first.variable not in bound:
+            plan = Op.CreateNode(plan, first.variable, first.labels,
+                                 first.properties)
+            bound.add(first.variable)
+        for i, edge in enumerate(edges):
+            if edge.direction == "both":
+                raise SemanticException(
+                    "CREATE requires a directed relationship")
+            if not edge.types or len(edge.types) != 1:
+                raise SemanticException(
+                    "CREATE requires exactly one relationship type")
+            to_node = nodes[i + 1]
+            create_to = to_node.variable not in bound
+            plan = Op.CreateExpand(
+                plan, nodes[i].variable, edge.variable, to_node.variable,
+                edge.direction, edge.types[0], edge.properties,
+                create_to, to_node.labels, to_node.properties)
+            bound.add(edge.variable)
+            bound.add(to_node.variable)
+        if pattern.variable:
+            interleaved = []
+            for i in range(len(edges)):
+                interleaved.append(nodes[i].variable)
+                interleaved.append(edges[i].variable)
+            interleaved.append(nodes[-1].variable)
+            plan = Op.ConstructNamedPath(plan, pattern.variable, interleaved)
+            bound.add(pattern.variable)
+        return plan
+
+    def plan_merge(self, merge: A.Merge, plan, bound: set):
+        pattern = merge.pattern
+        # match side
+        match_bound = set(bound)
+        match_plan = self.plan_pattern(pattern, Op.Argument(), match_bound,
+                                       [], [])
+        for item in merge.on_match:
+            match_plan = self.plan_set_items([item], match_plan, match_bound)
+        # create side
+        create_bound = set(bound)
+        create_plan = self._plan_create_pattern(pattern, Op.Argument(),
+                                                create_bound)
+        for item in merge.on_create:
+            create_plan = self.plan_set_items([item], create_plan,
+                                              create_bound)
+        bound.update(match_bound | create_bound)
+        return Op.Merge(plan, match_plan, create_plan)
+
+    def plan_set_items(self, items, plan, bound: set):
+        for item in items:
+            if item.kind == "prop":
+                plan = Op.SetProperty(plan, item.target, item.value)
+            elif item.kind == "var_assign":
+                plan = Op.SetProperties(plan, item.target.name, item.value,
+                                        update=False)
+            elif item.kind == "var_update":
+                if not isinstance(item.target, A.Identifier):
+                    raise SemanticException("+= requires a variable target")
+                plan = Op.SetProperties(plan, item.target.name, item.value,
+                                        update=True)
+            elif item.kind == "label":
+                if not isinstance(item.target, A.Identifier):
+                    raise SemanticException("SET label requires a variable")
+                plan = Op.SetLabels(plan, item.target.name, item.value)
+            else:
+                raise SemanticException(f"unknown SET item {item.kind}")
+        return plan
+
+    def plan_remove(self, remove: A.Remove, plan):
+        for item in remove.items:
+            if item.kind == "prop":
+                plan = Op.RemoveProperty(plan, item.target)
+            else:
+                if not isinstance(item.target, A.Identifier):
+                    raise SemanticException("REMOVE label requires a variable")
+                plan = Op.RemoveLabels(plan, item.target.name, item.labels)
+        return plan
+
+    def plan_foreach(self, clause: A.Foreach, plan, bound: set):
+        sub_bound = set(bound) | {clause.variable}
+        update_plan: Op.LogicalOperator = Op.Argument()
+        for upd in clause.updates:
+            if isinstance(upd, A.Create):
+                update_plan = self.plan_create(upd, update_plan, sub_bound)
+            elif isinstance(upd, A.Merge):
+                update_plan = self.plan_merge(upd, update_plan, sub_bound)
+            elif isinstance(upd, A.SetClause):
+                update_plan = self.plan_set_items(upd.items, update_plan,
+                                                  sub_bound)
+            elif isinstance(upd, A.Remove):
+                update_plan = self.plan_remove(upd, update_plan)
+            elif isinstance(upd, A.Delete):
+                update_plan = Op.Delete(update_plan, upd.exprs, upd.detach)
+            elif isinstance(upd, A.Foreach):
+                update_plan = self.plan_foreach(upd, update_plan, sub_bound)
+            else:
+                raise SemanticException(
+                    "FOREACH allows only update clauses")
+        return Op.Foreach(plan, clause.variable, clause.expr, update_plan)
+
+    # --- CALL ---------------------------------------------------------------
+
+    def plan_call(self, clause: A.CallProcedure, plan, bound: set):
+        from ..procedures.registry import global_registry
+        proc = global_registry.find(clause.name)
+        if proc is None:
+            raise SemanticException(f"unknown procedure: {clause.name}")
+        if clause.yield_star or (not clause.yields):
+            fields = [f for f, _ in proc.results]
+            yields = [(f, None) for f in fields]
+        else:
+            yields = clause.yields
+        result_fields = [f for f, _ in yields]
+        output_symbols = [a or f for f, a in yields]
+        plan = Op.CallProcedureOp(plan, clause.name, clause.args,
+                                  result_fields, output_symbols)
+        bound.update(output_symbols)
+        if clause.where is not None:
+            plan = Op.Filter(plan, clause.where)
+        return plan
+
+    # --- RETURN / WITH ------------------------------------------------------
+
+    def plan_projection(self, body: A.ReturnBody, plan, bound: set,
+                        has_update: bool, is_with: bool,
+                        where: Optional[A.Expr] = None):
+        items: list[tuple[A.Expr, str]] = []
+        if body.star:
+            for sym in sorted(s for s in bound if not s.startswith("__")):
+                items.append((A.Identifier(sym), sym))
+        for expr, alias in body.items:
+            name = alias or _expr_name(expr)
+            items.append((expr, name))
+        columns = [name for _, name in items]
+        if len(set(columns)) != len(columns):
+            raise SemanticException("duplicate column names in projection")
+
+        # aggregation split
+        agg_specs = []
+        group_items: list[tuple[A.Expr, str]] = []
+        final_items: list[tuple[A.Expr, str]] = []
+        any_agg = False
+        for expr, name in items:
+            aggs: list = []
+            collect_aggregations(expr, aggs)
+            if aggs:
+                any_agg = True
+        if any_agg:
+            rewritten = []
+            for expr, name in items:
+                aggs = []
+                collect_aggregations(expr, aggs)
+                if not aggs:
+                    group_items.append((expr, name))
+                    rewritten.append((A.Identifier(name), name))
+                else:
+                    new_expr = self._rewrite_aggs(expr, agg_specs)
+                    rewritten.append((new_expr, name))
+            final_items = rewritten
+        if has_update:
+            plan = Op.Accumulate(plan)
+
+        if any_agg:
+            group_named = [(e, n) for (e, n) in group_items]
+            remember = sorted(bound)
+            plan = Op.Aggregate(plan, group_named, agg_specs, remember=[])
+            inner_items = final_items
+        else:
+            inner_items = items
+
+        if body.order_by or body.skip is not None or body.limit is not None \
+                or body.distinct or is_with or where is not None or True:
+            plan = Op.Produce(plan, inner_items)
+        if body.distinct:
+            plan = Op.Distinct(plan, columns)
+        if body.order_by:
+            plan = Op.OrderBy(plan, [(s.expr, s.ascending)
+                                     for s in body.order_by])
+        if body.skip is not None:
+            plan = Op.Skip(plan, body.skip)
+        if body.limit is not None:
+            plan = Op.Limit(plan, body.limit)
+        if where is not None:
+            plan = Op.Filter(plan, where)
+        return plan, columns
+
+    def _rewrite_aggs(self, expr: A.Expr, agg_specs: list) -> A.Expr:
+        if isinstance(expr, A.CountStar):
+            name = _anon("agg")
+            agg_specs.append(("count", None, False, name))
+            return A.Identifier(name)
+        if isinstance(expr, A.FunctionCall) and \
+                expr.name in Op.AGGREGATE_FUNCTIONS:
+            name = _anon("agg")
+            arg = expr.args[0] if expr.args else None
+            agg_specs.append((expr.name, arg, expr.distinct, name))
+            return A.Identifier(name)
+        # rebuild children
+        import copy
+        clone = copy.copy(expr)
+        if isinstance(expr, A.Unary):
+            clone.expr = self._rewrite_aggs(expr.expr, agg_specs)
+        elif isinstance(expr, A.Binary):
+            clone.left = self._rewrite_aggs(expr.left, agg_specs)
+            clone.right = self._rewrite_aggs(expr.right, agg_specs)
+        elif isinstance(expr, A.FunctionCall):
+            clone.args = [self._rewrite_aggs(a, agg_specs) for a in expr.args]
+        elif isinstance(expr, A.PropertyLookup):
+            clone.expr = self._rewrite_aggs(expr.expr, agg_specs)
+        elif isinstance(expr, A.ListLiteral):
+            clone.items = [self._rewrite_aggs(a, agg_specs)
+                           for a in expr.items]
+        elif isinstance(expr, A.MapLiteral):
+            clone.items = {k: self._rewrite_aggs(v, agg_specs)
+                           for k, v in expr.items.items()}
+        return clone
+
+
+def _flip(op: str) -> str:
+    return {"=": "=", "<": ">", ">": "<", "<=": ">=", ">=": "<="}[op]
+
+
+def _expr_name(expr: A.Expr) -> str:
+    if isinstance(expr, A.Identifier):
+        return expr.name
+    if isinstance(expr, A.PropertyLookup):
+        return f"{_expr_name(expr.expr)}.{expr.prop}"
+    if isinstance(expr, A.CountStar):
+        return "count(*)"
+    if isinstance(expr, A.FunctionCall):
+        return f"{expr.name}({', '.join(_expr_name(a) for a in expr.args)})"
+    if isinstance(expr, A.Literal):
+        return repr(expr.value)
+    if isinstance(expr, A.Parameter):
+        return f"${expr.name}"
+    return "expression"
+
+
+def _param_props_predicate(sym: str, param: A.Parameter) -> A.Expr:
+    # n matches {k: v, ...} parameter map: all entries equal
+    # implemented as a function-less AND chain at eval time via a custom
+    # expression — reuse quantifier over keys is overkill; build Binary AND
+    # over map items is impossible without knowing keys, so compare maps:
+    # properties(n) "contains" param — evaluate as subset via ALL quantifier.
+    return A.Quantifier(
+        "ALL", "__k__",
+        A.FunctionCall("keys", [param]),
+        A.Binary("=",
+                 A.Subscript(A.Identifier(sym), A.Identifier("__k__")),
+                 A.Subscript(param, A.Identifier("__k__"))))

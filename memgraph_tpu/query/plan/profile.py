@@ -1,0 +1,106 @@
+"""PROFILE instrumentation: per-operator pull counts and timings.
+
+Counterpart of the reference's ScopedProfile/ProfilingStats
+(/root/reference/src/query/plan/profile.cpp, scoped_profile.hpp): every
+operator cursor is wrapped with a counter + timer; results render as the
+profile tree (OPERATOR, ACTUAL HITS, RELATIVE TIME, ABSOLUTE TIME).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+
+from .operators import LogicalOperator
+
+
+class ProfileCollector:
+    def __init__(self) -> None:
+        self.stats: dict[int, dict] = {}
+
+    def entry(self, op_id: int, name: str) -> dict:
+        if op_id not in self.stats:
+            self.stats[op_id] = {"name": name, "hits": 0, "time": 0.0}
+        return self.stats[op_id]
+
+
+class ProfiledOp(LogicalOperator):
+    def __init__(self, inner: LogicalOperator, collector: ProfileCollector):
+        self.inner = inner
+        self.collector = collector
+        self.input = getattr(inner, "input", None)
+
+    def name(self) -> str:
+        return self.inner.name()
+
+    def children(self):
+        return self.inner.children()
+
+    def cursor(self, ctx):
+        entry = self.collector.entry(id(self.inner), self.inner.name())
+        it = self.inner.cursor(ctx)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                frame = next(it)
+            except StopIteration:
+                entry["time"] += time.perf_counter() - t0
+                return
+            entry["time"] += time.perf_counter() - t0
+            entry["hits"] += 1
+            yield frame
+
+
+def attach_profiling(plan: LogicalOperator):
+    """Deep-copy the plan and wrap every operator. Returns (plan, collector).
+
+    Self-time accounting: the wrapper measures inclusive time; rendering
+    subtracts children's inclusive time to show self time.
+    """
+    collector = ProfileCollector()
+    plan = copy.deepcopy(plan)
+
+    def wrap(op):
+        if op is None:
+            return None
+        for attr in ("input", "subplan", "match_plan", "create_plan",
+                     "update_plan", "left", "right"):
+            child = getattr(op, attr, None)
+            if isinstance(child, LogicalOperator):
+                setattr(op, attr, wrap(child))
+        return ProfiledOp(op, collector)
+
+    return wrap(plan), collector
+
+
+def profile_rows(plan, collector: ProfileCollector, total_time: float):
+    """Render the profile tree as rows."""
+    def walk(op, depth):
+        if isinstance(op, ProfiledOp):
+            inner = op.inner
+        else:
+            inner = op
+        stats = collector.stats.get(id(inner),
+                                    {"name": inner.name(), "hits": 0,
+                                     "time": 0.0})
+        child_time = 0.0
+        children = []
+        for attr in ("input", "subplan", "match_plan", "create_plan",
+                     "update_plan", "left", "right"):
+            child = getattr(inner, attr, None)
+            if isinstance(child, LogicalOperator):
+                children.append(child)
+        for child in children:
+            cin = child.inner if isinstance(child, ProfiledOp) else child
+            cstats = collector.stats.get(id(cin))
+            if cstats:
+                child_time += cstats["time"]
+        self_time = max(stats["time"] - child_time, 0.0)
+        rel = (self_time / total_time * 100.0) if total_time > 0 else 0.0
+        indent = "| " * depth
+        yield [f"{indent}* {stats['name']}", stats["hits"],
+               f"{rel:.6f} %", f"{self_time * 1000:.6f} ms"]
+        for child in children:
+            yield from walk(child, depth + 1)
+
+    yield from walk(plan, 0)

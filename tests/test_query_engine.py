@@ -1,0 +1,462 @@
+"""End-to-end Cypher tests (the gql_behave-style conformance slice).
+
+Modeled on the reference's query test strategy (tests/gql_behave +
+tests/unit/query_plan*): every test drives full text → parse → plan →
+execute → rows.
+"""
+
+import pytest
+
+from memgraph_tpu.exceptions import SemanticException, SyntaxException
+from memgraph_tpu.query import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    storage = InMemoryStorage()
+    ictx = InterpreterContext(storage)
+    return ictx
+
+
+def run(ictx, query, params=None):
+    interp = Interpreter(ictx)
+    cols, rows, summary = interp.execute(query, params)
+    return cols, rows
+
+
+def seed_people(ictx):
+    run(ictx, """CREATE (a:Person {name: 'alice', age: 34}),
+                        (b:Person {name: 'bob', age: 27}),
+                        (c:Person {name: 'carol', age: 41}),
+                        (d:Person:Admin {name: 'dave', age: 27}),
+                        (a)-[:KNOWS {since: 2010}]->(b),
+                        (b)-[:KNOWS {since: 2015}]->(c),
+                        (c)-[:KNOWS {since: 2020}]->(a),
+                        (d)-[:MANAGES]->(a)""")
+
+
+# --- basics ------------------------------------------------------------------
+
+def test_create_and_count(db):
+    cols, rows = run(db, "CREATE (n:Thing) RETURN n")
+    assert cols == ["n"]
+    assert len(rows) == 1
+    cols, rows = run(db, "MATCH (n) RETURN count(n)")
+    assert rows == [[1]]
+
+
+def test_return_literal_expressions(db):
+    cols, rows = run(db, "RETURN 1 + 2 AS x, 'a' + 'b' AS s, 3 * 2.5 AS f")
+    assert rows == [[3, "ab", 7.5]]
+
+
+def test_match_where_property(db):
+    seed_people(db)
+    cols, rows = run(db, "MATCH (n:Person) WHERE n.age > 30 "
+                         "RETURN n.name ORDER BY n.name")
+    assert [r[0] for r in rows] == ["alice", "carol"]
+
+
+def test_pattern_property_match(db):
+    seed_people(db)
+    cols, rows = run(db, "MATCH (n:Person {age: 27}) RETURN n.name "
+                         "ORDER BY n.name")
+    assert [r[0] for r in rows] == ["bob", "dave"]
+
+
+def test_multiple_labels(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person:Admin) RETURN n.name")
+    assert [r[0] for r in rows] == ["dave"]
+
+
+def test_expand(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (a:Person {name: 'alice'})-[:KNOWS]->(b) "
+                      "RETURN b.name")
+    assert [r[0] for r in rows] == ["bob"]
+    _, rows = run(db, "MATCH (a)-[:KNOWS]->(b {name: 'alice'}) RETURN a.name")
+    assert [r[0] for r in rows] == ["carol"]
+    _, rows = run(db, "MATCH (a {name: 'alice'})-[r]-(b) "
+                      "RETURN b.name ORDER BY b.name")
+    assert [r[0] for r in rows] == ["bob", "carol", "dave"]
+
+
+def test_edge_property_access(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (:Person {name:'alice'})-[r:KNOWS]->() "
+                      "RETURN r.since")
+    assert rows == [[2010]]
+
+
+def test_var_length_path(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (a {name:'alice'})-[:KNOWS*1..2]->(b) "
+                      "RETURN b.name ORDER BY b.name")
+    assert [r[0] for r in rows] == ["bob", "carol"]
+    _, rows = run(db, "MATCH (a {name:'alice'})-[:KNOWS*]->(b) "
+                      "RETURN DISTINCT b.name ORDER BY b.name")
+    assert [r[0] for r in rows] == ["alice", "bob", "carol"]
+
+
+def test_named_path(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH p = (a {name:'alice'})-[:KNOWS]->(b) "
+                      "RETURN size(nodes(p)), length(p)")
+    assert rows == [[2, 1]]
+
+
+def test_aggregations(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) RETURN count(*), min(n.age), "
+                      "max(n.age), sum(n.age), avg(n.age)")
+    assert rows == [[4, 27, 41, 129, 129 / 4]]
+
+
+def test_collect_and_distinct_agg(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) RETURN collect(DISTINCT n.age) AS ages")
+    assert sorted(rows[0][0]) == [27, 34, 41]
+    _, rows = run(db, "MATCH (n:Person) RETURN count(DISTINCT n.age)")
+    assert rows == [[3]]
+
+
+def test_group_by(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) RETURN n.age AS age, count(*) AS c "
+                      "ORDER BY age")
+    assert rows == [[27, 2], [34, 1], [41, 1]]
+
+
+def test_order_skip_limit(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) RETURN n.name ORDER BY n.age DESC, "
+                      "n.name SKIP 1 LIMIT 2")
+    assert [r[0] for r in rows] == ["alice", "bob"]
+
+
+def test_with_chain(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) WITH n.age AS age, count(*) AS c "
+                      "WHERE c > 1 RETURN age, c")
+    assert rows == [[27, 2]]
+
+
+def test_unwind(db):
+    _, rows = run(db, "UNWIND [1, 2, 3] AS x RETURN x * 10 AS y")
+    assert [r[0] for r in rows] == [10, 20, 30]
+
+
+def test_unwind_nested(db):
+    _, rows = run(db, "UNWIND [[1, 2], [3]] AS l UNWIND l AS x RETURN x")
+    assert [r[0] for r in rows] == [1, 2, 3]
+
+
+def test_set_and_remove(db):
+    seed_people(db)
+    run(db, "MATCH (n {name: 'bob'}) SET n.age = 28, n:Verified")
+    _, rows = run(db, "MATCH (n:Verified) RETURN n.age")
+    assert rows == [[28]]
+    run(db, "MATCH (n {name: 'bob'}) REMOVE n.age, n:Verified")
+    _, rows = run(db, "MATCH (n {name: 'bob'}) RETURN n.age")
+    assert rows == [[None]]
+
+
+def test_set_plus_equals(db):
+    run(db, "CREATE (n:T {a: 1})")
+    run(db, "MATCH (n:T) SET n += {b: 2}")
+    _, rows = run(db, "MATCH (n:T) RETURN n.a, n.b")
+    assert rows == [[1, 2]]
+    run(db, "MATCH (n:T) SET n = {c: 3}")
+    _, rows = run(db, "MATCH (n:T) RETURN n.a, n.c")
+    assert rows == [[None, 3]]
+
+
+def test_delete(db):
+    seed_people(db)
+    run(db, "MATCH (n {name: 'dave'}) DETACH DELETE n")
+    _, rows = run(db, "MATCH (n:Person) RETURN count(n)")
+    assert rows == [[3]]
+
+
+def test_merge_match_and_create(db):
+    run(db, "MERGE (n:City {name: 'zagreb'})")
+    run(db, "MERGE (n:City {name: 'zagreb'})")
+    _, rows = run(db, "MATCH (n:City) RETURN count(n)")
+    assert rows == [[1]]
+
+
+def test_merge_on_create_on_match(db):
+    run(db, "MERGE (n:C {k: 1}) ON CREATE SET n.created = true "
+            "ON MATCH SET n.matched = true")
+    _, rows = run(db, "MATCH (n:C) RETURN n.created, n.matched")
+    assert rows == [[True, None]]
+    run(db, "MERGE (n:C {k: 1}) ON CREATE SET n.created2 = true "
+            "ON MATCH SET n.matched = true")
+    _, rows = run(db, "MATCH (n:C) RETURN n.created, n.matched, n.created2")
+    assert rows == [[True, True, None]]
+
+
+def test_merge_relationship(db):
+    seed_people(db)
+    run(db, "MATCH (a {name:'alice'}), (b {name:'bob'}) "
+            "MERGE (a)-[:KNOWS]->(b)")
+    _, rows = run(db, "MATCH (:Person {name:'alice'})-[r:KNOWS]->"
+                      "(:Person {name:'bob'}) RETURN count(r)")
+    assert rows == [[1]]
+
+
+def test_optional_match(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person {name: 'bob'}) "
+                      "OPTIONAL MATCH (n)-[:MANAGES]->(m) "
+                      "RETURN n.name, m")
+    assert rows == [["bob", None]]
+
+
+def test_optional_match_existing(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n {name: 'dave'}) "
+                      "OPTIONAL MATCH (n)-[:MANAGES]->(m) RETURN m.name")
+    assert rows == [["alice"]]
+
+
+def test_union(db):
+    _, rows = run(db, "RETURN 1 AS x UNION RETURN 2 AS x UNION RETURN 1 AS x")
+    assert sorted(r[0] for r in rows) == [1, 2]
+    _, rows = run(db, "RETURN 1 AS x UNION ALL RETURN 1 AS x")
+    assert [r[0] for r in rows] == [1, 1]
+
+
+def test_case_expression(db):
+    _, rows = run(db, "UNWIND [1, 2, 3] AS x RETURN CASE "
+                      "WHEN x = 1 THEN 'one' WHEN x = 2 THEN 'two' "
+                      "ELSE 'many' END AS w")
+    assert [r[0] for r in rows] == ["one", "two", "many"]
+    _, rows = run(db, "UNWIND [1, 2] AS x RETURN CASE x WHEN 1 THEN 'a' "
+                      "ELSE 'b' END AS w")
+    assert [r[0] for r in rows] == ["a", "b"]
+
+
+def test_list_comprehension(db):
+    _, rows = run(db, "RETURN [x IN range(1, 5) WHERE x % 2 = 1 | x * x] AS l")
+    assert rows == [[[1, 9, 25]]]
+
+
+def test_quantifiers(db):
+    _, rows = run(db, "RETURN all(x IN [1,2,3] WHERE x > 0) AS a, "
+                      "any(x IN [1,2,3] WHERE x > 2) AS b, "
+                      "none(x IN [1,2,3] WHERE x > 5) AS c, "
+                      "single(x IN [1,2,3] WHERE x = 2) AS d")
+    assert rows == [[True, True, True, True]]
+
+
+def test_reduce(db):
+    _, rows = run(db, "RETURN reduce(acc = 0, x IN [1,2,3,4] | acc + x) AS s")
+    assert rows == [[10]]
+
+
+def test_string_predicates(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) WHERE n.name STARTS WITH 'a' "
+                      "RETURN n.name")
+    assert [r[0] for r in rows] == ["alice"]
+    _, rows = run(db, "MATCH (n:Person) WHERE n.name CONTAINS 'aro' "
+                      "RETURN n.name")
+    assert [r[0] for r in rows] == ["carol"]
+    _, rows = run(db, "MATCH (n:Person) WHERE n.name =~ '.*e$' "
+                      "RETURN n.name ORDER BY n.name")
+    assert [r[0] for r in rows] == ["alice", "dave"]
+
+
+def test_null_semantics(db):
+    _, rows = run(db, "RETURN null = null AS a, null <> 1 AS b, "
+                      "null IS NULL AS c, 1 + null AS d, "
+                      "null AND false AS e, null OR true AS f")
+    assert rows == [[None, None, True, None, False, True]]
+
+
+def test_in_operator(db):
+    _, rows = run(db, "RETURN 2 IN [1, 2] AS a, 5 IN [1, 2] AS b, "
+                      "null IN [1] AS c, 1 IN [null, 1] AS d")
+    assert rows == [[True, False, None, True]]
+
+
+def test_parameters(db):
+    _, rows = run(db, "RETURN $x + 1 AS y", {"x": 41})
+    assert rows == [[42]]
+    run(db, "CREATE (n:P $props)", {"props": {"name": "zoe", "age": 5}})
+    _, rows = run(db, "MATCH (n:P {name: $name}) RETURN n.age",
+                  {"name": "zoe"})
+    assert rows == [[5]]
+
+
+def test_functions(db):
+    _, rows = run(db, "RETURN size([1,2,3]), toUpper('ab'), abs(-3), "
+                      "round(2.5), head([7,8]), last([7,8]), "
+                      "split('a,b', ','), coalesce(null, 'x')")
+    assert rows == [[3, "AB", 3, 3.0, 7, 8, ["a", "b"], "x"]]
+
+
+def test_id_labels_type_functions(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n {name:'dave'})-[r]->() "
+                      "RETURN labels(n), type(r)")
+    assert rows == [[["Person", "Admin"], "MANAGES"]]
+
+
+def test_exists_pattern(db):
+    seed_people(db)
+    _, rows = run(db, "MATCH (n:Person) WHERE exists((n)-[:MANAGES]->()) "
+                      "RETURN n.name")
+    assert [r[0] for r in rows] == ["dave"]
+
+
+def test_foreach(db):
+    run(db, "FOREACH (x IN [1, 2, 3] | CREATE (:F {v: x}))")
+    _, rows = run(db, "MATCH (n:F) RETURN n.v ORDER BY n.v")
+    assert [r[0] for r in rows] == [1, 2, 3]
+
+
+def test_relationship_uniqueness(db):
+    # a single edge must not be matched twice within one pattern
+    run(db, "CREATE (a:X)-[:R]->(b:X)")
+    _, rows = run(db, "MATCH (a)-[r1]->(b)<-[r2]-(c) RETURN count(*)")
+    assert rows == [[0]]
+
+
+def test_explain(db):
+    _, rows = run(db, "EXPLAIN MATCH (n:Person) RETURN n")
+    text = "\n".join(r[0] for r in rows)
+    assert "Produce" in text and "Scan" in text
+
+
+def test_profile(db):
+    seed_people(db)
+    cols, rows = run(db, "PROFILE MATCH (n:Person) RETURN n")
+    assert cols[0] == "OPERATOR"
+    assert any("Scan" in r[0] for r in rows)
+    hits = {r[0].strip("| *"): r[1] for r in rows}
+    assert any(h >= 4 for h in hits.values())
+
+
+def test_index_usage_and_show(db):
+    seed_people(db)
+    run(db, "CREATE INDEX ON :Person(age)")
+    _, rows = run(db, "SHOW INDEX INFO")
+    assert any(r[0] == "label+property" for r in rows)
+    # indexed equality scan
+    _, rows = run(db, "MATCH (n:Person) WHERE n.age = 27 "
+                      "RETURN n.name ORDER BY n.name")
+    assert [r[0] for r in rows] == ["bob", "dave"]
+    _, rows = run(db, "EXPLAIN MATCH (n:Person) WHERE n.age = 27 RETURN n")
+    text = "\n".join(r[0] for r in rows)
+    assert "ScanAllByLabelPropertyValue" in text
+    # range scan
+    _, rows = run(db, "EXPLAIN MATCH (n:Person) WHERE n.age > 30 RETURN n")
+    text = "\n".join(r[0] for r in rows)
+    assert "ScanAllByLabelPropertyRange" in text
+
+
+def test_constraints_via_cypher(db):
+    run(db, "CREATE CONSTRAINT ON (n:U) ASSERT n.email IS UNIQUE")
+    run(db, "CREATE (n:U {email: 'a@x'})")
+    from memgraph_tpu.exceptions import ConstraintViolation
+    with pytest.raises(ConstraintViolation):
+        run(db, "CREATE (n:U {email: 'a@x'})")
+    _, rows = run(db, "SHOW CONSTRAINT INFO")
+    assert rows and rows[0][0] == "unique"
+
+
+def test_explicit_transaction(db):
+    interp = Interpreter(db)
+    interp.execute("BEGIN")
+    interp.execute("CREATE (n:TxTest)")
+    # another session doesn't see it yet
+    other = Interpreter(db)
+    _, rows, _ = other.execute("MATCH (n:TxTest) RETURN count(n)")
+    assert rows == [[0]]
+    interp.execute("COMMIT")
+    _, rows, _ = other.execute("MATCH (n:TxTest) RETURN count(n)")
+    assert rows == [[1]]
+
+
+def test_explicit_rollback(db):
+    interp = Interpreter(db)
+    interp.execute("BEGIN")
+    interp.execute("CREATE (n:RbTest)")
+    interp.execute("ROLLBACK")
+    _, rows = run(db, "MATCH (n:RbTest) RETURN count(n)")
+    assert rows == [[0]]
+
+
+def test_storage_info(db):
+    seed_people(db)
+    _, rows = run(db, "SHOW STORAGE INFO")
+    info = {r[0]: r[1] for r in rows}
+    assert info["vertex_count"] == 4
+    assert info["edge_count"] == 4
+
+
+def test_syntax_error(db):
+    with pytest.raises(SyntaxException):
+        run(db, "MATCH (n RETURN n")
+
+
+def test_unbound_variable(db):
+    with pytest.raises(SemanticException):
+        run(db, "RETURN nonexistent_variable_xyz")
+
+
+def test_return_star(db):
+    seed_people(db)
+    cols, rows = run(db, "MATCH (n:Admin) RETURN *")
+    assert cols == ["n"]
+    assert len(rows) == 1
+
+
+def test_with_star(db):
+    _, rows = run(db, "UNWIND [1,2] AS x WITH *, x * 2 AS y RETURN x, y "
+                      "ORDER BY x")
+    assert rows == [[1, 2], [2, 4]]
+
+
+def test_distinct_rows(db):
+    _, rows = run(db, "UNWIND [1, 1, 2] AS x RETURN DISTINCT x")
+    assert sorted(r[0] for r in rows) == [1, 2]
+
+
+def test_chained_comparison(db):
+    _, rows = run(db, "UNWIND [1, 5, 9] AS x WITH x WHERE 1 < x <= 5 RETURN x")
+    assert [r[0] for r in rows] == [5]
+
+
+def test_pull_streaming(db):
+    seed_people(db)
+    interp = Interpreter(db)
+    prepared = interp.prepare("MATCH (n:Person) RETURN n.name")
+    rows1, has_more, _ = interp.pull(2)
+    assert len(rows1) == 2 and has_more
+    rows2, has_more, summary = interp.pull(-1)
+    assert len(rows2) == 2 and not has_more
+    assert "stats" in summary
+
+
+def test_call_procedure_mg(db):
+    _, rows = run(db, "CALL mg.procedures() YIELD name RETURN count(name)")
+    assert rows[0][0] > 5
+
+
+def test_temporal_values(db):
+    _, rows = run(db, "RETURN date('2024-02-29') + duration('P1D') AS d")
+    assert str(rows[0][0]) == "2024-03-01"
+    _, rows = run(db, "RETURN duration({days: 1, hours: 2}).hours AS h")
+    assert rows == [[2]]
+
+
+def test_point_values(db):
+    _, rows = run(db, "RETURN point({x: 0.0, y: 0.0}) AS p, "
+                      "point.distance(point({x: 0.0, y: 0.0}), "
+                      "point({x: 3.0, y: 4.0})) AS d")
+    assert rows[0][1] == 5.0
