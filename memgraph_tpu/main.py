@@ -1,0 +1,174 @@
+"""Process entry / composition root.
+
+Counterpart of /root/reference/src/memgraph.cpp main(): wires config,
+storage (with durability recovery), interpreter context, triggers, auth,
+query-module directory, Bolt server, monitoring endpoint, and ordered
+shutdown (snapshot-on-exit).
+
+Run:  python -m memgraph_tpu.main --bolt-port 7687 --data-directory /tmp/mg
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from .auth.auth import Auth
+from .query.interpreter import Interpreter, InterpreterContext
+from .server.bolt import BoltServer
+from .storage import InMemoryStorage, StorageConfig
+from .storage.common import IsolationLevel, StorageMode
+
+
+def build_config(argv=None) -> argparse.Namespace:
+    """~Flag surface of the reference's src/flags/ (the subset that exists)."""
+    p = argparse.ArgumentParser("memgraph_tpu")
+    p.add_argument("--bolt-address", default="0.0.0.0")
+    p.add_argument("--bolt-port", type=int, default=7687)
+    p.add_argument("--data-directory", default=None,
+                   help="durability directory (snapshots + WAL)")
+    p.add_argument("--storage-mode", default="IN_MEMORY_TRANSACTIONAL",
+                   choices=[m.value for m in StorageMode])
+    p.add_argument("--isolation-level", default="SNAPSHOT_ISOLATION",
+                   choices=[l.value for l in IsolationLevel])
+    p.add_argument("--storage-wal-enabled",
+                   action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--storage-snapshot-on-exit",
+                   action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--storage-recover-on-startup",
+                   action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--query-modules-directory", default=None)
+    p.add_argument("--auth-user-or-role-name-regex", default=".*")
+    p.add_argument("--monitoring-port", type=int, default=0,
+                   help="Prometheus metrics HTTP port (0 = disabled)")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--init-file", default=None,
+                   help="cypherl file executed on startup")
+    p.add_argument("--execution-timeout-sec", type=float, default=600.0)
+    return p.parse_args(argv)
+
+
+def build_database(args) -> InterpreterContext:
+    storage_config = StorageConfig(
+        storage_mode=StorageMode(args.storage_mode),
+        isolation_level=IsolationLevel(args.isolation_level),
+        durability_dir=args.data_directory,
+        wal_enabled=bool(args.storage_wal_enabled and args.data_directory),
+        snapshot_on_exit=args.storage_snapshot_on_exit,
+    )
+    storage = InMemoryStorage(storage_config)
+
+    if args.data_directory and args.storage_recover_on_startup:
+        from .storage.durability.recovery import recover
+        stats = recover(storage)
+        logging.info("recovery: %s", stats)
+    if storage_config.wal_enabled:
+        from .storage.durability.recovery import wire_durability
+        wire_durability(storage)
+
+    ictx = InterpreterContext(storage, {
+        "execution_timeout_sec": args.execution_timeout_sec,
+        "advertised_address": f"localhost:{args.bolt_port}",
+    })
+
+    # trigger store wiring (registers its commit hook)
+    from .query.triggers import global_trigger_store
+    global_trigger_store(ictx)
+
+    if args.query_modules_directory:
+        from .query.procedures.registry import global_registry
+        loaded = global_registry.load_directory(args.query_modules_directory)
+        logging.info("loaded query modules: %s", loaded)
+
+    if args.init_file:
+        interp = Interpreter(ictx)
+        with open(args.init_file) as f:
+            for statement in split_statements(f.read()):
+                interp.execute(statement)
+    return ictx
+
+
+def split_statements(text: str) -> list[str]:
+    """Split a cypherl stream on top-level ';' (string/comment-aware)."""
+    from .query.frontend.lexer import tokenize
+    out = []
+    start = 0
+    for tok in tokenize(text):
+        if tok.type == ";":
+            stmt = text[start:tok.pos].strip()
+            if stmt:
+                out.append(stmt)
+            start = tok.pos + 1
+    tail = text[start:].strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+async def serve(args, ictx) -> None:
+    auth_path = None
+    if args.data_directory:
+        import os
+        os.makedirs(args.data_directory, exist_ok=True)
+        auth_path = os.path.join(args.data_directory, "auth.json")
+    auth = Auth(auth_path)
+
+    server = BoltServer(ictx, args.bolt_address, args.bolt_port, auth)
+    await server.start()
+    logging.info("Bolt server listening on %s:%d", args.bolt_address,
+                 args.bolt_port)
+
+    monitoring = None
+    if args.monitoring_port:
+        from .observability.http import start_monitoring_server
+        monitoring = await start_monitoring_server(
+            args.bolt_address, args.monitoring_port, ictx)
+        logging.info("monitoring endpoint on :%d", args.monitoring_port)
+
+    stop = asyncio.Event()
+
+    def shutdown(*_):
+        stop.set()
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, shutdown)
+    await stop.wait()
+
+    logging.info("shutting down ...")
+    server._server.close()
+    if monitoring is not None:
+        monitoring.close()
+    if args.storage_snapshot_on_exit and args.data_directory:
+        from .storage.durability.snapshot import create_snapshot
+        create_snapshot(ictx.storage)
+        logging.info("exit snapshot written")
+
+
+def main(argv=None) -> int:
+    args = build_config(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # honor JAX_PLATFORMS even when a site hook pre-initialized jax with a
+    # different backend (e.g. the axon TPU plugin)
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            logging.exception("could not apply JAX_PLATFORMS")
+    ictx = build_database(args)
+    try:
+        asyncio.run(serve(args, ictx))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

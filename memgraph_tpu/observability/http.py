@@ -1,0 +1,45 @@
+"""Monitoring HTTP endpoint: Prometheus metrics + JSON status.
+
+Counterpart of the reference's metrics/monitoring servers
+(/root/reference/src/glue/PrometheusServerT.cpp, src/http_handlers/):
+GET /metrics → Prometheus text; GET /status → JSON storage info.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from .metrics import global_metrics
+
+
+async def start_monitoring_server(host: str, port: int, ictx):
+    async def handle(reader, writer):
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=5)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = request.split()[1].decode() if request.split() else "/"
+            if path.startswith("/metrics"):
+                body = global_metrics.prometheus_text()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                info = dict(ictx.storage.info())
+                info["running_queries"] = len(ictx.running_queries)
+                body = json.dumps(info)
+                ctype = "application/json"
+            payload = body.encode("utf-8")
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                + f"Content-Type: {ctype}\r\n".encode()
+                + f"Content-Length: {len(payload)}\r\n".encode()
+                + b"Connection: close\r\n\r\n" + payload)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host, port)

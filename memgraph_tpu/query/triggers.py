@@ -29,6 +29,7 @@ class TriggerStore:
         self.ictx = interpreter_context
         self._lock = threading.Lock()
         self._triggers: dict[str, Trigger] = {}
+        self._firing = threading.local()  # recursion guard
         interpreter_context.storage.on_commit_hooks.append(self._on_commit)
 
     def create(self, name, event, phase, statement) -> None:
@@ -55,6 +56,8 @@ class TriggerStore:
     # --- firing -------------------------------------------------------------
 
     def _on_commit(self, txn, commit_ts) -> None:
+        if getattr(self._firing, "active", False):
+            return  # changes made BY a trigger do not re-fire triggers
         with self._lock:
             triggers = list(self._triggers.values())
         if not triggers:
@@ -63,18 +66,22 @@ class TriggerStore:
         if context is None:
             return
         from .interpreter import Interpreter
-        for trig in triggers:
-            if not self._event_matches(trig.event, context):
-                continue
-            interp = Interpreter(self.ictx)
-            try:
-                interp.execute(trig.statement, parameters=context)
-            except Exception:
-                # AFTER-commit trigger failures must not corrupt the session;
-                # surfaced via logs (reference behavior: logged, not raised)
-                import logging
-                logging.getLogger(__name__).exception(
-                    "trigger %s failed", trig.name)
+        self._firing.active = True
+        try:
+            for trig in triggers:
+                if not self._event_matches(trig.event, context):
+                    continue
+                interp = Interpreter(self.ictx)
+                try:
+                    interp.execute(trig.statement, parameters=context)
+                except Exception:
+                    # AFTER-commit trigger failures must not corrupt the
+                    # session; logged (reference behavior)
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "trigger %s failed", trig.name)
+        finally:
+            self._firing.active = False
 
     def _build_context(self, txn):
         created_v, deleted_v, updated_v = [], [], []

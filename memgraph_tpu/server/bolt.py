@@ -1,0 +1,494 @@
+"""Bolt protocol server (asyncio).
+
+Counterpart of the reference's Bolt stack
+(/root/reference/src/communication/bolt/ — session state machine at
+bolt/v1/session.hpp:55, message handlers at bolt/v1/states/executing.hpp):
+handshake (versions 4.3/4.4/5.x), chunked message framing, HELLO/LOGON
+auth, RUN/PULL/DISCARD with qid-less streaming, BEGIN/COMMIT/ROLLBACK,
+RESET/GOODBYE, value conversion between the engine's Python values and
+PackStream structures (the glue/communication.cpp analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+
+from ..exceptions import MemgraphTpuError, QueryException
+from ..query.interpreter import Interpreter, InterpreterContext
+from ..query.values import Path
+from ..storage.storage import EdgeAccessor, VertexAccessor
+from ..utils.point import Point
+from ..utils.temporal import (Date, Duration, LocalDateTime, LocalTime,
+                              ZonedDateTime)
+from . import packstream as ps
+
+log = logging.getLogger(__name__)
+
+BOLT_MAGIC = b"\x60\x60\xB0\x17"
+# Bolt 5.x only: the value encoder emits v5 structures (element ids, UTC
+# datetimes); advertising 4.x would hand old drivers structures they can't
+# hydrate. Legacy 4.x encodings are a follow-up.
+SUPPORTED_VERSIONS = [(5, 2), (5, 1), (5, 0)]
+
+# message signatures
+M_HELLO = 0x01
+M_LOGON = 0x6A
+M_LOGOFF = 0x6B
+M_GOODBYE = 0x02
+M_RESET = 0x0F
+M_RUN = 0x10
+M_BEGIN = 0x11
+M_COMMIT = 0x12
+M_ROLLBACK = 0x13
+M_DISCARD = 0x2F
+M_PULL = 0x3F
+M_ROUTE = 0x66
+M_SUCCESS = 0x70
+M_RECORD = 0x71
+M_IGNORED = 0x7E
+M_FAILURE = 0x7F
+
+
+def value_to_bolt(v, storage, view):
+    """Engine value → PackStream-compatible value (glue/communication.cpp)."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [value_to_bolt(x, storage, view) for x in v]
+    if isinstance(v, dict):
+        return {k: value_to_bolt(x, storage, view) for k, x in v.items()}
+    if isinstance(v, VertexAccessor):
+        labels = [storage.label_mapper.id_to_name(l) for l in v.labels(view)]
+        props = {storage.property_mapper.id_to_name(k):
+                 value_to_bolt(val, storage, view)
+                 for k, val in v.properties(view).items()}
+        return ps.Structure(ps.S_NODE,
+                            [v.gid, labels, props, str(v.gid)])
+    if isinstance(v, EdgeAccessor):
+        props = {storage.property_mapper.id_to_name(k):
+                 value_to_bolt(val, storage, view)
+                 for k, val in v.properties(view).items()}
+        return ps.Structure(ps.S_RELATIONSHIP, [
+            v.gid, v.from_vertex().gid, v.to_vertex().gid,
+            storage.edge_type_mapper.id_to_name(v.edge_type), props,
+            str(v.gid), str(v.from_vertex().gid), str(v.to_vertex().gid)])
+    if isinstance(v, Path):
+        nodes = [value_to_bolt(n, storage, view) for n in v.vertices()]
+        edges = v.edges()
+        rels = []
+        for e in edges:
+            props = {storage.property_mapper.id_to_name(k):
+                     value_to_bolt(val, storage, view)
+                     for k, val in e.properties(view).items()}
+            rels.append(ps.Structure(ps.S_UNBOUND_RELATIONSHIP, [
+                e.gid, storage.edge_type_mapper.id_to_name(e.edge_type),
+                props, str(e.gid)]))
+        # index sequence: alternating rel index (1-based) and node index
+        seq = []
+        node_ids = [n.gid for n in v.vertices()]
+        for i, e in enumerate(edges):
+            rel_idx = i + 1
+            if e.from_vertex().gid == node_ids[i]:
+                seq.append(rel_idx)
+            else:
+                seq.append(-rel_idx)
+            seq.append(i + 1)
+        return ps.Structure(ps.S_PATH, [nodes, rels, seq])
+    if isinstance(v, Date):
+        return ps.Structure(ps.S_DATE, [v.d.toordinal() - 719163])  # epoch day
+    if isinstance(v, LocalTime):
+        return ps.Structure(ps.S_LOCAL_TIME, [v._micros() * 1000])
+    if isinstance(v, LocalDateTime):
+        micros = v.timestamp_micros()
+        return ps.Structure(ps.S_LOCAL_DATETIME,
+                            [micros // 1_000_000,
+                             (micros % 1_000_000) * 1000])
+    if isinstance(v, ZonedDateTime):
+        micros = v.timestamp_micros()
+        offset = int(v.dt.utcoffset().total_seconds()) if v.dt.utcoffset() \
+            else 0
+        return ps.Structure(ps.S_DATETIME,
+                            [micros // 1_000_000,
+                             (micros % 1_000_000) * 1000, offset])
+    if isinstance(v, Duration):
+        days, rem = divmod(v.micros, 86_400_000_000)
+        seconds, micros = divmod(rem, 1_000_000)
+        return ps.Structure(ps.S_DURATION,
+                            [0, days, seconds, micros * 1000])
+    if isinstance(v, Point):
+        if v.crs.dims == 2:
+            return ps.Structure(ps.S_POINT_2D, [v.crs.value, v.x, v.y])
+        return ps.Structure(ps.S_POINT_3D, [v.crs.value, v.x, v.y, v.z])
+    raise ps.PackStreamError(f"cannot convert {type(v)!r} to bolt")
+
+
+def bolt_to_value(v):
+    """PackStream input (parameters) → engine value."""
+    if isinstance(v, list):
+        return [bolt_to_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: bolt_to_value(x) for k, x in v.items()}
+    if isinstance(v, ps.Structure):
+        import datetime as dt
+        if v.tag == ps.S_DATE:
+            return Date(dt.date.fromordinal(v.fields[0] + 719163))
+        if v.tag == ps.S_LOCAL_TIME:
+            from ..utils.temporal import _micros_to_time
+            return LocalTime(_micros_to_time(v.fields[0] // 1000))
+        if v.tag == ps.S_LOCAL_DATETIME:
+            sec, nanos = v.fields
+            return LocalDateTime(dt.datetime(1970, 1, 1)
+                                 + dt.timedelta(seconds=sec,
+                                                microseconds=nanos // 1000))
+        if v.tag == ps.S_DURATION:
+            months, days, seconds, nanos = v.fields
+            return Duration.from_parts(days=months * 30 + days,
+                                       seconds=seconds,
+                                       microseconds=nanos // 1000)
+        if v.tag == ps.S_DATETIME:
+            sec, nanos, offset = v.fields
+            tz = dt.timezone(dt.timedelta(seconds=offset))
+            return ZonedDateTime(dt.datetime.fromtimestamp(
+                sec + nanos / 1e9, tz))
+        if v.tag == ps.S_DATETIME_ZONE_ID:
+            sec, nanos, zone = v.fields
+            base = dt.datetime.fromtimestamp(sec + nanos / 1e9,
+                                             dt.timezone.utc)
+            try:
+                from zoneinfo import ZoneInfo
+                base = base.astimezone(ZoneInfo(zone))
+            except Exception:
+                pass
+            return ZonedDateTime(base)
+        if v.tag == ps.S_TIME:
+            nanos, offset = v.fields
+            from ..utils.temporal import _micros_to_time
+            # offset-carrying time flattens to LocalTime (engine has no
+            # zoned-time type; matches reference behavior for TIME values)
+            return LocalTime(_micros_to_time(nanos // 1000))
+        if v.tag in (ps.S_POINT_2D, ps.S_POINT_3D):
+            from ..utils.point import CrsType
+            crs = CrsType(v.fields[0])
+            z = v.fields[3] if v.tag == ps.S_POINT_3D else None
+            return Point(v.fields[1], v.fields[2], z, crs)
+        raise ps.PackStreamError(
+            f"unsupported parameter structure 0x{v.tag:02X}")
+    return v
+
+
+class BoltSession:
+    """One connection: handshake → auth → message loop.
+
+    The reference's SessionHL analog (glue/SessionHL.hpp): bridges the wire
+    protocol to an Interpreter.
+    """
+
+    def __init__(self, reader, writer, interpreter_context, auth=None):
+        self.reader = reader
+        self.writer = writer
+        self.ictx = interpreter_context
+        self.auth = auth
+        self.interpreter = Interpreter(interpreter_context)
+        self.version: tuple[int, int] = (0, 0)
+        self.authenticated = False
+        self.failed = False  # FAILURE → ignore until RESET
+        self._prepared = None
+
+    # --- wire framing -------------------------------------------------------
+
+    async def _read_exact(self, n: int) -> bytes:
+        return await self.reader.readexactly(n)
+
+    async def read_message(self) -> bytes:
+        chunks = []
+        while True:
+            header = await self._read_exact(2)
+            size = struct.unpack(">H", header)[0]
+            if size == 0:
+                if chunks:
+                    return b"".join(chunks)
+                continue  # noop chunk (keep-alive)
+            chunks.append(await self._read_exact(size))
+
+    def write_message(self, data: bytes) -> None:
+        pos = 0
+        while pos < len(data):
+            chunk = data[pos:pos + 0xFFFF]
+            self.writer.write(struct.pack(">H", len(chunk)) + chunk)
+            pos += len(chunk)
+        self.writer.write(b"\x00\x00")
+
+    def send(self, signature: int, *fields) -> None:
+        self.write_message(ps.pack(ps.Structure(signature, list(fields))))
+
+    def send_success(self, metadata=None) -> None:
+        self.send(M_SUCCESS, metadata or {})
+
+    def send_failure(self, code: str, message: str) -> None:
+        self.failed = True
+        self.send(M_FAILURE, {"code": code, "message": message})
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def run(self) -> None:
+        try:
+            if not await self.handshake():
+                return
+            while True:
+                data = await self.read_message()
+                msg = ps.unpack(data)
+                if not isinstance(msg, ps.Structure):
+                    raise MemgraphTpuError("malformed bolt message")
+                if not await self.dispatch(msg):
+                    break
+                await self.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("bolt session crashed")
+        finally:
+            self.interpreter.abort()
+            self.writer.close()
+
+    async def drain(self):
+        await self.writer.drain()
+
+    async def handshake(self) -> bool:
+        magic = await self._read_exact(4)
+        if magic != BOLT_MAGIC:
+            return False
+        proposals = await self._read_exact(16)
+        chosen = (0, 0)
+        for i in range(4):
+            major = proposals[i * 4 + 3]
+            minor = proposals[i * 4 + 2]
+            rng = proposals[i * 4 + 1]
+            # a proposal (major, minor, range) offers minors
+            # [minor - range, minor]; pick the highest we support
+            for (maj, min_) in SUPPORTED_VERSIONS:
+                if maj == major and minor >= min_ >= minor - rng:
+                    chosen = (maj, min_)
+                    break
+            if chosen != (0, 0):
+                break
+        self.writer.write(bytes([0, 0, chosen[1], chosen[0]]))
+        await self.drain()
+        self.version = chosen
+        return chosen != (0, 0)
+
+    # --- dispatch -----------------------------------------------------------
+
+    async def dispatch(self, msg: ps.Structure) -> bool:
+        sig = msg.tag
+        if sig == M_GOODBYE:
+            return False
+        if sig == M_RESET:
+            self.failed = False
+            self.interpreter.abort()
+            self.interpreter = Interpreter(self.ictx)
+            self._prepared = None
+            self.send_success()
+            return True
+        if self.failed and sig not in (M_RESET, M_GOODBYE):
+            self.send(M_IGNORED)
+            return True
+        if not self.authenticated and sig not in (M_HELLO, M_LOGON):
+            self.send_failure(
+                "Memgraph.ClientError.Security.Unauthenticated",
+                "authentication required before other requests")
+            return True
+        try:
+            if sig == M_HELLO:
+                return self.on_hello(msg.fields[0] if msg.fields else {})
+            if sig == M_LOGON:
+                return self.on_logon(msg.fields[0] if msg.fields else {})
+            if sig == M_LOGOFF:
+                self.authenticated = False
+                self.send_success()
+                return True
+            if sig == M_RUN:
+                return self.on_run(*msg.fields)
+            if sig == M_PULL:
+                return self.on_pull(msg.fields[0] if msg.fields else {})
+            if sig == M_DISCARD:
+                return self.on_discard(msg.fields[0] if msg.fields else {})
+            if sig == M_BEGIN:
+                self.interpreter.execute("BEGIN")
+                self.send_success()
+                return True
+            if sig == M_COMMIT:
+                self.interpreter.execute("COMMIT")
+                self.send_success({"bookmark": "mg-bookmark"})
+                return True
+            if sig == M_ROLLBACK:
+                self.interpreter.execute("ROLLBACK")
+                self.send_success()
+                return True
+            if sig == M_ROUTE:
+                return self.on_route(msg.fields)
+            self.send_failure("Memgraph.ClientError.Request.Invalid",
+                              f"unsupported message 0x{sig:02X}")
+            return True
+        except MemgraphTpuError as e:
+            self.send_failure(self._error_code(e), str(e))
+            return True
+        except Exception as e:  # pragma: no cover - defensive
+            log.exception("error handling bolt message")
+            self.send_failure("Memgraph.DatabaseError.Generic.Unknown",
+                              str(e))
+            return True
+
+    @staticmethod
+    def _error_code(e: MemgraphTpuError) -> str:
+        from ..exceptions import (SemanticException, SyntaxException,
+                                  TransactionException)
+        if isinstance(e, SyntaxException):
+            return "Memgraph.ClientError.Statement.SyntaxError"
+        if isinstance(e, SemanticException):
+            return "Memgraph.ClientError.Statement.SemanticError"
+        if isinstance(e, TransactionException):
+            return "Memgraph.ClientError.Transaction.Invalid"
+        return "Memgraph.TransientError.General.Error"
+
+    # --- handlers -----------------------------------------------------------
+
+    def on_hello(self, extra: dict) -> bool:
+        if self.version >= (5, 1):
+            # auth arrives via LOGON; only an instance with no users defined
+            # may proceed unauthenticated
+            self.authenticated = (self.auth is None
+                                  or not self.auth.users())
+        else:
+            principal = extra.get("principal", "")
+            credentials = extra.get("credentials", "")
+            if self.auth is not None and not self.auth.authenticate(
+                    principal, credentials):
+                self.send_failure(
+                    "Memgraph.ClientError.Security.Unauthenticated",
+                    "authentication failure")
+                return True
+            self.authenticated = True
+        self.send_success({
+            "server": "Neo4j/5.2.0 compatible (memgraph-tpu)",
+            "connection_id": "bolt-1",
+        })
+        return True
+
+    def on_logon(self, auth_data: dict) -> bool:
+        principal = auth_data.get("principal", "")
+        credentials = auth_data.get("credentials", "")
+        if self.auth is not None and not self.auth.authenticate(
+                principal, credentials):
+            self.send_failure(
+                "Memgraph.ClientError.Security.Unauthenticated",
+                "authentication failure")
+            return True
+        self.authenticated = True
+        self.send_success()
+        return True
+
+    def on_run(self, query: str, parameters: dict = None,
+               extra: dict = None) -> bool:
+        parameters = {k: bolt_to_value(v)
+                      for k, v in (parameters or {}).items()}
+        prepared = self.interpreter.prepare(query, parameters)
+        self._prepared = prepared
+        self.send_success({"fields": prepared.columns, "t_first": 0,
+                           "qid": 0})
+        return True
+
+    def on_pull(self, extra: dict) -> bool:
+        n = extra.get("n", -1)
+        storage = self.ictx.storage
+        from ..storage.common import View
+        rows, has_more, summary = self.interpreter.pull(n)
+        for row in rows:
+            self.send(M_RECORD,
+                      [value_to_bolt(v, storage, View.NEW) for v in row])
+        meta = {"has_more": has_more}
+        if not has_more:
+            meta["t_last"] = 0
+            meta["type"] = self._prepared.summary_type if self._prepared \
+                else "r"
+            stats = summary.get("stats") if summary else None
+            if stats and any(stats.values()):
+                meta["stats"] = {k.replace("_", "-"): v
+                                 for k, v in stats.items() if v}
+        self.send_success(meta)
+        return True
+
+    def on_discard(self, extra: dict) -> bool:
+        self.interpreter.pull(-1)
+        self.send_success({"has_more": False})
+        return True
+
+    def on_route(self, fields) -> bool:
+        # single-instance routing table: this server serves all roles
+        addr = self.ictx.config.get("advertised_address", "localhost:7687")
+        self.send_success({"rt": {
+            "ttl": 300,
+            "db": "memgraph",
+            "servers": [
+                {"addresses": [addr], "role": "WRITE"},
+                {"addresses": [addr], "role": "READ"},
+                {"addresses": [addr], "role": "ROUTE"},
+            ],
+        }})
+        return True
+
+
+class BoltServer:
+    """Asyncio TCP server accepting Bolt sessions."""
+
+    def __init__(self, interpreter_context: InterpreterContext,
+                 host: str = "127.0.0.1", port: int = 7687, auth=None):
+        self.ictx = interpreter_context
+        self.host = host
+        self.port = port
+        self.auth = auth
+        self._server = None
+
+    async def _handle(self, reader, writer):
+        session = BoltSession(reader, writer, self.ictx, self.auth)
+        await session.run()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        return self._server
+
+    async def serve_forever(self):
+        await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def run_in_thread(self):
+        """Start the server on a background thread; returns (thread, loop).
+
+        Raises the underlying error (e.g. port in use) if startup fails.
+        """
+        import threading
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        startup_error: list = []
+
+        def runner():
+            asyncio.set_event_loop(loop)
+            try:
+                loop.run_until_complete(self.start())
+            except Exception as e:
+                startup_error.append(e)
+                started.set()
+                return
+            started.set()
+            loop.run_forever()
+
+        thread = threading.Thread(target=runner, daemon=True)
+        thread.start()
+        if not started.wait(timeout=10):
+            raise TimeoutError("bolt server failed to start within 10s")
+        if startup_error:
+            raise startup_error[0]
+        return thread, loop

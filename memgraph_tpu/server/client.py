@@ -1,0 +1,149 @@
+"""Minimal synchronous Bolt client.
+
+Counterpart of the reference's test/client bolt client
+(/root/reference/src/communication/bolt/client.cpp): handshake, HELLO/LOGON,
+RUN/PULL, explicit transactions. Used by the e2e tests and usable as a thin
+Python driver for the server.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from ..exceptions import MemgraphTpuError
+from . import packstream as ps
+from .bolt import (BOLT_MAGIC, M_BEGIN, M_COMMIT, M_GOODBYE, M_HELLO,
+                   M_LOGON, M_PULL, M_RECORD, M_RESET, M_ROLLBACK, M_RUN,
+                   M_SUCCESS, M_FAILURE, M_IGNORED)
+
+
+class BoltClientError(MemgraphTpuError):
+    def __init__(self, code, message):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class BoltClient:
+    def __init__(self, host="127.0.0.1", port=7687, username="",
+                 password="", timeout=30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._handshake()
+        self._hello(username, password)
+
+    # --- wire ---------------------------------------------------------------
+
+    def _handshake(self):
+        # propose 5.2, 5.0, 4.4, 4.3
+        proposals = b""
+        for (maj, minor) in ((5, 2), (5, 0), (4, 4), (4, 3)):
+            proposals += bytes([0, 0, minor, maj])
+        self.sock.sendall(BOLT_MAGIC + proposals)
+        chosen = self._recv_exact(4)
+        self.version = (chosen[3], chosen[2])
+        if self.version == (0, 0):
+            raise MemgraphTpuError("bolt version negotiation failed")
+
+    def _recv_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise MemgraphTpuError("connection closed")
+            out += chunk
+        return out
+
+    def _send_message(self, signature: int, *fields):
+        data = ps.pack(ps.Structure(signature, list(fields)))
+        msg = b""
+        pos = 0
+        while pos < len(data):
+            chunk = data[pos:pos + 0xFFFF]
+            msg += struct.pack(">H", len(chunk)) + chunk
+            pos += len(chunk)
+        self.sock.sendall(msg + b"\x00\x00")
+
+    def _read_message(self) -> ps.Structure:
+        chunks = []
+        while True:
+            size = struct.unpack(">H", self._recv_exact(2))[0]
+            if size == 0:
+                if chunks:
+                    return ps.unpack(b"".join(chunks))
+                continue
+            chunks.append(self._recv_exact(size))
+
+    def _expect_success(self) -> dict:
+        msg = self._read_message()
+        if msg.tag == M_SUCCESS:
+            return msg.fields[0] if msg.fields else {}
+        if msg.tag == M_FAILURE:
+            meta = msg.fields[0]
+            raise BoltClientError(meta.get("code", "?"),
+                                  meta.get("message", "?"))
+        if msg.tag == M_IGNORED:
+            raise MemgraphTpuError("request ignored (session failed state)")
+        raise MemgraphTpuError(f"unexpected message 0x{msg.tag:02X}")
+
+    # --- protocol -----------------------------------------------------------
+
+    def _hello(self, username, password):
+        extra = {"user_agent": "memgraph-tpu-client/0.1"}
+        if self.version < (5, 1):
+            extra.update({"scheme": "basic", "principal": username,
+                          "credentials": password})
+        self._send_message(M_HELLO, extra)
+        self._expect_success()
+        if self.version >= (5, 1):
+            self._send_message(M_LOGON, {"scheme": "basic",
+                                         "principal": username,
+                                         "credentials": password})
+            self._expect_success()
+
+    def execute(self, query: str, parameters: dict | None = None):
+        """Run a query, pull everything. Returns (columns, rows, summary)."""
+        self._send_message(M_RUN, query, parameters or {}, {})
+        meta = self._expect_success()
+        columns = meta.get("fields", [])
+        rows = []
+        while True:
+            self._send_message(M_PULL, {"n": 1000})
+            while True:
+                msg = self._read_message()
+                if msg.tag == M_RECORD:
+                    rows.append(msg.fields[0])
+                    continue
+                if msg.tag == M_SUCCESS:
+                    summary = msg.fields[0] if msg.fields else {}
+                    break
+                if msg.tag == M_FAILURE:
+                    m = msg.fields[0]
+                    raise BoltClientError(m.get("code", "?"),
+                                          m.get("message", "?"))
+                raise MemgraphTpuError(
+                    f"unexpected message 0x{msg.tag:02X}")
+            if not summary.get("has_more"):
+                return columns, rows, summary
+
+    def begin(self):
+        self._send_message(M_BEGIN, {})
+        self._expect_success()
+
+    def commit(self):
+        self._send_message(M_COMMIT)
+        self._expect_success()
+
+    def rollback(self):
+        self._send_message(M_ROLLBACK)
+        self._expect_success()
+
+    def reset(self):
+        self._send_message(M_RESET)
+        self._expect_success()
+
+    def close(self):
+        try:
+            self._send_message(M_GOODBYE)
+        except Exception:
+            pass
+        self.sock.close()

@@ -1,0 +1,220 @@
+"""PackStream serialization (Bolt's value format).
+
+Counterpart of the reference's Bolt encoder/decoder
+(/root/reference/src/communication/bolt/v1/encoder/, decoder/): the
+PackStream v2 wire format used by Bolt 4.x/5.x — ints, floats, strings,
+lists, maps, structs (Node/Relationship/Path/temporal/point), with the
+v5 element-id fields.
+"""
+
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+
+from ..exceptions import MemgraphTpuError
+
+
+class PackStreamError(MemgraphTpuError):
+    pass
+
+
+# struct tags
+S_NODE = 0x4E
+S_RELATIONSHIP = 0x52
+S_UNBOUND_RELATIONSHIP = 0x72
+S_PATH = 0x50
+S_DATE = 0x44
+S_TIME = 0x54
+S_LOCAL_TIME = 0x74
+S_DATETIME = 0x49          # v5 UTC datetime
+S_DATETIME_ZONE_ID = 0x69  # v5 UTC datetime w/ zone name
+S_LOCAL_DATETIME = 0x64
+S_DURATION = 0x45
+S_POINT_2D = 0x58
+S_POINT_3D = 0x59
+
+
+class Structure:
+    __slots__ = ("tag", "fields")
+
+    def __init__(self, tag: int, fields: list) -> None:
+        self.tag = tag
+        self.fields = fields
+
+    def __eq__(self, other):
+        return (isinstance(other, Structure) and other.tag == self.tag
+                and other.fields == self.fields)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Structure(0x{self.tag:02X}, {self.fields!r})"
+
+
+def pack(value, buf: BytesIO | None = None) -> bytes:
+    out = buf or BytesIO()
+    _pack(value, out)
+    return out.getvalue() if buf is None else b""
+
+
+def _pack(v, out: BytesIO) -> None:
+    if v is None:
+        out.write(b"\xC0")
+    elif v is True:
+        out.write(b"\xC3")
+    elif v is False:
+        out.write(b"\xC2")
+    elif isinstance(v, int):
+        _pack_int(v, out)
+    elif isinstance(v, float):
+        out.write(b"\xC1" + struct.pack(">d", v))
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        n = len(raw)
+        if n < 0x10:
+            out.write(bytes((0x80 | n,)))
+        elif n < 0x100:
+            out.write(b"\xD0" + bytes((n,)))
+        elif n < 0x10000:
+            out.write(b"\xD1" + struct.pack(">H", n))
+        else:
+            out.write(b"\xD2" + struct.pack(">I", n))
+        out.write(raw)
+    elif isinstance(v, bytes):
+        n = len(v)
+        if n < 0x100:
+            out.write(b"\xCC" + bytes((n,)))
+        elif n < 0x10000:
+            out.write(b"\xCD" + struct.pack(">H", n))
+        else:
+            out.write(b"\xCE" + struct.pack(">I", n))
+        out.write(v)
+    elif isinstance(v, (list, tuple)):
+        n = len(v)
+        if n < 0x10:
+            out.write(bytes((0x90 | n,)))
+        elif n < 0x100:
+            out.write(b"\xD4" + bytes((n,)))
+        elif n < 0x10000:
+            out.write(b"\xD5" + struct.pack(">H", n))
+        else:
+            out.write(b"\xD6" + struct.pack(">I", n))
+        for item in v:
+            _pack(item, out)
+    elif isinstance(v, dict):
+        n = len(v)
+        if n < 0x10:
+            out.write(bytes((0xA0 | n,)))
+        elif n < 0x100:
+            out.write(b"\xD8" + bytes((n,)))
+        elif n < 0x10000:
+            out.write(b"\xD9" + struct.pack(">H", n))
+        else:
+            out.write(b"\xDA" + struct.pack(">I", n))
+        for key, val in v.items():
+            _pack(str(key), out)
+            _pack(val, out)
+    elif isinstance(v, Structure):
+        n = len(v.fields)
+        out.write(bytes((0xB0 | n, v.tag)))
+        for f in v.fields:
+            _pack(f, out)
+    else:
+        raise PackStreamError(f"cannot pack {type(v)!r}")
+
+
+def _pack_int(v: int, out: BytesIO) -> None:
+    if -0x10 <= v < 0x80:
+        out.write(struct.pack(">b", v))
+    elif -0x80 <= v < 0x80:
+        out.write(b"\xC8" + struct.pack(">b", v))
+    elif -0x8000 <= v < 0x8000:
+        out.write(b"\xC9" + struct.pack(">h", v))
+    elif -0x80000000 <= v < 0x80000000:
+        out.write(b"\xCA" + struct.pack(">i", v))
+    elif -0x8000000000000000 <= v < 0x8000000000000000:
+        out.write(b"\xCB" + struct.pack(">q", v))
+    else:
+        raise PackStreamError(f"integer out of 64-bit range: {v}")
+
+
+class Unpacker:
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+
+    def _read(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise PackStreamError("unexpected end of data")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def unpack(self):
+        marker = self._read(1)[0]
+        if marker < 0x80:
+            return marker
+        if marker >= 0xF0:
+            return marker - 0x100
+        if 0x80 <= marker < 0x90:
+            return self._read(marker & 0x0F).decode("utf-8")
+        if 0x90 <= marker < 0xA0:
+            return [self.unpack() for _ in range(marker & 0x0F)]
+        if 0xA0 <= marker < 0xB0:
+            return {self.unpack(): self.unpack()
+                    for _ in range(marker & 0x0F)}
+        if 0xB0 <= marker < 0xC0:
+            n = marker & 0x0F
+            tag = self._read(1)[0]
+            return Structure(tag, [self.unpack() for _ in range(n)])
+        if marker == 0xC0:
+            return None
+        if marker == 0xC1:
+            return struct.unpack(">d", self._read(8))[0]
+        if marker == 0xC2:
+            return False
+        if marker == 0xC3:
+            return True
+        if marker == 0xC8:
+            return struct.unpack(">b", self._read(1))[0]
+        if marker == 0xC9:
+            return struct.unpack(">h", self._read(2))[0]
+        if marker == 0xCA:
+            return struct.unpack(">i", self._read(4))[0]
+        if marker == 0xCB:
+            return struct.unpack(">q", self._read(8))[0]
+        if marker == 0xCC:
+            return self._read(self._read(1)[0])
+        if marker == 0xCD:
+            return self._read(struct.unpack(">H", self._read(2))[0])
+        if marker == 0xCE:
+            return self._read(struct.unpack(">I", self._read(4))[0])
+        if marker == 0xD0:
+            return self._read(self._read(1)[0]).decode("utf-8")
+        if marker == 0xD1:
+            return self._read(struct.unpack(">H", self._read(2))[0]) \
+                .decode("utf-8")
+        if marker == 0xD2:
+            return self._read(struct.unpack(">I", self._read(4))[0]) \
+                .decode("utf-8")
+        if marker == 0xD4:
+            return [self.unpack() for _ in range(self._read(1)[0])]
+        if marker == 0xD5:
+            return [self.unpack()
+                    for _ in range(struct.unpack(">H", self._read(2))[0])]
+        if marker == 0xD6:
+            return [self.unpack()
+                    for _ in range(struct.unpack(">I", self._read(4))[0])]
+        if marker == 0xD8:
+            return {self.unpack(): self.unpack()
+                    for _ in range(self._read(1)[0])}
+        if marker == 0xD9:
+            return {self.unpack(): self.unpack()
+                    for _ in range(struct.unpack(">H", self._read(2))[0])}
+        if marker == 0xDA:
+            return {self.unpack(): self.unpack()
+                    for _ in range(struct.unpack(">I", self._read(4))[0])}
+        raise PackStreamError(f"unknown marker 0x{marker:02X}")
+
+
+def unpack(data: bytes):
+    return Unpacker(data).unpack()
