@@ -364,6 +364,16 @@ class TriggerQuery:
 
 
 @dataclass
+class ReplicationQuery:
+    action: str                 # set_role_main | set_role_replica |
+                                # register | drop | show_replicas | show_role
+    name: Optional[str] = None
+    mode: Optional[str] = None  # SYNC | ASYNC | STRICT_SYNC
+    address: Optional[str] = None
+    port: Optional[int] = None
+
+
+@dataclass
 class AuthQuery:
     action: str                     # create_user/drop_user/set_password/...
     user: Optional[str] = None

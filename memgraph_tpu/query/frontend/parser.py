@@ -136,6 +136,9 @@ class Parser:
             if nxt.is_kw("TRIGGER"):
                 self.advance(); self.advance()
                 return A.TriggerQuery("drop", name=self.name_token())
+            if nxt.is_kw("REPLICA"):
+                self.advance(); self.advance()
+                return A.ReplicationQuery("drop", name=self.name_token())
             if nxt.is_kw("USER"):
                 return self.parse_auth()
             self.error("unsupported DROP statement")
@@ -185,9 +188,13 @@ class Parser:
                 return self.parse_isolation_or_storage()
             if nxt.is_kw("STORAGE"):
                 return self.parse_isolation_or_storage()
+            if nxt.is_kw("REPLICATION"):
+                return self.parse_set_replication_role()
             if nxt.is_kw("PASSWORD"):
                 return self.parse_auth()
             return self.parse_cypher_query()
+        if self.at_kw("REGISTER"):
+            return self.parse_register_replica()
         return self.parse_cypher_query()
 
     def _colon_label(self) -> str:
@@ -307,7 +314,42 @@ class Parser:
         if self.accept_kw("SCHEMA"):
             self.expect_kw("INFO")
             return A.InfoQuery("schema")
+        if self.accept_kw("REPLICAS"):
+            return A.ReplicationQuery("show_replicas")
+        if self.accept_kw("REPLICATION"):
+            self.expect_kw("ROLE")
+            return A.ReplicationQuery("show_role")
         self.error("unsupported SHOW statement")
+
+    def parse_set_replication_role(self) -> A.ReplicationQuery:
+        self.expect_kw("SET")
+        self.expect_kw("REPLICATION")
+        self.expect_kw("ROLE")
+        self.expect_kw("TO")
+        if self.accept_kw("MAIN"):
+            return A.ReplicationQuery("set_role_main")
+        self.expect_kw("REPLICA")
+        port = 10000
+        if self.accept_kw("WITH"):
+            self.expect_kw("PORT")
+            port = self.expect(T.INT).value
+        return A.ReplicationQuery("set_role_replica", port=port)
+
+    def parse_register_replica(self) -> A.ReplicationQuery:
+        self.expect_kw("REGISTER")
+        self.expect_kw("REPLICA")
+        name = self.name_token()
+        mode = "SYNC"
+        if self.accept_kw("SYNC"):
+            mode = "SYNC"
+        elif self.accept_kw("ASYNC"):
+            mode = "ASYNC"
+        elif self.accept_kw("STRICT_SYNC"):
+            mode = "STRICT_SYNC"
+        self.expect_kw("TO")
+        addr = self.expect(T.STRING).value
+        return A.ReplicationQuery("register", name=name, mode=mode,
+                                  address=addr)
 
     def parse_isolation_or_storage(self):
         self.expect_kw("SET")

@@ -116,6 +116,11 @@ class Interpreter:
             return self._prepare_transaction(node)
         if isinstance(node, A.CypherQuery):
             return self._prepare_cypher(text, node, parameters)
+        if isinstance(node, (A.IndexQuery, A.ConstraintQuery,
+                             A.TriggerQuery, A.StorageModeQuery,
+                             A.AuthQuery)) and not (
+                isinstance(node, A.TriggerQuery) and node.action == "show"):
+            self._ensure_writable(type(node).__name__)
         if isinstance(node, A.IndexQuery):
             return self._prepare_generator(self._run_index_query(node),
                                            ["status"], "s")
@@ -154,8 +159,48 @@ class Interpreter:
             return self._prepare_trigger(node)
         if isinstance(node, A.AuthQuery):
             return self._prepare_auth(node)
+        if isinstance(node, A.ReplicationQuery):
+            return self._prepare_replication(node)
         raise SemanticException(
             f"unsupported query type {type(node).__name__}")
+
+    def _ensure_writable(self, what: str) -> None:
+        replication = getattr(self.ctx, "replication", None)
+        if replication is not None and replication.role == "replica":
+            raise QueryException(
+                f"{what} is forbidden on a REPLICA instance")
+
+    def _replication_state(self):
+        if getattr(self.ctx, "replication", None) is None:
+            from ..replication.main_role import ReplicationState
+            self.ctx.replication = ReplicationState(self.ctx.storage)
+        return self.ctx.replication
+
+    def _prepare_replication(self, node: A.ReplicationQuery) -> PreparedQuery:
+        from ..replication.main_role import ReplicationMode
+        state = self._replication_state()
+        if node.action == "set_role_main":
+            state.set_role_main()
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "set_role_replica":
+            state.set_role_replica("0.0.0.0", node.port)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "register":
+            state.register_replica(node.name, node.address,
+                                   ReplicationMode[node.mode])
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "drop":
+            state.drop_replica(node.name)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "show_replicas":
+            return self._prepare_generator(
+                iter(state.show_replicas()),
+                ["name", "socket_address", "sync_mode",
+                 "last_acked_timestamp", "state"], "r")
+        if node.action == "show_role":
+            return self._prepare_generator(
+                iter([[state.role]]), ["replication role"], "r")
+        raise SemanticException(f"unknown replication action {node.action}")
 
     def pull(self, n: int = -1) -> tuple[list[list], bool, dict]:
         """Pull up to n rows (n<0 = all). Returns (rows, has_more, summary)."""
@@ -244,6 +289,12 @@ class Interpreter:
             # strip the EXPLAIN/PROFILE keyword for plan-cache keying
             strip = strip.split(None, 1)[1] if " " in strip else strip
         plan, columns = self.ctx.cached_plan(strip, query)
+
+        replication = getattr(self.ctx, "replication", None)
+        if replication is not None and replication.role == "replica" \
+                and _plan_is_write(plan):
+            raise QueryException(
+                "write queries are forbidden on a REPLICA instance")
 
         if query.explain:
             rows = [[line] for line in plan_to_rows(plan)]
@@ -584,3 +635,30 @@ class Interpreter:
 def _chain_front(first_row, rest):
     yield first_row
     yield from rest
+
+
+def _plan_is_write(plan) -> bool:
+    from .plan import operators as Op
+    write_types = (Op.CreateNode, Op.CreateExpand, Op.SetProperty,
+                   Op.SetProperties, Op.SetLabels, Op.RemoveProperty,
+                   Op.RemoveLabels, Op.Delete, Op.Merge, Op.Foreach)
+    found = False
+
+    def walk(op):
+        nonlocal found
+        if op is None or found:
+            return
+        if isinstance(op, write_types):
+            found = True
+            return
+        if isinstance(op, Op.CallProcedureOp):
+            from .procedures.registry import global_registry
+            proc = global_registry.find(op.proc_name)
+            if proc is not None and proc.is_write:
+                found = True
+                return
+        for child in op.children():
+            walk(child)
+
+    walk(plan)
+    return found

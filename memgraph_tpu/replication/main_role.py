@@ -1,0 +1,337 @@
+"""MAIN-side replication: per-replica clients, modes, catch-up.
+
+Counterpart of the reference's replication client/handler
+(/root/reference/src/replication_handler/replication_handler.cpp,
+storage/v2/replication/): one connection per registered replica; commits
+ship as WAL frames. Modes (replication_coordination_glue/mode.hpp:22):
+
+  SYNC        — the committing thread waits for the replica's ack
+  ASYNC       — frames queue onto a background worker
+  STRICT_SYNC — like SYNC, and a failed ack marks the commit degraded
+                (full 2PC vote-before-visibility is the HA follow-up)
+
+Catch-up: on registration (or reconnect) the replica receives a full
+snapshot transfer, then live frames — the reference's snapshot→WAL
+catch-up ladder collapsed to its snapshot rung (recovery.hpp analog).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import queue
+import socket
+import threading
+
+from . import protocol as P
+
+log = logging.getLogger(__name__)
+
+
+class ReplicationMode(enum.Enum):
+    SYNC = "sync"
+    ASYNC = "async"
+    STRICT_SYNC = "strict_sync"
+
+
+class ReplicaStatus(enum.Enum):
+    READY = "ready"
+    REPLICATING = "replicating"
+    RECOVERY = "recovery"
+    INVALID = "invalid"
+
+
+class ReplicaClient:
+    def __init__(self, name: str, address: str, mode: ReplicationMode,
+                 storage):
+        from ..exceptions import QueryException
+        self.name = name
+        self.address = address
+        host, _, port = address.rpartition(":")
+        if not host or not port.isdigit():
+            raise QueryException(
+                f"replica address must be 'host:port', got {address!r}")
+        self._host, self._port = host, int(port)
+        self.mode = mode
+        self.storage = storage
+        self.status = ReplicaStatus.INVALID
+        self.last_acked_ts = 0
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[bytes]" = queue.Queue(maxsize=10_000)
+        self._worker: threading.Thread | None = None
+        self._stop = threading.Event()
+        # frames committed while catch-up is in flight buffer here; the
+        # replica dedups by commit_ts, so replay overlap is harmless
+        self._catchup_buffer: list[bytes] = []
+
+    # --- connection / catch-up ----------------------------------------------
+
+    def connect_and_catch_up(self) -> None:
+        self.status = ReplicaStatus.RECOVERY
+        sock = socket.create_connection((self._host, self._port), timeout=30)
+        P.send_json(sock, P.MSG_REGISTER,
+                    {"name": self.name, "epoch": "epoch-1",
+                     "main_commit_ts": self.storage.latest_commit_ts()})
+        msg_type, payload = P.recv_frame(sock)
+        if msg_type != P.MSG_REGISTER_OK:
+            sock.close()
+            raise ConnectionError("replica registration failed")
+        info = P.parse_json(payload)
+        self._sock = sock
+        # full state transfer (catch-up) when the replica is behind
+        if info.get("last_commit_ts", 0) < self.storage.latest_commit_ts():
+            snapshot_bytes = self._snapshot_bytes()
+            P.send_frame(sock, P.MSG_SNAPSHOT, snapshot_bytes)
+            msg_type, payload = P.recv_frame(sock)
+            if msg_type != P.MSG_ACK:
+                raise ConnectionError("snapshot transfer failed")
+            self.last_acked_ts = P.parse_json(payload)["last_commit_ts"]
+        # drain anything committed while catch-up ran, then go live; the
+        # status flip and the drain share the lock so no frame slips between
+        with self._lock:
+            buffered = self._catchup_buffer
+            self._catchup_buffer = []
+            for frame in buffered:
+                self._send_frame_locked(frame)
+            self.status = ReplicaStatus.READY
+        if self.mode is ReplicationMode.ASYNC:
+            self._worker = threading.Thread(target=self._drain_loop,
+                                            daemon=True)
+            self._worker.start()
+
+    def _snapshot_bytes(self) -> bytes:
+        import os
+        import tempfile
+        from ..storage.durability.snapshot import create_snapshot
+        if self.storage.config.durability_dir:
+            path = create_snapshot(self.storage)
+            with open(path, "rb") as f:
+                return f.read()
+        # no durability dir: snapshot into a temp dir
+        from ..storage.storage import StorageConfig
+        old = self.storage.config.durability_dir
+        with tempfile.TemporaryDirectory() as tmp:
+            self.storage.config.durability_dir = tmp
+            try:
+                path = create_snapshot(self.storage)
+                with open(path, "rb") as f:
+                    return f.read()
+            finally:
+                self.storage.config.durability_dir = old
+
+    # --- commit shipping ----------------------------------------------------
+
+    def ship(self, frame: bytes) -> bool:
+        """Ship one commit frame per the replica's mode. Returns success."""
+        if self.status is ReplicaStatus.INVALID:
+            return False
+        with self._lock:
+            if self.status is ReplicaStatus.RECOVERY:
+                self._catchup_buffer.append(frame)
+                return True
+        if self.mode is ReplicationMode.ASYNC:
+            try:
+                self._queue.put_nowait(frame)
+                return True
+            except queue.Full:
+                log.warning("replica %s queue full; marking invalid",
+                            self.name)
+                self.status = ReplicaStatus.INVALID
+                return False
+        return self._send_frame_sync(frame)
+
+    def _send_frame_sync(self, frame: bytes) -> bool:
+        with self._lock:
+            return self._send_frame_locked(frame)
+
+    def _send_frame_locked(self, frame: bytes) -> bool:
+        try:
+            P.send_frame(self._sock, P.MSG_WAL_FRAME, frame)
+            msg_type, payload = P.recv_frame(self._sock)
+            if msg_type == P.MSG_ACK:
+                self.last_acked_ts = P.parse_json(payload)["last_commit_ts"]
+                return True
+            self.status = ReplicaStatus.INVALID
+            return False
+        except (ConnectionError, OSError) as e:
+            log.warning("replica %s unreachable: %s", self.name, e)
+            self.status = ReplicaStatus.INVALID
+            return False
+
+    def _drain_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                frame = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self._send_frame_sync(frame)
+
+    def heartbeat(self) -> bool:
+        with self._lock:
+            try:
+                P.send_json(self._sock, P.MSG_HEARTBEAT,
+                            {"main_commit_ts":
+                             self.storage.latest_commit_ts()})
+                msg_type, payload = P.recv_frame(self._sock)
+                if msg_type == P.MSG_ACK:
+                    self.last_acked_ts = P.parse_json(
+                        payload)["last_commit_ts"]
+                    return True
+            except (ConnectionError, OSError):
+                pass
+            self.status = ReplicaStatus.INVALID
+            return False
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class ReplicationState:
+    """Role + replica registry, owned by the InterpreterContext.
+
+    Reference analog: ReplicationState + ReplicationHandler
+    (src/replication/state.hpp, replication_handler.cpp).
+    """
+
+    HEARTBEAT_INTERVAL_SEC = 2.0
+
+    def __init__(self, storage):
+        self.storage = storage
+        self.role = "main"
+        self.replicas: dict[str, ReplicaClient] = {}
+        self.replica_server = None
+        self._lock = threading.Lock()
+        self._consumer_registered = False
+        self._heartbeat_thread: threading.Thread | None = None
+        self._stop_heartbeat = threading.Event()
+
+    def _ensure_consumer(self) -> None:
+        # lazy: commits only pay frame encoding once a replica exists
+        if not self._consumer_registered:
+            self.storage.frame_consumers.append(self._on_commit_frame)
+            self._consumer_registered = True
+
+    def _maybe_remove_consumer(self) -> None:
+        if self._consumer_registered and not self.replicas:
+            try:
+                self.storage.frame_consumers.remove(self._on_commit_frame)
+            except ValueError:
+                pass
+            self._consumer_registered = False
+
+    # --- role management ----------------------------------------------------
+
+    def set_role_replica(self, host: str, port: int) -> None:
+        from ..exceptions import QueryException
+        from .replica import ReplicaServer
+        with self._lock:
+            for r in self.replicas.values():
+                r.close()
+            self.replicas.clear()
+            self._maybe_remove_consumer()
+            if self.replica_server is not None:
+                self.replica_server.stop()
+                self.replica_server = None
+            server = ReplicaServer(self.storage, host, port)
+            try:
+                server.start()
+            except OSError as e:
+                raise QueryException(
+                    f"cannot listen on {host}:{port}: {e}") from e
+            self.replica_server = server
+            self.role = "replica"
+
+    def set_role_main(self) -> None:
+        with self._lock:
+            if self.replica_server is not None:
+                self.replica_server.stop()
+                self.replica_server = None
+            self.role = "main"
+
+    # --- replica registry ---------------------------------------------------
+
+    def register_replica(self, name: str, address: str,
+                         mode: ReplicationMode) -> None:
+        from ..exceptions import QueryException
+        if self.role != "main":
+            raise QueryException("only MAIN can register replicas")
+        client = ReplicaClient(name, address, mode, self.storage)
+        with self._lock:
+            if name in self.replicas:
+                raise QueryException(f"replica {name!r} already registered")
+            # visible to the commit path BEFORE catch-up starts: frames
+            # committed during the snapshot transfer buffer on the client
+            # (status RECOVERY) and drain after it — no gap
+            self.replicas[name] = client
+            self._ensure_consumer()
+        try:
+            client.connect_and_catch_up()
+        except (ConnectionError, OSError, QueryException) as e:
+            with self._lock:
+                self.replicas.pop(name, None)
+                self._maybe_remove_consumer()
+            client.close()
+            raise QueryException(
+                f"cannot register replica {name!r}: {e}") from e
+        self._start_heartbeat()
+
+    def drop_replica(self, name: str) -> None:
+        from ..exceptions import QueryException
+        with self._lock:
+            client = self.replicas.pop(name, None)
+            self._maybe_remove_consumer()
+        if client is None:
+            raise QueryException(f"replica {name!r} is not registered")
+        client.close()
+
+    # --- liveness -----------------------------------------------------------
+
+    def _start_heartbeat(self) -> None:
+        if self._heartbeat_thread is not None:
+            return
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True)
+        self._heartbeat_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop_heartbeat.wait(self.HEARTBEAT_INTERVAL_SEC):
+            with self._lock:
+                clients = list(self.replicas.values())
+            for c in clients:
+                if c.status is ReplicaStatus.READY:
+                    c.heartbeat()
+
+    def show_replicas(self) -> list[list]:
+        rows = []
+        with self._lock:
+            clients = list(self.replicas.values())
+        for c in clients:
+            rows.append([c.name, c.address, c.mode.value,
+                         c.last_acked_ts, c.status.value])
+        return rows
+
+    # --- commit hook --------------------------------------------------------
+
+    def _on_commit_frame(self, frame: bytes, commit_ts: int) -> None:
+        if self.role != "main":
+            return
+        with self._lock:
+            clients = list(self.replicas.values())
+        if not clients:
+            return
+        for c in clients:
+            ok = c.ship(frame)
+            if not ok and c.mode in (ReplicationMode.SYNC,
+                                     ReplicationMode.STRICT_SYNC):
+                # the commit is already locally visible — raising here could
+                # only corrupt the session; the replica is marked INVALID and
+                # surfaces through SHOW REPLICAS (full 2PC vote-before-
+                # visibility is the STRICT_SYNC follow-up)
+                log.error("replica %s (%s) failed to confirm commit %d",
+                          c.name, c.mode.value, commit_ts)

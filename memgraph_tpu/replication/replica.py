@@ -1,0 +1,133 @@
+"""Replica-side replication server.
+
+Counterpart of the reference's replica handlers
+(/root/reference/src/dbms/replication_handlers.cpp): accepts a MAIN's
+registration, ingests a full snapshot transfer for catch-up, then applies
+WAL transaction frames in commit order. Applies bypass MVCC (the replica's
+state is always a prefix of MAIN's committed history) — the same direct-
+apply model the reference uses on replicas.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+
+from ..storage.durability import wal as W
+from ..storage.durability.recovery import _apply_wal_txn
+from . import protocol as P
+
+log = logging.getLogger(__name__)
+
+
+class ReplicaServer:
+    """Listens for the MAIN; applies snapshot + WAL frames to storage."""
+
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 10000):
+        self.storage = storage
+        self.host = host
+        self.port = port
+        self.last_commit_ts = 0
+        self.epoch = None
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._apply_lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(4)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(target=self._serve_main, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_main(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg_type, payload = P.recv_frame(conn)
+                if msg_type == P.MSG_REGISTER:
+                    info = P.parse_json(payload)
+                    self.epoch = info.get("epoch")
+                    P.send_json(conn, P.MSG_REGISTER_OK,
+                                {"last_commit_ts": self.last_commit_ts,
+                                 "epoch": self.epoch})
+                elif msg_type == P.MSG_SNAPSHOT:
+                    self._apply_snapshot_bytes(payload)
+                    P.send_json(conn, P.MSG_ACK,
+                                {"last_commit_ts": self.last_commit_ts})
+                elif msg_type == P.MSG_WAL_FRAME:
+                    self._apply_wal_frame(payload)
+                    P.send_json(conn, P.MSG_ACK,
+                                {"last_commit_ts": self.last_commit_ts})
+                elif msg_type == P.MSG_HEARTBEAT:
+                    P.send_json(conn, P.MSG_ACK,
+                                {"last_commit_ts": self.last_commit_ts})
+                else:
+                    P.send_json(conn, P.MSG_ERROR,
+                                {"message": f"unknown message {msg_type}"})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # --- appliers -----------------------------------------------------------
+
+    def _apply_snapshot_bytes(self, data: bytes) -> None:
+        import os
+        import tempfile
+        from ..storage.durability.recovery import (_apply_snapshot,
+                                                   _clear_storage)
+        from ..storage.durability.snapshot import load_snapshot
+        with self._apply_lock:
+            with tempfile.NamedTemporaryFile(delete=False,
+                                             suffix=".mgsnap") as f:
+                f.write(data)
+                path = f.name
+            try:
+                parsed = load_snapshot(path)
+                _clear_storage(self.storage)
+                _apply_snapshot(self.storage, parsed)
+                self.last_commit_ts = parsed["timestamp"]
+                self.storage._bump_topology()
+            finally:
+                os.unlink(path)
+
+    def _apply_wal_frame(self, frame: bytes) -> None:
+        with self._apply_lock:
+            for commit_ts, ops in W.iter_txns_from_bytes(frame):
+                if commit_ts <= self.last_commit_ts:
+                    continue  # duplicate delivery (idempotent)
+                _apply_wal_txn(self.storage, ops)
+                self.last_commit_ts = commit_ts
+                self.storage._timestamp = max(self.storage._timestamp,
+                                              commit_ts)
+            self.storage._bump_topology()

@@ -152,11 +152,10 @@ class WalFile:
         self.sync_every_commit = sync_every_commit
         self.storage = storage
 
-    def sink(self, txn, commit_ts: int) -> None:
-        """storage.wal_sink hook (called under the engine lock)."""
-        data = encode_txn_ops(self.storage, txn, commit_ts)
+    def sink(self, frame: bytes, commit_ts: int) -> None:
+        """storage.wal_sink hook: frame pre-encoded under the engine lock."""
         with self._lock:
-            self._file.write(data)
+            self._file.write(frame)
             self._file.flush()
             if self.sync_every_commit:
                 os.fsync(self._file.fileno())
@@ -166,10 +165,8 @@ class WalFile:
             self._file.close()
 
 
-def iter_wal_records(path: str):
+def iter_records_from_bytes(data: bytes):
     """Yield (kind, payload_bytes) frames; tolerates a truncated tail."""
-    with open(path, "rb") as f:
-        data = f.read()
     pos = 0
     n = len(data)
     while pos + 5 <= n:
@@ -182,12 +179,17 @@ def iter_wal_records(path: str):
         pos = start + payload_len
 
 
-def iter_wal_transactions(path: str):
+def iter_wal_records(path: str):
+    with open(path, "rb") as f:
+        yield from iter_records_from_bytes(f.read())
+
+
+def iter_txns_from_bytes(data: bytes):
     """Group frames into (commit_ts, [(kind, payload)]) transactions.
     Incomplete transactions (no TXN_END) are discarded."""
     current_ts = None
     ops = []
-    for kind, payload in iter_wal_records(path):
+    for kind, payload in iter_records_from_bytes(data):
         if kind == OP_TXN_BEGIN:
             current_ts = _read_varint(BytesIO(payload))
             ops = []
@@ -200,6 +202,11 @@ def iter_wal_transactions(path: str):
         else:
             if current_ts is not None:
                 ops.append((kind, payload))
+
+
+def iter_wal_transactions(path: str):
+    with open(path, "rb") as f:
+        yield from iter_txns_from_bytes(f.read())
 
 
 def list_wal_files(storage) -> list[str]:

@@ -635,10 +635,21 @@ class InMemoryStorage:
         self._next_txn_id = TRANSACTION_ID_START + 1
         self._engine_lock = threading.Lock()
         self._active_txns: dict[int, Transaction] = {}
+        # frame shipping order: sequence assigned under the engine lock,
+        # consumers invoked strictly in sequence order (replicas must see
+        # commits in commit-timestamp order)
+        self._ship_cond = threading.Condition()
+        self._next_ship_seq = 0
+        self._frame_seq = 0
 
         self._topology_version = 0
-        self.wal_sink: Optional[Callable] = None  # set by durability wiring
-        self.on_commit_hooks: list[Callable] = []  # triggers, replication
+        # durability wiring: receives (frame_bytes, commit_ts) under the
+        # engine lock, BEFORE the visibility flip (write-ahead ordering)
+        self.wal_sink: Optional[Callable] = None
+        # replication etc.: receive the same (frame_bytes, commit_ts) after
+        # the commit is visible (outside the engine lock)
+        self.frame_consumers: list[Callable] = []
+        self.on_commit_hooks: list[Callable] = []  # triggers (txn, commit_ts)
 
     # --- transactions -------------------------------------------------------
 
@@ -673,19 +684,42 @@ class InMemoryStorage:
                 self.constraints.type.validate_vertex(
                     v.labels, v.properties, self.namer)
 
+        frame = None
+        ship_seq = None
         with self._engine_lock:
             registrations = self.constraints.unique.validate_commit(
                 [v for v in touched], self.namer)
             self._timestamp += 1
             commit_ts = self._timestamp
-            if self.wal_sink is not None:
-                self.wal_sink(txn, commit_ts)
+            if self.wal_sink is not None or self.frame_consumers:
+                # encode ONCE under the lock: object fields hold exactly this
+                # transaction's final state here (no later writer can have
+                # touched them yet — they'd need the lock to commit)
+                from .durability.wal import encode_txn_ops
+                frame = encode_txn_ops(self, txn, commit_ts)
+                if self.wal_sink is not None:
+                    self.wal_sink(frame, commit_ts)
+                if self.frame_consumers:
+                    ship_seq = self._frame_seq
+                    self._frame_seq += 1
             # visibility flip: all the txn's deltas share this CommitInfo
             txn.commit_info.timestamp = commit_ts
             self.constraints.unique.apply_registrations(registrations)
             self._active_txns.pop(txn.id, None)
         # committed state changed → device snapshot caches must re-export
         self._bump_topology()
+        if ship_seq is not None:
+            # strict shipping order across concurrent committers
+            with self._ship_cond:
+                while self._next_ship_seq != ship_seq:
+                    self._ship_cond.wait()
+            try:
+                for consumer in self.frame_consumers:
+                    consumer(frame, commit_ts)
+            finally:
+                with self._ship_cond:
+                    self._next_ship_seq = ship_seq + 1
+                    self._ship_cond.notify_all()
         return commit_ts
 
     def _abort(self, txn: Transaction) -> None:
