@@ -20,3 +20,4 @@ def load_builtin_modules() -> None:
     from . import vector_search     # noqa: F401
     from . import node2vec_module   # noqa: F401
     from . import utility_modules   # noqa: F401
+    from . import text_search_module  # noqa: F401

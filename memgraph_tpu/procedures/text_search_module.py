@@ -1,0 +1,54 @@
+"""text_search module: full-text index management + BM25 search.
+
+Counterpart of /root/reference/query_modules/text_search_module.cpp
+(which fronts the tantivy index): create/drop/list indexes, ranked search.
+"""
+
+from __future__ import annotations
+
+from . import mgp
+
+
+@mgp.write_proc("text_search.create_index",
+                args=[("index_name", "STRING"), ("label", "STRING")],
+                results=[("status", "STRING")])
+def create_index(ctx, index_name, label):
+    from ..storage.text_index import text_indices
+    text_indices(ctx.storage).create(str(index_name), str(label))
+    yield {"status": f"text index {index_name} created"}
+
+
+@mgp.write_proc("text_search.drop_index",
+                args=[("index_name", "STRING")],
+                results=[("status", "STRING")])
+def drop_index(ctx, index_name):
+    from ..storage.text_index import text_indices
+    dropped = text_indices(ctx.storage).drop(str(index_name))
+    yield {"status": ("dropped" if dropped else "no such index")}
+
+
+@mgp.read_proc("text_search.search",
+               args=[("index_name", "STRING"), ("search_query", "STRING")],
+               opt_args=[("limit", "INTEGER", 10)],
+               results=[("node", "NODE"), ("score", "FLOAT")])
+def search(ctx, index_name, search_query, limit=10):
+    from ..storage.text_index import text_indices
+    index = text_indices(ctx.storage).get(str(index_name))
+    if index is None:
+        from ..exceptions import ProcedureException
+        raise ProcedureException(f"text index {index_name!r} does not exist")
+    for gid, score in index.search(str(search_query), int(limit)):
+        node = ctx.accessor.find_vertex(gid, ctx.view)
+        if node is not None:
+            yield {"node": node, "score": float(score)}
+
+
+@mgp.read_proc("text_search.show_index_info",
+               results=[("index_name", "STRING"), ("documents", "INTEGER"),
+                        ("terms", "INTEGER")])
+def show_index_info(ctx):
+    from ..storage.text_index import text_indices
+    for index in text_indices(ctx.storage).all():
+        info = index.info()
+        yield {"index_name": info["name"], "documents": info["documents"],
+               "terms": info["terms"]}

@@ -270,6 +270,28 @@ class Foreach(Clause):
     updates: list[Clause]
 
 
+@dataclass
+class LoadCsv(Clause):
+    file: Expr
+    variable: str
+    with_header: bool = True
+    ignore_bad: bool = False
+    delimiter: Optional[Expr] = None
+    quote: Optional[Expr] = None
+
+
+@dataclass
+class LoadJsonl(Clause):
+    file: Expr
+    variable: str
+
+
+@dataclass
+class LoadParquet(Clause):
+    file: Expr
+    variable: str
+
+
 # --- queries -----------------------------------------------------------------
 
 @dataclass
@@ -361,6 +383,27 @@ class TriggerQuery:
     event: Optional[str] = None     # e.g. 'CREATE' / 'UPDATE' / 'DELETE' / None
     phase: Optional[str] = None     # 'BEFORE' | 'AFTER'
     statement: Optional[str] = None
+
+
+@dataclass
+class StreamQuery:
+    action: str            # create | drop | start | stop | start_all |
+                           # stop_all | show | check
+    name: Optional[str] = None
+    kind: str = "kafka"    # kafka | pulsar | file
+    topics: list[str] = field(default_factory=list)
+    transform: Optional[str] = None
+    batch_size: int = 100
+    batch_interval_ms: int = 100
+    bootstrap_servers: str = ""
+    service_url: str = ""
+    consumer_group: str = ""
+
+
+@dataclass
+class TtlQuery:
+    action: str            # enable | disable
+    period: Optional[str] = None   # e.g. "1s", "5m"
 
 
 @dataclass

@@ -111,6 +111,12 @@ class Parser:
     def _dispatch(self):
         if self.at_kw("CREATE"):
             nxt = self.peek()
+            if nxt.type == T.IDENT and nxt.value.upper() in (
+                    "KAFKA", "PULSAR", "FILE") and \
+                    self.peek(2).is_kw("STREAM"):
+                return self.parse_create_stream()
+            if nxt.is_kw("STREAM"):
+                return self.parse_create_stream()
             if nxt.is_kw("INDEX"):
                 return self.parse_create_index()
             if nxt.is_kw("EDGE"):
@@ -139,6 +145,9 @@ class Parser:
             if nxt.is_kw("REPLICA"):
                 self.advance(); self.advance()
                 return A.ReplicationQuery("drop", name=self.name_token())
+            if nxt.is_kw("STREAM"):
+                self.advance(); self.advance()
+                return A.StreamQuery("drop", name=self.name_token())
             if nxt.is_kw("USER"):
                 return self.parse_auth()
             self.error("unsupported DROP statement")
@@ -195,6 +204,35 @@ class Parser:
             return self.parse_cypher_query()
         if self.at_kw("REGISTER"):
             return self.parse_register_replica()
+        if self.at_kw("START"):
+            self.advance()
+            if self.accept_kw("ALL"):
+                self.expect_kw("STREAMS")
+                return A.StreamQuery("start_all")
+            self.expect_kw("STREAM")
+            return A.StreamQuery("start", name=self.name_token())
+        if self.at_kw("STOP"):
+            self.advance()
+            if self.accept_kw("ALL"):
+                self.expect_kw("STREAMS")
+                return A.StreamQuery("stop_all")
+            self.expect_kw("STREAM")
+            return A.StreamQuery("stop", name=self.name_token())
+        if self.at_kw("CHECK"):
+            self.advance()
+            self.expect_kw("STREAM")
+            return A.StreamQuery("check", name=self.name_token())
+        if self.at_kw("ENABLE"):
+            self.advance()
+            self.expect_kw("TTL")
+            period = None
+            if self.accept_kw("EVERY"):
+                period = self.expect(T.STRING).value
+            return A.TtlQuery("enable", period)
+        if self.at_kw("DISABLE"):
+            self.advance()
+            self.expect_kw("TTL")
+            return A.TtlQuery("disable")
         return self.parse_cypher_query()
 
     def _colon_label(self) -> str:
@@ -319,7 +357,56 @@ class Parser:
         if self.accept_kw("REPLICATION"):
             self.expect_kw("ROLE")
             return A.ReplicationQuery("show_role")
+        if self.accept_kw("STREAMS"):
+            return A.StreamQuery("show")
         self.error("unsupported SHOW statement")
+
+    def parse_create_stream(self) -> A.StreamQuery:
+        self.expect_kw("CREATE")
+        kind = "kafka"
+        if self.at(T.IDENT) and self.cur.value.upper() in (
+                "KAFKA", "PULSAR", "FILE"):
+            kind = self.advance().value.lower()
+        self.expect_kw("STREAM")
+        name = self.name_token()
+        q = A.StreamQuery("create", name=name, kind=kind)
+        while True:
+            if self.accept_kw("TOPICS"):
+                if self.at(T.STRING):
+                    q.topics.append(self.advance().value)
+                else:
+                    q.topics.append(self.name_token())
+                while self.accept(","):
+                    if self.at(T.STRING):
+                        q.topics.append(self.advance().value)
+                    else:
+                        q.topics.append(self.name_token())
+                continue
+            if self.accept_kw("TRANSFORM"):
+                parts = [self.name_token()]
+                while self.accept("."):
+                    parts.append(self.name_token())
+                q.transform = ".".join(parts)
+                continue
+            if self.accept_kw("BATCH_SIZE"):
+                q.batch_size = self.expect(T.INT).value
+                continue
+            if self.accept_kw("BATCH_INTERVAL"):
+                q.batch_interval_ms = self.expect(T.INT).value
+                continue
+            if self.accept_kw("BOOTSTRAP_SERVERS"):
+                q.bootstrap_servers = self.expect(T.STRING).value
+                continue
+            if self.accept_kw("SERVICE_URL"):
+                q.service_url = self.expect(T.STRING).value
+                continue
+            if self.accept_kw("CONSUMER_GROUP"):
+                q.consumer_group = self.expect(T.STRING).value
+                continue
+            break
+        if not q.topics or not q.transform:
+            self.error("CREATE STREAM requires TOPICS and TRANSFORM")
+        return q
 
     def parse_set_replication_role(self) -> A.ReplicationQuery:
         self.expect_kw("SET")
@@ -498,7 +585,57 @@ class Parser:
             return self.parse_call()
         if self.at_kw("FOREACH"):
             return self.parse_foreach()
+        if self.at_kw("LOAD"):
+            return self.parse_load()
         return None
+
+    def parse_load(self):
+        self.expect_kw("LOAD")
+        if self.accept_kw("CSV"):
+            self.expect_kw("FROM")
+            file_expr = self.parse_expression()
+            with_header = False
+            if self.accept_kw("WITH"):
+                self.expect_kw("HEADER")
+                with_header = True
+            elif self.accept_kw("NO"):
+                self.expect_kw("HEADER")
+            ignore_bad = False
+            if self.at(T.IDENT) and self.cur.value.upper() == "IGNORE":
+                self.advance()
+                if self.at(T.IDENT) and self.cur.value.upper() == "BAD":
+                    self.advance()
+                ignore_bad = True
+            delimiter = quote = None
+            while True:
+                if self.accept_kw("FIELDTERMINATOR"):
+                    delimiter = self.parse_expression()
+                    continue
+                if self.at(T.IDENT) and self.cur.value.upper() == "DELIMITER":
+                    self.advance()
+                    delimiter = self.parse_expression()
+                    continue
+                if self.at(T.IDENT) and self.cur.value.upper() == "QUOTE":
+                    self.advance()
+                    quote = self.parse_expression()
+                    continue
+                break
+            self.expect_kw("AS")
+            var = self.name_token()
+            return A.LoadCsv(file_expr, var, with_header, ignore_bad,
+                             delimiter, quote)
+        kind = self.name_token().upper()
+        if kind == "JSONL":
+            self.expect_kw("FROM")
+            file_expr = self.parse_expression()
+            self.expect_kw("AS")
+            return A.LoadJsonl(file_expr, self.name_token())
+        if kind == "PARQUET":
+            self.expect_kw("FROM")
+            file_expr = self.parse_expression()
+            self.expect_kw("AS")
+            return A.LoadParquet(file_expr, self.name_token())
+        self.error(f"unsupported LOAD source {kind}")
 
     def parse_match(self, optional: bool, consumed=False) -> A.Match:
         if not consumed:

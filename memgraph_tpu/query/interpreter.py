@@ -161,8 +161,64 @@ class Interpreter:
             return self._prepare_auth(node)
         if isinstance(node, A.ReplicationQuery):
             return self._prepare_replication(node)
+        if isinstance(node, A.StreamQuery):
+            return self._prepare_stream(node)
+        if isinstance(node, A.TtlQuery):
+            return self._prepare_ttl(node)
         raise SemanticException(
             f"unsupported query type {type(node).__name__}")
+
+    def _prepare_stream(self, node: A.StreamQuery) -> PreparedQuery:
+        from .streams import StreamSpec, streams_of
+        streams = streams_of(self.ctx)
+        if node.action == "create":
+            self._ensure_writable("CREATE STREAM")
+            streams.create(StreamSpec(
+                name=node.name, kind=node.kind, topics=list(node.topics),
+                transform=node.transform, batch_size=node.batch_size,
+                batch_interval_sec=node.batch_interval_ms / 1000.0,
+                bootstrap_servers=node.bootstrap_servers,
+                service_url=node.service_url,
+                consumer_group=node.consumer_group))
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "drop":
+            streams.drop(node.name)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "start":
+            streams.start(node.name)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "stop":
+            streams.stop(node.name)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "start_all":
+            streams.start_all()
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "stop_all":
+            streams.stop_all()
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "show":
+            return self._prepare_generator(
+                iter(streams.show()),
+                ["name", "type", "topics", "transform", "batch_size",
+                 "status", "processed_messages", "last_error"], "r")
+        if node.action == "check":
+            rows = [r for r in streams.show() if r[0] == node.name]
+            return self._prepare_generator(
+                iter(rows),
+                ["name", "type", "topics", "transform", "batch_size",
+                 "status", "processed_messages", "last_error"], "r")
+        raise SemanticException(f"unknown stream action {node.action}")
+
+    def _prepare_ttl(self, node: A.TtlQuery) -> PreparedQuery:
+        from ..storage.ttl import ttl_runner
+        runner = ttl_runner(self.ctx)
+        if node.action == "enable":
+            if node.period:
+                runner.period_sec = _parse_period(node.period)
+            runner.start()
+        else:
+            runner.stop()
+        return self._prepare_generator(iter([]), [], "s")
 
     def _ensure_writable(self, what: str) -> None:
         replication = getattr(self.ctx, "replication", None)
@@ -630,6 +686,17 @@ class Interpreter:
         self._install_stream(iterator, None, False)
         self._prepared = PreparedQuery(columns, 0, summary_type)
         return self._prepared
+
+
+def _parse_period(text: str) -> float:
+    """'500ms' / '2s' / '5m' / '1h' → seconds."""
+    import re
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*(ms|s|m|h)?\s*", text)
+    if not m:
+        raise SemanticException(f"invalid period {text!r}")
+    value = float(m.group(1))
+    unit = m.group(2) or "s"
+    return value * {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}[unit]
 
 
 def _chain_front(first_row, rest):

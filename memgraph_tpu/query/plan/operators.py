@@ -1019,6 +1019,112 @@ class Foreach(LogicalOperator):
 
 
 @dataclass
+class LoadCsvOp(LogicalOperator):
+    """Stream rows from a CSV file (reference: operator.hpp:2883 LoadCsv).
+    With header → map rows; without → list rows. Values stay strings
+    (explicit casts in the query, matching the reference's LOAD CSV)."""
+    input: LogicalOperator
+    file: A.Expr
+    symbol: str
+    with_header: bool
+    ignore_bad: bool
+    delimiter: Optional[A.Expr]
+    quote: Optional[A.Expr]
+
+    def cursor(self, ctx):
+        import csv as csvlib
+        for frame in self.input.cursor(ctx):
+            path = ctx.evaluator.eval(self.file, frame)
+            if not isinstance(path, str):
+                raise TypeException("LOAD CSV FROM requires a string path")
+            delim = (ctx.evaluator.eval(self.delimiter, frame)
+                     if self.delimiter is not None else ",")
+            quote = (ctx.evaluator.eval(self.quote, frame)
+                     if self.quote is not None else '"')
+            try:
+                f = open(path, newline="", encoding="utf-8")
+            except OSError as e:
+                raise QueryException(f"cannot open CSV file: {e}") from e
+            with f:
+                reader = csvlib.reader(f, delimiter=delim, quotechar=quote)
+                header = None
+                for lineno, row in enumerate(reader):
+                    ctx.check_abort()
+                    if self.with_header and header is None:
+                        header = row
+                        continue
+                    if self.with_header:
+                        if len(row) != len(header):
+                            if self.ignore_bad:
+                                continue
+                            raise QueryException(
+                                f"CSV row {lineno + 1} has {len(row)} "
+                                f"fields, header has {len(header)}")
+                        value = dict(zip(header, row))
+                    else:
+                        value = list(row)
+                    new = dict(frame)
+                    new[self.symbol] = value
+                    yield new
+
+
+@dataclass
+class LoadJsonlOp(LogicalOperator):
+    """Stream objects from a JSON-lines file (reference: LoadJsonl,
+    query/jsonl/reader.cppm)."""
+    input: LogicalOperator
+    file: A.Expr
+    symbol: str
+
+    def cursor(self, ctx):
+        import json as jsonlib
+        for frame in self.input.cursor(ctx):
+            path = ctx.evaluator.eval(self.file, frame)
+            if not isinstance(path, str):
+                raise TypeException("LOAD JSONL FROM requires a string path")
+            try:
+                f = open(path, encoding="utf-8")
+            except OSError as e:
+                raise QueryException(f"cannot open JSONL file: {e}") from e
+            with f:
+                for line in f:
+                    ctx.check_abort()
+                    line = line.strip()
+                    if not line:
+                        continue
+                    new = dict(frame)
+                    new[self.symbol] = jsonlib.loads(line)
+                    yield new
+
+
+@dataclass
+class LoadParquetOp(LogicalOperator):
+    """Stream rows from a Parquet file via pyarrow (reference: LoadParquet,
+    query/arrow_parquet/reader.cppm)."""
+    input: LogicalOperator
+    file: A.Expr
+    symbol: str
+
+    def cursor(self, ctx):
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:  # pragma: no cover
+            raise QueryException("pyarrow is not available") from e
+        for frame in self.input.cursor(ctx):
+            path = ctx.evaluator.eval(self.file, frame)
+            if not isinstance(path, str):
+                raise TypeException("LOAD PARQUET FROM requires a string path")
+            table = pq.read_table(path)
+            for batch in table.to_batches():
+                rows = batch.to_pylist()
+                for row in rows:
+                    ctx.check_abort()
+                    new = dict(frame)
+                    new[self.symbol] = row
+                    yield new
+
+
+@dataclass
 class Accumulate(LogicalOperator):
     """Materialize all input rows before streaming (write barrier between
     updating clauses and RETURN — reference: Accumulate operator)."""

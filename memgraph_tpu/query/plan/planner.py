@@ -157,6 +157,17 @@ class Planner:
             elif isinstance(clause, A.Foreach):
                 has_update = True
                 plan = self.plan_foreach(clause, plan, bound)
+            elif isinstance(clause, A.LoadCsv):
+                plan = Op.LoadCsvOp(plan, clause.file, clause.variable,
+                                    clause.with_header, clause.ignore_bad,
+                                    clause.delimiter, clause.quote)
+                bound.add(clause.variable)
+            elif isinstance(clause, A.LoadJsonl):
+                plan = Op.LoadJsonlOp(plan, clause.file, clause.variable)
+                bound.add(clause.variable)
+            elif isinstance(clause, A.LoadParquet):
+                plan = Op.LoadParquetOp(plan, clause.file, clause.variable)
+                bound.add(clause.variable)
             else:
                 raise SemanticException(
                     f"unsupported clause {type(clause).__name__}")
@@ -714,6 +725,19 @@ def _expr_name(expr: A.Expr) -> str:
         return repr(expr.value)
     if isinstance(expr, A.Parameter):
         return f"${expr.name}"
+    if isinstance(expr, A.Subscript):
+        return f"{_expr_name(expr.expr)}[{_expr_name(expr.index)}]"
+    if isinstance(expr, A.Binary):
+        return f"{_expr_name(expr.left)} {expr.op} {_expr_name(expr.right)}"
+    if isinstance(expr, A.Unary):
+        return f"{expr.op} {_expr_name(expr.expr)}"
+    if isinstance(expr, A.Slice):
+        return f"{_expr_name(expr.expr)}[..]"
+    if isinstance(expr, A.LabelsTest):
+        return f"{_expr_name(expr.expr)}:{':'.join(expr.labels)}"
+    if isinstance(expr, A.IsNull):
+        return (f"{_expr_name(expr.expr)} IS "
+                f"{'NOT ' if expr.negated else ''}NULL")
     return "expression"
 
 

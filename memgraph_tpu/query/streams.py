@@ -1,0 +1,338 @@
+"""Streaming ingestion: sources → transformation → retried transactions.
+
+Counterpart of the reference's stream subsystem
+(/root/reference/src/query/stream/streams.hpp:82 + src/integrations/
+{kafka,pulsar}/): a stream couples a message source with a transformation
+that turns message batches into parameterized queries, executed in a
+conflict-retried transaction loop (interpreter config analog of
+memgraph.cpp:652-653).
+
+Sources are pluggable:
+  kafka  — librdkafka-equivalent client, gated on an importable client lib
+  pulsar — gated likewise
+  file   — JSONL file tail (always available; the test/e2e source)
+
+Transformations are Python callables registered with
+@mgp.transformation (procedures/mgp.py), receiving a list of Message and
+returning [{query, parameters}] — the same contract as the reference's
+transformation modules.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..exceptions import QueryException
+
+log = logging.getLogger(__name__)
+
+TRANSFORMATIONS: dict = {}
+
+
+def register_transformation(name: str, fn) -> None:
+    TRANSFORMATIONS[name.lower()] = fn
+
+
+@dataclass
+class Message:
+    payload: bytes
+    topic: str = ""
+    key: bytes | None = None
+    timestamp: int = 0
+    offset: int = 0
+
+    def payload_str(self) -> str:
+        return self.payload.decode("utf-8", errors="replace")
+
+
+class FileSource:
+    """JSONL file tail: each appended line is one message."""
+
+    def __init__(self, path: str, topic: str = "file"):
+        self.path = path
+        self.topic = topic
+        self._offset = 0
+
+    def poll(self, batch_size: int, timeout_sec: float) -> list[Message]:
+        out: list[Message] = []
+        deadline = time.time() + timeout_sec
+        while not out and time.time() < deadline:
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(self._offset)
+                    while len(out) < batch_size:
+                        line = f.readline()
+                        if not line:
+                            break
+                        self._offset = f.tell()
+                        if line.strip():
+                            out.append(Message(line.strip(), self.topic,
+                                               offset=self._offset))
+            except FileNotFoundError:
+                pass
+            if not out:
+                time.sleep(0.05)
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class KafkaSource:  # pragma: no cover - requires a kafka client lib
+    def __init__(self, topics, bootstrap_servers, consumer_group):
+        try:
+            from confluent_kafka import Consumer
+        except ImportError as e:
+            raise QueryException(
+                "no Kafka client library available in this environment; "
+                "use a FILE stream or install confluent-kafka") from e
+        self._consumer = Consumer({
+            "bootstrap.servers": bootstrap_servers,
+            "group.id": consumer_group or "memgraph-tpu",
+            "auto.offset.reset": "earliest"})
+        self._consumer.subscribe(list(topics))
+
+    def poll(self, batch_size: int, timeout_sec: float) -> list[Message]:
+        msgs = self._consumer.consume(batch_size, timeout=timeout_sec)
+        out = []
+        for m in msgs or []:
+            if m.error():
+                continue
+            out.append(Message(m.value(), m.topic(), m.key(),
+                               m.timestamp()[1], m.offset()))
+        return out
+
+    def close(self) -> None:
+        self._consumer.close()
+
+
+class PulsarSource:  # pragma: no cover - requires pulsar client lib
+    def __init__(self, topics, service_url, consumer_group):
+        try:
+            import pulsar
+        except ImportError as e:
+            raise QueryException(
+                "no Pulsar client library available in this environment; "
+                "use a FILE stream or install pulsar-client") from e
+        self._client = pulsar.Client(service_url)
+        self._consumer = self._client.subscribe(
+            list(topics), consumer_group or "memgraph-tpu")
+
+    def poll(self, batch_size, timeout_sec):
+        out = []
+        deadline = time.time() + timeout_sec
+        while len(out) < batch_size and time.time() < deadline:
+            try:
+                m = self._consumer.receive(
+                    timeout_millis=int(timeout_sec * 1000))
+            except Exception:
+                break
+            out.append(Message(m.data(), m.topic_name()))
+            self._consumer.acknowledge(m)
+        return out
+
+    def close(self):
+        self._client.close()
+
+
+@dataclass
+class StreamSpec:
+    name: str
+    kind: str                 # 'kafka' | 'pulsar' | 'file'
+    topics: list[str]
+    transform: str
+    batch_size: int = 100
+    batch_interval_sec: float = 0.1
+    bootstrap_servers: str = ""
+    service_url: str = ""
+    consumer_group: str = ""
+
+
+class Stream:
+    def __init__(self, spec: StreamSpec, interpreter_context):
+        self.spec = spec
+        self.ictx = interpreter_context
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.running = False
+        self.processed_batches = 0
+        self.processed_messages = 0
+        self.last_error: str | None = None
+
+    def _make_source(self):
+        spec = self.spec
+        if spec.kind == "file":
+            return FileSource(spec.topics[0])
+        if spec.kind == "kafka":
+            return KafkaSource(spec.topics, spec.bootstrap_servers,
+                               spec.consumer_group)
+        if spec.kind == "pulsar":
+            return PulsarSource(spec.topics, spec.service_url,
+                                spec.consumer_group)
+        raise QueryException(f"unknown stream kind {spec.kind}")
+
+    def start(self) -> None:
+        if self.running:
+            raise QueryException(f"stream {self.spec.name!r} already running")
+        transform = TRANSFORMATIONS.get(self.spec.transform.lower())
+        if transform is None:
+            raise QueryException(
+                f"unknown transformation {self.spec.transform!r}")
+        source = self._make_source()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(source, transform), daemon=True)
+        self.running = True
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.running = False
+
+    def _loop(self, source, transform) -> None:
+        from .interpreter import Interpreter
+        from ..exceptions import SerializationError
+        try:
+            while not self._stop.is_set():
+                batch = source.poll(self.spec.batch_size,
+                                    self.spec.batch_interval_sec)
+                if not batch:
+                    continue
+                try:
+                    actions = transform(batch)
+                except Exception as e:
+                    self.last_error = f"transform failed: {e}"
+                    log.exception("stream %s transform failed",
+                                  self.spec.name)
+                    continue
+                # conflict-retried transaction (reference: retry interval
+                # config, memgraph.cpp:652)
+                for attempt in range(10):
+                    interp = Interpreter(self.ictx)
+                    try:
+                        interp.execute("BEGIN")
+                        for action in actions:
+                            interp.execute(action["query"],
+                                           action.get("parameters"))
+                        interp.execute("COMMIT")
+                        break
+                    except SerializationError:
+                        interp.abort()
+                        time.sleep(0.01 * (attempt + 1))
+                    except Exception as e:
+                        interp.abort()
+                        self.last_error = str(e)
+                        log.exception("stream %s batch failed",
+                                      self.spec.name)
+                        break
+                self.processed_batches += 1
+                self.processed_messages += len(batch)
+        finally:
+            source.close()
+
+
+class Streams:
+    """Registry of streams (reference: query/stream/streams.hpp Streams)."""
+
+    def __init__(self, interpreter_context):
+        self.ictx = interpreter_context
+        self._lock = threading.Lock()
+        self._streams: dict[str, Stream] = {}
+
+    def create(self, spec: StreamSpec) -> None:
+        with self._lock:
+            if spec.name in self._streams:
+                raise QueryException(
+                    f"stream {spec.name!r} already exists")
+            self._streams[spec.name] = Stream(spec, self.ictx)
+
+    def drop(self, name: str) -> None:
+        with self._lock:
+            stream = self._streams.pop(name, None)
+        if stream is None:
+            raise QueryException(f"stream {name!r} does not exist")
+        if stream.running:
+            stream.stop()
+
+    def start(self, name: str) -> None:
+        self._get(name).start()
+
+    def stop(self, name: str) -> None:
+        self._get(name).stop()
+
+    def start_all(self) -> None:
+        with self._lock:
+            streams = list(self._streams.values())
+        for s in streams:
+            if not s.running:
+                s.start()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            streams = list(self._streams.values())
+        for s in streams:
+            if s.running:
+                s.stop()
+
+    def _get(self, name: str) -> Stream:
+        with self._lock:
+            stream = self._streams.get(name)
+        if stream is None:
+            raise QueryException(f"stream {name!r} does not exist")
+        return stream
+
+    def show(self) -> list[list]:
+        with self._lock:
+            streams = list(self._streams.values())
+        return [[s.spec.name, s.spec.kind, "|".join(s.spec.topics),
+                 s.spec.transform, s.spec.batch_size,
+                 "running" if s.running else "stopped",
+                 s.processed_messages, s.last_error]
+                for s in sorted(streams, key=lambda s: s.spec.name)]
+
+
+_REGISTRY: dict[int, Streams] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def streams_of(interpreter_context) -> Streams:
+    with _REGISTRY_LOCK:
+        s = _REGISTRY.get(id(interpreter_context))
+        if s is None:
+            s = Streams(interpreter_context)
+            _REGISTRY[id(interpreter_context)] = s
+        return s
+
+
+# --- builtin transformations -------------------------------------------------
+
+def _cypher_jsonl_transform(messages):
+    """Each message: {"query": "...", "parameters": {...}} JSON."""
+    actions = []
+    for m in messages:
+        obj = json.loads(m.payload_str())
+        actions.append({"query": obj["query"],
+                        "parameters": obj.get("parameters")})
+    return actions
+
+
+def _node_jsonl_transform(messages):
+    """Each message: {"labels": [...], "properties": {...}} → CREATE."""
+    actions = []
+    for m in messages:
+        obj = json.loads(m.payload_str())
+        labels = "".join(f":{l}" for l in obj.get("labels", []))
+        actions.append({
+            "query": f"CREATE (n{labels} $props)",
+            "parameters": {"props": obj.get("properties", {})}})
+    return actions
+
+
+register_transformation("transform.cypher", _cypher_jsonl_transform)
+register_transformation("transform.nodes", _node_jsonl_transform)

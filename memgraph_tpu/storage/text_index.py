@@ -1,0 +1,168 @@
+"""Full-text search index (BM25 inverted index).
+
+Counterpart of the reference's tantivy-backed text index
+(/root/reference/src/storage/v2/indices/text_index.cpp via the mgcxx Rust
+bridge — no Rust in this environment, so a native-Python inverted index
+with BM25 ranking; a C++ backend slots behind the same interface).
+
+Indexes all string properties of vertices with a given label. Exposed via
+the text_search module procedures (text_search.search, matching the
+reference's query_modules/text_search_module.cpp surface).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from collections import Counter, defaultdict
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def tokenize_text(text: str) -> list[str]:
+    return _TOKEN_RE.findall(text.lower())
+
+
+class TextIndex:
+    """One named text index over (label, [string properties])."""
+
+    K1 = 1.5
+    B = 0.75
+
+    def __init__(self, name: str, label_id: int,
+                 property_ids: list[int] | None = None):
+        self.name = name
+        self.label_id = label_id
+        self.property_ids = property_ids  # None = all string properties
+        self._lock = threading.Lock()
+        self._postings: dict[str, dict[int, int]] = defaultdict(dict)
+        self._doc_len: dict[int, int] = {}
+        self._total_len = 0
+
+    # --- maintenance --------------------------------------------------------
+
+    def _document_tokens(self, vertex) -> list[str]:
+        tokens: list[str] = []
+        for pid, value in vertex.properties.items():
+            if self.property_ids is not None and pid not in self.property_ids:
+                continue
+            if isinstance(value, str):
+                tokens.extend(tokenize_text(value))
+        return tokens
+
+    def add_vertex(self, vertex) -> None:
+        if self.label_id not in vertex.labels or vertex.deleted:
+            return
+        tokens = self._document_tokens(vertex)
+        with self._lock:
+            self._remove_locked(vertex.gid)
+            if not tokens:
+                return
+            counts = Counter(tokens)
+            for term, tf in counts.items():
+                self._postings[term][vertex.gid] = tf
+            self._doc_len[vertex.gid] = len(tokens)
+            self._total_len += len(tokens)
+
+    def remove_vertex(self, gid: int) -> None:
+        with self._lock:
+            self._remove_locked(gid)
+
+    def _remove_locked(self, gid: int) -> None:
+        old_len = self._doc_len.pop(gid, None)
+        if old_len is None:
+            return
+        self._total_len -= old_len
+        for term_docs in self._postings.values():
+            term_docs.pop(gid, None)
+
+    def rebuild(self, vertices) -> None:
+        with self._lock:
+            self._postings.clear()
+            self._doc_len.clear()
+            self._total_len = 0
+        for v in vertices:
+            self.add_vertex(v)
+
+    # --- search -------------------------------------------------------------
+
+    def search(self, query: str, limit: int = 10) -> list[tuple[int, float]]:
+        """BM25-ranked [(gid, score)] for the query terms (OR semantics)."""
+        terms = tokenize_text(query)
+        with self._lock:
+            n_docs = len(self._doc_len)
+            if not n_docs or not terms:
+                return []
+            avg_len = self._total_len / n_docs
+            scores: dict[int, float] = defaultdict(float)
+            for term in terms:
+                docs = self._postings.get(term)
+                if not docs:
+                    continue
+                idf = math.log(1 + (n_docs - len(docs) + 0.5)
+                               / (len(docs) + 0.5))
+                for gid, tf in docs.items():
+                    dl = self._doc_len[gid]
+                    denom = tf + self.K1 * (1 - self.B
+                                            + self.B * dl / avg_len)
+                    scores[gid] += idf * tf * (self.K1 + 1) / denom
+            ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+            return ranked[:limit]
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "documents": len(self._doc_len),
+                    "terms": len(self._postings)}
+
+
+class TextIndices:
+    """Registry of named text indexes, kept fresh by a commit hook."""
+
+    def __init__(self, storage) -> None:
+        self.storage = storage
+        self._lock = threading.Lock()
+        self._indexes: dict[str, TextIndex] = {}
+        storage.on_commit_hooks.append(self._on_commit)
+
+    def create(self, name: str, label_name: str) -> TextIndex:
+        from ..exceptions import QueryException
+        with self._lock:
+            if name in self._indexes:
+                raise QueryException(f"text index {name!r} already exists")
+        label_id = self.storage.label_mapper.name_to_id(label_name)
+        index = TextIndex(name, label_id)
+        index.rebuild(list(self.storage._vertices.values()))
+        with self._lock:
+            self._indexes[name] = index
+        return index
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            return self._indexes.pop(name, None) is not None
+
+    def get(self, name: str) -> TextIndex | None:
+        with self._lock:
+            return self._indexes.get(name)
+
+    def all(self) -> list[TextIndex]:
+        with self._lock:
+            return list(self._indexes.values())
+
+    def _on_commit(self, txn, commit_ts) -> None:
+        with self._lock:
+            indexes = list(self._indexes.values())
+        if not indexes:
+            return
+        for vertex in txn.touched_vertices.values():
+            for index in indexes:
+                if vertex.deleted:
+                    index.remove_vertex(vertex.gid)
+                else:
+                    index.add_vertex(vertex)
+
+
+def text_indices(storage) -> TextIndices:
+    if storage.indices.text is None:
+        storage.indices.text = TextIndices(storage)
+    return storage.indices.text

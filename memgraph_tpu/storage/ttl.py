@@ -1,0 +1,100 @@
+"""TTL: background expiry of vertices by a ttl property.
+
+Counterpart of /root/reference/src/storage/v2/ttl.{hpp,cpp}: vertices
+carrying a `ttl` property (microseconds-since-epoch expiry time) are deleted
+by a periodic background job; replication-aware (runs on MAIN only).
+Enabled via `ENABLE TTL EVERY <duration>`-style queries or the API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+TTL_PROPERTY = "ttl"
+
+
+class TtlRunner:
+    def __init__(self, interpreter_context, period_sec: float = 1.0,
+                 batch_size: int = 10_000):
+        self.ictx = interpreter_context
+        self.period_sec = period_sec
+        self.batch_size = batch_size
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.enabled = False
+        self.runs = 0
+        self.deleted_total = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.enabled = True
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.enabled = False
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_sec):
+            try:
+                self.run_once()
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception("ttl sweep failed")
+
+    def run_once(self) -> int:
+        """One expiry sweep; returns number of deleted vertices."""
+        replication = getattr(self.ictx, "replication", None)
+        if replication is not None and replication.role == "replica":
+            return 0  # MAIN-only (reference: memgraph.cpp:816-823 analog)
+        storage = self.ictx.storage
+        pid = storage.property_mapper.maybe_name_to_id(TTL_PROPERTY)
+        if pid is None:
+            return 0
+        now_us = int(time.time() * 1_000_000)
+        deleted = 0
+        from ..exceptions import SerializationError
+        acc = storage.access()
+        try:
+            doomed = []
+            for va in acc.vertices():
+                expiry = va.get_property(pid)
+                if isinstance(expiry, int) and not isinstance(expiry, bool) \
+                        and expiry <= now_us:
+                    doomed.append(va)
+                    if len(doomed) >= self.batch_size:
+                        break
+            for va in doomed:
+                try:
+                    acc.delete_vertex(va, detach=True)
+                    deleted += 1
+                except SerializationError:
+                    pass  # concurrent writer owns it; next sweep
+            acc.commit()
+        except SerializationError:
+            acc.abort()
+            return 0
+        self.runs += 1
+        self.deleted_total += deleted
+        return deleted
+
+
+_RUNNERS: dict[int, TtlRunner] = {}
+_RUNNERS_LOCK = threading.Lock()
+
+
+def ttl_runner(interpreter_context) -> TtlRunner:
+    with _RUNNERS_LOCK:
+        runner = _RUNNERS.get(id(interpreter_context))
+        if runner is None:
+            runner = TtlRunner(interpreter_context)
+            _RUNNERS[id(interpreter_context)] = runner
+        return runner

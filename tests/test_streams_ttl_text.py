@@ -1,0 +1,185 @@
+"""Streams (file source), TTL expiry, text index, LOAD CSV/JSONL tests."""
+
+import json
+import time
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+# --- streams -----------------------------------------------------------------
+
+def test_file_stream_ingest(db, tmp_path):
+    feed = tmp_path / "feed.jsonl"
+    feed.write_text("")
+    run(db, f"CREATE FILE STREAM s1 TOPICS '{feed}' "
+            f"TRANSFORM transform.nodes BATCH_SIZE 10 BATCH_INTERVAL 50")
+    rows = run(db, "SHOW STREAMS")
+    assert rows[0][0] == "s1" and rows[0][5] == "stopped"
+    run(db, "START STREAM s1")
+    with open(feed, "a") as f:
+        f.write(json.dumps({"labels": ["Event"],
+                            "properties": {"v": 1}}) + "\n")
+        f.write(json.dumps({"labels": ["Event"],
+                            "properties": {"v": 2}}) + "\n")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        rows = run(db, "MATCH (n:Event) RETURN count(n)")
+        if rows == [[2]]:
+            break
+        time.sleep(0.05)
+    assert rows == [[2]]
+    run(db, "STOP STREAM s1")
+    rows = run(db, "SHOW STREAMS")
+    assert rows[0][5] == "stopped"
+    run(db, "DROP STREAM s1")
+    assert run(db, "SHOW STREAMS") == []
+
+
+def test_cypher_transform_stream(db, tmp_path):
+    feed = tmp_path / "q.jsonl"
+    feed.write_text(json.dumps({
+        "query": "CREATE (:FromStream {k: $k})",
+        "parameters": {"k": 42}}) + "\n")
+    run(db, f"CREATE FILE STREAM s2 TOPICS '{feed}' "
+            f"TRANSFORM transform.cypher BATCH_INTERVAL 50")
+    run(db, "START STREAM s2")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        rows = run(db, "MATCH (n:FromStream) RETURN n.k")
+        if rows == [[42]]:
+            break
+        time.sleep(0.05)
+    assert rows == [[42]]
+    run(db, "STOP STREAM s2")
+
+
+def test_kafka_stream_unavailable(db):
+    run(db, "CREATE KAFKA STREAM k1 TOPICS t TRANSFORM transform.cypher "
+            "BOOTSTRAP_SERVERS 'localhost:9092'")
+    from memgraph_tpu.exceptions import QueryException
+    with pytest.raises(QueryException):  # no kafka client lib in this env
+        run(db, "START STREAM k1")
+
+
+# --- TTL ---------------------------------------------------------------------
+
+def test_ttl_expiry(db):
+    import time as _t
+    now_us = int(_t.time() * 1_000_000)
+    run(db, "CREATE (:Ephemeral {ttl: $past}), (:Ephemeral {ttl: $future}), "
+            "(:Durable)",
+        {"past": now_us - 1_000_000, "future": now_us + 60_000_000})
+    from memgraph_tpu.storage.ttl import ttl_runner
+    runner = ttl_runner(db)
+    deleted = runner.run_once()
+    assert deleted == 1
+    rows = run(db, "MATCH (n) RETURN count(n)")
+    assert rows == [[2]]
+
+
+def test_ttl_enable_disable(db):
+    run(db, 'ENABLE TTL EVERY "100ms"')
+    from memgraph_tpu.storage.ttl import ttl_runner
+    runner = ttl_runner(db)
+    assert runner.enabled
+    assert runner.period_sec == pytest.approx(0.1)
+    run(db, "DISABLE TTL")
+    assert not runner.enabled
+
+
+def test_ttl_not_on_replica(db):
+    from memgraph_tpu.replication.main_role import ReplicationState
+    db.replication = ReplicationState(db.storage)
+    db.replication.role = "replica"
+    from memgraph_tpu.storage.ttl import ttl_runner
+    assert ttl_runner(db).run_once() == 0
+
+
+# --- text index --------------------------------------------------------------
+
+def test_text_search(db):
+    run(db, "CREATE (:Doc {title: 'graph databases on TPU hardware'}), "
+            "(:Doc {title: 'cooking pasta quickly'}), "
+            "(:Doc {title: 'TPU kernels for graph analytics'})")
+    run(db, "CALL text_search.create_index('docs', 'Doc') YIELD status "
+            "RETURN status")
+    rows = run(db, "CALL text_search.search('docs', 'TPU graph') "
+                   "YIELD node, score RETURN node.title, score")
+    titles = [r[0] for r in rows]
+    assert "cooking pasta quickly" not in titles
+    assert len(titles) == 2
+    assert rows[0][1] >= rows[-1][1]  # ranked
+
+
+def test_text_search_index_updates(db):
+    run(db, "CALL text_search.create_index('idx', 'Note') YIELD status "
+            "RETURN status")
+    run(db, "CREATE (:Note {body: 'quantum entanglement'})")
+    rows = run(db, "CALL text_search.search('idx', 'quantum') YIELD node "
+                   "RETURN count(node)")
+    assert rows == [[1]]
+    run(db, "MATCH (n:Note) DETACH DELETE n")
+    rows = run(db, "CALL text_search.search('idx', 'quantum') YIELD node "
+                   "RETURN count(node)")
+    assert rows == [[0]]
+    info = run(db, "CALL text_search.show_index_info() YIELD index_name, "
+                   "documents RETURN index_name, documents")
+    assert info == [["idx", 0]]
+
+
+# --- LOAD CSV / JSONL / PARQUET ---------------------------------------------
+
+def test_load_csv_with_header(db, tmp_path):
+    csv_file = tmp_path / "people.csv"
+    csv_file.write_text("name,age\nana,34\nben,27\n")
+    rows = run(db, f"LOAD CSV FROM '{csv_file}' WITH HEADER AS row "
+                   f"RETURN row.name, toInteger(row.age) ORDER BY row.name")
+    assert rows == [["ana", 34], ["ben", 27]]
+
+
+def test_load_csv_create_nodes(db, tmp_path):
+    csv_file = tmp_path / "cities.csv"
+    csv_file.write_text("name\nzagreb\nsplit\n")
+    run(db, f"LOAD CSV FROM '{csv_file}' WITH HEADER AS row "
+            f"CREATE (:City {{name: row.name}})")
+    rows = run(db, "MATCH (c:City) RETURN count(c)")
+    assert rows == [[2]]
+
+
+def test_load_csv_no_header(db, tmp_path):
+    csv_file = tmp_path / "pairs.csv"
+    csv_file.write_text("1;2\n3;4\n")
+    rows = run(db, f"LOAD CSV FROM '{csv_file}' NO HEADER "
+                   f"DELIMITER ';' AS row RETURN row[0], row[1]")
+    assert rows == [["1", "2"], ["3", "4"]]
+
+
+def test_load_jsonl(db, tmp_path):
+    f = tmp_path / "data.jsonl"
+    f.write_text('{"a": 1, "b": [true, null]}\n{"a": 2}\n')
+    rows = run(db, f"LOAD JSONL FROM '{f}' AS row RETURN row.a ORDER BY row.a")
+    assert rows == [[1], [2]]
+
+
+def test_load_parquet(db, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    table = pa.table({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    f = tmp_path / "data.parquet"
+    pq.write_table(table, f)
+    rows = run(db, f"LOAD PARQUET FROM '{f}' AS row "
+                   f"RETURN row.x, row.y ORDER BY row.x")
+    assert rows == [[1, "a"], [2, "b"], [3, "c"]]
