@@ -111,38 +111,53 @@ def from_coo(src: np.ndarray, dst: np.ndarray,
     # extra padding row n_nodes (guaranteed to exist since n_pad >= n_nodes+1)
     sink = n_nodes
 
-    # lexicographic (src, dst) order: rows contiguous AND sorted by dst, so
-    # device-side edge-membership queries can binary-search within a row
-    order = np.lexsort((dst, src))
-    s_sorted = src[order]
-    d_sorted = dst[order]
-    w_sorted = weights[order]
+    # fast path: native C++ counting-sort builder (O(E+N), ops/native.py)
+    from .native import build_csr_csc_native
+    native = build_csr_csc_native(src, dst, weights, n_nodes, n_pad, e_pad) \
+        if n_edges > 0 else None
+    if native is not None:
+        src_full = native["csr_src"]
+        dst_full = native["csr_dst"]
+        w_full = native["csr_w"]
+        csc_src = native["csc_src"]
+        csc_dst = native["csc_dst"]
+        csc_w = native["csc_w"]
+        row_ptr = native["row_ptr"]
+        out_degree = native["out_degree"]
+    else:
+        # numpy fallback — lexicographic (src, dst) order: rows contiguous
+        # AND sorted by dst, so device-side edge-membership queries can
+        # binary-search within a row
+        order = np.lexsort((dst, src))
+        s_sorted = src[order]
+        d_sorted = dst[order]
+        w_sorted = weights[order]
 
-    src_full = np.full(e_pad, sink, dtype=np.int32)
-    dst_full = np.full(e_pad, sink, dtype=np.int32)
-    w_full = np.zeros(e_pad, dtype=np.float32)
-    src_full[:n_edges] = s_sorted
-    dst_full[:n_edges] = d_sorted
-    w_full[:n_edges] = w_sorted
+        src_full = np.full(e_pad, sink, dtype=np.int32)
+        dst_full = np.full(e_pad, sink, dtype=np.int32)
+        w_full = np.zeros(e_pad, dtype=np.float32)
+        src_full[:n_edges] = s_sorted
+        dst_full[:n_edges] = d_sorted
+        w_full[:n_edges] = w_sorted
 
-    # CSC mirror: (dst, src)-sorted. Reuse the (src, dst)-sorted arrays with
-    # one single-key stable sort — stability preserves the src order within
-    # equal dst, giving (dst, src) lexicographic order at half the sort cost.
-    corder = np.argsort(d_sorted, kind="stable")
-    csc_src = np.full(e_pad, sink, dtype=np.int32)
-    csc_dst = np.full(e_pad, sink, dtype=np.int32)
-    csc_w = np.zeros(e_pad, dtype=np.float32)
-    csc_src[:n_edges] = s_sorted[corder]
-    csc_dst[:n_edges] = d_sorted[corder]
-    csc_w[:n_edges] = w_sorted[corder]
+        # CSC mirror: (dst, src)-sorted. Reuse the (src, dst)-sorted arrays
+        # with one single-key stable sort — stability preserves the src order
+        # within equal dst, giving (dst, src) order at half the sort cost.
+        corder = np.argsort(d_sorted, kind="stable")
+        csc_src = np.full(e_pad, sink, dtype=np.int32)
+        csc_dst = np.full(e_pad, sink, dtype=np.int32)
+        csc_w = np.zeros(e_pad, dtype=np.float32)
+        csc_src[:n_edges] = s_sorted[corder]
+        csc_dst[:n_edges] = d_sorted[corder]
+        csc_w[:n_edges] = w_sorted[corder]
 
-    counts = np.bincount(s_sorted, minlength=n_pad).astype(np.int64)
-    row_ptr = np.zeros(n_pad + 1, dtype=np.int32)
-    np.cumsum(counts, out=row_ptr[1:])
+        counts = np.bincount(s_sorted, minlength=n_pad).astype(np.int64)
+        row_ptr = np.zeros(n_pad + 1, dtype=np.int32)
+        np.cumsum(counts, out=row_ptr[1:])
 
-    out_degree = np.zeros(n_pad, dtype=np.float32)
-    out_degree[:n_nodes] = np.bincount(
-        src, minlength=n_nodes).astype(np.float32)[:n_nodes]
+        out_degree = np.zeros(n_pad, dtype=np.float32)
+        out_degree[:n_nodes] = np.bincount(
+            src, minlength=n_nodes).astype(np.float32)[:n_nodes]
 
     if node_gids is None:
         node_gids = np.arange(n_nodes, dtype=np.int64)
