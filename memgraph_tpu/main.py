@@ -74,6 +74,11 @@ def build_database(args) -> InterpreterContext:
         "advertised_address": f"localhost:{args.bolt_port}",
     })
 
+    # warm the native CSR builder at startup so the first analytics query
+    # doesn't pay the compile
+    from .ops.native import get_lib
+    get_lib()
+
     # trigger store wiring (registers its commit hook)
     from .query.triggers import global_trigger_store
     global_trigger_store(ictx)

@@ -1,0 +1,133 @@
+"""Louvain community detection (modularity maximization).
+
+Counterpart of /root/reference/mage/cpp/community_detection_module/ (Louvain
+via grappolo) and cugraph_module/algorithms/louvain.cu. Host implementation
+over the exported COO arrays: local-move phase with modularity gain, then
+graph aggregation, repeated until modularity converges. The label-propagation
+module (labelprop.py) covers the massively-parallel regime; Louvain is the
+quality reference.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from .csr import DeviceGraph
+
+
+def louvain(graph: DeviceGraph, max_levels: int = 10,
+            min_gain: float = 1e-7, seed: int = 0):
+    """Returns (community[:n_nodes] np.int64, modularity float).
+
+    Treats the graph as undirected (weights symmetrized), standard Louvain.
+    """
+    n = graph.n_nodes
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), 0.0
+    src = np.asarray(graph.src_idx)[:graph.n_edges].astype(np.int64)
+    dst = np.asarray(graph.col_idx)[:graph.n_edges].astype(np.int64)
+    w = np.asarray(graph.weights)[:graph.n_edges].astype(np.float64)
+
+    # symmetrize
+    s = np.concatenate([src, dst])
+    d = np.concatenate([dst, src])
+    ww = np.concatenate([w, w])
+
+    mapping = np.arange(n, dtype=np.int64)  # node -> final community
+    cur_n = n
+
+    for _level in range(max_levels):
+        comm, gain = _one_level(cur_n, s, d, ww, min_gain, seed)
+        mapping = comm[mapping]
+        if gain < min_gain:
+            break
+        # aggregate: communities become nodes
+        uniq, new_ids = np.unique(comm, return_inverse=True)
+        mapping = new_ids[mapping]
+        s2 = new_ids[s]
+        d2 = new_ids[d]
+        # merge parallel edges
+        keys = s2 * len(uniq) + d2
+        order = np.argsort(keys, kind="stable")
+        keys_s = keys[order]
+        w_s = ww[order]
+        boundaries = np.concatenate([[True], keys_s[1:] != keys_s[:-1]])
+        group_ids = np.cumsum(boundaries) - 1
+        agg_w = np.zeros(group_ids[-1] + 1 if len(group_ids) else 0)
+        np.add.at(agg_w, group_ids, w_s)
+        first_idx = np.nonzero(boundaries)[0]
+        s = keys_s[first_idx] // len(uniq)
+        d = keys_s[first_idx] % len(uniq)
+        ww = agg_w
+        cur_n = len(uniq)
+        if cur_n <= 1:
+            break
+
+    modularity = _modularity(n, np.concatenate([src, dst]),
+                             np.concatenate([dst, src]),
+                             np.concatenate([w, w]), mapping)
+    # compact ids
+    _, compact = np.unique(mapping, return_inverse=True)
+    return compact.astype(np.int64), float(modularity)
+
+
+def _one_level(n, s, d, w, min_gain, seed):
+    """Local-move phase; returns (community assignment, total gain)."""
+    m2 = w.sum()  # = 2m for the symmetrized graph
+    if m2 <= 0:
+        return np.arange(n, dtype=np.int64), 0.0
+    # adjacency as python dicts for the move loop
+    neighbors: list[dict] = [defaultdict(float) for _ in range(n)]
+    k = np.zeros(n)  # weighted degree
+    for si, di, wi in zip(s, d, w):
+        if si != di:
+            neighbors[si][di] += wi
+        k[si] += wi
+    comm = np.arange(n, dtype=np.int64)
+    comm_tot = k.copy()  # total degree per community
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    total_gain = 0.0
+    improved = True
+    rounds = 0
+    while improved and rounds < 20:
+        improved = False
+        rounds += 1
+        for v in order:
+            cv = comm[v]
+            kv = k[v]
+            # weights to neighboring communities
+            links: dict[int, float] = defaultdict(float)
+            for u, wu in neighbors[v].items():
+                links[comm[u]] += wu
+            comm_tot[cv] -= kv
+            best_c, best_gain = cv, 0.0
+            base = links.get(cv, 0.0) - comm_tot[cv] * kv / m2
+            for c, wc in links.items():
+                if c == cv:
+                    continue
+                gain = (wc - comm_tot[c] * kv / m2) - base
+                if gain > best_gain:
+                    best_gain, best_c = gain, c
+            comm[v] = best_c
+            comm_tot[best_c] += kv
+            if best_c != cv and best_gain > min_gain:
+                improved = True
+                total_gain += best_gain
+    return comm, total_gain
+
+
+def _modularity(n, s, d, w, comm):
+    m2 = w.sum()
+    if m2 <= 0:
+        return 0.0
+    internal = w[comm[s] == comm[d]].sum()
+    k = np.zeros(n)
+    np.add.at(k, s, w)
+    comm_deg = defaultdict(float)
+    for v in range(n):
+        comm_deg[comm[v]] += k[v]
+    expected = sum(x * x for x in comm_deg.values()) / (m2 * m2)
+    return internal / m2 - expected

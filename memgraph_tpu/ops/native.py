@@ -33,14 +33,22 @@ def _ensure_built() -> bool:
     src = os.path.join(_NATIVE_DIR, "csr_builder.cpp")
     if not os.path.exists(src):
         return False
+    # compile to a temp name and rename: an interrupted build must never
+    # leave a half-written .so that later loads treat as valid
+    tmp = _LIB_PATH + f".tmp{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-Wall",
-             "-o", _LIB_PATH, src],
+             "-o", tmp, src],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         log.info("native csr builder unavailable (%s); using numpy path", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -109,6 +117,11 @@ def build_csr_csc_native(src: np.ndarray, dst: np.ndarray,
         p32(csr_src), p32(csr_dst), pf(csr_w),
         p32(csc_src), p32(csc_dst), pf(csc_w),
         p32(row_ptr), pf(out_degree))
+    if rc == 2:
+        # invalid input, not "builder unavailable": the numpy path would
+        # silently build a corrupt graph from the same ids
+        raise ValueError(
+            f"edge endpoint id out of range [0, {n_nodes}) in COO input")
     if rc != 0:
         log.warning("native csr builder returned %d; falling back", rc)
         return None

@@ -1,0 +1,77 @@
+"""Node similarity: Jaccard / overlap / cosine over neighborhoods.
+
+Counterpart of /root/reference/mage/cpp/node_similarity_module/. Two
+regimes:
+  - dense MXU path (n_nodes <= dense_limit): boolean adjacency as a
+    bfloat16 matrix; common-neighbor counts are one A @ A^T matmul — the
+    formulation TPUs are built for
+  - host path: per-pair neighbor-set intersection for specific pairs
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .csr import DeviceGraph
+
+DENSE_LIMIT = 8192
+
+
+@partial(jax.jit, static_argnames=("n", "mode"))
+def _dense_similarity(src, dst, e_mask, n: int, mode: str):
+    adj = jnp.zeros((n, n), dtype=jnp.float32)
+    adj = adj.at[src, dst].max(e_mask)  # boolean adjacency (out-neighbors)
+    common = jax.lax.dot_general(
+        adj.astype(jnp.bfloat16), adj.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    deg = jnp.sum(adj, axis=1)
+    if mode == "jaccard":
+        union = deg[:, None] + deg[None, :] - common
+        return jnp.where(union > 0, common / jnp.maximum(union, 1e-9), 0.0)
+    if mode == "overlap":
+        m = jnp.minimum(deg[:, None], deg[None, :])
+        return jnp.where(m > 0, common / jnp.maximum(m, 1e-9), 0.0)
+    # cosine
+    denom = jnp.sqrt(deg[:, None] * deg[None, :])
+    return jnp.where(denom > 0, common / jnp.maximum(denom, 1e-9), 0.0)
+
+
+def similarity_matrix(graph: DeviceGraph, mode: str = "jaccard"):
+    """(n, n) similarity matrix via the MXU (n_nodes <= DENSE_LIMIT)."""
+    if graph.n_nodes > DENSE_LIMIT:
+        raise ValueError(
+            f"dense similarity limited to {DENSE_LIMIT} nodes; "
+            f"use pairwise_similarity for larger graphs")
+    e_mask = (jnp.arange(graph.e_pad) < graph.n_edges).astype(jnp.float32)
+    # clip sink ids into range for the scatter; masked entries write 0
+    src = jnp.minimum(graph.src_idx, graph.n_nodes - 1)
+    dst = jnp.minimum(graph.col_idx, graph.n_nodes - 1)
+    return _dense_similarity(src, dst, e_mask, graph.n_nodes, mode)
+
+
+def pairwise_similarity(graph: DeviceGraph, pairs, mode: str = "jaccard"):
+    """[(i, j, score)] for explicit node-index pairs (host set ops)."""
+    row_ptr = np.asarray(graph.row_ptr)
+    col = np.asarray(graph.col_idx)
+
+    def neigh(v):
+        return set(col[row_ptr[v]:row_ptr[v + 1]].tolist())
+
+    out = []
+    cache: dict[int, set] = {}
+    for (i, j) in pairs:
+        si = cache.setdefault(i, neigh(i))
+        sj = cache.setdefault(j, neigh(j))
+        inter = len(si & sj)
+        if mode == "jaccard":
+            denom = len(si | sj)
+        elif mode == "overlap":
+            denom = min(len(si), len(sj))
+        else:
+            denom = (len(si) * len(sj)) ** 0.5
+        out.append((i, j, inter / denom if denom else 0.0))
+    return out

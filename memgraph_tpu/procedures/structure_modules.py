@@ -1,0 +1,212 @@
+"""Structure modules: louvain, node similarity, bridges, cycles,
+biconnected components, point index, nxalg bridge.
+
+Counterparts: mage/cpp/{community_detection,node_similarity,bridges,cycles,
+biconnected_components}_module and the reference's NetworkX bridge
+(query_modules/nxalg.py, mgp_networkx.py) — the same delegation pattern:
+export the visible graph, run the algorithm, stream rows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import mgp
+
+
+@mgp.read_proc("community_detection.louvain",
+               opt_args=[("weight_property", "STRING", None)],
+               results=[("node", "NODE"), ("community_id", "INTEGER"),
+                        ("modularity", "FLOAT")])
+def louvain_proc(ctx, weight_property=None):
+    from ..ops.louvain import louvain
+    graph = ctx.device_graph(weight_property=weight_property)
+    if graph.n_nodes == 0:
+        return
+    comm, modularity = louvain(graph)
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "community_id": int(comm[i]) + 1,
+                   "modularity": modularity}
+
+
+@mgp.read_proc("node_similarity.jaccard",
+               results=[("node1", "NODE"), ("node2", "NODE"),
+                        ("similarity", "FLOAT")])
+def jaccard_all(ctx):
+    yield from _similarity_all(ctx, "jaccard")
+
+
+@mgp.read_proc("node_similarity.overlap",
+               results=[("node1", "NODE"), ("node2", "NODE"),
+                        ("similarity", "FLOAT")])
+def overlap_all(ctx):
+    yield from _similarity_all(ctx, "overlap")
+
+
+@mgp.read_proc("node_similarity.cosine",
+               results=[("node1", "NODE"), ("node2", "NODE"),
+                        ("similarity", "FLOAT")])
+def cosine_all(ctx):
+    yield from _similarity_all(ctx, "cosine")
+
+
+def _similarity_all(ctx, mode):
+    from ..ops.similarity import DENSE_LIMIT, similarity_matrix
+    graph = ctx.device_graph()
+    n = graph.n_nodes
+    if n == 0:
+        return
+    if n > DENSE_LIMIT:
+        from ..exceptions import ProcedureException
+        raise ProcedureException(
+            f"all-pairs similarity supports up to {DENSE_LIMIT} nodes; "
+            f"use node_similarity.pairwise for larger graphs")
+    sim = np.asarray(similarity_matrix(graph, mode))
+    for i in range(n):
+        ni = ctx.vertex_by_index(graph, i)
+        if ni is None:
+            continue
+        for j in range(i + 1, n):
+            if sim[i, j] <= 0:
+                continue
+            nj = ctx.vertex_by_index(graph, j)
+            if nj is not None:
+                yield {"node1": ni, "node2": nj,
+                       "similarity": float(sim[i, j])}
+
+
+@mgp.read_proc("node_similarity.pairwise",
+               args=[("pairs", "LIST")],
+               opt_args=[("mode", "STRING", "jaccard")],
+               results=[("node1", "NODE"), ("node2", "NODE"),
+                        ("similarity", "FLOAT")])
+def pairwise(ctx, pairs, mode="jaccard"):
+    from ..ops.similarity import pairwise_similarity
+    graph = ctx.device_graph()
+    index_pairs = []
+    for pair in pairs:
+        if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+            continue
+        a, b = pair
+        ia = graph.gid_to_idx.get(a.gid) if a is not None else None
+        ib = graph.gid_to_idx.get(b.gid) if b is not None else None
+        if ia is not None and ib is not None:
+            index_pairs.append((ia, ib))
+    for (i, j, score) in pairwise_similarity(graph, index_pairs, str(mode)):
+        n1 = ctx.vertex_by_index(graph, i)
+        n2 = ctx.vertex_by_index(graph, j)
+        if n1 is not None and n2 is not None:
+            yield {"node1": n1, "node2": n2, "similarity": float(score)}
+
+
+def _nx_graph(ctx, graph, directed=False):
+    import networkx as nx
+    g = nx.DiGraph() if directed else nx.Graph()
+    g.add_nodes_from(range(graph.n_nodes))
+    src = np.asarray(graph.src_idx)[:graph.n_edges]
+    dst = np.asarray(graph.col_idx)[:graph.n_edges]
+    g.add_edges_from(zip(src.tolist(), dst.tolist()))
+    return g
+
+
+@mgp.read_proc("bridges.get",
+               results=[("node_from", "NODE"), ("node_to", "NODE")])
+def bridges_get(ctx):
+    """Bridge edges (mage/cpp/bridges_module counterpart)."""
+    import networkx as nx
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    g = _nx_graph(ctx, graph, directed=False)
+    for (u, v) in nx.bridges(g):
+        nu = ctx.vertex_by_index(graph, u)
+        nv = ctx.vertex_by_index(graph, v)
+        if nu is not None and nv is not None:
+            yield {"node_from": nu, "node_to": nv}
+
+
+@mgp.read_proc("cycles.get", results=[("cycle", "LIST")])
+def cycles_get(ctx):
+    """Simple cycles (mage/cpp/cycles_module counterpart; undirected base
+    cycles via the cycle basis)."""
+    import networkx as nx
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    g = _nx_graph(ctx, graph, directed=False)
+    for cycle in nx.cycle_basis(g):
+        nodes = [ctx.vertex_by_index(graph, v) for v in cycle]
+        if all(n is not None for n in nodes):
+            yield {"cycle": nodes}
+
+
+@mgp.read_proc("biconnected_components.get",
+               results=[("bcc_id", "INTEGER"), ("node_from", "NODE"),
+                        ("node_to", "NODE")])
+def biconnected_get(ctx):
+    import networkx as nx
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    g = _nx_graph(ctx, graph, directed=False)
+    for bcc_id, comp_edges in enumerate(nx.biconnected_component_edges(g)):
+        for (u, v) in comp_edges:
+            nu = ctx.vertex_by_index(graph, u)
+            nv = ctx.vertex_by_index(graph, v)
+            if nu is not None and nv is not None:
+                yield {"bcc_id": bcc_id, "node_from": nu, "node_to": nv}
+
+
+@mgp.read_proc("nxalg.betweenness_centrality",
+               opt_args=[("normalized", "BOOLEAN", True)],
+               results=[("node", "NODE"), ("betweenness", "FLOAT")])
+def nx_betweenness(ctx, normalized=True):
+    """Exact Brandes via the NetworkX bridge (reference: nxalg.py)."""
+    import networkx as nx
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    g = _nx_graph(ctx, graph, directed=True)
+    bc = nx.betweenness_centrality(g, normalized=bool(normalized))
+    for i, score in bc.items():
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "betweenness": float(score)}
+
+
+# --- point index procedures --------------------------------------------------
+
+@mgp.write_proc("point_index.create",
+                args=[("label", "STRING"), ("property", "STRING")],
+                results=[("status", "STRING")])
+def point_index_create(ctx, label, property):
+    from ..storage.point_index import point_indices
+    point_indices(ctx.storage).create(str(label), str(property))
+    yield {"status": "point index created"}
+
+
+@mgp.write_proc("point_index.drop",
+                args=[("label", "STRING"), ("property", "STRING")],
+                results=[("status", "STRING")])
+def point_index_drop(ctx, label, property):
+    from ..storage.point_index import point_indices
+    dropped = point_indices(ctx.storage).drop(str(label), str(property))
+    yield {"status": "dropped" if dropped else "no such index"}
+
+
+@mgp.read_proc("point_index.within_distance",
+               args=[("label", "STRING"), ("property", "STRING"),
+                     ("center", "POINT"), ("radius", "FLOAT")],
+               results=[("node", "NODE"), ("distance", "FLOAT")])
+def point_within_distance(ctx, label, property, center, radius):
+    from ..storage.point_index import point_indices
+    from ..exceptions import ProcedureException
+    index = point_indices(ctx.storage).get(str(label), str(property))
+    if index is None:
+        raise ProcedureException("point index does not exist")
+    for gid, dist in index.within_distance(center, float(radius)):
+        node = ctx.accessor.find_vertex(gid, ctx.view)
+        if node is not None:
+            yield {"node": node, "distance": float(dist)}

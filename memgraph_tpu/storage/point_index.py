@@ -1,0 +1,150 @@
+"""Spatial point index: uniform grid buckets per (label, property).
+
+Counterpart of the reference's point index
+(/root/reference/src/storage/v2/indices/point_index.cpp): accelerates
+point.distance / withinbbox queries. Grid cells hash (floor(x/cell),
+floor(y/cell)); WGS-84 uses degree cells (distance filtering re-validates
+exactly, so cell size only affects candidate counts).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import defaultdict
+
+from ..utils.point import Point
+
+
+class PointIndex:
+    def __init__(self, label_id: int, prop_id: int, cell_size: float = 1.0):
+        self.label_id = label_id
+        self.prop_id = prop_id
+        self.cell_size = cell_size
+        self._lock = threading.Lock()
+        self._cells: dict[tuple[int, int], dict[int, tuple]] = \
+            defaultdict(dict)  # cell -> {gid: (vertex, point)}
+        self._by_gid: dict[int, tuple[int, int]] = {}
+
+    def _cell(self, p: Point) -> tuple[int, int]:
+        return (math.floor(p.x / self.cell_size),
+                math.floor(p.y / self.cell_size))
+
+    def add_vertex(self, vertex) -> None:
+        value = vertex.properties.get(self.prop_id)
+        with self._lock:
+            self._remove_locked(vertex.gid)
+            if (self.label_id not in vertex.labels or vertex.deleted
+                    or not isinstance(value, Point)):
+                return
+            cell = self._cell(value)
+            self._cells[cell][vertex.gid] = (vertex, value)
+            self._by_gid[vertex.gid] = cell
+
+    def remove_vertex(self, gid: int) -> None:
+        with self._lock:
+            self._remove_locked(gid)
+
+    def _remove_locked(self, gid: int) -> None:
+        cell = self._by_gid.pop(gid, None)
+        if cell is not None:
+            self._cells[cell].pop(gid, None)
+
+    def rebuild(self, vertices) -> None:
+        with self._lock:
+            self._cells.clear()
+            self._by_gid.clear()
+        for v in vertices:
+            self.add_vertex(v)
+
+    def within_distance(self, center: Point, radius: float
+                        ) -> list[tuple[int, float]]:
+        """[(gid, distance)] within radius (exact re-validation per hit)."""
+        # conservative cell radius: WGS degrees ≈ 111km
+        cell_r = radius / (111_000.0 if center.crs.is_wgs else 1.0)
+        cr = max(1, math.ceil(cell_r / self.cell_size))
+        cx, cy = self._cell(center)
+        out = []
+        with self._lock:
+            for dx in range(-cr, cr + 1):
+                for dy in range(-cr, cr + 1):
+                    for gid, (v, p) in self._cells.get(
+                            (cx + dx, cy + dy), {}).items():
+                        try:
+                            d = center.distance(p)
+                        except Exception:
+                            continue
+                        if d <= radius:
+                            out.append((gid, d))
+        out.sort(key=lambda t: t[1])
+        return out
+
+    def within_bbox(self, lo: Point, hi: Point) -> list[int]:
+        clo, chi = self._cell(lo), self._cell(hi)
+        out = []
+        with self._lock:
+            for cx in range(clo[0], chi[0] + 1):
+                for cy in range(clo[1], chi[1] + 1):
+                    for gid, (v, p) in self._cells.get((cx, cy), {}).items():
+                        if lo.x <= p.x <= hi.x and lo.y <= p.y <= hi.y:
+                            out.append(gid)
+        return out
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._by_gid)
+
+
+class PointIndices:
+    def __init__(self, storage) -> None:
+        self.storage = storage
+        self._lock = threading.Lock()
+        self._indexes: dict[tuple[int, int], PointIndex] = {}
+        storage.on_commit_hooks.append(self._on_commit)
+
+    def create(self, label_name: str, prop_name: str) -> PointIndex:
+        from ..exceptions import QueryException
+        lid = self.storage.label_mapper.name_to_id(label_name)
+        pid = self.storage.property_mapper.name_to_id(prop_name)
+        with self._lock:
+            if (lid, pid) in self._indexes:
+                raise QueryException("point index already exists")
+        index = PointIndex(lid, pid)
+        index.rebuild(list(self.storage._vertices.values()))
+        with self._lock:
+            self._indexes[(lid, pid)] = index
+        return index
+
+    def drop(self, label_name: str, prop_name: str) -> bool:
+        lid = self.storage.label_mapper.maybe_name_to_id(label_name)
+        pid = self.storage.property_mapper.maybe_name_to_id(prop_name)
+        with self._lock:
+            return self._indexes.pop((lid, pid), None) is not None
+
+    def get(self, label_name: str, prop_name: str) -> PointIndex | None:
+        lid = self.storage.label_mapper.maybe_name_to_id(label_name)
+        pid = self.storage.property_mapper.maybe_name_to_id(prop_name)
+        with self._lock:
+            return self._indexes.get((lid, pid))
+
+    def all(self):
+        with self._lock:
+            return dict(self._indexes)
+
+    def _on_commit(self, txn, commit_ts) -> None:
+        with self._lock:
+            indexes = list(self._indexes.values())
+        if not indexes:
+            return
+        for vertex in txn.touched_vertices.values():
+            for index in indexes:
+                if vertex.deleted:
+                    index.remove_vertex(vertex.gid)
+                else:
+                    index.add_vertex(vertex)
+
+
+def point_indices(storage) -> PointIndices:
+    if storage.indices.point is None:
+        storage.indices.point = PointIndices(storage)
+    return storage.indices.point

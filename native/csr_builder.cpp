@@ -66,8 +66,7 @@ int build_csr_csc(const int64_t* src, const int64_t* dst,
   for (int64_t v = 1; v <= n_nodes; ++v) offset[v] = offset[v - 1] + count[v - 1];
   // row_ptr over the padded node range
   for (int64_t v = 0; v <= n_pad; ++v) {
-    row_ptr[v] = static_cast<int32_t>(v <= n_nodes ? offset[v > n_nodes ? n_nodes : v]
-                                                   : n_edges);
+    row_ptr[v] = static_cast<int32_t>(v <= n_nodes ? offset[v] : n_edges);
   }
   for (int64_t v = 0; v < n_pad; ++v) {
     out_degree[v] = (v < n_nodes) ? static_cast<float>(count[v]) : 0.0f;

@@ -47,8 +47,8 @@ def test_native_matches_numpy(lib):
 def test_native_rejects_bad_ids(lib):
     src = np.array([0, 5], dtype=np.int64)  # 5 >= n_nodes
     dst = np.array([0, 1], dtype=np.int64)
-    out = build_csr_csc_native(src, dst, None, 3, 8, 8)
-    assert out is None  # error surfaced as fallback
+    with pytest.raises(ValueError):  # corrupt input must not fall back
+        build_csr_csc_native(src, dst, None, 3, 8, 8)
 
 
 def test_from_coo_uses_native_and_kernels_agree(lib):

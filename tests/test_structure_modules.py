@@ -1,0 +1,120 @@
+"""Louvain, node similarity (MXU dense path), bridges/cycles, point index."""
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def _two_cliques(db):
+    run(db, """
+        UNWIND range(0, 4) AS i UNWIND range(0, 4) AS j
+        WITH i, j WHERE i < j
+        MERGE (a:N {id: i}) MERGE (b:N {id: j}) CREATE (a)-[:E]->(b)""")
+    run(db, """
+        UNWIND range(5, 9) AS i UNWIND range(5, 9) AS j
+        WITH i, j WHERE i < j
+        MERGE (a:N {id: i}) MERGE (b:N {id: j}) CREATE (a)-[:E]->(b)""")
+    run(db, "MATCH (a:N {id: 0}), (b:N {id: 5}) CREATE (a)-[:E]->(b)")
+
+
+def test_louvain_two_cliques(db):
+    _two_cliques(db)
+    rows = run(db, "CALL community_detection.louvain() "
+                   "YIELD node, community_id, modularity "
+                   "RETURN node.id, community_id, modularity")
+    comm = {r[0]: r[1] for r in rows}
+    assert len({comm[i] for i in range(5)}) == 1
+    assert len({comm[i] for i in range(5, 10)}) == 1
+    assert comm[0] != comm[5]
+    assert rows[0][2] > 0.3  # decent modularity
+
+
+def test_louvain_matches_networkx_quality(db):
+    import networkx as nx
+    _two_cliques(db)
+    rows = run(db, "CALL community_detection.louvain() "
+                   "YIELD modularity RETURN modularity LIMIT 1")
+    assert rows[0][0] >= 0.3
+
+
+def test_node_similarity_jaccard(db):
+    # a -> {x, y}; b -> {x, y}; c -> {x}
+    run(db, """CREATE (a:S {k:'a'}), (b:S {k:'b'}), (c:S {k:'c'}),
+                      (x:S {k:'x'}), (y:S {k:'y'}),
+                      (a)-[:E]->(x), (a)-[:E]->(y),
+                      (b)-[:E]->(x), (b)-[:E]->(y),
+                      (c)-[:E]->(x)""")
+    rows = run(db, "CALL node_similarity.jaccard() "
+                   "YIELD node1, node2, similarity "
+                   "RETURN node1.k, node2.k, similarity")
+    sim = {(min(a, b), max(a, b)): s for a, b, s in rows}
+    assert sim[("a", "b")] == pytest.approx(1.0, abs=0.05)
+    assert sim[("a", "c")] == pytest.approx(0.5, abs=0.05)
+
+
+def test_node_similarity_pairwise(db):
+    run(db, """CREATE (a:P {k:'a'}), (b:P {k:'b'}), (x:P), (y:P),
+                      (a)-[:E]->(x), (a)-[:E]->(y), (b)-[:E]->(x)""")
+    rows = run(db, "MATCH (a:P {k:'a'}), (b:P {k:'b'}) "
+                   "CALL node_similarity.pairwise([[a, b]], 'overlap') "
+                   "YIELD similarity RETURN similarity")
+    assert rows[0][0] == pytest.approx(1.0)
+
+
+def test_bridges(db):
+    # two triangles joined by one bridge edge
+    run(db, """CREATE (a:B {i:0}), (b:B {i:1}), (c:B {i:2}),
+                      (d:B {i:3}), (e:B {i:4}), (f:B {i:5}),
+                      (a)-[:E]->(b), (b)-[:E]->(c), (c)-[:E]->(a),
+                      (d)-[:E]->(e), (e)-[:E]->(f), (f)-[:E]->(d),
+                      (c)-[:E]->(d)""")
+    rows = run(db, "CALL bridges.get() YIELD node_from, node_to "
+                   "RETURN node_from.i, node_to.i")
+    assert len(rows) == 1
+    assert sorted(rows[0]) == [2, 3]
+
+
+def test_cycles(db):
+    run(db, """CREATE (a:C), (b:C), (c:C),
+                      (a)-[:E]->(b), (b)-[:E]->(c), (c)-[:E]->(a)""")
+    rows = run(db, "CALL cycles.get() YIELD cycle RETURN size(cycle)")
+    assert rows == [[3]]
+
+
+def test_point_index(db):
+    run(db, """CREATE (:Place {name: 'near', loc: point({x: 1.0, y: 1.0})}),
+                      (:Place {name: 'far', loc: point({x: 100.0, y: 100.0})})""")
+    run(db, "CALL point_index.create('Place', 'loc') YIELD status "
+            "RETURN status")
+    rows = run(db, "CALL point_index.within_distance('Place', 'loc', "
+                   "point({x: 0.0, y: 0.0}), 5.0) "
+                   "YIELD node, distance RETURN node.name, distance")
+    assert len(rows) == 1
+    assert rows[0][0] == "near"
+    # index tracks later commits
+    run(db, "CREATE (:Place {name: 'also-near', loc: point({x: 2.0, y: 0.0})})")
+    rows = run(db, "CALL point_index.within_distance('Place', 'loc', "
+                   "point({x: 0.0, y: 0.0}), 5.0) YIELD node "
+                   "RETURN count(node)")
+    assert rows == [[2]]
+
+
+def test_nxalg_betweenness(db):
+    run(db, """CREATE (a:X), (b:X), (c:X),
+                      (a)-[:E]->(b), (b)-[:E]->(c)""")
+    rows = run(db, "CALL nxalg.betweenness_centrality() "
+                   "YIELD node, betweenness RETURN betweenness "
+                   "ORDER BY betweenness DESC")
+    assert rows[0][0] > 0  # the middle node carries the path
