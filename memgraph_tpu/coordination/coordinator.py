@@ -1,0 +1,183 @@
+"""Coordinator instance: Raft-replicated cluster state + failover.
+
+Counterpart of the reference's CoordinatorInstance
+(/root/reference/src/coordination/coordinator_instance.cpp): the Raft
+leader health-checks every data instance (StateCheck RPC analog, :478-502);
+after `FAILOVER_MISS_THRESHOLD` consecutive misses of the MAIN it runs
+TryFailover (:542-585): pick the most up-to-date alive replica, commit the
+new topology through Raft, then promote/demote the data instances.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .data_instance import mgmt_call
+from .raft import RaftNode
+
+log = logging.getLogger(__name__)
+
+
+class CoordinatorInstance:
+    HEALTH_CHECK_INTERVAL = 0.5
+    FAILOVER_MISS_THRESHOLD = 3
+
+    def __init__(self, node_id: str, host: str, raft_port: int,
+                 peers: dict[str, tuple[str, int]]):
+        self.raft = RaftNode(node_id, host, raft_port, peers,
+                             apply_fn=self._apply)
+        # replicated cluster state: name -> instance descriptor
+        self.instances: dict[str, dict] = {}
+        self.main_name: str | None = None
+        self._lock = threading.Lock()
+        self._miss_counts: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.raft.start()
+        self._health_thread = threading.Thread(target=self._health_loop,
+                                               daemon=True)
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.raft.stop()
+
+    # --- replicated state machine -------------------------------------------
+
+    def _apply(self, command: dict) -> None:
+        """Applied on EVERY coordinator for each committed Raft entry."""
+        op = command.get("op")
+        with self._lock:
+            if op == "register_instance":
+                self.instances[command["name"]] = {
+                    "name": command["name"],
+                    "mgmt_address": command["mgmt_address"],
+                    "replication_address": command["replication_address"],
+                    "role": "replica",
+                }
+            elif op == "unregister_instance":
+                self.instances.pop(command["name"], None)
+                if self.main_name == command["name"]:
+                    self.main_name = None
+            elif op == "set_main":
+                name = command["name"]
+                for inst in self.instances.values():
+                    inst["role"] = "replica"
+                if name in self.instances:
+                    self.instances[name]["role"] = "main"
+                    self.main_name = name
+
+    # --- client operations (leader only) ------------------------------------
+
+    def register_instance(self, name: str, mgmt_address: str,
+                          replication_address: str) -> bool:
+        return self.raft.propose({
+            "op": "register_instance", "name": name,
+            "mgmt_address": mgmt_address,
+            "replication_address": replication_address})
+
+    def unregister_instance(self, name: str) -> bool:
+        return self.raft.propose({"op": "unregister_instance", "name": name})
+
+    def set_instance_to_main(self, name: str) -> bool:
+        """Explicit promotion: commit through Raft, then reconfigure."""
+        with self._lock:
+            if name not in self.instances:
+                return False
+        if not self.raft.propose({"op": "set_main", "name": name}):
+            return False
+        self._reconfigure_data_instances(name)
+        return True
+
+    def show_instances(self) -> list[list]:
+        with self._lock:
+            instances = [dict(i) for i in self.instances.values()]
+        rows = []
+        is_leader = self.raft.is_leader()
+        for inst in sorted(instances, key=lambda i: i["name"]):
+            health = "unknown"
+            if is_leader:
+                misses = self._miss_counts.get(inst["name"], 0)
+                health = "up" if misses == 0 else (
+                    "down" if misses >= self.FAILOVER_MISS_THRESHOLD
+                    else "degraded")
+            rows.append([inst["name"], inst["mgmt_address"],
+                         inst["role"], health])
+        rows.append([self.raft.node_id, f"raft:{self.raft.port}",
+                     "leader" if is_leader else "coordinator", "up"])
+        return rows
+
+    # --- health checks + failover (leader) ----------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.HEALTH_CHECK_INTERVAL):
+            if not self.raft.is_leader():
+                continue
+            with self._lock:
+                instances = [dict(i) for i in self.instances.values()]
+                main_name = self.main_name
+            for inst in instances:
+                resp = mgmt_call(inst["mgmt_address"],
+                                 {"kind": "state_check"}, timeout=1.0)
+                name = inst["name"]
+                if resp is None or not resp.get("ok"):
+                    self._miss_counts[name] = \
+                        self._miss_counts.get(name, 0) + 1
+                else:
+                    self._miss_counts[name] = 0
+            if main_name is not None and \
+                    self._miss_counts.get(main_name, 0) >= \
+                    self.FAILOVER_MISS_THRESHOLD:
+                self._try_failover(main_name)
+
+    def _try_failover(self, failed_main: str) -> None:
+        """Choose the most up-to-date alive replica and promote it."""
+        with self._lock:
+            candidates = [dict(i) for i in self.instances.values()
+                          if i["name"] != failed_main]
+        best_name, best_ts = None, -1
+        for inst in candidates:
+            resp = mgmt_call(inst["mgmt_address"], {"kind": "state_check"},
+                             timeout=1.0)
+            if resp is None or not resp.get("ok"):
+                continue
+            ts = resp.get("last_commit_ts", 0)
+            if ts > best_ts:
+                best_name, best_ts = inst["name"], ts
+        if best_name is None:
+            log.error("failover: no alive replica to promote")
+            return
+        log.warning("failover: promoting %s (last_commit_ts=%d) to MAIN",
+                    best_name, best_ts)
+        if not self.raft.propose({"op": "set_main", "name": best_name}):
+            log.error("failover: raft commit failed")
+            return
+        self._reconfigure_data_instances(best_name)
+
+    def _reconfigure_data_instances(self, new_main: str) -> None:
+        with self._lock:
+            instances = [dict(i) for i in self.instances.values()]
+        replicas = []
+        for inst in instances:
+            if inst["name"] == new_main:
+                continue
+            # demote (best effort — the failed MAIN may be unreachable)
+            port = int(inst["replication_address"].rpartition(":")[2])
+            mgmt_call(inst["mgmt_address"],
+                      {"kind": "demote", "replication_port": port},
+                      timeout=2.0)
+            replicas.append({"name": inst["name"],
+                             "address": inst["replication_address"],
+                             "mode": "SYNC"})
+        resp = mgmt_call(
+            next(i["mgmt_address"] for i in instances
+                 if i["name"] == new_main),
+            {"kind": "promote", "replicas": replicas}, timeout=10.0)
+        if resp is None or not resp.get("ok"):
+            log.error("failover: promote of %s reported %s", new_main, resp)

@@ -1,0 +1,121 @@
+"""Management server running on each data instance.
+
+Counterpart of the reference's DataInstanceManagementServer
+(/root/reference/src/coordination/data_instance_management_server.cpp,
+registered at memgraph.cpp:964-970): answers coordinator health checks
+(STATE_CHECK) and executes promote/demote RPCs during failover.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+
+from ..replication import protocol as P
+
+log = logging.getLogger(__name__)
+
+MSG_MGMT = 0x30
+
+
+class DataInstanceManagementServer:
+    def __init__(self, interpreter_context, host="127.0.0.1", port=12000):
+        self.ictx = interpreter_context
+        self.host = host
+        self.port = port
+        self._sock: socket.socket | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(4)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _replication(self):
+        from ..replication.main_role import ReplicationState
+        if getattr(self.ictx, "replication", None) is None:
+            self.ictx.replication = ReplicationState(self.ictx.storage)
+        return self.ictx.replication
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            while not self._stop.is_set():
+                msg_type, payload = P.recv_frame(conn)
+                if msg_type != MSG_MGMT:
+                    break
+                req = json.loads(payload.decode("utf-8"))
+                resp = self._handle(req)
+                P.send_frame(conn, MSG_MGMT,
+                             json.dumps(resp).encode("utf-8"))
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, req: dict) -> dict:
+        kind = req.get("kind")
+        replication = self._replication()
+        if kind == "state_check":
+            return {"ok": True, "role": replication.role,
+                    "last_commit_ts": self.ictx.storage.latest_commit_ts()}
+        if kind == "promote":
+            # become MAIN and adopt the given replicas
+            from ..replication.main_role import ReplicationMode
+            replication.set_role_main()
+            errors = []
+            for rep in req.get("replicas", []):
+                try:
+                    replication.register_replica(
+                        rep["name"], rep["address"],
+                        ReplicationMode[rep.get("mode", "SYNC")])
+                except Exception as e:
+                    errors.append(f"{rep['name']}: {e}")
+            return {"ok": not errors, "errors": errors}
+        if kind == "demote":
+            port = req.get("replication_port", 10000)
+            try:
+                replication.set_role_replica("0.0.0.0", port)
+            except Exception as e:
+                return {"ok": False, "errors": [str(e)]}
+            return {"ok": True}
+        return {"ok": False, "errors": [f"unknown request {kind}"]}
+
+
+def mgmt_call(address: str, request: dict, timeout: float = 2.0
+              ) -> dict | None:
+    host, _, port = address.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as sock:
+            P.send_frame(sock, MSG_MGMT,
+                         json.dumps(request).encode("utf-8"))
+            msg_type, payload = P.recv_frame(sock)
+            if msg_type != MSG_MGMT:
+                return None
+            return json.loads(payload.decode("utf-8"))
+    except (ConnectionError, OSError, ValueError,
+            json.JSONDecodeError):
+        return None

@@ -1,0 +1,362 @@
+"""Raft consensus (from scratch; control-plane sized).
+
+Implements the core Raft protocol (Ongaro & Ousterhout): follower/candidate/
+leader roles, randomized election timeouts, RequestVote and AppendEntries
+RPCs over TCP (JSON payloads on the replication framing), log replication
+with per-peer nextIndex/matchIndex, commit on majority, and application of
+committed entries to a pluggable state machine.
+
+Reference analog: the NuRaft integration in
+/root/reference/src/coordination/raft_state.cpp — same role in the system,
+re-implemented because this environment ships no consensus library.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..replication import protocol as P
+
+log = logging.getLogger(__name__)
+
+MSG_RAFT = 0x20  # JSON raft message on the shared framing
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: dict
+
+    def to_json(self):
+        return {"term": self.term, "command": self.command}
+
+    @staticmethod
+    def from_json(obj):
+        return LogEntry(obj["term"], obj["command"])
+
+
+class RaftNode:
+    """One Raft participant listening on (host, port).
+
+    peers: {node_id: (host, port)} for the OTHER nodes.
+    apply_fn(command: dict) is invoked exactly once per committed entry,
+    in log order, on every node.
+    """
+
+    ELECTION_TIMEOUT = (0.6, 1.2)   # seconds, randomized
+    HEARTBEAT_INTERVAL = 0.15
+
+    def __init__(self, node_id: str, host: str, port: int,
+                 peers: dict[str, tuple[str, int]], apply_fn=None):
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self.peers = dict(peers)
+        self.apply_fn = apply_fn or (lambda cmd: None)
+
+        # persistent state (in-memory here; durability via snapshot hooks)
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []
+
+        # volatile
+        self.commit_index = -1
+        self.last_applied = -1
+        self.role = "follower"
+        self.leader_id: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._last_heartbeat = time.monotonic()
+        self._election_deadline = self._new_deadline()
+        self._sock: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._commit_events: dict[int, threading.Event] = {}
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(8)
+        for target in (self._accept_loop, self._timer_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _new_deadline(self) -> float:
+        return time.monotonic() + random.uniform(*self.ELECTION_TIMEOUT)
+
+    # --- public API ---------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == "leader"
+
+    def propose(self, command: dict, timeout: float = 5.0) -> bool:
+        """Leader-only: append a command; block until committed (majority)."""
+        with self._lock:
+            if self.role != "leader":
+                return False
+            entry = LogEntry(self.current_term, command)
+            self.log.append(entry)
+            index = len(self.log) - 1
+            event = threading.Event()
+            self._commit_events[index] = event
+            # a single-node cluster (or one whose peers are all caught up)
+            # can commit immediately — majority may already be satisfied
+            self._advance_commit()
+        self._broadcast_append()
+        ok = event.wait(timeout)
+        with self._lock:
+            self._commit_events.pop(index, None)
+        return ok and self.commit_index >= index
+
+    # --- networking ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg_type, payload = P.recv_frame(conn)
+                if msg_type != MSG_RAFT:
+                    break
+                request = json.loads(payload.decode("utf-8"))
+                response = self._handle(request)
+                P.send_frame(conn, MSG_RAFT,
+                             json.dumps(response).encode("utf-8"))
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            conn.close()
+
+    def _call_peer(self, peer_id: str, request: dict,
+                   timeout: float = 0.5) -> dict | None:
+        host, port = self.peers[peer_id]
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout) as sock:
+                P.send_frame(sock, MSG_RAFT,
+                             json.dumps(request).encode("utf-8"))
+                msg_type, payload = P.recv_frame(sock)
+                if msg_type != MSG_RAFT:
+                    return None
+                return json.loads(payload.decode("utf-8"))
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            return None
+
+    # --- RPC handlers -------------------------------------------------------
+
+    def _handle(self, req: dict) -> dict:
+        kind = req.get("kind")
+        if kind == "request_vote":
+            return self._on_request_vote(req)
+        if kind == "append_entries":
+            return self._on_append_entries(req)
+        return {"ok": False}
+
+    def _maybe_step_down(self, term: int) -> None:
+        # caller holds lock
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self.role = "follower"
+
+    def _on_request_vote(self, req: dict) -> dict:
+        with self._lock:
+            self._maybe_step_down(req["term"])
+            grant = False
+            if req["term"] >= self.current_term and \
+                    self.voted_for in (None, req["candidate"]):
+                my_last_term = self.log[-1].term if self.log else 0
+                my_last_index = len(self.log) - 1
+                up_to_date = (req["last_log_term"] > my_last_term
+                              or (req["last_log_term"] == my_last_term
+                                  and req["last_log_index"] >= my_last_index))
+                if up_to_date:
+                    grant = True
+                    self.voted_for = req["candidate"]
+                    self._election_deadline = self._new_deadline()
+            return {"kind": "vote", "term": self.current_term,
+                    "granted": grant}
+
+    def _on_append_entries(self, req: dict) -> dict:
+        with self._lock:
+            self._maybe_step_down(req["term"])
+            if req["term"] < self.current_term:
+                return {"kind": "append_ack", "term": self.current_term,
+                        "success": False}
+            self.role = "follower"
+            self.leader_id = req["leader"]
+            self._election_deadline = self._new_deadline()
+
+            prev_index = req["prev_log_index"]
+            prev_term = req["prev_log_term"]
+            if prev_index >= 0:
+                if prev_index >= len(self.log) or \
+                        self.log[prev_index].term != prev_term:
+                    return {"kind": "append_ack",
+                            "term": self.current_term, "success": False}
+            # append/overwrite entries
+            insert_at = prev_index + 1
+            for i, obj in enumerate(req.get("entries", [])):
+                entry = LogEntry.from_json(obj)
+                idx = insert_at + i
+                if idx < len(self.log):
+                    if self.log[idx].term != entry.term:
+                        del self.log[idx:]
+                        self.log.append(entry)
+                else:
+                    self.log.append(entry)
+            # advance commit
+            leader_commit = req["leader_commit"]
+            if leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, len(self.log) - 1)
+            self._apply_committed()
+            return {"kind": "append_ack", "term": self.current_term,
+                    "success": True,
+                    "match_index": prev_index + len(req.get("entries", []))}
+
+    def _apply_committed(self) -> None:
+        # caller holds lock
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log[self.last_applied]
+            try:
+                self.apply_fn(entry.command)
+            except Exception:
+                log.exception("state machine apply failed at %d",
+                              self.last_applied)
+            event = self._commit_events.get(self.last_applied)
+            if event is not None:
+                event.set()
+
+    # --- timers / elections -------------------------------------------------
+
+    def _timer_loop(self) -> None:
+        while not self._stop.wait(0.05):
+            with self._lock:
+                role = self.role
+                deadline = self._election_deadline
+            now = time.monotonic()
+            if role == "leader":
+                self._broadcast_append()
+                time.sleep(self.HEARTBEAT_INTERVAL)
+            elif now >= deadline:
+                self._run_election()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.role = "candidate"
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.node_id
+            self._election_deadline = self._new_deadline()
+            last_index = len(self.log) - 1
+            last_term = self.log[-1].term if self.log else 0
+        votes = 1
+        for peer_id in list(self.peers):
+            resp = self._call_peer(peer_id, {
+                "kind": "request_vote", "term": term,
+                "candidate": self.node_id,
+                "last_log_index": last_index, "last_log_term": last_term})
+            if resp is None:
+                continue
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._maybe_step_down(resp["term"])
+                    return
+            if resp.get("granted"):
+                votes += 1
+        majority = (len(self.peers) + 1) // 2 + 1
+        with self._lock:
+            if self.role != "candidate" or self.current_term != term:
+                return
+            if votes >= majority:
+                self.role = "leader"
+                self.leader_id = self.node_id
+                self.next_index = {p: len(self.log) for p in self.peers}
+                self.match_index = {p: -1 for p in self.peers}
+                log.info("raft %s became leader (term %d)", self.node_id,
+                         term)
+        if self.is_leader():
+            self._broadcast_append()
+
+    # --- leader replication -------------------------------------------------
+
+    def _broadcast_append(self) -> None:
+        for peer_id in list(self.peers):
+            threading.Thread(target=self._replicate_to, args=(peer_id,),
+                             daemon=True).start()
+
+    def _replicate_to(self, peer_id: str) -> None:
+        with self._lock:
+            if self.role != "leader":
+                return
+            term = self.current_term
+            next_idx = self.next_index.get(peer_id, len(self.log))
+            prev_index = next_idx - 1
+            prev_term = self.log[prev_index].term if prev_index >= 0 else 0
+            entries = [e.to_json() for e in self.log[next_idx:]]
+            commit = self.commit_index
+        resp = self._call_peer(peer_id, {
+            "kind": "append_entries", "term": term, "leader": self.node_id,
+            "prev_log_index": prev_index, "prev_log_term": prev_term,
+            "entries": entries, "leader_commit": commit})
+        if resp is None:
+            return
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._maybe_step_down(resp["term"])
+                return
+            if self.role != "leader" or self.current_term != term:
+                return
+            if resp.get("success"):
+                match = resp.get("match_index", prev_index)
+                self.match_index[peer_id] = max(
+                    self.match_index.get(peer_id, -1), match)
+                self.next_index[peer_id] = self.match_index[peer_id] + 1
+                self._advance_commit()
+            else:
+                self.next_index[peer_id] = max(0, next_idx - 1)
+
+    def _advance_commit(self) -> None:
+        # caller holds lock; commit entries from the CURRENT term replicated
+        # on a majority (Raft §5.4.2 safety rule)
+        for idx in range(len(self.log) - 1, self.commit_index, -1):
+            if self.log[idx].term != self.current_term:
+                continue
+            replicated = 1 + sum(
+                1 for p in self.peers if self.match_index.get(p, -1) >= idx)
+            if replicated >= (len(self.peers) + 1) // 2 + 1:
+                self.commit_index = idx
+                self._apply_committed()
+                break

@@ -48,6 +48,15 @@ def build_config(argv=None) -> argparse.Namespace:
     p.add_argument("--init-file", default=None,
                    help="cypherl file executed on startup")
     p.add_argument("--execution-timeout-sec", type=float, default=600.0)
+    # HA coordination (reference: --coordinator-id/--coordinator-port etc.)
+    p.add_argument("--coordinator-id", default=None,
+                   help="run as a coordinator with this raft node id")
+    p.add_argument("--coordinator-port", type=int, default=0,
+                   help="raft port for this coordinator")
+    p.add_argument("--coordinator-peers", default="",
+                   help="comma list of id=host:port raft peers")
+    p.add_argument("--management-port", type=int, default=0,
+                   help="data-instance management server port (HA)")
     return p.parse_args(argv)
 
 
@@ -87,6 +96,26 @@ def build_database(args) -> InterpreterContext:
         from .query.procedures.registry import global_registry
         loaded = global_registry.load_directory(args.query_modules_directory)
         logging.info("loaded query modules: %s", loaded)
+
+    if args.coordinator_id:
+        from .coordination.coordinator import CoordinatorInstance
+        peers = {}
+        for part in filter(None, args.coordinator_peers.split(",")):
+            pid, _, addr = part.partition("=")
+            host, _, port = addr.rpartition(":")
+            peers[pid] = (host, int(port))
+        ictx.coordinator = CoordinatorInstance(
+            args.coordinator_id, args.bolt_address, args.coordinator_port,
+            peers)
+        ictx.coordinator.start()
+        logging.info("coordinator %s on raft port %d (%d peers)",
+                     args.coordinator_id, args.coordinator_port, len(peers))
+    if args.management_port:
+        from .coordination.data_instance import DataInstanceManagementServer
+        ictx.mgmt_server = DataInstanceManagementServer(
+            ictx, args.bolt_address, args.management_port)
+        ictx.mgmt_server.start()
+        logging.info("management server on port %d", args.management_port)
 
     if args.init_file:
         interp = Interpreter(ictx)

@@ -386,6 +386,14 @@ class TriggerQuery:
 
 
 @dataclass
+class CoordinatorQuery:
+    action: str                 # register | unregister | set_main | show
+    name: Optional[str] = None
+    mgmt_address: Optional[str] = None
+    replication_address: Optional[str] = None
+
+
+@dataclass
 class StreamQuery:
     action: str            # create | drop | start | stop | start_all |
                            # stop_all | show | check

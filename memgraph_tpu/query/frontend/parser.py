@@ -193,6 +193,12 @@ class Parser:
             return A.AnalyzeGraphQuery(action, labels)
         if self.at_kw("SET"):
             nxt = self.peek()
+            if nxt.type == T.IDENT and nxt.value.upper() == "INSTANCE":
+                self.advance(); self.advance()
+                name = self.name_token()
+                self.expect_kw("TO")
+                self.expect_kw("MAIN")
+                return A.CoordinatorQuery("set_main", name=name)
             if nxt.is_kw("GLOBAL", "SESSION", "NEXT"):
                 return self.parse_isolation_or_storage()
             if nxt.is_kw("STORAGE"):
@@ -203,7 +209,17 @@ class Parser:
                 return self.parse_auth()
             return self.parse_cypher_query()
         if self.at_kw("REGISTER"):
+            if self.peek().type == T.IDENT and \
+                    self.peek().value.upper() == "INSTANCE":
+                return self.parse_register_instance()
             return self.parse_register_replica()
+        if self.at(T.IDENT) and self.cur.value.upper() == "UNREGISTER":
+            self.advance()
+            if not (self.at(T.IDENT)
+                    and self.cur.value.upper() == "INSTANCE"):
+                self.error("expected INSTANCE")
+            self.advance()
+            return A.CoordinatorQuery("unregister", name=self.name_token())
         if self.at_kw("START"):
             self.advance()
             if self.accept_kw("ALL"):
@@ -359,7 +375,21 @@ class Parser:
             return A.ReplicationQuery("show_role")
         if self.accept_kw("STREAMS"):
             return A.StreamQuery("show")
+        if self.at(T.IDENT) and self.cur.value.upper() == "INSTANCES":
+            self.advance()
+            return A.CoordinatorQuery("show")
         self.error("unsupported SHOW statement")
+
+    def parse_register_instance(self) -> A.CoordinatorQuery:
+        self.expect_kw("REGISTER")
+        self.advance()  # INSTANCE
+        name = self.name_token()
+        self.expect_kw("ON")
+        mgmt = self.expect(T.STRING).value
+        self.expect_kw("WITH")
+        repl = self.expect(T.STRING).value
+        return A.CoordinatorQuery("register", name=name, mgmt_address=mgmt,
+                                  replication_address=repl)
 
     def parse_create_stream(self) -> A.StreamQuery:
         self.expect_kw("CREATE")

@@ -163,6 +163,8 @@ class Interpreter:
             return self._prepare_replication(node)
         if isinstance(node, A.StreamQuery):
             return self._prepare_stream(node)
+        if isinstance(node, A.CoordinatorQuery):
+            return self._prepare_coordinator(node)
         if isinstance(node, A.TtlQuery):
             return self._prepare_ttl(node)
         raise SemanticException(
@@ -208,6 +210,33 @@ class Interpreter:
                 ["name", "type", "topics", "transform", "batch_size",
                  "status", "processed_messages", "last_error"], "r")
         raise SemanticException(f"unknown stream action {node.action}")
+
+    def _prepare_coordinator(self, node: A.CoordinatorQuery) -> PreparedQuery:
+        coordinator = getattr(self.ctx, "coordinator", None)
+        if coordinator is None:
+            raise QueryException(
+                "this instance is not a coordinator (start with "
+                "--coordinator-id/--coordinator-port)")
+        if node.action == "register":
+            ok = coordinator.register_instance(node.name, node.mgmt_address,
+                                               node.replication_address)
+            if not ok:
+                raise QueryException(
+                    "could not commit instance registration (no raft "
+                    "majority or not the leader)")
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "unregister":
+            coordinator.unregister_instance(node.name)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "set_main":
+            if not coordinator.set_instance_to_main(node.name):
+                raise QueryException(f"cannot promote {node.name!r}")
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "show":
+            return self._prepare_generator(
+                iter(coordinator.show_instances()),
+                ["name", "address", "role", "health"], "r")
+        raise SemanticException(f"unknown coordinator action {node.action}")
 
     def _prepare_ttl(self, node: A.TtlQuery) -> PreparedQuery:
         from ..storage.ttl import ttl_runner

@@ -1,0 +1,210 @@
+"""Raft + coordinator failover tests.
+
+Modeled on the reference's in-process coordination tests
+(tests/unit/coordinator_raft_state.cpp, e2e/high_availability/): 3-node
+Raft clusters on localhost ports; full failover e2e with real data
+instances (storage + replication + mgmt servers) and a killed MAIN.
+"""
+
+import socket
+import time
+
+import pytest
+
+from memgraph_tpu.coordination.coordinator import CoordinatorInstance
+from memgraph_tpu.coordination.data_instance import (
+    DataInstanceManagementServer, mgmt_call)
+from memgraph_tpu.coordination.raft import RaftNode
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+def _ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait(pred, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _leader(nodes):
+    for n in nodes:
+        if n.is_leader():
+            return n
+    return None
+
+
+@pytest.fixture
+def raft3():
+    ports = _ports(3)
+    ids = ["c1", "c2", "c3"]
+    applied = {i: [] for i in ids}
+    nodes = []
+    for i, nid in enumerate(ids):
+        peers = {ids[j]: ("127.0.0.1", ports[j])
+                 for j in range(3) if j != i}
+        node = RaftNode(nid, "127.0.0.1", ports[i], peers,
+                        apply_fn=lambda cmd, _n=nid: applied[_n].append(cmd))
+        nodes.append(node)
+    for n in nodes:
+        n.start()
+    yield nodes, applied
+    for n in nodes:
+        n.stop()
+
+
+def test_raft_elects_single_leader(raft3):
+    nodes, _ = raft3
+    assert _wait(lambda: _leader(nodes) is not None)
+    time.sleep(0.5)
+    leaders = [n for n in nodes if n.is_leader()]
+    assert len(leaders) == 1
+
+
+def test_raft_replicates_and_applies(raft3):
+    nodes, applied = raft3
+    assert _wait(lambda: _leader(nodes) is not None)
+    leader = _leader(nodes)
+    assert leader.propose({"op": "x", "v": 1})
+    assert leader.propose({"op": "x", "v": 2})
+    assert _wait(lambda: all(len(applied[n.node_id]) == 2 for n in nodes))
+    for n in nodes:
+        assert [c["v"] for c in applied[n.node_id]] == [1, 2]
+
+
+def test_raft_leader_failover(raft3):
+    nodes, applied = raft3
+    assert _wait(lambda: _leader(nodes) is not None)
+    leader = _leader(nodes)
+    leader.propose({"op": "x", "v": 1})
+    leader.stop()
+    rest = [n for n in nodes if n is not leader]
+    assert _wait(lambda: _leader(rest) is not None, timeout=15)
+    new_leader = _leader(rest)
+    assert new_leader.propose({"op": "x", "v": 2}, timeout=10)
+    assert _wait(lambda: all(
+        [c["v"] for c in applied[n.node_id]] == [1, 2] for n in rest))
+
+
+def test_follower_rejects_propose(raft3):
+    nodes, _ = raft3
+    assert _wait(lambda: _leader(nodes) is not None)
+    followers = [n for n in nodes if not n.is_leader()]
+    assert followers[0].propose({"op": "x"}) is False
+
+
+class _DataInstance:
+    def __init__(self, mgmt_port, repl_port):
+        self.ictx = InterpreterContext(InMemoryStorage())
+        self.interp = Interpreter(self.ictx)
+        self.mgmt = DataInstanceManagementServer(
+            self.ictx, "127.0.0.1", mgmt_port)
+        self.mgmt.start()
+        self.mgmt_address = f"127.0.0.1:{mgmt_port}"
+        self.repl_address = f"127.0.0.1:{repl_port}"
+        self.repl_port = repl_port
+
+    def stop(self):
+        self.mgmt.stop()
+        replication = getattr(self.ictx, "replication", None)
+        if replication is not None:
+            if replication.replica_server:
+                replication.replica_server.stop()
+            for c in replication.replicas.values():
+                c.close()
+
+
+def test_full_failover_e2e():
+    """Coordinator + 2 data instances; kill the MAIN; the replica is
+    promoted and accepts writes with the replicated data intact."""
+    mgmt1, repl1, mgmt2, repl2, raft_port = _ports(5)
+    i1 = _DataInstance(mgmt1, repl1)
+    i2 = _DataInstance(mgmt2, repl2)
+    coord = CoordinatorInstance("coord1", "127.0.0.1", raft_port, {})
+    coord.HEALTH_CHECK_INTERVAL = 0.2
+    coord.start()
+    try:
+        assert _wait(lambda: coord.raft.is_leader(), timeout=10)
+        assert coord.register_instance("i1", i1.mgmt_address,
+                                       i1.repl_address)
+        assert coord.register_instance("i2", i2.mgmt_address,
+                                       i2.repl_address)
+        assert coord.set_instance_to_main("i1")
+        # i2 was demoted to replica listening on its replication port,
+        # i1 promoted with i2 registered
+        assert _wait(lambda: getattr(i1.ictx, "replication", None)
+                     is not None and i1.ictx.replication.role == "main")
+        assert i2.ictx.replication.role == "replica"
+        # write on MAIN replicates
+        i1.interp.execute("CREATE (:HA {v: 1})")
+        _wait(lambda: Interpreter(i2.ictx).execute(
+            "MATCH (n:HA) RETURN count(n)")[1] == [[1]])
+        _, rows, _ = Interpreter(i2.ictx).execute(
+            "MATCH (n:HA) RETURN count(n)")
+        assert rows == [[1]]
+
+        # kill the MAIN
+        i1.stop()
+        # failover: coordinator promotes i2
+        assert _wait(lambda: coord.main_name == "i2", timeout=20)
+        assert _wait(lambda: i2.ictx.replication.role == "main", timeout=10)
+        # promoted instance has the data and accepts writes
+        _, rows, _ = i2.interp.execute("MATCH (n:HA) RETURN count(n)")
+        assert rows == [[1]]
+        i2.interp.execute("CREATE (:HA {v: 2})")
+        _, rows, _ = i2.interp.execute("MATCH (n:HA) RETURN count(n)")
+        assert rows == [[2]]
+    finally:
+        coord.stop()
+        i1.stop()
+        i2.stop()
+
+
+def test_coordinator_cypher_surface():
+    """REGISTER INSTANCE / SET INSTANCE TO MAIN / SHOW INSTANCES via Cypher."""
+    mgmt1, repl1, raft_port = _ports(3)
+    inst = _DataInstance(mgmt1, repl1)
+    coord_ictx = InterpreterContext(InMemoryStorage())
+    coord = CoordinatorInstance("c1", "127.0.0.1", raft_port, {})
+    coord_ictx.coordinator = coord
+    coord.start()
+    interp = Interpreter(coord_ictx)
+    try:
+        assert _wait(lambda: coord.raft.is_leader(), timeout=10)
+        interp.execute(f'REGISTER INSTANCE i1 ON "{inst.mgmt_address}" '
+                       f'WITH "{inst.repl_address}"')
+        interp.execute("SET INSTANCE i1 TO MAIN")
+        _, rows, _ = interp.execute("SHOW INSTANCES")
+        by_name = {r[0]: r for r in rows}
+        assert by_name["i1"][2] == "main"
+        assert by_name["c1"][2] == "leader"
+        # non-coordinator instances reject coordinator queries
+        from memgraph_tpu.exceptions import QueryException
+        with pytest.raises(QueryException):
+            inst.interp.execute("SHOW INSTANCES")
+    finally:
+        coord.stop()
+        inst.stop()
+
+
+def test_mgmt_state_check():
+    (mgmt_port,) = _ports(1)
+    inst = _DataInstance(mgmt_port, 0)
+    try:
+        resp = mgmt_call(inst.mgmt_address, {"kind": "state_check"})
+        assert resp["ok"] and resp["role"] == "main"
+    finally:
+        inst.stop()
