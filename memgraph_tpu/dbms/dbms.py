@@ -1,0 +1,96 @@
+"""Multi-tenancy: named databases with isolated storage.
+
+Counterpart of the reference's DbmsHandler
+(/root/reference/src/dbms/dbms_handler.hpp:134 — per-tenant Database with
+isolated storage and memory arena; New_/Get/Delete at :916-991). Each
+database owns its InMemoryStorage + InterpreterContext; sessions switch
+with USE DATABASE. The default database always exists.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..exceptions import QueryException
+from ..storage import InMemoryStorage, StorageConfig
+
+DEFAULT_DB = "memgraph"
+
+
+class DbmsHandler:
+    def __init__(self, root_config: StorageConfig | None = None,
+                 interpreter_config: dict | None = None,
+                 recover_on_startup: bool = True):
+        from ..query.interpreter import InterpreterContext
+        self._lock = threading.Lock()
+        self._root_config = root_config or StorageConfig()
+        self._interp_config = interpreter_config or {}
+        self._recover = recover_on_startup
+        self._databases: dict[str, "InterpreterContext"] = {}
+        self._make(DEFAULT_DB)
+
+    def _db_config(self, name: str) -> StorageConfig:
+        cfg = StorageConfig(
+            storage_mode=self._root_config.storage_mode,
+            isolation_level=self._root_config.isolation_level,
+            wal_enabled=self._root_config.wal_enabled,
+        )
+        if self._root_config.durability_dir:
+            if name == DEFAULT_DB:
+                # the default database lives at the root (single-tenant
+                # layouts stay recoverable when multi-tenancy is enabled)
+                cfg.durability_dir = self._root_config.durability_dir
+            else:
+                cfg.durability_dir = os.path.join(
+                    self._root_config.durability_dir, "databases", name)
+            os.makedirs(cfg.durability_dir, exist_ok=True)
+        return cfg
+
+    def _make(self, name: str):
+        from ..query.interpreter import InterpreterContext
+        cfg = self._db_config(name)
+        storage = InMemoryStorage(cfg)
+        if cfg.durability_dir:
+            from ..storage.durability.recovery import recover, wire_durability
+            if self._recover:
+                recover(storage)
+            if cfg.wal_enabled:
+                wire_durability(storage)
+        ictx = InterpreterContext(storage, dict(self._interp_config))
+        ictx.database_name = name
+        ictx.dbms = self
+        self._databases[name] = ictx
+        return ictx
+
+    # --- API (reference: New_/Get/TryDelete) --------------------------------
+
+    def create(self, name: str):
+        if not name.replace("_", "").replace("-", "").isalnum():
+            raise QueryException(f"invalid database name {name!r}")
+        with self._lock:
+            if name in self._databases:
+                raise QueryException(f"database {name!r} already exists")
+            return self._make(name)
+
+    def get(self, name: str):
+        with self._lock:
+            ictx = self._databases.get(name)
+        if ictx is None:
+            raise QueryException(f"database {name!r} does not exist")
+        return ictx
+
+    def drop(self, name: str) -> None:
+        if name == DEFAULT_DB:
+            raise QueryException("cannot drop the default database")
+        with self._lock:
+            if name not in self._databases:
+                raise QueryException(f"database {name!r} does not exist")
+            del self._databases[name]
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._databases)
+
+    def default(self):
+        return self.get(DEFAULT_DB)

@@ -68,20 +68,17 @@ def build_database(args) -> InterpreterContext:
         wal_enabled=bool(args.storage_wal_enabled and args.data_directory),
         snapshot_on_exit=args.storage_snapshot_on_exit,
     )
-    storage = InMemoryStorage(storage_config)
-
-    if args.data_directory and args.storage_recover_on_startup:
-        from .storage.durability.recovery import recover
-        stats = recover(storage)
-        logging.info("recovery: %s", stats)
-    if storage_config.wal_enabled:
-        from .storage.durability.recovery import wire_durability
-        wire_durability(storage)
-
-    ictx = InterpreterContext(storage, {
+    interp_config = {
         "execution_timeout_sec": args.execution_timeout_sec,
         "advertised_address": f"localhost:{args.bolt_port}",
-    })
+    }
+    # multi-tenancy: every server runs behind a DbmsHandler; the default
+    # database recovers from (and persists to) the root data directory
+    from .dbms.dbms import DbmsHandler
+    dbms = DbmsHandler(storage_config, interp_config,
+                       recover_on_startup=args.storage_recover_on_startup)
+    ictx = dbms.default()
+    storage = ictx.storage
 
     # warm the native CSR builder at startup so the first analytics query
     # doesn't pay the compile

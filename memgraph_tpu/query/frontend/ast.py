@@ -386,6 +386,12 @@ class TriggerQuery:
 
 
 @dataclass
+class MultiDatabaseQuery:
+    action: str                 # create | drop | use | show
+    name: Optional[str] = None
+
+
+@dataclass
 class CoordinatorQuery:
     action: str                 # register | unregister | set_main | show
     name: Optional[str] = None

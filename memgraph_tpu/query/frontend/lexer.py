@@ -35,7 +35,7 @@ KEYWORDS = {
     "AT", "EVERY", "ENABLE", "DISABLE", "USING", "PERIODIC", "HOPS",
     "KEY", "OF", "TYPE", "POINT", "TEXT", "VECTORS", "PASSWORD", "USER",
     "ROLE", "PRIVILEGES", "GRANT", "DENY", "REVOKE", "TO", "FOR", "METRICS",
-    "REPLICA", "REPLICAS", "MAIN", "REPLICATION", "REGISTER", "SYNC",
+    "REPLICA", "REPLICAS", "MAIN", "REPLICATION", "REGISTER", "SYNC", "USE", "DATABASES",
     "ASYNC", "STRICT_SYNC", "PORT", "SERVER", "VERSION", "BUILD", "SCHEMA",
     "LABELS", "REQUIRE", "ID",
 }

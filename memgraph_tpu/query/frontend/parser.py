@@ -109,8 +109,15 @@ class Parser:
         return node
 
     def _dispatch(self):
+        if self.at_kw("USE"):
+            self.advance()
+            self.accept_kw("DATABASE")
+            return A.MultiDatabaseQuery("use", name=self.name_token())
         if self.at_kw("CREATE"):
             nxt = self.peek()
+            if nxt.is_kw("DATABASE"):
+                self.advance(); self.advance()
+                return A.MultiDatabaseQuery("create", name=self.name_token())
             if nxt.type == T.IDENT and nxt.value.upper() in (
                     "KAFKA", "PULSAR", "FILE") and \
                     self.peek(2).is_kw("STREAM"):
@@ -148,6 +155,9 @@ class Parser:
             if nxt.is_kw("STREAM"):
                 self.advance(); self.advance()
                 return A.StreamQuery("drop", name=self.name_token())
+            if nxt.is_kw("DATABASE"):
+                self.advance(); self.advance()
+                return A.MultiDatabaseQuery("drop", name=self.name_token())
             if nxt.is_kw("USER"):
                 return self.parse_auth()
             self.error("unsupported DROP statement")
@@ -363,6 +373,8 @@ class Parser:
             return A.SnapshotQuery("show")
         if self.accept_kw("TRIGGERS"):
             return A.TriggerQuery("show")
+        if self.accept_kw("DATABASES"):
+            return A.MultiDatabaseQuery("show")
         if self.accept_kw("DATABASE"):
             return A.InfoQuery("database")
         if self.accept_kw("SCHEMA"):

@@ -165,6 +165,8 @@ class Interpreter:
             return self._prepare_stream(node)
         if isinstance(node, A.CoordinatorQuery):
             return self._prepare_coordinator(node)
+        if isinstance(node, A.MultiDatabaseQuery):
+            return self._prepare_multidb(node)
         if isinstance(node, A.TtlQuery):
             return self._prepare_ttl(node)
         raise SemanticException(
@@ -210,6 +212,36 @@ class Interpreter:
                 ["name", "type", "topics", "transform", "batch_size",
                  "status", "processed_messages", "last_error"], "r")
         raise SemanticException(f"unknown stream action {node.action}")
+
+    def _prepare_multidb(self, node: A.MultiDatabaseQuery) -> PreparedQuery:
+        dbms = getattr(self.ctx, "dbms", None)
+        if dbms is None:
+            raise QueryException(
+                "multi-database support requires a DbmsHandler (enabled "
+                "automatically by the server entry point)")
+        if node.action == "create":
+            dbms.create(node.name)
+            return self._prepare_generator(
+                iter([[f"Database {node.name} created."]]), ["status"], "s")
+        if node.action == "drop":
+            dbms.drop(node.name)
+            return self._prepare_generator(
+                iter([[f"Database {node.name} dropped."]]), ["status"], "s")
+        if node.action == "use":
+            if self._in_explicit_txn:
+                raise TransactionException(
+                    "cannot switch databases inside a transaction")
+            target = dbms.get(node.name)
+            # the session keeps this Interpreter object; rebind it
+            self.ctx = target
+            return self._prepare_generator(
+                iter([[f"Using database {node.name}."]]), ["status"], "s")
+        if node.action == "show":
+            current = getattr(self.ctx, "database_name", "memgraph")
+            rows = [[name, name == current] for name in dbms.names()]
+            return self._prepare_generator(iter(rows),
+                                           ["Name", "Current"], "r")
+        raise SemanticException(f"unknown database action {node.action}")
 
     def _prepare_coordinator(self, node: A.CoordinatorQuery) -> PreparedQuery:
         coordinator = getattr(self.ctx, "coordinator", None)

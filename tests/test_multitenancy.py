@@ -1,0 +1,80 @@
+"""Multi-tenancy tests (reference: src/dbms/, tests/e2e multi-tenancy)."""
+
+import pytest
+
+from memgraph_tpu.dbms.dbms import DbmsHandler
+from memgraph_tpu.exceptions import QueryException
+from memgraph_tpu.query.interpreter import Interpreter
+from memgraph_tpu.storage import StorageConfig
+
+
+@pytest.fixture
+def dbms():
+    return DbmsHandler()
+
+
+def test_isolated_databases(dbms):
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE DATABASE tenant1")
+    interp.execute("CREATE (:InDefault)")
+    interp.execute("USE DATABASE tenant1")
+    interp.execute("CREATE (:InTenant)")
+    _, rows, _ = interp.execute("MATCH (n) RETURN count(n)")
+    assert rows == [[1]]
+    _, rows, _ = interp.execute("MATCH (n:InDefault) RETURN count(n)")
+    assert rows == [[0]]  # isolation
+    interp.execute("USE DATABASE memgraph")
+    _, rows, _ = interp.execute("MATCH (n:InDefault) RETURN count(n)")
+    assert rows == [[1]]
+
+
+def test_show_databases(dbms):
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE DATABASE t2")
+    _, rows, _ = interp.execute("SHOW DATABASES")
+    assert [r[0] for r in rows] == ["memgraph", "t2"]
+    current = {r[0]: r[1] for r in rows}
+    assert current["memgraph"] is True
+    interp.execute("USE DATABASE t2")
+    _, rows, _ = interp.execute("SHOW DATABASES")
+    current = {r[0]: r[1] for r in rows}
+    assert current["t2"] is True
+
+
+def test_drop_database_rules(dbms):
+    interp = Interpreter(dbms.default())
+    with pytest.raises(QueryException):
+        interp.execute("DROP DATABASE memgraph")
+    with pytest.raises(QueryException):
+        interp.execute("DROP DATABASE nonexistent")
+    interp.execute("CREATE DATABASE temp")
+    interp.execute("DROP DATABASE temp")
+    _, rows, _ = interp.execute("SHOW DATABASES")
+    assert [r[0] for r in rows] == ["memgraph"]
+
+
+def test_duplicate_database(dbms):
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE DATABASE dup")
+    with pytest.raises(QueryException):
+        interp.execute("CREATE DATABASE dup")
+
+
+def test_per_database_durability(tmp_path):
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    dbms = DbmsHandler(cfg)
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE DATABASE t1")
+    interp.execute("CREATE (:RootData)")
+    interp.execute("USE DATABASE t1")
+    interp.execute("CREATE (:TenantData)")
+
+    # new handler over the same directory recovers both
+    dbms2 = DbmsHandler(cfg)
+    dbms2.create("t1") if "t1" not in dbms2.names() else None
+    interp2 = Interpreter(dbms2.default())
+    _, rows, _ = interp2.execute("MATCH (n:RootData) RETURN count(n)")
+    assert rows == [[1]]
+    interp2.execute("USE DATABASE t1")
+    _, rows, _ = interp2.execute("MATCH (n:TenantData) RETURN count(n)")
+    assert rows == [[1]]
