@@ -1,0 +1,233 @@
+"""Data-manipulation utility modules: map, collections, create, refactor.
+
+Compact counterparts of the reference's MAGE utility modules
+(/root/reference/mage/cpp/{map,collections,create,refactor,merge,nodes}_module):
+the procedure names and shapes users rely on for data wrangling.
+"""
+
+from __future__ import annotations
+
+from . import mgp
+from ..exceptions import ProcedureException
+
+
+# --- map module --------------------------------------------------------------
+
+@mgp.read_proc("map.from_pairs", args=[("pairs", "LIST")],
+               results=[("map", "MAP")])
+def map_from_pairs(ctx, pairs):
+    out = {}
+    for pair in pairs:
+        if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+            raise ProcedureException("map.from_pairs expects [key, value] pairs")
+        out[str(pair[0])] = pair[1]
+    yield {"map": out}
+
+
+@mgp.read_proc("map.merge", args=[("first", "MAP"), ("second", "MAP")],
+               results=[("result", "MAP")])
+def map_merge(ctx, first, second):
+    out = dict(first or {})
+    out.update(second or {})
+    yield {"result": out}
+
+
+@mgp.read_proc("map.remove_key", args=[("map", "MAP"), ("key", "STRING")],
+               results=[("result", "MAP")])
+def map_remove_key(ctx, map, key):
+    out = dict(map or {})
+    out.pop(key, None)
+    yield {"result": out}
+
+
+@mgp.read_proc("map.flatten", args=[("map", "MAP")],
+               opt_args=[("delimiter", "STRING", ".")],
+               results=[("result", "MAP")])
+def map_flatten(ctx, map, delimiter="."):
+    out = {}
+
+    def walk(prefix, value):
+        if isinstance(value, dict):
+            for k, v in value.items():
+                walk(f"{prefix}{delimiter}{k}" if prefix else str(k), v)
+        else:
+            out[prefix] = value
+
+    walk("", map or {})
+    yield {"result": out}
+
+
+# --- collections module ------------------------------------------------------
+
+@mgp.read_proc("collections.sum", args=[("values", "LIST")],
+               results=[("sum", "FLOAT")])
+def collections_sum(ctx, values):
+    yield {"sum": float(sum(v for v in values if v is not None))}
+
+
+@mgp.read_proc("collections.avg", args=[("values", "LIST")],
+               results=[("avg", "FLOAT")])
+def collections_avg(ctx, values):
+    vals = [v for v in values if v is not None]
+    yield {"avg": (sum(vals) / len(vals)) if vals else 0.0}
+
+
+@mgp.read_proc("collections.contains", args=[("coll", "LIST"),
+                                             ("value", "ANY")],
+               results=[("output", "BOOLEAN")])
+def collections_contains(ctx, coll, value):
+    yield {"output": value in coll}
+
+
+def _dedupe(values):
+    from ..query.values import hashable_key
+    seen = set()
+    out = []
+    for v in values:
+        key = hashable_key(v)
+        if key not in seen:
+            seen.add(key)
+            out.append(v)
+    return out
+
+
+@mgp.read_proc("collections.distinct", args=[("values", "LIST")],
+               results=[("distinct", "LIST")])
+def collections_distinct(ctx, values):
+    yield {"distinct": _dedupe(values)}
+
+
+@mgp.read_proc("collections.sort", args=[("values", "LIST")],
+               results=[("sorted", "LIST")])
+def collections_sort(ctx, values):
+    from ..storage.ordering import order_key
+    yield {"sorted": sorted(values, key=order_key)}
+
+
+@mgp.read_proc("collections.pairs", args=[("values", "LIST")],
+               results=[("pairs", "LIST")])
+def collections_pairs(ctx, values):
+    yield {"pairs": [[values[i], values[i + 1]]
+                     for i in range(len(values) - 1)]}
+
+
+@mgp.read_proc("collections.to_set", args=[("values", "LIST")],
+               results=[("result", "LIST")])
+def collections_to_set(ctx, values):
+    yield {"result": _dedupe(values)}
+
+
+@mgp.read_proc("collections.partition", args=[("values", "LIST"),
+                                              ("size", "INTEGER")],
+               results=[("partition", "LIST")])
+def collections_partition(ctx, values, size):
+    size = int(size)
+    if size <= 0:
+        raise ProcedureException("partition size must be positive")
+    for i in range(0, len(values), size):
+        yield {"partition": list(values[i:i + size])}
+
+
+# --- create module -----------------------------------------------------------
+
+def _make_node(ctx, labels, properties):
+    va = ctx.accessor.create_vertex()
+    for label in labels or []:
+        va.add_label(ctx.storage.label_mapper.name_to_id(str(label)))
+    for key, value in (properties or {}).items():
+        if value is not None:
+            va.set_property(ctx.storage.property_mapper.name_to_id(key),
+                            value)
+    return va
+
+
+@mgp.write_proc("create.node",
+                opt_args=[("labels", "LIST", None),
+                          ("properties", "MAP", None)],
+                results=[("node", "NODE")])
+def create_node(ctx, labels=None, properties=None):
+    yield {"node": _make_node(ctx, labels, properties)}
+
+
+@mgp.write_proc("create.nodes",
+                args=[("labels", "LIST"), ("props", "LIST")],
+                results=[("node", "NODE")])
+def create_nodes(ctx, labels, props):
+    for properties in props:
+        yield {"node": _make_node(ctx, labels, properties)}
+
+
+@mgp.write_proc("create.relationship",
+                args=[("from", "NODE"), ("relationshipType", "STRING"),
+                      ("properties", "MAP"), ("to", "NODE")],
+                results=[("relationship", "RELATIONSHIP")])
+def create_relationship(ctx, from_, relationshipType, properties, to):
+    tid = ctx.storage.edge_type_mapper.name_to_id(str(relationshipType))
+    ea = ctx.accessor.create_edge(from_, to, tid)
+    for key, value in (properties or {}).items():
+        if value is not None:
+            ea.set_property(ctx.storage.property_mapper.name_to_id(key),
+                            value)
+    yield {"relationship": ea}
+
+
+@mgp.write_proc("create.remove_labels",
+                args=[("node", "NODE"), ("labels", "LIST")],
+                results=[("node", "NODE")])
+def create_remove_labels(ctx, node, labels):
+    for label in labels or []:
+        lid = ctx.storage.label_mapper.maybe_name_to_id(str(label))
+        if lid is not None:
+            node.remove_label(lid)
+    yield {"node": node}
+
+
+# --- refactor module ---------------------------------------------------------
+
+@mgp.write_proc("refactor.rename_label",
+                args=[("old_label", "STRING"), ("new_label", "STRING")],
+                results=[("nodes_changed", "INTEGER")])
+def refactor_rename_label(ctx, old_label, new_label):
+    old_id = ctx.storage.label_mapper.maybe_name_to_id(str(old_label))
+    new_id = ctx.storage.label_mapper.name_to_id(str(new_label))
+    changed = 0
+    if old_id is not None:
+        for va in list(ctx.accessor.vertices(ctx.view)):
+            if va.has_label(old_id, ctx.view):
+                va.remove_label(old_id)
+                va.add_label(new_id)
+                changed += 1
+    yield {"nodes_changed": changed}
+
+
+@mgp.write_proc("refactor.rename_node_property",
+                args=[("old_property", "STRING"),
+                      ("new_property", "STRING")],
+                results=[("nodes_changed", "INTEGER")])
+def refactor_rename_property(ctx, old_property, new_property):
+    old_id = ctx.storage.property_mapper.maybe_name_to_id(str(old_property))
+    new_id = ctx.storage.property_mapper.name_to_id(str(new_property))
+    changed = 0
+    if old_id is not None:
+        for va in list(ctx.accessor.vertices(ctx.view)):
+            value = va.get_property(old_id, ctx.view)
+            if value is not None:
+                va.set_property(new_id, value)
+                va.set_property(old_id, None)
+                changed += 1
+    yield {"nodes_changed": changed}
+
+
+@mgp.write_proc("refactor.invert",
+                args=[("relationship", "RELATIONSHIP")],
+                results=[("relationship", "RELATIONSHIP")])
+def refactor_invert(ctx, relationship):
+    props = relationship.properties(ctx.view)
+    tid = relationship.edge_type
+    from_v = relationship.from_vertex()
+    to_v = relationship.to_vertex()
+    ctx.accessor.delete_edge(relationship)
+    new_edge = ctx.accessor.create_edge(to_v, from_v, tid)
+    for pid, value in props.items():
+        new_edge.set_property(pid, value)
+    yield {"relationship": new_edge}

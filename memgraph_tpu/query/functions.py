@@ -575,6 +575,51 @@ def fn_point_withinbbox(ev, args):
     return ok
 
 
+# --- assertion / counters (reference: awesome_memgraph_functions) ------------
+
+@register("assert", 1, 2, propagate_null=False)
+def fn_assert(ev, args):
+    ok = args[0]
+    message = args[1] if len(args) > 1 else "Assertion failed"
+    if ok is not True:
+        raise TypeException(str(message))
+    return True
+
+
+@register("counter", 2, 3)
+def fn_counter(ev, args):
+    """counter(name, initial, step=1): named counter scoped to the query
+    execution (reference: per-EvaluationContext counters, context.hpp),
+    returns the current value then advances."""
+    name = _str("counter", args[0])
+    initial = int(_num("counter", args[1]))
+    step = int(_num("counter", args[2])) if len(args) == 3 else 1
+    counters = getattr(ev.ctx, "_query_counters", None)
+    if counters is None:
+        counters = ev.ctx._query_counters = {}
+    current = counters.get(name, initial)
+    counters[name] = current + step
+    return current
+
+
+@register("propertysize", 2, 2)
+def fn_propertysize(ev, args):
+    """Approximate encoded byte size of a stored property."""
+    from ..storage.property_store import value_key
+    obj, prop = args
+    if not isinstance(obj, (VertexAccessor, EdgeAccessor)):
+        raise TypeException("propertySize() requires a node or relationship")
+    value = ev.get_property(obj, _str("propertySize", prop))
+    if value is None:
+        return 0
+    return len(value_key(value))
+
+
+@register("tocharlist", 1, 1)
+def fn_tocharlist(ev, args):
+    return list(_str("toCharList", args[0]))
+
+
 # --- ids / misc --------------------------------------------------------------
 
 @register("randomuuid", 0, 0, propagate_null=False)

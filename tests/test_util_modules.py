@@ -1,0 +1,90 @@
+"""map/collections/create/refactor modules + new builtin functions."""
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def test_map_module(db):
+    rows = run(db, "CALL map.from_pairs([['a', 1], ['b', 2]]) YIELD map "
+                   "RETURN map")
+    assert rows == [[{"a": 1, "b": 2}]]
+    rows = run(db, "CALL map.merge({a: 1}, {b: 2}) YIELD result RETURN result")
+    assert rows == [[{"a": 1, "b": 2}]]
+    rows = run(db, "CALL map.flatten({a: {b: 1}}) YIELD result RETURN result")
+    assert rows == [[{"a.b": 1}]]
+
+
+def test_collections_module(db):
+    rows = run(db, "CALL collections.sum([1, 2, 3]) YIELD sum RETURN sum")
+    assert rows == [[6.0]]
+    rows = run(db, "CALL collections.sort([3, 1, 2]) YIELD sorted "
+                   "RETURN sorted")
+    assert rows == [[[1, 2, 3]]]
+    rows = run(db, "CALL collections.partition([1,2,3,4,5], 2) "
+                   "YIELD partition RETURN partition")
+    assert [r[0] for r in rows] == [[1, 2], [3, 4], [5]]
+
+
+def test_create_module(db):
+    rows = run(db, "CALL create.node(['Person'], {name: 'zed'}) YIELD node "
+                   "RETURN labels(node), node.name")
+    assert rows == [[["Person"], "zed"]]
+    run(db, "MATCH (a:Person) CALL create.node(['Other'], {}) YIELD node "
+            "CALL create.relationship(a, 'LIKES', {w: 1}, node) "
+            "YIELD relationship RETURN relationship")
+    rows = run(db, "MATCH (:Person)-[r:LIKES]->(:Other) RETURN r.w")
+    assert rows == [[1]]
+
+
+def test_refactor_module(db):
+    run(db, "CREATE (:Old {a: 1}), (:Old {a: 2})")
+    rows = run(db, "CALL refactor.rename_label('Old', 'New') "
+                   "YIELD nodes_changed RETURN nodes_changed")
+    assert rows == [[2]]
+    assert run(db, "MATCH (n:New) RETURN count(n)") == [[2]]
+    rows = run(db, "CALL refactor.rename_node_property('a', 'b') "
+                   "YIELD nodes_changed RETURN nodes_changed")
+    assert rows == [[2]]
+    assert run(db, "MATCH (n:New) WHERE n.b IS NOT NULL RETURN count(n)") \
+        == [[2]]
+
+
+def test_refactor_invert(db):
+    run(db, "CREATE (:A)-[:R {k: 7}]->(:B)")
+    run(db, "MATCH (:A)-[r:R]->(:B) CALL refactor.invert(r) "
+            "YIELD relationship RETURN relationship")
+    rows = run(db, "MATCH (:B)-[r:R]->(:A) RETURN r.k")
+    assert rows == [[7]]
+
+
+def test_assert_function(db):
+    from memgraph_tpu.exceptions import TypeException
+    assert run(db, "RETURN assert(1 = 1) AS ok") == [[True]]
+    with pytest.raises(TypeException):
+        run(db, "RETURN assert(1 = 2, 'boom')")
+
+
+def test_counter_function(db):
+    rows = run(db, "UNWIND range(1, 3) AS i RETURN counter('c1', 10) AS c")
+    assert [r[0] for r in rows] == [10, 11, 12]
+    rows = run(db, "RETURN counter('c2', 0, 5) AS c")
+    assert rows == [[0]]
+
+
+def test_tocharlist_propertysize(db):
+    run(db, "CREATE (:PS {s: 'hello'})")
+    rows = run(db, "MATCH (n:PS) RETURN toCharList(n.s), "
+                   "propertySize(n, 's') > 0")
+    assert rows == [[["h", "e", "l", "l", "o"], True]]
