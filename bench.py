@@ -119,7 +119,27 @@ def call_to_first_record_latency():
     return cold, warm
 
 
+def _arm_watchdog(seconds: int = 540):
+    """Print a failure JSON line and exit if the bench wedges (e.g. the TPU
+    tunnel is down) — the driver must always get its one line."""
+    import signal
+
+    def on_alarm(signum, frame):
+        print(json.dumps({
+            "metric": "pagerank_edges_per_sec_10M", "value": 0.0,
+            "unit": "edges/s", "vs_baseline": 0.0,
+            "extra": {"error": f"bench timed out after {seconds}s "
+                               f"(device unreachable?)"}}))
+        sys.stdout.flush()
+        import os
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+
+
 def main():
+    _arm_watchdog()
     import jax
     log(f"devices: {jax.devices()}")
 

@@ -348,3 +348,15 @@ class Evaluator:
         for _ in match_pattern_anchored(self.ctx, e.pattern, frame):
             return True
         return False
+
+    def _eval_PatternComprehension(self, e: A.PatternComprehension, frame):
+        """[(n)-->(m) WHERE pred | expr] — collect projections per match."""
+        from .plan.pattern_match import match_pattern_anchored
+        out = []
+        for match_frame in match_pattern_anchored(self.ctx, e.pattern, frame):
+            inner = dict(frame)
+            inner.update(match_frame)
+            if e.where is not None and self.eval(e.where, inner) is not True:
+                continue
+            out.append(self.eval(e.projection, inner))
+        return out

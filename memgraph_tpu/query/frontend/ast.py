@@ -138,6 +138,14 @@ class PatternExpr(Expr):
     exists_form: bool = True
 
 
+@dataclass
+class PatternComprehension(Expr):
+    """[(n)-[r]->(m) WHERE pred | projection]"""
+    pattern: "Pattern"
+    where: Optional[Expr]
+    projection: Expr
+
+
 # --- patterns ----------------------------------------------------------------
 
 @dataclass
@@ -145,6 +153,14 @@ class NodePattern:
     variable: Optional[str]
     labels: list[str]
     properties: object = None     # dict[str, Expr] | Parameter | None
+
+
+@dataclass
+class Lambda:
+    """(edge_var, node_var | expr) — weight/filter lambdas on expansions."""
+    edge_var: str
+    node_var: str
+    expr: Expr
 
 
 @dataclass
@@ -156,6 +172,10 @@ class EdgePattern:
     var_length: bool = False
     min_hops: Optional[Expr] = None
     max_hops: Optional[Expr] = None
+    algo: Optional[str] = None    # 'bfs' | 'wshortest' | 'allshortest'
+    weight_lambda: Optional[Lambda] = None
+    filter_lambda: Optional[Lambda] = None
+    total_weight: Optional[str] = None
 
 
 @dataclass
@@ -261,6 +281,12 @@ class CallProcedure(Clause):
     yields: list[tuple[str, Optional[str]]]   # (field, alias)
     yield_star: bool = False
     where: Optional[Expr] = None
+
+
+@dataclass
+class CallSubquery(Clause):
+    """CALL { <single query> } — correlated subquery per input row."""
+    query: "SingleQuery"
 
 
 @dataclass

@@ -784,8 +784,13 @@ class Parser:
             asc = False
         return A.SortItem(expr, asc)
 
-    def parse_call(self) -> A.CallProcedure:
+    def parse_call(self):
         self.expect_kw("CALL")
+        if self.at("{"):
+            self.advance()
+            sub = self.parse_single_query()
+            self.expect("}")
+            return A.CallSubquery(sub)
         parts = [self.name_token()]
         while self.accept("."):
             parts.append(self.name_token())
@@ -893,6 +898,10 @@ class Parser:
         props = None
         var_length = False
         min_hops = max_hops = None
+        algo = None
+        weight_lambda = None
+        filter_lambda = None
+        total_weight = None
         if self.accept("["):
             if self.at(T.IDENT) and self.peek().type in (":", "]", "*", "{"):
                 variable = self.advance().value
@@ -904,6 +913,9 @@ class Parser:
             if self.accept("*"):
                 var_length = True
                 from .lexer import T as TT
+                if self.at(TT.IDENT) and self.cur.value.upper() in (
+                        "BFS", "WSHORTEST", "ALLSHORTEST"):
+                    algo = self.advance().value.lower()
                 if self.at(TT.INT):
                     min_hops = A.Literal(self.advance().value)
                     if self.accept(".."):
@@ -917,6 +929,14 @@ class Parser:
                 elif self.at(T.FLOAT):
                     # "*1.5" is invalid; but "*1..2" lexes as INT '..' INT
                     self.error("invalid variable-length bounds")
+                # lambdas: weight first for WSHORTEST/ALLSHORTEST, then an
+                # optional filter lambda (reference: MemgraphCypher grammar)
+                if algo in ("wshortest", "allshortest") and self.at("("):
+                    weight_lambda = self._parse_lambda()
+                    if self.at(T.IDENT) and self.peek().type in ("]", "("):
+                        total_weight = self.advance().value
+                if self.at("("):
+                    filter_lambda = self._parse_lambda()
             if self.at("{") or self.at(T.PARAM):
                 props = self.parse_map_or_param()
             self.expect("]")
@@ -939,7 +959,18 @@ class Parser:
             else:
                 self.error("malformed relationship pattern")
         return A.EdgePattern(variable, types, direction, props, var_length,
-                             min_hops, max_hops)
+                             min_hops, max_hops, algo, weight_lambda,
+                             filter_lambda, total_weight)
+
+    def _parse_lambda(self) -> A.Lambda:
+        self.expect("(")
+        edge_var = self.name_token()
+        self.expect(",")
+        node_var = self.name_token()
+        self.expect("|")
+        expr = self.parse_expression()
+        self.expect(")")
+        return A.Lambda(edge_var, node_var, expr)
 
     def parse_map_or_param(self):
         if self.at(T.PARAM):
@@ -1292,6 +1323,23 @@ class Parser:
         if self.at("]"):
             self.advance()
             return A.ListLiteral([])
+        # pattern comprehension: [(n)-[]->(m) ... | expr]
+        if self.at("("):
+            save = self.i
+            try:
+                pattern = self.parse_pattern()
+                if len(pattern.elements) > 1 and (self.at("|")
+                                                  or self.at_kw("WHERE")):
+                    where = None
+                    if self.accept_kw("WHERE"):
+                        where = self.parse_expression()
+                    self.expect("|")
+                    proj = self.parse_expression()
+                    self.expect("]")
+                    return A.PatternComprehension(pattern, where, proj)
+                raise SyntaxException("not a pattern comprehension")
+            except SyntaxException:
+                self.i = save
         # lookahead: ident IN → comprehension
         if (self.cur.type in (T.IDENT,) and self.peek().is_kw("IN")):
             var = self.advance().value

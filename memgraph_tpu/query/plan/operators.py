@@ -329,6 +329,179 @@ class ExpandVariable(LogicalOperator):
 
 
 @dataclass
+class ExpandShortest(LogicalOperator):
+    """BFS / weighted-shortest / all-shortest expansion.
+
+    Counterpart of the traversal modes the reference embeds in
+    ExpandVariable (plan/operator.hpp:1140 — *BFS, *WSHORTEST,
+    *ALLSHORTEST with filter/weight lambdas). Host-side graph walk (the
+    point-query regime); whole-graph distances run on device via
+    ops/traversal.py.
+    """
+    input: LogicalOperator
+    from_symbol: str
+    edge_symbol: str
+    to_symbol: str
+    direction: str
+    edge_types: list[str]
+    algo: str                          # 'bfs' | 'wshortest' | 'allshortest'
+    max_hops: int = -1
+    weight_lambda: object = None       # A.Lambda
+    filter_lambda: object = None       # A.Lambda
+    total_weight_symbol: Optional[str] = None
+
+    def cursor(self, ctx):
+        type_ids = Expand._type_ids(self, ctx)
+        max_hops = self.max_hops if self.max_hops >= 0 else 1 << 30
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            if self.edge_types and not type_ids:
+                continue
+            source = frame.get(self.from_symbol)
+            if not isinstance(source, VertexAccessor):
+                continue
+            to_bound = self.to_symbol in frame
+            target_gid = None
+            if to_bound:
+                bound = frame[self.to_symbol]
+                if not isinstance(bound, VertexAccessor):
+                    continue
+                target_gid = bound.gid
+            if self.algo == "bfs":
+                results = self._bfs(ctx, frame, source, target_gid, max_hops,
+                                    type_ids)
+            else:
+                results = self._dijkstra(
+                    ctx, frame, source, target_gid, max_hops, type_ids,
+                    all_shortest=(self.algo == "allshortest"))
+            for (end_vertex, edges, weight) in results:
+                new = dict(frame)
+                new[self.edge_symbol] = edges
+                if not to_bound:
+                    new[self.to_symbol] = end_vertex
+                if self.total_weight_symbol:
+                    new[self.total_weight_symbol] = weight
+                yield new
+
+    def _neighbors(self, ctx, va, type_ids):
+        yield from Expand._edges(self, ctx, va, type_ids)
+
+    def _passes_filter(self, ctx, frame, edge, node) -> bool:
+        lam = self.filter_lambda
+        if lam is None:
+            return True
+        inner = dict(frame)
+        inner[lam.edge_var] = edge
+        inner[lam.node_var] = node
+        return ctx.evaluator.eval(lam.expr, inner) is True
+
+    def _edge_weight(self, ctx, frame, edge, node) -> float:
+        lam = self.weight_lambda
+        if lam is None:
+            return 1.0
+        inner = dict(frame)
+        inner[lam.edge_var] = edge
+        inner[lam.node_var] = node
+        w = ctx.evaluator.eval(lam.expr, inner)
+        if not V.is_numeric(w):
+            raise TypeException("weight lambda must return a number")
+        if w < 0:
+            raise TypeException("weight lambda must be non-negative")
+        return w
+
+    def _bfs(self, ctx, frame, source, target_gid, max_hops, type_ids):
+        from collections import deque
+        parent = {source.gid: None}   # gid -> (prev_gid, edge)
+        node_of = {source.gid: source}
+        queue = deque([(source, 0)])
+        while queue:
+            ctx.check_abort()
+            va, depth = queue.popleft()
+            if depth >= max_hops:
+                continue
+            for ea, other in self._neighbors(ctx, va, type_ids):
+                if other.gid in parent:
+                    continue
+                if not self._passes_filter(ctx, frame, ea, other):
+                    continue
+                parent[other.gid] = (va.gid, ea)
+                node_of[other.gid] = other
+                if target_gid is not None and other.gid == target_gid:
+                    yield (other, self._path(parent, other.gid),
+                           float(depth + 1))
+                    return
+                if target_gid is None:
+                    yield (other, self._path(parent, other.gid),
+                           float(depth + 1))
+                queue.append((other, depth + 1))
+
+    @staticmethod
+    def _path(parent, gid):
+        edges = []
+        while parent[gid] is not None:
+            prev_gid, edge = parent[gid]
+            edges.append(edge)
+            gid = prev_gid
+        edges.reverse()
+        return edges
+
+    def _dijkstra(self, ctx, frame, source, target_gid, max_hops, type_ids,
+                  all_shortest):
+        import heapq
+        import itertools as it
+        dist = {source.gid: 0.0}
+        hops = {source.gid: 0}
+        parents: dict = {source.gid: []}  # gid -> [(prev_gid, edge)]
+        node_of = {source.gid: source}
+        tie = it.count()
+        heap = [(0.0, next(tie), source)]
+        settled = set()
+        while heap:
+            ctx.check_abort()
+            d, _, va = heapq.heappop(heap)
+            if va.gid in settled:
+                continue
+            settled.add(va.gid)
+            if target_gid is not None and va.gid == target_gid:
+                break
+            if hops[va.gid] >= max_hops:
+                continue
+            for ea, other in self._neighbors(ctx, va, type_ids):
+                if not self._passes_filter(ctx, frame, ea, other):
+                    continue
+                w = self._edge_weight(ctx, frame, ea, other)
+                nd = d + w
+                old = dist.get(other.gid)
+                if old is None or nd < old - 1e-12:
+                    dist[other.gid] = nd
+                    hops[other.gid] = hops[va.gid] + 1
+                    parents[other.gid] = [(va.gid, ea)]
+                    node_of[other.gid] = other
+                    heapq.heappush(heap, (nd, next(tie), other))
+                elif all_shortest and abs(nd - old) <= 1e-12:
+                    parents[other.gid].append((va.gid, ea))
+
+        def all_paths(gid):
+            if not parents[gid]:
+                yield []
+                return
+            for (prev_gid, edge) in parents[gid]:
+                for prefix in all_paths(prev_gid):
+                    yield prefix + [edge]
+
+        targets = ([target_gid] if target_gid is not None
+                   else [g for g in dist if g != source.gid])
+        for gid in targets:
+            if gid not in dist:
+                continue
+            if all_shortest:
+                for path in all_paths(gid):
+                    yield (node_of[gid], path, dist[gid])
+            else:
+                yield (node_of[gid], all_paths(gid).__next__(), dist[gid])
+
+
+@dataclass
 class ConstructNamedPath(LogicalOperator):
     """Bind a path variable from matched pattern symbols."""
     input: LogicalOperator
@@ -966,6 +1139,32 @@ class CallProcedureOp(LogicalOperator):
                             f"{fieldname!r}")
                     new[sym] = record[fieldname]
                 yield new
+
+
+@dataclass
+class Apply(LogicalOperator):
+    """CALL { subquery }: run the subplan per input row; merge returned
+    columns (or pass rows through for unit subqueries)."""
+    input: LogicalOperator
+    subplan: LogicalOperator
+    columns: list[str]
+
+    def cursor(self, ctx):
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            sub_rows = _run_subplan(self.subplan, ctx, frame)
+            if not self.columns:
+                yield frame  # unit subquery: cardinality preserved
+                continue
+            for sub in sub_rows:
+                row = sub.get("__row__", {})
+                merged = dict(frame)
+                for col in self.columns:
+                    merged[col] = row.get(col, sub.get(col))
+                yield merged
+
+    def children(self):
+        return [self.input, self.subplan]
 
 
 @dataclass

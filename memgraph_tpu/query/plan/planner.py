@@ -104,9 +104,10 @@ class Planner:
             plan = Op.Union(plan, sub_plan, columns, distinct=not union_all)
         return plan, columns
 
-    def plan_single(self, single: A.SingleQuery):
-        plan: Op.LogicalOperator = Op.Once()
-        bound: set[str] = set()
+    def plan_single(self, single: A.SingleQuery, leaf=None,
+                    initial_bound=None):
+        plan: Op.LogicalOperator = leaf if leaf is not None else Op.Once()
+        bound: set[str] = set(initial_bound or ())
         columns: list[str] = []
         clauses = single.clauses
         has_update = False
@@ -133,6 +134,13 @@ class Planner:
             elif isinstance(clause, A.Unwind):
                 plan = Op.Unwind(plan, clause.expr, clause.variable)
                 bound.add(clause.variable)
+            elif isinstance(clause, A.CallSubquery):
+                sub_plan, sub_cols = self.plan_single(
+                    clause.query, leaf=Op.Argument(), initial_bound=bound)
+                if _single_has_update(clause.query):
+                    has_update = True
+                plan = Op.Apply(plan, sub_plan, sub_cols)
+                bound.update(sub_cols)
             elif isinstance(clause, A.CallProcedure):
                 plan = self.plan_call(clause, plan, bound)
                 if ci == len(clauses) - 1 and (clause.yields
@@ -449,7 +457,15 @@ class Planner:
         to_sym = to_node.variable
         edge_sym = edge.variable
 
-        if edge.var_length:
+        if edge.algo:
+            max_h = edge.max_hops.value if edge.max_hops else -1
+            plan = Op.ExpandShortest(plan, from_sym, edge_sym, to_sym,
+                                     direction, edge.types, edge.algo,
+                                     max_h, edge.weight_lambda,
+                                     edge.filter_lambda, edge.total_weight)
+            if edge.total_weight:
+                bound.add(edge.total_weight)
+        elif edge.var_length:
             min_h = edge.min_hops.value if edge.min_hops else 1
             max_h = edge.max_hops.value if edge.max_hops else -1
             plan = Op.ExpandVariable(plan, from_sym, edge_sym, to_sym,
@@ -706,6 +722,11 @@ class Planner:
             clone.items = {k: self._rewrite_aggs(v, agg_specs)
                            for k, v in expr.items.items()}
         return clone
+
+
+def _single_has_update(single: A.SingleQuery) -> bool:
+    return any(isinstance(c, (A.Create, A.Merge, A.SetClause, A.Remove,
+                              A.Delete, A.Foreach)) for c in single.clauses)
 
 
 def _flip(op: str) -> str:

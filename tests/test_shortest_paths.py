@@ -1,0 +1,91 @@
+"""*BFS / *WSHORTEST / *ALLSHORTEST expansion tests (reference:
+tests/unit/bfs_single_node.cpp, query_plan_* weighted shortest)."""
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    ictx = InterpreterContext(InMemoryStorage())
+    run(ictx, """CREATE (a:City {name:'a'}), (b:City {name:'b'}),
+                        (c:City {name:'c'}), (d:City {name:'d'}),
+                        (a)-[:ROAD {d: 1.0}]->(b),
+                        (b)-[:ROAD {d: 1.0}]->(d),
+                        (a)-[:ROAD {d: 5.0}]->(d),
+                        (a)-[:ROAD {d: 1.0}]->(c),
+                        (c)-[:ROAD {d: 1.0}]->(d)""")
+    return ictx
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def test_bfs_shortest_hops(db):
+    # the direct a->d edge makes the hop-shortest path length 1
+    rows = run(db, "MATCH (a:City {name:'a'})-[e *BFS]->(d:City {name:'d'}) "
+                   "RETURN size(e)")
+    assert rows == [[1]]
+
+
+def test_bfs_unbound_target(db):
+    rows = run(db, "MATCH (a:City {name:'a'})-[e *BFS]->(x) "
+                   "RETURN x.name, size(e) ORDER BY x.name")
+    got = {r[0]: r[1] for r in rows}
+    assert got == {"b": 1, "c": 1, "d": 1}
+
+
+def test_bfs_max_hops(db):
+    rows = run(db, "MATCH (a:City {name:'a'})-[e *BFS ..1]->(x) "
+                   "RETURN x.name ORDER BY x.name")
+    assert [r[0] for r in rows] == ["b", "c", "d"]
+
+
+def test_bfs_filter_lambda(db):
+    # excluding heavy edges forces the two-hop route
+    rows = run(db, "MATCH (a:City {name:'a'})-[e *BFS (r, n | r.d < 2.0)]"
+                   "->(d:City {name:'d'}) RETURN size(e)")
+    assert rows == [[2]]
+
+
+def test_wshortest(db):
+    rows = run(db, "MATCH (a:City {name:'a'})"
+                   "-[e *WSHORTEST (r, n | r.d) w]->(d:City {name:'d'}) "
+                   "RETURN size(e), w")
+    assert rows == [[2, 2.0]]  # cost 2 beats the direct 5.0 edge
+
+
+def test_wshortest_unbound(db):
+    rows = run(db, "MATCH (a:City {name:'a'})"
+                   "-[e *WSHORTEST (r, n | r.d) w]->(x) "
+                   "RETURN x.name, w ORDER BY x.name")
+    got = {r[0]: r[1] for r in rows}
+    assert got == {"b": 1.0, "c": 1.0, "d": 2.0}
+
+
+def test_allshortest(db):
+    rows = run(db, "MATCH (a:City {name:'a'})"
+                   "-[e *ALLSHORTEST (r, n | r.d) w]->(d:City {name:'d'}) "
+                   "RETURN size(e), w")
+    assert len(rows) == 2  # both cost-2 paths (via b and via c)
+    assert all(r == [2, 2.0] for r in rows)
+
+
+def test_bfs_named_path(db):
+    rows = run(db, "MATCH p = (a:City {name:'a'})-[*BFS]->(d:City {name:'d'})"
+                   " RETURN length(p), size(nodes(p))")
+    assert rows == [[1, 2]]
+
+
+def test_negative_weight_rejected(db):
+    run(db, "MATCH (a:City {name:'a'})-[r:ROAD]->(b:City {name:'b'}) "
+            "SET r.d = -1.0")
+    from memgraph_tpu.exceptions import TypeException
+    with pytest.raises(TypeException):
+        run(db, "MATCH (a:City {name:'a'})"
+                "-[e *WSHORTEST (r, n | r.d) w]->(d:City {name:'d'}) "
+                "RETURN w")

@@ -1,0 +1,89 @@
+"""CALL { subquery } and pattern comprehension tests."""
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    ictx = InterpreterContext(InMemoryStorage())
+    run(ictx, """CREATE (a:P {name:'ana'}), (b:P {name:'ben'}),
+                        (c:P {name:'cy'}),
+                        (a)-[:KNOWS]->(b), (a)-[:KNOWS]->(c),
+                        (b)-[:KNOWS]->(c)""")
+    return ictx
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def test_call_subquery_correlated(db):
+    rows = run(db, """
+        MATCH (p:P)
+        CALL {
+          WITH p
+          MATCH (p)-[:KNOWS]->(f)
+          RETURN count(f) AS friends
+        }
+        RETURN p.name, friends ORDER BY p.name""")
+    assert rows == [["ana", 2], ["ben", 1], ["cy", 0]]
+
+
+def test_call_subquery_multiplies_rows(db):
+    rows = run(db, """
+        MATCH (p:P {name:'ana'})
+        CALL {
+          WITH p
+          MATCH (p)-[:KNOWS]->(f)
+          RETURN f.name AS friend
+        }
+        RETURN friend ORDER BY friend""")
+    assert [r[0] for r in rows] == ["ben", "cy"]
+
+
+def test_unit_subquery_preserves_cardinality(db):
+    rows = run(db, """
+        UNWIND [1, 2] AS x
+        CALL {
+          CREATE (:FromSub)
+        }
+        RETURN x ORDER BY x""")
+    assert [r[0] for r in rows] == [1, 2]
+    assert run(db, "MATCH (n:FromSub) RETURN count(n)") == [[2]]
+
+
+def test_uncorrelated_subquery(db):
+    rows = run(db, """
+        UNWIND [10, 20] AS x
+        CALL {
+          UNWIND [1, 2] AS y
+          RETURN y
+        }
+        RETURN x, y ORDER BY x, y""")
+    assert rows == [[10, 1], [10, 2], [20, 1], [20, 2]]
+
+
+def test_pattern_comprehension(db):
+    rows = run(db, "MATCH (p:P) RETURN p.name, "
+                   "[(p)-[:KNOWS]->(f) | f.name] AS friends "
+                   "ORDER BY p.name")
+    got = {r[0]: sorted(r[1]) for r in rows}
+    assert got == {"ana": ["ben", "cy"], "ben": ["cy"], "cy": []}
+
+
+def test_pattern_comprehension_where(db):
+    rows = run(db, "MATCH (p:P {name:'ana'}) RETURN "
+                   "[(p)-[:KNOWS]->(f) WHERE f.name STARTS WITH 'b' | f.name]"
+                   " AS friends")
+    assert rows == [[["ben"]]]
+
+
+def test_pattern_comprehension_size(db):
+    rows = run(db, "MATCH (p:P) RETURN p.name, "
+                   "size([(p)-[:KNOWS]->(f) | f]) AS degree "
+                   "ORDER BY p.name")
+    assert rows == [["ana", 2], ["ben", 1], ["cy", 0]]
