@@ -44,6 +44,8 @@ def build_config(argv=None) -> argparse.Namespace:
     p.add_argument("--auth-user-or-role-name-regex", default=".*")
     p.add_argument("--monitoring-port", type=int, default=0,
                    help="Prometheus metrics HTTP port (0 = disabled)")
+    p.add_argument("--audit-enabled",
+                   action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--init-file", default=None,
                    help="cypherl file executed on startup")
@@ -84,6 +86,14 @@ def build_database(args) -> InterpreterContext:
     # doesn't pay the compile
     from .ops.native import get_lib
     get_lib()
+
+    if args.audit_enabled and args.data_directory:
+        from .observability.audit import AuditLog
+        import os
+        ictx.audit = AuditLog(
+            os.path.join(args.data_directory, "audit", "audit.log"),
+            install_sigusr2=True)
+        logging.info("audit log enabled")
 
     # trigger store wiring (registers its commit hook)
     from .query.triggers import global_trigger_store

@@ -1,0 +1,78 @@
+"""Audit log: buffered JSONL of executed queries.
+
+Counterpart of the reference's audit log (/root/reference/src/audit/log.hpp
+— buffered (user, query, params) records with logrotate reopen on SIGUSR2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+
+class AuditLog:
+    def __init__(self, path: str, buffer_size: int = 100,
+                 install_sigusr2: bool = False):
+        self.path = path
+        self.buffer_size = buffer_size
+        self._lock = threading.Lock()
+        self._buffer: list[str] = []
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._file = open(path, "a", encoding="utf-8")
+        if install_sigusr2:
+            signal.signal(signal.SIGUSR2, self._reopen_handler)
+
+    def record(self, username: str, query: str, parameters=None) -> None:
+        entry = json.dumps({
+            "timestamp": time.time(),
+            "address": "",
+            "username": username or "",
+            "query": query,
+            "params": parameters or {},
+        })
+        with self._lock:
+            self._buffer.append(entry)
+            if len(self._buffer) >= self.buffer_size:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buffer:
+            self._file.write("\n".join(self._buffer) + "\n")
+            self._file.flush()
+            self._buffer.clear()
+
+    def _reopen_handler(self, signum, frame) -> None:
+        """SIGUSR2: reopen after logrotate (reference: memgraph.cpp:495)."""
+        with self._lock:
+            self._flush_locked()
+            self._file.close()
+            self._file = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        self.flush()
+        self._file.close()
+
+
+class SessionTrace:
+    """Per-session event timeline (reference: SESSION TRACE ON,
+    interpreter.cpp:8530 EmitSessionTraceEvent)."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.events: list[dict] = []
+
+    def emit(self, event: str, **data) -> None:
+        if self.enabled:
+            self.events.append({"ts": time.time(), "event": event, **data})
+
+    def drain(self) -> list[dict]:
+        out = self.events
+        self.events = []
+        return out

@@ -412,6 +412,11 @@ class TriggerQuery:
 
 
 @dataclass
+class SessionTraceQuery:
+    enabled: bool
+
+
+@dataclass
 class MultiDatabaseQuery:
     action: str                 # create | drop | use | show
     name: Optional[str] = None

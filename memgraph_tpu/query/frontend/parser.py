@@ -248,6 +248,16 @@ class Parser:
             self.advance()
             self.expect_kw("STREAM")
             return A.StreamQuery("check", name=self.name_token())
+        if self.at_kw("SESSION") and self.peek().type == T.IDENT and \
+                self.peek().value.upper() == "TRACE":
+            self.advance()
+            self.advance()
+            if self.accept_kw("ON"):
+                return A.SessionTraceQuery(True)
+            if self.at(T.IDENT) and self.cur.value.upper() == "OFF":
+                self.advance()
+                return A.SessionTraceQuery(False)
+            self.error("expected ON or OFF after SESSION TRACE")
         if self.at_kw("ENABLE"):
             self.advance()
             self.expect_kw("TTL")

@@ -104,13 +104,31 @@ class Interpreter:
         self._profile_start = None
         self._abort_flag = threading.Event()
         self._current_query_info = None
+        from ..observability.audit import SessionTrace
+        self.session_trace = SessionTrace()
+        self.username = ""
 
     # --- public API ---------------------------------------------------------
 
     def prepare(self, text: str, parameters: Optional[dict] = None
                 ) -> PreparedQuery:
         parameters = parameters or {}
+        audit = getattr(self.ctx, "audit", None)
+        if audit is not None:
+            audit.record(getattr(self, "username", ""), text, parameters)
+        self.session_trace.emit("prepare", query=text)
         node = self.ctx.cached_parse(text)
+        if isinstance(node, A.SessionTraceQuery):
+            if node.enabled:
+                self.session_trace.enabled = True
+                self.session_trace.events = []
+                return self._prepare_generator(
+                    iter([["session trace enabled"]]), ["status"], "s")
+            self.session_trace.enabled = False
+            rows = [[e.pop("ts"), e.pop("event"), str(e)]
+                    for e in self.session_trace.drain()]
+            return self._prepare_generator(
+                iter(rows), ["timestamp", "event", "data"], "r")
 
         if isinstance(node, A.TransactionQuery):
             return self._prepare_transaction(node)
@@ -486,6 +504,7 @@ class Interpreter:
 
     def _finish_stream(self) -> dict:
         summary = {}
+        self.session_trace.emit("finish")
         if self._exec_ctx is not None:
             summary["stats"] = dict(self._exec_ctx.stats)
         if self._stream_owns_txn and self._stream_accessor is not None:

@@ -1,0 +1,122 @@
+"""Process-level e2e harness.
+
+Counterpart of the reference's interactive_mg_runner.py
+(/root/reference/tests/e2e/interactive_mg_runner.py): spawns REAL server
+processes (python -m memgraph_tpu.main) from a declarative cluster
+description — distinct ports and data directories on one host — and hands
+back connected Bolt clients.
+
+    cluster = Cluster({
+        "main": {"args": ["--bolt-port", "0"]},
+        "replica1": {...},
+    }, base_dir=tmp_path)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Instance:
+    def __init__(self, name: str, bolt_port: int, proc: subprocess.Popen,
+                 data_dir: str, extra_args: list[str], log_path: str):
+        self.name = name
+        self.bolt_port = bolt_port
+        self.proc = proc
+        self.data_dir = data_dir
+        self.extra_args = extra_args
+        self.log_path = log_path
+
+    def client(self, timeout=30.0):
+        from memgraph_tpu.server.client import BoltClient
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                return BoltClient(port=self.bolt_port)
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        raise TimeoutError(
+            f"instance {self.name} not reachable on {self.bolt_port}: {last}"
+            f"\n--- log tail ---\n{self.log_tail()}")
+
+    def log_tail(self, n=30) -> str:
+        try:
+            with open(self.log_path) as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return "<no log>"
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    def is_alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class Cluster:
+    def __init__(self, description: dict, base_dir: str):
+        self.base_dir = str(base_dir)
+        self.instances: dict[str, Instance] = {}
+        for name, spec in description.items():
+            self.start_instance(name, spec)
+
+    def start_instance(self, name: str, spec: dict | None = None,
+                       reuse_port: int | None = None) -> Instance:
+        spec = spec or {}
+        bolt_port = reuse_port or spec.get("bolt_port") or free_port()
+        data_dir = os.path.join(self.base_dir, name)
+        os.makedirs(data_dir, exist_ok=True)
+        extra = list(spec.get("args", []))
+        log_path = os.path.join(self.base_dir, f"{name}.log")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "memgraph_tpu.main",
+               "--bolt-address", "127.0.0.1",
+               "--bolt-port", str(bolt_port),
+               "--data-directory", data_dir] + extra
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(cmd, stdout=log_file, stderr=log_file,
+                                env=env, cwd=REPO_ROOT)
+        inst = Instance(name, bolt_port, proc, data_dir, extra, log_path)
+        self.instances[name] = inst
+        return inst
+
+    def restart_instance(self, name: str) -> Instance:
+        old = self.instances[name]
+        old.terminate()
+        return self.start_instance(name, {"args": old.extra_args},
+                                   reuse_port=old.bolt_port)
+
+    def __getitem__(self, name: str) -> Instance:
+        return self.instances[name]
+
+    def shutdown(self) -> None:
+        for inst in self.instances.values():
+            inst.terminate()

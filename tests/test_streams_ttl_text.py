@@ -140,6 +140,36 @@ def test_text_search_index_updates(db):
     assert info == [["idx", 0]]
 
 
+# --- audit + session trace ---------------------------------------------------
+
+def test_audit_log(db, tmp_path):
+    import json as jsonlib
+    from memgraph_tpu.observability.audit import AuditLog
+    db.audit = AuditLog(str(tmp_path / "audit.log"), buffer_size=1)
+    run(db, "RETURN 1")
+    run(db, "CREATE (:Audited)")
+    db.audit.flush()
+    lines = (tmp_path / "audit.log").read_text().strip().splitlines()
+    entries = [jsonlib.loads(l) for l in lines]
+    assert any("CREATE (:Audited)" in e["query"] for e in entries)
+    db.audit = None
+
+
+def test_session_trace(db):
+    interp = Interpreter(db)
+    _, rows, _ = interp.execute("SESSION TRACE ON")
+    assert rows == [["session trace enabled"]]
+    interp.execute("RETURN 1")
+    interp.execute("CREATE (:Traced)")
+    _, rows, _ = interp.execute("SESSION TRACE OFF")
+    events = [r[1] for r in rows]
+    assert "prepare" in events and "finish" in events
+    # trace is per-session: a fresh interpreter has none
+    interp2 = Interpreter(db)
+    _, rows, _ = interp2.execute("SESSION TRACE OFF")
+    assert rows == []
+
+
 # --- LOAD CSV / JSONL / PARQUET ---------------------------------------------
 
 def test_load_csv_with_header(db, tmp_path):
