@@ -159,6 +159,39 @@ class ReplicaClient:
             self.status = ReplicaStatus.INVALID
             return False
 
+    # --- 2PC (STRICT_SYNC) --------------------------------------------------
+
+    def prepare(self, frame: bytes) -> bool:
+        """Phase 1: ship the frame for a vote (held pending on the replica)."""
+        if self.status is not ReplicaStatus.READY:
+            return False
+        with self._lock:
+            try:
+                P.send_frame(self._sock, P.MSG_PREPARE, frame)
+                msg_type, payload = P.recv_frame(self._sock)
+                return msg_type == P.MSG_ACK
+            except (ConnectionError, OSError) as e:
+                log.warning("replica %s prepare failed: %s", self.name, e)
+                self.status = ReplicaStatus.INVALID
+                return False
+
+    def finalize(self, commit_ts: int, decision: str) -> bool:
+        """Phase 2: commit/abort the pending frame."""
+        with self._lock:
+            try:
+                P.send_json(self._sock, P.MSG_FINALIZE,
+                            {"commit_ts": commit_ts, "decision": decision})
+                msg_type, payload = P.recv_frame(self._sock)
+                if msg_type == P.MSG_ACK:
+                    if decision == "commit":
+                        self.last_acked_ts = P.parse_json(
+                            payload)["last_commit_ts"]
+                    return True
+            except (ConnectionError, OSError) as e:
+                log.warning("replica %s finalize failed: %s", self.name, e)
+            self.status = ReplicaStatus.INVALID
+            return False
+
     def _drain_loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -215,14 +248,19 @@ class ReplicationState:
         # lazy: commits only pay frame encoding once a replica exists
         if not self._consumer_registered:
             self.storage.frame_consumers.append(self._on_commit_frame)
+            self.storage.pre_commit_hooks.append(self._on_pre_commit)
             self._consumer_registered = True
 
     def _maybe_remove_consumer(self) -> None:
         if self._consumer_registered and not self.replicas:
-            try:
-                self.storage.frame_consumers.remove(self._on_commit_frame)
-            except ValueError:
-                pass
+            for lst, hook in ((self.storage.frame_consumers,
+                               self._on_commit_frame),
+                              (self.storage.pre_commit_hooks,
+                               self._on_pre_commit)):
+                try:
+                    lst.remove(hook)
+                except ValueError:
+                    pass
             self._consumer_registered = False
 
     # --- role management ----------------------------------------------------
@@ -318,6 +356,46 @@ class ReplicationState:
 
     # --- commit hook --------------------------------------------------------
 
+    def _on_pre_commit(self, frame: bytes, commit_ts: int) -> None:
+        """2PC phase 1 (under the engine lock, before WAL + visibility):
+        every STRICT_SYNC replica must vote yes or the commit aborts
+        (reference: PrepareCommit with vote wait,
+        inmemory/storage.cpp:1224-1272)."""
+        if self.role != "main":
+            return
+        with self._lock:
+            all_strict = [c for c in self.replicas.values()
+                          if c.mode is ReplicationMode.STRICT_SYNC]
+        # a dead STRICT_SYNC replica means NO commit may proceed — that is
+        # the strict guarantee; replicas mid-catch-up don't vote (the frame
+        # reaches them via the RECOVERY buffer / snapshot instead)
+        down = [c for c in all_strict if c.status is ReplicaStatus.INVALID]
+        if down:
+            from ..exceptions import TransactionException
+            raise TransactionException(
+                "STRICT_SYNC replica(s) unavailable: "
+                + ", ".join(c.name for c in down)
+                + " — transaction aborted (drop the replica or restore it)")
+        strict = [c for c in all_strict
+                  if c.status is ReplicaStatus.READY]
+        if not strict:
+            return
+        prepared = []
+        failed = []
+        for c in strict:
+            if c.prepare(frame):
+                prepared.append(c)
+            else:
+                failed.append(c)
+        if failed:
+            for c in prepared:
+                c.finalize(commit_ts, "abort")
+            from ..exceptions import TransactionException
+            raise TransactionException(
+                "STRICT_SYNC replica(s) did not confirm the prepare phase: "
+                + ", ".join(c.name for c in failed)
+                + " — transaction aborted")
+
     def _on_commit_frame(self, frame: bytes, commit_ts: int) -> None:
         if self.role != "main":
             return
@@ -326,12 +404,17 @@ class ReplicationState:
         if not clients:
             return
         for c in clients:
+            if c.mode is ReplicationMode.STRICT_SYNC:
+                if c.status is ReplicaStatus.READY:
+                    # 2PC phase 2: the frame was prepared pre-visibility
+                    c.finalize(commit_ts, "commit")
+                elif c.status is ReplicaStatus.RECOVERY:
+                    c.ship(frame)  # buffers for the catch-up drain
+                continue
             ok = c.ship(frame)
-            if not ok and c.mode in (ReplicationMode.SYNC,
-                                     ReplicationMode.STRICT_SYNC):
+            if not ok and c.mode is ReplicationMode.SYNC:
                 # the commit is already locally visible — raising here could
                 # only corrupt the session; the replica is marked INVALID and
-                # surfaces through SHOW REPLICAS (full 2PC vote-before-
-                # visibility is the STRICT_SYNC follow-up)
-                log.error("replica %s (%s) failed to confirm commit %d",
-                          c.name, c.mode.value, commit_ts)
+                # surfaces through SHOW REPLICAS
+                log.error("replica %s (sync) failed to confirm commit %d",
+                          c.name, commit_ts)

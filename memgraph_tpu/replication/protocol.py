@@ -22,6 +22,8 @@ MSG_SNAPSHOT = 0x03       # raw snapshot bytes (full state transfer)
 MSG_WAL_FRAME = 0x04      # raw wal txn frame (commit application)
 MSG_HEARTBEAT = 0x05      # json: {main_commit_ts}
 MSG_ACK = 0x06            # json: {last_commit_ts}
+MSG_PREPARE = 0x07        # 2PC phase 1: wal frame held pending a decision
+MSG_FINALIZE = 0x08       # 2PC phase 2: json {commit_ts, decision}
 MSG_ERROR = 0x7F          # json: {message}
 
 

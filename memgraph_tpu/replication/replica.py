@@ -35,6 +35,10 @@ class ReplicaServer:
         self._stop = threading.Event()
         self._apply_lock = threading.Lock()
         self._conns: list[socket.socket] = []
+        # 2PC (STRICT_SYNC): frames received via MSG_PREPARE wait here for
+        # the MAIN's MSG_FINALIZE decision (reference: PrepareCommit /
+        # FinalizeCommit RPCs, storage/v2/replication/rpc.hpp:59-98)
+        self._pending_2pc: dict[int, bytes] = {}
 
     def start(self) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -86,6 +90,22 @@ class ReplicaServer:
                                 {"last_commit_ts": self.last_commit_ts})
                 elif msg_type == P.MSG_WAL_FRAME:
                     self._apply_wal_frame(payload)
+                    P.send_json(conn, P.MSG_ACK,
+                                {"last_commit_ts": self.last_commit_ts})
+                elif msg_type == P.MSG_PREPARE:
+                    # phase 1: durably hold the frame, vote yes
+                    txns = list(W.iter_txns_from_bytes(payload))
+                    for commit_ts, _ in txns:
+                        self._pending_2pc[commit_ts] = payload
+                    P.send_json(conn, P.MSG_ACK,
+                                {"last_commit_ts": self.last_commit_ts,
+                                 "prepared": [ts for ts, _ in txns]})
+                elif msg_type == P.MSG_FINALIZE:
+                    info = P.parse_json(payload)
+                    commit_ts = info["commit_ts"]
+                    frame = self._pending_2pc.pop(commit_ts, None)
+                    if info.get("decision") == "commit" and frame is not None:
+                        self._apply_wal_frame(frame)
                     P.send_json(conn, P.MSG_ACK,
                                 {"last_commit_ts": self.last_commit_ts})
                 elif msg_type == P.MSG_HEARTBEAT:

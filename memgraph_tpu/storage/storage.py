@@ -646,6 +646,9 @@ class InMemoryStorage:
         # durability wiring: receives (frame_bytes, commit_ts) under the
         # engine lock, BEFORE the visibility flip (write-ahead ordering)
         self.wal_sink: Optional[Callable] = None
+        # 2PC vote stage: run under the engine lock BEFORE the WAL write and
+        # visibility flip; raising aborts the commit (STRICT_SYNC replicas)
+        self.pre_commit_hooks: list[Callable] = []
         # replication etc.: receive the same (frame_bytes, commit_ts) after
         # the commit is visible (outside the engine lock)
         self.frame_consumers: list[Callable] = []
@@ -691,12 +694,17 @@ class InMemoryStorage:
                 [v for v in touched], self.namer)
             self._timestamp += 1
             commit_ts = self._timestamp
-            if self.wal_sink is not None or self.frame_consumers:
+            if self.wal_sink is not None or self.frame_consumers \
+                    or self.pre_commit_hooks:
                 # encode ONCE under the lock: object fields hold exactly this
                 # transaction's final state here (no later writer can have
                 # touched them yet — they'd need the lock to commit)
                 from .durability.wal import encode_txn_ops
                 frame = encode_txn_ops(self, txn, commit_ts)
+                for hook in self.pre_commit_hooks:
+                    # 2PC vote: a raise here aborts the commit before any
+                    # durability or visibility effect
+                    hook(frame, commit_ts)
                 if self.wal_sink is not None:
                     self.wal_sink(frame, commit_ts)
                 if self.frame_consumers:

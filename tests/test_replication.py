@@ -127,6 +127,30 @@ def test_failed_replica_marked_invalid(cluster):
     assert rows[0][4] == "invalid"
 
 
+def test_strict_sync_two_phase_commit(cluster):
+    main, replica = cluster["main"], cluster["replica"]
+    main.execute(
+        f"REGISTER REPLICA r1 STRICT_SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    main.execute("CREATE (:Strict {v: 1})")
+    # committed on both sides
+    assert _rows(replica, "MATCH (n:Strict) RETURN count(n)") == [[1]]
+    assert _rows(main, "MATCH (n:Strict) RETURN count(n)") == [[1]]
+
+
+def test_strict_sync_abort_on_unreachable_replica(cluster):
+    main = cluster["main"]
+    main.execute(
+        f"REGISTER REPLICA r1 STRICT_SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    main.execute("CREATE (:BeforeKill)")
+    cluster["replica_ictx"].replication.replica_server.stop()
+    # prepare phase fails → the MAIN's commit must abort entirely
+    from memgraph_tpu.exceptions import TransactionException
+    with pytest.raises(TransactionException):
+        main.execute("CREATE (:AfterKill)")
+    assert _rows(main, "MATCH (n:AfterKill) RETURN count(n)") == [[0]]
+    assert _rows(main, "MATCH (n:BeforeKill) RETURN count(n)") == [[1]]
+
+
 def test_replica_promote_to_main(cluster):
     main, replica = cluster["main"], cluster["replica"]
     main.execute(
