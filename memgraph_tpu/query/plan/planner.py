@@ -753,7 +753,9 @@ def _expr_name(expr: A.Expr) -> str:
     if isinstance(expr, A.Unary):
         return f"{expr.op} {_expr_name(expr.expr)}"
     if isinstance(expr, A.Slice):
-        return f"{_expr_name(expr.expr)}[..]"
+        lo = _expr_name(expr.lo) if expr.lo is not None else ""
+        hi = _expr_name(expr.hi) if expr.hi is not None else ""
+        return f"{_expr_name(expr.expr)}[{lo}..{hi}]"
     if isinstance(expr, A.LabelsTest):
         return f"{_expr_name(expr.expr)}:{':'.join(expr.labels)}"
     if isinstance(expr, A.IsNull):

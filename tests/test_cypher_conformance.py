@@ -1,0 +1,212 @@
+"""openCypher conformance slice — TCK-flavored semantic edge cases.
+
+Counterpart of the reference's gql_behave suites
+(/root/reference/tests/gql_behave/tests/openCypher_M09, memgraph_V1):
+behavioral corners of the language that implementations commonly get wrong.
+"""
+
+import math
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+# --- null semantics ----------------------------------------------------------
+
+def test_aggregates_skip_nulls(db):
+    rows = run(db, "UNWIND [1, null, 3] AS x "
+                   "RETURN count(x), sum(x), avg(x), collect(x)")
+    assert rows == [[2, 4, 2.0, [1, 3]]]
+
+
+def test_count_star_counts_null_rows(db):
+    rows = run(db, "UNWIND [1, null] AS x RETURN count(*)")
+    assert rows == [[2]]
+
+
+def test_avg_of_empty_is_null(db):
+    rows = run(db, "UNWIND [1] AS x WITH x WHERE x > 5 "
+                   "RETURN avg(x), sum(x), count(x)")
+    assert rows == [[None, 0, 0]]
+
+
+def test_property_of_missing_key_is_null(db):
+    run(db, "CREATE (:N {a: 1})")
+    rows = run(db, "MATCH (n:N) RETURN n.nonexistent")
+    assert rows == [[None]]
+
+
+def test_where_null_filters_out(db):
+    run(db, "CREATE (:W {a: 1}), (:W)")
+    rows = run(db, "MATCH (n:W) WHERE n.a > 0 RETURN count(n)")
+    assert rows == [[1]]  # null comparison is null → filtered
+
+
+def test_order_by_nulls_last_ascending(db):
+    rows = run(db, "UNWIND [3, null, 1] AS x RETURN x ORDER BY x")
+    assert [r[0] for r in rows] == [1, 3, None]
+
+
+def test_distinct_treats_nulls_equal(db):
+    rows = run(db, "UNWIND [null, null, 1] AS x RETURN DISTINCT x")
+    values = [r[0] for r in rows]
+    assert sorted(values, key=lambda v: (v is None, v or 0)) == [1, None]
+
+
+# --- arithmetic + types ------------------------------------------------------
+
+def test_integer_division_truncates_toward_zero(db):
+    rows = run(db, "RETURN 7 / 2, -7 / 2, 7 % 2, -7 % 2")
+    assert rows == [[3, -3, 1, -1]]
+
+
+def test_division_by_zero_integer_raises(db):
+    from memgraph_tpu.exceptions import ArithmeticException
+    with pytest.raises(ArithmeticException):
+        run(db, "RETURN 1 / 0")
+
+
+def test_float_division_by_zero_is_inf(db):
+    rows = run(db, "RETURN 1.0 / 0.0")
+    assert rows[0][0] == math.inf
+
+
+def test_string_concat_and_list_concat(db):
+    rows = run(db, "RETURN 'a' + 'b', [1] + [2], [1] + 2, 1 + [2]")
+    assert rows == [["ab", [1, 2], [1, 2], [1, 2]]]
+
+
+def test_mixed_numeric_comparison(db):
+    rows = run(db, "RETURN 1 = 1.0, 1 < 1.5, '1' = 1")
+    assert rows == [[True, True, False]]
+
+
+def test_list_index_out_of_bounds_is_null(db):
+    rows = run(db, "RETURN [1, 2][5], [1, 2][-1], [1, 2][-5]")
+    assert rows == [[None, 2, None]]
+
+
+def test_list_slice(db):
+    rows = run(db, "WITH [1,2,3,4,5] AS l RETURN l[1..3], l[..2], l[3..]")
+    assert rows == [[[2, 3], [1, 2], [4, 5]]]
+
+
+# --- MERGE semantics ---------------------------------------------------------
+
+def test_merge_binds_per_input_row(db):
+    run(db, "UNWIND [1, 1, 2] AS x MERGE (:M {k: x})")
+    rows = run(db, "MATCH (n:M) RETURN count(n)")
+    assert rows == [[2]]
+
+
+def test_merge_full_pattern_semantics(db):
+    """MERGE matches the WHOLE pattern or creates the WHOLE pattern."""
+    run(db, "CREATE (:MA {k: 1}), (:MB {k: 2})")
+    # pattern (a)-[r]->(b) doesn't exist → ALL of it is created fresh
+    run(db, "MERGE (a:MA {k: 1})-[:R]->(b:MB {k: 2})")
+    rows = run(db, "MATCH (n) RETURN count(n)")
+    assert rows == [[4]]  # the two originals + a fresh pair
+    run(db, "MERGE (a:MA {k: 1})-[:R]->(b:MB {k: 2})")  # now it matches
+    rows = run(db, "MATCH ()-[r:R]->() RETURN count(r)")
+    assert rows == [[1]]
+
+
+# --- OPTIONAL MATCH ----------------------------------------------------------
+
+def test_optional_match_aggregation(db):
+    run(db, "CREATE (:OA {k: 1})")
+    rows = run(db, "MATCH (a:OA) OPTIONAL MATCH (a)-[:NOPE]->(b) "
+                   "RETURN count(b)")
+    assert rows == [[0]]
+
+
+def test_optional_match_property_of_null(db):
+    run(db, "CREATE (:OB)")
+    rows = run(db, "MATCH (a:OB) OPTIONAL MATCH (a)-->(b) "
+                   "RETURN b.name, labels(b)")
+    assert rows == [[None, None]]
+
+
+# --- pattern matching corners ------------------------------------------------
+
+def test_self_loop_matched_once_per_direction(db):
+    run(db, "CREATE (a:SL)-[:R]->(a)")
+    rows = run(db, "MATCH (a:SL)-[r:R]->(a) RETURN count(r)")
+    assert rows == [[1]]
+    rows = run(db, "MATCH (a:SL)-[r:R]-(b) RETURN count(r)")
+    assert rows == [[1]]  # undirected: the self-loop isn't double-counted
+
+
+def test_bidirectional_counts_both_orientations(db):
+    run(db, "CREATE (:BA)-[:R]->(:BB)")
+    rows = run(db, "MATCH (x)-[r:R]-(y) RETURN count(*)")
+    assert rows == [[2]]  # (a,b) and (b,a)
+
+
+def test_multiple_match_cartesian(db):
+    run(db, "CREATE (:CA), (:CA), (:CB)")
+    rows = run(db, "MATCH (a:CA) MATCH (b:CB) RETURN count(*)")
+    assert rows == [[2]]
+    rows = run(db, "MATCH (a:CA), (b:CA) RETURN count(*)")
+    assert rows == [[4]]  # no uniqueness across comma patterns for nodes
+
+
+def test_var_length_zero_hops(db):
+    run(db, "CREATE (:Z {k: 1})-[:R]->(:Z {k: 2})")
+    rows = run(db, "MATCH (a:Z {k: 1})-[*0..1]->(b) RETURN b.k ORDER BY b.k")
+    assert [r[0] for r in rows] == [1, 2]  # zero hops includes a itself
+
+
+# --- WITH / projection corners ----------------------------------------------
+
+def test_with_shadows_previous_scope(db):
+    rows = run(db, "WITH 1 AS x WITH x + 1 AS x RETURN x")
+    assert rows == [[2]]
+
+
+def test_with_limit_before_more_match(db):
+    run(db, "UNWIND range(1, 10) AS i CREATE (:L {v: i})")
+    rows = run(db, "MATCH (n:L) WITH n ORDER BY n.v DESC LIMIT 3 "
+                   "RETURN collect(n.v)")
+    assert rows == [[[10, 9, 8]]]
+
+
+def test_unwind_empty_list_produces_no_rows(db):
+    rows = run(db, "UNWIND [] AS x RETURN x")
+    assert rows == []
+
+
+def test_unwind_null_produces_no_rows(db):
+    rows = run(db, "UNWIND null AS x RETURN x")
+    assert rows == []
+
+
+# --- string functions --------------------------------------------------------
+
+def test_case_insensitive_keywords_and_functions(db):
+    rows = run(db, "return TOUPPER('ab') as X")
+    assert rows == [["AB"]]
+
+
+def test_temporal_ordering(db):
+    rows = run(db, "UNWIND [date('2024-03-01'), date('2024-01-01')] AS d "
+                   "RETURN d ORDER BY d")
+    assert str(rows[0][0]) == "2024-01-01"
+
+
+def test_deeply_nested_expression(db):
+    rows = run(db, "RETURN size([x IN range(1, 3) | "
+                   "[y IN range(1, x) WHERE y % 2 = 1 | y * x]]) AS s")
+    assert rows == [[3]]
