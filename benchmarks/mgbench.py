@@ -1,0 +1,135 @@
+"""Workload benchmark driver (the reference's tests/mgbench analog).
+
+Measures the host query engine over a live Bolt server with
+Pokec-flavored workloads (/root/reference/tests/mgbench/workloads/pokec.py
+methodology: isolated query groups, latency percentiles + throughput):
+
+  point_read        MATCH (n:User {id: $id}) RETURN n
+  one_hop           MATCH (n:User {id: $id})-[:FRIEND]->(m) RETURN count(m)
+  two_hop           ... -[:FRIEND*2..2]-> ...
+  property_update   SET on a matched vertex
+  aggregate         global count/avg
+  analytical        CALL pagerank.get() (device path)
+
+Usage: python benchmarks/mgbench.py [--nodes 10000] [--edges 50000]
+Prints a JSON report; used manually and by round notes, not by the driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import time
+
+
+def percentiles(samples):
+    s = sorted(samples)
+
+    def pct(p):
+        return s[min(int(p * len(s)), len(s) - 1)] * 1000
+
+    return {"p50_ms": round(pct(0.50), 3), "p90_ms": round(pct(0.90), 3),
+            "p99_ms": round(pct(0.99), 3),
+            "mean_ms": round(statistics.mean(samples) * 1000, 3)}
+
+
+def run_group(client, name, query, param_fn, iterations):
+    samples = []
+    for _ in range(iterations):
+        params = param_fn() if param_fn else None
+        t0 = time.perf_counter()
+        client.execute(query, params)
+        samples.append(time.perf_counter() - t0)
+    total = sum(samples)
+    return {"name": name, "iterations": iterations,
+            "throughput_qps": round(iterations / total, 1),
+            **percentiles(samples)}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=10_000)
+    p.add_argument("--edges", type=int, default=50_000)
+    p.add_argument("--iterations", type=int, default=300)
+    p.add_argument("--port", type=int, default=0,
+                   help="existing server port (0 = spawn in-process)")
+    args = p.parse_args()
+
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from memgraph_tpu.query.interpreter import InterpreterContext
+    from memgraph_tpu.server.bolt import BoltServer
+    from memgraph_tpu.server.client import BoltClient
+    from memgraph_tpu.storage import InMemoryStorage
+
+    if args.port:
+        port = args.port
+    else:
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        server = BoltServer(InterpreterContext(InMemoryStorage()),
+                            "127.0.0.1", port)
+        server.run_in_thread()
+
+    client = BoltClient(port=port)
+    rng = random.Random(7)
+
+    print(f"loading {args.nodes} users / {args.edges} friendships ...",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    client.execute("CREATE INDEX ON :User(id)")
+    batch = 2000
+    for start in range(0, args.nodes, batch):
+        ids = list(range(start, min(start + batch, args.nodes)))
+        client.execute(
+            "UNWIND $ids AS i CREATE (:User {id: i, age: i % 80})",
+            {"ids": ids})
+    for start in range(0, args.edges, batch):
+        pairs = [[rng.randrange(args.nodes), rng.randrange(args.nodes)]
+                 for _ in range(min(batch, args.edges - start))]
+        client.execute(
+            "UNWIND $pairs AS p "
+            "MATCH (a:User {id: p[0]}), (b:User {id: p[1]}) "
+            "CREATE (a)-[:FRIEND]->(b)", {"pairs": pairs})
+    load_s = time.perf_counter() - t0
+    print(f"  loaded in {load_s:.1f}s "
+          f"({(args.nodes + args.edges) / load_s:,.0f} records/s)",
+          file=sys.stderr)
+
+    rand_id = lambda: {"id": rng.randrange(args.nodes)}
+    groups = [
+        run_group(client, "point_read",
+                  "MATCH (n:User {id: $id}) RETURN n.age", rand_id,
+                  args.iterations),
+        run_group(client, "one_hop",
+                  "MATCH (n:User {id: $id})-[:FRIEND]->(m) RETURN count(m)",
+                  rand_id, args.iterations),
+        run_group(client, "two_hop",
+                  "MATCH (n:User {id: $id})-[:FRIEND*2..2]->(m) "
+                  "RETURN count(m)", rand_id, max(args.iterations // 3, 10)),
+        run_group(client, "property_update",
+                  "MATCH (n:User {id: $id}) SET n.age = n.age + 1", rand_id,
+                  args.iterations),
+        run_group(client, "aggregate",
+                  "MATCH (n:User) RETURN count(n), avg(n.age)", None,
+                  max(args.iterations // 10, 5)),
+        run_group(client, "analytical_pagerank",
+                  "CALL pagerank.get() YIELD rank RETURN max(rank)", None, 3),
+    ]
+    client.close()
+    report = {"workload": "pokec-flavored", "nodes": args.nodes,
+              "edges": args.edges, "load_records_per_sec":
+              round((args.nodes + args.edges) / load_s, 1),
+              "groups": groups}
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
