@@ -4,7 +4,7 @@ Measures the host query engine over a live Bolt server with
 Pokec-flavored workloads (/root/reference/tests/mgbench/workloads/pokec.py
 methodology: isolated query groups, latency percentiles + throughput):
 
-  point_read        MATCH (n:User {id: $id}) RETURN n
+  point_read        MATCH (n:User {id: $id}) RETURN n.age
   one_hop           MATCH (n:User {id: $id})-[:FRIEND]->(m) RETURN count(m)
   two_hop           ... -[:FRIEND*2..2]-> ...
   property_update   SET on a matched vertex
@@ -36,13 +36,20 @@ def percentiles(samples):
             "mean_ms": round(statistics.mean(samples) * 1000, 3)}
 
 
-def run_group(client, name, query, param_fn, iterations):
-    samples = []
-    for _ in range(iterations):
-        params = param_fn() if param_fn else None
-        t0 = time.perf_counter()
-        client.execute(query, params)
-        samples.append(time.perf_counter() - t0)
+def run_group(client, name, query, param_fn, iterations, warmup=0):
+    """Fault-isolated: an error (e.g. unreachable device) yields an error
+    entry instead of discarding the whole report."""
+    try:
+        for _ in range(warmup):  # discarded (JIT compilation etc.)
+            client.execute(query, param_fn() if param_fn else None)
+        samples = []
+        for _ in range(iterations):
+            params = param_fn() if param_fn else None
+            t0 = time.perf_counter()
+            client.execute(query, params)
+            samples.append(time.perf_counter() - t0)
+    except Exception as e:
+        return {"name": name, "error": f"{type(e).__name__}: {e}"}
     total = sum(samples)
     return {"name": name, "iterations": iterations,
             "throughput_qps": round(iterations / total, 1),
@@ -120,10 +127,16 @@ def main():
         run_group(client, "aggregate",
                   "MATCH (n:User) RETURN count(n), avg(n.age)", None,
                   max(args.iterations // 10, 5)),
-        run_group(client, "analytical_pagerank",
-                  "CALL pagerank.get() YIELD rank RETURN max(rank)", None, 3),
     ]
     client.close()
+    # the analytical group gets its own client with a wide timeout (first
+    # CALL pays XLA compilation) and one discarded warm-up run
+    analytical = BoltClient(port=port, timeout=600.0)
+    groups.append(run_group(
+        analytical, "analytical_pagerank",
+        "CALL pagerank.get() YIELD rank RETURN max(rank)", None, 3,
+        warmup=1))
+    analytical.close()
     report = {"workload": "pokec-flavored", "nodes": args.nodes,
               "edges": args.edges, "load_records_per_sec":
               round((args.nodes + args.edges) / load_s, 1),
