@@ -23,3 +23,4 @@ def load_builtin_modules() -> None:
     from . import text_search_module  # noqa: F401
     from . import structure_modules   # noqa: F401
     from . import data_modules        # noqa: F401
+    from . import graphrag            # noqa: F401
