@@ -1,0 +1,109 @@
+"""Export / import modules: JSON, cypherl.
+
+Counterparts of /root/reference/mage/python/export_util.py and
+import_util.py: whole-graph export to JSON/cypherl files and JSON import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import mgp
+from ..exceptions import ProcedureException
+
+
+def _value_to_json(v, storage, view):
+    from ..storage.storage import EdgeAccessor, VertexAccessor
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        raise ProcedureException("nested graph values are not exportable")
+    if isinstance(v, (list, tuple)):
+        return [_value_to_json(x, storage, view) for x in v]
+    if isinstance(v, dict):
+        return {k: _value_to_json(x, storage, view) for k, x in v.items()}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)  # temporal/point → ISO-ish strings
+
+
+@mgp.read_proc("export_util.json", args=[("path", "STRING")],
+               results=[("path", "STRING"), ("nodes", "INTEGER"),
+                        ("relationships", "INTEGER")])
+def export_json(ctx, path):
+    storage = ctx.storage
+    lm, pm, tm = (storage.label_mapper, storage.property_mapper,
+                  storage.edge_type_mapper)
+    out = []
+    n_nodes = n_rels = 0
+    for va in ctx.accessor.vertices(ctx.view):
+        out.append({
+            "type": "node", "id": va.gid,
+            "labels": [lm.id_to_name(l) for l in va.labels(ctx.view)],
+            "properties": {pm.id_to_name(k):
+                           _value_to_json(v, storage, ctx.view)
+                           for k, v in va.properties(ctx.view).items()}})
+        n_nodes += 1
+    for ea in ctx.accessor.edges(ctx.view):
+        out.append({
+            "type": "relationship", "id": ea.gid,
+            "label": tm.id_to_name(ea.edge_type),
+            "start": ea.from_vertex().gid, "end": ea.to_vertex().gid,
+            "properties": {pm.id_to_name(k):
+                           _value_to_json(v, storage, ctx.view)
+                           for k, v in ea.properties(ctx.view).items()}})
+        n_rels += 1
+    os.makedirs(os.path.dirname(os.path.abspath(str(path))), exist_ok=True)
+    with open(str(path), "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+    yield {"path": str(path), "nodes": n_nodes, "relationships": n_rels}
+
+
+@mgp.read_proc("export_util.cypherl", args=[("path", "STRING")],
+               results=[("path", "STRING"), ("statements", "INTEGER")])
+def export_cypherl(ctx, path):
+    from ..query.dump import dump_database
+    count = 0
+    os.makedirs(os.path.dirname(os.path.abspath(str(path))), exist_ok=True)
+    with open(str(path), "w", encoding="utf-8") as f:
+        for line in dump_database(ctx.accessor):
+            f.write(line + "\n")
+            count += 1
+    yield {"path": str(path), "statements": count}
+
+
+@mgp.write_proc("import_util.json", args=[("path", "STRING")],
+                results=[("nodes", "INTEGER"), ("relationships", "INTEGER")])
+def import_json(ctx, path):
+    storage = ctx.storage
+    try:
+        with open(str(path), encoding="utf-8") as f:
+            records = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ProcedureException(f"cannot read {path}: {e}") from e
+    id_map: dict[int, object] = {}
+    n_nodes = n_rels = 0
+    for rec in records:
+        if rec.get("type") == "node":
+            va = ctx.accessor.create_vertex()
+            for label in rec.get("labels", []):
+                va.add_label(storage.label_mapper.name_to_id(label))
+            for key, value in rec.get("properties", {}).items():
+                va.set_property(storage.property_mapper.name_to_id(key),
+                                value)
+            id_map[rec["id"]] = va
+            n_nodes += 1
+    for rec in records:
+        if rec.get("type") == "relationship":
+            src = id_map.get(rec.get("start"))
+            dst = id_map.get(rec.get("end"))
+            if src is None or dst is None:
+                raise ProcedureException(
+                    f"relationship {rec.get('id')} references an unknown "
+                    f"node id")
+            tid = storage.edge_type_mapper.name_to_id(rec["label"])
+            ea = ctx.accessor.create_edge(src, dst, tid)
+            for key, value in rec.get("properties", {}).items():
+                ea.set_property(storage.property_mapper.name_to_id(key),
+                                value)
+            n_rels += 1
+    yield {"nodes": n_nodes, "relationships": n_rels}

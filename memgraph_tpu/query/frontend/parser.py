@@ -248,6 +248,10 @@ class Parser:
             self.advance()
             self.expect_kw("STREAM")
             return A.StreamQuery("check", name=self.name_token())
+        if self.at_kw("FREE"):
+            self.advance()
+            self.expect_kw("MEMORY")
+            return A.InfoQuery("free_memory")
         if self.at_kw("SESSION") and self.peek().type == T.IDENT and \
                 self.peek().value.upper() == "TRACE":
             self.advance()

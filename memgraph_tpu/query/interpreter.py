@@ -644,8 +644,17 @@ class Interpreter:
             return self._prepare_generator(iter(rows),
                                            ["kind", "name", "count"], "r")
         if node.kind == "database":
-            rows = [["memgraph"]]
-            return self._prepare_generator(iter(rows), ["Name"], "r")
+            name = getattr(self.ctx, "database_name", "memgraph")
+            return self._prepare_generator(iter([[name]]), ["Name"], "r")
+        if node.kind == "free_memory":
+            import gc
+            stats = storage.collect_garbage()
+            gc.collect()
+            from ..ops.csr import GLOBAL_GRAPH_CACHE
+            GLOBAL_GRAPH_CACHE.clear()
+            rows = [[k, v] for k, v in sorted(stats.items())]
+            return self._prepare_generator(iter(rows),
+                                           ["freed", "count"], "s")
         raise SemanticException(f"unknown info query {node.kind}")
 
     def _schema_info_rows(self):

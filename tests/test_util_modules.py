@@ -88,3 +88,28 @@ def test_tocharlist_propertysize(db):
     rows = run(db, "MATCH (n:PS) RETURN toCharList(n.s), "
                    "propertySize(n, 's') > 0")
     assert rows == [[["h", "e", "l", "l", "o"], True]]
+
+
+def test_export_import_json(db, tmp_path):
+    run(db, "CREATE (a:X {name:'a', tags:[1,2]})-[:R {w: 1.5}]->(b:Y)")
+    path = str(tmp_path / "graph.json")
+    rows = run(db, f"CALL export_util.json('{path}') "
+                   f"YIELD nodes, relationships RETURN nodes, relationships")
+    assert rows == [[2, 1]]
+    fresh = InterpreterContext(InMemoryStorage())
+    rows = run(fresh, f"CALL import_util.json('{path}') "
+                      f"YIELD nodes, relationships "
+                      f"RETURN nodes, relationships")
+    assert rows == [[2, 1]]
+    rows = run(fresh, "MATCH (a:X)-[r:R]->(b:Y) RETURN a.name, a.tags, r.w")
+    assert rows == [["a", [1, 2], 1.5]]
+
+
+def test_export_cypherl(db, tmp_path):
+    run(db, "CREATE (:C {v: 1})")
+    path = str(tmp_path / "dump.cypherl")
+    rows = run(db, f"CALL export_util.cypherl('{path}') "
+                   f"YIELD statements RETURN statements > 0")
+    assert rows == [[True]]
+    content = open(path).read()
+    assert "CREATE" in content
