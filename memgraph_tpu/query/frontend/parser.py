@@ -928,7 +928,7 @@ class Parser:
                 var_length = True
                 from .lexer import T as TT
                 if self.at(TT.IDENT) and self.cur.value.upper() in (
-                        "BFS", "WSHORTEST", "ALLSHORTEST"):
+                        "BFS", "WSHORTEST", "ALLSHORTEST", "KSHORTEST"):
                     algo = self.advance().value.lower()
                 if self.at(TT.INT):
                     min_hops = A.Literal(self.advance().value)
@@ -945,7 +945,8 @@ class Parser:
                     self.error("invalid variable-length bounds")
                 # lambdas: weight first for WSHORTEST/ALLSHORTEST, then an
                 # optional filter lambda (reference: MemgraphCypher grammar)
-                if algo in ("wshortest", "allshortest") and self.at("("):
+                if algo in ("wshortest", "allshortest", "kshortest") \
+                        and self.at("("):
                     weight_lambda = self._parse_lambda()
                     if self.at(T.IDENT) and self.peek().type in ("]", "("):
                         total_weight = self.advance().value

@@ -446,7 +446,8 @@ class ExpandShortest(LogicalOperator):
         return edges
 
     def _dijkstra(self, ctx, frame, source, target_gid, max_hops, type_ids,
-                  all_shortest):
+                  all_shortest, banned_edges=frozenset(),
+                  banned_nodes=frozenset()):
         import heapq
         import itertools as it
         dist = {source.gid: 0.0}
@@ -467,6 +468,8 @@ class ExpandShortest(LogicalOperator):
             if hops[va.gid] >= max_hops:
                 continue
             for ea, other in self._neighbors(ctx, va, type_ids):
+                if ea.gid in banned_edges or other.gid in banned_nodes:
+                    continue
                 if not self._passes_filter(ctx, frame, ea, other):
                     continue
                 w = self._edge_weight(ctx, frame, ea, other)
@@ -499,6 +502,110 @@ class ExpandShortest(LogicalOperator):
                     yield (node_of[gid], path, dist[gid])
             else:
                 yield (node_of[gid], all_paths(gid).__next__(), dist[gid])
+
+
+@dataclass
+class ExpandKShortest(LogicalOperator):
+    """*KSHORTEST: Yen's algorithm over the Dijkstra base (reference:
+    the KSHORTEST mode of ExpandVariable). Requires a bound target."""
+    input: LogicalOperator
+    from_symbol: str
+    edge_symbol: str
+    to_symbol: str
+    direction: str
+    edge_types: list[str]
+    k: int
+    weight_lambda: object = None
+    filter_lambda: object = None
+    total_weight_symbol: Optional[str] = None
+
+    def cursor(self, ctx):
+        type_ids = Expand._type_ids(self, ctx)
+        helper = ExpandShortest(
+            self.input, self.from_symbol, self.edge_symbol, self.to_symbol,
+            self.direction, self.edge_types, "wshortest", -1,
+            self.weight_lambda, self.filter_lambda, None)
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            source = frame.get(self.from_symbol)
+            target = frame.get(self.to_symbol)
+            if not isinstance(source, VertexAccessor) or \
+                    not isinstance(target, VertexAccessor):
+                continue
+            for (edges, weight) in self._yen(ctx, frame, helper, source,
+                                             target, type_ids):
+                new = dict(frame)
+                new[self.edge_symbol] = edges
+                if self.total_weight_symbol:
+                    new[self.total_weight_symbol] = weight
+                yield new
+
+    def _shortest(self, ctx, frame, helper, source, target, banned_edges,
+                  banned_nodes, type_ids):
+        """One Dijkstra run honoring Yen's removals."""
+        results = list(helper._dijkstra(
+            ctx, frame, source, target.gid, 1 << 30, type_ids,
+            all_shortest=False, banned_edges=frozenset(banned_edges),
+            banned_nodes=frozenset(banned_nodes)))
+        return results[0] if results else None
+
+    def _yen(self, ctx, frame, helper, source, target, type_ids):
+        first = self._shortest(ctx, frame, helper, source, target,
+                               set(), set(), type_ids)
+        if first is None:
+            return
+        paths = [(first[1], first[2])]   # (edges, weight)
+        yield paths[0]
+        candidates: list = []
+        import heapq
+        while len(paths) < self.k:
+            prev_edges, _ = paths[-1]
+            prev_nodes = self._node_seq(source, prev_edges)
+            for i in range(len(prev_edges)):
+                spur_node = prev_nodes[i]
+                root_edges = prev_edges[:i]
+                root_weight = sum(
+                    helper._edge_weight(ctx, frame, e,
+                                        self._other(e, prev_nodes[j]))
+                    for j, e in enumerate(root_edges))
+                banned_edges = set()
+                for (p_edges, _w) in paths:
+                    if [e.gid for e in p_edges[:i]] == \
+                            [e.gid for e in root_edges] and len(p_edges) > i:
+                        banned_edges.add(p_edges[i].gid)
+                banned_nodes = {n.gid for n in prev_nodes[:i]}
+                spur = self._shortest(ctx, frame, helper, spur_node, target,
+                                      banned_edges, banned_nodes, type_ids)
+                if spur is None:
+                    continue
+                total = root_edges + spur[1]
+                weight = root_weight + spur[2]
+                key = tuple(e.gid for e in total)
+                if not any(tuple(e.gid for e in c[2]) == key
+                           for c in candidates) and \
+                        not any(tuple(e.gid for e in p[0]) == key
+                                for p in paths):
+                    heapq.heappush(candidates,
+                                   (weight, id(total), total))
+            if not candidates:
+                return
+            weight, _, best = heapq.heappop(candidates)
+            paths.append((best, weight))
+            yield paths[-1]
+
+    def _node_seq(self, source, edges):
+        nodes = [source]
+        for e in edges:
+            cur = nodes[-1]
+            nxt = e.to_vertex() if e.from_vertex().gid == cur.gid \
+                else e.from_vertex()
+            nodes.append(nxt)
+        return nodes
+
+    @staticmethod
+    def _other(edge, from_node):
+        return edge.to_vertex() if edge.from_vertex().gid == from_node.gid \
+            else edge.from_vertex()
 
 
 @dataclass

@@ -457,7 +457,18 @@ class Planner:
         to_sym = to_node.variable
         edge_sym = edge.variable
 
-        if edge.algo:
+        if edge.algo == "kshortest":
+            if to_sym not in bound:
+                # Yen's needs a bound target: scan it first
+                plan = self._plan_node_scan(to_node, plan, bound, pending)
+            k = edge.max_hops.value if edge.max_hops else 1
+            plan = Op.ExpandKShortest(plan, from_sym, edge_sym, to_sym,
+                                      direction, edge.types, k,
+                                      edge.weight_lambda,
+                                      edge.filter_lambda, edge.total_weight)
+            if edge.total_weight:
+                bound.add(edge.total_weight)
+        elif edge.algo:
             max_h = edge.max_hops.value if edge.max_hops else -1
             plan = Op.ExpandShortest(plan, from_sym, edge_sym, to_sym,
                                      direction, edge.types, edge.algo,

@@ -89,3 +89,20 @@ def test_negative_weight_rejected(db):
         run(db, "MATCH (a:City {name:'a'})"
                 "-[e *WSHORTEST (r, n | r.d) w]->(d:City {name:'d'}) "
                 "RETURN w")
+
+
+def test_kshortest(db):
+    rows = run(db, "MATCH (a:City {name:'a'})"
+                   "-[e *KSHORTEST 3 (r, n | r.d) w]->(d:City {name:'d'}) "
+                   "RETURN size(e), w ORDER BY w")
+    # path costs: 2.0 (via b), 2.0 (via c), 5.0 (direct)
+    assert len(rows) == 3
+    assert [r[1] for r in rows] == [2.0, 2.0, 5.0]
+    assert [r[0] for r in rows] == [2, 2, 1]
+
+
+def test_kshortest_fewer_paths_than_k(db):
+    rows = run(db, "MATCH (a:City {name:'b'})"
+                   "-[e *KSHORTEST 10 (r, n | r.d) w]->(d:City {name:'d'}) "
+                   "RETURN w")
+    assert len(rows) == 1  # only one route b->d
