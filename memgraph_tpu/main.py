@@ -46,6 +46,10 @@ def build_config(argv=None) -> argparse.Namespace:
                    help="Prometheus metrics HTTP port (0 = disabled)")
     p.add_argument("--audit-enabled",
                    action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--storage-snapshot-interval-sec", type=int, default=0,
+                   help="periodic snapshot interval (0 = disabled)")
+    p.add_argument("--storage-gc-cycle-sec", type=int, default=30,
+                   help="periodic delta-GC interval (0 = disabled)")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--init-file", default=None,
                    help="cypherl file executed on startup")
@@ -94,6 +98,32 @@ def build_database(args) -> InterpreterContext:
             os.path.join(args.data_directory, "audit", "audit.log"),
             install_sigusr2=True)
         logging.info("audit log enabled")
+
+    # background maintenance (reference: periodic snapshots memgraph.cpp:588,
+    # GC cycle flags)
+    import threading
+
+    def _periodic(interval, fn, name):
+        def loop():
+            import time as _t
+            while True:
+                _t.sleep(interval)
+                try:
+                    fn()
+                except Exception:
+                    logging.exception("%s failed", name)
+        t = threading.Thread(target=loop, daemon=True, name=name)
+        t.start()
+
+    if args.storage_snapshot_interval_sec and args.data_directory:
+        from .storage.durability.snapshot import create_snapshot
+        _periodic(args.storage_snapshot_interval_sec,
+                  lambda: create_snapshot(storage), "periodic-snapshot")
+        logging.info("periodic snapshots every %ds",
+                     args.storage_snapshot_interval_sec)
+    if args.storage_gc_cycle_sec:
+        _periodic(args.storage_gc_cycle_sec, storage.collect_garbage,
+                  "periodic-gc")
 
     # trigger store wiring (registers its commit hook)
     from .query.triggers import global_trigger_store

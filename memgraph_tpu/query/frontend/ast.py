@@ -192,10 +192,19 @@ class Clause:
 
 
 @dataclass
+class IndexHint:
+    variable: str
+    label: str
+    properties: list[str]
+
+
+@dataclass
 class Match(Clause):
     patterns: list[Pattern]
     where: Optional[Expr] = None
     optional: bool = False
+    index_hints: list = field(default_factory=list)
+    hops_limit: Optional[int] = None
 
 
 @dataclass

@@ -697,10 +697,30 @@ class Parser:
         if not consumed:
             self.expect_kw("MATCH")
         patterns = self.parse_pattern_list()
+        index_hints = []
+        hops_limit = None
+        while self.at_kw("USING"):
+            self.advance()
+            if self.accept_kw("INDEX"):
+                var = self.name_token()
+                self.expect(":")
+                label = self.name_token()
+                props = []
+                if self.accept("("):
+                    props.append(self.name_token())
+                    while self.accept(","):
+                        props.append(self.name_token())
+                    self.expect(")")
+                index_hints.append(A.IndexHint(var, label, props))
+            elif self.accept_kw("HOPS"):
+                self.expect_kw("LIMIT")
+                hops_limit = self.expect(T.INT).value
+            else:
+                self.error("expected INDEX or HOPS LIMIT after USING")
         where = None
         if self.accept_kw("WHERE"):
             where = self.parse_expression()
-        return A.Match(patterns, where, optional)
+        return A.Match(patterns, where, optional, index_hints, hops_limit)
 
     def parse_merge(self) -> A.Merge:
         self.expect_kw("MERGE")

@@ -41,10 +41,18 @@ class ExecutionContext:
                       "relationships_created": 0, "relationships_deleted": 0,
                       "properties_set": 0, "labels_added": 0,
                       "labels_removed": 0}
+        self.hops_budget = None  # USING HOPS LIMIT (query/hops_limit.hpp)
 
     def check_abort(self):
         if self.timeout_checker is not None:
             self.timeout_checker()
+
+    def consume_hop(self):
+        if self.hops_budget is not None:
+            self.hops_budget -= 1
+            if self.hops_budget < 0:
+                raise QueryException(
+                    "hops limit exceeded (USING HOPS LIMIT)")
 
     @property
     def storage(self):
@@ -224,6 +232,7 @@ class Expand(LogicalOperator):
             used = {frame[s].gid for s in self.prev_edge_symbols
                     if isinstance(frame.get(s), EdgeAccessor)}
             for ea, other in self._edges(ctx, from_v, type_ids):
+                ctx.consume_hop()
                 if ea.gid in used:
                     continue
                 if to_bound:
@@ -298,6 +307,7 @@ class ExpandVariable(LogicalOperator):
                 if depth >= max_hops:
                     return
                 for ea, other in Expand._edges(self, ctx, node, type_ids):
+                    ctx.consume_hop()
                     if ea.gid in used_gids:
                         continue
                     yield from dfs(other, path_edges + [ea],
@@ -919,6 +929,16 @@ class Delete(LogicalOperator):
         else:
             raise TypeException(
                 f"DELETE on {V.type_name(value)} is not supported")
+
+
+@dataclass
+class SetHopsLimit(LogicalOperator):
+    input: LogicalOperator
+    limit: int
+
+    def cursor(self, ctx):
+        ctx.hops_budget = self.limit
+        yield from self.input.cursor(ctx)
 
 
 class Argument(LogicalOperator):

@@ -196,6 +196,10 @@ class Planner:
 
     def plan_match(self, match: A.Match, plan, bound: set):
         where_parts = _split_and(match.where)
+        self._index_hints = {h.variable: h for h in
+                             getattr(match, "index_hints", [])}
+        if getattr(match, "hops_limit", None):
+            plan = Op.SetHopsLimit(plan, match.hops_limit)
         if match.optional:
             sub_bound = set(bound)
             subplan = self.plan_pattern_chain(
@@ -328,6 +332,7 @@ class Planner:
         scan = None
         used_label = None
         used_props: set = set()
+        hint = getattr(self, "_index_hints", {}).get(sym)
 
         eq_map = {}  # prop name -> value expr
         if isinstance(node.properties, dict):
@@ -350,14 +355,22 @@ class Planner:
                             range_preds.setdefault(lhs.prop, []).append(
                                 (op, rhs, pred))
 
-        for label in node.labels:
+        label_order = list(node.labels)
+        if hint is not None and hint.label in label_order:
+            label_order.remove(hint.label)
+            label_order.insert(0, hint.label)
+        for label in label_order:
             lid = mapper.maybe_name_to_id(label)
             if lid is None:
                 continue
-            # equality composite index
-            for (ilabel, iprops) in sorted(
-                    indices.label_property.relevant_to(lid),
-                    key=lambda k: -len(k[1])):
+            # equality composite index (hinted key tried first)
+            keys = sorted(indices.label_property.relevant_to(lid),
+                          key=lambda k: -len(k[1]))
+            if hint is not None and hint.label == label and hint.properties:
+                hint_pids = tuple(pmapper.maybe_name_to_id(pr)
+                                  for pr in hint.properties)
+                keys.sort(key=lambda k: 0 if k[1] == hint_pids else 1)
+            for (ilabel, iprops) in keys:
                 names = [pmapper.id_to_name(p) for p in iprops]
                 if all(n in eq_map or n in where_eq for n in names):
                     exprs = []

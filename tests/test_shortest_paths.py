@@ -106,3 +106,20 @@ def test_kshortest_fewer_paths_than_k(db):
                    "-[e *KSHORTEST 10 (r, n | r.d) w]->(d:City {name:'d'}) "
                    "RETURN w")
     assert len(rows) == 1  # only one route b->d
+
+
+def test_using_index_hint(db):
+    run(db, "CREATE INDEX ON :City(name)")
+    rows = run(db, "EXPLAIN MATCH (n:City) USING INDEX n:City(name) "
+                   "WHERE n.name = 'a' RETURN n")
+    text = "\n".join(r[0] for r in rows)
+    assert "ScanAllByLabelPropertyValue" in text
+
+
+def test_hops_limit(db):
+    from memgraph_tpu.exceptions import QueryException
+    # full traversal exceeds 2 hops-worth of edge visits
+    with pytest.raises(QueryException):
+        run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 2 RETURN count(*)")
+    rows = run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 100 RETURN count(*)")
+    assert rows == [[5]]
