@@ -1,0 +1,253 @@
+"""Loader for native (C ABI) query modules.
+
+Counterpart of the reference's dlopen module loading
+(/root/reference/src/query/procedure/module.cpp:861): shared libraries
+implementing `mgtpu_init_module` (native/mg_procedure.h) are loaded via
+ctypes, handed a vtable of host callbacks, and register procedures that
+compute over the zero-copy CSR snapshot view.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from typing import Optional
+
+import numpy as np
+
+from .registry import Procedure, global_registry
+
+log = logging.getLogger(__name__)
+
+
+class _CsrView(ctypes.Structure):
+    _fields_ = [
+        ("n_nodes", ctypes.c_int64),
+        ("n_edges", ctypes.c_int64),
+        ("n_pad", ctypes.c_int64),
+        ("e_pad", ctypes.c_int64),
+        ("row_ptr", ctypes.POINTER(ctypes.c_int32)),
+        ("col_idx", ctypes.POINTER(ctypes.c_int32)),
+        ("csr_src", ctypes.POINTER(ctypes.c_int32)),
+        ("weights", ctypes.POINTER(ctypes.c_float)),
+        ("csc_src", ctypes.POINTER(ctypes.c_int32)),
+        ("csc_dst", ctypes.POINTER(ctypes.c_int32)),
+        ("node_gids", ctypes.POINTER(ctypes.c_int64)),
+    ]
+
+
+PROC_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(_CsrView),
+                           ctypes.c_void_p, ctypes.c_void_p)
+
+_REGISTER = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                             ctypes.c_char_p, PROC_CB, ctypes.c_char_p)
+_NEW_RECORD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+_SET_INT = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                            ctypes.c_char_p, ctypes.c_int64)
+_SET_DOUBLE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_char_p, ctypes.c_double)
+_SET_STRING = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_char_p, ctypes.c_char_p)
+_SET_NODE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                             ctypes.c_char_p, ctypes.c_int64)
+_SET_ERROR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                              ctypes.c_char_p)
+
+
+class _HostApi(ctypes.Structure):
+    _fields_ = [
+        ("register_procedure", _REGISTER),
+        ("result_new_record", _NEW_RECORD),
+        ("result_set_int", _SET_INT),
+        ("result_set_double", _SET_DOUBLE),
+        ("result_set_string", _SET_STRING),
+        ("result_set_node", _SET_NODE),
+        ("result_set_error", _SET_ERROR),
+    ]
+
+
+class _ResultCollector:
+    """Backs the opaque mgtpu_result handle during one procedure call."""
+
+    def __init__(self) -> None:
+        self.rows: list[dict] = []
+        self.error: Optional[str] = None
+
+    def new_record(self) -> None:
+        self.rows.append({})
+
+    def set(self, field: str, value) -> None:
+        if not self.rows:
+            self.rows.append({})
+        self.rows[-1][field] = value
+
+
+# live result collectors keyed by handle id (the void* we pass to C)
+_ACTIVE_RESULTS: dict[int, _ResultCollector] = {}
+_NEXT_HANDLE = [1]
+
+# keep callback objects and loaded libs alive for the process lifetime
+_KEEPALIVE: list = []
+
+
+def _collector(handle) -> Optional[_ResultCollector]:
+    return _ACTIVE_RESULTS.get(int(handle or 0))
+
+
+def _make_host_api() -> _HostApi:
+    def new_record(handle):
+        c = _collector(handle)
+        if c is None:
+            return 1
+        c.new_record()
+        return 0
+
+    def set_int(handle, field, value):
+        c = _collector(handle)
+        if c is None:
+            return 1
+        c.set(field.decode(), int(value))
+        return 0
+
+    def set_double(handle, field, value):
+        c = _collector(handle)
+        if c is None:
+            return 1
+        c.set(field.decode(), float(value))
+        return 0
+
+    def set_string(handle, field, value):
+        c = _collector(handle)
+        if c is None:
+            return 1
+        c.set(field.decode(), value.decode() if value else "")
+        return 0
+
+    def set_node(handle, field, idx):
+        c = _collector(handle)
+        if c is None:
+            return 1
+        c.set(field.decode(), ("__node_index__", int(idx)))
+        return 0
+
+    def set_error(handle, message):
+        c = _collector(handle)
+        if c is None:
+            return 1
+        c.error = message.decode() if message else "native module error"
+        return 0
+
+    def register(registry_handle, name, cb, results_sig):
+        try:
+            name_s = name.decode()
+            results = []
+            for part in (results_sig.decode() if results_sig else "").split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                fname, _, ftype = part.partition(":")
+                results.append((fname.strip(), ftype.strip() or "ANY"))
+            _KEEPALIVE.append(cb)
+            global_registry.register(Procedure(
+                name=name_s, func=_make_proc_func(cb, results),
+                args=[], opt_args=[], results=results, is_write=False))
+            return 0
+        except Exception:
+            log.exception("native procedure registration failed")
+            return 1
+
+    api = _HostApi(
+        register_procedure=_REGISTER(register),
+        result_new_record=_NEW_RECORD(new_record),
+        result_set_int=_SET_INT(set_int),
+        result_set_double=_SET_DOUBLE(set_double),
+        result_set_string=_SET_STRING(set_string),
+        result_set_node=_SET_NODE(set_node),
+        result_set_error=_SET_ERROR(set_error),
+    )
+    _KEEPALIVE.append(api)
+    return api
+
+
+def _p32(a):
+    return np.ascontiguousarray(a, dtype=np.int32).ctypes.data_as(
+        ctypes.POINTER(ctypes.c_int32))
+
+
+def _make_proc_func(cb, results):
+    node_fields = {f for f, t in results if t.upper() == "NODE"}
+
+    def proc(pctx, *args):
+        from ...exceptions import ProcedureException
+        graph = pctx.device_graph()
+        # host-resident contiguous copies (zero-copy for the C side)
+        row_ptr = np.ascontiguousarray(np.asarray(graph.row_ptr),
+                                       dtype=np.int32)
+        col_idx = np.ascontiguousarray(np.asarray(graph.col_idx),
+                                       dtype=np.int32)
+        csr_src = np.ascontiguousarray(np.asarray(graph.src_idx),
+                                       dtype=np.int32)
+        weights = np.ascontiguousarray(np.asarray(graph.weights),
+                                       dtype=np.float32)
+        csc_src = np.ascontiguousarray(np.asarray(graph.csc_src),
+                                       dtype=np.int32)
+        csc_dst = np.ascontiguousarray(np.asarray(graph.csc_dst),
+                                       dtype=np.int32)
+        node_gids = np.ascontiguousarray(graph.node_gids, dtype=np.int64)
+        view = _CsrView(
+            n_nodes=graph.n_nodes, n_edges=graph.n_edges,
+            n_pad=graph.n_pad, e_pad=graph.e_pad,
+            row_ptr=row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            col_idx=col_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            csr_src=csr_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            weights=weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            csc_src=csc_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            csc_dst=csc_dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            node_gids=node_gids.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)),
+        )
+        collector = _ResultCollector()
+        handle = _NEXT_HANDLE[0]
+        _NEXT_HANDLE[0] += 1
+        _ACTIVE_RESULTS[handle] = collector
+        try:
+            rc = cb(ctypes.byref(view), ctypes.c_void_p(handle), None)
+        finally:
+            _ACTIVE_RESULTS.pop(handle, None)
+        if rc != 0 or collector.error:
+            raise ProcedureException(
+                collector.error or f"native procedure failed (rc={rc})")
+        for row in collector.rows:
+            out = {}
+            for key, value in row.items():
+                if (key in node_fields and isinstance(value, tuple)
+                        and value and value[0] == "__node_index__"):
+                    out[key] = pctx.vertex_by_index(graph, value[1])
+                else:
+                    out[key] = value
+            yield out
+
+    return proc
+
+
+def load_native_module(path: str) -> bool:
+    """dlopen a native module and run its registration. Returns success."""
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        log.error("cannot load native module %s: %s", path, e)
+        return False
+    try:
+        init = lib.mgtpu_init_module
+    except AttributeError:
+        log.error("%s does not export mgtpu_init_module", path)
+        return False
+    init.restype = ctypes.c_int
+    init.argtypes = [ctypes.POINTER(_HostApi), ctypes.c_void_p]
+    api = _make_host_api()
+    rc = init(ctypes.byref(api), None)
+    if rc != 0:
+        log.error("native module %s init returned %d", path, rc)
+        return False
+    _KEEPALIVE.append(lib)
+    return True

@@ -100,22 +100,26 @@ class ProcedureRegistry:
         load_builtin_modules()
 
     def load_directory(self, path: str) -> list[str]:
-        """Load user query modules (*.py) from a directory (the dlopen/.py
-        analog of the reference's module dir scan, module.cpp:811)."""
+        """Load user query modules (*.py and native *.so) from a directory
+        (the reference's module dir scan, module.cpp:811)."""
         import importlib.util
         import os
         loaded = []
         if not os.path.isdir(path):
             return loaded
         for fname in sorted(os.listdir(path)):
-            if not fname.endswith(".py") or fname.startswith("_"):
-                continue
-            mod_name = fname[:-3]
-            spec = importlib.util.spec_from_file_location(
-                f"mg_user_module_{mod_name}", os.path.join(path, fname))
-            module = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(module)
-            loaded.append(mod_name)
+            full = os.path.join(path, fname)
+            if fname.endswith(".py") and not fname.startswith("_"):
+                mod_name = fname[:-3]
+                spec = importlib.util.spec_from_file_location(
+                    f"mg_user_module_{mod_name}", full)
+                module = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(module)
+                loaded.append(mod_name)
+            elif fname.endswith(".so"):
+                from .native_loader import load_native_module
+                if load_native_module(full):
+                    loaded.append(fname)
         return loaded
 
 

@@ -1,0 +1,77 @@
+/* Example native query module against the mgtpu C ABI.
+ *
+ * Registers:
+ *   c_degree.get()      -> (node NODE, out_degree INT, in_degree INT)
+ *   c_triangles.count() -> (triangles INT)  — naive per-edge intersection
+ *
+ * Build: gcc -O2 -shared -fPIC -o libexample_module.so example_module.c
+ */
+
+#include "mg_procedure.h"
+
+#include <stdlib.h>
+
+static const mgtpu_host_api *g_api;
+
+static int degree_cb(const mgtpu_csr_view *view, mgtpu_result *result,
+                     void *host_ctx) {
+  (void)host_ctx;
+  int64_t n = view->n_nodes;
+  int64_t *in_deg = calloc((size_t)n, sizeof(int64_t));
+  if (!in_deg) return g_api->result_set_error(result, "out of memory"), 1;
+  for (int64_t e = 0; e < view->n_edges; ++e) {
+    int32_t d = view->col_idx[e];
+    if (d < n) ++in_deg[d];
+  }
+  for (int64_t v = 0; v < n; ++v) {
+    g_api->result_new_record(result);
+    g_api->result_set_node(result, "node", v);
+    g_api->result_set_int(result, "out_degree",
+                          view->row_ptr[v + 1] - view->row_ptr[v]);
+    g_api->result_set_int(result, "in_degree", in_deg[v]);
+  }
+  free(in_deg);
+  return 0;
+}
+
+/* binary search for dst in v's sorted CSR row */
+static int has_edge(const mgtpu_csr_view *view, int32_t v, int32_t dst) {
+  int32_t lo = view->row_ptr[v], hi = view->row_ptr[v + 1];
+  while (lo < hi) {
+    int32_t mid = lo + (hi - lo) / 2;
+    if (view->col_idx[mid] < dst)
+      lo = mid + 1;
+    else
+      hi = mid;
+  }
+  return lo < view->row_ptr[v + 1] && view->col_idx[lo] == dst;
+}
+
+static int triangles_cb(const mgtpu_csr_view *view, mgtpu_result *result,
+                        void *host_ctx) {
+  (void)host_ctx;
+  int64_t count = 0;
+  for (int64_t e = 0; e < view->n_edges; ++e) {
+    int32_t u = view->csr_src[e], v = view->col_idx[e];
+    if (u >= view->n_nodes || v >= view->n_nodes) continue;
+    /* directed triangles u->v->w->u */
+    for (int32_t j = view->row_ptr[v]; j < view->row_ptr[v + 1]; ++j) {
+      int32_t w = view->col_idx[j];
+      if (w < view->n_nodes && has_edge(view, w, u)) ++count;
+    }
+  }
+  g_api->result_new_record(result);
+  g_api->result_set_int(result, "triangles", count / 3);
+  return 0;
+}
+
+int mgtpu_init_module(const mgtpu_host_api *api, void *registry) {
+  g_api = api;
+  if (api->register_procedure(registry, "c_degree.get", degree_cb,
+                              "node:NODE,out_degree:INT,in_degree:INT"))
+    return 1;
+  if (api->register_procedure(registry, "c_triangles.count", triangles_cb,
+                              "triangles:INT"))
+    return 1;
+  return 0;
+}

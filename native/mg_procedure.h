@@ -1,0 +1,81 @@
+/* Native query-module C ABI.
+ *
+ * Role parity with the reference's module ABI
+ * (/root/reference/include/mg_procedure.h — mgp_graph view in,
+ * mgp_result_record stream out, dlopen'd registration), re-designed for
+ * this framework's TPU-first architecture: instead of a pointer-chasing
+ * graph view, native modules receive the SAME padded CSR/CSC snapshot the
+ * device kernels consume — zero-copy int32/float32 arrays. The host passes
+ * a vtable (mgtpu_host_api) at load time; the module registers procedures
+ * through it and streams result rows through mgtpu_result callbacks.
+ *
+ * A module implements:
+ *     int mgtpu_init_module(const mgtpu_host_api *api, void *registry);
+ * returning 0 on success.
+ */
+
+#ifndef MEMGRAPH_TPU_MG_PROCEDURE_H
+#define MEMGRAPH_TPU_MG_PROCEDURE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct mgtpu_graph mgtpu_graph;    /* opaque: one CSR snapshot   */
+typedef struct mgtpu_result mgtpu_result;  /* opaque: row stream builder */
+
+/* Zero-copy CSR view of the current graph snapshot (see
+ * memgraph_tpu/ops/csr.py for the layout contract: (src,dst)-sorted CSR,
+ * (dst,src)-sorted CSC, sink-row padding). Arrays remain owned by the
+ * host and are valid for the duration of the procedure call. */
+typedef struct mgtpu_csr_view {
+  int64_t n_nodes;        /* real vertex count                   */
+  int64_t n_edges;        /* real edge count                     */
+  int64_t n_pad;          /* padded vertex rows (>= n_nodes + 1) */
+  int64_t e_pad;          /* padded edge slots                   */
+  const int32_t *row_ptr; /* [n_pad + 1] CSR offsets             */
+  const int32_t *col_idx; /* [e_pad] CSR destinations            */
+  const int32_t *csr_src; /* [e_pad] CSR sources                 */
+  const float *weights;   /* [e_pad] edge weights (0 = padding)  */
+  const int32_t *csc_src; /* [e_pad] CSC sources                 */
+  const int32_t *csc_dst; /* [e_pad] CSC destinations            */
+  const int64_t *node_gids; /* [n_nodes] dense index -> storage gid */
+} mgtpu_csr_view;
+
+/* Procedure callback: compute over the view, emit rows via `result`.
+ * Return 0 on success, nonzero to signal an error (use set_error). */
+typedef int (*mgtpu_proc_cb)(const mgtpu_csr_view *view,
+                             mgtpu_result *result, void *host_ctx);
+
+typedef struct mgtpu_host_api {
+  /* registration (call during mgtpu_init_module):
+   *   name:    dotted procedure name, e.g. "c_degree.get"
+   *   results: comma list of "field:TYPE" with TYPE in
+   *            {INT, DOUBLE, STRING, NODE} — NODE fields are set with
+   *            result_set_node from a dense vertex index */
+  int (*register_procedure)(void *registry, const char *name,
+                            mgtpu_proc_cb cb, const char *results);
+
+  /* result streaming */
+  int (*result_new_record)(mgtpu_result *result);
+  int (*result_set_int)(mgtpu_result *result, const char *field,
+                        int64_t value);
+  int (*result_set_double)(mgtpu_result *result, const char *field,
+                           double value);
+  int (*result_set_string)(mgtpu_result *result, const char *field,
+                           const char *value);
+  int (*result_set_node)(mgtpu_result *result, const char *field,
+                         int64_t dense_index);
+  int (*result_set_error)(mgtpu_result *result, const char *message);
+} mgtpu_host_api;
+
+/* Entry point every native module must export. */
+int mgtpu_init_module(const mgtpu_host_api *api, void *registry);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MEMGRAPH_TPU_MG_PROCEDURE_H */

@@ -1,0 +1,60 @@
+"""Native (C ABI) query module tests: build, load, CALL through Cypher."""
+
+import os
+import subprocess
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.query.procedures.native_loader import load_native_module
+from memgraph_tpu.storage import InMemoryStorage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+@pytest.fixture(scope="module")
+def example_lib(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("native") / "libexample_module.so")
+    try:
+        subprocess.run(
+            ["gcc", "-O2", "-shared", "-fPIC", "-I", NATIVE, "-o", out,
+             os.path.join(NATIVE, "example_module.c")],
+            check=True, capture_output=True, timeout=60)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"no C toolchain: {e}")
+    assert load_native_module(out)
+    return out
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def test_native_degree_module(example_lib, db):
+    run(db, """CREATE (a:N {name:'a'}), (b:N {name:'b'}), (c:N {name:'c'}),
+                      (a)-[:E]->(b), (a)-[:E]->(c), (b)-[:E]->(c)""")
+    rows = run(db, "CALL c_degree.get() YIELD node, out_degree, in_degree "
+                   "RETURN node.name, out_degree, in_degree "
+                   "ORDER BY node.name")
+    assert rows == [["a", 2, 0], ["b", 1, 1], ["c", 0, 2]]
+
+
+def test_native_triangle_count(example_lib, db):
+    # directed 3-cycle = one triangle
+    run(db, """CREATE (a:T), (b:T), (c:T),
+                      (a)-[:E]->(b), (b)-[:E]->(c), (c)-[:E]->(a)""")
+    rows = run(db, "CALL c_triangles.count() YIELD triangles RETURN triangles")
+    assert rows == [[1]]
+
+
+def test_native_module_listed_in_mg_procedures(example_lib, db):
+    rows = run(db, "CALL mg.procedures() YIELD name WITH name "
+                   "WHERE name STARTS WITH 'c_' RETURN count(name)")
+    assert rows[0][0] >= 2
