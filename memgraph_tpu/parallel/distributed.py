@@ -133,6 +133,120 @@ def pagerank_sharded(sg: ShardedGraph, damping: float = 0.85,
     return rank[:sg.n_nodes], float(err), int(iters)
 
 
+def shard_graph_by_src(graph: DeviceGraph, mesh: Mesh,
+                       axis: str | None = None) -> ShardedGraph:
+    """Partition edges by SOURCE shard (edge e goes to the device owning
+    src block floor(src / (n_pad / n_shards))) — the layout the 1.5D
+    pagerank needs: every gather rank[src] is then device-local.
+
+    Within each device block edges stay (dst-sorted) for the sorted
+    segment reduction.
+    """
+    import numpy as np
+    axis = axis or mesh.axis_names[0]
+    n_shards = mesh.shape[axis]
+    if graph.n_pad % n_shards:
+        raise ValueError("n_pad must divide the mesh size")
+    block = graph.n_pad // n_shards
+    src = np.asarray(graph.csc_src)[:graph.n_edges]
+    dst = np.asarray(graph.csc_dst)[:graph.n_edges]
+    w = np.asarray(graph.csc_weights)[:graph.n_edges]
+    owner = src // block
+    # bucket edges per owner, keep dst order within the bucket (stable)
+    order = np.argsort(owner, kind="stable")
+    src, dst, w, owner = src[order], dst[order], w[order], owner[order]
+    counts = np.bincount(owner, minlength=n_shards)
+    per = int(counts.max()) if len(counts) else 1
+    per = max(per, 1)
+    sink = graph.n_nodes
+    e_pad = per * n_shards
+    src_full = np.full(e_pad, sink, dtype=np.int32)
+    dst_full = np.full(e_pad, sink, dtype=np.int32)
+    w_full = np.zeros(e_pad, dtype=np.float32)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    for s in range(n_shards):
+        lo, hi = offsets[s], offsets[s + 1]
+        src_full[s * per:s * per + (hi - lo)] = src[lo:hi]
+        dst_full[s * per:s * per + (hi - lo)] = dst[lo:hi]
+        w_full[s * per:s * per + (hi - lo)] = w[lo:hi]
+    sharding = NamedSharding(mesh, P(axis))
+    return ShardedGraph(
+        src=jax.device_put(src_full, sharding),
+        dst=jax.device_put(dst_full, sharding),
+        weights=jax.device_put(w_full, sharding),
+        n_nodes=graph.n_nodes, n_edges=graph.n_edges,
+        n_pad=graph.n_pad, e_pad=e_pad, mesh=mesh, axis=axis)
+
+
+def _pagerank_15d_fn(mesh: Mesh, axis: str, n_pad: int, n_shards: int,
+                     max_iterations: int):
+    """1.5D pagerank: rank is SHARDED over the mesh (each device holds
+    n_pad/n_shards entries); edges are src-sharded so the per-edge rank
+    gather is device-local, and partial destination sums combine with ONE
+    reduce_scatter per iteration — O(n/p) memory and lower ICI volume than
+    the replicated psum scheme (the scaling-book recipe)."""
+    block = n_pad // n_shards
+
+    def step(src_blk, dst_blk, w_blk, n_nodes, damping, tol):
+        shard_id = jax.lax.axis_index(axis)
+        base = shard_id * block
+        n_f = n_nodes.astype(jnp.float32)
+        local_ids = base + jnp.arange(block, dtype=jnp.int32)
+        valid_f = (local_ids < n_nodes).astype(jnp.float32)
+
+        local_src = jnp.clip(src_blk - base, 0, block - 1)
+        src_mine = (src_blk >= base) & (src_blk < base + block)
+        w_eff = jnp.where(src_mine, w_blk, 0.0)
+
+        # local out-weight per owned node (edges are src-sharded: complete)
+        wsum = jax.ops.segment_sum(w_eff, local_src, num_segments=block)
+        inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
+        dangling_f = valid_f * (wsum <= 0)
+
+        rank0 = valid_f / n_f  # local shard of the rank vector
+
+        def body(carry):
+            rank, _, it = carry
+            contrib = rank[local_src] * w_eff * inv_wsum[local_src]
+            # partial sums over ALL destinations, then scatter to owners
+            acc_full = jax.ops.segment_sum(contrib, dst_blk,
+                                           num_segments=n_pad,
+                                           indices_are_sorted=True)
+            acc = jax.lax.psum_scatter(
+                acc_full.reshape(n_shards, block), axis,
+                scatter_dimension=0, tiled=False)
+            dangling_mass = jax.lax.psum(jnp.sum(rank * dangling_f), axis)
+            new_rank = valid_f * ((1.0 - damping) / n_f
+                                  + damping * (acc + dangling_mass / n_f))
+            err = jax.lax.psum(jnp.sum(jnp.abs(new_rank - rank)), axis)
+            return new_rank, err, it + 1
+
+        def cond(carry):
+            _, err, it = carry
+            return (err > tol) & (it < max_iterations)
+
+        rank, err, iters = jax.lax.while_loop(
+            cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
+        return rank, err, iters
+
+    return shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(axis), P(), P()))
+
+
+def pagerank_sharded_15d(sg: ShardedGraph, damping: float = 0.85,
+                         max_iterations: int = 100, tol: float = 1e-6):
+    """Memory-scalable distributed PageRank (use shard_graph_by_src)."""
+    n_shards = sg.mesh.shape[sg.axis]
+    fn = jax.jit(_pagerank_15d_fn(sg.mesh, sg.axis, sg.n_pad, n_shards,
+                                  max_iterations))
+    rank, err, iters = fn(sg.src, sg.dst, sg.weights,
+                          jnp.int32(sg.n_nodes), jnp.float32(damping),
+                          jnp.float32(tol))
+    return rank[:sg.n_nodes], float(err), int(iters)
+
+
 def _min_propagate_sharded_fn(mesh: Mesh, axis: str, n_pad: int,
                               max_iterations: int, undirected: bool,
                               pointer_jump: bool):
