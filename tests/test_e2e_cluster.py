@@ -52,6 +52,82 @@ def test_replicated_cluster_processes(cluster):
     rc.close()
 
 
+def test_ha_cluster_processes(cluster):
+    """Coordinator + 2 data instances as REAL processes; explicit
+    promotion then failover after killing the MAIN."""
+    import time as _t
+    coord_raft = free_port()
+    m1, m2 = free_port(), free_port()
+    r1, r2 = free_port(), free_port()
+    coord = cluster.start_instance("coord", {"args": [
+        "--coordinator-id", "c1", "--coordinator-port", str(coord_raft),
+        "--no-storage-wal-enabled"]})
+    i1 = cluster.start_instance("data1", {"args": [
+        "--management-port", str(m1), "--no-storage-wal-enabled"]})
+    i2 = cluster.start_instance("data2", {"args": [
+        "--management-port", str(m2), "--no-storage-wal-enabled"]})
+    cc = coord.client()
+    c1 = i1.client()
+    c2 = i2.client()
+    # single-coordinator raft elects itself quickly
+    deadline = _t.time() + 15
+    while _t.time() < deadline:
+        try:
+            cc.execute(f'REGISTER INSTANCE i1 ON "127.0.0.1:{m1}" '
+                       f'WITH "127.0.0.1:{r1}"')
+            break
+        except Exception:
+            try:
+                cc.reset()
+            except Exception:
+                pass
+            _t.sleep(0.3)
+    cc.execute(f'REGISTER INSTANCE i2 ON "127.0.0.1:{m2}" '
+               f'WITH "127.0.0.1:{r2}"')
+    cc.execute("SET INSTANCE i1 TO MAIN")
+    _, rows, _ = cc.execute("SHOW INSTANCES")
+    roles = {r[0]: r[2] for r in rows}
+    assert roles["i1"] == "main" and roles["i2"] == "replica"
+    # write on MAIN replicates to the demoted replica process
+    c1.execute("CREATE (:HAP {v: 1})")
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        _, rows, _ = c2.execute("MATCH (n:HAP) RETURN count(n)")
+        if rows == [[1]]:
+            break
+        _t.sleep(0.2)
+    assert rows == [[1]]
+    # kill the MAIN process → automatic failover to i2
+    c1.close()
+    i1.kill()
+    deadline = _t.time() + 30
+    promoted = False
+    while _t.time() < deadline:
+        _, rows, _ = cc.execute("SHOW INSTANCES")
+        roles = {r[0]: r[2] for r in rows}
+        if roles.get("i2") == "main":
+            promoted = True
+            break
+        _t.sleep(0.3)
+    assert promoted, f"failover did not happen: {roles}"
+    # promoted instance accepts writes and kept the data
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        try:
+            c2.execute("CREATE (:HAP {v: 2})")
+            break
+        except Exception:
+            try:
+                c2.reset()
+            except Exception:
+                pass
+            _t.sleep(0.3)
+    _, rows, _ = c2.execute("MATCH (n:HAP) RETURN count(n)")
+    assert rows == [[2]]
+    cc.close()
+    c2.close()
+
+
 def test_bank_transfer_chaos(cluster):
     """Jepsen-lite bank workload: concurrent transfers + process kill/restart;
     total balance must be conserved after recovery."""
