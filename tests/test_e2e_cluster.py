@@ -55,7 +55,6 @@ def test_replicated_cluster_processes(cluster):
 def test_ha_cluster_processes(cluster):
     """Coordinator + 2 data instances as REAL processes; explicit
     promotion then failover after killing the MAIN."""
-    import time as _t
     coord_raft = free_port()
     m1, m2 = free_port(), free_port()
     r1, r2 = free_port(), free_port()
@@ -70,18 +69,23 @@ def test_ha_cluster_processes(cluster):
     c1 = i1.client()
     c2 = i2.client()
     # single-coordinator raft elects itself quickly
-    deadline = _t.time() + 15
-    while _t.time() < deadline:
+    deadline = time.time() + 30
+    registered = False
+    last_error = None
+    while time.time() < deadline:
         try:
             cc.execute(f'REGISTER INSTANCE i1 ON "127.0.0.1:{m1}" '
                        f'WITH "127.0.0.1:{r1}"')
+            registered = True
             break
-        except Exception:
+        except Exception as e:
+            last_error = e
             try:
                 cc.reset()
             except Exception:
                 pass
-            _t.sleep(0.3)
+            time.sleep(0.3)
+    assert registered, f"REGISTER INSTANCE never succeeded: {last_error}"
     cc.execute(f'REGISTER INSTANCE i2 ON "127.0.0.1:{m2}" '
                f'WITH "127.0.0.1:{r2}"')
     cc.execute("SET INSTANCE i1 TO MAIN")
@@ -90,38 +94,41 @@ def test_ha_cluster_processes(cluster):
     assert roles["i1"] == "main" and roles["i2"] == "replica"
     # write on MAIN replicates to the demoted replica process
     c1.execute("CREATE (:HAP {v: 1})")
-    deadline = _t.time() + 10
-    while _t.time() < deadline:
+    deadline = time.time() + 10
+    while time.time() < deadline:
         _, rows, _ = c2.execute("MATCH (n:HAP) RETURN count(n)")
         if rows == [[1]]:
             break
-        _t.sleep(0.2)
+        time.sleep(0.2)
     assert rows == [[1]]
     # kill the MAIN process → automatic failover to i2
     c1.close()
     i1.kill()
-    deadline = _t.time() + 30
+    deadline = time.time() + 30
     promoted = False
-    while _t.time() < deadline:
+    while time.time() < deadline:
         _, rows, _ = cc.execute("SHOW INSTANCES")
         roles = {r[0]: r[2] for r in rows}
         if roles.get("i2") == "main":
             promoted = True
             break
-        _t.sleep(0.3)
+        time.sleep(0.3)
     assert promoted, f"failover did not happen: {roles}"
     # promoted instance accepts writes and kept the data
-    deadline = _t.time() + 10
-    while _t.time() < deadline:
+    deadline = time.time() + 10
+    wrote = False
+    while time.time() < deadline:
         try:
             c2.execute("CREATE (:HAP {v: 2})")
+            wrote = True
             break
         except Exception:
             try:
                 c2.reset()
             except Exception:
                 pass
-            _t.sleep(0.3)
+            time.sleep(0.3)
+    assert wrote, "promoted instance never accepted the write"
     _, rows, _ = c2.execute("MATCH (n:HAP) RETURN count(n)")
     assert rows == [[2]]
     cc.close()
