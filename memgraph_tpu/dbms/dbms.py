@@ -60,6 +60,11 @@ class DbmsHandler:
         ictx = InterpreterContext(storage, dict(self._interp_config))
         ictx.database_name = name
         ictx.dbms = self
+        if cfg.durability_dir:
+            from ..storage.kvstore import KVStore, Settings
+            ictx.kvstore = KVStore(
+                os.path.join(cfg.durability_dir, "kvstore.db"))
+            ictx.settings = Settings(ictx.kvstore)
         self._databases[name] = ictx
         return ictx
 

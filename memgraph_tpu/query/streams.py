@@ -244,6 +244,24 @@ class Streams:
         self.ictx = interpreter_context
         self._lock = threading.Lock()
         self._streams: dict[str, Stream] = {}
+        self._kv = getattr(interpreter_context, "kvstore", None)
+        if self._kv is not None:
+            self._restore()
+
+    def _restore(self) -> None:
+        """Reload persisted stream definitions (reference: RestoreStreams,
+        memgraph.cpp:929). Streams come back in the stopped state."""
+        import dataclasses
+        for key, raw in self._kv.items_with_prefix("stream:"):
+            data = json.loads(raw.decode("utf-8"))
+            spec = StreamSpec(**data)
+            self._streams[spec.name] = Stream(spec, self.ictx)
+
+    def _persist(self, spec: StreamSpec) -> None:
+        if self._kv is not None:
+            import dataclasses
+            self._kv.put(f"stream:{spec.name}",
+                         json.dumps(dataclasses.asdict(spec)))
 
     def create(self, spec: StreamSpec) -> None:
         with self._lock:
@@ -251,10 +269,13 @@ class Streams:
                 raise QueryException(
                     f"stream {spec.name!r} already exists")
             self._streams[spec.name] = Stream(spec, self.ictx)
+            self._persist(spec)
 
     def drop(self, name: str) -> None:
         with self._lock:
             stream = self._streams.pop(name, None)
+            if stream is not None and self._kv is not None:
+                self._kv.delete(f"stream:{name}")
         if stream is None:
             raise QueryException(f"stream {name!r} does not exist")
         if stream.running:
@@ -297,16 +318,18 @@ class Streams:
                 for s in sorted(streams, key=lambda s: s.spec.name)]
 
 
-_REGISTRY: dict[int, Streams] = {}
+import weakref
+
+_REGISTRY: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _REGISTRY_LOCK = threading.Lock()
 
 
 def streams_of(interpreter_context) -> Streams:
     with _REGISTRY_LOCK:
-        s = _REGISTRY.get(id(interpreter_context))
+        s = _REGISTRY.get(interpreter_context)
         if s is None:
             s = Streams(interpreter_context)
-            _REGISTRY[id(interpreter_context)] = s
+            _REGISTRY[interpreter_context] = s
         return s
 
 

@@ -30,7 +30,27 @@ class TriggerStore:
         self._lock = threading.Lock()
         self._triggers: dict[str, Trigger] = {}
         self._firing = threading.local()  # recursion guard
+        self._kv = getattr(interpreter_context, "kvstore", None)
+        if self._kv is not None:
+            self._restore()
         interpreter_context.storage.on_commit_hooks.append(self._on_commit)
+
+    def _restore(self) -> None:
+        """Reload persisted triggers (reference: RestoreTriggers,
+        memgraph.cpp:926)."""
+        import json
+        for key, raw in self._kv.items_with_prefix("trigger:"):
+            data = json.loads(raw.decode("utf-8"))
+            self._triggers[data["name"]] = Trigger(
+                data["name"], data.get("event"), data.get("phase", "AFTER"),
+                data["statement"])
+
+    def _persist(self, trigger: Trigger) -> None:
+        if self._kv is not None:
+            import json
+            self._kv.put(f"trigger:{trigger.name}", json.dumps({
+                "name": trigger.name, "event": trigger.event,
+                "phase": trigger.phase, "statement": trigger.statement}))
 
     def create(self, name, event, phase, statement) -> None:
         from ..exceptions import QueryException
@@ -39,8 +59,9 @@ class TriggerStore:
         with self._lock:
             if name in self._triggers:
                 raise QueryException(f"trigger {name!r} already exists")
-            self._triggers[name] = Trigger(name, event, phase or "AFTER",
-                                           statement)
+            trigger = Trigger(name, event, phase or "AFTER", statement)
+            self._triggers[name] = trigger
+            self._persist(trigger)
 
     def drop(self, name) -> None:
         from ..exceptions import QueryException
@@ -48,6 +69,8 @@ class TriggerStore:
             if name not in self._triggers:
                 raise QueryException(f"trigger {name!r} does not exist")
             del self._triggers[name]
+            if self._kv is not None:
+                self._kv.delete(f"trigger:{name}")
 
     def all(self):
         with self._lock:
@@ -140,14 +163,16 @@ class TriggerStore:
         return False
 
 
-_STORES: dict[int, TriggerStore] = {}
+import weakref
+
+_STORES: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _STORES_LOCK = threading.Lock()
 
 
 def global_trigger_store(interpreter_context) -> TriggerStore:
     with _STORES_LOCK:
-        store = _STORES.get(id(interpreter_context))
+        store = _STORES.get(interpreter_context)
         if store is None:
             store = TriggerStore(interpreter_context)
-            _STORES[id(interpreter_context)] = store
+            _STORES[interpreter_context] = store
         return store

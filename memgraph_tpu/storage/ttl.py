@@ -87,14 +87,16 @@ class TtlRunner:
         return deleted
 
 
-_RUNNERS: dict[int, TtlRunner] = {}
+import weakref
+
+_RUNNERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _RUNNERS_LOCK = threading.Lock()
 
 
 def ttl_runner(interpreter_context) -> TtlRunner:
     with _RUNNERS_LOCK:
-        runner = _RUNNERS.get(id(interpreter_context))
+        runner = _RUNNERS.get(interpreter_context)
         if runner is None:
             runner = TtlRunner(interpreter_context)
-            _RUNNERS[id(interpreter_context)] = runner
+            _RUNNERS[interpreter_context] = runner
         return runner
