@@ -25,9 +25,9 @@ class CoordinatorInstance:
     FAILOVER_MISS_THRESHOLD = 3
 
     def __init__(self, node_id: str, host: str, raft_port: int,
-                 peers: dict[str, tuple[str, int]]):
+                 peers: dict[str, tuple[str, int]], kvstore=None):
         self.raft = RaftNode(node_id, host, raft_port, peers,
-                             apply_fn=self._apply)
+                             apply_fn=self._apply, kvstore=kvstore)
         # replicated cluster state: name -> instance descriptor
         self.instances: dict[str, dict] = {}
         self.main_name: str | None = None

@@ -53,17 +53,23 @@ class RaftNode:
     HEARTBEAT_INTERVAL = 0.15
 
     def __init__(self, node_id: str, host: str, port: int,
-                 peers: dict[str, tuple[str, int]], apply_fn=None):
+                 peers: dict[str, tuple[str, int]], apply_fn=None,
+                 kvstore=None):
         self.node_id = node_id
         self.host = host
         self.port = port
         self.peers = dict(peers)
         self.apply_fn = apply_fn or (lambda cmd: None)
 
-        # persistent state (in-memory here; durability via snapshot hooks)
+        # persistent state (Raft §5.1: currentTerm, votedFor, log[] must
+        # survive restarts — reference: coordinator_log_store.cpp); durable
+        # through the kvstore when one is provided
+        self._kv = kvstore
         self.current_term = 0
         self.voted_for: str | None = None
         self.log: list[LogEntry] = []
+        if kvstore is not None:
+            self._restore_persistent_state()
 
         # volatile
         self.commit_index = -1
@@ -106,6 +112,36 @@ class RaftNode:
     def _new_deadline(self) -> float:
         return time.monotonic() + random.uniform(*self.ELECTION_TIMEOUT)
 
+    # --- durability (Raft persistent state) ---------------------------------
+
+    def _restore_persistent_state(self) -> None:
+        term = self._kv.get_str("raft:term")
+        if term is not None:
+            self.current_term = int(term)
+        self.voted_for = self._kv.get_str("raft:voted_for") or None
+        for key, raw in self._kv.items_with_prefix("raft:log:"):
+            self.log.append(LogEntry.from_json(
+                json.loads(raw.decode("utf-8"))))
+
+    def _persist_term_vote(self) -> None:
+        # caller holds lock
+        if self._kv is not None:
+            self._kv.put("raft:term", str(self.current_term))
+            self._kv.put("raft:voted_for", self.voted_for or "")
+
+    def _persist_log_from(self, start: int) -> None:
+        # caller holds lock; rewrite entries >= start (truncation-safe keys
+        # are zero-padded so prefix iteration returns them in order)
+        if self._kv is None:
+            return
+        for idx in range(start, len(self.log)):
+            self._kv.put(f"raft:log:{idx:012d}",
+                         json.dumps(self.log[idx].to_json()))
+        # drop stale tail entries beyond the new log length
+        for key, _ in list(self._kv.items_with_prefix("raft:log:")):
+            if int(key.rsplit(":", 1)[1]) >= len(self.log):
+                self._kv.delete(key)
+
     # --- public API ---------------------------------------------------------
 
     def is_leader(self) -> bool:
@@ -120,6 +156,7 @@ class RaftNode:
             entry = LogEntry(self.current_term, command)
             self.log.append(entry)
             index = len(self.log) - 1
+            self._persist_log_from(index)
             event = threading.Event()
             self._commit_events[index] = event
             # a single-node cluster (or one whose peers are all caught up)
@@ -189,6 +226,7 @@ class RaftNode:
             self.current_term = term
             self.voted_for = None
             self.role = "follower"
+            self._persist_term_vote()
 
     def _on_request_vote(self, req: dict) -> dict:
         with self._lock:
@@ -204,6 +242,7 @@ class RaftNode:
                 if up_to_date:
                     grant = True
                     self.voted_for = req["candidate"]
+                    self._persist_term_vote()
                     self._election_deadline = self._new_deadline()
             return {"kind": "vote", "term": self.current_term,
                     "granted": grant}
@@ -227,6 +266,7 @@ class RaftNode:
                             "term": self.current_term, "success": False}
             # append/overwrite entries
             insert_at = prev_index + 1
+            changed_from = None
             for i, obj in enumerate(req.get("entries", [])):
                 entry = LogEntry.from_json(obj)
                 idx = insert_at + i
@@ -234,8 +274,14 @@ class RaftNode:
                     if self.log[idx].term != entry.term:
                         del self.log[idx:]
                         self.log.append(entry)
+                        changed_from = idx if changed_from is None \
+                            else min(changed_from, idx)
                 else:
                     self.log.append(entry)
+                    changed_from = idx if changed_from is None \
+                        else min(changed_from, idx)
+            if changed_from is not None:
+                self._persist_log_from(changed_from)
             # advance commit
             leader_commit = req["leader_commit"]
             if leader_commit > self.commit_index:
@@ -279,6 +325,7 @@ class RaftNode:
             self.current_term += 1
             term = self.current_term
             self.voted_for = self.node_id
+            self._persist_term_vote()
             self._election_deadline = self._new_deadline()
             last_index = len(self.log) - 1
             last_term = self.log[-1].term if self.log else 0

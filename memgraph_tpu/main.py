@@ -143,7 +143,7 @@ def build_database(args) -> InterpreterContext:
             peers[pid] = (host, int(port))
         ictx.coordinator = CoordinatorInstance(
             args.coordinator_id, args.bolt_address, args.coordinator_port,
-            peers)
+            peers, kvstore=getattr(ictx, "kvstore", None))
         ictx.coordinator.start()
         logging.info("coordinator %s on raft port %d (%d peers)",
                      args.coordinator_id, args.coordinator_port, len(peers))

@@ -208,3 +208,29 @@ def test_mgmt_state_check():
         assert resp["ok"] and resp["role"] == "main"
     finally:
         inst.stop()
+
+
+def test_raft_state_survives_restart(tmp_path):
+    """Raft persistent state (term, vote, log) restores via the kvstore."""
+    from memgraph_tpu.storage.kvstore import KVStore
+    port, port2 = _ports(2)
+    kv = KVStore(str(tmp_path / "raft.db"))
+    applied = []
+    node = RaftNode("solo", "127.0.0.1", port, {},
+                    apply_fn=applied.append, kvstore=kv)
+    node.start()
+    assert _wait(lambda: node.is_leader(), timeout=10)
+    assert node.propose({"op": "a"})
+    assert node.propose({"op": "b"})
+    term_before = node.current_term
+    node.stop()
+
+    node2 = RaftNode("solo", "127.0.0.1", port2, {},
+                     apply_fn=applied.append, kvstore=kv)
+    assert node2.current_term == term_before
+    assert [e.command["op"] for e in node2.log] == ["a", "b"]
+    node2.start()
+    assert _wait(lambda: node2.is_leader(), timeout=10)
+    assert node2.propose({"op": "c"})
+    assert [e.command["op"] for e in node2.log] == ["a", "b", "c"]
+    node2.stop()
