@@ -2,53 +2,123 @@
 
 Counterpart of /root/reference/query_modules/vector_search_module.cpp (which
 fronts the usearch HNSW index): here search IS the index — batched MXU
-matmul + top_k over a device-resident embedding matrix, cached per
-(storage, topology_version, property).
+matmul + top_k over a device-resident embedding matrix. The matrix is
+maintained INCREMENTALLY: a storage commit hook records which vertices
+changed, and only their rows are re-extracted on the next search (full
+device re-upload only when rows actually changed) — the delta-maintenance
+analog of usearch's in-place index updates.
 """
 
 from __future__ import annotations
 
 import threading
+import weakref
 
 import numpy as np
 
 from . import mgp
 
 _CACHE_LOCK = threading.Lock()
-_CACHE: dict = {}
+# storage (weak) -> {property_name: _MatrixState}
+_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+class _MatrixState:
+    __slots__ = ("matrix", "gids", "gid_rows", "dirty", "hooked")
+
+    def __init__(self):
+        self.matrix = None          # jnp (n, d) or None
+        self.gids: list[int] = []
+        self.gid_rows: dict[int, int] = {}
+        self.dirty: set[int] = set()   # gids touched since last refresh
+        self.hooked = False
+
+
+def _get_states(storage) -> dict:
+    with _CACHE_LOCK:
+        states = _CACHE.get(storage)
+        if states is None:
+            states = {}
+            _CACHE[storage] = states
+
+            def on_commit(txn, commit_ts, _states=states):
+                touched = set(txn.touched_vertices.keys())
+                with _CACHE_LOCK:
+                    for st in _states.values():
+                        st.dirty |= touched
+
+            storage.on_commit_hooks.append(on_commit)
+        return states
 
 
 def _embedding_matrix(ctx, property_name: str):
-    """(matrix (n, d) jnp array, gids list) for nodes carrying the property."""
+    """(matrix (n, d) jnp array, gids list) for nodes carrying the property.
+
+    Incremental: only vertices dirtied by commits since the last call are
+    re-read; unchanged states return the cached device matrix untouched.
+    """
     import jax.numpy as jnp
     storage = ctx.storage
-    key = (id(storage), storage.topology_version, property_name)
+    states = _get_states(storage)
     with _CACHE_LOCK:
-        hit = _CACHE.get(key)
-    if hit is not None:
-        return hit
+        state = states.get(property_name)
+        if state is None:
+            state = _MatrixState()
+            state.dirty = None  # sentinel: full build needed
+            states[property_name] = state
+        dirty = state.dirty
+        state.dirty = set()
     pid = storage.property_mapper.maybe_name_to_id(property_name)
-    vectors = []
-    gids = []
-    if pid is not None:
+    if pid is None:
+        return None, []
+
+    def read_vec(va):
+        vec = va.get_property(pid, ctx.view)
+        if isinstance(vec, (list, tuple)) and vec and \
+                all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                    for x in vec):
+            return [float(x) for x in vec]
+        return None
+
+    if dirty is None:
+        # full build
+        vectors, gids = [], []
         for va in ctx.accessor.vertices(ctx.view):
-            vec = va.get_property(pid, ctx.view)
-            if isinstance(vec, (list, tuple)) and vec and \
-                    all(isinstance(x, (int, float)) and not isinstance(x, bool)
-                        for x in vec):
+            vec = read_vec(va)
+            if vec is not None:
                 vectors.append(vec)
                 gids.append(va.gid)
-    if vectors:
-        matrix = jnp.asarray(np.asarray(vectors, dtype=np.float32))
-    else:
-        matrix = None
-    result = (matrix, gids)
-    with _CACHE_LOCK:
-        stale = [k for k in _CACHE if k[0] == id(storage) and k != key]
-        for k in stale:
-            del _CACHE[k]
-        _CACHE[key] = result
-    return result
+        state.gids = gids
+        state.gid_rows = {g: i for i, g in enumerate(gids)}
+        state.matrix = (jnp.asarray(np.asarray(vectors, dtype=np.float32))
+                        if vectors else None)
+        return state.matrix, state.gids
+
+    if dirty:
+        host = (np.asarray(state.matrix)
+                if state.matrix is not None else np.zeros((0, 0), np.float32))
+        rows = {g: host[i] for g, i in state.gid_rows.items()
+                if g not in dirty}
+        for gid in dirty:
+            va = ctx.accessor.find_vertex(gid, ctx.view)
+            if va is None:
+                continue
+            vec = read_vec(va)
+            if vec is not None:
+                rows[gid] = np.asarray(vec, dtype=np.float32)
+        if rows:
+            # drop rows with a deviating dimension (property was rewritten
+            # with a different-length vector) — keep the dominant dim
+            from collections import Counter
+            dims = Counter(len(v) for v in rows.values())
+            dim = dims.most_common(1)[0][0]
+            rows = {g: v for g, v in rows.items() if len(v) == dim}
+        gids = sorted(rows)
+        state.gids = gids
+        state.gid_rows = {g: i for i, g in enumerate(gids)}
+        state.matrix = (jnp.asarray(np.stack([rows[g] for g in gids]))
+                        if gids else None)
+    return state.matrix, state.gids
 
 
 @mgp.read_proc("vector_search.search",
@@ -79,14 +149,13 @@ def search(ctx, property, query, limit, metric="cosine"):
                         ("size", "INTEGER")])
 def show_index_info(ctx):
     with _CACHE_LOCK:
-        items = list(_CACHE.items())
-    for (sid, ver, prop), (matrix, gids) in items:
-        if sid != id(ctx.storage):
-            continue
+        states = dict(_CACHE.get(ctx.storage) or {})
+    for prop, state in sorted(states.items()):
         yield {"index_name": f"vector::{prop}", "label": "*",
                "property": prop,
-               "dimension": int(matrix.shape[1]) if matrix is not None else 0,
-               "size": len(gids)}
+               "dimension": (int(state.matrix.shape[1])
+                             if state.matrix is not None else 0),
+               "size": len(state.gids)}
 
 
 @mgp.read_proc("knn.get",

@@ -100,3 +100,32 @@ def test_graphrag_with_streaming_ingest(db, tmp_path):
     rows = run(db, "CALL graphrag.retrieve('emb', [1.0, 0.0, 0.0, 0.0], 2, "
                    "2, 6) YIELD node RETURN node.title")
     assert "pallas guide" in [r[0] for r in rows]
+
+
+def test_vector_index_incremental_maintenance(db):
+    """New/updated/deleted embeddings appear in search without full rebuild."""
+    _seed_docs(db)
+    rows = run(db, "CALL vector_search.search('emb', [1.0,0.0,0.0,0.0], 10) "
+                   "YIELD node RETURN count(node)")
+    n0 = rows[0][0]
+    run(db, "CREATE (:Doc {title: 'new doc', emb: [0.99, 0.0, 0.0, 0.0]})")
+    rows = run(db, "CALL vector_search.search('emb', [1.0,0.0,0.0,0.0], 10) "
+                   "YIELD node, similarity RETURN node.title, similarity "
+                   "ORDER BY similarity DESC")
+    assert len(rows) == n0 + 1
+    assert rows[0][0] in ("new doc", "tpu kernels")
+    # update an embedding: it must re-rank
+    run(db, "MATCH (n:Doc {title: 'pasta recipe'}) "
+            "SET n.emb = [1.0, 0.0, 0.0, 0.0]")
+    rows = run(db, "CALL vector_search.search('emb', [1.0,0.0,0.0,0.0], 3) "
+                   "YIELD node RETURN node.title")
+    assert "pasta recipe" in [r[0] for r in rows]
+    # delete: it must disappear
+    run(db, "MATCH (n:Doc {title: 'pasta recipe'}) DETACH DELETE n")
+    rows = run(db, "CALL vector_search.search('emb', [1.0,0.0,0.0,0.0], 10) "
+                   "YIELD node RETURN node.title")
+    assert "pasta recipe" not in [r[0] for r in rows]
+    # index info reflects maintained state
+    rows = run(db, "CALL vector_search.show_index_info() "
+                   "YIELD property, size RETURN property, size")
+    assert rows == [["emb", n0]]
