@@ -2,11 +2,12 @@
 
 Counterpart of /root/reference/query_modules/vector_search_module.cpp (which
 fronts the usearch HNSW index): here search IS the index — batched MXU
-matmul + top_k over a device-resident embedding matrix. The matrix is
-maintained INCREMENTALLY: a storage commit hook records which vertices
-changed, and only their rows are re-extracted on the next search (full
-device re-upload only when rows actually changed) — the delta-maintenance
-analog of usearch's in-place index updates.
+matmul + top_k over a device-resident embedding matrix, cached per
+(storage, topology_version, property) and rebuilt from the reader's own
+snapshot whenever committed state changed. The rebuild is O(n) host-side;
+true row-level delta maintenance is a known follow-up (NOTES_ROUND2.md) —
+previous attempt showed it interacts subtly with snapshot isolation and
+replica WAL apply, so correctness keeps the simple design for now.
 """
 
 from __future__ import annotations
@@ -19,106 +20,53 @@ import numpy as np
 from . import mgp
 
 _CACHE_LOCK = threading.Lock()
-# storage (weak) -> {property_name: _MatrixState}
+# storage (weak) -> {(topology_version, property): (matrix, gids)}
 _CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-
-
-class _MatrixState:
-    __slots__ = ("matrix", "gids", "gid_rows", "dirty", "hooked")
-
-    def __init__(self):
-        self.matrix = None          # jnp (n, d) or None
-        self.gids: list[int] = []
-        self.gid_rows: dict[int, int] = {}
-        self.dirty: set[int] = set()   # gids touched since last refresh
-        self.hooked = False
-
-
-def _get_states(storage) -> dict:
-    with _CACHE_LOCK:
-        states = _CACHE.get(storage)
-        if states is None:
-            states = {}
-            _CACHE[storage] = states
-
-            def on_commit(txn, commit_ts, _states=states):
-                touched = set(txn.touched_vertices.keys())
-                with _CACHE_LOCK:
-                    for st in _states.values():
-                        st.dirty |= touched
-
-            storage.on_commit_hooks.append(on_commit)
-        return states
 
 
 def _embedding_matrix(ctx, property_name: str):
     """(matrix (n, d) jnp array, gids list) for nodes carrying the property.
 
-    Incremental: only vertices dirtied by commits since the last call are
-    re-read; unchanged states return the cached device matrix untouched.
+    Valid for the storage's current topology_version — any commit (and
+    replica WAL apply, which bumps the version too) invalidates it. Rows
+    with a deviating vector dimension are dropped to the dominant one.
     """
     import jax.numpy as jnp
     storage = ctx.storage
-    states = _get_states(storage)
+    key = (storage.topology_version, property_name)
     with _CACHE_LOCK:
-        state = states.get(property_name)
-        if state is None:
-            state = _MatrixState()
-            state.dirty = None  # sentinel: full build needed
-            states[property_name] = state
-        dirty = state.dirty
-        state.dirty = set()
+        per = _CACHE.get(storage)
+        hit = per.get(key) if per else None
+    if hit is not None:
+        return hit
     pid = storage.property_mapper.maybe_name_to_id(property_name)
-    if pid is None:
-        return None, []
-
-    def read_vec(va):
-        vec = va.get_property(pid, ctx.view)
-        if isinstance(vec, (list, tuple)) and vec and \
-                all(isinstance(x, (int, float)) and not isinstance(x, bool)
-                    for x in vec):
-            return [float(x) for x in vec]
-        return None
-
-    if dirty is None:
-        # full build
-        vectors, gids = [], []
+    vectors = []
+    gids = []
+    if pid is not None:
         for va in ctx.accessor.vertices(ctx.view):
-            vec = read_vec(va)
-            if vec is not None:
-                vectors.append(vec)
+            vec = va.get_property(pid, ctx.view)
+            if isinstance(vec, (list, tuple)) and vec and \
+                    all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                        for x in vec):
+                vectors.append([float(x) for x in vec])
                 gids.append(va.gid)
-        state.gids = gids
-        state.gid_rows = {g: i for i, g in enumerate(gids)}
-        state.matrix = (jnp.asarray(np.asarray(vectors, dtype=np.float32))
-                        if vectors else None)
-        return state.matrix, state.gids
-
-    if dirty:
-        host = (np.asarray(state.matrix)
-                if state.matrix is not None else np.zeros((0, 0), np.float32))
-        rows = {g: host[i] for g, i in state.gid_rows.items()
-                if g not in dirty}
-        for gid in dirty:
-            va = ctx.accessor.find_vertex(gid, ctx.view)
-            if va is None:
-                continue
-            vec = read_vec(va)
-            if vec is not None:
-                rows[gid] = np.asarray(vec, dtype=np.float32)
-        if rows:
-            # drop rows with a deviating dimension (property was rewritten
-            # with a different-length vector) — keep the dominant dim
-            from collections import Counter
-            dims = Counter(len(v) for v in rows.values())
-            dim = dims.most_common(1)[0][0]
-            rows = {g: v for g, v in rows.items() if len(v) == dim}
-        gids = sorted(rows)
-        state.gids = gids
-        state.gid_rows = {g: i for i, g in enumerate(gids)}
-        state.matrix = (jnp.asarray(np.stack([rows[g] for g in gids]))
-                        if gids else None)
-    return state.matrix, state.gids
+    if vectors:
+        from collections import Counter
+        dims = Counter(len(v) for v in vectors)
+        dim = dims.most_common(1)[0][0]
+        kept = [(v, g) for v, g in zip(vectors, gids) if len(v) == dim]
+        vectors = [v for v, _ in kept]
+        gids = [g for _, g in kept]
+    matrix = (jnp.asarray(np.asarray(vectors, dtype=np.float32))
+              if vectors else None)
+    result = (matrix, gids)
+    with _CACHE_LOCK:
+        per = _CACHE.get(storage) or {}
+        # keep only current-version entries
+        per = {k: v for k, v in per.items() if k[0] == key[0]}
+        per[key] = result
+        _CACHE[storage] = per
+    return result
 
 
 @mgp.read_proc("vector_search.search",
@@ -149,13 +97,13 @@ def search(ctx, property, query, limit, metric="cosine"):
                         ("size", "INTEGER")])
 def show_index_info(ctx):
     with _CACHE_LOCK:
-        states = dict(_CACHE.get(ctx.storage) or {})
-    for prop, state in sorted(states.items()):
+        per = dict(_CACHE.get(ctx.storage) or {})
+    for (version, prop), (matrix, gids) in sorted(per.items()):
         yield {"index_name": f"vector::{prop}", "label": "*",
                "property": prop,
-               "dimension": (int(state.matrix.shape[1])
-                             if state.matrix is not None else 0),
-               "size": len(state.gids)}
+               "dimension": (int(matrix.shape[1])
+                             if matrix is not None else 0),
+               "size": len(gids)}
 
 
 @mgp.read_proc("knn.get",
