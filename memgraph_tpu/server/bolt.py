@@ -27,10 +27,11 @@ from . import packstream as ps
 log = logging.getLogger(__name__)
 
 BOLT_MAGIC = b"\x60\x60\xB0\x17"
-# Bolt 5.x only: the value encoder emits v5 structures (element ids, UTC
-# datetimes); advertising 4.x would hand old drivers structures they can't
-# hydrate. Legacy 4.x encodings are a follow-up.
-SUPPORTED_VERSIONS = [(5, 2), (5, 1), (5, 0)]
+# value_to_bolt emits version-appropriate structures: v5 (element ids, UTC
+# datetimes) for 5.x sessions, legacy 3-field/5-field structures for 4.x
+SUPPORTED_VERSIONS = [(5, 2), (5, 1), (5, 0), (4, 4), (4, 3)]
+LEGACY_DATETIME = 0x46  # 4.x offset datetime ('F')
+LEGACY_DATETIME_ZONE_ID = 0x66  # 4.x zoned datetime ('f')
 
 # message signatures
 M_HELLO = 0x01
@@ -51,40 +52,51 @@ M_IGNORED = 0x7E
 M_FAILURE = 0x7F
 
 
-def value_to_bolt(v, storage, view):
-    """Engine value → PackStream-compatible value (glue/communication.cpp)."""
+def value_to_bolt(v, storage, view, version=(5, 2)):
+    """Engine value → PackStream value (glue/communication.cpp analog).
+    Structure field sets follow the negotiated protocol version."""
+    v5 = version >= (5, 0)
     if v is None or isinstance(v, (bool, int, float, str, bytes)):
         return v
     if isinstance(v, (list, tuple)):
-        return [value_to_bolt(x, storage, view) for x in v]
+        return [value_to_bolt(x, storage, view, version) for x in v]
     if isinstance(v, dict):
-        return {k: value_to_bolt(x, storage, view) for k, x in v.items()}
+        return {k: value_to_bolt(x, storage, view, version)
+                for k, x in v.items()}
     if isinstance(v, VertexAccessor):
         labels = [storage.label_mapper.id_to_name(l) for l in v.labels(view)]
         props = {storage.property_mapper.id_to_name(k):
-                 value_to_bolt(val, storage, view)
+                 value_to_bolt(val, storage, view, version)
                  for k, val in v.properties(view).items()}
-        return ps.Structure(ps.S_NODE,
-                            [v.gid, labels, props, str(v.gid)])
+        fields = [v.gid, labels, props]
+        if v5:
+            fields.append(str(v.gid))  # element_id
+        return ps.Structure(ps.S_NODE, fields)
     if isinstance(v, EdgeAccessor):
         props = {storage.property_mapper.id_to_name(k):
-                 value_to_bolt(val, storage, view)
+                 value_to_bolt(val, storage, view, version)
                  for k, val in v.properties(view).items()}
-        return ps.Structure(ps.S_RELATIONSHIP, [
-            v.gid, v.from_vertex().gid, v.to_vertex().gid,
-            storage.edge_type_mapper.id_to_name(v.edge_type), props,
-            str(v.gid), str(v.from_vertex().gid), str(v.to_vertex().gid)])
+        fields = [v.gid, v.from_vertex().gid, v.to_vertex().gid,
+                  storage.edge_type_mapper.id_to_name(v.edge_type), props]
+        if v5:
+            fields += [str(v.gid), str(v.from_vertex().gid),
+                       str(v.to_vertex().gid)]
+        return ps.Structure(ps.S_RELATIONSHIP, fields)
     if isinstance(v, Path):
-        nodes = [value_to_bolt(n, storage, view) for n in v.vertices()]
+        nodes = [value_to_bolt(n, storage, view, version)
+                 for n in v.vertices()]
         edges = v.edges()
         rels = []
         for e in edges:
             props = {storage.property_mapper.id_to_name(k):
-                     value_to_bolt(val, storage, view)
+                     value_to_bolt(val, storage, view, version)
                      for k, val in e.properties(view).items()}
-            rels.append(ps.Structure(ps.S_UNBOUND_RELATIONSHIP, [
-                e.gid, storage.edge_type_mapper.id_to_name(e.edge_type),
-                props, str(e.gid)]))
+            fields = [e.gid,
+                      storage.edge_type_mapper.id_to_name(e.edge_type),
+                      props]
+            if v5:
+                fields.append(str(e.gid))
+            rels.append(ps.Structure(ps.S_UNBOUND_RELATIONSHIP, fields))
         # index sequence: alternating rel index (1-based) and node index
         seq = []
         node_ids = [n.gid for n in v.vertices()]
@@ -109,6 +121,12 @@ def value_to_bolt(v, storage, view):
         micros = v.timestamp_micros()
         offset = int(v.dt.utcoffset().total_seconds()) if v.dt.utcoffset() \
             else 0
+        if not v5:
+            # legacy 4.x: wall-clock seconds (local) + offset, tag 'F'
+            local = micros + offset * 1_000_000
+            return ps.Structure(LEGACY_DATETIME,
+                                [local // 1_000_000,
+                                 (local % 1_000_000) * 1000, offset])
         return ps.Structure(ps.S_DATETIME,
                             [micros // 1_000_000,
                              (micros % 1_000_000) * 1000, offset])
@@ -162,6 +180,14 @@ def bolt_to_value(v):
             except Exception:
                 pass
             return ZonedDateTime(base)
+        if v.tag == LEGACY_DATETIME:
+            # 4.x: local wall-clock seconds + offset
+            sec, nanos, offset = v.fields
+            tz = dt.timezone(dt.timedelta(seconds=offset))
+            utc_micros = sec * 1_000_000 + nanos // 1000 \
+                - offset * 1_000_000
+            return ZonedDateTime(dt.datetime.fromtimestamp(
+                utc_micros / 1e6, tz))
         if v.tag == ps.S_TIME:
             nanos, offset = v.fields
             from ..utils.temporal import _micros_to_time
@@ -401,12 +427,13 @@ class BoltSession:
 
     def on_pull(self, extra: dict) -> bool:
         n = extra.get("n", -1)
-        storage = self.ictx.storage
+        storage = self.interpreter.ctx.storage  # honors USE DATABASE
         from ..storage.common import View
         rows, has_more, summary = self.interpreter.pull(n)
         for row in rows:
             self.send(M_RECORD,
-                      [value_to_bolt(v, storage, View.NEW) for v in row])
+                      [value_to_bolt(v, storage, View.NEW, self.version)
+                       for v in row])
         meta = {"has_more": has_more}
         if not has_more:
             meta["t_last"] = 0

@@ -26,18 +26,20 @@ class BoltClientError(MemgraphTpuError):
 
 class BoltClient:
     def __init__(self, host="127.0.0.1", port=7687, username="",
-                 password="", timeout=30.0):
+                 password="", timeout=30.0, versions=None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._versions = versions or ((5, 2), (5, 0), (4, 4), (4, 3))
         self._handshake()
         self._hello(username, password)
 
     # --- wire ---------------------------------------------------------------
 
     def _handshake(self):
-        # propose 5.2, 5.0, 4.4, 4.3
         proposals = b""
-        for (maj, minor) in ((5, 2), (5, 0), (4, 4), (4, 3)):
+        for (maj, minor) in list(self._versions)[:4]:
             proposals += bytes([0, 0, minor, maj])
+        while len(proposals) < 16:
+            proposals += bytes([0, 0, 0, 0])
         self.sock.sendall(BOLT_MAGIC + proposals)
         chosen = self._recv_exact(4)
         self.version = (chosen[3], chosen[2])

@@ -137,6 +137,22 @@ def test_call_procedure_over_bolt(server):
     client.close()
 
 
+def test_bolt_44_legacy_structures(server):
+    """A 4.4-only client gets legacy 3-field Node / 5-field Relationship
+    structures and legacy datetime tags."""
+    client = BoltClient(port=server["port"], versions=((4, 4),))
+    assert client.version == (4, 4)
+    client.execute("CREATE (:Legacy {k: 1})-[:L]->(:Legacy)")
+    _, rows, _ = client.execute(
+        "MATCH (a:Legacy {k: 1})-[r:L]->(b) RETURN a, r")
+    node, rel = rows[0]
+    assert node.tag == 0x4E and len(node.fields) == 3  # no element_id
+    assert rel.tag == 0x52 and len(rel.fields) == 5
+    _, rows, _ = client.execute("RETURN datetime('2024-06-15T08:30:00+02:00')")
+    assert rows[0][0].tag == 0x46  # legacy offset datetime
+    client.close()
+
+
 def test_auth_required():
     """With users defined, unauthenticated RUN must be rejected."""
     from memgraph_tpu.auth.auth import Auth
