@@ -426,6 +426,13 @@ class SessionTraceQuery:
 
 
 @dataclass
+class SettingQuery:
+    action: str                 # set | show_one | show_all
+    name: Optional[str] = None
+    value: Optional[str] = None
+
+
+@dataclass
 class MultiDatabaseQuery:
     action: str                 # create | drop | use | show
     name: Optional[str] = None

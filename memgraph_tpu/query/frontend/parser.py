@@ -215,6 +215,16 @@ class Parser:
                 return self.parse_isolation_or_storage()
             if nxt.is_kw("REPLICATION"):
                 return self.parse_set_replication_role()
+            if nxt.is_kw("DATABASE"):
+                self.advance(); self.advance()
+                if not (self.at(T.IDENT)
+                        and self.cur.value.upper() == "SETTING"):
+                    self.error("expected SETTING after SET DATABASE")
+                self.advance()
+                name = self.expect(T.STRING).value
+                self.expect_kw("TO")
+                value = self.expect(T.STRING).value
+                return A.SettingQuery("set", name, value)
             if nxt.is_kw("PASSWORD"):
                 return self.parse_auth()
             return self.parse_cypher_query()
@@ -390,6 +400,13 @@ class Parser:
         if self.accept_kw("DATABASES"):
             return A.MultiDatabaseQuery("show")
         if self.accept_kw("DATABASE"):
+            if self.at(T.IDENT) and self.cur.value.upper() == "SETTINGS":
+                self.advance()
+                return A.SettingQuery("show_all")
+            if self.at(T.IDENT) and self.cur.value.upper() == "SETTING":
+                self.advance()
+                return A.SettingQuery("show_one",
+                                      self.expect(T.STRING).value)
             return A.InfoQuery("database")
         if self.accept_kw("SCHEMA"):
             self.expect_kw("INFO")

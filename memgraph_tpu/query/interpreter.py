@@ -185,6 +185,8 @@ class Interpreter:
             return self._prepare_coordinator(node)
         if isinstance(node, A.MultiDatabaseQuery):
             return self._prepare_multidb(node)
+        if isinstance(node, A.SettingQuery):
+            return self._prepare_setting(node)
         if isinstance(node, A.TtlQuery):
             return self._prepare_ttl(node)
         raise SemanticException(
@@ -230,6 +232,31 @@ class Interpreter:
                 ["name", "type", "topics", "transform", "batch_size",
                  "status", "processed_messages", "last_error"], "r")
         raise SemanticException(f"unknown stream action {node.action}")
+
+    def _settings(self):
+        settings = getattr(self.ctx, "settings", None)
+        if settings is None:
+            from ..storage.kvstore import Settings
+            settings = self.ctx.settings = Settings(
+                getattr(self.ctx, "kvstore", None))
+        return settings
+
+    def _prepare_setting(self, node: A.SettingQuery) -> PreparedQuery:
+        settings = self._settings()
+        if node.action == "set":
+            self._ensure_writable("SET DATABASE SETTING")
+            settings.set(node.name, node.value)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "show_one":
+            value = settings.get(node.name)
+            rows = [[node.name, value]] if value is not None else []
+            return self._prepare_generator(iter(rows),
+                                           ["setting_name", "setting_value"],
+                                           "r")
+        rows = sorted([k, v] for k, v in settings.all().items())
+        return self._prepare_generator(iter(rows),
+                                       ["setting_name", "setting_value"],
+                                       "r")
 
     def _prepare_multidb(self, node: A.MultiDatabaseQuery) -> PreparedQuery:
         dbms = getattr(self.ctx, "dbms", None)

@@ -72,3 +72,19 @@ def test_streams_restored_on_startup(tmp_path):
     assert rows[0][0] == "s1"
     assert rows[0][4] == 7          # batch size survived
     assert rows[0][5] == "stopped"  # restored stopped
+
+
+def test_database_settings_cypher(tmp_path):
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    dbms = DbmsHandler(cfg)
+    interp = Interpreter(dbms.default())
+    interp.execute('SET DATABASE SETTING "log.level" TO "DEBUG"')
+    _, rows, _ = interp.execute('SHOW DATABASE SETTING "log.level"')
+    assert rows == [["log.level", "DEBUG"]]
+    _, rows, _ = interp.execute("SHOW DATABASE SETTINGS")
+    assert ["log.level", "DEBUG"] in rows
+    # durable across a new handler
+    dbms2 = DbmsHandler(cfg)
+    interp2 = Interpreter(dbms2.default())
+    _, rows, _ = interp2.execute('SHOW DATABASE SETTING "log.level"')
+    assert rows == [["log.level", "DEBUG"]]
