@@ -279,6 +279,16 @@ class ExpandVariable(LogicalOperator):
     min_hops: int = 1
     max_hops: int = -1          # -1 = unbounded
     prev_edge_symbols: list[str] = field(default_factory=list)
+    filter_lambda: object = None    # A.Lambda — per-step (e, n | pred)
+
+    def _step_ok(self, ctx, frame, edge, node) -> bool:
+        lam = self.filter_lambda
+        if lam is None:
+            return True
+        inner = dict(frame)
+        inner[lam.edge_var] = edge
+        inner[lam.node_var] = node
+        return ctx.evaluator.eval(lam.expr, inner) is True
 
     def cursor(self, ctx):
         type_ids = Expand._type_ids(self, ctx)
@@ -309,6 +319,8 @@ class ExpandVariable(LogicalOperator):
                 for ea, other in Expand._edges(self, ctx, node, type_ids):
                     ctx.consume_hop()
                     if ea.gid in used_gids:
+                        continue
+                    if not self._step_ok(ctx, frame, ea, other):
                         continue
                     yield from dfs(other, path_edges + [ea],
                                    used_gids | {ea.gid})

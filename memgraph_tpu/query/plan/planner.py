@@ -494,7 +494,8 @@ class Planner:
             max_h = edge.max_hops.value if edge.max_hops else -1
             plan = Op.ExpandVariable(plan, from_sym, edge_sym, to_sym,
                                      direction, edge.types, min_h, max_h,
-                                     list(edge_syms_in_match))
+                                     list(edge_syms_in_match),
+                                     edge.filter_lambda)
         else:
             plan = Op.Expand(plan, from_sym, edge_sym, to_sym, direction,
                              edge.types, list(edge_syms_in_match))

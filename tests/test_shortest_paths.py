@@ -123,3 +123,13 @@ def test_hops_limit(db):
         run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 2 RETURN count(*)")
     rows = run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 100 RETURN count(*)")
     assert rows == [[5]]
+
+
+def test_var_length_filter_lambda(db):
+    # only traverse cheap edges: a->b->d (all d<2.0); the 5.0 edge is cut
+    rows = run(db, "MATCH (a:City {name:'a'})-[e *1..3 (r, n | r.d < 2.0)]->"
+                   "(x) RETURN DISTINCT x.name ORDER BY x.name")
+    assert [r[0] for r in rows] == ["b", "c", "d"]
+    rows = run(db, "MATCH (a:City {name:'a'})-[e *1..3 (r, n | r.d > 4.0)]->"
+                   "(x) RETURN x.name")
+    assert [r[0] for r in rows] == ["d"]  # only the direct heavy edge
