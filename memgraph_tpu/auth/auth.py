@@ -75,8 +75,12 @@ class Auth:
         with self._lock:
             if name in self._users:
                 raise AuthException(f"user {name!r} already exists")
-            self._users[name] = User(
-                name, _hash_password(password) if password else None)
+            user = User(name, _hash_password(password) if password else None)
+            if not self._users:
+                # the first user becomes the administrator (full grants) —
+                # otherwise enabling auth would lock everyone out
+                user.granted = set(PRIVILEGES)
+            self._users[name] = user
             self._save()
 
     def drop_user(self, name: str) -> None:

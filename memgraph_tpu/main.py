@@ -186,6 +186,7 @@ async def serve(args, ictx) -> None:
         os.makedirs(args.data_directory, exist_ok=True)
         auth_path = os.path.join(args.data_directory, "auth.json")
     auth = Auth(auth_path)
+    ictx.auth_store = auth  # RBAC enforcement reads this
 
     server = BoltServer(ictx, args.bolt_address, args.bolt_port, auth)
     await server.start()

@@ -479,7 +479,9 @@ class ReplicationQuery:
 
 @dataclass
 class AuthQuery:
-    action: str                     # create_user/drop_user/set_password/...
+    action: str   # create_user | drop_user | set_password | show_users |
+                  # create_role | drop_role | set_role | show_roles |
+                  # grant | deny | revoke | show_privileges
     user: Optional[str] = None
     password: Optional[object] = None
     role: Optional[str] = None

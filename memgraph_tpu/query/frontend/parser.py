@@ -137,6 +137,9 @@ class Parser:
                 return self.parse_create_trigger()
             if nxt.is_kw("USER"):
                 return self.parse_auth()
+            if nxt.is_kw("ROLE"):
+                self.advance(); self.advance()
+                return A.AuthQuery("create_role", role=self.name_token())
             return self.parse_cypher_query()
         if self.at_kw("DROP"):
             nxt = self.peek()
@@ -160,6 +163,9 @@ class Parser:
                 return A.MultiDatabaseQuery("drop", name=self.name_token())
             if nxt.is_kw("USER"):
                 return self.parse_auth()
+            if nxt.is_kw("ROLE"):
+                self.advance(); self.advance()
+                return A.AuthQuery("drop_role", role=self.name_token())
             self.error("unsupported DROP statement")
         if self.at_kw("SHOW"):
             return self.parse_show()
@@ -227,7 +233,30 @@ class Parser:
                 return A.SettingQuery("set", name, value)
             if nxt.is_kw("PASSWORD"):
                 return self.parse_auth()
+            if nxt.is_kw("ROLE"):
+                self.advance(); self.advance()
+                self.expect_kw("FOR")
+                user = self.name_token()
+                self.expect_kw("TO")
+                return A.AuthQuery("set_role", user=user,
+                                   role=self.name_token())
             return self.parse_cypher_query()
+        if self.at_kw("GRANT") or self.at_kw("DENY"):
+            action = self.advance().value.lower()
+            privs = [self.name_token().upper()]
+            while self.accept(","):
+                privs.append(self.name_token().upper())
+            self.expect_kw("TO")
+            target = self.name_token()
+            return A.AuthQuery(action, user=target, privileges=privs)
+        if self.at_kw("REVOKE"):
+            self.advance()
+            privs = [self.name_token().upper()]
+            while self.accept(","):
+                privs.append(self.name_token().upper())
+            self.expect_kw("FROM")
+            target = self.name_token()
+            return A.AuthQuery("revoke", user=target, privileges=privs)
         if self.at_kw("REGISTER"):
             if self.peek().type == T.IDENT and \
                     self.peek().value.upper() == "INSTANCE":
@@ -418,6 +447,15 @@ class Parser:
             return A.ReplicationQuery("show_role")
         if self.accept_kw("STREAMS"):
             return A.StreamQuery("show")
+        if self.at(T.IDENT) and self.cur.value.upper() == "USERS":
+            self.advance()
+            return A.AuthQuery("show_users")
+        if self.at(T.IDENT) and self.cur.value.upper() == "ROLES":
+            self.advance()
+            return A.AuthQuery("show_roles")
+        if self.accept_kw("PRIVILEGES"):
+            self.expect_kw("FOR")
+            return A.AuthQuery("show_privileges", user=self.name_token())
         if self.at(T.IDENT) and self.cur.value.upper() == "INSTANCES":
             self.advance()
             return A.CoordinatorQuery("show")

@@ -130,6 +130,10 @@ class Interpreter:
             return self._prepare_generator(
                 iter(rows), ["timestamp", "event", "data"], "r")
 
+        priv = self._NODE_PRIVILEGES.get(type(node).__name__)
+        if priv is not None:
+            self._check_privilege(priv)
+
         if isinstance(node, A.TransactionQuery):
             return self._prepare_transaction(node)
         if isinstance(node, A.CypherQuery):
@@ -326,6 +330,37 @@ class Interpreter:
             runner.stop()
         return self._prepare_generator(iter([]), [], "s")
 
+    def _auth_store(self):
+        auth = getattr(self.ctx, "auth_store", None)
+        if auth is None:
+            from ..auth.auth import global_auth
+            auth = global_auth()
+        return auth
+
+    def _check_privilege(self, privilege: str) -> None:
+        """Enforce RBAC when users are defined (reference: AuthChecker,
+        glue/auth_checker.cpp). Sessions without users run open."""
+        auth = self._auth_store()
+        if not auth.users():
+            return
+        from ..exceptions import AuthException
+        if not auth.has_privilege(self.username or "", privilege):
+            raise AuthException(
+                f"user {self.username or '<anonymous>'!r} is not allowed "
+                f"to execute this query (missing privilege {privilege})")
+
+    _NODE_PRIVILEGES = {
+        "IndexQuery": "INDEX", "ConstraintQuery": "CONSTRAINT",
+        "TriggerQuery": "TRIGGER", "StorageModeQuery": "STORAGE_MODE",
+        "AuthQuery": "AUTH", "ReplicationQuery": "REPLICATION",
+        "StreamQuery": "STREAM", "SnapshotQuery": "DURABILITY",
+        "DumpQuery": "DUMP", "MultiDatabaseQuery": "MULTI_DATABASE_EDIT",
+        "TtlQuery": "CONFIG", "SettingQuery": "CONFIG",
+        "CoordinatorQuery": "COORDINATOR",
+        "TerminateTransactionsQuery": "TRANSACTION_MANAGEMENT",
+        "ShowTransactionsQuery": "TRANSACTION_MANAGEMENT",
+    }
+
     def _ensure_writable(self, what: str) -> None:
         replication = getattr(self.ctx, "replication", None)
         if replication is not None and replication.role == "replica":
@@ -452,9 +487,12 @@ class Interpreter:
             strip = strip.split(None, 1)[1] if " " in strip else strip
         plan, columns = self.ctx.cached_plan(strip, query)
 
+        is_write = _plan_is_write(plan)
+        self._check_privilege("CREATE" if is_write else "MATCH")
+
         replication = getattr(self.ctx, "replication", None)
         if replication is not None and replication.role == "replica" \
-                and _plan_is_write(plan):
+                and is_write:
             raise QueryException(
                 "write queries are forbidden on a REPLICA instance")
 
@@ -783,8 +821,7 @@ class Interpreter:
             iter(rows), ["trigger name", "event", "phase", "statement"], "r")
 
     def _prepare_auth(self, node: A.AuthQuery) -> PreparedQuery:
-        from ..auth.auth import global_auth
-        auth = global_auth()
+        auth = self._auth_store()
         if node.action == "create_user":
             pw = None
             if node.password is not None and isinstance(node.password,
@@ -793,6 +830,36 @@ class Interpreter:
             auth.create_user(node.user, pw)
         elif node.action == "drop_user":
             auth.drop_user(node.user)
+        elif node.action == "create_role":
+            auth.create_role(node.role)
+        elif node.action == "drop_role":
+            auth.drop_role(node.role)
+        elif node.action == "set_role":
+            auth.set_role(node.user, node.role)
+        elif node.action == "grant":
+            auth.grant(node.user, node.privileges)
+        elif node.action == "deny":
+            auth.deny(node.user, node.privileges)
+        elif node.action == "revoke":
+            auth.revoke(node.user, node.privileges)
+        elif node.action == "show_users":
+            return self._prepare_generator(
+                iter([[u] for u in auth.users()]), ["user"], "r")
+        elif node.action == "show_roles":
+            with auth._lock:
+                roles = sorted(auth._roles)
+            return self._prepare_generator(
+                iter([[r] for r in roles]), ["role"], "r")
+        elif node.action == "show_privileges":
+            from ..auth.auth import PRIVILEGES
+            rows = []
+            for p in PRIVILEGES:
+                if auth.has_privilege(node.user, p):
+                    rows.append([p, "GRANT"])
+            return self._prepare_generator(
+                iter(rows), ["privilege", "effective"], "r")
+        else:
+            raise SemanticException(f"unknown auth action {node.action}")
         return self._prepare_generator(iter([]), [], "s")
 
     # --- helpers ------------------------------------------------------------

@@ -312,8 +312,10 @@ class BoltSession:
             return False
         if sig == M_RESET:
             self.failed = False
+            username = self.interpreter.username
             self.interpreter.abort()
             self.interpreter = Interpreter(self.ictx)
+            self.interpreter.username = username  # RESET keeps the identity
             self._prepared = None
             self.send_success()
             return True
@@ -396,6 +398,7 @@ class BoltSession:
                     "authentication failure")
                 return True
             self.authenticated = True
+            self.interpreter.username = principal
         self.send_success({
             "server": "Neo4j/5.2.0 compatible (memgraph-tpu)",
             "connection_id": "bolt-1",
@@ -412,6 +415,7 @@ class BoltSession:
                 "authentication failure")
             return True
         self.authenticated = True
+        self.interpreter.username = principal  # RBAC enforcement identity
         self.send_success()
         return True
 

@@ -1,0 +1,98 @@
+"""RBAC enforcement e2e: privileges checked per query over Bolt."""
+
+import socket
+
+import pytest
+
+from memgraph_tpu.auth.auth import Auth
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.server.bolt import BoltServer
+from memgraph_tpu.server.client import BoltClient, BoltClientError
+from memgraph_tpu.storage import InMemoryStorage
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def rbac():
+    auth = Auth()
+    auth.create_user("admin", "adminpw")  # first user → all privileges
+    auth.create_user("reader", "readerpw")
+    auth.grant("reader", ["MATCH"])
+    ictx = InterpreterContext(InMemoryStorage())
+    ictx.auth_store = auth
+    port = _free_port()
+    srv = BoltServer(ictx, "127.0.0.1", port, auth)
+    thread, loop = srv.run_in_thread()
+    yield {"port": port, "auth": auth, "ictx": ictx}
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_admin_has_all(rbac):
+    c = BoltClient(port=rbac["port"], username="admin", password="adminpw")
+    c.execute("CREATE (:T {v: 1})")
+    c.execute("CREATE INDEX ON :T(v)")
+    _, rows, _ = c.execute("MATCH (n:T) RETURN count(n)")
+    assert rows == [[1]]
+    c.close()
+
+
+def test_reader_read_only(rbac):
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    admin.execute("CREATE (:T {v: 1})")
+    admin.close()
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    _, rows, _ = c.execute("MATCH (n:T) RETURN count(n)")
+    assert rows == [[1]]
+    with pytest.raises(BoltClientError):
+        c.execute("CREATE (:Nope)")
+    c.reset()
+    with pytest.raises(BoltClientError):
+        c.execute("CREATE INDEX ON :T(x)")
+    c.reset()
+    with pytest.raises(BoltClientError):
+        c.execute("SHOW USERS")  # AUTH privilege missing
+    c.close()
+
+
+def test_grant_and_revoke_via_cypher(rbac):
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    admin.execute("GRANT CREATE TO reader")
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    c.execute("CREATE (:Allowed)")
+    c.close()
+    admin.execute("REVOKE CREATE FROM reader")
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    with pytest.raises(BoltClientError):
+        c.execute("CREATE (:DeniedAgain)")
+    c.close()
+    _, rows, _ = admin.execute("SHOW PRIVILEGES FOR reader")
+    privs = [r[0] for r in rows]
+    assert "MATCH" in privs and "CREATE" not in privs
+    admin.close()
+
+
+def test_roles_via_cypher(rbac):
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    admin.execute("CREATE ROLE writers")
+    admin.execute("GRANT CREATE TO writers")
+    admin.execute("SET ROLE FOR reader TO writers")
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    c.execute("CREATE (:ViaRole)")  # privilege via the role
+    c.close()
+    _, rows, _ = admin.execute("SHOW ROLES")
+    assert rows == [["writers"]]
+    admin.close()
