@@ -113,6 +113,39 @@ class Auth:
         with self._lock:
             return sorted(self._users)
 
+    def roles(self) -> list[str]:
+        with self._lock:
+            return sorted(self._roles)
+
+    def effective_privileges(self, name: str) -> list[tuple[str, str]]:
+        """[(privilege, 'GRANT'|'DENY')] for a user or role; raises for
+        unknown names. DENYs are reported explicitly."""
+        with self._lock:
+            target = self._users.get(name) or self._roles.get(name)
+            if target is None:
+                raise AuthException(f"user or role {name!r} does not exist")
+        out = []
+        for p in PRIVILEGES:
+            denied = False
+            with self._lock:
+                user = self._users.get(name)
+                if p in target.denied:
+                    denied = True
+                elif user is not None:
+                    for role_name in user.roles:
+                        role = self._roles.get(role_name)
+                        if role is not None and p in role.denied:
+                            denied = True
+                            break
+            if denied:
+                out.append((p, "DENY"))
+                continue
+            granted = (self.has_privilege(name, p)
+                       if name in self.users() else p in target.granted)
+            if granted:
+                out.append((p, "GRANT"))
+        return out
+
     # --- roles / privileges -------------------------------------------------
 
     def create_role(self, name: str) -> None:

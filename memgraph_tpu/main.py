@@ -154,8 +154,15 @@ def build_database(args) -> InterpreterContext:
         ictx.mgmt_server.start()
         logging.info("management server on port %d", args.management_port)
 
+    # auth store wired BEFORE the init file runs (single source of truth)
+    if args.data_directory:
+        import os as _os
+        _os.makedirs(args.data_directory, exist_ok=True)
+        ictx.auth_store = Auth(
+            _os.path.join(args.data_directory, "auth.json"))
+
     if args.init_file:
-        interp = Interpreter(ictx)
+        interp = Interpreter(ictx, system=True)
         with open(args.init_file) as f:
             for statement in split_statements(f.read()):
                 interp.execute(statement)
@@ -180,13 +187,10 @@ def split_statements(text: str) -> list[str]:
 
 
 async def serve(args, ictx) -> None:
-    auth_path = None
-    if args.data_directory:
-        import os
-        os.makedirs(args.data_directory, exist_ok=True)
-        auth_path = os.path.join(args.data_directory, "auth.json")
-    auth = Auth(auth_path)
-    ictx.auth_store = auth  # RBAC enforcement reads this
+    auth = getattr(ictx, "auth_store", None)
+    if auth is None:
+        auth = Auth(None)
+        ictx.auth_store = auth
 
     server = BoltServer(ictx, args.bolt_address, args.bolt_port, auth)
     await server.start()

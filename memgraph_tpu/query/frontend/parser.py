@@ -244,6 +244,8 @@ class Parser:
         if self.at_kw("GRANT") or self.at_kw("DENY"):
             action = self.advance().value.lower()
             privs = [self.name_token().upper()]
+            if privs == ["ALL"]:
+                self.accept_kw("PRIVILEGES")
             while self.accept(","):
                 privs.append(self.name_token().upper())
             self.expect_kw("TO")
@@ -252,6 +254,8 @@ class Parser:
         if self.at_kw("REVOKE"):
             self.advance()
             privs = [self.name_token().upper()]
+            if privs == ["ALL"]:
+                self.accept_kw("PRIVILEGES")
             while self.accept(","):
                 privs.append(self.name_token().upper())
             self.expect_kw("FROM")

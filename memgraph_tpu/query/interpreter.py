@@ -89,7 +89,11 @@ class PreparedQuery:
 class Interpreter:
     """One per client session (reference: one per Bolt session)."""
 
-    def __init__(self, context: InterpreterContext) -> None:
+    def __init__(self, context: InterpreterContext,
+                 system: bool = False) -> None:
+        # system interpreters (triggers, streams, init-file, replication
+        # internals) bypass RBAC — they act on behalf of the server
+        self.system = system
         self.ctx = context
         self.session_isolation: Optional[IsolationLevel] = None
         self.next_isolation: Optional[IsolationLevel] = None
@@ -340,6 +344,8 @@ class Interpreter:
     def _check_privilege(self, privilege: str) -> None:
         """Enforce RBAC when users are defined (reference: AuthChecker,
         glue/auth_checker.cpp). Sessions without users run open."""
+        if self.system:
+            return
         auth = self._auth_store()
         if not auth.users():
             return
@@ -487,8 +493,10 @@ class Interpreter:
             strip = strip.split(None, 1)[1] if " " in strip else strip
         plan, columns = self.ctx.cached_plan(strip, query)
 
-        is_write = _plan_is_write(plan)
-        self._check_privilege("CREATE" if is_write else "MATCH")
+        needed = _plan_privileges(plan)
+        for privilege in sorted(needed):
+            self._check_privilege(privilege)
+        is_write = bool(needed - {"MATCH"})
 
         replication = getattr(self.ctx, "replication", None)
         if replication is not None and replication.role == "replica" \
@@ -846,16 +854,11 @@ class Interpreter:
             return self._prepare_generator(
                 iter([[u] for u in auth.users()]), ["user"], "r")
         elif node.action == "show_roles":
-            with auth._lock:
-                roles = sorted(auth._roles)
             return self._prepare_generator(
-                iter([[r] for r in roles]), ["role"], "r")
+                iter([[r] for r in auth.roles()]), ["role"], "r")
         elif node.action == "show_privileges":
-            from ..auth.auth import PRIVILEGES
-            rows = []
-            for p in PRIVILEGES:
-                if auth.has_privilege(node.user, p):
-                    rows.append([p, "GRANT"])
+            rows = [[p, eff] for p, eff
+                    in auth.effective_privileges(node.user)]
             return self._prepare_generator(
                 iter(rows), ["privilege", "effective"], "r")
         else:
@@ -887,28 +890,42 @@ def _chain_front(first_row, rest):
     yield from rest
 
 
-def _plan_is_write(plan) -> bool:
+def _plan_privileges(plan) -> set:
+    """Privileges a plan requires (reference: per-clause privilege map)."""
     from .plan import operators as Op
-    write_types = (Op.CreateNode, Op.CreateExpand, Op.SetProperty,
-                   Op.SetProperties, Op.SetLabels, Op.RemoveProperty,
-                   Op.RemoveLabels, Op.Delete, Op.Merge, Op.Foreach)
-    found = False
+    needed: set = set()
 
     def walk(op):
-        nonlocal found
-        if op is None or found:
+        if op is None:
             return
-        if isinstance(op, write_types):
-            found = True
-            return
-        if isinstance(op, Op.CallProcedureOp):
+        if isinstance(op, (Op.ScanAll, Op.ScanAllByLabel,
+                           Op.ScanAllByLabelPropertyValue,
+                           Op.ScanAllByLabelPropertyRange, Op.ScanAllById,
+                           Op.Expand, Op.ExpandVariable, Op.ExpandShortest,
+                           Op.ExpandKShortest)):
+            needed.add("MATCH")
+        elif isinstance(op, (Op.CreateNode, Op.CreateExpand)):
+            needed.add("CREATE")
+        elif isinstance(op, Op.Merge):
+            needed.update(("MERGE", "MATCH", "CREATE"))
+        elif isinstance(op, Op.Delete):
+            needed.add("DELETE")
+        elif isinstance(op, (Op.SetProperty, Op.SetProperties,
+                             Op.SetLabels)):
+            needed.add("SET")
+        elif isinstance(op, (Op.RemoveProperty, Op.RemoveLabels)):
+            needed.add("REMOVE")
+        elif isinstance(op, Op.CallProcedureOp):
             from .procedures.registry import global_registry
             proc = global_registry.find(op.proc_name)
-            if proc is not None and proc.is_write:
-                found = True
-                return
+            needed.add("MODULE_WRITE" if proc is not None and proc.is_write
+                       else "MODULE_READ")
         for child in op.children():
             walk(child)
 
     walk(plan)
-    return found
+    return needed
+
+
+def _plan_is_write(plan) -> bool:
+    return bool(_plan_privileges(plan) - {"MATCH", "MODULE_READ"})

@@ -214,7 +214,7 @@ class Stream:
                 # conflict-retried transaction (reference: retry interval
                 # config, memgraph.cpp:652)
                 for attempt in range(10):
-                    interp = Interpreter(self.ictx)
+                    interp = Interpreter(self.ictx, system=True)
                     try:
                         interp.execute("BEGIN")
                         for action in actions:

@@ -94,7 +94,7 @@ class TriggerStore:
             for trig in triggers:
                 if not self._event_matches(trig.event, context):
                     continue
-                interp = Interpreter(self.ictx)
+                interp = Interpreter(self.ictx, system=True)
                 try:
                     interp.execute(trig.statement, parameters=context)
                 except Exception:

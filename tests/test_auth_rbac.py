@@ -96,3 +96,44 @@ def test_roles_via_cypher(rbac):
     _, rows, _ = admin.execute("SHOW ROLES")
     assert rows == [["writers"]]
     admin.close()
+
+
+def test_fine_grained_write_privileges(rbac):
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    admin.execute("CREATE (:FG {v: 1})")
+    admin.execute("GRANT MATCH, SET TO reader")
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    c.execute("MATCH (n:FG) SET n.v = 2")  # SET granted
+    with pytest.raises(BoltClientError):
+        c.execute("MATCH (n:FG) DETACH DELETE n")  # DELETE not granted
+    c.reset()
+    with pytest.raises(BoltClientError):
+        c.execute("CREATE (:Nope)")  # CREATE not granted
+    c.close()
+    admin.close()
+
+
+def test_triggers_bypass_rbac(rbac):
+    """Triggers run as the system even when users exist."""
+    from memgraph_tpu.query.triggers import global_trigger_store
+    global_trigger_store(rbac["ictx"])
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    admin.execute("CREATE TRIGGER t ON CREATE AFTER COMMIT "
+                  "EXECUTE MERGE (c:Cnt) SET c.n = coalesce(c.n, 0) + 1")
+    admin.execute("CREATE (:Fire)")
+    _, rows, _ = admin.execute("MATCH (c:Cnt) RETURN c.n")
+    assert rows == [[1]]
+    admin.close()
+
+
+def test_grant_all_privileges_syntax(rbac):
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    admin.execute("CREATE USER power")
+    admin.execute("GRANT ALL PRIVILEGES TO power")
+    _, rows, _ = admin.execute("SHOW PRIVILEGES FOR power")
+    assert len(rows) >= 20
+    admin.close()
