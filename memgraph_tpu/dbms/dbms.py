@@ -65,8 +65,44 @@ class DbmsHandler:
             ictx.kvstore = KVStore(
                 os.path.join(cfg.durability_dir, "kvstore.db"))
             ictx.settings = Settings(ictx.kvstore)
+            self._restore_ddl(storage, ictx.kvstore)
         self._databases[name] = ictx
         return ictx
+
+    @staticmethod
+    def _restore_ddl(storage, kvstore) -> None:
+        """Re-create persisted indexes/constraints (WAL doesn't carry DDL;
+        reference restores them from its durability metadata)."""
+        import json as _json
+        for key, _ in kvstore.items_with_prefix("ddl:index:"):
+            spec = _json.loads(key[len("ddl:index:"):])
+            if spec[0] == "label":
+                storage.create_label_index(
+                    storage.label_mapper.name_to_id(spec[1]))
+            elif spec[0] == "label_property":
+                storage.create_label_property_index(
+                    storage.label_mapper.name_to_id(spec[1]),
+                    tuple(storage.property_mapper.name_to_id(p)
+                          for p in spec[2]))
+            elif spec[0] == "edge_type":
+                storage.create_edge_type_index(
+                    storage.edge_type_mapper.name_to_id(spec[1]))
+        for key, _ in kvstore.items_with_prefix("ddl:constraint:"):
+            kind, label, props, data_type = _json.loads(
+                key[len("ddl:constraint:"):])
+            lid = storage.label_mapper.name_to_id(label)
+            pids = [storage.property_mapper.name_to_id(p) for p in props]
+            try:
+                if kind == "exists":
+                    storage.create_existence_constraint(lid, pids[0])
+                elif kind == "unique":
+                    storage.create_unique_constraint(lid, tuple(pids))
+                elif kind == "type":
+                    storage.create_type_constraint(lid, pids[0], data_type)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "constraint restore failed: %s", key)
 
     # --- API (reference: New_/Get/TryDelete) --------------------------------
 

@@ -63,6 +63,7 @@ class Token:
     pos: int
     line: int
     col: int
+    raw: str | None = None   # original source text (keywords keep case)
 
     def is_kw(self, *names: str) -> bool:
         return self.type == T.KEYWORD and self.value in names
@@ -156,7 +157,7 @@ def tokenize(text: str) -> list[Token]:
             word = text[i:j]
             upper = word.upper()
             if upper in KEYWORDS:
-                tokens.append(Token(T.KEYWORD, upper, i, line, col))
+                tokens.append(Token(T.KEYWORD, upper, i, line, col, word))
             else:
                 tokens.append(Token(T.IDENT, word, i, line, col))
             i = j

@@ -81,11 +81,14 @@ class Parser:
         raise SyntaxException(f"line {tok.line}:{tok.col} {msg}")
 
     def name_token(self) -> str:
-        """Identifier or any keyword used as a name (Cypher allows both)."""
+        """Identifier or any keyword used as a name (Cypher allows both;
+        keywords keep their ORIGINAL case — `:User` must intern "User",
+        not "user", even though USER is a keyword)."""
         if self.at(T.IDENT):
             return self.advance().value
         if self.cur.type == T.KEYWORD:
-            return self.advance().value.lower()
+            tok = self.advance()
+            return tok.raw if tok.raw is not None else tok.value.lower()
         self.error(f"expected a name, got {self._desc(self.cur)}")
 
     # --- statement dispatch -------------------------------------------------

@@ -120,6 +120,9 @@ class Interpreter:
         audit = getattr(self.ctx, "audit", None)
         if audit is not None:
             audit.record(getattr(self, "username", ""), text, parameters)
+        from ..observability.metrics import global_metrics
+        global_metrics.increment("query.prepared")
+        self._query_started = time.monotonic()
         self.session_trace.emit("prepare", query=text)
         node = self.ctx.cached_parse(text)
         if isinstance(node, A.SessionTraceQuery):
@@ -578,8 +581,17 @@ class Interpreter:
     def _finish_stream(self) -> dict:
         summary = {}
         self.session_trace.emit("finish")
+        from ..observability.metrics import global_metrics
+        global_metrics.increment("query.finished")
+        started = getattr(self, "_query_started", None)
+        if started is not None:
+            global_metrics.observe("query.execution_latency_sec",
+                                   time.monotonic() - started)
         if self._exec_ctx is not None:
             summary["stats"] = dict(self._exec_ctx.stats)
+            for key, value in self._exec_ctx.stats.items():
+                if value:
+                    global_metrics.increment(f"storage.{key}", value)
         if self._stream_owns_txn and self._stream_accessor is not None:
             self._stream_accessor.commit()
         self._stream = None
@@ -606,17 +618,31 @@ class Interpreter:
 
     # --- DDL ----------------------------------------------------------------
 
+    def _persist_ddl(self, kind: str, key: str, create: bool) -> None:
+        """Record index/constraint DDL in the kvstore so WAL-only restarts
+        restore it (snapshots carry it too; kvstore covers the gap)."""
+        kv = getattr(self.ctx, "kvstore", None)
+        if kv is None:
+            return
+        if create:
+            kv.put(f"ddl:{kind}:{key}", b"1")
+        else:
+            kv.delete(f"ddl:{kind}:{key}")
+
     def _run_index_query(self, node: A.IndexQuery):
         storage = self.ctx.storage
         if self._in_explicit_txn:
             raise TransactionException(
                 "index operations are not allowed in explicit transactions")
+        import json as _json
         if node.kind == "label":
             lid = storage.label_mapper.name_to_id(node.label)
             if node.action == "create":
                 storage.create_label_index(lid)
             else:
                 storage.indices.label.drop(lid)
+            self._persist_ddl("index", _json.dumps(["label", node.label]),
+                              node.action == "create")
         elif node.kind == "label_property":
             lid = storage.label_mapper.name_to_id(node.label)
             pids = tuple(storage.property_mapper.name_to_id(p)
@@ -625,12 +651,20 @@ class Interpreter:
                 storage.create_label_property_index(lid, pids)
             else:
                 storage.indices.label_property.drop(lid, pids)
+            self._persist_ddl(
+                "index",
+                _json.dumps(["label_property", node.label,
+                             list(node.properties)]),
+                node.action == "create")
         elif node.kind == "edge_type":
             tid = storage.edge_type_mapper.name_to_id(node.edge_type)
             if node.action == "create":
                 storage.create_edge_type_index(tid)
             else:
                 storage.indices.edge_type.drop(tid)
+            self._persist_ddl("index",
+                              _json.dumps(["edge_type", node.edge_type]),
+                              node.action == "create")
         self.ctx.invalidate_plans()
         yield [f"Index {node.action}d."]
 
@@ -640,6 +674,7 @@ class Interpreter:
             raise TransactionException(
                 "constraint operations are not allowed in explicit "
                 "transactions")
+        import json as _json
         lid = storage.label_mapper.name_to_id(node.label)
         pids = [storage.property_mapper.name_to_id(p)
                 for p in node.properties]
@@ -658,6 +693,11 @@ class Interpreter:
                 storage.create_type_constraint(lid, pids[0], node.data_type)
             else:
                 storage.constraints.type.drop(lid, pids[0])
+        self._persist_ddl(
+            "constraint",
+            _json.dumps([node.kind, node.label, list(node.properties),
+                         node.data_type]),
+            node.action == "create")
         yield [f"Constraint {node.action}d."]
 
     # --- info / admin -------------------------------------------------------

@@ -88,3 +88,41 @@ def test_database_settings_cypher(tmp_path):
     interp2 = Interpreter(dbms2.default())
     _, rows, _ = interp2.execute('SHOW DATABASE SETTING "log.level"')
     assert rows == [["log.level", "DEBUG"]]
+
+
+def test_index_and_constraint_ddl_survive_wal_restart(tmp_path):
+    """DDL restores from the kvstore when only WAL (no snapshot) exists."""
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    dbms = DbmsHandler(cfg)
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE INDEX ON :P(name)")
+    interp.execute("CREATE CONSTRAINT ON (n:P) ASSERT n.name IS UNIQUE")
+    interp.execute("CREATE (:P {name: 'x'})")
+
+    dbms2 = DbmsHandler(cfg)
+    interp2 = Interpreter(dbms2.default())
+    _, rows, _ = interp2.execute("SHOW INDEX INFO")
+    assert any(r[0] == "label+property" for r in rows)
+    _, rows, _ = interp2.execute("SHOW CONSTRAINT INFO")
+    assert rows and rows[0][0] == "unique"
+    from memgraph_tpu.exceptions import ConstraintViolation
+    with pytest.raises(ConstraintViolation):
+        interp2.execute("CREATE (:P {name: 'x'})")
+    # dropped DDL stays dropped
+    interp2.execute("DROP INDEX ON :P(name)")
+    dbms3 = DbmsHandler(cfg)
+    interp3 = Interpreter(dbms3.default())
+    _, rows, _ = interp3.execute("SHOW INDEX INFO")
+    assert not any(r[0] == "label+property" for r in rows)
+
+
+def test_keyword_named_labels_and_properties(tmp_path):
+    """Regression: names colliding with keywords (User, key, type, point)
+    must keep their case and identity through parse/intern."""
+    dbms = DbmsHandler()
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE (:User {key: 1, type: 'x', point: 2, count: 3})")
+    _, rows, _ = interp.execute(
+        "MATCH (n:User) RETURN n.key, n.type, n.point, n.count")
+    assert rows == [[1, "x", 2, 3]]
+    assert "User" in dbms.default().storage.label_mapper.all_names()
