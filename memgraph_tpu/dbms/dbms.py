@@ -65,15 +65,58 @@ class DbmsHandler:
             ictx.kvstore = KVStore(
                 os.path.join(cfg.durability_dir, "kvstore.db"))
             ictx.settings = Settings(ictx.kvstore)
-            self._restore_ddl(storage, ictx.kvstore)
+            if self._recover:
+                self._restore_ddl(storage, ictx.kvstore)
         self._databases[name] = ictx
         return ictx
 
     @staticmethod
     def _restore_ddl(storage, kvstore) -> None:
-        """Re-create persisted indexes/constraints (WAL doesn't carry DDL;
-        reference restores them from its durability metadata)."""
+        """Make the kvstore the authoritative DDL set: re-create persisted
+        indexes/constraints AND drop any that the snapshot restored but the
+        kvstore no longer lists (a drop after the last snapshot must win)."""
         import json as _json
+        index_keys = set()
+        for key, _ in kvstore.items_with_prefix("ddl:index:"):
+            index_keys.add(tuple(_json.loads(key[len("ddl:index:"):])[:1])
+                           + (key,))
+        # reconcile drops first (only when DDL persistence has ever run —
+        # a directory predating the feature keeps its snapshot DDL)
+        has_any = kvstore.get("ddl:enabled") is not None
+        if has_any:
+            lm, pm, tm = (storage.label_mapper, storage.property_mapper,
+                          storage.edge_type_mapper)
+            listed = {key[len("ddl:index:"):]
+                      for key, _ in kvstore.items_with_prefix("ddl:index:")}
+            for lid in list(storage.indices.label.labels()):
+                if _json.dumps(["label", lm.id_to_name(lid)]) not in listed:
+                    storage.indices.label.drop(lid)
+            for (lid, pids) in list(storage.indices.label_property.keys()):
+                spec = _json.dumps(["label_property", lm.id_to_name(lid),
+                                    [pm.id_to_name(p) for p in pids]])
+                if spec not in listed:
+                    storage.indices.label_property.drop(lid, pids)
+            for tid in list(storage.indices.edge_type.types()):
+                if _json.dumps(["edge_type", tm.id_to_name(tid)])                         not in listed:
+                    storage.indices.edge_type.drop(tid)
+            listed_c = {key[len("ddl:constraint:"):]
+                        for key, _ in
+                        kvstore.items_with_prefix("ddl:constraint:")}
+            for (lid, pid) in list(storage.constraints.existence.all()):
+                spec = _json.dumps(["exists", lm.id_to_name(lid),
+                                    [pm.id_to_name(pid)]])
+                if spec not in listed_c:
+                    storage.constraints.existence.drop(lid, pid)
+            for (lid, pids) in list(storage.constraints.unique.all()):
+                spec = _json.dumps(["unique", lm.id_to_name(lid),
+                                    [pm.id_to_name(p) for p in pids]])
+                if spec not in listed_c:
+                    storage.constraints.unique.drop(lid, tuple(pids))
+            for (lid, pid, tname) in list(storage.constraints.type.all()):
+                spec = _json.dumps(["type", lm.id_to_name(lid),
+                                    [pm.id_to_name(pid)]])
+                if spec not in listed_c:
+                    storage.constraints.type.drop(lid, pid)
         for key, _ in kvstore.items_with_prefix("ddl:index:"):
             spec = _json.loads(key[len("ddl:index:"):])
             if spec[0] == "label":
@@ -87,9 +130,9 @@ class DbmsHandler:
             elif spec[0] == "edge_type":
                 storage.create_edge_type_index(
                     storage.edge_type_mapper.name_to_id(spec[1]))
-        for key, _ in kvstore.items_with_prefix("ddl:constraint:"):
-            kind, label, props, data_type = _json.loads(
-                key[len("ddl:constraint:"):])
+        for key, raw in kvstore.items_with_prefix("ddl:constraint:"):
+            kind, label, props = _json.loads(key[len("ddl:constraint:"):])
+            data_type = raw.decode("utf-8")
             lid = storage.label_mapper.name_to_id(label)
             pids = [storage.property_mapper.name_to_id(p) for p in props]
             try:

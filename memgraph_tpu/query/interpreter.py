@@ -584,6 +584,7 @@ class Interpreter:
         from ..observability.metrics import global_metrics
         global_metrics.increment("query.finished")
         started = getattr(self, "_query_started", None)
+        self._query_started = None
         if started is not None:
             global_metrics.observe("query.execution_latency_sec",
                                    time.monotonic() - started)
@@ -601,6 +602,7 @@ class Interpreter:
         return summary
 
     def _cleanup_stream(self, error: bool = False) -> None:
+        self._query_started = None
         if self._stream_owns_txn and self._stream_accessor is not None:
             self._stream_accessor.abort()
         self._stream = None
@@ -618,14 +620,17 @@ class Interpreter:
 
     # --- DDL ----------------------------------------------------------------
 
-    def _persist_ddl(self, kind: str, key: str, create: bool) -> None:
-        """Record index/constraint DDL in the kvstore so WAL-only restarts
-        restore it (snapshots carry it too; kvstore covers the gap)."""
+    def _persist_ddl(self, kind: str, key: str, create: bool,
+                     value: str = "1") -> None:
+        """Record index/constraint DDL in the kvstore — the authoritative
+        DDL set at startup (snapshots carry DDL too, but drops after the
+        last snapshot must win)."""
         kv = getattr(self.ctx, "kvstore", None)
         if kv is None:
             return
+        kv.put("ddl:enabled", "1")  # marker: kvstore is DDL-authoritative
         if create:
-            kv.put(f"ddl:{kind}:{key}", b"1")
+            kv.put(f"ddl:{kind}:{key}", value or "1")
         else:
             kv.delete(f"ddl:{kind}:{key}")
 
@@ -693,11 +698,13 @@ class Interpreter:
                 storage.create_type_constraint(lid, pids[0], node.data_type)
             else:
                 storage.constraints.type.drop(lid, pids[0])
+        # data_type stays OUT of the key (drop matches on (label, props));
+        # normalize it into the stored value instead
         self._persist_ddl(
             "constraint",
-            _json.dumps([node.kind, node.label, list(node.properties),
-                         node.data_type]),
-            node.action == "create")
+            _json.dumps([node.kind, node.label, list(node.properties)]),
+            node.action == "create",
+            value=(node.data_type or "").upper())
         yield [f"Constraint {node.action}d."]
 
     # --- info / admin -------------------------------------------------------

@@ -126,3 +126,36 @@ def test_keyword_named_labels_and_properties(tmp_path):
         "MATCH (n:User) RETURN n.key, n.type, n.point, n.count")
     assert rows == [[1, "x", 2, 3]]
     assert "User" in dbms.default().storage.label_mapper.all_names()
+
+
+def test_ddl_drop_wins_over_snapshot(tmp_path):
+    """An index dropped AFTER the last snapshot must stay dropped."""
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    dbms = DbmsHandler(cfg)
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE INDEX ON :Q(name)")
+    interp.execute("CREATE SNAPSHOT")
+    interp.execute("DROP INDEX ON :Q(name)")
+    dbms2 = DbmsHandler(cfg)
+    _, rows, _ = Interpreter(dbms2.default()).execute("SHOW INDEX INFO")
+    assert not any(r[0] == "label+property" for r in rows)
+
+
+def test_type_constraint_drop_case_insensitive_persist(tmp_path):
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    dbms = DbmsHandler(cfg)
+    interp = Interpreter(dbms.default())
+    interp.execute("CREATE CONSTRAINT ON (n:P) ASSERT n.a IS TYPED STRING")
+    interp.execute("DROP CONSTRAINT ON (n:P) ASSERT n.a IS TYPED string")
+    dbms2 = DbmsHandler(cfg)
+    _, rows, _ = Interpreter(dbms2.default()).execute("SHOW CONSTRAINT INFO")
+    assert rows == []  # must NOT resurrect
+
+
+def test_restore_ddl_respects_recover_flag(tmp_path):
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    dbms = DbmsHandler(cfg)
+    Interpreter(dbms.default()).execute("CREATE INDEX ON :R(name)")
+    dbms2 = DbmsHandler(cfg, recover_on_startup=False)
+    _, rows, _ = Interpreter(dbms2.default()).execute("SHOW INDEX INFO")
+    assert rows == []
