@@ -620,6 +620,93 @@ def fn_tocharlist(ev, args):
     return list(_str("toCharList", args[0]))
 
 
+# --- conversions: *OrNull / *List / container helpers ------------------------
+
+@register("isempty", 1, 1)
+def fn_isempty(ev, args):
+    v = args[0]
+    if isinstance(v, (str, list, tuple, dict)):
+        return len(v) == 0
+    raise TypeException("isEmpty() requires a string, list or map")
+
+
+def _or_null(conv):
+    def inner(ev, args):
+        try:
+            return conv(ev, args)
+        except Exception:
+            return None
+    return inner
+
+
+register("tointegerornull", 1, 1)(_or_null(fn_tointeger))
+register("tofloatornull", 1, 1)(_or_null(fn_tofloat))
+register("tobooleanornull", 1, 1)(_or_null(fn_toboolean))
+register("tostringornull", 1, 1)(_or_null(fn_tostring))
+
+
+def _list_conv(name, elem_fn):
+    @register(name, 1, 1)
+    def inner(ev, args, _fn=elem_fn):
+        lst = _list(name, args[0])
+        out = []
+        for item in lst:
+            if item is None:
+                out.append(None)
+                continue
+            try:
+                out.append(_fn(ev, [item]))
+            except Exception:
+                out.append(None)
+        return out
+    return inner
+
+
+_list_conv("tointegerlist", fn_tointeger)
+_list_conv("tofloatlist", fn_tofloat)
+_list_conv("tobooleanlist", fn_toboolean)
+_list_conv("tostringlist", fn_tostring)
+
+
+@register("toset", 1, 1)
+def fn_toset(ev, args):
+    lst = _list("toSet", args[0])
+    seen = set()
+    out = []
+    for item in lst:
+        key = V.hashable_key(item)
+        if key not in seen:
+            seen.add(key)
+            out.append(item)
+    return out
+
+
+@register("values", 1, 1)
+def fn_values(ev, args):
+    v = args[0]
+    if isinstance(v, dict):
+        return list(v.values())
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        return list(v.properties(ev.ctx.view).values())
+    raise TypeException("values() requires a map, node or relationship")
+
+
+@register("username", 0, 0, propagate_null=False)
+def fn_username(ev, args):
+    # bound by the session; null on embedded/anonymous use
+    return getattr(ev.ctx, "username", None) or None
+
+
+@register("gethopscounter", 0, 0, propagate_null=False)
+def fn_gethopscounter(ev, args):
+    """Edge visits consumed so far under USING HOPS LIMIT (reference:
+    query/hops_limit.hpp counter surface)."""
+    exec_ctx = getattr(ev.ctx, "exec_ctx", None)
+    if exec_ctx is not None and exec_ctx.hops_budget is not None:
+        return getattr(exec_ctx, "hops_initial", 0) - exec_ctx.hops_budget
+    return 0
+
+
 # --- ids / misc --------------------------------------------------------------
 
 @register("randomuuid", 0, 0, propagate_null=False)

@@ -532,6 +532,7 @@ class Interpreter:
 
         exec_ctx = ExecutionContext(accessor, parameters,
                                     View.NEW, self.ctx, timeout_checker)
+        exec_ctx.eval_ctx.username = self.username
         self._exec_ctx = exec_ctx
 
         if query.profile:

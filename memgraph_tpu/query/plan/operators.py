@@ -34,6 +34,7 @@ class ExecutionContext:
         self.parameters = parameters or {}
         self.view = view
         self.eval_ctx = EvalContext(accessor, self.parameters, view)
+        self.eval_ctx.exec_ctx = self  # functions needing execution state
         self.evaluator = Evaluator(self.eval_ctx)
         self.interpreter_context = interpreter_context
         self.timeout_checker = timeout_checker
@@ -950,6 +951,7 @@ class SetHopsLimit(LogicalOperator):
 
     def cursor(self, ctx):
         ctx.hops_budget = self.limit
+        ctx.hops_initial = self.limit
         yield from self.input.cursor(ctx)
 
 

@@ -786,6 +786,11 @@ def _expr_name(expr: A.Expr) -> str:
     if isinstance(expr, A.IsNull):
         return (f"{_expr_name(expr.expr)} IS "
                 f"{'NOT ' if expr.negated else ''}NULL")
+    if isinstance(expr, A.ListLiteral):
+        return "[" + ", ".join(_expr_name(i) for i in expr.items) + "]"
+    if isinstance(expr, A.MapLiteral):
+        return "{" + ", ".join(f"{k}: {_expr_name(v)}"
+                               for k, v in expr.items.items()) + "}"
     return "expression"
 
 

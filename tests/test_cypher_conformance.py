@@ -210,3 +210,29 @@ def test_deeply_nested_expression(db):
     rows = run(db, "RETURN size([x IN range(1, 3) | "
                    "[y IN range(1, x) WHERE y % 2 = 1 | y * x]]) AS s")
     assert rows == [[3]]
+
+
+def test_conversion_families(db):
+    rows = run(db, "RETURN toIntegerList(['1', 'x', 2.7, null]), "
+                   "toFloatList(['1.5', 'bad']), "
+                   "toBooleanList(['true', 'nope', 1]), "
+                   "toStringList([1, 2.5, true]), "
+                   "toIntegerOrNull('oops'), toFloatOrNull('2.5'), "
+                   "toBooleanOrNull([1]), toStringOrNull(7)")
+    assert rows == [[[1, None, 2, None], [1.5, None], [True, None, True],
+                     ["1", "2.5", "true"], None, 2.5, None, "7"]]
+
+
+def test_isempty_toset_values(db):
+    rows = run(db, "RETURN isEmpty([]), isEmpty('x'), isEmpty({}), "
+                   "toSet([1, 1.0, 2, 1]), values({a: 1, b: 2})")
+    assert rows == [[True, False, True, [1, 2], [1, 2]]]
+
+
+def test_username_and_hops_counter(db):
+    rows = run(db, "RETURN username()")
+    assert rows == [[None]]  # anonymous embedded session
+    run(db, "CREATE (:H)-[:E]->(:H)")
+    rows = run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 100 "
+                   "RETURN getHopsCounter() > 0 LIMIT 1")
+    assert rows == [[True]]
