@@ -161,3 +161,39 @@ def test_replica_promote_to_main(cluster):
     replica.execute("CREATE (:Data {v: 2})")  # writes now allowed
     rows = _rows(replica, "MATCH (n:Data) RETURN n.v ORDER BY n.v")
     assert rows == [[1], [2]]
+
+
+def test_replica_churn_under_load(cluster):
+    """Nemesis: replica restarts mid-load; a re-registered replica catches
+    up completely (no lost or phantom rows)."""
+    main = cluster["main"]
+    main.execute(
+        f"REGISTER REPLICA r1 SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    for i in range(20):
+        main.execute(f"CREATE (:Churn {{i: {i}}})")
+    # kill the replica server mid-stream
+    cluster["replica_ictx"].replication.replica_server.stop()
+    for i in range(20, 35):
+        try:
+            main.execute(f"CREATE (:Churn {{i: {i}}})")
+        except Exception:
+            pass  # sync failures tolerated while the replica is down
+    # replica returns on a fresh port; drop + re-register triggers catch-up
+    import socket as socketlib
+    s = socketlib.socket()
+    s.bind(("127.0.0.1", 0))
+    new_port = s.getsockname()[1]
+    s.close()
+    cluster["replica"].execute(
+        f"SET REPLICATION ROLE TO REPLICA WITH PORT {new_port}")
+    main.execute("DROP REPLICA r1")
+    main.execute(
+        f"REGISTER REPLICA r1 SYNC TO \"127.0.0.1:{new_port}\"")
+    for i in range(35, 40):
+        main.execute(f"CREATE (:Churn {{i: {i}}})")
+    _, main_rows, _ = main.execute("MATCH (n:Churn) RETURN count(n)")
+    _, rep_rows, _ = cluster["replica"].execute(
+        "MATCH (n:Churn) RETURN count(n)")
+    assert rep_rows == main_rows  # exact convergence after catch-up
+    rows = cluster["main"].execute("SHOW REPLICAS")[1]
+    assert rows[0][4] == "ready"
