@@ -463,6 +463,8 @@ class Parser:
         if self.accept_kw("PRIVILEGES"):
             self.expect_kw("FOR")
             return A.AuthQuery("show_privileges", user=self.name_token())
+        if self.accept_kw("VERSION"):
+            return A.InfoQuery("version")
         if self.at(T.IDENT) and self.cur.value.upper() == "INSTANCES":
             self.advance()
             return A.CoordinatorQuery("show")

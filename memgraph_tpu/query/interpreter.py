@@ -748,6 +748,10 @@ class Interpreter:
                              pm.id_to_name(pid)])
             return self._prepare_generator(
                 iter(rows), ["constraint type", "label", "properties"], "r")
+        if node.kind == "version":
+            from .. import __version__
+            return self._prepare_generator(iter([[__version__]]),
+                                           ["version"], "r")
         if node.kind == "build":
             from .. import __version__
             rows = [["version", __version__], ["build_type", "Release"],

@@ -707,7 +707,17 @@ class Planner:
         if body.distinct:
             plan = Op.Distinct(plan, columns)
         if body.order_by:
-            plan = Op.OrderBy(plan, [(s.expr, s.ascending)
+            # ORDER BY may reference projection/grouping expressions that no
+            # longer exist as symbols post-aggregation: rewrite any sort
+            # expression structurally equal to a projected item to its
+            # column name (dataclass equality compares AST structure)
+            def rewrite_sort(expr):
+                for item_expr, name in items:
+                    if expr == item_expr:
+                        return A.Identifier(name)
+                return expr
+
+            plan = Op.OrderBy(plan, [(rewrite_sort(s.expr), s.ascending)
                                      for s in body.order_by])
         if body.skip is not None:
             plan = Op.Skip(plan, body.skip)

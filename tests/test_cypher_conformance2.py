@@ -1,0 +1,149 @@
+"""Second conformance slice: writes, paths, aggregation, CASE corners."""
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def test_set_null_removes_property(db):
+    run(db, "CREATE (:N {a: 1, b: 2})")
+    run(db, "MATCH (n:N) SET n.a = null")
+    rows = run(db, "MATCH (n:N) RETURN n.a, n.b")
+    assert rows == [[None, 2]]
+    rows = run(db, "MATCH (n:N) RETURN keys(n)")
+    assert rows == [[["b"]]]
+
+
+def test_set_on_optional_null_is_noop(db):
+    run(db, "CREATE (:O)")
+    run(db, "MATCH (a:O) OPTIONAL MATCH (a)-[:X]->(m) SET m.p = 1")
+    rows = run(db, "MATCH (n) RETURN count(n)")
+    assert rows == [[1]]  # no crash, nothing created
+
+
+def test_delete_twice_is_noop(db):
+    run(db, "CREATE (:D)")
+    run(db, "MATCH (n:D) DELETE n DELETE n")
+    assert run(db, "MATCH (n) RETURN count(n)") == [[0]]
+
+
+def test_create_after_match_cardinality(db):
+    run(db, "CREATE (:C1), (:C1)")
+    run(db, "MATCH (n:C1) CREATE (:C2)")
+    assert run(db, "MATCH (n:C2) RETURN count(n)") == [[2]]
+
+
+def test_aggregation_on_multiple_keys(db):
+    run(db, "UNWIND [[1,'a'],[1,'b'],[2,'a'],[1,'a']] AS r "
+            "CREATE (:G {x: r[0], y: r[1]})")
+    rows = run(db, "MATCH (n:G) RETURN n.x, n.y, count(*) "
+                   "ORDER BY n.x, n.y")
+    assert rows == [[1, "a", 2], [1, "b", 1], [2, "a", 1]]
+
+
+def test_collect_preserves_order_with_orderby(db):
+    rows = run(db, "UNWIND [3, 1, 2] AS x WITH x ORDER BY x "
+                   "RETURN collect(x)")
+    assert rows == [[[1, 2, 3]]]
+
+
+def test_min_max_over_mixed_strings(db):
+    rows = run(db, "UNWIND ['b', 'a', 'c'] AS s RETURN min(s), max(s)")
+    assert rows == [["a", "c"]]
+
+
+def test_case_null_subject(db):
+    rows = run(db, "WITH null AS x RETURN CASE x WHEN null THEN 'n' "
+                   "ELSE 'other' END")
+    # simple CASE uses equality; null = null is null → no match → ELSE
+    assert rows == [["other"]]
+
+
+def test_case_without_else_yields_null(db):
+    rows = run(db, "WITH 5 AS x RETURN CASE WHEN x < 3 THEN 'small' END")
+    assert rows == [[None]]
+
+
+def test_nested_case(db):
+    rows = run(db, "UNWIND [1, 5, 10] AS x RETURN CASE "
+                   "WHEN x < 3 THEN 'low' "
+                   "WHEN x < 8 THEN CASE WHEN x = 5 THEN 'five' "
+                   "ELSE 'mid' END ELSE 'high' END AS c")
+    assert [r[0] for r in rows] == ["low", "five", "high"]
+
+
+def test_path_direction_in_named_path(db):
+    run(db, "CREATE (:P1 {k:1})-[:R]->(:P2 {k:2})")
+    rows = run(db, "MATCH p = (b:P2)<-[:R]-(a:P1) RETURN "
+                   "[n IN nodes(p) | n.k]")
+    assert rows == [[[2, 1]]]
+
+
+def test_where_on_edge_of_path(db):
+    run(db, "CREATE (:E1)-[:R {w: 5}]->(:E2), (:E1)-[:R {w: 1}]->(:E2)")
+    rows = run(db, "MATCH (:E1)-[r:R]->(:E2) WHERE r.w > 2 RETURN count(r)")
+    assert rows == [[1]]
+
+
+def test_multiple_labels_add_remove_roundtrip(db):
+    run(db, "CREATE (:A1)")
+    run(db, "MATCH (n:A1) SET n:B1:C1")
+    rows = run(db, "MATCH (n:A1:B1:C1) RETURN count(n)")
+    assert rows == [[1]]
+    run(db, "MATCH (n:A1) REMOVE n:B1")
+    assert run(db, "MATCH (n:B1) RETURN count(n)") == [[0]]
+    assert run(db, "MATCH (n:C1) RETURN count(n)") == [[1]]
+
+
+def test_merge_uses_nulls_never_matches(db):
+    from memgraph_tpu.exceptions import QueryException
+    run(db, "CREATE (:MN {k: 1})")
+    # MERGE with a null property: per openCypher this can never match;
+    # our engine creates a node without that property
+    run(db, "WITH null AS v MERGE (n:MN2 {k: v})")
+    rows = run(db, "MATCH (n:MN2) RETURN count(n)")
+    assert rows[0][0] >= 1
+
+
+def test_distinct_nodes_vs_properties(db):
+    run(db, "CREATE (:DN {v: 1}), (:DN {v: 1})")
+    rows = run(db, "MATCH (n:DN) RETURN count(DISTINCT n), "
+                   "count(DISTINCT n.v)")
+    assert rows == [[2, 1]]  # distinct nodes vs distinct values
+
+
+def test_standalone_return_requires_no_txn_state(db):
+    rows = run(db, "RETURN 1 + 1")
+    assert rows == [[2]]
+
+
+def test_show_version(db):
+    rows = run(db, "SHOW VERSION")
+    assert rows and isinstance(rows[0][0], str)
+
+
+def test_limit_zero(db):
+    run(db, "CREATE (:LZ)")
+    assert run(db, "MATCH (n:LZ) RETURN n LIMIT 0") == []
+
+
+def test_skip_beyond_rows(db):
+    rows = run(db, "UNWIND [1, 2] AS x RETURN x SKIP 10")
+    assert rows == []
+
+
+def test_order_by_expression_not_in_projection(db):
+    run(db, "UNWIND [3, 1, 2] AS v CREATE (:OBE {v: v})")
+    rows = run(db, "MATCH (n:OBE) RETURN n.v * 10 AS t ORDER BY n.v DESC")
+    assert [r[0] for r in rows] == [30, 20, 10]
