@@ -294,8 +294,9 @@ class CallProcedure(Clause):
 
 @dataclass
 class CallSubquery(Clause):
-    """CALL { <single query> } — correlated subquery per input row."""
+    """CALL { <single query> } [IN TRANSACTIONS OF n ROWS]."""
     query: "SingleQuery"
+    batch_rows: Optional[int] = None
 
 
 @dataclass

@@ -888,7 +888,19 @@ class Parser:
             self.advance()
             sub = self.parse_single_query()
             self.expect("}")
-            return A.CallSubquery(sub)
+            batch_rows = None
+            if self.accept_kw("IN"):
+                self.expect_kw("TRANSACTIONS")
+                if self.accept_kw("OF"):
+                    batch_rows = self.expect(T.INT).value
+                    if not (self.at(T.IDENT)
+                            and self.cur.value.upper() == "ROWS") \
+                            and not self.at_kw("ROW"):
+                        self.error("expected ROWS after the batch size")
+                    self.advance()
+                else:
+                    batch_rows = 1
+            return A.CallSubquery(sub, batch_rows)
         parts = [self.name_token()]
         while self.accept("."):
             parts.append(self.name_token())

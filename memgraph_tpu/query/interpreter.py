@@ -533,6 +533,8 @@ class Interpreter:
         exec_ctx = ExecutionContext(accessor, parameters,
                                     View.NEW, self.ctx, timeout_checker)
         exec_ctx.eval_ctx.username = self.username
+        if owns:
+            exec_ctx._txn_owner = _TxnOwner(self, exec_ctx)
         self._exec_ctx = exec_ctx
 
         if query.profile:
@@ -924,6 +926,24 @@ class Interpreter:
         self._install_stream(iterator, None, False)
         self._prepared = PreparedQuery(columns, 0, summary_type)
         return self._prepared
+
+
+class _TxnOwner:
+    """Lets CALL { } IN TRANSACTIONS batch-commit an autocommit query:
+    commits the current accessor and swaps in a fresh one mid-stream."""
+
+    def __init__(self, interp: "Interpreter", exec_ctx) -> None:
+        self._interp = interp
+        self._exec_ctx = exec_ctx
+
+    def renew(self) -> None:
+        interp = self._interp
+        exec_ctx = self._exec_ctx
+        exec_ctx.accessor.commit()
+        new_acc = interp.ctx.storage.access(interp._pick_isolation())
+        exec_ctx.accessor = new_acc
+        exec_ctx.eval_ctx.accessor = new_acc
+        interp._stream_accessor = new_acc
 
 
 def _parse_period(text: str) -> float:

@@ -1285,15 +1285,29 @@ class CallProcedureOp(LogicalOperator):
 @dataclass
 class Apply(LogicalOperator):
     """CALL { subquery }: run the subplan per input row; merge returned
-    columns (or pass rows through for unit subqueries)."""
+    columns (or pass rows through for unit subqueries).
+
+    batch_rows (CALL { } IN TRANSACTIONS OF n ROWS): commit the enclosing
+    autocommit transaction and open a fresh one every n input rows —
+    periodic-commit batching for huge loads (reference: PeriodicCommit,
+    plan/operator.hpp). Restriction: frames crossing the batch boundary
+    must not carry graph values (their accessors die with the committed
+    transaction); the operator enforces this with a clear error.
+    """
     input: LogicalOperator
     subplan: LogicalOperator
     columns: list[str]
+    batch_rows: Optional[int] = None
 
     def cursor(self, ctx):
+        since_commit = 0
         for frame in self.input.cursor(ctx):
             ctx.check_abort()
+            if self.batch_rows and since_commit >= self.batch_rows:
+                self._renew_transaction(ctx, frame)
+                since_commit = 0
             sub_rows = _run_subplan(self.subplan, ctx, frame)
+            since_commit += 1
             if not self.columns:
                 yield frame  # unit subquery: cardinality preserved
                 continue
@@ -1303,6 +1317,22 @@ class Apply(LogicalOperator):
                 for col in self.columns:
                     merged[col] = row.get(col, sub.get(col))
                 yield merged
+
+    @staticmethod
+    def _renew_transaction(ctx, frame) -> None:
+        for key, value in frame.items():
+            if key.startswith("__"):
+                continue
+            if isinstance(value, (VertexAccessor, EdgeAccessor, Path)):
+                raise QueryException(
+                    "CALL { } IN TRANSACTIONS cannot carry graph values "
+                    f"({key}) across the batch boundary — project scalar "
+                    "values (ids, properties) before the CALL instead")
+        if getattr(ctx, "_txn_owner", None) is None:
+            raise QueryException(
+                "CALL { } IN TRANSACTIONS requires an implicit "
+                "(autocommit) transaction")
+        ctx._txn_owner.renew()
 
     def children(self):
         return [self.input, self.subplan]

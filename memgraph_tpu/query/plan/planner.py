@@ -139,7 +139,8 @@ class Planner:
                     clause.query, leaf=Op.Argument(), initial_bound=bound)
                 if _single_has_update(clause.query):
                     has_update = True
-                plan = Op.Apply(plan, sub_plan, sub_cols)
+                plan = Op.Apply(plan, sub_plan, sub_cols,
+                                getattr(clause, "batch_rows", None))
                 bound.update(sub_cols)
             elif isinstance(clause, A.CallProcedure):
                 plan = self.plan_call(clause, plan, bound)

