@@ -891,15 +891,15 @@ class Parser:
             batch_rows = None
             if self.accept_kw("IN"):
                 self.expect_kw("TRANSACTIONS")
-                if self.accept_kw("OF"):
-                    batch_rows = self.expect(T.INT).value
-                    if not (self.at(T.IDENT)
-                            and self.cur.value.upper() == "ROWS") \
-                            and not self.at_kw("ROW"):
-                        self.error("expected ROWS after the batch size")
-                    self.advance()
-                else:
-                    batch_rows = 1
+                self.expect_kw("OF")  # reference grammar: OF n ROWS required
+                batch_rows = self.expect(T.INT).value
+                if batch_rows < 1:
+                    self.error("IN TRANSACTIONS batch size must be >= 1")
+                if not (self.at(T.IDENT)
+                        and self.cur.value.upper() == "ROWS") \
+                        and not self.at_kw("ROW"):
+                    self.error("expected ROWS after the batch size")
+                self.advance()
             return A.CallSubquery(sub, batch_rows)
         parts = [self.name_token()]
         while self.accept("."):

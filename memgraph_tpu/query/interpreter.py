@@ -496,6 +496,10 @@ class Interpreter:
             strip = strip.split(None, 1)[1] if " " in strip else strip
         plan, columns = self.ctx.cached_plan(strip, query)
 
+        if self._in_explicit_txn and _plan_has_batched_apply(plan):
+            raise TransactionException(
+                "CALL { } IN TRANSACTIONS is not allowed inside an "
+                "explicit transaction")
         needed = _plan_privileges(plan)
         for privilege in sorted(needed):
             self._check_privilege(privilege)
@@ -960,6 +964,24 @@ def _parse_period(text: str) -> float:
 def _chain_front(first_row, rest):
     yield first_row
     yield from rest
+
+
+def _plan_has_batched_apply(plan) -> bool:
+    from .plan import operators as Op
+    found = False
+
+    def walk(op):
+        nonlocal found
+        if op is None or found:
+            return
+        if isinstance(op, Op.Apply) and op.batch_rows:
+            found = True
+            return
+        for child in op.children():
+            walk(child)
+
+    walk(plan)
+    return found
 
 
 def _plan_privileges(plan) -> set:

@@ -1303,9 +1303,11 @@ class Apply(LogicalOperator):
         since_commit = 0
         for frame in self.input.cursor(ctx):
             ctx.check_abort()
-            if self.batch_rows and since_commit >= self.batch_rows:
-                self._renew_transaction(ctx, frame)
-                since_commit = 0
+            if self.batch_rows:
+                self._guard_frame(frame, "input row")
+                if since_commit >= self.batch_rows:
+                    self._renew_transaction(ctx)
+                    since_commit = 0
             sub_rows = _run_subplan(self.subplan, ctx, frame)
             since_commit += 1
             if not self.columns:
@@ -1316,18 +1318,38 @@ class Apply(LogicalOperator):
                 merged = dict(frame)
                 for col in self.columns:
                     merged[col] = row.get(col, sub.get(col))
+                if self.batch_rows:
+                    # subquery outputs may outlive this batch's transaction
+                    # downstream — graph values would silently go stale
+                    self._guard_frame({c: merged[c] for c in self.columns},
+                                      "subquery result")
                 yield merged
 
     @staticmethod
-    def _renew_transaction(ctx, frame) -> None:
+    def _contains_graph_value(value) -> bool:
+        if isinstance(value, (VertexAccessor, EdgeAccessor, Path)):
+            return True
+        if isinstance(value, (list, tuple)):
+            return any(Apply._contains_graph_value(v) for v in value)
+        if isinstance(value, dict):
+            return any(Apply._contains_graph_value(v)
+                       for v in value.values())
+        return False
+
+    @staticmethod
+    def _guard_frame(frame: dict, where: str) -> None:
         for key, value in frame.items():
             if key.startswith("__"):
                 continue
-            if isinstance(value, (VertexAccessor, EdgeAccessor, Path)):
+            if Apply._contains_graph_value(value):
                 raise QueryException(
                     "CALL { } IN TRANSACTIONS cannot carry graph values "
-                    f"({key}) across the batch boundary — project scalar "
-                    "values (ids, properties) before the CALL instead")
+                    f"({key}, in the {where}) across batch boundaries — "
+                    "their transaction commits mid-query; project scalar "
+                    "values (ids, properties) instead")
+
+    @staticmethod
+    def _renew_transaction(ctx) -> None:
         if getattr(ctx, "_txn_owner", None) is None:
             raise QueryException(
                 "CALL { } IN TRANSACTIONS requires an implicit "

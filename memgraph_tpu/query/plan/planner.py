@@ -140,7 +140,7 @@ class Planner:
                 if _single_has_update(clause.query):
                     has_update = True
                 plan = Op.Apply(plan, sub_plan, sub_cols,
-                                getattr(clause, "batch_rows", None))
+                                clause.batch_rows)
                 bound.update(sub_cols)
             elif isinstance(clause, A.CallProcedure):
                 plan = self.plan_call(clause, plan, bound)

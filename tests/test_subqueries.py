@@ -137,3 +137,42 @@ def test_call_in_transactions_rejects_graph_values(db):
     with pytest.raises(QueryException):
         run(db, "MATCH (n:GV) CALL { CREATE (:X) } "
                 "IN TRANSACTIONS OF 1 ROWS RETURN count(n)")
+
+
+def test_call_in_transactions_rejects_returned_graph_values(db):
+    from memgraph_tpu.exceptions import QueryException
+    with pytest.raises(QueryException):
+        run(db, "UNWIND range(1, 4) AS x "
+                "CALL { CREATE (m:Y) RETURN m } IN TRANSACTIONS OF 2 ROWS "
+                "RETURN m")
+
+
+def test_call_in_transactions_rejects_nested_graph_values(db):
+    from memgraph_tpu.exceptions import QueryException
+    run(db, "CREATE (:NG {name: 'a'})")
+    with pytest.raises(QueryException):
+        run(db, "MATCH (n:NG) WITH collect(n) AS ns "
+                "UNWIND range(1, 3) AS x "
+                "CALL { CREATE (:X) } IN TRANSACTIONS OF 1 ROWS "
+                "RETURN x, ns")
+
+
+def test_call_in_transactions_rejects_zero_batch(db):
+    from memgraph_tpu.exceptions import SyntaxException
+    with pytest.raises(SyntaxException):
+        run(db, "UNWIND range(1, 5) AS x CALL { CREATE (:Z) } "
+                "IN TRANSACTIONS OF 0 ROWS RETURN count(x)")
+    with pytest.raises(SyntaxException):  # bare form not in the grammar
+        run(db, "UNWIND range(1, 5) AS x CALL { CREATE (:Z) } "
+                "IN TRANSACTIONS RETURN count(x)")
+
+
+def test_call_in_transactions_rejected_in_explicit_txn(db):
+    from memgraph_tpu.exceptions import TransactionException
+    interp = Interpreter(db)
+    interp.execute("BEGIN")
+    with pytest.raises(TransactionException):
+        interp.execute("UNWIND range(1, 2) AS x "
+                       "CALL { CREATE (:E1) } IN TRANSACTIONS OF 5 ROWS "
+                       "RETURN count(x)")
+    interp.abort()
