@@ -1,0 +1,66 @@
+"""Mock procedure context for module unit tests.
+
+Counterpart of the reference's module-author mocking surface
+(/root/reference/include/mgp_mock.py + tests/e2e/mock_api): build a tiny
+graph from edge lists, get a real ProcedureContext over a real (throwaway)
+storage, and call procedures directly — no server needed.
+
+    from memgraph_tpu.procedures.mock import mock_context
+
+    ctx, nodes = mock_context(
+        nodes=[{"labels": ["User"], "name": "ana"}, {...}],
+        edges=[(0, 1, "KNOWS", {"w": 1.0})])
+    rows = list(my_proc(ctx, some_arg))
+"""
+
+from __future__ import annotations
+
+from ..query.plan.operators import ExecutionContext
+from ..query.procedures.registry import ProcedureContext
+from ..storage import InMemoryStorage
+
+
+def mock_context(nodes=None, edges=None, storage=None):
+    """Build (ProcedureContext, [VertexAccessor]) over a fresh storage.
+
+    nodes: list of dicts; the "labels" key (list of label names) is special,
+           every other key becomes a property.
+    edges: (from_index, to_index, type_name, properties?) tuples.
+    """
+    storage = storage or InMemoryStorage()
+    acc = storage.access()
+    vas = []
+    for spec in nodes or []:
+        va = acc.create_vertex()
+        for label in spec.get("labels", []):
+            va.add_label(storage.label_mapper.name_to_id(label))
+        for key, value in spec.items():
+            if key == "labels":
+                continue
+            va.set_property(storage.property_mapper.name_to_id(key), value)
+        vas.append(va)
+    for edge in edges or []:
+        src, dst, type_name = edge[0], edge[1], edge[2]
+        props = edge[3] if len(edge) > 3 else {}
+        ea = acc.create_edge(vas[src], vas[dst],
+                             storage.edge_type_mapper.name_to_id(type_name))
+        for key, value in (props or {}).items():
+            ea.set_property(storage.property_mapper.name_to_id(key), value)
+    acc.commit()
+
+    read_acc = storage.access()
+    exec_ctx = ExecutionContext(read_acc)
+    pctx = ProcedureContext(exec_ctx)
+    fresh = [read_acc.find_vertex(va.gid) for va in vas]
+    return pctx, fresh
+
+
+def call_procedure(name: str, *args, nodes=None, edges=None):
+    """Convenience: build a mock graph and call a REGISTERED procedure by
+    its dotted name; returns the list of result records."""
+    from ..query.procedures.registry import global_registry
+    proc = global_registry.find(name)
+    if proc is None:
+        raise KeyError(f"procedure {name!r} is not registered")
+    pctx, _ = mock_context(nodes=nodes, edges=edges)
+    return list(proc.func(pctx, *args))

@@ -113,3 +113,19 @@ def test_export_cypherl(db, tmp_path):
     assert rows == [[True]]
     content = open(path).read()
     assert "CREATE" in content
+
+
+def test_mock_context_api():
+    from memgraph_tpu.procedures.mock import call_procedure, mock_context
+    ctx, nodes = mock_context(
+        nodes=[{"labels": ["U"], "name": "a"}, {"labels": ["U"],
+               "name": "b"}],
+        edges=[(0, 1, "KNOWS", {"w": 2.0})])
+    assert len(nodes) == 2
+    graph = ctx.device_graph()
+    assert graph.n_nodes == 2 and graph.n_edges == 1
+    rows = call_procedure(
+        "degree_centrality.get",
+        nodes=[{"labels": ["U"]}, {"labels": ["U"]}],
+        edges=[(0, 1, "E")])
+    assert len(rows) == 2
