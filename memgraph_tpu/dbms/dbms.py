@@ -67,6 +67,12 @@ class DbmsHandler:
             ictx.settings = Settings(ictx.kvstore)
             if self._recover:
                 self._restore_ddl(storage, ictx.kvstore)
+                raw = ictx.kvstore.get("enums")
+                if raw:
+                    import json as _json
+                    from ..storage.enums import enum_registry
+                    enum_registry(storage).load(_json.loads(
+                        raw.decode("utf-8")))
         self._databases[name] = ictx
         return ictx
 

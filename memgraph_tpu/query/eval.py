@@ -349,6 +349,19 @@ class Evaluator:
             return True
         return False
 
+    def _eval_EnumLiteral(self, e: A.EnumLiteral, frame):
+        # positions are immutable (no redefinition, ALTER only appends), so a
+        # literal resolves once per (AST node, storage) and is memoized
+        storage = self.ctx.storage
+        memo = e.resolved
+        if memo is not None and memo[0]() is storage:
+            return memo[1]
+        import weakref
+        from ..storage.enums import enum_registry
+        value = enum_registry(storage).value(e.enum_name, e.value_name)
+        e.resolved = (weakref.ref(storage), value)
+        return value
+
     def _eval_PatternComprehension(self, e: A.PatternComprehension, frame):
         """[(n)-->(m) WHERE pred | expr] — collect projections per match."""
         from .plan.pattern_match import match_pattern_anchored

@@ -427,6 +427,22 @@ class SessionTraceQuery:
 
 
 @dataclass
+class EnumQuery:
+    action: str                 # create | add_value | show
+    name: Optional[str] = None
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class EnumLiteral(Expr):
+    enum_name: str
+    value_name: str
+    # evaluator's memo: (weakref-to-storage, EnumValue); excluded from
+    # structural equality so ORDER BY column rewriting still matches
+    resolved: object = field(default=None, compare=False, repr=False)
+
+
+@dataclass
 class SettingQuery:
     action: str                 # set | show_one | show_all
     name: Optional[str] = None

@@ -73,7 +73,7 @@ class Token:
 
 
 _PUNCT3 = ()
-_PUNCT2 = ("<>", "<=", ">=", "=~", "->", "<-", "--", "+=", "..", "||")
+_PUNCT2 = ("<>", "<=", ">=", "=~", "->", "<-", "--", "+=", "..", "||", "::")
 _PUNCT1 = ("(", ")", "[", "]", "{", "}", ",", ":", ";", ".", "+", "-", "*",
            "/", "%", "^", "=", "<", ">", "|", "&")
 

@@ -143,6 +143,19 @@ class Parser:
             if nxt.is_kw("ROLE"):
                 self.advance(); self.advance()
                 return A.AuthQuery("create_role", role=self.name_token())
+            if nxt.type == "IDENT" and str(nxt.value).upper() == "ENUM":
+                self.advance(); self.advance()
+                name = self.name_token()
+                if not (self.at(T.IDENT)
+                        and self.cur.value.upper() == "VALUES"):
+                    self.error("expected VALUES in CREATE ENUM")
+                self.advance()
+                self.expect("{")
+                values = [self.name_token()]
+                while self.accept(","):
+                    values.append(self.name_token())
+                self.expect("}")
+                return A.EnumQuery("create", name, values)
             return self.parse_cypher_query()
         if self.at_kw("DROP"):
             nxt = self.peek()
@@ -244,6 +257,18 @@ class Parser:
                 return A.AuthQuery("set_role", user=user,
                                    role=self.name_token())
             return self.parse_cypher_query()
+        if self.at(T.IDENT) and self.cur.value.upper() == "ALTER" and \
+                self.peek().type == T.IDENT and \
+                str(self.peek().value).upper() == "ENUM":
+            self.advance(); self.advance()
+            name = self.name_token()
+            if not (self.at(T.IDENT) and self.cur.value.upper() == "ADD"):
+                self.error("expected ADD VALUE in ALTER ENUM")
+            self.advance()
+            if not (self.at(T.IDENT) and self.cur.value.upper() == "VALUE"):
+                self.error("expected VALUE after ADD")
+            self.advance()
+            return A.EnumQuery("add_value", name, [self.name_token()])
         if self.at_kw("GRANT") or self.at_kw("DENY"):
             action = self.advance().value.lower()
             privs = [self.name_token().upper()]
@@ -465,6 +490,9 @@ class Parser:
             return A.AuthQuery("show_privileges", user=self.name_token())
         if self.accept_kw("VERSION"):
             return A.InfoQuery("version")
+        if self.at(T.IDENT) and self.cur.value.upper() == "ENUMS":
+            self.advance()
+            return A.EnumQuery("show")
         if self.at(T.IDENT) and self.cur.value.upper() == "INSTANCES":
             self.advance()
             return A.CoordinatorQuery("show")
@@ -1346,6 +1374,10 @@ class Parser:
             items = self.parse_map_or_param()
             return A.MapLiteral(items)
         if tok.type == T.IDENT or tok.type == T.KEYWORD:
+            if self.peek().type == "::":
+                enum_name = self.name_token()
+                self.advance()  # '::'
+                return A.EnumLiteral(enum_name, self.name_token())
             # function call or identifier (possibly namespaced)
             if self.peek().type == "(" or (self.peek().type == "."
                                            and self._looks_like_ns_call()):

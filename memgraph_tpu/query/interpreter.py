@@ -198,6 +198,8 @@ class Interpreter:
             return self._prepare_multidb(node)
         if isinstance(node, A.SettingQuery):
             return self._prepare_setting(node)
+        if isinstance(node, A.EnumQuery):
+            return self._prepare_enum(node)
         if isinstance(node, A.TtlQuery):
             return self._prepare_ttl(node)
         raise SemanticException(
@@ -251,6 +253,29 @@ class Interpreter:
             settings = self.ctx.settings = Settings(
                 getattr(self.ctx, "kvstore", None))
         return settings
+
+    def _prepare_enum(self, node: A.EnumQuery) -> PreparedQuery:
+        from ..storage.enums import enum_registry
+        registry = enum_registry(self.ctx.storage)
+        if node.action == "create":
+            self._ensure_writable("CREATE ENUM")
+            registry.create(node.name, node.values)
+            self._persist_enums(registry)
+            return self._prepare_generator(iter([]), [], "s")
+        if node.action == "add_value":
+            self._ensure_writable("ALTER ENUM")
+            registry.add_value(node.name, node.values[0])
+            self._persist_enums(registry)
+            return self._prepare_generator(iter([]), [], "s")
+        rows = [[name, values] for name, values in registry.to_list()]
+        return self._prepare_generator(iter(rows),
+                                       ["enum_name", "enum_values"], "r")
+
+    def _persist_enums(self, registry) -> None:
+        kv = getattr(self.ctx, "kvstore", None)
+        if kv is not None:
+            import json as _json
+            kv.put("enums", _json.dumps(registry.to_list()))
 
     def _prepare_setting(self, node: A.SettingQuery) -> PreparedQuery:
         settings = self._settings()

@@ -115,6 +115,10 @@ def cypher_lt(a, b):
             return a < b
     if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
         return order_key(list(a)) < order_key(list(b))
+    from ..storage.enums import EnumValue
+    if (isinstance(a, EnumValue) and isinstance(b, EnumValue)
+            and a.enum_name == b.enum_name):
+        return a.position < b.position
     return None  # incomparable mix → null (openCypher comparability)
 
 

@@ -139,6 +139,9 @@ def value_to_bolt(v, storage, view, version=(5, 2)):
         if v.crs.dims == 2:
             return ps.Structure(ps.S_POINT_2D, [v.crs.value, v.x, v.y])
         return ps.Structure(ps.S_POINT_3D, [v.crs.value, v.x, v.y, v.z])
+    from ..storage.enums import EnumValue
+    if isinstance(v, EnumValue):
+        return str(v)  # "Name::Value" (reference sends enums as strings)
     raise ps.PackStreamError(f"cannot convert {type(v)!r} to bolt")
 
 

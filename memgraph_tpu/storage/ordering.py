@@ -31,7 +31,8 @@ _RANK_ZONED_DATETIME = 11
 _RANK_DURATION = 12
 _RANK_POINT = 13
 _RANK_BYTES = 14
-_RANK_NULL = 15  # null sorts last in ascending order (openCypher)
+_RANK_ENUM = 15
+_RANK_NULL = 16  # null sorts last in ascending order (openCypher)
 
 
 def order_key(v):
@@ -67,6 +68,9 @@ def order_key(v):
         return (_RANK_POINT, v.crs.value, v.x, v.y, v.z if v.z is not None else 0.0)
     if isinstance(v, bytes):
         return (_RANK_BYTES, v)
+    from .enums import EnumValue
+    if isinstance(v, EnumValue):
+        return (_RANK_ENUM, v.enum_name, v.position)
     # graph objects (VertexAccessor/EdgeAccessor/Path) order by identity ids
     gid = getattr(v, "gid", None)
     if gid is not None:

@@ -41,6 +41,7 @@ T_DURATION = 0x0B
 T_ZONED_DATETIME = 0x0C
 T_POINT = 0x0D
 T_BYTES = 0x0E
+T_ENUM = 0x0F
 
 
 def _write_varint(buf: BytesIO, n: int) -> None:
@@ -77,6 +78,11 @@ def _big_zigzag(n: int) -> int:
 
 def _unzigzag(n: int) -> int:
     return (n >> 1) if not n & 1 else -((n + 1) >> 1)
+
+
+def _is_enum(v) -> bool:
+    from .enums import EnumValue
+    return isinstance(v, EnumValue)
 
 
 def encode_value(buf: BytesIO, v) -> None:
@@ -134,6 +140,13 @@ def encode_value(buf: BytesIO, v) -> None:
         tz = v.timezone_name().encode("utf-8")
         _write_varint(buf, len(tz))
         buf.write(tz)
+    elif _is_enum(v):
+        buf.write(bytes((T_ENUM,)))
+        for part in (v.enum_name, v.value_name):
+            raw = part.encode("utf-8")
+            _write_varint(buf, len(raw))
+            buf.write(raw)
+        _write_varint(buf, v.position)
     elif isinstance(v, Point):
         buf.write(bytes((T_POINT,)))
         _write_varint(buf, v.crs.value)
@@ -202,6 +215,12 @@ def decode_value(buf: BytesIO):
         except Exception:
             pass
         return ZonedDateTime(dt)
+    if tag == T_ENUM:
+        from .enums import EnumValue
+        enum_name = buf.read(_read_varint(buf)).decode("utf-8")
+        value_name = buf.read(_read_varint(buf)).decode("utf-8")
+        position = _read_varint(buf)
+        return EnumValue(enum_name, value_name, position)
     if tag == T_POINT:
         crs = CrsType(_read_varint(buf))
         x = struct.unpack("<d", buf.read(8))[0]
