@@ -25,3 +25,4 @@ def load_builtin_modules() -> None:
     from . import data_modules        # noqa: F401
     from . import graphrag            # noqa: F401
     from . import export_import       # noqa: F401
+    from . import combinatorial_modules  # noqa: F401

@@ -1,0 +1,696 @@
+"""Combinatorial-optimization query modules.
+
+Counterparts of the reference's MAGE modules
+  mage/python/max_flow.py        — max_flow.get_flow / get_paths
+  mage/python/union_find.py      — union_find.connected
+  mage/python/graph_coloring.py  — graph_coloring.color_graph/color_subgraph
+  mage/python/tsp.py             — tsp.solve
+  mage/python/vrp.py             — vrp.route
+  mage/python/set_cover.py       — set_cover.cp_solve / greedy
+  mage/python/temporal.py        — temporal.format
+  mage/cpp/bipartite_matching_module — bipartite_matching.max
+  mage/cpp/leiden_community_detection_module — leiden_community_detection.get
+
+Same procedure names, argument lists, and result fields. Deviations from the
+reference are algorithmic, not behavioral: set_cover.cp_solve uses the greedy
+ln(n)-approximation instead of a constraint-programming solver (no ortools in
+this build), tsp's "1.5-approx" falls back to the MST 2-approximation (no
+perfect-matching solver), and vrp.route uses Clarke-Wright savings instead of
+a CP solver. Connectivity for union_find rides the TPU WCC kernel
+(ops/components.py) through the version-keyed device-graph cache, so repeated
+calls on an unchanged graph are O(1) lookups.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+
+import numpy as np
+
+from ..exceptions import QueryException
+from . import mgp
+
+_EARTH_RADIUS_M = 6_371_000.0
+
+
+# --- max_flow ----------------------------------------------------------------
+
+
+def _capacity_network(ctx, edge_property: str):
+    """{u_gid: {v_gid: capacity}} over the MVCC-visible directed graph."""
+    pid = ctx.storage.property_mapper.maybe_name_to_id(edge_property)
+    cap: dict = collections.defaultdict(lambda: collections.defaultdict(float))
+    edge_of: dict = {}
+    for v in ctx.accessor.vertices(ctx.view):
+        for e in v.out_edges(ctx.view):
+            c = e.get_property(pid, ctx.view) if pid is not None else None
+            if c is None:
+                continue
+            try:
+                c = float(c)
+            except (TypeError, ValueError):
+                continue
+            if c <= 0:
+                continue
+            cap[v.gid][e.to_vertex().gid] += c
+            edge_of.setdefault((v.gid, e.to_vertex().gid), e)
+    return cap, edge_of
+
+
+def _bfs_augment(cap, residual, source, sink):
+    """Shortest augmenting path in the residual network (Edmonds-Karp)."""
+    parent = {source: None}
+    queue = collections.deque([source])
+    while queue:
+        u = queue.popleft()
+        if u == sink:
+            break
+        for v, c in residual[u].items():
+            if c > 1e-12 and v not in parent:
+                parent[v] = u
+                queue.append(v)
+    if sink not in parent:
+        return None, 0.0
+    path = [sink]
+    while parent[path[-1]] is not None:
+        path.append(parent[path[-1]])
+    path.reverse()
+    bottleneck = min(residual[path[i]][path[i + 1]]
+                     for i in range(len(path) - 1))
+    return path, bottleneck
+
+
+def _solve_max_flow(ctx, start_v, end_v, edge_property):
+    """Edmonds-Karp. Returns (net-flow {(u,v): f>0}, total, edge_of)."""
+    cap, edge_of = _capacity_network(ctx, edge_property)
+    residual: dict = collections.defaultdict(
+        lambda: collections.defaultdict(float))
+    for u, outs in cap.items():
+        for v, c in outs.items():
+            residual[u][v] += c
+            residual[v][u] += 0.0
+    total = 0.0
+    while True:
+        path, flow = _bfs_augment(cap, residual, start_v.gid, end_v.gid)
+        if path is None:
+            break
+        for i in range(len(path) - 1):
+            residual[path[i]][path[i + 1]] -= flow
+            residual[path[i + 1]][path[i]] += flow
+        total += flow
+    net = {}
+    for u, outs in cap.items():
+        for v, c in outs.items():
+            f = c - residual[u][v]
+            if f > 1e-12:
+                net[(u, v)] = f
+    return net, total, edge_of
+
+
+def _decompose_flow(net, source, sink):
+    """Split a net flow into forward-only source->sink paths: each walk
+    follows positive-flow arcs and subtracts its bottleneck, so the yielded
+    flows sum to the max flow (reverse residual arcs cancel in the net)."""
+    outs = collections.defaultdict(dict)
+    for (u, v), f in net.items():
+        outs[u][v] = f
+    paths = []
+    while outs[source]:
+        path = [source]
+        seen = {source}
+        while path[-1] != sink:
+            nxts = outs[path[-1]]
+            nxt = next((v for v in nxts if v not in seen), None)
+            if nxt is None:
+                break
+            seen.add(nxt)
+            path.append(nxt)
+        if path[-1] != sink:
+            break  # leftover circulation that never reaches the sink
+        bottleneck = min(outs[path[i]][path[i + 1]]
+                         for i in range(len(path) - 1))
+        for i in range(len(path) - 1):
+            u, v = path[i], path[i + 1]
+            outs[u][v] -= bottleneck
+            if outs[u][v] <= 1e-12:
+                del outs[u][v]
+        paths.append((path, bottleneck))
+    return paths
+
+
+@mgp.read_proc("max_flow.get_flow",
+               args=[("start_v", "NODE"), ("end_v", "NODE")],
+               opt_args=[("edge_property", "STRING", "weight")],
+               results=[("max_flow", "FLOAT")])
+def max_flow_get_flow(ctx, start_v, end_v, edge_property="weight"):
+    _, total, _ = _solve_max_flow(ctx, start_v, end_v, edge_property)
+    yield {"max_flow": float(total)}
+
+
+@mgp.read_proc("max_flow.get_paths",
+               args=[("start_v", "NODE"), ("end_v", "NODE")],
+               opt_args=[("edge_property", "STRING", "weight")],
+               results=[("path", "PATH"), ("flow", "FLOAT")])
+def max_flow_get_paths(ctx, start_v, end_v, edge_property="weight"):
+    from ..query.values import Path
+    net, _, edge_of = _solve_max_flow(ctx, start_v, end_v, edge_property)
+    for gids, flow in _decompose_flow(net, start_v.gid, end_v.gid):
+        items = [ctx.accessor.find_vertex(gids[0], ctx.view)]
+        ok = items[0] is not None
+        for i in range(len(gids) - 1):
+            edge = edge_of.get((gids[i], gids[i + 1]))
+            nxt = ctx.accessor.find_vertex(gids[i + 1], ctx.view)
+            if edge is None or nxt is None:
+                ok = False
+                break
+            items.extend([edge, nxt])
+        if ok:
+            yield {"path": Path(items), "flow": float(flow)}
+
+
+# --- union_find --------------------------------------------------------------
+
+
+def _wcc_labels(ctx, update: bool):
+    """gid -> component label, via the TPU WCC kernel; cached on storage."""
+    cached = getattr(ctx.storage, "_union_find_labels", None)
+    if not update and cached is not None:
+        return cached
+    from ..ops.components import weakly_connected_components
+    graph = ctx.device_graph()
+    labels = {}
+    if graph.n_nodes:
+        comp = np.asarray(weakly_connected_components(graph)[0])
+        gids = np.asarray(graph.node_gids[:graph.n_nodes])
+        labels = {int(g): int(c) for g, c in zip(gids, comp[:graph.n_nodes])}
+    ctx.storage._union_find_labels = labels
+    return labels
+
+
+@mgp.read_proc("union_find.connected",
+               args=[("nodes1", "ANY"), ("nodes2", "ANY")],
+               opt_args=[("mode", "STRING", "pairwise"),
+                         ("update", "BOOLEAN", True)],
+               results=[("node1", "NODE"), ("node2", "NODE"),
+                        ("connected", "BOOLEAN")])
+def union_find_connected(ctx, nodes1, nodes2, mode="pairwise", update=True):
+    labels = _wcc_labels(ctx, update)
+    lhs = nodes1 if isinstance(nodes1, (list, tuple)) else [nodes1]
+    rhs = nodes2 if isinstance(nodes2, (list, tuple)) else [nodes2]
+    if mode == "pairwise":
+        if len(lhs) != len(rhs):
+            raise QueryException(
+                "union_find.connected pairwise mode needs equal-length lists")
+        pairs = zip(lhs, rhs)
+    elif mode == "cartesian":
+        pairs = ((a, b) for a in lhs for b in rhs)
+    else:
+        raise QueryException(f"unknown union_find mode {mode!r}")
+    for a, b in pairs:
+        same = (labels.get(a.gid) is not None
+                and labels.get(a.gid) == labels.get(b.gid))
+        yield {"node1": a, "node2": b, "connected": same}
+
+
+# --- graph_coloring ----------------------------------------------------------
+
+
+def _undirected_adjacency(ctx, vertices=None, edges=None):
+    """gid -> set(gid). Whole visible graph, or an explicit subgraph."""
+    adj = collections.defaultdict(set)
+    if vertices is not None:
+        for v in vertices:
+            adj[v.gid]  # ensure isolated vertices appear
+        for e in edges or []:
+            a, b = e.from_vertex().gid, e.to_vertex().gid
+            adj[a].add(b)
+            adj[b].add(a)
+        return adj
+    for v in ctx.accessor.vertices(ctx.view):
+        adj[v.gid]
+        for e in v.out_edges(ctx.view):
+            adj[v.gid].add(e.to_vertex().gid)
+            adj[e.to_vertex().gid].add(v.gid)
+    return adj
+
+
+def _dsatur(adj, no_of_colors=None):
+    """DSATUR greedy coloring: highest saturation first, ties by degree.
+
+    With no_of_colors set, assignment is clamped into [0, k): a node whose
+    neighbors already use every color takes the least-conflicting one (the
+    reference's metaheuristic also minimizes conflicts at a fixed k rather
+    than guaranteeing a proper coloring, graph_coloring.py parameters)."""
+    colors: dict[int, int] = {}
+    saturation = {g: set() for g in adj}
+    uncolored = set(adj)
+    while uncolored:
+        g = max(uncolored,
+                key=lambda x: (len(saturation[x]), len(adj[x]), -x))
+        used = saturation[g]
+        color = 0
+        while color in used:
+            color += 1
+        if no_of_colors is not None and color >= no_of_colors:
+            counts = collections.Counter(
+                colors[nb] for nb in adj[g] if nb in colors)
+            color = min(range(no_of_colors), key=lambda c: counts.get(c, 0))
+        colors[g] = color
+        uncolored.discard(g)
+        for nb in adj[g]:
+            saturation[nb].add(color)
+    return colors
+
+
+def _coloring_budget(parameters):
+    if not parameters:
+        return None
+    k = parameters.get("no_of_colors")
+    if k is None:
+        return None
+    if not isinstance(k, int) or isinstance(k, bool) or k <= 0:
+        raise QueryException("no_of_colors must be a positive integer")
+    return k
+
+
+@mgp.read_proc("graph_coloring.color_graph",
+               opt_args=[("parameters", "MAP", None),
+                         ("edge_property", "STRING", "weight")],
+               results=[("node", "NODE"), ("color", "INTEGER")])
+def graph_coloring_color_graph(ctx, parameters=None, edge_property="weight"):
+    colors = _dsatur(_undirected_adjacency(ctx), _coloring_budget(parameters))
+    for gid, color in sorted(colors.items()):
+        node = ctx.accessor.find_vertex(gid, ctx.view)
+        if node is not None:
+            yield {"node": node, "color": int(color)}
+
+
+@mgp.read_proc("graph_coloring.color_subgraph",
+               args=[("vertices", "LIST"), ("edges", "LIST")],
+               opt_args=[("parameters", "MAP", None),
+                         ("edge_property", "STRING", "weight")],
+               results=[("node", "NODE"), ("color", "INTEGER")])
+def graph_coloring_color_subgraph(ctx, vertices, edges, parameters=None,
+                                  edge_property="weight"):
+    colors = _dsatur(_undirected_adjacency(ctx, vertices, edges),
+                     _coloring_budget(parameters))
+    by_gid = {v.gid: v for v in vertices}
+    for gid, color in sorted(colors.items()):
+        if gid in by_gid:
+            yield {"node": by_gid[gid], "color": int(color)}
+
+
+# --- tsp / vrp ---------------------------------------------------------------
+
+
+def _latlng(v, ctx):
+    lat = _prop(ctx, v, "lat")
+    lng = _prop(ctx, v, "lng")
+    if lat is None or lng is None:
+        raise QueryException(
+            "tsp/vrp nodes need numeric 'lat' and 'lng' properties")
+    return float(lat), float(lng)
+
+
+def _prop(ctx, v, name):
+    pid = ctx.storage.property_mapper.maybe_name_to_id(name)
+    return None if pid is None else v.get_property(pid, ctx.view)
+
+
+def _haversine_matrix(coords):
+    """All-pairs great-circle distance (meters) via one vectorized pass."""
+    arr = np.radians(np.asarray(coords, dtype=np.float64))
+    lat, lng = arr[:, 0:1], arr[:, 1:2]
+    dlat = lat - lat.T
+    dlng = lng - lng.T
+    a = (np.sin(dlat / 2) ** 2
+         + np.cos(lat) * np.cos(lat.T) * np.sin(dlng / 2) ** 2)
+    return 2 * _EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0.0, 1.0)))
+
+
+def _tour_greedy(dist):
+    n = dist.shape[0]
+    seen = {0}
+    tour = [0]
+    while len(tour) < n:
+        cur = tour[-1]
+        order = np.argsort(dist[cur])
+        nxt = next(int(i) for i in order if int(i) not in seen)
+        seen.add(nxt)
+        tour.append(nxt)
+    return tour
+
+
+def _tour_mst(dist):
+    """MST preorder walk — the classic 2-approximation."""
+    from scipy.sparse.csgraph import minimum_spanning_tree
+    n = dist.shape[0]
+    mst = minimum_spanning_tree(dist).toarray()
+    adj = collections.defaultdict(list)
+    for i in range(n):
+        for j in range(n):
+            if mst[i, j] > 0:
+                adj[i].append(j)
+                adj[j].append(i)
+    tour, stack, seen = [], [0], set()
+    while stack:
+        u = stack.pop()
+        if u in seen:
+            continue
+        seen.add(u)
+        tour.append(u)
+        for nb in sorted(adj[u], reverse=True):
+            stack.append(nb)
+    return tour
+
+
+def _two_opt(tour, dist, max_rounds=8):
+    n = len(tour)
+    for _ in range(max_rounds):
+        improved = False
+        for i in range(n - 2):
+            for j in range(i + 2, n - (0 if i else 1)):
+                a, b = tour[i], tour[i + 1]
+                c, d = tour[j], tour[(j + 1) % n]
+                if dist[a, b] + dist[c, d] > dist[a, c] + dist[b, d] + 1e-12:
+                    tour[i + 1:j + 1] = reversed(tour[i + 1:j + 1])
+                    improved = True
+        if not improved:
+            break
+    return tour
+
+
+@mgp.read_proc("tsp.solve",
+               args=[("points", "LIST")],
+               opt_args=[("method", "STRING", "greedy")],
+               results=[("sources", "LIST"), ("destinations", "LIST")])
+def tsp_solve(ctx, points, method="greedy"):
+    if not points:
+        yield {"sources": None, "destinations": None}
+        return
+    coords = [_latlng(v, ctx) for v in points]
+    dist = _haversine_matrix(coords)
+    # reference accepts '2_approx'/'1.5_approx' (lowercased) and silently
+    # falls back to greedy on unknown names (mage/python/tsp.py)
+    method = str(method).lower().replace("-", "_")
+    if method in ("2_approx", "1.5_approx"):
+        tour = _tour_mst(dist)
+    else:
+        tour = _two_opt(_tour_greedy(dist), dist)
+    cycle = tour + [tour[0]]
+    yield {"sources": [points[i] for i in cycle[:-1]],
+           "destinations": [points[i] for i in cycle[1:]]}
+
+
+@mgp.read_proc("vrp.route",
+               args=[("depot_node", "NODE")],
+               opt_args=[("number_of_vehicles", "INTEGER", None)],
+               results=[("from_vertex", "NODE"), ("to_vertex", "NODE")])
+def vrp_route(ctx, depot_node, number_of_vehicles=None):
+    """Clarke-Wright savings: start with depot->i->depot routes, merge the
+    pairs with the largest savings until the vehicle budget is met."""
+    if number_of_vehicles is not None and number_of_vehicles <= 0:
+        raise QueryException("Number of vehicles must be greater than 0.")
+    stops = [v for v in ctx.accessor.vertices(ctx.view)
+             if v.gid != depot_node.gid
+             and _prop(ctx, v, "lat") is not None
+             and _prop(ctx, v, "lng") is not None]
+    if not stops:
+        return
+    coords = [_latlng(depot_node, ctx)] + [_latlng(v, ctx) for v in stops]
+    dist = _haversine_matrix(coords)
+    n = len(stops)
+    target = min(number_of_vehicles or 1, n)
+    routes = {i: [i] for i in range(1, n + 1)}   # route-id -> stop indices
+    owner = {i: i for i in range(1, n + 1)}      # stop index -> route-id
+    savings = sorted(
+        ((dist[0, i] + dist[0, j] - dist[i, j], i, j)
+         for i in range(1, n + 1) for j in range(i + 1, n + 1)),
+        reverse=True)
+    for s, i, j in savings:
+        if len(routes) <= target:
+            break
+        ri, rj = owner[i], owner[j]
+        if ri == rj:
+            continue
+        a, b = routes[ri], routes[rj]
+        # merge only at route endpoints (classic CW interior rule)
+        if a[-1] == i and b[0] == j:
+            merged = a + b
+        elif b[-1] == j and a[0] == i:
+            merged = b + a
+        elif a[0] == i and b[0] == j:
+            merged = list(reversed(a)) + b
+        elif a[-1] == i and b[-1] == j:
+            merged = a + list(reversed(b))
+        else:
+            continue
+        del routes[rj]
+        routes[ri] = merged
+        for idx in merged:
+            owner[idx] = ri
+    for route in routes.values():
+        hops = [0] + route + [0]
+        for k in range(len(hops) - 1):
+            frm = depot_node if hops[k] == 0 else stops[hops[k] - 1]
+            to = depot_node if hops[k + 1] == 0 else stops[hops[k + 1] - 1]
+            yield {"from_vertex": frm, "to_vertex": to}
+
+
+# --- set_cover ---------------------------------------------------------------
+
+
+def _set_cover_greedy(element_vertexes, set_vertexes):
+    if len(element_vertexes) != len(set_vertexes):
+        raise QueryException(
+            "set_cover inputs must be equal-length element/set lists")
+    members = collections.defaultdict(set)
+    by_gid = {}
+    for el, st in zip(element_vertexes, set_vertexes):
+        members[st.gid].add(el.gid)
+        by_gid[st.gid] = st
+    uncovered = set()
+    for el in element_vertexes:
+        uncovered.add(el.gid)
+    chosen = []
+    while uncovered:
+        best = max(members, key=lambda g: len(members[g] & uncovered))
+        gain = members[best] & uncovered
+        if not gain:
+            break
+        uncovered -= gain
+        chosen.append(by_gid[best])
+        del members[best]
+    return chosen
+
+
+@mgp.read_proc("set_cover.cp_solve",
+               args=[("element_vertexes", "LIST"), ("set_vertexes", "LIST")],
+               results=[("containing_set", "NODE")])
+def set_cover_cp_solve(ctx, element_vertexes, set_vertexes):
+    for st in _set_cover_greedy(element_vertexes, set_vertexes):
+        yield {"containing_set": st}
+
+
+@mgp.read_proc("set_cover.greedy",
+               args=[("element_vertexes", "LIST"), ("set_vertexes", "LIST")],
+               results=[("containing_set", "NODE")])
+def set_cover_greedy(ctx, element_vertexes, set_vertexes):
+    for st in _set_cover_greedy(element_vertexes, set_vertexes):
+        yield {"containing_set": st}
+
+
+# --- bipartite_matching ------------------------------------------------------
+
+
+@mgp.read_proc("bipartite_matching.max",
+               results=[("maximum_bipartite_matching", "INTEGER")])
+def bipartite_matching_max(ctx):
+    """2-color the graph; if bipartite, run Hopcroft-Karp. Non-bipartite
+    graphs report 0, matching the reference's is_graph_bipartite gate
+    (mage/cpp/bipartite_matching_module/algorithm/bipartite_matching.cpp)."""
+    adj = _undirected_adjacency(ctx)
+    side = {}
+    for start in adj:
+        if start in side:
+            continue
+        side[start] = 0
+        queue = collections.deque([start])
+        while queue:
+            u = queue.popleft()
+            for v in adj[u]:
+                if v not in side:
+                    side[v] = side[u] ^ 1
+                    queue.append(v)
+                elif side[v] == side[u]:
+                    yield {"maximum_bipartite_matching": 0}
+                    return
+    left = [g for g, s in side.items() if s == 0]
+    matching = _hopcroft_karp(adj, left)
+    yield {"maximum_bipartite_matching": int(matching)}
+
+
+def _hopcroft_karp(adj, left):
+    INF = math.inf
+    match_l: dict = {u: None for u in left}
+    match_r: dict = {}
+    total = 0
+    while True:
+        dist = {}
+        queue = collections.deque()
+        for u in left:
+            if match_l[u] is None:
+                dist[u] = 0
+                queue.append(u)
+            else:
+                dist[u] = INF
+        found = False
+        while queue:
+            u = queue.popleft()
+            for v in adj[u]:
+                w = match_r.get(v)
+                if w is None:
+                    found = True
+                elif dist.get(w, INF) is INF:
+                    dist[w] = dist[u] + 1
+                    queue.append(w)
+
+        def dfs(root):
+            # explicit stack of (u, iterator over u's neighbors) frames —
+            # augmenting paths can be thousands of vertices long, past
+            # Python's recursion limit
+            stack = [(root, iter(adj[root]))]
+            trail = []  # (u, v) edges taken downward
+            while stack:
+                u, it = stack[-1]
+                advanced = False
+                for v in it:
+                    w = match_r.get(v)
+                    if w is None:
+                        # free right vertex: flip the whole trail
+                        for pu, pv in trail:
+                            match_l[pu] = pv
+                            match_r[pv] = pu
+                        match_l[u] = v
+                        match_r[v] = u
+                        return True
+                    if dist.get(w) == dist[u] + 1:
+                        trail.append((u, v))
+                        stack.append((w, iter(adj[w])))
+                        advanced = True
+                        break
+                if not advanced:
+                    dist[u] = INF
+                    stack.pop()
+                    if trail:
+                        trail.pop()
+            return False
+
+        if not found:
+            return total
+        for u in left:
+            if match_l[u] is None and dfs(u):
+                total += 1
+
+
+# --- leiden ------------------------------------------------------------------
+
+
+@mgp.read_proc("leiden_community_detection.get",
+               opt_args=[("weight_property", "STRING", None)],
+               results=[("node", "NODE"), ("community_id", "INTEGER"),
+                        ("communities", "LIST")])
+def leiden_get(ctx, weight_property=None):
+    """Louvain TPU kernel + a host refinement sweep (the Leiden move: each
+    node may only stay or move to a strictly modularity-improving neighbor
+    community, splitting badly-connected merges)."""
+    from ..ops.louvain import louvain
+    graph = ctx.device_graph(weight_property=weight_property)
+    if graph.n_nodes == 0:
+        return
+    comm, _ = louvain(graph)
+    comm = _refine_communities(graph, np.asarray(comm).copy())
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            cid = int(comm[i])
+            yield {"node": node, "community_id": cid, "communities": [cid]}
+
+
+def _refine_communities(graph, comm):
+    """One constrained local-move sweep over the host CSR arrays.
+
+    DeviceGraph stores each edge once, directed — symmetrize here (as
+    ops/louvain.py does) so per-community link weights see the full
+    undirected adjacency, not just out-edges."""
+    n, m = graph.n_nodes, graph.n_edges
+    e_src = np.asarray(graph.src_idx[:m])
+    e_dst = np.asarray(graph.col_idx[:m])
+    e_w = np.asarray(graph.weights[:m], dtype=np.float64)
+    src = np.concatenate([e_src, e_dst])
+    dst = np.concatenate([e_dst, e_src])
+    w = np.concatenate([e_w, e_w])
+    order_idx = np.argsort(src, kind="stable")
+    src, dst, w = src[order_idx], dst[order_idx], w[order_idx]
+    deg = np.zeros(n)
+    np.add.at(deg, src, w)
+    two_m = max(deg.sum(), 1e-12)
+    comm_deg = np.zeros(comm.max() + 2)
+    np.add.at(comm_deg, comm, deg)
+    order = np.argsort(-deg[:n])
+    starts = np.searchsorted(src, np.arange(n))
+    ends = np.searchsorted(src, np.arange(n) + 1)
+    for u in order:
+        u = int(u)
+        links = collections.defaultdict(float)
+        for k in range(int(starts[u]), int(ends[u])):
+            links[int(comm[dst[k]])] += float(w[k])
+        cur = int(comm[u])
+        best, best_gain = cur, 0.0
+        for c, l_uc in links.items():
+            if c == cur:
+                continue
+            gain = (l_uc - links.get(cur, 0.0)
+                    - deg[u] * (comm_deg[c] - comm_deg[cur] + deg[u]) / two_m)
+            if gain > best_gain + 1e-12:
+                best, best_gain = c, gain
+        if best != cur:
+            comm_deg[cur] -= deg[u]
+            comm_deg[best] += deg[u]
+            comm[u] = best
+    return comm
+
+
+# --- temporal ----------------------------------------------------------------
+
+
+@mgp.read_proc("temporal.format",
+               args=[("temporal", "ANY")],
+               opt_args=[("format", "STRING", "ISO")],
+               results=[("formatted", "STRING")])
+def temporal_format(ctx, temporal, format="ISO"):
+    """Non-temporal values fall through to str(); a Duration with a custom
+    format is strftime'd via the Unix epoch — both matching the reference
+    (mage/python/temporal.py)."""
+    import datetime as _dt
+    from ..utils.temporal import (Date, Duration, LocalDateTime, LocalTime,
+                                  ZonedDateTime)
+    if isinstance(temporal, Duration):
+        if format == "ISO":
+            yield {"formatted": str(temporal)}
+        else:
+            epoch = _dt.datetime(1970, 1, 1) \
+                + _dt.timedelta(microseconds=temporal.micros)
+            yield {"formatted": epoch.strftime(format)}
+        return
+    if not isinstance(temporal, (Date, LocalTime, LocalDateTime,
+                                 ZonedDateTime)):
+        yield {"formatted": str(temporal)}
+        return
+    inner = getattr(temporal, "d", None) or getattr(temporal, "t", None) \
+        or getattr(temporal, "dt", None)
+    if format == "ISO":
+        yield {"formatted": inner.isoformat()}
+    else:
+        yield {"formatted": inner.strftime(format)}
