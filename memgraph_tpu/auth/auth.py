@@ -113,6 +113,11 @@ class Auth:
         with self._lock:
             return sorted(self._users)
 
+    def user_roles(self, name: str) -> list[str]:
+        with self._lock:
+            user = self._users.get(name)
+            return sorted(user.roles) if user is not None else []
+
     def roles(self) -> list[str]:
         with self._lock:
             return sorted(self._roles)
@@ -259,6 +264,14 @@ class Auth:
 
 _GLOBAL_AUTH: Auth | None = None
 _GLOBAL_LOCK = threading.Lock()
+
+
+def resolve_auth(interpreter_context) -> Auth:
+    """The Auth store a session should consult: the context's wired
+    auth_store, else the process-global one. Single source for both RBAC
+    enforcement (Interpreter._auth_store) and the roles() builtin."""
+    auth = getattr(interpreter_context, "auth_store", None)
+    return auth if auth is not None else global_auth()
 
 
 def global_auth() -> Auth:

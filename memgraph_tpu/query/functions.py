@@ -697,6 +697,49 @@ def fn_username(ev, args):
     return getattr(ev.ctx, "username", None) or None
 
 
+@register("roles", 0, 1, propagate_null=False)
+def fn_roles(ev, args):
+    """Role names of the session user (reference:
+    awesome_memgraph_functions.cpp Roles); [] when anonymous. The optional
+    db_name argument is accepted for parity (roles are global here)."""
+    if args and args[0] is not None and not isinstance(args[0], str):
+        raise TypeException("roles() db_name must be a string")
+    username = getattr(ev.ctx, "username", None)
+    if not username:
+        return []
+    from ..auth.auth import resolve_auth
+    exec_ctx = getattr(ev.ctx, "exec_ctx", None)
+    auth = resolve_auth(getattr(exec_ctx, "interpreter_context", None))
+    return auth.user_roles(username)
+
+
+@register("elementid", 1, 1)
+def fn_elementid(ev, args):
+    """id() as a string, for external-integration compatibility (reference:
+    awesome_memgraph_functions.cpp ElementId)."""
+    v = args[0]
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        return str(v.gid)
+    raise TypeException("elementId() requires a node or relationship")
+
+
+@register("toenum", 1, 2)
+def fn_toenum(ev, args):
+    """toEnum("Name::Value") or toEnum("Name", "Value") -> enum value
+    (reference: awesome_memgraph_functions.cpp ToEnum)."""
+    from ..storage.enums import enum_registry
+    if not all(isinstance(a, str) for a in args):
+        raise TypeException("toEnum() requires string arguments")
+    if len(args) == 1:
+        name, sep, value = args[0].partition("::")
+        if not sep:
+            raise TypeException(
+                f"invalid enum literal {args[0]!r} (expected 'Name::Value')")
+    else:
+        name, value = args
+    return enum_registry(ev.ctx.storage).value(name, value)
+
+
 @register("gethopscounter", 0, 0, propagate_null=False)
 def fn_gethopscounter(ev, args):
     """Edge visits consumed so far under USING HOPS LIMIT (reference:

@@ -363,11 +363,8 @@ class Interpreter:
         return self._prepare_generator(iter([]), [], "s")
 
     def _auth_store(self):
-        auth = getattr(self.ctx, "auth_store", None)
-        if auth is None:
-            from ..auth.auth import global_auth
-            auth = global_auth()
-        return auth
+        from ..auth.auth import resolve_auth
+        return resolve_auth(self.ctx)
 
     def _check_privilege(self, privilege: str) -> None:
         """Enforce RBAC when users are defined (reference: AuthChecker,

@@ -137,3 +137,24 @@ def test_grant_all_privileges_syntax(rbac):
     _, rows, _ = admin.execute("SHOW PRIVILEGES FOR power")
     assert len(rows) >= 20
     admin.close()
+
+
+def test_roles_function(rbac):
+    rbac["auth"].create_role("analyst")
+    rbac["auth"].set_role("reader", "analyst")
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    _, rows, _ = c.execute("RETURN roles(), username()")
+    assert rows == [[["analyst"], "reader"]]
+    c.close()
+
+
+def test_roles_db_name_type_checked(rbac):
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    with pytest.raises(BoltClientError):
+        c.execute("RETURN roles(123)")
+    c.reset()
+    _, rows, _ = c.execute("RETURN roles('memgraph')")
+    assert rows == [[[]]]
+    c.close()

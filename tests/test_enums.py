@@ -132,3 +132,31 @@ class TestDurability:
         out = rows(i2.execute(
             "MATCH (n:T) WHERE n.s = Status::Bad RETURN n.s"))
         assert out == [[EnumValue("Status", "Bad", 1)]]
+
+
+class TestFunctions:
+    def test_to_enum(self):
+        i = make_interp()
+        i.execute("CREATE ENUM Status VALUES { Good, Bad }")
+        out = rows(i.execute(
+            "RETURN toEnum('Status::Bad') AS a, toEnum('Status', 'Good') AS b"))
+        assert out == [[EnumValue("Status", "Bad", 1),
+                        EnumValue("Status", "Good", 0)]]
+
+    def test_to_enum_errors(self):
+        i = make_interp()
+        i.execute("CREATE ENUM Status VALUES { Good }")
+        with pytest.raises(QueryException):
+            i.execute("RETURN toEnum('Status::Nope')")
+        with pytest.raises(QueryException):
+            i.execute("RETURN toEnum('NoSeparator')")
+
+    def test_element_id_is_string(self):
+        i = make_interp()
+        i.execute("CREATE (:T)")
+        out = rows(i.execute("MATCH (n:T) RETURN elementId(n), id(n)"))
+        assert out == [["0", 0]]
+
+    def test_roles_empty_when_anonymous(self):
+        i = make_interp()
+        assert rows(i.execute("RETURN roles()")) == [[[]]]
