@@ -215,12 +215,18 @@ class Parser:
             labels = []
             if self.accept_kw("ON"):
                 self.expect_kw("LABELS")
-                labels.append(self._colon_label())
-                while self.accept(","):
+                if not self.accept("*"):   # * = all labels (grammar:636)
                     labels.append(self._colon_label())
+                    while self.accept(","):
+                        labels.append(self._colon_label())
             action = "analyze"
             if self.accept_kw("DELETE"):
-                self.expect_kw("STATS")
+                if self.at_kw("STATS") or (
+                        self.at(T.IDENT)
+                        and self.cur.value.upper() == "STATISTICS"):
+                    self.advance()
+                else:
+                    self.error("expected STATISTICS after DELETE")
                 action = "delete"
             return A.AnalyzeGraphQuery(action, labels)
         if self.at_kw("SET"):

@@ -18,6 +18,7 @@ from typing import Iterator, Optional
 from ..exceptions import (HintedAbortError, QueryException, SemanticException,
                           TransactionException)
 from ..storage.common import IsolationLevel, StorageMode, View
+from ..storage.ordering import order_key
 from ..storage.storage import InMemoryStorage
 from .frontend import ast as A
 from .frontend.parser import parse_with_source
@@ -177,9 +178,7 @@ class Interpreter:
                     acc.abort()
             return self._prepare_generator(gen(), ["QUERY"], "r")
         if isinstance(node, A.AnalyzeGraphQuery):
-            return self._prepare_generator(
-                iter([["Graph analyzed (index statistics refreshed)"]]),
-                ["status"], "s")
+            return self._prepare_analyze_graph(node)
         if isinstance(node, A.IsolationLevelQuery):
             return self._prepare_isolation(node)
         if isinstance(node, A.StorageModeQuery):
@@ -276,6 +275,113 @@ class Interpreter:
         if kv is not None:
             import json as _json
             kv.put("enums", _json.dumps(registry.to_list()))
+
+    def _prepare_analyze_graph(self, node) -> PreparedQuery:
+        """ANALYZE GRAPH [ON LABELS ...] [DELETE STATISTICS].
+
+        Computes the same per-index statistics the reference stores for its
+        cost model (interpreter.cpp HandleAnalyzeGraphQuery: num estimation
+        nodes, num groups, avg group size, chi-squared, avg degree; degrees
+        count both directions, and composite indexes get a row per property
+        prefix). The planner here reads live approx_count() from the
+        indexes, so the rows are a reporting surface; stats live in
+        indices.analyze_stats (dropped with their index) and are cleared by
+        DELETE STATISTICS."""
+        if self._in_explicit_txn:
+            raise TransactionException(
+                "ANALYZE GRAPH cannot run inside a transaction")
+        storage = self.ctx.storage
+        indices = storage.indices
+        label_filter = None
+        if node.labels:
+            label_filter = {storage.label_mapper.maybe_name_to_id(name)
+                            for name in node.labels}
+            label_filter.discard(None)
+
+        def wanted(lid):
+            return label_filter is None or lid in label_filter
+
+        if node.action == "delete":
+            rows = []
+            for (lid, pids) in sorted(indices.analyze_stats):
+                if not wanted(lid):
+                    continue
+                rows.append([
+                    storage.label_mapper.id_to_name(lid),
+                    [storage.property_mapper.id_to_name(p) for p in pids]
+                    if pids else None,
+                ])
+            indices.analyze_stats = {
+                k: v for k, v in indices.analyze_stats.items()
+                if not wanted(k[0])}
+            return self._prepare_generator(
+                iter(rows), ["label", "property"], "r")
+
+        acc = storage.access()
+        try:
+            stats = {}
+            rows = []
+            for lid in sorted(indices.label.labels()):
+                if not wanted(lid):
+                    continue
+                count = 0
+                degree_sum = 0
+                for va in acc.vertices_by_label(lid, View.OLD):
+                    count += 1
+                    degree_sum += (va.out_degree(View.OLD)
+                                   + va.in_degree(View.OLD))
+                avg_degree = degree_sum / count if count else 0.0
+                stats[(lid, ())] = {"count": count,
+                                    "avg_degree": avg_degree}
+                rows.append([storage.label_mapper.id_to_name(lid), None,
+                             count, None, None, None, avg_degree])
+            # one scan per indexed label covers the full key and every
+            # property prefix (the reference emits a row per prefix so
+            # prefix lookups on composite indexes get costed)
+            for (lid, pids) in sorted(indices.label_property.keys()):
+                if not wanted(lid):
+                    continue
+                prefixes = [pids[:k] for k in range(1, len(pids) + 1)]
+                acc_stats = {pref: {"groups": {}, "count": 0, "deg": 0}
+                             for pref in prefixes}
+                for va in acc.vertices_by_label(lid, View.OLD):
+                    values = tuple(va.get_property(p, View.OLD)
+                                   for p in pids)
+                    degree = (va.out_degree(View.OLD)
+                              + va.in_degree(View.OLD))
+                    for pref in prefixes:
+                        pvals = values[:len(pref)]
+                        if all(v is None for v in pvals):
+                            continue
+                        st = acc_stats[pref]
+                        st["count"] += 1
+                        st["deg"] += degree
+                        key = order_key(list(pvals))
+                        st["groups"][key] = st["groups"].get(key, 0) + 1
+                for pref in prefixes:
+                    st = acc_stats[pref]
+                    count, n_groups = st["count"], len(st["groups"])
+                    avg_group = count / n_groups if n_groups else 0.0
+                    chi2 = sum((c - avg_group) ** 2 / avg_group
+                               for c in st["groups"].values()) \
+                        if avg_group else 0.0
+                    avg_degree = st["deg"] / count if count else 0.0
+                    stats[(lid, pref)] = {
+                        "count": count, "num_groups": n_groups,
+                        "avg_group_size": avg_group, "chi_squared": chi2,
+                        "avg_degree": avg_degree}
+                    rows.append([
+                        storage.label_mapper.id_to_name(lid),
+                        [storage.property_mapper.id_to_name(p)
+                         for p in pref],
+                        count, n_groups, avg_group, chi2, avg_degree])
+        finally:
+            acc.abort()
+        indices.analyze_stats.update(stats)
+        return self._prepare_generator(
+            iter(rows),
+            ["label", "property", "num estimation nodes", "num groups",
+             "avg group size", "chi-squared value", "avg degree"], "r")
 
     def _prepare_setting(self, node: A.SettingQuery) -> PreparedQuery:
         settings = self._settings()
@@ -675,6 +781,7 @@ class Interpreter:
                 storage.create_label_index(lid)
             else:
                 storage.indices.label.drop(lid)
+                storage.indices.drop_stats(lid)
             self._persist_ddl("index", _json.dumps(["label", node.label]),
                               node.action == "create")
         elif node.kind == "label_property":
@@ -685,6 +792,7 @@ class Interpreter:
                 storage.create_label_property_index(lid, pids)
             else:
                 storage.indices.label_property.drop(lid, pids)
+                storage.indices.drop_stats(lid, pids)
             self._persist_ddl(
                 "index",
                 _json.dumps(["label_property", node.label,

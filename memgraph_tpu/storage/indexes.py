@@ -288,7 +288,20 @@ class Indices:
         self.label = LabelIndex()
         self.label_property = LabelPropertyIndex()
         self.edge_type = EdgeTypeIndex()
+        # ANALYZE GRAPH results: (label_id, prop_id_tuple) -> stats dict
+        # (() for plain label indexes); dropped alongside the index
+        self.analyze_stats: dict = {}
         # vector / text / point indexes attach here (separate modules)
         self.vector = None
         self.text = None
         self.point = None
+
+    def drop_stats(self, label_id: int, prop_ids: tuple = None) -> None:
+        """Forget ANALYZE stats for a dropped index (all prefixes)."""
+        if prop_ids is None:
+            self.analyze_stats.pop((label_id, ()), None)
+            return
+        for k in [k for k in self.analyze_stats
+                  if k[0] == label_id and k[1]
+                  and k[1] == prop_ids[:len(k[1])]]:
+            del self.analyze_stats[k]

@@ -1,0 +1,82 @@
+"""ANALYZE GRAPH statistics rows (reference: interpreter.cpp
+HandleAnalyzeGraphQuery — label/label+property stats with chi-squared)."""
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+def make():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+def test_analyze_graph_label_property_stats():
+    i = make()
+    i.execute("CREATE INDEX ON :P(age)")
+    i.execute("UNWIND range(0, 9) AS x CREATE (:P {age: x % 3})")
+    cols, rows, _ = i.execute("ANALYZE GRAPH")
+    assert cols == ["label", "property", "num estimation nodes",
+                    "num groups", "avg group size", "chi-squared value",
+                    "avg degree"]
+    assert rows == [["P", ["age"], 10, 3, 10 / 3, 0.2, 0.0]]
+
+
+def test_analyze_graph_label_index_row():
+    i = make()
+    i.execute("CREATE INDEX ON :P")
+    i.execute("CREATE (:P)-[:R]->(:P), (:P)")
+    _, rows, _ = i.execute("ANALYZE GRAPH")
+    # degrees count both directions (reference sums out + in)
+    assert rows == [["P", None, 3, None, None, None, 2 / 3]]
+
+
+def test_analyze_graph_delete_statistics():
+    i = make()
+    i.execute("CREATE INDEX ON :P(age)")
+    i.execute("CREATE (:P {age: 1})")
+    i.execute("ANALYZE GRAPH")
+    cols, rows, _ = i.execute("ANALYZE GRAPH DELETE STATISTICS")
+    assert cols == ["label", "property"]
+    assert rows == [["P", ["age"]]]
+    # second delete: nothing left
+    assert i.execute("ANALYZE GRAPH DELETE STATS")[1] == []
+
+
+def test_analyze_graph_label_filter_and_star():
+    i = make()
+    i.execute("CREATE INDEX ON :A(x)")
+    i.execute("CREATE INDEX ON :B(y)")
+    i.execute("CREATE (:A {x: 1}), (:B {y: 2})")
+    _, rows, _ = i.execute("ANALYZE GRAPH ON LABELS :A")
+    assert [r[0] for r in rows] == ["A"]
+    _, rows, _ = i.execute("ANALYZE GRAPH ON LABELS *")
+    assert sorted(r[0] for r in rows) == ["A", "B"]
+
+
+def test_analyze_graph_composite_prefix_rows():
+    i = make()
+    i.execute("CREATE INDEX ON :L(a, b)")
+    i.execute("UNWIND range(0, 3) AS x CREATE (:L {a: x % 2, b: x})")
+    _, rows, _ = i.execute("ANALYZE GRAPH")
+    by_props = {tuple(r[1]): r for r in rows}
+    assert set(by_props) == {("a",), ("a", "b")}
+    assert by_props[("a",)][3] == 2     # a has 2 distinct values
+    assert by_props[("a", "b")][3] == 4  # (a,b) all distinct
+
+
+def test_analyze_graph_rejected_in_transaction():
+    import pytest
+    from memgraph_tpu.exceptions import TransactionException
+    i = make()
+    i.execute("BEGIN")
+    with pytest.raises(TransactionException):
+        i.execute("ANALYZE GRAPH")
+    i.execute("ROLLBACK")
+
+
+def test_drop_index_forgets_stats():
+    i = make()
+    i.execute("CREATE INDEX ON :L(a, b)")
+    i.execute("CREATE (:L {a: 1, b: 2})")
+    i.execute("ANALYZE GRAPH")
+    i.execute("DROP INDEX ON :L(a, b)")
+    assert i.execute("ANALYZE GRAPH DELETE STATISTICS")[1] == []
