@@ -26,3 +26,4 @@ def load_builtin_modules() -> None:
     from . import graphrag            # noqa: F401
     from . import export_import       # noqa: F401
     from . import combinatorial_modules  # noqa: F401
+    from . import igraph_module           # noqa: F401

@@ -81,9 +81,20 @@ def _bfs_augment(cap, residual, source, sink):
     return path, bottleneck
 
 
-def _solve_max_flow(ctx, start_v, end_v, edge_property):
-    """Edmonds-Karp. Returns (net-flow {(u,v): f>0}, total, edge_of)."""
+def _solve_max_flow(ctx, start_v, end_v, edge_property, directed=True):
+    """Edmonds-Karp. Returns (net-flow {(u,v): f>0}, total, edge_of).
+    With directed=False each edge contributes capacity both ways (the
+    igraph undirected-flow convention)."""
     cap, edge_of = _capacity_network(ctx, edge_property)
+    if not directed:
+        undirected = collections.defaultdict(
+            lambda: collections.defaultdict(float))
+        for u, outs in cap.items():
+            for v, c in outs.items():
+                undirected[u][v] += c
+                undirected[v][u] += c
+                edge_of.setdefault((v, u), edge_of.get((u, v)))
+        cap = undirected
     residual: dict = collections.defaultdict(
         lambda: collections.defaultdict(float))
     for u, outs in cap.items():
@@ -106,6 +117,30 @@ def _solve_max_flow(ctx, start_v, end_v, edge_property):
             if f > 1e-12:
                 net[(u, v)] = f
     return net, total, edge_of
+
+
+def residual_reachable(ctx, source_gid, edge_property, net, directed=True):
+    """Gids on the source side of the min cut: BFS over leftover capacity
+    in the SAME network the flow was solved on."""
+    cap, _ = _capacity_network(ctx, edge_property)
+    residual = collections.defaultdict(dict)
+    for u, outs in cap.items():
+        for v, c in outs.items():
+            residual[u][v] = residual[u].get(v, 0.0) + c
+            if not directed:
+                residual[v][u] = residual[v].get(u, 0.0) + c
+    for (u, v), f in net.items():
+        residual[u][v] = residual[u].get(v, 0.0) - f
+        residual[v][u] = residual[v].get(u, 0.0) + f
+    reachable = {source_gid}
+    queue = collections.deque([source_gid])
+    while queue:
+        u = queue.popleft()
+        for v, c in residual.get(u, {}).items():
+            if c > 1e-12 and v not in reachable:
+                reachable.add(v)
+                queue.append(v)
+    return reachable
 
 
 def _decompose_flow(net, source, sink):

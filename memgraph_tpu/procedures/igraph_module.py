@@ -1,0 +1,474 @@
+"""igraph-bridge and path-algorithm query modules.
+
+Counterparts of the reference's igraph bridge (mage/python/igraphalg.py —
+same procedure names, arguments, result fields) and the C++ algo module
+(mage/cpp/algo_module — astar / all_simple_paths / cover). Where the
+reference delegates to the igraph C library, this build routes bulk work
+through the TPU kernels (pagerank, Bellman-Ford SSSP) or scipy.csgraph over
+the same CSR export (spanning tree, all-pairs shortest paths); path
+enumeration and A* run on the host adjacency, which is where pointer-chasing
+belongs.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import math
+
+import numpy as np
+
+from ..exceptions import QueryException
+from . import mgp
+from .combinatorial_modules import _EARTH_RADIUS_M, _solve_max_flow
+
+
+def _haversine(a, b):
+    """Scalar great-circle distance in meters between (lat, lng) pairs."""
+    la1, lo1, la2, lo2 = map(math.radians, (*a, *b))
+    h = (math.sin((la2 - la1) / 2) ** 2
+         + math.cos(la1) * math.cos(la2) * math.sin((lo2 - lo1) / 2) ** 2)
+    return 2 * _EARTH_RADIUS_M * math.asin(min(1.0, math.sqrt(h)))
+
+# --- helpers -----------------------------------------------------------------
+
+
+def _dense_index(ctx, graph, vertex):
+    idx = graph.gid_to_idx.get(vertex.gid)
+    if idx is None:
+        raise QueryException("vertex is not part of the current graph")
+    return int(idx)
+
+
+def _host_adjacency(ctx, directed=True, weight_property=None,
+                    edge_types=None):
+    """gid -> [(gid, weight, edge)]; None weight_property -> weight 1.0."""
+    pid = None
+    if weight_property is not None:
+        pid = ctx.storage.property_mapper.maybe_name_to_id(weight_property)
+    type_ids = None
+    if edge_types:
+        type_ids = {ctx.storage.edge_type_mapper.maybe_name_to_id(t)
+                    for t in edge_types}
+        type_ids.discard(None)
+    adj = collections.defaultdict(list)
+    for v in ctx.accessor.vertices(ctx.view):
+        adj[v.gid]
+        for e in v.out_edges(ctx.view):
+            if type_ids is not None and e.edge_type not in type_ids:
+                continue
+            w = 1.0
+            if pid is not None:
+                val = e.get_property(pid, ctx.view)
+                w = float(val) if val is not None else 1.0
+            adj[v.gid].append((e.to_vertex().gid, w, e))
+            if not directed:
+                adj[e.to_vertex().gid].append((v.gid, w, e))
+    return adj
+
+
+def _scipy_csr(ctx, weight_property, directed):
+    """(scipy matrix, DeviceGraph) over the cached CSR export.
+
+    Parallel edges keep the MINIMUM weight (shortest-path semantics —
+    csr_matrix's default COO handling would sum them), and undirected
+    graphs take the minimum of reciprocal directed weights."""
+    from scipy.sparse import csr_matrix
+    graph = ctx.device_graph(weight_property=weight_property)
+    n, m = graph.n_nodes, graph.n_edges
+    src = np.asarray(graph.src_idx[:m])
+    dst = np.asarray(graph.col_idx[:m])
+    w = np.asarray(graph.weights[:m], dtype=np.float64)
+    if not directed:
+        src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+        w = np.concatenate([w, w])
+    if len(src):
+        order = np.lexsort((w, dst, src))
+        src, dst, w = src[order], dst[order], w[order]
+        first = np.ones(len(src), dtype=bool)
+        first[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+        src, dst, w = src[first], dst[first], w[first]
+    mat = csr_matrix((w, (src, dst)), shape=(n, n))
+    return mat, graph
+
+
+# --- igraphalg ---------------------------------------------------------------
+
+
+@mgp.read_proc("igraphalg.pagerank",
+               opt_args=[("damping", "FLOAT", 0.85),
+                         ("weights", "STRING", None),
+                         ("directed", "BOOLEAN", True),
+                         ("implementation", "STRING", "prpack")],
+               results=[("node", "NODE"), ("rank", "FLOAT")])
+def igraph_pagerank(ctx, damping=0.85, weights=None, directed=True,
+                    implementation="prpack"):
+    if implementation not in ("prpack", "arpack"):
+        raise QueryException(
+            'Implementation argument value can be "prpack" or "arpack"')
+    from ..ops.csr import from_coo
+    from ..ops.pagerank import pagerank
+    graph = ctx.device_graph(weight_property=weights)
+    if graph.n_nodes == 0:
+        return
+    if not directed:
+        # symmetrize before the kernel (each edge walks both ways)
+        m = graph.n_edges
+        src = np.asarray(graph.src_idx[:m])
+        dst = np.asarray(graph.col_idx[:m])
+        w = np.asarray(graph.weights[:m])
+        sym = from_coo(np.concatenate([src, dst]),
+                       np.concatenate([dst, src]),
+                       np.concatenate([w, w]), n_nodes=graph.n_nodes,
+                       node_gids=np.asarray(graph.node_gids))
+        ranks, _, _ = pagerank(sym, damping=float(damping))
+        graph = sym
+    else:
+        ranks, _, _ = pagerank(graph, damping=float(damping))
+    ranks = np.asarray(ranks)
+    for i in range(graph.n_nodes):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "rank": float(ranks[i])}
+
+
+@mgp.read_proc("igraphalg.maxflow",
+               args=[("source", "NODE"), ("target", "NODE")],
+               opt_args=[("capacity", "STRING", "weight")],
+               results=[("max_flow", "FLOAT")])
+def igraph_maxflow(ctx, source, target, capacity="weight"):
+    _, total, _ = _solve_max_flow(ctx, source, target, capacity)
+    yield {"max_flow": float(total)}
+
+
+def _simple_path_chains(adj, start_gid, end_gid, max_edges):
+    """All simple start->end chains as (node_gids, edges) pairs, DFS with
+    at most max_edges hops. Single enumerator shared by the igraphalg and
+    algo variants (they differ only in output shape)."""
+    stack = [(start_gid, [start_gid], [])]
+    while stack:
+        cur, nodes, edges = stack.pop()
+        if cur == end_gid and edges:
+            yield nodes, edges
+            continue
+        if len(edges) >= max_edges:
+            continue
+        for nb, _, e in adj.get(cur, ()):
+            if nb not in nodes:
+                stack.append((nb, nodes + [nb], edges + [e]))
+
+
+@mgp.read_proc("igraphalg.get_all_simple_paths",
+               args=[("v", "NODE"), ("to", "NODE")],
+               opt_args=[("cutoff", "INTEGER", -1)],
+               results=[("path", "LIST")])
+def igraph_all_simple_paths(ctx, v, to, cutoff=-1):
+    adj = _host_adjacency(ctx, directed=True)
+    limit = math.inf if cutoff is None or cutoff < 0 else int(cutoff)
+    by_gid = {}
+
+    def vertex(gid):
+        if gid not in by_gid:
+            by_gid[gid] = ctx.accessor.find_vertex(gid, ctx.view)
+        return by_gid[gid]
+
+    if v.gid == to.gid:
+        yield {"path": [vertex(v.gid)]}
+        return
+    for nodes, _ in _simple_path_chains(adj, v.gid, to.gid, limit):
+        yield {"path": [vertex(g) for g in nodes]}
+
+
+@mgp.read_proc("igraphalg.mincut",
+               args=[("source", "NODE"), ("target", "NODE")],
+               opt_args=[("capacity", "STRING", None),
+                         ("directed", "BOOLEAN", True)],
+               results=[("node", "NODE"), ("partition_id", "INTEGER")])
+def igraph_mincut(ctx, source, target, capacity=None, directed=True):
+    """s-t mincut via max-flow: the source side is what stays reachable in
+    the residual of the SAME capacity network the flow was solved on
+    (null capacity follows igraph's unit-capacity convention)."""
+    from .combinatorial_modules import residual_reachable
+    if capacity is None:
+        # unit capacities on every edge: synthesize via hop weights
+        net, reachable = _unit_capacity_cut(ctx, source, target, directed)
+    else:
+        net, _, _ = _solve_max_flow(ctx, source, target, capacity,
+                                    directed=directed)
+        reachable = residual_reachable(ctx, source.gid, capacity, net,
+                                       directed=directed)
+    for v in ctx.accessor.vertices(ctx.view):
+        yield {"node": v,
+               "partition_id": 0 if v.gid in reachable else 1}
+
+
+def _unit_capacity_cut(ctx, source, target, directed):
+    """Max-flow + source-side reachability with capacity 1.0 per edge."""
+    cap = collections.defaultdict(lambda: collections.defaultdict(float))
+    for v in ctx.accessor.vertices(ctx.view):
+        for e in v.out_edges(ctx.view):
+            cap[v.gid][e.to_vertex().gid] += 1.0
+            if not directed:
+                cap[e.to_vertex().gid][v.gid] += 1.0
+    from .combinatorial_modules import _bfs_augment
+    residual = collections.defaultdict(
+        lambda: collections.defaultdict(float))
+    for u, outs in cap.items():
+        for v, c in outs.items():
+            residual[u][v] += c
+            residual[v][u] += 0.0
+    while True:
+        path, flow = _bfs_augment(cap, residual, source.gid, target.gid)
+        if path is None:
+            break
+        for i in range(len(path) - 1):
+            residual[path[i]][path[i + 1]] -= flow
+            residual[path[i + 1]][path[i]] += flow
+    reachable = {source.gid}
+    queue = collections.deque([source.gid])
+    while queue:
+        u = queue.popleft()
+        for v, c in residual.get(u, {}).items():
+            if c > 1e-12 and v not in reachable:
+                reachable.add(v)
+                queue.append(v)
+    net = {}
+    for u, outs in cap.items():
+        for v, c in outs.items():
+            if c - residual[u][v] > 1e-12:
+                net[(u, v)] = c - residual[u][v]
+    return net, reachable
+
+
+@mgp.read_proc("igraphalg.topological_sort",
+               opt_args=[("mode", "STRING", "out")],
+               results=[("nodes", "LIST")])
+def igraph_topological_sort(ctx, mode="out"):
+    if mode not in ("out", "in"):
+        raise QueryException('Mode can only be either "out" or "in"')
+    adj = _host_adjacency(ctx, directed=True)
+    if mode == "in":
+        rev = collections.defaultdict(list)
+        for u, nbrs in adj.items():
+            rev[u]
+            for nb, w, e in nbrs:
+                rev[nb].append((u, w, e))
+        adj = rev
+    indeg = {g: 0 for g in adj}
+    for u, nbrs in adj.items():
+        for nb, _, _ in nbrs:
+            indeg[nb] = indeg.get(nb, 0) + 1
+    queue = collections.deque(sorted(g for g, d in indeg.items() if d == 0))
+    out = []
+    while queue:
+        u = queue.popleft()
+        out.append(u)
+        for nb, _, _ in adj.get(u, ()):
+            indeg[nb] -= 1
+            if indeg[nb] == 0:
+                queue.append(nb)
+    if len(out) != len(indeg):
+        raise QueryException(
+            "Topological sort can't be performed on graph that contains "
+            "cycle!")
+    yield {"nodes": [ctx.accessor.find_vertex(g, ctx.view) for g in out]}
+
+
+@mgp.read_proc("igraphalg.spanning_tree",
+               opt_args=[("weights", "STRING", None),
+                         ("directed", "BOOLEAN", False)],
+               results=[("tree", "LIST")])
+def igraph_spanning_tree(ctx, weights=None, directed=False):
+    """directed=True keeps each directed edge as-is (scipy, like igraph,
+    still treats entries as undirected edges for the MST); directed=False
+    first min-combines reciprocal weights."""
+    from scipy.sparse.csgraph import minimum_spanning_tree
+    mat, graph = _scipy_csr(ctx, weights, directed=directed)
+    if graph.n_nodes == 0:
+        yield {"tree": []}
+        return
+    mst = minimum_spanning_tree(mat).tocoo()
+    tree = []
+    for i, j in zip(mst.row, mst.col):
+        a = ctx.vertex_by_index(graph, int(i))
+        b = ctx.vertex_by_index(graph, int(j))
+        if a is not None and b is not None:
+            tree.append([a, b])
+    yield {"tree": tree}
+
+
+@mgp.read_proc("igraphalg.shortest_path_length",
+               args=[("source", "NODE"), ("target", "NODE")],
+               opt_args=[("weights", "STRING", None),
+                         ("directed", "BOOLEAN", True)],
+               results=[("length", "FLOAT")])
+def igraph_shortest_path_length(ctx, source, target, weights=None,
+                                directed=True):
+    from ..ops.traversal import sssp
+    graph = ctx.device_graph(weight_property=weights)
+    src = _dense_index(ctx, graph, source)
+    dst = _dense_index(ctx, graph, target)
+    dist, _ = sssp(graph, src, weighted=weights is not None,
+                   directed=directed)
+    length = float(np.asarray(dist)[dst])
+    yield {"length": length if math.isfinite(length) else math.inf}
+
+
+@mgp.read_proc("igraphalg.all_shortest_path_lengths",
+               opt_args=[("weights", "STRING", None),
+                         ("directed", "BOOLEAN", False)],
+               results=[("src_node", "NODE"), ("dest_node", "NODE"),
+                        ("length", "FLOAT")])
+def igraph_all_shortest_path_lengths(ctx, weights=None, directed=False):
+    from scipy.sparse.csgraph import shortest_path
+    mat, graph = _scipy_csr(ctx, weights, directed)
+    if graph.n_nodes == 0:
+        return
+    unweighted = weights is None
+    lengths = shortest_path(mat, directed=directed,
+                            unweighted=unweighted)
+    nodes = [ctx.vertex_by_index(graph, i) for i in range(graph.n_nodes)]
+    for i in range(graph.n_nodes):
+        for j in range(graph.n_nodes):
+            if nodes[i] is not None and nodes[j] is not None:
+                yield {"src_node": nodes[i], "dest_node": nodes[j],
+                       "length": float(lengths[i][j])}
+
+
+@mgp.read_proc("igraphalg.get_shortest_path",
+               args=[("source", "NODE"), ("target", "NODE")],
+               opt_args=[("weights", "STRING", None),
+                         ("directed", "BOOLEAN", True)],
+               results=[("path", "LIST")])
+def igraph_get_shortest_path(ctx, source, target, weights=None,
+                             directed=True):
+    from scipy.sparse.csgraph import dijkstra
+    mat, graph = _scipy_csr(ctx, weights, directed)
+    src = _dense_index(ctx, graph, source)
+    dst = _dense_index(ctx, graph, target)
+    if weights is None:
+        mat = mat.sign()  # hop counts
+    _, predecessors = dijkstra(mat, directed=directed, indices=src,
+                               return_predecessors=True)
+    if predecessors[dst] < 0 and src != dst:
+        yield {"path": []}
+        return
+    chain = [dst]
+    while chain[-1] != src:
+        chain.append(int(predecessors[chain[-1]]))
+    chain.reverse()
+    yield {"path": [ctx.vertex_by_index(graph, i) for i in chain]}
+
+
+# --- algo (astar / all_simple_paths / cover) ---------------------------------
+
+
+@mgp.read_proc("algo.astar",
+               args=[("start", "NODE"), ("target", "NODE")],
+               opt_args=[("config", "MAP", None)],
+               results=[("path", "PATH"), ("weight", "FLOAT")])
+def algo_astar(ctx, start, target, config=None):
+    """A* over edge distances with a great-circle heuristic when nodes
+    carry latitude/longitude (config: distance_prop, latitude_name,
+    longitude_name, unweighted — reference algo_module astar)."""
+    from ..query.values import Path
+    config = config or {}
+    distance_prop = config.get("distance_prop", "distance")
+    lat_name = config.get("latitude_name", "lat")
+    lon_name = config.get("longitude_name", "lon")
+    unweighted = bool(config.get("unweighted", False))
+    adj = _host_adjacency(
+        ctx, directed=True,
+        weight_property=None if unweighted else distance_prop)
+
+    lat_pid = ctx.storage.property_mapper.maybe_name_to_id(lat_name)
+    lon_pid = ctx.storage.property_mapper.maybe_name_to_id(lon_name)
+    coord_cache = {}
+
+    def coords(gid):
+        if gid in coord_cache:
+            return coord_cache[gid]
+        out = None
+        if lat_pid is not None and lon_pid is not None:
+            v = ctx.accessor.find_vertex(gid, ctx.view)
+            if v is not None:
+                lat = v.get_property(lat_pid, ctx.view)
+                lon = v.get_property(lon_pid, ctx.view)
+                if lat is not None and lon is not None:
+                    out = (float(lat), float(lon))
+        coord_cache[gid] = out
+        return out
+
+    t_coords = coords(target.gid)
+    h_cache = {}
+
+    def heuristic(gid):
+        if unweighted or t_coords is None:
+            return 0.0
+        h = h_cache.get(gid)
+        if h is None:
+            c = coords(gid)
+            h = 0.0 if c is None else _haversine(c, t_coords)
+            h_cache[gid] = h
+        return h
+
+    dist = {start.gid: 0.0}
+    parent = {}
+    heap = [(heuristic(start.gid), start.gid)]
+    seen = set()
+    while heap:
+        _, u = heapq.heappop(heap)
+        if u in seen:
+            continue
+        if u == target.gid:
+            break
+        seen.add(u)
+        for nb, w, e in adj.get(u, ()):
+            nd = dist[u] + w
+            if nd < dist.get(nb, math.inf):
+                dist[nb] = nd
+                parent[nb] = (u, e)
+                heapq.heappush(heap, (nd + heuristic(nb), nb))
+    if target.gid not in dist:
+        return
+    items = [ctx.accessor.find_vertex(target.gid, ctx.view)]
+    cur = target.gid
+    while cur != start.gid:
+        prev, edge = parent[cur]
+        items = [ctx.accessor.find_vertex(prev, ctx.view), edge] + items
+        cur = prev
+    yield {"path": Path(items), "weight": float(dist[target.gid])}
+
+
+@mgp.read_proc("algo.all_simple_paths",
+               args=[("start_node", "NODE"), ("end_node", "NODE"),
+                     ("relationship_types", "LIST"),
+                     ("max_length", "INTEGER")],
+               results=[("path", "PATH")])
+def algo_all_simple_paths(ctx, start_node, end_node, relationship_types,
+                          max_length):
+    from ..query.values import Path
+    adj = _host_adjacency(ctx, directed=True,
+                          edge_types=relationship_types or None)
+    if max_length is None or max_length < 0:
+        raise QueryException("max_length must be a non-negative integer")
+    for nodes, edges in _simple_path_chains(adj, start_node.gid,
+                                            end_node.gid, max_length):
+        items = [ctx.accessor.find_vertex(nodes[0], ctx.view)]
+        for k, e in enumerate(edges):
+            items.extend(
+                [e, ctx.accessor.find_vertex(nodes[k + 1], ctx.view)])
+        yield {"path": Path(items)}
+
+
+@mgp.read_proc("algo.cover",
+               args=[("nodes", "LIST")],
+               results=[("rel", "RELATIONSHIP")])
+def algo_cover(ctx, nodes):
+    """All relationships whose both endpoints are in the given node set
+    (reference algo_module cover)."""
+    wanted = {v.gid for v in nodes}
+    for v in nodes:
+        for e in v.out_edges(ctx.view):
+            if e.to_vertex().gid in wanted:
+                yield {"rel": e}

@@ -1,0 +1,202 @@
+"""igraphalg bridge + algo module parity tests."""
+
+import math
+
+import pytest
+
+from memgraph_tpu.exceptions import QueryException
+from memgraph_tpu.procedures import load_builtin_modules
+from memgraph_tpu.procedures.mock import mock_context
+from memgraph_tpu.query.procedures.registry import global_registry
+
+load_builtin_modules()
+
+
+def proc(name):
+    p = global_registry.find(name)
+    assert p is not None, f"procedure {name} not registered"
+    return p.func
+
+
+def chain_ctx():
+    # 0 -> 1 -> 2 -> 3 with a shortcut 0 -> 3 (weight 10)
+    return mock_context(
+        nodes=[{} for _ in range(4)],
+        edges=[(0, 1, "E", {"weight": 1.0}), (1, 2, "E", {"weight": 1.0}),
+               (2, 3, "E", {"weight": 1.0}), (0, 3, "E", {"weight": 10.0})])
+
+
+def test_pagerank_delegates_to_kernel():
+    ctx, vs = mock_context(nodes=[{}, {}, {}],
+                           edges=[(0, 2, "E"), (1, 2, "E")])
+    rows = list(proc("igraphalg.pagerank")(ctx))
+    ranks = {r["node"].gid: r["rank"] for r in rows}
+    assert ranks[vs[2].gid] > ranks[vs[0].gid]
+    assert abs(sum(ranks.values()) - 1.0) < 1e-3
+    with pytest.raises(QueryException):
+        list(proc("igraphalg.pagerank")(ctx, 0.85, None, True, "bogus"))
+
+
+def test_maxflow_alias():
+    ctx, vs = chain_ctx()
+    rows = list(proc("igraphalg.maxflow")(ctx, vs[0], vs[3]))
+    assert rows == [{"max_flow": 11.0}]
+
+
+def test_get_all_simple_paths_and_cutoff():
+    ctx, vs = chain_ctx()
+    rows = list(proc("igraphalg.get_all_simple_paths")(ctx, vs[0], vs[3]))
+    paths = sorted([v.gid for v in r["path"]] for r in rows)
+    assert paths == [[vs[0].gid, vs[1].gid, vs[2].gid, vs[3].gid],
+                     [vs[0].gid, vs[3].gid]]
+    rows = list(proc("igraphalg.get_all_simple_paths")(ctx, vs[0], vs[3], 1))
+    assert len(rows) == 1  # only the direct edge fits in cutoff 1
+
+
+def test_mincut_partitions():
+    # bottleneck edge 1->2 (capacity 1) separates {0,1} from {2,3}
+    ctx, vs = mock_context(
+        nodes=[{} for _ in range(4)],
+        edges=[(0, 1, "E", {"weight": 5.0}), (1, 2, "E", {"weight": 1.0}),
+               (2, 3, "E", {"weight": 5.0})])
+    rows = list(proc("igraphalg.mincut")(ctx, vs[0], vs[3], "weight"))
+    part = {r["node"].gid: r["partition_id"] for r in rows}
+    assert part[vs[0].gid] == part[vs[1].gid] == 0
+    assert part[vs[2].gid] == part[vs[3].gid] == 1
+
+
+def test_topological_sort_and_cycle():
+    ctx, vs = mock_context(nodes=[{} for _ in range(3)],
+                           edges=[(0, 1, "E"), (1, 2, "E")])
+    rows = list(proc("igraphalg.topological_sort")(ctx))
+    order = [v.gid for v in rows[0]["nodes"]]
+    assert order.index(vs[0].gid) < order.index(vs[1].gid) < \
+        order.index(vs[2].gid)
+    ctx2, _ = mock_context(nodes=[{}, {}],
+                           edges=[(0, 1, "E"), (1, 0, "E")])
+    with pytest.raises(QueryException):
+        list(proc("igraphalg.topological_sort")(ctx2))
+    with pytest.raises(QueryException):
+        list(proc("igraphalg.topological_sort")(ctx, "sideways"))
+
+
+def test_spanning_tree():
+    # triangle with one heavy edge: MST keeps the two light edges
+    ctx, vs = mock_context(
+        nodes=[{} for _ in range(3)],
+        edges=[(0, 1, "E", {"w": 1.0}), (1, 2, "E", {"w": 1.0}),
+               (0, 2, "E", {"w": 9.0})])
+    rows = list(proc("igraphalg.spanning_tree")(ctx, "w"))
+    tree = {frozenset((a.gid, b.gid)) for a, b in rows[0]["tree"]}
+    assert tree == {frozenset((vs[0].gid, vs[1].gid)),
+                    frozenset((vs[1].gid, vs[2].gid))}
+
+
+def test_shortest_path_length_weighted_vs_hops():
+    ctx, vs = chain_ctx()
+    rows = list(proc("igraphalg.shortest_path_length")(
+        ctx, vs[0], vs[3], "weight"))
+    assert rows[0]["length"] == 3.0  # 1+1+1 beats the 10 shortcut
+    rows = list(proc("igraphalg.shortest_path_length")(ctx, vs[0], vs[3]))
+    assert rows[0]["length"] == 1.0  # hop count takes the shortcut
+
+
+def test_all_shortest_path_lengths_symmetric():
+    ctx, vs = mock_context(nodes=[{}, {}], edges=[(0, 1, "E")])
+    rows = list(proc("igraphalg.all_shortest_path_lengths")(ctx))
+    lengths = {(r["src_node"].gid, r["dest_node"].gid): r["length"]
+               for r in rows}
+    assert lengths[(vs[0].gid, vs[1].gid)] == 1.0
+    assert lengths[(vs[1].gid, vs[0].gid)] == 1.0  # undirected default
+
+
+def test_get_shortest_path_vertices():
+    ctx, vs = chain_ctx()
+    rows = list(proc("igraphalg.get_shortest_path")(
+        ctx, vs[0], vs[3], "weight"))
+    assert [v.gid for v in rows[0]["path"]] == [v.gid for v in vs]
+    # unreachable -> empty path
+    ctx2, vs2 = mock_context(nodes=[{}, {}], edges=[])
+    rows = list(proc("igraphalg.get_shortest_path")(ctx2, vs2[0], vs2[1]))
+    assert rows[0]["path"] == []
+
+
+def test_astar_with_haversine_heuristic():
+    nodes = [{"lat": 0.0, "lon": 0.0}, {"lat": 0.0, "lon": 1.0},
+             {"lat": 0.0, "lon": 2.0}, {"lat": 5.0, "lon": 1.0}]
+    ctx, vs = mock_context(
+        nodes=nodes,
+        edges=[(0, 1, "R", {"distance": 1.0}), (1, 2, "R", {"distance": 1.0}),
+               (0, 3, "R", {"distance": 1.0}), (3, 2, "R", {"distance": 5.0})])
+    rows = list(proc("algo.astar")(ctx, vs[0], vs[2]))
+    assert rows[0]["weight"] == 2.0
+    assert [v.gid for v in rows[0]["path"].vertices()] == \
+        [vs[0].gid, vs[1].gid, vs[2].gid]
+    # unreachable target -> no rows
+    ctx2, vs2 = mock_context(nodes=[{}, {}], edges=[])
+    assert list(proc("algo.astar")(ctx2, vs2[0], vs2[1])) == []
+
+
+def test_algo_all_simple_paths_type_filter():
+    ctx, vs = mock_context(
+        nodes=[{} for _ in range(3)],
+        edges=[(0, 1, "A"), (1, 2, "A"), (0, 2, "B")])
+    rows = list(proc("algo.all_simple_paths")(ctx, vs[0], vs[2], ["A"], 5))
+    assert len(rows) == 1
+    assert [v.gid for v in rows[0]["path"].vertices()] == \
+        [vs[0].gid, vs[1].gid, vs[2].gid]
+    rows = list(proc("algo.all_simple_paths")(ctx, vs[0], vs[2], [], 5))
+    assert len(rows) == 2
+    with pytest.raises(QueryException):
+        list(proc("algo.all_simple_paths")(ctx, vs[0], vs[2], [], -1))
+
+
+def test_algo_cover():
+    ctx, vs = mock_context(
+        nodes=[{} for _ in range(3)],
+        edges=[(0, 1, "E"), (1, 2, "E")])
+    rows = list(proc("algo.cover")(ctx, [vs[0], vs[1]]))
+    assert len(rows) == 1  # only 0->1 has both endpoints in the set
+    assert rows[0]["rel"].from_vertex().gid == vs[0].gid
+
+
+def test_mincut_unit_capacities_and_undirected():
+    # no weight property at all: igraph unit-capacity convention must
+    # still separate source from target
+    ctx, vs = mock_context(nodes=[{} for _ in range(3)],
+                           edges=[(0, 1, "E"), (1, 2, "E")])
+    rows = list(proc("igraphalg.mincut")(ctx, vs[0], vs[2]))
+    part = {r["node"].gid: r["partition_id"] for r in rows}
+    assert part[vs[0].gid] == 0 and part[vs[2].gid] == 1
+    # undirected: A->B, C->B — cut must separate A from C through B
+    ctx2, vs2 = mock_context(
+        nodes=[{} for _ in range(3)],
+        edges=[(0, 1, "E", {"w": 5.0}), (2, 1, "E", {"w": 5.0})])
+    rows = list(proc("igraphalg.mincut")(ctx2, vs2[0], vs2[2], "w", False))
+    part = {r["node"].gid: r["partition_id"] for r in rows}
+    assert part[vs2[0].gid] == 0 and part[vs2[2].gid] == 1
+
+
+def test_parallel_edges_take_min_weight():
+    ctx, vs = mock_context(
+        nodes=[{}, {}],
+        edges=[(0, 1, "E", {"w": 1.0}), (0, 1, "E", {"w": 9.0})])
+    rows = list(proc("igraphalg.get_shortest_path")(ctx, vs[0], vs[1], "w"))
+    assert [v.gid for v in rows[0]["path"]] == [vs[0].gid, vs[1].gid]
+    rows = list(proc("igraphalg.all_shortest_path_lengths")(ctx, "w"))
+    lengths = {(r["src_node"].gid, r["dest_node"].gid): r["length"]
+               for r in rows}
+    assert lengths[(vs[0].gid, vs[1].gid)] == 1.0
+
+
+def test_pagerank_undirected():
+    ctx, vs = mock_context(nodes=[{}, {}, {}],
+                           edges=[(0, 2, "E"), (1, 2, "E")])
+    directed = {r["node"].gid: r["rank"]
+                for r in proc("igraphalg.pagerank")(ctx)}
+    undirected = {r["node"].gid: r["rank"]
+                  for r in proc("igraphalg.pagerank")(ctx, 0.85, None,
+                                                      False)}
+    # undirected walk flows back out of the sink: its rank drops
+    assert undirected[vs[2].gid] < directed[vs[2].gid]
+    assert abs(sum(undirected.values()) - 1.0) < 1e-3
