@@ -1,0 +1,619 @@
+"""Graph-utility query modules (the APOC-like MAGE surface).
+
+Counterparts of the reference's C++ utility modules under mage/cpp/:
+uuid, label, node, nodes, neighbors, meta, path, merge, text, util,
+distance_calculator, and periodic (periodic.iterate / periodic.delete run
+batched Cypher through a system interpreter session, committing per batch
+exactly like the reference's periodic module). Procedure names, arguments,
+and result fields follow the reference modules.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import re
+import uuid as _uuid
+
+from ..exceptions import QueryException
+from . import mgp
+from .igraph_module import _haversine
+
+# --- uuid / util / text ------------------------------------------------------
+
+
+@mgp.read_proc("uuid.get", results=[("uuid", "STRING")])
+def uuid_get(ctx):
+    yield {"uuid": str(_uuid.uuid4())}
+
+
+@mgp.read_proc("util.md5", args=[("values", "LIST")],
+               results=[("result", "STRING")])
+def util_md5(ctx, values):
+    digest = hashlib.md5()
+    for v in values:
+        digest.update(str(v).encode("utf-8"))
+    yield {"result": digest.hexdigest()}
+
+
+@mgp.read_proc("text.join",
+               args=[("strings", "LIST"), ("delimiter", "STRING")],
+               results=[("string", "STRING")])
+def text_join(ctx, strings, delimiter):
+    if any(not isinstance(s, str) for s in strings):
+        raise QueryException("text.join expects a list of strings")
+    yield {"string": delimiter.join(strings)}
+
+
+@mgp.read_proc("text.format",
+               args=[("text", "STRING"), ("params", "LIST")],
+               results=[("result", "STRING")])
+def text_format(ctx, text, params):
+    yield {"result": text.format(*params)}
+
+
+@mgp.read_proc("text.regex_groups",
+               args=[("input", "STRING"), ("regex", "STRING")],
+               results=[("results", "LIST")])
+def text_regex_groups(ctx, input, regex):
+    out = []
+    for m in re.finditer(regex, input):
+        out.append([m.group(0), *m.groups()])
+    yield {"results": out}
+
+
+# --- label / node / nodes ----------------------------------------------------
+
+
+@mgp.read_proc("label.exists",
+               args=[("node", "ANY"), ("label", "STRING")],
+               results=[("exists", "BOOLEAN")])
+def label_exists(ctx, node, label):
+    lid = ctx.storage.label_mapper.maybe_name_to_id(label)
+    exists = (lid is not None and hasattr(node, "has_label")
+              and node.has_label(lid, ctx.view))
+    yield {"exists": bool(exists)}
+
+
+@mgp.read_proc("node.degree_in",
+               args=[("node", "NODE")],
+               opt_args=[("type", "STRING", "")],
+               results=[("degree", "INTEGER")])
+def node_degree_in(ctx, node, type=""):
+    yield {"degree": _degree(ctx, node, type, incoming=True)}
+
+
+@mgp.read_proc("node.degree_out",
+               args=[("node", "NODE")],
+               opt_args=[("type", "STRING", "")],
+               results=[("degree", "INTEGER")])
+def node_degree_out(ctx, node, type=""):
+    yield {"degree": _degree(ctx, node, type, incoming=False)}
+
+
+def _degree(ctx, node, type_name, incoming):
+    type_ids = None
+    if type_name:
+        tid = ctx.storage.edge_type_mapper.maybe_name_to_id(type_name)
+        if tid is None:
+            return 0
+        type_ids = [tid]
+    edges = (node.in_edges(ctx.view, edge_types=type_ids) if incoming
+             else node.out_edges(ctx.view, edge_types=type_ids))
+    return len(edges)
+
+
+@mgp.read_proc("node.relationship_types",
+               args=[("node", "NODE")],
+               results=[("relationship_types", "LIST")])
+def node_relationship_types(ctx, node):
+    mapper = ctx.storage.edge_type_mapper
+    types = {mapper.id_to_name(e.edge_type)
+             for e in node.out_edges(ctx.view)}
+    types |= {mapper.id_to_name(e.edge_type)
+              for e in node.in_edges(ctx.view)}
+    yield {"relationship_types": sorted(types)}
+
+
+@mgp.read_proc("node.relationships_exist",
+               args=[("node", "NODE"), ("relationships", "LIST")],
+               results=[("result", "MAP")])
+def node_relationships_exist(ctx, node, relationships):
+    """Each pattern is "TYPE" / "TYPE>" (outgoing) / "<TYPE" (incoming),
+    as in the reference's node module."""
+    result = {}
+    for pattern in relationships:
+        result[pattern] = _relationship_exists(ctx, node, pattern)
+    yield {"result": result}
+
+
+def _relationship_exists(ctx, node, pattern):
+    name = pattern.strip("<>")
+    tid = ctx.storage.edge_type_mapper.maybe_name_to_id(name)
+    if tid is None:
+        return False
+    check_out = not pattern.startswith("<")
+    check_in = not pattern.endswith(">")
+    if check_out and node.out_edges(ctx.view, edge_types=[tid]):
+        return True
+    if check_in and node.in_edges(ctx.view, edge_types=[tid]):
+        return True
+    return False
+
+
+@mgp.write_proc("nodes.link",
+                args=[("nodes", "LIST"), ("type", "STRING")],
+                results=[("success", "BOOLEAN")])
+def nodes_link(ctx, nodes, type):
+    """Chain-link the given nodes with TYPE relationships (reference
+    nodes_module Link)."""
+    tid = ctx.storage.edge_type_mapper.name_to_id(type)
+    for a, b in zip(nodes, nodes[1:]):
+        ctx.accessor.create_edge(a, b, tid)
+    yield {"success": True}
+
+
+@mgp.write_proc("nodes.delete",
+                args=[("nodes", "LIST")],
+                results=[("success", "BOOLEAN")])
+def nodes_delete(ctx, nodes):
+    for node in nodes:
+        ctx.accessor.delete_vertex(node, detach=True)
+    yield {"success": True}
+
+
+# --- neighbors ---------------------------------------------------------------
+
+
+def _hop_frontiers(ctx, node, rel_types, max_distance):
+    """[{gids at hop 1}, {hop 2}, ...] breadth-first, undirected unless a
+    pattern pins a direction ("TYPE>" out, "<TYPE" in)."""
+    out_ids, in_ids, any_dir = set(), set(), not rel_types
+    for pattern in rel_types or []:
+        name = pattern.strip("<>")
+        tid = ctx.storage.edge_type_mapper.maybe_name_to_id(name)
+        if tid is None:
+            continue
+        if not pattern.startswith("<"):
+            out_ids.add(tid)
+        if not pattern.endswith(">"):
+            in_ids.add(tid)
+    seen = {node.gid}
+    frontier = [node]
+    layers = []
+    for _ in range(max_distance):
+        nxt = []
+        for v in frontier:
+            for e in v.out_edges(ctx.view):
+                if any_dir or e.edge_type in out_ids:
+                    o = e.to_vertex()
+                    if o.gid not in seen:
+                        seen.add(o.gid)
+                        nxt.append(o)
+            for e in v.in_edges(ctx.view):
+                if any_dir or e.edge_type in in_ids:
+                    o = e.from_vertex()
+                    if o.gid not in seen:
+                        seen.add(o.gid)
+                        nxt.append(o)
+        if not nxt:
+            break
+        layers.append(nxt)
+        frontier = nxt
+    return layers
+
+
+@mgp.read_proc("neighbors.at_hop",
+               args=[("node", "NODE"), ("rel_type", "LIST"),
+                     ("distance", "INTEGER")],
+               results=[("nodes", "NODE")])
+def neighbors_at_hop(ctx, node, rel_type, distance):
+    if distance < 1:
+        raise QueryException("distance must be a positive integer")
+    layers = _hop_frontiers(ctx, node, rel_type, distance)
+    if len(layers) >= distance:
+        for v in layers[distance - 1]:
+            yield {"nodes": v}
+
+
+@mgp.read_proc("neighbors.by_hop",
+               args=[("node", "NODE"), ("rel_type", "LIST"),
+                     ("distance", "INTEGER")],
+               results=[("nodes", "LIST")])
+def neighbors_by_hop(ctx, node, rel_type, distance):
+    if distance < 1:
+        raise QueryException("distance must be a positive integer")
+    layers = _hop_frontiers(ctx, node, rel_type, distance)
+    for k in range(distance):
+        yield {"nodes": layers[k] if k < len(layers) else []}
+
+
+# --- meta --------------------------------------------------------------------
+
+
+_META_RESULTS = [("labelCount", "INTEGER"),
+                 ("relationshipTypeCount", "INTEGER"),
+                 ("propertyKeyCount", "INTEGER"),
+                 ("nodeCount", "INTEGER"),
+                 ("relationshipCount", "INTEGER"),
+                 ("labels", "MAP"), ("relationshipTypes", "MAP"),
+                 ("relationshipTypesCount", "MAP"), ("stats", "MAP")]
+
+
+def _meta_stats(ctx):
+    """Result fields and key formats follow the reference meta_module
+    (algorithm/meta.hpp kReturnStats1-9, meta.cpp UpdateRelationshipTypes:
+    "(:Label)-[:TYPE]->()" / "()-[:TYPE]->(:Label)" keys)."""
+    label_mapper = ctx.storage.label_mapper
+    type_mapper = ctx.storage.edge_type_mapper
+    labels = collections.Counter()
+    rel_types = collections.Counter()
+    rel_types_cnt = collections.Counter()
+    node_count = 0
+    rel_count = 0
+    for v in ctx.accessor.vertices(ctx.view):
+        node_count += 1
+        for lid in v.labels(ctx.view):
+            labels[label_mapper.id_to_name(lid)] += 1
+        for e in v.out_edges(ctx.view):
+            rel_count += 1
+            type_name = type_mapper.id_to_name(e.edge_type)
+            rel_types_cnt[type_name] += 1
+            for lid in e.from_vertex().labels(ctx.view):
+                key = f"(:{label_mapper.id_to_name(lid)})-" \
+                      f"[:{type_name}]->()"
+                rel_types[key] += 1
+            for lid in e.to_vertex().labels(ctx.view):
+                key = f"()-[:{type_name}]->" \
+                      f"(:{label_mapper.id_to_name(lid)})"
+                rel_types[key] += 1
+    out = {
+        "labelCount": len(labels),
+        "relationshipTypeCount": len(rel_types_cnt),
+        "propertyKeyCount": len(ctx.storage.property_mapper.all_names()),
+        "nodeCount": node_count,
+        "relationshipCount": rel_count,
+        "labels": dict(labels),
+        "relationshipTypes": dict(rel_types),
+        "relationshipTypesCount": dict(rel_types_cnt),
+    }
+    out["stats"] = dict(out)
+    return out
+
+
+@mgp.read_proc("meta.stats_online", results=_META_RESULTS)
+def meta_stats_online(ctx):
+    yield _meta_stats(ctx)
+
+
+@mgp.read_proc("meta.stats_offline", results=_META_RESULTS)
+def meta_stats_offline(ctx):
+    yield _meta_stats(ctx)
+
+
+# --- path --------------------------------------------------------------------
+
+
+@mgp.read_proc("path.expand",
+               args=[("start", "ANY"), ("relationships", "LIST"),
+                     ("labels", "LIST"), ("min_hops", "INTEGER"),
+                     ("max_hops", "INTEGER")],
+               results=[("result", "PATH")])
+def path_expand(ctx, start, relationships, labels, min_hops, max_hops):
+    """BFS path expansion with relationship-direction patterns ("TYPE>",
+    "<TYPE", "TYPE") and label filters ("+Allowed", "-Forbidden"),
+    following the reference path_module Expand."""
+    from ..query.values import Path
+    starts = start if isinstance(start, (list, tuple)) else [start]
+    allow, deny = set(), set()
+    for spec in labels or []:
+        if spec.startswith("-"):
+            deny.add(spec[1:])
+        else:
+            allow.add(spec.lstrip("+"))
+    out_ids, in_ids, any_dir = set(), set(), not relationships
+    for pattern in relationships or []:
+        name = pattern.strip("<>")
+        tid = ctx.storage.edge_type_mapper.maybe_name_to_id(name)
+        if tid is None:
+            continue
+        if not pattern.startswith("<"):
+            out_ids.add(tid)
+        if not pattern.endswith(">"):
+            in_ids.add(tid)
+
+    def label_ok(v):
+        names = {ctx.storage.label_mapper.id_to_name(l)
+                 for l in v.labels(ctx.view)}
+        if names & deny:
+            return False
+        return not allow or bool(names & allow)
+
+    for s in starts:
+        stack = [(s, [s], [])]
+        while stack:
+            cur, nodes, edges = stack.pop()
+            if len(edges) >= min_hops:
+                items = [nodes[0]]
+                for k, e in enumerate(edges):
+                    items.extend([e, nodes[k + 1]])
+                yield {"result": Path(items)}
+            if len(edges) >= max_hops:
+                continue
+            steps = []
+            for e in cur.out_edges(ctx.view):
+                if any_dir or e.edge_type in out_ids:
+                    steps.append((e, e.to_vertex()))
+            for e in cur.in_edges(ctx.view):
+                if any_dir or e.edge_type in in_ids:
+                    steps.append((e, e.from_vertex()))
+            for e, nxt in steps:
+                if any(nxt.gid == v.gid for v in nodes):
+                    continue
+                if not label_ok(nxt):
+                    continue
+                stack.append((nxt, nodes + [nxt], edges + [e]))
+
+
+@mgp.read_proc("path.subgraph_nodes",
+               args=[("start", "ANY"), ("config", "MAP")],
+               results=[("nodes", "NODE")])
+def path_subgraph_nodes(ctx, start, config):
+    for v in _subgraph(ctx, start, config):
+        yield {"nodes": v}
+
+
+@mgp.read_proc("path.subgraph_all",
+               args=[("start", "ANY"), ("config", "MAP")],
+               results=[("nodes", "LIST"), ("rels", "LIST")])
+def path_subgraph_all(ctx, start, config):
+    nodes = _subgraph(ctx, start, config)
+    gids = {v.gid for v in nodes}
+    rels = []
+    for v in nodes:
+        for e in v.out_edges(ctx.view):
+            if e.to_vertex().gid in gids:
+                rels.append(e)
+    yield {"nodes": nodes, "rels": rels}
+
+
+def _subgraph(ctx, start, config):
+    config = config or {}
+    max_level = config.get("max_level", -1)
+    max_level = float("inf") if max_level is None or max_level < 0 \
+        else int(max_level)
+    starts = start if isinstance(start, (list, tuple)) else [start]
+    seen = {v.gid: v for v in starts}
+    frontier = list(starts)
+    level = 0
+    while frontier and level < max_level:
+        nxt = []
+        for v in frontier:
+            for e in list(v.out_edges(ctx.view)) + list(v.in_edges(ctx.view)):
+                o = e.to_vertex() if e.from_vertex().gid == v.gid \
+                    else e.from_vertex()
+                if o.gid not in seen:
+                    seen[o.gid] = o
+                    nxt.append(o)
+        frontier = nxt
+        level += 1
+    return list(seen.values())
+
+
+# --- merge -------------------------------------------------------------------
+
+
+@mgp.write_proc("merge.node",
+                args=[("labels", "LIST"), ("identProps", "MAP"),
+                      ("createProps", "MAP"), ("matchProps", "MAP")],
+                results=[("node", "NODE")])
+def merge_node(ctx, labels, identProps, createProps, matchProps):
+    """MERGE semantics: find a node carrying all labels + identProps; on
+    create also set createProps, on match also set matchProps (reference
+    merge_module Node)."""
+    if not identProps:
+        raise QueryException("merge.node requires non-empty identProps")
+    lids = [ctx.storage.label_mapper.name_to_id(name) for name in labels]
+    pid_of = ctx.storage.property_mapper.name_to_id
+    ident = {pid_of(k): v for k, v in identProps.items()}
+    for v in ctx.accessor.vertices(ctx.view):
+        if all(v.has_label(l, ctx.view) for l in lids) and \
+                all(v.get_property(p, ctx.view) == val
+                    for p, val in ident.items()):
+            for k, val in (matchProps or {}).items():
+                v.set_property(pid_of(k), val)
+            yield {"node": v}
+            return
+    v = ctx.accessor.create_vertex()
+    for l in lids:
+        v.add_label(l)
+    for p, val in ident.items():
+        v.set_property(p, val)
+    for k, val in (createProps or {}).items():
+        v.set_property(pid_of(k), val)
+    yield {"node": v}
+
+
+@mgp.write_proc("merge.relationship",
+                args=[("startNode", "NODE"), ("relationshipType", "STRING"),
+                      ("identProps", "MAP"), ("createProps", "MAP"),
+                      ("endNode", "NODE"), ("matchProps", "MAP")],
+                results=[("rel", "RELATIONSHIP")])
+def merge_relationship(ctx, startNode, relationshipType, identProps,
+                       createProps, endNode, matchProps):
+    tid = ctx.storage.edge_type_mapper.name_to_id(relationshipType)
+    pid_of = ctx.storage.property_mapper.name_to_id
+    ident = {pid_of(k): v for k, v in (identProps or {}).items()}
+    for e in startNode.out_edges(ctx.view, edge_types=[tid]):
+        if e.to_vertex().gid != endNode.gid:
+            continue
+        if all(e.get_property(p, ctx.view) == val
+               for p, val in ident.items()):
+            for k, val in (matchProps or {}).items():
+                e.set_property(pid_of(k), val)
+            yield {"rel": e}
+            return
+    e = ctx.accessor.create_edge(startNode, endNode, tid)
+    for p, val in ident.items():
+        e.set_property(p, val)
+    for k, val in (createProps or {}).items():
+        e.set_property(pid_of(k), val)
+    yield {"rel": e}
+
+
+# --- distance_calculator -----------------------------------------------------
+
+
+def _node_latlng(ctx, node, metrics_ignored=None):
+    lat_pid = ctx.storage.property_mapper.maybe_name_to_id("lat")
+    lng_pid = ctx.storage.property_mapper.maybe_name_to_id("lng")
+    lat = node.get_property(lat_pid, ctx.view) if lat_pid is not None \
+        else None
+    lng = node.get_property(lng_pid, ctx.view) if lng_pid is not None \
+        else None
+    if lat is None or lng is None:
+        raise QueryException(
+            "distance_calculator nodes need 'lat' and 'lng' properties")
+    return float(lat), float(lng)
+
+
+_METRIC_SCALE = {"m": 1.0, "km": 1 / 1000.0}
+
+
+@mgp.read_proc("distance_calculator.single",
+               args=[("start", "NODE"), ("end", "NODE")],
+               opt_args=[("metrics", "STRING", "m")],
+               results=[("distance", "FLOAT")])
+def distance_single(ctx, start, end, metrics="m"):
+    scale = _METRIC_SCALE.get(metrics)
+    if scale is None:
+        raise QueryException('metrics must be "m" or "km"')
+    d = _haversine(_node_latlng(ctx, start), _node_latlng(ctx, end))
+    yield {"distance": d * scale}
+
+
+@mgp.read_proc("distance_calculator.multiple",
+               args=[("start_points", "LIST"), ("end_points", "LIST")],
+               opt_args=[("metrics", "STRING", "m")],
+               results=[("distances", "LIST")])
+def distance_multiple(ctx, start_points, end_points, metrics="m"):
+    scale = _METRIC_SCALE.get(metrics)
+    if scale is None:
+        raise QueryException('metrics must be "m" or "km"')
+    if len(start_points) != len(end_points):
+        raise QueryException(
+            "start_points and end_points must be the same length")
+    yield {"distances": [
+        _haversine(_node_latlng(ctx, a), _node_latlng(ctx, b)) * scale
+        for a, b in zip(start_points, end_points)]}
+
+
+# --- periodic ----------------------------------------------------------------
+
+
+def _system_interpreter(ctx):
+    from ..query.interpreter import Interpreter
+    ictx = getattr(ctx.exec_ctx, "interpreter_context", None)
+    if ictx is None:
+        raise QueryException(
+            "periodic.* requires a server interpreter context")
+    return Interpreter(ictx, system=True)
+
+
+@mgp.read_proc("periodic.iterate",
+               args=[("input_query", "STRING"),
+                     ("running_query", "STRING"), ("config", "MAP")],
+               results=[("success", "BOOLEAN"),
+                        ("number_of_executed_batches", "INTEGER")])
+def periodic_iterate(ctx, input_query, running_query, config):
+    """Stream input_query rows, batch them, and run running_query once per
+    batch with each input column bound per-row — the reference's prefix
+    construction (periodic_module/periodic.cpp ConstructQueryPrefix):
+    'UNWIND $__batch AS __batch_row WITH __batch_row.col AS col ...' with
+    node/relationship columns re-matched by id, committed per batch."""
+    config = config or {}
+    batch_size = int(config.get("batch_size", 1000))
+    if batch_size <= 0:
+        raise QueryException("batch_size must be a positive integer")
+    interp = _system_interpreter(ctx)
+    columns, rows, _ = interp.execute(input_query)
+    if not columns:
+        yield {"success": True, "number_of_executed_batches": 0}
+        return
+    # classify columns from the first row (reference: by value type)
+    from ..storage.storage import EdgeAccessor, VertexAccessor
+    node_cols, rel_cols, prim_cols = set(), set(), set()
+    for k, col in enumerate(columns):
+        sample = rows[0][k] if rows else None
+        if isinstance(sample, VertexAccessor):
+            node_cols.add(col)
+        elif isinstance(sample, EdgeAccessor):
+            rel_cols.add(col)
+        else:
+            prim_cols.add(col)
+    with_parts = []
+    match_parts = []
+    for col in columns:
+        if col in node_cols:
+            with_parts.append(f"__batch_row.{col} AS __{col}_id")
+            match_parts.append(f"MATCH ({col}) WHERE id({col}) = __{col}_id")
+        elif col in rel_cols:
+            with_parts.append(f"__batch_row.{col} AS __{col}_id")
+            match_parts.append(
+                f"MATCH ()-[{col}]->() WHERE id({col}) = __{col}_id")
+        else:
+            with_parts.append(f"__batch_row.{col} AS {col}")
+    prefix = ("UNWIND $__batch AS __batch_row WITH "
+              + ", ".join(with_parts)
+              + (" " + " ".join(match_parts) if match_parts else " "))
+    batches = 0
+    runner = _system_interpreter(ctx)
+    try:
+        for i in range(0, len(rows), batch_size):
+            batch = rows[i:i + batch_size]
+            payload = []
+            for r in batch:
+                entry = {}
+                for k, col in enumerate(columns):
+                    v = r[k]
+                    entry[col] = v.gid if col in node_cols or \
+                        col in rel_cols else v
+                payload.append(entry)
+            runner.execute(prefix + " " + running_query,
+                           {"__batch": payload})
+            batches += 1
+    except Exception:
+        import logging
+        logging.getLogger("memgraph_tpu.periodic").exception(
+            "periodic.iterate batch %d failed", batches + 1)
+        yield {"success": False, "number_of_executed_batches": batches}
+        return
+    yield {"success": True, "number_of_executed_batches": batches}
+
+
+@mgp.read_proc("periodic.delete",
+               args=[("config", "MAP")],
+               results=[("success", "BOOLEAN"),
+                        ("number_of_deleted_nodes", "INTEGER")])
+def periodic_delete(ctx, config):
+    """Delete nodes matching config.labels in batches of config.batch_size
+    (reference periodic_module Delete)."""
+    config = config or {}
+    batch_size = int(config.get("batch_size", 1000))
+    if batch_size <= 0:
+        raise QueryException("batch_size must be a positive integer")
+    labels = config.get("labels", [])
+    where = ""
+    if labels:
+        where = ":" + ":".join(labels)
+    interp = _system_interpreter(ctx)
+    total = 0
+    while True:
+        _, rows, _ = interp.execute(
+            f"MATCH (n{where}) WITH n LIMIT $lim DETACH DELETE n "
+            f"RETURN count(n)", {"lim": batch_size})
+        deleted = rows[0][0] if rows else 0
+        total += deleted
+        if deleted < batch_size:
+            break
+    yield {"success": True, "number_of_deleted_nodes": total}

@@ -81,20 +81,9 @@ def _bfs_augment(cap, residual, source, sink):
     return path, bottleneck
 
 
-def _solve_max_flow(ctx, start_v, end_v, edge_property, directed=True):
-    """Edmonds-Karp. Returns (net-flow {(u,v): f>0}, total, edge_of).
-    With directed=False each edge contributes capacity both ways (the
-    igraph undirected-flow convention)."""
-    cap, edge_of = _capacity_network(ctx, edge_property)
-    if not directed:
-        undirected = collections.defaultdict(
-            lambda: collections.defaultdict(float))
-        for u, outs in cap.items():
-            for v, c in outs.items():
-                undirected[u][v] += c
-                undirected[v][u] += c
-                edge_of.setdefault((v, u), edge_of.get((u, v)))
-        cap = undirected
+def max_flow_on(cap, source, sink):
+    """Edmonds-Karp over a prebuilt {u: {v: capacity}} network. Returns
+    (net-flow {(u,v): f>0}, total, final residual)."""
     residual: dict = collections.defaultdict(
         lambda: collections.defaultdict(float))
     for u, outs in cap.items():
@@ -103,7 +92,7 @@ def _solve_max_flow(ctx, start_v, end_v, edge_property, directed=True):
             residual[v][u] += 0.0
     total = 0.0
     while True:
-        path, flow = _bfs_augment(cap, residual, start_v.gid, end_v.gid)
+        path, flow = _bfs_augment(cap, residual, source, sink)
         if path is None:
             break
         for i in range(len(path) - 1):
@@ -116,22 +105,22 @@ def _solve_max_flow(ctx, start_v, end_v, edge_property, directed=True):
             f = c - residual[u][v]
             if f > 1e-12:
                 net[(u, v)] = f
-    return net, total, edge_of
+    return net, total, residual
 
 
-def residual_reachable(ctx, source_gid, edge_property, net, directed=True):
-    """Gids on the source side of the min cut: BFS over leftover capacity
-    in the SAME network the flow was solved on."""
-    cap, _ = _capacity_network(ctx, edge_property)
-    residual = collections.defaultdict(dict)
+def undirect_capacities(cap):
+    """Each directed capacity also usable in reverse (igraph convention)."""
+    out = collections.defaultdict(lambda: collections.defaultdict(float))
     for u, outs in cap.items():
         for v, c in outs.items():
-            residual[u][v] = residual[u].get(v, 0.0) + c
-            if not directed:
-                residual[v][u] = residual[v].get(u, 0.0) + c
-    for (u, v), f in net.items():
-        residual[u][v] = residual[u].get(v, 0.0) - f
-        residual[v][u] = residual[v].get(u, 0.0) + f
+            out[u][v] += c
+            out[v][u] += c
+    return out
+
+
+def residual_reachable(residual, source_gid):
+    """Gids on the source side of the min cut: BFS over leftover capacity
+    in the solver's final residual."""
     reachable = {source_gid}
     queue = collections.deque([source_gid])
     while queue:
@@ -141,6 +130,19 @@ def residual_reachable(ctx, source_gid, edge_property, net, directed=True):
                 reachable.add(v)
                 queue.append(v)
     return reachable
+
+
+def _solve_max_flow(ctx, start_v, end_v, edge_property, directed=True):
+    """Edmonds-Karp over the MVCC-visible capacity network. Returns
+    (net-flow {(u,v): f>0}, total, edge_of). With directed=False each edge
+    contributes capacity both ways (the igraph undirected convention)."""
+    cap, edge_of = _capacity_network(ctx, edge_property)
+    if not directed:
+        for (u, v) in list(edge_of):
+            edge_of.setdefault((v, u), edge_of[(u, v)])
+        cap = undirect_capacities(cap)
+    net, total, _ = max_flow_on(cap, start_v.gid, end_v.gid)
+    return net, total, edge_of
 
 
 def _decompose_flow(net, source, sink):

@@ -186,58 +186,26 @@ def igraph_all_simple_paths(ctx, v, to, cutoff=-1):
                results=[("node", "NODE"), ("partition_id", "INTEGER")])
 def igraph_mincut(ctx, source, target, capacity=None, directed=True):
     """s-t mincut via max-flow: the source side is what stays reachable in
-    the residual of the SAME capacity network the flow was solved on
-    (null capacity follows igraph's unit-capacity convention)."""
-    from .combinatorial_modules import residual_reachable
+    the solver's final residual (null capacity follows igraph's
+    unit-capacity convention)."""
+    from .combinatorial_modules import (_capacity_network, max_flow_on,
+                                        residual_reachable,
+                                        undirect_capacities)
     if capacity is None:
-        # unit capacities on every edge: synthesize via hop weights
-        net, reachable = _unit_capacity_cut(ctx, source, target, directed)
+        cap = collections.defaultdict(
+            lambda: collections.defaultdict(float))
+        for v in ctx.accessor.vertices(ctx.view):
+            for e in v.out_edges(ctx.view):
+                cap[v.gid][e.to_vertex().gid] += 1.0
     else:
-        net, _, _ = _solve_max_flow(ctx, source, target, capacity,
-                                    directed=directed)
-        reachable = residual_reachable(ctx, source.gid, capacity, net,
-                                       directed=directed)
+        cap, _ = _capacity_network(ctx, capacity)
+    if not directed:
+        cap = undirect_capacities(cap)
+    _, _, residual = max_flow_on(cap, source.gid, target.gid)
+    reachable = residual_reachable(residual, source.gid)
     for v in ctx.accessor.vertices(ctx.view):
         yield {"node": v,
                "partition_id": 0 if v.gid in reachable else 1}
-
-
-def _unit_capacity_cut(ctx, source, target, directed):
-    """Max-flow + source-side reachability with capacity 1.0 per edge."""
-    cap = collections.defaultdict(lambda: collections.defaultdict(float))
-    for v in ctx.accessor.vertices(ctx.view):
-        for e in v.out_edges(ctx.view):
-            cap[v.gid][e.to_vertex().gid] += 1.0
-            if not directed:
-                cap[e.to_vertex().gid][v.gid] += 1.0
-    from .combinatorial_modules import _bfs_augment
-    residual = collections.defaultdict(
-        lambda: collections.defaultdict(float))
-    for u, outs in cap.items():
-        for v, c in outs.items():
-            residual[u][v] += c
-            residual[v][u] += 0.0
-    while True:
-        path, flow = _bfs_augment(cap, residual, source.gid, target.gid)
-        if path is None:
-            break
-        for i in range(len(path) - 1):
-            residual[path[i]][path[i + 1]] -= flow
-            residual[path[i + 1]][path[i]] += flow
-    reachable = {source.gid}
-    queue = collections.deque([source.gid])
-    while queue:
-        u = queue.popleft()
-        for v, c in residual.get(u, {}).items():
-            if c > 1e-12 and v not in reachable:
-                reachable.add(v)
-                queue.append(v)
-    net = {}
-    for u, outs in cap.items():
-        for v, c in outs.items():
-            if c - residual[u][v] > 1e-12:
-                net[(u, v)] = c - residual[u][v]
-    return net, reachable
 
 
 @mgp.read_proc("igraphalg.topological_sort",

@@ -1321,7 +1321,7 @@ class Parser:
             return A.CountStar()
         if tok.is_kw("CASE"):
             return self.parse_case()
-        if tok.is_kw("EXISTS"):
+        if tok.is_kw("EXISTS") and self.peek().type == "(":
             self.advance()
             self.expect("(")
             if self.at("("):

@@ -1,0 +1,206 @@
+"""Graph-utility modules (uuid/text/util/label/node/nodes/neighbors/meta/
+path/merge/distance_calculator/periodic) — reference mage/cpp parity."""
+
+import pytest
+
+from memgraph_tpu.exceptions import QueryException
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def interp():
+    i = Interpreter(InterpreterContext(InMemoryStorage()))
+    i.execute(
+        "CREATE (a:P {name: 'a', lat: 0.0, lng: 0.0})"
+        "-[:KNOWS]->(b:P {name: 'b', lat: 1.0, lng: 1.0}),"
+        "(b)-[:LIKES]->(c:Q {name: 'c'})")
+    return i
+
+
+def rows(result):
+    return result[1]
+
+
+def test_uuid_and_md5(interp):
+    out = rows(interp.execute("CALL uuid.get() YIELD uuid RETURN uuid"))
+    assert len(out[0][0]) == 36
+    out = rows(interp.execute(
+        "CALL util.md5(['a', 1]) YIELD result RETURN result"))
+    assert out == [["8a8bb7cd343aa2ad99b7d762030857a2"]]
+
+
+def test_text_procs(interp):
+    assert rows(interp.execute(
+        "CALL text.join(['a', 'b'], '-') YIELD string RETURN string")) == \
+        [["a-b"]]
+    assert rows(interp.execute(
+        "CALL text.format('x={}', [3]) YIELD result RETURN result")) == \
+        [["x=3"]]
+    out = rows(interp.execute(
+        "CALL text.regex_groups('ab12cd34', '([a-z]+)([0-9]+)') "
+        "YIELD results RETURN results"))
+    assert out == [[[["ab12", "ab", "12"], ["cd34", "cd", "34"]]]]
+    with pytest.raises(QueryException):
+        interp.execute("CALL text.join([1], '-') YIELD string RETURN 1")
+
+
+def test_label_and_node_procs(interp):
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL label.exists(n, 'P') "
+        "YIELD exists RETURN exists")) == [[True]]
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL label.exists(n, 'Q') "
+        "YIELD exists RETURN exists")) == [[False]]
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'b'}) CALL node.degree_in(n) "
+        "YIELD degree RETURN degree")) == [[1]]
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'b'}) CALL node.degree_out(n, 'LIKES') "
+        "YIELD degree RETURN degree")) == [[1]]
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'b'}) CALL node.relationship_types(n) "
+        "YIELD relationship_types AS t RETURN t")) == [[["KNOWS", "LIKES"]]]
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'b'}) "
+        "CALL node.relationships_exist(n, ['KNOWS>', '<KNOWS', 'NOPE']) "
+        "YIELD result RETURN result"))
+    assert out == [[{"KNOWS>": False, "<KNOWS": True, "NOPE": False}]]
+
+
+def test_nodes_link_and_delete(interp):
+    interp.execute(
+        "MATCH (n) WITH collect(n) AS ns "
+        "CALL nodes.link(ns, 'NEXT') YIELD success RETURN success")
+    assert rows(interp.execute(
+        "MATCH ()-[r:NEXT]->() RETURN count(r)")) == [[2]]
+    interp.execute(
+        "MATCH (n:Q) WITH collect(n) AS ns "
+        "CALL nodes.delete(ns) YIELD success RETURN success")
+    assert rows(interp.execute("MATCH (n:Q) RETURN count(n)")) == [[0]]
+
+
+def test_neighbors(interp):
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL neighbors.at_hop(n, [], 2) "
+        "YIELD nodes RETURN nodes.name")) == [["c"]]
+    assert rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL neighbors.at_hop(n, ['KNOWS>'], 1) "
+        "YIELD nodes RETURN nodes.name")) == [["b"]]
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL neighbors.by_hop(n, [], 3) "
+        "YIELD nodes RETURN size(nodes)"))
+    assert [r[0] for r in out] == [1, 1, 0]
+    with pytest.raises(QueryException):
+        interp.execute(
+            "MATCH (n:P {name:'a'}) CALL neighbors.at_hop(n, [], 0) "
+            "YIELD nodes RETURN 1")
+
+
+def test_meta_stats(interp):
+    out = rows(interp.execute(
+        "CALL meta.stats_online() YIELD nodeCount, relationshipCount, "
+        "labels, relationshipTypes, relationshipTypesCount, stats "
+        "RETURN nodeCount, relationshipCount, labels, relationshipTypes, "
+        "relationshipTypesCount, stats.labelCount"))
+    assert out == [[3, 2, {"P": 2, "Q": 1},
+                    {"(:P)-[:KNOWS]->()": 1, "()-[:KNOWS]->(:P)": 1,
+                     "(:P)-[:LIKES]->()": 1, "()-[:LIKES]->(:Q)": 1},
+                    {"KNOWS": 1, "LIKES": 1}, 2]]
+
+
+def test_path_expand_and_subgraph(interp):
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL path.expand(n, [], [], 1, 2) "
+        "YIELD result RETURN size(nodes(result)) ORDER BY 1"))
+    assert [r[0] for r in out] == [2, 3]
+    # label deny filter stops at :Q
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL path.expand(n, [], ['-Q'], 1, 3) "
+        "YIELD result RETURN size(nodes(result))"))
+    assert [r[0] for r in out] == [2]
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL path.subgraph_all(n, {max_level: 1}) "
+        "YIELD nodes, rels RETURN size(nodes), size(rels)"))
+    assert out == [[2, 1]]
+
+
+def test_merge_node_and_relationship(interp):
+    out = rows(interp.execute(
+        "CALL merge.node(['M'], {k: 1}, {c: 1}, {m: 1}) "
+        "YIELD node RETURN node.k, node.c, node.m"))
+    assert out == [[1, 1, None]]  # created: createProps only
+    out = rows(interp.execute(
+        "CALL merge.node(['M'], {k: 1}, {c: 2}, {m: 9}) "
+        "YIELD node RETURN node.k, node.c, node.m"))
+    assert out == [[1, 1, 9]]     # matched: matchProps applied, c untouched
+    assert rows(interp.execute("MATCH (n:M) RETURN count(n)")) == [[1]]
+    out = rows(interp.execute(
+        "MATCH (a:P {name:'a'}), (b:P {name:'b'}) "
+        "CALL merge.relationship(a, 'KNOWS', {}, {w: 1}, b, {}) "
+        "YIELD rel RETURN rel.w"))
+    assert out == [[None]]  # matched the existing KNOWS edge
+    assert rows(interp.execute(
+        "MATCH (:P {name:'a'})-[r:KNOWS]->() RETURN count(r)")) == [[1]]
+
+
+def test_distance_calculator(interp):
+    out = rows(interp.execute(
+        "MATCH (a:P {name:'a'}), (b:P {name:'b'}) "
+        "CALL distance_calculator.single(a, b, 'km') "
+        "YIELD distance RETURN round(distance)"))
+    assert out == [[157.0]]  # ~157 km per diagonal degree at the equator
+    out = rows(interp.execute(
+        "MATCH (a:P {name:'a'}), (b:P {name:'b'}) "
+        "CALL distance_calculator.multiple([a], [b], 'm') "
+        "YIELD distances RETURN round(distances[0] / 1000)"))
+    assert out == [[157.0]]
+    with pytest.raises(QueryException):
+        interp.execute(
+            "MATCH (a:P {name:'a'}) "
+            "CALL distance_calculator.single(a, a, 'furlongs') "
+            "YIELD distance RETURN 1")
+
+
+def test_periodic_iterate_and_delete(interp):
+    # canonical reference form: running query sees each column per row
+    out = rows(interp.execute(
+        "CALL periodic.iterate("
+        "'MATCH (n:P) RETURN n.name AS name', "
+        "'CREATE (:Copy {name: name})', "
+        "{batch_size: 1}) "
+        "YIELD success, number_of_executed_batches RETURN *"))
+    assert out == [[2, True]] or out == [[True, 2]]
+    assert rows(interp.execute("MATCH (c:Copy) RETURN count(c)")) == [[2]]
+    out = rows(interp.execute(
+        "CALL periodic.delete({labels: ['Copy'], batch_size: 1}) "
+        "YIELD number_of_deleted_nodes RETURN number_of_deleted_nodes"))
+    assert out == [[2]]
+    assert rows(interp.execute("MATCH (c:Copy) RETURN count(c)")) == [[0]]
+
+
+def test_exists_still_works_as_function(interp):
+    # the YIELD-name fix must not break EXISTS( pattern ) expressions
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'a'}) RETURN exists((n)-[:KNOWS]->())"))
+    assert out == [[True]]
+
+
+def test_periodic_iterate_node_columns(interp):
+    # node columns are re-matched by id in the running query
+    out = rows(interp.execute(
+        "CALL periodic.iterate("
+        "'MATCH (n:P) RETURN n', "
+        "'SET n.seen = true', "
+        "{batch_size: 10}) "
+        "YIELD success RETURN success"))
+    assert out == [[True]]
+    assert rows(interp.execute(
+        "MATCH (n:P) WHERE n.seen RETURN count(n)")) == [[2]]
+
+
+def test_path_expand_zero_hops(interp):
+    out = rows(interp.execute(
+        "MATCH (n:P {name:'a'}) CALL path.expand(n, [], [], 0, 1) "
+        "YIELD result RETURN size(nodes(result)) ORDER BY 1"))
+    assert [r[0] for r in out] == [1, 2]  # includes the start-only path
