@@ -28,3 +28,4 @@ def load_builtin_modules() -> None:
     from . import combinatorial_modules  # noqa: F401
     from . import igraph_module           # noqa: F401
     from . import apoc_modules            # noqa: F401
+    from . import ml_modules              # noqa: F401

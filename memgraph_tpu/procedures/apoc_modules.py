@@ -511,13 +511,19 @@ def distance_multiple(ctx, start_points, end_points, metrics="m"):
 # --- periodic ----------------------------------------------------------------
 
 
-def _system_interpreter(ctx):
+def _sub_interpreter(ctx):
+    """Interpreter for sub-queries run on behalf of the calling user:
+    NOT a system session — RBAC applies with the caller's username, so a
+    read-only user cannot escalate through do.*/periodic.* sub-queries."""
     from ..query.interpreter import Interpreter
     ictx = getattr(ctx.exec_ctx, "interpreter_context", None)
     if ictx is None:
         raise QueryException(
-            "periodic.* requires a server interpreter context")
-    return Interpreter(ictx, system=True)
+            "do.*/periodic.* require a server interpreter context")
+    interp = Interpreter(ictx)
+    eval_ctx = getattr(ctx.exec_ctx, "eval_ctx", None)
+    interp.username = getattr(eval_ctx, "username", "") or ""
+    return interp
 
 
 @mgp.read_proc("periodic.iterate",
@@ -535,7 +541,7 @@ def periodic_iterate(ctx, input_query, running_query, config):
     batch_size = int(config.get("batch_size", 1000))
     if batch_size <= 0:
         raise QueryException("batch_size must be a positive integer")
-    interp = _system_interpreter(ctx)
+    interp = _sub_interpreter(ctx)
     columns, rows, _ = interp.execute(input_query)
     if not columns:
         yield {"success": True, "number_of_executed_batches": 0}
@@ -567,7 +573,7 @@ def periodic_iterate(ctx, input_query, running_query, config):
               + ", ".join(with_parts)
               + (" " + " ".join(match_parts) if match_parts else " "))
     batches = 0
-    runner = _system_interpreter(ctx)
+    runner = _sub_interpreter(ctx)
     try:
         for i in range(0, len(rows), batch_size):
             batch = rows[i:i + batch_size]
@@ -606,7 +612,7 @@ def periodic_delete(ctx, config):
     where = ""
     if labels:
         where = ":" + ":".join(labels)
-    interp = _system_interpreter(ctx)
+    interp = _sub_interpreter(ctx)
     total = 0
     while True:
         _, rows, _ = interp.execute(
@@ -617,3 +623,69 @@ def periodic_delete(ctx, config):
         if deleted < batch_size:
             break
     yield {"success": True, "number_of_deleted_nodes": total}
+
+
+# --- do ----------------------------------------------------------------------
+
+
+def _is_global_operation(query):
+    """Parse and classify (the reference inspects the parsed query too:
+    do_module IsGlobalOperation) — substring checks both miss legal
+    whitespace variants and false-positive on string literals."""
+    from ..query.frontend import ast as A
+    from ..query.frontend.parser import parse_with_source
+    try:
+        node = parse_with_source(query)
+    except Exception:
+        return False  # let execution surface the real syntax error
+    return isinstance(node, (A.IndexQuery, A.ConstraintQuery,
+                             A.IsolationLevelQuery, A.StorageModeQuery))
+
+
+def _run_conditional_query(ctx, query, params):
+    """Execute a sub-query for do.case/do.when, yielding each result row as
+    a map (reference do_module InsertConditionalResults)."""
+    if _is_global_operation(query):
+        raise QueryException(
+            f"The query {query} isn't supported by `do` because it "
+            f"would execute a global operation.")
+    interp = _sub_interpreter(ctx)
+    columns, rows, _ = interp.execute(query, params or {})
+    for row in rows:
+        yield {"value": dict(zip(columns, row))}
+
+
+@mgp.read_proc("do.when",
+               args=[("condition", "BOOLEAN"), ("if_query", "STRING"),
+                     ("else_query", "STRING")],
+               opt_args=[("params", "MAP", None)],
+               results=[("value", "MAP")])
+def do_when(ctx, condition, if_query, else_query, params=None):
+    yield from _run_conditional_query(
+        ctx, if_query if condition else else_query, params)
+
+
+@mgp.read_proc("do.case",
+               args=[("conditionals", "LIST"), ("else_query", "STRING")],
+               opt_args=[("params", "MAP", None)],
+               results=[("value", "MAP")])
+def do_case(ctx, conditionals, else_query, params=None):
+    if not conditionals:
+        raise QueryException("Conditionals list must not be empty!")
+    if len(conditionals) % 2:
+        raise QueryException("Size of the conditionals size must be even!")
+    for i, item in enumerate(conditionals):
+        if i % 2 == 0 and not isinstance(item, bool):
+            raise QueryException(
+                f"Argument on index {i} in do.case conditionals is not "
+                f"bool!")
+        if i % 2 == 1 and not isinstance(item, str):
+            raise QueryException(
+                f"Argument on index {i} in do.case conditionals is not "
+                f"string!")
+    query = else_query
+    for i in range(0, len(conditionals), 2):
+        if conditionals[i]:
+            query = conditionals[i + 1]
+            break
+    yield from _run_conditional_query(ctx, query, params)

@@ -1,0 +1,355 @@
+"""GNN query modules: link prediction and node classification.
+
+Counterparts of the reference's DGL/PyTorch modules
+(mage/python/link_prediction.py — set_model_parameters / train / predict /
+recommend / get_training_results / reset_parameters;
+mage/python/node_classification.py — set_model_parameters / train /
+predict / get_training_data / reset) with the same procedure names and
+result fields. The model is the JAX GraphSAGE in ops/gnn.py (TPU MXU
+matmuls + sorted segment aggregation) instead of DGL; model state lives on
+the storage keyed by graph topology version, so predict() after a write
+retrains lazily rather than silently serving a stale model.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..exceptions import QueryException
+from . import mgp
+
+_DEFAULTS = {
+    "hidden_features_size": 64,
+    "out_features_size": 32,
+    "num_epochs": 30,
+    "learning_rate": 0.01,
+    "num_layers": 2,
+    "node_features_property": "",
+    "target_property": "",   # node_classification label property
+}
+
+
+class _ModelSlot:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.config = dict(_DEFAULTS)
+        self.params = None
+        self.feats = None
+        self.graph = None
+        self.emb = None           # cached forward pass; same lifetime as params
+        self.n_classes = None
+        self.history = []
+
+    def invalidate(self):
+        self.params = None
+        self.emb = None
+        self.history = []
+
+
+_SLOTS_CREATE_LOCK = threading.Lock()
+
+
+def _slot(ctx, name) -> _ModelSlot:
+    with _SLOTS_CREATE_LOCK:
+        slots = getattr(ctx.storage, "_gnn_models", None)
+        if slots is None:
+            slots = ctx.storage._gnn_models = {}
+        if name not in slots:
+            slots[name] = _ModelSlot()
+        return slots[name]
+
+
+_INT_PARAMS = {"hidden_features_size", "out_features_size", "num_epochs",
+               "num_layers"}
+
+
+def _validate_parameters(parameters):
+    unknown = set(parameters or {}) - set(_DEFAULTS)
+    if unknown:
+        raise QueryException(f"unknown model parameters: {sorted(unknown)}")
+    for key, value in (parameters or {}).items():
+        if key in _INT_PARAMS:
+            if not isinstance(value, int) or isinstance(value, bool)                     or value <= 0:
+                raise QueryException(
+                    f"{key} must be a positive integer")
+        elif key == "learning_rate":
+            if not isinstance(value, (int, float))                     or isinstance(value, bool) or value <= 0:
+                raise QueryException("learning_rate must be positive")
+        elif not isinstance(value, str):
+            raise QueryException(f"{key} must be a string")
+
+
+def _features(ctx, graph, prop_name):
+    """Stack a numeric list property into (n_pad, d) features, or None to
+    fall back to degree/positional features."""
+    if not prop_name:
+        return None
+    pid = ctx.storage.property_mapper.maybe_name_to_id(prop_name)
+    if pid is None:
+        raise QueryException(f"unknown feature property {prop_name!r}")
+    rows = []
+    dim = None
+    for i in range(graph.n_nodes):
+        v = ctx.vertex_by_index(graph, i)
+        val = v.get_property(pid, ctx.view) if v is not None else None
+        if not isinstance(val, (list, tuple)):
+            raise QueryException(
+                f"node feature property {prop_name!r} must be a numeric "
+                f"list on every node")
+        if dim is None:
+            dim = len(val)
+        if len(val) != dim:
+            raise QueryException(
+                f"node feature property {prop_name!r} has inconsistent "
+                f"dimensions")
+        rows.append([float(x) for x in val])
+    import jax.numpy as jnp
+    feats = np.zeros((graph.n_pad, dim), dtype=np.float32)
+    if rows:
+        feats[:graph.n_nodes] = np.asarray(rows, dtype=np.float32)
+    return jnp.asarray(feats)
+
+
+# --- link_prediction ---------------------------------------------------------
+
+
+@mgp.read_proc("link_prediction.set_model_parameters",
+               args=[("parameters", "MAP")],
+               results=[("status", "BOOLEAN"), ("message", "STRING")])
+def lp_set_model_parameters(ctx, parameters):
+    slot = _slot(ctx, "link_prediction")
+    _validate_parameters(parameters)
+    with slot.lock:
+        slot.config.update(parameters or {})
+        slot.invalidate()  # stale params AND history of the old config
+    yield {"status": True,
+           "message": "Model parameters updated. Train to apply."}
+
+
+def _ensure_lp_embeddings(ctx, slot):
+    """Train if stale, then cache the full-graph forward pass — predict and
+    recommend score many pairs against the same embeddings."""
+    from ..ops.gnn import sage_forward
+    graph = ctx.device_graph()
+    if slot.params is None or slot.graph is not graph:
+        _train_lp(ctx, slot)
+        graph = slot.graph
+    if slot.emb is None:
+        slot.emb = sage_forward(slot.params, slot.feats, graph.csc_src,
+                                graph.csc_dst, graph.n_pad)
+    return graph
+
+
+def _train_lp(ctx, slot):
+    from ..ops.gnn import train_link_prediction
+    graph = ctx.device_graph()
+    if graph.n_edges == 0:
+        raise QueryException("link_prediction.train needs at least one "
+                             "edge")
+    cfg = slot.config
+    feats = _features(ctx, graph, cfg["node_features_property"])
+    params, feats, history = train_link_prediction(
+        graph, feats=feats,
+        hidden_dim=int(cfg["hidden_features_size"]),
+        out_dim=int(cfg["out_features_size"]),
+        n_layers=int(cfg["num_layers"]),
+        epochs=int(cfg["num_epochs"]),
+        lr=float(cfg["learning_rate"]))
+    slot.params, slot.feats, slot.graph = params, feats, graph
+    slot.emb = None
+    slot.history = history
+    return history
+
+
+@mgp.read_proc("link_prediction.train",
+               results=[("training_results", "ANY"),
+                        ("validation_results", "ANY")])
+def lp_train(ctx):
+    slot = _slot(ctx, "link_prediction")
+    with slot.lock:
+        history = _train_lp(ctx, slot)
+    yield {"training_results": history,
+           "validation_results": [history[-1]]}
+
+
+@mgp.read_proc("link_prediction.predict",
+               args=[("src_vertex", "NODE"), ("dest_vertex", "NODE")],
+               results=[("score", "FLOAT")])
+def lp_predict(ctx, src_vertex, dest_vertex):
+    from ..ops.gnn import _edge_scores
+    import jax
+    slot = _slot(ctx, "link_prediction")
+    with slot.lock:
+        graph = _ensure_lp_embeddings(ctx, slot)
+        src = graph.gid_to_idx.get(src_vertex.gid)
+        dst = graph.gid_to_idx.get(dest_vertex.gid)
+        if src is None or dst is None:
+            raise QueryException("vertex is not part of the graph")
+        score = jax.nn.sigmoid(_edge_scores(
+            slot.emb, np.asarray([src]), np.asarray([dst])))[0]
+    yield {"score": float(score)}
+
+
+@mgp.read_proc("link_prediction.recommend",
+               args=[("src_vertex", "NODE"), ("dest_vertexes", "LIST"),
+                     ("k", "INTEGER")],
+               results=[("score", "FLOAT"), ("recommendation", "NODE")])
+def lp_recommend(ctx, src_vertex, dest_vertexes, k):
+    from ..ops.gnn import _edge_scores
+    import jax
+    slot = _slot(ctx, "link_prediction")
+    with slot.lock:
+        graph = _ensure_lp_embeddings(ctx, slot)
+        src = graph.gid_to_idx.get(src_vertex.gid)
+        if src is None:
+            raise QueryException("vertex is not part of the graph")
+        dsts, keep = [], []
+        for v in dest_vertexes:
+            idx = graph.gid_to_idx.get(v.gid)
+            if idx is not None:
+                dsts.append(idx)
+                keep.append(v)
+        if not dsts:
+            return
+        scores = np.asarray(jax.nn.sigmoid(_edge_scores(
+            slot.emb, np.full(len(dsts), src), np.asarray(dsts))))
+    order = np.argsort(-scores)[:max(0, int(k))]
+    for i in order:
+        yield {"score": float(scores[i]), "recommendation": keep[int(i)]}
+
+
+@mgp.read_proc("link_prediction.get_training_results",
+               results=[("training_results", "ANY"),
+                        ("validation_results", "ANY")])
+def lp_get_training_results(ctx):
+    slot = _slot(ctx, "link_prediction")
+    with slot.lock:
+        if not slot.history:
+            raise QueryException("model is not trained yet")
+        history = list(slot.history)
+    yield {"training_results": history,
+           "validation_results": [history[-1]]}
+
+
+@mgp.read_proc("link_prediction.reset_parameters",
+               results=[("status", "ANY")])
+def lp_reset_parameters(ctx):
+    slot = _slot(ctx, "link_prediction")
+    with slot.lock:
+        slot.config = dict(_DEFAULTS)
+        slot.invalidate()
+    yield {"status": "Parameters and model reset."}
+
+
+# --- node_classification -----------------------------------------------------
+
+
+@mgp.read_proc("node_classification.set_model_parameters",
+               args=[("parameters", "MAP")],
+               results=[("status", "BOOLEAN"), ("message", "STRING")])
+def nc_set_model_parameters(ctx, parameters):
+    slot = _slot(ctx, "node_classification")
+    _validate_parameters(parameters)
+    with slot.lock:
+        slot.config.update(parameters or {})
+        slot.invalidate()
+    yield {"status": True,
+           "message": "Model parameters updated. Train to apply."}
+
+
+def _train_nc(ctx, slot):
+    from ..ops.gnn import train_node_classification
+    graph = ctx.device_graph()
+    cfg = slot.config
+    target = cfg["target_property"] or "label"
+    pid = ctx.storage.property_mapper.maybe_name_to_id(target)
+    if pid is None:
+        raise QueryException(
+            f"no node carries the target property {target!r}")
+    label_idx, labels = [], []
+    for i in range(graph.n_nodes):
+        v = ctx.vertex_by_index(graph, i)
+        val = v.get_property(pid, ctx.view) if v is not None else None
+        if isinstance(val, int) and not isinstance(val, bool):
+            label_idx.append(i)
+            labels.append(val)
+    if not labels:
+        raise QueryException(
+            f"no node carries an integer {target!r} property")
+    feats = _features(ctx, graph, cfg["node_features_property"])
+    params, feats, n_classes, history = train_node_classification(
+        graph, label_idx, labels, feats=feats,
+        hidden_dim=int(cfg["hidden_features_size"]),
+        n_layers=int(cfg["num_layers"]),
+        epochs=int(cfg["num_epochs"]),
+        lr=float(cfg["learning_rate"]))
+    slot.params, slot.feats, slot.graph = params, feats, graph
+    slot.emb = None
+    slot.n_classes = n_classes
+    slot.history = history
+    return history
+
+
+@mgp.read_proc("node_classification.train",
+               results=[("epoch", "INTEGER"), ("loss", "FLOAT"),
+                        ("val_loss", "FLOAT"), ("train_log", "ANY"),
+                        ("val_log", "ANY")])
+def nc_train(ctx):
+    slot = _slot(ctx, "node_classification")
+    with slot.lock:
+        history = _train_nc(ctx, slot)
+    for entry in history:
+        yield {"epoch": entry["epoch"], "loss": entry["loss"],
+               "val_loss": entry["loss"],
+               "train_log": entry, "val_log": entry}
+
+
+@mgp.read_proc("node_classification.predict",
+               args=[("vertex", "NODE")],
+               results=[("predicted_class", "INTEGER"),
+                        ("status", "STRING")])
+def nc_predict(ctx, vertex):
+    from ..ops.gnn import sage_forward
+    import jax.numpy as jnp
+    slot = _slot(ctx, "node_classification")
+    with slot.lock:
+        graph = ctx.device_graph()
+        if slot.params is None or slot.graph is not graph:
+            _train_nc(ctx, slot)
+            graph = slot.graph
+        if slot.emb is None:
+            slot.emb = sage_forward(slot.params, slot.feats,
+                                    graph.csc_src, graph.csc_dst,
+                                    graph.n_pad)
+        idx = graph.gid_to_idx.get(vertex.gid)
+        if idx is None:
+            raise QueryException("vertex is not part of the graph")
+        cls = int(jnp.argmax(slot.emb[idx]))
+    yield {"predicted_class": cls, "status": "ok"}
+
+
+@mgp.read_proc("node_classification.get_training_data",
+               results=[("epoch", "INTEGER"), ("loss", "FLOAT"),
+                        ("val_loss", "FLOAT"), ("train_log", "ANY"),
+                        ("val_log", "ANY")])
+def nc_get_training_data(ctx):
+    slot = _slot(ctx, "node_classification")
+    with slot.lock:
+        if not slot.history:
+            raise QueryException("model is not trained yet")
+        history = list(slot.history)
+    for entry in history:
+        yield {"epoch": entry["epoch"], "loss": entry["loss"],
+               "val_loss": entry["loss"],
+               "train_log": entry, "val_log": entry}
+
+
+@mgp.read_proc("node_classification.reset", results=[("status", "STRING")])
+def nc_reset(ctx):
+    slot = _slot(ctx, "node_classification")
+    with slot.lock:
+        slot.config = dict(_DEFAULTS)
+        slot.invalidate()
+    yield {"status": "Model reset."}

@@ -204,3 +204,37 @@ def test_path_expand_zero_hops(interp):
         "MATCH (n:P {name:'a'}) CALL path.expand(n, [], [], 0, 1) "
         "YIELD result RETURN size(nodes(result)) ORDER BY 1"))
     assert [r[0] for r in out] == [1, 2]  # includes the start-only path
+
+
+def test_do_when_and_case(interp):
+    out = rows(interp.execute(
+        "CALL do.when(true, 'RETURN 1 AS a', 'RETURN 2 AS a') "
+        "YIELD value RETURN value.a"))
+    assert out == [[1]]
+    out = rows(interp.execute(
+        "CALL do.case([false, 'RETURN 1 AS a', true, 'RETURN 2 AS a'], "
+        "'RETURN 3 AS a') YIELD value RETURN value.a"))
+    assert out == [[2]]
+    out = rows(interp.execute(
+        "CALL do.case([false, 'RETURN 1 AS a'], 'RETURN 3 AS a') "
+        "YIELD value RETURN value.a"))
+    assert out == [[3]]
+    with pytest.raises(QueryException):
+        interp.execute("CALL do.case([], 'RETURN 1') YIELD value RETURN 1")
+    with pytest.raises(QueryException):
+        interp.execute("CALL do.case([true], 'RETURN 1') "
+                       "YIELD value RETURN 1")
+
+
+def test_do_rejects_global_operations(interp):
+    # whitespace variants must still be caught (parsed, not substring-matched)
+    with pytest.raises(QueryException):
+        interp.execute(
+            "CALL do.when(true, 'CREATE  INDEX ON :L(p)', 'RETURN 1') "
+            "YIELD value RETURN 1")
+    # string literals mentioning global ops are NOT false positives
+    out = rows(interp.execute(
+        "CALL do.when(true, "
+        "\"RETURN 'storage mode tips' AS a\", 'RETURN 2') "
+        "YIELD value RETURN value.a"))
+    assert out == [["storage mode tips"]]

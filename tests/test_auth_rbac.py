@@ -158,3 +158,26 @@ def test_roles_db_name_type_checked(rbac):
     _, rows, _ = c.execute("RETURN roles('memgraph')")
     assert rows == [[[]]]
     c.close()
+
+
+def test_do_subqueries_enforce_rbac(rbac):
+    # reader can CALL read procedures but has no write privileges: a write
+    # smuggled through do.when's sub-query must still be denied
+    rbac["auth"].grant("reader", ["MODULE_READ"])
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    with pytest.raises(BoltClientError):
+        c.execute("CALL do.when(true, 'CREATE (:Smuggled)', 'RETURN 1') "
+                  "YIELD value RETURN 1")
+    c.reset()
+    admin = BoltClient(port=rbac["port"], username="admin",
+                       password="adminpw")
+    _, out, _ = admin.execute("MATCH (n:Smuggled) RETURN count(n)")
+    assert out == [[0]]
+    # read-only sub-queries still work for the reader
+    _, out, _ = c.execute(
+        "CALL do.when(true, 'RETURN 1 AS a', 'RETURN 2 AS a') "
+        "YIELD value RETURN value.a")
+    assert out == [[1]]
+    c.close()
+    admin.close()

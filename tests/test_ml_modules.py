@@ -1,0 +1,118 @@
+"""JAX GraphSAGE link-prediction / node-classification modules
+(reference: mage/python/link_prediction.py, node_classification.py)."""
+
+import itertools
+
+import pytest
+
+from memgraph_tpu.exceptions import QueryException
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture(scope="module")
+def interp():
+    """Two 6-node communities, dense intra-edges, no inter-edges."""
+    i = Interpreter(InterpreterContext(InMemoryStorage()))
+    i.execute("UNWIND range(0, 11) AS x CREATE (:U {id: x, label: x / 6})")
+    for block in (range(0, 6), range(6, 12)):
+        for a, b in itertools.combinations(block, 2):
+            if (a + b) % 3 != 0:
+                i.execute(f"MATCH (a:U {{id: {a}}}), (b:U {{id: {b}}}) "
+                          f"CREATE (a)-[:F]->(b)")
+    return i
+
+
+def rows(result):
+    return result[1]
+
+
+def test_link_prediction_learns_communities(interp):
+    interp.execute("CALL link_prediction.set_model_parameters("
+                   "{num_epochs: 30}) YIELD status RETURN status")
+    out = rows(interp.execute(
+        "CALL link_prediction.train() "
+        "YIELD training_results, validation_results RETURN *"))
+    final = out[0][1][-1] if isinstance(out[0][1], list) else out[0][0][-1]
+    assert final["auc"] > 0.6
+    intra = rows(interp.execute(
+        "MATCH (a:U {id: 0}), (b:U {id: 3}) "
+        "CALL link_prediction.predict(a, b) YIELD score RETURN score"
+    ))[0][0]
+    inter = rows(interp.execute(
+        "MATCH (a:U {id: 0}), (b:U {id: 9}) "
+        "CALL link_prediction.predict(a, b) YIELD score RETURN score"
+    ))[0][0]
+    assert 0.0 <= inter <= 1.0 and 0.0 <= intra <= 1.0
+    assert intra > inter
+
+
+def test_link_prediction_recommend(interp):
+    out = rows(interp.execute(
+        "MATCH (a:U {id: 0}) MATCH (c:U) WHERE c.id IN [3, 9] "
+        "WITH a, collect(c) AS cs "
+        "CALL link_prediction.recommend(a, cs, 1) "
+        "YIELD recommendation RETURN recommendation.id"))
+    assert out == [[3]]  # intra-community candidate wins
+
+
+def test_link_prediction_results_and_reset(interp):
+    out = rows(interp.execute(
+        "CALL link_prediction.get_training_results() "
+        "YIELD training_results RETURN size(training_results)"))
+    assert out[0][0] >= 30
+    interp.execute("CALL link_prediction.reset_parameters() "
+                   "YIELD status RETURN status")
+    with pytest.raises(QueryException):
+        interp.execute("CALL link_prediction.get_training_results() "
+                       "YIELD training_results RETURN 1")
+    with pytest.raises(QueryException):
+        interp.execute("CALL link_prediction.set_model_parameters("
+                       "{bogus_knob: 1}) YIELD status RETURN status")
+
+
+def test_node_classification_end_to_end(interp):
+    interp.execute(
+        "CALL node_classification.set_model_parameters("
+        "{target_property: 'label', num_epochs: 50}) "
+        "YIELD status RETURN status")
+    out = rows(interp.execute(
+        "CALL node_classification.train() YIELD epoch, loss "
+        "RETURN count(epoch), min(loss)"))
+    assert out[0][0] == 50
+    assert out[0][1] < 0.5  # converged well below chance
+    for node_id, expected in ((1, 0), (10, 1)):
+        out = rows(interp.execute(
+            f"MATCH (v:U {{id: {node_id}}}) "
+            f"CALL node_classification.predict(v) "
+            f"YIELD predicted_class RETURN predicted_class"))
+        assert out == [[expected]]
+    out = rows(interp.execute(
+        "CALL node_classification.get_training_data() "
+        "YIELD epoch RETURN count(epoch)"))
+    assert out == [[50]]
+
+
+def test_node_classification_missing_target():
+    i = Interpreter(InterpreterContext(InMemoryStorage()))
+    i.execute("CREATE (:V)")
+    with pytest.raises(QueryException):
+        i.execute("CALL node_classification.train() YIELD epoch RETURN 1")
+
+
+def test_kernel_shapes_direct():
+    """ops/gnn.py API sanity without the module layer."""
+    import numpy as np
+    from memgraph_tpu.ops.csr import from_coo
+    from memgraph_tpu.ops.gnn import (degree_features, sage_forward,
+                                      train_link_prediction)
+    graph = from_coo(np.array([0, 1, 2]), np.array([1, 2, 3]))
+    feats = degree_features(graph, dim=8)
+    assert feats.shape == (graph.n_pad, 8)
+    params, feats, history = train_link_prediction(graph, epochs=2,
+                                                   hidden_dim=8,
+                                                   out_dim=4)
+    emb = sage_forward(params, feats, graph.csc_src, graph.csc_dst,
+                       graph.n_pad)
+    assert emb.shape == (graph.n_pad, 4)
+    assert len(history) == 2
