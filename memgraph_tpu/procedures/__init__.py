@@ -29,3 +29,4 @@ def load_builtin_modules() -> None:
     from . import igraph_module           # noqa: F401
     from . import apoc_modules            # noqa: F401
     from . import ml_modules              # noqa: F401
+    from . import compat_modules          # noqa: F401

@@ -1,0 +1,455 @@
+"""Compatibility and introspection query modules.
+
+Counterparts of the reference's in-tree query_modules:
+  mgps.py         — mgps.components / await_indexes / validate (Spark and
+                    Neo4j-connector compatibility shims)
+  graph_analyzer.py — graph_analyzer.analyze / analyze_subgraph / help
+  schema.cpp      — schema.node_type_properties / rel_type_properties /
+                    schema.assert
+  mage/python/meta_util.py — meta_util.schema
+Same procedure names, arguments, and result fields; the analyzer rides the
+TPU kernels (WCC, bridges) instead of NetworkX.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from ..exceptions import QueryException
+from . import mgp
+
+_SERVER_VERSION = "5.9.0"  # Neo4j-compatible version string (mgps.py:9)
+
+
+# --- mgps --------------------------------------------------------------------
+
+
+@mgp.read_proc("mgps.components",
+               results=[("versions", "LIST"), ("edition", "STRING"),
+                        ("name", "STRING")])
+def mgps_components(ctx):
+    yield {"versions": [_SERVER_VERSION], "edition": "community",
+           "name": "Memgraph"}
+    yield {"versions": [_SERVER_VERSION], "edition": "community",
+           "name": "Neo4j Kernel"}
+
+
+@mgp.read_proc("mgps.await_indexes",
+               args=[("seconds", "INTEGER")], results=[])
+def mgps_await_indexes(ctx, seconds):
+    # index creation is synchronous here; compatibility no-op
+    return
+    yield  # pragma: no cover — makes this a generator
+
+
+@mgp.read_proc("mgps.validate",
+               args=[("predicate", "BOOLEAN"), ("message", "STRING"),
+                     ("params", "LIST")],
+               results=[])
+def mgps_validate(ctx, predicate, message, params):
+    if predicate:
+        raise QueryException(message % tuple(params))
+    return
+    yield  # pragma: no cover
+
+
+# --- graph_analyzer ----------------------------------------------------------
+
+
+def _build_nx(ctx, vertices=None, edges=None):
+    """networkx MultiDiGraph over the visible graph or an explicit
+    node/edge subset (the analyzer is delegation territory, like the
+    reference's NetworkX-backed graph_analyzer.py)."""
+    import networkx as nx
+    g = nx.MultiDiGraph()
+    if vertices is not None:
+        for v in vertices:
+            g.add_node(v.gid)
+        for e in edges or []:
+            if e.from_vertex().gid in g and e.to_vertex().gid in g:
+                g.add_edge(e.from_vertex().gid, e.to_vertex().gid)
+        return g
+    for v in ctx.accessor.vertices(ctx.view):
+        g.add_node(v.gid)
+        for e in v.out_edges(ctx.view):
+            g.add_edge(v.gid, e.to_vertex().gid)
+    return g
+
+
+def _analyses(g):
+    """Reference analysis names -> callables over a networkx MultiDiGraph
+    (graph_analyzer.py _get_analysis_mapping)."""
+    import networkx as nx
+
+    def und():
+        return nx.Graph(g)
+
+    return collections.OrderedDict([
+        ("nodes", g.number_of_nodes),
+        ("edges", g.number_of_edges),
+        ("bridges", lambda: sum(1 for _ in nx.bridges(und()))),
+        ("articulation_points",
+         lambda: sum(1 for _ in nx.articulation_points(und()))),
+        ("avg_degree",
+         lambda: (2.0 * g.number_of_edges() / g.number_of_nodes())
+         if g.number_of_nodes() else 0.0),
+        ("self_loops", lambda: nx.number_of_selfloops(g)),
+        ("is_bipartite", lambda: nx.is_bipartite(und())),
+        ("is_weakly_connected",
+         lambda: g.number_of_nodes() > 0 and nx.is_weakly_connected(g)),
+        ("number_of_weakly_components",
+         lambda: nx.number_weakly_connected_components(g)),
+        ("is_strongly_connected",
+         lambda: g.number_of_nodes() > 0 and nx.is_strongly_connected(g)),
+        ("strongly_components",
+         lambda: nx.number_strongly_connected_components(g)),
+        ("is_dag", lambda: nx.is_directed_acyclic_graph(g)),
+        ("is_eulerian",
+         lambda: g.number_of_nodes() > 0 and nx.is_eulerian(g)),
+        ("is_forest", lambda: nx.is_forest(und())
+         if g.number_of_nodes() else False),
+        ("is_tree", lambda: nx.is_tree(und())
+         if g.number_of_nodes() else False),
+    ])
+
+
+def _run_analyses(g, analyses):
+    available = _analyses(g)
+    wanted = list(available) if analyses is None else analyses
+    for name in wanted:
+        fn = available.get(name)
+        if fn is None:
+            raise QueryException(
+                f"unknown analysis {name!r}; available: "
+                f"{sorted(available)}")
+        try:
+            value = fn()
+        except Exception as exc:  # e.g. is_eulerian on disconnected graphs
+            value = f"unavailable ({exc})"
+        yield {"name": name, "value": str(value)}
+
+
+@mgp.read_proc("graph_analyzer.analyze",
+               opt_args=[("analyses", "LIST", None)],
+               results=[("name", "STRING"), ("value", "STRING")])
+def graph_analyzer_analyze(ctx, analyses=None):
+    yield from _run_analyses(_build_nx(ctx), analyses)
+
+
+@mgp.read_proc("graph_analyzer.analyze_subgraph",
+               args=[("vertices", "LIST"), ("edges", "LIST")],
+               opt_args=[("analyses", "LIST", None)],
+               results=[("name", "STRING"), ("value", "STRING")])
+def graph_analyzer_analyze_subgraph(ctx, vertices, edges, analyses=None):
+    yield from _run_analyses(_build_nx(ctx, vertices, edges), analyses)
+
+
+@mgp.read_proc("graph_analyzer.help",
+               results=[("name", "STRING"), ("value", "STRING")])
+def graph_analyzer_help(ctx):
+    yield {"name": "Procedure 'analyze'",
+           "value": "CALL graph_analyzer.analyze([analyses]) YIELD *"}
+    yield {"name": "Procedure 'analyze_subgraph'",
+           "value": "CALL graph_analyzer.analyze_subgraph(nodes, edges) "
+                    "YIELD *"}
+    for name in _analyses(_build_nx(ctx)):
+        yield {"name": f"Analysis '{name}'", "value": name}
+
+
+# --- schema ------------------------------------------------------------------
+
+
+def _type_name(v):
+    from ..query.values import type_name
+    return type_name(v)
+
+
+@mgp.read_proc("schema.node_type_properties",
+               results=[("nodeType", "STRING"), ("nodeLabels", "LIST"),
+                        ("mandatory", "BOOLEAN"),
+                        ("propertyName", "STRING"),
+                        ("propertyTypes", "LIST")])
+def schema_node_type_properties(ctx):
+    """One row per (label set, property) with observed value types
+    (reference schema.cpp node_type_properties)."""
+    label_mapper = ctx.storage.label_mapper
+    prop_mapper = ctx.storage.property_mapper
+    # (labels tuple) -> {prop name -> set(type names)}, plus per-group count
+    groups: dict = {}
+    for v in ctx.accessor.vertices(ctx.view):
+        labels = tuple(sorted(label_mapper.id_to_name(l)
+                              for l in v.labels(ctx.view)))
+        g = groups.setdefault(labels, {"count": 0, "props": {}})
+        g["count"] += 1
+        for pid, val in v.properties(ctx.view).items():
+            name = prop_mapper.id_to_name(pid)
+            entry = g["props"].setdefault(name, {"types": set(), "seen": 0})
+            entry["types"].add(_type_name(val))
+            entry["seen"] += 1
+    for labels in sorted(groups):
+        g = groups[labels]
+        node_type = ":" + ":".join(f"`{l}`" for l in labels) if labels \
+            else ""
+        if not g["props"]:
+            yield {"nodeType": node_type, "nodeLabels": list(labels),
+                   "mandatory": False, "propertyName": "",
+                   "propertyTypes": []}
+            continue
+        for name in sorted(g["props"]):
+            entry = g["props"][name]
+            yield {"nodeType": node_type, "nodeLabels": list(labels),
+                   "mandatory": entry["seen"] == g["count"],
+                   "propertyName": name,
+                   "propertyTypes": sorted(entry["types"])}
+
+
+@mgp.read_proc("schema.rel_type_properties",
+               results=[("relType", "STRING"),
+                        ("sourceNodeLabels", "LIST"),
+                        ("targetNodeLabels", "LIST"),
+                        ("mandatory", "BOOLEAN"),
+                        ("propertyName", "STRING"),
+                        ("propertyTypes", "LIST")])
+def schema_rel_type_properties(ctx):
+    label_mapper = ctx.storage.label_mapper
+    type_mapper = ctx.storage.edge_type_mapper
+    prop_mapper = ctx.storage.property_mapper
+    groups: dict = {}
+    for v in ctx.accessor.vertices(ctx.view):
+        for e in v.out_edges(ctx.view):
+            src_labels = tuple(sorted(label_mapper.id_to_name(l)
+                                      for l in v.labels(ctx.view)))
+            dst_labels = tuple(sorted(
+                label_mapper.id_to_name(l)
+                for l in e.to_vertex().labels(ctx.view)))
+            key = (type_mapper.id_to_name(e.edge_type), src_labels,
+                   dst_labels)
+            g = groups.setdefault(key, {"count": 0, "props": {}})
+            g["count"] += 1
+            for pid, val in e.properties(ctx.view).items():
+                name = prop_mapper.id_to_name(pid)
+                entry = g["props"].setdefault(
+                    name, {"types": set(), "seen": 0})
+                entry["types"].add(_type_name(val))
+                entry["seen"] += 1
+    for key in sorted(groups):
+        type_name_, src_labels, dst_labels = key
+        g = groups[key]
+        rel_type = f":`{type_name_}`"
+        if not g["props"]:
+            yield {"relType": rel_type,
+                   "sourceNodeLabels": list(src_labels),
+                   "targetNodeLabels": list(dst_labels),
+                   "mandatory": False, "propertyName": "",
+                   "propertyTypes": []}
+            continue
+        for name in sorted(g["props"]):
+            entry = g["props"][name]
+            yield {"relType": rel_type,
+                   "sourceNodeLabels": list(src_labels),
+                   "targetNodeLabels": list(dst_labels),
+                   "mandatory": entry["seen"] == g["count"],
+                   "propertyName": name,
+                   "propertyTypes": sorted(entry["types"])}
+
+
+def _esc(name):
+    # Cypher escapes backticks by doubling them inside a quoted identifier
+    return str(name).replace("`", "``")
+
+
+def _constraint_lists(props):
+    """Normalize a constraint spec to a list of property tuples: the
+    reference shape is a list of property LISTS (schema.cpp
+    CreateUniqueConstraintsForLabel); a flat list of strings is accepted
+    as one single-property constraint per entry."""
+    out = []
+    for item in props or []:
+        if isinstance(item, (list, tuple)):
+            out.append(tuple(str(p) for p in item))
+        else:
+            out.append((str(item),))
+    return out
+
+
+@mgp.read_proc("schema.assert",
+               args=[("indices", "MAP"), ("unique_constraints", "MAP"),
+                     ("existence_constraints", "MAP")],
+               opt_args=[("drop_existing", "BOOLEAN", True)],
+               results=[("action", "STRING"), ("key", "STRING"),
+                        ("keys", "LIST"), ("label", "STRING"),
+                        ("unique", "BOOLEAN")])
+def schema_assert(ctx, indices, unique_constraints, existence_constraints,
+                  drop_existing=True):
+    """Reconcile indexes/constraints to the asserted state (reference
+    schema.cpp Assert): create what's missing, report 'Kept' for what
+    already matches, and with drop_existing drop indexes AND constraints
+    that exist but weren't asserted. indices maps label -> list of
+    properties ([] or [""] asserts a label index); unique_constraints maps
+    label -> list of property lists."""
+    from .apoc_modules import _sub_interpreter
+    interp = _sub_interpreter(ctx)
+    storage = ctx.storage
+
+    asserted_label = set()
+    asserted_prop = set()
+    for label, props in (indices or {}).items():
+        for prop in (props if props else [""]):
+            if prop:
+                asserted_prop.add((label, str(prop)))
+            else:
+                asserted_label.add(label)
+    existing_label = {storage.label_mapper.id_to_name(l)
+                      for l in storage.indices.label.labels()}
+    existing_prop = {
+        (storage.label_mapper.id_to_name(lid),
+         ", ".join(storage.property_mapper.id_to_name(p) for p in pids))
+        for lid, pids in storage.indices.label_property.keys()}
+
+    asserted_unique = {
+        (label, key) for label, props in (unique_constraints or {}).items()
+        for key in _constraint_lists(props)}
+    asserted_exist = {
+        (label, str(p)) for label, props in
+        (existence_constraints or {}).items()
+        for key in _constraint_lists(props) for p in key}
+    existing_unique = {
+        (storage.label_mapper.id_to_name(lid),
+         tuple(storage.property_mapper.id_to_name(p) for p in pids))
+        for lid, pids in storage.constraints.unique.all()}
+    existing_exist = {
+        (storage.label_mapper.id_to_name(lid),
+         storage.property_mapper.id_to_name(pid))
+        for lid, pid in storage.constraints.existence.all()}
+
+    for label in sorted(asserted_label):
+        if label in existing_label:
+            yield {"action": "Kept", "key": label, "keys": [],
+                   "label": label, "unique": False}
+        else:
+            interp.execute(f"CREATE INDEX ON :`{_esc(label)}`")
+            yield {"action": "Created", "key": label, "keys": [],
+                   "label": label, "unique": False}
+    for label, prop in sorted(asserted_prop):
+        if (label, prop) in existing_prop:
+            yield {"action": "Kept", "key": prop, "keys": [prop],
+                   "label": label, "unique": False}
+        else:
+            interp.execute(
+                f"CREATE INDEX ON :`{_esc(label)}`(`{_esc(prop)}`)")
+            yield {"action": "Created", "key": prop, "keys": [prop],
+                   "label": label, "unique": False}
+    for label, key in sorted(asserted_unique):
+        if (label, key) in existing_unique:
+            yield {"action": "Kept", "key": ", ".join(key),
+                   "keys": list(key), "label": label, "unique": True}
+        else:
+            plist = ", ".join(f"n.`{_esc(p)}`" for p in key)
+            interp.execute(
+                f"CREATE CONSTRAINT ON (n:`{_esc(label)}`) "
+                f"ASSERT {plist} IS UNIQUE")
+            yield {"action": "Created", "key": ", ".join(key),
+                   "keys": list(key), "label": label, "unique": True}
+    for label, prop in sorted(asserted_exist):
+        if (label, prop) in existing_exist:
+            yield {"action": "Kept", "key": prop, "keys": [prop],
+                   "label": label, "unique": False}
+        else:
+            interp.execute(
+                f"CREATE CONSTRAINT ON (n:`{_esc(label)}`) "
+                f"ASSERT EXISTS (n.`{_esc(prop)}`)")
+            yield {"action": "Created", "key": prop, "keys": [prop],
+                   "label": label, "unique": False}
+    if drop_existing:
+        for label in sorted(existing_label - asserted_label):
+            interp.execute(f"DROP INDEX ON :`{_esc(label)}`")
+            yield {"action": "Dropped", "key": label, "keys": [],
+                   "label": label, "unique": False}
+        for label, prop in sorted(existing_prop - asserted_prop):
+            props = f"`{'`, `'.join(_esc(p.strip()) for p in prop.split(','))}`"
+            interp.execute(f"DROP INDEX ON :`{_esc(label)}`({props})")
+            yield {"action": "Dropped", "key": prop,
+                   "keys": [p.strip() for p in prop.split(",")],
+                   "label": label, "unique": False}
+        for label, key in sorted(existing_unique - asserted_unique):
+            plist = ", ".join(f"n.`{_esc(p)}`" for p in key)
+            interp.execute(
+                f"DROP CONSTRAINT ON (n:`{_esc(label)}`) "
+                f"ASSERT {plist} IS UNIQUE")
+            yield {"action": "Dropped", "key": ", ".join(key),
+                   "keys": list(key), "label": label, "unique": True}
+        for label, prop in sorted(existing_exist - asserted_exist):
+            interp.execute(
+                f"DROP CONSTRAINT ON (n:`{_esc(label)}`) "
+                f"ASSERT EXISTS (n.`{_esc(prop)}`)")
+            yield {"action": "Dropped", "key": prop, "keys": [prop],
+                   "label": label, "unique": False}
+
+
+# --- meta_util ---------------------------------------------------------------
+
+
+@mgp.read_proc("meta_util.schema",
+               opt_args=[("include_properties", "BOOLEAN", False)],
+               results=[("nodes", "LIST"), ("relationships", "LIST")])
+def meta_util_schema(ctx, include_properties=False):
+    """Graph schema as node/relationship descriptor maps with the
+    reference's field shapes (mage/python/meta_util.py +
+    mage/meta_util/parameters.py): nodes carry {id, labels,
+    properties: {count[, properties_count]}, type: "node"}; relationships
+    carry {id, start, end, label, properties, type: "relationship"}.
+    Raises on an empty database like the reference."""
+    label_mapper = ctx.storage.label_mapper
+    type_mapper = ctx.storage.edge_type_mapper
+    prop_mapper = ctx.storage.property_mapper
+    node_groups: dict = {}
+    rel_groups: dict = {}
+    empty = True
+    for v in ctx.accessor.vertices(ctx.view):
+        empty = False
+        labels = tuple(sorted(label_mapper.id_to_name(l)
+                              for l in v.labels(ctx.view)))
+        g = node_groups.setdefault(
+            labels, {"count": 0, "properties": collections.Counter()})
+        g["count"] += 1
+        if include_properties:
+            for pid in v.properties(ctx.view):
+                g["properties"][prop_mapper.id_to_name(pid)] += 1
+        for e in v.out_edges(ctx.view):
+            dst_labels = tuple(sorted(
+                label_mapper.id_to_name(l)
+                for l in e.to_vertex().labels(ctx.view)))
+            key = (labels, type_mapper.id_to_name(e.edge_type), dst_labels)
+            rg = rel_groups.setdefault(
+                key, {"count": 0, "properties": collections.Counter()})
+            rg["count"] += 1
+            if include_properties:
+                for pid in e.properties(ctx.view):
+                    rg["properties"][prop_mapper.id_to_name(pid)] += 1
+    if empty:
+        raise QueryException(
+            "Can't generate a graph schema since there is no data in the "
+            "database.")
+
+    def props_map(g):
+        if include_properties:
+            return {"count": g["count"],
+                    "properties_count": dict(g["properties"])}
+        return {"count": g["count"]}
+
+    nodes = []
+    node_id = {}
+    for i, labels in enumerate(sorted(node_groups)):
+        node_id[labels] = i
+        nodes.append({"id": i, "labels": list(labels),
+                      "properties": props_map(node_groups[labels]),
+                      "type": "node"})
+    relationships = []
+    for i, key in enumerate(sorted(rel_groups)):
+        src, type_name_, dst = key
+        relationships.append({
+            "id": i, "start": node_id[src], "end": node_id[dst],
+            "label": type_name_,
+            "properties": props_map(rel_groups[key]),
+            "type": "relationship"})
+    yield {"nodes": nodes, "relationships": relationships}

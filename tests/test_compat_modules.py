@@ -1,0 +1,145 @@
+"""mgps / graph_analyzer / schema / meta_util compatibility modules
+(reference: query_modules/mgps.py, graph_analyzer.py, schema.cpp,
+mage/python/meta_util.py)."""
+
+import pytest
+
+from memgraph_tpu.exceptions import QueryException
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def interp():
+    i = Interpreter(InterpreterContext(InMemoryStorage()))
+    i.execute("CREATE (a:P {x: 1})-[:R {w: 2}]->(b:Q), (c:P)")
+    return i
+
+
+def rows(result):
+    return result[1]
+
+
+def test_mgps_components_and_await(interp):
+    out = rows(interp.execute(
+        "CALL mgps.components() YIELD name, edition, versions RETURN *"))
+    assert [r for r in out] == [["community", "Memgraph", ["5.9.0"]],
+                                ["community", "Neo4j Kernel", ["5.9.0"]]]
+    assert rows(interp.execute(
+        "CALL mgps.await_indexes(1) YIELD * RETURN 1")) == []
+
+
+def test_mgps_validate(interp):
+    assert rows(interp.execute(
+        "CALL mgps.validate(false, 'bad %s', ['x']) YIELD * RETURN 1")) == []
+    with pytest.raises(QueryException, match="bad x"):
+        interp.execute(
+            "CALL mgps.validate(true, 'bad %s', ['x']) YIELD * RETURN 1")
+
+
+def test_graph_analyzer(interp):
+    out = dict(rows(interp.execute(
+        "CALL graph_analyzer.analyze() YIELD name, value RETURN *")))
+    assert out["nodes"] == "3"
+    assert out["edges"] == "1"
+    assert out["number_of_weakly_components"] == "2"
+    assert out["bridges"] == "1"
+    assert out["self_loops"] == "0"
+    assert out["is_dag"] == "True"
+    assert out["is_tree"] == "False"  # disconnected
+    # reference analysis names resolve
+    out = rows(interp.execute(
+        "CALL graph_analyzer.analyze(['avg_degree']) "
+        "YIELD value RETURN value"))
+    assert abs(float(out[0][0]) - 2 / 3) < 1e-9
+    with pytest.raises(QueryException):
+        interp.execute(
+            "CALL graph_analyzer.analyze(['bogus']) YIELD value RETURN 1")
+    assert len(rows(interp.execute(
+        "CALL graph_analyzer.help() YIELD name RETURN name"))) >= 10
+
+
+def test_graph_analyzer_subgraph(interp):
+    out = rows(interp.execute(
+        "MATCH (a:P)-[r:R]->(b:Q) "
+        "CALL graph_analyzer.analyze_subgraph([a, b], [r], ['nodes', "
+        "'edges', 'is_tree']) YIELD name, value RETURN value"))
+    assert [v[0] for v in out] == ["2", "1", "True"]
+
+
+def test_schema_node_type_properties(interp):
+    out = rows(interp.execute(
+        "CALL schema.node_type_properties() "
+        "YIELD nodeType, nodeLabels, mandatory, propertyName, propertyTypes "
+        "RETURN nodeType, nodeLabels, mandatory, propertyName, "
+        "propertyTypes ORDER BY nodeType"))
+    # one P carries x, the other doesn't -> mandatory False
+    assert out == [[":`P`", ["P"], False, "x", ["INTEGER"]],
+                   [":`Q`", ["Q"], False, "", []]]
+
+
+def test_schema_rel_type_properties(interp):
+    out = rows(interp.execute(
+        "CALL schema.rel_type_properties() "
+        "YIELD relType, sourceNodeLabels, targetNodeLabels, mandatory, "
+        "propertyName RETURN *"))
+    assert out == [[True, "w", ":`R`", ["P"], ["Q"]]]
+
+
+def test_schema_assert_creates_and_drops(interp):
+    out = rows(interp.execute(
+        "CALL schema.assert({P: ['x']}, {}, {}, true) "
+        "YIELD action, label, key RETURN *"))
+    assert out == [["Created", "x", "P"]]
+    assert rows(interp.execute("SHOW INDEX INFO")) == [
+        ["label+property", "P", ["x"], 1]]
+    # re-assert: existing entries are reported as Kept (reference behavior)
+    assert rows(interp.execute(
+        "CALL schema.assert({P: ['x']}, {}, {}, true) "
+        "YIELD action RETURN action")) == [["Kept"]]
+    # dropping via empty assertion
+    out = rows(interp.execute(
+        "CALL schema.assert({}, {}, {}, true) YIELD action, label "
+        "RETURN *"))
+    assert out == [["Dropped", "P"]]
+    assert rows(interp.execute("SHOW INDEX INFO")) == []
+
+
+def test_schema_assert_constraints(interp):
+    interp.execute("MATCH (q:Q) SET q.name = 'only'")
+    # reference shape: unique_constraints is a list of property LISTS
+    rows(interp.execute(
+        "CALL schema.assert({}, {Q: [['name']]}, {Q: ['name']}, false) "
+        "YIELD action, unique RETURN *"))
+    out = rows(interp.execute("SHOW CONSTRAINT INFO"))
+    kinds = sorted(r[0] for r in out)
+    assert kinds == ["exists", "unique"]
+    # drop_existing reconciles constraints away too
+    out = rows(interp.execute(
+        "CALL schema.assert({}, {}, {}, true) YIELD action, unique "
+        "RETURN action, unique ORDER BY unique"))
+    assert out == [["Dropped", False], ["Dropped", True]]
+    assert rows(interp.execute("SHOW CONSTRAINT INFO")) == []
+    # an assertion the data violates surfaces the engine's error
+    with pytest.raises(Exception):
+        interp.execute(
+            "CALL schema.assert({}, {}, {P: ['x']}, false) "
+            "YIELD action RETURN action")
+
+
+def test_meta_util_schema(interp):
+    out = rows(interp.execute(
+        "CALL meta_util.schema(true) YIELD nodes, relationships RETURN *"))
+    nodes, relationships = out[0]
+    labels = sorted(tuple(n["labels"]) for n in nodes)
+    assert labels == [("P",), ("Q",)]
+    assert all(n["type"] == "node" for n in nodes)
+    rel = relationships[0]
+    assert rel["type"] == "relationship"
+    assert rel["label"] == "R"
+    assert rel["properties"] == {"count": 1, "properties_count": {"w": 1}}
+    assert {"id", "start", "end"} <= set(rel)
+    # empty database raises, as in the reference
+    empty = Interpreter(InterpreterContext(InMemoryStorage()))
+    with pytest.raises(QueryException):
+        empty.execute("CALL meta_util.schema() YIELD nodes RETURN 1")
