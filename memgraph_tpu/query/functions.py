@@ -776,3 +776,154 @@ def fn_frombytestring(ev, args):
     if not isinstance(v, bytes):
         raise TypeException("fromByteString() requires bytes")
     return v.decode("utf-8", errors="replace")
+
+# --- convert.* / mgps.* module functions -------------------------------------
+# (reference: query_modules/convert.cpp registers these as magic functions;
+#  query_modules/mgps.py registers version/validate_predicate)
+
+
+def _json_path_select(text, path):
+    """Parse JSON and walk an optional '$.a.b[0]' path. Returns the selected
+    subtree, or None for an unresolved path or a JSON null leaf (reference
+    convert.cpp ResolveJsonPath/JsonPathToPointer)."""
+    import json
+    import re as _re
+    try:
+        root = json.loads(text)
+    except ValueError as exc:
+        raise TypeException(f"invalid JSON: {exc}") from None
+    if not path:
+        return root
+    cur = root
+    spec = path[1:] if path.startswith("$") else path
+    for step in _re.findall(r"\.([^.\[]+)|\[(\d+)\]", spec):
+        key, idx = step
+        if key:
+            if not isinstance(cur, dict) or key not in cur:
+                return None
+            cur = cur[key]
+        else:
+            i = int(idx)
+            if not isinstance(cur, list) or i >= len(cur):
+                return None
+            cur = cur[i]
+    return cur
+
+
+def _from_json(args, expected_type, what):
+    if not isinstance(args[0], str):
+        raise TypeException(f"convert.from_json_{what} expects a JSON "
+                            f"string")
+    path = args[1] if len(args) > 1 else None
+    if path is not None and not isinstance(path, str):
+        raise TypeException("the path argument must be a string")
+    out = _json_path_select(args[0], path)
+    if out is None:
+        return None  # unresolved path / JSON null leaf -> null
+    if not isinstance(out, expected_type):
+        raise TypeException(
+            f"convert.from_json_{what} expects a JSON "
+            f"{'object' if expected_type is dict else 'array'}")
+    return out
+
+
+@register("convert.from_json_map", 1, 2)
+def fn_convert_from_json_map(ev, args):
+    return _from_json(args, dict, "map")
+
+
+@register("convert.from_json_list", 1, 2)
+def fn_convert_from_json_list(ev, args):
+    return _from_json(args, list, "list")
+
+
+def _node_json(ev, v):
+    mapper = ev.ctx.storage.property_mapper
+    obj = {"id": str(v.gid), "type": "node"}
+    labels = [ev.ctx.storage.label_mapper.id_to_name(l)
+              for l in v.labels(ev.ctx.view)]
+    if labels:
+        obj["labels"] = labels
+    props = {mapper.id_to_name(pid): _jsonable(ev, val)
+             for pid, val in v.properties(ev.ctx.view).items()}
+    if props:
+        obj["properties"] = props
+    return obj
+
+
+def _edge_json(ev, e):
+    mapper = ev.ctx.storage.property_mapper
+    obj = {"id": str(e.gid), "type": "relationship",
+           "label": ev.ctx.storage.edge_type_mapper.id_to_name(e.edge_type),
+           "start": _node_json(ev, e.from_vertex()),
+           "end": _node_json(ev, e.to_vertex())}
+    props = {mapper.id_to_name(pid): _jsonable(ev, val)
+             for pid, val in e.properties(ev.ctx.view).items()}
+    if props:
+        obj["properties"] = props
+    return obj
+
+
+def _jsonable(ev, v):
+    """Reference convert.cpp JSON shapes: nodes {id,type,labels,properties},
+    relationships with full start/end node objects, paths as interleaved
+    arrays; temporal/point/enum values serialize via their string form."""
+    from .values import Path as _QPath
+    if isinstance(v, VertexAccessor):
+        return _node_json(ev, v)
+    if isinstance(v, EdgeAccessor):
+        return _edge_json(ev, v)
+    if isinstance(v, _QPath):
+        out = []
+        for k, item in enumerate(v.items):
+            out.append(_node_json(ev, item) if k % 2 == 0
+                       else _edge_json(ev, item))
+        return out
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(ev, x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(ev, val) for k, val in v.items()}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)  # temporal/point/enum -> string form
+
+
+@register("convert.to_json", 1, 1, propagate_null=False)
+def fn_convert_to_json(ev, args):
+    import json
+    return json.dumps(_jsonable(ev, args[0]), separators=(",", ":"))
+
+
+@register("convert.to_map", 1, 1)
+def fn_convert_to_map(ev, args):
+    # a map passes through; a node/relationship yields its properties;
+    # anything else yields null (reference convert.cpp to_map)
+    v = args[0]
+    if isinstance(v, dict):
+        return v
+    if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        mapper = ev.ctx.storage.property_mapper
+        return {mapper.id_to_name(pid): val
+                for pid, val in v.properties(ev.ctx.view).items()}
+    return None
+
+
+@register("mgps.version", 0, 0, propagate_null=False)
+def fn_mgps_version(ev, args):
+    return "5.9.0"
+
+
+@register("mgps.validate_predicate", 3, 3)
+def fn_mgps_validate_predicate(ev, args):
+    predicate, message, params = args
+    if not isinstance(predicate, bool):
+        raise TypeException(
+            "mgps.validate_predicate expects a boolean predicate")
+    if predicate:
+        try:
+            rendered = message % tuple(params or [])
+        except (TypeError, ValueError) as exc:
+            raise TypeException(
+                f"invalid validation message format: {exc}") from None
+        raise TypeException(rendered)
+    return True

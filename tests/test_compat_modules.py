@@ -143,3 +143,51 @@ def test_meta_util_schema(interp):
     empty = Interpreter(InterpreterContext(InMemoryStorage()))
     with pytest.raises(QueryException):
         empty.execute("CALL meta_util.schema() YIELD nodes RETURN 1")
+
+
+def test_convert_functions(interp):
+    out = rows(interp.execute(
+        "RETURN convert.from_json_map('{\"k\": 1}') AS m, "
+        "convert.from_json_list('[1, 2]') AS l"))
+    assert out == [[{"k": 1}, [1, 2]]]
+    # reference node shape: {id, type, labels, properties}
+    import json
+    out = rows(interp.execute(
+        "MATCH (n:P {x: 1}) RETURN convert.to_json(n), convert.to_map(n)"))
+    doc = json.loads(out[0][0])
+    assert doc["type"] == "node" and doc["labels"] == ["P"]
+    assert doc["properties"] == {"x": 1}
+    assert out[0][1] == {"x": 1}
+    # relationship shape has full start/end node objects
+    out = rows(interp.execute(
+        "MATCH ()-[r:R]->() RETURN convert.to_json(r)"))
+    rel = json.loads(out[0][0])
+    assert rel["type"] == "relationship" and rel["label"] == "R"
+    assert rel["start"]["type"] == "node" and rel["end"]["type"] == "node"
+    # optional JSON path argument + null semantics
+    out = rows(interp.execute(
+        "RETURN convert.from_json_map('{\"a\": {\"b\": 1}}', '$.a'), "
+        "convert.from_json_map('{\"a\": 1}', '$.zzz'), "
+        "convert.from_json_map('null')"))
+    assert out == [[{"b": 1}, None, None]]
+    # non-map-convertible yields null; bad JSON raises
+    assert rows(interp.execute("RETURN convert.to_map(5)")) == [[None]]
+    with pytest.raises(Exception):
+        interp.execute("RETURN convert.from_json_map('[1]')")
+    with pytest.raises(Exception):
+        interp.execute("RETURN convert.from_json_list('nope')")
+
+
+def test_mgps_functions(interp):
+    assert rows(interp.execute("RETURN mgps.version()")) == [["5.9.0"]]
+    assert rows(interp.execute(
+        "RETURN mgps.validate_predicate(false, 'm %s', ['x'])")) == [[True]]
+    with pytest.raises(Exception):
+        interp.execute("RETURN mgps.validate_predicate(true, 'm %s', ['x'])")
+    # bad format strings surface as query errors, not raw TypeErrors
+    with pytest.raises(QueryException, match="format"):
+        interp.execute(
+            "RETURN mgps.validate_predicate(true, 'm %s %s', ['x'])")
+    # null predicate propagates null (openCypher ternary)
+    assert rows(interp.execute(
+        "RETURN mgps.validate_predicate(null, 'm', [])")) == [[None]]
