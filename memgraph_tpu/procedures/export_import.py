@@ -107,3 +107,173 @@ def import_json(ctx, path):
                                 value)
             n_rels += 1
     yield {"nodes": n_nodes, "relationships": n_rels}
+
+
+@mgp.read_proc("export_util.graphml",
+               opt_args=[("path", "STRING", ""), ("config", "MAP", None)],
+               results=[("status", "STRING")])
+def export_graphml(ctx, path="", config=None):
+    """Whole-database GraphML export (reference export_util.py graphml):
+    nodes carry a 'labels' data key (:A:B form) plus properties; edges a
+    'label' key. config.leaveOutLabels / leaveOutProperties are BOOLEANS
+    (omit all labels / all properties, as in the reference's
+    set_default_config); config.stream returns the XML in `status`
+    instead of writing a file. Property keys get sequential GraphML ids
+    (d0, d1, ...) so user properties can't collide with the reserved
+    labels/label keys."""
+    from xml.sax.saxutils import escape, quoteattr
+    config = config or {}
+    if not isinstance(config.get("leaveOutLabels", False), bool) or \
+            not isinstance(config.get("leaveOutProperties", False), bool):
+        raise ProcedureException(
+            "leaveOutLabels / leaveOutProperties must be booleans")
+    drop_labels = bool(config.get("leaveOutLabels", False))
+    drop_props = bool(config.get("leaveOutProperties", False))
+    stream = bool(config.get("stream", False))
+    if not path and not stream:
+        raise ProcedureException(
+            "export_util.graphml requires a path or {stream: true}")
+    storage = ctx.storage
+    lm, pm, tm = (storage.label_mapper, storage.property_mapper,
+                  storage.edge_type_mapper)
+    key_ids: dict = {}
+
+    def key_id(name):
+        if name not in key_ids:
+            key_ids[name] = f"d{len(key_ids)}"
+        return key_ids[name]
+
+    nodes, edges = [], []
+    for va in ctx.accessor.vertices(ctx.view):
+        labels = [] if drop_labels else \
+            [lm.id_to_name(l) for l in va.labels(ctx.view)]
+        props = {} if drop_props else \
+            {pm.id_to_name(k): _value_to_json(v, storage, ctx.view)
+             for k, v in va.properties(ctx.view).items()}
+        for name in props:
+            key_id(name)
+        nodes.append((va.gid, labels, props))
+    for ea in ctx.accessor.edges(ctx.view):
+        props = {} if drop_props else \
+            {pm.id_to_name(k): _value_to_json(v, storage, ctx.view)
+             for k, v in ea.properties(ctx.view).items()}
+        for name in props:
+            key_id(name)
+        edges.append((ea.gid, ea.from_vertex().gid, ea.to_vertex().gid,
+                      tm.id_to_name(ea.edge_type), props))
+
+    def data_value(v):
+        return escape(json.dumps(v) if isinstance(v, (list, dict))
+                      else str(v))
+
+    parts = []
+    parts.append('<?xml version="1.0" encoding="UTF-8"?>\n')
+    parts.append('<graphml xmlns='
+                 '"http://graphml.graphdrawing.org/xmlns">\n')
+    parts.append('<key id="labels" for="node" attr.name="labels" '
+                 'attr.type="string"/>\n')
+    parts.append('<key id="label" for="edge" attr.name="label" '
+                 'attr.type="string"/>\n')
+    for name, kid in sorted(key_ids.items(), key=lambda kv: kv[1]):
+        parts.append(f'<key id="{kid}" for="all" '
+                     f'attr.name={quoteattr(str(name))}/>\n')
+    parts.append('<graph id="G" edgedefault="directed">\n')
+    for gid, labels, props in nodes:
+        parts.append(f'<node id="n{gid}">')
+        if labels:
+            parts.append('<data key="labels">'
+                         + escape(":" + ":".join(labels)) + "</data>")
+        for k, v in sorted(props.items()):
+            parts.append(f'<data key="{key_ids[k]}">'
+                         + data_value(v) + "</data>")
+        parts.append("</node>\n")
+    for gid, src, dst, type_name, props in edges:
+        parts.append(f'<edge id="e{gid}" source="n{src}" '
+                     f'target="n{dst}">')
+        parts.append('<data key="label">' + escape(type_name) + "</data>")
+        for k, v in sorted(props.items()):
+            parts.append(f'<data key="{key_ids[k]}">'
+                         + data_value(v) + "</data>")
+        parts.append("</edge>\n")
+    parts.append("</graph>\n</graphml>\n")
+    document = "".join(parts)
+    if path:
+        os.makedirs(os.path.dirname(os.path.abspath(str(path))),
+                    exist_ok=True)
+        with open(str(path), "w", encoding="utf-8") as f:
+            f.write(document)
+        yield {"status": f"Exported {len(nodes)} nodes and {len(edges)} "
+                         f"relationships to {path}."}
+    else:
+        yield {"status": document}
+
+
+@mgp.read_proc("export_util.csv_query",
+               args=[("query", "STRING")],
+               opt_args=[("file_path", "STRING", ""),
+                         ("stream", "BOOLEAN", False)],
+               results=[("file_path", "STRING"), ("data", "STRING")])
+def export_csv_query(ctx, query, file_path="", stream=False):
+    """Run a query and emit its results as CSV to a file, a returned
+    stream, or both (reference export_util.py csv_query)."""
+    import csv
+    import io
+    if not file_path and not stream:
+        raise ProcedureException(
+            "provide a file_path or set stream to true")
+    from .apoc_modules import _sub_interpreter
+    interp = _sub_interpreter(ctx)
+    columns, rows, _ = interp.execute(query)
+    from ..storage.storage import EdgeAccessor, VertexAccessor
+
+    def cell(v):
+        if v is None:
+            return ""
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return v
+        if isinstance(v, (VertexAccessor, EdgeAccessor, list, dict)):
+            # structured values serialize as JSON, not object reprs
+            from ..query.functions import _jsonable
+            from ..query.eval import Evaluator, EvalContext
+            ev = Evaluator(EvalContext(ctx.accessor, view=ctx.view))
+            return json.dumps(_jsonable(ev, v), separators=(",", ":"))
+        return str(v)
+
+    buf = io.StringIO()
+    writer = csv.writer(buf, quoting=csv.QUOTE_NONNUMERIC)
+    writer.writerow(columns)
+    for row in rows:
+        writer.writerow([cell(v) for v in row])
+    data = buf.getvalue()
+    if file_path:
+        os.makedirs(os.path.dirname(os.path.abspath(str(file_path))),
+                    exist_ok=True)
+        with open(str(file_path), "w", encoding="utf-8") as f:
+            f.write(data)
+    yield {"file_path": str(file_path),
+           "data": data if stream else ""}
+
+
+@mgp.read_proc("csv_utils.create_csv_file",
+               args=[("filepath", "STRING"), ("content", "STRING")],
+               opt_args=[("is_append", "BOOLEAN", False)],
+               results=[("filepath", "STRING")])
+def csv_utils_create(ctx, filepath, content, is_append=False):
+    """Create or append to a CSV file (reference mage/cpp/csv_utils)."""
+    os.makedirs(os.path.dirname(os.path.abspath(str(filepath))),
+                exist_ok=True)
+    with open(str(filepath), "a" if is_append else "w",
+              encoding="utf-8") as f:
+        f.write(str(content))
+    yield {"filepath": str(filepath)}
+
+
+@mgp.read_proc("csv_utils.delete_csv_file",
+               args=[("filepath", "STRING")],
+               results=[("filepath", "STRING")])
+def csv_utils_delete(ctx, filepath):
+    try:
+        os.remove(str(filepath))
+    except FileNotFoundError:
+        raise ProcedureException(f"file {filepath!r} does not exist")
+    yield {"filepath": str(filepath)}

@@ -191,3 +191,81 @@ def test_mgps_functions(interp):
     # null predicate propagates null (openCypher ternary)
     assert rows(interp.execute(
         "RETURN mgps.validate_predicate(null, 'm', [])")) == [[None]]
+
+
+def test_export_graphml_and_csv(tmp_path, interp):
+    out = rows(interp.execute(
+        f"CALL export_util.graphml('{tmp_path}/g.graphml') "
+        f"YIELD status RETURN status"))
+    assert "3 nodes" in out[0][0]
+    content = (tmp_path / "g.graphml").read_text()
+    assert content.startswith('<?xml version="1.0"')
+    assert '<data key="labels">:P</data>' in content
+    assert '<data key="label">R</data>' in content
+    # round-trip sanity: stdlib XML parser accepts it
+    import xml.etree.ElementTree as ET
+    ET.fromstring(content)
+    out = rows(interp.execute(
+        "CALL export_util.csv_query('MATCH (n:P) RETURN n.x AS x "
+        "ORDER BY x', '', true) YIELD data RETURN data"))
+    assert out[0][0].splitlines()[0] == '"x"'
+    with pytest.raises(Exception):
+        interp.execute(
+            "CALL export_util.csv_query('RETURN 1', '', false) "
+            "YIELD data RETURN data")
+
+
+def test_csv_utils(tmp_path, interp):
+    f = tmp_path / "t.csv"
+    interp.execute(
+        f"CALL csv_utils.create_csv_file('{f}', 'a,b\\n') "
+        f"YIELD filepath RETURN filepath")
+    interp.execute(
+        f"CALL csv_utils.create_csv_file('{f}', '1,2\\n', true) "
+        f"YIELD filepath RETURN filepath")
+    assert f.read_text() == "a,b\n1,2\n"
+    interp.execute(
+        f"CALL csv_utils.delete_csv_file('{f}') YIELD filepath RETURN 1")
+    assert not f.exists()
+    with pytest.raises(Exception):
+        interp.execute(
+            f"CALL csv_utils.delete_csv_file('{f}') YIELD filepath RETURN 1")
+
+
+def test_export_graphml_stream_and_bool_config(interp):
+    out = rows(interp.execute(
+        "CALL export_util.graphml('', {stream: true}) "
+        "YIELD status RETURN status"))
+    import xml.etree.ElementTree as ET
+    ET.fromstring(out[0][0])  # stream mode returns the XML document
+    # leaveOutProperties is a boolean (reference set_default_config)
+    out = rows(interp.execute(
+        "CALL export_util.graphml('', {stream: true, "
+        "leaveOutProperties: true}) YIELD status RETURN status"))
+    assert "<data key=\"d0\">" not in out[0][0]
+    with pytest.raises(Exception):
+        interp.execute(
+            "CALL export_util.graphml('', {stream: true, "
+            "leaveOutLabels: ['A']}) YIELD status RETURN 1")
+
+
+def test_export_graphml_reserved_key_collision(tmp_path, interp):
+    # a property literally named 'labels' must not clash with the
+    # reserved labels key (sequential data-key ids)
+    interp.execute("CREATE (:Tricky {labels: 'x'})")
+    out = rows(interp.execute(
+        "CALL export_util.graphml('', {stream: true}) "
+        "YIELD status RETURN status"))
+    import xml.etree.ElementTree as ET
+    root = ET.fromstring(out[0][0])
+    key_ids = [k.get("id") for k in root.findall(
+        "{http://graphml.graphdrawing.org/xmlns}key")]
+    assert len(key_ids) == len(set(key_ids))  # no duplicate key ids
+
+
+def test_csv_query_serializes_nodes_as_json(interp):
+    out = rows(interp.execute(
+        "CALL export_util.csv_query('MATCH (n:Q) RETURN n', '', true) "
+        "YIELD data RETURN data"))
+    assert "VertexAccessor object at" not in out[0][0]
+    assert '""type"":""node""' in out[0][0].replace("\r", "")
