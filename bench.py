@@ -9,23 +9,42 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
                 (/root/reference/mage/cpp/pagerank_module), measured on the
                 same graph with the same iteration count.
 
-Also verifies top-100 rank parity between the TPU and CPU implementations
-(the BASELINE.json acceptance criterion) and reports CALL-to-first-record
-latency through the module/CSR-cache path on a smaller stored graph.
+Hardening (round 2, after BENCH_r01 recorded 0.0 on a dead device tunnel):
+  - the device is probed in a SUBPROCESS with a short timeout before the
+    main process ever imports jax, so a wedged axon tunnel cannot hang us;
+  - every device stage runs in a subprocess with its own timeout and a
+    fallback ladder (axon @ 10M edges -> axon @ 1M -> jax-CPU @ 10M), so
+    the driver always receives a nonzero measurement with the execution
+    path recorded in "extra";
+  - the scipy baseline runs first (pure numpy/scipy — cannot wedge).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
 
-N_NODES = 1_000_000
-N_EDGES = 10_000_000
+N_NODES = int(os.environ.get("BENCH_N_NODES", 1_000_000))
+N_EDGES = int(os.environ.get("BENCH_N_EDGES", 10_000_000))
 ITERATIONS = 50
 DAMPING = 0.85
+
+PROBE_TIMEOUT_SEC = 30
+STAGE_TIMEOUT_SEC = 300
+MASTER_TIMEOUT_SEC = int(os.environ.get("BENCH_MASTER_TIMEOUT", 530))
+
+# best-so-far partial result; the belt-and-braces watchdog prints this, so
+# a wedge after the CPU baseline still yields a nonzero, honest record
+PARTIAL = {
+    "metric": "pagerank_edges_per_sec_10M", "value": 0.0, "unit": "edges/s",
+    "vs_baseline": 0.0, "extra": {"error": "bench wedged before any stage"},
+}
 
 
 def log(msg):
@@ -61,9 +80,32 @@ def cpu_pagerank(src, dst, n_nodes, iterations=ITERATIONS, damping=DAMPING):
     return rank, elapsed
 
 
-def tpu_pagerank(graph, iterations=ITERATIONS, damping=DAMPING):
-    from memgraph_tpu.ops.pagerank import _pagerank_kernel
+# --------------------------------------------------------------------------
+# device-side stages (run in subprocesses; see --stage flags at the bottom)
+# --------------------------------------------------------------------------
+
+def stage_probe():
+    """Tiny end-to-end device check: devices() + a compiled matmul, with a
+    host transfer to force completion. Exits 0 iff the device works."""
+    import jax
     import jax.numpy as jnp
+    ds = jax.devices()
+    x = jnp.ones((256, 256), jnp.float32)
+    s = float((x @ x).sum())
+    print(json.dumps({"devices": [str(d) for d in ds], "sum": s}))
+
+
+def stage_pagerank(n_nodes, n_edges, seed, out_path):
+    """CSR export + device PageRank; writes ranks + timings to out_path."""
+    from memgraph_tpu.ops import csr
+    from memgraph_tpu.ops.pagerank import _pagerank_kernel
+    import jax
+    import jax.numpy as jnp
+
+    src, dst = generate_graph(n_nodes, n_edges, seed)
+    t0 = time.perf_counter()
+    graph = csr.from_coo(src, dst, n_nodes=n_nodes).to_device()
+    export_s = time.perf_counter() - t0
 
     def run(d):
         # CSC ((dst, src)-sorted) arrays — the kernel's required order
@@ -71,23 +113,25 @@ def tpu_pagerank(graph, iterations=ITERATIONS, damping=DAMPING):
                                 graph.csc_weights,
                                 graph.src_idx, graph.weights,
                                 jnp.int32(graph.n_nodes), graph.n_pad,
-                                jnp.float32(d), iterations,
+                                jnp.float32(d), ITERATIONS,
                                 jnp.float32(0.0))  # tol=0 → fixed iterations
 
     # compile + warm up (excluded from timing); host-transfer forces
     # completion — block_until_ready is unreliable on the tunneled platform
-    rank, err, iters = run(damping)
+    rank, err, iters = run(DAMPING)
     _ = float(rank[0])
     t0 = time.perf_counter()
-    rank, err, iters = run(damping)
+    rank, err, iters = run(DAMPING)
     _ = float(rank[0])  # host sync
     elapsed = time.perf_counter() - t0
-    assert int(iters) == iterations, f"expected {iterations}, ran {int(iters)}"
-    return np.asarray(rank[:graph.n_nodes]), elapsed
+    assert int(iters) == ITERATIONS, f"expected {ITERATIONS}, ran {int(iters)}"
+    np.savez(out_path, ranks=np.asarray(rank[:n_nodes]),
+             elapsed=elapsed, export_s=export_s,
+             platform=jax.devices()[0].platform)
 
 
-def call_to_first_record_latency():
-    """End-to-end module-path latency on a 100k-edge stored graph."""
+def stage_latency(out_path):
+    """CALL-to-first-record latency through the module/CSR-cache path."""
     from memgraph_tpu.storage import InMemoryStorage, StorageConfig, StorageMode
     from memgraph_tpu.ops.csr import GraphCache
     from memgraph_tpu.ops.pagerank import pagerank
@@ -108,86 +152,207 @@ def call_to_first_record_latency():
     t0 = time.perf_counter()
     g = cache.get(acc)
     ranks, _, _ = pagerank(g, max_iterations=100, tol=1e-6)
-    first = (int(g.node_gids[0]), float(ranks[0]))
+    _ = (int(g.node_gids[0]), float(ranks[0]))
     cold = time.perf_counter() - t0
     t0 = time.perf_counter()
     g = cache.get(acc)
     ranks, _, _ = pagerank(g, max_iterations=100, tol=1e-6)
-    ranks[0].block_until_ready()
+    _ = float(ranks[0])
     warm = time.perf_counter() - t0
     acc.abort()
-    return cold, warm
+    np.savez(out_path, cold=cold, warm=warm)
 
 
-def _arm_watchdog(seconds: int = 540):
-    """Print a failure JSON line and exit if the bench wedges (e.g. the TPU
-    tunnel is down) — the driver must always get its one line."""
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+# the stage subprocess currently in flight, so the watchdog can kill it
+# before emitting (an orphan would keep hammering the device tunnel)
+_CURRENT_CHILD = None
+
+
+def _emit_and_exit():
+    child = _CURRENT_CHILD
+    if child is not None and child.poll() is None:
+        try:
+            child.kill()
+        except OSError:
+            pass
+    print(json.dumps(PARTIAL))
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def _arm_watchdog(seconds=MASTER_TIMEOUT_SEC):
     import signal
 
     def on_alarm(signum, frame):
-        print(json.dumps({
-            "metric": "pagerank_edges_per_sec_10M", "value": 0.0,
-            "unit": "edges/s", "vs_baseline": 0.0,
-            "extra": {"error": f"bench timed out after {seconds}s "
-                               f"(device unreachable?)"}}))
-        sys.stdout.flush()
-        import os
-        os._exit(0)
+        PARTIAL["extra"].setdefault(
+            "error", "bench watchdog fired (partial result)")
+        PARTIAL["extra"]["watchdog_fired_after_s"] = seconds
+        _emit_and_exit()
 
     signal.signal(signal.SIGALRM, on_alarm)
     signal.alarm(seconds)
 
 
+def _run_stage(args, env, timeout):
+    """Run this script as a subprocess stage. Returns (rc, stdout) or
+    (None, None) on timeout (the child is killed)."""
+    global _CURRENT_CHILD
+    cmd = [sys.executable, os.path.abspath(__file__)] + args
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=sys.stderr)
+    _CURRENT_CHILD = p
+    try:
+        out, _ = p.communicate(timeout=timeout)
+        return p.returncode, out
+    except subprocess.TimeoutExpired:
+        p.kill()
+        try:
+            p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, None
+    finally:
+        _CURRENT_CHILD = None
+
+
+def _stage_env(platform=None):
+    env = dict(os.environ)
+    if platform is not None:
+        # JAX_PLATFORMS alone is NOT enough: /root/.axon_site pre-inits jax
+        # in subprocesses, so the stage must also jax.config.update() — it
+        # reads this variable (see __main__ below)
+        env["JAX_PLATFORMS"] = platform
+        env["BENCH_JAX_PLATFORM"] = platform
+    return env
+
+
 def main():
     _arm_watchdog()
-    import jax
-    log(f"devices: {jax.devices()}")
-
-    from memgraph_tpu.ops import csr
+    t_bench = time.perf_counter()
 
     log(f"generating {N_EDGES:,}-edge graph ...")
     src, dst = generate_graph()
-
-    log("building CSR ...")
-    t0 = time.perf_counter()
-    graph = csr.from_coo(src, dst, n_nodes=N_NODES).to_device()
-    log(f"  export+transfer: {time.perf_counter() - t0:.2f}s "
-        f"(n_pad={graph.n_pad:,}, e_pad={graph.e_pad:,})")
-
-    log("TPU pagerank ...")
-    tpu_ranks, tpu_time = tpu_pagerank(graph)
-    tpu_eps = N_EDGES * ITERATIONS / tpu_time
-    log(f"  {tpu_time:.3f}s for {ITERATIONS} iterations -> {tpu_eps:,.0f} edges/s")
 
     log("CPU baseline (scipy CSR power iteration) ...")
     cpu_ranks, cpu_time = cpu_pagerank(src, dst, N_NODES)
     cpu_eps = N_EDGES * ITERATIONS / cpu_time
     log(f"  {cpu_time:.3f}s -> {cpu_eps:,.0f} edges/s")
+    PARTIAL["extra"] = {"cpu_seconds_50iter": round(cpu_time, 4),
+                        "error": "device stages did not complete"}
 
-    # acceptance: top-100 rank parity
-    top_tpu = set(np.argsort(-tpu_ranks)[:100].tolist())
-    top_cpu = set(np.argsort(-cpu_ranks)[:100].tolist())
-    overlap = len(top_tpu & top_cpu)
+    log("probing device (subprocess) ...")
+    rc, out = _run_stage(["--stage", "probe"], _stage_env(),
+                         PROBE_TIMEOUT_SEC)
+    device_ok = rc == 0
+    log(f"  probe: rc={rc} ok={device_ok} "
+        f"{(out or b'').decode(errors='replace').strip()}")
+
+    # fallback ladder: tunneled TPU at full size, TPU at 1M edges, then
+    # jax-CPU at full size — the driver must always get a nonzero number
+    ladder = []
+    if device_ok:
+        ladder.append(("axon", N_NODES, N_EDGES, STAGE_TIMEOUT_SEC))
+        ladder.append(("axon", N_NODES // 10, N_EDGES // 10, 120))
+    ladder.append(("cpu", N_NODES, N_EDGES, STAGE_TIMEOUT_SEC))
+
+    result = None
+    for platform, n_nodes, n_edges, budget in ladder:
+        remaining = MASTER_TIMEOUT_SEC - (time.perf_counter() - t_bench) - 15
+        if remaining < 35:
+            log("  out of time budget; stopping the ladder")
+            break
+        budget = min(budget, int(remaining))
+        log(f"pagerank stage: platform={platform} edges={n_edges:,} "
+            f"budget={budget}s ...")
+        with tempfile.NamedTemporaryFile(suffix=".npz") as tf:
+            rc, _ = _run_stage(
+                ["--stage", "pagerank", str(n_nodes), str(n_edges), "7",
+                 tf.name], _stage_env(platform), budget)
+            if rc != 0:
+                log(f"  stage failed (rc={rc}); falling back")
+                continue
+            data = np.load(tf.name)
+            result = {
+                "platform": str(data["platform"]),
+                "n_nodes": n_nodes, "n_edges": n_edges,
+                "ranks": data["ranks"], "elapsed": float(data["elapsed"]),
+                "export_s": float(data["export_s"]),
+            }
+        break
+
+    if result is None:
+        PARTIAL["extra"]["error"] = ("all device stages failed/timed out; "
+                                     "cpu baseline only")
+        _emit_and_exit()
+
+    eps = result["n_edges"] * ITERATIONS / result["elapsed"]
+    log(f"  {result['elapsed']:.3f}s for {ITERATIONS} iterations "
+        f"-> {eps:,.0f} edges/s on {result['platform']}")
+
+    # acceptance: top-100 rank parity vs scipy on the same graph
+    if result["n_edges"] == N_EDGES:
+        base_ranks = cpu_ranks
+        base_eps = cpu_eps
+    else:  # fallback size: recompute baseline at that size for parity
+        s2, d2 = generate_graph(result["n_nodes"], result["n_edges"], 7)
+        base_ranks, base_time = cpu_pagerank(s2, d2, result["n_nodes"])
+        base_eps = result["n_edges"] * ITERATIONS / base_time
+    top_dev = set(np.argsort(-result["ranks"])[:100].tolist())
+    top_cpu = set(np.argsort(-base_ranks)[:100].tolist())
+    overlap = len(top_dev & top_cpu)
     log(f"top-100 overlap: {overlap}/100")
 
-    cold, warm = call_to_first_record_latency()
-    log(f"CALL-to-first-record: cold={cold * 1e3:.1f}ms warm={warm * 1e3:.1f}ms")
-
-    result = {
-        "metric": "pagerank_edges_per_sec_10M",
-        "value": round(tpu_eps, 1),
-        "unit": "edges/s",
-        "vs_baseline": round(tpu_eps / cpu_eps, 3),
-        "extra": {
-            "tpu_seconds_50iter": round(tpu_time, 4),
-            "cpu_seconds_50iter": round(cpu_time, 4),
-            "top100_overlap": overlap,
-            "call_to_first_record_cold_ms": round(cold * 1e3, 1),
-            "call_to_first_record_warm_ms": round(warm * 1e3, 1),
-        },
+    PARTIAL.update({
+        "value": round(eps, 1),
+        "vs_baseline": round(eps / base_eps, 3),
+    })
+    PARTIAL["extra"] = {
+        "device_platform": result["platform"],
+        "bench_edges": result["n_edges"],
+        "device_seconds_50iter": round(result["elapsed"], 4),
+        "cpu_seconds_50iter": round(cpu_time, 4),
+        "csr_export_transfer_s": round(result["export_s"], 2),
+        "top100_overlap": overlap,
+        "device_probe_ok": device_ok,
     }
-    print(json.dumps(result))
+
+    # CALL-to-first-record latency (best-effort; never blocks the result)
+    remaining = MASTER_TIMEOUT_SEC - (time.perf_counter() - t_bench) - 10
+    if remaining > 45:
+        with tempfile.NamedTemporaryFile(suffix=".npz") as tf:
+            rc, _ = _run_stage(
+                ["--stage", "latency", tf.name],
+                _stage_env("axon" if device_ok else "cpu"),
+                min(120, int(remaining)))
+            if rc == 0:
+                data = np.load(tf.name)
+                PARTIAL["extra"]["call_to_first_record_cold_ms"] = round(
+                    float(data["cold"]) * 1e3, 1)
+                PARTIAL["extra"]["call_to_first_record_warm_ms"] = round(
+                    float(data["warm"]) * 1e3, 1)
+
+    _emit_and_exit()
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--stage":
+        _plat = os.environ.get("BENCH_JAX_PLATFORM")
+        if _plat:
+            import jax
+            jax.config.update("jax_platforms", _plat)
+        stage = sys.argv[2]
+        if stage == "probe":
+            stage_probe()
+        elif stage == "pagerank":
+            stage_pagerank(int(sys.argv[3]), int(sys.argv[4]),
+                           int(sys.argv[5]), sys.argv[6])
+        elif stage == "latency":
+            stage_latency(sys.argv[3])
+        else:
+            raise SystemExit(f"unknown stage {stage}")
+    else:
+        main()

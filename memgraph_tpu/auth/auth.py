@@ -122,34 +122,47 @@ class Auth:
         with self._lock:
             return sorted(self._roles)
 
+    def _resolve_locked(self, name: str, privilege: str) -> str | None:
+        """Single resolution routine shared by enforcement and reporting:
+        user deny > user grant > role deny > role grant. Returns 'GRANT',
+        'DENY', or None (no opinion). Caller holds self._lock."""
+        user = self._users.get(name)
+        if user is not None:
+            if privilege in user.denied:
+                return "DENY"
+            if privilege in user.granted:
+                return "GRANT"
+            role_granted = False
+            for role_name in user.roles:
+                role = self._roles.get(role_name)
+                if role is None:
+                    continue
+                if privilege in role.denied:
+                    return "DENY"
+                if privilege in role.granted:
+                    role_granted = True
+            return "GRANT" if role_granted else None
+        role = self._roles.get(name)
+        if role is not None:
+            if privilege in role.denied:
+                return "DENY"
+            if privilege in role.granted:
+                return "GRANT"
+        return None
+
     def effective_privileges(self, name: str) -> list[tuple[str, str]]:
         """[(privilege, 'GRANT'|'DENY')] for a user or role; raises for
-        unknown names. DENYs are reported explicitly."""
+        unknown names. Uses the same resolution order as has_privilege
+        so SHOW PRIVILEGES never contradicts enforcement."""
         with self._lock:
-            target = self._users.get(name) or self._roles.get(name)
-            if target is None:
+            if name not in self._users and name not in self._roles:
                 raise AuthException(f"user or role {name!r} does not exist")
-        out = []
-        for p in PRIVILEGES:
-            denied = False
-            with self._lock:
-                user = self._users.get(name)
-                if p in target.denied:
-                    denied = True
-                elif user is not None:
-                    for role_name in user.roles:
-                        role = self._roles.get(role_name)
-                        if role is not None and p in role.denied:
-                            denied = True
-                            break
-            if denied:
-                out.append((p, "DENY"))
-                continue
-            granted = (self.has_privilege(name, p)
-                       if name in self.users() else p in target.granted)
-            if granted:
-                out.append((p, "GRANT"))
-        return out
+            out = []
+            for p in PRIVILEGES:
+                verdict = self._resolve_locked(name, p)
+                if verdict is not None:
+                    out.append((p, verdict))
+            return out
 
     # --- roles / privileges -------------------------------------------------
 
@@ -213,22 +226,9 @@ class Auth:
         with self._lock:
             if not self._users:
                 return True
-            user = self._users.get(user_name)
-            if user is None:
+            if user_name not in self._users:
                 return False
-            if privilege in user.denied:
-                return False
-            if privilege in user.granted:
-                return True
-            for role_name in user.roles:
-                role = self._roles.get(role_name)
-                if role is None:
-                    continue
-                if privilege in role.denied:
-                    return False
-                if privilege in role.granted:
-                    return True
-            return False
+            return self._resolve_locked(user_name, privilege) == "GRANT"
 
     # --- durability ---------------------------------------------------------
 

@@ -664,12 +664,13 @@ class Parser:
         if first.value == "DROP":
             return A.AuthQuery("drop_user", user=user)
         pw = None
-        if self.accept_kw("ID"):
-            pass
-        if self.accept(T.IDENT):
-            pass
-        if self.accept_kw("PASSWORD") or (self.at(T.IDENT)
-                                          and self.cur.value == "IDENTIFIED"):
+        # reference grammar: CREATE USER user ( IDENTIFIED BY literal )?
+        # (MemgraphCypher.g4:498)
+        if self.at(T.IDENT) and self.cur.value.upper() == "IDENTIFIED":
+            self.advance()
+            self.expect_kw("BY")
+            pw = self.parse_expression()
+        elif self.accept_kw("PASSWORD"):
             pw = self.parse_expression()
         return A.AuthQuery("create_user", user=user, password=pw)
 

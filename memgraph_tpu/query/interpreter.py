@@ -496,6 +496,7 @@ class Interpreter:
         "CoordinatorQuery": "COORDINATOR",
         "TerminateTransactionsQuery": "TRANSACTION_MANAGEMENT",
         "ShowTransactionsQuery": "TRANSACTION_MANAGEMENT",
+        "AnalyzeGraphQuery": "STATS",
     }
 
     def _ensure_writable(self, what: str) -> None:
@@ -631,7 +632,7 @@ class Interpreter:
         needed = _plan_privileges(plan)
         for privilege in sorted(needed):
             self._check_privilege(privilege)
-        is_write = bool(needed - {"MATCH"})
+        is_write = bool(needed - _READ_ONLY_PRIVILEGES)
 
         replication = getattr(self.ctx, "replication", None)
         if replication is not None and replication.role == "replica" \
@@ -908,6 +909,9 @@ class Interpreter:
             name = getattr(self.ctx, "database_name", "memgraph")
             return self._prepare_generator(iter([[name]]), ["Name"], "r")
         if node.kind == "free_memory":
+            # reference requires FREE_MEMORY for FREE MEMORY (declared in
+            # auth.PRIVILEGES; enforce it here, not just declare it).
+            self._check_privilege("FREE_MEMORY")
             import gc
             stats = storage.collect_garbage()
             gc.collect()
@@ -1139,6 +1143,11 @@ def _plan_privileges(plan) -> set:
             needed.add("SET")
         elif isinstance(op, (Op.RemoveProperty, Op.RemoveLabels)):
             needed.add("REMOVE")
+        elif isinstance(op, (Op.LoadCsvOp, Op.LoadJsonlOp,
+                             Op.LoadParquetOp)):
+            # reference: required_privileges.cpp:283-293 (READ_FILE for
+            # LOAD CSV); file-reading operators must not run unprivileged.
+            needed.add("READ_FILE")
         elif isinstance(op, Op.CallProcedureOp):
             from .procedures.registry import global_registry
             proc = global_registry.find(op.proc_name)
@@ -1151,5 +1160,5 @@ def _plan_privileges(plan) -> set:
     return needed
 
 
-def _plan_is_write(plan) -> bool:
-    return bool(_plan_privileges(plan) - {"MATCH", "MODULE_READ"})
+# privileges whose presence does NOT make a plan a write
+_READ_ONLY_PRIVILEGES = frozenset({"MATCH", "MODULE_READ", "READ_FILE"})

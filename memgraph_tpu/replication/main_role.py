@@ -161,14 +161,26 @@ class ReplicaClient:
 
     # --- 2PC (STRICT_SYNC) --------------------------------------------------
 
+    # 2PC vote RPCs run inside the storage engine lock — a hung replica
+    # there stalls every new transaction, so they get a short dedicated
+    # timeout instead of the 30s connection default (advisor finding).
+    TWO_PC_RPC_TIMEOUT_SEC = 5.0
+
     def prepare(self, frame: bytes) -> bool:
         """Phase 1: ship the frame for a vote (held pending on the replica)."""
         if self.status is not ReplicaStatus.READY:
             return False
         with self._lock:
             try:
-                P.send_frame(self._sock, P.MSG_PREPARE, frame)
-                msg_type, payload = P.recv_frame(self._sock)
+                if self._sock is None:
+                    return False
+                old = self._sock.gettimeout()
+                self._sock.settimeout(self.TWO_PC_RPC_TIMEOUT_SEC)
+                try:
+                    P.send_frame(self._sock, P.MSG_PREPARE, frame)
+                    msg_type, payload = P.recv_frame(self._sock)
+                finally:
+                    self._sock.settimeout(old)
                 return msg_type == P.MSG_ACK
             except (ConnectionError, OSError) as e:
                 log.warning("replica %s prepare failed: %s", self.name, e)
@@ -179,9 +191,16 @@ class ReplicaClient:
         """Phase 2: commit/abort the pending frame."""
         with self._lock:
             try:
-                P.send_json(self._sock, P.MSG_FINALIZE,
-                            {"commit_ts": commit_ts, "decision": decision})
-                msg_type, payload = P.recv_frame(self._sock)
+                if self._sock is None:  # mid-registration: nothing prepared
+                    return False
+                old = self._sock.gettimeout()
+                self._sock.settimeout(self.TWO_PC_RPC_TIMEOUT_SEC)
+                try:
+                    P.send_json(self._sock, P.MSG_FINALIZE,
+                                {"commit_ts": commit_ts, "decision": decision})
+                    msg_type, payload = P.recv_frame(self._sock)
+                finally:
+                    self._sock.settimeout(old)
                 if msg_type == P.MSG_ACK:
                     if decision == "commit":
                         self.last_acked_ts = P.parse_json(
@@ -201,12 +220,22 @@ class ReplicaClient:
             self._send_frame_sync(frame)
 
     def heartbeat(self) -> bool:
+        # short timeout: heartbeat holds the per-client lock, and the 2PC
+        # commit path (inside the storage engine lock) waits on that same
+        # lock — a wedged replica must not stall commits for 30s
         with self._lock:
             try:
-                P.send_json(self._sock, P.MSG_HEARTBEAT,
-                            {"main_commit_ts":
-                             self.storage.latest_commit_ts()})
-                msg_type, payload = P.recv_frame(self._sock)
+                if self._sock is None:
+                    return False
+                old = self._sock.gettimeout()
+                self._sock.settimeout(self.TWO_PC_RPC_TIMEOUT_SEC)
+                try:
+                    P.send_json(self._sock, P.MSG_HEARTBEAT,
+                                {"main_commit_ts":
+                                 self.storage.latest_commit_ts()})
+                    msg_type, payload = P.recv_frame(self._sock)
+                finally:
+                    self._sock.settimeout(old)
                 if msg_type == P.MSG_ACK:
                     self.last_acked_ts = P.parse_json(
                         payload)["last_commit_ts"]
@@ -249,6 +278,7 @@ class ReplicationState:
         if not self._consumer_registered:
             self.storage.frame_consumers.append(self._on_commit_frame)
             self.storage.pre_commit_hooks.append(self._on_pre_commit)
+            self.storage.commit_abort_hooks.append(self._on_commit_abort)
             self._consumer_registered = True
 
     def _maybe_remove_consumer(self) -> None:
@@ -256,7 +286,9 @@ class ReplicationState:
             for lst, hook in ((self.storage.frame_consumers,
                                self._on_commit_frame),
                               (self.storage.pre_commit_hooks,
-                               self._on_pre_commit)):
+                               self._on_pre_commit),
+                              (self.storage.commit_abort_hooks,
+                               self._on_commit_abort)):
                 try:
                     lst.remove(hook)
                 except ValueError:
@@ -395,6 +427,25 @@ class ReplicationState:
                 "STRICT_SYNC replica(s) did not confirm the prepare phase: "
                 + ", ".join(c.name for c in failed)
                 + " — transaction aborted")
+
+    def _on_commit_abort(self, commit_ts: int) -> None:
+        """Commit failed after the 2PC vote succeeded (e.g. the WAL write
+        raised): release the prepared frame on every STRICT_SYNC replica
+        so it is not orphaned in its pending-2PC table forever."""
+        if self.role != "main":
+            return
+        # filter by mode only, NOT by READY: a replica that voted yes may
+        # have been marked INVALID concurrently (heartbeat thread); sending
+        # abort to an un-prepared replica is harmless (it pops nothing)
+        with self._lock:
+            strict = [c for c in self.replicas.values()
+                      if c.mode is ReplicationMode.STRICT_SYNC]
+        for c in strict:
+            try:
+                c.finalize(commit_ts, "abort")
+            except Exception:
+                # one broken client must not keep the abort from the rest
+                log.exception("finalize(abort) failed for replica %s", c.name)
 
     def _on_commit_frame(self, frame: bytes, commit_ts: int) -> None:
         if self.role != "main":

@@ -44,6 +44,7 @@ class ReplicaServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]  # resolve port 0 for tests
         self._sock.listen(4)
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -81,10 +82,15 @@ class ReplicaServer:
                 if msg_type == P.MSG_REGISTER:
                     info = P.parse_json(payload)
                     self.epoch = info.get("epoch")
+                    # a (re-)registering MAIN supersedes any in-flight 2PC:
+                    # prepared-but-unfinalized frames from the previous
+                    # connection would otherwise leak forever
+                    self._pending_2pc.clear()
                     P.send_json(conn, P.MSG_REGISTER_OK,
                                 {"last_commit_ts": self.last_commit_ts,
                                  "epoch": self.epoch})
                 elif msg_type == P.MSG_SNAPSHOT:
+                    self._pending_2pc.clear()
                     self._apply_snapshot_bytes(payload)
                     P.send_json(conn, P.MSG_ACK,
                                 {"last_commit_ts": self.last_commit_ts})

@@ -16,6 +16,7 @@ topology changed and a re-export is needed.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
@@ -30,6 +31,8 @@ from .indexes import Indices
 from .mvcc import (materialize_edge, materialize_vertex, prepare_for_write,
                    push_delta)
 from .objects import Edge, Vertex
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -653,6 +656,10 @@ class InMemoryStorage:
         # the commit is visible (outside the engine lock)
         self.frame_consumers: list[Callable] = []
         self.on_commit_hooks: list[Callable] = []  # triggers (txn, commit_ts)
+        # called with commit_ts when a commit fails AFTER the 2PC vote
+        # succeeded (e.g. wal_sink raised) — lets replication send
+        # finalize('abort') so replicas don't orphan prepared frames
+        self.commit_abort_hooks: list[Callable] = []
 
     # --- transactions -------------------------------------------------------
 
@@ -706,7 +713,19 @@ class InMemoryStorage:
                     # durability or visibility effect
                     hook(frame, commit_ts)
                 if self.wal_sink is not None:
-                    self.wal_sink(frame, commit_ts)
+                    try:
+                        self.wal_sink(frame, commit_ts)
+                    except BaseException:
+                        # the vote already succeeded: tell prepared replicas
+                        # to drop the pending frame, or it is orphaned forever
+                        for hook in self.commit_abort_hooks:
+                            try:
+                                hook(commit_ts)
+                            except Exception:
+                                log.exception(
+                                    "commit abort hook failed for ts %d",
+                                    commit_ts)
+                        raise
                 if self.frame_consumers:
                     ship_seq = self._frame_seq
                     self._frame_seq += 1

@@ -1,0 +1,75 @@
+#
+# Copyright (c) 2015-2018 "Neo Technology,"
+# Network Engine for Objects in Lund AB [http://neotechnology.com]
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+#
+
+Feature: ListComprehension
+
+  Scenario: Returning a list comprehension
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)
+      CREATE (a)-[:T]->(:B),
+             (a)-[:T]->(:C)
+      """
+    When executing query:
+      """
+      MATCH p = (n)-->()
+      RETURN [x IN collect(p) | head(nodes(x))] AS p
+      """
+    Then the result should be:
+      | p            |
+      | [(:A), (:A)] |
+    And no side effects
+
+  Scenario: Using a list comprehension in a WITH
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A)
+      CREATE (a)-[:T]->(:B),
+             (a)-[:T]->(:C)
+      """
+    When executing query:
+      """
+      MATCH p = (n:A)-->()
+      WITH [x IN collect(p) | head(nodes(x))] AS p, count(n) AS c
+      RETURN p, c
+      """
+    Then the result should be:
+      | p            | c |
+      | [(:A), (:A)] | 2 |
+    And no side effects
+
+  Scenario: Using a list comprehension in a WHERE
+    Given an empty graph
+    And having executed:
+      """
+      CREATE (a:A {prop: 'c'})
+      CREATE (a)-[:T]->(:B),
+             (a)-[:T]->(:C)
+      """
+    When executing query:
+      """
+      MATCH (n)-->(b)
+      WHERE n.prop IN [x IN labels(b) | lower(x)]
+      RETURN b
+      """
+    Then the result should be:
+      | b    |
+      | (:C) |
+    And no side effects
+

@@ -1,0 +1,703 @@
+"""Gherkin runner for the openCypher TCK conformance suite.
+
+Counterpart of the reference's gql_behave harness
+(/root/reference/tests/gql_behave/run.py + steps/): parses .feature files
+(openCypher M09 TCK, Apache-2.0, (c) Neo Technology — see features/),
+executes each scenario against a fresh in-process Interpreter, and checks
+result tables, expected errors, and side-effect counts.
+
+Step vocabulary supported (the full set used by the M09 features):
+  Given an empty graph | any graph | the <name> graph
+  And having executed: <docstring>
+  And parameters are: <table>
+  When executing query: / executing control query: <docstring>
+  Then the result should be: / , in order: / (ignoring element order for
+      lists): <table>
+  Then the result should be empty
+  And no side effects / the side effects should be: <table>
+  Then a <ErrorType> should be raised at compile time/runtime: <detail>
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+GRAPH_DIR = os.path.join(os.path.dirname(__file__), "graphs")
+FEATURE_DIR = os.path.join(os.path.dirname(__file__), "features")
+
+
+# --------------------------------------------------------------------------
+# Gherkin parsing
+# --------------------------------------------------------------------------
+
+@dataclass
+class Step:
+    keyword: str                    # Given/When/Then/And/But
+    text: str
+    docstring: str | None = None
+    table: list[list[str]] | None = None
+
+
+@dataclass
+class Scenario:
+    feature: str
+    name: str
+    steps: list[Step] = field(default_factory=list)
+
+    @property
+    def id(self) -> str:
+        return f"{self.feature}::{self.name}"
+
+
+def parse_feature(text: str, feature_name: str) -> list[Scenario]:
+    lines = text.split("\n")
+    scenarios: list[Scenario] = []
+    cur: Scenario | None = None
+    outline: Scenario | None = None
+    examples_header: list[str] | None = None
+    i = 0
+
+    def strip_comment(ln: str) -> str:
+        return ln
+
+    while i < len(lines):
+        line = lines[i].strip()
+        if not line or line.startswith("#"):
+            i += 1
+            continue
+        if line.startswith("Feature:"):
+            i += 1
+            continue
+        m = re.match(r"(Scenario Outline|Scenario):\s*(.*)", line)
+        if m:
+            cur = Scenario(feature_name, m.group(2).strip())
+            if m.group(1) == "Scenario Outline":
+                outline = cur
+            else:
+                outline = None
+                scenarios.append(cur)
+            i += 1
+            continue
+        if line.startswith("Examples:"):
+            # expand the outline scenario per example row
+            i += 1
+            header = None
+            rows = []
+            while i < len(lines) and lines[i].strip().startswith("|"):
+                cells = _split_table_row(lines[i].strip())
+                if header is None:
+                    header = cells
+                else:
+                    rows.append(cells)
+                i += 1
+            for k, row in enumerate(rows):
+                subst = dict(zip(header, row))
+                inst = Scenario(feature_name, f"{outline.name} [{k}]")
+                for st in outline.steps:
+                    inst.steps.append(Step(
+                        st.keyword,
+                        _substitute(st.text, subst),
+                        _substitute(st.docstring, subst)
+                        if st.docstring else None,
+                        [[_substitute(c, subst) for c in r]
+                         for r in st.table] if st.table else None))
+                scenarios.append(inst)
+            continue
+        m = re.match(r"(Given|When|Then|And|But)\s+(.*)", line)
+        if m and cur is not None:
+            step = Step(m.group(1), m.group(2).strip())
+            i += 1
+            # docstring?
+            if i < len(lines) and lines[i].strip().startswith('"""'):
+                i += 1
+                doc = []
+                while i < len(lines) and not lines[i].strip().startswith('"""'):
+                    doc.append(lines[i])
+                    i += 1
+                i += 1  # closing """
+                indent = min((len(l) - len(l.lstrip())
+                              for l in doc if l.strip()), default=0)
+                step.docstring = "\n".join(l[indent:] for l in doc)
+            # table?
+            elif i < len(lines) and lines[i].strip().startswith("|"):
+                rows = []
+                while i < len(lines) and lines[i].strip().startswith("|"):
+                    rows.append(_split_table_row(lines[i].strip()))
+                    i += 1
+                step.table = rows
+            cur.steps.append(step)
+            continue
+        i += 1
+    return scenarios
+
+
+def _substitute(text: str, subst: dict) -> str:
+    for k, v in subst.items():
+        text = text.replace(f"<{k}>", v)
+    return text
+
+
+def _split_table_row(line: str) -> list[str]:
+    # split on | not preceded by \ ; cells are trimmed
+    parts = re.split(r"(?<!\\)\|", line)
+    return [p.strip().replace("\\|", "|") for p in parts[1:-1]]
+
+
+def load_all_scenarios(feature_dir: str = FEATURE_DIR) -> list[Scenario]:
+    out = []
+    for fn in sorted(os.listdir(feature_dir)):
+        if not fn.endswith(".feature"):
+            continue
+        with open(os.path.join(feature_dir, fn)) as f:
+            out.extend(parse_feature(f.read(), fn[:-len(".feature")]))
+    return out
+
+
+# --------------------------------------------------------------------------
+# TCK expected-value language
+# --------------------------------------------------------------------------
+
+class TCKValueParser:
+    """Parses TCK table-cell value syntax into canonical comparable forms.
+
+    Canonical forms:
+      None/bool/int/float/str      -> themselves
+      node                         -> ('node', frozenset(labels), props_tuple)
+      relationship                 -> ('rel', type, props_tuple)
+      path                         -> ('path', (start_node, (rel, forward,
+                                       node), ...))
+      list                         -> tuple of canonical values
+      map                          -> ('map', sorted((k, v) tuple))
+    """
+
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def parse(self):
+        v = self.value()
+        self.ws()
+        if self.i != len(self.s):
+            raise ValueError(f"trailing input in TCK value {self.s!r}")
+        return v
+
+    def ws(self):
+        while self.i < len(self.s) and self.s[self.i] in " \t":
+            self.i += 1
+
+    def peek(self):
+        self.ws()
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def value(self):
+        c = self.peek()
+        if c == "'":
+            return self.string()
+        if c == "[":
+            # list or relationship
+            if re.match(r"\[\s*:", self.s[self.i:]):
+                return self.relationship()
+            return self.list_()
+        if c == "{":
+            return self.map_()
+        if c == "(":
+            return self.node()
+        if c == "<":
+            return self.path()
+        m = re.match(r"-?\d+\.\d+(?:[eE][-+]?\d+)?|-?\d+[eE][-+]?\d+|-?\.\d+",
+                     self.s[self.i:])
+        if m:
+            self.i += m.end()
+            return float(m.group(0))
+        m = re.match(r"-?\d+", self.s[self.i:])
+        if m:
+            self.i += m.end()
+            return int(m.group(0))
+        for lit, val in (("true", True), ("false", False), ("null", None),
+                         ("NaN", float("nan")), ("Inf", float("inf")),
+                         ("-Inf", float("-inf"))):
+            if self.s[self.i:self.i + len(lit)] == lit:
+                self.i += len(lit)
+                return val
+        raise ValueError(f"bad TCK value at {self.s[self.i:]!r}")
+
+    def string(self):
+        assert self.peek() == "'"
+        self.i += 1
+        out = []
+        while self.i < len(self.s):
+            c = self.s[self.i]
+            if c == "\\":
+                out.append(self.s[self.i + 1])
+                self.i += 2
+                continue
+            if c == "'":
+                self.i += 1
+                return "".join(out)
+            out.append(c)
+            self.i += 1
+        raise ValueError("unterminated string")
+
+    def list_(self):
+        assert self.peek() == "["
+        self.i += 1
+        items = []
+        if self.peek() == "]":
+            self.i += 1
+            return tuple(items)
+        while True:
+            items.append(self.value())
+            c = self.peek()
+            if c == ",":
+                self.i += 1
+                continue
+            if c == "]":
+                self.i += 1
+                return tuple(items)
+            raise ValueError(f"bad list at {self.s[self.i:]!r}")
+
+    def map_(self):
+        assert self.peek() == "{"
+        self.i += 1
+        items = []
+        if self.peek() == "}":
+            self.i += 1
+            return ("map", tuple(items))
+        while True:
+            self.ws()
+            m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", self.s[self.i:])
+            if not m:
+                raise ValueError(f"bad map key at {self.s[self.i:]!r}")
+            key = m.group(0)
+            self.i += m.end()
+            self.ws()
+            if self.s[self.i] != ":":
+                raise ValueError(f"expected : at {self.s[self.i:]!r}")
+            self.i += 1
+            items.append((key, self.value()))
+            c = self.peek()
+            if c == ",":
+                self.i += 1
+                continue
+            if c == "}":
+                self.i += 1
+                return ("map", tuple(sorted(items)))
+            raise ValueError(f"bad map at {self.s[self.i:]!r}")
+
+    def node(self):
+        assert self.peek() == "("
+        self.i += 1
+        labels, props = self.labels_and_props(")")
+        return ("node", labels, props)
+
+    def labels_and_props(self, closer: str):
+        labels = set()
+        props = ()
+        while True:
+            c = self.peek()
+            if c == ":":
+                self.i += 1
+                m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", self.s[self.i:])
+                labels.add(m.group(0))
+                self.i += m.end()
+            elif c == "{":
+                props = self.map_()[1]
+            elif c == closer:
+                self.i += 1
+                return frozenset(labels), props
+            elif c == "" or c not in ": {":
+                # ignore variable names inside node patterns (rare in TCK)
+                m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", self.s[self.i:])
+                if not m:
+                    raise ValueError(f"bad pattern at {self.s[self.i:]!r}")
+                self.i += m.end()
+
+    def relationship(self):
+        assert self.peek() == "["
+        self.i += 1
+        self.ws()
+        assert self.s[self.i] == ":", f"rel must have type {self.s!r}"
+        self.i += 1
+        m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", self.s[self.i:])
+        rtype = m.group(0)
+        self.i += m.end()
+        props = ()
+        if self.peek() == "{":
+            props = self.map_()[1]
+        if self.peek() != "]":
+            raise ValueError(f"bad relationship at {self.s[self.i:]!r}")
+        self.i += 1
+        return ("rel", rtype, props)
+
+    def path(self):
+        assert self.peek() == "<"
+        self.i += 1
+        items = [self.node()]
+        while self.peek() in "<-":
+            backward = False
+            if self.peek() == "<":
+                backward = True
+                self.i += 1
+                assert self.s[self.i] == "-"
+            self.i += 1  # consume '-'
+            rel = self.relationship()
+            assert self.s[self.i] == "-", f"bad path at {self.s[self.i:]!r}"
+            self.i += 1
+            forward = False
+            if self.i < len(self.s) and self.s[self.i] == ">":
+                forward = True
+                self.i += 1
+            node = self.node()
+            items.append((rel, not backward if (forward or backward)
+                          else True, node))
+        if self.peek() != ">":
+            raise ValueError(f"unterminated path {self.s!r}")
+        self.i += 1
+        return ("path", tuple(items))
+
+
+def parse_tck_value(s: str):
+    return TCKValueParser(s).parse()
+
+
+# --------------------------------------------------------------------------
+# actual-value canonicalization
+# --------------------------------------------------------------------------
+
+def canonicalize(value, storage):
+    """Convert an interpreter result value into the TCK canonical form."""
+    from memgraph_tpu.query.values import Path
+    from memgraph_tpu.storage.storage import EdgeAccessor, VertexAccessor
+
+    lm = storage.label_mapper
+    pm = storage.property_mapper
+    em = storage.edge_type_mapper
+
+    def props_of(d):
+        return tuple(sorted((pm.id_to_name(k), canon(v))
+                            for k, v in d.items()))
+
+    def canon(v):
+        if isinstance(v, VertexAccessor):
+            return ("node",
+                    frozenset(lm.id_to_name(l) for l in v.labels()),
+                    props_of(v.properties()))
+        if isinstance(v, EdgeAccessor):
+            return ("rel", em.id_to_name(v.edge_type),
+                    props_of(v.properties()))
+        if isinstance(v, Path):
+            items = [canon(v.items[0])]
+            for k in range(1, len(v.items), 2):
+                edge = v.items[k]
+                frm = v.items[k - 1]
+                to = v.items[k + 1]
+                forward = edge.from_vertex().vertex is frm.vertex
+                items.append((canon(edge), forward, canon(to)))
+            return ("path", tuple(items))
+        if isinstance(v, dict):
+            return ("map", tuple(sorted((k, canon(x))
+                                        for k, x in v.items())))
+        if isinstance(v, (list, tuple)):
+            return tuple(canon(x) for x in v)
+        if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+            return v  # keep floats as floats; comparator handles int==float
+        return v
+
+    return canon(value)
+
+
+def values_equal(expected, actual) -> bool:
+    import math
+    if isinstance(expected, float) and isinstance(actual, (int, float)):
+        if math.isnan(expected):
+            return isinstance(actual, float) and math.isnan(actual)
+        return float(actual) == expected
+    if isinstance(expected, int) and isinstance(actual, float):
+        return False  # TCK distinguishes 1 from 1.0
+    if isinstance(expected, bool) != isinstance(actual, bool):
+        return False
+    if isinstance(expected, tuple) and isinstance(actual, tuple):
+        if len(expected) != len(actual):
+            return False
+        if expected and expected[0] in ("node", "rel", "path", "map") \
+                and actual and actual[0] == expected[0]:
+            return _tagged_equal(expected, actual)
+        return all(values_equal(e, a) for e, a in zip(expected, actual))
+    return expected == actual
+
+
+def _tagged_equal(e, a) -> bool:
+    tag = e[0]
+    if tag == "node":
+        return e[1] == a[1] and _props_equal(e[2], a[2])
+    if tag == "rel":
+        return e[1] == a[1] and _props_equal(e[2], a[2])
+    if tag == "map":
+        return _props_equal(e[1], a[1])
+    if tag == "path":
+        if len(e[1]) != len(a[1]):
+            return False
+        if not values_equal(e[1][0], a[1][0]):
+            return False
+        for (er, ef, en), (ar, af, an) in zip(e[1][1:], a[1][1:]):
+            if ef != af or not values_equal(er, ar) \
+                    or not values_equal(en, an):
+                return False
+        return True
+    return e == a
+
+
+def _props_equal(e, a) -> bool:
+    if len(e) != len(a):
+        return False
+    for (ek, ev), (ak, av) in zip(e, a):
+        if ek != ak or not values_equal(ev, av):
+            return False
+    return True
+
+
+# --------------------------------------------------------------------------
+# scenario execution
+# --------------------------------------------------------------------------
+
+class ScenarioFailure(AssertionError):
+    pass
+
+
+class ScenarioRunner:
+    def __init__(self):
+        from memgraph_tpu.query.interpreter import (Interpreter,
+                                                    InterpreterContext)
+        from memgraph_tpu.storage import InMemoryStorage
+        self.storage = InMemoryStorage()
+        self.ctx = InterpreterContext(self.storage)
+        self.interp = Interpreter(self.ctx)
+        self.params: dict = {}
+        self.columns: list[str] = []
+        self.rows: list[list] = []
+        self.error: Exception | None = None
+        self.snapshot_before: tuple | None = None
+        self.executed_query = False
+
+    # --- graph state snapshot for side-effect accounting -------------------
+
+    def _snapshot(self):
+        acc = self.storage.access()
+        try:
+            nodes = {}
+            rels = {}
+            for v in acc.vertices():
+                nodes[int(v.gid)] = (frozenset(v.labels()),
+                                     tuple(sorted(
+                                         (k, _freeze(val)) for k, val
+                                         in v.properties().items())))
+            for e in acc.edges():
+                rels[int(e.gid)] = (e.edge_type,
+                                    tuple(sorted(
+                                        (k, _freeze(val)) for k, val
+                                        in e.properties().items())))
+            return nodes, rels
+        finally:
+            acc.abort()
+
+    def side_effects(self) -> dict:
+        before_n, before_r = self.snapshot_before
+        after_n, after_r = self._snapshot()
+        eff = {k: 0 for k in ("+nodes", "-nodes", "+relationships",
+                              "-relationships", "+labels", "-labels",
+                              "+properties", "-properties")}
+        for gid in after_n:
+            if gid not in before_n:
+                eff["+nodes"] += 1
+                eff["+labels"] += len(after_n[gid][0])
+                eff["+properties"] += len(after_n[gid][1])
+            else:
+                b_labels, b_props = before_n[gid]
+                a_labels, a_props = after_n[gid]
+                eff["+labels"] += len(a_labels - b_labels)
+                eff["-labels"] += len(b_labels - a_labels)
+                self._prop_diff(b_props, a_props, eff)
+        for gid in before_n:
+            if gid not in after_n:
+                eff["-nodes"] += 1
+        for gid in after_r:
+            if gid not in before_r:
+                eff["+relationships"] += 1
+                eff["+properties"] += len(after_r[gid][1])
+            else:
+                self._prop_diff(before_r[gid][1], after_r[gid][1], eff)
+        for gid in before_r:
+            if gid not in after_r:
+                eff["-relationships"] += 1
+        return eff
+
+    @staticmethod
+    def _prop_diff(before, after, eff):
+        b = dict(before)
+        a = dict(after)
+        for k in a:
+            if k not in b:
+                eff["+properties"] += 1
+            elif a[k] != b[k]:
+                eff["+properties"] += 1
+                eff["-properties"] += 1
+        for k in b:
+            if k not in a:
+                eff["-properties"] += 1
+
+    # --- steps --------------------------------------------------------------
+
+    def run_step(self, step: Step):
+        t = step.text
+        if t.startswith("an empty graph") or t.startswith("any graph"):
+            return
+        m = re.match(r"the (.+) graph$", t)
+        if m:
+            path = os.path.join(GRAPH_DIR, m.group(1) + ".cypher")
+            with open(path) as f:
+                setup = f.read()
+            for q in _split_statements(setup):
+                self.interp.execute(q)
+            return
+        if t.startswith("having executed"):
+            for q in _split_statements(step.docstring):
+                self.interp.execute(q)
+            return
+        if t.startswith("parameters are"):
+            for k, v in step.table:
+                self.params[k] = _tck_to_python(parse_tck_value(v))
+            return
+        if t.startswith("executing query") \
+                or t.startswith("executing control query"):
+            self.snapshot_before = self._snapshot()
+            self.executed_query = True
+            self.columns, self.rows, self.error = [], [], None
+            try:
+                self.columns, self.rows, _ = self.interp.execute(
+                    step.docstring, self.params or None)
+            except Exception as e:  # noqa: BLE001 — error steps assert on it
+                self.error = e
+                try:
+                    self.interp.reset()
+                except Exception:
+                    pass
+            return
+        if t.startswith("the result should be empty"):
+            self._check_no_error()
+            if self.rows:
+                raise ScenarioFailure(
+                    f"expected empty result, got {self.rows!r}")
+            return
+        m = re.match(r"the result should be(, in order)?"
+                     r"( \(ignoring element order for lists\))?:", t)
+        if m:
+            self._check_no_error()
+            self._check_result(step.table, in_order=bool(m.group(1)),
+                               unordered_lists=bool(m.group(2)))
+            return
+        if t.startswith("no side effects"):
+            if self.executed_query and self.error is None:
+                eff = self.side_effects()
+                nonzero = {k: v for k, v in eff.items() if v}
+                if nonzero:
+                    raise ScenarioFailure(f"unexpected side effects {nonzero}")
+            return
+        if t.startswith("the side effects should be"):
+            self._check_no_error()
+            eff = self.side_effects()
+            expected = {k: 0 for k in eff}
+            for row in step.table:
+                expected[row[0]] = int(row[1])
+            if eff != expected:
+                raise ScenarioFailure(
+                    f"side effects {eff} != expected {expected}")
+            return
+        m = re.match(r"an? (\w+) should be raised at (compile time|runtime)"
+                     r"(?::\s*(\w+))?", t)
+        if m:
+            if self.error is None:
+                raise ScenarioFailure(
+                    f"expected {m.group(1)}, query succeeded with "
+                    f"{self.rows!r}")
+            return
+        raise ScenarioFailure(f"unsupported step: {step.keyword} {t}")
+
+    def _check_no_error(self):
+        if self.error is not None:
+            raise ScenarioFailure(
+                f"query raised {type(self.error).__name__}: {self.error}") \
+                from self.error
+
+    def _check_result(self, table, in_order: bool, unordered_lists: bool):
+        header, *rows = table
+        if list(self.columns) != header:
+            raise ScenarioFailure(
+                f"columns {self.columns!r} != expected {header!r}")
+        expected = [[parse_tck_value(c) for c in row] for row in rows]
+        actual = [[canonicalize(v, self.storage) for v in row]
+                  for row in self.rows]
+        if unordered_lists:
+            expected = [[_sort_lists(c) for c in row] for row in expected]
+            actual = [[_sort_lists(c) for c in row] for row in actual]
+        if len(expected) != len(actual):
+            raise ScenarioFailure(
+                f"{len(actual)} rows != expected {len(expected)}: "
+                f"actual={actual!r} expected={expected!r}")
+        if in_order:
+            for e_row, a_row in zip(expected, actual):
+                if not _row_equal(e_row, a_row):
+                    raise ScenarioFailure(
+                        f"row {a_row!r} != expected {e_row!r}")
+        else:
+            remaining = list(actual)
+            for e_row in expected:
+                for idx, a_row in enumerate(remaining):
+                    if _row_equal(e_row, a_row):
+                        del remaining[idx]
+                        break
+                else:
+                    raise ScenarioFailure(
+                        f"expected row {e_row!r} not found in "
+                        f"{remaining!r}")
+
+    def run(self, scenario: Scenario):
+        for step in scenario.steps:
+            self.run_step(step)
+
+
+def _row_equal(e_row, a_row) -> bool:
+    return len(e_row) == len(a_row) and all(
+        values_equal(e, a) for e, a in zip(e_row, a_row))
+
+
+def _sort_lists(v):
+    if isinstance(v, tuple) and (not v or v[0] not in
+                                 ("node", "rel", "path", "map")):
+        return tuple(sorted((_sort_lists(x) for x in v), key=repr))
+    return v
+
+
+def _freeze(v):
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _tck_to_python(v):
+    """Canonical TCK value -> plain python (for query parameters)."""
+    if isinstance(v, tuple):
+        if v and v[0] == "map":
+            return {k: _tck_to_python(x) for k, x in v[1]}
+        return [_tck_to_python(x) for x in v]
+    return v
+
+
+_STMT_SPLIT = re.compile(r";\s*\n")
+
+
+def _split_statements(text: str) -> list[str]:
+    return [s.strip() for s in _STMT_SPLIT.split(text) if s.strip()]

@@ -181,3 +181,50 @@ def test_do_subqueries_enforce_rbac(rbac):
     assert out == [[1]]
     c.close()
     admin.close()
+
+
+def test_load_csv_requires_read_file(rbac, tmp_path):
+    # advisor finding: LOAD CSV must require READ_FILE, else any
+    # authenticated user can read arbitrary server files
+    # (reference: required_privileges.cpp:283-293)
+    p = tmp_path / "x.csv"
+    p.write_text("a,b\n1,2\n")
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    with pytest.raises(BoltClientError):
+        c.execute(f"LOAD CSV FROM '{p}' WITH HEADER AS row RETURN row")
+    c.reset()
+    rbac["auth"].grant("reader", ["READ_FILE"])
+    _, rows, _ = c.execute(
+        f"LOAD CSV FROM '{p}' WITH HEADER AS row RETURN row.a")
+    assert rows == [["1"]]
+    c.close()
+
+
+def test_free_memory_requires_privilege(rbac):
+    c = BoltClient(port=rbac["port"], username="reader",
+                   password="readerpw")
+    with pytest.raises(BoltClientError):
+        c.execute("FREE MEMORY")
+    c.reset()
+    rbac["auth"].grant("reader", ["FREE_MEMORY"])
+    c.execute("FREE MEMORY")
+    c.close()
+
+
+def test_effective_privileges_matches_enforcement(rbac):
+    # advisor finding: SHOW PRIVILEGES must use the same resolution order
+    # as enforcement (user deny > user grant > role deny > role grant)
+    auth = rbac["auth"]
+    auth.create_role("denier")
+    auth.deny("denier", ["MATCH"])
+    auth.set_role("reader", "denier")
+    # user-level GRANT (set in the fixture) beats role-level DENY
+    assert auth.has_privilege("reader", "MATCH")
+    eff = dict(auth.effective_privileges("reader"))
+    assert eff["MATCH"] == "GRANT"
+    # remove the user-level grant: role deny now wins for both views
+    auth.revoke("reader", ["MATCH"])
+    assert not auth.has_privilege("reader", "MATCH")
+    eff = dict(auth.effective_privileges("reader"))
+    assert eff["MATCH"] == "DENY"
