@@ -259,7 +259,8 @@ class SortItem:
 @dataclass
 class ReturnBody:
     distinct: bool
-    items: list[tuple[Expr, Optional[str]]]   # (expr, alias)
+    # (expr, explicit alias | None, verbatim source text | None)
+    items: list[tuple[Expr, Optional[str], Optional[str]]]
     star: bool
     order_by: list[SortItem] = field(default_factory=list)
     skip: Optional[Expr] = None
@@ -286,10 +287,11 @@ class Unwind(Clause):
 @dataclass
 class CallProcedure(Clause):
     name: str
-    args: list[Expr]
+    args: Optional[list[Expr]]   # None = no parens (implicit/param args)
     yields: list[tuple[str, Optional[str]]]   # (field, alias)
     yield_star: bool = False
     where: Optional[Expr] = None
+    yield_dash: bool = False     # CALL proc() YIELD - (explicitly nothing)
 
 
 @dataclass

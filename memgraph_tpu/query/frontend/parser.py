@@ -21,13 +21,16 @@ from .lexer import T, Token, tokenize
 def parse(text: str):
     """Parse one statement (trailing ';' tolerated). Returns an AST root:
     CypherQuery | IndexQuery | ConstraintQuery | InfoQuery | ... """
-    return Parser(tokenize(text)).parse_statement()
+    p = Parser(tokenize(text))
+    p._source = text
+    return p.parse_statement()
 
 
 class Parser:
     def __init__(self, tokens: list[Token]) -> None:
         self.toks = tokens
         self.i = 0
+        self._source: str | None = None  # original text (verbatim columns)
 
     # --- token helpers ------------------------------------------------------
 
@@ -902,11 +905,17 @@ class Parser:
         return A.ReturnBody(distinct, items, star, order_by, skip, limit)
 
     def parse_return_item(self):
+        start = self.cur.pos
         expr = self.parse_expression()
-        alias = None
+        end = self.cur.pos  # first token NOT part of the expression
         if self.accept_kw("AS"):
-            alias = self.name_token()
-        return (expr, alias)
+            return (expr, self.name_token(), None)
+        # unaliased item: the column name is the VERBATIM source text of
+        # the expression, case and spacing included (openCypher TCK
+        # ColumnNameAcceptance "Keeping used expression")
+        verbatim = (self._source[start:end].strip()
+                    if self._source is not None else None)
+        return (expr, None, verbatim)
 
     def parse_sort_item(self) -> A.SortItem:
         expr = self.parse_expression()
@@ -940,8 +949,13 @@ class Parser:
         while self.accept("."):
             parts.append(self.name_token())
         name = ".".join(parts)
-        args: list[A.Expr] = []
+        # args=None (no parens) is distinct from args=[] (empty parens):
+        # standalone CALL without parens takes arguments implicitly from
+        # query parameters; in-query CALL requires explicit parens
+        # (TCK ProcedureCallAcceptance: InvalidArgumentPassingMode)
+        args: Optional[list[A.Expr]] = None
         if self.accept("("):
+            args = []
             if not self.at(")"):
                 args.append(self.parse_expression())
                 while self.accept(","):
@@ -949,17 +963,21 @@ class Parser:
             self.expect(")")
         yields: list[tuple[str, Optional[str]]] = []
         yield_star = False
+        yield_dash = False
         where = None
         if self.accept_kw("YIELD"):
             if self.accept("*"):
                 yield_star = True
+            elif self.accept("-"):
+                yield_dash = True  # explicitly yield nothing
             else:
                 yields.append(self.parse_yield_item())
                 while self.accept(","):
                     yields.append(self.parse_yield_item())
             if self.accept_kw("WHERE"):
                 where = self.parse_expression()
-        return A.CallProcedure(name, args, yields, yield_star, where)
+        return A.CallProcedure(name, args, yields, yield_star, where,
+                               yield_dash)
 
     def parse_yield_item(self):
         field = self.name_token()
@@ -1088,10 +1106,12 @@ class Parser:
             self.expect("]")
         # closing arrow
         if direction == "in":
-            self.expect("-")
-            if self.accept(">"):
-                direction = "both" if False else "both"  # <-[]-> treated as both
+            if self.accept("->"):   # bare '<-->' lexes as '<-' + '->'
                 direction = "both"
+            else:
+                self.expect("-")
+                if self.accept(">"):
+                    direction = "both"  # <-[..]-> treated as undirected
         else:
             if self.accept("->"):
                 direction = "out"
@@ -1490,9 +1510,11 @@ class Parser:
                 raise SyntaxException("not a pattern comprehension")
             except SyntaxException:
                 self.i = save
-        # lookahead: ident IN → comprehension
-        if (self.cur.type in (T.IDENT,) and self.peek().is_kw("IN")):
-            var = self.advance().value
+        # lookahead: name IN → comprehension (the variable may lex as a
+        # KEYWORD, e.g. `[key IN keys(r) | ...]` — KEY is a keyword)
+        if (self.cur.type in (T.IDENT, T.KEYWORD)
+                and self.peek().is_kw("IN")):
+            var = self.name_token()
             self.advance()  # IN
             lst = self.parse_expression()
             where = None

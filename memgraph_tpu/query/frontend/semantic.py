@@ -1,0 +1,88 @@
+"""Compile-time semantic validation shared by the planner.
+
+Counterpart of the reference's symbol generator / semantic checks
+(/root/reference/src/query/frontend/semantic/symbol_generator.cpp):
+unbound-variable detection with correct binder scoping, plus the
+openCypher error classes the TCK exercises (VariableAlreadyBound,
+InvalidArgumentType for IN, aggregation placement, ...).
+"""
+
+from __future__ import annotations
+
+from ...exceptions import SemanticException
+from . import ast as A
+
+
+def check_expr_scope(expr: A.Expr | None, bound: set,
+                     where: str = "expression") -> None:
+    """Raise SemanticException for identifiers not in scope. `bound` is the
+    set of visible variable names; binder expressions (comprehensions,
+    reduce, quantifiers, pattern comprehensions) extend it locally."""
+    if expr is None:
+        return
+    if isinstance(expr, A.Identifier):
+        if expr.name not in bound:
+            raise SemanticException(
+                f"UndefinedVariable: {expr.name} is not defined "
+                f"(in {where})")
+        return
+    if isinstance(expr, A.ListComprehension):
+        check_expr_scope(expr.list_expr, bound, where)
+        inner = bound | {expr.var}
+        check_expr_scope(expr.where, inner, where)
+        check_expr_scope(expr.projection, inner, where)
+        return
+    if isinstance(expr, A.Quantifier):
+        check_expr_scope(expr.list_expr, bound, where)
+        check_expr_scope(expr.where, bound | {expr.var}, where)
+        return
+    if isinstance(expr, A.Reduce):
+        check_expr_scope(expr.init, bound, where)
+        check_expr_scope(expr.list_expr, bound, where)
+        check_expr_scope(expr.expr, bound | {expr.acc, expr.var}, where)
+        return
+    if isinstance(expr, (A.PatternExpr, A.PatternComprehension)):
+        inner = set(bound)
+        if expr.pattern.variable:
+            inner.add(expr.pattern.variable)
+        for item in expr.pattern.elements:   # [Node, Edge, Node, ...]
+            if item.variable:
+                inner.add(item.variable)
+            props = getattr(item, "properties", None)
+            if isinstance(props, dict):
+                for v in props.values():
+                    check_expr_scope(v, bound, where)
+        if isinstance(expr, A.PatternComprehension):
+            check_expr_scope(expr.where, inner, where)
+            check_expr_scope(expr.projection, inner, where)
+        return
+    if isinstance(expr, A.Binary) and expr.op == "IN":
+        # compile-time: IN with a literal non-list RHS
+        # (TCK SemanticErrorAcceptance: InvalidArgumentType)
+        rhs = expr.right
+        if isinstance(rhs, A.Literal) and rhs.value is not None \
+                and not isinstance(rhs.value, (list, tuple)):
+            raise SemanticException(
+                f"InvalidArgumentType: IN expects a list, "
+                f"got {rhs.value!r}")
+    for child in _children(expr):
+        check_expr_scope(child, bound, where)
+
+
+def _children(expr):
+    from ..plan.planner import _children_exprs
+    return _children_exprs(expr)
+
+
+def check_no_aggregates(expr: A.Expr | None, context: str) -> None:
+    """Aggregation functions are invalid in WHERE / pattern properties /
+    procedure args (TCK: InvalidAggregation)."""
+    if expr is None:
+        return
+    from ..plan.planner import collect_aggregations
+    aggs: list = []
+    collect_aggregations(expr, aggs)
+    if aggs:
+        raise SemanticException(
+            f"InvalidAggregation: aggregation functions are not allowed "
+            f"in {context}")

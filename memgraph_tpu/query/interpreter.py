@@ -688,6 +688,14 @@ class Interpreter:
 
         def rows_iter():
             try:
+                if not columns:
+                    # write-only query (no RETURN / YIELD): drain for the
+                    # side effects but emit NO records — the reference
+                    # streams zero records for such queries (EmptyResult
+                    # operator, query/plan/operator.hpp)
+                    for _ in plan.cursor(exec_ctx):
+                        pass
+                    return
                 for frame in plan.cursor(exec_ctx):
                     row = frame.get("__row__", {})
                     yield [row.get(c) for c in columns]

@@ -83,6 +83,25 @@ class Once(LogicalOperator):
 
 
 @dataclass
+class Eager(LogicalOperator):
+    """Pipeline barrier: drain the input fully before yielding anything.
+
+    Gives Cypher its clause-at-a-time visibility semantics — a reading
+    clause must observe the graph state AFTER a preceding updating clause
+    processed every row, and an updating clause must not mutate the graph
+    while an upstream scan is still iterating. The planner inserts this on
+    read->write and write->read clause transitions (reference: Accumulate
+    with advance_command, query/plan/operator.hpp; neo4j's Eager)."""
+    input: LogicalOperator
+
+    def cursor(self, ctx):
+        rows = list(self.input.cursor(ctx))
+        for frame in rows:
+            ctx.check_abort()
+            yield frame
+
+
+@dataclass
 class ScanAll(LogicalOperator):
     input: LogicalOperator
     symbol: str
@@ -1024,7 +1043,8 @@ class Merge(LogicalOperator):
 
 
 AGGREGATE_FUNCTIONS = {"count", "sum", "avg", "min", "max", "collect",
-                       "stdev", "stdevp", "project"}
+                       "stdev", "stdevp", "project",
+                       "percentiledisc", "percentilecont"}
 
 
 @dataclass
@@ -1047,22 +1067,25 @@ class Aggregate(LogicalOperator):
                 state = {
                     "key_vals": key_vals,
                     "frame": {s: frame.get(s) for s in self.remember},
-                    "aggs": [_AggState(kind, distinct)
-                             for kind, _, distinct, _ in self.aggregations],
+                    "aggs": [_AggState(spec[0], spec[2])
+                             for spec in self.aggregations],
                 }
                 groups[key] = state
                 order.append(key)
             state = groups[key]
-            for (kind, expr, distinct, _), agg in zip(self.aggregations,
-                                                      state["aggs"]):
+            for spec, agg in zip(self.aggregations, state["aggs"]):
+                kind, expr = spec[0], spec[1]
+                if len(spec) > 4 and spec[4] is not None:
+                    # extra constant argument (percentileDisc/Cont's p)
+                    agg.param = ctx.evaluator.eval(spec[4], frame)
                 value = (ctx.evaluator.eval(expr, frame)
                          if expr is not None else "__row__")
                 agg.update(value)
         if not groups and not self.group_by:
             # aggregation over empty input yields one row of neutral values
             state = {"key_vals": [], "frame": {},
-                     "aggs": [_AggState(kind, distinct)
-                              for kind, _, distinct, _ in self.aggregations]}
+                     "aggs": [_AggState(spec[0], spec[2])
+                              for spec in self.aggregations]}
             groups[()] = state
             order.append(())
         for key in order:
@@ -1070,14 +1093,14 @@ class Aggregate(LogicalOperator):
             new = dict(state["frame"])
             for (_, name), val in zip(self.group_by, state["key_vals"]):
                 new[name] = val
-            for (_, _, _, name), agg in zip(self.aggregations, state["aggs"]):
-                new[name] = agg.result()
+            for spec, agg in zip(self.aggregations, state["aggs"]):
+                new[spec[3]] = agg.result()
             yield new
 
 
 class _AggState:
     __slots__ = ("kind", "distinct", "seen", "count", "total", "minv",
-                 "maxv", "items", "m2", "mean")
+                 "maxv", "items", "m2", "mean", "param")
 
     def __init__(self, kind, distinct):
         self.kind = kind
@@ -1090,6 +1113,7 @@ class _AggState:
         self.items = []
         self.mean = 0.0
         self.m2 = 0.0
+        self.param = None    # percentileDisc/Cont's p argument
 
     def update(self, value):
         kind = self.kind
@@ -1107,6 +1131,11 @@ class _AggState:
         if kind == "count":
             return
         if kind == "collect":
+            self.items.append(value)
+            return
+        if kind in ("percentiledisc", "percentilecont"):
+            if not V.is_numeric(value):
+                raise TypeException(f"{kind}() requires numeric input")
             self.items.append(value)
             return
         if kind == "project":
@@ -1163,6 +1192,28 @@ class _AggState:
             if not self.count:
                 return None
             return (self.m2 / self.count) ** 0.5
+        if kind in ("percentiledisc", "percentilecont"):
+            if not self.items:
+                return None  # aggregation over zero rows yields null
+            p = self.param
+            if not V.is_numeric(p) or not (0.0 <= p <= 1.0):
+                raise QueryException(
+                    f"NumberOutOfRange: {kind}() percentile must be in "
+                    f"[0, 1], got {p!r}")
+            xs = sorted(self.items)
+            if kind == "percentiledisc":
+                # smallest value with cumulative frequency >= p
+                import math
+                idx = max(0, math.ceil(p * len(xs)) - 1)
+                return xs[idx]
+            if len(xs) == 1:
+                return float(xs[0])
+            pos = p * (len(xs) - 1)
+            lo = int(pos)
+            frac = pos - lo
+            if lo + 1 >= len(xs):
+                return float(xs[-1])
+            return xs[lo] + (xs[lo + 1] - xs[lo]) * frac
         raise SemanticException(f"unknown aggregate {kind}")
 
 
@@ -1267,9 +1318,24 @@ class CallProcedureOp(LogicalOperator):
         proc = global_registry.find(self.proc_name)
         if proc is None:
             raise SemanticException(f"unknown procedure: {self.proc_name}")
+        from .planner import _literal_matches_type
         for frame in self.input.cursor(ctx):
             ctx.check_abort()
             args = [ctx.evaluator.eval(e, frame) for e in self.args]
+            for value, (aname, atype) in zip(args, proc.args):
+                if not _literal_matches_type(value, atype):
+                    raise TypeException(
+                        f"procedure {self.proc_name} argument {aname!r} "
+                        f"expects {atype}, got {value!r}")
+            if not proc.results:
+                # VOID procedure: run for its effects, pass the row through
+                # (TCK: "In-query call to VOID procedure does not consume
+                # rows"); a ':: ()' procedure instead yields nothing
+                for _ in proc.call(ctx, args):
+                    pass
+                if getattr(proc, "void", False):
+                    yield dict(frame)
+                continue
             for record in proc.call(ctx, args):
                 new = dict(frame)
                 for fieldname, sym in zip(self.result_fields,

@@ -15,6 +15,8 @@ from typing import Optional
 
 from ...exceptions import SemanticException
 from ..frontend import ast as A
+from ..frontend.semantic import (check_expr_scope,
+                                  check_no_aggregates)
 from . import operators as Op
 
 _ANON = itertools.count()
@@ -96,6 +98,10 @@ class Planner:
 
     def plan_query(self, query: A.CypherQuery):
         plan, columns = self.plan_single(query.query)
+        if query.unions and len({ua for ua, _ in query.unions}) > 1:
+            raise SemanticException(
+                "InvalidClauseComposition: mixing UNION and UNION ALL "
+                "in one query is not allowed")
         for union_all, sub in query.unions:
             sub_plan, sub_cols = self.plan_single(sub)
             if [c for c in sub_cols] != [c for c in columns]:
@@ -113,7 +119,36 @@ class Planner:
         has_update = False
         produced = False
 
+        # clause-at-a-time visibility: a reading clause after an updating
+        # one (and vice versa) gets an Eager barrier so scans never
+        # interleave with mutations (TCK CreateAcceptance "Combine MATCH,
+        # WITH and CREATE"; reference: Accumulate + advance_command)
+        read_seen = False
+        write_seen = False
+        _READING = (A.Match,)
+        _WRITING = (A.Create, A.Merge, A.SetClause, A.Remove, A.Delete,
+                    A.Foreach)
+        kinds: dict[str, str] = {}   # variable -> node|edge|path|value
+        prev_optional = False
+
         for ci, clause in enumerate(clauses):
+            if isinstance(clause, A.Match):
+                if prev_optional and not clause.optional:
+                    raise SemanticException(
+                        "InvalidClauseComposition: MATCH cannot follow "
+                        "OPTIONAL MATCH (use a WITH between them)")
+                prev_optional = clause.optional
+                self._validate_match(clause, bound, kinds)
+            if isinstance(clause, _READING) and write_seen:
+                plan = Op.Eager(plan)
+                write_seen = False  # barrier absorbs prior writes
+            elif isinstance(clause, _WRITING) and read_seen:
+                plan = Op.Eager(plan)
+                read_seen = False   # consecutive writes share one barrier
+            if isinstance(clause, _READING):
+                read_seen = True
+            if isinstance(clause, _WRITING):
+                write_seen = True
             if isinstance(clause, A.Match):
                 plan = self.plan_match(clause, plan, bound)
             elif isinstance(clause, A.Create):
@@ -124,14 +159,32 @@ class Planner:
                 plan = self.plan_merge(clause, plan, bound)
             elif isinstance(clause, A.SetClause):
                 has_update = True
+                for item in clause.items:
+                    check_expr_scope(item.target, bound, "SET")
+                    if isinstance(item.value, A.Expr):
+                        check_expr_scope(item.value, bound, "SET")
                 plan = self.plan_set_items(clause.items, plan, bound)
             elif isinstance(clause, A.Remove):
                 has_update = True
+                for item in clause.items:
+                    check_expr_scope(item.target, bound, "REMOVE")
                 plan = self.plan_remove(clause, plan)
             elif isinstance(clause, A.Delete):
                 has_update = True
+                for expr in clause.exprs:
+                    if isinstance(expr, A.LabelsTest):
+                        raise SemanticException(
+                            "InvalidDelete: DELETE takes an entity, not a "
+                            "label expression — use REMOVE for labels")
+                    if isinstance(expr, (A.Literal, A.Binary, A.Unary,
+                                         A.MapLiteral)):
+                        raise SemanticException(
+                            "InvalidArgumentType: DELETE requires a node, "
+                            "relationship or path expression")
+                    check_expr_scope(expr, bound, "DELETE")
                 plan = Op.Delete(plan, clause.exprs, clause.detach)
             elif isinstance(clause, A.Unwind):
+                check_expr_scope(clause.expr, bound, "UNWIND")
                 plan = Op.Unwind(plan, clause.expr, clause.variable)
                 bound.add(clause.variable)
             elif isinstance(clause, A.CallSubquery):
@@ -143,21 +196,58 @@ class Planner:
                                 clause.batch_rows)
                 bound.update(sub_cols)
             elif isinstance(clause, A.CallProcedure):
-                plan = self.plan_call(clause, plan, bound)
-                if ci == len(clauses) - 1 and (clause.yields
-                                               or clause.yield_star):
-                    # standalone CALL ... YIELD: surface yielded columns
+                standalone = len(clauses) == 1
+                plan = self.plan_call(clause, plan, bound,
+                                      standalone=standalone)
+                if ci == len(clauses) - 1 and not clause.yield_dash and (
+                        clause.yields or clause.yield_star or standalone):
+                    # terminal CALL: surface the yielded columns (standalone
+                    # CALL without YIELD surfaces every result field —
+                    # TCK ProcedureCallAcceptance "Standalone call ...")
                     names = [a or f for f, a in clause.yields] \
                         if clause.yields else self._call_fields(clause)
                     items = [(A.Identifier(n), n) for n in names]
-                    plan = Op.Produce(plan, items)
-                    columns = names
+                    if names:
+                        plan = Op.Produce(plan, items)
+                        columns = names
                     produced = True
             elif isinstance(clause, A.With):
                 plan, columns = self.plan_projection(
                     clause.body, plan, bound, has_update, is_with=True,
                     where=clause.where)
                 has_update = False
+                prev_optional = False
+                # propagate variable kinds through the projection: a
+                # passed-through identifier keeps its kind, any computed
+                # expression becomes a plain value (so `WITH [n] AS users
+                # MATCH (users)` is a VariableTypeConflict)
+                new_kinds: dict[str, str] = {}
+                for expr, alias, _verbatim in clause.body.items:
+                    name = alias or (_verbatim if _verbatim
+                                     else _expr_name(expr))
+                    if isinstance(expr, A.Identifier):
+                        k = kinds.get(expr.name)
+                        if k:
+                            new_kinds[name] = k
+                    elif isinstance(expr, (A.ListLiteral, A.MapLiteral,
+                                           A.ListComprehension,
+                                           A.PatternComprehension)) or (
+                            isinstance(expr, A.Literal)
+                            and expr.value is not None) or (
+                            isinstance(expr, A.FunctionCall)
+                            and expr.name in ("collect", "count", "sum",
+                                              "avg", "stdev", "stdevp",
+                                              "percentiledisc",
+                                              "percentilecont")):
+                        # statically KNOWN not to be a graph entity; other
+                        # expressions (coalesce, null, head, ...) stay
+                        # unknown so they may legally appear in patterns
+                        new_kinds[name] = "value"
+                if clause.body.star:
+                    for sym in columns:
+                        if sym in kinds and sym not in new_kinds:
+                            new_kinds[sym] = kinds[sym]
+                kinds = new_kinds
                 bound = set(columns)
             elif isinstance(clause, A.Return):
                 plan, columns = self.plan_projection(
@@ -184,6 +274,10 @@ class Planner:
         if not produced and not has_update and not any(
                 isinstance(c, A.CallProcedure) for c in clauses):
             raise SemanticException("query must end with RETURN or an update")
+        if not produced:
+            # write-only query: WITH projections along the way must not
+            # leak as result columns — such queries stream zero records
+            columns = []
         return plan, columns
 
     def _call_fields(self, clause: A.CallProcedure) -> list[str]:
@@ -194,6 +288,74 @@ class Planner:
         return [f for f, _ in proc.results]
 
     # --- MATCH --------------------------------------------------------------
+
+    def _validate_match(self, match: A.Match, bound: set,
+                        kinds: dict) -> None:
+        """Compile-time MATCH validity (TCK SemanticErrorAcceptance /
+        MiscellaneousErrorAcceptance): variable kind conflicts, relationship
+        uniqueness within a clause, parameter property maps, WHERE scope."""
+        clause_vars: set = set()
+        clause_edge_vars: set = set()
+        for pattern in match.patterns:
+            if pattern.variable:
+                if pattern.variable in bound or pattern.variable \
+                        in clause_vars:
+                    raise SemanticException(
+                        f"VariableAlreadyBound: path variable "
+                        f"{pattern.variable} cannot be rebound")
+                kinds[pattern.variable] = "path"
+                clause_vars.add(pattern.variable)
+            nodes = pattern.elements[0::2]
+            edges = pattern.elements[1::2]
+            for node in nodes:
+                v = node.variable
+                if v:
+                    if kinds.get(v) in ("edge", "path", "value"):
+                        raise SemanticException(
+                            f"VariableTypeConflict: {v} is a "
+                            f"{kinds[v]}, used here as a node")
+                    kinds.setdefault(v, "node")
+                    clause_vars.add(v)
+                if isinstance(node.properties, A.Parameter):
+                    raise SemanticException(
+                        "InvalidParameterUse: a parameter property map "
+                        "is not allowed in MATCH")
+            for edge in edges:
+                v = edge.variable
+                if v:
+                    if v in clause_edge_vars:
+                        raise SemanticException(
+                            f"RelationshipUniquenessViolation: "
+                            f"relationship variable {v} is used more than "
+                            f"once in this MATCH")
+                    # a var-length slot legally binds a LIST of
+                    # relationships (`MATCH ()-[rs*]->()` with rs
+                    # projected from collect/[r1, r2]) — only fixed-length
+                    # slots conflict with non-edge kinds
+                    if not edge.var_length and \
+                            kinds.get(v) in ("node", "path", "value"):
+                        raise SemanticException(
+                            f"VariableTypeConflict: {v} is a "
+                            f"{kinds[v]}, used here as a relationship")
+                    if not edge.var_length:
+                        kinds.setdefault(v, "edge")
+                    clause_edge_vars.add(v)
+                    clause_vars.add(v)
+                if isinstance(edge.properties, A.Parameter):
+                    raise SemanticException(
+                        "InvalidParameterUse: a parameter property map "
+                        "is not allowed in MATCH")
+        scope = bound | clause_vars
+        for pattern in match.patterns:
+            for item in pattern.elements:
+                props = getattr(item, "properties", None)
+                if isinstance(props, dict):
+                    for p in props.values():
+                        check_expr_scope(p, scope, "pattern properties")
+                        check_no_aggregates(p, "pattern properties")
+        if match.where is not None:
+            check_expr_scope(match.where, scope, "WHERE")
+            check_no_aggregates(match.where, "WHERE")
 
     def plan_match(self, match: A.Match, plan, bound: set):
         where_parts = _split_and(match.where)
@@ -518,7 +680,57 @@ class Planner:
 
     # --- CREATE / MERGE -----------------------------------------------------
 
+    def _validate_create_pattern(self, pattern: A.Pattern, bound: set,
+                                 new_in_clause: set, what: str = "CREATE"):
+        """openCypher CREATE/MERGE validity (TCK SemanticErrorAcceptance):
+        a bound variable may be reused only as a bare path endpoint — any
+        labels or properties on it are VariableAlreadyBound; var-length
+        edges cannot be created; whole-pattern property scope is checked
+        by the caller."""
+        elements = pattern.elements
+        nodes = elements[0::2]
+        edges = elements[1::2]
+        # property expressions may reference vars from earlier patterns of
+        # the same clause: CREATE (a {v: 1}), (b {v: a.v})
+        clause_vars = {n.variable for n in nodes if n.variable} \
+            | {e.variable for e in edges if e.variable} | new_in_clause
+        seen = set(new_in_clause)
+        for node in nodes:
+            v = node.variable
+            if v and (v in bound or v in seen) \
+                    and (node.labels or node.properties):
+                raise SemanticException(
+                    f"VariableAlreadyBound: {v} is already declared — "
+                    f"{what} may reuse it only as a bare endpoint")
+            if what == "CREATE" and len(elements) == 1 and v and v in bound:
+                raise SemanticException(
+                    f"VariableAlreadyBound: {what} ({v}) — the variable "
+                    f"is already declared")
+            if v:
+                seen.add(v)
+            props = node.properties
+            if isinstance(props, dict):
+                for p in props.values():
+                    check_expr_scope(p, bound | clause_vars, what)
+        for edge in edges:
+            if edge.var_length:
+                raise SemanticException(
+                    f"CreatingVarLength: variable-length relationships "
+                    f"cannot be used in {what}")
+            v = edge.variable
+            if v and (v in bound or v in seen):
+                raise SemanticException(
+                    f"VariableAlreadyBound: relationship variable {v} is "
+                    f"already declared")
+            if isinstance(edge.properties, dict):
+                for p in edge.properties.values():
+                    check_expr_scope(p, bound | clause_vars, what)
+        new_in_clause.update(clause_vars)
+
     def plan_create(self, create: A.Create, plan, bound: set):
+        new_in_clause: set = set()
+        for pattern in create.patterns:
+            self._validate_create_pattern(pattern, bound, new_in_clause)
         for pattern in create.patterns:
             plan = self._plan_create_pattern(pattern, plan, bound)
         return plan
@@ -564,6 +776,7 @@ class Planner:
 
     def plan_merge(self, merge: A.Merge, plan, bound: set):
         pattern = merge.pattern
+        self._validate_create_pattern(pattern, bound, set(), what="MERGE")
         # match side
         match_bound = set(bound)
         match_plan = self.plan_pattern(pattern, Op.Argument(), match_bound,
@@ -634,19 +847,66 @@ class Planner:
 
     # --- CALL ---------------------------------------------------------------
 
-    def plan_call(self, clause: A.CallProcedure, plan, bound: set):
+    def plan_call(self, clause: A.CallProcedure, plan, bound: set,
+                  standalone: bool = False):
         from ..procedures.registry import global_registry
         proc = global_registry.find(clause.name)
         if proc is None:
             raise SemanticException(f"unknown procedure: {clause.name}")
-        if clause.yield_star or (not clause.yields):
-            fields = [f for f, _ in proc.results]
-            yields = [(f, None) for f in fields]
+        args = clause.args
+        if args is None:
+            # no parens: standalone CALL binds declared args from query
+            # parameters by name; in-query CALL must pass them explicitly
+            # (reference: InvalidArgumentPassingMode)
+            if proc.args and not standalone:
+                raise SemanticException(
+                    f"in-query CALL to {clause.name} requires explicit "
+                    f"arguments — implicit (parameter) passing is only "
+                    f"allowed for standalone CALL")
+            args = [A.Parameter(name) for name, _ in proc.args]
         else:
+            n_req, n_max = len(proc.args), len(proc.args) + len(proc.opt_args)
+            if not (n_req <= len(args) <= n_max):
+                raise SemanticException(
+                    f"procedure {clause.name} expects "
+                    f"{n_req if n_req == n_max else f'{n_req}..{n_max}'} "
+                    f"arguments, got {len(args)}")
+            for expr, (aname, atype) in zip(args, proc.args):
+                if isinstance(expr, A.Literal) and not _literal_matches_type(
+                        expr.value, atype):
+                    raise SemanticException(
+                        f"procedure {clause.name} argument {aname!r} "
+                        f"expects {atype}, got literal {expr.value!r}")
+        for expr in args:
+            aggs: list = []
+            collect_aggregations(expr, aggs)
+            if aggs:
+                raise SemanticException(
+                    f"CALL {clause.name}: aggregation functions are not "
+                    f"allowed in procedure arguments")
+        known_fields = {f for f, _ in proc.results}
+        if clause.yields:
+            for f, _ in clause.yields:
+                if f not in known_fields:
+                    raise SemanticException(
+                        f"procedure {clause.name} does not yield {f!r}")
             yields = clause.yields
+        elif clause.yield_dash:
+            yields = []
+        else:
+            if not standalone and proc.results:
+                raise SemanticException(
+                    f"in-query CALL to {clause.name} must YIELD its output "
+                    f"(or YIELD - to discard it)")
+            yields = [(f, None) for f, _ in proc.results]
         result_fields = [f for f, _ in yields]
         output_symbols = [a or f for f, a in yields]
-        plan = Op.CallProcedureOp(plan, clause.name, clause.args,
+        for sym in output_symbols:
+            if sym in bound:
+                raise SemanticException(
+                    f"variable {sym!r} is already bound — YIELD must not "
+                    f"shadow an existing variable")
+        plan = Op.CallProcedureOp(plan, clause.name, args,
                                   result_fields, output_symbols)
         bound.update(output_symbols)
         if clause.where is not None:
@@ -660,11 +920,23 @@ class Planner:
                         where: Optional[A.Expr] = None):
         items: list[tuple[A.Expr, str]] = []
         if body.star:
-            for sym in sorted(s for s in bound if not s.startswith("__")):
+            visible = [s for s in bound if not s.startswith("__")]
+            if not visible and not body.items and not is_with:
+                raise SemanticException(
+                    "NoVariablesInScope: RETURN * with no variables in "
+                    "scope")
+            for sym in sorted(visible):
                 items.append((A.Identifier(sym), sym))
-        for expr, alias in body.items:
-            name = alias or _expr_name(expr)
+        for expr, alias, verbatim in body.items:
+            if is_with and alias is None and not isinstance(expr,
+                                                            A.Identifier):
+                raise SemanticException(
+                    "NoExpressionAlias: expressions in WITH must be "
+                    "aliased (use AS)")
+            name = alias or verbatim or _expr_name(expr)
             items.append((expr, name))
+        for expr, _ in items:
+            check_expr_scope(expr, bound, "projection")
         columns = [name for _, name in items]
         if len(set(columns)) != len(columns):
             raise SemanticException("duplicate column names in projection")
@@ -688,7 +960,8 @@ class Planner:
                     group_items.append((expr, name))
                     rewritten.append((A.Identifier(name), name))
                 else:
-                    new_expr = self._rewrite_aggs(expr, agg_specs)
+                    new_expr = self._rewrite_aggs(expr, agg_specs,
+                                                  group_items)
                     rewritten.append((new_expr, name))
             final_items = rewritten
         if has_update:
@@ -708,18 +981,54 @@ class Planner:
         if body.distinct:
             plan = Op.Distinct(plan, columns)
         if body.order_by:
+            # scope: projected columns, plus the pre-projection variables
+            # unless DISTINCT/aggregation made them unavailable
+            # (TCK ReturnAcceptance: "ORDER BY of a column introduced in
+            # RETURN" vs UndefinedVariable after DISTINCT)
             # ORDER BY may reference projection/grouping expressions that no
             # longer exist as symbols post-aggregation: rewrite any sort
-            # expression structurally equal to a projected item to its
+            # subexpression structurally equal to a projected item to its
             # column name (dataclass equality compares AST structure)
             def rewrite_sort(expr):
                 for item_expr, name in items:
                     if expr == item_expr:
                         return A.Identifier(name)
-                return expr
+                import copy
+                clone = copy.copy(expr)
+                if isinstance(expr, A.Unary):
+                    clone.expr = rewrite_sort(expr.expr)
+                elif isinstance(expr, A.Binary):
+                    clone.left = rewrite_sort(expr.left)
+                    clone.right = rewrite_sort(expr.right)
+                elif isinstance(expr, A.PropertyLookup):
+                    clone.expr = rewrite_sort(expr.expr)
+                elif isinstance(expr, A.FunctionCall):
+                    clone.args = [rewrite_sort(a) for a in expr.args]
+                elif isinstance(expr, A.ListLiteral):
+                    clone.items = [rewrite_sort(a) for a in expr.items]
+                elif isinstance(expr, A.MapLiteral):
+                    clone.items = {k: rewrite_sort(v)
+                                   for k, v in expr.items.items()}
+                return clone
 
-            plan = Op.OrderBy(plan, [(rewrite_sort(s.expr), s.ascending)
-                                     for s in body.order_by])
+            sort_items = [(rewrite_sort(s.expr), s.ascending)
+                          for s in body.order_by]
+            # scope: projected columns, plus the pre-projection variables
+            # unless DISTINCT/aggregation consumed them (TCK: ORDER BY
+            # a.age after RETURN DISTINCT a.name is UndefinedVariable)
+            sort_scope = set(columns)
+            if not body.distinct and not any_agg:
+                sort_scope |= bound
+            for (sexpr, _), s in zip(sort_items, body.order_by):
+                if not any_agg:
+                    aggs = []
+                    collect_aggregations(s.expr, aggs)
+                    if aggs:
+                        raise SemanticException(
+                            "InvalidAggregation: aggregation in ORDER BY "
+                            "requires an aggregating projection")
+                check_expr_scope(sexpr, sort_scope, "ORDER BY")
+            plan = Op.OrderBy(plan, sort_items)
         if body.skip is not None:
             plan = Op.Skip(plan, body.skip)
         if body.limit is not None:
@@ -728,7 +1037,8 @@ class Planner:
             plan = Op.Filter(plan, where)
         return plan, columns
 
-    def _rewrite_aggs(self, expr: A.Expr, agg_specs: list) -> A.Expr:
+    def _rewrite_aggs(self, expr: A.Expr, agg_specs: list,
+                      group_items: list | None = None) -> A.Expr:
         if isinstance(expr, A.CountStar):
             name = _anon("agg")
             agg_specs.append(("count", None, False, name))
@@ -737,27 +1047,82 @@ class Planner:
                 expr.name in Op.AGGREGATE_FUNCTIONS:
             name = _anon("agg")
             arg = expr.args[0] if expr.args else None
-            agg_specs.append((expr.name, arg, expr.distinct, name))
+            if len(expr.args) > 1:
+                # e.g. percentileDisc(x, p): extra args ride in slot 4
+                agg_specs.append((expr.name, arg, expr.distinct, name,
+                                  expr.args[1]))
+            else:
+                agg_specs.append((expr.name, arg, expr.distinct, name))
+            return A.Identifier(name)
+        if group_items is not None and isinstance(
+                expr, (A.Identifier, A.PropertyLookup)):
+            # a non-aggregate variable reference inside an aggregating
+            # item becomes an implicit grouping key (`RETURN {foo: a.name,
+            # kids: collect(...)}` groups by a.name — TCK
+            # AggregationAcceptance "aggregates inside non-aggregate
+            # expressions")
+            for g_expr, g_name in group_items:
+                if g_expr == expr:
+                    return A.Identifier(g_name)
+            name = _anon("group")
+            group_items.append((expr, name))
             return A.Identifier(name)
         # rebuild children
         import copy
         clone = copy.copy(expr)
         if isinstance(expr, A.Unary):
-            clone.expr = self._rewrite_aggs(expr.expr, agg_specs)
+            clone.expr = self._rewrite_aggs(expr.expr, agg_specs,
+                                            group_items)
         elif isinstance(expr, A.Binary):
-            clone.left = self._rewrite_aggs(expr.left, agg_specs)
-            clone.right = self._rewrite_aggs(expr.right, agg_specs)
+            clone.left = self._rewrite_aggs(expr.left, agg_specs,
+                                            group_items)
+            clone.right = self._rewrite_aggs(expr.right, agg_specs,
+                                             group_items)
         elif isinstance(expr, A.FunctionCall):
-            clone.args = [self._rewrite_aggs(a, agg_specs) for a in expr.args]
+            clone.args = [self._rewrite_aggs(a, agg_specs, group_items)
+                          for a in expr.args]
         elif isinstance(expr, A.PropertyLookup):
-            clone.expr = self._rewrite_aggs(expr.expr, agg_specs)
+            clone.expr = self._rewrite_aggs(expr.expr, agg_specs,
+                                            group_items)
         elif isinstance(expr, A.ListLiteral):
-            clone.items = [self._rewrite_aggs(a, agg_specs)
+            clone.items = [self._rewrite_aggs(a, agg_specs, group_items)
                            for a in expr.items]
         elif isinstance(expr, A.MapLiteral):
-            clone.items = {k: self._rewrite_aggs(v, agg_specs)
+            clone.items = {k: self._rewrite_aggs(v, agg_specs, group_items)
                            for k, v in expr.items.items()}
         return clone
+
+
+def _literal_matches_type(value, type_decl: str) -> bool:
+    """Compile-time literal-vs-declared-type check for procedure args.
+
+    Type syntax follows the reference's mgp type names (mg_procedure.h
+    mgp_type): INTEGER, FLOAT, NUMBER, STRING, BOOLEAN, MAP, LIST OF T,
+    ANY, NODE, RELATIONSHIP, PATH; a '?' suffix means nullable.
+    """
+    t = type_decl.strip().upper()
+    nullable = t.endswith("?")
+    if nullable:
+        t = t[:-1]
+    if value is None:
+        return nullable
+    if t.startswith("LIST"):
+        return isinstance(value, (list, tuple))
+    def _numeric(v):
+        # INTEGER/FLOAT/NUMBER coerce freely between int and float
+        # (TCK: "argument of type INTEGER accepts value of type FLOAT")
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    checkers = {
+        "INTEGER": _numeric,
+        "FLOAT": _numeric,
+        "NUMBER": _numeric,
+        "STRING": lambda v: isinstance(v, str),
+        "BOOLEAN": lambda v: isinstance(v, bool),
+        "MAP": lambda v: isinstance(v, dict),
+    }
+    check = checkers.get(t)
+    return True if check is None else check(value)
 
 
 def _single_has_update(single: A.SingleQuery) -> bool:

@@ -27,6 +27,10 @@ class Procedure:
     opt_args: list[tuple[str, str, object]]
     results: list[tuple[str, str]]         # (field, type hint)
     is_write: bool = False
+    # VOID procs run for their side effects and pass the input row through;
+    # a proc declared ':: ()' instead yields an empty record stream
+    # (openCypher TCK distinction, ProcedureCallAcceptance)
+    void: bool = False
 
     def call(self, exec_ctx, args: list) -> Iterable[dict]:
         pctx = ProcedureContext(exec_ctx)
@@ -79,6 +83,10 @@ class ProcedureRegistry:
     def register(self, proc: Procedure) -> None:
         with self._lock:
             self._procedures[proc.name.lower()] = proc
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._procedures.pop(name.lower(), None)
 
     def find(self, name: str) -> Optional[Procedure]:
         self._ensure_builtin()

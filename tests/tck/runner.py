@@ -54,13 +54,10 @@ class Scenario:
 def parse_feature(text: str, feature_name: str) -> list[Scenario]:
     lines = text.split("\n")
     scenarios: list[Scenario] = []
+    background: list[Step] = []
     cur: Scenario | None = None
     outline: Scenario | None = None
-    examples_header: list[str] | None = None
     i = 0
-
-    def strip_comment(ln: str) -> str:
-        return ln
 
     while i < len(lines):
         line = lines[i].strip()
@@ -70,9 +67,19 @@ def parse_feature(text: str, feature_name: str) -> list[Scenario]:
         if line.startswith("Feature:"):
             i += 1
             continue
+        if line.startswith("Background:"):
+            # its steps run before EVERY scenario of the feature; collect
+            # them into a pseudo-scenario and prepend on finalize
+            cur = Scenario(feature_name, "__background__")
+            outline = None
+            i += 1
+            continue
         m = re.match(r"(Scenario Outline|Scenario):\s*(.*)", line)
         if m:
-            cur = Scenario(feature_name, m.group(2).strip())
+            if cur is not None and cur.name == "__background__":
+                background = cur.steps
+            cur = Scenario(feature_name, m.group(2).strip(),
+                           steps=list(background))
             if m.group(1) == "Scenario Outline":
                 outline = cur
             else:
@@ -480,6 +487,7 @@ class ScenarioRunner:
         self.error: Exception | None = None
         self.snapshot_before: tuple | None = None
         self.executed_query = False
+        self._registered_procs: list[str] = []
 
     # --- graph state snapshot for side-effect accounting -------------------
 
@@ -553,6 +561,10 @@ class ScenarioRunner:
         t = step.text
         if t.startswith("an empty graph") or t.startswith("any graph"):
             return
+        if t.startswith("there exists a procedure"):
+            self._register_procedure(t[len("there exists a procedure"):],
+                                     step.table or [])
+            return
         m = re.match(r"the (.+) graph$", t)
         if m:
             path = os.path.join(GRAPH_DIR, m.group(1) + ".cypher")
@@ -566,7 +578,10 @@ class ScenarioRunner:
                 self.interp.execute(q)
             return
         if t.startswith("parameters are"):
-            for k, v in step.table:
+            rows = step.table
+            if rows and rows[0] == ["par", "val"]:  # optional header row
+                rows = rows[1:]
+            for k, v in rows:
                 self.params[k] = _tck_to_python(parse_tck_value(v))
             return
         if t.startswith("executing query") \
@@ -662,9 +677,58 @@ class ScenarioRunner:
                         f"expected row {e_row!r} not found in "
                         f"{remaining!r}")
 
+    def _register_procedure(self, signature: str, table: list[list[str]]):
+        """TCK step: 'there exists a procedure <sig>:' with a data table.
+        The table's columns are the input args followed by the result
+        fields; calling the procedure yields the rows whose arg columns
+        match the call arguments."""
+        from memgraph_tpu.query.procedures.registry import (Procedure,
+                                                            global_registry)
+        sig = signature.strip().rstrip(":").strip()
+        m = re.match(r"([\w.]+)\s*\((.*?)\)\s*::\s*(.*)$", sig)
+        if not m:
+            raise ScenarioFailure(f"unparseable procedure signature {sig!r}")
+        name, args_s, results_s = m.groups()
+        args = []
+        for part in filter(None, (p.strip() for p in args_s.split(","))):
+            aname, _, atype = part.partition("::")
+            args.append((aname.strip(), atype.strip()))
+        results = []
+        results_s = results_s.strip()
+        if results_s not in ("VOID", "()"):
+            inner = results_s.strip("()")
+            for part in filter(None, (p.strip() for p in inner.split(","))):
+                rname, _, rtype = part.partition("::")
+                results.append((rname.strip(), rtype.strip()))
+        header = table[0] if table and any(table[0]) else \
+            [a for a, _ in args] + [r for r, _ in results]
+        data = [[_tck_to_python(parse_tck_value(c)) for c in row]
+                for row in table[1:]]
+        n_args = len(args)
+
+        def func(pctx, *call_args):
+            for row in data:
+                if list(row[:n_args]) == list(call_args):
+                    yield {header[n_args + i]: v
+                           for i, v in enumerate(row[n_args:])}
+
+        global_registry.register(Procedure(
+            name=name, func=func, args=args, opt_args=[], results=results,
+            void=(results_s == "VOID")))
+        self._registered_procs.append(name)
+
+    def cleanup(self):
+        from memgraph_tpu.query.procedures.registry import global_registry
+        for name in self._registered_procs:
+            global_registry.unregister(name)
+        self._registered_procs = []
+
     def run(self, scenario: Scenario):
-        for step in scenario.steps:
-            self.run_step(step)
+        try:
+            for step in scenario.steps:
+                self.run_step(step)
+        finally:
+            self.cleanup()
 
 
 def _row_equal(e_row, a_row) -> bool:
