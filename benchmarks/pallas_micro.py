@@ -1,0 +1,172 @@
+"""Microbenchmarks for the Pallas PageRank kernel design (round 2).
+
+Measures, on the real chip:
+  1. dynamic_gather axis=0 (cross-sublane, per-lane column gather) on tall
+     (R,128) operands — the core primitive of the fused kernel design.
+  2. dynamic_gather axis=1 (per-sublane lane gather).
+  3. Streaming bandwidth of a simple pallas grid kernel (HBM->VMEM->HBM).
+  4. In-loop iteration cost (lax.fori_loop around a pallas_call vs grid).
+
+Run: python benchmarks/pallas_micro.py [cpu]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if len(sys.argv) > 1 and sys.argv[1] == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INTERPRET = jax.devices()[0].platform == "cpu"
+
+
+def timeit(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n, out
+
+
+def bench_col_gather(R):
+    """out[s,l] = table[idx[s,l], l] via take_along_axis axis=0."""
+    def kernel(tab_ref, idx_ref, out_ref):
+        out_ref[:] = jnp.take_along_axis(
+            tab_ref[:], idx_ref[:], axis=0, mode="promise_in_bounds")
+
+    @jax.jit
+    def run(tab, idx):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=INTERPRET,
+        )(tab, idx)
+
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.random((R, 128), dtype=np.float32))
+    idx = jnp.asarray(rng.integers(0, R, (R, 128)), dtype=jnp.int32)
+    try:
+        dt, out = timeit(run, tab, idx)
+    except Exception as e:  # noqa: BLE001
+        print(f"  col_gather R={R}: FAILED {type(e).__name__}: {str(e)[:200]}")
+        return
+    # correctness
+    ref = np.take_along_axis(np.asarray(tab), np.asarray(idx), axis=0)
+    ok = np.allclose(np.asarray(out), ref)
+    n_elem = R * 128
+    print(f"  col_gather R={R}: {dt*1e6:9.1f} us  {n_elem/dt/1e9:8.2f} Gelem/s  ok={ok}")
+
+
+def bench_lane_gather(R):
+    """out[s,l] = table[s, idx[s,l]] via take_along_axis axis=1."""
+    def kernel(tab_ref, idx_ref, out_ref):
+        out_ref[:] = jnp.take_along_axis(
+            tab_ref[:], idx_ref[:], axis=1, mode="promise_in_bounds")
+
+    @jax.jit
+    def run(tab, idx):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=INTERPRET,
+        )(tab, idx)
+
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.random((R, 128), dtype=np.float32))
+    idx = jnp.asarray(rng.integers(0, 128, (R, 128)), dtype=jnp.int32)
+    try:
+        dt, out = timeit(run, tab, idx)
+    except Exception as e:  # noqa: BLE001
+        print(f"  lane_gather R={R}: FAILED {type(e).__name__}: {str(e)[:200]}")
+        return
+    ref = np.take_along_axis(np.asarray(tab), np.asarray(idx), axis=1)
+    ok = np.allclose(np.asarray(out), ref)
+    n_elem = R * 128
+    print(f"  lane_gather R={R}: {dt*1e6:9.1f} us  {n_elem/dt/1e9:8.2f} Gelem/s  ok={ok}")
+
+
+def bench_stream(MB):
+    """x*2+1 over a big array, blocked grid: streaming bandwidth."""
+    R = MB * 1024 * 1024 // (128 * 4)
+    TILE = 2048
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0 + 1.0
+
+    @jax.jit
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, 128), jnp.float32),
+            grid=(R // TILE,),
+            in_specs=[pl.BlockSpec((TILE, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((TILE, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=INTERPRET,
+        )(x)
+
+    x = jnp.ones((R, 128), jnp.float32)
+    dt, _ = timeit(run, x)
+    nbytes = R * 128 * 4 * 2  # read + write
+    print(f"  stream {MB}MB: {dt*1e3:8.2f} ms  {nbytes/dt/1e9:8.1f} GB/s")
+
+
+def bench_gather_loop(R, iters=50):
+    """50 chained col-gathers inside ONE jit dispatch (iteration-loop shape)."""
+    def kernel(tab_ref, idx_ref, out_ref):
+        def body(_, acc):
+            return jnp.take_along_axis(acc, idx_ref[:], axis=0,
+                                       mode="promise_in_bounds")
+        out_ref[:] = jax.lax.fori_loop(0, iters, body, tab_ref[:])
+
+    @jax.jit
+    def run(tab, idx):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=INTERPRET,
+        )(tab, idx)
+
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.random((R, 128), dtype=np.float32))
+    idx = jnp.asarray(rng.integers(0, R, (R, 128)), dtype=jnp.int32)
+    try:
+        dt, _ = timeit(run, tab, idx, n=5)
+    except Exception as e:  # noqa: BLE001
+        print(f"  gather_loop R={R}: FAILED {type(e).__name__}: {str(e)[:160]}")
+        return
+    per = dt / iters
+    print(f"  gather_loop R={R} x{iters}: {per*1e6:9.1f} us/gather "
+          f"{R*128/per/1e9:8.2f} Gelem/s")
+
+
+if __name__ == "__main__":
+    print(f"platform: {jax.devices()[0].platform} interpret={INTERPRET}")
+    print("col gather (axis=0, cross-sublane):")
+    for R in (8, 64, 512, 2048, 8192):
+        bench_col_gather(R)
+    print("lane gather (axis=1):")
+    for R in (8, 512, 8192):
+        bench_lane_gather(R)
+    print("streaming:")
+    for MB in (64, 256):
+        bench_stream(MB)
+    print("gather in-loop:")
+    bench_gather_loop(8192)
