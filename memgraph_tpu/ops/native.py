@@ -27,19 +27,25 @@ _lib = None
 _tried = False
 
 
+_SOURCES = ("csr_builder.cpp", "benes_router.cpp")
+
+
 def _ensure_built() -> bool:
-    if os.path.exists(_LIB_PATH):
+    srcs = [os.path.join(_NATIVE_DIR, f) for f in _SOURCES]
+    if not all(os.path.exists(p) for p in srcs):
+        # sources pruned (e.g. binary-only deployment): trust a prebuilt .so
+        return os.path.exists(_LIB_PATH)
+    if os.path.exists(_LIB_PATH) and all(
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(p)
+            for p in srcs):
         return True
-    src = os.path.join(_NATIVE_DIR, "csr_builder.cpp")
-    if not os.path.exists(src):
-        return False
     # compile to a temp name and rename: an interrupted build must never
     # leave a half-written .so that later loads treat as valid
     tmp = _LIB_PATH + f".tmp{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-Wall",
-             "-o", tmp, src],
+             "-o", tmp] + srcs,
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB_PATH)
         return True
@@ -66,6 +72,15 @@ def get_lib():
         except OSError as e:
             log.info("cannot load native csr builder: %s", e)
             return None
+        try:
+            lib.benes_route.restype = ctypes.c_int
+            lib.benes_route.argtypes = [
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib._has_benes = True
+        except AttributeError:  # stale prebuilt .so without the router
+            lib._has_benes = False
         lib.build_csr_csc.restype = ctypes.c_int
         lib.build_csr_csc.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
@@ -130,3 +145,25 @@ def build_csr_csc_native(src: np.ndarray, dst: np.ndarray,
         "csc_src": csc_src, "csc_dst": csc_dst, "csc_w": csc_w,
         "row_ptr": row_ptr, "out_degree": out_degree,
     }
+
+
+def benes_route_native(perm: np.ndarray):
+    """Bit-packed Benes stage masks via the C++ router, or None.
+
+    Returns (n_stages, (N+7)//8) uint8, rows packbits-compatible.
+    """
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_benes", False):
+        return None
+    perm = np.ascontiguousarray(perm, dtype=np.int64)
+    N = len(perm)
+    if N < 2 or N & (N - 1):
+        raise ValueError("benes_route_native requires power-of-two N >= 2")
+    n_stages = 2 * (N.bit_length() - 1) - 1
+    out = np.zeros((n_stages, (N + 7) // 8), dtype=np.uint8)
+    rc = lib.benes_route(
+        perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        N, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        raise ValueError("invalid permutation for benes_route")
+    return out

@@ -1,0 +1,402 @@
+"""Gather-free sparse matvec (PageRank core) built from MXU matmuls,
+Benes routing, and roll-tree reductions.
+
+Motivation (measured, docs/kernel_design_r2.md): on this TPU platform XLA
+elementwise/matmul run at full speed while every gather/scatter/sort
+formulation — including Pallas — is 2-3 orders of magnitude slower. This
+module therefore expresses `acc[dst] += rank[src] * mult(edge)` with NO
+data-dependent addressing on the device:
+
+  1. EXPAND   — one-hot matmul multicast: per supergroup of 128 rank rows,
+                T = einsum(OH(src_row), rank_planes) places rank[src] in
+                every edge slot (slot lane == src & 127); multiply by the
+                per-slot `mult` (weight / out-weight-sum, 0 on padding).
+  2. PERMUTE  — a Benes network (ops.benes) moves every edge slot from its
+                gather-layout position to its scatter-layout position via
+                2*log2(N)-1 masked-swap stages.
+  3. REDUCE   — scatter layout keeps each destination's edges contiguous
+                within its lane (lane == dst & 127, runs aligned per
+                dst-row); ~log2(max in-degree) passes of
+                x += mask_k * roll(x, -2^k) leave each run's total at its
+                base row.
+  4. EXTRACT  — chunked one-hot matmuls pick the base-row totals into a
+                dense accumulator, then a small window one-hot matmul sums
+                chunks into aligned windows.
+  5. RELABEL  — a second (node-sized) Benes converts the accumulator from
+                the in-degree-sorted labeling (which keeps scatter padding
+                small under skew) to the out-degree-sorted labeling (which
+                keeps gather padding small), ready for the next EXPAND.
+
+All routing/masks/layouts are precomputed on the host at export time and
+shipped once; per-iteration device work is elementwise + MXU + rolls only.
+
+Reference analog: the sparse power iteration of
+/root/reference/mage/cpp/pagerank_module/ and the cuGraph CUDA variant
+(mage/cpp/cugraph_module/algorithms/pagerank.cu); the formulation here is
+TPU-native rather than scatter/gather-based.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from .benes import benes_stage_distances, route_packed
+
+LANES = 128
+SG_ROWS = 128          # rank rows per supergroup (=> 16384 nodes)
+R_C = 256              # scatter rows per extract chunk
+K_C = 256              # dst-rows per aligned output window
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+@dataclass
+class MXUPlan:
+    n_nodes: int
+    # --- gather (out-degree labeling) ---
+    G: int                     # supergroups
+    R_G: int                   # gather rows per supergroup (padded uniform)
+    rowid: np.ndarray          # (G, R_G) int16: src row within supergroup
+    mult: np.ndarray           # (G, R_G, LANES) f32: w/wsum, 0 = pad slot
+    out_relabel: np.ndarray    # (n_nodes,) original -> out-label id
+    valid_out: np.ndarray      # (G*SG_ROWS*LANES,) f32 1.0 for real nodes
+    dangling_out: np.ndarray   # same shape: 1.0 where out-wsum == 0
+    # --- big Benes ---
+    net_log2: int
+    masks_packed: np.ndarray   # (stages, N/8) uint8
+    # --- scatter/reduce (in-degree labeling) ---
+    C: int                     # extract chunks (total rows = C * R_C)
+    reduce_k: int              # roll-tree depth
+    reduce_masks: np.ndarray   # (reduce_k, C*R_C) bool (per-row)
+    ext_base: np.ndarray       # (C, R_C) int16: local window dst-row or -1
+    win_oh: np.ndarray         # (C, W) f32 one-hot chunk->window
+    W: int
+    in_relabel: np.ndarray     # (n_nodes,) original -> in-label id
+    # --- node relabel Benes (in-label acc -> out-label acc) ---
+    node_net_log2: int
+    node_masks_packed: np.ndarray
+
+
+def _relabel_by(key: np.ndarray, stripe_groups: int = 0) -> np.ndarray:
+    """relabel[node] = position when sorted by key desc (stable).
+
+    With stripe_groups=G, rows of 128 consecutive sorted nodes (degree-
+    homogeneous, so each row's max ~ its mean) are dealt round-robin
+    across the G supergroups: row j lands at supergroup j%G, slot j//G.
+    This balances per-supergroup row totals so the uniform R_G padding of
+    the batched expand einsum stays ~1x instead of concentrating all the
+    tall rows in supergroup 0."""
+    order = np.argsort(-key, kind="stable")
+    n = len(key)
+    pos = np.arange(n)
+    if stripe_groups:
+        j, lane = pos >> 7, pos & 127
+        r2 = (j % stripe_groups) * SG_ROWS + j // stripe_groups
+        pos = r2 * LANES + lane
+    relab = np.empty(n, dtype=np.int64)
+    relab[order] = pos
+    return relab
+
+
+def build_plan(src: np.ndarray, dst: np.ndarray,
+               weights: Optional[np.ndarray], n_nodes: int) -> MXUPlan:
+    """Precompute layouts + routing for the MXU pagerank kernel."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    E = len(src)
+    w = (np.ones(E, dtype=np.float64) if weights is None
+         else np.asarray(weights, dtype=np.float64))
+
+    out_deg = np.bincount(src, minlength=n_nodes)
+    in_deg = np.bincount(dst, minlength=n_nodes)
+    wsum = np.bincount(src, weights=w, minlength=n_nodes)
+
+    n_rows = _ceil_to(n_nodes, LANES) // LANES
+    G = _ceil_to(n_rows, SG_ROWS) // SG_ROWS
+    relab_out = _relabel_by(out_deg, stripe_groups=G)
+    relab_in = _relabel_by(in_deg)
+
+    # ---------------- gather layout (out labeling) ----------------
+    u = relab_out[src]
+    srow, slane = u >> 7, u & 127
+    # rows per src-row block = max out-degree among its 128 nodes
+    deg_out_l = np.zeros(G * SG_ROWS * LANES, dtype=np.int64)
+    deg_out_l[relab_out] = out_deg
+    H_out = deg_out_l.reshape(-1, LANES).max(axis=1)          # per src-row
+    H_out = np.maximum(H_out, 0)
+    rows_per_sg = H_out.reshape(G, SG_ROWS).sum(axis=1)
+    R_G = max(1, int(rows_per_sg.max()))
+    # base row (within supergroup) of each src-row block
+    base_in_sg = np.zeros(G * SG_ROWS, dtype=np.int64)
+    for g in range(G):
+        base_in_sg[g * SG_ROWS:(g + 1) * SG_ROWS] = \
+            np.cumsum(H_out[g * SG_ROWS:(g + 1) * SG_ROWS]) \
+            - H_out[g * SG_ROWS:(g + 1) * SG_ROWS]
+    # per-edge sequence within its (node) bucket, in (src) sorted order
+    order_g = np.argsort(u, kind="stable")
+    seq = np.arange(E) - np.concatenate(([0], np.cumsum(
+        np.bincount(u, minlength=G * SG_ROWS * LANES))))[u[order_g]]
+    sg = srow[order_g] >> 7
+    grow = base_in_sg[srow[order_g]] + seq                    # row in sg
+    gather_pos = ((sg * R_G + grow) * LANES + slane[order_g])
+
+    rowid = np.zeros((G, R_G), dtype=np.int16)
+    for g in range(G):
+        rs = H_out[g * SG_ROWS:(g + 1) * SG_ROWS]
+        rowid[g, :rs.sum()] = np.repeat(np.arange(SG_ROWS, dtype=np.int16),
+                                        rs)
+    mult = np.zeros((G, R_G, LANES), dtype=np.float32)
+    mult_flat = mult.reshape(-1)
+    inv_wsum = np.where(wsum > 0, 1.0 / np.maximum(wsum, 1e-300), 0.0)
+    mult_flat[gather_pos] = (w * inv_wsum[src])[order_g]
+
+    node_flat = G * SG_ROWS * LANES
+    valid_out = np.zeros(node_flat, dtype=np.float32)
+    valid_out[relab_out] = 1.0
+    dangling_out = np.zeros(node_flat, dtype=np.float32)
+    dangling_out[relab_out[wsum <= 0]] = 1.0
+    # relab_out covers exactly [0, n_nodes) so valid == first n_nodes
+
+    # ---------------- scatter layout (in labeling) ----------------
+    v = relab_in[dst]
+    drow, dlane = v >> 7, v & 127
+    deg_in_l = np.zeros(node_flat, dtype=np.int64)
+    deg_in_l[relab_in] = in_deg
+    H_in = np.maximum(deg_in_l.reshape(-1, LANES).max(axis=1), 1)
+    n_drows = _ceil_to(n_nodes, LANES) // LANES
+    n_drows_p = _ceil_to(n_drows, K_C)                        # whole windows
+    if len(H_in) >= n_drows_p:
+        H_in = H_in[:n_drows_p]
+    else:  # extend with single-row empty blocks (extract reads zeros)
+        H_in = np.concatenate(
+            [H_in, np.ones(n_drows_p - len(H_in), dtype=H_in.dtype)])
+    W = n_drows_p // K_C
+
+    # chunked row allocation: each chunk's BASE rows must map to one
+    # aligned K_C window of dst-rows; blocks may spill across chunks.
+    base2 = np.zeros(n_drows_p, dtype=np.int64)
+    chunk_of_base = np.zeros(n_drows_p, dtype=np.int64)
+    rows_acc = 0
+    last_base_chunk = -1
+    last_base_win = -1
+    for dr in range(n_drows_p):
+        wdw = dr // K_C
+        c = rows_acc // R_C
+        if c == last_base_chunk and wdw != last_base_win:
+            rows_acc = _ceil_to(rows_acc, R_C)                # pad chunk
+            c = rows_acc // R_C
+        base2[dr] = rows_acc
+        chunk_of_base[dr] = c
+        last_base_chunk, last_base_win = c, wdw
+        rows_acc += int(H_in[dr])
+    R_total = _ceil_to(rows_acc, R_C)
+    C = R_total // R_C
+
+    # window of each chunk = window of the bases it contains (unique by
+    # construction; chunks with no base keep the previous window)
+    win_of_chunk = np.zeros(C, dtype=np.int64)
+    wtmp = np.zeros(C, dtype=np.int64) - 1
+    for dr in range(n_drows_p):
+        wtmp[chunk_of_base[dr]] = dr // K_C
+    last = 0
+    for c in range(C):
+        if wtmp[c] >= 0:
+            last = wtmp[c]
+        win_of_chunk[c] = last
+    win_oh = np.zeros((C, W), dtype=np.float32)
+    win_oh[np.arange(C), win_of_chunk] = 1.0
+
+    ext_base = np.full((C, R_C), -1, dtype=np.int16)
+    ext_base[chunk_of_base, base2 % R_C] = \
+        (np.arange(n_drows_p) % K_C).astype(np.int16)
+
+    # reduce masks: mask_k[row]=1 iff row and row+2^k in same dst block
+    reduce_k = max(1, int(np.ceil(np.log2(max(2, H_in.max())))))
+    block_of_row = np.full(R_total, -1, dtype=np.int64)
+    for dr in range(n_drows_p):
+        block_of_row[base2[dr]:base2[dr] + H_in[dr]] = dr
+    reduce_masks = np.zeros((reduce_k, R_total), dtype=bool)
+    rows_idx = np.arange(R_total)
+    for k in range(reduce_k):
+        j = rows_idx + (1 << k)
+        ok = j < R_total
+        reduce_masks[k, ok] = (block_of_row[rows_idx[ok]] >= 0) & \
+            (block_of_row[rows_idx[ok]] == block_of_row[j[ok]])
+
+    # per-edge scatter position
+    order_s = np.argsort(v, kind="stable")
+    seq2 = np.arange(E) - np.concatenate(([0], np.cumsum(
+        np.bincount(v, minlength=node_flat))))[v[order_s]]
+    scatter_pos = ((base2[drow[order_s]] + seq2) * LANES + dlane[order_s])
+
+    # ---------------- big Benes routing ----------------
+    n_gather_flat = G * R_G * LANES
+    n_scatter_flat = R_total * LANES
+    net = max(n_gather_flat, n_scatter_flat, 2)
+    net_log2 = int(np.ceil(np.log2(net)))
+    N_net = 1 << net_log2
+    # perm in gather form: output position q takes input position p
+    perm = np.full(N_net, -1, dtype=np.int64)
+    # edge e sits at gather_pos[i] where i indexes order_g; express both
+    # positions for the SAME edge: map order_g-indexed to order_s-indexed
+    gp_by_edge = np.empty(E, dtype=np.int64)
+    gp_by_edge[order_g] = gather_pos
+    sp_by_edge = np.empty(E, dtype=np.int64)
+    sp_by_edge[order_s] = scatter_pos
+    perm[sp_by_edge] = gp_by_edge
+    # complete the bijection: remaining outputs take remaining inputs
+    free_out = np.flatnonzero(perm < 0)
+    used_in = np.zeros(N_net, dtype=bool)
+    used_in[gp_by_edge] = True
+    perm[free_out] = np.flatnonzero(~used_in)
+    masks_packed = route_packed(perm)
+
+    # ---------------- node relabel Benes ----------------
+    acc_flat_len = n_drows_p * LANES          # in-label dense acc
+    node_net_log2 = int(np.ceil(np.log2(max(node_flat, acc_flat_len, 2))))
+    N_nn = 1 << node_net_log2
+    nperm = np.full(N_nn, -1, dtype=np.int64)
+    nperm[relab_out] = relab_in                # out position <- in position
+    free_out = np.flatnonzero(nperm < 0)
+    used_in = np.zeros(N_nn, dtype=bool)
+    used_in[relab_in] = True
+    nperm[free_out] = np.flatnonzero(~used_in)
+    node_masks_packed = route_packed(nperm)
+
+    return MXUPlan(
+        n_nodes=n_nodes, G=G, R_G=R_G, rowid=rowid, mult=mult,
+        out_relabel=relab_out, valid_out=valid_out,
+        dangling_out=dangling_out,
+        net_log2=net_log2, masks_packed=masks_packed,
+        C=C, reduce_k=reduce_k, reduce_masks=reduce_masks,
+        ext_base=ext_base, win_oh=win_oh, W=W, in_relabel=relab_in,
+        node_net_log2=node_net_log2, node_masks_packed=node_masks_packed)
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+# ---------------------------------------------------------------------------
+
+def _unpack_bits_jnp(packed, n):
+    import jax.numpy as jnp
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[..., :, None] >> shifts) & 1
+    return bits.reshape(*packed.shape[:-1], packed.shape[-1] * 8)[..., :n]
+
+
+def _benes_apply_jnp(x, masks, net_log2):
+    """masks: (stages, N) bool device array; static unrolled stages."""
+    import jax.numpy as jnp
+    N = 1 << net_log2
+    dists = benes_stage_distances(net_log2)
+    for s, d in enumerate(dists):
+        y = x.reshape(N // (2 * d), 2, d)
+        sw = jnp.flip(y, axis=1).reshape(N)
+        x = jnp.where(masks[s], sw, x)
+    return x
+
+
+def make_pagerank_kernel(plan: MXUPlan):
+    """Returns (jitted_fn, device_args). fn(rank0_flat, damping,
+    max_iter, tol, *device_args) -> (rank_flat, err, iters); rank vectors
+    are flat in OUT labeling, length G*SG_ROWS*LANES."""
+    import jax
+    import jax.numpy as jnp
+
+    G, R_G, C, W = plan.G, plan.R_G, plan.C, plan.W
+    N_net = 1 << plan.net_log2
+    N_nn = 1 << plan.node_net_log2
+    node_flat = G * SG_ROWS * LANES
+    n_f = float(plan.n_nodes)
+    acc_len = plan.win_oh.shape[1] * K_C * LANES
+
+    dev = dict(
+        rowid=jnp.asarray(plan.rowid, jnp.int32),
+        mult=jnp.asarray(plan.mult),
+        valid=jnp.asarray(plan.valid_out),
+        dangling=jnp.asarray(plan.dangling_out),
+        masks=_unpack_bits_jnp(jnp.asarray(plan.masks_packed),
+                               N_net).astype(bool),
+        reduce_masks=jnp.asarray(plan.reduce_masks),
+        ext_base=jnp.asarray(plan.ext_base, jnp.int32),
+        win_oh=jnp.asarray(plan.win_oh),
+        node_masks=_unpack_bits_jnp(jnp.asarray(plan.node_masks_packed),
+                                    N_nn).astype(bool),
+    )
+
+    iota_sg = jnp.arange(SG_ROWS, dtype=jnp.int32)
+    iota_kc = jnp.arange(K_C, dtype=jnp.int32)
+
+    def one_iter(rank_flat, d, dv):
+        rank_planes = rank_flat.reshape(G, SG_ROWS, LANES)
+        oh = (dv["rowid"][:, :, None] == iota_sg[None, None, :]
+              ).astype(jnp.float32)                       # (G, R_G, 128)
+        T = jnp.einsum("grw,gwl->grl", oh, rank_planes,
+                       preferred_element_type=jnp.float32)
+        contrib = (T * dv["mult"]).reshape(-1)
+        x = jnp.zeros(N_net, jnp.float32).at[:contrib.shape[0]].set(contrib)
+        x = _benes_apply_jnp(x, dv["masks"], plan.net_log2)
+        x2 = x[:C * R_C * LANES].reshape(C * R_C, LANES)
+        for k in range(plan.reduce_k):
+            x2 = x2 + dv["reduce_masks"][k][:, None] * \
+                jnp.roll(x2, -(1 << k), axis=0)
+        xc = x2.reshape(C, R_C, LANES)
+        ohe = (dv["ext_base"][:, :, None] == iota_kc[None, None, :]
+               ).astype(jnp.float32)                      # (C, R_C, K_C)
+        per_chunk = jnp.einsum("cik,cil->ckl", ohe, xc,
+                               preferred_element_type=jnp.float32)
+        accw = jnp.einsum("cw,ckl->wkl", dv["win_oh"], per_chunk,
+                          preferred_element_type=jnp.float32)
+        acc_in = accw.reshape(-1)                         # in-label dense
+        xa = jnp.zeros(N_nn, jnp.float32).at[:acc_len].set(acc_in)
+        acc_out = _benes_apply_jnp(xa, dv["node_masks"],
+                                   plan.node_net_log2)[:node_flat]
+        dm = jnp.sum(rank_flat * dv["dangling"])
+        new_rank = dv["valid"] * ((1.0 - d) / n_f
+                                  + d * (acc_out + dm / n_f))
+        return new_rank
+
+    @partial(jax.jit, static_argnames=("max_iterations",))
+    def run_impl(rank0, damping, max_iterations: int, tol, dv):
+        def body(carry):
+            rank, _, it = carry
+            new_rank = one_iter(rank, damping, dv)
+            err = jnp.sum(jnp.abs(new_rank - rank))
+            return new_rank, err, it + 1
+
+        def cond(carry):
+            _, err, it = carry
+            return (err > tol) & (it < max_iterations)
+
+        return jax.lax.while_loop(
+            cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
+
+    def run(rank0, damping, max_iterations, tol):
+        # dev passed as an argument pytree so the big mask arrays are
+        # runtime inputs, not baked-in jit constants
+        return run_impl(rank0, damping, max_iterations, tol, dev)
+
+    return run
+
+
+def pagerank_mxu(src, dst, weights, n_nodes, damping=0.85,
+                 max_iterations=100, tol=1e-6, plan: MXUPlan = None):
+    """End-to-end: build plan (or reuse), run kernel, return ranks in
+    ORIGINAL node ids plus (err, iters)."""
+    import jax.numpy as jnp
+    if plan is None:
+        plan = build_plan(src, dst, weights, n_nodes)
+    run = make_pagerank_kernel(plan)
+    node_flat = plan.G * SG_ROWS * LANES
+    rank0 = np.zeros(node_flat, dtype=np.float32)
+    rank0[plan.out_relabel] = 1.0 / plan.n_nodes
+    rank, err, iters = run(jnp.asarray(rank0), jnp.float32(damping),
+                           max_iterations, jnp.float32(tol))
+    rank = np.asarray(rank)
+    return rank[plan.out_relabel], float(err), int(iters)

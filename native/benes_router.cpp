@@ -1,0 +1,110 @@
+// Benes permutation-network router.
+//
+// Computes the per-stage swap masks that realize a fixed permutation on a
+// power-of-two array as 2*log2(N)-1 masked-swap stages (the TPU-native
+// "scatter" used by memgraph_tpu/ops/spmv_mxu.py; algorithm documented in
+// memgraph_tpu/ops/benes.py, which holds the pure-python reference
+// implementation). The classic looping algorithm: at every level, elements
+// paired at the input stage and elements paired at the output stage form
+// even cycles; 2-coloring each cycle assigns elements to the top/bottom
+// half-network. O(N log N) total.
+//
+// Masks are bit-packed MSB-first per byte to match numpy.packbits.
+//
+// Build: part of libcsr_builder.so (see Makefile).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline void set_bit(uint8_t* bits, int64_t i, bool v) {
+  if (v) bits[i >> 3] |= static_cast<uint8_t>(0x80u >> (i & 7));
+}
+
+}  // namespace
+
+extern "C" {
+
+// perm: gather form — output position i receives input position perm[i].
+// masks_packed: caller-allocated (2*log2(N)-1) * (N/8) bytes, zeroed here.
+// Returns 0 on success, 1 on invalid arguments.
+int benes_route(const int64_t* perm, int64_t N, uint8_t* masks_packed) {
+  if (N < 2 || (N & (N - 1))) return 1;
+  int n = 0;
+  while ((int64_t{1} << n) < N) n++;
+  const int n_stages = 2 * n - 1;
+  const int64_t bytes_per_stage = (N + 7) >> 3;
+  std::memset(masks_packed, 0,
+              static_cast<size_t>(n_stages) * bytes_per_stage);
+
+  // forward[p] = q: element at input p must reach output q.
+  std::vector<int32_t> fwd(N, -1), nxt(N), inv(N);
+  std::vector<int8_t> halves(N);
+  for (int64_t i = 0; i < N; i++) {
+    if (perm[i] < 0 || perm[i] >= N) return 1;
+    if (fwd[perm[i]] >= 0) return 1;  // duplicate: not a bijection
+    fwd[perm[i]] = static_cast<int32_t>(i);
+  }
+
+  for (int level = 0; level < n - 1; level++) {
+    const int64_t B = N >> level;
+    const int64_t h = B >> 1;
+    uint8_t* in_bits = masks_packed + int64_t(level) * bytes_per_stage;
+    uint8_t* out_bits =
+        masks_packed + int64_t(n_stages - 1 - level) * bytes_per_stage;
+    for (int64_t base = 0; base < N; base += B) {
+      int32_t* f = fwd.data() + base;
+      int32_t* iv = inv.data() + base;
+      int8_t* hv = halves.data() + base;
+      for (int64_t i = 0; i < B; i++) iv[f[i]] = static_cast<int32_t>(i);
+      std::memset(hv, -1, B);
+      for (int64_t start = 0; start < B; start++) {
+        if (hv[start] >= 0) continue;
+        int64_t i = start;
+        int8_t color = 0;
+        while (hv[i] < 0) {
+          hv[i] = color;
+          const int64_t ip = i ^ h;  // input partner
+          if (hv[ip] < 0) hv[ip] = color ^ 1;
+          const int64_t op_out = int64_t(f[ip]) ^ h;  // ip's output partner
+          i = iv[op_out];
+          color = hv[ip] ^ 1;
+        }
+      }
+      // IN stage: element at local input i routed to half hv[i]; the pair
+      // (i, i+h) swaps iff the element in the top slot goes bottom.
+      for (int64_t i = 0; i < B; i++) {
+        const bool swap_in = (hv[i] == 1) == (i < h);
+        set_bit(in_bits, base + i, swap_in);
+      }
+      // OUT stage: output o receives its element from half hv[iv[o]].
+      for (int64_t o = 0; o < B; o++) {
+        const bool swap_out = (hv[iv[o]] == 1) == (o < h);
+        set_bit(out_bits, base + o, swap_out);
+      }
+      // Sub-permutations (forward form, local to each half).
+      int32_t* top = nxt.data() + base;
+      int32_t* bot = nxt.data() + base + h;
+      for (int64_t i = 0; i < B; i++) {
+        const int64_t slot = i & (h - 1);
+        if (hv[i] == 0)
+          top[slot] = static_cast<int32_t>(int64_t(f[i]) & (h - 1));
+        else
+          bot[slot] = static_cast<int32_t>(int64_t(f[i]) & (h - 1));
+      }
+    }
+    fwd.swap(nxt);
+  }
+  // middle level: blocks of 2
+  uint8_t* mid = masks_packed + int64_t(n - 1) * bytes_per_stage;
+  for (int64_t base = 0; base < N; base += 2) {
+    const bool sw = fwd[base] == 1;
+    set_bit(mid, base, sw);
+    set_bit(mid, base + 1, sw);
+  }
+  return 0;
+}
+
+}  // extern "C"
