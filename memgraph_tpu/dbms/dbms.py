@@ -49,14 +49,26 @@ class DbmsHandler:
 
     def _make(self, name: str):
         from ..query.interpreter import InterpreterContext
+        from ..storage.common import StorageMode
         cfg = self._db_config(name)
-        storage = InMemoryStorage(cfg)
-        if cfg.durability_dir:
-            from ..storage.durability.recovery import recover, wire_durability
-            if self._recover:
-                recover(storage)
-            if cfg.wal_enabled:
-                wire_durability(storage)
+        if cfg.storage_mode is StorageMode.ON_DISK_TRANSACTIONAL:
+            # disk mode: sqlite owns persistence; snapshots/WAL unused
+            # (ref: disk/storage.cpp — RocksDB owns durability)
+            from ..storage.disk_storage import DiskStorage
+            if not cfg.durability_dir:
+                cfg.durability_dir = os.path.join(
+                    os.getcwd(), "mg_disk_data", name)
+                os.makedirs(cfg.durability_dir, exist_ok=True)
+            storage = DiskStorage(cfg)
+        else:
+            storage = InMemoryStorage(cfg)
+            if cfg.durability_dir:
+                from ..storage.durability.recovery import (recover,
+                                                           wire_durability)
+                if self._recover:
+                    recover(storage)
+                if cfg.wal_enabled:
+                    wire_durability(storage)
         ictx = InterpreterContext(storage, dict(self._interp_config))
         ictx.database_name = name
         ictx.dbms = self

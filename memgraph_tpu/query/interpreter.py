@@ -1011,8 +1011,57 @@ class Interpreter:
         return self._prepare_generator(iter([]), [], "s")
 
     def _prepare_storage_mode(self, node: A.StorageModeQuery) -> PreparedQuery:
-        self.ctx.storage.config.storage_mode = StorageMode(node.mode)
+        target = StorageMode(node.mode)
+        current = self.ctx.storage.config.storage_mode
+        disk = StorageMode.ON_DISK_TRANSACTIONAL
+        if target is disk or current is disk:
+            if target is not current:
+                # same rule as the reference: memory<->disk switching only
+                # while the database holds no data
+                acc = self.ctx.storage.access()
+                try:
+                    empty = next(acc.vertices(), None) is None
+                finally:
+                    acc.abort()
+                if not empty:
+                    raise QueryException(
+                        "Cannot switch between in-memory and on-disk "
+                        "storage modes on a non-empty database")
+                self._swap_storage(target)
+                return self._prepare_generator(iter([]), [], "s")
+        self.ctx.storage.config.storage_mode = target
         return self._prepare_generator(iter([]), [], "s")
+
+    def _swap_storage(self, target) -> None:
+        """Replace ctx.storage with a fresh engine of the target mode (only
+        reachable on an empty database)."""
+        import dataclasses
+        from ..storage import InMemoryStorage
+        from ..storage.common import StorageMode as SM
+        from ..storage.disk_storage import DiskStorage
+        old = self.ctx.storage
+        cfg = dataclasses.replace(old.config, storage_mode=target)
+        if target is SM.ON_DISK_TRANSACTIONAL:
+            if not cfg.durability_dir:
+                import tempfile
+                cfg.durability_dir = tempfile.mkdtemp(prefix="mg_disk_")
+            new = DiskStorage(cfg)
+            if len(new._vertices) or len(new._edges):
+                new.close()
+                raise QueryException(
+                    "on-disk data directory already contains a graph; "
+                    "cannot switch a different database onto it")
+        else:
+            new = InMemoryStorage(cfg)
+        if not len(new.label_mapper) and not len(new.property_mapper):
+            # fresh target: carry interned names so ids stay stable for
+            # cached plans; a restored disk store keeps its own mappers
+            new.label_mapper = old.label_mapper
+            new.property_mapper = old.property_mapper
+            new.edge_type_mapper = old.edge_type_mapper
+        self.ctx.storage = new
+        if getattr(self.ctx, "dbms", None) is not None:
+            self.ctx.dbms._databases[self.ctx.database_name] = self.ctx
 
     def _prepare_trigger(self, node: A.TriggerQuery) -> PreparedQuery:
         from .triggers import global_trigger_store

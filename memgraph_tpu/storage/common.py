@@ -31,3 +31,4 @@ class IsolationLevel(enum.Enum):
 class StorageMode(enum.Enum):
     IN_MEMORY_TRANSACTIONAL = "IN_MEMORY_TRANSACTIONAL"
     IN_MEMORY_ANALYTICAL = "IN_MEMORY_ANALYTICAL"
+    ON_DISK_TRANSACTIONAL = "ON_DISK_TRANSACTIONAL"

@@ -1,0 +1,498 @@
+"""ON_DISK_TRANSACTIONAL storage: demand-paged graph over sqlite.
+
+Third storage mode, mirroring the reference's RocksDB-backed DiskStorage
+(/root/reference/src/storage/v2/disk/storage.cpp, ADRs/003_rocksdb.md):
+durable committed state lives in an embedded KV-style store (sqlite here —
+the environment's RocksDB-class embedded engine), transactions run the
+same optimistic MVCC as the in-memory engine, and the in-memory object
+table becomes a demand-paged CACHE of the durable state.
+
+Design:
+  - `PagedVertex`/`PagedEdge` carry a `loaded` flag; every accessor read or
+    write hydrates the object from sqlite first (DiskAccessor overrides the
+    state/materialize entry points).
+  - Object identity is canonical: the paged tables return one object per
+    gid, so `is`-comparisons and MVCC delta chains behave exactly as in the
+    in-memory engine.
+  - Commit: after the in-memory MVCC commit succeeds, the touched objects
+    are written through to sqlite in ONE sqlite transaction (the analog of
+    the reference's RocksDB write-batch at commit,
+    disk/storage.cpp commit path).
+  - Eviction: hydrated, clean (no delta chain) objects are dehydrated when
+    the cache exceeds `disk_cache_objects` and no other transaction is
+    active — the same safety rule as GC (evicted state must already be
+    visible to every possible reader).
+  - Snapshots/WAL are not used in this mode (sqlite IS the durability),
+    matching the reference where RocksDB owns persistence in disk mode.
+
+Like the reference (storage mode switching docs), a database can only be
+switched to/from ON_DISK_TRANSACTIONAL while empty.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import struct
+import threading
+from typing import Iterator, Optional
+
+from .common import Gid, IsolationLevel, StorageMode, View
+from .mvcc import materialize_edge, materialize_vertex
+from .objects import Edge, Vertex
+from .property_store import decode_properties, encode_properties
+from .storage import Accessor, InMemoryStorage, StorageConfig
+
+
+class _AsOf:
+    """Pseudo-transaction pinning reads at a commit timestamp — used to
+    materialize exactly the committed-at-ts state for persistence, immune
+    to concurrent writers that already own the object head."""
+
+    def __init__(self, ts: int) -> None:
+        self._ts = ts
+        self.id = 0          # matches no delta owner
+
+    def effective_start_ts(self) -> int:
+        return self._ts
+
+_ADJ = struct.Struct("<qqq")  # edge_gid, edge_type, other_gid
+
+
+class PagedVertex(Vertex):
+    __slots__ = ("loaded",)
+
+    def __init__(self, gid: int, loaded: bool = True) -> None:
+        super().__init__(gid)
+        self.loaded = loaded
+
+
+class PagedEdge(Edge):
+    __slots__ = ("loaded",)
+
+    def __init__(self, gid: int, edge_type: int, from_vertex, to_vertex,
+                 loaded: bool = True) -> None:
+        super().__init__(gid, edge_type, from_vertex, to_vertex)
+        self.loaded = loaded
+
+
+class _PagedTable:
+    """dict-compatible view over cache + sqlite backing rows."""
+
+    def __init__(self, storage: "DiskStorage", kind: str) -> None:
+        self._s = storage
+        self._kind = kind          # "v" | "e"
+        self.cache: dict[int, object] = {}
+
+    # -- dict protocol used by the engine ------------------------------
+    def __contains__(self, gid: int) -> bool:
+        return self.get(gid) is not None
+
+    def __getitem__(self, gid: int):
+        obj = self.get(gid)
+        if obj is None:
+            raise KeyError(gid)
+        return obj
+
+    def get(self, gid: int, default=None):
+        obj = self.cache.get(gid)
+        if obj is not None:
+            return obj
+        obj = self._s._load_stub(self._kind, gid)
+        return obj if obj is not None else default
+
+    def __setitem__(self, gid: int, obj) -> None:
+        self.cache[gid] = obj
+
+    def pop(self, gid: int, default=None):
+        return self.cache.pop(gid, default)
+
+    def items(self):
+        """CACHED items only — used by GC, and only cached objects can
+        carry delta chains or tombstones."""
+        return list(self.cache.items())
+
+    def __len__(self) -> int:
+        return self._s._count(self._kind, len(self.cache))
+
+    def values(self) -> Iterator:
+        """All objects: cached ones plus backing rows not in cache.
+
+        Hydrates lazily. To keep full scans memory-bounded, objects this
+        scan loaded are evicted in batches once the cache exceeds budget —
+        but only while at most one transaction (the scanner's own) is
+        active, because with concurrent writers an eviction could split
+        object identity (stale reload vs a writer's delta-carrying
+        object)."""
+        seen = set(self.cache.keys())
+        for obj in list(self.cache.values()):
+            yield self._s._hydrated(obj)
+        loaded_by_scan: list[int] = []
+        for gid in self._s._backing_gids(self._kind):
+            if gid in seen:
+                continue
+            obj = self.get(gid)
+            if obj is None:
+                continue
+            yield obj
+            loaded_by_scan.append(gid)
+            if len(loaded_by_scan) >= 8192 and \
+                    len(self.cache) > self._s.cache_budget:
+                self._s._evict_scan_batch(self._kind, loaded_by_scan[:-1])
+                loaded_by_scan = loaded_by_scan[-1:]
+
+
+class DiskAccessor(Accessor):
+    """Accessor that hydrates paged objects before every state read/write."""
+
+    def _vertex_state(self, vertex, view):
+        self.storage._hydrated(vertex)
+        return super()._vertex_state(vertex, view)
+
+    def _edge_state(self, edge, view):
+        self.storage._hydrated(edge)
+        return super()._edge_state(edge, view)
+
+    def _vertex_add_label(self, vertex, label_id):
+        self.storage._hydrated(vertex)
+        return super()._vertex_add_label(vertex, label_id)
+
+    def _vertex_remove_label(self, vertex, label_id):
+        self.storage._hydrated(vertex)
+        return super()._vertex_remove_label(vertex, label_id)
+
+    def _vertex_set_property(self, vertex, prop_id, value):
+        self.storage._hydrated(vertex)
+        return super()._vertex_set_property(vertex, prop_id, value)
+
+    def _edge_set_property(self, edge, prop_id, value):
+        self.storage._hydrated(edge)
+        return super()._edge_set_property(edge, prop_id, value)
+
+    def create_edge(self, from_va, to_va, edge_type_id):
+        self.storage._hydrated(from_va.vertex)
+        self.storage._hydrated(to_va.vertex)
+        return super().create_edge(from_va, to_va, edge_type_id)
+
+    def delete_vertex(self, va, detach=False):
+        self.storage._hydrated(va.vertex)
+        for (_, other, edge) in list(va.vertex.in_edges) + \
+                list(va.vertex.out_edges):
+            self.storage._hydrated(other)
+            self.storage._hydrated(edge)
+        return super().delete_vertex(va, detach=detach)
+
+    def delete_edge(self, ea):
+        self.storage._hydrated(ea.edge)
+        self.storage._hydrated(ea.edge.from_vertex)
+        self.storage._hydrated(ea.edge.to_vertex)
+        return super().delete_edge(ea)
+
+
+class DiskStorage(InMemoryStorage):
+    """The ON_DISK_TRANSACTIONAL engine."""
+
+    def __init__(self, config: Optional[StorageConfig] = None) -> None:
+        config = config or StorageConfig()
+        config.storage_mode = StorageMode.ON_DISK_TRANSACTIONAL
+        if not config.durability_dir:
+            raise ValueError("ON_DISK_TRANSACTIONAL requires durability_dir")
+        super().__init__(config)
+        os.makedirs(config.durability_dir, exist_ok=True)
+        self._db_path = os.path.join(config.durability_dir, "disk.sqlite3")
+        self._conn = sqlite3.connect(self._db_path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._sql_lock = threading.RLock()
+        with self._sql_lock, self._conn:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS vertices "
+                "(gid INTEGER PRIMARY KEY, data BLOB)")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS edges "
+                "(gid INTEGER PRIMARY KEY, data BLOB)")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS meta "
+                "(key TEXT PRIMARY KEY, value TEXT)")
+        self._vertices = _PagedTable(self, "v")
+        self._edges = _PagedTable(self, "e")
+        self.cache_budget = getattr(config, "disk_cache_objects", 100_000)
+        self._load_meta()
+
+    # ------------------------------------------------------------------
+    # hydration / paging
+    # ------------------------------------------------------------------
+
+    def _hydrated(self, obj):
+        if isinstance(obj, (PagedVertex, PagedEdge)) and not obj.loaded:
+            with obj.lock:  # double-checked: loaded is set LAST, inside
+                if not obj.loaded:
+                    if isinstance(obj, PagedVertex):
+                        self._hydrate_vertex(obj)
+                    else:
+                        self._hydrate_edge(obj)
+        return obj
+
+    def _canonical_vertex(self, gid: int) -> PagedVertex:
+        v = self._vertices.cache.get(gid)
+        if v is None:
+            v = PagedVertex(gid, loaded=False)
+            self._vertices.cache[gid] = v
+        return v
+
+    def _canonical_edge(self, gid: int, etype: int, fro, to) -> PagedEdge:
+        e = self._edges.cache.get(gid)
+        if e is None:
+            e = PagedEdge(gid, etype, fro, to, loaded=False)
+            self._edges.cache[gid] = e
+        return e
+
+    def _row(self, kind: str, gid: int):
+        table = "vertices" if kind == "v" else "edges"
+        with self._sql_lock:
+            cur = self._conn.execute(
+                f"SELECT data FROM {table} WHERE gid=?", (gid,))
+            row = cur.fetchone()
+        return row[0] if row else None
+
+    def _load_stub(self, kind: str, gid: int):
+        """Create (unhydrated) canonical object for a backing row."""
+        blob = self._row(kind, gid)
+        if blob is None:
+            return None
+        if kind == "v":
+            v = self._canonical_vertex(gid)
+            return self._hydrated(v)
+        # edges need endpoints decoded up front
+        etype, fgid, tgid = struct.unpack_from("<qqq", blob, 0)
+        fro = self._canonical_vertex(fgid)
+        to = self._canonical_vertex(tgid)
+        e = self._canonical_edge(gid, etype, fro, to)
+        return self._hydrated(e)
+
+    def _hydrate_vertex(self, v: PagedVertex) -> None:
+        """Populate from sqlite. Caller holds v.lock; sets loaded last."""
+        blob = self._row("v", v.gid)
+        if blob is None:
+            v.loaded = True
+            return
+        off = 0
+        n_labels, n_in, n_out, props_len = struct.unpack_from("<qqqq", blob)
+        off = 32
+        labels = struct.unpack_from(f"<{n_labels}q", blob, off)
+        off += 8 * n_labels
+        v.labels = set(labels)
+        in_adj = []
+        for _ in range(n_in):
+            egid, etype, ogid = _ADJ.unpack_from(blob, off)
+            off += _ADJ.size
+            other = self._canonical_vertex(ogid)
+            edge = self._canonical_edge(egid, etype, other, v)
+            in_adj.append((etype, other, edge))
+        out_adj = []
+        for _ in range(n_out):
+            egid, etype, ogid = _ADJ.unpack_from(blob, off)
+            off += _ADJ.size
+            other = self._canonical_vertex(ogid)
+            edge = self._canonical_edge(egid, etype, v, other)
+            out_adj.append((etype, other, edge))
+        v.in_edges = in_adj
+        v.out_edges = out_adj
+        v.properties = decode_properties(blob[off:off + props_len])
+        v.loaded = True
+
+    def _hydrate_edge(self, e: PagedEdge) -> None:
+        """Populate from sqlite. Caller holds e.lock; sets loaded last."""
+        blob = self._row("e", e.gid)
+        if blob is not None:
+            e.properties = decode_properties(blob[_ADJ.size:])
+        e.loaded = True
+
+    def _encode_state_vertex(self, st) -> bytes:
+        props = encode_properties(st.properties)
+        parts = [struct.pack("<qqqq", len(st.labels), len(st.in_edges),
+                             len(st.out_edges), len(props))]
+        parts.append(struct.pack(f"<{len(st.labels)}q", *sorted(st.labels)))
+        for (etype, other, edge) in st.in_edges:
+            parts.append(_ADJ.pack(edge.gid, etype, other.gid))
+        for (etype, other, edge) in st.out_edges:
+            parts.append(_ADJ.pack(edge.gid, etype, other.gid))
+        parts.append(props)
+        return b"".join(parts)
+
+    def _encode_state_edge(self, e: Edge, st) -> bytes:
+        return struct.pack("<qqq", e.edge_type, e.from_vertex.gid,
+                           e.to_vertex.gid) + encode_properties(st.properties)
+
+    def _backing_gids(self, kind: str) -> list[int]:
+        table = "vertices" if kind == "v" else "edges"
+        with self._sql_lock:
+            rows = self._conn.execute(f"SELECT gid FROM {table}").fetchall()
+        return [r[0] for r in rows]
+
+    def _count(self, kind: str, cached: int) -> int:
+        """Approximate count: durable rows + uncommitted in-flight creates
+        (cache objects still carrying a delta chain). Matches the "approx"
+        contract of approx_vertex_count."""
+        table = "vertices" if kind == "v" else "edges"
+        cache = (self._vertices if kind == "v" else self._edges).cache
+        with self._sql_lock:
+            n = self._conn.execute(
+                f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+        extra = sum(1 for gid, obj in list(cache.items())
+                    if obj.delta is not None and not obj.deleted
+                    and not isinstance(obj, (PagedVertex, PagedEdge))
+                    and self._row(kind, gid) is None)
+        return n + extra
+
+    # ------------------------------------------------------------------
+    # engine overrides
+    # ------------------------------------------------------------------
+
+    def access(self, isolation: Optional[IsolationLevel] = None) -> Accessor:
+        return DiskAccessor(self, isolation or self.config.isolation_level)
+
+    def _commit(self, txn) -> int:
+        touched_v = dict(txn.touched_vertices)
+        touched_e = dict(txn.touched_edges)
+        commit_ts = super()._commit(txn)
+        if not touched_v and not touched_e:
+            return commit_ts
+        # Materialize at commit_ts: the engine lock is released after the
+        # visibility flip, so object heads may already carry a NEWER
+        # transaction's uncommitted writes — the MVCC walk pins exactly the
+        # state this commit made durable.
+        as_of = _AsOf(commit_ts)
+        # encode OUTSIDE _sql_lock: materialize takes object locks, and
+        # hydration's lock order is object lock -> _sql_lock
+        v_rows, v_dels, e_rows, e_dels = [], [], [], []
+        for gid, v in touched_v.items():
+            st = materialize_vertex(v, as_of, View.OLD)
+            if st.deleted or not st.exists:
+                v_dels.append((gid,))
+            else:
+                v_rows.append((gid, self._encode_state_vertex(st)))
+        for gid, e in touched_e.items():
+            st = materialize_edge(e, as_of, View.OLD)
+            if st.deleted or not st.exists:
+                e_dels.append((gid,))
+            else:
+                e_rows.append((gid, self._encode_state_edge(e, st)))
+        with self._sql_lock, self._conn:
+            if v_dels:
+                self._conn.executemany(
+                    "DELETE FROM vertices WHERE gid=?", v_dels)
+            if v_rows:
+                self._conn.executemany(
+                    "INSERT OR REPLACE INTO vertices VALUES (?,?)", v_rows)
+            if e_dels:
+                self._conn.executemany(
+                    "DELETE FROM edges WHERE gid=?", e_dels)
+            if e_rows:
+                self._conn.executemany(
+                    "INSERT OR REPLACE INTO edges VALUES (?,?)", e_rows)
+            # edge creation/deletion changes endpoint adjacency: those
+            # endpoints are in touched_vertices by construction (create_edge
+            # and delete_edge record both endpoints)
+            self._save_meta_locked()
+        self._maybe_evict()
+        return commit_ts
+
+    def _abort(self, txn) -> None:
+        # hydration guarantee: every object a delta touches was hydrated
+        # before the write, so the base reverse-undo works unchanged
+        super()._abort(txn)
+
+
+    def _evict_scan_batch(self, kind: str, gids: list) -> None:
+        """Drop clean scan-loaded objects mid-scan (see values())."""
+        with self._engine_lock:
+            if len(self._active_txns) > 1:
+                return
+            cache = (self._vertices if kind == "v" else self._edges).cache
+            ecache = self._edges.cache if kind == "v" else None
+            for gid in gids:
+                obj = cache.get(gid)
+                if obj is not None and obj.delta is None and not obj.deleted:
+                    del cache[gid]
+                    if ecache is not None and isinstance(obj, Vertex):
+                        # drop the adjacency edges it pulled in too
+                        for (_, _, edge) in obj.in_edges + obj.out_edges:
+                            e2 = ecache.get(edge.gid)
+                            if e2 is edge and e2.delta is None:
+                                del ecache[edge.gid]
+
+    def _maybe_evict(self) -> None:
+        """Drop the whole clean cache once it exceeds the budget.
+
+        Partial eviction would split object identity: a cached neighbor's
+        adjacency still references the evicted object while a fresh load
+        creates a second one. Whole-cache eviction after a GC pass (which
+        truncates committed delta chains) leaves no dangling intra-cache
+        references. Only runs with no active transactions — the same
+        safety rule as GC: evicted state is the only state any future
+        reader can see."""
+        vcache = self._vertices.cache
+        ecache = self._edges.cache
+        if len(vcache) + len(ecache) <= self.cache_budget:
+            return
+        # under the engine lock: transactions begin under the same lock, so
+        # no txn can start between the active-check and the clear (a racing
+        # start would otherwise see a cached object later replaced by a
+        # fresh load — an object-identity split)
+        super().collect_garbage()
+        with self._engine_lock:
+            if self._active_txns:
+                return
+            dirty = any(o.delta is not None for o in vcache.values()) or \
+                any(o.delta is not None for o in ecache.values())
+            if dirty:
+                return
+            vcache.clear()
+            ecache.clear()
+
+    # ------------------------------------------------------------------
+    # meta persistence + recovery
+    # ------------------------------------------------------------------
+
+    def _save_meta_locked(self) -> None:
+        meta = {
+            "next_vertex_gid": self._next_vertex_gid,
+            "next_edge_gid": self._next_edge_gid,
+            "timestamp": self._timestamp,
+            "labels": self.label_mapper.to_dict(),
+            "properties": self.property_mapper.to_dict(),
+            "edge_types": self.edge_type_mapper.to_dict(),
+        }
+        self._conn.execute(
+            "INSERT OR REPLACE INTO meta VALUES ('meta', ?)",
+            (json.dumps(meta),))
+
+    def _load_meta(self) -> None:
+        with self._sql_lock:
+            row = self._conn.execute(
+                "SELECT value FROM meta WHERE key='meta'").fetchone()
+        if not row:
+            return
+        meta = json.loads(row[0])
+        self._next_vertex_gid = meta["next_vertex_gid"]
+        self._next_edge_gid = meta["next_edge_gid"]
+        self._timestamp = max(self._timestamp, meta["timestamp"])
+        self.label_mapper.load_dict(meta["labels"])
+        self.property_mapper.load_dict(meta["properties"])
+        self.edge_type_mapper.load_dict(meta["edge_types"])
+
+    def close(self) -> None:
+        with self._sql_lock:
+            self._conn.close()
+
+    def info(self) -> dict:
+        base = super().info()
+        base["storage_mode"] = StorageMode.ON_DISK_TRANSACTIONAL.value
+        base["disk_cache_objects"] = (len(self._vertices.cache)
+                                      + len(self._edges.cache))
+        with self._sql_lock:
+            base["disk_bytes"] = os.path.getsize(self._db_path) \
+                if os.path.exists(self._db_path) else 0
+        return base

@@ -47,6 +47,18 @@ class NameIdMapper:
     def maybe_name_to_id(self, name: str) -> int | None:
         return self._name_to_id.get(name)
 
+    def to_dict(self) -> dict[str, int]:
+        """Snapshot for persistence (disk mode metadata)."""
+        with self._lock:
+            return dict(self._name_to_id)
+
+    def load_dict(self, mapping: dict[str, int]) -> None:
+        """Restore from a to_dict() snapshot (ids must be dense from 0)."""
+        with self._lock:
+            items = sorted(mapping.items(), key=lambda kv: kv[1])
+            self._id_to_name = [name for name, _ in items]
+            self._name_to_id = dict(mapping)
+
     def __len__(self) -> int:
         return len(self._id_to_name)
 
