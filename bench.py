@@ -95,6 +95,52 @@ def stage_probe():
     print(json.dumps({"devices": [str(d) for d in ds], "sum": s}))
 
 
+def stage_pagerank_mxu(n_nodes, n_edges, seed, out_path):
+    """Gather-free MXU kernel (ops/spmv_mxu.py): plan from cache or fresh,
+    run 50 fixed iterations on the device."""
+    from memgraph_tpu.ops import spmv_mxu
+    import jax
+    import jax.numpy as jnp
+
+    src, dst = generate_graph(n_nodes, n_edges, seed)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    cache = os.path.join(cache_dir,
+                         f"mxu_plan_{n_nodes}_{n_edges}_{seed}.npz")
+    t0 = time.perf_counter()
+    plan = spmv_mxu.load_plan(cache) if os.path.exists(cache) else None
+    if plan is None or plan.n_nodes != n_nodes:
+        plan = spmv_mxu.build_plan(src, dst, None, n_nodes)
+        try:
+            spmv_mxu.save_plan(plan, cache)
+        except OSError:
+            pass
+    plan_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    run = spmv_mxu.make_pagerank_kernel(plan)
+    node_flat = plan.G * spmv_mxu.SG_ROWS * spmv_mxu.LANES
+    rank0_np = np.zeros(node_flat, dtype=np.float32)
+    rank0_np[plan.out_relabel] = 1.0 / n_nodes
+    rank0 = jnp.asarray(rank0_np)
+    # compile + warm (excluded); 1-element host transfer forces completion
+    rank, err, iters = run(rank0, jnp.float32(DAMPING), ITERATIONS,
+                           jnp.float32(0.0))
+    _ = float(rank[0])
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    rank, err, iters = run(rank0, jnp.float32(DAMPING), ITERATIONS,
+                           jnp.float32(0.0))
+    _ = float(rank[0])
+    elapsed = time.perf_counter() - t0
+    assert int(iters) == ITERATIONS, f"expected {ITERATIONS}, ran {int(iters)}"
+    ranks = np.asarray(rank)[plan.out_relabel]
+    np.savez(out_path, ranks=ranks, elapsed=elapsed,
+             export_s=plan_s + warm_s,
+             platform=jax.devices()[0].platform)
+
+
 def stage_pagerank(n_nodes, n_edges, seed, out_path):
     """CSR export + device PageRank; writes ranks + timings to out_path."""
     from memgraph_tpu.ops import csr
@@ -255,29 +301,32 @@ def main():
     # jax-CPU at full size — the driver must always get a nonzero number
     ladder = []
     if device_ok:
-        ladder.append(("axon", N_NODES, N_EDGES, STAGE_TIMEOUT_SEC))
-        ladder.append(("axon", N_NODES // 10, N_EDGES // 10, 120))
-    ladder.append(("cpu", N_NODES, N_EDGES, STAGE_TIMEOUT_SEC))
+        ladder.append(("axon", "pagerank_mxu", N_NODES, N_EDGES,
+                       STAGE_TIMEOUT_SEC))
+        ladder.append(("axon", "pagerank", N_NODES, N_EDGES,
+                       STAGE_TIMEOUT_SEC))
+        ladder.append(("axon", "pagerank", N_NODES // 10, N_EDGES // 10, 120))
+    ladder.append(("cpu", "pagerank", N_NODES, N_EDGES, STAGE_TIMEOUT_SEC))
 
     result = None
-    for platform, n_nodes, n_edges, budget in ladder:
+    for platform, stage, n_nodes, n_edges, budget in ladder:
         remaining = MASTER_TIMEOUT_SEC - (time.perf_counter() - t_bench) - 15
         if remaining < 35:
             log("  out of time budget; stopping the ladder")
             break
         budget = min(budget, int(remaining))
-        log(f"pagerank stage: platform={platform} edges={n_edges:,} "
+        log(f"{stage} stage: platform={platform} edges={n_edges:,} "
             f"budget={budget}s ...")
         with tempfile.NamedTemporaryFile(suffix=".npz") as tf:
             rc, _ = _run_stage(
-                ["--stage", "pagerank", str(n_nodes), str(n_edges), "7",
+                ["--stage", stage, str(n_nodes), str(n_edges), "7",
                  tf.name], _stage_env(platform), budget)
             if rc != 0:
                 log(f"  stage failed (rc={rc}); falling back")
                 continue
             data = np.load(tf.name)
             result = {
-                "platform": str(data["platform"]),
+                "platform": str(data["platform"]), "kernel": stage,
                 "n_nodes": n_nodes, "n_edges": n_edges,
                 "ranks": data["ranks"], "elapsed": float(data["elapsed"]),
                 "export_s": float(data["export_s"]),
@@ -312,6 +361,7 @@ def main():
     })
     PARTIAL["extra"] = {
         "device_platform": result["platform"],
+        "kernel": result["kernel"],
         "bench_edges": result["n_edges"],
         "device_seconds_50iter": round(result["elapsed"], 4),
         "cpu_seconds_50iter": round(cpu_time, 4),
@@ -350,6 +400,9 @@ if __name__ == "__main__":
         elif stage == "pagerank":
             stage_pagerank(int(sys.argv[3]), int(sys.argv[4]),
                            int(sys.argv[5]), sys.argv[6])
+        elif stage == "pagerank_mxu":
+            stage_pagerank_mxu(int(sys.argv[3]), int(sys.argv[4]),
+                               int(sys.argv[5]), sys.argv[6])
         elif stage == "latency":
             stage_latency(sys.argv[3])
         else:

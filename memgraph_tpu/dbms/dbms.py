@@ -45,6 +45,11 @@ class DbmsHandler:
                 cfg.durability_dir = os.path.join(
                     self._root_config.durability_dir, "databases", name)
             os.makedirs(cfg.durability_dir, exist_ok=True)
+            marker = os.path.join(cfg.durability_dir, "STORAGE_MODE")
+            if os.path.exists(marker):
+                from ..storage.common import StorageMode
+                with open(marker, encoding="utf-8") as f:
+                    cfg.storage_mode = StorageMode(f.read().strip())
         return cfg
 
     def _make(self, name: str):

@@ -1036,15 +1036,17 @@ class Interpreter:
         """Replace ctx.storage with a fresh engine of the target mode (only
         reachable on an empty database)."""
         import dataclasses
+        import os as _os
         from ..storage import InMemoryStorage
         from ..storage.common import StorageMode as SM
         from ..storage.disk_storage import DiskStorage
         old = self.ctx.storage
         cfg = dataclasses.replace(old.config, storage_mode=target)
+        if not cfg.durability_dir:
+            raise QueryException(
+                "switching to/from ON_DISK_TRANSACTIONAL requires the "
+                "server to run with a data directory")
         if target is SM.ON_DISK_TRANSACTIONAL:
-            if not cfg.durability_dir:
-                import tempfile
-                cfg.durability_dir = tempfile.mkdtemp(prefix="mg_disk_")
             new = DiskStorage(cfg)
             if len(new._vertices) or len(new._edges):
                 new.close()
@@ -1059,6 +1061,12 @@ class Interpreter:
             new.label_mapper = old.label_mapper
             new.property_mapper = old.property_mapper
             new.edge_type_mapper = old.edge_type_mapper
+        if isinstance(old, DiskStorage):
+            old.close()
+        # persist the choice so restarts come back in the same mode
+        marker = _os.path.join(cfg.durability_dir, "STORAGE_MODE")
+        with open(marker, "w", encoding="utf-8") as f:
+            f.write(target.value)
         self.ctx.storage = new
         if getattr(self.ctx, "dbms", None) is not None:
             self.ctx.dbms._databases[self.ctx.database_name] = self.ctx

@@ -144,39 +144,50 @@ class _PagedTable:
 
 
 class DiskAccessor(Accessor):
-    """Accessor that hydrates paged objects before every state read/write."""
+    """Accessor that re-resolves objects to their CANONICAL (and hydrated)
+    instance before every state read/write.
+
+    Stale references — index buckets, adjacency triples, or accessors held
+    across an eviction — are thereby re-pointed at the authoritative object
+    on each use, so eviction can never serve stale state or lose a write.
+    Within one transaction objects stay canonical (eviction only runs with
+    no active transactions)."""
+
+    def _canon_v(self, vertex):
+        c = self.storage._vertices.get(vertex.gid)
+        return self.storage._hydrated(c) if c is not None else vertex
+
+    def _canon_e(self, edge):
+        c = self.storage._edges.get(edge.gid)
+        return self.storage._hydrated(c) if c is not None else edge
 
     def _vertex_state(self, vertex, view):
-        self.storage._hydrated(vertex)
-        return super()._vertex_state(vertex, view)
+        return super()._vertex_state(self._canon_v(vertex), view)
 
     def _edge_state(self, edge, view):
-        self.storage._hydrated(edge)
-        return super()._edge_state(edge, view)
+        return super()._edge_state(self._canon_e(edge), view)
 
     def _vertex_add_label(self, vertex, label_id):
-        self.storage._hydrated(vertex)
-        return super()._vertex_add_label(vertex, label_id)
+        return super()._vertex_add_label(self._canon_v(vertex), label_id)
 
     def _vertex_remove_label(self, vertex, label_id):
-        self.storage._hydrated(vertex)
-        return super()._vertex_remove_label(vertex, label_id)
+        return super()._vertex_remove_label(self._canon_v(vertex), label_id)
 
     def _vertex_set_property(self, vertex, prop_id, value):
-        self.storage._hydrated(vertex)
-        return super()._vertex_set_property(vertex, prop_id, value)
+        return super()._vertex_set_property(self._canon_v(vertex), prop_id,
+                                            value)
 
     def _edge_set_property(self, edge, prop_id, value):
-        self.storage._hydrated(edge)
-        return super()._edge_set_property(edge, prop_id, value)
+        return super()._edge_set_property(self._canon_e(edge), prop_id,
+                                          value)
 
     def create_edge(self, from_va, to_va, edge_type_id):
-        self.storage._hydrated(from_va.vertex)
-        self.storage._hydrated(to_va.vertex)
+        from_va.vertex = self._canon_v(from_va.vertex)
+        to_va.vertex = self._canon_v(to_va.vertex)
         return super().create_edge(from_va, to_va, edge_type_id)
 
     def delete_vertex(self, va, detach=False):
-        self.storage._hydrated(va.vertex)
+        va.vertex = self._canon_v(va.vertex)
         for (_, other, edge) in list(va.vertex.in_edges) + \
                 list(va.vertex.out_edges):
             self.storage._hydrated(other)
@@ -184,7 +195,7 @@ class DiskAccessor(Accessor):
         return super().delete_vertex(va, detach=detach)
 
     def delete_edge(self, ea):
-        self.storage._hydrated(ea.edge)
+        ea.edge = self._canon_e(ea.edge)
         self.storage._hydrated(ea.edge.from_vertex)
         self.storage._hydrated(ea.edge.to_vertex)
         return super().delete_edge(ea)
@@ -206,12 +217,16 @@ class DiskStorage(InMemoryStorage):
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._sql_lock = threading.RLock()
         with self._sql_lock, self._conn:
+            # ts = commit timestamp of the row; rows apply only in ts
+            # order (conditional upsert) so late out-of-order persists from
+            # concurrent committers cannot clobber newer state. Deletes are
+            # NULL-data tombstones for the same reason.
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS vertices "
-                "(gid INTEGER PRIMARY KEY, data BLOB)")
+                "(gid INTEGER PRIMARY KEY, data BLOB, ts INTEGER)")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS edges "
-                "(gid INTEGER PRIMARY KEY, data BLOB)")
+                "(gid INTEGER PRIMARY KEY, data BLOB, ts INTEGER)")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS meta "
                 "(key TEXT PRIMARY KEY, value TEXT)")
@@ -254,7 +269,7 @@ class DiskStorage(InMemoryStorage):
             cur = self._conn.execute(
                 f"SELECT data FROM {table} WHERE gid=?", (gid,))
             row = cur.fetchone()
-        return row[0] if row else None
+        return row[0] if row else None   # tombstones have data NULL
 
     def _load_stub(self, kind: str, gid: int):
         """Create (unhydrated) canonical object for a backing row."""
@@ -328,7 +343,8 @@ class DiskStorage(InMemoryStorage):
     def _backing_gids(self, kind: str) -> list[int]:
         table = "vertices" if kind == "v" else "edges"
         with self._sql_lock:
-            rows = self._conn.execute(f"SELECT gid FROM {table}").fetchall()
+            rows = self._conn.execute(
+                f"SELECT gid FROM {table} WHERE data IS NOT NULL").fetchall()
         return [r[0] for r in rows]
 
     def _count(self, kind: str, cached: int) -> int:
@@ -337,13 +353,20 @@ class DiskStorage(InMemoryStorage):
         contract of approx_vertex_count."""
         table = "vertices" if kind == "v" else "edges"
         cache = (self._vertices if kind == "v" else self._edges).cache
+        pending = [gid for gid, obj in list(cache.items())
+                   if obj.delta is not None and not obj.deleted
+                   and not isinstance(obj, (PagedVertex, PagedEdge))]
         with self._sql_lock:
             n = self._conn.execute(
-                f"SELECT COUNT(*) FROM {table}").fetchone()[0]
-        extra = sum(1 for gid, obj in list(cache.items())
-                    if obj.delta is not None and not obj.deleted
-                    and not isinstance(obj, (PagedVertex, PagedEdge))
-                    and self._row(kind, gid) is None)
+                f"SELECT COUNT(*) FROM {table} WHERE data IS NOT NULL"
+            ).fetchone()[0]
+            extra = len(pending)
+            for i in range(0, len(pending), 500):
+                chunk = pending[i:i + 500]
+                marks = ",".join("?" * len(chunk))
+                extra -= self._conn.execute(
+                    f"SELECT COUNT(*) FROM {table} WHERE data IS NOT NULL "
+                    f"AND gid IN ({marks})", chunk).fetchone()[0]
         return n + extra
 
     # ------------------------------------------------------------------
@@ -366,32 +389,29 @@ class DiskStorage(InMemoryStorage):
         as_of = _AsOf(commit_ts)
         # encode OUTSIDE _sql_lock: materialize takes object locks, and
         # hydration's lock order is object lock -> _sql_lock
-        v_rows, v_dels, e_rows, e_dels = [], [], [], []
+        v_rows, e_rows = [], []
         for gid, v in touched_v.items():
             st = materialize_vertex(v, as_of, View.OLD)
             if st.deleted or not st.exists:
-                v_dels.append((gid,))
+                v_rows.append((gid, None, commit_ts))      # tombstone
             else:
-                v_rows.append((gid, self._encode_state_vertex(st)))
+                v_rows.append((gid, self._encode_state_vertex(st),
+                               commit_ts))
         for gid, e in touched_e.items():
             st = materialize_edge(e, as_of, View.OLD)
             if st.deleted or not st.exists:
-                e_dels.append((gid,))
+                e_rows.append((gid, None, commit_ts))
             else:
-                e_rows.append((gid, self._encode_state_edge(e, st)))
+                e_rows.append((gid, self._encode_state_edge(e, st),
+                               commit_ts))
+        upsert = ("INSERT INTO {t} (gid, data, ts) VALUES (?,?,?) "
+                  "ON CONFLICT(gid) DO UPDATE SET data=excluded.data, "
+                  "ts=excluded.ts WHERE excluded.ts >= {t}.ts")
         with self._sql_lock, self._conn:
-            if v_dels:
-                self._conn.executemany(
-                    "DELETE FROM vertices WHERE gid=?", v_dels)
             if v_rows:
-                self._conn.executemany(
-                    "INSERT OR REPLACE INTO vertices VALUES (?,?)", v_rows)
-            if e_dels:
-                self._conn.executemany(
-                    "DELETE FROM edges WHERE gid=?", e_dels)
+                self._conn.executemany(upsert.format(t="vertices"), v_rows)
             if e_rows:
-                self._conn.executemany(
-                    "INSERT OR REPLACE INTO edges VALUES (?,?)", e_rows)
+                self._conn.executemany(upsert.format(t="edges"), e_rows)
             # edge creation/deletion changes endpoint adjacency: those
             # endpoints are in touched_vertices by construction (create_edge
             # and delete_edge record both endpoints)

@@ -97,7 +97,10 @@ class VertexAccessor:
         return self.vertex.gid
 
     def __eq__(self, other):
-        return isinstance(other, VertexAccessor) and other.vertex is self.vertex
+        # gid equality, not object identity: the disk mode can re-load a
+        # fresh object for the same gid; gids are never reused
+        return isinstance(other, VertexAccessor) and \
+            other.vertex.gid == self.vertex.gid
 
     def __hash__(self):
         return hash(("v", self.vertex.gid))
@@ -130,7 +133,8 @@ class VertexAccessor:
         for (etype, other, edge) in st.in_edges:
             if edge_types is not None and etype not in edge_types:
                 continue
-            if from_vertex is not None and other is not from_vertex.vertex:
+            if from_vertex is not None and \
+                    other.gid != from_vertex.vertex.gid:
                 continue
             ea = EdgeAccessor(edge, self._acc)
             if ea.is_visible(view):
@@ -144,7 +148,7 @@ class VertexAccessor:
         for (etype, other, edge) in st.out_edges:
             if edge_types is not None and etype not in edge_types:
                 continue
-            if to_vertex is not None and other is not to_vertex.vertex:
+            if to_vertex is not None and other.gid != to_vertex.vertex.gid:
                 continue
             ea = EdgeAccessor(edge, self._acc)
             if ea.is_visible(view):
@@ -185,7 +189,8 @@ class EdgeAccessor:
         return self.edge.edge_type
 
     def __eq__(self, other):
-        return isinstance(other, EdgeAccessor) and other.edge is self.edge
+        return isinstance(other, EdgeAccessor) and \
+            other.edge.gid == self.edge.gid
 
     def __hash__(self):
         return hash(("e", self.edge.gid))
