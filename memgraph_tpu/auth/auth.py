@@ -49,6 +49,9 @@ class Role:
     name: str
     granted: set = field(default_factory=set)
     denied: set = field(default_factory=set)
+    # fine-grained: label/edge-type name (or "*") -> access level
+    fg_labels: dict = field(default_factory=dict)
+    fg_edge_types: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -58,6 +61,8 @@ class User:
     roles: list[str] = field(default_factory=list)
     granted: set = field(default_factory=set)
     denied: set = field(default_factory=set)
+    fg_labels: dict = field(default_factory=dict)
+    fg_edge_types: dict = field(default_factory=dict)
 
 
 class Auth:
@@ -222,6 +227,34 @@ class Auth:
                     target.denied.discard(p)
             self._save()
 
+    def grant_fine_grained(self, name: str, kind: str, items: list[str],
+                           level: str) -> None:
+        """kind: 'labels' | 'edge_types'; items may be ['*']."""
+        if level not in FG_LEVELS:
+            raise AuthException(f"unknown access level {level!r}")
+        with self._lock:
+            p = self._users.get(name) or self._roles.get(name)
+            if p is None:
+                raise AuthException(f"no such user or role {name!r}")
+            target = p.fg_labels if kind == "labels" else p.fg_edge_types
+            for item in items:
+                target[item] = level
+            self._save()
+
+    def revoke_fine_grained(self, name: str, kind: str,
+                            items: list[str]) -> None:
+        with self._lock:
+            p = self._users.get(name) or self._roles.get(name)
+            if p is None:
+                raise AuthException(f"no such user or role {name!r}")
+            target = p.fg_labels if kind == "labels" else p.fg_edge_types
+            for item in items:
+                target.pop(item, None)
+            self._save()
+
+    def fine_grained_checker(self, username: str) -> "FineGrainedChecker":
+        return FineGrainedChecker(self, username)
+
     def has_privilege(self, user_name: str, privilege: str) -> bool:
         with self._lock:
             if not self._users:
@@ -232,16 +265,64 @@ class Auth:
 
     # --- durability ---------------------------------------------------------
 
+    def to_dict(self) -> dict:
+        """Full-state dump for system replication (reference analog: the
+        ordered auth system txns of src/system/transaction.cpp; the store
+        is small, so full-state transfer is idempotent and order-safe)."""
+        with self._lock:
+            return self._dump_locked()
+
+    def apply_dict(self, data: dict) -> None:
+        """Replace contents with a to_dict() dump (replica apply)."""
+        with self._lock:
+            self._users.clear()
+            self._roles.clear()
+            self._load_data(data)
+            self._save()
+
+    def _dump_locked(self) -> dict:
+        return {
+            "users": [{"name": u.name, "password_hash": u.password_hash,
+                       "roles": u.roles, "granted": sorted(u.granted),
+                       "denied": sorted(u.denied),
+                       "fg_labels": u.fg_labels,
+                       "fg_edge_types": u.fg_edge_types}
+                      for u in self._users.values()],
+            "roles": [{"name": r.name, "granted": sorted(r.granted),
+                       "denied": sorted(r.denied),
+                       "fg_labels": r.fg_labels,
+                       "fg_edge_types": r.fg_edge_types}
+                      for r in self._roles.values()],
+        }
+
+    def _load_data(self, data: dict) -> None:
+        for u in data.get("users", []):
+            self._users[u["name"]] = User(
+                u["name"], u.get("password_hash"), u.get("roles", []),
+                set(u.get("granted", [])), set(u.get("denied", [])),
+                dict(u.get("fg_labels", {})),
+                dict(u.get("fg_edge_types", {})))
+        for r in data.get("roles", []):
+            self._roles[r["name"]] = Role(
+                r["name"], set(r.get("granted", [])),
+                set(r.get("denied", [])),
+                dict(r.get("fg_labels", {})),
+                dict(r.get("fg_edge_types", {})))
+
     def _save(self) -> None:
         if not self._path:
             return
         data = {
             "users": [{"name": u.name, "password_hash": u.password_hash,
                        "roles": u.roles, "granted": sorted(u.granted),
-                       "denied": sorted(u.denied)}
+                       "denied": sorted(u.denied),
+                       "fg_labels": u.fg_labels,
+                       "fg_edge_types": u.fg_edge_types}
                       for u in self._users.values()],
             "roles": [{"name": r.name, "granted": sorted(r.granted),
-                       "denied": sorted(r.denied)}
+                       "denied": sorted(r.denied),
+                       "fg_labels": r.fg_labels,
+                       "fg_edge_types": r.fg_edge_types}
                       for r in self._roles.values()],
         }
         tmp = self._path + ".tmp"
@@ -252,14 +333,88 @@ class Auth:
     def _load(self) -> None:
         with open(self._path) as f:
             data = json.load(f)
-        for u in data.get("users", []):
-            self._users[u["name"]] = User(
-                u["name"], u.get("password_hash"), u.get("roles", []),
-                set(u.get("granted", [])), set(u.get("denied", [])))
-        for r in data.get("roles", []):
-            self._roles[r["name"]] = Role(
-                r["name"], set(r.get("granted", [])),
-                set(r.get("denied", [])))
+        self._load_data(data)
+
+
+# --- fine-grained (label-based) access -------------------------------------
+# Reference: src/auth/models.cpp FineGrainedAccessPermissions — per-label /
+# per-edge-type levels NOTHING < READ < UPDATE < CREATE_DELETE, with "*"
+# as the global fallback rule.
+
+FG_LEVELS = {"NOTHING": 0, "READ": 1, "UPDATE": 2, "CREATE_DELETE": 3}
+
+
+class FineGrainedChecker:
+    """Resolved per-session view of a user's label/edge-type permissions.
+
+    Resolution per item: user-specific rule > user "*" > role-specific >
+    role "*". A principal with NO fine-grained rules anywhere is
+    unrestricted (fine-grained is opt-in, as in the reference); once any
+    rule exists, unmatched items default to NOTHING.
+    """
+
+    def __init__(self, auth: "Auth", username: str) -> None:
+        # kept as SEPARATE chains: a user's "*" rule must shadow a role's
+        # label-specific rule, which a flat merge cannot express
+        self._label_chain: list[dict] = []
+        self._etype_chain: list[dict] = []
+        with auth._lock:
+            user = auth._users.get(username)
+            if user is not None:
+                self._label_chain.append(
+                    {k: FG_LEVELS.get(v, 0) for k, v in user.fg_labels.items()})
+                self._etype_chain.append(
+                    {k: FG_LEVELS.get(v, 0)
+                     for k, v in user.fg_edge_types.items()})
+                for rn in user.roles:
+                    role = auth._roles.get(rn)
+                    if role is not None:
+                        self._label_chain.append(
+                            {k: FG_LEVELS.get(v, 0)
+                             for k, v in role.fg_labels.items()})
+                        self._etype_chain.append(
+                            {k: FG_LEVELS.get(v, 0)
+                             for k, v in role.fg_edge_types.items()})
+        self.restricted = any(self._label_chain) or any(self._etype_chain)
+        # flattened views for SHOW PRIVILEGES (resolution order preserved)
+        self._labels: dict[str, int] = {}
+        self._edge_types: dict[str, int] = {}
+        for keys, chain, out in (("l", self._label_chain, self._labels),
+                                 ("e", self._etype_chain, self._edge_types)):
+            for rules in chain:
+                for k in rules:
+                    out.setdefault(
+                        k, self._resolve(chain, k))
+
+    @staticmethod
+    def _resolve(chain: list[dict], name: str) -> int:
+        """First chain entry (user, then roles in order) that has either a
+        specific rule or a "*" rule decides."""
+        for rules in chain:
+            if name in rules:
+                return rules[name]
+            if "*" in rules:
+                return rules["*"]
+        return 0
+
+    def label_level(self, name: str) -> int:
+        if not self.restricted:
+            return 3
+        return self._resolve(self._label_chain, name)
+
+    def edge_type_level(self, name: str) -> int:
+        if not self.restricted:
+            return 3
+        return self._resolve(self._etype_chain, name)
+
+    # vertex rules: the level of a vertex is the MINIMUM over its labels
+    # (an unlabeled vertex is unrestricted), matching the reference's
+    # FineGrainedAuthChecker vertex accumulation
+    def vertex_level(self, label_names) -> int:
+        level = 3
+        for name in label_names:
+            level = min(level, self.label_level(name))
+        return level
 
 
 _GLOBAL_AUTH: Auth | None = None

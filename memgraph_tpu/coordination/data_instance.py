@@ -48,7 +48,7 @@ class DataInstanceManagementServer:
     def _replication(self):
         from ..replication.main_role import ReplicationState
         if getattr(self.ictx, "replication", None) is None:
-            self.ictx.replication = ReplicationState(self.ictx.storage)
+            self.ictx.replication = ReplicationState(self.ictx.storage, ictx=self.ictx)
         return self.ictx.replication
 
     def _loop(self) -> None:

@@ -505,3 +505,6 @@ class AuthQuery:
     password: Optional[object] = None
     role: Optional[str] = None
     privileges: list[str] = field(default_factory=list)
+    fg_kind: Optional[str] = None       # labels | edge_types
+    fg_items: list[str] = field(default_factory=list)
+    fg_level: Optional[str] = None      # READ | UPDATE | CREATE_DELETE | NOTHING

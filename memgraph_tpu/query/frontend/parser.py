@@ -280,7 +280,24 @@ class Parser:
             return A.EnumQuery("add_value", name, [self.name_token()])
         if self.at_kw("GRANT") or self.at_kw("DENY"):
             action = self.advance().value.lower()
-            privs = [self.name_token().upper()]
+            first = self.name_token().upper()
+            # fine-grained: GRANT <LEVEL> ON LABELS :a, :b | * TO name
+            # (reference grammar: MemgraphCypher.g4 grantPrivilege with
+            # READ/UPDATE/CREATE_DELETE/NOTHING ON LABELS/EDGE_TYPES)
+            if first in ("READ", "UPDATE", "CREATE_DELETE", "NOTHING") \
+                    and self.at_kw("ON"):
+                self.advance()
+                kind_tok = self.name_token().upper()
+                if kind_tok not in ("LABELS", "EDGE_TYPES"):
+                    self.error("expected LABELS or EDGE_TYPES")
+                items = self.parse_fg_items()
+                self.expect_kw("TO")
+                target = self.name_token()
+                level = "NOTHING" if action == "deny" else first
+                return A.AuthQuery("grant_fine_grained", user=target,
+                                   fg_kind=kind_tok.lower(),
+                                   fg_items=items, fg_level=level)
+            privs = [first]
             if privs == ["ALL"]:
                 self.accept_kw("PRIVILEGES")
             while self.accept(","):
@@ -290,7 +307,19 @@ class Parser:
             return A.AuthQuery(action, user=target, privileges=privs)
         if self.at_kw("REVOKE"):
             self.advance()
-            privs = [self.name_token().upper()]
+            first = self.name_token().upper()
+            if first in ("READ", "UPDATE", "CREATE_DELETE", "NOTHING") \
+                    and self.at_kw("ON"):
+                self.advance()
+                kind_tok = self.name_token().upper()
+                if kind_tok not in ("LABELS", "EDGE_TYPES"):
+                    self.error("expected LABELS or EDGE_TYPES")
+                items = self.parse_fg_items()
+                self.expect_kw("FROM")
+                target = self.name_token()
+                return A.AuthQuery("revoke_fine_grained", user=target,
+                                   fg_kind=kind_tok.lower(), fg_items=items)
+            privs = [first]
             if privs == ["ALL"]:
                 self.accept_kw("PRIVILEGES")
             while self.accept(","):
@@ -654,6 +683,17 @@ class Parser:
         # Parser doesn't retain source by default; tokenizer pos is enough
         # only if the caller provided it. parse() wires it below.
         return self._source[start:].rstrip("; \n\t") if self._source else ""
+
+    def parse_fg_items(self) -> list:
+        if self.accept("*"):
+            return ["*"]
+        items = []
+        self.expect(":")
+        items.append(self.name_token())
+        while self.accept(","):
+            self.expect(":")
+            items.append(self.name_token())
+        return items
 
     def parse_auth(self) -> A.AuthQuery:
         first = self.advance()  # CREATE/DROP/SET

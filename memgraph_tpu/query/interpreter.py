@@ -408,10 +408,12 @@ class Interpreter:
                 "automatically by the server entry point)")
         if node.action == "create":
             dbms.create(node.name)
+            self._publish_system("db_create", {"name": node.name})
             return self._prepare_generator(
                 iter([[f"Database {node.name} created."]]), ["status"], "s")
         if node.action == "drop":
             dbms.drop(node.name)
+            self._publish_system("db_drop", {"name": node.name})
             return self._prepare_generator(
                 iter([[f"Database {node.name} dropped."]]), ["status"], "s")
         if node.action == "use":
@@ -468,6 +470,20 @@ class Interpreter:
             runner.stop()
         return self._prepare_generator(iter([]), [], "s")
 
+    def _fine_grained_view(self):
+        """Storage-level fine-grained filter for this session's user, or
+        None when unrestricted (reference: glue/auth_checker.cpp building a
+        FineGrainedAuthChecker per execution)."""
+        from ..auth.auth import resolve_auth
+        auth = resolve_auth(self.ctx)
+        if not auth.users():
+            return None
+        checker = auth.fine_grained_checker(self.username or "")
+        if not checker.restricted:
+            return None
+        from ..auth.fine_grained import FgStorageView
+        return FgStorageView(checker, self.ctx.storage)
+
     def _auth_store(self):
         from ..auth.auth import resolve_auth
         return resolve_auth(self.ctx)
@@ -508,7 +524,7 @@ class Interpreter:
     def _replication_state(self):
         if getattr(self.ctx, "replication", None) is None:
             from ..replication.main_role import ReplicationState
-            self.ctx.replication = ReplicationState(self.ctx.storage)
+            self.ctx.replication = ReplicationState(self.ctx.storage, ictx=self.ctx)
         return self.ctx.replication
 
     def _prepare_replication(self, node: A.ReplicationQuery) -> PreparedQuery:
@@ -584,7 +600,7 @@ class Interpreter:
             if self._in_explicit_txn:
                 raise TransactionException(
                     "nested transactions are not supported")
-            self._explicit_accessor = self.ctx.storage.access(
+            self._explicit_accessor = self._fg_access(
                 self._pick_isolation())
             self._in_explicit_txn = True
             return self._prepare_generator(iter([]), [], "w")
@@ -605,6 +621,11 @@ class Interpreter:
             self._in_explicit_txn = False
             return self._prepare_generator(iter([]), [], "w")
         raise SemanticException(f"unknown transaction action {node.action}")
+
+    def _fg_access(self, isolation=None):
+        acc = self.ctx.storage.access(isolation)
+        acc.fine_grained = self._fine_grained_view()
+        return acc
 
     def _pick_isolation(self) -> IsolationLevel:
         if self.next_isolation is not None:
@@ -648,7 +669,7 @@ class Interpreter:
             accessor = self._explicit_accessor
             owns = False
         else:
-            accessor = self.ctx.storage.access(self._pick_isolation())
+            accessor = self._fg_access(self._pick_isolation())
             owns = True
 
         self._abort_flag = threading.Event()
@@ -1107,6 +1128,11 @@ class Interpreter:
             auth.deny(node.user, node.privileges)
         elif node.action == "revoke":
             auth.revoke(node.user, node.privileges)
+        elif node.action == "grant_fine_grained":
+            auth.grant_fine_grained(node.user, node.fg_kind, node.fg_items,
+                                    node.fg_level)
+        elif node.action == "revoke_fine_grained":
+            auth.revoke_fine_grained(node.user, node.fg_kind, node.fg_items)
         elif node.action == "show_users":
             return self._prepare_generator(
                 iter([[u] for u in auth.users()]), ["user"], "r")
@@ -1116,11 +1142,30 @@ class Interpreter:
         elif node.action == "show_privileges":
             rows = [[p, eff] for p, eff
                     in auth.effective_privileges(node.user)]
+            checker = auth.fine_grained_checker(node.user)
+            if checker.restricted:
+                from ..auth.auth import FG_LEVELS
+                inv = {v: k for k, v in FG_LEVELS.items()}
+                for lbl, lv in sorted(checker._labels.items()):
+                    rows.append([f"LABEL :{lbl}" if lbl != "*"
+                                 else "LABEL *", inv[lv]])
+                for et, lv in sorted(checker._edge_types.items()):
+                    rows.append([f"EDGE_TYPE :{et}" if et != "*"
+                                 else "EDGE_TYPE *", inv[lv]])
             return self._prepare_generator(
                 iter(rows), ["privilege", "effective"], "r")
         else:
             raise SemanticException(f"unknown auth action {node.action}")
+        # mutations replicate as ordered system transactions (reference:
+        # src/system/transaction.cpp — auth + multi-DB DDL must survive
+        # failover); full-state dumps keep replays idempotent
+        self._publish_system("auth", auth.to_dict())
         return self._prepare_generator(iter([]), [], "s")
+
+    def _publish_system(self, kind: str, data: dict) -> None:
+        replication = getattr(self.ctx, "replication", None)
+        if replication is not None and replication.role == "main":
+            replication.publish_system(kind, data)
 
     # --- helpers ------------------------------------------------------------
 
@@ -1144,6 +1189,7 @@ class _TxnOwner:
         exec_ctx = self._exec_ctx
         exec_ctx.accessor.commit()
         new_acc = interp.ctx.storage.access(interp._pick_isolation())
+        new_acc.fine_grained = exec_ctx.accessor.fine_grained
         exec_ctx.accessor = new_acc
         exec_ctx.eval_ctx.accessor = new_acc
         interp._stream_accessor = new_acc

@@ -64,6 +64,7 @@ class ReplicaClient:
         # frames committed while catch-up is in flight buffer here; the
         # replica dedups by commit_ts, so replay overlap is harmless
         self._catchup_buffer: list[bytes] = []
+        self._catchup_system: list[dict] = []
 
     # --- connection / catch-up ----------------------------------------------
 
@@ -87,6 +88,14 @@ class ReplicaClient:
             if msg_type != P.MSG_ACK:
                 raise ConnectionError("snapshot transfer failed")
             self.last_acked_ts = P.parse_json(payload)["last_commit_ts"]
+        # system-state catch-up: full auth + database list (idempotent)
+        state_provider = getattr(self, "system_state_provider", None)
+        if state_provider is not None:
+            full = state_provider()
+            if full:
+                with self._lock:
+                    self._send_system_locked({"seq": 0, "kind": "full",
+                                              "data": full})
         # drain anything committed while catch-up ran, then go live; the
         # status flip and the drain share the lock so no frame slips between
         with self._lock:
@@ -94,6 +103,9 @@ class ReplicaClient:
             self._catchup_buffer = []
             for frame in buffered:
                 self._send_frame_locked(frame)
+            for txn in self._catchup_system:
+                self._send_system_locked(txn)
+            self._catchup_system = []
             self.status = ReplicaStatus.READY
         if self.mode is ReplicationMode.ASYNC:
             self._worker = threading.Thread(target=self._drain_loop,
@@ -144,6 +156,25 @@ class ReplicaClient:
     def _send_frame_sync(self, frame: bytes) -> bool:
         with self._lock:
             return self._send_frame_locked(frame)
+
+    def send_system(self, txn: dict) -> bool:
+        with self._lock:
+            if self.status is ReplicaStatus.RECOVERY:
+                # published mid-catch-up: the full dump may have been built
+                # before this txn; buffer it to drain before going live
+                self._catchup_system.append(txn)
+                return True
+            return self._send_system_locked(txn)
+
+    def _send_system_locked(self, txn: dict) -> bool:
+        try:
+            P.send_json(self._sock, P.MSG_SYSTEM, txn)
+            msg_type, _ = P.recv_frame(self._sock)
+            return msg_type == P.MSG_ACK
+        except (ConnectionError, OSError) as e:
+            log.warning("replica %s system txn failed: %s", self.name, e)
+            self.status = ReplicaStatus.INVALID
+            return False
 
     def _send_frame_locked(self, frame: bytes) -> bool:
         try:
@@ -263,9 +294,11 @@ class ReplicationState:
 
     HEARTBEAT_INTERVAL_SEC = 2.0
 
-    def __init__(self, storage):
+    def __init__(self, storage, ictx=None):
         self.storage = storage
+        self.ictx = ictx           # system-state source (auth, dbms)
         self.role = "main"
+        self._system_seq = 0
         self.replicas: dict[str, ReplicaClient] = {}
         self.replica_server = None
         self._lock = threading.Lock()
@@ -308,7 +341,8 @@ class ReplicationState:
             if self.replica_server is not None:
                 self.replica_server.stop()
                 self.replica_server = None
-            server = ReplicaServer(self.storage, host, port)
+            server = ReplicaServer(self.storage, host, port,
+                                   ictx=self.ictx)
             try:
                 server.start()
             except OSError as e:
@@ -332,6 +366,7 @@ class ReplicationState:
         if self.role != "main":
             raise QueryException("only MAIN can register replicas")
         client = ReplicaClient(name, address, mode, self.storage)
+        client.system_state_provider = self.system_state
         with self._lock:
             if name in self.replicas:
                 raise QueryException(f"replica {name!r} already registered")
@@ -385,6 +420,40 @@ class ReplicationState:
             rows.append([c.name, c.address, c.mode.value,
                          c.last_acked_ts, c.status.value])
         return rows
+
+    # --- system-state replication -------------------------------------------
+
+    def system_state(self) -> dict:
+        """Full system state for catch-up: auth dump + database names
+        (reference: the system txn log replayed at replica registration,
+        src/system/transaction.cpp)."""
+        out = {}
+        ictx = self.ictx
+        if ictx is not None:
+            auth = getattr(ictx, "auth_store", None)
+            if auth is not None:
+                out["auth"] = auth.to_dict()
+            dbms = getattr(ictx, "dbms", None)
+            if dbms is not None:
+                out["databases"] = dbms.names()
+        return out
+
+    def publish_system(self, kind: str, data: dict) -> None:
+        """Ship one ordered system transaction to every replica. Best
+        effort per replica (a failed replica is marked INVALID and will
+        receive the full state on re-registration)."""
+        if self.role != "main":
+            return
+        # the state lock covers assignment AND delivery: concurrent system
+        # mutations must reach each replica in seq order or the replica's
+        # dedup (seq <= last) would drop the earlier one. System txns are
+        # rare (admin DDL), so holding the lock across the sends is fine.
+        with self._lock:
+            self._system_seq += 1
+            txn = {"seq": self._system_seq, "kind": kind, "data": data}
+            for c in list(self.replicas.values()):
+                if c.status in (ReplicaStatus.READY, ReplicaStatus.RECOVERY):
+                    c.send_system(txn)
 
     # --- commit hook --------------------------------------------------------
 

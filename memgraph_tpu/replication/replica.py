@@ -24,10 +24,13 @@ log = logging.getLogger(__name__)
 class ReplicaServer:
     """Listens for the MAIN; applies snapshot + WAL frames to storage."""
 
-    def __init__(self, storage, host: str = "127.0.0.1", port: int = 10000):
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 10000,
+                 ictx=None):
         self.storage = storage
+        self.ictx = ictx           # for system-state apply (auth, multi-db)
         self.host = host
         self.port = port
+        self.last_system_seq = 0
         self.last_commit_ts = 0
         self.epoch = None
         self._sock: socket.socket | None = None
@@ -114,6 +117,11 @@ class ReplicaServer:
                         self._apply_wal_frame(frame)
                     P.send_json(conn, P.MSG_ACK,
                                 {"last_commit_ts": self.last_commit_ts})
+                elif msg_type == P.MSG_SYSTEM:
+                    self._apply_system(P.parse_json(payload))
+                    P.send_json(conn, P.MSG_ACK,
+                                {"last_commit_ts": self.last_commit_ts,
+                                 "system_seq": self.last_system_seq})
                 elif msg_type == P.MSG_HEARTBEAT:
                     P.send_json(conn, P.MSG_ACK,
                                 {"last_commit_ts": self.last_commit_ts})
@@ -146,6 +154,47 @@ class ReplicaServer:
                 self.storage._bump_topology()
             finally:
                 os.unlink(path)
+
+    def _apply_system(self, txn: dict) -> None:
+        """Apply an ordered system transaction (auth / multi-db DDL) —
+        the replica-side half of the reference's system::Transaction
+        (/root/reference/src/system/transaction.cpp). Deliveries are
+        full-state (auth) or idempotent DDL, so replays are harmless."""
+        seq = txn.get("seq", 0)
+        kind = txn.get("kind")
+        if kind == "full":
+            # a full-state dump re-baselines the sequence: a restarted MAIN
+            # starts its seq counter over
+            self.last_system_seq = 0
+        elif seq and seq <= self.last_system_seq:
+            return
+        data = txn.get("data") or {}
+        ictx = self.ictx
+        if kind in ("auth", "full") and ictx is not None:
+            auth = getattr(ictx, "auth_store", None)
+            if auth is None:
+                from ..auth.auth import Auth
+                auth = Auth()
+                ictx.auth_store = auth
+            dump = data.get("auth") if kind == "full" else data
+            if dump is not None:
+                auth.apply_dict(dump)
+        if kind in ("db_create", "db_drop", "full") and ictx is not None:
+            dbms = getattr(ictx, "dbms", None)
+            if dbms is not None:
+                if kind == "db_create":
+                    names = [data["name"]]
+                elif kind == "full":
+                    names = data.get("databases", [])
+                else:
+                    names = []
+                for name in names:
+                    if name not in dbms.names():
+                        dbms.create(name)
+                if kind == "db_drop" and data["name"] in dbms.names():
+                    dbms.drop(data["name"])
+        if seq:
+            self.last_system_seq = seq
 
     def _apply_wal_frame(self, frame: bytes) -> None:
         with self._apply_lock:

@@ -137,7 +137,7 @@ class VertexAccessor:
                     other.gid != from_vertex.vertex.gid:
                 continue
             ea = EdgeAccessor(edge, self._acc)
-            if ea.is_visible(view):
+            if ea.is_visible(view) and self._acc._fg_edge_ok(ea, view):
                 out.append(ea)
         return out
 
@@ -151,7 +151,7 @@ class VertexAccessor:
             if to_vertex is not None and other.gid != to_vertex.vertex.gid:
                 continue
             ea = EdgeAccessor(edge, self._acc)
-            if ea.is_visible(view):
+            if ea.is_visible(view) and self._acc._fg_edge_ok(ea, view):
                 out.append(ea)
         return out
 
@@ -224,6 +224,8 @@ class Accessor:
     Usable as a context manager; __exit__ aborts if not committed.
     """
 
+    fine_grained = None  # optional FgStorageView (auth/fine_grained.py)
+
     def __init__(self, storage: "InMemoryStorage",
                  isolation: IsolationLevel) -> None:
         self.storage = storage
@@ -287,6 +289,8 @@ class Accessor:
 
         Returns (deleted_vertex_accessor, deleted_edge_accessors) or raises.
         """
+        if self.fine_grained is not None:
+            self.fine_grained.check_vertex_delete(va.vertex.labels)
         vertex = va.vertex
         deleted_edges: list[EdgeAccessor] = []
         with vertex.lock:
@@ -321,6 +325,8 @@ class Accessor:
 
     def create_edge(self, from_va: VertexAccessor, to_va: VertexAccessor,
                     edge_type: int, gid: Optional[Gid] = None) -> EdgeAccessor:
+        if self.fine_grained is not None:
+            self.fine_grained.check_edge_create_delete(edge_type)
         storage = self.storage
         from_v, to_v = from_va.vertex, to_va.vertex
         with storage._gid_lock:
@@ -367,6 +373,8 @@ class Accessor:
         return EdgeAccessor(edge, self)
 
     def delete_edge(self, ea: EdgeAccessor):
+        if self.fine_grained is not None:
+            self.fine_grained.check_edge_create_delete(ea.edge.edge_type)
         edge = ea.edge
         from_v, to_v = edge.from_vertex, edge.to_vertex
         with edge.lock:
@@ -404,6 +412,8 @@ class Accessor:
     # --- vertex mutations (called through VertexAccessor) -------------------
 
     def _vertex_add_label(self, vertex: Vertex, label_id: int) -> bool:
+        if self.fine_grained is not None:
+            self.fine_grained.check_label_modify(label_id)
         with vertex.lock:
             if not self._analytical:
                 prepare_for_write(vertex, self.txn)
@@ -420,6 +430,8 @@ class Accessor:
         return True
 
     def _vertex_remove_label(self, vertex: Vertex, label_id: int) -> bool:
+        if self.fine_grained is not None:
+            self.fine_grained.check_label_modify(label_id)
         with vertex.lock:
             if not self._analytical:
                 prepare_for_write(vertex, self.txn)
@@ -435,6 +447,8 @@ class Accessor:
         return True
 
     def _vertex_set_property(self, vertex: Vertex, prop_id: int, value):
+        if self.fine_grained is not None:
+            self.fine_grained.check_vertex_update(vertex.labels)
         with vertex.lock:
             if not self._analytical:
                 prepare_for_write(vertex, self.txn)
@@ -453,6 +467,8 @@ class Accessor:
         return old
 
     def _edge_set_property(self, edge: Edge, prop_id: int, value):
+        if self.fine_grained is not None:
+            self.fine_grained.check_edge_update(edge.edge_type)
         if not self.storage.config.properties_on_edges:
             raise StorageError("properties on edges are disabled")
         with edge.lock:
@@ -498,30 +514,48 @@ class Accessor:
                     properties=dict(edge.properties))
         return materialize_edge(edge, txn, view)
 
-    def find_vertex(self, gid: Gid, view: View = View.NEW) -> Optional[VertexAccessor]:
+    def find_vertex(self, gid: Gid, view: View = View.NEW
+                    ) -> Optional[VertexAccessor]:
         vertex = self.storage._vertices.get(gid)
         if vertex is None:
             return None
         va = VertexAccessor(vertex, self)
-        return va if va.is_visible(view) else None
+        if not va.is_visible(view):
+            return None
+        return va if self._fg_vertex_ok(va, view) else None
 
     def find_edge(self, gid: Gid, view: View = View.NEW) -> Optional[EdgeAccessor]:
         edge = self.storage._edges.get(gid)
         if edge is None:
             return None
         ea = EdgeAccessor(edge, self)
-        return ea if ea.is_visible(view) else None
+        if not ea.is_visible(view):
+            return None
+        return ea if self._fg_edge_ok(ea, view) else None
+
+    def _fg_vertex_ok(self, va: "VertexAccessor", view: View) -> bool:
+        fg = self.fine_grained
+        return fg is None or fg.can_read_vertex(va._state(view).labels)
+
+    def _fg_edge_ok(self, ea: "EdgeAccessor", view: View) -> bool:
+        fg = self.fine_grained
+        if fg is None:
+            return True
+        if not fg.can_read_edge(ea.edge.edge_type):
+            return False
+        return fg.can_read_vertex(ea.from_vertex()._state(view).labels) and \
+            fg.can_read_vertex(ea.to_vertex()._state(view).labels)
 
     def vertices(self, view: View = View.OLD) -> Iterator[VertexAccessor]:
         for vertex in list(self.storage._vertices.values()):
             va = VertexAccessor(vertex, self)
-            if va.is_visible(view):
+            if va.is_visible(view) and self._fg_vertex_ok(va, view):
                 yield va
 
     def edges(self, view: View = View.OLD) -> Iterator[EdgeAccessor]:
         for edge in list(self.storage._edges.values()):
             ea = EdgeAccessor(edge, self)
-            if ea.is_visible(view):
+            if ea.is_visible(view) and self._fg_edge_ok(ea, view):
                 yield ea
 
     def vertices_by_label(self, label_id: int,
@@ -535,7 +569,8 @@ class Accessor:
             return
         for vertex in candidates:
             va = VertexAccessor(vertex, self)
-            if va.is_visible(view) and va.has_label(label_id, view):
+            if va.is_visible(view) and va.has_label(label_id, view) \
+                    and self._fg_vertex_ok(va, view):
                 yield va
 
     def vertices_by_label_property_value(self, label_id: int,
@@ -553,6 +588,8 @@ class Accessor:
         for vertex in candidates:
             va = VertexAccessor(vertex, self)
             if not va.is_visible(view) or not va.has_label(label_id, view):
+                continue
+            if not self._fg_vertex_ok(va, view):
                 continue
             props = va.properties(view)
             if all(props.get(p) == v for p, v in zip(prop_ids, values)):
@@ -579,6 +616,8 @@ class Accessor:
             va = VertexAccessor(vertex, self)
             if not va.is_visible(view) or not va.has_label(label_id, view):
                 continue
+            if not self._fg_vertex_ok(va, view):
+                continue
             val = va.get_property(prop_ids[0], view)
             if val is None:
                 continue
@@ -603,7 +642,7 @@ class Accessor:
             return
         for edge in candidates:
             ea = EdgeAccessor(edge, self)
-            if ea.is_visible(view):
+            if ea.is_visible(view) and self._fg_edge_ok(ea, view):
                 yield ea
 
     # --- counts for the planner ---------------------------------------------

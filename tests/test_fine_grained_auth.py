@@ -1,0 +1,158 @@
+"""Label-based fine-grained access control.
+
+Reference contract (src/auth/models.cpp FineGrainedAccessPermissions +
+FineGrainedAuthChecker): per-label / per-edge-type levels
+NOTHING < READ < UPDATE < CREATE_DELETE, "*" as global rule, user rules
+over role rules; vertices are gated by the minimum level over their
+labels; enforcement filters reads and rejects writes.
+"""
+
+import pytest
+
+from memgraph_tpu.auth.auth import Auth
+from memgraph_tpu.exceptions import AuthException
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def env():
+    ictx = InterpreterContext(InMemoryStorage())
+    ictx.auth_store = Auth()
+    admin = Interpreter(ictx)
+    ictx.auth_store.create_user("admin", "a")  # first user: all privileges
+    admin.username = "admin"
+    admin.execute("CREATE (:Public {v: 1})-[:LINK {w: 1}]->(:Secret {v: 2})")
+    admin.execute("CREATE (:Public {v: 3})")
+    return ictx, admin
+
+
+def _mk_user(ictx, admin, name, *grants):
+    admin.execute(f"CREATE USER {name} IDENTIFIED BY 'x'")
+    admin.execute(f"GRANT MATCH, CREATE, MERGE, SET, DELETE, REMOVE TO {name}")
+    for g in grants:
+        admin.execute(g)
+    u = Interpreter(ictx)
+    u.username = name
+    return u
+
+
+def _rows(interp, q):
+    _, rows, _ = interp.execute(q)
+    return rows
+
+
+class TestReadFiltering:
+    def test_label_read_filter(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "reader",
+                     "GRANT READ ON LABELS :Public TO reader")
+        vals = sorted(r[0] for r in _rows(u, "MATCH (n) RETURN n.v"))
+        assert vals == [1, 3]          # :Secret invisible
+        assert _rows(u, "MATCH (n:Secret) RETURN n.v") == []
+        # admin still sees everything
+        assert len(_rows(admin, "MATCH (n) RETURN n.v")) == 3
+
+    def test_expand_respects_labels(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "r2",
+                     "GRANT READ ON LABELS :Public TO r2",
+                     "GRANT READ ON EDGE_TYPES * TO r2")
+        # the LINK edge ends at :Secret — expansion must not reveal it
+        assert _rows(u, "MATCH (:Public)-[e]->(m) RETURN m.v") == []
+
+    def test_edge_type_filter(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "r3",
+                     "GRANT READ ON LABELS * TO r3")
+        # no edge-type rule at all means "*" fallback -> NOTHING for edges?
+        # no: only labels were restricted; edge map is empty so the global
+        # label restriction makes the checker restricted; edges default to
+        # NOTHING via "*" lookup on the empty edge map
+        assert _rows(u, "MATCH ()-[e]->() RETURN e.w") == []
+        admin.execute("GRANT READ ON EDGE_TYPES :LINK TO r3")
+        assert _rows(u, "MATCH ()-[e]->() RETURN e.w") == [[1]]
+
+    def test_wildcard_and_specific(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "r4",
+                     "GRANT READ ON LABELS * TO r4",
+                     "GRANT NOTHING ON LABELS :Secret TO r4")
+        vals = sorted(r[0] for r in _rows(u, "MATCH (n) RETURN n.v"))
+        assert vals == [1, 3]
+
+
+class TestWriteGates:
+    def test_update_requires_level(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "w1",
+                     "GRANT READ ON LABELS :Public TO w1")
+        with pytest.raises(AuthException):
+            u.execute("MATCH (n:Public) SET n.v = 99")
+        admin.execute("GRANT UPDATE ON LABELS :Public TO w1")
+        u.execute("MATCH (n:Public {v: 1}) SET n.v = 99")
+        assert sorted(r[0] for r in _rows(admin,
+                      "MATCH (n:Public) RETURN n.v")) == [3, 99]
+
+    def test_create_delete_label(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "w2",
+                     "GRANT UPDATE ON LABELS :Public TO w2")
+        with pytest.raises(AuthException):
+            u.execute("CREATE (:Public {v: 7})")
+        with pytest.raises(AuthException):
+            u.execute("MATCH (n:Public {v: 3}) DELETE n")
+        admin.execute("GRANT CREATE_DELETE ON LABELS :Public TO w2")
+        u.execute("CREATE (:Public {v: 7})")
+        u.execute("MATCH (n:Public {v: 7}) DELETE n")
+
+    def test_edge_create_gate(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "w3",
+                     "GRANT CREATE_DELETE ON LABELS * TO w3",
+                     "GRANT READ ON EDGE_TYPES :LINK TO w3")
+        with pytest.raises(AuthException):
+            u.execute(
+                "MATCH (a:Public {v: 1}), (b:Public {v: 3}) "
+                "CREATE (a)-[:LINK]->(b)")
+        admin.execute("GRANT CREATE_DELETE ON EDGE_TYPES :LINK TO w3")
+        u.execute("MATCH (a:Public {v: 1}), (b:Public {v: 3}) "
+                  "CREATE (a)-[:LINK]->(b)")
+
+
+class TestRolesAndShow:
+    def test_role_rules_apply(self, env):
+        ictx, admin = env
+        admin.execute("CREATE ROLE analysts")
+        admin.execute("GRANT READ ON LABELS :Public TO analysts")
+        u = _mk_user(ictx, admin, "carol")
+        admin.execute("SET ROLE FOR carol TO analysts")
+        vals = sorted(r[0] for r in _rows(u, "MATCH (n) RETURN n.v"))
+        assert vals == [1, 3]
+
+    def test_user_rule_overrides_role(self, env):
+        ictx, admin = env
+        admin.execute("CREATE ROLE locked")
+        admin.execute("GRANT NOTHING ON LABELS * TO locked")
+        u = _mk_user(ictx, admin, "dave",
+                     "GRANT READ ON LABELS :Secret TO dave")
+        admin.execute("SET ROLE FOR dave TO locked")
+        vals = [r[0] for r in _rows(u, "MATCH (n) RETURN n.v")]
+        assert vals == [2]             # user rule beats role's * NOTHING
+
+    def test_show_privileges_lists_fine_grained(self, env):
+        ictx, admin = env
+        _mk_user(ictx, admin, "eve",
+                 "GRANT READ ON LABELS :Public TO eve")
+        rows = _rows(admin, "SHOW PRIVILEGES FOR eve")
+        fg = [r for r in rows if r[0].startswith("LABEL")]
+        assert ["LABEL :Public", "READ"] in fg
+
+    def test_revoke_restores(self, env):
+        ictx, admin = env
+        u = _mk_user(ictx, admin, "frank",
+                     "GRANT READ ON LABELS :Public TO frank")
+        assert len(_rows(u, "MATCH (n) RETURN n.v")) == 2
+        admin.execute("REVOKE READ ON LABELS :Public FROM frank")
+        # no rules left anywhere -> unrestricted again
+        assert len(_rows(u, "MATCH (n) RETURN n.v")) == 3
