@@ -360,6 +360,15 @@ class FineGrainedChecker:
         self._etype_chain: list[dict] = []
         with auth._lock:
             user = auth._users.get(username)
+            if user is None and username in auth._roles:
+                # allow inspecting a ROLE's fine-grained rules directly
+                role = auth._roles[username]
+                self._label_chain.append(
+                    {k: FG_LEVELS.get(v, 0)
+                     for k, v in role.fg_labels.items()})
+                self._etype_chain.append(
+                    {k: FG_LEVELS.get(v, 0)
+                     for k, v in role.fg_edge_types.items()})
             if user is not None:
                 self._label_chain.append(
                     {k: FG_LEVELS.get(v, 0) for k, v in user.fg_labels.items()})

@@ -62,6 +62,8 @@ class DataInstanceManagementServer:
 
     def _serve(self, conn) -> None:
         try:
+            from ..utils.tls import wrap_cluster_server
+            conn = wrap_cluster_server(conn)
             while not self._stop.is_set():
                 msg_type, payload = P.recv_frame(conn)
                 if msg_type != MSG_MGMT:
@@ -108,14 +110,16 @@ def mgmt_call(address: str, request: dict, timeout: float = 2.0
               ) -> dict | None:
     host, _, port = address.rpartition(":")
     try:
+        from ..utils.tls import wrap_cluster_client
         with socket.create_connection((host, int(port)),
-                                      timeout=timeout) as sock:
-            P.send_frame(sock, MSG_MGMT,
-                         json.dumps(request).encode("utf-8"))
-            msg_type, payload = P.recv_frame(sock)
-            if msg_type != MSG_MGMT:
-                return None
-            return json.loads(payload.decode("utf-8"))
+                                      timeout=timeout) as raw:
+            with wrap_cluster_client(raw, server_hostname=host) as sock:
+                P.send_frame(sock, MSG_MGMT,
+                             json.dumps(request).encode("utf-8"))
+                msg_type, payload = P.recv_frame(sock)
+                if msg_type != MSG_MGMT:
+                    return None
+                return json.loads(payload.decode("utf-8"))
     except (ConnectionError, OSError, ValueError,
             json.JSONDecodeError):
         return None

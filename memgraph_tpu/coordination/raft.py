@@ -182,6 +182,10 @@ class RaftNode:
 
     def _serve(self, conn: socket.socket) -> None:
         try:
+            # TLS handshake here, NOT in the accept loop: a silent peer
+            # must only pin this thread (bounded by the handshake timeout)
+            from ..utils.tls import wrap_cluster_server
+            conn = wrap_cluster_server(conn)
             while not self._stop.is_set():
                 msg_type, payload = P.recv_frame(conn)
                 if msg_type != MSG_RAFT:
@@ -199,14 +203,16 @@ class RaftNode:
                    timeout: float = 0.5) -> dict | None:
         host, port = self.peers[peer_id]
         try:
+            from ..utils.tls import wrap_cluster_client
             with socket.create_connection((host, port),
-                                          timeout=timeout) as sock:
-                P.send_frame(sock, MSG_RAFT,
-                             json.dumps(request).encode("utf-8"))
-                msg_type, payload = P.recv_frame(sock)
-                if msg_type != MSG_RAFT:
-                    return None
-                return json.loads(payload.decode("utf-8"))
+                                          timeout=timeout) as raw:
+                with wrap_cluster_client(raw, server_hostname=host) as sock:
+                    P.send_frame(sock, MSG_RAFT,
+                                 json.dumps(request).encode("utf-8"))
+                    msg_type, payload = P.recv_frame(sock)
+                    if msg_type != MSG_RAFT:
+                        return None
+                    return json.loads(payload.decode("utf-8"))
         except (ConnectionError, OSError, json.JSONDecodeError):
             return None
 

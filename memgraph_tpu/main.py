@@ -28,6 +28,14 @@ def build_config(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("memgraph_tpu")
     p.add_argument("--bolt-address", default="0.0.0.0")
     p.add_argument("--bolt-port", type=int, default=7687)
+    p.add_argument("--bolt-cert-file", default=None,
+                   help="TLS certificate for the Bolt listener (bolt+s)")
+    p.add_argument("--bolt-key-file", default=None)
+    p.add_argument("--cluster-cert-file", default=None,
+                   help="intra-cluster TLS (replication, Raft, mgmt RPC); "
+                        "reference analog memgraph.cpp:302-317")
+    p.add_argument("--cluster-key-file", default=None)
+    p.add_argument("--cluster-ca-file", default=None)
     p.add_argument("--data-directory", default=None,
                    help="durability directory (snapshots + WAL)")
     p.add_argument("--storage-mode", default="IN_MEMORY_TRANSACTIONAL",
@@ -192,10 +200,15 @@ async def serve(args, ictx) -> None:
         auth = Auth(None)
         ictx.auth_store = auth
 
-    server = BoltServer(ictx, args.bolt_address, args.bolt_port, auth)
+    ssl_ctx = None
+    if args.bolt_cert_file and args.bolt_key_file:
+        from .utils.tls import server_context
+        ssl_ctx = server_context(args.bolt_cert_file, args.bolt_key_file)
+    server = BoltServer(ictx, args.bolt_address, args.bolt_port, auth,
+                        ssl_context=ssl_ctx)
     await server.start()
-    logging.info("Bolt server listening on %s:%d", args.bolt_address,
-                 args.bolt_port)
+    logging.info("Bolt server listening on %s:%d%s", args.bolt_address,
+                 args.bolt_port, " (TLS)" if ssl_ctx else "")
 
     monitoring = None
     if args.monitoring_port:
@@ -238,6 +251,19 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except Exception:
             logging.exception("could not apply JAX_PLATFORMS")
+    if bool(args.bolt_cert_file) != bool(args.bolt_key_file):
+        logging.error("--bolt-cert-file and --bolt-key-file must be "
+                      "given together")
+        return 1
+    if bool(args.cluster_cert_file) != bool(args.cluster_key_file):
+        logging.error("--cluster-cert-file and --cluster-key-file must be "
+                      "given together")
+        return 1
+    if args.cluster_cert_file and args.cluster_key_file:
+        from .utils.tls import set_cluster_tls
+        set_cluster_tls(args.cluster_cert_file, args.cluster_key_file,
+                        args.cluster_ca_file)
+        logging.info("intra-cluster TLS enabled")
     ictx = build_database(args)
     try:
         asyncio.run(serve(args, ictx))

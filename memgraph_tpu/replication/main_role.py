@@ -65,12 +65,17 @@ class ReplicaClient:
         # replica dedups by commit_ts, so replay overlap is harmless
         self._catchup_buffer: list[bytes] = []
         self._catchup_system: list[dict] = []
+        self._system_queue: list[dict] = []
+        self._syslock = threading.Lock()
+        self._sys_draining = False
 
     # --- connection / catch-up ----------------------------------------------
 
     def connect_and_catch_up(self) -> None:
         self.status = ReplicaStatus.RECOVERY
         sock = socket.create_connection((self._host, self._port), timeout=30)
+        from ..utils.tls import wrap_cluster_client
+        sock = wrap_cluster_client(sock, server_hostname=self._host)
         P.send_json(sock, P.MSG_REGISTER,
                     {"name": self.name, "epoch": "epoch-1",
                      "main_commit_ts": self.storage.latest_commit_ts()})
@@ -156,6 +161,29 @@ class ReplicaClient:
     def _send_frame_sync(self, frame: bytes) -> bool:
         with self._lock:
             return self._send_frame_locked(frame)
+
+    def enqueue_system(self, txn: dict) -> None:
+        """Queue a system txn in seq order (called under the state lock)."""
+        with self._syslock:
+            self._system_queue.append(txn)
+
+    def drain_system(self) -> None:
+        """Deliver queued system txns in order. Only one drainer runs at a
+        time per client, so deliveries never interleave out of seq order."""
+        with self._syslock:
+            if self._sys_draining:
+                return
+            self._sys_draining = True
+        try:
+            while True:
+                with self._syslock:
+                    if not self._system_queue:
+                        return
+                    txn = self._system_queue.pop(0)
+                self.send_system(txn)
+        finally:
+            with self._syslock:
+                self._sys_draining = False
 
     def send_system(self, txn: dict) -> bool:
         with self._lock:
@@ -444,16 +472,20 @@ class ReplicationState:
         receive the full state on re-registration)."""
         if self.role != "main":
             return
-        # the state lock covers assignment AND delivery: concurrent system
-        # mutations must reach each replica in seq order or the replica's
-        # dedup (seq <= last) would drop the earlier one. System txns are
-        # rare (admin DDL), so holding the lock across the sends is fine.
+        # seq assignment + per-client enqueue under the state lock (fixes
+        # global ordering); DELIVERY happens outside it via each client's
+        # ordered drain — a wedged replica must not stall data commits,
+        # which also contend on this lock (_on_pre_commit)
         with self._lock:
             self._system_seq += 1
             txn = {"seq": self._system_seq, "kind": kind, "data": data}
-            for c in list(self.replicas.values()):
+            clients = []
+            for c in self.replicas.values():
                 if c.status in (ReplicaStatus.READY, ReplicaStatus.RECOVERY):
-                    c.send_system(txn)
+                    c.enqueue_system(txn)
+                    clients.append(c)
+        for c in clients:
+            c.drain_system()
 
     # --- commit hook --------------------------------------------------------
 

@@ -80,6 +80,10 @@ class ReplicaServer:
 
     def _serve_main(self, conn: socket.socket) -> None:
         try:
+            # TLS handshake on THIS thread (accept loop must never block
+            # on a silent peer); timeout inside wrap_cluster_server
+            from ..utils.tls import wrap_cluster_server
+            conn = wrap_cluster_server(conn)
             while not self._stop.is_set():
                 msg_type, payload = P.recv_frame(conn)
                 if msg_type == P.MSG_REGISTER:

@@ -477,11 +477,13 @@ class BoltServer:
     """Asyncio TCP server accepting Bolt sessions."""
 
     def __init__(self, interpreter_context: InterpreterContext,
-                 host: str = "127.0.0.1", port: int = 7687, auth=None):
+                 host: str = "127.0.0.1", port: int = 7687, auth=None,
+                 ssl_context=None):
         self.ictx = interpreter_context
         self.host = host
         self.port = port
         self.auth = auth
+        self.ssl_context = ssl_context   # bolt+s (ref: communication/context.cpp)
         self._server = None
 
     async def _handle(self, reader, writer):
@@ -490,7 +492,7 @@ class BoltServer:
 
     async def start(self):
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port)
+            self._handle, self.host, self.port, ssl=self.ssl_context)
         return self._server
 
     async def serve_forever(self):

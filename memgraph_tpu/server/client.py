@@ -26,8 +26,14 @@ class BoltClientError(MemgraphTpuError):
 
 class BoltClient:
     def __init__(self, host="127.0.0.1", port=7687, username="",
-                 password="", timeout=30.0, versions=None):
+                 password="", timeout=30.0, versions=None,
+                 encrypted=False, ca_file=None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        if encrypted:  # bolt+s: TLS from the first byte
+            from ..utils.tls import client_context
+            # hostname verification on when a CA is pinned (end-user path)
+            self.sock = client_context(ca_file).wrap_socket(
+                self.sock, server_hostname=host)
         self._versions = versions or ((5, 2), (5, 0), (4, 4), (4, 3))
         self._handshake()
         self._hello(username, password)

@@ -147,6 +147,11 @@ class TestRolesAndShow:
         rows = _rows(admin, "SHOW PRIVILEGES FOR eve")
         fg = [r for r in rows if r[0].startswith("LABEL")]
         assert ["LABEL :Public", "READ"] in fg
+        # role inspection shows the role's own fine-grained rules
+        admin.execute("CREATE ROLE viewers")
+        admin.execute("GRANT READ ON LABELS :Public TO viewers")
+        rows = _rows(admin, "SHOW PRIVILEGES FOR viewers")
+        assert ["LABEL :Public", "READ"] in rows
 
     def test_revoke_restores(self, env):
         ictx, admin = env

@@ -1,0 +1,129 @@
+"""TLS on every listener: Bolt (bolt+s), replication, Raft mgmt.
+
+Reference analog: communication/context.cpp (Bolt SSL) and the
+intra-cluster TLS of memgraph.cpp:302-317.
+"""
+
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from memgraph_tpu.utils import tls as T
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = T.generate_self_signed(str(d))
+    return cert, key
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_bolt_server_tls(certs, tmp_path):
+    """Real server process with --bolt-cert-file; client speaks bolt+s."""
+    cert, key = certs
+    port = _free_port()
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "memgraph_tpu.main",
+         "--bolt-port", str(port), "--log-level", "WARNING",
+         "--bolt-cert-file", cert, "--bolt-key-file", key],
+        cwd="/root/repo", env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(("127.0.0.1", port), 0.3)
+                s.close()
+                break
+            except OSError:
+                time.sleep(0.2)
+        from memgraph_tpu.server.client import BoltClient
+        # plaintext must NOT work against a TLS listener
+        with pytest.raises(Exception):
+            c = BoltClient(port=port, timeout=3)
+            c.execute("RETURN 1")
+        # encrypted works
+        c = BoltClient(port=port, encrypted=True, ca_file=cert)
+        _, rows, _ = c.execute("RETURN 40 + 2")
+        assert rows == [[42]]
+        c.close()
+    finally:
+        p.terminate()
+        p.wait(timeout=10)
+
+
+def test_replication_over_cluster_tls(certs):
+    """MAIN<->replica channel encrypted via set_cluster_tls."""
+    cert, key = certs
+    T.set_cluster_tls(cert, key, cert)
+    try:
+        from memgraph_tpu.query.interpreter import (Interpreter,
+                                                    InterpreterContext)
+        from memgraph_tpu.storage import InMemoryStorage
+        main = Interpreter(InterpreterContext(InMemoryStorage()))
+        rep_ictx = InterpreterContext(InMemoryStorage())
+        rep = Interpreter(rep_ictx)
+        port = _free_port()
+        rep.execute(f"SET REPLICATION ROLE TO REPLICA WITH PORT {port}")
+        main.execute(f"REGISTER REPLICA tls1 SYNC TO '127.0.0.1:{port}'")
+        main.execute("CREATE (:Enc {v: 7})")
+        _, rows, _ = rep.execute("MATCH (n:Enc) RETURN n.v")
+        assert rows == [[7]]
+        # a PLAINTEXT peer cannot talk to the TLS replica listener
+        raw = socket.create_connection(("127.0.0.1", port), timeout=2)
+        raw.settimeout(2)
+        from memgraph_tpu.replication import protocol as P
+        try:
+            P.send_json(raw, P.MSG_HEARTBEAT, {})
+            with pytest.raises((ConnectionError, OSError)):
+                P.recv_frame(raw)
+        finally:
+            raw.close()
+        rep_ictx.replication.replica_server.stop()
+    finally:
+        T.clear_cluster_tls()
+
+
+def test_raft_mgmt_over_cluster_tls(certs):
+    """Coordinator Raft RPCs work with cluster TLS installed."""
+    cert, key = certs
+    T.set_cluster_tls(cert, key, cert)
+    try:
+        from memgraph_tpu.coordination.raft import RaftNode
+        ports = [_free_port() for _ in range(3)]
+        peers = {f"c{i}": ("127.0.0.1", ports[i]) for i in range(3)}
+        nodes = []
+        for i in range(3):
+            n = RaftNode(f"c{i}", "127.0.0.1", ports[i],
+                         {k: v for k, v in peers.items() if k != f"c{i}"})
+            n.start()
+            nodes.append(n)
+        try:
+            deadline = time.time() + 15
+            leader = None
+            while time.time() < deadline and leader is None:
+                leaders = [n for n in nodes if n.is_leader()]
+                if leaders:
+                    leader = leaders[0]
+                time.sleep(0.2)
+            assert leader is not None, "no leader elected over TLS"
+        finally:
+            for n in nodes:
+                n.stop()
+    finally:
+        T.clear_cluster_tls()
