@@ -1,0 +1,175 @@
+"""Per-distance Benes-stage cost on chip, dispatch overhead subtracted.
+
+Compares formulations of the masked pairwise swap at distance d:
+  flip:  y = reshape(x,(N/2d,2,d)); sw = flip(y,1);      out = where(m,sw,x)
+  xroll: sw = where(bit_d(i), roll(x,d), roll(x,-d));    out = where(m,sw,x)
+  concat: sw = concat(x[d:2d],x[0:d],...) via reshape+slice swap
+Also: roll cost (flat & axis0), in-loop einsum cost.
+
+Method: time a chain of K stages (distinct masks, no CSE) minus an empty
+dispatch, divide by K. Sync via 1-element host transfer. Internal deadline.
+"""
+import json
+import sys
+import time
+
+DEADLINE = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
+T0 = time.perf_counter()
+N_LOG2 = 24
+N = 1 << N_LOG2
+K = 8  # stages per chain
+
+
+def left():
+    return DEADLINE - (time.perf_counter() - T0)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    results = {"platform": jax.devices()[0].platform}
+
+    def measure(fn, *args, reps=3):
+        out = fn(*args)
+        _ = float(jnp.ravel(out)[0])
+        best = float("inf")
+        for _ in range(reps):
+            t = time.perf_counter()
+            out = fn(*args)
+            _ = float(jnp.ravel(out)[0])
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    # empty dispatch baseline
+    @jax.jit
+    def nop(x):
+        return x + 0.0
+
+    xsmall = jnp.ones(8, jnp.float32)
+    disp = measure(nop, xsmall, reps=5)
+    results["dispatch_ms"] = round(disp * 1e3, 2)
+    print(f"dispatch: {disp*1e3:.1f} ms", file=sys.stderr, flush=True)
+
+    packed_np = rng.integers(0, 256, (K, N // 8), dtype=np.uint8)
+    packed = jnp.asarray(packed_np)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+
+    def unpack(p):
+        return ((p[:, None] >> shifts) & 1).reshape(N) != 0
+
+    def chain_flip(x, packed, d):
+        for s in range(K):
+            m = unpack(packed[s])
+            y = x.reshape(N // (2 * d), 2, d)
+            sw = jnp.flip(y, axis=1).reshape(N)
+            x = jnp.where(m, sw, x)
+        return x
+
+    def chain_xroll(x, packed, d, bit):
+        for s in range(K):
+            m = unpack(packed[s])
+            sw = jnp.where(bit, jnp.roll(x, -d), jnp.roll(x, d))
+            x = jnp.where(m, sw, x)
+        return x
+
+    iota = None
+    dists = [1, 2, 8, 32, 128, 1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 23]
+    for d in dists:
+        if left() < 60:
+            break
+        x = jnp.ones(N, jnp.bfloat16)
+        f = jax.jit(lambda x, p, d=d: chain_flip(x, p, d))
+        t = measure(f, x, packed)
+        per = (t - disp) / K
+        results[f"flip_d{d}_ms"] = round(per * 1e3, 3)
+        print(f"flip d={d}: {per*1e3:.2f} ms/stage", file=sys.stderr,
+              flush=True)
+
+    if iota is None:
+        iota_np = ((np.arange(N, dtype=np.int64) >> 0) & 0).astype(np.uint8)
+    for d in dists:
+        if left() < 60:
+            break
+        bit_np = ((np.arange(N, dtype=np.int64) // d) & 1).astype(bool)
+        bit = jnp.asarray(bit_np)
+        x = jnp.ones(N, jnp.bfloat16)
+        f = jax.jit(lambda x, p, b, d=d: chain_xroll(x, p, d, b))
+        t = measure(f, x, packed, bit)
+        per = (t - disp) / K
+        results[f"xroll_d{d}_ms"] = round(per * 1e3, 3)
+        print(f"xroll d={d}: {per*1e3:.2f} ms/stage", file=sys.stderr,
+              flush=True)
+
+    # plain roll cost, flat
+    for d in (1, 128, 1 << 14, 1 << 22):
+        if left() < 45:
+            break
+        x = jnp.ones(N, jnp.bfloat16)
+
+        def chain_roll(x, d=d):
+            for s in range(K):
+                x = jnp.roll(x, d + s)  # vary shift to prevent CSE
+            return x
+
+        t = measure(jax.jit(chain_roll), x)
+        results[f"roll_d{d}_ms"] = round((t - disp) / K * 1e3, 3)
+        print(f"roll d={d}: {(t-disp)/K*1e3:.2f} ms", file=sys.stderr,
+              flush=True)
+
+    # roll along axis0 of (R,128) — the reduce-tree shape
+    R = N // 128
+    x2 = jnp.ones((R, 128), jnp.float32)
+    mask2 = jnp.asarray(rng.random((K, R)) < 0.5)
+
+    def chain_roll0(x, mask2):
+        for s in range(K):
+            x = x + mask2[s][:, None] * jnp.roll(x, -(1 << s), axis=0)
+        return x
+
+    t = measure(jax.jit(chain_roll0), x2, mask2)
+    results["rolltree_stage_ms"] = round((t - disp) / K * 1e3, 3)
+    print(f"rolltree: {(t-disp)/K*1e3:.2f} ms/stage", file=sys.stderr,
+          flush=True)
+
+    # in-loop einsums (expand + extract), dispatch-corrected
+    G, R_G = 62, 1280
+    oh = jnp.asarray(rng.random((G, R_G, 128)) < 0.008, jnp.bfloat16)
+
+    def chain_expand(rank, oh):
+        for s in range(K):
+            t_ = jnp.einsum("grw,gwl->grl", oh, rank,
+                            preferred_element_type=jnp.float32)
+            rank = rank + t_[:, :128, :].astype(jnp.bfloat16) * 1e-9
+        return rank
+
+    rank = jnp.ones((G, 128, 128), jnp.bfloat16)
+    t = measure(jax.jit(chain_expand), rank, oh)
+    results["expand_einsum_ms"] = round((t - disp) / K * 1e3, 3)
+    print(f"expand einsum: {(t-disp)/K*1e3:.2f} ms", file=sys.stderr,
+          flush=True)
+
+    C, R_C, K_C = 350, 256, 256
+    ohe = jnp.asarray(rng.random((C, R_C, K_C)) < 0.004, jnp.bfloat16)
+
+    def chain_extract(xc, ohe):
+        for s in range(K):
+            pc = jnp.einsum("cik,cil->ckl", ohe, xc,
+                            preferred_element_type=jnp.float32)
+            xc = xc + pc[:, :, :128].astype(jnp.bfloat16)[:, :R_C % 256 or 256][:, :R_C].reshape(C, -1, 128)[:, :R_C, :] * 1e-9 \
+                if False else xc + pc[:, :R_C, :].astype(jnp.bfloat16) * 1e-9
+        return xc
+
+    xc = jnp.ones((C, R_C, 128), jnp.bfloat16)
+    t = measure(jax.jit(chain_extract), xc, ohe)
+    results["extract_einsum_ms"] = round((t - disp) / K * 1e3, 3)
+    print(f"extract einsum: {(t-disp)/K*1e3:.2f} ms", file=sys.stderr,
+          flush=True)
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

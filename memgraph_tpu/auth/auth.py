@@ -252,8 +252,14 @@ class Auth:
                 target.pop(item, None)
             self._save()
 
-    def fine_grained_checker(self, username: str) -> "FineGrainedChecker":
-        return FineGrainedChecker(self, username)
+    def fine_grained_checker(self, username: str,
+                             allow_role: bool = False
+                             ) -> "FineGrainedChecker":
+        """allow_role=True additionally resolves a bare role name (for
+        SHOW PRIVILEGES inspection); the runtime authorization path must
+        keep it False so a dropped user never inherits a same-named
+        role's rules."""
+        return FineGrainedChecker(self, username, allow_role=allow_role)
 
     def has_privilege(self, user_name: str, privilege: str) -> bool:
         with self._lock:
@@ -353,14 +359,15 @@ class FineGrainedChecker:
     rule exists, unmatched items default to NOTHING.
     """
 
-    def __init__(self, auth: "Auth", username: str) -> None:
+    def __init__(self, auth: "Auth", username: str,
+                 allow_role: bool = False) -> None:
         # kept as SEPARATE chains: a user's "*" rule must shadow a role's
         # label-specific rule, which a flat merge cannot express
         self._label_chain: list[dict] = []
         self._etype_chain: list[dict] = []
         with auth._lock:
             user = auth._users.get(username)
-            if user is None and username in auth._roles:
+            if user is None and allow_role and username in auth._roles:
                 # allow inspecting a ROLE's fine-grained rules directly
                 role = auth._roles[username]
                 self._label_chain.append(

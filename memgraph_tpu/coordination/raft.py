@@ -86,6 +86,12 @@ class RaftNode:
         self._sock: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._commit_events: dict[int, threading.Event] = {}
+        # persistent per-peer RPC connections: with cluster TLS on, a
+        # handshake per heartbeat would eat the 0.5s RPC deadline and
+        # destabilize leadership; the server loop handles many frames per
+        # connection, so reuse one socket per peer (fresh on error)
+        self._peer_conns: dict[str, socket.socket] = {}
+        self._peer_conns_lock = threading.Lock()
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -104,6 +110,13 @@ class RaftNode:
         if self._sock is not None:
             try:
                 self._sock.close()
+            except OSError:
+                pass
+        with self._peer_conns_lock:
+            conns, self._peer_conns = dict(self._peer_conns), {}
+        for sock in conns.values():
+            try:
+                sock.close()
             except OSError:
                 pass
         for t in self._threads:
@@ -202,19 +215,42 @@ class RaftNode:
     def _call_peer(self, peer_id: str, request: dict,
                    timeout: float = 0.5) -> dict | None:
         host, port = self.peers[peer_id]
-        try:
-            from ..utils.tls import wrap_cluster_client
-            with socket.create_connection((host, port),
-                                          timeout=timeout) as raw:
-                with wrap_cluster_client(raw, server_hostname=host) as sock:
-                    P.send_frame(sock, MSG_RAFT,
-                                 json.dumps(request).encode("utf-8"))
-                    msg_type, payload = P.recv_frame(sock)
-                    if msg_type != MSG_RAFT:
-                        return None
-                    return json.loads(payload.decode("utf-8"))
-        except (ConnectionError, OSError, json.JSONDecodeError):
-            return None
+        data = json.dumps(request).encode("utf-8")
+        # first attempt reuses the pooled connection (may be stale if the
+        # peer restarted); second attempt always dials fresh
+        for attempt in (0, 1):
+            with self._peer_conns_lock:
+                sock = self._peer_conns.pop(peer_id, None)
+            try:
+                if sock is None:
+                    if attempt == 0:
+                        continue
+                    from ..utils.tls import wrap_cluster_client
+                    raw = socket.create_connection((host, port),
+                                                   timeout=timeout)
+                    sock = wrap_cluster_client(raw, server_hostname=host)
+                sock.settimeout(timeout)
+                P.send_frame(sock, MSG_RAFT, data)
+                msg_type, payload = P.recv_frame(sock)
+                if msg_type != MSG_RAFT:
+                    raise ConnectionError("unexpected frame type")
+                response = json.loads(payload.decode("utf-8"))
+                with self._peer_conns_lock:
+                    displaced = self._peer_conns.get(peer_id)
+                    self._peer_conns[peer_id] = sock
+                if displaced is not None:  # concurrent caller raced us
+                    try:
+                        displaced.close()
+                    except OSError:
+                        pass
+                return response
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+        return None
 
     # --- RPC handlers -------------------------------------------------------
 

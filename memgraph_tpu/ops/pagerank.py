@@ -15,12 +15,20 @@ contribute nothing.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .csr import DeviceGraph
+
+# Above this edge count the gather-free MXU formulation (ops/spmv_mxu.py)
+# wins despite its host-side plan build; below it the segment-sum kernel's
+# zero setup cost wins. Plan+kernel are cached on the DeviceGraph snapshot,
+# so repeated CALLs on an unchanged graph pay the build once.
+MXU_MIN_EDGES = int(os.environ.get("MEMGRAPH_TPU_MXU_MIN_EDGES", 500_000))
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
@@ -68,9 +76,36 @@ def _pagerank_kernel(src, dst, weights, csr_src, csr_weights, n_nodes,
     return rank, err, iters
 
 
+def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
+    """Large-graph path: gather-free MXU kernel with the plan cached on
+    the (immutable) DeviceGraph snapshot."""
+    from . import spmv_mxu
+    cached = getattr(graph, "_mxu_state", None)
+    if cached is None:
+        # true edges only: padding edges sort to the end (sink rows)
+        src = np.asarray(graph.src_idx)[:graph.n_edges]
+        dst = np.asarray(graph.col_idx)[:graph.n_edges]
+        w = np.asarray(graph.weights)[:graph.n_edges]
+        plan = spmv_mxu.build_plan(src, dst, w, graph.n_nodes)
+        cached = (plan, spmv_mxu.make_pagerank_kernel(plan))
+        # DeviceGraph is a frozen dataclass; bypass its setattr guard
+        object.__setattr__(graph, "_mxu_state", cached)
+    plan, run = cached
+    node_flat = plan.G * spmv_mxu.SG_ROWS * spmv_mxu.LANES
+    rank0 = np.zeros(node_flat, dtype=np.float32)
+    rank0[plan.out_relabel] = 1.0 / plan.n_nodes
+    rank, err, iters = run(jnp.asarray(rank0), jnp.float32(damping),
+                           int(max_iterations), jnp.float32(tol))
+    return np.asarray(rank)[plan.out_relabel], float(err), int(iters)
+
+
 def pagerank(graph: DeviceGraph, damping: float = 0.85,
              max_iterations: int = 100, tol: float = 1e-6):
     """Returns (ranks[:n_nodes], error, iterations)."""
+    if graph.n_edges >= MXU_MIN_EDGES and (
+            jax.default_backend() != "cpu"
+            or os.environ.get("MEMGRAPH_TPU_FORCE_MXU")):
+        return _pagerank_via_mxu(graph, damping, max_iterations, tol)
     rank, err, iters = _pagerank_kernel(
         graph.csc_src, graph.csc_dst, graph.csc_weights,
         graph.src_idx, graph.weights,

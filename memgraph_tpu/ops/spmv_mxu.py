@@ -1,5 +1,5 @@
 """Gather-free sparse matvec (PageRank core) built from MXU matmuls,
-Benes routing, and roll-tree reductions.
+Benes routing, and roll-based exchanges.
 
 Motivation (measured, docs/kernel_design_r2.md): on this TPU platform XLA
 elementwise/matmul run at full speed while every gather/scatter/sort
@@ -13,16 +13,20 @@ data-dependent addressing on the device:
                 per-slot `mult` (weight / out-weight-sum, 0 on padding).
   2. PERMUTE  — a Benes network (ops.benes) moves every edge slot from its
                 gather-layout position to its scatter-layout position via
-                2*log2(N)-1 masked-swap stages.
-  3. REDUCE   — scatter layout keeps each destination's edges contiguous
-                within its lane (lane == dst & 127, runs aligned per
-                dst-row); ~log2(max in-degree) passes of
-                x += mask_k * roll(x, -2^k) leave each run's total at its
-                base row.
-  4. EXTRACT  — chunked one-hot matmuls pick the base-row totals into a
-                dense accumulator, then a small window one-hot matmul sums
-                chunks into aligned windows.
-  5. RELABEL  — a second (node-sized) Benes converts the accumulator from
+                2*log2(N)-1 masked-exchange stages. Each stage exchanges
+                partners i <-> i^d, realized as two jnp.rolls + selects on
+                an (N/128, 128) layout: a row roll for d >= 128, a lane
+                roll for d < 128. (The earlier reshape+flip formulation
+                lowered to ~30 ms/stage at small d on this platform; rolls
+                run at HBM speed at every distance.)
+  3. REDUCE + EXTRACT — scatter layout keeps each destination's edges
+                contiguous within its lane (lane == dst & 127, runs
+                aligned per dst-row); a full-run one-hot matmul per chunk
+                sums every run directly on the MXU (no roll-tree passes):
+                per_chunk[c,k,l] = sum_i OH(run slot)[c,i,k] * x[c,i,l],
+                then a small window one-hot sums chunks into aligned
+                windows.
+  4. RELABEL  — a second (node-sized) Benes converts the accumulator from
                 the in-degree-sorted labeling (which keeps scatter padding
                 small under skew) to the out-degree-sorted labeling (which
                 keeps gather padding small), ready for the next EXPAND.
@@ -70,11 +74,10 @@ class MXUPlan:
     # --- big Benes ---
     net_log2: int
     masks_packed: np.ndarray   # (stages, N/8) uint8
-    # --- scatter/reduce (in-degree labeling) ---
+    # --- scatter/extract (in-degree labeling) ---
     C: int                     # extract chunks (total rows = C * R_C)
-    reduce_k: int              # roll-tree depth
-    reduce_masks: np.ndarray   # (reduce_k, C*R_C) bool (per-row)
-    ext_base: np.ndarray       # (C, R_C) int16: local window dst-row or -1
+    run_k: np.ndarray          # (C, R_C) int16: window slot of the row's
+    #                            dst block (dr % K_C), -1 on padding rows
     win_oh: np.ndarray         # (C, W) f32 one-hot chunk->window
     W: int
     in_relabel: np.ndarray     # (n_nodes,) original -> in-label id
@@ -178,56 +181,43 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
             [H_in, np.ones(n_drows_p - len(H_in), dtype=H_in.dtype)])
     W = n_drows_p // K_C
 
-    # chunked row allocation: each chunk's BASE rows must map to one
-    # aligned K_C window of dst-rows; blocks may spill across chunks.
+    # chunked row allocation: the full-run one-hot extract sums EVERY row
+    # of a dst block, so every row of a block must live in chunks claimed
+    # by the block's window — pad to a chunk boundary whenever a block
+    # would otherwise share a chunk with a different window.
     base2 = np.zeros(n_drows_p, dtype=np.int64)
-    chunk_of_base = np.zeros(n_drows_p, dtype=np.int64)
+    chunk_win: dict = {}
     rows_acc = 0
-    last_base_chunk = -1
-    last_base_win = -1
     for dr in range(n_drows_p):
         wdw = dr // K_C
         c = rows_acc // R_C
-        if c == last_base_chunk and wdw != last_base_win:
-            rows_acc = _ceil_to(rows_acc, R_C)                # pad chunk
-            c = rows_acc // R_C
+        if chunk_win.get(c, wdw) != wdw:
+            rows_acc = _ceil_to(rows_acc, R_C)
         base2[dr] = rows_acc
-        chunk_of_base[dr] = c
-        last_base_chunk, last_base_win = c, wdw
-        rows_acc += int(H_in[dr])
+        end = rows_acc + int(H_in[dr])
+        for cc in range(rows_acc // R_C, (end - 1) // R_C + 1):
+            chunk_win[cc] = wdw
+        rows_acc = end
     R_total = _ceil_to(rows_acc, R_C)
     C = R_total // R_C
 
-    # window of each chunk = window of the bases it contains (unique by
-    # construction; chunks with no base keep the previous window)
     win_of_chunk = np.zeros(C, dtype=np.int64)
-    wtmp = np.zeros(C, dtype=np.int64) - 1
-    for dr in range(n_drows_p):
-        wtmp[chunk_of_base[dr]] = dr // K_C
-    last = 0
     for c in range(C):
-        if wtmp[c] >= 0:
-            last = wtmp[c]
-        win_of_chunk[c] = last
+        win_of_chunk[c] = chunk_win.get(
+            c, win_of_chunk[c - 1] if c else 0)
     win_oh = np.zeros((C, W), dtype=np.float32)
     win_oh[np.arange(C), win_of_chunk] = 1.0
 
-    ext_base = np.full((C, R_C), -1, dtype=np.int16)
-    ext_base[chunk_of_base, base2 % R_C] = \
-        (np.arange(n_drows_p) % K_C).astype(np.int16)
-
-    # reduce masks: mask_k[row]=1 iff row and row+2^k in same dst block
-    reduce_k = max(1, int(np.ceil(np.log2(max(2, H_in.max())))))
+    # run_k[c, i] = window slot (dr % K_C) of the block owning row
+    # c*R_C + i, or -1 for padding rows. Distinct blocks sharing a chunk
+    # share its window, so slots cannot collide.
     block_of_row = np.full(R_total, -1, dtype=np.int64)
     for dr in range(n_drows_p):
         block_of_row[base2[dr]:base2[dr] + H_in[dr]] = dr
-    reduce_masks = np.zeros((reduce_k, R_total), dtype=bool)
-    rows_idx = np.arange(R_total)
-    for k in range(reduce_k):
-        j = rows_idx + (1 << k)
-        ok = j < R_total
-        reduce_masks[k, ok] = (block_of_row[rows_idx[ok]] >= 0) & \
-            (block_of_row[rows_idx[ok]] == block_of_row[j[ok]])
+    run_k = np.full(R_total, -1, dtype=np.int16)
+    owned = block_of_row >= 0
+    run_k[owned] = (block_of_row[owned] % K_C).astype(np.int16)
+    run_k = run_k.reshape(C, R_C)
 
     # per-edge scatter position
     order_s = np.argsort(v, kind="stable")
@@ -251,6 +241,8 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
     sp_by_edge[order_s] = scatter_pos
     perm[sp_by_edge] = gp_by_edge
     # complete the bijection: remaining outputs take remaining inputs
+    # (all of which carry exactly 0: pad slots have mult == 0 and
+    # positions beyond the gather layout are zero-filled)
     free_out = np.flatnonzero(perm < 0)
     used_in = np.zeros(N_net, dtype=bool)
     used_in[gp_by_edge] = True
@@ -274,8 +266,7 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
         out_relabel=relab_out, valid_out=valid_out,
         dangling_out=dangling_out,
         net_log2=net_log2, masks_packed=masks_packed,
-        C=C, reduce_k=reduce_k, reduce_masks=reduce_masks,
-        ext_base=ext_base, win_oh=win_oh, W=W, in_relabel=relab_in,
+        C=C, run_k=run_k, win_oh=win_oh, W=W, in_relabel=relab_in,
         node_net_log2=node_net_log2, node_masks_packed=node_masks_packed)
 
 
@@ -283,29 +274,51 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
 # device kernel
 # ---------------------------------------------------------------------------
 
-def _unpack_bits_jnp(packed, n):
-    import jax.numpy as jnp
-    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
-    bits = (packed[..., :, None] >> shifts) & 1
-    return bits.reshape(*packed.shape[:-1], packed.shape[-1] * 8)[..., :n]
-
-
-def _benes_apply_jnp(x, masks, net_log2):
-    """masks: (stages, N) bool device array; static unrolled stages."""
+def _unpack_masks_2d(packed, net_log2):
+    """(stages, N/8) uint8 -> (stages, N/128, 128) bool (flat if N < 128)."""
     import jax.numpy as jnp
     N = 1 << net_log2
-    dists = benes_stage_distances(net_log2)
-    for s, d in enumerate(dists):
-        y = x.reshape(N // (2 * d), 2, d)
-        sw = jnp.flip(y, axis=1).reshape(N)
-        x = jnp.where(masks[s], sw, x)
-    return x
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = ((packed[..., :, None] >> shifts) & 1) != 0
+    bits = bits.reshape(packed.shape[0], -1)[:, :N]
+    if N >= LANES:
+        return bits.reshape(packed.shape[0], N // LANES, LANES)
+    return bits
+
+
+def _benes_apply_rolls(x2, masks2, net_log2):
+    """Roll-based Benes. x2 is (N/128, 128) (or flat (N,) when N < 128).
+
+    Stage distance d exchanges partners i <-> i^d (masks are symmetric:
+    mask[i] == mask[i^d], see ops/benes.py). For i with bit d clear the
+    partner is i+d == roll(x, -d)[i]; bit set, i-d == roll(x, +d)[i] —
+    so the exchanged view is a two-roll select on a static bit pattern,
+    a row roll when d >= 128 and a lane roll when d < 128. Rolls run at
+    HBM bandwidth on this platform at every distance, unlike the
+    reshape+flip lowering (docs/kernel_design_r2.md)."""
+    import jax.numpy as jnp
+    flat = x2.ndim == 1
+    for s, d in enumerate(benes_stage_distances(net_log2)):
+        if flat:
+            bit = ((jnp.arange(x2.shape[0]) // d) & 1) == 1
+            sw = jnp.where(bit, jnp.roll(x2, d), jnp.roll(x2, -d))
+        elif d >= LANES:
+            e = d // LANES
+            bit = ((jnp.arange(x2.shape[0]) // e) & 1) == 1
+            sw = jnp.where(bit[:, None], jnp.roll(x2, e, axis=0),
+                           jnp.roll(x2, -e, axis=0))
+        else:
+            bit = ((jnp.arange(LANES) // d) & 1) == 1
+            sw = jnp.where(bit[None, :], jnp.roll(x2, d, axis=1),
+                           jnp.roll(x2, -d, axis=1))
+        x2 = jnp.where(masks2[s], sw, x2)
+    return x2
 
 
 def make_pagerank_kernel(plan: MXUPlan):
-    """Returns (jitted_fn, device_args). fn(rank0_flat, damping,
-    max_iter, tol, *device_args) -> (rank_flat, err, iters); rank vectors
-    are flat in OUT labeling, length G*SG_ROWS*LANES."""
+    """Returns jitted fn(rank0_flat, damping, max_iter, tol) ->
+    (rank_flat, err, iters); rank vectors are flat in OUT labeling,
+    length G*SG_ROWS*LANES."""
     import jax
     import jax.numpy as jnp
 
@@ -314,49 +327,48 @@ def make_pagerank_kernel(plan: MXUPlan):
     N_nn = 1 << plan.node_net_log2
     node_flat = G * SG_ROWS * LANES
     n_f = float(plan.n_nodes)
-    acc_len = plan.win_oh.shape[1] * K_C * LANES
+
+    iota_sg = np.arange(SG_ROWS, dtype=np.int32)
+    iota_kc = np.arange(K_C, dtype=np.int32)
+    # one-hots are static: precompute once on host, ship to HBM
+    oh_np = (plan.rowid[:, :, None] == iota_sg[None, None, :]
+             ).astype(np.float32)                          # (G, R_G, 128)
+    ohe_np = ((plan.run_k[:, :, None] == iota_kc[None, None, :])
+              & (plan.run_k[:, :, None] >= 0)).astype(np.float32)
 
     dev = dict(
-        rowid=jnp.asarray(plan.rowid, jnp.int32),
+        oh=jnp.asarray(oh_np),
         mult=jnp.asarray(plan.mult),
         valid=jnp.asarray(plan.valid_out),
         dangling=jnp.asarray(plan.dangling_out),
-        masks=_unpack_bits_jnp(jnp.asarray(plan.masks_packed),
-                               N_net).astype(bool),
-        reduce_masks=jnp.asarray(plan.reduce_masks),
-        ext_base=jnp.asarray(plan.ext_base, jnp.int32),
+        masks2=_unpack_masks_2d(jnp.asarray(plan.masks_packed),
+                                plan.net_log2),
+        ohe=jnp.asarray(ohe_np),
         win_oh=jnp.asarray(plan.win_oh),
-        node_masks=_unpack_bits_jnp(jnp.asarray(plan.node_masks_packed),
-                                    N_nn).astype(bool),
+        node_masks2=_unpack_masks_2d(jnp.asarray(plan.node_masks_packed),
+                                     plan.node_net_log2),
     )
-
-    iota_sg = jnp.arange(SG_ROWS, dtype=jnp.int32)
-    iota_kc = jnp.arange(K_C, dtype=jnp.int32)
 
     def one_iter(rank_flat, d, dv):
         rank_planes = rank_flat.reshape(G, SG_ROWS, LANES)
-        oh = (dv["rowid"][:, :, None] == iota_sg[None, None, :]
-              ).astype(jnp.float32)                       # (G, R_G, 128)
-        T = jnp.einsum("grw,gwl->grl", oh, rank_planes,
+        T = jnp.einsum("grw,gwl->grl", dv["oh"], rank_planes,
                        preferred_element_type=jnp.float32)
-        contrib = (T * dv["mult"]).reshape(-1)
-        x = jnp.zeros(N_net, jnp.float32).at[:contrib.shape[0]].set(contrib)
-        x = _benes_apply_jnp(x, dv["masks"], plan.net_log2)
-        x2 = x[:C * R_C * LANES].reshape(C * R_C, LANES)
-        for k in range(plan.reduce_k):
-            x2 = x2 + dv["reduce_masks"][k][:, None] * \
-                jnp.roll(x2, -(1 << k), axis=0)
-        xc = x2.reshape(C, R_C, LANES)
-        ohe = (dv["ext_base"][:, :, None] == iota_kc[None, None, :]
-               ).astype(jnp.float32)                      # (C, R_C, K_C)
-        per_chunk = jnp.einsum("cik,cil->ckl", ohe, xc,
+        contrib = (T * dv["mult"]).reshape(-1, LANES)      # (G*R_G, 128)
+        x2 = jnp.zeros((N_net // LANES, LANES), jnp.float32
+                       ).at[:contrib.shape[0]].set(contrib)
+        x2 = _benes_apply_rolls(x2, dv["masks2"], plan.net_log2)
+        xc = x2[:C * R_C].reshape(C, R_C, LANES)
+        # full-run one-hot reduce+extract on the MXU (no roll-tree)
+        per_chunk = jnp.einsum("cik,cil->ckl", dv["ohe"], xc,
                                preferred_element_type=jnp.float32)
         accw = jnp.einsum("cw,ckl->wkl", dv["win_oh"], per_chunk,
                           preferred_element_type=jnp.float32)
-        acc_in = accw.reshape(-1)                         # in-label dense
-        xa = jnp.zeros(N_nn, jnp.float32).at[:acc_len].set(acc_in)
-        acc_out = _benes_apply_jnp(xa, dv["node_masks"],
-                                   plan.node_net_log2)[:node_flat]
+        acc_in2 = accw.reshape(-1, LANES)                  # (W*K_C, 128)
+        xa = jnp.zeros((N_nn // LANES, LANES), jnp.float32
+                       ).at[:acc_in2.shape[0]].set(acc_in2)
+        acc_out = _benes_apply_rolls(
+            xa, dv["node_masks2"],
+            plan.node_net_log2).reshape(-1)[:node_flat]
         dm = jnp.sum(rank_flat * dv["dangling"])
         new_rank = dv["valid"] * ((1.0 - d) / n_f
                                   + d * (acc_out + dm / n_f))
@@ -406,7 +418,7 @@ def pagerank_mxu(src, dst, weights, n_nodes, damping=0.85,
 # plan persistence (bench reuse: routing a 10M-edge graph costs ~35s host-side)
 # ---------------------------------------------------------------------------
 
-_PLAN_VERSION = 2
+_PLAN_VERSION = 3
 
 
 def save_plan(plan: MXUPlan, path: str) -> None:
@@ -415,8 +427,7 @@ def save_plan(plan: MXUPlan, path: str) -> None:
         R_G=plan.R_G, rowid=plan.rowid, mult=plan.mult,
         out_relabel=plan.out_relabel, valid_out=plan.valid_out,
         dangling_out=plan.dangling_out, net_log2=plan.net_log2,
-        masks_packed=plan.masks_packed, C=plan.C, reduce_k=plan.reduce_k,
-        reduce_masks=plan.reduce_masks, ext_base=plan.ext_base,
+        masks_packed=plan.masks_packed, C=plan.C, run_k=plan.run_k,
         win_oh=plan.win_oh, W=plan.W, in_relabel=plan.in_relabel,
         node_net_log2=plan.node_net_log2,
         node_masks_packed=plan.node_masks_packed)
@@ -432,8 +443,7 @@ def load_plan(path: str) -> Optional[MXUPlan]:
             rowid=z["rowid"], mult=z["mult"], out_relabel=z["out_relabel"],
             valid_out=z["valid_out"], dangling_out=z["dangling_out"],
             net_log2=int(z["net_log2"]), masks_packed=z["masks_packed"],
-            C=int(z["C"]), reduce_k=int(z["reduce_k"]),
-            reduce_masks=z["reduce_masks"], ext_base=z["ext_base"],
+            C=int(z["C"]), run_k=z["run_k"],
             win_oh=z["win_oh"], W=int(z["W"]), in_relabel=z["in_relabel"],
             node_net_log2=int(z["node_net_log2"]),
             node_masks_packed=z["node_masks_packed"])

@@ -1142,7 +1142,7 @@ class Interpreter:
         elif node.action == "show_privileges":
             rows = [[p, eff] for p, eff
                     in auth.effective_privileges(node.user)]
-            checker = auth.fine_grained_checker(node.user)
+            checker = auth.fine_grained_checker(node.user, allow_role=True)
             if checker.restricted:
                 from ..auth.auth import FG_LEVELS
                 inv = {v: k for k, v in FG_LEVELS.items()}

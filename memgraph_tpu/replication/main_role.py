@@ -178,12 +178,19 @@ class ReplicaClient:
             while True:
                 with self._syslock:
                     if not self._system_queue:
+                        # clear the flag in the SAME critical section that
+                        # observes the queue empty: a txn enqueued after an
+                        # unlocked empty-check but before a finally-block
+                        # clear would see _sys_draining=True and never be
+                        # delivered (lost wakeup)
+                        self._sys_draining = False
                         return
                     txn = self._system_queue.pop(0)
                 self.send_system(txn)
-        finally:
+        except BaseException:
             with self._syslock:
                 self._sys_draining = False
+            raise
 
     def send_system(self, txn: dict) -> bool:
         with self._lock:
