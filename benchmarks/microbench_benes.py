@@ -75,7 +75,6 @@ def main():
             x = jnp.where(m, sw, x)
         return x
 
-    iota = None
     dists = [1, 2, 8, 32, 128, 1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 23]
     for d in dists:
         if left() < 60:
@@ -88,8 +87,6 @@ def main():
         print(f"flip d={d}: {per*1e3:.2f} ms/stage", file=sys.stderr,
               flush=True)
 
-    if iota is None:
-        iota_np = ((np.arange(N, dtype=np.int64) >> 0) & 0).astype(np.uint8)
     for d in dists:
         if left() < 60:
             break
@@ -158,8 +155,7 @@ def main():
         for s in range(K):
             pc = jnp.einsum("cik,cil->ckl", ohe, xc,
                             preferred_element_type=jnp.float32)
-            xc = xc + pc[:, :, :128].astype(jnp.bfloat16)[:, :R_C % 256 or 256][:, :R_C].reshape(C, -1, 128)[:, :R_C, :] * 1e-9 \
-                if False else xc + pc[:, :R_C, :].astype(jnp.bfloat16) * 1e-9
+            xc = xc + pc[:, :R_C, :].astype(jnp.bfloat16) * 1e-9
         return xc
 
     xc = jnp.ones((C, R_C, 128), jnp.bfloat16)

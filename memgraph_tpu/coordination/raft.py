@@ -217,8 +217,15 @@ class RaftNode:
         host, port = self.peers[peer_id]
         data = json.dumps(request).encode("utf-8")
         # first attempt reuses the pooled connection (may be stale if the
-        # peer restarted); second attempt always dials fresh
+        # peer restarted); second attempt always dials fresh. Both share
+        # ONE deadline so a black-holed peer costs at most `timeout`, not
+        # 2x — election rounds poll peers sequentially and a doubled stall
+        # per dead peer would eat the election deadline.
+        deadline = time.monotonic() + timeout
         for attempt in (0, 1):
+            budget = deadline - time.monotonic()
+            if budget <= 0.0:
+                return None
             with self._peer_conns_lock:
                 sock = self._peer_conns.pop(peer_id, None)
             try:
@@ -227,9 +234,9 @@ class RaftNode:
                         continue
                     from ..utils.tls import wrap_cluster_client
                     raw = socket.create_connection((host, port),
-                                                   timeout=timeout)
+                                                   timeout=budget)
                     sock = wrap_cluster_client(raw, server_hostname=host)
-                sock.settimeout(timeout)
+                sock.settimeout(budget)
                 P.send_frame(sock, MSG_RAFT, data)
                 msg_type, payload = P.recv_frame(sock)
                 if msg_type != MSG_RAFT:

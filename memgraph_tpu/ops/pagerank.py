@@ -16,6 +16,7 @@ contribute nothing.
 from __future__ import annotations
 
 import os
+import threading
 from functools import partial
 
 import jax
@@ -29,6 +30,10 @@ from .csr import DeviceGraph
 # zero setup cost wins. Plan+kernel are cached on the DeviceGraph snapshot,
 # so repeated CALLs on an unchanged graph pay the build once.
 MXU_MIN_EDGES = int(os.environ.get("MEMGRAPH_TPU_MXU_MIN_EDGES", 500_000))
+
+# serializes the expensive plan build so concurrent first CALLs on the
+# same snapshot don't each run it (~35s host-side at 10M edges)
+_mxu_build_lock = threading.Lock()
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
@@ -82,14 +87,17 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
     from . import spmv_mxu
     cached = getattr(graph, "_mxu_state", None)
     if cached is None:
-        # true edges only: padding edges sort to the end (sink rows)
-        src = np.asarray(graph.src_idx)[:graph.n_edges]
-        dst = np.asarray(graph.col_idx)[:graph.n_edges]
-        w = np.asarray(graph.weights)[:graph.n_edges]
-        plan = spmv_mxu.build_plan(src, dst, w, graph.n_nodes)
-        cached = (plan, spmv_mxu.make_pagerank_kernel(plan))
-        # DeviceGraph is a frozen dataclass; bypass its setattr guard
-        object.__setattr__(graph, "_mxu_state", cached)
+        with _mxu_build_lock:
+            cached = getattr(graph, "_mxu_state", None)
+            if cached is None:
+                # true edges only: padding edges sort to the end (sinks)
+                src = np.asarray(graph.src_idx)[:graph.n_edges]
+                dst = np.asarray(graph.col_idx)[:graph.n_edges]
+                w = np.asarray(graph.weights)[:graph.n_edges]
+                plan = spmv_mxu.build_plan(src, dst, w, graph.n_nodes)
+                cached = (plan, spmv_mxu.make_pagerank_kernel(plan))
+                # DeviceGraph is frozen; bypass its setattr guard
+                object.__setattr__(graph, "_mxu_state", cached)
     plan, run = cached
     node_flat = plan.G * spmv_mxu.SG_ROWS * spmv_mxu.LANES
     rank0 = np.zeros(node_flat, dtype=np.float32)
