@@ -127,7 +127,24 @@ def main():
         run_group(client, "aggregate",
                   "MATCH (n:User) RETURN count(n), avg(n.age)", None,
                   max(args.iterations // 10, 5)),
+        # intra-query parallel execution (columnar scan+filter+aggregate)
+        # vs the same work through the serial Volcano path (`n.age + 0`
+        # makes the filter ineligible for the columnar rewrite)
+        run_group(client, "scan_aggregate_parallel",
+                  "MATCH (n:User) WHERE n.age > 40 "
+                  "RETURN count(*), sum(n.age)", None,
+                  max(args.iterations // 10, 5), warmup=1),
+        run_group(client, "scan_aggregate_serial",
+                  "MATCH (n:User) WHERE n.age + 0 > 40 "
+                  "RETURN count(*), sum(n.age)", None,
+                  max(args.iterations // 30, 3)),
     ]
+    par = next((g for g in groups if g["name"] == "scan_aggregate_parallel"
+                and "mean_ms" in g), None)
+    ser = next((g for g in groups if g["name"] == "scan_aggregate_serial"
+                and "mean_ms" in g), None)
+    if par and ser:
+        par["speedup_vs_serial"] = round(ser["mean_ms"] / par["mean_ms"], 1)
     client.close()
     # the analytical group gets its own client with a wide timeout (first
     # CALL pays XLA compilation) and one discarded warm-up run

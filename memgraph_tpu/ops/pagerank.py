@@ -31,9 +31,11 @@ from .csr import DeviceGraph
 # so repeated CALLs on an unchanged graph pay the build once.
 MXU_MIN_EDGES = int(os.environ.get("MEMGRAPH_TPU_MXU_MIN_EDGES", 500_000))
 
-# serializes the expensive plan build so concurrent first CALLs on the
-# same snapshot don't each run it (~35s host-side at 10M edges)
-_mxu_build_lock = threading.Lock()
+# serializes the expensive plan build PER GRAPH so concurrent first CALLs
+# on one snapshot don't each run it (~35s host-side at 10M edges), while
+# unrelated graphs build in parallel; the registry lock only guards the
+# per-graph lock creation
+_mxu_locks_guard = threading.Lock()
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
@@ -87,7 +89,12 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
     from . import spmv_mxu
     cached = getattr(graph, "_mxu_state", None)
     if cached is None:
-        with _mxu_build_lock:
+        with _mxu_locks_guard:
+            lock = getattr(graph, "_mxu_build_lock", None)
+            if lock is None:
+                lock = threading.Lock()
+                object.__setattr__(graph, "_mxu_build_lock", lock)
+        with lock:
             cached = getattr(graph, "_mxu_state", None)
             if cached is None:
                 # true edges only: padding edges sort to the end (sinks)

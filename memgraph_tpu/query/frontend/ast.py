@@ -205,6 +205,7 @@ class Match(Clause):
     optional: bool = False
     index_hints: list = field(default_factory=list)
     hops_limit: Optional[int] = None
+    parallel: bool = False       # USING PARALLEL EXECUTION hint
 
 
 @dataclass

@@ -33,6 +33,7 @@ KEYWORDS = {
     "START", "STOP", "TOPICS", "TRANSFORM", "BATCH_SIZE", "BATCH_INTERVAL",
     "CONSUMER_GROUP", "BOOTSTRAP_SERVERS", "CHECK", "SERVICE_URL", "TTL",
     "AT", "EVERY", "ENABLE", "DISABLE", "USING", "PERIODIC", "HOPS",
+    "PARALLEL", "EXECUTION",
     "KEY", "OF", "TYPE", "POINT", "TEXT", "VECTORS", "PASSWORD", "USER",
     "ROLE", "PRIVILEGES", "GRANT", "DENY", "REVOKE", "TO", "FOR", "METRICS",
     "REPLICA", "REPLICAS", "MAIN", "REPLICATION", "REGISTER", "SYNC", "USE", "DATABASES",

@@ -841,9 +841,13 @@ class Parser:
         patterns = self.parse_pattern_list()
         index_hints = []
         hops_limit = None
+        parallel = False
         while self.at_kw("USING"):
             self.advance()
-            if self.accept_kw("INDEX"):
+            if self.accept_kw("PARALLEL"):
+                self.expect_kw("EXECUTION")
+                parallel = True
+            elif self.accept_kw("INDEX"):
                 var = self.name_token()
                 self.expect(":")
                 label = self.name_token()
@@ -858,11 +862,13 @@ class Parser:
                 self.expect_kw("LIMIT")
                 hops_limit = self.expect(T.INT).value
             else:
-                self.error("expected INDEX or HOPS LIMIT after USING")
+                self.error("expected INDEX, HOPS LIMIT or PARALLEL "
+                           "EXECUTION after USING")
         where = None
         if self.accept_kw("WHERE"):
             where = self.parse_expression()
-        return A.Match(patterns, where, optional, index_hints, hops_limit)
+        return A.Match(patterns, where, optional, index_hints, hops_limit,
+                       parallel)
 
     def parse_merge(self) -> A.Merge:
         self.expect_kw("MERGE")

@@ -1,0 +1,301 @@
+"""Intra-query parallel execution: columnar scan+filter+aggregate.
+
+TPU-first analog of the reference's parallel operators
+(/root/reference/src/query/plan/operator.hpp:1925-2273 — ScanAllParallel,
+AggregateParallelBase, ParallelMerge — and the plan rewriter in
+plan/rewrite/parallel_rewrite.hpp). Instead of sharding the Volcano
+iterator across a thread pool, an eligible
+    Produce <- Aggregate <- Filter* <- ScanAll[ByLabel] <- Once
+tail is collapsed into ONE operator that evaluates the filters and
+aggregates as whole-column vectorized kernels over a cached columnar
+snapshot (ops/columnar.py). Anything the columnar engine cannot express
+falls back to the original row-at-a-time subplan at runtime — semantics
+are identical by construction, the rewrite is purely an execution
+strategy.
+
+Eligibility (matched at plan time):
+  - Aggregate with no GROUP BY keys, aggregations in
+    count(*)/count/sum/min/max/avg, non-DISTINCT, over a property of the
+    scanned symbol;
+  - filters that AND-decompose into `sym.prop <op> literal/parameter`
+    (op in =, <>, <, <=, >, >=) or a redundant label test on the scan's
+    own label.
+
+Cypher three-valued logic is preserved: a predicate over an absent
+property is NULL -> row excluded; cross-type equality is false; ordering
+comparisons across types are NULL (both exclude); count/sum over zero
+rows are 0, min/max/avg are NULL.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+from typing import Optional
+
+import numpy as np
+
+from ...ops.columnar import COLUMNAR_CACHE
+from ..frontend import ast as A
+from . import operators as Op
+
+_CMP_OPS = {"=", "<>", "<", "<=", ">", ">="}
+_AGG_KINDS = {"count", "sum", "min", "max", "avg"}
+
+# below this row count the row-at-a-time path is cheaper than a column
+# sweep (and than a device dispatch, once offloaded); hints force through
+MIN_ROWS = int(os.environ.get("MEMGRAPH_TPU_PARALLEL_MIN_ROWS", 1024))
+
+
+class _Unsupported(Exception):
+    pass
+
+
+@dataclass
+class ParallelScanAggregate(Op.LogicalOperator):
+    """Single-operator columnar scan+filter+aggregate with row fallback."""
+    input: Op.LogicalOperator          # Once
+    fallback: Op.LogicalOperator       # the original Aggregate subplan
+    symbol: str
+    label: Optional[str]
+    predicates: list                   # [(prop, op, rhs A.Expr), ...]
+    aggregations: list                 # [(kind, prop|None, out name), ...]
+    hinted: bool = False
+
+    def cursor(self, ctx):
+        try:
+            yield self._columnar_row(ctx)
+            return
+        except _Unsupported:
+            pass
+        yield from self.fallback.cursor(ctx)
+
+    # -- columnar path ----------------------------------------------------
+
+    def _columnar_row(self, ctx) -> dict:
+        props = tuple(sorted(
+            {p for p, _, _ in self.predicates}
+            | {p for _, p, _ in self.aggregations if p is not None}))
+        snap = COLUMNAR_CACHE.get(ctx.accessor, self.label, props,
+                                  ctx.view, abort_check=ctx.check_abort)
+        ctx.check_abort()
+        if snap.n < MIN_ROWS and not self.hinted:
+            raise _Unsupported
+        mask = np.ones(snap.n, dtype=bool)
+        for prop, op, rhs_expr in self.predicates:
+            mask &= self._pred_mask(ctx, snap, prop, op, rhs_expr)
+        out: dict = {}
+        for kind, prop, name in self.aggregations:
+            out[name] = self._aggregate(snap, mask, kind, prop)
+        return out
+
+    def _pred_mask(self, ctx, snap, prop, op, rhs_expr) -> np.ndarray:
+        rhs = ctx.evaluator.eval(rhs_expr, {})
+        col = snap.columns[prop]
+        n = snap.n
+        if rhs is None:
+            return np.zeros(n, dtype=bool)       # NULL comparison -> NULL
+        if col.kind == "other":
+            if not col.present.any():
+                # vacuous column: no present value, every row excluded
+                return np.zeros(n, dtype=bool)
+            raise _Unsupported
+        if isinstance(rhs, bool):
+            if col.kind != "bool":
+                return self._type_mismatch(col, op, n)
+            rhs_v: object = 1 if rhs else 0
+        elif isinstance(rhs, (int, float)):
+            if col.kind not in ("int", "float"):
+                return self._type_mismatch(col, op, n)
+            rhs_v = rhs
+        elif isinstance(rhs, str):
+            if col.kind != "str":
+                return self._type_mismatch(col, op, n)
+            if op not in ("=", "<>"):
+                raise _Unsupported  # lexicographic order not dict-coded
+            code = col.vocab.get(rhs)
+            if code is None:
+                return (np.zeros(n, dtype=bool) if op == "=" else
+                        col.present.copy())
+            eq = (col.values == code) & col.present
+            return eq if op == "=" else (~eq & col.present)
+        else:
+            raise _Unsupported                   # list/map/temporal rhs
+        v = col.values
+        if op == "=":
+            m = v == rhs_v
+        elif op == "<>":
+            m = v != rhs_v
+        elif op == "<":
+            m = v < rhs_v
+        elif op == "<=":
+            m = v <= rhs_v
+        elif op == ">":
+            m = v > rhs_v
+        else:
+            m = v >= rhs_v
+        return m & col.present
+
+    @staticmethod
+    def _type_mismatch(col, op, n) -> np.ndarray:
+        # Cypher: cross-type equality is false, <> is true (for non-null
+        # values); ordering across types is NULL. All exclude on =/</...;
+        # <> keeps every present row.
+        if op == "<>":
+            return col.present.copy()
+        return np.zeros(n, dtype=bool)
+
+    def _aggregate(self, snap, mask, kind, prop):
+        if kind == "count" and prop is None:
+            return int(mask.sum())
+        col = snap.columns[prop]
+        sel = mask & col.present
+        if kind == "count":
+            return int(sel.sum())
+        if col.kind not in ("int", "float"):
+            raise _Unsupported      # sum/min/max/avg over non-numerics
+        vals = col.values[sel]
+        if kind == "sum":
+            if vals.size == 0:
+                return 0
+            if col.kind == "int":
+                # int64 accumulation can wrap; the row path sums exact
+                # Python ints. Guard: re-sum exactly when magnitudes
+                # could overflow.
+                if int(np.abs(vals).max()) > (2**62) // max(vals.size, 1):
+                    return sum(int(v) for v in vals)
+                return int(vals.sum())
+            return float(vals.sum())
+        if vals.size == 0:
+            return None             # min/max/avg over no rows
+        if kind == "min":
+            m = vals.min()
+        elif kind == "max":
+            m = vals.max()
+        else:
+            return float(vals.mean())
+        return int(m) if col.kind == "int" else float(m)
+
+
+# -------------------------------------------------------------------------
+# plan rewrite
+# -------------------------------------------------------------------------
+
+def _split_and(expr):
+    if isinstance(expr, A.Binary) and expr.op == "AND":
+        return _split_and(expr.left) + _split_and(expr.right)
+    return [expr]
+
+
+def _as_predicate(cond, sym: str, label: Optional[str]):
+    """Return (prop, op, rhs_expr) if `cond` is columnar-expressible on
+    `sym`, None otherwise."""
+    if isinstance(cond, A.LabelsTest) and \
+            isinstance(cond.expr, A.Identifier) and cond.expr.name == sym \
+            and label is not None and cond.labels == [label]:
+        return ()  # redundant with the label scan: drop
+    if not isinstance(cond, A.Binary) or cond.op not in _CMP_OPS:
+        return None
+    lhs, rhs, op = cond.left, cond.right, cond.op
+    if not _is_prop_of(lhs, sym):
+        if not _is_prop_of(rhs, sym):
+            return None
+        lhs, rhs = rhs, lhs
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+    if not _is_const(rhs):
+        return None
+    return (lhs.prop, op, rhs)
+
+
+def _is_const(e) -> bool:
+    if isinstance(e, (A.Literal, A.Parameter)):
+        return True
+    return (isinstance(e, A.Unary) and e.op in ("-", "+")
+            and isinstance(e.expr, A.Literal))
+
+
+def _is_prop_of(e, sym: str) -> bool:
+    return (isinstance(e, A.PropertyLookup)
+            and isinstance(e.expr, A.Identifier) and e.expr.name == sym)
+
+
+def _match_tail(agg: Op.Aggregate, hinted: bool):
+    """Match Aggregate <- Filter* <- ScanAll[ByLabel] <- Once."""
+    if agg.group_by or agg.remember:
+        return None
+    aggregations = []
+    for spec in agg.aggregations:
+        kind, expr, distinct = spec[0], spec[1], spec[2]
+        name = spec[3]
+        if kind not in _AGG_KINDS or distinct:
+            return None
+        if len(spec) > 4 and spec[4] is not None:
+            return None
+        if expr is None:
+            if kind != "count":
+                return None
+            aggregations.append((kind, None, name))
+            continue
+        if kind == "count" and isinstance(expr, A.Identifier):
+            # count(n) over a scanned symbol == count(*): n is never null
+            aggregations.append((kind, None, name))
+            continue
+        if not isinstance(expr, A.PropertyLookup) or \
+                not isinstance(expr.expr, A.Identifier):
+            return None
+        aggregations.append((kind, expr.prop, name))
+
+    filters = []
+    node = agg.input
+    while isinstance(node, Op.Filter):
+        filters.append(node.expr)
+        node = node.input
+    if isinstance(node, Op.ScanAllByLabel):
+        sym, label = node.symbol, node.label
+    elif isinstance(node, Op.ScanAll):
+        sym, label = node.symbol, None
+    else:
+        return None
+    if not isinstance(node.input, Op.Once):
+        return None
+    # every aggregated expression must target the scanned symbol
+    for spec in agg.aggregations:
+        expr = spec[1]
+        if expr is None:
+            continue
+        if isinstance(expr, A.Identifier):
+            if expr.name != sym:
+                return None
+        elif expr.expr.name != sym:
+            return None
+
+    predicates = []
+    for f in filters:
+        for cond in _split_and(f):
+            pred = _as_predicate(cond, sym, label)
+            if pred is None:
+                return None
+            if pred == ():
+                continue
+            predicates.append(pred)
+    return ParallelScanAggregate(
+        input=Op.Once(), fallback=agg, symbol=sym, label=label,
+        predicates=predicates, aggregations=aggregations, hinted=hinted)
+
+
+def parallel_rewrite(plan, hinted: bool = False):
+    """Walk the plan, replacing eligible Aggregate tails in place.
+    Reference analog: plan/rewrite/parallel_rewrite.hpp."""
+    if os.environ.get("MEMGRAPH_TPU_DISABLE_PARALLEL"):
+        return plan
+    if isinstance(plan, Op.Aggregate):
+        repl = _match_tail(plan, hinted)
+        if repl is not None:
+            return repl
+    if not hasattr(plan, "__dataclass_fields__"):
+        return plan
+    for f in fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, Op.LogicalOperator):
+            setattr(plan, f.name, parallel_rewrite(v, hinted))
+    return plan

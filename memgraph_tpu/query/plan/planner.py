@@ -118,6 +118,7 @@ class Planner:
         clauses = single.clauses
         has_update = False
         produced = False
+        parallel_hint = False   # USING PARALLEL EXECUTION, this query only
 
         # clause-at-a-time visibility: a reading clause after an updating
         # one (and vice versa) gets an Eager barrier so scans never
@@ -138,6 +139,8 @@ class Planner:
                         "InvalidClauseComposition: MATCH cannot follow "
                         "OPTIONAL MATCH (use a WITH between them)")
                 prev_optional = clause.optional
+                if clause.parallel:
+                    parallel_hint = True
                 self._validate_match(clause, bound, kinds)
             if isinstance(clause, _READING) and write_seen:
                 plan = Op.Eager(plan)
@@ -278,6 +281,8 @@ class Planner:
             # write-only query: WITH projections along the way must not
             # leak as result columns — such queries stream zero records
             columns = []
+        from .parallel import parallel_rewrite
+        plan = parallel_rewrite(plan, hinted=parallel_hint)
         return plan, columns
 
     def _call_fields(self, clause: A.CallProcedure) -> list[str]:

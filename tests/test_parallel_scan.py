@@ -1,0 +1,220 @@
+"""Intra-query parallel execution: columnar scan+filter+aggregate.
+
+Oracle: the serial Volcano path (MEMGRAPH_TPU_DISABLE_PARALLEL) — the
+rewrite is an execution strategy, so results must be identical on every
+query, including NULL/absent-property and cross-type semantics.
+
+Reference analog: tests around ScanAllParallel/AggregateParallel
+(/root/reference/src/query/plan/operator.hpp:1925-2273).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.query.plan.parallel import ParallelScanAggregate
+from memgraph_tpu.storage import (InMemoryStorage, StorageConfig,
+                                  StorageMode)
+
+
+@pytest.fixture()
+def db():
+    storage = InMemoryStorage(StorageConfig(
+        storage_mode=StorageMode.IN_MEMORY_TRANSACTIONAL))
+    ctx = InterpreterContext(storage)
+    acc = storage.access()
+    lid = storage.label_mapper.name_to_id("P")
+    px = storage.property_mapper.name_to_id("x")
+    pf = storage.property_mapper.name_to_id("f")
+    ps = storage.property_mapper.name_to_id("s")
+    pb = storage.property_mapper.name_to_id("b")
+    rng = np.random.default_rng(7)
+    for i in range(3000):
+        v = acc.create_vertex()
+        v.add_label(lid)
+        v.set_property(px, int(rng.integers(-50, 50)))
+        if i % 3 == 0:
+            v.set_property(pf, float(rng.random() * 10 - 5))
+        if i % 4 != 0:
+            v.set_property(ps, str(rng.choice(["red", "green", "blue"])))
+        if i % 5 == 0:
+            v.set_property(pb, bool(rng.integers(0, 2)))
+    acc.commit()
+    return ctx
+
+
+def both(ctx, query, params=None):
+    """Run via parallel and serial paths; assert identical rows."""
+    interp = Interpreter(ctx)
+    os.environ.pop("MEMGRAPH_TPU_DISABLE_PARALLEL", None)
+    ctx.invalidate_plans()
+    _, par, _ = interp.execute(query, params)
+    os.environ["MEMGRAPH_TPU_DISABLE_PARALLEL"] = "1"
+    ctx.invalidate_plans()
+    try:
+        _, ser, _ = interp.execute(query, params)
+    finally:
+        os.environ.pop("MEMGRAPH_TPU_DISABLE_PARALLEL", None)
+        ctx.invalidate_plans()
+    assert _approx(par, ser), (query, par, ser)
+    return par
+
+
+def _approx(a, b):
+    """Row-set equality, tolerating last-ulp float differences (numpy's
+    pairwise summation vs the serial path's sequential sum)."""
+    if isinstance(a, float) and isinstance(b, float):
+        return a == pytest.approx(b, rel=1e-12, abs=1e-12)
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(
+            _approx(x, y) for x, y in zip(a, b))
+    return a == b and type(a) is type(b)
+
+
+def plan_uses_parallel(ctx, query) -> bool:
+    interp = Interpreter(ctx)
+    ctx.invalidate_plans()
+    _, rows, _ = interp.execute("EXPLAIN " + query)
+    return any("ParallelScanAggregate" in r[0] for r in rows)
+
+
+HINT = "USING PARALLEL EXECUTION "
+
+
+class TestParity:
+    @pytest.mark.parametrize("q", [
+        "MATCH (n:P) %s RETURN count(*) AS c",
+        "MATCH (n:P) %s RETURN count(n.x) AS c, count(n.s) AS c2",
+        "MATCH (n:P) %s WHERE n.x > 10 RETURN sum(n.x) AS s",
+        "MATCH (n:P) %s WHERE n.x >= -5 AND n.x <= 5 RETURN min(n.x) AS "
+        "mn, max(n.x) AS mx, avg(n.x) AS av",
+        "MATCH (n:P) %s WHERE n.f < 0.0 RETURN count(*) AS c, avg(n.f) "
+        "AS av",
+        "MATCH (n:P) %s WHERE n.s = 'red' RETURN count(*) AS c",
+        "MATCH (n:P) %s WHERE n.s <> 'red' RETURN count(*) AS c",
+        "MATCH (n:P) %s WHERE n.b = true RETURN count(*) AS c",
+        "MATCH (n:P) %s WHERE 10 < n.x RETURN count(*) AS c",  # flipped
+        "MATCH (n) %s WHERE n.x = 0 RETURN count(*) AS c",     # no label
+    ])
+    def test_query_parity(self, db, q):
+        query = q % HINT
+        assert plan_uses_parallel(db, query), query
+        both(db, query)
+
+    def test_parameter_rhs(self, db):
+        q = f"MATCH (n:P) {HINT}WHERE n.x > $k RETURN count(*) AS c"
+        assert plan_uses_parallel(db, q)
+        r = both(db, q, {"k": 25})
+        assert r[0][0] > 0
+
+    def test_null_and_crosstype_semantics(self, db):
+        # absent property -> NULL comparison -> excluded
+        both(db, f"MATCH (n:P) {HINT}WHERE n.missing > 0 "
+                 "RETURN count(*) AS c")
+        # NULL literal rhs excludes everything
+        both(db, f"MATCH (n:P) {HINT}WHERE n.x > null RETURN count(*) AS c")
+        # cross-type: string column vs number (equality false, <> true)
+        both(db, f"MATCH (n:P) {HINT}WHERE n.s = 3 RETURN count(*) AS c")
+        both(db, f"MATCH (n:P) {HINT}WHERE n.s <> 3 RETURN count(*) AS c")
+        # ordering across types is NULL -> excluded
+        both(db, f"MATCH (n:P) {HINT}WHERE n.s > 3 RETURN count(*) AS c")
+
+    def test_sum_type_preserved(self, db):
+        r = both(db, f"MATCH (n:P) {HINT}RETURN sum(n.x) AS s")
+        assert isinstance(r[0][0], int)
+        r = both(db, f"MATCH (n:P) {HINT}RETURN sum(n.f) AS s")
+        assert isinstance(r[0][0], float)
+
+    def test_empty_input_aggregates(self, db):
+        r = both(db, f"MATCH (n:P) {HINT}WHERE n.x > 10000 RETURN "
+                     "count(*) AS c, sum(n.x) AS s, min(n.x) AS mn, "
+                     "avg(n.x) AS av")
+        assert r == [[0, 0, None, None]]
+
+
+class TestEligibility:
+    def test_group_by_not_rewritten(self, db):
+        assert not plan_uses_parallel(
+            db, "MATCH (n:P) RETURN n.s AS s, count(*) AS c")
+
+    def test_distinct_not_rewritten(self, db):
+        assert not plan_uses_parallel(
+            db, "MATCH (n:P) RETURN count(DISTINCT n.x) AS c")
+
+    def test_expand_not_rewritten(self, db):
+        assert not plan_uses_parallel(
+            db, "MATCH (n:P)-[]->(m) RETURN count(*) AS c")
+
+    def test_complex_predicate_not_rewritten(self, db):
+        assert not plan_uses_parallel(
+            db, "MATCH (n:P) WHERE n.x + 1 > 2 RETURN count(*) AS c")
+        assert not plan_uses_parallel(
+            db, "MATCH (n:P) WHERE n.x > n.f RETURN count(*) AS c")
+
+    def test_auto_mode_large_scan(self, db):
+        # no hint needed: rewrite applies automatically (runtime falls
+        # back below MIN_ROWS; here we only check the plan shape)
+        assert plan_uses_parallel(
+            db, "MATCH (n:P) WHERE n.x > 0 RETURN count(*) AS c")
+
+    def test_fallback_on_unsupported_aggregate(self, db):
+        # min over strings: columnar path refuses, row fallback answers
+        q = f"MATCH (n:P) {HINT}RETURN min(n.s) AS m"
+        assert plan_uses_parallel(db, q)
+        r = both(db, q)
+        assert r[0][0] == "blue"
+
+    def test_string_ordering_falls_back(self, db):
+        q = f"MATCH (n:P) {HINT}WHERE n.s > 'green' RETURN count(*) AS c"
+        assert plan_uses_parallel(db, q)
+        both(db, q)
+
+
+class TestMVCC:
+    def test_own_uncommitted_writes_visible(self, db):
+        interp = Interpreter(db)
+        interp.execute("BEGIN")
+        interp.execute("CREATE (:P {x: 12345})")
+        q = f"MATCH (n:P) {HINT}WHERE n.x = 12345 RETURN count(*) AS c"
+        _, rows, _ = interp.execute(q)
+        assert rows == [[1]]
+        interp.execute("ROLLBACK")
+        _, rows, _ = interp.execute(q)
+        assert rows == [[0]]
+
+    def test_other_txn_uncommitted_invisible(self, db):
+        w = Interpreter(db)
+        w.execute("BEGIN")
+        w.execute("CREATE (:P {x: 54321})")
+        r = Interpreter(db)
+        _, rows, _ = r.execute(
+            f"MATCH (n:P) {HINT}WHERE n.x = 54321 RETURN count(*) AS c")
+        assert rows == [[0]]
+        w.execute("COMMIT")
+        _, rows, _ = r.execute(
+            f"MATCH (n:P) {HINT}WHERE n.x = 54321 RETURN count(*) AS c")
+        assert rows == [[1]]
+
+    def test_cache_invalidation_on_commit(self, db):
+        interp = Interpreter(db)
+        q = f"MATCH (n:P) {HINT}RETURN count(*) AS c"
+        _, rows1, _ = interp.execute(q)
+        interp.execute("CREATE (:P {x: 1})")
+        _, rows2, _ = interp.execute(q)
+        assert rows2[0][0] == rows1[0][0] + 1
+
+
+class TestHintParsing:
+    def test_hint_roundtrip(self, db):
+        interp = Interpreter(db)
+        _, rows, _ = interp.execute(
+            "MATCH (n:P) USING PARALLEL EXECUTION WHERE n.x > 0 "
+            "RETURN count(*) AS c")
+        assert rows[0][0] > 0
+
+    def test_bad_hint_rejected(self, db):
+        interp = Interpreter(db)
+        with pytest.raises(Exception):
+            interp.execute("MATCH (n:P) USING PARALLEL RETURN n")
