@@ -56,6 +56,31 @@ def run_group(client, name, query, param_fn, iterations, warmup=0):
             **percentiles(samples)}
 
 
+def _client_worker(port, n_iter, n_nodes, barrier, queue):
+    """Point-read loop in a separate process (own GIL). Waits on the
+    barrier after import+connect+warmup so measured time excludes
+    process startup, then reports its own (start, end) window."""
+    import os
+    import random as _random
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from memgraph_tpu.server.client import BoltClient
+    c = BoltClient(port=port)
+    try:
+        local = _random.Random()
+        for _ in range(20):   # warmup
+            c.execute("MATCH (n:User {id: $id}) RETURN n.age",
+                      {"id": local.randrange(n_nodes)})
+        barrier.wait()
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            c.execute("MATCH (n:User {id: $id}) RETURN n.age",
+                      {"id": local.randrange(n_nodes)})
+        queue.put((t0, time.perf_counter(), n_iter))
+    finally:
+        c.close()
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10_000)
@@ -63,6 +88,8 @@ def main():
     p.add_argument("--iterations", type=int, default=300)
     p.add_argument("--port", type=int, default=0,
                    help="existing server port (0 = spawn in-process)")
+    p.add_argument("--clients", type=int, default=8,
+                   help="connections for the multi-client scaling group")
     args = p.parse_args()
 
     import os
@@ -145,6 +172,48 @@ def main():
                 and "mean_ms" in g), None)
     if par and ser:
         par["speedup_vs_serial"] = round(ser["mean_ms"] / par["mean_ms"], 1)
+
+    # multi-client scaling: N concurrent connections hammering point
+    # reads. Clients run as separate PROCESSES so their encode/decode CPU
+    # doesn't share the server's GIL; server-side execution runs on the
+    # Bolt worker pool.
+    import multiprocessing as mp
+
+    mp_ctx = mp.get_context("spawn")
+    for n_clients in (1, args.clients):
+        barrier = mp_ctx.Barrier(n_clients)
+        queue = mp_ctx.Queue()
+        procs = [mp_ctx.Process(
+            target=_client_worker,
+            args=(port, args.iterations, args.nodes, barrier, queue))
+            for _ in range(n_clients)]
+        for t in procs:
+            t.start()
+        try:
+            spans = [queue.get(timeout=120) for _ in range(n_clients)]
+        except Exception as e:   # a dead worker must not hang the bench
+            for t in procs:
+                t.terminate()
+            groups.append({
+                "name": f"point_read_{n_clients}_clients",
+                "clients": n_clients,
+                "error": f"{type(e).__name__}: worker died or timed out"})
+            continue
+        finally:
+            for t in procs:
+                t.join(timeout=10)
+        total = sum(s[2] for s in spans)
+        wall = max(s[1] for s in spans) - min(s[0] for s in spans)
+        groups.append({
+            "name": f"point_read_{n_clients}_clients",
+            "clients": n_clients,
+            "aggregate_qps": round(total / wall, 1),
+        })
+    one = next(g for g in groups if g["name"] == "point_read_1_clients")
+    many = next(g for g in groups
+                if g["name"] == f"point_read_{args.clients}_clients")
+    many["scaling_vs_1_client"] = round(
+        many["aggregate_qps"] / one["aggregate_qps"], 2)
     client.close()
     # the analytical group gets its own client with a wide timeout (first
     # CALL pays XLA compilation) and one discarded warm-up run

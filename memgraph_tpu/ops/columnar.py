@@ -39,6 +39,8 @@ class Column:
     values: np.ndarray | None      # typed values (None for "other")
     present: np.ndarray            # (n,) bool
     vocab: dict | None = None      # str value -> code, for kind "str"
+    big: bool = False              # int column holds |v| > 2^53: a float
+    #                                rhs comparison would lose exactness
 
 
 @dataclass
@@ -76,7 +78,9 @@ def _classify(values: list, present: np.ndarray) -> Column:
         for i, (v, p) in enumerate(zip(values, present)):
             if p:
                 out[i] = v
-        return Column("int", out, present)
+        big = any(p and not -2**53 <= v <= 2**53
+                  for v, p in zip(values, present))
+        return Column("int", out, present, big=big)
     if kinds <= {"int", "float"}:
         # mixed numerics coerce to f64; an int beyond 2^53 would lose
         # exactness (= / < would diverge from the row path) -> opt out

@@ -107,6 +107,13 @@ class ParallelScanAggregate(Op.LogicalOperator):
         elif isinstance(rhs, (int, float)):
             if col.kind not in ("int", "float"):
                 return self._type_mismatch(col, op, n)
+            # cross-dtype compare happens in float64; beyond 2^53 that
+            # diverges from the row path's exact int-vs-float compare
+            if col.kind == "int" and isinstance(rhs, float) and col.big:
+                raise _Unsupported
+            if col.kind == "float" and isinstance(rhs, int) \
+                    and not -2**53 <= rhs <= 2**53:
+                raise _Unsupported
             rhs_v = rhs
         elif isinstance(rhs, str):
             if col.kind != "str":

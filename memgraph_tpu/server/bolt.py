@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import struct
 
 from ..exceptions import MemgraphTpuError, QueryException
@@ -214,7 +215,8 @@ class BoltSession:
     protocol to an Interpreter.
     """
 
-    def __init__(self, reader, writer, interpreter_context, auth=None):
+    def __init__(self, reader, writer, interpreter_context, auth=None,
+                 executor=None):
         self.reader = reader
         self.writer = writer
         self.ictx = interpreter_context
@@ -224,6 +226,21 @@ class BoltSession:
         self.authenticated = False
         self.failed = False  # FAILURE → ignore until RESET
         self._prepared = None
+        # interpreter work (parse/plan/execute/pull) runs on this pool so
+        # one session's long query never blocks the event loop — the
+        # reference runs sessions on a work-stealing priority pool
+        # (utils/priority_thread_pool.hpp); numpy/JAX sections release
+        # the GIL, so columnar scans and device kernels overlap for real.
+        # Protocol reads/writes stay on the loop (transports are not
+        # thread-safe); per-session ordering is preserved because the
+        # message loop awaits each dispatch before reading the next.
+        self._executor = executor
+
+    async def _offload(self, fn, *args):
+        if self._executor is None:
+            return fn(*args)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, fn, *args)
 
     # --- wire framing -------------------------------------------------------
 
@@ -340,21 +357,23 @@ class BoltSession:
                 self.send_success()
                 return True
             if sig == M_RUN:
-                return self.on_run(*msg.fields)
+                return await self.on_run(*msg.fields)
             if sig == M_PULL:
-                return self.on_pull(msg.fields[0] if msg.fields else {})
+                return await self.on_pull(
+                    msg.fields[0] if msg.fields else {})
             if sig == M_DISCARD:
-                return self.on_discard(msg.fields[0] if msg.fields else {})
+                return await self.on_discard(
+                    msg.fields[0] if msg.fields else {})
             if sig == M_BEGIN:
-                self.interpreter.execute("BEGIN")
+                await self._offload(self.interpreter.execute, "BEGIN")
                 self.send_success()
                 return True
             if sig == M_COMMIT:
-                self.interpreter.execute("COMMIT")
+                await self._offload(self.interpreter.execute, "COMMIT")
                 self.send_success({"bookmark": "mg-bookmark"})
                 return True
             if sig == M_ROLLBACK:
-                self.interpreter.execute("ROLLBACK")
+                await self._offload(self.interpreter.execute, "ROLLBACK")
                 self.send_success()
                 return True
             if sig == M_ROUTE:
@@ -422,21 +441,23 @@ class BoltSession:
         self.send_success()
         return True
 
-    def on_run(self, query: str, parameters: dict = None,
-               extra: dict = None) -> bool:
+    async def on_run(self, query: str, parameters: dict = None,
+                     extra: dict = None) -> bool:
         parameters = {k: bolt_to_value(v)
                       for k, v in (parameters or {}).items()}
-        prepared = self.interpreter.prepare(query, parameters)
+        prepared = await self._offload(self.interpreter.prepare, query,
+                                       parameters)
         self._prepared = prepared
         self.send_success({"fields": prepared.columns, "t_first": 0,
                            "qid": 0})
         return True
 
-    def on_pull(self, extra: dict) -> bool:
+    async def on_pull(self, extra: dict) -> bool:
         n = extra.get("n", -1)
         storage = self.interpreter.ctx.storage  # honors USE DATABASE
         from ..storage.common import View
-        rows, has_more, summary = self.interpreter.pull(n)
+        rows, has_more, summary = await self._offload(
+            self.interpreter.pull, n)
         for row in rows:
             self.send(M_RECORD,
                       [value_to_bolt(v, storage, View.NEW, self.version)
@@ -453,8 +474,8 @@ class BoltSession:
         self.send_success(meta)
         return True
 
-    def on_discard(self, extra: dict) -> bool:
-        self.interpreter.pull(-1)
+    async def on_discard(self, extra: dict) -> bool:
+        await self._offload(self.interpreter.pull, -1)
         self.send_success({"has_more": False})
         return True
 
@@ -478,16 +499,23 @@ class BoltServer:
 
     def __init__(self, interpreter_context: InterpreterContext,
                  host: str = "127.0.0.1", port: int = 7687, auth=None,
-                 ssl_context=None):
+                 ssl_context=None, workers: int = None):
         self.ictx = interpreter_context
         self.host = host
         self.port = port
         self.auth = auth
         self.ssl_context = ssl_context   # bolt+s (ref: communication/context.cpp)
         self._server = None
+        if workers is None:
+            workers = min(32, (os.cpu_count() or 4) * 4)
+        from concurrent.futures import ThreadPoolExecutor
+        self._executor = (ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="bolt-worker")
+            if workers > 0 else None)
 
     async def _handle(self, reader, writer):
-        session = BoltSession(reader, writer, self.ictx, self.auth)
+        session = BoltSession(reader, writer, self.ictx, self.auth,
+                              executor=self._executor)
         await session.run()
 
     async def start(self):

@@ -200,12 +200,15 @@ class LabelPropertyIndex:
         if slot is None:
             return None
         key = self._entry_key(values)
-        lo = bisect.bisect_left(slot["sorted"], (key,), key=lambda e: (e[0],))
+        entries = slot["sorted"]
+        lo = bisect.bisect_left(entries, (key,), key=lambda e: (e[0],))
         out = []
-        for entry in slot["sorted"][lo:]:
-            if entry[0] != key:
+        # index walk, NOT entries[lo:]: a tail slice copies O(n) entries
+        # per lookup, which dominated point-read CPU
+        for i in range(lo, len(entries)):
+            if entries[i][0] != key:
                 break
-            out.append(entry[2])
+            out.append(entries[i][2])
         return out
 
     def candidates_range(self, label_id, prop_ids, lower=None, upper=None,

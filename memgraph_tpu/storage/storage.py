@@ -427,6 +427,10 @@ class Accessor:
         self.storage.indices.label.add(label_id, vertex)
         self.storage.indices.label_property.update_on_change(vertex)
         self.txn.touched_vertices[vertex.gid] = vertex
+        if self._analytical:
+            # analytical commits skip the commit-time bump; invalidate
+            # device/columnar snapshot caches per write instead
+            self.storage._bump_topology()
         return True
 
     def _vertex_remove_label(self, vertex: Vertex, label_id: int) -> bool:
@@ -444,6 +448,8 @@ class Accessor:
             vertex.labels.discard(label_id)
         self.storage.indices.label_property.update_on_change(vertex)
         self.txn.touched_vertices[vertex.gid] = vertex
+        if self._analytical:
+            self.storage._bump_topology()
         return True
 
     def _vertex_set_property(self, vertex: Vertex, prop_id: int, value):
@@ -464,6 +470,8 @@ class Accessor:
                 vertex.properties[prop_id] = value
         self.storage.indices.label_property.update_on_change(vertex)
         self.txn.touched_vertices[vertex.gid] = vertex
+        if self._analytical:
+            self.storage._bump_topology()
         return old
 
     def _edge_set_property(self, edge: Edge, prop_id: int, value):
@@ -485,6 +493,8 @@ class Accessor:
             else:
                 edge.properties[prop_id] = value
         self.txn.touched_edges[edge.gid] = edge
+        if self._analytical:
+            self.storage._bump_topology()
         return old
 
     # --- reads --------------------------------------------------------------

@@ -230,3 +230,48 @@ def test_concurrent_clients(server):
     for t in threads:
         t.join()
     assert not errors
+
+
+def test_slow_query_does_not_block_other_sessions(server):
+    """Interpreter work runs on the Bolt worker pool, so one session's
+    long-running PULL must not freeze the event loop for other sessions
+    (reference analog: priority_thread_pool.hpp session scheduling)."""
+    import threading
+    import time as _time
+
+    slow = BoltClient(port=server["port"], timeout=60.0)
+    fast = BoltClient(port=server["port"])
+    try:
+        fast.execute("CREATE (:Fair {id: 1})")
+        done = threading.Event()
+        slow_elapsed = []
+
+        def run_slow():
+            t0 = _time.perf_counter()
+            slow.execute(
+                "UNWIND range(0, 2000000) AS x "
+                "WITH sum(x) AS s RETURN s")
+            slow_elapsed.append(_time.perf_counter() - t0)
+            done.set()
+
+        t = threading.Thread(target=run_slow)
+        t.start()
+        _time.sleep(0.1)          # ensure the slow PULL is in flight
+        worst = 0.0
+        while not done.is_set():
+            t0 = _time.perf_counter()
+            _, rows, _ = fast.execute(
+                "MATCH (n:Fair {id: 1}) RETURN n.id")
+            worst = max(worst, _time.perf_counter() - t0)
+            assert rows == [[1]]
+        t.join()
+        assert slow_elapsed and slow_elapsed[0] > 0.3, \
+            "slow query finished too fast to prove anything"
+        # before the worker pool, the fast session waited for the ENTIRE
+        # slow pull (>0.3s); now it interleaves at GIL granularity
+        assert worst < slow_elapsed[0] / 2, \
+            f"fast query blocked {worst:.3f}s behind a " \
+            f"{slow_elapsed[0]:.3f}s query"
+    finally:
+        slow.close()
+        fast.close()
