@@ -209,11 +209,15 @@ def main():
             "clients": n_clients,
             "aggregate_qps": round(total / wall, 1),
         })
-    one = next(g for g in groups if g["name"] == "point_read_1_clients")
-    many = next(g for g in groups
-                if g["name"] == f"point_read_{args.clients}_clients")
-    many["scaling_vs_1_client"] = round(
-        many["aggregate_qps"] / one["aggregate_qps"], 2)
+    one = next((g for g in groups
+                if g["name"] == "point_read_1_clients"
+                and "aggregate_qps" in g), None)
+    many = next((g for g in groups
+                 if g["name"] == f"point_read_{args.clients}_clients"
+                 and "aggregate_qps" in g), None)
+    if one and many:
+        many["scaling_vs_1_client"] = round(
+            many["aggregate_qps"] / one["aggregate_qps"], 2)
     client.close()
     # the analytical group gets its own client with a wide timeout (first
     # CALL pays XLA compilation) and one discarded warm-up run

@@ -228,7 +228,7 @@ async def serve(args, ictx) -> None:
     await stop.wait()
 
     logging.info("shutting down ...")
-    server._server.close()
+    server.stop()
     if monitoring is not None:
         monitoring.close()
     if args.storage_snapshot_on_exit and args.data_directory:

@@ -22,6 +22,7 @@ import logging
 import queue
 import socket
 import threading
+from collections import deque
 
 from . import protocol as P
 
@@ -68,10 +69,26 @@ class ReplicaClient:
         self._system_queue: list[dict] = []
         self._syslock = threading.Lock()
         self._sys_draining = False
+        self.catchup_used: str | None = None   # "wal_delta" | "snapshot"
+        # serializes catch-up attempts: the registering thread and the
+        # heartbeat reconnect may target the same client concurrently
+        self._catchup_lock = threading.Lock()
 
     # --- connection / catch-up ----------------------------------------------
 
     def connect_and_catch_up(self) -> None:
+        with self._catchup_lock:
+            if self.status is ReplicaStatus.READY:
+                return            # another thread just finished catch-up
+            try:
+                self._connect_and_catch_up()
+            except BaseException:
+                # a half-done catch-up must not linger in RECOVERY: ship()
+                # would buffer frames into _catchup_buffer forever
+                self.status = ReplicaStatus.INVALID
+                raise
+
+    def _connect_and_catch_up(self) -> None:
         self.status = ReplicaStatus.RECOVERY
         sock = socket.create_connection((self._host, self._port), timeout=30)
         from ..utils.tls import wrap_cluster_client
@@ -85,14 +102,33 @@ class ReplicaClient:
             raise ConnectionError("replica registration failed")
         info = P.parse_json(payload)
         self._sock = sock
-        # full state transfer (catch-up) when the replica is behind
-        if info.get("last_commit_ts", 0) < self.storage.latest_commit_ts():
-            snapshot_bytes = self._snapshot_bytes()
-            P.send_frame(sock, P.MSG_SNAPSHOT, snapshot_bytes)
-            msg_type, payload = P.recv_frame(sock)
-            if msg_type != P.MSG_ACK:
-                raise ConnectionError("snapshot transfer failed")
-            self.last_acked_ts = P.parse_json(payload)["last_commit_ts"]
+        # catch-up ladder (reference recovery.hpp): WAL-delta rung first —
+        # a briefly-behind replica receives only the missed commit frames;
+        # snapshot rung when the ring no longer covers its position
+        replica_ts = info.get("last_commit_ts", 0)
+        if replica_ts < self.storage.latest_commit_ts():
+            frames = None
+            provider = getattr(self, "recent_frames_provider", None)
+            if provider is not None:
+                frames = provider(replica_ts)
+            if frames is not None:
+                self.catchup_used = "wal_delta"
+                for frame in frames:
+                    P.send_frame(sock, P.MSG_WAL_FRAME, frame)
+                    msg_type, payload = P.recv_frame(sock)
+                    if msg_type != P.MSG_ACK:
+                        raise ConnectionError("wal-delta catch-up failed")
+                    self.last_acked_ts = \
+                        P.parse_json(payload)["last_commit_ts"]
+            else:
+                self.catchup_used = "snapshot"
+                snapshot_bytes = self._snapshot_bytes()
+                P.send_frame(sock, P.MSG_SNAPSHOT, snapshot_bytes)
+                msg_type, payload = P.recv_frame(sock)
+                if msg_type != P.MSG_ACK:
+                    raise ConnectionError("snapshot transfer failed")
+                self.last_acked_ts = \
+                    P.parse_json(payload)["last_commit_ts"]
         # system-state catch-up: full auth + database list (idempotent)
         state_provider = getattr(self, "system_state_provider", None)
         if state_provider is not None:
@@ -338,12 +374,28 @@ class ReplicationState:
         self.replica_server = None
         self._lock = threading.Lock()
         self._consumer_registered = False
+        # recent-commit ring for the WAL-delta catch-up rung (reference:
+        # storage/v2/replication/recovery.hpp ladder): a briefly-behind
+        # replica receives just the missed frames instead of a snapshot.
+        # _frames_floor = highest commit_ts that may be MISSING from the
+        # ring (commits before the consumer registered, or evicted).
+        import os as _os
+        self._recent_frames: "deque[tuple[int, bytes]]" = deque()
+        self._frames_floor = 0
+        self._frames_cap = int(_os.environ.get(
+            "MEMGRAPH_TPU_REPL_RING", 4096))
+        self._frames_lock = threading.Lock()
         self._heartbeat_thread: threading.Thread | None = None
         self._stop_heartbeat = threading.Event()
 
     def _ensure_consumer(self) -> None:
         # lazy: commits only pay frame encoding once a replica exists
         if not self._consumer_registered:
+            with self._frames_lock:
+                # commits made while no consumer ran never reached the
+                # ring: everything at/below the current ts needs snapshot
+                self._recent_frames.clear()
+                self._frames_floor = self.storage.latest_commit_ts()
             self.storage.frame_consumers.append(self._on_commit_frame)
             self.storage.pre_commit_hooks.append(self._on_pre_commit)
             self.storage.commit_abort_hooks.append(self._on_commit_abort)
@@ -402,6 +454,7 @@ class ReplicationState:
             raise QueryException("only MAIN can register replicas")
         client = ReplicaClient(name, address, mode, self.storage)
         client.system_state_provider = self.system_state
+        client.recent_frames_provider = self._frames_since
         with self._lock:
             if name in self.replicas:
                 raise QueryException(f"replica {name!r} already registered")
@@ -446,6 +499,19 @@ class ReplicationState:
             for c in clients:
                 if c.status is ReplicaStatus.READY:
                     c.heartbeat()
+                elif c.status is ReplicaStatus.INVALID:
+                    # auto-reconnect (reference: the replication client's
+                    # retry loop); the WAL-delta rung makes this cheap
+                    # for briefly-severed replicas. Catch EVERYTHING: one
+                    # malformed ack must not kill the heartbeat thread
+                    # (it is never restarted).
+                    try:
+                        c.connect_and_catch_up()
+                        log.info("replica %s reconnected via %s catch-up",
+                                 c.name, c.catchup_used)
+                    except Exception:
+                        log.debug("replica %s reconnect failed", c.name,
+                                  exc_info=True)
 
     def show_replicas(self) -> list[list]:
         rows = []
@@ -506,18 +572,21 @@ class ReplicationState:
         with self._lock:
             all_strict = [c for c in self.replicas.values()
                           if c.mode is ReplicationMode.STRICT_SYNC]
-        # a dead STRICT_SYNC replica means NO commit may proceed — that is
-        # the strict guarantee; replicas mid-catch-up don't vote (the frame
-        # reaches them via the RECOVERY buffer / snapshot instead)
-        down = [c for c in all_strict if c.status is ReplicaStatus.INVALID]
+        # a STRICT_SYNC replica that cannot vote means NO commit may
+        # proceed — that is the strict guarantee. RECOVERY counts as
+        # unavailable too: with heartbeat auto-reconnect a replica can sit
+        # mid-catch-up at commit time, and if that catch-up fails a
+        # buffered frame would be silently lost after MAIN committed.
+        down = [c for c in all_strict if c.status is not ReplicaStatus.READY]
         if down:
             from ..exceptions import TransactionException
             raise TransactionException(
                 "STRICT_SYNC replica(s) unavailable: "
                 + ", ".join(c.name for c in down)
                 + " — transaction aborted (drop the replica or restore it)")
-        strict = [c for c in all_strict
-                  if c.status is ReplicaStatus.READY]
+        # every strict client is READY here (the vote above aborts
+        # otherwise)
+        strict = all_strict
         if not strict:
             return
         prepared = []
@@ -555,9 +624,23 @@ class ReplicationState:
                 # one broken client must not keep the abort from the rest
                 log.exception("finalize(abort) failed for replica %s", c.name)
 
+    def _frames_since(self, since_ts: int):
+        """WAL frames with commit_ts > since_ts in commit order, or None
+        when the ring no longer covers that range (snapshot needed)."""
+        with self._frames_lock:
+            if since_ts < self._frames_floor:
+                return None
+            return [f for ts, f in self._recent_frames if ts > since_ts]
+
     def _on_commit_frame(self, frame: bytes, commit_ts: int) -> None:
         if self.role != "main":
             return
+        with self._frames_lock:
+            self._recent_frames.append((commit_ts, frame))
+            while len(self._recent_frames) > self._frames_cap:
+                ts, _ = self._recent_frames.popleft()
+                if ts > self._frames_floor:
+                    self._frames_floor = ts
         with self._lock:
             clients = list(self.replicas.values())
         if not clients:

@@ -528,6 +528,13 @@ class BoltServer:
         async with self._server:
             await self._server.serve_forever()
 
+    def stop(self) -> None:
+        """Release the worker pool (and the listener if still open)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+        if self._server is not None:
+            self._server.close()
+
     def run_in_thread(self):
         """Start the server on a background thread; returns (thread, loop).
 
