@@ -28,6 +28,9 @@ def build_config(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("memgraph_tpu")
     p.add_argument("--bolt-address", default="0.0.0.0")
     p.add_argument("--bolt-port", type=int, default=7687)
+    p.add_argument("--memory-limit", type=int, default=0,
+                   help="global tracked-memory limit in MiB (0 = off; "
+                        "reference: --memory-limit)")
     p.add_argument("--bolt-cert-file", default=None,
                    help="TLS certificate for the Bolt listener (bolt+s)")
     p.add_argument("--bolt-key-file", default=None)
@@ -93,6 +96,10 @@ def build_database(args) -> InterpreterContext:
                        recover_on_startup=args.storage_recover_on_startup)
     ictx = dbms.default()
     storage = ictx.storage
+
+    if args.memory_limit:
+        from .utils.memory_tracker import GLOBAL
+        GLOBAL.limit = args.memory_limit * 1024 * 1024
 
     # warm the native CSR builder at startup so the first analytics query
     # doesn't pay the compile

@@ -293,6 +293,7 @@ class CallProcedure(Clause):
     yield_star: bool = False
     where: Optional[Expr] = None
     yield_dash: bool = False     # CALL proc() YIELD - (explicitly nothing)
+    memory_limit: Optional[int] = None   # PROCEDURE MEMORY LIMIT, bytes
 
 
 @dataclass
@@ -345,6 +346,7 @@ class CypherQuery:
     # [(all?, query)]
     explain: bool = False
     profile: bool = False
+    memory_limit: Optional[int] = None   # QUERY MEMORY LIMIT, bytes
 
 
 # --- administrative / DDL queries -------------------------------------------

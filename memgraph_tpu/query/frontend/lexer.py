@@ -26,6 +26,7 @@ KEYWORDS = {
     "TRANSACTIONS", "TERMINATE", "FOREACH", "LOAD", "CSV", "FROM", "HEADER",
     "NO", "ROW", "FIELDTERMINATOR", "COALESCE", "COUNT", "EDGE", "TYPED",
     "SNAPSHOT", "RECOVER", "DUMP", "ANALYZE", "GRAPH", "FREE", "MEMORY",
+    "QUERY", "UNLIMITED", "PROCEDURE",
     "ISOLATION", "LEVEL", "NEXT", "READ", "COMMITTED", "UNCOMMITTED",
     "GLOBAL", "SESSION", "TRANSACTION", "STATS", "TRIGGER", "TRIGGERS",
     "AFTER", "BEFORE", "EXECUTE", "CREATED", "UPDATED", "DELETED", "VERTICES",

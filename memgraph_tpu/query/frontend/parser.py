@@ -726,7 +726,28 @@ class Parser:
             self.advance()
             union_all = bool(self.accept_kw("ALL"))
             unions.append((union_all, self.parse_single_query()))
-        return A.CypherQuery(first, unions)
+        mem = None
+        if self.at_kw("QUERY"):
+            # trailing `QUERY MEMORY LIMIT n MB|KB` / `QUERY MEMORY
+            # UNLIMITED` (reference grammar Cypher.g4:134-136)
+            self.advance()
+            mem = self.parse_memory_limit()
+        return A.CypherQuery(first, unions, memory_limit=mem)
+
+    def parse_memory_limit(self) -> "Optional[int]":
+        self.expect_kw("MEMORY")
+        if self.accept_kw("UNLIMITED"):
+            return None
+        self.expect_kw("LIMIT")
+        amount = self.expect(T.INT).value
+        if amount < 1:
+            self.error("memory limit must be positive")
+        unit = self.name_token().upper()
+        if unit == "MB":
+            return amount * 1024 * 1024
+        if unit == "KB":
+            return amount * 1024
+        self.error("expected MB or KB after the memory limit")
 
     def parse_single_query(self) -> A.SingleQuery:
         clauses: list[A.Clause] = []
@@ -1007,6 +1028,11 @@ class Parser:
                 while self.accept(","):
                     args.append(self.parse_expression())
             self.expect(")")
+        mem_limit = None
+        if self.at_kw("PROCEDURE"):
+            # CALL proc() PROCEDURE MEMORY LIMIT n MB|KB (Cypher.g4:138)
+            self.advance()
+            mem_limit = self.parse_memory_limit()
         yields: list[tuple[str, Optional[str]]] = []
         yield_star = False
         yield_dash = False

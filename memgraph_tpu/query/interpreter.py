@@ -684,8 +684,11 @@ class Interpreter:
                 raise HintedAbortError(
                     f"query exceeded timeout of {timeout}s")
 
+        from ..utils.memory_tracker import QueryMemoryTracker
         exec_ctx = ExecutionContext(accessor, parameters,
-                                    View.NEW, self.ctx, timeout_checker)
+                                    View.NEW, self.ctx, timeout_checker,
+                                    memory=QueryMemoryTracker(
+                                        query.memory_limit))
         exec_ctx.eval_ctx.username = self.username
         if owns:
             exec_ctx._txn_owner = _TxnOwner(self, exec_ctx)
@@ -758,6 +761,7 @@ class Interpreter:
             for key, value in self._exec_ctx.stats.items():
                 if value:
                     global_metrics.increment(f"storage.{key}", value)
+            self._exec_ctx.memory.release_all()
         if self._stream_owns_txn and self._stream_accessor is not None:
             self._stream_accessor.commit()
         self._stream = None
@@ -768,6 +772,8 @@ class Interpreter:
 
     def _cleanup_stream(self, error: bool = False) -> None:
         self._query_started = None
+        if self._exec_ctx is not None:
+            self._exec_ctx.memory.release_all()
         if self._stream_owns_txn and self._stream_accessor is not None:
             self._stream_accessor.abort()
         self._stream = None
@@ -931,9 +937,15 @@ class Interpreter:
             return self._prepare_generator(iter(rows),
                                            ["name", "type", "value"], "r")
         if node.kind == "schema":
-            rows = self._schema_info_rows()
-            return self._prepare_generator(iter(rows),
-                                           ["kind", "name", "count"], "r")
+            # full live-schema JSON document (reference:
+            # storage/v2/schema_info.cpp, returned as one `schema` row)
+            from ..storage.schema_info import schema_info_json
+            acc = storage.access()
+            try:
+                doc = schema_info_json(acc, View.OLD)
+            finally:
+                acc.abort()
+            return self._prepare_generator(iter([[doc]]), ["schema"], "r")
         if node.kind == "database":
             name = getattr(self.ctx, "database_name", "memgraph")
             return self._prepare_generator(iter([[name]]), ["Name"], "r")
@@ -950,26 +962,6 @@ class Interpreter:
             return self._prepare_generator(iter(rows),
                                            ["freed", "count"], "s")
         raise SemanticException(f"unknown info query {node.kind}")
-
-    def _schema_info_rows(self):
-        storage = self.ctx.storage
-        label_counts: dict[int, int] = {}
-        edge_counts: dict[int, int] = {}
-        acc = storage.access()
-        try:
-            for va in acc.vertices():
-                for l in va.labels():
-                    label_counts[l] = label_counts.get(l, 0) + 1
-            for ea in acc.edges():
-                edge_counts[ea.edge_type] = edge_counts.get(
-                    ea.edge_type, 0) + 1
-        finally:
-            acc.abort()
-        rows = [["node_label", storage.label_mapper.id_to_name(l), c]
-                for l, c in sorted(label_counts.items())]
-        rows += [["edge_type", storage.edge_type_mapper.id_to_name(t), c]
-                 for t, c in sorted(edge_counts.items())]
-        return rows
 
     def _show_transactions(self):
         rows = []

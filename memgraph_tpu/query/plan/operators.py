@@ -29,7 +29,9 @@ class ExecutionContext:
     """Per-execution state shared by all cursors."""
 
     def __init__(self, accessor, parameters=None, view=View.NEW,
-                 interpreter_context=None, timeout_checker=None):
+                 interpreter_context=None, timeout_checker=None,
+                 memory=None):
+        from ...utils.memory_tracker import QueryMemoryTracker
         self.accessor = accessor
         self.parameters = parameters or {}
         self.view = view
@@ -38,6 +40,9 @@ class ExecutionContext:
         self.evaluator = Evaluator(self.eval_ctx)
         self.interpreter_context = interpreter_context
         self.timeout_checker = timeout_checker
+        # per-query materialized-state accounting (QUERY MEMORY LIMIT);
+        # reference: memory/query_memory_control.cpp
+        self.memory = memory if memory is not None else QueryMemoryTracker()
         self.stats = {"nodes_created": 0, "nodes_deleted": 0,
                       "relationships_created": 0, "relationships_deleted": 0,
                       "properties_set": 0, "labels_added": 0,
@@ -95,7 +100,10 @@ class Eager(LogicalOperator):
     input: LogicalOperator
 
     def cursor(self, ctx):
-        rows = list(self.input.cursor(ctx))
+        rows = []
+        for frame in self.input.cursor(ctx):
+            ctx.memory.add_value(frame)
+            rows.append(frame)
         for frame in rows:
             ctx.check_abort()
             yield frame
@@ -1070,6 +1078,8 @@ class Aggregate(LogicalOperator):
                     "aggs": [_AggState(spec[0], spec[2])
                              for spec in self.aggregations],
                 }
+                ctx.memory.add_value(key_vals)
+                ctx.memory.add(256)   # group bookkeeping overhead
                 groups[key] = state
                 order.append(key)
             state = groups[key]
@@ -1080,6 +1090,11 @@ class Aggregate(LogicalOperator):
                     agg.param = ctx.evaluator.eval(spec[4], frame)
                 value = (ctx.evaluator.eval(expr, frame)
                          if expr is not None else "__row__")
+                if agg.seen is not None or kind in (
+                        "collect", "project", "percentiledisc",
+                        "percentilecont"):
+                    # collecting/DISTINCT aggregates retain every value
+                    ctx.memory.add_value(value)
                 agg.update(value)
         if not groups and not self.group_by:
             # aggregation over empty input yields one row of neutral values
@@ -1230,6 +1245,7 @@ class OrderBy(LogicalOperator):
             for expr, asc in self.items:
                 k = order_key(ctx.evaluator.eval(expr, frame))
                 keys.append((k, asc))
+            ctx.memory.add_value(frame)
             rows.append((keys, frame))
 
         import functools
@@ -1282,6 +1298,7 @@ class Distinct(LogicalOperator):
             key = tuple(V.hashable_key(frame.get(s)) for s in self.symbols)
             if key in seen:
                 continue
+            ctx.memory.add_value(key)
             seen.add(key)
             yield frame
 
@@ -1312,13 +1329,17 @@ class CallProcedureOp(LogicalOperator):
     args: list[A.Expr]
     result_fields: list[str]
     output_symbols: list[str]
+    memory_limit: "Optional[int]" = None   # PROCEDURE MEMORY LIMIT, bytes
 
     def cursor(self, ctx):
         from ..procedures.registry import global_registry
+        from ...utils.memory_tracker import (MemoryLimitException,
+                                             approx_size)
         proc = global_registry.find(self.proc_name)
         if proc is None:
             raise SemanticException(f"unknown procedure: {self.proc_name}")
         from .planner import _literal_matches_type
+        proc_bytes = 0   # yielded-record accounting vs PROCEDURE limit
         for frame in self.input.cursor(ctx):
             ctx.check_abort()
             args = [ctx.evaluator.eval(e, frame) for e in self.args]
@@ -1337,6 +1358,13 @@ class CallProcedureOp(LogicalOperator):
                     yield dict(frame)
                 continue
             for record in proc.call(ctx, args):
+                if self.memory_limit is not None:
+                    proc_bytes += approx_size(record)
+                    if proc_bytes > self.memory_limit:
+                        raise MemoryLimitException(
+                            f"procedure {self.proc_name} exceeded its "
+                            f"PROCEDURE MEMORY LIMIT of "
+                            f"{self.memory_limit} bytes")
                 new = dict(frame)
                 for fieldname, sym in zip(self.result_fields,
                                           self.output_symbols):
@@ -1589,5 +1617,8 @@ class Accumulate(LogicalOperator):
     input: LogicalOperator
 
     def cursor(self, ctx):
-        rows = list(self.input.cursor(ctx))
+        rows = []
+        for frame in self.input.cursor(ctx):
+            ctx.memory.add_value(frame)
+            rows.append(frame)
         yield from rows

@@ -912,7 +912,8 @@ class Planner:
                     f"variable {sym!r} is already bound — YIELD must not "
                     f"shadow an existing variable")
         plan = Op.CallProcedureOp(plan, clause.name, args,
-                                  result_fields, output_symbols)
+                                  result_fields, output_symbols,
+                                  memory_limit=clause.memory_limit)
         bound.update(output_symbols)
         if clause.where is not None:
             plan = Op.Filter(plan, clause.where)

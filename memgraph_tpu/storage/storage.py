@@ -950,6 +950,9 @@ class InMemoryStorage:
     # --- info ---------------------------------------------------------------
 
     def info(self) -> dict:
+        from ..utils.memory_tracker import GLOBAL
+        import resource
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         return {
             "vertex_count": len(self._vertices),
             "edge_count": len(self._edges),
@@ -957,4 +960,10 @@ class InMemoryStorage:
                                if self._vertices else 0.0),
             "storage_mode": self.config.storage_mode.value,
             "isolation_level": self.config.isolation_level.value,
+            # tracked query-materialization memory + process peak RSS
+            # (reference: utils/memory_tracker.cpp counters in storage info)
+            "memory_tracked": GLOBAL.current,
+            "peak_memory_tracked": GLOBAL.peak,
+            "peak_memory_res": rss_kb * 1024,
+            "memory_limit": GLOBAL.limit,
         }

@@ -1,0 +1,112 @@
+"""QUERY/PROCEDURE MEMORY LIMIT + per-query/global memory tracking.
+
+Reference: src/memory/query_memory_control.cpp, utils/memory_tracker.cpp,
+grammar Cypher.g4:134-138 (memoryLimit, queryMemoryLimit,
+procedureMemoryLimit).
+"""
+
+import pytest
+
+from memgraph_tpu.exceptions import SyntaxException
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+from memgraph_tpu.utils.memory_tracker import (GLOBAL, MemoryLimitException,
+                                               QueryMemoryTracker,
+                                               approx_size)
+
+
+@pytest.fixture()
+def interp():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+class TestQueryMemoryLimit:
+    def test_under_limit_succeeds(self, interp):
+        _, rows, _ = interp.execute(
+            "UNWIND range(0, 100) AS x RETURN count(x) AS c "
+            "QUERY MEMORY LIMIT 10 MB")
+        assert rows == [[101]]
+
+    def test_sort_buffer_over_limit_aborts(self, interp):
+        with pytest.raises(MemoryLimitException):
+            interp.execute(
+                "UNWIND range(0, 200000) AS x WITH x ORDER BY x DESC "
+                "RETURN count(x) QUERY MEMORY LIMIT 100 KB")
+
+    def test_collect_over_limit_aborts(self, interp):
+        with pytest.raises(MemoryLimitException):
+            interp.execute(
+                "UNWIND range(0, 200000) AS x RETURN collect(x) AS c "
+                "QUERY MEMORY LIMIT 100 KB")
+
+    def test_distinct_over_limit_aborts(self, interp):
+        with pytest.raises(MemoryLimitException):
+            interp.execute(
+                "UNWIND range(0, 200000) AS x RETURN DISTINCT x "
+                "QUERY MEMORY LIMIT 100 KB")
+
+    def test_aggregate_groups_over_limit_aborts(self, interp):
+        with pytest.raises(MemoryLimitException):
+            interp.execute(
+                "UNWIND range(0, 200000) AS x "
+                "RETURN x AS g, count(*) AS c QUERY MEMORY LIMIT 100 KB")
+
+    def test_unlimited(self, interp):
+        _, rows, _ = interp.execute("RETURN 1 AS one QUERY MEMORY UNLIMITED")
+        assert rows == [[1]]
+
+    def test_kb_unit(self, interp):
+        _, rows, _ = interp.execute(
+            "RETURN 1 AS one QUERY MEMORY LIMIT 512 KB")
+        assert rows == [[1]]
+
+    def test_bad_unit_rejected(self, interp):
+        with pytest.raises(SyntaxException):
+            interp.execute("RETURN 1 QUERY MEMORY LIMIT 10 GB")
+
+    def test_streaming_query_unaffected(self, interp):
+        # pure streaming (no materialization) passes even with a tiny
+        # limit: only retained state is accounted
+        _, rows, _ = interp.execute(
+            "UNWIND range(0, 200000) AS x RETURN count(x) AS c "
+            "QUERY MEMORY LIMIT 100 KB")
+        assert rows == [[200001]]
+
+    def test_released_after_query(self, interp):
+        before = GLOBAL.current
+        interp.execute(
+            "UNWIND range(0, 50000) AS x RETURN collect(x) AS c")
+        assert GLOBAL.current == before
+
+    def test_released_after_failed_query(self, interp):
+        before = GLOBAL.current
+        with pytest.raises(MemoryLimitException):
+            interp.execute(
+                "UNWIND range(0, 200000) AS x RETURN collect(x) AS c "
+                "QUERY MEMORY LIMIT 100 KB")
+        assert GLOBAL.current == before
+
+
+class TestProcedureMemoryLimit:
+    def test_parse_and_pass(self, interp):
+        _, rows, _ = interp.execute(
+            "CALL util.md5(['x']) PROCEDURE MEMORY LIMIT 10 MB "
+            "YIELD result RETURN result IS NOT NULL AS ok")
+        assert rows == [[True]]
+
+
+class TestGlobalTracker:
+    def test_global_limit_enforced(self):
+        tracker = QueryMemoryTracker(limit=None)
+        old_limit = GLOBAL.limit
+        GLOBAL.limit = GLOBAL.current + 1000
+        try:
+            with pytest.raises(MemoryLimitException):
+                tracker.add(10_000)
+        finally:
+            GLOBAL.limit = old_limit
+            tracker.release_all()
+
+    def test_approx_size_containers(self):
+        assert approx_size([1] * 1000) > 8000
+        assert approx_size({"k" * 10: "v" * 100}) > 100

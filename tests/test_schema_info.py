@@ -1,0 +1,78 @@
+"""SHOW SCHEMA INFO live schema document
+(reference: storage/v2/schema_info.cpp ToJson shape)."""
+
+import json
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture()
+def interp():
+    i = Interpreter(InterpreterContext(InMemoryStorage()))
+    i.execute("CREATE (:Person {name: 'a', age: 30})-[:KNOWS {since: 2020}]->"
+              "(:Person {name: 'b'})")
+    i.execute("CREATE (:Person:Admin {name: 'c', age: 1.5})")
+    i.execute("CREATE (:Lonely)")
+    i.execute("CREATE CONSTRAINT ON (p:Person) ASSERT EXISTS (p.name)")
+    return i
+
+
+def _doc(interp):
+    _, rows, _ = interp.execute("SHOW SCHEMA INFO")
+    assert len(rows) == 1 and len(rows[0]) == 1
+    return json.loads(rows[0][0])
+
+
+def test_nodes_grouped_by_label_set(interp):
+    doc = _doc(interp)
+    by_labels = {tuple(n["labels"]): n for n in doc["nodes"]}
+    assert by_labels[("Person",)]["count"] == 2
+    assert by_labels[("Admin", "Person")]["count"] == 1
+    assert by_labels[("Lonely",)]["count"] == 1
+
+
+def test_property_stats_and_types(interp):
+    doc = _doc(interp)
+    person = next(n for n in doc["nodes"] if n["labels"] == ["Person"])
+    props = {p["key"]: p for p in person["properties"]}
+    assert props["name"]["count"] == 2
+    assert props["name"]["filling_factor"] == 100.0
+    assert props["age"]["count"] == 1
+    assert props["age"]["filling_factor"] == 50.0
+    assert props["age"]["types"] == [{"type": "Integer", "count": 1}]
+    mixed = next(n for n in doc["nodes"] if n["labels"] == ["Admin", "Person"])
+    age = next(p for p in mixed["properties"] if p["key"] == "age")
+    assert age["types"] == [{"type": "Float", "count": 1}]
+
+
+def test_edges_with_endpoint_labels(interp):
+    doc = _doc(interp)
+    assert len(doc["edges"]) == 1
+    e = doc["edges"][0]
+    assert e["type"] == "KNOWS"
+    assert e["start_node_labels"] == ["Person"]
+    assert e["end_node_labels"] == ["Person"]
+    assert e["count"] == 1
+    assert e["properties"][0]["key"] == "since"
+
+
+def test_constraints_listed(interp):
+    doc = _doc(interp)
+    assert {"type": "existence", "label": "Person",
+            "properties": ["name"]} in doc["node_constraints"]
+
+
+def test_enums_listed(interp):
+    interp.execute("CREATE ENUM Status VALUES { Good, Bad }")
+    doc = _doc(interp)
+    assert {"name": "Status", "values": ["Good", "Bad"]} in doc["enums"]
+
+
+def test_live_updates(interp):
+    before = _doc(interp)
+    interp.execute("CREATE (:Fresh {x: 1})")
+    after = _doc(interp)
+    assert len(after["nodes"]) == len(before["nodes"]) + 1
