@@ -32,6 +32,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..storage.common import IsolationLevel
+
 
 @dataclass
 class Column:
@@ -170,6 +172,11 @@ class ColumnarCache:
             return True
         if getattr(txn, "deltas", None):
             return False
+        # READ_COMMITTED / READ_UNCOMMITTED resolve visibility against the
+        # *live* latest commit ts, so a commit landing mid-sweep yields a
+        # mixed snapshot that must never be shared under a version key.
+        if txn.isolation is not IsolationLevel.SNAPSHOT_ISOLATION:
+            return False
         return txn.effective_start_ts() >= accessor.storage.latest_commit_ts()
 
     def get(self, accessor, label: str | None, props: tuple[str, ...],
@@ -193,6 +200,13 @@ class ColumnarCache:
         if missing or entry is None:
             snap = export_columns(accessor, label, missing, view,
                                   abort_check)
+            if storage.topology_version != key[0]:
+                # topology moved mid-sweep: the sweep may be mixed — never
+                # store it; serve this caller a fresh full (uncached) build
+                if missing != props:
+                    snap = export_columns(accessor, label, props, view,
+                                          abort_check)
+                return snap
             with self._lock:
                 per = self._cache.get(storage) or {}
                 per = {k: v for k, v in per.items() if k[0] == key[0]}

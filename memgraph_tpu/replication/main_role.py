@@ -387,6 +387,7 @@ class ReplicationState:
         self._frames_lock = threading.Lock()
         self._heartbeat_thread: threading.Thread | None = None
         self._stop_heartbeat = threading.Event()
+        self._reconnecting: set[int] = set()
 
     def _ensure_consumer(self) -> None:
         # lazy: commits only pay frame encoding once a replica exists
@@ -500,18 +501,52 @@ class ReplicationState:
                 if c.status is ReplicaStatus.READY:
                     c.heartbeat()
                 elif c.status is ReplicaStatus.INVALID:
-                    # auto-reconnect (reference: the replication client's
-                    # retry loop); the WAL-delta rung makes this cheap
-                    # for briefly-severed replicas. Catch EVERYTHING: one
-                    # malformed ack must not kill the heartbeat thread
-                    # (it is never restarted).
-                    try:
-                        c.connect_and_catch_up()
-                        log.info("replica %s reconnected via %s catch-up",
-                                 c.name, c.catchup_used)
-                    except Exception:
-                        log.debug("replica %s reconnect failed", c.name,
-                                  exc_info=True)
+                    # auto-reconnect on a per-replica worker thread: one
+                    # dead replica's connect timeout or long snapshot
+                    # transfer must not stall heartbeats to the others
+                    self._spawn_reconnect(c)
+
+    def _spawn_reconnect(self, client) -> None:
+        name = client.name
+        # dedup by client identity, not name: a stale worker for a dropped
+        # client must not block reconnects of a re-registered replacement
+        key = id(client)
+        with self._lock:
+            if key in self._reconnecting:
+                return
+            self._reconnecting.add(key)
+
+        def run():
+            # Catch EVERYTHING: one malformed ack must not kill the
+            # worker silently mid-bookkeeping (reference: the
+            # replication client's retry loop); the WAL-delta rung makes
+            # this cheap for briefly-severed replicas.
+            try:
+                # ownership check BEFORE connecting: a dropped/demoted
+                # replica must not receive a snapshot from a main that no
+                # longer owns it
+                with self._lock:
+                    if self.replicas.get(name) is not client:
+                        return
+                client.connect_and_catch_up()
+                # re-check: drop may have raced the transfer — don't
+                # resurrect a connection the registry no longer owns
+                with self._lock:
+                    still_ours = self.replicas.get(name) is client
+                if not still_ours:
+                    client.close()
+                else:
+                    log.info("replica %s reconnected via %s catch-up",
+                             client.name, client.catchup_used)
+            except Exception:
+                log.debug("replica %s reconnect failed", client.name,
+                          exc_info=True)
+            finally:
+                with self._lock:
+                    self._reconnecting.discard(key)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"repl-reconnect-{name}").start()
 
     def show_replicas(self) -> list[list]:
         rows = []

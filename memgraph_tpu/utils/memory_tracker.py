@@ -63,15 +63,18 @@ class GlobalMemoryTracker:
 
     def add(self, nbytes: int) -> None:
         with self._lock:
+            if (self.limit is not None
+                    and self.current + nbytes > self.limit):
+                # never record the breaching chunk: callers treat a raise
+                # as "nothing was added" (QueryMemoryTracker symmetry)
+                raise MemoryLimitException(
+                    f"global memory limit exceeded: tracked "
+                    f"{self.current + nbytes} bytes > limit {self.limit} "
+                    "(raise --memory-limit or add QUERY MEMORY LIMIT to "
+                    "the offending queries)")
             self.current += nbytes
             if self.current > self.peak:
                 self.peak = self.current
-            if self.limit is not None and self.current > self.limit:
-                cur = self.current
-                raise MemoryLimitException(
-                    f"global memory limit exceeded: tracked {cur} bytes "
-                    f"> limit {self.limit} (raise --memory-limit or add "
-                    "QUERY MEMORY LIMIT to the offending queries)")
 
     def release(self, nbytes: int) -> None:
         with self._lock:
@@ -96,14 +99,18 @@ class QueryMemoryTracker:
         self._global = GLOBAL if global_tracker is None else global_tracker
 
     def add(self, nbytes: int) -> None:
+        # order matters for symmetry with release_all(): self.current must
+        # only ever count bytes that were also added to the global tracker,
+        # so a raise here (per-query or global limit) records nothing
+        if self.limit is not None and self.current + nbytes > self.limit:
+            raise MemoryLimitException(
+                f"query memory limit exceeded: tracked "
+                f"{self.current + nbytes} bytes > limit {self.limit} "
+                "(QUERY MEMORY LIMIT)")
+        self._global.add(nbytes)
         self.current += nbytes
         if self.current > self.peak:
             self.peak = self.current
-        if self.limit is not None and self.current > self.limit:
-            raise MemoryLimitException(
-                f"query memory limit exceeded: tracked {self.current} "
-                f"bytes > limit {self.limit} (QUERY MEMORY LIMIT)")
-        self._global.add(nbytes)
 
     def add_value(self, value) -> None:
         self.add(approx_size(value))

@@ -110,3 +110,60 @@ class TestGlobalTracker:
     def test_approx_size_containers(self):
         assert approx_size([1] * 1000) > 8000
         assert approx_size({"k" * 10: "v" * 100}) > 100
+
+
+class TestTrackerSymmetry:
+    """ADVICE r2: a limit breach must not desync query/global accounting —
+    release_all() may only return bytes that were actually added globally."""
+
+    def test_query_limit_breach_does_not_over_release(self):
+        g_before = GLOBAL.current
+        other = QueryMemoryTracker(limit=None)
+        other.add(5_000)                      # a concurrent live query
+        t = QueryMemoryTracker(limit=1_000)
+        t.add(500)
+        with pytest.raises(MemoryLimitException):
+            t.add(10_000)                     # breaches per-query limit
+        t.release_all()
+        # other's 5_000 global bytes must still be tracked
+        assert GLOBAL.current == g_before + 5_000
+        other.release_all()
+        assert GLOBAL.current == g_before
+
+    def test_global_limit_breach_records_nothing_locally(self):
+        t = QueryMemoryTracker(limit=None)
+        g_before = GLOBAL.current
+        old_limit = GLOBAL.limit
+        GLOBAL.limit = GLOBAL.current + 100
+        try:
+            with pytest.raises(MemoryLimitException):
+                t.add(10_000)
+        finally:
+            GLOBAL.limit = old_limit
+        # neither side recorded the breaching chunk — no wedge, no leak
+        assert t.current == 0
+        assert GLOBAL.current == g_before
+        t.release_all()
+        assert GLOBAL.current == g_before
+
+
+class TestColumnarCacheIsolation:
+    """ADVICE r2: only SNAPSHOT_ISOLATION reads may populate the shared
+    columnar cache — weaker levels resolve against the live commit ts."""
+
+    def test_read_committed_not_cacheable(self):
+        from memgraph_tpu.ops.columnar import ColumnarCache
+        from memgraph_tpu.storage.storage import IsolationLevel
+        s = InMemoryStorage()
+        with s.access() as acc:
+            v = acc.create_vertex()
+            acc.commit()
+        cache = ColumnarCache()
+        acc_rc = s.access(IsolationLevel.READ_COMMITTED)
+        acc_si = s.access(IsolationLevel.SNAPSHOT_ISOLATION)
+        try:
+            assert not cache._cacheable(acc_rc)
+            assert cache._cacheable(acc_si)
+        finally:
+            acc_rc.abort()
+            acc_si.abort()
