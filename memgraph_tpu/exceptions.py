@@ -48,6 +48,11 @@ class TypeException(QueryException):
     """Runtime type mismatch in expression evaluation."""
 
 
+class EntityNotFound(QueryException):
+    """Access to a deleted graph entity's properties or labels
+    (TCK: EntityNotFound / DeletedEntityAccess)."""
+
+
 class ArithmeticException(QueryException):
     pass
 

@@ -182,6 +182,10 @@ class ColumnarCache:
     def get(self, accessor, label: str | None, props: tuple[str, ...],
             view, abort_check=None) -> ColumnarSnapshot:
         storage = accessor.storage
+        # capture the version BEFORE the freshness check: a commit landing
+        # between _cacheable() and the key read would otherwise let a
+        # pre-commit sweep be stored under the post-commit version
+        version = storage.topology_version
         if not self._cacheable(accessor):
             return export_columns(accessor, label, props, view,
                                   abort_check)
@@ -189,7 +193,7 @@ class ColumnarCache:
         # query needing extra properties of the same label sweeps only
         # the missing columns (row order is stable within a version, so
         # columns from separate sweeps align — verified by row count)
-        key = (storage.topology_version, label)
+        key = (version, label)
         with self._lock:
             per = self._cache.get(storage)
             entry = per.get(key) if per else None

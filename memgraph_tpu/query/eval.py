@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import re
 
-from ..exceptions import SemanticException, TypeException
+from ..exceptions import EntityNotFound, SemanticException, TypeException
 from ..storage.common import View
 from ..storage.storage import EdgeAccessor, VertexAccessor
 from .frontend import ast as A
@@ -75,10 +75,11 @@ class Evaluator:
         if isinstance(obj, dict):
             return obj.get(prop)
         if isinstance(obj, VertexAccessor) or isinstance(obj, EdgeAccessor):
+            st = self.checked_state(obj)
             pid = self.ctx.storage.property_mapper.maybe_name_to_id(prop)
             if pid is None:
                 return None
-            return obj.get_property(pid, self.ctx.view)
+            return st.properties.get(pid)
         # temporal/point component access (d.year, p.x, ...)
         attr = getattr(type(obj), prop, None)
         if attr is not None and isinstance(attr, property):
@@ -87,6 +88,18 @@ class Evaluator:
             return getattr(obj, prop)
         raise TypeException(
             f"property access on {V.type_name(obj)} is not supported")
+
+    def checked_state(self, obj):
+        """Materialized accessor state; raises on a deleted entity
+        (TCK DeletedEntityAccess; reference: ExpressionEvaluator raises
+        on property/label access of deleted objects, eval.hpp)."""
+        st = obj._state(self.ctx.view)
+        if not st.exists or st.deleted:
+            kind = ("node" if isinstance(obj, VertexAccessor)
+                    else "relationship")
+            raise EntityNotFound(
+                f"cannot access properties of a deleted {kind}")
+        return st
 
     def _eval_LabelsTest(self, e: A.LabelsTest, frame):
         obj = self.eval(e.expr, frame)

@@ -89,8 +89,9 @@ def fn_labels(ev, args):
     v = args[0]
     if not isinstance(v, VertexAccessor):
         raise TypeException("labels() requires a node")
+    st = ev.checked_state(v)
     mapper = ev.ctx.storage.label_mapper
-    return [mapper.id_to_name(l) for l in v.labels(ev.ctx.view)]
+    return [mapper.id_to_name(l) for l in sorted(st.labels)]
 
 
 @register("properties", 1, 1)
@@ -99,9 +100,10 @@ def fn_properties(ev, args):
     if isinstance(v, dict):
         return dict(v)
     if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        st = ev.checked_state(v)
         mapper = ev.ctx.storage.property_mapper
         return {mapper.id_to_name(k): val
-                for k, val in v.properties(ev.ctx.view).items()}
+                for k, val in st.properties.items()}
     raise TypeException("properties() requires a node, relationship or map")
 
 
@@ -111,8 +113,9 @@ def fn_keys(ev, args):
     if isinstance(v, dict):
         return list(v.keys())
     if isinstance(v, (VertexAccessor, EdgeAccessor)):
+        st = ev.checked_state(v)
         mapper = ev.ctx.storage.property_mapper
-        return [mapper.id_to_name(k) for k in v.properties(ev.ctx.view)]
+        return [mapper.id_to_name(k) for k in st.properties]
     raise TypeException("keys() requires a node, relationship or map")
 
 

@@ -61,7 +61,7 @@ class _Namer:
 
 class Transaction:
     __slots__ = ("id", "start_ts", "commit_info", "deltas", "isolation",
-                 "storage", "touched_vertices", "touched_edges")
+                 "storage", "touched_vertices", "touched_edges", "commit_ts")
 
     def __init__(self, txn_id: int, start_ts: int, isolation: IsolationLevel,
                  storage: "InMemoryStorage") -> None:
@@ -73,8 +73,17 @@ class Transaction:
         self.storage = storage
         self.touched_vertices: dict[int, Vertex] = {}
         self.touched_edges: dict[int, Edge] = {}
+        self.commit_ts: Optional[int] = None   # set at commit
 
     def effective_start_ts(self) -> int:
+        # Once committed, the transaction's snapshot ADVANCES to its commit
+        # ts: accessors returned to the client (RETURN n materialized after
+        # stream exhaustion) must see the transaction's own committed state
+        # — commit rewrote the deltas' timestamps to commit_ts, so the
+        # own-write (ts == txn_id) rule no longer identifies them
+        # (reference: storage/v2/mvcc.hpp:37-64 visibility rules).
+        if self.commit_ts is not None:
+            return self.commit_ts
         if self.isolation is IsolationLevel.SNAPSHOT_ISOLATION:
             return self.start_ts
         # READ_COMMITTED / READ_UNCOMMITTED see the latest committed state
@@ -737,6 +746,9 @@ class InMemoryStorage:
         if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or not txn.deltas:
             with self._engine_lock:
                 self._active_txns.pop(txn.id, None)
+                # commit_ts stays None: a no-delta txn has no own writes to
+                # expose, and advancing would leak later commits into a
+                # read-only SI transaction's retained accessors
                 return self._timestamp
 
         touched = list(txn.touched_vertices.values())
@@ -785,6 +797,7 @@ class InMemoryStorage:
                     self._frame_seq += 1
             # visibility flip: all the txn's deltas share this CommitInfo
             txn.commit_info.timestamp = commit_ts
+            txn.commit_ts = commit_ts
             self.constraints.unique.apply_registrations(registrations)
             self._active_txns.pop(txn.id, None)
         # committed state changed → device snapshot caches must re-export

@@ -321,3 +321,69 @@ def test_read_committed_sees_latest():
     w.commit()
     assert rc.find_vertex(gid).get_property(prop) == 2
     rc.abort()
+
+
+def test_post_commit_accessor_sees_own_committed_state(storage):
+    """VERDICT r2 regression: an accessor returned to the client (RETURN n,
+    materialized after the transaction committed and stream exhausted) must
+    see the transaction's OWN committed writes, not the pre-txn state —
+    commit rewrites delta timestamps to the commit ts, so the own-write
+    (ts == txn_id) rule no longer matches and effective_start_ts() must
+    advance to the commit ts."""
+    prop = storage.property_mapper.name_to_id("name")
+    lbl = storage.label_mapper.name_to_id("Extra")
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.set_property(prop, "Andres")
+    gid = v.gid
+    acc.commit()
+
+    acc2 = storage.access()
+    va = acc2.find_vertex(gid)
+    va.set_property(prop, "Michael")
+    va.add_label(lbl)
+    acc2.commit()
+    # post-commit reads through the SAME accessor object, both views
+    assert va.get_property(prop, View.NEW) == "Michael"
+    assert va.get_property(prop, View.OLD) == "Michael"
+    assert va.has_label(lbl, View.OLD)
+
+    # a later writer's commit must stay invisible to the finished txn
+    acc3 = storage.access()
+    acc3.find_vertex(gid).set_property(prop, "Peter")
+    acc3.commit()
+    assert va.get_property(prop, View.NEW) == "Michael"
+
+
+def test_post_commit_deleted_accessor_reports_deleted(storage):
+    acc = storage.access()
+    v = acc.create_vertex()
+    gid = v.gid
+    acc.commit()
+    acc2 = storage.access()
+    va = acc2.find_vertex(gid)
+    acc2.delete_vertex(va, detach=True)
+    acc2.commit()
+    assert not va.is_visible(View.NEW)
+    assert not va.is_visible(View.OLD)
+
+
+def test_read_only_commit_keeps_snapshot(storage):
+    """A no-delta (read-only) SI transaction's retained accessors must NOT
+    advance to later commits when the transaction commits."""
+    prop = storage.property_mapper.name_to_id("p")
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.set_property(prop, 1)
+    gid = v.gid
+    acc.commit()
+
+    r = storage.access()            # SI reader, no writes
+    va = r.find_vertex(gid)
+    assert va.get_property(prop) == 1
+    w = storage.access()
+    w.find_vertex(gid).set_property(prop, 2)
+    w.commit()
+    assert va.get_property(prop) == 1   # snapshot holds pre-commit
+    r.commit()                          # read-only commit
+    assert va.get_property(prop) == 1   # ... and post-commit
