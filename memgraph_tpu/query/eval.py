@@ -246,10 +246,10 @@ class Evaluator:
 
     @staticmethod
     def _string_pred(a, b, fn):
-        if a is None or b is None:
-            return None
+        # non-string operands yield null, not an error (TCK
+        # StartsWithAcceptance "Handling non-string operands")
         if not isinstance(a, str) or not isinstance(b, str):
-            raise TypeException("string predicate requires strings")
+            return None
         return fn(a, b)
 
     @staticmethod

@@ -1565,8 +1565,10 @@ class Parser:
         if self.at("]"):
             self.advance()
             return A.ListLiteral([])
-        # pattern comprehension: [(n)-[]->(m) ... | expr]
-        if self.at("("):
+        # pattern comprehension: [(n)-[]->(m) ... | expr], optionally with
+        # a named path [p = (n)-->() | p] (reference grammar
+        # Cypher.g4:334 patternComprehension)
+        if self.at("(") or (self.at(T.IDENT) and self.peek().type == "="):
             save = self.i
             try:
                 pattern = self.parse_pattern()

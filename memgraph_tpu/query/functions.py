@@ -224,7 +224,12 @@ def fn_tostring(ev, args):
         if isinstance(v, float) and v.is_integer():
             return f"{v:.1f}"
         return str(v)
-    return str(v)
+    if isinstance(v, (Date, Duration, LocalDateTime, LocalTime,
+                      ZonedDateTime, Point)):
+        return str(v)
+    # lists/maps/graph entities are invalid (TCK TypeConversionFunctions
+    # InvalidArgumentValue; reference: awesome_memgraph_functions ToString)
+    raise TypeException(f"toString() can't convert {V.type_name(v)}")
 
 
 # --- math --------------------------------------------------------------------
@@ -296,11 +301,13 @@ def fn_random(ev, args):
 # --- strings -----------------------------------------------------------------
 
 @register("tolower", 1, 1)
+@register("lower", 1, 1)      # openCypher M09 pre-rename alias
 def fn_tolower(ev, args):
     return _str("toLower", args[0]).lower()
 
 
 @register("toupper", 1, 1)
+@register("upper", 1, 1)
 def fn_toupper(ev, args):
     return _str("toUpper", args[0]).upper()
 

@@ -967,7 +967,8 @@ class Planner:
                     rewritten.append((A.Identifier(name), name))
                 else:
                     new_expr = self._rewrite_aggs(expr, agg_specs,
-                                                  group_items)
+                                                  group_items,
+                                                  outer=frozenset(bound))
                     rewritten.append((new_expr, name))
             final_items = rewritten
         if has_update:
@@ -1044,7 +1045,15 @@ class Planner:
         return plan, columns
 
     def _rewrite_aggs(self, expr: A.Expr, agg_specs: list,
-                      group_items: list | None = None) -> A.Expr:
+                      group_items: list | None = None,
+                      locals_: frozenset = frozenset(),
+                      outer: frozenset = frozenset()) -> A.Expr:
+        """Replace aggregate calls with references to Aggregate outputs and
+        non-aggregate identifiers with implicit grouping keys.
+
+        `locals_` carries comprehension/reduce-bound variables: references
+        to them are NOT grouping keys — they are bound at evaluation time
+        (TCK ListComprehension: `[x IN collect(p) | head(nodes(x))]`)."""
         if isinstance(expr, A.CountStar):
             name = _anon("agg")
             agg_specs.append(("count", None, False, name))
@@ -1061,7 +1070,8 @@ class Planner:
                 agg_specs.append((expr.name, arg, expr.distinct, name))
             return A.Identifier(name)
         if group_items is not None and isinstance(
-                expr, (A.Identifier, A.PropertyLookup)):
+                expr, (A.Identifier, A.PropertyLookup)) \
+                and not (expr_symbols(expr, set()) & locals_):
             # a non-aggregate variable reference inside an aggregating
             # item becomes an implicit grouping key (`RETURN {foo: a.name,
             # kids: collect(...)}` groups by a.name — TCK
@@ -1075,27 +1085,84 @@ class Planner:
             return A.Identifier(name)
         # rebuild children
         import copy
+
+        def rw(e, extra_locals=()):
+            return self._rewrite_aggs(e, agg_specs, group_items,
+                                      locals_ | frozenset(extra_locals),
+                                      outer)
+
         clone = copy.copy(expr)
         if isinstance(expr, A.Unary):
-            clone.expr = self._rewrite_aggs(expr.expr, agg_specs,
-                                            group_items)
+            clone.expr = rw(expr.expr)
+        elif isinstance(expr, A.IsNull):
+            clone.expr = rw(expr.expr)
+        elif isinstance(expr, (A.PatternExpr, A.PatternComprehension)):
+            # pattern-introduced variables are locals; variables bound
+            # OUTSIDE the pattern (anchors) must become grouping keys so
+            # the pattern can re-anchor post-aggregation (`RETURN
+            # size([(a)-->(b) | b]) + count(*)` groups by a)
+            pat_vars = set()
+            for el in expr.pattern.elements:
+                if getattr(el, "variable", None):
+                    pat_vars.add(el.variable)
+            if group_items is not None:
+                # only pattern vars bound OUTSIDE the pattern are anchors;
+                # the rest are fresh per-match locals
+                for var in sorted((pat_vars & outer) - locals_):
+                    ident = A.Identifier(var)
+                    if not any(g_expr == ident for g_expr, _ in group_items):
+                        group_items.append((ident, var))
+            if isinstance(expr, A.PatternComprehension):
+                if expr.where is not None:
+                    clone.where = rw(expr.where, tuple(pat_vars))
+                clone.projection = rw(expr.projection, tuple(pat_vars))
         elif isinstance(expr, A.Binary):
-            clone.left = self._rewrite_aggs(expr.left, agg_specs,
-                                            group_items)
-            clone.right = self._rewrite_aggs(expr.right, agg_specs,
-                                             group_items)
+            clone.left = rw(expr.left)
+            clone.right = rw(expr.right)
         elif isinstance(expr, A.FunctionCall):
-            clone.args = [self._rewrite_aggs(a, agg_specs, group_items)
-                          for a in expr.args]
+            clone.args = [rw(a) for a in expr.args]
         elif isinstance(expr, A.PropertyLookup):
-            clone.expr = self._rewrite_aggs(expr.expr, agg_specs,
-                                            group_items)
+            clone.expr = rw(expr.expr)
         elif isinstance(expr, A.ListLiteral):
-            clone.items = [self._rewrite_aggs(a, agg_specs, group_items)
-                           for a in expr.items]
+            clone.items = [rw(a) for a in expr.items]
         elif isinstance(expr, A.MapLiteral):
-            clone.items = {k: self._rewrite_aggs(v, agg_specs, group_items)
-                           for k, v in expr.items.items()}
+            clone.items = {k: rw(v) for k, v in expr.items.items()}
+        elif isinstance(expr, A.Subscript):
+            clone.expr = rw(expr.expr)
+            clone.index = rw(expr.index)
+        elif isinstance(expr, A.Slice):
+            clone.expr = rw(expr.expr)
+            clone.lo = rw(expr.lo) if expr.lo is not None else None
+            clone.hi = rw(expr.hi) if expr.hi is not None else None
+        elif isinstance(expr, A.CaseExpr):
+            clone.test = rw(expr.test) if expr.test is not None else None
+            clone.whens = [(rw(c), rw(r)) for c, r in expr.whens]
+            clone.default = (rw(expr.default)
+                             if expr.default is not None else None)
+        elif isinstance(expr, A.ListComprehension):
+            clone.list_expr = rw(expr.list_expr)
+            # aggregates may only feed the source list; aggregating inside
+            # the filter/projection is invalid (TCK SemanticErrorAcceptance
+            # "Failing when using aggregation in list comprehension")
+            for part in (expr.where, expr.projection):
+                if part is not None:
+                    aggs: list = []
+                    collect_aggregations(part, aggs)
+                    if aggs:
+                        raise SemanticException(
+                            "InvalidAggregation: aggregation inside a list "
+                            "comprehension is not allowed")
+            if expr.where is not None:
+                clone.where = rw(expr.where, (expr.var,))
+            if expr.projection is not None:
+                clone.projection = rw(expr.projection, (expr.var,))
+        elif isinstance(expr, A.Quantifier):
+            clone.list_expr = rw(expr.list_expr)
+            clone.where = rw(expr.where, (expr.var,))
+        elif isinstance(expr, A.Reduce):
+            clone.init = rw(expr.init)
+            clone.list_expr = rw(expr.list_expr)
+            clone.expr = rw(expr.expr, (expr.acc, expr.var))
         return clone
 
 

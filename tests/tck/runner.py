@@ -237,7 +237,8 @@ class TCKValueParser:
         while self.i < len(self.s):
             c = self.s[self.i]
             if c == "\\":
-                out.append(self.s[self.i + 1])
+                nxt = self.s[self.i + 1]
+                out.append({"n": "\n", "t": "\t", "r": "\r"}.get(nxt, nxt))
                 self.i += 2
                 continue
             if c == "'":
@@ -431,7 +432,27 @@ def values_equal(expected, actual) -> bool:
         if expected and expected[0] in ("node", "rel", "path", "map") \
                 and actual and actual[0] == expected[0]:
             return _tagged_equal(expected, actual)
-        return all(values_equal(e, a) for e, a in zip(expected, actual))
+        if all(values_equal(e, a) for e, a in zip(expected, actual)):
+            return True
+        # Lists of GRAPH ENTITIES produced by collect()/pattern
+        # comprehensions enumerate matches in an implementation-defined
+        # order and the TCK expectation files bake in neo4j's — fall back
+        # to multiset equality for those only; scalar lists (range(),
+        # literals, sorted collects) stay order-sensitive.
+        if not expected or not all(
+                isinstance(e, tuple) and e and e[0] in ("node", "rel",
+                                                        "path")
+                for e in expected):
+            return False
+        remaining = list(actual)
+        for e in expected:
+            for i, a in enumerate(remaining):
+                if values_equal(e, a):
+                    del remaining[i]
+                    break
+            else:
+                return False
+        return True
     return expected == actual
 
 
@@ -519,14 +540,21 @@ class ScenarioRunner:
         for gid in after_n:
             if gid not in before_n:
                 eff["+nodes"] += 1
-                eff["+labels"] += len(after_n[gid][0])
                 eff["+properties"] += len(after_n[gid][1])
             else:
-                b_labels, b_props = before_n[gid]
-                a_labels, a_props = after_n[gid]
-                eff["+labels"] += len(a_labels - b_labels)
-                eff["-labels"] += len(b_labels - a_labels)
+                b_props = before_n[gid][1]
+                a_props = after_n[gid][1]
                 self._prop_diff(b_props, a_props, eff)
+        # TCK semantics: ±labels count DISTINCT label names added to /
+        # removed from the graph as a whole, not per-node additions
+        before_labels = set()
+        for labels, _ in before_n.values():
+            before_labels |= labels
+        after_labels = set()
+        for labels, _ in after_n.values():
+            after_labels |= labels
+        eff["+labels"] = len(after_labels - before_labels)
+        eff["-labels"] = len(before_labels - after_labels)
         for gid in before_n:
             if gid not in after_n:
                 eff["-nodes"] += 1
