@@ -220,6 +220,22 @@ class ScanAllById(LogicalOperator):
                 yield new
 
 
+def _used_edge_gids(frame, prev_edge_symbols) -> set:
+    """Edge gids already consumed by earlier pattern elements of the same
+    MATCH — single edges AND var-length edge lists (relationship
+    isomorphism; reference: EdgeUniquenessFilter, plan/operator.hpp)."""
+    used = set()
+    for s in prev_edge_symbols:
+        v = frame.get(s)
+        if isinstance(v, EdgeAccessor):
+            used.add(v.gid)
+        elif isinstance(v, (list, tuple)):
+            for e in v:
+                if isinstance(e, EdgeAccessor):
+                    used.add(e.gid)
+    return used
+
+
 @dataclass
 class Expand(LogicalOperator):
     """Expand one hop from `from_symbol`; binds edge_symbol/to_symbol.
@@ -257,11 +273,23 @@ class Expand(LogicalOperator):
             if from_v is None:
                 continue
             to_bound = self.to_symbol in frame
-            used = {frame[s].gid for s in self.prev_edge_symbols
-                    if isinstance(frame.get(s), EdgeAccessor)}
+            # an edge variable bound by an earlier clause constrains the
+            # match to that exact edge (TCK MatchAcceptance2 "Matching
+            # using a relationship that is already bound"; reference:
+            # existing-symbol handling in rule_based_planner). A PRESENT
+            # key bound to null (OPTIONAL MATCH miss) matches nothing.
+            if self.edge_symbol in frame:
+                prebound = frame[self.edge_symbol]
+                if not isinstance(prebound, EdgeAccessor):
+                    continue
+            else:
+                prebound = None
+            used = _used_edge_gids(frame, self.prev_edge_symbols)
             for ea, other in self._edges(ctx, from_v, type_ids):
                 ctx.consume_hop()
                 if ea.gid in used:
+                    continue
+                if prebound is not None and ea.gid != prebound.gid:
                     continue
                 if to_bound:
                     bound = frame[self.to_symbol]
@@ -329,8 +357,7 @@ class ExpandVariable(LogicalOperator):
             if from_v is None:
                 continue
             to_bound = self.to_symbol in frame
-            used = {frame[s].gid for s in self.prev_edge_symbols
-                    if isinstance(frame.get(s), EdgeAccessor)}
+            used = _used_edge_gids(frame, self.prev_edge_symbols)
 
             def dfs(node, path_edges, used_gids):
                 depth = len(path_edges)
@@ -348,28 +375,52 @@ class ExpandVariable(LogicalOperator):
                     ctx.consume_hop()
                     if ea.gid in used_gids:
                         continue
+                    if prebound is not None and (
+                            depth >= len(prebound)
+                            or ea.gid != prebound[depth].gid):
+                        continue
                     if not self._step_ok(ctx, frame, ea, other):
                         continue
                     yield from dfs(other, path_edges + [ea],
                                    used_gids | {ea.gid})
 
+            # a pre-bound edge-list variable constrains the path to exactly
+            # that relationship sequence (TCK MatchAcceptance2 "Matching
+            # relationships into a list and matching variable length using
+            # the list"); a null binding (OPTIONAL MATCH miss) matches
+            # nothing. The dfs prefix check below keeps this O(len(list))
+            # instead of enumerating every path and filtering after.
+            if self.edge_symbol in frame:
+                prebound = frame[self.edge_symbol]
+                if not isinstance(prebound, (list, tuple)) or not all(
+                        isinstance(p, EdgeAccessor) for p in prebound):
+                    continue
+            else:
+                prebound = None
+
+            def seq_ok(path_edges):
+                return prebound is None or len(path_edges) == len(prebound)
+
             if self.min_hops == 0:
                 # zero-length: from == to
-                if to_bound:
-                    bound = frame[self.to_symbol]
-                    if isinstance(bound, VertexAccessor) and \
-                            bound.gid == from_v.gid:
+                if seq_ok([]):
+                    if to_bound:
+                        bound = frame[self.to_symbol]
+                        if isinstance(bound, VertexAccessor) and \
+                                bound.gid == from_v.gid:
+                            new = dict(frame)
+                            new[self.edge_symbol] = []
+                            yield new
+                    else:
                         new = dict(frame)
                         new[self.edge_symbol] = []
+                        new[self.to_symbol] = from_v
                         yield new
-                else:
-                    new = dict(frame)
-                    new[self.edge_symbol] = []
-                    new[self.to_symbol] = from_v
-                    yield new
             start = max(self.min_hops, 1)
             for path_edges, end in dfs(from_v, [], set(used)):
                 if len(path_edges) < start:
+                    continue
+                if not seq_ok(path_edges):
                     continue
                 new = dict(frame)
                 new[self.edge_symbol] = list(path_edges)
@@ -1285,6 +1336,20 @@ class Limit(LogicalOperator):
         if not isinstance(n, int) or isinstance(n, bool) or n < 0:
             raise TypeException("LIMIT must be a non-negative integer")
         yield from itertools.islice(self.input.cursor(ctx), n)
+
+
+@dataclass
+class ScopeBarrier(LogicalOperator):
+    """WITH scope close: prune frames to the projected columns so stale
+    pre-WITH bindings never leak into later clauses (reference: symbol
+    table scoping in semantic/symbol_generator.cpp)."""
+    input: LogicalOperator
+    columns: list[str]
+
+    def cursor(self, ctx):
+        cols = self.columns
+        for frame in self.input.cursor(ctx):
+            yield {k: frame[k] for k in cols if k in frame}
 
 
 @dataclass

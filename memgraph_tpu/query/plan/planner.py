@@ -75,6 +75,23 @@ def expr_symbols(expr: A.Expr, out: set) -> set:
     """Free identifiers referenced by an expression (over-approximate)."""
     if isinstance(expr, A.Identifier):
         out.add(expr.name)
+    if isinstance(expr, (A.PatternExpr, A.PatternComprehension)):
+        # pattern variables anchor on outer bindings when those exist, so
+        # a predicate mentioning them must not be applied before they are
+        # bound (over-approximation: fresh existential vars are included
+        # too — harmless, leftover predicates apply at end of MATCH)
+        for el in expr.pattern.elements:
+            v = getattr(el, "variable", None)
+            if v:
+                out.add(v)
+            props = getattr(el, "properties", None)
+            if isinstance(props, dict):
+                for p in props.values():
+                    expr_symbols(p, out)
+        if isinstance(expr, A.PatternComprehension):
+            if expr.where is not None:
+                expr_symbols(expr.where, out)
+            expr_symbols(expr.projection, out)
     for child in _children_exprs(expr):
         expr_symbols(child, out)
     return out
@@ -668,7 +685,6 @@ class Planner:
             plan = Op.Expand(plan, from_sym, edge_sym, to_sym, direction,
                              edge.types, list(edge_syms_in_match))
         edge_syms_in_match.append(edge_sym)
-        newly_bound = to_sym not in bound
         bound.add(edge_sym)
         bound.add(to_sym)
         # edge property filters
@@ -677,10 +693,20 @@ class Planner:
             for key, expr in edge.properties.items():
                 plan = Op.Filter(plan, A.Binary(
                     "=", A.PropertyLookup(ident, key), expr))
-        if newly_bound:
-            plan = self._apply_node_filters(to_node, plan, bound, pending)
-        else:
-            plan = self._apply_ready_predicates(plan, bound, pending)
+        elif isinstance(edge.properties, dict) and edge.var_length:
+            # a property map on a var-length edge applies to EVERY edge of
+            # the path (TCK: `-[:WORKED_WITH* {year: 1988}]->`)
+            var = _anon("vlprop")
+            for key, expr in edge.properties.items():
+                plan = Op.Filter(plan, A.Quantifier(
+                    "ALL", var, A.Identifier(edge_sym),
+                    A.Binary("=", A.PropertyLookup(A.Identifier(var), key),
+                             expr)))
+        # labels/properties on the endpoint filter whether it was newly
+        # bound here or bound by an earlier clause — in the latter case
+        # they are constraints, not binders (TCK: `(a)-[:T]->(b:Label)`
+        # with b already bound)
+        plan = self._apply_node_filters(to_node, plan, bound, pending)
         return plan
 
     # --- CREATE / MERGE -----------------------------------------------------
@@ -788,9 +814,16 @@ class Planner:
                                        [], [])
         for item in merge.on_match:
             match_plan = self.plan_set_items([item], match_plan, match_bound)
-        # create side
+        # create side — an undirected MERGE relationship matches both
+        # orientations but CREATES outgoing (TCK MergeRelationshipAcceptance
+        # "Use outgoing direction when unspecified")
+        import copy
+        create_pattern = copy.deepcopy(pattern)
+        for el in create_pattern.elements[1::2]:
+            if el.direction == "both":
+                el.direction = "out"
         create_bound = set(bound)
-        create_plan = self._plan_create_pattern(pattern, Op.Argument(),
+        create_plan = self._plan_create_pattern(create_pattern, Op.Argument(),
                                                 create_bound)
         for item in merge.on_create:
             create_plan = self.plan_set_items([item], create_plan,
@@ -1042,6 +1075,12 @@ class Planner:
             plan = Op.Limit(plan, body.limit)
         if where is not None:
             plan = Op.Filter(plan, where)
+        if is_with:
+            # WITH closes the variable scope: only projected columns may
+            # leak downstream — stale frame keys from before the WITH must
+            # not make later pattern variables look bound (TCK
+            # WithAcceptance "A simple pattern with one bound endpoint")
+            plan = Op.ScopeBarrier(plan, columns)
         return plan, columns
 
     def _rewrite_aggs(self, expr: A.Expr, agg_specs: list,
@@ -1105,6 +1144,8 @@ class Planner:
             for el in expr.pattern.elements:
                 if getattr(el, "variable", None):
                     pat_vars.add(el.variable)
+            if expr.pattern.variable:        # named path: [p = (a)--() | p]
+                pat_vars.add(expr.pattern.variable)
             if group_items is not None:
                 # only pattern vars bound OUTSIDE the pattern are anchors;
                 # the rest are fresh per-match locals
@@ -1112,6 +1153,14 @@ class Planner:
                     ident = A.Identifier(var)
                     if not any(g_expr == ident for g_expr, _ in group_items):
                         group_items.append((ident, var))
+            # property-map expressions inside the pattern may reference
+            # outer variables — those must become grouping keys too
+            clone.pattern = copy.deepcopy(expr.pattern)
+            for el in clone.pattern.elements:
+                props = getattr(el, "properties", None)
+                if isinstance(props, dict):
+                    for key in list(props):
+                        props[key] = rw(props[key], tuple(pat_vars))
             if isinstance(expr, A.PatternComprehension):
                 if expr.where is not None:
                     clone.where = rw(expr.where, tuple(pat_vars))

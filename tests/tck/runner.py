@@ -416,7 +416,7 @@ def canonicalize(value, storage):
     return canon(value)
 
 
-def values_equal(expected, actual) -> bool:
+def values_equal(expected, actual, entity_multiset=False) -> bool:
     import math
     if isinstance(expected, float) and isinstance(actual, (int, float)):
         if math.isnan(expected):
@@ -432,13 +432,17 @@ def values_equal(expected, actual) -> bool:
         if expected and expected[0] in ("node", "rel", "path", "map") \
                 and actual and actual[0] == expected[0]:
             return _tagged_equal(expected, actual)
-        if all(values_equal(e, a) for e, a in zip(expected, actual)):
+        if all(values_equal(e, a, entity_multiset)
+               for e, a in zip(expected, actual)):
             return True
         # Lists of GRAPH ENTITIES produced by collect()/pattern
         # comprehensions enumerate matches in an implementation-defined
         # order and the TCK expectation files bake in neo4j's — fall back
-        # to multiset equality for those only; scalar lists (range(),
+        # to multiset equality for those only, and only when the scenario
+        # does not demand ordered results; scalar lists (range(),
         # literals, sorted collects) stay order-sensitive.
+        if not entity_multiset:
+            return False
         if not expected or not all(
                 isinstance(e, tuple) and e and e[0] in ("node", "rel",
                                                         "path")
@@ -689,6 +693,9 @@ class ScenarioRunner:
                 f"{len(actual)} rows != expected {len(expected)}: "
                 f"actual={actual!r} expected={expected!r}")
         if in_order:
+            # ordered expectations stay fully strict, including list
+            # element order (a collect() after ORDER BY must not be
+            # accepted shuffled)
             for e_row, a_row in zip(expected, actual):
                 if not _row_equal(e_row, a_row):
                     raise ScenarioFailure(
@@ -697,7 +704,7 @@ class ScenarioRunner:
             remaining = list(actual)
             for e_row in expected:
                 for idx, a_row in enumerate(remaining):
-                    if _row_equal(e_row, a_row):
+                    if _row_equal(e_row, a_row, entity_multiset=True):
                         del remaining[idx]
                         break
                 else:
@@ -759,9 +766,9 @@ class ScenarioRunner:
             self.cleanup()
 
 
-def _row_equal(e_row, a_row) -> bool:
+def _row_equal(e_row, a_row, entity_multiset=False) -> bool:
     return len(e_row) == len(a_row) and all(
-        values_equal(e, a) for e, a in zip(e_row, a_row))
+        values_equal(e, a, entity_multiset) for e, a in zip(e_row, a_row))
 
 
 def _sort_lists(v):
