@@ -74,16 +74,33 @@ class DeviceGraph:
     gid_to_idx: dict = field(repr=False, hash=False, compare=False)
 
     def to_device(self) -> "DeviceGraph":
-        import jax.numpy as jnp
+        from .blob import put_packed
+        if not isinstance(self.row_ptr, np.ndarray):
+            # arrays already device-resident: shipping them through
+            # pack_blob would round-trip device->host->device
+            return DeviceGraph(
+                row_ptr=self.row_ptr, col_idx=self.col_idx,
+                src_idx=self.src_idx, weights=self.weights,
+                csc_src=self.csc_src, csc_dst=self.csc_dst,
+                csc_weights=self.csc_weights, out_degree=self.out_degree,
+                n_nodes=self.n_nodes, n_edges=self.n_edges,
+                n_pad=self.n_pad, e_pad=self.e_pad,
+                node_gids=self.node_gids, gid_to_idx=self.gid_to_idx)
+        dev = put_packed({
+            "row_ptr": self.row_ptr, "col_idx": self.col_idx,
+            "src_idx": self.src_idx, "weights": self.weights,
+            "csc_src": self.csc_src, "csc_dst": self.csc_dst,
+            "csc_weights": self.csc_weights,
+            "out_degree": self.out_degree})
         return DeviceGraph(
-            row_ptr=jnp.asarray(self.row_ptr),
-            col_idx=jnp.asarray(self.col_idx),
-            src_idx=jnp.asarray(self.src_idx),
-            weights=jnp.asarray(self.weights),
-            csc_src=jnp.asarray(self.csc_src),
-            csc_dst=jnp.asarray(self.csc_dst),
-            csc_weights=jnp.asarray(self.csc_weights),
-            out_degree=jnp.asarray(self.out_degree),
+            row_ptr=dev["row_ptr"],
+            col_idx=dev["col_idx"],
+            src_idx=dev["src_idx"],
+            weights=dev["weights"],
+            csc_src=dev["csc_src"],
+            csc_dst=dev["csc_dst"],
+            csc_weights=dev["csc_weights"],
+            out_degree=dev["out_degree"],
             n_nodes=self.n_nodes, n_edges=self.n_edges,
             n_pad=self.n_pad, e_pad=self.e_pad,
             node_gids=self.node_gids, gid_to_idx=self.gid_to_idx)

@@ -117,6 +117,8 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
 def pagerank(graph: DeviceGraph, damping: float = 0.85,
              max_iterations: int = 100, tol: float = 1e-6):
     """Returns (ranks[:n_nodes], error, iterations)."""
+    from ..utils.jax_cache import ensure_compile_cache
+    ensure_compile_cache()
     if graph.n_edges >= MXU_MIN_EDGES and (
             jax.default_backend() != "cpu"
             or os.environ.get("MEMGRAPH_TPU_FORCE_MXU")):

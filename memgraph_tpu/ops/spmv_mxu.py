@@ -275,15 +275,14 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
 # device kernel
 # ---------------------------------------------------------------------------
 
-def _unpack_masks_2d(packed, net_log2):
-    """(stages, N/8) uint8 -> (stages, N/128, 128) bool (flat if N < 128)."""
-    import jax.numpy as jnp
+def _unpack_mask_words(words, net_log2):
+    """(stages, W) uint32 words -> (stages, N/128, 128) bool (flat if
+    N < 128). Word layout per blob.unpack_bit_words."""
+    from .blob import unpack_bit_words
     N = 1 << net_log2
-    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
-    bits = ((packed[..., :, None] >> shifts) & 1) != 0
-    bits = bits.reshape(packed.shape[0], -1)[:, :N]
+    bits = unpack_bit_words(words, N)
     if N >= LANES:
-        return bits.reshape(packed.shape[0], N // LANES, LANES)
+        return bits.reshape(words.shape[0], N // LANES, LANES)
     return bits
 
 
@@ -333,6 +332,8 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
     on the 10M-edge bench graph. float32 is the exact path."""
     import jax
     import jax.numpy as jnp
+    from ..utils.jax_cache import ensure_compile_cache
+    ensure_compile_cache()
 
     if route_dtype is None:
         route_dtype = (jnp.bfloat16 if os.environ.get(
@@ -344,26 +345,47 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
     node_flat = G * SG_ROWS * LANES
     n_f = float(plan.n_nodes)
 
-    iota_sg = np.arange(SG_ROWS, dtype=np.int32)
-    iota_kc = np.arange(K_C, dtype=np.int32)
-    # one-hots are static: precompute once on host, ship to HBM
-    oh_np = (plan.rowid[:, :, None] == iota_sg[None, None, :]
-             ).astype(np.float32)                          # (G, R_G, 128)
-    ohe_np = ((plan.run_k[:, :, None] == iota_kc[None, None, :])
-              & (plan.run_k[:, :, None] >= 0)).astype(np.float32)
+    from .blob import pack_blob, unblob
+    blob_np, segs = pack_blob({
+        "masks": ("bits", plan.masks_packed),
+        "node_masks": ("bits", plan.node_masks_packed),
+        "mult": plan.mult.astype(np.float32),
+        "rowid_i32": plan.rowid.astype(np.int32),
+        "run_k_i32": plan.run_k.astype(np.int32),
+        "win_oh": plan.win_oh.astype(np.float32),
+        "valid": plan.valid_out.astype(np.float32),
+        "dangling": plan.dangling_out.astype(np.float32),
+    })
 
-    dev = dict(
-        oh=jnp.asarray(oh_np),
-        mult=jnp.asarray(plan.mult),
-        valid=jnp.asarray(plan.valid_out),
-        dangling=jnp.asarray(plan.dangling_out),
-        masks2=_unpack_masks_2d(jnp.asarray(plan.masks_packed),
-                                plan.net_log2),
-        ohe=jnp.asarray(ohe_np, route_dtype),
-        win_oh=jnp.asarray(plan.win_oh),
-        node_masks2=_unpack_masks_2d(jnp.asarray(plan.node_masks_packed),
-                                     plan.node_net_log2),
-    )
+    def _unblob(blob, name):
+        return unblob(blob, segs, name)
+
+    @jax.jit
+    def prepare(blob):
+        """One compiled pass: slice, bitcast, unpack masks, build one-hots."""
+        iota_sg = jnp.arange(SG_ROWS, dtype=jnp.int32)
+        iota_kc = jnp.arange(K_C, dtype=jnp.int32)
+        # keep int32 on device: narrow conversions compile slowly here
+        rowid = _unblob(blob, "rowid_i32")
+        run_k = _unblob(blob, "run_k_i32")
+        oh = (rowid[:, :, None] == iota_sg[None, None, :]
+              ).astype(jnp.float32)                        # (G, R_G, 128)
+        ohe = ((run_k[:, :, None] == iota_kc[None, None, :])
+               & (run_k[:, :, None] >= 0)).astype(route_dtype)
+        return dict(
+            oh=oh,
+            mult=_unblob(blob, "mult"),
+            valid=_unblob(blob, "valid"),
+            dangling=_unblob(blob, "dangling"),
+            masks2=_unpack_mask_words(_unblob(blob, "masks"),
+                                      plan.net_log2),
+            ohe=ohe,
+            win_oh=_unblob(blob, "win_oh"),
+            node_masks2=_unpack_mask_words(_unblob(blob, "node_masks"),
+                                           plan.node_net_log2),
+        )
+
+    dev = prepare(jax.device_put(blob_np))
     # all-zero-mask stages route nothing: skip them at trace time
     live_big = [bool(row.any()) for row in plan.masks_packed]
     live_node = [bool(row.any()) for row in plan.node_masks_packed]
