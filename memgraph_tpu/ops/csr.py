@@ -78,14 +78,7 @@ class DeviceGraph:
         if not isinstance(self.row_ptr, np.ndarray):
             # arrays already device-resident: shipping them through
             # pack_blob would round-trip device->host->device
-            return DeviceGraph(
-                row_ptr=self.row_ptr, col_idx=self.col_idx,
-                src_idx=self.src_idx, weights=self.weights,
-                csc_src=self.csc_src, csc_dst=self.csc_dst,
-                csc_weights=self.csc_weights, out_degree=self.out_degree,
-                n_nodes=self.n_nodes, n_edges=self.n_edges,
-                n_pad=self.n_pad, e_pad=self.e_pad,
-                node_gids=self.node_gids, gid_to_idx=self.gid_to_idx)
+            return self
         dev = put_packed({
             "row_ptr": self.row_ptr, "col_idx": self.col_idx,
             "src_idx": self.src_idx, "weights": self.weights,

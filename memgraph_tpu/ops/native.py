@@ -27,7 +27,7 @@ _lib = None
 _tried = False
 
 
-_SOURCES = ("csr_builder.cpp", "benes_router.cpp")
+_SOURCES = ("csr_builder.cpp", "benes_router.cpp", "edge_color.cpp")
 
 
 def _ensure_built() -> bool:
@@ -81,6 +81,17 @@ def get_lib():
             lib._has_benes = True
         except AttributeError:  # stale prebuilt .so without the router
             lib._has_benes = False
+        try:
+            lib.balanced_edge_color.restype = ctypes.c_int
+            lib.balanced_edge_color.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib._has_edge_color = True
+        except AttributeError:
+            lib._has_edge_color = False
         lib.build_csr_csc.restype = ctypes.c_int
         lib.build_csr_csc.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
@@ -145,6 +156,28 @@ def build_csr_csc_native(src: np.ndarray, dst: np.ndarray,
         "csc_src": csc_src, "csc_dst": csc_dst, "csc_w": csc_w,
         "row_ptr": row_ptr, "out_degree": out_degree,
     }
+
+
+def balanced_edge_color_native(src: np.ndarray, dst: np.ndarray,
+                               n_src: int, n_dst: int, levels: int):
+    """Balanced bipartite edge coloring into 2^levels shards (Euler
+    splits, native/edge_color.cpp): every vertex's edges divide
+    floor(d/P)..ceil(d/P) per shard on BOTH sides. Returns uint8
+    shard ids, or None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_edge_color", False):
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    out = np.zeros(len(src), dtype=np.uint8)
+    rc = lib.balanced_edge_color(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(src), n_src, n_dst, levels,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        raise ValueError("invalid input for balanced_edge_color")
+    return out
 
 
 def benes_route_native(perm: np.ndarray):

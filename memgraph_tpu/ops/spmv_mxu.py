@@ -108,34 +108,30 @@ def _relabel_by(key: np.ndarray, stripe_groups: int = 0) -> np.ndarray:
     return relab
 
 
-def build_plan(src: np.ndarray, dst: np.ndarray,
-               weights: Optional[np.ndarray], n_nodes: int) -> MXUPlan:
-    """Precompute layouts + routing for the MXU pagerank kernel."""
-    src = np.asarray(src, dtype=np.int64)
-    dst = np.asarray(dst, dtype=np.int64)
+def _gather_layout(src, w, relab_out, inv_wsum, G, force_R_G=None):
+    """Gather-side layout for an edge subset under a FIXED out labeling.
+
+    Returns (R_G, rowid, mult, gp_by_edge): rows per supergroup, the
+    src-row id of every gather row, the per-slot multiplier (w/wsum,
+    0 on padding), and each edge's flat gather position (edge order).
+
+    force_R_G: use this (>= required) row count so plans for different
+    edge shards stack into uniform arrays.
+    """
     E = len(src)
-    w = (np.ones(E, dtype=np.float64) if weights is None
-         else np.asarray(weights, dtype=np.float64))
-
-    out_deg = np.bincount(src, minlength=n_nodes)
-    in_deg = np.bincount(dst, minlength=n_nodes)
-    wsum = np.bincount(src, weights=w, minlength=n_nodes)
-
-    n_rows = _ceil_to(n_nodes, LANES) // LANES
-    G = _ceil_to(n_rows, SG_ROWS) // SG_ROWS
-    relab_out = _relabel_by(out_deg, stripe_groups=G)
-    relab_in = _relabel_by(in_deg)
-
-    # ---------------- gather layout (out labeling) ----------------
+    node_flat = G * SG_ROWS * LANES
     u = relab_out[src]
     srow, slane = u >> 7, u & 127
-    # rows per src-row block = max out-degree among its 128 nodes
-    deg_out_l = np.zeros(G * SG_ROWS * LANES, dtype=np.int64)
-    deg_out_l[relab_out] = out_deg
-    H_out = deg_out_l.reshape(-1, LANES).max(axis=1)          # per src-row
-    H_out = np.maximum(H_out, 0)
+    # per-edge count per labeled node (LOCAL to this subset)
+    deg_l = np.bincount(u, minlength=node_flat)
+    # rows per src-row block = max subset-degree among its 128 nodes
+    H_out = deg_l.reshape(-1, LANES).max(axis=1)              # per src-row
     rows_per_sg = H_out.reshape(G, SG_ROWS).sum(axis=1)
     R_G = max(1, int(rows_per_sg.max()))
+    if force_R_G is not None:
+        if force_R_G < R_G:
+            raise ValueError(f"force_R_G={force_R_G} < required {R_G}")
+        R_G = force_R_G
     # base row (within supergroup) of each src-row block
     base_in_sg = np.zeros(G * SG_ROWS, dtype=np.int64)
     for g in range(G):
@@ -145,7 +141,7 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
     # per-edge sequence within its (node) bucket, in (src) sorted order
     order_g = np.argsort(u, kind="stable")
     seq = np.arange(E) - np.concatenate(([0], np.cumsum(
-        np.bincount(u, minlength=G * SG_ROWS * LANES))))[u[order_g]]
+        deg_l)))[u[order_g]]
     sg = srow[order_g] >> 7
     grow = base_in_sg[srow[order_g]] + seq                    # row in sg
     gather_pos = ((sg * R_G + grow) * LANES + slane[order_g])
@@ -157,30 +153,24 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
                                         rs)
     mult = np.zeros((G, R_G, LANES), dtype=np.float32)
     mult_flat = mult.reshape(-1)
-    inv_wsum = np.where(wsum > 0, 1.0 / np.maximum(wsum, 1e-300), 0.0)
     mult_flat[gather_pos] = (w * inv_wsum[src])[order_g]
+    gp_by_edge = np.empty(E, dtype=np.int64)
+    gp_by_edge[order_g] = gather_pos
+    return R_G, rowid, mult, gp_by_edge
 
-    node_flat = G * SG_ROWS * LANES
-    valid_out = np.zeros(node_flat, dtype=np.float32)
-    valid_out[relab_out] = 1.0
-    dangling_out = np.zeros(node_flat, dtype=np.float32)
-    dangling_out[relab_out[wsum <= 0]] = 1.0
-    # relab_out covers exactly [0, n_nodes) so valid == first n_nodes
 
-    # ---------------- scatter layout (in labeling) ----------------
+def _scatter_layout(dst, relab_in, n_drows_p):
+    """Scatter/extract layout for an edge subset under a FIXED in
+    labeling. n_drows_p: dst-row count padded to whole K_C windows.
+
+    Returns (C, run_k, win_oh, sp_by_edge, R_total).
+    """
+    E = len(dst)
+    W = n_drows_p // K_C
     v = relab_in[dst]
     drow, dlane = v >> 7, v & 127
-    deg_in_l = np.zeros(node_flat, dtype=np.int64)
-    deg_in_l[relab_in] = in_deg
-    H_in = np.maximum(deg_in_l.reshape(-1, LANES).max(axis=1), 1)
-    n_drows = _ceil_to(n_nodes, LANES) // LANES
-    n_drows_p = _ceil_to(n_drows, K_C)                        # whole windows
-    if len(H_in) >= n_drows_p:
-        H_in = H_in[:n_drows_p]
-    else:  # extend with single-row empty blocks (extract reads zeros)
-        H_in = np.concatenate(
-            [H_in, np.ones(n_drows_p - len(H_in), dtype=H_in.dtype)])
-    W = n_drows_p // K_C
+    cnt = np.bincount(v, minlength=n_drows_p * LANES)
+    H_in = np.maximum(cnt.reshape(-1, LANES).max(axis=1), 1)[:n_drows_p]
 
     # chunked row allocation: the full-run one-hot extract sums EVERY row
     # of a dst block, so every row of a block must live in chunks claimed
@@ -223,35 +213,29 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
     # per-edge scatter position
     order_s = np.argsort(v, kind="stable")
     seq2 = np.arange(E) - np.concatenate(([0], np.cumsum(
-        np.bincount(v, minlength=node_flat))))[v[order_s]]
+        cnt)))[v[order_s]]
     scatter_pos = ((base2[drow[order_s]] + seq2) * LANES + dlane[order_s])
-
-    # ---------------- big Benes routing ----------------
-    n_gather_flat = G * R_G * LANES
-    n_scatter_flat = R_total * LANES
-    net = max(n_gather_flat, n_scatter_flat, 2)
-    net_log2 = int(np.ceil(np.log2(net)))
-    N_net = 1 << net_log2
-    # perm in gather form: output position q takes input position p
-    perm = np.full(N_net, -1, dtype=np.int64)
-    # edge e sits at gather_pos[i] where i indexes order_g; express both
-    # positions for the SAME edge: map order_g-indexed to order_s-indexed
-    gp_by_edge = np.empty(E, dtype=np.int64)
-    gp_by_edge[order_g] = gather_pos
     sp_by_edge = np.empty(E, dtype=np.int64)
     sp_by_edge[order_s] = scatter_pos
+    return C, run_k, win_oh, sp_by_edge, R_total
+
+
+def _edge_perm_masks(gp_by_edge, sp_by_edge, net_log2):
+    """Route the big Benes: scatter position <- gather position for every
+    edge, identity-completed on free slots (all of which carry zeros)."""
+    N_net = 1 << net_log2
+    perm = np.full(N_net, -1, dtype=np.int64)
     perm[sp_by_edge] = gp_by_edge
-    # complete the bijection: remaining outputs take remaining inputs
-    # (all of which carry exactly 0: pad slots have mult == 0 and
-    # positions beyond the gather layout are zero-filled)
     free_out = np.flatnonzero(perm < 0)
     used_in = np.zeros(N_net, dtype=bool)
     used_in[gp_by_edge] = True
     perm[free_out] = np.flatnonzero(~used_in)
-    masks_packed = route_packed(perm)
+    return route_packed(perm)
 
-    # ---------------- node relabel Benes ----------------
-    acc_flat_len = n_drows_p * LANES          # in-label dense acc
+
+def _node_relabel_masks(relab_out, relab_in, node_flat, n_drows_p):
+    """Route the node Benes: in-label dense acc -> out labeling."""
+    acc_flat_len = n_drows_p * LANES
     node_net_log2 = int(np.ceil(np.log2(max(node_flat, acc_flat_len, 2))))
     N_nn = 1 << node_net_log2
     nperm = np.full(N_nn, -1, dtype=np.int64)
@@ -260,14 +244,62 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
     used_in = np.zeros(N_nn, dtype=bool)
     used_in[relab_in] = True
     nperm[free_out] = np.flatnonzero(~used_in)
-    node_masks_packed = route_packed(nperm)
+    return node_net_log2, route_packed(nperm)
+
+
+def _global_labelings(src, dst, w, n_nodes):
+    """Degree stats + out/in relabelings shared by all shards."""
+    out_deg = np.bincount(src, minlength=n_nodes)
+    in_deg = np.bincount(dst, minlength=n_nodes)
+    wsum = np.bincount(src, weights=w, minlength=n_nodes)
+    n_rows = _ceil_to(n_nodes, LANES) // LANES
+    G = _ceil_to(n_rows, SG_ROWS) // SG_ROWS
+    relab_out = _relabel_by(out_deg, stripe_groups=G)
+    relab_in = _relabel_by(in_deg)
+    inv_wsum = np.where(wsum > 0, 1.0 / np.maximum(wsum, 1e-300), 0.0)
+    node_flat = G * SG_ROWS * LANES
+    valid_out = np.zeros(node_flat, dtype=np.float32)
+    valid_out[relab_out] = 1.0
+    dangling_out = np.zeros(node_flat, dtype=np.float32)
+    dangling_out[relab_out[wsum <= 0]] = 1.0
+    n_drows = _ceil_to(n_nodes, LANES) // LANES
+    n_drows_p = _ceil_to(n_drows, K_C)                        # whole windows
+    return (G, relab_out, relab_in, inv_wsum, valid_out, dangling_out,
+            n_drows_p)
+
+
+def build_plan(src: np.ndarray, dst: np.ndarray,
+               weights: Optional[np.ndarray], n_nodes: int) -> MXUPlan:
+    """Precompute layouts + routing for the MXU pagerank kernel."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    E = len(src)
+    w = (np.ones(E, dtype=np.float64) if weights is None
+         else np.asarray(weights, dtype=np.float64))
+
+    (G, relab_out, relab_in, inv_wsum, valid_out, dangling_out,
+     n_drows_p) = _global_labelings(src, dst, w, n_nodes)
+
+    R_G, rowid, mult, gp_by_edge = _gather_layout(
+        src, w, relab_out, inv_wsum, G)
+    C, run_k, win_oh, sp_by_edge, R_total = _scatter_layout(
+        dst, relab_in, n_drows_p)
+
+    net = max(G * R_G * LANES, R_total * LANES, 2)
+    net_log2 = int(np.ceil(np.log2(net)))
+    masks_packed = _edge_perm_masks(gp_by_edge, sp_by_edge, net_log2)
+
+    node_flat = G * SG_ROWS * LANES
+    node_net_log2, node_masks_packed = _node_relabel_masks(
+        relab_out, relab_in, node_flat, n_drows_p)
 
     return MXUPlan(
         n_nodes=n_nodes, G=G, R_G=R_G, rowid=rowid, mult=mult,
         out_relabel=relab_out, valid_out=valid_out,
         dangling_out=dangling_out,
         net_log2=net_log2, masks_packed=masks_packed,
-        C=C, run_k=run_k, win_oh=win_oh, W=W, in_relabel=relab_in,
+        C=C, run_k=run_k, win_oh=win_oh, W=n_drows_p // K_C,
+        in_relabel=relab_in,
         node_net_log2=node_net_log2, node_masks_packed=node_masks_packed)
 
 
