@@ -106,11 +106,9 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
                 # DeviceGraph is frozen; bypass its setattr guard
                 object.__setattr__(graph, "_mxu_state", cached)
     plan, run = cached
-    node_flat = plan.G * spmv_mxu.SG_ROWS * spmv_mxu.LANES
-    rank0 = np.zeros(node_flat, dtype=np.float32)
-    rank0[plan.out_relabel] = 1.0 / plan.n_nodes
-    rank, err, iters = run(jnp.asarray(rank0), jnp.float32(damping),
-                           int(max_iterations), jnp.float32(tol))
+    # None = uniform start computed on-device (saves a node-flat transfer)
+    rank, err, iters = run(None, np.float32(damping),
+                           int(max_iterations), np.float32(tol))
     return np.asarray(rank)[plan.out_relabel], float(err), int(iters)
 
 
@@ -126,8 +124,8 @@ def pagerank(graph: DeviceGraph, damping: float = 0.85,
     rank, err, iters = _pagerank_kernel(
         graph.csc_src, graph.csc_dst, graph.csc_weights,
         graph.src_idx, graph.weights,
-        jnp.int32(graph.n_nodes), graph.n_pad,
-        jnp.float32(damping), max_iterations, jnp.float32(tol))
+        np.int32(graph.n_nodes), graph.n_pad,
+        np.float32(damping), max_iterations, np.float32(tol))
     return rank[:graph.n_nodes], float(err), int(iters)
 
 
@@ -180,6 +178,6 @@ def personalized_pagerank(graph: DeviceGraph, source_nodes,
     rank, err, iters = _personalized_kernel(
         graph.csc_src, graph.csc_dst, graph.csc_weights,
         graph.src_idx, graph.weights,
-        jnp.int32(graph.n_nodes), graph.n_pad, p,
-        jnp.float32(damping), max_iterations, jnp.float32(tol))
+        np.int32(graph.n_nodes), graph.n_pad, p,
+        np.float32(damping), max_iterations, np.float32(tol))
     return rank[:graph.n_nodes], float(err), int(iters)

@@ -417,7 +417,7 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
                                            plan.node_net_log2),
         )
 
-    dev = prepare(jax.device_put(blob_np))
+    blob_dev = jax.device_put(blob_np)
     # all-zero-mask stages route nothing: skip them at trace time
     live_big = [bool(row.any()) for row in plan.masks_packed]
     live_node = [bool(row.any()) for row in plan.node_masks_packed]
@@ -450,8 +450,7 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
                                   + d * (acc_out + dm / n_f))
         return new_rank
 
-    @partial(jax.jit, static_argnames=("max_iterations",))
-    def run_impl(rank0, damping, max_iterations: int, tol, dv):
+    def _loop(rank0, damping, max_iterations, tol, dv):
         def body(carry):
             rank, _, it = carry
             new_rank = one_iter(rank, damping, dv)
@@ -465,10 +464,25 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
         return jax.lax.while_loop(
             cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
 
+    # prepare + loop fused into ONE jit call: the cold path is then a
+    # single blob transfer + one compile-cached dispatch + one readback
+    # (each extra RPC costs ~0.5-1s through the tunnel)
+    @partial(jax.jit, static_argnames=("max_iterations",))
+    def run_impl(blob, rank0, damping, max_iterations: int, tol):
+        return _loop(rank0, damping, max_iterations, tol, prepare(blob))
+
+    @partial(jax.jit, static_argnames=("max_iterations",))
+    def run_impl_uniform(blob, damping, max_iterations: int, tol):
+        dv = prepare(blob)
+        rank0 = dv["valid"] * jnp.float32(1.0 / n_f)
+        return _loop(rank0, damping, max_iterations, tol, dv)
+
     def run(rank0, damping, max_iterations, tol):
-        # dev passed as an argument pytree so the big mask arrays are
-        # runtime inputs, not baked-in jit constants
-        return run_impl(rank0, damping, max_iterations, tol, dev)
+        """rank0 = None starts from the uniform distribution, computed
+        on-device (saves the rank0 host->device transfer)."""
+        if rank0 is None:
+            return run_impl_uniform(blob_dev, damping, max_iterations, tol)
+        return run_impl(blob_dev, rank0, damping, max_iterations, tol)
 
     return run
 
@@ -481,10 +495,7 @@ def pagerank_mxu(src, dst, weights, n_nodes, damping=0.85,
     if plan is None:
         plan = build_plan(src, dst, weights, n_nodes)
     run = make_pagerank_kernel(plan)
-    node_flat = plan.G * SG_ROWS * LANES
-    rank0 = np.zeros(node_flat, dtype=np.float32)
-    rank0[plan.out_relabel] = 1.0 / plan.n_nodes
-    rank, err, iters = run(jnp.asarray(rank0), jnp.float32(damping),
+    rank, err, iters = run(None, jnp.float32(damping),
                            max_iterations, jnp.float32(tol))
     rank = np.asarray(rank)
     return rank[plan.out_relabel], float(err), int(iters)

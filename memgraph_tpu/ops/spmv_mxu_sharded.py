@@ -178,6 +178,10 @@ def make_sharded_pagerank_kernel(plan: ShardedMXUPlan, mesh,
 
     if route_dtype is None:
         route_dtype = jnp.bfloat16
+    if plan.n_shards != int(mesh.shape[axis_name]):
+        raise ValueError(
+            f"plan built for {plan.n_shards} shards but mesh axis "
+            f"'{axis_name}' has {mesh.shape[axis_name]} devices")
 
     G, R_G, C, W = plan.G, plan.R_G, plan.C, plan.W
     Pn = plan.n_shards
@@ -273,7 +277,6 @@ def make_sharded_pagerank_kernel(plan: ShardedMXUPlan, mesh,
         return jax.lax.while_loop(
             cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
 
-    Ps = P(axis_name)
     Pr = P()
     sharded = shard_map(
         shard_fn, mesh=mesh,
