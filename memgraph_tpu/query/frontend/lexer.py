@@ -249,7 +249,13 @@ def _scan_number(text, i, line, col, err):
                 j += 1
     raw = text[i:j]
     if is_float:
-        return Token(T.FLOAT, float(raw), i, line, col), j
+        value = float(raw)
+        if value in (float("inf"), float("-inf")):
+            # FloatingPointOverflow (TCK SemanticErrorAcceptance):
+            # a literal too large for f64 is a compile-time error
+            err(f"FloatingPointOverflow: float literal {raw!r} is out of "
+                f"range", i)
+        return Token(T.FLOAT, value, i, line, col), j
     # leading-zero octal (Cypher legacy)
     if len(raw) > 1 and raw[0] == "0" and all(ch in "01234567" for ch in raw[1:]):
         return Token(T.INT, int(raw, 8), i, line, col), j

@@ -1423,6 +1423,12 @@ class Parser:
                 return A.PatternExpr(pattern)
             expr = self.parse_expression()
             self.expect(")")
+            if not isinstance(expr, (A.PropertyLookup, A.Identifier,
+                                     A.Subscript, A.PatternExpr)):
+                # TCK SemanticErrorAcceptance: InvalidArgumentExpression
+                raise SyntaxException(
+                    "InvalidArgumentExpression: exists() expects a "
+                    "property access or a pattern")
             return A.IsNull(expr, negated=True)
         if tok.is_kw("ALL", "ANY", "NONE", "SINGLE") and self.peek().type == "(":
             kind = self.advance().value

@@ -74,6 +74,62 @@ def _children(expr):
     return _children_exprs(expr)
 
 
+def _contains_call(expr, name: str) -> bool:
+    if isinstance(expr, A.FunctionCall) and expr.name.lower() == name:
+        return True
+    return any(_contains_call(c, name) for c in _children(expr))
+
+
+def check_static_types(expr: A.Expr | None, kinds: dict) -> None:
+    """Static argument-type errors the TCK requires at COMPILE time
+    (SemanticErrorAcceptance / SyntaxErrorAcceptance /
+    MiscellaneousErrorAcceptance): functions applied to entity kinds they
+    can never accept, property access on a variable-length relationship
+    list, unknown function names, and non-deterministic rand() inside
+    aggregations. `kinds` is the planner's variable->kind map
+    (node|edge|path|edge_list|value)."""
+    if expr is None:
+        return
+    if isinstance(expr, A.PropertyLookup) and isinstance(expr.expr,
+                                                         A.Identifier):
+        if kinds.get(expr.expr.name) == "edge_list":
+            raise SemanticException(
+                f"InvalidArgumentType: {expr.expr.name} is a variable "
+                f"length relationship (a list), not a single relationship")
+    if isinstance(expr, A.FunctionCall):
+        name = expr.name.lower()
+        arg_kind = None
+        if expr.args and isinstance(expr.args[0], A.Identifier):
+            arg_kind = kinds.get(expr.args[0].name)
+        if name == "type" and arg_kind in ("node", "path"):
+            raise SemanticException(
+                f"InvalidArgumentType: type() expects a relationship, "
+                f"got a {arg_kind}")
+        if name == "length" and arg_kind in ("node", "edge"):
+            raise SemanticException(
+                f"InvalidArgumentType: length() expects a path, "
+                f"got a {arg_kind}")
+        if name == "size" and arg_kind in ("path", "node", "edge"):
+            raise SemanticException(
+                f"InvalidArgumentType: size() expects a list or string, "
+                f"got a {arg_kind}")
+        # exists() is intercepted by the parser (never a FunctionCall
+        # here); its argument check lives in parser.py
+        from ..functions import FUNCTIONS
+        from ..plan.operators import AGGREGATE_FUNCTIONS
+        if name in AGGREGATE_FUNCTIONS:
+            for a in expr.args:
+                if _contains_call(a, "rand"):
+                    raise SemanticException(
+                        "NonConstantExpression: rand() is not allowed "
+                        "inside aggregation functions")
+        elif name not in FUNCTIONS and "." not in expr.name:
+            raise SemanticException(
+                f"UnknownFunction: {expr.name}() is not a known function")
+    for child in _children(expr):
+        check_static_types(child, kinds)
+
+
 def check_no_aggregates(expr: A.Expr | None, context: str) -> None:
     """Aggregation functions are invalid in WHERE / pattern properties /
     procedure args (TCK: InvalidAggregation)."""

@@ -170,7 +170,9 @@ def fn_valuetype(ev, args):
 def fn_tointeger(ev, args):
     v = args[0]
     if isinstance(v, bool):
-        return 1 if v else 0
+        # InvalidArgumentValue per TCK TypeConversionFunctions (the
+        # bool-accepting variant is toIntegerOrNull/toBooleanList)
+        raise TypeException("toInteger() can't convert Boolean")
     if isinstance(v, int):
         return v
     if isinstance(v, float):
@@ -202,7 +204,8 @@ def fn_toboolean(ev, args):
     if isinstance(v, bool):
         return v
     if isinstance(v, int):
-        return v != 0
+        # InvalidArgumentValue per TCK TypeConversionFunctions
+        raise TypeException("toBoolean() can't convert Integer")
     if isinstance(v, str):
         low = v.strip().lower()
         if low == "true":
@@ -640,6 +643,22 @@ def fn_isempty(ev, args):
     raise TypeException("isEmpty() requires a string, list or map")
 
 
+def _toboolean_lenient(ev, args):
+    """List/OrNull-variant semantics: integers coerce (nonzero -> true),
+    unlike the scalar toBoolean() which raises per the TCK."""
+    v = args[0]
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v != 0
+    return fn_toboolean(ev, args)
+
+
+def _tointeger_lenient(ev, args):
+    v = args[0]
+    if isinstance(v, bool):
+        return 1 if v else 0
+    return fn_tointeger(ev, args)
+
+
 def _or_null(conv):
     def inner(ev, args):
         try:
@@ -649,9 +668,9 @@ def _or_null(conv):
     return inner
 
 
-register("tointegerornull", 1, 1)(_or_null(fn_tointeger))
+register("tointegerornull", 1, 1)(_or_null(_tointeger_lenient))
 register("tofloatornull", 1, 1)(_or_null(fn_tofloat))
-register("tobooleanornull", 1, 1)(_or_null(fn_toboolean))
+register("tobooleanornull", 1, 1)(_or_null(_toboolean_lenient))
 register("tostringornull", 1, 1)(_or_null(fn_tostring))
 
 
@@ -672,9 +691,9 @@ def _list_conv(name, elem_fn):
     return inner
 
 
-_list_conv("tointegerlist", fn_tointeger)
+_list_conv("tointegerlist", _tointeger_lenient)
 _list_conv("tofloatlist", fn_tofloat)
-_list_conv("tobooleanlist", fn_toboolean)
+_list_conv("tobooleanlist", _toboolean_lenient)
 _list_conv("tostringlist", fn_tostring)
 
 

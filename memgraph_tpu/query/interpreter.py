@@ -10,8 +10,17 @@ and streams results batch-by-batch so Bolt's PULL n maps directly onto
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
+
+# a query plan is a linked chain of operators (one per clause element) and
+# execution is a chain of generators — both need Python stack depth
+# proportional to query size. 1000-clause CREATE queries (TCK
+# LargeCreateQuery) blow the 1000-frame default.
+_MIN_RECURSION_LIMIT = 20_000
+if sys.getrecursionlimit() < _MIN_RECURSION_LIMIT:
+    sys.setrecursionlimit(_MIN_RECURSION_LIMIT)
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 

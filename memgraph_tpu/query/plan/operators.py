@@ -709,6 +709,25 @@ class ExpandKShortest(LogicalOperator):
             else edge.from_vertex()
 
 
+def _chain_edges(edge_list, start_node):
+    """Walk edge_list in the GIVEN order from start_node; returns the
+    interleaved [edge, node, edge, node, ...] tail, or None if some edge
+    is not incident to the walk front (wrong orientation)."""
+    out = []
+    last = start_node
+    for ea in edge_list:
+        if ea.from_vertex().gid == last.gid:
+            nxt = ea.to_vertex()
+        elif ea.to_vertex().gid == last.gid:
+            nxt = ea.from_vertex()
+        else:
+            return None
+        out.append(ea)
+        out.append(nxt)
+        last = nxt
+    return out
+
+
 @dataclass
 class ConstructNamedPath(LogicalOperator):
     """Bind a path variable from matched pattern symbols."""
@@ -727,14 +746,20 @@ class ConstructNamedPath(LogicalOperator):
                     break
                 if isinstance(v, list):      # variable-length edge list
                     if items:
-                        last_node = items[-1]
-                        for ea in v:
-                            nxt = ea.to_vertex() \
-                                if ea.from_vertex().gid == last_node.gid \
-                                else ea.from_vertex()
-                            items.append(ea)
-                            items.append(nxt)
-                            last_node = nxt
+                        # the matcher stores the list in TRAVERSAL order,
+                        # which is REVERSED when the planner expanded from
+                        # the far end — chain whichever orientation walks
+                        # from the declared start, so relationships(p)
+                        # comes out in pattern order (TCK MatchAcceptance
+                        # "starting from the end"). Trying both exact
+                        # orders (not greedy incidence picking) stays
+                        # correct on cycles and parallel edges.
+                        chained = _chain_edges(v, items[-1]) or \
+                            _chain_edges(list(reversed(v)), items[-1])
+                        if chained is None:
+                            ok = False
+                            break
+                        items.extend(chained)
                     continue
                 if items and isinstance(v, VertexAccessor) and \
                         isinstance(items[-1], VertexAccessor):
@@ -991,32 +1016,42 @@ class Delete(LogicalOperator):
 
     def cursor(self, ctx):
         for frame in self.input.cursor(ctx):
+            # two-phase per input row: collect every entity from every
+            # clause expression, delete relationships FIRST, then nodes —
+            # so DELETE p1, p2 over paths sharing endpoints never trips
+            # the has-edges check on a node whose edge dies in the same
+            # clause (TCK DeleteAcceptance "Delete paths from nested
+            # map/list")
+            edges: list = []
+            vertices: list = []
             for expr in self.exprs:
                 value = ctx.evaluator.eval(expr, frame)
-                self._delete_value(ctx, value)
+                self._collect(value, edges, vertices)
+            for ea in edges:
+                if ea.is_visible(View.NEW):
+                    ctx.accessor.delete_edge(ea)
+                    ctx.stats["relationships_deleted"] += 1
+            for va in vertices:
+                if va.is_visible(View.NEW):
+                    _, deleted_edges = ctx.accessor.delete_vertex(
+                        va, detach=self.detach)
+                    ctx.stats["nodes_deleted"] += 1
+                    ctx.stats["relationships_deleted"] += len(deleted_edges)
             yield frame
 
-    def _delete_value(self, ctx, value):
+    def _collect(self, value, edges, vertices):
         if value is None:
             return
         if isinstance(value, VertexAccessor):
-            if value.is_visible(View.NEW):
-                _, deleted_edges = ctx.accessor.delete_vertex(
-                    value, detach=self.detach)
-                ctx.stats["nodes_deleted"] += 1
-                ctx.stats["relationships_deleted"] += len(deleted_edges)
+            vertices.append(value)
         elif isinstance(value, EdgeAccessor):
-            if value.is_visible(View.NEW):
-                ctx.accessor.delete_edge(value)
-                ctx.stats["relationships_deleted"] += 1
+            edges.append(value)
         elif isinstance(value, Path):
-            for ea in value.edges():
-                self._delete_value(ctx, ea)
-            for va in value.vertices():
-                self._delete_value(ctx, va)
+            edges.extend(value.edges())
+            vertices.extend(value.vertices())
         elif isinstance(value, (list, tuple)):
             for item in value:
-                self._delete_value(ctx, item)
+                self._collect(item, edges, vertices)
         else:
             raise TypeException(
                 f"DELETE on {V.type_name(value)} is not supported")
@@ -1221,11 +1256,13 @@ class _AggState:
             self.m2 += delta * (value - self.mean)
             return
         if kind == "min":
-            if self.minv is None or V.cypher_lt(value, self.minv) is True:
+            # full orderability, not comparability: over mixed types the
+            # TCK expects e.g. lists < strings < numbers (order_key ranks)
+            if self.minv is None or order_key(value) < order_key(self.minv):
                 self.minv = value
             return
         if kind == "max":
-            if self.maxv is None or V.cypher_lt(self.maxv, value) is True:
+            if self.maxv is None or order_key(self.maxv) < order_key(value):
                 self.maxv = value
             return
         raise SemanticException(f"unknown aggregate {kind}")
@@ -1333,9 +1370,11 @@ class Limit(LogicalOperator):
 
     def cursor(self, ctx):
         n = ctx.evaluator.eval(self.expr, {})
-        if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+        if not isinstance(n, int) or isinstance(n, bool):
             raise TypeException("LIMIT must be a non-negative integer")
-        yield from itertools.islice(self.input.cursor(ctx), n)
+        # negative literals fail at compile time; a negative PARAMETER
+        # "should not generate errors" (TCK OrderByAcceptance) — clamp
+        yield from itertools.islice(self.input.cursor(ctx), max(n, 0))
 
 
 @dataclass

@@ -16,7 +16,8 @@ from typing import Optional
 from ...exceptions import SemanticException
 from ..frontend import ast as A
 from ..frontend.semantic import (check_expr_scope,
-                                  check_no_aggregates)
+                                  check_no_aggregates,
+                                  check_static_types)
 from . import operators as Op
 
 _ANON = itertools.count()
@@ -97,6 +98,20 @@ def expr_symbols(expr: A.Expr, out: set) -> set:
     return out
 
 
+def _check_storable_literal(expr) -> None:
+    """SET n.p = <literal> with a statically-invalid property type —
+    a list containing maps — is a compile-time TypeError (TCK
+    MiscellaneousErrorAcceptance: InvalidPropertyType)."""
+    if isinstance(expr, A.ListLiteral):
+        for item in expr.items:
+            if isinstance(item, A.MapLiteral):
+                from ...exceptions import TypeException
+                raise TypeException(
+                    "InvalidPropertyType: a list of maps cannot be "
+                    "stored as a property")
+            _check_storable_literal(item)
+
+
 def _split_and(expr: Optional[A.Expr]) -> list:
     if expr is None:
         return []
@@ -159,6 +174,7 @@ class Planner:
                 if clause.parallel:
                     parallel_hint = True
                 self._validate_match(clause, bound, kinds)
+            write_seen_before = write_seen   # for MERGE's read-side barrier
             if isinstance(clause, _READING) and write_seen:
                 plan = Op.Eager(plan)
                 write_seen = False  # barrier absorbs prior writes
@@ -176,6 +192,12 @@ class Planner:
                 plan = self.plan_create(clause, plan, bound)
             elif isinstance(clause, A.Merge):
                 has_update = True
+                if write_seen_before:
+                    # MERGE READS its match side: PRIOR writes (e.g. a
+                    # DELETE) must be fully applied first, or the match
+                    # subplan sees not-yet-deleted entities (TCK
+                    # MergeNodeAcceptance "not able to match on deleted")
+                    plan = Op.Eager(plan)
                 plan = self.plan_merge(clause, plan, bound)
             elif isinstance(clause, A.SetClause):
                 has_update = True
@@ -183,6 +205,8 @@ class Planner:
                     check_expr_scope(item.target, bound, "SET")
                     if isinstance(item.value, A.Expr):
                         check_expr_scope(item.value, bound, "SET")
+                        check_static_types(item.value, kinds)
+                        _check_storable_literal(item.value)
                 plan = self.plan_set_items(clause.items, plan, bound)
             elif isinstance(clause, A.Remove):
                 has_update = True
@@ -232,44 +256,30 @@ class Planner:
                         columns = names
                     produced = True
             elif isinstance(clause, A.With):
+                # items see the PRE-projection scope; WHERE and ORDER BY
+                # see the POST-projection scope (an alias may shadow a
+                # node variable with e.g. a list)
+                self._check_body_types(clause.body, kinds)
+                new_kinds = self._project_kinds(clause.body, kinds,
+                                                columns)
+                check_static_types(clause.where, new_kinds)
+                for si in clause.body.order_by:
+                    check_static_types(getattr(si, "expr", None),
+                                       new_kinds)
                 plan, columns = self.plan_projection(
                     clause.body, plan, bound, has_update, is_with=True,
                     where=clause.where)
                 has_update = False
                 prev_optional = False
-                # propagate variable kinds through the projection: a
-                # passed-through identifier keeps its kind, any computed
-                # expression becomes a plain value (so `WITH [n] AS users
-                # MATCH (users)` is a VariableTypeConflict)
-                new_kinds: dict[str, str] = {}
-                for expr, alias, _verbatim in clause.body.items:
-                    name = alias or (_verbatim if _verbatim
-                                     else _expr_name(expr))
-                    if isinstance(expr, A.Identifier):
-                        k = kinds.get(expr.name)
-                        if k:
-                            new_kinds[name] = k
-                    elif isinstance(expr, (A.ListLiteral, A.MapLiteral,
-                                           A.ListComprehension,
-                                           A.PatternComprehension)) or (
-                            isinstance(expr, A.Literal)
-                            and expr.value is not None) or (
-                            isinstance(expr, A.FunctionCall)
-                            and expr.name in ("collect", "count", "sum",
-                                              "avg", "stdev", "stdevp",
-                                              "percentiledisc",
-                                              "percentilecont")):
-                        # statically KNOWN not to be a graph entity; other
-                        # expressions (coalesce, null, head, ...) stay
-                        # unknown so they may legally appear in patterns
-                        new_kinds[name] = "value"
-                if clause.body.star:
-                    for sym in columns:
-                        if sym in kinds and sym not in new_kinds:
-                            new_kinds[sym] = kinds[sym]
                 kinds = new_kinds
                 bound = set(columns)
             elif isinstance(clause, A.Return):
+                self._check_body_types(clause.body, kinds)
+                post_kinds = self._project_kinds(clause.body, kinds,
+                                                 columns)
+                for si in clause.body.order_by:
+                    check_static_types(getattr(si, "expr", None),
+                                       post_kinds)
                 plan, columns = self.plan_projection(
                     clause.body, plan, bound, has_update, is_with=False)
                 produced = True
@@ -310,6 +320,41 @@ class Planner:
         return [f for f, _ in proc.results]
 
     # --- MATCH --------------------------------------------------------------
+
+    def _check_body_types(self, body: A.ReturnBody, kinds: dict) -> None:
+        for expr, _alias, _verbatim in body.items:
+            check_static_types(expr, kinds)
+
+    @staticmethod
+    def _project_kinds(body: A.ReturnBody, kinds: dict,
+                       columns: list) -> dict:
+        """Variable kinds AFTER a WITH/RETURN projection: a passed-through
+        identifier keeps its kind, a statically-known non-entity expression
+        becomes 'value' (so `WITH [n] AS users MATCH (users)` is a
+        VariableTypeConflict), anything else is unknown (unchecked)."""
+        new_kinds: dict[str, str] = {}
+        for expr, alias, _verbatim in body.items:
+            name = alias or (_verbatim if _verbatim else _expr_name(expr))
+            if isinstance(expr, A.Identifier):
+                k = kinds.get(expr.name)
+                if k:
+                    new_kinds[name] = k
+            elif isinstance(expr, (A.ListLiteral, A.MapLiteral,
+                                   A.ListComprehension,
+                                   A.PatternComprehension)) or (
+                    isinstance(expr, A.Literal)
+                    and expr.value is not None) or (
+                    isinstance(expr, A.FunctionCall)
+                    and expr.name in ("collect", "count", "sum",
+                                      "avg", "stdev", "stdevp",
+                                      "percentiledisc",
+                                      "percentilecont")):
+                new_kinds[name] = "value"
+        if body.star:
+            for sym in columns:
+                if sym in kinds and sym not in new_kinds:
+                    new_kinds[sym] = kinds[sym]
+        return new_kinds
 
     def _validate_match(self, match: A.Match, bound: set,
                         kinds: dict) -> None:
@@ -361,6 +406,10 @@ class Planner:
                             f"{kinds[v]}, used here as a relationship")
                     if not edge.var_length:
                         kinds.setdefault(v, "edge")
+                    else:
+                        # binds a LIST of relationships: single-rel use
+                        # (r.prop) is a compile-time InvalidArgumentType
+                        kinds.setdefault(v, "edge_list")
                     clause_edge_vars.add(v)
                     clause_vars.add(v)
                 if isinstance(edge.properties, A.Parameter):
@@ -378,6 +427,7 @@ class Planner:
         if match.where is not None:
             check_expr_scope(match.where, scope, "WHERE")
             check_no_aggregates(match.where, "WHERE")
+            check_static_types(match.where, kinds)
 
     def plan_match(self, match: A.Match, plan, bound: set):
         where_parts = _split_and(match.where)
@@ -729,7 +779,9 @@ class Planner:
         for node in nodes:
             v = node.variable
             if v and (v in bound or v in seen) \
-                    and (node.labels or node.properties):
+                    and (node.labels or node.properties is not None):
+                # an EMPTY map `(n {})` also counts as re-declaring
+                # (TCK LabelsAcceptance "already bound 5")
                 raise SemanticException(
                     f"VariableAlreadyBound: {v} is already declared — "
                     f"{what} may reuse it only as a bare endpoint")
@@ -808,11 +860,27 @@ class Planner:
     def plan_merge(self, merge: A.Merge, plan, bound: set):
         pattern = merge.pattern
         self._validate_create_pattern(pattern, bound, set(), what="MERGE")
+        # a LITERAL null property can never match nor be created —
+        # compile-time error (TCK MiscellaneousErrorAcceptance
+        # "merging node/relationship with null property")
+        pat_vars = {el.variable for el in pattern.elements if el.variable}
+        for el in pattern.elements:
+            props = getattr(el, "properties", None)
+            if isinstance(props, dict):
+                for key, pexpr in props.items():
+                    if isinstance(pexpr, A.Literal) and pexpr.value is None:
+                        raise SemanticException(
+                            f"MergeReadOwnWrites: cannot merge with null "
+                            f"property value for {key!r}")
         # match side
         match_bound = set(bound)
         match_plan = self.plan_pattern(pattern, Op.Argument(), match_bound,
                                        [], [])
         for item in merge.on_match:
+            check_expr_scope(item.target, bound | pat_vars, "ON MATCH SET")
+            if isinstance(item.value, A.Expr):
+                check_expr_scope(item.value, bound | pat_vars,
+                                 "ON MATCH SET")
             match_plan = self.plan_set_items([item], match_plan, match_bound)
         # create side — an undirected MERGE relationship matches both
         # orientations but CREATES outgoing (TCK MergeRelationshipAcceptance
@@ -826,6 +894,10 @@ class Planner:
         create_plan = self._plan_create_pattern(create_pattern, Op.Argument(),
                                                 create_bound)
         for item in merge.on_create:
+            check_expr_scope(item.target, bound | pat_vars, "ON CREATE SET")
+            if isinstance(item.value, A.Expr):
+                check_expr_scope(item.value, bound | pat_vars,
+                                 "ON CREATE SET")
             create_plan = self.plan_set_items([item], create_plan,
                                               create_bound)
         bound.update(match_bound | create_bound)
@@ -1072,6 +1144,16 @@ class Planner:
         if body.skip is not None:
             plan = Op.Skip(plan, body.skip)
         if body.limit is not None:
+            # negative LITERAL fails at compile; a negative PARAMETER is
+            # clamped at runtime (TCK OrderByAcceptance pair)
+            lim = body.limit
+            if (isinstance(lim, A.Unary) and lim.op == "-"
+                    and isinstance(lim.expr, A.Literal)) or (
+                    isinstance(lim, A.Literal)
+                    and isinstance(lim.value, int) and lim.value < 0):
+                raise SemanticException(
+                    "NegativeIntegerArgument: LIMIT must be a "
+                    "non-negative integer")
             plan = Op.Limit(plan, body.limit)
         if where is not None:
             plan = Op.Filter(plan, where)

@@ -15,6 +15,7 @@ import asyncio
 import logging
 import os
 import struct
+import threading
 
 from ..exceptions import MemgraphTpuError, QueryException
 from ..query.interpreter import Interpreter, InterpreterContext
@@ -509,6 +510,14 @@ class BoltServer:
         if workers is None:
             workers = min(32, (os.cpu_count() or 4) * 4)
         from concurrent.futures import ThreadPoolExecutor
+        # deep generator chains (one frame per plan operator; the
+        # interpreter raises sys recursionlimit for them) need native
+        # stack room in worker threads — 64MB, matching the reference's
+        # bolt worker stack sizing
+        try:
+            threading.stack_size(64 * 1024 * 1024)
+        except (ValueError, RuntimeError):
+            pass
         self._executor = (ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="bolt-worker")
             if workers > 0 else None)

@@ -562,6 +562,8 @@ class ScenarioRunner:
         for gid in before_n:
             if gid not in after_n:
                 eff["-nodes"] += 1
+                # TCK: a deleted entity's properties count as removed
+                eff["-properties"] += len(before_n[gid][1])
         for gid in after_r:
             if gid not in before_r:
                 eff["+relationships"] += 1
@@ -571,6 +573,7 @@ class ScenarioRunner:
         for gid in before_r:
             if gid not in after_r:
                 eff["-relationships"] += 1
+                eff["-properties"] += len(before_r[gid][1])
         return eff
 
     @staticmethod
