@@ -25,13 +25,21 @@ class CoordinatorInstance:
     FAILOVER_MISS_THRESHOLD = 3
 
     def __init__(self, node_id: str, host: str, raft_port: int,
-                 peers: dict[str, tuple[str, int]], kvstore=None):
-        self.raft = RaftNode(node_id, host, raft_port, peers,
-                             apply_fn=self._apply, kvstore=kvstore)
+                 peers: dict[str, tuple[str, int]], kvstore=None,
+                 routers: list[str] | None = None):
+        # bolt addresses of ALL coordinators (config-derived), served in
+        # the ROUTE role so drivers survive losing their bootstrap router
+        self.routers = list(routers or [])
         # replicated cluster state: name -> instance descriptor
+        # (initialized BEFORE RaftNode: restoring a persisted snapshot
+        # calls _restore during RaftNode.__init__)
         self.instances: dict[str, dict] = {}
         self.main_name: str | None = None
         self._lock = threading.Lock()
+        self.raft = RaftNode(node_id, host, raft_port, peers,
+                             apply_fn=self._apply, kvstore=kvstore,
+                             snapshot_fn=self._snapshot,
+                             restore_fn=self._restore)
         self._miss_counts: dict[str, int] = {}
         self._stop = threading.Event()
         self._health_thread: threading.Thread | None = None
@@ -59,6 +67,7 @@ class CoordinatorInstance:
                     "name": command["name"],
                     "mgmt_address": command["mgmt_address"],
                     "replication_address": command["replication_address"],
+                    "bolt_address": command.get("bolt_address"),
                     "role": "replica",
                 }
             elif op == "unregister_instance":
@@ -73,14 +82,43 @@ class CoordinatorInstance:
                     self.instances[name]["role"] = "main"
                     self.main_name = name
 
+    def _snapshot(self) -> dict:
+        """State-machine snapshot for Raft log compaction."""
+        with self._lock:
+            return {"instances": {k: dict(v)
+                                  for k, v in self.instances.items()},
+                    "main_name": self.main_name}
+
+    def _restore(self, state: dict) -> None:
+        """Replace the state machine from a Raft snapshot (restart replay
+        or leader install-snapshot for a lagging coordinator)."""
+        with self._lock:
+            self.instances = {k: dict(v)
+                              for k, v in state.get("instances",
+                                                    {}).items()}
+            self.main_name = state.get("main_name")
+
     # --- client operations (leader only) ------------------------------------
 
     def register_instance(self, name: str, mgmt_address: str,
-                          replication_address: str) -> bool:
+                          replication_address: str,
+                          bolt_address: str | None = None) -> bool:
         return self.raft.propose({
             "op": "register_instance", "name": name,
             "mgmt_address": mgmt_address,
-            "replication_address": replication_address})
+            "replication_address": replication_address,
+            "bolt_address": bolt_address})
+
+    def route_table(self) -> dict:
+        """Bolt ROUTE table from LIVE replicated cluster state (reference:
+        coordinator_instance.cpp routing): MAIN serves writes, replicas
+        serve reads; this coordinator serves further ROUTE requests."""
+        with self._lock:
+            writers = [i["bolt_address"] for i in self.instances.values()
+                       if i["role"] == "main" and i.get("bolt_address")]
+            readers = [i["bolt_address"] for i in self.instances.values()
+                       if i["role"] == "replica" and i.get("bolt_address")]
+        return {"writers": writers, "readers": readers or writers}
 
     def unregister_instance(self, name: str) -> bool:
         return self.raft.propose({"op": "unregister_instance", "name": name})

@@ -51,15 +51,25 @@ class RaftNode:
 
     ELECTION_TIMEOUT = (0.6, 1.2)   # seconds, randomized
     HEARTBEAT_INTERVAL = 0.15
+    COMPACTION_THRESHOLD = 256      # applied entries kept before snapshot
 
     def __init__(self, node_id: str, host: str, port: int,
                  peers: dict[str, tuple[str, int]], apply_fn=None,
-                 kvstore=None):
+                 kvstore=None, snapshot_fn=None, restore_fn=None,
+                 compaction_threshold: int | None = None):
         self.node_id = node_id
         self.host = host
         self.port = port
         self.peers = dict(peers)
         self.apply_fn = apply_fn or (lambda cmd: None)
+        # log compaction (Raft §7; reference: coordinator_log_store.cpp +
+        # raft_state.cpp:370 install-snapshot): snapshot_fn() returns a
+        # JSON-able state-machine snapshot, restore_fn(state) replaces the
+        # state machine wholesale. Without them the log grows unboundedly.
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.compaction_threshold = (compaction_threshold
+                                     or self.COMPACTION_THRESHOLD)
 
         # persistent state (Raft §5.1: currentTerm, votedFor, log[] must
         # survive restarts — reference: coordinator_log_store.cpp); durable
@@ -68,12 +78,18 @@ class RaftNode:
         self.current_term = 0
         self.voted_for: str | None = None
         self.log: list[LogEntry] = []
+        # entries [0, log_start) live in the snapshot; log[0] has absolute
+        # index log_start
+        self.log_start = 0
+        self.snapshot_last_term = 0
+        self.snapshot_state = None
         if kvstore is not None:
             self._restore_persistent_state()
 
-        # volatile
-        self.commit_index = -1
-        self.last_applied = -1
+        # volatile (a restored snapshot means everything up to log_start-1
+        # is already committed and applied into the state machine)
+        self.commit_index = self.log_start - 1
+        self.last_applied = self.log_start - 1
         self.role = "follower"
         self.leader_id: str | None = None
         self.next_index: dict[str, int] = {}
@@ -132,7 +148,19 @@ class RaftNode:
         if term is not None:
             self.current_term = int(term)
         self.voted_for = self._kv.get_str("raft:voted_for") or None
+        snap_raw = self._kv.get_str("raft:snapshot")
+        if snap_raw:
+            snap = json.loads(snap_raw)
+            self.log_start = snap["index"] + 1
+            self.snapshot_last_term = snap["term"]
+            self.snapshot_state = snap["state"]
+            if self.restore_fn is not None and snap["state"] is not None:
+                self.restore_fn(snap["state"])
         for key, raw in self._kv.items_with_prefix("raft:log:"):
+            idx = int(key.rsplit(":", 1)[1])
+            if idx < self.log_start:  # already folded into the snapshot
+                self._kv.delete(key)
+                continue
             self.log.append(LogEntry.from_json(
                 json.loads(raw.decode("utf-8"))))
 
@@ -142,18 +170,68 @@ class RaftNode:
             self._kv.put("raft:term", str(self.current_term))
             self._kv.put("raft:voted_for", self.voted_for or "")
 
-    def _persist_log_from(self, start: int) -> None:
-        # caller holds lock; rewrite entries >= start (truncation-safe keys
-        # are zero-padded so prefix iteration returns them in order)
+    def _persist_log_from(self, start_abs: int) -> None:
+        # caller holds lock; rewrite entries with ABSOLUTE index >= start
+        # (truncation-safe keys are zero-padded so prefix iteration
+        # returns them in order)
         if self._kv is None:
             return
-        for idx in range(start, len(self.log)):
+        for idx in range(max(start_abs, self.log_start), self._abs_len()):
             self._kv.put(f"raft:log:{idx:012d}",
-                         json.dumps(self.log[idx].to_json()))
+                         json.dumps(self.log[idx - self.log_start]
+                                    .to_json()))
         # drop stale tail entries beyond the new log length
         for key, _ in list(self._kv.items_with_prefix("raft:log:")):
-            if int(key.rsplit(":", 1)[1]) >= len(self.log):
+            if int(key.rsplit(":", 1)[1]) >= self._abs_len():
                 self._kv.delete(key)
+
+    def _persist_snapshot(self) -> None:
+        # caller holds lock
+        if self._kv is None:
+            return
+        self._kv.put("raft:snapshot", json.dumps({
+            "index": self.log_start - 1,
+            "term": self.snapshot_last_term,
+            "state": self.snapshot_state}))
+        for key, _ in list(self._kv.items_with_prefix("raft:log:")):
+            if int(key.rsplit(":", 1)[1]) < self.log_start:
+                self._kv.delete(key)
+
+    # --- log index translation (absolute <-> in-memory) ---------------------
+
+    def _abs_len(self) -> int:
+        return self.log_start + len(self.log)
+
+    def _entry(self, idx_abs: int) -> LogEntry:
+        return self.log[idx_abs - self.log_start]
+
+    def _term_at(self, idx_abs: int) -> int:
+        if idx_abs == self.log_start - 1:
+            return self.snapshot_last_term
+        if idx_abs < self.log_start - 1:
+            return -1  # compacted away; only reachable on stale RPCs
+        return self.log[idx_abs - self.log_start].term
+
+    def _maybe_compact(self) -> None:
+        """Caller holds lock: fold applied entries into a state-machine
+        snapshot once enough accumulate (Raft §7)."""
+        if self.snapshot_fn is None:
+            return
+        applied_in_log = self.last_applied - self.log_start + 1
+        if applied_in_log < self.compaction_threshold:
+            return
+        try:
+            state = self.snapshot_fn()
+        except Exception:
+            log.exception("raft snapshot_fn failed; skipping compaction")
+            return
+        self.snapshot_last_term = self._term_at(self.last_applied)
+        del self.log[:applied_in_log]
+        self.log_start = self.last_applied + 1
+        self.snapshot_state = state
+        self._persist_snapshot()
+        log.info("raft %s compacted log through %d", self.node_id,
+                 self.log_start - 1)
 
     # --- public API ---------------------------------------------------------
 
@@ -168,7 +246,7 @@ class RaftNode:
                 return False
             entry = LogEntry(self.current_term, command)
             self.log.append(entry)
-            index = len(self.log) - 1
+            index = self._abs_len() - 1
             self._persist_log_from(index)
             event = threading.Event()
             self._commit_events[index] = event
@@ -267,6 +345,8 @@ class RaftNode:
             return self._on_request_vote(req)
         if kind == "append_entries":
             return self._on_append_entries(req)
+        if kind == "install_snapshot":
+            return self._on_install_snapshot(req)
         return {"ok": False}
 
     def _maybe_step_down(self, term: int) -> None:
@@ -283,8 +363,9 @@ class RaftNode:
             grant = False
             if req["term"] >= self.current_term and \
                     self.voted_for in (None, req["candidate"]):
-                my_last_term = self.log[-1].term if self.log else 0
-                my_last_index = len(self.log) - 1
+                my_last_index = self._abs_len() - 1
+                my_last_term = self._term_at(my_last_index) \
+                    if my_last_index >= 0 else 0
                 up_to_date = (req["last_log_term"] > my_last_term
                               or (req["last_log_term"] == my_last_term
                                   and req["last_log_index"] >= my_last_index))
@@ -308,9 +389,15 @@ class RaftNode:
 
             prev_index = req["prev_log_index"]
             prev_term = req["prev_log_term"]
+            if prev_index < self.log_start - 1:
+                # the leader's window precedes our snapshot: everything
+                # there is committed state already — ack up to the snapshot
+                return {"kind": "append_ack", "term": self.current_term,
+                        "success": True,
+                        "match_index": self.log_start - 1}
             if prev_index >= 0:
-                if prev_index >= len(self.log) or \
-                        self.log[prev_index].term != prev_term:
+                if prev_index >= self._abs_len() or \
+                        self._term_at(prev_index) != prev_term:
                     return {"kind": "append_ack",
                             "term": self.current_term, "success": False}
             # append/overwrite entries
@@ -319,9 +406,11 @@ class RaftNode:
             for i, obj in enumerate(req.get("entries", [])):
                 entry = LogEntry.from_json(obj)
                 idx = insert_at + i
-                if idx < len(self.log):
-                    if self.log[idx].term != entry.term:
-                        del self.log[idx:]
+                if idx < self.log_start:
+                    continue  # already folded into our snapshot
+                if idx < self._abs_len():
+                    if self._term_at(idx) != entry.term:
+                        del self.log[idx - self.log_start:]
                         self.log.append(entry)
                         changed_from = idx if changed_from is None \
                             else min(changed_from, idx)
@@ -334,25 +423,67 @@ class RaftNode:
             # advance commit
             leader_commit = req["leader_commit"]
             if leader_commit > self.commit_index:
-                self.commit_index = min(leader_commit, len(self.log) - 1)
+                self.commit_index = min(leader_commit, self._abs_len() - 1)
             self._apply_committed()
             return {"kind": "append_ack", "term": self.current_term,
                     "success": True,
                     "match_index": prev_index + len(req.get("entries", []))}
 
+    def _on_install_snapshot(self, req: dict) -> dict:
+        """Replace log+state with the leader's snapshot (Raft §7.1;
+        reference analog: raft_state.cpp:370)."""
+        with self._lock:
+            self._maybe_step_down(req["term"])
+            if req["term"] < self.current_term:
+                return {"kind": "snapshot_ack", "term": self.current_term,
+                        "success": False}
+            self.role = "follower"
+            self.leader_id = req["leader"]
+            self._election_deadline = self._new_deadline()
+            idx = req["last_included_index"]
+            trm = req["last_included_term"]
+            if idx <= self.log_start - 1:
+                # stale/duplicate snapshot: we already cover it
+                return {"kind": "snapshot_ack", "term": self.current_term,
+                        "success": True,
+                        "match_index": self.log_start - 1}
+            if idx < self._abs_len() and self._term_at(idx) == trm:
+                # retain the suffix that extends past the snapshot
+                del self.log[:idx + 1 - self.log_start]
+            else:
+                self.log = []
+            self.log_start = idx + 1
+            self.snapshot_last_term = trm
+            self.snapshot_state = req.get("state")
+            if self.restore_fn is not None and \
+                    self.snapshot_state is not None:
+                try:
+                    self.restore_fn(self.snapshot_state)
+                except Exception:
+                    log.exception("raft restore_fn failed")
+            self.commit_index = max(self.commit_index, idx)
+            self.last_applied = idx
+            self._persist_snapshot()
+            self._persist_log_from(self.log_start)
+            self._apply_committed()
+            return {"kind": "snapshot_ack", "term": self.current_term,
+                    "success": True, "match_index": idx}
+
     def _apply_committed(self) -> None:
         # caller holds lock
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            entry = self.log[self.last_applied]
-            try:
-                self.apply_fn(entry.command)
-            except Exception:
-                log.exception("state machine apply failed at %d",
-                              self.last_applied)
+            entry = self._entry(self.last_applied)
+            if not entry.command.get("_noop"):
+                try:
+                    self.apply_fn(entry.command)
+                except Exception:
+                    log.exception("state machine apply failed at %d",
+                                  self.last_applied)
             event = self._commit_events.get(self.last_applied)
             if event is not None:
                 event.set()
+        self._maybe_compact()
 
     # --- timers / elections -------------------------------------------------
 
@@ -376,8 +507,8 @@ class RaftNode:
             self.voted_for = self.node_id
             self._persist_term_vote()
             self._election_deadline = self._new_deadline()
-            last_index = len(self.log) - 1
-            last_term = self.log[-1].term if self.log else 0
+            last_index = self._abs_len() - 1
+            last_term = self._term_at(last_index) if last_index >= 0 else 0
         votes = 1
         for peer_id in list(self.peers):
             resp = self._call_peer(peer_id, {
@@ -399,8 +530,16 @@ class RaftNode:
             if votes >= majority:
                 self.role = "leader"
                 self.leader_id = self.node_id
-                self.next_index = {p: len(self.log) for p in self.peers}
+                self.next_index = {p: self._abs_len() for p in self.peers}
                 self.match_index = {p: -1 for p in self.peers}
+                # Raft §5.4.2: entries from PREVIOUS terms can only be
+                # committed alongside a current-term entry — append a
+                # no-op immediately so a committed-but-unapplied tail
+                # (e.g. the old leader died right after majority ack)
+                # becomes visible without waiting for a client write
+                self.log.append(LogEntry(term, {"_noop": True}))
+                self._persist_log_from(self._abs_len() - 1)
+                self._advance_commit()
                 log.info("raft %s became leader (term %d)", self.node_id,
                          term)
         if self.is_leader():
@@ -418,15 +557,29 @@ class RaftNode:
             if self.role != "leader":
                 return
             term = self.current_term
-            next_idx = self.next_index.get(peer_id, len(self.log))
-            prev_index = next_idx - 1
-            prev_term = self.log[prev_index].term if prev_index >= 0 else 0
-            entries = [e.to_json() for e in self.log[next_idx:]]
-            commit = self.commit_index
-        resp = self._call_peer(peer_id, {
-            "kind": "append_entries", "term": term, "leader": self.node_id,
-            "prev_log_index": prev_index, "prev_log_term": prev_term,
-            "entries": entries, "leader_commit": commit})
+            next_idx = self.next_index.get(peer_id, self._abs_len())
+            if next_idx < self.log_start:
+                # peer is behind our compacted window: ship the snapshot
+                request = {
+                    "kind": "install_snapshot", "term": term,
+                    "leader": self.node_id,
+                    "last_included_index": self.log_start - 1,
+                    "last_included_term": self.snapshot_last_term,
+                    "state": self.snapshot_state}
+            else:
+                prev_index = next_idx - 1
+                prev_term = self._term_at(prev_index) \
+                    if prev_index >= 0 else 0
+                entries = [e.to_json()
+                           for e in self.log[next_idx - self.log_start:]]
+                request = {
+                    "kind": "append_entries", "term": term,
+                    "leader": self.node_id,
+                    "prev_log_index": prev_index,
+                    "prev_log_term": prev_term,
+                    "entries": entries,
+                    "leader_commit": self.commit_index}
+        resp = self._call_peer(peer_id, request)
         if resp is None:
             return
         with self._lock:
@@ -436,7 +589,7 @@ class RaftNode:
             if self.role != "leader" or self.current_term != term:
                 return
             if resp.get("success"):
-                match = resp.get("match_index", prev_index)
+                match = resp.get("match_index", next_idx - 1)
                 self.match_index[peer_id] = max(
                     self.match_index.get(peer_id, -1), match)
                 self.next_index[peer_id] = self.match_index[peer_id] + 1
@@ -447,8 +600,8 @@ class RaftNode:
     def _advance_commit(self) -> None:
         # caller holds lock; commit entries from the CURRENT term replicated
         # on a majority (Raft §5.4.2 safety rule)
-        for idx in range(len(self.log) - 1, self.commit_index, -1):
-            if self.log[idx].term != self.current_term:
+        for idx in range(self._abs_len() - 1, self.commit_index, -1):
+            if self._term_at(idx) != self.current_term:
                 continue
             replicated = 1 + sum(
                 1 for p in self.peers if self.match_index.get(p, -1) >= idx)

@@ -152,13 +152,24 @@ def build_database(args) -> InterpreterContext:
     if args.coordinator_id:
         from .coordination.coordinator import CoordinatorInstance
         peers = {}
+        # peer format: id=host:raftport[@boltport] — the optional bolt
+        # port lets every coordinator advertise ALL coordinators in the
+        # ROUTE role, so drivers survive losing the one they bootstrapped
+        # from (reference: coordinator_instance.cpp routing table)
+        # own entry uses the DIALABLE advertised address, not the bind
+        # address (0.0.0.0 would be served verbatim to remote drivers)
+        routers = [ictx.config["advertised_address"]]
         for part in filter(None, args.coordinator_peers.split(",")):
             pid, _, addr = part.partition("=")
+            addr, _, bolt_port = addr.partition("@")
             host, _, port = addr.rpartition(":")
             peers[pid] = (host, int(port))
+            if bolt_port:
+                routers.append(f"{host}:{int(bolt_port)}")
         ictx.coordinator = CoordinatorInstance(
             args.coordinator_id, args.bolt_address, args.coordinator_port,
-            peers, kvstore=getattr(ictx, "kvstore", None))
+            peers, kvstore=getattr(ictx, "kvstore", None),
+            routers=routers)
         ictx.coordinator.start()
         logging.info("coordinator %s on raft port %d (%d peers)",
                      args.coordinator_id, args.coordinator_port, len(peers))

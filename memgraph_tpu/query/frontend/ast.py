@@ -466,6 +466,7 @@ class CoordinatorQuery:
     name: Optional[str] = None
     mgmt_address: Optional[str] = None
     replication_address: Optional[str] = None
+    bolt_address: Optional[str] = None
 
 
 @dataclass

@@ -544,8 +544,15 @@ class Parser:
         mgmt = self.expect(T.STRING).value
         self.expect_kw("WITH")
         repl = self.expect(T.STRING).value
+        bolt = None
+        # optional bolt endpoint so coordinators can serve ROUTE tables
+        # (reference: REGISTER INSTANCE ... WITH CONFIG {"bolt_server": ...})
+        if self.at(T.IDENT) and self.cur.value.upper() == "BOLT":
+            self.advance()
+            bolt = self.expect(T.STRING).value
         return A.CoordinatorQuery("register", name=name, mgmt_address=mgmt,
-                                  replication_address=repl)
+                                  replication_address=repl,
+                                  bolt_address=bolt)
 
     def parse_create_stream(self) -> A.StreamQuery:
         self.expect_kw("CREATE")

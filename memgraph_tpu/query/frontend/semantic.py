@@ -90,6 +90,30 @@ def check_static_types(expr: A.Expr | None, kinds: dict) -> None:
     (node|edge|path|edge_list|value)."""
     if expr is None:
         return
+    # binders rebind their variable: the outer kind must not leak into
+    # the body (e.g. [r IN [{a: 1}] | r.a] where r is a var-length rel)
+    if isinstance(expr, (A.ListComprehension, A.Quantifier)):
+        check_static_types(expr.list_expr, kinds)
+        inner = {k: v for k, v in kinds.items() if k != expr.var}
+        check_static_types(getattr(expr, "where", None), inner)
+        check_static_types(getattr(expr, "projection", None), inner)
+        return
+    if isinstance(expr, A.Reduce):
+        check_static_types(expr.init, kinds)
+        check_static_types(expr.list_expr, kinds)
+        inner = {k: v for k, v in kinds.items()
+                 if k not in (expr.acc, expr.var)}
+        check_static_types(expr.expr, inner)
+        return
+    if isinstance(expr, A.PatternComprehension):
+        # pattern variables are fresh bindings local to the comprehension
+        inner = dict(kinds)
+        for item in expr.pattern.elements:
+            if item.variable:
+                inner.pop(item.variable, None)
+        check_static_types(expr.where, inner)
+        check_static_types(expr.projection, inner)
+        return
     if isinstance(expr, A.PropertyLookup) and isinstance(expr.expr,
                                                          A.Identifier):
         if kinds.get(expr.expr.name) == "edge_list":

@@ -17,10 +17,16 @@ import time
 # a query plan is a linked chain of operators (one per clause element) and
 # execution is a chain of generators — both need Python stack depth
 # proportional to query size. 1000-clause CREATE queries (TCK
-# LargeCreateQuery) blow the 1000-frame default.
+# LargeCreateQuery) blow the 1000-frame default. Raised when an
+# Interpreter is constructed (not at import: embedders using only the
+# parser/client keep their own limit). Frames are heap-allocated on
+# CPython 3.11+, so this does not risk native stack exhaustion.
 _MIN_RECURSION_LIMIT = 20_000
-if sys.getrecursionlimit() < _MIN_RECURSION_LIMIT:
-    sys.setrecursionlimit(_MIN_RECURSION_LIMIT)
+
+
+def _ensure_recursion_limit() -> None:
+    if sys.getrecursionlimit() < _MIN_RECURSION_LIMIT:
+        sys.setrecursionlimit(_MIN_RECURSION_LIMIT)
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -101,6 +107,7 @@ class Interpreter:
 
     def __init__(self, context: InterpreterContext,
                  system: bool = False) -> None:
+        _ensure_recursion_limit()
         # system interpreters (triggers, streams, init-file, replication
         # internals) bypass RBAC — they act on behalf of the server
         self.system = system
@@ -449,7 +456,8 @@ class Interpreter:
                 "--coordinator-id/--coordinator-port)")
         if node.action == "register":
             ok = coordinator.register_instance(node.name, node.mgmt_address,
-                                               node.replication_address)
+                                               node.replication_address,
+                                               node.bolt_address)
             if not ok:
                 raise QueryException(
                     "could not commit instance registration (no raft "

@@ -260,8 +260,7 @@ class Planner:
                 # see the POST-projection scope (an alias may shadow a
                 # node variable with e.g. a list)
                 self._check_body_types(clause.body, kinds)
-                new_kinds = self._project_kinds(clause.body, kinds,
-                                                columns)
+                new_kinds = self._project_kinds(clause.body, kinds)
                 check_static_types(clause.where, new_kinds)
                 for si in clause.body.order_by:
                     check_static_types(getattr(si, "expr", None),
@@ -275,8 +274,7 @@ class Planner:
                 bound = set(columns)
             elif isinstance(clause, A.Return):
                 self._check_body_types(clause.body, kinds)
-                post_kinds = self._project_kinds(clause.body, kinds,
-                                                 columns)
+                post_kinds = self._project_kinds(clause.body, kinds)
                 for si in clause.body.order_by:
                     check_static_types(getattr(si, "expr", None),
                                        post_kinds)
@@ -326,8 +324,7 @@ class Planner:
             check_static_types(expr, kinds)
 
     @staticmethod
-    def _project_kinds(body: A.ReturnBody, kinds: dict,
-                       columns: list) -> dict:
+    def _project_kinds(body: A.ReturnBody, kinds: dict) -> dict:
         """Variable kinds AFTER a WITH/RETURN projection: a passed-through
         identifier keeps its kind, a statically-known non-entity expression
         becomes 'value' (so `WITH [n] AS users MATCH (users)` is a
@@ -351,9 +348,10 @@ class Planner:
                                       "percentilecont")):
                 new_kinds[name] = "value"
         if body.star:
-            for sym in columns:
-                if sym in kinds and sym not in new_kinds:
-                    new_kinds[sym] = kinds[sym]
+            # every currently-visible variable stays visible under `*`
+            # (kinds only ever holds in-scope variables)
+            for sym, k in kinds.items():
+                new_kinds.setdefault(sym, k)
         return new_kinds
 
     def _validate_match(self, match: A.Match, bound: set,

@@ -15,7 +15,6 @@ import asyncio
 import logging
 import os
 import struct
-import threading
 
 from ..exceptions import MemgraphTpuError, QueryException
 from ..query.interpreter import Interpreter, InterpreterContext
@@ -481,8 +480,28 @@ class BoltSession:
         return True
 
     def on_route(self, fields) -> bool:
-        # single-instance routing table: this server serves all roles
         addr = self.ictx.config.get("advertised_address", "localhost:7687")
+        coordinator = getattr(self.ictx, "coordinator", None)
+        if coordinator is not None:
+            # serve from LIVE replicated cluster state: MAIN writes,
+            # replicas read, this coordinator routes (reference:
+            # coordinator_instance.cpp; clients re-route to a surviving
+            # coordinator after a failover)
+            table = coordinator.route_table()
+            servers = []
+            if table["writers"]:
+                servers.append({"addresses": table["writers"],
+                                "role": "WRITE"})
+            if table["readers"]:
+                servers.append({"addresses": table["readers"],
+                                "role": "READ"})
+            servers.append(
+                {"addresses": coordinator.routers or [addr],
+                 "role": "ROUTE"})
+            self.send_success({"rt": {"ttl": 10, "db": "memgraph",
+                                      "servers": servers}})
+            return True
+        # single-instance routing table: this server serves all roles
         self.send_success({"rt": {
             "ttl": 300,
             "db": "memgraph",
@@ -510,14 +529,10 @@ class BoltServer:
         if workers is None:
             workers = min(32, (os.cpu_count() or 4) * 4)
         from concurrent.futures import ThreadPoolExecutor
-        # deep generator chains (one frame per plan operator; the
-        # interpreter raises sys recursionlimit for them) need native
-        # stack room in worker threads — 64MB, matching the reference's
-        # bolt worker stack sizing
-        try:
-            threading.stack_size(64 * 1024 * 1024)
-        except (ValueError, RuntimeError):
-            pass
+        # deep generator chains (one Python frame per plan operator) are
+        # heap-allocated and FOR_ITER_GEN-inlined on CPython 3.12 — no
+        # native stack growth — so only sys.recursionlimit (raised by the
+        # Interpreter) matters, not thread stack size
         self._executor = (ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="bolt-worker")
             if workers > 0 else None)

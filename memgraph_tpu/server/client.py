@@ -14,8 +14,8 @@ import struct
 from ..exceptions import MemgraphTpuError
 from . import packstream as ps
 from .bolt import (BOLT_MAGIC, M_BEGIN, M_COMMIT, M_GOODBYE, M_HELLO,
-                   M_LOGON, M_PULL, M_RECORD, M_RESET, M_ROLLBACK, M_RUN,
-                   M_SUCCESS, M_FAILURE, M_IGNORED)
+                   M_LOGON, M_PULL, M_RECORD, M_RESET, M_ROLLBACK,
+                   M_ROUTE, M_RUN, M_SUCCESS, M_FAILURE, M_IGNORED)
 
 
 class BoltClientError(MemgraphTpuError):
@@ -148,6 +148,12 @@ class BoltClient:
     def reset(self):
         self._send_message(M_RESET)
         self._expect_success()
+
+    def route(self, routing: dict | None = None, db: str | None = None):
+        """Fetch the routing table (Bolt 4.3+ ROUTE message)."""
+        self._send_message(M_ROUTE, routing or {}, [], db)
+        meta = self._expect_success()
+        return meta.get("rt")
 
     def close(self):
         try:

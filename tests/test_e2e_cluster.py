@@ -198,3 +198,99 @@ def test_bank_transfer_chaos(cluster):
     check.close()
     assert rows[0][0] == N_ACCOUNTS
     assert rows[0][1] == TOTAL  # balance conserved through crashes
+
+
+def test_coordinator_route_and_reroute(cluster):
+    """3 REAL coordinator processes share cluster state through raft;
+    Bolt ROUTE serves it with ALL coordinators in the ROUTE role; after
+    the bootstrap coordinator is killed, a client re-routes using only
+    addresses learned from the routing table (reference:
+    coordinator_instance.cpp routing + NuRaft failover)."""
+    raft_ports = [free_port() for _ in range(3)]
+    bolt_ports = [free_port() for _ in range(3)]
+    ids = ["c1", "c2", "c3"]
+    coords = []
+    for i, cid in enumerate(ids):
+        peers = ",".join(
+            f"{ids[j]}=127.0.0.1:{raft_ports[j]}@{bolt_ports[j]}"
+            for j in range(3) if j != i)
+        coords.append(cluster.start_instance(f"coord{i + 1}", {
+            "bolt_port": bolt_ports[i],
+            "args": [
+                "--coordinator-id", cid,
+                "--coordinator-port", str(raft_ports[i]),
+                "--coordinator-peers", peers,
+                "--no-storage-wal-enabled"]}))
+    m1 = free_port()
+    r1 = free_port()
+    data1 = cluster.start_instance("rdata1", {"args": [
+        "--management-port", str(m1), "--no-storage-wal-enabled"]})
+
+    # find the raft leader by trying REGISTER on each coordinator
+    clients = {}
+    leader_idx = None
+    deadline = time.time() + 40
+    while time.time() < deadline and leader_idx is None:
+        for i, co in enumerate(coords):
+            try:
+                c = clients.get(i) or co.client()
+                clients[i] = c
+                c.execute(
+                    f'REGISTER INSTANCE i1 ON "127.0.0.1:{m1}" '
+                    f'WITH "127.0.0.1:{r1}" '
+                    f'BOLT "127.0.0.1:{data1.bolt_port}"')
+                leader_idx = i
+                break
+            except Exception:
+                try:
+                    clients[i].reset()
+                except Exception:
+                    clients.pop(i, None)
+        time.sleep(0.3)
+    assert leader_idx is not None, "no raft leader accepted REGISTER"
+    clients[leader_idx].execute("SET INSTANCE i1 TO MAIN")
+
+    # the routing table: MAIN as WRITE, every coordinator as ROUTE
+    rt = clients[leader_idx].route()
+    roles = {s["role"]: s["addresses"] for s in rt["servers"]}
+    assert roles.get("WRITE") == [f"127.0.0.1:{data1.bolt_port}"]
+    # own entry is the advertised address (localhost), peers by host
+    route_ports = sorted(int(a.rpartition(":")[2]) for a in roles["ROUTE"])
+    assert route_ports == sorted(bolt_ports)
+
+    # kill the bootstrap coordinator; re-route like a driver would, using
+    # ONLY the router addresses learned from the table
+    killed_addr = f"127.0.0.1:{bolt_ports[leader_idx]}"
+    coords[leader_idx].kill()
+    for c in clients.values():
+        try:
+            c.close()
+        except Exception:
+            pass
+    from memgraph_tpu.server.client import BoltClient
+    survivor_write = None
+    deadline = time.time() + 40
+    while time.time() < deadline and survivor_write is None:
+        for router in roles["ROUTE"]:
+            host, _, port = router.rpartition(":")
+            if int(port) == int(killed_addr.rpartition(":")[2]):
+                continue
+            try:
+                rc = BoltClient(host=host, port=int(port))
+                rt3 = rc.route()
+                rc.close()
+            except Exception:
+                continue
+            roles3 = {s["role"]: s["addresses"] for s in rt3["servers"]}
+            if roles3.get("WRITE"):
+                survivor_write = roles3["WRITE"][0]
+                break
+        time.sleep(0.3)
+    assert survivor_write == f"127.0.0.1:{data1.bolt_port}"
+    # the routed WRITE address accepts a write
+    host, _, port = survivor_write.rpartition(":")
+    wc = BoltClient(host=host, port=int(port))
+    wc.execute("CREATE (:Routed {ok: 1})")
+    _, rows, _ = wc.execute("MATCH (n:Routed) RETURN count(n)")
+    assert rows == [[1]]
+    wc.close()
