@@ -28,6 +28,10 @@ def build_config(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("memgraph_tpu")
     p.add_argument("--bolt-address", default="0.0.0.0")
     p.add_argument("--bolt-port", type=int, default=7687)
+    p.add_argument("--bolt-advertised-address", default=None,
+                   help="host:port other machines should dial for this "
+                        "server (routing tables, cluster metadata); "
+                        "defaults to localhost:<bolt-port>")
     p.add_argument("--memory-limit", type=int, default=0,
                    help="global tracked-memory limit in MiB (0 = off; "
                         "reference: --memory-limit)")
@@ -87,7 +91,8 @@ def build_database(args) -> InterpreterContext:
     )
     interp_config = {
         "execution_timeout_sec": args.execution_timeout_sec,
-        "advertised_address": f"localhost:{args.bolt_port}",
+        "advertised_address": (args.bolt_advertised_address
+                               or f"localhost:{args.bolt_port}"),
     }
     # multi-tenancy: every server runs behind a DbmsHandler; the default
     # database recovers from (and persists to) the root data directory

@@ -108,6 +108,8 @@ def check_static_types(expr: A.Expr | None, kinds: dict) -> None:
     if isinstance(expr, A.PatternComprehension):
         # pattern variables are fresh bindings local to the comprehension
         inner = dict(kinds)
+        if expr.pattern.variable:
+            inner.pop(expr.pattern.variable, None)
         for item in expr.pattern.elements:
             if item.variable:
                 inner.pop(item.variable, None)
