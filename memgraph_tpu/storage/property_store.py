@@ -71,6 +71,16 @@ def _read_varint(buf: BytesIO) -> int:
         shift += 7
 
 
+def _read_exact(buf: BytesIO, n: int) -> bytes:
+    """Short reads mean a torn/corrupt blob — surface StorageError, never
+    a silently-shortened value (found by the truncation fuzzer)."""
+    raw = buf.read(n)
+    if len(raw) != n:
+        raise StorageError(
+            f"truncated value payload: wanted {n} bytes, got {len(raw)}")
+    return raw
+
+
 def _big_zigzag(n: int) -> int:
     # zig-zag over unbounded Python ints: non-negatives → even, negatives → odd
     return (n << 1) if n >= 0 else ((-n << 1) - 1)
@@ -172,13 +182,13 @@ def decode_value(buf: BytesIO):
     if tag == T_INT:
         return _unzigzag(_read_varint(buf))
     if tag == T_DOUBLE:
-        return struct.unpack("<d", buf.read(8))[0]
+        return struct.unpack("<d", _read_exact(buf, 8))[0]
     if tag == T_STRING:
         n = _read_varint(buf)
-        return buf.read(n).decode("utf-8")
+        return _read_exact(buf, n).decode("utf-8")
     if tag == T_BYTES:
         n = _read_varint(buf)
-        return buf.read(n)
+        return _read_exact(buf, n)
     if tag == T_LIST:
         n = _read_varint(buf)
         return [decode_value(buf) for _ in range(n)]
@@ -187,7 +197,7 @@ def decode_value(buf: BytesIO):
         out = {}
         for _ in range(n):
             klen = _read_varint(buf)
-            key = buf.read(klen).decode("utf-8")
+            key = _read_exact(buf, klen).decode("utf-8")
             out[key] = decode_value(buf)
         return out
     if tag == T_DATE:
@@ -207,7 +217,7 @@ def decode_value(buf: BytesIO):
         import datetime as _dt
         micros = _unzigzag(_read_varint(buf))
         tzlen = _read_varint(buf)
-        tzname = buf.read(tzlen).decode("utf-8")
+        tzname = _read_exact(buf, tzlen).decode("utf-8")
         dt = _dt.datetime.fromtimestamp(micros / 1_000_000, _dt.timezone.utc)
         try:
             from zoneinfo import ZoneInfo
@@ -217,15 +227,16 @@ def decode_value(buf: BytesIO):
         return ZonedDateTime(dt)
     if tag == T_ENUM:
         from .enums import EnumValue
-        enum_name = buf.read(_read_varint(buf)).decode("utf-8")
-        value_name = buf.read(_read_varint(buf)).decode("utf-8")
+        enum_name = _read_exact(buf, _read_varint(buf)).decode("utf-8")
+        value_name = _read_exact(buf, _read_varint(buf)).decode("utf-8")
         position = _read_varint(buf)
         return EnumValue(enum_name, value_name, position)
     if tag == T_POINT:
         crs = CrsType(_read_varint(buf))
-        x = struct.unpack("<d", buf.read(8))[0]
-        y = struct.unpack("<d", buf.read(8))[0]
-        z = struct.unpack("<d", buf.read(8))[0] if crs.dims == 3 else None
+        x = struct.unpack("<d", _read_exact(buf, 8))[0]
+        y = struct.unpack("<d", _read_exact(buf, 8))[0]
+        z = struct.unpack("<d", _read_exact(buf, 8))[0] \
+            if crs.dims == 3 else None
         return Point(x, y, z, crs)
     raise StorageError(f"unknown value tag 0x{tag:02x}")
 
@@ -242,12 +253,19 @@ def encode_properties(props: dict[int, object]) -> bytes:
 
 def decode_properties(data: bytes) -> dict[int, object]:
     buf = BytesIO(data)
-    n = _read_varint(buf)
-    out = {}
-    for _ in range(n):
-        pid = _read_varint(buf)
-        out[pid] = decode_value(buf)
-    return out
+    try:
+        n = _read_varint(buf)
+        out = {}
+        for _ in range(n):
+            pid = _read_varint(buf)
+            out[pid] = decode_value(buf)
+        return out
+    except (struct.error, UnicodeDecodeError, ValueError,
+            OverflowError) as e:
+        # torn/corrupt blob (truncated payload, invalid utf-8, unknown
+        # CRS id, out-of-range temporal): surface the domain error, not
+        # the codec internals (found by the property fuzzers)
+        raise StorageError(f"corrupt property blob: {e}") from e
 
 
 def value_key(v) -> bytes:

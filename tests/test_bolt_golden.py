@@ -224,7 +224,6 @@ def test_point_structures_golden():
 
 @pytest.fixture
 def raw_server():
-    import asyncio
     from memgraph_tpu.query.interpreter import InterpreterContext
     from memgraph_tpu.server.bolt import BoltServer
     from memgraph_tpu.storage import InMemoryStorage
@@ -234,22 +233,7 @@ def raw_server():
         probe.bind(("127.0.0.1", 0))
         port = probe.getsockname()[1]
     server = BoltServer(ictx, "127.0.0.1", port)
-    loop = asyncio.new_event_loop()
-
-    async def run():
-        await server.start()
-
-    t = threading.Thread(target=lambda: (loop.run_until_complete(run()),
-                                         loop.run_forever()), daemon=True)
-    t.start()
-    import time
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), 0.2).close()
-            break
-        except OSError:
-            time.sleep(0.05)
+    thread, loop = server.run_in_thread()
     yield port
     loop.call_soon_threadsafe(loop.stop)
 

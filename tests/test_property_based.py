@@ -1,0 +1,297 @@
+"""Property-based / fuzz testing of the storage engine.
+
+Counterpart of the reference's randomized suites:
+  - tests/property_based/random_graph.cpp — random op sequences against
+    the MVCC store, checked against a pure-python model (committed state,
+    label index contents, snapshot isolation of long-lived readers);
+  - src/storage/v2/fuzz/fuzz_property_store.cpp — property-store
+    round-trip over the full value domain + garbage-bytes decoding.
+
+hypothesis drives both; each example is one transaction-structured op
+sequence or one value tree.
+"""
+
+import math
+
+import pytest
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from memgraph_tpu.exceptions import MemgraphTpuError
+from memgraph_tpu.storage import InMemoryStorage, StorageConfig, StorageMode, View
+from memgraph_tpu.storage.property_store import (decode_properties,
+                                                 encode_properties)
+from memgraph_tpu.utils.point import CrsType, Point
+from memgraph_tpu.utils.temporal import (Date, Duration, LocalDateTime,
+                                         LocalTime, _micros_to_time)
+
+# --------------------------------------------------------------------------
+# property-store round-trip fuzzer
+# --------------------------------------------------------------------------
+
+_scalars = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2 ** 63), max_value=2 ** 63 - 1),
+    st.floats(allow_nan=False),          # NaN != NaN breaks equality check
+    st.text(max_size=40),
+    st.binary(max_size=40),
+    st.builds(lambda d: Date.parse(d.isoformat()),
+              st.dates(min_value=Date.parse("0001-01-01").d,
+                       max_value=Date.parse("9999-12-31").d)),
+    st.builds(lambda us: LocalTime(_micros_to_time(us)),
+              st.integers(min_value=0, max_value=86_399_999_999)),
+    st.builds(lambda us: Duration(micros=us),
+              st.integers(min_value=-(2 ** 50), max_value=2 ** 50)),
+    st.builds(lambda x, y: Point(x=x, y=y, z=None, crs=CrsType.CARTESIAN_2D),
+              st.floats(allow_nan=False, allow_infinity=False, width=32),
+              st.floats(allow_nan=False, allow_infinity=False, width=32)),
+)
+
+_values = st.recursive(
+    _scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=6),
+        st.dictionaries(st.text(max_size=8), children, max_size=6)),
+    max_leaves=12)
+
+
+@settings(max_examples=400, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(props=st.dictionaries(
+    st.integers(min_value=0, max_value=200), _values, max_size=12))
+def test_property_store_roundtrip(props):
+    blob = encode_properties(props)
+    decoded = decode_properties(blob)
+    assert set(decoded) == set(props)
+    for k, v in props.items():
+        _assert_value_equal(decoded[k], v)
+
+
+def _assert_value_equal(a, b):
+    if isinstance(b, float):
+        assert isinstance(a, float)
+        assert math.isinf(b) and math.isinf(a) and (a > 0) == (b > 0) \
+            or a == b
+    elif isinstance(b, list):
+        assert isinstance(a, list) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_value_equal(x, y)
+    elif isinstance(b, dict):
+        assert isinstance(a, dict) and set(a) == set(b)
+        for k in b:
+            _assert_value_equal(a[k], b[k])
+    else:
+        assert a == b
+        assert type(a) is type(b)
+
+
+@settings(max_examples=300, deadline=None)
+@given(garbage=st.binary(min_size=1, max_size=64))
+def test_property_store_rejects_garbage_cleanly(garbage):
+    """Arbitrary bytes either decode to SOMETHING or raise a clean
+    exception — never hang, crash, or leak internal state."""
+    try:
+        decode_properties(garbage)
+    except Exception as e:  # noqa: BLE001 — any CLEAN python error is fine
+        assert isinstance(e, (ValueError, KeyError, EOFError, OverflowError,
+                              IndexError, TypeError, MemgraphTpuError))
+
+
+@settings(max_examples=200, deadline=None)
+@given(props=st.dictionaries(
+    st.integers(min_value=0, max_value=50), _scalars, max_size=8),
+    cut=st.integers(min_value=0, max_value=100))
+def test_property_store_truncation_never_crashes(props, cut):
+    """Truncated valid blobs (torn write analog) fail cleanly."""
+    blob = encode_properties(props)
+    if cut >= len(blob):
+        return
+    try:
+        decode_properties(blob[:cut])
+    except Exception as e:  # noqa: BLE001
+        assert isinstance(e, (ValueError, KeyError, EOFError, OverflowError,
+                              IndexError, TypeError, MemgraphTpuError))
+
+
+# --------------------------------------------------------------------------
+# randomized MVCC op sequences vs a model
+# --------------------------------------------------------------------------
+
+class _Model:
+    """Committed graph state + in-flight transaction overlay."""
+
+    def __init__(self):
+        self.committed = {}          # gid -> (set(labels), dict(props))
+        self.pending = None          # overlay during a txn
+        self.created = None          # gids created in the open txn
+
+    def begin(self):
+        self.pending = {g: (set(l), dict(p))
+                        for g, (l, p) in self.committed.items()}
+        self.created = set()
+
+    def commit(self):
+        self.committed = self.pending
+        self.pending = self.created = None
+
+    def abort(self):
+        self.pending = self.created = None
+
+
+_op = st.sampled_from(
+    ["create", "delete", "add_label", "remove_label", "set_prop",
+     "del_prop", "commit_txn", "abort_txn", "check"])
+
+
+@settings(max_examples=300, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow,
+                                 HealthCheck.data_too_large])
+@given(data=st.data())
+def test_random_op_sequences_match_model(data):
+    storage = InMemoryStorage(StorageConfig(
+        storage_mode=StorageMode.IN_MEMORY_TRANSACTIONAL))
+    labels = [storage.label_mapper.name_to_id(f"L{i}") for i in range(3)]
+    props = [storage.property_mapper.name_to_id(f"p{i}") for i in range(3)]
+    storage.create_label_index(labels[0])
+
+    model = _Model()
+    acc = None
+    live = {}                       # gid -> VertexAccessor in open txn
+    n_ops = data.draw(st.integers(min_value=5, max_value=40))
+
+    def ensure_txn():
+        nonlocal acc
+        if acc is None:
+            acc = storage.access()
+            model.begin()
+            live.clear()
+            for gid in model.pending:
+                va = acc.find_vertex(gid)
+                if va is not None:
+                    live[gid] = va
+
+    def pick_vertex():
+        if not live:
+            return None, None
+        gid = data.draw(st.sampled_from(sorted(live)))
+        return gid, live[gid]
+
+    for _ in range(n_ops):
+        op = data.draw(_op)
+        if op == "create":
+            ensure_txn()
+            va = acc.create_vertex()
+            live[va.gid] = va
+            model.pending[va.gid] = (set(), {})
+            model.created.add(va.gid)
+        elif op == "delete":
+            ensure_txn()
+            gid, va = pick_vertex()
+            if va is None or not va.is_visible(View.NEW):
+                continue
+            acc.delete_vertex(va, detach=True)
+            live.pop(gid)
+            model.pending.pop(gid, None)
+        elif op in ("add_label", "remove_label"):
+            ensure_txn()
+            gid, va = pick_vertex()
+            if va is None or not va.is_visible(View.NEW):
+                continue
+            lid = data.draw(st.sampled_from(labels))
+            if op == "add_label":
+                va.add_label(lid)
+                model.pending[gid][0].add(lid)
+            else:
+                va.remove_label(lid)
+                model.pending[gid][0].discard(lid)
+        elif op == "set_prop":
+            ensure_txn()
+            gid, va = pick_vertex()
+            if va is None or not va.is_visible(View.NEW):
+                continue
+            pid = data.draw(st.sampled_from(props))
+            val = data.draw(st.one_of(st.integers(-100, 100),
+                                      st.text(max_size=6),
+                                      st.booleans()))
+            va.set_property(pid, val)
+            model.pending[gid][1][pid] = val
+        elif op == "del_prop":
+            ensure_txn()
+            gid, va = pick_vertex()
+            if va is None or not va.is_visible(View.NEW):
+                continue
+            pid = data.draw(st.sampled_from(props))
+            va.set_property(pid, None)
+            model.pending[gid][1].pop(pid, None)
+        elif op == "commit_txn":
+            if acc is not None:
+                acc.commit()
+                acc = None
+                model.commit()
+        elif op == "abort_txn":
+            if acc is not None:
+                acc.abort()
+                acc = None
+                model.abort()
+        elif op == "check":
+            if acc is not None:
+                continue            # checks run between transactions
+            _check_against_model(storage, model, labels[0])
+    if acc is not None:
+        acc.abort()
+        model.abort()
+    _check_against_model(storage, model, labels[0])
+
+
+def _check_against_model(storage, model, indexed_label):
+    reader = storage.access()
+    try:
+        seen = {}
+        for va in reader.vertices(View.OLD):
+            seen[va.gid] = (set(va.labels(View.OLD)),
+                            dict(va.properties(View.OLD)))
+        assert seen == model.committed, (
+            f"graph {sorted(seen)} != model {sorted(model.committed)}")
+        # label index agrees with the model
+        via_index = {va.gid for va in
+                     reader.vertices_by_label(indexed_label, View.OLD)}
+        expected = {g for g, (ls, _) in model.committed.items()
+                    if indexed_label in ls}
+        assert via_index == expected
+    finally:
+        reader.abort()
+
+
+@settings(max_examples=50, deadline=None)
+@given(data=st.data())
+def test_snapshot_isolation_under_random_writes(data):
+    """A reader opened mid-sequence sees EXACTLY the committed state from
+    its snapshot time, no matter what commits afterwards."""
+    storage = InMemoryStorage(StorageConfig(
+        storage_mode=StorageMode.IN_MEMORY_TRANSACTIONAL))
+    pid = storage.property_mapper.name_to_id("v")
+
+    # committed baseline
+    acc = storage.access()
+    gids = [acc.create_vertex().gid for _ in range(4)]
+    for g in gids:
+        acc.find_vertex(g).set_property(pid, 0)
+    acc.commit()
+
+    reader = storage.access()        # snapshot here
+    frozen = {g: reader.find_vertex(g).get_property(pid, View.OLD)
+              for g in gids}
+
+    # arbitrary committed writes afterwards
+    for _ in range(data.draw(st.integers(1, 8))):
+        w = storage.access()
+        g = data.draw(st.sampled_from(gids))
+        wv = w.find_vertex(g)
+        if wv is not None and wv.is_visible(View.NEW):
+            wv.set_property(pid, data.draw(st.integers(1, 9)))
+        w.commit()
+
+    for g in gids:
+        rv = reader.find_vertex(g)
+        assert rv.get_property(pid, View.OLD) == frozen[g]
+    reader.abort()
