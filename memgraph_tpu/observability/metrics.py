@@ -12,12 +12,20 @@ import threading
 from collections import defaultdict
 
 
+def _promname(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
 class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, int] = defaultdict(int)
         self._gauges: dict[str, float] = {}
         self._histograms: dict[str, list] = defaultdict(list)
+        # cumulative count/sum survive quantile-window trimming: summary
+        # _count/_sum must be monotonic or rate() queries see resets
+        self._hist_count: dict[str, int] = defaultdict(int)
+        self._hist_sum: dict[str, float] = defaultdict(float)
 
     def increment(self, name: str, delta: int = 1) -> None:
         with self._lock:
@@ -31,6 +39,8 @@ class Metrics:
         with self._lock:
             h = self._histograms[name]
             h.append(value)
+            self._hist_count[name] += 1
+            self._hist_sum[name] += value
             if len(h) > 10_000:
                 del h[: len(h) // 2]
 
@@ -51,11 +61,35 @@ class Metrics:
 
     def prometheus_text(self) -> str:
         lines = []
-        for name, kind, value in self.snapshot():
-            metric = name.replace(".", "_").replace("-", "_")
-            lines.append(f"# TYPE {metric} "
-                         f"{'counter' if kind == 'Counter' else 'gauge'}")
-            lines.append(f"{metric} {value}")
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histograms = {n: list(v)
+                          for n, v in sorted(self._histograms.items())}
+            hist_count = dict(self._hist_count)
+            hist_sum = dict(self._hist_sum)
+        for name, value in counters:
+            metric = _promname(name)
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {float(value)}")
+        for name, value in gauges:
+            metric = _promname(name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {float(value)}")
+        # summary exposition: quantiles + _count + _sum (reference:
+        # prometheus_metrics.hpp histogram family)
+        for name, values in histograms.items():
+            if not values:
+                continue
+            metric = _promname(name)
+            s = sorted(values)
+            lines.append(f"# TYPE {metric} summary")
+            for q in (0.5, 0.9, 0.99):
+                idx = min(int(q * len(s)), len(s) - 1)
+                lines.append(f'{metric}{{quantile="{q}"}} {float(s[idx])}')
+            lines.append(f"{metric}_count {hist_count.get(name, len(s))}")
+            lines.append(
+                f"{metric}_sum {float(hist_sum.get(name, sum(s)))}")
         return "\n".join(lines) + "\n"
 
 

@@ -22,11 +22,13 @@ import jax.numpy as jnp
 
 @partial(jax.jit, static_argnames=("k", "metric", "use_bf16"))
 def knn(corpus, queries, k: int, metric: str = "cosine",
-        use_bf16: bool = True, valid_count=None):
+        use_bf16: bool = True, valid_count=None, valid_mask=None):
     """Top-k nearest rows of `corpus` (n, d) for each of `queries` (q, d).
 
     Returns (scores (q, k), indices (q, k)); higher score = closer.
     `valid_count`: rows >= valid_count are padding and never returned.
+    `valid_mask`: optional (n,) bool/float — rows where falsy are masked
+    out (delta-maintained indexes keep free rows in place).
     """
     x = corpus
     qv = queries
@@ -47,6 +49,8 @@ def knn(corpus, queries, k: int, metric: str = "cosine",
     if valid_count is not None:
         col = jnp.arange(corpus.shape[0])
         scores = jnp.where(col[None, :] < valid_count, scores, -jnp.inf)
+    if valid_mask is not None:
+        scores = jnp.where(valid_mask[None, :] > 0, scores, -jnp.inf)
     top_scores, top_idx = jax.lax.top_k(scores, k)
     return top_scores, top_idx
 

@@ -28,28 +28,31 @@ def retrieve(ctx, property, query_vector, k_seeds, hops=2, limit=10,
              damping=0.85, metric="cosine"):
     """Hybrid retrieval over the current graph snapshot."""
     import jax.numpy as jnp
-    from ..ops.knn import knn
     from ..ops.pagerank import personalized_pagerank
     from ..ops.traversal import khop_neighborhood
-    from .vector_search import _embedding_matrix
+    from .vector_search import _get_index, _search_entry
 
-    matrix, gids = _embedding_matrix(ctx, str(property))
-    if matrix is None:
+    entry = _get_index(ctx, str(property))
+    if entry.matrix is None:
         return
     graph = ctx.device_graph()
     if graph.n_nodes == 0:
         return
 
-    # 1) seed selection: vector kNN over the embedding matrix (MXU)
+    # 1) seed selection: vector kNN over the embedding index (MXU,
+    #    delta-maintained — streaming GraphRAG never full-rebuilds)
     q = jnp.asarray(np.asarray([query_vector], dtype=np.float32))
-    kk = min(int(k_seeds), len(gids))
-    sims, idx = knn(matrix, q, k=kk, metric=str(metric))
+    sims, idx = _search_entry(entry, q, int(k_seeds), str(metric))
+    if sims is None:
+        return
     sims = np.asarray(sims[0])
     idx = np.asarray(idx[0])
     seed_sim: dict[int, float] = {}
     seed_indices = []
     for sim, i in zip(sims, idx):
-        gid = gids[int(i)]
+        gid = entry.row_gids[int(i)]
+        if gid is None:
+            continue
         di = graph.gid_to_idx.get(gid)
         if di is not None:
             seed_indices.append(di)

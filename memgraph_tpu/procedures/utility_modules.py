@@ -52,12 +52,20 @@ def graph_stats(ctx):
                results=[("node", "NODE"), ("cluster_id", "INTEGER")])
 def kmeans_clusters(ctx, property, n_clusters, iterations=10, seed=0):
     import jax
-    import jax.numpy as jnp
     from ..ops.knn import kmeans_fit
-    from .vector_search import _embedding_matrix
-    matrix, gids = _embedding_matrix(ctx, str(property))
-    if matrix is None:
+    from .vector_search import _get_index
+    entry = _get_index(ctx, str(property))
+    if entry.matrix is None:
         return
+    # compact to live rows (the delta-maintained matrix may hold freed
+    # rows); row order follows the index layout
+    live = [(row, gid) for row, gid in enumerate(entry.row_gids)
+            if gid is not None]
+    if not live:
+        return
+    rows = np.asarray([r for r, _ in live], dtype=np.int32)
+    matrix = entry.matrix[rows]
+    gids = [g for _, g in live]
     k = max(1, min(int(n_clusters), matrix.shape[0]))
     _, assign = kmeans_fit(matrix, jax.random.PRNGKey(int(seed)), k,
                            iters=int(iterations))

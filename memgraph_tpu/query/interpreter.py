@@ -682,6 +682,14 @@ class Interpreter:
             rows = [[line] for line in plan_to_rows(plan)]
             return self._prepare_generator(iter(rows), ["QUERY PLAN"], "r")
 
+        # per-operator execution counters (reference:
+        # prometheus_metrics.hpp:108-157 operator counters via
+        # interpreter.cpp:3320): one increment per operator instance per
+        # executed query — PROFILE shows the same plan shape
+        from ..observability.metrics import global_metrics
+        for op_name, count in _plan_operator_counts(plan).items():
+            global_metrics.increment(f"operator.{op_name}", count)
+
         if self._in_explicit_txn:
             accessor = self._explicit_accessor
             owns = False
@@ -1218,6 +1226,21 @@ def _parse_period(text: str) -> float:
 def _chain_front(first_row, rest):
     yield first_row
     yield from rest
+
+
+def _plan_operator_counts(plan) -> dict:
+    """{operator class name: occurrences} over a plan tree."""
+    counts: dict = {}
+
+    def walk(op):
+        if op is None:
+            return
+        counts[type(op).__name__] = counts.get(type(op).__name__, 0) + 1
+        for child in op.children():
+            walk(child)
+
+    walk(plan)
+    return counts
 
 
 def _plan_has_batched_apply(plan) -> bool:

@@ -202,11 +202,14 @@ class ReplicaServer:
 
     def _apply_wal_frame(self, frame: bytes) -> None:
         with self._apply_lock:
+            changed: set = set()
             for commit_ts, ops in W.iter_txns_from_bytes(frame):
                 if commit_ts <= self.last_commit_ts:
                     continue  # duplicate delivery (idempotent)
-                _apply_wal_txn(self.storage, ops)
+                changed |= _apply_wal_txn(self.storage, ops)
                 self.last_commit_ts = commit_ts
                 self.storage._timestamp = max(self.storage._timestamp,
                                               commit_ts)
-            self.storage._bump_topology()
+            # version-keyed delta caches (vector index) refresh O(delta)
+            # on replicas too — WAL apply records its changed gids
+            self.storage._bump_topology(changed)

@@ -103,9 +103,14 @@ def _apply_snapshot(storage, data: dict) -> None:
         storage.create_type_constraint(lid, pid, tname)
 
 
-def _apply_wal_txn(storage, ops) -> None:
-    """Replay one committed transaction's forward records (idempotent)."""
+def _apply_wal_txn(storage, ops):
+    """Replay one committed transaction's forward records (idempotent).
+
+    Returns the set of vertex gids whose state changed (for the
+    topology change log: replica WAL apply must feed version-keyed
+    delta caches exactly like local commits do)."""
     from ..objects import Edge, Vertex
+    changed: set = set()
     for kind, payload in ops:
         buf = BytesIO(payload)
         if kind == W.OP_MAPPER_SYNC:
@@ -119,6 +124,7 @@ def _apply_wal_txn(storage, ops) -> None:
             storage.edge_type_mapper = NameIdMapper.from_list(tables[2])
         elif kind in (W.OP_CREATE_VERTEX, W.OP_VERTEX_STATE):
             gid = _read_varint(buf)
+            changed.add(gid)
             labels = {_read_varint(buf) for _ in range(_read_varint(buf))}
             props = {}
             for _ in range(_read_varint(buf)):
@@ -137,6 +143,7 @@ def _apply_wal_txn(storage, ops) -> None:
             storage.indices.label_property.update_on_change(v)
         elif kind == W.OP_DELETE_VERTEX:
             gid = _read_varint(buf)
+            changed.add(gid)
             v = storage._vertices.pop(gid, None)
             if v is not None:
                 v.deleted = True
@@ -148,6 +155,8 @@ def _apply_wal_txn(storage, ops) -> None:
             etype = _read_varint(buf)
             from_gid = _read_varint(buf)
             to_gid = _read_varint(buf)
+            changed.add(from_gid)
+            changed.add(to_gid)
             props = {}
             for _ in range(_read_varint(buf)):
                 pid = _read_varint(buf)
@@ -191,8 +200,11 @@ def _apply_wal_txn(storage, ops) -> None:
                 except ValueError:
                     pass
                 storage.indices.edge_type.remove_entry(e)
+                changed.add(e.from_vertex.gid)
+                changed.add(e.to_vertex.gid)
         else:
             raise DurabilityError(f"unknown WAL op 0x{kind:02x}")
+    return changed
 
 
 def wire_durability(storage) -> "W.WalFile | None":

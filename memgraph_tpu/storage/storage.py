@@ -61,7 +61,8 @@ class _Namer:
 
 class Transaction:
     __slots__ = ("id", "start_ts", "commit_info", "deltas", "isolation",
-                 "storage", "touched_vertices", "touched_edges", "commit_ts")
+                 "storage", "touched_vertices", "touched_edges", "commit_ts",
+                 "topology_snapshot")
 
     def __init__(self, txn_id: int, start_ts: int, isolation: IsolationLevel,
                  storage: "InMemoryStorage") -> None:
@@ -74,6 +75,7 @@ class Transaction:
         self.touched_vertices: dict[int, Vertex] = {}
         self.touched_edges: dict[int, Edge] = {}
         self.commit_ts: Optional[int] = None   # set at commit
+        self.topology_snapshot = 0             # set by _begin_transaction
 
     def effective_start_ts(self) -> int:
         # Once committed, the transaction's snapshot ADVANCES to its commit
@@ -241,6 +243,13 @@ class Accessor:
         self.txn = storage._begin_transaction(isolation)
         self._finished = False
         self._analytical = storage.config.storage_mode is StorageMode.IN_MEMORY_ANALYTICAL
+        # what this reader's MVCC snapshot corresponds to: commits AFTER
+        # this accessor began are invisible to it, so version-keyed caches
+        # built through it must key on THIS, not the live version
+        # (vector-index delta maintenance, NOTES_ROUND2 hole #2).
+        # Captured by _begin_transaction under the engine lock, atomically
+        # with the snapshot timestamp.
+        self.topology_snapshot = self.txn.topology_snapshot
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -290,7 +299,7 @@ class Accessor:
             push_delta(vertex, self.txn, DeltaAction.DELETE_OBJECT, None)
         storage._vertices[gid] = vertex
         self.txn.touched_vertices[gid] = vertex
-        storage._bump_topology()
+        storage._bump_topology({gid})
         return VertexAccessor(vertex, self)
 
     def delete_vertex(self, va: VertexAccessor, detach: bool = False):
@@ -329,7 +338,7 @@ class Accessor:
                 push_delta(vertex, self.txn, DeltaAction.RECREATE_OBJECT, None)
             vertex.deleted = True
         self.txn.touched_vertices[vertex.gid] = vertex
-        self.storage._bump_topology()
+        self.storage._bump_topology({vertex.gid})
         return va, deleted_edges
 
     def create_edge(self, from_va: VertexAccessor, to_va: VertexAccessor,
@@ -378,7 +387,7 @@ class Accessor:
         self.txn.touched_edges[gid] = edge
         self.txn.touched_vertices[from_v.gid] = from_v
         self.txn.touched_vertices[to_v.gid] = to_v
-        storage._bump_topology()
+        storage._bump_topology({from_v.gid, to_v.gid})
         return EdgeAccessor(edge, self)
 
     def delete_edge(self, ea: EdgeAccessor):
@@ -415,7 +424,7 @@ class Accessor:
         self.txn.touched_edges[edge.gid] = edge
         self.txn.touched_vertices[from_v.gid] = from_v
         self.txn.touched_vertices[to_v.gid] = to_v
-        self.storage._bump_topology()
+        self.storage._bump_topology({from_v.gid, to_v.gid})
         return ea
 
     # --- vertex mutations (called through VertexAccessor) -------------------
@@ -439,7 +448,7 @@ class Accessor:
         if self._analytical:
             # analytical commits skip the commit-time bump; invalidate
             # device/columnar snapshot caches per write instead
-            self.storage._bump_topology()
+            self.storage._bump_topology({vertex.gid})
         return True
 
     def _vertex_remove_label(self, vertex: Vertex, label_id: int) -> bool:
@@ -458,7 +467,7 @@ class Accessor:
         self.storage.indices.label_property.update_on_change(vertex)
         self.txn.touched_vertices[vertex.gid] = vertex
         if self._analytical:
-            self.storage._bump_topology()
+            self.storage._bump_topology({vertex.gid})
         return True
 
     def _vertex_set_property(self, vertex: Vertex, prop_id: int, value):
@@ -480,7 +489,7 @@ class Accessor:
         self.storage.indices.label_property.update_on_change(vertex)
         self.txn.touched_vertices[vertex.gid] = vertex
         if self._analytical:
-            self.storage._bump_topology()
+            self.storage._bump_topology({vertex.gid})
         return old
 
     def _edge_set_property(self, edge: Edge, prop_id: int, value):
@@ -503,7 +512,8 @@ class Accessor:
                 edge.properties[prop_id] = value
         self.txn.touched_edges[edge.gid] = edge
         if self._analytical:
-            self.storage._bump_topology()
+            self.storage._bump_topology(
+                {edge.from_vertex.gid, edge.to_vertex.gid})
         return old
 
     # --- reads --------------------------------------------------------------
@@ -709,6 +719,11 @@ class InMemoryStorage:
         self._frame_seq = 0
 
         self._topology_version = 0
+        # bounded (version, frozenset(gids)|None) log backing
+        # changes_between(); 1024 entries cover bursts of small commits
+        from collections import deque
+        self._change_log = deque(maxlen=1024)
+        self._change_log_lock = threading.Lock()
         # durability wiring: receives (frame_bytes, commit_ts) under the
         # engine lock, BEFORE the visibility flip (write-ahead ordering)
         self.wal_sink: Optional[Callable] = None
@@ -736,6 +751,11 @@ class InMemoryStorage:
             start_ts = self._timestamp
             txn = Transaction(txn_id, start_ts, isolation, self)
             self._active_txns[txn_id] = txn
+            # captured under the SAME lock as the commit-side visibility
+            # flip + bump, so an accessor's MVCC snapshot and its
+            # topology snapshot can never disagree (version-keyed caches
+            # would otherwise cache wrong data under this version)
+            txn.topology_snapshot = self._topology_version
             return txn
 
     def latest_commit_ts(self) -> int:
@@ -800,8 +820,12 @@ class InMemoryStorage:
             txn.commit_ts = commit_ts
             self.constraints.unique.apply_registrations(registrations)
             self._active_txns.pop(txn.id, None)
-        # committed state changed → device snapshot caches must re-export
-        self._bump_topology()
+            # committed state changed → device snapshot caches must
+            # re-export. INSIDE the engine lock: the bump must be atomic
+            # with the visibility flip relative to _begin_transaction's
+            # (start_ts, topology_snapshot) capture, or a reader could
+            # key a cache entry at a version whose data it cannot see
+            self._bump_topology(set(txn.touched_vertices))
         if ship_seq is not None:
             # strict shipping order across concurrent committers
             with self._ship_cond:
@@ -857,7 +881,7 @@ class InMemoryStorage:
             self.indices.label_property.update_on_change(v)
         with self._engine_lock:
             self._active_txns.pop(txn.id, None)
-        self._bump_topology()
+        self._bump_topology(set(txn.touched_vertices))
 
     # --- GC -----------------------------------------------------------------
 
@@ -953,12 +977,43 @@ class InMemoryStorage:
 
     # --- TPU snapshot cache signal ------------------------------------------
 
-    def _bump_topology(self) -> None:
-        self._topology_version += 1
+    def _bump_topology(self, changed_gids=None) -> None:
+        """Bump the cache-invalidation version. changed_gids: vertex gids
+        whose visible state may differ across the bump (None = unknown —
+        consumers must fully rebuild). The bounded change log lets
+        version-keyed caches (vector index) refresh O(delta) instead of
+        O(n): every mutation path funnels here, INCLUDING replica WAL
+        apply and recovery, so deltas are never silently missed
+        (NOTES_ROUND2 hole #1)."""
+        with self._change_log_lock:
+            self._topology_version += 1
+            self._change_log.append(
+                (self._topology_version,
+                 frozenset(changed_gids) if changed_gids is not None
+                 else None))
 
     @property
     def topology_version(self) -> int:
         return self._topology_version
+
+    def changes_between(self, v_from: int, v_to: int):
+        """Union of vertex gids changed in versions (v_from, v_to], or
+        None if unknowable (log evicted the range, or a bump didn't
+        record its gids)."""
+        if v_from == v_to:
+            return frozenset()
+        with self._change_log_lock:
+            entries = list(self._change_log)
+        if not entries or entries[0][0] > v_from + 1:
+            return None     # log no longer reaches back to v_from
+        out: set = set()
+        for version, gids in entries:
+            if version <= v_from or version > v_to:
+                continue
+            if gids is None:
+                return None
+            out |= gids
+        return frozenset(out)
 
     # --- info ---------------------------------------------------------------
 

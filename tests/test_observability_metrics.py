@@ -1,0 +1,111 @@
+"""Metrics depth: per-operator counters, query-latency histogram,
+SHOW METRICS INFO, Prometheus exposition — and agreement with PROFILE.
+
+Reference: src/metrics/prometheus_metrics.hpp:108-157 (operator counter
+family), interpreter.cpp:3320 (increment site).
+"""
+
+import pytest
+
+from memgraph_tpu.observability.metrics import Metrics, global_metrics
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def interp():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+def _counter(name):
+    for n, kind, v in global_metrics.snapshot():
+        if n == name:
+            return v
+    return 0.0
+
+
+def test_per_operator_counters_agree_with_profile(interp):
+    interp.execute("UNWIND range(1, 5) AS i CREATE (:N {v: i})")
+    query = "MATCH (n:N) WHERE n.v > 1 RETURN n.v ORDER BY n.v"
+    # PROFILE exposes the plan's operator names
+    hdr, rows = interp.execute("PROFILE " + query)[:2]
+    profiled_ops = {r[0].strip().lstrip("+-| ").split("(")[0].strip()
+                    for r in rows}
+    before = {op: _counter(f"operator.{op}") for op in
+              ("ScanAllByLabel", "Filter", "Produce", "OrderBy")}
+    interp.execute(query)
+    for op, prev in before.items():
+        assert _counter(f"operator.{op}") == prev + 1, op
+    # the counted operators are the ones PROFILE shows
+    for op in before:
+        assert any(op in p for p in profiled_ops), (op, profiled_ops)
+
+
+def test_latency_histogram_and_query_counters(interp):
+    before_finished = _counter("query.finished")
+    interp.execute("RETURN 1")
+    interp.execute("RETURN 2")
+    assert _counter("query.finished") == before_finished + 2
+    text = global_metrics.prometheus_text()
+    assert "query_execution_latency_sec_count" in text
+    assert "query_execution_latency_sec_sum" in text
+    assert 'query_execution_latency_sec{quantile="0.9"}' in text
+
+
+def test_show_metrics_info_surface(interp):
+    interp.execute("CREATE (:M)")
+    hdr, rows = interp.execute("SHOW METRICS INFO")[:2]
+    assert hdr == ["name", "type", "value"]
+    names = {r[0] for r in rows}
+    assert "query.finished" in names
+    assert any(n.startswith("operator.") for n in names)
+    assert any(n.startswith("storage.nodes_created") for n in names)
+    kinds = {r[0]: r[1] for r in rows}
+    assert kinds["query.finished"] == "Counter"
+
+
+def test_prometheus_exposition_format():
+    m = Metrics()
+    m.increment("a.count", 3)
+    m.set_gauge("g", 1.5)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        m.observe("lat", v)
+    text = m.prometheus_text()
+    assert "# TYPE a_count counter\na_count 3.0" in text
+    assert "# TYPE g gauge\ng 1.5" in text
+    assert "# TYPE lat summary" in text
+    assert 'lat{quantile="0.5"} 3.0' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 10.0" in text
+
+
+def test_monitoring_http_endpoint_exposes_operator_counters(interp):
+    import asyncio
+    import socket
+    import threading
+    import urllib.request
+    from memgraph_tpu.observability.http import start_monitoring_server
+
+    interp.execute("MATCH (x) RETURN count(x)")
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(
+            start_monitoring_server("127.0.0.1", port, interp.ctx))
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    assert "operator_ScanAll" in body
+    assert "query_finished" in body
+    loop.call_soon_threadsafe(loop.stop)
