@@ -202,21 +202,31 @@ def _get_index(ctx, property_name: str) -> _IndexEntry:
     storage = ctx.storage
     version = getattr(ctx.accessor, "topology_snapshot",
                       storage.topology_version)
+    # a transaction with its OWN writes sees state no other reader at
+    # this version sees: serve it a PRIVATE entry (parent + own touched
+    # gids as extra delta) and never store it — read-your-own-writes
+    # without poisoning the shared version map
+    own_writes = frozenset(
+        getattr(getattr(ctx.accessor, "txn", None), "touched_vertices",
+                None) or ())
     with _CACHE_LOCK:
         per = _CACHE.get(storage) or {}
         by_version = dict(per.get(property_name) or {})
     entry = by_version.get(version)
-    if entry is not None:
+    if entry is not None and not own_writes:
         return entry
 
-    parent = None
-    candidates = [e for v, e in by_version.items() if v < version]
-    if candidates:
-        parent = max(candidates, key=lambda e: e.version)
+    parent = entry
+    if parent is None:
+        candidates = [e for v, e in by_version.items() if v < version]
+        if candidates:
+            parent = max(candidates, key=lambda e: e.version)
 
     entry = None
     if parent is not None:
         changed = storage.changes_between(parent.version, version)
+        if changed is not None:
+            changed = changed | own_writes
         if changed is not None and not changed:
             # nothing relevant changed: alias the parent at this version
             entry = parent
@@ -228,6 +238,9 @@ def _get_index(ctx, property_name: str) -> _IndexEntry:
     if entry is None:
         pid = storage.property_mapper.maybe_name_to_id(property_name)
         entry = _full_build(ctx, pid, version)
+
+    if own_writes:
+        return entry                   # private view: never cached
 
     with _CACHE_LOCK:
         per = _CACHE.get(storage)

@@ -683,12 +683,10 @@ class Interpreter:
             return self._prepare_generator(iter(rows), ["QUERY PLAN"], "r")
 
         # per-operator execution counters (reference:
-        # prometheus_metrics.hpp:108-157 operator counters via
-        # interpreter.cpp:3320): one increment per operator instance per
-        # executed query — PROFILE shows the same plan shape
-        from ..observability.metrics import global_metrics
-        for op_name, count in _plan_operator_counts(plan).items():
-            global_metrics.increment(f"operator.{op_name}", count)
+        # prometheus_metrics.hpp:108-157 via interpreter.cpp:3320):
+        # counted at successful COMPLETION (_finish_stream), not prepare,
+        # so failed/aborted queries don't inflate them
+        self._pending_op_counts = _plan_operator_counts(plan)
 
         if self._in_explicit_txn:
             accessor = self._explicit_accessor
@@ -776,6 +774,11 @@ class Interpreter:
         self.session_trace.emit("finish")
         from ..observability.metrics import global_metrics
         global_metrics.increment("query.finished")
+        pending_ops = getattr(self, "_pending_op_counts", None)
+        self._pending_op_counts = None
+        if pending_ops:
+            for op_name, count in pending_ops.items():
+                global_metrics.increment(f"operator.{op_name}", count)
         started = getattr(self, "_query_started", None)
         self._query_started = None
         if started is not None:
@@ -797,6 +800,7 @@ class Interpreter:
 
     def _cleanup_stream(self, error: bool = False) -> None:
         self._query_started = None
+        self._pending_op_counts = None
         if self._exec_ctx is not None:
             self._exec_ctx.memory.release_all()
         if self._stream_owns_txn and self._stream_accessor is not None:

@@ -5,13 +5,22 @@ Counterpart of the reference's sectioned snapshot format
 magic + version header, interning tables, vertices, edges, index +
 constraint metadata, all encoded with the property codec. Snapshots are
 written atomically (tmp + rename) into <durability_dir>/snapshots.
+
+Format v2 chunks the vertex and edge sections (varint chunk count, then
+per chunk varint byte-length + varint item-count + payload) so create
+and load pipeline each chunk through a worker pool — the same
+parallel-durability shape as the reference's threaded snapshot writers
+(memgraph.cpp:531-534 --storage-parallel-schema-recovery). v1 files
+remain readable.
 """
 
 from __future__ import annotations
 
 import os
 import struct
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from io import BytesIO
 
 from ...exceptions import DurabilityError
@@ -19,7 +28,21 @@ from ..property_store import (_read_varint, _write_varint, decode_value,
                               encode_value)
 
 MAGIC = b"MGTPUSNAP"
-VERSION = 1
+VERSION = 2
+CHUNK_ITEMS = 50_000
+
+_POOL: ThreadPoolExecutor | None = None
+_POOL_LOCK = threading.Lock()
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(
+                max_workers=max(2, (os.cpu_count() or 2)),
+                thread_name_prefix="snapshot-worker")
+        return _POOL
 
 # section markers
 SEC_MAPPERS = 0x01
@@ -28,6 +51,95 @@ SEC_EDGES = 0x03
 SEC_INDICES = 0x04
 SEC_CONSTRAINTS = 0x05
 SEC_END = 0xFF
+
+
+def _encode_vertex_chunk(items) -> bytes:
+    from ...storage.common import View
+    buf = BytesIO()
+    for va in items:
+        _write_varint(buf, va.gid)
+        labels = va.labels(View.OLD)
+        _write_varint(buf, len(labels))
+        for l in labels:
+            _write_varint(buf, l)
+        props = va.properties(View.OLD)
+        _write_varint(buf, len(props))
+        for pid in sorted(props):
+            _write_varint(buf, pid)
+            encode_value(buf, props[pid])
+    return buf.getvalue()
+
+
+def _encode_edge_chunk(items) -> bytes:
+    from ...storage.common import View
+    buf = BytesIO()
+    for ea in items:
+        _write_varint(buf, ea.gid)
+        _write_varint(buf, ea.edge_type)
+        _write_varint(buf, ea.from_vertex().gid)
+        _write_varint(buf, ea.to_vertex().gid)
+        props = ea.properties(View.OLD)
+        _write_varint(buf, len(props))
+        for pid in sorted(props):
+            _write_varint(buf, pid)
+            encode_value(buf, props[pid])
+    return buf.getvalue()
+
+
+def _write_chunked(buf, items, encode_chunk) -> None:
+    chunks = [items[i:i + CHUNK_ITEMS]
+              for i in range(0, len(items), CHUNK_ITEMS)] or [[]]
+    payloads = list(_pool().map(encode_chunk, chunks))
+    _write_varint(buf, len(chunks))
+    for chunk, payload in zip(chunks, payloads):
+        _write_varint(buf, len(payload))
+        _write_varint(buf, len(chunk))
+        buf.write(payload)
+
+
+def _read_chunked(buf, decode_chunk) -> list:
+    n_chunks = _read_varint(buf)
+    raw = []
+    for _ in range(n_chunks):
+        nbytes = _read_varint(buf)
+        count = _read_varint(buf)
+        raw.append((buf.read(nbytes), count))
+    out: list = []
+    for part in _pool().map(lambda rc: decode_chunk(*rc), raw):
+        out.extend(part)
+    return out
+
+
+def _decode_vertex_chunk(payload: bytes, count: int) -> list:
+    buf = BytesIO(payload)
+    return [_decode_v1_vertex(buf) for _ in range(count)]
+
+
+def _decode_edge_chunk(payload: bytes, count: int) -> list:
+    buf = BytesIO(payload)
+    return [_decode_v1_edge(buf) for _ in range(count)]
+
+
+def _decode_v1_vertex(buf):
+    gid = _read_varint(buf)
+    labels = [_read_varint(buf) for _ in range(_read_varint(buf))]
+    props = {}
+    for _ in range(_read_varint(buf)):
+        pid = _read_varint(buf)
+        props[pid] = decode_value(buf)
+    return (gid, labels, props)
+
+
+def _decode_v1_edge(buf):
+    gid = _read_varint(buf)
+    etype = _read_varint(buf)
+    from_gid = _read_varint(buf)
+    to_gid = _read_varint(buf)
+    props = {}
+    for _ in range(_read_varint(buf)):
+        pid = _read_varint(buf)
+        props[pid] = decode_value(buf)
+    return (gid, etype, from_gid, to_gid, props)
 
 
 def snapshot_dir(storage) -> str:
@@ -65,37 +177,15 @@ def create_snapshot(storage) -> str:
                 _write_varint(buf, len(raw))
                 buf.write(raw)
 
-        # vertices
+        # vertices + edges: chunked, encoded in parallel on the pool
         from ...storage.common import View
         vertices = list(acc.vertices(View.OLD))
         buf.write(bytes((SEC_VERTICES,)))
-        _write_varint(buf, len(vertices))
-        for va in vertices:
-            _write_varint(buf, va.gid)
-            labels = va.labels(View.OLD)
-            _write_varint(buf, len(labels))
-            for l in labels:
-                _write_varint(buf, l)
-            props = va.properties(View.OLD)
-            _write_varint(buf, len(props))
-            for pid in sorted(props):
-                _write_varint(buf, pid)
-                encode_value(buf, props[pid])
+        _write_chunked(buf, vertices, _encode_vertex_chunk)
 
-        # edges
         edges = list(acc.edges(View.OLD))
         buf.write(bytes((SEC_EDGES,)))
-        _write_varint(buf, len(edges))
-        for ea in edges:
-            _write_varint(buf, ea.gid)
-            _write_varint(buf, ea.edge_type)
-            _write_varint(buf, ea.from_vertex().gid)
-            _write_varint(buf, ea.to_vertex().gid)
-            props = ea.properties(View.OLD)
-            _write_varint(buf, len(props))
-            for pid in sorted(props):
-                _write_varint(buf, pid)
-                encode_value(buf, props[pid])
+        _write_chunked(buf, edges, _encode_edge_chunk)
 
         # indices
         buf.write(bytes((SEC_INDICES,)))
@@ -183,7 +273,7 @@ def load_snapshot(path: str) -> dict:
     if buf.read(len(MAGIC)) != MAGIC:
         raise DurabilityError(f"{path}: bad snapshot magic")
     version, ts, wall = struct.unpack("<HQQ", buf.read(18))
-    if version != VERSION:
+    if version not in (1, 2):
         raise DurabilityError(f"{path}: unsupported snapshot version "
                               f"{version}")
     out = {"timestamp": ts, "wall_time": wall}
@@ -202,32 +292,18 @@ def load_snapshot(path: str) -> dict:
             out["properties"] = read_name_list()
             out["edge_types"] = read_name_list()
         elif marker == SEC_VERTICES:
-            n = _read_varint(buf)
-            vertices = []
-            for _ in range(n):
-                gid = _read_varint(buf)
-                labels = [_read_varint(buf)
-                          for _ in range(_read_varint(buf))]
-                props = {}
-                for _ in range(_read_varint(buf)):
-                    pid = _read_varint(buf)
-                    props[pid] = decode_value(buf)
-                vertices.append((gid, labels, props))
-            out["vertices"] = vertices
+            if version >= 2:
+                out["vertices"] = _read_chunked(buf, _decode_vertex_chunk)
+            else:
+                n = _read_varint(buf)
+                out["vertices"] = [_decode_v1_vertex(buf)
+                                   for _ in range(n)]
         elif marker == SEC_EDGES:
-            n = _read_varint(buf)
-            edges = []
-            for _ in range(n):
-                gid = _read_varint(buf)
-                etype = _read_varint(buf)
-                from_gid = _read_varint(buf)
-                to_gid = _read_varint(buf)
-                props = {}
-                for _ in range(_read_varint(buf)):
-                    pid = _read_varint(buf)
-                    props[pid] = decode_value(buf)
-                edges.append((gid, etype, from_gid, to_gid, props))
-            out["edges"] = edges
+            if version >= 2:
+                out["edges"] = _read_chunked(buf, _decode_edge_chunk)
+            else:
+                n = _read_varint(buf)
+                out["edges"] = [_decode_v1_edge(buf) for _ in range(n)]
         elif marker == SEC_INDICES:
             out["label_indices"] = [_read_varint(buf)
                                     for _ in range(_read_varint(buf))]

@@ -21,38 +21,92 @@ from .ordering import order_key
 
 
 class LabelIndex:
-    """label_id -> insertion-ordered dict of candidate vertices."""
+    """label_id -> insertion-ordered dict of candidate vertices.
+
+    Supports BACKGROUND population (reference:
+    src/storage/v2/async_indexer.cpp): a populating index accepts live
+    writer additions but serves no candidates until its ready gate opens,
+    so concurrent readers fall back to full scans and never see a
+    half-built index.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._index: dict[int, dict] = {}
+        self._ready: dict[int, threading.Event] = {}
 
     def create(self, label_id: int, vertices) -> None:
         with self._lock:
             bucket = self._index.setdefault(label_id, {})
+            event = self._ready.setdefault(label_id, threading.Event())
         for v in vertices:
             if label_id in v.labels and not v.deleted:
                 bucket[v.gid] = v
+        event.set()
+
+    def create_in_background(self, label_id: int, vertices) -> threading.Event:
+        """Register the index immediately, populate on a worker thread;
+        returns the ready event. `vertices` must be a materialized
+        sequence (the caller snapshots the live dict)."""
+        with self._lock:
+            bucket = self._index.setdefault(label_id, {})
+            event = self._ready.setdefault(label_id, threading.Event())
+            if event.is_set():
+                return event            # already populated
+
+        def populate():
+            try:
+                for v in vertices:
+                    if label_id in v.labels and not v.deleted:
+                        bucket[v.gid] = v
+                with self._lock:
+                    still_ours = self._ready.get(label_id) is event
+            except Exception:
+                # failed population: drop the shell so readers keep the
+                # (correct) fallback path and DDL can retry
+                self.drop(label_id)
+                still_ours = False
+            # ALWAYS wake waiters; serving is gated on the registry so a
+            # concurrently-dropped index is never resurrected (we write
+            # only into the captured bucket, never re-register)
+            event.set()
+            if not still_ours:
+                bucket.clear()
+
+        threading.Thread(target=populate, daemon=True,
+                         name=f"index-build-{label_id}").start()
+        return event
 
     def drop(self, label_id: int) -> bool:
         with self._lock:
+            self._ready.pop(label_id, None)
             return self._index.pop(label_id, None) is not None
 
     def has(self, label_id: int) -> bool:
         return label_id in self._index
 
+    def ready(self, label_id: int) -> bool:
+        event = self._ready.get(label_id)
+        return event is not None and event.is_set()
+
+    def wait_ready(self, label_id: int, timeout: float | None = None) -> bool:
+        event = self._ready.get(label_id)
+        return event.wait(timeout) if event is not None else False
+
     def labels(self) -> list[int]:
         return list(self._index)
 
     def add(self, label_id: int, vertex) -> None:
+        # populating buckets take live additions too: a commit racing the
+        # background build must not be lost
         bucket = self._index.get(label_id)
         if bucket is not None:
             bucket[vertex.gid] = vertex
 
     def candidates(self, label_id: int):
         bucket = self._index.get(label_id)
-        if bucket is None:
-            return None
+        if bucket is None or not self.ready(label_id):
+            return None                 # not (yet) usable: callers scan
         return list(bucket.values())
 
     def approx_count(self, label_id: int) -> int:

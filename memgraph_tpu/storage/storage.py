@@ -950,8 +950,19 @@ class InMemoryStorage:
     # --- schema operations (run outside transactions, like the reference's
     #     unique-accessor index/constraint DDL) ------------------------------
 
-    def create_label_index(self, label_id: int) -> None:
+    def create_label_index(self, label_id: int,
+                           background: bool = False):
+        """background=True returns immediately with the index populating
+        on a worker thread (reference: async_indexer.cpp); queries during
+        the build fall back to full scans — correct, just unindexed —
+        until the returned ready event fires."""
+        if background:
+            # materialize: the live dict view would race concurrent
+            # commits ("dictionary changed size during iteration")
+            return self.indices.label.create_in_background(
+                label_id, list(self._vertices.values()))
         self.indices.label.create(label_id, self._vertices.values())
+        return None
 
     def create_label_property_index(self, label_id: int,
                                     prop_ids: tuple[int, ...]) -> None:

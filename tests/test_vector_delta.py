@@ -166,3 +166,38 @@ def test_changes_between_log_semantics():
     storage._bump_topology(None)
     v2 = storage.topology_version
     assert storage.changes_between(v1, v2) is None
+
+
+def test_read_your_own_writes_in_transaction(db):
+    """A transaction that writes a vector must see it in its OWN later
+    searches, and its uncommitted entry must never reach the shared
+    cache for same-snapshot readers."""
+    _seed(db, n=3)
+    _search(db, [1.0, 0.0, 0.0, 0.0])      # prime shared cache
+    w = Interpreter(db)
+    w.execute("BEGIN")
+    w.execute("CREATE (:V {name: 'mine', emb: [5.0, 0.0, 0.0, 0.0]})")
+    _, rows, _ = w.execute(
+        "CALL vector_search.search('emb', [1.0,0.0,0.0,0.0], 50) "
+        "YIELD node RETURN node.name ORDER BY node.name")
+    assert ["mine"] in rows                # read-your-own-writes
+    # a concurrent reader at the same committed snapshot must NOT see it
+    assert len(_search(db, [1.0, 0.0, 0.0, 0.0])) == 3
+    w.execute("ROLLBACK")
+    assert len(_search(db, [1.0, 0.0, 0.0, 0.0])) == 3
+
+
+def test_background_index_drop_race():
+    """DROP INDEX during a background build must not resurrect."""
+    from memgraph_tpu.storage import InMemoryStorage, View
+    storage = InMemoryStorage()
+    lid = storage.label_mapper.name_to_id("L")
+    acc = storage.access()
+    for _ in range(5000):
+        acc.create_vertex().add_label(lid)
+    acc.commit()
+    event = storage.create_label_index(lid, background=True)
+    storage.indices.label.drop(lid)
+    event.wait(20)
+    assert not storage.indices.label.has(lid)
+    assert storage.indices.label.candidates(lid) is None
