@@ -140,6 +140,7 @@ class Interpreter:
         from ..observability.metrics import global_metrics
         global_metrics.increment("query.prepared")
         self._query_started = time.monotonic()
+        self._pending_op_counts = None   # drop any abandoned prepare's
         self.session_trace.emit("prepare", query=text)
         node = self.ctx.cached_parse(text)
         if isinstance(node, A.SessionTraceQuery):
@@ -773,25 +774,27 @@ class Interpreter:
         summary = {}
         self.session_trace.emit("finish")
         from ..observability.metrics import global_metrics
-        global_metrics.increment("query.finished")
         pending_ops = getattr(self, "_pending_op_counts", None)
         self._pending_op_counts = None
+        started = getattr(self, "_query_started", None)
+        self._query_started = None
+        if self._exec_ctx is not None:
+            summary["stats"] = dict(self._exec_ctx.stats)
+            self._exec_ctx.memory.release_all()
+        # the commit can still fail (constraint violations surface here):
+        # counters are recorded only after it succeeds
+        if self._stream_owns_txn and self._stream_accessor is not None:
+            self._stream_accessor.commit()
+        global_metrics.increment("query.finished")
         if pending_ops:
             for op_name, count in pending_ops.items():
                 global_metrics.increment(f"operator.{op_name}", count)
-        started = getattr(self, "_query_started", None)
-        self._query_started = None
         if started is not None:
             global_metrics.observe("query.execution_latency_sec",
                                    time.monotonic() - started)
-        if self._exec_ctx is not None:
-            summary["stats"] = dict(self._exec_ctx.stats)
-            for key, value in self._exec_ctx.stats.items():
-                if value:
-                    global_metrics.increment(f"storage.{key}", value)
-            self._exec_ctx.memory.release_all()
-        if self._stream_owns_txn and self._stream_accessor is not None:
-            self._stream_accessor.commit()
+        for key, value in summary.get("stats", {}).items():
+            if value:
+                global_metrics.increment(f"storage.{key}", value)
         self._stream = None
         self._stream_accessor = None
         self._stream_owns_txn = False

@@ -50,12 +50,22 @@ class Message:
 
 
 class FileSource:
-    """JSONL file tail: each appended line is one message."""
+    """JSONL file tail: each appended line is one message.
 
-    def __init__(self, path: str, topic: str = "file"):
+    Offset discipline (same as the Kafka source): poll() re-reads from
+    the last COMMITTED offset; commit() — called only after the ingest
+    transaction commits — advances it. A failed batch is redelivered on
+    the next poll, so each line enters the graph exactly once per
+    committed batch (reference: integrations/kafka/consumer.hpp:99
+    TestStream/Check commit semantics)."""
+
+    def __init__(self, path: str, topic: str = "file",
+                 start_offset: int = 0):
         self.path = path
         self.topic = topic
-        self._offset = 0
+        self._committed = start_offset
+        self._pending = start_offset
+        self._torn_tail: bytes | None = None   # unterminated tail seen
 
     def poll(self, batch_size: int, timeout_sec: float) -> list[Message]:
         out: list[Message] = []
@@ -63,48 +73,111 @@ class FileSource:
         while not out and time.time() < deadline:
             try:
                 with open(self.path, "rb") as f:
-                    f.seek(self._offset)
+                    f.seek(self._committed)
+                    pending = self._committed
                     while len(out) < batch_size:
                         line = f.readline()
                         if not line:
                             break
-                        self._offset = f.tell()
+                        if not line.endswith(b"\n"):
+                            # unterminated tail: mid-append OR a finished
+                            # file without a final newline. Deliver it
+                            # once it is STABLE (unchanged across polls)
+                            if line == self._torn_tail:
+                                pending = f.tell()
+                                if line.strip():
+                                    out.append(Message(
+                                        line.strip(), self.topic,
+                                        offset=pending))
+                                self._torn_tail = None
+                            else:
+                                self._torn_tail = line
+                            break
+                        pending = f.tell()
                         if line.strip():
                             out.append(Message(line.strip(), self.topic,
-                                               offset=self._offset))
+                                               offset=pending))
+                    self._pending = pending if out else self._committed
             except FileNotFoundError:
                 pass
             if not out:
                 time.sleep(0.05)
         return out
 
+    def commit(self) -> None:
+        self._committed = self._pending
+
+    def rollback(self) -> None:
+        self._pending = self._committed
+
+    @property
+    def committed_offset(self) -> int:
+        return self._committed
+
     def close(self) -> None:
         pass
 
 
-class KafkaSource:  # pragma: no cover - requires a kafka client lib
-    def __init__(self, topics, bootstrap_servers, consumer_group):
-        try:
-            from confluent_kafka import Consumer
-        except ImportError as e:
-            raise QueryException(
-                "no Kafka client library available in this environment; "
-                "use a FILE stream or install confluent-kafka") from e
-        self._consumer = Consumer({
+class KafkaSource:
+    """Kafka consumer with EXACTLY-ONCE-per-committed-batch offsets:
+    auto-commit is disabled; offsets are committed to the broker only
+    after the ingest transaction commits, and a failed batch seeks back
+    so the broker redelivers it (reference:
+    /root/reference/src/integrations/kafka/consumer.hpp:99).
+
+    client_module: confluent_kafka by default; tests inject a fake with
+    the same Consumer/TopicPartition surface.
+    """
+
+    def __init__(self, topics, bootstrap_servers, consumer_group,
+                 client_module=None):
+        if client_module is None:
+            try:
+                import confluent_kafka as client_module
+            except ImportError as e:
+                raise QueryException(
+                    "no Kafka client library available in this "
+                    "environment; use a FILE stream or install "
+                    "confluent-kafka") from e
+        self._ck = client_module
+        self._consumer = client_module.Consumer({
             "bootstrap.servers": bootstrap_servers,
             "group.id": consumer_group or "memgraph-tpu",
-            "auto.offset.reset": "earliest"})
+            "auto.offset.reset": "earliest",
+            # offsets move ONLY via commit() after txn success
+            "enable.auto.commit": False})
         self._consumer.subscribe(list(topics))
+        self._batch_start: dict = {}    # (topic, partition) -> first offset
 
     def poll(self, batch_size: int, timeout_sec: float) -> list[Message]:
         msgs = self._consumer.consume(batch_size, timeout=timeout_sec)
         out = []
+        self._batch_start = {}
         for m in msgs or []:
             if m.error():
                 continue
+            tp = (m.topic(), m.partition())
+            if tp not in self._batch_start:
+                self._batch_start[tp] = m.offset()
             out.append(Message(m.value(), m.topic(), m.key(),
                                m.timestamp()[1], m.offset()))
         return out
+
+    def commit(self) -> None:
+        if self._batch_start:
+            self._consumer.commit(asynchronous=False)
+            self._batch_start = {}
+
+    def rollback(self) -> None:
+        # seek back to each partition's batch start: the broker
+        # redelivers the exact same batch on the next poll
+        for (topic, partition), offset in self._batch_start.items():
+            try:
+                self._consumer.seek(
+                    self._ck.TopicPartition(topic, partition, offset))
+            except Exception:  # pragma: no cover - client-specific
+                log.exception("kafka seek-back failed")
+        self._batch_start = {}
 
     def close(self) -> None:
         self._consumer.close()
@@ -121,9 +194,11 @@ class PulsarSource:  # pragma: no cover - requires pulsar client lib
         self._client = pulsar.Client(service_url)
         self._consumer = self._client.subscribe(
             list(topics), consumer_group or "memgraph-tpu")
+        self._unacked = []
 
     def poll(self, batch_size, timeout_sec):
         out = []
+        self._unacked = []
         deadline = time.time() + timeout_sec
         while len(out) < batch_size and time.time() < deadline:
             try:
@@ -132,8 +207,18 @@ class PulsarSource:  # pragma: no cover - requires pulsar client lib
             except Exception:
                 break
             out.append(Message(m.data(), m.topic_name()))
-            self._consumer.acknowledge(m)
+            self._unacked.append(m)
         return out
+
+    def commit(self):
+        for m in self._unacked:
+            self._consumer.acknowledge(m)
+        self._unacked = []
+
+    def rollback(self):
+        for m in self._unacked:
+            self._consumer.negative_acknowledge(m)
+        self._unacked = []
 
     def close(self):
         self._client.close()
@@ -166,7 +251,8 @@ class Stream:
     def _make_source(self):
         spec = self.spec
         if spec.kind == "file":
-            return FileSource(spec.topics[0])
+            return FileSource(spec.topics[0],
+                              start_offset=self._restore_offset())
         if spec.kind == "kafka":
             return KafkaSource(spec.topics, spec.bootstrap_servers,
                                spec.consumer_group)
@@ -198,6 +284,7 @@ class Stream:
     def _loop(self, source, transform) -> None:
         from .interpreter import Interpreter
         from ..exceptions import SerializationError
+        consecutive_failures = 0
         try:
             while not self._stop.is_set():
                 batch = source.poll(self.spec.batch_size,
@@ -207,12 +294,18 @@ class Stream:
                 try:
                     actions = transform(batch)
                 except Exception as e:
+                    # a transformation error stops the stream (reference
+                    # semantics): skipping would silently drop data,
+                    # redelivering would loop on the poison batch
+                    source.rollback()
                     self.last_error = f"transform failed: {e}"
-                    log.exception("stream %s transform failed",
+                    log.exception("stream %s transform failed; stopping",
                                   self.spec.name)
-                    continue
+                    self.running = False
+                    return
                 # conflict-retried transaction (reference: retry interval
                 # config, memgraph.cpp:652)
+                committed = False
                 for attempt in range(10):
                     interp = Interpreter(self.ictx, system=True)
                     try:
@@ -221,9 +314,12 @@ class Stream:
                             interp.execute(action["query"],
                                            action.get("parameters"))
                         interp.execute("COMMIT")
+                        committed = True
                         break
                     except SerializationError:
                         interp.abort()
+                        self.last_error = ("batch exhausted serialization "
+                                           "retries")
                         time.sleep(0.01 * (attempt + 1))
                     except Exception as e:
                         interp.abort()
@@ -231,10 +327,40 @@ class Stream:
                         log.exception("stream %s batch failed",
                                       self.spec.name)
                         break
-                self.processed_batches += 1
-                self.processed_messages += len(batch)
+                if committed:
+                    # offsets advance ONLY now: a crash between COMMIT
+                    # and commit() redelivers (at-least-once floor), a
+                    # failed txn never advances (no message loss)
+                    source.commit()
+                    self._persist_offset(source)
+                    consecutive_failures = 0
+                    self.last_error = None
+                    self.processed_batches += 1
+                    self.processed_messages += len(batch)
+                else:
+                    source.rollback()
+                    consecutive_failures += 1
+                    if consecutive_failures >= 3:
+                        log.error(
+                            "stream %s: batch failed %d times; stopping",
+                            self.spec.name, consecutive_failures)
+                        self.running = False
+                        return
         finally:
             source.close()
+
+    def _persist_offset(self, source) -> None:
+        committed = getattr(source, "committed_offset", None)
+        kv = getattr(self.ictx, "kvstore", None)
+        if committed is not None and kv is not None:
+            kv.put(f"streams:offset:{self.spec.name}", str(committed))
+
+    def _restore_offset(self) -> int:
+        kv = getattr(self.ictx, "kvstore", None)
+        if kv is None:
+            return 0
+        raw = kv.get_str(f"streams:offset:{self.spec.name}")
+        return int(raw) if raw else 0
 
 
 class Streams:

@@ -44,10 +44,13 @@ class LabelIndex:
                 bucket[v.gid] = v
         event.set()
 
-    def create_in_background(self, label_id: int, vertices) -> threading.Event:
+    def create_in_background(self, label_id: int,
+                             vertices_fn) -> threading.Event:
         """Register the index immediately, populate on a worker thread;
-        returns the ready event. `vertices` must be a materialized
-        sequence (the caller snapshots the live dict)."""
+        returns the ready event. `vertices_fn` materializes the vertex
+        snapshot and is called only AFTER registration, so a concurrent
+        writer's add() cannot fall in the unregistered window and be
+        lost."""
         with self._lock:
             bucket = self._index.setdefault(label_id, {})
             event = self._ready.setdefault(label_id, threading.Event())
@@ -56,7 +59,7 @@ class LabelIndex:
 
         def populate():
             try:
-                for v in vertices:
+                for v in vertices_fn():
                     if label_id in v.labels and not v.deleted:
                         bucket[v.gid] = v
                 with self._lock:

@@ -957,10 +957,11 @@ class InMemoryStorage:
         the build fall back to full scans — correct, just unindexed —
         until the returned ready event fires."""
         if background:
-            # materialize: the live dict view would race concurrent
-            # commits ("dictionary changed size during iteration")
+            # materialized lazily AFTER the bucket registers (concurrent
+            # writers' add() must have a bucket to land in), as a list
+            # (the live dict view would race commits)
             return self.indices.label.create_in_background(
-                label_id, list(self._vertices.values()))
+                label_id, lambda: list(self._vertices.values()))
         self.indices.label.create(label_id, self._vertices.values())
         return None
 
