@@ -1,0 +1,159 @@
+"""Betweenness centrality: batched Brandes on the device.
+
+TPU-native replacement for the reference's exact/C++ implementation
+(/root/reference/mage/cpp/betweenness_centrality_module/) and cuGraph's
+betweenness_centrality.cu: per-source level-synchronous BFS with
+shortest-path counting (sigma) expressed as segment reductions over the
+edge list, then the backward dependency accumulation — both batched over
+sources with vmap so the MXU/VPU sees (B, n_pad) blocks instead of
+pointer chasing.
+
+Unweighted Brandes (the reference module is unweighted too). Sources are
+processed in chunks to bound device memory at (chunk, n_pad) floats.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .csr import DeviceGraph
+
+INF = jnp.float32(3.0e38)
+
+
+@partial(jax.jit, static_argnames=("n_pad", "max_levels"))
+def _brandes_chunk(src, dst, edge_valid, sources, weights, n_pad: int,
+                   max_levels: int):
+    """Weighted sum of per-source dependency scores: (n_pad,).
+    weights: (B,) — 0 entries let the final chunk pad to a uniform
+    static shape without double-counting.
+
+    The whole chunk runs level-synchronously as ONE while_loop over
+    (B, n_pad) state — NOT vmap-of-while_loop, which mis-executes on
+    this backend (the masked continuation stops after one iteration;
+    verified r4). Rows whose BFS finished simply stop discovering.
+    """
+    B = sources.shape[0]
+    rows = jnp.arange(B)
+    seg_ids = rows[:, None] * n_pad + dst[None, :]   # batched segment ids
+    seg_ids_back = rows[:, None] * n_pad + src[None, :]
+
+    dist0 = jnp.full((B, n_pad), INF, jnp.float32).at[rows, sources].set(0.0)
+    sigma0 = jnp.zeros((B, n_pad), jnp.float32).at[rows, sources].set(1.0)
+
+    # forward: settle level L+1 from level L, all sources in lockstep
+    def fwd_body(carry):
+        dist, sigma, level, _ = carry
+        on_frontier = (dist[:, src] == level) & edge_valid[None, :]
+        contrib = jnp.where(on_frontier, sigma[:, src], 0.0)
+        sig_new = jax.ops.segment_sum(
+            contrib.reshape(-1), seg_ids.reshape(-1),
+            num_segments=B * n_pad).reshape(B, n_pad)
+        newly = (dist >= INF / 2) & (sig_new > 0)
+        dist = jnp.where(newly, level + 1.0, dist)
+        sigma = jnp.where(newly, sig_new, sigma)
+        return dist, sigma, level + 1.0, jnp.any(newly)
+
+    def fwd_cond(carry):
+        _, _, level, progressed = carry
+        return progressed & (level < max_levels)
+
+    dist, sigma, top_level, _ = jax.lax.while_loop(
+        fwd_cond, fwd_body,
+        (dist0, sigma0, jnp.float32(0.0), jnp.bool_(True)))
+
+    # backward: accumulate dependencies from the deepest level down
+    def bwd_body(carry):
+        delta, level = carry
+        on_edge = (dist[:, src] == level) \
+            & (dist[:, dst] == level + 1.0) & edge_valid[None, :]
+        safe_sigma = jnp.maximum(sigma[:, dst], 1.0)
+        contrib = jnp.where(
+            on_edge,
+            sigma[:, src] / safe_sigma * (1.0 + delta[:, dst]), 0.0)
+        add = jax.ops.segment_sum(
+            contrib.reshape(-1), seg_ids_back.reshape(-1),
+            num_segments=B * n_pad).reshape(B, n_pad)
+        delta = jnp.where(dist == level, add, delta)
+        return delta, level - 1.0
+
+    delta0 = jnp.zeros((B, n_pad), jnp.float32)
+    delta, _ = jax.lax.while_loop(
+        lambda c: c[1] >= 0.0, bwd_body, (delta0, top_level - 1.0))
+    # sources accumulate no dependency for their own BFS
+    delta = delta.at[rows, sources].set(0.0)
+    return (weights[:, None] * delta).sum(axis=0)
+
+
+def betweenness_centrality(graph: DeviceGraph, directed: bool = True,
+                           normalized: bool = True, samples=None,
+                           chunk: int = 32, seed: int = 0,
+                           max_levels: int | None = None):
+    """Betweenness scores (n_nodes,). samples=None → exact (all sources);
+    an int → sampled approximation scaled by n/samples."""
+    n = graph.n_nodes
+    if n == 0:
+        return jnp.zeros((0,), jnp.float32)
+    # simple-graph semantics (Brandes sigma counts SHORTEST PATHS, not
+    # parallel-edge multiplicities): dedupe edges host-side; undirected
+    # canonicalizes (min, max) then mirrors
+    s_np = np.asarray(graph.src_idx)[:graph.n_edges]
+    d_np = np.asarray(graph.col_idx)[:graph.n_edges]
+    keep = s_np != d_np                 # self-loops never carry paths
+    s_np, d_np = s_np[keep], d_np[keep]
+    if directed:
+        pairs = np.unique(np.stack([s_np, d_np], axis=1), axis=0)
+        src = jnp.asarray(pairs[:, 0], jnp.int32)
+        dst = jnp.asarray(pairs[:, 1], jnp.int32)
+    else:
+        canon = np.stack([np.minimum(s_np, d_np),
+                          np.maximum(s_np, d_np)], axis=1)
+        pairs = np.unique(canon, axis=0)
+        src = jnp.asarray(np.concatenate([pairs[:, 0], pairs[:, 1]]),
+                          jnp.int32)
+        dst = jnp.asarray(np.concatenate([pairs[:, 1], pairs[:, 0]]),
+                          jnp.int32)
+    edge_valid = jnp.ones(src.shape, bool)
+
+    if samples is None or samples >= n:
+        sources = np.arange(n, dtype=np.int32)
+        scale = 1.0
+    else:
+        rng = np.random.default_rng(seed)
+        sources = rng.choice(n, size=int(samples),
+                             replace=False).astype(np.int32)
+        scale = n / float(len(sources))
+
+    levels = max_levels if max_levels is not None else n_levels_bound(n)
+    bc = jnp.zeros((graph.n_pad,), jnp.float32)
+    for i in range(0, len(sources), chunk):
+        part = sources[i:i + chunk]
+        pad = chunk - len(part)
+        # the final chunk pads with repeats weighted 0: one jit shape,
+        # no duplicate contributions
+        padded = np.concatenate([part, np.full(pad, part[0], np.int32)]) \
+            if pad else part
+        w = np.concatenate([np.ones(len(part), np.float32),
+                            np.zeros(pad, np.float32)])
+        bc = bc + _brandes_chunk(src, dst, edge_valid,
+                                 jnp.asarray(padded), jnp.asarray(w),
+                                 graph.n_pad, levels)
+
+    bc = bc[:n] * scale
+    if not directed:
+        bc = bc / 2.0
+    if normalized and n > 2:
+        denom = (n - 1) * (n - 2)
+        if not directed:
+            denom /= 2.0
+        bc = bc / denom
+    return bc
+
+
+def n_levels_bound(n: int) -> int:
+    """BFS level cap: the diameter can't exceed n-1; bounded for jit."""
+    return max(2, min(n, 10_000))

@@ -30,3 +30,6 @@ def load_builtin_modules() -> None:
     from . import apoc_modules            # noqa: F401
     from . import ml_modules              # noqa: F401
     from . import compat_modules          # noqa: F401
+    from . import migrate_modules         # noqa: F401
+    from . import elastic_modules         # noqa: F401
+    from . import tgn_module              # noqa: F401

@@ -1,0 +1,139 @@
+"""elastic_search.* — serialize graph objects into Elasticsearch.
+
+Counterpart of /root/reference/mage/python/elastic_search_serialization.py:
+connect/create_index/index_db/scroll against a live cluster (gated on
+the `elasticsearch` client), plus the document serialization itself
+exposed as `elastic_search.serialize_db` — usable (and tested) without
+any cluster, and the piece the synchronization triggers compose with.
+"""
+
+from __future__ import annotations
+
+from ..exceptions import QueryException
+from . import mgp
+
+_CLIENTS: dict = {}
+
+
+def _serialize_vertex(ctx, va):
+    storage = ctx.storage
+    return {
+        "_id": str(va.gid),
+        "labels": [storage.label_mapper.id_to_name(l)
+                   for l in va.labels(ctx.view)],
+        "properties": {storage.property_mapper.id_to_name(k): v
+                       for k, v in va.properties(ctx.view).items()},
+    }
+
+
+def _serialize_edge(ctx, ea):
+    storage = ctx.storage
+    return {
+        "_id": str(ea.gid),
+        "edge_type": storage.edge_type_mapper.id_to_name(ea.edge_type),
+        "source": str(ea.from_vertex().gid),
+        "target": str(ea.to_vertex().gid),
+        "properties": {storage.property_mapper.id_to_name(k): v
+                       for k, v in ea.properties(ctx.view).items()},
+    }
+
+
+@mgp.read_proc("elastic_search.serialize_db",
+               opt_args=[("edges", "BOOLEAN", False)],
+               results=[("id", "STRING"), ("document", "MAP")])
+def serialize_db(ctx, edges=False):
+    """Every vertex (or edge) as the ES document the reference's bulk
+    indexers ship — no cluster required."""
+    if edges:
+        for ea in ctx.accessor.edges(ctx.view):
+            doc = _serialize_edge(ctx, ea)
+            yield {"id": doc["_id"], "document": doc}
+    else:
+        for va in ctx.accessor.vertices(ctx.view):
+            doc = _serialize_vertex(ctx, va)
+            yield {"id": doc["_id"], "document": doc}
+
+
+def _client():
+    es = _CLIENTS.get("default")
+    if es is None:
+        raise QueryException(
+            "elastic_search: call elastic_search.connect(...) first")
+    return es
+
+
+@mgp.read_proc("elastic_search.connect",
+               args=[("elastic_url", "STRING")],
+               opt_args=[("ca_certs", "STRING", None),
+                         ("elastic_user", "STRING", None),
+                         ("elastic_password", "STRING", None)],
+               results=[("connection_status", "STRING")])
+def connect(ctx, elastic_url, ca_certs=None, elastic_user=None,
+            elastic_password=None):
+    try:
+        from elasticsearch import Elasticsearch
+    except ImportError as e:
+        raise QueryException(
+            "the 'elasticsearch' client library is not installed in "
+            "this environment") from e
+    kwargs = {}
+    if ca_certs:
+        kwargs["ca_certs"] = ca_certs
+    if elastic_user:
+        kwargs["basic_auth"] = (elastic_user, elastic_password or "")
+    es = Elasticsearch(elastic_url, **kwargs)
+    _CLIENTS["default"] = es
+    yield {"connection_status": str(es.info())}
+
+
+@mgp.read_proc("elastic_search.create_index",
+               args=[("index_name", "STRING"), ("schema", "MAP")],
+               results=[("message", "STRING")])
+def create_index(ctx, index_name, schema):
+    es = _client()
+    es.indices.create(index=index_name, body=dict(schema or {}))
+    yield {"message": f"created index {index_name}"}
+
+
+@mgp.read_proc("elastic_search.index_db",
+               args=[("node_index", "STRING"), ("edge_index", "STRING")],
+               opt_args=[("thread_count", "INTEGER", 1)],
+               results=[("number_of_nodes", "INTEGER"),
+                        ("number_of_edges", "INTEGER")])
+def index_db(ctx, node_index, edge_index, thread_count=1):
+    """Bulk-index the whole graph (reference: streaming_bulk /
+    parallel_bulk paths, selected by thread_count)."""
+    from elasticsearch.helpers import parallel_bulk, streaming_bulk
+    es = _client()
+
+    def bulk(docs):
+        if int(thread_count) > 1:
+            return parallel_bulk(es, docs,
+                                 thread_count=int(thread_count))
+        return streaming_bulk(es, docs)
+
+    n_nodes = n_edges = 0
+    node_docs = ({"_index": node_index, "_id": d["id"],
+                  "_source": d["document"]}
+                 for d in serialize_db(ctx))
+    for ok, _ in bulk(node_docs):
+        n_nodes += bool(ok)
+    edge_docs = ({"_index": edge_index, "_id": d["id"],
+                  "_source": d["document"]}
+                 for d in serialize_db(ctx, edges=True))
+    for ok, _ in bulk(edge_docs):
+        n_edges += bool(ok)
+    yield {"number_of_nodes": n_nodes, "number_of_edges": n_edges}
+
+
+@mgp.read_proc("elastic_search.scroll",
+               args=[("index_name", "STRING"), ("query", "MAP")],
+               results=[("document", "MAP")])
+def scroll(ctx, index_name, query):
+    es = _client()
+    resp = es.search(index=index_name, body=dict(query or {}),
+                     scroll="1m")
+    while resp["hits"]["hits"]:
+        for hit in resp["hits"]["hits"]:
+            yield {"document": hit["_source"]}
+        resp = es.scroll(scroll_id=resp["_scroll_id"], scroll="1m")

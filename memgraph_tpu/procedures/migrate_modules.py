@@ -1,0 +1,149 @@
+"""migrate.* — pull rows from external systems into Cypher pipelines.
+
+Counterpart of the reference's cross-database migration module
+(/root/reference/mage/python/cross_database.py: migrate.mysql/
+postgresql/oracle_db/sql_server/duckdb/neo4j/s3/...): each procedure
+streams the source's rows as `row` maps, composing with UNWIND/CREATE
+for ingest. Drivers are optional — sqlite3 ships with CPython and is
+fully functional; the rest raise a clear error when their client
+library is absent.
+"""
+
+from __future__ import annotations
+
+from ..exceptions import QueryException
+from . import mgp
+
+
+def _is_table_name(text: str) -> bool:
+    return all(c.isalnum() or c in "._$" for c in text.strip()) \
+        and bool(text.strip())
+
+
+def _sql_for(table_or_sql: str) -> str:
+    t = table_or_sql.strip()
+    return f"SELECT * FROM {t}" if _is_table_name(t) else t
+
+
+def _rows_from_cursor(cursor, columns=None):
+    cols = columns or [d[0] for d in cursor.description]
+    for rec in cursor:
+        yield {"row": dict(zip(cols, rec))}
+
+
+@mgp.read_proc("migrate.sqlite",
+               args=[("table_or_sql", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "LIST", None)],
+               results=[("row", "MAP")])
+def migrate_sqlite(ctx, table_or_sql, config, params=None):
+    """Rows from a sqlite database file; config: {"database": path}."""
+    import sqlite3
+    path = (config or {}).get("database")
+    if not path:
+        raise QueryException("migrate.sqlite: config.database is required")
+    con = sqlite3.connect(path)
+    try:
+        cur = con.execute(_sql_for(table_or_sql), tuple(params or ()))
+        yield from _rows_from_cursor(cur)
+    finally:
+        con.close()
+
+
+def _gated(module_name, pip_name):
+    try:
+        return __import__(module_name)
+    except ImportError as e:
+        raise QueryException(
+            f"migrate: the {pip_name!r} client library is not installed "
+            f"in this environment") from e
+
+
+@mgp.read_proc("migrate.mysql",
+               args=[("table_or_sql", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "LIST", None)],
+               results=[("row", "MAP")])
+def migrate_mysql(ctx, table_or_sql, config, params=None):
+    connector = _gated("mysql.connector", "mysql-connector-python")
+    con = connector.connect(**(config or {}))
+    try:
+        cur = con.cursor()
+        cur.execute(_sql_for(table_or_sql), tuple(params or ()))
+        yield from _rows_from_cursor(cur)
+    finally:
+        con.close()
+
+
+@mgp.read_proc("migrate.postgresql",
+               args=[("table_or_sql", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "LIST", None)],
+               results=[("row", "MAP")])
+def migrate_postgresql(ctx, table_or_sql, config, params=None):
+    psycopg2 = _gated("psycopg2", "psycopg2")
+    con = psycopg2.connect(**(config or {}))
+    try:
+        cur = con.cursor()
+        cur.execute(_sql_for(table_or_sql), tuple(params or ()))
+        yield from _rows_from_cursor(cur)
+    finally:
+        con.close()
+
+
+@mgp.read_proc("migrate.duckdb",
+               args=[("table_or_sql", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "LIST", None)],
+               results=[("row", "MAP")])
+def migrate_duckdb(ctx, table_or_sql, config, params=None):
+    duckdb = _gated("duckdb", "duckdb")
+    con = duckdb.connect((config or {}).get("database", ":memory:"))
+    try:
+        cur = con.execute(_sql_for(table_or_sql), params or [])
+        cols = [d[0] for d in cur.description]
+        for rec in cur.fetchall():
+            yield {"row": dict(zip(cols, rec))}
+    finally:
+        con.close()
+
+
+@mgp.read_proc("migrate.neo4j",
+               args=[("label_or_rel_or_query", "STRING"),
+                     ("config", "MAP")],
+               results=[("row", "MAP")])
+def migrate_neo4j(ctx, label_or_rel_or_query, config):
+    neo4j = _gated("neo4j", "neo4j")
+    text = label_or_rel_or_query.strip()
+    if _is_table_name(text):
+        # a bare name is a node LABEL (relationship types are pulled
+        # with an explicit MATCH ()-[r:T]->() query — the casing
+        # heuristic the reference uses misroutes all-caps labels)
+        query = f"MATCH (n:{text}) RETURN properties(n) AS props"
+    else:
+        query = text
+    driver = neo4j.GraphDatabase.driver(
+        (config or {}).get("uri", "bolt://localhost:7687"),
+        auth=((config or {}).get("username", ""),
+              (config or {}).get("password", "")))
+    try:
+        with driver.session() as session:
+            for rec in session.run(query):
+                yield {"row": dict(rec)}
+    finally:
+        driver.close()
+
+
+@mgp.read_proc("migrate.s3",
+               args=[("file_path", "STRING"), ("config", "MAP")],
+               results=[("row", "MAP")])
+def migrate_s3(ctx, file_path, config):
+    """CSV object from S3; config: {"bucket", ...boto3 client kwargs}."""
+    boto3 = _gated("boto3", "boto3")
+    import csv
+    import io
+    cfg = dict(config or {})
+    bucket = cfg.pop("bucket", None)
+    if not bucket:
+        raise QueryException("migrate.s3: config.bucket is required")
+    client = boto3.client("s3", **cfg)
+    body = client.get_object(Bucket=bucket, Key=file_path)["Body"]
+    reader = csv.DictReader(io.TextIOWrapper(body, encoding="utf-8"))
+    for row in reader:
+        yield {"row": dict(row)}

@@ -159,6 +159,30 @@ def biconnected_get(ctx):
                 yield {"bcc_id": bcc_id, "node_from": nu, "node_to": nv}
 
 
+@mgp.read_proc("betweenness_centrality.get",
+               opt_args=[("directed", "BOOLEAN", True),
+                         ("normalized", "BOOLEAN", True),
+                         ("samples", "INTEGER", 0)],
+               results=[("node", "NODE"),
+                        ("betweenness_centrality", "FLOAT")])
+def betweenness_get(ctx, directed=True, normalized=True, samples=0):
+    """Native batched-Brandes device kernel (ops/betweenness.py) —
+    counterpart of /root/reference/mage/cpp/betweenness_centrality_module/
+    (exact when samples=0, sampled approximation otherwise)."""
+    import numpy as np
+    from ..ops.betweenness import betweenness_centrality
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    bc = np.asarray(betweenness_centrality(
+        graph, directed=bool(directed), normalized=bool(normalized),
+        samples=int(samples) or None))
+    for i, score in enumerate(bc):
+        node = ctx.vertex_by_index(graph, i)
+        if node is not None:
+            yield {"node": node, "betweenness_centrality": float(score)}
+
+
 @mgp.read_proc("nxalg.betweenness_centrality",
                opt_args=[("normalized", "BOOLEAN", True)],
                results=[("node", "NODE"), ("betweenness", "FLOAT")])

@@ -1,0 +1,296 @@
+"""tgn.* — temporal graph network for streamed edge batches.
+
+Compact JAX re-design of /root/reference/mage/python/tgn.py (itself the
+TGN of Rossi et al.): per-node MEMORY updated by a GRU cell on message
+aggregation, sinusoidal time encoding of inter-event deltas, and an
+MLP link predictor over (memory[src], memory[dst], time_enc) — trained
+online on each streamed edge batch with negative sampling, exactly the
+module's role in the reference (self-supervised mode). The full
+attention-embedding stack is collapsed to the memory path: that is the
+part that carries TGN's temporal signal, and it keeps every step a
+dense batched matmul (MXU) instead of per-edge python.
+
+Surface parity: set_params / update / train_and_eval / get /
+predict_link_score / reset.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..exceptions import QueryException
+from . import mgp
+
+_STATE: dict = {}
+
+
+def _defaults():
+    return {"memory_dim": 32, "time_dim": 8, "learning_rate": 0.01,
+            "num_neg_samples": 1, "seed": 7}
+
+
+def _init_state(params, n_hint=256):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    p = _defaults()
+    p.update(params or {})
+    d, t = int(p["memory_dim"]), int(p["time_dim"])
+    key = jax.random.PRNGKey(int(p["seed"]))
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    scale = 0.1
+    weights = {
+        # GRU cell: input = [other_memory, time_enc]
+        "W_z": jax.random.normal(k1, (d + t + d, d)) * scale,
+        "W_r": jax.random.normal(k2, (d + t + d, d)) * scale,
+        "W_h": jax.random.normal(k3, (d + t + d, d)) * scale,
+        # link predictor MLP over [mem_src, mem_dst, mem_src*mem_dst,
+        # feat_src*feat_dst, time_enc] — the product terms make pair
+        # affinity linearly learnable, and the FEATURE product survives
+        # the GRU's contractive dynamics (memories of structurally
+        # symmetric nodes converge to one attractor)
+        "W_p1": jax.random.normal(k4, (4 * d + t, d)) * scale,
+        "b_p1": jnp.zeros((d,)),
+        "W_p2": jax.random.normal(k1, (d, 1)) * scale,
+        "b_p2": jnp.zeros((1,)),
+    }
+    optimizer = optax.adam(float(p["learning_rate"]))
+    init_mem = jnp.asarray(_init_rows(n_hint, d, seed=0))
+    _STATE.update({
+        "params": p, "weights": weights, "optimizer": optimizer,
+        "opt_state": optimizer.init(weights),
+        "memory": init_mem,
+        "init_memory": init_mem,
+        "last_seen": jnp.zeros((n_hint,)),
+        "gid_to_row": {}, "clock": 0.0, "step": 0,
+        "train_losses": [], "eval_scores": [],
+    })
+
+
+def _ensure_state():
+    if not _STATE:
+        _init_state({})
+    return _STATE
+
+
+def _init_rows(n_rows, d, seed):
+    """Fixed pseudorandom per-node initial memory: the stand-in for node
+    features (zeros would make structurally-symmetric nodes permanently
+    indistinguishable to the link predictor)."""
+    rng = np.random.default_rng(seed)
+    return 0.1 * rng.standard_normal((n_rows, d)).astype(np.float32)
+
+
+def _rows_for(gids):
+    st = _ensure_state()
+    import jax.numpy as jnp
+    mapping = st["gid_to_row"]
+    rows = []
+    for g in gids:
+        if g not in mapping:
+            mapping[g] = len(mapping)
+        rows.append(mapping[g])
+    need = len(mapping)
+    cap = st["memory"].shape[0]
+    if need > cap:
+        new_cap = max(need, cap * 2)
+        d = st["memory"].shape[1]
+        grow = _init_rows(new_cap - cap, d, seed=cap)
+        st["memory"] = jnp.concatenate([st["memory"], jnp.asarray(grow)])
+        st["init_memory"] = jnp.concatenate(
+            [st["init_memory"], jnp.asarray(grow)])
+        st["last_seen"] = jnp.concatenate(
+            [st["last_seen"], jnp.zeros((new_cap - cap,))])
+    return np.asarray(rows, dtype=np.int32)
+
+
+def _time_encode(delta, t_dim):
+    import jax.numpy as jnp
+    freqs = jnp.exp(-jnp.arange(t_dim // 2) * 1.0)
+    ang = delta[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+
+
+def _batch_step(weights, memory, feats, last_seen, src_r, dst_r, ts,
+                neg_r, optimizer, opt_state, train=True):
+    """One streamed batch: loss on pos vs neg links, grad step, memory
+    update. All dense (B, d) matmuls."""
+    import jax
+    import jax.numpy as jnp
+
+    t_dim = weights["W_p1"].shape[0] - 4 * memory.shape[1]
+
+    def link_logits(w, mem, s, d_, te):
+        h = jnp.concatenate([mem[s], mem[d_], mem[s] * mem[d_],
+                             feats[s] * feats[d_], te], axis=1)
+        h = jnp.tanh(h @ w["W_p1"] + w["b_p1"])
+        return (h @ w["W_p2"] + w["b_p2"])[:, 0]
+
+    delta = ts - last_seen[src_r]
+    te = _time_encode(delta, t_dim)
+
+    def loss_fn(w):
+        pos = link_logits(w, memory, src_r, dst_r, te)
+        neg = link_logits(w, memory, src_r, neg_r, te)
+        return jnp.mean(jax.nn.softplus(-pos) + jax.nn.softplus(neg))
+
+    if train:
+        loss, grads = jax.value_and_grad(loss_fn)(weights)
+        import optax
+        updates, opt_state = optimizer.update(grads, opt_state, weights)
+        weights = optax.apply_updates(weights, updates)
+    else:
+        loss = loss_fn(weights)
+
+    # GRU memory update for the DESTINATION of each event (message from
+    # src), then symmetric for the source
+    def gru(mem, rows, other_rows, te_):
+        x = jnp.concatenate([mem[other_rows], te_], axis=1)
+        xin = jnp.concatenate([x, mem[rows]], axis=1)
+        z = jax.nn.sigmoid(xin @ weights["W_z"])
+        r = jax.nn.sigmoid(xin @ weights["W_r"])
+        xh = jnp.concatenate([x, r * mem[rows]], axis=1)
+        h = jnp.tanh(xh @ weights["W_h"])
+        return mem.at[rows].set((1 - z) * mem[rows] + z * h)
+
+    memory = gru(memory, dst_r, src_r, te)
+    memory = gru(memory, src_r, dst_r, te)
+    last_seen = last_seen.at[src_r].set(ts)
+    last_seen = last_seen.at[dst_r].set(ts)
+    return weights, opt_state, memory, last_seen, float(loss)
+
+
+def _ingest(edges_spec, train):
+    """edges_spec: list of (src_gid, dst_gid, timestamp)."""
+    import jax.numpy as jnp
+    st = _ensure_state()
+    if not edges_spec:
+        return 0.0
+    src_g = [e[0] for e in edges_spec]
+    dst_g = [e[1] for e in edges_spec]
+    ts = np.asarray([float(e[2]) for e in edges_spec], np.float32)
+    src_r = _rows_for(src_g)
+    dst_r = _rows_for(dst_g)
+    st["step"] = st.get("step", 0) + 1   # fresh negatives every batch
+    rng = np.random.default_rng(st["step"])
+    neg_r = rng.integers(0, len(st["gid_to_row"]),
+                         len(src_r)).astype(np.int32)
+    (st["weights"], st["opt_state"], st["memory"], st["last_seen"],
+     loss) = _batch_step(
+        st["weights"], st["memory"], st["init_memory"], st["last_seen"],
+        jnp.asarray(src_r), jnp.asarray(dst_r), jnp.asarray(ts),
+        jnp.asarray(neg_r), st["optimizer"], st["opt_state"],
+        train=train)
+    st["clock"] = max(st["clock"], float(ts.max()))
+    (st["train_losses"] if train else st["eval_scores"]).append(loss)
+    return loss
+
+
+def _edges_from_graph(ctx, timestamp_property):
+    pid = ctx.storage.property_mapper.maybe_name_to_id(timestamp_property)
+    out = []
+    for ea in ctx.accessor.edges(ctx.view):
+        ts = ea.properties(ctx.view).get(pid, 0) if pid is not None else 0
+        if not isinstance(ts, (int, float)):
+            ts = 0
+        out.append((ea.from_vertex().gid, ea.to_vertex().gid, ts))
+    out.sort(key=lambda e: e[2])
+    return out
+
+
+@mgp.read_proc("tgn.set_params",
+               args=[("params", "MAP")],
+               results=[("message", "STRING")])
+def set_params(ctx, params):
+    _init_state(dict(params or {}))
+    yield {"message": f"tgn initialized with {_STATE['params']}"}
+
+
+@mgp.read_proc("tgn.update",
+               args=[("edges", "LIST")],
+               opt_args=[("timestamp_property", "STRING", "timestamp")],
+               results=[("loss", "FLOAT")])
+def update(ctx, edges, timestamp_property="timestamp"):
+    """Online-train on a batch of edges (self-supervised link signal)."""
+    pid = ctx.storage.property_mapper.maybe_name_to_id(timestamp_property)
+    spec = []
+    for ea in edges or []:
+        ts = 0
+        if pid is not None:
+            val = ea.properties(ctx.view).get(pid, 0)
+            ts = val if isinstance(val, (int, float)) else 0
+        spec.append((ea.from_vertex().gid, ea.to_vertex().gid, ts))
+    yield {"loss": _ingest(spec, train=True)}
+
+
+@mgp.read_proc("tgn.train_and_eval",
+               args=[("num_epochs", "INTEGER")],
+               opt_args=[("timestamp_property", "STRING", "timestamp"),
+                         ("train_fraction", "FLOAT", 0.8),
+                         ("batch_size", "INTEGER", 64)],
+               results=[("epoch", "INTEGER"), ("train_loss", "FLOAT"),
+                        ("eval_loss", "FLOAT")])
+def train_and_eval(ctx, num_epochs, timestamp_property="timestamp",
+                   train_fraction=0.8, batch_size=64):
+    """Epoch training over the graph's edges in timestamp order."""
+    edges = _edges_from_graph(ctx, timestamp_property)
+    if not edges:
+        raise QueryException("tgn: the graph has no edges to train on")
+    cut = max(1, int(len(edges) * float(train_fraction)))
+    train_edges, eval_edges = edges[:cut], edges[cut:]
+    bs = max(1, int(batch_size))
+    import jax.numpy as jnp
+    st = _ensure_state()
+    for epoch in range(int(num_epochs)):
+        # memory restarts from the node-feature init each epoch (standard
+        # TGN training loop; the reference does the same)
+        st["memory"] = jnp.asarray(st["init_memory"])
+        st["last_seen"] = jnp.zeros_like(st["last_seen"])
+        t_losses, e_losses = [], []
+        for i in range(0, len(train_edges), bs):
+            t_losses.append(_ingest(train_edges[i:i + bs], train=True))
+        for i in range(0, len(eval_edges), bs):
+            e_losses.append(_ingest(eval_edges[i:i + bs], train=False))
+        yield {"epoch": epoch,
+               "train_loss": float(np.mean(t_losses)) if t_losses else 0.0,
+               "eval_loss": float(np.mean(e_losses)) if e_losses else 0.0}
+
+
+@mgp.read_proc("tgn.get",
+               results=[("node", "NODE"), ("embedding", "LIST")])
+def get(ctx):
+    """Current memory embedding of every tracked node."""
+    st = _ensure_state()
+    mem = np.asarray(st["memory"])
+    for gid, row in st["gid_to_row"].items():
+        node = ctx.accessor.find_vertex(gid, ctx.view)
+        if node is not None:
+            yield {"node": node, "embedding": [float(x)
+                                               for x in mem[row]]}
+
+
+@mgp.read_proc("tgn.predict_link_score",
+               args=[("src", "NODE"), ("dest", "NODE")],
+               results=[("prediction", "FLOAT")])
+def predict_link_score(ctx, src, dest):
+    import jax
+    import jax.numpy as jnp
+    st = _ensure_state()
+    rows = _rows_for([src.gid, dest.gid])
+    mem = st["memory"]
+    feats = st["init_memory"]
+    t_dim = st["weights"]["W_p1"].shape[0] - 4 * mem.shape[1]
+    te = _time_encode(jnp.zeros((1,)), t_dim)
+    ms, md = mem[rows[0]][None], mem[rows[1]][None]
+    fs, fd = feats[rows[0]][None], feats[rows[1]][None]
+    h = jnp.concatenate([ms, md, ms * md, fs * fd, te], axis=1)
+    h = jnp.tanh(h @ st["weights"]["W_p1"] + st["weights"]["b_p1"])
+    logit = (h @ st["weights"]["W_p2"] + st["weights"]["b_p2"])[0, 0]
+    yield {"prediction": float(jax.nn.sigmoid(logit))}
+
+
+@mgp.read_proc("tgn.reset", results=[("message", "STRING")])
+def reset(ctx):
+    _STATE.clear()
+    yield {"message": "tgn state cleared"}

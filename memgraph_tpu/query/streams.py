@@ -69,29 +69,33 @@ class FileSource:
 
     def poll(self, batch_size: int, timeout_sec: float) -> list[Message]:
         out: list[Message] = []
+        # a torn tail counts as stable only if seen by a PREVIOUS poll
+        # call — the in-poll 50ms retry must not promote a mid-append
+        # fragment (the producer may just be slow between writes)
+        prev_tail = self._torn_tail
+        seen_tail = None
         deadline = time.time() + timeout_sec
         while not out and time.time() < deadline:
             try:
                 with open(self.path, "rb") as f:
                     f.seek(self._committed)
                     pending = self._committed
+                    seen_tail = None
                     while len(out) < batch_size:
                         line = f.readline()
                         if not line:
                             break
                         if not line.endswith(b"\n"):
-                            # unterminated tail: mid-append OR a finished
-                            # file without a final newline. Deliver it
-                            # once it is STABLE (unchanged across polls)
-                            if line == self._torn_tail:
+                            # unterminated: mid-append OR a finished file
+                            # without a final newline
+                            if line == prev_tail:
                                 pending = f.tell()
                                 if line.strip():
                                     out.append(Message(
                                         line.strip(), self.topic,
                                         offset=pending))
-                                self._torn_tail = None
                             else:
-                                self._torn_tail = line
+                                seen_tail = line
                             break
                         pending = f.tell()
                         if line.strip():
@@ -102,6 +106,7 @@ class FileSource:
                 pass
             if not out:
                 time.sleep(0.05)
+        self._torn_tail = seen_tail
         return out
 
     def commit(self) -> None:
@@ -402,6 +407,9 @@ class Streams:
             stream = self._streams.pop(name, None)
             if stream is not None and self._kv is not None:
                 self._kv.delete(f"stream:{name}")
+                # a recreated stream of the same name must NOT resume at
+                # the dropped stream's byte offset
+                self._kv.delete(f"streams:offset:{name}")
         if stream is None:
             raise QueryException(f"stream {name!r} does not exist")
         if stream.running:

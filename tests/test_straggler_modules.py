@@ -1,0 +1,106 @@
+"""Straggler module families: migrate.*, elastic_search.*, tgn.*.
+
+References: /root/reference/mage/python/cross_database.py,
+elastic_search_serialization.py, tgn.py.
+"""
+
+import json
+import sqlite3
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def db():
+    return InterpreterContext(InMemoryStorage())
+
+
+def run(db, q, params=None):
+    _, rows, _ = Interpreter(db).execute(q, params)
+    return rows
+
+
+def test_migrate_sqlite_roundtrip(db, tmp_path):
+    path = str(tmp_path / "src.db")
+    con = sqlite3.connect(path)
+    con.execute("CREATE TABLE people (id INTEGER, name TEXT)")
+    con.executemany("INSERT INTO people VALUES (?, ?)",
+                    [(1, "ann"), (2, "bob"), (3, "cy")])
+    con.commit()
+    con.close()
+    # table form
+    rows = run(db, "CALL migrate.sqlite('people', {database: $p}) "
+                   "YIELD row RETURN row.id AS id, row.name AS name "
+                   "ORDER BY id", {"p": path})
+    assert rows == [[1, "ann"], [2, "bob"], [3, "cy"]]
+    # SQL + params form, composing with CREATE
+    run(db, "CALL migrate.sqlite('SELECT * FROM people WHERE id > ?', "
+            "{database: $p}, [1]) YIELD row "
+            "CREATE (:Person {id: row.id, name: row.name})", {"p": path})
+    rows = run(db, "MATCH (p:Person) RETURN count(p)")
+    assert rows == [[2]]
+
+
+def test_migrate_gated_sources_error_cleanly(db):
+    from memgraph_tpu.exceptions import QueryException
+    with pytest.raises(Exception) as e:
+        run(db, "CALL migrate.mysql('t', {}) YIELD row RETURN row")
+    assert "not installed" in str(e.value)
+
+
+def test_elastic_serialize_db(db):
+    run(db, "CREATE (:Doc {title: 'a'})-[:REF {w: 2}]->(:Doc:Hot "
+            "{title: 'b'})")
+    rows = run(db, "CALL elastic_search.serialize_db() "
+                   "YIELD id, document RETURN id, document ORDER BY id")
+    assert len(rows) == 2
+    doc0 = rows[0][1]
+    assert doc0["labels"] == ["Doc"] and doc0["properties"] == {
+        "title": "a"}
+    rows = run(db, "CALL elastic_search.serialize_db(true) "
+                   "YIELD document RETURN document")
+    assert rows[0][0]["edge_type"] == "REF"
+    assert rows[0][0]["properties"] == {"w": 2}
+
+
+def test_tgn_trains_and_separates_links(db):
+    """A bipartite temporal pattern: after training, observed links
+    score higher than never-observed cross links."""
+    run(db, "CALL tgn.reset() YIELD message RETURN message")
+    run(db, "CALL tgn.set_params({memory_dim: 16, learning_rate: 0.05}) "
+            "YIELD message RETURN message")
+    rng = np.random.default_rng(0)
+    n_half = 6
+    for i in range(2 * n_half):
+        run(db, "CREATE (:U {id: $i})", {"i": i})
+    # group A (0..5) repeatedly interacts with group B (6..11) pairwise
+    t = 0
+    for _ in range(30):
+        for i in range(n_half):
+            t += 1
+            run(db, "MATCH (a:U {id: $a}), (b:U {id: $b}) "
+                    "CREATE (a)-[:MSG {timestamp: $t}]->(b)",
+                {"a": i, "b": i + n_half, "t": t})
+    rows = run(db, "CALL tgn.train_and_eval(8, 'timestamp', 0.8, 12) "
+                   "YIELD epoch, train_loss, eval_loss "
+                   "RETURN epoch, train_loss, eval_loss")
+    assert len(rows) == 8
+    assert rows[-1][1] < rows[0][1]     # loss decreases
+    # observed pair scores above an unobserved pairing
+    pos = run(db, "MATCH (a:U {id: 0}), (b:U {id: 6}) "
+                  "CALL tgn.predict_link_score(a, b) YIELD prediction "
+                  "RETURN prediction")[0][0]
+    neg = run(db, "MATCH (a:U {id: 0}), (b:U {id: 3}) "
+                  "CALL tgn.predict_link_score(a, b) YIELD prediction "
+                  "RETURN prediction")[0][0]
+    assert 0.0 <= pos <= 1.0 and 0.0 <= neg <= 1.0
+    assert pos > neg, (pos, neg)
+    # embeddings exposed for every tracked node
+    rows = run(db, "CALL tgn.get() YIELD node, embedding "
+                   "RETURN count(node), size(embedding)")
+    assert rows[0][0] == 2 * n_half
+    assert rows[0][1] == 16

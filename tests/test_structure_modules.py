@@ -118,3 +118,57 @@ def test_nxalg_betweenness(db):
                    "YIELD node, betweenness RETURN betweenness "
                    "ORDER BY betweenness DESC")
     assert rows[0][0] > 0  # the middle node carries the path
+
+
+def test_native_betweenness_matches_networkx(db):
+    """Device Brandes kernel vs NetworkX exact, directed + undirected."""
+    import networkx as nx
+    import numpy as np
+    rng = np.random.default_rng(4)
+    n, e = 40, 160
+    edges = {(int(a), int(b)) for a, b in
+             zip(rng.integers(0, n, e), rng.integers(0, n, e))
+             if a != b}
+    for i in range(n):
+        run(db, "CREATE (:B {id: $i})", {"i": i})
+    for a, b in edges:
+        run(db, "MATCH (x:B {id: $a}), (y:B {id: $b}) CREATE (x)-[:E]->(y)",
+            {"a": a, "b": b})
+
+    rows = run(db, "CALL betweenness_centrality.get() "
+                   "YIELD node, betweenness_centrality "
+                   "RETURN node.id AS id, betweenness_centrality AS bc")
+    got = {r[0]: r[1] for r in rows}
+    g = nx.DiGraph(sorted(edges))
+    g.add_nodes_from(range(n))
+    expect = nx.betweenness_centrality(g, normalized=True)
+    for i in range(n):
+        assert abs(got[i] - expect[i]) < 1e-4, (i, got[i], expect[i])
+
+    rows = run(db, "CALL betweenness_centrality.get(false, true) "
+                   "YIELD node, betweenness_centrality "
+                   "RETURN node.id AS id, betweenness_centrality AS bc")
+    got_u = {r[0]: r[1] for r in rows}
+    gu = nx.Graph(sorted(edges))
+    gu.add_nodes_from(range(n))
+    expect_u = nx.betweenness_centrality(gu, normalized=True)
+    for i in range(n):
+        assert abs(got_u[i] - expect_u[i]) < 1e-4, (i, got_u[i],
+                                                    expect_u[i])
+
+
+def test_sampled_betweenness_approximates(db):
+    import numpy as np
+    rng = np.random.default_rng(9)
+    n = 60
+    for i in range(n):
+        run(db, "CREATE (:S {id: $i})", {"i": i})
+    # star + chain: node 0 is a hub with high betweenness
+    for i in range(1, n):
+        run(db, "MATCH (a:S {id: 0}), (b:S {id: $i}) "
+                "CREATE (a)-[:E]->(b), (b)-[:E]->(a)", {"i": i})
+    rows = run(db, "CALL betweenness_centrality.get(true, true, 20) "
+                   "YIELD node, betweenness_centrality "
+                   "RETURN node.id AS id, betweenness_centrality AS bc "
+                   "ORDER BY bc DESC LIMIT 1")
+    assert rows[0][0] == 0              # the hub dominates even sampled
