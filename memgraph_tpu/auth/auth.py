@@ -63,16 +63,57 @@ class User:
     denied: set = field(default_factory=set)
     fg_labels: dict = field(default_factory=dict)
     fg_edge_types: dict = field(default_factory=dict)
+    # module-managed (SSO) identity: basic-scheme login is REFUSED for
+    # these users (a passwordless external user must not be open)
+    external: bool = False
 
 
 class Auth:
-    def __init__(self, storage_path: str | None = None) -> None:
+    def __init__(self, storage_path: str | None = None,
+                 module_mappings: dict | None = None) -> None:
         self._lock = threading.Lock()
         self._users: dict[str, User] = {}
         self._roles: dict[str, Role] = {}
         self._path = storage_path
+        # scheme -> AuthModule (SSO/external auth; auth/module.py)
+        self.module_mappings = dict(module_mappings or {})
         if storage_path and os.path.exists(storage_path):
             self._load()
+
+    # --- external (SSO) authentication --------------------------------------
+
+    def authenticate_external(self, scheme: str, principal: str,
+                              credentials) -> str | None:
+        """Route a non-basic Bolt auth scheme through its external
+        module. Returns the authenticated username, or None.
+
+        The module decides identity AND role
+        ({"authenticated": true, "username": ..., "role": ...}); the
+        user is auto-created on first login and its role assignment
+        follows the module on every login (reference: SSO users are
+        module-managed, auth/module.cpp)."""
+        module = self.module_mappings.get((scheme or "").lower())
+        if module is None:
+            return None
+        reply = module.call({"scheme": scheme, "username": principal,
+                             "response": credentials})
+        if not reply or reply.get("authenticated") is not True:
+            return None
+        username = reply.get("username") or principal
+        if not isinstance(username, str) or not username:
+            return None
+        role = reply.get("role")
+        with self._lock:
+            user = self._users.get(username)
+            if user is None:
+                user = User(username, None, external=True)
+                self._users[username] = user
+            if isinstance(role, str) and role:
+                if role not in self._roles:
+                    self._roles[role] = Role(role)
+                user.roles = [role]
+            self._save()
+        return username
 
     # --- users --------------------------------------------------------------
 
@@ -109,6 +150,9 @@ class Auth:
                 return True  # no users defined → open instance (reference behavior)
             user = self._users.get(name)
             if user is None:
+                return False
+            if user.external:
+                # SSO identities authenticate ONLY through their module
                 return False
             if user.password_hash is None:
                 return True
@@ -292,7 +336,8 @@ class Auth:
                        "roles": u.roles, "granted": sorted(u.granted),
                        "denied": sorted(u.denied),
                        "fg_labels": u.fg_labels,
-                       "fg_edge_types": u.fg_edge_types}
+                       "fg_edge_types": u.fg_edge_types,
+                       "external": u.external}
                       for u in self._users.values()],
             "roles": [{"name": r.name, "granted": sorted(r.granted),
                        "denied": sorted(r.denied),
@@ -307,7 +352,8 @@ class Auth:
                 u["name"], u.get("password_hash"), u.get("roles", []),
                 set(u.get("granted", [])), set(u.get("denied", [])),
                 dict(u.get("fg_labels", {})),
-                dict(u.get("fg_edge_types", {})))
+                dict(u.get("fg_edge_types", {})),
+                external=bool(u.get("external", False)))
         for r in data.get("roles", []):
             self._roles[r["name"]] = Role(
                 r["name"], set(r.get("granted", [])),
@@ -323,7 +369,8 @@ class Auth:
                        "roles": u.roles, "granted": sorted(u.granted),
                        "denied": sorted(u.denied),
                        "fg_labels": u.fg_labels,
-                       "fg_edge_types": u.fg_edge_types}
+                       "fg_edge_types": u.fg_edge_types,
+                       "external": u.external}
                       for u in self._users.values()],
             "roles": [{"name": r.name, "granted": sorted(r.granted),
                        "denied": sorted(r.denied),

@@ -28,7 +28,23 @@ class DbmsHandler:
         self._interp_config = interpreter_config or {}
         self._recover = recover_on_startup
         self._databases: dict[str, "InterpreterContext"] = {}
+        self._suspended: set[str] = set()
         self._make(DEFAULT_DB)
+        # suspended tenants stay cold across restarts (their durable
+        # shell is on disk; SUSPENDED markers record the state)
+        root = self._root_config.durability_dir
+        if root:
+            dbdir = os.path.join(root, "databases")
+            if os.path.isdir(dbdir):
+                for entry in os.listdir(dbdir):
+                    if os.path.exists(os.path.join(dbdir, entry,
+                                                   "SUSPENDED")):
+                        self._suspended.add(entry)
+        from .tenant_profiles import TenantProfiles
+        self.tenant_profiles = TenantProfiles(
+            self._databases[DEFAULT_DB].kvstore
+            if getattr(self._databases[DEFAULT_DB], "kvstore", None)
+            is not None else None)
 
     def _db_config(self, name: str) -> StorageConfig:
         cfg = StorageConfig(
@@ -52,9 +68,10 @@ class DbmsHandler:
                     cfg.storage_mode = StorageMode(f.read().strip())
         return cfg
 
-    def _make(self, name: str):
+    def _make(self, name: str, force_recover: bool = False):
         from ..query.interpreter import InterpreterContext
         from ..storage.common import StorageMode
+        recover_now = self._recover or force_recover
         cfg = self._db_config(name)
         if cfg.storage_mode is StorageMode.ON_DISK_TRANSACTIONAL:
             # disk mode: sqlite owns persistence; snapshots/WAL unused
@@ -70,7 +87,7 @@ class DbmsHandler:
             if cfg.durability_dir:
                 from ..storage.durability.recovery import (recover,
                                                            wire_durability)
-                if self._recover:
+                if recover_now:
                     recover(storage)
                 if cfg.wal_enabled:
                     wire_durability(storage)
@@ -82,7 +99,7 @@ class DbmsHandler:
             ictx.kvstore = KVStore(
                 os.path.join(cfg.durability_dir, "kvstore.db"))
             ictx.settings = Settings(ictx.kvstore)
-            if self._recover:
+            if recover_now:
                 self._restore_ddl(storage, ictx.kvstore)
                 raw = ictx.kvstore.get("enums")
                 if raw:
@@ -176,13 +193,17 @@ class DbmsHandler:
         if not name.replace("_", "").replace("-", "").isalnum():
             raise QueryException(f"invalid database name {name!r}")
         with self._lock:
-            if name in self._databases:
+            if name in self._databases or name in self._suspended:
                 raise QueryException(f"database {name!r} already exists")
             return self._make(name)
 
     def get(self, name: str):
         with self._lock:
             ictx = self._databases.get(name)
+            if ictx is None and name in self._suspended:
+                raise QueryException(
+                    f"database {name!r} is suspended; RESUME DATABASE "
+                    f"{name} first")
         if ictx is None:
             raise QueryException(f"database {name!r} does not exist")
         return ictx
@@ -191,13 +212,88 @@ class DbmsHandler:
         if name == DEFAULT_DB:
             raise QueryException("cannot drop the default database")
         with self._lock:
+            if name in self._suspended:
+                self._suspended.discard(name)
+                self._clear_suspend_marker(name)
+                return
             if name not in self._databases:
                 raise QueryException(f"database {name!r} does not exist")
             del self._databases[name]
+        # a recreated same-name database must not inherit the old limits
+        profiles = getattr(self, "tenant_profiles", None)
+        if profiles is not None:
+            profiles.clear(name)
 
     def names(self) -> list[str]:
         with self._lock:
-            return sorted(self._databases)
+            return sorted(set(self._databases) | self._suspended)
+
+    # --- hot/cold (reference: specs/hot-cold-databases.md) ------------------
+
+    def _suspend_marker(self, name: str) -> str:
+        return os.path.join(self._db_config(name).durability_dir or "",
+                            "SUSPENDED")
+
+    def _clear_suspend_marker(self, name: str) -> None:
+        try:
+            os.remove(self._suspend_marker(name))
+        except OSError:
+            pass
+
+    def suspend(self, name: str) -> None:
+        """HOT -> COLD: persist a durable shell, drop the in-memory
+        storage. Never loses data (spec §2); not queryable until
+        resumed."""
+        if name == DEFAULT_DB:
+            raise QueryException(
+                "the default database cannot be suspended")
+        with self._lock:
+            if name in self._suspended:
+                return                  # idempotent (spec §4 SUSPEND|cold)
+            ictx = self._databases.get(name)
+            if ictx is None:
+                raise QueryException(f"database {name!r} does not exist")
+            cfg = ictx.storage.config
+            if not getattr(cfg, "durability_dir", None):
+                raise QueryException(
+                    f"database {name!r} has no durability directory — "
+                    f"suspending would lose its data")
+            # make the db invisible first; the (possibly long) snapshot
+            # runs OUTSIDE the handler lock so other tenants never stall
+            del self._databases[name]
+            self._suspended.add(name)
+        try:
+            from ..storage.durability.snapshot import create_snapshot
+            create_snapshot(ictx.storage)
+        except Exception:
+            with self._lock:            # undo: the db stays hot
+                self._suspended.discard(name)
+                self._databases[name] = ictx
+            raise
+        # sessions holding a USE DATABASE reference fail loudly now
+        ictx.storage.suspended = True
+        with open(self._suspend_marker(name), "w") as f:
+            f.write("cold\n")
+
+    def resume(self, name: str) -> None:
+        """COLD -> HOT: rebuild from the durable shell; blocks until the
+        database is queryable again. Idempotent on hot databases."""
+        with self._lock:
+            if name in self._databases:
+                return
+            if name not in self._suspended:
+                raise QueryException(f"database {name!r} does not exist")
+            self._suspended.discard(name)
+            self._clear_suspend_marker(name)
+            # recovery is NON-optional here even when the server skips it
+            # at startup: resuming without it would bring up an empty db
+            self._make(name, force_recover=True)
+
+    def database_states(self) -> list[tuple[str, str]]:
+        with self._lock:
+            rows = [(n, "hot") for n in self._databases]
+            rows += [(n, "suspended") for n in self._suspended]
+        return sorted(rows)
 
     def default(self):
         return self.get(DEFAULT_DB)

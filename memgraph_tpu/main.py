@@ -57,6 +57,10 @@ def build_config(argv=None) -> argparse.Namespace:
                    action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--query-modules-directory", default=None)
     p.add_argument("--auth-user-or-role-name-regex", default=".*")
+    p.add_argument("--auth-module-mappings", default="",
+                   help="external auth modules per Bolt scheme, e.g. "
+                        "'saml:/path/to/module.py;oidc:/path/other.py' "
+                        "(reference: src/auth/module.hpp)")
     p.add_argument("--monitoring-port", type=int, default=0,
                    help="Prometheus metrics HTTP port (0 = disabled)")
     p.add_argument("--audit-enabled",
@@ -186,11 +190,17 @@ def build_database(args) -> InterpreterContext:
         logging.info("management server on port %d", args.management_port)
 
     # auth store wired BEFORE the init file runs (single source of truth)
+    from .auth.module import parse_module_mappings
+    auth_modules = parse_module_mappings(args.auth_module_mappings)
     if args.data_directory:
         import os as _os
         _os.makedirs(args.data_directory, exist_ok=True)
         ictx.auth_store = Auth(
-            _os.path.join(args.data_directory, "auth.json"))
+            _os.path.join(args.data_directory, "auth.json"),
+            module_mappings=auth_modules)
+    elif auth_modules:
+        # SSO works without durable auth too (module-managed identities)
+        ictx.auth_store = Auth(module_mappings=auth_modules)
 
     if args.init_file:
         interp = Interpreter(ictx, system=True)

@@ -456,8 +456,16 @@ class SettingQuery:
 
 @dataclass
 class MultiDatabaseQuery:
-    action: str                 # create | drop | use | show
+    action: str        # create | drop | use | show | suspend | resume
     name: Optional[str] = None
+
+
+@dataclass
+class TenantProfileQuery:
+    action: str        # create | alter | drop | show | assign | clear
+    name: Optional[str] = None
+    limits: Optional[dict] = None      # key -> bytes | None (UNLIMITED)
+    database: Optional[str] = None
 
 
 @dataclass

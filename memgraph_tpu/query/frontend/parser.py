@@ -119,8 +119,27 @@ class Parser:
             self.advance()
             self.accept_kw("DATABASE")
             return A.MultiDatabaseQuery("use", name=self.name_token())
+        if self.at(T.IDENT) and self.cur.value.upper() in ("SUSPEND",
+                                                          "RESUME"):
+            # hot/cold tenants (reference: specs/hot-cold-databases.md)
+            action = self.advance().value.lower()
+            self.expect_kw("DATABASE")
+            return A.MultiDatabaseQuery(action, name=self.name_token())
+        if self.at(T.IDENT) and self.cur.value.upper() == "CLEAR" and \
+                self.peek().type == T.IDENT and \
+                self.peek().value.upper() == "TENANT":
+            self.advance()
+            return self.parse_tenant_profile("clear")
+        if self.at(T.IDENT) and self.cur.value.upper() == "ALTER" and \
+                self.peek().type == T.IDENT and \
+                self.peek().value.upper() == "TENANT":
+            self.advance()
+            return self.parse_tenant_profile("alter")
         if self.at_kw("CREATE"):
             nxt = self.peek()
+            if nxt.type == T.IDENT and nxt.value.upper() == "TENANT":
+                self.advance()
+                return self.parse_tenant_profile("create")
             if nxt.is_kw("DATABASE"):
                 self.advance(); self.advance()
                 return A.MultiDatabaseQuery("create", name=self.name_token())
@@ -162,6 +181,9 @@ class Parser:
             return self.parse_cypher_query()
         if self.at_kw("DROP"):
             nxt = self.peek()
+            if nxt.type == T.IDENT and nxt.value.upper() == "TENANT":
+                self.advance()
+                return self.parse_tenant_profile("drop")
             if nxt.is_kw("INDEX"):
                 return self.parse_drop_index()
             if nxt.is_kw("EDGE"):
@@ -240,6 +262,9 @@ class Parser:
                 self.expect_kw("TO")
                 self.expect_kw("MAIN")
                 return A.CoordinatorQuery("set_main", name=name)
+            if nxt.type == T.IDENT and nxt.value.upper() == "TENANT":
+                self.advance()
+                return self.parse_tenant_profile("assign")
             if nxt.is_kw("GLOBAL", "SESSION", "NEXT"):
                 return self.parse_isolation_or_storage()
             if nxt.is_kw("STORAGE"):
@@ -520,6 +545,22 @@ class Parser:
         if self.at(T.IDENT) and self.cur.value.upper() == "USERS":
             self.advance()
             return A.AuthQuery("show_users")
+        if self.at(T.IDENT) and self.cur.value.upper() == "TENANT":
+            self.advance()
+            if not (self.at_kw("PROFILE") or (
+                    self.at(T.IDENT) and self.cur.value.upper()
+                    in ("PROFILE", "PROFILES"))):
+                self.error("expected PROFILE(S) after SHOW TENANT")
+            plural = self.advance().value.upper() == "PROFILES"
+            name = None if plural else self.name_token()
+            return A.TenantProfileQuery("show", name=name)
+        if self.at(T.IDENT) and self.cur.value.upper() == "CURRENT":
+            self.advance()
+            if self.at_kw("USER") or (self.at(T.IDENT)
+                                      and self.cur.value.upper() == "USER"):
+                self.advance()
+                return A.AuthQuery("show_current_user")
+            self.error("expected USER after SHOW CURRENT")
         if self.at(T.IDENT) and self.cur.value.upper() == "ROLES":
             self.advance()
             return A.AuthQuery("show_roles")
@@ -740,6 +781,57 @@ class Parser:
             self.advance()
             mem = self.parse_memory_limit()
         return A.CypherQuery(first, unions, memory_limit=mem)
+
+    def parse_tenant_profile(self, action: str) -> "A.TenantProfileQuery":
+        """TENANT PROFILE grammar (reference MemgraphCypher.g4:995-1001):
+        CREATE TENANT PROFILE p LIMIT k v[, ...] / ALTER ... SET ... /
+        DROP TENANT PROFILE p / SET TENANT PROFILE ON DATABASE db TO p /
+        CLEAR TENANT PROFILE ON DATABASE db. Caller consumed the leading
+        verb; cursor sits at TENANT."""
+        self.advance()                  # TENANT
+        if not (self.at_kw("PROFILE") or (
+                self.at(T.IDENT)
+                and self.cur.value.upper() == "PROFILE")):
+            self.error("expected PROFILE after TENANT")
+        self.advance()
+        if action == "assign":
+            self.expect_kw("ON")
+            self.expect_kw("DATABASE")
+            db = self.name_token()
+            self.expect_kw("TO")
+            return A.TenantProfileQuery("assign", name=self.name_token(),
+                                        database=db)
+        if action == "clear":
+            self.expect_kw("ON")
+            self.expect_kw("DATABASE")
+            return A.TenantProfileQuery("clear",
+                                        database=self.name_token())
+        name = self.name_token()
+        if action == "drop":
+            return A.TenantProfileQuery("drop", name=name)
+        if action == "create":
+            self.expect_kw("LIMIT")
+        else:                           # alter
+            self.expect_kw("SET")
+        return A.TenantProfileQuery(action, name=name,
+                                    limits=self.parse_limit_list())
+
+    def parse_limit_list(self) -> dict:
+        """k v pairs: `memory_limit 100MB, ...`; UNLIMITED -> None."""
+        limits: dict = {}
+        while True:
+            key = self.name_token().lower()
+            if self.accept_kw("UNLIMITED"):
+                limits[key] = None
+            else:
+                amount = self.expect(T.INT).value
+                if self.at(T.IDENT) and self.cur.value.upper() in ("MB",
+                                                                   "KB"):
+                    unit = self.advance().value.upper()
+                    amount *= 1024 * 1024 if unit == "MB" else 1024
+                limits[key] = amount
+            if not self.accept(","):
+                return limits
 
     def parse_memory_limit(self) -> "Optional[int]":
         self.expect_kw("MEMORY")

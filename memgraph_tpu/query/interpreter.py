@@ -212,6 +212,8 @@ class Interpreter:
             return self._prepare_coordinator(node)
         if isinstance(node, A.MultiDatabaseQuery):
             return self._prepare_multidb(node)
+        if isinstance(node, A.TenantProfileQuery):
+            return self._prepare_tenant_profile(node)
         if isinstance(node, A.SettingQuery):
             return self._prepare_setting(node)
         if isinstance(node, A.EnumQuery):
@@ -417,6 +419,38 @@ class Interpreter:
                                        ["setting_name", "setting_value"],
                                        "r")
 
+    def _prepare_tenant_profile(self, node) -> PreparedQuery:
+        """Tenant profiles (reference: dbms/tenant_profiles.cpp)."""
+        dbms = getattr(self.ctx, "dbms", None)
+        if dbms is None:
+            raise QueryException(
+                "tenant profiles require a DbmsHandler (enabled "
+                "automatically by the server entry point)")
+        profiles = dbms.tenant_profiles
+        if node.action == "create":
+            profiles.create(node.name, node.limits or {})
+        elif node.action == "alter":
+            profiles.alter(node.name, node.limits or {})
+        elif node.action == "drop":
+            profiles.drop(node.name)
+        elif node.action == "assign":
+            if node.database not in dbms.names():
+                raise QueryException(
+                    f"database {node.database!r} does not exist")
+            profiles.assign(node.database, node.name)
+        elif node.action == "clear":
+            profiles.clear(node.database)
+        elif node.action == "show":
+            import json as _json
+            rows = [[name, _json.dumps(limits), dbs]
+                    for name, limits, dbs in profiles.show(node.name)]
+            return self._prepare_generator(
+                iter(rows), ["profile", "limits", "databases"], "r")
+        else:
+            raise SemanticException(
+                f"unknown tenant profile action {node.action}")
+        return self._prepare_generator(iter([]), [], "s")
+
     def _prepare_multidb(self, node: A.MultiDatabaseQuery) -> PreparedQuery:
         dbms = getattr(self.ctx, "dbms", None)
         if dbms is None:
@@ -442,6 +476,18 @@ class Interpreter:
             self.ctx = target
             return self._prepare_generator(
                 iter([[f"Using database {node.name}."]]), ["status"], "s")
+        if node.action == "suspend":
+            dbms.suspend(node.name)
+            self._publish_system("db_suspend", {"name": node.name})
+            return self._prepare_generator(
+                iter([[f"Database {node.name} suspended."]]),
+                ["status"], "s")
+        if node.action == "resume":
+            dbms.resume(node.name)
+            self._publish_system("db_resume", {"name": node.name})
+            return self._prepare_generator(
+                iter([[f"Database {node.name} resumed."]]),
+                ["status"], "s")
         if node.action == "show":
             current = getattr(self.ctx, "database_name", "memgraph")
             rows = [[name, name == current] for name in dbms.names()]
@@ -526,6 +572,7 @@ class Interpreter:
         "AuthQuery": "AUTH", "ReplicationQuery": "REPLICATION",
         "StreamQuery": "STREAM", "SnapshotQuery": "DURABILITY",
         "DumpQuery": "DUMP", "MultiDatabaseQuery": "MULTI_DATABASE_EDIT",
+        "TenantProfileQuery": "MULTI_DATABASE_EDIT",
         "TtlQuery": "CONFIG", "SettingQuery": "CONFIG",
         "CoordinatorQuery": "COORDINATOR",
         "TerminateTransactionsQuery": "TRANSACTION_MANAGEMENT",
@@ -709,10 +756,18 @@ class Interpreter:
                     f"query exceeded timeout of {timeout}s")
 
         from ..utils.memory_tracker import QueryMemoryTracker
+        mem_limit = query.memory_limit
+        if mem_limit is None:
+            # the database's tenant profile caps queries by default
+            # (reference: tenant_profiles.cpp memory_limit)
+            dbms = getattr(self.ctx, "dbms", None)
+            if dbms is not None:
+                mem_limit = dbms.tenant_profiles.limit_for_database(
+                    getattr(self.ctx, "database_name", ""),
+                    "memory_limit")
         exec_ctx = ExecutionContext(accessor, parameters,
                                     View.NEW, self.ctx, timeout_checker,
-                                    memory=QueryMemoryTracker(
-                                        query.memory_limit))
+                                    memory=QueryMemoryTracker(mem_limit))
         exec_ctx.eval_ctx.username = self.username
         if owns:
             exec_ctx._txn_owner = _TxnOwner(self, exec_ctx)
@@ -1160,6 +1215,9 @@ class Interpreter:
         elif node.action == "show_users":
             return self._prepare_generator(
                 iter([[u] for u in auth.users()]), ["user"], "r")
+        elif node.action == "show_current_user":
+            return self._prepare_generator(
+                iter([[self.username or None]]), ["user"], "r")
         elif node.action == "show_roles":
             return self._prepare_generator(
                 iter([[r] for r in auth.roles()]), ["role"], "r")

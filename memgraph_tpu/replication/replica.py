@@ -197,6 +197,16 @@ class ReplicaServer:
                         dbms.create(name)
                 if kind == "db_drop" and data["name"] in dbms.names():
                     dbms.drop(data["name"])
+        if kind in ("db_suspend", "db_resume") and ictx is not None:
+            dbms = getattr(ictx, "dbms", None)
+            if dbms is not None:
+                try:
+                    if kind == "db_suspend":
+                        dbms.suspend(data["name"])
+                    else:
+                        dbms.resume(data["name"])
+                except Exception:  # noqa: BLE001 — idempotent replays
+                    pass
         if seq:
             self.last_system_seq = seq
 

@@ -413,14 +413,26 @@ class BoltSession:
         else:
             principal = extra.get("principal", "")
             credentials = extra.get("credentials", "")
-            if self.auth is not None and not self.auth.authenticate(
+            scheme = (extra.get("scheme") or "basic").lower()
+            if self.auth is not None and scheme not in ("basic", "none"):
+                username = self.auth.authenticate_external(
+                    scheme, principal, credentials)
+                if username is None:
+                    self.send_failure(
+                        "Memgraph.ClientError.Security.Unauthenticated",
+                        f"authentication failure (scheme {scheme!r})")
+                    return True
+                self.authenticated = True
+                self.interpreter.username = username
+            elif self.auth is not None and not self.auth.authenticate(
                     principal, credentials):
                 self.send_failure(
                     "Memgraph.ClientError.Security.Unauthenticated",
                     "authentication failure")
                 return True
-            self.authenticated = True
-            self.interpreter.username = principal
+            else:
+                self.authenticated = True
+                self.interpreter.username = principal
         self.send_success({
             "server": "Neo4j/5.2.0 compatible (memgraph-tpu)",
             "connection_id": "bolt-1",
@@ -430,6 +442,22 @@ class BoltSession:
     def on_logon(self, auth_data: dict) -> bool:
         principal = auth_data.get("principal", "")
         credentials = auth_data.get("credentials", "")
+        scheme = (auth_data.get("scheme") or "basic").lower()
+        if self.auth is not None and scheme != "basic" \
+                and scheme != "none":
+            # SSO/external scheme: routed through the mapped auth module
+            # (reference: --auth-module-mappings, auth/module.hpp)
+            username = self.auth.authenticate_external(
+                scheme, principal, credentials)
+            if username is None:
+                self.send_failure(
+                    "Memgraph.ClientError.Security.Unauthenticated",
+                    f"authentication failure (scheme {scheme!r})")
+                return True
+            self.authenticated = True
+            self.interpreter.username = username
+            self.send_success({})
+            return True
         if self.auth is not None and not self.auth.authenticate(
                 principal, credentials):
             self.send_failure(

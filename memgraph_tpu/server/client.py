@@ -27,7 +27,7 @@ class BoltClientError(MemgraphTpuError):
 class BoltClient:
     def __init__(self, host="127.0.0.1", port=7687, username="",
                  password="", timeout=30.0, versions=None,
-                 encrypted=False, ca_file=None):
+                 encrypted=False, ca_file=None, scheme="basic"):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         if encrypted:  # bolt+s: TLS from the first byte
             from ..utils.tls import client_context
@@ -36,7 +36,7 @@ class BoltClient:
                 self.sock, server_hostname=host)
         self._versions = versions or ((5, 2), (5, 0), (4, 4), (4, 3))
         self._handshake()
-        self._hello(username, password)
+        self._hello(username, password, scheme)
 
     # --- wire ---------------------------------------------------------------
 
@@ -95,15 +95,15 @@ class BoltClient:
 
     # --- protocol -----------------------------------------------------------
 
-    def _hello(self, username, password):
+    def _hello(self, username, password, scheme="basic"):
         extra = {"user_agent": "memgraph-tpu-client/0.1"}
         if self.version < (5, 1):
-            extra.update({"scheme": "basic", "principal": username,
+            extra.update({"scheme": scheme, "principal": username,
                           "credentials": password})
         self._send_message(M_HELLO, extra)
         self._expect_success()
         if self.version >= (5, 1):
-            self._send_message(M_LOGON, {"scheme": "basic",
+            self._send_message(M_LOGON, {"scheme": scheme,
                                          "principal": username,
                                          "credentials": password})
             self._expect_success()

@@ -742,6 +742,11 @@ class InMemoryStorage:
     # --- transactions -------------------------------------------------------
 
     def access(self, isolation: Optional[IsolationLevel] = None) -> Accessor:
+        if getattr(self, "suspended", False):
+            # a session that kept its USE DATABASE reference across a
+            # SUSPEND must fail loudly, not write into an orphaned store
+            raise StorageError(
+                "this database is suspended; RESUME it first")
         return Accessor(self, isolation or self.config.isolation_level)
 
     def _begin_transaction(self, isolation: IsolationLevel) -> Transaction:

@@ -1,0 +1,118 @@
+"""External (SSO) auth modules: subprocess JSON protocol + Bolt scheme
+routing. Reference: src/auth/module.hpp:30, auth/reference_modules/.
+"""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from memgraph_tpu.auth.auth import Auth
+from memgraph_tpu.auth.module import AuthModule, parse_module_mappings
+
+MODULE = os.path.join(os.path.dirname(__file__), "..", "memgraph_tpu",
+                      "auth", "reference_modules", "userfile.py")
+
+
+@pytest.fixture
+def userfile_module(tmp_path):
+    users = {"users": {"ann": {"password": "s3cret", "role": "analyst"},
+                       "root": {"password": "pw", "role": "admin"}}}
+    ufile = tmp_path / "users.json"
+    ufile.write_text(json.dumps(users))
+    # wrapper script so the module finds its config and interpreter
+    wrapper = tmp_path / "module.sh"
+    wrapper.write_text(
+        f"#!/bin/sh\nAUTH_USERFILE={ufile} exec {sys.executable} "
+        f"{os.path.abspath(MODULE)}\n")
+    wrapper.chmod(wrapper.stat().st_mode | stat.S_IEXEC)
+    return str(wrapper)
+
+
+def test_module_protocol_roundtrip(userfile_module):
+    mod = AuthModule(userfile_module)
+    try:
+        ok = mod.call({"scheme": "saml", "username": "ann",
+                       "response": "s3cret"})
+        assert ok == {"authenticated": True, "username": "ann",
+                      "role": "analyst"}
+        bad = mod.call({"scheme": "saml", "username": "ann",
+                        "response": "wrong"})
+        assert bad["authenticated"] is False
+        # the subprocess stays alive across calls
+        again = mod.call({"scheme": "saml", "username": "root",
+                          "response": "pw"})
+        assert again["authenticated"] is True
+    finally:
+        mod.close()
+
+
+def test_auth_external_creates_user_with_role(userfile_module, tmp_path):
+    auth = Auth(str(tmp_path / "auth.json"),
+                module_mappings=parse_module_mappings(
+                    f"saml:{userfile_module}"))
+    assert auth.authenticate_external("saml", "ann", "s3cret") == "ann"
+    assert "ann" in auth.users()
+    assert auth.user_roles("ann") == ["analyst"]
+    # wrong credentials denied; unknown scheme denied
+    assert auth.authenticate_external("saml", "ann", "nope") is None
+    assert auth.authenticate_external("oidc", "ann", "s3cret") is None
+
+
+def test_module_timeout_denies(tmp_path):
+    hang = tmp_path / "hang.sh"
+    hang.write_text("#!/bin/sh\nsleep 60\n")
+    hang.chmod(hang.stat().st_mode | stat.S_IEXEC)
+    mod = AuthModule(str(hang), timeout=0.5)
+    try:
+        assert mod.call({"username": "x"}) is None
+    finally:
+        mod.close()
+
+
+def test_malformed_module_reply_denies(tmp_path):
+    bad = tmp_path / "bad.sh"
+    bad.write_text("#!/bin/sh\nwhile read line; do echo 'not json'; done\n")
+    bad.chmod(bad.stat().st_mode | stat.S_IEXEC)
+    auth = Auth(module_mappings=parse_module_mappings(f"x:{bad}"))
+    assert auth.authenticate_external("x", "ann", "pw") is None
+
+
+def test_bolt_logon_routes_scheme(userfile_module, tmp_path):
+    import asyncio
+    import socket
+    import threading
+    from memgraph_tpu.query.interpreter import InterpreterContext
+    from memgraph_tpu.server.bolt import BoltServer
+    from memgraph_tpu.server.client import BoltClient, BoltClientError
+    from memgraph_tpu.storage import InMemoryStorage
+
+    ictx = InterpreterContext(InMemoryStorage())
+    auth = Auth(str(tmp_path / "auth.json"),
+                module_mappings=parse_module_mappings(
+                    f"saml:{userfile_module}"))
+    auth.create_user("admin", "adminpw")   # first user = admin
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    server = BoltServer(ictx, "127.0.0.1", port, auth=auth)
+    thread, loop = server.run_in_thread()
+    try:
+        # SSO login via the module-backed scheme
+        c = BoltClient(port=port, username="ann", password="s3cret",
+                       scheme="saml")
+        _, rows, _ = c.execute("SHOW CURRENT USER")
+        c.close()
+        assert rows and rows[0][0] == "ann"
+        # wrong SSO credentials rejected
+        with pytest.raises(BoltClientError):
+            BoltClient(port=port, username="ann", password="wrong",
+                       scheme="saml")
+        # basic scheme still works
+        c = BoltClient(port=port, username="admin", password="adminpw")
+        c.execute("RETURN 1")
+        c.close()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)

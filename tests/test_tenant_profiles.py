@@ -1,0 +1,108 @@
+"""Tenant profiles + hot/cold database suspend.
+
+References: /root/reference/src/dbms/tenant_profiles.cpp,
+specs/hot-cold-databases.md, MemgraphCypher.g4:995-1001.
+"""
+
+import pytest
+
+from memgraph_tpu.exceptions import QueryException
+from memgraph_tpu.utils.memory_tracker import MemoryLimitException
+from memgraph_tpu.query.interpreter import Interpreter
+from memgraph_tpu.storage import StorageConfig
+
+
+@pytest.fixture
+def dbms(tmp_path):
+    from memgraph_tpu.dbms.dbms import DbmsHandler
+    return DbmsHandler(StorageConfig(durability_dir=str(tmp_path),
+                                     wal_enabled=True))
+
+
+def run(ictx, q, params=None):
+    _, rows, _ = Interpreter(ictx).execute(q, params)
+    return rows
+
+
+def test_tenant_profile_ddl_and_show(dbms):
+    root = dbms.default()
+    run(root, "CREATE TENANT PROFILE small LIMIT memory_limit 10MB")
+    run(root, "CREATE DATABASE t1")
+    run(root, "SET TENANT PROFILE ON DATABASE t1 TO small")
+    rows = run(root, "SHOW TENANT PROFILES")
+    assert rows[0][0] == "small" and "10485760" in rows[0][1]
+    assert rows[0][2] == ["t1"]
+    run(root, "ALTER TENANT PROFILE small SET memory_limit 5MB")
+    rows = run(root, "SHOW TENANT PROFILE small")
+    assert "5242880" in rows[0][1]
+    run(root, "CLEAR TENANT PROFILE ON DATABASE t1")
+    assert run(root, "SHOW TENANT PROFILES")[0][2] == []
+    run(root, "DROP TENANT PROFILE small")
+    with pytest.raises(QueryException):
+        run(root, "SHOW TENANT PROFILE small")
+
+
+def test_profile_memory_limit_enforced(dbms):
+    root = dbms.default()
+    run(root, "CREATE DATABASE small_db")
+    run(root, "CREATE TENANT PROFILE tiny LIMIT memory_limit 300KB")
+    run(root, "SET TENANT PROFILE ON DATABASE small_db TO tiny")
+    ictx = dbms.get("small_db")
+    # a memory-hungry query trips the profile's default cap
+    with pytest.raises(MemoryLimitException):
+        run(ictx, "UNWIND range(1, 200000) AS i "
+                  "WITH collect(i) AS xs RETURN size(xs)")
+    # the same query on an unprofiled database is fine
+    assert run(root, "UNWIND range(1, 200000) AS i "
+                     "WITH collect(i) AS xs RETURN size(xs)") == [[200000]]
+    # explicit QUERY MEMORY LIMIT still wins over the profile
+    assert run(ictx, "RETURN 1 QUERY MEMORY LIMIT 100 MB") == [[1]]
+
+
+def test_profiles_survive_restart(tmp_path):
+    from memgraph_tpu.dbms.dbms import DbmsHandler
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    d1 = DbmsHandler(cfg)
+    run(d1.default(), "CREATE TENANT PROFILE p LIMIT memory_limit 1MB")
+    d2 = DbmsHandler(StorageConfig(durability_dir=str(tmp_path),
+                                   wal_enabled=True))
+    rows = run(d2.default(), "SHOW TENANT PROFILES")
+    assert rows and rows[0][0] == "p"
+
+
+def test_suspend_resume_cycle(dbms):
+    root = dbms.default()
+    run(root, "CREATE DATABASE tenant_a")
+    ictx = dbms.get("tenant_a")
+    run(ictx, "CREATE (:Keep {v: 41}), (:Keep {v: 1})")
+    run(root, "SUSPEND DATABASE tenant_a")
+    # cold: not queryable, still listed
+    with pytest.raises(QueryException, match="suspended"):
+        dbms.get("tenant_a")
+    assert "tenant_a" in dbms.names()
+    assert ("tenant_a", "suspended") in dbms.database_states()
+    # suspend is idempotent; default cannot be suspended
+    run(root, "SUSPEND DATABASE tenant_a")
+    with pytest.raises(QueryException):
+        run(root, "SUSPEND DATABASE memgraph")
+    # resume restores the exact data
+    run(root, "RESUME DATABASE tenant_a")
+    ictx = dbms.get("tenant_a")
+    assert run(ictx, "MATCH (k:Keep) RETURN sum(k.v)") == [[42]]
+
+
+def test_suspended_state_survives_restart(tmp_path):
+    from memgraph_tpu.dbms.dbms import DbmsHandler
+    cfg = StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+    d1 = DbmsHandler(cfg)
+    run(d1.default(), "CREATE DATABASE cold_t")
+    run(d1.get("cold_t"), "CREATE (:X {v: 7})")
+    run(d1.default(), "SUSPEND DATABASE cold_t")
+
+    d2 = DbmsHandler(StorageConfig(durability_dir=str(tmp_path),
+                                   wal_enabled=True))
+    assert ("cold_t", "suspended") in d2.database_states()
+    with pytest.raises(QueryException, match="suspended"):
+        d2.get("cold_t")
+    run(d2.default(), "RESUME DATABASE cold_t")
+    assert run(d2.get("cold_t"), "MATCH (x:X) RETURN x.v") == [[7]]
