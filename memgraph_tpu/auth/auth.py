@@ -112,6 +112,10 @@ class Auth:
                 if role not in self._roles:
                     self._roles[role] = Role(role)
                 user.roles = [role]
+            else:
+                # the module is authoritative on EVERY login: a reply
+                # without a role revokes previous module-granted roles
+                user.roles = []
             self._save()
         return username
 

@@ -31,12 +31,14 @@ def main():
         try:
             req = json.loads(line)
             username = req.get("username", "")
-            if username:
+            password = req.get("response", "")
+            # empty password would perform an ANONYMOUS bind, which most
+            # LDAP servers accept — deny before binding
+            if username and password:
                 dn = cfg.get("prefix", "") + \
                     ldap3.utils.dn.escape_rdn(username) + \
                     cfg.get("suffix", "")
-                conn = ldap3.Connection(server, dn,
-                                        req.get("response", ""))
+                conn = ldap3.Connection(server, dn, password)
                 if conn.bind():
                     reply = {"authenticated": True, "username": username}
                     base = cfg.get("role_base")

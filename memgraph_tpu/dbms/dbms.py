@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from ..exceptions import QueryException
 from ..storage import InMemoryStorage, StorageConfig
@@ -215,10 +216,10 @@ class DbmsHandler:
             if name in self._suspended:
                 self._suspended.discard(name)
                 self._clear_suspend_marker(name)
-                return
-            if name not in self._databases:
+            elif name in self._databases:
+                del self._databases[name]
+            else:
                 raise QueryException(f"database {name!r} does not exist")
-            del self._databases[name]
         # a recreated same-name database must not inherit the old limits
         profiles = getattr(self, "tenant_profiles", None)
         if profiles is not None:
@@ -262,18 +263,34 @@ class DbmsHandler:
             # runs OUTSIDE the handler lock so other tenants never stall
             del self._databases[name]
             self._suspended.add(name)
+        # gate BEFORE snapshotting: sessions holding a USE DATABASE
+        # reference can no longer open transactions, and in-flight ones
+        # must drain — a commit racing the snapshot would be silently
+        # lost on resume ("never loses data", spec §2)
+        ictx.storage.suspended = True
         try:
+            deadline = time.monotonic() + 30.0
+            while getattr(ictx.storage, "_active_txns", None):
+                if time.monotonic() > deadline:
+                    raise QueryException(
+                        f"cannot suspend {name!r}: transactions did not "
+                        f"drain within 30s")
+                time.sleep(0.01)
             from ..storage.durability.snapshot import create_snapshot
             create_snapshot(ictx.storage)
         except Exception:
             with self._lock:            # undo: the db stays hot
+                ictx.storage.suspended = False
                 self._suspended.discard(name)
                 self._databases[name] = ictx
             raise
-        # sessions holding a USE DATABASE reference fail loudly now
-        ictx.storage.suspended = True
-        with open(self._suspend_marker(name), "w") as f:
-            f.write("cold\n")
+        with self._lock:
+            # a concurrent RESUME may have re-made the db while we
+            # snapshotted; its fresh instance wins — no stale marker
+            if name not in self._suspended:
+                return
+            with open(self._suspend_marker(name), "w") as f:
+                f.write("cold\n")
 
     def resume(self, name: str) -> None:
         """COLD -> HOT: rebuild from the durable shell; blocks until the

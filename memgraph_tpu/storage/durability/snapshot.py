@@ -159,7 +159,10 @@ def create_snapshot(storage) -> str:
     concurrent DDL; concurrent txn writes carry uncommitted deltas which
     are skipped via the delta==None fast path or materialized as OLD).
     """
-    acc = storage.access()
+    # direct Accessor construction: access() is gated for SUSPENDED
+    # databases, but the suspend path itself snapshots through here
+    from ..storage import Accessor
+    acc = Accessor(storage, storage.config.isolation_level)
     try:
         ts = acc.txn.start_ts
         buf = BytesIO()

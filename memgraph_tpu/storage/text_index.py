@@ -1,4 +1,4 @@
-"""Full-text search index (BM25 inverted index).
+"""Full-text search index (BM25 inverted index with positions).
 
 Counterpart of the reference's tantivy-backed text index
 (/root/reference/src/storage/v2/indices/text_index.cpp via the mgcxx Rust
@@ -8,6 +8,15 @@ with BM25 ranking; a C++ backend slots behind the same interface).
 Indexes all string properties of vertices with a given label. Exposed via
 the text_search module procedures (text_search.search, matching the
 reference's query_modules/text_search_module.cpp surface).
+
+Query language (the tantivy subset the reference exposes):
+  term term         OR of terms (default)
+  "a b c"           phrase (consecutive positions)
+  a AND b, a OR b   boolean operators (AND binds tighter)
+  NOT a             negation (filters the candidate set)
+  ( ... )           grouping
+Ranking is BM25 over the query's positive terms; boolean structure
+selects the candidate documents.
 """
 
 from __future__ import annotations
@@ -18,6 +27,7 @@ import threading
 from collections import Counter, defaultdict
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
+_QUERY_RE = re.compile(r'"[^"]*"|\(|\)|[^\s()]+')
 
 
 def tokenize_text(text: str) -> list[str]:
@@ -36,34 +46,51 @@ class TextIndex:
         self.label_id = label_id
         self.property_ids = property_ids  # None = all string properties
         self._lock = threading.Lock()
-        self._postings: dict[str, dict[int, int]] = defaultdict(dict)
+        # term -> {gid: (tf, positions)} — positions enable phrases
+        self._postings: dict[str, dict[int, tuple[int, list[int]]]] = \
+            defaultdict(dict)
         self._doc_len: dict[int, int] = {}
         self._total_len = 0
 
     # --- maintenance --------------------------------------------------------
 
-    def _document_tokens(self, vertex) -> list[str]:
-        tokens: list[str] = []
-        for pid, value in vertex.properties.items():
+    # gap between properties so phrases never match across field
+    # boundaries (tantivy has per-field postings; a gap is the compact
+    # equivalent for our concatenated layout)
+    FIELD_GAP = 1000
+
+    def _document_positions(self, vertex):
+        """[(term, position)] with inter-property gaps; and token count."""
+        out = []
+        pos = 0
+        count = 0
+        for pid, value in sorted(vertex.properties.items()):
             if self.property_ids is not None and pid not in self.property_ids:
                 continue
             if isinstance(value, str):
-                tokens.extend(tokenize_text(value))
-        return tokens
+                toks = tokenize_text(value)
+                for t in toks:
+                    out.append((t, pos))
+                    pos += 1
+                count += len(toks)
+                pos += self.FIELD_GAP
+        return out, count
 
     def add_vertex(self, vertex) -> None:
         if self.label_id not in vertex.labels or vertex.deleted:
             return
-        tokens = self._document_tokens(vertex)
+        term_positions, n_tokens = self._document_positions(vertex)
         with self._lock:
             self._remove_locked(vertex.gid)
-            if not tokens:
+            if not term_positions:
                 return
-            counts = Counter(tokens)
-            for term, tf in counts.items():
-                self._postings[term][vertex.gid] = tf
-            self._doc_len[vertex.gid] = len(tokens)
-            self._total_len += len(tokens)
+            positions: dict[str, list[int]] = defaultdict(list)
+            for term, pos in term_positions:
+                positions[term].append(pos)
+            for term, plist in positions.items():
+                self._postings[term][vertex.gid] = (len(plist), plist)
+            self._doc_len[vertex.gid] = n_tokens
+            self._total_len += n_tokens
 
     def remove_vertex(self, gid: int) -> None:
         with self._lock:
@@ -88,32 +115,197 @@ class TextIndex:
     # --- search -------------------------------------------------------------
 
     def search(self, query: str, limit: int = 10) -> list[tuple[int, float]]:
-        """BM25-ranked [(gid, score)] for the query terms (OR semantics)."""
-        terms = tokenize_text(query)
+        """BM25-ranked [(gid, score)] for a boolean/phrase query."""
         with self._lock:
             n_docs = len(self._doc_len)
-            if not n_docs or not terms:
+            if not n_docs:
+                return []
+            try:
+                node = _parse_query(query)
+            except _QuerySyntaxError:
+                from ..exceptions import QueryException
+                raise QueryException(
+                    f"invalid text search query: {query!r}")
+            if node is None:
+                return []
+            docs, positive = node.evaluate(self)
+            if not docs:
                 return []
             avg_len = self._total_len / n_docs
             scores: dict[int, float] = defaultdict(float)
-            for term in terms:
-                docs = self._postings.get(term)
-                if not docs:
+            for term in positive:
+                entries = self._postings.get(term)
+                if not entries:
                     continue
-                idf = math.log(1 + (n_docs - len(docs) + 0.5)
-                               / (len(docs) + 0.5))
-                for gid, tf in docs.items():
+                idf = math.log(1 + (n_docs - len(entries) + 0.5)
+                               / (len(entries) + 0.5))
+                for gid, (tf, _pos) in entries.items():
+                    if gid not in docs:
+                        continue
                     dl = self._doc_len[gid]
                     denom = tf + self.K1 * (1 - self.B
                                             + self.B * dl / avg_len)
                     scores[gid] += idf * tf * (self.K1 + 1) / denom
+            for gid in docs:
+                scores.setdefault(gid, 0.0)   # pure-NOT / filter matches
             ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
             return ranked[:limit]
+
+    # caller holds self._lock
+    def _docs_for_term(self, term: str) -> set[int]:
+        return set(self._postings.get(term, ()))
+
+    def _docs_for_phrase(self, terms: list[str]) -> set[int]:
+        """Docs where the terms occur at consecutive positions."""
+        if not terms:
+            return set()
+        if len(terms) == 1:
+            return self._docs_for_term(terms[0])
+        entries = [self._postings.get(t) for t in terms]
+        if any(e is None for e in entries):
+            return set()
+        candidates = set(entries[0])
+        for e in entries[1:]:
+            candidates &= set(e)
+        out = set()
+        for gid in candidates:
+            psets = [set(e[gid][1]) for e in entries]
+            if any(all((p + i) in psets[i]
+                       for i in range(1, len(terms)))
+                   for p in psets[0]):
+                out.add(gid)
+        return out
+
+    def _all_docs(self) -> set[int]:
+        return set(self._doc_len)
 
     def info(self) -> dict:
         with self._lock:
             return {"name": self.name, "documents": len(self._doc_len),
                     "terms": len(self._postings)}
+
+
+# --- query language ---------------------------------------------------------
+
+class _QuerySyntaxError(Exception):
+    pass
+
+
+class _Term:
+    def __init__(self, term):
+        self.term = term
+
+    def evaluate(self, index):
+        return index._docs_for_term(self.term), {self.term}
+
+
+class _Phrase:
+    def __init__(self, terms):
+        self.terms = terms
+
+    def evaluate(self, index):
+        return index._docs_for_phrase(self.terms), set(self.terms)
+
+
+class _Bool:
+    def __init__(self, op, left, right):
+        self.op, self.left, self.right = op, left, right
+
+    def evaluate(self, index):
+        ld, lp = self.left.evaluate(index)
+        rd, rp = self.right.evaluate(index)
+        if self.op == "AND":
+            return ld & rd, lp | rp
+        return ld | rd, lp | rp
+
+
+class _Nothing:
+    def evaluate(self, index):
+        return set(), set()
+
+
+class _Not:
+    def __init__(self, child):
+        self.child = child
+
+    def evaluate(self, index):
+        cd, _ = self.child.evaluate(index)
+        return index._all_docs() - cd, set()
+
+
+def _parse_query(query: str):
+    tokens = _QUERY_RE.findall(query)
+    pos = [0]
+
+    def peek():
+        return tokens[pos[0]] if pos[0] < len(tokens) else None
+
+    def advance():
+        tok = tokens[pos[0]]
+        pos[0] += 1
+        return tok
+
+    def parse_or():
+        node = parse_and()
+        while True:
+            tok = peek()
+            if tok is None or tok == ")":
+                return node
+            if tok.upper() == "OR":
+                advance()
+                node = _Bool("OR", node, parse_and())
+            elif tok.upper() == "AND":
+                return node      # handled by parse_and of the caller
+            else:
+                # bare adjacency = OR (tantivy default)
+                node = _Bool("OR", node, parse_and())
+
+    def parse_and():
+        node = parse_not()
+        while peek() is not None and peek().upper() == "AND":
+            advance()
+            node = _Bool("AND", node, parse_not())
+        return node
+
+    def parse_not():
+        tok = peek()
+        if tok is not None and tok.upper() == "NOT":
+            advance()
+            return _Not(parse_not())
+        return parse_primary()
+
+    def parse_primary():
+        tok = peek()
+        if tok is None:
+            raise _QuerySyntaxError("unexpected end of query")
+        if tok == "(":
+            advance()
+            node = parse_or()
+            if peek() != ")":
+                raise _QuerySyntaxError("missing )")
+            advance()
+            return node
+        if tok == ")":
+            raise _QuerySyntaxError("unexpected )")
+        advance()
+        if tok.startswith('"'):
+            terms = tokenize_text(tok.strip('"'))
+            if not terms:
+                return _Nothing()   # punctuation-only: matches no docs
+            return _Phrase(terms)
+        terms = tokenize_text(tok)
+        if not terms:
+            return _Nothing()       # e.g. '???' — old behavior: no rows
+        if len(terms) > 1:
+            return _Phrase(terms)    # e.g. hyphenated-word
+        return _Term(terms[0])
+
+    if not tokens:
+        return None
+    node = parse_or()
+    if peek() is not None:
+        raise _QuerySyntaxError(f"trailing input at {peek()!r}")
+    return node
 
 
 class TextIndices:

@@ -213,3 +213,44 @@ def test_load_parquet(db, tmp_path):
     rows = run(db, f"LOAD PARQUET FROM '{f}' AS row "
                    f"RETURN row.x, row.y ORDER BY row.x")
     assert rows == [[1, "a"], [2, "b"], [3, "c"]]
+
+
+def test_text_search_phrases_and_booleans(db):
+    """tantivy-subset query language: phrases, AND/OR/NOT, grouping
+    (reference: text_index.cpp query parser surface)."""
+    docs = [
+        ("d0", "the quick brown fox jumps"),
+        ("d1", "the brown quick fox naps"),
+        ("d2", "a lazy dog sleeps"),
+        ("d3", "quick dogs and lazy foxes"),
+    ]
+    for name, body in docs:
+        run(db, "CREATE (:Doc {name: $n, body: $b})", {"n": name, "b": body})
+    run(db, "CALL text_search.create_index('bodies', 'Doc') "
+            "YIELD status RETURN status")
+
+    def names(q):
+        rows = run(db, "CALL text_search.search('bodies', $q, 10) "
+                       "YIELD node, score RETURN node.name ORDER BY node.name",
+                   {"q": q})
+        return [r[0] for r in rows]
+
+    # phrase: exact consecutive order
+    assert names('"quick brown fox"') == ["d0"]
+    assert names('"brown quick fox"') == ["d1"]
+    # boolean AND narrows, OR widens
+    assert names('quick AND lazy') == ["d3"]
+    assert names('sleeps OR naps') == ["d1", "d2"]
+    # NOT filters; AND binds tighter than OR
+    assert names('quick AND NOT brown') == ["d3"]
+    assert names('sleeps OR quick AND brown') == ["d0", "d1", "d2"]
+    # grouping overrides precedence
+    assert names('(sleeps OR quick) AND lazy') == ["d2", "d3"]
+    # bare terms stay OR (previous default behavior)
+    assert set(names('fox dog')) == {"d0", "d1", "d2"}
+    # invalid query raises cleanly
+    import pytest as _pytest
+    from memgraph_tpu.exceptions import QueryException
+    with _pytest.raises(QueryException):
+        run(db, "CALL text_search.search('bodies', '(broken', 10) "
+                "YIELD node RETURN node")
