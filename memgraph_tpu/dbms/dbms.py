@@ -30,6 +30,7 @@ class DbmsHandler:
         self._recover = recover_on_startup
         self._databases: dict[str, "InterpreterContext"] = {}
         self._suspended: set[str] = set()
+        self._suspending: set[str] = set()   # snapshot in flight
         self._make(DEFAULT_DB)
         # suspended tenants stay cold across restarts (their durable
         # shell is on disk; SUSPENDED markers record the state)
@@ -52,6 +53,11 @@ class DbmsHandler:
             storage_mode=self._root_config.storage_mode,
             isolation_level=self._root_config.isolation_level,
             wal_enabled=self._root_config.wal_enabled,
+            gc_interval_sec=self._root_config.gc_interval_sec,
+            snapshot_on_exit=self._root_config.snapshot_on_exit,
+            properties_on_edges=self._root_config.properties_on_edges,
+            snapshot_retention_count=(
+                self._root_config.snapshot_retention_count),
         )
         if self._root_config.durability_dir:
             if name == DEFAULT_DB:
@@ -263,6 +269,7 @@ class DbmsHandler:
             # runs OUTSIDE the handler lock so other tenants never stall
             del self._databases[name]
             self._suspended.add(name)
+            self._suspending.add(name)
         # gate BEFORE snapshotting: sessions holding a USE DATABASE
         # reference can no longer open transactions, and in-flight ones
         # must drain — a commit racing the snapshot would be silently
@@ -277,14 +284,20 @@ class DbmsHandler:
                         f"drain within 30s")
                 time.sleep(0.01)
             from ..storage.durability.snapshot import create_snapshot
-            create_snapshot(ictx.storage)
+            ictx.storage._suspend_internal = True
+            try:
+                create_snapshot(ictx.storage)
+            finally:
+                ictx.storage._suspend_internal = False
         except Exception:
             with self._lock:            # undo: the db stays hot
                 ictx.storage.suspended = False
                 self._suspended.discard(name)
+                self._suspending.discard(name)
                 self._databases[name] = ictx
             raise
         with self._lock:
+            self._suspending.discard(name)
             # a concurrent RESUME may have re-made the db while we
             # snapshotted; its fresh instance wins — no stale marker
             if name not in self._suspended:
@@ -295,6 +308,18 @@ class DbmsHandler:
     def resume(self, name: str) -> None:
         """COLD -> HOT: rebuild from the durable shell; blocks until the
         database is queryable again. Idempotent on hot databases."""
+        # a concurrent SUSPEND may still be writing its snapshot: block
+        # until the durable shell is complete, or resuming would recover
+        # stale state (spec: RESUME blocks until the database is hot)
+        deadline = time.monotonic() + 60.0
+        while True:
+            with self._lock:
+                if name not in self._suspending:
+                    break
+            if time.monotonic() > deadline:
+                raise QueryException(
+                    f"database {name!r} is still being suspended")
+            time.sleep(0.01)
         with self._lock:
             if name in self._databases:
                 return

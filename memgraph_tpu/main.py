@@ -82,6 +82,36 @@ def build_config(argv=None) -> argparse.Namespace:
                    help="comma list of id=host:port raft peers")
     p.add_argument("--management-port", type=int, default=0,
                    help="data-instance management server port (HA)")
+    # --- wider reference flag surface ------------------------------------
+    p.add_argument("--storage-snapshot-retention-count", type=int,
+                   default=3, help="how many snapshots to keep")
+    p.add_argument("--storage-snapshot-thread-count", type=int, default=0,
+                   help="snapshot encode/decode worker threads "
+                        "(0 = cpu count)")
+    p.add_argument("--storage-properties-on-edges",
+                   action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--bolt-num-workers", type=int, default=0,
+                   help="bolt worker threads (0 = auto)")
+    p.add_argument("--query-execution-timeout-sec", type=float,
+                   default=None,
+                   help="reference-named alias of --execution-timeout-sec")
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--also-log-to-stderr",
+                   action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--allow-load-csv",
+                   action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--memory-warning-threshold", type=int, default=1024,
+                   help="log a warning when free system memory drops "
+                        "below this many MB (0 disables)")
+    p.add_argument("--kafka-bootstrap-servers", default="",
+                   help="default brokers for CREATE KAFKA STREAM")
+    p.add_argument("--pulsar-service-url", default="",
+                   help="default service url for CREATE PULSAR STREAM")
+    p.add_argument("--auth-password-strength-regex", default=".+",
+                   help="regex newly set passwords must match")
+    p.add_argument("--auth-password-permit-null",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="allow users without a password")
     return p.parse_args(argv)
 
 
@@ -92,9 +122,19 @@ def build_database(args) -> InterpreterContext:
         durability_dir=args.data_directory,
         wal_enabled=bool(args.storage_wal_enabled and args.data_directory),
         snapshot_on_exit=args.storage_snapshot_on_exit,
+        properties_on_edges=args.storage_properties_on_edges,
+        snapshot_retention_count=args.storage_snapshot_retention_count,
     )
+    timeout_sec = (args.query_execution_timeout_sec
+                   if args.query_execution_timeout_sec is not None
+                   else args.execution_timeout_sec)
     interp_config = {
-        "execution_timeout_sec": args.execution_timeout_sec,
+        "execution_timeout_sec": timeout_sec,
+        "allow_load_csv": args.allow_load_csv,
+        "kafka_bootstrap_servers": args.kafka_bootstrap_servers,
+        "pulsar_service_url": args.pulsar_service_url,
+        "auth_password_strength_regex": args.auth_password_strength_regex,
+        "auth_password_permit_null": args.auth_password_permit_null,
         "advertised_address": (args.bolt_advertised_address
                                or f"localhost:{args.bolt_port}"),
     }
@@ -145,6 +185,22 @@ def build_database(args) -> InterpreterContext:
                   lambda: create_snapshot(storage), "periodic-snapshot")
         logging.info("periodic snapshots every %ds",
                      args.storage_snapshot_interval_sec)
+    if args.memory_warning_threshold:
+        def _warn_low_memory():
+            try:
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        if line.startswith("MemAvailable:"):
+                            avail_mb = int(line.split()[1]) // 1024
+                            if avail_mb < args.memory_warning_threshold:
+                                logging.warning(
+                                    "available system memory low: %d MB "
+                                    "(threshold %d MB)", avail_mb,
+                                    args.memory_warning_threshold)
+                            break
+            except OSError:
+                pass
+        _periodic(60, _warn_low_memory, "memory watcher")
     if args.storage_gc_cycle_sec:
         _periodic(args.storage_gc_cycle_sec, storage.collect_garbage,
                   "periodic-gc")
@@ -238,7 +294,8 @@ async def serve(args, ictx) -> None:
         from .utils.tls import server_context
         ssl_ctx = server_context(args.bolt_cert_file, args.bolt_key_file)
     server = BoltServer(ictx, args.bolt_address, args.bolt_port, auth,
-                        ssl_context=ssl_ctx)
+                        ssl_context=ssl_ctx,
+                        workers=args.bolt_num_workers or None)
     await server.start()
     logging.info("Bolt server listening on %s:%d%s", args.bolt_address,
                  args.bolt_port, " (TLS)" if ssl_ctx else "")
@@ -272,9 +329,18 @@ async def serve(args, ictx) -> None:
 
 def main(argv=None) -> int:
     args = build_config(argv)
+    handlers = None
+    if args.log_file:
+        handlers = [logging.FileHandler(args.log_file)]
+        if args.also_log_to_stderr:
+            handlers.append(logging.StreamHandler())
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        handlers=handlers)
+    if args.storage_snapshot_thread_count:
+        from .storage.durability import snapshot as _snap
+        _snap.POOL_WORKERS = args.storage_snapshot_thread_count
     # honor JAX_PLATFORMS even when a site hook pre-initialized jax with a
     # different backend (e.g. the axon TPU plugin)
     import os

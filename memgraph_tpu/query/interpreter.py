@@ -203,7 +203,7 @@ class Interpreter:
         if isinstance(node, A.TriggerQuery):
             return self._prepare_trigger(node)
         if isinstance(node, A.AuthQuery):
-            return self._prepare_auth(node)
+            return self._prepare_auth(node, parameters)
         if isinstance(node, A.ReplicationQuery):
             return self._prepare_replication(node)
         if isinstance(node, A.StreamQuery):
@@ -228,12 +228,16 @@ class Interpreter:
         streams = streams_of(self.ctx)
         if node.action == "create":
             self._ensure_writable("CREATE STREAM")
+            cfg = getattr(self.ctx, "config", {}) or {}
             streams.create(StreamSpec(
                 name=node.name, kind=node.kind, topics=list(node.topics),
                 transform=node.transform, batch_size=node.batch_size,
                 batch_interval_sec=node.batch_interval_ms / 1000.0,
-                bootstrap_servers=node.bootstrap_servers,
-                service_url=node.service_url,
+                bootstrap_servers=(node.bootstrap_servers
+                                   or cfg.get("kafka_bootstrap_servers",
+                                              "")),
+                service_url=(node.service_url
+                             or cfg.get("pulsar_service_url", "")),
                 consumer_group=node.consumer_group))
             return self._prepare_generator(iter([]), [], "s")
         if node.action == "drop":
@@ -551,6 +555,41 @@ class Interpreter:
     def _auth_store(self):
         from ..auth.auth import resolve_auth
         return resolve_auth(self.ctx)
+
+    @staticmethod
+    def _password_value(expr, parameters):
+        """Password expression -> value: literal or $parameter only — a
+        silently-ignored expression would null the password and open the
+        account (found by review r4)."""
+        if expr is None:
+            return None
+        if isinstance(expr, A.Literal):
+            return expr.value
+        if isinstance(expr, A.Parameter):
+            params = parameters or {}
+            if expr.name not in params:
+                raise QueryException(
+                    f"password parameter ${expr.name} not provided")
+            return params[expr.name]
+        raise QueryException(
+            "passwords must be a string literal or a $parameter")
+
+    def _check_password_policy(self, password) -> None:
+        """--auth-password-strength-regex / --auth-password-permit-null
+        (reference: flags/general.cpp password policy)."""
+        import re as _re
+        cfg = getattr(self.ctx, "config", {}) or {}
+        if password is None:
+            if not cfg.get("auth_password_permit_null", True):
+                raise QueryException(
+                    "null passwords are forbidden "
+                    "(--no-auth-password-permit-null)")
+            return
+        pattern = cfg.get("auth_password_strength_regex", ".+")
+        if not _re.fullmatch(pattern, str(password)):
+            raise QueryException(
+                "the new password does not satisfy the password "
+                "strength policy (--auth-password-strength-regex)")
 
     def _check_privilege(self, privilege: str) -> None:
         """Enforce RBAC when users are defined (reference: AuthChecker,
@@ -1185,13 +1224,12 @@ class Interpreter:
         return self._prepare_generator(
             iter(rows), ["trigger name", "event", "phase", "statement"], "r")
 
-    def _prepare_auth(self, node: A.AuthQuery) -> PreparedQuery:
+    def _prepare_auth(self, node: A.AuthQuery,
+                  parameters=None) -> PreparedQuery:
         auth = self._auth_store()
         if node.action == "create_user":
-            pw = None
-            if node.password is not None and isinstance(node.password,
-                                                        A.Literal):
-                pw = node.password.value
+            pw = self._password_value(node.password, parameters)
+            self._check_password_policy(pw)
             auth.create_user(node.user, pw)
         elif node.action == "drop_user":
             auth.drop_user(node.user)
@@ -1221,6 +1259,13 @@ class Interpreter:
         elif node.action == "show_roles":
             return self._prepare_generator(
                 iter([[r] for r in auth.roles()]), ["role"], "r")
+        elif node.action == "set_password":
+            pw = self._password_value(node.password, parameters)
+            self._check_password_policy(pw)
+            if not self.username:
+                raise QueryException(
+                    "SET PASSWORD requires an authenticated user")
+            auth.set_password(self.username, pw)
         elif node.action == "show_privileges":
             rows = [[p, eff] for p, eff
                     in auth.effective_privileges(node.user)]

@@ -1622,6 +1622,10 @@ class LoadCsvOp(LogicalOperator):
     quote: Optional[A.Expr]
 
     def cursor(self, ctx):
+        cfg = getattr(ctx.interpreter_context, "config", None) or {}
+        if not cfg.get("allow_load_csv", True):
+            raise QueryException(
+                "LOAD CSV is disabled (--no-allow-load-csv)")
         import csv as csvlib
         for frame in self.input.cursor(ctx):
             path = ctx.evaluator.eval(self.file, frame)

@@ -35,12 +35,15 @@ _POOL: ThreadPoolExecutor | None = None
 _POOL_LOCK = threading.Lock()
 
 
+POOL_WORKERS = 0          # 0 = cpu count (--storage-snapshot-thread-count)
+
+
 def _pool() -> ThreadPoolExecutor:
     global _POOL
     with _POOL_LOCK:
         if _POOL is None:
             _POOL = ThreadPoolExecutor(
-                max_workers=max(2, (os.cpu_count() or 2)),
+                max_workers=POOL_WORKERS or max(2, (os.cpu_count() or 2)),
                 thread_name_prefix="snapshot-worker")
         return _POOL
 
@@ -244,11 +247,14 @@ def create_snapshot(storage) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
-    _apply_retention(storage)
+    _apply_retention(storage,
+                     keep=getattr(storage.config,
+                                  'snapshot_retention_count', 3))
     return path
 
 
 def _apply_retention(storage, keep: int = 3) -> None:
+    keep = max(1, keep)          # snaps[:-0] would retain EVERYTHING
     d = snapshot_dir(storage)
     snaps = sorted(p for p in os.listdir(d) if p.endswith(".mgsnap"))
     for old in snaps[:-keep]:

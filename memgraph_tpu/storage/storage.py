@@ -44,6 +44,7 @@ class StorageConfig:
     wal_enabled: bool = False
     snapshot_on_exit: bool = False
     properties_on_edges: bool = True
+    snapshot_retention_count: int = 3
 
 
 class _Namer:
@@ -751,6 +752,13 @@ class InMemoryStorage:
 
     def _begin_transaction(self, isolation: IsolationLevel) -> Transaction:
         with self._engine_lock:
+            # gate + registration must be ATOMIC: a check outside this
+            # lock could let a transaction slip past the suspend drain.
+            # _suspend_internal lets the suspend flow's own snapshot
+            # reader through after the drain completed.
+            if getattr(self, "suspended", False) and                     not getattr(self, "_suspend_internal", False):
+                raise StorageError(
+                    "this database is suspended; RESUME it first")
             txn_id = self._next_txn_id
             self._next_txn_id += 1
             start_ts = self._timestamp

@@ -254,10 +254,9 @@ def _parse_query(query: str):
             if tok.upper() == "OR":
                 advance()
                 node = _Bool("OR", node, parse_and())
-            elif tok.upper() == "AND":
-                return node      # handled by parse_and of the caller
             else:
-                # bare adjacency = OR (tantivy default)
+                # bare adjacency = OR (tantivy default); trailing ANDs
+                # were already consumed by parse_and
                 node = _Bool("OR", node, parse_and())
 
     def parse_and():

@@ -106,3 +106,42 @@ def test_suspended_state_survives_restart(tmp_path):
         d2.get("cold_t")
     run(d2.default(), "RESUME DATABASE cold_t")
     assert run(d2.get("cold_t"), "MATCH (x:X) RETURN x.v") == [[7]]
+
+
+def test_password_policy_flags():
+    """--auth-password-strength-regex / --no-auth-password-permit-null."""
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage
+    from memgraph_tpu.auth.auth import Auth
+    ictx = InterpreterContext(InMemoryStorage(), {
+        "auth_password_strength_regex": r"[A-Za-z0-9]{8,}",
+        "auth_password_permit_null": False})
+    ictx.auth_store = Auth()     # isolated: never touch the global store
+    interp = Interpreter(ictx)
+    with pytest.raises(QueryException, match="strength"):
+        interp.execute("CREATE USER weak IDENTIFIED BY 'short'")
+    with pytest.raises(QueryException, match="null"):
+        interp.execute("CREATE USER nopw")
+    interp.execute("CREATE USER strong IDENTIFIED BY 'longenough1'")
+    interp.username = "strong"
+    with pytest.raises(QueryException, match="strength"):
+        interp.execute("SET PASSWORD TO 'nope'")
+    interp.execute("SET PASSWORD TO 'alsolongenough2'")
+
+
+def test_allow_load_csv_flag(tmp_path):
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage
+    csv = tmp_path / "rows.csv"
+    csv.write_text("a,b\n1,2\n")
+    blocked = Interpreter(InterpreterContext(
+        InMemoryStorage(), {"allow_load_csv": False}))
+    with pytest.raises(QueryException, match="disabled"):
+        blocked.execute(
+            f'LOAD CSV FROM "{csv}" WITH HEADER AS row RETURN row.a')
+    allowed = Interpreter(InterpreterContext(InMemoryStorage()))
+    _, rows, _ = allowed.execute(
+        f'LOAD CSV FROM "{csv}" WITH HEADER AS row RETURN row.a')
+    assert rows == [["1"]]
