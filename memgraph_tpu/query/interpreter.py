@@ -772,8 +772,16 @@ class Interpreter:
         # per-operator execution counters (reference:
         # prometheus_metrics.hpp:108-157 via interpreter.cpp:3320):
         # counted at successful COMPLETION (_finish_stream), not prepare,
-        # so failed/aborted queries don't inflate them
-        self._pending_op_counts = _plan_operator_counts(plan)
+        # so failed/aborted queries don't inflate them. The counts are
+        # derived once per (cached) plan, not walked per query.
+        counts = getattr(plan, "_op_counts", None)
+        if counts is None:
+            counts = _plan_operator_counts(plan)
+            try:
+                plan._op_counts = counts
+            except (AttributeError, TypeError):
+                pass  # frozen/slotted root: recompute next time
+        self._pending_op_counts = counts
 
         if self._in_explicit_txn:
             accessor = self._explicit_accessor
