@@ -393,6 +393,7 @@ class TerminateTransactionsQuery:
 @dataclass
 class SnapshotQuery:
     action: str  # 'create' | 'recover' | 'show'
+    source: Optional[str] = None   # RECOVER SNAPSHOT FROM "<uri>"
 
 
 @dataclass

@@ -229,6 +229,11 @@ class Parser:
         if self.at_kw("RECOVER"):
             self.advance()
             self.expect_kw("SNAPSHOT")
+            if self.accept_kw("FROM"):
+                # remote/explicit source: file path, http(s):// or s3://
+                # (reference: storage.hpp:158-168 remote snapshot load)
+                return A.SnapshotQuery("recover",
+                                       source=self.expect(T.STRING).value)
             return A.SnapshotQuery("recover")
         if self.at_kw("DUMP"):
             self.advance()

@@ -1140,8 +1140,16 @@ class Interpreter:
             return self._prepare_generator(iter(rows),
                                            ["path", "timestamp"], "r")
         if node.action == "recover":
-            from ..storage.durability.recovery import recover_latest_snapshot
-            recover_latest_snapshot(storage)
+            from ..storage.durability.recovery import (
+                recover_latest_snapshot, recover_snapshot_from)
+            if node.source is not None:
+                if not node.source.strip():
+                    raise QueryException(
+                        "RECOVER SNAPSHOT FROM requires a non-empty "
+                        "source")
+                recover_snapshot_from(storage, node.source)
+            else:
+                recover_latest_snapshot(storage)
             self.ctx.invalidate_plans()
             return self._prepare_generator(iter([["Snapshot recovered."]]),
                                            ["status"], "s")

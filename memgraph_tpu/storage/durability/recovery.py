@@ -40,6 +40,87 @@ def recover(storage) -> dict:
     return stats
 
 
+def recover_snapshot_from(storage, source: str) -> None:
+    """RECOVER SNAPSHOT FROM "<uri>": load a snapshot from an explicit
+    local path, http(s):// URL, or s3:// object (reference:
+    storage/v2/inmemory/storage.hpp:158-168 remote snapshot load).
+
+    The remote bytes are staged into the snapshots directory first
+    (atomic rename), so a half-downloaded file is never loaded and the
+    snapshot also becomes part of the local retention set."""
+    import os
+    import tempfile
+    import time as _time
+    from .snapshot import create_snapshot, snapshot_dir
+
+    def _stage(reader, suffix="remote"):
+        """Download to a tmp file, VALIDATE, only then rename into the
+        snapshots dir — a corrupt download must never become the
+        "latest" snapshot and poison every later recovery."""
+        d = snapshot_dir(storage)
+        final = os.path.join(
+            d, f"snapshot_{int(_time.time() * 1e6)}_{suffix}.mgsnap")
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                while True:
+                    chunk = reader(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+            staged = load_snapshot(tmp)      # raises on corrupt payload
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return final, staged
+
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        try:
+            with urllib.request.urlopen(source, timeout=60) as resp:
+                path, data = _stage(resp.read)
+        except OSError as e:   # URLError/HTTPError/timeouts subclass this
+            raise DurabilityError(
+                f"cannot fetch snapshot from {source!r}: {e}") from e
+    elif source.startswith("s3://"):
+        try:
+            import boto3
+        except ImportError as e:
+            raise DurabilityError(
+                "s3:// snapshot sources need the boto3 client library, "
+                "which is not installed in this environment") from e
+        bucket, _, key = source[len("s3://"):].partition("/")
+        body = boto3.client("s3").get_object(Bucket=bucket,
+                                             Key=key)["Body"]
+        path, data = _stage(body.read)
+    else:
+        if not os.path.exists(source):
+            raise DurabilityError(f"snapshot source {source!r} not found")
+        data = load_snapshot(source)
+    _clear_storage(storage)
+    _apply_snapshot(storage, data)
+    # NEW durability epoch: the local WAL predates the foreign snapshot
+    # and must never replay on top of it at the next restart — advance
+    # past every local WAL commit and persist a fresh local snapshot
+    # that restart recovery will pick as the baseline
+    max_wal_ts = 0
+    for wal_path in W.list_wal_files(storage):
+        try:
+            for commit_ts, _ops in W.iter_wal_transactions(wal_path):
+                max_wal_ts = max(max_wal_ts, commit_ts)
+        except DurabilityError:
+            pass
+    storage._timestamp = max(storage._timestamp, max_wal_ts + 1)
+    create_snapshot(storage)
+    storage._bump_topology()
+
+
 def recover_latest_snapshot(storage) -> None:
     """RECOVER SNAPSHOT query: wipe current state, load newest snapshot."""
     snaps = list_snapshots(storage)
