@@ -83,74 +83,11 @@ class ParallelScanAggregate(Op.LogicalOperator):
             raise _Unsupported
         mask = np.ones(snap.n, dtype=bool)
         for prop, op, rhs_expr in self.predicates:
-            mask &= self._pred_mask(ctx, snap, prop, op, rhs_expr)
+            mask &= _pred_mask(ctx, snap, prop, op, rhs_expr)
         out: dict = {}
         for kind, prop, name in self.aggregations:
             out[name] = self._aggregate(snap, mask, kind, prop)
         return out
-
-    def _pred_mask(self, ctx, snap, prop, op, rhs_expr) -> np.ndarray:
-        rhs = ctx.evaluator.eval(rhs_expr, {})
-        col = snap.columns[prop]
-        n = snap.n
-        if rhs is None:
-            return np.zeros(n, dtype=bool)       # NULL comparison -> NULL
-        if col.kind == "other":
-            if not col.present.any():
-                # vacuous column: no present value, every row excluded
-                return np.zeros(n, dtype=bool)
-            raise _Unsupported
-        if isinstance(rhs, bool):
-            if col.kind != "bool":
-                return self._type_mismatch(col, op, n)
-            rhs_v: object = 1 if rhs else 0
-        elif isinstance(rhs, (int, float)):
-            if col.kind not in ("int", "float"):
-                return self._type_mismatch(col, op, n)
-            # cross-dtype compare happens in float64; beyond 2^53 that
-            # diverges from the row path's exact int-vs-float compare
-            if col.kind == "int" and isinstance(rhs, float) and col.big:
-                raise _Unsupported
-            if col.kind == "float" and isinstance(rhs, int) \
-                    and not -2**53 <= rhs <= 2**53:
-                raise _Unsupported
-            rhs_v = rhs
-        elif isinstance(rhs, str):
-            if col.kind != "str":
-                return self._type_mismatch(col, op, n)
-            if op not in ("=", "<>"):
-                raise _Unsupported  # lexicographic order not dict-coded
-            code = col.vocab.get(rhs)
-            if code is None:
-                return (np.zeros(n, dtype=bool) if op == "=" else
-                        col.present.copy())
-            eq = (col.values == code) & col.present
-            return eq if op == "=" else (~eq & col.present)
-        else:
-            raise _Unsupported                   # list/map/temporal rhs
-        v = col.values
-        if op == "=":
-            m = v == rhs_v
-        elif op == "<>":
-            m = v != rhs_v
-        elif op == "<":
-            m = v < rhs_v
-        elif op == "<=":
-            m = v <= rhs_v
-        elif op == ">":
-            m = v > rhs_v
-        else:
-            m = v >= rhs_v
-        return m & col.present
-
-    @staticmethod
-    def _type_mismatch(col, op, n) -> np.ndarray:
-        # Cypher: cross-type equality is false, <> is true (for non-null
-        # values); ordering across types is NULL. All exclude on =/</...;
-        # <> keeps every present row.
-        if op == "<>":
-            return col.present.copy()
-        return np.zeros(n, dtype=bool)
 
     def _aggregate(self, snap, mask, kind, prop):
         if kind == "count" and prop is None:
@@ -184,6 +121,68 @@ class ParallelScanAggregate(Op.LogicalOperator):
         return int(m) if col.kind == "int" else float(m)
 
 
+
+def _pred_mask(ctx, snap, prop, op, rhs_expr) -> np.ndarray:
+    rhs = ctx.evaluator.eval(rhs_expr, {})
+    col = snap.columns[prop]
+    n = snap.n
+    if rhs is None:
+        return np.zeros(n, dtype=bool)       # NULL comparison -> NULL
+    if col.kind == "other":
+        if not col.present.any():
+            # vacuous column: no present value, every row excluded
+            return np.zeros(n, dtype=bool)
+        raise _Unsupported
+    if isinstance(rhs, bool):
+        if col.kind != "bool":
+            return _type_mismatch(col, op, n)
+        rhs_v: object = 1 if rhs else 0
+    elif isinstance(rhs, (int, float)):
+        if col.kind not in ("int", "float"):
+            return _type_mismatch(col, op, n)
+        # cross-dtype compare happens in float64; beyond 2^53 that
+        # diverges from the row path's exact int-vs-float compare
+        if col.kind == "int" and isinstance(rhs, float) and col.big:
+            raise _Unsupported
+        if col.kind == "float" and isinstance(rhs, int) \
+                and not -2**53 <= rhs <= 2**53:
+            raise _Unsupported
+        rhs_v = rhs
+    elif isinstance(rhs, str):
+        if col.kind != "str":
+            return _type_mismatch(col, op, n)
+        if op not in ("=", "<>"):
+            raise _Unsupported  # lexicographic order not dict-coded
+        code = col.vocab.get(rhs)
+        if code is None:
+            return (np.zeros(n, dtype=bool) if op == "=" else
+                    col.present.copy())
+        eq = (col.values == code) & col.present
+        return eq if op == "=" else (~eq & col.present)
+    else:
+        raise _Unsupported                   # list/map/temporal rhs
+    v = col.values
+    if op == "=":
+        m = v == rhs_v
+    elif op == "<>":
+        m = v != rhs_v
+    elif op == "<":
+        m = v < rhs_v
+    elif op == "<=":
+        m = v <= rhs_v
+    elif op == ">":
+        m = v > rhs_v
+    else:
+        m = v >= rhs_v
+    return m & col.present
+
+def _type_mismatch(col, op, n) -> np.ndarray:
+    # Cypher: cross-type equality is false, <> is true (for non-null
+    # values); ordering across types is NULL. All exclude on =/</...;
+    # <> keeps every present row.
+    if op == "<>":
+        return col.present.copy()
+    return np.zeros(n, dtype=bool)
 # -------------------------------------------------------------------------
 # plan rewrite
 # -------------------------------------------------------------------------
@@ -290,13 +289,169 @@ def _match_tail(agg: Op.Aggregate, hinted: bool):
         predicates=predicates, aggregations=aggregations, hinted=hinted)
 
 
+@dataclass
+class ParallelOrderedScan(Op.LogicalOperator):
+    """Columnar ORDER BY over a scan tail: filters + sort keys evaluated
+    as whole-column numpy kernels (argsort/lexsort) instead of per-row
+    python comparisons — the OrderBy analog of ParallelScanAggregate
+    (reference: operator.hpp:1925-2273 parallel operators). Yields SCAN
+    frames in final order; the original Produce sits above unchanged.
+    Falls back to the row-at-a-time OrderBy on anything the columnar
+    engine cannot express (mixed-type columns, temporal keys, ...)."""
+    input: Op.LogicalOperator          # Once
+    fallback: Op.LogicalOperator       # OrderBy over the original tail
+    symbol: str
+    label: Optional[str]
+    predicates: list
+    keys: list                         # [(prop name, ascending)]
+    hinted: bool = False
+
+    def cursor(self, ctx):
+        try:
+            order, gids = self._columnar_order(ctx)
+        except _Unsupported:
+            yield from self.fallback.cursor(ctx)
+            return
+        find = ctx.accessor.find_vertex
+        for i in order:
+            ctx.check_abort()
+            va = find(int(gids[i]), ctx.view)
+            if va is not None:
+                yield {self.symbol: va}
+
+    def _columnar_order(self, ctx):
+        props = tuple(sorted({p for p, _, _ in self.predicates}
+                             | {p for p, _ in self.keys}))
+        snap = COLUMNAR_CACHE.get(ctx.accessor, self.label, props,
+                                  ctx.view, abort_check=ctx.check_abort)
+        ctx.check_abort()
+        if snap.n < MIN_ROWS and not self.hinted:
+            raise _Unsupported
+        mask = np.ones(snap.n, dtype=bool)
+        for prop, op, rhs_expr in self.predicates:
+            mask &= _pred_mask(ctx, snap, prop, op, rhs_expr)
+        idx = np.flatnonzero(mask)
+        # np.lexsort: LAST key is primary -> feed reversed; each sort
+        # item contributes (value_key, null_rank) with null_rank primary
+        # within the item (openCypher: nulls last ascending, so first
+        # under DESC reversal). Stable — tie order matches the row path.
+        lex_keys = []
+        for prop, asc in reversed(self.keys):
+            col = snap.columns.get(prop)
+            if col is None or (col.kind == "other"
+                               and col.present.any()):
+                raise _Unsupported
+            if col.kind == "other":        # all-null column: constant key
+                continue
+            present = col.present[idx]
+            nan_rank = np.zeros(len(idx), dtype=np.int8)
+            if col.kind == "str":
+                decode = np.empty(len(col.vocab) + 1, dtype=object)
+                for s, code in col.vocab.items():
+                    decode[code] = s
+                decode[len(col.vocab)] = ""
+                codes = np.where(present, col.values[idx],
+                                 len(col.vocab))
+                strings = decode[codes].astype(str)
+                uniq, ranks = np.unique(strings, return_inverse=True)
+                vals = ranks.astype(np.int64)
+            else:
+                if col.kind == "int" and col.big:
+                    # |v| > 2^53: float64 would merge distinct keys (the
+                    # predicate path opts out for the same reason)
+                    raise _Unsupported
+                vals = col.values[idx].astype(np.float64)
+                # openCypher orderability ranks NaN after +inf; negation
+                # alone cannot move NaN, so rank it explicitly
+                nan = np.isnan(vals)
+                if nan.any():
+                    vals = np.where(nan, 0.0, vals)
+                    nan_rank = (np.where(nan, 1, 0) if asc
+                                else np.where(nan, 0, 1)).astype(np.int8)
+            if not asc:
+                vals = -vals
+            null_rank = (np.where(present, 0, 1) if asc
+                         else np.where(present, 1, 0))
+            lex_keys.append(vals)
+            lex_keys.append(nan_rank)
+            lex_keys.append(null_rank)     # primary within this item
+        if not lex_keys:
+            return np.arange(len(idx)), snap.gids[idx]
+        order = np.lexsort(lex_keys)
+        return order, snap.gids[idx]
+
+
+def _match_orderby(ob: "Op.OrderBy", hinted: bool):
+    """Match OrderBy <- Produce <- Filter* <- ScanAll[ByLabel] <- Once
+    with every sort key a property of the scanned symbol."""
+    produce = ob.input
+    if not isinstance(produce, Op.Produce):
+        return None
+    filters = []
+    node = produce.input
+    while isinstance(node, Op.Filter):
+        filters.append(node.expr)
+        node = node.input
+    if isinstance(node, Op.ScanAllByLabel):
+        sym, label = node.symbol, node.label
+    elif isinstance(node, Op.ScanAll):
+        sym, label = node.symbol, None
+    else:
+        return None
+    if not isinstance(node.input, Op.Once):
+        return None
+    # sort keys arrive either as sym.prop lookups or as projected ALIASES
+    # of such lookups (plan_projection rewrites ORDER BY p.age -> age)
+    alias_to_prop = {}
+    for expr, name in produce.items:
+        if isinstance(expr, A.PropertyLookup) and \
+                isinstance(expr.expr, A.Identifier) and \
+                expr.expr.name == sym:
+            alias_to_prop[name] = expr.prop
+    keys = []
+    fallback_items = []
+    for expr, asc in ob.items:
+        if isinstance(expr, A.PropertyLookup) and \
+                isinstance(expr.expr, A.Identifier) and \
+                expr.expr.name == sym:
+            prop = expr.prop
+        elif isinstance(expr, A.Identifier) and expr.name in alias_to_prop:
+            prop = alias_to_prop[expr.name]
+        else:
+            return None
+        keys.append((prop, asc))
+        # the fallback sorts PRE-projection frames: keys as sym.prop
+        fallback_items.append(
+            (A.PropertyLookup(A.Identifier(sym), prop), asc))
+    predicates = []
+    for f in filters:
+        for cond in _split_and(f):
+            pred = _as_predicate(cond, sym, label)
+            if pred is None:
+                return None
+            if pred == ():
+                continue
+            predicates.append(pred)
+    # fallback: row OrderBy over the ORIGINAL (unprojected) tail — the
+    # Produce above re-projects either way
+    fallback = Op.OrderBy(input=produce.input, items=fallback_items)
+    scan = ParallelOrderedScan(
+        input=Op.Once(), fallback=fallback, symbol=sym, label=label,
+        predicates=predicates, keys=keys, hinted=hinted)
+    return Op.Produce(input=scan, items=produce.items)
+
+
 def parallel_rewrite(plan, hinted: bool = False):
-    """Walk the plan, replacing eligible Aggregate tails in place.
-    Reference analog: plan/rewrite/parallel_rewrite.hpp."""
+    """Walk the plan, replacing eligible Aggregate and OrderBy tails in
+    place. Reference analog: plan/rewrite/parallel_rewrite.hpp."""
     if os.environ.get("MEMGRAPH_TPU_DISABLE_PARALLEL"):
         return plan
     if isinstance(plan, Op.Aggregate):
         repl = _match_tail(plan, hinted)
+        if repl is not None:
+            return repl
+    if isinstance(plan, Op.OrderBy):
+        repl = _match_orderby(plan, hinted)
         if repl is not None:
             return repl
     if not hasattr(plan, "__dataclass_fields__"):
