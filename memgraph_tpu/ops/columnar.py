@@ -43,6 +43,8 @@ class Column:
     vocab: dict | None = None      # str value -> code, for kind "str"
     big: bool = False              # int column holds |v| > 2^53: a float
     #                                rhs comparison would lose exactness
+    mixed: bool = False            # float column coerced from int+float
+    #                                values: original per-row types lost
 
 
 @dataclass
@@ -93,7 +95,7 @@ def _classify(values: list, present: np.ndarray) -> Column:
         for i, (v, p) in enumerate(zip(values, present)):
             if p:
                 out[i] = v
-        return Column("float", out, present)
+        return Column("float", out, present, mixed=("int" in kinds))
     if kinds == {"bool"}:
         out = np.zeros(len(values), dtype=np.int8)
         for i, (v, p) in enumerate(zip(values, present)):

@@ -60,22 +60,28 @@ class ParallelScanAggregate(Op.LogicalOperator):
     label: Optional[str]
     predicates: list                   # [(prop, op, rhs A.Expr), ...]
     aggregations: list                 # [(kind, prop|None, out name), ...]
+    group_by: list = None              # [(prop, out name), ...] | None
     hinted: bool = False
 
     def cursor(self, ctx):
         try:
-            yield self._columnar_row(ctx)
-            return
+            if self.group_by:
+                rows = self._columnar_groups(ctx)
+            else:
+                rows = [self._columnar_row(ctx)]
         except _Unsupported:
-            pass
-        yield from self.fallback.cursor(ctx)
+            yield from self.fallback.cursor(ctx)
+            return
+        yield from rows
 
     # -- columnar path ----------------------------------------------------
 
-    def _columnar_row(self, ctx) -> dict:
+    def _snapshot_and_mask(self, ctx, extra_props=()):
+        """Shared preamble: columnar snapshot + predicate mask."""
         props = tuple(sorted(
             {p for p, _, _ in self.predicates}
-            | {p for _, p, _ in self.aggregations if p is not None}))
+            | {p for _, p, _ in self.aggregations if p is not None}
+            | set(extra_props)))
         snap = COLUMNAR_CACHE.get(ctx.accessor, self.label, props,
                                   ctx.view, abort_check=ctx.check_abort)
         ctx.check_abort()
@@ -84,10 +90,141 @@ class ParallelScanAggregate(Op.LogicalOperator):
         mask = np.ones(snap.n, dtype=bool)
         for prop, op, rhs_expr in self.predicates:
             mask &= _pred_mask(ctx, snap, prop, op, rhs_expr)
+        return snap, mask
+
+    def _columnar_row(self, ctx) -> dict:
+        snap, mask = self._snapshot_and_mask(ctx)
         out: dict = {}
         for kind, prop, name in self.aggregations:
             out[name] = self._aggregate(snap, mask, kind, prop)
         return out
+
+    def _columnar_groups(self, ctx) -> list:
+        """Grouped aggregation: np.unique-keyed groups in FIRST-SEEN
+        order (matching the hash aggregation's emission order)."""
+        snap, mask = self._snapshot_and_mask(
+            ctx, extra_props=[p for p, _ in self.group_by])
+        idx = np.flatnonzero(mask)
+        if idx.size == 0:
+            return []                   # grouped agg over 0 rows: no rows
+
+        # composite group key: per-key (presence, value) columns. Mixed
+        # or exotic columns fall back; big ints would merge under the
+        # composite's float64 promotion; mixed numerics lose the
+        # original per-row value type the row path emits.
+        key_cols = []
+        decoders = []
+        for prop, _name in self.group_by:
+            col = snap.columns.get(prop)
+            if col is None or col.kind == "other":
+                if col is not None and not col.present.any():
+                    key_cols.append(np.zeros(idx.size, dtype=np.int8))
+                    decoders.append(("null", None))
+                    continue
+                raise _Unsupported
+            if (col.kind == "int" and col.big) or                     (col.kind == "float" and col.mixed):
+                raise _Unsupported
+            present = col.present[idx]
+            vals = np.where(present, col.values[idx], 0)
+            key_cols.append(np.where(present, 1, 0).astype(np.int8))
+            key_cols.append(vals)
+            decoders.append((col.kind, col))
+        combo = np.stack(key_cols, axis=1)
+        _, first_pos, inverse = np.unique(
+            combo, axis=0, return_index=True, return_inverse=True)
+        n_groups = first_pos.size
+        # emission order = first appearance of each group
+        emit_order = np.argsort(first_pos, kind="stable")
+
+        out_rows = [dict() for _ in range(n_groups)]
+        # group key values (decoded back to engine values)
+        ki = 0
+        for (kind, col), (prop, name) in zip(decoders, self.group_by):
+            if kind == "null":
+                for g in range(n_groups):
+                    out_rows[g][name] = None
+                ki += 1
+                continue
+            pres_col = key_cols[ki]
+            val_col = key_cols[ki + 1]
+            ki += 2
+            decode = _vocab_decode(col) if kind == "str" else None
+            for g in range(n_groups):
+                row0 = first_pos[g]
+                if not pres_col[row0]:
+                    out_rows[g][name] = None
+                elif kind == "str":
+                    out_rows[g][name] = decode[val_col[row0]]
+                elif kind == "bool":
+                    out_rows[g][name] = bool(val_col[row0])
+                elif kind == "int":
+                    out_rows[g][name] = int(val_col[row0])
+                else:
+                    out_rows[g][name] = float(val_col[row0])
+
+        for kind, prop, name in self.aggregations:
+            if kind == "count" and prop is None:
+                counts = np.bincount(inverse, minlength=n_groups)
+                for g in range(n_groups):
+                    out_rows[g][name] = int(counts[g])
+                continue
+            col = snap.columns[prop]
+            present = col.present[idx]
+            if kind == "count":
+                # needs only presence: works for EVERY column kind
+                counts = np.bincount(inverse[present],
+                                     minlength=n_groups)
+                for g in range(n_groups):
+                    out_rows[g][name] = int(counts[g])
+                continue
+            if col.kind not in ("int", "float"):
+                raise _Unsupported
+            if col.kind == "int" and col.big:
+                raise _Unsupported
+            vals = col.values[idx]
+            sel = present
+            counts = np.bincount(inverse[sel], minlength=n_groups)
+            if kind in ("min", "max"):
+                fvals = vals.astype(np.float64)
+                fill = np.inf if kind == "min" else -np.inf
+                acc = np.full(n_groups, fill)
+                ufn = np.minimum if kind == "min" else np.maximum
+                ufn.at(acc, inverse[sel], fvals[sel])
+                for g in range(n_groups):
+                    if counts[g] == 0:
+                        out_rows[g][name] = None
+                    elif col.kind == "int":
+                        out_rows[g][name] = int(acc[g])
+                    else:
+                        out_rows[g][name] = float(acc[g])
+                continue
+            if col.kind == "int":
+                # EXACT int accumulation (np.add.at on int64); the row
+                # path sums arbitrary-precision python ints, so guard
+                # potential int64 wrap the same way the ungrouped path
+                # guards float drift
+                sel_vals = vals[sel]
+                if sel_vals.size and int(np.abs(sel_vals).max()) >                         (2**62) // max(int(counts.max()), 1):
+                    sums = [0] * n_groups
+                    for gi, v in zip(inverse[sel], sel_vals):
+                        sums[gi] += int(v)
+                else:
+                    acc = np.zeros(n_groups, dtype=np.int64)
+                    np.add.at(acc, inverse[sel], sel_vals)
+                    sums = acc
+            else:
+                sums = np.bincount(inverse[sel],
+                                   weights=vals[sel].astype(np.float64),
+                                   minlength=n_groups)
+            for g in range(n_groups):
+                if kind == "sum":
+                    out_rows[g][name] = (int(sums[g])
+                                         if col.kind == "int"
+                                         else float(sums[g]))
+                else:                   # avg
+                    out_rows[g][name] = (float(sums[g] / counts[g])
+                                         if counts[g] else None)
+        return [out_rows[g] for g in emit_order]
 
     def _aggregate(self, snap, mask, kind, prop):
         if kind == "count" and prop is None:
@@ -176,6 +313,14 @@ def _pred_mask(ctx, snap, prop, op, rhs_expr) -> np.ndarray:
         m = v >= rhs_v
     return m & col.present
 
+def _vocab_decode(col):
+    """code -> string array for a dict-coded str column."""
+    decode = np.empty(len(col.vocab), dtype=object)
+    for s, code in col.vocab.items():
+        decode[code] = s
+    return decode
+
+
 def _type_mismatch(col, op, n) -> np.ndarray:
     # Cypher: cross-type equality is false, <> is true (for non-null
     # values); ordering across types is NULL. All exclude on =/</...;
@@ -226,8 +371,9 @@ def _is_prop_of(e, sym: str) -> bool:
 
 
 def _match_tail(agg: Op.Aggregate, hinted: bool):
-    """Match Aggregate <- Filter* <- ScanAll[ByLabel] <- Once."""
-    if agg.group_by or agg.remember:
+    """Match Aggregate <- Filter* <- ScanAll[ByLabel] <- Once (with or
+    without sym.prop GROUP BY keys)."""
+    if agg.remember:
         return None
     aggregations = []
     for spec in agg.aggregations:
@@ -275,6 +421,14 @@ def _match_tail(agg: Op.Aggregate, hinted: bool):
         elif expr.expr.name != sym:
             return None
 
+    group_by = []
+    for expr, name in agg.group_by:
+        if not (isinstance(expr, A.PropertyLookup)
+                and isinstance(expr.expr, A.Identifier)
+                and expr.expr.name == sym):
+            return None
+        group_by.append((expr.prop, name))
+
     predicates = []
     for f in filters:
         for cond in _split_and(f):
@@ -286,7 +440,8 @@ def _match_tail(agg: Op.Aggregate, hinted: bool):
             predicates.append(pred)
     return ParallelScanAggregate(
         input=Op.Once(), fallback=agg, symbol=sym, label=label,
-        predicates=predicates, aggregations=aggregations, hinted=hinted)
+        predicates=predicates, aggregations=aggregations,
+        group_by=group_by, hinted=hinted)
 
 
 @dataclass
@@ -346,10 +501,8 @@ class ParallelOrderedScan(Op.LogicalOperator):
             present = col.present[idx]
             nan_rank = np.zeros(len(idx), dtype=np.int8)
             if col.kind == "str":
-                decode = np.empty(len(col.vocab) + 1, dtype=object)
-                for s, code in col.vocab.items():
-                    decode[code] = s
-                decode[len(col.vocab)] = ""
+                decode = np.concatenate([_vocab_decode(col),
+                                         np.asarray([""], dtype=object)])
                 codes = np.where(present, col.values[idx],
                                  len(col.vocab))
                 strings = decode[codes].astype(str)

@@ -135,8 +135,9 @@ class TestParity:
 
 
 class TestEligibility:
-    def test_group_by_not_rewritten(self, db):
-        assert not plan_uses_parallel(
+    def test_group_by_now_rewritten(self, db):
+        # grouped aggregation joined the columnar collapse in r4
+        assert plan_uses_parallel(
             db, "MATCH (n:P) RETURN n.s AS s, count(*) AS c")
 
     def test_distinct_not_rewritten(self, db):
@@ -318,3 +319,69 @@ def test_parallel_orderby_falls_back_on_mixed_types():
         del os.environ["MEMGRAPH_TPU_DISABLE_PARALLEL"]
         db.invalidate_plans()
     assert fast == slow
+
+
+# --------------------------------------------------------------------------
+# grouped columnar aggregation (GROUP BY collapse)
+# --------------------------------------------------------------------------
+
+def _grouped_db(n=3000, seed=11):
+    import numpy as np
+    from memgraph_tpu.storage import InMemoryStorage
+    from memgraph_tpu.query.interpreter import InterpreterContext
+    db = InterpreterContext(InMemoryStorage())
+    rng = np.random.default_rng(seed)
+    acc = db.storage.access()
+    lid = db.storage.label_mapper.name_to_id("G")
+    city = db.storage.property_mapper.name_to_id("city")
+    age = db.storage.property_mapper.name_to_id("age")
+    active = db.storage.property_mapper.name_to_id("active")
+    cities = ["oslo", "lima", "pune", "kyiv"]
+    for i in range(n):
+        v = acc.create_vertex()
+        v.add_label(lid)
+        if i % 11:                     # some rows lack the group key
+            v.set_property(city, cities[int(rng.integers(0, 4))])
+        if i % 5:
+            v.set_property(age, int(rng.integers(18, 80)))
+        v.set_property(active, bool(rng.integers(0, 2)))
+    acc.commit()
+    return db
+
+
+def _both_paths(db, q):
+    import os
+    _, fast, _ = Interpreter(db).execute(q)
+    os.environ["MEMGRAPH_TPU_DISABLE_PARALLEL"] = "1"
+    try:
+        db.invalidate_plans()
+        _, slow, _ = Interpreter(db).execute(q)
+    finally:
+        del os.environ["MEMGRAPH_TPU_DISABLE_PARALLEL"]
+        db.invalidate_plans()
+    return fast, slow
+
+
+def test_grouped_aggregate_matches_row_path():
+    db = _grouped_db()
+    for q in (
+        "MATCH (g:G) RETURN g.city AS c, count(*) AS n, avg(g.age) AS a",
+        "MATCH (g:G) WHERE g.age > 30 RETURN g.city AS c, "
+        "sum(g.age) AS s, min(g.age) AS lo, max(g.age) AS hi",
+        "MATCH (g:G) RETURN g.city AS c, g.active AS act, count(g.age) AS n",
+    ):
+        assert "ParallelScanAggregate" in _explain(db, q), q
+        fast, slow = _both_paths(db, q)
+        assert fast == slow, (q, fast[:3], slow[:3])
+
+
+def test_grouped_aggregate_null_group_and_empty():
+    db = _grouped_db(n=1500)
+    q = "MATCH (g:G) RETURN g.city AS c, count(*) AS n"
+    fast, slow = _both_paths(db, q)
+    assert fast == slow
+    assert any(r[0] is None for r in fast)     # the null group exists
+    # empty input after filters: no groups at all
+    q = "MATCH (g:G) WHERE g.age > 1000 RETURN g.city AS c, count(*) AS n"
+    fast, slow = _both_paths(db, q)
+    assert fast == slow == []
