@@ -51,6 +51,22 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+
+def best_timed(once, budget_s=45.0, runs=3):
+    """min-of-N wall time, adaptively: stop repeating once the cumulative
+    timed spend exceeds budget_s, so a slow environment (fallback rungs,
+    loaded host) never triples a stage that barely fit its timeout."""
+    best, spent = float("inf"), 0.0
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        result = once()
+        dt = time.perf_counter() - t0
+        best, spent = min(best, dt), spent + dt
+        if spent > budget_s:
+            break
+    return result, best
+
+
 def generate_graph(n_nodes=N_NODES, n_edges=N_EDGES, seed=7):
     """Skewed random digraph: power-law-ish in-degree via squared sampling
     (supernode skew stresses the segment reductions, SURVEY.md §7)."""
@@ -71,12 +87,17 @@ def cpu_pagerank(src, dst, n_nodes, iterations=ITERATIONS, damping=DAMPING):
     mat = sp.csr_matrix((w * inv_deg[src], (dst, src)),
                         shape=(n_nodes, n_nodes))
     dangling = deg == 0
-    rank = np.full(n_nodes, 1.0 / n_nodes)
-    t0 = time.perf_counter()
-    for _ in range(iterations):
-        dm = rank[dangling].sum()
-        rank = (1 - damping) / n_nodes + damping * (mat @ rank + dm / n_nodes)
-    elapsed = time.perf_counter() - t0
+    # best-of-3: single-run wall time swings +-30% on this shared host,
+    # which would swing vs_baseline by the same amount for free
+
+    def once():
+        rank = np.full(n_nodes, 1.0 / n_nodes)
+        for _ in range(iterations):
+            dm = rank[dangling].sum()
+            rank = (1 - damping) / n_nodes \
+                + damping * (mat @ rank + dm / n_nodes)
+        return rank
+    rank, elapsed = best_timed(once)
     return rank, elapsed
 
 
@@ -135,11 +156,13 @@ def stage_pagerank_mxu(n_nodes, n_edges, seed, out_path):
                            jnp.float32(0.0))
     _ = float(rank[0])
     warm_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    rank, err, iters = run(None, jnp.float32(DAMPING), ITERATIONS,
-                           jnp.float32(0.0))
-    _ = float(rank[0])
-    elapsed = time.perf_counter() - t0
+
+    def once():
+        out = run(None, jnp.float32(DAMPING), ITERATIONS, jnp.float32(0.0))
+        _ = float(out[0][0])
+        return out
+    # best-of-3 mirrors the CPU baseline's timing
+    (rank, err, iters), elapsed = best_timed(once)
     assert int(iters) == ITERATIONS, f"expected {ITERATIONS}, ran {int(iters)}"
     ranks = np.asarray(rank)[plan.out_relabel]
     np.savez(out_path, ranks=ranks, elapsed=elapsed,
@@ -174,10 +197,12 @@ def stage_pagerank(n_nodes, n_edges, seed, out_path):
     # completion — block_until_ready is unreliable on the tunneled platform
     rank, err, iters = run(DAMPING)
     _ = float(rank[0])
-    t0 = time.perf_counter()
-    rank, err, iters = run(DAMPING)
-    _ = float(rank[0])  # host sync
-    elapsed = time.perf_counter() - t0
+
+    def once():
+        out = run(DAMPING)
+        _ = float(out[0][0])  # host sync
+        return out
+    (rank, err, iters), elapsed = best_timed(once)
     assert int(iters) == ITERATIONS, f"expected {ITERATIONS}, ran {int(iters)}"
     np.savez(out_path, ranks=np.asarray(rank[:n_nodes]),
              elapsed=elapsed, export_s=export_s,
