@@ -347,6 +347,9 @@ class CypherQuery:
     explain: bool = False
     profile: bool = False
     memory_limit: Optional[int] = None   # QUERY MEMORY LIMIT, bytes
+    # USING PERIODIC COMMIT n: int literal or Parameter (reference:
+    # MemgraphCypher.g4:413 periodicCommit pre-query directive)
+    commit_frequency: Optional[object] = None
 
 
 # --- administrative / DDL queries -------------------------------------------

@@ -773,6 +773,7 @@ class Parser:
     # --- Cypher query -------------------------------------------------------
 
     def parse_cypher_query(self) -> A.CypherQuery:
+        commit_frequency = self.parse_periodic_commit()
         first = self.parse_single_query()
         unions = []
         while self.at_kw("UNION"):
@@ -785,7 +786,29 @@ class Parser:
             # UNLIMITED` (reference grammar Cypher.g4:134-136)
             self.advance()
             mem = self.parse_memory_limit()
-        return A.CypherQuery(first, unions, memory_limit=mem)
+        if commit_frequency is not None and unions:
+            self.error("periodic commit is not allowed with UNION")
+        return A.CypherQuery(first, unions, memory_limit=mem,
+                             commit_frequency=commit_frequency)
+
+    def parse_periodic_commit(self):
+        """Leading `USING PERIODIC COMMIT n` pre-query directive
+        (reference: MemgraphCypher.g4:405,413). Other USING directives
+        (INDEX / HOPS LIMIT / PARALLEL EXECUTION) attach to MATCH and are
+        parsed there; only PERIODIC COMMIT legally precedes the first
+        clause (`USING PERIODIC COMMIT 500 LOAD CSV ... CREATE ...`)."""
+        if not self.at_kw("USING"):
+            return None
+        self.advance()
+        self.expect_kw("PERIODIC")
+        self.expect_kw("COMMIT")
+        if self.at(T.PARAM):
+            freq = A.Parameter(self.advance().value)
+        else:
+            freq = self.expect(T.INT).value
+            if freq < 1:
+                self.error("periodic commit frequency must be >= 1")
+        return freq
 
     def parse_tenant_profile(self, action: str) -> "A.TenantProfileQuery":
         """TENANT PROFILE grammar (reference MemgraphCypher.g4:995-1001):

@@ -1328,14 +1328,11 @@ class _TxnOwner:
         self._exec_ctx = exec_ctx
 
     def renew(self) -> None:
-        interp = self._interp
-        exec_ctx = self._exec_ctx
-        exec_ctx.accessor.commit()
-        new_acc = interp.ctx.storage.access(interp._pick_isolation())
-        new_acc.fine_grained = exec_ctx.accessor.fine_grained
-        exec_ctx.accessor = new_acc
-        exec_ctx.eval_ctx.accessor = new_acc
-        interp._stream_accessor = new_acc
+        # in-place: the SAME accessor object re-begins, so graph handles
+        # held in frames and in-flight scan iterators keep working and
+        # post-boundary writes land in the fresh transaction (a swapped-in
+        # accessor would leave them bound to the finished one)
+        self._exec_ctx.accessor.periodic_commit()
 
 
 def _parse_period(text: str) -> float:

@@ -1481,6 +1481,51 @@ class CallProcedureOp(LogicalOperator):
 
 
 @dataclass
+class PeriodicCommit(LogicalOperator):
+    """USING PERIODIC COMMIT n: commit the enclosing autocommit
+    transaction and open a fresh one after every n pulled rows, plus once
+    more for the remainder when the stream ends (reference:
+    plan/operator.cpp PeriodicCommitCursor). Batches already committed
+    survive a later failure — the point of the directive for huge loads.
+
+    Graph values in frames stay readable across the boundary: reads
+    through a committed accessor see its committed state (round-3
+    post-commit visibility semantics), matching the reference where
+    accessors outlive PeriodicCommit's internal commits.
+    """
+    input: LogicalOperator
+    frequency: object   # int literal or frontend Parameter
+
+    def cursor(self, ctx):
+        freq = self.frequency
+        if not isinstance(freq, int):   # $param, resolved at runtime
+            freq = ctx.evaluator.eval(freq, {})
+            if not isinstance(freq, int) or isinstance(freq, bool) \
+                    or freq < 1:
+                raise QueryException(
+                    "periodic commit frequency must be a positive "
+                    f"integer, got {freq!r}")
+        owner = getattr(ctx, "_txn_owner", None)
+        if owner is None:
+            raise QueryException(
+                "USING PERIODIC COMMIT requires an implicit (autocommit) "
+                "transaction")
+        pulled = 0
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            pulled += 1
+            if pulled >= freq:
+                owner.renew()
+                pulled = 0
+            yield frame
+        if pulled:
+            owner.renew()   # remainder batch, mirroring the reference
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass
 class Apply(LogicalOperator):
     """CALL { subquery }: run the subplan per input row; merge returned
     columns (or pass rows through for unit subqueries).

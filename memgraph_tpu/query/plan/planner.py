@@ -140,6 +140,23 @@ class Planner:
                 raise SemanticException(
                     "UNION queries must return the same column names")
             plan = Op.Union(plan, sub_plan, columns, distinct=not union_all)
+        if query.commit_frequency is not None:
+            # root PeriodicCommit wraps the whole plan (reference:
+            # rule_based_planner.hpp:504); combining it with CALL {} IN
+            # TRANSACTIONS — at any subquery nesting depth — is the
+            # reference's "only once" semantic error
+            # (symbol_generator.cpp:177)
+            def _has_batched_apply(op):
+                if op is None:
+                    return False
+                if isinstance(op, Op.Apply) and op.batch_rows:
+                    return True
+                return any(_has_batched_apply(c) for c in op.children())
+            if _has_batched_apply(plan):
+                raise SemanticException(
+                    "You can specify periodic commit only once during "
+                    "a query!")
+            plan = Op.PeriodicCommit(plan, query.commit_frequency)
         return plan, columns
 
     def plan_single(self, single: A.SingleQuery, leaf=None,

@@ -283,6 +283,19 @@ class Accessor:
         self.storage._abort(self.txn)
         self._finished = True
 
+    def periodic_commit(self) -> None:
+        """Commit and immediately re-begin on the SAME accessor object
+        (reference: InMemoryStorage::Accessor::PeriodicCommit). Every
+        live VertexAccessor/EdgeAccessor and in-flight scan iterator
+        dereferences this accessor dynamically, so they all migrate to
+        the fresh transaction — writes after the boundary land in the
+        new transaction instead of stamping deltas onto a finished one."""
+        isolation = self.txn.isolation
+        self.commit()
+        self.txn = self.storage._begin_transaction(isolation)
+        self.topology_snapshot = self.txn.topology_snapshot
+        self._finished = False
+
     # --- object creation / deletion -----------------------------------------
 
     def create_vertex(self, gid: Optional[Gid] = None) -> VertexAccessor:

@@ -1,0 +1,135 @@
+"""USING PERIODIC COMMIT: batch commits on huge autocommit writes.
+
+Reference: MemgraphCypher.g4:405,413 (pre-query directive), plan/
+operator.cpp PeriodicCommitCursor (commit every n pulls + remainder),
+symbol_generator.cpp:177 (only one periodic commit per query).
+"""
+
+import pytest
+
+from memgraph_tpu.exceptions import QueryException, SemanticException
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def interp():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+def _count(interp, label="N"):
+    return interp.execute(f"MATCH (n:{label}) RETURN count(n)")[1][0][0]
+
+
+def test_batches_commit_during_the_query(interp):
+    interp.execute(
+        "USING PERIODIC COMMIT 10 UNWIND range(0, 99) AS i "
+        "CREATE (:N {v: i})")
+    assert _count(interp) == 100
+
+
+def test_committed_batches_survive_a_later_failure(interp):
+    # row i=50 divides by zero AFTER five full batches of 10 committed;
+    # the committed 50 rows must survive the failed query — the entire
+    # point of the directive (reference docs: partial imports persist)
+    with pytest.raises(QueryException):
+        interp.execute(
+            "USING PERIODIC COMMIT 10 UNWIND range(0, 99) AS i "
+            "CREATE (:N {v: 1 / (50 - i)})")
+    assert _count(interp) == 50
+
+
+def test_remainder_batch_commits_at_stream_end(interp):
+    interp.execute(
+        "USING PERIODIC COMMIT 30 UNWIND range(0, 69) AS i CREATE (:N)")
+    assert _count(interp) == 70   # 30 + 30 + remainder 10
+
+
+def test_explain_shows_periodic_commit_operator(interp):
+    _, rows, _ = interp.execute(
+        "EXPLAIN USING PERIODIC COMMIT 5 UNWIND range(0, 9) AS i "
+        "CREATE (:N)")
+    assert any("PeriodicCommit" in r[0] for r in rows)
+
+
+def test_parameter_frequency(interp):
+    interp.execute(
+        "USING PERIODIC COMMIT $f UNWIND range(0, 24) AS i CREATE (:N)",
+        parameters={"f": 7})
+    assert _count(interp) == 25
+    with pytest.raises(QueryException):
+        interp.execute("USING PERIODIC COMMIT $f CREATE (:M)",
+                       parameters={"f": 0})
+
+
+def test_rejected_in_explicit_transaction(interp):
+    interp.execute("BEGIN")
+    with pytest.raises(QueryException, match="implicit"):
+        interp.execute(
+            "USING PERIODIC COMMIT 2 UNWIND range(0, 9) AS i CREATE (:N)")
+    interp.execute("ROLLBACK")
+
+
+def test_only_one_periodic_commit_per_query(interp):
+    with pytest.raises(SemanticException, match="only once"):
+        interp.execute(
+            "USING PERIODIC COMMIT 5 UNWIND range(0, 9) AS i "
+            "CALL { CREATE (:N) } IN TRANSACTIONS OF 2 ROWS")
+
+
+def test_rejected_with_union(interp):
+    with pytest.raises((QueryException, SemanticException)):
+        interp.execute(
+            "USING PERIODIC COMMIT 5 MATCH (n) RETURN n.v AS v "
+            "UNION MATCH (m) RETURN m.v AS v")
+
+
+def test_frequency_must_be_positive(interp):
+    with pytest.raises((QueryException, SemanticException)):
+        interp.execute("USING PERIODIC COMMIT 0 CREATE (:N)")
+
+
+def test_writes_after_boundary_land_in_the_new_transaction(interp):
+    # SET through handles matched BEFORE a commit boundary: the accessor
+    # renews in place, so writes go into the fresh transaction instead of
+    # stamping deltas onto a finished one (review finding: a swapped-in
+    # accessor left handles bound to the committed txn)
+    interp.execute("UNWIND range(0, 9) AS i CREATE (:N {v: i})")
+    interp.execute(
+        "USING PERIODIC COMMIT 1 MATCH (n:N) SET n.flag = true")
+    _, rows, _ = interp.execute(
+        "MATCH (n:N) WHERE n.flag RETURN count(n)")
+    assert rows[0][0] == 10
+
+
+def test_post_boundary_writes_respect_constraints(interp):
+    # a write after a commit boundary must still hit commit-time unique
+    # validation — the finished-txn write path skipped it entirely
+    from memgraph_tpu.exceptions import ConstraintViolation
+    interp.execute("CREATE CONSTRAINT ON (n:N) ASSERT n.u IS UNIQUE")
+    interp.execute("CREATE (:N {v: 0}), (:N {v: 1})")
+    with pytest.raises(ConstraintViolation):
+        interp.execute(
+            "USING PERIODIC COMMIT 1 MATCH (n:N) SET n.u = 7")
+    _, rows, _ = interp.execute(
+        "MATCH (n:N) WHERE n.u = 7 RETURN count(n)")
+    assert rows[0][0] == 1   # first batch committed; second failed
+
+
+def test_nested_batched_subquery_also_conflicts(interp):
+    with pytest.raises(SemanticException, match="only once"):
+        interp.execute(
+            "USING PERIODIC COMMIT 5 UNWIND range(0, 9) AS i "
+            "CALL { WITH i CALL { CREATE (:N) } IN TRANSACTIONS "
+            "OF 2 ROWS RETURN 1 AS r } RETURN r")
+
+
+def test_works_with_return_and_reads_after_commit(interp):
+    # frames carry graph values across the commit boundary; post-commit
+    # accessor reads must still serve them (round-3 visibility fix)
+    _, rows, _ = interp.execute(
+        "USING PERIODIC COMMIT 3 UNWIND range(0, 9) AS i "
+        "CREATE (n:N {v: i}) RETURN n.v AS v ORDER BY v")
+    assert [r[0] for r in rows] == list(range(10))
+    assert _count(interp) == 10
