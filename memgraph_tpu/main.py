@@ -96,6 +96,12 @@ def build_config(argv=None) -> argparse.Namespace:
                    default=None,
                    help="reference-named alias of --execution-timeout-sec")
     p.add_argument("--log-file", default=None)
+    p.add_argument("--telemetry-enabled", action="store_true",
+                   help="send anonymous usage telemetry (object counts, "
+                        "uptime; never query text or data) — reference: "
+                        "--telemetry-enabled, src/telemetry/")
+    p.add_argument("--telemetry-endpoint",
+                   default="https://telemetry.invalid/v1/beat")
     p.add_argument("--also-log-to-stderr",
                    action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--allow-load-csv",
@@ -300,6 +306,18 @@ async def serve(args, ictx) -> None:
     logging.info("Bolt server listening on %s:%d%s", args.bolt_address,
                  args.bolt_port, " (TLS)" if ssl_ctx else "")
 
+    telemetry = None
+    if args.telemetry_enabled:
+        from .observability.telemetry import (Telemetry,
+                                              attach_query_collectors,
+                                              attach_storage_collectors)
+        telemetry = Telemetry(args.telemetry_endpoint,
+                              kvstore=getattr(ictx, "kvstore", None))
+        attach_storage_collectors(telemetry, ictx)
+        attach_query_collectors(telemetry)
+        telemetry.start()
+        logging.info("telemetry enabled -> %s", args.telemetry_endpoint)
+
     monitoring = None
     if args.monitoring_port:
         from .observability.http import start_monitoring_server
@@ -318,6 +336,8 @@ async def serve(args, ictx) -> None:
     await stop.wait()
 
     logging.info("shutting down ...")
+    if telemetry is not None:
+        telemetry.stop()
     server.stop()
     if monitoring is not None:
         monitoring.close()

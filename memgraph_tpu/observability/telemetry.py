@@ -1,0 +1,132 @@
+"""Anonymous usage telemetry (reference: src/telemetry/telemetry.cpp —
+periodic phone-home with pluggable collectors, gated by the
+--telemetry-enabled flag, off by default here).
+
+A stable anonymous run id lives in the kvstore; each beat POSTs a JSON
+document assembled from registered collectors. Delivery failures are
+swallowed (the reference buffers and retries; we keep the last error for
+observability instead — this environment has no egress anyway).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+DEFAULT_INTERVAL_SEC = 8 * 3600   # reference: every 8h (memgraph.cpp:1006)
+_RUN_ID_KEY = "telemetry:run_id"
+
+
+class Telemetry:
+    def __init__(self, endpoint: str, kvstore=None,
+                 interval_sec: float = DEFAULT_INTERVAL_SEC,
+                 first_beat_sec: float = None) -> None:
+        import os
+        if first_beat_sec is None:
+            first_beat_sec = float(os.environ.get(
+                "MEMGRAPH_TPU_TELEMETRY_FIRST_BEAT_SEC", "60"))
+        self.endpoint = endpoint
+        self.interval_sec = interval_sec
+        self.first_beat_sec = first_beat_sec
+        self.run_id = self._load_run_id(kvstore)
+        self.started_at = time.time()
+        self.beats_sent = 0
+        self.last_error: str | None = None
+        self._collectors: dict[str, callable] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.add_collector("uptime", lambda: time.time() - self.started_at)
+        self.add_collector("version", self._version)
+
+    @staticmethod
+    def _version():
+        from .. import __version__
+        return __version__
+
+    @staticmethod
+    def _load_run_id(kvstore) -> str:
+        if kvstore is None:
+            return str(uuid.uuid4())
+        existing = kvstore.get_str(_RUN_ID_KEY)
+        if existing:
+            return existing
+        run_id = str(uuid.uuid4())
+        kvstore.put(_RUN_ID_KEY, run_id)
+        return run_id
+
+    def add_collector(self, name: str, fn) -> None:
+        """fn() -> JSON-serializable value; exceptions are isolated per
+        collector so one broken probe never kills the beat."""
+        self._collectors[name] = fn
+
+    def collect(self) -> dict:
+        data = {}
+        for name, fn in self._collectors.items():
+            try:
+                data[name] = fn()
+            except Exception as e:
+                data[name] = f"<collector error: {e}>"
+        return {"run_id": self.run_id, "timestamp": time.time(),
+                "data": data}
+
+    def send_beat(self) -> bool:
+        payload = json.dumps(self.collect()).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=payload,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+            self.beats_sent += 1
+            self.last_error = None
+            return True
+        except Exception as e:
+            self.last_error = str(e)
+            return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="telemetry")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        if self._stop.wait(self.first_beat_sec):
+            return
+        while not self._stop.is_set():
+            self.send_beat()
+            if self._stop.wait(self.interval_sec):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def attach_storage_collectors(telemetry: Telemetry, ctx) -> None:
+    """The reference's database collector: object counts only — never
+    query text or data (telemetry/collectors.cpp). `ctx` may be an
+    InterpreterContext (read live — STORAGE MODE switches replace the
+    storage object) or a bare storage."""
+    def counts():
+        storage = getattr(ctx, "storage", ctx)
+        info = storage.info()   # public surface shared with SHOW STORAGE INFO
+        return {"vertices": info["vertex_count"],
+                "edges": info["edge_count"]}
+    telemetry.add_collector("storage", counts)
+
+
+def attach_query_collectors(telemetry: Telemetry) -> None:
+    from .metrics import global_metrics
+
+    def counters():
+        return {name: value
+                for name, kind, value in global_metrics.snapshot()
+                if name.startswith("query.")}
+    telemetry.add_collector("query_counters", counters)

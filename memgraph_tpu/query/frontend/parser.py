@@ -520,6 +520,19 @@ class Parser:
         if self.accept_kw("METRICS"):
             self.accept_kw("INFO")
             return A.InfoQuery("metrics")
+        if self.at(T.IDENT) and self.cur.value.upper() == "LICENSE":
+            self.advance()
+            self.expect_kw("INFO")
+            return A.InfoQuery("license")
+        if self.at(T.IDENT) and self.cur.value.upper() == "ACTIVE":
+            # SHOW ACTIVE USERS INFO (reference: MemgraphCypher.g4:1032
+            # systemInfoQuery activeUsersInfo)
+            self.advance()
+            if not (self.at(T.IDENT) and self.cur.value.upper() == "USERS"):
+                self.error("expected USERS after SHOW ACTIVE")
+            self.advance()
+            self.expect_kw("INFO")
+            return A.InfoQuery("active_users")
         if self.accept_kw("TRANSACTIONS"):
             return A.ShowTransactionsQuery()
         if self.accept_kw("SNAPSHOT"):  # SHOW SNAPSHOTS

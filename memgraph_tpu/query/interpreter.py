@@ -112,6 +112,10 @@ class Interpreter:
         # internals) bypass RBAC — they act on behalf of the server
         self.system = system
         self.ctx = context
+        # instance-level anchor: USE DATABASE rebinds self.ctx, but the
+        # active-session registry is instance-wide (reference:
+        # GetActiveUsersInfo), so it always reads/writes through this
+        self.root_ctx = context
         self.session_isolation: Optional[IsolationLevel] = None
         self.next_isolation: Optional[IsolationLevel] = None
         self._explicit_accessor = None
@@ -1064,6 +1068,23 @@ class Interpreter:
                     ["backend", "jax/XLA (TPU)"]]
             return self._prepare_generator(iter(rows),
                                            ["build info", "value"], "r")
+        if node.kind == "license":
+            from ..utils.license import LicenseChecker
+            info = LicenseChecker(self._settings()).info()
+            rows = [[k, v] for k, v in info.items()]
+            return self._prepare_generator(iter(rows),
+                                           ["license info", "value"], "r")
+        if node.kind == "active_users":
+            sessions = getattr(self.root_ctx, "active_sessions", {})
+            # snapshot: the event-loop thread mutates this dict while
+            # queries run on the worker pool
+            rows = [[username, sid, login_ts]
+                    for sid, (username, login_ts)
+                    in sorted(list(sessions.items()),
+                              key=lambda kv: kv[1][1])]
+            return self._prepare_generator(
+                iter(rows), ["username", "session uuid",
+                             "login timestamp"], "r")
         if node.kind == "metrics":
             from ..observability.metrics import global_metrics
             rows = [[name, str(kind), value]

@@ -226,6 +226,8 @@ class BoltSession:
         self.authenticated = False
         self.failed = False  # FAILURE → ignore until RESET
         self._prepared = None
+        import uuid as _uuid
+        self.session_id = str(_uuid.uuid4())
         # interpreter work (parse/plan/execute/pull) runs on this pool so
         # one session's long query never blocks the event loop — the
         # reference runs sessions on a work-stealing priority pool
@@ -235,6 +237,19 @@ class BoltSession:
         # thread-safe); per-session ordering is preserved because the
         # message loop awaits each dispatch before reading the next.
         self._executor = executor
+
+    def _register_session(self) -> None:
+        """SHOW ACTIVE USERS INFO registry (reference: GetActiveUsersInfo,
+        interpreter.cpp SystemInfoQuery ACTIVE_USERS)."""
+        import datetime
+        sessions = getattr(self.ictx, "active_sessions", None)
+        if sessions is None:
+            sessions = self.ictx.active_sessions = {}
+        ts = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        sessions[self.session_id] = (self.interpreter.username or "", ts)
+
+    def _unregister_session(self) -> None:
+        getattr(self.ictx, "active_sessions", {}).pop(self.session_id, None)
 
     async def _offload(self, fn, *args):
         if self._executor is None:
@@ -295,6 +310,7 @@ class BoltSession:
         except Exception:
             log.exception("bolt session crashed")
         finally:
+            self._unregister_session()
             self.interpreter.abort()
             self.writer.close()
 
@@ -354,6 +370,7 @@ class BoltSession:
                 return self.on_logon(msg.fields[0] if msg.fields else {})
             if sig == M_LOGOFF:
                 self.authenticated = False
+                self._unregister_session()
                 self.send_success()
                 return True
             if sig == M_RUN:
@@ -433,6 +450,8 @@ class BoltSession:
             else:
                 self.authenticated = True
                 self.interpreter.username = principal
+        if self.authenticated:
+            self._register_session()
         self.send_success({
             "server": "Neo4j/5.2.0 compatible (memgraph-tpu)",
             "connection_id": "bolt-1",
@@ -456,6 +475,7 @@ class BoltSession:
                 return True
             self.authenticated = True
             self.interpreter.username = username
+            self._register_session()
             self.send_success({})
             return True
         if self.auth is not None and not self.auth.authenticate(
@@ -466,6 +486,7 @@ class BoltSession:
             return True
         self.authenticated = True
         self.interpreter.username = principal  # RBAC enforcement identity
+        self._register_session()
         self.send_success()
         return True
 

@@ -1,0 +1,147 @@
+"""License checking + telemetry + SHOW LICENSE / ACTIVE USERS INFO.
+
+Reference: src/license/license.cpp (key validation, org binding, expiry),
+src/telemetry/telemetry.cpp (periodic anonymous beats, pluggable
+collectors), interpreter.cpp SystemInfoQuery LICENSE / ACTIVE_USERS.
+"""
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from memgraph_tpu.observability.telemetry import (Telemetry,
+                                                  attach_storage_collectors)
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+from memgraph_tpu.utils.license import LicenseChecker, generate_key
+
+
+@pytest.fixture
+def interp():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+def _info(interp):
+    _, rows, _ = interp.execute("SHOW LICENSE INFO")
+    return dict(rows)
+
+
+def test_no_license_shows_invalid(interp):
+    info = _info(interp)
+    assert info["is_valid"] is False
+    assert info["status"] == "no license key set"
+
+
+def test_valid_key_roundtrip(interp):
+    key = generate_key("Acme Corp", "enterprise",
+                       valid_until=int(time.time()) + 86400,
+                       memory_limit=8 << 30)
+    interp.execute(
+        f"SET DATABASE SETTING 'enterprise.license' TO '{key}'")
+    interp.execute(
+        "SET DATABASE SETTING 'organization.name' TO 'Acme Corp'")
+    info = _info(interp)
+    assert info["is_valid"] is True
+    assert info["license_type"] == "enterprise"
+    assert info["memory_limit"] == "8.00GiB"
+
+
+def test_org_mismatch_and_expiry(interp):
+    key = generate_key("Acme Corp")
+    interp.execute(
+        f"SET DATABASE SETTING 'enterprise.license' TO '{key}'")
+    interp.execute(
+        "SET DATABASE SETTING 'organization.name' TO 'Other Org'")
+    info = _info(interp)
+    assert info["is_valid"] is False
+    assert "different organization" in info["status"]
+    expired = generate_key("Acme Corp", valid_until=int(time.time()) - 10)
+    interp.execute(
+        f"SET DATABASE SETTING 'enterprise.license' TO '{expired}'")
+    interp.execute(
+        "SET DATABASE SETTING 'organization.name' TO 'Acme Corp'")
+    assert _info(interp)["status"] == "license expired"
+
+
+def test_tampered_key_rejected():
+    class FakeSettings(dict):
+        def get(self, k, d=None):
+            return dict.get(self, k, d)
+    key = generate_key("Acme Corp")
+    # flip a payload character: checksum must catch it
+    broken = key[:10] + ("A" if key[10] != "A" else "B") + key[11:]
+    s = FakeSettings({"enterprise.license": broken,
+                      "organization.name": "Acme Corp"})
+    info = LicenseChecker(s).info()
+    assert info["is_valid"] is False
+    assert "checksum" in info["status"] or "malformed" in info["status"]
+
+
+def test_show_active_users_info(interp):
+    interp.ctx.active_sessions = {
+        "uuid-1": ("alice", "2026-07-30T00:00:00+00:00"),
+        "uuid-2": ("bob", "2026-07-30T00:00:01+00:00"),
+    }
+    hdr, rows, _ = interp.execute("SHOW ACTIVE USERS INFO")
+    assert hdr == ["username", "session uuid", "login timestamp"]
+    assert [r[0] for r in rows] == ["alice", "bob"]   # login order
+
+
+def test_telemetry_beat_payload_and_delivery():
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            received.append(json.loads(
+                self.rfile.read(int(self.headers["Content-Length"]))))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        storage = InMemoryStorage()
+        acc = storage.access()
+        acc.create_vertex()
+        acc.commit()
+        t = Telemetry(f"http://127.0.0.1:{srv.server_port}/beat")
+        attach_storage_collectors(t, storage)
+        assert t.send_beat() is True
+        beat = received[0]
+        assert beat["run_id"] == t.run_id
+        assert beat["data"]["storage"] == {"vertices": 1, "edges": 0}
+        assert "uptime" in beat["data"] and "version" in beat["data"]
+        # never query text or user data in the payload
+        assert "query_text" not in json.dumps(beat)
+    finally:
+        srv.shutdown()
+
+
+def test_telemetry_failure_is_swallowed():
+    t = Telemetry("http://127.0.0.1:9/unreachable")
+    assert t.send_beat() is False
+    assert t.last_error
+    assert t.beats_sent == 0
+
+
+def test_telemetry_broken_collector_is_isolated():
+    t = Telemetry("http://unused.invalid/")
+    t.add_collector("boom", lambda: 1 / 0)
+    data = t.collect()["data"]
+    assert "collector error" in data["boom"]
+    assert "uptime" in data   # others unaffected
+
+
+def test_telemetry_run_id_persists_in_kvstore(tmp_path):
+    from memgraph_tpu.storage.kvstore import KVStore
+    kv = KVStore(str(tmp_path / "kv"))
+    a = Telemetry("http://unused.invalid/", kvstore=kv)
+    b = Telemetry("http://unused.invalid/", kvstore=kv)
+    assert a.run_id == b.run_id
