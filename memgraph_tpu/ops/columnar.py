@@ -150,6 +150,53 @@ def export_columns(accessor, label: str | None,
     return snap
 
 
+@dataclass
+class EdgeSnapshot:
+    """Columnar edge table: one row per visible edge, with endpoint gids,
+    type ids and requested edge-property columns (the edge analog of
+    ColumnarSnapshot; feeds the columnar Expand collapse)."""
+    n: int
+    gids: np.ndarray               # (n,) int64 edge gids
+    src: np.ndarray                # (n,) int64 from-vertex gids
+    dst: np.ndarray                # (n,) int64 to-vertex gids
+    type_ids: np.ndarray           # (n,) int32 edge type ids
+    columns: dict = field(default_factory=dict)   # prop name -> Column
+
+
+def export_edges(accessor, props: tuple[str, ...], view,
+                 abort_check=None) -> EdgeSnapshot:
+    """One MVCC-correct sweep over the accessor's visible edges."""
+    storage = accessor.storage
+    prop_ids = [storage.property_mapper.maybe_name_to_id(p) for p in props]
+    gids: list[int] = []
+    src: list[int] = []
+    dst: list[int] = []
+    types: list[int] = []
+    raw: list[list] = [[] for _ in props]
+    for i, ea in enumerate(accessor.edges(view)):
+        if abort_check is not None and (i & 0x1FFF) == 0:
+            abort_check()
+        gids.append(ea.gid)
+        src.append(ea.from_vertex().gid)
+        dst.append(ea.to_vertex().gid)
+        types.append(ea.edge_type)
+        pd = ea.properties(view)
+        for j, pid in enumerate(prop_ids):
+            raw[j].append(None if pid is None else pd.get(pid))
+    n = len(gids)
+    snap = EdgeSnapshot(
+        n=n, gids=np.asarray(gids, dtype=np.int64),
+        src=np.asarray(src, dtype=np.int64),
+        dst=np.asarray(dst, dtype=np.int64),
+        type_ids=np.asarray(types, dtype=np.int32))
+    for j, p in enumerate(props):
+        vals = raw[j]
+        present = np.fromiter((v is not None for v in vals), dtype=bool,
+                              count=n)
+        snap.columns[p] = _classify(vals, present)
+    return snap
+
+
 class ColumnarCache:
     """Per-storage cache keyed by (topology_version, label, props).
 
@@ -181,37 +228,26 @@ class ColumnarCache:
             return False
         return txn.effective_start_ts() >= accessor.storage.latest_commit_ts()
 
-    def get(self, accessor, label: str | None, props: tuple[str, ...],
-            view, abort_check=None) -> ColumnarSnapshot:
+    def _get_cached(self, accessor, key, props, export_fn, abort_check):
+        """Shared cache skeleton for vertex and edge snapshots: per
+        (version, key) entries with column-level sharing — a later query
+        needing extra properties sweeps only the missing columns (row
+        order is stable within a version, so columns from separate
+        sweeps align; verified by row count). The version is captured by
+        the CALLER before its freshness check, embedded in `key`."""
         storage = accessor.storage
-        # capture the version BEFORE the freshness check: a commit landing
-        # between _cacheable() and the key read would otherwise let a
-        # pre-commit sweep be stored under the post-commit version
-        version = storage.topology_version
-        if not self._cacheable(accessor):
-            return export_columns(accessor, label, props, view,
-                                  abort_check)
-        # cache per (version, label) with column-level sharing: a later
-        # query needing extra properties of the same label sweeps only
-        # the missing columns (row order is stable within a version, so
-        # columns from separate sweeps align — verified by row count)
-        key = (version, label)
         with self._lock:
             per = self._cache.get(storage)
             entry = per.get(key) if per else None
         missing = tuple(p for p in props
                         if entry is None or p not in entry.columns)
-        if entry is None and not missing:
-            missing = ()        # no columns needed, but n/gids still are
         if missing or entry is None:
-            snap = export_columns(accessor, label, missing, view,
-                                  abort_check)
+            snap = export_fn(missing)
             if storage.topology_version != key[0]:
                 # topology moved mid-sweep: the sweep may be mixed — never
                 # store it; serve this caller a fresh full (uncached) build
                 if missing != props:
-                    snap = export_columns(accessor, label, props, view,
-                                          abort_check)
+                    snap = export_fn(props)
                 return snap
             with self._lock:
                 per = self._cache.get(storage) or {}
@@ -228,5 +264,33 @@ class ColumnarCache:
                 self._cache[storage] = per
         return entry
 
+    def get(self, accessor, label: str | None, props: tuple[str, ...],
+            view, abort_check=None) -> ColumnarSnapshot:
+        # capture the version BEFORE the freshness check: a commit landing
+        # between _cacheable() and the key read would otherwise let a
+        # pre-commit sweep be stored under the post-commit version
+        version = accessor.storage.topology_version
+        if not self._cacheable(accessor):
+            return export_columns(accessor, label, props, view,
+                                  abort_check)
+        return self._get_cached(
+            accessor, (version, label), props,
+            lambda ps: export_columns(accessor, label, ps, view,
+                                      abort_check), abort_check)
+
+    def get_edges(self, accessor, props: tuple[str, ...], view,
+                  abort_check=None) -> EdgeSnapshot:
+        """Edge-table analog of get(): cached under (version, _EDGES_KEY)
+        with the same MVCC staleness contract."""
+        version = accessor.storage.topology_version
+        if not self._cacheable(accessor):
+            return export_edges(accessor, props, view, abort_check)
+        return self._get_cached(
+            accessor, (version, _EDGES_KEY), props,
+            lambda ps: export_edges(accessor, ps, view, abort_check),
+            abort_check)
+
+
+_EDGES_KEY = "\x00edges"   # sentinel: no label can collide (labels never contain NUL)
 
 COLUMNAR_CACHE = ColumnarCache()

@@ -259,6 +259,139 @@ class ParallelScanAggregate(Op.LogicalOperator):
 
 
 
+def _gid_rows(sorted_gids: np.ndarray, order: np.ndarray,
+              query: np.ndarray) -> np.ndarray:
+    """Vectorized gid -> row lookup: returns row indices into the
+    original (unsorted) gid array, -1 where absent."""
+    if len(sorted_gids) == 0:   # empty endpoint snapshot: nothing matches
+        return np.full(len(query), -1, dtype=np.int64)
+    pos = np.searchsorted(sorted_gids, query)
+    pos_c = np.clip(pos, 0, len(sorted_gids) - 1)
+    hit = sorted_gids[pos_c] == query
+    return np.where(hit, order[pos_c], -1)
+
+
+def _gather_column(col, rows: np.ndarray, valid: np.ndarray):
+    """Column indexed at `rows` (edge-aligned): rows<0 or ~valid are
+    absent. Shares vocab and exactness flags with the source column."""
+    from ...ops.columnar import Column
+    ok = valid & (rows >= 0)
+    rows_c = np.clip(rows, 0, max(len(col.present) - 1, 0))
+    if len(col.present) == 0:
+        return Column(col.kind, None if col.values is None
+                      else col.values[:0], np.zeros(len(rows), dtype=bool),
+                      col.vocab, col.big, col.mixed)
+    present = ok & col.present[rows_c]
+    values = None if col.values is None else col.values[rows_c]
+    return Column(col.kind, values, present, col.vocab, col.big, col.mixed)
+
+
+@dataclass
+class ParallelExpandAggregate(ParallelScanAggregate):
+    """Columnar collapse of a single-hop expand+aggregate tail:
+
+        Aggregate <- Filter* <- Expand <- Filter* <- ScanAll[ByLabel] <- Once
+
+    One row per visible edge (oriented by `direction`); endpoint
+    properties are gathered from the label-restricted vertex snapshots
+    via vectorized gid lookups, so predicates/aggregations/group-keys
+    run as the same whole-column kernels as ParallelScanAggregate —
+    property keys are role-qualified: "n0.x" (scan node), "n1.x"
+    (expanded node), "e.x" (edge). Inherits the grouped/ungrouped
+    aggregation kernels unchanged.
+
+    Reference analog: the parallel Expand+Aggregate pipelines the
+    enterprise rewriter builds (plan/rewrite/parallel_rewrite.hpp); here
+    the edge table IS the parallel axis, matching how the MXU kernels
+    treat edges (ops/spmv_mxu.py).
+    """
+    b_label: Optional[str] = None      # LabelsTest on the expanded node
+    direction: str = "out"
+    edge_types: Optional[list] = None
+
+    def _snapshot_and_mask(self, ctx, extra_props=()):
+        from ...ops.columnar import ColumnarSnapshot
+        role_props: dict = {"n0": set(), "n1": set(), "e": set()}
+        for key, _, _ in self.predicates:
+            role, _, prop = key.partition(".")
+            role_props[role].add(prop)
+        for _, key, _ in self.aggregations:
+            if key is not None:
+                role, _, prop = key.partition(".")
+                role_props[role].add(prop)
+        for key in extra_props:
+            role, _, prop = key.partition(".")
+            role_props[role].add(prop)
+
+        acc = ctx.accessor
+        edges = COLUMNAR_CACHE.get_edges(
+            acc, tuple(sorted(role_props["e"])), ctx.view,
+            abort_check=ctx.check_abort)
+        ctx.check_abort()
+        if edges.n < MIN_ROWS and not self.hinted:
+            raise _Unsupported
+        a_snap = COLUMNAR_CACHE.get(acc, self.label,
+                                    tuple(sorted(role_props["n0"])),
+                                    ctx.view, abort_check=ctx.check_abort)
+        b_snap = COLUMNAR_CACHE.get(acc, self.b_label,
+                                    tuple(sorted(role_props["n1"])),
+                                    ctx.view, abort_check=ctx.check_abort)
+        ctx.check_abort()
+
+        type_mask = np.ones(edges.n, dtype=bool)
+        if self.edge_types:
+            ids = [tid for tid in
+                   (ctx.storage.edge_type_mapper.maybe_name_to_id(t)
+                    for t in self.edge_types) if tid is not None]
+            type_mask = np.isin(edges.type_ids,
+                                np.asarray(ids, dtype=np.int32))
+
+        # orient rows: n0 = the scanned side, n1 = the expanded side
+        if self.direction == "out":
+            orientations = [(edges.src, edges.dst, None)]
+        elif self.direction == "in":
+            orientations = [(edges.dst, edges.src, None)]
+        else:   # both: each edge row twice (u->v and v->u), a self-loop
+            # only once — matching the row path's expand-both semantics
+            not_loop = edges.src != edges.dst
+            orientations = [(edges.src, edges.dst, None),
+                            (edges.dst, edges.src, not_loop)]
+
+        a_order = np.argsort(a_snap.gids, kind="stable")
+        a_sorted = a_snap.gids[a_order]
+        b_order = np.argsort(b_snap.gids, kind="stable")
+        b_sorted = b_snap.gids[b_order]
+
+        parts = []       # (edge_row_idx, a_rows, b_rows, valid)
+        for n0_gids, n1_gids, extra_mask in orientations:
+            a_rows = _gid_rows(a_sorted, a_order, n0_gids)
+            b_rows = _gid_rows(b_sorted, b_order, n1_gids)
+            valid = type_mask & (a_rows >= 0) & (b_rows >= 0)
+            if extra_mask is not None:
+                valid = valid & extra_mask
+            parts.append((np.arange(edges.n), a_rows, b_rows, valid))
+        erow = np.concatenate([p[0] for p in parts])
+        a_rows = np.concatenate([p[1] for p in parts])
+        b_rows = np.concatenate([p[2] for p in parts])
+        valid = np.concatenate([p[3] for p in parts])
+
+        snap = ColumnarSnapshot(n=len(erow), gids=edges.gids[erow])
+        for prop in role_props["n0"]:
+            snap.columns[f"n0.{prop}"] = _gather_column(
+                a_snap.columns[prop], a_rows, valid)
+        for prop in role_props["n1"]:
+            snap.columns[f"n1.{prop}"] = _gather_column(
+                b_snap.columns[prop], b_rows, valid)
+        for prop in role_props["e"]:
+            snap.columns[f"e.{prop}"] = _gather_column(
+                edges.columns[prop], erow, valid)
+
+        mask = valid.copy()
+        for key, op, rhs_expr in self.predicates:
+            mask &= _pred_mask(ctx, snap, key, op, rhs_expr)
+        return snap, mask
+
+
 def _pred_mask(ctx, snap, prop, op, rhs_expr) -> np.ndarray:
     rhs = ctx.evaluator.eval(rhs_expr, {})
     col = snap.columns[prop]
@@ -444,6 +577,113 @@ def _match_tail(agg: Op.Aggregate, hinted: bool):
         group_by=group_by, hinted=hinted)
 
 
+def _match_expand_tail(agg: Op.Aggregate, hinted: bool):
+    """Match Aggregate <- Filter* <- Expand <- Filter* <-
+    ScanAll[ByLabel] <- Once (single hop, fresh to-symbol) and rewrite
+    to ParallelExpandAggregate with role-qualified property keys."""
+    if agg.remember:
+        return None
+
+    # walk the tail first so symbols are known for predicate targeting
+    upper_filters = []
+    node = agg.input
+    while isinstance(node, Op.Filter):
+        upper_filters.append(node.expr)
+        node = node.input
+    if not isinstance(node, Op.Expand) or type(node) is not Op.Expand:
+        return None
+    expand = node
+    if expand.direction not in ("out", "in", "both"):
+        return None
+    if expand.from_symbol == expand.to_symbol:
+        return None       # (a)-[]->(a): src==dst constraint not expressed
+    if expand.prev_edge_symbols:
+        return None
+    lower_filters = []
+    node = expand.input
+    while isinstance(node, Op.Filter):
+        lower_filters.append(node.expr)
+        node = node.input
+    if isinstance(node, Op.ScanAllByLabel):
+        a_label = node.label
+    elif isinstance(node, Op.ScanAll):
+        a_label = None
+    else:
+        return None
+    if node.symbol != expand.from_symbol or \
+            not isinstance(node.input, Op.Once):
+        return None
+    roles = {expand.from_symbol: "n0", expand.to_symbol: "n1",
+             expand.edge_symbol: "e"}
+
+    def qualify(sym, prop):
+        return f"{roles[sym]}.{prop}"
+
+    aggregations = []
+    for spec in agg.aggregations:
+        kind, expr, distinct, name = spec[0], spec[1], spec[2], spec[3]
+        if kind not in _AGG_KINDS or distinct:
+            return None
+        if len(spec) > 4 and spec[4] is not None:
+            return None
+        if expr is None:
+            if kind != "count":
+                return None
+            aggregations.append((kind, None, name))
+        elif kind == "count" and isinstance(expr, A.Identifier) \
+                and expr.name in roles:
+            # count(a)/count(r)/count(b): none can be null in an expand row
+            aggregations.append((kind, None, name))
+        elif isinstance(expr, A.PropertyLookup) and \
+                isinstance(expr.expr, A.Identifier) and \
+                expr.expr.name in roles:
+            aggregations.append((kind, qualify(expr.expr.name, expr.prop),
+                                 name))
+        else:
+            return None
+
+    group_by = []
+    for expr, name in agg.group_by:
+        if not (isinstance(expr, A.PropertyLookup)
+                and isinstance(expr.expr, A.Identifier)
+                and expr.expr.name in roles):
+            return None
+        group_by.append((qualify(expr.expr.name, expr.prop), name))
+
+    b_label = None
+    predicates = []
+    for f in upper_filters + lower_filters:
+        for cond in _split_and(f):
+            # label tests: scan label redundant; ONE single-label test on
+            # the expanded node becomes the b-side snapshot restriction
+            if isinstance(cond, A.LabelsTest) and \
+                    isinstance(cond.expr, A.Identifier):
+                sym = cond.expr.name
+                if sym == expand.from_symbol and a_label is not None \
+                        and cond.labels == [a_label]:
+                    continue
+                if sym == expand.to_symbol and len(cond.labels) == 1 \
+                        and b_label is None:
+                    b_label = cond.labels[0]
+                    continue
+                return None
+            matched = False
+            for sym in roles:
+                pred = _as_predicate(cond, sym, None)
+                if pred is not None and pred != ():
+                    predicates.append((qualify(sym, pred[0]), pred[1],
+                                       pred[2]))
+                    matched = True
+                    break
+            if not matched:
+                return None
+    return ParallelExpandAggregate(
+        input=Op.Once(), fallback=agg, symbol=expand.from_symbol,
+        label=a_label, predicates=predicates, aggregations=aggregations,
+        group_by=group_by, hinted=hinted, b_label=b_label,
+        direction=expand.direction, edge_types=list(expand.edge_types))
+
+
 @dataclass
 class ParallelOrderedScan(Op.LogicalOperator):
     """Columnar ORDER BY over a scan tail: filters + sort keys evaluated
@@ -601,6 +841,8 @@ def parallel_rewrite(plan, hinted: bool = False):
         return plan
     if isinstance(plan, Op.Aggregate):
         repl = _match_tail(plan, hinted)
+        if repl is None:
+            repl = _match_expand_tail(plan, hinted)
         if repl is not None:
             return repl
     if isinstance(plan, Op.OrderBy):
