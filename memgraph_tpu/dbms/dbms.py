@@ -49,16 +49,12 @@ class DbmsHandler:
             is not None else None)
 
     def _db_config(self, name: str) -> StorageConfig:
-        cfg = StorageConfig(
-            storage_mode=self._root_config.storage_mode,
-            isolation_level=self._root_config.isolation_level,
-            wal_enabled=self._root_config.wal_enabled,
-            gc_interval_sec=self._root_config.gc_interval_sec,
-            snapshot_on_exit=self._root_config.snapshot_on_exit,
-            properties_on_edges=self._root_config.properties_on_edges,
-            snapshot_retention_count=(
-                self._root_config.snapshot_retention_count),
-        )
+        import dataclasses
+        # copy EVERY field (replace, not field-by-field: a hand-copied
+        # list silently drops newly added StorageConfig knobs — it
+        # already lost automatic_*_index once); per-db durability_dir is
+        # assigned below
+        cfg = dataclasses.replace(self._root_config, durability_dir=None)
         if self._root_config.durability_dir:
             if name == DEFAULT_DB:
                 # the default database lives at the root (single-tenant

@@ -72,6 +72,44 @@ def build_config(argv=None) -> argparse.Namespace:
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--init-file", default=None,
                    help="cypherl file executed on startup")
+    p.add_argument("--init-data-file", default=None,
+                   help="cypherl data file executed after --init-file "
+                        "(reference: --init-data-file)")
+    p.add_argument("--bolt-server-name-for-init", default=None,
+                   help="server name sent in the Bolt HELLO response")
+    p.add_argument("--log-failed-queries",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="log the text of failing queries at WARNING")
+    p.add_argument("--debug-query-plans",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="log each prepared query's plan at DEBUG")
+    p.add_argument("--monitoring-address", default=None,
+                   help="bind address for the monitoring endpoint "
+                        "(default: --bolt-address)")
+    p.add_argument("--aws-access-key", default=None)
+    p.add_argument("--aws-secret-key", default=None)
+    p.add_argument("--aws-region", default=None)
+    p.add_argument("--aws-endpoint-url", default=None,
+                   help="S3-compatible endpoint for s3:// snapshot loads")
+    p.add_argument("--storage-delta-on-identical-property-update",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="write a delta even when SET stores an identical "
+                        "value (disable to skip no-op writes)")
+    p.add_argument("--storage-automatic-label-index-creation-enabled",
+                   action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--storage-automatic-edge-type-index-creation-enabled",
+                   action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--storage-parallel-snapshot-creation",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="encode/decode snapshot chunks on a worker pool")
+    p.add_argument("--replication-restore-state-on-startup",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="restore MAIN/REPLICA role and registered "
+                        "replicas from the durable state")
+    p.add_argument("--hops-limit-partial-results",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="USING HOPS LIMIT returns partial results when "
+                        "the budget is spent (false: error)")
     p.add_argument("--execution-timeout-sec", type=float, default=600.0)
     # HA coordination (reference: --coordinator-id/--coordinator-port etc.)
     p.add_argument("--coordinator-id", default=None,
@@ -130,7 +168,25 @@ def build_database(args) -> InterpreterContext:
         snapshot_on_exit=args.storage_snapshot_on_exit,
         properties_on_edges=args.storage_properties_on_edges,
         snapshot_retention_count=args.storage_snapshot_retention_count,
+        delta_on_identical_property_update=(
+            args.storage_delta_on_identical_property_update),
+        automatic_label_index=(
+            args.storage_automatic_label_index_creation_enabled),
+        automatic_edge_type_index=(
+            args.storage_automatic_edge_type_index_creation_enabled),
     )
+    if args.aws_access_key:
+        _os.environ.setdefault("AWS_ACCESS_KEY_ID", args.aws_access_key)
+    if args.aws_secret_key:
+        _os.environ.setdefault("AWS_SECRET_ACCESS_KEY",
+                               args.aws_secret_key)
+    if args.aws_region:
+        _os.environ.setdefault("AWS_DEFAULT_REGION", args.aws_region)
+    if args.aws_endpoint_url:
+        _os.environ.setdefault("AWS_ENDPOINT_URL", args.aws_endpoint_url)
+    if not args.storage_parallel_snapshot_creation:
+        from .storage.durability import snapshot as _snap
+        _snap.POOL_WORKERS = 1
     timeout_sec = (args.query_execution_timeout_sec
                    if args.query_execution_timeout_sec is not None
                    else args.execution_timeout_sec)
@@ -143,6 +199,10 @@ def build_database(args) -> InterpreterContext:
         "auth_password_permit_null": args.auth_password_permit_null,
         "advertised_address": (args.bolt_advertised_address
                                or f"localhost:{args.bolt_port}"),
+        "log_failed_queries": args.log_failed_queries,
+        "debug_query_plans": args.debug_query_plans,
+        "bolt_server_name": args.bolt_server_name_for_init,
+        "hops_limit_partial_results": args.hops_limit_partial_results,
     }
     # multi-tenancy: every server runs behind a DbmsHandler; the default
     # database recovers from (and persists to) the root data directory
@@ -264,11 +324,17 @@ def build_database(args) -> InterpreterContext:
         # SSO works without durable auth too (module-managed identities)
         ictx.auth_store = Auth(module_mappings=auth_modules)
 
-    if args.init_file:
-        interp = Interpreter(ictx, system=True)
-        with open(args.init_file) as f:
-            for statement in split_statements(f.read()):
-                interp.execute(statement)
+    for path in (args.init_file, args.init_data_file):
+        if path:
+            interp = Interpreter(ictx, system=True)
+            with open(path) as f:
+                for statement in split_statements(f.read()):
+                    interp.execute(statement)
+
+    if args.replication_restore_state_on_startup:
+        from .replication.main_role import ReplicationState
+        ictx.replication = ReplicationState(ictx.storage, ictx=ictx)
+        ictx.replication.restore_state()
     return ictx
 
 
@@ -322,7 +388,8 @@ async def serve(args, ictx) -> None:
     if args.monitoring_port:
         from .observability.http import start_monitoring_server
         monitoring = await start_monitoring_server(
-            args.bolt_address, args.monitoring_port, ictx)
+            args.monitoring_address or args.bolt_address,
+            args.monitoring_port, ictx)
         logging.info("monitoring endpoint on :%d", args.monitoring_port)
 
     stop = asyncio.Event()

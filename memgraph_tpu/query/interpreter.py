@@ -137,6 +137,17 @@ class Interpreter:
 
     def prepare(self, text: str, parameters: Optional[dict] = None
                 ) -> PreparedQuery:
+        try:
+            return self._prepare_inner(text, parameters)
+        except Exception:
+            if self.ctx.config.get("log_failed_queries"):
+                import logging
+                logging.getLogger(__name__).warning(
+                    "query failed: %s", text.strip())
+            raise
+
+    def _prepare_inner(self, text: str, parameters: Optional[dict] = None
+                       ) -> PreparedQuery:
         parameters = parameters or {}
         audit = getattr(self.ctx, "audit", None)
         if audit is not None:
@@ -754,6 +765,11 @@ class Interpreter:
             strip = strip.split(None, 1)[1] if " " in strip else strip
         plan, columns = self.ctx.cached_plan(strip, query)
 
+        if self.ctx.config.get("debug_query_plans"):
+            import logging
+            logging.getLogger(__name__).debug(
+                "plan for %s:\n%s", strip, "\n".join(plan_to_rows(plan)))
+
         if self._in_explicit_txn and _plan_has_batched_apply(plan):
             raise TransactionException(
                 "CALL { } IN TRANSACTIONS is not allowed inside an "
@@ -820,6 +836,13 @@ class Interpreter:
                                     View.NEW, self.ctx, timeout_checker,
                                     memory=QueryMemoryTracker(mem_limit))
         exec_ctx.eval_ctx.username = self.username
+        # flag default, overridable per-instance at runtime via
+        # SET DATABASE SETTING 'hops_limit_partial_results'
+        exec_ctx.hops_partial = bool(self.ctx.config.get(
+            "hops_limit_partial_results", True))
+        hp = self._settings().get("hops_limit_partial_results")
+        if hp is not None:
+            exec_ctx.hops_partial = hp.strip().lower() != "false"
         if owns:
             exec_ctx._txn_owner = _TxnOwner(self, exec_ctx)
         self._exec_ctx = exec_ctx

@@ -48,17 +48,26 @@ class ExecutionContext:
                       "properties_set": 0, "labels_added": 0,
                       "labels_removed": 0}
         self.hops_budget = None  # USING HOPS LIMIT (query/hops_limit.hpp)
+        # when the budget runs out: True -> stop expanding (partial
+        # results), False -> raise. Reference default true
+        # (run_time_configurable.cpp:77 hops_limit_partial_results)
+        self.hops_partial = True
 
     def check_abort(self):
         if self.timeout_checker is not None:
             self.timeout_checker()
 
-    def consume_hop(self):
+    def consume_hop(self) -> bool:
+        """False = budget exhausted in partial-results mode (caller stops
+        expanding); raises when partial results are disabled."""
         if self.hops_budget is not None:
             self.hops_budget -= 1
             if self.hops_budget < 0:
+                if self.hops_partial:
+                    return False
                 raise QueryException(
                     "hops limit exceeded (USING HOPS LIMIT)")
+        return True
 
     @property
     def storage(self):
@@ -286,7 +295,8 @@ class Expand(LogicalOperator):
                 prebound = None
             used = _used_edge_gids(frame, self.prev_edge_symbols)
             for ea, other in self._edges(ctx, from_v, type_ids):
-                ctx.consume_hop()
+                if not ctx.consume_hop():
+                    break
                 if ea.gid in used:
                     continue
                 if prebound is not None and ea.gid != prebound.gid:
@@ -372,7 +382,8 @@ class ExpandVariable(LogicalOperator):
                 if depth >= max_hops:
                     return
                 for ea, other in Expand._edges(self, ctx, node, type_ids):
-                    ctx.consume_hop()
+                    if not ctx.consume_hop():
+                        break
                     if ea.gid in used_gids:
                         continue
                     if prebound is not None and (

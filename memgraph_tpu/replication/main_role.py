@@ -418,6 +418,53 @@ class ReplicationState:
 
     # --- role management ----------------------------------------------------
 
+    # --- durable state (reference: --replication-restore-state-on-startup,
+    # replication/state.hpp persisted role + registry) ----------------------
+
+    def _kv(self):
+        return getattr(self.ictx, "kvstore", None) if self.ictx else None
+
+    def _persist_state(self) -> None:
+        kv = self._kv()
+        if kv is None:
+            return
+        import json
+        with self._lock:
+            doc = {"role": self.role,
+                   "listen_port": (self.replica_server.port
+                                   if self.replica_server else 0),
+                   "replicas": [
+                       {"name": r.name, "address": r.address,
+                        "mode": r.mode.name}
+                       for r in self.replicas.values()]}
+        kv.put("replication:state", json.dumps(doc))
+
+    def restore_state(self) -> None:
+        """Re-apply the persisted role + replica registry (called at
+        startup under --replication-restore-state-on-startup)."""
+        kv = self._kv()
+        if kv is None:
+            return
+        import json
+        raw = kv.get_str("replication:state")
+        if not raw:
+            return
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            return
+        if doc.get("role") == "replica" and doc.get("listen_port"):
+            self.set_role_replica("0.0.0.0", int(doc["listen_port"]))
+            return
+        for spec in doc.get("replicas", ()):
+            try:
+                self.register_replica(spec["name"], spec["address"],
+                                      ReplicationMode[spec["mode"]])
+            except Exception:
+                # an unreachable replica must not block startup — it can
+                # be re-registered (or will reconnect) later
+                continue
+
     def set_role_replica(self, host: str, port: int) -> None:
         from ..exceptions import QueryException
         from .replica import ReplicaServer
@@ -438,6 +485,7 @@ class ReplicationState:
                     f"cannot listen on {host}:{port}: {e}") from e
             self.replica_server = server
             self.role = "replica"
+        self._persist_state()
 
     def set_role_main(self) -> None:
         with self._lock:
@@ -445,6 +493,7 @@ class ReplicationState:
                 self.replica_server.stop()
                 self.replica_server = None
             self.role = "main"
+        self._persist_state()
 
     # --- replica registry ---------------------------------------------------
 
@@ -473,6 +522,7 @@ class ReplicationState:
             client.close()
             raise QueryException(
                 f"cannot register replica {name!r}: {e}") from e
+        self._persist_state()
         self._start_heartbeat()
 
     def drop_replica(self, name: str) -> None:
@@ -483,6 +533,7 @@ class ReplicationState:
         if client is None:
             raise QueryException(f"replica {name!r} is not registered")
         client.close()
+        self._persist_state()
 
     # --- liveness -----------------------------------------------------------
 

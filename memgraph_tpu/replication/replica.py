@@ -56,6 +56,14 @@ class ReplicaServer:
         self._stop.set()
         if self._sock is not None:
             try:
+                # shutdown() wakes the blocked accept() — close() alone
+                # leaves the accept thread holding the fd, so the port
+                # stays bound and a REPLICA->MAIN->REPLICA role flip on
+                # the same port fails with EADDRINUSE
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._sock.close()
             except OSError:
                 pass

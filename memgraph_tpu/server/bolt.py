@@ -452,8 +452,10 @@ class BoltSession:
                 self.interpreter.username = principal
         if self.authenticated:
             self._register_session()
+        server_name = (getattr(self.ictx, "config", {}) or {}).get(
+            "bolt_server_name") or "Neo4j/5.2.0 compatible (memgraph-tpu)"
         self.send_success({
-            "server": "Neo4j/5.2.0 compatible (memgraph-tpu)",
+            "server": server_name,
             "connection_id": "bolt-1",
         })
         return True

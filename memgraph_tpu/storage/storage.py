@@ -45,6 +45,14 @@ class StorageConfig:
     snapshot_on_exit: bool = False
     properties_on_edges: bool = True
     snapshot_retention_count: int = 3
+    # skip the delta/WAL record when a SET writes the identical value
+    # (reference: --storage-delta-on-identical-property-update)
+    delta_on_identical_property_update: bool = True
+    # auto-create label / edge-type indexes for labels and types first
+    # touched by a commit (reference: --storage-automatic-*-index-
+    # creation-enabled)
+    automatic_label_index: bool = False
+    automatic_edge_type_index: bool = False
 
 
 class _Namer:
@@ -272,10 +280,29 @@ class Accessor:
             self._finished = True
             raise
         self._finished = True
+        self._auto_create_indexes()
         # hooks run strictly after the commit is final: a failing hook must
         # never trigger rollback of already-visible data
         for hook in self.storage.on_commit_hooks:
             hook(self.txn, commit_ts)
+
+    def _auto_create_indexes(self) -> None:
+        """--storage-automatic-*-index-creation-enabled: index any label /
+        edge type this commit touched that has no index yet (reference:
+        flags/general.cpp; runs post-commit so the build scans committed
+        state)."""
+        cfg = self.storage.config
+        if cfg.automatic_label_index:
+            idx = self.storage.indices.label
+            for v in self.txn.touched_vertices.values():
+                for lid in v.labels:
+                    if not idx.has(lid):
+                        self.storage.create_label_index(lid)
+        if cfg.automatic_edge_type_index:
+            idx = self.storage.indices.edge_type
+            for e in self.txn.touched_edges.values():
+                if not idx.has(e.edge_type):
+                    self.storage.create_edge_type_index(e.edge_type)
 
     def abort(self) -> None:
         if self._finished:
@@ -493,6 +520,10 @@ class Accessor:
             if vertex.deleted:
                 raise StorageError("cannot modify a deleted vertex")
             old = vertex.properties.get(prop_id)
+            if not self.storage.config.delta_on_identical_property_update \
+                    and old == value and type(old) is type(value) \
+                    and value is not None:
+                return old      # identical rewrite: no delta, no WAL
             if not self._analytical:
                 push_delta(vertex, self.txn, DeltaAction.SET_PROPERTY,
                            (prop_id, old))

@@ -1,0 +1,141 @@
+"""Behavior behind the widened flag surface (reference: src/flags/*.cpp).
+
+Every flag tested here is WIRED — the test drives the behavior, not just
+argument parsing.
+"""
+
+import pytest
+
+from memgraph_tpu.main import build_config, build_database
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+from memgraph_tpu.storage.storage import StorageConfig
+
+
+def test_delta_on_identical_property_update_disabled():
+    storage = InMemoryStorage(StorageConfig(
+        delta_on_identical_property_update=False))
+    acc = storage.access()
+    pid = storage.property_mapper.name_to_id("x")
+    v = acc.create_vertex()
+    v.set_property(pid, 7)
+    before = len(acc.txn.deltas)
+    v.set_property(pid, 7)          # identical rewrite: no delta
+    assert len(acc.txn.deltas) == before
+    v.set_property(pid, 8)          # real change: delta
+    assert len(acc.txn.deltas) == before + 1
+    # type-sensitive: 7 -> 7.0 changes the stored type, must delta
+    v.set_property(pid, 7.0)
+    assert len(acc.txn.deltas) == before + 2
+    acc.commit()
+
+
+def test_delta_on_identical_default_still_writes():
+    storage = InMemoryStorage()
+    acc = storage.access()
+    pid = storage.property_mapper.name_to_id("x")
+    v = acc.create_vertex()
+    v.set_property(pid, 7)
+    before = len(acc.txn.deltas)
+    v.set_property(pid, 7)
+    assert len(acc.txn.deltas) == before + 1
+    acc.commit()
+
+
+def test_automatic_index_creation():
+    storage = InMemoryStorage(StorageConfig(
+        automatic_label_index=True, automatic_edge_type_index=True))
+    interp = Interpreter(InterpreterContext(storage))
+    interp.execute("CREATE (:Auto {x: 1})-[:REL]->(:Auto {x: 2})")
+    lid = storage.label_mapper.maybe_name_to_id("Auto")
+    tid = storage.edge_type_mapper.maybe_name_to_id("REL")
+    assert storage.indices.label.has(lid)
+    assert storage.indices.edge_type.has(tid)
+    # and they actually serve queries
+    _, rows, _ = interp.execute("SHOW INDEX INFO")
+    kinds = {r[0] for r in rows}
+    assert "label" in kinds and "edge-type" in kinds
+
+
+def test_no_automatic_index_by_default():
+    storage = InMemoryStorage()
+    interp = Interpreter(InterpreterContext(storage))
+    interp.execute("CREATE (:Auto)")
+    lid = storage.label_mapper.maybe_name_to_id("Auto")
+    assert not storage.indices.label.has(lid)
+
+
+def test_init_data_file_runs_after_init_file(tmp_path):
+    (tmp_path / "schema.cypherl").write_text(
+        "CREATE INDEX ON :P(x);\n")
+    (tmp_path / "data.cypherl").write_text(
+        "CREATE (:P {x: 1});\nCREATE (:P {x: 2});\n")
+    args = build_config([
+        "--data-directory", str(tmp_path / "dd"),
+        "--init-file", str(tmp_path / "schema.cypherl"),
+        "--init-data-file", str(tmp_path / "data.cypherl"),
+    ])
+    ictx = build_database(args)
+    interp = Interpreter(ictx)
+    _, rows, _ = interp.execute("MATCH (p:P) RETURN count(p)")
+    assert rows[0][0] == 2
+    _, rows, _ = interp.execute("SHOW INDEX INFO")
+    assert any(r[0] == "label+property" for r in rows)
+
+
+def test_replication_state_restore(tmp_path):
+    from memgraph_tpu.replication.main_role import ReplicationState
+    from memgraph_tpu.storage.kvstore import KVStore
+
+    storage = InMemoryStorage()
+    ctx = InterpreterContext(storage)
+    ctx.kvstore = KVStore(str(tmp_path / "kv"))
+    state = ReplicationState(storage, ictx=ctx)
+    state.set_role_replica("127.0.0.1", 0)
+    port = state.replica_server.port
+    assert port > 0
+    state.replica_server.stop()
+
+    # a fresh process: restore from the kvstore
+    storage2 = InMemoryStorage()
+    ctx2 = InterpreterContext(storage2)
+    ctx2.kvstore = KVStore(str(tmp_path / "kv"))
+    state2 = ReplicationState(storage2, ictx=ctx2)
+    assert state2.role == "main"
+    state2.restore_state()
+    assert state2.role == "replica"
+    assert state2.replica_server is not None
+    state2.replica_server.stop()
+
+
+def test_replication_restore_skips_unreachable_replicas(tmp_path):
+    import json
+    from memgraph_tpu.replication.main_role import ReplicationState
+    from memgraph_tpu.storage.kvstore import KVStore
+
+    ctx = InterpreterContext(InMemoryStorage())
+    ctx.kvstore = KVStore(str(tmp_path / "kv"))
+    ctx.kvstore.put("replication:state", json.dumps(
+        {"role": "main", "listen_port": 0,
+         "replicas": [{"name": "gone", "address": "127.0.0.1:1",
+                       "mode": "ASYNC"}]}))
+    state = ReplicationState(ctx.storage, ictx=ctx)
+    state.restore_state()        # must not raise
+    assert state.role == "main" and not state.replicas
+
+
+def test_hops_limit_partial_results_flag_default():
+    ctx = InterpreterContext(InMemoryStorage(),
+                             {"hops_limit_partial_results": False})
+    interp = Interpreter(ctx)
+    interp.execute("CREATE (:H)-[:E]->(:H)-[:E]->(:H)-[:E]->(:H)")
+    from memgraph_tpu.exceptions import QueryException
+    with pytest.raises(QueryException):
+        interp.execute("MATCH (a)-[e]->(b) USING HOPS LIMIT 1 "
+                       "RETURN count(*)")
+
+
+def test_bolt_server_name_flag_parses():
+    args = build_config(["--bolt-server-name-for-init", "Neo4j/5.2.0"])
+    assert args.bolt_server_name_for_init == "Neo4j/5.2.0"

@@ -118,9 +118,15 @@ def test_using_index_hint(db):
 
 def test_hops_limit(db):
     from memgraph_tpu.exceptions import QueryException
-    # full traversal exceeds 2 hops-worth of edge visits
+    # default (reference run_time_configurable.cpp:77): partial results —
+    # expansion stops when the budget is spent
+    rows = run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 2 RETURN count(*)")
+    assert rows[0][0] <= 2
+    # hops_limit_partial_results=false: exceeding the budget is an error
+    run(db, "SET DATABASE SETTING 'hops_limit_partial_results' TO 'false'")
     with pytest.raises(QueryException):
         run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 2 RETURN count(*)")
+    run(db, "SET DATABASE SETTING 'hops_limit_partial_results' TO 'true'")
     rows = run(db, "MATCH (a)-[e]->(b) USING HOPS LIMIT 100 RETURN count(*)")
     assert rows == [[5]]
 
