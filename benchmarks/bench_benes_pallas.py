@@ -1,0 +1,130 @@
+"""Micro-benchmark: Benes apply — XLA per-stage rolls vs pallas 3-pass.
+
+Uses the REAL masks from the cached 10M-edge bench plan when present
+(.bench_cache/mxu_plan_*.npz), else a random permutation at --n.
+
+Usage:  python benchmarks/bench_benes_pallas.py [--n 24] [--iters 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=24)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--K", type=int, default=18)
+    ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    ap.add_argument("--which", default="both",
+                    choices=["both", "pallas", "xla"])
+    args = ap.parse_args()
+
+    from memgraph_tpu.utils.jax_cache import ensure_compile_cache
+    ensure_compile_cache()
+    import jax
+    import jax.numpy as jnp
+    from memgraph_tpu.ops import spmv_mxu
+    from memgraph_tpu.ops.benes_pallas import (build_pallas_masks,
+                                               benes_apply_pallas)
+    from memgraph_tpu.ops.spmv_mxu import _benes_apply_rolls, \
+        _unpack_mask_words
+    from memgraph_tpu.ops.blob import pack_blob, unblob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cands = glob.glob(os.path.join(repo, ".bench_cache", "mxu_plan_*.npz"))
+    masks_packed = None
+    for c in cands:
+        z = np.load(c)
+        if int(z["net_log2"]) == args.n:
+            masks_packed = z["masks_packed"]
+            print(f"using real plan masks from {os.path.basename(c)}",
+                  file=sys.stderr)
+            break
+    if masks_packed is None:
+        from memgraph_tpu.ops.benes import benes_route, pack_masks
+        print(f"routing random perm at 2^{args.n} (slow at large n)...",
+              file=sys.stderr)
+        rng = np.random.default_rng(0)
+        from memgraph_tpu.ops.native import benes_route_native
+        perm = rng.permutation(1 << args.n)
+        masks_packed = benes_route_native(perm)
+        if masks_packed is None:
+            masks_packed = pack_masks(benes_route(perm))
+
+    N = 1 << args.n
+    rows = N // 128
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    rng = np.random.default_rng(1)
+    x_np = rng.standard_normal(N).astype(np.float32).reshape(rows, 128)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} platform={dev.platform}", file=sys.stderr)
+
+    def timeit(fn, x):
+        t0 = time.perf_counter()
+        out = fn(x)
+        _ = float(out[0, 0])
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _i in range(3):
+            t0 = time.perf_counter()
+            out = fn(x)
+            _ = float(out[0, 0])
+            best = min(best, time.perf_counter() - t0)
+        return compile_s, best
+
+    x_dev = jax.device_put(x_np.astype(dtype))
+    iters = args.iters
+
+    if args.which in ("both", "pallas"):
+        spec, midw, outw = build_pallas_masks(masks_packed, args.n, K=args.K)
+        print(f"pallas spec: outer={len(spec.outer_down)}+"
+              f"{len(spec.outer_up)} mid={len(spec.mid_stages)} "
+              f"planes={spec.mid_planes}", file=sys.stderr)
+        midw_d = jax.device_put(midw)
+        outw_d = jax.device_put(outw) if outw is not None else None
+
+        @jax.jit
+        def run_pallas(x):
+            def body(_, x):
+                return benes_apply_pallas(x, midw_d, outw_d, spec)
+            return jax.lax.fori_loop(0, iters, body, x)
+
+        comp, best = timeit(run_pallas, x_dev)
+        per = best / iters * 1e3
+        print(f"pallas: compile={comp:.2f}s  {iters} iters best={best:.4f}s"
+              f"  -> {per:.3f} ms/apply")
+
+    if args.which in ("both", "xla"):
+        live = [bool(r.any()) for r in masks_packed]
+        blob_np, segs = pack_blob({"masks": ("bits", masks_packed)})
+        blob_d = jax.device_put(blob_np)
+
+        @jax.jit
+        def run_xla(x):
+            masks2 = _unpack_mask_words(unblob(blob_d, segs, "masks"),
+                                        args.n)
+            m2 = masks2.reshape(masks_packed.shape[0], rows, 128)
+
+            def body(_, x):
+                return _benes_apply_rolls(x, m2, args.n, live_stages=live)
+            return jax.lax.fori_loop(0, iters, body, x)
+
+        comp, best = timeit(run_xla, x_dev)
+        per = best / iters * 1e3
+        print(f"xla:    compile={comp:.2f}s  {iters} iters best={best:.4f}s"
+              f"  -> {per:.3f} ms/apply")
+
+
+if __name__ == "__main__":
+    main()

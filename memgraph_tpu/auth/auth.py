@@ -102,21 +102,35 @@ class Auth:
         username = reply.get("username") or principal
         if not isinstance(username, str) or not username:
             return None
-        role = reply.get("role")
+        # modules may return a single "role" or a "roles" list (the OIDC
+        # flow maps one IdP role to several local roles)
+        roles = reply.get("roles")
+        if not isinstance(roles, list):
+            role = reply.get("role")
+            roles = [role] if isinstance(role, str) and role else []
+        roles = [r for r in roles if isinstance(r, str) and r]
         with self._lock:
+            changed = False
             user = self._users.get(username)
             if user is None:
                 user = User(username, None, external=True)
                 self._users[username] = user
-            if isinstance(role, str) and role:
-                if role not in self._roles:
-                    self._roles[role] = Role(role)
-                user.roles = [role]
+                changed = True
+            if roles:
+                for role in roles:
+                    if role not in self._roles:
+                        self._roles[role] = Role(role)
+                        changed = True
+                new_roles = list(dict.fromkeys(roles))
             else:
                 # the module is authoritative on EVERY login: a reply
                 # without a role revokes previous module-granted roles
-                user.roles = []
-            self._save()
+                new_roles = []
+            if user.roles != new_roles:
+                user.roles = new_roles
+                changed = True
+            if changed:   # reconnect storms must not rewrite the store
+                self._save()
         return username
 
     # --- users --------------------------------------------------------------

@@ -109,12 +109,6 @@ class Duration:
         return f"{sign}P{days}DT{hours}H{minutes}M{seconds}{frac}S"
 
 
-def _wrap(cls_name):
-    """Make a thin frozen wrapper over a datetime payload with ordering."""
-    # implemented explicitly below for clarity; helper unused
-    raise NotImplementedError
-
-
 @total_ordering
 @dataclass(frozen=True)
 class Date:
