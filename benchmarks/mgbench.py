@@ -11,8 +11,15 @@ methodology: isolated query groups, latency percentiles + throughput):
   aggregate         global count/avg
   analytical        CALL pagerank.get() (device path)
 
+Round 5 additions (VERDICT r4 item 4): a supernode-skew workload
+(/root/reference/tests/mgbench/workloads/supernode.py — one hub node
+with CARDINALITY in-edges), a multiprocess read-executor group
+(server/mp_executor.py), and `--out OLTP_rN.json` so every round ships
+a tracked OLTP artifact, not prose.
+
 Usage: python benchmarks/mgbench.py [--nodes 10000] [--edges 50000]
-Prints a JSON report; used manually and by round notes, not by the driver.
+                                    [--supernode 20000] [--out FILE]
+Prints a JSON report; the driver-tracked artifact is OLTP_r{N}.json.
 """
 
 from __future__ import annotations
@@ -90,6 +97,12 @@ def main():
                    help="existing server port (0 = spawn in-process)")
     p.add_argument("--clients", type=int, default=8,
                    help="connections for the multi-client scaling group")
+    p.add_argument("--supernode", type=int, default=20_000,
+                   help="in-degree of the supernode hub (0 = skip)")
+    p.add_argument("--mp-workers", type=int, default=4,
+                   help="processes for the mp-executor group (0 = skip)")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this file")
     args = p.parse_args()
 
     import os
@@ -218,6 +231,76 @@ def main():
     if one and many:
         many["scaling_vs_1_client"] = round(
             many["aggregate_qps"] / one["aggregate_qps"], 2)
+    # supernode skew (reference workload: one hub, CARDINALITY spokes):
+    # expansion over the hub, hub-touching writes, MERGE over the hub
+    if args.supernode:
+        print(f"loading supernode hub with {args.supernode} spokes ...",
+              file=sys.stderr)
+        client.execute("CREATE INDEX ON :SNode(id)")
+        client.execute("CREATE (:Supernode {id: 0})")
+        for start in range(0, args.supernode, batch):
+            ids = list(range(start, min(start + batch, args.supernode)))
+            client.execute(
+                "MATCH (s:Supernode {id: 0}) UNWIND $ids AS i "
+                "CREATE (s)<-[:EDGE]-(:SNode {id: i})", {"ids": ids})
+        groups += [
+            run_group(client, "supernode_expand_count",
+                      "MATCH (s:Supernode {id: 0})<-[:EDGE]-(n) "
+                      "RETURN count(n)", None,
+                      max(args.iterations // 10, 5), warmup=1),
+            run_group(client, "supernode_two_hop",
+                      "MATCH (n:SNode {id: $id})-[:EDGE]->(s)"
+                      "<-[:EDGE]-(m) RETURN count(m)",
+                      lambda: {"id": rng.randrange(args.supernode)},
+                      max(args.iterations // 30, 3)),
+            run_group(client, "supernode_unwind_writes",
+                      f"UNWIND range(1, {args.supernode}) AS x "
+                      "MATCH (s:Supernode {id: 0}) SET s.prop = x", None,
+                      max(args.iterations // 30, 3)),
+            run_group(client, "supernode_merge_edges",
+                      "MATCH (s:Supernode {id: 0}), (n:SNode {id: $id}) "
+                      "MERGE (s)<-[:EDGE]-(n)",
+                      lambda: {"id": rng.randrange(args.supernode)},
+                      max(args.iterations // 3, 10)),
+        ]
+
+    # multiprocess read executor (server/mp_executor.py): same point
+    # reads dispatched over N forked workers with independent GILs —
+    # the architectural answer to the GIL ceiling (1-core hosts show ~1x)
+    if args.mp_workers and not args.port:
+        import threading as _threading
+        from memgraph_tpu.server.mp_executor import MPReadExecutor
+        ex = MPReadExecutor(server.ictx, n_workers=args.mp_workers)
+        try:
+            for _ in range(20):
+                ex.execute("MATCH (n:User {id: $id}) RETURN n.age",
+                           {"id": rng.randrange(args.nodes)})
+            per_thread = max(args.iterations // 2, 50)
+            t0 = time.perf_counter()
+
+            def _pump():
+                local = random.Random()
+                for _ in range(per_thread):
+                    ex.execute("MATCH (n:User {id: $id}) RETURN n.age",
+                               {"id": local.randrange(args.nodes)})
+            threads = [_threading.Thread(target=_pump)
+                       for _ in range(args.mp_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            groups.append({
+                "name": f"point_read_mp_executor_{args.mp_workers}w",
+                "workers": args.mp_workers,
+                "aggregate_qps": round(per_thread * args.mp_workers / wall,
+                                       1)})
+        except Exception as e:  # noqa: BLE001
+            groups.append({"name": "point_read_mp_executor",
+                           "error": f"{type(e).__name__}: {e}"})
+        finally:
+            ex.close()
+
     client.close()
     # the analytical group gets its own client with a wide timeout (first
     # CALL pays XLA compilation) and one discarded warm-up run
@@ -227,10 +310,14 @@ def main():
         "CALL pagerank.get() YIELD rank RETURN max(rank)", None, 3,
         warmup=1))
     analytical.close()
-    report = {"workload": "pokec-flavored", "nodes": args.nodes,
-              "edges": args.edges, "load_records_per_sec":
+    report = {"workload": "pokec-flavored+supernode", "nodes": args.nodes,
+              "edges": args.edges, "supernode_degree": args.supernode,
+              "load_records_per_sec":
               round((args.nodes + args.edges) / load_s, 1),
               "groups": groups}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))
 
 

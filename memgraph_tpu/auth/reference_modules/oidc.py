@@ -36,13 +36,22 @@ def _b64url_uint(data: str) -> int:
     return int.from_bytes(_b64url(data), "big")
 
 
+_JWKS_CACHE: dict = {}          # url -> (fetched_at, jwks)
+JWKS_TTL_SEC = 300.0            # IdPs rate-limit their keys endpoints
+
+
 def _fetch_jwks(url: str, cafile=None) -> dict:
+    cached = _JWKS_CACHE.get(url)
+    if cached and time.time() - cached[0] < JWKS_TTL_SEC:
+        return cached[1]
     ctx = None
     if url.startswith("https"):
         import ssl
         ctx = ssl.create_default_context(cafile=cafile)
     with urllib.request.urlopen(url, timeout=10, context=ctx) as resp:
-        return json.loads(resp.read().decode("utf-8"))
+        jwks = json.loads(resp.read().decode("utf-8"))
+    _JWKS_CACHE[url] = (time.time(), jwks)
+    return jwks
 
 
 def _verify_rs256(token: str, jwk: dict) -> dict:

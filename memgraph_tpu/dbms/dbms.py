@@ -91,7 +91,16 @@ class DbmsHandler:
                 from ..storage.durability.recovery import (recover,
                                                            wire_durability)
                 if recover_now:
-                    recover(storage)
+                    if cfg.allow_recovery_failure:
+                        try:
+                            recover(storage)
+                        except Exception as e:  # noqa: BLE001
+                            import logging
+                            logging.getLogger(__name__).error(
+                                "recovery failed (continuing, "
+                                "--storage-allow-recovery-failure): %s", e)
+                    else:
+                        recover(storage)
                 if cfg.wal_enabled:
                     wire_durability(storage)
         ictx = InterpreterContext(storage, dict(self._interp_config))

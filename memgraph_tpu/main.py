@@ -62,7 +62,15 @@ def build_config(argv=None) -> argparse.Namespace:
                         "'saml:/path/to/module.py;oidc:/path/other.py' "
                         "(reference: src/auth/module.hpp)")
     p.add_argument("--monitoring-port", type=int, default=0,
-                   help="Prometheus metrics HTTP port (0 = disabled)")
+                   help="websocket monitoring port: live log streaming + "
+                        "metrics frames, as the reference's Lab channel "
+                        "(communication/websocket/listener.cpp); "
+                        "0 = disabled (reference default 7444)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="Prometheus/JSON metrics HTTP port "
+                        "(0 = disabled; reference default 9091)")
+    p.add_argument("--metrics-address", default=None,
+                   help="bind address for the metrics HTTP endpoint")
     p.add_argument("--audit-enabled",
                    action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--storage-snapshot-interval-sec", type=int, default=0,
@@ -156,10 +164,111 @@ def build_config(argv=None) -> argparse.Namespace:
     p.add_argument("--auth-password-permit-null",
                    action=argparse.BooleanOptionalAction, default=True,
                    help="allow users without a password")
-    return p.parse_args(argv)
+    # --- round-5 flag surface (reference: src/flags/*.cpp) ------------------
+    p.add_argument("--storage-property-store-compression-enabled",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="zlib-compress large property blobs (reference: "
+                        "storage/v2/property_store.hpp:38)")
+    p.add_argument("--storage-property-store-compression-level",
+                   choices=["low", "mid", "high"], default="mid",
+                   help="zlib level: low=1 mid=6 high=9")
+    p.add_argument("--license-key", default="",
+                   help="enterprise license key (utils/license.py)")
+    p.add_argument("--organization-name", default="",
+                   help="organization the license key was issued for")
+    p.add_argument("--data-recovery-on-startup", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="recover snapshot+WAL on startup (newer alias of "
+                        "--storage-recover-on-startup; wins when both set)")
+    p.add_argument("--log-query-plan",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="log every prepared query's plan at INFO")
+    p.add_argument("--log-min-duration-ms", type=int, default=0,
+                   help="log queries slower than this (0 = off)")
+    p.add_argument("--metrics-format", choices=["JSON", "PROMETHEUS"],
+                   default="JSON",
+                   help="default metrics HTTP payload format")
+    p.add_argument("--schema-info-enabled",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="collect + serve SHOW SCHEMA INFO")
+    p.add_argument("--storage-gc-aggressive",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="run GC after every commit, not just the timer")
+    p.add_argument("--timezone", default=None,
+                   help="IANA timezone for temporal functions "
+                        "(sets TZ process-wide)")
+    p.add_argument("--strict-flag-check",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="unknown flags abort startup (off: warn + ignore, "
+                        "for config files shared across versions)")
+    p.add_argument("--storage-enable-schema-metadata",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="alias of --schema-info-enabled (reference name)")
+    p.add_argument("--storage-enable-edges-metadata",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="include per-edge-type counts in STORAGE INFO")
+    p.add_argument("--storage-parallel-schema-recovery",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="decode snapshot chunks on the worker pool")
+    p.add_argument("--storage-allow-recovery-failure",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="start with partial/empty data when durability "
+                        "files are damaged instead of refusing to boot")
+    p.add_argument("--storage-snapshot-interval", default=None,
+                   help="snapshot cadence in seconds (reference also "
+                        "accepts cron syntax; numeric-only here, alias "
+                        "of --storage-snapshot-interval-sec)")
+    p.add_argument("--coordinator-hostname", default=None,
+                   help="hostname this coordinator advertises to peers "
+                        "and in ROUTE responses")
+    p.add_argument("--experimental-enabled", default="",
+                   help="comma-separated experimental feature gates "
+                        "(recorded in runtime settings; all features in "
+                        "this build are stable, so gates are advisory)")
+    p.add_argument("--experimental-config", default="",
+                   help="JSON config for experimental features")
+    p.add_argument("--query-callable-mappings-path", default=None,
+                   help="JSON {alias: procedure} mapping file so "
+                        "Neo4j-style CALL names resolve locally")
+    if argv is None:
+        import sys as _sys
+        argv = _sys.argv[1:]
+    known, unknown = p.parse_known_args(argv)
+    if unknown:
+        if known.strict_flag_check:
+            p.error(f"unrecognized arguments: {' '.join(unknown)} "
+                    "(use --no-strict-flag-check to ignore)")
+        import logging as _logging
+        _logging.getLogger(__name__).warning(
+            "ignoring unknown flags (--no-strict-flag-check): %s", unknown)
+    return known
 
 
 def build_database(args) -> InterpreterContext:
+    if args.timezone:
+        # process-wide, as the reference's --timezone configures the
+        # server-side zone used by temporal functions
+        _os.environ["TZ"] = args.timezone
+        import time as _time
+        if hasattr(_time, "tzset"):
+            _time.tzset()
+    if args.storage_property_store_compression_enabled:
+        from .storage.property_store import COMPRESSION
+        COMPRESSION["enabled"] = True
+        COMPRESSION["level"] = {"low": 1, "mid": 6, "high": 9}[
+            args.storage_property_store_compression_level]
+    if args.storage_snapshot_interval:
+        try:
+            args.storage_snapshot_interval_sec = int(
+                args.storage_snapshot_interval)
+        except ValueError:
+            logging.warning("--storage-snapshot-interval: only numeric "
+                            "seconds are supported; ignoring %r",
+                            args.storage_snapshot_interval)
+    recover_flag = args.storage_recover_on_startup
+    if args.data_recovery_on_startup is not None:
+        recover_flag = args.data_recovery_on_startup
+    args.storage_recover_on_startup = recover_flag
     storage_config = StorageConfig(
         storage_mode=StorageMode(args.storage_mode),
         isolation_level=IsolationLevel(args.isolation_level),
@@ -174,7 +283,12 @@ def build_database(args) -> InterpreterContext:
             args.storage_automatic_label_index_creation_enabled),
         automatic_edge_type_index=(
             args.storage_automatic_edge_type_index_creation_enabled),
+        gc_aggressive=args.storage_gc_aggressive,
+        allow_recovery_failure=args.storage_allow_recovery_failure,
     )
+    if not args.storage_parallel_schema_recovery:
+        from .storage.durability import snapshot as _snap_mod
+        _snap_mod.POOL_WORKERS = 1
     if args.aws_access_key:
         _os.environ.setdefault("AWS_ACCESS_KEY_ID", args.aws_access_key)
     if args.aws_secret_key:
@@ -203,6 +317,16 @@ def build_database(args) -> InterpreterContext:
         "debug_query_plans": args.debug_query_plans,
         "bolt_server_name": args.bolt_server_name_for_init,
         "hops_limit_partial_results": args.hops_limit_partial_results,
+        "log_query_plan": args.log_query_plan,
+        "log_min_duration_ms": args.log_min_duration_ms,
+        "schema_info_enabled": (args.schema_info_enabled
+                                and args.storage_enable_schema_metadata),
+        "storage_enable_edges_metadata":
+            args.storage_enable_edges_metadata,
+        "metrics_format": args.metrics_format,
+        "experimental_enabled": args.experimental_enabled,
+        "experimental_config": args.experimental_config,
+        "coordinator_hostname": args.coordinator_hostname,
     }
     # multi-tenancy: every server runs behind a DbmsHandler; the default
     # database recovers from (and persists to) the root data directory
@@ -275,6 +399,25 @@ def build_database(args) -> InterpreterContext:
     from .query.triggers import global_trigger_store
     global_trigger_store(ictx)
 
+    if args.license_key or args.organization_name:
+        from .utils.license import LICENSE_SETTING, ORGANIZATION_SETTING
+        from .storage.kvstore import ensure_settings
+        settings = ensure_settings(ictx)
+        if args.license_key:
+            settings.set(LICENSE_SETTING, args.license_key)
+        if args.organization_name:
+            settings.set(ORGANIZATION_SETTING, args.organization_name)
+        logging.info("license configured from flags")
+
+    if args.query_callable_mappings_path:
+        from .query.procedures.registry import global_registry as _greg
+        try:
+            n_aliases = _greg.load_callable_mappings(
+                args.query_callable_mappings_path)
+            logging.info("loaded %d callable mappings", n_aliases)
+        except (OSError, ValueError) as e:
+            logging.error("callable mappings failed to load: %s", e)
+
     if args.query_modules_directory:
         from .query.procedures.registry import global_registry
         loaded = global_registry.load_directory(args.query_modules_directory)
@@ -288,8 +431,14 @@ def build_database(args) -> InterpreterContext:
         # ROUTE role, so drivers survive losing the one they bootstrapped
         # from (reference: coordinator_instance.cpp routing table)
         # own entry uses the DIALABLE advertised address, not the bind
-        # address (0.0.0.0 would be served verbatim to remote drivers)
-        routers = [ictx.config["advertised_address"]]
+        # address (0.0.0.0 would be served verbatim to remote drivers);
+        # --coordinator-hostname overrides the host part (reference:
+        # coordination flag of the same name)
+        advertised = ictx.config["advertised_address"]
+        if args.coordinator_hostname:
+            advertised = (f"{args.coordinator_hostname}:"
+                          f"{advertised.rsplit(':', 1)[-1]}")
+        routers = [advertised]
         for part in filter(None, args.coordinator_peers.split(",")):
             pid, _, addr = part.partition("=")
             addr, _, bolt_port = addr.partition("@")
@@ -385,12 +534,22 @@ async def serve(args, ictx) -> None:
         logging.info("telemetry enabled -> %s", args.telemetry_endpoint)
 
     monitoring = None
-    if args.monitoring_port:
+    if args.metrics_port:
         from .observability.http import start_monitoring_server
         monitoring = await start_monitoring_server(
+            args.metrics_address or args.monitoring_address
+            or args.bolt_address, args.metrics_port, ictx)
+        logging.info("metrics endpoint on :%d", args.metrics_port)
+
+    ws_monitoring = None
+    if args.monitoring_port:
+        from .observability.metrics import global_metrics
+        from .observability.monitoring_ws import MonitoringServer
+        ws_monitoring = MonitoringServer(
             args.monitoring_address or args.bolt_address,
-            args.monitoring_port, ictx)
-        logging.info("monitoring endpoint on :%d", args.monitoring_port)
+            args.monitoring_port, auth=auth, metrics=global_metrics)
+        ws_monitoring.start()
+        logging.info("websocket monitoring on :%d", args.monitoring_port)
 
     stop = asyncio.Event()
 
@@ -408,6 +567,8 @@ async def serve(args, ictx) -> None:
     server.stop()
     if monitoring is not None:
         monitoring.close()
+    if ws_monitoring is not None:
+        ws_monitoring.stop()
     if args.storage_snapshot_on_exit and args.data_directory:
         from .storage.durability.snapshot import create_snapshot
         create_snapshot(ictx.storage)

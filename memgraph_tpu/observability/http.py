@@ -23,8 +23,21 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     break
             path = request.split()[1].decode() if request.split() else "/"
             if path.startswith("/metrics"):
-                body = global_metrics.prometheus_text()
-                ctype = "text/plain; version=0.0.4"
+                # --metrics-format picks the default payload; the
+                # /metrics?format= query overrides per request
+                fmt = ictx.config.get("metrics_format", "PROMETHEUS")
+                if "format=json" in path.lower():
+                    fmt = "JSON"
+                elif "format=prometheus" in path.lower():
+                    fmt = "PROMETHEUS"
+                if fmt == "JSON":
+                    body = json.dumps({
+                        name: value for name, _k, value
+                        in global_metrics.snapshot()})
+                    ctype = "application/json"
+                else:
+                    body = global_metrics.prometheus_text()
+                    ctype = "text/plain; version=0.0.4"
             else:
                 info = dict(ictx.storage.info())
                 info["running_queries"] = len(ictx.running_queries)

@@ -1,0 +1,285 @@
+"""Websocket monitoring server: live log streaming + metrics pull.
+
+Counterpart of the reference's monitoring websocket
+(/root/reference/src/communication/websocket/{listener,session}.cpp,
+wired at memgraph.cpp:1033-1044): Lab connects to --monitoring-port,
+optionally authenticates with a {"username", "password"} JSON frame,
+and receives every log line as it is emitted (the reference broadcasts
+its spdlog sink via Listener::WriteToAll; here a logging.Handler
+broadcasts to all authenticated sessions). A {"command": "show_metrics"}
+frame answers with a metrics snapshot.
+
+The RFC 6455 implementation is hand-rolled on stdlib sockets — no
+external websocket dependency exists in this image, and the subset
+needed (HTTP upgrade, masked client frames, unmasked server frames,
+ping/pong/close) is small.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+# --------------------------------------------------------------------------
+# frame codec
+# --------------------------------------------------------------------------
+
+def encode_frame(payload: bytes, opcode: int = 0x1) -> bytes:
+    """Server->client frame (FIN set, unmasked)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < (1 << 16):
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def decode_frame(sock: socket.socket):
+    """Read one client frame -> (opcode, payload). Client frames MUST be
+    masked per RFC 6455 §5.1; unmasked ones close the connection."""
+    b0, b1 = _read_exact(sock, 2)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        (n,) = struct.unpack(">H", _read_exact(sock, 2))
+    elif n == 127:
+        (n,) = struct.unpack(">Q", _read_exact(sock, 8))
+    if not masked:
+        raise ConnectionError("unmasked client frame")
+    mask = _read_exact(sock, 4)
+    data = bytearray(_read_exact(sock, n))
+    for i in range(n):
+        data[i] ^= mask[i & 3]
+    return opcode, bytes(data)
+
+
+def _handshake(sock: socket.socket) -> bool:
+    """Read the HTTP upgrade request, answer 101. False on anything else."""
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = sock.recv(4096)
+        if not chunk or len(data) > 65536:
+            return False
+        data += chunk
+    headers = {}
+    for line in data.split(b"\r\n")[1:]:
+        if b":" in line:
+            k, v = line.split(b":", 1)
+            headers[k.strip().lower()] = v.strip()
+    key = headers.get(b"sec-websocket-key")
+    if key is None or b"websocket" not in headers.get(b"upgrade", b"").lower():
+        sock.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+        return False
+    accept = base64.b64encode(hashlib.sha1(
+        key + _GUID.encode()).digest()).decode()
+    sock.sendall(
+        ("HTTP/1.1 101 Switching Protocols\r\n"
+         "Upgrade: websocket\r\n"
+         "Connection: Upgrade\r\n"
+         f"Sec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+    return True
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class MonitoringServer:
+    """Threaded websocket endpoint broadcasting logs + serving metrics.
+
+    auth: optional memgraph_tpu.auth.Auth — when it has users, sessions
+    must authenticate before receiving anything (reference: session.cpp
+    refuses unauthenticated traffic when access control is on).
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 7444,
+                 auth=None, metrics=None) -> None:
+        self.host, self.port = host, port
+        self.auth = auth
+        self.metrics = metrics
+        self._sessions: list = []       # (socket, lock) of live sessions
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self._stop = threading.Event()
+        self._log_handler: logging.Handler | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((self.host, self.port))
+        self.port = self._srv.getsockname()[1]
+        self._srv.listen(16)
+        self._srv.settimeout(0.5)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="monitoring-ws").start()
+        self._log_handler = _BroadcastHandler(self)
+        self._log_handler.setLevel(logging.INFO)
+        logging.getLogger().addHandler(self._log_handler)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._log_handler is not None:
+            logging.getLogger().removeHandler(self._log_handler)
+        with self._lock:
+            sessions = list(self._sessions)
+            self._sessions.clear()
+        for sock, _lk in sessions:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._srv is not None:
+            self._srv.close()
+
+    # -- broadcast ----------------------------------------------------------
+
+    def broadcast(self, obj: dict) -> None:
+        frame = encode_frame(json.dumps(obj).encode("utf-8"))
+        with self._lock:
+            sessions = list(self._sessions)
+        dead = []
+        for sock, lk in sessions:
+            try:
+                with lk:
+                    sock.sendall(frame)
+            except OSError:
+                dead.append((sock, lk))
+        if dead:
+            with self._lock:
+                for s in dead:
+                    if s in self._sessions:
+                        self._sessions.remove(s)
+
+    # -- internals ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._session, args=(conn,),
+                             daemon=True).start()
+
+    def _needs_auth(self) -> bool:
+        return self.auth is not None and bool(self.auth.users())
+
+    def _session(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            if not _handshake(conn):
+                conn.close()
+                return
+            lk = threading.Lock()
+            authenticated = not self._needs_auth()
+            if not authenticated:
+                opcode, payload = decode_frame(conn)
+                ok = False
+                try:
+                    creds = json.loads(payload)
+                    ok = self.auth.authenticate(
+                        str(creds.get("username", "")),
+                        str(creds.get("password", "")))
+                except (ValueError, KeyError):
+                    ok = False
+                with lk:
+                    conn.sendall(encode_frame(json.dumps({
+                        "success": bool(ok),
+                        "message": ("User has been successfully "
+                                    "authenticated!") if ok
+                        else "Authentication failed!"}).encode()))
+                if not ok:
+                    conn.close()
+                    return
+                authenticated = True
+            conn.settimeout(None)
+            with self._lock:
+                self._sessions.append((conn, lk))
+            # request loop: metrics pull, ping/pong, close
+            while not self._stop.is_set():
+                opcode, payload = decode_frame(conn)
+                if opcode == 0x8:            # close
+                    break
+                if opcode == 0x9:            # ping -> pong
+                    with lk:
+                        conn.sendall(encode_frame(payload, opcode=0xA))
+                    continue
+                if opcode != 0x1:
+                    continue
+                try:
+                    req = json.loads(payload)
+                except ValueError:
+                    continue
+                if req.get("command") == "show_metrics":
+                    snap = (self.metrics.snapshot()
+                            if self.metrics is not None else {})
+                    with lk:
+                        conn.sendall(encode_frame(json.dumps(
+                            {"event": "metrics", "metrics": snap,
+                             "timestamp": time.time()}).encode()))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            with self._lock:
+                self._sessions = [s for s in self._sessions
+                                  if s[0] is not conn]
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class _BroadcastHandler(logging.Handler):
+    """Root-logger handler pushing every record to all sessions (the
+    reference registers a spdlog sink that does Listener::WriteToAll)."""
+
+    def __init__(self, server: MonitoringServer) -> None:
+        super().__init__()
+        self._server = server
+        self._emitting = threading.local()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if getattr(self._emitting, "on", False):
+            return      # a broadcast-triggered log must not recurse
+        self._emitting.on = True
+        try:
+            self._server.broadcast({
+                "event": "log",
+                "level": record.levelname.lower(),
+                "message": record.getMessage(),
+                "logger": record.name,
+                "timestamp": record.created,
+            })
+        except Exception:   # noqa: BLE001 — logging must never throw
+            pass
+        finally:
+            self._emitting.on = False

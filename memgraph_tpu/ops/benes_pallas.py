@@ -37,7 +37,9 @@ from .benes import benes_stage_distances
 
 LANES = 128
 BITS_PER_PLANE = 31
-DEFAULT_K = 18          # middle-block log2 size: 2^18 elems = 2048 rows
+DEFAULT_K = 17          # middle-block log2 size: 2^17 elems = 1024 rows
+# (2^18 blocks hit the 16MB scoped-vmem stack limit when the kernel is
+# co-scheduled with the pagerank einsums inside one while_loop body)
 
 
 def _log2(x: int) -> int:

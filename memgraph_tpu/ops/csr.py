@@ -56,6 +56,9 @@ class DeviceGraph:
     out_degree: (n_pad,) float32 — true out-degrees (0 for padding rows)
     n_nodes / n_edges: true counts;  n_pad / e_pad: padded counts
     node_gids:  (n_nodes,) int64 host array — dense index -> storage gid
+    host_coo:   optional (src, dst, w) HOST arrays of the true edges —
+                kept so a successor snapshot can diff edges for the
+                O(delta) MXU plan refresh (ops/spmv_mxu.DeltaPlan)
     """
 
     row_ptr: object
@@ -72,6 +75,8 @@ class DeviceGraph:
     e_pad: int
     node_gids: np.ndarray
     gid_to_idx: dict = field(repr=False, hash=False, compare=False)
+    host_coo: tuple = field(default=None, repr=False, hash=False,
+                            compare=False)
 
     def to_device(self) -> "DeviceGraph":
         from .blob import put_packed
@@ -96,7 +101,8 @@ class DeviceGraph:
             out_degree=dev["out_degree"],
             n_nodes=self.n_nodes, n_edges=self.n_edges,
             n_pad=self.n_pad, e_pad=self.e_pad,
-            node_gids=self.node_gids, gid_to_idx=self.gid_to_idx)
+            node_gids=self.node_gids, gid_to_idx=self.gid_to_idx,
+            host_coo=self.host_coo)
 
 
 def from_coo(src: np.ndarray, dst: np.ndarray,
@@ -180,7 +186,9 @@ def from_coo(src: np.ndarray, dst: np.ndarray,
                        n_nodes=n_nodes, n_edges=n_edges,
                        n_pad=n_pad, e_pad=e_pad,
                        node_gids=np.asarray(node_gids, dtype=np.int64),
-                       gid_to_idx=gid_to_idx)
+                       gid_to_idx=gid_to_idx,
+                       host_coo=(src.astype(np.int32), dst.astype(np.int32),
+                                 weights))
 
 
 def export_csr(accessor, weight_property: Optional[int] = None,
@@ -271,22 +279,53 @@ class GraphCache:
         storage = accessor.storage
         etf = (tuple(sorted(edge_type_filter))
                if edge_type_filter is not None else None)
-        key = (storage.topology_version, weight_property, label_filter, etf)
+        version = storage.topology_version
+        key = (version, weight_property, label_filter, etf)
+        base_key = ("base", weight_property, label_filter, etf)
         with self._lock:
             per_storage = self._cache.get(storage)
             hit = per_storage.get(key) if per_storage else None
+            base = per_storage.get(base_key) if per_storage else None
+            # a snapshot becomes the base anchor only after pagerank
+            # marks it (_mxu_base_self post-dates its get()), so also
+            # scan live version entries for the newest marked one
+            for k, v in (per_storage or {}).items():
+                if k[0] != "base" and k[1:] == key[1:] \
+                        and getattr(v, "_mxu_base_self", False) \
+                        and (base is None or base[0] < k[0]):
+                    base = (k[0], v)
         if hit is not None:
             return hit
         g = export_csr(accessor, weight_property=weight_property,
                        label_filter=label_filter,
                        edge_type_filter=edge_type_filter)
+        # Delta lineage: if an earlier snapshot of this view carries a
+        # fully-built MXU plan, record it plus the changed-vertex set so
+        # the analytics layer can refresh O(delta) instead of replanning
+        # (ops/pagerank._try_delta_plan).
+        if base is not None:
+            base_version, base_g = base
+            changed = storage.changes_between(base_version, version)
+            if changed is not None \
+                    and getattr(base_g, "_mxu_state", None) is not None:
+                object.__setattr__(g, "_delta_ctx", (base_g, changed))
         with self._lock:
-            # keep current-version variants (e.g. other weight properties),
-            # drop stale versions
+            # keep current-version variants (e.g. other weight properties)
+            # and base anchors; drop stale version snapshots
             per = self._cache.get(storage) or {}
-            per = {k: v for k, v in per.items() if k[0] == key[0]}
-            per[key] = g
-            self._cache[storage] = per
+            prev = {k: v for k, v in per.items()
+                    if k[0] == "base" or k[0] == version}
+            # the previous snapshot becomes the base anchor once a FULL
+            # plan was built on it (pagerank marks _mxu_base_self)
+            for k, v in per.items():
+                if k[0] not in ("base", version) \
+                        and k[1:] == key[1:] \
+                        and getattr(v, "_mxu_base_self", False):
+                    cur_base = prev.get(base_key)
+                    if cur_base is None or cur_base[0] < k[0]:
+                        prev[base_key] = (k[0], v)
+            prev[key] = g
+            self._cache[storage] = prev
         return g
 
     def clear(self) -> None:

@@ -83,9 +83,86 @@ def _pagerank_kernel(src, dst, weights, csr_src, csr_weights, n_nodes,
     return rank, err, iters
 
 
+# a delta larger than this fraction of the base edge set triggers a full
+# replan (padding inflation + per-iter delta cost outgrow the saving)
+DELTA_RECOMPACT_FRACTION = 0.10
+
+
+def _edge_diff(base_g: DeviceGraph, new_g: DeviceGraph, changed_gids):
+    """Multiset edge diff restricted to vertices in changed_gids.
+    Returns (added, removed) as (src, dst, w) tuples of host arrays, or
+    None when the diff cannot be derived (node set changed, no host
+    arrays kept, ...)."""
+    if base_g.host_coo is None or new_g.host_coo is None:
+        return None
+    if base_g.n_nodes != new_g.n_nodes or \
+            not np.array_equal(base_g.node_gids, new_g.node_gids):
+        return None     # node set changed: dense ids shifted
+    bitmap = np.zeros(new_g.n_nodes, dtype=bool)
+    for gid in changed_gids:
+        idx = new_g.gid_to_idx.get(gid)
+        if idx is not None:
+            bitmap[idx] = True
+    os_, od, ow = base_g.host_coo
+    ns_, nd, nw = new_g.host_coo
+    o_sel = bitmap[os_]
+    n_sel = bitmap[ns_]
+    # multiset diff over (src, dst, w) rows: +1 for new, -1 for old
+    rows = np.stack([
+        np.concatenate([ns_[n_sel].astype(np.int64),
+                        os_[o_sel].astype(np.int64)]),
+        np.concatenate([nd[n_sel].astype(np.int64),
+                        od[o_sel].astype(np.int64)]),
+        np.concatenate([nw[n_sel], ow[o_sel]]).view(np.int32).astype(
+            np.int64),
+    ], axis=1)
+    sign = np.concatenate([np.ones(int(n_sel.sum()), dtype=np.int64),
+                           -np.ones(int(o_sel.sum()), dtype=np.int64)])
+    uniq, inv = np.unique(rows, axis=0, return_inverse=True)
+    counts = np.zeros(len(uniq), dtype=np.int64)
+    np.add.at(counts, inv, sign)
+    add_idx = np.repeat(np.arange(len(uniq)), np.maximum(counts, 0))
+    rem_idx = np.repeat(np.arange(len(uniq)), np.maximum(-counts, 0))
+    w_back = lambda col: col.astype(np.int32).view(np.float32)  # noqa: E731
+    added = (uniq[add_idx, 0], uniq[add_idx, 1], w_back(uniq[add_idx, 2]))
+    removed = (uniq[rem_idx, 0], uniq[rem_idx, 1], w_back(uniq[rem_idx, 2]))
+    return added, removed
+
+
+def _try_delta_plan(graph: DeviceGraph):
+    """Derive this snapshot's MXU state from a predecessor's full plan
+    via an O(changed-edges) DeltaPlan. None -> caller does a full build.
+    """
+    from . import spmv_mxu
+    ctx = getattr(graph, "_delta_ctx", None)
+    if ctx is None:
+        return None
+    base_g, changed_gids = ctx
+    base_state = getattr(base_g, "_mxu_state", None)
+    if base_state is None or base_state[0].wsum is None:
+        return None
+    base_plan = base_state[0]
+    diff = _edge_diff(base_g, graph, changed_gids)
+    if diff is None:
+        return None
+    (a_s, a_d, a_w), (r_s, r_d, r_w) = diff
+    n_delta = len(a_s) + len(r_s)
+    if n_delta == 0:
+        return base_state    # property-only bump: plan still exact
+    if n_delta > max(DELTA_RECOMPACT_FRACTION * base_g.n_edges, 1024):
+        return None          # recompact: full replan is the better deal
+    delta = spmv_mxu.build_delta_plan(base_plan, a_s, a_d, a_w,
+                                      r_s, r_d, r_w)
+    run = spmv_mxu.make_pagerank_kernel(base_plan, delta=delta)
+    return (base_plan, run)
+
+
 def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
     """Large-graph path: gather-free MXU kernel with the plan cached on
-    the (immutable) DeviceGraph snapshot."""
+    the (immutable) DeviceGraph snapshot. Successor snapshots of a
+    mutated graph refresh O(delta) via DeltaPlan side-nets instead of
+    replanning (reference analog: pagerank_online_module.cpp keeps
+    incremental state for the same reason)."""
     from . import spmv_mxu
     cached = getattr(graph, "_mxu_state", None)
     if cached is None:
@@ -97,6 +174,10 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
         with lock:
             cached = getattr(graph, "_mxu_state", None)
             if cached is None:
+                cached = _try_delta_plan(graph)
+                if cached is not None:
+                    object.__setattr__(graph, "_mxu_state", cached)
+            if cached is None:
                 # true edges only: padding edges sort to the end (sinks)
                 src = np.asarray(graph.src_idx)[:graph.n_edges]
                 dst = np.asarray(graph.col_idx)[:graph.n_edges]
@@ -105,6 +186,8 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
                 cached = (plan, spmv_mxu.make_pagerank_kernel(plan))
                 # DeviceGraph is frozen; bypass its setattr guard
                 object.__setattr__(graph, "_mxu_state", cached)
+                # full plans anchor future delta refreshes (GraphCache)
+                object.__setattr__(graph, "_mxu_base_self", True)
     plan, run = cached
     # None = uniform start computed on-device (saves a node-flat transfer)
     rank, err, iters = run(None, np.float32(damping),

@@ -85,6 +85,9 @@ class MXUPlan:
     # --- node relabel Benes (in-label acc -> out-label acc) ---
     node_net_log2: int
     node_masks_packed: np.ndarray
+    # per-node out-weight sums (ORIGINAL ids) — the delta-refresh path
+    # rescales stale w/wsum multipliers with these (see DeltaPlan)
+    wsum: np.ndarray = None
 
 
 def _relabel_by(key: np.ndarray, stripe_groups: int = 0) -> np.ndarray:
@@ -265,7 +268,7 @@ def _global_labelings(src, dst, w, n_nodes):
     n_drows = _ceil_to(n_nodes, LANES) // LANES
     n_drows_p = _ceil_to(n_drows, K_C)                        # whole windows
     return (G, relab_out, relab_in, inv_wsum, valid_out, dangling_out,
-            n_drows_p)
+            n_drows_p, wsum)
 
 
 def build_plan(src: np.ndarray, dst: np.ndarray,
@@ -278,7 +281,7 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
          else np.asarray(weights, dtype=np.float64))
 
     (G, relab_out, relab_in, inv_wsum, valid_out, dangling_out,
-     n_drows_p) = _global_labelings(src, dst, w, n_nodes)
+     n_drows_p, wsum) = _global_labelings(src, dst, w, n_nodes)
 
     R_G, rowid, mult, gp_by_edge = _gather_layout(
         src, w, relab_out, inv_wsum, G)
@@ -300,7 +303,126 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
         net_log2=net_log2, masks_packed=masks_packed,
         C=C, run_k=run_k, win_oh=win_oh, W=n_drows_p // K_C,
         in_relabel=relab_in,
-        node_net_log2=node_net_log2, node_masks_packed=node_masks_packed)
+        node_net_log2=node_net_log2, node_masks_packed=node_masks_packed,
+        wsum=wsum)
+
+
+# ---------------------------------------------------------------------------
+# delta plans: O(changed-edges) refresh instead of a full replan
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DeltaPlan:
+    """Side-plan covering edges added/removed since the base plan.
+
+    The base plan keeps serving its (now stale) edges; this plan routes
+    only the delta, and two correction vectors make the combination
+    exact:
+      - scale_out: rank is pre-scaled by wsum_old/wsum_new per source
+        before the BASE expand, so stale w/wsum_old multipliers become
+        w/wsum_new;
+      - removed edges ride the delta net with NEGATIVE multipliers
+        -w/wsum_new, cancelling the base contribution exactly;
+      - dangling_out replaces the base vector (nodes may gain/lose all
+        out-edges).
+    Valid only while the node set is unchanged. Analog of the
+    reference's online pagerank keeping incremental state
+    (/root/reference/query_modules/pagerank_module/
+    pagerank_online_module.cpp:17-20) — here the increment is a
+    TPU-routable side-net rather than a CPU ordering.
+    """
+    n_delta: int
+    R_G: int
+    rowid: np.ndarray          # (G, R_G) int16
+    mult: np.ndarray           # (G, R_G, LANES) f32 (signed)
+    net_log2: int
+    masks_packed: np.ndarray
+    C: int
+    run_k: np.ndarray
+    win_oh: np.ndarray
+    scale_out: np.ndarray      # (node_flat,) f32
+    dangling_out: np.ndarray   # (node_flat,) f32 — replaces base's
+    wsum: np.ndarray           # updated per-node out-weight sums
+
+
+def build_delta_plan(base: MXUPlan,
+                     add_src, add_dst, add_w=None,
+                     rem_src=None, rem_dst=None, rem_w=None,
+                     bucket: bool = True) -> DeltaPlan:
+    """Build the O(delta) side-plan. All ids are ORIGINAL node ids and
+    must be < base.n_nodes (node additions require a full replan).
+
+    bucket=True pads R_G / C to powers of two so growing deltas reuse
+    the same compiled kernel shapes (recompiles only on bucket jumps)."""
+    if base.wsum is None:
+        raise ValueError("base plan predates delta support (no wsum)")
+    n = base.n_nodes
+    add_src = np.asarray(add_src, dtype=np.int64)
+    add_dst = np.asarray(add_dst, dtype=np.int64)
+    a_w = (np.ones(len(add_src)) if add_w is None
+           else np.asarray(add_w, dtype=np.float64))
+    rem_src = np.asarray(
+        rem_src if rem_src is not None else [], dtype=np.int64)
+    rem_dst = np.asarray(
+        rem_dst if rem_dst is not None else [], dtype=np.int64)
+    r_w = (np.ones(len(rem_src)) if rem_w is None
+           else np.asarray(rem_w, dtype=np.float64))
+    for arr in (add_src, add_dst, rem_src, rem_dst):
+        if len(arr) and (arr.min() < 0 or arr.max() >= n):
+            raise ValueError("delta references nodes outside the base plan")
+
+    wsum_new = base.wsum.copy()
+    if len(add_src):
+        wsum_new += np.bincount(add_src, weights=a_w, minlength=n)
+    if len(rem_src):
+        wsum_new -= np.bincount(rem_src, weights=r_w, minlength=n)
+    wsum_new[np.abs(wsum_new) < 1e-9] = 0.0     # cancel fp dust at zero
+    inv_new = np.where(wsum_new > 0, 1.0 / np.maximum(wsum_new, 1e-300),
+                       0.0)
+
+    d_src = np.concatenate([add_src, rem_src])
+    d_dst = np.concatenate([add_dst, rem_dst])
+    d_w = np.concatenate([a_w, -r_w])           # removals route negative
+
+    G = base.G
+    n_drows_p = base.W * K_C
+    R_G, rowid, mult, gp = _gather_layout(d_src, d_w, base.out_relabel,
+                                          inv_new, G)
+    if bucket and R_G & (R_G - 1):
+        R_G = 1 << R_G.bit_length()
+        R_G, rowid, mult, gp = _gather_layout(
+            d_src, d_w, base.out_relabel, inv_new, G, force_R_G=R_G)
+    C, run_k, win_oh, sp, R_total = _scatter_layout(
+        d_dst, base.in_relabel, n_drows_p)
+    if bucket and C & (C - 1):
+        # pad with dead chunks: run_k=-1 rows extract nothing, zero
+        # win_oh rows route no window
+        C_pad = 1 << C.bit_length()
+        run_k = np.concatenate(
+            [run_k, np.full((C_pad - C, R_C), -1, dtype=run_k.dtype)])
+        win_oh = np.concatenate(
+            [win_oh, np.zeros((C_pad - C, win_oh.shape[1]),
+                              dtype=win_oh.dtype)])
+        C, R_total = C_pad, C_pad * R_C
+    net = max(G * R_G * LANES, R_total * LANES, 2)
+    net_log2 = int(np.ceil(np.log2(net)))
+    masks_packed = _edge_perm_masks(gp, sp, net_log2)
+
+    node_flat = G * SG_ROWS * LANES
+    # exact-1 scale for untouched nodes: only rescale where wsum changed
+    changed = wsum_new != base.wsum
+    scale_nodes = np.ones(n, dtype=np.float64)
+    scale_nodes[changed] = base.wsum[changed] * inv_new[changed]
+    scale_out = np.zeros(node_flat, dtype=np.float32)
+    scale_out[base.out_relabel] = scale_nodes
+    dangling_out = np.zeros(node_flat, dtype=np.float32)
+    dangling_out[base.out_relabel[wsum_new <= 0]] = 1.0
+
+    return DeltaPlan(
+        n_delta=len(d_src), R_G=R_G, rowid=rowid, mult=mult,
+        net_log2=net_log2, masks_packed=masks_packed,
+        C=C, run_k=run_k, win_oh=win_oh,
+        scale_out=scale_out, dangling_out=dangling_out, wsum=wsum_new)
 
 
 # ---------------------------------------------------------------------------
@@ -352,7 +474,8 @@ def _benes_apply_rolls(x2, masks2, net_log2, live_stages=None):
     return x2
 
 
-def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
+def make_pagerank_kernel(plan: MXUPlan, route_dtype=None,
+                         delta: "DeltaPlan" = None):
     """Returns jitted fn(rank0_flat, damping, max_iter, tol) ->
     (rank_flat, err, iters); rank vectors are flat in OUT labeling,
     length G*SG_ROWS*LANES.
@@ -361,7 +484,12 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
     Benes (the dominant HBM traffic). bfloat16 halves it; sums still
     accumulate in f32 on the MXU, so each contribution carries one
     0.4%-relative rounding — validated to preserve exact top-100 order
-    on the 10M-edge bench graph. float32 is the exact path."""
+    on the 10M-edge bench graph. float32 is the exact path.
+
+    delta: optional DeltaPlan — per iteration the base expand reads
+    rank pre-scaled by delta.scale_out, the delta edges route through
+    their own (small) net, and both accumulators sum before the node
+    relabel. Exact for edge additions AND removals."""
     import jax
     import jax.numpy as jnp
     from ..utils.jax_cache import ensure_compile_cache
@@ -377,17 +505,60 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
     node_flat = G * SG_ROWS * LANES
     n_f = float(plan.n_nodes)
 
+    # Benes backend: the pallas 3-pass formulation cuts per-stage HBM
+    # round trips ~16x (measured 13.4 -> 3.7 ms/apply at 2^24, r5); the
+    # XLA roll path remains for CPU (tests / virtual meshes) and tiny nets
+    benes_mode = os.environ.get("MEMGRAPH_TPU_BENES", "auto")
+    use_pallas = (benes_mode == "pallas"
+                  or (benes_mode == "auto"
+                      and jax.default_backend() not in ("cpu",)
+                      and plan.net_log2 >= 12
+                      and plan.node_net_log2 >= 12))
+
     from .blob import pack_blob, unblob
-    blob_np, segs = pack_blob({
-        "masks": ("bits", plan.masks_packed),
-        "node_masks": ("bits", plan.node_masks_packed),
+    blob_arrays = {
         "mult": plan.mult.astype(np.float32),
         "rowid_i32": plan.rowid.astype(np.int32),
         "run_k_i32": plan.run_k.astype(np.int32),
         "win_oh": plan.win_oh.astype(np.float32),
         "valid": plan.valid_out.astype(np.float32),
         "dangling": plan.dangling_out.astype(np.float32),
-    })
+    }
+    if use_pallas:
+        from .benes_pallas import build_pallas_masks
+        big_spec, big_mid, big_out = build_pallas_masks(
+            plan.masks_packed, plan.net_log2)
+        node_spec, node_mid, node_out = build_pallas_masks(
+            plan.node_masks_packed, plan.node_net_log2)
+        blob_arrays["pb_big_mid"] = big_mid
+        if big_out is not None:
+            blob_arrays["pb_big_out"] = big_out
+        blob_arrays["pb_node_mid"] = node_mid
+        if node_out is not None:
+            blob_arrays["pb_node_out"] = node_out
+    else:
+        blob_arrays["masks"] = ("bits", plan.masks_packed)
+        blob_arrays["node_masks"] = ("bits", plan.node_masks_packed)
+    if delta is not None:
+        N_dnet = 1 << delta.net_log2
+        blob_arrays["d_mult"] = delta.mult.astype(np.float32)
+        blob_arrays["d_rowid_i32"] = delta.rowid.astype(np.int32)
+        blob_arrays["d_run_k_i32"] = delta.run_k.astype(np.int32)
+        blob_arrays["d_win_oh"] = delta.win_oh.astype(np.float32)
+        blob_arrays["d_scale"] = delta.scale_out.astype(np.float32)
+        # the delta's dangling vector REPLACES the base one
+        blob_arrays["dangling"] = delta.dangling_out.astype(np.float32)
+        use_pallas_delta = use_pallas and delta.net_log2 >= 12
+        if use_pallas_delta:
+            d_spec, d_mid, d_out = build_pallas_masks(
+                delta.masks_packed, delta.net_log2)
+            blob_arrays["pb_d_mid"] = d_mid
+            if d_out is not None:
+                blob_arrays["pb_d_out"] = d_out
+        else:
+            blob_arrays["d_masks"] = ("bits", delta.masks_packed)
+        live_delta = [bool(r.any()) for r in delta.masks_packed]
+    blob_np, segs = pack_blob(blob_arrays)
 
     def _unblob(blob, name):
         return unblob(blob, segs, name)
@@ -404,34 +575,101 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
               ).astype(jnp.float32)                        # (G, R_G, 128)
         ohe = ((run_k[:, :, None] == iota_kc[None, None, :])
                & (run_k[:, :, None] >= 0)).astype(route_dtype)
-        return dict(
+        dv = dict(
             oh=oh,
             mult=_unblob(blob, "mult"),
             valid=_unblob(blob, "valid"),
             dangling=_unblob(blob, "dangling"),
-            masks2=_unpack_mask_words(_unblob(blob, "masks"),
-                                      plan.net_log2),
             ohe=ohe,
             win_oh=_unblob(blob, "win_oh"),
-            node_masks2=_unpack_mask_words(_unblob(blob, "node_masks"),
-                                           plan.node_net_log2),
         )
+        if use_pallas:
+            for name in ("pb_big_mid", "pb_big_out", "pb_node_mid",
+                         "pb_node_out"):
+                if name in segs:
+                    dv[name] = _unblob(blob, name)
+        else:
+            dv["masks2"] = _unpack_mask_words(_unblob(blob, "masks"),
+                                              plan.net_log2)
+            dv["node_masks2"] = _unpack_mask_words(
+                _unblob(blob, "node_masks"), plan.node_net_log2)
+        if delta is not None:
+            d_rowid = _unblob(blob, "d_rowid_i32")
+            d_run_k = _unblob(blob, "d_run_k_i32")
+            dv["d_oh"] = (d_rowid[:, :, None] == iota_sg[None, None, :]
+                          ).astype(jnp.float32)
+            dv["d_ohe"] = ((d_run_k[:, :, None] == iota_kc[None, None, :])
+                           & (d_run_k[:, :, None] >= 0)).astype(route_dtype)
+            dv["d_mult"] = _unblob(blob, "d_mult")
+            dv["d_win_oh"] = _unblob(blob, "d_win_oh")
+            dv["d_scale"] = _unblob(blob, "d_scale")
+            if use_pallas_delta:
+                dv["pb_d_mid"] = _unblob(blob, "pb_d_mid")
+                if "pb_d_out" in segs:
+                    dv["pb_d_out"] = _unblob(blob, "pb_d_out")
+            else:
+                dv["d_masks2"] = _unpack_mask_words(
+                    _unblob(blob, "d_masks"), delta.net_log2)
+        return dv
 
     blob_dev = jax.device_put(blob_np)
     # all-zero-mask stages route nothing: skip them at trace time
     live_big = [bool(row.any()) for row in plan.masks_packed]
     live_node = [bool(row.any()) for row in plan.node_masks_packed]
 
+    def _route_big(x2, dv):
+        if use_pallas:
+            from .benes_pallas import benes_apply_pallas
+            return benes_apply_pallas(x2, dv["pb_big_mid"],
+                                      dv.get("pb_big_out"), big_spec)
+        return _benes_apply_rolls(x2, dv["masks2"], plan.net_log2,
+                                  live_stages=live_big)
+
+    def _route_node(xa, dv):
+        if use_pallas:
+            from .benes_pallas import benes_apply_pallas
+            return benes_apply_pallas(xa, dv["pb_node_mid"],
+                                      dv.get("pb_node_out"), node_spec)
+        return _benes_apply_rolls(xa, dv["node_masks2"],
+                                  plan.node_net_log2,
+                                  live_stages=live_node)
+
+    def _route_delta(x2, dv):
+        if use_pallas_delta:
+            from .benes_pallas import benes_apply_pallas
+            return benes_apply_pallas(x2, dv["pb_d_mid"],
+                                      dv.get("pb_d_out"), d_spec)
+        return _benes_apply_rolls(x2, dv["d_masks2"], delta.net_log2,
+                                  live_stages=live_delta)
+
+    def _delta_acc(rank_planes, dv):
+        """Expand + route + extract the delta edges; (W, K_C, 128) f32."""
+        T = jnp.einsum("grw,gwl->grl", dv["d_oh"], rank_planes,
+                       preferred_element_type=jnp.float32)
+        contrib = (T * dv["d_mult"]).astype(route_dtype).reshape(-1, LANES)
+        N_rows = max((1 << delta.net_log2) // LANES, 1)
+        x2 = jnp.zeros((N_rows, LANES), route_dtype
+                       ).at[:contrib.shape[0]].set(contrib)
+        x2 = _route_delta(x2, dv)
+        xc = x2[:delta.C * R_C].reshape(delta.C, R_C, LANES)
+        per_chunk = jnp.einsum("cik,cil->ckl", dv["d_ohe"], xc,
+                               preferred_element_type=jnp.float32)
+        return jnp.einsum("cw,ckl->wkl", dv["d_win_oh"], per_chunk,
+                          preferred_element_type=jnp.float32)
+
     def one_iter(rank_flat, d, dv):
-        rank_planes = rank_flat.reshape(G, SG_ROWS, LANES)
+        # base expand reads rank pre-scaled so stale w/wsum_old
+        # multipliers become w/wsum_new (exact; see DeltaPlan)
+        base_in = (rank_flat * dv["d_scale"] if delta is not None
+                   else rank_flat)
+        rank_planes = base_in.reshape(G, SG_ROWS, LANES)
         T = jnp.einsum("grw,gwl->grl", dv["oh"], rank_planes,
                        preferred_element_type=jnp.float32)
         contrib = (T * dv["mult"]).astype(route_dtype
                                           ).reshape(-1, LANES)
         x2 = jnp.zeros((N_net // LANES, LANES), route_dtype
                        ).at[:contrib.shape[0]].set(contrib)
-        x2 = _benes_apply_rolls(x2, dv["masks2"], plan.net_log2,
-                                live_stages=live_big)
+        x2 = _route_big(x2, dv)
         xc = x2[:C * R_C].reshape(C, R_C, LANES)
         # full-run one-hot reduce+extract on the MXU (no roll-tree);
         # f32 accumulation regardless of the routed dtype
@@ -439,12 +677,13 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None):
                                preferred_element_type=jnp.float32)
         accw = jnp.einsum("cw,ckl->wkl", dv["win_oh"], per_chunk,
                           preferred_element_type=jnp.float32)
+        if delta is not None:
+            accw = accw + _delta_acc(
+                rank_flat.reshape(G, SG_ROWS, LANES), dv)
         acc_in2 = accw.reshape(-1, LANES)                  # (W*K_C, 128)
         xa = jnp.zeros((N_nn // LANES, LANES), jnp.float32
                        ).at[:acc_in2.shape[0]].set(acc_in2)
-        acc_out = _benes_apply_rolls(
-            xa, dv["node_masks2"], plan.node_net_log2,
-            live_stages=live_node).reshape(-1)[:node_flat]
+        acc_out = _route_node(xa, dv).reshape(-1)[:node_flat]
         dm = jnp.sum(rank_flat * dv["dangling"])
         new_rank = dv["valid"] * ((1.0 - d) / n_f
                                   + d * (acc_out + dm / n_f))
@@ -505,7 +744,7 @@ def pagerank_mxu(src, dst, weights, n_nodes, damping=0.85,
 # plan persistence (bench reuse: routing a 10M-edge graph costs ~35s host-side)
 # ---------------------------------------------------------------------------
 
-_PLAN_VERSION = 3
+_PLAN_VERSION = 4
 
 
 def save_plan(plan: MXUPlan, path: str) -> None:
@@ -517,7 +756,8 @@ def save_plan(plan: MXUPlan, path: str) -> None:
         masks_packed=plan.masks_packed, C=plan.C, run_k=plan.run_k,
         win_oh=plan.win_oh, W=plan.W, in_relabel=plan.in_relabel,
         node_net_log2=plan.node_net_log2,
-        node_masks_packed=plan.node_masks_packed)
+        node_masks_packed=plan.node_masks_packed,
+        wsum=plan.wsum if plan.wsum is not None else np.zeros(0))
 
 
 def load_plan(path: str) -> Optional[MXUPlan]:
@@ -533,6 +773,7 @@ def load_plan(path: str) -> Optional[MXUPlan]:
             C=int(z["C"]), run_k=z["run_k"],
             win_oh=z["win_oh"], W=int(z["W"]), in_relabel=z["in_relabel"],
             node_net_log2=int(z["node_net_log2"]),
-            node_masks_packed=z["node_masks_packed"])
+            node_masks_packed=z["node_masks_packed"],
+            wsum=z["wsum"] if z["wsum"].size else None)
     except Exception:  # noqa: BLE001 — any cache damage means "rebuild"
         return None

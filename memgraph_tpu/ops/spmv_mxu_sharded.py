@@ -102,7 +102,7 @@ def build_sharded_plan(src: np.ndarray, dst: np.ndarray,
          else np.asarray(weights, dtype=np.float64))
 
     (G, relab_out, relab_in, inv_wsum, valid_out, dangling_out,
-     n_drows_p) = _global_labelings(src, dst, w, n_nodes)
+     n_drows_p, _wsum) = _global_labelings(src, dst, w, n_nodes)
 
     shard_of = _assign_shards(src, dst, n_nodes, n_shards)
     subs = [(src[shard_of == p], dst[shard_of == p], w[shard_of == p])

@@ -33,3 +33,6 @@ def load_builtin_modules() -> None:
     from . import migrate_modules         # noqa: F401
     from . import elastic_modules         # noqa: F401
     from . import tgn_module              # noqa: F401
+    from . import llm_util_module         # noqa: F401
+    from . import embeddings_module       # noqa: F401
+    from . import cross_database          # noqa: F401

@@ -1,0 +1,82 @@
+"""cross_database.* — stream query results from OTHER graph databases.
+
+Counterpart of /root/reference/mage/python/cross_database.py: the
+bolt/neo4j sources connect to a remote Bolt endpoint and stream records
+as `row` maps for UNWIND/CREATE composition. The Bolt transport is THIS
+repo's own client (server/client.py) — no external driver needed, and
+it speaks to any Bolt 4.x/5.x server (memgraph, neo4j, another
+memgraph_tpu). Relational sources live in migrate.* (migrate_modules);
+`cross_database.sqlite` aliases there for surface parity.
+"""
+
+from __future__ import annotations
+
+from ..exceptions import QueryException
+from . import mgp
+
+
+def _label_or_query(text: str) -> str:
+    """A bare label/relationship name becomes a full-row MATCH, anything
+    else is passed through as Cypher (reference:
+    cross_database._formulate_cypher_query)."""
+    t = text.strip()
+    if t and all(c.isalnum() or c in "_:" for c in t):
+        if t.upper().startswith("REL:"):
+            rel = t.split(":", 1)[1]
+            return (f"MATCH (a)-[r:{rel}]->(b) "
+                    "RETURN properties(a) AS from_props, "
+                    "properties(r) AS edge_props, "
+                    "properties(b) AS to_props")
+        label = t.lstrip(":")
+        return f"MATCH (n:{label}) RETURN properties(n) AS props"
+    return t
+
+
+def _bolt_rows(config, query, params):
+    from ..server.client import BoltClient, BoltClientError
+    host = (config or {}).get("host", "127.0.0.1")
+    port = int((config or {}).get("port", 7687))
+    try:
+        client = BoltClient(host=host, port=port,
+                            username=(config or {}).get("username", ""),
+                            password=(config or {}).get("password", ""))
+    except (OSError, BoltClientError) as e:
+        raise QueryException(
+            f"cross_database: cannot connect to bolt://{host}:{port}: {e}"
+        ) from e
+    try:
+        columns, rows, _summary = client.execute(query, params or {})
+        for rec in rows:
+            yield {"row": dict(zip(columns, rec))}
+    except BoltClientError as e:
+        raise QueryException(f"cross_database: remote error: {e}") from e
+    finally:
+        client.close()
+
+
+@mgp.read_proc("cross_database.bolt",
+               args=[("label_or_query", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "MAP", None)],
+               results=[("row", "MAP")])
+def bolt(ctx, label_or_query, config, params=None):
+    """Stream rows from any Bolt server; config: {host, port,
+    username, password}."""
+    yield from _bolt_rows(config, _label_or_query(label_or_query), params)
+
+
+@mgp.read_proc("cross_database.neo4j",
+               args=[("label_or_query", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "MAP", None)],
+               results=[("row", "MAP")])
+def neo4j(ctx, label_or_query, config, params=None):
+    """Neo4j flavor of cross_database.bolt (same wire protocol)."""
+    yield from _bolt_rows(config, _label_or_query(label_or_query), params)
+
+
+@mgp.read_proc("cross_database.sqlite",
+               args=[("table_or_sql", "STRING"), ("config", "MAP")],
+               opt_args=[("params", "LIST", None)],
+               results=[("row", "MAP")])
+def sqlite(ctx, table_or_sql, config, params=None):
+    from .migrate_modules import migrate_sqlite
+    yield from migrate_sqlite(ctx, table_or_sql, config, params)

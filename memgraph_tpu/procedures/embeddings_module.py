@@ -1,0 +1,169 @@
+"""embeddings.* — device-batched node text embeddings.
+
+Counterpart of /root/reference/mage/python/embeddings.py (+
+embed_worker): build a "sentence" per node from its labels/properties,
+encode all sentences in device-sized batches, write the vectors to a
+node property (composing with the vector index / knn procedures).
+
+TPU-first redesign of the compute path: the reference shards texts over
+GPU workers running sentence-transformers; here the default encoder is
+a feature-hashing n-gram projection evaluated as ONE batched matmul per
+chunk on the device (deterministic, dependency-free, MXU-shaped). When
+a HuggingFace model is available locally, `model` config switches to it
+(gated import — this image has transformers but no model weights/egress,
+so the hashing encoder is the always-works default).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..exceptions import QueryException
+from . import mgp
+
+_N_FEATURES = 1 << 14          # hashed n-gram vocabulary
+_SEED = 1234567
+
+
+def build_text(vertex, label_names, prop_named, excluded) -> str:
+    """Node sentence: labels + 'key: value' pairs, property-name sorted
+    (reference: embeddings.build_texts)."""
+    parts = [" ".join(label_names)]
+    for key, value in sorted(prop_named.items()):
+        if key in excluded or value is None:
+            continue
+        parts.append(f"{key}: {value}")
+    return " ".join(p for p in parts if p).strip()
+
+
+def _hash_tokens(text: str):
+    """Word unigrams + character trigrams -> hashed feature ids."""
+    import zlib
+    ids = []
+    for tok in text.lower().split():
+        ids.append(zlib.crc32(tok.encode()) % _N_FEATURES)
+        for i in range(len(tok) - 2):
+            ids.append(zlib.crc32(tok[i:i + 3].encode("utf-8"))
+                       % _N_FEATURES)
+    return ids
+
+
+def hashing_encode(texts, dimension: int, batch_size: int = 2048):
+    """Deterministic feature-hash embedding: sparse counts x a fixed
+    random projection, one device matmul per chunk, L2-normalized."""
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.PRNGKey(_SEED)
+    proj = jax.random.normal(key, (_N_FEATURES, dimension),
+                             dtype=jnp.float32) / np.sqrt(dimension)
+
+    @jax.jit
+    def _encode(counts):                     # (B, F) -> (B, D)
+        emb = counts @ proj
+        norm = jnp.linalg.norm(emb, axis=1, keepdims=True)
+        return emb / jnp.maximum(norm, 1e-12)
+
+    out = np.zeros((len(texts), dimension), dtype=np.float32)
+    for lo in range(0, len(texts), batch_size):
+        chunk = texts[lo:lo + batch_size]
+        counts = np.zeros((batch_size, _N_FEATURES), dtype=np.float32)
+        for i, t in enumerate(chunk):
+            for fid in _hash_tokens(t):
+                counts[i, fid] += 1.0
+        out[lo:lo + len(chunk)] = np.asarray(_encode(counts))[:len(chunk)]
+    return out
+
+
+def _transformer_encode(texts, model_name, batch_size):
+    try:
+        import torch
+        from transformers import AutoModel, AutoTokenizer
+    except ImportError as e:
+        raise QueryException(
+            "embeddings: transformers/torch are not available") from e
+    tok = AutoTokenizer.from_pretrained(model_name)
+    model = AutoModel.from_pretrained(model_name)
+    model.eval()
+    outs = []
+    with torch.no_grad():
+        for lo in range(0, len(texts), batch_size):
+            batch = tok(texts[lo:lo + batch_size], padding=True,
+                        truncation=True, return_tensors="pt")
+            hidden = model(**batch).last_hidden_state
+            mask = batch["attention_mask"].unsqueeze(-1)
+            emb = (hidden * mask).sum(1) / mask.sum(1).clamp(min=1)
+            outs.append(torch.nn.functional.normalize(emb, dim=1).numpy())
+    return np.concatenate(outs)
+
+
+def _gather(ctx, excluded):
+    storage = ctx.accessor.storage
+    nodes, texts = [], []
+    for va in ctx.accessor.vertices():
+        labels = [storage.label_mapper.id_to_name(l) for l in va.labels()]
+        props = {storage.property_mapper.id_to_name(pid): val
+                 for pid, val in va.properties().items()}
+        nodes.append(va)
+        texts.append(build_text(va, labels, props, excluded))
+    return nodes, texts
+
+
+@mgp.write_proc("embeddings.compute_embeddings",
+                opt_args=[("configuration", "MAP", None)],
+                results=[("success", "BOOLEAN"),
+                         ("count", "INTEGER"),
+                         ("dimension", "INTEGER")])
+def compute_embeddings(ctx, configuration=None):
+    cfg = dict(configuration or {})
+    prop_name = cfg.get("embedding_property", "embedding")
+    dimension = int(cfg.get("dimension", 256))
+    batch_size = int(cfg.get("batch_size", 2048))
+    model = cfg.get("model")          # None -> hashing encoder
+    excluded = set(cfg.get("excluded_properties") or [prop_name])
+    excluded.add(prop_name)
+    if dimension <= 0 or batch_size <= 0:
+        raise QueryException("embeddings: dimension and batch_size "
+                             "must be positive")
+    nodes, texts = _gather(ctx, excluded)
+    if not nodes:
+        yield {"success": True, "count": 0, "dimension": dimension}
+        return
+    if model:
+        vecs = _transformer_encode(texts, model, batch_size)
+        dimension = vecs.shape[1]
+    else:
+        vecs = hashing_encode(texts, dimension, batch_size)
+    pid = ctx.accessor.storage.property_mapper.name_to_id(prop_name)
+    for va, vec in zip(nodes, vecs):
+        va.set_property(pid, [float(x) for x in vec])
+    yield {"success": True, "count": len(nodes), "dimension": dimension}
+
+
+@mgp.write_proc("embeddings.node_sentence",
+                opt_args=[("configuration", "MAP", None)],
+                results=[("node", "NODE"), ("sentence", "STRING")])
+def node_sentence(ctx, configuration=None):
+    """The sentence each node would be embedded with (debugging aid,
+    reference: embeddings.node_sentence)."""
+    cfg = dict(configuration or {})
+    excluded = set(cfg.get("excluded_properties") or [])
+    excluded.add(cfg.get("embedding_property", "embedding"))
+    nodes, texts = _gather(ctx, excluded)
+    for va, text in zip(nodes, texts):
+        yield {"node": va, "sentence": text}
+
+
+@mgp.read_proc("embeddings.model_info",
+               opt_args=[("configuration", "MAP", None)],
+               results=[("name", "STRING"), ("dimension", "INTEGER"),
+                        ("device", "STRING")])
+def model_info(ctx, configuration=None):
+    cfg = dict(configuration or {})
+    model = cfg.get("model")
+    if model:
+        yield {"name": model, "dimension": -1, "device": "cpu"}
+        return
+    import jax
+    yield {"name": "feature-hashing/ngram-projection",
+           "dimension": int(cfg.get("dimension", 256)),
+           "device": jax.default_backend()}

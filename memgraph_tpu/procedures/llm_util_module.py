@@ -1,0 +1,79 @@
+"""llm_util.* — graph schema rendered for LLM prompts.
+
+Counterpart of /root/reference/mage/python/llm_util.py: `schema()`
+returns either a prompt-ready natural-language schema description or
+the raw structure, assembled from the live schema info
+(storage/schema_info.py) instead of a fresh full scan.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..exceptions import QueryException
+from . import mgp
+
+
+def _raw_schema(accessor) -> list:
+    from ..storage.common import View
+    from ..storage.schema_info import schema_info_json
+    doc = json.loads(schema_info_json(accessor, View.OLD))
+    out = []
+    for node in doc.get("nodes", []):
+        labels = ":".join(node.get("labels", []))
+        props = {p["key"]: [t["type"] for t in p.get("types", [])]
+                 for p in node.get("properties", [])}
+        out.append({"kind": "node", "labels": labels, "properties": props,
+                    "count": node.get("count", 0)})
+    for edge in doc.get("edges", []):
+        props = {p["key"]: [t["type"] for t in p.get("types", [])]
+                 for p in edge.get("properties", [])}
+        out.append({
+            "kind": "relationship", "type": edge.get("type", ""),
+            "start": ":".join(edge.get("start_node_labels", [])),
+            "end": ":".join(edge.get("end_node_labels", [])),
+            "properties": props, "count": edge.get("count", 0)})
+    return out
+
+
+def _prompt_ready(raw: list) -> str:
+    lines = ["Node properties are the following:"]
+    for item in raw:
+        if item["kind"] != "node":
+            continue
+        props = ", ".join(f"{k}: {'/'.join(v) or 'Any'}"
+                          for k, v in sorted(item["properties"].items()))
+        lines.append(f'Node name: "{item["labels"] or "(no label)"}", '
+                     f"Node properties: [{props}]")
+    lines.append("Relationship properties are the following:")
+    for item in raw:
+        if item["kind"] != "relationship" or not item["properties"]:
+            continue
+        props = ", ".join(f"{k}: {'/'.join(v) or 'Any'}"
+                          for k, v in sorted(item["properties"].items()))
+        lines.append(f'Relationship name: "{item["type"]}", '
+                     f"Relationship properties: [{props}]")
+    lines.append("The relationships are the following:")
+    for item in raw:
+        if item["kind"] != "relationship":
+            continue
+        lines.append(f'(:{item["start"]})-[:{item["type"]}]->'
+                     f'(:{item["end"]})')
+    return "\n".join(lines)
+
+
+@mgp.read_proc("llm_util.schema",
+               opt_args=[("output_type", "STRING", "prompt_ready")],
+               results=[("schema", "ANY")])
+def schema(ctx, output_type="prompt_ready"):
+    if not any(True for _ in ctx.accessor.vertices()):
+        raise QueryException("can't generate a graph schema since there "
+                             "is no data in the database")
+    raw = _raw_schema(ctx.accessor)
+    if output_type == "raw":
+        yield {"schema": raw}
+    elif output_type == "prompt_ready":
+        yield {"schema": _prompt_ready(raw)}
+    else:
+        raise QueryException(
+            "llm_util.schema: output_type must be 'prompt_ready' or 'raw'")

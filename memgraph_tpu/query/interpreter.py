@@ -155,6 +155,7 @@ class Interpreter:
         from ..observability.metrics import global_metrics
         global_metrics.increment("query.prepared")
         self._query_started = time.monotonic()
+        self._query_text = text
         self._pending_op_counts = None   # drop any abandoned prepare's
         self.session_trace.emit("prepare", query=text)
         node = self.ctx.cached_parse(text)
@@ -284,12 +285,8 @@ class Interpreter:
         raise SemanticException(f"unknown stream action {node.action}")
 
     def _settings(self):
-        settings = getattr(self.ctx, "settings", None)
-        if settings is None:
-            from ..storage.kvstore import Settings
-            settings = self.ctx.settings = Settings(
-                getattr(self.ctx, "kvstore", None))
-        return settings
+        from ..storage.kvstore import ensure_settings
+        return ensure_settings(self.ctx)
 
     def _prepare_enum(self, node: A.EnumQuery) -> PreparedQuery:
         from ..storage.enums import enum_registry
@@ -769,6 +766,10 @@ class Interpreter:
             import logging
             logging.getLogger(__name__).debug(
                 "plan for %s:\n%s", strip, "\n".join(plan_to_rows(plan)))
+        if self.ctx.config.get("log_query_plan"):
+            import logging
+            logging.getLogger(__name__).info(
+                "plan for %s:\n%s", strip, "\n".join(plan_to_rows(plan)))
 
         if self._in_explicit_txn and _plan_has_batched_apply(plan):
             raise TransactionException(
@@ -919,8 +920,14 @@ class Interpreter:
             for op_name, count in pending_ops.items():
                 global_metrics.increment(f"operator.{op_name}", count)
         if started is not None:
-            global_metrics.observe("query.execution_latency_sec",
-                                   time.monotonic() - started)
+            elapsed = time.monotonic() - started
+            global_metrics.observe("query.execution_latency_sec", elapsed)
+            min_ms = self.ctx.config.get("log_min_duration_ms") or 0
+            if min_ms and elapsed * 1000.0 >= min_ms:
+                import logging
+                logging.getLogger(__name__).info(
+                    "slow query (%.1f ms): %s", elapsed * 1000.0,
+                    (getattr(self, "_query_text", "") or "").strip())
         for key, value in summary.get("stats", {}).items():
             if value:
                 global_metrics.increment(f"storage.{key}", value)
@@ -1047,6 +1054,16 @@ class Interpreter:
         storage = self.ctx.storage
         if node.kind == "storage":
             info = storage.info()
+            if self.ctx.config.get("storage_enable_edges_metadata"):
+                # per-edge-type counts (reference:
+                # --storage-enable-edges-metadata)
+                counts: dict = {}
+                for e in list(storage._edges.values()):
+                    if not e.deleted:
+                        counts[e.edge_type] = counts.get(e.edge_type, 0) + 1
+                for et_id, cnt in sorted(counts.items()):
+                    name = storage.edge_type_mapper.id_to_name(et_id)
+                    info[f"edge_count[{name}]"] = cnt
             rows = [[k, v] for k, v in sorted(info.items())]
             return self._prepare_generator(iter(rows),
                                            ["storage info", "value"], "r")
@@ -1116,7 +1133,13 @@ class Interpreter:
                                            ["name", "type", "value"], "r")
         if node.kind == "schema":
             # full live-schema JSON document (reference:
-            # storage/v2/schema_info.cpp, returned as one `schema` row)
+            # storage/v2/schema_info.cpp, returned as one `schema` row;
+            # gated by --schema-info-enabled as the reference gates it
+            # behind --storage-enable-schema-metadata)
+            if self.ctx.config.get("schema_info_enabled", True) is False:
+                raise QueryException(
+                    "SHOW SCHEMA INFO is disabled "
+                    "(--schema-info-enabled=false)")
             from ..storage.schema_info import schema_info_json
             acc = storage.access()
             try:

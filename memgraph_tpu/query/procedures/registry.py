@@ -90,7 +90,29 @@ class ProcedureRegistry:
 
     def find(self, name: str) -> Optional[Procedure]:
         self._ensure_builtin()
-        return self._procedures.get(name.lower())
+        proc = self._procedures.get(name.lower())
+        if proc is None:
+            target = getattr(self, "_aliases", {}).get(name.lower())
+            if target:
+                proc = self._procedures.get(target.lower())
+        return proc
+
+    def load_callable_mappings(self, path: str) -> int:
+        """JSON {alias: canonical-procedure-name} — lets Neo4j-style
+        CALL names resolve to local implementations (reference:
+        --query-callable-mappings-path)."""
+        import json
+        with open(path, encoding="utf-8") as f:
+            mappings = json.load(f)
+        if not isinstance(mappings, dict):
+            raise ValueError("callable mappings must be a JSON object")
+        with self._lock:
+            aliases = getattr(self, "_aliases", None)
+            if aliases is None:
+                aliases = self._aliases = {}
+            for alias, target in mappings.items():
+                aliases[str(alias).lower()] = str(target)
+        return len(mappings)
 
     def all_procedures(self) -> list[Procedure]:
         self._ensure_builtin()

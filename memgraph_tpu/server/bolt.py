@@ -297,6 +297,9 @@ class BoltSession:
         try:
             if not await self.handshake():
                 return
+            peer = self.writer.get_extra_info("peername")
+            log.info("Accepted a connection from %s:%s",
+                     *(peer[:2] if peer else ("?", "?")))
             while True:
                 data = await self.read_message()
                 msg = ps.unpack(data)

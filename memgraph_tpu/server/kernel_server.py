@@ -1,0 +1,279 @@
+"""Resident kernel server: keeps one JAX/TPU runtime warm for
+short-lived client processes.
+
+Measured on the tunneled axon platform (NOTES_ROUND4): every fresh
+process pays ~1.5s to load the device executable stack before its first
+kernel dispatch — which dominated CALL-to-first-record latency for CLI
+tools and bench stages. The production server process (memgraph_tpu.main)
+is naturally resident; this daemon gives every OTHER process the same
+property: a unix-socket service holding the device runtime, compiled
+kernels, and graph caches, so a cold client's first CALL costs one
+socket round-trip plus device compute.
+
+Protocol (local trusted unix socket): length-prefixed frames, each a
+JSON header {op, arrays: [{name, dtype, shape}], ...params} followed by
+the raw array bytes in order. Ops: ping, pagerank, shutdown.
+
+Reference analog: none directly — the reference is a resident C++
+daemon by construction (src/memgraph.cpp); this component restores that
+property for out-of-process analytics callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+DEFAULT_SOCKET = os.environ.get(
+    "MEMGRAPH_TPU_KERNEL_SERVER_SOCKET",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".kernel_server.sock"))
+
+
+# --------------------------------------------------------------------------
+# framing
+# --------------------------------------------------------------------------
+
+def _send_msg(sock: socket.socket, header: dict,
+              arrays: dict[str, np.ndarray] | None = None) -> None:
+    arrays = arrays or {}
+    header = dict(header)
+    header["arrays"] = [
+        {"name": k, "dtype": str(v.dtype), "shape": list(v.shape)}
+        for k, v in arrays.items()]
+    hb = json.dumps(header).encode("utf-8")
+    parts = [struct.pack("<I", len(hb)), hb]
+    for v in arrays.values():
+        parts.append(np.ascontiguousarray(v).tobytes())
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    arrays = {}
+    for spec in header.pop("arrays", []):
+        dt = np.dtype(spec["dtype"])
+        count = int(np.prod(spec["shape"], dtype=np.int64)) if spec["shape"] \
+            else 1
+        raw = _recv_exact(sock, count * dt.itemsize)
+        arrays[spec["name"]] = np.frombuffer(raw, dtype=dt).reshape(
+            spec["shape"])
+    return header, arrays
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class KernelServer:
+    """One thread per connection; device dispatch serialized by a lock
+    (one chip — concurrent kernels would just queue anyway)."""
+
+    def __init__(self, socket_path: str = DEFAULT_SOCKET,
+                 idle_timeout_s: float = 0.0) -> None:
+        import threading
+        self.socket_path = socket_path
+        self.idle_timeout_s = idle_timeout_s
+        self._graphs: dict = {}      # graph_key -> DeviceGraph
+        self._dispatch_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._last_activity = time.monotonic()
+
+    def _warm(self) -> None:
+        """Touch the device so the first client request pays no init."""
+        import jax
+        import jax.numpy as jnp
+        x = jnp.ones((128, 128), jnp.float32)
+        float((x @ x).sum())
+
+    def serve_forever(self) -> None:
+        import threading
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.socket_path)
+        srv.listen(8)
+        self._warm()
+        self._last_activity = time.monotonic()
+        srv.settimeout(1.0)
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                if self.idle_timeout_s and \
+                        time.monotonic() - self._last_activity \
+                        > self.idle_timeout_s:
+                    break
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        srv.close()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    header, arrays = _recv_msg(conn)
+                except (ConnectionError, struct.error, OSError):
+                    return
+                self._last_activity = time.monotonic()
+                op = header.get("op")
+                try:
+                    if op == "ping":
+                        _send_msg(conn, {"ok": True, "pid": os.getpid()})
+                    elif op == "shutdown":
+                        _send_msg(conn, {"ok": True})
+                        self._shutdown.set()
+                        return
+                    elif op == "pagerank":
+                        with self._dispatch_lock:
+                            self._op_pagerank(conn, header, arrays)
+                    else:
+                        _send_msg(conn, {"ok": False,
+                                         "error": f"unknown op {op!r}"})
+                except Exception as e:  # noqa: BLE001 — report, continue
+                    try:
+                        _send_msg(conn, {"ok": False, "error": str(e)})
+                    except OSError:
+                        return
+        finally:
+            conn.close()
+
+    def _op_pagerank(self, conn, header, arrays) -> None:
+        from ..ops import pagerank as pr
+        from ..ops.csr import from_coo
+        key = header.get("graph_key")
+        g = self._graphs.get(key) if key else None
+        if g is None:
+            if "src" not in arrays:
+                _send_msg(conn, {"ok": False, "error": "unknown graph_key "
+                                 "and no edge arrays supplied"})
+                return
+            g = from_coo(arrays["src"].astype(np.int64),
+                         arrays["dst"].astype(np.int64),
+                         arrays.get("weights"),
+                         n_nodes=header.get("n_nodes")).to_device()
+            if key:
+                self._graphs[key] = g
+        ranks, err, iters = pr.pagerank(
+            g, damping=header.get("damping", 0.85),
+            max_iterations=header.get("max_iterations", 100),
+            tol=header.get("tol", 1e-6))
+        _send_msg(conn, {"ok": True, "err": float(err),
+                         "iters": int(iters)},
+                  {"ranks": np.asarray(ranks, dtype=np.float32)})
+
+
+# --------------------------------------------------------------------------
+# client
+# --------------------------------------------------------------------------
+
+class KernelClient:
+    def __init__(self, socket_path: str = DEFAULT_SOCKET,
+                 timeout: float = 300.0) -> None:
+        self.socket_path = socket_path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(socket_path)
+
+    def call(self, header: dict, arrays=None):
+        _send_msg(self._sock, header, arrays)
+        return _recv_msg(self._sock)
+
+    def ping(self) -> bool:
+        try:
+            h, _ = self.call({"op": "ping"})
+            return bool(h.get("ok"))
+        except (OSError, ConnectionError):
+            return False
+
+    def pagerank(self, src=None, dst=None, weights=None, n_nodes=None,
+                 graph_key=None, **params):
+        arrays = {}
+        if src is not None:
+            arrays["src"] = np.asarray(src, dtype=np.int64)
+            arrays["dst"] = np.asarray(dst, dtype=np.int64)
+            if weights is not None:
+                arrays["weights"] = np.asarray(weights, dtype=np.float32)
+        h, out = self.call({"op": "pagerank", "graph_key": graph_key,
+                            "n_nodes": n_nodes, **params}, arrays)
+        if not h.get("ok"):
+            raise RuntimeError(h.get("error", "kernel server error"))
+        return out["ranks"], h["err"], h["iters"]
+
+    def shutdown(self) -> None:
+        try:
+            self.call({"op": "shutdown"})
+        except (OSError, ConnectionError):
+            pass
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def ensure_server(socket_path: str = DEFAULT_SOCKET,
+                  spawn_timeout_s: float = 120.0,
+                  idle_timeout_s: float = 900.0):
+    """Connect to the resident server, spawning it if absent. Returns a
+    connected KernelClient or None if the server cannot start."""
+    try:
+        c = KernelClient(socket_path, timeout=spawn_timeout_s)
+        if c.ping():
+            return c
+        c.close()
+    except OSError:
+        pass
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "memgraph_tpu.server.kernel_server",
+         "--socket", socket_path, "--idle-timeout", str(idle_timeout_s)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)   # survives the spawning client
+    deadline = time.monotonic() + spawn_timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return None           # died during init (no device, ...)
+        try:
+            c = KernelClient(socket_path, timeout=spawn_timeout_s)
+            if c.ping():
+                return c
+            c.close()
+        except OSError:
+            time.sleep(0.1)
+    return None
+
+
+def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", default=DEFAULT_SOCKET)
+    ap.add_argument("--idle-timeout", type=float, default=900.0)
+    args = ap.parse_args()
+    KernelServer(args.socket, idle_timeout_s=args.idle_timeout).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

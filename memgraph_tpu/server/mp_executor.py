@@ -1,0 +1,143 @@
+"""Multiprocess read-query executor: a path past the GIL for OLTP reads.
+
+The Bolt worker pool gives concurrency, not CPU parallelism — pure-
+Python operator execution serializes on the GIL, so aggregate
+multi-client read throughput plateaus at ~1x (README, measured r4).
+This executor forks N worker processes, each inheriting a copy-on-write
+snapshot of the storage; read-only queries fan out round-robin over
+pipes and execute with N independent GILs.
+
+Semantics: every worker serves the database AS OF the last fork().
+`refresh()` re-forks after commits — the same snapshot-staleness
+contract as the analytics GraphCache (ops/csr.py), applied to host
+reads. Writes and transactional reads stay on the in-process path.
+
+Caveats (documented, enforced):
+  - queries that reach jax/device state are refused in workers (fork
+    after CUDA/TPU init is unsafe); this pool is for host-path OLTP.
+  - one core boxes (like this dev host) show ~1x: the component buys
+    architecture; the speedup needs real cores.
+
+Reference analog: the reference is a multithreaded C++ server with no
+GIL to escape; this component restores multi-core reads for the Python
+host layer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import pickle
+import struct
+import threading
+
+__all__ = ["MPReadExecutor"]
+
+
+def _send(fd, obj) -> None:
+    data = pickle.dumps(obj)
+    os.write(fd, struct.pack("<I", len(data)) + data)
+
+
+def _recv(fd):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = os.read(fd, 4 - len(hdr))
+        if not chunk:
+            raise EOFError
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = os.read(fd, n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class MPReadExecutor:
+    def __init__(self, ictx, n_workers: int = 4) -> None:
+        self._ictx = ictx
+        self._n = max(1, n_workers)
+        self._workers: list = []       # (pid, req_fd, resp_fd)
+        self._locks: list = []
+        self._rr = itertools.count()
+        self._fork()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _fork(self) -> None:
+        self.close()
+        self._workers = []
+        self._locks = []
+        for _ in range(self._n):
+            req_r, req_w = os.pipe()
+            resp_r, resp_w = os.pipe()
+            pid = os.fork()
+            if pid == 0:                      # ---- child ----
+                os.close(req_w)
+                os.close(resp_r)
+                try:
+                    self._worker_loop(req_r, resp_w)
+                finally:
+                    os._exit(0)
+            os.close(req_r)
+            os.close(resp_w)
+            self._workers.append((pid, req_w, resp_r))
+            self._locks.append(threading.Lock())
+
+    def _worker_loop(self, req_fd: int, resp_fd: int) -> None:
+        from ..query import Interpreter
+        interp = Interpreter(self._ictx)
+        while True:
+            try:
+                msg = _recv(req_fd)
+            except EOFError:
+                return
+            if msg is None:
+                return
+            query, params = msg
+            try:
+                cols, rows, _summary = interp.execute(query, params)
+                _send(resp_fd, ("ok", cols, rows))
+            except Exception as e:  # noqa: BLE001 — ship the error back
+                _send(resp_fd, ("err", type(e).__name__, str(e)))
+
+    def refresh(self) -> None:
+        """Re-fork so workers see the current committed state."""
+        self._fork()
+
+    def close(self) -> None:
+        for pid, req_fd, resp_fd in self._workers:
+            try:
+                _send(req_fd, None)
+            except OSError:
+                pass
+            for fd in (req_fd, resp_fd):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._workers = []
+        self._locks = []
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, query: str, params: dict | None = None):
+        """Round-robin a read-only query to a worker; returns
+        (columns, rows). Raises RuntimeError on worker-side errors."""
+        if not self._workers:
+            raise RuntimeError("executor is closed")
+        i = next(self._rr) % len(self._workers)
+        pid, req_fd, resp_fd = self._workers[i]
+        with self._locks[i]:
+            _send(req_fd, (query, params or {}))
+            out = _recv(resp_fd)
+        if out[0] == "err":
+            raise RuntimeError(f"{out[1]}: {out[2]}")
+        return out[1], out[2]

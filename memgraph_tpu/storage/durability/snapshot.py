@@ -28,7 +28,7 @@ from ..property_store import (_read_varint, _write_varint, decode_value,
                               encode_value)
 
 MAGIC = b"MGTPUSNAP"
-VERSION = 2
+VERSION = 3   # v3: per-chunk flag byte (bit0 = zlib payload)
 CHUNK_ITEMS = 50_000
 
 _POOL: ThreadPoolExecutor | None = None
@@ -94,19 +94,32 @@ def _write_chunked(buf, items, encode_chunk) -> None:
               for i in range(0, len(items), CHUNK_ITEMS)] or [[]]
     payloads = list(_pool().map(encode_chunk, chunks))
     _write_varint(buf, len(chunks))
+    from ..property_store import COMPRESSION
     for chunk, payload in zip(chunks, payloads):
+        flags = 0
+        if COMPRESSION["enabled"] and len(payload) >= 512:
+            import zlib
+            packed = zlib.compress(payload, COMPRESSION["level"])
+            if len(packed) < len(payload):
+                payload, flags = packed, 1
         _write_varint(buf, len(payload))
         _write_varint(buf, len(chunk))
+        buf.write(bytes([flags]))
         buf.write(payload)
 
 
-def _read_chunked(buf, decode_chunk) -> list:
+def _read_chunked(buf, decode_chunk, version=VERSION) -> list:
     n_chunks = _read_varint(buf)
     raw = []
     for _ in range(n_chunks):
         nbytes = _read_varint(buf)
         count = _read_varint(buf)
-        raw.append((buf.read(nbytes), count))
+        flags = buf.read(1)[0] if version >= 3 else 0
+        payload = buf.read(nbytes)
+        if flags & 1:
+            import zlib
+            payload = zlib.decompress(payload)
+        raw.append((payload, count))
     out: list = []
     for part in _pool().map(lambda rc: decode_chunk(*rc), raw):
         out.extend(part)
@@ -282,7 +295,7 @@ def load_snapshot(path: str) -> dict:
     if buf.read(len(MAGIC)) != MAGIC:
         raise DurabilityError(f"{path}: bad snapshot magic")
     version, ts, wall = struct.unpack("<HQQ", buf.read(18))
-    if version not in (1, 2):
+    if version not in (1, 2, 3):
         raise DurabilityError(f"{path}: unsupported snapshot version "
                               f"{version}")
     out = {"timestamp": ts, "wall_time": wall}
@@ -302,14 +315,14 @@ def load_snapshot(path: str) -> dict:
             out["edge_types"] = read_name_list()
         elif marker == SEC_VERTICES:
             if version >= 2:
-                out["vertices"] = _read_chunked(buf, _decode_vertex_chunk)
+                out["vertices"] = _read_chunked(buf, _decode_vertex_chunk, version)
             else:
                 n = _read_varint(buf)
                 out["vertices"] = [_decode_v1_vertex(buf)
                                    for _ in range(n)]
         elif marker == SEC_EDGES:
             if version >= 2:
-                out["edges"] = _read_chunked(buf, _decode_edge_chunk)
+                out["edges"] = _read_chunked(buf, _decode_edge_chunk, version)
             else:
                 n = _read_varint(buf)
                 out["edges"] = [_decode_v1_edge(buf) for _ in range(n)]

@@ -96,3 +96,13 @@ class Settings:
 
     def observe(self, name: str, fn) -> None:
         self._observers.setdefault(name, []).append(fn)
+
+
+def ensure_settings(ictx) -> "Settings":
+    """The one place that lazily attaches the runtime Settings store to
+    an interpreter context (shared by the interpreter's SET DATABASE
+    SETTING path and main.py's license wiring)."""
+    settings = getattr(ictx, "settings", None)
+    if settings is None:
+        settings = ictx.settings = Settings(getattr(ictx, "kvstore", None))
+    return settings

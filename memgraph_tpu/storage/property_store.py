@@ -241,17 +241,43 @@ def decode_value(buf: BytesIO):
     raise StorageError(f"unknown value tag 0x{tag:02x}")
 
 
+# Flag-driven blob compression (reference: property_store.hpp:38-40 +
+# utils/compressor.cpp — zlib, gated by
+# --storage-property-store-compression-enabled). Set by main.py; the
+# decoder auto-detects, so mixed-config blobs always read correctly.
+COMPRESSION = {"enabled": False, "level": 6, "min_bytes": 64}
+
+# envelope marker: a legacy blob starts with a varint property count, and
+# the only legal single-byte blob starting 0x00 is the 1-byte empty set —
+# so "0x00 + more bytes" is free to mean "zlib payload follows"
+_COMPRESSED_MARK = b"\x00"
+
+
 def encode_properties(props: dict[int, object]) -> bytes:
-    """Deterministically encode a {prop_id: value} set."""
+    """Deterministically encode a {prop_id: value} set. When compression
+    is enabled, blobs over min_bytes are zlib-wrapped (marker 0x00)."""
     buf = BytesIO()
     _write_varint(buf, len(props))
     for pid in sorted(props):
         _write_varint(buf, pid)
         encode_value(buf, props[pid])
-    return buf.getvalue()
+    raw = buf.getvalue()
+    if COMPRESSION["enabled"] and len(raw) >= COMPRESSION["min_bytes"]:
+        import zlib
+        packed = _COMPRESSED_MARK + zlib.compress(raw, COMPRESSION["level"])
+        if len(packed) < len(raw):
+            return packed
+    return raw
 
 
 def decode_properties(data: bytes) -> dict[int, object]:
+    if len(data) > 1 and data[:1] == _COMPRESSED_MARK:
+        import zlib
+        try:
+            data = zlib.decompress(data[1:])
+        except zlib.error as e:
+            raise StorageError(f"corrupt compressed property blob: {e}") \
+                from e
     buf = BytesIO(data)
     try:
         n = _read_varint(buf)

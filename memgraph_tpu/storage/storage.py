@@ -53,6 +53,13 @@ class StorageConfig:
     # creation-enabled)
     automatic_label_index: bool = False
     automatic_edge_type_index: bool = False
+    # run a GC cycle after every committing transaction instead of only
+    # on the periodic timer (reference: --storage-gc-aggressive)
+    gc_aggressive: bool = False
+    # continue with whatever recovered instead of failing startup when
+    # durability files are damaged (reference:
+    # --storage-allow-recovery-failure)
+    allow_recovery_failure: bool = False
 
 
 class _Namer:
@@ -882,7 +889,13 @@ class InMemoryStorage:
             # with the visibility flip relative to _begin_transaction's
             # (start_ts, topology_snapshot) capture, or a reader could
             # key a cache entry at a version whose data it cannot see
-            self._bump_topology(set(txn.touched_vertices))
+            self._bump_topology(
+                set(txn.touched_vertices)
+                # edge-property commits must invalidate too: the
+                # delta-refresh path diffs edges of CHANGED nodes,
+                # so both endpoints count as changed (r5 review)
+                | {e.from_vertex.gid for e in txn.touched_edges.values()}
+                | {e.to_vertex.gid for e in txn.touched_edges.values()})
         if ship_seq is not None:
             # strict shipping order across concurrent committers
             with self._ship_cond:
@@ -895,6 +908,10 @@ class InMemoryStorage:
                 with self._ship_cond:
                     self._next_ship_seq = ship_seq + 1
                     self._ship_cond.notify_all()
+        if self.config.gc_aggressive:
+            # eager delta reclamation after every commit
+            # (reference: --storage-gc-aggressive)
+            self.collect_garbage()
         return commit_ts
 
     def _abort(self, txn: Transaction) -> None:
@@ -938,7 +955,10 @@ class InMemoryStorage:
             self.indices.label_property.update_on_change(v)
         with self._engine_lock:
             self._active_txns.pop(txn.id, None)
-        self._bump_topology(set(txn.touched_vertices))
+        self._bump_topology(
+            set(txn.touched_vertices)
+            | {e.from_vertex.gid for e in txn.touched_edges.values()}
+            | {e.to_vertex.gid for e in txn.touched_edges.values()})
 
     # --- GC -----------------------------------------------------------------
 

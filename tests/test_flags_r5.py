@@ -1,0 +1,202 @@
+"""Round-5 flag surface: property-store compression, schema-info gate,
+aggressive GC, slow-query/plan logging, callable mappings, recovery
+failure tolerance, edges metadata, strict flag check, metrics format.
+References: /root/reference/src/flags/*.cpp,
+storage/v2/property_store.cpp:44 (compression flag).
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+from memgraph_tpu.storage.property_store import (COMPRESSION,
+                                                 decode_properties,
+                                                 encode_properties)
+
+
+@pytest.fixture
+def compression_on():
+    old = dict(COMPRESSION)
+    COMPRESSION.update(enabled=True, level=6, min_bytes=64)
+    yield
+    COMPRESSION.update(old)
+
+
+class TestPropertyCompression:
+    def test_round_trip_and_shrinks(self, compression_on):
+        props = {1: "the quick brown fox " * 50, 2: 42,
+                 3: [1.5] * 40, 4: "x"}
+        blob = encode_properties(props)
+        COMPRESSION["enabled"] = False
+        raw = encode_properties(props)
+        assert len(blob) < len(raw) // 2
+        # decoder auto-detects regardless of current config
+        assert decode_properties(blob) == props
+        assert decode_properties(raw) == props
+
+    def test_small_blobs_stay_raw(self, compression_on):
+        blob = encode_properties({1: "tiny"})
+        assert blob[:1] != b"\x00" or len(blob) == 1
+        assert decode_properties(blob) == {1: "tiny"}
+
+    def test_empty_props_unambiguous(self, compression_on):
+        blob = encode_properties({})
+        assert blob == b"\x00"
+        assert decode_properties(blob) == {}
+
+    def test_corrupt_compressed_blob_raises_domain_error(self):
+        from memgraph_tpu.exceptions import StorageError
+        with pytest.raises(StorageError):
+            decode_properties(b"\x00not-zlib-data")
+
+    def test_snapshot_round_trip_compressed(self, tmp_path,
+                                            compression_on):
+        from memgraph_tpu.storage.durability.snapshot import (
+            create_snapshot, load_snapshot)
+        from memgraph_tpu.storage.common import StorageMode
+        cfg = StorageConfig(durability_dir=str(tmp_path))
+        storage = InMemoryStorage(cfg)
+        acc = storage.access()
+        prop = storage.property_mapper.name_to_id("bio")
+        for i in range(200):
+            v = acc.create_vertex()
+            v.set_property(prop, f"a long biography string {i} " * 20)
+        acc.commit()
+        path = create_snapshot(storage)
+        data = load_snapshot(path)
+        assert len(data["vertices"]) == 200
+        # and the payload is actually smaller than uncompressed
+        COMPRESSION["enabled"] = False
+        path2 = create_snapshot(storage)
+        import os
+        assert os.path.getsize(path) < os.path.getsize(path2) // 2
+
+    def test_compressed_snapshot_recovers(self, tmp_path, compression_on):
+        from memgraph_tpu.storage.durability.snapshot import create_snapshot
+        from memgraph_tpu.storage.durability.recovery import recover
+        cfg = StorageConfig(durability_dir=str(tmp_path))
+        storage = InMemoryStorage(cfg)
+        acc = storage.access()
+        prop = storage.property_mapper.name_to_id("t")
+        v = acc.create_vertex()
+        v.set_property(prop, "payload " * 100)
+        acc.commit()
+        create_snapshot(storage)
+        COMPRESSION["enabled"] = False      # reader config differs
+        fresh = InMemoryStorage(cfg)
+        recover(fresh)
+        acc2 = fresh.access()
+        vs = list(acc2.vertices())
+        assert len(vs) == 1
+        assert vs[0].properties()[prop] == "payload " * 100
+        acc2.abort()
+
+
+class TestInterpreterFlags:
+    def test_schema_info_gate(self):
+        from memgraph_tpu.exceptions import QueryException
+        interp = Interpreter(InterpreterContext(
+            InMemoryStorage(), {"schema_info_enabled": False}))
+        with pytest.raises(QueryException):
+            interp.execute("SHOW SCHEMA INFO")
+        interp2 = Interpreter(InterpreterContext(InMemoryStorage()))
+        cols, rows, _ = interp2.execute("SHOW SCHEMA INFO")
+        assert cols == ["schema"]
+
+    def test_log_min_duration(self, caplog):
+        interp = Interpreter(InterpreterContext(
+            InMemoryStorage(), {"log_min_duration_ms": 0.0001}))
+        with caplog.at_level(logging.INFO,
+                             logger="memgraph_tpu.query.interpreter"):
+            interp.execute("UNWIND range(1, 100) AS i RETURN sum(i)")
+        assert any("slow query" in r.message for r in caplog.records)
+
+    def test_log_query_plan(self, caplog):
+        interp = Interpreter(InterpreterContext(
+            InMemoryStorage(), {"log_query_plan": True}))
+        with caplog.at_level(logging.INFO,
+                             logger="memgraph_tpu.query.interpreter"):
+            interp.execute("MATCH (n) RETURN n LIMIT 1")
+        assert any("plan for" in r.message for r in caplog.records)
+
+    def test_edges_metadata_in_storage_info(self):
+        interp = Interpreter(InterpreterContext(
+            InMemoryStorage(), {"storage_enable_edges_metadata": True}))
+        interp.execute("CREATE (a)-[:KNOWS]->(b), (a)-[:LIKES]->(b), "
+                       "(b)-[:KNOWS]->(a)")
+        _, rows, _ = interp.execute("SHOW STORAGE INFO")
+        info = {r[0]: r[1] for r in rows}
+        assert info.get("edge_count[KNOWS]") == 2
+        assert info.get("edge_count[LIKES]") == 1
+
+    def test_callable_mappings(self, tmp_path):
+        from memgraph_tpu.query.procedures.registry import global_registry
+        mpath = tmp_path / "mappings.json"
+        mpath.write_text(json.dumps(
+            {"gds.util.nan": "util.validate"}))
+        n = global_registry.load_callable_mappings(str(mpath))
+        assert n == 1
+        try:
+            real = global_registry.find("util.validate")
+            if real is not None:     # alias resolves to the same proc
+                assert global_registry.find("gds.util.nan") is real
+        finally:
+            global_registry._aliases.clear()
+
+
+class TestStorageFlags:
+    def test_gc_aggressive_truncates_after_commit(self):
+        storage = InMemoryStorage(StorageConfig(gc_aggressive=True))
+        acc = storage.access()
+        v = acc.create_vertex()
+        prop = storage.property_mapper.name_to_id("p")
+        v.set_property(prop, 1)
+        acc.commit()
+        acc2 = storage.access()
+        v2 = next(iter(acc2.vertices(View := __import__("memgraph_tpu.storage.common", fromlist=["View"]).View.NEW)))
+        v2.set_property(prop, 2)
+        acc2.commit()
+        # no active readers: the eager GC must have dropped the chain
+        vertex = next(iter(storage._vertices.values()))
+        assert vertex.delta is None
+
+    def test_allow_recovery_failure_boots_on_corruption(self, tmp_path):
+        from memgraph_tpu.dbms.dbms import DbmsHandler
+        snapdir = tmp_path / "snapshots"
+        snapdir.mkdir(parents=True)
+        (snapdir / "snapshot_1.mgsnap").write_bytes(b"GARBAGE" * 10)
+        cfg = StorageConfig(durability_dir=str(tmp_path),
+                            allow_recovery_failure=True)
+        dbms = DbmsHandler(cfg, {}, recover_on_startup=True)
+        ictx = dbms.default()     # must not raise
+        assert ictx.storage is not None
+
+
+class TestBuildConfig:
+    def test_strict_flag_check(self, capsys):
+        from memgraph_tpu.main import build_config
+        with pytest.raises(SystemExit):
+            build_config(["--no-such-flag"])
+        args = build_config(["--no-such-flag", "--no-strict-flag-check"])
+        assert args.strict_flag_check is False
+
+    def test_flag_count_at_least_80(self):
+        import re
+        import os
+        src = open(os.path.join(os.path.dirname(__file__), "..",
+                                "memgraph_tpu", "main.py")).read()
+        flags = set(re.findall(r'add_argument\("(--[a-z0-9-]+)"', src))
+        assert len(flags) >= 80, f"only {len(flags)} flags wired"
+
+    def test_compression_flags_parse(self):
+        from memgraph_tpu.main import build_config
+        args = build_config(
+            ["--storage-property-store-compression-enabled",
+             "--storage-property-store-compression-level", "high"])
+        assert args.storage_property_store_compression_enabled
+        assert args.storage_property_store_compression_level == "high"

@@ -1,0 +1,94 @@
+"""Resident kernel server (server/kernel_server.py): spawn, ping,
+remote pagerank vs scipy, server-side graph caching, shutdown."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.server.kernel_server import (KernelClient, ensure_server)
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("ks") / "ks.sock")
+    env_backup = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"   # the daemon inherits this
+    # generous spawn budget: under a full-suite run this 1-core host
+    # makes the daemon's jax import take minutes
+    client = ensure_server(sock, spawn_timeout_s=240, idle_timeout_s=300)
+    if env_backup is None:
+        os.environ.pop("JAX_PLATFORMS", None)
+    else:
+        os.environ["JAX_PLATFORMS"] = env_backup
+    assert client is not None, "kernel server failed to start"
+    yield client, sock
+    client.shutdown()
+    client.close()
+
+
+def _scipy_pagerank(src, dst, n, iters=100, damping=0.85, tol=1e-6):
+    import scipy.sparse as sp
+    w = np.ones(len(src))
+    wsum = np.bincount(src, weights=w, minlength=n)
+    inv = np.where(wsum > 0, 1.0 / np.maximum(wsum, 1e-300), 0.0)
+    m = sp.csr_matrix((w * inv[src], (dst, src)), shape=(n, n))
+    dang = wsum <= 0
+    rank = np.full(n, 1.0 / n)
+    for _ in range(iters):
+        dm = rank[dang].sum()
+        new = (1 - damping) / n + damping * (m @ rank + dm / n)
+        if np.abs(new - rank).sum() <= tol:
+            return new
+        rank = new
+    return rank
+
+
+def test_ping(server):
+    client, _ = server
+    assert client.ping()
+    # the daemon is a different process
+    h, _ = client.call({"op": "ping"})
+    assert h["pid"] != os.getpid()
+
+
+def test_remote_pagerank_matches_scipy(server):
+    client, _ = server
+    rng = np.random.default_rng(0)
+    n, e = 2000, 12000
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    ranks, err, iters = client.pagerank(src=src, dst=dst, n_nodes=n)
+    want = _scipy_pagerank(src, dst, n)
+    np.testing.assert_allclose(ranks, want, rtol=3e-4, atol=1e-8)
+
+
+def test_graph_key_caching(server):
+    """Second call by key only (no arrays) computes on the cached graph;
+    a fresh client sharing the socket sees the same cache."""
+    client, sock = server
+    rng = np.random.default_rng(1)
+    n, e = 1000, 6000
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    r1, _, _ = client.pagerank(src=src, dst=dst, n_nodes=n, graph_key="g1")
+    r2, _, _ = client.pagerank(graph_key="g1")
+    np.testing.assert_allclose(r1, r2, rtol=1e-6)
+    c2 = KernelClient(sock)
+    r3, _, _ = c2.pagerank(graph_key="g1")
+    c2.close()
+    np.testing.assert_allclose(r1, r3, rtol=1e-6)
+
+
+def test_unknown_key_without_arrays_errors(server):
+    client, _ = server
+    with pytest.raises(RuntimeError):
+        client.pagerank(graph_key="never-seen")
+
+
+def test_error_does_not_kill_server(server):
+    client, _ = server
+    with pytest.raises(RuntimeError):
+        client.pagerank(graph_key="nope")
+    assert client.ping()

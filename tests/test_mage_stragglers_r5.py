@@ -1,0 +1,141 @@
+"""Round-5 MAGE stragglers: llm_util.schema, embeddings.*,
+cross_database.* (reference: mage/python/{llm_util,embeddings,
+cross_database}.py)."""
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def interp():
+    i = Interpreter(InterpreterContext(InMemoryStorage()))
+    i.execute("CREATE (a:Person {name: 'ann', age: 34})-[:KNOWS "
+              "{since: 2020}]->(b:Person {name: 'bob'}), "
+              "(a)-[:LIKES]->(c:Movie {title: 'Heat'})")
+    return i
+
+
+class TestLlmUtil:
+    def test_prompt_ready(self, interp):
+        _, rows, _ = interp.execute(
+            "CALL llm_util.schema() YIELD schema RETURN schema")
+        text = rows[0][0]
+        assert 'Node name: "Person"' in text
+        assert "(:Person)-[:KNOWS]->(:Person)" in text
+        assert "name: String" in text
+
+    def test_raw(self, interp):
+        _, rows, _ = interp.execute(
+            "CALL llm_util.schema('raw') YIELD schema RETURN schema")
+        raw = rows[0][0]
+        kinds = {item["kind"] for item in raw}
+        assert kinds == {"node", "relationship"}
+
+    def test_empty_graph_errors(self):
+        interp = Interpreter(InterpreterContext(InMemoryStorage()))
+        with pytest.raises(Exception, match="no data"):
+            interp.execute("CALL llm_util.schema() YIELD schema "
+                           "RETURN schema")
+
+
+class TestEmbeddings:
+    def test_compute_and_knn_compose(self, interp):
+        _, rows, _ = interp.execute(
+            "CALL embeddings.compute_embeddings({dimension: 64}) "
+            "YIELD success, count, dimension "
+            "RETURN success, count, dimension")
+        assert rows[0] == [True, 3, 64]
+        _, rows, _ = interp.execute(
+            "MATCH (n:Person {name: 'ann'}) RETURN size(n.embedding)")
+        assert rows[0][0] == 64
+        # deterministic: same config -> same vectors
+        _, v1, _ = interp.execute(
+            "MATCH (n:Person {name: 'ann'}) RETURN n.embedding")
+        interp.execute(
+            "CALL embeddings.compute_embeddings({dimension: 64}) "
+            "YIELD count RETURN count")
+        _, v2, _ = interp.execute(
+            "MATCH (n:Person {name: 'ann'}) RETURN n.embedding")
+        np.testing.assert_allclose(v1[0][0], v2[0][0], rtol=1e-5)
+
+    def test_similar_text_closer_than_different(self, interp):
+        interp.execute("CREATE (:Person {name: 'ann smith'})")
+        interp.execute(
+            "CALL embeddings.compute_embeddings({dimension: 128}) "
+            "YIELD count RETURN count")
+        _, rows, _ = interp.execute(
+            "MATCH (n) WHERE n.name IS NOT NULL OR n.title IS NOT NULL "
+            "RETURN coalesce(n.name, n.title), n.embedding")
+        vecs = {r[0]: np.asarray(r[1]) for r in rows}
+        sim_same = float(vecs["ann"] @ vecs["ann smith"])
+        sim_diff = float(vecs["ann"] @ vecs["Heat"])
+        assert sim_same > sim_diff
+
+    def test_node_sentence(self, interp):
+        _, rows, _ = interp.execute(
+            "CALL embeddings.node_sentence() YIELD node, sentence "
+            "WHERE node.name = 'ann' RETURN sentence")
+        assert "Person" in rows[0][0]
+        assert "name: ann" in rows[0][0]
+        assert "age: 34" in rows[0][0]
+
+    def test_model_info(self, interp):
+        _, rows, _ = interp.execute(
+            "CALL embeddings.model_info() YIELD name, dimension, device "
+            "RETURN name, dimension, device")
+        assert "hashing" in rows[0][0]
+
+
+class TestCrossDatabase:
+    def test_bolt_roundtrip_against_own_server(self, interp, tmp_path):
+        import socket
+        from memgraph_tpu.server.bolt import BoltServer
+        remote = InterpreterContext(InMemoryStorage())
+        Interpreter(remote).execute(
+            "CREATE (:City {name: 'berlin', pop: 3600000}), "
+            "(:City {name: 'zagreb', pop: 800000})")
+        with socket.socket() as p:
+            p.bind(("127.0.0.1", 0))
+            port = p.getsockname()[1]
+        server = BoltServer(remote, "127.0.0.1", port)
+        thread, loop = server.run_in_thread()
+        try:
+            _, rows, _ = interp.execute(
+                f"CALL cross_database.bolt('MATCH (c:City) RETURN "
+                f"c.name AS name, c.pop AS pop', "
+                f"{{host: '127.0.0.1', port: {port}}}) YIELD row "
+                f"RETURN row.name, row.pop ORDER BY row.name")
+            assert rows == [["berlin", 3600000], ["zagreb", 800000]]
+            # label shorthand expands to a properties() scan
+            _, rows, _ = interp.execute(
+                f"CALL cross_database.neo4j('City', "
+                f"{{host: '127.0.0.1', port: {port}}}) YIELD row "
+                f"RETURN row.props.name ORDER BY row.props.name")
+            assert [r[0] for r in rows] == ["berlin", "zagreb"]
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_connection_refused_is_query_error(self, interp):
+        from memgraph_tpu.exceptions import QueryException
+        with pytest.raises(QueryException, match="cannot connect"):
+            interp.execute(
+                "CALL cross_database.bolt('RETURN 1', "
+                "{host: '127.0.0.1', port: 1}) YIELD row RETURN row")
+
+    def test_sqlite_alias(self, interp, tmp_path):
+        import sqlite3
+        db = tmp_path / "t.db"
+        con = sqlite3.connect(db)
+        con.execute("CREATE TABLE users (id INTEGER, name TEXT)")
+        con.execute("INSERT INTO users VALUES (1, 'ann'), (2, 'bob')")
+        con.commit()
+        con.close()
+        _, rows, _ = interp.execute(
+            f"CALL cross_database.sqlite('users', "
+            f"{{database: '{db}'}}) YIELD row "
+            f"RETURN row.id, row.name ORDER BY row.id")
+        assert rows == [[1, "ann"], [2, "bob"]]

@@ -1,0 +1,191 @@
+"""Websocket monitoring server (observability/monitoring_ws.py):
+handshake, auth gate, live log streaming, metrics frames.
+Reference behavior: communication/websocket/{listener,session}.cpp.
+"""
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from memgraph_tpu.observability.monitoring_ws import MonitoringServer
+
+GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class WSClient:
+    """Minimal RFC 6455 client for the tests (masked frames, as the RFC
+    requires of clients — which also exercises the server's unmasking)."""
+
+    def __init__(self, port, timeout=5.0):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (f"GET / HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0]
+        want = base64.b64encode(
+            hashlib.sha1((key + GUID).encode()).digest())
+        assert want in resp
+
+    def send_json(self, obj):
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        head = bytes([0x81])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        else:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        body = bytes(b ^ mask[i & 3] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + body)
+
+    def recv_json(self):
+        op, payload = self._recv_frame()
+        assert op == 0x1
+        return json.loads(payload)
+
+    def _recv_frame(self):
+        def rx(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = self.sock.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            return buf
+        b0, b1 = rx(2)
+        n = b1 & 0x7F
+        if n == 126:
+            (n,) = struct.unpack(">H", rx(2))
+        elif n == 127:
+            (n,) = struct.unpack(">Q", rx(8))
+        assert not (b1 & 0x80), "server frames must be unmasked"
+        return b0 & 0x0F, rx(n)
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture
+def server():
+    root = logging.getLogger()
+    old_level = root.level
+    root.setLevel(logging.INFO)   # main.py's --log-level does this in prod
+    srv = MonitoringServer("127.0.0.1", 0)
+    srv.start()
+    yield srv
+    srv.stop()
+    root.setLevel(old_level)
+
+
+def test_log_streaming(server):
+    c = WSClient(server.port)
+    time.sleep(0.2)     # session registration is async
+    logging.getLogger("memgraph_tpu.test").info("hello from the log")
+    msg = c.recv_json()
+    assert msg["event"] == "log"
+    assert msg["message"] == "hello from the log"
+    assert msg["level"] == "info"
+    c.close()
+
+
+def test_metrics_frame(server):
+    class FakeMetrics:
+        def snapshot(self):
+            return {"QueryExecutionLatency_us_count": 42}
+    server.metrics = FakeMetrics()
+    c = WSClient(server.port)
+    c.send_json({"command": "show_metrics"})
+    msg = c.recv_json()
+    assert msg["event"] == "metrics"
+    assert msg["metrics"]["QueryExecutionLatency_us_count"] == 42
+    c.close()
+
+
+def test_multiple_sessions_all_receive(server):
+    c1, c2 = WSClient(server.port), WSClient(server.port)
+    time.sleep(0.2)
+    logging.getLogger("x").warning("broadcast me")
+    for c in (c1, c2):
+        msg = c.recv_json()
+        assert msg["message"] == "broadcast me"
+        assert msg["level"] == "warning"
+        c.close()
+
+
+def test_auth_gate(tmp_path):
+    from memgraph_tpu.auth.auth import Auth
+    auth = Auth(str(tmp_path / "auth.json"))
+    auth.create_user("admin", "pw")
+    srv = MonitoringServer("127.0.0.1", 0, auth=auth)
+    srv.start()
+    try:
+        # wrong password: refused and disconnected
+        c = WSClient(srv.port)
+        c.send_json({"username": "admin", "password": "nope"})
+        assert c.recv_json()["success"] is False
+        c.close()
+        # correct password: authenticated, then receives logs
+        c = WSClient(srv.port)
+        c.send_json({"username": "admin", "password": "pw"})
+        assert c.recv_json()["success"] is True
+        time.sleep(0.2)
+        logging.getLogger("y").error("secured line")
+        assert c.recv_json()["message"] == "secured line"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_e2e_through_main(tmp_path):
+    """--monitoring-port on the composition root serves live logs."""
+    import subprocess
+    import sys
+    with socket.socket() as p:
+        p.bind(("127.0.0.1", 0))
+        port = p.getsockname()[1]
+    with socket.socket() as p:
+        p.bind(("127.0.0.1", 0))
+        bolt_port = p.getsockname()[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "memgraph_tpu.main",
+         "--bolt-port", str(bolt_port),
+         "--monitoring-port", str(port),
+         "--data-directory", str(tmp_path / "data"),
+         "--log-level", "INFO"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        c = None
+        while time.time() < deadline:
+            try:
+                c = WSClient(port, timeout=5)
+                break
+            except OSError:
+                time.sleep(0.3)
+        assert c is not None, "websocket monitoring never came up"
+        # a Bolt connection generates server log lines -> pushed frames
+        from memgraph_tpu.server.client import BoltClient
+        bc = BoltClient(port=bolt_port)
+        bc.execute("RETURN 1")
+        bc.close()
+        msg = c.recv_json()
+        assert msg["event"] == "log" and msg["message"]
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,90 @@
+"""Multiprocess read executor (server/mp_executor.py): snapshot
+semantics, parallel dispatch, error transport, refresh."""
+
+import threading
+
+import pytest
+
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.server.mp_executor import MPReadExecutor
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def ictx():
+    ictx = InterpreterContext(InMemoryStorage())
+    Interpreter(ictx).execute(
+        "UNWIND range(0, 99) AS i CREATE (:User {id: i, age: i % 50})")
+    return ictx
+
+
+def test_reads_match_in_process(ictx):
+    ex = MPReadExecutor(ictx, n_workers=2)
+    try:
+        cols, rows = ex.execute(
+            "MATCH (n:User {id: 7}) RETURN n.age")
+        assert rows == [[7]]
+        cols, rows = ex.execute("MATCH (n:User) RETURN count(n)")
+        assert rows == [[100]]
+    finally:
+        ex.close()
+
+
+def test_snapshot_staleness_and_refresh(ictx):
+    ex = MPReadExecutor(ictx, n_workers=2)
+    try:
+        Interpreter(ictx).execute("CREATE (:User {id: 1000, age: 1})")
+        # workers still see the fork-time snapshot
+        _, rows = ex.execute("MATCH (n:User) RETURN count(n)")
+        assert rows == [[100]]
+        ex.refresh()
+        _, rows = ex.execute("MATCH (n:User) RETURN count(n)")
+        assert rows == [[101]]
+    finally:
+        ex.close()
+
+
+def test_concurrent_dispatch(ictx):
+    ex = MPReadExecutor(ictx, n_workers=4)
+    results = []
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(25):
+                _, rows = ex.execute(
+                    "MATCH (n:User) WHERE n.age > 10 RETURN count(n)")
+                results.append(rows[0][0])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+    try:
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(results) == 100 and len(set(results)) == 1
+    finally:
+        ex.close()
+
+
+def test_worker_error_transport(ictx):
+    ex = MPReadExecutor(ictx, n_workers=1)
+    try:
+        with pytest.raises(RuntimeError, match="SyntaxException|Query"):
+            ex.execute("MATCH (n RETURN n")
+        # the worker survives the error
+        _, rows = ex.execute("RETURN 1")
+        assert rows == [[1]]
+    finally:
+        ex.close()
+
+
+def test_close_idempotent(ictx):
+    ex = MPReadExecutor(ictx, n_workers=1)
+    ex.close()
+    ex.close()
+    with pytest.raises(RuntimeError):
+        ex.execute("RETURN 1")

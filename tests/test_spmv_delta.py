@@ -1,0 +1,123 @@
+"""Delta side-plans (ops/spmv_mxu.DeltaPlan): O(changed-edges) refresh
+must match a full replan / scipy power iteration on the mutated graph
+exactly — additions, removals, weight-implied rescales, dangling flips.
+"""
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.ops import spmv_mxu
+
+
+def _scipy_pagerank(src, dst, w, n, iters=40, damping=0.85):
+    import scipy.sparse as sp
+    wsum = np.bincount(src, weights=w, minlength=n)
+    inv = np.where(wsum > 0, 1.0 / np.maximum(wsum, 1e-300), 0.0)
+    m = sp.csr_matrix((w * inv[src], (dst, src)), shape=(n, n))
+    dang = wsum <= 0
+    rank = np.full(n, 1.0 / n)
+    for _ in range(iters):
+        dm = rank[dang].sum()
+        rank = (1 - damping) / n + damping * (m @ rank + dm / n)
+    return rank
+
+
+def _run(plan, delta=None, iters=40):
+    import jax.numpy as jnp
+    run = spmv_mxu.make_pagerank_kernel(plan, delta=delta)
+    rank, err, it = run(None, jnp.float32(0.85), iters, jnp.float32(0.0))
+    return np.asarray(rank)[plan.out_relabel]
+
+
+@pytest.fixture(scope="module")
+def base_graph():
+    rng = np.random.default_rng(11)
+    n, e = 3000, 20000
+    src = rng.integers(0, n, e)
+    dst = (rng.random(e) ** 2 * n).astype(np.int64)   # skewed in-degree
+    w = np.ones(e)
+    return n, src, dst, w
+
+
+@pytest.fixture(scope="module")
+def base_plan(base_graph):
+    n, src, dst, w = base_graph
+    return spmv_mxu.build_plan(src, dst, w, n)
+
+
+def test_delta_additions(base_graph, base_plan):
+    n, src, dst, w = base_graph
+    rng = np.random.default_rng(5)
+    a_src = rng.integers(0, n, 700)
+    a_dst = rng.integers(0, n, 700)
+    delta = spmv_mxu.build_delta_plan(base_plan, a_src, a_dst)
+    got = _run(base_plan, delta)
+    want = _scipy_pagerank(np.concatenate([src, a_src]),
+                           np.concatenate([dst, a_dst]),
+                           np.ones(len(src) + 700), n)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-9)
+
+
+def test_delta_removals_and_additions(base_graph, base_plan):
+    n, src, dst, w = base_graph
+    rng = np.random.default_rng(6)
+    # remove a real subset (must match existing edges exactly)
+    rm = rng.choice(len(src), 500, replace=False)
+    keep = np.setdiff1d(np.arange(len(src)), rm)
+    a_src = rng.integers(0, n, 300)
+    a_dst = rng.integers(0, n, 300)
+    delta = spmv_mxu.build_delta_plan(
+        base_plan, a_src, a_dst, None, src[rm], dst[rm], w[rm])
+    got = _run(base_plan, delta)
+    want = _scipy_pagerank(np.concatenate([src[keep], a_src]),
+                           np.concatenate([dst[keep], a_dst]),
+                           np.ones(len(keep) + 300), n)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-9)
+
+
+def test_delta_dangling_transitions(base_plan, base_graph):
+    """A node losing ALL out-edges becomes dangling; a dangling node
+    gaining one stops being dangling."""
+    n, src, dst, w = base_graph
+    # node with out-edges: remove all of them
+    victim = int(src[0])
+    vm = src == victim
+    # dangling node: one with no out-edges
+    wsum = np.bincount(src, minlength=n)
+    dangler = int(np.flatnonzero(wsum == 0)[0])
+    a_src = np.array([dangler]); a_dst = np.array([(dangler + 7) % n])
+    delta = spmv_mxu.build_delta_plan(
+        base_plan, a_src, a_dst, None, src[vm], dst[vm], w[vm])
+    got = _run(base_plan, delta)
+    keep = ~vm
+    want = _scipy_pagerank(np.concatenate([src[keep], a_src]),
+                           np.concatenate([dst[keep], a_dst]),
+                           np.ones(keep.sum() + 1), n)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-9)
+
+
+def test_empty_delta_is_identity(base_graph, base_plan):
+    n, src, dst, w = base_graph
+    delta = spmv_mxu.build_delta_plan(base_plan, [], [])
+    got = _run(base_plan, delta)
+    want = _run(base_plan)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_delta_rejects_new_nodes(base_plan, base_graph):
+    n = base_graph[0]
+    with pytest.raises(ValueError):
+        spmv_mxu.build_delta_plan(base_plan, [n + 1], [0])
+
+
+def test_delta_build_is_fast(base_graph, base_plan):
+    """The point of the feature: delta build must be orders of magnitude
+    cheaper than a full replan."""
+    import time
+    n, src, dst, w = base_graph
+    rng = np.random.default_rng(9)
+    a_src = rng.integers(0, n, 200); a_dst = rng.integers(0, n, 200)
+    t0 = time.perf_counter()
+    spmv_mxu.build_delta_plan(base_plan, a_src, a_dst)
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"delta build took {dt:.2f}s"
