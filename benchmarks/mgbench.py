@@ -125,7 +125,9 @@ def main():
                             "127.0.0.1", port)
         server.run_in_thread()
 
-    client = BoltClient(port=port)
+    # wide timeout: load batches at 1M+ nodes can stall on GC/index
+    # growth well past the 30s default
+    client = BoltClient(port=port, timeout=600.0)
     rng = random.Random(7)
 
     print(f"loading {args.nodes} users / {args.edges} friendships ...",

@@ -185,12 +185,26 @@ def authenticate(scheme: str, response: str) -> dict:
         cfg = load_config(scheme)
         tokens = dict(t.split("=", 1) for t in response.split(";") if t)
         jwks = _fetch_jwks(cfg["jwks_uri"], cafile=cfg["cafile"])
-        access = validate_jwt(tokens["access_token"], jwks,
-                              cfg["access_aud"] or None)
+
+        def _validate_with_rotation(token, aud):
+            """On a kid miss, bypass the JWKS cache once: the IdP may
+            have rotated its signing keys inside the cache TTL."""
+            nonlocal jwks
+            try:
+                return validate_jwt(token, jwks, aud)
+            except ValueError as e:
+                if "kid not found" not in str(e):
+                    raise
+                _JWKS_CACHE.pop(cfg["jwks_uri"], None)
+                jwks = _fetch_jwks(cfg["jwks_uri"], cafile=cfg["cafile"])
+                return validate_jwt(token, jwks, aud)
+
+        access = _validate_with_rotation(tokens["access_token"],
+                                         cfg["access_aud"] or None)
         id_claims = None
         if cfg["use_id_token"]:
-            id_claims = validate_jwt(tokens["id_token"], jwks,
-                                     cfg["id_aud"] or None)
+            id_claims = _validate_with_rotation(tokens["id_token"],
+                                                cfg["id_aud"] or None)
         roles = map_roles(access, cfg)
         token_type, _, field = cfg["username"].partition(":")
         source = id_claims if token_type == "id" else access

@@ -169,7 +169,9 @@ class MonitoringServer:
             try:
                 with lk:
                     sock.sendall(frame)
-            except OSError:
+            except (OSError, socket.timeout):
+                # includes send timeouts: slow/stalled clients are
+                # dropped rather than ever blocking the logger
                 dead.append((sock, lk))
         if dead:
             with self._lock:
@@ -221,12 +223,19 @@ class MonitoringServer:
                     conn.close()
                     return
                 authenticated = True
-            conn.settimeout(None)
+            # finite timeout on BOTH directions: a stalled client must
+            # never block broadcast() (its send fails after 5s and the
+            # session is dropped) — the recv loop treats the timeout as
+            # "no request yet" and keeps serving
+            conn.settimeout(5.0)
             with self._lock:
                 self._sessions.append((conn, lk))
             # request loop: metrics pull, ping/pong, close
             while not self._stop.is_set():
-                opcode, payload = decode_frame(conn)
+                try:
+                    opcode, payload = decode_frame(conn)
+                except socket.timeout:
+                    continue
                 if opcode == 0x8:            # close
                     break
                 if opcode == 0x9:            # ping -> pong

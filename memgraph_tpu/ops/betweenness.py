@@ -89,12 +89,31 @@ def _brandes_chunk(src, dst, edge_valid, sources, weights, n_pad: int,
     return (weights[:, None] * delta).sum(axis=0)
 
 
+def autotune_chunk(n_edges: int, n_pad: int,
+                   budget_bytes: int | None = None) -> int:
+    """Pick the source-chunk size B from a device-memory budget.
+
+    Live state per source row: ~2 (B, E) f32 temporaries in the
+    segment-sum (frontier contributions + their exchange buffer) plus
+    3 (B, n_pad) f32 carries (dist/sigma/delta). At bench scale
+    (1M nodes / 10M edges) an unbounded B=32 would demand >1.2 GB of
+    (B, E) temporaries alone — the autotuner keeps the total under the
+    budget (default 4 GiB, MEMGRAPH_TPU_BC_MEM_BUDGET_MB overrides)."""
+    import os
+    if budget_bytes is None:
+        budget_bytes = int(os.environ.get(
+            "MEMGRAPH_TPU_BC_MEM_BUDGET_MB", 4096)) << 20
+    per_row = 2 * n_edges * 4 + 3 * n_pad * 4
+    return int(max(1, min(64, budget_bytes // max(per_row, 1))))
+
+
 def betweenness_centrality(graph: DeviceGraph, directed: bool = True,
                            normalized: bool = True, samples=None,
-                           chunk: int = 32, seed: int = 0,
+                           chunk=None, seed: int = 0,
                            max_levels: int | None = None):
     """Betweenness scores (n_nodes,). samples=None → exact (all sources);
-    an int → sampled approximation scaled by n/samples."""
+    an int → sampled approximation scaled by n/samples. chunk=None →
+    autotuned from the device-memory budget (autotune_chunk)."""
     n = graph.n_nodes
     if n == 0:
         return jnp.zeros((0,), jnp.float32)
@@ -128,6 +147,8 @@ def betweenness_centrality(graph: DeviceGraph, directed: bool = True,
                              replace=False).astype(np.int32)
         scale = n / float(len(sources))
 
+    if chunk is None:
+        chunk = autotune_chunk(int(src.shape[0]), graph.n_pad)
     levels = max_levels if max_levels is not None else n_levels_bound(n)
     bc = jnp.zeros((graph.n_pad,), jnp.float32)
     for i in range(0, len(sources), chunk):

@@ -473,6 +473,17 @@ class TenantProfileQuery:
 
 
 @dataclass
+class UserProfileQuery:
+    """Per-user profiles (reference: MemgraphCypher.g4:974-991,
+    auth/profiles/user_profiles.cpp)."""
+    action: str        # create | update | drop | show | show_for |
+    #                    users_for | assign | clear
+    name: Optional[str] = None         # profile name
+    user: Optional[str] = None
+    limits: Optional[dict] = None
+
+
+@dataclass
 class CoordinatorQuery:
     action: str                 # register | unregister | set_main | show
     name: Optional[str] = None

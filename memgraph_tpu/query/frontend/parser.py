@@ -53,6 +53,17 @@ class Parser:
     def at_kw(self, *names: str) -> bool:
         return self.cur.is_kw(*names)
 
+    def _at_profile_word(self) -> bool:
+        """PROFILE/PROFILES at the cursor (keyword or identifier)."""
+        return self.cur.is_kw("PROFILE") or (
+            self.cur.type == T.IDENT
+            and self.cur.value.upper() in ("PROFILE", "PROFILES"))
+
+    def _peek_is_profile(self) -> bool:
+        nxt = self.peek()
+        return nxt.is_kw("PROFILE") or (
+            nxt.type == T.IDENT and nxt.value.upper() == "PROFILE")
+
     def accept(self, type_: str) -> Optional[Token]:
         if self.cur.type == type_:
             return self.advance()
@@ -130,6 +141,21 @@ class Parser:
                 self.peek().value.upper() == "TENANT":
             self.advance()
             return self.parse_tenant_profile("clear")
+        if self.at(T.IDENT) and self.cur.value.upper() == "CLEAR" and \
+                self._peek_is_profile():
+            # CLEAR PROFILE FOR user (MemgraphCypher.g4:981)
+            self.advance(); self.advance()
+            self.expect_kw("FOR")
+            return A.UserProfileQuery("clear", user=self.name_token())
+        if self.at(T.IDENT) and self.cur.value.upper() == "UPDATE" and \
+                self._peek_is_profile():
+            # UPDATE PROFILE p LIMIT k v, ... (MemgraphCypher.g4:974)
+            self.advance(); self.advance()
+            name = self.name_token()
+            limits = {}
+            if self.accept_kw("LIMIT"):
+                limits = self.parse_limit_list()
+            return A.UserProfileQuery("update", name=name, limits=limits)
         if self.at(T.IDENT) and self.cur.value.upper() == "ALTER" and \
                 self.peek().type == T.IDENT and \
                 self.peek().value.upper() == "TENANT":
@@ -140,6 +166,15 @@ class Parser:
             if nxt.type == T.IDENT and nxt.value.upper() == "TENANT":
                 self.advance()
                 return self.parse_tenant_profile("create")
+            if self._peek_is_profile():
+                # CREATE PROFILE p [LIMIT k v, ...]
+                self.advance(); self.advance()
+                name = self.name_token()
+                limits = {}
+                if self.accept_kw("LIMIT"):
+                    limits = self.parse_limit_list()
+                return A.UserProfileQuery("create", name=name,
+                                          limits=limits)
             if nxt.is_kw("DATABASE"):
                 self.advance(); self.advance()
                 return A.MultiDatabaseQuery("create", name=self.name_token())
@@ -184,6 +219,9 @@ class Parser:
             if nxt.type == T.IDENT and nxt.value.upper() == "TENANT":
                 self.advance()
                 return self.parse_tenant_profile("drop")
+            if self._peek_is_profile():
+                self.advance(); self.advance()
+                return A.UserProfileQuery("drop", name=self.name_token())
             if nxt.is_kw("INDEX"):
                 return self.parse_drop_index()
             if nxt.is_kw("EDGE"):
@@ -270,6 +308,14 @@ class Parser:
             if nxt.type == T.IDENT and nxt.value.upper() == "TENANT":
                 self.advance()
                 return self.parse_tenant_profile("assign")
+            if self._peek_is_profile():
+                # SET PROFILE FOR user TO profile
+                self.advance(); self.advance()
+                self.expect_kw("FOR")
+                user = self.name_token()
+                self.expect_kw("TO")
+                return A.UserProfileQuery("assign", user=user,
+                                          name=self.name_token())
             if nxt.is_kw("GLOBAL", "SESSION", "NEXT"):
                 return self.parse_isolation_or_storage()
             if nxt.is_kw("STORAGE"):
@@ -562,7 +608,24 @@ class Parser:
             return A.StreamQuery("show")
         if self.at(T.IDENT) and self.cur.value.upper() == "USERS":
             self.advance()
+            if self.at_kw("FOR"):
+                # SHOW USERS FOR PROFILE p (MemgraphCypher.g4:979)
+                self.advance()
+                if not self._at_profile_word():
+                    self.error("expected PROFILE after SHOW USERS FOR")
+                self.advance()
+                return A.UserProfileQuery("users_for",
+                                          name=self.name_token())
             return A.AuthQuery("show_users")
+        if self._at_profile_word():
+            plural = self.cur.value.upper() == "PROFILES"
+            self.advance()
+            if plural:
+                return A.UserProfileQuery("show")
+            if self.accept_kw("FOR"):
+                return A.UserProfileQuery("show_for",
+                                          user=self.name_token())
+            return A.UserProfileQuery("show", name=self.name_token())
         if self.at(T.IDENT) and self.cur.value.upper() == "TENANT":
             self.advance()
             if not (self.at_kw("PROFILE") or (

@@ -230,6 +230,8 @@ class Interpreter:
             return self._prepare_multidb(node)
         if isinstance(node, A.TenantProfileQuery):
             return self._prepare_tenant_profile(node)
+        if isinstance(node, A.UserProfileQuery):
+            return self._prepare_user_profile(node)
         if isinstance(node, A.SettingQuery):
             return self._prepare_setting(node)
         if isinstance(node, A.EnumQuery):
@@ -435,6 +437,39 @@ class Interpreter:
                                        ["setting_name", "setting_value"],
                                        "r")
 
+    def _prepare_user_profile(self, node) -> PreparedQuery:
+        """Per-user profiles (reference: auth/profiles/user_profiles.cpp,
+        grammar MemgraphCypher.g4:974-991)."""
+        from ..auth.profiles import ensure_user_profiles
+        profiles = ensure_user_profiles(self.ctx)
+        if node.action == "create":
+            profiles.create(node.name, node.limits or {})
+        elif node.action == "update":
+            profiles.update(node.name, node.limits or {})
+        elif node.action == "drop":
+            profiles.drop(node.name)
+        elif node.action == "assign":
+            profiles.assign(node.user, node.name)
+        elif node.action == "clear":
+            profiles.clear(node.user)
+        elif node.action == "users_for":
+            rows = [[u] for u in profiles.users_for(node.name)]
+            return self._prepare_generator(iter(rows), ["username"], "r")
+        elif node.action == "show_for":
+            pname = profiles.profile_for(node.user)
+            rows = ([[pname, limits] for _n, limits
+                     in profiles.show(pname)] if pname else [])
+            return self._prepare_generator(iter(rows),
+                                           ["profile", "limits"], "r")
+        elif node.action == "show":
+            rows = [[n, limits] for n, limits in profiles.show(node.name)]
+            return self._prepare_generator(iter(rows),
+                                           ["profile", "limits"], "r")
+        else:
+            raise SemanticException(
+                f"unknown profile action {node.action}")
+        return self._prepare_generator(iter([]), [], "w")
+
     def _prepare_tenant_profile(self, node) -> PreparedQuery:
         """Tenant profiles (reference: dbms/tenant_profiles.cpp)."""
         dbms = getattr(self.ctx, "dbms", None)
@@ -624,6 +659,7 @@ class Interpreter:
         "StreamQuery": "STREAM", "SnapshotQuery": "DURABILITY",
         "DumpQuery": "DUMP", "MultiDatabaseQuery": "MULTI_DATABASE_EDIT",
         "TenantProfileQuery": "MULTI_DATABASE_EDIT",
+        "UserProfileQuery": "AUTH",
         "TtlQuery": "CONFIG", "SettingQuery": "CONFIG",
         "CoordinatorQuery": "COORDINATOR",
         "TerminateTransactionsQuery": "TRANSACTION_MANAGEMENT",
@@ -826,13 +862,25 @@ class Interpreter:
         from ..utils.memory_tracker import QueryMemoryTracker
         mem_limit = query.memory_limit
         if mem_limit is None:
-            # the database's tenant profile caps queries by default
-            # (reference: tenant_profiles.cpp memory_limit)
+            # defaults layer: the tenant profile caps the database, the
+            # USER profile caps the session's user — smaller wins
+            # (reference: tenant_profiles.cpp memory_limit +
+            # user_profiles.cpp transactions_memory)
+            caps = []
             dbms = getattr(self.ctx, "dbms", None)
             if dbms is not None:
-                mem_limit = dbms.tenant_profiles.limit_for_database(
+                cap = dbms.tenant_profiles.limit_for_database(
                     getattr(self.ctx, "database_name", ""),
                     "memory_limit")
+                if cap is not None:
+                    caps.append(cap)
+            up = getattr(self.ctx, "user_profiles", None)
+            if up is not None and self.username:
+                cap = up.limit_for_user(self.username,
+                                        "transactions_memory")
+                if cap is not None:
+                    caps.append(cap)
+            mem_limit = min(caps) if caps else None
         exec_ctx = ExecutionContext(accessor, parameters,
                                     View.NEW, self.ctx, timeout_checker,
                                     memory=QueryMemoryTracker(mem_limit))

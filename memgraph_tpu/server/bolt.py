@@ -238,15 +238,38 @@ class BoltSession:
         # message loop awaits each dispatch before reading the next.
         self._executor = executor
 
-    def _register_session(self) -> None:
+    def _register_session(self) -> bool:
         """SHOW ACTIVE USERS INFO registry (reference: GetActiveUsersInfo,
-        interpreter.cpp SystemInfoQuery ACTIVE_USERS)."""
+        interpreter.cpp SystemInfoQuery ACTIVE_USERS). Also the
+        enforcement point for the user profile `sessions` limit
+        (reference: user_profiles.cpp kSessions) — False = refused."""
         import datetime
         sessions = getattr(self.ictx, "active_sessions", None)
         if sessions is None:
             sessions = self.ictx.active_sessions = {}
+        username = self.interpreter.username or ""
+        profiles = getattr(self.ictx, "user_profiles", None)
+        if profiles is not None and username:
+            cap = profiles.limit_for_user(username, "sessions")
+            if cap is not None:
+                live = sum(1 for sid, (u, _t) in sessions.items()
+                           if u == username and sid != self.session_id)
+                if live >= cap:
+                    return False
         ts = datetime.datetime.now(datetime.timezone.utc).isoformat()
-        sessions[self.session_id] = (self.interpreter.username or "", ts)
+        sessions[self.session_id] = (username, ts)
+        return True
+
+    def _register_or_refuse(self) -> bool:
+        """Register, or send the session-limit refusal; False = refused
+        (the failure is already on the wire, caller just returns)."""
+        if self._register_session():
+            return True
+        self.authenticated = False
+        self.send_failure(
+            "Memgraph.ClientError.Security.Unauthenticated",
+            "session limit exceeded for this user's profile")
+        return False
 
     def _unregister_session(self) -> None:
         getattr(self.ictx, "active_sessions", {}).pop(self.session_id, None)
@@ -453,8 +476,8 @@ class BoltSession:
             else:
                 self.authenticated = True
                 self.interpreter.username = principal
-        if self.authenticated:
-            self._register_session()
+        if self.authenticated and not self._register_or_refuse():
+            return True
         server_name = (getattr(self.ictx, "config", {}) or {}).get(
             "bolt_server_name") or "Neo4j/5.2.0 compatible (memgraph-tpu)"
         self.send_success({
@@ -480,7 +503,8 @@ class BoltSession:
                 return True
             self.authenticated = True
             self.interpreter.username = username
-            self._register_session()
+            if not self._register_or_refuse():
+                return True
             self.send_success({})
             return True
         if self.auth is not None and not self.auth.authenticate(
@@ -491,7 +515,8 @@ class BoltSession:
             return True
         self.authenticated = True
         self.interpreter.username = principal  # RBAC enforcement identity
-        self._register_session()
+        if not self._register_or_refuse():
+            return True
         self.send_success()
         return True
 

