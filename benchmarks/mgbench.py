@@ -239,6 +239,8 @@ def main():
         print(f"loading supernode hub with {args.supernode} spokes ...",
               file=sys.stderr)
         client.execute("CREATE INDEX ON :SNode(id)")
+        client.execute("CREATE INDEX ON :Supernode")
+        client.execute("CREATE INDEX ON :Supernode(id)")
         client.execute("CREATE (:Supernode {id: 0})")
         for start in range(0, args.supernode, batch):
             ids = list(range(start, min(start + batch, args.supernode)))

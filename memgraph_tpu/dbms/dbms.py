@@ -105,6 +105,11 @@ class DbmsHandler:
                     wire_durability(storage)
         ictx = InterpreterContext(storage, dict(self._interp_config))
         ictx.database_name = name
+        # per-DB arena cap: the tenant profile's storage_limit is
+        # enforced at write commits (storage._check_db_memory_limit)
+        storage.memory_limit_fn = (
+            lambda n=name: self.tenant_profiles.limit_for_database(
+                n, "storage_limit"))
         ictx.dbms = self
         if cfg.durability_dir:
             from ..storage.kvstore import KVStore, Settings

@@ -825,6 +825,38 @@ class InMemoryStorage:
     def latest_commit_ts(self) -> int:
         return self._timestamp
 
+    def _check_db_memory_limit(self, txn: "Transaction") -> None:
+        """Tenant-profile `storage_limit` (per-DB arena cap, reference:
+        memory/db_arena.cpp): refuse GROWING commits once the database's
+        estimated footprint exceeds it. Transactions that create no
+        objects (deletes, label/property updates) always pass — an
+        over-limit database must stay recoverable in-band via DETACH
+        DELETE. The O(sample) estimate is recomputed at most every 5s
+        and immediately when the limit value changes; writes inside
+        that staleness window are admitted (sampling estimator, not an
+        allocator hook — documented deviation)."""
+        fn = getattr(self, "memory_limit_fn", None)
+        if fn is None:
+            return
+        limit = fn()
+        if not limit:
+            return
+        # growing = the txn created vertices/edges (their undo action
+        # is DELETE_OBJECT); delete-only / update-only txns pass
+        if not any(d.action is DeltaAction.DELETE_OBJECT
+                   for d in txn.deltas):
+            return
+        import time as _time
+        now = _time.monotonic()
+        cached = getattr(self, "_arena_estimate", None)
+        if cached is None or now - cached[0] > 5.0 or cached[2] != limit:
+            cached = (now, self.memory_usage_estimate(), limit)
+            self._arena_estimate = cached
+        if cached[1] > limit:
+            raise StorageError(
+                f"database memory limit exceeded: ~{cached[1]:,} bytes "
+                f"used, storage_limit {limit:,} (tenant profile)")
+
     def _commit(self, txn: Transaction) -> int:
         storage_mode = self.config.storage_mode
         if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or not txn.deltas:
@@ -834,6 +866,7 @@ class InMemoryStorage:
                 # expose, and advancing would leak later commits into a
                 # read-only SI transaction's retained accessors
                 return self._timestamp
+        self._check_db_memory_limit(txn)
 
         touched = list(txn.touched_vertices.values())
         # existence + type constraints against the transaction's NEW state
@@ -1106,6 +1139,45 @@ class InMemoryStorage:
 
     # --- info ---------------------------------------------------------------
 
+    def memory_usage_estimate(self) -> int:
+        """Approximate live bytes held by THIS database's graph objects.
+
+        Behavioral counterpart of the reference's per-DB arena
+        accounting (memory/db_arena.cpp:204-283 — jemalloc arenas per
+        database); CPython has no per-object arena hooks, so this
+        samples up to 512 vertices/edges, deep-sizes them
+        (object + labels + property keys/values + adjacency tuples),
+        and scales by the population. O(sample), computed on demand."""
+        import sys
+        from itertools import islice
+
+        def deep(obj) -> int:
+            n = sys.getsizeof(obj)
+            if isinstance(obj, dict):
+                n += sum(deep(k) + deep(v) for k, v in obj.items())
+            elif isinstance(obj, (list, tuple, set, frozenset)):
+                n += sum(deep(x) for x in obj)
+            return n
+
+        def sample_total(pop: dict, size_fn) -> int:
+            # snapshot the values list first: concurrent commits/GC
+            # mutate these dicts (same defense as the GC sweep)
+            values = list(pop.values())
+            count = len(values)
+            if count == 0:
+                return 0
+            sample = list(islice(values, 512))
+            return int(sum(size_fn(o) for o in sample)
+                       / len(sample) * count)
+
+        v_bytes = sample_total(self._vertices, lambda v: (
+            sys.getsizeof(v) + deep(v.labels) + deep(v.properties)
+            + sys.getsizeof(v.in_edges) + sys.getsizeof(v.out_edges)
+            + 72 * (len(v.in_edges) + len(v.out_edges))))
+        e_bytes = sample_total(self._edges, lambda e: (
+            sys.getsizeof(e) + deep(e.properties)))
+        return v_bytes + e_bytes
+
     def info(self) -> dict:
         from ..utils.memory_tracker import GLOBAL
         import resource
@@ -1123,4 +1195,6 @@ class InMemoryStorage:
             "peak_memory_tracked": GLOBAL.peak,
             "peak_memory_res": rss_kb * 1024,
             "memory_limit": GLOBAL.limit,
+            # per-DB arena estimate (reference: memory/db_arena.cpp)
+            "memory_usage_db_estimate": self.memory_usage_estimate(),
         }

@@ -177,6 +177,44 @@ class TestStorageFlags:
         assert ictx.storage is not None
 
 
+class TestDbArena:
+    def test_memory_estimate_in_storage_info(self):
+        interp = Interpreter(InterpreterContext(InMemoryStorage()))
+        interp.execute("UNWIND range(1, 500) AS i "
+                       "CREATE (:N {data: 'x' + toString(i)})")
+        _, rows, _ = interp.execute("SHOW STORAGE INFO")
+        info = {r[0]: r[1] for r in rows}
+        est = info["memory_usage_db_estimate"]
+        # 500 vertices with labels+props: plausibly tens of KB, not 0
+        assert est > 50_000, est
+
+    def test_tenant_storage_limit_refuses_writes(self, tmp_path):
+        from memgraph_tpu.dbms.dbms import DbmsHandler
+        from memgraph_tpu.exceptions import StorageError
+        dbms = DbmsHandler(StorageConfig(), {})
+        ictx = dbms.default()
+        interp = Interpreter(ictx)
+        interp.execute("UNWIND range(1, 300) AS i CREATE (:N {v: i})")
+        dbms.tenant_profiles.create("tiny", {"storage_limit": 1000})
+        dbms.tenant_profiles.assign("memgraph", "tiny")
+        # limit-change invalidates the 5s estimate cache immediately
+        with pytest.raises(Exception, match="memory limit exceeded"):
+            interp.execute("CREATE (:N {v: -1})")
+        # reads still work, and so do DELETES — an over-limit database
+        # must remain recoverable in-band (review finding r5)
+        _, rows, _ = interp.execute("MATCH (n:N) RETURN count(n)")
+        assert rows == [[300]]
+        interp.execute("MATCH (n:N) WITH n LIMIT 250 DETACH DELETE n")
+        # property updates on survivors pass too (not a growing commit)
+        interp.execute("MATCH (n:N) SET n.touched = true")
+        # still over the (absurdly small) limit for growth...
+        with pytest.raises(Exception, match="memory limit exceeded"):
+            interp.execute("CREATE (:N {v: -2})")
+        # ...until the profile is lifted
+        dbms.tenant_profiles.clear("memgraph")
+        interp.execute("CREATE (:N {v: -1})")
+
+
 class TestBuildConfig:
     def test_strict_flag_check(self, capsys):
         from memgraph_tpu.main import build_config

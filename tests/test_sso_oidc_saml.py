@@ -146,6 +146,41 @@ class TestOIDC:
         finally:
             mod.close()
 
+    def test_key_rotation_refetches_within_ttl(self, rsa_key, tmp_path):
+        """The IdP rotates signing keys while the module's JWKS cache is
+        warm: a kid miss must bypass the cache once (review fix r5)."""
+        from cryptography.hazmat.primitives.asymmetric import rsa as _rsa
+        jwks_path = tmp_path / "rotating.json"
+
+        def write_jwks(key, kid):
+            nums = key.public_key().public_numbers()
+            jwk = {"kty": "RSA", "kid": kid, "alg": "RS256",
+                   "n": _b64url(nums.n.to_bytes(
+                       (nums.n.bit_length() + 7) // 8, "big")),
+                   "e": _b64url(nums.e.to_bytes(
+                       (nums.e.bit_length() + 7) // 8, "big"))}
+            jwks_path.write_text(json.dumps({"keys": [jwk]}))
+
+        write_jwks(rsa_key, "test-key-1")
+        mod = AuthModule(_oidc_wrapper(
+            tmp_path, f"file://{jwks_path}", "idp-admins:admin"))
+        try:
+            r = mod.call({"scheme": "oidc-custom", "username": "",
+                          "response":
+                          f"access_token={_access_token(rsa_key)}"})
+            assert r["authenticated"] is True     # cache now warm
+            new_key = _rsa.generate_private_key(
+                public_exponent=65537, key_size=2048)
+            write_jwks(new_key, "rotated-key")
+            tok = mint_jwt(new_key, {
+                "sub": "alice", "aud": "mg-aud", "roles": ["idp-admins"],
+                "exp": int(time.time()) + 300}, kid="rotated-key")
+            r = mod.call({"scheme": "oidc-custom", "username": "",
+                          "response": f"access_token={tok}"})
+            assert r["authenticated"] is True, r   # refetched on kid miss
+        finally:
+            mod.close()
+
     def test_e2e_auth_multi_roles(self, rsa_key, jwks_file, tmp_path):
         auth = Auth(str(tmp_path / "auth.json"),
                     module_mappings=parse_module_mappings(
