@@ -245,8 +245,13 @@ class KernelClient:
 def ensure_server(socket_path: str = DEFAULT_SOCKET,
                   spawn_timeout_s: float = 120.0,
                   idle_timeout_s: float = 900.0):
-    """Connect to the resident server, spawning it if absent. Returns a
-    connected KernelClient or None if the server cannot start."""
+    """Connect to the resident server, spawning it if absent.
+
+    Returns a connected KernelClient, or None when the spawn TIMED OUT
+    (the stillborn daemon is killed so it cannot keep competing for
+    CPU). A daemon that DIED during init raises RuntimeError — that is
+    a real regression, not an environmental condition, and callers'
+    skip/fallback paths must not mask it."""
     try:
         c = KernelClient(socket_path, timeout=spawn_timeout_s)
         if c.ping():
@@ -262,7 +267,8 @@ def ensure_server(socket_path: str = DEFAULT_SOCKET,
     deadline = time.monotonic() + spawn_timeout_s
     while time.monotonic() < deadline:
         if proc.poll() is not None:
-            return None           # died during init (no device, ...)
+            raise RuntimeError(
+                f"kernel server died during init (rc={proc.returncode})")
         try:
             c = KernelClient(socket_path, timeout=spawn_timeout_s)
             if c.ping():
@@ -270,6 +276,11 @@ def ensure_server(socket_path: str = DEFAULT_SOCKET,
             c.close()
         except OSError:
             time.sleep(0.1)
+    try:
+        proc.kill()               # a starved spawn must not linger
+        proc.wait(timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
     return None
 
 
@@ -279,6 +290,8 @@ def main() -> None:
     ap.add_argument("--socket", default=DEFAULT_SOCKET)
     ap.add_argument("--idle-timeout", type=float, default=900.0)
     args = ap.parse_args()
+    from ..utils.jax_cache import honor_jax_platforms_env
+    honor_jax_platforms_env()
     KernelServer(args.socket, idle_timeout_s=args.idle_timeout).serve_forever()
 
 

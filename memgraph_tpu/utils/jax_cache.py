@@ -58,3 +58,21 @@ def ensure_compile_cache() -> bool:
         return False
     _done = True
     return True
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-apply JAX_PLATFORMS after a site hook pre-initialized jax with
+    a different backend (the axon .pth pins the TPU plugin regardless of
+    env — a cpu-pinned process must not touch, or hang on, the tunnel).
+    Shared by the composition root, bench stages, and the kernel-server
+    daemon; failures are LOGGED, not swallowed, because silently running
+    on the pinned backend is exactly the hang this call prevents."""
+    platform = os.environ.get("JAX_PLATFORMS")
+    if not platform:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    except Exception:  # noqa: BLE001 — diagnose, then proceed pinned
+        log.exception("could not re-apply JAX_PLATFORMS=%s; this process "
+                      "will use the pre-initialized backend", platform)
