@@ -591,13 +591,8 @@ def main(argv=None) -> int:
         _snap.POOL_WORKERS = args.storage_snapshot_thread_count
     # honor JAX_PLATFORMS even when a site hook pre-initialized jax with a
     # different backend (e.g. the axon TPU plugin)
-    import os
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            import jax
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            logging.exception("could not apply JAX_PLATFORMS")
+    from .utils.jax_cache import honor_jax_platforms_env
+    honor_jax_platforms_env()
     if bool(args.bolt_cert_file) != bool(args.bolt_key_file):
         logging.error("--bolt-cert-file and --bolt-key-file must be "
                       "given together")

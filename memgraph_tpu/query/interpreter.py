@@ -351,6 +351,8 @@ class Interpreter:
             indices.analyze_stats = {
                 k: v for k, v in indices.analyze_stats.items()
                 if not wanted(k[0])}
+            # cached plans were chosen under the dropped statistics
+            self.ctx.invalidate_plans()
             return self._prepare_generator(
                 iter(rows), ["label", "property"], "r")
 
@@ -415,6 +417,9 @@ class Interpreter:
         finally:
             acc.abort()
         indices.analyze_stats.update(stats)
+        # fresh statistics change index selection: cached plans must
+        # re-plan (reference re-plans through its stats-keyed cache)
+        self.ctx.invalidate_plans()
         return self._prepare_generator(
             iter(rows),
             ["label", "property", "num estimation nodes", "num groups",

@@ -548,8 +548,20 @@ class Planner:
             eq_props = self._equality_props(node, pending)
             for (ilabel, iprops) in indices.label_property.relevant_to(lid):
                 if all(pmapper.id_to_name(p) in eq_props for p in iprops):
-                    best = min(best, indices.label_property.approx_count(
-                        ilabel, iprops) / max(len(iprops), 1))
+                    # ANALYZE GRAPH statistics predict an equality
+                    # lookup's result size exactly: the average group
+                    # size per distinct key (reference:
+                    # cost_estimator.hpp using
+                    # label_property_index_stats avg_group_size);
+                    # without stats, fall back to the count heuristic
+                    stats = indices.analyze_stats.get((ilabel, iprops))
+                    if stats and stats.get("num_groups"):
+                        best = min(best, float(stats["avg_group_size"]))
+                    else:
+                        best = min(best,
+                                   indices.label_property.approx_count(
+                                       ilabel, iprops)
+                                   / max(len(iprops), 1))
             if indices.label.has(lid):
                 best = min(best, float(indices.label.approx_count(lid)))
             else:
@@ -613,9 +625,20 @@ class Planner:
             lid = mapper.maybe_name_to_id(label)
             if lid is None:
                 continue
-            # equality composite index (hinted key tried first)
+            # equality composite index: most selective first — by
+            # ANALYZE GRAPH avg_group_size when stats exist, else by
+            # specificity (longest prefix)
+            def _expected_rows(key):
+                stats = indices.analyze_stats.get(key)
+                if stats and stats.get("num_groups"):
+                    return float(stats["avg_group_size"])
+                # no stats (e.g. index created after ANALYZE): fall back
+                # to the live count heuristic so a fresh selective index
+                # still competes with stale-analyzed ones
+                return (indices.label_property.approx_count(*key)
+                        / max(len(key[1]), 1))
             keys = sorted(indices.label_property.relevant_to(lid),
-                          key=lambda k: -len(k[1]))
+                          key=lambda k: (_expected_rows(k), -len(k[1])))
             if hint is not None and hint.label == label and hint.properties:
                 hint_pids = tuple(pmapper.maybe_name_to_id(pr)
                                   for pr in hint.properties)

@@ -266,9 +266,9 @@ def ensure_server(socket_path: str = DEFAULT_SOCKET,
         start_new_session=True)   # survives the spawning client
     deadline = time.monotonic() + spawn_timeout_s
     while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            raise RuntimeError(
-                f"kernel server died during init (rc={proc.returncode})")
+        # keep polling the socket even if OUR child died: in a spawn
+        # race the loser exits on the unix-socket bind conflict while
+        # the winner is still importing jax — its server arrives soon
         try:
             c = KernelClient(socket_path, timeout=spawn_timeout_s)
             if c.ping():
@@ -276,6 +276,11 @@ def ensure_server(socket_path: str = DEFAULT_SOCKET,
             c.close()
         except OSError:
             time.sleep(0.1)
+    if proc.poll() is not None:
+        # nobody ever served AND our daemon died: a real init failure
+        # (import error, crash), not environmental starvation
+        raise RuntimeError(
+            f"kernel server died during init (rc={proc.returncode})")
     try:
         proc.kill()               # a starved spawn must not linger
         proc.wait(timeout=10)

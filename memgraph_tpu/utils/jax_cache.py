@@ -64,9 +64,10 @@ def honor_jax_platforms_env() -> None:
     """Re-apply JAX_PLATFORMS after a site hook pre-initialized jax with
     a different backend (the axon .pth pins the TPU plugin regardless of
     env — a cpu-pinned process must not touch, or hang on, the tunnel).
-    Shared by the composition root, bench stages, and the kernel-server
-    daemon; failures are LOGGED, not swallowed, because silently running
-    on the pinned backend is exactly the hang this call prevents."""
+    Shared by the composition root and the kernel-server daemon
+    (bench.py stages do the same dance on their own BENCH_JAX_PLATFORM
+    variable); failures are LOGGED, not swallowed, because silently
+    running on the pinned backend is exactly the hang this prevents."""
     platform = os.environ.get("JAX_PLATFORMS")
     if not platform:
         return

@@ -39,13 +39,21 @@ int benes_route(const int64_t* perm, int64_t N, uint8_t* masks_packed) {
   std::memset(masks_packed, 0,
               static_cast<size_t>(n_stages) * bytes_per_stage);
 
-  // forward[p] = q: element at input p must reach output q.
-  std::vector<int32_t> fwd(N, -1), nxt(N), inv(N);
-  std::vector<int8_t> halves(N);
+  // forward[p] = q: element at input p must reach output q. The cycle
+  // walk is cache-miss-bound at large N, so the 2-coloring state rides
+  // in the TOP BITS of the fwd entries (bit 31 = colored, bit 30 =
+  // color) instead of a separate halves[] array — one cacheline per
+  // random access where there used to be two. Requires N < 2^30.
+  if (N >= (int64_t{1} << 30)) return 1;
+  constexpr uint32_t kColored = 0x80000000u;
+  constexpr uint32_t kColor = 0x40000000u;
+  constexpr uint32_t kValue = 0x3FFFFFFFu;
+  std::vector<uint32_t> fwd(N, kValue), nxt(N);
+  std::vector<int32_t> inv(N);
   for (int64_t i = 0; i < N; i++) {
     if (perm[i] < 0 || perm[i] >= N) return 1;
-    if (fwd[perm[i]] >= 0) return 1;  // duplicate: not a bijection
-    fwd[perm[i]] = static_cast<int32_t>(i);
+    if (fwd[perm[i]] != kValue) return 1;  // duplicate: not a bijection
+    fwd[perm[i]] = static_cast<uint32_t>(i);
   }
 
   for (int level = 0; level < n - 1; level++) {
@@ -55,44 +63,48 @@ int benes_route(const int64_t* perm, int64_t N, uint8_t* masks_packed) {
     uint8_t* out_bits =
         masks_packed + int64_t(n_stages - 1 - level) * bytes_per_stage;
     for (int64_t base = 0; base < N; base += B) {
-      int32_t* f = fwd.data() + base;
+      uint32_t* f = fwd.data() + base;
       int32_t* iv = inv.data() + base;
-      int8_t* hv = halves.data() + base;
-      for (int64_t i = 0; i < B; i++) iv[f[i]] = static_cast<int32_t>(i);
-      std::memset(hv, -1, B);
+      for (int64_t i = 0; i < B; i++)
+        iv[f[i] & kValue] = static_cast<int32_t>(i);
       for (int64_t start = 0; start < B; start++) {
-        if (hv[start] >= 0) continue;
+        if (f[start] & kColored) continue;
         int64_t i = start;
-        int8_t color = 0;
-        while (hv[i] < 0) {
-          hv[i] = color;
-          const int64_t ip = i ^ h;  // input partner
-          if (hv[ip] < 0) hv[ip] = color ^ 1;
-          const int64_t op_out = int64_t(f[ip]) ^ h;  // ip's output partner
+        uint32_t color = 0;  // 0 = top half, kColor = bottom half
+        while (!(f[i] & kColored)) {
+          f[i] |= kColored | color;
+          const int64_t ip = i ^ h;  // input partner: the other half
+          const uint32_t fip = f[ip];
+          if (!(fip & kColored)) f[ip] = fip | kColored | (color ^ kColor);
+          // ip's output partner: the element sharing ip's output pair
+          const int64_t op_out = int64_t(f[ip] & kValue) ^ h;
           i = iv[op_out];
-          color = hv[ip] ^ 1;
+          color = (f[ip] & kColor) ^ kColor;
         }
       }
-      // IN stage: element at local input i routed to half hv[i]; the pair
-      // (i, i+h) swaps iff the element in the top slot goes bottom.
+      // IN stage: element at local input i routed to half color(i); the
+      // pair (i, i+h) swaps iff the element in the top slot goes bottom.
       for (int64_t i = 0; i < B; i++) {
-        const bool swap_in = (hv[i] == 1) == (i < h);
-        set_bit(in_bits, base + i, swap_in);
+        const bool bottom = (f[i] & kColor) != 0;
+        set_bit(in_bits, base + i, bottom == (i < h));
       }
-      // OUT stage: output o receives its element from half hv[iv[o]].
+      // OUT stage: output o receives its element from half color(iv[o]).
       for (int64_t o = 0; o < B; o++) {
-        const bool swap_out = (hv[iv[o]] == 1) == (o < h);
-        set_bit(out_bits, base + o, swap_out);
+        const bool bottom = (f[iv[o]] & kColor) != 0;
+        set_bit(out_bits, base + o, bottom == (o < h));
       }
-      // Sub-permutations (forward form, local to each half).
-      int32_t* top = nxt.data() + base;
-      int32_t* bot = nxt.data() + base + h;
+      // Sub-permutations (forward form, local to each half; color and
+      // colored bits are consumed here, nxt starts clean).
+      uint32_t* top = nxt.data() + base;
+      uint32_t* bot = nxt.data() + base + h;
       for (int64_t i = 0; i < B; i++) {
         const int64_t slot = i & (h - 1);
-        if (hv[i] == 0)
-          top[slot] = static_cast<int32_t>(int64_t(f[i]) & (h - 1));
+        const uint32_t val =
+            static_cast<uint32_t>(int64_t(f[i] & kValue) & (h - 1));
+        if (f[i] & kColor)
+          bot[slot] = val;
         else
-          bot[slot] = static_cast<int32_t>(int64_t(f[i]) & (h - 1));
+          top[slot] = val;
       }
     }
     fwd.swap(nxt);
@@ -100,7 +112,7 @@ int benes_route(const int64_t* perm, int64_t N, uint8_t* masks_packed) {
   // middle level: blocks of 2
   uint8_t* mid = masks_packed + int64_t(n - 1) * bytes_per_stage;
   for (int64_t base = 0; base < N; base += 2) {
-    const bool sw = fwd[base] == 1;
+    const bool sw = (fwd[base] & kValue) == 1;
     set_bit(mid, base, sw);
     set_bit(mid, base + 1, sw);
   }
