@@ -36,6 +36,12 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def _coerce_weight(w) -> float:
+    """Edge-weight property -> float; non-numeric/missing -> 1.0."""
+    return (float(w) if isinstance(w, (int, float))
+            and not isinstance(w, bool) else 1.0)
+
+
 @dataclass(frozen=True)
 class DeviceGraph:
     """Immutable CSR+CSC snapshot. Arrays may live on device (jax) or host (np).
@@ -245,9 +251,8 @@ def export_csr(accessor, weight_property: Optional[int] = None,
         srcs.append(si)
         dsts.append(di)
         if has_w:
-            w = props.get(weight_property) if props else None
-            ws.append(float(w) if isinstance(w, (int, float))
-                      and not isinstance(w, bool) else 1.0)
+            ws.append(_coerce_weight(
+                props.get(weight_property) if props else None))
 
     g = from_coo(np.asarray(srcs, dtype=np.int64),
                  np.asarray(dsts, dtype=np.int64),
@@ -255,6 +260,101 @@ def export_csr(accessor, weight_property: Optional[int] = None,
                  n_nodes=len(node_gids),
                  node_gids=np.asarray(node_gids, dtype=np.int64),
                  pad=pad)
+    return g.to_device() if to_device else g
+
+
+def export_csr_delta(prev: DeviceGraph, accessor, changed_gids,
+                     weight_property=None, label_filter=None,
+                     edge_type_filter=None, pad: bool = True,
+                     to_device: bool = True):
+    """O(changed) re-export: splice the changed vertices' edges into the
+    previous snapshot's host arrays instead of walking ALL edges in
+    Python (the full export is the dominant per-version cost at 10M
+    edges). Valid only while the VERTEX SET of the view is unchanged —
+    returns None when it cannot guarantee that (caller falls back to
+    export_csr). Rebuild = drop every edge incident to a changed vertex
+    from the previous COO, append the changed vertices' current edges
+    read from storage (O(changed x degree)), then one native/numpy
+    from_coo pass.
+    """
+    if prev.host_coo is None:
+        return None
+    storage = accessor.storage
+    changed = list(changed_gids)
+    bitmap = np.zeros(prev.n_nodes, dtype=bool)
+    from ..storage.storage import VertexAccessor
+    fresh_src: list = []
+    fresh_dst: list = []
+    fresh_w: list = []
+    has_w = weight_property is not None
+    for gid in changed:
+        idx = prev.gid_to_idx.get(gid)
+        vertex = storage._vertices.get(gid)
+        if vertex is None:
+            return None               # vertex gone: node set changed
+        va = VertexAccessor(vertex, accessor)
+        visible = va.is_visible(View.OLD)
+        if label_filter is not None and visible:
+            visible = va.has_label(label_filter, View.OLD)
+        if idx is None or not visible:
+            return None               # joined/left the view: full export
+        bitmap[idx] = True
+    from ..storage.storage import EdgeAccessor
+    for gid in changed:
+        idx = prev.gid_to_idx[gid]
+        vertex = storage._vertices[gid]
+        # raw MVCC state, NOT VertexAccessor.out_edges/in_edges: those
+        # apply the SESSION's fine-grained permissions (_fg_edge_ok),
+        # and a globally cached snapshot must match export_csr's
+        # permission-free content regardless of which user built it
+        st = accessor._vertex_state(vertex, View.OLD)
+        for (etype, _other, edge) in st.out_edges:
+            if edge_type_filter is not None and \
+                    etype not in edge_type_filter:
+                continue
+            ea = EdgeAccessor(edge, accessor)
+            if not ea.is_visible(View.OLD):
+                continue
+            di = prev.gid_to_idx.get(edge.to_vertex.gid)
+            if di is None:
+                return None           # new endpoint: node set changed
+            # every out-edge of a changed vertex re-emits exactly once
+            # here; edges INTO a changed vertex from an UNCHANGED source
+            # re-emit in the in_edges pass below
+            fresh_src.append(idx)
+            fresh_dst.append(di)
+            if has_w:
+                fresh_w.append(_coerce_weight(
+                    ea.properties(View.OLD).get(weight_property)))
+        for (etype, _other, edge) in st.in_edges:
+            if edge_type_filter is not None and \
+                    etype not in edge_type_filter:
+                continue
+            ea = EdgeAccessor(edge, accessor)
+            if not ea.is_visible(View.OLD):
+                continue
+            si = prev.gid_to_idx.get(edge.from_vertex.gid)
+            if si is None:
+                return None
+            if bitmap[si]:
+                continue              # its changed src re-emits it
+            fresh_src.append(si)
+            fresh_dst.append(idx)
+            if has_w:
+                fresh_w.append(_coerce_weight(
+                    ea.properties(View.OLD).get(weight_property)))
+    p_src, p_dst, p_w = prev.host_coo
+    keep = ~(bitmap[p_src] | bitmap[p_dst])
+    src = np.concatenate([p_src[keep].astype(np.int64),
+                          np.asarray(fresh_src, dtype=np.int64)])
+    dst = np.concatenate([p_dst[keep].astype(np.int64),
+                          np.asarray(fresh_dst, dtype=np.int64)])
+    weights = None
+    if has_w:
+        weights = np.concatenate(
+            [p_w[keep], np.asarray(fresh_w, dtype=np.float32)])
+    g = from_coo(src, dst, weights, n_nodes=prev.n_nodes,
+                 node_gids=prev.node_gids, pad=pad)
     return g.to_device() if to_device else g
 
 
@@ -279,26 +379,59 @@ class GraphCache:
         storage = accessor.storage
         etf = (tuple(sorted(edge_type_filter))
                if edge_type_filter is not None else None)
-        version = storage.topology_version
+        # key on the TRANSACTION's topology snapshot, not the live
+        # version: a concurrent commit after this txn began must not be
+        # visible in (or poison) the snapshot cached for this view —
+        # the bump is atomic with the visibility flip relative to this
+        # capture (storage._commit), so the snapshot id and the MVCC
+        # view agree (r5 review findings 2+3)
+        version = getattr(accessor, "topology_snapshot", None)
+        if version is None:
+            version = storage.topology_version
         key = (version, weight_property, label_filter, etf)
         base_key = ("base", weight_property, label_filter, etf)
+        newest = None
         with self._lock:
             per_storage = self._cache.get(storage)
             hit = per_storage.get(key) if per_storage else None
             base = per_storage.get(base_key) if per_storage else None
-            # a snapshot becomes the base anchor only after pagerank
-            # marks it (_mxu_base_self post-dates its get()), so also
-            # scan live version entries for the newest marked one
             for k, v in (per_storage or {}).items():
-                if k[0] != "base" and k[1:] == key[1:] \
-                        and getattr(v, "_mxu_base_self", False) \
+                if k[0] == "base" or k[1:] != key[1:]:
+                    continue
+                # base anchor: newest snapshot with a FULL mxu plan
+                # (_mxu_base_self post-dates its get(), so scan live)
+                if getattr(v, "_mxu_base_self", False) \
                         and (base is None or base[0] < k[0]):
                     base = (k[0], v)
+                # delta-export base: newest snapshot STRICTLY OLDER than
+                # this view (a newer one may contain commits this txn
+                # cannot see)
+                if k[0] < version and (newest is None
+                                       or k[0] > newest[0]):
+                    newest = (k[0], v)
         if hit is not None:
             return hit
-        g = export_csr(accessor, weight_property=weight_property,
-                       label_filter=label_filter,
-                       edge_type_filter=edge_type_filter)
+        g = None
+        # O(changed) incremental export (the python walk over ALL edges
+        # is the dominant per-version cost at 10M+ edges); bulk commits
+        # touching a large fraction of the graph fall back to the full
+        # export, whose delta-free fast path is cheaper per edge
+        if newest is not None:
+            changed = storage.changes_between(newest[0], version)
+            if changed is not None and \
+                    len(changed) <= max(1024, newest[1].n_nodes // 5):
+                try:
+                    g = export_csr_delta(
+                        newest[1], accessor, changed,
+                        weight_property=weight_property,
+                        label_filter=label_filter,
+                        edge_type_filter=edge_type_filter)
+                except Exception:  # noqa: BLE001 — any doubt: full export
+                    g = None
+        if g is None:
+            g = export_csr(accessor, weight_property=weight_property,
+                           label_filter=label_filter,
+                           edge_type_filter=edge_type_filter)
         # Delta lineage: if an earlier snapshot of this view carries a
         # fully-built MXU plan, record it plus the changed-vertex set so
         # the analytics layer can refresh O(delta) instead of replanning
@@ -310,11 +443,13 @@ class GraphCache:
                     and getattr(base_g, "_mxu_state", None) is not None:
                 object.__setattr__(g, "_delta_ctx", (base_g, changed))
         with self._lock:
-            # keep current-version variants (e.g. other weight properties)
-            # and base anchors; drop stale version snapshots
+            # keep base anchors, this version's variants (e.g. other
+            # weight properties), and NEWER versions (an older-view txn
+            # storing must not evict a newer snapshot — r5 review);
+            # drop strictly older version snapshots
             per = self._cache.get(storage) or {}
             prev = {k: v for k, v in per.items()
-                    if k[0] == "base" or k[0] == version}
+                    if k[0] == "base" or k[0] >= version}
             # the previous snapshot becomes the base anchor once a FULL
             # plan was built on it (pagerank marks _mxu_base_self)
             for k, v in per.items():

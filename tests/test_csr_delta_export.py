@@ -1,0 +1,186 @@
+"""Incremental CSR export (ops/csr.export_csr_delta): splicing changed
+vertices' edges into the previous snapshot must produce EXACTLY the
+arrays a full export produces — adds, removes, weight changes, filter
+views, and the fall-back-to-full conditions."""
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.ops.csr import GraphCache, export_csr, export_csr_delta
+from memgraph_tpu.storage import InMemoryStorage, StorageConfig, StorageMode
+
+
+def _graphs_equal(a, b):
+    for field in ("row_ptr", "col_idx", "src_idx", "weights",
+                  "csc_src", "csc_dst", "csc_weights", "out_degree"):
+        if not np.array_equal(np.asarray(getattr(a, field)),
+                              np.asarray(getattr(b, field))):
+            return field
+    if not np.array_equal(a.node_gids, b.node_gids):
+        return "node_gids"
+    return None
+
+
+@pytest.fixture
+def setup():
+    storage = InMemoryStorage(StorageConfig(
+        storage_mode=StorageMode.IN_MEMORY_TRANSACTIONAL))
+    rng = np.random.default_rng(5)
+    n, e = 400, 2500
+    acc = storage.access()
+    et = storage.edge_type_mapper.name_to_id("E")
+    vs = [acc.create_vertex() for _ in range(n)]
+    for s, d in zip(rng.integers(0, n, e), rng.integers(0, n, e)):
+        acc.create_edge(vs[s], vs[d], et)
+    acc.commit()
+    return storage, vs, et, n
+
+
+def _mutate(storage, vs, et, rng, adds=30, removes=10):
+    from memgraph_tpu.storage.storage import EdgeAccessor
+    acc = storage.access()
+    for _ in range(adds):
+        acc.create_edge(vs[int(rng.integers(0, len(vs)))],
+                        vs[int(rng.integers(0, len(vs)))], et)
+    removed = 0
+    for ve in list(storage._edges.values()):
+        if removed >= removes:
+            break
+        ea = EdgeAccessor(ve, acc)
+        if ea.is_visible():
+            acc.delete_edge(ea)
+            removed += 1
+    acc.commit()
+
+
+def test_delta_export_equals_full(setup):
+    storage, vs, et, n = setup
+    v0 = storage.topology_version
+    acc = storage.access()
+    prev = export_csr(acc, to_device=False)
+    acc.abort()
+    rng = np.random.default_rng(0)
+    _mutate(storage, vs, et, rng)
+    changed = storage.changes_between(v0, storage.topology_version)
+    assert changed
+    acc = storage.access()
+    got = export_csr_delta(prev, acc, changed, to_device=False)
+    want = export_csr(acc, to_device=False)
+    acc.abort()
+    assert got is not None
+    assert _graphs_equal(got, want) is None
+
+
+def test_delta_export_weighted(setup):
+    storage, vs, et, n = setup
+    wprop = storage.property_mapper.name_to_id("w")
+    from memgraph_tpu.storage.storage import EdgeAccessor
+    acc = storage.access()
+    for ve in list(storage._edges.values())[:100]:
+        EdgeAccessor(ve, acc).set_property(wprop, 2.5)
+    acc.commit()
+    v0 = storage.topology_version
+    acc = storage.access()
+    prev = export_csr(acc, weight_property=wprop, to_device=False)
+    acc.abort()
+    # weight change on one edge
+    acc = storage.access()
+    victim = next(iter(storage._edges.values()))
+    EdgeAccessor(victim, acc).set_property(wprop, 9.0)
+    acc.commit()
+    changed = storage.changes_between(v0, storage.topology_version)
+    acc = storage.access()
+    got = export_csr_delta(prev, acc, changed, weight_property=wprop,
+                           to_device=False)
+    want = export_csr(acc, weight_property=wprop, to_device=False)
+    acc.abort()
+    assert got is not None
+    assert _graphs_equal(got, want) is None
+    assert 9.0 in np.asarray(got.weights)
+
+
+def test_delta_export_bails_on_new_vertex(setup):
+    storage, vs, et, n = setup
+    v0 = storage.topology_version
+    acc = storage.access()
+    prev = export_csr(acc, to_device=False)
+    acc.abort()
+    acc = storage.access()
+    nv = acc.create_vertex()
+    acc.create_edge(nv, vs[0], et)
+    acc.commit()
+    changed = storage.changes_between(v0, storage.topology_version)
+    acc = storage.access()
+    got = export_csr_delta(prev, acc, changed, to_device=False)
+    acc.abort()
+    assert got is None    # node set changed: caller does a full export
+
+
+def test_graph_cache_uses_delta_path(setup, monkeypatch):
+    storage, vs, et, n = setup
+    cache = GraphCache()
+    acc = storage.access()
+    g1 = cache.get(acc)
+    acc.abort()
+    calls = {"full": 0}
+    import memgraph_tpu.ops.csr as csr_mod
+    real_full = csr_mod.export_csr
+
+    def counting_full(*a, **k):
+        calls["full"] += 1
+        return real_full(*a, **k)
+    monkeypatch.setattr(csr_mod, "export_csr", counting_full)
+    rng = np.random.default_rng(1)
+    _mutate(storage, vs, et, rng, adds=10, removes=3)
+    acc = storage.access()
+    g2 = cache.get(acc)
+    want = real_full(acc, to_device=False)
+    acc.abort()
+    assert calls["full"] == 0, "delta export did not engage"
+    assert _graphs_equal(g2, want) is None
+    # chained: a second mutation delta-exports from g2, not g1
+    _mutate(storage, vs, et, rng, adds=5, removes=2)
+    acc = storage.access()
+    g3 = cache.get(acc)
+    want3 = real_full(acc, to_device=False)
+    acc.abort()
+    assert calls["full"] == 0     # chained delta: still no full export
+    assert _graphs_equal(g3, want3) is None
+
+
+def test_delta_export_ignores_session_fine_grained_filters(setup):
+    """The globally cached snapshot's content must not depend on WHICH
+    user's session triggered the refresh: a fine-grained edge deny on
+    the triggering accessor must not leak into the delta-exported
+    arrays (r5 review finding)."""
+    from memgraph_tpu.auth.fine_grained import FgStorageView
+    from memgraph_tpu.auth.auth import Auth
+    storage, vs, et, n = setup
+    v0 = storage.topology_version
+    acc = storage.access()
+    prev = export_csr(acc, to_device=False)
+    acc.abort()
+    rng = np.random.default_rng(2)
+    _mutate(storage, vs, et, rng, adds=20, removes=5)
+    changed = storage.changes_between(v0, storage.topology_version)
+    # restricted accessor: no fine-grained edge grants for this session
+    auth = Auth(None)
+    auth.create_user("restricted", "pw")
+    auth.grant("restricted", ["MATCH"])
+    # fine-grained is opt-in: granting on an unrelated edge type makes
+    # the session restricted, and type E (ungranted) becomes invisible
+    auth.grant_fine_grained("restricted", "edge_types", ["OTHER"], "READ")
+    acc = storage.access()
+    checker = auth.fine_grained_checker("restricted")
+    assert checker.restricted
+    acc.fine_grained = FgStorageView(checker, storage)
+    # sanity: the session filter really does hide edges from accessors
+    some_v = next(iter(storage._vertices.values()))
+    from memgraph_tpu.storage.storage import VertexAccessor
+    va = VertexAccessor(some_v, acc)
+    assert va.out_edges() == [] and va.in_edges() == []
+    got = export_csr_delta(prev, acc, changed, to_device=False)
+    want = export_csr(storage.access(), to_device=False)
+    acc.abort()
+    assert got is not None
+    assert _graphs_equal(got, want) is None
