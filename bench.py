@@ -489,6 +489,28 @@ def main():
         if key in result:
             PARTIAL["extra"][key] = round(result[key], 2)
 
+    # bulk-write fast lane: storage-level batch_insert throughput (r6).
+    # Best-effort and cheap; the OLTP-grade end-to-end number lives in
+    # benchmarks/mgbench.py (OLTP_r06.json load_records_per_sec).
+    try:
+        from memgraph_tpu.storage import InMemoryStorage as _IMS
+        _st = _IMS()
+        _lid = _st.label_mapper.name_to_id("U")
+        _pid = _st.property_mapper.name_to_id("id")
+        _t0 = time.perf_counter()
+        _total = 0
+        while time.perf_counter() - _t0 < 2.0:
+            _acc = _st.access()
+            _acc.batch_insert(vertices=[
+                ((_lid,), {_pid: _total + i}) for i in range(10_000)])
+            _acc.commit()
+            _total += 10_000
+        _rate = _total / (time.perf_counter() - _t0)
+        PARTIAL["extra"]["bulk_insert_vertices_per_s"] = round(_rate, 1)
+        log(f"bulk ingest (batch_insert): {_rate:,.0f} vertices/s")
+    except Exception as _e:  # noqa: BLE001 — never block the north star
+        log(f"bulk ingest stage skipped: {_e}")
+
     # CALL-to-first-record latency (best-effort; never blocks the result)
     remaining = MASTER_TIMEOUT_SEC - (time.perf_counter() - t_bench) - 10
     if remaining > 45:

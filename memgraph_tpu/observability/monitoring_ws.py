@@ -117,6 +117,9 @@ class MonitoringServer:
     refuses unauthenticated traffic when access control is on).
     """
 
+    # log records queued for broadcast before the drain thread drops them
+    QUEUE_CAPACITY = 1024
+
     def __init__(self, host: str = "0.0.0.0", port: int = 7444,
                  auth=None, metrics=None) -> None:
         self.host, self.port = host, port
@@ -127,6 +130,12 @@ class MonitoringServer:
         self._srv: socket.socket | None = None
         self._stop = threading.Event()
         self._log_handler: logging.Handler | None = None
+        # broadcast() is called from INSIDE a logging.Handler on arbitrary
+        # threads; network sends happen only on the drain thread below, so
+        # a stalled monitoring client can never block a writer thread
+        import queue as _queue
+        self._queue: _queue.Queue = _queue.Queue(self.QUEUE_CAPACITY)
+        self.dropped_records = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -139,6 +148,8 @@ class MonitoringServer:
         self._srv.settimeout(0.5)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="monitoring-ws").start()
+        threading.Thread(target=self._drain_loop, daemon=True,
+                         name="monitoring-ws-broadcast").start()
         self._log_handler = _BroadcastHandler(self)
         self._log_handler.setLevel(logging.INFO)
         logging.getLogger().addHandler(self._log_handler)
@@ -147,6 +158,10 @@ class MonitoringServer:
         self._stop.set()
         if self._log_handler is not None:
             logging.getLogger().removeHandler(self._log_handler)
+        try:
+            self._queue.put_nowait(None)    # wake the drain thread
+        except Exception:   # noqa: BLE001 — queue full: drain sees _stop
+            pass
         with self._lock:
             sessions = list(self._sessions)
             self._sessions.clear()
@@ -161,6 +176,26 @@ class MonitoringServer:
     # -- broadcast ----------------------------------------------------------
 
     def broadcast(self, obj: dict) -> None:
+        """Enqueue for the drain thread; NEVER touches the network on the
+        caller's thread. A full queue drops the record (counted) rather
+        than exerting backpressure on whoever is logging."""
+        try:
+            self._queue.put_nowait(obj)
+        except Exception:   # noqa: BLE001 — queue.Full
+            self.dropped_records += 1
+
+    def _drain_loop(self) -> None:
+        import queue as _queue
+        while not self._stop.is_set():
+            try:
+                obj = self._queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            if obj is None:
+                continue
+            self._send_to_sessions(obj)
+
+    def _send_to_sessions(self, obj: dict) -> None:
         frame = encode_frame(json.dumps(obj).encode("utf-8"))
         with self._lock:
             sessions = list(self._sessions)
@@ -171,7 +206,7 @@ class MonitoringServer:
                     sock.sendall(frame)
             except (OSError, socket.timeout):
                 # includes send timeouts: slow/stalled clients are
-                # dropped rather than ever blocking the logger
+                # dropped rather than ever stalling the drain thread
                 dead.append((sock, lk))
         if dead:
             with self._lock:

@@ -93,7 +93,11 @@ class Evaluator:
         """Materialized accessor state; raises on a deleted entity
         (TCK DeletedEntityAccess; reference: ExpressionEvaluator raises
         on property/label access of deleted objects, eval.hpp)."""
-        st = obj._state(self.ctx.view)
+        if isinstance(obj, VertexAccessor):
+            # property/label reads: skip the O(degree) adjacency copy
+            st = obj._state(self.ctx.view, need_edges=False)
+        else:
+            st = obj._state(self.ctx.view)
         if not st.exists or st.deleted:
             kind = ("node" if isinstance(obj, VertexAccessor)
                     else "relationship")

@@ -82,7 +82,7 @@ class InterpreterContext:
             hit = self._plan_cache.get(key)
         if hit is not None:
             return hit
-        planner = Planner(self.storage)
+        planner = Planner(self.storage, self.config)
         import copy
         plan, columns = planner.plan_query(copy.deepcopy(query))
         with self._plan_cache_lock:
@@ -100,6 +100,11 @@ class PreparedQuery:
     columns: list[str]
     qid: int
     summary_type: str = "r"   # 'r' read, 'w' write, 'rw', 's' schema
+    # Cypher-only precise classification (plan-derived): True when the
+    # plan contains any updating operator. Read-only dispatchers (the
+    # multiprocess read executor) key on this instead of summary_type,
+    # which stays 'rw' for every Cypher query for Bolt compatibility.
+    is_write: bool = False
 
 
 class Interpreter:
@@ -157,6 +162,7 @@ class Interpreter:
         self._query_started = time.monotonic()
         self._query_text = text
         self._pending_op_counts = None   # drop any abandoned prepare's
+        self._query_priv_auth = False    # AUTH queries skip the slow log
         self.session_trace.emit("prepare", query=text)
         node = self.ctx.cached_parse(text)
         if isinstance(node, A.SessionTraceQuery):
@@ -172,6 +178,10 @@ class Interpreter:
                 iter(rows), ["timestamp", "event", "data"], "r")
 
         priv = self._NODE_PRIVILEGES.get(type(node).__name__)
+        # AUTH statements carry plaintext credentials (CREATE USER ...
+        # IDENTIFIED BY, SET PASSWORD): never echo them into the slow-query
+        # log / monitoring-websocket broadcast (ADVICE r5)
+        self._query_priv_auth = priv == "AUTH"
         if priv is not None:
             self._check_privilege(priv)
 
@@ -909,7 +919,7 @@ class Interpreter:
             columns_out = ["OPERATOR", "ACTUAL HITS", "RELATIVE TIME",
                            "ABSOLUTE TIME"]
             self._install_stream(rows_iter, accessor, owns)
-            return self._finish_prepare(columns_out, "r")
+            return self._finish_prepare(columns_out, "r", is_write)
 
         qinfo = {"query": text, "start": time.time(),
                  "interpreter": self}
@@ -934,7 +944,7 @@ class Interpreter:
                 self.ctx.running_queries.pop(qid, None)
 
         self._install_stream(rows_iter(), accessor, owns)
-        return self._finish_prepare(columns, "rw")
+        return self._finish_prepare(columns, "rw", is_write)
 
     def _profile_rows_iter(self, plan, exec_ctx, columns):
         # drain fully, then emit the profile tree
@@ -949,8 +959,9 @@ class Interpreter:
         self._stream_accessor = accessor
         self._stream_owns_txn = owns_txn
 
-    def _finish_prepare(self, columns, summary_type) -> PreparedQuery:
-        self._prepared = PreparedQuery(columns, 0, summary_type)
+    def _finish_prepare(self, columns, summary_type,
+                        is_write: bool = False) -> PreparedQuery:
+        self._prepared = PreparedQuery(columns, 0, summary_type, is_write)
         return self._prepared
 
     def _finish_stream(self) -> dict:
@@ -976,11 +987,13 @@ class Interpreter:
             elapsed = time.monotonic() - started
             global_metrics.observe("query.execution_latency_sec", elapsed)
             min_ms = self.ctx.config.get("log_min_duration_ms") or 0
-            if min_ms and elapsed * 1000.0 >= min_ms:
+            if min_ms and elapsed * 1000.0 >= min_ms and \
+                    not getattr(self, "_query_priv_auth", False):
                 import logging
                 logging.getLogger(__name__).info(
                     "slow query (%.1f ms): %s", elapsed * 1000.0,
-                    (getattr(self, "_query_text", "") or "").strip())
+                    _redact_literals(
+                        (getattr(self, "_query_text", "") or "").strip()))
         for key, value in summary.get("stats", {}).items():
             if value:
                 global_metrics.increment(f"storage.{key}", value)
@@ -1455,6 +1468,14 @@ class _TxnOwner:
         self._exec_ctx.accessor.periodic_commit()
 
 
+def _redact_literals(text: str) -> str:
+    """Mask quoted string literals before a query reaches logs or the
+    monitoring broadcast — secrets may hide in any literal, not only in
+    AUTH statements (which are skipped entirely)."""
+    import re
+    return re.sub(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"", "'***'", text)
+
+
 def _parse_period(text: str) -> float:
     """'500ms' / '2s' / '5m' / '1h' → seconds."""
     import re
@@ -1518,7 +1539,8 @@ def _plan_privileges(plan) -> set:
                            Op.Expand, Op.ExpandVariable, Op.ExpandShortest,
                            Op.ExpandKShortest)):
             needed.add("MATCH")
-        elif isinstance(op, (Op.CreateNode, Op.CreateExpand)):
+        elif isinstance(op, (Op.CreateNode, Op.CreateExpand,
+                             Op.BatchCreateGraph)):
             needed.add("CREATE")
         elif isinstance(op, Op.Merge):
             needed.update(("MERGE", "MATCH", "CREATE"))

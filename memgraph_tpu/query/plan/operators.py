@@ -17,6 +17,7 @@ from typing import Optional
 from ...exceptions import (HintedAbortError, QueryException, SemanticException,
                            TypeException)
 from ...storage.common import View
+from ...storage.objects import Vertex
 from ...storage.ordering import order_key
 from ...storage.storage import EdgeAccessor, VertexAccessor
 from ..eval import EvalContext, Evaluator
@@ -294,7 +295,13 @@ class Expand(LogicalOperator):
             else:
                 prebound = None
             used = _used_edge_gids(frame, self.prev_edge_symbols)
-            for ea, other in self._edges(ctx, from_v, type_ids):
+            bound_other = None
+            if to_bound:
+                bound_other = frame[self.to_symbol]
+                if not isinstance(bound_other, VertexAccessor):
+                    continue
+            for ea, other in self._edges(ctx, from_v, type_ids,
+                                         bound_other):
                 if not ctx.consume_hop():
                     break
                 if ea.gid in used:
@@ -302,9 +309,7 @@ class Expand(LogicalOperator):
                 if prebound is not None and ea.gid != prebound.gid:
                     continue
                 if to_bound:
-                    bound = frame[self.to_symbol]
-                    if not isinstance(bound, VertexAccessor) or \
-                            bound.gid != other.gid:
+                    if bound_other.gid != other.gid:
                         continue
                     new = dict(frame)
                     new[self.edge_symbol] = ea
@@ -315,13 +320,19 @@ class Expand(LogicalOperator):
                     new[self.to_symbol] = other
                     yield new
 
-    def _edges(self, ctx, from_v, type_ids):
+    def _edges(self, ctx, from_v, type_ids, bound_other=None):
+        # a bound destination is pushed down into the adjacency read: on
+        # supernode hubs the accessor serves it from the per-vertex
+        # adjacency map instead of scanning all O(degree) entries — this is
+        # what takes hub MERGE's existence probe from O(degree) to O(1)
         view = ctx.view
         if self.direction in ("out", "both"):
-            for ea in from_v.out_edges(view, type_ids):
+            for ea in from_v.out_edges(view, type_ids,
+                                       to_vertex=bound_other):
                 yield ea, ea.to_vertex()
         if self.direction in ("in", "both"):
-            for ea in from_v.in_edges(view, type_ids):
+            for ea in from_v.in_edges(view, type_ids,
+                                      from_vertex=bound_other):
                 if self.direction == "both" and \
                         ea.from_vertex().gid == from_v.gid and \
                         ea.to_vertex().gid == from_v.gid:
@@ -1772,6 +1783,546 @@ class LoadParquetOp(LogicalOperator):
                     new = dict(frame)
                     new[self.symbol] = row
                     yield new
+
+
+def _expr_references(expr, names) -> bool:
+    """Does an expression tree mention any Identifier in `names`?"""
+    import dataclasses
+    if isinstance(expr, A.Identifier):
+        return expr.name in names
+    if dataclasses.is_dataclass(expr) and not isinstance(expr, type):
+        return any(_expr_references(getattr(expr, f.name), names)
+                   for f in dataclasses.fields(expr))
+    if isinstance(expr, (list, tuple)):
+        return any(_expr_references(e, names) for e in expr)
+    if isinstance(expr, dict):
+        return any(_expr_references(e, names) for e in expr.values())
+    return False
+
+
+def _compile_value_fn(expr, parameters):
+    """Closure for trivially-evaluable expressions (literal / identifier /
+    parameter / constant list subscript) on the bulk lane's per-row hot
+    path — mirrors the evaluator's semantics for exactly these shapes.
+    None = not compilable, caller keeps the generic evaluator."""
+    if isinstance(expr, A.Literal):
+        value = expr.value
+        return lambda frame: value
+    if isinstance(expr, A.Identifier):
+        name = expr.name
+        return lambda frame: frame.get(name)
+    if isinstance(expr, A.Parameter):
+        if expr.name not in parameters:
+            return None     # let the evaluator raise its own error
+        value = parameters[expr.name]
+        return lambda frame: value
+    if isinstance(expr, A.Subscript) and isinstance(expr.expr, A.Identifier) \
+            and isinstance(expr.index, A.Literal):
+        name = expr.expr.name
+        idx = expr.index.value
+        if isinstance(idx, int) and not isinstance(idx, bool):
+            def list_item(frame):
+                obj = frame.get(name)
+                if obj is None:
+                    return None
+                if isinstance(obj, (list, tuple)):
+                    if idx < -len(obj) or idx >= len(obj):
+                        return None
+                    return obj[idx]
+                if isinstance(obj, dict):
+                    raise TypeException("map key must be a string")
+                raise TypeException("subscript on a non-list value")
+            return list_item
+        # string subscripts can hit maps OR graph entities at runtime —
+        # those keep the generic evaluator
+    if isinstance(expr, A.Binary):
+        op_fn = _COMPILED_BINOPS.get(expr.op)
+        if op_fn is not None:
+            lf = _compile_value_fn(expr.left, parameters)
+            rf = _compile_value_fn(expr.right, parameters)
+            if lf is not None and rf is not None:
+                # delegates to the evaluator's own arithmetic functions,
+                # so null propagation / type rules stay identical
+                return lambda frame: op_fn(lf(frame), rf(frame))
+    return None
+
+
+_COMPILED_BINOPS = {
+    "+": V.cypher_add, "-": V.cypher_sub, "*": V.cypher_mul,
+    "/": V.cypher_div, "%": V.cypher_mod, "^": V.cypher_pow,
+}
+
+
+@dataclass
+class BatchNodeStep:
+    """One per-row vertex creation inside the bulk-write fast lane."""
+    symbol: str
+    labels: list[str]
+    properties: object           # dict[str, Expr] | A.Parameter | None
+
+
+@dataclass
+class BatchEdgeStep:
+    """One per-row edge creation inside the bulk-write fast lane. Endpoints
+    resolve to a same-row BatchNodeStep symbol or a frame-bound vertex."""
+    from_symbol: str
+    edge_symbol: str
+    to_symbol: str
+    direction: str               # 'out' | 'in'
+    edge_type: str
+    edge_properties: object
+
+
+@dataclass
+class BatchCreateGraph(LogicalOperator):
+    """Bulk-write fast lane: executes a root chain of CreateNode /
+    CreateExpand steps over ALL input rows with one storage
+    ``batch_insert()`` call instead of per-row operator pulls — one gid
+    reservation, one undo delta per object, bulk-merged index maintenance,
+    one WAL record, one change-log bump per batch.
+
+    Installed by query/plan/bulk.py only at the root of write-only plans
+    (no downstream consumer exists), so it yields no frames. Engines that
+    don't support batch_insert fall back to equivalent per-row creates.
+
+    When the row source is a pure point-lookup pipeline (UNWIND /
+    equality-index scans over a simple base), bulk.py additionally folds
+    it into `pipeline` and the cursor runs the lookups inline against the
+    label+property index — skipping per-row generator frames, dict copies,
+    and the Eager barrier's bookkeeping (safe: the batch path defers every
+    write until the input is fully consumed anyway).
+    """
+    input: LogicalOperator
+    steps: list                  # BatchNodeStep | BatchEdgeStep, row order
+    pipeline_base: object = None   # base operator of the folded pipeline
+    pipeline: list = None          # [("unwind", expr, sym) |
+    #                                 ("scan", sym, label, props, exprs)]
+
+    def cursor(self, ctx):
+        storage = ctx.storage
+        acc = ctx.accessor
+        if not getattr(storage, "supports_batch_insert", False) \
+                or not hasattr(acc, "batch_insert"):
+            yield from self._row_fallback(ctx)
+            return
+
+        # resolve name->id mappings and compile property maps once per
+        # batch, not once per row
+        name_to_pid = storage.property_mapper.name_to_id
+
+        def compile_props(properties):
+            """[(pid, fn_or_None, expr)] for a static map; None when the
+            map itself is dynamic (a $parameter)."""
+            if properties is None:
+                return ()
+            if isinstance(properties, A.Parameter):
+                return None
+            return [(name_to_pid(k), _compile_value_fn(e, ctx.parameters), e)
+                    for k, e in properties.items()]
+
+        resolved = []
+        for step in self.steps:
+            if isinstance(step, BatchNodeStep):
+                resolved.append((step, tuple(
+                    storage.label_mapper.name_to_id(l)
+                    for l in step.labels),
+                    compile_props(step.properties)))
+            else:
+                resolved.append((step, storage.edge_type_mapper.name_to_id(
+                    step.edge_type),
+                    compile_props(step.edge_properties)))
+        pid_cache: dict[str, int] = {}
+        evaluator = ctx.evaluator
+
+        def prop_ids(compiled, properties, frame) -> dict:
+            out = {}
+            if compiled is None:    # $parameter map: dynamic keys
+                for key, value in _eval_prop_map(ctx, properties,
+                                                 frame).items():
+                    if value is None:
+                        continue
+                    pid = pid_cache.get(key)
+                    if pid is None:
+                        pid = name_to_pid(key)
+                        pid_cache[key] = pid
+                    out[pid] = value
+                return out
+            for pid, fn, expr in compiled:
+                value = fn(frame) if fn is not None \
+                    else evaluator.eval(expr, frame)
+                if value is not None:
+                    out[pid] = value
+            return out
+
+        vertices: list = []
+        edges: list = []
+        counters = [0, 0, 0]     # rows, labels_added, props_set
+        single = len(resolved) == 1
+        first_step, first_ids, first_compiled = resolved[0]
+        single_node = single and isinstance(first_step, BatchNodeStep)
+        single_edge = single and isinstance(first_step, BatchEdgeStep)
+
+        def process_row(frame):
+            counters[0] += 1
+            if not counters[0] % 1024:
+                ctx.check_abort()
+            if single_node:
+                # the dominant UNWIND…CREATE-one-node shape, un-dispatched
+                props = prop_ids(first_compiled, first_step.properties,
+                                 frame)
+                vertices.append((first_ids, props))
+                counters[1] += len(first_ids)
+                counters[2] += len(props)
+                return
+            if single_edge:
+                # the dominant MATCH-endpoints…CREATE-one-edge shape
+                from_ref = frame.get(first_step.from_symbol)
+                if isinstance(from_ref, VertexAccessor):
+                    from_ref = from_ref.vertex
+                elif not isinstance(from_ref, Vertex):
+                    raise QueryException(
+                        "CREATE edge endpoint is not a node")
+                to_ref = frame.get(first_step.to_symbol)
+                if isinstance(to_ref, VertexAccessor):
+                    to_ref = to_ref.vertex
+                elif not isinstance(to_ref, Vertex):
+                    raise QueryException(
+                        "CREATE edge endpoint is not a node")
+                if first_compiled == ():
+                    props = None     # no property map: share the no-op
+                else:
+                    props = prop_ids(first_compiled,
+                                     first_step.edge_properties, frame)
+                    counters[2] += len(props)
+                if first_step.direction == "in":
+                    from_ref, to_ref = to_ref, from_ref
+                edges.append((first_ids, from_ref, to_ref, props))
+                return
+            refs: dict[str, object] = {}
+            for step, ids, compiled in resolved:
+                if isinstance(step, BatchNodeStep):
+                    props = prop_ids(compiled, step.properties, frame)
+                    refs[step.symbol] = len(vertices)
+                    vertices.append((ids, props))
+                    counters[1] += len(ids)
+                    counters[2] += len(props)
+                else:
+                    from_ref = refs.get(step.from_symbol)
+                    if from_ref is None:
+                        from_ref = frame.get(step.from_symbol)
+                        if isinstance(from_ref, VertexAccessor):
+                            from_ref = from_ref.vertex
+                        elif not isinstance(from_ref, Vertex):
+                            raise QueryException(
+                                "CREATE edge endpoint is not a node")
+                    to_ref = refs.get(step.to_symbol)
+                    if to_ref is None:
+                        to_ref = frame.get(step.to_symbol)
+                        if isinstance(to_ref, VertexAccessor):
+                            to_ref = to_ref.vertex
+                        elif not isinstance(to_ref, Vertex):
+                            raise QueryException(
+                                "CREATE edge endpoint is not a node")
+                    props = prop_ids(compiled, step.edge_properties, frame)
+                    counters[2] += len(props)
+                    if step.direction == "in":
+                        from_ref, to_ref = to_ref, from_ref
+                    edges.append((ids, from_ref, to_ref, props))
+
+        self._drive_rows(ctx, process_row)
+        acc.batch_insert(vertices, edges)
+        ctx.stats["nodes_created"] += len(vertices)
+        ctx.stats["relationships_created"] += len(edges)
+        ctx.stats["labels_added"] += counters[1]
+        ctx.stats["properties_set"] += counters[2]
+        return
+        yield  # pragma: no cover — marks cursor() as a generator
+
+    def _drive_rows(self, ctx, process_row):
+        """Feed frames to process_row: the folded point-lookup pipeline
+        when usable, else the generic input subtree (minus a redundant top
+        Eager barrier — the batch path defers every write past input
+        exhaustion, which is exactly the guarantee Eager provides)."""
+        if self.pipeline is not None and ctx.accessor.fine_grained is None:
+            resolved = self._resolve_pipeline(ctx)
+            if resolved == "empty":
+                return
+            if resolved is not None:
+                self._pipeline_run(ctx, resolved, process_row)
+                return
+        source = self.input
+        if isinstance(source, Eager):
+            source = source.input
+        for frame in source.cursor(ctx):
+            process_row(frame)
+
+    def _resolve_pipeline(self, ctx):
+        """Map stage names to ids; None = fall back to the generic source
+        (an equality scan without its composite index), "empty" = an
+        unknown label/property name can match nothing."""
+        storage = ctx.storage
+        out = []
+        for stage in self.pipeline:
+            if stage[0] == "unwind":
+                out.append(stage)
+                continue
+            _tag, sym, label, props, exprs = stage
+            lid = storage.label_mapper.maybe_name_to_id(label)
+            pids = tuple(storage.property_mapper.maybe_name_to_id(p)
+                         for p in props)
+            if lid is None or any(p is None for p in pids):
+                return "empty"
+            slot = storage.indices.label_property._index.get((lid, pids))
+            if slot is None:
+                return None
+            out.append(("scan", sym, lid, pids, exprs, slot["eq"]))
+        return out
+
+    def _steps_reference(self, names) -> bool:
+        """True when any step property expression references one of
+        `names` (then frames must carry full accessors, not raw
+        vertices)."""
+        for step in self.steps:
+            props = step.properties if isinstance(step, BatchNodeStep) \
+                else step.edge_properties
+            if props is None:
+                continue
+            exprs = props.values() if isinstance(props, dict) else [props]
+            for e in exprs:
+                if _expr_references(e, names):
+                    return True
+        return False
+
+    def _pipeline_run(self, ctx, stages, emit):
+        from ...storage.mvcc import state_is_current
+        evaluator = ctx.evaluator
+        view = ctx.view
+        acc = ctx.accessor
+        txn = acc.txn
+        n_stages = len(stages)
+        # bind raw Vertex objects for scan symbols no step expression
+        # reads back — skips one accessor allocation per matched row
+        scan_syms = {s[1] for s in stages if s[0] == "scan"}
+        raw_bind = not self._steps_reference(scan_syms)
+
+        def compiled(exprs):
+            return [(_compile_value_fn(e, ctx.parameters), e)
+                    for e in exprs]
+
+        stages = [
+            ("unwind", compiled([stage[1]])[0], stage[2])
+            if stage[0] == "unwind" else
+            ("scan", stage[1], stage[2], stage[3], compiled(stage[4]),
+             stage[5])
+            for stage in stages]
+
+        def flat_run():
+            """Fully-inlined loop for THE bulk-load shape — one UNWIND
+            followed only by equality scans — avoiding a Python frame per
+            stage per row. Multi-candidate or composite-key rows fall back
+            to the generic expand() from the stage that needs it."""
+            from ...storage.common import (TRANSACTION_ID_START,
+                                           IsolationLevel)
+            _t0, (ufn, uexpr), usym = stages[0]
+            scan_stages = stages[1:]
+            txn_id = txn.id
+            # effective_start_ts is constant during execution under
+            # snapshot isolation (the default) — hoist it; other levels
+            # keep the per-candidate call
+            si_mode = txn.isolation is IsolationLevel.SNAPSHOT_ISOLATION \
+                and view is View.NEW
+            start_ts = txn.effective_start_ts() if si_mode else 0
+            for base_frame in self.pipeline_base.cursor(ctx):
+                frame = base_frame
+                lst = ufn(frame) if ufn is not None \
+                    else evaluator.eval(uexpr, frame)
+                if lst is None:
+                    continue
+                if not isinstance(lst, (list, tuple)):
+                    raise TypeException("UNWIND requires a list")
+                for item in lst:
+                    frame[usym] = item
+                    ok = True
+                    si = 1
+                    for stage in scan_stages:
+                        _t, sym, lid, pids, exprs, eq = stage
+                        if len(exprs) != 1:
+                            ok = None      # composite key: generic path
+                            break
+                        fn, e = exprs[0]
+                        v0 = fn(frame) if fn is not None \
+                            else evaluator.eval(e, frame)
+                        if v0 is None:
+                            ok = False
+                            break
+                        bucket = eq.get((order_key(v0),))
+                        if not bucket:
+                            ok = False
+                            break
+                        if len(bucket) != 1:
+                            ok = None      # cartesian: generic path
+                            break
+                        vertex = bucket[0]
+                        lock = vertex.lock
+                        lock.acquire()
+                        if si_mode:
+                            d = vertex.delta
+                            current = d is None or \
+                                (ts := d.commit_info.timestamp) == txn_id \
+                                or (ts < TRANSACTION_ID_START
+                                    and ts <= start_ts)
+                        else:
+                            current = state_is_current(vertex, txn, view)
+                        if current:
+                            bad = (vertex.deleted
+                                   or lid not in vertex.labels
+                                   or vertex.properties.get(pids[0]) != v0)
+                            lock.release()
+                        else:
+                            lock.release()
+                            st = acc._vertex_state(vertex, view, False)
+                            bad = (not st.exists or st.deleted
+                                   or lid not in st.labels
+                                   or st.properties.get(pids[0]) != v0)
+                        if bad:
+                            ok = False
+                            break
+                        frame[sym] = vertex if raw_bind \
+                            else VertexAccessor(vertex, acc)
+                        si += 1
+                    if ok:
+                        emit(frame)
+                    elif ok is None:
+                        expand(frame, si)
+                frame.pop(usym, None)
+
+        def expand(frame, si):
+            if si == n_stages:
+                emit(frame)
+                return
+            stage = stages[si]
+            if stage[0] == "unwind":
+                _t, (fn, expr), sym = stage
+                value = fn(frame) if fn is not None \
+                    else evaluator.eval(expr, frame)
+                if value is None:
+                    return
+                if not isinstance(value, (list, tuple)):
+                    raise TypeException("UNWIND requires a list")
+                nxt = si + 1
+                for item in value:
+                    frame[sym] = item
+                    expand(frame, nxt)
+                frame.pop(sym, None)
+                return
+            _t, sym, lid, pids, exprs, eq = stage
+            if len(exprs) == 1:
+                fn, e = exprs[0]
+                v0 = fn(frame) if fn is not None \
+                    else evaluator.eval(e, frame)
+                if v0 is None:
+                    return  # = null never matches
+                values = (v0,)
+                candidates = eq.get((order_key(v0),))
+            else:
+                values = [fn(frame) if fn is not None
+                          else evaluator.eval(e, frame) for fn, e in exprs]
+                if None in values:
+                    return
+                candidates = eq.get(tuple(order_key(v) for v in values))
+            if candidates is None:
+                return
+            nxt = si + 1
+            for vertex in candidates:
+                # settled fast check: when the reader's view equals the
+                # live fields, validate against them directly — no
+                # MaterializedState allocation or dict/set copies
+                lock = vertex.lock
+                lock.acquire()
+                if state_is_current(vertex, txn, view):
+                    try:
+                        if vertex.deleted or lid not in vertex.labels:
+                            continue
+                        props = vertex.properties
+                        skip = False
+                        for p, v in zip(pids, values):
+                            if props.get(p) != v:
+                                skip = True
+                                break
+                        if skip:
+                            continue
+                    finally:
+                        lock.release()
+                else:
+                    lock.release()
+                    st = acc._vertex_state(vertex, view, False)
+                    if not st.exists or st.deleted or lid not in st.labels:
+                        continue
+                    props = st.properties
+                    skip = False
+                    for p, v in zip(pids, values):
+                        if props.get(p) != v:
+                            skip = True
+                            break
+                    if skip:
+                        continue
+                frame[sym] = vertex if raw_bind \
+                    else VertexAccessor(vertex, acc)
+                expand(frame, nxt)
+            frame.pop(sym, None)
+
+        if n_stages and stages[0][0] == "unwind" \
+                and all(s[0] == "scan" for s in stages[1:]):
+            flat_run()
+            return
+        for base_frame in self.pipeline_base.cursor(ctx):
+            expand(base_frame, 0)
+
+    def _row_fallback(self, ctx):
+        """Per-row creates with identical semantics, for engines without
+        batch_insert (the on-disk engine)."""
+        storage = ctx.storage
+        for frame in self.input.cursor(ctx):
+            ctx.check_abort()
+            env = dict(frame)
+            for step in self.steps:
+                if isinstance(step, BatchNodeStep):
+                    va = ctx.accessor.create_vertex()
+                    ctx.stats["nodes_created"] += 1
+                    for label in step.labels:
+                        va.add_label(storage.label_mapper.name_to_id(label))
+                        ctx.stats["labels_added"] += 1
+                    for key, value in _eval_prop_map(
+                            ctx, step.properties, frame).items():
+                        if value is not None:
+                            va.set_property(
+                                storage.property_mapper.name_to_id(key),
+                                value)
+                            ctx.stats["properties_set"] += 1
+                    env[step.symbol] = va
+                else:
+                    from_v = env.get(step.from_symbol)
+                    to_v = env.get(step.to_symbol)
+                    if not isinstance(from_v, VertexAccessor) or \
+                            not isinstance(to_v, VertexAccessor):
+                        raise QueryException(
+                            "CREATE edge endpoint is not a node")
+                    tid = storage.edge_type_mapper.name_to_id(step.edge_type)
+                    if step.direction == "in":
+                        ea = ctx.accessor.create_edge(to_v, from_v, tid)
+                    else:
+                        ea = ctx.accessor.create_edge(from_v, to_v, tid)
+                    ctx.stats["relationships_created"] += 1
+                    for key, value in _eval_prop_map(
+                            ctx, step.edge_properties, frame).items():
+                        if value is not None:
+                            ea.set_property(
+                                storage.property_mapper.name_to_id(key),
+                                value)
+                            ctx.stats["properties_set"] += 1
+                    env[step.edge_symbol] = ea
+        return
+        yield  # pragma: no cover
 
 
 @dataclass

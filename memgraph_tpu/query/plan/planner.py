@@ -123,8 +123,9 @@ def _split_and(expr: Optional[A.Expr]) -> list:
 class Planner:
     """Plans one SingleQuery clause chain."""
 
-    def __init__(self, storage) -> None:
+    def __init__(self, storage, config=None) -> None:
         self.storage = storage
+        self.config = config
 
     # --- public -------------------------------------------------------------
 
@@ -157,6 +158,11 @@ class Planner:
                     "You can specify periodic commit only once during "
                     "a query!")
             plan = Op.PeriodicCommit(plan, query.commit_frequency)
+        elif not query.unions and not columns:
+            # bulk-write fast lane: write-only root-level create chains
+            # route through storage.batch_insert (query/plan/bulk.py)
+            from .bulk import bulk_rewrite
+            plan = bulk_rewrite(plan, self.storage, self.config)
         return plan, columns
 
     def plan_single(self, single: A.SingleQuery, leaf=None,

@@ -96,6 +96,7 @@ class KernelServer:
         self._dispatch_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._last_activity = time.monotonic()
+        self._sock_ino = None        # inode of OUR bound socket path
 
     def _warm(self) -> None:
         """Touch the device so the first client request pays no init."""
@@ -105,13 +106,40 @@ class KernelServer:
         float((x @ x).sum())
 
     def serve_forever(self) -> None:
+        import errno
         import threading
+
+        # Spawn-race discipline (ADVICE r5): never unlink-before-bind.
+        # A live responder on the path means another daemon already won —
+        # exit and let clients use it. Only a provably-stale path (connect
+        # refused) is unlinked, and shutdown unlinks only while the inode
+        # still belongs to THIS server, so a losing daemon's exit can
+        # never orphan the winner's socket.
         try:
-            os.unlink(self.socket_path)
+            probe = KernelClient(self.socket_path, timeout=5.0)
+            alive = probe.ping()
+            probe.close()
+            if alive:
+                return           # already running; we lost the race
         except OSError:
-            pass
+            pass                 # nothing listening (or no socket yet)
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        srv.bind(self.socket_path)
+        try:
+            srv.bind(self.socket_path)
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE:
+                raise
+            # path exists but nobody answered the probe: stale socket
+            # from a crashed daemon — reclaim it
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+            srv.bind(self.socket_path)
+        try:
+            self._sock_ino = os.stat(self.socket_path).st_ino
+        except OSError:
+            self._sock_ino = None
         srv.listen(8)
         self._warm()
         self._last_activity = time.monotonic()
@@ -129,7 +157,9 @@ class KernelServer:
                              daemon=True).start()
         srv.close()
         try:
-            os.unlink(self.socket_path)
+            if self._sock_ino is not None and \
+                    os.stat(self.socket_path).st_ino == self._sock_ino:
+                os.unlink(self.socket_path)
         except OSError:
             pass
 
@@ -267,8 +297,9 @@ def ensure_server(socket_path: str = DEFAULT_SOCKET,
     deadline = time.monotonic() + spawn_timeout_s
     while time.monotonic() < deadline:
         # keep polling the socket even if OUR child died: in a spawn
-        # race the loser exits on the unix-socket bind conflict while
-        # the winner is still importing jax — its server arrives soon
+        # race the loser exits after probing a live responder (or on the
+        # bind conflict) while the winner is still importing jax — its
+        # server arrives soon
         try:
             c = KernelClient(socket_path, timeout=spawn_timeout_s)
             if c.ping():

@@ -89,7 +89,12 @@ class MPReadExecutor:
 
     def _worker_loop(self, req_fd: int, resp_fd: int) -> None:
         from ..query import Interpreter
+        from ..query.frontend import ast as A
         interp = Interpreter(self._ictx)
+        refusal = ("QueryException",
+                   "only read-only Cypher queries may run on the "
+                   "multiprocess read executor (writes against the forked "
+                   "snapshot would be silently lost)")
         while True:
             try:
                 msg = _recv(req_fd)
@@ -99,8 +104,21 @@ class MPReadExecutor:
                 return
             query, params = msg
             try:
-                cols, rows, _summary = interp.execute(query, params)
-                _send(resp_fd, ("ok", cols, rows))
+                # enforce the read-only contract BEFORE prepare: non-Cypher
+                # statements (auth/DDL/admin) can mutate state at prepare
+                # time, and a misrouted write would vanish into this
+                # worker's copy-on-write snapshot
+                node = interp.ctx.cached_parse(query)
+                if not isinstance(node, A.CypherQuery):
+                    _send(resp_fd, ("err", *refusal))
+                    continue
+                prepared = interp.prepare(query, params)
+                if prepared.is_write:
+                    interp.abort()
+                    _send(resp_fd, ("err", *refusal))
+                    continue
+                rows, _more, _summary = interp.pull(-1)
+                _send(resp_fd, ("ok", prepared.columns, rows))
             except Exception as e:  # noqa: BLE001 — ship the error back
                 _send(resp_fd, ("err", type(e).__name__, str(e)))
 

@@ -51,90 +51,240 @@ class Structure:
 
 
 def pack(value, buf: BytesIO | None = None) -> bytes:
-    out = buf or BytesIO()
+    out = bytearray()
     _pack(value, out)
-    return out.getvalue() if buf is None else b""
+    if buf is not None:
+        buf.write(bytes(out))
+        return b""
+    return bytes(out)
 
 
-def _pack(v, out: BytesIO) -> None:
+_pack_to = struct.pack
+
+
+def _pack(v, out: bytearray) -> None:
+    # bytearray appends, not BytesIO writes: bulk UNWIND parameters are
+    # one huge nested list and the encoder runs per element
     if v is None:
-        out.write(b"\xC0")
+        out.append(0xC0)
     elif v is True:
-        out.write(b"\xC3")
+        out.append(0xC3)
     elif v is False:
-        out.write(b"\xC2")
+        out.append(0xC2)
     elif isinstance(v, int):
-        _pack_int(v, out)
+        if -0x10 <= v < 0x80:
+            out.append(v & 0xFF)
+        elif -0x80 <= v < 0x80:
+            out.append(0xC8)
+            out.append(v & 0xFF)
+        elif -0x8000 <= v < 0x8000:
+            out.append(0xC9)
+            out += v.to_bytes(2, "big", signed=True)
+        elif -0x80000000 <= v < 0x80000000:
+            out.append(0xCA)
+            out += v.to_bytes(4, "big", signed=True)
+        elif -0x8000000000000000 <= v < 0x8000000000000000:
+            out.append(0xCB)
+            out += v.to_bytes(8, "big", signed=True)
+        else:
+            raise PackStreamError(f"integer out of 64-bit range: {v}")
     elif isinstance(v, float):
-        out.write(b"\xC1" + struct.pack(">d", v))
+        out.append(0xC1)
+        out += _pack_to(">d", v)
     elif isinstance(v, str):
         raw = v.encode("utf-8")
         n = len(raw)
         if n < 0x10:
-            out.write(bytes((0x80 | n,)))
+            out.append(0x80 | n)
         elif n < 0x100:
-            out.write(b"\xD0" + bytes((n,)))
+            out.append(0xD0)
+            out.append(n)
         elif n < 0x10000:
-            out.write(b"\xD1" + struct.pack(">H", n))
+            out.append(0xD1)
+            out += _pack_to(">H", n)
         else:
-            out.write(b"\xD2" + struct.pack(">I", n))
-        out.write(raw)
+            out.append(0xD2)
+            out += _pack_to(">I", n)
+        out += raw
     elif isinstance(v, bytes):
         n = len(v)
         if n < 0x100:
-            out.write(b"\xCC" + bytes((n,)))
+            out.append(0xCC)
+            out.append(n)
         elif n < 0x10000:
-            out.write(b"\xCD" + struct.pack(">H", n))
+            out.append(0xCD)
+            out += _pack_to(">H", n)
         else:
-            out.write(b"\xCE" + struct.pack(">I", n))
-        out.write(v)
+            out.append(0xCE)
+            out += _pack_to(">I", n)
+        out += v
     elif isinstance(v, (list, tuple)):
         n = len(v)
         if n < 0x10:
-            out.write(bytes((0x90 | n,)))
+            out.append(0x90 | n)
         elif n < 0x100:
-            out.write(b"\xD4" + bytes((n,)))
+            out.append(0xD4)
+            out.append(n)
         elif n < 0x10000:
-            out.write(b"\xD5" + struct.pack(">H", n))
+            out.append(0xD5)
+            out += _pack_to(">H", n)
         else:
-            out.write(b"\xD6" + struct.pack(">I", n))
+            out.append(0xD6)
+            out += _pack_to(">I", n)
         for item in v:
             _pack(item, out)
     elif isinstance(v, dict):
         n = len(v)
         if n < 0x10:
-            out.write(bytes((0xA0 | n,)))
+            out.append(0xA0 | n)
         elif n < 0x100:
-            out.write(b"\xD8" + bytes((n,)))
+            out.append(0xD8)
+            out.append(n)
         elif n < 0x10000:
-            out.write(b"\xD9" + struct.pack(">H", n))
+            out.append(0xD9)
+            out += _pack_to(">H", n)
         else:
-            out.write(b"\xDA" + struct.pack(">I", n))
+            out.append(0xDA)
+            out += _pack_to(">I", n)
         for key, val in v.items():
             _pack(str(key), out)
             _pack(val, out)
     elif isinstance(v, Structure):
-        n = len(v.fields)
-        out.write(bytes((0xB0 | n, v.tag)))
+        out.append(0xB0 | len(v.fields))
+        out.append(v.tag)
         for f in v.fields:
             _pack(f, out)
     else:
         raise PackStreamError(f"cannot pack {type(v)!r}")
 
 
-def _pack_int(v: int, out: BytesIO) -> None:
-    if -0x10 <= v < 0x80:
-        out.write(struct.pack(">b", v))
-    elif -0x80 <= v < 0x80:
-        out.write(b"\xC8" + struct.pack(">b", v))
-    elif -0x8000 <= v < 0x8000:
-        out.write(b"\xC9" + struct.pack(">h", v))
-    elif -0x80000000 <= v < 0x80000000:
-        out.write(b"\xCA" + struct.pack(">i", v))
-    elif -0x8000000000000000 <= v < 0x8000000000000000:
-        out.write(b"\xCB" + struct.pack(">q", v))
-    else:
-        raise PackStreamError(f"integer out of 64-bit range: {v}")
+def _pack_int(v: int, out) -> None:
+    """Kept for callers that encode bare ints; bytearray-based."""
+    if isinstance(out, BytesIO):
+        tmp = bytearray()
+        _pack(v, tmp)
+        out.write(bytes(tmp))
+        return
+    _pack(v, out)
+
+
+_unpack_from = struct.unpack_from
+
+
+def _unpack_at(data: bytes, pos: int):
+    """Decode one value at `pos`; returns (value, next_pos). Flat function
+    with direct byte indexing — the per-element method-call + slice +
+    bounds-check of the old class decoder dominated bulk-parameter
+    ingestion (10k-row UNWIND batches are one big nested list)."""
+    marker = data[pos]
+    pos += 1
+    if marker < 0x80:
+        return marker, pos
+    if marker >= 0xF0:
+        return marker - 0x100, pos
+    if marker < 0x90:
+        n = marker & 0x0F
+        if pos + n > len(data):
+            raise PackStreamError("unexpected end of data")
+        return data[pos:pos + n].decode("utf-8"), pos + n
+    if marker < 0xA0:
+        out = []
+        append = out.append
+        for _ in range(marker & 0x0F):
+            v, pos = _unpack_at(data, pos)
+            append(v)
+        return out, pos
+    if marker < 0xB0:
+        out = {}
+        for _ in range(marker & 0x0F):
+            k, pos = _unpack_at(data, pos)
+            v, pos = _unpack_at(data, pos)
+            out[k] = v
+        return out, pos
+    if marker < 0xC0:
+        n = marker & 0x0F
+        tag = data[pos]
+        pos += 1
+        fields = []
+        for _ in range(n):
+            v, pos = _unpack_at(data, pos)
+            fields.append(v)
+        return Structure(tag, fields), pos
+    if marker == 0xC0:
+        return None, pos
+    if marker == 0xC1:
+        return _unpack_from(">d", data, pos)[0], pos + 8
+    if marker == 0xC2:
+        return False, pos
+    if marker == 0xC3:
+        return True, pos
+    if marker == 0xC8:
+        return _unpack_from(">b", data, pos)[0], pos + 1
+    if marker == 0xC9:
+        return _unpack_from(">h", data, pos)[0], pos + 2
+    if marker == 0xCA:
+        return _unpack_from(">i", data, pos)[0], pos + 4
+    if marker == 0xCB:
+        return _unpack_from(">q", data, pos)[0], pos + 8
+    if marker in (0xCC, 0xCD, 0xCE):
+        if marker == 0xCC:
+            n = data[pos]
+            pos += 1
+        elif marker == 0xCD:
+            n = _unpack_from(">H", data, pos)[0]
+            pos += 2
+        else:
+            n = _unpack_from(">I", data, pos)[0]
+            pos += 4
+        if pos + n > len(data):
+            raise PackStreamError("unexpected end of data")
+        return data[pos:pos + n], pos + n
+    if marker in (0xD0, 0xD1, 0xD2):
+        if marker == 0xD0:
+            n = data[pos]
+            pos += 1
+        elif marker == 0xD1:
+            n = _unpack_from(">H", data, pos)[0]
+            pos += 2
+        else:
+            n = _unpack_from(">I", data, pos)[0]
+            pos += 4
+        if pos + n > len(data):
+            raise PackStreamError("unexpected end of data")
+        return data[pos:pos + n].decode("utf-8"), pos + n
+    if marker in (0xD4, 0xD5, 0xD6):
+        if marker == 0xD4:
+            n = data[pos]
+            pos += 1
+        elif marker == 0xD5:
+            n = _unpack_from(">H", data, pos)[0]
+            pos += 2
+        else:
+            n = _unpack_from(">I", data, pos)[0]
+            pos += 4
+        out = []
+        append = out.append
+        for _ in range(n):
+            v, pos = _unpack_at(data, pos)
+            append(v)
+        return out, pos
+    if marker in (0xD8, 0xD9, 0xDA):
+        if marker == 0xD8:
+            n = data[pos]
+            pos += 1
+        elif marker == 0xD9:
+            n = _unpack_from(">H", data, pos)[0]
+            pos += 2
+        else:
+            n = _unpack_from(">I", data, pos)[0]
+            pos += 4
+        out = {}
+        for _ in range(n):
+            k, pos = _unpack_at(data, pos)
+            v, pos = _unpack_at(data, pos)
+            out[k] = v
+        return out, pos
+    raise PackStreamError(f"unknown marker 0x{marker:02X}")
 
 
 class Unpacker:
@@ -142,78 +292,12 @@ class Unpacker:
         self.data = data
         self.pos = 0
 
-    def _read(self, n: int) -> bytes:
-        if self.pos + n > len(self.data):
-            raise PackStreamError("unexpected end of data")
-        out = self.data[self.pos:self.pos + n]
-        self.pos += n
-        return out
-
     def unpack(self):
-        marker = self._read(1)[0]
-        if marker < 0x80:
-            return marker
-        if marker >= 0xF0:
-            return marker - 0x100
-        if 0x80 <= marker < 0x90:
-            return self._read(marker & 0x0F).decode("utf-8")
-        if 0x90 <= marker < 0xA0:
-            return [self.unpack() for _ in range(marker & 0x0F)]
-        if 0xA0 <= marker < 0xB0:
-            return {self.unpack(): self.unpack()
-                    for _ in range(marker & 0x0F)}
-        if 0xB0 <= marker < 0xC0:
-            n = marker & 0x0F
-            tag = self._read(1)[0]
-            return Structure(tag, [self.unpack() for _ in range(n)])
-        if marker == 0xC0:
-            return None
-        if marker == 0xC1:
-            return struct.unpack(">d", self._read(8))[0]
-        if marker == 0xC2:
-            return False
-        if marker == 0xC3:
-            return True
-        if marker == 0xC8:
-            return struct.unpack(">b", self._read(1))[0]
-        if marker == 0xC9:
-            return struct.unpack(">h", self._read(2))[0]
-        if marker == 0xCA:
-            return struct.unpack(">i", self._read(4))[0]
-        if marker == 0xCB:
-            return struct.unpack(">q", self._read(8))[0]
-        if marker == 0xCC:
-            return self._read(self._read(1)[0])
-        if marker == 0xCD:
-            return self._read(struct.unpack(">H", self._read(2))[0])
-        if marker == 0xCE:
-            return self._read(struct.unpack(">I", self._read(4))[0])
-        if marker == 0xD0:
-            return self._read(self._read(1)[0]).decode("utf-8")
-        if marker == 0xD1:
-            return self._read(struct.unpack(">H", self._read(2))[0]) \
-                .decode("utf-8")
-        if marker == 0xD2:
-            return self._read(struct.unpack(">I", self._read(4))[0]) \
-                .decode("utf-8")
-        if marker == 0xD4:
-            return [self.unpack() for _ in range(self._read(1)[0])]
-        if marker == 0xD5:
-            return [self.unpack()
-                    for _ in range(struct.unpack(">H", self._read(2))[0])]
-        if marker == 0xD6:
-            return [self.unpack()
-                    for _ in range(struct.unpack(">I", self._read(4))[0])]
-        if marker == 0xD8:
-            return {self.unpack(): self.unpack()
-                    for _ in range(self._read(1)[0])}
-        if marker == 0xD9:
-            return {self.unpack(): self.unpack()
-                    for _ in range(struct.unpack(">H", self._read(2))[0])}
-        if marker == 0xDA:
-            return {self.unpack(): self.unpack()
-                    for _ in range(struct.unpack(">I", self._read(4))[0])}
-        raise PackStreamError(f"unknown marker 0x{marker:02X}")
+        try:
+            value, self.pos = _unpack_at(self.data, self.pos)
+        except (IndexError, struct.error) as e:
+            raise PackStreamError("unexpected end of data") from e
+        return value
 
 
 def unpack(data: bytes):

@@ -36,6 +36,21 @@ class DeltaAction(enum.Enum):
     ADD_OUT_EDGE = 7       # undo of remove_out_edge
     REMOVE_IN_EDGE = 8     # undo of add_in_edge
     REMOVE_OUT_EDGE = 9    # undo of add_out_edge
+    # batch-insert amortization: ONE undo for all adjacency entries a bulk
+    # insert appended to a pre-existing vertex (payload: tuple of entries).
+    # Keeps hub vertices from growing one delta per spoke during bulk loads.
+    REMOVE_IN_EDGES_BULK = 10
+    REMOVE_OUT_EDGES_BULK = 11
+
+
+# actions that only affect the adjacency lists of a materialized state —
+# readers that need labels/properties/existence only can skip both copying
+# the (possibly huge) adjacency lists and applying these undos
+EDGE_ACTIONS = frozenset({
+    DeltaAction.ADD_IN_EDGE, DeltaAction.ADD_OUT_EDGE,
+    DeltaAction.REMOVE_IN_EDGE, DeltaAction.REMOVE_OUT_EDGE,
+    DeltaAction.REMOVE_IN_EDGES_BULK, DeltaAction.REMOVE_OUT_EDGES_BULK,
+})
 
 
 class Delta:
@@ -89,6 +104,12 @@ def apply_undo(state: "MaterializedState", delta: Delta) -> None:
         state.out_edges.append(delta.payload)
     elif a is DeltaAction.REMOVE_OUT_EDGE:
         state.out_edges.remove(delta.payload)
+    elif a is DeltaAction.REMOVE_IN_EDGES_BULK:
+        drop = set(delta.payload)
+        state.in_edges = [e for e in state.in_edges if e not in drop]
+    elif a is DeltaAction.REMOVE_OUT_EDGES_BULK:
+        drop = set(delta.payload)
+        state.out_edges = [e for e in state.out_edges if e not in drop]
     else:  # pragma: no cover
         raise AssertionError(f"unknown delta action {a}")
 

@@ -161,11 +161,16 @@ class DiskAccessor(Accessor):
         c = self.storage._edges.get(edge.gid)
         return self.storage._hydrated(c) if c is not None else edge
 
-    def _vertex_state(self, vertex, view):
-        return super()._vertex_state(self._canon_v(vertex), view)
+    def _vertex_state(self, vertex, view, need_edges=True):
+        return super()._vertex_state(self._canon_v(vertex), view, need_edges)
 
     def _edge_state(self, edge, view):
         return super()._edge_state(self._canon_e(edge), view)
+
+    def _neighbor_entries(self, vertex, side, other_gid, view):
+        # adjacency triples may reference evicted (dehydrated) objects —
+        # the supernode fast path is an in-memory-engine optimization
+        return None
 
     def _vertex_add_label(self, vertex, label_id):
         return super()._vertex_add_label(self._canon_v(vertex), label_id)
@@ -203,6 +208,11 @@ class DiskAccessor(Accessor):
 
 class DiskStorage(InMemoryStorage):
     """The ON_DISK_TRANSACTIONAL engine."""
+
+    # per-commit sqlite persistence walks touched objects row-by-row; the
+    # bulk lane's batch bookkeeping doesn't reach _persist_commit, so keep
+    # the planner on the per-row operators for this engine
+    supports_batch_insert = False
 
     def __init__(self, config: Optional[StorageConfig] = None) -> None:
         config = config or StorageConfig()

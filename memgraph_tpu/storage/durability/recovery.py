@@ -184,17 +184,79 @@ def _apply_snapshot(storage, data: dict) -> None:
         storage.create_type_constraint(lid, pid, tname)
 
 
+def _apply_batch_vertices(storage, vertices, changed) -> None:
+    """Replay the vertex half of a BATCH_INSERT record with the same
+    amortization as the live path: objects rebuilt row-by-row, indexes
+    updated with one bulk merge per index."""
+    from ..objects import Vertex
+    fresh = []
+    for (gid, labels, props) in vertices:
+        changed.add(gid)
+        v = storage._vertices.get(gid)
+        if v is None:
+            v = Vertex(gid)
+            storage._vertices[gid] = v
+            storage._next_vertex_gid = max(storage._next_vertex_gid, gid + 1)
+        v.labels = set(labels)
+        v.properties = dict(props)
+        fresh.append(v)
+    per_label: dict = {}
+    for v in fresh:
+        for lid in v.labels:
+            per_label.setdefault(lid, []).append(v)
+    for lid, group in per_label.items():
+        storage.indices.label.bulk_add(lid, group)
+    storage.indices.label_property.bulk_add(fresh)
+
+
+def _apply_batch_edges(storage, edges, changed) -> None:
+    from ..objects import Edge, adj_map_add
+    fresh = []
+    for (gid, etype, from_gid, to_gid, props) in edges:
+        changed.add(from_gid)
+        changed.add(to_gid)
+        if gid in storage._edges:
+            storage._edges[gid].properties = dict(props)
+            continue
+        from_v = storage._vertices.get(from_gid)
+        to_v = storage._vertices.get(to_gid)
+        if from_v is None or to_v is None:
+            raise DurabilityError(
+                f"batch edge {gid} references missing vertex")
+        e = Edge(gid, etype, from_v, to_v)
+        e.properties = dict(props)
+        out_entry = (etype, to_v, e)
+        in_entry = (etype, from_v, e)
+        from_v.out_edges.append(out_entry)
+        adj_map_add(from_v, "out", out_entry)
+        to_v.in_edges.append(in_entry)
+        adj_map_add(to_v, "in", in_entry)
+        storage._edges[gid] = e
+        storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+        fresh.append(e)
+    storage.indices.edge_type.bulk_add(fresh)
+
+
 def _apply_wal_txn(storage, ops):
     """Replay one committed transaction's forward records (idempotent).
+
+    BATCH_INSERT vertices apply in frame order, but BATCH_INSERT edges are
+    deferred to the end of the transaction so they may reference vertices
+    created by per-row records appearing later in the same transaction.
 
     Returns the set of vertex gids whose state changed (for the
     topology change log: replica WAL apply must feed version-keyed
     delta caches exactly like local commits do)."""
     from ..objects import Edge, Vertex
     changed: set = set()
+    batches = []   # decoded BATCH_INSERT payloads, replayed across passes
     for kind, payload in ops:
         buf = BytesIO(payload)
-        if kind == W.OP_MAPPER_SYNC:
+        if kind == W.OP_BATCH_INSERT:
+            vertices, edges = W.decode_batch_insert(buf)
+            _apply_batch_vertices(storage, vertices, changed)
+            batches.append(edges)
+        elif kind == W.OP_MAPPER_SYNC:
             tables = []
             for _ in range(3):
                 n = _read_varint(buf)
@@ -252,8 +314,13 @@ def _apply_wal_txn(storage, ops):
                     f"WAL edge {gid} references missing vertex")
             e = Edge(gid, etype, from_v, to_v)
             e.properties = props
-            from_v.out_edges.append((etype, to_v, e))
-            to_v.in_edges.append((etype, from_v, e))
+            from ..objects import adj_map_add
+            out_entry = (etype, to_v, e)
+            in_entry = (etype, from_v, e)
+            from_v.out_edges.append(out_entry)
+            adj_map_add(from_v, "out", out_entry)
+            to_v.in_edges.append(in_entry)
+            adj_map_add(to_v, "in", in_entry)
             storage._edges[gid] = e
             storage.indices.edge_type.add(e)
             storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
@@ -270,21 +337,26 @@ def _apply_wal_txn(storage, ops):
             gid = _read_varint(buf)
             e = storage._edges.pop(gid, None)
             if e is not None:
+                from ..objects import adj_map_remove
                 entry_out = (e.edge_type, e.to_vertex, e)
                 entry_in = (e.edge_type, e.from_vertex, e)
                 try:
                     e.from_vertex.out_edges.remove(entry_out)
                 except ValueError:
                     pass
+                adj_map_remove(e.from_vertex, "out", entry_out)
                 try:
                     e.to_vertex.in_edges.remove(entry_in)
                 except ValueError:
                     pass
+                adj_map_remove(e.to_vertex, "in", entry_in)
                 storage.indices.edge_type.remove_entry(e)
                 changed.add(e.from_vertex.gid)
                 changed.add(e.to_vertex.gid)
         else:
             raise DurabilityError(f"unknown WAL op 0x{kind:02x}")
+    for edges in batches:
+        _apply_batch_edges(storage, edges, changed)
     return changed
 
 

@@ -33,6 +33,114 @@ OP_CREATE_EDGE = 0x20       # gid, type, from, to, props
 OP_EDGE_STATE = 0x21        # gid, props
 OP_DELETE_EDGE = 0x22       # gid
 OP_MAPPER_SYNC = 0x30       # label/property/edge-type name tables
+OP_BATCH_INSERT = 0x40      # one bulk-insert batch, columnar layout
+
+
+def _encode_batch_insert(batch, deleted_v, deleted_e) -> bytes:
+    """Columnar payload for one batch_insert() call: delta-encoded gid
+    ranges, a label-set dictionary, and per-property value columns with
+    presence bitmaps — one record per batch instead of one per object.
+    Objects that also died inside the transaction are filtered out (they
+    never become durable), matching the per-object encoder's rule."""
+    vertices = [v for v in batch.vertices if v not in deleted_v]
+    edges = [e for e in batch.edges
+             if e not in deleted_e and e.from_vertex not in deleted_v
+             and e.to_vertex not in deleted_v]
+    p = BytesIO()
+
+    def gid_column(objs) -> None:
+        prev = 0
+        for i, o in enumerate(objs):
+            _write_varint(p, o.gid if i == 0 else o.gid - prev)
+            prev = o.gid
+
+    def prop_columns(objs) -> None:
+        cols: dict[int, list] = {}
+        for i, o in enumerate(objs):
+            for pid, value in o.properties.items():
+                cols.setdefault(pid, []).append((i, value))
+        _write_varint(p, len(cols))
+        n = len(objs)
+        for pid in sorted(cols):
+            _write_varint(p, pid)
+            present = bytearray((n + 7) // 8)
+            for i, _v in cols[pid]:
+                present[i >> 3] |= 1 << (i & 7)
+            p.write(bytes(present))
+            for _i, value in cols[pid]:
+                encode_value(p, value)
+
+    _write_varint(p, len(vertices))
+    gid_column(vertices)
+    # label-set dictionary: bulk rows overwhelmingly share one label set
+    label_sets: dict[tuple, int] = {}
+    set_idx = []
+    for v in vertices:
+        key = tuple(sorted(v.labels))
+        idx = label_sets.setdefault(key, len(label_sets))
+        set_idx.append(idx)
+    _write_varint(p, len(label_sets))
+    for key in label_sets:
+        _write_varint(p, len(key))
+        for lid in key:
+            _write_varint(p, lid)
+    for idx in set_idx:
+        _write_varint(p, idx)
+    prop_columns(vertices)
+
+    _write_varint(p, len(edges))
+    gid_column(edges)
+    for e in edges:
+        _write_varint(p, e.edge_type)
+    for e in edges:
+        _write_varint(p, e.from_vertex.gid)
+    for e in edges:
+        _write_varint(p, e.to_vertex.gid)
+    prop_columns(edges)
+    return p.getvalue()
+
+
+def decode_batch_insert(buf: BytesIO):
+    """Decode one OP_BATCH_INSERT payload into
+    (vertices: [(gid, labels, props)], edges: [(gid, etype, from, to, props)]).
+    """
+    def gid_column(n) -> list[int]:
+        gids = []
+        prev = 0
+        for i in range(n):
+            d = _read_varint(buf)
+            prev = d if i == 0 else prev + d
+            gids.append(prev)
+        return gids
+
+    def prop_columns(n) -> list[dict]:
+        props: list[dict] = [{} for _ in range(n)]
+        for _ in range(_read_varint(buf)):
+            pid = _read_varint(buf)
+            present = buf.read((n + 7) // 8)
+            rows = [i for i in range(n) if present[i >> 3] & (1 << (i & 7))]
+            for i in rows:
+                props[i][pid] = decode_value(buf)
+        return props
+
+    n_v = _read_varint(buf)
+    v_gids = gid_column(n_v)
+    label_sets = []
+    for _ in range(_read_varint(buf)):
+        label_sets.append([_read_varint(buf)
+                           for _ in range(_read_varint(buf))])
+    v_labels = [label_sets[_read_varint(buf)] for _ in range(n_v)]
+    v_props = prop_columns(n_v)
+    vertices = list(zip(v_gids, v_labels, v_props))
+
+    n_e = _read_varint(buf)
+    e_gids = gid_column(n_e)
+    e_types = [_read_varint(buf) for _ in range(n_e)]
+    e_from = [_read_varint(buf) for _ in range(n_e)]
+    e_to = [_read_varint(buf) for _ in range(n_e)]
+    e_props = prop_columns(n_e)
+    edges = list(zip(e_gids, e_types, e_from, e_to, e_props))
+    return vertices, edges
 
 
 def encode_txn_ops(storage, txn, commit_ts: int) -> bytes:
@@ -90,7 +198,20 @@ def encode_txn_ops(storage, txn, commit_ts: int) -> bytes:
             encode_value(p, v.properties[pid])
         return p.getvalue()
 
+    # bulk-insert batches: one columnar BATCH_INSERT record per batch;
+    # their objects are then excluded from the per-object loops below
+    # (final state read here, under the engine lock, so later in-txn
+    # mutations of batch-created objects are captured by the record)
+    batch_objs: set = set()
+    for batch in (getattr(txn, "batches", None) or ()):
+        frame(OP_BATCH_INSERT,
+              _encode_batch_insert(batch, deleted_v, deleted_e))
+        batch_objs.update(batch.vertices)
+        batch_objs.update(batch.edges)
+
     for v in txn.touched_vertices.values():
+        if v in batch_objs:
+            continue  # carried by a BATCH_INSERT record
         if v in created_v and v in deleted_v:
             continue  # created and deleted within the txn
         if v in deleted_v:
@@ -103,6 +224,8 @@ def encode_txn_ops(storage, txn, commit_ts: int) -> bytes:
             frame(OP_VERTEX_STATE, vertex_state_payload(v))
 
     for e in txn.touched_edges.values():
+        if e in batch_objs:
+            continue  # carried by a BATCH_INSERT record
         if e in created_e and e in deleted_e:
             continue
         if e in deleted_e:

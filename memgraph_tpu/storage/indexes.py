@@ -106,6 +106,13 @@ class LabelIndex:
         if bucket is not None:
             bucket[vertex.gid] = vertex
 
+    def bulk_add(self, label_id: int, vertices) -> None:
+        """Deferred batch maintenance: one dict update for a whole batch
+        instead of per-row add() calls."""
+        bucket = self._index.get(label_id)
+        if bucket is not None:
+            bucket.update((v.gid, v) for v in vertices)
+
     def candidates(self, label_id: int):
         bucket = self._index.get(label_id)
         if bucket is None or not self.ready(label_id):
@@ -153,7 +160,8 @@ class LabelPropertyIndex:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         # key -> {"sorted": list[(key_tuple, gid, vertex, values)],
-        #         "by_gid": dict[gid, set[key_tuple]]}
+        #         "by_gid": dict[gid, set[key_tuple]],
+        #         "eq": dict[key_tuple, list[vertex]]}   (point lookups)
         self._index: dict[tuple[int, tuple[int, ...]], dict] = {}
 
     @staticmethod
@@ -163,7 +171,8 @@ class LabelPropertyIndex:
     def create(self, label_id: int, prop_ids: tuple[int, ...], vertices) -> None:
         with self._lock:
             slot = self._index.setdefault((label_id, prop_ids),
-                                          {"sorted": [], "by_gid": {}})
+                                          {"sorted": [], "by_gid": {},
+                                           "eq": {}})
         for v in vertices:
             self.maybe_add(label_id, prop_ids, v)
         # created concurrently with writes in principle; final sort for safety
@@ -206,19 +215,87 @@ class LabelPropertyIndex:
             keys.add(key)
             bisect.insort(slot["sorted"], (key, vertex.gid, vertex, tuple(values)),
                           key=lambda e: (e[0], e[1]))
+            slot["eq"].setdefault(key, []).append(vertex)
 
     def update_on_change(self, vertex) -> None:
         """Add entries for the vertex's current state (add-only, see class doc)."""
         for (label_id, prop_ids) in list(self._index):
             self.maybe_add(label_id, prop_ids, vertex)
 
+    def bulk_add(self, vertices) -> None:
+        """Deferred batch maintenance: per index, collect every qualifying
+        entry for the batch, sort ONCE, and splice into the sorted entry
+        list with a single linear merge — replacing one O(log n) bisect +
+        O(n) insort memmove per row with O((n+m)) per batch."""
+        for (label_id, prop_ids), slot in list(self._index.items()):
+            fresh = []
+            for v in vertices:
+                if label_id not in v.labels or v.deleted:
+                    continue
+                values = []
+                for pid in prop_ids:
+                    if pid not in v.properties:
+                        values = None
+                        break
+                    values.append(v.properties[pid])
+                if values is None:
+                    continue
+                fresh.append((self._entry_key(values), v.gid, v,
+                              tuple(values)))
+            if not fresh:
+                continue
+            fresh.sort(key=lambda e: (e[0], e[1]))
+            with self._lock:
+                by_gid = slot["by_gid"]
+                deduped = []
+                for entry in fresh:
+                    keys = by_gid.setdefault(entry[1], set())
+                    if entry[0] in keys:
+                        continue
+                    keys.add(entry[0])
+                    deduped.append(entry)
+                if not deduped:
+                    continue
+                eq = slot["eq"]
+                for entry in deduped:
+                    eq.setdefault(entry[0], []).append(entry[2])
+                old = slot["sorted"]
+                if old and (old[-1][0], old[-1][1]) <= \
+                        (deduped[0][0], deduped[0][1]):
+                    # common bulk-load case: fresh keys all sort after the
+                    # existing tail (monotonic ids) — plain extend
+                    old.extend(deduped)
+                else:
+                    merged = []
+                    i = j = 0
+                    while i < len(old) and j < len(deduped):
+                        if (old[i][0], old[i][1]) <= \
+                                (deduped[j][0], deduped[j][1]):
+                            merged.append(old[i])
+                            i += 1
+                        else:
+                            merged.append(deduped[j])
+                            j += 1
+                    merged.extend(old[i:])
+                    merged.extend(deduped[j:])
+                    slot["sorted"] = merged
+
     def remove_entry(self, vertex) -> None:
         """Drop every entry for a dead (GC'd) vertex."""
         with self._lock:
             for slot in self._index.values():
-                if slot["by_gid"].pop(vertex.gid, None) is not None:
+                keys = slot["by_gid"].pop(vertex.gid, None)
+                if keys is not None:
                     slot["sorted"] = [e for e in slot["sorted"]
                                       if e[1] != vertex.gid]
+                    eq = slot["eq"]
+                    for key in keys:
+                        bucket = eq.get(key)
+                        if bucket is not None:
+                            bucket[:] = [v for v in bucket
+                                         if v.gid != vertex.gid]
+                            if not bucket:
+                                del eq[key]
 
     def sweep(self) -> int:
         """Drop stale entries for settled vertices (delta chain fully GC'd).
@@ -246,8 +323,12 @@ class LabelPropertyIndex:
                             continue
                     keep.append(entry)
                     by_gid.setdefault(gid, set()).add(key)
+                eq: dict = {}
+                for key, _gid, vertex, _values in keep:
+                    eq.setdefault(key, []).append(vertex)
                 slot["sorted"] = keep
                 slot["by_gid"] = by_gid
+                slot["eq"] = eq
         return removed
 
     # --- scans --------------------------------------------------------------
@@ -256,17 +337,8 @@ class LabelPropertyIndex:
         slot = self._index.get((label_id, prop_ids))
         if slot is None:
             return None
-        key = self._entry_key(values)
-        entries = slot["sorted"]
-        lo = bisect.bisect_left(entries, (key,), key=lambda e: (e[0],))
-        out = []
-        # index walk, NOT entries[lo:]: a tail slice copies O(n) entries
-        # per lookup, which dominated point-read CPU
-        for i in range(lo, len(entries)):
-            if entries[i][0] != key:
-                break
-            out.append(entries[i][2])
-        return out
+        # hash bucket per key: point lookups skip the sorted list entirely
+        return list(slot["eq"].get(self._entry_key(values), ()))
 
     def candidates_range(self, label_id, prop_ids, lower=None, upper=None,
                          lower_inclusive=True, upper_inclusive=True):
@@ -324,6 +396,18 @@ class EdgeTypeIndex:
         bucket = self._index.get(edge.edge_type)
         if bucket is not None:
             bucket[edge.gid] = edge
+
+    def bulk_add(self, edges) -> None:
+        """Deferred batch maintenance: group by type, one update per bucket."""
+        if not self._index:
+            return
+        by_type: dict[int, list] = {}
+        for e in edges:
+            by_type.setdefault(e.edge_type, []).append(e)
+        for etype, group in by_type.items():
+            bucket = self._index.get(etype)
+            if bucket is not None:
+                bucket.update((e.gid, e) for e in group)
 
     def candidates(self, edge_type_id: int):
         bucket = self._index.get(edge_type_id)

@@ -23,8 +23,8 @@ from __future__ import annotations
 
 from ..exceptions import SerializationError
 from .common import TRANSACTION_ID_START, View
-from .delta import (CommitInfo, Delta, DeltaAction, MaterializedState,
-                    apply_undo)
+from .delta import (EDGE_ACTIONS, CommitInfo, Delta, DeltaAction,
+                    MaterializedState, apply_undo)
 from .objects import Edge, Vertex
 
 
@@ -36,19 +36,38 @@ def _writer_invisible(ts: int, txn_id: int, start_ts: int, view: View) -> bool:
     return ts > start_ts             # committed after our snapshot
 
 
-def materialize_vertex(vertex: Vertex, txn, view: View) -> MaterializedState:
-    """Reconstruct `vertex` as seen by `txn` under `view`."""
+def state_is_current(obj: Vertex | Edge, txn, view: View) -> bool:
+    """True when `txn`'s view of `obj` equals its live fields: the undo walk
+    stops at the first visible delta, so a visible (or absent) chain head
+    means no undo applies. Caller should hold obj.lock for an atomic answer.
+    """
+    delta = obj.delta
+    if delta is None:
+        return True
+    ts = delta.commit_info.timestamp
+    return not _writer_invisible(ts, txn.id, txn.effective_start_ts(), view)
+
+
+def materialize_vertex(vertex: Vertex, txn, view: View,
+                       need_edges: bool = True) -> MaterializedState:
+    """Reconstruct `vertex` as seen by `txn` under `view`.
+
+    need_edges=False skips copying the adjacency lists AND applying edge
+    undos — labels/properties/existence readers on supernode hubs must not
+    pay an O(degree) list copy per property access (round-5 write-path
+    profile: this copy dominated hub UNWIND SET).
+    """
     with vertex.lock:
         state = MaterializedState(
             exists=True,
             deleted=vertex.deleted,
             labels=set(vertex.labels),
             properties=dict(vertex.properties),
-            in_edges=list(vertex.in_edges),
-            out_edges=list(vertex.out_edges),
+            in_edges=list(vertex.in_edges) if need_edges else [],
+            out_edges=list(vertex.out_edges) if need_edges else [],
         )
         delta = vertex.delta
-    _walk(delta, state, txn, view)
+    _walk(delta, state, txn, view, apply_edges=need_edges)
     return state
 
 
@@ -64,14 +83,16 @@ def materialize_edge(edge: Edge, txn, view: View) -> MaterializedState:
     return state
 
 
-def _walk(delta: Delta | None, state: MaterializedState, txn, view: View) -> None:
+def _walk(delta: Delta | None, state: MaterializedState, txn, view: View,
+          apply_edges: bool = True) -> None:
     start_ts = txn.effective_start_ts()
     txn_id = txn.id
     while delta is not None:
         ts = delta.commit_info.timestamp
         if not _writer_invisible(ts, txn_id, start_ts, view):
             break
-        apply_undo(state, delta)
+        if apply_edges or delta.action not in EDGE_ACTIONS:
+            apply_undo(state, delta)
         delta = delta.next
     # Callers treat visibility as `state.exists and not state.deleted`;
     # the flags stay separate so accessors can distinguish "never existed at
@@ -108,3 +129,4 @@ def push_delta(obj: Vertex | Edge, txn, action: DeltaAction, payload) -> Delta:
     obj.delta = delta
     txn.deltas.append(delta)
     return delta
+

@@ -17,9 +17,15 @@ from typing import Optional
 from .delta import Delta
 
 
+# in/out degree at which a per-vertex adjacency map (neighbor gid -> entry
+# list) is built lazily, making bound-endpoint edge lookups — the MERGE
+# existence probe — O(1) instead of O(degree) on supernode hubs
+ADJ_INDEX_THRESHOLD = 64
+
+
 class Vertex:
     __slots__ = ("gid", "labels", "properties", "in_edges", "out_edges",
-                 "deleted", "delta", "lock")
+                 "deleted", "delta", "lock", "adj_in", "adj_out")
 
     def __init__(self, gid: int, delta: Optional[Delta] = None) -> None:
         self.gid = gid
@@ -31,9 +37,52 @@ class Vertex:
         self.deleted = False
         self.delta = delta
         self.lock = threading.Lock()
+        # lazy supernode adjacency maps: other_gid -> [entry, ...].
+        # None = not built; kept exactly in sync with in_edges/out_edges by
+        # every path that mutates those lists (or invalidated back to None).
+        self.adj_in: Optional[dict] = None
+        self.adj_out: Optional[dict] = None
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Vertex(gid={self.gid}, labels={self.labels}, deleted={self.deleted})"
+
+
+def adj_map_add(vertex: "Vertex", side: str, entry: tuple) -> None:
+    """Mirror an adjacency-list append into the vertex's lazy adjacency map
+    (no-op while the map is unbuilt). Caller holds vertex.lock."""
+    adj = vertex.adj_in if side == "in" else vertex.adj_out
+    if adj is not None:
+        adj.setdefault(entry[1].gid, []).append(entry)
+
+
+def adj_map_remove(vertex: "Vertex", side: str, entry: tuple) -> None:
+    """Mirror an adjacency-list removal. Caller holds vertex.lock."""
+    adj = vertex.adj_in if side == "in" else vertex.adj_out
+    if adj is None:
+        return
+    bucket = adj.get(entry[1].gid)
+    if bucket is None:
+        return
+    try:
+        bucket.remove(entry)
+    except ValueError:
+        pass
+    if not bucket:
+        del adj[entry[1].gid]
+
+
+def adj_map_build(vertex: "Vertex", side: str) -> dict:
+    """Build (and install) the adjacency map from the live adjacency list.
+    Caller holds vertex.lock."""
+    adj: dict = {}
+    entries = vertex.in_edges if side == "in" else vertex.out_edges
+    for entry in entries:
+        adj.setdefault(entry[1].gid, []).append(entry)
+    if side == "in":
+        vertex.adj_in = adj
+    else:
+        vertex.adj_out = adj
+    return adj
 
 
 class Edge:

@@ -30,7 +30,8 @@ from .delta import CommitInfo, DeltaAction
 from .indexes import Indices
 from .mvcc import (materialize_edge, materialize_vertex, prepare_for_write,
                    push_delta)
-from .objects import Edge, Vertex
+from .objects import (ADJ_INDEX_THRESHOLD, Edge, Vertex, adj_map_add,
+                      adj_map_build, adj_map_remove)
 
 log = logging.getLogger(__name__)
 
@@ -62,6 +63,15 @@ class StorageConfig:
     allow_recovery_failure: bool = False
 
 
+@dataclass
+class BatchInsert:
+    """One batch_insert() call's created objects, recorded on the owning
+    transaction so commit can emit a single columnar BATCH_INSERT WAL
+    record instead of one record per object."""
+    vertices: list = field(default_factory=list)
+    edges: list = field(default_factory=list)
+
+
 class _Namer:
     """Adapter giving constraints readable names in error messages."""
 
@@ -78,7 +88,7 @@ class _Namer:
 class Transaction:
     __slots__ = ("id", "start_ts", "commit_info", "deltas", "isolation",
                  "storage", "touched_vertices", "touched_edges", "commit_ts",
-                 "topology_snapshot")
+                 "topology_snapshot", "batches", "edge_prop_endpoint_gids")
 
     def __init__(self, txn_id: int, start_ts: int, isolation: IsolationLevel,
                  storage: "InMemoryStorage") -> None:
@@ -92,6 +102,11 @@ class Transaction:
         self.touched_edges: dict[int, Edge] = {}
         self.commit_ts: Optional[int] = None   # set at commit
         self.topology_snapshot = 0             # set by _begin_transaction
+        self.batches = None  # list[BatchInsert] once batch_insert is used
+        # endpoint gids of edges touched WITHOUT their vertices entering
+        # touched_vertices (only _edge_set_property) — lets the commit/abort
+        # topology bump skip re-walking every touched edge's endpoints
+        self.edge_prop_endpoint_gids = None
 
     def effective_start_ts(self) -> int:
         # Once committed, the transaction's snapshot ADVANCES to its commit
@@ -134,27 +149,32 @@ class VertexAccessor:
 
     # --- reads --------------------------------------------------------------
 
-    def _state(self, view: View):
-        return self._acc._vertex_state(self.vertex, view)
+    def _state(self, view: View, need_edges: bool = True):
+        return self._acc._vertex_state(self.vertex, view, need_edges)
 
     def is_visible(self, view: View = View.OLD) -> bool:
-        st = self._state(view)
+        st = self._state(view, need_edges=False)
         return st.exists and not st.deleted
 
     def labels(self, view: View = View.NEW) -> list[int]:
-        return sorted(self._state(view).labels)
+        return sorted(self._state(view, need_edges=False).labels)
 
     def has_label(self, label_id: int, view: View = View.NEW) -> bool:
-        return label_id in self._state(view).labels
+        return label_id in self._state(view, need_edges=False).labels
 
     def properties(self, view: View = View.NEW) -> dict[int, object]:
-        return dict(self._state(view).properties)
+        return dict(self._state(view, need_edges=False).properties)
 
     def get_property(self, prop_id: int, view: View = View.NEW):
-        return self._state(view).properties.get(prop_id)
+        return self._state(view, need_edges=False).properties.get(prop_id)
 
     def in_edges(self, view: View = View.NEW, edge_types=None,
                  from_vertex=None) -> list["EdgeAccessor"]:
+        if from_vertex is not None:
+            entries = self._acc._neighbor_entries(
+                self.vertex, "in", from_vertex.vertex.gid, view)
+            if entries is not None:
+                return self._filter_entries(entries, view, edge_types, None)
         st = self._state(view)
         out = []
         for (etype, other, edge) in st.in_edges:
@@ -170,12 +190,27 @@ class VertexAccessor:
 
     def out_edges(self, view: View = View.NEW, edge_types=None,
                   to_vertex=None) -> list["EdgeAccessor"]:
+        if to_vertex is not None:
+            entries = self._acc._neighbor_entries(
+                self.vertex, "out", to_vertex.vertex.gid, view)
+            if entries is not None:
+                return self._filter_entries(entries, view, edge_types, None)
         st = self._state(view)
         out = []
         for (etype, other, edge) in st.out_edges:
             if edge_types is not None and etype not in edge_types:
                 continue
             if to_vertex is not None and other.gid != to_vertex.vertex.gid:
+                continue
+            ea = EdgeAccessor(edge, self._acc)
+            if ea.is_visible(view) and self._acc._fg_edge_ok(ea, view):
+                out.append(ea)
+        return out
+
+    def _filter_entries(self, entries, view, edge_types, _unused):
+        out = []
+        for (etype, _other, edge) in entries:
+            if edge_types is not None and etype not in edge_types:
                 continue
             ea = EdgeAccessor(edge, self._acc)
             if ea.is_visible(view) and self._acc._fg_edge_ok(ea, view):
@@ -426,6 +461,8 @@ class Accessor:
                 push_delta(to_v, self.txn, DeltaAction.REMOVE_IN_EDGE, in_entry)
             from_v.out_edges.append(out_entry)
             to_v.in_edges.append(in_entry)
+            adj_map_add(from_v, "out", out_entry)
+            adj_map_add(to_v, "in", in_entry)
         finally:
             if second is not first:
                 second.lock.release()
@@ -461,6 +498,7 @@ class Accessor:
                 from_v.out_edges.remove(out_entry)
             except ValueError:
                 pass
+            adj_map_remove(from_v, "out", out_entry)
         with to_v.lock:
             if not self._analytical:
                 prepare_for_write(to_v, self.txn)
@@ -469,11 +507,216 @@ class Accessor:
                 to_v.in_edges.remove(in_entry)
             except ValueError:
                 pass
+            adj_map_remove(to_v, "in", in_entry)
         self.txn.touched_edges[edge.gid] = edge
         self.txn.touched_vertices[from_v.gid] = from_v
         self.txn.touched_vertices[to_v.gid] = to_v
         self.storage._bump_topology({from_v.gid, to_v.gid})
         return ea
+
+    # --- bulk-write fast lane ----------------------------------------------
+
+    def batch_insert(self, vertices=(), edges=()):
+        """Bulk-create vertices and edges with per-batch (not per-row)
+        overhead: one gid-counter reservation, one undo delta per object
+        (plus one bulk adjacency undo per pre-existing endpoint), deferred
+        bulk-merged index maintenance, and a single change-log bump. The
+        batch stays one MVCC transaction: invisible to other readers until
+        commit, fully undone by abort, and encoded as one BATCH_INSERT
+        WAL/replication record at commit.
+
+        vertices: sequence of (label_ids, props) — label_ids an iterable of
+          label ids, props a dict[prop_id, value] (ownership transfers).
+        edges: sequence of (edge_type_id, from_ref, to_ref, props) — a ref
+          is an int index into this call's `vertices`, or a Vertex /
+          VertexAccessor for a pre-existing endpoint.
+
+        Returns (new_vertices, new_edges) as raw storage objects.
+        """
+        import numpy as np
+        storage = self.storage
+        txn = self.txn
+        analytical = self._analytical
+        vertices = list(vertices)
+        edges = list(edges)
+        nv, ne = len(vertices), len(edges)
+        if not nv and not ne:
+            return [], []
+        fg = self.fine_grained
+        if fg is not None:
+            seen_sets: set = set()
+            for labels, _props in vertices:
+                t = tuple(labels)
+                if t not in seen_sets:
+                    seen_sets.add(t)
+                    for lid in t:
+                        fg.check_label_modify(lid)
+                    fg.check_vertex_update(set(t))
+            seen_types: set = set()
+            for etype, _f, _t, _p in edges:
+                if etype not in seen_types:
+                    seen_types.add(etype)
+                    fg.check_edge_create_delete(etype)
+
+        # (a) vectorized gid allocation: one counter reservation per batch
+        with storage._gid_lock:
+            v_base = storage._next_vertex_gid
+            storage._next_vertex_gid += nv
+            e_base = storage._next_edge_gid
+            storage._next_edge_gid += ne
+        v_gids = np.arange(v_base, v_base + nv, dtype=np.int64).tolist()
+
+        from .delta import Delta
+        commit_info = txn.commit_info
+        deltas = txn.deltas
+        _DELETE = DeltaAction.DELETE_OBJECT
+
+        new_vertices: list[Vertex] = []
+        append_vertex = new_vertices.append
+        for gid, (labels, props) in zip(v_gids, vertices):
+            v = Vertex(gid)
+            if labels:
+                v.labels = set(labels)
+            if props:
+                v.properties = props if isinstance(props, dict) \
+                    else dict(props)
+            if not analytical:
+                d = Delta(_DELETE, None, commit_info, None, v)
+                v.delta = d
+                deltas.append(d)
+            append_vertex(v)
+
+        props_on_edges = storage.config.properties_on_edges
+        new_edges: list[Edge] = []
+        append_edge = new_edges.append
+        # pre-existing endpoints: entries grouped per vertex (object-keyed,
+        # identity hash) so each gets ONE lock round + ONE bulk undo delta
+        # for the whole batch
+        pending_in: dict[Vertex, list] = {}
+        pending_out: dict[Vertex, list] = {}
+        egid = e_base
+        for etype, fref, tref, props in edges:
+            from_new = type(fref) is int
+            to_new = type(tref) is int
+            from_v = new_vertices[fref] if from_new else \
+                (fref.vertex if type(fref) is VertexAccessor else fref)
+            to_v = new_vertices[tref] if to_new else \
+                (tref.vertex if type(tref) is VertexAccessor else tref)
+            edge = Edge(egid, etype, from_v, to_v)
+            egid += 1
+            if props:
+                if not props_on_edges:
+                    raise StorageError("properties on edges are disabled")
+                edge.properties = props if isinstance(props, dict) \
+                    else dict(props)
+            if not analytical:
+                d = Delta(_DELETE, None, commit_info, None, edge)
+                edge.delta = d
+                deltas.append(d)
+            out_entry = (etype, to_v, edge)
+            in_entry = (etype, from_v, edge)
+            if from_new:
+                # unpublished: no lock, no adjacency undo needed — the
+                # vertex's own DELETE_OBJECT undo covers its whole state
+                from_v.out_edges.append(out_entry)
+                if from_v.adj_out is not None:
+                    adj_map_add(from_v, "out", out_entry)
+            else:
+                group = pending_out.get(from_v)
+                if group is None:
+                    group = pending_out[from_v] = []
+                group.append(out_entry)
+            if to_new:
+                to_v.in_edges.append(in_entry)
+                if to_v.adj_in is not None:
+                    adj_map_add(to_v, "in", in_entry)
+            else:
+                group = pending_in.get(to_v)
+                if group is None:
+                    group = pending_in[to_v] = []
+                group.append(in_entry)
+            append_edge(edge)
+
+        # (e) amortized supernode bookkeeping: one lock round + one bulk
+        # undo per pre-existing endpoint per direction, however many edges
+        # it gained
+        touched_v = txn.touched_vertices
+        changed = {v.gid for v in new_vertices}
+        changed_add = changed.add
+        _IN_BULK = DeltaAction.REMOVE_IN_EDGES_BULK
+        _OUT_BULK = DeltaAction.REMOVE_OUT_EDGES_BULK
+        for side, bulk_action, pending in (
+                ("in", _IN_BULK, pending_in),
+                ("out", _OUT_BULK, pending_out)):
+            is_in = side == "in"
+            for v, entries in pending.items():
+                lock = v.lock
+                lock.acquire()
+                try:
+                    if not analytical:
+                        prepare_for_write(v, txn)
+                    if v.deleted:
+                        raise StorageError(
+                            "cannot create edge on a deleted vertex")
+                    if not analytical:
+                        d = Delta(bulk_action, tuple(entries), commit_info,
+                                  v.delta, v)
+                        v.delta = d
+                        deltas.append(d)
+                    if is_in:
+                        v.in_edges.extend(entries)
+                        if v.adj_in is not None:
+                            for entry in entries:
+                                adj_map_add(v, "in", entry)
+                    else:
+                        v.out_edges.extend(entries)
+                        if v.adj_out is not None:
+                            for entry in entries:
+                                adj_map_add(v, "out", entry)
+                finally:
+                    lock.release()
+                gid = v.gid
+                touched_v[gid] = v
+                changed_add(gid)
+
+        # publish
+        storage._vertices.update(zip(v_gids, new_vertices))
+        storage._edges.update((e.gid, e) for e in new_edges)
+
+        # (c) deferred index maintenance: one sorted bulk-merge per index
+        if new_vertices:
+            per_label: dict[int, list] = {}
+            for v in new_vertices:
+                for lid in v.labels:
+                    per_label.setdefault(lid, []).append(v)
+            for lid, group in per_label.items():
+                storage.indices.label.bulk_add(lid, group)
+            storage.indices.label_property.bulk_add(new_vertices)
+        if new_edges:
+            storage.indices.edge_type.bulk_add(new_edges)
+
+        txn.touched_vertices.update((v.gid, v) for v in new_vertices)
+        txn.touched_edges.update((e.gid, e) for e in new_edges)
+        if not analytical:
+            if txn.batches is None:
+                txn.batches = []
+            txn.batches.append(BatchInsert(new_vertices, new_edges))
+
+        # (d) one change-log record per batch (gids collected while hot in
+        # the loops above)
+        storage._bump_topology(changed)
+
+        if nv + ne >= 1024:
+            # bulk-load pacing: graph objects are long-lived by
+            # construction, but CPython's cyclic GC rescans every one of
+            # them on each gen-2 collection — at millions of objects the
+            # scans ate >50% of ingest wall time (measured r6). Freeze the
+            # current heap into the permanent generation; collect_garbage()
+            # unfreezes before sweeping so deleted vertex<->edge cycles
+            # stay reclaimable.
+            import gc as _gc
+            _gc.freeze()
+        return new_vertices, new_edges
 
     # --- vertex mutations (called through VertexAccessor) -------------------
 
@@ -563,6 +806,11 @@ class Accessor:
             else:
                 edge.properties[prop_id] = value
         self.txn.touched_edges[edge.gid] = edge
+        eps = self.txn.edge_prop_endpoint_gids
+        if eps is None:
+            eps = self.txn.edge_prop_endpoint_gids = set()
+        eps.add(edge.from_vertex.gid)
+        eps.add(edge.to_vertex.gid)
         if self._analytical:
             self.storage._bump_topology(
                 {edge.from_vertex.gid, edge.to_vertex.gid})
@@ -570,7 +818,8 @@ class Accessor:
 
     # --- reads --------------------------------------------------------------
 
-    def _vertex_state(self, vertex: Vertex, view: View):
+    def _vertex_state(self, vertex: Vertex, view: View,
+                      need_edges: bool = True):
         txn = self.txn
         if (txn.isolation is IsolationLevel.READ_UNCOMMITTED
                 or self._analytical):
@@ -580,9 +829,34 @@ class Accessor:
                     exists=True, deleted=vertex.deleted,
                     labels=set(vertex.labels),
                     properties=dict(vertex.properties),
-                    in_edges=list(vertex.in_edges),
-                    out_edges=list(vertex.out_edges))
-        return materialize_vertex(vertex, txn, view)
+                    in_edges=list(vertex.in_edges) if need_edges else [],
+                    out_edges=list(vertex.out_edges) if need_edges else [])
+        return materialize_vertex(vertex, txn, view, need_edges)
+
+    def _neighbor_entries(self, vertex: Vertex, side: str, other_gid: int,
+                          view: View):
+        """Supernode fast path for bound-endpoint edge lookups: candidate
+        adjacency entries between `vertex` and `other_gid`, or None when the
+        caller must fall back to the full materialize-and-scan.
+
+        Only valid when the reader's view of the vertex equals its live
+        fields (state_is_current): then the live adjacency map is
+        authoritative and the O(degree) state copy is skipped. Each
+        returned entry's edge still gets the normal per-edge visibility
+        check, so an invisible concurrent edge never leaks through."""
+        from .mvcc import state_is_current
+        live = vertex.in_edges if side == "in" else vertex.out_edges
+        if len(live) < ADJ_INDEX_THRESHOLD:
+            return None
+        with vertex.lock:
+            if not (self._analytical
+                    or self.txn.isolation is IsolationLevel.READ_UNCOMMITTED
+                    or state_is_current(vertex, self.txn, view)):
+                return None
+            adj = vertex.adj_in if side == "in" else vertex.adj_out
+            if adj is None:
+                adj = adj_map_build(vertex, side)
+            return list(adj.get(other_gid, ()))
 
     def _edge_state(self, edge: Edge, view: View):
         txn = self.txn
@@ -616,7 +890,8 @@ class Accessor:
 
     def _fg_vertex_ok(self, va: "VertexAccessor", view: View) -> bool:
         fg = self.fine_grained
-        return fg is None or fg.can_read_vertex(va._state(view).labels)
+        return fg is None or fg.can_read_vertex(
+            va._state(view, need_edges=False).labels)
 
     def _fg_edge_ok(self, ea: "EdgeAccessor", view: View) -> bool:
         fg = self.fine_grained
@@ -624,8 +899,10 @@ class Accessor:
             return True
         if not fg.can_read_edge(ea.edge.edge_type):
             return False
-        return fg.can_read_vertex(ea.from_vertex()._state(view).labels) and \
-            fg.can_read_vertex(ea.to_vertex()._state(view).labels)
+        return fg.can_read_vertex(
+            ea.from_vertex()._state(view, need_edges=False).labels) and \
+            fg.can_read_vertex(
+                ea.to_vertex()._state(view, need_edges=False).labels)
 
     def vertices(self, view: View = View.OLD) -> Iterator[VertexAccessor]:
         for vertex in list(self.storage._vertices.values()):
@@ -648,11 +925,14 @@ class Accessor:
                 if va.has_label(label_id, view):
                     yield va
             return
+        fg = self.fine_grained
         for vertex in candidates:
-            va = VertexAccessor(vertex, self)
-            if va.is_visible(view) and va.has_label(label_id, view) \
-                    and self._fg_vertex_ok(va, view):
-                yield va
+            st = self._vertex_state(vertex, view, need_edges=False)
+            if not st.exists or st.deleted or label_id not in st.labels:
+                continue
+            if fg is not None and not fg.can_read_vertex(st.labels):
+                continue
+            yield VertexAccessor(vertex, self)
 
     def vertices_by_label_property_value(self, label_id: int,
                                          prop_ids: tuple[int, ...], values,
@@ -666,15 +946,18 @@ class Accessor:
                        for p, v in zip(prop_ids, values)):
                     yield va
             return
+        fg = self.fine_grained
         for vertex in candidates:
-            va = VertexAccessor(vertex, self)
-            if not va.is_visible(view) or not va.has_label(label_id, view):
+            # one props-only materialization covers visibility, label,
+            # auth, and value revalidation (was four walks per candidate)
+            st = self._vertex_state(vertex, view, need_edges=False)
+            if not st.exists or st.deleted or label_id not in st.labels:
                 continue
-            if not self._fg_vertex_ok(va, view):
+            if fg is not None and not fg.can_read_vertex(st.labels):
                 continue
-            props = va.properties(view)
+            props = st.properties
             if all(props.get(p) == v for p, v in zip(prop_ids, values)):
-                yield va
+                yield VertexAccessor(vertex, self)
 
     def vertices_by_label_property_range(self, label_id: int,
                                          prop_ids: tuple[int, ...],
@@ -743,6 +1026,11 @@ class Accessor:
 
 class InMemoryStorage:
     """The storage engine. Owns objects, indexes, constraints, mappers."""
+
+    # the planner's bulk-write fast lane (query/plan/bulk.py) only routes
+    # through batch_insert() on engines that declare support — subclasses
+    # with their own persistence model (disk storage) opt out
+    supports_batch_insert = True
 
     def __init__(self, config: Optional[StorageConfig] = None) -> None:
         self.config = config or StorageConfig()
@@ -868,20 +1156,27 @@ class InMemoryStorage:
                 return self._timestamp
         self._check_db_memory_limit(txn)
 
-        touched = list(txn.touched_vertices.values())
-        # existence + type constraints against the transaction's NEW state
-        for v in touched:
-            if not v.deleted:
-                self.constraints.existence.validate_vertex(
-                    v.labels, v.properties, self.namer)
-                self.constraints.type.validate_vertex(
-                    v.labels, v.properties, self.namer)
+        # existence + type + unique constraints all walk the touched set —
+        # skipped (and never materialized) when none are defined: bulk
+        # commits touch hundreds of thousands of vertices
+        constrained = bool(self.constraints.existence._constraints
+                           or self.constraints.type._constraints
+                           or self.constraints.unique._maps)
+        touched = list(txn.touched_vertices.values()) if constrained else ()
+        if self.constraints.existence._constraints or \
+                self.constraints.type._constraints:
+            for v in touched:
+                if not v.deleted:
+                    self.constraints.existence.validate_vertex(
+                        v.labels, v.properties, self.namer)
+                    self.constraints.type.validate_vertex(
+                        v.labels, v.properties, self.namer)
 
         frame = None
         ship_seq = None
         with self._engine_lock:
             registrations = self.constraints.unique.validate_commit(
-                [v for v in touched], self.namer)
+                touched, self.namer)
             self._timestamp += 1
             commit_ts = self._timestamp
             if self.wal_sink is not None or self.frame_consumers \
@@ -922,13 +1217,15 @@ class InMemoryStorage:
             # with the visibility flip relative to _begin_transaction's
             # (start_ts, topology_snapshot) capture, or a reader could
             # key a cache entry at a version whose data it cannot see
-            self._bump_topology(
-                set(txn.touched_vertices)
-                # edge-property commits must invalidate too: the
-                # delta-refresh path diffs edges of CHANGED nodes,
-                # so both endpoints count as changed (r5 review)
-                | {e.from_vertex.gid for e in txn.touched_edges.values()}
-                | {e.to_vertex.gid for e in txn.touched_edges.values()})
+            # edge-property commits must invalidate both endpoints too: the
+            # delta-refresh path diffs edges of CHANGED nodes (r5 review).
+            # Every OTHER edge-touching path already put its endpoints in
+            # touched_vertices, so only _edge_set_property's endpoint set
+            # needs unioning — not a walk over every touched edge (r6).
+            changed = set(txn.touched_vertices)
+            if txn.edge_prop_endpoint_gids:
+                changed |= txn.edge_prop_endpoint_gids
+            self._bump_topology(changed)
         if ship_seq is not None:
             # strict shipping order across concurrent committers
             with self._ship_cond:
@@ -941,11 +1238,38 @@ class InMemoryStorage:
                 with self._ship_cond:
                     self._next_ship_seq = ship_seq + 1
                     self._ship_cond.notify_all()
+        if txn.batches:
+            self._retire_batch_deltas(txn, commit_ts)
         if self.config.gc_aggressive:
             # eager delta reclamation after every commit
             # (reference: --storage-gc-aggressive)
             self.collect_garbage()
         return commit_ts
+
+    def _retire_batch_deltas(self, txn: Transaction, commit_ts: int) -> None:
+        """Eagerly sever the undo deltas of a committed bulk insert when no
+        active transaction's snapshot predates the commit — the same rule
+        GC's truncate applies, hit at the moment it is cheapest. A bulk
+        load otherwise accumulates one delta per inserted object until the
+        next GC cycle (millions of objects whose refcount cycles through
+        obj.delta ↔ delta.obj), which measurably poisons cache locality at
+        the 5M-edge scale."""
+        if self.oldest_active_start_ts() <= commit_ts:
+            return     # a concurrent reader may still need the undos
+        ci = txn.commit_info
+        for batch in txn.batches:
+            for obj in batch.vertices:
+                d = obj.delta
+                if d is not None and d.commit_info is ci and d.next is None:
+                    with obj.lock:
+                        if obj.delta is d and d.next is None:
+                            obj.delta = None
+            for obj in batch.edges:
+                d = obj.delta
+                if d is not None and d.commit_info is ci and d.next is None:
+                    with obj.lock:
+                        if obj.delta is d and d.next is None:
+                            obj.delta = None
 
     def _abort(self, txn: Transaction) -> None:
         # undo in reverse; our deltas are contiguous at each object's head
@@ -982,16 +1306,27 @@ class InMemoryStorage:
                         obj.out_edges.remove(delta.payload)
                     except ValueError:
                         pass
+                elif a is A.REMOVE_IN_EDGES_BULK:
+                    drop = set(delta.payload)
+                    obj.in_edges = [e for e in obj.in_edges if e not in drop]
+                elif a is A.REMOVE_OUT_EDGES_BULK:
+                    drop = set(delta.payload)
+                    obj.out_edges = [e for e in obj.out_edges
+                                     if e not in drop]
                 assert obj.delta is delta, "abort: delta chain corrupted"
                 obj.delta = delta.next
         for v in txn.touched_vertices.values():
+            # the undo loop rewrote adjacency lists directly; drop any lazy
+            # adjacency maps so they rebuild from the restored lists
+            v.adj_in = None
+            v.adj_out = None
             self.indices.label_property.update_on_change(v)
         with self._engine_lock:
             self._active_txns.pop(txn.id, None)
-        self._bump_topology(
-            set(txn.touched_vertices)
-            | {e.from_vertex.gid for e in txn.touched_edges.values()}
-            | {e.to_vertex.gid for e in txn.touched_edges.values()})
+        changed = set(txn.touched_vertices)
+        if txn.edge_prop_endpoint_gids:
+            changed |= txn.edge_prop_endpoint_gids
+        self._bump_topology(changed)
 
     # --- GC -----------------------------------------------------------------
 
@@ -1009,6 +1344,11 @@ class InMemoryStorage:
         """
         oldest = self.oldest_active_start_ts()
         stats = {"deltas_freed": 0, "vertices_freed": 0, "edges_freed": 0}
+        # bulk ingest freezes the heap (batch_insert) so cyclic GC stops
+        # rescanning live graph objects; thaw here so the vertex<->edge
+        # reference cycles of objects THIS sweep drops become collectable
+        import gc as _gc
+        _gc.unfreeze()
 
         def truncate(obj) -> None:
             with obj.lock:

@@ -1,0 +1,444 @@
+"""Bulk-write fast lane tests: batch detection, batch_insert semantics,
+deferred index consistency, BATCH_INSERT WAL durability (incl. crash
+recovery), replication equivalence, and supernode adjacency bookkeeping.
+"""
+
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.query.plan import operators as Op
+from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+from memgraph_tpu.storage.common import View
+from memgraph_tpu.storage.durability import wal as W
+from memgraph_tpu.storage.durability.recovery import recover, wire_durability
+
+
+def _db():
+    ictx = InterpreterContext(InMemoryStorage())
+    return ictx, Interpreter(ictx)
+
+
+def _rows(interp, q, params=None):
+    _, rows, _ = interp.execute(q, params)
+    return rows
+
+
+def _plan(ictx, q):
+    plan, _cols = ictx.cached_plan(q, ictx.cached_parse(q))
+    return plan
+
+
+# --- plan-shape detection ---------------------------------------------------
+
+def test_fast_lane_detection():
+    ictx, interp = _db()
+    interp.execute("CREATE INDEX ON :User(id)")
+    assert isinstance(_plan(ictx, "UNWIND $ids AS i CREATE (:User {id: i})"),
+                      Op.BatchCreateGraph)
+    assert isinstance(_plan(ictx, "CREATE (:A {x: 1}), (:B {x: 2})"),
+                      Op.BatchCreateGraph)
+    assert isinstance(
+        _plan(ictx, "UNWIND $p AS p MATCH (a:User {id: p[0]}), "
+                    "(b:User {id: p[1]}) CREATE (a)-[:F]->(b)"),
+        Op.BatchCreateGraph)
+    # a RETURN means downstream consumers exist: no rewrite
+    assert not isinstance(
+        _plan(ictx, "UNWIND $ids AS i CREATE (n:User {id: i}) RETURN n"),
+        Op.BatchCreateGraph)
+    # property referencing a same-chain created node: no rewrite
+    assert not isinstance(
+        _plan(ictx, "CREATE (a:A {x: 1}) CREATE (b:B {y: a.x})"),
+        Op.BatchCreateGraph)
+
+
+def test_fast_lane_can_be_disabled():
+    ictx = InterpreterContext(InMemoryStorage(),
+                              {"bulk_fast_lane": False})
+    assert not isinstance(_plan(ictx, "UNWIND $ids AS i CREATE (:U {id: i})"),
+                          Op.BatchCreateGraph)
+
+
+# --- batch create correctness ----------------------------------------------
+
+def test_unwind_create_nodes_and_stats():
+    _ictx, interp = _db()
+    _cols, _rows_, summary = interp.execute(
+        "UNWIND $ids AS i CREATE (:User {id: i, age: i % 7})",
+        {"ids": list(range(500))})
+    stats = summary["stats"]
+    assert stats["nodes_created"] == 500
+    assert stats["labels_added"] == 500
+    assert stats["properties_set"] == 1000
+    assert _rows(interp, "MATCH (n:User) RETURN count(n), min(n.id), "
+                         "max(n.id), sum(n.age)") == \
+        [[500, 0, 499, sum(i % 7 for i in range(500))]]
+
+
+def test_multi_create_pattern_with_edges():
+    _ictx, interp = _db()
+    _c, _r, summary = interp.execute(
+        "CREATE (:A {x: 1})-[:R {w: 2}]->(:B {y: 3})")
+    assert summary["stats"]["nodes_created"] == 2
+    assert summary["stats"]["relationships_created"] == 1
+    assert _rows(interp, "MATCH (a:A)-[r:R]->(b:B) "
+                         "RETURN a.x, r.w, b.y") == [[1, 2, 3]]
+
+
+def test_edge_batch_matches_per_row_semantics():
+    _ictx, interp = _db()
+    interp.execute("CREATE INDEX ON :U(id)")
+    interp.execute("UNWIND $ids AS i CREATE (:U {id: i})",
+                   {"ids": list(range(50))})
+    rng = random.Random(3)
+    pairs = [[rng.randrange(50), rng.randrange(50)] for _ in range(200)]
+    pairs.append(pairs[0])          # duplicate row → parallel edge
+    interp.execute(
+        "UNWIND $pairs AS p MATCH (a:U {id: p[0]}), (b:U {id: p[1]}) "
+        "CREATE (a)-[:F]->(b)", {"pairs": pairs})
+    assert _rows(interp, "MATCH ()-[r:F]->() RETURN count(r)") == \
+        [[len(pairs)]]
+    # spot-check endpoints
+    a, b = pairs[5]
+    got = _rows(interp, "MATCH (a:U {id: $a})-[:F]->(b) RETURN count(b)",
+                {"a": a})
+    assert got[0][0] == sum(1 for p in pairs if p[0] == a)
+
+
+def test_missing_match_row_creates_nothing():
+    _ictx, interp = _db()
+    interp.execute("CREATE INDEX ON :U(id)")
+    interp.execute("CREATE (:U {id: 1})")
+    interp.execute(
+        "UNWIND $pairs AS p MATCH (a:U {id: p[0]}), (b:U {id: p[1]}) "
+        "CREATE (a)-[:F]->(b)", {"pairs": [[1, 1], [1, 99], [99, 1]]})
+    assert _rows(interp, "MATCH ()-[r:F]->() RETURN count(r)") == [[1]]
+
+
+def test_load_csv_create_goes_through_fast_lane(tmp_path):
+    path = tmp_path / "people.csv"
+    path.write_text("name,age\nana,30\nben,40\n")
+    ictx, interp = _db()
+    q = f'LOAD CSV FROM "{path}" WITH HEADER AS row ' \
+        "CREATE (:Person {name: row.name})"
+    assert isinstance(_plan(ictx, q), Op.BatchCreateGraph)
+    interp.execute(q)
+    assert _rows(interp, "MATCH (p:Person) RETURN p.name ORDER BY p.name") \
+        == [["ana"], ["ben"]]
+
+
+# --- transactionality -------------------------------------------------------
+
+def test_batch_rollback_leaves_nothing():
+    _ictx, interp = _db()
+    interp.execute("BEGIN")
+    interp.execute("UNWIND $ids AS i CREATE (:T {id: i})",
+                   {"ids": list(range(100))})
+    interp.execute("ROLLBACK")
+    assert _rows(interp, "MATCH (n:T) RETURN count(n)") == [[0]]
+
+
+def test_batch_invisible_until_commit():
+    ictx, interp = _db()
+    storage = ictx.storage
+    interp.execute("BEGIN")
+    interp.execute("UNWIND $ids AS i CREATE (:T {id: i})",
+                   {"ids": list(range(64))})
+    # a concurrent snapshot reader must not see the uncommitted batch
+    acc = storage.access()
+    try:
+        assert sum(1 for _ in acc.vertices(View.OLD)) == 0
+    finally:
+        acc.abort()
+    interp.execute("COMMIT")
+    acc = storage.access()
+    try:
+        assert sum(1 for _ in acc.vertices(View.OLD)) == 64
+    finally:
+        acc.abort()
+
+
+def test_batch_insert_abort_restores_hub_adjacency():
+    storage = InMemoryStorage()
+    acc = storage.access()
+    hub_list, _ = acc.batch_insert(vertices=[((), {})])
+    hub = hub_list[0]
+    acc.commit()
+
+    acc = storage.access()
+    spokes, edges = acc.batch_insert(
+        vertices=[((), {}) for _ in range(10)],
+        edges=[(0, i, hub, None) for i in range(10)])
+    assert len(hub.in_edges) == 10
+    acc.abort()
+    assert len(hub.in_edges) == 0
+    # aborted batch objects are invisible
+    acc = storage.access()
+    try:
+        assert sum(1 for _ in acc.vertices(View.OLD)) == 1
+    finally:
+        acc.abort()
+
+
+# --- deferred index consistency ---------------------------------------------
+
+def test_deferred_index_matches_per_row_insertion():
+    rng = random.Random(11)
+    bulk = InMemoryStorage()
+    row = InMemoryStorage()
+    for st in (bulk, row):
+        lid = st.label_mapper.name_to_id("L")
+        pid = st.property_mapper.name_to_id("k")
+        st.create_label_index(lid)
+        st.create_label_property_index(lid, (pid,))
+    lid = bulk.label_mapper.name_to_id("L")
+    pid = bulk.property_mapper.name_to_id("k")
+
+    for _batch in range(5):
+        specs = [((lid,), {pid: rng.randrange(40)})
+                 for _ in range(rng.randrange(1, 80))]
+        acc = bulk.access()
+        acc.batch_insert(vertices=[(l, dict(p)) for l, p in specs])
+        acc.commit()
+        acc = row.access()
+        for labels, props in specs:
+            va = acc.create_vertex()
+            for l in labels:
+                va.add_label(l)
+            for p, v in props.items():
+                va.set_property(p, v)
+        acc.commit()
+
+    for value in range(40):
+        b = bulk.indices.label_property.candidates_equal(lid, (pid,),
+                                                         [value])
+        r = row.indices.label_property.candidates_equal(lid, (pid,),
+                                                        [value])
+        assert sorted(v.properties[pid] for v in b) == \
+            sorted(v.properties[pid] for v in r)
+    b = bulk.indices.label_property.candidates_range(lid, (pid,), 10, 30)
+    r = row.indices.label_property.candidates_range(lid, (pid,), 10, 30)
+    assert sorted(v.properties[pid] for v in b) == \
+        sorted(v.properties[pid] for v in r)
+    assert bulk.indices.label.approx_count(lid) == \
+        row.indices.label.approx_count(lid)
+
+
+# --- durability: BATCH_INSERT WAL record ------------------------------------
+
+def _wal_config(tmp_path):
+    return StorageConfig(durability_dir=str(tmp_path), wal_enabled=True)
+
+
+def test_batch_wal_record_roundtrip(tmp_path):
+    storage = InMemoryStorage(_wal_config(tmp_path))
+    wal = wire_durability(storage)
+    ictx = InterpreterContext(storage)
+    interp = Interpreter(ictx)
+    interp.execute("UNWIND $ids AS i CREATE (:U {id: i, tag: 'x'})",
+                   {"ids": list(range(200))})
+    interp.execute(
+        "MATCH (a:U {id: 0}), (b:U {id: 1}) "
+        "UNWIND range(1, 3) AS i CREATE (a)-[:F {n: i}]->(b)")
+    wal.close()
+
+    kinds = [k for p in W.list_wal_files(storage)
+             for k, _ in W.iter_wal_records(p)]
+    assert kinds.count(W.OP_BATCH_INSERT) >= 2
+    # the bulk vertices must NOT also appear as per-object records
+    assert kinds.count(W.OP_CREATE_VERTEX) == 0
+
+    restored = InMemoryStorage(_wal_config(tmp_path))
+    recover(restored)
+    interp2 = Interpreter(InterpreterContext(restored))
+    assert _rows(interp2, "MATCH (n:U) RETURN count(n), sum(n.id)") == \
+        [[200, sum(range(200))]]
+    assert _rows(interp2, "MATCH (a:U {id: 0})-[r:F]->(b:U {id: 1}) "
+                          "RETURN count(r), sum(r.n)") == [[3, 6]]
+
+
+def test_truncated_batch_record_is_all_or_nothing(tmp_path):
+    storage = InMemoryStorage(_wal_config(tmp_path))
+    wal = wire_durability(storage)
+    ictx = InterpreterContext(storage)
+    interp = Interpreter(ictx)
+    interp.execute("UNWIND $ids AS i CREATE (:U {id: i})",
+                   {"ids": list(range(50))})
+    interp.execute("UNWIND $ids AS i CREATE (:V {id: i})",
+                   {"ids": list(range(70))})
+    wal.close()
+    # crash mid-write of the second transaction: truncate inside its frame
+    path = W.list_wal_files(storage)[0]
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:len(data) - 37])
+
+    restored = InMemoryStorage(_wal_config(tmp_path))
+    recover(restored)
+    interp2 = Interpreter(InterpreterContext(restored))
+    # first batch fully present, torn batch fully absent
+    assert _rows(interp2, "MATCH (n:U) RETURN count(n)") == [[50]]
+    assert _rows(interp2, "MATCH (n:V) RETURN count(n)") == [[0]]
+
+
+_CRASH_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+from memgraph_tpu.storage.durability.recovery import wire_durability
+
+storage = InMemoryStorage(StorageConfig(durability_dir={ddir!r},
+                                        wal_enabled=True))
+wire_durability(storage)
+interp = Interpreter(InterpreterContext(storage))
+interp.execute("UNWIND $ids AS i CREATE (:C {{id: i}})",
+               {{"ids": list(range(300))}})
+# die WITHOUT closing anything the moment the batch commit returned
+os.kill(os.getpid(), 9)
+"""
+
+
+def test_crash_recovery_after_batch_commit(tmp_path):
+    script = _CRASH_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ddir=str(tmp_path))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, timeout=120)
+    assert proc.returncode == -9, proc.stderr.decode()
+
+    restored = InMemoryStorage(_wal_config(tmp_path))
+    recover(restored)
+    interp = Interpreter(InterpreterContext(restored))
+    # the fsynced BATCH_INSERT record replays all-or-nothing: every row
+    assert _rows(interp, "MATCH (n:C) RETURN count(n)") == [[300]]
+
+
+# --- replication -------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_replica_applies_batch_like_per_row():
+    main_ictx = InterpreterContext(InMemoryStorage())
+    replica_ictx = InterpreterContext(InMemoryStorage())
+    main = Interpreter(main_ictx)
+    replica = Interpreter(replica_ictx)
+    port = _free_port()
+    replica.execute(f"SET REPLICATION ROLE TO REPLICA WITH PORT {port}")
+    try:
+        main.execute(f'REGISTER REPLICA r1 SYNC TO "127.0.0.1:{port}"')
+        main.execute("CREATE INDEX ON :U(id)")
+        main.execute("UNWIND $ids AS i CREATE (:U {id: i, b: i * 2})",
+                     {"ids": list(range(150))})
+        main.execute(
+            "UNWIND $pairs AS p MATCH (a:U {id: p[0]}), (b:U {id: p[1]}) "
+            "CREATE (a)-[:F]->(b)",
+            {"pairs": [[i, (i + 1) % 150] for i in range(150)]})
+        # SYNC replication: applied on commit; verify equivalence with the
+        # per-row representation of the same data
+        ref_ictx = InterpreterContext(InMemoryStorage())
+        ref = Interpreter(ref_ictx)
+        for i in range(150):
+            ref.execute("CREATE (:U {id: $i, b: $b})",
+                        {"i": i, "b": i * 2})
+        for q in ("MATCH (n:U) RETURN count(n), sum(n.id), sum(n.b)",):
+            assert _rows(replica, q) == _rows(ref, q) == _rows(main, q)
+        assert _rows(replica, "MATCH (a)-[:F]->(b) "
+                              "RETURN count(*), sum(a.id), sum(b.id)") == \
+            _rows(main, "MATCH (a)-[:F]->(b) "
+                        "RETURN count(*), sum(a.id), sum(b.id)")
+        # replica scans through ITS indexes must see batch rows
+        assert _rows(replica, "MATCH (n:U {id: 42}) RETURN n.b") == [[84]]
+    finally:
+        if getattr(replica_ictx, "replication", None) and \
+                replica_ictx.replication.replica_server:
+            replica_ictx.replication.replica_server.stop()
+        if getattr(main_ictx, "replication", None):
+            for c in main_ictx.replication.replicas.values():
+                c.close()
+
+
+# --- supernode adjacency ----------------------------------------------------
+
+def test_supernode_adjacency_fast_path_consistency():
+    from memgraph_tpu.storage.objects import ADJ_INDEX_THRESHOLD
+    _ictx, interp = _db()
+    interp.execute("CREATE INDEX ON :S(id)")
+    interp.execute("CREATE INDEX ON :N(id)")
+    interp.execute("CREATE (:S {id: 0})")
+    n = ADJ_INDEX_THRESHOLD * 3
+    interp.execute(
+        "MATCH (s:S {id: 0}) UNWIND range(0, $n - 1) AS i "
+        "CREATE (s)<-[:E]-(:N {id: i})", {"n": n})
+    # bound-endpoint lookup (exercises the adjacency map)
+    for i in (0, 7, n - 1):
+        assert _rows(interp, "MATCH (s:S {id: 0})<-[r:E]-(n:N {id: $i}) "
+                             "RETURN count(r)", {"i": i}) == [[1]]
+    assert _rows(interp, "MATCH (s:S {id: 0})<-[r:E]-(n:N {id: $i}) "
+                         "RETURN count(r)", {"i": n + 5}) == [[0]]
+    # MERGE: existing edge is found (no duplicate), new edge is created
+    interp.execute("MATCH (s:S {id: 0}), (n:N {id: 3}) MERGE (s)<-[:E]-(n)")
+    assert _rows(interp, "MATCH (s:S {id: 0})<-[:E]-(m) "
+                         "RETURN count(m)") == [[n]]
+    interp.execute("CREATE (:N {id: $i})", {"i": n})
+    interp.execute("MATCH (s:S {id: 0}), (n:N {id: $i}) "
+                   "MERGE (s)<-[:E]-(n)", {"i": n})
+    assert _rows(interp, "MATCH (s:S {id: 0})<-[:E]-(m) "
+                         "RETURN count(m)") == [[n + 1]]
+    # deletion keeps the map consistent
+    interp.execute("MATCH (s:S {id: 0})<-[r:E]-(n:N {id: 5}) DELETE r")
+    assert _rows(interp, "MATCH (s:S {id: 0})<-[r:E]-(n:N {id: 5}) "
+                         "RETURN count(r)") == [[0]]
+    assert _rows(interp, "MATCH (s:S {id: 0})<-[:E]-(m) "
+                         "RETURN count(m)") == [[n]]
+
+
+def test_props_only_materialization_keeps_edge_semantics():
+    # labels/property reads skip adjacency copies; edge reads still work
+    _ictx, interp = _db()
+    interp.execute("CREATE (:A {x: 1})-[:R]->(:B {y: 2})")
+    interp.execute("BEGIN")
+    interp.execute("MATCH (a:A) SET a.x = 10")
+    # own-transaction read sees the write AND the adjacency
+    assert _rows(interp, "MATCH (a:A)-[:R]->(b:B) RETURN a.x, b.y") == \
+        [[10, 2]]
+    interp.execute("ROLLBACK")
+    assert _rows(interp, "MATCH (a:A)-[:R]->(b) RETURN a.x") == [[1]]
+
+
+def test_explicit_txn_multi_batch_wal_replay(tmp_path):
+    """Two batch records in ONE transaction, the second's edges pointing
+    at the first's vertices — replay must resolve across records."""
+    storage = InMemoryStorage(_wal_config(tmp_path))
+    wal = wire_durability(storage)
+    interp = Interpreter(InterpreterContext(storage))
+    interp.execute("CREATE INDEX ON :T(id)")
+    interp.execute("BEGIN")
+    interp.execute("UNWIND $ids AS i CREATE (:T {id: i})",
+                   {"ids": list(range(20))})
+    interp.execute(
+        "UNWIND $pairs AS p MATCH (a:T {id: p[0]}), (b:T {id: p[1]}) "
+        "CREATE (a)-[:F]->(b)",
+        {"pairs": [[i, (i + 1) % 20] for i in range(20)]})
+    interp.execute("COMMIT")
+    wal.close()
+
+    restored = InMemoryStorage(_wal_config(tmp_path))
+    recover(restored)
+    interp2 = Interpreter(InterpreterContext(restored))
+    assert _rows(interp2, "MATCH (n:T) RETURN count(n)") == [[20]]
+    assert _rows(interp2, "MATCH (a:T)-[:F]->(b:T) "
+                          "RETURN count(*), sum(a.id)") == \
+        [[20, sum(range(20))]]

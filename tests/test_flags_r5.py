@@ -116,6 +116,25 @@ class TestInterpreterFlags:
             interp.execute("UNWIND range(1, 100) AS i RETURN sum(i)")
         assert any("slow query" in r.message for r in caplog.records)
 
+    def test_slow_log_never_leaks_credentials(self, caplog):
+        """AUTH statements are skipped entirely; other queries have their
+        string literals redacted (the monitoring websocket re-broadcasts
+        every INFO record, so plaintext secrets must never reach it)."""
+        from memgraph_tpu.auth.auth import Auth
+        ictx = InterpreterContext(
+            InMemoryStorage(), {"log_min_duration_ms": 0.0001})
+        ictx.auth_store = Auth()   # session-local: don't leak users
+        interp = Interpreter(ictx)
+        interp.username = "alice"
+        with caplog.at_level(logging.INFO,
+                             logger="memgraph_tpu.query.interpreter"):
+            interp.execute("CREATE USER alice IDENTIFIED BY 's3cret'")
+            interp.execute("RETURN 'sensitive-literal' AS x")
+        messages = [r.getMessage() for r in caplog.records]
+        assert not any("s3cret" in m for m in messages)
+        assert not any("sensitive-literal" in m for m in messages)
+        assert any("slow query" in m and "'***'" in m for m in messages)
+
     def test_log_query_plan(self, caplog):
         interp = Interpreter(InterpreterContext(
             InMemoryStorage(), {"log_query_plan": True}))

@@ -189,3 +189,26 @@ def test_e2e_through_main(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_broadcast_never_blocks_caller(server):
+    """A stalled client must not delay logging threads: broadcast() only
+    enqueues; the dedicated drain thread owns every network send."""
+    c = WSClient(server.port)
+    time.sleep(0.2)              # session registered
+    t0 = time.time()
+    for i in range(200):
+        server.broadcast({"event": "log", "message": f"m{i}"})
+    # 200 enqueues complete far faster than one 5s send timeout
+    assert time.time() - t0 < 1.0
+    got = c.recv_json()
+    assert got["event"] == "log"
+    c.close()
+
+
+def test_full_queue_drops_records_not_callers():
+    srv = MonitoringServer("127.0.0.1", 0)
+    # not started: no drain thread, so the queue fills deterministically
+    for i in range(srv.QUEUE_CAPACITY + 50):
+        srv.broadcast({"event": "log", "message": str(i)})
+    assert srv.dropped_records == 50

@@ -82,6 +82,30 @@ def test_worker_error_transport(ictx):
         ex.close()
 
 
+def test_write_queries_rejected_loudly(ictx):
+    """Misrouted writes must fail, not vanish into the forked snapshot."""
+    ex = MPReadExecutor(ictx, n_workers=1)
+    try:
+        with pytest.raises(RuntimeError, match="read-only"):
+            ex.execute("CREATE (:Ghost {id: 1})")
+        with pytest.raises(RuntimeError, match="read-only"):
+            ex.execute("MATCH (n:User {id: 1}) SET n.age = 99")
+        # non-Cypher statements (auth/DDL) are refused before prepare
+        with pytest.raises(RuntimeError, match="read-only"):
+            ex.execute("CREATE INDEX ON :User(id)")
+        with pytest.raises(RuntimeError, match="read-only"):
+            ex.execute("CREATE USER ghost IDENTIFIED BY 'pw'")
+        # worker still serves reads afterwards
+        _, rows = ex.execute("MATCH (n:User) RETURN count(n)")
+        assert rows == [[100]]
+    finally:
+        ex.close()
+    # nothing leaked into the parent either
+    _, rows, _ = Interpreter(ictx).execute(
+        "MATCH (n:Ghost) RETURN count(n)")
+    assert rows == [[0]]
+
+
 def test_close_idempotent(ictx):
     ex = MPReadExecutor(ictx, n_workers=1)
     ex.close()
