@@ -15,10 +15,12 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import random
 import socket
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 
 from ..replication import protocol as P
@@ -56,12 +58,24 @@ class RaftNode:
     def __init__(self, node_id: str, host: str, port: int,
                  peers: dict[str, tuple[str, int]], apply_fn=None,
                  kvstore=None, snapshot_fn=None, restore_fn=None,
-                 compaction_threshold: int | None = None):
+                 compaction_threshold: int | None = None,
+                 election_seed: int | None = None):
         self.node_id = node_id
         self.host = host
         self.port = port
         self.peers = dict(peers)
         self.apply_fn = apply_fn or (lambda cmd: None)
+        # seedable election jitter: fault-injection cluster tests need the
+        # timeout schedule to replay exactly (MEMGRAPH_TPU_RAFT_SEED as
+        # env fallback; the node_id keeps same-seed nodes from tying)
+        if election_seed is None:
+            env_seed = os.environ.get("MEMGRAPH_TPU_RAFT_SEED")
+            if env_seed is not None:
+                # crc32, not hash(): per-node derivation must replay
+                # across processes (PYTHONHASHSEED salts str hashing)
+                election_seed = int(env_seed) ^ zlib.crc32(
+                    node_id.encode("utf-8"))
+        self._rng = random.Random(election_seed)
         # log compaction (Raft §7; reference: coordinator_log_store.cpp +
         # raft_state.cpp:370 install-snapshot): snapshot_fn() returns a
         # JSON-able state-machine snapshot, restore_fn(state) replaces the
@@ -139,7 +153,7 @@ class RaftNode:
             t.join(timeout=2)
 
     def _new_deadline(self) -> float:
-        return time.monotonic() + random.uniform(*self.ELECTION_TIMEOUT)
+        return time.monotonic() + self._rng.uniform(*self.ELECTION_TIMEOUT)
 
     # --- durability (Raft persistent state) ---------------------------------
 
@@ -292,6 +306,12 @@ class RaftNode:
 
     def _call_peer(self, peer_id: str, request: dict,
                    timeout: float = 0.5) -> dict | None:
+        from ..utils import faultinject as FI
+        try:
+            if FI.fire("raft.rpc") == "drop":
+                return None  # RPC lost on the wire
+        except FI.FaultInjected:
+            return None      # injected network fault == unreachable peer
         host, port = self.peers[peer_id]
         data = json.dumps(request).encode("utf-8")
         # first attempt reuses the pooled connection (may be stale if the

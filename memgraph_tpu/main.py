@@ -51,6 +51,9 @@ def build_config(argv=None) -> argparse.Namespace:
                    choices=[l.value for l in IsolationLevel])
     p.add_argument("--storage-wal-enabled",
                    action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--storage-wal-file-size-kib", type=int, default=65536,
+                   help="WAL segment rotation size (KiB); old segments "
+                        "are pruned once a snapshot covers them")
     p.add_argument("--storage-snapshot-on-exit",
                    action=argparse.BooleanOptionalAction, default=False)
     p.add_argument("--storage-recover-on-startup",
@@ -274,6 +277,7 @@ def build_database(args) -> InterpreterContext:
         isolation_level=IsolationLevel(args.isolation_level),
         durability_dir=args.data_directory,
         wal_enabled=bool(args.storage_wal_enabled and args.data_directory),
+        wal_segment_size=args.storage_wal_file_size_kib * 1024,
         snapshot_on_exit=args.storage_snapshot_on_exit,
         properties_on_edges=args.storage_properties_on_edges,
         snapshot_retention_count=args.storage_snapshot_retention_count,

@@ -22,8 +22,12 @@ import logging
 import queue
 import socket
 import threading
+import time
 from collections import deque
 
+from ..observability.metrics import global_metrics
+from ..utils import faultinject as FI
+from ..utils.retry import RetryPolicy
 from . import protocol as P
 
 log = logging.getLogger(__name__)
@@ -57,6 +61,16 @@ class ReplicaClient:
         self.storage = storage
         self.status = ReplicaStatus.INVALID
         self.last_acked_ts = 0
+        # self-healing: ONE shared backoff policy for every RPC site and
+        # the reconnect loop (replaces the old per-site except blocks);
+        # exhausting it lets a STRICT_SYNC replica degrade instead of
+        # wedging commits forever
+        self.retry_policy = RetryPolicy(base_delay=0.1, max_delay=5.0,
+                                        max_retries=5)
+        self.failures = 0              # consecutive failed RPCs
+        self.degraded_from_strict = False
+        self._reconnect_attempts = 0
+        self._next_reconnect_at = 0.0
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self._queue: "queue.Queue[bytes]" = queue.Queue(maxsize=10_000)
@@ -173,6 +187,52 @@ class ReplicaClient:
             finally:
                 self.storage.config.durability_dir = old
 
+    # --- unified failure / health bookkeeping -------------------------------
+
+    def _mark_failed(self, op: str, exc: BaseException) -> None:
+        """One handler for every RPC failure site: count it, mark the
+        client INVALID (the heartbeat loop reconnects with backoff), and
+        export health so operators see it without grepping logs."""
+        self.failures += 1
+        self.status = ReplicaStatus.INVALID
+        global_metrics.increment("replication.rpc_failures")
+        global_metrics.set_gauge(
+            f"replication.replica_health.{self.name}", 0.0)
+        log.warning("replica %s %s failed (%d consecutive): %s",
+                    self.name, op, self.failures, exc)
+
+    def _note_ack(self, last_commit_ts: int) -> None:
+        """Every successful ack resets the failure streak and refreshes
+        the exported lag/health gauges."""
+        self.last_acked_ts = last_commit_ts
+        self.failures = 0
+        self._reconnect_attempts = 0
+        self._next_reconnect_at = 0.0
+        lag = max(0, self.storage.latest_commit_ts() - last_commit_ts)
+        global_metrics.set_gauge(
+            f"replication.replica_lag.{self.name}", float(lag))
+        global_metrics.set_gauge(
+            f"replication.replica_health.{self.name}", 1.0)
+
+    def reconnect_due(self, now: float) -> bool:
+        return now >= self._next_reconnect_at
+
+    def note_reconnect_attempt(self, ok: bool) -> None:
+        if ok:
+            self._reconnect_attempts = 0
+            self._next_reconnect_at = 0.0
+            return
+        delay = self.retry_policy.delay_for(
+            min(self._reconnect_attempts, self.retry_policy.max_retries))
+        self._reconnect_attempts += 1
+        self._next_reconnect_at = time.monotonic() + delay
+
+    def retry_budget_exhausted(self) -> bool:
+        """True once failures + backoff reconnect attempts blow past the
+        policy budget — the trigger for STRICT_SYNC degradation."""
+        return (self.failures + self._reconnect_attempts
+                > self.retry_policy.max_retries)
+
     # --- commit shipping ----------------------------------------------------
 
     def ship(self, frame: bytes) -> bool:
@@ -239,26 +299,30 @@ class ReplicaClient:
 
     def _send_system_locked(self, txn: dict) -> bool:
         try:
+            if FI.fire("repl.send") == "drop":
+                raise FI.FaultInjected("injected drop of system txn")
             P.send_json(self._sock, P.MSG_SYSTEM, txn)
             msg_type, _ = P.recv_frame(self._sock)
             return msg_type == P.MSG_ACK
         except (ConnectionError, OSError) as e:
-            log.warning("replica %s system txn failed: %s", self.name, e)
-            self.status = ReplicaStatus.INVALID
+            self._mark_failed("system txn", e)
             return False
 
     def _send_frame_locked(self, frame: bytes) -> bool:
         try:
+            if FI.fire("repl.send") == "drop":
+                # the frame is lost before hitting the wire; the ack
+                # timeout/reconnect path must re-ship it via catch-up
+                raise FI.FaultInjected("injected drop of WAL frame")
             P.send_frame(self._sock, P.MSG_WAL_FRAME, frame)
             msg_type, payload = P.recv_frame(self._sock)
             if msg_type == P.MSG_ACK:
-                self.last_acked_ts = P.parse_json(payload)["last_commit_ts"]
+                self._note_ack(P.parse_json(payload)["last_commit_ts"])
                 return True
-            self.status = ReplicaStatus.INVALID
+            self._mark_failed("frame ship", ValueError(f"nack {msg_type}"))
             return False
         except (ConnectionError, OSError) as e:
-            log.warning("replica %s unreachable: %s", self.name, e)
-            self.status = ReplicaStatus.INVALID
+            self._mark_failed("frame ship", e)
             return False
 
     # --- 2PC (STRICT_SYNC) --------------------------------------------------
@@ -279,14 +343,15 @@ class ReplicaClient:
                 old = self._sock.gettimeout()
                 self._sock.settimeout(self.TWO_PC_RPC_TIMEOUT_SEC)
                 try:
+                    if FI.fire("repl.send") == "drop":
+                        raise FI.FaultInjected("injected drop of prepare")
                     P.send_frame(self._sock, P.MSG_PREPARE, frame)
                     msg_type, payload = P.recv_frame(self._sock)
                 finally:
                     self._sock.settimeout(old)
                 return msg_type == P.MSG_ACK
             except (ConnectionError, OSError) as e:
-                log.warning("replica %s prepare failed: %s", self.name, e)
-                self.status = ReplicaStatus.INVALID
+                self._mark_failed("prepare", e)
                 return False
 
     def finalize(self, commit_ts: int, decision: str) -> bool:
@@ -305,12 +370,13 @@ class ReplicaClient:
                     self._sock.settimeout(old)
                 if msg_type == P.MSG_ACK:
                     if decision == "commit":
-                        self.last_acked_ts = P.parse_json(
-                            payload)["last_commit_ts"]
+                        self._note_ack(P.parse_json(
+                            payload)["last_commit_ts"])
                     return True
+                self._mark_failed("finalize", ValueError(f"nack {msg_type}"))
+                return False
             except (ConnectionError, OSError) as e:
-                log.warning("replica %s finalize failed: %s", self.name, e)
-            self.status = ReplicaStatus.INVALID
+                self._mark_failed("finalize", e)
             return False
 
     def _drain_loop(self) -> None:
@@ -339,12 +405,13 @@ class ReplicaClient:
                 finally:
                     self._sock.settimeout(old)
                 if msg_type == P.MSG_ACK:
-                    self.last_acked_ts = P.parse_json(
-                        payload)["last_commit_ts"]
+                    self._note_ack(P.parse_json(payload)["last_commit_ts"])
                     return True
-            except (ConnectionError, OSError):
-                pass
-            self.status = ReplicaStatus.INVALID
+                self._mark_failed("heartbeat",
+                                  ValueError(f"nack {msg_type}"))
+                return False
+            except (ConnectionError, OSError) as e:
+                self._mark_failed("heartbeat", e)
             return False
 
     def close(self) -> None:
@@ -551,10 +618,12 @@ class ReplicationState:
             for c in clients:
                 if c.status is ReplicaStatus.READY:
                     c.heartbeat()
-                elif c.status is ReplicaStatus.INVALID:
+                elif c.status is ReplicaStatus.INVALID and \
+                        c.reconnect_due(time.monotonic()):
                     # auto-reconnect on a per-replica worker thread: one
                     # dead replica's connect timeout or long snapshot
-                    # transfer must not stall heartbeats to the others
+                    # transfer must not stall heartbeats to the others;
+                    # attempts back off per the client's RetryPolicy
                     self._spawn_reconnect(c)
 
     def _spawn_reconnect(self, client) -> None:
@@ -587,9 +656,11 @@ class ReplicationState:
                 if not still_ours:
                     client.close()
                 else:
+                    client.note_reconnect_attempt(True)
                     log.info("replica %s reconnected via %s catch-up",
                              client.name, client.catchup_used)
             except Exception:
+                client.note_reconnect_attempt(False)
                 log.debug("replica %s reconnect failed", client.name,
                           exc_info=True)
             finally:
@@ -663,16 +734,27 @@ class ReplicationState:
         # unavailable too: with heartbeat auto-reconnect a replica can sit
         # mid-catch-up at commit time, and if that catch-up fails a
         # buffered frame would be silently lost after MAIN committed.
+        # Graceful degradation: a replica that has already exhausted its
+        # retry budget is DEMOTED to ASYNC catch-up instead of wedging
+        # every future commit (loud metric + log; catch-up re-ships what
+        # it missed once it returns).
         down = [c for c in all_strict if c.status is not ReplicaStatus.READY]
-        if down:
+        still_down = []
+        for c in down:
+            if c.retry_budget_exhausted():
+                self._demote_strict(c)
+            else:
+                still_down.append(c)
+        if still_down:
             from ..exceptions import TransactionException
             raise TransactionException(
                 "STRICT_SYNC replica(s) unavailable: "
-                + ", ".join(c.name for c in down)
+                + ", ".join(c.name for c in still_down)
                 + " — transaction aborted (drop the replica or restore it)")
-        # every strict client is READY here (the vote above aborts
-        # otherwise)
-        strict = all_strict
+        # every remaining strict client is READY here (the vote above
+        # aborts otherwise; demoted clients left the strict set)
+        strict = [c for c in all_strict
+                  if c.mode is ReplicationMode.STRICT_SYNC]
         if not strict:
             return
         prepared = []
@@ -690,6 +772,21 @@ class ReplicationState:
                 "STRICT_SYNC replica(s) did not confirm the prepare phase: "
                 + ", ".join(c.name for c in failed)
                 + " — transaction aborted")
+
+    def _demote_strict(self, client) -> None:
+        """STRICT_SYNC → ASYNC-catchup degradation: acknowledged commits
+        stop waiting for a replica that exhausted its retry budget. Loud
+        by design — an operator must notice the durability downgrade."""
+        client.mode = ReplicationMode.ASYNC
+        client.degraded_from_strict = True
+        global_metrics.increment("replication.strict_sync_demotions")
+        global_metrics.set_gauge(
+            f"replication.replica_degraded.{client.name}", 1.0)
+        log.error(
+            "STRICT_SYNC replica %s exhausted its retry budget "
+            "(max_retries=%d) — DEMOTED to ASYNC catch-up; commits no "
+            "longer wait for its vote (re-register to restore strictness)",
+            client.name, client.retry_policy.max_retries)
 
     def _on_commit_abort(self, commit_ts: int) -> None:
         """Commit failed after the 2PC vote succeeded (e.g. the WAL write

@@ -94,6 +94,13 @@ class ReplicaServer:
             conn = wrap_cluster_server(conn)
             while not self._stop.is_set():
                 msg_type, payload = P.recv_frame(conn)
+                # armed repl.recv faults sever the connection before the
+                # received frame is applied or acked (a lost-frame /
+                # crashed-replica stand-in) — the MAIN must heal via its
+                # retry/catch-up path
+                from ..utils import faultinject as FI
+                if FI.fire("repl.recv") == "drop":
+                    raise FI.FaultInjected("injected drop of received frame")
                 if msg_type == P.MSG_REGISTER:
                     info = P.parse_json(payload)
                     self.epoch = info.get("epoch")

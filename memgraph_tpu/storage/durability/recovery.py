@@ -8,6 +8,7 @@ transactions with commit_ts greater than the snapshot timestamp.
 
 from __future__ import annotations
 
+import logging
 import os
 from io import BytesIO
 
@@ -17,10 +18,17 @@ from .snapshot import list_snapshots, load_snapshot
 from . import wal as W
 from ..property_store import _read_varint, decode_value
 
+log = logging.getLogger(__name__)
+
 
 def recover(storage) -> dict:
-    """Full recovery into an (assumed empty) storage. Returns stats."""
-    stats = {"snapshot": None, "wal_transactions": 0}
+    """Full recovery into an (assumed empty) storage. Returns stats.
+
+    WAL segments replay streamed (constant memory) in seqnum order; a
+    damaged record truncates that segment's replay at the last complete
+    transaction before it, and a hole in the segment chain refuses
+    recovery outright (replaying around it would forge history)."""
+    stats = {"snapshot": None, "wal_transactions": 0, "wal_corruption": []}
     snaps = list_snapshots(storage)
     snapshot_ts = 0
     if snaps:
@@ -29,8 +37,12 @@ def recover(storage) -> dict:
         _apply_snapshot(storage, data)
         snapshot_ts = data["timestamp"]
         stats["snapshot"] = path
-    for wal_path in W.list_wal_files(storage):
-        for commit_ts, ops in W.iter_wal_transactions(wal_path):
+    segments = W.list_wal_segments(storage)
+    W.check_segment_chain(segments)
+    for wal_path, _seq in segments:
+        def note(reason, offset, _p=wal_path):
+            stats["wal_corruption"].append((_p, reason, offset))
+        for commit_ts, ops in W.iter_wal_transactions(wal_path, note):
             if commit_ts <= snapshot_ts:
                 continue
             _apply_wal_txn(storage, ops)
@@ -82,9 +94,21 @@ def recover_snapshot_from(storage, source: str) -> None:
 
     if source.startswith(("http://", "https://")):
         import urllib.request
-        try:
+        from ...utils.retry import RetryPolicy
+
+        def _download():
             with urllib.request.urlopen(source, timeout=60) as resp:
-                path, data = _stage(resp.read)
+                return _stage(resp.read)
+
+        try:
+            # transient fetch failures (droppy link, restarting peer) get
+            # a bounded backoff instead of failing the whole RECOVER
+            path, data = RetryPolicy(
+                base_delay=0.2, max_delay=5.0, max_retries=3).call(
+                _download,
+                on_retry=lambda attempt, e: log.warning(
+                    "snapshot download from %s failed (attempt %d): %s — "
+                    "retrying", source, attempt + 1, e))
         except OSError as e:   # URLError/HTTPError/timeouts subclass this
             raise DurabilityError(
                 f"cannot fetch snapshot from {source!r}: {e}") from e
@@ -366,4 +390,6 @@ def wire_durability(storage) -> "W.WalFile | None":
         return None
     wal_file = W.WalFile(storage)
     storage.wal_sink = wal_file.sink
+    # snapshot-time WAL retention needs the active segment path
+    storage.wal_file = wal_file
     return wal_file

@@ -252,17 +252,36 @@ def create_snapshot(storage) -> str:
     finally:
         acc.abort()
 
+    # atomic publish: tmp write + fsync + rename + directory fsync — a
+    # crash at any point leaves either the old snapshot set or the new
+    # one, never a half-written "latest"
     path = os.path.join(snapshot_dir(storage),
                         f"snapshot_{int(time.time() * 1e6)}_{ts}.mgsnap")
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    from ...utils import faultinject as FI
+    from . import wal as W
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        FI.fire("snapshot.rename")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    W.fsync_dir(snapshot_dir(storage))
     _apply_retention(storage,
                      keep=getattr(storage.config,
                                   'snapshot_retention_count', 3))
+    # WAL retention rides the snapshot cadence: segments fully covered by
+    # this snapshot will never be replayed again
+    wal_file = getattr(storage, "wal_file", None)
+    W.prune_wal_segments(storage, ts,
+                         active_path=wal_file.path if wal_file else None)
     return path
 
 

@@ -8,21 +8,47 @@ the *final state* of every object it touched (create/state/delete records).
 Replay is idempotent per record, which also makes these records directly
 shippable to replicas (replication reuses this encoder).
 
-Record framing: [u32 length][u8 kind][payload]; txn frame:
+Record framing v2: [u32 length][u8 kind][u32 crc32][payload] where
+length = 5 + len(payload) and the CRC covers kind + payload; txn frame:
   TXN_BEGIN(commit_ts) op* TXN_END(commit_ts)
 fsync policy: every commit (default) or batched.
+
+On-disk WAL segments (v2) carry a 19-byte header —
+  [9s magic "MGTPUWAL2"][u16 version][u64 seqnum]
+— and are named wal_<seqnum:012d>.mgwal with a monotonic segment
+sequence number persisted by the filenames themselves (the previous
+wall-clock-microsecond names could collide or reorder across a clock
+step). Segments rotate at StorageConfig.wal_segment_size bytes; closed
+segments whose every transaction is covered by the newest snapshot are
+pruned (oldest-first only, so the seqnum chain never gets a hole).
+Recovery streams each segment in chunks, verifies per-record CRCs,
+truncates at the first damaged record (logging what it dropped), and
+refuses a seqnum gap in the chain. Legacy headerless v1 files
+([u32 length][u8 kind][payload], no CRC) remain readable.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
+import zlib
 from io import BytesIO
 
 from ...exceptions import DurabilityError
+from ...utils import faultinject as FI
 from ..property_store import _read_varint, _write_varint, decode_value, \
     encode_value
+
+log = logging.getLogger(__name__)
+
+WAL_MAGIC = b"MGTPUWAL2"
+WAL_VERSION = 2
+_HEADER_LEN = len(WAL_MAGIC) + 10          # magic + u16 version + u64 seq
+_RECORD_HEADER = struct.Struct("<IBI")     # length, kind, crc32
+_MAX_RECORD_BYTES = 1 << 30                # length-field sanity bound
+DEFAULT_SEGMENT_SIZE = 64 * 1024 * 1024
 
 OP_TXN_BEGIN = 0x01
 OP_TXN_END = 0x02
@@ -34,6 +60,16 @@ OP_EDGE_STATE = 0x21        # gid, props
 OP_DELETE_EDGE = 0x22       # gid
 OP_MAPPER_SYNC = 0x30       # label/property/edge-type name tables
 OP_BATCH_INSERT = 0x40      # one bulk-insert batch, columnar layout
+
+
+def _crc(kind: int, payload: bytes) -> int:
+    return zlib.crc32(payload, zlib.crc32(bytes((kind,))))
+
+
+def frame_record(kind: int, payload: bytes) -> bytes:
+    """One v2 record: [u32 length][u8 kind][u32 crc32][payload]."""
+    return _RECORD_HEADER.pack(len(payload) + 5, kind,
+                               _crc(kind, payload)) + payload
 
 
 def _encode_batch_insert(batch, deleted_v, deleted_e) -> bytes:
@@ -167,8 +203,7 @@ def encode_txn_ops(storage, txn, commit_ts: int) -> bytes:
     buf = BytesIO()
 
     def frame(kind: int, payload: bytes) -> None:
-        buf.write(struct.pack("<IB", len(payload) + 1, kind))
-        buf.write(payload)
+        buf.write(frame_record(kind, payload))
 
     p = BytesIO()
     _write_varint(p, commit_ts)
@@ -258,8 +293,22 @@ def encode_txn_ops(storage, txn, commit_ts: int) -> bytes:
     return buf.getvalue()
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename/create inside it is durable (a
+    crashed rename otherwise may resurrect the old directory entry)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class WalFile:
-    """Append-only WAL writer with fsync-per-commit (configurable)."""
+    """Append-only segmented WAL writer with fsync-per-commit
+    (configurable) and size-based rotation."""
 
     def __init__(self, storage, sync_every_commit: bool = True) -> None:
         base = storage.config.durability_dir
@@ -267,52 +316,213 @@ class WalFile:
             raise DurabilityError("durability_dir is not configured")
         self.dir = os.path.join(base, "wal")
         os.makedirs(self.dir, exist_ok=True)
-        import time
-        self.path = os.path.join(self.dir,
-                                 f"wal_{int(time.time() * 1e6)}.mgwal")
-        self._file = open(self.path, "ab")
+        self.segment_size = getattr(storage.config, "wal_segment_size",
+                                    DEFAULT_SEGMENT_SIZE)
         self._lock = threading.Lock()
         self.sync_every_commit = sync_every_commit
         self.storage = storage
+        self._seq = next_segment_seq(self.dir)
+        self._open_segment()
+
+    def _open_segment(self) -> None:
+        self.path = os.path.join(self.dir, f"wal_{self._seq:012d}.mgwal")
+        self._file = open(self.path, "ab")
+        if self._file.tell() == 0:
+            self._file.write(WAL_MAGIC
+                             + struct.pack("<HQ", WAL_VERSION, self._seq))
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            fsync_dir(self.dir)  # the new segment's dirent is durable
 
     def sink(self, frame: bytes, commit_ts: int) -> None:
         """storage.wal_sink hook: frame pre-encoded under the engine lock."""
+        from ...observability.metrics import global_metrics
         with self._lock:
-            self._file.write(frame)
+            FI.faulty_write("wal.write", self._file, frame)
             self._file.flush()
             if self.sync_every_commit:
+                FI.fire("wal.fsync")
+                import time
+                t0 = time.perf_counter()
                 os.fsync(self._file.fileno())
+                global_metrics.observe("wal.fsync_latency_sec",
+                                       time.perf_counter() - t0)
+            if self._file.tell() >= self.segment_size:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        from ...observability.metrics import global_metrics
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._file.close()
+        self._seq += 1
+        self._open_segment()
+        global_metrics.increment("wal.segments_rotated")
+
+    def rotate(self) -> str:
+        """Force a rotation (returns the new active segment path)."""
+        with self._lock:
+            self._rotate_locked()
+            return self.path
 
     def close(self) -> None:
         with self._lock:
             self._file.close()
 
 
-def iter_records_from_bytes(data: bytes):
-    """Yield (kind, payload_bytes) frames; tolerates a truncated tail."""
+# --- reading ---------------------------------------------------------------
+
+
+def iter_records_from_bytes(data: bytes, on_corruption=None):
+    """Yield (kind, payload_bytes) v2 records from an in-memory frame;
+    stops cleanly at a truncated tail or the first bad-CRC record
+    (invoking on_corruption(reason, offset) if given)."""
     pos = 0
     n = len(data)
-    while pos + 5 <= n:
-        (length, kind) = struct.unpack_from("<IB", data, pos)
-        payload_len = length - 1
-        start = pos + 5
+    while pos + 9 <= n:
+        length, kind, crc = _RECORD_HEADER.unpack_from(data, pos)
+        if length < 5 or length > _MAX_RECORD_BYTES:
+            if on_corruption:
+                on_corruption("bad record length", pos)
+            return
+        payload_len = length - 5
+        start = pos + 9
         if start + payload_len > n:
-            break  # truncated tail (crash mid-write) — stop cleanly
-        yield kind, data[start:start + payload_len]
+            if on_corruption:
+                on_corruption("truncated record", pos)
+            return  # torn tail (crash mid-write) — stop cleanly
+        payload = data[start:start + payload_len]
+        if _crc(kind, payload) != crc:
+            if on_corruption:
+                on_corruption("crc mismatch", pos)
+            return
+        yield kind, payload
         pos = start + payload_len
 
 
-def iter_wal_records(path: str):
+def _iter_records_stream(f, first: bytes, base_offset: int,
+                         on_corruption=None, chunk_size: int = 1 << 20):
+    """Stream v2 records from an open file in chunks — recovery of a
+    multi-GB segment must not double peak RSS by slurping the file."""
+    buf = bytearray(first)
+    off = 0            # parse position inside buf
+    consumed = base_offset   # absolute file offset of buf[0]
+    eof = False
+
+    def fill(need: int) -> bool:
+        nonlocal eof
+        while len(buf) - off < need and not eof:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                eof = True
+            else:
+                buf.extend(chunk)
+        return len(buf) - off >= need
+
+    while True:
+        if off > chunk_size:   # compact the consumed prefix
+            del buf[:off]
+            consumed += off
+            off = 0
+        if not fill(9):
+            if len(buf) - off and on_corruption:
+                on_corruption("truncated record header", consumed + off)
+            return
+        length, kind, crc = _RECORD_HEADER.unpack_from(buf, off)
+        if length < 5 or length > _MAX_RECORD_BYTES:
+            if on_corruption:
+                on_corruption("bad record length", consumed + off)
+            return
+        if not fill(4 + length):
+            if on_corruption:
+                on_corruption("truncated record", consumed + off)
+            return
+        payload = bytes(buf[off + 9:off + 4 + length])
+        if _crc(kind, payload) != crc:
+            if on_corruption:
+                on_corruption("crc mismatch", consumed + off)
+            return
+        yield kind, payload
+        off += 4 + length
+
+
+def _iter_records_stream_v1(f, first: bytes, chunk_size: int = 1 << 20):
+    """Legacy v1 framing ([u32 len][u8 kind][payload], no CRC), streamed."""
+    buf = bytearray(first)
+    off = 0
+    eof = False
+
+    def fill(need: int) -> bool:
+        nonlocal eof
+        while len(buf) - off < need and not eof:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                eof = True
+            else:
+                buf.extend(chunk)
+        return len(buf) - off >= need
+
+    while True:
+        if off > chunk_size:
+            del buf[:off]
+            off = 0
+        if not fill(5):
+            return
+        length, kind = struct.unpack_from("<IB", buf, off)
+        if length < 1 or length > _MAX_RECORD_BYTES or not fill(4 + length):
+            return  # truncated tail — stop cleanly
+        yield kind, bytes(buf[off + 5:off + 4 + length])
+        off += 4 + length
+
+
+def read_segment_header(path: str) -> tuple[int, int] | None:
+    """(version, seqnum) for a v2 segment; None for a legacy v1 file."""
     with open(path, "rb") as f:
-        yield from iter_records_from_bytes(f.read())
+        head = f.read(_HEADER_LEN)
+    if not head.startswith(WAL_MAGIC) or len(head) < _HEADER_LEN:
+        return None
+    version, seq = struct.unpack_from("<HQ", head, len(WAL_MAGIC))
+    return version, seq
 
 
-def iter_txns_from_bytes(data: bytes):
-    """Group frames into (commit_ts, [(kind, payload)]) transactions.
+def iter_wal_records(path: str, on_corruption=None):
+    """Stream (kind, payload) records from one segment file. Damage
+    truncates iteration at the first bad record; what was dropped is
+    logged (and counted) so operators can see the data loss boundary."""
+    def report(reason: str, offset: int) -> None:
+        from ...observability.metrics import global_metrics
+        try:
+            dropped = os.path.getsize(path) - offset
+        except OSError:
+            dropped = -1
+        log.warning("WAL %s: %s at offset %d — truncating recovery here "
+                    "(%d trailing byte(s) dropped)", path, reason, offset,
+                    dropped)
+        global_metrics.increment("wal.recovery_truncations")
+        if on_corruption:
+            on_corruption(reason, offset)
+
+    with open(path, "rb") as f:
+        head = f.read(_HEADER_LEN)
+        if head.startswith(WAL_MAGIC):
+            if len(head) < _HEADER_LEN:
+                report("truncated segment header", 0)
+                return
+            version = struct.unpack_from("<H", head, len(WAL_MAGIC))[0]
+            if version > WAL_VERSION:
+                raise DurabilityError(
+                    f"{path}: unsupported WAL version {version}")
+            yield from _iter_records_stream(f, b"", _HEADER_LEN, report)
+        else:
+            yield from _iter_records_stream_v1(f, head)
+
+
+def _group_txns(records):
+    """Group (kind, payload) records into (commit_ts, ops) transactions.
     Incomplete transactions (no TXN_END) are discarded."""
     current_ts = None
     ops = []
-    for kind, payload in iter_records_from_bytes(data):
+    for kind, payload in records:
         if kind == OP_TXN_BEGIN:
             current_ts = _read_varint(BytesIO(payload))
             ops = []
@@ -327,17 +537,104 @@ def iter_txns_from_bytes(data: bytes):
                 ops.append((kind, payload))
 
 
-def iter_wal_transactions(path: str):
-    with open(path, "rb") as f:
-        yield from iter_txns_from_bytes(f.read())
+def iter_txns_from_bytes(data: bytes):
+    yield from _group_txns(iter_records_from_bytes(data))
 
 
-def list_wal_files(storage) -> list[str]:
+def iter_wal_transactions(path: str, on_corruption=None):
+    yield from _group_txns(iter_wal_records(path, on_corruption))
+
+
+# --- segment chain management ----------------------------------------------
+
+
+def list_wal_segments(storage) -> list[tuple[str, int | None]]:
+    """All WAL segments in replay order: legacy (headerless) files first
+    in name order, then v2 segments by seqnum. Each entry is
+    (path, seqnum-or-None)."""
     base = storage.config.durability_dir
     if not base:
         return []
     d = os.path.join(base, "wal")
     if not os.path.isdir(d):
         return []
-    return [os.path.join(d, p) for p in sorted(os.listdir(d))
-            if p.endswith(".mgwal")]
+    legacy, v2 = [], []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".mgwal"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            header = read_segment_header(path)
+        except OSError:
+            continue
+        if header is None:
+            legacy.append((path, None))
+        else:
+            v2.append((path, header[1]))
+    v2.sort(key=lambda item: item[1])
+    return legacy + v2
+
+
+def check_segment_chain(segments) -> None:
+    """Refuse a hole in the v2 seqnum chain: a missing middle segment
+    means committed transactions are gone, and replaying around the gap
+    would silently resurrect a torn history."""
+    seqs = [seq for _, seq in segments if seq is not None]
+    for prev, cur in zip(seqs, seqs[1:]):
+        if cur != prev + 1:
+            raise DurabilityError(
+                f"WAL segment chain has a gap: segment {prev} is followed "
+                f"by {cur} (missing {prev + 1}..{cur - 1}) — refusing to "
+                "replay a torn history")
+
+
+def list_wal_files(storage) -> list[str]:
+    return [path for path, _seq in list_wal_segments(storage)]
+
+
+def next_segment_seq(wal_dir: str) -> int:
+    """Next monotonic segment seqnum: one past the highest existing v2
+    header seq (legacy files don't participate — they sort before every
+    v2 segment in replay order)."""
+    best = 0
+    if os.path.isdir(wal_dir):
+        for name in os.listdir(wal_dir):
+            if not name.endswith(".mgwal"):
+                continue
+            try:
+                header = read_segment_header(os.path.join(wal_dir, name))
+            except OSError:
+                continue
+            if header is not None:
+                best = max(best, header[1])
+    return best + 1
+
+
+def prune_wal_segments(storage, snapshot_ts: int,
+                       active_path: str | None = None) -> list[str]:
+    """Delete leading segments fully covered by the newest snapshot.
+
+    Only a PREFIX of the chain is ever removed (stop at the first
+    segment holding a transaction newer than the snapshot), so the
+    seqnum chain stays contiguous. The active segment is never touched.
+    Returns the deleted paths."""
+    deleted = []
+    for path, _seq in list_wal_segments(storage):
+        if active_path is not None and \
+                os.path.abspath(path) == os.path.abspath(active_path):
+            break
+        max_ts = 0
+        for commit_ts, _ops in iter_wal_transactions(path):
+            max_ts = max(max_ts, commit_ts)
+        if max_ts > snapshot_ts:
+            break
+        try:
+            os.remove(path)
+            deleted.append(path)
+        except OSError:
+            break
+    if deleted:
+        fsync_dir(os.path.join(storage.config.durability_dir, "wal"))
+        log.info("WAL retention: pruned %d segment(s) covered by "
+                 "snapshot ts %d", len(deleted), snapshot_ts)
+    return deleted

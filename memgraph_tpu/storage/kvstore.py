@@ -25,6 +25,8 @@ class KVStore:
         self._conn.commit()
 
     def put(self, key: str, value: bytes | str) -> None:
+        from ..utils import faultinject as FI
+        FI.fire("kvstore.put")
         if isinstance(value, str):
             value = value.encode("utf-8")
         with self._lock:

@@ -43,6 +43,9 @@ class StorageConfig:
     gc_interval_sec: float = 30.0
     durability_dir: Optional[str] = None
     wal_enabled: bool = False
+    # WAL v2 segments rotate at this size; old segments are pruned once
+    # the newest snapshot covers them (reference: --storage-wal-file-size-kib)
+    wal_segment_size: int = 64 * 1024 * 1024
     snapshot_on_exit: bool = False
     properties_on_edges: bool = True
     snapshot_retention_count: int = 3

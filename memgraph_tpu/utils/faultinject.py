@@ -1,0 +1,264 @@
+"""Deterministic fault injection for the durability / replication stack.
+
+Named fault points are wired into the crash-critical call sites
+(WAL writes, fsyncs, snapshot renames, replication sends/receives, Raft
+RPCs, kvstore puts). Each point can be armed — programmatically via
+``arm()`` or from the ``MEMGRAPH_TPU_FAULTS`` environment variable — to
+fire one of a small set of failure actions at specific hit counts, so a
+failing run replays byte-for-byte identically.
+
+Env grammar (comma-separated specs)::
+
+    MEMGRAPH_TPU_FAULTS="wal.write=kill@3,repl.send=raise@2,wal.write=torn:7+kill@5"
+
+    <point>=<action>[:<arg>][+<then>]@<hit>[;<hit>...]
+
+Actions:
+    raise         raise FaultInjected (an OSError subclass — the network
+                  call sites treat it exactly like a dropped connection)
+    kill          os._exit(137): simulates kill -9 at that byte offset
+    drop          the site silently skips the operation (fire() returns
+                  "drop"; only honored by sites where skipping is
+                  meaningful, e.g. raft.rpc loses the RPC)
+    delay:<sec>   sleep, then continue normally
+    torn:<n>      (write sites only) write the first n bytes of the
+                  record, flush, then raise — or ``torn:<n>+kill`` to
+                  exit(137) after the partial write ("torn write")
+
+``@<hits>`` is a semicolon-separated list of 1-based hit numbers at
+which the action fires; omitted means every hit. ``seeded_schedule()``
+derives hit numbers from a seed, so randomized campaigns replay exactly.
+
+The registry is process-global; an unarmed point costs one attribute
+read (module flag) per call.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "MEMGRAPH_TPU_FAULTS"
+KILL_EXIT_CODE = 137  # the code a SIGKILLed process reports
+
+#: the catalog of wired fault points (arming an unknown name is an error
+#: so a typo in a test cannot silently arm nothing)
+KNOWN_POINTS = (
+    "wal.write",       # WalFile.sink, around the record write (torn-able)
+    "wal.fsync",       # WalFile.sink, before os.fsync
+    "snapshot.rename", # create_snapshot, before the tmp→final os.replace
+    "repl.send",       # ReplicaClient frame/system/2PC sends
+    "repl.recv",       # ReplicaServer, before handling a received frame
+    "raft.rpc",        # RaftNode._call_peer ("drop" = RPC lost)
+    "kvstore.put",     # KVStore.put, before the sqlite write
+)
+
+
+class FaultInjected(OSError):
+    """Raised at an armed fault point.
+
+    Subclasses OSError deliberately: replication and Raft call sites
+    already handle (ConnectionError, OSError) as "peer unreachable", so
+    an injected network fault exercises exactly the production handling.
+    """
+
+
+@dataclass
+class _FaultSpec:
+    point: str
+    action: str                      # raise | kill | drop | delay | torn
+    arg: float | None = None         # delay seconds / torn byte count
+    then: str = "raise"              # torn follow-up: raise | kill
+    hits: frozenset[int] | None = None   # 1-based; None = every hit
+    fired: int = field(default=0)
+
+    def matches(self, hit: int) -> bool:
+        return self.hits is None or hit in self.hits
+
+
+_LOCK = threading.Lock()
+_SPECS: dict[str, list[_FaultSpec]] = {}
+_COUNTS: dict[str, int] = {}
+_ARMED = False   # fast-path flag: unarmed fire() is one global read
+
+
+def _parse_spec(text: str) -> _FaultSpec:
+    text = text.strip()
+    point, _, rest = text.partition("=")
+    point = point.strip()
+    if point not in KNOWN_POINTS:
+        raise ValueError(f"unknown fault point {point!r} "
+                         f"(known: {', '.join(KNOWN_POINTS)})")
+    if not rest:
+        raise ValueError(f"fault spec {text!r} has no action")
+    action_part, _, hits_part = rest.partition("@")
+    then = "raise"
+    if "+" in action_part:
+        action_part, _, then = action_part.partition("+")
+        if then not in ("raise", "kill"):
+            raise ValueError(f"bad torn follow-up {then!r}")
+    action, _, arg_s = action_part.partition(":")
+    action = action.strip()
+    if action not in ("raise", "kill", "drop", "delay", "torn"):
+        raise ValueError(f"unknown fault action {action!r}")
+    arg: float | None = None
+    if action == "delay":
+        arg = float(arg_s or 0.05)
+    elif action == "torn":
+        arg = int(arg_s or 0)
+    hits = None
+    if hits_part:
+        hits = frozenset(int(h) for h in hits_part.split(";") if h)
+    return _FaultSpec(point, action, arg, then, hits)
+
+
+def arm(point: str, action: str, *, arg: float | None = None,
+        at: int | list[int] | None = None, then: str = "raise") -> None:
+    """Arm one fault point programmatically (tests)."""
+    if point not in KNOWN_POINTS:
+        raise ValueError(f"unknown fault point {point!r}")
+    hits = None
+    if at is not None:
+        hits = frozenset([at] if isinstance(at, int) else at)
+    spec = _FaultSpec(point, action, arg, then, hits)
+    global _ARMED
+    with _LOCK:
+        _SPECS.setdefault(point, []).append(spec)
+        _ARMED = True
+
+
+def arm_from_string(text: str) -> None:
+    """Arm from the env-var grammar (also used by the env loader)."""
+    global _ARMED
+    for chunk in text.split(","):
+        if not chunk.strip():
+            continue
+        spec = _parse_spec(chunk)
+        with _LOCK:
+            _SPECS.setdefault(spec.point, []).append(spec)
+            _ARMED = True
+
+
+def reset(reload_env: bool = False) -> None:
+    """Disarm everything and zero the hit counters."""
+    global _ARMED
+    with _LOCK:
+        _SPECS.clear()
+        _COUNTS.clear()
+        _ARMED = False
+    if reload_env:
+        _load_env()
+
+
+def hit_count(point: str) -> int:
+    with _LOCK:
+        return _COUNTS.get(point, 0)
+
+
+def seeded_schedule(seed: int, points=KNOWN_POINTS,
+                    max_hit: int = 16) -> dict[str, int]:
+    """Deterministic {point: hit_number} schedule derived from a seed.
+
+    The same seed always yields the same schedule (points are visited in
+    sorted order), so a failure found by a randomized campaign replays
+    exactly by re-arming with the same seed.
+    """
+    rng = random.Random(seed)
+    return {p: rng.randint(1, max_hit) for p in sorted(points)}
+
+
+def arm_seeded(seed: int, points=KNOWN_POINTS, action: str = "raise",
+               max_hit: int = 16) -> dict[str, int]:
+    schedule = seeded_schedule(seed, points, max_hit)
+    for point, hit in schedule.items():
+        arm(point, action, at=hit)
+    return schedule
+
+
+def _next_matching(point: str) -> _FaultSpec | None:
+    """Count a hit on `point`; return the armed spec that fires on it."""
+    with _LOCK:
+        hit = _COUNTS.get(point, 0) + 1
+        _COUNTS[point] = hit
+        for spec in _SPECS.get(point, ()):
+            if spec.matches(hit):
+                spec.fired += 1
+                return spec
+    return None
+
+
+def _execute(spec: _FaultSpec, hit: int) -> str | None:
+    if spec.action == "delay":
+        time.sleep(spec.arg or 0.05)
+        return None
+    if spec.action == "drop":
+        log.warning("faultinject: dropping at %s (hit %d)", spec.point, hit)
+        return "drop"
+    if spec.action == "kill":
+        log.error("faultinject: killing process at %s (hit %d)",
+                  spec.point, hit)
+        os._exit(KILL_EXIT_CODE)
+    # raise (torn is handled by faulty_write; firing it via fire() is
+    # equivalent to raise — there is no payload to tear here)
+    raise FaultInjected(f"injected fault at {spec.point} (hit {hit})")
+
+
+def fire(point: str) -> str | None:
+    """Hook call site. Returns "drop" when the site should silently skip
+    the operation, None to continue; raises FaultInjected or kills the
+    process per the armed action."""
+    if not _ARMED:
+        return None
+    spec = _next_matching(point)
+    if spec is None:
+        return None
+    return _execute(spec, _COUNTS.get(point, 0))
+
+
+def faulty_write(point: str, fileobj, data: bytes) -> None:
+    """Write `data` to `fileobj`, honoring torn-write faults at `point`.
+
+    A torn spec writes only the first n bytes, flushes them so they
+    actually land in the file, then raises (or kills) — reproducing a
+    crash mid-write at an exact byte offset.
+    """
+    if not _ARMED:
+        fileobj.write(data)
+        return
+    spec = _next_matching(point)
+    if spec is None:
+        fileobj.write(data)
+        return
+    hit = _COUNTS.get(point, 0)
+    if spec.action == "torn":
+        n = int(spec.arg or 0)
+        fileobj.write(data[:n])
+        fileobj.flush()
+        log.error("faultinject: torn write at %s — %d/%d bytes (hit %d)",
+                  point, n, len(data), hit)
+        if spec.then == "kill":
+            os._exit(KILL_EXIT_CODE)
+        raise FaultInjected(
+            f"injected torn write at {point}: {n}/{len(data)} bytes")
+    result = _execute(spec, hit)
+    if result == "drop":
+        return  # the write is silently lost
+    fileobj.write(data)
+
+
+def _load_env() -> None:
+    text = os.environ.get(ENV_VAR, "")
+    if text:
+        try:
+            arm_from_string(text)
+        except ValueError:
+            log.exception("faultinject: bad %s value %r", ENV_VAR, text)
+
+
+_load_env()

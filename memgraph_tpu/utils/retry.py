@@ -1,0 +1,61 @@
+"""Shared retry/backoff policy for cluster networking.
+
+One policy object replaces the ad-hoc except-and-mark-invalid blocks
+that used to be scattered across the replication client, the snapshot
+download path, and reconnect loops: exponential backoff with a cap and
+deterministic (seedable) jitter, plus a budget after which the caller
+degrades instead of retrying forever.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator
+
+
+class RetryPolicy:
+    """Exponential backoff: base_delay * factor^n, capped, jittered.
+
+    max_retries is the RETRY budget (total attempts = max_retries + 1).
+    A seed makes the jitter sequence reproducible for deterministic
+    fault-injection tests.
+    """
+
+    def __init__(self, base_delay: float = 0.05, factor: float = 2.0,
+                 max_delay: float = 2.0, max_retries: int = 5,
+                 jitter: float = 0.2, seed: int | None = None) -> None:
+        self.base_delay = base_delay
+        self.factor = factor
+        self.max_delay = max_delay
+        self.max_retries = max_retries
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff delay after the (attempt+1)-th failure (attempt >= 0)."""
+        delay = min(self.max_delay,
+                    self.base_delay * (self.factor ** attempt))
+        if self.jitter:
+            delay *= 1.0 + self.jitter * self._rng.random()
+        return delay
+
+    def delays(self) -> Iterator[float]:
+        for attempt in range(self.max_retries):
+            yield self.delay_for(attempt)
+
+    def call(self, fn: Callable, *, retry_on=(ConnectionError, OSError),
+             on_retry: Callable | None = None):
+        """Run fn(), retrying on `retry_on` with backoff; re-raises the
+        last exception once the budget is exhausted."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on as e:
+                if attempt >= self.max_retries:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                time.sleep(self.delay_for(attempt))
+                attempt += 1

@@ -1,0 +1,59 @@
+"""Crash-harness child: a durable write workload killed mid-commit.
+
+Invoked as a subprocess by tests/test_fault_injection.py:
+
+    python tests/crash_child.py <durability_dir> <acked_file> <n_txns>
+
+Faults are armed through MEMGRAPH_TPU_FAULTS (see utils/faultinject.py);
+a ``kill`` action exits with code 137 at the armed byte offset, exactly
+like kill -9. Each transaction creates TWO vertices sharing a ``pair``
+id, so a torn replay would surface as a half-pair. The transaction id is
+appended (fsynced) to <acked_file> only AFTER the commit returned — the
+parent asserts every acked pair survives recovery intact and no partial
+pair is ever visible.
+
+Env knobs:
+    CRASH_CHILD_SNAPSHOT  CREATE SNAPSHOT every N transactions (default off)
+    CRASH_CHILD_SEGMENT   WAL segment size in bytes (default 4096, small
+                          enough that the workload crosses rotations)
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    dur_dir, acked_path, n_txns = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+    from memgraph_tpu.storage.durability.recovery import (recover,
+                                                          wire_durability)
+    from memgraph_tpu.storage.durability.snapshot import create_snapshot
+
+    storage = InMemoryStorage(StorageConfig(
+        durability_dir=dur_dir, wal_enabled=True,
+        wal_segment_size=int(os.environ.get("CRASH_CHILD_SEGMENT", 4096))))
+    recover(storage)
+    wire_durability(storage)
+    interp = Interpreter(InterpreterContext(storage))
+    snap_every = int(os.environ.get("CRASH_CHILD_SNAPSHOT", 0))
+
+    with open(acked_path, "a") as acked:
+        for i in range(n_txns):
+            interp.execute(
+                f"CREATE (:P {{pair: {i}, half: 1}}), "
+                f"(:P {{pair: {i}, half: 2}})")
+            acked.write(f"{i}\n")
+            acked.flush()
+            os.fsync(acked.fileno())
+            if snap_every and (i + 1) % snap_every == 0:
+                create_snapshot(storage)
+    print("workload complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

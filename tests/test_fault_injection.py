@@ -1,0 +1,395 @@
+"""Deterministic fault injection + crash-consistency harness.
+
+Three layers of coverage:
+
+1. Unit: the faultinject registry (env grammar, hit schedules, torn
+   writes, seeded determinism) and the shared RetryPolicy.
+2. In-process fault points: dropped replication frames heal via
+   reconnect catch-up, STRICT_SYNC degrades to ASYNC after the retry
+   budget, Raft survives injected RPC loss, seedable election timeouts.
+3. Crash harness: a subprocess workload (tests/crash_child.py) killed
+   at armed fault points mid-WAL / mid-snapshot; the parent recovers
+   and asserts the acknowledged-commit prefix survives exactly — no
+   acked transaction lost, no partial transaction visible.
+
+The full kill matrix is marked slow+crash (`pytest -m crash`); a
+3-point smoke subset runs in tier-1.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from memgraph_tpu.utils import faultinject as FI
+from memgraph_tpu.utils.retry import RetryPolicy
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CHILD = REPO / "tests" / "crash_child.py"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset()
+    yield
+    FI.reset()
+
+
+# --- faultinject unit coverage ---------------------------------------------
+
+
+def test_env_grammar_parses_actions():
+    FI.arm_from_string("wal.write=torn:7+kill@3,repl.send=drop@2;5,"
+                       "raft.rpc=delay:0.01,kvstore.put=raise@1")
+    assert FI._SPECS["wal.write"][0].action == "torn"
+    assert FI._SPECS["wal.write"][0].arg == 7
+    assert FI._SPECS["wal.write"][0].then == "kill"
+    assert FI._SPECS["repl.send"][0].hits == frozenset({2, 5})
+    assert FI._SPECS["raft.rpc"][0].hits is None  # every hit
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError):
+        FI.arm("wal.wrte", "raise")
+    with pytest.raises(ValueError):
+        FI.arm_from_string("wal.write=explode@1")
+
+
+def test_fire_raises_only_at_armed_hit():
+    FI.arm("kvstore.put", "raise", at=2)
+    assert FI.fire("kvstore.put") is None           # hit 1
+    with pytest.raises(FI.FaultInjected):
+        FI.fire("kvstore.put")                      # hit 2
+    assert FI.fire("kvstore.put") is None           # hit 3
+    assert FI.hit_count("kvstore.put") == 3
+
+
+def test_fire_drop_returns_directive():
+    FI.arm("raft.rpc", "drop", at=1)
+    assert FI.fire("raft.rpc") == "drop"
+    assert FI.fire("raft.rpc") is None
+
+
+def test_faulty_write_tears_at_exact_offset():
+    from io import BytesIO
+    buf = BytesIO()
+    FI.arm("wal.write", "torn", arg=3, at=2)
+    FI.faulty_write("wal.write", buf, b"aaaa")      # hit 1: full write
+    with pytest.raises(FI.FaultInjected):
+        FI.faulty_write("wal.write", buf, b"bbbbbb")  # hit 2: 3 bytes land
+    FI.faulty_write("wal.write", buf, b"cc")        # hit 3: full write
+    assert buf.getvalue() == b"aaaa" + b"bbb" + b"cc"
+
+
+def test_seeded_schedule_replays_exactly():
+    s1 = FI.seeded_schedule(1234)
+    s2 = FI.seeded_schedule(1234)
+    assert s1 == s2
+    assert set(s1) == set(FI.KNOWN_POINTS)
+    assert all(1 <= hit <= 16 for hit in s1.values())
+
+
+def test_retry_policy_backoff_caps_and_budget():
+    p = RetryPolicy(base_delay=0.1, factor=2.0, max_delay=0.5,
+                    max_retries=4, jitter=0.0)
+    delays = list(p.delays())
+    assert delays == [0.1, 0.2, 0.4, 0.5]           # capped at max_delay
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise ConnectionError("nope")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        RetryPolicy(base_delay=0.01, max_retries=2, jitter=0.0).call(flaky)
+    assert len(calls) == 3                          # 1 try + 2 retries
+    assert time.monotonic() - t0 >= 0.02
+
+    # seeded jitter replays exactly
+    a = list(RetryPolicy(seed=9, max_retries=5).delays())
+    b = list(RetryPolicy(seed=9, max_retries=5).delays())
+    assert a == b
+
+
+# --- crash harness ----------------------------------------------------------
+
+
+def _run_child(tmp_path, faults, n=30, snapshot_every=0):
+    dur = tmp_path / "data"
+    dur.mkdir(exist_ok=True)
+    acked = tmp_path / "acked.txt"
+    env = os.environ.copy()
+    env["MEMGRAPH_TPU_FAULTS"] = faults
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    if snapshot_every:
+        env["CRASH_CHILD_SNAPSHOT"] = str(snapshot_every)
+    proc = subprocess.run(
+        [sys.executable, str(CHILD), str(dur), str(acked), str(n)],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300)
+    acked_ids = ([int(x) for x in acked.read_text().split()]
+                 if acked.exists() else [])
+    return proc, dur, acked_ids
+
+
+def _recover_pairs(dur):
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+    from memgraph_tpu.storage.durability.recovery import recover
+    storage = InMemoryStorage(StorageConfig(durability_dir=str(dur),
+                                            wal_enabled=True))
+    recover(storage)
+    _, rows, _ = Interpreter(InterpreterContext(storage)).execute(
+        "MATCH (p:P) RETURN p.pair, count(*) ORDER BY p.pair")
+    return {r[0]: r[1] for r in rows}
+
+
+def _assert_crash_consistent(proc, dur, acked_ids):
+    assert proc.returncode != 0, (
+        f"child should have crashed, got rc=0\n{proc.stdout}{proc.stderr}")
+    pairs = _recover_pairs(dur)
+    for i in acked_ids:
+        assert pairs.get(i) == 2, (
+            f"acked txn {i} lost or torn after recovery: "
+            f"{pairs.get(i)} of 2 vertices\n{proc.stderr}")
+    for pair, cnt in pairs.items():
+        assert cnt == 2, f"partial txn {pair} visible after recovery"
+    # the recovered state is the acked prefix plus at most the one
+    # in-flight txn that was durable but unacked at the kill
+    unacked = set(pairs) - set(acked_ids)
+    assert len(unacked) <= 1, f"phantom txns recovered: {sorted(unacked)}"
+
+
+# ≥10 distinct crash points: torn WAL writes at several byte offsets,
+# kills before WAL write / before fsync, snapshot-rename crashes (with
+# WAL retention riding the snapshot), all crossing segment rotations
+# (CRASH_CHILD_SEGMENT=4096 rotates every few txns).
+CRASH_MATRIX = [
+    ("wal.write=kill@1", 0),
+    ("wal.write=kill@7", 0),
+    ("wal.write=torn:1+kill@2", 0),
+    ("wal.write=torn:9+kill@5", 0),
+    ("wal.write=torn:64+kill@11", 0),
+    ("wal.write=torn:300+kill@13", 0),
+    ("wal.fsync=kill@1", 0),
+    ("wal.fsync=kill@9", 0),
+    ("snapshot.rename=kill@1", 5),
+    ("snapshot.rename=kill@2", 3),
+    ("wal.write=torn:5+kill@17", 4),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.crash
+@pytest.mark.parametrize("faults,snap", CRASH_MATRIX)
+def test_crash_kill_matrix(tmp_path, faults, snap):
+    proc, dur, acked = _run_child(tmp_path, faults, n=30,
+                                  snapshot_every=snap)
+    _assert_crash_consistent(proc, dur, acked)
+
+
+# tier-1 smoke: three fault points from the matrix (kill before write,
+# torn write, kill before fsync)
+CRASH_SMOKE = [
+    ("wal.write=kill@2", 0),
+    ("wal.write=torn:6+kill@3", 0),
+    ("wal.fsync=kill@4", 0),
+]
+
+
+@pytest.mark.parametrize("faults,snap", CRASH_SMOKE)
+def test_crash_smoke(tmp_path, faults, snap):
+    proc, dur, acked = _run_child(tmp_path, faults, n=8,
+                                  snapshot_every=snap)
+    _assert_crash_consistent(proc, dur, acked)
+
+
+def test_child_completes_with_no_faults(tmp_path):
+    proc, dur, acked = _run_child(tmp_path, "", n=6)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert _recover_pairs(dur) == {i: 2 for i in range(6)}
+
+
+# --- replication fault points ----------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def cluster():
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage
+    main_ictx = InterpreterContext(InMemoryStorage())
+    replica_ictx = InterpreterContext(InMemoryStorage())
+    main = Interpreter(main_ictx)
+    replica = Interpreter(replica_ictx)
+    port = _free_port()
+    replica.execute(f"SET REPLICATION ROLE TO REPLICA WITH PORT {port}")
+    yield {"main": main, "replica": replica, "port": port,
+           "main_ictx": main_ictx, "replica_ictx": replica_ictx}
+    if getattr(replica_ictx, "replication", None):
+        if replica_ictx.replication.replica_server:
+            replica_ictx.replication.replica_server.stop()
+    if getattr(main_ictx, "replication", None):
+        for c in main_ictx.replication.replicas.values():
+            c.close()
+
+
+def _rows(interp, q):
+    _, rows, _ = interp.execute(q)
+    return rows
+
+
+def test_dropped_replication_frame_heals_via_catchup(cluster):
+    main, replica = cluster["main"], cluster["replica"]
+    main.execute(
+        f"REGISTER REPLICA r1 SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    main.execute("CREATE (:R {v: 1})")
+    client = cluster["main_ictx"].replication.replicas["r1"]
+    # lose exactly the next shipped frame on the MAIN side
+    FI.arm("repl.send", "drop", at=FI.hit_count("repl.send") + 1)
+    main.execute("CREATE (:R {v: 2})")        # ship fails, commit stands
+    assert client.status.name == "INVALID"
+    assert _rows(main, "MATCH (n:R) RETURN count(n)") == [[2]]
+    client.connect_and_catch_up()             # the heartbeat would do this
+    assert client.catchup_used == "wal_delta"
+    rows = _rows(replica, "MATCH (n:R) RETURN n.v ORDER BY n.v")
+    assert rows == [[1], [2]]
+
+
+def test_replica_recv_fault_heals_via_catchup(cluster):
+    main, replica = cluster["main"], cluster["replica"]
+    main.execute(
+        f"REGISTER REPLICA r1 SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    # sever the replica-side connection on the next received frame
+    FI.arm("repl.recv", "raise", at=FI.hit_count("repl.recv") + 1)
+    main.execute("CREATE (:S {v: 1})")
+    client = cluster["main_ictx"].replication.replicas["r1"]
+    assert client.status.name == "INVALID"
+    client.connect_and_catch_up()
+    rows = _rows(replica, "MATCH (n:S) RETURN count(n)")
+    assert rows == [[1]]
+
+
+def test_strict_sync_degrades_to_async_after_budget(cluster):
+    from memgraph_tpu.exceptions import TransactionException
+    from memgraph_tpu.observability.metrics import global_metrics
+    from memgraph_tpu.replication.main_role import ReplicationMode
+    main = cluster["main"]
+    main.execute(
+        f"REGISTER REPLICA r1 STRICT_SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    client = cluster["main_ictx"].replication.replicas["r1"]
+    client.retry_policy = RetryPolicy(max_retries=0, base_delay=0.01)
+    cluster["replica_ictx"].replication.replica_server.stop()
+    # budget not yet exhausted: the strict guarantee aborts the commit
+    with pytest.raises(TransactionException):
+        main.execute("CREATE (:D {v: 1})")
+    # budget exhausted now (failures > max_retries=0): the replica is
+    # demoted to ASYNC catch-up and commits flow again
+    main.execute("CREATE (:D {v: 2})")
+    assert client.mode is ReplicationMode.ASYNC
+    assert client.degraded_from_strict
+    assert _rows(main, "MATCH (n:D) RETURN count(n)") == [[1]]
+    text = global_metrics.prometheus_text()
+    assert "replication_strict_sync_demotions" in text
+    assert "replication_replica_degraded_r1 1.0" in text
+
+
+def test_replica_lag_and_fsync_metrics_exported(cluster, tmp_path):
+    from memgraph_tpu.observability.metrics import global_metrics
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+    from memgraph_tpu.storage.durability.recovery import wire_durability
+    main = cluster["main"]
+    main.execute(
+        f"REGISTER REPLICA r1 SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    main.execute("CREATE (:M {v: 1})")
+    # a durable commit records WAL fsync latency
+    storage = InMemoryStorage(StorageConfig(durability_dir=str(tmp_path),
+                                            wal_enabled=True))
+    wire_durability(storage)
+    Interpreter(InterpreterContext(storage)).execute("CREATE (:W)")
+    text = global_metrics.prometheus_text()
+    assert "replication_replica_lag_r1" in text
+    assert "replication_replica_health_r1 1.0" in text
+    assert "wal_fsync_latency_sec_count" in text
+    assert 'wal_fsync_latency_sec{quantile="0.9"}' in text
+
+
+# --- raft fault points ------------------------------------------------------
+
+
+def test_raft_election_timeouts_are_seedable():
+    from memgraph_tpu.coordination.raft import RaftNode
+    a = RaftNode("n", "127.0.0.1", 0, {}, election_seed=7)
+    b = RaftNode("n", "127.0.0.1", 0, {}, election_seed=7)
+    c = RaftNode("n", "127.0.0.1", 0, {}, election_seed=8)
+    seq = [a._rng.uniform(*RaftNode.ELECTION_TIMEOUT) for _ in range(8)]
+    assert seq == [b._rng.uniform(*RaftNode.ELECTION_TIMEOUT)
+                   for _ in range(8)]
+    assert seq != [c._rng.uniform(*RaftNode.ELECTION_TIMEOUT)
+                   for _ in range(8)]
+
+
+def test_raft_survives_injected_rpc_loss():
+    from memgraph_tpu.coordination.raft import RaftNode
+    ports = [_free_port() for _ in range(3)]
+    ids = ["f1", "f2", "f3"]
+    applied = {i: [] for i in ids}
+    nodes = []
+    for i, nid in enumerate(ids):
+        peers = {ids[j]: ("127.0.0.1", ports[j])
+                 for j in range(3) if j != i}
+        nodes.append(RaftNode(nid, "127.0.0.1", ports[i], peers,
+                              apply_fn=lambda cmd, _n=nid:
+                              applied[_n].append(cmd),
+                              election_seed=100 + i))
+    # the first 8 RPCs in the whole cluster are lost on the wire
+    FI.arm("raft.rpc", "drop", at=list(range(1, 9)))
+    for n in nodes:
+        n.start()
+    try:
+        deadline = time.monotonic() + 20
+        leader = None
+        while time.monotonic() < deadline and leader is None:
+            leader = next((n for n in nodes if n.is_leader()), None)
+            time.sleep(0.05)
+        assert leader is not None, "no leader elected despite RPC loss"
+        assert leader.propose({"op": "set", "v": 1}, timeout=10)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(len(applied[i]) == 1 for i in ids):
+                break
+            time.sleep(0.05)
+        assert all(len(applied[i]) == 1 for i in ids)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_kvstore_put_fault_point(tmp_path):
+    from memgraph_tpu.storage.kvstore import KVStore
+    kv = KVStore(str(tmp_path / "kv.db"))
+    kv.put("a", "1")
+    FI.arm("kvstore.put", "raise", at=FI.hit_count("kvstore.put") + 1)
+    with pytest.raises(FI.FaultInjected):
+        kv.put("b", "2")
+    kv.put("c", "3")          # the store keeps working after the fault
+    assert kv.get_str("a") == "1"
+    assert kv.get_str("b") is None
+    assert kv.get_str("c") == "3"
+    kv.close()
