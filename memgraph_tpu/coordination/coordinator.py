@@ -14,6 +14,7 @@ import logging
 import threading
 import time
 
+from ..utils.locks import tracked_lock
 from .data_instance import mgmt_call
 from .raft import RaftNode
 
@@ -35,7 +36,7 @@ class CoordinatorInstance:
         # calls _restore during RaftNode.__init__)
         self.instances: dict[str, dict] = {}
         self.main_name: str | None = None
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("Coordinator._lock")
         self.raft = RaftNode(node_id, host, raft_port, peers,
                              apply_fn=self._apply, kvstore=kvstore,
                              snapshot_fn=self._snapshot,

@@ -24,6 +24,7 @@ import zlib
 from dataclasses import dataclass, field
 
 from ..replication import protocol as P
+from ..utils.locks import tracked_lock, tracked_rlock
 
 log = logging.getLogger(__name__)
 
@@ -109,7 +110,7 @@ class RaftNode:
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
 
-        self._lock = threading.RLock()
+        self._lock = tracked_rlock("RaftNode._lock")
         self._stop = threading.Event()
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_deadline()
@@ -121,7 +122,7 @@ class RaftNode:
         # destabilize leadership; the server loop handles many frames per
         # connection, so reuse one socket per peer (fresh on error)
         self._peer_conns: dict[str, socket.socket] = {}
-        self._peer_conns_lock = threading.Lock()
+        self._peer_conns_lock = tracked_lock("RaftNode._peer_conns_lock")
 
     # --- lifecycle ----------------------------------------------------------
 

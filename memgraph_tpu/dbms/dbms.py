@@ -14,6 +14,7 @@ import threading
 import time
 
 from ..exceptions import QueryException
+from ..utils.locks import tracked_lock
 from ..storage import InMemoryStorage, StorageConfig
 
 DEFAULT_DB = "memgraph"
@@ -24,7 +25,7 @@ class DbmsHandler:
                  interpreter_config: dict | None = None,
                  recover_on_startup: bool = True):
         from ..query.interpreter import InterpreterContext
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("Dbms._lock")
         self._root_config = root_config or StorageConfig()
         self._interp_config = interpreter_config or {}
         self._recover = recover_on_startup

@@ -50,8 +50,8 @@ async def start_monitoring_server(host: str, port: int, ictx):
                 + f"Content-Length: {len(payload)}\r\n".encode()
                 + b"Connection: close\r\n\r\n" + payload)
             await writer.drain()
-        except Exception:
-            pass
+        except OSError:
+            pass  # client went away mid-response; nothing to serve
         finally:
             writer.close()
 

@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+from ..utils.locks import tracked_lock
+
 
 def _promname(name: str) -> str:
     return name.replace(".", "_").replace("-", "_")
@@ -18,7 +20,7 @@ def _promname(name: str) -> str:
 
 class Metrics:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("Metrics._lock")
         self._counters: dict[str, int] = defaultdict(int)
         self._gauges: dict[str, float] = {}
         self._histograms: dict[str, list] = defaultdict(list)

@@ -21,6 +21,7 @@ import base64
 import hashlib
 import json
 import logging
+import queue
 import socket
 import struct
 import threading
@@ -160,7 +161,7 @@ class MonitoringServer:
             logging.getLogger().removeHandler(self._log_handler)
         try:
             self._queue.put_nowait(None)    # wake the drain thread
-        except Exception:   # noqa: BLE001 — queue full: drain sees _stop
+        except queue.Full:   # drain sees _stop on its next timeout
             pass
         with self._lock:
             sessions = list(self._sessions)
@@ -181,7 +182,7 @@ class MonitoringServer:
         than exerting backpressure on whoever is logging."""
         try:
             self._queue.put_nowait(obj)
-        except Exception:   # noqa: BLE001 — queue.Full
+        except queue.Full:
             self.dropped_records += 1
 
     def _drain_loop(self) -> None:
@@ -323,6 +324,8 @@ class _BroadcastHandler(logging.Handler):
                 "logger": record.name,
                 "timestamp": record.created,
             })
+        # mglint: disable=MG003 — a logging handler must never throw into
+        # the emitting thread; broadcast() already counts drops
         except Exception:   # noqa: BLE001 — logging must never throw
             pass
         finally:

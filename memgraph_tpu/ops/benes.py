@@ -134,6 +134,9 @@ def route_packed(perm: np.ndarray) -> np.ndarray:
     try:
         packed = benes_route_native(perm)
     except Exception:  # noqa: BLE001 — any native failure falls back
+        import logging
+        logging.getLogger(__name__).debug(
+            "native benes router failed; python fallback", exc_info=True)
         packed = None
     if packed is not None:
         return packed

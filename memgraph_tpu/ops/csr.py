@@ -427,6 +427,10 @@ class GraphCache:
                         label_filter=label_filter,
                         edge_type_filter=edge_type_filter)
                 except Exception:  # noqa: BLE001 — any doubt: full export
+                    import logging
+                    logging.getLogger(__name__).debug(
+                        "delta CSR export failed; falling back to full "
+                        "export", exc_info=True)
                     g = None
         if g is None:
             g = export_csr(accessor, weight_property=weight_property,

@@ -776,4 +776,8 @@ def load_plan(path: str) -> Optional[MXUPlan]:
             node_masks_packed=z["node_masks_packed"],
             wsum=z["wsum"] if z["wsum"].size else None)
     except Exception:  # noqa: BLE001 — any cache damage means "rebuild"
+        import logging
+        logging.getLogger(__name__).debug(
+            "MXU plan cache at %s unreadable; rebuilding", path,
+            exc_info=True)
         return None

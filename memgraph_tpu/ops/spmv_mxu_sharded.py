@@ -78,6 +78,10 @@ def _assign_shards(src, dst, n_nodes, n_shards):
             shard = balanced_edge_color_native(src, dst, n_nodes, n_nodes,
                                                levels)
         except Exception:  # noqa: BLE001 — fall back on any native issue
+            import logging
+            logging.getLogger(__name__).debug(
+                "native edge coloring failed; numpy round-robin "
+                "fallback", exc_info=True)
             shard = None
         if shard is not None:
             return shard.astype(np.int64)

@@ -636,8 +636,10 @@ def _is_global_operation(query):
     from ..query.frontend.parser import parse_with_source
     try:
         node = parse_with_source(query)
+    # mglint: disable=MG003 — classification only; execution re-parses
+    # and surfaces the real syntax error to the caller
     except Exception:
-        return False  # let execution surface the real syntax error
+        return False
     return isinstance(node, (A.IndexQuery, A.ConstraintQuery,
                              A.IsolationLevelQuery, A.StorageModeQuery))
 

@@ -663,6 +663,8 @@ def _or_null(conv):
     def inner(ev, args):
         try:
             return conv(ev, args)
+        # mglint: disable=MG003 — Cypher toXOrNull() contract: any
+        # conversion failure IS the null result, not an error
         except Exception:
             return None
     return inner
@@ -685,6 +687,8 @@ def _list_conv(name, elem_fn):
                 continue
             try:
                 out.append(_fn(ev, [item]))
+            # mglint: disable=MG003 — per-element toX() null-on-failure
+            # is the Cypher list-conversion contract
             except Exception:
                 out.append(None)
         return out

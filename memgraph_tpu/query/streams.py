@@ -209,6 +209,8 @@ class PulsarSource:  # pragma: no cover - requires pulsar client lib
             try:
                 m = self._consumer.receive(
                     timeout_millis=int(timeout_sec * 1000))
+            # mglint: disable=MG003 — the pulsar client raises its own
+            # client-specific timeout type; a timeout just ends the batch
             except Exception:
                 break
             out.append(Message(m.data(), m.topic_name()))

@@ -27,6 +27,7 @@ from collections import deque
 
 from ..observability.metrics import global_metrics
 from ..utils import faultinject as FI
+from ..utils.locks import tracked_lock
 from ..utils.retry import RetryPolicy
 from . import protocol as P
 
@@ -72,7 +73,7 @@ class ReplicaClient:
         self._reconnect_attempts = 0
         self._next_reconnect_at = 0.0
         self._sock: socket.socket | None = None
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("ReplicaClient._lock")
         self._queue: "queue.Queue[bytes]" = queue.Queue(maxsize=10_000)
         self._worker: threading.Thread | None = None
         self._stop = threading.Event()
@@ -81,12 +82,12 @@ class ReplicaClient:
         self._catchup_buffer: list[bytes] = []
         self._catchup_system: list[dict] = []
         self._system_queue: list[dict] = []
-        self._syslock = threading.Lock()
+        self._syslock = tracked_lock("ReplicaClient._syslock")
         self._sys_draining = False
         self.catchup_used: str | None = None   # "wal_delta" | "snapshot"
         # serializes catch-up attempts: the registering thread and the
         # heartbeat reconnect may target the same client concurrently
-        self._catchup_lock = threading.Lock()
+        self._catchup_lock = tracked_lock("ReplicaClient._catchup_lock")
 
     # --- connection / catch-up ----------------------------------------------
 
@@ -439,7 +440,7 @@ class ReplicationState:
         self._system_seq = 0
         self.replicas: dict[str, ReplicaClient] = {}
         self.replica_server = None
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("ReplicationState._lock")
         self._consumer_registered = False
         # recent-commit ring for the WAL-delta catch-up rung (reference:
         # storage/v2/replication/recovery.hpp ladder): a briefly-behind
@@ -451,7 +452,7 @@ class ReplicationState:
         self._frames_floor = 0
         self._frames_cap = int(_os.environ.get(
             "MEMGRAPH_TPU_REPL_RING", 4096))
-        self._frames_lock = threading.Lock()
+        self._frames_lock = tracked_lock("ReplicationState._frames_lock")
         self._heartbeat_thread: threading.Thread | None = None
         self._stop_heartbeat = threading.Event()
         self._reconnecting: set[int] = set()
@@ -523,13 +524,19 @@ class ReplicationState:
         if doc.get("role") == "replica" and doc.get("listen_port"):
             self.set_role_replica("0.0.0.0", int(doc["listen_port"]))
             return
+        from ..exceptions import QueryException
         for spec in doc.get("replicas", ()):
             try:
                 self.register_replica(spec["name"], spec["address"],
                                       ReplicationMode[spec["mode"]])
-            except Exception:
+            except (KeyError, ConnectionError, OSError,
+                    QueryException) as e:
                 # an unreachable replica must not block startup — it can
                 # be re-registered (or will reconnect) later
+                log.warning("replication state restore: replica %r not "
+                            "restored (%s); re-register it or let the "
+                            "heartbeat reconnect it",
+                            spec.get("name", "?"), e)
                 continue
 
     def set_role_replica(self, host: str, port: int) -> None:
@@ -660,9 +667,19 @@ class ReplicationState:
                     log.info("replica %s reconnected via %s catch-up",
                              client.name, client.catchup_used)
             except Exception:
+                first = client._reconnect_attempts == 0
                 client.note_reconnect_attempt(False)
-                log.debug("replica %s reconnect failed", client.name,
-                          exc_info=True)
+                # WARNING once per outage (the operator-visible event),
+                # debug for the backed-off retries — a dead replica must
+                # not spam one warning per attempt forever
+                if first:
+                    log.warning("replica %s reconnect failed; retrying "
+                                "with backoff", client.name,
+                                exc_info=True)
+                else:
+                    log.debug("replica %s reconnect failed (attempt %d)",
+                              client.name, client._reconnect_attempts,
+                              exc_info=True)
             finally:
                 with self._lock:
                     self._reconnecting.discard(key)

@@ -15,6 +15,7 @@ import socket
 import threading
 
 from ..storage.durability import wal as W
+from ..utils.locks import tracked_lock
 from ..storage.durability.recovery import _apply_wal_txn
 from . import protocol as P
 
@@ -36,7 +37,7 @@ class ReplicaServer:
         self._sock: socket.socket | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._apply_lock = threading.Lock()
+        self._apply_lock = tracked_lock("ReplicaServer._apply_lock")
         self._conns: list[socket.socket] = []
         # 2PC (STRICT_SYNC): frames received via MSG_PREPARE wait here for
         # the MAIN's MSG_FINALIZE decision (reference: PrepareCommit /
@@ -221,7 +222,9 @@ class ReplicaServer:
                     else:
                         dbms.resume(data["name"])
                 except Exception:  # noqa: BLE001 — idempotent replays
-                    pass
+                    log.debug("system txn %s for %r already applied "
+                              "(idempotent replay)", kind,
+                              data.get("name"), exc_info=True)
         if seq:
             self.last_system_seq = seq
 

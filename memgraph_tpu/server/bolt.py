@@ -181,8 +181,8 @@ def bolt_to_value(v):
             try:
                 from zoneinfo import ZoneInfo
                 base = base.astimezone(ZoneInfo(zone))
-            except Exception:
-                pass
+            except (ImportError, KeyError, ValueError, OSError):
+                pass  # unknown/unavailable tz db: keep UTC instant
             return ZonedDateTime(base)
         if v.tag == LEGACY_DATETIME:
             # 4.x: local wall-clock seconds + offset

@@ -158,6 +158,6 @@ class BoltClient:
     def close(self):
         try:
             self._send_message(M_GOODBYE)
-        except Exception:
-            pass
+        except OSError:
+            pass  # peer already gone; GOODBYE is best-effort
         self.sock.close()

@@ -93,7 +93,8 @@ class KernelServer:
         self.socket_path = socket_path
         self.idle_timeout_s = idle_timeout_s
         self._graphs: dict = {}      # graph_key -> DeviceGraph
-        self._dispatch_lock = threading.Lock()
+        from ..utils.locks import tracked_lock
+        self._dispatch_lock = tracked_lock("KernelServer._dispatch_lock")
         self._shutdown = threading.Event()
         self._last_activity = time.monotonic()
         self._sock_ino = None        # inode of OUR bound socket path
@@ -180,8 +181,13 @@ class KernelServer:
                         self._shutdown.set()
                         return
                     elif op == "pagerank":
+                        # device compute under the dispatch lock; the
+                        # reply ships AFTER release — a slow client must
+                        # not hold up every other client's dispatch
                         with self._dispatch_lock:
-                            self._op_pagerank(conn, header, arrays)
+                            reply, out_arrays = self._op_pagerank(
+                                header, arrays)
+                        _send_msg(conn, reply, out_arrays)
                     else:
                         _send_msg(conn, {"ok": False,
                                          "error": f"unknown op {op!r}"})
@@ -196,7 +202,9 @@ class KernelServer:
     MAX_CACHED_GRAPHS = 8     # LRU cap: the daemon is long-lived and a
     #                           DeviceGraph pins device HBM + host arrays
 
-    def _op_pagerank(self, conn, header, arrays) -> None:
+    def _op_pagerank(self, header, arrays):
+        """Runs under _dispatch_lock; returns (reply_header,
+        reply_arrays) for the caller to ship outside the lock."""
         from ..ops import pagerank as pr
         from ..ops.csr import from_coo
         key = header.get("graph_key")
@@ -205,9 +213,8 @@ class KernelServer:
             self._graphs[key] = g              # re-insert: LRU refresh
         if g is None:
             if "src" not in arrays:
-                _send_msg(conn, {"ok": False, "error": "unknown graph_key "
-                                 "and no edge arrays supplied"})
-                return
+                return ({"ok": False, "error": "unknown graph_key "
+                         "and no edge arrays supplied"}, None)
             g = from_coo(arrays["src"].astype(np.int64),
                          arrays["dst"].astype(np.int64),
                          arrays.get("weights"),
@@ -220,9 +227,8 @@ class KernelServer:
             g, damping=header.get("damping", 0.85),
             max_iterations=header.get("max_iterations", 100),
             tol=header.get("tol", 1e-6))
-        _send_msg(conn, {"ok": True, "err": float(err),
-                         "iters": int(iters)},
-                  {"ranks": np.asarray(ranks, dtype=np.float32)})
+        return ({"ok": True, "err": float(err), "iters": int(iters)},
+                {"ranks": np.asarray(ranks, dtype=np.float32)})
 
 
 # --------------------------------------------------------------------------

@@ -38,6 +38,7 @@ from io import BytesIO
 
 from ...exceptions import DurabilityError
 from ...utils import faultinject as FI
+from ...utils.locks import tracked_lock
 from ..property_store import _read_varint, _write_varint, decode_value, \
     encode_value
 
@@ -318,7 +319,7 @@ class WalFile:
         os.makedirs(self.dir, exist_ok=True)
         self.segment_size = getattr(storage.config, "wal_segment_size",
                                     DEFAULT_SEGMENT_SIZE)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("WalFile._lock")
         self.sync_every_commit = sync_every_commit
         self.storage = storage
         self._seq = next_segment_seq(self.dir)

@@ -17,6 +17,7 @@ import bisect
 import threading
 from collections import defaultdict
 
+from ..utils.locks import tracked_lock
 from .ordering import order_key
 
 
@@ -31,7 +32,7 @@ class LabelIndex:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("LabelIndex._lock")
         self._index: dict[int, dict] = {}
         self._ready: dict[int, threading.Event] = {}
 
@@ -67,6 +68,11 @@ class LabelIndex:
             except Exception:
                 # failed population: drop the shell so readers keep the
                 # (correct) fallback path and DDL can retry
+                import logging
+                logging.getLogger(__name__).exception(
+                    "background population of label index %d failed — "
+                    "dropping the shell; CREATE INDEX can be retried",
+                    label_id)
                 self.drop(label_id)
                 still_ours = False
             # ALWAYS wake waiters; serving is gated on the registry so a
@@ -158,7 +164,7 @@ class LabelPropertyIndex:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("LabelPropertyIndex._lock")
         # key -> {"sorted": list[(key_tuple, gid, vertex, values)],
         #         "by_gid": dict[gid, set[key_tuple]],
         #         "eq": dict[key_tuple, list[vertex]]}   (point lookups)

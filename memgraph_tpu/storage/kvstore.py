@@ -10,14 +10,15 @@ from __future__ import annotations
 
 import os
 import sqlite3
-import threading
 from typing import Iterator, Optional
+
+from ..utils.locks import tracked_lock
 
 
 class KVStore:
     def __init__(self, path: str) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("KVStore._lock")
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute(

@@ -13,6 +13,7 @@ import math
 import threading
 from collections import defaultdict
 
+from ..exceptions import TypeException
 from ..utils.point import Point
 
 
@@ -72,8 +73,8 @@ class PointIndex:
                             (cx + dx, cy + dy), {}).items():
                         try:
                             d = center.distance(p)
-                        except Exception:
-                            continue
+                        except TypeException:
+                            continue  # mixed-CRS point is never a hit
                         if d <= radius:
                             out.append((gid, d))
         out.sort(key=lambda t: t[1])

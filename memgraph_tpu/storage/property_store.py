@@ -222,8 +222,8 @@ def decode_value(buf: BytesIO):
         try:
             from zoneinfo import ZoneInfo
             dt = dt.astimezone(ZoneInfo(tzname))
-        except Exception:
-            pass
+        except (ImportError, KeyError, ValueError, OSError):
+            pass  # unknown/unavailable tz db: keep UTC instant
         return ZonedDateTime(dt)
     if tag == T_ENUM:
         from .enums import EnumValue

@@ -23,6 +23,7 @@ from typing import Callable, Iterator, Optional
 
 from ..exceptions import ConstraintViolation, SerializationError, StorageError
 from ..utils.ids import NameIdMapper
+from ..utils.locks import tracked_lock
 from .common import (TRANSACTION_ID_START, Gid, IsolationLevel, StorageMode,
                      View)
 from .constraints import Constraints
@@ -1048,11 +1049,11 @@ class InMemoryStorage:
         self._edges: dict[Gid, Edge] = {}
         self._next_vertex_gid = 0
         self._next_edge_gid = 0
-        self._gid_lock = threading.Lock()
+        self._gid_lock = tracked_lock("Storage._gid_lock")
 
         self._timestamp = 1  # commit timestamps; 0 reserved
         self._next_txn_id = TRANSACTION_ID_START + 1
-        self._engine_lock = threading.Lock()
+        self._engine_lock = tracked_lock("Storage._engine_lock")
         self._active_txns: dict[int, Transaction] = {}
         # frame shipping order: sequence assigned under the engine lock,
         # consumers invoked strictly in sequence order (replicas must see
@@ -1066,7 +1067,7 @@ class InMemoryStorage:
         # changes_between(); 1024 entries cover bursts of small commits
         from collections import deque
         self._change_log = deque(maxlen=1024)
-        self._change_log_lock = threading.Lock()
+        self._change_log_lock = tracked_lock("Storage._change_log_lock")
         # durability wiring: receives (frame_bytes, commit_ts) under the
         # engine lock, BEFORE the visibility flip (write-ahead ordering)
         self.wal_sink: Optional[Callable] = None

@@ -1,0 +1,250 @@
+"""Runtime lock-order witness: the dynamic half of mglint's MG001.
+
+``tracked_lock("Class.attr")`` is a drop-in replacement for
+``threading.Lock()`` at lock *creation* sites. Unarmed (the default) it
+returns a plain ``threading.Lock`` — zero overhead, byte-identical
+behavior. Armed via ``MG_TRACK_LOCKS=1`` it returns a ``TrackedLock``
+that records every "acquired B while holding A" edge into a global
+digraph, with the acquiring file:line, and checks incrementally for
+cycles: the first edge that closes a cycle is recorded as a violation
+(and logged loudly) without blocking the program.
+
+The test suite arms this (tests/conftest.py) and asserts at session end
+that the witnessed graph is acyclic — so the static analysis (MG001,
+which under-approximates: dynamic dispatch and unresolvable receivers
+contribute no edges) and the dynamic witness (which only sees executed
+interleavings) validate each other from opposite sides.
+
+Lock names are class-scoped (``Storage._engine_lock``), not instance-
+scoped: two instances of the same class count as ONE node, so nesting
+two ``ReplicaClient._lock`` instances is reported as a self-edge. That
+is deliberate — same-class instances locked in an unordered way are
+exactly the two-thread deadlock the witness exists to catch (the fix is
+an explicit tiebreak order, e.g. by gid, not an exemption).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "MG_TRACK_LOCKS"
+
+
+def armed() -> bool:
+    return os.environ.get(ENV_VAR, "") not in ("", "0")
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by assert_acyclic() when the witnessed graph has a cycle."""
+
+
+# --- global witness state ---------------------------------------------------
+
+# the witness's own mutex is a strict leaf: nothing is acquired under it
+_W_LOCK = threading.Lock()
+#: (from_name, to_name) -> first-seen site "file:line (thread)"
+_EDGES: dict[tuple[str, str], str] = {}
+#: recorded cycles: list of (cycle path tuple, closing site)
+_VIOLATIONS: list[tuple[tuple[str, ...], str]] = []
+_TLS = threading.local()
+
+
+def _held_stack() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def _caller_site(depth: int) -> str:
+    """First stack frame OUTSIDE this module (the user's acquire site)."""
+    try:
+        frame = sys._getframe(depth)
+        while frame is not None and frame.f_code.co_filename == __file__:
+            frame = frame.f_back
+        if frame is None:
+            return "<unknown>"
+        return (f"{frame.f_code.co_filename}:{frame.f_lineno} "
+                f"({threading.current_thread().name})")
+    except ValueError:
+        return "<unknown>"
+
+
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """DFS path src -> dst in the edge graph (caller holds _W_LOCK)."""
+    succ: dict[str, list[str]] = {}
+    for (frm, to) in _EDGES:
+        succ.setdefault(frm, []).append(to)
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in succ.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquired(lock: "TrackedLock") -> None:
+    stack = _held_stack()
+    entry_ids = [e[0] for e in stack]
+    if id(lock) in entry_ids:
+        # reentrant re-acquire of the same instance: no new ordering
+        stack.append((id(lock), lock.name, True))
+        return
+    held_names = [e[1] for e in stack]
+    stack.append((id(lock), lock.name, False))
+    if not held_names:
+        return
+    site = _caller_site(3)
+    with _W_LOCK:
+        for held in held_names:
+            key = (held, lock.name)
+            if key in _EDGES:
+                continue
+            # does the REVERSE direction already exist (possibly via a
+            # longer path)? then this edge closes a cycle.
+            back = _find_path(lock.name, held)
+            _EDGES[key] = site
+            if back is not None:
+                cycle = tuple([held] + back)
+                _VIOLATIONS.append((cycle, site))
+                log.error(
+                    "LOCK-ORDER VIOLATION: acquiring %s while holding "
+                    "%s at %s closes the cycle %s (first-seen sites: "
+                    "%s)", lock.name, held, site, " -> ".join(cycle),
+                    "; ".join(f"{a}->{b} @ {_EDGES[(a, b)]}"
+                              for a, b in zip(cycle, cycle[1:])
+                              if (a, b) in _EDGES))
+
+
+def _note_released(lock: "TrackedLock") -> None:
+    stack = _held_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == id(lock):
+            del stack[i]
+            return
+
+
+class TrackedLock:
+    """Lock wrapper that witnesses acquisition order. Supports the
+    ``with`` protocol plus acquire/release, like threading.Lock."""
+
+    __slots__ = ("name", "_lock", "_reentrant")
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self._reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        _note_released(self)
+        self._lock.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        if self._reentrant:
+            raise AttributeError("RLock has no locked()")
+        return self._lock.locked()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name!r}>"
+
+
+# --- factories (the only API lock-creation sites use) -----------------------
+
+
+def tracked_lock(name: str):
+    """threading.Lock() unarmed; a named TrackedLock under
+    MG_TRACK_LOCKS=1."""
+    if armed():
+        return TrackedLock(name)
+    return threading.Lock()
+
+
+def tracked_rlock(name: str):
+    if armed():
+        return TrackedLock(name, reentrant=True)
+    return threading.RLock()
+
+
+# --- inspection / assertion --------------------------------------------------
+
+
+def edges() -> dict[tuple[str, str], str]:
+    with _W_LOCK:
+        return dict(_EDGES)
+
+
+def violations() -> list[tuple[tuple[str, ...], str]]:
+    with _W_LOCK:
+        return list(_VIOLATIONS)
+
+
+def reset() -> None:
+    with _W_LOCK:
+        _EDGES.clear()
+        _VIOLATIONS.clear()
+
+
+class isolated_witness:
+    """Context manager for tests: run against a clean witness, then
+    restore whatever the surrounding session had recorded so a test's
+    deliberate cycle never fails the session-level assert."""
+
+    def __enter__(self):
+        with _W_LOCK:
+            self._edges = dict(_EDGES)
+            self._violations = list(_VIOLATIONS)
+            _EDGES.clear()
+            _VIOLATIONS.clear()
+        return self
+
+    def __exit__(self, *exc):
+        with _W_LOCK:
+            _EDGES.clear()
+            _EDGES.update(self._edges)
+            _VIOLATIONS[:] = self._violations
+
+
+def witness_report() -> str:
+    with _W_LOCK:
+        lines = [f"lock-order witness: {len(_EDGES)} edge(s), "
+                 f"{len(_VIOLATIONS)} violation(s)"]
+        for (frm, to), site in sorted(_EDGES.items()):
+            lines.append(f"  {frm} -> {to}   first seen {site}")
+        for cycle, site in _VIOLATIONS:
+            lines.append(f"  CYCLE {' -> '.join(cycle)} closed at {site}")
+    return "\n".join(lines)
+
+
+def assert_acyclic() -> None:
+    """Raise LockOrderViolation if any witnessed cycle was recorded."""
+    with _W_LOCK:
+        if not _VIOLATIONS:
+            return
+        detail = "; ".join(
+            f"{' -> '.join(cycle)} (closed at {site})"
+            for cycle, site in _VIOLATIONS)
+    raise LockOrderViolation(
+        f"lock acquisition order has {len(_VIOLATIONS)} witnessed "
+        f"cycle(s): {detail}")

@@ -11,6 +11,14 @@ import os
 # and /root/.axon_site pre-initializes jax, so both the env var AND the jax
 # config must be set.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Arm the runtime lock-order witness (memgraph_tpu/utils/locks.py) for the
+# whole suite: every lock the package creates becomes a TrackedLock, the
+# actual acquisition graph is recorded, and the session fails if any cycle
+# was witnessed (the dynamic validation of mglint's static MG001 rule).
+# Must happen BEFORE any memgraph_tpu import creates a lock; opt out with
+# MG_TRACK_LOCKS=0.
+os.environ.setdefault("MG_TRACK_LOCKS", "1")
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
@@ -26,3 +34,26 @@ import pytest  # noqa: E402
 def storage():
     from memgraph_tpu.storage import InMemoryStorage
     return InMemoryStorage()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Lock-order witness verdict for the whole session."""
+    from memgraph_tpu.utils import locks
+    if not locks.armed():
+        return
+    edges = locks.edges()
+    bad = locks.violations()
+    terminalreporter.write_line(
+        f"lock-order witness: {len(edges)} edge(s) recorded, "
+        f"{len(bad)} cycle(s)"
+        + (" — ACYCLIC" if not bad else " — VIOLATIONS BELOW"))
+    for cycle, site in bad:
+        terminalreporter.write_line(
+            f"  CYCLE {' -> '.join(cycle)} closed at {site}", red=True)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail the run if the witness recorded any lock-order cycle."""
+    from memgraph_tpu.utils import locks
+    if locks.armed() and locks.violations():
+        session.exitstatus = 1

@@ -1,0 +1,33 @@
+"""MG003 fixture: one silent swallow, one suppressed, two clean."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def swallows():
+    try:
+        return 1 / 0
+    except Exception:          # MG003 fires HERE
+        pass
+
+
+def suppressed():
+    try:
+        return 1 / 0
+    except Exception:  # mglint: disable=MG003 — fixture: deliberate
+        pass
+
+
+def logs_it():
+    try:
+        return 1 / 0
+    except Exception:
+        log.warning("failed", exc_info=True)
+
+
+def uses_it(sink):
+    try:
+        return 1 / 0
+    except Exception as e:
+        sink.append(e)

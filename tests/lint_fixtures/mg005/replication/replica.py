@@ -1,0 +1,7 @@
+"""MG005 fixture replica: shares the recovery applier (the invariant)."""
+
+from ..storage.durability.recovery import _apply_wal_txn
+
+
+def apply_frame(storage, ops):
+    return _apply_wal_txn(storage, ops)

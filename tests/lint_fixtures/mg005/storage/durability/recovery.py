@@ -1,0 +1,9 @@
+"""MG005 fixture recovery: replays OP_WIRED only."""
+
+from . import wal as W
+
+
+def _apply_wal_txn(storage, ops):
+    for kind, payload in ops:
+        if kind == W.OP_WIRED:
+            storage.apply(payload)

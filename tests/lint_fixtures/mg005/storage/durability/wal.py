@@ -1,0 +1,12 @@
+"""MG005 fixture WAL: OP_WIRED is fully handled, OP_ORPHAN is not."""
+
+OP_WIRED = 0x01
+OP_ORPHAN = 0x7F       # MG005: never framed, never replayed
+
+
+def frame_record(kind, payload):
+    return bytes([kind]) + payload
+
+
+def encode(payload):
+    return frame_record(OP_WIRED, payload)

@@ -1,0 +1,8 @@
+"""MG005 fixture fire sites: one wired, one unregistered typo."""
+
+from .utils import faultinject as FI
+
+
+def do_write():
+    FI.fire("wired.point")
+    FI.fire("wired.typo")      # MG005: not in KNOWN_POINTS

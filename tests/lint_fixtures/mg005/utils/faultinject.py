@@ -1,0 +1,10 @@
+"""MG005 fixture fault registry: one wired point, one dead one."""
+
+KNOWN_POINTS = (
+    "wired.point",      # fired below in user.py
+    "dead.point",       # MG005: registered but never fired
+)
+
+
+def fire(point):
+    return None

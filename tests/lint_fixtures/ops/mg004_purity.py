@@ -1,0 +1,32 @@
+"""MG004 fixture: host side effects inside a jitted op (never imported,
+only parsed — jax/np here are decorative)."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n_pad",))
+def impure_kernel(x, n_pad):
+    print("tracing")                # MG004: print in jit
+    y = np.asarray(x)               # MG004: np on traced arg
+    pad = np.zeros(n_pad)           # clean: n_pad is in static_argnames
+    return y, pad
+
+
+@partial(jax.jit, static_argnames=("n",))
+def clean_kernel(x, n):
+    import jax.numpy as jnp
+    return jnp.sum(x) + n           # pure: must NOT fire
+
+
+def helper_with_sleep(v):
+    import time
+    time.sleep(0.1)                 # MG004 via reachability
+    return v
+
+
+@jax.jit
+def reaches_helper(x):
+    return helper_with_sleep(x)

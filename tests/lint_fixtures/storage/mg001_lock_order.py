@@ -1,0 +1,37 @@
+"""MG001 fixture: two locks acquired in both orders — one cycle."""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self.alpha_lock = threading.Lock()
+        self.beta_lock = threading.Lock()
+
+    def forward(self):
+        with self.alpha_lock:
+            with self.beta_lock:       # edge alpha -> beta
+                return 1
+
+    def backward(self):
+        with self.beta_lock:
+            with self.alpha_lock:      # edge beta -> alpha: CYCLE
+                return 2
+
+
+class Ordered:
+    """Decoy: consistent order, must NOT fire."""
+
+    def __init__(self):
+        self.first_lock = threading.Lock()
+        self.second_lock = threading.Lock()
+
+    def one(self):
+        with self.first_lock:
+            with self.second_lock:
+                return 1
+
+    def two(self):
+        with self.first_lock:
+            with self.second_lock:
+                return 2

@@ -1,0 +1,20 @@
+"""MG002 fixture: fsync held under a lock (and a clean decoy)."""
+
+import os
+import threading
+
+
+class Syncer:
+    def __init__(self, f):
+        self._commit_lock = threading.Lock()
+        self._f = f
+
+    def bad(self):
+        with self._commit_lock:
+            os.fsync(self._f.fileno())     # MG002: fsync under lock
+
+    def good(self):
+        with self._commit_lock:
+            n = self._f.tell()
+        os.fsync(self._f.fileno())         # outside the lock: clean
+        return n

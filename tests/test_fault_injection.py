@@ -126,6 +126,10 @@ def _run_child(tmp_path, faults, n=30, snapshot_every=0):
     env = os.environ.copy()
     env["MEMGRAPH_TPU_FAULTS"] = faults
     env["JAX_PLATFORMS"] = "cpu"
+    # lock-order witness armed in the child too: the kill-matrix drives
+    # the WAL/snapshot/replication paths PR 2 added, exactly where a
+    # nesting inversion would bite
+    env.setdefault("MG_TRACK_LOCKS", "1")
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     if snapshot_every:
         env["CRASH_CHILD_SNAPSHOT"] = str(snapshot_every)

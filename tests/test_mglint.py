@@ -1,0 +1,228 @@
+"""mglint: tier-1 gate + per-rule fixture tests + lock-order witness.
+
+The gate test runs the analyzer over memgraph_tpu/ exactly like
+`python -m tools.mglint memgraph_tpu/` and fails on any unbaselined
+finding — so a new lock inversion, swallowed exception, impure kernel,
+or unwired WAL opcode/fault point fails CI the commit it appears.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.mglint.core import Project, load_baseline, run_rules  # noqa: E402
+
+
+def _run(paths, baseline=None, only=None):
+    project = Project([os.path.join(REPO, p) for p in paths], cwd=REPO)
+    return run_rules(project, baseline or {}, only=only)
+
+
+def _hits(result, rule):
+    return [(f.path.split("/")[-1], f.line) for f in result.findings
+            if f.rule == rule]
+
+
+# --- the gate ---------------------------------------------------------------
+
+
+def test_package_has_no_unbaselined_findings():
+    result = _run(["memgraph_tpu"], baseline=load_baseline())
+    assert not result.parse_errors, result.parse_errors
+    assert not result.findings, \
+        "unbaselined mglint findings:\n" + "\n".join(
+            f.render() for f in result.findings)
+
+
+def test_baseline_is_fully_used_and_justified():
+    baseline = load_baseline()   # raises on missing justifications
+    for key, justification in baseline.items():
+        assert len(justification) >= 25, \
+            f"baseline justification for {key} is too thin to mean much"
+    result = _run(["memgraph_tpu"], baseline=baseline)
+    assert not result.unused_baseline, \
+        f"stale baseline entries (fixed or drifted): " \
+        f"{result.unused_baseline}"
+
+
+def test_cli_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mglint", "memgraph_tpu/",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert doc["files_scanned"] > 100
+
+
+def test_cli_nonzero_on_fixtures():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mglint", "tests/lint_fixtures",
+         "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1
+    assert "MG001" in proc.stdout and "MG005" in proc.stdout
+
+
+# --- per-rule fixtures ------------------------------------------------------
+
+
+def test_mg001_fires_on_inversion_only():
+    result = _run(["tests/lint_fixtures"], only={"MG001"})
+    hits = _hits(result, "MG001")
+    assert ("mg001_lock_order.py", 13) in hits
+    assert ("mg001_lock_order.py", 18) in hits
+    # the consistently-ordered decoy class stays silent
+    assert all(line in (13, 18) for _p, line in hits), hits
+
+
+def test_mg002_fires_under_lock_only():
+    result = _run(["tests/lint_fixtures"], only={"MG002"})
+    hits = _hits(result, "MG002")
+    assert hits == [("mg002_blocking.py", 14)], hits
+
+
+def test_mg003_fires_on_silent_swallow_only():
+    result = _run(["tests/lint_fixtures"], only={"MG003"})
+    hits = _hits(result, "MG003")
+    # one silent swallow; the logging / exception-using handlers and the
+    # suppressed one stay silent
+    assert hits == [("mg003_swallowed.py", 11)], hits
+    assert result.suppressed_count == 1
+
+
+def test_mg004_fires_on_impurity_only():
+    result = _run(["tests/lint_fixtures"], only={"MG004"})
+    hits = _hits(result, "MG004")
+    assert ("mg004_purity.py", 12) in hits   # print
+    assert ("mg004_purity.py", 13) in hits   # np on traced arg
+    assert ("mg004_purity.py", 26) in hits   # sleep via reachability
+    assert len(hits) == 3, hits              # clean_kernel is silent
+
+
+def test_mg005_fires_on_coverage_gaps_only():
+    result = _run(["tests/lint_fixtures"], only={"MG005"})
+    msgs = {f.fingerprint for f in result.findings}
+    assert "wal-op:OP_ORPHAN" in msgs
+    assert "fault-unregistered:wired.typo" in msgs
+    assert "fault-dead:dead.point" in msgs
+    assert len(msgs) == 3, msgs              # OP_WIRED is fully covered
+
+
+def test_suppression_comment_scopes_to_one_handler():
+    # remove the suppression and the second handler must fire too
+    path = os.path.join(FIXTURES, "mg003_swallowed.py")
+    with open(path) as f:
+        text = f.read()
+    stripped = text.replace(
+        "  # mglint: disable=MG003 — fixture: deliberate", "")
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        alt = os.path.join(tmp, "mg003_swallowed.py")
+        with open(alt, "w") as f:
+            f.write(stripped)
+        project = Project([alt], cwd=tmp)
+        result = run_rules(project, {}, only={"MG003"})
+        assert len([f for f in result.findings
+                    if f.rule == "MG003"]) == 2
+
+
+def test_finding_keys_are_line_stable():
+    """Baseline keys must not change when code above a finding moves."""
+    import tempfile
+    src = ("def f():\n    try:\n        pass\n"
+           "    except Exception:\n        pass\n")
+    shifted = "import os\n\n\n" + src
+    keys = []
+    for body in (src, shifted):
+        with tempfile.TemporaryDirectory() as tmp:
+            p = os.path.join(tmp, "m.py")
+            with open(p, "w") as f:
+                f.write(body)
+            result = run_rules(Project([p], cwd=tmp), {},
+                               only={"MG003"})
+            assert len(result.findings) == 1
+            keys.append(result.findings[0].key)
+    assert keys[0] == keys[1]
+
+
+# --- runtime witness (TrackedLock) ------------------------------------------
+
+
+def test_tracked_lock_witnesses_cycle():
+    from memgraph_tpu.utils import locks
+    with locks.isolated_witness():
+        a = locks.TrackedLock("Fix.A")
+        b = locks.TrackedLock("Fix.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(locks.violations()) == 1
+        with pytest.raises(locks.LockOrderViolation) as exc:
+            locks.assert_acyclic()
+        assert "Fix.A" in str(exc.value) and "Fix.B" in str(exc.value)
+    # the surrounding session's witness state is restored
+    assert all("Fix.A" not in f for f, _t in locks.edges())
+
+
+def test_tracked_lock_consistent_order_is_clean():
+    from memgraph_tpu.utils import locks
+    with locks.isolated_witness():
+        a = locks.TrackedLock("Fix.C")
+        b = locks.TrackedLock("Fix.D")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        locks.assert_acyclic()
+        assert ("Fix.C", "Fix.D") in locks.edges()
+
+
+def test_tracked_rlock_reentry_records_no_self_edge():
+    from memgraph_tpu.utils import locks
+    with locks.isolated_witness():
+        r = locks.TrackedLock("Fix.R", reentrant=True)
+        with r:
+            with r:
+                pass
+        assert locks.edges() == {}
+        locks.assert_acyclic()
+
+
+def test_factory_unarmed_returns_plain_lock(monkeypatch):
+    import threading
+    from memgraph_tpu.utils import locks
+    monkeypatch.setenv(locks.ENV_VAR, "0")
+    lk = locks.tracked_lock("X.Y")
+    assert isinstance(lk, type(threading.Lock()))
+    monkeypatch.setenv(locks.ENV_VAR, "1")
+    assert isinstance(locks.tracked_lock("X.Y"), locks.TrackedLock)
+
+
+def test_suite_witness_is_armed_and_recording():
+    """conftest arms MG_TRACK_LOCKS for the tier-1 run; storage commits
+    must actually produce witnessed edges."""
+    from memgraph_tpu.utils import locks
+    if not locks.armed():
+        pytest.skip("witness disarmed via MG_TRACK_LOCKS=0")
+    from memgraph_tpu.storage import InMemoryStorage
+    storage = InMemoryStorage()
+    acc = storage.access()
+    v = acc.create_vertex()
+    v.add_label(1)
+    acc.commit()
+    edges = locks.edges()
+    assert any(frm.startswith("Storage.") for frm, _to in edges), edges
+    locks.assert_acyclic()

@@ -1,0 +1,36 @@
+"""mglint — project-native static analysis for memgraph_tpu.
+
+The hot write path, WAL, fault injection, and replication are
+invariant-heavy: every mutation needs an undo delta, every WAL opcode a
+replay handler, every fault point a registration, and locks must nest in
+one global order. The reference C++ Memgraph leans on sanitizers and
+Jepsen-style checking for this class of bug; this Python reproduction
+gets neither for free. mglint is the replacement: AST-based rules that
+encode the invariants the code review keeps re-checking by hand, run in
+tier-1 forever (tests/test_mglint.py).
+
+Rules:
+    MG001  lock-order        static lock-nesting graph; order inversions
+    MG002  blocking-under-lock  fsync/socket/sleep/subprocess in a
+                                critical section
+    MG003  swallowed-exception  broad except that neither logs,
+                                re-raises, nor routes the error
+    MG004  jax-purity        host side effects inside jitted ops
+    MG005  registry-coverage WAL opcodes and fault points fully wired
+
+Usage:
+    python -m tools.mglint memgraph_tpu/            # human output
+    python -m tools.mglint --json memgraph_tpu/     # machine output
+
+Inline suppression:  # mglint: disable=MG003 — <why>
+Accepted findings live in tools/mglint/baseline.json, one justification
+per entry. Exit is non-zero on any unbaselined finding.
+
+The runtime counterpart is memgraph_tpu/utils/locks.py (TrackedLock):
+MG001 proves the *static* acquisition graph acyclic; TrackedLock, armed
+with MG_TRACK_LOCKS=1, witnesses the *dynamic* graph during the test
+suite and fails on cycles.
+"""
+
+from .core import Finding, Project, load_baseline, run_rules  # noqa: F401
+from .registry import RULES, register  # noqa: F401

@@ -1,0 +1,95 @@
+"""mglint command line: `python -m tools.mglint [paths...]`.
+
+Exit codes: 0 clean (or everything baselined/suppressed), 1 unbaselined
+findings, 2 bad invocation / broken baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (DEFAULT_BASELINE, Project, load_baseline,
+                   run_rules)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mglint",
+        description="memgraph_tpu project-native static analysis")
+    p.add_argument("paths", nargs="*", default=["memgraph_tpu"],
+                   help="files or directories to analyze "
+                        "(default: memgraph_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON output")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (default: tools/mglint/"
+                        "baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: show every finding")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="MG00X",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings covered by the baseline")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from . import rules as _rules  # noqa: F401
+        from .registry import RULES
+        for rule_id in sorted(RULES):
+            entry = RULES[rule_id]
+            first_line = (entry.doc or "").splitlines()[0] if entry.doc \
+                else ""
+            print(f"{rule_id}  {entry.name:24s} {first_line}")
+        return 0
+
+    try:
+        baseline = {} if args.no_baseline else \
+            load_baseline(args.baseline)
+    except (ValueError, OSError) as e:
+        print(f"mglint: broken baseline: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or ["memgraph_tpu"]
+    project = Project(paths)
+    if not project.files:
+        print(f"mglint: no Python files under {paths}",
+              file=sys.stderr)
+        return 2
+    only = {r.upper() for r in args.rule} if args.rule else None
+    result = run_rules(project, baseline, only=only)
+
+    if args.json:
+        doc = {
+            "findings": [f.as_dict() for f in result.findings],
+            "baselined": [f.as_dict() for f in result.baselined],
+            "suppressed": result.suppressed_count,
+            "unused_baseline": result.unused_baseline,
+            "parse_errors": result.parse_errors,
+            "files_scanned": len(project.files),
+        }
+        print(json.dumps(doc, indent=2))
+        return 1 if (result.findings or result.parse_errors) else 0
+
+    for err in result.parse_errors:
+        print(f"PARSE ERROR: {err}")
+    for f in result.findings:
+        print(f.render())
+    if args.show_baselined:
+        for f in result.baselined:
+            print(f"(baselined) {f.render()}")
+    for key in result.unused_baseline:
+        print(f"note: unused baseline entry: {key}")
+    n, b, s = (len(result.findings), len(result.baselined),
+               result.suppressed_count)
+    print(f"mglint: {len(project.files)} files, {n} finding(s), "
+          f"{b} baselined, {s} suppressed")
+    return 1 if (result.findings or result.parse_errors) else 0

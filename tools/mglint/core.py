@@ -1,0 +1,229 @@
+"""mglint core: project model, findings, suppressions, baseline.
+
+A `Project` parses every .py file under the scan roots exactly once and
+hands rules a uniform view (path -> AST + source lines + suppression
+map). Findings carry a *stable* key — rule : relative path : enclosing
+symbol : rule-chosen fingerprint — deliberately excluding line numbers,
+so a baseline entry survives unrelated edits to the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mglint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*[—#-].*)?$")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str            # "MG001"
+    path: str            # posix-style path relative to the scan cwd
+    line: int
+    col: int
+    message: str
+    symbol: str = ""     # enclosing qualname ("Class.method") or ""
+    fingerprint: str = ""  # rule-chosen stable detail (never a line no.)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.fingerprint}"
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}{sym}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "symbol": self.symbol, "key": self.key}
+
+
+class SourceFile:
+    """One parsed file: AST, raw lines, and the suppression line-map."""
+
+    def __init__(self, path: str, rel_path: str, text: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._suppressed: dict[int, set[str]] | None = None
+
+    @property
+    def suppressed(self) -> dict[int, set[str]]:
+        """line number -> set of rule ids disabled on that line.
+
+        A trailing comment covers its own line; a standalone comment
+        line covers itself and the next line.
+        """
+        if self._suppressed is None:
+            out: dict[int, set[str]] = {}
+            try:
+                tokens = list(tokenize.generate_tokens(
+                    StringIO(self.text).readline))
+            except (tokenize.TokenError, IndentationError):
+                tokens = []
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip().upper() for r in m.group(1).split(",")
+                         if r.strip()}
+                line = tok.start[0]
+                out.setdefault(line, set()).update(rules)
+                # standalone comment: also covers the next non-comment
+                # line (multi-line justification comments are one unit)
+                if self.lines[line - 1].lstrip().startswith("#"):
+                    nxt = line + 1
+                    while nxt <= len(self.lines) and \
+                            self.lines[nxt - 1].lstrip().startswith("#"):
+                        nxt += 1
+                    out.setdefault(nxt, set()).update(rules)
+            self._suppressed = out
+        return self._suppressed
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        got = self.suppressed.get(line, ())
+        return rule in got or "ALL" in got
+
+
+class Project:
+    """All parsed sources under the scan roots."""
+
+    def __init__(self, roots: list[str], cwd: str | None = None):
+        self.cwd = os.path.abspath(cwd or os.getcwd())
+        self.files: dict[str, SourceFile] = {}   # rel_path -> SourceFile
+        self.errors: list[str] = []
+        for root in roots:
+            root = os.path.abspath(root)
+            if os.path.isfile(root):
+                self._load(root)
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__",))
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        self._load(os.path.join(dirpath, name))
+
+    def _load(self, path: str) -> None:
+        rel = os.path.relpath(path, self.cwd).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            self.files[rel] = SourceFile(path, rel, text)
+        except (OSError, SyntaxError, ValueError) as e:
+            self.errors.append(f"{rel}: cannot parse: {e}")
+
+    def by_suffix(self, suffix: str) -> "SourceFile | None":
+        """The unique file whose relative path ends with `suffix`
+        (posix-style), or None."""
+        hits = [sf for rel, sf in self.files.items()
+                if rel.endswith(suffix)]
+        return hits[0] if len(hits) == 1 else None
+
+
+# --- qualname helper used by several rules ---------------------------------
+
+
+def attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._mglint_parent = node  # type: ignore[attr-defined]
+
+
+def qualname_of(node: ast.AST) -> str:
+    """Dotted Class.method / function name enclosing `node` (best effort;
+    requires attach_parents() on the tree)."""
+    parts: list[str] = []
+    cur = getattr(node, "_mglint_parent", None)
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        parts.append(node.name)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_mglint_parent", None)
+    return ".".join(reversed(parts))
+
+
+# --- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: str | None = None) -> dict[str, str]:
+    """baseline.json -> {finding key: justification}. Every entry MUST
+    carry a non-empty justification — an unexplained baseline entry is
+    itself an error (raised here so the tier-1 gate catches it)."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    out: dict[str, str] = {}
+    for entry in doc.get("entries", ()):
+        key = entry.get("key", "")
+        just = (entry.get("justification") or "").strip()
+        if not key:
+            raise ValueError(f"{path}: baseline entry without a key")
+        if not just:
+            raise ValueError(
+                f"{path}: baseline entry {key!r} has no justification — "
+                "every accepted finding must say why it is accepted")
+        out[key] = just
+    return out
+
+
+# --- driver -----------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)      # unbaselined
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed_count: int = 0
+    unused_baseline: list[str] = field(default_factory=list)
+    parse_errors: list[str] = field(default_factory=list)
+
+
+def run_rules(project: Project, baseline: dict[str, str] | None = None,
+              only: set[str] | None = None) -> RunResult:
+    # importing .rules registers every rule exactly once
+    from . import rules as _rules  # noqa: F401
+    from .registry import RULES
+
+    baseline = baseline or {}
+    result = RunResult(parse_errors=list(project.errors))
+    seen_keys: set[str] = set()
+    for rule_id in sorted(RULES):
+        if only and rule_id not in only:
+            continue
+        for finding in RULES[rule_id](project):
+            sf = project.files.get(finding.path)
+            if sf is not None and sf.is_suppressed(finding.rule,
+                                                   finding.line):
+                result.suppressed_count += 1
+                continue
+            seen_keys.add(finding.key)
+            if finding.key in baseline:
+                result.baselined.append(finding)
+            else:
+                result.findings.append(finding)
+    if not only:
+        result.unused_baseline = sorted(k for k in baseline
+                                        if k not in seen_keys)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

@@ -1,0 +1,32 @@
+"""Rule registry: one decorator, one dict, deterministic order."""
+
+from __future__ import annotations
+
+#: rule id -> rule callable(project) -> iterable[Finding]
+RULES: dict[str, "RuleEntry"] = {}
+
+
+class RuleEntry:
+    __slots__ = ("rule_id", "name", "doc", "fn")
+
+    def __init__(self, rule_id: str, name: str, doc: str, fn):
+        self.rule_id = rule_id
+        self.name = name
+        self.doc = doc
+        self.fn = fn
+
+    def __call__(self, project):
+        return self.fn(project)
+
+
+def register(rule_id: str, name: str):
+    """Register a rule function under `rule_id` (e.g. "MG001")."""
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = RuleEntry(rule_id, name,
+                                   (fn.__doc__ or "").strip(), fn)
+        return fn
+
+    return deco

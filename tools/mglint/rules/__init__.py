@@ -1,0 +1,7 @@
+"""Importing this package registers every rule in the registry."""
+
+from . import lock_order  # noqa: F401
+from . import blocking_under_lock  # noqa: F401
+from . import swallowed_exception  # noqa: F401
+from . import jax_purity  # noqa: F401
+from . import registry_coverage  # noqa: F401

@@ -1,0 +1,202 @@
+"""MG004 — jax-purity: no host side effects inside jitted ops.
+
+Functions that reach ``jax.jit`` / ``pjit`` / ``pallas_call`` in
+``ops/`` trace ONCE and replay as compiled XLA programs; a ``print``,
+``time.time()``, Python ``random``, host mutation, or a ``np.``
+call on a traced argument either silently freezes a trace-time value
+into the compiled program (wrong results on the second call) or breaks
+fusion with a host round-trip. GraphBLAST-style kernel-purity
+discipline is what keeps fused TPU paths correct as they grow.
+
+Jit regions are: functions decorated with ``@jax.jit`` / ``@jit`` /
+``@partial(jax.jit, ...)``, functions wrapped inline via
+``jax.jit(f)``, nested functions defined inside a jit region, and
+same-module functions called from one (transitively).
+
+Inside a region this rule flags:
+  * ``print(...)``               (use jax.debug.print)
+  * ``time.time/perf_counter/monotonic/sleep``
+  * Python stdlib ``random.*``   (use jax.random with explicit keys)
+  * ``np.<fn>(...)`` applied directly to a traced parameter of the
+    jitted entry function (static_argnames are exempt)
+  * ``os.environ`` mutation, ``open(...)``, ``.block_until_ready()``
+  * ``global`` / ``nonlocal`` declarations (trace-time host mutation)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project
+from ..locking import dotted
+from ..registry import register
+
+_JIT_NAMES = {"jit", "pjit"}
+_TIME_BAD = {"time.time", "time.perf_counter", "time.monotonic",
+             "time.sleep", "time.process_time"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+def _jit_static_args(deco: ast.AST) -> tuple[bool, set[str]]:
+    """(is_jit_decorator, static arg names)."""
+    if isinstance(deco, (ast.Name, ast.Attribute)):
+        name = dotted(deco) or ""
+        short = name.split(".")[-1]
+        return short in _JIT_NAMES, set()
+    if isinstance(deco, ast.Call):
+        fn_name = dotted(deco.func) or ""
+        short = fn_name.split(".")[-1]
+        if short in _JIT_NAMES:
+            return True, _static_names(deco)
+        if short == "partial" and deco.args:
+            inner = dotted(deco.args[0]) or ""
+            if inner.split(".")[-1] in _JIT_NAMES:
+                return True, _static_names(deco)
+    return False, set()
+
+
+def _static_names(call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        out.add(el.value)
+            elif isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                out.add(kw.value.value)
+    return out
+
+
+class _ModuleScan:
+    """Per-module jit-region discovery."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.funcs: dict[str, ast.AST] = {}      # local name -> def node
+        self.jit_roots: dict[str, set[str]] = {}  # name -> static args
+        self.calls: dict[str, set[str]] = {}      # caller -> callee names
+        self._index(sf.tree, prefix="")
+        self._find_inline_jit(sf.tree)
+
+    def _index(self, tree: ast.AST, prefix: str) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            self.funcs.setdefault(node.name, node)
+            callees = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    callees.add(sub.func.id)
+            self.calls[node.name] = callees
+            for deco in node.decorator_list:
+                is_jit, static = _jit_static_args(deco)
+                if is_jit:
+                    self.jit_roots[node.name] = static
+
+    def _find_inline_jit(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name.split(".")[-1] in _JIT_NAMES and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in self.funcs:
+                    self.jit_roots.setdefault(arg.id,
+                                              _static_names(node))
+
+    def jit_region(self) -> dict[str, tuple[str, set[str]]]:
+        """function name -> (root name, root's static args) for every
+        function transitively reachable from a jit root via same-module
+        calls."""
+        region: dict[str, tuple[str, set[str]]] = {}
+        work = [(root, root) for root in self.jit_roots]
+        while work:
+            name, root = work.pop()
+            if name in region or name not in self.funcs:
+                continue
+            region[name] = (root, self.jit_roots.get(root, set()))
+            for callee in self.calls.get(name, ()):
+                if callee in self.funcs and callee not in region:
+                    work.append((callee, root))
+        return region
+
+
+def _traced_params(fn: ast.AST, static: set[str]) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args
+             + args.kwonlyargs]
+    return {n for n in names if n not in static and n != "self"}
+
+
+@register("MG004", "jax-purity")
+def check(project: Project):
+    """No host side effects inside jit-reachable ops/ functions."""
+    findings = []
+    for rel, sf in project.files.items():
+        if "/ops/" not in f"/{rel}":
+            continue
+        scan = _ModuleScan(sf)
+        region = scan.jit_region()
+        if not region:
+            continue
+        seen: set[tuple[int, int, str]] = set()
+        for name, (root, static) in sorted(region.items()):
+            fn = scan.funcs[name]
+            is_root = name == root
+            traced = _traced_params(fn, static) if is_root else set()
+            for node in ast.walk(fn):
+                bad = _classify(node, traced, is_root)
+                if bad is None:
+                    continue
+                mark = (node.lineno, getattr(node, "col_offset", 0),
+                        bad)
+                if mark in seen:   # nested defs walk twice
+                    continue
+                seen.add(mark)
+                where = name if is_root else f"{name} (reached from " \
+                    f"jitted {root})"
+                findings.append(Finding(
+                    rule="MG004", path=rel, line=node.lineno,
+                    col=getattr(node, "col_offset", 0), symbol=name,
+                    message=f"{bad} inside jit region of {where} — "
+                            "host side effect in a traced function",
+                    fingerprint=f"impure:{bad.split('(')[0].strip()}"
+                                f"@{name}"))
+    return findings
+
+
+def _classify(node: ast.AST, traced: set[str],
+              is_root: bool) -> str | None:
+    if isinstance(node, ast.Global):
+        return "global statement"
+    if isinstance(node, ast.Call):
+        name = dotted(node.func) or ""
+        short = name.split(".")[-1]
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            return "print() call"
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            return "open() call"
+        if name in _TIME_BAD:
+            return f"{name}() call"
+        root_mod = name.split(".")[0]
+        if root_mod == "random":
+            return f"stdlib {name}() call"
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            return ".block_until_ready() call"
+        if root_mod in _NUMPY_ALIASES and is_root and traced:
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in traced:
+                    return f"{name}() on traced argument '{arg.id}'"
+    if isinstance(node, ast.Subscript):
+        tgt = dotted(node.value) or ""
+        if tgt == "os.environ" and isinstance(getattr(node, "ctx", None),
+                                              (ast.Store, ast.Del)):
+            return "os.environ mutation"
+    return None

@@ -1,0 +1,142 @@
+"""MG001 — lock-order: the static lock-acquisition nesting graph must be
+acyclic.
+
+Every observed "lock B acquired while lock A is held" (directly, or via
+a conservatively-resolved call chain) adds edge A -> B. A cycle in that
+graph means two code paths can interleave into a deadlock; a self-edge
+on a non-reentrant lock means one thread can deadlock against itself
+(or two threads against two instances of the same class).
+
+The runtime counterpart is utils/locks.TrackedLock (MG_TRACK_LOCKS=1),
+which witnesses the *dynamic* graph during the test suite. This rule's
+view is an under-approximation (unresolvable receivers contribute no
+edges) while the witness only sees executed interleavings — each covers
+the other's blind side, and both must stay acyclic.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Project
+from ..locking import LockModel
+from ..registry import register
+
+
+def _build_edges(model: LockModel):
+    """(from_id, to_id) -> example site dict."""
+    edges: dict[tuple[str, str], dict] = {}
+
+    def add(frm, to, rel, line, qual, via=None):
+        key = (frm, to)
+        if key not in edges:
+            edges[key] = {"path": rel, "line": line, "qual": qual,
+                          "via": via}
+
+    for fi in model.functions.values():
+        for ev in fi.events:
+            held_ids = [a.lock_id for a in ev.held if a.lock_id]
+            if ev.acquisition is not None and ev.acquisition.lock_id:
+                for h in held_ids:
+                    add(h, ev.acquisition.lock_id, fi.rel_path,
+                        ev.acquisition.line, fi.qualname)
+            elif ev.call is not None:
+                callee = model.callee(ev.call)
+                if callee is None:
+                    continue
+                for target in callee.may_acquire:
+                    for h in held_ids:
+                        add(h, target, fi.rel_path, ev.call.line,
+                            fi.qualname, via=callee.qualname)
+    return edges
+
+
+def _sccs(nodes, succ):
+    """Tarjan SCCs, iterative (analysis code must not recursion-limit)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(succ.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(succ.get(nxt, ()))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out
+
+
+@register("MG001", "lock-order")
+def check(project: Project):
+    """Static lock-nesting graph must be acyclic (deadlock risk)."""
+    model = LockModel(project)
+    edges = _build_edges(model)
+    succ: dict[str, set[str]] = {}
+    nodes: set[str] = set()
+    for (frm, to) in edges:
+        nodes.add(frm)
+        nodes.add(to)
+        succ.setdefault(frm, set()).add(to)
+
+    findings = []
+    # self-edges: re-acquiring a non-reentrant lock id
+    for (frm, to), site in sorted(edges.items()):
+        if frm == to and not model.is_rlock(frm):
+            via = f" (via {site['via']})" if site.get("via") else ""
+            findings.append(Finding(
+                rule="MG001", path=site["path"], line=site["line"],
+                col=0, symbol=site["qual"],
+                message=f"lock {frm} acquired while already held{via} — "
+                        "self-deadlock on a non-reentrant lock (or "
+                        "unordered same-class instances)",
+                fingerprint=f"self-edge:{frm}"))
+
+    for comp in _sccs(sorted(nodes), succ):
+        if len(comp) < 2:
+            continue
+        comp_set = set(comp)
+        cyc = " -> ".join(sorted(comp))
+        # report each edge inside the SCC once, at its example site
+        for (frm, to), site in sorted(edges.items()):
+            if frm in comp_set and to in comp_set and frm != to:
+                via = f" via {site['via']}()" if site.get("via") else ""
+                findings.append(Finding(
+                    rule="MG001", path=site["path"], line=site["line"],
+                    col=0, symbol=site["qual"],
+                    message=f"lock-order cycle [{cyc}]: {frm} -> "
+                            f"{to}{via} participates in an inversion "
+                            "(deadlock risk)",
+                    fingerprint=f"cycle-edge:{frm}->{to}"))
+    return findings

@@ -1,0 +1,184 @@
+"""MG005 — registry-coverage: every WAL opcode and fault point is fully
+wired.
+
+WAL opcodes (``OP_* = 0x..`` in storage/durability/wal.py) need four
+handlers to round-trip a commit through crash recovery AND replication:
+
+  * encode   — referenced in wal.py outside its own assignment
+               (framed by encode_txn_ops / the txn grouping protocol)
+  * replay   — referenced in storage/durability/recovery.py
+               (``_apply_wal_txn``), or handled by wal.py's own
+               ``_group_txns`` protocol layer (TXN_BEGIN / TXN_END)
+  * replication-apply — replication/replica.py must import the shared
+               applier ``_apply_wal_txn`` (one applier for recovery and
+               replicas is the invariant; a replica-side fork would
+               have to re-handle every opcode)
+
+A new opcode with a missing replay arm recovers to silent data loss;
+the reference enforces this with exhaustive switch statements the
+compiler checks — this rule is the Python stand-in.
+
+Fault points: every ``fire("x")`` / ``faulty_write("x", ...)`` site
+must name a point registered in utils/faultinject.py KNOWN_POINTS (a
+typo'd point silently never fires), and every registered point must
+have at least one live fire site (a dead registration means a fault
+campaign "covers" a path that no longer exists).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project
+from ..locking import dotted
+from ..registry import register
+
+
+def _op_constants(sf) -> dict[str, int]:
+    out = {}
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id.startswith("OP_") \
+                and isinstance(stmt.value, ast.Constant):
+            out[stmt.targets[0].id] = (stmt.value.value,
+                                       stmt.lineno)
+    return out
+
+
+def _names_used(tree: ast.AST) -> set[str]:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            used.add(node.attr)
+    return used
+
+
+def _names_in_function(tree: ast.AST, fn_name: str) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == fn_name:
+            return _names_used(node)
+    return set()
+
+
+@register("MG005", "registry-coverage")
+def check(project: Project):
+    """WAL opcodes and fault points must be fully wired end to end."""
+    findings = []
+    findings.extend(_check_wal_opcodes(project))
+    findings.extend(_check_fault_points(project))
+    return findings
+
+
+def _check_wal_opcodes(project: Project):
+    wal = project.by_suffix("durability/wal.py")
+    if wal is None:
+        return []
+    recovery = project.by_suffix("durability/recovery.py")
+    replica = project.by_suffix("replication/replica.py")
+    ops = _op_constants(wal)
+    if not ops:
+        return []
+
+    # encode side: any use in wal.py beyond the defining assignment
+    wal_uses: dict[str, int] = {}
+    for node in ast.walk(wal.tree):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and \
+                node.id.startswith("OP_"):
+            wal_uses[node.id] = wal_uses.get(node.id, 0) + 1
+    group_txn_names = _names_in_function(wal.tree, "_group_txns")
+    recovery_names = _names_used(recovery.tree) \
+        if recovery is not None else set()
+
+    replica_shares_applier = False
+    if replica is not None:
+        for node in ast.walk(replica.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    "recovery" in node.module:
+                if any(a.name == "_apply_wal_txn" for a in node.names):
+                    replica_shares_applier = True
+    replica_names = _names_used(replica.tree) \
+        if replica is not None else set()
+
+    findings = []
+    for op_name, (_value, line) in sorted(ops.items()):
+        missing = []
+        if not wal_uses.get(op_name):
+            missing.append("encode (never framed in wal.py)")
+        replayed = op_name in recovery_names or \
+            op_name in group_txn_names
+        if not replayed:
+            missing.append("recovery replay (no handler in "
+                           "recovery.py/_group_txns)")
+        repl_ok = replica_shares_applier or op_name in replica_names \
+            or op_name in group_txn_names
+        if not repl_ok:
+            missing.append("replication apply (replica.py neither "
+                           "imports _apply_wal_txn nor handles it)")
+        if missing:
+            findings.append(Finding(
+                rule="MG005", path=wal.rel_path, line=line, col=0,
+                symbol=op_name,
+                message=f"WAL opcode {op_name} is missing handlers: "
+                        + "; ".join(missing),
+                fingerprint=f"wal-op:{op_name}"))
+    return findings
+
+
+def _check_fault_points(project: Project):
+    fi_mod = project.by_suffix("utils/faultinject.py")
+    if fi_mod is None:
+        return []
+    known: dict[str, int] = {}
+    for stmt in fi_mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == "KNOWN_POINTS" \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            for el in stmt.value.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    known[el.value] = stmt.lineno
+
+    findings = []
+    fired: set[str] = set()
+    for rel, sf in project.files.items():
+        if sf is fi_mod:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            short = name.split(".")[-1]
+            if short not in ("fire", "faulty_write"):
+                continue
+            if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            point = node.args[0].value
+            fired.add(point)
+            if known and point not in known:
+                findings.append(Finding(
+                    rule="MG005", path=rel, line=node.lineno,
+                    col=node.col_offset, symbol=short,
+                    message=f"fault point {point!r} is not registered "
+                            "in faultinject.KNOWN_POINTS — arming it "
+                            "is impossible and the site never fires",
+                    fingerprint=f"fault-unregistered:{point}"))
+    for point, line in sorted(known.items()):
+        if point not in fired:
+            findings.append(Finding(
+                rule="MG005", path=fi_mod.rel_path, line=line, col=0,
+                symbol="KNOWN_POINTS",
+                message=f"registered fault point {point!r} has no "
+                        "fire()/faulty_write() site — dead "
+                        "registration, campaigns covering it test "
+                        "nothing",
+                fingerprint=f"fault-dead:{point}"))
+    return findings

@@ -1,0 +1,95 @@
+"""MG003 — swallowed-exception: a broad ``except`` must log, re-raise,
+route through RetryPolicy, or otherwise *use* the error.
+
+Flags ``except:``, ``except Exception:``, ``except BaseException:``
+handlers whose body does none of:
+
+  * re-raise (any ``raise``),
+  * call a logging-ish method (exception/warning/error/info/debug/
+    critical, or anything on a logger object),
+  * reference ``RetryPolicy`` / a ``retry_policy`` attribute,
+  * use the bound exception name (``except Exception as e`` followed by
+    shipping ``e`` somewhere is routing, not swallowing).
+
+The undo-delta/replication stack is exactly where a silently-dropped
+error turns into a wedged replica or a half-applied commit; when a
+swallow IS the contract (e.g. Cypher's ``toInteger`` returning null),
+say so with an inline ``# mglint: disable=MG003 — why`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, attach_parents, qualname_of
+from ..registry import register
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGING_METHODS = {"exception", "warning", "error", "info", "debug",
+                    "critical", "log", "warn"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = node.id if isinstance(node, ast.Name) else (
+            node.attr if isinstance(node, ast.Attribute) else None)
+        if name in _BROAD:
+            return True
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body raises, logs, retries, or uses the
+    bound exception."""
+    bound = handler.name
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Name):
+            if node.id == "RetryPolicy":
+                return True
+            if bound and node.id == bound:
+                return True
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("retry_policy", "RetryPolicy"):
+                return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in _LOGGING_METHODS:
+                return True
+    return False
+
+
+@register("MG003", "swallowed-exception")
+def check(project: Project):
+    """Broad except must log, re-raise, retry, or use the error."""
+    findings = []
+    for rel, sf in project.files.items():
+        attach_parents(sf.tree)
+        per_scope: dict[str, int] = {}
+        hits = [n for n in ast.walk(sf.tree)
+                if isinstance(n, ast.ExceptHandler)]
+        for node in sorted(hits, key=lambda n: (n.lineno,
+                                                n.col_offset)):
+            if not _is_broad(node) or _handles(node):
+                continue
+            qual = qualname_of(node)
+            nth = per_scope.get(qual, 0)
+            per_scope[qual] = nth + 1
+            shape = "bare except" if node.type is None else \
+                "except Exception" if not node.name else \
+                f"except Exception as {node.name} (unused)"
+            findings.append(Finding(
+                rule="MG003", path=rel, line=node.lineno,
+                col=node.col_offset, symbol=qual,
+                message=f"{shape} swallows the error: neither logs, "
+                        "re-raises, routes through RetryPolicy, nor "
+                        "uses the exception",
+                fingerprint=f"swallow#{nth}@{qual or 'module'}"))
+    return findings
