@@ -40,7 +40,8 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     ctype = "text/plain; version=0.0.4"
             else:
                 info = dict(ictx.storage.info())
-                info["running_queries"] = len(ictx.running_queries)
+                with ictx._rq_lock:
+                    info["running_queries"] = len(ictx.running_queries)
                 body = json.dumps(info)
                 ctype = "application/json"
             payload = body.encode("utf-8")

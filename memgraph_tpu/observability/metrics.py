@@ -12,6 +12,7 @@ import threading
 from collections import defaultdict
 
 from ..utils.locks import tracked_lock
+from ..utils.sanitize import shared_field, shared_read, shared_write
 
 
 def _promname(name: str) -> str:
@@ -28,17 +29,22 @@ class Metrics:
         # _count/_sum must be monotonic or rate() queries see resets
         self._hist_count: dict[str, int] = defaultdict(int)
         self._hist_sum: dict[str, float] = defaultdict(float)
+        shared_field(self, "_counters", "_gauges", "_histograms",
+                     "_hist_count", "_hist_sum")
 
     def increment(self, name: str, delta: int = 1) -> None:
         with self._lock:
+            shared_write(self, "_counters")
             self._counters[name] += delta
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
+            shared_write(self, "_gauges")
             self._gauges[name] = value
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
+            shared_write(self, "_histograms")
             h = self._histograms[name]
             h.append(value)
             self._hist_count[name] += 1
@@ -48,6 +54,7 @@ class Metrics:
 
     def snapshot(self) -> list[tuple[str, str, float]]:
         with self._lock:
+            shared_read(self, "_counters")
             out = [(n, "Counter", float(v))
                    for n, v in sorted(self._counters.items())]
             out += [(n, "Gauge", float(v))
@@ -64,6 +71,7 @@ class Metrics:
     def prometheus_text(self) -> str:
         lines = []
         with self._lock:
+            shared_read(self, "_counters")
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
             histograms = {n: list(v)

@@ -126,8 +126,10 @@ class MonitoringServer:
         self.host, self.port = host, port
         self.auth = auth
         self.metrics = metrics
+        from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
         self._sessions: list = []       # (socket, lock) of live sessions
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("MonitoringServer._lock")
         self._srv: socket.socket | None = None
         self._stop = threading.Event()
         self._log_handler: logging.Handler | None = None
@@ -136,7 +138,11 @@ class MonitoringServer:
         # a stalled monitoring client can never block a writer thread
         import queue as _queue
         self._queue: _queue.Queue = _queue.Queue(self.QUEUE_CAPACITY)
+        # drop counting is a read-modify-write from arbitrary logging
+        # threads: it needs its own leaf lock, not the sessions lock
+        self._stats_lock = tracked_lock("MonitoringServer._stats_lock")
         self.dropped_records = 0
+        shared_field(self, "_sessions", "dropped_records")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -183,7 +189,12 @@ class MonitoringServer:
         try:
             self._queue.put_nowait(obj)
         except queue.Full:
-            self.dropped_records += 1
+            # racy `self.dropped_records += 1` lost drops under
+            # concurrent logging (mgsan write-write race, PR 4 sweep)
+            from ..utils.sanitize import shared_write
+            with self._stats_lock:
+                shared_write(self, "dropped_records")
+                self.dropped_records += 1
 
     def _drain_loop(self) -> None:
         import queue as _queue

@@ -33,8 +33,14 @@ class Telemetry:
         self.first_beat_sec = first_beat_sec
         self.run_id = self._load_run_id(kvstore)
         self.started_at = time.time()
+        # beat bookkeeping is written by the telemetry thread and read by
+        # status collectors / tests on other threads — guarded state
+        from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
+        self._stats_lock = tracked_lock("Telemetry._stats_lock")
         self.beats_sent = 0
         self.last_error: str | None = None
+        shared_field(self, "beats_sent", "last_error")
         self._collectors: dict[str, callable] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -77,14 +83,19 @@ class Telemetry:
         req = urllib.request.Request(
             self.endpoint, data=payload,
             headers={"Content-Type": "application/json"})
+        from ..utils.sanitize import shared_write
         try:
             with urllib.request.urlopen(req, timeout=10) as resp:
                 resp.read()
-            self.beats_sent += 1
-            self.last_error = None
+            with self._stats_lock:
+                shared_write(self, "beats_sent")
+                self.beats_sent += 1
+                self.last_error = None
             return True
         except Exception as e:
-            self.last_error = str(e)
+            with self._stats_lock:
+                shared_write(self, "last_error")
+                self.last_error = str(e)
             return False
 
     def start(self) -> None:

@@ -174,8 +174,10 @@ def make_sharded_pagerank_kernel(plan: ShardedMXUPlan, mesh,
     from jax.sharding import NamedSharding, PartitionSpec as P
     try:
         from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    except ImportError:  # older jax: no replication rule for while_loop
+        import functools
+        from jax.experimental.shard_map import shard_map as _shard_map
+        shard_map = functools.partial(_shard_map, check_rep=False)
     from .blob import pack_blob, unblob
     from ..utils.jax_cache import ensure_compile_cache
     ensure_compile_cache()

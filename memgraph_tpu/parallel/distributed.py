@@ -22,7 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax: no replication rule for while_loop
+    import functools
+    from jax.experimental.shard_map import shard_map as _shard_map
+    shard_map = functools.partial(_shard_map, check_rep=False)
 
 from ..ops.csr import DeviceGraph
 

@@ -48,14 +48,23 @@ class InterpreterContext:
     InterpreterContext, interpreter.hpp)."""
 
     def __init__(self, storage: InMemoryStorage, config: Optional[dict] = None):
+        from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
         self.storage = storage
         self.config = config or {}
-        self._plan_cache_lock = threading.Lock()
+        self._plan_cache_lock = tracked_lock(
+            "InterpreterContext._plan_cache_lock")
         self._plan_cache: dict[str, tuple] = {}
         self._ast_cache: dict[str, object] = {}
         self.running_queries: dict[int, dict] = {}
+        # SHOW/TERMINATE TRANSACTIONS iterate this dict from other
+        # sessions' threads while queries register/unregister — the old
+        # unguarded list(items()) could see a mid-resize dict
+        self._rq_lock = tracked_lock("InterpreterContext._rq_lock")
         self._next_query_id = 0
         self._query_id_lock = threading.Lock()
+        shared_field(self, "_plan_cache", "_ast_cache",
+                     "running_queries")
         self.triggers = None       # wired by trigger store
         self.auth = None           # wired by auth subsystem
         self.metrics = None
@@ -66,19 +75,28 @@ class InterpreterContext:
             return self._next_query_id
 
     def cached_parse(self, text: str):
+        from ..utils.sanitize import shared_read, shared_write
         key = text.strip()
-        hit = self._ast_cache.get(key)
+        with self._plan_cache_lock:
+            shared_read(self, "_ast_cache")
+            hit = self._ast_cache.get(key)
         if hit is not None:
             return hit
         node = parse_with_source(text)
-        # only cache cacheable query classes (parameters keep text stable)
-        if len(self._ast_cache) < 1024:
-            self._ast_cache[key] = node
+        # only cache cacheable query classes (parameters keep text stable).
+        # Parse happens OUTSIDE the lock: duplicated work on a cache miss
+        # is benign, serializing parsing is not.
+        with self._plan_cache_lock:
+            shared_write(self, "_ast_cache")
+            if len(self._ast_cache) < 1024:
+                self._ast_cache[key] = node
         return node
 
     def cached_plan(self, text: str, query: A.CypherQuery):
+        from ..utils.sanitize import shared_read, shared_write
         key = text.strip()
         with self._plan_cache_lock:
+            shared_read(self, "_plan_cache")
             hit = self._plan_cache.get(key)
         if hit is not None:
             return hit
@@ -86,6 +104,7 @@ class InterpreterContext:
         import copy
         plan, columns = planner.plan_query(copy.deepcopy(query))
         with self._plan_cache_lock:
+            shared_write(self, "_plan_cache")
             if len(self._plan_cache) < 256:
                 self._plan_cache[key] = (plan, columns)
         return plan, columns
@@ -924,7 +943,8 @@ class Interpreter:
         qinfo = {"query": text, "start": time.time(),
                  "interpreter": self}
         qid = self.ctx.next_query_id()
-        self.ctx.running_queries[qid] = qinfo
+        with self.ctx._rq_lock:
+            self.ctx.running_queries[qid] = qinfo
         self._current_query_info = qid
 
         def rows_iter():
@@ -941,7 +961,8 @@ class Interpreter:
                     row = frame.get("__row__", {})
                     yield [row.get(c) for c in columns]
             finally:
-                self.ctx.running_queries.pop(qid, None)
+                with self.ctx._rq_lock:
+                    self.ctx.running_queries.pop(qid, None)
 
         self._install_stream(rows_iter(), accessor, owns)
         return self._finish_prepare(columns, "rw", is_write)
@@ -1232,7 +1253,9 @@ class Interpreter:
 
     def _show_transactions(self):
         rows = []
-        for qid, info in list(self.ctx.running_queries.items()):
+        with self.ctx._rq_lock:
+            snapshot = list(self.ctx.running_queries.items())
+        for qid, info in snapshot:
             rows.append([str(qid), info.get("query", ""),
                          info.get("username", "")])
         return rows
@@ -1247,8 +1270,9 @@ class Interpreter:
             for expr in node.ids:
                 tid = ctx.evaluator.eval(expr, {})
                 killed = False
-                info = self.ctx.running_queries.get(
-                    int(tid) if str(tid).isdigit() else -1)
+                with self.ctx._rq_lock:
+                    info = self.ctx.running_queries.get(
+                        int(tid) if str(tid).isdigit() else -1)
                 if info is not None:
                     interp = info.get("interpreter")
                     if interp is not None and interp is not self:

@@ -450,7 +450,7 @@ class Streams:
         return [[s.spec.name, s.spec.kind, "|".join(s.spec.topics),
                  s.spec.transform, s.spec.batch_size,
                  "running" if s.running else "stopped",
-                 s.processed_messages, s.last_error]
+                 s.processed_messages, s.last_error]  # mglint: disable=MG006 — s is a Stream, not Telemetry: field-name collision on last_error (unique-owner resolution)
                 for s in sorted(streams, key=lambda s: s.spec.name)]
 
 

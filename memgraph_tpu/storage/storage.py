@@ -24,6 +24,8 @@ from typing import Callable, Iterator, Optional
 from ..exceptions import ConstraintViolation, SerializationError, StorageError
 from ..utils.ids import NameIdMapper
 from ..utils.locks import tracked_lock
+from ..utils.sanitize import (mvcc_event, shared_field, shared_read,
+                              shared_write)
 from .common import (TRANSACTION_ID_START, Gid, IsolationLevel, StorageMode,
                      View)
 from .constraints import Constraints
@@ -170,7 +172,10 @@ class VertexAccessor:
         return dict(self._state(view, need_edges=False).properties)
 
     def get_property(self, prop_id: int, view: View = View.NEW):
-        return self._state(view, need_edges=False).properties.get(prop_id)
+        value = self._state(view, need_edges=False).properties.get(prop_id)
+        mvcc_event("read", txn=self._acc.txn.id, gid=self.vertex.gid,
+                   prop=prop_id, value=value)
+        return value
 
     def in_edges(self, view: View = View.NEW, edge_types=None,
                  from_vertex=None) -> list["EdgeAccessor"]:
@@ -278,7 +283,10 @@ class EdgeAccessor:
         return dict(self._state(view).properties)
 
     def get_property(self, prop_id: int, view: View = View.NEW):
-        return self._state(view).properties.get(prop_id)
+        value = self._state(view).properties.get(prop_id)
+        mvcc_event("read", txn=self._acc.txn.id, gid=("e", self.edge.gid),
+                   prop=prop_id, value=value)
+        return value
 
     def set_property(self, prop_id: int, value) -> object:
         return self._acc._edge_set_property(self.edge, prop_id, value)
@@ -374,6 +382,7 @@ class Accessor:
     def create_vertex(self, gid: Optional[Gid] = None) -> VertexAccessor:
         storage = self.storage
         with storage._gid_lock:
+            shared_write(storage, "_next_vertex_gid")
             if gid is None:
                 gid = storage._next_vertex_gid
                 storage._next_vertex_gid += 1
@@ -381,10 +390,17 @@ class Accessor:
                 if gid in storage._vertices:
                     raise StorageError(f"vertex with gid {gid} already exists")
                 storage._next_vertex_gid = max(storage._next_vertex_gid, gid + 1)
-        vertex = Vertex(gid)
-        if not self._analytical:
-            push_delta(vertex, self.txn, DeltaAction.DELETE_OBJECT, None)
-        storage._vertices[gid] = vertex
+            # publish under the SAME lock as the uniqueness check: two
+            # concurrent explicit-gid creates could both pass the check
+            # and the loser's vertex silently vanished (check-then-act,
+            # MG007 pattern — mgsan sweep). The undo delta goes on BEFORE
+            # publication so a concurrent scanner never sees the vertex
+            # as committed.
+            vertex = Vertex(gid)
+            if not self._analytical:
+                push_delta(vertex, self.txn, DeltaAction.DELETE_OBJECT,
+                           None)
+            storage._vertices[gid] = vertex
         self.txn.touched_vertices[gid] = vertex
         storage._bump_topology({gid})
         return VertexAccessor(vertex, self)
@@ -434,7 +450,14 @@ class Accessor:
             self.fine_grained.check_edge_create_delete(edge_type)
         storage = self.storage
         from_v, to_v = from_va.vertex, to_va.vertex
+        # the gid lock is held across validation AND publication: the old
+        # check-then-publish split let two explicit-gid creates both pass
+        # the uniqueness check and silently drop one edge (check-then-act,
+        # MG007 pattern — mgsan sweep). Ordering stays gid_lock ->
+        # Vertex.lock everywhere; no path takes the gid lock under a
+        # vertex lock.
         with storage._gid_lock:
+            shared_write(storage, "_next_edge_gid")
             if gid is None:
                 gid = storage._next_edge_gid
                 storage._next_edge_gid += 1
@@ -442,36 +465,40 @@ class Accessor:
                 if gid in storage._edges:
                     raise StorageError(f"edge with gid {gid} already exists")
                 storage._next_edge_gid = max(storage._next_edge_gid, gid + 1)
-        edge = Edge(gid, edge_type, from_v, to_v)
+            edge = Edge(gid, edge_type, from_v, to_v)
 
-        # lock both endpoints in gid order to avoid deadlock
-        first, second = (from_v, to_v) if from_v.gid <= to_v.gid else (to_v, from_v)
-        first.lock.acquire()
-        if second is not first:
-            second.lock.acquire()
-        try:
-            if not self._analytical:
-                prepare_for_write(from_v, self.txn)
-                if to_v is not from_v:
-                    prepare_for_write(to_v, self.txn)
-            if from_v.deleted or to_v.deleted:
-                raise StorageError("cannot create edge on a deleted vertex")
-            out_entry = (edge_type, to_v, edge)
-            in_entry = (edge_type, from_v, edge)
-            if not self._analytical:
-                push_delta(edge, self.txn, DeltaAction.DELETE_OBJECT, None)
-                push_delta(from_v, self.txn, DeltaAction.REMOVE_OUT_EDGE,
-                           out_entry)
-                push_delta(to_v, self.txn, DeltaAction.REMOVE_IN_EDGE, in_entry)
-            from_v.out_edges.append(out_entry)
-            to_v.in_edges.append(in_entry)
-            adj_map_add(from_v, "out", out_entry)
-            adj_map_add(to_v, "in", in_entry)
-        finally:
+            # lock both endpoints in gid order to avoid deadlock
+            first, second = (from_v, to_v) if from_v.gid <= to_v.gid \
+                else (to_v, from_v)
+            first.lock.acquire()
             if second is not first:
-                second.lock.release()
-            first.lock.release()
-        storage._edges[gid] = edge
+                second.lock.acquire()
+            try:
+                if not self._analytical:
+                    prepare_for_write(from_v, self.txn)
+                    if to_v is not from_v:
+                        prepare_for_write(to_v, self.txn)
+                if from_v.deleted or to_v.deleted:
+                    raise StorageError(
+                        "cannot create edge on a deleted vertex")
+                out_entry = (edge_type, to_v, edge)
+                in_entry = (edge_type, from_v, edge)
+                if not self._analytical:
+                    push_delta(edge, self.txn, DeltaAction.DELETE_OBJECT,
+                               None)
+                    push_delta(from_v, self.txn,
+                               DeltaAction.REMOVE_OUT_EDGE, out_entry)
+                    push_delta(to_v, self.txn, DeltaAction.REMOVE_IN_EDGE,
+                               in_entry)
+                from_v.out_edges.append(out_entry)
+                to_v.in_edges.append(in_entry)
+                adj_map_add(from_v, "out", out_entry)
+                adj_map_add(to_v, "in", in_entry)
+            finally:
+                if second is not first:
+                    second.lock.release()
+                first.lock.release()
+            storage._edges[gid] = edge
         storage.indices.edge_type.add(edge)
         self.txn.touched_edges[gid] = edge
         self.txn.touched_vertices[from_v.gid] = from_v
@@ -564,6 +591,7 @@ class Accessor:
 
         # (a) vectorized gid allocation: one counter reservation per batch
         with storage._gid_lock:
+            shared_write(storage, "_next_vertex_gid")
             v_base = storage._next_vertex_gid
             storage._next_vertex_gid += nv
             e_base = storage._next_edge_gid
@@ -785,6 +813,8 @@ class Accessor:
                 vertex.properties.pop(prop_id, None)
             else:
                 vertex.properties[prop_id] = value
+        mvcc_event("write", txn=self.txn.id, gid=vertex.gid, prop=prop_id,
+                   value=value)
         self.storage.indices.label_property.update_on_change(vertex)
         self.txn.touched_vertices[vertex.gid] = vertex
         if self._analytical:
@@ -809,6 +839,8 @@ class Accessor:
                 edge.properties.pop(prop_id, None)
             else:
                 edge.properties[prop_id] = value
+        mvcc_event("write", txn=self.txn.id, gid=("e", edge.gid),
+                   prop=prop_id, value=value)
         self.txn.touched_edges[edge.gid] = edge
         eps = self.txn.edge_prop_endpoint_gids
         if eps is None:
@@ -1068,6 +1100,17 @@ class InMemoryStorage:
         from collections import deque
         self._change_log = deque(maxlen=1024)
         self._change_log_lock = tracked_lock("Storage._change_log_lock")
+        # mgsan shared-state declarations (MG006/MG007 + race detector):
+        # gid counters under _gid_lock, engine bookkeeping under
+        # _engine_lock, change log under _change_log_lock. The object
+        # maps (_vertices/_edges) and per-object delta chains are
+        # deliberately NOT declared: they synchronize through per-object
+        # plain locks + GIL-atomic dict publication, and their
+        # correctness is witnessed end-to-end by the MVCC isolation
+        # checker instead of field annotations.
+        shared_field(self, "_next_vertex_gid", "_next_edge_gid",
+                     "_timestamp", "_next_txn_id", "_active_txns",
+                     "_topology_version", "_change_log")
         # durability wiring: receives (frame_bytes, commit_ts) under the
         # engine lock, BEFORE the visibility flip (write-ahead ordering)
         self.wal_sink: Optional[Callable] = None
@@ -1102,11 +1145,13 @@ class InMemoryStorage:
             if getattr(self, "suspended", False) and                     not getattr(self, "_suspend_internal", False):
                 raise StorageError(
                     "this database is suspended; RESUME it first")
+            shared_write(self, "_next_txn_id")
             txn_id = self._next_txn_id
             self._next_txn_id += 1
             start_ts = self._timestamp
             txn = Transaction(txn_id, start_ts, isolation, self)
             self._active_txns[txn_id] = txn
+            mvcc_event("begin", txn=txn_id, start_ts=start_ts)
             # captured under the SAME lock as the commit-side visibility
             # flip + bump, so an accessor's MVCC snapshot and its
             # topology snapshot can never disagree (version-keyed caches
@@ -1115,7 +1160,9 @@ class InMemoryStorage:
             return txn
 
     def latest_commit_ts(self) -> int:
-        return self._timestamp
+        # single GIL-atomic int read; a stale value only makes a replica
+        # lag gauge or catch-up decision conservative, never wrong
+        return self._timestamp  # mglint: disable=MG006 — lock-free monotonic read is the contract
 
     def _check_db_memory_limit(self, txn: "Transaction") -> None:
         """Tenant-profile `storage_limit` (per-DB arena cap, reference:
@@ -1154,6 +1201,7 @@ class InMemoryStorage:
         if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or not txn.deltas:
             with self._engine_lock:
                 self._active_txns.pop(txn.id, None)
+                mvcc_event("commit", txn=txn.id, commit_ts=None, ro=True)
                 # commit_ts stays None: a no-delta txn has no own writes to
                 # expose, and advancing would leak later commits into a
                 # read-only SI transaction's retained accessors
@@ -1181,6 +1229,7 @@ class InMemoryStorage:
         with self._engine_lock:
             registrations = self.constraints.unique.validate_commit(
                 touched, self.namer)
+            shared_write(self, "_timestamp")
             self._timestamp += 1
             commit_ts = self._timestamp
             if self.wal_sink is not None or self.frame_consumers \
@@ -1230,6 +1279,7 @@ class InMemoryStorage:
             if txn.edge_prop_endpoint_gids:
                 changed |= txn.edge_prop_endpoint_gids
             self._bump_topology(changed)
+            mvcc_event("commit", txn=txn.id, commit_ts=commit_ts)
         if ship_seq is not None:
             # strict shipping order across concurrent committers
             with self._ship_cond:
@@ -1277,6 +1327,7 @@ class InMemoryStorage:
 
     def _abort(self, txn: Transaction) -> None:
         # undo in reverse; our deltas are contiguous at each object's head
+        mvcc_event("abort", txn=txn.id)
         from .delta import DeltaAction as A
         for delta in reversed(txn.deltas):
             obj = delta.obj
@@ -1452,6 +1503,7 @@ class InMemoryStorage:
         apply and recovery, so deltas are never silently missed
         (NOTES_ROUND2 hole #1)."""
         with self._change_log_lock:
+            shared_write(self, "_change_log")
             self._topology_version += 1
             self._change_log.append(
                 (self._topology_version,
@@ -1460,7 +1512,9 @@ class InMemoryStorage:
 
     @property
     def topology_version(self) -> int:
-        return self._topology_version
+        # same contract as latest_commit_ts: monotonic int, stale reads
+        # only cause an extra cache refresh
+        return self._topology_version  # mglint: disable=MG006 — lock-free monotonic read is the contract
 
     def changes_between(self, v_from: int, v_to: int):
         """Union of vertex gids changed in versions (v_from, v_to], or
@@ -1469,6 +1523,7 @@ class InMemoryStorage:
         if v_from == v_to:
             return frozenset()
         with self._change_log_lock:
+            shared_read(self, "_change_log")
             entries = list(self._change_log)
         if not entries or entries[0][0] > v_from + 1:
             return None     # log no longer reaches back to v_from

@@ -30,13 +30,22 @@ import os
 import sys
 import threading
 
+from . import sanitize as _san
+
 log = logging.getLogger(__name__)
 
 ENV_VAR = "MG_TRACK_LOCKS"
 
 
 def armed() -> bool:
-    return os.environ.get(ENV_VAR, "") not in ("", "0")
+    # MG_SAN=1 implies tracked locks: the race detector and the schedule
+    # explorer both hook TrackedLock acquire/release, so arming the
+    # sanitizer without the witness would blind them. An explicit
+    # MG_TRACK_LOCKS=0 still wins (opt-out).
+    v = os.environ.get(ENV_VAR)
+    if v is not None:
+        return v not in ("", "0")
+    return _san.armed()
 
 
 class LockOrderViolation(AssertionError):
@@ -146,14 +155,33 @@ class TrackedLock:
         self._lock = threading.RLock() if reentrant else threading.Lock()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        ok = self._lock.acquire(blocking, timeout)
+        sched = _san.current_scheduler()
+        if sched is not None and blocking:
+            # cooperative acquisition: yield the schedule decision to the
+            # explorer, then try-acquire in a blocked/retry loop so a
+            # *paused* holder can never deadlock the harness
+            sched.lock_acquire(self)
+            ok = True
+        else:
+            ok = self._lock.acquire(blocking, timeout)
         if ok:
             _note_acquired(self)
+            hook = _san._LOCK_ACQ_HOOK
+            if hook is not None:
+                hook(self)
         return ok
 
     def release(self) -> None:
         _note_released(self)
+        hook = _san._LOCK_REL_HOOK
+        if hook is not None:
+            # BEFORE the real release: the lock's vector clock must carry
+            # this thread's epoch before any other thread can acquire it
+            hook(self)
         self._lock.release()
+        sched = _san.current_scheduler()
+        if sched is not None:
+            sched.lock_released(self)
 
     def __enter__(self) -> bool:
         return self.acquire()

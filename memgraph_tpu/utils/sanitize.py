@@ -1,0 +1,128 @@
+"""mgsan runtime annotation shim: the product-side half of tools/mgsan.
+
+The package annotates its hot cross-thread shared state with three tiny
+calls that are **no-ops unless a sanitizer is armed** (one module-global
+``is None`` check each):
+
+``shared_field(owner, "a", "b")``
+    Declares attributes of ``owner`` as shared across threads. This is
+    simultaneously the *static* marker mglint's MG006/MG007 rules key on
+    (they resolve ``X.a`` accesses against these declarations) and the
+    *dynamic* registration point for the vector-clock race detector.
+
+``shared_read(owner, "a")`` / ``shared_write(owner, "a")``
+    Access annotations placed next to the real attribute access. Armed,
+    they (1) give the cooperative schedule explorer a preemption point
+    exactly where interleavings matter and (2) feed the FastTrack-style
+    happens-before race detector.
+
+``mvcc_event(kind, **fields)``
+    Transaction life-cycle / read / write events for the MVCC isolation
+    checker's history log (begin, read, write, commit, abort).
+
+``yield_point(label)``
+    Explicit scheduling point for multi-threaded tests running under the
+    deterministic explorer (tools/mgsan/scheduler.py). Outside an
+    explorer run it costs one global read.
+
+tools/mgsan installs the hooks below when armed (``MG_SAN=1`` or
+programmatically from tests); memgraph_tpu never imports tools/, so the
+production import graph stays closed.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_VAR = "MG_SAN"
+
+
+def armed() -> bool:
+    return os.environ.get(ENV_VAR, "") not in ("", "0")
+
+
+# --- hook registry (written only by tools/mgsan) -----------------------------
+
+#: callable(kind, owner, field) — kind is "r" or "w"
+_ACCESS_HOOK = None
+#: callable(owner, fields) — shared_field declarations
+_DECLARE_HOOK = None
+#: callable(event: dict) — MVCC history recorder
+_MVCC_HOOK = None
+#: callable(lock) / callable(lock) — TrackedLock acquired/about-to-release
+_LOCK_ACQ_HOOK = None
+_LOCK_REL_HOOK = None
+#: callable() -> scheduler-or-None for the *current thread* (TLS-based)
+_SCHED_RESOLVER = None
+
+
+def install_hooks(*, access=None, declare=None, mvcc=None, lock_acq=None,
+                  lock_rel=None, scheduler=None) -> None:
+    """Install (or clear, with explicit None) sanitizer hooks. Only
+    tools/mgsan calls this."""
+    global _ACCESS_HOOK, _DECLARE_HOOK, _MVCC_HOOK
+    global _LOCK_ACQ_HOOK, _LOCK_REL_HOOK, _SCHED_RESOLVER
+    _ACCESS_HOOK = access
+    _DECLARE_HOOK = declare
+    _MVCC_HOOK = mvcc
+    _LOCK_ACQ_HOOK = lock_acq
+    _LOCK_REL_HOOK = lock_rel
+    if scheduler is not None:
+        _SCHED_RESOLVER = scheduler
+
+
+def current_scheduler():
+    """The cooperative scheduler driving the current thread, or None."""
+    r = _SCHED_RESOLVER
+    if r is None:
+        return None
+    return r()
+
+
+# --- annotation API (the only calls product code makes) ----------------------
+
+
+def shared_field(owner, *fields: str) -> None:
+    """Declare attributes of ``owner`` as cross-thread shared state.
+
+    Static: mglint MG006 (unguarded-shared-field) and MG007
+    (check-then-act) resolve attribute accesses against these
+    declarations. Dynamic: registers the fields with the armed race
+    detector. Unarmed: a single global read.
+    """
+    h = _DECLARE_HOOK
+    if h is not None:
+        h(owner, fields)
+
+
+def shared_read(owner, field: str) -> None:
+    s = current_scheduler()
+    if s is not None:
+        s.yield_point(f"read:{type(owner).__name__}.{field}")
+    h = _ACCESS_HOOK
+    if h is not None:
+        h("r", owner, field)
+
+
+def shared_write(owner, field: str) -> None:
+    s = current_scheduler()
+    if s is not None:
+        s.yield_point(f"write:{type(owner).__name__}.{field}")
+    h = _ACCESS_HOOK
+    if h is not None:
+        h("w", owner, field)
+
+
+def yield_point(label: str = "") -> None:
+    s = current_scheduler()
+    if s is not None:
+        s.yield_point(label or "yield")
+
+
+def mvcc_event(kind: str, **fields) -> None:
+    """Record one MVCC history event (begin/read/write/commit/abort)."""
+    h = _MVCC_HOOK
+    if h is not None:
+        ev = {"e": kind}
+        ev.update(fields)
+        h(ev)

@@ -27,7 +27,28 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import sys  # noqa: E402
+
 import pytest  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pytest_configure(config):
+    """MG_SAN=1: arm the vector-clock race detector for the whole suite.
+
+    Every TrackedLock acquire/release and every shared_field annotation
+    feeds the process-global detector; the session fails if any access
+    pair is unordered by happens-before. Tests that arm their own
+    detector via `mgsan.detecting()` stack on top and restore this one
+    on exit."""
+    from memgraph_tpu.utils import sanitize
+    if not sanitize.armed():
+        return
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    from tools.mgsan import racedetect
+    config._mgsan_detector = racedetect.arm()
 
 
 @pytest.fixture
@@ -50,10 +71,20 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     for cycle, site in bad:
         terminalreporter.write_line(
             f"  CYCLE {' -> '.join(cycle)} closed at {site}", red=True)
+    det = getattr(config, "_mgsan_detector", None)
+    if det is not None:
+        terminalreporter.write_line(
+            f"mgsan race detector: {len(det.races)} race(s)"
+            + (" — CLEAN" if not det.races else " — RACES BELOW"))
+        for race in det.races:
+            terminalreporter.write_line(f"  {race.render()}", red=True)
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """Fail the run if the witness recorded any lock-order cycle."""
+    """Fail the run on witnessed lock-order cycles or data races."""
     from memgraph_tpu.utils import locks
     if locks.armed() and locks.violations():
+        session.exitstatus = 1
+    det = getattr(session.config, "_mgsan_detector", None)
+    if det is not None and det.races:
         session.exitstatus = 1

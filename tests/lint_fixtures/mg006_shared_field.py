@@ -1,0 +1,34 @@
+"""MG006 fixture: a declared shared_field accessed with no lock held.
+
+tests/test_mglint.py asserts MG006 fires exactly at the marked lines
+and nowhere else in this file (construction and the lock-guarded decoy
+stay silent; the suppressed access is counted as suppressed).
+"""
+import threading
+
+from memgraph_tpu.utils.sanitize import shared_field
+
+
+class Hot:
+    def __init__(self):
+        self._hot_lock = threading.Lock()
+        shared_field(self, "hits", "log")
+        self.hits = 0          # construction: exempt
+        self.log = []          # construction: exempt
+
+    def guarded(self):         # decoy: every access under the lock
+        with self._hot_lock:
+            self.hits += 1
+            self.log.append(self.hits)
+
+    def unguarded_write(self):
+        self.hits += 1         # MG006: unguarded write
+
+    def unguarded_read(self):
+        return [self.hits]     # MG006: unguarded read
+
+    def mutator_is_write(self):
+        self.log.append(1)     # MG006: mutating method call is a write
+
+    def suppressed(self):
+        self.hits = 9  # mglint: disable=MG006 — fixture: suppression scoping check

@@ -1,0 +1,43 @@
+"""MG007 fixture: shared-field read in one lock region, dependent write
+in another.
+
+tests/test_mglint.py asserts MG007 fires exactly at the marked write
+and that the atomic and revalidated decoys stay silent.
+"""
+import threading
+
+from memgraph_tpu.utils.sanitize import shared_field
+
+
+class Registry:
+    def __init__(self):
+        self._reg_lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        shared_field(self, "entries")
+        self.entries = {}
+
+    def atomic(self, key):          # decoy: read+write in ONE region
+        with self._reg_lock:
+            if key not in self.entries:
+                self.entries[key] = 1
+
+    def revalidated(self, key):     # decoy: write region re-checks
+        with self._reg_lock:
+            n = len(self.entries)
+        with self._reg_lock:
+            if key not in self.entries:
+                self.entries[key] = n
+
+    def split(self, key):           # check under one lock, act under another
+        with self._reg_lock:
+            known = key in self.entries
+        with self._aux_lock:
+            if not known:
+                self.entries[key] = 1      # MG007: stale-read window
+
+    def suppressed_split(self, key):
+        with self._reg_lock:
+            known = key in self.entries
+        with self._aux_lock:
+            if not known:
+                self.entries[key] = 2  # mglint: disable=MG007 — fixture: suppression scoping check

@@ -1,0 +1,26 @@
+"""Race-detector TRUE-NEGATIVE fixture: the same counter, correctly
+guarded by a TrackedLock. The lock's release→acquire edge orders the
+accesses (happens-before), so an armed detector must stay silent no
+matter how threads interleave — and mglint stays silent statically.
+(Imported by tests/test_mgsan.py; scanned, never imported, by mglint.)
+"""
+
+from memgraph_tpu.utils.locks import TrackedLock
+from memgraph_tpu.utils.sanitize import shared_field, shared_read, shared_write
+
+
+class GuardedCounter:
+    def __init__(self):
+        self._counter_lock = TrackedLock("RaceFixture._counter_lock")
+        shared_field(self, "value")
+        self.value = 0
+
+    def bump(self):
+        with self._counter_lock:
+            shared_write(self, "value")
+            self.value += 1
+
+    def peek(self):
+        with self._counter_lock:
+            shared_read(self, "value")
+            return self.value

@@ -1,0 +1,22 @@
+"""Race-detector TRUE-POSITIVE fixture: an annotated counter bumped
+with no lock. Two threads calling ``bump()`` under an armed detector
+MUST produce a write-write race report — and static MG006 flags the
+same line, so the static and dynamic views of this defect agree.
+(Imported by tests/test_mgsan.py; scanned, never imported, by mglint.)
+"""
+
+from memgraph_tpu.utils.sanitize import shared_field, shared_read, shared_write
+
+
+class UnguardedCounter:
+    def __init__(self):
+        shared_field(self, "value")
+        self.value = 0
+
+    def bump(self):
+        shared_write(self, "value")
+        self.value += 1        # MG006 fires here too (static agrees)
+
+    def peek(self):
+        shared_read(self, "value")
+        return self.value      # MG006: unguarded read

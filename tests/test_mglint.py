@@ -71,6 +71,7 @@ def test_cli_nonzero_on_fixtures():
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 1
     assert "MG001" in proc.stdout and "MG005" in proc.stdout
+    assert "MG006" in proc.stdout and "MG007" in proc.stdout
 
 
 # --- per-rule fixtures ------------------------------------------------------
@@ -116,6 +117,31 @@ def test_mg005_fires_on_coverage_gaps_only():
     assert "fault-unregistered:wired.typo" in msgs
     assert "fault-dead:dead.point" in msgs
     assert len(msgs) == 3, msgs              # OP_WIRED is fully covered
+
+
+def test_mg006_fires_on_unguarded_access_only():
+    result = _run(["tests/lint_fixtures"], only={"MG006"})
+    hits = _hits(result, "MG006")
+    assert ("mg006_shared_field.py", 25) in hits   # unguarded write
+    assert ("mg006_shared_field.py", 28) in hits   # unguarded read
+    assert ("mg006_shared_field.py", 31) in hits   # mutator call = write
+    # construction + the lock-guarded decoy stay silent
+    assert len([h for h in hits
+                if h[0] == "mg006_shared_field.py"]) == 3, hits
+    # the dynamic race fixtures agree with the static view: the
+    # unguarded one is flagged, the TrackedLock-guarded one is clean
+    assert ("race_unguarded.py", 18) in hits
+    assert ("race_unguarded.py", 22) in hits
+    assert all(p != "race_guarded.py" for p, _l in hits), hits
+    assert result.suppressed_count == 1   # Hot.suppressed
+
+
+def test_mg007_fires_on_split_regions_only():
+    result = _run(["tests/lint_fixtures"], only={"MG007"})
+    hits = _hits(result, "MG007")
+    # atomic + revalidated decoys silent; only the split check-then-act
+    assert hits == [("mg007_check_then_act.py", 36)], hits
+    assert result.suppressed_count == 1   # Registry.suppressed_split
 
 
 def test_suppression_comment_scopes_to_one_handler():

@@ -14,6 +14,10 @@ sequence or one value tree.
 import math
 
 import pytest
+
+# skip (not error) on images that don't ship hypothesis
+pytest.importorskip("hypothesis")
+
 from hypothesis import HealthCheck, given, settings, strategies as st
 
 from memgraph_tpu.exceptions import MemgraphTpuError

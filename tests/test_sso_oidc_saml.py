@@ -20,6 +20,10 @@ from xml.etree import ElementTree as ET
 
 import pytest
 
+# token signing / assertion crypto needs the optional cryptography
+# package — skip (not error) on images that don't ship it
+pytest.importorskip("cryptography")
+
 from memgraph_tpu.auth.auth import Auth
 from memgraph_tpu.auth.module import AuthModule, parse_module_mappings
 

@@ -11,6 +11,10 @@ import time
 
 import pytest
 
+# self-signed cert generation needs the optional cryptography package —
+# skip (not error) on images that don't ship it
+pytest.importorskip("cryptography")
+
 from memgraph_tpu.utils import tls as T
 
 

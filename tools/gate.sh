@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Dev gate: everything tier-1 enforces, in one command.
+#
+#   tools/gate.sh          # mglint + mgsan smoke + tier-1 tests
+#   tools/gate.sh --full   # additionally: full seeded sanitize sweep
+#
+# Run from anywhere; exits non-zero on the first failing stage.
+set -u
+cd "$(dirname "$0")/.."
+
+FULL=0
+[ "${1:-}" = "--full" ] && FULL=1
+
+fail=0
+stage() {
+    echo
+    echo "=== gate: $1 ==="
+    shift
+    "$@" || { echo "gate: FAILED: $*" >&2; fail=1; }
+}
+
+# 1. static analysis: all mglint rules (MG001-MG007) over the package;
+#    unbaselined findings exit non-zero
+stage "mglint (static analysis)" \
+    python -m tools.mglint memgraph_tpu
+
+# 2. mgsan smoke: the invariant-holding scenarios over a few seeds (the
+#    racy_counter true-positive is exercised by the test suite, not here)
+stage "mgsan schedule-exploration smoke" \
+    python -m tools.mgsan explore --seeds 3 \
+        --scenario metrics_counter --scenario storage_commits \
+        --scenario replica_health
+
+# 3. mgsan MVCC workload: randomized concurrent history must check clean,
+#    and the checker must flag the deliberately broken run
+stage "mgsan MVCC isolation check" \
+    python -m tools.mgsan workload --seed 0
+stage "mgsan MVCC checker sensitivity (broken isolation)" \
+    python -m tools.mgsan workload --seed 0 --break-isolation
+
+# 4. tier-1 tests: arms the lock-order witness (MG_TRACK_LOCKS=1, from
+#    conftest) and the vector-clock race detector (MG_SAN=1) suite-wide;
+#    the session fails on any witnessed lock cycle or data race.
+#    Optional-dep suites (hypothesis, cryptography) self-skip.
+stage "tier-1 tests (MG_SAN=1)" \
+    env MG_SAN=1 python -m pytest tests/ -q \
+        -m "not slow and not crash and not sanitize"
+
+if [ "$FULL" = 1 ]; then
+    # 5. the full seeded sweep: 25 seeds per scenario + 5 workload seeds
+    stage "mgsan full seeded sweep (-m sanitize)" \
+        env MG_SAN=1 python -m pytest tests/test_mgsan.py -q -m sanitize
+fi
+
+echo
+if [ "$fail" = 0 ]; then
+    echo "gate: ALL STAGES PASSED"
+else
+    echo "gate: FAILURES ABOVE" >&2
+fi
+exit "$fail"

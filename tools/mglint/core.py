@@ -98,6 +98,13 @@ class SourceFile:
         got = self.suppressed.get(line, ())
         return rule in got or "ALL" in got
 
+    def ensure_parents(self) -> None:
+        """Attach parent links exactly once per file per run; every rule
+        that needs qualnames shares the same annotated tree."""
+        if not getattr(self, "_parents_attached", False):
+            attach_parents(self.tree)
+            self._parents_attached = True
+
 
 class Project:
     """All parsed sources under the scan roots."""

@@ -39,7 +39,8 @@ LOCKISH_ATTR = re.compile(r"(?:^|_)(lock|cond|mutex|sem)", re.I)
 
 _LOCK_CTOR_ATTRS = {"Lock", "RLock", "Condition", "Semaphore",
                     "BoundedSemaphore"}
-_TRACKED_CTORS = {"tracked_lock", "tracked_rlock", "tracked_condition"}
+_TRACKED_CTORS = {"tracked_lock", "tracked_rlock", "tracked_condition",
+                  "TrackedLock"}
 
 # call patterns that block the calling thread (syscalls / sleeps)
 _BLOCKING_DOTTED = {
@@ -64,6 +65,14 @@ _BLOCKING_NAMES = {"open": "file open", "sleep": "sleep"}
 
 #: subsystems whose locks sit on commit / session critical paths
 CRITICAL_DIRS = ("storage", "replication", "server", "coordination")
+
+#: container methods that MUTATE their receiver — `self.shared.append(x)`
+#: counts as a write to the shared field for MG006/MG007
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "remove", "discard", "pop", "popleft",
+    "popitem", "clear", "update", "setdefault", "extend", "insert",
+    "sort",
+})
 
 #: method names that shadow stdlib container/file/thread APIs — never
 #: resolved by project-wide uniqueness (a `cache.values()` must not
@@ -141,6 +150,22 @@ class HeldEvent:
 
 
 @dataclass
+class FieldAccess:
+    """One syntactic access to a declared shared_field, with the lock
+    regions held at that point. `held` snapshots the live Acquisition
+    objects — two accesses are atomic w.r.t. each other iff they share
+    one (identity-compared) acquisition, i.e. sit in the SAME `with`
+    region, not merely under the same lock name."""
+    cls: str               # declaring class ("Metrics")
+    fname: str             # field name ("_counters")
+    kind: str              # "r" | "w"
+    line: int
+    col: int
+    held: tuple[Acquisition, ...]
+    in_return: bool = False   # load consumed by a `return` statement
+
+
+@dataclass
 class FuncInfo:
     key: str               # "<rel_path>::<qualname>"
     rel_path: str
@@ -152,9 +177,22 @@ class FuncInfo:
     events: list[HeldEvent] = field(default_factory=list)
     direct_blocking: list[tuple[str, CallSite]] = field(
         default_factory=list)
+    shared_accesses: list[FieldAccess] = field(default_factory=list)
     # fixpoint results
     may_acquire: set[str] = field(default_factory=set)
     may_block: dict[str, str] = field(default_factory=dict)  # op -> via
+
+
+def get_model(project: Project) -> "LockModel":
+    """The project's LockModel, built exactly ONCE and shared by every
+    rule that needs lock regions / call resolution (MG001, MG002, MG006,
+    MG007). The model walk dominates mglint runtime, so the single-pass
+    driver keeps the tier-1 gate flat as rules accumulate."""
+    model = getattr(project, "_mglint_lock_model", None)
+    if model is None:
+        model = LockModel(project)
+        project._mglint_lock_model = model
+    return model
 
 
 class LockModel:
@@ -170,6 +208,11 @@ class LockModel:
         # (rel, local name) -> module rel path  /  (module rel, symbol)
         self._mod_alias: dict[tuple[str, str], str] = {}
         self._sym_import: dict[tuple[str, str], tuple[str, str]] = {}
+        # shared_field(self, "a", "b") declarations (MG006/MG007):
+        # class -> declared fields / field -> declaring classes
+        self.shared_decls: dict[str, set[str]] = {}
+        self.shared_owners: dict[str, set[str]] = {}
+        self._class_bases: dict[str, set[str]] = {}
         self._collect_definitions()
         self._collect_imports()
         self._collect_functions()
@@ -225,7 +268,22 @@ class LockModel:
             for node in ast.walk(sf.tree):
                 if not isinstance(node, ast.ClassDef):
                     continue
+                self._class_bases.setdefault(node.name, set()).update(
+                    b.id if isinstance(b, ast.Name) else b.attr
+                    for b in node.bases
+                    if isinstance(b, (ast.Name, ast.Attribute)))
                 for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and self._is_shared_decl(sub)):
+                        fields = {a.value for a in sub.args[1:]
+                                  if isinstance(a, ast.Constant)
+                                  and isinstance(a.value, str)}
+                        if fields:
+                            self.shared_decls.setdefault(
+                                node.name, set()).update(fields)
+                            for f in fields:
+                                self.shared_owners.setdefault(
+                                    f, set()).add(node.name)
                     if not (isinstance(sub, ast.Assign)
                             and isinstance(sub.value, ast.Call)):
                         continue
@@ -255,6 +313,92 @@ class LockModel:
                             self.defs.setdefault(lock_id, LockDef(
                                 lock_id, kind, rel, stmt.lineno))
                             self._module_locks[(rel, tgt.id)] = lock_id
+
+    @staticmethod
+    def _is_shared_decl(call: ast.Call) -> bool:
+        """True for `shared_field(<owner>, "f", ...)` calls (any import
+        spelling: bare name or `sanitize.shared_field`)."""
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        return name == "shared_field" and len(call.args) >= 2
+
+    # --- shared-field access resolution (MG006/MG007) --------------------
+
+    def _inherits(self, cls: str, owner: str) -> bool:
+        seen, frontier = set(), {cls}
+        while frontier:
+            cur = frontier.pop()
+            if cur == owner:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            frontier |= self._class_bases.get(cur, set())
+        return False
+
+    def resolve_shared_owner(self, node: ast.Attribute,
+                             fi: FuncInfo) -> str | None:
+        """Declaring class for an `X.field` access, or None.
+
+        `self.field` resolves through the enclosing class (including
+        inherited declarations); any other receiver resolves only when
+        exactly ONE class project-wide declares that field name —
+        ambiguity is dropped, never guessed, mirroring resolve_lock."""
+        owners = self.shared_owners.get(node.attr)
+        if not owners:
+            return None
+        recv = node.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if not fi.class_name:
+                return None
+            if fi.class_name in owners:
+                return fi.class_name
+            for owner in owners:
+                if self._inherits(fi.class_name, owner):
+                    return owner
+            return None
+        if len(owners) == 1:
+            return next(iter(owners))
+        return None
+
+    def is_constructor_of(self, fi: FuncInfo, owner: str) -> bool:
+        """True when `fi` is __init__/__post_init__ of the declaring
+        class (or a subclass): the object is thread-local during
+        construction, so unguarded field setup there is not a race."""
+        short = fi.qualname.rsplit(".", 1)[-1]
+        if short not in ("__init__", "__post_init__"):
+            return False
+        cls = fi.class_name
+        return cls is not None and (cls == owner
+                                    or self._inherits(cls, owner))
+
+    @staticmethod
+    def _access_kind(node: ast.Attribute) -> str:
+        """'w' for stores, subscript-stores (`x.f[k] = v`) and mutating
+        method calls (`x.f.append(v)`); 'r' otherwise."""
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return "w"
+        parent = getattr(node, "_mglint_parent", None)
+        if (isinstance(parent, ast.Subscript) and parent.value is node
+                and isinstance(parent.ctx, (ast.Store, ast.Del))):
+            return "w"
+        if (isinstance(parent, ast.Attribute) and parent.value is node
+                and parent.attr in _MUTATOR_METHODS):
+            grand = getattr(parent, "_mglint_parent", None)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return "w"
+        return "r"
+
+    @staticmethod
+    def _in_return(node: ast.AST) -> bool:
+        """True when the access sits inside a `return` expression: the
+        function exits with it, so it cannot be the "check" half of a
+        check-then-act within this function (MG007)."""
+        cur = getattr(node, "_mglint_parent", None)
+        while cur is not None and isinstance(cur, ast.expr):
+            cur = getattr(cur, "_mglint_parent", None)
+        return isinstance(cur, ast.Return)
 
     # --- lock expression resolution -------------------------------------
 
@@ -295,7 +439,12 @@ class LockModel:
                 self._methods.setdefault(short, []).append(key)
             else:
                 self._module_funcs[(fi.rel_path, short)] = key
-        # phase B: walk bodies (resolution indexes are now complete)
+        # phase B: walk bodies (resolution indexes are now complete);
+        # parent links are needed for shared-field access kinds and are
+        # attached exactly once per file (shared with MG003 et al.)
+        if self.shared_owners:
+            for sf in self.project.files.values():
+                sf.ensure_parents()
         for fi in self.functions.values():
             sf = self.project.files[fi.rel_path]
             self._walk_function(sf, fi, fi.node.body, held=[])
@@ -376,6 +525,14 @@ class LockModel:
                 continue
             if isinstance(node, ast.Call):
                 self._visit_call(sf, fi, node, held)
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr in self.shared_owners):
+                owner = self.resolve_shared_owner(node, fi)
+                if owner is not None:
+                    fi.shared_accesses.append(FieldAccess(
+                        owner, node.attr, self._access_kind(node),
+                        node.lineno, node.col_offset, tuple(held),
+                        in_return=self._in_return(node)))
             stack.extend(ast.iter_child_nodes(node))
 
     def _visit_call(self, sf: SourceFile, fi: FuncInfo, call: ast.Call,

@@ -5,3 +5,5 @@ from . import blocking_under_lock  # noqa: F401
 from . import swallowed_exception  # noqa: F401
 from . import jax_purity  # noqa: F401
 from . import registry_coverage  # noqa: F401
+from . import shared_field  # noqa: F401
+from . import check_then_act  # noqa: F401

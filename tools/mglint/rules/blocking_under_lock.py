@@ -17,7 +17,7 @@ justification, not silently ignored.
 from __future__ import annotations
 
 from ..core import Finding, Project
-from ..locking import CRITICAL_DIRS, LockModel
+from ..locking import CRITICAL_DIRS, get_model
 from ..registry import register
 
 
@@ -29,7 +29,7 @@ def _critical(rel_path: str) -> bool:
 @register("MG002", "blocking-under-lock")
 def check(project: Project):
     """No fsync/socket/sleep/subprocess inside a critical section."""
-    model = LockModel(project)
+    model = get_model(project)
     # (func key, lock display) -> {"ops": [...], "line": first line, ...}
     grouped: dict[tuple[str, str], dict] = {}
 

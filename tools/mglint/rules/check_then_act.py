@@ -1,0 +1,69 @@
+"""MG007 — check-then-act: a declared shared field is READ in one lock
+region and then WRITTEN in a different (or no) region inside the same
+function.
+
+The classic TOCTOU shape: take the lock, read the value, drop the lock,
+decide, re-take a lock, write — another thread interleaves between the
+two regions and the write acts on a stale read. Atomicity is judged by
+*region identity*, not lock name: re-acquiring the same lock in a second
+`with` block is still two regions (the interleaving window is the gap
+between them). A read and write covered by one common live acquisition
+are atomic and never flagged.
+
+The canonical fix is recognized: a write whose OWN region re-reads the
+field before acting (`if key in self.cache: ...` under the write lock)
+has re-validated the stale decision and is clean — only writes that act
+on the earlier region's read with no re-check are flagged.
+
+Deliberate splits that dodge even the re-check carry an inline
+`# mglint: disable=MG007` with the reason at the write site.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Project
+from ..locking import get_model
+from ..registry import register
+
+
+@register("MG007", "check-then-act")
+def check(project: Project):
+    """Shared-field read then write must share one lock region."""
+    model = get_model(project)
+    findings = []
+    for key in sorted(model.functions):
+        fi = model.functions[key]
+        if not fi.shared_accesses:
+            continue
+        reported: set[tuple] = set()
+        loads: dict[tuple, list] = {}    # (cls, field) -> earlier loads
+        for fa in fi.shared_accesses:
+            fk = (fa.cls, fa.fname)
+            if fa.kind == "r":
+                # a returned read exits the function: it can never be
+                # the "check" half (e.g. an early-return branch)
+                if not fa.in_return:
+                    loads.setdefault(fk, []).append(fa)
+                continue
+            if fk in reported or model.is_constructor_of(fi, fa.cls):
+                continue
+            held_ids = {id(a) for a in fa.held}
+            # a load sharing a live acquisition with this write is a
+            # re-check under the write's own region: the stale earlier
+            # read was re-validated, the canonical check-then-act fix
+            if any(held_ids & {id(a) for a in ld.held}
+                   for ld in loads.get(fk, ())):
+                continue
+            for ld in loads.get(fk, ()):
+                if held_ids & {id(a) for a in ld.held}:
+                    continue
+                reported.add(fk)
+                findings.append(Finding(
+                    "MG007", fi.rel_path, fa.line, fa.col,
+                    f"check-then-act on {fa.cls}.{fa.fname}: read at "
+                    f"line {ld.line} and this write share no lock "
+                    f"region (stale-read window between them)",
+                    symbol=fi.qualname,
+                    fingerprint=f"{fa.cls}.{fa.fname}"))
+                break
+    return findings

@@ -17,7 +17,7 @@ the other's blind side, and both must stay acyclic.
 from __future__ import annotations
 
 from ..core import Finding, Project
-from ..locking import LockModel
+from ..locking import LockModel, get_model
 from ..registry import register
 
 
@@ -101,7 +101,7 @@ def _sccs(nodes, succ):
 @register("MG001", "lock-order")
 def check(project: Project):
     """Static lock-nesting graph must be acyclic (deadlock risk)."""
-    model = LockModel(project)
+    model = get_model(project)
     edges = _build_edges(model)
     succ: dict[str, set[str]] = {}
     nodes: set[str] = set()

@@ -1,0 +1,51 @@
+"""MG006 — unguarded-shared-field: a field declared via
+`sanitize.shared_field(self, ...)` is accessed with NO lock held.
+
+The declaration is a contract: this attribute is read/written by more
+than one thread, so every access must sit inside some lock region (the
+dynamic race detector checks the *executed* schedules; this rule checks
+every *syntactic* path). Deliberate lock-free reads — monotonic
+timestamp gauges where a stale value is merely conservative — carry an
+inline `# mglint: disable=MG006` with the reason, or a baseline entry.
+
+Construction is exempt: `__init__`/`__post_init__` of the declaring
+class (or a subclass) runs before the object is published to other
+threads. Receivers other than `self` resolve only when exactly one
+class project-wide declares the field name — ambiguous names are
+dropped, never guessed.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Project
+from ..locking import get_model
+from ..registry import register
+
+
+@register("MG006", "unguarded-shared-field")
+def check(project: Project):
+    """Every access to a declared shared field must hold some lock."""
+    model = get_model(project)
+    findings = []
+    seen: set[tuple] = set()
+    for key in sorted(model.functions):
+        fi = model.functions[key]
+        for fa in fi.shared_accesses:
+            if fa.held:
+                continue
+            if model.is_constructor_of(fi, fa.cls):
+                continue
+            # one finding per (function, field, kind): a hot loop that
+            # touches the field five times is one defect, not five
+            dedupe = (fi.key, fa.cls, fa.fname, fa.kind)
+            if dedupe in seen:
+                continue
+            seen.add(dedupe)
+            verb = "written" if fa.kind == "w" else "read"
+            findings.append(Finding(
+                "MG006", fi.rel_path, fa.line, fa.col,
+                f"shared field {fa.cls}.{fa.fname} {verb} with no lock "
+                f"held (declared shared_field)",
+                symbol=fi.qualname,
+                fingerprint=f"{fa.cls}.{fa.fname}:{fa.kind}"))
+    return findings

@@ -71,7 +71,7 @@ def check(project: Project):
     """Broad except must log, re-raise, retry, or use the error."""
     findings = []
     for rel, sf in project.files.items():
-        attach_parents(sf.tree)
+        sf.ensure_parents()
         per_scope: dict[str, int] = {}
         hits = [n for n in ast.walk(sf.tree)
                 if isinstance(n, ast.ExceptHandler)]

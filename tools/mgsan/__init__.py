@@ -1,0 +1,24 @@
+"""mgsan — dynamic concurrency sanitizer suite for memgraph_tpu.
+
+Three cooperating parts, all armed through the lightweight annotation
+shim ``memgraph_tpu/utils/sanitize.py`` (no-ops unless armed):
+
+* ``scheduler``  — loom/CHESS-style deterministic schedule explorer:
+  multi-threaded scenarios run one thread at a time under a
+  seed-replayable schedule (same seed => byte-identical trace).
+* ``racedetect`` — FastTrack-style vector-clock data-race detector over
+  TrackedLock acquire/release and ``shared_read``/``shared_write``
+  annotations; reports racy access pairs with both sites.
+* ``isocheck``   — MVCC isolation checker: records per-transaction
+  read/write/commit events into a history log and verifies
+  snapshot-isolation invariants offline (G1a, G1b, future reads,
+  lost updates / overlapping committed writers).
+
+Complements mglint: MG001-MG007 prove static properties (lock order,
+declared fields guarded on every path); mgsan witnesses the *dynamic*
+ones (executed interleavings are race-free, histories serializable).
+"""
+
+from .scheduler import DeadlockError, Scheduler, SchedulerError, explore  # noqa: F401
+from .racedetect import Detector, detecting, arm, disarm, current_detector  # noqa: F401
+from .isocheck import HistoryLog, check_history, recording, run_workload  # noqa: F401

@@ -1,0 +1,142 @@
+"""mgsan command line: `python -m tools.mgsan <cmd>`.
+
+    explore   run the built-in scenario bank over N seeded schedules,
+              printing a per-seed trace digest (same seed => same digest)
+    workload  run the randomized MVCC workload and check its history
+    check     offline-check a previously dumped history JSONL file
+
+Exit codes: 0 clean, 1 violations/races found, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mgsan",
+        description="memgraph_tpu dynamic concurrency sanitizer")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ex = sub.add_parser("explore", help="seeded schedule exploration")
+    ex.add_argument("--seeds", type=int, default=10,
+                    help="number of seeds per scenario (default 10)")
+    ex.add_argument("--seed-base", type=int, default=0)
+    ex.add_argument("--scenario", action="append", default=None,
+                    help="scenario name (repeatable; default: all)")
+    ex.add_argument("--trace", action="store_true",
+                    help="print full schedule traces, not just digests")
+
+    wl = sub.add_parser("workload", help="randomized MVCC workload + check")
+    wl.add_argument("--seed", type=int, default=0)
+    wl.add_argument("--threads", type=int, default=4)
+    wl.add_argument("--txns", type=int, default=8)
+    wl.add_argument("--keys", type=int, default=3)
+    wl.add_argument("--break-isolation", action="store_true",
+                    help="disable write-write conflict detection (the "
+                         "checker MUST then flag lost updates)")
+    wl.add_argument("--dump", metavar="PATH",
+                    help="write the history JSONL to PATH")
+
+    ck = sub.add_parser("check", help="offline-check a history JSONL")
+    ck.add_argument("history", help="path to a history .jsonl")
+    return p
+
+
+def _cmd_explore(args) -> int:
+    from .racedetect import detecting
+    from .scenarios import SCENARIOS
+    from .scheduler import DeadlockError, Scheduler
+
+    names = args.scenario or sorted(SCENARIOS)
+    bad = 0
+    for name in names:
+        build = SCENARIOS.get(name)
+        if build is None:
+            print(f"unknown scenario {name!r} "
+                  f"(known: {', '.join(sorted(SCENARIOS))})",
+                  file=sys.stderr)
+            return 2
+        for seed in range(args.seed_base, args.seed_base + args.seeds):
+            sched = Scheduler(seed=seed)
+            with detecting() as det:
+                check = build(sched)
+                try:
+                    sched.run()
+                    violations = check()
+                except DeadlockError as e:
+                    violations = [f"DEADLOCK: {e}"]
+            text = sched.trace_text()
+            digest = hashlib.sha256(text.encode()).hexdigest()[:16]
+            status = "ok"
+            if violations:
+                status = "; ".join(violations)
+                bad += 1
+            if det.races:
+                status += f" [{len(det.races)} race(s)]"
+                bad += 1
+            print(f"{name:20s} seed={seed:<4d} steps={len(sched.trace):<4d} "
+                  f"trace={digest} {status}")
+            if args.trace:
+                print(text)
+    return 1 if bad else 0
+
+
+def _cmd_workload(args) -> int:
+    from .isocheck import check_history, run_workload
+
+    history, stats = run_workload(
+        seed=args.seed, threads=args.threads,
+        txns_per_thread=args.txns, keys=args.keys,
+        break_isolation=args.break_isolation)
+    if args.dump:
+        history.dump(args.dump)
+    violations = check_history(history)
+    print(f"workload: {stats['committed']} committed, "
+          f"{stats['aborted']} aborted, {len(history.events)} events, "
+          f"{len(violations)} violation(s)")
+    for v in violations:
+        print(f"  {v}")
+    if args.break_isolation:
+        # inverted contract: the checker proving it CAN see the damage
+        if not violations:
+            print("FAIL: isolation was disabled but the checker saw "
+                  "nothing", file=sys.stderr)
+            return 1
+        print("(expected: isolation was deliberately broken)")
+        return 0
+    return 1 if violations else 0
+
+
+def _cmd_check(args) -> int:
+    from .isocheck import HistoryLog, check_history
+
+    try:
+        history = HistoryLog.load(args.history)
+    except (OSError, ValueError) as e:
+        print(f"mgsan: cannot load {args.history}: {e}", file=sys.stderr)
+        return 2
+    violations = check_history(history)
+    print(f"{len(history.events)} events, {len(violations)} violation(s)")
+    for v in violations:
+        print(f"  {v}")
+    return 1 if violations else 0
+
+
+def main(argv=None) -> int:
+    # Arm lock tracking BEFORE any memgraph_tpu module creates a lock
+    # (all product imports are lazy, inside the _cmd_* handlers): the
+    # schedule explorer can only preempt at TrackedLock acquisitions —
+    # a task parked at a yield point while holding a *plain* lock would
+    # wedge every other task that touches it.
+    os.environ.setdefault("MG_TRACK_LOCKS", "1")
+    args = build_parser().parse_args(argv)
+    if args.cmd == "explore":
+        return _cmd_explore(args)
+    if args.cmd == "workload":
+        return _cmd_workload(args)
+    return _cmd_check(args)

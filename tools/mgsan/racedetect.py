@@ -1,0 +1,326 @@
+"""FastTrack-style vector-clock data-race detector (MG_SAN=1).
+
+Happens-before tracking:
+
+* each thread carries a vector clock; thread *creation* copies the
+  parent's clock into the child (``threading.Thread.start`` is patched
+  while armed) and ``join`` merges the child's final clock back;
+* every ``TrackedLock`` release publishes the releasing thread's clock
+  on the lock and bumps the thread's own epoch; every acquire joins the
+  lock's clock into the acquiring thread (utils/locks.py calls the
+  hooks installed here);
+* every ``shared_read``/``shared_write`` annotation on a declared
+  ``shared_field`` checks the access against the field's last-writer
+  epoch (FastTrack write epochs) and per-thread read clocks.
+
+An access pair unordered by happens-before is a data race; the report
+carries **both** access sites (file:line of the annotation's caller),
+the two thread names, and the access kinds. Races dedupe on
+(field label, kind, site pair) so a racy hot loop produces one finding,
+not thousands.
+
+Scope is deliberate: only *annotated* fields are checked, so
+synchronization the detector cannot see (queue.Queue hand-off, plain
+locks, Condition wake-ups) never yields false positives — unannotated
+state is simply out of scope, exactly like TSan's
+ANNOTATE_BENIGN_RACE-free manual instrumentation mode.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+
+from memgraph_tpu.utils import sanitize as _san
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_SHIM_FILES = ("sanitize.py", "locks.py")
+
+
+def _site(depth: int = 2) -> str:
+    """First frame outside the sanitizer plumbing: the annotated access."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return "<unknown>"
+    while frame is not None:
+        fn = frame.f_code.co_filename
+        base = os.path.basename(fn)
+        if not (fn.startswith(_THIS_DIR) or base in _SHIM_FILES):
+            return f"{fn}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+@dataclass
+class Race:
+    label: str                    # "Metrics._counters"
+    kind: str                     # write-write | read-write | write-read
+    prior_site: str
+    prior_thread: str
+    site: str
+    thread: str
+
+    def render(self) -> str:
+        return (f"DATA RACE on {self.label} [{self.kind}]: "
+                f"{self.prior_thread} @ {self.prior_site}  vs  "
+                f"{self.thread} @ {self.site}")
+
+
+class _VarState:
+    __slots__ = ("write", "write_site", "write_thread", "reads",
+                 "read_sites")
+
+    def __init__(self):
+        self.write = None          # (tid, epoch) of last write
+        self.write_site = ""
+        self.write_thread = ""
+        self.reads: dict[int, int] = {}       # tid -> epoch of last read
+        self.read_sites: dict[int, tuple] = {}  # tid -> (site, name)
+
+
+@dataclass
+class Detector:
+    """One detection session. ``arm()`` installs a process-global one."""
+
+    allowlist: frozenset = frozenset()
+    races: list = field(default_factory=list)
+
+    def __post_init__(self):
+        # the detector's own mutex is a strict leaf and deliberately a
+        # *plain* lock: a TrackedLock here would recurse into the hooks
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._next_tid = [0]
+        self._clocks: dict[int, dict[int, int]] = {}
+        self._lock_clocks: dict[int, dict[int, int]] = {}
+        self._pending_forks: dict[int, dict[int, int]] = {}
+        self._final_clocks: dict[int, dict[int, int]] = {}
+        self._seen_pairs: set = set()
+
+    # --- thread registry --------------------------------------------------
+
+    def _current(self) -> tuple[int, dict]:
+        tid = getattr(self._tls, "tid", None)
+        if tid is None:
+            with self._mu:
+                tid = self._next_tid[0]
+                self._next_tid[0] += 1
+                clock = {tid: 1}
+                parent = self._pending_forks.pop(
+                    threading.get_ident(), None)
+                if parent is not None:
+                    clock.update({t: c for t, c in parent.items()
+                                  if t != tid})
+                    clock[tid] = 1
+                self._clocks[tid] = clock
+            self._tls.tid = tid
+        return tid, self._clocks[tid]
+
+    def fork_snapshot(self) -> dict:
+        """Parent-side half of a thread fork: snapshot + epoch bump."""
+        tid, clock = self._current()
+        with self._mu:
+            snap = dict(clock)
+            clock[tid] += 1
+        return snap
+
+    def adopt_fork(self, parent_snapshot: dict) -> None:
+        """Child-side half, keyed by the child's OS ident (runs before
+        any access the child makes)."""
+        with self._mu:
+            self._pending_forks[threading.get_ident()] = parent_snapshot
+
+    def finish_thread(self) -> None:
+        tid, clock = self._current()
+        with self._mu:
+            self._final_clocks[threading.get_ident()] = dict(clock)
+
+    def join_thread(self, ident: int) -> None:
+        tid, clock = self._current()
+        with self._mu:
+            final = self._final_clocks.get(ident)
+            if final:
+                for t, c in final.items():
+                    if clock.get(t, 0) < c:
+                        clock[t] = c
+
+    # --- lock hooks -------------------------------------------------------
+
+    def on_acquire(self, lock) -> None:
+        tid, clock = self._current()
+        with self._mu:
+            lc = self._lock_clocks.get(id(lock))
+            if lc:
+                for t, c in lc.items():
+                    if clock.get(t, 0) < c:
+                        clock[t] = c
+
+    def on_release(self, lock) -> None:
+        tid, clock = self._current()
+        with self._mu:
+            self._lock_clocks[id(lock)] = dict(clock)
+            clock[tid] += 1
+
+    # --- declared fields / accesses --------------------------------------
+
+    def on_declare(self, owner, fields) -> None:
+        # identity comes from (id(owner), field) at access time; the
+        # declaration itself needs no bookkeeping beyond existing — it
+        # is primarily the static marker for MG006/MG007
+        pass
+
+    def on_access(self, kind: str, owner, fname: str) -> None:
+        label = f"{type(owner).__name__}.{fname}"
+        if label in self.allowlist:
+            return
+        tid, clock = self._current()
+        me = threading.current_thread().name
+        site = _site()
+        key = (id(owner), fname)
+        with self._mu:
+            st = self._vars_get(key)
+            if kind == "w":
+                if st.write is not None:
+                    wtid, wepoch = st.write
+                    if wtid != tid and clock.get(wtid, 0) < wepoch:
+                        self._record(label, "write-write", st.write_site,
+                                     st.write_thread, site, me)
+                for rtid, repoch in st.reads.items():
+                    if rtid != tid and clock.get(rtid, 0) < repoch:
+                        rsite, rname = st.read_sites[rtid]
+                        self._record(label, "read-write", rsite, rname,
+                                     site, me)
+                st.write = (tid, clock[tid])
+                st.write_site = site
+                st.write_thread = me
+                st.reads = {}
+                st.read_sites = {}
+            else:
+                if st.write is not None:
+                    wtid, wepoch = st.write
+                    if wtid != tid and clock.get(wtid, 0) < wepoch:
+                        self._record(label, "write-read", st.write_site,
+                                     st.write_thread, site, me)
+                st.reads[tid] = clock[tid]
+                st.read_sites[tid] = (site, me)
+
+    def _vars_get(self, key) -> _VarState:
+        vars_ = getattr(self, "_vars", None)
+        if vars_ is None:
+            vars_ = self._vars = {}
+        st = vars_.get(key)
+        if st is None:
+            st = vars_[key] = _VarState()
+        return st
+
+    def _record(self, label, kind, psite, pthread, site, me) -> None:
+        pair = (label, kind, psite, site)
+        if pair in self._seen_pairs:
+            return
+        self._seen_pairs.add(pair)
+        self.races.append(Race(label, kind, psite, pthread, site, me))
+
+    def report(self) -> str:
+        lines = [f"mgsan race detector: {len(self.races)} race(s)"]
+        lines += [f"  {r.render()}" for r in self.races]
+        return "\n".join(lines)
+
+
+# --- process-global arming ----------------------------------------------------
+
+_DETECTOR: Detector | None = None
+_ORIG_START = threading.Thread.start
+_ORIG_JOIN = threading.Thread.join
+
+
+def current_detector() -> Detector | None:
+    return _DETECTOR
+
+
+def _patched_start(self):
+    det = _DETECTOR
+    if det is None:
+        return _ORIG_START(self)
+    snap = det.fork_snapshot()
+    orig_run = self.run
+
+    def run():
+        d = _DETECTOR
+        if d is not None:
+            d.adopt_fork(snap)
+        try:
+            orig_run()
+        finally:
+            if d is not None:
+                d.finish_thread()
+
+    self.run = run
+    return _ORIG_START(self)
+
+
+def _patched_join(self, timeout=None):
+    _ORIG_JOIN(self, timeout)
+    det = _DETECTOR
+    if det is not None and not self.is_alive():
+        det.join_thread(self.ident)
+
+
+def arm(allowlist=()) -> Detector:
+    """Install a process-global detector: lock + access hooks, patched
+    Thread.start/join for fork/join happens-before edges."""
+    global _DETECTOR
+    det = Detector(allowlist=frozenset(allowlist))
+    _DETECTOR = det
+    _san.install_hooks(
+        access=det.on_access,
+        declare=det.on_declare,
+        mvcc=_san._MVCC_HOOK,
+        lock_acq=det.on_acquire,
+        lock_rel=det.on_release,
+    )
+    threading.Thread.start = _patched_start
+    threading.Thread.join = _patched_join
+    return det
+
+
+def disarm() -> None:
+    global _DETECTOR
+    _DETECTOR = None
+    threading.Thread.start = _ORIG_START
+    threading.Thread.join = _ORIG_JOIN
+    _san.install_hooks(mvcc=_san._MVCC_HOOK)
+
+
+class detecting:
+    """Context manager for tests: arm a fresh detector, restore on exit.
+
+    with detecting() as det:
+        ... run threads ...
+    assert det.races == []
+    """
+
+    def __init__(self, allowlist=()):
+        self.allowlist = allowlist
+        self.detector: Detector | None = None
+
+    def __enter__(self) -> Detector:
+        self._prev = _DETECTOR
+        self.detector = arm(self.allowlist)
+        return self.detector
+
+    def __exit__(self, *exc) -> None:
+        global _DETECTOR
+        if self._prev is None:
+            disarm()
+        else:
+            _DETECTOR = self._prev
+            _san.install_hooks(
+                access=self._prev.on_access,
+                declare=self._prev.on_declare,
+                mvcc=_san._MVCC_HOOK,
+                lock_acq=self._prev.on_acquire,
+                lock_rel=self._prev.on_release,
+            )

@@ -1,0 +1,139 @@
+"""Built-in schedule-exploration scenarios (shared by tests and CLI).
+
+Each ``scenario_*`` function takes a Scheduler, spawns its tasks, and
+returns a ``check()`` thunk that validates the invariant after the
+schedule completes — returning a list of violation strings (empty ==
+invariant held under that interleaving).
+
+The first three are the tier-1 smoke (true negatives: correct code must
+hold its invariant under EVERY explored schedule); ``scenario_racy_counter``
+is the true-positive fixture — a deliberately unsynchronized
+read-modify-write that the explorer must catch losing updates on at
+least one seed.
+"""
+
+from __future__ import annotations
+
+from memgraph_tpu.utils import sanitize as _san
+
+
+def scenario_metrics_counter(sched):
+    """Two tasks increment one lock-guarded Metrics counter 3x each."""
+    from memgraph_tpu.observability.metrics import Metrics
+    m = Metrics()
+
+    def bump():
+        for _ in range(3):
+            m.increment("sanity.hits")
+
+    sched.spawn(bump, name="inc-a")
+    sched.spawn(bump, name="inc-b")
+
+    def check():
+        got = dict((n, v) for n, _k, v in m.snapshot())
+        if got.get("sanity.hits") != 6.0:
+            return [f"metrics lost updates: {got.get('sanity.hits')} != 6"]
+        return []
+
+    return check
+
+
+def scenario_storage_commits(sched):
+    """Two tasks each create+commit a vertex on one shared storage."""
+    from memgraph_tpu.storage import InMemoryStorage
+    st = InMemoryStorage()
+    label = st.label_mapper.name_to_id("N")
+
+    def txn(n):
+        for _ in range(n):
+            acc = st.access()
+            v = acc.create_vertex()
+            v.add_label(label)
+            acc.commit()
+
+    sched.spawn(txn, 2, name="writer-a")
+    sched.spawn(txn, 2, name="writer-b")
+
+    def check():
+        out = []
+        if len(st._vertices) != 4:
+            out.append(f"expected 4 vertices, got {len(st._vertices)}")
+        gids = sorted(st._vertices)
+        if gids != [0, 1, 2, 3]:
+            out.append(f"gid allocation not dense/unique: {gids}")
+        if st.latest_commit_ts() != 1 + 4:
+            out.append(f"commit ts drifted: {st.latest_commit_ts()}")
+        return out
+
+    return check
+
+
+def scenario_replica_health(sched):
+    """Concurrent RPC-failure bookkeeping on one ReplicaClient: the
+    failure streak is a read-modify-write shared between the shipping
+    path and the heartbeat thread — no increment may be lost."""
+    from memgraph_tpu.replication.main_role import (ReplicaClient,
+                                                    ReplicationMode)
+
+    class _St:
+        def latest_commit_ts(self):
+            return 10
+
+    c = ReplicaClient("r1", "127.0.0.1:7687", ReplicationMode.ASYNC,
+                      _St())
+
+    def fail(n):
+        for _ in range(n):
+            c._mark_failed("ship", OSError("injected"))
+
+    sched.spawn(fail, 2, name="shipper")
+    sched.spawn(fail, 2, name="heartbeat")
+
+    def check():
+        if c.failures != 4:
+            return [f"lost failure increments: {c.failures} != 4"]
+        return []
+
+    return check
+
+
+def scenario_racy_counter(sched):
+    """TRUE POSITIVE: unsynchronized read-modify-write with an explicit
+    yield between the read and the write. Some seeds MUST lose updates."""
+
+    class Racy:
+        def __init__(self):
+            self.count = 0
+
+        def bump(self):
+            snap = self.count
+            _san.yield_point("racy:between-read-and-write")
+            self.count = snap + 1
+
+    r = Racy()
+
+    def loop():
+        for _ in range(2):
+            r.bump()
+
+    sched.spawn(loop, name="racy-a")
+    sched.spawn(loop, name="racy-b")
+
+    def check():
+        if r.count != 4:
+            return [f"lost update: count {r.count} != 4"]
+        return []
+
+    return check
+
+
+#: name -> builder; the smoke runs the first three, the sweep all of them
+SCENARIOS = {
+    "metrics_counter": scenario_metrics_counter,
+    "storage_commits": scenario_storage_commits,
+    "replica_health": scenario_replica_health,
+    "racy_counter": scenario_racy_counter,
+}
+
+#: invariant-holding scenarios (every seed must pass)
+CLEAN_SCENARIOS = ("metrics_counter", "storage_commits", "replica_health")

@@ -1,0 +1,233 @@
+"""Deterministic cooperative schedule explorer (loom / CHESS style).
+
+A ``Scheduler`` owns N *tasks* (real threads) but lets exactly ONE run
+at any moment. Tasks pause at schedule points — every
+``TrackedLock`` acquisition (utils/locks.py calls back in here), every
+``shared_read``/``shared_write`` annotation, and every explicit
+``sanitize.yield_point()`` — and a seeded RNG picks which runnable task
+proceeds. Because only one task executes between points, the entire
+interleaving is a pure function of the seed: the same seed replays a
+byte-identical schedule trace (``trace_text()``), so a failing
+interleaving found by a randomized campaign is replayed exactly by
+re-running with its seed — the same arming pattern as
+``utils/faultinject.seeded_schedule``.
+
+Lock handling: a task acquiring a TrackedLock first yields (scheduling
+decision *before* the acquire), then try-acquires in a blocked/retry
+loop. A task that cannot take the lock parks in BLOCKED state and is
+not scheduled again until the holder releases — so a paused holder can
+never deadlock the harness. If every live task is BLOCKED the program
+itself has deadlocked and ``DeadlockError`` reports who holds what:
+the explorer doubles as a deadlock finder.
+
+Plain (untracked) locks are invisible to the scheduler: scenarios must
+synchronize through tracked locks or annotated state. A task wedged on
+something invisible trips the watchdog timeout instead of hanging CI.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+# tools/ must be importable standalone: resolve the repo root the same
+# way tools/mglint does (tests insert the repo root on sys.path)
+from memgraph_tpu.utils import sanitize as _san
+
+
+class SchedulerError(RuntimeError):
+    """Harness-level failure (watchdog, step explosion, misuse)."""
+
+
+class DeadlockError(SchedulerError):
+    """Every live task is blocked on a tracked lock: real deadlock."""
+
+
+_TLS = threading.local()
+
+
+def _resolver():
+    """Installed as sanitize._SCHED_RESOLVER: scheduler for the current
+    thread, or None for threads the explorer does not own."""
+    return getattr(_TLS, "sched", None)
+
+
+class _Task:
+    __slots__ = ("idx", "name", "fn", "args", "state", "label",
+                 "blocked_on", "error", "thread")
+
+    def __init__(self, idx: int, name: str, fn, args):
+        self.idx = idx
+        self.name = name
+        self.fn = fn
+        self.args = args
+        self.state = "new"        # new|waiting|running|blocked|done
+        self.label = "start"      # where the task is parked
+        self.blocked_on = None    # id(TrackedLock) while state == blocked
+        self.error: BaseException | None = None
+        self.thread: threading.Thread | None = None
+
+
+class Scheduler:
+    """One exploration run: spawn tasks, then ``run()`` one seeded
+    schedule to completion."""
+
+    def __init__(self, seed: int = 0, max_steps: int = 50_000,
+                 watchdog_s: float = 30.0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.max_steps = max_steps
+        self.watchdog_s = watchdog_s
+        self.trace: list[tuple[int, int, str]] = []  # (step, task, label)
+        self._tasks: list[_Task] = []
+        self._cv = threading.Condition()
+        self._owner: dict[int, int] = {}   # id(lock) -> task idx
+        self._started = False
+
+    # --- scenario construction ------------------------------------------
+
+    def spawn(self, fn, *args, name: str | None = None) -> None:
+        if self._started:
+            raise SchedulerError("spawn() after run()")
+        idx = len(self._tasks)
+        self._tasks.append(_Task(idx, name or f"t{idx}", fn, args))
+
+    # --- the scheduling loop --------------------------------------------
+
+    def run(self) -> "list[tuple[int, int, str]]":
+        if self._started:
+            raise SchedulerError("a Scheduler runs exactly once")
+        self._started = True
+        if not self._tasks:
+            return self.trace
+        # idempotent global install: the resolver is TLS-scoped, so
+        # non-explorer threads always resolve to None
+        _san._SCHED_RESOLVER = _resolver
+        for task in self._tasks:
+            task.thread = threading.Thread(
+                target=self._bootstrap, args=(task,),
+                name=f"mgsan-{task.name}", daemon=True)
+            task.thread.start()
+        step = 0
+        with self._cv:
+            while True:
+                if all(t.state == "done" for t in self._tasks):
+                    break
+                runnable = [t for t in self._tasks
+                            if t.state in ("new", "waiting")]
+                if not runnable:
+                    blocked = [t for t in self._tasks
+                               if t.state == "blocked"]
+                    held = {lock_id: idx
+                            for lock_id, idx in self._owner.items()}
+                    detail = "; ".join(
+                        f"{t.name} blocked at {t.label}"
+                        for t in blocked)
+                    raise DeadlockError(
+                        f"seed {self.seed}: all live tasks blocked "
+                        f"({detail}); lock owners: {held}")
+                step += 1
+                if step > self.max_steps:
+                    raise SchedulerError(
+                        f"seed {self.seed}: exceeded {self.max_steps} "
+                        "schedule steps (livelock or missing yield?)")
+                task = runnable[self.rng.randrange(len(runnable))]
+                self.trace.append((step, task.idx, task.label))
+                task.state = "running"
+                self._cv.notify_all()
+                deadline_hit = not self._cv.wait_for(
+                    lambda: task.state != "running",
+                    timeout=self.watchdog_s)
+                if deadline_hit:
+                    raise SchedulerError(
+                        f"seed {self.seed}: task {task.name} did not "
+                        f"reach a schedule point within "
+                        f"{self.watchdog_s}s (blocked on an untracked "
+                        "primitive?)")
+        errors = [t for t in self._tasks if t.error is not None]
+        if errors:
+            raise errors[0].error
+        return self.trace
+
+    def _bootstrap(self, task: _Task) -> None:
+        _TLS.sched = self
+        _TLS.task = task
+        with self._cv:
+            while task.state != "running":
+                self._cv.wait()
+        try:
+            task.fn(*task.args)
+        except BaseException as e:   # surfaced by run()
+            task.error = e
+        finally:
+            with self._cv:
+                task.state = "done"
+                task.label = "done"
+                self._cv.notify_all()
+
+    # --- schedule points (called from sanitize/locks) --------------------
+
+    def yield_point(self, label: str = "") -> None:
+        task = getattr(_TLS, "task", None)
+        if task is None or task.state != "running":
+            return
+        with self._cv:
+            task.state = "waiting"
+            task.label = label or "yield"
+            self._cv.notify_all()
+            while task.state != "running":
+                self._cv.wait()
+
+    def lock_acquire(self, tracked) -> None:
+        """Called from TrackedLock.acquire for scheduler-owned threads."""
+        task = getattr(_TLS, "task", None)
+        if task is None:
+            tracked._lock.acquire()
+            return
+        self.yield_point(f"acquire:{tracked.name}")
+        while not tracked._lock.acquire(False):
+            with self._cv:
+                task.state = "blocked"
+                task.blocked_on = id(tracked)
+                task.label = f"blocked:{tracked.name}"
+                self._cv.notify_all()
+                while task.state != "running":
+                    self._cv.wait()
+        with self._cv:
+            self._owner[id(tracked)] = task.idx
+
+    def lock_released(self, tracked) -> None:
+        with self._cv:
+            self._owner.pop(id(tracked), None)
+            for t in self._tasks:
+                if t.state == "blocked" and t.blocked_on == id(tracked):
+                    t.state = "waiting"
+                    t.blocked_on = None
+                    t.label = f"retry:{tracked.name}"
+
+    # --- replayable trace -------------------------------------------------
+
+    def trace_text(self) -> str:
+        """Canonical one-line-per-step rendering; byte-identical across
+        runs with the same seed and scenario."""
+        names = {t.idx: t.name for t in self._tasks}
+        return "\n".join(f"{step:04d} {names[idx]} {label}"
+                         for step, idx, label in self.trace)
+
+
+def explore(build, seeds, check=None) -> dict:
+    """Run ``build(scheduler) -> ctx`` under one seeded schedule per seed.
+
+    ``build`` spawns tasks on the scheduler it receives and returns an
+    arbitrary context object; ``check(ctx)``, if given, runs after the
+    schedule completes and its return value is collected. Returns
+    {seed: {"trace": trace_text, "check": check result}}.
+    """
+    out = {}
+    for seed in seeds:
+        sched = Scheduler(seed=seed)
+        ctx = build(sched)
+        sched.run()
+        out[seed] = {"trace": sched.trace_text(),
+                     "check": check(ctx) if check is not None else None}
+    return out
