@@ -21,10 +21,14 @@ MSG_MGMT = 0x30
 
 
 class DataInstanceManagementServer:
-    def __init__(self, interpreter_context, host="127.0.0.1", port=12000):
+    def __init__(self, interpreter_context, host="127.0.0.1", port=12000,
+                 node_name: str | None = None):
         self.ictx = interpreter_context
         self.host = host
         self.port = port
+        # logical node name for the nemesis network model; threaded into
+        # the lazily created ReplicationState
+        self.node_name = node_name
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -48,7 +52,9 @@ class DataInstanceManagementServer:
     def _replication(self):
         from ..replication.main_role import ReplicationState
         if getattr(self.ictx, "replication", None) is None:
-            self.ictx.replication = ReplicationState(self.ictx.storage, ictx=self.ictx)
+            self.ictx.replication = ReplicationState(
+                self.ictx.storage, ictx=self.ictx,
+                node_name=self.node_name)
         return self.ictx.replication
 
     def _loop(self) -> None:
@@ -81,12 +87,26 @@ class DataInstanceManagementServer:
         kind = req.get("kind")
         replication = self._replication()
         if kind == "state_check":
+            # role/epoch/replicas let the coordinator RECONCILE divergent
+            # topology (a healed old main, a restarted node) instead of
+            # only counting health misses
+            epoch, fenced = replication.fencing_info()
             return {"ok": True, "role": replication.role,
+                    "fencing_epoch": epoch,
+                    "fenced": fenced,
+                    "replicas": replication.replica_names(),
                     "last_commit_ts": self.ictx.storage.latest_commit_ts()}
         if kind == "promote":
-            # become MAIN and adopt the given replicas
+            # become MAIN (fencing epoch minted through Raft) and adopt
+            # the given replicas
+            from ..exceptions import FencedException
             from ..replication.main_role import ReplicationMode
-            replication.set_role_main()
+            try:
+                replication.set_role_main(epoch=req.get("epoch"))
+            except FencedException as e:
+                return {"ok": False, "fenced": True, "errors": [str(e)]}
+            if req.get("no_strict_degradation"):
+                replication.allow_strict_degradation = False
             errors = []
             for rep in req.get("replicas", []):
                 try:
@@ -95,19 +115,35 @@ class DataInstanceManagementServer:
                         ReplicationMode[rep.get("mode", "SYNC")])
                 except Exception as e:
                     errors.append(f"{rep['name']}: {e}")
-            return {"ok": not errors, "errors": errors}
+            return {"ok": not errors, "errors": errors,
+                    "fencing_epoch": replication.current_epoch()}
         if kind == "demote":
             port = req.get("replication_port", 10000)
             try:
-                replication.set_role_replica("0.0.0.0", port)
+                replication.set_role_replica("0.0.0.0", port,
+                                             epoch=req.get("epoch"))
             except Exception as e:
                 return {"ok": False, "errors": [str(e)]}
-            return {"ok": True}
+            return {"ok": True,
+                    "fencing_epoch": replication.current_epoch()}
         return {"ok": False, "errors": [f"unknown request {kind}"]}
 
 
-def mgmt_call(address: str, request: dict, timeout: float = 2.0
+def mgmt_call(address: str, request: dict, timeout: float = 2.0,
+              src: str | None = None, dst: str | None = None
               ) -> dict | None:
+    """One management RPC. ``src``/``dst`` are logical node names for
+    the nemesis network model (the coordinator passes its raft id and
+    the instance name, so chaos tests can partition exactly the
+    coordinator↔instance link)."""
+    from ..utils import faultinject as FI
+    try:
+        if FI.fire("mgmt.rpc") == "drop":
+            return None  # RPC lost on the wire
+    except FI.FaultInjected:
+        return None      # injected fault == unreachable instance
+    if FI.net_fire(src, dst) == "drop":
+        return None      # request direction partitioned
     host, _, port = address.rpartition(":")
     try:
         from ..utils.tls import wrap_cluster_client
@@ -119,7 +155,10 @@ def mgmt_call(address: str, request: dict, timeout: float = 2.0
                 msg_type, payload = P.recv_frame(sock)
                 if msg_type != MSG_MGMT:
                     return None
-                return json.loads(payload.decode("utf-8"))
+                response = json.loads(payload.decode("utf-8"))
     except (ConnectionError, OSError, ValueError,
             json.JSONDecodeError):
         return None
+    if FI.net_fire(dst, src) == "drop":
+        return None      # asymmetric link: executed, but the ack is lost
+    return response

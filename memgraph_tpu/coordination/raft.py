@@ -44,6 +44,43 @@ class LogEntry:
         return LogEntry(obj["term"], obj["command"])
 
 
+@dataclass(frozen=True)
+class ProposeResult:
+    """Typed outcome of ``RaftNode.propose`` — callers must distinguish
+    retryable rejections from fatal ones (a bare bool collapsed "not the
+    leader, go elsewhere" and "timed out, maybe committed" into the same
+    silent False).
+
+    outcome:
+      committed        entry committed on a majority and applied
+      not_leader       this node cannot propose; retry against the leader
+      timeout          commit not observed in time — the entry MAY still
+                       commit later (ambiguous; retries must be
+                       idempotent)
+      lost_leadership  leadership changed under the proposal; the entry
+                       was superseded or its fate belongs to the new
+                       leader
+    """
+
+    outcome: str
+    index: int | None = None
+    term: int | None = None
+
+    def __bool__(self) -> bool:
+        return self.outcome == "committed"
+
+    @property
+    def retryable(self) -> bool:
+        """Safe to re-propose (for idempotent commands): the entry was
+        rejected or its commit is unresolved, not superseded."""
+        return self.outcome in ("not_leader", "timeout")
+
+    COMMITTED = "committed"
+    NOT_LEADER = "not_leader"
+    TIMEOUT = "timeout"
+    LOST_LEADERSHIP = "lost_leadership"
+
+
 class RaftNode:
     """One Raft participant listening on (host, port).
 
@@ -55,6 +92,13 @@ class RaftNode:
     ELECTION_TIMEOUT = (0.6, 1.2)   # seconds, randomized
     HEARTBEAT_INTERVAL = 0.15
     COMPACTION_THRESHOLD = 256      # applied entries kept before snapshot
+    # leader lease: a leader that cannot reach a majority within this
+    # window steps down instead of acting on stale authority (a
+    # minority-partitioned leader would otherwise keep serving reads and
+    # accepting doomed proposals until something ELSE noticed). Shorter
+    # than the minimum election timeout so the old leader abdicates
+    # before a partition-side majority can crown a successor.
+    LEADER_LEASE = 0.6
 
     def __init__(self, node_id: str, host: str, port: int,
                  peers: dict[str, tuple[str, int]], apply_fn=None,
@@ -114,6 +158,15 @@ class RaftNode:
         self._stop = threading.Event()
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_deadline()
+        # pre-vote (Raft §9.6 / the thesis' "PreVote" extension): a node
+        # that heard from a live leader within the minimum election
+        # timeout refuses pre-votes, so a flapping partitioned node
+        # cannot inflate terms and depose a healthy leader on heal.
+        # 0.0 = "never heard from a leader" so bootstrap elections work.
+        self._last_leader_contact = 0.0
+        # leader lease bookkeeping: last time each peer answered any RPC
+        self._peer_ack_at: dict[str, float] = {}
+        self._lease_started = 0.0
         self._sock: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._commit_events: dict[int, threading.Event] = {}
@@ -254,12 +307,18 @@ class RaftNode:
         with self._lock:
             return self.role == "leader"
 
-    def propose(self, command: dict, timeout: float = 5.0) -> bool:
-        """Leader-only: append a command; block until committed (majority)."""
+    def propose(self, command: dict, timeout: float = 5.0) -> ProposeResult:
+        """Leader-only: append a command; block until committed (majority).
+
+        Returns a :class:`ProposeResult` (truthy iff committed) so
+        callers can tell "retry elsewhere" from "may have committed"
+        from "superseded by a new leader"."""
         with self._lock:
             if self.role != "leader":
-                return False
-            entry = LogEntry(self.current_term, command)
+                return ProposeResult(ProposeResult.NOT_LEADER,
+                                     term=self.current_term)
+            term = self.current_term
+            entry = LogEntry(term, command)
             self.log.append(entry)
             index = self._abs_len() - 1
             self._persist_log_from(index)
@@ -272,7 +331,27 @@ class RaftNode:
         ok = event.wait(timeout)
         with self._lock:
             self._commit_events.pop(index, None)
-        return ok and self.commit_index >= index
+            # commit events are keyed by INDEX: a successor leader's
+            # entry at the same index also fires ours, so verify the
+            # committed entry carries OUR term. A committed entry that
+            # was already compacted away is still ours iff leadership
+            # never changed (an overwrite needs a higher-term leader).
+            if index >= self.log_start - 1:
+                ours = self._term_at(index) == term
+            else:
+                ours = self.current_term == term and self.role == "leader"
+            committed = ok and self.commit_index >= index and ours
+            if committed:
+                return ProposeResult(ProposeResult.COMMITTED,
+                                     index=index, term=term)
+            if self.current_term != term or self.role != "leader":
+                # a new leader took over mid-proposal; our entry was (or
+                # will be) overwritten — re-proposing here could double-
+                # apply, the caller must re-evaluate against new state
+                return ProposeResult(ProposeResult.LOST_LEADERSHIP,
+                                     index=index, term=term)
+            return ProposeResult(ProposeResult.TIMEOUT,
+                                 index=index, term=term)
 
     # --- networking ---------------------------------------------------------
 
@@ -313,6 +392,25 @@ class RaftNode:
                 return None  # RPC lost on the wire
         except FI.FaultInjected:
             return None      # injected network fault == unreachable peer
+        # nemesis link model, request direction: a dropped request never
+        # reaches the peer; "duplicate" delivers the (idempotent) RPC
+        # twice, exercising dedup/at-least-once handling
+        net = FI.net_fire(self.node_id, peer_id)
+        if net == "drop":
+            return None
+        response = self._call_peer_once(peer_id, request, timeout)
+        if net == "duplicate" and response is not None:
+            dup = self._call_peer_once(peer_id, request, timeout)
+            response = dup if dup is not None else response
+        if response is not None:
+            # ack direction: an asymmetric peer→us partition means the
+            # peer DID execute the RPC but we never learn the outcome
+            if FI.net_fire(peer_id, self.node_id) == "drop":
+                return None
+        return response
+
+    def _call_peer_once(self, peer_id: str, request: dict,
+                        timeout: float = 0.5) -> dict | None:
         host, port = self.peers[peer_id]
         data = json.dumps(request).encode("utf-8")
         # first attempt reuses the pooled connection (may be stale if the
@@ -364,6 +462,8 @@ class RaftNode:
         kind = req.get("kind")
         if kind == "request_vote":
             return self._on_request_vote(req)
+        if kind == "pre_vote":
+            return self._on_pre_vote(req)
         if kind == "append_entries":
             return self._on_append_entries(req)
         if kind == "install_snapshot":
@@ -377,6 +477,28 @@ class RaftNode:
             self.voted_for = None
             self.role = "follower"
             self._persist_term_vote()
+
+    def _on_pre_vote(self, req: dict) -> dict:
+        """Pre-vote (Raft §9.6): answer "would I vote for you?" WITHOUT
+        touching persistent state. Refused while a live leader is heard
+        from, so a node returning from a partition cannot force a real
+        election (term inflation) against a healthy cluster."""
+        with self._lock:
+            my_last_index = self._abs_len() - 1
+            my_last_term = self._term_at(my_last_index) \
+                if my_last_index >= 0 else 0
+            up_to_date = (req["last_log_term"] > my_last_term
+                          or (req["last_log_term"] == my_last_term
+                              and req["last_log_index"] >= my_last_index))
+            leader_is_live = (
+                self.role == "leader"
+                or (self._last_leader_contact > 0.0
+                    and time.monotonic() - self._last_leader_contact
+                    < self.ELECTION_TIMEOUT[0]))
+            grant = (req["term"] >= self.current_term and up_to_date
+                     and not leader_is_live)
+            return {"kind": "pre_vote_ack", "term": self.current_term,
+                    "granted": grant}
 
     def _on_request_vote(self, req: dict) -> dict:
         with self._lock:
@@ -407,6 +529,7 @@ class RaftNode:
             self.role = "follower"
             self.leader_id = req["leader"]
             self._election_deadline = self._new_deadline()
+            self._last_leader_contact = time.monotonic()
 
             prev_index = req["prev_log_index"]
             prev_term = req["prev_log_term"]
@@ -461,6 +584,7 @@ class RaftNode:
             self.role = "follower"
             self.leader_id = req["leader"]
             self._election_deadline = self._new_deadline()
+            self._last_leader_contact = time.monotonic()
             idx = req["last_included_index"]
             trm = req["last_included_term"]
             if idx <= self.log_start - 1:
@@ -515,12 +639,40 @@ class RaftNode:
                 deadline = self._election_deadline
             now = time.monotonic()
             if role == "leader":
+                if self._lease_expired(now):
+                    with self._lock:
+                        if self.role == "leader":
+                            log.warning(
+                                "raft %s: leader lease expired (no "
+                                "majority contact for %.1fs) — stepping "
+                                "down", self.node_id, self.LEADER_LEASE)
+                            self.role = "follower"
+                            self.leader_id = None
+                            self._election_deadline = self._new_deadline()
+                    continue
                 self._broadcast_append()
                 time.sleep(self.HEARTBEAT_INTERVAL)
             elif now >= deadline:
                 self._run_election()
 
+    def _lease_expired(self, now: float) -> bool:
+        """True when this leader has not heard from a majority (self
+        included) within LEADER_LEASE — i.e. it may be on the minority
+        side of a partition and must stop acting on its authority."""
+        if not self.peers:
+            return False     # single-node cluster: self IS the majority
+        with self._lock:
+            acks = sorted((self._peer_ack_at.get(p, self._lease_started)
+                           for p in self.peers), reverse=True)
+        majority = (len(self.peers) + 1) // 2 + 1
+        # self always counts; the (majority-1)-th freshest peer ack must
+        # still be inside the lease window
+        freshest_needed = acks[majority - 2]
+        return now - freshest_needed > self.LEADER_LEASE
+
     def _run_election(self) -> None:
+        if not self._pre_vote():
+            return
         with self._lock:
             self.role = "candidate"
             self.current_term += 1
@@ -553,6 +705,10 @@ class RaftNode:
                 self.leader_id = self.node_id
                 self.next_index = {p: self._abs_len() for p in self.peers}
                 self.match_index = {p: -1 for p in self.peers}
+                # fresh lease: the election itself just proved majority
+                # contact, so the clock starts now
+                self._lease_started = time.monotonic()
+                self._peer_ack_at = {}
                 # Raft §5.4.2: entries from PREVIOUS terms can only be
                 # committed alongside a current-term entry — append a
                 # no-op immediately so a committed-but-unapplied tail
@@ -565,6 +721,35 @@ class RaftNode:
                          term)
         if self.is_leader():
             self._broadcast_append()
+
+    def _pre_vote(self) -> bool:
+        """Canvass the cluster WITHOUT incrementing the term; only a
+        majority of pre-votes (self included) starts a real election."""
+        with self._lock:
+            if self.role == "leader":
+                return False
+            term = self.current_term + 1
+            last_index = self._abs_len() - 1
+            last_term = self._term_at(last_index) if last_index >= 0 else 0
+            # re-arm the deadline so a failed canvass retries later
+            # instead of spinning the timer loop
+            self._election_deadline = self._new_deadline()
+        granted = 1
+        for peer_id in list(self.peers):
+            resp = self._call_peer(peer_id, {
+                "kind": "pre_vote", "term": term,
+                "candidate": self.node_id,
+                "last_log_index": last_index, "last_log_term": last_term})
+            if resp is None:
+                continue
+            with self._lock:
+                if resp["term"] > self.current_term:
+                    self._maybe_step_down(resp["term"])
+                    return False
+            if resp.get("granted"):
+                granted += 1
+        majority = (len(self.peers) + 1) // 2 + 1
+        return granted >= majority
 
     # --- leader replication -------------------------------------------------
 
@@ -604,6 +789,8 @@ class RaftNode:
         if resp is None:
             return
         with self._lock:
+            # any response proves the link is alive — feed the lease
+            self._peer_ack_at[peer_id] = time.monotonic()
             if resp["term"] > self.current_term:
                 self._maybe_step_down(resp["term"])
                 return

@@ -69,6 +69,17 @@ class TransactionException(QueryException):
     pass
 
 
+class ReplicaUnavailableException(TransactionException):
+    """Commit refused BEFORE any replica prepared: the write definitely
+    did not happen anywhere (a safe, non-ambiguous failure — chaos
+    clients may record it as a clean fail, not indeterminate)."""
+
+
+class FencedException(TransactionException):
+    """This MAIN holds a stale fencing epoch — a newer MAIN was
+    promoted. Refused before any effect; definitely did not happen."""
+
+
 class ProcedureException(QueryException):
     """Error raised from a CALLed query module procedure."""
 

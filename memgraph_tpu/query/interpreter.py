@@ -706,6 +706,14 @@ class Interpreter:
         if replication is not None and replication.role == "replica":
             raise QueryException(
                 f"{what} is forbidden on a REPLICA instance")
+        if replication is not None and replication.role == "main" \
+                and replication.is_fenced():
+            # deposed MAIN (a newer fencing epoch exists): refuse loudly
+            # at query admission, before the commit path even starts
+            from ..exceptions import FencedException
+            raise FencedException(
+                f"{what} is forbidden: this MAIN was deposed (fenced); "
+                "reconnect via the coordinator routing table")
 
     def _replication_state(self):
         if getattr(self.ctx, "replication", None) is None:

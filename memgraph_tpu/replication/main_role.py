@@ -47,12 +47,32 @@ class ReplicaStatus(enum.Enum):
     INVALID = "invalid"
 
 
+class FencedError(OSError):
+    """A replica refused this MAIN's registration: its fencing epoch is
+    newer — a successor MAIN was promoted. OSError subclass so generic
+    network handlers treat it as a dead link, but carries the observed
+    epoch so ReplicationState can fence itself on sight."""
+
+    def __init__(self, observed_epoch: int):
+        super().__init__(
+            f"fenced: a main with epoch {observed_epoch} superseded us")
+        self.observed_epoch = observed_epoch
+
+
 class ReplicaClient:
     def __init__(self, name: str, address: str, mode: ReplicationMode,
-                 storage):
+                 storage, src_node: str = "main", epoch_fn=None):
         from ..exceptions import QueryException
         self.name = name
         self.address = address
+        # logical node identities for the nemesis network model: every
+        # message direction main→replica / replica→main consults the
+        # (src, dst)-keyed link rules in utils/faultinject
+        self.src_node = src_node
+        # current fencing epoch, read at (re-)registration time — a
+        # callable because reconnects may happen after a demote/promote
+        # changed the owning state's epoch
+        self.epoch_fn = epoch_fn or (lambda: 0)
         host, _, port = address.rpartition(":")
         if not host or not port.isdigit():
             raise QueryException(
@@ -112,18 +132,43 @@ class ReplicaClient:
                 self.status = ReplicaStatus.INVALID
                 raise
 
+    def _net_out(self) -> None:
+        """Nemesis link check, main→replica direction: a partitioned
+        link means the message never leaves this node."""
+        if FI.net_fire(self.src_node, self.name) == "drop":
+            raise FI.FaultInjected(
+                f"link {self.src_node}->{self.name} partitioned")
+
+    def _net_in(self) -> None:
+        """Nemesis link check, replica→main direction, applied AFTER the
+        peer processed the message: with an asymmetric partition the
+        replica DID apply the frame but the ack is lost on the wire."""
+        if FI.net_fire(self.name, self.src_node) == "drop":
+            raise FI.FaultInjected(
+                f"link {self.name}->{self.src_node} partitioned (ack lost)")
+
     def _connect_and_catch_up(self) -> None:
         self.status = ReplicaStatus.RECOVERY
+        self._net_out()
         sock = socket.create_connection((self._host, self._port), timeout=30)
         from ..utils.tls import wrap_cluster_client
         sock = wrap_cluster_client(sock, server_hostname=self._host)
         P.send_json(sock, P.MSG_REGISTER,
-                    {"name": self.name, "epoch": "epoch-1",
+                    {"name": self.name, "epoch": self.epoch_fn(),
+                     "src": self.src_node,
                      "main_commit_ts": self.storage.latest_commit_ts()})
         msg_type, payload = P.recv_frame(sock)
+        if msg_type == P.MSG_FENCED:
+            sock.close()
+            raise FencedError(P.parse_json(payload).get("fencing_epoch", 0))
         if msg_type != P.MSG_REGISTER_OK:
             sock.close()
             raise ConnectionError("replica registration failed")
+        try:
+            self._net_in()
+        except FI.FaultInjected:
+            sock.close()
+            raise
         info = P.parse_json(payload)
         self._sock = sock
         # catch-up ladder (reference recovery.hpp): WAL-delta rung first —
@@ -138,8 +183,10 @@ class ReplicaClient:
             if frames is not None:
                 self.catchup_used = "wal_delta"
                 for frame in frames:
+                    self._net_out()
                     P.send_frame(sock, P.MSG_WAL_FRAME, frame)
                     msg_type, payload = P.recv_frame(sock)
+                    self._net_in()
                     if msg_type != P.MSG_ACK:
                         raise ConnectionError("wal-delta catch-up failed")
                     self._set_acked(
@@ -147,8 +194,10 @@ class ReplicaClient:
             else:
                 self.catchup_used = "snapshot"
                 snapshot_bytes = self._snapshot_bytes()
+                self._net_out()
                 P.send_frame(sock, P.MSG_SNAPSHOT, snapshot_bytes)
                 msg_type, payload = P.recv_frame(sock)
+                self._net_in()
                 if msg_type != P.MSG_ACK:
                     raise ConnectionError("snapshot transfer failed")
                 self._set_acked(
@@ -346,8 +395,10 @@ class ReplicaClient:
         try:
             if FI.fire("repl.send") == "drop":
                 raise FI.FaultInjected("injected drop of system txn")
+            self._net_out()
             P.send_json(self._sock, P.MSG_SYSTEM, txn)
             msg_type, _ = P.recv_frame(self._sock)
+            self._net_in()
             return msg_type == P.MSG_ACK
         except (ConnectionError, OSError) as e:
             self._mark_failed("system txn", e)
@@ -359,8 +410,10 @@ class ReplicaClient:
                 # the frame is lost before hitting the wire; the ack
                 # timeout/reconnect path must re-ship it via catch-up
                 raise FI.FaultInjected("injected drop of WAL frame")
+            self._net_out()
             P.send_frame(self._sock, P.MSG_WAL_FRAME, frame)
             msg_type, payload = P.recv_frame(self._sock)
+            self._net_in()
             if msg_type == P.MSG_ACK:
                 self._note_ack(P.parse_json(payload)["last_commit_ts"])
                 return True
@@ -390,8 +443,10 @@ class ReplicaClient:
                 try:
                     if FI.fire("repl.send") == "drop":
                         raise FI.FaultInjected("injected drop of prepare")
+                    self._net_out()
                     P.send_frame(self._sock, P.MSG_PREPARE, frame)
                     msg_type, payload = P.recv_frame(self._sock)
+                    self._net_in()
                 finally:
                     self._sock.settimeout(old)
                 return msg_type == P.MSG_ACK
@@ -408,9 +463,11 @@ class ReplicaClient:
                 old = self._sock.gettimeout()
                 self._sock.settimeout(self.TWO_PC_RPC_TIMEOUT_SEC)
                 try:
+                    self._net_out()
                     P.send_json(self._sock, P.MSG_FINALIZE,
                                 {"commit_ts": commit_ts, "decision": decision})
                     msg_type, payload = P.recv_frame(self._sock)
+                    self._net_in()
                 finally:
                     self._sock.settimeout(old)
                 if msg_type == P.MSG_ACK:
@@ -443,10 +500,12 @@ class ReplicaClient:
                 old = self._sock.gettimeout()
                 self._sock.settimeout(self.TWO_PC_RPC_TIMEOUT_SEC)
                 try:
+                    self._net_out()
                     P.send_json(self._sock, P.MSG_HEARTBEAT,
                                 {"main_commit_ts":
                                  self.storage.latest_commit_ts()})
                     msg_type, payload = P.recv_frame(self._sock)
+                    self._net_in()
                 finally:
                     self._sock.settimeout(old)
                 if msg_type == P.MSG_ACK:
@@ -477,10 +536,26 @@ class ReplicationState:
 
     HEARTBEAT_INTERVAL_SEC = 2.0
 
-    def __init__(self, storage, ictx=None):
+    def __init__(self, storage, ictx=None, node_name: str | None = None):
+        import os as _os
         self.storage = storage
         self.ictx = ictx           # system-state source (auth, dbms)
         self.role = "main"
+        # logical node name for the nemesis network model (chaos tests
+        # partition links keyed on these names)
+        self.node_name = node_name or _os.environ.get(
+            "MEMGRAPH_TPU_NODE_NAME", "main")
+        # fencing: the promotion epoch this instance last learned from
+        # the coordinator (promote/demote RPC) or from a replica's
+        # MSG_FENCED refusal. A MAIN that observes a newer epoch than
+        # its own has been deposed and must stop acking writes.
+        self.fencing_epoch = 0
+        self.fenced = False
+        # STRICT_SYNC degradation trades safety for availability; a
+        # fenced/HA deployment turns it off so a partitioned MAIN can
+        # never silently stop waiting for its strict replicas (that is
+        # exactly the split-brain ack-loss window)
+        self.allow_strict_degradation = True
         self._system_seq = 0
         self.replicas: dict[str, ReplicaClient] = {}
         self.replica_server = None
@@ -502,7 +577,8 @@ class ReplicationState:
         self._reconnecting: set[int] = set()
         from ..utils.sanitize import shared_field
         shared_field(self, "replicas", "_recent_frames", "_frames_floor",
-                     "_reconnecting", "_system_seq")
+                     "_reconnecting", "_system_seq", "fencing_epoch",
+                     "fenced")
 
     def _ensure_consumer(self) -> None:
         # lazy: commits only pay frame encoding once a replica exists
@@ -549,6 +625,7 @@ class ReplicationState:
             doc = {"role": self.role,
                    "listen_port": (self.replica_server.port
                                    if self.replica_server else 0),
+                   "fencing_epoch": self.fencing_epoch,
                    "replicas": [
                        {"name": r.name, "address": r.address,
                         "mode": r.mode.name}
@@ -569,9 +646,13 @@ class ReplicationState:
             doc = json.loads(raw)
         except ValueError:
             return
+        epoch = int(doc.get("fencing_epoch") or 0)
         if doc.get("role") == "replica" and doc.get("listen_port"):
-            self.set_role_replica("0.0.0.0", int(doc["listen_port"]))
+            self.set_role_replica("0.0.0.0", int(doc["listen_port"]),
+                                  epoch=epoch)
             return
+        with self._lock:
+            self.fencing_epoch = max(self.fencing_epoch, epoch)
         from ..exceptions import QueryException
         for spec in doc.get("replicas", ()):
             try:
@@ -587,7 +668,8 @@ class ReplicationState:
                             spec.get("name", "?"), e)
                 continue
 
-    def set_role_replica(self, host: str, port: int) -> None:
+    def set_role_replica(self, host: str, port: int,
+                         epoch: int | None = None) -> None:
         from ..exceptions import QueryException
         from .replica import ReplicaServer
         with self._lock:
@@ -595,11 +677,14 @@ class ReplicationState:
                 r.close()
             self.replicas.clear()
             self._maybe_remove_consumer()
+            if epoch is not None:
+                self.fencing_epoch = max(self.fencing_epoch, int(epoch))
             if self.replica_server is not None:
                 self.replica_server.stop()
                 self.replica_server = None
             server = ReplicaServer(self.storage, host, port,
-                                   ictx=self.ictx)
+                                   ictx=self.ictx,
+                                   fencing_epoch=self.fencing_epoch)
             try:
                 server.start()
             except OSError as e:
@@ -607,24 +692,110 @@ class ReplicationState:
                     f"cannot listen on {host}:{port}: {e}") from e
             self.replica_server = server
             self.role = "replica"
+            self.fenced = False    # a demoted node is no longer a main
         self._persist_state()
 
-    def set_role_main(self) -> None:
+    def set_role_main(self, epoch: int | None = None) -> None:
+        from ..exceptions import FencedException
         with self._lock:
-            if self.replica_server is not None:
-                self.replica_server.stop()
-                self.replica_server = None
+            if epoch is not None and int(epoch) < self.fencing_epoch:
+                # a delayed/replayed promote RPC from a PREVIOUS epoch
+                # must not resurrect a deposed main
+                raise FencedException(
+                    f"stale promote epoch {epoch} < known "
+                    f"{self.fencing_epoch}")
+            server, self.replica_server = self.replica_server, None
+        if server is not None:
+            # presumed-commit OUTSIDE the state lock (the WAL apply
+            # takes the engine lock, whose commit path takes the state
+            # lock — holding it here closes a lock cycle): prepared 2PC
+            # frames whose finalize never arrived are applied so an
+            # acked write on the old MAIN survives this promotion
+            server.apply_pending_2pc()
+            server.stop()
+        with self._lock:
+            # re-check under the write lock: a concurrent fence/demote
+            # may have advanced the epoch while the 2PC drain ran — a
+            # now-stale promote must still be refused (the coordinator's
+            # reconcile loop repairs the half-stopped server state)
+            if epoch is not None and int(epoch) < self.fencing_epoch:
+                raise FencedException(
+                    f"stale promote epoch {epoch} < known "
+                    f"{self.fencing_epoch} (epoch advanced mid-promote)")
             self.role = "main"
+            if epoch is not None:
+                self.fencing_epoch = max(self.fencing_epoch, int(epoch))
+            self.fenced = False
         self._persist_state()
+
+    def current_epoch(self) -> int:
+        """Fencing epoch under the state lock (replica registration,
+        mgmt state_check)."""
+        from ..utils.sanitize import shared_read
+        with self._lock:
+            shared_read(self, "fencing_epoch")
+            return self.fencing_epoch
+
+    def is_fenced(self) -> bool:
+        from ..utils.sanitize import shared_read
+        with self._lock:
+            shared_read(self, "fenced")
+            return self.fenced
+
+    def fencing_info(self) -> tuple[int, bool]:
+        """(fencing_epoch, fenced) as one consistent snapshot."""
+        from ..utils.sanitize import shared_read
+        with self._lock:
+            shared_read(self, "fencing_epoch")
+            return self.fencing_epoch, self.fenced
+
+    def replica_names(self) -> list[str]:
+        """Registered replica names under the state lock (state_check)."""
+        with self._lock:
+            return sorted(self.replicas)
+
+    def fence(self, observed_epoch: int) -> None:
+        """A replica (or the coordinator) proved a newer MAIN exists:
+        stop acking writes until promoted again with a fresh epoch."""
+        from ..utils.sanitize import shared_write
+        with self._lock:
+            if observed_epoch <= self.fencing_epoch and self.fenced:
+                return
+            shared_write(self, "fencing_epoch")
+            self.fencing_epoch = max(self.fencing_epoch,
+                                     int(observed_epoch))
+            self.fenced = True
+        global_metrics.increment("replication.fenced_total")
+        log.error(
+            "MAIN %s FENCED: epoch %d superseded ours — refusing further "
+            "write acks until re-promoted", self.node_name, observed_epoch)
+
+    def shutdown(self) -> None:
+        """Hard-stop everything this state owns (chaos kill / dbms
+        teardown): heartbeat loop, replica clients, replica server."""
+        self._stop_heartbeat.set()
+        with self._lock:
+            clients = list(self.replicas.values())
+            server, self.replica_server = self.replica_server, None
+        for c in clients:
+            c.close()
+        if server is not None:
+            server.stop()
 
     # --- replica registry ---------------------------------------------------
 
     def register_replica(self, name: str, address: str,
                          mode: ReplicationMode) -> None:
-        from ..exceptions import QueryException
+        from ..exceptions import FencedException, QueryException
         if self.role != "main":
             raise QueryException("only MAIN can register replicas")
-        client = ReplicaClient(name, address, mode, self.storage)
+        if self.is_fenced():
+            raise FencedException(
+                "this MAIN is fenced (a newer epoch exists); it cannot "
+                "adopt replicas")
+        client = ReplicaClient(name, address, mode, self.storage,
+                               src_node=self.node_name,
+                               epoch_fn=self.current_epoch)
         client.system_state_provider = self.system_state
         client.recent_frames_provider = self._frames_since
         with self._lock:
@@ -646,6 +817,11 @@ class ReplicationState:
                     del self.replicas[name]
                 self._maybe_remove_consumer()
             client.close()
+            if isinstance(e, FencedError):
+                # the replica proved a newer MAIN exists: fence NOW so
+                # no further commit on this deposed main gets acked
+                self.fence(e.observed_epoch)
+                raise FencedException(str(e)) from e
             raise QueryException(
                 f"cannot register replica {name!r}: {e}") from e
         self._persist_state()
@@ -707,7 +883,13 @@ class ReplicationState:
                 with self._lock:
                     if self.replicas.get(name) is not client:
                         return
-                client.connect_and_catch_up()
+                try:
+                    client.connect_and_catch_up()
+                except FencedError as fe:
+                    # the replica now answers to a newer MAIN; stop
+                    # reconnecting AND stop acking — we are deposed
+                    self.fence(fe.observed_epoch)
+                    return
                 # re-check: drop may have raced the transfer — don't
                 # resurrect a connection the registry no longer owns
                 with self._lock:
@@ -796,6 +978,13 @@ class ReplicationState:
         inmemory/storage.cpp:1224-1272)."""
         if self.role != "main":
             return
+        epoch, fenced = self.fencing_info()
+        if fenced:
+            # refused BEFORE any prepare: a deposed main acks nothing
+            from ..exceptions import FencedException
+            raise FencedException(
+                f"write refused: this MAIN is fenced (epoch "
+                f"{epoch} superseded it)")
         with self._lock:
             all_strict = [c for c in self.replicas.values()
                           if c.mode is ReplicationMode.STRICT_SYNC]
@@ -811,13 +1000,17 @@ class ReplicationState:
         down = [c for c in all_strict if c.status is not ReplicaStatus.READY]
         still_down = []
         for c in down:
-            if c.retry_budget_exhausted():
+            if self.allow_strict_degradation and \
+                    c.retry_budget_exhausted():
                 self._demote_strict(c)
             else:
                 still_down.append(c)
         if still_down:
-            from ..exceptions import TransactionException
-            raise TransactionException(
+            # ReplicaUnavailable (not the generic TransactionException):
+            # nothing was prepared anywhere, so this abort is a SAFE
+            # "definitely did not happen" — chaos clients rely on that
+            from ..exceptions import ReplicaUnavailableException
+            raise ReplicaUnavailableException(
                 "STRICT_SYNC replica(s) unavailable: "
                 + ", ".join(c.name for c in still_down)
                 + " — transaction aborted (drop the replica or restore it)")

@@ -25,6 +25,8 @@ MSG_ACK = 0x06            # json: {last_commit_ts}
 MSG_PREPARE = 0x07        # 2PC phase 1: wal frame held pending a decision
 MSG_FINALIZE = 0x08       # 2PC phase 2: json {commit_ts, decision}
 MSG_SYSTEM = 0x09         # json: ordered system txn (auth / multi-db DDL)
+MSG_FENCED = 0x0A         # json: {fencing_epoch} — registration refused,
+                          # the sender's epoch is stale (a deposed MAIN)
 MSG_ERROR = 0x7F          # json: {message}
 
 

@@ -26,14 +26,20 @@ class ReplicaServer:
     """Listens for the MAIN; applies snapshot + WAL frames to storage."""
 
     def __init__(self, storage, host: str = "127.0.0.1", port: int = 10000,
-                 ictx=None):
+                 ictx=None, fencing_epoch: int = 0):
         self.storage = storage
         self.ictx = ictx           # for system-state apply (auth, multi-db)
         self.host = host
         self.port = port
         self.last_system_seq = 0
         self.last_commit_ts = 0
-        self.epoch = None
+        # fencing: the highest promotion epoch this replica has ever
+        # heard (from its own demote RPC or a registering MAIN). A MAIN
+        # registering with a LOWER epoch was deposed — its registration
+        # is refused with MSG_FENCED so a partitioned-away old MAIN can
+        # never feed us stale writes (split-brain guard).
+        self.fencing_epoch = int(fencing_epoch or 0)
+        self.epoch = self.fencing_epoch    # back-compat alias
         self._sock: socket.socket | None = None
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -104,14 +110,27 @@ class ReplicaServer:
                     raise FI.FaultInjected("injected drop of received frame")
                 if msg_type == P.MSG_REGISTER:
                     info = P.parse_json(payload)
-                    self.epoch = info.get("epoch")
+                    main_epoch = int(info.get("epoch") or 0)
+                    if main_epoch < self.fencing_epoch:
+                        # deposed MAIN: refuse — and TELL it the current
+                        # epoch so it can fence itself immediately
+                        log.warning(
+                            "refusing registration from stale-epoch main "
+                            "(theirs %d < ours %d)", main_epoch,
+                            self.fencing_epoch)
+                        P.send_json(conn, P.MSG_FENCED,
+                                    {"fencing_epoch": self.fencing_epoch})
+                        continue
+                    self.fencing_epoch = max(self.fencing_epoch,
+                                             main_epoch)
+                    self.epoch = self.fencing_epoch
                     # a (re-)registering MAIN supersedes any in-flight 2PC:
                     # prepared-but-unfinalized frames from the previous
                     # connection would otherwise leak forever
                     self._pending_2pc.clear()
                     P.send_json(conn, P.MSG_REGISTER_OK,
                                 {"last_commit_ts": self.last_commit_ts,
-                                 "epoch": self.epoch})
+                                 "epoch": self.fencing_epoch})
                 elif msg_type == P.MSG_SNAPSHOT:
                     self._pending_2pc.clear()
                     self._apply_snapshot_bytes(payload)
@@ -152,6 +171,27 @@ class ReplicaServer:
             pass
         finally:
             conn.close()
+
+    def apply_pending_2pc(self) -> int:
+        """Presumed-commit on promotion: apply prepared-but-unfinalized
+        2PC frames in commit order before this replica becomes MAIN.
+
+        A frame sits here only after the old MAIN collected the full
+        strict vote — the common reason the finalize never arrived is
+        that the MAIN committed (and ACKED the client) and then lost us.
+        Applying is therefore the durability-safe direction; the rare
+        aborted-after-vote txn resurfaces as an UN-acked write, which no
+        client was promised anything about. Returns the applied count."""
+        pending = sorted(self._pending_2pc.items())
+        self._pending_2pc.clear()
+        for commit_ts, frame in pending:
+            if commit_ts <= self.last_commit_ts:
+                continue
+            self._apply_wal_frame(frame)
+        if pending:
+            log.warning("promotion: presumed-commit applied %d pending "
+                        "2PC frame(s)", len(pending))
+        return len(pending)
 
     # --- appliers -----------------------------------------------------------
 

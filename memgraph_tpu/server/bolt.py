@@ -578,6 +578,7 @@ class BoltSession:
                 {"addresses": coordinator.routers or [addr],
                  "role": "ROUTE"})
             self.send_success({"rt": {"ttl": 10, "db": "memgraph",
+                                      "epoch": table.get("epoch", 0),
                                       "servers": servers}})
             return True
         # single-instance routing table: this server serves all roles
@@ -592,18 +593,51 @@ class BoltSession:
         }})
         return True
 
+    async def refuse_overloaded(self) -> None:
+        """Session-cap refusal: finish the handshake so the client can
+        parse a real Bolt FAILURE (instead of a dead socket), send it,
+        and hang up. The client sees a transient, retryable error."""
+        try:
+            if not await self.handshake():
+                return
+            # consume the client's HELLO first: sending FAILURE and
+            # closing immediately can RST the client's in-flight HELLO
+            # before it ever reads our refusal
+            await self.read_message()
+            self.send_failure(
+                "Memgraph.TransientError.General.ServerOverloaded",
+                "server overloaded: max concurrent sessions reached, "
+                "retry later")
+            await self.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                OSError):
+            pass   # the refused peer vanished first; nothing to clean up
+        finally:
+            self.writer.close()
+
 
 class BoltServer:
     """Asyncio TCP server accepting Bolt sessions."""
 
     def __init__(self, interpreter_context: InterpreterContext,
                  host: str = "127.0.0.1", port: int = 7687, auth=None,
-                 ssl_context=None, workers: int = None):
+                 ssl_context=None, workers: int = None,
+                 max_sessions: int | None = None):
         self.ictx = interpreter_context
         self.host = host
         self.port = port
         self.auth = auth
         self.ssl_context = ssl_context   # bolt+s (ref: communication/context.cpp)
+        # accept-loop backpressure (reference: --bolt-num-workers bounded
+        # session pool): beyond max_sessions concurrent sessions, new
+        # connections get a proper Bolt FAILURE ("server overloaded")
+        # instead of unbounded accept → fd/thread exhaustion under a
+        # connection storm. 0/None = unlimited (single-user default).
+        if max_sessions is None:
+            max_sessions = int(os.environ.get(
+                "MEMGRAPH_TPU_BOLT_MAX_SESSIONS", 0))
+        self.max_sessions = max_sessions
+        self._live_sessions = 0      # only touched on the event loop
         self._server = None
         if workers is None:
             workers = min(32, (os.cpu_count() or 4) * 4)
@@ -619,7 +653,18 @@ class BoltServer:
     async def _handle(self, reader, writer):
         session = BoltSession(reader, writer, self.ictx, self.auth,
                               executor=self._executor)
-        await session.run()
+        if self.max_sessions and self._live_sessions >= self.max_sessions:
+            from ..observability.metrics import global_metrics
+            global_metrics.increment("bolt.connections_rejected_total")
+            log.warning("bolt: refusing connection, %d/%d sessions live",
+                        self._live_sessions, self.max_sessions)
+            await session.refuse_overloaded()
+            return
+        self._live_sessions += 1
+        try:
+            await session.run()
+        finally:
+            self._live_sessions -= 1
 
     async def start(self):
         self._server = await asyncio.start_server(

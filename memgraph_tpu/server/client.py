@@ -161,3 +161,131 @@ class BoltClient:
         except OSError:
             pass  # peer already gone; GOODBYE is best-effort
         self.sock.close()
+
+
+class RoutedClient:
+    """Route-table-driven writes with failover retry.
+
+    A thin HA driver over :class:`BoltClient` (reference analog: the
+    neo4j driver's routing table handling against coordinators): it
+    bootstraps from one or more router (coordinator) addresses, fetches
+    the ROUTE table, and sends writes to the current writer. On any
+    failure it refreshes the table — from ANY reachable router learned
+    so far — and retries against the (possibly new) MAIN with
+    exponential backoff, so a failover is a handful of retried requests
+    instead of an error surfaced to the caller.
+
+    Fencing: the table carries the coordinator's fencing epoch; the
+    client remembers the highest epoch it has seen and refuses to go
+    back to a table (or writer) from an older one — a partitioned
+    coordinator serving a stale table cannot steer writes to a deposed
+    MAIN.
+    """
+
+    def __init__(self, routers: list[str], username: str = "",
+                 password: str = "", retry=None, timeout: float = 10.0):
+        from ..utils.retry import RetryPolicy
+        if not routers:
+            raise MemgraphTpuError("RoutedClient needs >= 1 router")
+        self.routers = list(routers)
+        self.username = username
+        self.password = password
+        self.timeout = timeout
+        self.retry = retry or RetryPolicy(base_delay=0.2, max_delay=2.0,
+                                          max_retries=8)
+        self.known_epoch = 0
+        self._writer_addr: str | None = None
+        self._writer: BoltClient | None = None
+
+    @staticmethod
+    def _split(addr: str) -> tuple[str, int]:
+        host, _, port = addr.rpartition(":")
+        return host, int(port)
+
+    def refresh_route_table(self) -> bool:
+        """Fetch a fresh table from any reachable router; keep only a
+        table at least as new (by fencing epoch) as what we know."""
+        for router in list(self.routers):
+            host, port = self._split(router)
+            try:
+                rc = BoltClient(host=host, port=port,
+                                username=self.username,
+                                password=self.password,
+                                timeout=self.timeout)
+            except (OSError, MemgraphTpuError):
+                continue
+            try:
+                rt = rc.route() or {}
+            except (OSError, MemgraphTpuError):
+                continue
+            finally:
+                try:
+                    rc.close()
+                except OSError:
+                    pass
+            epoch = int(rt.get("epoch") or 0)
+            if epoch < self.known_epoch:
+                continue   # stale coordinator (partitioned minority)
+            self.known_epoch = max(self.known_epoch, epoch)
+            servers = {s["role"]: s["addresses"]
+                       for s in rt.get("servers", [])}
+            for r in servers.get("ROUTE", []):
+                if r not in self.routers:
+                    self.routers.append(r)
+            writers = servers.get("WRITE", [])
+            if writers:
+                if writers[0] != self._writer_addr:
+                    self._disconnect()
+                    self._writer_addr = writers[0]
+                return True
+        return False
+
+    def _disconnect(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except OSError:
+                pass
+            self._writer = None
+
+    def _connect_writer(self) -> BoltClient:
+        if self._writer is None:
+            if self._writer_addr is None and not self.refresh_route_table():
+                raise MemgraphTpuError("no writer in any routing table")
+            host, port = self._split(self._writer_addr)
+            self._writer = BoltClient(host=host, port=port,
+                                      username=self.username,
+                                      password=self.password,
+                                      timeout=self.timeout)
+        return self._writer
+
+    def execute_write(self, query: str, parameters: dict | None = None):
+        """Run a write on the current MAIN, re-routing with backoff on
+        failure. Returns (columns, rows, summary) like BoltClient."""
+        last: Exception | None = None
+        for attempt in range(self.retry.max_retries + 1):
+            try:
+                return self._connect_writer().execute(query, parameters)
+            except BoltClientError as e:
+                if e.code.startswith(("Memgraph.ClientError.Statement",
+                                      "Memgraph.ClientError.Security")):
+                    raise   # the query/auth is wrong; rerouting won't help
+                # transaction/transient failures (fenced main, strict
+                # replicas unavailable mid-failover) ARE the retry case
+                last = e
+                self._disconnect()
+                self.refresh_route_table()
+                import time as _time
+                _time.sleep(self.retry.delay_for(attempt))
+            except (OSError, MemgraphTpuError) as e:
+                last = e
+                self._disconnect()
+                self.refresh_route_table()
+                import time as _time
+                _time.sleep(self.retry.delay_for(attempt))
+        raise MemgraphTpuError(
+            f"write failed after {self.retry.max_retries + 1} routed "
+            f"attempts: {last}") from last
+
+    def close(self) -> None:
+        self._disconnect()

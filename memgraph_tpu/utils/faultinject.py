@@ -57,6 +57,20 @@ KNOWN_POINTS = (
     "repl.recv",       # ReplicaServer, before handling a received frame
     "raft.rpc",        # RaftNode._call_peer ("drop" = RPC lost)
     "kvstore.put",     # KVStore.put, before the sqlite write
+    "mgmt.rpc",        # coordination.mgmt_call ("drop" = mgmt RPC lost)
+)
+
+#: the catalog of nemesis operations tools/mgchaos schedules (the
+#: MG005-style coverage contract: every op here must map to a live
+#: ``net_*``/cluster hook AND be exercised by at least one test)
+NEMESIS_OPS = (
+    "partition",          # symmetric partition of a peer pair
+    "partition_oneway",   # asymmetric: src->dst traffic lost, dst->src fine
+    "partition_node",     # isolate one node from everybody (a "pause")
+    "delay",              # fixed extra latency on a link
+    "duplicate",          # every message on the link delivered twice
+    "reorder",            # seeded jitter on the link (messages overtake)
+    "kill_restart",       # node churn: hard-kill a node, later restart it
 )
 
 
@@ -146,12 +160,15 @@ def arm_from_string(text: str) -> None:
 
 
 def reset(reload_env: bool = False) -> None:
-    """Disarm everything and zero the hit counters."""
-    global _ARMED
+    """Disarm everything (scalar faults AND the network model) and zero
+    the hit counters."""
+    global _ARMED, _NET_ARMED
     with _LOCK:
         _SPECS.clear()
         _COUNTS.clear()
         _ARMED = False
+        _NET_RULES.clear()
+        _NET_ARMED = False
     if reload_env:
         _load_env()
 
@@ -250,6 +267,153 @@ def faulty_write(point: str, fileobj, data: bytes) -> None:
     if result == "drop":
         return  # the write is silently lost
     fileobj.write(data)
+
+
+# --- peer-aware network model (the mgchaos nemesis layer) --------------------
+#
+# Where the scalar points above fault ONE call site, the network model
+# faults LINKS: rules are keyed on (src, dst) logical node names and
+# evaluated by every cluster RPC site (raft._call_peer, replication
+# send/ack, coordinator mgmt RPCs) in BOTH directions, so asymmetric
+# one-way partitions behave like real ones — the request arrives and is
+# executed, only the ack is lost. "*" matches any node. All state is
+# process-global like the scalar registry: an in-process cluster shares
+# one network.
+
+NET_ACTIONS = ("drop", "delay", "duplicate", "reorder")
+
+
+@dataclass
+class _LinkRule:
+    src: str                 # node name or "*"
+    dst: str
+    action: str              # one of NET_ACTIONS
+    arg: float = 0.0         # delay seconds / reorder max jitter seconds
+
+    def matches(self, src: str | None, dst: str | None) -> bool:
+        # None = the caller did not declare a node identity (an admin /
+        # harness connection); such traffic is nemesis-exempt
+        if src is None or dst is None:
+            return False
+        return (self.src == "*" or self.src == src) and \
+            (self.dst == "*" or self.dst == dst)
+
+
+_NET_RULES: list[_LinkRule] = []
+_NET_ARMED = False           # fast path: unarmed net_fire() is one read
+_NET_RNG = random.Random(0)  # reorder jitter; reseed via net_seed()
+
+
+def net_seed(seed: int) -> None:
+    """Seed the jitter RNG so reorder delays replay deterministically."""
+    global _NET_RNG
+    with _LOCK:
+        _NET_RNG = random.Random(seed)
+
+
+def _net_add(src: str, dst: str, action: str, arg: float = 0.0) -> None:
+    if action not in NET_ACTIONS:
+        raise ValueError(f"unknown net action {action!r} "
+                         f"(known: {', '.join(NET_ACTIONS)})")
+    global _NET_ARMED
+    with _LOCK:
+        _NET_RULES.append(_LinkRule(src, dst, action, arg))
+        _NET_ARMED = True
+
+
+def net_partition(a: str, b: str, *, bidirectional: bool = True) -> None:
+    """Partition a↔b (or only a→b with ``bidirectional=False``)."""
+    _net_add(a, b, "drop")
+    if bidirectional:
+        _net_add(b, a, "drop")
+
+
+def net_partition_node(node: str) -> None:
+    """Isolate one node from everybody (both directions)."""
+    _net_add(node, "*", "drop")
+    _net_add("*", node, "drop")
+
+
+def net_delay(a: str, b: str, seconds: float, *,
+              bidirectional: bool = True) -> None:
+    _net_add(a, b, "delay", seconds)
+    if bidirectional:
+        _net_add(b, a, "delay", seconds)
+
+
+def net_duplicate(a: str, b: str, *, bidirectional: bool = True) -> None:
+    _net_add(a, b, "duplicate")
+    if bidirectional:
+        _net_add(b, a, "duplicate")
+
+
+def net_reorder(a: str, b: str, jitter: float = 0.05, *,
+                bidirectional: bool = True) -> None:
+    """Seeded random per-message jitter: messages overtake each other."""
+    _net_add(a, b, "reorder", jitter)
+    if bidirectional:
+        _net_add(b, a, "reorder", jitter)
+
+
+def net_heal(a: str | None = None, b: str | None = None) -> None:
+    """Remove link rules. ``net_heal()`` heals everything;
+    ``net_heal(a)`` heals every link touching a; ``net_heal(a, b)``
+    heals both directions of that pair."""
+    global _NET_ARMED
+    with _LOCK:
+        if a is None:
+            _NET_RULES.clear()
+        elif b is None:
+            _NET_RULES[:] = [r for r in _NET_RULES
+                             if a not in (r.src, r.dst)]
+        else:
+            _NET_RULES[:] = [r for r in _NET_RULES
+                             if {r.src, r.dst} != {a, b}
+                             and (r.src, r.dst) not in ((a, b), (b, a))]
+        _NET_ARMED = bool(_NET_RULES)
+
+
+def net_links() -> list[tuple[str, str, str]]:
+    """Current (src, dst, action) rules — for SHOW-style introspection."""
+    with _LOCK:
+        return [(r.src, r.dst, r.action) for r in _NET_RULES]
+
+
+def _net_execute(rules: list[_LinkRule]) -> str | None:
+    """Apply matched rules: drop dominates, delays accumulate,
+    duplicate is reported back to the caller (RPC sites re-send)."""
+    result = None
+    sleep_s = 0.0
+    for rule in rules:
+        if rule.action == "drop":
+            return "drop"
+        if rule.action == "delay":
+            sleep_s += rule.arg
+        elif rule.action == "reorder":
+            with _LOCK:
+                sleep_s += _NET_RNG.random() * rule.arg
+        elif rule.action == "duplicate":
+            result = "duplicate"
+    if sleep_s > 0.0:
+        time.sleep(sleep_s)
+    return result
+
+
+def net_fire(src: str | None, dst: str | None) -> str | None:
+    """Link hook for one message direction src→dst. Returns "drop" when
+    the message is lost, "duplicate" when the caller should deliver it
+    twice, None to continue (delays/jitter already slept). ``None`` for
+    src or dst marks nemesis-exempt traffic (admin/harness connections
+    with no declared node identity) — it never matches a rule."""
+    if not _NET_ARMED:
+        return None
+    if src is None or dst is None:
+        return None
+    with _LOCK:
+        matched = [r for r in _NET_RULES if r.matches(src, dst)]
+    if not matched:
+        return None
+    return _net_execute(matched)
 
 
 def _load_env() -> None:

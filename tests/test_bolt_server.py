@@ -35,6 +35,50 @@ def server():
     loop.call_soon_threadsafe(loop.stop)
 
 
+def test_max_sessions_cap_rejects_with_bolt_failure():
+    """Beyond max_sessions the server answers a real Bolt FAILURE
+    ("server overloaded") instead of accepting unboundedly, and counts
+    the rejection."""
+    from memgraph_tpu.observability.metrics import global_metrics
+    ictx = InterpreterContext(InMemoryStorage())
+    port = _free_port()
+    srv = BoltServer(ictx, "127.0.0.1", port, max_sessions=2)
+    thread, loop = srv.run_in_thread()
+    try:
+        rejected0 = dict(
+            (n, v) for n, _t, v in global_metrics.snapshot()).get(
+            "bolt.connections_rejected_total", 0.0)
+        keep = [BoltClient(port=port) for _ in range(2)]
+        with pytest.raises(BoltClientError) as exc:
+            extra = BoltClient(port=port)
+            extra.execute("RETURN 1")
+        assert "ServerOverloaded" in exc.value.code
+        assert "overloaded" in str(exc.value)
+        rejected1 = dict(
+            (n, v) for n, _t, v in global_metrics.snapshot()).get(
+            "bolt.connections_rejected_total", 0.0)
+        assert rejected1 == rejected0 + 1
+        # live sessions still work, and freeing one readmits a newcomer
+        _, rows, _ = keep[0].execute("RETURN 40 + 2")
+        assert rows == [[42]]
+        keep.pop().close()
+        import time
+        deadline = time.time() + 5
+        admitted = None
+        while time.time() < deadline and admitted is None:
+            try:
+                admitted = BoltClient(port=port)
+            except (BoltClientError, OSError):
+                time.sleep(0.1)
+        assert admitted is not None, "slot was never released"
+        admitted.close()
+        for c in keep:
+            c.close()
+    finally:
+        srv.stop()
+        loop.call_soon_threadsafe(loop.stop)
+
+
 def test_packstream_roundtrip():
     values = [None, True, False, 0, 1, -1, 127, -128, 1 << 20, -(1 << 40),
               3.14, "", "hello", "é" * 300, b"\x00\xff",

@@ -103,7 +103,21 @@ def test_follower_rejects_propose(raft3):
     nodes, _ = raft3
     assert _wait(lambda: _leader(nodes) is not None)
     followers = [n for n in nodes if not n.is_leader()]
-    assert followers[0].propose({"op": "x"}) is False
+    result = followers[0].propose({"op": "x"})
+    assert not result                     # falsy: nothing committed
+    assert result.outcome == "not_leader"
+    assert result.retryable               # safe to retry on the leader
+
+
+def test_propose_result_is_typed(raft3):
+    """Committed proposals report a truthy, index-carrying result."""
+    nodes, _ = raft3
+    assert _wait(lambda: _leader(nodes) is not None)
+    leader = _leader(nodes)
+    result = leader.propose({"op": "x", "v": 1})
+    assert result and result.outcome == "committed"
+    assert result.index is not None and result.term is not None
+    assert not result.retryable
 
 
 class _DataInstance:

@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # Dev gate: everything tier-1 enforces, in one command.
 #
-#   tools/gate.sh          # mglint + mgsan smoke + tier-1 tests
-#   tools/gate.sh --full   # additionally: full seeded sanitize sweep
+#   tools/gate.sh          # mglint + mgsan smoke + mgchaos smoke + tier-1
+#   tools/gate.sh --full   # additionally: full sanitize + chaos sweeps
 #
 # Run from anywhere; exits non-zero on the first failing stage.
 set -u
@@ -38,7 +38,16 @@ stage "mgsan MVCC isolation check" \
 stage "mgsan MVCC checker sensitivity (broken isolation)" \
     python -m tools.mgsan workload --seed 0 --break-isolation
 
-# 4. tier-1 tests: arms the lock-order witness (MG_TRACK_LOCKS=1, from
+# 4. mgchaos smoke: one seeded nemesis round (partition/churn →
+#    failover → heal) through the cluster safety checker, plus the
+#    checker-honesty gate (the fencing-disabled split-brain script MUST
+#    be flagged; the fenced one MUST be clean)
+stage "mgchaos seeded round + safety checker" \
+    python -m tools.mgchaos run --seed 0 --rounds 1
+stage "mgchaos checker honesty (split-brain script)" \
+    python -m tools.mgchaos honesty
+
+# 5. tier-1 tests: arms the lock-order witness (MG_TRACK_LOCKS=1, from
 #    conftest) and the vector-clock race detector (MG_SAN=1) suite-wide;
 #    the session fails on any witnessed lock cycle or data race.
 #    Optional-dep suites (hypothesis, cryptography) self-skip.
@@ -47,9 +56,12 @@ stage "tier-1 tests (MG_SAN=1)" \
         -m "not slow and not crash and not sanitize"
 
 if [ "$FULL" = 1 ]; then
-    # 5. the full seeded sweep: 25 seeds per scenario + 5 workload seeds
+    # 6. the full seeded sweeps: 25 mgsan seeds per scenario + 5
+    #    workload seeds, and the 10-seed mgchaos nemesis sweep
     stage "mgsan full seeded sweep (-m sanitize)" \
         env MG_SAN=1 python -m pytest tests/test_mgsan.py -q -m sanitize
+    stage "mgchaos full nemesis sweep (-m chaos)" \
+        python -m pytest tests/test_chaos.py -q -m chaos
 fi
 
 echo

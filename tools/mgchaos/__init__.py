@@ -1,0 +1,25 @@
+"""mgchaos — Jepsen-style cluster chaos harness for memgraph_tpu.
+
+Three cooperating parts, capping PR 2's crash harness and PR 4's
+sanitizers at the CLUSTER level:
+
+* ``nemesis``  — seeded, byte-replayable fault schedules over the
+  peer-aware network model in ``memgraph_tpu/utils/faultinject.py``
+  (symmetric/asymmetric partitions, delay, duplicate, reorder, node
+  kill/restart churn).
+* ``cluster``  — an in-process HA topology (Raft coordinators + MAIN +
+  replicas on real sockets) plus the register workload whose every
+  client-visible ack carries its fencing epoch.
+* ``checker``  — offline cluster-safety verification over the recorded
+  history: zero acked-write loss, at most one acking MAIN per fencing
+  epoch, bounded post-heal election liveness.
+
+The hardening it gates: Raft pre-vote + leader lease, monotonic fencing
+epochs minted through Raft on every promotion, replica-side stale-main
+rejection, self-fencing deposed MAINs, and idempotent retry-backed
+coordinator failover with topology reconciliation.
+"""
+
+from .checker import check_cluster_history  # noqa: F401
+from .nemesis import Nemesis, NemesisOp, schedule, schedule_text  # noqa: F401
+from .runner import run_chaos  # noqa: F401

@@ -1,0 +1,126 @@
+"""Cluster safety checker: offline verification of a chaos history.
+
+The chaos harness records a Jepsen-style history (same JSONL round-trip
+as ``tools/mgsan/isocheck.HistoryLog``): every client-visible write
+attempt with its outcome and fencing epoch, every nemesis step, the
+post-heal convergence event, and a final read of the cluster state.
+
+Workload model: each client owns ONE register (key) and writes strictly
+increasing integer values to it. That makes recovery checking exact
+without any storage cooperation — for every key, the ok-acked values
+form a monotone sequence, so "no acked write lost" reduces to
+``final[key] >= max(acked values for key)``.
+
+Events::
+
+    {"e":"invoke", "op":n, "client":c, "key":k, "value":v}
+    {"e":"ok",     "op":n, "node":main, "epoch":e}
+    {"e":"fail",   "op":n, "err":...}    definitely did not happen
+    {"e":"info",   "op":n, "err":...}    indeterminate (may surface later)
+    {"e":"nemesis","round":r, "op":kind, "phase":"start"|"heal", ...}
+    {"e":"converged", "seconds":s, "node":main, "epoch":e}
+    {"e":"final",  "node":main, "epoch":e, "state":{key: value}}
+
+Checked invariants (the acceptance contract):
+
+* **No acked write lost** — after the final heal, every key's final
+  value is >= every value whose write was acked.
+* **Final value provenance** — the final value of a key was actually
+  written by an acked or indeterminate op (a ``fail``-ed write that
+  surfaces anyway means an abort was acked as an abort and happened
+  regardless).
+* **At most one acking main per epoch** — two nodes acking writes in
+  the same fencing epoch is split-brain, full stop.
+* **Election liveness** — the history contains a ``converged`` event
+  within ``heal_window`` seconds of the final heal (a new acking MAIN
+  emerged), and at least one post-heal acked write exists.
+"""
+
+from __future__ import annotations
+
+from memgraph_tpu.utils import faultinject as FI  # noqa: F401  (re-export hub)
+from tools.mgsan.isocheck import HistoryLog
+
+__all__ = ["HistoryLog", "check_cluster_history"]
+
+
+def check_cluster_history(events, heal_window: float = 30.0) -> list[str]:
+    """Verify cluster-safety invariants over a chaos history; returns
+    violation strings (empty == the run was safe)."""
+    if isinstance(events, HistoryLog):
+        events = events.snapshot()
+
+    invokes: dict[int, dict] = {}
+    outcomes: dict[int, dict] = {}
+    epoch_ackers: dict[int, set] = {}
+    converged = None
+    final = None
+    saw_nemesis = False
+    for ev in events:
+        kind = ev.get("e")
+        if kind == "invoke":
+            invokes[ev["op"]] = ev
+        elif kind in ("ok", "fail", "info"):
+            outcomes[ev["op"]] = ev
+            if kind == "ok":
+                epoch_ackers.setdefault(
+                    int(ev.get("epoch") or 0), set()).add(ev.get("node"))
+        elif kind == "nemesis":
+            saw_nemesis = True
+        elif kind == "converged":
+            converged = ev
+        elif kind == "final":
+            final = ev
+
+    violations: list[str] = []
+
+    # ---- split-brain: one acking main per epoch -------------------------
+    for epoch, nodes in sorted(epoch_ackers.items()):
+        if len(nodes) > 1:
+            violations.append(
+                f"split-brain: epoch {epoch} has {len(nodes)} acking "
+                f"mains ({', '.join(sorted(map(str, nodes)))})")
+
+    # ---- acked-write durability ----------------------------------------
+    if final is None:
+        violations.append("history has no final read: cannot verify "
+                          "acked-write durability")
+        return violations
+    state = final.get("state", {})
+    acked_max: dict[str, int] = {}
+    written: dict[str, set] = {}
+    for op, inv in invokes.items():
+        key, value = inv["key"], inv["value"]
+        out = outcomes.get(op)
+        outcome = out["e"] if out else "info"   # no outcome = in flight
+        if outcome != "fail":
+            written.setdefault(key, set()).add(value)
+        if outcome == "ok":
+            acked_max[key] = max(acked_max.get(key, -1), value)
+    for key, highest in sorted(acked_max.items()):
+        fin = state.get(key)
+        if fin is None or int(fin) < highest:
+            violations.append(
+                f"lost acked write: key {key} acked value {highest} but "
+                f"final state has {fin!r}")
+    for key, fin in sorted(state.items()):
+        if fin is None:
+            continue
+        ok_vals = written.get(key, set())
+        if int(fin) != 0 and int(fin) not in ok_vals:
+            violations.append(
+                f"phantom final value: key {key} ended at {fin!r}, which "
+                f"no acked/indeterminate write produced")
+
+    # ---- election liveness ---------------------------------------------
+    if saw_nemesis:
+        if converged is None:
+            violations.append(
+                "liveness: no convergence event — the cluster never "
+                "produced a new acking MAIN after the final heal")
+        elif float(converged.get("seconds", 0.0)) > heal_window:
+            violations.append(
+                f"liveness: convergence took "
+                f"{converged['seconds']:.1f}s > heal window "
+                f"{heal_window:.1f}s")
+    return violations

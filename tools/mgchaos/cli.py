@@ -1,0 +1,133 @@
+"""mgchaos command line: `python -m tools.mgchaos <cmd>`.
+
+    run       one seeded chaos campaign (cluster + nemesis + checker)
+    sweep     N seeded campaigns; any violation fails the sweep
+    schedule  print a seed's nemesis schedule (byte-replayable)
+    check     offline-check a previously dumped history JSONL
+
+Exit codes: 0 safe, 1 violations found, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mgchaos",
+        description="memgraph_tpu Jepsen-style cluster chaos harness")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    rn = sub.add_parser("run", help="one seeded chaos campaign")
+    rn.add_argument("--seed", type=int, default=0)
+    rn.add_argument("--rounds", type=int, default=4)
+    rn.add_argument("--clients", type=int, default=3)
+    rn.add_argument("--no-fencing", action="store_true",
+                    help="deliberately unsafe SYNC cluster without "
+                         "fencing (the checker MUST flag it)")
+    rn.add_argument("--expect-unsafe", action="store_true",
+                    help="invert the exit code: succeed only when the "
+                         "checker FOUND violations (honesty check)")
+    rn.add_argument("--dump", metavar="PATH",
+                    help="write the history JSONL to PATH")
+
+    sw = sub.add_parser("sweep", help="N seeded campaigns")
+    sw.add_argument("--seeds", type=int, default=10)
+    sw.add_argument("--seed-base", type=int, default=0)
+    sw.add_argument("--rounds", type=int, default=4)
+
+    sub.add_parser(
+        "honesty",
+        help="checker-honesty gate: the scripted split-brain scenario "
+             "must be FLAGGED without fencing and CLEAN with it")
+
+    sc = sub.add_parser("schedule", help="print a seed's nemesis schedule")
+    sc.add_argument("--seed", type=int, default=0)
+    sc.add_argument("--rounds", type=int, default=4)
+    sc.add_argument("--coords", type=int, default=3)
+    sc.add_argument("--data", type=int, default=3)
+
+    ck = sub.add_parser("check", help="offline-check a history JSONL")
+    ck.add_argument("history", help="path to a chaos history .jsonl")
+    return p
+
+
+def _report(seed: int, violations: list[str], stats: dict) -> None:
+    verdict = "SAFE" if not violations else "UNSAFE"
+    print(f"seed {seed}: {verdict} — {stats['acked']} acked / "
+          f"{stats['ops']} ops, main={stats['main']} "
+          f"epoch={stats['epoch']} converged={stats['converged']}")
+    for v in violations:
+        print(f"  VIOLATION: {v}")
+
+
+def _cmd_run(args) -> int:
+    from .runner import run_chaos
+    history, violations, stats = run_chaos(
+        args.seed, rounds=args.rounds, n_clients=args.clients,
+        fencing=not args.no_fencing)
+    _report(args.seed, violations, stats)
+    if args.dump:
+        history.dump(args.dump)
+        print(f"history written to {args.dump}")
+    if args.expect_unsafe:
+        if violations:
+            print("checker-honesty: violations found, as expected")
+            return 0
+        print("checker-honesty FAILED: the unsafe run was NOT flagged",
+              file=sys.stderr)
+        return 1
+    return 1 if violations else 0
+
+
+def _cmd_sweep(args) -> int:
+    from .runner import run_chaos
+    bad = 0
+    for i in range(args.seeds):
+        seed = args.seed_base + i
+        _, violations, stats = run_chaos(seed, rounds=args.rounds)
+        _report(seed, violations, stats)
+        bad += bool(violations)
+    print(f"sweep: {args.seeds - bad}/{args.seeds} seeds safe")
+    return 1 if bad else 0
+
+
+def _cmd_honesty(_args) -> int:
+    from .runner import run_split_brain_scenario
+    _, unsafe_violations, _ = run_split_brain_scenario(fencing=False)
+    _, safe_violations, _ = run_split_brain_scenario(fencing=True)
+    ok = bool(unsafe_violations) and not safe_violations
+    print(f"checker-honesty: fencing-off flagged={bool(unsafe_violations)}"
+          f" ({len(unsafe_violations)} violation(s)), "
+          f"fencing-on clean={not safe_violations}")
+    for v in unsafe_violations:
+        print(f"  [expected] {v}")
+    for v in safe_violations:
+        print(f"  [UNEXPECTED] {v}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _cmd_schedule(args) -> int:
+    from .nemesis import schedule_text
+    coords = [f"c{i + 1}" for i in range(args.coords)]
+    data = [f"i{i + 1}" for i in range(args.data)]
+    sys.stdout.write(schedule_text(args.seed, sorted(coords) + sorted(data),
+                                   sorted(data), rounds=args.rounds))
+    return 0
+
+
+def _cmd_check(args) -> int:
+    from .checker import HistoryLog, check_cluster_history
+    violations = check_cluster_history(HistoryLog.load(args.history))
+    for v in violations:
+        print(f"VIOLATION: {v}")
+    print(f"{len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return {"run": _cmd_run, "sweep": _cmd_sweep, "honesty": _cmd_honesty,
+            "schedule": _cmd_schedule, "check": _cmd_check}[args.cmd](args)

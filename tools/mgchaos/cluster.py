@@ -1,0 +1,367 @@
+"""In-process chaos cluster: coordinators + data instances + workload.
+
+One ``ChaosCluster`` wires up a real HA topology — N Raft coordinators,
+one MAIN and M replicas with real mgmt/replication sockets on
+localhost — inside the current process, so the nemesis can partition
+links through the faultinject network model AND hard-kill nodes by
+tearing their servers down (the in-process analog of the PR-2
+subprocess kill: sockets die mid-conversation, state the node did not
+replicate is lost to its peers until heal).
+
+Storage is treated as each node's durable disk (it survives a
+kill/restart); WAL-level crash consistency has its own subprocess
+matrix in tests/test_durability.py — this harness is about CLUSTER
+safety: fencing, failover, replication holes.
+
+``ChaosClient`` implements the Jepsen workload: each client owns one
+register key and writes strictly increasing values through the current
+MAIN (per the leader coordinator's replicated state), recording every
+invoke/ok/fail/info with the fencing epoch into the shared history.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+
+from memgraph_tpu.coordination.coordinator import CoordinatorInstance
+from memgraph_tpu.coordination.data_instance import (
+    DataInstanceManagementServer)
+from memgraph_tpu.exceptions import (FencedException, MemgraphTpuError,
+                                     ReplicaUnavailableException)
+from memgraph_tpu.query.interpreter import InterpreterContext
+from memgraph_tpu.replication.main_role import ReplicationState
+from memgraph_tpu.storage import InMemoryStorage
+from memgraph_tpu.storage.storage import VertexAccessor
+from memgraph_tpu.utils import faultinject as FI
+from tools.mgsan.isocheck import HistoryLog
+
+log = logging.getLogger(__name__)
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_for(pred, timeout: float = 15.0, interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class ChaosDataNode:
+    """One data instance: storage (the node's 'disk'), an interpreter
+    context, a mgmt server, and a replication state named for the
+    nemesis link model."""
+
+    def __init__(self, name: str, mgmt_port: int, repl_port: int):
+        self.name = name
+        self.mgmt_port = mgmt_port
+        self.repl_port = repl_port
+        self.storage = InMemoryStorage()
+        self.alive = False
+        self.ictx: InterpreterContext | None = None
+        self.mgmt: DataInstanceManagementServer | None = None
+        # simulated durable replication state for restart (the real
+        # server persists role/epoch in its kvstore)
+        self._saved_role = "main"
+        self._saved_epoch = 0
+        self.start()
+
+    @property
+    def mgmt_address(self) -> str:
+        return f"127.0.0.1:{self.mgmt_port}"
+
+    @property
+    def repl_address(self) -> str:
+        return f"127.0.0.1:{self.repl_port}"
+
+    @property
+    def replication(self) -> ReplicationState | None:
+        return getattr(self.ictx, "replication", None) if self.ictx \
+            else None
+
+    def start(self) -> None:
+        self.ictx = InterpreterContext(self.storage)
+        self.ictx.replication = ReplicationState(
+            self.storage, ictx=self.ictx, node_name=self.name)
+        self.mgmt = DataInstanceManagementServer(
+            self.ictx, "127.0.0.1", self.mgmt_port, node_name=self.name)
+        self.mgmt.start()
+        if self._saved_role == "replica":
+            self.ictx.replication.set_role_replica(
+                "0.0.0.0", self.repl_port, epoch=self._saved_epoch)
+        else:
+            self.ictx.replication.fencing_epoch = self._saved_epoch
+        self.alive = True
+
+    def kill(self) -> None:
+        """Hard kill: every socket dies mid-conversation; unreplicated
+        in-memory session state (pending 2PC, connections) is lost."""
+        if not self.alive:
+            return
+        self.alive = False
+        repl = self.replication
+        if repl is not None:
+            self._saved_role = repl.role
+            self._saved_epoch = repl.current_epoch()
+            repl.shutdown()
+        if self.mgmt is not None:
+            self.mgmt.stop()
+        if self.ictx is not None:
+            self.ictx.replication = None
+
+    def restart(self) -> None:
+        if self.alive:
+            return
+        self.start()
+
+
+class ChaosCluster:
+    """The full topology plus the shared history log."""
+
+    HEALTH_INTERVAL = 0.2
+
+    def __init__(self, seed: int = 0, n_coords: int = 3, n_data: int = 3,
+                 fencing: bool = True):
+        FI.net_seed(seed)
+        self.seed = seed
+        self.fencing = fencing
+        self.history = HistoryLog()
+        coord_ids = [f"c{i + 1}" for i in range(n_coords)]
+        data_ids = [f"i{i + 1}" for i in range(n_data)]
+        self.coord_ids, self.data_ids = coord_ids, data_ids
+        raft_ports = free_ports(n_coords)
+        data_ports = free_ports(2 * n_data)
+        self.coordinators: dict[str, CoordinatorInstance] = {}
+        for i, cid in enumerate(coord_ids):
+            peers = {coord_ids[j]: ("127.0.0.1", raft_ports[j])
+                     for j in range(n_coords) if j != i}
+            coord = CoordinatorInstance(
+                cid, "127.0.0.1", raft_ports[i], peers,
+                # STRICT_SYNC + no degradation is the split-brain-proof
+                # profile; fencing=False is the checker-honesty mode (a
+                # deliberately unsafe SYNC cluster the checker must flag)
+                repl_mode="STRICT_SYNC" if fencing else "SYNC",
+                election_seed=seed * 1000 + i)
+            coord.HEALTH_CHECK_INTERVAL = self.HEALTH_INTERVAL
+            self.coordinators[cid] = coord
+        self.data: dict[str, ChaosDataNode] = {}
+        for i, did in enumerate(data_ids):
+            self.data[did] = ChaosDataNode(
+                did, data_ports[2 * i], data_ports[2 * i + 1])
+
+    # --- topology bring-up --------------------------------------------------
+
+    def start(self, main: str | None = None) -> None:
+        for coord in self.coordinators.values():
+            coord.start()
+        if not wait_for(lambda: self.leader() is not None, timeout=20):
+            raise RuntimeError("no raft leader elected at bring-up")
+        leader = self.leader()
+        for did, node in self.data.items():
+            if not leader.register_instance(did, node.mgmt_address,
+                                            node.repl_address):
+                raise RuntimeError(f"register_instance({did}) failed")
+        main = main or self.data_ids[0]
+        if not leader.set_instance_to_main(main):
+            raise RuntimeError(f"set_instance_to_main({main}) failed")
+        ok = wait_for(lambda: self._main_ready(main), timeout=20)
+        if not ok:
+            raise RuntimeError("initial topology never became ready")
+
+    def _main_ready(self, main: str) -> bool:
+        repl = self.data[main].replication
+        if repl is None or repl.role != "main":
+            return False
+        others = [d for d in self.data_ids if d != main]
+        from memgraph_tpu.replication.main_role import ReplicaStatus
+        with repl._lock:
+            clients = dict(repl.replicas)
+        return sorted(clients) == sorted(others) and all(
+            c.status is ReplicaStatus.READY for c in clients.values())
+
+    # --- cluster views ------------------------------------------------------
+
+    def leader(self) -> CoordinatorInstance | None:
+        for coord in self.coordinators.values():
+            if coord.raft.is_leader():
+                return coord
+        return None
+
+    def cluster_view(self) -> tuple[str | None, int]:
+        """(main name, fencing epoch) per the current raft leader, or
+        the freshest epoch any coordinator knows when leaderless."""
+        leader = self.leader()
+        if leader is not None:
+            with leader._lock:
+                return leader.main_name, leader.epoch
+        best = (None, 0)
+        for coord in self.coordinators.values():
+            with coord._lock:
+                if coord.epoch >= best[1]:
+                    best = (coord.main_name, coord.epoch)
+        return best
+
+    # --- nemesis node ops ---------------------------------------------------
+
+    def kill(self, name: str) -> None:
+        node = self.data.get(name)
+        if node is not None:
+            log.warning("chaos: killing %s", name)
+            node.kill()
+
+    def restart(self, name: str) -> None:
+        node = self.data.get(name)
+        if node is not None:
+            log.warning("chaos: restarting %s", name)
+            node.restart()
+
+    def heal_all(self) -> None:
+        FI.net_heal()
+        for node in self.data.values():
+            if not node.alive:
+                node.restart()
+
+    def stop(self) -> None:
+        FI.net_heal()
+        for coord in self.coordinators.values():
+            coord.stop()
+        for node in self.data.values():
+            node.kill()
+
+    # --- workload -----------------------------------------------------------
+
+    def setup_registers(self, n_clients: int) -> dict[str, int]:
+        """Create one register vertex per client ON THE MAIN (value 0);
+        replication ships them everywhere. Returns {key: gid}."""
+        main, _ = self.cluster_view()
+        node = self.data[main]
+        st = node.storage
+        prop = st.property_mapper.name_to_id("v")
+        gids = {}
+        for c in range(n_clients):
+            acc = st.access()
+            v = acc.create_vertex()
+            v.set_property(prop, 0)
+            acc.commit()
+            gids[f"k{c}"] = v.vertex.gid
+        return gids
+
+    def write(self, node_name: str, gid: int, value: int) -> None:
+        """One register write through the full commit path (2PC votes,
+        fencing, replication) of the named node."""
+        node = self.data[node_name]
+        if not node.alive or node.replication is None:
+            raise MemgraphTpuError(f"node {node_name} is down")
+        if node.replication.role != "main":
+            # a real server refuses writes on replicas at the
+            # interpreter layer; the harness mirrors that check
+            raise ReplicaUnavailableException(
+                f"{node_name} is not MAIN")
+        st = node.storage
+        prop = st.property_mapper.name_to_id("v")
+        acc = st.access()
+        va = VertexAccessor(st._vertices[gid], acc)
+        va.set_property(prop, value)
+        acc.commit()
+
+    def read_final_state(self, node_name: str,
+                         gids: dict[str, int]) -> dict[str, int]:
+        node = self.data[node_name]
+        st = node.storage
+        prop = st.property_mapper.name_to_id("v")
+        out = {}
+        for key, gid in gids.items():
+            acc = st.access()
+            try:
+                va = VertexAccessor(st._vertices[gid], acc)
+                out[key] = va.get_property(prop)
+            finally:
+                acc.abort()
+        return out
+
+
+class ChaosClient(threading.Thread):
+    """One Jepsen client: writes increasing values to its own register
+    via whatever node the coordinators currently call MAIN."""
+
+    def __init__(self, cluster: ChaosCluster, idx: int, key: str,
+                 gid: int, op_counter, interval: float = 0.05):
+        super().__init__(daemon=True, name=f"chaos-client-{idx}")
+        self.cluster = cluster
+        self.idx = idx
+        self.key = key
+        self.gid = gid
+        self.interval = interval
+        self.next_value = 1
+        self.known_epoch = 0
+        self._ops = op_counter       # shared itertools.count
+        # NB: not "_stop" — threading.Thread owns that attribute
+        self._halt = threading.Event()
+        self.acked = 0
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def one_op(self) -> bool:
+        """Attempt one write; returns True when it was validly acked."""
+        hist = self.cluster.history
+        main, epoch = self.cluster.cluster_view()
+        self.known_epoch = max(self.known_epoch, epoch)
+        if main is None:
+            return False
+        op = next(self._ops)
+        value = self.next_value
+        hist.record({"e": "invoke", "op": op, "client": self.idx,
+                     "key": self.key, "value": value})
+        try:
+            self.cluster.write(main, self.gid, value)
+        except (FencedException, ReplicaUnavailableException) as e:
+            # refused BEFORE any replica prepared: definitely did not
+            # happen anywhere — a clean, safe failure
+            hist.record({"e": "fail", "op": op, "err": type(e).__name__})
+            self.next_value += 1
+            return False
+        except Exception as e:  # noqa: BLE001 — anything else is ambiguous
+            hist.record({"e": "info", "op": op, "err": type(e).__name__})
+            self.next_value += 1
+            return False
+        repl = self.cluster.data[main].replication
+        ack_epoch, fenced = repl.fencing_info() if repl is not None \
+            else (0, True)
+        if self.cluster.fencing and \
+                (fenced or ack_epoch < self.known_epoch):
+            # the commit reported success but the acking node's epoch is
+            # already stale — a fencing-aware client refuses the ack
+            hist.record({"e": "info", "op": op, "err": "stale-epoch-ack"})
+            self.next_value += 1
+            return False
+        self.known_epoch = max(self.known_epoch, ack_epoch)
+        hist.record({"e": "ok", "op": op, "node": main,
+                     "epoch": ack_epoch})
+        self.next_value += 1
+        self.acked += 1
+        return True
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self.one_op()
+            except Exception:  # noqa: BLE001 — a client crash must not
+                # kill the workload thread silently mid-campaign
+                log.exception("chaos client %d op crashed", self.idx)
+            self._halt.wait(self.interval)

@@ -1,0 +1,150 @@
+"""Seeded nemesis: a byte-replayable schedule of cluster faults.
+
+A nemesis schedule is a PURE function of ``(seed, node lists, rounds)``
+— the same arming contract as ``faultinject.seeded_schedule`` and the
+mgsan scheduler: a failure found by a randomized campaign replays
+exactly by re-running with its seed. ``schedule_text`` renders the
+whole schedule as one canonical string, so determinism is testable as
+byte identity.
+
+Each round picks one op from ``faultinject.NEMESIS_OPS``:
+
+    partition          symmetric partition of a chosen peer pair
+    partition_oneway   asymmetric link: src→dst lost, dst→src intact
+    partition_node     isolate one node from everybody (a "pause")
+    delay              fixed latency on a link
+    duplicate          every message on the link delivered twice
+    reorder            seeded jitter on the link (messages overtake)
+    kill_restart       hard-kill a DATA node, restart it after the dwell
+
+then dwells, heals (or restarts), and lets the cluster recover before
+the next round. The ``Nemesis`` executor applies ops against a live
+``ChaosCluster`` through the faultinject network model.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from memgraph_tpu.utils import faultinject as FI
+
+
+@dataclass(frozen=True)
+class NemesisOp:
+    round: int
+    kind: str                  # one of faultinject.NEMESIS_OPS
+    targets: tuple[str, ...]   # the node(s)/link the op hits
+    arg: float                 # delay/jitter seconds (0 when unused)
+    dwell: float               # seconds the fault stays active
+    recover: float             # seconds of calm after heal/restart
+
+    def render(self) -> str:
+        return (f"r{self.round:02d} {self.kind}"
+                f"({','.join(self.targets)})"
+                f" arg={self.arg:.3f} dwell={self.dwell:.2f}"
+                f" recover={self.recover:.2f}")
+
+
+def schedule(seed: int, nodes: list[str], data_nodes: list[str],
+             rounds: int = 6, dwell: tuple[float, float] = (1.5, 3.0),
+             recover: tuple[float, float] = (1.5, 2.5),
+             ops: tuple[str, ...] = FI.NEMESIS_OPS) -> list[NemesisOp]:
+    """Derive a deterministic fault schedule from ``seed``.
+
+    ``nodes`` is every partitionable node (coordinators + data);
+    ``data_nodes`` the subset eligible for kill/restart churn. Node
+    lists are consumed in the given order, so pass them in a canonical
+    (sorted) order for cross-process replay."""
+    for op in ops:
+        if op not in FI.NEMESIS_OPS:
+            raise ValueError(f"unknown nemesis op {op!r}")
+    rng = random.Random(seed)
+    out: list[NemesisOp] = []
+    for rnd in range(rounds):
+        kind = ops[rng.randrange(len(ops))]
+        arg = 0.0
+        if kind == "kill_restart":
+            targets = (data_nodes[rng.randrange(len(data_nodes))],)
+        elif kind == "partition_node":
+            targets = (nodes[rng.randrange(len(nodes))],)
+        else:
+            i = rng.randrange(len(nodes))
+            j = rng.randrange(len(nodes) - 1)
+            if j >= i:
+                j += 1
+            targets = (nodes[i], nodes[j])
+            if kind == "delay":
+                arg = round(0.05 + rng.random() * 0.2, 3)
+            elif kind == "reorder":
+                arg = round(0.02 + rng.random() * 0.1, 3)
+        out.append(NemesisOp(
+            round=rnd, kind=kind, targets=targets, arg=arg,
+            dwell=round(rng.uniform(*dwell), 2),
+            recover=round(rng.uniform(*recover), 2)))
+    return out
+
+
+def schedule_text(seed: int, nodes: list[str], data_nodes: list[str],
+                  rounds: int = 6, **kw) -> str:
+    """Canonical one-op-per-line rendering; same seed ⇒ identical bytes."""
+    lines = [f"nemesis seed={seed} nodes={','.join(nodes)} "
+             f"data={','.join(data_nodes)} rounds={rounds}"]
+    lines += [op.render()
+              for op in schedule(seed, nodes, data_nodes, rounds, **kw)]
+    return "\n".join(lines) + "\n"
+
+
+class Nemesis:
+    """Applies a schedule against a live ChaosCluster, recording every
+    step into the cluster history so the checker can correlate faults
+    with anomalies."""
+
+    def __init__(self, cluster, history=None):
+        self.cluster = cluster
+        self.history = history
+
+    def _record(self, op: NemesisOp, phase: str) -> None:
+        if self.history is not None:
+            self.history.record({"e": "nemesis", "round": op.round,
+                                 "op": op.kind, "phase": phase,
+                                 "targets": list(op.targets)})
+
+    def apply(self, op: NemesisOp) -> None:
+        self._record(op, "start")
+        if op.kind == "partition":
+            FI.net_partition(op.targets[0], op.targets[1])
+        elif op.kind == "partition_oneway":
+            FI.net_partition(op.targets[0], op.targets[1],
+                             bidirectional=False)
+        elif op.kind == "partition_node":
+            FI.net_partition_node(op.targets[0])
+        elif op.kind == "delay":
+            FI.net_delay(op.targets[0], op.targets[1], op.arg)
+        elif op.kind == "duplicate":
+            FI.net_duplicate(op.targets[0], op.targets[1])
+        elif op.kind == "reorder":
+            FI.net_reorder(op.targets[0], op.targets[1], op.arg)
+        elif op.kind == "kill_restart":
+            self.cluster.kill(op.targets[0])
+        else:  # pragma: no cover - schedule() validates op kinds
+            raise ValueError(f"unknown nemesis op {op.kind!r}")
+
+    def heal(self, op: NemesisOp) -> None:
+        if op.kind == "kill_restart":
+            self.cluster.restart(op.targets[0])
+        elif op.kind == "partition_node":
+            FI.net_heal(op.targets[0])
+        else:
+            FI.net_heal(op.targets[0], op.targets[1])
+        self._record(op, "heal")
+
+    def run(self, sched: list[NemesisOp], sleep=None) -> None:
+        """Execute a whole schedule: apply → dwell → heal → recover."""
+        import time
+        sleep = sleep or time.sleep
+        for op in sched:
+            self.apply(op)
+            sleep(op.dwell)
+            self.heal(op)
+            sleep(op.recover)

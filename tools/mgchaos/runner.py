@@ -1,0 +1,205 @@
+"""One chaos campaign: bring-up → workload → nemesis → heal → verify.
+
+``run_chaos(seed)`` is the unit both the CLI and the test sweep drive:
+it builds a fresh in-process cluster, starts the register workload,
+replays the seed's nemesis schedule, heals, waits for the cluster to
+produce a new acking MAIN (the liveness bound), snapshots the final
+state, and runs the offline safety checker. The nemesis schedule —
+and therefore the shape of the whole campaign — is a pure function of
+the seed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+
+from memgraph_tpu.utils import faultinject as FI
+from .checker import check_cluster_history
+from .cluster import ChaosClient, ChaosCluster, wait_for
+from .nemesis import Nemesis, schedule
+
+log = logging.getLogger(__name__)
+
+HEAL_WINDOW_S = 30.0     # bounded liveness: new acking MAIN within this
+
+
+def run_chaos(seed: int, rounds: int = 4, n_clients: int = 3,
+              n_coords: int = 3, n_data: int = 3, fencing: bool = True,
+              dwell: tuple[float, float] = (1.2, 2.2),
+              recover: tuple[float, float] = (1.2, 2.0),
+              ops: tuple[str, ...] = FI.NEMESIS_OPS,
+              heal_window: float = HEAL_WINDOW_S):
+    """Run one seeded campaign. Returns (history, violations, stats)."""
+    FI.reset()
+    cluster = ChaosCluster(seed=seed, n_coords=n_coords, n_data=n_data,
+                           fencing=fencing)
+    try:
+        cluster.start()
+        gids = cluster.setup_registers(n_clients)
+        ops_counter = itertools.count()
+        clients = [ChaosClient(cluster, i, f"k{i}", gids[f"k{i}"],
+                               ops_counter)
+                   for i in range(n_clients)]
+        for c in clients:
+            c.start()
+        nodes = sorted(cluster.coord_ids) + sorted(cluster.data_ids)
+        sched = schedule(seed, nodes, sorted(cluster.data_ids),
+                         rounds=rounds, dwell=dwell, recover=recover,
+                         ops=ops)
+        Nemesis(cluster, cluster.history).run(sched)
+
+        # final heal, then the bounded-liveness probe: some client must
+        # get a validly-acked write through the (possibly new) MAIN
+        cluster.heal_all()
+        heal_t0 = time.monotonic()
+        probe = clients[0]
+        converged = wait_for(lambda: probe.one_op(),
+                             timeout=heal_window, interval=0.2)
+        if converged:
+            elapsed = time.monotonic() - heal_t0
+            main, epoch = cluster.cluster_view()
+            cluster.history.record({"e": "converged",
+                                    "seconds": round(elapsed, 2),
+                                    "node": main, "epoch": epoch})
+        for c in clients:
+            c.stop()
+        for c in clients:
+            c.join(timeout=10)
+        # quiesce: let in-flight finalizes/reconciliation drain before
+        # the final read (acked writes are already ON the main — this
+        # only avoids racing a reconcile-triggered catch-up)
+        time.sleep(0.5)
+        main, epoch = cluster.cluster_view()
+        final_state = cluster.read_final_state(main, gids) \
+            if main is not None else {}
+        cluster.history.record({"e": "final", "node": main,
+                                "epoch": epoch, "state": final_state})
+        violations = check_cluster_history(cluster.history,
+                                           heal_window=heal_window)
+        stats = {
+            "seed": seed,
+            "rounds": rounds,
+            "acked": sum(c.acked for c in clients),
+            "ops": next(ops_counter),
+            "converged": converged,
+            "main": main,
+            "epoch": epoch,
+            "violations": len(violations),
+        }
+        return cluster.history, violations, stats
+    finally:
+        cluster.stop()
+        FI.reset()
+
+
+def run_split_brain_scenario(fencing: bool = True, n_coords: int = 3,
+                             heal_window: float = HEAL_WINDOW_S):
+    """The canonical split-brain script, deterministic by construction:
+
+    1. isolate the MAIN from everybody (coordinator AND replicas);
+    2. a client with a stale route table keeps writing at the old MAIN;
+    3. the coordinator promotes a replica (new fencing epoch);
+    4. heal — the deposed MAIN is demoted and resynced from its
+       successor, wiping whatever it acked while isolated.
+
+    With ``fencing=False`` (SYNC replication, epochs ignored) step 2
+    ACKS writes that step 4 destroys — the checker MUST flag the run
+    (the checker-honesty contract). With ``fencing=True`` the same
+    script is safe: STRICT_SYNC refuses the isolated writes outright
+    and the epoch-aware client rejects any ack that slips through.
+
+    Returns (history, violations, stats).
+    """
+    FI.reset()
+    cluster = ChaosCluster(seed=0, n_coords=n_coords, n_data=3,
+                           fencing=fencing)
+    hist = cluster.history
+    try:
+        cluster.start()
+        gids = cluster.setup_registers(1)
+        gid = gids["k0"]
+        old_main, epoch0 = cluster.cluster_view()
+        hist.record({"e": "nemesis", "round": 0, "op": "partition_node",
+                     "phase": "start", "targets": [old_main]})
+        FI.net_partition_node(old_main)
+
+        # stale-route-table client: keeps writing AT the old main
+        value, acked_on_old = 0, 0
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            _, epoch_now = cluster.cluster_view()
+            if epoch_now > epoch0 and (acked_on_old or cluster.fencing):
+                # failover happened; unsafe mode also waits until at
+                # least one stale write was acked (the loss to detect)
+                break
+            value += 1
+            op = value
+            hist.record({"e": "invoke", "op": op, "client": 0,
+                         "key": "k0", "value": value})
+            try:
+                cluster.write(old_main, gid, value)
+            except Exception as e:  # noqa: BLE001 — outcome classified below
+                from memgraph_tpu.exceptions import (
+                    FencedException, ReplicaUnavailableException)
+                kind = "fail" if isinstance(
+                    e, (FencedException, ReplicaUnavailableException)) \
+                    else "info"
+                hist.record({"e": kind, "op": op,
+                             "err": type(e).__name__})
+                time.sleep(0.2)
+                continue
+            repl = cluster.data[old_main].replication
+            ack_epoch, fenced = repl.fencing_info() if repl \
+                else (0, True)
+            if cluster.fencing and (fenced or ack_epoch < epoch0):
+                hist.record({"e": "info", "op": op,
+                             "err": "stale-epoch-ack"})
+            else:
+                hist.record({"e": "ok", "op": op, "node": old_main,
+                             "epoch": ack_epoch})
+                acked_on_old += 1
+            time.sleep(0.2)
+
+        # heal; the coordinator demotes + resyncs the deposed main
+        cluster.heal_all()
+        hist.record({"e": "nemesis", "round": 0, "op": "partition_node",
+                     "phase": "heal", "targets": [old_main]})
+        heal_t0 = time.monotonic()
+        new_main_holder = {}
+
+        def _converged():
+            main, epoch = cluster.cluster_view()
+            if main is None:
+                return False
+            repl = cluster.data[old_main].replication
+            if repl is None or repl.role != "replica":
+                return False   # the deposed main must be demoted
+            new_main_holder["main"], new_main_holder["epoch"] = \
+                main, epoch
+            return True
+
+        converged = wait_for(_converged, timeout=heal_window,
+                             interval=0.2)
+        if converged:
+            hist.record({"e": "converged",
+                         "seconds":
+                             round(time.monotonic() - heal_t0, 2),
+                         "node": new_main_holder["main"],
+                         "epoch": new_main_holder["epoch"]})
+        time.sleep(0.5)
+        main, epoch = cluster.cluster_view()
+        final_state = cluster.read_final_state(main, gids) \
+            if main is not None else {}
+        hist.record({"e": "final", "node": main, "epoch": epoch,
+                     "state": final_state})
+        violations = check_cluster_history(hist, heal_window=heal_window)
+        stats = {"seed": "scripted-split-brain", "rounds": 1,
+                 "acked": acked_on_old, "ops": value,
+                 "converged": converged, "main": main, "epoch": epoch,
+                 "violations": len(violations)}
+        return hist, violations, stats
+    finally:
+        cluster.stop()
+        FI.reset()

@@ -23,6 +23,16 @@ must name a point registered in utils/faultinject.py KNOWN_POINTS (a
 typo'd point silently never fires), and every registered point must
 have at least one live fire site (a dead registration means a fault
 campaign "covers" a path that no longer exists).
+
+Nemesis ops: the ``NEMESIS_OPS`` registry (the contract the mgchaos
+schedule generator draws from) must stay wired both ways — every
+network-level op needs a live ``net_<op>`` installer in faultinject.py,
+and every installer (a ``net_*`` function that adds link rules) must be
+reachable from a registered op, or chaos campaigns "cover" ops that can
+no longer fire (the same dead-registration hazard as fault points; the
+per-op *test* coverage half of this contract lives in
+tests/test_chaos.py, which asserts the seeded sweep exercises every
+registered op).
 """
 
 from __future__ import annotations
@@ -71,6 +81,7 @@ def check(project: Project):
     findings = []
     findings.extend(_check_wal_opcodes(project))
     findings.extend(_check_fault_points(project))
+    findings.extend(_check_nemesis_ops(project))
     return findings
 
 
@@ -127,6 +138,75 @@ def _check_wal_opcodes(project: Project):
                 message=f"WAL opcode {op_name} is missing handlers: "
                         + "; ".join(missing),
                 fingerprint=f"wal-op:{op_name}"))
+    return findings
+
+
+#: ops the cluster harness (not the network model) implements; they have
+#: no net_* installer by design
+_CLUSTER_LEVEL_OPS = {"kill_restart"}
+
+
+def _nemesis_op_installer(op: str) -> str:
+    """Registered op name -> the net_* installer expected to back it
+    ("partition_oneway" rides net_partition's bidirectional flag)."""
+    if op == "partition_oneway":
+        return "net_partition"
+    return f"net_{op}"
+
+
+def _check_nemesis_ops(project: Project):
+    fi_mod = project.by_suffix("utils/faultinject.py")
+    if fi_mod is None:
+        return []
+    ops: dict[str, int] = {}
+    for stmt in fi_mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == "NEMESIS_OPS" \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            for el in stmt.value.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    ops[el.value] = stmt.lineno
+    if not ops:
+        return []
+
+    # net_* installers = module-level functions whose body calls _net_add
+    installers: dict[str, int] = {}
+    for stmt in fi_mod.tree.body:
+        if not isinstance(stmt, ast.FunctionDef) or \
+                not stmt.name.startswith("net_"):
+            continue
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "_net_add":
+                installers[stmt.name] = stmt.lineno
+                break
+
+    findings = []
+    for op, line in sorted(ops.items()):
+        if op in _CLUSTER_LEVEL_OPS:
+            continue
+        wanted = _nemesis_op_installer(op)
+        if wanted not in installers:
+            findings.append(Finding(
+                rule="MG005", path=fi_mod.rel_path, line=line, col=0,
+                symbol="NEMESIS_OPS",
+                message=f"nemesis op {op!r} has no {wanted}() installer "
+                        "— scheduling it would be a silent no-op",
+                fingerprint=f"nemesis-dead:{op}"))
+    expected = {_nemesis_op_installer(op) for op in ops
+                if op not in _CLUSTER_LEVEL_OPS}
+    for name, line in sorted(installers.items()):
+        if name not in expected:
+            findings.append(Finding(
+                rule="MG005", path=fi_mod.rel_path, line=line, col=0,
+                symbol=name,
+                message=f"link-rule installer {name}() backs no entry "
+                        "of NEMESIS_OPS — chaos campaigns can never "
+                        "schedule it",
+                fingerprint=f"nemesis-unregistered:{name}"))
     return findings
 
 
