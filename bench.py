@@ -40,10 +40,14 @@ STAGE_TIMEOUT_SEC = 300
 MASTER_TIMEOUT_SEC = int(os.environ.get("BENCH_MASTER_TIMEOUT", 530))
 
 # best-so-far partial result; the belt-and-braces watchdog prints this, so
-# a wedge after the CPU baseline still yields a nonzero, honest record
+# a wedge after the CPU baseline still yields a nonzero, honest record.
+# "degraded" starts True and is only cleared when the headline number came
+# from the real accelerator at full size — a CPU fallback (BENCH_r05's 0.64×)
+# can never again masquerade as the headline metric.
 PARTIAL = {
     "metric": "pagerank_edges_per_sec_10M", "value": 0.0, "unit": "edges/s",
-    "vs_baseline": 0.0, "extra": {"error": "bench wedged before any stage"},
+    "vs_baseline": 0.0, "degraded": True, "backend": "none",
+    "extra": {"error": "bench wedged before any stage"},
 }
 
 
@@ -177,6 +181,8 @@ def stage_pagerank_mxu(n_nodes, n_edges, seed, out_path):
     # preserve exact top-100 order on this graph; the overlap check below
     # re-verifies every run
     run = spmv_mxu.make_pagerank_kernel(plan, route_dtype=jnp.bfloat16)
+    transfer_s = time.perf_counter() - t0  # blob pack + device_put
+    t0 = time.perf_counter()
     # uniform start computed on-device (None): saves one 33MB transfer
     # compile + warm (excluded); 1-element host transfer forces completion
     rank, err, iters = run(None, jnp.float32(DAMPING), ITERATIONS,
@@ -193,7 +199,8 @@ def stage_pagerank_mxu(n_nodes, n_edges, seed, out_path):
     assert int(iters) == ITERATIONS, f"expected {ITERATIONS}, ran {int(iters)}"
     ranks = np.asarray(rank)[plan.out_relabel]
     np.savez(out_path, ranks=ranks, elapsed=elapsed,
-             export_s=plan_s + warm_s,
+             export_s=plan_s + transfer_s + warm_s,
+             build_s=plan_s, transfer_s=transfer_s,
              plan_build_s=plan_build_s, plan_cached=plan_cached,
              plan_build_fresh_s=plan_build_fresh_s,
              plan_delta_build_s=plan_delta_build_s,
@@ -210,8 +217,12 @@ def stage_pagerank(n_nodes, n_edges, seed, out_path):
 
     src, dst = generate_graph(n_nodes, n_edges, seed)
     t0 = time.perf_counter()
-    graph = csr.from_coo(src, dst, n_nodes=n_nodes).to_device()
-    export_s = time.perf_counter() - t0
+    graph = csr.from_coo(src, dst, n_nodes=n_nodes)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    graph = graph.to_device()
+    transfer_s = time.perf_counter() - t0
+    export_s = build_s + transfer_s
 
     def run(d):
         # CSC ((dst, src)-sorted) arrays — the kernel's required order
@@ -224,8 +235,10 @@ def stage_pagerank(n_nodes, n_edges, seed, out_path):
 
     # compile + warm up (excluded from timing); host-transfer forces
     # completion — block_until_ready is unreliable on the tunneled platform
+    t0 = time.perf_counter()
     rank, err, iters = run(DAMPING)
     _ = float(rank[0])
+    warm_s = time.perf_counter() - t0
 
     def once():
         out = run(DAMPING)
@@ -235,6 +248,7 @@ def stage_pagerank(n_nodes, n_edges, seed, out_path):
     assert int(iters) == ITERATIONS, f"expected {ITERATIONS}, ran {int(iters)}"
     np.savez(out_path, ranks=np.asarray(rank[:n_nodes]),
              elapsed=elapsed, export_s=export_s,
+             build_s=build_s, transfer_s=transfer_s, warm_s=warm_s,
              platform=jax.devices()[0].platform)
 
 
@@ -262,17 +276,30 @@ def stage_latency(out_path):
     acc.commit()
 
     resident = False
+    client = None
     try:
         from memgraph_tpu.server.kernel_server import ensure_server, \
             KernelClient
-        client = ensure_server()
-    except RuntimeError as e:
-        # daemon died during init: a real regression — say so loudly
-        # (the bench still falls back so a number is always produced)
-        log(f"  RESIDENT KERNEL SERVER DIED DURING INIT: {e}")
-        client = None
     except Exception:  # noqa: BLE001 — environmental -> quiet fallback
-        client = None
+        ensure_server = None
+    if ensure_server is not None:
+        # reuse the resident daemon when it is already up; one retry on
+        # failure — a transient spawn race must not demote the whole
+        # latency stage to the non-resident fallback
+        for attempt in range(2):
+            try:
+                client = ensure_server()
+                break
+            except RuntimeError as e:
+                # daemon died during init: a real regression — say so
+                # loudly (the bench still falls back so a number is
+                # always produced)
+                log(f"  RESIDENT KERNEL SERVER DIED DURING INIT "
+                    f"(attempt {attempt + 1}): {e}")
+            except Exception as e:  # noqa: BLE001 — environmental
+                log(f"  resident kernel server unavailable "
+                    f"(attempt {attempt + 1}): {e}")
+            time.sleep(2)
     if client is not None:
         # steady-state server: shape-bucket kernels already compiled
         # (a production daemon has served before); measure a NEW graph
@@ -399,11 +426,20 @@ def main():
                         "error": "device stages did not complete"}
 
     log("probing device (subprocess) ...")
-    rc, out = _run_stage(["--stage", "probe"], _stage_env(),
-                         PROBE_TIMEOUT_SEC)
-    device_ok = rc == 0
-    log(f"  probe: rc={rc} ok={device_ok} "
-        f"{(out or b'').decode(errors='replace').strip()}")
+    t_probe = time.perf_counter()
+    device_ok = False
+    for attempt in range(2):
+        rc, out = _run_stage(["--stage", "probe"], _stage_env(),
+                             PROBE_TIMEOUT_SEC)
+        device_ok = rc == 0
+        log(f"  probe attempt {attempt + 1}: rc={rc} ok={device_ok} "
+            f"{(out or b'').decode(errors='replace').strip()}")
+        if device_ok:
+            break
+        # BENCH_r05 scored a CPU fallback because ONE flaky probe failed;
+        # a single retry after a short pause is cheap insurance
+        time.sleep(3)
+    probe_s = time.perf_counter() - t_probe
 
     # fallback ladder: tunneled TPU at full size, TPU at 1M edges, then
     # jax-CPU at full size — the driver must always get a nonzero number
@@ -440,7 +476,8 @@ def main():
                 "export_s": float(data["export_s"]),
             }
             for key in ("plan_build_s", "plan_cached", "warm_s",
-                        "plan_build_fresh_s", "plan_delta_build_s"):
+                        "plan_build_fresh_s", "plan_delta_build_s",
+                        "build_s", "transfer_s"):
                 if key in data.files:
                     result[key] = float(data[key])
         break
@@ -467,9 +504,20 @@ def main():
     overlap = len(top_dev & top_cpu)
     log(f"top-100 overlap: {overlap}/100")
 
+    # honesty contract (ROADMAP open item 5): the headline is only
+    # non-degraded when it came from the real accelerator at full size.
+    # A CPU fallback or a shrunken graph still yields a number, but one
+    # every consumer (and tools/perf_gate.py) can see is not comparable.
+    degraded = (result["platform"] == "cpu"
+                or result["n_edges"] < N_EDGES)
+    if degraded:
+        log(f"  DEGRADED RUN: backend={result['platform']} "
+            f"edges={result['n_edges']:,} — not a headline measurement")
     PARTIAL.update({
         "value": round(eps, 1),
         "vs_baseline": round(eps / base_eps, 3),
+        "degraded": degraded,
+        "backend": result["platform"],
     })
     PARTIAL["extra"] = {
         "device_platform": result["platform"],
@@ -480,6 +528,15 @@ def main():
         "csr_export_transfer_s": round(result["export_s"], 2),
         "top100_overlap": overlap,
         "device_probe_ok": device_ok,
+        # per-stage timings: where the wall clock actually went
+        "stages": {
+            "probe_s": round(probe_s, 2),
+            "baseline_s": round(cpu_time, 2),
+            "build_s": round(result.get("build_s", 0.0), 2),
+            "transfer_s": round(result.get("transfer_s", 0.0), 2),
+            "compile_warm_s": round(result.get("warm_s", 0.0), 2),
+            "iterate_s": round(result["elapsed"], 4),
+        },
     }
     if "plan_build_s" in result:
         PARTIAL["extra"]["plan_build_s"] = round(result["plan_build_s"], 2)

@@ -10,6 +10,81 @@ embeddings). Static shapes throughout: edge/vertex arrays are padded to
 bucketed sizes so recompilation is amortized across graph mutations.
 """
 
-from .csr import DeviceGraph, export_csr, GraphCache
+from .csr import DeviceGraph, ShardedCSR, export_csr, shard_csr, GraphCache
 
-__all__ = ["DeviceGraph", "export_csr", "GraphCache"]
+# --------------------------------------------------------------------------
+# SpMV-shaped algorithm registry (mesh-path coverage contract)
+# --------------------------------------------------------------------------
+# Every algorithm whose inner loop is an SpMV shape (per-edge gather +
+# segment reduction inside a while_loop) inherits the multi-chip mesh
+# path from the shared partition-centric core — unless it declares a
+# justified exemption here. mglint's MG005 registry-coverage rule
+# enforces the contract both ways:
+#   * each entry needs exactly one of "sharded" (a "module:function"
+#     target that must statically resolve) or "exempt" (a real
+#     justification, not a stub), and
+#   * every ops/ module whose AST shows the SpMV shape must be covered
+#     by some entry, so a new algorithm cannot silently miss the mesh.
+# tests/test_sharded_analytics.py resolves every "sharded" target at
+# runtime and tier-1 runs sharded-vs-single equivalence for the core
+# four (pagerank / katz / labelprop / components).
+SPMV_ALGORITHMS = {
+    "pagerank": {
+        "entry": "memgraph_tpu.ops.pagerank:pagerank",
+        "sharded": "memgraph_tpu.parallel.analytics:pagerank_mesh",
+    },
+    "personalized_pagerank": {
+        "entry": "memgraph_tpu.ops.pagerank:personalized_pagerank",
+        "exempt": "per-user restart vectors belong to the batched-PPR "
+                  "serving lane (ROADMAP item 3): one query's work is "
+                  "latency-bound, and the mesh axis there is the batch "
+                  "of personalization vectors, not edges",
+    },
+    "katz": {
+        "entry": "memgraph_tpu.ops.katz:katz_centrality",
+        "sharded": "memgraph_tpu.parallel.analytics:katz_mesh",
+    },
+    "hits": {
+        "entry": "memgraph_tpu.ops.katz:hits",
+        "exempt": "two interleaved L2-normalized reductions per round "
+                  "(hub and authority) cost >= 2 collectives each "
+                  "iteration; below the mesh win threshold until the "
+                  "fused-normalization core lands (ROADMAP item 2)",
+    },
+    "labelprop": {
+        "entry": "memgraph_tpu.ops.labelprop:label_propagation",
+        "sharded": "memgraph_tpu.parallel.analytics:label_propagation_mesh",
+    },
+    "components": {
+        "entry": "memgraph_tpu.ops.components:weakly_connected_components",
+        "sharded": "memgraph_tpu.parallel.analytics:components_mesh",
+    },
+    "scc": {
+        "entry": "memgraph_tpu.ops.components:strongly_connected_components",
+        "exempt": "host-driven multi-round FW-BW coloring; the round "
+                  "count is data-dependent and each round already runs "
+                  "the jitted min-propagation, so the mesh story needs "
+                  "the device-resident frontier work first",
+    },
+    "sssp": {
+        "entry": "memgraph_tpu.ops.traversal:sssp",
+        "sharded": "memgraph_tpu.parallel.analytics:sssp_mesh",
+    },
+    "bfs_layers": {
+        "entry": "memgraph_tpu.ops.traversal:bfs_levels",
+        "exempt": "frontier-based traversal: per-level frontiers are "
+                  "sparse and tiny relative to the edge set; edge-mesh "
+                  "sharding adds a collective per level for no win at "
+                  "current scales",
+    },
+    "betweenness": {
+        "entry": "memgraph_tpu.ops.betweenness:betweenness_centrality",
+        "exempt": "Brandes is a batch over SOURCES (forward + backward "
+                  "sweep per source); the profitable mesh axis is the "
+                  "source batch, planned with the batched-PPR lane "
+                  "(ROADMAP item 3), not the edge axis",
+    },
+}
+
+__all__ = ["DeviceGraph", "ShardedCSR", "export_csr", "shard_csr",
+           "GraphCache", "SPMV_ALGORITHMS"]

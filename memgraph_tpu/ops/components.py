@@ -42,9 +42,17 @@ def _wcc_kernel(src, dst, n_pad: int, max_iterations: int):
 
 
 def weakly_connected_components(graph: DeviceGraph,
-                                max_iterations: int = 200):
+                                max_iterations: int = 200, mesh=None):
     """Returns (component_id[:n_nodes], iterations). Component ids are the
-    minimum dense node index in each component."""
+    minimum dense node index in each component.
+
+    `mesh` (MeshContext | Mesh | int | None) routes through the
+    multi-chip layer; see ops.pagerank.pagerank."""
+    from ..parallel.mesh import resolve_mesh
+    ctx = resolve_mesh(mesh)
+    if ctx is not None:
+        from ..parallel.analytics import components_mesh
+        return components_mesh(graph, ctx, max_iterations=max_iterations)
     comp, iters = _wcc_kernel(graph.src_idx, graph.col_idx, graph.n_pad,
                               max_iterations)
     return comp[:graph.n_nodes], int(iters)

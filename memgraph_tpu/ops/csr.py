@@ -358,6 +358,157 @@ def export_csr_delta(prev: DeviceGraph, accessor, changed_gids,
     return g.to_device() if to_device else g
 
 
+# --------------------------------------------------------------------------
+# Partition-centric sharded layout (multi-chip analytics)
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ShardedCSR:
+    """Partition-centric (src-shard, dst-shard)-blocked edge layout.
+
+    The mesh analog of DeviceGraph: vertices are split into `n_shards`
+    contiguous blocks of `block` ids (padded to n_pad2 = n_shards*block,
+    so uneven `n_nodes % n_shards` just pads the last block); every edge
+    is owned by the shard of its `by` endpoint ("src" for the pull-style
+    SpMV kernels, "dst" for label propagation). Within a shard, edges
+    are (dst, src)-sorted, which makes the per-device edge list a
+    concatenation of (owner, dst-shard) BLOCKS — the partition-centric
+    layout of "Accelerating PageRank using Partition-Centric Processing"
+    (PAPERS.md): a device's contribution to remote shard q is the
+    contiguous run block_ptr[p, q]:block_ptr[p, q+1], and one
+    psum/psum_scatter per iteration moves exactly those partials.
+
+    Arrays are stacked (n_shards, edges_per_shard) and, once
+    `.to_device(ctx)` runs, placed one row per device via the
+    MeshContext's edge_blocks sharding — CSR shards resident per device,
+    so graphs larger than one chip's HBM fit.
+
+    Padding edges: src = shard base (locally index 0), dst = n_nodes
+    (the sink row, always < n_pad2), weight 0 — inert under every
+    segment reduction, and appended at the tail so dst stays sorted.
+    """
+
+    src: object          # (P, per) int32
+    dst: object          # (P, per) int32
+    weights: object      # (P, per) float32
+    block_ptr: np.ndarray  # (P, P+1) int32 — (p, q)-block boundaries
+    n_nodes: int
+    n_edges: int
+    n_shards: int
+    block: int           # vertices per shard
+    n_pad2: int          # n_shards * block
+    per: int             # edges per shard row (incl. padding)
+    by: str              # "src" | "dst" — owning endpoint
+
+    def to_device(self, ctx) -> "ShardedCSR":
+        """Place edge rows one-per-device under ctx's edge sharding."""
+        if not isinstance(self.src, np.ndarray):
+            return self
+        return ShardedCSR(
+            src=ctx.put_edge_blocks(self.src),
+            dst=ctx.put_edge_blocks(self.dst),
+            weights=ctx.put_edge_blocks(self.weights),
+            block_ptr=self.block_ptr, n_nodes=self.n_nodes,
+            n_edges=self.n_edges, n_shards=self.n_shards,
+            block=self.block, n_pad2=self.n_pad2, per=self.per,
+            by=self.by)
+
+
+def _ceil_multiple(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def shard_edges(src, dst, weights, n_nodes: int, n_shards: int,
+                by: str = "src", block_multiple: int = 8) -> ShardedCSR:
+    """Block COO edges partition-centrically over `n_shards` shards.
+
+    Host-side layout only — call `.to_device(ctx)` to make the rows
+    device-resident. `block` is rounded to `block_multiple` so vertex
+    blocks tile the VPU lanes on TPU.
+    """
+    if by not in ("src", "dst"):
+        raise ValueError(f"by must be 'src' or 'dst', got {by!r}")
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    n_edges = len(src)
+    w = (np.ones(n_edges, dtype=np.float32) if weights is None
+         else np.asarray(weights, dtype=np.float32))
+    # +1: the sink row n_nodes must exist inside the padded vertex space
+    block = _ceil_multiple(max((n_nodes + 1 + n_shards - 1) // n_shards, 1),
+                           block_multiple)
+    n_pad2 = n_shards * block
+
+    key = src if by == "src" else dst
+    owner = key // block
+    order = np.lexsort((src, dst, owner))
+    s_s, d_s, w_s, o_s = src[order], dst[order], w[order], owner[order]
+    counts = np.bincount(o_s, minlength=n_shards)
+    per = _ceil_multiple(max(int(counts.max(initial=0)), 1), block_multiple)
+
+    sink = n_nodes
+    src_b = np.empty((n_shards, per), dtype=np.int32)
+    dst_b = np.full((n_shards, per), sink, dtype=np.int32)
+    w_b = np.zeros((n_shards, per), dtype=np.float32)
+    # padding src must gather in-bounds LOCALLY on its shard: shard base
+    src_b[:] = (np.arange(n_shards, dtype=np.int32) * block)[:, None]
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    for p in range(n_shards):
+        lo, hi = offsets[p], offsets[p + 1]
+        src_b[p, :hi - lo] = s_s[lo:hi]
+        dst_b[p, :hi - lo] = d_s[lo:hi]
+        w_b[p, :hi - lo] = w_s[lo:hi]
+
+    # partition-centric block boundaries: device p's edges into dst
+    # shard q are dst_b[p, block_ptr[p, q]:block_ptr[p, q+1]] (the dst
+    # sort within each shard makes these contiguous runs)
+    block_ptr = np.empty((n_shards, n_shards + 1), dtype=np.int32)
+    for p in range(n_shards):
+        block_ptr[p] = np.searchsorted(
+            dst_b[p], np.arange(n_shards + 1, dtype=np.int64) * block)
+
+    return ShardedCSR(src=src_b, dst=dst_b, weights=w_b,
+                      block_ptr=block_ptr, n_nodes=n_nodes,
+                      n_edges=n_edges, n_shards=n_shards, block=block,
+                      n_pad2=n_pad2, per=per, by=by)
+
+
+_sharded_csr_guard = threading.Lock()
+
+
+def shard_csr(graph: DeviceGraph, ctx, by: str = "src",
+              doubled: bool = False) -> ShardedCSR:
+    """Partition-centric ShardedCSR for `graph` on `ctx`, cached on the
+    (immutable) DeviceGraph snapshot per (mesh, by, doubled) — repeated
+    mesh CALLs on an unchanged graph pay the blocking and transfer once.
+
+    `doubled=True` concatenates both edge directions before blocking
+    (the undirected view label propagation iterates over)."""
+    key = (ctx.cache_key, by, doubled)
+    cache = getattr(graph, "_sharded_csr", None)
+    if cache is not None and key in cache:
+        return cache[key]
+    with _sharded_csr_guard:
+        cache = getattr(graph, "_sharded_csr", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(graph, "_sharded_csr", cache)
+        if key not in cache:
+            if graph.host_coo is not None:
+                src, dst, w = graph.host_coo
+            else:
+                src = np.asarray(graph.src_idx)[:graph.n_edges]
+                dst = np.asarray(graph.col_idx)[:graph.n_edges]
+                w = np.asarray(graph.weights)[:graph.n_edges]
+            if doubled:
+                src, dst = (np.concatenate([src, dst]),
+                            np.concatenate([dst, src]))
+                w = np.concatenate([w, w])
+            scsr = shard_edges(src, dst, w, graph.n_nodes,
+                               ctx.n_shards, by=by)
+            cache[key] = scsr.to_device(ctx)
+    return cache[key]
+
+
 class GraphCache:
     """Per-storage cache of device CSR snapshots keyed by topology version.
 

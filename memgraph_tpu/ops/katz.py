@@ -43,8 +43,18 @@ def _katz_kernel(src, dst, weights, n_nodes, n_pad: int, alpha, beta,
 
 def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
                     max_iterations: int = 100, tol: float = 1e-6,
-                    normalized: bool = False):
-    """Returns (centralities[:n_nodes], error, iterations)."""
+                    normalized: bool = False, mesh=None):
+    """Returns (centralities[:n_nodes], error, iterations).
+
+    `mesh` (MeshContext | Mesh | int | None) routes through the
+    multi-chip layer; see ops.pagerank.pagerank."""
+    from ..parallel.mesh import resolve_mesh
+    ctx = resolve_mesh(mesh)
+    if ctx is not None:
+        from ..parallel.analytics import katz_mesh
+        return katz_mesh(graph, ctx, alpha=alpha, beta=beta,
+                         max_iterations=max_iterations, tol=tol,
+                         normalized=normalized)
     x, err, iters = _katz_kernel(
         graph.csc_src, graph.csc_dst, graph.csc_weights,
         jnp.int32(graph.n_nodes), graph.n_pad,

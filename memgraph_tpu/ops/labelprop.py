@@ -78,11 +78,21 @@ def _labelprop_kernel(src2, dst2, w2, n_pad: int, e2: int,
 
 
 def label_propagation(graph: DeviceGraph, max_iterations: int = 30,
-                      self_weight: float = 0.0, directed: bool = False):
+                      self_weight: float = 0.0, directed: bool = False,
+                      mesh=None):
     """Returns (community_label[:n_nodes], iterations).
 
     Labels are dense node indices (a community's label is one member's id).
+    `mesh` (MeshContext | Mesh | int | None) routes through the
+    multi-chip layer; see ops.pagerank.pagerank.
     """
+    from ..parallel.mesh import resolve_mesh
+    ctx = resolve_mesh(mesh)
+    if ctx is not None:
+        from ..parallel.analytics import label_propagation_mesh
+        return label_propagation_mesh(
+            graph, ctx, max_iterations=max_iterations,
+            self_weight=self_weight, directed=directed)
     if directed:
         src2, dst2, w2 = graph.src_idx, graph.col_idx, graph.weights
         e2 = graph.e_pad

@@ -196,10 +196,23 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
 
 
 def pagerank(graph: DeviceGraph, damping: float = 0.85,
-             max_iterations: int = 100, tol: float = 1e-6):
-    """Returns (ranks[:n_nodes], error, iterations)."""
+             max_iterations: int = 100, tol: float = 1e-6, mesh=None):
+    """Returns (ranks[:n_nodes], error, iterations).
+
+    `mesh` routes the computation through the multi-chip layer
+    (parallel/analytics.py): a MeshContext, a jax Mesh, a device count,
+    or None (→ the MEMGRAPH_TPU_MESH_DEVICES env default; unset keeps
+    the single-chip kernels). A mesh-of-1 runs the same sharded code
+    path as any other size — single-device is a degeneracy, not a fork.
+    """
     from ..utils.jax_cache import ensure_compile_cache
     ensure_compile_cache()
+    from ..parallel.mesh import resolve_mesh
+    ctx = resolve_mesh(mesh)
+    if ctx is not None:
+        from ..parallel.analytics import pagerank_mesh
+        return pagerank_mesh(graph, ctx, damping=damping,
+                             max_iterations=max_iterations, tol=tol)
     if graph.n_edges >= MXU_MIN_EDGES and (
             jax.default_backend() != "cpu"
             or os.environ.get("MEMGRAPH_TPU_FORCE_MXU")):

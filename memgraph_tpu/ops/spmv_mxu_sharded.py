@@ -168,16 +168,21 @@ def make_sharded_pagerank_kernel(plan: ShardedMXUPlan, mesh,
     (rank_flat, err, iters), with the edge phase sharded over
     `axis_name` of `mesh` and one psum per iteration.
 
-    rank vectors are replicated, flat in OUT labeling."""
+    rank vectors are replicated, flat in OUT labeling.
+
+    `mesh` may be a jax Mesh (with `axis_name` naming the edge axis) or
+    a parallel.mesh.MeshContext (its axis wins)."""
+    from ..parallel.mesh import MeshContext
+    if isinstance(mesh, MeshContext):
+        axis_name = mesh.axis
+        mesh = mesh.mesh
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax: no replication rule for while_loop
-        import functools
-        from jax.experimental.shard_map import shard_map as _shard_map
-        shard_map = functools.partial(_shard_map, check_rep=False)
+    # version-gated central resolution (parallel/mesh.py): warns once on
+    # the jax-0.4 check_rep=False fallback instead of silently degrading
+    from ..parallel.mesh import shard_map_fn
+    shard_map = shard_map_fn()
     from .blob import pack_blob, unblob
     from ..utils.jax_cache import ensure_compile_cache
     ensure_compile_cache()
@@ -306,9 +311,13 @@ def pagerank_mxu_sharded(src, dst, weights, n_nodes, mesh,
                          axis_name: str = "edges", damping=0.85,
                          max_iterations=100, tol=1e-6,
                          plan: ShardedMXUPlan = None, route_dtype=None):
-    """End-to-end sharded MXU pagerank over `mesh`. Returns ranks in
-    ORIGINAL node ids plus (err, iters)."""
+    """End-to-end sharded MXU pagerank over `mesh` (a jax Mesh or a
+    MeshContext). Returns ranks in ORIGINAL node ids plus (err, iters)."""
     import jax.numpy as jnp
+    from ..parallel.mesh import MeshContext
+    if isinstance(mesh, MeshContext):
+        axis_name = mesh.axis
+        mesh = mesh.mesh
     n_shards = int(mesh.shape[axis_name])
     if plan is None:
         plan = build_sharded_plan(src, dst, weights, n_nodes, n_shards)

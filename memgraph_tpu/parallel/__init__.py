@@ -9,9 +9,22 @@ is the graph analog of data parallelism: the "sequence" axis is the edge
 axis (SURVEY.md §5 long-context mapping).
 """
 
-from .mesh import make_mesh, device_count
+from .mesh import (make_mesh, device_count, MeshContext, get_mesh_context,
+                   analytics_mesh, resolve_mesh, resolve_shard_map)
 from .distributed import (shard_graph, ShardedGraph, pagerank_sharded,
-                          sssp_sharded, wcc_sharded)
+                          sssp_sharded, wcc_sharded,
+                          pagerank_partition_centric,
+                          katz_partition_centric,
+                          labelprop_partition_centric,
+                          wcc_partition_centric)
+from .analytics import (pagerank_mesh, katz_mesh, label_propagation_mesh,
+                        components_mesh, sssp_mesh)
 
-__all__ = ["make_mesh", "device_count", "shard_graph", "ShardedGraph",
-           "pagerank_sharded", "sssp_sharded", "wcc_sharded"]
+__all__ = ["make_mesh", "device_count", "MeshContext", "get_mesh_context",
+           "analytics_mesh", "resolve_mesh", "resolve_shard_map",
+           "shard_graph", "ShardedGraph",
+           "pagerank_sharded", "sssp_sharded", "wcc_sharded",
+           "pagerank_partition_centric", "katz_partition_centric",
+           "labelprop_partition_centric", "wcc_partition_centric",
+           "pagerank_mesh", "katz_mesh", "label_propagation_mesh",
+           "components_mesh", "sssp_mesh"]

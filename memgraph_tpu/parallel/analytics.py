@@ -1,0 +1,84 @@
+"""Algorithm-level mesh entry points: DeviceGraph in, results out.
+
+The seam between `ops/` (single-chip algorithms over DeviceGraph
+snapshots) and `parallel/distributed.py` (partition-centric kernels over
+ShardedCSR). Each `*_mesh` function:
+
+  1. blocks the snapshot's edges partition-centrically for the given
+     MeshContext (cached on the immutable DeviceGraph, so repeated CALLs
+     pay the blocking + device transfer once),
+  2. runs the sharded kernel (one collective per iteration), and
+  3. returns exactly the same (values[:n_nodes], ...) shape as the
+     single-chip entry point it mirrors.
+
+The mesh-of-1 context runs the SAME code path — `psum`/`psum_scatter`
+over a 1-device axis compiles to a copy — so single-device is a
+degeneracy of the sharded story, not a separate implementation.
+`ops/pagerank.py` (and katz/labelprop/components) route here whenever a
+mesh is requested (explicit `mesh=` argument or the
+MEMGRAPH_TPU_MESH_DEVICES env default; see `parallel/mesh.py`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .mesh import MeshContext
+from ..ops.csr import DeviceGraph, shard_csr
+
+
+def pagerank_mesh(graph: DeviceGraph, ctx: MeshContext,
+                  damping: float = 0.85, max_iterations: int = 100,
+                  tol: float = 1e-6):
+    """Sharded PageRank; same contract as ops.pagerank.pagerank."""
+    from .distributed import pagerank_partition_centric
+    scsr = shard_csr(graph, ctx, by="src")
+    return pagerank_partition_centric(scsr, ctx, damping=damping,
+                                      max_iterations=max_iterations,
+                                      tol=tol)
+
+
+def katz_mesh(graph: DeviceGraph, ctx: MeshContext, alpha: float = 0.2,
+              beta: float = 1.0, max_iterations: int = 100,
+              tol: float = 1e-6, normalized: bool = False):
+    """Sharded Katz centrality; same contract as ops.katz.katz_centrality."""
+    from .distributed import katz_partition_centric
+    scsr = shard_csr(graph, ctx, by="src")
+    return katz_partition_centric(scsr, ctx, alpha=alpha, beta=beta,
+                                  max_iterations=max_iterations, tol=tol,
+                                  normalized=normalized)
+
+
+def label_propagation_mesh(graph: DeviceGraph, ctx: MeshContext,
+                           max_iterations: int = 30,
+                           self_weight: float = 0.0,
+                           directed: bool = False):
+    """Sharded label propagation; same contract as
+    ops.labelprop.label_propagation."""
+    from .distributed import labelprop_partition_centric
+    scsr = shard_csr(graph, ctx, by="dst", doubled=not directed)
+    labels, iters = labelprop_partition_centric(
+        scsr, ctx, max_iterations=max_iterations,
+        self_weight=self_weight)
+    return labels, iters
+
+
+def components_mesh(graph: DeviceGraph, ctx: MeshContext,
+                    max_iterations: int = 200):
+    """Sharded WCC; same contract as
+    ops.components.weakly_connected_components."""
+    from .distributed import wcc_partition_centric
+    scsr = shard_csr(graph, ctx, by="src")
+    return wcc_partition_centric(scsr, ctx,
+                                 max_iterations=max_iterations)
+
+
+def sssp_mesh(graph: DeviceGraph, ctx: MeshContext, source: int,
+              max_iterations: int = 10_000):
+    """Sharded Bellman-Ford over the context's mesh (weighted,
+    directed); same result contract as ops.traversal.sssp's weighted
+    directed mode. Rides the edge-partition ShardedGraph layout."""
+    from .distributed import shard_graph, sssp_sharded
+    sg = shard_graph(graph, ctx.mesh, axis=ctx.axis)
+    dist, iters = sssp_sharded(sg, source, max_iterations=max_iterations)
+    return np.asarray(dist), iters

@@ -22,14 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax: no replication rule for while_loop
-    import functools
-    from jax.experimental.shard_map import shard_map as _shard_map
-    shard_map = functools.partial(_shard_map, check_rep=False)
 
-from ..ops.csr import DeviceGraph
+from .mesh import MeshContext, shard_map_fn
+from ..ops.csr import DeviceGraph, ShardedCSR
+
+# version-gated central resolution (parallel/mesh.py): jax >= 0.5 uses the
+# public jax.shard_map; the 0.4 line gets the experimental one with
+# check_rep=False and a WARNING logged once — never a silent fallback
+shard_map = shard_map_fn()
 
 
 @dataclass(frozen=True)
@@ -342,3 +342,322 @@ def wcc_sharded(sg: ShardedGraph, max_iterations: int = 200):
     fn = jax.jit(_wcc_sharded_fn(sg.mesh, sg.axis, sg.n_pad, max_iterations))
     comp, iters = fn(sg.src, sg.dst, init)
     return comp[:sg.n_nodes], int(iters)
+
+
+# ==========================================================================
+# Partition-centric kernels over ShardedCSR (the pjit/NamedSharding story)
+# ==========================================================================
+#
+# Inputs are placed ONCE under the MeshContext's NamedShardings
+# (ShardedCSR.to_device); the kernels below are shard_mapped over the
+# context's edge axis and keep the ONE-collective-per-iteration invariant:
+#
+#   pagerank  — rank SHARDED over vertex blocks; per-iteration partials
+#               land in the (dst-shard, local) partition-centric layout
+#               and ONE fused psum_scatter both scatters them to their
+#               owners AND rides the dangling-mass / convergence-error
+#               partial sums in two extra lanes (so neither needs its
+#               own psum — the 3-collective 1.5D scheme collapses to 1).
+#   katz      — x replicated, partial A^T x psum-combined: one psum.
+#   labelprop — edges owned by DST shard, labels replicated; each round
+#               a device elects labels for its own block only and one
+#               psum concatenates the disjoint blocks.
+#   wcc       — comp replicated, one pmin per round + pointer jumping.
+#
+# Convergence checks that need a global reduction are carried one
+# iteration behind (the error partial rides the NEXT iteration's
+# collective), so tol-based runs execute at most one extra iteration —
+# never an extra collective.
+
+_PC_EXTRA = 2          # piggyback lanes: [dangling_mass, prev_local_err]
+
+
+def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int,
+                       max_iterations: int):
+    axis = ctx.axis
+    n_pad2 = n_shards * block
+
+    def step(src_blk, dst_blk, w_blk, n_nodes, damping, tol):
+        src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
+        shard_id = jax.lax.axis_index(axis)
+        base = shard_id * block
+        n_f = n_nodes.astype(jnp.float32)
+        local_ids = base + jnp.arange(block, dtype=jnp.int32)
+        valid_f = (local_ids < n_nodes).astype(jnp.float32)
+
+        # edges are src-owned: every out-edge of an owned vertex is
+        # local, so the out-weight sum needs no collective
+        local_src = src_blk - base
+        wsum = jax.ops.segment_sum(w_blk, local_src, num_segments=block)
+        inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
+        dangling_f = valid_f * (wsum <= 0)
+        edge_mult = w_blk * inv_wsum[local_src]
+
+        rank0 = valid_f / n_f
+
+        def body(carry):
+            rank, local_err, _, it = carry
+            contrib = rank[local_src] * edge_mult
+            # the (dst, src) sort within the shard means this sorted
+            # segment-sum fills the (dst-shard, local-dst) blocks of the
+            # partition-centric layout contiguously
+            acc = jax.ops.segment_sum(contrib, dst_blk,
+                                      num_segments=n_pad2,
+                                      indices_are_sorted=True
+                                      ).reshape(n_shards, block)
+            dm_local = jnp.sum(rank * dangling_f)
+            extras = jnp.broadcast_to(
+                jnp.stack([dm_local, local_err]), (n_shards, _PC_EXTRA))
+            payload = jnp.concatenate([acc, extras], axis=1)
+            # THE collective: row q of the payload sum lands on device q
+            got = jax.lax.psum_scatter(payload, axis,
+                                       scatter_dimension=0, tiled=False)
+            acc_own = got[:block]
+            dm = got[block]
+            g_err_prev = got[block + 1]
+            new_rank = valid_f * ((1.0 - damping) / n_f
+                                  + damping * (acc_own + dm / n_f))
+            new_local_err = jnp.sum(jnp.abs(new_rank - rank))
+            return new_rank, new_local_err, g_err_prev, it + 1
+
+        def cond(carry):
+            _, _, g_err_prev, it = carry
+            return (g_err_prev > tol) & (it < max_iterations)
+
+        rank, _, g_err, iters = jax.lax.while_loop(
+            cond, body,
+            (rank0, jnp.float32(jnp.inf), jnp.float32(jnp.inf),
+             jnp.int32(0)))
+        return rank, g_err, iters
+
+    Pr = P()
+    Pe = P(axis, None)
+    return jax.jit(shard_map(
+        step, mesh=ctx.mesh,
+        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr),
+        out_specs=(P(axis), Pr, Pr)))
+
+
+_PC_KERNEL_CACHE: dict = {}
+
+
+def _pc_cached(kind: str, builder, ctx: MeshContext, *shape_key):
+    key = (kind, ctx.cache_key, shape_key)
+    fn = _PC_KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = _PC_KERNEL_CACHE[key] = builder(ctx, *shape_key)
+    return fn
+
+
+def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
+                               damping: float = 0.85,
+                               max_iterations: int = 100,
+                               tol: float = 1e-6):
+    """PageRank over a partition-centric ShardedCSR: rank sharded over
+    vertex blocks, exactly one collective (a fused psum_scatter) per
+    power iteration. Returns (ranks[:n_nodes], err, iters).
+
+    The convergence check trails by one iteration (its global reduction
+    rides the next iteration's collective), so tol-based runs may do one
+    extra iteration; fixed-iteration runs (tol=0) are unchanged.
+    """
+    if scsr.by != "src":
+        raise ValueError("pagerank needs a src-owned ShardedCSR")
+    fn = _pc_cached("pagerank", _pc_pagerank_build, ctx,
+                    scsr.block, scsr.n_shards, max_iterations)
+    rank, err, iters = fn(scsr.src, scsr.dst, scsr.weights,
+                          jnp.int32(scsr.n_nodes), jnp.float32(damping),
+                          jnp.float32(tol))
+    return rank[:scsr.n_nodes], float(err), int(iters)
+
+
+def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int,
+                   max_iterations: int):
+    axis = ctx.axis
+    n_pad2 = n_shards * block
+
+    def step(src_blk, dst_blk, w_blk, n_nodes, alpha, beta, tol,
+             normalized):
+        src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
+        valid_f = (jnp.arange(n_pad2, dtype=jnp.int32) < n_nodes
+                   ).astype(jnp.float32)
+        x0 = jnp.zeros(n_pad2, dtype=jnp.float32)
+
+        def body(carry):
+            x, _, it = carry
+            acc_local = jax.ops.segment_sum(x[src_blk] * w_blk, dst_blk,
+                                            num_segments=n_pad2,
+                                            indices_are_sorted=True)
+            acc = jax.lax.psum(acc_local, axis)    # the one collective
+            new_x = valid_f * (alpha * acc + beta)
+            # x is replicated: every device computes the same error —
+            # no collective needed for the convergence check
+            err = jnp.max(jnp.abs(new_x - x))
+            return new_x, err, it + 1
+
+        def cond(carry):
+            _, err, it = carry
+            return (err > tol) & (it < max_iterations)
+
+        x, err, iters = jax.lax.while_loop(
+            cond, body, (x0, jnp.float32(jnp.inf), jnp.int32(0)))
+        norm = jnp.sqrt(jnp.sum(x * x))
+        x = jnp.where(normalized, x / jnp.maximum(norm, 1e-30), x)
+        return x, err, iters
+
+    Pr = P()
+    Pe = P(axis, None)
+    return jax.jit(shard_map(
+        step, mesh=ctx.mesh,
+        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr),
+        out_specs=(Pr, Pr, Pr)))
+
+
+def katz_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
+                           alpha: float = 0.2, beta: float = 1.0,
+                           max_iterations: int = 100, tol: float = 1e-6,
+                           normalized: bool = False):
+    """Katz centrality over the mesh: x replicated, one psum/iteration."""
+    fn = _pc_cached("katz", _pc_katz_build, ctx,
+                    scsr.block, scsr.n_shards, max_iterations)
+    x, err, iters = fn(scsr.src, scsr.dst, scsr.weights,
+                       jnp.int32(scsr.n_nodes), jnp.float32(alpha),
+                       jnp.float32(beta), jnp.float32(tol),
+                       jnp.bool_(normalized))
+    return x[:scsr.n_nodes], float(err), int(iters)
+
+
+def _pc_labelprop_build(ctx: MeshContext, block: int, n_shards: int,
+                        per: int, max_iterations: int):
+    axis = ctx.axis
+    n_pad2 = n_shards * block
+
+    def step(src_blk, dst_blk, w_blk, self_weight):
+        src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
+        shard_id = jax.lax.axis_index(axis)
+        base = shard_id * block
+        labels0 = jnp.arange(n_pad2, dtype=jnp.int32)
+
+        def one_round(labels):
+            # edges are DST-owned: every incident edge of an owned
+            # vertex is local, so run reduction + election are local
+            lab_e = labels[src_blk]
+            d_s, l_s, w_s = jax.lax.sort((dst_blk, lab_e, w_blk),
+                                         num_keys=2)
+            first = jnp.concatenate([
+                jnp.ones((1,), dtype=jnp.bool_),
+                (d_s[1:] != d_s[:-1]) | (l_s[1:] != l_s[:-1])])
+            run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+            run_w = jax.ops.segment_sum(w_s, run_id, num_segments=per)
+            idx = jnp.arange(per, dtype=jnp.int32)
+            first_idx = jax.ops.segment_min(
+                jnp.where(first, idx, per), run_id, num_segments=per)
+            first_idx = jnp.minimum(first_idx, per - 1)
+            run_dst_local = d_s[first_idx] - base
+            run_lab = l_s[first_idx]
+            valid_run = idx <= run_id[-1]
+            # padding edges carry weight 0 into the sink row; runs that
+            # fall outside the local block clip to an ignored slot
+            in_block = (run_dst_local >= 0) & (run_dst_local < block)
+            run_dst_local = jnp.clip(run_dst_local, 0, block - 1)
+            run_w = jnp.where(valid_run & in_block, run_w, 0.0)
+            best_w = jax.ops.segment_max(run_w, run_dst_local,
+                                         num_segments=block)
+            is_best = run_w >= best_w[run_dst_local] - 1e-12
+            cand = jnp.where(valid_run & in_block & is_best, run_lab,
+                             jnp.int32(n_pad2))
+            best_lab = jax.ops.segment_min(cand, run_dst_local,
+                                           num_segments=block)
+            has_nb = best_lab < n_pad2
+            own = jax.lax.dynamic_slice(labels, (base,), (block,))
+            own_wins = (~has_nb) | (self_weight >= best_w) | \
+                       (jnp.isclose(self_weight, best_w)
+                        & (own <= best_lab))
+            new_local = jnp.where(own_wins, own, best_lab)
+            # disjoint block election: one psum concatenates the blocks
+            contrib = jax.lax.dynamic_update_slice(
+                jnp.zeros(n_pad2, dtype=jnp.int32), new_local, (base,))
+            return jax.lax.psum(contrib, axis)
+
+        def body(carry):
+            labels, _, it = carry
+            new = one_round(labels)
+            return new, jnp.any(new != labels), it + 1
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < max_iterations)
+
+        labels, _, iters = jax.lax.while_loop(
+            cond, body, (labels0, jnp.bool_(True), jnp.int32(0)))
+        return labels, iters
+
+    Pr = P()
+    Pe = P(axis, None)
+    return jax.jit(shard_map(
+        step, mesh=ctx.mesh,
+        in_specs=(Pe, Pe, Pe, Pr),
+        out_specs=(Pr, Pr)))
+
+
+def labelprop_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
+                                max_iterations: int = 30,
+                                self_weight: float = 0.0):
+    """Synchronous label propagation over the mesh (dst-owned edges,
+    labels replicated, one int psum per round). `scsr` must be built
+    with by="dst" (both edge directions already concatenated for the
+    undirected variant). Returns (labels[:n_nodes], iters)."""
+    if scsr.by != "dst":
+        raise ValueError("labelprop needs a dst-owned ShardedCSR")
+    fn = _pc_cached("labelprop", _pc_labelprop_build, ctx,
+                    scsr.block, scsr.n_shards, scsr.per, max_iterations)
+    labels, iters = fn(scsr.src, scsr.dst, scsr.weights,
+                       jnp.float32(self_weight))
+    return labels[:scsr.n_nodes], int(iters)
+
+
+def _pc_wcc_build(ctx: MeshContext, block: int, n_shards: int,
+                  max_iterations: int):
+    axis = ctx.axis
+    n_pad2 = n_shards * block
+
+    def step(src_blk, dst_blk):
+        src_blk, dst_blk = src_blk[0], dst_blk[0]
+        init = jnp.arange(n_pad2, dtype=jnp.int32)
+
+        def body(carry):
+            comp, _, it = carry
+            fwd = jax.ops.segment_min(comp[src_blk], dst_blk,
+                                      num_segments=n_pad2,
+                                      indices_are_sorted=True)
+            bwd = jax.ops.segment_min(comp[dst_blk], src_blk,
+                                      num_segments=n_pad2)
+            cand = jax.lax.pmin(jnp.minimum(fwd, bwd), axis)  # the one
+            new = jnp.minimum(comp, cand)
+            new = new[new]                     # pointer jump, replicated
+            return new, jnp.any(new != comp), it + 1
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < max_iterations)
+
+        comp, _, iters = jax.lax.while_loop(
+            cond, body, (init, jnp.bool_(True), jnp.int32(0)))
+        return comp, iters
+
+    Pr = P()
+    Pe = P(axis, None)
+    return jax.jit(shard_map(
+        step, mesh=ctx.mesh,
+        in_specs=(Pe, Pe),
+        out_specs=(Pr, Pr)))
+
+
+def wcc_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
+                          max_iterations: int = 200):
+    """Weakly-connected components over the mesh: comp replicated, one
+    pmin per round + pointer jumping. Returns (comp[:n_nodes], iters)."""
+    fn = _pc_cached("wcc", _pc_wcc_build, ctx,
+                    scsr.block, scsr.n_shards, max_iterations)
+    comp, iters = fn(scsr.src, scsr.dst)
+    return comp[:scsr.n_nodes], int(iters)

@@ -47,7 +47,15 @@ stage "mgchaos seeded round + safety checker" \
 stage "mgchaos checker honesty (split-brain script)" \
     python -m tools.mgchaos honesty
 
-# 5. tier-1 tests: arms the lock-order witness (MG_TRACK_LOCKS=1, from
+# 5. perf-regression gate: the newest BENCH_r*.json record must be
+#    non-degraded and within BASELINE.json's envelope (>15% regression
+#    fails). Hosts without an accelerator skip LOUDLY (exit 0): the
+#    gate defends the trajectory on real hardware, it does not punish
+#    CPU-only dev boxes — but it never silently passes either.
+stage "perf regression gate (BASELINE.json envelopes)" \
+    python -m tools.perf_gate --latest
+
+# 6. tier-1 tests: arms the lock-order witness (MG_TRACK_LOCKS=1, from
 #    conftest) and the vector-clock race detector (MG_SAN=1) suite-wide;
 #    the session fails on any witnessed lock cycle or data race.
 #    Optional-dep suites (hypothesis, cryptography) self-skip.
@@ -56,7 +64,7 @@ stage "tier-1 tests (MG_SAN=1)" \
         -m "not slow and not crash and not sanitize"
 
 if [ "$FULL" = 1 ]; then
-    # 6. the full seeded sweeps: 25 mgsan seeds per scenario + 5
+    # 7. the full seeded sweeps: 25 mgsan seeds per scenario + 5
     #    workload seeds, and the 10-seed mgchaos nemesis sweep
     stage "mgsan full seeded sweep (-m sanitize)" \
         env MG_SAN=1 python -m pytest tests/test_mgsan.py -q -m sanitize

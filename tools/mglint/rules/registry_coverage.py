@@ -82,6 +82,7 @@ def check(project: Project):
     findings.extend(_check_wal_opcodes(project))
     findings.extend(_check_fault_points(project))
     findings.extend(_check_nemesis_ops(project))
+    findings.extend(_check_spmv_registry(project))
     return findings
 
 
@@ -261,4 +262,152 @@ def _check_fault_points(project: Project):
                         "registration, campaigns covering it test "
                         "nothing",
                 fingerprint=f"fault-dead:{point}"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# SpMV-algorithm mesh coverage (ops/__init__.py SPMV_ALGORITHMS)
+# --------------------------------------------------------------------------
+#
+# The multi-chip mesh path (parallel/analytics.py) is only a win if every
+# SpMV-shaped algorithm actually rides it. The contract:
+#   * ops/__init__.py keeps a SPMV_ALGORITHMS registry; each entry names
+#     its single-chip "entry" target and EXACTLY ONE of a "sharded"
+#     target or a justified "exempt" string;
+#   * every "module:function" target must statically resolve to a
+#     function defined in a scanned file (a typo'd target would only
+#     surface when a user requests a mesh);
+#   * every ops/ module whose AST shows the SpMV shape (a segment_*
+#     reduction AND a while_loop) must be covered by some entry, so a
+#     new algorithm cannot silently miss the mesh path.
+
+_SPMV_MIN_JUSTIFICATION = 40   # chars; "TODO" is not a justification
+
+
+def _registry_dict(sf, name: str):
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name \
+                and isinstance(stmt.value, ast.Dict):
+            return stmt.value, stmt.lineno
+    return None, 0
+
+
+def _literal_or_none(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _target_resolves(project: Project, target: str) -> bool:
+    """Does 'pkg.mod:fn' point at a def in a scanned file?"""
+    if ":" not in target:
+        return False
+    mod, fn = target.split(":", 1)
+    sf = project.by_suffix(mod.replace(".", "/") + ".py")
+    if sf is None:
+        return False
+    return any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and n.name == fn for n in sf.tree.body)
+
+
+def _has_spmv_shape(sf) -> bool:
+    has_segment = has_loop = False
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            name = (dotted(node.func) or "").split(".")[-1]
+            if name.startswith("segment_"):
+                has_segment = True
+            elif name == "while_loop":
+                has_loop = True
+        if has_segment and has_loop:
+            return True
+    return False
+
+
+def _check_spmv_registry(project: Project):
+    ops_init = project.by_suffix("ops/__init__.py")
+    if ops_init is None:
+        return []
+    reg, reg_line = _registry_dict(ops_init, "SPMV_ALGORITHMS")
+    findings = []
+    if reg is None:
+        findings.append(Finding(
+            rule="MG005", path=ops_init.rel_path, line=1, col=0,
+            symbol="SPMV_ALGORITHMS",
+            message="ops/__init__.py has no SPMV_ALGORITHMS registry — "
+                    "the mesh-coverage contract has nothing to check",
+            fingerprint="spmv-registry-missing"))
+        return findings
+
+    covered_modules: set[str] = set()
+    for key_node, val_node in zip(reg.keys, reg.values):
+        algo = _literal_or_none(key_node)
+        entry = _literal_or_none(val_node)
+        if not isinstance(algo, str) or not isinstance(entry, dict):
+            findings.append(Finding(
+                rule="MG005", path=ops_init.rel_path,
+                line=getattr(key_node, "lineno", reg_line), col=0,
+                symbol="SPMV_ALGORITHMS",
+                message="SPMV_ALGORITHMS entries must be literal "
+                        "str -> dict",
+                fingerprint=f"spmv-nonliteral:{algo!r}"))
+            continue
+        line = getattr(key_node, "lineno", reg_line)
+        sharded = entry.get("sharded")
+        exempt = entry.get("exempt")
+        if (sharded is None) == (exempt is None):
+            findings.append(Finding(
+                rule="MG005", path=ops_init.rel_path, line=line, col=0,
+                symbol=algo,
+                message=f"SPMV_ALGORITHMS[{algo!r}] must declare "
+                        "exactly one of 'sharded' (mesh entry point) "
+                        "or 'exempt' (justification)",
+                fingerprint=f"spmv-undeclared:{algo}"))
+        if exempt is not None and (not isinstance(exempt, str)
+                                   or len(exempt.strip())
+                                   < _SPMV_MIN_JUSTIFICATION):
+            findings.append(Finding(
+                rule="MG005", path=ops_init.rel_path, line=line, col=0,
+                symbol=algo,
+                message=f"SPMV_ALGORITHMS[{algo!r}] exemption needs a "
+                        "real justification (>= "
+                        f"{_SPMV_MIN_JUSTIFICATION} chars)",
+                fingerprint=f"spmv-stub-exemption:{algo}"))
+        for field_name in ("entry", "sharded"):
+            target = entry.get(field_name)
+            if target is None:
+                continue
+            if not isinstance(target, str) \
+                    or not _target_resolves(project, target):
+                findings.append(Finding(
+                    rule="MG005", path=ops_init.rel_path, line=line,
+                    col=0, symbol=algo,
+                    message=f"SPMV_ALGORITHMS[{algo!r}].{field_name} "
+                            f"target {target!r} does not resolve to a "
+                            "function in the scanned tree",
+                    fingerprint=f"spmv-dangling:{algo}:{field_name}"))
+            if isinstance(target, str) and ":" in target:
+                covered_modules.add(target.split(":", 1)[0]
+                                    .rsplit(".", 1)[-1])
+
+    # sweep: every SpMV-shaped ops/ module must be covered by an entry
+    for rel, sf in sorted(project.files.items()):
+        if "/ops/" not in rel or rel.endswith("__init__.py"):
+            continue
+        mod = rel.rsplit("/", 1)[-1][:-3]
+        # the kernel cores themselves (spmv_mxu*, benes*) are the shared
+        # engine the registry's targets ride, not algorithms to register
+        if mod.startswith(("spmv_", "benes")):
+            continue
+        if _has_spmv_shape(sf) and mod not in covered_modules:
+            findings.append(Finding(
+                rule="MG005", path=rel, line=1, col=0, symbol=mod,
+                message=f"ops/{mod}.py has an SpMV-shaped kernel "
+                        "(segment reduction inside while_loop) but no "
+                        "SPMV_ALGORITHMS entry references it — it "
+                        "silently misses the mesh path",
+                fingerprint=f"spmv-uncovered:{mod}"))
     return findings

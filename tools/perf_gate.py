@@ -1,0 +1,169 @@
+"""Perf-regression gate: bench output vs BASELINE.json envelopes.
+
+The lesson of BENCH_r05 (a silent CPU fallback scored 0.64× while the
+real kernel measured 3.03B edges/s): a perf number nobody can trust is
+not a perf number. This gate makes the trajectory enforceable:
+
+  * no accelerator present      -> LOUD skip, exit 0 (a CPU-only dev
+                                   box must not fail the gate — but it
+                                   must SAY it measured nothing);
+  * bench record is degraded    -> FAIL (a degraded run can never
+                                   stand in for the headline metric);
+  * value under the envelope    -> FAIL on > max_regression (15%)
+                                   against BASELINE.json's reference;
+  * otherwise                   -> PASS with the measured margin.
+
+Usage:
+    python -m tools.perf_gate                 # probe; run bench.py; check
+    python -m tools.perf_gate --json F.json   # check an existing record
+    python -m tools.perf_gate --latest        # check newest BENCH_r*.json
+
+`tools/gate.sh` runs `--latest` so the dev gate validates the freshest
+recorded measurement without re-running the 9-minute bench; CI on real
+hardware runs the bare form to measure fresh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "BASELINE.json")
+PROBE_TIMEOUT_SEC = 30
+BENCH_TIMEOUT_SEC = 700
+
+_PROBE_SNIPPET = (
+    "import jax, sys; "
+    "b = jax.default_backend(); "
+    "print(b); "
+    "sys.exit(0 if b != 'cpu' else 3)"
+)
+
+
+def log(msg: str) -> None:
+    print(f"perf-gate: {msg}", flush=True)
+
+
+def accelerator_present() -> bool:
+    """Probe in a subprocess (a wedged device tunnel must not hang the
+    gate); exit 3 from the child means 'jax is up but CPU-only'."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True, timeout=PROBE_TIMEOUT_SEC, text=True,
+            env={k: v for k, v in os.environ.items()
+                 if k != "JAX_PLATFORMS"})
+        log(f"probe backend: {proc.stdout.strip() or '?'} "
+            f"(rc={proc.returncode})")
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError) as e:
+        log(f"probe failed: {e}")
+        return False
+
+
+def run_bench() -> dict | None:
+    """Run bench.py and parse its single JSON stdout line."""
+    log("running bench.py for a fresh measurement ...")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            stdout=subprocess.PIPE, timeout=BENCH_TIMEOUT_SEC)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        log(f"bench.py did not complete: {e}")
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace")
+                         .strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    log("bench.py produced no JSON record")
+    return None
+
+
+def latest_bench_json() -> str | None:
+    records = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    return records[-1] if records else None
+
+
+def check(record: dict, baseline: dict) -> int:
+    envelopes = baseline.get("envelopes") or {}
+    metric = record.get("metric", "")
+    env = envelopes.get(metric)
+    if env is None:
+        log(f"NO ENVELOPE for metric {metric!r} in BASELINE.json — "
+            "add one; gate cannot pass what it cannot compare")
+        return 1
+    if "degraded" not in record:
+        log("FAIL: record predates the degraded-tagging format "
+            "(pre-r06) — an untagged number cannot be trusted; "
+            "regenerate with the current bench.py")
+        return 1
+    if record["degraded"]:
+        log(f"FAIL: record is degraded (backend="
+            f"{record.get('backend', '?')}); a degraded run can never "
+            "stand in for the headline metric")
+        return 1
+    value = float(record.get("value", 0.0))
+    ref = float(env["value"])
+    max_reg = float(env.get("max_regression", 0.15))
+    floor = ref * (1.0 - max_reg)
+    if value < floor:
+        log(f"FAIL: {metric} = {value:,.0f} is "
+            f"{(1 - value / ref) * 100:.1f}% below the envelope "
+            f"reference {ref:,.0f} (allowed regression "
+            f"{max_reg * 100:.0f}%, floor {floor:,.0f})")
+        return 1
+    log(f"PASS: {metric} = {value:,.0f} vs envelope {ref:,.0f} "
+        f"(margin {(value / ref - 1) * 100:+.1f}%, floor {floor:,.0f})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="perf_gate")
+    ap.add_argument("--json", help="check an existing bench JSON record")
+    ap.add_argument("--latest", action="store_true",
+                    help="check the newest BENCH_r*.json in the repo")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    args = ap.parse_args(argv)
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+
+    if not accelerator_present():
+        log("=" * 62)
+        log("SKIPPED: no accelerator present — nothing was measured.")
+        log("This gate only defends the perf trajectory on real")
+        log("hardware; do NOT read this skip as a pass.")
+        log("=" * 62)
+        return 0
+
+    if args.json:
+        path = args.json
+    elif args.latest:
+        path = latest_bench_json()
+        if path is None:
+            log("no BENCH_r*.json records found")
+            return 1
+        log(f"checking newest record {os.path.basename(path)}")
+    else:
+        record = run_bench()
+        if record is None:
+            log("FAIL: could not obtain a bench measurement")
+            return 1
+        return check(record, baseline)
+
+    with open(path) as f:
+        record = json.load(f)
+    return check(record, baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
