@@ -413,6 +413,22 @@ class ShardedCSR:
             block=self.block, n_pad2=self.n_pad2, per=self.per,
             by=self.by)
 
+    def refresh(self, ctx) -> "ShardedCSR":
+        """Re-place the edge rows on the mesh — the device_lost recovery
+        hook (parallel/checkpoint.py): after a backend loss the resident
+        rows are gone, so pull the host copy and re-run placement. On a
+        host-side (not yet placed) layout this is a no-op."""
+        if isinstance(self.src, np.ndarray):
+            return self
+        return ShardedCSR(
+            src=ctx.put_edge_blocks(np.asarray(self.src)),
+            dst=ctx.put_edge_blocks(np.asarray(self.dst)),
+            weights=ctx.put_edge_blocks(np.asarray(self.weights)),
+            block_ptr=self.block_ptr, n_nodes=self.n_nodes,
+            n_edges=self.n_edges, n_shards=self.n_shards,
+            block=self.block, n_pad2=self.n_pad2, per=self.per,
+            by=self.by)
+
 
 def _ceil_multiple(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m

@@ -17,9 +17,20 @@ degeneracy of the sharded story, not a separate implementation.
 `ops/pagerank.py` (and katz/labelprop/components) route here whenever a
 mesh is requested (explicit `mesh=` argument or the
 MEMGRAPH_TPU_MESH_DEVICES env default; see `parallel/mesh.py`).
+
+Resilience (r12): every iterative entry point accepts
+``checkpoint_every=k`` (plus ``job``/``store``/``report``) and routes
+through `parallel/checkpoint.run_resumable` — the loop carry is copied
+to host memory every k iterations and a device fault resumes from the
+last checkpoint, bit-exact, instead of restarting. The
+MEMGRAPH_TPU_CHECKPOINT_EVERY env var sets the default k for callers
+that do not pass one (0 = single full-budget chunk, no host round
+trips); the kernel server and bench.py pass it explicitly.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -27,50 +38,81 @@ from .mesh import MeshContext
 from ..ops.csr import DeviceGraph, shard_csr
 
 
+def default_checkpoint_every() -> int:
+    """Process-default checkpoint interval for mesh analytics (env
+    MEMGRAPH_TPU_CHECKPOINT_EVERY; 0 disables intermediate
+    checkpoints — one full-budget chunk, the classic fast path)."""
+    try:
+        return max(0, int(os.environ.get(
+            "MEMGRAPH_TPU_CHECKPOINT_EVERY", "0")))
+    except ValueError:
+        return 0
+
+
+def _resume_kw(checkpoint_every, job, store, report, retry):
+    if checkpoint_every is None:
+        checkpoint_every = default_checkpoint_every()
+    return {"checkpoint_every": checkpoint_every, "job": job,
+            "store": store, "report": report, "retry": retry}
+
+
 def pagerank_mesh(graph: DeviceGraph, ctx: MeshContext,
                   damping: float = 0.85, max_iterations: int = 100,
-                  tol: float = 1e-6):
+                  tol: float = 1e-6, *, checkpoint_every: int | None = None,
+                  job: str | None = None, store=None, report=None,
+                  retry=None):
     """Sharded PageRank; same contract as ops.pagerank.pagerank."""
     from .distributed import pagerank_partition_centric
     scsr = shard_csr(graph, ctx, by="src")
-    return pagerank_partition_centric(scsr, ctx, damping=damping,
-                                      max_iterations=max_iterations,
-                                      tol=tol)
+    return pagerank_partition_centric(
+        scsr, ctx, damping=damping, max_iterations=max_iterations,
+        tol=tol, **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
 def katz_mesh(graph: DeviceGraph, ctx: MeshContext, alpha: float = 0.2,
               beta: float = 1.0, max_iterations: int = 100,
-              tol: float = 1e-6, normalized: bool = False):
+              tol: float = 1e-6, normalized: bool = False, *,
+              checkpoint_every: int | None = None, job: str | None = None,
+              store=None, report=None, retry=None):
     """Sharded Katz centrality; same contract as ops.katz.katz_centrality."""
     from .distributed import katz_partition_centric
     scsr = shard_csr(graph, ctx, by="src")
-    return katz_partition_centric(scsr, ctx, alpha=alpha, beta=beta,
-                                  max_iterations=max_iterations, tol=tol,
-                                  normalized=normalized)
+    return katz_partition_centric(
+        scsr, ctx, alpha=alpha, beta=beta,
+        max_iterations=max_iterations, tol=tol, normalized=normalized,
+        **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
 def label_propagation_mesh(graph: DeviceGraph, ctx: MeshContext,
                            max_iterations: int = 30,
                            self_weight: float = 0.0,
-                           directed: bool = False):
+                           directed: bool = False, *,
+                           checkpoint_every: int | None = None,
+                           job: str | None = None, store=None,
+                           report=None, retry=None):
     """Sharded label propagation; same contract as
     ops.labelprop.label_propagation."""
     from .distributed import labelprop_partition_centric
     scsr = shard_csr(graph, ctx, by="dst", doubled=not directed)
     labels, iters = labelprop_partition_centric(
         scsr, ctx, max_iterations=max_iterations,
-        self_weight=self_weight)
+        self_weight=self_weight,
+        **_resume_kw(checkpoint_every, job, store, report, retry))
     return labels, iters
 
 
 def components_mesh(graph: DeviceGraph, ctx: MeshContext,
-                    max_iterations: int = 200):
+                    max_iterations: int = 200, *,
+                    checkpoint_every: int | None = None,
+                    job: str | None = None, store=None, report=None,
+                    retry=None):
     """Sharded WCC; same contract as
     ops.components.weakly_connected_components."""
     from .distributed import wcc_partition_centric
     scsr = shard_csr(graph, ctx, by="src")
-    return wcc_partition_centric(scsr, ctx,
-                                 max_iterations=max_iterations)
+    return wcc_partition_centric(
+        scsr, ctx, max_iterations=max_iterations,
+        **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
 def sssp_mesh(graph: DeviceGraph, ctx: MeshContext, source: int,

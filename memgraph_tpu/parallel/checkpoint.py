@@ -1,0 +1,218 @@
+"""Checkpoint/resume layer for long-running mesh analytics.
+
+The partition-centric kernels in ``parallel/distributed.py`` compile to
+ONE device program per chunk of up to ``k`` power iterations (the chunk
+carry is the loop state: rank/label vector, convergence partials,
+iteration counter). This module drives those chunks from the host:
+
+  * every completed chunk's carry is copied to HOST memory as a
+    :class:`Checkpoint` (k iterations of work is the most a device fault
+    can destroy),
+  * a device fault (``utils/devicefault.classify_device_error``) is
+    answered by re-placing the carry from the last checkpoint — after a
+    ``device_lost`` additionally rebuilding the device-resident inputs
+    via the caller's ``rebuild`` hook — and resuming, NOT restarting,
+  * resumption is bit-exact: a chunk is a pure function of its carry, so
+    re-running from checkpoint ``c`` replays iterations ``c..c+k``
+    identically to an unfaulted run (asserted by
+    tests/test_device_resilience.py),
+  * ``checkpoint_every=0`` (the default for callers that opt out) runs
+    one full-budget chunk — byte-identical device programs and no host
+    round-trips, so the non-resumable fast path IS the k=∞ degeneracy of
+    the resumable one, not a separate implementation.
+
+Every fault, resume, checkpoint, and slow chunk is counted through
+``observability.metrics.global_metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..observability.metrics import global_metrics
+from ..utils import devicefault
+from ..utils.locks import tracked_lock
+from ..utils.retry import RetryPolicy
+
+
+@dataclass(frozen=True)
+class Checkpoint:
+    """Host-memory snapshot of one algorithm's loop state."""
+    algo: str
+    iteration: int
+    payload: tuple            # host (numpy / python scalar) carry copy
+
+
+class CheckpointStore:
+    """Host-memory checkpoint store keyed by job id.
+
+    Deliberately process-local: the checkpoint protects against DEVICE
+    faults (the HBM state vanishing), not host crashes — durability of
+    source data is the WAL's job. A bounded LRU keeps long-lived servers
+    from accumulating dead jobs.
+    """
+
+    MAX_JOBS = 64
+
+    def __init__(self) -> None:
+        self._lock = tracked_lock("CheckpointStore._lock")
+        self._ckpts: dict[str, Checkpoint] = {}
+
+    def put(self, job: str, ckpt: Checkpoint) -> None:
+        with self._lock:
+            self._ckpts.pop(job, None)        # re-insert: LRU refresh
+            self._ckpts[job] = ckpt
+            while len(self._ckpts) > self.MAX_JOBS:
+                self._ckpts.pop(next(iter(self._ckpts)))
+        global_metrics.increment("analytics.checkpoint.saved_total")
+
+    def get(self, job: str) -> Checkpoint | None:
+        with self._lock:
+            return self._ckpts.get(job)
+
+    def drop(self, job: str) -> None:
+        with self._lock:
+            self._ckpts.pop(job, None)
+
+    def jobs(self) -> list[str]:
+        with self._lock:
+            return list(self._ckpts)
+
+
+_default_store = CheckpointStore()
+
+
+def default_store() -> CheckpointStore:
+    """The process-wide store the analytics entry points default to."""
+    return _default_store
+
+
+@dataclass
+class RunReport:
+    """What the resumable runner observed — filled in place so entry
+    points keep their (values, err, iters) return contract."""
+    algo: str = ""
+    iterations: int = 0          # final iteration count
+    chunks: int = 0              # successful chunk dispatches
+    checkpoints: int = 0         # host checkpoints written
+    resumes: int = 0             # device-fault recoveries
+    faults: list = field(default_factory=list)   # typed outcome per fault
+    lost_spans: list = field(default_factory=list)  # iters redone/resume
+    slow_chunks: int = 0         # chunks exceeding chunk_deadline_s
+    rebuilds: int = 0            # device_lost input re-placements
+
+    @property
+    def redone_iterations(self) -> int:
+        return int(sum(self.lost_spans))
+
+
+def run_resumable(*, algo: str, chunk, carry, carry_to_host,
+                  carry_from_host, iter_of, max_iterations: int,
+                  checkpoint_every: int = 0, job: str | None = None,
+                  store: CheckpointStore | None = None,
+                  retry: RetryPolicy | None = None, rebuild=None,
+                  chunk_deadline_s: float | None = None,
+                  report: RunReport | None = None):
+    """Drive a chunked device loop to completion, surviving device faults.
+
+    ``chunk(carry, it_stop)`` runs the compiled kernel until convergence
+    or iteration ``it_stop`` and returns the new carry; ``iter_of``
+    reads the (host-synced) iteration counter — the sync point where
+    device errors surface. ``carry_to_host``/``carry_from_host`` convert
+    the carry to/from host arrays for checkpointing. ``rebuild()`` is
+    called after a ``device_lost`` to re-place device-resident inputs
+    (and may return a replacement ``chunk`` callable). Returns the final
+    carry.
+    """
+    report = report if report is not None else RunReport()
+    report.algo = algo
+    store = store or default_store()
+    retry = retry or RetryPolicy(base_delay=0.05, max_delay=1.0,
+                                 max_retries=3)
+    k = checkpoint_every if checkpoint_every and checkpoint_every > 0 \
+        else max_iterations
+    ephemeral = job is None
+    if ephemeral:
+        job = f"{algo}:{uuid.uuid4().hex}"
+
+    it = int(iter_of(carry))
+    prior = store.get(job)
+    if prior is not None and prior.algo == algo \
+            and prior.iteration > it:
+        carry = carry_from_host(prior.payload)
+        it = prior.iteration
+        global_metrics.increment("analytics.checkpoint.restored_total")
+    # iteration-0 checkpoint: a fault during the FIRST chunk must also
+    # resume (from the start) instead of poisoning the run
+    store.put(job, Checkpoint(algo, it, carry_to_host(carry)))
+    report.checkpoints += 1
+
+    faults_in_a_row = 0
+    t_run = time.monotonic()
+    try:
+        while True:
+            it_stop = min(max_iterations, it + k)
+            t0 = time.monotonic()
+            try:
+                devicefault.device_fault_point()
+                new_carry = chunk(carry, it_stop)
+                new_it = int(iter_of(new_carry))   # host sync: device
+                #                                    errors surface here
+            except Exception as e:  # noqa: BLE001 — classified below
+                kind = devicefault.classify_device_error(e)
+                if kind is None:
+                    raise
+                report.faults.append(kind)
+                global_metrics.increment(
+                    f"analytics.device_fault.{kind}_total")
+                faults_in_a_row += 1
+                if faults_in_a_row > retry.max_retries:
+                    raise
+                time.sleep(retry.delay_for(faults_in_a_row - 1))
+                if kind == "device_lost" and rebuild is not None:
+                    replacement = rebuild()
+                    if replacement is not None:
+                        chunk = replacement
+                    report.rebuilds += 1
+                ckpt = store.get(job)
+                carry = carry_from_host(ckpt.payload)
+                it = ckpt.iteration
+                report.resumes += 1
+                # the failed chunk's partial progress is discarded; at
+                # most it_stop - checkpoint iterations (≤ k) are redone
+                report.lost_spans.append(it_stop - it)
+                global_metrics.increment("analytics.resume_total")
+                continue
+            faults_in_a_row = 0
+            elapsed = time.monotonic() - t0
+            if chunk_deadline_s is not None and elapsed > chunk_deadline_s:
+                # the chunk COMPLETED, late — the analytics-plane analog
+                # of the kernel server's deadline_exceeded outcome
+                report.slow_chunks += 1
+                global_metrics.increment(
+                    "analytics.chunk_deadline_exceeded_total")
+            carry = new_carry
+            report.chunks += 1
+            if new_it >= max_iterations or new_it < it_stop \
+                    or new_it == it:
+                # budget spent, or the kernel's own convergence check
+                # stopped the loop before the chunk cap
+                it = new_it
+                break
+            it = new_it
+            store.put(job, Checkpoint(algo, it, carry_to_host(carry)))
+            report.checkpoints += 1
+    finally:
+        if ephemeral:
+            store.drop(job)
+        global_metrics.observe("analytics.resumable_run_seconds",
+                               time.monotonic() - t_run)
+    report.iterations = it
+    if not ephemeral:
+        store.drop(job)   # completed: the job's checkpoint is obsolete
+    return carry

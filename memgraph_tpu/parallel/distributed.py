@@ -368,17 +368,30 @@ def wcc_sharded(sg: ShardedGraph, max_iterations: int = 200):
 # iteration behind (the error partial rides the NEXT iteration's
 # collective), so tol-based runs execute at most one extra iteration —
 # never an extra collective.
+#
+# Resumability (r12): every kernel is a CHUNK — it takes the loop carry
+# (state vector(s), convergence partials, iteration counter) plus an
+# `it_stop` bound and runs `while cond & (it < it_stop)`. The entry
+# points drive chunks through parallel/checkpoint.run_resumable, which
+# copies the carry to host every k iterations and resumes from the last
+# checkpoint after a device fault. `checkpoint_every=0` runs ONE chunk
+# covering the whole budget: identical device program, no host round
+# trips — the fast path is the k=∞ degeneracy, not a separate kernel.
 
 _PC_EXTRA = 2          # piggyback lanes: [dangling_mass, prev_local_err]
 
 
-def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int,
-                       max_iterations: int):
+def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int):
     axis = ctx.axis
     n_pad2 = n_shards * block
 
-    def step(src_blk, dst_blk, w_blk, n_nodes, damping, tol):
+    def step(src_blk, dst_blk, w_blk, n_nodes, damping, tol,
+             rank, local_err_v, g_err_prev, it, it_stop):
         src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
+        # local_err is a genuinely per-shard partial (it rides the next
+        # iteration's collective), so it crosses chunk boundaries as a
+        # P(axis)-sharded (n_shards,) vector: one lane per device
+        local_err = local_err_v[0]
         shard_id = jax.lax.axis_index(axis)
         base = shard_id * block
         n_f = n_nodes.astype(jnp.float32)
@@ -392,8 +405,6 @@ def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int,
         inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
         dangling_f = valid_f * (wsum <= 0)
         edge_mult = w_blk * inv_wsum[local_src]
-
-        rank0 = valid_f / n_f
 
         def body(carry):
             rank, local_err, _, it = carry
@@ -422,20 +433,19 @@ def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int,
 
         def cond(carry):
             _, _, g_err_prev, it = carry
-            return (g_err_prev > tol) & (it < max_iterations)
+            return (g_err_prev > tol) & (it < it_stop)
 
-        rank, _, g_err, iters = jax.lax.while_loop(
-            cond, body,
-            (rank0, jnp.float32(jnp.inf), jnp.float32(jnp.inf),
-             jnp.int32(0)))
-        return rank, g_err, iters
+        rank, local_err, g_err, iters = jax.lax.while_loop(
+            cond, body, (rank, local_err, g_err_prev, it))
+        return rank, local_err.reshape(1), g_err, iters
 
     Pr = P()
     Pe = P(axis, None)
+    Pv = P(axis)
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
-        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr),
-        out_specs=(P(axis), Pr, Pr)))
+        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pv, Pv, Pr, Pr, Pr),
+        out_specs=(Pv, Pv, Pr, Pr)))
 
 
 _PC_KERNEL_CACHE: dict = {}
@@ -449,10 +459,41 @@ def _pc_cached(kind: str, builder, ctx: MeshContext, *shape_key):
     return fn
 
 
+def _run_pc_resumable(*, algo, scsr, ctx, chunk_of, carry0, iter_index,
+                      max_iterations, checkpoint_every=0, job=None,
+                      store=None, retry=None, chunk_deadline_s=None,
+                      report=None):
+    """Shared driver: wire a partition-centric chunk kernel into the
+    checkpoint layer. `chunk_of(scsr)` binds the (possibly re-placed)
+    ShardedCSR into a `chunk(carry, it_stop)` callable; after a
+    device_lost the rebuild hook re-places the edge rows and re-binds."""
+    from .checkpoint import run_resumable
+    holder = {"scsr": scsr}
+
+    def rebuild():
+        holder["scsr"] = holder["scsr"].refresh(ctx)
+        return chunk_of(holder["scsr"])
+
+    carry = run_resumable(
+        algo=algo, chunk=chunk_of(scsr), carry=carry0,
+        carry_to_host=lambda c: tuple(np.asarray(x) for x in c),
+        carry_from_host=lambda p: p,
+        iter_of=lambda c: int(c[iter_index]),
+        max_iterations=max_iterations,
+        checkpoint_every=checkpoint_every, job=job, store=store,
+        retry=retry, rebuild=rebuild, chunk_deadline_s=chunk_deadline_s,
+        report=report)
+    return carry
+
+
 def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                                damping: float = 0.85,
                                max_iterations: int = 100,
-                               tol: float = 1e-6):
+                               tol: float = 1e-6, *,
+                               checkpoint_every: int = 0,
+                               job: str | None = None, store=None,
+                               retry=None, chunk_deadline_s=None,
+                               report=None):
     """PageRank over a partition-centric ShardedCSR: rank sharded over
     vertex blocks, exactly one collective (a fused psum_scatter) per
     power iteration. Returns (ranks[:n_nodes], err, iters).
@@ -460,28 +501,48 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     The convergence check trails by one iteration (its global reduction
     rides the next iteration's collective), so tol-based runs may do one
     extra iteration; fixed-iteration runs (tol=0) are unchanged.
+
+    `checkpoint_every=k` (> 0) checkpoints the loop carry to host memory
+    every k iterations and resumes from the last checkpoint after a
+    device fault — re-executing at most k iterations, bit-exact to an
+    unfaulted run (parallel/checkpoint.py). `job` keys the checkpoint in
+    `store` so a caller that died mid-run can also resume.
     """
     if scsr.by != "src":
         raise ValueError("pagerank needs a src-owned ShardedCSR")
     fn = _pc_cached("pagerank", _pc_pagerank_build, ctx,
-                    scsr.block, scsr.n_shards, max_iterations)
-    rank, err, iters = fn(scsr.src, scsr.dst, scsr.weights,
-                          jnp.int32(scsr.n_nodes), jnp.float32(damping),
-                          jnp.float32(tol))
+                    scsr.block, scsr.n_shards)
+    ids = np.arange(scsr.n_pad2, dtype=np.int64)
+    rank0 = (ids < scsr.n_nodes).astype(np.float32) \
+        / np.float32(scsr.n_nodes)
+    carry0 = (rank0,
+              np.full((scsr.n_shards,), np.inf, dtype=np.float32),
+              np.float32(np.inf), np.int32(0))
+
+    def chunk_of(s):
+        def chunk(carry, it_stop):
+            return fn(s.src, s.dst, s.weights, jnp.int32(s.n_nodes),
+                      jnp.float32(damping), jnp.float32(tol),
+                      *carry, jnp.int32(it_stop))
+        return chunk
+
+    rank, _, err, iters = _run_pc_resumable(
+        algo="pagerank", scsr=scsr, ctx=ctx, chunk_of=chunk_of,
+        carry0=carry0, iter_index=3, max_iterations=max_iterations,
+        checkpoint_every=checkpoint_every, job=job, store=store,
+        retry=retry, chunk_deadline_s=chunk_deadline_s, report=report)
     return rank[:scsr.n_nodes], float(err), int(iters)
 
 
-def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int,
-                   max_iterations: int):
+def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int):
     axis = ctx.axis
     n_pad2 = n_shards * block
 
     def step(src_blk, dst_blk, w_blk, n_nodes, alpha, beta, tol,
-             normalized):
+             x, err, it, it_stop):
         src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
         valid_f = (jnp.arange(n_pad2, dtype=jnp.int32) < n_nodes
                    ).astype(jnp.float32)
-        x0 = jnp.zeros(n_pad2, dtype=jnp.float32)
 
         def body(carry):
             x, _, it = carry
@@ -497,46 +558,70 @@ def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int,
 
         def cond(carry):
             _, err, it = carry
-            return (err > tol) & (it < max_iterations)
+            return (err > tol) & (it < it_stop)
 
-        x, err, iters = jax.lax.while_loop(
-            cond, body, (x0, jnp.float32(jnp.inf), jnp.int32(0)))
-        norm = jnp.sqrt(jnp.sum(x * x))
-        x = jnp.where(normalized, x / jnp.maximum(norm, 1e-30), x)
+        x, err, iters = jax.lax.while_loop(cond, body, (x, err, it))
         return x, err, iters
 
     Pr = P()
     Pe = P(axis, None)
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
-        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr),
+        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr, Pr, Pr, Pr),
         out_specs=(Pr, Pr, Pr)))
+
+
+def _katz_normalize(x):
+    """Final L2 normalization, applied once AFTER the outer chunk loop
+    (inside the loop it would have to re-run per chunk and break the
+    chunked ≡ monolithic equivalence)."""
+    x = jnp.asarray(x)
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return x / jnp.maximum(norm, 1e-30)
 
 
 def katz_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                            alpha: float = 0.2, beta: float = 1.0,
                            max_iterations: int = 100, tol: float = 1e-6,
-                           normalized: bool = False):
-    """Katz centrality over the mesh: x replicated, one psum/iteration."""
+                           normalized: bool = False, *,
+                           checkpoint_every: int = 0,
+                           job: str | None = None, store=None,
+                           retry=None, chunk_deadline_s=None,
+                           report=None):
+    """Katz centrality over the mesh: x replicated, one psum/iteration.
+    Checkpoint/resume semantics as in `pagerank_partition_centric`."""
     fn = _pc_cached("katz", _pc_katz_build, ctx,
-                    scsr.block, scsr.n_shards, max_iterations)
-    x, err, iters = fn(scsr.src, scsr.dst, scsr.weights,
-                       jnp.int32(scsr.n_nodes), jnp.float32(alpha),
-                       jnp.float32(beta), jnp.float32(tol),
-                       jnp.bool_(normalized))
+                    scsr.block, scsr.n_shards)
+    carry0 = (np.zeros(scsr.n_pad2, dtype=np.float32),
+              np.float32(np.inf), np.int32(0))
+
+    def chunk_of(s):
+        def chunk(carry, it_stop):
+            return fn(s.src, s.dst, s.weights, jnp.int32(s.n_nodes),
+                      jnp.float32(alpha), jnp.float32(beta),
+                      jnp.float32(tol), *carry, jnp.int32(it_stop))
+        return chunk
+
+    x, err, iters = _run_pc_resumable(
+        algo="katz", scsr=scsr, ctx=ctx, chunk_of=chunk_of,
+        carry0=carry0, iter_index=2, max_iterations=max_iterations,
+        checkpoint_every=checkpoint_every, job=job, store=store,
+        retry=retry, chunk_deadline_s=chunk_deadline_s, report=report)
+    if normalized:
+        x = _katz_normalize(x)
     return x[:scsr.n_nodes], float(err), int(iters)
 
 
 def _pc_labelprop_build(ctx: MeshContext, block: int, n_shards: int,
-                        per: int, max_iterations: int):
+                        per: int):
     axis = ctx.axis
     n_pad2 = n_shards * block
 
-    def step(src_blk, dst_blk, w_blk, self_weight):
+    def step(src_blk, dst_blk, w_blk, self_weight,
+             labels_in, changed_in, it, it_stop):
         src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
         shard_id = jax.lax.axis_index(axis)
         base = shard_id * block
-        labels0 = jnp.arange(n_pad2, dtype=jnp.int32)
 
         def one_round(labels):
             # edges are DST-owned: every incident edge of an owned
@@ -586,44 +671,59 @@ def _pc_labelprop_build(ctx: MeshContext, block: int, n_shards: int,
 
         def cond(carry):
             _, changed, it = carry
-            return changed & (it < max_iterations)
+            return changed & (it < it_stop)
 
-        labels, _, iters = jax.lax.while_loop(
-            cond, body, (labels0, jnp.bool_(True), jnp.int32(0)))
-        return labels, iters
+        labels, changed, iters = jax.lax.while_loop(
+            cond, body, (labels_in, changed_in, it))
+        return labels, changed, iters
 
     Pr = P()
     Pe = P(axis, None)
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
-        in_specs=(Pe, Pe, Pe, Pr),
-        out_specs=(Pr, Pr)))
+        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr),
+        out_specs=(Pr, Pr, Pr)))
 
 
 def labelprop_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                                 max_iterations: int = 30,
-                                self_weight: float = 0.0):
+                                self_weight: float = 0.0, *,
+                                checkpoint_every: int = 0,
+                                job: str | None = None, store=None,
+                                retry=None, chunk_deadline_s=None,
+                                report=None):
     """Synchronous label propagation over the mesh (dst-owned edges,
     labels replicated, one int psum per round). `scsr` must be built
     with by="dst" (both edge directions already concatenated for the
-    undirected variant). Returns (labels[:n_nodes], iters)."""
+    undirected variant). Returns (labels[:n_nodes], iters).
+    Checkpoint/resume semantics as in `pagerank_partition_centric`."""
     if scsr.by != "dst":
         raise ValueError("labelprop needs a dst-owned ShardedCSR")
     fn = _pc_cached("labelprop", _pc_labelprop_build, ctx,
-                    scsr.block, scsr.n_shards, scsr.per, max_iterations)
-    labels, iters = fn(scsr.src, scsr.dst, scsr.weights,
-                       jnp.float32(self_weight))
+                    scsr.block, scsr.n_shards, scsr.per)
+    carry0 = (np.arange(scsr.n_pad2, dtype=np.int32),
+              np.bool_(True), np.int32(0))
+
+    def chunk_of(s):
+        def chunk(carry, it_stop):
+            return fn(s.src, s.dst, s.weights, jnp.float32(self_weight),
+                      *carry, jnp.int32(it_stop))
+        return chunk
+
+    labels, _, iters = _run_pc_resumable(
+        algo="labelprop", scsr=scsr, ctx=ctx, chunk_of=chunk_of,
+        carry0=carry0, iter_index=2, max_iterations=max_iterations,
+        checkpoint_every=checkpoint_every, job=job, store=store,
+        retry=retry, chunk_deadline_s=chunk_deadline_s, report=report)
     return labels[:scsr.n_nodes], int(iters)
 
 
-def _pc_wcc_build(ctx: MeshContext, block: int, n_shards: int,
-                  max_iterations: int):
+def _pc_wcc_build(ctx: MeshContext, block: int, n_shards: int):
     axis = ctx.axis
     n_pad2 = n_shards * block
 
-    def step(src_blk, dst_blk):
+    def step(src_blk, dst_blk, comp_in, changed_in, it, it_stop):
         src_blk, dst_blk = src_blk[0], dst_blk[0]
-        init = jnp.arange(n_pad2, dtype=jnp.int32)
 
         def body(carry):
             comp, _, it = carry
@@ -639,25 +739,42 @@ def _pc_wcc_build(ctx: MeshContext, block: int, n_shards: int,
 
         def cond(carry):
             _, changed, it = carry
-            return changed & (it < max_iterations)
+            return changed & (it < it_stop)
 
-        comp, _, iters = jax.lax.while_loop(
-            cond, body, (init, jnp.bool_(True), jnp.int32(0)))
-        return comp, iters
+        comp, changed, iters = jax.lax.while_loop(
+            cond, body, (comp_in, changed_in, it))
+        return comp, changed, iters
 
     Pr = P()
     Pe = P(axis, None)
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
-        in_specs=(Pe, Pe),
-        out_specs=(Pr, Pr)))
+        in_specs=(Pe, Pe, Pr, Pr, Pr, Pr),
+        out_specs=(Pr, Pr, Pr)))
 
 
 def wcc_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
-                          max_iterations: int = 200):
+                          max_iterations: int = 200, *,
+                          checkpoint_every: int = 0,
+                          job: str | None = None, store=None,
+                          retry=None, chunk_deadline_s=None,
+                          report=None):
     """Weakly-connected components over the mesh: comp replicated, one
-    pmin per round + pointer jumping. Returns (comp[:n_nodes], iters)."""
+    pmin per round + pointer jumping. Returns (comp[:n_nodes], iters).
+    Checkpoint/resume semantics as in `pagerank_partition_centric`."""
     fn = _pc_cached("wcc", _pc_wcc_build, ctx,
-                    scsr.block, scsr.n_shards, max_iterations)
-    comp, iters = fn(scsr.src, scsr.dst)
+                    scsr.block, scsr.n_shards)
+    carry0 = (np.arange(scsr.n_pad2, dtype=np.int32),
+              np.bool_(True), np.int32(0))
+
+    def chunk_of(s):
+        def chunk(carry, it_stop):
+            return fn(s.src, s.dst, *carry, jnp.int32(it_stop))
+        return chunk
+
+    comp, _, iters = _run_pc_resumable(
+        algo="wcc", scsr=scsr, ctx=ctx, chunk_of=chunk_of,
+        carry0=carry0, iter_index=2, max_iterations=max_iterations,
+        checkpoint_every=checkpoint_every, job=job, store=store,
+        retry=retry, chunk_deadline_s=chunk_deadline_s, report=report)
     return comp[:scsr.n_nodes], int(iters)

@@ -190,9 +190,14 @@ class RoutedClient:
         self.routers = list(routers)
         self.username = username
         self.password = password
-        self.timeout = timeout
+        # the RetryPolicy owns ALL timing: per-connection timeout rides
+        # attempt_timeout (the legacy `timeout` arg seeds it), and an
+        # optional policy deadline bounds a whole routed write
         self.retry = retry or RetryPolicy(base_delay=0.2, max_delay=2.0,
-                                          max_retries=8)
+                                          max_retries=8,
+                                          attempt_timeout=timeout)
+        self.timeout = self.retry.attempt_timeout \
+            if self.retry.attempt_timeout is not None else timeout
         self.known_epoch = 0
         self._writer_addr: str | None = None
         self._writer: BoltClient | None = None
@@ -261,9 +266,13 @@ class RoutedClient:
 
     def execute_write(self, query: str, parameters: dict | None = None):
         """Run a write on the current MAIN, re-routing with backoff on
-        failure. Returns (columns, rows, summary) like BoltClient."""
+        failure. Returns (columns, rows, summary) like BoltClient.
+
+        Timing is RetryPolicy-owned: `attempts()` sleeps the backoff
+        between tries and stops early when the policy's overall deadline
+        would be crossed — no ad-hoc sleep/timeout constants here."""
         last: Exception | None = None
-        for attempt in range(self.retry.max_retries + 1):
+        for _attempt in self.retry.attempts():
             try:
                 return self._connect_writer().execute(query, parameters)
             except BoltClientError as e:
@@ -275,14 +284,10 @@ class RoutedClient:
                 last = e
                 self._disconnect()
                 self.refresh_route_table()
-                import time as _time
-                _time.sleep(self.retry.delay_for(attempt))
             except (OSError, MemgraphTpuError) as e:
                 last = e
                 self._disconnect()
                 self.refresh_route_table()
-                import time as _time
-                _time.sleep(self.retry.delay_for(attempt))
         raise MemgraphTpuError(
             f"write failed after {self.retry.max_retries + 1} routed "
             f"attempts: {last}") from last

@@ -1,5 +1,5 @@
 """Resident kernel server: keeps one JAX/TPU runtime warm for
-short-lived client processes.
+short-lived client processes — now a SUPERVISED service.
 
 Measured on the tunneled axon platform (NOTES_ROUND4): every fresh
 process pays ~1.5s to load the device executable stack before its first
@@ -10,9 +10,35 @@ property: a unix-socket service holding the device runtime, compiled
 kernels, and graph caches, so a cold client's first CALL costs one
 socket round-trip plus device compute.
 
+Resilience (r12) — device failure is a first-class, typed, recoverable
+event end to end:
+
+  * every dispatch returns a TYPED outcome: completed /
+    deadline_exceeded / device_error / oom / shed / invalid. Clients
+    raise matching exception types (AdmissionRejected, KernelOom, ...)
+    so callers branch on class, not message text;
+  * a per-request ``deadline_s`` bounds how long a client waits on the
+    device — the dispatch runs on a worker thread, and a device hang
+    yields a prompt ``deadline_exceeded`` instead of a wedged client;
+  * an HBM ADMISSION GUARD estimates each request's device footprint
+    against a budget and sheds (typed, counted, loudly logged) instead
+    of letting one oversized request OOM the resident runtime for
+    everyone;
+  * compute routes through the RESUMABLE mesh entry points
+    (parallel/analytics.py): long pagerank runs checkpoint every k
+    iterations, so a mid-run device fault costs ≤ k redone iterations;
+  * :class:`SupervisedKernelClient` is the client-side supervisor:
+    idempotent requests retry under a shared RetryPolicy (per-attempt
+    timeout + overall deadline), a health-check loop watches the
+    daemon's ``health`` op, and a WEDGED (dispatch overdue) or LOST
+    (device.lost killed the process) server is restarted;
+  * everything is counted through observability.metrics — the server's
+    own counters ride the ``health`` reply across the process boundary.
+
 Protocol (local trusted unix socket): length-prefixed frames, each a
 JSON header {op, arrays: [{name, dtype, shape}], ...params} followed by
-the raw array bytes in order. Ops: ping, pagerank, shutdown.
+the raw array bytes in order. Ops: ping, health, probe, pagerank,
+shutdown.
 
 Reference analog: none directly — the reference is a resident C++
 daemon by construction (src/memgraph.cpp); this component restores that
@@ -22,7 +48,9 @@ property for out-of-process analytics callers.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import signal
 import socket
 import struct
 import subprocess
@@ -31,10 +59,138 @@ import time
 
 import numpy as np
 
+from ..observability.metrics import global_metrics
+from ..utils.devicefault import classify_device_error, device_fault_point
+from ..utils.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
+
 DEFAULT_SOCKET = os.environ.get(
     "MEMGRAPH_TPU_KERNEL_SERVER_SOCKET",
     os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), ".kernel_server.sock"))
+
+#: typed per-dispatch outcomes (the taxonomy tests assert against)
+DISPATCH_OUTCOMES = ("completed", "deadline_exceeded", "device_error",
+                     "oom", "shed", "invalid")
+
+
+def _resolve_hbm_budget() -> int:
+    """Admission budget: env override, else 75% of the device's reported
+    byte limit, else a conservative 4 GiB."""
+    env = os.environ.get("MEMGRAPH_TPU_HBM_BUDGET_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            log.warning("bad MEMGRAPH_TPU_HBM_BUDGET_BYTES=%r; ignoring",
+                        env)
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit") or 0)
+        if limit > 0:
+            return int(limit * 0.75)
+    except Exception as e:  # noqa: BLE001 — backends without memory_stats
+        log.debug("no device memory stats (%s); using default budget", e)
+    return 4 << 30
+
+
+def _resolve_checkpoint_every() -> int:
+    try:
+        return max(0, int(os.environ.get(
+            "MEMGRAPH_TPU_CHECKPOINT_EVERY", "16")))
+    except ValueError:
+        return 16
+
+
+def _estimate_request_bytes(header: dict, arrays: dict) -> int:
+    """Request HBM footprint estimate: the wire arrays land on device in
+    up to 3 forms (COO staging, CSC copy, per-edge multipliers) plus
+    ~8 O(n) float vectors of iteration state."""
+    edge_bytes = sum(int(np.prod(a.shape, dtype=np.int64))
+                     * a.dtype.itemsize for a in arrays.values())
+    n_nodes = int(header.get("n_nodes") or 0)
+    return 3 * edge_bytes + n_nodes * 4 * 8
+
+
+def probe_device():
+    """Tiny end-to-end device check: a compiled matmul with a host
+    transfer forcing completion. Shared by the server warm-up, the
+    ``probe`` op, and bench.py's probe stage — and guarded by the
+    device fault point so probe failures are injectable too.
+    Returns (checksum, platform)."""
+    device_fault_point()
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128), jnp.float32)
+    return float((x @ x).sum()), jax.devices()[0].platform
+
+
+# --------------------------------------------------------------------------
+# typed client errors (one per server outcome)
+# --------------------------------------------------------------------------
+
+
+class KernelServerError(RuntimeError):
+    """Base kernel-server failure; carries the typed outcome."""
+
+    def __init__(self, message: str, outcome: str = "invalid",
+                 retryable: bool = False) -> None:
+        super().__init__(message)
+        self.outcome = outcome
+        self.retryable = retryable
+
+
+class AdmissionRejected(KernelServerError):
+    """The HBM admission guard shed this request (outcome "shed").
+    Deliberately NOT retryable: the same request against the same budget
+    sheds again — resize the request or raise the budget."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, outcome="shed", retryable=False)
+
+
+class KernelOom(KernelServerError):
+    """Device memory exhausted during dispatch (outcome "oom")."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, outcome="oom", retryable=False)
+
+
+class KernelDeviceError(KernelServerError):
+    """Device-side dispatch failure (outcome "device_error"); the op is
+    pure, so idempotent retry is safe."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, outcome="device_error", retryable=True)
+
+
+class KernelDeadlineExceeded(KernelServerError):
+    """The dispatch missed its deadline (outcome "deadline_exceeded") —
+    possibly a wedged device; the supervisor health-checks on this."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, outcome="deadline_exceeded",
+                         retryable=True)
+
+
+_OUTCOME_ERRORS = {
+    "shed": AdmissionRejected,
+    "oom": KernelOom,
+    "device_error": KernelDeviceError,
+    "deadline_exceeded": KernelDeadlineExceeded,
+}
+
+
+def _raise_for_reply(header: dict):
+    outcome = header.get("outcome", "invalid")
+    cls = _OUTCOME_ERRORS.get(outcome)
+    msg = header.get("error", "kernel server error")
+    if cls is not None:
+        raise cls(msg)
+    raise KernelServerError(msg, outcome=outcome,
+                            retryable=bool(header.get("retryable")))
 
 
 # --------------------------------------------------------------------------
@@ -85,13 +241,27 @@ def _recv_msg(sock: socket.socket):
 
 class KernelServer:
     """One thread per connection; device dispatch serialized by a lock
-    (one chip — concurrent kernels would just queue anyway)."""
+    (one chip — concurrent kernels would just queue anyway). Every
+    dispatch runs on a worker thread under a per-request deadline: a
+    wedged device costs the caller a typed ``deadline_exceeded``, never
+    a silent hang, and the ``health`` op exposes the overdue dispatch so
+    the client-side supervisor can restart the process."""
 
     def __init__(self, socket_path: str = DEFAULT_SOCKET,
-                 idle_timeout_s: float = 0.0) -> None:
+                 idle_timeout_s: float = 0.0,
+                 hbm_budget_bytes: int | None = None,
+                 checkpoint_every: int | None = None,
+                 wedge_after_s: float | None = None) -> None:
         import threading
         self.socket_path = socket_path
         self.idle_timeout_s = idle_timeout_s
+        self.hbm_budget_bytes = hbm_budget_bytes \
+            if hbm_budget_bytes is not None else _resolve_hbm_budget()
+        self.checkpoint_every = checkpoint_every \
+            if checkpoint_every is not None else _resolve_checkpoint_every()
+        self.wedge_after_s = wedge_after_s if wedge_after_s is not None \
+            else float(os.environ.get(
+                "MEMGRAPH_TPU_KS_WEDGE_AFTER_S", "60"))
         self._graphs: dict = {}      # graph_key -> DeviceGraph
         from ..utils.locks import tracked_lock
         from ..utils.sanitize import shared_field
@@ -101,8 +271,17 @@ class KernelServer:
         # idle-timeout check — a leaf lock, never held across dispatch
         self._activity_lock = tracked_lock("KernelServer._activity_lock")
         self._last_activity = time.monotonic()
+        # dispatch bookkeeping for the health op — a leaf lock too: the
+        # health reply must never wait behind a wedged dispatch
+        self._stats_lock = tracked_lock("KernelServer._stats_lock")
+        self._active: dict[int, tuple[float, float | None]] = {}
+        self._dispatch_seq = 0
+        self._graphs_cached = 0
+        self._started = time.monotonic()
+        self._platform = "unknown"
         self._sock_ino = None        # inode of OUR bound socket path
-        shared_field(self, "_graphs", "_last_activity")
+        shared_field(self, "_graphs", "_last_activity", "_active",
+                     "_dispatch_seq", "_graphs_cached", "_platform")
 
     def _touch_activity(self) -> None:
         from ..utils.sanitize import shared_write
@@ -118,10 +297,11 @@ class KernelServer:
 
     def _warm(self) -> None:
         """Touch the device so the first client request pays no init."""
-        import jax
-        import jax.numpy as jnp
-        x = jnp.ones((128, 128), jnp.float32)
-        float((x @ x).sum())
+        from ..utils.sanitize import shared_write
+        _, platform = probe_device()
+        with self._stats_lock:
+            shared_write(self, "_platform")
+            self._platform = platform
 
     def serve_forever(self) -> None:
         import errno
@@ -192,39 +372,168 @@ class KernelServer:
                 try:
                     if op == "ping":
                         _send_msg(conn, {"ok": True, "pid": os.getpid()})
+                    elif op == "health":
+                        _send_msg(conn, self._health_reply())
                     elif op == "shutdown":
                         _send_msg(conn, {"ok": True})
                         self._shutdown.set()
                         return
-                    elif op == "pagerank":
-                        # device compute under the dispatch lock; the
-                        # reply ships AFTER release — a slow client must
-                        # not hold up every other client's dispatch
-                        with self._dispatch_lock:
-                            reply, out_arrays = self._op_pagerank(
-                                header, arrays)
+                    elif op in ("pagerank", "probe"):
+                        # supervised: admission guard + worker thread +
+                        # per-request deadline; the reply ships AFTER
+                        # the dispatch lock is released — a slow client
+                        # must not hold up other clients' dispatches
+                        reply, out_arrays = self._supervised(op, header,
+                                                             arrays)
                         _send_msg(conn, reply, out_arrays)
                     else:
-                        _send_msg(conn, {"ok": False,
+                        _send_msg(conn, {"ok": False, "outcome": "invalid",
                                          "error": f"unknown op {op!r}"})
                 except Exception as e:  # noqa: BLE001 — report, continue
                     try:
-                        _send_msg(conn, {"ok": False, "error": str(e)})
+                        _send_msg(conn, {"ok": False, "outcome": "invalid",
+                                         "error": str(e)})
                     except OSError:
                         return
         finally:
             conn.close()
+
+    # --- supervised dispatch ----------------------------------------------
+
+    def _count(self, outcome: str) -> None:
+        global_metrics.increment(f"kernel_server.dispatch.{outcome}_total")
+
+    def _supervised(self, op: str, header: dict, arrays: dict):
+        """Admission guard → worker-thread dispatch → typed outcome."""
+        import threading
+        from ..utils.sanitize import shared_write
+
+        est = _estimate_request_bytes(header, arrays)
+        if est > self.hbm_budget_bytes:
+            self._count("shed")
+            global_metrics.increment(
+                "kernel_server.admission_rejected_total")
+            log.warning(
+                "kernel_server: SHED %s request — estimated footprint "
+                "%d bytes exceeds HBM budget %d bytes", op, est,
+                self.hbm_budget_bytes)
+            return ({"ok": False, "outcome": "shed", "retryable": False,
+                     "error": f"AdmissionRejected: estimated footprint "
+                              f"{est} bytes exceeds HBM budget "
+                              f"{self.hbm_budget_bytes} bytes"}, None)
+
+        deadline_s = header.get("deadline_s")
+        deadline_s = float(deadline_s) if deadline_s else None
+        with self._stats_lock:
+            shared_write(self, "_dispatch_seq")
+            self._dispatch_seq += 1
+            did = self._dispatch_seq
+            self._active[did] = (time.monotonic(),
+                                 deadline_s or self.wedge_after_s)
+        box: dict = {}
+
+        def work():
+            try:
+                with self._dispatch_lock:
+                    device_fault_point()
+                    box["result"] = self._dispatch_op(op, header, arrays)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                box["exc"] = e
+            finally:
+                with self._stats_lock:
+                    shared_write(self, "_active")
+                    self._active.pop(did, None)
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"ks-dispatch-{did}")
+        t.start()
+        t.join(deadline_s)
+        if t.is_alive():
+            # the dispatch is overdue; it stays in _active, so the
+            # health op reports the server as wedged until it finishes
+            self._count("deadline_exceeded")
+            log.warning("kernel_server: dispatch %d (%s) exceeded its "
+                        "%.3fs deadline — device possibly wedged",
+                        did, op, deadline_s)
+            return ({"ok": False, "outcome": "deadline_exceeded",
+                     "retryable": True,
+                     "error": f"dispatch exceeded {deadline_s}s "
+                              "deadline"}, None)
+        if "exc" in box:
+            e = box["exc"]
+            kind = classify_device_error(e)
+            if kind == "oom":
+                outcome, retryable = "oom", False
+            elif kind in ("device_error", "device_lost"):
+                outcome, retryable = "device_error", True
+            else:
+                outcome, retryable = "invalid", False
+            self._count(outcome)
+            log.warning("kernel_server: dispatch %d (%s) failed "
+                        "[%s]: %s", did, op, outcome, e)
+            return ({"ok": False, "outcome": outcome,
+                     "retryable": retryable,
+                     "error": f"{type(e).__name__}: {e}"}, None)
+        reply, out_arrays = box["result"]
+        if reply.get("ok", True):
+            reply.setdefault("outcome", "completed")
+            self._count("completed")
+        else:
+            reply.setdefault("outcome", "invalid")
+            self._count("invalid")
+        return reply, out_arrays
+
+    def _dispatch_op(self, op: str, header: dict, arrays: dict):
+        """Runs under _dispatch_lock on the worker thread."""
+        if op == "probe":
+            checksum, platform = probe_device()
+            return ({"ok": True, "platform": platform,
+                     "sum": checksum}, None)
+        return self._op_pagerank(header, arrays)
+
+    def _health_reply(self) -> dict:
+        """Liveness + wedge detection + counters; NEVER touches the
+        dispatch lock (a wedged dispatch must not wedge health)."""
+        from ..utils.sanitize import shared_read
+        now = time.monotonic()
+        with self._stats_lock:
+            shared_read(self, "_active")
+            entries = list(self._active.values())
+            cached = self._graphs_cached
+            platform = self._platform
+        ages = [now - t0 for t0, _dl in entries]
+        wedged = any(dl is not None and now - t0 > dl
+                     for t0, dl in entries)
+        counters = {name: value for name, _kind, value
+                    in global_metrics.snapshot()
+                    if name.startswith(("kernel_server.", "analytics."))}
+        return {"ok": True, "pid": os.getpid(),
+                "uptime_s": round(now - self._started, 3),
+                "in_flight": len(entries),
+                "oldest_dispatch_s": round(max(ages, default=0.0), 3),
+                "wedged": wedged,
+                "graphs_cached": cached,
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "checkpoint_every": self.checkpoint_every,
+                "wedge_after_s": self.wedge_after_s,
+                "platform": platform,
+                "counters": counters}
 
     MAX_CACHED_GRAPHS = 8     # LRU cap: the daemon is long-lived and a
     #                           DeviceGraph pins device HBM + host arrays
 
     def _op_pagerank(self, header, arrays):
         """Runs under _dispatch_lock; returns (reply_header,
-        reply_arrays) for the caller to ship outside the lock."""
-        from ..ops import pagerank as pr
+        reply_arrays) for the caller to ship outside the lock. Routes
+        through the RESUMABLE mesh entry point (mesh-of-1 unless
+        MEMGRAPH_TPU_MESH_DEVICES configures a wider mesh), so a device
+        fault mid-run redoes at most checkpoint_every iterations."""
         from ..ops.csr import from_coo
+        from ..parallel import analytics
+        from ..parallel.mesh import analytics_mesh, get_mesh_context
+        from ..utils.sanitize import shared_write
         key = header.get("graph_key")
-        # mglint: disable=MG006 — the dispatcher (_serve_conn) holds _dispatch_lock across this whole handler; intraprocedural analysis cannot see caller locks
+        # mglint: disable=MG006 — the dispatcher (_supervised worker) holds _dispatch_lock across this whole handler; intraprocedural analysis cannot see caller locks
         g = self._graphs.pop(key, None) if key else None
         if g is not None:
             self._graphs[key] = g              # re-insert: LRU refresh
@@ -241,10 +550,16 @@ class KernelServer:
                 self._graphs[key] = g
                 while len(self._graphs) > self.MAX_CACHED_GRAPHS:  # mglint: disable=MG006 — under caller's _dispatch_lock
                     self._graphs.pop(next(iter(self._graphs)))  # mglint: disable=MG006,MG007 — under caller's _dispatch_lock
-        ranks, err, iters = pr.pagerank(
-            g, damping=header.get("damping", 0.85),
+                with self._stats_lock:
+                    shared_write(self, "_graphs_cached")
+                    self._graphs_cached = len(self._graphs)  # mglint: disable=MG006 — len snapshot for health; insert path holds _dispatch_lock
+        ctx = analytics_mesh() or get_mesh_context(1)
+        ranks, err, iters = analytics.pagerank_mesh(
+            g, ctx, damping=header.get("damping", 0.85),
             max_iterations=header.get("max_iterations", 100),
-            tol=header.get("tol", 1e-6))
+            tol=header.get("tol", 1e-6),
+            checkpoint_every=self.checkpoint_every,
+            job=f"kernel_server:pagerank:{key}" if key else None)
         return ({"ok": True, "err": float(err), "iters": int(iters)},
                 {"ranks": np.asarray(ranks, dtype=np.float32)})
 
@@ -261,6 +576,9 @@ class KernelClient:
         self._sock.settimeout(timeout)
         self._sock.connect(socket_path)
 
+    def settimeout(self, timeout: float | None) -> None:
+        self._sock.settimeout(timeout)
+
     def call(self, header: dict, arrays=None):
         _send_msg(self._sock, header, arrays)
         return _recv_msg(self._sock)
@@ -272,18 +590,30 @@ class KernelClient:
         except (OSError, ConnectionError):
             return False
 
+    def health(self) -> dict:
+        h, _ = self.call({"op": "health"})
+        return h
+
+    def probe(self) -> dict:
+        """Typed device probe through the resident runtime."""
+        h, _ = self.call({"op": "probe"})
+        return h
+
     def pagerank(self, src=None, dst=None, weights=None, n_nodes=None,
-                 graph_key=None, **params):
+                 graph_key=None, deadline_s=None, **params):
         arrays = {}
         if src is not None:
             arrays["src"] = np.asarray(src, dtype=np.int64)
             arrays["dst"] = np.asarray(dst, dtype=np.int64)
             if weights is not None:
                 arrays["weights"] = np.asarray(weights, dtype=np.float32)
-        h, out = self.call({"op": "pagerank", "graph_key": graph_key,
-                            "n_nodes": n_nodes, **params}, arrays)
+        header = {"op": "pagerank", "graph_key": graph_key,
+                  "n_nodes": n_nodes, **params}
+        if deadline_s is not None:
+            header["deadline_s"] = deadline_s
+        h, out = self.call(header, arrays)
         if not h.get("ok"):
-            raise RuntimeError(h.get("error", "kernel server error"))
+            _raise_for_reply(h)
         return out["ranks"], h["err"], h["iters"]
 
     def shutdown(self) -> None:
@@ -294,6 +624,242 @@ class KernelClient:
 
     def close(self) -> None:
         self._sock.close()
+
+
+# --------------------------------------------------------------------------
+# client-side supervisor
+# --------------------------------------------------------------------------
+
+class SupervisedKernelClient:
+    """Supervised access to the resident kernel server.
+
+    Wraps :class:`KernelClient` with the client half of the resilience
+    contract:
+
+      * requests carry a per-request ``deadline_s`` and retry under a
+        shared :class:`RetryPolicy` (per-attempt timeout + overall
+        deadline) — but ONLY idempotent ones; non-idempotent calls
+        surface the first typed failure;
+      * connection loss (the daemon died — e.g. device.lost killed it)
+        respawns the server via :func:`ensure_server` and retries;
+      * ``check_once()`` (and the optional background health loop)
+        polls the ``health`` op and RESTARTS a wedged or unreachable
+        server process — SIGKILL + respawn; the daemon's stale-socket
+        reclaim logic makes that safe;
+      * typed non-retryable outcomes (AdmissionRejected, KernelOom)
+        propagate immediately.
+    """
+
+    def __init__(self, socket_path: str = DEFAULT_SOCKET,
+                 retry: RetryPolicy | None = None,
+                 spawn_timeout_s: float = 120.0,
+                 idle_timeout_s: float = 900.0,
+                 deadline_s: float | None = None,
+                 spawn: bool = True) -> None:
+        import threading
+        from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
+        self.socket_path = socket_path
+        self.retry = retry or RetryPolicy(
+            base_delay=0.2, max_delay=2.0, max_retries=4,
+            attempt_timeout=300.0)
+        self.spawn_timeout_s = spawn_timeout_s
+        self.idle_timeout_s = idle_timeout_s
+        self.deadline_s = deadline_s
+        self.spawn = spawn
+        # leaf lock guarding the (client, pid) pair: swapped by the
+        # caller thread AND the health loop; network I/O always happens
+        # OUTSIDE it
+        self._state_lock = tracked_lock("SupervisedKernelClient._state_lock")
+        self._client: KernelClient | None = None
+        self._pid: int | None = None
+        self._stop = threading.Event()
+        self._health_thread = None
+        shared_field(self, "_client", "_pid")
+
+    # --- connection management ---------------------------------------------
+
+    def _install(self, client: KernelClient | None):
+        from ..utils.sanitize import shared_write
+        with self._state_lock:
+            shared_write(self, "_client")
+            old, self._client = self._client, client
+        if old is not None:
+            try:
+                old.close()
+            except OSError as e:
+                log.debug("closing stale kernel client: %s", e)
+        return client
+
+    def _current(self) -> KernelClient | None:
+        from ..utils.sanitize import shared_read
+        with self._state_lock:
+            shared_read(self, "_client")
+            return self._client
+
+    def _set_pid(self, pid: int | None) -> None:
+        from ..utils.sanitize import shared_write
+        with self._state_lock:
+            shared_write(self, "_pid")
+            self._pid = pid
+
+    def _get_pid(self) -> int | None:
+        from ..utils.sanitize import shared_read
+        with self._state_lock:
+            shared_read(self, "_pid")
+            return self._pid
+
+    def _connect(self) -> KernelClient:
+        c = self._current()
+        if c is not None:
+            return c
+        timeout = self.retry.attempt_timeout or 300.0
+        if self.spawn:
+            c = ensure_server(self.socket_path,
+                              spawn_timeout_s=self.spawn_timeout_s,
+                              idle_timeout_s=self.idle_timeout_s)
+            if c is None:
+                raise ConnectionError(
+                    "kernel server spawn starved (no responder within "
+                    f"{self.spawn_timeout_s}s)")
+            c.settimeout(timeout)
+        else:
+            c = KernelClient(self.socket_path, timeout=timeout)
+        try:
+            h, _ = c.call({"op": "ping"})
+            self._set_pid(h.get("pid"))
+        except (OSError, ConnectionError) as e:
+            log.debug("post-connect ping failed: %s", e)
+        return self._install(c)
+
+    def _drop(self) -> None:
+        self._install(None)
+
+    # --- supervision --------------------------------------------------------
+
+    def health(self, timeout: float = 5.0) -> dict | None:
+        """The daemon's health reply over a FRESH connection (a wedged
+        request stream must not block the health probe), or None when
+        nothing answers."""
+        try:
+            c = KernelClient(self.socket_path, timeout=timeout)
+        except OSError:
+            return None
+        try:
+            return c.health()
+        except (OSError, ConnectionError):
+            return None
+        finally:
+            try:
+                c.close()
+            except OSError as e:
+                log.debug("closing health probe connection: %s", e)
+
+    def check_once(self) -> str:
+        """One supervision round: health-check, restart when wedged or
+        unreachable. Returns "ok" or "restarted"."""
+        global_metrics.increment(
+            "kernel_server.supervisor.health_checks_total")
+        h = self.health()
+        if h is None:
+            self.restart_server(reason="unreachable")
+            return "restarted"
+        if h.get("wedged"):
+            self.restart_server(reason="wedged", pid=h.get("pid"))
+            return "restarted"
+        self._set_pid(h.get("pid"))
+        return "ok"
+
+    def restart_server(self, reason: str = "manual",
+                       pid: int | None = None) -> None:
+        """Kill the (wedged / device-lost) daemon and let the next call
+        respawn it. The daemon's probe-then-bind + stale-socket reclaim
+        makes the SIGKILL safe: the successor reclaims the path."""
+        pid = pid or self._get_pid()
+        self._drop()
+        self._set_pid(None)
+        if pid and pid != os.getpid():
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError) as e:
+                log.debug("kernel server pid %s already gone: %s", pid, e)
+        global_metrics.increment("kernel_server.supervisor.restarts_total")
+        log.warning("kernel_server supervisor: restarting server "
+                    "(reason=%s pid=%s)", reason, pid)
+
+    def start_health_loop(self, interval_s: float = 5.0) -> None:
+        """Background supervision: health-check every interval_s,
+        restarting a wedged/lost daemon. Idempotent."""
+        import threading
+        if self._health_thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.check_once()
+                except Exception:  # noqa: BLE001 — supervision must survive
+                    log.exception("kernel_server supervisor health "
+                                  "check failed")
+
+        self._health_thread = threading.Thread(
+            target=loop, daemon=True, name="ks-supervisor")
+        self._health_thread.start()
+
+    # --- supervised calls ---------------------------------------------------
+
+    def pagerank(self, src=None, dst=None, weights=None, n_nodes=None,
+                 graph_key=None, idempotent: bool = True,
+                 deadline_s: float | None = None, **params):
+        """PageRank with supervised retries. Pure computation ⇒
+        idempotent by default; callers piping through side-effecting
+        wrappers pass idempotent=False and get fail-fast semantics."""
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        last: Exception | None = None
+        for _attempt in self.retry.attempts():
+            try:
+                c = self._connect()
+                return c.pagerank(src=src, dst=dst, weights=weights,
+                                  n_nodes=n_nodes, graph_key=graph_key,
+                                  deadline_s=deadline_s, **params)
+            except (AdmissionRejected, KernelOom):
+                # deterministic against this budget/graph: retry is noise
+                raise
+            except KernelDeadlineExceeded as e:
+                last = e
+                if not idempotent:
+                    raise
+                global_metrics.increment(
+                    "kernel_server.client.retries_total")
+                self.check_once()    # a wedged server gets restarted here
+            except KernelDeviceError as e:
+                last = e
+                if not idempotent:
+                    raise
+                global_metrics.increment(
+                    "kernel_server.client.retries_total")
+            except (ConnectionError, OSError) as e:
+                # daemon gone (device.lost kill) or socket timed out:
+                # drop the connection; _connect respawns when allowed
+                last = e
+                self._drop()
+                if not idempotent:
+                    raise
+                global_metrics.increment(
+                    "kernel_server.client.retries_total")
+        raise KernelServerError(
+            f"kernel request failed after {self.retry.max_retries + 1} "
+            f"supervised attempts: {last}",
+            outcome=getattr(last, "outcome", "invalid"),
+            retryable=False) from last
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=10)
+            self._health_thread = None
+        self._drop()
 
 
 def ensure_server(socket_path: str = DEFAULT_SOCKET,

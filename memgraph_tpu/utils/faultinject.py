@@ -58,7 +58,35 @@ KNOWN_POINTS = (
     "raft.rpc",        # RaftNode._call_peer ("drop" = RPC lost)
     "kvstore.put",     # KVStore.put, before the sqlite write
     "mgmt.rpc",        # coordination.mgmt_call ("drop" = mgmt RPC lost)
+    # --- device fault family (utils/devicefault.py wraps these into
+    # typed XLA-shaped errors at every device dispatch boundary) ---
+    "device.call",     # dispatch raises XlaRuntimeError (compile/run fail)
+    "device.oom",      # dispatch raises RESOURCE_EXHAUSTED (HBM OOM)
+    "device.hang",     # arm with delay:<sec> — dispatch stalls past its
+    #                    deadline (the wedge class supervision contains)
+    "device.lost",     # backend gone: arm "raise" for an in-process
+    #                    DeviceLostError, "kill" to take down the whole
+    #                    process (the resident kernel-server daemon case)
 )
+
+#: device-plane nemesis ops (tools/mgchaos device schedules). Same
+#: MG005-style contract as NEMESIS_OPS, but these arm the scalar
+#: ``device.*`` fault points above instead of installing link rules:
+#: every op here must map to a registered device point AND be exercised
+#: by the seeded device sweep (tests/test_device_resilience.py).
+DEVICE_NEMESIS_OPS = (
+    "device_call",     # arms device.call  (raise)
+    "device_oom",      # arms device.oom   (raise)
+    "device_hang",     # arms device.hang  (delay)
+    "device_lost",     # arms device.lost  (raise / kill)
+)
+
+
+def device_point_for_op(op: str) -> str:
+    """Map a DEVICE_NEMESIS_OPS entry to its scalar fault point."""
+    if op not in DEVICE_NEMESIS_OPS:
+        raise ValueError(f"unknown device nemesis op {op!r}")
+    return "device." + op[len("device_"):]
 
 #: the catalog of nemesis operations tools/mgchaos schedules (the
 #: MG005-style coverage contract: every op here must map to a live
@@ -157,6 +185,15 @@ def arm_from_string(text: str) -> None:
         with _LOCK:
             _SPECS.setdefault(spec.point, []).append(spec)
             _ARMED = True
+
+
+def disarm(point: str) -> None:
+    """Disarm one fault point (nemesis heal); hit counters are kept so
+    later re-arming at a seeded hit number stays byte-replayable."""
+    global _ARMED
+    with _LOCK:
+        _SPECS.pop(point, None)
+        _ARMED = bool(_SPECS)
 
 
 def reset(reload_env: bool = False) -> None:

@@ -1,10 +1,21 @@
-"""Shared retry/backoff policy for cluster networking.
+"""Shared retry/backoff policy for cluster networking and device calls.
 
 One policy object replaces the ad-hoc except-and-mark-invalid blocks
 that used to be scattered across the replication client, the snapshot
 download path, and reconnect loops: exponential backoff with a cap and
 deterministic (seedable) jitter, plus a budget after which the caller
 degrades instead of retrying forever.
+
+Deadline semantics (r12): a policy can additionally carry
+
+  * ``attempt_timeout`` — the per-attempt budget. Callers making socket
+    or kernel-server calls use it as the per-call timeout instead of a
+    scattered constant (``attempt_timeout_at`` clips it to whatever is
+    left of the overall deadline, so the final attempt cannot overshoot).
+  * ``deadline`` — the overall wall-clock budget across ALL attempts
+    (including backoff sleeps). ``attempts()`` and ``call()`` stop
+    retrying once the next backoff would cross it; the caller sees the
+    last real exception, not a synthetic timeout.
 """
 
 from __future__ import annotations
@@ -24,12 +35,16 @@ class RetryPolicy:
 
     def __init__(self, base_delay: float = 0.05, factor: float = 2.0,
                  max_delay: float = 2.0, max_retries: int = 5,
-                 jitter: float = 0.2, seed: int | None = None) -> None:
+                 jitter: float = 0.2, seed: int | None = None,
+                 attempt_timeout: float | None = None,
+                 deadline: float | None = None) -> None:
         self.base_delay = base_delay
         self.factor = factor
         self.max_delay = max_delay
         self.max_retries = max_retries
         self.jitter = jitter
+        self.attempt_timeout = attempt_timeout
+        self.deadline = deadline
         self._rng = random.Random(seed)
 
     def delay_for(self, attempt: int) -> float:
@@ -44,10 +59,55 @@ class RetryPolicy:
         for attempt in range(self.max_retries):
             yield self.delay_for(attempt)
 
+    def remaining(self, t0: float) -> float | None:
+        """Seconds left of the overall deadline started at monotonic t0,
+        or None when the policy has no deadline. Floors at 0."""
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - (time.monotonic() - t0))
+
+    def attempt_timeout_at(self, t0: float) -> float | None:
+        """Per-attempt timeout for an attempt starting now: the policy's
+        attempt_timeout clipped to what the overall deadline (started at
+        monotonic t0) still allows; None = unbounded."""
+        left = self.remaining(t0)
+        if left is None:
+            return self.attempt_timeout
+        if self.attempt_timeout is None:
+            return left
+        return min(self.attempt_timeout, left)
+
+    def attempts(self) -> Iterator[int]:
+        """Yield attempt numbers 0..max_retries, sleeping the backoff
+        BETWEEN yields and honoring the overall deadline: iteration ends
+        early (no sleep) once the next backoff would cross it. The
+        caller's loop pattern::
+
+            last = None
+            for attempt in policy.attempts():
+                try:
+                    return op()
+                except RetryableError as e:
+                    last = e
+            raise last   # budget or deadline exhausted
+        """
+        t0 = time.monotonic()
+        for attempt in range(self.max_retries + 1):
+            yield attempt
+            if attempt >= self.max_retries:
+                return
+            delay = self.delay_for(attempt)
+            left = self.remaining(t0)
+            if left is not None and delay >= left:
+                return
+            time.sleep(delay)
+
     def call(self, fn: Callable, *, retry_on=(ConnectionError, OSError),
              on_retry: Callable | None = None):
         """Run fn(), retrying on `retry_on` with backoff; re-raises the
-        last exception once the budget is exhausted."""
+        last exception once the retry budget OR the overall deadline is
+        exhausted."""
+        t0 = time.monotonic()
         attempt = 0
         while True:
             try:
@@ -55,7 +115,11 @@ class RetryPolicy:
             except retry_on as e:
                 if attempt >= self.max_retries:
                     raise
+                delay = self.delay_for(attempt)
+                left = self.remaining(t0)
+                if left is not None and delay >= left:
+                    raise
                 if on_retry is not None:
                     on_retry(attempt, e)
-                time.sleep(self.delay_for(attempt))
+                time.sleep(delay)
                 attempt += 1

@@ -1,4 +1,5 @@
-"""MG005 fixture fire sites: one wired, one unregistered typo."""
+"""MG005 fixture fire sites: one wired, one unregistered typo, plus the
+device-family points (so only the WIRING gaps fire, not fault-dead)."""
 
 from .utils import faultinject as FI
 
@@ -6,3 +7,8 @@ from .utils import faultinject as FI
 def do_write():
     FI.fire("wired.point")
     FI.fire("wired.typo")      # MG005: not in KNOWN_POINTS
+
+
+def do_dispatch():
+    FI.fire("device.wired")
+    FI.fire("device.orphan")   # fired, but no op schedules it

@@ -1,8 +1,16 @@
-"""MG005 fixture fault registry: one wired point, one dead one."""
+"""MG005 fixture fault registry: one wired point, one dead one, plus
+the device-nemesis wiring cases (r12)."""
 
 KNOWN_POINTS = (
     "wired.point",      # fired below in user.py
     "dead.point",       # MG005: registered but never fired
+    "device.wired",     # wired: op below + fired in user.py
+    "device.orphan",    # MG005: no DEVICE_NEMESIS_OPS entry backs it
+)
+
+DEVICE_NEMESIS_OPS = (
+    "device_wired",     # wired: device.wired above
+    "device_ghost",     # MG005: no device.ghost fault point
 )
 
 

@@ -1,0 +1,28 @@
+"""MG002 fixture: device dispatch under a server lock (plus a clean
+decoy that ships the dispatch outside the critical section)."""
+
+import threading
+
+import jax
+
+from memgraph_tpu.utils.devicefault import device_fault_point
+
+
+class Dispatcher:
+    def __init__(self, graph):
+        self._dispatch_lock = threading.Lock()
+        self._graph = graph
+
+    def bad_put(self, arr):
+        with self._dispatch_lock:
+            return jax.device_put(arr)   # MG002: device dispatch under lock
+
+    def bad_boundary(self):
+        with self._dispatch_lock:
+            device_fault_point()         # MG002: compiled-call boundary
+
+    def good(self, arr):
+        with self._dispatch_lock:
+            g = self._graph
+        _ = g
+        return jax.device_put(arr)       # outside the lock: clean

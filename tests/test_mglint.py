@@ -89,7 +89,14 @@ def test_mg001_fires_on_inversion_only():
 def test_mg002_fires_under_lock_only():
     result = _run(["tests/lint_fixtures"], only={"MG002"})
     hits = _hits(result, "MG002")
-    assert hits == [("mg002_blocking.py", 14)], hits
+    assert ("mg002_blocking.py", 14) in hits            # fsync under lock
+    # r12: device dispatches under a server lock are the wedge class
+    # the kernel-server supervision contains — both the raw device_put
+    # and the compiled-call fault boundary fire; the decoy that ships
+    # the dispatch outside the lock stays silent
+    assert ("mg002_device_dispatch.py", 18) in hits     # jax.device_put
+    assert ("mg002_device_dispatch.py", 22) in hits     # fault boundary
+    assert len(hits) == 3, hits
 
 
 def test_mg003_fires_on_silent_swallow_only():
@@ -116,7 +123,12 @@ def test_mg005_fires_on_coverage_gaps_only():
     assert "wal-op:OP_ORPHAN" in msgs
     assert "fault-unregistered:wired.typo" in msgs
     assert "fault-dead:dead.point" in msgs
-    assert len(msgs) == 3, msgs              # OP_WIRED is fully covered
+    # r12 device-nemesis wiring: an op without a fault point and a
+    # device point no op can schedule both fire; the fully-wired
+    # device_wired/device.wired pair stays silent
+    assert "device-nemesis-dead:device_ghost" in msgs
+    assert "device-point-unscheduled:device.orphan" in msgs
+    assert len(msgs) == 5, msgs              # OP_WIRED is fully covered
 
 
 def test_mg006_fires_on_unguarded_access_only():

@@ -215,15 +215,20 @@ def _collectives(compiled_text: str) -> list:
 
 
 def test_pagerank_exactly_one_collective_per_iteration(graph, ctx8):
-    """The WHOLE compiled program contains exactly one cross-device
-    collective — the fused psum_scatter inside the while body. Setup
-    (out-weights, dangling mask) and the convergence check add none."""
+    """The WHOLE compiled CHUNK program contains exactly one
+    cross-device collective — the fused psum_scatter inside the while
+    body. Setup (out-weights, dangling mask), the convergence check AND
+    the r12 chunk-carry plumbing (checkpoint/resume) add none."""
     from memgraph_tpu.parallel.distributed import _pc_pagerank_build
     scsr = csr.shard_csr(graph, ctx8)
-    fn = _pc_pagerank_build(ctx8, scsr.block, scsr.n_shards, 100)
+    fn = _pc_pagerank_build(ctx8, scsr.block, scsr.n_shards)
+    rank0 = np.zeros(scsr.n_pad2, dtype=np.float32)
+    lerr0 = np.zeros(scsr.n_shards, dtype=np.float32)
     txt = fn.lower(scsr.src, scsr.dst, scsr.weights,
                    jnp.int32(scsr.n_nodes), jnp.float32(0.85),
-                   jnp.float32(1e-6)).compile().as_text()
+                   jnp.float32(1e-6), rank0, lerr0,
+                   jnp.float32(np.inf), jnp.int32(0),
+                   jnp.int32(100)).compile().as_text()
     colls = _collectives(txt)
     assert colls == ["reduce-scatter"], (
         f"expected exactly one reduce-scatter, got {colls}")
@@ -235,21 +240,24 @@ def test_pagerank_exactly_one_collective_per_iteration(graph, ctx8):
 def test_katz_exactly_one_collective_per_iteration(graph, ctx8):
     from memgraph_tpu.parallel.distributed import _pc_katz_build
     scsr = csr.shard_csr(graph, ctx8)
-    fn = _pc_katz_build(ctx8, scsr.block, scsr.n_shards, 100)
+    fn = _pc_katz_build(ctx8, scsr.block, scsr.n_shards)
+    x0 = np.zeros(scsr.n_pad2, dtype=np.float32)
     txt = fn.lower(scsr.src, scsr.dst, scsr.weights,
                    jnp.int32(scsr.n_nodes), jnp.float32(0.05),
                    jnp.float32(1.0), jnp.float32(1e-8),
-                   jnp.bool_(False)).compile().as_text()
+                   x0, jnp.float32(np.inf), jnp.int32(0),
+                   jnp.int32(100)).compile().as_text()
     assert _collectives(txt) == ["all-reduce"]
 
 
 def test_labelprop_exactly_one_collective_per_round(graph, ctx8):
     from memgraph_tpu.parallel.distributed import _pc_labelprop_build
     scsr = csr.shard_csr(graph, ctx8, by="dst", doubled=True)
-    fn = _pc_labelprop_build(ctx8, scsr.block, scsr.n_shards, scsr.per,
-                             30)
-    txt = fn.lower(scsr.src, scsr.dst, scsr.weights,
-                   jnp.float32(0.0)).compile().as_text()
+    fn = _pc_labelprop_build(ctx8, scsr.block, scsr.n_shards, scsr.per)
+    labels0 = np.arange(scsr.n_pad2, dtype=np.int32)
+    txt = fn.lower(scsr.src, scsr.dst, scsr.weights, jnp.float32(0.0),
+                   labels0, jnp.bool_(True), jnp.int32(0),
+                   jnp.int32(30)).compile().as_text()
     assert _collectives(txt) == ["all-reduce"]
 
 

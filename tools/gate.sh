@@ -47,6 +47,14 @@ stage "mgchaos seeded round + safety checker" \
 stage "mgchaos checker honesty (split-brain script)" \
     python -m tools.mgchaos honesty
 
+# 4b. device nemesis smoke: the full (fault x context) matrix — call/
+#     oom/hang/lost injected mid-pagerank, mid-kernel-request and during
+#     probe — through the supervised kernel plane; results must stay
+#     bit-exact, resumes bounded by k, and every typed outcome observed.
+#     Runs on the CPU backend (MGCHAOS_DEVICE_PLATFORM overrides).
+stage "mgchaos device nemesis smoke (supervised kernel plane)" \
+    python -m tools.mgchaos device-smoke --seed 0
+
 # 5. perf-regression gate: the newest BENCH_r*.json record must be
 #    non-degraded and within BASELINE.json's envelope (>15% regression
 #    fails). Hosts without an accelerator skip LOUDLY (exit 0): the

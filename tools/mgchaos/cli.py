@@ -1,9 +1,12 @@
 """mgchaos command line: `python -m tools.mgchaos <cmd>`.
 
-    run       one seeded chaos campaign (cluster + nemesis + checker)
-    sweep     N seeded campaigns; any violation fails the sweep
-    schedule  print a seed's nemesis schedule (byte-replayable)
-    check     offline-check a previously dumped history JSONL
+    run          one seeded chaos campaign (cluster + nemesis + checker)
+    sweep        N seeded campaigns; any violation fails the sweep
+    schedule     print a seed's nemesis schedule (byte-replayable)
+    check        offline-check a previously dumped history JSONL
+    device-smoke one seeded DEVICE nemesis round (accelerator faults
+                 through the supervised kernel plane; gate stage)
+    device-schedule  print a seed's device nemesis schedule
 
 Exit codes: 0 safe, 1 violations found, 2 bad invocation.
 """
@@ -11,6 +14,7 @@ Exit codes: 0 safe, 1 violations found, 2 bad invocation.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -51,7 +55,36 @@ def build_parser() -> argparse.ArgumentParser:
 
     ck = sub.add_parser("check", help="offline-check a history JSONL")
     ck.add_argument("history", help="path to a chaos history .jsonl")
+
+    ds = sub.add_parser(
+        "device-smoke",
+        help="seeded device nemesis round: accelerator faults "
+             "(call/oom/hang/lost) injected mid-pagerank, mid-kernel-"
+             "request and during probe, through the supervised plane")
+    ds.add_argument("--seed", type=int, default=0)
+    ds.add_argument("--rounds", type=int, default=None,
+                    help="truncate the (op x context) matrix "
+                         "(default: full matrix)")
+
+    dsch = sub.add_parser("device-schedule",
+                          help="print a seed's device nemesis schedule")
+    dsch.add_argument("--seed", type=int, default=0)
+    dsch.add_argument("--rounds", type=int, default=None)
     return p
+
+
+def _force_cpu_backend() -> None:
+    """Device-smoke runs on the CPU backend unless the operator opts a
+    real accelerator in: the stage validates the resilience machinery
+    deterministically, and the dev-gate must not touch (or hang on) a
+    tunneled device. Must run before jax is first imported."""
+    platform = os.environ.get("MGCHAOS_DEVICE_PLATFORM", "cpu")
+    os.environ["JAX_PLATFORMS"] = platform
+    flags = os.environ.get("XLA_FLAGS", "")
+    if platform == "cpu" and \
+            "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=2").strip()
 
 
 def _report(seed: int, violations: list[str], stats: dict) -> None:
@@ -118,6 +151,25 @@ def _cmd_schedule(args) -> int:
     return 0
 
 
+def _cmd_device_smoke(args) -> int:
+    _force_cpu_backend()
+    from .device import run_device_matrix
+    print(f"device nemesis smoke: seed={args.seed}")
+    failures, observed = run_device_matrix(args.seed, rounds=args.rounds)
+    for f in failures:
+        print(f"  FAILURE: {f}", file=sys.stderr)
+    ops = ", ".join(f"{k}→{sorted(v)}" for k, v in sorted(observed.items()))
+    print(f"device-smoke: {'UNSAFE' if failures else 'SAFE'} — "
+          f"{len(failures)} failure(s); outcomes: {ops}")
+    return 1 if failures else 0
+
+
+def _cmd_device_schedule(args) -> int:
+    from .device import device_schedule_text
+    sys.stdout.write(device_schedule_text(args.seed, args.rounds))
+    return 0
+
+
 def _cmd_check(args) -> int:
     from .checker import HistoryLog, check_cluster_history
     violations = check_cluster_history(HistoryLog.load(args.history))
@@ -130,4 +182,6 @@ def _cmd_check(args) -> int:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     return {"run": _cmd_run, "sweep": _cmd_sweep, "honesty": _cmd_honesty,
-            "schedule": _cmd_schedule, "check": _cmd_check}[args.cmd](args)
+            "schedule": _cmd_schedule, "check": _cmd_check,
+            "device-smoke": _cmd_device_smoke,
+            "device-schedule": _cmd_device_schedule}[args.cmd](args)

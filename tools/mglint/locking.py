@@ -51,6 +51,12 @@ _BLOCKING_DOTTED = {
     "subprocess.check_call": "subprocess",
     "subprocess.check_output": "subprocess",
     "socket.create_connection": "socket connect",
+    # device-plane dispatches (r12): a device call made while holding a
+    # storage/server lock is EXACTLY the wedge class the kernel-server
+    # supervision exists to contain — a hung tunnel or lost chip stalls
+    # every thread queued behind that lock
+    "jax.device_put": "device dispatch (device_put)",
+    "jax.block_until_ready": "device sync (block_until_ready)",
 }
 _BLOCKING_METHODS = {
     "sendall": "socket send", "sendto": "socket send",
@@ -60,8 +66,20 @@ _BLOCKING_METHODS = {
     # project replication protocol helpers (replication/protocol.py)
     "send_json": "socket send", "send_frame": "socket send",
     "recv_frame": "socket recv",
+    # device dispatch / sync entry points reachable as methods
+    "block_until_ready": "device sync (block_until_ready)",
+    "to_device": "device dispatch (to_device)",
+    "put_edge_blocks": "device dispatch (device_put)",
+    "put_replicated": "device dispatch (device_put)",
+    "device_fault_point": "device dispatch (fault boundary)",
 }
-_BLOCKING_NAMES = {"open": "file open", "sleep": "sleep"}
+_BLOCKING_NAMES = {"open": "file open", "sleep": "sleep",
+                   # kernel-server protocol helpers
+                   # (server/kernel_server.py framing)
+                   "_send_msg": "kernel-server send",
+                   "_recv_msg": "kernel-server recv",
+                   "device_fault_point": "device dispatch "
+                                         "(fault boundary)"}
 
 #: subsystems whose locks sit on commit / session critical paths
 CRITICAL_DIRS = ("storage", "replication", "server", "coordination")

@@ -1,6 +1,6 @@
 """MG002 — blocking-under-lock: no fsync / socket I/O / sleep /
-subprocess while a storage, replication, server, or coordination lock
-is held.
+subprocess / DEVICE DISPATCH while a storage, replication, server, or
+coordination lock is held.
 
 A commit-critical lock held across a syscall turns one slow disk or one
 wedged peer into a stall for every thread behind the lock (the
@@ -9,9 +9,19 @@ are deduplicated per (function, lock): one finding lists every blocking
 operation reachable inside that function's critical section, directly
 or through a resolved call chain.
 
+Device dispatches (r12) — `jax.device_put`, `.to_device()` /
+`put_edge_blocks` placements, compiled-call invocations entering
+through the `device_fault_point()` boundary, and kernel-server
+`_send_msg`/`_recv_msg` frames — are classified as blocking too: a
+hung device tunnel or a lost chip under a storage/server lock is
+EXACTLY the wedge class the kernel-server supervision (deadline +
+health-check restart) exists to contain, and it must never hide behind
+a lock the rest of the system waits on.
+
 Deliberate cases — e.g. the WAL writer's own append lock, whose entire
-purpose is serializing write+fsync — belong in the baseline with a
-justification, not silently ignored.
+purpose is serializing write+fsync, or the kernel server's dispatch
+lock, which is supervised by construction — belong in the baseline
+with a justification, not silently ignored.
 """
 
 from __future__ import annotations

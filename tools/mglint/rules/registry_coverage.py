@@ -82,6 +82,7 @@ def check(project: Project):
     findings.extend(_check_wal_opcodes(project))
     findings.extend(_check_fault_points(project))
     findings.extend(_check_nemesis_ops(project))
+    findings.extend(_check_device_nemesis_ops(project))
     findings.extend(_check_spmv_registry(project))
     return findings
 
@@ -208,6 +209,79 @@ def _check_nemesis_ops(project: Project):
                         "of NEMESIS_OPS — chaos campaigns can never "
                         "schedule it",
                 fingerprint=f"nemesis-unregistered:{name}"))
+    return findings
+
+
+def _collect_tuple_registry(fi_mod, name: str) -> dict[str, int]:
+    """{literal: lineno} for a module-level tuple/list-of-str registry."""
+    out: dict[str, int] = {}
+    for stmt in fi_mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            for el in stmt.value.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    out[el.value] = stmt.lineno
+    return out
+
+
+def _check_device_nemesis_ops(project: Project):
+    """DEVICE_NEMESIS_OPS ↔ device.* fault-point wiring, both ways.
+
+    Device nemesis ops arm SCALAR ``device.*`` points (there is no
+    net_* installer — the fault is in the accelerator, not a link), so
+    the contract is: every ``device_<x>`` op needs a registered
+    ``device.<x>`` point in KNOWN_POINTS, and every ``device.*`` point
+    must be reachable from a registered op — else chaos campaigns
+    "cover" device faults that can never fire, or a device point exists
+    the device sweep can never schedule. The fire-site half (every
+    registered point needs a live fire() site) already rides
+    ``_check_fault_points``; the dynamic half (the seeded device sweep
+    exercises every op) lives in tests/test_device_resilience.py.
+    """
+    fi_mod = project.by_suffix("utils/faultinject.py")
+    if fi_mod is None:
+        return []
+    ops = _collect_tuple_registry(fi_mod, "DEVICE_NEMESIS_OPS")
+    known = _collect_tuple_registry(fi_mod, "KNOWN_POINTS")
+    device_points = {p: ln for p, ln in known.items()
+                     if p.startswith("device.")}
+    if not ops and not device_points:
+        return []
+
+    def point_for(op: str) -> str:
+        return "device." + op[len("device_"):]
+
+    findings = []
+    for op, line in sorted(ops.items()):
+        if not op.startswith("device_"):
+            findings.append(Finding(
+                rule="MG005", path=fi_mod.rel_path, line=line, col=0,
+                symbol="DEVICE_NEMESIS_OPS",
+                message=f"device nemesis op {op!r} must be named "
+                        "device_<point>",
+                fingerprint=f"device-nemesis-misnamed:{op}"))
+            continue
+        if point_for(op) not in device_points:
+            findings.append(Finding(
+                rule="MG005", path=fi_mod.rel_path, line=line, col=0,
+                symbol="DEVICE_NEMESIS_OPS",
+                message=f"device nemesis op {op!r} has no registered "
+                        f"fault point {point_for(op)!r} — scheduling it "
+                        "would be a silent no-op",
+                fingerprint=f"device-nemesis-dead:{op}"))
+    backed = {point_for(op) for op in ops if op.startswith("device_")}
+    for point, line in sorted(device_points.items()):
+        if point not in backed:
+            findings.append(Finding(
+                rule="MG005", path=fi_mod.rel_path, line=line, col=0,
+                symbol="KNOWN_POINTS",
+                message=f"device fault point {point!r} backs no entry "
+                        "of DEVICE_NEMESIS_OPS — device chaos "
+                        "campaigns can never schedule it",
+                fingerprint=f"device-point-unscheduled:{point}"))
     return findings
 
 
