@@ -23,6 +23,7 @@ import time
 import zlib
 from dataclasses import dataclass, field
 
+from ..observability import trace as mgtrace
 from ..replication import protocol as P
 from ..utils.locks import tracked_lock, tracked_rlock
 
@@ -387,6 +388,18 @@ class RaftNode:
     def _call_peer(self, peer_id: str, request: dict,
                    timeout: float = 0.5) -> dict | None:
         from ..utils import faultinject as FI
+        carrier = mgtrace.inject()
+        if carrier is not None:
+            # the raft wire is JSON: RPCs issued while a trace is active
+            # (e.g. a coordinator action inside a traced query) carry it
+            request = {**request, "trace": carrier}
+        with mgtrace.span("raft.rpc") as sp:
+            if sp:
+                sp.set(peer=peer_id, kind=str(request.get("kind")))
+            return self._call_peer_guarded(peer_id, request, timeout, FI)
+
+    def _call_peer_guarded(self, peer_id: str, request: dict,
+                           timeout: float, FI) -> dict | None:
         try:
             if FI.fire("raft.rpc") == "drop":
                 return None  # RPC lost on the wire
@@ -459,6 +472,16 @@ class RaftNode:
     # --- RPC handlers -------------------------------------------------------
 
     def _handle(self, req: dict) -> dict:
+        carrier = req.pop("trace", None)
+        if carrier is not None:
+            with mgtrace.adopt(carrier, retain=True):
+                with mgtrace.span("raft.handle",
+                                  kind=str(req.get("kind")),
+                                  node=self.node_id):
+                    return self._handle_inner(req)
+        return self._handle_inner(req)
+
+    def _handle_inner(self, req: dict) -> dict:
         kind = req.get("kind")
         if kind == "request_vote":
             return self._on_request_vote(req)

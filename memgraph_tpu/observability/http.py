@@ -1,8 +1,11 @@
-"""Monitoring HTTP endpoint: Prometheus metrics + JSON status.
+"""Monitoring HTTP endpoint: Prometheus metrics + JSON status + traces.
 
 Counterpart of the reference's metrics/monitoring servers
 (/root/reference/src/glue/PrometheusServerT.cpp, src/http_handlers/):
-GET /metrics → Prometheus text; GET /status → JSON storage info.
+GET /metrics → Prometheus text; GET /status → JSON storage info;
+GET /traces → retained mgtrace traces (JSON), ?format=chrome for
+Chrome-trace-event JSON loadable in Perfetto, ?trace_id=<id> to fetch
+the one trace a slow-query log line names.
 """
 
 from __future__ import annotations
@@ -10,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 
+from . import trace as mgtrace
 from .metrics import global_metrics
 
 
@@ -38,6 +42,21 @@ async def start_monitoring_server(host: str, port: int, ictx):
                 else:
                     body = global_metrics.prometheus_text()
                     ctype = "text/plain; version=0.0.4"
+            elif path.startswith("/traces"):
+                trace_id = None
+                if "trace_id=" in path:
+                    trace_id = path.split("trace_id=", 1)[1] \
+                        .split("&", 1)[0]
+                if "format=chrome" in path.lower():
+                    body = json.dumps(mgtrace.chrome_trace(
+                        mgtrace.traces_json(trace_id)))
+                else:
+                    body = json.dumps({
+                        "armed": mgtrace.armed(),
+                        "counts": mgtrace.TRACER.counts(),
+                        "traces": mgtrace.traces_json(trace_id)},
+                        default=str)
+                ctype = "application/json"
             else:
                 info = dict(ictx.storage.info())
                 with ictx._rq_lock:

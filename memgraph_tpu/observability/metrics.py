@@ -4,19 +4,105 @@ Counterpart of the reference's metrics layer
 (/root/reference/src/metrics/prometheus_metrics.hpp): named counters with
 types, snapshot for SHOW METRICS INFO, Prometheus text exposition for the
 monitoring endpoint.
+
+r13 (mgtrace): ``observe()`` now records into a REAL histogram — fixed
+exponential buckets with correct cumulative Prometheus exposition
+(``_bucket{le=...}`` monotone, ``+Inf`` bucket == ``_count``) instead of
+the windowed-summary approximation, so p50/p99 survive scrape-side
+``histogram_quantile()`` and rate() math. Latency observations taken
+inside an armed trace carry the trace id as an OpenMetrics exemplar, so
+a p99 spike links straight to a retained trace in /traces.
 """
 
 from __future__ import annotations
 
-import threading
+import bisect
+import re
+import time
 from collections import defaultdict
 
 from ..utils.locks import tracked_lock
 from ..utils.sanitize import shared_field, shared_read, shared_write
 
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
 
 def _promname(name: str) -> str:
-    return name.replace(".", "_").replace("-", "_")
+    """Prometheus metric-name sanitization: every invalid character maps
+    to '_' and a leading digit gets a '_' prefix (names like
+    "edge_count[Knows]" must not produce an unparseable exposition)."""
+    out = _NAME_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _promlabel(value: str) -> str:
+    """Prometheus label-VALUE escaping (backslash, quote, newline)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+#: fixed exponential bucket bounds (seconds): 100µs .. ~1677s, factor 2.
+#: One shared layout for every histogram keeps exposition predictable
+#: and cross-metric comparisons honest.
+DEFAULT_BUCKETS = tuple(0.0001 * (2 ** i) for i in range(24))
+
+
+class Histogram:
+    """Fixed-bucket histogram with cumulative exposition + exemplars.
+
+    Not thread-safe on its own — the owning :class:`Metrics` registry
+    serializes access under its lock.
+    """
+
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "exemplars")
+
+    def __init__(self, bounds=DEFAULT_BUCKETS) -> None:
+        self.bounds = tuple(bounds)
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+        #: bucket index -> (value, trace_id, unix_ts) — the latest
+        #: traced observation landing in that bucket
+        self.exemplars: dict[int, tuple[float, str, float]] = {}
+
+    def observe(self, value: float, trace_id: str | None = None) -> None:
+        idx = bisect.bisect_left(self.bounds, value)
+        self.bucket_counts[idx] += 1
+        self.count += 1
+        self.sum += value
+        if trace_id:
+            self.exemplars[idx] = (value, trace_id, time.time())
+
+    def quantile(self, q: float) -> float:
+        """Estimate via linear interpolation inside the hit bucket (the
+        same math PromQL's histogram_quantile applies)."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.bucket_counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) \
+                    else self.bounds[-1] * 2
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.bounds[-1] * 2
+
+    def cumulative(self):
+        """[(le_bound_or_inf, cumulative_count)] — exposition order."""
+        total = 0
+        out = []
+        for i, c in enumerate(self.bucket_counts):
+            total += c
+            bound = self.bounds[i] if i < len(self.bounds) else None
+            out.append((bound, total))
+        return out
 
 
 class Metrics:
@@ -24,13 +110,8 @@ class Metrics:
         self._lock = tracked_lock("Metrics._lock")
         self._counters: dict[str, int] = defaultdict(int)
         self._gauges: dict[str, float] = {}
-        self._histograms: dict[str, list] = defaultdict(list)
-        # cumulative count/sum survive quantile-window trimming: summary
-        # _count/_sum must be monotonic or rate() queries see resets
-        self._hist_count: dict[str, int] = defaultdict(int)
-        self._hist_sum: dict[str, float] = defaultdict(float)
-        shared_field(self, "_counters", "_gauges", "_histograms",
-                     "_hist_count", "_hist_sum")
+        self._histograms: dict[str, Histogram] = {}
+        shared_field(self, "_counters", "_gauges", "_histograms")
 
     def increment(self, name: str, delta: int = 1) -> None:
         with self._lock:
@@ -42,15 +123,19 @@ class Metrics:
             shared_write(self, "_gauges")
             self._gauges[name] = value
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                trace_id: str | None = None) -> None:
+        if trace_id is None:
+            # latency observed inside an armed trace links back to it
+            # (exemplar); disarmed this is one attribute read
+            from .trace import current_trace_id
+            trace_id = current_trace_id()
         with self._lock:
             shared_write(self, "_histograms")
-            h = self._histograms[name]
-            h.append(value)
-            self._hist_count[name] += 1
-            self._hist_sum[name] += value
-            if len(h) > 10_000:
-                del h[: len(h) // 2]
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram()
+            h.observe(value, trace_id)
 
     def snapshot(self) -> list[tuple[str, str, float]]:
         with self._lock:
@@ -59,13 +144,12 @@ class Metrics:
                    for n, v in sorted(self._counters.items())]
             out += [(n, "Gauge", float(v))
                     for n, v in sorted(self._gauges.items())]
-            for n, values in sorted(self._histograms.items()):
-                if not values:
+            for n, h in sorted(self._histograms.items()):
+                if not h.count:
                     continue
-                s = sorted(values)
                 for q, suffix in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
-                    idx = min(int(q * len(s)), len(s) - 1)
-                    out.append((f"{n}_{suffix}", "Histogram", float(s[idx])))
+                    out.append((f"{n}_{suffix}", "Histogram",
+                                float(h.quantile(q))))
             return out
 
     def prometheus_text(self) -> str:
@@ -74,10 +158,10 @@ class Metrics:
             shared_read(self, "_counters")
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
-            histograms = {n: list(v)
-                          for n, v in sorted(self._histograms.items())}
-            hist_count = dict(self._hist_count)
-            hist_sum = dict(self._hist_sum)
+            histograms = [
+                (n, h.cumulative(), h.count, h.sum, dict(h.exemplars),
+                 h.bounds)
+                for n, h in sorted(self._histograms.items())]
         for name, value in counters:
             metric = _promname(name)
             lines.append(f"# TYPE {metric} counter")
@@ -86,20 +170,26 @@ class Metrics:
             metric = _promname(name)
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {float(value)}")
-        # summary exposition: quantiles + _count + _sum (reference:
-        # prometheus_metrics.hpp histogram family)
-        for name, values in histograms.items():
-            if not values:
+        # cumulative histogram exposition (reference:
+        # prometheus_metrics.hpp histogram family): every bucket line is
+        # the count of observations ≤ le, the +Inf bucket equals _count,
+        # and traced observations append OpenMetrics exemplars
+        for name, cumulative, count, total, exemplars, bounds in histograms:
+            if not count:
                 continue
             metric = _promname(name)
-            s = sorted(values)
-            lines.append(f"# TYPE {metric} summary")
-            for q in (0.5, 0.9, 0.99):
-                idx = min(int(q * len(s)), len(s) - 1)
-                lines.append(f'{metric}{{quantile="{q}"}} {float(s[idx])}')
-            lines.append(f"{metric}_count {hist_count.get(name, len(s))}")
-            lines.append(
-                f"{metric}_sum {float(hist_sum.get(name, sum(s)))}")
+            lines.append(f"# TYPE {metric} histogram")
+            for i, (bound, cum) in enumerate(cumulative):
+                le = "+Inf" if bound is None else repr(float(bound))
+                line = f'{metric}_bucket{{le="{le}"}} {cum}'
+                ex = exemplars.get(i)
+                if ex is not None:
+                    value, trace_id, ts = ex
+                    line += (f' # {{trace_id="{_promlabel(trace_id)}"}}'
+                             f" {float(value)} {ts:.3f}")
+                lines.append(line)
+            lines.append(f"{metric}_count {count}")
+            lines.append(f"{metric}_sum {float(total)}")
         return "\n".join(lines) + "\n"
 
 

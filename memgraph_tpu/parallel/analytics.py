@@ -35,7 +35,21 @@ import os
 import numpy as np
 
 from .mesh import MeshContext
+from ..observability import trace as mgtrace
 from ..ops.csr import DeviceGraph, shard_csr
+
+
+def _shard_traced(graph: DeviceGraph, ctx: MeshContext, by: str = "src",
+                  doubled: bool = False):
+    """shard_csr under a ``device.transfer`` span: the partition-centric
+    blocking + device placement stage of the trace (cache hits show as
+    ~zero-duration spans, which is itself useful signal)."""
+    with mgtrace.span("device.transfer") as sp:
+        scsr = shard_csr(graph, ctx, by=by, doubled=doubled)
+        if sp:
+            sp.set(n_shards=ctx.n_shards, by=by,
+                   n_nodes=int(graph.n_nodes))
+    return scsr
 
 
 def default_checkpoint_every() -> int:
@@ -63,7 +77,7 @@ def pagerank_mesh(graph: DeviceGraph, ctx: MeshContext,
                   retry=None):
     """Sharded PageRank; same contract as ops.pagerank.pagerank."""
     from .distributed import pagerank_partition_centric
-    scsr = shard_csr(graph, ctx, by="src")
+    scsr = _shard_traced(graph, ctx, by="src")
     return pagerank_partition_centric(
         scsr, ctx, damping=damping, max_iterations=max_iterations,
         tol=tol, **_resume_kw(checkpoint_every, job, store, report, retry))
@@ -76,7 +90,7 @@ def katz_mesh(graph: DeviceGraph, ctx: MeshContext, alpha: float = 0.2,
               store=None, report=None, retry=None):
     """Sharded Katz centrality; same contract as ops.katz.katz_centrality."""
     from .distributed import katz_partition_centric
-    scsr = shard_csr(graph, ctx, by="src")
+    scsr = _shard_traced(graph, ctx, by="src")
     return katz_partition_centric(
         scsr, ctx, alpha=alpha, beta=beta,
         max_iterations=max_iterations, tol=tol, normalized=normalized,
@@ -93,7 +107,7 @@ def label_propagation_mesh(graph: DeviceGraph, ctx: MeshContext,
     """Sharded label propagation; same contract as
     ops.labelprop.label_propagation."""
     from .distributed import labelprop_partition_centric
-    scsr = shard_csr(graph, ctx, by="dst", doubled=not directed)
+    scsr = _shard_traced(graph, ctx, by="dst", doubled=not directed)
     labels, iters = labelprop_partition_centric(
         scsr, ctx, max_iterations=max_iterations,
         self_weight=self_weight,
@@ -109,7 +123,7 @@ def components_mesh(graph: DeviceGraph, ctx: MeshContext,
     """Sharded WCC; same contract as
     ops.components.weakly_connected_components."""
     from .distributed import wcc_partition_centric
-    scsr = shard_csr(graph, ctx, by="src")
+    scsr = _shard_traced(graph, ctx, by="src")
     return wcc_partition_centric(
         scsr, ctx, max_iterations=max_iterations,
         **_resume_kw(checkpoint_every, job, store, report, retry))

@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..observability import trace as mgtrace
 from ..observability.metrics import global_metrics
 from ..utils import devicefault
 from ..utils.locks import tracked_lock
@@ -159,10 +160,17 @@ def run_resumable(*, algo: str, chunk, carry, carry_to_host,
             it_stop = min(max_iterations, it + k)
             t0 = time.monotonic()
             try:
-                devicefault.device_fault_point()
-                new_carry = chunk(carry, it_stop)
-                new_it = int(iter_of(new_carry))   # host sync: device
-                #                                    errors surface here
+                # one compiled device chunk = one span; the FIRST chunk
+                # folds XLA compilation in (its duration vs later chunks
+                # is the compile cost), a faulted chunk records as error
+                with mgtrace.span("device.chunk") as sp:
+                    devicefault.device_fault_point()
+                    new_carry = chunk(carry, it_stop)
+                    new_it = int(iter_of(new_carry))   # host sync: device
+                    #                                    errors surface here
+                    if sp:
+                        sp.set(algo=algo, chunk=report.chunks,
+                               it_from=it, it_to=new_it)
             except Exception as e:  # noqa: BLE001 — classified below
                 kind = devicefault.classify_device_error(e)
                 if kind is None:

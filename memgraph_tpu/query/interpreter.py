@@ -32,6 +32,7 @@ from typing import Iterator, Optional
 
 from ..exceptions import (HintedAbortError, QueryException, SemanticException,
                           TransactionException)
+from ..observability import trace as mgtrace
 from ..storage.common import IsolationLevel, StorageMode, View
 from ..storage.ordering import order_key
 from ..storage.storage import InMemoryStorage
@@ -156,14 +157,35 @@ class Interpreter:
         from ..observability.audit import SessionTrace
         self.session_trace = SessionTrace()
         self.username = ""
+        # mgtrace: the query-root trace handle (None unless tracing is
+        # armed) + per-phase durations for the slow-query log
+        self._trace_root = None
+        self._phase_s: dict[str, float] = {}
+        self._prepare_finished: tuple[float, float] | None = None
 
     # --- public API ---------------------------------------------------------
 
     def prepare(self, text: str, parameters: Optional[dict] = None
                 ) -> PreparedQuery:
+        handle = None
+        if mgtrace.armed():
+            if self._trace_root is not None:
+                # the client abandoned the previous prepare (never
+                # pulled): close its trace out instead of leaking it
+                self._trace_root.finish(status="abandoned")
+            # inherits the ambient context (the Bolt session span) as
+            # parent when one is active on this thread
+            self._trace_root = handle = mgtrace.begin_trace("query")
         try:
-            return self._prepare_inner(text, parameters)
-        except Exception:
+            with mgtrace.activate(handle.ctx if handle else None):
+                prepared = self._prepare_inner(text, parameters)
+            self._prepare_finished = (time.time(), time.monotonic())
+            return prepared
+        except Exception as e:
+            if handle is not None:
+                handle.finish(status="error",
+                              error=f"{type(e).__name__}: {e}")
+                self._trace_root = None
             if self.ctx.config.get("log_failed_queries"):
                 import logging
                 logging.getLogger(__name__).warning(
@@ -182,8 +204,13 @@ class Interpreter:
         self._query_text = text
         self._pending_op_counts = None   # drop any abandoned prepare's
         self._query_priv_auth = False    # AUTH queries skip the slow log
+        self._phase_s = {}
+        self._prepare_finished = None
         self.session_trace.emit("prepare", query=text)
-        node = self.ctx.cached_parse(text)
+        t0 = time.perf_counter()
+        with mgtrace.span("query.parse"):
+            node = self.ctx.cached_parse(text)
+        self._phase_s["parse"] = time.perf_counter() - t0
         if isinstance(node, A.SessionTraceQuery):
             if node.enabled:
                 self.session_trace.enabled = True
@@ -751,6 +778,14 @@ class Interpreter:
         """Pull up to n rows (n<0 = all). Returns (rows, has_more, summary)."""
         if self._stream is None:
             raise QueryException("no query prepared")
+        # re-activate the query root on THIS thread (Bolt pulls may run
+        # on a different worker thread than the prepare): device/kernel
+        # spans opened during execution join the query's trace
+        root = self._trace_root
+        with mgtrace.activate(root.ctx if root is not None else None):
+            return self._pull_inner(n)
+
+    def _pull_inner(self, n: int) -> tuple[list[list], bool, dict]:
         rows: list[list] = []
         has_more = False
         try:
@@ -838,7 +873,10 @@ class Interpreter:
         if query.explain or query.profile:
             # strip the EXPLAIN/PROFILE keyword for plan-cache keying
             strip = strip.split(None, 1)[1] if " " in strip else strip
-        plan, columns = self.ctx.cached_plan(strip, query)
+        t0 = time.perf_counter()
+        with mgtrace.span("query.plan"):
+            plan, columns = self.ctx.cached_plan(strip, query)
+        self._phase_s["plan"] = time.perf_counter() - t0
 
         if self.ctx.config.get("debug_query_plans"):
             import logging
@@ -1004,10 +1042,20 @@ class Interpreter:
         if self._exec_ctx is not None:
             summary["stats"] = dict(self._exec_ctx.stats)
             self._exec_ctx.memory.release_all()
+        # execute phase = end of prepare -> stream exhaustion (measured
+        # BEFORE the commit below so the phases stay disjoint)
+        pf = self._prepare_finished
+        if pf is not None:
+            self._phase_s["execute"] = time.monotonic() - pf[1]
+            mgtrace.record_span("query.execute", pf[0],
+                                self._phase_s["execute"])
         # the commit can still fail (constraint violations surface here):
         # counters are recorded only after it succeeds
         if self._stream_owns_txn and self._stream_accessor is not None:
-            self._stream_accessor.commit()
+            t0 = time.perf_counter()
+            with mgtrace.span("query.commit"):
+                self._stream_accessor.commit()
+            self._phase_s["commit"] = time.perf_counter() - t0
         global_metrics.increment("query.finished")
         if pending_ops:
             for op_name, count in pending_ops.items():
@@ -1016,13 +1064,34 @@ class Interpreter:
             elapsed = time.monotonic() - started
             global_metrics.observe("query.execution_latency_sec", elapsed)
             min_ms = self.ctx.config.get("log_min_duration_ms") or 0
-            if min_ms and elapsed * 1000.0 >= min_ms and \
-                    not getattr(self, "_query_priv_auth", False):
+            slow = min_ms and elapsed * 1000.0 >= min_ms and \
+                not getattr(self, "_query_priv_auth", False)
+            if slow:
+                # the logged entry names its trace_id so a slow query
+                # links directly to the retained trace in /traces; the
+                # per-phase breakdown says WHERE the time went
                 import logging
+                phases = " ".join(
+                    f"{k}={v * 1000.0:.1f}ms"
+                    for k, v in sorted(self._phase_s.items()))
+                trace_id = self._trace_root.trace_id \
+                    if self._trace_root is not None else "-"
                 logging.getLogger(__name__).info(
-                    "slow query (%.1f ms): %s", elapsed * 1000.0,
+                    "slow query (%.1f ms, trace_id=%s, %s): %s",
+                    elapsed * 1000.0, trace_id, phases or "-",
                     _redact_literals(
                         (getattr(self, "_query_text", "") or "").strip()))
+            if self._trace_root is not None:
+                self._trace_root.finish(
+                    status="ok", force_keep=bool(slow),
+                    query=_redact_literals(
+                        (getattr(self, "_query_text", "") or "").strip()),
+                    **{f"{k}_ms": round(v * 1000.0, 3)
+                       for k, v in self._phase_s.items()})
+                self._trace_root = None
+        elif self._trace_root is not None:
+            self._trace_root.finish(status="ok")
+            self._trace_root = None
         for key, value in summary.get("stats", {}).items():
             if value:
                 global_metrics.increment(f"storage.{key}", value)
@@ -1039,6 +1108,13 @@ class Interpreter:
             self._exec_ctx.memory.release_all()
         if self._stream_owns_txn and self._stream_accessor is not None:
             self._stream_accessor.abort()
+        if self._trace_root is not None:
+            # errored/aborted queries are always retained
+            self._trace_root.finish(
+                status="error" if error else "aborted",
+                error="query aborted or failed mid-stream" if error
+                else None, force_keep=error)
+            self._trace_root = None
         self._stream = None
         self._stream_accessor = None
         self._stream_owns_txn = False

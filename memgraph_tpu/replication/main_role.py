@@ -25,6 +25,7 @@ import threading
 import time
 from collections import deque
 
+from ..observability import trace as mgtrace
 from ..observability.metrics import global_metrics
 from ..utils import faultinject as FI
 from ..utils.locks import tracked_lock
@@ -393,6 +394,12 @@ class ReplicaClient:
 
     def _send_system_locked(self, txn: dict) -> bool:
         try:
+            carrier = mgtrace.inject()
+            if carrier is not None:
+                # system txns are JSON: the replication wire carries the
+                # trace context so the replica-side apply span joins the
+                # originating query's trace
+                txn = {**txn, "trace": carrier}
             if FI.fire("repl.send") == "drop":
                 raise FI.FaultInjected("injected drop of system txn")
             self._net_out()
@@ -405,18 +412,28 @@ class ReplicaClient:
             return False
 
     def _send_frame_locked(self, frame: bytes) -> bool:
+        t0 = time.perf_counter()
         try:
-            if FI.fire("repl.send") == "drop":
-                # the frame is lost before hitting the wire; the ack
-                # timeout/reconnect path must re-ship it via catch-up
-                raise FI.FaultInjected("injected drop of WAL frame")
-            self._net_out()
-            P.send_frame(self._sock, P.MSG_WAL_FRAME, frame)
-            msg_type, payload = P.recv_frame(self._sock)
-            self._net_in()
-            if msg_type == P.MSG_ACK:
-                self._note_ack(P.parse_json(payload)["last_commit_ts"])
-                return True
+            # one span per (frame, replica): the replication-ack leg of
+            # a committing query's trace
+            with mgtrace.span("repl.ship") as sp:
+                if sp:
+                    sp.set(replica=self.name, bytes=len(frame))
+                if FI.fire("repl.send") == "drop":
+                    # the frame is lost before hitting the wire; the ack
+                    # timeout/reconnect path must re-ship it via catch-up
+                    raise FI.FaultInjected("injected drop of WAL frame")
+                self._net_out()
+                P.send_frame(self._sock, P.MSG_WAL_FRAME, frame)
+                msg_type, payload = P.recv_frame(self._sock)
+                self._net_in()
+                if msg_type == P.MSG_ACK:
+                    self._note_ack(
+                        P.parse_json(payload)["last_commit_ts"])
+                    global_metrics.observe(
+                        "replication.ship_latency_sec",
+                        time.perf_counter() - t0)
+                    return True
             self._mark_failed("frame ship", ValueError(f"nack {msg_type}"))
             return False
         except (ConnectionError, OSError) as e:

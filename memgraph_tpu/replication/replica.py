@@ -14,6 +14,7 @@ import logging
 import socket
 import threading
 
+from ..observability import trace as mgtrace
 from ..storage.durability import wal as W
 from ..utils.locks import tracked_lock
 from ..storage.durability.recovery import _apply_wal_txn
@@ -220,6 +221,15 @@ class ReplicaServer:
         the replica-side half of the reference's system::Transaction
         (/root/reference/src/system/transaction.cpp). Deliveries are
         full-state (auth) or idempotent DDL, so replays are harmless."""
+        carrier = txn.pop("trace", None)
+        with mgtrace.adopt(carrier, retain=True):
+            with mgtrace.span("repl.apply") as sp:
+                if sp:
+                    sp.set(kind=str(txn.get("kind")),
+                           seq=txn.get("seq", 0))
+                self._apply_system_inner(txn)
+
+    def _apply_system_inner(self, txn: dict) -> None:
         seq = txn.get("seq", 0)
         kind = txn.get("kind")
         if kind == "full":

@@ -17,6 +17,7 @@ import os
 import struct
 
 from ..exceptions import MemgraphTpuError, QueryException
+from ..observability import trace as mgtrace
 from ..query.interpreter import Interpreter, InterpreterContext
 from ..query.values import Path
 from ..storage.storage import EdgeAccessor, VertexAccessor
@@ -228,6 +229,9 @@ class BoltSession:
         self._prepared = None
         import uuid as _uuid
         self.session_id = str(_uuid.uuid4())
+        # mgtrace: the session-level root of the current RUN..PULL*
+        # exchange (None unless tracing is armed)
+        self._bolt_trace = None
         # interpreter work (parse/plan/execute/pull) runs on this pool so
         # one session's long query never blocks the event loop — the
         # reference runs sessions on a work-stealing priority pool
@@ -312,6 +316,7 @@ class BoltSession:
 
     def send_failure(self, code: str, message: str) -> None:
         self.failed = True
+        self._finish_bolt_trace("error")
         self.send(M_FAILURE, {"code": code, "message": message})
 
     # --- lifecycle ----------------------------------------------------------
@@ -336,6 +341,7 @@ class BoltSession:
         except Exception:
             log.exception("bolt session crashed")
         finally:
+            self._finish_bolt_trace("abandoned")
             self._unregister_session()
             self.interpreter.abort()
             self.writer.close()
@@ -374,6 +380,7 @@ class BoltSession:
             return False
         if sig == M_RESET:
             self.failed = False
+            self._finish_bolt_trace("abandoned")
             username = self.interpreter.username
             self.interpreter.abort()
             self.interpreter = Interpreter(self.ictx)
@@ -520,15 +527,51 @@ class BoltSession:
         self.send_success()
         return True
 
+    def _traced_call(self, fn, *args):
+        """Run fn on the worker thread under the session's trace context
+        (thread-local, so the activation must happen ON that thread)."""
+        handle = self._bolt_trace
+        if handle is None:
+            return fn(*args)
+        with mgtrace.activate(handle.ctx):
+            return fn(*args)
+
+    def _finish_bolt_trace(self, status: str = "ok") -> None:
+        if self._bolt_trace is not None:
+            self._bolt_trace.finish(status=status)
+            self._bolt_trace = None
+
     async def on_run(self, query: str, parameters: dict = None,
                      extra: dict = None) -> bool:
         parameters = {k: bolt_to_value(v)
                       for k, v in (parameters or {}).items()}
-        prepared = await self._offload(self.interpreter.prepare, query,
+        if mgtrace.armed():
+            # the Bolt extra-metadata field is the trace carrier across
+            # the client boundary: drivers propagate {"trace":
+            # {trace_id, span_id, sampled}} and the whole server-side
+            # trace joins the caller's
+            self._finish_bolt_trace("abandoned")
+            carrier = None
+            if isinstance(extra, dict):
+                carrier = extra.get("trace") or \
+                    (extra.get("tx_metadata") or {}).get("trace")
+            self._bolt_trace = mgtrace.begin_trace(
+                "bolt.run", carrier if isinstance(carrier, dict) else None)
+        import time as _time
+        t0 = _time.perf_counter()
+        prepared = await self._offload(self._traced_call,
+                                       self.interpreter.prepare, query,
                                        parameters)
+        from ..observability.metrics import global_metrics
+        global_metrics.observe(
+            "bolt.prepare_latency_sec", _time.perf_counter() - t0,
+            trace_id=self._bolt_trace.trace_id
+            if self._bolt_trace is not None else None)
         self._prepared = prepared
-        self.send_success({"fields": prepared.columns, "t_first": 0,
-                           "qid": 0})
+        meta = {"fields": prepared.columns, "t_first": 0, "qid": 0}
+        if self._bolt_trace is not None:
+            meta["trace_id"] = self._bolt_trace.trace_id
+        self.send_success(meta)
         return True
 
     async def on_pull(self, extra: dict) -> bool:
@@ -550,11 +593,15 @@ class BoltSession:
             if stats and any(stats.values()):
                 meta["stats"] = {k.replace("_", "-"): v
                                  for k, v in stats.items() if v}
+            if self._bolt_trace is not None:
+                meta["trace_id"] = self._bolt_trace.trace_id
+                self._finish_bolt_trace("ok")
         self.send_success(meta)
         return True
 
     async def on_discard(self, extra: dict) -> bool:
         await self._offload(self.interpreter.pull, -1)
+        self._finish_bolt_trace("ok")
         self.send_success({"has_more": False})
         return True
 

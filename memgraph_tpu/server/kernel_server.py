@@ -59,6 +59,7 @@ import time
 
 import numpy as np
 
+from ..observability import trace as mgtrace
 from ..observability.metrics import global_metrics
 from ..utils.devicefault import classify_device_error, device_fault_point
 from ..utils.retry import RetryPolicy
@@ -424,6 +425,10 @@ class KernelServer:
 
         deadline_s = header.get("deadline_s")
         deadline_s = float(deadline_s) if deadline_s else None
+        # trace carrier off the request protocol: the dispatch (and the
+        # device stages under it) joins the caller's trace; its spans
+        # ship home on the reply (take_trace below)
+        carrier = header.pop("trace", None)
         with self._stats_lock:
             shared_write(self, "_dispatch_seq")
             self._dispatch_seq += 1
@@ -431,18 +436,37 @@ class KernelServer:
             self._active[did] = (time.monotonic(),
                                  deadline_s or self.wedge_after_s)
         box: dict = {}
+        t_dispatch = time.perf_counter()
 
         def work():
             try:
-                with self._dispatch_lock:
-                    device_fault_point()
-                    box["result"] = self._dispatch_op(op, header, arrays)
+                # the activation is thread-local; the worker thread must
+                # adopt the remote context itself
+                with mgtrace.adopt(carrier):
+                    with mgtrace.span("kernel.dispatch", op=op,
+                                      pid=os.getpid()):
+                        with self._dispatch_lock:
+                            device_fault_point()
+                            box["result"] = self._dispatch_op(op, header,
+                                                              arrays)
             except BaseException as e:  # noqa: BLE001 — classified below
                 box["exc"] = e
             finally:
                 with self._stats_lock:
                     shared_write(self, "_active")
                     self._active.pop(did, None)
+
+        def ship_trace(reply: dict) -> dict:
+            """Attach this dispatch's spans + latency to the reply."""
+            global_metrics.observe(
+                "kernel_server.dispatch_latency_sec",
+                time.perf_counter() - t_dispatch,
+                trace_id=(carrier or {}).get("trace_id"))
+            if carrier and carrier.get("trace_id"):
+                spans = mgtrace.take_trace(carrier["trace_id"])
+                if spans:
+                    reply["trace_spans"] = spans
+            return reply
 
         t = threading.Thread(target=work, daemon=True,
                              name=f"ks-dispatch-{did}")
@@ -471,9 +495,10 @@ class KernelServer:
             self._count(outcome)
             log.warning("kernel_server: dispatch %d (%s) failed "
                         "[%s]: %s", did, op, outcome, e)
-            return ({"ok": False, "outcome": outcome,
-                     "retryable": retryable,
-                     "error": f"{type(e).__name__}: {e}"}, None)
+            return (ship_trace({"ok": False, "outcome": outcome,
+                                "retryable": retryable,
+                                "error": f"{type(e).__name__}: {e}"}),
+                    None)
         reply, out_arrays = box["result"]
         if reply.get("ok", True):
             reply.setdefault("outcome", "completed")
@@ -481,7 +506,7 @@ class KernelServer:
         else:
             reply.setdefault("outcome", "invalid")
             self._count("invalid")
-        return reply, out_arrays
+        return ship_trace(reply), out_arrays
 
     def _dispatch_op(self, op: str, header: dict, arrays: dict):
         """Runs under _dispatch_lock on the worker thread."""
@@ -581,7 +606,13 @@ class KernelClient:
 
     def call(self, header: dict, arrays=None):
         _send_msg(self._sock, header, arrays)
-        return _recv_msg(self._sock)
+        h, out = _recv_msg(self._sock)
+        # spans the server recorded for OUR trace come home on the
+        # reply; adopt them so the retained trace is connected
+        spans = h.pop("trace_spans", None)
+        if spans:
+            mgtrace.adopt_spans(spans)
+        return h, out
 
     def ping(self) -> bool:
         try:
@@ -596,7 +627,11 @@ class KernelClient:
 
     def probe(self) -> dict:
         """Typed device probe through the resident runtime."""
-        h, _ = self.call({"op": "probe"})
+        header = {"op": "probe"}
+        carrier = mgtrace.inject()
+        if carrier is not None:
+            header["trace"] = carrier
+        h, _ = self.call(header)
         return h
 
     def pagerank(self, src=None, dst=None, weights=None, n_nodes=None,
@@ -611,6 +646,9 @@ class KernelClient:
                   "n_nodes": n_nodes, **params}
         if deadline_s is not None:
             header["deadline_s"] = deadline_s
+        carrier = mgtrace.inject()
+        if carrier is not None:
+            header["trace"] = carrier
         h, out = self.call(header, arrays)
         if not h.get("ok"):
             _raise_for_reply(h)
@@ -755,6 +793,23 @@ class SupervisedKernelClient:
             except OSError as e:
                 log.debug("closing health probe connection: %s", e)
 
+    def _mirror_daemon_counters(self, h: dict) -> None:
+        """Publish the daemon's health-reply counters through the LOCAL
+        global Metrics registry so the supervisor's prometheus_text()
+        carries them (restarts, sheds, deadline_exceeded, oom, ...) —
+        not only callers of the ``health`` op. Gauges, not counters:
+        they mirror another process's monotonic state and must not
+        double-count across supervision rounds."""
+        for name, value in (h.get("counters") or {}).items():
+            short = name[len("kernel_server."):] \
+                if name.startswith("kernel_server.") else name
+            global_metrics.set_gauge(f"kernel_server.daemon.{short}",
+                                     float(value))
+        global_metrics.set_gauge("kernel_server.daemon.in_flight",
+                                 float(h.get("in_flight", 0)))
+        global_metrics.set_gauge("kernel_server.daemon.wedged",
+                                 1.0 if h.get("wedged") else 0.0)
+
     def check_once(self) -> str:
         """One supervision round: health-check, restart when wedged or
         unreachable. Returns "ok" or "restarted"."""
@@ -764,7 +819,10 @@ class SupervisedKernelClient:
         if h is None:
             self.restart_server(reason="unreachable")
             return "restarted"
+        self._mirror_daemon_counters(h)
         if h.get("wedged"):
+            global_metrics.increment(
+                "kernel_server.supervisor.wedge_detected_total")
             self.restart_server(reason="wedged", pid=h.get("pid"))
             return "restarted"
         self._set_pid(h.get("pid"))
@@ -820,9 +878,12 @@ class SupervisedKernelClient:
         for _attempt in self.retry.attempts():
             try:
                 c = self._connect()
-                return c.pagerank(src=src, dst=dst, weights=weights,
-                                  n_nodes=n_nodes, graph_key=graph_key,
-                                  deadline_s=deadline_s, **params)
+                with mgtrace.span("kernel.request", op="pagerank",
+                                  attempt=_attempt):
+                    return c.pagerank(src=src, dst=dst, weights=weights,
+                                      n_nodes=n_nodes,
+                                      graph_key=graph_key,
+                                      deadline_s=deadline_s, **params)
             except (AdmissionRejected, KernelOom):
                 # deterministic against this budget/graph: retry is noise
                 raise

@@ -31,6 +31,8 @@ import pickle
 import struct
 import threading
 
+from ..observability import trace as mgtrace
+
 __all__ = ["MPReadExecutor"]
 
 
@@ -102,7 +104,7 @@ class MPReadExecutor:
                 return
             if msg is None:
                 return
-            query, params = msg
+            query, params, carrier = msg
             try:
                 # enforce the read-only contract BEFORE prepare: non-Cypher
                 # statements (auth/DDL/admin) can mutate state at prepare
@@ -112,13 +114,21 @@ class MPReadExecutor:
                 if not isinstance(node, A.CypherQuery):
                     _send(resp_fd, ("err", *refusal))
                     continue
-                prepared = interp.prepare(query, params)
-                if prepared.is_write:
-                    interp.abort()
-                    _send(resp_fd, ("err", *refusal))
-                    continue
-                rows, _more, _summary = interp.pull(-1)
-                _send(resp_fd, ("ok", prepared.columns, rows))
+                # the job envelope is the trace carrier across the fork
+                # boundary: this worker's spans (incl. the interpreter's
+                # own query trace) join the parent's trace, then ship
+                # home on the response envelope
+                with mgtrace.adopt(carrier):
+                    with mgtrace.span("mp.worker"):
+                        prepared = interp.prepare(query, params)
+                        if prepared.is_write:
+                            interp.abort()
+                            _send(resp_fd, ("err", *refusal))
+                            continue
+                        rows, _more, _summary = interp.pull(-1)
+                spans = mgtrace.take_trace(carrier["trace_id"]) \
+                    if carrier else []
+                _send(resp_fd, ("ok", prepared.columns, rows, spans))
             except Exception as e:  # noqa: BLE001 — ship the error back
                 _send(resp_fd, ("err", type(e).__name__, str(e)))
 
@@ -153,9 +163,12 @@ class MPReadExecutor:
             raise RuntimeError("executor is closed")
         i = next(self._rr) % len(self._workers)
         pid, req_fd, resp_fd = self._workers[i]
-        with self._locks[i]:
-            _send(req_fd, (query, params or {}))
-            out = _recv(resp_fd)
+        with mgtrace.span("mp.execute", worker=i, worker_pid=pid):
+            with self._locks[i]:
+                _send(req_fd, (query, params or {}, mgtrace.inject()))
+                out = _recv(resp_fd)
         if out[0] == "err":
             raise RuntimeError(f"{out[1]}: {out[2]}")
+        if len(out) > 3:
+            mgtrace.adopt_spans(out[3])
         return out[1], out[2]

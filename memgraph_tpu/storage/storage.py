@@ -302,8 +302,13 @@ class Accessor:
 
     def __init__(self, storage: "InMemoryStorage",
                  isolation: IsolationLevel) -> None:
+        from ..observability import trace as mgtrace
         self.storage = storage
-        self.txn = storage._begin_transaction(isolation)
+        with mgtrace.span("mvcc.begin") as sp:
+            self.txn = storage._begin_transaction(isolation)
+            if sp:
+                sp.set(txn_id=self.txn.id,
+                       isolation=str(isolation.value))
         self._finished = False
         self._analytical = storage.config.storage_mode is StorageMode.IN_MEMORY_ANALYTICAL
         # what this reader's MVCC snapshot corresponds to: commits AFTER
@@ -324,10 +329,14 @@ class Accessor:
             self.abort()
 
     def commit(self) -> None:
+        from ..observability import trace as mgtrace
         if self._finished:
             raise StorageError("transaction already finished")
         try:
-            commit_ts = self.storage._commit(self.txn)
+            with mgtrace.span("mvcc.commit") as sp:
+                commit_ts = self.storage._commit(self.txn)
+                if sp:
+                    sp.set(txn_id=self.txn.id, commit_ts=commit_ts)
         except Exception:
             # constraint violation etc. → roll back so objects aren't left owned
             self.storage._abort(self.txn)

@@ -1,0 +1,15 @@
+"""MG005 fixture span registry (r13, mgtrace): one wired name, one
+dead registration; the open sites live in user.py."""
+
+SPAN_NAMES = (
+    "wired.span",       # opened below in user.py
+    "dead.span",        # MG005: declared but never opened
+)
+
+
+def span(name, **attrs):
+    return None
+
+
+def record_span(name, start_wall, duration_s, **attrs):
+    return None

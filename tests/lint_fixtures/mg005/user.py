@@ -12,3 +12,13 @@ def do_write():
 def do_dispatch():
     FI.fire("device.wired")
     FI.fire("device.orphan")   # fired, but no op schedules it
+
+
+def do_trace(tracer):
+    from .observability import trace as T
+    with T.span("wired.span"):
+        pass
+    with T.span("unregistered.span"):   # MG005: not in SPAN_NAMES
+        pass
+    T.record_span("wired.span", 0.0, 1.0)
+    tracer._begin_span("wired.span")    # MG005: manual begin/end API

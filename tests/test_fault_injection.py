@@ -331,7 +331,7 @@ def test_replica_lag_and_fsync_metrics_exported(cluster, tmp_path):
     assert "replication_replica_lag_r1" in text
     assert "replication_replica_health_r1 1.0" in text
     assert "wal_fsync_latency_sec_count" in text
-    assert 'wal_fsync_latency_sec{quantile="0.9"}' in text
+    assert 'wal_fsync_latency_sec_bucket{le="+Inf"}' in text
 
 
 # --- raft fault points ------------------------------------------------------

@@ -128,7 +128,13 @@ def test_mg005_fires_on_coverage_gaps_only():
     # device_wired/device.wired pair stays silent
     assert "device-nemesis-dead:device_ghost" in msgs
     assert "device-point-unscheduled:device.orphan" in msgs
-    assert len(msgs) == 5, msgs              # OP_WIRED is fully covered
+    # r13 span-registry wiring: an undeclared opened name, a declared
+    # never-opened name, and a manual _begin_span call all fire; the
+    # wired.span open sites (span + record_span) stay silent
+    assert "span-unregistered:unregistered.span" in msgs
+    assert "span-dead:dead.span" in msgs
+    assert "span-manual:_begin_span" in msgs
+    assert len(msgs) == 8, msgs              # OP_WIRED is fully covered
 
 
 def test_mg006_fires_on_unguarded_access_only():

@@ -54,7 +54,11 @@ def test_latency_histogram_and_query_counters(interp):
     text = global_metrics.prometheus_text()
     assert "query_execution_latency_sec_count" in text
     assert "query_execution_latency_sec_sum" in text
-    assert 'query_execution_latency_sec{quantile="0.9"}' in text
+    assert "# TYPE query_execution_latency_sec histogram" in text
+    assert 'query_execution_latency_sec_bucket{le="+Inf"}' in text
+    # SHOW METRICS INFO still surfaces estimated quantiles
+    names = {n for n, _k, _v in global_metrics.snapshot()}
+    assert "query.execution_latency_sec_p99" in names
 
 
 def test_show_metrics_info_surface(interp):
@@ -78,17 +82,105 @@ def test_prometheus_exposition_format():
     text = m.prometheus_text()
     assert "# TYPE a_count counter\na_count 3.0" in text
     assert "# TYPE g gauge\ng 1.5" in text
-    assert "# TYPE lat summary" in text
-    assert 'lat{quantile="0.5"} 3.0' in text
+    assert "# TYPE lat histogram" in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
     assert "lat_count 4" in text
     assert "lat_sum 10.0" in text
 
 
+def _bucket_lines(text, metric):
+    """[(le, cumulative_count)] parsed back from the exposition."""
+    import re
+    out = []
+    for line in text.splitlines():
+        m = re.match(rf'{metric}_bucket{{le="([^"]+)"}} (\d+)', line)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" \
+                else float(m.group(1))
+            out.append((le, int(m.group(2))))
+    return out
+
+
+def test_histogram_buckets_cumulative_and_inf_equals_count():
+    m = Metrics()
+    values = [0.0001, 0.003, 0.003, 0.1, 2.5, 40.0, 1e9]
+    for v in values:
+        m.observe("lat.sec", v)
+    text = m.prometheus_text()
+    buckets = _bucket_lines(text, "lat_sec")
+    assert buckets, text
+    # bucket bounds strictly increasing, counts monotone non-decreasing
+    les = [le for le, _c in buckets]
+    assert les == sorted(les) and len(set(les)) == len(les)
+    counts = [c for _le, c in buckets]
+    assert all(a <= b for a, b in zip(counts, counts[1:]))
+    # the +Inf bucket IS the count (an out-of-range observation may not
+    # vanish), and every observation ≤ le is counted cumulatively
+    assert buckets[-1][0] == float("inf")
+    assert buckets[-1][1] == len(values)
+    assert f"lat_sec_count {len(values)}" in text
+    for le, c in buckets:
+        assert c == sum(1 for v in values if v <= le), (le, c)
+
+
+def test_metric_name_and_label_sanitization():
+    from memgraph_tpu.observability.metrics import _promlabel, _promname
+    m = Metrics()
+    m.increment('weird metric-name![with]"stuff"', 1)
+    m.set_gauge("9starts.with-digit", 2.0)
+    m.observe("lat", 1.0, trace_id='t"1\\x\n2')
+    text = m.prometheus_text()
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert name and not name[0].isdigit(), line
+        import re
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), line
+    assert _promname("a.b-c!d") == "a_b_c_d"
+    assert _promname("9x").startswith("_")
+    # label values escape quotes/backslashes/newlines (an unescaped
+    # quote truncates the exemplar label and corrupts the exposition)
+    assert _promlabel('t"1\\x\n2') == 't\\"1\\\\x\\n2'
+    assert '\\"' in text and "\n2" not in text.replace("\\n2", "")
+
+
+def test_histogram_exemplars_carry_trace_ids():
+    m = Metrics()
+    m.observe("lat", 0.005, trace_id="abc123")
+    text = m.prometheus_text()
+    exemplar_lines = [l for l in text.splitlines()
+                      if 'trace_id="abc123"' in l]
+    assert exemplar_lines, text
+    # OpenMetrics shape: bucket value # {labels} exemplar_value ts
+    assert " # {" in exemplar_lines[0]
+    assert " 0.005 " in exemplar_lines[0]
+
+
+def test_histogram_quantile_estimates_are_ordered():
+    from memgraph_tpu.observability.metrics import Histogram
+    h = Histogram()
+    import random
+    rng = random.Random(7)
+    values = [rng.uniform(0.001, 1.0) for _ in range(500)]
+    for v in values:
+        h.observe(v)
+    q50, q90, q99 = (h.quantile(q) for q in (0.5, 0.9, 0.99))
+    assert 0 < q50 <= q90 <= q99
+    # bucketed estimate lands within a factor-2 band of the true value
+    # (factor-2 buckets bound the interpolation error)
+    values.sort()
+    true_p50 = values[len(values) // 2]
+    assert true_p50 / 2 <= q50 <= true_p50 * 2
+
+
 def test_monitoring_http_endpoint_exposes_operator_counters(interp):
     import asyncio
+    import json as _json
     import socket
     import threading
     import urllib.request
+    from memgraph_tpu.observability import trace as T
     from memgraph_tpu.observability.http import start_monitoring_server
 
     interp.execute("MATCH (x) RETURN count(x)")
@@ -112,4 +204,24 @@ def test_monitoring_http_endpoint_exposes_operator_counters(interp):
         f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
     assert "operator_ParallelScanAggregate" in body   # the rewritten plan
     assert "query_finished" in body
+    # /traces view: retained traces as JSON, ?format=chrome for Perfetto
+    T.TRACER.reset()
+    T.enable(sample=1.0)
+    try:
+        interp.execute("RETURN 42")
+        doc = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/traces", timeout=5).read())
+        assert doc["armed"] and doc["traces"]
+        names = {s["name"] for s in doc["traces"][0]}
+        assert "query" in names
+        trace_id = doc["traces"][0][0]["trace_id"]
+        chrome = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/traces?format=chrome"
+            f"&trace_id={trace_id}", timeout=5).read())
+        assert chrome["traceEvents"]
+        assert all(ev["args"]["trace_id"] == trace_id
+                   for ev in chrome["traceEvents"])
+    finally:
+        T.disable()
+        T.TRACER.reset()
     loop.call_soon_threadsafe(loop.stop)

@@ -24,6 +24,12 @@ stage() {
 stage "mglint (static analysis)" \
     python -m tools.mglint memgraph_tpu
 
+# 1b. mgtrace smoke: one traced query end-to-end (parse → plan →
+#     execute → MVCC commit → mesh-routed device stages), single
+#     connected trace, Chrome-trace-event export validated structurally
+stage "mgtrace smoke (traced query -> chrome export)" \
+    python -m tools.trace_smoke
+
 # 2. mgsan smoke: the invariant-holding scenarios over a few seeds (the
 #    racy_counter true-positive is exercised by the test suite, not here)
 stage "mgsan schedule-exploration smoke" \

@@ -33,6 +33,15 @@ no longer fire (the same dead-registration hazard as fault points; the
 per-op *test* coverage half of this contract lives in
 tests/test_chaos.py, which asserts the seeded sweep exercises every
 registered op).
+
+Span names (r13, mgtrace): every literal span name opened in product
+code — ``span("x")`` / ``record_span("x", ...)`` / ``begin_trace("x")``
+— must be declared in observability/trace.py ``SPAN_NAMES`` (a typo'd
+name silently fragments a trace), and every declared name must have at
+least one live open site. Spans may ONLY be opened through that
+context-manager API: any call to the private ``_begin_span``/
+``_end_span`` primitives outside trace.py is a manual begin/end
+imbalance waiting to happen and is flagged outright.
 """
 
 from __future__ import annotations
@@ -84,6 +93,7 @@ def check(project: Project):
     findings.extend(_check_nemesis_ops(project))
     findings.extend(_check_device_nemesis_ops(project))
     findings.extend(_check_spmv_registry(project))
+    findings.extend(_check_span_registry(project))
     return findings
 
 
@@ -484,4 +494,69 @@ def _check_spmv_registry(project: Project):
                         "SPMV_ALGORITHMS entry references it — it "
                         "silently misses the mesh path",
                 fingerprint=f"spmv-uncovered:{mod}"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# mgtrace span-name coverage (observability/trace.py SPAN_NAMES)
+# --------------------------------------------------------------------------
+
+#: the sanctioned span-opening API (all context-manager / atomic-record
+#: shaped; no caller can leave a span open by mistake)
+_SPAN_OPEN_FUNCS = ("span", "record_span", "begin_trace")
+
+
+def _check_span_registry(project: Project):
+    tr = project.by_suffix("observability/trace.py")
+    if tr is None:
+        return []
+    names = _collect_tuple_registry(tr, "SPAN_NAMES")
+    if not names:
+        return []
+
+    findings = []
+    opened: set[str] = set()
+    for rel, sf in project.files.items():
+        if sf is tr:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (dotted(node.func) or "").split(".")[-1]
+            if fname in ("_begin_span", "_end_span"):
+                findings.append(Finding(
+                    rule="MG005", path=rel, line=node.lineno,
+                    col=node.col_offset, symbol=fname,
+                    message=f"{fname}() is private to trace.py — spans "
+                            "open only via the context-manager API "
+                            "(span / record_span / begin_trace); manual "
+                            "begin/end pairs are imbalance hazards",
+                    fingerprint=f"span-manual:{fname}"))
+                continue
+            if fname not in _SPAN_OPEN_FUNCS:
+                continue
+            if not node.args or not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            span_name = node.args[0].value
+            opened.add(span_name)
+            if span_name not in names:
+                findings.append(Finding(
+                    rule="MG005", path=rel, line=node.lineno,
+                    col=node.col_offset, symbol=fname,
+                    message=f"span name {span_name!r} is not declared "
+                            "in observability/trace.py SPAN_NAMES — an "
+                            "undeclared name fragments traces and "
+                            "dashboards can never know it exists",
+                    fingerprint=f"span-unregistered:{span_name}"))
+    for span_name, line in sorted(names.items()):
+        if span_name not in opened:
+            findings.append(Finding(
+                rule="MG005", path=tr.rel_path, line=line, col=0,
+                symbol="SPAN_NAMES",
+                message=f"declared span name {span_name!r} has no open "
+                        "site — dead registration, dashboards covering "
+                        "it watch a span that can never fire",
+                fingerprint=f"span-dead:{span_name}"))
     return findings

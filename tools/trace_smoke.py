@@ -1,0 +1,130 @@
+"""mgtrace smoke: one traced query end-to-end, validated Chrome export.
+
+The gate stage (`tools/gate.sh`) that proves the tracing plane actually
+produces a CONNECTED trace and a loadable Chrome-trace-event export:
+
+  1. arm the tracer (sample=1.0),
+  2. run real Cypher through a real Interpreter (parse → plan → execute
+     → MVCC commit) plus a mesh-routed analytics call (mesh-of-1
+     degeneracy — the identical sharded path a TPU pod runs) under the
+     same trace,
+  3. assert every expected span family appears, all spans share one
+     trace_id, and every parent link resolves,
+  4. export Chrome-trace JSON and validate it structurally (the format
+     Perfetto/chrome://tracing parses).
+
+Exit 0 only if every check passes. Writes the export next to nothing —
+pass --out to keep it for manual inspection.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def fail(msg: str) -> None:
+    print(f"trace-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="write the Chrome-trace JSON here")
+    args = ap.parse_args()
+
+    from memgraph_tpu.observability import trace as T
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage
+
+    T.enable(sample=1.0)
+    interp = Interpreter(InterpreterContext(InMemoryStorage()))
+    interp.execute(
+        "UNWIND range(0, 63) AS i CREATE (:N {v: i})")
+    interp.execute(
+        "MATCH (a:N), (b:N) WHERE b.v = a.v + 1 OR b.v = a.v * 2 "
+        "CREATE (a)-[:E]->(b)")
+
+    # mesh-routed analytics under the same trace: the device stages
+    # (transfer + chunked iterate) must join the query's trace exactly
+    # as a kernel-server dispatch would
+    handle = T.begin_trace("query")
+    with T.activate(handle.ctx):
+        import numpy as np
+        from memgraph_tpu.ops import csr
+        from memgraph_tpu.parallel import analytics
+        from memgraph_tpu.parallel.mesh import get_mesh_context
+        rng = np.random.default_rng(0)
+        src = rng.integers(0, 64, 512)
+        dst = rng.integers(0, 64, 512)
+        graph = csr.from_coo(src, dst, n_nodes=64)
+        ranks, err, iters = analytics.pagerank_mesh(
+            graph, get_mesh_context(1), max_iterations=10,
+            checkpoint_every=4)
+    handle.finish(status="ok")
+    if len(ranks) != 64 or int(iters) < 1:
+        fail(f"analytics smoke returned ranks={len(ranks)} iters={iters}")
+
+    traces = T.traces_json()
+    if len(traces) < 3:
+        fail(f"expected >=3 retained traces, got {len(traces)}")
+
+    want_query = {"query", "query.parse", "query.plan", "query.execute",
+                  "query.commit", "mvcc.begin", "mvcc.commit"}
+    got_query = {s["name"] for s in traces[0]}
+    if not want_query <= got_query:
+        fail(f"query trace missing spans: {want_query - got_query}")
+
+    device_trace = traces[-1]
+    got_device = {s["name"] for s in device_trace}
+    if not {"query", "device.transfer", "device.chunk"} <= got_device:
+        fail(f"device trace missing spans: got {got_device}")
+
+    for spans in traces:
+        ids = {s["span_id"] for s in spans}
+        tids = {s["trace_id"] for s in spans}
+        if len(tids) != 1:
+            fail(f"trace mixes trace_ids: {tids}")
+        dangling = [s["name"] for s in spans
+                    if s["parent_id"] and s["parent_id"] not in ids]
+        if dangling:
+            fail(f"dangling parent links: {dangling}")
+        roots = [s for s in spans if not s["parent_id"]]
+        if len(roots) != 1:
+            fail(f"expected exactly one root span, got "
+                 f"{[s['name'] for s in roots]}")
+
+    doc = T.chrome_trace()
+    encoded = json.dumps(doc)
+    parsed = json.loads(encoded)
+    events = parsed.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail("chrome export has no traceEvents")
+    for ev in events:
+        for key in ("name", "cat", "ph", "ts", "dur", "pid", "tid",
+                    "args"):
+            if key not in ev:
+                fail(f"chrome event missing {key!r}: {ev}")
+        if ev["ph"] != "X":
+            fail(f"expected complete ('X') events, got {ev['ph']!r}")
+        if not (isinstance(ev["ts"], (int, float)) and ev["ts"] > 0):
+            fail(f"bad ts in {ev}")
+        if not (isinstance(ev["dur"], (int, float)) and ev["dur"] > 0):
+            fail(f"bad dur in {ev}")
+        if "trace_id" not in ev["args"]:
+            fail(f"chrome event args missing trace_id: {ev}")
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(encoded)
+        print(f"trace-smoke: wrote {len(events)} events to {args.out}")
+
+    print(f"trace-smoke: OK — {len(traces)} traces, {len(events)} "
+          "chrome events, all parent links resolve")
+
+
+if __name__ == "__main__":
+    main()
