@@ -174,6 +174,33 @@ class CoordinatorInstance:
         self._reconfigure_data_instances(name)
         return True
 
+    def federated_prometheus_text(self) -> str:
+        """One labeled exposition for the whole cluster (r14, mgstat).
+
+        Scrapes every registered data instance's metrics through the
+        mgmt channel (main + replicas), plus this coordinator's own
+        registry; instances exposing a resident kernel daemon contribute
+        it as a separate ``<name>-kernel-daemon`` series. Unreachable
+        instances are simply absent — the scrape must degrade, not
+        fail, under partitions."""
+        from ..observability import stats as mgstats
+        global_metrics.increment("coordination.federation_scrapes_total")
+        parts: dict[str, str] = {
+            self.raft.node_id: global_metrics.prometheus_text()}
+        with self._lock:
+            instances = [dict(i) for i in self.instances.values()]
+        for inst in instances:
+            resp = mgmt_call(inst["mgmt_address"], {"kind": "metrics"},
+                             timeout=2.0, src=self.raft.node_id,
+                             dst=inst["name"])
+            if resp is None or not resp.get("ok"):
+                continue
+            parts[inst["name"]] = resp.get("text", "")
+            daemon = resp.get("daemon_text")
+            if daemon:
+                parts[f"{inst['name']}-kernel-daemon"] = daemon
+        return mgstats.federate_expositions(parts)
+
     def show_instances(self) -> list[list]:
         with self._lock:
             instances = [dict(i) for i in self.instances.values()]

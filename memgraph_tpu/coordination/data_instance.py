@@ -126,7 +126,46 @@ class DataInstanceManagementServer:
                 return {"ok": False, "errors": [str(e)]}
             return {"ok": True,
                     "fencing_epoch": replication.current_epoch()}
+        if kind == "metrics":
+            # scrape federation (r14, mgstat): the coordinator pulls
+            # every instance's exposition through the mgmt channel and
+            # serves one labeled payload. When a resident kernel daemon
+            # is reachable its health counters ride along as a separate
+            # exposition, so the accelerator plane appears as its own
+            # federated instance.
+            from ..observability.metrics import global_metrics
+            resp = {"ok": True, "role": replication.role,
+                    "text": global_metrics.prometheus_text()}
+            daemon = self._kernel_daemon_exposition()
+            if daemon:
+                resp["daemon_text"] = daemon
+            return resp
         return {"ok": False, "errors": [f"unknown request {kind}"]}
+
+    def _kernel_daemon_exposition(self) -> str | None:
+        """The local kernel daemon's counters as an exposition, or None
+        when no daemon socket is configured/answering."""
+        sock = (getattr(self.ictx, "config", {}) or {}).get(
+            "kernel_server_socket")
+        if not sock:
+            return None
+        from ..observability import stats as mgstats
+        from ..server.kernel_server import SupervisedKernelClient
+        client = SupervisedKernelClient(sock, spawn=False)
+        try:
+            health = client.health(timeout=1.0)
+        finally:
+            client.close()
+        if health is None:
+            return None
+        return mgstats.counters_exposition(
+            health.get("counters"),
+            {"kernel_server.daemon.in_flight":
+                 float(health.get("in_flight", 0)),
+             "kernel_server.daemon.wedged":
+                 1.0 if health.get("wedged") else 0.0,
+             "kernel_server.daemon.uptime_s":
+                 float(health.get("uptime_s", 0.0))})
 
 
 def mgmt_call(address: str, request: dict, timeout: float = 2.0,

@@ -1,11 +1,17 @@
-"""Monitoring HTTP endpoint: Prometheus metrics + JSON status + traces.
+"""Monitoring HTTP endpoint: Prometheus metrics + JSON status + traces
++ workload stats + readiness.
 
 Counterpart of the reference's metrics/monitoring servers
 (/root/reference/src/glue/PrometheusServerT.cpp, src/http_handlers/):
 GET /metrics → Prometheus text; GET /status → JSON storage info;
 GET /traces → retained mgtrace traces (JSON), ?format=chrome for
 Chrome-trace-event JSON loadable in Perfetto, ?trace_id=<id> to fetch
-the one trace a slow-query log line names.
+the one trace a slow-query log line names; GET /stats → per-fingerprint
+workload statistics (mgstat top-K, linked trace_ids, plan-cache hit
+counts); GET /health → the saturation plane's readiness verdict —
+HTTP 200 when ready, 503 with machine-readable reasons when any bounded
+resource is saturated (the shape load balancers and admission control
+consume).
 """
 
 from __future__ import annotations
@@ -13,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import json
 
+from . import stats as mgstats
 from . import trace as mgtrace
 from .metrics import global_metrics
 
@@ -26,6 +33,7 @@ async def start_monitoring_server(host: str, port: int, ictx):
                 if line in (b"\r\n", b"\n", b""):
                     break
             path = request.split()[1].decode() if request.split() else "/"
+            status = "200 OK"
             if path.startswith("/metrics"):
                 # --metrics-format picks the default payload; the
                 # /metrics?format= query overrides per request
@@ -57,6 +65,22 @@ async def start_monitoring_server(host: str, port: int, ictx):
                         "traces": mgtrace.traces_json(trace_id)},
                         default=str)
                 ctype = "application/json"
+            elif path.startswith("/stats"):
+                # mgstat workload statistics: bounded top-K fingerprints
+                # with latency quantiles, error/plan-cache-hit counts,
+                # and the retained trace_ids each shape links to
+                body = json.dumps({
+                    "enabled": mgstats.global_query_stats.enabled(),
+                    "capacity": mgstats.global_query_stats.capacity,
+                    "fingerprints": mgstats.global_query_stats.snapshot()},
+                    default=str)
+                ctype = "application/json"
+            elif path.startswith("/health"):
+                verdict = mgstats.global_saturation.evaluate(ictx)
+                if not verdict["ready"]:
+                    status = "503 Service Unavailable"
+                body = json.dumps(verdict, default=str)
+                ctype = "application/json"
             else:
                 info = dict(ictx.storage.info())
                 with ictx._rq_lock:
@@ -65,7 +89,7 @@ async def start_monitoring_server(host: str, port: int, ictx):
                 ctype = "application/json"
             payload = body.encode("utf-8")
             writer.write(
-                b"HTTP/1.1 200 OK\r\n"
+                f"HTTP/1.1 {status}\r\n".encode()
                 + f"Content-Type: {ctype}\r\n".encode()
                 + f"Content-Length: {len(payload)}\r\n".encode()
                 + b"Connection: close\r\n\r\n" + payload)

@@ -48,6 +48,77 @@ def _promlabel(value: str) -> str:
 #: and cross-metric comparisons honest.
 DEFAULT_BUCKETS = tuple(0.0001 * (2 ** i) for i in range(24))
 
+#: Every metric name product code may emit through ``global_metrics``
+#: (r14, mgstat). Entries ending in ``*`` declare a dynamic FAMILY whose
+#: members share the literal prefix (``operator.*`` covers
+#: ``operator.ScanAll`` etc.). mglint MG005 (stat-registry) statically
+#: enforces that (a) every literal name passed to increment()/
+#: set_gauge()/observe() appears here (or matches a family), (b) every
+#: f-string name's literal prefix matches a declared family, (c) every
+#: declared name/family has at least one live emit site, and (d) no
+#: name is declared twice — a typo'd metric silently splits a series,
+#: and a dead registration means dashboards "cover" a metric that can
+#: never move.
+STAT_NAMES = (
+    # query engine
+    "query.prepared",
+    "query.finished",
+    "query.execution_latency_sec",
+    "operator.*",                  # per-operator completion counters
+    "storage.*",                   # per-query write-stat counters
+    "mgstat.evictions_total",      # space-saving top-K evictions
+    # bolt session pool
+    "bolt.prepare_latency_sec",
+    "bolt.connections_rejected_total",
+    "bolt.sessions_live",
+    "bolt.sessions_max",
+    # multiprocess read executor
+    "mp_executor.in_flight",
+    "mp_executor.workers",
+    "mp_executor.errors_total",
+    # kernel server (local process + mirrored daemon state)
+    "kernel_server.dispatch.*",    # typed per-outcome dispatch counters
+    "kernel_server.daemon.*",      # daemon counters mirrored as gauges
+    "kernel_server.admission_rejected_total",
+    "kernel_server.dispatch_latency_sec",
+    "kernel_server.in_flight",
+    "kernel_server.hbm_budget_bytes",
+    "kernel_server.supervisor.health_checks_total",
+    "kernel_server.supervisor.wedge_detected_total",
+    "kernel_server.supervisor.restarts_total",
+    "kernel_server.client.retries_total",
+    # analytics / checkpoint plane
+    "analytics.checkpoint.saved_total",
+    "analytics.checkpoint.restored_total",
+    "analytics.resume_total",
+    "analytics.chunk_deadline_exceeded_total",
+    "analytics.resumable_run_seconds",
+    "analytics.device_fault.*",    # typed per-kind device-fault counters
+    "analytics.kernel_routed_total",
+    "analytics.kernel_route_fallback_total",
+    # durability
+    "wal.fsync_latency_sec",
+    "wal.fsync_backlog_bytes",
+    "wal.segments_rotated",
+    "wal.recovery_truncations",
+    # replication
+    "replication.rpc_failures",
+    "replication.ship_latency_sec",
+    "replication.fenced_total",
+    "replication.strict_sync_demotions",
+    "replication.replica_lag.*",       # per-replica txn lag gauges
+    "replication.replica_health.*",    # per-replica up/down gauges
+    "replication.replica_degraded.*",  # per-replica STRICT_SYNC demotions
+    # coordination
+    "coordination.current_epoch",
+    "coordination.failover_attempts",
+    "coordination.failovers_total",
+    "coordination.federation_scrapes_total",
+    # saturation plane
+    "health.ready",
+    "health.not_ready_total",
+)
+
 
 class Histogram:
     """Fixed-bucket histogram with cumulative exposition + exemplars.

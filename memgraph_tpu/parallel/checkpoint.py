@@ -198,6 +198,13 @@ def run_resumable(*, algo: str, chunk, carry, carry_to_host,
                 continue
             faults_in_a_row = 0
             elapsed = time.monotonic() - t0
+            # mgstat device attribution: the FIRST completed chunk folds
+            # XLA compilation (same convention as the device.chunk span),
+            # later chunks are pure iteration time
+            from ..observability import stats as mgstats
+            mgstats.record_stage(
+                "device_compile" if report.chunks == 0
+                else "device_iterate", elapsed)
             if chunk_deadline_s is not None and elapsed > chunk_deadline_s:
                 # the chunk COMPLETED, late — the analytics-plane analog
                 # of the kernel server's deadline_exceeded outcome

@@ -17,9 +17,20 @@ All `*_tpu` aliases expose the same procedures for explicit dispatch.
 
 from __future__ import annotations
 
+import logging
+import os
+import threading
+
 import numpy as np
 
 from . import mgp
+
+log = logging.getLogger(__name__)
+
+#: per-socket supervised clients, shared across queries (the client owns
+#: a connection + supervision state; one per daemon is the contract)
+_KERNEL_CLIENTS: dict = {}
+_KERNEL_CLIENTS_LOCK = threading.Lock()
 
 
 def _rank_results(ctx, graph, values, field_name):
@@ -29,15 +40,91 @@ def _rank_results(ctx, graph, values, field_name):
             yield {"node": node, field_name: float(values[i])}
 
 
+def _kernel_route_socket(ctx) -> str | None:
+    """The resident-kernel-server socket analytics should route through,
+    or None for the in-process path. Config key ``kernel_server_socket``
+    (the server entry point sets it) or the
+    MEMGRAPH_TPU_ANALYTICS_KERNEL_SERVER env var; the value "1" means
+    the default socket."""
+    ictx = getattr(ctx.exec_ctx, "interpreter_context", None)
+    cfg = getattr(ictx, "config", None) or {}
+    sock = cfg.get("kernel_server_socket") or os.environ.get(
+        "MEMGRAPH_TPU_ANALYTICS_KERNEL_SERVER")
+    if not sock:
+        return None
+    if sock in ("1", "default"):
+        from ..server.kernel_server import DEFAULT_SOCKET
+        return DEFAULT_SOCKET
+    return str(sock)
+
+
+def _kernel_client(sock: str, spawn: bool):
+    from ..server.kernel_server import SupervisedKernelClient
+    with _KERNEL_CLIENTS_LOCK:
+        client = _KERNEL_CLIENTS.get(sock)
+        if client is None:
+            client = _KERNEL_CLIENTS[sock] = SupervisedKernelClient(
+                sock, spawn=spawn)
+        return client
+
+
+def _graph_coo(graph):
+    """Host COO arrays of the true edges (weights only when real)."""
+    if graph.host_coo is not None:
+        src, dst, w = graph.host_coo
+        return (np.asarray(src, dtype=np.int64),
+                np.asarray(dst, dtype=np.int64),
+                None if w is None else np.asarray(w, dtype=np.float32))
+    n = graph.n_edges
+    return (np.asarray(graph.src_idx, dtype=np.int64)[:n],
+            np.asarray(graph.col_idx, dtype=np.int64)[:n],
+            np.asarray(graph.weights, dtype=np.float32)[:n])
+
+
+def _kernel_server_pagerank(ctx, graph, damping, max_iterations, tol):
+    """Route pagerank through the resident kernel server when one is
+    configured; returns ranks or None (→ caller runs in-process).
+
+    The dispatch's device attribution (transfer/compile/iterate splits)
+    ships home in the reply and lands in the active stage accumulator,
+    so PROFILE on the routed query still shows where HBM-seconds went.
+    A kernel-plane failure falls back to the in-process path LOUDLY —
+    analytics availability beats routing purity."""
+    sock = _kernel_route_socket(ctx)
+    if sock is None:
+        return None
+    from ..observability.metrics import global_metrics
+    from ..server.kernel_server import KernelServerError
+    src, dst, weights = _graph_coo(graph)
+    try:
+        client = _kernel_client(sock, spawn=False)
+        ranks, _err, _iters = client.pagerank(
+            src=src, dst=dst, weights=weights, n_nodes=graph.n_nodes,
+            graph_key=f"proc:{id(graph)}:{graph.n_nodes}:{graph.n_edges}",
+            damping=float(damping), max_iterations=int(max_iterations),
+            tol=float(tol))
+        global_metrics.increment("analytics.kernel_routed_total")
+        return np.asarray(ranks)[:graph.n_nodes]
+    except (KernelServerError, ConnectionError, OSError) as e:
+        global_metrics.increment("analytics.kernel_route_fallback_total")
+        log.warning("kernel-server pagerank route failed (%s: %s); "
+                    "falling back to the in-process path",
+                    type(e).__name__, e)
+        return None
+
+
 def _pagerank_impl(ctx, max_iterations=100, damping_factor=0.85,
                    stop_epsilon=1e-5, weight_property=None):
     from ..ops.pagerank import pagerank
     graph = ctx.device_graph(weight_property=weight_property)
     if graph.n_nodes == 0:
         return
-    ranks, _, _ = pagerank(graph, damping=float(damping_factor),
-                           max_iterations=int(max_iterations),
-                           tol=float(stop_epsilon))
+    ranks = _kernel_server_pagerank(ctx, graph, damping_factor,
+                                    max_iterations, stop_epsilon)
+    if ranks is None:
+        ranks, _, _ = pagerank(graph, damping=float(damping_factor),
+                               max_iterations=int(max_iterations),
+                               tol=float(stop_epsilon))
     ranks = np.asarray(ranks)
     yield from _rank_results(ctx, graph, ranks, "rank")
 

@@ -566,6 +566,11 @@ class Parser:
         if self.accept_kw("METRICS"):
             self.accept_kw("INFO")
             return A.InfoQuery("metrics")
+        if self.accept_kw("QUERY"):
+            # SHOW QUERY STATS (r14, mgstat): bounded top-K fingerprint
+            # statistics from observability/stats.py
+            self.expect_kw("STATS")
+            return A.InfoQuery("query_stats")
         if self.at(T.IDENT) and self.cur.value.upper() == "LICENSE":
             self.advance()
             self.expect_kw("INFO")

@@ -94,13 +94,15 @@ class InterpreterContext:
         return node
 
     def cached_plan(self, text: str, query: A.CypherQuery):
+        """Returns (plan, columns, cache_hit) — the hit flag feeds the
+        per-fingerprint plan-cache hit-rate in SHOW QUERY STATS."""
         from ..utils.sanitize import shared_read, shared_write
         key = text.strip()
         with self._plan_cache_lock:
             shared_read(self, "_plan_cache")
             hit = self._plan_cache.get(key)
         if hit is not None:
-            return hit
+            return hit[0], hit[1], True
         planner = Planner(self.storage, self.config)
         import copy
         plan, columns = planner.plan_query(copy.deepcopy(query))
@@ -108,7 +110,7 @@ class InterpreterContext:
             shared_write(self, "_plan_cache")
             if len(self._plan_cache) < 256:
                 self._plan_cache[key] = (plan, columns)
-        return plan, columns
+        return plan, columns, False
 
     def invalidate_plans(self) -> None:
         with self._plan_cache_lock:
@@ -162,6 +164,10 @@ class Interpreter:
         self._trace_root = None
         self._phase_s: dict[str, float] = {}
         self._prepare_finished: tuple[float, float] | None = None
+        # mgstat: per-query fingerprint accounting state
+        self._query_fingerprint: str | None = None
+        self._plan_cache_hit = False
+        self._rows_emitted = 0
 
     # --- public API ---------------------------------------------------------
 
@@ -875,8 +881,17 @@ class Interpreter:
             strip = strip.split(None, 1)[1] if " " in strip else strip
         t0 = time.perf_counter()
         with mgtrace.span("query.plan"):
-            plan, columns = self.ctx.cached_plan(strip, query)
+            plan, columns, cache_hit = self.ctx.cached_plan(strip, query)
         self._phase_s["plan"] = time.perf_counter() - t0
+        # mgstat: the fingerprint is keyed off the same stripped text as
+        # the plan cache, so repeat queries pay one memo-dict lookup
+        from ..observability.stats import global_query_stats
+        if global_query_stats.enabled():
+            self._query_fingerprint = global_query_stats.fingerprint(strip)
+        else:
+            self._query_fingerprint = None
+        self._plan_cache_hit = cache_hit
+        self._rows_emitted = 0
 
         if self.ctx.config.get("debug_query_plans"):
             import logging
@@ -977,14 +992,14 @@ class Interpreter:
         self._exec_ctx = exec_ctx
 
         if query.profile:
+            from .plan.profile import PROFILE_COLUMNS
             plan, collector = attach_profiling(plan)
             self._profile_plan = (plan, collector)
             self._profile_start = time.perf_counter()
             rows_iter = self._profile_rows_iter(plan, exec_ctx, columns)
-            columns_out = ["OPERATOR", "ACTUAL HITS", "RELATIVE TIME",
-                           "ABSOLUTE TIME"]
             self._install_stream(rows_iter, accessor, owns)
-            return self._finish_prepare(columns_out, "r", is_write)
+            return self._finish_prepare(list(PROFILE_COLUMNS), "r",
+                                        is_write)
 
         qinfo = {"query": text, "start": time.time(),
                  "interpreter": self}
@@ -1005,6 +1020,7 @@ class Interpreter:
                     return
                 for frame in plan.cursor(exec_ctx):
                     row = frame.get("__row__", {})
+                    self._rows_emitted += 1
                     yield [row.get(c) for c in columns]
             finally:
                 with self.ctx._rq_lock:
@@ -1014,12 +1030,19 @@ class Interpreter:
         return self._finish_prepare(columns, "rw", is_write)
 
     def _profile_rows_iter(self, plan, exec_ctx, columns):
-        # drain fully, then emit the profile tree
-        for _ in plan.cursor(exec_ctx):
-            pass
+        # drain fully under an active stage accumulator (device work —
+        # in-process mesh kernels OR kernel-server dispatches whose
+        # replies ship their stage splits home — attributes to it),
+        # then emit the profile tree
+        from ..observability import stats as mgstats
+        acc = mgstats.StageAccumulator()
+        with mgstats.collecting_stages(acc):
+            for _ in plan.cursor(exec_ctx):
+                self._rows_emitted += 1
         total = time.perf_counter() - self._profile_start
         plan_obj, collector = self._profile_plan
-        yield from profile_rows(plan_obj, collector, total)
+        yield from profile_rows(plan_obj, collector, total,
+                                stages=acc.snapshot())
 
     def _install_stream(self, iterator, accessor, owns_txn):
         self._stream = iterator
@@ -1063,6 +1086,21 @@ class Interpreter:
         if started is not None:
             elapsed = time.monotonic() - started
             global_metrics.observe("query.execution_latency_sec", elapsed)
+            # mgstat: per-fingerprint accounting (Cypher queries only —
+            # admin statements never set a fingerprint). Recorded after
+            # the commit so a constraint-violating query lands in the
+            # error path below instead.
+            fp = getattr(self, "_query_fingerprint", None)
+            if fp is not None:
+                from ..observability.stats import global_query_stats
+                global_query_stats.record(
+                    fp, elapsed, rows=getattr(self, "_rows_emitted", 0),
+                    error=False,
+                    plan_cache_hit=getattr(self, "_plan_cache_hit",
+                                           False),
+                    trace_id=self._trace_root.trace_id
+                    if self._trace_root is not None else None)
+                self._query_fingerprint = None
             min_ms = self.ctx.config.get("log_min_duration_ms") or 0
             slow = min_ms and elapsed * 1000.0 >= min_ms and \
                 not getattr(self, "_query_priv_auth", False)
@@ -1102,6 +1140,20 @@ class Interpreter:
         return summary
 
     def _cleanup_stream(self, error: bool = False) -> None:
+        started = getattr(self, "_query_started", None)
+        fp = getattr(self, "_query_fingerprint", None)
+        if fp is not None and started is not None and error:
+            # errored/aborted queries count against their fingerprint
+            # too — an error-heavy hot shape is exactly what SHOW QUERY
+            # STATS exists to surface
+            from ..observability.stats import global_query_stats
+            global_query_stats.record(
+                fp, time.monotonic() - started,
+                rows=getattr(self, "_rows_emitted", 0), error=True,
+                plan_cache_hit=getattr(self, "_plan_cache_hit", False),
+                trace_id=self._trace_root.trace_id
+                if self._trace_root is not None else None)
+        self._query_fingerprint = None
         self._query_started = None
         self._pending_op_counts = None
         if self._exec_ctx is not None:
@@ -1239,22 +1291,46 @@ class Interpreter:
             return self._prepare_generator(iter(rows),
                                            ["storage info", "value"], "r")
         if node.kind == "index":
+            # usage columns (r14, mgstat): lookups served, rows returned,
+            # last-used timestamp — an index with writes but no lookups
+            # is silent write overhead, now visible
             rows = []
             lm, pm = storage.label_mapper, storage.property_mapper
+
+            def usage_cols(usage):
+                if usage is None:
+                    return [0, 0, None]
+                return [usage.lookups, usage.rows,
+                        _iso_utc(usage.last_used)]
+
             for lid in storage.indices.label.labels():
                 rows.append(["label", lm.id_to_name(lid), None,
-                             storage.indices.label.approx_count(lid)])
+                             storage.indices.label.approx_count(lid)]
+                            + usage_cols(storage.indices.label.usage(lid)))
             for (lid, pids) in storage.indices.label_property.keys():
                 rows.append(["label+property", lm.id_to_name(lid),
                              [pm.id_to_name(p) for p in pids],
                              storage.indices.label_property.approx_count(
-                                 lid, pids)])
+                                 lid, pids)]
+                            + usage_cols(
+                                storage.indices.label_property.usage(
+                                    lid, pids)))
             for tid in storage.indices.edge_type.types():
                 rows.append(["edge-type",
                              storage.edge_type_mapper.id_to_name(tid), None,
-                             storage.indices.edge_type.approx_count(tid)])
+                             storage.indices.edge_type.approx_count(tid)]
+                            + usage_cols(
+                                storage.indices.edge_type.usage(tid)))
             return self._prepare_generator(
-                iter(rows), ["index type", "label", "property", "count"], "r")
+                iter(rows),
+                ["index type", "label", "property", "count", "lookups",
+                 "rows_returned", "last_used"], "r")
+        if node.kind == "query_stats":
+            from ..observability.stats import (QUERY_STATS_COLUMNS,
+                                               global_query_stats)
+            return self._prepare_generator(
+                iter(global_query_stats.rows()),
+                list(QUERY_STATS_COLUMNS), "r")
         if node.kind == "constraint":
             rows = []
             lm, pm = storage.label_mapper, storage.property_mapper
@@ -1582,6 +1658,15 @@ def _redact_literals(text: str) -> str:
     AUTH statements (which are skipped entirely)."""
     import re
     return re.sub(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"", "'***'", text)
+
+
+def _iso_utc(ts: float | None) -> str | None:
+    """Unix seconds -> ISO-8601 UTC string (SHOW INDEX INFO last_used)."""
+    if not ts:
+        return None
+    import datetime
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc).isoformat()
 
 
 def _parse_period(text: str) -> float:

@@ -698,20 +698,28 @@ class BoltServer:
             if workers > 0 else None)
 
     async def _handle(self, reader, writer):
+        from ..observability.metrics import global_metrics
         session = BoltSession(reader, writer, self.ictx, self.auth,
                               executor=self._executor)
         if self.max_sessions and self._live_sessions >= self.max_sessions:
-            from ..observability.metrics import global_metrics
             global_metrics.increment("bolt.connections_rejected_total")
             log.warning("bolt: refusing connection, %d/%d sessions live",
                         self._live_sessions, self.max_sessions)
             await session.refuse_overloaded()
             return
         self._live_sessions += 1
+        # USE-style pool gauges for the saturation plane (GET /health):
+        # live vs cap makes pool exhaustion machine-readable
+        global_metrics.set_gauge("bolt.sessions_live",
+                                 float(self._live_sessions))
+        global_metrics.set_gauge("bolt.sessions_max",
+                                 float(self.max_sessions or 0))
         try:
             await session.run()
         finally:
             self._live_sessions -= 1
+            global_metrics.set_gauge("bolt.sessions_live",
+                                     float(self._live_sessions))
 
     async def start(self):
         self._server = await asyncio.start_server(

@@ -59,6 +59,7 @@ import time
 
 import numpy as np
 
+from ..observability import stats as mgstats
 from ..observability import trace as mgtrace
 from ..observability.metrics import global_metrics
 from ..utils.devicefault import classify_device_error, device_fault_point
@@ -283,6 +284,10 @@ class KernelServer:
         self._sock_ino = None        # inode of OUR bound socket path
         shared_field(self, "_graphs", "_last_activity", "_active",
                      "_dispatch_seq", "_graphs_cached", "_platform")
+        # saturation plane: the admission budget is a bounded resource —
+        # export it so capacity planning can see utilization vs limit
+        global_metrics.set_gauge("kernel_server.hbm_budget_bytes",
+                                 float(self.hbm_budget_bytes))
 
     def _touch_activity(self) -> None:
         from ..utils.sanitize import shared_write
@@ -435,29 +440,41 @@ class KernelServer:
             did = self._dispatch_seq
             self._active[did] = (time.monotonic(),
                                  deadline_s or self.wedge_after_s)
+            global_metrics.set_gauge("kernel_server.in_flight",
+                                     float(len(self._active)))
         box: dict = {}
         t_dispatch = time.perf_counter()
 
         def work():
             try:
                 # the activation is thread-local; the worker thread must
-                # adopt the remote context itself
-                with mgtrace.adopt(carrier):
-                    with mgtrace.span("kernel.dispatch", op=op,
-                                      pid=os.getpid()):
-                        with self._dispatch_lock:
-                            device_fault_point()
-                            box["result"] = self._dispatch_op(op, header,
-                                                              arrays)
+                # adopt the remote context itself. The stage accumulator
+                # collects this dispatch's device attribution (transfer/
+                # compile/iterate splits from the mesh entry points);
+                # its snapshot ships home in the reply header so the
+                # CALLER's PROFILE sees where the HBM-seconds went.
+                acc = mgstats.StageAccumulator()
+                with mgstats.collecting_stages(acc):
+                    with mgtrace.adopt(carrier):
+                        with mgtrace.span("kernel.dispatch", op=op,
+                                          pid=os.getpid()):
+                            with self._dispatch_lock:
+                                device_fault_point()
+                                box["result"] = self._dispatch_op(
+                                    op, header, arrays)
+                box["stages"] = acc.snapshot()
             except BaseException as e:  # noqa: BLE001 — classified below
                 box["exc"] = e
             finally:
                 with self._stats_lock:
                     shared_write(self, "_active")
                     self._active.pop(did, None)
+                    global_metrics.set_gauge(
+                        "kernel_server.in_flight",
+                        float(len(self._active)))
 
         def ship_trace(reply: dict) -> dict:
-            """Attach this dispatch's spans + latency to the reply."""
+            """Attach this dispatch's spans + stage splits + latency."""
             global_metrics.observe(
                 "kernel_server.dispatch_latency_sec",
                 time.perf_counter() - t_dispatch,
@@ -466,6 +483,9 @@ class KernelServer:
                 spans = mgtrace.take_trace(carrier["trace_id"])
                 if spans:
                     reply["trace_spans"] = spans
+            stages = box.get("stages")
+            if stages:
+                reply["stages"] = stages
             return reply
 
         t = threading.Thread(target=work, daemon=True,
@@ -612,6 +632,9 @@ class KernelClient:
         spans = h.pop("trace_spans", None)
         if spans:
             mgtrace.adopt_spans(spans)
+        # same for the dispatch's device-stage splits: merge into the
+        # caller's active stage accumulator (PROFILE attribution)
+        mgstats.merge_stages(h.pop("stages", None))
         return h, out
 
     def ping(self) -> bool:
@@ -878,12 +901,18 @@ class SupervisedKernelClient:
         for _attempt in self.retry.attempts():
             try:
                 c = self._connect()
+                t0 = time.perf_counter()
                 with mgtrace.span("kernel.request", op="pagerank",
                                   attempt=_attempt):
-                    return c.pagerank(src=src, dst=dst, weights=weights,
-                                      n_nodes=n_nodes,
-                                      graph_key=graph_key,
-                                      deadline_s=deadline_s, **params)
+                    result = c.pagerank(src=src, dst=dst, weights=weights,
+                                        n_nodes=n_nodes,
+                                        graph_key=graph_key,
+                                        deadline_s=deadline_s, **params)
+                # client-observed dispatch wall time (request + device +
+                # reply) for the caller's PROFILE attribution
+                mgstats.record_stage("kernel_dispatch",
+                                     time.perf_counter() - t0)
+                return result
             except (AdmissionRejected, KernelOom):
                 # deterministic against this budget/graph: retry is noise
                 raise

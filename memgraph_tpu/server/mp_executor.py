@@ -30,6 +30,7 @@ import os
 import pickle
 import struct
 import threading
+import time
 
 from ..observability import trace as mgtrace
 
@@ -60,11 +61,17 @@ def _recv(fd):
 
 class MPReadExecutor:
     def __init__(self, ictx, n_workers: int = 4) -> None:
+        from ..observability.metrics import global_metrics
         self._ictx = ictx
         self._n = max(1, n_workers)
         self._workers: list = []       # (pid, req_fd, resp_fd)
         self._locks: list = []
         self._rr = itertools.count()
+        # saturation plane: in-flight vs worker count = queue depth
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        global_metrics.set_gauge("mp_executor.workers", float(self._n))
+        global_metrics.set_gauge("mp_executor.in_flight", 0.0)
         self._fork()
 
     # -- lifecycle ----------------------------------------------------------
@@ -159,16 +166,39 @@ class MPReadExecutor:
     def execute(self, query: str, params: dict | None = None):
         """Round-robin a read-only query to a worker; returns
         (columns, rows). Raises RuntimeError on worker-side errors."""
+        from ..observability.metrics import global_metrics
+        from ..observability.stats import global_query_stats
         if not self._workers:
             raise RuntimeError("executor is closed")
         i = next(self._rr) % len(self._workers)
         pid, req_fd, resp_fd = self._workers[i]
-        with mgtrace.span("mp.execute", worker=i, worker_pid=pid):
-            with self._locks[i]:
-                _send(req_fd, (query, params or {}, mgtrace.inject()))
-                out = _recv(resp_fd)
+        with self._inflight_lock:
+            self._inflight += 1
+            global_metrics.set_gauge("mp_executor.in_flight",
+                                     float(self._inflight))
+        t0 = time.perf_counter()
+        try:
+            with mgtrace.span("mp.execute", worker=i, worker_pid=pid):
+                with self._locks[i]:
+                    _send(req_fd, (query, params or {}, mgtrace.inject()))
+                    out = _recv(resp_fd)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+                global_metrics.set_gauge("mp_executor.in_flight",
+                                         float(self._inflight))
         if out[0] == "err":
+            # worker-side stats die with the forked snapshot; the parent
+            # registry is the authoritative fingerprint table, so the
+            # routed query accounts HERE — errors included
+            global_metrics.increment("mp_executor.errors_total")
+            global_query_stats.record_text(
+                query, time.perf_counter() - t0, rows=0, error=True,
+                trace_id=mgtrace.current_trace_id())
             raise RuntimeError(f"{out[1]}: {out[2]}")
         if len(out) > 3:
             mgtrace.adopt_spans(out[3])
+        global_query_stats.record_text(
+            query, time.perf_counter() - t0, rows=len(out[2]),
+            trace_id=mgtrace.current_trace_id())
         return out[1], out[2]

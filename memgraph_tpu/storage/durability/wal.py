@@ -323,6 +323,10 @@ class WalFile:
         self.sync_every_commit = sync_every_commit
         self.storage = storage
         self._seq = next_segment_seq(self.dir)
+        # bytes flushed but not yet fsynced (batched-fsync mode): the
+        # saturation plane's wal_fsync_backlog check reads this gauge —
+        # a growing backlog is acked-but-volatile data at risk
+        self._unsynced_bytes = 0
         self._open_segment()
 
     def _open_segment(self) -> None:
@@ -348,6 +352,10 @@ class WalFile:
                 os.fsync(self._file.fileno())
                 global_metrics.observe("wal.fsync_latency_sec",
                                        time.perf_counter() - t0)
+            else:
+                self._unsynced_bytes += len(frame)
+                global_metrics.set_gauge("wal.fsync_backlog_bytes",
+                                         float(self._unsynced_bytes))
             if self._file.tell() >= self.segment_size:
                 self._rotate_locked()
 
@@ -355,6 +363,9 @@ class WalFile:
         from ...observability.metrics import global_metrics
         self._file.flush()
         os.fsync(self._file.fileno())
+        if self._unsynced_bytes:
+            self._unsynced_bytes = 0
+            global_metrics.set_gauge("wal.fsync_backlog_bytes", 0.0)
         self._file.close()
         self._seq += 1
         self._open_segment()

@@ -21,6 +21,28 @@ from ..utils.locks import tracked_lock
 from .ordering import order_key
 
 
+class IndexUsage:
+    """Per-index usage accounting (r14, mgstat): lookups served, rows
+    returned, last-used wall time — surfaced by SHOW INDEX INFO so an
+    index that only ever absorbs writes is visible instead of silent
+    overhead. Updated once per scan (the scan's row count accumulates
+    locally and flushes in the iterator's ``finally``), so abandoned
+    iterators (LIMIT) still account what they served."""
+
+    __slots__ = ("lookups", "rows", "last_used")
+
+    def __init__(self) -> None:
+        self.lookups = 0
+        self.rows = 0
+        self.last_used = 0.0
+
+    def note(self, rows: int) -> None:
+        import time
+        self.lookups += 1
+        self.rows += rows
+        self.last_used = time.time()
+
+
 class LabelIndex:
     """label_id -> insertion-ordered dict of candidate vertices.
 
@@ -35,6 +57,7 @@ class LabelIndex:
         self._lock = tracked_lock("LabelIndex._lock")
         self._index: dict[int, dict] = {}
         self._ready: dict[int, threading.Event] = {}
+        self._usage: dict[int, IndexUsage] = {}
 
     def create(self, label_id: int, vertices) -> None:
         with self._lock:
@@ -89,7 +112,18 @@ class LabelIndex:
     def drop(self, label_id: int) -> bool:
         with self._lock:
             self._ready.pop(label_id, None)
+            self._usage.pop(label_id, None)
             return self._index.pop(label_id, None) is not None
+
+    def note_usage(self, label_id: int, rows: int) -> None:
+        with self._lock:
+            usage = self._usage.get(label_id)
+            if usage is None:
+                usage = self._usage[label_id] = IndexUsage()
+            usage.note(rows)
+
+    def usage(self, label_id: int) -> IndexUsage | None:
+        return self._usage.get(label_id)
 
     def has(self, label_id: int) -> bool:
         return label_id in self._index
@@ -169,6 +203,7 @@ class LabelPropertyIndex:
         #         "by_gid": dict[gid, set[key_tuple]],
         #         "eq": dict[key_tuple, list[vertex]]}   (point lookups)
         self._index: dict[tuple[int, tuple[int, ...]], dict] = {}
+        self._usage: dict[tuple[int, tuple[int, ...]], IndexUsage] = {}
 
     @staticmethod
     def _entry_key(values) -> tuple:
@@ -186,7 +221,21 @@ class LabelPropertyIndex:
 
     def drop(self, label_id: int, prop_ids: tuple[int, ...]) -> bool:
         with self._lock:
+            self._usage.pop((label_id, prop_ids), None)
             return self._index.pop((label_id, prop_ids), None) is not None
+
+    def note_usage(self, label_id: int, prop_ids: tuple[int, ...],
+                   rows: int) -> None:
+        with self._lock:
+            key = (label_id, prop_ids)
+            usage = self._usage.get(key)
+            if usage is None:
+                usage = self._usage[key] = IndexUsage()
+            usage.note(rows)
+
+    def usage(self, label_id: int,
+              prop_ids: tuple[int, ...]) -> IndexUsage | None:
+        return self._usage.get((label_id, prop_ids))
 
     def has(self, label_id: int, prop_ids: tuple[int, ...]) -> bool:
         return (label_id, prop_ids) in self._index
@@ -382,6 +431,7 @@ class EdgeTypeIndex:
 
     def __init__(self) -> None:
         self._index: dict[int, dict] = {}
+        self._usage: dict[int, IndexUsage] = {}
 
     def create(self, edge_type_id: int, edges) -> None:
         bucket = self._index.setdefault(edge_type_id, {})
@@ -390,7 +440,17 @@ class EdgeTypeIndex:
                 bucket[e.gid] = e
 
     def drop(self, edge_type_id: int) -> bool:
+        self._usage.pop(edge_type_id, None)
         return self._index.pop(edge_type_id, None) is not None
+
+    def note_usage(self, edge_type_id: int, rows: int) -> None:
+        usage = self._usage.get(edge_type_id)
+        if usage is None:
+            usage = self._usage[edge_type_id] = IndexUsage()
+        usage.note(rows)
+
+    def usage(self, edge_type_id: int) -> IndexUsage | None:
+        return self._usage.get(edge_type_id)
 
     def has(self, edge_type_id: int) -> bool:
         return edge_type_id in self._index

@@ -971,13 +971,20 @@ class Accessor:
                     yield va
             return
         fg = self.fine_grained
-        for vertex in candidates:
-            st = self._vertex_state(vertex, view, need_edges=False)
-            if not st.exists or st.deleted or label_id not in st.labels:
-                continue
-            if fg is not None and not fg.can_read_vertex(st.labels):
-                continue
-            yield VertexAccessor(vertex, self)
+        served = 0
+        try:
+            for vertex in candidates:
+                st = self._vertex_state(vertex, view, need_edges=False)
+                if not st.exists or st.deleted or label_id not in st.labels:
+                    continue
+                if fg is not None and not fg.can_read_vertex(st.labels):
+                    continue
+                served += 1
+                yield VertexAccessor(vertex, self)
+        finally:
+            # mgstat: one usage record per index-served scan (flushed on
+            # abandon too — LIMIT still accounts what it consumed)
+            self.storage.indices.label.note_usage(label_id, served)
 
     def vertices_by_label_property_value(self, label_id: int,
                                          prop_ids: tuple[int, ...], values,
@@ -992,17 +999,23 @@ class Accessor:
                     yield va
             return
         fg = self.fine_grained
-        for vertex in candidates:
-            # one props-only materialization covers visibility, label,
-            # auth, and value revalidation (was four walks per candidate)
-            st = self._vertex_state(vertex, view, need_edges=False)
-            if not st.exists or st.deleted or label_id not in st.labels:
-                continue
-            if fg is not None and not fg.can_read_vertex(st.labels):
-                continue
-            props = st.properties
-            if all(props.get(p) == v for p, v in zip(prop_ids, values)):
-                yield VertexAccessor(vertex, self)
+        served = 0
+        try:
+            for vertex in candidates:
+                # one props-only materialization covers visibility, label,
+                # auth, and value revalidation (was four walks per candidate)
+                st = self._vertex_state(vertex, view, need_edges=False)
+                if not st.exists or st.deleted or label_id not in st.labels:
+                    continue
+                if fg is not None and not fg.can_read_vertex(st.labels):
+                    continue
+                props = st.properties
+                if all(props.get(p) == v for p, v in zip(prop_ids, values)):
+                    served += 1
+                    yield VertexAccessor(vertex, self)
+        finally:
+            self.storage.indices.label_property.note_usage(
+                label_id, prop_ids, served)
 
     def vertices_by_label_property_range(self, label_id: int,
                                          prop_ids: tuple[int, ...],
@@ -1013,33 +1026,42 @@ class Accessor:
         from .ordering import order_key
         candidates = self.storage.indices.label_property.candidates_range(
             label_id, prop_ids, lower, upper, lower_inclusive, upper_inclusive)
+        index_served = candidates is not None
         if candidates is None:
             candidates = []
             for va in self.vertices_by_label(label_id, view):
                 candidates.append(va.vertex)
         seen: set[int] = set()  # add-only index can hold several keys per gid
-        for vertex in candidates:
-            if vertex.gid in seen:
-                continue
-            seen.add(vertex.gid)
-            va = VertexAccessor(vertex, self)
-            if not va.is_visible(view) or not va.has_label(label_id, view):
-                continue
-            if not self._fg_vertex_ok(va, view):
-                continue
-            val = va.get_property(prop_ids[0], view)
-            if val is None:
-                continue
-            k = order_key(val)
-            if lower is not None:
-                lk = order_key(lower)
-                if k < lk or (k == lk and not lower_inclusive):
+        served = 0
+        try:
+            for vertex in candidates:
+                if vertex.gid in seen:
                     continue
-            if upper is not None:
-                uk = order_key(upper)
-                if k > uk or (k == uk and not upper_inclusive):
+                seen.add(vertex.gid)
+                va = VertexAccessor(vertex, self)
+                if not va.is_visible(view) or not va.has_label(label_id,
+                                                               view):
                     continue
-            yield va
+                if not self._fg_vertex_ok(va, view):
+                    continue
+                val = va.get_property(prop_ids[0], view)
+                if val is None:
+                    continue
+                k = order_key(val)
+                if lower is not None:
+                    lk = order_key(lower)
+                    if k < lk or (k == lk and not lower_inclusive):
+                        continue
+                if upper is not None:
+                    uk = order_key(upper)
+                    if k > uk or (k == uk and not upper_inclusive):
+                        continue
+                served += 1
+                yield va
+        finally:
+            if index_served:
+                self.storage.indices.label_property.note_usage(
+                    label_id, prop_ids, served)
 
     def edges_by_type(self, edge_type_id: int,
                       view: View = View.OLD) -> Iterator[EdgeAccessor]:
@@ -1049,10 +1071,15 @@ class Accessor:
                 if ea.edge_type == edge_type_id:
                     yield ea
             return
-        for edge in candidates:
-            ea = EdgeAccessor(edge, self)
-            if ea.is_visible(view) and self._fg_edge_ok(ea, view):
-                yield ea
+        served = 0
+        try:
+            for edge in candidates:
+                ea = EdgeAccessor(edge, self)
+                if ea.is_visible(view) and self._fg_edge_ok(ea, view):
+                    served += 1
+                    yield ea
+        finally:
+            self.storage.indices.edge_type.note_usage(edge_type_id, served)
 
     # --- counts for the planner ---------------------------------------------
 

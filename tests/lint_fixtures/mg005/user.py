@@ -22,3 +22,12 @@ def do_trace(tracer):
         pass
     T.record_span("wired.span", 0.0, 1.0)
     tracer._begin_span("wired.span")    # MG005: manual begin/end API
+
+
+def do_count(kind):
+    from .observability.metrics import global_metrics
+    global_metrics.increment("wired.stat")
+    global_metrics.increment("dup.stat")
+    global_metrics.set_gauge(f"wired.family.{kind}", 1.0)
+    global_metrics.observe("unregistered.stat", 0.5)   # MG005: typo'd name
+    global_metrics.increment(f"ghost.family.{kind}")   # MG005: no family

@@ -31,7 +31,7 @@ def _rows(interp, q, params=None):
 
 
 def _plan(ictx, q):
-    plan, _cols = ictx.cached_plan(q, ictx.cached_parse(q))
+    plan, _cols, _hit = ictx.cached_plan(q, ictx.cached_parse(q))
     return plan
 
 

@@ -134,7 +134,15 @@ def test_mg005_fires_on_coverage_gaps_only():
     assert "span-unregistered:unregistered.span" in msgs
     assert "span-dead:dead.span" in msgs
     assert "span-manual:_begin_span" in msgs
-    assert len(msgs) == 8, msgs              # OP_WIRED is fully covered
+    # r14 stat-registry wiring: an unregistered literal, an unmatched
+    # dynamic prefix, a dead exact name, a dead family, and a duplicate
+    # declaration all fire; wired.stat / wired.family.* stay silent
+    assert "stat-unregistered:unregistered.stat" in msgs
+    assert "stat-dynamic-unregistered:ghost.family." in msgs
+    assert "stat-dead:dead.stat" in msgs
+    assert "stat-dead-family:dead.family.*" in msgs
+    assert "stat-duplicate:dup.stat" in msgs
+    assert len(msgs) == 13, msgs             # OP_WIRED is fully covered
 
 
 def test_mg006_fires_on_unguarded_access_only():

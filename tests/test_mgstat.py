@@ -1,0 +1,569 @@
+"""mgstat: PROFILE v2, query fingerprint statistics, per-index usage,
+the saturation/readiness plane, and scrape federation.
+
+The satellite contracts live here too: attach_profiling must not
+deep-copy (a PROFILE of a plan-cache-hit query neither poisons the
+cache nor changes results), an mp_executor-routed query and a
+kernel-server-routed analytics query must both return populated profile
+rows and increment the same fingerprint registry, and disarmed stats
+collection must fit the ≤2% overhead budget.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from memgraph_tpu.observability import stats as S
+from memgraph_tpu.observability.metrics import Metrics, global_metrics
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage
+
+
+@pytest.fixture(autouse=True)
+def _reset_stats():
+    S.global_query_stats.reset()
+    yield
+    S.global_query_stats.reset()
+
+
+@pytest.fixture
+def interp():
+    return Interpreter(InterpreterContext(InMemoryStorage()))
+
+
+def _seed(interp, n=16):
+    interp.execute(f"UNWIND range(1, {n}) AS i CREATE (:P {{v: i}})")
+
+
+# --- fingerprinting ---------------------------------------------------------
+
+
+def test_fingerprint_strips_literals_params_numbers():
+    fp = S.fingerprint_text
+    assert fp("MATCH (n:P) WHERE n.v = 42 RETURN n") == \
+        fp("MATCH (n:P)  WHERE n.v = 7\n RETURN n")
+    assert fp("CREATE (:U {name: 'ana'})") == \
+        fp('CREATE (:U {name: "bob"})')
+    assert fp("MATCH (n) WHERE n.v = $x RETURN n") == \
+        fp("MATCH (n) WHERE n.v = $other RETURN n")
+    # label identity is case-sensitive and must survive
+    assert fp("MATCH (n:Person) RETURN n") != fp("MATCH (n:person) RETURN n")
+    # no literal values leak into the shape
+    assert "ana" not in fp("CREATE (:U {name: 'ana'})")
+    # PROFILE/EXPLAIN wrap a shape — same fingerprint as the plain query
+    assert fp("PROFILE MATCH (n) RETURN n") == fp("MATCH (n) RETURN n")
+
+
+def test_topk_is_bounded_and_space_saving():
+    reg = S.QueryStatsRegistry(capacity=8)
+    reg.enable()
+    for i in range(32):
+        for _ in range(i + 1):        # shape i recorded i+1 times
+            reg.record(f"shape-{i}", 0.001, rows=1)
+    snap = reg.snapshot()
+    assert len(snap) <= 8
+    # the hottest shapes survive, counts at least their true frequency
+    assert snap[0]["fingerprint"] == "shape-31"
+    assert snap[0]["count"] >= 32
+    # evicted-inheritance is documented per entry
+    assert all("overcount_bound" in s for s in snap)
+
+
+# --- PROFILE v2 -------------------------------------------------------------
+
+
+def _walk_types(op, out):
+    from memgraph_tpu.query.plan.profile import CHILD_ATTRS
+    out.add(type(op).__name__)
+    for attr in CHILD_ATTRS:
+        child = getattr(op, attr, None)
+        if child is not None and hasattr(child, "cursor"):
+            _walk_types(child, out)
+
+
+def test_profile_does_not_poison_plan_cache_or_change_results(interp):
+    """Satellite: attach_profiling wraps without cloning the cached plan
+    and a PROFILE of a cache-hit query leaves cache + results intact."""
+    _seed(interp)
+    query = "MATCH (p:P) WHERE p.v > 4 RETURN p.v ORDER BY p.v"
+    _, before, _ = interp.execute(query)
+    key = query.strip()
+    cached = interp.ctx._plan_cache[key]
+    plan_id = id(cached[0])
+    types_before = set()
+    _walk_types(cached[0], types_before)
+    assert "ProfiledOp" not in types_before
+
+    _, prows, _ = interp.execute("PROFILE " + query)
+    assert prows
+
+    cached_after = interp.ctx._plan_cache[key]
+    assert id(cached_after[0]) == plan_id          # same object, not replaced
+    types_after = set()
+    _walk_types(cached_after[0], types_after)
+    assert types_after == types_before             # no wrapper leaked in
+    _, after, _ = interp.execute(query)
+    assert after == before
+
+
+def test_profile_v2_columns_hits_rows_memory(interp):
+    _seed(interp)
+    cols, rows, _ = interp.execute(
+        "PROFILE MATCH (p:P) WHERE p.v > 4 RETURN p.v")
+    assert cols == ["OPERATOR", "ACTUAL HITS", "ROWS", "RELATIVE TIME",
+                    "ABSOLUTE TIME", "PEAK MEM (BYTES)"]
+    scan = next(r for r in rows if "ScanAllByLabel" in r[0])
+    assert scan[1] >= scan[2] >= 12               # hits >= rows produced
+    assert scan[5] > 0                            # sampled frame memory
+    produce = next(r for r in rows if "Produce" in r[0])
+    assert produce[2] == 12
+
+
+def test_profile_mesh_routed_query_attributes_device_stages(
+        interp, monkeypatch):
+    """PROFILE on an analytics-routed query shows where the device
+    seconds went (transfer + compile/iterate) — mesh-of-1 degeneracy."""
+    monkeypatch.setenv("MEMGRAPH_TPU_MESH_DEVICES", "1")
+    _seed(interp, 32)
+    interp.execute("MATCH (a:P), (b:P) WHERE b.v = a.v + 1 "
+                   "CREATE (a)-[:E]->(b)")
+    _, rows, _ = interp.execute(
+        "PROFILE CALL pagerank.get() YIELD node, rank RETURN rank "
+        "ORDER BY rank DESC LIMIT 3")
+    stages = {r[0].split(": ", 1)[1] for r in rows
+              if r[0].startswith(">> device: ")}
+    assert "device_transfer" in stages
+    assert "device_compile" in stages
+    ops = [r for r in rows if not r[0].startswith(">>")]
+    assert any(r[2] > 0 for r in ops)
+
+
+# --- SHOW QUERY STATS -------------------------------------------------------
+
+
+def test_show_query_stats_counts_and_plan_cache_hits(interp):
+    _seed(interp)
+    # same text twice (plan-cache hits) + a different literal (same
+    # FINGERPRINT, different cache key — a miss by design)
+    for v in (1, 1, 9):
+        interp.execute(f"MATCH (p:P) WHERE p.v > {v} RETURN count(p)")
+    cols, rows, _ = interp.execute("SHOW QUERY STATS")
+    assert cols[0] == "fingerprint"
+    fp = S.fingerprint_text("MATCH (p:P) WHERE p.v > 1 RETURN count(p)")
+    entry = next(r for r in rows if r[0] == fp)
+    assert entry[1] == 3                          # count
+    assert entry[2] == 0                          # errors
+    assert entry[6] == 1                          # plan-cache hit (2nd run)
+    assert entry[5] == 3                          # one count() row each
+
+
+def test_errored_queries_count_against_their_fingerprint(interp):
+    from memgraph_tpu.exceptions import MemgraphTpuError
+    _seed(interp, 4)
+    with pytest.raises(MemgraphTpuError):
+        interp.execute("MATCH (p:P) RETURN p.v / 0")
+    fp = S.fingerprint_text("MATCH (p:P) RETURN p.v / ?")
+    entry = next(s for s in S.global_query_stats.snapshot()
+                 if s["fingerprint"] == fp)
+    assert entry["errors"] == 1
+
+
+def test_concurrent_clients_agree_on_counts_and_trace_links():
+    """Acceptance: bounded top-K with correct counts under a concurrent
+    multi-client workload; entries link to retained trace_ids."""
+    from memgraph_tpu.observability import trace as T
+    ictx = InterpreterContext(InMemoryStorage())
+    Interpreter(ictx).execute(
+        "UNWIND range(1, 32) AS i CREATE (:C {v: i})")
+    T.TRACER.reset()
+    T.enable(sample=1.0)
+    n_threads, per_thread = 4, 15
+    errors = []
+
+    def client(tid):
+        interp = Interpreter(ictx)
+        try:
+            for i in range(per_thread):
+                interp.execute(
+                    f"MATCH (c:C) WHERE c.v > {i % 7} RETURN count(c)")
+                interp.execute(f"MATCH (c:C) WHERE c.v = {i % 5} "
+                               "RETURN c.v")
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        rows = {r[0]: r for r in S.global_query_stats.rows()}
+        fp_a = S.fingerprint_text(
+            "MATCH (c:C) WHERE c.v > 0 RETURN count(c)")
+        fp_b = S.fingerprint_text("MATCH (c:C) WHERE c.v = 0 RETURN c.v")
+        assert rows[fp_a][1] == n_threads * per_thread
+        assert rows[fp_b][1] == n_threads * per_thread
+        # sample=1.0 retains every trace: the linked ids must resolve
+        retained = {s["trace_id"] for tr in T.traces_json() for s in tr}
+        assert rows[fp_a][7] and set(rows[fp_a][7]) <= retained
+    finally:
+        T.disable()
+        T.TRACER.reset()
+
+
+# --- cross-process propagation (satellite) ----------------------------------
+
+
+def test_mp_executor_profile_rows_and_shared_fingerprint():
+    """An mp-routed query returns populated PROFILE rows, and the plain
+    shape increments the SAME fingerprint entry as an in-process run."""
+    from memgraph_tpu.server.mp_executor import MPReadExecutor
+    ictx = InterpreterContext(InMemoryStorage())
+    interp = Interpreter(ictx)
+    interp.execute("UNWIND range(1, 12) AS i CREATE (:M {v: i})")
+    executor = MPReadExecutor(ictx, n_workers=2)
+    try:
+        query = "MATCH (m:M) WHERE m.v > 2 RETURN m.v"
+        cols, prows = executor.execute("PROFILE " + query)
+        assert cols[0] == "OPERATOR"
+        assert any(r[1] > 0 and r[2] == 10 for r in prows), prows
+
+        interp.execute(query)                 # in-process
+        executor.execute(query)               # mp-routed
+        fp = S.fingerprint_text(query)
+        entry = next(s for s in S.global_query_stats.snapshot()
+                     if s["fingerprint"] == fp)
+        # in-process + mp-routed + the PROFILE run all land on ONE entry
+        assert entry["count"] == 3
+    finally:
+        executor.close()
+
+
+@pytest.fixture(scope="module")
+def kernel_server(tmp_path_factory):
+    """In-thread resident kernel server on a private socket."""
+    from memgraph_tpu.server.kernel_server import KernelClient, KernelServer
+    sock = str(tmp_path_factory.mktemp("ks") / "ks.sock")
+    server = KernelServer(sock, idle_timeout_s=0.0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 120
+    client = None
+    while time.monotonic() < deadline:
+        try:
+            client = KernelClient(sock, timeout=60)
+            if client.ping():
+                break
+            client.close()
+            client = None
+        except OSError:
+            time.sleep(0.1)
+    assert client is not None, "kernel server never came up"
+    client.close()
+    yield sock
+    server._shutdown.set()
+
+
+def test_kernel_routed_query_profile_attribution_and_fingerprint(
+        kernel_server):
+    """Acceptance + satellite: a kernel-server-routed analytics query
+    returns profile rows with kernel dispatch/transfer/compile
+    attribution and increments the shared fingerprint registry."""
+    before = None
+    for name, _k, v in global_metrics.snapshot():
+        if name == "analytics.kernel_routed_total":
+            before = v
+    ictx = InterpreterContext(
+        InMemoryStorage(), {"kernel_server_socket": kernel_server})
+    interp = Interpreter(ictx)
+    interp.execute("UNWIND range(0, 31) AS i CREATE (:K {v: i})")
+    interp.execute("MATCH (a:K), (b:K) WHERE b.v = a.v + 1 "
+                   "CREATE (a)-[:E]->(b)")
+    query = ("CALL pagerank.get() YIELD node, rank "
+             "RETURN node.v, rank ORDER BY rank DESC LIMIT 5")
+    _, rows, _ = interp.execute("PROFILE " + query)
+    stages = {r[0].split(": ", 1)[1] for r in rows
+              if r[0].startswith(">> device: ")}
+    # client-observed dispatch + the server-side splits shipped home on
+    # the reply (transfer/compile/iterate measured IN the daemon thread)
+    assert "kernel_dispatch" in stages
+    assert {"device_transfer", "device_compile"} <= stages
+    after = next(v for name, _k, v in global_metrics.snapshot()
+                 if name == "analytics.kernel_routed_total")
+    assert before is None or after > before
+    fp = S.fingerprint_text(query)
+    entry = next(s for s in S.global_query_stats.snapshot()
+                 if s["fingerprint"] == fp)
+    assert entry["count"] >= 1
+
+
+# --- index usage (satellite) ------------------------------------------------
+
+
+def test_index_usage_counters_and_show_index_info(interp):
+    _seed(interp)
+    interp.execute("CREATE INDEX ON :P(v)")
+    interp.execute("CREATE INDEX ON :P(unused)")
+    for v in (3, 7, 7):
+        interp.execute(f"MATCH (p:P) WHERE p.v = {v} RETURN p.v")
+    cols, rows, _ = interp.execute("SHOW INDEX INFO")
+    assert cols[4:] == ["lookups", "rows_returned", "last_used"]
+    used = next(r for r in rows if r[2] == ["v"])
+    assert used[4] == 3 and used[5] == 3
+    assert used[6] is not None
+    # the index that only absorbs writes is visibly idle
+    unused = next(r for r in rows if r[2] == ["unused"])
+    assert unused[4] == 0 and unused[5] == 0 and unused[6] is None
+
+
+def test_index_usage_counts_abandoned_scans(storage):
+    """A LIMIT-abandoned iterator still flushes what it served."""
+    ictx = InterpreterContext(storage)
+    interp = Interpreter(ictx)
+    _seed(interp, 20)
+    interp.execute("CREATE INDEX ON :P(v)")
+    interp.execute("MATCH (p:P) WHERE p.v > 0 RETURN p.v LIMIT 3")
+    lid = storage.label_mapper.maybe_name_to_id("P")
+    pid = storage.property_mapper.maybe_name_to_id("v")
+    usage = storage.indices.label_property.usage(lid, (pid,))
+    assert usage is not None and usage.lookups == 1
+    assert 0 < usage.rows <= 20
+
+
+def test_index_usage_cleared_on_drop(interp):
+    _seed(interp, 4)
+    interp.execute("CREATE INDEX ON :P(v)")
+    interp.execute("MATCH (p:P) WHERE p.v = 1 RETURN p")
+    interp.execute("DROP INDEX ON :P(v)")
+    interp.execute("CREATE INDEX ON :P(v)")
+    _, rows, _ = interp.execute("SHOW INDEX INFO")
+    fresh = next(r for r in rows if r[2] == ["v"])
+    assert fresh[4] == 0                           # usage died with the drop
+
+
+# --- saturation plane -------------------------------------------------------
+
+
+def test_health_verdict_trips_on_shed_and_recovers():
+    plane = S.SaturationPlane()
+    assert plane.evaluate()["ready"]
+    global_metrics.increment("kernel_server.dispatch.shed_total")
+    verdict = plane.evaluate()
+    assert not verdict["ready"]
+    reason = next(r for r in verdict["reasons"]
+                  if r["check"] == "kernel_server_admission")
+    assert reason["value"] >= 1
+    # pressure stopped: the next evaluation recovers (rate semantics)
+    assert plane.evaluate()["ready"]
+
+
+def test_health_verdict_trips_on_replication_lag():
+    plane = S.SaturationPlane()
+    plane.evaluate()
+    global_metrics.set_gauge("replication.replica_lag.r1", 5000.0)
+    try:
+        verdict = plane.evaluate()
+        assert not verdict["ready"]
+        reason = next(r for r in verdict["reasons"]
+                      if r["check"] == "replication_lag")
+        assert reason["value"] == 5000.0
+        assert reason["threshold"] == plane.max_replica_lag
+    finally:
+        global_metrics.set_gauge("replication.replica_lag.r1", 0.0)
+
+
+def test_health_verdict_trips_on_wal_backlog_and_wedge():
+    plane = S.SaturationPlane()
+    plane.evaluate()
+    global_metrics.set_gauge("wal.fsync_backlog_bytes", 1e12)
+    global_metrics.set_gauge("kernel_server.daemon.wedged", 1.0)
+    try:
+        verdict = plane.evaluate()
+        checks = {r["check"] for r in verdict["reasons"]}
+        assert {"wal_fsync_backlog", "kernel_server"} <= checks
+    finally:
+        global_metrics.set_gauge("wal.fsync_backlog_bytes", 0.0)
+        global_metrics.set_gauge("kernel_server.daemon.wedged", 0.0)
+
+
+# --- HTTP surfaces ----------------------------------------------------------
+
+
+@pytest.fixture
+def monitoring(interp):
+    import asyncio
+    import socket
+    from memgraph_tpu.observability.http import start_monitoring_server
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(
+            start_monitoring_server("127.0.0.1", port, interp.ctx))
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    yield port, interp
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_get_stats_endpoint(monitoring):
+    port, interp = monitoring
+    _seed(interp, 4)
+    interp.execute("MATCH (p:P) RETURN count(p)")
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=5).read())
+    assert doc["enabled"] and doc["capacity"] >= 8
+    fps = {e["fingerprint"]: e for e in doc["fingerprints"]}
+    fp = S.fingerprint_text("MATCH (p:P) RETURN count(p)")
+    assert fps[fp]["count"] == 1
+    assert "latency_p99_ms" in fps[fp]
+
+
+def test_get_health_flips_to_503_with_reason(monitoring):
+    """Acceptance: /health goes not-ready with a machine-readable
+    reason under an injected saturation fault, then recovers."""
+    port, _interp = monitoring
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/health", timeout=5).read()
+    assert json.loads(body)["ready"] is True
+    global_metrics.set_gauge("replication.replica_lag.inj", 1e9)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5)
+        assert err.value.code == 503
+        doc = json.loads(err.value.read())
+        assert doc["ready"] is False
+        reason = next(r for r in doc["reasons"]
+                      if r["check"] == "replication_lag")
+        assert reason["value"] == 1e9 and "threshold" in reason
+    finally:
+        global_metrics.set_gauge("replication.replica_lag.inj", 0.0)
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/health", timeout=5).read())
+    assert doc["ready"] is True
+
+
+# --- federation -------------------------------------------------------------
+
+
+def test_federate_expositions_labels_and_type_dedupe():
+    m = Metrics()
+    m.increment("demo.counter", 3)
+    m.set_gauge("demo.gauge", 1.5)
+    m.observe("demo.latency", 0.01, trace_id="cafe1234")
+    text = m.prometheus_text()
+    fed = S.federate_expositions({"main": text, "replica-1": text})
+    lines = fed.splitlines()
+    type_lines = [ln for ln in lines if ln.startswith("# TYPE ")]
+    assert len(type_lines) == len({ln for ln in type_lines})
+    samples = [ln for ln in lines if not ln.startswith("#")]
+    assert all('instance="' in ln for ln in samples)
+    assert any('demo_counter{instance="main"} 3.0' == ln
+               for ln in samples)
+    # histogram bucket labels merge with the instance label and the
+    # OpenMetrics exemplar survives federation
+    assert any(ln.startswith('demo_latency_bucket{instance="replica-1",'
+                             'le=') for ln in samples)
+    assert any('trace_id="cafe1234"' in ln for ln in samples)
+
+
+def test_coordinator_federates_main_replica_and_kernel_daemon(
+        kernel_server):
+    """Acceptance: the coordinator's federated exposition carries main +
+    replica + kernel-daemon series, each with its instance label."""
+    import socket as _socket
+    from memgraph_tpu.coordination.coordinator import CoordinatorInstance
+    from memgraph_tpu.coordination.data_instance import (
+        DataInstanceManagementServer)
+
+    def free_port():
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    mgmt1, repl1, mgmt2, repl2, raft = (free_port() for _ in range(5))
+    ictx1 = InterpreterContext(
+        InMemoryStorage(), {"kernel_server_socket": kernel_server})
+    ictx2 = InterpreterContext(InMemoryStorage())
+    m1 = DataInstanceManagementServer(ictx1, "127.0.0.1", mgmt1)
+    m2 = DataInstanceManagementServer(ictx2, "127.0.0.1", mgmt2)
+    m1.start()
+    m2.start()
+    coord = CoordinatorInstance("coord1", "127.0.0.1", raft, {})
+    coord.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not coord.raft.is_leader() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert coord.raft.is_leader()
+        assert coord.register_instance("main1", f"127.0.0.1:{mgmt1}",
+                                       f"127.0.0.1:{repl1}")
+        assert coord.register_instance("replica1", f"127.0.0.1:{mgmt2}",
+                                       f"127.0.0.1:{repl2}")
+        assert coord.set_instance_to_main("main1")
+        global_metrics.increment("query.finished", 0)  # ensure series
+        fed = coord.federated_prometheus_text()
+        assert 'instance="main1"' in fed
+        assert 'instance="replica1"' in fed
+        assert 'instance="coord1"' in fed
+        # the resident daemon appears as its own federated instance
+        assert 'instance="main1-kernel-daemon"' in fed
+        assert "kernel_server_daemon_in_flight" in fed
+    finally:
+        coord.stop()
+        m1.stop()
+        m2.stop()
+
+
+# --- overhead guard ---------------------------------------------------------
+
+
+def test_default_stats_overhead_under_two_percent(interp):
+    """Per-query stat collection (fingerprint memo hit + one record)
+    must fit the same deterministic ≤2% bound mgtrace holds itself to:
+    (stat calls per query) x (measured per-call cost) vs the measured
+    per-query time of a representative micro-benchmark."""
+    _seed(interp, 200)
+    reg = S.global_query_stats
+    text = "MATCH (p:P) WHERE p.v > 100 RETURN count(p)"
+    reg.fingerprint(text)                     # memo warm (plan-cache analog)
+
+    def stat_batch():
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            fp = reg.fingerprint(text)
+            reg.record(fp, 0.001, rows=1, plan_cache_hit=True)
+        return (time.perf_counter() - t0) / 2000
+
+    per_call = min(stat_batch() for _ in range(5))
+    reg.reset()
+
+    interp.execute(text)                      # warm plan cache
+
+    def query_batch():
+        t0 = time.perf_counter()
+        for _ in range(30):
+            interp.execute(text)
+        return (time.perf_counter() - t0) / 30
+
+    per_query = min(query_batch() for _ in range(3))
+    budget_calls = 2                          # fingerprint + record
+    overhead = per_call * budget_calls
+    assert overhead <= 0.02 * per_query, (
+        f"stat collection overhead {overhead * 1e6:.2f}µs exceeds 2% of "
+        f"the {per_query * 1e6:.1f}µs micro-benchmark query")

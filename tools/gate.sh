@@ -30,6 +30,13 @@ stage "mglint (static analysis)" \
 stage "mgtrace smoke (traced query -> chrome export)" \
     python -m tools.trace_smoke
 
+# 1c. mgstat smoke: one traced+profiled query end-to-end (PROFILE v2
+#     operator rows + device attribution), SHOW QUERY STATS fingerprint
+#     linkage, exposition + federation parse, health verdict trips on an
+#     injected saturation fault and recovers
+stage "stats-smoke (profiled query -> fingerprints -> health)" \
+    python -m tools.stats_smoke
+
 # 2. mgsan smoke: the invariant-holding scenarios over a few seeds (the
 #    racy_counter true-positive is exercised by the test suite, not here)
 stage "mgsan schedule-exploration smoke" \

@@ -94,6 +94,7 @@ def check(project: Project):
     findings.extend(_check_device_nemesis_ops(project))
     findings.extend(_check_spmv_registry(project))
     findings.extend(_check_span_registry(project))
+    findings.extend(_check_stat_registry(project))
     return findings
 
 
@@ -559,4 +560,138 @@ def _check_span_registry(project: Project):
                         "site — dead registration, dashboards covering "
                         "it watch a span that can never fire",
                 fingerprint=f"span-dead:{span_name}"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# metric-name coverage (observability/metrics.py STAT_NAMES) — r14, mgstat
+# --------------------------------------------------------------------------
+#
+# Every name emitted through global_metrics.increment()/set_gauge()/
+# observe() must be declared exactly once in STAT_NAMES; entries ending
+# in "*" declare a dynamic FAMILY (f-string sites whose literal prefix
+# matches). Four failure modes fire:
+#   * stat-unregistered  — a literal name no registry entry covers
+#                          (typo: the series silently splits)
+#   * stat-dynamic-unregistered — an f-string name whose literal prefix
+#                          matches no declared family
+#   * stat-dead          — a declared exact name with no emit site
+#   * stat-dead-family   — a declared family with no dynamic emit site
+#   * stat-duplicate     — a name declared more than once
+
+_METRIC_EMIT_FUNCS = ("increment", "set_gauge", "observe")
+
+
+def _collect_registry_with_dupes(sf, name: str):
+    """[(literal, lineno)] preserving duplicates (the 'declared once'
+    half of the contract needs them)."""
+    out = []
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            for el in stmt.value.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    out.append((el.value, getattr(el, "lineno",
+                                                  stmt.lineno)))
+    return out
+
+
+def _check_stat_registry(project: Project):
+    mx = project.by_suffix("observability/metrics.py")
+    if mx is None:
+        return []
+    declared = _collect_registry_with_dupes(mx, "STAT_NAMES")
+    if not declared:
+        return []
+
+    findings = []
+    seen: set[str] = set()
+    for name, line in declared:
+        if name in seen:
+            findings.append(Finding(
+                rule="MG005", path=mx.rel_path, line=line, col=0,
+                symbol="STAT_NAMES",
+                message=f"metric name {name!r} is declared more than "
+                        "once in STAT_NAMES — every name is declared "
+                        "exactly once",
+                fingerprint=f"stat-duplicate:{name}"))
+        seen.add(name)
+    exact = {n for n, _l in declared if not n.endswith("*")}
+    families = {n[:-1] for n, _l in declared if n.endswith("*")}
+
+    def family_of(prefix: str):
+        for fam in families:
+            if prefix.startswith(fam):
+                return fam
+        return None
+
+    used_exact: set[str] = set()
+    used_family: set[str] = set()
+    for rel, sf in project.files.items():
+        if sf is mx:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            d = (dotted(node.func) or "").split(".")
+            if len(d) < 2 or d[-1] not in _METRIC_EMIT_FUNCS \
+                    or d[-2] != "global_metrics":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                stat = arg.value
+                fam = family_of(stat)
+                if stat in exact:
+                    used_exact.add(stat)
+                elif fam is not None:
+                    used_family.add(fam)
+                else:
+                    findings.append(Finding(
+                        rule="MG005", path=rel, line=node.lineno,
+                        col=node.col_offset, symbol=d[-1],
+                        message=f"metric name {stat!r} is not declared "
+                                "in observability/metrics.py STAT_NAMES "
+                                "— a typo'd name silently splits the "
+                                "series and dashboards never learn it "
+                                "exists",
+                        fingerprint=f"stat-unregistered:{stat}"))
+            elif isinstance(arg, ast.JoinedStr):
+                first = arg.values[0] if arg.values else None
+                prefix = first.value \
+                    if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) else ""
+                fam = family_of(prefix)
+                if fam is not None:
+                    used_family.add(fam)
+                else:
+                    findings.append(Finding(
+                        rule="MG005", path=rel, line=node.lineno,
+                        col=node.col_offset, symbol=d[-1],
+                        message=f"dynamic metric name (prefix "
+                                f"{prefix!r}) matches no STAT_NAMES "
+                                "family — declare '<prefix>*' so the "
+                                "family is discoverable",
+                        fingerprint=f"stat-dynamic-unregistered:"
+                                    f"{prefix}"))
+    for name, line in declared:
+        if name.endswith("*"):
+            if name[:-1] not in used_family:
+                findings.append(Finding(
+                    rule="MG005", path=mx.rel_path, line=line, col=0,
+                    symbol="STAT_NAMES",
+                    message=f"declared metric family {name!r} has no "
+                            "dynamic emit site — dead registration",
+                    fingerprint=f"stat-dead-family:{name}"))
+        elif name not in used_exact and family_of(name) is None:
+            findings.append(Finding(
+                rule="MG005", path=mx.rel_path, line=line, col=0,
+                symbol="STAT_NAMES",
+                message=f"declared metric name {name!r} has no emit "
+                        "site — dead registration, dashboards covering "
+                        "it watch a metric that can never move",
+                fingerprint=f"stat-dead:{name}"))
     return findings

@@ -1,0 +1,152 @@
+"""mgstat smoke: one traced+profiled query end-to-end, exposition
+parses, health verdict sane.
+
+The gate stage (`tools/gate.sh`) proving the workload-statistics plane
+actually works:
+
+  1. arm tracing (sample=1.0) and run real Cypher through a real
+     Interpreter, including a PROFILE-d mesh-routed analytics CALL
+     (mesh-of-1 degeneracy — same sharded path a TPU pod runs);
+  2. assert PROFILE v2 rows carry hits/rows/peak-mem AND device
+     attribution rows (transfer + compile/iterate stages);
+  3. assert SHOW QUERY STATS surfaces the fingerprints with counts,
+     plan-cache hits, and retained trace links;
+  4. parse the Prometheus exposition line by line, then federate two
+     labeled copies and re-parse — every sample must carry an instance
+     label and every family exactly one TYPE line;
+  5. evaluate the saturation plane: ready on a quiet instance, NOT
+     ready (machine-readable reason) under an injected replication-lag
+     fault, ready again once the fault clears.
+
+Exit 0 only if every check passes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+def fail(msg: str) -> None:
+    print(f"stats-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? [0-9eE.+-]+"
+    r"( # \{.*\} [0-9eE.+-]+ [0-9.]+)?$")
+
+
+def check_exposition(text: str, require_instance: bool = False) -> int:
+    samples = 0
+    type_lines: dict[str, int] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            family = line.split()[2]
+            type_lines[family] = type_lines.get(family, 0) + 1
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            fail(f"unparseable exposition line: {line!r}")
+        if require_instance and 'instance="' not in (m.group(2) or ""):
+            fail(f"federated sample missing instance label: {line!r}")
+        samples += 1
+    for family, n in type_lines.items():
+        if n != 1:
+            fail(f"family {family} has {n} TYPE lines (want exactly 1)")
+    return samples
+
+
+def main() -> None:
+    # mesh-of-1 so the analytics CALL rides the sharded device path and
+    # attributes transfer/compile/iterate stages
+    os.environ.setdefault("MEMGRAPH_TPU_MESH_DEVICES", "1")
+
+    from memgraph_tpu.observability import stats as mgstats
+    from memgraph_tpu.observability import trace as T
+    from memgraph_tpu.observability.metrics import global_metrics
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage
+
+    T.enable(sample=1.0)
+    interp = Interpreter(InterpreterContext(InMemoryStorage()))
+    interp.execute("UNWIND range(0, 63) AS i CREATE (:N {v: i})")
+    interp.execute(
+        "MATCH (a:N), (b:N) WHERE b.v = a.v + 1 OR b.v = a.v * 2 "
+        "CREATE (a)-[:E]->(b)")
+
+    # 1-2. traced + PROFILE-d device-routed query with attribution
+    query = ("CALL pagerank.get() YIELD node, rank "
+             "RETURN node.v, rank ORDER BY rank DESC LIMIT 5")
+    interp.execute(query)                       # warm plan cache
+    cols, rows, _ = interp.execute("PROFILE " + query)
+    if cols[0] != "OPERATOR" or "ROWS" not in cols \
+            or "PEAK MEM (BYTES)" not in cols:
+        fail(f"PROFILE v2 columns wrong: {cols}")
+    ops = [r for r in rows if r[0].lstrip("| ").startswith("*")]
+    if not any(int(r[1]) > 0 and int(r[2]) > 0 for r in ops):
+        fail(f"no operator row with hits+rows: {ops}")
+    stages = {r[0].split(": ", 1)[1] for r in rows
+              if r[0].startswith(">> device: ")}
+    if not {"device_transfer", "device_compile"} <= stages:
+        fail(f"PROFILE device attribution missing stages: {stages}")
+
+    # 3. fingerprint statistics with trace links
+    cols, srows, _ = interp.execute("SHOW QUERY STATS")
+    by_fp = {r[0]: r for r in srows}
+    fp = mgstats.fingerprint_text(query)
+    if fp not in by_fp:
+        fail(f"fingerprint {fp!r} missing from SHOW QUERY STATS "
+             f"({list(by_fp)})")
+    entry = by_fp[fp]
+    if entry[1] < 2:
+        fail(f"expected >=2 recorded runs for {fp!r}: {entry}")
+    if entry[6] < 1:
+        fail(f"expected a plan-cache hit for {fp!r}: {entry}")
+    if not entry[7]:
+        fail(f"fingerprint entry has no retained trace link: {entry}")
+    retained = {s["trace_id"] for t in T.traces_json() for s in t}
+    if not set(entry[7]) & retained:
+        fail(f"linked trace_ids {entry[7]} not in retained ring")
+
+    # 4. exposition parses, federation labels every sample
+    text = global_metrics.prometheus_text()
+    n = check_exposition(text)
+    if n == 0:
+        fail("empty exposition")
+    fed = mgstats.federate_expositions({"main": text, "replica1": text})
+    fn = check_exposition(fed, require_instance=True)
+    if fn < 2 * n * 0.9:
+        fail(f"federated exposition lost samples: {fn} < 2x{n}")
+
+    # 5. health verdict: sane, trips on injected lag, recovers
+    verdict = mgstats.global_saturation.evaluate()
+    if not verdict["ready"] or verdict["reasons"]:
+        fail(f"quiet instance not ready: {verdict}")
+    global_metrics.set_gauge("replication.replica_lag.smoke", 1e9)
+    verdict = mgstats.global_saturation.evaluate()
+    if verdict["ready"] or not any(
+            r["check"] == "replication_lag" for r in verdict["reasons"]):
+        fail(f"injected lag did not trip readiness: {verdict}")
+    reason = verdict["reasons"][0]
+    for key in ("check", "reason", "value", "threshold"):
+        if key not in reason:
+            fail(f"reason not machine-readable: {reason}")
+    global_metrics.set_gauge("replication.replica_lag.smoke", 0.0)
+    verdict = mgstats.global_saturation.evaluate()
+    if not verdict["ready"]:
+        fail(f"readiness did not recover after fault cleared: {verdict}")
+
+    print(f"stats-smoke: OK — profile stages {sorted(stages)}, "
+          f"{len(srows)} fingerprints, {n} exposition samples "
+          f"({fn} federated), health verdict trips and recovers")
+
+
+if __name__ == "__main__":
+    main()
