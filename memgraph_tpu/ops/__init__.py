@@ -13,28 +13,39 @@ bucketed sizes so recompilation is amortized across graph mutations.
 from .csr import DeviceGraph, ShardedCSR, export_csr, shard_csr, GraphCache
 
 # --------------------------------------------------------------------------
-# SpMV-shaped algorithm registry (mesh-path coverage contract)
+# SpMV-shaped algorithm registry (semiring-core + mesh coverage contract)
 # --------------------------------------------------------------------------
 # Every algorithm whose inner loop is an SpMV shape (per-edge gather +
-# segment reduction inside a while_loop) inherits the multi-chip mesh
-# path from the shared partition-centric core — unless it declares a
-# justified exemption here. mglint's MG005 registry-coverage rule
-# enforces the contract both ways:
+# segment reduction inside a while_loop) rides the semiring kernel core
+# (ops/semiring.py) and inherits the multi-chip mesh path from the
+# shared partition-centric kernels — unless it declares a justified
+# exemption here. mglint's MG005 registry-coverage rule enforces the
+# contract every way:
+#   * each entry declares "core": the SEMIRINGS key its inner loop
+#     iterates (or "blocks" when it composes the core's edge_reduce /
+#     spmv building blocks in a custom round, e.g. labelprop's sorted
+#     run-length election) — validated against ops/semiring.py;
 #   * each entry needs exactly one of "sharded" (a "module:function"
 #     target that must statically resolve) or "exempt" (a real
-#     justification, not a stub), and
-#   * every ops/ module whose AST shows the SpMV shape must be covered
-#     by some entry, so a new algorithm cannot silently miss the mesh.
+#     justification, not a stub);
+#   * every ops/ module whose AST shows the SpMV shape OR that imports
+#     the semiring core must be covered by some entry, so a new
+#     algorithm cannot silently miss the mesh; and
+#   * NO ops/ module outside the core may hand-roll a segment_* +
+#     while_loop pipeline (the "spmv-handrolled" sweep) — new code goes
+#     through the core or it fails the gate.
 # tests/test_sharded_analytics.py resolves every "sharded" target at
 # runtime and tier-1 runs sharded-vs-single equivalence for the core
-# four (pagerank / katz / labelprop / components).
+# algorithms; tests/test_semiring.py pins old-vs-new f32 bit-exactness.
 SPMV_ALGORITHMS = {
     "pagerank": {
         "entry": "memgraph_tpu.ops.pagerank:pagerank",
+        "core": "plus_times",
         "sharded": "memgraph_tpu.parallel.analytics:pagerank_mesh",
     },
     "personalized_pagerank": {
         "entry": "memgraph_tpu.ops.pagerank:personalized_pagerank",
+        "core": "plus_times",
         "exempt": "per-user restart vectors belong to the batched-PPR "
                   "serving lane (ROADMAP item 3): one query's work is "
                   "latency-bound, and the mesh axis there is the batch "
@@ -42,47 +53,61 @@ SPMV_ALGORITHMS = {
     },
     "katz": {
         "entry": "memgraph_tpu.ops.katz:katz_centrality",
+        "core": "plus_times",
         "sharded": "memgraph_tpu.parallel.analytics:katz_mesh",
     },
     "hits": {
         "entry": "memgraph_tpu.ops.katz:hits",
+        "core": "plus_times",
         "exempt": "two interleaved L2-normalized reductions per round "
                   "(hub and authority) cost >= 2 collectives each "
-                  "iteration; below the mesh win threshold until the "
-                  "fused-normalization core lands (ROADMAP item 2)",
+                  "iteration; below the mesh win threshold even with "
+                  "the r10 core (the normalizations are global sums)",
     },
     "labelprop": {
         "entry": "memgraph_tpu.ops.labelprop:label_propagation",
+        "core": "blocks",
         "sharded": "memgraph_tpu.parallel.analytics:label_propagation_mesh",
     },
     "components": {
         "entry": "memgraph_tpu.ops.components:weakly_connected_components",
+        "core": "min_first",
         "sharded": "memgraph_tpu.parallel.analytics:components_mesh",
     },
     "scc": {
         "entry": "memgraph_tpu.ops.components:strongly_connected_components",
+        "core": "min_first",
         "exempt": "host-driven multi-round FW-BW coloring; the round "
                   "count is data-dependent and each round already runs "
-                  "the jitted min-propagation, so the mesh story needs "
-                  "the device-resident frontier work first",
+                  "the jitted masked min-first propagation, so the mesh "
+                  "story needs the device-resident frontier work first",
     },
     "sssp": {
         "entry": "memgraph_tpu.ops.traversal:sssp",
+        "core": "min_plus",
         "sharded": "memgraph_tpu.parallel.analytics:sssp_mesh",
     },
     "bfs_layers": {
         "entry": "memgraph_tpu.ops.traversal:bfs_levels",
-        "exempt": "frontier-based traversal: per-level frontiers are "
-                  "sparse and tiny relative to the edge set; edge-mesh "
-                  "sharding adds a collective per level for no win at "
-                  "current scales",
+        "core": "min_plus",
+        "sharded": "memgraph_tpu.parallel.analytics:bfs_mesh",
     },
     "betweenness": {
         "entry": "memgraph_tpu.ops.betweenness:betweenness_centrality",
+        "core": "plus_first",
         "exempt": "Brandes is a batch over SOURCES (forward + backward "
                   "sweep per source); the profitable mesh axis is the "
                   "source batch, planned with the batched-PPR lane "
                   "(ROADMAP item 3), not the edge axis",
+    },
+    "gnn": {
+        "entry": "memgraph_tpu.ops.gnn:sage_forward",
+        "core": "plus_first",
+        "exempt": "GraphSAGE aggregation is a plus-first SpMM over "
+                  "dense (n, d) feature blocks; its mesh axis is the "
+                  "2D data x model embedding-training mesh "
+                  "(parallel.mesh.make_mesh_2d), not the edge axis the "
+                  "partition-centric kernels shard",
     },
 }
 

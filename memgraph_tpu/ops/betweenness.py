@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import semiring as S
 from .csr import DeviceGraph
 
 INF = jnp.float32(3.0e38)
@@ -50,9 +51,11 @@ def _brandes_chunk(src, dst, edge_valid, sources, weights, n_pad: int,
         dist, sigma, level, _ = carry
         on_frontier = (dist[:, src] == level) & edge_valid[None, :]
         contrib = jnp.where(on_frontier, sigma[:, src], 0.0)
-        sig_new = jax.ops.segment_sum(
-            contrib.reshape(-1), seg_ids.reshape(-1),
-            num_segments=B * n_pad).reshape(B, n_pad)
+        # batched plus-first reduction (core ⊕): sigma flows along the
+        # frontier edges of every source row at once
+        sig_new = S.edge_reduce(
+            "sum", contrib.reshape(-1), seg_ids.reshape(-1),
+            B * n_pad).reshape(B, n_pad)
         newly = (dist >= INF / 2) & (sig_new > 0)
         dist = jnp.where(newly, level + 1.0, dist)
         sigma = jnp.where(newly, sig_new, sigma)
@@ -75,9 +78,9 @@ def _brandes_chunk(src, dst, edge_valid, sources, weights, n_pad: int,
         contrib = jnp.where(
             on_edge,
             sigma[:, src] / safe_sigma * (1.0 + delta[:, dst]), 0.0)
-        add = jax.ops.segment_sum(
-            contrib.reshape(-1), seg_ids_back.reshape(-1),
-            num_segments=B * n_pad).reshape(B, n_pad)
+        add = S.edge_reduce(
+            "sum", contrib.reshape(-1), seg_ids_back.reshape(-1),
+            B * n_pad).reshape(B, n_pad)
         delta = jnp.where(dist == level, add, delta)
         return delta, level - 1.0
 

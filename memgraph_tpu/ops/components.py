@@ -1,10 +1,13 @@
-"""Connected components on TPU.
+"""Connected components on the semiring core.
 
 Counterpart of the reference's WCC module
 (/root/reference/mage/cpp/connectivity_module/ and query_modules/wcc.py):
-iterative min-label propagation over both edge directions (treating the
-graph as undirected) combined with pointer-jumping (path halving), which
-converges in O(log n) rounds instead of O(diameter).
+WCC is a min-first semiring fixpoint over both edge directions (treating
+the graph as undirected) with pointer-jumping (path halving) fused into
+the epilogue, which converges in O(log n) rounds instead of O(diameter).
+SCC is multi-pivot forward-backward coloring whose propagation rounds are
+MASKED min-first matvecs (the masked-SpMV of GraphBLAST: edges with a
+settled endpoint contribute the ⊕ identity).
 """
 
 from __future__ import annotations
@@ -13,32 +16,18 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from . import semiring as S
 from .csr import DeviceGraph
 
 
-@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _wcc_kernel(src, dst, n_pad: int, max_iterations: int):
-    comp0 = jnp.arange(n_pad, dtype=jnp.int32)
-
-    def body(carry):
-        comp, _, it = carry
-        # propagate the minimum component over both edge directions
-        cand_fwd = jax.ops.segment_min(comp[src], dst, num_segments=n_pad)
-        cand_bwd = jax.ops.segment_min(comp[dst], src, num_segments=n_pad)
-        new_comp = jnp.minimum(comp, jnp.minimum(cand_fwd, cand_bwd))
-        # pointer jumping: comp[v] = comp[comp[v]] (path halving)
-        new_comp = new_comp[new_comp]
-        changed = jnp.any(new_comp != comp)
-        return new_comp, changed, it + 1
-
-    def cond(carry):
-        _, changed, it = carry
-        return changed & (it < max_iterations)
-
-    comp, _, iters = jax.lax.while_loop(
-        cond, body, (comp0, jnp.bool_(True), jnp.int32(0)))
-    return comp, iters
+def _wcc_epilogue(comp, acc, env, P):
+    """Fused WCC epilogue: keep the smaller label, then pointer-jump
+    (path halving: comp[v] = comp[comp[v]]) and the changed partial."""
+    new_comp = jnp.minimum(comp, acc)
+    new_comp = new_comp[new_comp]
+    return new_comp, jnp.any(new_comp != comp)
 
 
 def weakly_connected_components(graph: DeviceGraph,
@@ -48,13 +37,19 @@ def weakly_connected_components(graph: DeviceGraph,
 
     `mesh` (MeshContext | Mesh | int | None) routes through the
     multi-chip layer; see ops.pagerank.pagerank."""
-    from ..parallel.mesh import resolve_mesh
-    ctx = resolve_mesh(mesh)
-    if ctx is not None:
+    backend, ctx = S.route_backend(graph, mesh, semiring="min_first")
+    if backend == "mesh":
         from ..parallel.analytics import components_mesh
-        return components_mesh(graph, ctx, max_iterations=max_iterations)
-    comp, iters = _wcc_kernel(graph.src_idx, graph.col_idx, graph.n_pad,
-                              max_iterations)
+        with S.backend_extent("mesh"):
+            return components_mesh(graph, ctx,
+                                   max_iterations=max_iterations)
+    comp0 = np.arange(graph.n_pad, dtype=np.int32)
+    comp, _, iters = S.fixpoint(
+        "min_first",
+        arrays={"src": graph.src_idx, "dst": graph.col_idx},
+        x0=jnp.asarray(comp0), n_out=graph.n_pad,
+        epilogue=_wcc_epilogue, max_iterations=max_iterations,
+        metric="changed", direction="both")
     return comp[:graph.n_nodes], int(iters)
 
 
@@ -74,14 +69,15 @@ def _scc_round(src, dst, comp, n_pad: int, max_iterations: int):
     unsettled = comp < 0
     big = jnp.int32(n_pad)
     lab0 = jnp.where(unsettled, ids, big)
-    # propagation only along edges with both endpoints unsettled
+    # propagation only along edges with both endpoints unsettled: the
+    # masked min-first matvec (masked edges contribute the sentinel)
     edge_ok = unsettled[src] & unsettled[dst]
 
     def propagate(a, b):
         def body(carry):
             lab, _, it = carry
-            vals = jnp.where(edge_ok, lab[a], big)
-            cand = jax.ops.segment_min(vals, b, num_segments=n_pad)
+            cand = S.spmv("min_first", lab, a, b, n_out=n_pad,
+                          mask=edge_ok, mask_fill=big)
             new = jnp.minimum(lab, cand)
             return new, jnp.any(new != lab), it + 1
 
@@ -107,8 +103,8 @@ def _scc_trim(src, dst, comp, n_pad: int, max_iterations: int):
         comp, _, it = carry
         unsettled = comp < 0
         edge_ok = (unsettled[src] & unsettled[dst]).astype(jnp.int32)
-        in_deg = jax.ops.segment_sum(edge_ok, dst, num_segments=n_pad)
-        out_deg = jax.ops.segment_sum(edge_ok, src, num_segments=n_pad)
+        in_deg = S.edge_reduce("sum", edge_ok, dst, n_pad)
+        out_deg = S.edge_reduce("sum", edge_ok, src, n_pad)
         trim = unsettled & ((in_deg == 0) | (out_deg == 0))
         new_comp = jnp.where(trim, jnp.arange(n_pad, dtype=jnp.int32), comp)
         return new_comp, jnp.any(trim), it + 1
@@ -132,7 +128,6 @@ def strongly_connected_components(graph: DeviceGraph,
     effectively unbounded because correctness requires running each
     propagation to its fixpoint (a C-node cycle needs C rounds).
     """
-    import numpy as np
     n_pad = graph.n_pad
     comp = jnp.where(jnp.arange(n_pad, dtype=jnp.int32) < graph.n_nodes,
                      jnp.int32(-1), jnp.arange(n_pad, dtype=jnp.int32))

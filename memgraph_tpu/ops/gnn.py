@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from . import semiring as S
 from .csr import DeviceGraph
 
 
@@ -37,16 +38,18 @@ def init_sage_params(rng, in_dim, hidden_dim, out_dim, n_layers=2):
 
 
 def _mean_aggregate(feats, csc_src, csc_dst, n_pad):
-    """Undirected mean of neighbor features per node: one sorted-segment
-    pass per direction (csc_dst is sorted; csr src via the transpose trick
-    costs a second segment_sum on swapped indices)."""
-    summed = jax.ops.segment_sum(feats[csc_src], csc_dst, n_pad,
-                                 indices_are_sorted=True)
-    summed = summed + jax.ops.segment_sum(feats[csc_dst], csc_src, n_pad)
-    deg = jax.ops.segment_sum(jnp.ones_like(csc_dst, dtype=feats.dtype),
-                              csc_dst, n_pad, indices_are_sorted=True)
-    deg = deg + jax.ops.segment_sum(
-        jnp.ones_like(csc_src, dtype=feats.dtype), csc_src, n_pad)
+    """Undirected mean of neighbor features per node: a plus-first
+    semiring SpMM (one sorted core pass per direction; csc_dst is
+    sorted, the transpose direction costs a second reduction on swapped
+    indices)."""
+    summed = S.spmv("plus_first", feats, csc_src, csc_dst, n_out=n_pad,
+                    sorted=True)
+    summed = summed + S.spmv("plus_first", feats, csc_dst, csc_src,
+                             n_out=n_pad)
+    deg = S.edge_reduce("sum", jnp.ones_like(csc_dst, dtype=feats.dtype),
+                        csc_dst, n_pad, sorted=True)
+    deg = deg + S.edge_reduce(
+        "sum", jnp.ones_like(csc_src, dtype=feats.dtype), csc_src, n_pad)
     return summed / jnp.maximum(deg, 1.0)[:, None]
 
 

@@ -1,106 +1,135 @@
-"""Katz centrality on TPU.
+"""Katz centrality / HITS / degree centrality on the semiring core.
 
 Counterpart of /root/reference/query_modules/katz_centrality_module/ and
 mage/cpp/cugraph_module/algorithms/katz.cu: fixed-point iteration
-x_{t+1} = alpha * A^T x_t + beta, expressed as gather + segment-sum, with an
-L-infinity convergence check. Converges for alpha < 1/lambda_max(A).
+x_{t+1} = alpha * A^T x_t + beta as a plus-times semiring fixpoint with
+the update + L-infinity convergence check fused into the matvec body.
+Converges for alpha < 1/lambda_max(A).  On accelerator hosts with large
+graphs the dispatch routes through the gather-free MXU backend
+(semiring.mxu_fixpoint, normalize=False — a win katz never had before
+the r10 core: pagerank's fast path is now every plus-times algorithm's).
 """
 
 from __future__ import annotations
 
-from functools import partial
-
-import jax
 import jax.numpy as jnp
+import numpy as np
 
+from . import semiring as S
 from .csr import DeviceGraph
 
 
-@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _katz_kernel(src, dst, weights, n_nodes, n_pad: int, alpha, beta,
-                 max_iterations: int, tol, normalized):
-    valid_f = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes).astype(jnp.float32)
-    x0 = jnp.zeros(n_pad, dtype=jnp.float32)
+def _katz_setup(A, P, n_out):
+    valid_f = (jnp.arange(n_out, dtype=jnp.int32)
+               < P["n_nodes"]).astype(jnp.float32)
+    return {"valid_f": valid_f,
+            "x0": jnp.zeros(n_out, dtype=jnp.float32)}
 
-    def body(carry):
-        x, _, it = carry
-        acc = jax.ops.segment_sum(x[src] * weights, dst, num_segments=n_pad,
-                                  indices_are_sorted=True)
-        new_x = valid_f * (alpha * acc + beta)
-        err = jnp.max(jnp.abs(new_x - x))
-        return new_x, err, it + 1
 
-    def cond(carry):
-        _, err, it = carry
-        return (err > tol) & (it < max_iterations)
+def _katz_epilogue(x, acc, env, P):
+    """Fused katz update: new = valid * (alpha * A^T x + beta), with the
+    L-infinity convergence partial in the same body."""
+    new_x = env["valid_f"] * (P["alpha"] * acc + P["beta"])
+    err = jnp.max(jnp.abs(new_x - x))
+    return new_x, err
 
-    x, err, iters = jax.lax.while_loop(
-        cond, body, (x0, jnp.float32(jnp.inf), jnp.int32(0)))
+
+def _katz_mxu_epilogue(x, acc, env, P):
+    """The same update on the MXU backend's out-labeled accumulator."""
+    new_x = env["valid"] * (P["alpha"] * acc + P["beta"])
+    err = jnp.max(jnp.abs(new_x - x))
+    return new_x, err
+
+
+def _katz_normalized(x, normalized: bool):
+    if not normalized:
+        return x
+    x = jnp.asarray(x)
     norm = jnp.sqrt(jnp.sum(x * x))
-    x = jnp.where(normalized, x / jnp.maximum(norm, 1e-30), x)
-    return x, err, iters
+    return x / jnp.maximum(norm, 1e-30)
 
 
 def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
                     max_iterations: int = 100, tol: float = 1e-6,
-                    normalized: bool = False, mesh=None):
+                    normalized: bool = False, mesh=None,
+                    precision: str = "f32"):
     """Returns (centralities[:n_nodes], error, iterations).
 
     `mesh` (MeshContext | Mesh | int | None) routes through the
-    multi-chip layer; see ops.pagerank.pagerank."""
-    from ..parallel.mesh import resolve_mesh
-    ctx = resolve_mesh(mesh)
-    if ctx is not None:
+    multi-chip layer; `precision` selects the f32/bf16/int8 variants
+    (see ops.pagerank.pagerank)."""
+    backend, ctx = S.route_backend(graph, mesh, semiring="plus_times",
+                                   precision=precision)
+    if backend == "mesh":
         from ..parallel.analytics import katz_mesh
-        return katz_mesh(graph, ctx, alpha=alpha, beta=beta,
-                         max_iterations=max_iterations, tol=tol,
-                         normalized=normalized)
-    x, err, iters = _katz_kernel(
-        graph.csc_src, graph.csc_dst, graph.csc_weights,
-        jnp.int32(graph.n_nodes), graph.n_pad,
-        jnp.float32(alpha), jnp.float32(beta), max_iterations,
-        jnp.float32(tol), jnp.bool_(normalized))
+        with S.backend_extent("mesh"):
+            return katz_mesh(graph, ctx, alpha=alpha, beta=beta,
+                             max_iterations=max_iterations, tol=tol,
+                             normalized=normalized, precision=precision)
+    if backend == "mxu":
+        x, err, iters = S.mxu_fixpoint(
+            graph, epilogue=_katz_mxu_epilogue,
+            params={"alpha": np.float32(alpha), "beta": np.float32(beta)},
+            max_iterations=max_iterations, tol=tol, normalize=False,
+            precision=precision, cache_tag="katz")
+        return (np.asarray(_katz_normalized(x, normalized))[:graph.n_nodes],
+                float(err), int(iters))
+    x, err, iters = S.fixpoint(
+        "plus_times",
+        arrays={"src": graph.csc_src, "dst": graph.csc_dst,
+                "w": graph.csc_weights},
+        params={"n_nodes": np.int32(graph.n_nodes),
+                "alpha": np.float32(alpha), "beta": np.float32(beta),
+                "tol": np.float32(tol)},
+        n_out=graph.n_pad, setup=_katz_setup, epilogue=_katz_epilogue,
+        max_iterations=max_iterations, sorted=True, precision=precision)
+    x = _katz_normalized(x, normalized)
     return x[:graph.n_nodes], float(err), int(iters)
 
 
-@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _hits_kernel(src, dst, weights, csrc, cdst, cweights, n_nodes,
-                 n_pad: int, max_iterations: int, tol):
-    valid_f = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes).astype(jnp.float32)
-    hub0 = valid_f
-    auth0 = valid_f
+def _hits_step(x, A, env, P, n_out):
+    """One HITS round: two plus-times matvecs (authority then hub), each
+    L2-normalized — a custom step over a (hub, auth) state pair.
+    src is CSR order (sorted by src) → both reductions sorted: auth by
+    dst rides the CSC mirror passed as (csrc, cdst)."""
+    hub, _auth = x
+    valid_f = env["valid_f"]
+    new_auth = S.spmv("plus_times", hub, A["csrc"], A["cdst"], A["cw"],
+                      n_out=n_out, sorted=True) * valid_f
+    new_auth = new_auth / jnp.maximum(
+        jnp.sqrt(jnp.sum(new_auth ** 2)), 1e-30)
+    new_hub = S.spmv("plus_times", new_auth, A["dst"], A["src"], A["w"],
+                     n_out=n_out, sorted=True) * valid_f
+    new_hub = new_hub / jnp.maximum(
+        jnp.sqrt(jnp.sum(new_hub ** 2)), 1e-30)
+    return new_hub, new_auth
 
-    def body(carry):
-        hub, auth, _, it = carry
-        # src here is CSR order (sorted by src) → both reductions sorted:
-        # auth by dst uses the CSC mirror passed as (csrc, cdst)
-        new_auth = jax.ops.segment_sum(hub[csrc] * cweights, cdst,
-                                       num_segments=n_pad,
-                                       indices_are_sorted=True) * valid_f
-        new_auth = new_auth / jnp.maximum(jnp.sqrt(jnp.sum(new_auth ** 2)), 1e-30)
-        new_hub = jax.ops.segment_sum(new_auth[dst] * weights, src,
-                                      num_segments=n_pad,
-                                      indices_are_sorted=True) * valid_f
-        new_hub = new_hub / jnp.maximum(jnp.sqrt(jnp.sum(new_hub ** 2)), 1e-30)
-        err = jnp.max(jnp.abs(new_auth - auth)) + jnp.max(jnp.abs(new_hub - hub))
-        return new_hub, new_auth, err, it + 1
 
-    def cond(carry):
-        _, _, err, it = carry
-        return (err > tol) & (it < max_iterations)
+def _hits_setup(A, P, n_out):
+    valid_f = (jnp.arange(n_out, dtype=jnp.int32)
+               < P["n_nodes"]).astype(jnp.float32)
+    return {"valid_f": valid_f, "x0": (valid_f, valid_f)}
 
-    hub, auth, err, iters = jax.lax.while_loop(
-        cond, body, (hub0, auth0, jnp.float32(jnp.inf), jnp.int32(0)))
-    return hub, auth, err, iters
+
+def _hits_epilogue(x, acc, env, P):
+    hub, auth = x
+    new_hub, new_auth = acc
+    err = jnp.max(jnp.abs(new_auth - auth)) + jnp.max(jnp.abs(new_hub - hub))
+    return (new_hub, new_auth), err
 
 
 def hits(graph: DeviceGraph, max_iterations: int = 100, tol: float = 1e-6):
     """HITS hubs/authorities (analog of cugraph_module/algorithms/hits.cu)."""
-    hub, auth, err, iters = _hits_kernel(
-        graph.src_idx, graph.col_idx, graph.weights,
-        graph.csc_src, graph.csc_dst, graph.csc_weights,
-        jnp.int32(graph.n_nodes), graph.n_pad, max_iterations,
-        jnp.float32(tol))
+    (hub, auth), err, iters = S.fixpoint(
+        "plus_times",
+        arrays={"src": graph.src_idx, "dst": graph.col_idx,
+                "w": graph.weights,
+                "csrc": graph.csc_src, "cdst": graph.csc_dst,
+                "cw": graph.csc_weights},
+        params={"n_nodes": np.int32(graph.n_nodes),
+                "tol": np.float32(tol)},
+        n_out=graph.n_pad, setup=_hits_setup, step=_hits_step,
+        epilogue=_hits_epilogue, max_iterations=max_iterations)
     return hub[:graph.n_nodes], auth[:graph.n_nodes], float(err), int(iters)
 
 
@@ -108,8 +137,8 @@ def degree_centrality(graph: DeviceGraph, direction: str = "total"):
     """Degree centrality (analog of mage/cpp/degree_centrality_module)."""
     n_pad = graph.n_pad
     mask = (jnp.arange(graph.e_pad) < graph.n_edges).astype(jnp.float32)
-    out_deg = jax.ops.segment_sum(mask, graph.src_idx, num_segments=n_pad)
-    in_deg = jax.ops.segment_sum(mask, graph.col_idx, num_segments=n_pad)
+    out_deg = S.edge_reduce("sum", mask, graph.src_idx, n_pad)
+    in_deg = S.edge_reduce("sum", mask, graph.col_idx, n_pad)
     denom = jnp.maximum(graph.n_nodes - 1, 1)
     if direction == "in":
         d = in_deg

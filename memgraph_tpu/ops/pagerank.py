@@ -1,35 +1,32 @@
-"""PageRank as a jitted XLA program over CSR edge arrays.
+"""PageRank on the semiring kernel core (ops/semiring.py).
 
 TPU-native counterpart of the reference's PageRank modules
 (/root/reference/mage/cpp/pagerank_module/, CUDA analog
 mage/cpp/cugraph_module/algorithms/pagerank.cu, online variant
 query_modules/pagerank_module/pagerank_online_module.cpp): weighted power
-iteration expressed as per-edge gathers + a segment-sum scatter by
-destination — the sparse-matvec formulation XLA compiles well for TPU —
-inside a `lax.while_loop` with an L1 convergence check. Dangling-node mass
-is redistributed uniformly each round (standard PageRank semantics).
+iteration as a plus-times semiring fixpoint — the setup hoists the
+per-edge `w / wsum[src]` multipliers, the fused epilogue applies the
+damping update (semiring.pagerank_update, shared with every backend) and
+the L1 convergence partial inside the matvec body. Dangling-node mass is
+redistributed uniformly each round (standard PageRank semantics).
 
 All shapes static; padding edges carry weight 0 into a sink row, so they
-contribute nothing.
+contribute nothing.  `precision=` selects the f32 (exact) / bf16 /
+int8-streaming variants (semiring.PRECISION_BOUNDS documents the bounds).
 """
 
 from __future__ import annotations
 
-import os
 import threading
-from functools import partial
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import semiring as S
 from .csr import DeviceGraph
 
-# Above this edge count the gather-free MXU formulation (ops/spmv_mxu.py)
-# wins despite its host-side plan build; below it the segment-sum kernel's
-# zero setup cost wins. Plan+kernel are cached on the DeviceGraph snapshot,
-# so repeated CALLs on an unchanged graph pay the build once.
-MXU_MIN_EDGES = int(os.environ.get("MEMGRAPH_TPU_MXU_MIN_EDGES", 500_000))
+# back-compat alias; the routing threshold lives with the dispatch now
+MXU_MIN_EDGES = S.MXU_MIN_EDGES
 
 # serializes the expensive plan build PER GRAPH so concurrent first CALLs
 # on one snapshot don't each run it (~35s host-side at 10M edges), while
@@ -38,49 +35,32 @@ MXU_MIN_EDGES = int(os.environ.get("MEMGRAPH_TPU_MXU_MIN_EDGES", 500_000))
 _mxu_locks_guard = threading.Lock()
 
 
-@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _pagerank_kernel(src, dst, weights, csr_src, csr_weights, n_nodes,
-                     n_pad: int, damping, max_iterations: int, tol):
-    """src/dst/weights in CSC ((dst, src)-sorted) order; csr_src/csr_weights
-    are the same edges in CSR order (src sorted) for the out-weight sums.
-
-    TPU tuning (profiled on v5e): destination-sorted indices let XLA lower
-    segment_sum without general scatter (~3x/iteration), and the per-edge
-    multiplier `w / wsum[src]` is gathered ONCE outside the loop, leaving a
-    single rank gather + one sorted segment-sum per iteration.
-    """
+def _pagerank_setup(A, P, n_out):
+    """Loop invariants: hoisted edge multipliers + dangling/valid masks.
+    CSR order is src-sorted, so the out-weight sum takes the sorted
+    lowering; the per-edge multiplier is gathered ONCE per run."""
+    n_nodes = P["n_nodes"]
     n_f = n_nodes.astype(jnp.float32)
-    valid = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes)
+    valid = (jnp.arange(n_out, dtype=jnp.int32) < n_nodes)
     valid_f = valid.astype(jnp.float32)
-
-    # per-source total outgoing weight (0 ⇒ dangling); CSR order is sorted
-    wsum = jax.ops.segment_sum(csr_weights, csr_src, num_segments=n_pad,
-                               indices_are_sorted=True)
+    wsum = S.edge_reduce("sum", A["csr_w"], A["csr_src"], n_out,
+                         sorted=True)
     inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
     dangling = valid & (wsum <= 0)
     dangling_f = dangling.astype(jnp.float32)
-    edge_mult = weights * inv_wsum[src]  # hoisted: one gather per run
+    edge_mult = A["w"] * inv_wsum[A["src"]]  # hoisted: one gather per run
+    return {"w": edge_mult, "valid_f": valid_f, "dangling_f": dangling_f,
+            "n_f": n_f, "x0": valid_f / n_f}
 
-    rank0 = valid_f / n_f
 
-    def body(carry):
-        rank, _, it = carry
-        contrib = rank[src] * edge_mult
-        acc = jax.ops.segment_sum(contrib, dst, num_segments=n_pad,
-                                  indices_are_sorted=True)
-        dangling_mass = jnp.sum(rank * dangling_f)
-        new_rank = valid_f * ((1.0 - damping) / n_f
-                              + damping * (acc + dangling_mass / n_f))
-        err = jnp.sum(jnp.abs(new_rank - rank))
-        return new_rank, err, it + 1
-
-    def cond(carry):
-        _, err, it = carry
-        return (err > tol) & (it < max_iterations)
-
-    rank, err, iters = jax.lax.while_loop(
-        cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
-    return rank, err, iters
+def _pagerank_epilogue(rank, acc, env, P):
+    """FUSED-PAGERANK epilogue: damping update + L1 convergence partial
+    computed on the accumulator inside the while body."""
+    dangling_mass = jnp.sum(rank * env["dangling_f"])
+    new_rank = S.pagerank_update(acc, dangling_mass, env["valid_f"],
+                                 env["n_f"], P["damping"])
+    err = jnp.sum(jnp.abs(new_rank - rank))
+    return new_rank, err
 
 
 # a delta larger than this fraction of the base edge set triggers a full
@@ -157,7 +137,8 @@ def _try_delta_plan(graph: DeviceGraph):
     return (base_plan, run)
 
 
-def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
+def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol,
+                      precision: str = "f32"):
     """Large-graph path: gather-free MXU kernel with the plan cached on
     the (immutable) DeviceGraph snapshot. Successor snapshots of a
     mutated graph refresh O(delta) via DeltaPlan side-nets instead of
@@ -189,14 +170,24 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol):
                 # full plans anchor future delta refreshes (GraphCache)
                 object.__setattr__(graph, "_mxu_base_self", True)
     plan, run = cached
-    # None = uniform start computed on-device (saves a node-flat transfer)
-    rank, err, iters = run(None, np.float32(damping),
-                           int(max_iterations), np.float32(tol))
+    if precision == "bf16":
+        # bf16 Benes routing halves the dominant HBM traffic; cached
+        # separately so the f32 kernel (delta-refresh anchor) survives
+        run = getattr(graph, "_mxu_run_bf16", None)
+        if run is None:
+            run = spmv_mxu.make_pagerank_kernel(
+                plan, route_dtype=jnp.bfloat16)
+            object.__setattr__(graph, "_mxu_run_bf16", run)
+    with S.backend_extent("mxu", record_iterate=True):
+        # None = uniform start computed on-device (saves a transfer)
+        rank, err, iters = run(None, np.float32(damping),
+                               int(max_iterations), np.float32(tol))
     return np.asarray(rank)[plan.out_relabel], float(err), int(iters)
 
 
 def pagerank(graph: DeviceGraph, damping: float = 0.85,
-             max_iterations: int = 100, tol: float = 1e-6, mesh=None):
+             max_iterations: int = 100, tol: float = 1e-6, mesh=None,
+             precision: str = "f32"):
     """Returns (ranks[:n_nodes], error, iterations).
 
     `mesh` routes the computation through the multi-chip layer
@@ -204,76 +195,85 @@ def pagerank(graph: DeviceGraph, damping: float = 0.85,
     or None (→ the MEMGRAPH_TPU_MESH_DEVICES env default; unset keeps
     the single-chip kernels). A mesh-of-1 runs the same sharded code
     path as any other size — single-device is a degeneracy, not a fork.
+
+    `precision` — "f32" (exact), "bf16" (contributions rounded, f32
+    accumulation) or "int8" (quantized streaming; segment backend only);
+    error bounds: semiring.PRECISION_BOUNDS.
     """
     from ..utils.jax_cache import ensure_compile_cache
     ensure_compile_cache()
-    from ..parallel.mesh import resolve_mesh
-    ctx = resolve_mesh(mesh)
-    if ctx is not None:
+    # MXU_MIN_EDGES read at call time: tests (and operators) tune the
+    # threshold by monkeypatching this module attribute
+    backend, ctx = S.route_backend(graph, mesh, semiring="plus_times",
+                                   precision=precision,
+                                   min_edges=MXU_MIN_EDGES)
+    if backend == "mesh":
         from ..parallel.analytics import pagerank_mesh
-        return pagerank_mesh(graph, ctx, damping=damping,
-                             max_iterations=max_iterations, tol=tol)
-    if graph.n_edges >= MXU_MIN_EDGES and (
-            jax.default_backend() != "cpu"
-            or os.environ.get("MEMGRAPH_TPU_FORCE_MXU")):
-        return _pagerank_via_mxu(graph, damping, max_iterations, tol)
-    rank, err, iters = _pagerank_kernel(
-        graph.csc_src, graph.csc_dst, graph.csc_weights,
-        graph.src_idx, graph.weights,
-        np.int32(graph.n_nodes), graph.n_pad,
-        np.float32(damping), max_iterations, np.float32(tol))
+        with S.backend_extent("mesh"):
+            return pagerank_mesh(graph, ctx, damping=damping,
+                                 max_iterations=max_iterations, tol=tol,
+                                 precision=precision)
+    if backend == "mxu":
+        return _pagerank_via_mxu(graph, damping, max_iterations, tol,
+                                 precision)
+    rank, err, iters = S.fixpoint(
+        "plus_times",
+        arrays={"src": graph.csc_src, "dst": graph.csc_dst,
+                "w": graph.csc_weights,
+                "csr_src": graph.src_idx, "csr_w": graph.weights},
+        params={"n_nodes": np.int32(graph.n_nodes),
+                "damping": np.float32(damping),
+                "tol": np.float32(tol)},
+        n_out=graph.n_pad, setup=_pagerank_setup,
+        epilogue=_pagerank_epilogue, max_iterations=max_iterations,
+        sorted=True, precision=precision)
     return rank[:graph.n_nodes], float(err), int(iters)
 
 
-@partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
-def _personalized_kernel(src, dst, weights, csr_src, csr_weights, n_nodes,
-                         n_pad: int, personalization, damping,
-                         max_iterations: int, tol):
-    """src/dst/weights in CSC order (see _pagerank_kernel)."""
-    valid = (jnp.arange(n_pad, dtype=jnp.int32) < n_nodes)
+def _ppr_setup(A, P, n_out):
+    """PPR invariants: normalized restart vector + hoisted multipliers."""
+    n_nodes = P["n_nodes"]
+    valid = (jnp.arange(n_out, dtype=jnp.int32) < n_nodes)
     valid_f = valid.astype(jnp.float32)
-    p = personalization * valid_f
+    p = A["personalization"] * valid_f
     p = p / jnp.maximum(jnp.sum(p), 1e-30)
-
-    wsum = jax.ops.segment_sum(csr_weights, csr_src, num_segments=n_pad,
-                               indices_are_sorted=True)
+    wsum = S.edge_reduce("sum", A["csr_w"], A["csr_src"], n_out,
+                         sorted=True)
     inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
     dangling_f = (valid & (wsum <= 0)).astype(jnp.float32)
-    edge_mult = weights * inv_wsum[src]
+    edge_mult = A["w"] * inv_wsum[A["src"]]
+    return {"w": edge_mult, "p": p, "dangling_f": dangling_f, "x0": p}
 
-    rank0 = p
 
-    def body(carry):
-        rank, _, it = carry
-        contrib = rank[src] * edge_mult
-        acc = jax.ops.segment_sum(contrib, dst, num_segments=n_pad,
-                                  indices_are_sorted=True)
-        dangling_mass = jnp.sum(rank * dangling_f)
-        new_rank = (1.0 - damping) * p + damping * (acc + dangling_mass * p)
-        err = jnp.sum(jnp.abs(new_rank - rank))
-        return new_rank, err, it + 1
-
-    def cond(carry):
-        _, err, it = carry
-        return (err > tol) & (it < max_iterations)
-
-    rank, err, iters = jax.lax.while_loop(
-        cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
-    return rank, err, iters
+def _ppr_epilogue(rank, acc, env, P):
+    """Fused PPR update: restart mass flows to the personalization
+    vector (dangling mass included) instead of uniformly."""
+    p = env["p"]
+    dangling_mass = jnp.sum(rank * env["dangling_f"])
+    new_rank = (1.0 - P["damping"]) * p \
+        + P["damping"] * (acc + dangling_mass * p)
+    err = jnp.sum(jnp.abs(new_rank - rank))
+    return new_rank, err
 
 
 def personalized_pagerank(graph: DeviceGraph, source_nodes,
                           damping: float = 0.85, max_iterations: int = 100,
-                          tol: float = 1e-6):
+                          tol: float = 1e-6, precision: str = "f32"):
     """PPR with restart mass on `source_nodes` (dense indices).
 
     Analog of mage/cpp/cugraph_module/algorithms/personalized_pagerank.cu.
     """
     p = jnp.zeros(graph.n_pad, dtype=jnp.float32)
     p = p.at[jnp.asarray(source_nodes, dtype=jnp.int32)].set(1.0)
-    rank, err, iters = _personalized_kernel(
-        graph.csc_src, graph.csc_dst, graph.csc_weights,
-        graph.src_idx, graph.weights,
-        np.int32(graph.n_nodes), graph.n_pad, p,
-        np.float32(damping), max_iterations, np.float32(tol))
+    rank, err, iters = S.fixpoint(
+        "plus_times",
+        arrays={"src": graph.csc_src, "dst": graph.csc_dst,
+                "w": graph.csc_weights,
+                "csr_src": graph.src_idx, "csr_w": graph.weights,
+                "personalization": p},
+        params={"n_nodes": np.int32(graph.n_nodes),
+                "damping": np.float32(damping),
+                "tol": np.float32(tol)},
+        n_out=graph.n_pad, setup=_ppr_setup, epilogue=_ppr_epilogue,
+        max_iterations=max_iterations, sorted=True, precision=precision)
     return rank[:graph.n_nodes], float(err), int(iters)

@@ -272,8 +272,13 @@ def _global_labelings(src, dst, w, n_nodes):
 
 
 def build_plan(src: np.ndarray, dst: np.ndarray,
-               weights: Optional[np.ndarray], n_nodes: int) -> MXUPlan:
-    """Precompute layouts + routing for the MXU pagerank kernel."""
+               weights: Optional[np.ndarray], n_nodes: int,
+               normalize: bool = True) -> MXUPlan:
+    """Precompute layouts + routing for the MXU semiring-SpMV kernel.
+
+    normalize=True bakes w / out-weight-sum multipliers (the column-
+    stochastic matrix PageRank iterates); normalize=False bakes plain w
+    (the raw A^T other plus-times algorithms — katz — iterate)."""
     src = np.asarray(src, dtype=np.int64)
     dst = np.asarray(dst, dtype=np.int64)
     E = len(src)
@@ -282,6 +287,8 @@ def build_plan(src: np.ndarray, dst: np.ndarray,
 
     (G, relab_out, relab_in, inv_wsum, valid_out, dangling_out,
      n_drows_p, wsum) = _global_labelings(src, dst, w, n_nodes)
+    if not normalize:
+        inv_wsum = np.ones_like(inv_wsum)
 
     R_G, rowid, mult, gp_by_edge = _gather_layout(
         src, w, relab_out, inv_wsum, G)
@@ -474,11 +481,32 @@ def _benes_apply_rolls(x2, masks2, net_log2, live_stages=None):
     return x2
 
 
-def make_pagerank_kernel(plan: MXUPlan, route_dtype=None,
-                         delta: "DeltaPlan" = None):
-    """Returns jitted fn(rank0_flat, damping, max_iter, tol) ->
-    (rank_flat, err, iters); rank vectors are flat in OUT labeling,
-    length G*SG_ROWS*LANES.
+def pagerank_mxu_epilogue(rank, acc, env, P):
+    """The fused PageRank update + convergence partial, applied to the
+    MXU matvec's out-labeled accumulator (shared formula:
+    semiring.pagerank_update)."""
+    import jax.numpy as jnp
+    from .semiring import pagerank_update
+    dm = jnp.sum(rank * env["dangling"])
+    new_rank = pagerank_update(acc, dm, env["valid"], env["n_f"],
+                               P["damping"])
+    err = jnp.sum(jnp.abs(new_rank - rank))
+    return new_rank, err
+
+
+def make_semiring_kernel(plan: MXUPlan, epilogue, route_dtype=None,
+                         delta: "DeltaPlan" = None,
+                         x0_default: str = "uniform"):
+    """Returns jitted fn(x0_flat, params, max_iter, tol) ->
+    (x_flat, err, iters); state vectors are flat in OUT labeling,
+    length G*SG_ROWS*LANES.  The semiring-parameterized generalization
+    of the pagerank-only r5 kernel: the matvec (expand -> Benes route ->
+    MXU reduce/extract -> node relabel) is fixed ⊕ = sum machinery —
+    the one-hot extract matmul IS the sum — while the fused
+    ``epilogue(x, acc, env, params) -> (new_x, err)`` supplies the
+    algorithm (env carries valid / dangling / n_f; params is a dict of
+    traced scalars).  ⊗ is baked into the plan's multipliers
+    (build_plan(normalize=...)).
 
     route_dtype: dtype for the per-edge contributions through the big
     Benes (the dominant HBM traffic). bfloat16 halves it; sums still
@@ -489,7 +517,10 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None,
     delta: optional DeltaPlan — per iteration the base expand reads
     rank pre-scaled by delta.scale_out, the delta edges route through
     their own (small) net, and both accumulators sum before the node
-    relabel. Exact for edge additions AND removals."""
+    relabel. Exact for edge additions AND removals.
+
+    x0_default: the on-device start when x0 is None — "uniform"
+    (valid/n, pagerank) or "zeros" (katz)."""
     import jax
     import jax.numpy as jnp
     from ..utils.jax_cache import ensure_compile_cache
@@ -657,7 +688,9 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None,
         return jnp.einsum("cw,ckl->wkl", dv["d_win_oh"], per_chunk,
                           preferred_element_type=jnp.float32)
 
-    def one_iter(rank_flat, d, dv):
+    def matvec(rank_flat, dv):
+        """⊕ = sum semiring matvec in OUT labeling (expand -> route ->
+        MXU reduce/extract -> node relabel); ⊗ is baked into mult."""
         # base expand reads rank pre-scaled so stale w/wsum_old
         # multipliers become w/wsum_new (exact; see DeltaPlan)
         base_in = (rank_flat * dv["d_scale"] if delta is not None
@@ -683,47 +716,66 @@ def make_pagerank_kernel(plan: MXUPlan, route_dtype=None,
         acc_in2 = accw.reshape(-1, LANES)                  # (W*K_C, 128)
         xa = jnp.zeros((N_nn // LANES, LANES), jnp.float32
                        ).at[:acc_in2.shape[0]].set(acc_in2)
-        acc_out = _route_node(xa, dv).reshape(-1)[:node_flat]
-        dm = jnp.sum(rank_flat * dv["dangling"])
-        new_rank = dv["valid"] * ((1.0 - d) / n_f
-                                  + d * (acc_out + dm / n_f))
-        return new_rank
+        return _route_node(xa, dv).reshape(-1)[:node_flat]
 
-    def _loop(rank0, damping, max_iterations, tol, dv):
+    def _loop(x0, params, max_iterations, tol, dv):
+        env = {"valid": dv["valid"], "dangling": dv["dangling"],
+               "n_f": n_f}
+
         def body(carry):
-            rank, _, it = carry
-            new_rank = one_iter(rank, damping, dv)
-            err = jnp.sum(jnp.abs(new_rank - rank))
-            return new_rank, err, it + 1
+            x, _, it = carry
+            acc_out = matvec(x, dv)
+            # FUSED-PAGERANK: the update + convergence partial run on
+            # the accumulator inside the loop body — no extra HBM trip
+            new_x, err = epilogue(x, acc_out, env, params)
+            return new_x, err, it + 1
 
         def cond(carry):
             _, err, it = carry
             return (err > tol) & (it < max_iterations)
 
         return jax.lax.while_loop(
-            cond, body, (rank0, jnp.float32(jnp.inf), jnp.int32(0)))
+            cond, body, (x0, jnp.float32(jnp.inf), jnp.int32(0)))
 
     # prepare + loop fused into ONE jit call: the cold path is then a
     # single blob transfer + one compile-cached dispatch + one readback
     # (each extra RPC costs ~0.5-1s through the tunnel)
     @partial(jax.jit, static_argnames=("max_iterations",))
-    def run_impl(blob, rank0, damping, max_iterations: int, tol):
-        return _loop(rank0, damping, max_iterations, tol, prepare(blob))
+    def run_impl(blob, x0, params, max_iterations: int, tol):
+        return _loop(x0, params, max_iterations, tol, prepare(blob))
 
     @partial(jax.jit, static_argnames=("max_iterations",))
-    def run_impl_uniform(blob, damping, max_iterations: int, tol):
+    def run_impl_default(blob, params, max_iterations: int, tol):
         dv = prepare(blob)
-        rank0 = dv["valid"] * jnp.float32(1.0 / n_f)
-        return _loop(rank0, damping, max_iterations, tol, dv)
+        if x0_default == "zeros":
+            x0 = jnp.zeros_like(dv["valid"])
+        else:
+            x0 = dv["valid"] * jnp.float32(1.0 / n_f)
+        return _loop(x0, params, max_iterations, tol, dv)
 
-    def run(rank0, damping, max_iterations, tol):
-        """rank0 = None starts from the uniform distribution, computed
-        on-device (saves the rank0 host->device transfer)."""
-        if rank0 is None:
-            return run_impl_uniform(blob_dev, damping, max_iterations, tol)
-        return run_impl(blob_dev, rank0, damping, max_iterations, tol)
+    def run(x0, params, max_iterations, tol):
+        """x0 = None starts from the on-device default state (uniform
+        distribution or zeros; saves the x0 host->device transfer)."""
+        if x0 is None:
+            return run_impl_default(blob_dev, params, max_iterations, tol)
+        return run_impl(blob_dev, x0, params, max_iterations, tol)
 
     return run
+
+
+def make_pagerank_kernel(plan: MXUPlan, route_dtype=None,
+                         delta: "DeltaPlan" = None):
+    """Back-compat pagerank entry: the semiring kernel with the fused
+    pagerank epilogue.  Returns jitted fn(rank0_flat, damping,
+    max_iter, tol) -> (rank_flat, err, iters)."""
+    run = make_semiring_kernel(plan, epilogue=pagerank_mxu_epilogue,
+                               route_dtype=route_dtype, delta=delta,
+                               x0_default="uniform")
+
+    def run_pr(rank0, damping, max_iterations, tol):
+        return run(rank0, {"damping": damping}, max_iterations, tol)
+
+    return run_pr
 
 
 def pagerank_mxu(src, dst, weights, n_nodes, damping=0.85,

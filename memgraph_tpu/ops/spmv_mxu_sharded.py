@@ -242,13 +242,16 @@ def make_sharded_pagerank_kernel(plan: ShardedMXUPlan, mesh,
         return accw.reshape(-1, LANES)            # (n_drows_p, 128)
 
     def node_phase(acc_in2, rank_flat, gdv, d):
+        from .semiring import pagerank_update
         xa = jnp.zeros((N_nn // LANES, LANES), jnp.float32
                        ).at[:acc_in2.shape[0]].set(acc_in2)
         acc_out = _benes_apply_rolls(
             xa, gdv["node_masks2"], plan.node_net_log2,
             live_stages=live_node).reshape(-1)[:node_flat]
         dm = jnp.sum(rank_flat * gdv["dangling"])
-        return gdv["valid"] * ((1.0 - d) / n_f + d * (acc_out + dm / n_f))
+        # shared damping-update formula (ops/semiring.py): the sharded
+        # MXU kernel applies the SAME epilogue as every other backend
+        return pagerank_update(acc_out, dm, gdv["valid"], n_f, d)
 
     def shard_fn(blob_row, gblob, rank0, damping, tol, max_iterations):
         blob = blob_row[0]

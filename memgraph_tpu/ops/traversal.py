@@ -1,11 +1,18 @@
-"""Whole-graph traversal kernels: BFS levels, single-source shortest paths.
+"""Whole-graph traversal kernels on the semiring core: BFS levels,
+single-source shortest paths.
 
-Device-side counterparts of the traversal algorithms the reference embeds in
-its ExpandVariable operator (BFS/weighted shortest path,
-/root/reference/src/query/plan/operator.hpp:1140) for the *analytics* regime:
-when the query wants distances/paths from a source over the whole graph, a
-frontier-relaxation program (Bellman-Ford style: gather + segment-min until
+Device-side counterparts of the traversal algorithms the reference embeds
+in its ExpandVariable operator (BFS/weighted shortest path,
+/root/reference/src/query/plan/operator.hpp:1140) for the *analytics*
+regime: when the query wants distances/paths from a source over the whole
+graph, a min-plus semiring fixpoint (Bellman-Ford: gather + ⊕=min until
 fixpoint) beats pull-based expansion by orders of magnitude on TPU.
+
+BFS additionally rides the core's direction-optimizing push/pull
+selection (semiring.select_pull, the Beamer/GraphBLAST heuristic): a
+sparse frontier relaxes push-style (frontier-masked contributions), a
+dense one pulls over every edge — both exact, chosen per level from the
+frontier's out-edge mass.
 
 The point-query regime (short anchored expansions) stays on the host
 executor, which walks adjacency directly — same split the reference makes
@@ -18,72 +25,129 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from . import semiring as S
 from .csr import DeviceGraph
 
 INF = jnp.float32(3.4e38)
 
 
-@partial(jax.jit, static_argnames=("n_pad", "max_iterations", "directed"))
-def _sssp_kernel(src, dst, w, source, n_pad: int, max_iterations: int,
-                 directed: bool):
-    dist0 = jnp.full((n_pad,), INF, dtype=jnp.float32).at[source].set(0.0)
+def _sssp_step_directed(dist, A, env, P, n_out):
+    """min-plus relaxation: cand[v] = min over edges (u,v) of d[u]+w."""
+    cand = S.spmv("min_plus", dist, A["src"], A["dst"], A["w"],
+                  n_out=n_out)
+    return jnp.minimum(dist, cand)
 
-    def body(carry):
-        dist, _, it = carry
-        relax = dist[src] + w
-        cand = jax.ops.segment_min(relax, dst, num_segments=n_pad)
-        new = jnp.minimum(dist, cand)
-        if not directed:
-            relax_b = new[dst] + w
-            cand_b = jax.ops.segment_min(relax_b, src, num_segments=n_pad)
-            new = jnp.minimum(new, cand_b)
-        return new, jnp.any(new < dist), it + 1
 
-    def cond(carry):
-        _, changed, it = carry
-        return changed & (it < max_iterations)
+def _sssp_step_undirected(dist, A, env, P, n_out):
+    """Directed pass then the reverse orientation over the UPDATED
+    distances (Gauss-Seidel flavor: halves the round count)."""
+    new = _sssp_step_directed(dist, A, env, P, n_out)
+    cand_b = S.spmv("min_plus", new, A["dst"], A["src"], A["w"],
+                    n_out=n_out)
+    return jnp.minimum(new, cand_b)
 
-    dist, _, iters = jax.lax.while_loop(
-        cond, body, (dist0, jnp.bool_(True), jnp.int32(0)))
-    return dist, iters
+
+def _sssp_epilogue(dist, new, env, P):
+    return new, jnp.any(new < dist)
 
 
 def sssp(graph: DeviceGraph, source: int, weighted: bool = True,
          directed: bool = True, max_iterations: int = 10_000):
-    """Bellman-Ford SSSP. Returns (dist[:n_nodes] float32, iterations);
-    unreachable nodes get +inf. With weighted=False computes hop counts
-    (= BFS levels)."""
+    """Bellman-Ford SSSP as a min-plus fixpoint. Returns
+    (dist[:n_nodes] float32, iterations); unreachable nodes get +inf.
+    With weighted=False computes hop counts (= BFS levels)."""
     w = graph.weights if weighted else jnp.where(
         jnp.arange(graph.e_pad) < graph.n_edges, 1.0, INF).astype(jnp.float32)
     if weighted:
         # padding edges have weight 0 into the sink row — force them inert
         w = jnp.where(jnp.arange(graph.e_pad) < graph.n_edges, w, INF)
-    dist, iters = _sssp_kernel(graph.src_idx, graph.col_idx, w,
-                               jnp.int32(source), graph.n_pad,
-                               max_iterations, directed)
+    dist0 = np.full((graph.n_pad,), float(INF), dtype=np.float32)
+    dist0[source] = 0.0
+    dist, _, iters = S.fixpoint(
+        "min_plus",
+        arrays={"src": graph.src_idx, "dst": graph.col_idx, "w": w},
+        x0=jnp.asarray(dist0), n_out=graph.n_pad,
+        step=(_sssp_step_directed if directed
+              else _sssp_step_undirected),
+        epilogue=_sssp_epilogue, max_iterations=max_iterations,
+        metric="changed")
+    out = dist[:graph.n_nodes]
+    return jnp.where(out >= INF / 2, jnp.inf, out), int(iters)
+
+
+def _bfs_step(x, A, env, P, n_out):
+    """Direction-optimizing BFS relaxation: push (frontier-masked
+    contributions) while the frontier's out-edge mass is below
+    n_edges / alpha, pull (all edges) once it saturates.  Both sides
+    are exact for the monotone level recurrence; the selector only
+    changes the executed formulation."""
+    dist, frontier = x
+    pull = S.select_pull(frontier, A["deg"], P["n_edges"])
+    new = jax.lax.cond(
+        pull,
+        lambda d: S.spmv("min_plus", d, A["src"], A["dst"], A["w"],
+                         n_out=n_out),
+        lambda d: S.spmv("min_plus", d, A["src"], A["dst"], A["w"],
+                         n_out=n_out, frontier=frontier),
+        dist)
+    return jnp.minimum(dist, new)
+
+
+def _bfs_epilogue(x, new, env, P):
+    dist, _frontier = x
+    new_frontier = new < dist
+    return (new, new_frontier), jnp.any(new_frontier)
+
+
+def do_bfs(graph: DeviceGraph, source: int, max_iterations: int = 10_000):
+    """Direction-optimizing BFS (directed): returns (dist f32 hops with
+    +inf for unreachable, iterations).  Level-exact vs the plain
+    min-plus fixpoint — only the push/pull execution strategy differs."""
+    w = jnp.where(jnp.arange(graph.e_pad) < graph.n_edges, 1.0,
+                  INF).astype(jnp.float32)
+    dist0 = np.full((graph.n_pad,), float(INF), dtype=np.float32)
+    dist0[source] = 0.0
+    frontier0 = np.zeros(graph.n_pad, dtype=bool)
+    frontier0[source] = True
+    (dist, _), _, iters = S.fixpoint(
+        "min_plus",
+        arrays={"src": graph.src_idx, "dst": graph.col_idx, "w": w,
+                "deg": graph.out_degree},
+        params={"n_edges": np.float32(graph.n_edges)},
+        x0=(jnp.asarray(dist0), jnp.asarray(frontier0)),
+        n_out=graph.n_pad, step=_bfs_step, epilogue=_bfs_epilogue,
+        max_iterations=max_iterations, metric="changed")
     out = dist[:graph.n_nodes]
     return jnp.where(out >= INF / 2, jnp.inf, out), int(iters)
 
 
 def bfs_levels(graph: DeviceGraph, source: int, directed: bool = True,
                max_iterations: int = 10_000):
-    """BFS levels from source (-1 for unreachable)."""
-    dist, iters = sssp(graph, source, weighted=False, directed=directed,
-                       max_iterations=max_iterations)
+    """BFS levels from source (-1 for unreachable).  The directed case
+    rides the direction-optimizing push/pull core path; the undirected
+    view falls back to the Gauss-Seidel min-plus fixpoint."""
+    if directed:
+        dist, iters = do_bfs(graph, source, max_iterations=max_iterations)
+    else:
+        dist, iters = sssp(graph, source, weighted=False,
+                           directed=directed,
+                           max_iterations=max_iterations)
     levels = jnp.where(jnp.isinf(dist), -1, dist.astype(jnp.int32))
     return levels, iters
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))
 def _mssp_kernel(src, dst, w, sources, n_pad: int, max_iterations: int):
-    """Multi-source SSSP: one distance row per source, vmapped relaxation."""
+    """Multi-source SSSP: one distance row per source, vmapped min-plus
+    relaxation."""
     def single(source):
         dist0 = jnp.full((n_pad,), INF, dtype=jnp.float32).at[source].set(0.0)
 
         def body(carry):
             dist, _, it = carry
-            cand = jax.ops.segment_min(dist[src] + w, dst, num_segments=n_pad)
+            cand = S.spmv("min_plus", dist, src, dst, w, n_out=n_pad)
             new = jnp.minimum(dist, cand)
             return new, jnp.any(new < dist), it + 1
 

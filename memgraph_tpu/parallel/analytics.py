@@ -78,7 +78,8 @@ def _resume_kw(checkpoint_every, job, store, report, retry):
 
 def pagerank_mesh(graph: DeviceGraph, ctx: MeshContext,
                   damping: float = 0.85, max_iterations: int = 100,
-                  tol: float = 1e-6, *, checkpoint_every: int | None = None,
+                  tol: float = 1e-6, *, precision: str = "f32",
+                  checkpoint_every: int | None = None,
                   job: str | None = None, store=None, report=None,
                   retry=None):
     """Sharded PageRank; same contract as ops.pagerank.pagerank."""
@@ -86,12 +87,14 @@ def pagerank_mesh(graph: DeviceGraph, ctx: MeshContext,
     scsr = _shard_traced(graph, ctx, by="src")
     return pagerank_partition_centric(
         scsr, ctx, damping=damping, max_iterations=max_iterations,
-        tol=tol, **_resume_kw(checkpoint_every, job, store, report, retry))
+        tol=tol, precision=precision,
+        **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
 def katz_mesh(graph: DeviceGraph, ctx: MeshContext, alpha: float = 0.2,
               beta: float = 1.0, max_iterations: int = 100,
               tol: float = 1e-6, normalized: bool = False, *,
+              precision: str = "f32",
               checkpoint_every: int | None = None, job: str | None = None,
               store=None, report=None, retry=None):
     """Sharded Katz centrality; same contract as ops.katz.katz_centrality."""
@@ -100,6 +103,7 @@ def katz_mesh(graph: DeviceGraph, ctx: MeshContext, alpha: float = 0.2,
     return katz_partition_centric(
         scsr, ctx, alpha=alpha, beta=beta,
         max_iterations=max_iterations, tol=tol, normalized=normalized,
+        precision=precision,
         **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
@@ -144,3 +148,38 @@ def sssp_mesh(graph: DeviceGraph, ctx: MeshContext, source: int,
     sg = shard_graph(graph, ctx.mesh, axis=ctx.axis)
     dist, iters = sssp_sharded(sg, source, max_iterations=max_iterations)
     return np.asarray(dist), iters
+
+
+def bfs_mesh(graph: DeviceGraph, ctx: MeshContext, source: int,
+             max_iterations: int = 10_000, *, precision: str = "f32",
+             checkpoint_every: int | None = None, job: str | None = None,
+             store=None, report=None, retry=None):
+    """BFS levels over the mesh via the GENERIC semiring kernel — the
+    ~40-line new-algorithm story: a (min_plus, x0, relax-epilogue)
+    triple riding semiring_partition_centric (one pmin per level,
+    checkpoint-resumable).  Returns (levels[:n_nodes] int32 with -1 for
+    unreachable, iterations); same result contract as
+    ops.traversal.bfs_levels (directed)."""
+    import jax.numpy as jnp
+    from .distributed import (_minplus_relax_epilogue,
+                              semiring_partition_centric)
+    scsr = _shard_traced(graph, ctx, by="src")
+    inf = np.float32(3.4e38)
+    # unit hop weights; padding edges (dst = sink row n_nodes) stay inert
+    unit_w = jnp.where(scsr.dst == scsr.n_nodes, inf,
+                       jnp.float32(1.0)).astype(jnp.float32)
+    hop_scsr = scsr.__class__(
+        src=scsr.src, dst=scsr.dst, weights=unit_w,
+        block_ptr=scsr.block_ptr, n_nodes=scsr.n_nodes,
+        n_edges=scsr.n_edges, n_shards=scsr.n_shards, block=scsr.block,
+        n_pad2=scsr.n_pad2, per=scsr.per, by=scsr.by)
+    x0 = np.full(scsr.n_pad2, inf, dtype=np.float32)
+    x0[source] = 0.0
+    dist, _, iters = semiring_partition_centric(
+        hop_scsr, ctx, "min_plus", x0, _minplus_relax_epilogue,
+        max_iterations=max_iterations, metric="changed",
+        precision=precision, algo="bfs",
+        **_resume_kw(checkpoint_every, job, store, report, retry))
+    dist = np.asarray(dist)
+    levels = np.where(dist >= inf / 2, -1, dist.astype(np.int64))
+    return levels.astype(np.int32), iters

@@ -25,11 +25,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import MeshContext, shard_map_fn
 from ..ops.csr import DeviceGraph, ShardedCSR
+from ..ops.semiring import (edge_combine, edge_reduce,
+                            pagerank_update, resolve_semiring)
 
 # version-gated central resolution (parallel/mesh.py): jax >= 0.5 uses the
 # public jax.shard_map; the 0.4 line gets the experimental one with
 # check_rep=False and a WARNING logged once — never a silent fallback
 shard_map = shard_map_fn()
+
+
+def _cast_contrib(contrib, precision: str):
+    """Reduced-precision streaming on the mesh backend: round each
+    per-edge contribution to bf16 before the f32 segment accumulation
+    (same contract as the segment backend's bf16 path; int8 streaming
+    is a segment-backend feature — the collective lanes stay f32)."""
+    if precision == "bf16":
+        return contrib.astype(jnp.bfloat16).astype(jnp.float32)
+    if precision != "f32":
+        raise ValueError(
+            f"mesh kernels route f32/bf16 only, got {precision!r}")
+    return contrib
 
 
 @dataclass(frozen=True)
@@ -381,7 +396,8 @@ def wcc_sharded(sg: ShardedGraph, max_iterations: int = 200):
 _PC_EXTRA = 2          # piggyback lanes: [dangling_mass, prev_local_err]
 
 
-def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int):
+def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int,
+                       precision: str = "f32"):
     axis = ctx.axis
     n_pad2 = n_shards * block
 
@@ -408,14 +424,13 @@ def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int):
 
         def body(carry):
             rank, local_err, _, it = carry
-            contrib = rank[local_src] * edge_mult
+            contrib = _cast_contrib(rank[local_src] * edge_mult,
+                                    precision)
             # the (dst, src) sort within the shard means this sorted
             # segment-sum fills the (dst-shard, local-dst) blocks of the
             # partition-centric layout contiguously
-            acc = jax.ops.segment_sum(contrib, dst_blk,
-                                      num_segments=n_pad2,
-                                      indices_are_sorted=True
-                                      ).reshape(n_shards, block)
+            acc = edge_reduce("sum", contrib, dst_blk, n_pad2,
+                              sorted=True).reshape(n_shards, block)
             dm_local = jnp.sum(rank * dangling_f)
             extras = jnp.broadcast_to(
                 jnp.stack([dm_local, local_err]), (n_shards, _PC_EXTRA))
@@ -426,8 +441,7 @@ def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int):
             acc_own = got[:block]
             dm = got[block]
             g_err_prev = got[block + 1]
-            new_rank = valid_f * ((1.0 - damping) / n_f
-                                  + damping * (acc_own + dm / n_f))
+            new_rank = pagerank_update(acc_own, dm, valid_f, n_f, damping)
             new_local_err = jnp.sum(jnp.abs(new_rank - rank))
             return new_rank, new_local_err, g_err_prev, it + 1
 
@@ -490,6 +504,7 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                                damping: float = 0.85,
                                max_iterations: int = 100,
                                tol: float = 1e-6, *,
+                               precision: str = "f32",
                                checkpoint_every: int = 0,
                                job: str | None = None, store=None,
                                retry=None, chunk_deadline_s=None,
@@ -502,6 +517,10 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     rides the next iteration's collective), so tol-based runs may do one
     extra iteration; fixed-iteration runs (tol=0) are unchanged.
 
+    `precision="bf16"` rounds per-edge contributions to bfloat16 before
+    the f32 accumulation (semiring.PRECISION_BOUNDS documents the error
+    budget); the collective payload stays f32.
+
     `checkpoint_every=k` (> 0) checkpoints the loop carry to host memory
     every k iterations and resumes from the last checkpoint after a
     device fault — re-executing at most k iterations, bit-exact to an
@@ -511,7 +530,7 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     if scsr.by != "src":
         raise ValueError("pagerank needs a src-owned ShardedCSR")
     fn = _pc_cached("pagerank", _pc_pagerank_build, ctx,
-                    scsr.block, scsr.n_shards)
+                    scsr.block, scsr.n_shards, precision)
     ids = np.arange(scsr.n_pad2, dtype=np.int64)
     rank0 = (ids < scsr.n_nodes).astype(np.float32) \
         / np.float32(scsr.n_nodes)
@@ -534,7 +553,8 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     return rank[:scsr.n_nodes], float(err), int(iters)
 
 
-def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int):
+def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int,
+                   precision: str = "f32"):
     axis = ctx.axis
     n_pad2 = n_shards * block
 
@@ -546,9 +566,9 @@ def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int):
 
         def body(carry):
             x, _, it = carry
-            acc_local = jax.ops.segment_sum(x[src_blk] * w_blk, dst_blk,
-                                            num_segments=n_pad2,
-                                            indices_are_sorted=True)
+            contrib = _cast_contrib(x[src_blk] * w_blk, precision)
+            acc_local = edge_reduce("sum", contrib, dst_blk, n_pad2,
+                                    sorted=True)
             acc = jax.lax.psum(acc_local, axis)    # the one collective
             new_x = valid_f * (alpha * acc + beta)
             # x is replicated: every device computes the same error —
@@ -584,6 +604,7 @@ def katz_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                            alpha: float = 0.2, beta: float = 1.0,
                            max_iterations: int = 100, tol: float = 1e-6,
                            normalized: bool = False, *,
+                           precision: str = "f32",
                            checkpoint_every: int = 0,
                            job: str | None = None, store=None,
                            retry=None, chunk_deadline_s=None,
@@ -591,7 +612,7 @@ def katz_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     """Katz centrality over the mesh: x replicated, one psum/iteration.
     Checkpoint/resume semantics as in `pagerank_partition_centric`."""
     fn = _pc_cached("katz", _pc_katz_build, ctx,
-                    scsr.block, scsr.n_shards)
+                    scsr.block, scsr.n_shards, precision)
     carry0 = (np.zeros(scsr.n_pad2, dtype=np.float32),
               np.float32(np.inf), np.int32(0))
 
@@ -778,3 +799,101 @@ def wcc_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
         checkpoint_every=checkpoint_every, job=job, store=store,
         retry=retry, chunk_deadline_s=chunk_deadline_s, report=report)
     return comp[:scsr.n_nodes], int(iters)
+
+
+# ==========================================================================
+# Generic semiring kernel (ops/semiring.py's mesh backend)
+# ==========================================================================
+#
+# A NEW algorithm's mesh story is now a (semiring, x0, epilogue) triple:
+# x replicated, per-shard ⊗-combine + local ⊕-reduce, ONE ⊕-matched
+# collective per iteration (psum / pmin / pmax), the fused epilogue
+# applied replicated — same invariants as the tuned kernels above, and
+# checkpoint-resumable through the same r12 chunk machinery.
+
+_PC_COLLECTIVE = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                  "max": jax.lax.pmax, "or": jax.lax.pmax}
+
+
+def _pc_semiring_build(ctx: MeshContext, block: int, n_shards: int,
+                       sr_name: str, epilogue, metric: str,
+                       precision: str):
+    sr = resolve_semiring(sr_name)
+    axis = ctx.axis
+    n_pad2 = n_shards * block
+    collective = _PC_COLLECTIVE[sr.add]
+
+    def step(src_blk, dst_blk, w_blk, params, x, m, it, it_stop):
+        src_blk, dst_blk, w_blk = src_blk[0], dst_blk[0], w_blk[0]
+
+        def body(carry):
+            x, _, it = carry
+            vals = edge_combine(sr, x[src_blk],
+                                None if sr.mul == "first" else w_blk)
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                vals = _cast_contrib(vals, precision)
+            acc_local = edge_reduce(sr.add, vals, dst_blk, n_pad2,
+                                    sorted=True)
+            acc = collective(acc_local, axis)      # the one collective
+            new_x, new_m = epilogue(x, acc, {}, params)
+            return new_x, new_m, it + 1
+
+        if metric == "changed":
+            def cond(carry):
+                _, m, it = carry
+                return m & (it < it_stop)
+        else:
+            def cond(carry):
+                _, m, it = carry
+                return (m > params["tol"]) & (it < it_stop)
+
+        return jax.lax.while_loop(cond, body, (x, m, it))
+
+    Pr = P()
+    Pe = P(axis, None)
+    return jax.jit(shard_map(
+        step, mesh=ctx.mesh,
+        in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr),
+        out_specs=(Pr, Pr, Pr)))
+
+
+def semiring_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
+                               semiring, x0, epilogue, params=None,
+                               max_iterations: int = 100,
+                               metric: str = "changed",
+                               precision: str = "f32", *,
+                               algo: str = "semiring",
+                               checkpoint_every: int = 0,
+                               job: str | None = None, store=None,
+                               retry=None, chunk_deadline_s=None,
+                               report=None):
+    """Run a (semiring, x0, epilogue) fixpoint over the mesh: exactly
+    one collective per iteration, checkpoint-resumable. Returns
+    (x[:n_nodes], metric, iters)."""
+    sr = resolve_semiring(semiring)
+    params = params or {}
+    fn = _pc_cached(f"semiring:{sr.name}", _pc_semiring_build, ctx,
+                    scsr.block, scsr.n_shards, sr.name, epilogue,
+                    metric, precision)
+    m0 = np.bool_(True) if metric == "changed" \
+        else np.float32(np.inf)
+    carry0 = (np.asarray(x0), m0, np.int32(0))
+
+    def chunk_of(s):
+        def chunk(carry, it_stop):
+            return fn(s.src, s.dst, s.weights, params, *carry,
+                      jnp.int32(it_stop))
+        return chunk
+
+    x, m, iters = _run_pc_resumable(
+        algo=algo, scsr=scsr, ctx=ctx, chunk_of=chunk_of,
+        carry0=carry0, iter_index=2, max_iterations=max_iterations,
+        checkpoint_every=checkpoint_every, job=job, store=store,
+        retry=retry, chunk_deadline_s=chunk_deadline_s, report=report)
+    return x[:scsr.n_nodes], m, int(iters)
+
+
+def _minplus_relax_epilogue(x, acc, env, P):
+    """min-plus relaxation epilogue (BFS / SSSP over the mesh)."""
+    new = jnp.minimum(x, acc)
+    return new, jnp.any(new < x)

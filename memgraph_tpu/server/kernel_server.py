@@ -384,7 +384,7 @@ class KernelServer:
                         _send_msg(conn, {"ok": True})
                         self._shutdown.set()
                         return
-                    elif op in ("pagerank", "probe"):
+                    elif op in ("pagerank", "semiring", "probe"):
                         # supervised: admission guard + worker thread +
                         # per-request deadline; the reply ships AFTER
                         # the dispatch lock is released — a slow client
@@ -534,6 +534,8 @@ class KernelServer:
             checksum, platform = probe_device()
             return ({"ok": True, "platform": platform,
                      "sum": checksum}, None)
+        if op == "semiring":
+            return self._op_semiring(header, arrays)
         return self._op_pagerank(header, arrays)
 
     def _health_reply(self) -> dict:
@@ -567,15 +569,11 @@ class KernelServer:
     MAX_CACHED_GRAPHS = 8     # LRU cap: the daemon is long-lived and a
     #                           DeviceGraph pins device HBM + host arrays
 
-    def _op_pagerank(self, header, arrays):
-        """Runs under _dispatch_lock; returns (reply_header,
-        reply_arrays) for the caller to ship outside the lock. Routes
-        through the RESUMABLE mesh entry point (mesh-of-1 unless
-        MEMGRAPH_TPU_MESH_DEVICES configures a wider mesh), so a device
-        fault mid-run redoes at most checkpoint_every iterations."""
+    def _resolve_graph(self, header, arrays):
+        """Graph-key LRU lookup / edge-array import shared by every
+        graph-shaped op. Runs under _dispatch_lock (see _op_pagerank).
+        Returns a DeviceGraph or None (caller replies invalid)."""
         from ..ops.csr import from_coo
-        from ..parallel import analytics
-        from ..parallel.mesh import analytics_mesh, get_mesh_context
         from ..utils.sanitize import shared_write
         key = header.get("graph_key")
         # mglint: disable=MG006 — the dispatcher (_supervised worker) holds _dispatch_lock across this whole handler; intraprocedural analysis cannot see caller locks
@@ -584,8 +582,7 @@ class KernelServer:
             self._graphs[key] = g              # re-insert: LRU refresh
         if g is None:
             if "src" not in arrays:
-                return ({"ok": False, "error": "unknown graph_key "
-                         "and no edge arrays supplied"}, None)
+                return None
             g = from_coo(arrays["src"].astype(np.int64),
                          arrays["dst"].astype(np.int64),
                          arrays.get("weights"),
@@ -598,15 +595,74 @@ class KernelServer:
                 with self._stats_lock:
                     shared_write(self, "_graphs_cached")
                     self._graphs_cached = len(self._graphs)  # mglint: disable=MG006 — len snapshot for health; insert path holds _dispatch_lock
+        return g
+
+    def _op_pagerank(self, header, arrays):
+        """Runs under _dispatch_lock; returns (reply_header,
+        reply_arrays) for the caller to ship outside the lock. Routes
+        through the RESUMABLE mesh entry point (mesh-of-1 unless
+        MEMGRAPH_TPU_MESH_DEVICES configures a wider mesh), so a device
+        fault mid-run redoes at most checkpoint_every iterations."""
+        from ..ops import semiring as S
+        from ..parallel import analytics
+        from ..parallel.mesh import analytics_mesh, get_mesh_context
+        g = self._resolve_graph(header, arrays)
+        if g is None:
+            return ({"ok": False, "error": "unknown graph_key "
+                     "and no edge arrays supplied"}, None)
+        key = header.get("graph_key")
         ctx = analytics_mesh() or get_mesh_context(1)
-        ranks, err, iters = analytics.pagerank_mesh(
-            g, ctx, damping=header.get("damping", 0.85),
-            max_iterations=header.get("max_iterations", 100),
-            tol=header.get("tol", 1e-6),
-            checkpoint_every=self.checkpoint_every,
-            job=f"kernel_server:pagerank:{key}" if key else None)
+        with S.backend_extent("mesh"):
+            ranks, err, iters = analytics.pagerank_mesh(
+                g, ctx, damping=header.get("damping", 0.85),
+                max_iterations=header.get("max_iterations", 100),
+                tol=header.get("tol", 1e-6),
+                precision=header.get("precision", "f32"),
+                checkpoint_every=self.checkpoint_every,
+                job=f"kernel_server:pagerank:{key}" if key else None)
         return ({"ok": True, "err": float(err), "iters": int(iters)},
                 {"ranks": np.asarray(ranks, dtype=np.float32)})
+
+    def _op_semiring(self, header, arrays):
+        """Semiring-core dispatch: run a named core-routed algorithm at
+        a requested precision through the resident runtime.  Currently
+        serves `pagerank` (plus-times, any precision — the bench's
+        stage_semiring sweep) and `bfs` (min-plus levels via the
+        GENERIC mesh semiring kernel).  Runs under _dispatch_lock."""
+        from ..ops import semiring as S
+        from ..parallel import analytics
+        from ..parallel.mesh import analytics_mesh, get_mesh_context
+        g = self._resolve_graph(header, arrays)
+        if g is None:
+            return ({"ok": False, "error": "unknown graph_key "
+                     "and no edge arrays supplied"}, None)
+        algorithm = header.get("algorithm", "pagerank")
+        precision = header.get("precision", "f32")
+        max_iterations = header.get("max_iterations", 100)
+        if algorithm == "pagerank":
+            from ..ops.pagerank import pagerank
+            # ops-level entry: route_backend picks mesh/mxu/segment and
+            # records the per-backend stage the PROFILE plane shows
+            ranks, err, iters = pagerank(
+                g, damping=header.get("damping", 0.85),
+                max_iterations=max_iterations,
+                tol=header.get("tol", 1e-6), precision=precision)
+            return ({"ok": True, "err": float(err), "iters": int(iters),
+                     "algorithm": algorithm, "precision": precision},
+                    {"ranks": np.asarray(ranks, dtype=np.float32)})
+        if algorithm == "bfs":
+            ctx = analytics_mesh() or get_mesh_context(1)
+            with S.backend_extent("mesh"):
+                levels, iters = analytics.bfs_mesh(
+                    g, ctx, int(header.get("source", 0)),
+                    max_iterations=max_iterations, precision=precision,
+                    checkpoint_every=self.checkpoint_every)
+            return ({"ok": True, "iters": int(iters),
+                     "algorithm": algorithm, "precision": precision},
+                    {"levels": np.asarray(levels, dtype=np.int32)})
+        return ({"ok": False,
+                 "error": f"unknown semiring algorithm {algorithm!r}"},
+                None)
 
 
 # --------------------------------------------------------------------------
@@ -676,6 +732,31 @@ class KernelClient:
         if not h.get("ok"):
             _raise_for_reply(h)
         return out["ranks"], h["err"], h["iters"]
+
+    def semiring(self, algorithm: str = "pagerank", src=None, dst=None,
+                 weights=None, n_nodes=None, graph_key=None,
+                 precision: str = "f32", deadline_s=None, **params):
+        """Run a semiring-core-routed algorithm on the resident daemon.
+        Returns the reply header + arrays dict (algorithm-shaped:
+        pagerank -> ranks/err/iters, bfs -> levels/iters)."""
+        arrays = {}
+        if src is not None:
+            arrays["src"] = np.asarray(src, dtype=np.int64)
+            arrays["dst"] = np.asarray(dst, dtype=np.int64)
+            if weights is not None:
+                arrays["weights"] = np.asarray(weights, dtype=np.float32)
+        header = {"op": "semiring", "algorithm": algorithm,
+                  "graph_key": graph_key, "n_nodes": n_nodes,
+                  "precision": precision, **params}
+        if deadline_s is not None:
+            header["deadline_s"] = deadline_s
+        carrier = mgtrace.inject()
+        if carrier is not None:
+            header["trace"] = carrier
+        h, out = self.call(header, arrays)
+        if not h.get("ok"):
+            _raise_for_reply(h)
+        return h, out
 
     def shutdown(self) -> None:
         try:

@@ -201,6 +201,67 @@ def test_sssp_mesh_matches_single(graph, ctx8):
                                atol=1e-4)
 
 
+def test_bfs_mesh_matches_single_uneven(graph, ctx8):
+    """BFS over the GENERIC semiring mesh kernel (r10): level-exact vs
+    the single-chip core path on the uneven-shard graph."""
+    from memgraph_tpu.ops.traversal import bfs_levels
+    single, _ = bfs_levels(graph, 0)
+    sharded, _ = analytics.bfs_mesh(graph, ctx8, 0)
+    assert np.array_equal(np.asarray(single), np.asarray(sharded))
+
+
+def test_bfs_mesh_of_1_same_code_path(graph, ctx1):
+    from memgraph_tpu.ops.traversal import bfs_levels
+    single, _ = bfs_levels(graph, 0)
+    sharded, _ = analytics.bfs_mesh(graph, ctx1, 0)
+    assert np.array_equal(np.asarray(single), np.asarray(sharded))
+
+
+# --------------------------------------------------------------------------
+# r10 mixed precision on the mesh (8-device uneven + mesh-of-1)
+# --------------------------------------------------------------------------
+
+def test_pagerank_mesh_bf16_within_bounds(graph, ctx8, ctx1):
+    from memgraph_tpu.ops.semiring import PRECISION_BOUNDS
+    f32, _, _ = analytics.pagerank_mesh(graph, ctx8, tol=1e-10,
+                                        max_iterations=200)
+    for ctx in (ctx8, ctx1):
+        b16, _, _ = analytics.pagerank_mesh(graph, ctx, tol=1e-10,
+                                            max_iterations=200,
+                                            precision="bf16")
+        diff = np.abs(np.asarray(b16) - np.asarray(f32))
+        assert float(diff.max()) <= PRECISION_BOUNDS["bf16"]["pagerank_linf"]
+        assert float(diff.sum()) <= PRECISION_BOUNDS["bf16"]["pagerank_l1"]
+
+
+def test_pagerank_mesh_f32_bit_exact_across_precision_cache(graph, ctx8):
+    """Requesting bf16 must not poison the f32 kernel cache: f32 stays
+    bit-identical before and after a bf16 run on the same context."""
+    a, _, _ = analytics.pagerank_mesh(graph, ctx8, tol=1e-10,
+                                      max_iterations=50)
+    analytics.pagerank_mesh(graph, ctx8, tol=1e-10, max_iterations=50,
+                            precision="bf16")
+    b, _, _ = analytics.pagerank_mesh(graph, ctx8, tol=1e-10,
+                                      max_iterations=50)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_katz_mesh_bf16_close(graph, ctx8):
+    f32, _, _ = analytics.katz_mesh(graph, ctx8, alpha=0.05,
+                                    max_iterations=100, tol=1e-8)
+    b16, _, _ = analytics.katz_mesh(graph, ctx8, alpha=0.05,
+                                    max_iterations=100, tol=1e-8,
+                                    precision="bf16")
+    np.testing.assert_allclose(np.asarray(b16), np.asarray(f32),
+                               atol=5e-2, rtol=2e-2)
+
+
+def test_mesh_rejects_int8(graph, ctx1):
+    with pytest.raises(ValueError):
+        analytics.pagerank_mesh(graph, ctx1, max_iterations=5,
+                                precision="int8")
+
+
 # --------------------------------------------------------------------------
 # the one-collective-per-iteration invariant (compiled-HLO assertion)
 # --------------------------------------------------------------------------

@@ -351,22 +351,36 @@ def _check_fault_points(project: Project):
 
 
 # --------------------------------------------------------------------------
-# SpMV-algorithm mesh coverage (ops/__init__.py SPMV_ALGORITHMS)
+# SpMV-algorithm semiring-core + mesh coverage (ops/__init__.py
+# SPMV_ALGORITHMS)
 # --------------------------------------------------------------------------
 #
-# The multi-chip mesh path (parallel/analytics.py) is only a win if every
+# The semiring kernel core (ops/semiring.py, r10) is only a win if every
 # SpMV-shaped algorithm actually rides it. The contract:
 #   * ops/__init__.py keeps a SPMV_ALGORITHMS registry; each entry names
-#     its single-chip "entry" target and EXACTLY ONE of a "sharded"
-#     target or a justified "exempt" string;
+#     its single-chip "entry" target, EXACTLY ONE of a "sharded" target
+#     or a justified "exempt" string, and (when ops/semiring.py is in
+#     the scanned tree) a "core" declaration — a SEMIRINGS key naming
+#     the (⊕, ⊗) pair its inner loop iterates, or "blocks" for custom
+#     rounds composed from the core's building blocks;
 #   * every "module:function" target must statically resolve to a
 #     function defined in a scanned file (a typo'd target would only
 #     surface when a user requests a mesh);
 #   * every ops/ module whose AST shows the SpMV shape (a segment_*
-#     reduction AND a while_loop) must be covered by some entry, so a
-#     new algorithm cannot silently miss the mesh path.
+#     reduction AND a while_loop) OR that imports the semiring core
+#     must be covered by some entry, so a new algorithm cannot silently
+#     miss the mesh path; and
+#   * NO ops/ module outside the core engine (semiring / spmv_* /
+#     benes*) may contain a function that hand-rolls a direct
+#     ``jax.ops.segment_*`` reduction inside a ``while_loop`` pipeline
+#     ("spmv-handrolled") — residual hand-rolled kernels bypass the
+#     core's backends, precision variants and stage attribution.
 
 _SPMV_MIN_JUSTIFICATION = 40   # chars; "TODO" is not a justification
+
+#: modules that ARE the shared engine (the registry's targets ride
+#: them); they may use segment primitives directly
+_SPMV_CORE_PREFIXES = ("semiring", "spmv_", "benes")
 
 
 def _registry_dict(sf, name: str):
@@ -412,6 +426,58 @@ def _has_spmv_shape(sf) -> bool:
     return False
 
 
+def _imports_semiring_core(sf) -> bool:
+    """Does this module import ops/semiring.py (ride the core)?"""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[-1] == "semiring":
+                return True
+            if any(a.name == "semiring" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.split(".")[-1] == "semiring"
+                   for a in node.names):
+                return True
+    return False
+
+
+def _handrolled_functions(sf):
+    """Top-level functions containing BOTH a direct segment_* call and a
+    while_loop call — a residual hand-rolled SpMV pipeline."""
+    out = []
+    for fn in sf.tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_segment = has_loop = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = (dotted(node.func) or "").split(".")[-1]
+                if name.startswith("segment_"):
+                    has_segment = True
+                elif name == "while_loop":
+                    has_loop = True
+        if has_segment and has_loop:
+            out.append((fn.name, fn.lineno))
+    return out
+
+
+def _semiring_names(project: Project):
+    """Literal keys of ops/semiring.py's SEMIRINGS table (None when the
+    core module is not in the scanned tree — fixture projects)."""
+    sr_mod = project.by_suffix("ops/semiring.py")
+    if sr_mod is None:
+        return None
+    table, _line = _registry_dict(sr_mod, "SEMIRINGS")
+    if table is None:
+        return None
+    names = set()
+    for key_node in table.keys:
+        key = _literal_or_none(key_node)
+        if isinstance(key, str):
+            names.add(key)
+    return names
+
+
 def _check_spmv_registry(project: Project):
     ops_init = project.by_suffix("ops/__init__.py")
     if ops_init is None:
@@ -427,6 +493,7 @@ def _check_spmv_registry(project: Project):
             fingerprint="spmv-registry-missing"))
         return findings
 
+    semiring_names = _semiring_names(project)
     covered_modules: set[str] = set()
     for key_node, val_node in zip(reg.keys, reg.values):
         algo = _literal_or_none(key_node)
@@ -443,6 +510,25 @@ def _check_spmv_registry(project: Project):
         line = getattr(key_node, "lineno", reg_line)
         sharded = entry.get("sharded")
         exempt = entry.get("exempt")
+        if semiring_names is not None:
+            core = entry.get("core")
+            if not isinstance(core, str) or not core:
+                findings.append(Finding(
+                    rule="MG005", path=ops_init.rel_path, line=line,
+                    col=0, symbol=algo,
+                    message=f"SPMV_ALGORITHMS[{algo!r}] must declare "
+                            "'core': the SEMIRINGS key its inner loop "
+                            "iterates, or 'blocks' for custom rounds "
+                            "over the core's building blocks",
+                    fingerprint=f"spmv-no-core:{algo}"))
+            elif core != "blocks" and core not in semiring_names:
+                findings.append(Finding(
+                    rule="MG005", path=ops_init.rel_path, line=line,
+                    col=0, symbol=algo,
+                    message=f"SPMV_ALGORITHMS[{algo!r}].core = "
+                            f"{core!r} names no ops/semiring.py "
+                            "SEMIRINGS entry (and is not 'blocks')",
+                    fingerprint=f"spmv-unknown-core:{algo}:{core}"))
         if (sharded is None) == (exempt is None):
             findings.append(Finding(
                 rule="MG005", path=ops_init.rel_path, line=line, col=0,
@@ -478,23 +564,40 @@ def _check_spmv_registry(project: Project):
                 covered_modules.add(target.split(":", 1)[0]
                                     .rsplit(".", 1)[-1])
 
-    # sweep: every SpMV-shaped ops/ module must be covered by an entry
+    # sweep: every SpMV-shaped or core-riding ops/ module must be
+    # covered by an entry, and no non-core module may hand-roll a
+    # segment_* + while_loop pipeline
     for rel, sf in sorted(project.files.items()):
         if "/ops/" not in rel or rel.endswith("__init__.py"):
             continue
         mod = rel.rsplit("/", 1)[-1][:-3]
-        # the kernel cores themselves (spmv_mxu*, benes*) are the shared
-        # engine the registry's targets ride, not algorithms to register
-        if mod.startswith(("spmv_", "benes")):
+        # the kernel cores themselves (semiring, spmv_mxu*, benes*) are
+        # the shared engine the registry's targets ride, not algorithms
+        # to register
+        if mod.startswith(_SPMV_CORE_PREFIXES):
             continue
-        if _has_spmv_shape(sf) and mod not in covered_modules:
+        spmv_shaped = _has_spmv_shape(sf)
+        rides_core = _imports_semiring_core(sf)
+        if (spmv_shaped or rides_core) and mod not in covered_modules:
             findings.append(Finding(
                 rule="MG005", path=rel, line=1, col=0, symbol=mod,
                 message=f"ops/{mod}.py has an SpMV-shaped kernel "
-                        "(segment reduction inside while_loop) but no "
-                        "SPMV_ALGORITHMS entry references it — it "
-                        "silently misses the mesh path",
+                        "(segment reduction inside while_loop, or a "
+                        "semiring-core import) but no SPMV_ALGORITHMS "
+                        "entry references it — it silently misses the "
+                        "mesh path",
                 fingerprint=f"spmv-uncovered:{mod}"))
+        for fn_name, fn_line in _handrolled_functions(sf):
+            findings.append(Finding(
+                rule="MG005", path=rel, line=fn_line, col=0,
+                symbol=fn_name,
+                message=f"ops/{mod}.py:{fn_name} hand-rolls a "
+                        "segment_* reduction inside a while_loop — "
+                        "route it through ops/semiring.py (spmv / "
+                        "edge_reduce / fixpoint) so it inherits the "
+                        "MXU + mesh backends, precision variants and "
+                        "stage attribution",
+                fingerprint=f"spmv-handrolled:{mod}:{fn_name}"))
     return findings
 
 

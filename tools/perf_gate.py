@@ -123,7 +123,60 @@ def check(record: dict, baseline: dict) -> int:
         return 1
     log(f"PASS: {metric} = {value:,.0f} vs envelope {ref:,.0f} "
         f"(margin {(value / ref - 1) * 100:+.1f}%, floor {floor:,.0f})")
-    return 0
+    return check_semiring(record, envelopes, ref)
+
+
+def check_semiring(record: dict, envelopes: dict, headline_ref: float) -> int:
+    """r10 semiring-core ratio envelopes over the record's
+    extra.semiring sweep.  Runs only for records whose main metric
+    already passed (i.e. non-degraded, on-device): the sweep must be
+    present, honestly tagged, and inside the f32-parity / bf16-speedup
+    envelopes."""
+    f32p = envelopes.get("semiring_pagerank_f32_parity")
+    spd = envelopes.get("semiring_bf16_speedup")
+    if not f32p and not spd:
+        return 0
+    sem = (record.get("extra") or {}).get("semiring")
+    if sem is None:
+        log("FAIL: BASELINE.json declares semiring envelopes but the "
+            "record carries no extra.semiring sweep — regenerate with "
+            "the current bench.py")
+        return 1
+    if sem.get("backend") == "cpu" and not sem.get("degraded"):
+        log("FAIL: semiring sweep ran on cpu but is not tagged "
+            "degraded — an untagged CPU fallback cannot stand in for "
+            "the on-device core measurement")
+        return 1
+    if sem.get("degraded"):
+        log("FAIL: the main metric is on-device but the semiring sweep "
+            f"is degraded (backend={sem.get('backend', '?')}) — the "
+            "core sweep must ride the same accelerator")
+        return 1
+    rc = 0
+    if f32p:
+        frac = float(f32p["min_fraction_of_headline"])
+        f32_eps = float(sem.get("f32_eps", 0.0))
+        floor = frac * headline_ref
+        if f32_eps < floor:
+            log(f"FAIL: semiring f32 pagerank = {f32_eps:,.0f} e/s is "
+                f"below the parity floor {floor:,.0f} "
+                f"({frac:.0%} of the headline envelope)")
+            rc = 1
+        else:
+            log(f"PASS: semiring f32 parity {f32_eps:,.0f} e/s "
+                f"(floor {floor:,.0f})")
+    if spd:
+        need = float(spd["min"])
+        got = float(sem.get("bf16_speedup", 0.0))
+        if got < need:
+            log(f"FAIL: semiring bf16 speedup {got:.3f}x < required "
+                f"{need:.2f}x — the reduced-precision path stopped "
+                "paying for its rounding")
+            rc = 1
+        else:
+            log(f"PASS: semiring bf16 speedup {got:.3f}x "
+                f"(>= {need:.2f}x)")
+    return rc
 
 
 def main(argv=None) -> int:

@@ -96,6 +96,12 @@ def main() -> None:
               if r[0].startswith(">> device: ")}
     if not {"device_transfer", "device_compile"} <= stages:
         fail(f"PROFILE device attribution missing stages: {stages}")
+    # r10 semiring core: the dispatch attributes time PER BACKEND, so a
+    # PROFILE of a core-routed query says which backend served it
+    # (mesh here — the mesh-of-1 CALL above)
+    if "semiring_mesh" not in stages:
+        fail(f"PROFILE missing per-backend semiring attribution "
+             f"(want semiring_mesh): {stages}")
 
     # 3. fingerprint statistics with trace links
     cols, srows, _ = interp.execute("SHOW QUERY STATS")
