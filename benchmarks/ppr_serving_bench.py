@@ -1,0 +1,264 @@
+"""PPR serving-plane load generator: concurrent clients vs the
+coalescing kernel server.
+
+Measures the tentpole claim of the serving plane (ISSUE 11 / ROADMAP
+item 1): with >= 32 concurrent clients, request coalescing turns N
+per-user PPR point queries into one (n, B) SpMM fixpoint per window, so
+sustained QPS beats the sequential one-request-at-a-time baseline on
+the SAME host by the batch amortization factor. Records (honest
+``degraded``/``backend`` tags, same contract as bench.py):
+
+  * sequential baseline QPS + p50/p99 (one client, one in-flight
+    request, cold sources — the pre-serving-plane cost model);
+  * concurrent QPS + p50/p99 with the measured COALESCING RATIO
+    (requests per executed batch, from the server's ppr.* counters);
+  * cache hit rate on a repeated working set (the per-user steady
+    state);
+  * batched-vs-sequential f32 BIT-EXACTNESS spot check.
+
+Writes BENCH_ppr_r*.json (never BENCH_r*.json — the headline pagerank
+record keeps its own series) and prints the record as one JSON line;
+tools/perf_gate.py checks it against BASELINE.json's ``ppr_qps``
+envelope on accelerator hosts.
+
+Usage:
+    python benchmarks/ppr_serving_bench.py [--clients 32] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_OUT = os.path.join(REPO, "BENCH_ppr_r11.json")
+
+# serving-shaped graph: large enough that a request is real device
+# work, small enough that the sequential baseline finishes in seconds
+N_NODES = 20_000
+N_EDGES = 120_000
+SEQ_REQUESTS = 80
+CONC_REQUESTS_PER_CLIENT = 25
+CACHE_POOL = 64
+TOL = 1e-6
+
+
+def _quantiles(lat_s):
+    lat = np.sort(np.asarray(lat_s))
+    if lat.size == 0:
+        return 0.0, 0.0
+    return (float(lat[int(0.50 * (lat.size - 1))] * 1e3),
+            float(lat[int(0.99 * (lat.size - 1))] * 1e3))
+
+
+def _metric(name):
+    from memgraph_tpu.observability.metrics import global_metrics
+    return dict((n, v) for n, _k, v in global_metrics.snapshot()).get(
+        name, 0.0)
+
+
+def _connect(kernel_client_cls, sock, timeout=600, attempts=50):
+    """Connect with retry: a burst of simultaneous connects can briefly
+    outrun even a deep accept queue."""
+    for _ in range(attempts):
+        try:
+            return kernel_client_cls(sock, timeout=timeout)
+        except OSError:
+            time.sleep(0.05)
+    return kernel_client_cls(sock, timeout=timeout)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--nodes", type=int, default=N_NODES)
+    ap.add_argument("--edges", type=int, default=N_EDGES)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from memgraph_tpu.ops import csr
+    from memgraph_tpu.ops.pagerank import personalized_pagerank
+    from memgraph_tpu.server.kernel_server import KernelClient, KernelServer
+
+    backend = jax.default_backend()
+    degraded = backend == "cpu"
+
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, args.nodes, args.edges)
+    dst = rng.integers(0, args.nodes, args.edges)
+
+    sock = os.path.join(tempfile.mkdtemp(prefix="pprbench"), "ks.sock")
+    srv = KernelServer(sock, wedge_after_s=120)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    deadline = time.monotonic() + 120
+    seed_client = None
+    while time.monotonic() < deadline:
+        try:
+            seed_client = KernelClient(sock, timeout=300)
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert seed_client is not None, "kernel server never bound"
+
+    # stage the graph + compile the batch kernels once (honest steady
+    # state: serving traffic never pays the first-compile)
+    print(f"ppr-bench: staging graph ({args.nodes} nodes, "
+          f"{args.edges} edges) on backend={backend} ...", flush=True)
+    seed_client.ppr([0], src=src, dst=dst, n_nodes=args.nodes,
+                    graph_key="bench", graph_version=1, tol=TOL)
+    warm_sources = [[int(s)] for s in
+                    rng.choice(args.nodes, size=srv._ppr.max_batch,
+                               replace=False)]
+    warm_threads = []
+    for s in warm_sources:     # compile the wide-batch buckets up front
+        def _w(ss=s):
+            c = _connect(KernelClient, sock)
+            c.ppr(ss, graph_key="bench", graph_version=1,
+                  n_nodes=args.nodes, tol=TOL)
+            c.close()
+        t = threading.Thread(target=_w)
+        t.start()
+        warm_threads.append(t)
+    for t in warm_threads:
+        t.join()
+
+    # --- sequential baseline: one client, one in-flight request -----------
+    print("ppr-bench: sequential baseline ...", flush=True)
+    seq_lat = []
+    seq_sources = rng.choice(args.nodes, size=SEQ_REQUESTS, replace=False)
+    t0 = time.perf_counter()
+    for s in seq_sources:
+        t1 = time.perf_counter()
+        seed_client.ppr([int(s) + 0], graph_key="bench", graph_version=1,
+                        n_nodes=args.nodes, tol=TOL, top_k=10)
+        seq_lat.append(time.perf_counter() - t1)
+    seq_wall = time.perf_counter() - t0
+    seq_qps = SEQ_REQUESTS / seq_wall
+    seq_p50, seq_p99 = _quantiles(seq_lat)
+
+    # --- concurrent phase: the coalescing claim ----------------------------
+    print(f"ppr-bench: {args.clients} concurrent clients ...", flush=True)
+    req_before = _metric("ppr.requests_total")
+    batch_before = _metric("ppr.batches_total")
+    conc_lat = []
+    lat_lock = threading.Lock()
+    total = args.clients * CONC_REQUESTS_PER_CLIENT
+    conc_sources = rng.integers(0, args.nodes, size=(args.clients,
+                                                     CONC_REQUESTS_PER_CLIENT,
+                                                     2))
+    barrier = threading.Barrier(args.clients + 1)
+    check_pool: list = []
+
+    def client_loop(ci):
+        c = _connect(KernelClient, sock)
+        mine = []
+        try:
+            barrier.wait(timeout=120)
+            for ri in range(CONC_REQUESTS_PER_CLIENT):
+                sources = sorted(int(s) for s in set(conc_sources[ci, ri]))
+                t1 = time.perf_counter()
+                _h, out = c.ppr(sources, graph_key="bench",
+                                graph_version=1, n_nodes=args.nodes,
+                                tol=TOL)
+                mine.append(time.perf_counter() - t1)
+                if ci == 0 and ri < 3:
+                    check_pool.append((sources, out["ranks"]))
+        finally:
+            with lat_lock:
+                conc_lat.extend(mine)
+            c.close()
+
+    threads = [threading.Thread(target=client_loop, args=(ci,))
+               for ci in range(args.clients)]
+    for t in threads:
+        t.start()
+    barrier.wait(timeout=120)
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    conc_wall = time.perf_counter() - t0
+    conc_qps = len(conc_lat) / conc_wall
+    conc_p50, conc_p99 = _quantiles(conc_lat)
+    req_delta = _metric("ppr.requests_total") - req_before
+    batch_delta = max(_metric("ppr.batches_total") - batch_before, 1.0)
+    coalescing_ratio = req_delta / batch_delta
+
+    # --- cache phase: repeated working set ---------------------------------
+    print("ppr-bench: cache working set ...", flush=True)
+    hit_before = _metric("ppr.cache_hit_total")
+    pool = [[int(s)] for s in rng.choice(args.nodes, size=CACHE_POOL,
+                                         replace=False)]
+    cache_lat = []
+    for _round in range(2):
+        for sources in pool:
+            t1 = time.perf_counter()
+            seed_client.ppr(sources, graph_key="bench", graph_version=1,
+                            n_nodes=args.nodes, tol=TOL, top_k=10)
+            cache_lat.append(time.perf_counter() - t1)
+    hits = _metric("ppr.cache_hit_total") - hit_before
+    cache_hit_rate = hits / (2 * CACHE_POOL)
+    cache_p50, cache_p99 = _quantiles(cache_lat)
+
+    # --- bit-exactness spot check ------------------------------------------
+    g = csr.from_coo(src, dst, n_nodes=args.nodes).to_device()
+    bit_exact = True
+    for sources, ranks in check_pool:
+        want, _, _ = personalized_pagerank(g, sources, tol=TOL)
+        if not np.array_equal(np.asarray(want),
+                              np.asarray(ranks)[:args.nodes]):
+            bit_exact = False
+    seed_client.shutdown()
+    seed_client.close()
+
+    record = {
+        "metric": "ppr_qps",
+        "value": round(conc_qps, 2),
+        "unit": "requests/sec sustained",
+        "degraded": degraded,
+        "backend": backend,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "extra": {
+            "graph": {"nodes": args.nodes, "edges": args.edges},
+            "clients": args.clients,
+            "requests": {"sequential": SEQ_REQUESTS,
+                         "concurrent": int(len(conc_lat)),
+                         "cache": 2 * CACHE_POOL},
+            "sequential": {"qps": round(seq_qps, 2),
+                           "p50_ms": round(seq_p50, 3),
+                           "p99_ms": round(seq_p99, 3)},
+            "concurrent": {"qps": round(conc_qps, 2),
+                           "p50_ms": round(conc_p50, 3),
+                           "p99_ms": round(conc_p99, 3)},
+            "cache": {"hit_rate": round(cache_hit_rate, 4),
+                      "p50_ms": round(cache_p50, 3),
+                      "p99_ms": round(cache_p99, 3)},
+            "speedup_vs_sequential": round(conc_qps / max(seq_qps, 1e-9),
+                                           3),
+            "coalescing_ratio": round(coalescing_ratio, 3),
+            "batch_window_ms": srv._ppr.window_s * 1e3,
+            "max_batch": srv._ppr.max_batch,
+            "f32_bit_exact_vs_sequential": bit_exact,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print(json.dumps(record))
+    assert total == len(conc_lat), "lost requests under load"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

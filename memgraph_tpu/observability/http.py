@@ -72,7 +72,13 @@ async def start_monitoring_server(host: str, port: int, ictx):
                 body = json.dumps({
                     "enabled": mgstats.global_query_stats.enabled(),
                     "capacity": mgstats.global_query_stats.capacity,
-                    "fingerprints": mgstats.global_query_stats.snapshot()},
+                    "fingerprints": mgstats.global_query_stats.snapshot(),
+                    # PPR serving plane: coalescing/cache counters
+                    # (local, plus the daemon's mirrored gauges)
+                    "ppr": {name: value for name, _k, value
+                            in global_metrics.snapshot()
+                            if name.startswith(
+                                ("ppr.", "kernel_server.daemon.ppr."))}},
                     default=str)
                 ctype = "application/json"
             elif path.startswith("/health"):

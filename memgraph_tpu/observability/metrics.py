@@ -87,6 +87,18 @@ STAT_NAMES = (
     "kernel_server.supervisor.wedge_detected_total",
     "kernel_server.supervisor.restarts_total",
     "kernel_server.client.retries_total",
+    # PPR serving plane (r16): coalesced batched multi-source PPR
+    "ppr.requests_total",
+    "ppr.batches_total",
+    "ppr.batch_size",              # histogram of executed batch widths
+    "ppr.coalesced_total",         # requests that shared a batch
+    "ppr.cache_hit_total",
+    "ppr.cache_miss_total",
+    "ppr.cache_invalidate_total",
+    "ppr.warm_start_total",
+    "ppr.shed_total",
+    "ppr.queue_depth",             # coalescing queue backlog gauge
+    "ppr.window_occupancy",        # last batch width / max width gauge
     # analytics / checkpoint plane
     "analytics.checkpoint.saved_total",
     "analytics.checkpoint.restored_total",

@@ -64,6 +64,7 @@ ENV_DISABLE = "MEMGRAPH_TPU_STATS"          # "0" disables collection
 ENV_TOPK = "MEMGRAPH_TPU_STATS_TOPK"        # top-K capacity (default 128)
 ENV_MAX_LAG = "MEMGRAPH_TPU_HEALTH_MAX_REPL_LAG"        # txns (default 1000)
 ENV_MAX_BACKLOG = "MEMGRAPH_TPU_HEALTH_MAX_FSYNC_BACKLOG"  # bytes (64 MiB)
+ENV_MAX_PPR_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_PPR_QUEUE"  # pending (192)
 
 #: every device stage the accumulator may carry — the attribution
 #: vocabulary PROFILE and BENCH records share
@@ -380,6 +381,10 @@ class SaturationPlane:
         shared_field(self, "_last_counters")
         self.max_replica_lag = float(_env_int(ENV_MAX_LAG, 1000))
         self.max_fsync_backlog = float(_env_int(ENV_MAX_BACKLOG, 64 << 20))
+        # trip BEFORE the serving plane's own hard shed threshold
+        # (MEMGRAPH_TPU_PPR_MAX_QUEUE, default 256): load balancers see
+        # the 503 while the queue is still servable
+        self.max_ppr_queue = float(_env_int(ENV_MAX_PPR_QUEUE, 192))
 
     def evaluate(self, ictx=None) -> dict:
         """One readiness verdict from the current metrics snapshot.
@@ -442,6 +447,30 @@ class SaturationPlane:
                  shed_now - shed_prev, 0)
         else:
             ok("kernel_server_admission")
+
+        # PPR serving plane: coalescing queue depth (local gauge, or the
+        # daemon's own mirrored through the supervisor's health loop)
+        depth = max(float(snap.get("ppr.queue_depth") or 0.0),
+                    float(snap.get(
+                        "kernel_server.daemon.ppr.queue_depth") or 0.0))
+        if depth > self.max_ppr_queue:
+            trip("ppr_queue", "PPR coalescing queue depth over budget",
+                 depth, self.max_ppr_queue)
+        else:
+            ok("ppr_queue")
+
+        # PPR batch-window occupancy: every window is leaving FULL and
+        # requests still queue behind — the batcher is the bottleneck
+        occ = max(float(snap.get("ppr.window_occupancy") or 0.0),
+                  float(snap.get(
+                      "kernel_server.daemon.ppr.window_occupancy")
+                      or 0.0))
+        if occ >= 1.0 and depth > 0:
+            trip("ppr_window",
+                 "PPR batch windows saturated with queue backlog",
+                 occ, 1.0)
+        else:
+            ok("ppr_window")
 
         # replication lag (one gauge per replica)
         worst = None

@@ -18,6 +18,7 @@ int8-streaming variants (semiring.PRECISION_BOUNDS documents the bounds).
 from __future__ import annotations
 
 import threading
+from functools import partial
 
 import jax.numpy as jnp
 import numpy as np
@@ -258,11 +259,26 @@ def _ppr_epilogue(rank, acc, env, P):
 
 def personalized_pagerank(graph: DeviceGraph, source_nodes,
                           damping: float = 0.85, max_iterations: int = 100,
-                          tol: float = 1e-6, precision: str = "f32"):
+                          tol: float = 1e-6, precision: str = "f32",
+                          kernel=None, kernel_meta: dict | None = None):
     """PPR with restart mass on `source_nodes` (dense indices).
 
     Analog of mage/cpp/cugraph_module/algorithms/personalized_pagerank.cu.
+
+    ``kernel`` routes the request through the resident kernel server's
+    coalescing PPR plane (a socket path, ``True``/"1" for the default
+    socket, or a client object with a ``ppr`` method): concurrent
+    requests batch into one multi-source SpMM fixpoint and hit the
+    server's change-log-invalidated result cache. ``kernel_meta``
+    forwards serving metadata (graph_key / graph_version / delta — see
+    server/kernel_server.py). A kernel-plane failure falls back to the
+    in-process path LOUDLY.
     """
+    if kernel is not None:
+        got = _ppr_via_kernel(graph, source_nodes, damping, max_iterations,
+                              tol, precision, kernel, kernel_meta)
+        if got is not None:
+            return got
     p = jnp.zeros(graph.n_pad, dtype=jnp.float32)
     p = p.at[jnp.asarray(source_nodes, dtype=jnp.int32)].set(1.0)
     rank, err, iters = S.fixpoint(
@@ -277,3 +293,217 @@ def personalized_pagerank(graph: DeviceGraph, source_nodes,
         n_out=graph.n_pad, setup=_ppr_setup, epilogue=_ppr_epilogue,
         max_iterations=max_iterations, sorted=True, precision=precision)
     return rank[:graph.n_nodes], float(err), int(iters)
+
+
+def _ppr_via_kernel(graph, source_nodes, damping, max_iterations, tol,
+                    precision, kernel, kernel_meta):
+    """Route one PPR through the resident server's coalescing plane.
+    Returns (ranks, err, iters) or None (caller runs in-process)."""
+    import logging
+    from ..observability.metrics import global_metrics
+    from ..server import kernel_server as ks
+    meta = dict(kernel_meta or {})
+    try:
+        if hasattr(kernel, "ppr"):
+            client = kernel
+        else:
+            sock = ks.DEFAULT_SOCKET if kernel in (True, "1", "default") \
+                else str(kernel)
+            client = ks.shared_client(sock)
+        send_graph = meta.pop("send_graph", True)
+        meta.pop("top_k", None)    # this entry point returns full ranks
+        kwargs = {}
+        if send_graph:
+            src, dst, w = graph.host_coo if graph.host_coo is not None \
+                else (np.asarray(graph.src_idx)[:graph.n_edges],
+                      np.asarray(graph.col_idx)[:graph.n_edges],
+                      np.asarray(graph.weights)[:graph.n_edges])
+            kwargs.update(src=np.asarray(src, dtype=np.int64),
+                          dst=np.asarray(dst, dtype=np.int64),
+                          weights=np.asarray(w, dtype=np.float32))
+        meta.setdefault("graph_key",
+                        f"ppr:{id(graph)}:{graph.n_nodes}:{graph.n_edges}")
+        h, out = client.ppr(
+            sources=np.asarray(source_nodes, dtype=np.int32),
+            n_nodes=graph.n_nodes, damping=float(damping),
+            max_iterations=int(max_iterations), tol=float(tol),
+            precision=precision, **meta, **kwargs)
+        global_metrics.increment("analytics.kernel_routed_total")
+        return (np.asarray(out["ranks"])[:graph.n_nodes],
+                float(h.get("err", 0.0)), int(h.get("iters", 0)))
+    except (ks.KernelServerError, ConnectionError, OSError) as e:
+        global_metrics.increment("analytics.kernel_route_fallback_total")
+        logging.getLogger(__name__).warning(
+            "kernel-server PPR route failed (%s: %s); falling back to "
+            "the in-process path", type(e).__name__, e)
+        return None
+
+
+# --------------------------------------------------------------------------
+# batched multi-source PPR (the serving-plane SpMM fixpoint)
+# --------------------------------------------------------------------------
+#
+# N concurrent personalization vectors are ONE (n, B) SpMM per iteration
+# ("Accelerating Personalized PageRank Vector Computation", PAPERS.md):
+# the edge gather, ⊗-combine and segment-⊕ run once over B lanes, so the
+# dominant memory traffic (the edge stream) is amortized across every
+# rider of the batch — the coalescing win the PPR serving plane banks on.
+# Lanes are INDEPENDENT fixpoints: a converged column freezes (its value
+# is the exact iterate whose L1 step error first dipped under tol, same
+# as the sequential loop's stopping state), so batched f32 results are
+# BIT-EXACT vs sequential `personalized_pagerank` regardless of how
+# long slower batchmates keep iterating (tests/test_ppr_serving.py).
+
+_PPR_BATCH_CACHE: dict = {}
+_ppr_batch_cache_lock = threading.Lock()
+
+#: batch lanes are padded up to these bucket widths so a serving
+#: workload with jittery batch sizes reuses a handful of compiled
+#: programs instead of one per size
+_PPR_LANE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _bucket_lanes(b: int) -> int:
+    for cap in _PPR_LANE_BUCKETS:
+        if b <= cap:
+            return cap
+    return b
+
+
+def _build_ppr_batch(n_out: int, max_iterations: int, precision: str,
+                     warm: bool):
+    import jax
+
+    def run(A, P):
+        # batched analog of _ppr_setup: identical hoisted invariants,
+        # personalization columns normalized per lane
+        n_nodes = P["n_nodes"]
+        valid = (jnp.arange(n_out, dtype=jnp.int32) < n_nodes)
+        valid_f = valid.astype(jnp.float32)
+        pm = A["personalization"] * valid_f[:, None]
+        pm = pm / jnp.maximum(jnp.sum(pm, axis=0), 1e-30)
+        wsum = S.edge_reduce("sum", A["csr_w"], A["csr_src"], n_out,
+                             sorted=True)
+        inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
+        dangling_f = (valid & (wsum <= 0)).astype(jnp.float32)
+        edge_mult = A["w"] * inv_wsum[A["src"]]
+        x_init = A["x0"] if warm else pm
+        tol = P["tol"]
+        n_lanes = pm.shape[1]
+
+        def body(carry):
+            x, done, err, iters, it = carry
+            acc = S.spmv("plus_times", x, A["src"], A["dst"], edge_mult,
+                         n_out=n_out, sorted=True, precision=precision)
+            dangling_mass = jnp.sum(x * dangling_f[:, None], axis=0)
+            new_x = (1.0 - P["damping"]) * pm \
+                + P["damping"] * (acc + dangling_mass[None, :] * pm)
+            new_err = jnp.sum(jnp.abs(new_x - x), axis=0)
+            # freeze converged lanes: their retained iterate is exactly
+            # the sequential loop's stopping state
+            x = jnp.where(done[None, :], x, new_x)
+            err = jnp.where(done, err, new_err)
+            iters = jnp.where(done, iters, iters + 1)
+            done = done | (err <= tol)
+            return x, done, err, iters, it + 1
+
+        def cond(carry):
+            _x, done, _err, _iters, it = carry
+            return (~jnp.all(done)) & (it < max_iterations)
+
+        carry0 = (x_init, jnp.zeros(n_lanes, dtype=jnp.bool_),
+                  jnp.full(n_lanes, jnp.inf, dtype=jnp.float32),
+                  jnp.zeros(n_lanes, dtype=jnp.int32), jnp.int32(0))
+        x, _done, err, iters, _it = jax.lax.while_loop(cond, body, carry0)
+        return x, err, iters
+
+    return jax.jit(run)
+
+
+def personalized_pagerank_batch(graph: DeviceGraph, source_sets,
+                                damping: float = 0.85,
+                                max_iterations: int = 100,
+                                tol: float = 1e-6, precision: str = "f32",
+                                x0=None, raw: bool = False):
+    """B independent PPR fixpoints as ONE SpMM power iteration.
+
+    ``source_sets`` is a list of dense-index lists (one per lane) or a
+    prebuilt (n_pad, B) personalization matrix. ``x0`` optionally seeds
+    lanes from cached vectors ((n_pad, B); the serving plane's
+    warm-start path — PPR is a contraction, so ANY seed converges to
+    the same fixpoint, just in fewer iterations).
+
+    Returns (ranks (B, n_nodes), err (B,), iters (B,)). Lane counts are
+    padded up to compile-amortizing buckets; padding lanes restart on
+    lane 0's sources and are dropped before returning. ``raw=True``
+    instead returns the DEVICE (n_pad, n_lanes) iterate (padding lanes
+    included) so the caller can run on-device epilogues (top-k
+    extraction) before paying the host transfer.
+    """
+    from ..utils.jax_cache import ensure_compile_cache
+    ensure_compile_cache()
+    if getattr(source_sets, "ndim", None) == 2:
+        pm = np.asarray(source_sets, dtype=np.float32)
+        n_req = pm.shape[1]
+    else:
+        n_req = len(source_sets)
+        pm = np.zeros((graph.n_pad, n_req), dtype=np.float32)
+        for lane, sources in enumerate(source_sets):
+            pm[np.asarray(sources, dtype=np.int32), lane] = 1.0
+    if n_req == 0:
+        return (np.zeros((0, graph.n_nodes), dtype=np.float32),
+                np.zeros(0, dtype=np.float32), np.zeros(0, dtype=np.int32))
+    n_lanes = _bucket_lanes(n_req)
+    if n_lanes > n_req:
+        pad = np.repeat(pm[:, :1], n_lanes - n_req, axis=1)
+        pm = np.concatenate([pm, pad], axis=1)
+    warm = x0 is not None
+    if warm:
+        x0 = np.asarray(x0, dtype=np.float32)
+        if x0.shape[1] < n_lanes:
+            pad = np.repeat(pm[:, -1:], n_lanes - x0.shape[1], axis=1)
+            x0 = np.concatenate([x0, pad], axis=1)
+    key = (int(graph.n_pad), int(max_iterations), precision, warm)
+    fn = _PPR_BATCH_CACHE.get(key)
+    if fn is None:
+        with _ppr_batch_cache_lock:
+            fn = _PPR_BATCH_CACHE.get(key)
+            if fn is None:
+                fn = _build_ppr_batch(graph.n_pad, int(max_iterations),
+                                      precision, warm)
+                _PPR_BATCH_CACHE[key] = fn
+    arrays = {"src": graph.csc_src, "dst": graph.csc_dst,
+              "w": graph.csc_weights,
+              "csr_src": graph.src_idx, "csr_w": graph.weights,
+              "personalization": jnp.asarray(pm)}
+    if warm:
+        arrays["x0"] = jnp.asarray(x0)
+    with S.backend_extent("segment", record_iterate=True):
+        x, err, iters = fn(arrays, {"n_nodes": np.int32(graph.n_nodes),
+                                    "damping": np.float32(damping),
+                                    "tol": np.float32(tol)})
+    if raw:
+        return x, np.asarray(err)[:n_req], np.asarray(iters)[:n_req]
+    ranks = np.asarray(x)[: graph.n_nodes, :n_req].T
+    return (ranks, np.asarray(err)[:n_req], np.asarray(iters)[:n_req])
+
+
+_PPR_TOPK_CACHE: dict = {}
+
+
+def ppr_topk(ranks_matrix, n_nodes: int, k: int):
+    """Per-lane top-k over a (B, n) rank matrix ON DEVICE — the serving
+    plane extracts each request's answer before the reply ships, so a
+    top-10 query never pays an O(n) result transfer per rider beyond
+    the batch's own cache fill.
+
+    Returns (values (B, k), indices (B, k)) as host arrays."""
+    import jax
+    m = jnp.asarray(ranks_matrix)[:, :n_nodes]
+    k = max(1, min(int(k), int(n_nodes)))
+    fn = _PPR_TOPK_CACHE.get(k)
+    if fn is None:
+        fn = _PPR_TOPK_CACHE[k] = jax.jit(
+            partial(jax.lax.top_k, k=k))
+    vals, idx = fn(m)
+    return np.asarray(vals), np.asarray(idx)

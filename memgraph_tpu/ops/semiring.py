@@ -149,13 +149,20 @@ def quantize_int8(x):
 
 
 def edge_combine(sr, xe, w=None):
-    """Per-edge ⊗: combine the gathered vector entries with edge values."""
+    """Per-edge ⊗: combine the gathered vector entries with edge values.
+
+    SpMM lanes: when ``xe`` carries feature columns (e, d) and ``w`` is
+    the per-edge (e,) vector, the edge values broadcast across every
+    lane — one weight per edge, applied to all d fixpoints at once (the
+    batched multi-source PPR formulation)."""
     import jax.numpy as jnp
     sr = resolve_semiring(sr)
     if sr.mul == "first":
         return xe
     if w is None:
         raise ValueError(f"⊗ = {sr.mul!r} needs edge values")
+    if getattr(xe, "ndim", 1) > 1 and getattr(w, "ndim", 1) == 1:
+        w = w[(...,) + (None,) * (xe.ndim - 1)]
     if sr.mul == "times":
         return xe * w
     if sr.mul == "plus":

@@ -27,10 +27,12 @@ from . import mgp
 
 log = logging.getLogger(__name__)
 
-#: per-socket supervised clients, shared across queries (the client owns
-#: a connection + supervision state; one per daemon is the contract)
-_KERNEL_CLIENTS: dict = {}
-_KERNEL_CLIENTS_LOCK = threading.Lock()
+#: per-(socket, graph_key) serving-plane sync state: the last
+#: (topology_version, node_gids) this process pushed to the daemon, so
+#: the next PPR request ships the change-log DELTA covering the gap and
+#: the server invalidates only the cached sources it touches
+_PPR_PUSHED: dict = {}
+_PPR_PUSHED_LOCK = threading.Lock()
 
 
 def _rank_results(ctx, graph, values, field_name):
@@ -59,13 +61,8 @@ def _kernel_route_socket(ctx) -> str | None:
 
 
 def _kernel_client(sock: str, spawn: bool):
-    from ..server.kernel_server import SupervisedKernelClient
-    with _KERNEL_CLIENTS_LOCK:
-        client = _KERNEL_CLIENTS.get(sock)
-        if client is None:
-            client = _KERNEL_CLIENTS[sock] = SupervisedKernelClient(
-                sock, spawn=spawn)
-        return client
+    from ..server.kernel_server import shared_client
+    return shared_client(sock, spawn=spawn)
 
 
 def _graph_coo(graph):
@@ -113,6 +110,83 @@ def _kernel_server_pagerank(ctx, graph, damping, max_iterations, tol):
         return None
 
 
+def _ppr_serving_meta(ctx, graph, sock: str):
+    """The serving-plane sync envelope for this (socket, storage) pair:
+    a stable graph_key, the reader's topology version, and — when this
+    process already pushed an earlier version — the change-log DELTA
+    (dense indices) covering the gap, so the server's result cache
+    invalidates only sources whose neighborhoods moved. Also decides
+    whether the edge arrays must ride along (server behind, or never
+    fed)."""
+    storage = ctx.storage
+    graph_key = f"ppr:{hex(id(storage))}"
+    version = getattr(ctx.accessor, "topology_snapshot",
+                      storage.topology_version)
+    base_version = None
+    changed_idx = None
+    ids_stable = True
+    send_graph = True
+    with _PPR_PUSHED_LOCK:
+        prev = _PPR_PUSHED.get((sock, graph_key))
+    if prev is not None:
+        prev_version, prev_gids = prev
+        ids_stable = prev_gids is graph.node_gids or \
+            np.array_equal(prev_gids, graph.node_gids)
+        if prev_version == version:
+            send_graph = False          # the daemon already has it
+            base_version = version
+        elif ids_stable and prev_version < version:
+            gids = storage.changes_between(prev_version, version)
+            if gids is not None:
+                base_version = prev_version
+                changed_idx = [graph.gid_to_idx[g] for g in gids
+                               if g in graph.gid_to_idx]
+    return {"graph_key": graph_key, "graph_version": version,
+            "base_version": base_version, "changed": changed_idx,
+            "ids_stable": ids_stable, "send_graph": send_graph}
+
+
+def _note_ppr_pushed(sock: str, graph_key: str, version, node_gids):
+    with _PPR_PUSHED_LOCK:
+        _PPR_PUSHED[(sock, graph_key)] = (version, node_gids)
+
+
+def _kernel_server_ppr(ctx, graph, sources, damping, max_iterations,
+                       tol, top_k=0):
+    """Route one PPR through the resident server's COALESCING plane.
+    Concurrent Cypher queries batch into one multi-source SpMM fixpoint
+    and repeats ride the change-log-invalidated result cache. Returns
+    the (reply_header, arrays) pair or None (→ in-process fallback,
+    LOUD)."""
+    sock = _kernel_route_socket(ctx)
+    if sock is None:
+        return None
+    from ..observability.metrics import global_metrics
+    from ..server.kernel_server import KernelServerError
+    meta = _ppr_serving_meta(ctx, graph, sock)
+    kwargs = {}
+    if meta.pop("send_graph"):
+        src, dst, weights = _graph_coo(graph)
+        kwargs.update(src=src, dst=dst, weights=weights)
+    try:
+        client = _kernel_client(sock, spawn=False)
+        h, out = client.ppr(
+            sources=np.asarray(sources, dtype=np.int32),
+            n_nodes=graph.n_nodes, damping=float(damping),
+            max_iterations=int(max_iterations), tol=float(tol),
+            top_k=int(top_k), **meta, **kwargs)
+        _note_ppr_pushed(sock, meta["graph_key"], meta["graph_version"],
+                         graph.node_gids)
+        global_metrics.increment("analytics.kernel_routed_total")
+        return h, out
+    except (KernelServerError, ConnectionError, OSError) as e:
+        global_metrics.increment("analytics.kernel_route_fallback_total")
+        log.warning("kernel-server PPR route failed (%s: %s); "
+                    "falling back to the in-process path",
+                    type(e).__name__, e)
+        return None
+
+
 def _pagerank_impl(ctx, max_iterations=100, damping_factor=0.85,
                    stop_epsilon=1e-5, weight_property=None):
     from ..ops.pagerank import pagerank
@@ -153,8 +227,15 @@ def personalized_pagerank(ctx, source_nodes, max_iterations=100,
                if v is not None and v.gid in graph.gid_to_idx]
     if not sources:
         return
-    ranks, _, _ = ppr(graph, sources, damping=float(damping_factor),
-                      max_iterations=int(max_iterations))
+    served = _kernel_server_ppr(ctx, graph, sources,
+                                float(damping_factor),
+                                int(max_iterations), 1e-6)
+    if served is not None:
+        _h, out = served
+        ranks = np.asarray(out["ranks"])[:graph.n_nodes]
+    else:
+        ranks, _, _ = ppr(graph, sources, damping=float(damping_factor),
+                          max_iterations=int(max_iterations))
     yield from _rank_results(ctx, graph, np.asarray(ranks), "rank")
 
 

@@ -60,11 +60,31 @@ def retrieve(ctx, property, query_vector, k_seeds, hops=2, limit=10,
     if not seed_indices:
         return
 
-    # 2) context expansion: k-hop neighborhood of the seeds (device frontier)
+    # 2+3) expansion + rerank. With a resident kernel server configured
+    # the whole tail is ONE coalesced round trip: the seeds restart a
+    # personalized-PageRank fixpoint batched with every concurrent
+    # retrieve/search on the daemon, the server extracts the top-k on
+    # device, and repeats ride its change-log-invalidated result cache.
+    # PPR mass localizes around the restart set, so the top-k IS the
+    # neighborhood expansion + rerank in one step.
+    from .graph_algorithms import _kernel_server_ppr
+    served = _kernel_server_ppr(ctx, graph, seed_indices, float(damping),
+                                100, 1e-6, top_k=int(limit))
+    if served is not None:
+        _h, out = served
+        for score, i in zip(out["topk_val"], out["topk_idx"]):
+            if score <= 0:
+                break
+            node = ctx.vertex_by_index(graph, int(i))
+            if node is not None:
+                yield {"node": node, "score": float(score),
+                       "seed_similarity": seed_sim.get(int(i), 0.0)}
+        return
+
+    # in-process fallback: k-hop neighborhood mask (device frontier)
+    # then personalized PageRank restarted on the seeds
     mask = np.asarray(khop_neighborhood(graph, seed_indices, int(hops),
                                         directed=False))
-
-    # 3) rerank: personalized PageRank restarted on the seeds
     ranks, _, _ = personalized_pagerank(graph, seed_indices,
                                         damping=float(damping),
                                         max_iterations=100)

@@ -305,6 +305,70 @@ def show_index_info(ctx):
                "size": entry.size}
 
 
+@mgp.read_proc("vector_search.ppr_search",
+               args=[("property", "STRING"), ("query", "LIST"),
+                     ("k_seeds", "INTEGER"), ("limit", "INTEGER")],
+               opt_args=[("damping", "FLOAT", 0.85),
+                         ("metric", "STRING", "cosine")],
+               results=[("node", "NODE"), ("score", "FLOAT"),
+                        ("seed_similarity", "FLOAT")])
+def ppr_search(ctx, property, query, k_seeds, limit, damping=0.85,
+               metric="cosine"):
+    """ANN seed → coalesced PPR expansion → rerank.
+
+    The serving-plane sibling of plain ``search``: the k nearest
+    embedding rows seed a personalized-PageRank restart, so results
+    rank by graph proximity to the semantic matches instead of raw
+    cosine alone. With a resident kernel server configured the PPR leg
+    is ONE coalesced round trip (batched with every concurrent caller,
+    top-k extracted on device, result cache consulted); otherwise it
+    runs in-process."""
+    import jax.numpy as jnp
+    from ..ops.pagerank import personalized_pagerank
+    from .graph_algorithms import _kernel_server_ppr
+
+    entry = _get_index(ctx, str(property))
+    if entry.matrix is None:
+        return
+    graph = ctx.device_graph()
+    if graph.n_nodes == 0:
+        return
+    q = jnp.asarray(np.asarray([query], dtype=np.float32))
+    sims, idx = _search_entry(entry, q, int(k_seeds), str(metric))
+    if sims is None:
+        return
+    seed_sim: dict[int, float] = {}
+    seed_indices: list[int] = []
+    for sim, i in zip(np.asarray(sims[0]), np.asarray(idx[0])):
+        gid = entry.row_gids[int(i)]
+        di = graph.gid_to_idx.get(gid) if gid is not None else None
+        if di is not None:
+            seed_indices.append(di)
+            seed_sim[di] = float(sim)
+    if not seed_indices:
+        return
+
+    served = _kernel_server_ppr(ctx, graph, seed_indices, float(damping),
+                                100, 1e-6, top_k=int(limit))
+    if served is not None:
+        _h, out = served
+        pairs = zip(out["topk_val"], out["topk_idx"])
+    else:
+        ranks, _, _ = personalized_pagerank(graph, seed_indices,
+                                            damping=float(damping),
+                                            max_iterations=100)
+        ranks = np.asarray(ranks)
+        order = np.argsort(-ranks)[:int(limit)]
+        pairs = ((ranks[i], i) for i in order)
+    for score, i in pairs:
+        if score <= 0:
+            break
+        node = ctx.vertex_by_index(graph, int(i))
+        if node is not None:
+            yield {"node": node, "score": float(score),
+                   "seed_similarity": seed_sim.get(int(i), 0.0)}
+
+
 @mgp.read_proc("knn.get",
                args=[("node", "NODE"), ("property", "STRING"),
                      ("k", "INTEGER")],

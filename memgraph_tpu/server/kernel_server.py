@@ -35,10 +35,28 @@ event end to end:
   * everything is counted through observability.metrics — the server's
     own counters ride the ``health`` reply across the process boundary.
 
+PPR serving plane (r16) — the first end-to-end query-serving path:
+production graph traffic is per-user point queries, not whole-graph
+sweeps, and N concurrent personalization vectors are ONE (n, B) SpMM
+batch over the semiring core. The ``ppr`` op therefore does NOT dispatch
+directly: requests enter a COALESCING QUEUE (:class:`PprServingPlane`)
+and accumulate for a bounded window (time- or count-triggered,
+``MEMGRAPH_TPU_PPR_BATCH_WINDOW_MS`` / ``_MAX_BATCH``), then execute as
+one batched multi-source fixpoint — per-request top-k extracted on
+device before the reply, typed per-request outcomes (one shed/oom/
+deadline must never poison its batchmates), HBM admission accounting
+for the whole batch footprint. A per-source RESULT CACHE keyed on
+(graph version, source set, params) serves repeats without touching the
+device; commits bump the storage change log, the server consumes the
+deltas to invalidate only sources whose neighborhoods changed, and
+invalidated vectors seed the next fixpoint (warm start — PPR is a
+contraction, any seed converges). See docs/architecture.md §PPR
+serving plane.
+
 Protocol (local trusted unix socket): length-prefixed frames, each a
 JSON header {op, arrays: [{name, dtype, shape}], ...params} followed by
 the raw array bytes in order. Ops: ping, health, probe, pagerank,
-shutdown.
+ppr, shutdown.
 
 Reference analog: none directly — the reference is a resident C++
 daemon by construction (src/memgraph.cpp); this component restores that
@@ -55,6 +73,7 @@ import socket
 import struct
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -238,6 +257,581 @@ def _recv_msg(sock: socket.socket):
 
 
 # --------------------------------------------------------------------------
+# PPR serving plane: result cache + coalescing queue
+# --------------------------------------------------------------------------
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: above this neighborhood size an entry records None — "invalidate on
+#: any change" — instead of an exact set (hub sources touch everything)
+PPR_NEIGH_CAP = 4096
+
+
+def _source_neighborhood(graph, sources, cap: int = PPR_NEIGH_CAP):
+    """Dense indices whose mutation must invalidate a cached PPR vector
+    restarted on ``sources``: the sources plus their out-neighbors (the
+    rows the restart mass crosses first). None = unbounded (treat every
+    change as relevant)."""
+    if graph.host_coo is None:
+        return None
+    src, dst, _w = graph.host_coo
+    sel = np.isin(np.asarray(src), np.asarray(sources))
+    neigh = set(int(i) for i in np.asarray(dst)[sel])
+    neigh.update(int(s) for s in np.asarray(sources))
+    if len(neigh) > cap:
+        return None
+    return frozenset(neigh)
+
+
+class _PprCacheEntry:
+    """One cached PPR vector. ``fresh`` entries serve directly; STALE
+    entries (their source neighborhood changed) are never served but
+    seed the recomputation's fixpoint (warm start)."""
+
+    __slots__ = ("version", "ranks", "err", "iters", "neigh", "fresh")
+
+    def __init__(self, version, ranks, err, iters, neigh) -> None:
+        self.version = version
+        self.ranks = ranks              # np (n_nodes,) float32
+        self.err = err
+        self.iters = iters
+        self.neigh = neigh              # frozenset | None (= any change)
+        self.fresh = True
+
+
+class PprResultCache:
+    """Per-source PPR result cache with change-log-driven invalidation.
+
+    Keyed on (graph_key, source set, damping, tol, precision); bounded
+    LRU. The consumer-side route layer ships each commit's change-log
+    delta (dense indices) with the next request; :meth:`note_version`
+    applies it: entries whose source neighborhood intersects the delta
+    are DEMOTED to warm-start seeds, everything else is promoted to the
+    new version — a stale read across a version bump is impossible, and
+    untouched sources keep their hits. An unknowable delta (log
+    evicted, node set changed) invalidates the whole graph_key.
+    """
+
+    def __init__(self, capacity: int | None = None) -> None:
+        from collections import OrderedDict
+        from ..utils.locks import tracked_lock
+        from ..utils.sanitize import shared_field
+        self.capacity = capacity if capacity is not None \
+            else _env_int("MEMGRAPH_TPU_PPR_CACHE_ENTRIES", 512)
+        self._lock = tracked_lock("PprResultCache._lock")
+        self._entries: "OrderedDict[tuple, _PprCacheEntry]" = OrderedDict()
+        self._known: dict[str, int] = {}    # graph_key -> newest version
+        shared_field(self, "_entries", "_known")
+
+    @staticmethod
+    def key(graph_key, sources, damping, tol, precision) -> tuple:
+        return (graph_key, tuple(int(s) for s in sources),
+                float(damping), float(tol), str(precision))
+
+    def known_version(self, graph_key) -> int | None:
+        from ..utils.sanitize import shared_read
+        with self._lock:
+            shared_read(self, "_known")
+            return self._known.get(graph_key)
+
+    def note_version(self, graph_key, version: int, base_version,
+                     changed, ids_stable: bool) -> None:
+        """Advance a graph_key to ``version``. ``changed`` is the dense
+        index delta covering (base_version, version] or None when
+        unknowable; ``ids_stable`` says the dense-id layout survived."""
+        from ..utils.sanitize import shared_write
+        if graph_key is None:
+            return
+        with self._lock:
+            shared_write(self, "_known")
+            known = self._known.get(graph_key)
+            if known is None or version <= known:
+                self._known.setdefault(graph_key, version)
+                return
+            targeted = (ids_stable and base_version == known
+                        and changed is not None)
+            changed_set = frozenset(int(i) for i in changed) \
+                if targeted else None
+            for key, entry in list(self._entries.items()):
+                if key[0] != graph_key:
+                    continue
+                if targeted:
+                    if entry.neigh is not None and \
+                            not (entry.neigh & changed_set):
+                        entry.version = version      # provably untouched
+                        continue
+                    entry.fresh = False              # warm-start seed
+                    global_metrics.increment("ppr.cache_invalidate_total")
+                elif ids_stable:
+                    entry.fresh = False
+                    global_metrics.increment("ppr.cache_invalidate_total")
+                else:
+                    # dense-id layout changed: the vector indexes the
+                    # wrong nodes — useless even as a seed
+                    del self._entries[key]
+                    global_metrics.increment("ppr.cache_invalidate_total")
+            self._known[graph_key] = version
+
+    def lookup(self, key: tuple):
+        """("hit", entry) | ("warm", entry) | ("miss", None)."""
+        from ..utils.sanitize import shared_read
+        with self._lock:
+            shared_read(self, "_entries")
+            entry = self._entries.get(key)
+            if entry is None:
+                return "miss", None
+            if entry.fresh and entry.version == self._known.get(key[0]):
+                self._entries.move_to_end(key)
+                return "hit", entry
+            return "warm", entry
+
+    def insert(self, key: tuple, entry: _PprCacheEntry) -> None:
+        from ..utils.sanitize import shared_write
+        with self._lock:
+            shared_write(self, "_entries")
+            known = self._known.get(key[0])
+            if known is not None and entry.version < known:
+                return          # a newer delta landed mid-compute
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+
+class _PprPending:
+    """One queued PPR request awaiting its batch."""
+
+    __slots__ = ("header", "arrays", "carrier", "event", "reply",
+                 "out_arrays", "warm_entry", "abandoned", "t_enqueued")
+
+    def __init__(self, header, arrays, carrier, warm_entry) -> None:
+        self.header = header
+        self.arrays = arrays
+        self.carrier = carrier
+        self.event = threading.Event()
+        self.reply = None
+        self.out_arrays = None
+        self.warm_entry = warm_entry
+        self.abandoned = False
+        self.t_enqueued = time.monotonic()
+
+
+def _topk_host(vec: np.ndarray, k: int):
+    """Host-side top-k for cache hits (no device round trip)."""
+    k = max(1, min(int(k), len(vec)))
+    idx = np.argpartition(-vec, k - 1)[:k]
+    idx = idx[np.argsort(-vec[idx], kind="stable")]
+    return vec[idx].astype(np.float32), idx.astype(np.int32)
+
+
+class PprServingPlane:
+    """Request-coalescing batched PPR with result caching.
+
+    Concurrent ``ppr`` requests accumulate for a bounded window —
+    time-triggered (MEMGRAPH_TPU_PPR_BATCH_WINDOW_MS, default 4ms) or
+    count-triggered (MEMGRAPH_TPU_PPR_MAX_BATCH, default 32) — then
+    execute as ONE batched multi-source SpMM fixpoint per parameter
+    group (requests with differing damping/tol/precision NEVER share a
+    fixpoint). Each member gets a TYPED outcome; admission accounts the
+    whole batch footprint and splits oversized groups into sub-batches
+    instead of shedding riders.
+    """
+
+    def __init__(self, server: "KernelServer") -> None:
+        import queue as _queue
+        from ..utils.locks import tracked_lock
+        self.server = server
+        self.window_s = _env_float(
+            "MEMGRAPH_TPU_PPR_BATCH_WINDOW_MS", 4.0) / 1e3
+        self.max_batch = max(1, _env_int("MEMGRAPH_TPU_PPR_MAX_BATCH", 32))
+        self.max_queue = max(1, _env_int("MEMGRAPH_TPU_PPR_MAX_QUEUE", 256))
+        self.cache = PprResultCache()
+        self._queue: "_queue.Queue[_PprPending]" = _queue.Queue()
+        self._thread = None
+        self._thread_lock = tracked_lock("PprServingPlane._thread_lock")
+        self._graph_versions: dict = {}   # batcher-thread only
+
+    # --- request side (connection threads) ---------------------------------
+
+    def submit(self, header: dict, arrays: dict):
+        """Blocking request entry: cache probe → admission → coalescing
+        queue → (reply, out_arrays). Runs on the connection thread."""
+        global_metrics.increment("ppr.requests_total")
+        sources = arrays.get("sources")
+        if sources is None or len(sources) == 0:
+            return ({"ok": False, "outcome": "invalid",
+                     "error": "ppr request carries no sources"}, None)
+        carrier = header.pop("trace", None)
+        graph_key = header.get("graph_key")
+        version = int(header.get("graph_version") or 0)
+        self.cache.note_version(
+            graph_key, version, header.get("base_version"),
+            arrays.get("changed") if header.get("has_delta") else None,
+            bool(header.get("ids_stable", True)))
+        ckey = self.cache.key(graph_key, sources,
+                              header.get("damping", 0.85),
+                              header.get("tol", 1e-6),
+                              header.get("precision", "f32"))
+        warm_entry = None
+        if graph_key is not None:
+            t0 = time.perf_counter()
+            t_wall = time.time()
+            status, entry = self.cache.lookup(ckey)
+            if status == "hit":
+                global_metrics.increment("ppr.cache_hit_total")
+                return self._reply_from_vector(
+                    header, entry.ranks, entry.err, entry.iters,
+                    cache="hit", batch_size=1, coalesced=False,
+                    carrier=carrier, t_wall=t_wall,
+                    dur=time.perf_counter() - t0)
+            if status == "warm":
+                warm_entry = entry
+            global_metrics.increment("ppr.cache_miss_total")
+
+        est = _estimate_request_bytes(header, arrays) \
+            + self._lane_bytes(header)
+        if est > self.server.hbm_budget_bytes:
+            return self._shed(
+                f"estimated footprint {est} bytes exceeds HBM budget "
+                f"{self.server.hbm_budget_bytes} bytes")
+        depth = self._queue.qsize()
+        if depth >= self.max_queue:
+            # backpressure: the saturation plane flips /health to 503
+            # before this point; past it we shed typed instead of
+            # letting the queue (and every rider's latency) grow
+            return self._shed(
+                f"PPR coalescing queue saturated ({depth} >= "
+                f"{self.max_queue} pending)")
+        pending = _PprPending(header, arrays, carrier, warm_entry)
+        self._ensure_thread()
+        self._queue.put(pending)
+        global_metrics.set_gauge("ppr.queue_depth",
+                                 float(self._queue.qsize()))
+        deadline_s = header.get("deadline_s")
+        wait_s = float(deadline_s) if deadline_s \
+            else self.server.wedge_after_s + 30.0
+        if not pending.event.wait(wait_s):
+            pending.abandoned = True
+            self.server._count("deadline_exceeded")
+            log.warning("ppr: request exceeded its %.3fs deadline in "
+                        "the coalescing plane", wait_s)
+            return ({"ok": False, "outcome": "deadline_exceeded",
+                     "retryable": True,
+                     "error": f"ppr request exceeded {wait_s}s "
+                              "deadline"}, None)
+        return pending.reply, pending.out_arrays
+
+    def _shed(self, why: str):
+        self.server._count("shed")
+        global_metrics.increment("ppr.shed_total")
+        global_metrics.increment("kernel_server.admission_rejected_total")
+        log.warning("ppr: SHED request — %s", why)
+        return ({"ok": False, "outcome": "shed", "retryable": False,
+                 "error": f"AdmissionRejected: {why}"}, None)
+
+    def _lane_bytes(self, header: dict) -> int:
+        """One personalization lane's iteration-state footprint (x, new,
+        acc, p + slack), from the declared node count."""
+        n = int(header.get("n_nodes") or 0)
+        return max(n, 1) * 4 * 6
+
+    def _reply_from_vector(self, header, ranks, err, iters, *, cache,
+                           batch_size, coalesced, stages=None,
+                           carrier=None, t_wall=None, dur=None,
+                           topk=None):
+        k = int(header.get("top_k") or 0)
+        reply = {"ok": True, "outcome": "completed", "err": float(err),
+                 "iters": int(iters), "cache": cache,
+                 "batch_size": int(batch_size),
+                 "coalesced": bool(coalesced)}
+        if stages:
+            reply["stages"] = stages
+        if carrier and carrier.get("trace_id"):
+            with mgtrace.adopt(carrier):
+                mgtrace.record_span(
+                    "kernel.dispatch", t_wall or time.time(), dur or 0.0,
+                    op="ppr", batch=int(batch_size), cache=cache)
+            spans = mgtrace.take_trace(carrier["trace_id"])
+            if spans:
+                reply["trace_spans"] = spans
+        global_metrics.observe("kernel_server.dispatch_latency_sec",
+                               dur if dur is not None else 0.0,
+                               trace_id=(carrier or {}).get("trace_id"))
+        if k > 0:
+            if topk is not None:
+                vals, idx = topk
+                vals, idx = vals[:k], idx[:k]
+            else:
+                vals, idx = _topk_host(np.asarray(ranks), k)
+            return reply, {"topk_val": np.asarray(vals, dtype=np.float32),
+                           "topk_idx": np.asarray(idx, dtype=np.int32)}
+        return reply, {"ranks": np.asarray(ranks, dtype=np.float32)}
+
+    # --- batch side (the one batcher thread) -------------------------------
+
+    def _ensure_thread(self) -> None:
+        import threading
+        with self._thread_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="ks-ppr-batcher")
+            self._thread.start()
+
+    def _run(self) -> None:
+        import queue as _queue
+        while not self.server._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.25)
+            except _queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(
+                        timeout=max(rem, 0.0005)))
+                except _queue.Empty:
+                    break
+            global_metrics.set_gauge("ppr.queue_depth",
+                                     float(self._queue.qsize()))
+            global_metrics.set_gauge("ppr.window_occupancy",
+                                     len(batch) / self.max_batch)
+            groups: dict = {}
+            for m in batch:
+                h = m.header
+                gk = (h.get("graph_key"), float(h.get("damping", 0.85)),
+                      float(h.get("tol", 1e-6)),
+                      int(h.get("max_iterations", 100)),
+                      str(h.get("precision", "f32")))
+                groups.setdefault(gk, []).append(m)
+            for members in groups.values():
+                try:
+                    self._execute_group(members)
+                except Exception:   # noqa: BLE001 — serving must survive
+                    log.exception("ppr: group execution failed "
+                                  "unexpectedly")
+                    self._fail_group(members, "invalid", False,
+                                     "internal ppr batch failure")
+        # drain: pending requests must not leave connection threads
+        # blocked across shutdown
+        while True:
+            try:
+                m = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            self._fail_group([m], "invalid", False,
+                             "kernel server shutting down")
+
+    def _fail_group(self, members, outcome, retryable, error) -> None:
+        """Typed failure for EVERY live member — a batch dies whole or
+        answers whole, never half (device_chaos contract)."""
+        for m in members:
+            if m.reply is not None:
+                continue
+            self.server._count(outcome)
+            m.reply = {"ok": False, "outcome": outcome,
+                       "retryable": retryable, "error": error}
+            m.event.set()
+
+    def _resolve_group_graph(self, members):
+        """Resolve (importing/refreshing if needed) the group's graph.
+        Runs under _dispatch_lock on the batcher thread."""
+        key = members[0].header.get("graph_key")
+        want = max(int(m.header.get("graph_version") or 0)
+                   for m in members)
+        have = self._graph_versions.get(key)
+        carrier_m = None
+        for m in members:
+            if "src" in m.arrays and (
+                    carrier_m is None
+                    or int(m.header.get("graph_version") or 0)
+                    > int(carrier_m.header.get("graph_version") or 0)):
+                carrier_m = m
+        if key is not None and carrier_m is not None and \
+                have is not None and want > have:
+            # a commit moved the graph: drop the stale device copy so
+            # _resolve_graph re-imports from the carrier's arrays
+            self.server._graphs.pop(key, None)  # mglint: disable=MG006 — batcher thread holds _dispatch_lock (same contract as _resolve_graph)
+        m = carrier_m or members[0]
+        g = self.server._resolve_graph(m.header, m.arrays)
+        if g is not None and key is not None:
+            self._graph_versions[key] = max(want, have or 0)
+        return g
+
+    def _execute_group(self, members) -> None:
+        """One parameter group → one batched fixpoint dispatch."""
+        from ..observability import stats as mgstats
+        server = self.server
+        did = server._dispatch_begin(server.wedge_after_s)
+        global_metrics.increment("ppr.batches_total")
+        global_metrics.observe("ppr.batch_size", float(len(members)))
+        if len(members) > 1:
+            global_metrics.increment("ppr.coalesced_total",
+                                     delta=len(members))
+        t0 = time.perf_counter()
+        t_wall = time.time()
+        acc = mgstats.StageAccumulator()
+        results = None
+        live = []
+        try:
+            try:
+                with mgstats.collecting_stages(acc):
+                    with server._dispatch_lock:
+                        device_fault_point()
+                        g = self._resolve_group_graph(members)
+                        if g is None:
+                            self._fail_group(
+                                members, "invalid", False,
+                                "unknown graph_key and no edge arrays "
+                                "supplied")
+                            return
+                        live, results = self._compute(g, members)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                kind = classify_device_error(e)
+                if kind == "oom":
+                    outcome, retryable = "oom", False
+                elif kind in ("device_error", "device_lost"):
+                    outcome, retryable = "device_error", True
+                else:
+                    outcome, retryable = "invalid", False
+                log.warning("ppr: batch of %d failed [%s]: %s",
+                            len(members), outcome, e)
+                self._fail_group(members, outcome, retryable,
+                                 f"{type(e).__name__}: {e}")
+                return
+            dur = time.perf_counter() - t0
+            # pro-rata device-stage attribution: the batch's HBM-seconds
+            # split evenly across its riders, so per-query PROFILE sums
+            # stay truthful instead of charging the whole batch to one
+            snap = acc.snapshot()
+            share = 1.0 / max(1, len(live))
+            stages = {name: {"seconds": slot["seconds"] * share,
+                             "count": slot["count"]}
+                      for name, slot in snap.items()} if snap else None
+            for m, res in zip(live, results):
+                ranks, err, iters, cache_state, topk = res
+                m.reply, m.out_arrays = self._reply_from_vector(
+                    m.header, ranks, err, iters, cache=cache_state,
+                    batch_size=len(members),
+                    coalesced=len(members) > 1, stages=stages,
+                    carrier=m.carrier, t_wall=t_wall, dur=dur,
+                    topk=topk)
+                server._count("completed")
+                m.event.set()
+        finally:
+            server._dispatch_end(did)
+
+    def _compute(self, g, members):
+        """Batched fixpoint over the group's live members (under
+        _dispatch_lock). Returns (live_members, results) where results
+        align with live_members as (ranks, err, iters, cache_state).
+        Invalid members are replied typed HERE — they must not poison
+        the batch."""
+        from ..ops.pagerank import personalized_pagerank_batch, ppr_topk
+        h0 = members[0].header
+        damping = float(h0.get("damping", 0.85))
+        tol = float(h0.get("tol", 1e-6))
+        max_iterations = int(h0.get("max_iterations", 100))
+        precision = str(h0.get("precision", "f32"))
+        graph_key = h0.get("graph_key")
+        version = self._graph_versions.get(graph_key, 0)
+
+        live = []
+        for m in members:
+            sources = np.asarray(m.arrays["sources"], dtype=np.int32)
+            if sources.size == 0 or sources.min() < 0 \
+                    or sources.max() >= g.n_nodes:
+                self.server._count("invalid")
+                m.reply = {"ok": False, "outcome": "invalid",
+                           "retryable": False,
+                           "error": f"sources out of range for graph "
+                                    f"with {g.n_nodes} nodes"}
+                m.event.set()
+                continue
+            live.append(m)
+        if not live:
+            return [], []
+
+        # admission: chunk lanes so graph + B lanes fit the HBM budget
+        lane_bytes = g.n_pad * 4 * 6
+        graph_bytes = (g.e_pad * 12 + g.n_pad * 8) * 3
+        budget = max(self.server.hbm_budget_bytes - graph_bytes,
+                     lane_bytes)
+        max_lanes = max(1, min(int(budget // lane_bytes), 128))
+
+        results = []
+        for lo in range(0, len(live), max_lanes):
+            chunk = live[lo:lo + max_lanes]
+            source_sets = [np.asarray(m.arrays["sources"],
+                                      dtype=np.int32) for m in chunk]
+            x0 = None
+            warm_lanes = []
+            if any(m.warm_entry is not None
+                   and len(m.warm_entry.ranks) == g.n_nodes
+                   for m in chunk):
+                x0 = np.zeros((g.n_pad, len(chunk)), dtype=np.float32)
+                for lane, m in enumerate(chunk):
+                    e = m.warm_entry
+                    if e is not None and len(e.ranks) == g.n_nodes:
+                        x0[:g.n_nodes, lane] = e.ranks
+                        warm_lanes.append(lane)
+                        global_metrics.increment("ppr.warm_start_total")
+                    else:
+                        s = source_sets[lane]
+                        x0[s, lane] = np.float32(1.0) \
+                            / np.float32(len(s))
+            x_dev, errs, iters = personalized_pagerank_batch(
+                g, source_sets, damping=damping,
+                max_iterations=max_iterations, tol=tol,
+                precision=precision, x0=x0, raw=True)
+            # per-request top-k extracted ON DEVICE (one jitted top_k
+            # over the whole batch) before the O(n) host transfer the
+            # cache fill pays anyway
+            k_max = max((int(m.header.get("top_k") or 0) for m in chunk),
+                        default=0)
+            tvals = tidx = None
+            if k_max > 0:
+                tvals, tidx = ppr_topk(x_dev.T[:len(chunk)],
+                                       g.n_nodes, k_max)
+            ranks = np.asarray(x_dev)[:g.n_nodes, :len(chunk)].T
+            warm_set = set(warm_lanes)
+            for lane, m in enumerate(chunk):
+                vec = np.ascontiguousarray(ranks[lane])
+                if graph_key is not None:
+                    ckey = self.cache.key(
+                        graph_key, m.arrays["sources"], damping, tol,
+                        precision)
+                    self.cache.insert(ckey, _PprCacheEntry(
+                        version, vec, float(errs[lane]),
+                        int(iters[lane]),
+                        _source_neighborhood(g, m.arrays["sources"])))
+                topk = (tvals[lane], tidx[lane]) \
+                    if tvals is not None else None
+                results.append((vec, float(errs[lane]),
+                                int(iters[lane]),
+                                "warm" if lane in warm_set else "miss",
+                                topk))
+        return live, results
+
+
+# --------------------------------------------------------------------------
 # server
 # --------------------------------------------------------------------------
 
@@ -288,6 +882,8 @@ class KernelServer:
         # export it so capacity planning can see utilization vs limit
         global_metrics.set_gauge("kernel_server.hbm_budget_bytes",
                                  float(self.hbm_budget_bytes))
+        # PPR serving plane: coalescing queue + result cache (r16)
+        self._ppr = PprServingPlane(self)
 
     def _touch_activity(self) -> None:
         from ..utils.sanitize import shared_write
@@ -344,7 +940,10 @@ class KernelServer:
             self._sock_ino = os.stat(self.socket_path).st_ino
         except OSError:
             self._sock_ino = None
-        srv.listen(8)
+        # serving-plane backlog: the PPR coalescer exists precisely for
+        # bursts of concurrent clients, so simultaneous connects must
+        # not bounce off a tiny accept queue
+        srv.listen(128)
         self._warm()
         self._touch_activity()
         srv.settimeout(1.0)
@@ -384,6 +983,13 @@ class KernelServer:
                         _send_msg(conn, {"ok": True})
                         self._shutdown.set()
                         return
+                    elif op == "ppr":
+                        # the coalescing plane: this connection thread
+                        # blocks while its request rides a batch; the
+                        # batcher thread owns the device dispatch
+                        reply, out_arrays = self._ppr.submit(header,
+                                                             arrays)
+                        _send_msg(conn, reply, out_arrays)
                     elif op in ("pagerank", "semiring", "probe"):
                         # supervised: admission guard + worker thread +
                         # per-request deadline; the reply ships AFTER
@@ -409,11 +1015,29 @@ class KernelServer:
     def _count(self, outcome: str) -> None:
         global_metrics.increment(f"kernel_server.dispatch.{outcome}_total")
 
+    def _dispatch_begin(self, deadline_s) -> int:
+        """Register an in-flight dispatch for the health op's wedge
+        detection; returns its id for :meth:`_dispatch_end`."""
+        from ..utils.sanitize import shared_write
+        with self._stats_lock:
+            shared_write(self, "_dispatch_seq")
+            self._dispatch_seq += 1
+            did = self._dispatch_seq
+            self._active[did] = (time.monotonic(), deadline_s)
+            global_metrics.set_gauge("kernel_server.in_flight",
+                                     float(len(self._active)))
+        return did
+
+    def _dispatch_end(self, did: int) -> None:
+        from ..utils.sanitize import shared_write
+        with self._stats_lock:
+            shared_write(self, "_active")
+            self._active.pop(did, None)
+            global_metrics.set_gauge("kernel_server.in_flight",
+                                     float(len(self._active)))
+
     def _supervised(self, op: str, header: dict, arrays: dict):
         """Admission guard → worker-thread dispatch → typed outcome."""
-        import threading
-        from ..utils.sanitize import shared_write
-
         est = _estimate_request_bytes(header, arrays)
         if est > self.hbm_budget_bytes:
             self._count("shed")
@@ -434,14 +1058,7 @@ class KernelServer:
         # device stages under it) joins the caller's trace; its spans
         # ship home on the reply (take_trace below)
         carrier = header.pop("trace", None)
-        with self._stats_lock:
-            shared_write(self, "_dispatch_seq")
-            self._dispatch_seq += 1
-            did = self._dispatch_seq
-            self._active[did] = (time.monotonic(),
-                                 deadline_s or self.wedge_after_s)
-            global_metrics.set_gauge("kernel_server.in_flight",
-                                     float(len(self._active)))
+        did = self._dispatch_begin(deadline_s or self.wedge_after_s)
         box: dict = {}
         t_dispatch = time.perf_counter()
 
@@ -466,12 +1083,7 @@ class KernelServer:
             except BaseException as e:  # noqa: BLE001 — classified below
                 box["exc"] = e
             finally:
-                with self._stats_lock:
-                    shared_write(self, "_active")
-                    self._active.pop(did, None)
-                    global_metrics.set_gauge(
-                        "kernel_server.in_flight",
-                        float(len(self._active)))
+                self._dispatch_end(did)
 
         def ship_trace(reply: dict) -> dict:
             """Attach this dispatch's spans + stage splits + latency."""
@@ -553,7 +1165,8 @@ class KernelServer:
                      for t0, dl in entries)
         counters = {name: value for name, _kind, value
                     in global_metrics.snapshot()
-                    if name.startswith(("kernel_server.", "analytics."))}
+                    if name.startswith(("kernel_server.", "analytics.",
+                                        "ppr."))}
         return {"ok": True, "pid": os.getpid(),
                 "uptime_s": round(now - self._started, 3),
                 "in_flight": len(entries),
@@ -732,6 +1345,49 @@ class KernelClient:
         if not h.get("ok"):
             _raise_for_reply(h)
         return out["ranks"], h["err"], h["iters"]
+
+    def ppr(self, sources, src=None, dst=None, weights=None, n_nodes=None,
+            graph_key=None, graph_version=0, base_version=None,
+            ids_stable=True, changed=None, top_k=0, damping=0.85,
+            tol=1e-6, max_iterations=100, precision="f32",
+            deadline_s=None):
+        """One personalized-PageRank request through the server's
+        COALESCING plane: concurrent callers batch into one multi-source
+        SpMM fixpoint; repeats hit the change-log-invalidated result
+        cache. Returns (reply_header, arrays) — arrays carry either
+        ``ranks`` (top_k == 0) or ``topk_val``/``topk_idx``.
+
+        ``graph_version``/``base_version``/``changed``/``ids_stable``
+        are the cache-invalidation protocol: ``changed`` lists the dense
+        node indices mutated in (base_version, graph_version] (from the
+        storage change log); omitted → the server conservatively
+        invalidates every cached vector for this graph_key on a version
+        bump."""
+        arrays = {"sources": np.asarray(sources, dtype=np.int32)}
+        if src is not None:
+            arrays["src"] = np.asarray(src, dtype=np.int64)
+            arrays["dst"] = np.asarray(dst, dtype=np.int64)
+            if weights is not None:
+                arrays["weights"] = np.asarray(weights, dtype=np.float32)
+        if changed is not None:
+            arrays["changed"] = np.asarray(changed, dtype=np.int32)
+        header = {"op": "ppr", "graph_key": graph_key, "n_nodes": n_nodes,
+                  "graph_version": int(graph_version),
+                  "base_version": base_version,
+                  "ids_stable": bool(ids_stable),
+                  "has_delta": changed is not None,
+                  "damping": float(damping), "tol": float(tol),
+                  "max_iterations": int(max_iterations),
+                  "precision": str(precision), "top_k": int(top_k)}
+        if deadline_s is not None:
+            header["deadline_s"] = deadline_s
+        carrier = mgtrace.inject()
+        if carrier is not None:
+            header["trace"] = carrier
+        h, out = self.call(header, arrays)
+        if not h.get("ok"):
+            _raise_for_reply(h)
+        return h, out
 
     def semiring(self, algorithm: str = "pagerank", src=None, dst=None,
                  weights=None, n_nodes=None, graph_key=None,
@@ -970,25 +1626,18 @@ class SupervisedKernelClient:
 
     # --- supervised calls ---------------------------------------------------
 
-    def pagerank(self, src=None, dst=None, weights=None, n_nodes=None,
-                 graph_key=None, idempotent: bool = True,
-                 deadline_s: float | None = None, **params):
-        """PageRank with supervised retries. Pure computation ⇒
-        idempotent by default; callers piping through side-effecting
-        wrappers pass idempotent=False and get fail-fast semantics."""
-        if deadline_s is None:
-            deadline_s = self.deadline_s
+    def _call_supervised(self, op: str, invoke, idempotent: bool):
+        """The shared supervised-retry skeleton: ``invoke(client)`` runs
+        under the retry policy with the typed-outcome branching every
+        supervised op shares (pagerank, ppr, ...)."""
         last: Exception | None = None
         for _attempt in self.retry.attempts():
             try:
                 c = self._connect()
                 t0 = time.perf_counter()
-                with mgtrace.span("kernel.request", op="pagerank",
+                with mgtrace.span("kernel.request", op=op,
                                   attempt=_attempt):
-                    result = c.pagerank(src=src, dst=dst, weights=weights,
-                                        n_nodes=n_nodes,
-                                        graph_key=graph_key,
-                                        deadline_s=deadline_s, **params)
+                    result = invoke(c)
                 # client-observed dispatch wall time (request + device +
                 # reply) for the caller's PROFILE attribution
                 mgstats.record_stage("kernel_dispatch",
@@ -1024,6 +1673,35 @@ class SupervisedKernelClient:
             f"supervised attempts: {last}",
             outcome=getattr(last, "outcome", "invalid"),
             retryable=False) from last
+
+    def pagerank(self, src=None, dst=None, weights=None, n_nodes=None,
+                 graph_key=None, idempotent: bool = True,
+                 deadline_s: float | None = None, **params):
+        """PageRank with supervised retries. Pure computation ⇒
+        idempotent by default; callers piping through side-effecting
+        wrappers pass idempotent=False and get fail-fast semantics."""
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        return self._call_supervised(
+            "pagerank",
+            lambda c: c.pagerank(src=src, dst=dst, weights=weights,
+                                 n_nodes=n_nodes, graph_key=graph_key,
+                                 deadline_s=deadline_s, **params),
+            idempotent)
+
+    def ppr(self, sources, idempotent: bool = True,
+            deadline_s: float | None = None, **params):
+        """Coalesced personalized PageRank with supervised retries (see
+        :meth:`KernelClient.ppr` for the serving protocol). Pure
+        computation ⇒ idempotent by default; a device fault mid-batch
+        fails every rider typed, so the retry here re-enters the
+        coalescing queue cleanly."""
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        return self._call_supervised(
+            "ppr",
+            lambda c: c.ppr(sources, deadline_s=deadline_s, **params),
+            idempotent)
 
     def close(self) -> None:
         self._stop.set()
@@ -1079,6 +1757,25 @@ def ensure_server(socket_path: str = DEFAULT_SOCKET,
     except (OSError, subprocess.TimeoutExpired):
         pass
     return None
+
+
+#: per-socket supervised clients shared process-wide (a client owns a
+#: connection + supervision state; one per daemon is the contract)
+_SHARED_CLIENTS: dict = {}
+_shared_clients_guard = threading.Lock()
+
+
+def shared_client(socket_path: str = DEFAULT_SOCKET,
+                  spawn: bool = False) -> SupervisedKernelClient:
+    """The process-wide SupervisedKernelClient for a socket — ops-level
+    kernel routing (ops/pagerank.py) and the procedure layer share one
+    supervisor per daemon instead of each minting connections."""
+    with _shared_clients_guard:
+        client = _SHARED_CLIENTS.get(socket_path)
+        if client is None:
+            client = _SHARED_CLIENTS[socket_path] = \
+                SupervisedKernelClient(socket_path, spawn=spawn)
+        return client
 
 
 def main() -> None:

@@ -129,3 +129,68 @@ def test_vector_index_incremental_maintenance(db):
     rows = run(db, "CALL vector_search.show_index_info() "
                    "YIELD property, size RETURN property, size")
     assert rows == [["emb", n0]]
+
+
+def test_vector_search_ppr_search_in_process(db):
+    """ANN seed -> PPR expansion -> rerank, in-process fallback path (no
+    resident server configured)."""
+    _seed_docs(db)
+    rows = run(db, "CALL vector_search.ppr_search('emb', "
+                   "[1.0, 0.0, 0.0, 0.0], 2, 5) "
+                   "YIELD node, score, seed_similarity "
+                   "RETURN node.title, score, seed_similarity")
+    titles = [r[0] for r in rows]
+    assert "tpu kernels" in titles
+    scores = [r[1] for r in rows]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_graphrag_retrieve_through_resident_server(db, tmp_path,
+                                                   monkeypatch):
+    """The serving-plane round trip: retrieve routes its PPR leg through
+    an in-thread kernel server (env-configured socket), results ranked
+    by the server's device-extracted top-k; a repeat rides the result
+    cache; kernel_routed counter moves."""
+    import threading as _threading
+    import time as _time
+
+    from memgraph_tpu.observability.metrics import global_metrics
+    from memgraph_tpu.server.kernel_server import (KernelClient,
+                                                   KernelServer)
+
+    _seed_docs(db)
+    sock = str(tmp_path / "ks.sock")
+    srv = KernelServer(sock, wedge_after_s=30)
+    _threading.Thread(target=srv.serve_forever, daemon=True).start()
+    deadline = _time.monotonic() + 120
+    probe = None
+    while _time.monotonic() < deadline:
+        try:
+            probe = KernelClient(sock, timeout=60)
+            break
+        except OSError:
+            _time.sleep(0.05)
+    assert probe is not None
+
+    monkeypatch.setenv("MEMGRAPH_TPU_ANALYTICS_KERNEL_SERVER", sock)
+    before = {n: v for n, _k, v in global_metrics.snapshot()}
+    try:
+        rows = run(db, "CALL graphrag.retrieve('emb', "
+                       "[1.0, 0.0, 0.0, 0.0], 2, 2, 5) "
+                       "YIELD node, score RETURN node.title, score")
+        titles = [r[0] for r in rows]
+        assert "tpu kernels" in titles
+        assert [r[1] for r in rows] == sorted((r[1] for r in rows),
+                                              reverse=True)
+        after = {n: v for n, _k, v in global_metrics.snapshot()}
+        assert after.get("analytics.kernel_routed_total", 0) > \
+            before.get("analytics.kernel_routed_total", 0)
+        # the repeat rides the serving plane's result cache
+        hit_before = after.get("ppr.cache_hit_total", 0)
+        run(db, "CALL graphrag.retrieve('emb', [1.0, 0.0, 0.0, 0.0], 2, "
+                "2, 5) YIELD node RETURN node.title")
+        final = {n: v for n, _k, v in global_metrics.snapshot()}
+        assert final.get("ppr.cache_hit_total", 0) > hit_before
+    finally:
+        probe.shutdown()
+        probe.close()

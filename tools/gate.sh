@@ -68,6 +68,13 @@ stage "mgchaos checker honesty (split-brain script)" \
 stage "mgchaos device nemesis smoke (supervised kernel plane)" \
     python -m tools.mgchaos device-smoke --seed 0
 
+# 4c. PPR serving-plane smoke: spawn the kernel server, fire 64
+#     concurrent requests from threads, assert the coalescing ratio
+#     beats 1 (requests really shared batches), cache hit on repeat,
+#     clean shutdown. Functional on every host; perf is the bench's job.
+stage "ppr-smoke (coalesced PPR serving plane)" \
+    python -m tools.ppr_smoke
+
 # 5. perf-regression gate: the newest BENCH_r*.json record must be
 #    non-degraded and within BASELINE.json's envelope (>15% regression
 #    fails). Hosts without an accelerator skip LOUDLY (exit 0): the

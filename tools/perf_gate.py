@@ -93,6 +93,11 @@ def latest_bench_json() -> str | None:
     return records[-1] if records else None
 
 
+def latest_ppr_json() -> str | None:
+    records = sorted(glob.glob(os.path.join(REPO, "BENCH_ppr_r*.json")))
+    return records[-1] if records else None
+
+
 def check(record: dict, baseline: dict) -> int:
     envelopes = baseline.get("envelopes") or {}
     metric = record.get("metric", "")
@@ -179,6 +184,57 @@ def check_semiring(record: dict, envelopes: dict, headline_ref: float) -> int:
     return rc
 
 
+def check_ppr(record: dict, envelopes: dict) -> int:
+    """r16 PPR-serving envelope over a BENCH_ppr_r*.json record: the
+    coalescing plane's sustained QPS must beat the sequential baseline
+    by the declared factor with a real coalescing ratio, and a
+    degraded/untagged record can never stand in for the headline —
+    exactly the honesty contract the main metric carries."""
+    env = envelopes.get("ppr_qps")
+    if env is None:
+        return 0
+    if record is None:
+        log("FAIL: BASELINE.json declares a ppr_qps envelope but no "
+            "BENCH_ppr_r*.json record exists — run "
+            "benchmarks/ppr_serving_bench.py")
+        return 1
+    if "degraded" not in record:
+        log("FAIL: ppr record carries no degraded tag — an untagged "
+            "number cannot be trusted; regenerate with the current "
+            "ppr_serving_bench.py")
+        return 1
+    if record["degraded"]:
+        log(f"FAIL: ppr record is degraded (backend="
+            f"{record.get('backend', '?')}); a degraded run can never "
+            "stand in for the serving headline")
+        return 1
+    extra = record.get("extra") or {}
+    rc = 0
+    speedup = float(extra.get("speedup_vs_sequential", 0.0))
+    need_speedup = float(env.get("min_speedup_vs_sequential", 5.0))
+    if speedup < need_speedup:
+        log(f"FAIL: ppr speedup {speedup:.2f}x over the sequential "
+            f"baseline < required {need_speedup:.1f}x — coalescing "
+            "stopped paying")
+        rc = 1
+    else:
+        log(f"PASS: ppr speedup {speedup:.2f}x (>= {need_speedup:.1f}x)")
+    ratio = float(extra.get("coalescing_ratio", 0.0))
+    need_ratio = float(env.get("min_coalescing_ratio", 4.0))
+    if ratio < need_ratio:
+        log(f"FAIL: coalescing ratio {ratio:.2f} < required "
+            f"{need_ratio:.1f} — requests are not sharing batches")
+        rc = 1
+    else:
+        log(f"PASS: coalescing ratio {ratio:.2f} "
+            f"(>= {need_ratio:.1f})")
+    if not extra.get("f32_bit_exact_vs_sequential", False):
+        log("FAIL: batched f32 results are not bit-exact vs sequential "
+            "personalized_pagerank — the batch changed the answers")
+        rc = 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="perf_gate")
     ap.add_argument("--json", help="check an existing bench JSON record")
@@ -215,7 +271,19 @@ def main(argv=None) -> int:
 
     with open(path) as f:
         record = json.load(f)
-    return check(record, baseline)
+    rc = check(record, baseline)
+    if args.latest:
+        # the serving-plane record rides the same --latest gate run
+        ppr_path = latest_ppr_json()
+        ppr_record = None
+        if ppr_path is not None:
+            log(f"checking newest ppr record "
+                f"{os.path.basename(ppr_path)}")
+            with open(ppr_path) as f:
+                ppr_record = json.load(f)
+        rc = rc or check_ppr(ppr_record,
+                             baseline.get("envelopes") or {})
+    return rc
 
 
 if __name__ == "__main__":
