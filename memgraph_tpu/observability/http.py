@@ -78,7 +78,12 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     "ppr": {name: value for name, _k, value
                             in global_metrics.snapshot()
                             if name.startswith(
-                                ("ppr.", "kernel_server.daemon.ppr."))}},
+                                ("ppr.", "kernel_server.daemon.ppr."))},
+                    # device compile plane: the runtime witness for the
+                    # mgxla static compile budget (jit.compile_total)
+                    "device": {name: value for name, _k, value
+                               in global_metrics.snapshot()
+                               if name.startswith("jit.")}},
                     default=str)
                 ctype = "application/json"
             elif path.startswith("/health"):

@@ -99,6 +99,9 @@ STAT_NAMES = (
     "ppr.shed_total",
     "ppr.queue_depth",             # coalescing queue backlog gauge
     "ppr.window_occupancy",        # last batch width / max width gauge
+    # device compile plane (r17, mgxla): runtime witness for the static
+    # compile budget — every XLA backend compile bumps it
+    "jit.compile_total",
     # analytics / checkpoint plane
     "analytics.checkpoint.saved_total",
     "analytics.checkpoint.restored_total",

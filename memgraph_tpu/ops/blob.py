@@ -112,6 +112,14 @@ def unpack_bit_words(words, n_bits):
     return bits[..., :n_bits] != 0
 
 
+#: jitted reconstruction programs keyed by the blob's segment layout —
+#: re-jitting a fresh closure per call silently retraced + recompiled on
+#: EVERY upload (mglint MG008 recompile-hazard; the docstring's
+#: "compile-cached per shape signature" promise was only true for the
+#: persistent on-disk cache, not the in-process one)
+_PREPARE_CACHE: dict = {}
+
+
 def put_packed(arrays: dict) -> dict:
     """Ship `arrays` (dict of host np arrays) in one transfer; returns a
     dict of device arrays (one jitted reconstruction call, compile-cached
@@ -121,9 +129,16 @@ def put_packed(arrays: dict) -> dict:
     ensure_compile_cache()
 
     blob_np, segs = pack_blob(arrays)
+    key = tuple(sorted(
+        (name, off, n_words, kind, tuple(int(s) for s in shape),
+         np.dtype(dtype).str)
+        for name, (off, n_words, kind, shape, dtype) in segs.items()))
+    prepare = _PREPARE_CACHE.get(key)
+    if prepare is None:
+        @jax.jit
+        def prepare(blob, _segs=segs):
+            return {name: unblob(blob, _segs, name) for name in _segs}
 
-    @jax.jit
-    def prepare(blob):
-        return {name: unblob(blob, segs, name) for name in segs}
+        _PREPARE_CACHE[key] = prepare
 
     return prepare(jax.device_put(blob_np))

@@ -374,7 +374,7 @@ def _build_ppr_batch(n_out: int, max_iterations: int, precision: str,
                      warm: bool):
     import jax
 
-    def run(A, P):
+    def run(A, P, x0):
         # batched analog of _ppr_setup: identical hoisted invariants,
         # personalization columns normalized per lane
         n_nodes = P["n_nodes"]
@@ -387,7 +387,7 @@ def _build_ppr_batch(n_out: int, max_iterations: int, precision: str,
         inv_wsum = jnp.where(wsum > 0, 1.0 / jnp.maximum(wsum, 1e-30), 0.0)
         dangling_f = (valid & (wsum <= 0)).astype(jnp.float32)
         edge_mult = A["w"] * inv_wsum[A["src"]]
-        x_init = A["x0"] if warm else pm
+        x_init = x0 if warm else pm
         tol = P["tol"]
         n_lanes = pm.shape[1]
 
@@ -417,7 +417,11 @@ def _build_ppr_batch(n_out: int, max_iterations: int, precision: str,
         x, _done, err, iters, _it = jax.lax.while_loop(cond, body, carry0)
         return x, err, iters
 
-    return jax.jit(run)
+    # the warm-start seed matrix is donated back to the (n_pad, B)
+    # iterate — the serving plane builds a fresh x0 per batch, so the
+    # seed never needs to outlive the call (cold runs pass x0=None:
+    # nothing to donate, pm doubles as the start AND the restart vector)
+    return jax.jit(run, donate_argnums=(2,))
 
 
 def personalized_pagerank_batch(graph: DeviceGraph, source_sets,
@@ -476,14 +480,16 @@ def personalized_pagerank_batch(graph: DeviceGraph, source_sets,
               "w": graph.csc_weights,
               "csr_src": graph.src_idx, "csr_w": graph.weights,
               "personalization": jnp.asarray(pm)}
-    if warm:
-        arrays["x0"] = jnp.asarray(x0)
     with S.backend_extent("segment", record_iterate=True):
         x, err, iters = fn(arrays, {"n_nodes": np.int32(graph.n_nodes),
                                     "damping": np.float32(damping),
-                                    "tol": np.float32(tol)})
+                                    "tol": np.float32(tol)},
+                           jnp.asarray(x0) if warm else None)
     if raw:
-        return x, np.asarray(err)[:n_req], np.asarray(iters)[:n_req]
+        # DEVICE handles (padding lanes included for x): the serving
+        # plane fuses its epilogues (top-k) and pays ONE host transfer
+        # for the whole batch — err/iters ride that same device_get
+        return x, err, iters
     ranks = np.asarray(x)[: graph.n_nodes, :n_req].T
     return (ranks, np.asarray(err)[:n_req], np.asarray(iters)[:n_req])
 
@@ -491,13 +497,15 @@ def personalized_pagerank_batch(graph: DeviceGraph, source_sets,
 _PPR_TOPK_CACHE: dict = {}
 
 
-def ppr_topk(ranks_matrix, n_nodes: int, k: int):
+def ppr_topk(ranks_matrix, n_nodes: int, k: int, raw: bool = False):
     """Per-lane top-k over a (B, n) rank matrix ON DEVICE — the serving
     plane extracts each request's answer before the reply ships, so a
     top-10 query never pays an O(n) result transfer per rider beyond
     the batch's own cache fill.
 
-    Returns (values (B, k), indices (B, k)) as host arrays."""
+    Returns (values (B, k), indices (B, k)) as host arrays, or as
+    DEVICE handles with ``raw=True`` so the serving plane can fold them
+    into its one fused result transfer per batch (mglint MG009)."""
     import jax
     m = jnp.asarray(ranks_matrix)[:, :n_nodes]
     k = max(1, min(int(k), int(n_nodes)))
@@ -506,4 +514,6 @@ def ppr_topk(ranks_matrix, n_nodes: int, k: int):
         fn = _PPR_TOPK_CACHE[k] = jax.jit(
             partial(jax.lax.top_k, k=k))
     vals, idx = fn(m)
-    return np.asarray(vals), np.asarray(idx)
+    if raw:
+        return vals, idx
+    return np.asarray(vals), np.asarray(idx)  # mglint: disable=MG009 — host-array return contract for direct callers; the serving plane passes raw=True and folds these into its one fused device_get per chunk

@@ -355,7 +355,10 @@ def _build_fixpoint(sr, *, epilogue, setup, step, n_out, max_iterations,
 
         return jax.lax.while_loop(cond, body, (x, m0, jnp.int32(0)))
 
-    return jax.jit(run)
+    # the x0 seed is donated back to the iterate: callers pass freshly
+    # built start vectors (or None, which donates nothing), so the
+    # fixpoint carry never holds two live copies of the O(n) state
+    return jax.jit(run, donate_argnums=(2,))
 
 
 def fixpoint(sr, *, arrays, params=None, x0=None, n_out: int, epilogue,

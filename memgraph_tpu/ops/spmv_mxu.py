@@ -760,6 +760,13 @@ def make_semiring_kernel(plan: MXUPlan, epilogue, route_dtype=None,
             return run_impl_default(blob_dev, params, max_iterations, tol)
         return run_impl(blob_dev, x0, params, max_iterations, tol)
 
+    # mgxla contract-checker hooks: the inner jitted programs + the
+    # device blob, so tools/mgxla can abstractly .lower() the compiled
+    # artifact (f64 / host-callback / collective contracts) without
+    # executing a matvec
+    run.jitted = run_impl
+    run.jitted_default = run_impl_default
+    run.blob = blob_dev
     return run
 
 

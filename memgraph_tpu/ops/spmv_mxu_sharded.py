@@ -324,8 +324,19 @@ def pagerank_mxu_sharded(src, dst, weights, n_nodes, mesh,
     n_shards = int(mesh.shape[axis_name])
     if plan is None:
         plan = build_sharded_plan(src, dst, weights, n_nodes, n_shards)
-    run = make_sharded_pagerank_kernel(plan, mesh, axis_name,
-                                       route_dtype=route_dtype)
+    # the compiled kernel caches on the plan: rebuilding it per CALL
+    # retraced + recompiled the whole sharded program every invocation
+    # (mglint MG008 recompile-hazard)
+    cache = getattr(plan, "_kernel_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(plan, "_kernel_cache", cache)
+    key = (axis_name, tuple(d.id for d in mesh.devices.flat),
+           None if route_dtype is None else str(route_dtype))
+    run = cache.get(key)
+    if run is None:
+        run = cache[key] = make_sharded_pagerank_kernel(
+            plan, mesh, axis_name, route_dtype=route_dtype)
     node_flat = plan.G * SG_ROWS * LANES
     rank0 = np.zeros(node_flat, dtype=np.float32)
     rank0[plan.out_relabel] = 1.0 / plan.n_nodes

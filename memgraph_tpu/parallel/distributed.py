@@ -142,11 +142,29 @@ def _pagerank_sharded_fn(mesh: Mesh, axis: str, n_pad: int,
         out_specs=(P(), P(), P()))
 
 
+#: compiled legacy sharded kernels keyed by (kind, devices, shapes) —
+#: re-jitting the builder closure per call silently retraced + recompiled
+#: on EVERY invocation (mglint MG008 recompile-hazard; the partition-
+#: centric kernels already cache through _pc_cached)
+_SHARDED_JIT_CACHE: dict = {}
+
+
+def _sharded_jit(kind: str, builder_fn, mesh: Mesh, axis: str,
+                 *shape_key, donate: tuple = ()):
+    key = (kind, tuple(d.id for d in mesh.devices.flat), axis,
+           shape_key, donate)
+    fn = _SHARDED_JIT_CACHE.get(key)
+    if fn is None:
+        fn = _SHARDED_JIT_CACHE[key] = jax.jit(
+            builder_fn(mesh, axis, *shape_key), donate_argnums=donate)
+    return fn
+
+
 def pagerank_sharded(sg: ShardedGraph, damping: float = 0.85,
                      max_iterations: int = 100, tol: float = 1e-6):
     """Distributed PageRank over the mesh. Returns (ranks[:n], err, iters)."""
-    fn = jax.jit(_pagerank_sharded_fn(sg.mesh, sg.axis, sg.n_pad,
-                                      max_iterations))
+    fn = _sharded_jit("pagerank", _pagerank_sharded_fn, sg.mesh, sg.axis,
+                      sg.n_pad, max_iterations)
     rank, err, iters = fn(sg.src, sg.dst, sg.weights,
                           jnp.int32(sg.n_nodes), jnp.float32(damping),
                           jnp.float32(tol))
@@ -259,8 +277,8 @@ def pagerank_sharded_15d(sg: ShardedGraph, damping: float = 0.85,
                          max_iterations: int = 100, tol: float = 1e-6):
     """Memory-scalable distributed PageRank (use shard_graph_by_src)."""
     n_shards = sg.mesh.shape[sg.axis]
-    fn = jax.jit(_pagerank_15d_fn(sg.mesh, sg.axis, sg.n_pad, n_shards,
-                                  max_iterations))
+    fn = _sharded_jit("pagerank_15d", _pagerank_15d_fn, sg.mesh, sg.axis,
+                      sg.n_pad, n_shards, max_iterations)
     rank, err, iters = fn(sg.src, sg.dst, sg.weights,
                           jnp.int32(sg.n_nodes), jnp.float32(damping),
                           jnp.float32(tol))
@@ -313,8 +331,10 @@ def sssp_sharded(sg: ShardedGraph, source: int,
     real = jnp.arange(sg.e_pad) < sg.n_edges
     w = jnp.where(real, sg.weights, _INF)
     w = jax.device_put(w, NamedSharding(sg.mesh, P(sg.axis)))
-    fn = jax.jit(_min_propagate_sharded_fn(sg.mesh, sg.axis, sg.n_pad,
-                                           max_iterations, False, False))
+    # init is freshly built per call: donate it back to the iterate
+    fn = _sharded_jit("min_propagate", _min_propagate_sharded_fn,
+                      sg.mesh, sg.axis, sg.n_pad, max_iterations,
+                      False, False, donate=(3,))
     dist, iters = fn(sg.src, sg.dst, w, init)
     out = dist[:sg.n_nodes]
     return jnp.where(out >= _INF / 2, jnp.inf, out), int(iters)
@@ -354,7 +374,8 @@ def _wcc_sharded_fn(mesh: Mesh, axis: str, n_pad: int, max_iterations: int):
 def wcc_sharded(sg: ShardedGraph, max_iterations: int = 200):
     """Distributed weakly-connected components (min-label + pointer jump)."""
     init = jnp.arange(sg.n_pad, dtype=jnp.int32)
-    fn = jax.jit(_wcc_sharded_fn(sg.mesh, sg.axis, sg.n_pad, max_iterations))
+    fn = _sharded_jit("wcc", _wcc_sharded_fn, sg.mesh, sg.axis,
+                      sg.n_pad, max_iterations, donate=(2,))
     comp, iters = fn(sg.src, sg.dst, init)
     return comp[:sg.n_nodes], int(iters)
 
@@ -456,10 +477,15 @@ def _pc_pagerank_build(ctx: MeshContext, block: int, n_shards: int,
     Pr = P()
     Pe = P(axis, None)
     Pv = P(axis)
+    # the chunk carry (rank, local-err lanes, trailing error, iteration
+    # counter) is donated: each chunk consumes the previous chunk's
+    # output, so donation halves the iterate's HBM residency and the
+    # checkpoint layer's host copies are taken from OUTPUTS, never from
+    # donated inputs (parallel/checkpoint.run_resumable)
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
         in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pv, Pv, Pr, Pr, Pr),
-        out_specs=(Pv, Pv, Pr, Pr)))
+        out_specs=(Pv, Pv, Pr, Pr)), donate_argnums=(6, 7, 8, 9))
 
 
 _PC_KERNEL_CACHE: dict = {}
@@ -585,10 +611,11 @@ def _pc_katz_build(ctx: MeshContext, block: int, n_shards: int,
 
     Pr = P()
     Pe = P(axis, None)
+    # carry (x, err, it) donated — see _pc_pagerank_build
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
         in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr, Pr, Pr, Pr),
-        out_specs=(Pr, Pr, Pr)))
+        out_specs=(Pr, Pr, Pr)), donate_argnums=(7, 8, 9))
 
 
 def _katz_normalize(x):
@@ -700,10 +727,11 @@ def _pc_labelprop_build(ctx: MeshContext, block: int, n_shards: int,
 
     Pr = P()
     Pe = P(axis, None)
+    # carry (labels, changed, it) donated — see _pc_pagerank_build
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
         in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr),
-        out_specs=(Pr, Pr, Pr)))
+        out_specs=(Pr, Pr, Pr)), donate_argnums=(4, 5, 6))
 
 
 def labelprop_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
@@ -768,10 +796,11 @@ def _pc_wcc_build(ctx: MeshContext, block: int, n_shards: int):
 
     Pr = P()
     Pe = P(axis, None)
+    # carry (comp, changed, it) donated — see _pc_pagerank_build
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
         in_specs=(Pe, Pe, Pr, Pr, Pr, Pr),
-        out_specs=(Pr, Pr, Pr)))
+        out_specs=(Pr, Pr, Pr)), donate_argnums=(2, 3, 4))
 
 
 def wcc_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
@@ -851,10 +880,11 @@ def _pc_semiring_build(ctx: MeshContext, block: int, n_shards: int,
 
     Pr = P()
     Pe = P(axis, None)
+    # carry (x, m, it) donated — see _pc_pagerank_build
     return jax.jit(shard_map(
         step, mesh=ctx.mesh,
         in_specs=(Pe, Pe, Pe, Pr, Pr, Pr, Pr, Pr),
-        out_specs=(Pr, Pr, Pr)))
+        out_specs=(Pr, Pr, Pr)), donate_argnums=(4, 5, 6))
 
 
 def semiring_partition_centric(scsr: ShardedCSR, ctx: MeshContext,

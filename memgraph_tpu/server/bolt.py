@@ -732,11 +732,35 @@ class BoltServer:
             await self._server.serve_forever()
 
     def stop(self) -> None:
-        """Release the worker pool (and the listener if still open)."""
+        """Release the worker pool (and the listener if still open).
+
+        `asyncio.Server.close()` is not thread-safe: calling it from a
+        foreign thread races the loop thread's own `_wakeup` (a client
+        disconnect closing the last transport) and dies with
+        `TypeError: 'NoneType' object is not iterable`. When the
+        server's loop is still running, the close is marshalled onto it
+        with `call_soon_threadsafe`; a close that loses the race to an
+        already-completed shutdown is logged and ignored."""
         if self._executor is not None:
             self._executor.shutdown(wait=False, cancel_futures=True)
-        if self._server is not None:
-            self._server.close()
+        srv = self._server
+        if srv is None:
+            return
+
+        def _close():
+            try:
+                srv.close()
+            except (RuntimeError, TypeError) as e:
+                log.debug("bolt: listener already closing: %s", e)
+
+        try:
+            loop = srv.get_loop()
+        except (RuntimeError, AttributeError):
+            loop = None
+        if loop is not None and loop.is_running() and not loop.is_closed():
+            loop.call_soon_threadsafe(_close)
+        else:
+            _close()
 
     def run_in_thread(self):
         """Start the server on a background thread; returns (thread, loop).

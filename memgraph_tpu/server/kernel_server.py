@@ -797,7 +797,7 @@ class PprServingPlane:
                         s = source_sets[lane]
                         x0[s, lane] = np.float32(1.0) \
                             / np.float32(len(s))
-            x_dev, errs, iters = personalized_pagerank_batch(
+            x_dev, err_dev, iter_dev = personalized_pagerank_batch(
                 g, source_sets, damping=damping,
                 max_iterations=max_iterations, tol=tol,
                 precision=precision, x0=x0, raw=True)
@@ -807,10 +807,19 @@ class PprServingPlane:
             k_max = max((int(m.header.get("top_k") or 0) for m in chunk),
                         default=0)
             tvals = tidx = None
+            device_out = [x_dev, err_dev, iter_dev]
             if k_max > 0:
-                tvals, tidx = ppr_topk(x_dev.T[:len(chunk)],
-                                       g.n_nodes, k_max)
-            ranks = np.asarray(x_dev)[:g.n_nodes, :len(chunk)].T
+                device_out += list(ppr_topk(x_dev.T[:len(chunk)],
+                                            g.n_nodes, k_max, raw=True))
+            # THE one fused host sync per chunk: every device output of
+            # the batch (iterate, per-lane err/iters, top-k) crosses in
+            # a single device_get instead of one transfer per epilogue
+            import jax
+            host = jax.device_get(device_out)  # mglint: disable=MG009 — replies must ship host bytes; this IS the single fused result transfer the drain loop pays per chunk
+            x_host, errs, iters = host[0], host[1], host[2]
+            if k_max > 0:
+                tvals, tidx = host[3], host[4]
+            ranks = x_host[:g.n_nodes, :len(chunk)].T
             warm_set = set(warm_lanes)
             for lane, m in enumerate(chunk):
                 vec = np.ascontiguousarray(ranks[lane])

@@ -19,6 +19,41 @@ import os
 log = logging.getLogger(__name__)
 
 _done = False
+_compile_listener = False
+
+
+def install_compile_counter() -> bool:
+    """Runtime witness for the mgxla static compile budget: every XLA
+    backend compile in this process bumps the ``jit.compile_total``
+    counter (exported through SHOW METRICS INFO / ``GET /stats``), so a
+    silent recompile storm — the exact hazard mglint MG008 and the
+    lane-bucket contract check guard statically — shows up as a moving
+    counter in production. Idempotent; riding ``jax.monitoring``'s
+    backend-compile duration event keeps it zero-cost when nothing
+    compiles."""
+    global _compile_listener
+    if _compile_listener:
+        return True
+    try:
+        from jax import monitoring
+    except Exception as e:  # noqa: BLE001 — the witness is optional
+        log.info("jax.monitoring unavailable; jit.compile_total "
+                 "disabled: %s", e)
+        return False
+
+    def _on_duration(event: str, duration: float = 0.0, **kw) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            from ..observability.metrics import global_metrics
+            global_metrics.increment("jit.compile_total")
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception as e:  # noqa: BLE001 — the witness is optional
+        log.info("could not register compile listener; "
+                 "jit.compile_total disabled: %s", e)
+        return False
+    _compile_listener = True
+    return True
 
 
 def default_cache_dir() -> str:
@@ -41,6 +76,9 @@ def ensure_compile_cache() -> bool:
     MEMGRAPH_TPU_COMPILE_CACHE=0.
     """
     global _done
+    # the compile-count witness installs even when the persistent cache
+    # is opted out — budget observability must not depend on caching
+    install_compile_counter()
     if _done:
         return True
     if os.environ.get("MEMGRAPH_TPU_COMPILE_CACHE", "1") == "0":

@@ -1,0 +1,43 @@
+"""MG010 fixture: jitted while_loop fixpoints without donation.
+
+Never imported; scanned by tests/test_mglint.py. All jit applications
+are module-level so MG008's per-call check stays silent here.
+"""
+from functools import partial
+
+import jax
+
+
+def _step_loop(x, n):
+    def body(c):
+        return c * 2.0
+
+    def cond(c):
+        return c.sum() < n
+
+    return jax.lax.while_loop(cond, body, x)
+
+
+@jax.jit
+def undonated_fixpoint(x, n):       # MG010: while_loop, no donation
+    return _step_loop(x, n)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def donated_fixpoint(x, n):         # donated: silent
+    return _step_loop(x, n)
+
+
+@jax.jit
+def no_loop_is_silent(x):
+    return x + 1
+
+
+def _wrap(fn):
+    return fn
+
+
+undonated_wrapped = jax.jit(_wrap(_step_loop))    # MG010 via wrapper
+donated_wrapped = jax.jit(_wrap(_step_loop), donate_argnums=(0,))
+
+suppressed_fixpoint = jax.jit(_step_loop)  # mglint: disable=MG010 — fixture: deliberate

@@ -1,0 +1,30 @@
+"""MG009 fixture: host syncs on device values in the PPR batch path.
+
+Never imported; scanned by tests/test_mglint.py. The class/method names
+mirror the real serving plane so the rule's hot-root resolution treats
+this file exactly like server/kernel_server.py.
+"""
+import numpy as np
+
+
+def personalized_pagerank_batch(g, sets):
+    return g, sets, sets
+
+
+class PprServingPlane:
+    def _compute(self, g, members):
+        x_dev, errs, iters = personalized_pagerank_batch(g, members)
+        ranks = np.asarray(x_dev)       # MG009: sync on device value
+        first = errs.item()             # MG009: .item() always syncs
+        wire = members[0]
+        sources = np.asarray(wire)      # host bytes: silent
+        host = np.asarray(ranks)        # post-sync value: silent
+        return ranks, first, sources, host
+
+    def _run(self, g, members):
+        x_dev, _e, _i = personalized_pagerank_batch(g, members)
+        return np.asarray(x_dev)  # mglint: disable=MG009 — fixture: the one deliberate reply transfer
+
+    def cold_path(self, members):
+        # not a hot root and not reachable from one: silent
+        return np.asarray(members)

@@ -170,6 +170,50 @@ def test_mg007_fires_on_split_regions_only():
     assert result.suppressed_count == 1   # Registry.suppressed_split
 
 
+def test_mg008_fires_on_recompile_hazards_only():
+    result = _run(["tests/lint_fixtures"], only={"MG008"})
+    hits = _hits(result, "MG008")
+    assert ("mg008_recompile.py", 19) in hits   # per-call jit
+    assert ("mg008_recompile.py", 37) in hits   # traced branch
+    assert ("mg008_recompile.py", 52) in hits   # unhashable static
+    # the cached builder, structural branches (is None / .ndim) and the
+    # hashable static stay silent; the suppressed rebuild counts
+    assert len([h for h in hits
+                if h[0] == "mg008_recompile.py"]) == 3, hits
+    assert all(p == "mg008_recompile.py" for p, _l in hits), hits
+
+
+def test_mg009_fires_on_hot_path_syncs_only():
+    result = _run(["tests/lint_fixtures"], only={"MG009"})
+    hits = _hits(result, "MG009")
+    assert ("mg009_host_sync.py", 17) in hits   # np.asarray on device
+    assert ("mg009_host_sync.py", 18) in hits   # .item() sync
+    # wire bytes, the post-sync host value, the non-hot cold_path and
+    # the suppressed reply transfer stay silent
+    assert len(hits) == 2, hits
+    assert result.suppressed_count == 1
+
+
+def test_mg010_fires_on_missing_donation_only():
+    result = _run(["tests/lint_fixtures"], only={"MG010"})
+    hits = _hits(result, "MG010")
+    assert ("mg010_donation.py", 21) in hits    # decorator form
+    assert ("mg010_donation.py", 40) in hits    # wrapper call form
+    # donated variants, the loop-free jit and the suppressed one silent
+    assert len(hits) == 2, hits
+    assert result.suppressed_count == 1
+
+
+def test_new_rules_are_registered_in_catalog():
+    from tools.mglint import rules as _rules  # noqa: F401
+    from tools.mglint.registry import RULES
+    for rule_id in ("MG008", "MG009", "MG010"):
+        assert rule_id in RULES
+    assert RULES["MG008"].name == "recompile-hazard"
+    assert RULES["MG009"].name == "host-sync-in-hot-path"
+    assert RULES["MG010"].name == "missing-donation"
+
+
 def test_suppression_comment_scopes_to_one_handler():
     # remove the suppression and the second handler must fire too
     path = os.path.join(FIXTURES, "mg003_swallowed.py")

@@ -1,0 +1,294 @@
+"""mgxla: device-plane static analysis — contract checker tests.
+
+The full-manifest sweep (every SPMV_ALGORITHMS entry, all three
+backends, every PPR lane bucket) runs in the dev gate via
+`python -m tools.mgxla check`; tier-1 covers the checker's MACHINERY:
+contract pass/fail verdicts on real kernels, the HLO fact extractor,
+manifest round-trip, baseline honesty (unused entries fail), the
+lane-bucket budget, registry coverage, and a deliberately-broken
+two-collective kernel being caught.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.mgxla import hlo  # noqa: E402
+from tools.mgxla import checker, manifest  # noqa: E402
+from tools.mgxla.manifest import (MANIFEST, KernelContract,  # noqa: E402
+                                  contract_from_dict)
+
+
+# --------------------------------------------------------------------------
+# HLO fact extraction
+# --------------------------------------------------------------------------
+
+
+_SYNTH = """\
+HloModule jit_f, is_scheduled=true, input_output_alias={ {0}: (6, {}, \
+may-alias), {1}: (7, {}, may-alias) }, entry_computation_layout=...
+
+%wide.body (p: (f32[8], s32[])) -> (f32[8], s32[]) {
+  %ar = f32[8]{0} all-reduce(f32[8]{0} %x), to_apply=%add
+  %f = f32[8]{0} fusion(f32[8]{0} %ar), calls=%fused_thing
+  ROOT %t = tuple(%f)
+}
+
+%fused_thing (q: f32[8]) -> f32[8] {
+  %rs = f32[1]{0} reduce-scatter(f32[8]{0} %q), dimensions={0}
+  ROOT %r = f32[8]{0} broadcast(f32[1]{0} %rs)
+}
+
+ENTRY %main (a: f32[8]) -> f32[8] {
+  %w = (f32[8]{0}, s32[]) while((f32[8]{0}, s32[]) %init), \
+condition=%cond, body=%wide.body
+  %dead = f64[4]{0} constant({1, 2, 3, 4})
+  %cb = (f32[4]{0}) custom-call(f32[4]{0} %a), \
+custom_call_target="xla_python_cpu_callback"
+  ROOT %out = f32[8]{0} get-tuple-element((f32[8]{0}, s32[]) %w), index=0
+}
+"""
+
+
+def test_hlo_facts_on_synthetic_text():
+    facts = hlo.analyze(_SYNTH)
+    assert facts.collectives == ["all-reduce", "reduce-scatter"]
+    # the reduce-scatter hides inside a fusion CALLED from the while
+    # body: transitive attribution must find both
+    assert facts.while_collectives == ["all-reduce", "reduce-scatter"]
+    assert facts.donated == {6, 7}
+    assert len(facts.f64) == 1 and "f64[4]" in facts.f64[0]
+    assert len(facts.callbacks) == 1 and "custom-call" in facts.callbacks[0]
+
+
+def test_hlo_operand_references_do_not_count_as_collectives():
+    text = ("ENTRY %m (a: f32[4]) -> f32[4] {\n"
+            "  %f = f32[4]{0} fusion(f32[4]{0} %all-reduce.2)\n"
+            "  ROOT %r = f32[4]{0} add(f32[4]{0} %f, f32[4]{0} %f)\n"
+            "}\n")
+    assert hlo.collectives(text) == []
+
+
+def test_donated_params_empty_without_alias():
+    assert hlo.donated_params("HloModule jit_f, is_scheduled=true\n") \
+        == set()
+
+
+# --------------------------------------------------------------------------
+# contract verdicts on real kernels
+# --------------------------------------------------------------------------
+
+
+def test_mesh_katz_contract_passes():
+    assert checker.check_kernel_by_id("mesh:katz") == []
+
+
+def test_segment_pagerank_contract_passes():
+    assert checker.check_kernel_by_id("segment:pagerank") == []
+
+
+def test_ppr_bucket_contract_passes():
+    assert checker.check_kernel_by_id("segment:ppr_batch:b4") == []
+
+
+def test_warm_ppr_bucket_donates_its_seed():
+    assert checker.check_kernel_by_id("segment:ppr_batch:warm8") == []
+
+
+def test_broken_two_collective_kernel_is_caught():
+    """A kernel with TWO collectives per iteration must fail a
+    one-collective contract with the offending HLO in the violation."""
+    from jax.sharding import PartitionSpec as P
+    from memgraph_tpu.parallel.mesh import get_mesh_context, shard_map_fn
+    ctx = get_mesh_context(8)
+    shard_map = shard_map_fn()
+
+    def step(x, it_stop):
+        def body(carry):
+            v, it = carry
+            acc = jax.lax.psum(v, ctx.axis)          # collective 1
+            peak = jax.lax.pmax(jnp.sum(v), ctx.axis)  # collective 2
+            return acc / jnp.maximum(peak, 1.0), it + 1
+
+        def cond(carry):
+            return carry[1] < it_stop
+
+        return jax.lax.while_loop(cond, body, (x, jnp.int32(0)))
+
+    fn = jax.jit(shard_map(step, mesh=ctx.mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P())))
+    text = fn.lower(jax.ShapeDtypeStruct((64,), jnp.float32),
+                    jax.ShapeDtypeStruct((), jnp.int32)) \
+        .compile().as_text()
+    contract = KernelContract(kernel="test:broken", backend="mesh",
+                              collectives=("all-reduce",))
+    violations = checker.check_text(contract, text)
+    assert len(violations) == 1
+    v = violations[0]
+    assert v.check == "collectives"
+    assert "all-reduce,all-reduce" in v.detail
+    assert v.snippet, "violation must carry the offending HLO snippet"
+
+
+def test_donation_violation_when_contract_demands_it():
+    """A kernel compiled without aliasing fails a min_donated contract."""
+    text = "HloModule jit_x, is_scheduled=true\n"
+    contract = KernelContract(kernel="test:nodonate", backend="segment",
+                              min_donated=2, iterates=False)
+    violations = checker.check_text(contract, text)
+    assert [v.check for v in violations] == ["donation"]
+    assert "donated=0 < min=2" in violations[0].detail
+
+
+def test_f64_and_callback_violations():
+    contract = KernelContract(kernel="test:dirty", backend="segment",
+                              collectives=("all-reduce",
+                                           "reduce-scatter"),
+                              min_donated=2)
+    checks = {v.check for v in checker.check_text(contract, _SYNTH)}
+    assert checks == {"f64", "host-callback"}
+
+
+# --------------------------------------------------------------------------
+# manifest + baseline honesty
+# --------------------------------------------------------------------------
+
+
+def test_manifest_round_trips_through_dicts():
+    for kernel, contract in MANIFEST.items():
+        doc = json.loads(json.dumps(contract.as_dict()))
+        assert contract_from_dict(doc) == contract, kernel
+
+
+def test_every_manifest_kernel_has_a_builder():
+    missing = sorted(set(MANIFEST) - set(checker.BUILDERS))
+    assert not missing, f"manifest kernels without builders: {missing}"
+
+
+def test_registry_coverage_is_complete():
+    assert checker.check_coverage() == []
+
+
+def test_lane_bucket_budget_holds():
+    assert checker.check_lane_buckets() == []
+
+
+def test_unused_baseline_entry_fails(monkeypatch):
+    tiny = {"segment:gnn": MANIFEST["segment:gnn"]}
+    monkeypatch.setattr(manifest, "MANIFEST", tiny)
+    monkeypatch.setattr(checker, "MANIFEST", tiny)
+    report = checker.run_check(
+        baseline={"mesh:bogus:collectives:gone": "stale entry"},
+        structural=False)
+    assert not report.ok
+    assert report.unused_baseline == ["mesh:bogus:collectives:gone"]
+    assert "UNUSED" in report.render()
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(
+        {"entries": [{"key": "a:b:c", "justification": ""}]}))
+    with pytest.raises(ValueError):
+        manifest.load_baseline(str(p))
+
+
+def test_baselined_violation_reported_not_fatal(monkeypatch):
+    tiny = {"segment:gnn": MANIFEST["segment:gnn"]}
+    monkeypatch.setattr(manifest, "MANIFEST", tiny)
+    monkeypatch.setattr(checker, "MANIFEST", tiny)
+
+    def fake_builder(kernel):
+        return _SYNTH       # f64 + callback violations
+
+    monkeypatch.setitem(checker.BUILDERS, "segment:gnn", fake_builder)
+    contract = KernelContract(kernel="segment:gnn", backend="segment",
+                              collectives=("all-reduce",
+                                           "reduce-scatter"),
+                              min_donated=2)
+    monkeypatch.setitem(tiny, "segment:gnn", contract)
+    found = checker.run_check(baseline={}, structural=False)
+    keys = {v.key for v in found.violations}
+    report = checker.run_check(
+        baseline={k: "deliberate for this test" for k in keys},
+        structural=False)
+    assert report.ok and len(report.baselined) == len(keys)
+
+
+# --------------------------------------------------------------------------
+# runtime witness: jit.compile_total
+# --------------------------------------------------------------------------
+
+
+def test_compile_counter_moves_on_fresh_compile():
+    from memgraph_tpu.observability.metrics import STAT_NAMES, \
+        global_metrics
+    from memgraph_tpu.utils.jax_cache import install_compile_counter
+    assert "jit.compile_total" in STAT_NAMES
+    if not install_compile_counter():
+        pytest.skip("jax.monitoring unavailable")
+
+    def probe(v):
+        return (v * 3.25 + 1.5).sum()
+
+    def count():
+        return dict(
+            (n, v) for n, _k, v in global_metrics.snapshot()
+        ).get("jit.compile_total", 0.0)
+
+    before = count()
+    # a fresh closure + unusual shape forces a real backend compile
+    jax.jit(probe)(jnp.ones((17, 3)))
+    assert count() > before
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def _cli(*args):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mgxla", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=300, env=env)
+
+
+def test_cli_list():
+    proc = _cli("list", "--json")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert "mesh:pagerank" in doc
+    assert doc["mesh:pagerank"]["collectives"] == ["reduce-scatter"]
+
+
+def test_cli_check_single_kernel():
+    proc = _cli("check", "--only", "mesh:wcc", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] and doc["violations"] == []
+
+
+def test_cli_rejects_unknown_kernel():
+    proc = _cli("check", "--only", "mesh:nope")
+    assert proc.returncode == 2
+
+
+@pytest.mark.slow
+def test_cli_full_manifest_clean():
+    """The gate stage, as a slow-marked test: the WHOLE manifest —
+    every registry entry, all three backends, every lane bucket —
+    lowers clean with zero unbaselined contract violations."""
+    proc = _cli("check")
+    assert proc.returncode == 0, proc.stdout + proc.stderr

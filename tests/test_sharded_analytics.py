@@ -15,8 +15,6 @@ acceptance criteria:
   * the shard_map version-gate warns once, not per call site.
 """
 
-import re
-
 import numpy as np
 import pytest
 
@@ -265,61 +263,50 @@ def test_mesh_rejects_int8(graph, ctx1):
 # --------------------------------------------------------------------------
 # the one-collective-per-iteration invariant (compiled-HLO assertion)
 # --------------------------------------------------------------------------
+# r17: ONE source of truth — the mgxla contract checker (tools/mgxla)
+# abstractly lowers every mesh kernel over the forced 8-device mesh and
+# asserts the EXACT collective multiset, its location inside the while
+# body, zero f64 ops, zero host callbacks, and donation of the chunk
+# carry. These tests assert the checker's verdict instead of carrying
+# their own regexes; `python -m tools.mgxla check` runs the same
+# contracts over the full manifest in the dev gate.
 
-_COLLECTIVE_RE = re.compile(
-    r"=\s+\S+\s+(all-reduce|reduce-scatter|all-gather|"
-    r"collective-permute|all-to-all)\(")
+from tools.mgxla import checker as mgxla_checker
 
 
-def _collectives(compiled_text: str) -> list:
-    return _COLLECTIVE_RE.findall(compiled_text)
+def _assert_contract(kernel: str):
+    violations = mgxla_checker.check_kernel_by_id(kernel)
+    assert not violations, "\n".join(v.render() for v in violations)
 
 
-def test_pagerank_exactly_one_collective_per_iteration(graph, ctx8):
+def test_pagerank_exactly_one_collective_per_iteration():
     """The WHOLE compiled CHUNK program contains exactly one
     cross-device collective — the fused psum_scatter inside the while
     body. Setup (out-weights, dangling mask), the convergence check AND
-    the r12 chunk-carry plumbing (checkpoint/resume) add none."""
-    from memgraph_tpu.parallel.distributed import _pc_pagerank_build
-    scsr = csr.shard_csr(graph, ctx8)
-    fn = _pc_pagerank_build(ctx8, scsr.block, scsr.n_shards)
-    rank0 = np.zeros(scsr.n_pad2, dtype=np.float32)
-    lerr0 = np.zeros(scsr.n_shards, dtype=np.float32)
-    txt = fn.lower(scsr.src, scsr.dst, scsr.weights,
-                   jnp.int32(scsr.n_nodes), jnp.float32(0.85),
-                   jnp.float32(1e-6), rank0, lerr0,
-                   jnp.float32(np.inf), jnp.int32(0),
-                   jnp.int32(100)).compile().as_text()
-    colls = _collectives(txt)
-    assert colls == ["reduce-scatter"], (
-        f"expected exactly one reduce-scatter, got {colls}")
-    # and it sits inside the power-iteration while body
-    assert re.search(r"while/body.*reduce_scatter|reduce_scatter.*"
-                     r"while", txt, re.DOTALL)
+    the r12 chunk-carry plumbing (checkpoint/resume) add none. The
+    carry is donated (r17)."""
+    _assert_contract("mesh:pagerank")
 
 
-def test_katz_exactly_one_collective_per_iteration(graph, ctx8):
-    from memgraph_tpu.parallel.distributed import _pc_katz_build
-    scsr = csr.shard_csr(graph, ctx8)
-    fn = _pc_katz_build(ctx8, scsr.block, scsr.n_shards)
-    x0 = np.zeros(scsr.n_pad2, dtype=np.float32)
-    txt = fn.lower(scsr.src, scsr.dst, scsr.weights,
-                   jnp.int32(scsr.n_nodes), jnp.float32(0.05),
-                   jnp.float32(1.0), jnp.float32(1e-8),
-                   x0, jnp.float32(np.inf), jnp.int32(0),
-                   jnp.int32(100)).compile().as_text()
-    assert _collectives(txt) == ["all-reduce"]
+def test_pagerank_bf16_keeps_the_collective_contract():
+    _assert_contract("mesh:pagerank_bf16")
 
 
-def test_labelprop_exactly_one_collective_per_round(graph, ctx8):
-    from memgraph_tpu.parallel.distributed import _pc_labelprop_build
-    scsr = csr.shard_csr(graph, ctx8, by="dst", doubled=True)
-    fn = _pc_labelprop_build(ctx8, scsr.block, scsr.n_shards, scsr.per)
-    labels0 = np.arange(scsr.n_pad2, dtype=np.int32)
-    txt = fn.lower(scsr.src, scsr.dst, scsr.weights, jnp.float32(0.0),
-                   labels0, jnp.bool_(True), jnp.int32(0),
-                   jnp.int32(30)).compile().as_text()
-    assert _collectives(txt) == ["all-reduce"]
+def test_katz_exactly_one_collective_per_iteration():
+    _assert_contract("mesh:katz")
+
+
+def test_labelprop_exactly_one_collective_per_round():
+    _assert_contract("mesh:labelprop")
+
+
+def test_wcc_exactly_one_collective_per_round():
+    _assert_contract("mesh:wcc")
+
+
+def test_generic_semiring_mesh_kernel_contract():
+    """The (semiring, x0, epilogue) mesh kernel sssp_mesh/bfs_mesh ride."""
+    _assert_contract("mesh:semiring_min_plus")
 
 
 # --------------------------------------------------------------------------

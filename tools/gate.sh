@@ -19,10 +19,19 @@ stage() {
     "$@" || { echo "gate: FAILED: $*" >&2; fail=1; }
 }
 
-# 1. static analysis: all mglint rules (MG001-MG007) over the package;
+# 1. static analysis: all mglint rules (MG001-MG010) over the package;
 #    unbaselined findings exit non-zero
 stage "mglint (static analysis)" \
     python -m tools.mglint memgraph_tpu
+
+# 1a. mgxla: compiled-artifact contract checker — every SPMV_ALGORITHMS
+#     entry, all three semiring backends, and every PPR lane bucket
+#     abstractly lowered (nothing executes) over the forced 8-device
+#     mesh; exact collective multiset per iteration body, zero f64 ops,
+#     zero host callbacks, donated fixpoint carries, bounded lane-bucket
+#     compile count. Unbaselined violations exit non-zero.
+stage "mgxla (device-plane contract checker)" \
+    python -m tools.mgxla check
 
 # 1b. mgtrace smoke: one traced query end-to-end (parse → plan →
 #     execute → MVCC commit → mesh-routed device stages), single

@@ -7,3 +7,6 @@ from . import jax_purity  # noqa: F401
 from . import registry_coverage  # noqa: F401
 from . import shared_field  # noqa: F401
 from . import check_then_act  # noqa: F401
+from . import recompile_hazard  # noqa: F401
+from . import host_sync  # noqa: F401
+from . import missing_donation  # noqa: F401

@@ -1,0 +1,223 @@
+"""MG009 — host-sync-in-hot-path: device→host round trips inside the
+serving/fixpoint hot paths.
+
+A ``.item()``, ``np.asarray(...)``, ``jax.device_get(...)`` or
+``block_until_ready()`` on a DEVICE value blocks the calling thread
+until the device drains — inside the semiring fixpoint, the
+kernel-server dispatch loop, or the PPR batch drain loop that turns an
+async pipelined plane into a lock-step one (the r16 batch-extract at
+``server/kernel_server.py`` was the motivating case: four separate
+syncs per chunk where one fused ``device_get`` suffices).
+
+Hot roots (path-component + qualname suffix) and everything reachable
+from them through same-module calls plus project-unique cross-module
+names:
+
+  * ``ops/semiring.py``: ``fixpoint``, ``mxu_fixpoint``
+  * ``server/kernel_server.py``: the PPR serving plane's ``_run`` /
+    ``_execute_group`` / ``_compute`` drain path and the supervised
+    ``_supervised`` dispatch
+  * anything they call (``ppr_topk``, ``personalized_pagerank_batch``)
+
+Within a hot function the rule is TAINT-based so host-side numpy work
+stays silent: a name bound from a DEVICE PRODUCER call (a project
+function that returns device values — the configured set below — or a
+jitted local) is device-tainted, taint propagates through subscripts /
+attributes / tuple unpacking, and a sync op applied to a tainted
+expression fires. Syncs on untainted values (wire bytes, cache entries)
+are free. ``.item()`` / ``.block_until_ready()`` / ``.tolist()`` are
+device-sync by construction and fire untainted too.
+
+The ONE deliberate fused result transfer a reply needs carries an
+inline ``# mglint: disable=MG009`` with its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, qualname_of
+from ..locking import dotted
+from ..registry import register
+
+#: (directory component, qualname suffix) hot roots — directory
+#: matching (not exact file) so the rule's TP/TN fixtures under
+#: tests/lint_fixtures/{ops,server}/ exercise the same code path
+HOT_ROOTS = (
+    ("ops/", "fixpoint"),
+    ("ops/", "mxu_fixpoint"),
+    ("server/", "PprServingPlane._run"),
+    ("server/", "PprServingPlane._execute_group"),
+    ("server/", "PprServingPlane._compute"),
+    ("server/", "KernelServer._supervised"),
+)
+
+#: calls whose results are device values (taint sources); jitted
+#: functions discovered per-module are added dynamically
+DEVICE_PRODUCERS = {
+    "personalized_pagerank_batch", "ppr_topk", "spmv", "fixpoint",
+    "edge_reduce", "edge_combine", "device_put",
+}
+
+#: attribute calls that synchronize regardless of taint
+_ALWAYS_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+
+#: call targets that synchronize when applied to a tainted value
+_SYNC_CALLS = {"np.asarray", "np.array", "np.ascontiguousarray",
+               "numpy.asarray", "numpy.array",
+               "numpy.ascontiguousarray", "jax.device_get",
+               "device_get", "float", "int"}
+
+
+def _fn_index(project: Project):
+    """qualname -> (rel, fn node) for every function, with parents."""
+    out: dict[str, list] = {}
+    for rel, sf in project.files.items():
+        sf.ensure_parents()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(node.name, []).append((rel, node))
+    return out
+
+
+def _hot_functions(project: Project):
+    """Resolve hot roots, then close over callees: same-file calls plus
+    cross-module calls whose bare name is unique project-wide."""
+    index = _fn_index(project)
+    hot: dict[tuple, ast.AST] = {}   # (rel, qualname) -> fn
+    work: list[tuple] = []
+    for rel, sf in project.files.items():
+        for dir_part, qn_suffix in HOT_ROOTS:
+            if f"/{dir_part}" not in f"/{rel}":
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qn = qualname_of(node)
+                    if qn == qn_suffix or qn.endswith("." + qn_suffix):
+                        hot[(rel, qn)] = node
+                        work.append((rel, node))
+    seen = {id(fn) for _rel, fn in work}
+    while work:
+        rel, fn = work.pop()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = (dotted(node.func) or "").split(".")[-1]
+            cands = index.get(callee, ())
+            target = None
+            if len(cands) == 1:
+                target = cands[0]
+            else:
+                same = [c for c in cands if c[0] == rel]
+                if len(same) == 1:
+                    target = same[0]
+            if target is not None and id(target[1]) not in seen:
+                seen.add(id(target[1]))
+                hot[(target[0], qualname_of(target[1]))] = target[1]
+                work.append(target)
+    return hot
+
+
+def _jit_locals(fn: ast.AST) -> set[str]:
+    """Local names bound to jax.jit(...) results inside this function."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = (dotted(node.value.func) or "").split(".")[-1]
+            if callee in ("jit", "pjit"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _base_names(expr: ast.AST) -> set[str]:
+    """Root Name ids an expression reads through subscripts/attrs."""
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def _assign_targets(node: ast.Assign) -> list[str]:
+    out = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                if isinstance(el, ast.Name):
+                    out.append(el.id)
+    return out
+
+
+@register("MG009", "host-sync-in-hot-path")
+def check(project: Project):
+    """Host syncs on device values reachable from the hot paths."""
+    findings: list[Finding] = []
+    hot = _hot_functions(project)
+    for (rel, qn), fn in sorted(hot.items(),
+                                key=lambda kv: (kv[0][0], kv[0][1])):
+        producers = DEVICE_PRODUCERS | _jit_locals(fn)
+        tainted: set[str] = set()
+        assigns = [n for n in ast.walk(fn)
+                   if isinstance(n, ast.Assign)]
+
+        def _is_sync_call(v) -> bool:
+            if not isinstance(v, ast.Call):
+                return False
+            full = dotted(v.func) or ""
+            return full in _SYNC_CALLS \
+                or full.split(".")[-1] == "device_get"
+
+        # seed: names bound from device-producer calls
+        for node in assigns:
+            v = node.value
+            if isinstance(v, ast.Call) and not _is_sync_call(v):
+                callee = (dotted(v.func) or "").split(".")[-1]
+                if callee in producers:
+                    tainted.update(_assign_targets(node))
+        # propagate through expressions (subscripts, attrs, tuples,
+        # list wrapping) to a fixpoint; sync-call RESULTS are host
+        # values and never taint
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                if _is_sync_call(node.value):
+                    continue
+                if _base_names(node.value) & tainted:
+                    for t in _assign_targets(node):
+                        if t not in tainted:
+                            tainted.add(t)
+                            changed = True
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            full = dotted(node.func) or ""
+            short = full.split(".")[-1]
+            sync_kind = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _ALWAYS_SYNC_ATTRS:
+                sync_kind = f".{node.func.attr}()"
+            elif full in _SYNC_CALLS or short == "device_get":
+                args_names = set()
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    args_names |= _base_names(a)
+                if args_names & tainted:
+                    sync_kind = f"{full or short}()"
+            if sync_kind is None:
+                continue
+            findings.append(Finding(
+                rule="MG009", path=rel, line=node.lineno,
+                col=getattr(node, "col_offset", 0), symbol=qn,
+                message=f"{sync_kind} host sync on a device value "
+                        f"inside hot path {qn} — fuse into one "
+                        "device_get per batch/chunk or move it off the "
+                        "dispatch thread",
+                fingerprint=f"host-sync:{sync_kind.strip('().')}@{qn}"))
+    return findings

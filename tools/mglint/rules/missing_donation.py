@@ -1,0 +1,145 @@
+"""MG010 — missing-donation: fixpoint-shaped jitted entry points whose
+carry buffers are not donated.
+
+A ``lax.while_loop`` fixpoint holds its iterate in HBM. Without
+``donate_argnums`` the caller's input buffer AND the loop's output
+buffer are live simultaneously — double the HBM residency of every
+O(n)/O(n·B) state vector, which is exactly the headroom the
+admission-controlled serving plane budgets against. Donating the carry
+(the previous chunk's output, a freshly built seed) lets XLA alias
+input to output: before r17 there was not a single ``donate_argnums``
+in the tree.
+
+The rule flags ``jax.jit`` applications — call form, decorator form,
+and ``jax.jit(builder(...))`` where the builder is a same-module
+function — whose jitted computation contains a ``while_loop`` and whose
+jit call carries no ``donate_argnums``/``donate_argnames``. Kernels
+that genuinely cannot donate (every input reused across calls, the host
+loop re-reads the previous iterate, a caller retains the seed) carry a
+justified baseline entry — the decision is recorded either way.
+
+Scope: ``ops/`` and ``parallel/`` (the jitted device plane).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, qualname_of
+from ..locking import dotted
+from ..registry import register
+from .jax_purity import _jit_static_args
+
+_JIT_NAMES = {"jit", "pjit"}
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _in_scope(rel: str) -> bool:
+    return "/ops/" in f"/{rel}" or "/parallel/" in f"/{rel}"
+
+
+def _has_while_loop(fn: ast.AST, funcs: dict | None = None,
+                    _depth: int = 0, _seen: set | None = None) -> bool:
+    """while_loop in this function or (transitively, same module) in
+    anything it calls — the jitted entry often delegates to a `_loop`
+    helper."""
+    if _depth > 4:
+        return False
+    _seen = _seen if _seen is not None else set()
+    if id(fn) in _seen:
+        return False
+    _seen.add(id(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = (dotted(node.func) or "").split(".")[-1]
+            if name == "while_loop":
+                return True
+            callee = (funcs or {}).get(name)
+            if callee is not None and _has_while_loop(
+                    callee, funcs, _depth + 1, _seen):
+                return True
+    return False
+
+
+def _module_funcs(tree: ast.AST) -> dict[str, ast.AST]:
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _jit_target_has_while(arg: ast.AST, funcs: dict) -> bool:
+    """Resolve the jitted computation: a local function name, a
+    builder call returning one, or a lambda/partial — then look for a
+    while_loop in its body."""
+    if isinstance(arg, ast.Name):
+        fn = funcs.get(arg.id)
+        return fn is not None and _has_while_loop(fn, funcs)
+    if isinstance(arg, ast.Call):
+        callee = (dotted(arg.func) or "").split(".")[-1]
+        if callee == "partial" and arg.args:
+            return _jit_target_has_while(arg.args[0], funcs)
+        fn = funcs.get(callee)
+        if fn is not None and _has_while_loop(fn, funcs):
+            return True
+        # wrapper call (shard_map(step, ...), identity wrappers,
+        # functools pipelines): resolve local-function arguments too
+        return any(_jit_target_has_while(a, funcs)
+                   for a in arg.args if isinstance(a, (ast.Name,
+                                                       ast.Lambda)))
+    if isinstance(arg, ast.Lambda):
+        return _has_while_loop(arg)
+    return False
+
+
+@register("MG010", "missing-donation")
+def check(project: Project):
+    """jit-of-while_loop without donate_argnums in ops//parallel/."""
+    findings: list[Finding] = []
+    for rel, sf in sorted(project.files.items()):
+        if not _in_scope(rel):
+            continue
+        sf.ensure_parents()
+        funcs = _module_funcs(sf.tree)
+
+        for node in ast.walk(sf.tree):
+            hit = None        # (line, col, symbol)
+            # decorator form: @jax.jit / @partial(jax.jit, ...)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    is_jit, _static = _jit_static_args(deco)
+                    if not is_jit:
+                        continue
+                    if isinstance(deco, ast.Call) and any(
+                            kw.arg in _DONATE_KWARGS
+                            for kw in deco.keywords):
+                        continue
+                    if _has_while_loop(node, funcs):
+                        hit = (deco.lineno,
+                               getattr(deco, "col_offset", 0),
+                               node.name)
+            # call form: jax.jit(f, ...) / jax.jit(builder(...), ...)
+            elif isinstance(node, ast.Call):
+                name = (dotted(node.func) or "").split(".")[-1]
+                if name not in _JIT_NAMES or not node.args:
+                    continue
+                if any(kw.arg in _DONATE_KWARGS
+                       for kw in node.keywords):
+                    continue
+                if _jit_target_has_while(node.args[0], funcs):
+                    sym = qualname_of(node) or "<module>"
+                    hit = (node.lineno,
+                           getattr(node, "col_offset", 0), sym)
+            if hit is None:
+                continue
+            line, col, sym = hit
+            findings.append(Finding(
+                rule="MG010", path=rel, line=line, col=col, symbol=sym,
+                message=f"jitted fixpoint {sym} iterates a while_loop "
+                        "but donates no inputs — the carry's HBM "
+                        "residency doubles; add donate_argnums for the "
+                        "loop state (or baseline with why donation is "
+                        "illegal here)",
+                fingerprint=f"missing-donation@{sym}"))
+    return findings

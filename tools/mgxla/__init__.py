@@ -1,0 +1,20 @@
+"""mgxla: device-plane static analysis for the compiled kernel surface.
+
+Two halves:
+
+  * :mod:`tools.mgxla.checker` — the compiled-artifact contract checker.
+    Every kernel in :data:`tools.mgxla.manifest.MANIFEST` is abstractly
+    lowered (``jax.jit(...).lower(...)`` on ``ShapeDtypeStruct``s over a
+    forced multi-device mesh — nothing executes) and the compiled HLO is
+    verified against machine-checkable contracts: the EXACT collective
+    multiset per iteration body, zero f64 ops, zero host callbacks /
+    infeed / outfeed, input-output aliasing (donation) of fixpoint
+    carries, and a bounded compile count across the PPR lane buckets.
+  * three mglint AST rules (MG008 recompile-hazard, MG009
+    host-sync-in-hot-path, MG010 missing-donation) that live in
+    ``tools/mglint/rules/`` and ride the ordinary mglint gate.
+
+``python -m tools.mgxla check`` runs the full manifest; deliberate
+exceptions carry justifications in ``tools/mgxla/baseline.json`` (same
+contract as mglint's baseline: unexplained or unused entries fail).
+"""

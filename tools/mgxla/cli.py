@@ -1,0 +1,90 @@
+"""mgxla command line: ``python -m tools.mgxla check [--only K ...]``.
+
+Exit codes: 0 clean (or everything baselined), 1 contract violations /
+unused baseline entries, 2 bad invocation, broken baseline, or an
+environment that cannot host the forced mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mgxla",
+        description="device-plane static analysis: compiled-artifact "
+                    "contract checker")
+    sub = p.add_subparsers(dest="cmd")
+    chk = sub.add_parser("check", help="lower + verify the manifest")
+    chk.add_argument("--only", action="append", default=None,
+                     metavar="KERNEL",
+                     help="check only this manifest kernel (repeatable)")
+    chk.add_argument("--json", action="store_true",
+                     help="machine-readable JSON output")
+    chk.add_argument("--baseline", default=None,
+                     help="baseline file (default: tools/mgxla/"
+                          "baseline.json)")
+    chk.add_argument("--no-baseline", action="store_true",
+                     help="ignore the baseline: show every violation")
+    lst = sub.add_parser("list", help="list manifest kernels and exit")
+    lst.add_argument("--json", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd is None:
+        build_parser().print_help()
+        return 2
+
+    from .manifest import MANIFEST, load_baseline
+
+    if args.cmd == "list":
+        if args.json:
+            print(json.dumps({k: c.as_dict()
+                              for k, c in sorted(MANIFEST.items())},
+                             indent=2))
+        else:
+            for k, c in sorted(MANIFEST.items()):
+                cols = ",".join(c.collectives) or "-"
+                print(f"{k:32s} {c.backend:8s} collectives={cols} "
+                      f"donated>={c.min_donated}")
+        return 0
+
+    try:
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except (ValueError, OSError) as e:
+        print(f"mgxla: broken baseline: {e}", file=sys.stderr)
+        return 2
+
+    from .checker import CheckerEnvironmentError, run_check
+    only = set(args.only) if args.only else None
+    if only:
+        unknown = only - set(MANIFEST)
+        if unknown:
+            print(f"mgxla: unknown kernels {sorted(unknown)}; "
+                  "see `python -m tools.mgxla list`", file=sys.stderr)
+            return 2
+    try:
+        report = run_check(only=only, baseline=baseline,
+                           structural=only is None)
+    except CheckerEnvironmentError as e:
+        print(f"mgxla: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "kernels_checked": report.kernels_checked,
+            "violations": [{"kernel": v.kernel, "check": v.check,
+                            "detail": v.detail, "key": v.key,
+                            "snippet": v.snippet}
+                           for v in report.violations],
+            "baselined": [v.key for v in report.baselined],
+            "unused_baseline": report.unused_baseline,
+            "ok": report.ok}, indent=2))
+    else:
+        print(report.render())
+    return 0 if report.ok else 1

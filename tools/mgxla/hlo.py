@@ -1,0 +1,160 @@
+"""Compiled-HLO text analysis: the machine-checkable side of mgxla.
+
+Everything here works on ``lowered.compile().as_text()`` output — plain
+post-optimization HLO text — so the checks stay independent of jax
+internals: a contract violation is always demonstrable as a line of HLO
+the developer can read.
+
+The only structural assumption is the HLO text format itself:
+computations print as ``%name (params...) -> type {`` blocks (the entry
+computation prefixed with ``ENTRY``), ops reference other computations
+via ``body=%name`` / ``condition=%name`` / ``calls=%name`` /
+``to_apply=%name``, and the module header carries
+``input_output_alias={ {out}: (param, {...}) ... }`` when inputs are
+donated.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+#: cross-device collective ops (the complete set XLA can emit for the
+#: SPMD programs this tree builds; extend deliberately, never loosely —
+#: a new name appearing in a kernel should FAIL until it is understood)
+COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                  "collective-permute", "all-to-all", "collective-broadcast")
+
+# matches the op NAME position of a def line ("= <type> all-reduce(...)",
+# tuple types included); operand references ("%all-reduce.2") never have
+# "(" directly after the name, so they cannot match
+_COLLECTIVE_RE = re.compile(
+    r"=\s.*?[\s)](" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\(")
+
+#: host round-trip markers: python callbacks lower to custom-calls with
+#: these targets; infeed/outfeed are the streaming variants
+_CALLBACK_RE = re.compile(
+    r"custom_call_target=\"[^\"]*(callback|host)[^\"]*\"|"
+    r"=\s+\S+\s+(infeed|outfeed)\(")
+
+_F64_RE = re.compile(r"\b(f64|c128)\[")
+
+_COMPUTATION_HDR_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)"
+                                 r"\s+->\s+.*\{\s*$")
+
+_REF_RE = re.compile(r"(?:body|condition|calls|to_apply|branch_computations"
+                     r"|called_computations)=\{?%?([\w.\-]+(?:,\s*%?"
+                     r"[\w.\-]+)*)\}?")
+
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\((\d+)")
+
+
+def split_computations(text: str) -> dict[str, list[str]]:
+    """HLO computation name -> its body lines (header excluded)."""
+    out: dict[str, list[str]] = {}
+    cur: str | None = None
+    for line in text.splitlines():
+        m = _COMPUTATION_HDR_RE.match(line)
+        if m:
+            cur = m.group(1)
+            out[cur] = []
+            continue
+        if cur is not None:
+            if line.strip() == "}":
+                cur = None
+                continue
+            out[cur].append(line)
+    return out
+
+
+def _refs(lines: list[str]) -> set[str]:
+    got: set[str] = set()
+    for line in lines:
+        for m in _REF_RE.finditer(line):
+            for name in m.group(1).split(","):
+                got.add(name.strip().lstrip("%"))
+    return got
+
+
+def collectives(text: str) -> list[str]:
+    """Sorted multiset of cross-device collective ops in the program."""
+    return sorted(_COLLECTIVE_RE.findall(text))
+
+
+def while_body_collectives(text: str) -> list[str]:
+    """Sorted multiset of collectives reachable from any while body
+    (transitively through called computations) — the per-iteration cost."""
+    comps = split_computations(text)
+    bodies: set[str] = set()
+    for lines in comps.values():
+        for line in lines:
+            if re.search(r"[\s)]while\(", line):
+                m = re.search(r"body=%?([\w.\-]+)", line)
+                if m:
+                    bodies.add(m.group(1))
+    seen: set[str] = set()
+    work = list(bodies)
+    while work:
+        name = work.pop()
+        if name in seen or name not in comps:
+            continue
+        seen.add(name)
+        work.extend(_refs(comps[name]))
+    got: list[str] = []
+    for name in seen:
+        for line in comps[name]:
+            got.extend(_COLLECTIVE_RE.findall(line))
+    return sorted(got)
+
+
+def f64_lines(text: str) -> list[str]:
+    """Lines carrying double-precision types (silent upcasts)."""
+    return [ln.strip() for ln in text.splitlines() if _F64_RE.search(ln)]
+
+
+def callback_lines(text: str) -> list[str]:
+    """Lines carrying host callbacks / infeed / outfeed."""
+    return [ln.strip() for ln in text.splitlines()
+            if _CALLBACK_RE.search(ln)]
+
+
+def donated_params(text: str) -> set[int]:
+    """Parameter indices aliased to outputs (``donate_argnums`` made it
+    through to the executable) from the module header."""
+    for line in text.splitlines():
+        if "input_output_alias=" in line:
+            seg = line.split("input_output_alias=", 1)[1]
+            seg = seg.split("entry_computation_layout")[0]
+            return {int(m.group(1))
+                    for m in _ALIAS_ENTRY_RE.finditer(seg)}
+    return set()
+
+
+def snippet_around(text: str, pattern: str, context: int = 2) -> str:
+    """First match of `pattern` with `context` lines around it — the
+    offending-HLO excerpt a violation report carries."""
+    lines = text.splitlines()
+    rx = re.compile(pattern)
+    for i, ln in enumerate(lines):
+        if rx.search(ln):
+            lo, hi = max(0, i - context), min(len(lines), i + context + 1)
+            return "\n".join(lines[lo:hi])
+    return ""
+
+
+@dataclass
+class HloFacts:
+    """Everything the contract checks need, extracted in one pass."""
+    collectives: list[str] = field(default_factory=list)
+    while_collectives: list[str] = field(default_factory=list)
+    f64: list[str] = field(default_factory=list)
+    callbacks: list[str] = field(default_factory=list)
+    donated: set[int] = field(default_factory=set)
+
+
+def analyze(text: str) -> HloFacts:
+    return HloFacts(collectives=collectives(text),
+                    while_collectives=while_body_collectives(text),
+                    f64=f64_lines(text),
+                    callbacks=callback_lines(text),
+                    donated=donated_params(text))

@@ -1,0 +1,195 @@
+"""Per-kernel contract manifest for the mgxla checker.
+
+One :class:`KernelContract` per compiled artifact the device plane
+ships. The checker (tools/mgxla/checker.py) abstractly lowers each
+kernel through its registered builder and verifies the compiled HLO
+against the contract:
+
+  * ``collectives``        — the EXACT whole-program multiset of
+    cross-device collectives (sorted). For iterating kernels the checker
+    additionally asserts every one of them sits inside the while body
+    (the one-collective-per-iteration invariant from PR 6, generalized).
+  * ``min_donated``        — at least this many parameters must be
+    donated (``input_output_alias`` in the executable): the fixpoint
+    carry must not double its HBM residency.
+  * zero ``f64`` ops and zero host callbacks / infeed / outfeed are
+    implicit contracts on every kernel (no field needed — a silent
+    upcast or a host round-trip inside a compiled program is never
+    intentional here; genuinely deliberate cases go in baseline.json).
+
+``registry`` names the ``ops/__init__.py:SPMV_ALGORITHMS`` entries the
+kernel covers; the checker fails if any registry entry is covered by no
+kernel, if a manifest entry names an unknown registry key, or if any of
+the three semiring backends has no kernel at all.
+
+Registering a NEW kernel = one KernelContract here + one ``@builder``
+in checker.py that returns its lowered artifact(s). docs/architecture.md
+§Device-plane static analysis walks through it.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field
+
+# mgxla shares mglint's baseline loader (same justification-required
+# format); its OWN baseline file holds compiled-artifact exceptions.
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+#: the three backends every ⊕-shaped algorithm can ride (ops/semiring.py
+#: route_backend); the checker requires all three to be covered
+BACKENDS = ("segment", "mxu", "mesh")
+
+
+@dataclass(frozen=True)
+class KernelContract:
+    kernel: str                      # manifest id, e.g. "mesh:pagerank"
+    backend: str                     # segment | mxu | mesh
+    registry: tuple = ()             # SPMV_ALGORITHMS keys covered
+    collectives: tuple = ()          # exact sorted collective multiset
+    min_donated: int = 0             # donated-parameter floor
+    iterates: bool = True            # has a while-loop iteration body
+    note: str = ""
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+def contract_from_dict(doc: dict) -> KernelContract:
+    return KernelContract(
+        kernel=doc["kernel"], backend=doc["backend"],
+        registry=tuple(doc.get("registry", ())),
+        collectives=tuple(doc.get("collectives", ())),
+        min_donated=int(doc.get("min_donated", 0)),
+        iterates=bool(doc.get("iterates", True)),
+        note=doc.get("note", ""))
+
+
+def _c(kernel, backend, registry, collectives=(), min_donated=0,
+       iterates=True, note=""):
+    return KernelContract(kernel=kernel, backend=backend,
+                          registry=tuple(registry),
+                          collectives=tuple(sorted(collectives)),
+                          min_donated=min_donated, iterates=iterates,
+                          note=note)
+
+
+#: PPR serving-plane lane buckets — mirrored from ops/pagerank.py
+#: (the checker cross-validates the two are identical, so a bucket
+#: added there without a manifest row fails the gate).
+PPR_LANE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _ppr_bucket_contracts():
+    out = {}
+    for b in PPR_LANE_BUCKETS:
+        out[f"segment:ppr_batch:b{b}"] = _c(
+            f"segment:ppr_batch:b{b}", "segment",
+            ["personalized_pagerank"],
+            note=f"coalesced multi-source SpMM fixpoint, {b}-lane bucket")
+    # the warm-start variant donates the x0 seed matrix
+    out["segment:ppr_batch:warm8"] = _c(
+        "segment:ppr_batch:warm8", "segment", ["personalized_pagerank"],
+        min_donated=1,
+        note="warm-started 8-lane bucket; cached vectors seed x0 and the"
+             " seed buffer is donated back to the iterate")
+    return out
+
+
+MANIFEST: dict[str, KernelContract] = {
+    # ---- partition-centric mesh kernels (8-shard forced mesh) --------
+    # the one-collective-per-iteration invariant, donation of the chunk
+    # carry (state vector(s) + convergence partials + iteration counter)
+    "mesh:pagerank": _c(
+        "mesh:pagerank", "mesh", ["pagerank"],
+        collectives=["reduce-scatter"], min_donated=4,
+        note="rank sharded over vertex blocks; ONE fused psum_scatter "
+             "rides dangling-mass + convergence-error piggyback lanes"),
+    "mesh:pagerank_bf16": _c(
+        "mesh:pagerank_bf16", "mesh", ["pagerank"],
+        collectives=["reduce-scatter"], min_donated=4,
+        note="bf16 contribution streaming must not change the "
+             "collective structure (f32 payload) nor upcast"),
+    "mesh:katz": _c(
+        "mesh:katz", "mesh", ["katz"],
+        collectives=["all-reduce"], min_donated=3,
+        note="x replicated, one psum per iteration"),
+    "mesh:labelprop": _c(
+        "mesh:labelprop", "mesh", ["labelprop"],
+        collectives=["all-reduce"], min_donated=3,
+        note="dst-owned election; one int psum concatenates the "
+             "disjoint blocks"),
+    "mesh:wcc": _c(
+        "mesh:wcc", "mesh", ["components"],
+        collectives=["all-reduce"], min_donated=3,
+        note="comp replicated, one pmin per round + pointer jumping"),
+    "mesh:semiring_min_plus": _c(
+        "mesh:semiring_min_plus", "mesh", ["sssp", "bfs_layers"],
+        collectives=["all-reduce"], min_donated=3,
+        note="the generic (semiring, x0, epilogue) mesh kernel that "
+             "sssp_mesh / bfs_mesh ride (min-plus relaxation)"),
+
+    # ---- segment (reference) backend ---------------------------------
+    # single-device programs: zero collectives; x0-carrying fixpoints
+    # donate the seed
+    "segment:pagerank": _c(
+        "segment:pagerank", "segment", ["pagerank"],
+        note="fused damping update + L1 partial in the while body"),
+    "segment:ppr": _c(
+        "segment:ppr", "segment", ["personalized_pagerank"],
+        note="restart-vector fixpoint (single query, in-process path)"),
+    "segment:katz": _c("segment:katz", "segment", ["katz"]),
+    "segment:hits": _c(
+        "segment:hits", "segment", ["hits"],
+        note="two interleaved normalized matvecs per round (the "
+             "registry's mesh exemption case — still contract-checked "
+             "on one device)"),
+    "segment:labelprop": _c(
+        "segment:labelprop", "segment", ["labelprop"], min_donated=1),
+    "segment:wcc": _c(
+        "segment:wcc", "segment", ["components"], min_donated=1),
+    "segment:sssp": _c(
+        "segment:sssp", "segment", ["sssp"], min_donated=1),
+    "segment:bfs": _c(
+        "segment:bfs", "segment", ["bfs_layers"], min_donated=1,
+        note="direction-optimizing push/pull min-plus fixpoint"),
+    "segment:scc": _c(
+        "segment:scc", "segment", ["scc"],
+        note="one FW-BW coloring round (the host drives rounds; the "
+             "host loop reuses the previous iterate for its progress "
+             "check, so the round kernel deliberately does not donate)"),
+    "segment:betweenness": _c(
+        "segment:betweenness", "segment", ["betweenness"],
+        note="Brandes source-chunk: forward + backward sweeps as two "
+             "while loops over (B, n) state"),
+    "segment:gnn": _c(
+        "segment:gnn", "segment", ["gnn"], iterates=False,
+        note="GraphSAGE forward: plus-first SpMM aggregation, no "
+             "fixpoint loop"),
+
+    # ---- MXU (gather-free Benes) backend ------------------------------
+    "mxu:pagerank": _c(
+        "mxu:pagerank", "mxu", ["pagerank"],
+        note="expand -> Benes route -> MXU reduce/extract; x0 stays "
+             "un-donated: callers retain warm-start vectors (DeltaPlan "
+             "incremental reuse)"),
+    "mxu:katz": _c(
+        "mxu:katz", "mxu", ["katz"],
+        note="same machinery, katz epilogue, zeros start"),
+
+    # ---- PPR serving-plane lane buckets -------------------------------
+    **_ppr_bucket_contracts(),
+}
+
+
+def manifest_registry_keys() -> set:
+    out: set = set()
+    for c in MANIFEST.values():
+        out.update(c.registry)
+    return out
+
+
+def load_baseline(path: str | None = None) -> dict[str, str]:
+    """Justification-required baseline, shared format with mglint."""
+    from tools.mglint.core import load_baseline as _load
+    return _load(path or DEFAULT_BASELINE)
