@@ -57,6 +57,12 @@ class CoordinatorInstance:
         self.instances: dict[str, dict] = {}
         self.main_name: str | None = None
         self.epoch = 0        # fencing epoch; bumped by every set_main
+        # shard placement (r18, mgshard): shard_id -> owner endpoint.
+        # Reassignment mints the SAME fencing epoch inside the
+        # replicated apply, so a stale shard map can never route an
+        # acked write — one epoch chain fences MAIN role AND shard
+        # ownership.
+        self.shard_map: dict[int, str] = {}
         self._lock = tracked_lock("Coordinator._lock")
         self.raft = RaftNode(node_id, host, raft_port, peers,
                              apply_fn=self._apply, kvstore=kvstore,
@@ -115,6 +121,15 @@ class CoordinatorInstance:
                     self.main_name = name
                 global_metrics.set_gauge("coordination.current_epoch",
                                          float(self.epoch))
+            elif op == "set_shard_owner":
+                # minted HERE, inside the replicated apply: every
+                # coordinator derives the identical (epoch, owner) pair
+                # from log order alone — the shard-ownership fencing
+                # proof rides the same chain as set_main
+                self.epoch += 1
+                self.shard_map[int(command["shard"])] = command["owner"]
+                global_metrics.set_gauge("coordination.current_epoch",
+                                         float(self.epoch))
 
     def _snapshot(self) -> dict:
         """State-machine snapshot for Raft log compaction."""
@@ -122,7 +137,9 @@ class CoordinatorInstance:
             return {"instances": {k: dict(v)
                                   for k, v in self.instances.items()},
                     "main_name": self.main_name,
-                    "epoch": self.epoch}
+                    "epoch": self.epoch,
+                    "shard_map": {str(k): v
+                                  for k, v in self.shard_map.items()}}
 
     def _restore(self, state: dict) -> None:
         """Replace the state machine from a Raft snapshot (restart replay
@@ -133,6 +150,9 @@ class CoordinatorInstance:
                                                     {}).items()}
             self.main_name = state.get("main_name")
             self.epoch = int(state.get("epoch") or 0)
+            self.shard_map = {int(k): v
+                              for k, v in (state.get("shard_map")
+                                           or {}).items()}
 
     # --- client operations (leader only) ------------------------------------
 
@@ -157,8 +177,27 @@ class CoordinatorInstance:
             readers = [i["bolt_address"] for i in self.instances.values()
                        if i["role"] == "replica" and i.get("bolt_address")]
             epoch = self.epoch
-        return {"writers": writers, "readers": readers or writers,
-                "epoch": epoch}
+            shards = {str(k): v for k, v in self.shard_map.items()}
+        table = {"writers": writers, "readers": readers or writers,
+                 "epoch": epoch}
+        if shards:
+            # shard topology rides the same ROUTE payload (and the same
+            # epoch) so shard-aware clients refresh both in one fetch
+            table["shards"] = shards
+        return table
+
+    def assign_shard(self, shard_id: int, owner: str) -> bool:
+        """Commit a shard-ownership change through Raft; the fencing
+        epoch for the new owner is minted inside the apply."""
+        return bool(self.raft.propose({"op": "set_shard_owner",
+                                       "shard": int(shard_id),
+                                       "owner": owner}))
+
+    def shard_map_view(self) -> dict:
+        """The epoch-versioned shard map from replicated state."""
+        with self._lock:
+            return {"epoch": self.epoch,
+                    "owners": dict(self.shard_map)}
 
     def unregister_instance(self, name: str) -> bool:
         return bool(self.raft.propose({"op": "unregister_instance",

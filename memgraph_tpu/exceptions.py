@@ -84,5 +84,32 @@ class ProcedureException(QueryException):
     """Error raised from a CALLed query module procedure."""
 
 
+class WorkerCrashedError(MemgraphTpuError, ConnectionError):
+    """A pooled worker process died mid-request. The pool has already
+    respawned it, so the request is RETRYABLE — ConnectionError in the
+    MRO means RetryPolicy's default ``retry_on`` catches it without
+    special-casing (mp_executor and the shard plane both raise this)."""
+
+
+class ShardError(MemgraphTpuError):
+    pass
+
+
+class StaleShardEpoch(ShardError):
+    """A shard owner refused a write because the request's routing
+    epoch does not match its grant (stale client map, or a fenced
+    deposed owner). Carries the owner's epoch so the client can refresh
+    the shard map and retry against the current owner."""
+
+    def __init__(self, shard_id: int, epoch: int,
+                 fenced: bool = False) -> None:
+        what = "fenced owner" if fenced else "stale routing epoch"
+        super().__init__(f"shard {shard_id}: {what} "
+                         f"(owner epoch {epoch})")
+        self.shard_id = shard_id
+        self.epoch = epoch
+        self.fenced = fenced
+
+
 class AuthException(MemgraphTpuError):
     pass

@@ -83,7 +83,13 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     # mgxla static compile budget (jit.compile_total)
                     "device": {name: value for name, _k, value
                                in global_metrics.snapshot()
-                               if name.startswith("jit.")}},
+                               if name.startswith("jit.")},
+                    # sharded OLTP execution plane (r18, mgshard):
+                    # per-shard ops/latency/queue-depth, 2PC counters,
+                    # move durations, routing-table epoch
+                    "sharding": {name: value for name, _k, value
+                                 in global_metrics.snapshot()
+                                 if name.startswith("shard.")}},
                     default=str)
                 ctype = "application/json"
             elif path.startswith("/health"):

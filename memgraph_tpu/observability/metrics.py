@@ -76,6 +76,20 @@ STAT_NAMES = (
     "mp_executor.in_flight",
     "mp_executor.workers",
     "mp_executor.errors_total",
+    "mp_executor.worker_respawn_total",
+    # sharded OLTP execution plane (r18, mgshard)
+    "shard.requests_total",
+    "shard.scatter_gather_total",
+    "shard.stale_epoch_bounces_total",
+    "shard.twopc_total",
+    "shard.twopc_aborts_total",
+    "shard.moves_total",
+    "shard.move_duration_sec",
+    "shard.map_epoch",              # routing-table fencing epoch gauge
+    "shard.worker_respawn_total",
+    "shard.ops.*",                  # per-shard routed-op counters
+    "shard.op_latency_sec.*",       # per-shard latency histograms
+    "shard.queue_depth.*",          # per-shard in-flight gauges
     # kernel server (local process + mirrored daemon state)
     "kernel_server.dispatch.*",    # typed per-outcome dispatch counters
     "kernel_server.daemon.*",      # daemon counters mirrored as gauges

@@ -65,6 +65,7 @@ ENV_TOPK = "MEMGRAPH_TPU_STATS_TOPK"        # top-K capacity (default 128)
 ENV_MAX_LAG = "MEMGRAPH_TPU_HEALTH_MAX_REPL_LAG"        # txns (default 1000)
 ENV_MAX_BACKLOG = "MEMGRAPH_TPU_HEALTH_MAX_FSYNC_BACKLOG"  # bytes (64 MiB)
 ENV_MAX_PPR_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_PPR_QUEUE"  # pending (192)
+ENV_MAX_SHARD_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_SHARD_QUEUE"  # depth (16)
 
 #: every device stage the accumulator may carry — the attribution
 #: vocabulary PROFILE and BENCH records share
@@ -385,6 +386,10 @@ class SaturationPlane:
         # (MEMGRAPH_TPU_PPR_MAX_QUEUE, default 256): load balancers see
         # the 503 while the queue is still servable
         self.max_ppr_queue = float(_env_int(ENV_MAX_PPR_QUEUE, 192))
+        # per-shard dispatch is serial (shard-per-process): a deep
+        # queue on ONE shard means a hot key / skewed hash range, and
+        # admission control should shed before latency collapses
+        self.max_shard_queue = float(_env_int(ENV_MAX_SHARD_QUEUE, 16))
 
     def evaluate(self, ictx=None) -> dict:
         """One readiness verdict from the current metrics snapshot.
@@ -471,6 +476,23 @@ class SaturationPlane:
                  occ, 1.0)
         else:
             ok("ppr_window")
+
+        # sharded OLTP plane: per-shard queue depth (one gauge per
+        # shard; serial per-shard dispatch makes depth the direct
+        # saturation signal for a hot hash range)
+        worst_shard = None
+        for name, value in snap.items():
+            if name.startswith("shard.queue_depth."):
+                if worst_shard is None or value > worst_shard[1]:
+                    worst_shard = (name, value)
+        if worst_shard is not None and \
+                worst_shard[1] > self.max_shard_queue:
+            trip("shard_queue",
+                 f"shard {worst_shard[0].rsplit('.', 1)[1]} queue "
+                 "depth over budget", worst_shard[1],
+                 self.max_shard_queue)
+        else:
+            ok("shard_queue")
 
         # replication lag (one gauge per replica)
         worst = None

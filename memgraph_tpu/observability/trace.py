@@ -83,6 +83,8 @@ SPAN_NAMES = (
     "device.chunk",        # one compiled chunk of device iterations
     "mp.execute",          # parent->mp-worker round trip
     "mp.worker",           # worker-side prepare+pull
+    "shard.request",       # router->shard-owner round trip (r18)
+    "shard.worker",        # shard-worker-side statement execution
     "repl.ship",           # one WAL frame ship + ack, per replica
     "repl.apply",          # replica-side system-txn application
     "raft.rpc",            # outbound raft RPC (request + response)

@@ -624,9 +624,14 @@ class BoltSession:
             servers.append(
                 {"addresses": coordinator.routers or [addr],
                  "role": "ROUTE"})
-            self.send_success({"rt": {"ttl": 10, "db": "memgraph",
-                                      "epoch": table.get("epoch", 0),
-                                      "servers": servers}})
+            rt = {"ttl": 10, "db": "memgraph",
+                  "epoch": table.get("epoch", 0),
+                  "servers": servers}
+            if table.get("shards"):
+                # shard topology (r18, mgshard) rides the ROUTE reply
+                # under the same fencing epoch as the writer table
+                rt["shards"] = table["shards"]
+            self.send_success({"rt": rt})
             return True
         # single-instance routing table: this server serves all roles
         self.send_success({"rt": {

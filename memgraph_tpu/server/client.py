@@ -201,6 +201,10 @@ class RoutedClient:
         self.known_epoch = 0
         self._writer_addr: str | None = None
         self._writer: BoltClient | None = None
+        # shard topology (r18, mgshard): shard_id -> owner endpoint,
+        # refreshed with the writer table under the SAME epoch guard —
+        # a stale coordinator can never roll the shard map backwards
+        self.shard_table: dict[int, str] = {}
 
     @staticmethod
     def _split(addr: str) -> tuple[str, int]:
@@ -232,6 +236,9 @@ class RoutedClient:
             if epoch < self.known_epoch:
                 continue   # stale coordinator (partitioned minority)
             self.known_epoch = max(self.known_epoch, epoch)
+            if rt.get("shards"):
+                self.shard_table = {int(k): v
+                                    for k, v in rt["shards"].items()}
             servers = {s["role"]: s["addresses"]
                        for s in rt.get("servers", [])}
             for r in servers.get("ROUTE", []):

@@ -81,20 +81,41 @@ class MPReadExecutor:
         self._workers = []
         self._locks = []
         for _ in range(self._n):
-            req_r, req_w = os.pipe()
-            resp_r, resp_w = os.pipe()
-            pid = os.fork()
-            if pid == 0:                      # ---- child ----
-                os.close(req_w)
-                os.close(resp_r)
-                try:
-                    self._worker_loop(req_r, resp_w)
-                finally:
-                    os._exit(0)
-            os.close(req_r)
-            os.close(resp_w)
-            self._workers.append((pid, req_w, resp_r))
+            self._workers.append(self._spawn_one())
             self._locks.append(threading.Lock())
+
+    def _spawn_one(self) -> tuple:
+        req_r, req_w = os.pipe()
+        resp_r, resp_w = os.pipe()
+        pid = os.fork()
+        if pid == 0:                      # ---- child ----
+            os.close(req_w)
+            os.close(resp_r)
+            try:
+                self._worker_loop(req_r, resp_w)
+            finally:
+                os._exit(0)
+        os.close(req_r)
+        os.close(resp_w)
+        return (pid, req_w, resp_r)
+
+    def _respawn(self, i: int, dead) -> None:
+        """Replace a crashed worker (caller holds ``self._locks[i]``):
+        reap the corpse, fork a fresh worker off the CURRENT parent
+        snapshot, and count the respawn so dashboards see churn."""
+        from ..observability.metrics import global_metrics
+        pid, req_fd, resp_fd = dead
+        for fd in (req_fd, resp_fd):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        try:
+            os.waitpid(pid, os.WNOHANG)
+        except ChildProcessError:
+            pass
+        self._workers[i] = self._spawn_one()
+        global_metrics.increment("mp_executor.worker_respawn_total")
 
     def _worker_loop(self, req_fd: int, resp_fd: int) -> None:
         from ..query import Interpreter
@@ -171,17 +192,40 @@ class MPReadExecutor:
         if not self._workers:
             raise RuntimeError("executor is closed")
         i = next(self._rr) % len(self._workers)
-        pid, req_fd, resp_fd = self._workers[i]
         with self._inflight_lock:
             self._inflight += 1
             global_metrics.set_gauge("mp_executor.in_flight",
                                      float(self._inflight))
         t0 = time.perf_counter()
         try:
-            with mgtrace.span("mp.execute", worker=i, worker_pid=pid):
+            with mgtrace.span("mp.execute", worker=i):
                 with self._locks[i]:
-                    _send(req_fd, (query, params or {}, mgtrace.inject()))
-                    out = _recv(resp_fd)
+                    # unpack INSIDE the lock: _respawn replaces the
+                    # tuple under this same lock, and a pre-lock copy
+                    # could name fds already closed AND reused by the
+                    # replacement's pipes (framing corruption)
+                    pid, req_fd, resp_fd = self._workers[i]
+                    try:
+                        _send(req_fd,
+                              (query, params or {}, mgtrace.inject()))
+                        out = _recv(resp_fd)
+                    except (OSError, EOFError) as e:
+                        # dead worker: a wedged queue was the old
+                        # failure mode — instead, respawn in place and
+                        # fail THIS job with a typed retryable error
+                        # (ConnectionError in the MRO: RetryPolicy's
+                        # default retry_on catches it)
+                        from ..exceptions import WorkerCrashedError
+                        self._respawn(i, (pid, req_fd, resp_fd))
+                        global_metrics.increment(
+                            "mp_executor.errors_total")
+                        global_query_stats.record_text(
+                            query, time.perf_counter() - t0, rows=0,
+                            error=True,
+                            trace_id=mgtrace.current_trace_id())
+                        raise WorkerCrashedError(
+                            f"mp_executor worker {i} (pid {pid}) died "
+                            "mid-request; respawned — retry") from e
         finally:
             with self._inflight_lock:
                 self._inflight -= 1

@@ -47,6 +47,22 @@ def _pool() -> ThreadPoolExecutor:
                 thread_name_prefix="snapshot-worker")
         return _POOL
 
+
+def _reset_pool_after_fork() -> None:
+    """fork() copies the executor OBJECT but none of its worker
+    threads: a forked child (mp_executor / mgshard workers) that
+    inherited a live pool would submit snapshot chunks no thread will
+    ever run and hang forever. Drop the carcass so the child lazily
+    builds its own pool on first use."""
+    global _POOL
+    _POOL = None
+    # the lock may have been held by a parent thread at fork time;
+    # replace it rather than inherit a permanently-locked instance
+    globals()["_POOL_LOCK"] = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reset_pool_after_fork)
+
 # section markers
 SEC_MAPPERS = 0x01
 SEC_VERTICES = 0x02

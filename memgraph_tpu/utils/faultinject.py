@@ -99,6 +99,10 @@ NEMESIS_OPS = (
     "duplicate",          # every message on the link delivered twice
     "reorder",            # seeded jitter on the link (messages overtake)
     "kill_restart",       # node churn: hard-kill a node, later restart it
+    # --- sharded OLTP plane (r18, mgshard; cluster-harness ops like
+    # kill_restart — the harness drives ShardPlane, not a net_* rule) ---
+    "shard_move",         # live-rebalance a shard to a fresh worker
+    "shard_worker_kill",  # SIGKILL a shard owner; heal respawns it
 )
 
 

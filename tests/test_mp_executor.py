@@ -106,6 +106,67 @@ def test_write_queries_rejected_loudly(ictx):
     assert rows == [[0]]
 
 
+def test_worker_crash_respawns_with_typed_retryable_error(ictx):
+    """A SIGKILLed worker must not wedge its queue: the in-flight job
+    fails with the typed retryable WorkerCrashedError, the worker is
+    respawned in place, and the respawn counter moves."""
+    import os
+    import signal
+
+    from memgraph_tpu.exceptions import WorkerCrashedError
+    from memgraph_tpu.observability.metrics import global_metrics
+
+    def metric(name):
+        return {n: v for n, _k, v
+                in global_metrics.snapshot()}.get(name, 0.0)
+
+    ex = MPReadExecutor(ictx, n_workers=2)
+    try:
+        assert ex.execute("MATCH (n:User) RETURN count(n)")[1] == [[100]]
+        respawns0 = metric("mp_executor.worker_respawn_total")
+        for pid, _rq, _rs in list(ex._workers):
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+        crashes = 0
+        for _ in range(2):
+            try:
+                ex.execute("MATCH (n:User) RETURN count(n)")
+            except WorkerCrashedError as e:
+                # RetryPolicy-compatible: ConnectionError is in the MRO
+                assert isinstance(e, ConnectionError)
+                crashes += 1
+        assert crashes == 2
+        assert metric("mp_executor.worker_respawn_total") == \
+            respawns0 + 2
+        # both workers are fresh and serving again
+        for _ in range(4):
+            assert ex.execute(
+                "MATCH (n:User) RETURN count(n)")[1] == [[100]]
+    finally:
+        ex.close()
+
+
+def test_worker_crash_is_retry_policy_compatible(ictx):
+    """RetryPolicy.call's default retry_on catches the crash error —
+    the dispatch loop heals without special-casing."""
+    import os
+    import signal
+
+    from memgraph_tpu.utils.retry import RetryPolicy
+
+    ex = MPReadExecutor(ictx, n_workers=1)
+    try:
+        pid = ex._workers[0][0]
+        os.kill(pid, signal.SIGKILL)
+        os.waitpid(pid, 0)
+        policy = RetryPolicy(base_delay=0.01, max_retries=3)
+        _cols, rows = policy.call(
+            lambda: ex.execute("MATCH (n:User) RETURN count(n)"))
+        assert rows == [[100]]
+    finally:
+        ex.close()
+
+
 def test_close_idempotent(ictx):
     ex = MPReadExecutor(ictx, n_workers=1)
     ex.close()

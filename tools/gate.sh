@@ -84,6 +84,15 @@ stage "mgchaos device nemesis smoke (supervised kernel plane)" \
 stage "ppr-smoke (coalesced PPR serving plane)" \
     python -m tools.ppr_smoke
 
+# 4d. shard-plane smoke: spawn 4 shard workers (own storage + WAL per
+#     shard), routed point reads/writes, scatter-gather merge, a
+#     cross-shard 2PC transaction, one LIVE shard-move (epoch bump +
+#     cutover), a worker kill with typed-error respawn + per-shard WAL
+#     recovery, clean shutdown. Functional on every host; scaling is
+#     the bench's job (mgbench --shards -> OLTP_r*.json).
+stage "shard-smoke (sharded OLTP execution plane)" \
+    python -m tools.shard_smoke
+
 # 5. perf-regression gate: the newest BENCH_r*.json record must be
 #    non-degraded and within BASELINE.json's envelope (>15% regression
 #    fails). Hosts without an accelerator skip LOUDLY (exit 0): the

@@ -29,8 +29,11 @@ Checked invariants (the acceptance contract):
   written by an acked or indeterminate op (a ``fail``-ed write that
   surfaces anyway means an abort was acked as an abort and happened
   regardless).
-* **At most one acking main per epoch** — two nodes acking writes in
-  the same fencing epoch is split-brain, full stop.
+* **At most one acking owner per (epoch, shard)** — two nodes acking
+  writes in the same fencing epoch is split-brain, full stop. Sharded
+  histories (r18) tag acks with ``"shard"``: each shard may have its
+  own owner per epoch, but never two; unsharded histories degenerate
+  to the classic one-main-per-epoch check.
 * **Election liveness** — the history contains a ``converged`` event
   within ``heal_window`` seconds of the final heal (a new acking MAIN
   emerged), and at least one post-heal acked write exists.
@@ -52,7 +55,10 @@ def check_cluster_history(events, heal_window: float = 30.0) -> list[str]:
 
     invokes: dict[int, dict] = {}
     outcomes: dict[int, dict] = {}
-    epoch_ackers: dict[int, set] = {}
+    # keyed (epoch, shard): in a sharded run each shard legitimately
+    # has its own acking owner per epoch; shard None (unsharded
+    # histories) degenerates to the classic per-epoch check
+    epoch_ackers: dict[tuple, set] = {}
     converged = None
     final = None
     saw_nemesis = False
@@ -64,7 +70,8 @@ def check_cluster_history(events, heal_window: float = 30.0) -> list[str]:
             outcomes[ev["op"]] = ev
             if kind == "ok":
                 epoch_ackers.setdefault(
-                    int(ev.get("epoch") or 0), set()).add(ev.get("node"))
+                    (int(ev.get("epoch") or 0), ev.get("shard")),
+                    set()).add(ev.get("node"))
         elif kind == "nemesis":
             saw_nemesis = True
         elif kind == "converged":
@@ -74,12 +81,16 @@ def check_cluster_history(events, heal_window: float = 30.0) -> list[str]:
 
     violations: list[str] = []
 
-    # ---- split-brain: one acking main per epoch -------------------------
-    for epoch, nodes in sorted(epoch_ackers.items()):
+    # ---- split-brain: one acking owner per (epoch, shard) ---------------
+    for (epoch, shard), nodes in sorted(
+            epoch_ackers.items(),
+            key=lambda kv: (kv[0][0], str(kv[0][1]))):
         if len(nodes) > 1:
+            where = f"epoch {epoch}" if shard is None \
+                else f"epoch {epoch} shard {shard}"
             violations.append(
-                f"split-brain: epoch {epoch} has {len(nodes)} acking "
-                f"mains ({', '.join(sorted(map(str, nodes)))})")
+                f"split-brain: {where} has {len(nodes)} acking "
+                f"owners ({', '.join(sorted(map(str, nodes)))})")
 
     # ---- acked-write durability ----------------------------------------
     if final is None:

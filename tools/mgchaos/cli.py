@@ -7,6 +7,8 @@
     device-smoke one seeded DEVICE nemesis round (accelerator faults
                  through the supervised kernel plane; gate stage)
     device-schedule  print a seed's device nemesis schedule
+    shard        one seeded SHARD-plane campaign (shard_move +
+                 shard_worker_kill against a live ShardPlane; r18)
 
 Exit codes: 0 safe, 1 violations found, 2 bad invocation.
 """
@@ -70,6 +72,17 @@ def build_parser() -> argparse.ArgumentParser:
                           help="print a seed's device nemesis schedule")
     dsch.add_argument("--seed", type=int, default=0)
     dsch.add_argument("--rounds", type=int, default=None)
+
+    sh = sub.add_parser(
+        "shard",
+        help="one seeded shard-plane campaign: live shard moves + "
+             "owner kills under register traffic, offline-checked")
+    sh.add_argument("--seed", type=int, default=0)
+    sh.add_argument("--rounds", type=int, default=4)
+    sh.add_argument("--shards", type=int, default=4)
+    sh.add_argument("--clients", type=int, default=4)
+    sh.add_argument("--dump", metavar="PATH",
+                    help="write the history JSONL to PATH")
     return p
 
 
@@ -170,6 +183,23 @@ def _cmd_device_schedule(args) -> int:
     return 0
 
 
+def _cmd_shard(args) -> int:
+    from .shard import run_shard_chaos
+    history, violations, stats = run_shard_chaos(
+        args.seed, rounds=args.rounds, n_shards=args.shards,
+        n_clients=args.clients)
+    verdict = "SAFE" if not violations else "UNSAFE"
+    print(f"shard seed {args.seed}: {verdict} — {stats['acked']} acked "
+          f"/ {stats['ops']} ops, epoch={stats['epoch']} "
+          f"converged={stats['converged']}")
+    for v in violations:
+        print(f"  VIOLATION: {v}")
+    if args.dump:
+        history.dump(args.dump)
+        print(f"history written to {args.dump}")
+    return 1 if violations else 0
+
+
 def _cmd_check(args) -> int:
     from .checker import HistoryLog, check_cluster_history
     violations = check_cluster_history(HistoryLog.load(args.history))
@@ -184,4 +214,5 @@ def main(argv=None) -> int:
     return {"run": _cmd_run, "sweep": _cmd_sweep, "honesty": _cmd_honesty,
             "schedule": _cmd_schedule, "check": _cmd_check,
             "device-smoke": _cmd_device_smoke,
-            "device-schedule": _cmd_device_schedule}[args.cmd](args)
+            "device-schedule": _cmd_device_schedule,
+            "shard": _cmd_shard}[args.cmd](args)

@@ -16,6 +16,8 @@ Each round picks one op from ``faultinject.NEMESIS_OPS``:
     duplicate          every message on the link delivered twice
     reorder            seeded jitter on the link (messages overtake)
     kill_restart       hard-kill a DATA node, restart it after the dwell
+    shard_move         live-rebalance a shard to a fresh worker (r18)
+    shard_worker_kill  SIGKILL a shard owner; the heal respawns it
 
 then dwells, heals (or restarts), and lets the cluster recover before
 the next round. The ``Nemesis`` executor applies ops against a live
@@ -49,22 +51,28 @@ class NemesisOp:
 def schedule(seed: int, nodes: list[str], data_nodes: list[str],
              rounds: int = 6, dwell: tuple[float, float] = (1.5, 3.0),
              recover: tuple[float, float] = (1.5, 2.5),
-             ops: tuple[str, ...] = FI.NEMESIS_OPS) -> list[NemesisOp]:
+             ops: tuple[str, ...] = FI.NEMESIS_OPS,
+             shards: list[str] | None = None) -> list[NemesisOp]:
     """Derive a deterministic fault schedule from ``seed``.
 
     ``nodes`` is every partitionable node (coordinators + data);
-    ``data_nodes`` the subset eligible for kill/restart churn. Node
-    lists are consumed in the given order, so pass them in a canonical
-    (sorted) order for cross-process replay."""
+    ``data_nodes`` the subset eligible for kill/restart churn;
+    ``shards`` the shard-id targets for the r18 shard-plane ops
+    (defaults to ``data_nodes`` so a schedule stays derivable from any
+    node census). Lists are consumed in the given order, so pass them
+    in a canonical (sorted) order for cross-process replay."""
     for op in ops:
         if op not in FI.NEMESIS_OPS:
             raise ValueError(f"unknown nemesis op {op!r}")
+    shard_targets = shards if shards else data_nodes
     rng = random.Random(seed)
     out: list[NemesisOp] = []
     for rnd in range(rounds):
         kind = ops[rng.randrange(len(ops))]
         arg = 0.0
-        if kind == "kill_restart":
+        if kind in ("shard_move", "shard_worker_kill"):
+            targets = (shard_targets[rng.randrange(len(shard_targets))],)
+        elif kind == "kill_restart":
             targets = (data_nodes[rng.randrange(len(data_nodes))],)
         elif kind == "partition_node":
             targets = (nodes[rng.randrange(len(nodes))],)
@@ -127,12 +135,22 @@ class Nemesis:
             FI.net_reorder(op.targets[0], op.targets[1], op.arg)
         elif op.kind == "kill_restart":
             self.cluster.kill(op.targets[0])
+        elif op.kind == "shard_move":
+            # the move IS the fault AND the recovery (epoch bump +
+            # cutover); the dwell just lets traffic ride the new owner
+            self.cluster.shard_move(op.targets[0])
+        elif op.kind == "shard_worker_kill":
+            self.cluster.shard_kill(op.targets[0])
         else:  # pragma: no cover - schedule() validates op kinds
             raise ValueError(f"unknown nemesis op {op.kind!r}")
 
     def heal(self, op: NemesisOp) -> None:
         if op.kind == "kill_restart":
             self.cluster.restart(op.targets[0])
+        elif op.kind == "shard_worker_kill":
+            self.cluster.shard_restart(op.targets[0])
+        elif op.kind == "shard_move":
+            pass   # cutover already healed it; record the phase below
         elif op.kind == "partition_node":
             FI.net_heal(op.targets[0])
         else:

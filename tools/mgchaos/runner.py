@@ -24,12 +24,18 @@ log = logging.getLogger(__name__)
 
 HEAL_WINDOW_S = 30.0     # bounded liveness: new acking MAIN within this
 
+#: the replication-cluster subset of the nemesis registry: the r18
+#: shard-plane ops (shard_move / shard_worker_kill) drive a ShardPlane
+#: harness instead (tools/mgchaos/shard.py run_shard_chaos)
+CLUSTER_OPS = tuple(op for op in FI.NEMESIS_OPS
+                    if not op.startswith("shard_"))
+
 
 def run_chaos(seed: int, rounds: int = 4, n_clients: int = 3,
               n_coords: int = 3, n_data: int = 3, fencing: bool = True,
               dwell: tuple[float, float] = (1.2, 2.2),
               recover: tuple[float, float] = (1.2, 2.0),
-              ops: tuple[str, ...] = FI.NEMESIS_OPS,
+              ops: tuple[str, ...] = CLUSTER_OPS,
               heal_window: float = HEAL_WINDOW_S):
     """Run one seeded campaign. Returns (history, violations, stats)."""
     FI.reset()

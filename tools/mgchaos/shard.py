@@ -1,0 +1,177 @@
+"""Shard-plane chaos: seeded shard_move / shard_worker_kill campaigns.
+
+The same Jepsen shape as ``runner.run_chaos``, pointed at the r18
+sharded OLTP execution plane instead of the replication cluster: N
+register-writing clients route through a ``ShardedClient`` (each client
+owns one key, keys spread across shards), while the nemesis live-moves
+shards to fresh workers and SIGKILLs shard owners mid-traffic. The
+offline checker then proves:
+
+* zero acked-write loss across moves and owner kills (per-shard WAL
+  recovery + snapshot-ship/delta-catch-up must not drop an ack);
+* at most ONE acking owner per (epoch, shard) — the fencing chain
+  (map epoch minted by the placement authority, grant-epoch-checked
+  write acks) holds under churn;
+* bounded post-heal liveness (a probe write lands after the last op).
+
+``run_shard_chaos(seed)`` is a pure function of the seed via the shared
+``nemesis.schedule`` — a failing campaign replays exactly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from memgraph_tpu.exceptions import MemgraphTpuError
+from memgraph_tpu.sharding import ShardPlane, ShardedClient
+from memgraph_tpu.sharding.partition import shard_for_key
+
+from .checker import HistoryLog, check_cluster_history
+from .cluster import wait_for
+from .nemesis import Nemesis, schedule
+
+SHARD_OPS = ("shard_move", "shard_worker_kill")
+
+
+class ShardChaosHarness:
+    """Adapts a ShardPlane to the Nemesis cluster-hook interface
+    (shard targets are shard-id strings from the seeded schedule)."""
+
+    def __init__(self, plane: ShardPlane, history: HistoryLog) -> None:
+        self.plane = plane
+        self.history = history
+
+    def shard_move(self, target: str) -> None:
+        self.plane.shard_move(int(target))
+
+    def shard_kill(self, target: str) -> None:
+        self.plane.kill_worker(int(target))
+
+    def shard_restart(self, target: str) -> None:
+        self.plane.restart_worker(int(target))
+
+
+class _RegisterClient(threading.Thread):
+    """One register key, strictly increasing values, routed writes.
+    Ack events carry (node=owner name, epoch, shard) so the checker can
+    prove per-shard ownership uniqueness."""
+
+    def __init__(self, client: ShardedClient, idx: int, key: str,
+                 history: HistoryLog, ops_counter) -> None:
+        super().__init__(daemon=True)
+        self.client = client
+        self.idx = idx
+        self.key = key
+        self.history = history
+        self.ops = ops_counter
+        self.value = 0
+        self.acked = 0
+        self._halt = threading.Event()
+
+    def one_op(self) -> bool:
+        self.value += 1
+        op = next(self.ops)
+        shard = self.client.shard_for(self.key)
+        self.history.record({"e": "invoke", "op": op,
+                             "client": self.idx, "key": self.key,
+                             "value": self.value})
+        try:
+            _cols, _rows, ack = self.client.write(
+                "MERGE (r:Reg {k: $k}) SET r.v = $v",
+                {"k": self.key, "v": self.value}, key=self.key)
+        except MemgraphTpuError as e:
+            # retries exhausted mid-churn: indeterminate (a prepare may
+            # have landed); the checker treats info as maybe-committed
+            self.history.record({"e": "info", "op": op,
+                                 "err": type(e).__name__})
+            return False
+        self.history.record({"e": "ok", "op": op,
+                             "node": ack.get("owner"),
+                             "epoch": ack["epoch"],
+                             "shard": ack["shard"]})
+        self.acked += 1
+        return True
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            self.one_op()
+            time.sleep(0.05)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def run_shard_chaos(seed: int, rounds: int = 4, n_shards: int = 4,
+                    n_clients: int = 4,
+                    dwell: tuple[float, float] = (0.4, 0.9),
+                    recover: tuple[float, float] = (0.3, 0.6),
+                    heal_window: float = 30.0):
+    """One seeded shard-plane campaign; returns (history, violations,
+    stats) — the same contract as runner.run_chaos."""
+    history = HistoryLog()
+    plane = ShardPlane(n_shards=n_shards).start()
+    harness = ShardChaosHarness(plane, history)
+    try:
+        client = ShardedClient(plane)
+        ops_counter = itertools.count(1)
+        # spread client keys over distinct shards where possible
+        keys, used = [], set()
+        for i in itertools.count():
+            key = f"k{i}"
+            sid = shard_for_key(key, n_shards)
+            if sid not in used or len(keys) >= n_shards:
+                keys.append(key)
+                used.add(sid)
+            if len(keys) == n_clients:
+                break
+        clients = [_RegisterClient(ShardedClient(plane), i, keys[i],
+                                   history, ops_counter)
+                   for i in range(n_clients)]
+        for c in clients:
+            c.start()
+        shard_ids = [str(s) for s in range(n_shards)]
+        sched = schedule(seed, shard_ids, shard_ids, rounds=rounds,
+                         dwell=dwell, recover=recover, ops=SHARD_OPS,
+                         shards=shard_ids)
+        Nemesis(harness, history).run(sched)
+
+        # bounded liveness: a probe write must land post-heal
+        heal_t0 = time.monotonic()
+        probe = clients[0]
+        converged = wait_for(lambda: probe.one_op(),
+                             timeout=heal_window, interval=0.2)
+        if converged:
+            history.record({"e": "converged",
+                            "seconds":
+                                round(time.monotonic() - heal_t0, 2),
+                            "node": "shard-plane",
+                            "epoch": client.plane.map.epoch})
+        for c in clients:
+            c.stop()
+        for c in clients:
+            c.join(timeout=10)
+        # final read: scatter the registers off the (possibly moved)
+        # owners — acked values must all have survived
+        client.refresh_map()
+        final_state = {}
+        for key in keys:
+            _cols, rows = client.read(
+                "MATCH (r:Reg {k: $k}) RETURN r.v", {"k": key},
+                key=key)
+            final_state[key] = rows[0][0] if rows else 0
+        history.record({"e": "final", "node": "shard-plane",
+                        "epoch": plane.map.epoch,
+                        "state": final_state})
+        violations = check_cluster_history(history,
+                                           heal_window=heal_window)
+        stats = {"seed": seed, "rounds": rounds,
+                 "acked": sum(c.acked for c in clients),
+                 "ops": next(ops_counter) - 1,
+                 "converged": converged,
+                 "epoch": plane.map.epoch,
+                 "violations": len(violations)}
+        return history, violations, stats
+    finally:
+        plane.close()

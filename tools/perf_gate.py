@@ -98,6 +98,11 @@ def latest_ppr_json() -> str | None:
     return records[-1] if records else None
 
 
+def latest_oltp_json() -> str | None:
+    records = sorted(glob.glob(os.path.join(REPO, "OLTP_r*.json")))
+    return records[-1] if records else None
+
+
 def check(record: dict, baseline: dict) -> int:
     envelopes = baseline.get("envelopes") or {}
     metric = record.get("metric", "")
@@ -235,6 +240,66 @@ def check_ppr(record: dict, envelopes: dict) -> int:
     return rc
 
 
+def check_sharding(record: dict | None, envelopes: dict) -> int:
+    """r18 shard-scaling envelope over the newest OLTP_r*.json record:
+    the sharded point-read group must beat the single-process aggregate
+    by the declared factor at the declared worker count, the
+    cross-shard 2PC group must match its arithmetic oracle, and an
+    untagged or degraded record can never stand as the scaling
+    headline (a 1-core host's contention-bound curve carries
+    ``degraded: true`` + its core count, and fails here exactly like a
+    CPU-fallback device record would)."""
+    env = envelopes.get("shard_scaling")
+    if env is None:
+        return 0
+    if record is None:
+        log("FAIL: BASELINE.json declares a shard_scaling envelope but "
+            "no OLTP_r*.json record exists — run benchmarks/mgbench.py "
+            "--out OLTP_rN.json")
+        return 1
+    if "degraded" not in record or "cores" not in record:
+        log("FAIL: OLTP record predates the degraded/cores tagging — "
+            "an untagged scaling number cannot be trusted; regenerate "
+            "with the current mgbench.py")
+        return 1
+    if record["degraded"]:
+        log(f"FAIL: OLTP record is degraded "
+            f"({record.get('degraded_reason', 'no reason recorded')}); "
+            "a contention-bound curve can never stand in for the "
+            "shard-scaling headline")
+        return 1
+    workers = int(env.get("workers", 4))
+    group = next((g for g in record.get("groups", [])
+                  if g.get("name") == f"point_read_sharded_{workers}w"),
+                 None)
+    rc = 0
+    if group is None or "speedup_vs_single_process" not in group:
+        log(f"FAIL: record has no point_read_sharded_{workers}w group "
+            "with a speedup_vs_single_process measurement")
+        rc = 1
+    else:
+        got = float(group["speedup_vs_single_process"])
+        need = float(env.get("min_speedup", 3.0))
+        if got < need:
+            log(f"FAIL: sharded point-read speedup {got:.2f}x at "
+                f"{workers} workers < required {need:.1f}x — the "
+                "plane stopped scaling")
+            rc = 1
+        else:
+            log(f"PASS: sharded point-read speedup {got:.2f}x at "
+                f"{workers} workers (>= {need:.1f}x)")
+    twopc = next((g for g in record.get("groups", [])
+                  if g.get("name") == "cross_shard_write_2pc"), None)
+    if twopc is None or not twopc.get("oracle_match"):
+        log("FAIL: cross_shard_write_2pc group missing or its "
+            "arithmetic oracle did not match — cross-shard atomicity "
+            "is broken or unmeasured")
+        rc = 1
+    else:
+        log("PASS: cross-shard 2PC group matches its oracle")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="perf_gate")
     ap.add_argument("--json", help="check an existing bench JSON record")
@@ -283,6 +348,16 @@ def main(argv=None) -> int:
                 ppr_record = json.load(f)
         rc = rc or check_ppr(ppr_record,
                              baseline.get("envelopes") or {})
+        # the OLTP shard-scaling record rides the same --latest run
+        oltp_path = latest_oltp_json()
+        oltp_record = None
+        if oltp_path is not None:
+            log(f"checking newest OLTP record "
+                f"{os.path.basename(oltp_path)}")
+            with open(oltp_path) as f:
+                oltp_record = json.load(f)
+        rc = rc or check_sharding(oltp_record,
+                                  baseline.get("envelopes") or {})
     return rc
 
 
