@@ -342,13 +342,16 @@ class ShardPlane:
         """Proactive respawn of a killed owner (nemesis heal); a no-op
         when the worker is alive."""
         worker = self.owner(shard_id)
-        try:
-            pid, _status = os.waitpid(worker.pid, os.WNOHANG)
-        except ChildProcessError:
-            pid = worker.pid
-        if pid == 0:
-            return   # still alive
-        self._handle_dead(shard_id, worker)
+        with worker.lock:
+            if worker.closed:
+                return   # already replaced by another path
+            try:
+                pid, _status = os.waitpid(worker.pid, os.WNOHANG)
+            except ChildProcessError:
+                pid = worker.pid
+            if pid == 0:
+                return   # still alive
+            self._handle_dead(shard_id, worker)
 
     # -- shard move ----------------------------------------------------------
 
@@ -379,6 +382,7 @@ class ShardPlane:
             generation = self._generations.get(shard_id, 0) + 1
             self._generations[shard_id] = generation
         target = self._spawn(shard_id, generation)
+        ceded = False
         try:
             _status, begin = self._direct(source, "begin_move", {})
             self._direct(target, "apply_snapshot",
@@ -392,15 +396,31 @@ class ShardPlane:
             # epoch bump INSIDE the placement authority: from here a
             # stale-map client's write cannot produce an accepted ack
             self.map = self.placement.assign(shard_id, target.name)
+            ceded = True
             _status, end = self._direct(source, "end_move",
                                         {"epoch": self.map.epoch})
             if end["frames"]:
                 self._direct(target, "apply_frames",
                              {"frames": end["frames"]})
         except (OSError, EOFError, MemgraphTpuError):
-            # presumed abort of the move: retire the half-built target;
-            # the source keeps (or has already ceded) ownership
+            # presumed abort of the move: retire the half-built target
             self._retire(target)
+            if ceded:
+                # the epoch already moved to the target: hand ownership
+                # back through the placement authority (fresh epoch, so
+                # the grant un-fences an end_move-fenced source) — else
+                # the still-installed source stale-bounces every write
+                # at the new map epoch forever
+                try:
+                    self.map = self.placement.assign(shard_id,
+                                                     source.name)
+                    self._grant(shard_id, source)
+                except (OSError, EOFError, MemgraphTpuError):
+                    log.exception(
+                        "shard %d: could not restore source owner %s "
+                        "after aborted move; shard stays "
+                        "write-unavailable until reassigned", shard_id,
+                        source.name)
             raise
         with self._lock:
             shared_write(self, "_workers")

@@ -242,8 +242,24 @@ def _hashable(value):
 
 
 def _sort_key(value):
-    # Cypher orders NULL last ascending; mirror with a 2-tuple
-    return (value is None, value)
+    """Total order mirroring Cypher orderability: NULL sorts last
+    ascending, and mixed-type columns group by a type rank (maps <
+    lists < strings < booleans < numbers) instead of letting list.sort
+    raise TypeError on a cross-shard heterogeneous column."""
+    if value is None:
+        return (1, 0, 0)
+    if isinstance(value, bool):            # before int: bool IS an int
+        return (0, 3, value)
+    if isinstance(value, (int, float)):
+        return (0, 4, value)
+    if isinstance(value, str):
+        return (0, 2, value)
+    if isinstance(value, list):
+        return (0, 1, tuple(_sort_key(v) for v in value))
+    if isinstance(value, dict):
+        return (0, 0, tuple(sorted((k, _sort_key(v))
+                                   for k, v in value.items())))
+    return (0, 5, str(value))
 
 
 class ShardedClient:
@@ -424,14 +440,20 @@ class ShardedClient:
             by_shard.setdefault(self.map.shard_for(key), []).append(
                 {"query": query, "params": params or {}})
         txn_id = f"xs-{uuid.uuid4().hex[:12]}-{next(self._txn_seq)}"
+        shards = sorted(by_shard)
         prepared: list[int] = []
         try:
-            for shard in sorted(by_shard):
+            for shard in shards:
                 self._prepare_one(shard, txn_id, by_shard[shard])
                 prepared.append(shard)
         except Exception:
             global_metrics.increment("shard.twopc_aborts_total")
-            for shard in prepared:
+            # abort every touched shard INCLUDING the one whose prepare
+            # failed: it journals before voting, so a crash mid-prepare
+            # can leave a pending entry that the abort must prune (else
+            # it accumulates, and a late commit for this txn_id would
+            # replay writes the client was told aborted)
+            for shard in shards[:len(prepared) + 1]:
                 self._decide_one(shard, txn_id, "abort",
                                  best_effort=True)
             raise

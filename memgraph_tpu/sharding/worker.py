@@ -230,23 +230,31 @@ def _handle(state: _WorkerState, op: str, payload: dict):
         txn_id = str(payload["txn_id"])
         decision = payload["decision"]
         interp = state.held_2pc.pop(txn_id, None)
-        journaled = state.pending_2pc.pop(txn_id, None)
-        if journaled is not None:
-            state._save_pending()
         if interp is not None:
             interp.execute("COMMIT" if decision == "commit"
                            else "ROLLBACK")
+            # journal removal strictly AFTER the decision applied: a
+            # crash in between leaves the entry behind, and a re-driven
+            # commit replays it — never the reverse (entry gone while
+            # the commit was lost, a half-committed cross-shard txn)
+            if state.pending_2pc.pop(txn_id, None) is not None:
+                state._save_pending()
             state.ops += 1
             return "ok", {"shard": state.shard_id, "epoch": state.epoch}
         if decision == "abort":
-            # presumed abort: an unknown txn was never prepared here, or
-            # died with the previous incarnation — nothing to undo
+            # presumed abort: nothing committed here, but a crash
+            # between prepare and decide may have left a journal entry
+            # — prune it so it can never replay (and never accumulates)
+            if state.pending_2pc.pop(txn_id, None) is not None:
+                state._save_pending()
             return "ok", {"shard": state.shard_id, "epoch": state.epoch}
+        journaled = state.pending_2pc.get(txn_id)
         if journaled is not None:
             # crash between prepare and decide: the journaled
             # statements re-execute against the recovered store (the
             # same presumed-commit direction replicas use for voted
-            # frames), atomically via one held transaction
+            # frames), atomically via one held transaction; the entry
+            # is removed only after that commit succeeds
             interp = state._make_interp()
             interp.execute("BEGIN")
             try:
@@ -257,6 +265,8 @@ def _handle(state: _WorkerState, op: str, payload: dict):
                 interp.execute("ROLLBACK")
                 raise
             interp.execute("COMMIT")
+            state.pending_2pc.pop(txn_id, None)
+            state._save_pending()
             state.ops += 1
             return "ok", {"shard": state.shard_id, "epoch": state.epoch,
                           "replayed": True}

@@ -179,6 +179,18 @@ def test_merge_rows_unit():
     assert merged == [[1], [2], [3]]
 
 
+def test_merge_rows_mixed_type_order_by_is_total():
+    """A heterogeneous ORDER BY column across shards must sort by the
+    Cypher type rank (strings < numbers, NULL last ascending), not
+    raise TypeError out of list.sort."""
+    plan = plan_merge("MATCH (n) RETURN n.v ORDER BY n.v")
+    merged = merge_rows(plan, [[[3], ["b"], [None]], [[1], ["a"]]])
+    assert merged == [["a"], ["b"], [1], [3], [None]]
+    plan = plan_merge("MATCH (n) RETURN n.v ORDER BY n.v DESC")
+    merged = merge_rows(plan, [[[True], [2.5]], [["x"], [None]]])
+    assert merged == [[None], [2.5], [True], ["x"]]
+
+
 # --------------------------------------------------------------------------
 # fencing: epoch-monotonic refresh + stale-map bounce
 # --------------------------------------------------------------------------
@@ -309,6 +321,38 @@ def test_2pc_worker_killed_between_prepare_and_commit(plane):
             "MATCH (a:Acct {id: $id}) RETURN count(a)", {"id": k},
             key=k)
         assert rows == [[1]], f"key {k} lost its voted write"
+    # the replayed entry left the journal only AFTER its commit — and
+    # it did leave, on both the live path (s1) and the replay path (s2)
+    health = plane.health()
+    assert health[s1]["pending_2pc"] == []
+    assert health[s2]["pending_2pc"] == []
+
+
+def test_2pc_abort_prunes_crashed_participants_journal(plane):
+    """A participant that journaled its vote then died must not keep
+    the pending entry past the abort decision (presumed-abort journal
+    GC): a later buggy commit for the txn_id must find nothing to
+    replay, and health output must not accumulate dead entries."""
+    client = ShardedClient(plane)
+    _k1, k2 = _two_keys_on_distinct_shards(client)
+    s2 = client.shard_for(k2)
+    txn_id = "xs-test-prune"
+    plane.request(s2, "prepare",
+                  {"txn_id": txn_id, "epoch": client.map.epoch,
+                   "statements": [{"query": "CREATE (:Acct {id: $id})",
+                                   "params": {"id": k2}}]})
+    plane.kill_worker(s2)
+    # the respawned worker recovers the journal entry...
+    client._decide_one(s2, txn_id, "abort", best_effort=True)
+    # ...and the abort prunes it, durably
+    assert plane.health()[s2]["pending_2pc"] == []
+    status, _body = plane.request(s2, "decide",
+                                  {"txn_id": txn_id,
+                                   "decision": "commit"},
+                                  raise_typed=False)
+    assert status == "unknown_txn"
+    _c, rows = client.read("MATCH (a:Acct) RETURN count(a)")
+    assert rows == [[0]]
 
 
 def test_2pc_killed_before_decision_aborts_clean(plane):
@@ -377,6 +421,36 @@ def test_shard_move_preserves_data_and_live_writes(plane):
             key=key)
         assert rows == [[1]], f"acked write {key} lost in the move"
     assert _metric("shard.moves_total") > 0
+
+
+def test_shard_move_failure_after_epoch_bump_restores_source(plane):
+    """If the move dies AFTER the placement epoch moved to the target,
+    the source must be re-assigned (fresh epoch) and re-granted —
+    otherwise it stale-bounces every write at the new map epoch forever
+    and the shard is permanently write-unavailable."""
+    client = ShardedClient(plane)
+    client.write("CREATE (:User {id: $id})", {"id": 1}, key=1)
+    shard = client.shard_for(1)
+    real_direct = plane._direct
+
+    def flaky(worker, op, payload):
+        if op == "end_move":
+            raise MemgraphTpuError("injected cutover failure")
+        return real_direct(worker, op, payload)
+
+    plane._direct = flaky
+    try:
+        with pytest.raises(MemgraphTpuError, match="injected"):
+            plane.shard_move(shard)
+    finally:
+        plane._direct = real_direct
+    # ownership came back to the source at a fresh epoch: routed
+    # writes succeed after a refresh instead of bouncing forever
+    _c, _r, ack = client.write(
+        "MATCH (n:User {id: 1}) SET n.x = 1", key=1)
+    assert ack["epoch"] == plane.map.epoch
+    _c, rows = client.read("MATCH (n:User {id: 1}) RETURN n.x", key=1)
+    assert rows == [[1]]
 
 
 def test_worker_crash_typed_error_and_wal_recovery(plane):
