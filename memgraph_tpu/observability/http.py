@@ -84,6 +84,15 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     "device": {name: value for name, _k, value
                                in global_metrics.snapshot()
                                if name.startswith("jit.")},
+                    # incremental analytics plane (r19, mgdelta):
+                    # delta applies/compactions/fallbacks, warm-start
+                    # counters, resident-generation gauge (local plus
+                    # the daemon's counters mirrored through health)
+                    "delta": {name: value for name, _k, value
+                              in global_metrics.snapshot()
+                              if name.startswith(
+                                  ("delta.",
+                                   "kernel_server.daemon.delta."))},
                     # sharded OLTP execution plane (r18, mgshard):
                     # per-shard ops/latency/queue-depth, 2PC counters,
                     # move durations, routing-table epoch

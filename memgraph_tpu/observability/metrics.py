@@ -116,6 +116,15 @@ STAT_NAMES = (
     # device compile plane (r17, mgxla): runtime witness for the static
     # compile budget — every XLA backend compile bumps it
     "jit.compile_total",
+    # incremental analytics plane (r19, mgdelta): commit-to-fresh-result
+    "delta.applied_total",          # EdgeDelta splices applied
+    "delta.compacted_total",        # bounded-accumulation full rebuilds
+    "delta.fallback_rebuild_total",  # wrapped log / failed splice colds
+    "delta.edge_count",             # histogram: edges per applied delta
+    "delta.warm_start_total",
+    "delta.cold_start_total",       # LOUD monotone-unsafe cold starts
+    "delta.warm_start_iterations",  # histogram: iterations after warm
+    "delta.resident_generations",   # resident graph generations gauge
     # analytics / checkpoint plane
     "analytics.checkpoint.saved_total",
     "analytics.checkpoint.restored_total",

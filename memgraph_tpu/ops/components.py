@@ -31,26 +31,38 @@ def _wcc_epilogue(comp, acc, env, P):
 
 
 def weakly_connected_components(graph: DeviceGraph,
-                                max_iterations: int = 200, mesh=None):
+                                max_iterations: int = 200, mesh=None,
+                                comp0=None):
     """Returns (component_id[:n_nodes], iterations). Component ids are the
     minimum dense node index in each component.
 
     `mesh` (MeshContext | Mesh | int | None) routes through the
-    multi-chip layer; see ops.pagerank.pagerank."""
+    multi-chip layer; see ops.pagerank.pagerank.
+
+    `comp0` warm-starts the min-label propagation from a previous
+    assignment — callers must hold the ops/delta.py monotone contract
+    (only valid when the delta since that assignment ADDED edges;
+    min-labels can merge components but never split them)."""
     backend, ctx = S.route_backend(graph, mesh, semiring="min_first")
     if backend == "mesh":
         from ..parallel.analytics import components_mesh
         with S.backend_extent("mesh"):
             return components_mesh(graph, ctx,
-                                   max_iterations=max_iterations)
-    comp0 = np.arange(graph.n_pad, dtype=np.int32)
+                                   max_iterations=max_iterations,
+                                   comp0=comp0)
+    start = np.arange(graph.n_pad, dtype=np.int32)
+    if comp0 is not None:
+        arr = np.asarray(comp0, dtype=np.int32)[:graph.n_nodes]
+        start[:len(arr)] = arr
     comp, _, iters = S.fixpoint(
         "min_first",
         arrays={"src": graph.src_idx, "dst": graph.col_idx},
-        x0=jnp.asarray(comp0), n_out=graph.n_pad,
+        x0=jnp.asarray(start), n_out=graph.n_pad,
         epilogue=_wcc_epilogue, max_iterations=max_iterations,
         metric="changed", direction="both")
-    return comp[:graph.n_nodes], int(iters)
+    # one fused host transfer for the whole result tuple (MG009)
+    comp_h, iters_h = jax.device_get((comp[:graph.n_nodes], iters))  # mglint: disable=MG009 — results must ship host; this IS the single fused transfer for the whole tuple
+    return comp_h, int(iters_h)
 
 
 @partial(jax.jit, static_argnames=("n_pad", "max_iterations"))

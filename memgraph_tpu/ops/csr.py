@@ -584,7 +584,20 @@ class GraphCache:
         # touching a large fraction of the graph fall back to the full
         # export, whose delta-free fast path is cheaper per edge
         if newest is not None:
+            from ..storage.storage import ChangeLogUnknowable
             changed = storage.changes_between(newest[0], version)
+            if isinstance(changed, ChangeLogUnknowable):
+                # typed wrap verdict: the log cannot reconstruct the
+                # gap — full export, LOUDLY counted (a silently-partial
+                # delta here would cache a wrong snapshot)
+                import logging
+                from ..observability.metrics import global_metrics
+                global_metrics.increment("delta.fallback_rebuild_total")
+                logging.getLogger(__name__).info(
+                    "change log unknowable (%s) for versions (%d, %d]; "
+                    "full CSR export", changed.reason, newest[0],
+                    version)
+                changed = None
             if changed is not None and \
                     len(changed) <= max(1024, newest[1].n_nodes // 5):
                 try:
@@ -608,9 +621,12 @@ class GraphCache:
         # the analytics layer can refresh O(delta) instead of replanning
         # (ops/pagerank._try_delta_plan).
         if base is not None:
+            from ..storage.storage import ChangeLogUnknowable
             base_version, base_g = base
             changed = storage.changes_between(base_version, version)
-            if changed is not None \
+            # an unknowable gap (typed wrap verdict) anchors nothing:
+            # the MXU layer would replan from an incomplete diff
+            if isinstance(changed, frozenset) \
                     and getattr(base_g, "_mxu_state", None) is not None:
                 object.__setattr__(g, "_delta_ctx", (base_g, changed))
         with self._lock:

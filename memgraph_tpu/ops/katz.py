@@ -12,6 +12,7 @@ the r10 core: pagerank's fast path is now every plus-times algorithm's).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -52,12 +53,14 @@ def _katz_normalized(x, normalized: bool):
 def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
                     max_iterations: int = 100, tol: float = 1e-6,
                     normalized: bool = False, mesh=None,
-                    precision: str = "f32"):
+                    precision: str = "f32", x0=None):
     """Returns (centralities[:n_nodes], error, iterations).
 
     `mesh` (MeshContext | Mesh | int | None) routes through the
     multi-chip layer; `precision` selects the f32/bf16/int8 variants
-    (see ops.pagerank.pagerank)."""
+    (see ops.pagerank.pagerank). `x0` warm-starts from a previous
+    solution (contraction for alpha < 1/λ_max — same fixpoint at the
+    same tol from any seed; ops/delta.py commit-then-CALL contract)."""
     backend, ctx = S.route_backend(graph, mesh, semiring="plus_times",
                                    precision=precision)
     if backend == "mesh":
@@ -65,15 +68,24 @@ def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
         with S.backend_extent("mesh"):
             return katz_mesh(graph, ctx, alpha=alpha, beta=beta,
                              max_iterations=max_iterations, tol=tol,
-                             normalized=normalized, precision=precision)
+                             normalized=normalized, precision=precision,
+                             x0=x0)
     if backend == "mxu":
         x, err, iters = S.mxu_fixpoint(
             graph, epilogue=_katz_mxu_epilogue,
             params={"alpha": np.float32(alpha), "beta": np.float32(beta)},
             max_iterations=max_iterations, tol=tol, normalize=False,
-            precision=precision, cache_tag="katz")
-        return (np.asarray(_katz_normalized(x, normalized))[:graph.n_nodes],
-                float(err), int(iters))
+            precision=precision, cache_tag="katz", x0=x0)
+        # mxu_fixpoint already shipped host values; the asarray below
+        # only undoes the jnp normalize (one transfer, not a split)
+        return (np.asarray(_katz_normalized(x, normalized))[:graph.n_nodes],  # mglint: disable=MG009 — x/err/iters are host values from mxu_fixpoint; this is the single normalize readback
+                float(err), int(iters))  # mglint: disable=MG009 — host floats from mxu_fixpoint
+    x0_pad = None
+    if x0 is not None:
+        buf = np.zeros(graph.n_pad, dtype=np.float32)
+        arr = np.asarray(x0, dtype=np.float32)[:graph.n_nodes]
+        buf[:len(arr)] = arr
+        x0_pad = jnp.asarray(buf)
     x, err, iters = S.fixpoint(
         "plus_times",
         arrays={"src": graph.csc_src, "dst": graph.csc_dst,
@@ -82,9 +94,12 @@ def katz_centrality(graph: DeviceGraph, alpha: float = 0.2, beta: float = 1.0,
                 "alpha": np.float32(alpha), "beta": np.float32(beta),
                 "tol": np.float32(tol)},
         n_out=graph.n_pad, setup=_katz_setup, epilogue=_katz_epilogue,
-        max_iterations=max_iterations, sorted=True, precision=precision)
+        max_iterations=max_iterations, sorted=True, precision=precision,
+        x0=x0_pad)
     x = _katz_normalized(x, normalized)
-    return x[:graph.n_nodes], float(err), int(iters)
+    # one fused host transfer for the whole result tuple (MG009)
+    x_h, err_h, iters_h = jax.device_get((x[:graph.n_nodes], err, iters))  # mglint: disable=MG009 — results must ship host; this IS the single fused transfer for the whole tuple
+    return x_h, float(err_h), int(iters_h)
 
 
 def _hits_step(x, A, env, P, n_out):

@@ -71,12 +71,16 @@ def _labelprop_epilogue(labels, proposed, env, P):
 
 def label_propagation(graph: DeviceGraph, max_iterations: int = 30,
                       self_weight: float = 0.0, directed: bool = False,
-                      mesh=None):
+                      mesh=None, labels0=None):
     """Returns (community_label[:n_nodes], iterations).
 
     Labels are dense node indices (a community's label is one member's id).
     `mesh` (MeshContext | Mesh | int | None) routes through the
     multi-chip layer; see ops.pagerank.pagerank.
+
+    `labels0` warm-starts the election from a previous labeling —
+    callers must hold the ops/delta.py monotone contract (adds-only
+    deltas; a removal must cold-start LOUDLY).
     """
     backend, ctx = S.route_backend(graph, mesh, semiring="max_min")
     if backend == "mesh":
@@ -84,19 +88,25 @@ def label_propagation(graph: DeviceGraph, max_iterations: int = 30,
         with S.backend_extent("mesh"):
             return label_propagation_mesh(
                 graph, ctx, max_iterations=max_iterations,
-                self_weight=self_weight, directed=directed)
+                self_weight=self_weight, directed=directed,
+                labels0=labels0)
     if directed:
         src2, dst2, w2 = graph.src_idx, graph.col_idx, graph.weights
     else:
         src2 = jnp.concatenate([graph.src_idx, graph.col_idx])
         dst2 = jnp.concatenate([graph.col_idx, graph.src_idx])
         w2 = jnp.concatenate([graph.weights, graph.weights])
-    labels0 = np.arange(graph.n_pad, dtype=np.int32)
+    start = np.arange(graph.n_pad, dtype=np.int32)
+    if labels0 is not None:
+        arr = np.asarray(labels0, dtype=np.int32)[:graph.n_nodes]
+        start[:len(arr)] = arr
     labels, _, iters = S.fixpoint(
         "max_min",
         arrays={"src": src2, "dst": dst2, "w": w2},
         params={"self_weight": np.float32(self_weight)},
-        x0=jnp.asarray(labels0), n_out=graph.n_pad,
+        x0=jnp.asarray(start), n_out=graph.n_pad,
         step=_labelprop_step, epilogue=_labelprop_epilogue,
         max_iterations=max_iterations, metric="changed")
-    return labels[:graph.n_nodes], int(iters)
+    # one fused host transfer for the whole result tuple (MG009)
+    labels_h, iters_h = jax.device_get((labels[:graph.n_nodes], iters))  # mglint: disable=MG009 — results must ship host; this IS the single fused transfer for the whole tuple
+    return labels_h, int(iters_h)

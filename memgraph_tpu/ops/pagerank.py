@@ -139,7 +139,7 @@ def _try_delta_plan(graph: DeviceGraph):
 
 
 def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol,
-                      precision: str = "f32"):
+                      precision: str = "f32", x0=None):
     """Large-graph path: gather-free MXU kernel with the plan cached on
     the (immutable) DeviceGraph snapshot. Successor snapshots of a
     mutated graph refresh O(delta) via DeltaPlan side-nets instead of
@@ -179,16 +179,25 @@ def _pagerank_via_mxu(graph: DeviceGraph, damping, max_iterations, tol,
             run = spmv_mxu.make_pagerank_kernel(
                 plan, route_dtype=jnp.bfloat16)
             object.__setattr__(graph, "_mxu_run_bf16", run)
+    x0_flat = None
+    if x0 is not None:
+        # warm seed in the plan's OUT labeling (flat node space); the
+        # kernel renormalizes nothing — pass unit mass in
+        x0 = np.asarray(x0, dtype=np.float32)[:graph.n_nodes]
+        total = float(x0.sum())
+        if np.isfinite(total) and total > 0.0:
+            x0_flat = np.zeros(len(plan.valid_out), dtype=np.float32)
+            x0_flat[plan.out_relabel] = x0 / np.float32(total)
     with S.backend_extent("mxu", record_iterate=True):
         # None = uniform start computed on-device (saves a transfer)
-        rank, err, iters = run(None, np.float32(damping),
+        rank, err, iters = run(x0_flat, np.float32(damping),
                                int(max_iterations), np.float32(tol))
     return np.asarray(rank)[plan.out_relabel], float(err), int(iters)
 
 
 def pagerank(graph: DeviceGraph, damping: float = 0.85,
              max_iterations: int = 100, tol: float = 1e-6, mesh=None,
-             precision: str = "f32"):
+             precision: str = "f32", x0=None):
     """Returns (ranks[:n_nodes], error, iterations).
 
     `mesh` routes the computation through the multi-chip layer
@@ -200,6 +209,11 @@ def pagerank(graph: DeviceGraph, damping: float = 0.85,
     `precision` — "f32" (exact), "bf16" (contributions rounded, f32
     accumulation) or "int8" (quantized streaming; segment backend only);
     error bounds: semiring.PRECISION_BOUNDS.
+
+    `x0` — optional (n_nodes,) previous solution; warm-starts the
+    fixpoint on every backend (ops/delta.py commit-then-CALL contract:
+    PageRank is a contraction, any seed converges to the same answer at
+    the same tol — the seed only cuts the iteration count).
     """
     from ..utils.jax_cache import ensure_compile_cache
     ensure_compile_cache()
@@ -213,10 +227,18 @@ def pagerank(graph: DeviceGraph, damping: float = 0.85,
         with S.backend_extent("mesh"):
             return pagerank_mesh(graph, ctx, damping=damping,
                                  max_iterations=max_iterations, tol=tol,
-                                 precision=precision)
+                                 precision=precision, x0=x0)
     if backend == "mxu":
         return _pagerank_via_mxu(graph, damping, max_iterations, tol,
-                                 precision)
+                                 precision, x0=x0)
+    x0_pad = None
+    if x0 is not None:
+        x0 = np.asarray(x0, dtype=np.float32)[:graph.n_nodes]
+        total = float(x0.sum())
+        if np.isfinite(total) and total > 0.0:
+            buf = np.zeros(graph.n_pad, dtype=np.float32)
+            buf[:len(x0)] = x0 / np.float32(total)
+            x0_pad = jnp.asarray(buf)
     rank, err, iters = S.fixpoint(
         "plus_times",
         arrays={"src": graph.csc_src, "dst": graph.csc_dst,
@@ -227,7 +249,7 @@ def pagerank(graph: DeviceGraph, damping: float = 0.85,
                 "tol": np.float32(tol)},
         n_out=graph.n_pad, setup=_pagerank_setup,
         epilogue=_pagerank_epilogue, max_iterations=max_iterations,
-        sorted=True, precision=precision)
+        sorted=True, precision=precision, x0=x0_pad)
     return rank[:graph.n_nodes], float(err), int(iters)
 
 

@@ -495,14 +495,20 @@ _mxu_plan_guard = threading.Lock()
 
 def mxu_fixpoint(graph, *, epilogue, params, max_iterations, tol,
                  normalize: bool = True, precision: str = "f32",
-                 cache_tag: str = "generic", x0_default: str = "zeros"):
+                 cache_tag: str = "generic", x0_default: str = "zeros",
+                 x0=None):
     """Run a ⊕ = sum fixpoint on the gather-free MXU backend.
 
     Builds (or reuses, cached on the immutable DeviceGraph snapshot) a
     `spmv_mxu` plan with ``normalize=True`` baking w/out-weight-sum
     multipliers (the stochastic matrix pagerank iterates) or plain w
     (katz's A^T), then runs `make_semiring_kernel` with the given fused
-    epilogue.  Returns (x_original_ids, err, iters)."""
+    epilogue.  Returns (x_original_ids, err, iters).
+
+    ``x0`` — optional (n_nodes,) warm-start seed in ORIGINAL node ids
+    (ops/delta.py commit-then-CALL); mapped into the plan's OUT
+    labeling before dispatch. None keeps the on-device default start
+    (``x0_default``), which saves the host->device transfer."""
     import jax.numpy as jnp
     from . import spmv_mxu
     _check_precision(precision)
@@ -534,7 +540,12 @@ def mxu_fixpoint(graph, *, epilogue, params, max_iterations, tol,
                     plan, epilogue=epilogue, route_dtype=route_dtype,
                     x0_default=x0_default))
     plan, run = cache[key]
+    x0_flat = None
+    if x0 is not None:
+        x0_flat = np.zeros(len(plan.valid_out), dtype=np.float32)
+        x0_flat[plan.out_relabel] = \
+            np.asarray(x0, dtype=np.float32)[:graph.n_nodes]
     with backend_extent("mxu", record_iterate=True):
-        x, err, iters = run(None, params, int(max_iterations),
+        x, err, iters = run(x0_flat, params, int(max_iterations),
                             np.float32(tol))
     return np.asarray(x)[plan.out_relabel], float(err), int(iters)

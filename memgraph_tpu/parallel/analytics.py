@@ -79,31 +79,34 @@ def _resume_kw(checkpoint_every, job, store, report, retry):
 def pagerank_mesh(graph: DeviceGraph, ctx: MeshContext,
                   damping: float = 0.85, max_iterations: int = 100,
                   tol: float = 1e-6, *, precision: str = "f32",
+                  x0=None,
                   checkpoint_every: int | None = None,
                   job: str | None = None, store=None, report=None,
                   retry=None):
-    """Sharded PageRank; same contract as ops.pagerank.pagerank."""
+    """Sharded PageRank; same contract as ops.pagerank.pagerank.
+    ``x0`` warm-starts from a previous solution (ops/delta.py)."""
     from .distributed import pagerank_partition_centric
     scsr = _shard_traced(graph, ctx, by="src")
     return pagerank_partition_centric(
         scsr, ctx, damping=damping, max_iterations=max_iterations,
-        tol=tol, precision=precision,
+        tol=tol, precision=precision, x0=x0,
         **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
 def katz_mesh(graph: DeviceGraph, ctx: MeshContext, alpha: float = 0.2,
               beta: float = 1.0, max_iterations: int = 100,
               tol: float = 1e-6, normalized: bool = False, *,
-              precision: str = "f32",
+              precision: str = "f32", x0=None,
               checkpoint_every: int | None = None, job: str | None = None,
               store=None, report=None, retry=None):
-    """Sharded Katz centrality; same contract as ops.katz.katz_centrality."""
+    """Sharded Katz centrality; same contract as ops.katz.katz_centrality.
+    ``x0`` warm-starts from a previous solution (ops/delta.py)."""
     from .distributed import katz_partition_centric
     scsr = _shard_traced(graph, ctx, by="src")
     return katz_partition_centric(
         scsr, ctx, alpha=alpha, beta=beta,
         max_iterations=max_iterations, tol=tol, normalized=normalized,
-        precision=precision,
+        precision=precision, x0=x0,
         **_resume_kw(checkpoint_every, job, store, report, retry))
 
 
@@ -111,31 +114,36 @@ def label_propagation_mesh(graph: DeviceGraph, ctx: MeshContext,
                            max_iterations: int = 30,
                            self_weight: float = 0.0,
                            directed: bool = False, *,
+                           labels0=None,
                            checkpoint_every: int | None = None,
                            job: str | None = None, store=None,
                            report=None, retry=None):
     """Sharded label propagation; same contract as
-    ops.labelprop.label_propagation."""
+    ops.labelprop.label_propagation. ``labels0`` warm-starts the
+    election (adds-only deltas only — ops/delta.py monotone gate)."""
     from .distributed import labelprop_partition_centric
     scsr = _shard_traced(graph, ctx, by="dst", doubled=not directed)
     labels, iters = labelprop_partition_centric(
         scsr, ctx, max_iterations=max_iterations,
-        self_weight=self_weight,
+        self_weight=self_weight, labels0=labels0,
         **_resume_kw(checkpoint_every, job, store, report, retry))
     return labels, iters
 
 
 def components_mesh(graph: DeviceGraph, ctx: MeshContext,
                     max_iterations: int = 200, *,
+                    comp0=None,
                     checkpoint_every: int | None = None,
                     job: str | None = None, store=None, report=None,
                     retry=None):
     """Sharded WCC; same contract as
-    ops.components.weakly_connected_components."""
+    ops.components.weakly_connected_components. ``comp0`` warm-starts
+    the min-label propagation (adds-only deltas only — ops/delta.py
+    monotone gate)."""
     from .distributed import wcc_partition_centric
     scsr = _shard_traced(graph, ctx, by="src")
     return wcc_partition_centric(
-        scsr, ctx, max_iterations=max_iterations,
+        scsr, ctx, max_iterations=max_iterations, comp0=comp0,
         **_resume_kw(checkpoint_every, job, store, report, retry))
 
 

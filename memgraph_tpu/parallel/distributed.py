@@ -526,11 +526,27 @@ def _run_pc_resumable(*, algo, scsr, ctx, chunk_of, carry0, iter_index,
     return carry
 
 
+def _warm_vertex_vector(x0, scsr: ShardedCSR, dtype, pad_value=None):
+    """Pad a warm-start (n_nodes,) solution to the mesh's n_pad2 vertex
+    space. ``pad_value=None`` fills padding rows with their own index
+    (the label-algorithm convention); a scalar fills directly. The
+    returned buffer is FRESH — safe to donate into the chunk carry."""
+    if pad_value is None:
+        v = np.arange(scsr.n_pad2, dtype=dtype)
+    else:
+        v = np.full(scsr.n_pad2, pad_value, dtype=dtype)
+    x0 = np.asarray(x0)
+    n = min(len(x0), scsr.n_nodes)
+    v[:n] = x0[:n].astype(dtype, copy=False)
+    return v
+
+
 def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                                damping: float = 0.85,
                                max_iterations: int = 100,
                                tol: float = 1e-6, *,
                                precision: str = "f32",
+                               x0=None,
                                checkpoint_every: int = 0,
                                job: str | None = None, store=None,
                                retry=None, chunk_deadline_s=None,
@@ -547,6 +563,14 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     the f32 accumulation (semiring.PRECISION_BOUNDS documents the error
     budget); the collective payload stays f32.
 
+    `x0` (optional, (n_nodes,) f32) warm-starts the power iteration from
+    a previous solution (ops/delta.py commit-then-CALL): PageRank is a
+    contraction with a unique fixpoint, so any seed converges to the
+    same answer at the same tol — the seed only changes the iteration
+    count. The seed is renormalized to unit mass and rides the SAME
+    compiled chunk kernel (x0 is data, not structure: no recompile, the
+    carry donation covers it).
+
     `checkpoint_every=k` (> 0) checkpoints the loop carry to host memory
     every k iterations and resumes from the last checkpoint after a
     device fault — re-executing at most k iterations, bit-exact to an
@@ -557,9 +581,19 @@ def pagerank_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
         raise ValueError("pagerank needs a src-owned ShardedCSR")
     fn = _pc_cached("pagerank", _pc_pagerank_build, ctx,
                     scsr.block, scsr.n_shards, precision)
-    ids = np.arange(scsr.n_pad2, dtype=np.int64)
-    rank0 = (ids < scsr.n_nodes).astype(np.float32) \
-        / np.float32(scsr.n_nodes)
+    if x0 is None:
+        ids = np.arange(scsr.n_pad2, dtype=np.int64)
+        rank0 = (ids < scsr.n_nodes).astype(np.float32) \
+            / np.float32(scsr.n_nodes)
+    else:
+        rank0 = _warm_vertex_vector(x0, scsr, np.float32, pad_value=0.0)
+        total = float(rank0.sum())
+        if not np.isfinite(total) or total <= 0.0:
+            ids = np.arange(scsr.n_pad2, dtype=np.int64)
+            rank0 = (ids < scsr.n_nodes).astype(np.float32) \
+                / np.float32(scsr.n_nodes)
+        else:
+            rank0 /= np.float32(total)
     carry0 = (rank0,
               np.full((scsr.n_shards,), np.inf, dtype=np.float32),
               np.float32(np.inf), np.int32(0))
@@ -631,16 +665,22 @@ def katz_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                            alpha: float = 0.2, beta: float = 1.0,
                            max_iterations: int = 100, tol: float = 1e-6,
                            normalized: bool = False, *,
-                           precision: str = "f32",
+                           precision: str = "f32", x0=None,
                            checkpoint_every: int = 0,
                            job: str | None = None, store=None,
                            retry=None, chunk_deadline_s=None,
                            report=None):
     """Katz centrality over the mesh: x replicated, one psum/iteration.
+    `x0` warm-starts from a previous (UN-normalized) solution — the
+    Katz iteration is a contraction for alpha < 1/λ_max, so any seed
+    reaches the same fixpoint at the same tol (ops/delta.py contract).
     Checkpoint/resume semantics as in `pagerank_partition_centric`."""
     fn = _pc_cached("katz", _pc_katz_build, ctx,
                     scsr.block, scsr.n_shards, precision)
-    carry0 = (np.zeros(scsr.n_pad2, dtype=np.float32),
+    start = (np.zeros(scsr.n_pad2, dtype=np.float32) if x0 is None
+             else _warm_vertex_vector(x0, scsr, np.float32,
+                                      pad_value=0.0))
+    carry0 = (start,
               np.float32(np.inf), np.int32(0))
 
     def chunk_of(s):
@@ -737,6 +777,7 @@ def _pc_labelprop_build(ctx: MeshContext, block: int, n_shards: int,
 def labelprop_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                                 max_iterations: int = 30,
                                 self_weight: float = 0.0, *,
+                                labels0=None,
                                 checkpoint_every: int = 0,
                                 job: str | None = None, store=None,
                                 retry=None, chunk_deadline_s=None,
@@ -745,12 +786,21 @@ def labelprop_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
     labels replicated, one int psum per round). `scsr` must be built
     with by="dst" (both edge directions already concatenated for the
     undirected variant). Returns (labels[:n_nodes], iters).
-    Checkpoint/resume semantics as in `pagerank_partition_centric`."""
+
+    `labels0` warm-starts the election from a previous labeling —
+    ONLY valid when the delta since that labeling added edges (the
+    monotone gate in ops/delta.py): the election re-runs over a
+    superset of neighbors and re-converges; removals must cold-start
+    LOUDLY because a community held together by a removed edge would
+    never re-elect. Checkpoint/resume semantics as in
+    `pagerank_partition_centric`."""
     if scsr.by != "dst":
         raise ValueError("labelprop needs a dst-owned ShardedCSR")
     fn = _pc_cached("labelprop", _pc_labelprop_build, ctx,
                     scsr.block, scsr.n_shards, scsr.per)
-    carry0 = (np.arange(scsr.n_pad2, dtype=np.int32),
+    start = (np.arange(scsr.n_pad2, dtype=np.int32) if labels0 is None
+             else _warm_vertex_vector(labels0, scsr, np.int32))
+    carry0 = (start,
               np.bool_(True), np.int32(0))
 
     def chunk_of(s):
@@ -805,16 +855,24 @@ def _pc_wcc_build(ctx: MeshContext, block: int, n_shards: int):
 
 def wcc_partition_centric(scsr: ShardedCSR, ctx: MeshContext,
                           max_iterations: int = 200, *,
+                          comp0=None,
                           checkpoint_every: int = 0,
                           job: str | None = None, store=None,
                           retry=None, chunk_deadline_s=None,
                           report=None):
     """Weakly-connected components over the mesh: comp replicated, one
     pmin per round + pointer jumping. Returns (comp[:n_nodes], iters).
+
+    `comp0` warm-starts from a previous min-label assignment — ONLY
+    valid when the delta since it added edges (the monotone gate in
+    ops/delta.py): min-label propagation can merge components but never
+    split them, so a removal-carrying delta must cold-start LOUDLY.
     Checkpoint/resume semantics as in `pagerank_partition_centric`."""
     fn = _pc_cached("wcc", _pc_wcc_build, ctx,
                     scsr.block, scsr.n_shards)
-    carry0 = (np.arange(scsr.n_pad2, dtype=np.int32),
+    start = (np.arange(scsr.n_pad2, dtype=np.int32) if comp0 is None
+             else _warm_vertex_vector(comp0, scsr, np.int32))
+    carry0 = (start,
               np.bool_(True), np.int32(0))
 
     def chunk_of(s):

@@ -29,8 +29,10 @@ log = logging.getLogger(__name__)
 
 #: per-(socket, graph_key) serving-plane sync state: the last
 #: (topology_version, node_gids) this process pushed to the daemon, so
-#: the next PPR request ships the change-log DELTA covering the gap and
-#: the server invalidates only the cached sources it touches
+#: the next request ships the change-log DELTA covering the gap —
+#: the PPR plane invalidates only the cached sources it touches, and
+#: the analytics ops (r19 mgdelta) refresh the resident generation
+#: O(delta) and warm-start from its previous solution
 _PPR_PUSHED: dict = {}
 _PPR_PUSHED_LOCK = threading.Lock()
 
@@ -78,9 +80,73 @@ def _graph_coo(graph):
             np.asarray(graph.weights, dtype=np.float32)[:n])
 
 
+def _serving_delta_meta(ctx, graph, sock: str, graph_key: str):
+    """Shared serving-plane sync envelope (the `_ppr_serving_meta`
+    pattern promoted to ALL analytics ops, r19 mgdelta): a stable
+    per-storage graph_key, the reader's topology version, and — when
+    this process already pushed an earlier version — the change-log
+    delta payload covering the gap (dense changed indices PLUS those
+    vertices' current incident edges), so the server refreshes its
+    resident generation O(delta) and never needs the full edge list
+    re-shipped. ``send_graph`` says whether the edge arrays must ride
+    along (server behind with no usable delta, or never fed)."""
+    from ..ops.delta import incident_edges
+    storage = ctx.storage
+    version = getattr(ctx.accessor, "topology_snapshot",
+                      storage.topology_version)
+    meta = {"graph_key": graph_key, "graph_version": version,
+            "base_version": None, "ids_stable": True,
+            "send_graph": True}
+    with _PPR_PUSHED_LOCK:
+        prev = _PPR_PUSHED.get((sock, graph_key))
+    if prev is None:
+        return meta
+    prev_version, prev_gids = prev
+    ids_stable = prev_gids is graph.node_gids or \
+        np.array_equal(prev_gids, graph.node_gids)
+    meta["ids_stable"] = ids_stable
+    if not ids_stable:
+        return meta
+    if prev_version == version:
+        meta["send_graph"] = False
+        meta["base_version"] = version
+        return meta
+    if prev_version < version and graph.host_coo is not None:
+        gids = storage.changes_between(prev_version, version)
+        # typed wrap verdict (ChangeLogUnknowable) → full re-ship: the
+        # gap is unreconstructable and a partial delta would corrupt
+        # the resident generation
+        if isinstance(gids, frozenset):
+            changed_idx = [graph.gid_to_idx[g] for g in gids
+                           if g in graph.gid_to_idx]
+            bitmap = np.zeros(graph.n_nodes, dtype=bool)
+            if changed_idx:
+                bitmap[np.asarray(changed_idx, dtype=np.int64)] = True
+            inc_src, inc_dst, inc_w = incident_edges(
+                *graph.host_coo, bitmap)
+            meta.update(base_version=prev_version, changed=changed_idx,
+                        inc_src=inc_src, inc_dst=inc_dst, inc_w=inc_w,
+                        send_graph=False)
+    return meta
+
+
+def _drop_pushed(sock: str, graph_key: str) -> None:
+    """Forget the pushed version after a kernel-plane failure: the next
+    request re-ships the full graph instead of a delta the (possibly
+    respawned) server cannot anchor."""
+    with _PPR_PUSHED_LOCK:
+        _PPR_PUSHED.pop((sock, graph_key), None)
+
+
 def _kernel_server_pagerank(ctx, graph, damping, max_iterations, tol):
     """Route pagerank through the resident kernel server when one is
     configured; returns ranks or None (→ caller runs in-process).
+
+    Rides the resident-generation layer (r19 mgdelta): the graph_key is
+    stable per storage, commits ship the change-log delta instead of
+    the full edge list, and the server warm-starts the fixpoint from
+    its previous solution — commit-then-CALL costs O(delta) apply plus
+    the few iterations the perturbation needs.
 
     The dispatch's device attribution (transfer/compile/iterate splits)
     ships home in the reply and lands in the active stage accumulator,
@@ -92,17 +158,24 @@ def _kernel_server_pagerank(ctx, graph, damping, max_iterations, tol):
         return None
     from ..observability.metrics import global_metrics
     from ..server.kernel_server import KernelServerError
-    src, dst, weights = _graph_coo(graph)
+    graph_key = f"analytics:{hex(id(ctx.storage))}"
+    meta = _serving_delta_meta(ctx, graph, sock, graph_key)
+    kwargs = {}
+    if meta.pop("send_graph"):
+        src, dst, weights = _graph_coo(graph)
+        kwargs.update(src=src, dst=dst, weights=weights)
     try:
         client = _kernel_client(sock, spawn=False)
         ranks, _err, _iters = client.pagerank(
-            src=src, dst=dst, weights=weights, n_nodes=graph.n_nodes,
-            graph_key=f"proc:{id(graph)}:{graph.n_nodes}:{graph.n_edges}",
+            n_nodes=graph.n_nodes,
             damping=float(damping), max_iterations=int(max_iterations),
-            tol=float(tol))
+            tol=float(tol), **meta, **kwargs)
+        _note_ppr_pushed(sock, graph_key, meta["graph_version"],
+                         graph.node_gids)
         global_metrics.increment("analytics.kernel_routed_total")
         return np.asarray(ranks)[:graph.n_nodes]
     except (KernelServerError, ConnectionError, OSError) as e:
+        _drop_pushed(sock, graph_key)
         global_metrics.increment("analytics.kernel_route_fallback_total")
         log.warning("kernel-server pagerank route failed (%s: %s); "
                     "falling back to the in-process path",
@@ -111,39 +184,15 @@ def _kernel_server_pagerank(ctx, graph, damping, max_iterations, tol):
 
 
 def _ppr_serving_meta(ctx, graph, sock: str):
-    """The serving-plane sync envelope for this (socket, storage) pair:
-    a stable graph_key, the reader's topology version, and — when this
-    process already pushed an earlier version — the change-log DELTA
-    (dense indices) covering the gap, so the server's result cache
-    invalidates only sources whose neighborhoods moved. Also decides
-    whether the edge arrays must ride along (server behind, or never
-    fed)."""
-    storage = ctx.storage
-    graph_key = f"ppr:{hex(id(storage))}"
-    version = getattr(ctx.accessor, "topology_snapshot",
-                      storage.topology_version)
-    base_version = None
-    changed_idx = None
-    ids_stable = True
-    send_graph = True
-    with _PPR_PUSHED_LOCK:
-        prev = _PPR_PUSHED.get((sock, graph_key))
-    if prev is not None:
-        prev_version, prev_gids = prev
-        ids_stable = prev_gids is graph.node_gids or \
-            np.array_equal(prev_gids, graph.node_gids)
-        if prev_version == version:
-            send_graph = False          # the daemon already has it
-            base_version = version
-        elif ids_stable and prev_version < version:
-            gids = storage.changes_between(prev_version, version)
-            if gids is not None:
-                base_version = prev_version
-                changed_idx = [graph.gid_to_idx[g] for g in gids
-                               if g in graph.gid_to_idx]
-    return {"graph_key": graph_key, "graph_version": version,
-            "base_version": base_version, "changed": changed_idx,
-            "ids_stable": ids_stable, "send_graph": send_graph}
+    """The PPR serving-plane sync envelope: the shared
+    :func:`_serving_delta_meta` layer under the PPR graph_key. Since
+    r19 the delta payload carries the changed vertices' current
+    incident edges too, so the server's resident snapshot refreshes
+    O(delta) (and the result cache demotes off that SAME shipped delta)
+    instead of the client re-shipping the full edge list after every
+    commit."""
+    return _serving_delta_meta(ctx, graph, sock,
+                               f"ppr:{hex(id(ctx.storage))}")
 
 
 def _note_ppr_pushed(sock: str, graph_key: str, version, node_gids):
@@ -180,11 +229,35 @@ def _kernel_server_ppr(ctx, graph, sources, damping, max_iterations,
         global_metrics.increment("analytics.kernel_routed_total")
         return h, out
     except (KernelServerError, ConnectionError, OSError) as e:
+        _drop_pushed(sock, meta["graph_key"])
         global_metrics.increment("analytics.kernel_route_fallback_total")
         log.warning("kernel-server PPR route failed (%s: %s); "
                     "falling back to the in-process path",
                     type(e).__name__, e)
         return None
+
+
+def _warm_prepare(ctx, graph, algo: str, params_key: tuple):
+    """In-process commit-then-CALL state without a kernel server
+    (ops/delta.py LocalWarmPool): (cached_result | None, x0 | None,
+    store_fn). A non-None cached_result is the UNCHANGED graph's stored
+    solution, served verbatim (identical repeated CALLs must return
+    identical bytes); x0 seeds the fixpoint after a commit."""
+    from ..ops import delta as mgdelta
+    storage = ctx.storage
+    version = getattr(ctx.accessor, "topology_snapshot",
+                      storage.topology_version)
+    cached, x0 = mgdelta.GLOBAL_WARM_POOL.prepare(storage, graph,
+                                                  version, algo,
+                                                  params_key)
+
+    def store(x, iters=None):
+        mgdelta.GLOBAL_WARM_POOL.store(storage, graph, version, algo,
+                                       params_key, np.asarray(x))
+        if x0 is not None and iters is not None:
+            mgdelta.record_warm_start(algo, int(iters))
+
+    return cached, x0, store
 
 
 def _pagerank_impl(ctx, max_iterations=100, damping_factor=0.85,
@@ -196,9 +269,18 @@ def _pagerank_impl(ctx, max_iterations=100, damping_factor=0.85,
     ranks = _kernel_server_pagerank(ctx, graph, damping_factor,
                                     max_iterations, stop_epsilon)
     if ranks is None:
-        ranks, _, _ = pagerank(graph, damping=float(damping_factor),
-                               max_iterations=int(max_iterations),
-                               tol=float(stop_epsilon))
+        cached, x0, store = _warm_prepare(
+            ctx, graph, "pagerank",
+            ("pagerank", float(damping_factor), float(stop_epsilon),
+             int(max_iterations), weight_property))
+        if cached is not None:
+            ranks = cached
+        else:
+            ranks, _, iters = pagerank(
+                graph, damping=float(damping_factor),
+                max_iterations=int(max_iterations),
+                tol=float(stop_epsilon), x0=x0)
+            store(ranks, iters)
     ranks = np.asarray(ranks)
     yield from _rank_results(ctx, graph, ranks, "rank")
 
@@ -244,8 +326,15 @@ def _katz_impl(ctx, alpha=0.2, epsilon=1e-2):
     graph = ctx.device_graph()
     if graph.n_nodes == 0:
         return
-    xs, _, _ = katz_centrality(graph, alpha=float(alpha), tol=float(epsilon),
-                               max_iterations=500)
+    cached, x0, store = _warm_prepare(
+        ctx, graph, "katz", ("katz", float(alpha), float(epsilon)))
+    if cached is not None:
+        xs = cached
+    else:
+        xs, _, iters = katz_centrality(graph, alpha=float(alpha),
+                                       tol=float(epsilon),
+                                       max_iterations=500, x0=x0)
+        store(xs, iters)
     yield from _rank_results(ctx, graph, np.asarray(xs), "rank")
 
 
@@ -262,7 +351,17 @@ def _community_impl(ctx, max_iterations=30, weight_property=None):
     graph = ctx.device_graph(weight_property=weight_property)
     if graph.n_nodes == 0:
         return
-    labels, _ = label_propagation(graph, max_iterations=int(max_iterations))
+    # warm seed only over monotone (adds-only) deltas — the pool
+    # verifies against the real edge diff and cold-starts LOUDLY else
+    cached, labels0, store = _warm_prepare(
+        ctx, graph, "labelprop",
+        ("labelprop", int(max_iterations), weight_property))
+    if cached is not None:
+        labels = cached
+    else:
+        labels, iters = label_propagation(
+            graph, max_iterations=int(max_iterations), labels0=labels0)
+        store(labels, iters)
     labels = np.asarray(labels)
     # compact community ids to 1..k (reference convention: ids start at 1)
     uniq = {int(l): i + 1 for i, l in enumerate(sorted(set(labels.tolist())))}
@@ -286,7 +385,14 @@ def _wcc_impl(ctx):
     graph = ctx.device_graph()
     if graph.n_nodes == 0:
         return
-    comp, _ = weakly_connected_components(graph)
+    # warm seed only over monotone (adds-only) deltas — min-labels can
+    # merge components but never split; removals cold-start LOUDLY
+    cached, comp0, store = _warm_prepare(ctx, graph, "wcc", ("wcc",))
+    if cached is not None:
+        comp = cached
+    else:
+        comp, iters = weakly_connected_components(graph, comp0=comp0)
+        store(comp, iters)
     comp = np.asarray(comp)
     for i in range(graph.n_nodes):
         node = ctx.vertex_by_index(graph, i)

@@ -224,8 +224,14 @@ def _get_index(ctx, property_name: str) -> _IndexEntry:
 
     entry = None
     if parent is not None:
+        from ..storage.storage import ChangeLogUnknowable
         changed = storage.changes_between(parent.version, version)
-        if changed is not None:
+        if isinstance(changed, ChangeLogUnknowable):
+            # typed wrap verdict: the gap is unreconstructable — fall
+            # through to the full rebuild below (a partial delta would
+            # leave the index silently missing rows)
+            changed = None
+        else:
             changed = changed | own_writes
         if changed is not None and not changed:
             # nothing relevant changed: alias the parent at this version

@@ -39,6 +39,32 @@ from .objects import (ADJ_INDEX_THRESHOLD, Edge, Vertex, adj_map_add,
 log = logging.getLogger(__name__)
 
 
+class ChangeLogUnknowable:
+    """Typed "unknowable" verdict from :meth:`Storage.changes_between`.
+
+    The bounded change log cannot always answer a (v_from, v_to] query:
+    the deque may have wrapped past v_from (``reason="log_wrapped"``), a
+    bump may not have recorded its gids (``reason="untracked_bump"``),
+    or the log may be empty for a non-empty range. Consumers MUST
+    branch on this explicitly (falsy, so ``if changed:`` treats it like
+    an unusable delta) and fall back to a full rebuild — silently
+    treating it as "no changes" would serve stale data.
+    """
+
+    __slots__ = ("reason", "oldest_logged_version")
+
+    def __init__(self, reason: str, oldest_logged_version: int) -> None:
+        self.reason = reason
+        self.oldest_logged_version = oldest_logged_version
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:
+        return (f"ChangeLogUnknowable({self.reason!r}, "
+                f"oldest_logged_version={self.oldest_logged_version})")
+
+
 @dataclass
 class StorageConfig:
     storage_mode: StorageMode = StorageMode.IN_MEMORY_TRANSACTIONAL
@@ -411,7 +437,12 @@ class Accessor:
                            None)
             storage._vertices[gid] = vertex
         self.txn.touched_vertices[gid] = vertex
-        storage._bump_topology({gid})
+        if self._analytical:
+            # analytical commits skip the commit-time bump; transactional
+            # per-op bumps would only flood the bounded change log (a
+            # 30k-op transaction would wrap it) — the commit-time bump
+            # logs the txn's full touched set in ONE entry (r19 mgdelta)
+            storage._bump_topology({gid})
         return VertexAccessor(vertex, self)
 
     def delete_vertex(self, va: VertexAccessor, detach: bool = False):
@@ -450,7 +481,8 @@ class Accessor:
                 push_delta(vertex, self.txn, DeltaAction.RECREATE_OBJECT, None)
             vertex.deleted = True
         self.txn.touched_vertices[vertex.gid] = vertex
-        self.storage._bump_topology({vertex.gid})
+        if self._analytical:
+            self.storage._bump_topology({vertex.gid})
         return va, deleted_edges
 
     def create_edge(self, from_va: VertexAccessor, to_va: VertexAccessor,
@@ -512,7 +544,8 @@ class Accessor:
         self.txn.touched_edges[gid] = edge
         self.txn.touched_vertices[from_v.gid] = from_v
         self.txn.touched_vertices[to_v.gid] = to_v
-        storage._bump_topology({from_v.gid, to_v.gid})
+        if self._analytical:
+            storage._bump_topology({from_v.gid, to_v.gid})
         return EdgeAccessor(edge, self)
 
     def delete_edge(self, ea: EdgeAccessor):
@@ -551,7 +584,8 @@ class Accessor:
         self.txn.touched_edges[edge.gid] = edge
         self.txn.touched_vertices[from_v.gid] = from_v
         self.txn.touched_vertices[to_v.gid] = to_v
-        self.storage._bump_topology({from_v.gid, to_v.gid})
+        if self._analytical:
+            self.storage._bump_topology({from_v.gid, to_v.gid})
         return ea
 
     # --- bulk-write fast lane ----------------------------------------------
@@ -743,9 +777,12 @@ class Accessor:
                 txn.batches = []
             txn.batches.append(BatchInsert(new_vertices, new_edges))
 
-        # (d) one change-log record per batch (gids collected while hot in
-        # the loops above)
-        storage._bump_topology(changed)
+        # (d) one change-log record per batch (gids collected while hot
+        # in the loops above); transactional batches are covered by the
+        # commit-time bump (every gid is in touched_vertices), so only
+        # analytical mode needs the immediate record (r19 mgdelta)
+        if analytical:
+            storage._bump_topology(changed)
 
         if nv + ne >= 1024:
             # bulk-load pacing: graph objects are long-lived by
@@ -1135,6 +1172,13 @@ class InMemoryStorage:
         # changes_between(); 1024 entries cover bursts of small commits
         from collections import deque
         self._change_log = deque(maxlen=1024)
+        # monotone low-water mark: the version of the OLDEST entry the
+        # log still holds. deque(maxlen=) drops entries silently, so wrap
+        # detection must not depend on what happens to be retained —
+        # changes_between answers (v_from, v_to] iff v_from + 1 >=
+        # _oldest_logged_version, and returns a typed ChangeLogUnknowable
+        # otherwise instead of a silently-partial delta.
+        self._oldest_logged_version = 1
         self._change_log_lock = tracked_lock("Storage._change_log_lock")
         # mgsan shared-state declarations (MG006/MG007 + race detector):
         # gid counters under _gid_lock, engine bookkeeping under
@@ -1146,7 +1190,8 @@ class InMemoryStorage:
         # checker instead of field annotations.
         shared_field(self, "_next_vertex_gid", "_next_edge_gid",
                      "_timestamp", "_next_txn_id", "_active_txns",
-                     "_topology_version", "_change_log")
+                     "_topology_version", "_change_log",
+                     "_oldest_logged_version")
         # durability wiring: receives (frame_bytes, commit_ts) under the
         # engine lock, BEFORE the visibility flip (write-ahead ordering)
         self.wal_sink: Optional[Callable] = None
@@ -1541,6 +1586,12 @@ class InMemoryStorage:
         with self._change_log_lock:
             shared_write(self, "_change_log")
             self._topology_version += 1
+            if len(self._change_log) == self._change_log.maxlen:
+                # the append below silently drops the oldest entry —
+                # advance the monotone low-water mark FIRST so wrap
+                # detection never depends on the retained entries
+                shared_write(self, "_oldest_logged_version")
+                self._oldest_logged_version = self._change_log[0][0] + 1
             self._change_log.append(
                 (self._topology_version,
                  frozenset(changed_gids) if changed_gids is not None
@@ -1552,23 +1603,38 @@ class InMemoryStorage:
         # only cause an extra cache refresh
         return self._topology_version  # mglint: disable=MG006 — lock-free monotonic read is the contract
 
+    @property
+    def oldest_logged_version(self) -> int:
+        """Monotone low-water mark of the bounded change log: the oldest
+        version changes_between can still reach back PAST (a query with
+        ``v_from + 1 < oldest_logged_version`` is unknowable)."""
+        return self._oldest_logged_version  # mglint: disable=MG006 — lock-free monotonic read is the contract
+
     def changes_between(self, v_from: int, v_to: int):
-        """Union of vertex gids changed in versions (v_from, v_to], or
-        None if unknowable (log evicted the range, or a bump didn't
-        record its gids)."""
+        """Union of vertex gids changed in versions (v_from, v_to], or a
+        falsy :class:`ChangeLogUnknowable` when the log cannot answer
+        (the deque wrapped past v_from, or a bump in the range didn't
+        record its gids). Consumers must handle the unknowable verdict
+        explicitly and fall back to a full rebuild."""
         if v_from == v_to:
             return frozenset()
         with self._change_log_lock:
             shared_read(self, "_change_log")
             entries = list(self._change_log)
-        if not entries or entries[0][0] > v_from + 1:
-            return None     # log no longer reaches back to v_from
+            shared_read(self, "_oldest_logged_version")
+            oldest = self._oldest_logged_version
+        if v_from + 1 < oldest or not entries:
+            # log no longer reaches back to v_from (or never logged the
+            # range at all) — detected via the monotone low-water mark,
+            # not the retained entries, so a wrapped deque can never
+            # produce a silently-partial delta
+            return ChangeLogUnknowable("log_wrapped", oldest)
         out: set = set()
         for version, gids in entries:
             if version <= v_from or version > v_to:
                 continue
             if gids is None:
-                return None
+                return ChangeLogUnknowable("untracked_bump", oldest)
             out |= gids
         return frozenset(out)
 

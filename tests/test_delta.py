@@ -1,0 +1,792 @@
+"""mgdelta (ISSUE 14): incremental semiring fixpoints on a
+device-resident graph — commit-to-fresh-result in O(changed edges).
+
+Layers of coverage:
+
+1. EdgeDelta splice correctness: delta-refresh vs full-rebuild
+   BIT-EXACT ShardedCSR equivalence over adds/removes/weight updates,
+   uneven shard counts, both owning endpoints, mesh-of-1 and the full
+   8-virtual-device mesh; capacity overflow and removal mismatch return
+   None (the loud rebuild path), never a partial splice.
+2. Warm-started fixpoints per algorithm: pagerank/katz residual
+   equivalence at the same tol on segment AND mesh backends; WCC /
+   labelprop warm results identical to cold under adds-only deltas;
+   the monotone-unsafe LOUD cold start (delta.cold_start_total) when a
+   removal poisons the seed.
+3. ResidentGraph generations: empty-delta version bumps, bounded
+   delta-accumulation compaction, registry LRU + gauge.
+4. LocalWarmPool (in-process commit-then-CALL) against a REAL storage
+   change log, including the wrap fallback matrix.
+5. Kernel-server protocol: full import → delta-only request (changed +
+   incident edges, no full edge arrays) → warm-started reply; removal
+   delta forcing the typed cold start; stale-generation honesty.
+6. Change-log wrap: monotone oldest_logged_version, the typed
+   ChangeLogUnknowable verdict, and every consumer's explicit fallback.
+7. device_chaos: a device fault mid-(delta-apply → dispatch) yields a
+   typed outcome and the retry serves the CONSISTENT new generation.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from memgraph_tpu.observability.metrics import global_metrics
+from memgraph_tpu.ops import csr
+from memgraph_tpu.ops import delta as D
+from memgraph_tpu.ops.csr import from_coo, shard_edges
+from memgraph_tpu.storage.storage import (ChangeLogUnknowable,
+                                          InMemoryStorage)
+from memgraph_tpu.utils import faultinject as FI
+
+TOL = 1e-6
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FI.reset()
+    yield
+    FI.reset()
+
+
+def _metric(name):
+    return dict((n, v) for n, _k, v
+                in global_metrics.snapshot()).get(name, 0.0)
+
+
+def _coo(seed=0, n=200, e=1500, weighted=True):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e).astype(np.int64)
+    dst = rng.integers(0, n, e).astype(np.int64)
+    w = (rng.random(e).astype(np.float32) if weighted
+         else np.ones(e, dtype=np.float32))
+    return src, dst, w
+
+
+def _delta_of(src, dst, w, seed=1, n=200, n_add=40, n_rem=30, n_upd=10):
+    """A mixed delta: adds + removes + weight updates over existing
+    edges. Returns (delta, updated (src, dst, w))."""
+    rng = np.random.default_rng(seed)
+    e = len(src)
+    rem_i = rng.choice(e, n_rem, replace=False)
+    upd_i = rng.choice(np.setdiff1d(np.arange(e), rem_i), n_upd,
+                       replace=False)
+    add_src = rng.integers(0, n, n_add).astype(np.int64)
+    add_dst = rng.integers(0, n, n_add).astype(np.int64)
+    add_w = rng.random(n_add).astype(np.float32)
+    d = D.EdgeDelta(
+        0, 1,
+        add_src=np.concatenate([add_src, src[upd_i]]),
+        add_dst=np.concatenate([add_dst, dst[upd_i]]),
+        add_w=np.concatenate([add_w,
+                              (w[upd_i] * 2).astype(np.float32)]),
+        rem_src=np.concatenate([src[rem_i], src[upd_i]]),
+        rem_dst=np.concatenate([dst[rem_i], dst[upd_i]]),
+        rem_w=np.concatenate([w[rem_i], w[upd_i]]))
+    coo = D.splice_coo((src, dst, w), d, n)
+    assert coo is not None
+    return d, coo
+
+
+# ==========================================================================
+# 1. splice correctness
+# ==========================================================================
+
+
+@pytest.mark.parametrize("n_shards", [1, 3, 8])
+@pytest.mark.parametrize("by", ["src", "dst"])
+def test_apply_edge_delta_matches_full_reshard(n_shards, by):
+    """Per affected shard row the spliced layout must carry exactly the
+    edges a from-scratch reshard of the updated edge list carries, with
+    the (dst, src) sort and block_ptr invariants intact; unaffected
+    rows must be untouched. n=203 makes the last shard uneven."""
+    n = 203
+    src, dst, w = _coo(seed=0, n=n)
+    scsr = shard_edges(src, dst, w, n, n_shards, by=by)
+    d, coo = _delta_of(src, dst, w, seed=1, n=n)
+    out = D.apply_edge_delta(scsr, d)
+    ref = shard_edges(*coo, n, n_shards, by=by)
+    if out is None:
+        # legal ONLY on real per-row capacity overflow (1-shard layouts
+        # have zero padding slack) — never a silent partial apply
+        key = coo[0] if by == "src" else coo[1]
+        counts = np.bincount((key // scsr.block).astype(np.int64),
+                             minlength=n_shards)
+        assert counts.max() > scsr.per
+        return
+    assert out.n_edges == ref.n_edges == len(coo[0])
+    sink = n
+    for p in range(n_shards):
+        rc_o = int(np.searchsorted(out.dst[p], sink))
+        rc_r = int(np.searchsorted(ref.dst[p], sink))
+        assert rc_o == rc_r
+        got = sorted(zip(out.dst[p][:rc_o].tolist(),
+                         out.src[p][:rc_o].tolist(),
+                         out.weights[p][:rc_o].tolist()))
+        want = sorted(zip(ref.dst[p][:rc_r].tolist(),
+                          ref.src[p][:rc_r].tolist(),
+                          ref.weights[p][:rc_r].tolist()))
+        assert got == want
+        # layout invariants: (dst) non-decreasing incl. the sink tail,
+        # block_ptr = searchsorted of the shard bounds
+        assert np.all(np.diff(out.dst[p].astype(np.int64)) >= 0)
+        bounds = np.arange(n_shards + 1, dtype=np.int64) * out.block
+        assert np.array_equal(out.block_ptr[p],
+                              np.searchsorted(out.dst[p], bounds))
+        # padding convention: src = shard base, w = 0
+        assert np.all(out.src[p][rc_o:] == p * out.block)
+        assert np.all(out.weights[p][rc_o:] == 0.0)
+
+
+def test_apply_edge_delta_untouched_rows_not_copied_content():
+    """Rows no delta edge touches keep identical content (the O(delta +
+    affected rows) claim's observable half)."""
+    n = 640
+    src, dst, w = _coo(seed=3, n=n, e=4000)
+    scsr = shard_edges(src, dst, w, n, 8, by="src")
+    # confine the delta to shard 2's vertex range
+    lo, hi = 2 * scsr.block, 3 * scsr.block
+    add_src = np.arange(lo, lo + 8, dtype=np.int64)
+    add_dst = np.arange(8, dtype=np.int64)
+    d = D.EdgeDelta(0, 1, add_src, add_dst,
+                    np.ones(8, dtype=np.float32),
+                    np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.float32))
+    out = D.apply_edge_delta(scsr, d)
+    assert out is not None
+    for p in range(8):
+        if p == 2:
+            continue
+        np.testing.assert_array_equal(out.src[p], scsr.src[p])
+        np.testing.assert_array_equal(out.dst[p], scsr.dst[p])
+        np.testing.assert_array_equal(out.weights[p], scsr.weights[p])
+        np.testing.assert_array_equal(out.block_ptr[p],
+                                      scsr.block_ptr[p])
+
+
+def test_apply_edge_delta_removal_mismatch_is_loud_none():
+    n = 100
+    src, dst, w = _coo(seed=2, n=n, e=500)
+    scsr = shard_edges(src, dst, w, n, 4, by="src")
+    ghost = D.EdgeDelta(
+        0, 1, np.zeros(0, np.int64), np.zeros(0, np.int64),
+        np.zeros(0, np.float32),
+        rem_src=np.asarray([src[0]]), rem_dst=np.asarray([dst[0]]),
+        rem_w=np.asarray([w[0] + 1.0], dtype=np.float32))  # wrong weight
+    assert D.apply_edge_delta(scsr, ghost) is None
+
+
+def test_fixpoint_bit_exact_after_splice_mesh():
+    """The whole point: pagerank over the SPLICED resident layout is
+    bit-exact vs the same kernel over a from-scratch reshard of the
+    updated edge list — on mesh-of-1 and the 8-virtual-device mesh."""
+    from memgraph_tpu.parallel.distributed import \
+        pagerank_partition_centric
+    from memgraph_tpu.parallel.mesh import get_mesh_context
+    n = 300
+    src, dst, w = _coo(seed=5, n=n, e=2400)
+    for n_shards in (1, 8):
+        ctx = get_mesh_context(n_shards)
+        scsr = shard_edges(src, dst, w, n, n_shards, by="src")
+        # net-negative delta: the splice always fits the resident rows
+        # (capacity-overflow compaction has its own test above)
+        d, coo = _delta_of(src, dst, w, seed=6, n=n, n_add=8,
+                           n_rem=30, n_upd=5)
+        spliced = D.apply_edge_delta(scsr, d)
+        assert spliced is not None
+        fresh = shard_edges(*coo, n, n_shards, by="src")
+        # identical shapes -> identical compiled program; identical
+        # edge order within rows -> bit-identical reductions
+        r1, e1, i1 = pagerank_partition_centric(
+            spliced.to_device(ctx), ctx, tol=TOL)
+        r2, e2, i2 = pagerank_partition_centric(
+            fresh.to_device(ctx), ctx, tol=TOL)
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+        assert (e1, i1) == (e2, i2)
+
+
+def test_refresh_device_graph_equals_from_coo():
+    n = 250
+    src, dst, w = _coo(seed=7, n=n)
+    g = from_coo(src, dst, w, n_nodes=n)
+    d, coo = _delta_of(src, dst, w, seed=8, n=n)
+    g2 = D.refresh_device_graph(g, d)
+    ref = from_coo(*coo, n_nodes=n)
+    assert g2.n_edges == ref.n_edges
+    for field in ("row_ptr", "col_idx", "src_idx", "weights",
+                  "csc_src", "csc_dst", "csc_weights", "out_degree"):
+        np.testing.assert_array_equal(np.asarray(getattr(g2, field)),
+                                      np.asarray(getattr(ref, field)))
+    # wsum_adjust really is the rescale vector the delta implies
+    deg_old = np.bincount(src, weights=w, minlength=n)
+    deg_new = np.bincount(coo[0], weights=coo[2], minlength=n)
+    np.testing.assert_allclose(d.wsum_adjust(n), deg_new - deg_old,
+                               atol=1e-5)
+
+
+# ==========================================================================
+# 2. warm-started fixpoints per algorithm
+# ==========================================================================
+
+
+def _perturbed(seed=10, n=300, e=2400, adds_only=False):
+    src, dst, w = _coo(seed=seed, n=n, e=e)
+    g = from_coo(src, dst, w, n_nodes=n)
+    rng = np.random.default_rng(seed + 1)
+    add_src = rng.integers(0, n, 16).astype(np.int64)
+    add_dst = rng.integers(0, n, 16).astype(np.int64)
+    add_w = rng.random(16).astype(np.float32)
+    if adds_only:
+        d = D.EdgeDelta(0, 1, add_src, add_dst, add_w,
+                        np.zeros(0, np.int64), np.zeros(0, np.int64),
+                        np.zeros(0, np.float32))
+    else:
+        rem_i = rng.choice(e, 10, replace=False)
+        d = D.EdgeDelta(0, 1, add_src, add_dst, add_w,
+                        src[rem_i], dst[rem_i], w[rem_i])
+    g2 = D.refresh_device_graph(g, d)
+    assert g2 is not None
+    return g, g2, d
+
+
+@pytest.mark.parametrize("mesh", [None, 1, 8])
+def test_pagerank_warm_residual_equivalent(mesh):
+    """Warm-started pagerank converges to the SAME answer at the SAME
+    tol (residual equivalence: final err <= tol on both paths), in no
+    more iterations than cold."""
+    from memgraph_tpu.ops.pagerank import pagerank
+    g, g2, _ = _perturbed(seed=11)
+    prev, _, _ = pagerank(g, tol=TOL, mesh=mesh)
+    cold, err_c, it_c = pagerank(g2, tol=TOL, mesh=mesh)
+    warm, err_w, it_w = pagerank(g2, tol=TOL, mesh=mesh,
+                                 x0=np.asarray(prev))
+    assert err_w <= TOL and err_c <= TOL
+    assert it_w <= it_c
+    # same fixpoint: both inside the tol ball of each other
+    assert np.abs(np.asarray(cold) - np.asarray(warm)).max() < 10 * TOL
+
+
+@pytest.mark.parametrize("mesh", [None, 8])
+def test_katz_warm_residual_equivalent(mesh):
+    from memgraph_tpu.ops.katz import katz_centrality
+    g, g2, _ = _perturbed(seed=12)
+    prev, _, _ = katz_centrality(g, tol=TOL, max_iterations=300,
+                                 mesh=mesh)
+    cold, err_c, it_c = katz_centrality(g2, tol=TOL, max_iterations=300,
+                                        mesh=mesh)
+    warm, err_w, it_w = katz_centrality(g2, tol=TOL, max_iterations=300,
+                                        mesh=mesh, x0=np.asarray(prev))
+    assert err_w <= TOL and err_c <= TOL
+    assert it_w <= it_c
+    assert np.abs(np.asarray(cold) - np.asarray(warm)).max() < 10 * TOL
+
+
+@pytest.mark.parametrize("mesh", [None, 8])
+def test_wcc_warm_adds_only_identical(mesh):
+    """Adds-only: min-label propagation from the previous assignment
+    lands on exactly the cold labels (components only merge)."""
+    from memgraph_tpu.ops.components import weakly_connected_components
+    g, g2, d = _perturbed(seed=13, adds_only=True)
+    assert d.adds_only
+    prev, _ = weakly_connected_components(g, mesh=mesh)
+    cold, it_c = weakly_connected_components(g2, mesh=mesh)
+    warm, it_w = weakly_connected_components(g2, mesh=mesh,
+                                             comp0=np.asarray(prev))
+    np.testing.assert_array_equal(np.asarray(cold), np.asarray(warm))
+    assert it_w <= it_c
+
+
+@pytest.mark.parametrize("mesh", [None, 8])
+def test_labelprop_warm_adds_only_is_stable_fixpoint(mesh):
+    """Adds-only labelprop warm start: the warm result must be a
+    FIXPOINT of the election (re-running seeded with it converges in
+    one unchanged round) — labelprop's answer is init-dependent, so
+    fixpoint-ness (not bit-equality to cold) is the contract."""
+    from memgraph_tpu.ops.labelprop import label_propagation
+    g, g2, d = _perturbed(seed=14, adds_only=True)
+    prev, _ = label_propagation(g, mesh=mesh)
+    warm, _ = label_propagation(g2, mesh=mesh, labels0=np.asarray(prev))
+    again, it2 = label_propagation(g2, mesh=mesh,
+                                   labels0=np.asarray(warm))
+    np.testing.assert_array_equal(np.asarray(warm), np.asarray(again))
+    assert it2 <= 1
+
+
+def test_monotone_unsafe_delta_forces_loud_cold():
+    """A removal-carrying delta poisons WCC/labelprop seeds: warm_x0
+    returns None, delta.cold_start_total moves, and the seed is
+    dropped; pagerank's contraction seed survives the same delta."""
+    g, g2, d = _perturbed(seed=15, adds_only=False)
+    assert not d.adds_only
+    gen = D.ResidentGraph("k", 0, g)
+    gen.note_solution("wcc", ("wcc",), np.arange(g.n_nodes))
+    gen.note_solution("pagerank", ("p",),
+                      np.full(g.n_nodes, 1.0 / g.n_nodes))
+    assert gen.apply(d)
+    before = _metric("delta.cold_start_total")
+    x0, reason = gen.warm_x0("wcc", ("wcc",))
+    assert x0 is None and reason == "monotone_unsafe"
+    assert _metric("delta.cold_start_total") == before + 1
+    assert "wcc" not in gen.solutions          # poisoned seed dropped
+    x0p, reason_p = gen.warm_x0("pagerank", ("p",))
+    assert x0p is not None and reason_p == "contraction"
+
+
+# ==========================================================================
+# 3. ResidentGraph generations
+# ==========================================================================
+
+
+def test_empty_delta_bumps_version_without_rebuild():
+    g = from_coo(*_coo(seed=16), n_nodes=200)
+    gen = D.ResidentGraph("k", 3, g)
+    gen.note_solution("pagerank", ("p",), np.zeros(200))
+    snapshot = gen.graph
+    assert gen.apply(D.empty_delta(3, 7))
+    assert gen.version == 7
+    assert gen.graph is snapshot               # no rebuild
+    assert gen.solutions["pagerank"].monotone_ok
+
+
+def test_accumulated_deltas_trigger_compaction(monkeypatch):
+    monkeypatch.setattr(D, "DELTA_COMPACT_FRACTION", 0.01)
+    n = 200
+    src, dst, w = _coo(seed=17, n=n)
+    gen = D.ResidentGraph("k", 0, from_coo(src, dst, w, n_nodes=n))
+    from memgraph_tpu.parallel.mesh import get_mesh_context
+    ctx = get_mesh_context(4)
+    gen.ensure_sharded(ctx, by="src")
+    before = _metric("delta.compacted_total")
+    rng = np.random.default_rng(18)
+    version = 0
+    for i in range(4):
+        version += 1
+        add_s = rng.integers(0, n, 8).astype(np.int64)
+        add_d = rng.integers(0, n, 8).astype(np.int64)
+        d = D.EdgeDelta(version - 1, version, add_s, add_d,
+                        np.ones(8, np.float32), np.zeros(0, np.int64),
+                        np.zeros(0, np.int64), np.zeros(0, np.float32))
+        assert gen.apply(d, ctx)
+    assert _metric("delta.compacted_total") > before
+    assert gen.delta_edges == 0                # accumulation reset
+    # post-compaction the resident layout still matches from-scratch
+    hv = gen.host_variants[("src", False)]
+    ref = shard_edges(*gen.graph.host_coo, n, 4, by="src")
+    sink = n
+    for p in range(4):
+        rc = int(np.searchsorted(hv.dst[p], sink))
+        rr = int(np.searchsorted(ref.dst[p], sink))
+        assert rc == rr
+        assert sorted(zip(hv.dst[p][:rc], hv.src[p][:rc])) == \
+            sorted(zip(ref.dst[p][:rr], ref.src[p][:rr]))
+
+
+def test_resident_registry_lru_and_gauge():
+    reg = D.ResidentRegistry(capacity=2)
+    for i in range(3):
+        g = from_coo(*_coo(seed=20 + i, n=50, e=200), n_nodes=50)
+        reg.put(D.ResidentGraph(f"k{i}", 0, g))
+    assert len(reg) == 2
+    assert reg.get("k0") is None               # LRU-evicted
+    assert reg.get("k2") is not None
+    assert _metric("delta.resident_generations") == 2.0
+
+
+# ==========================================================================
+# 4. LocalWarmPool against a real storage change log
+# ==========================================================================
+
+
+def _storage_graph(n=40, extra_edges=()):
+    storage = InMemoryStorage()
+    acc = storage.access()
+    vas = [acc.create_vertex() for _ in range(n)]
+    rng = np.random.default_rng(0)
+    for _ in range(n * 4):
+        a, b = rng.integers(0, n, 2)
+        acc.create_edge(vas[a], vas[b],
+                        storage.edge_type_mapper.name_to_id("E"))
+    acc.commit()
+    return storage
+
+
+def _export(storage):
+    acc = storage.access()
+    g = csr.export_csr(acc, to_device=False)
+    return acc, g, acc.topology_snapshot
+
+
+def test_local_warm_pool_commit_then_call():
+    from memgraph_tpu.ops.pagerank import pagerank
+    pool = D.LocalWarmPool()
+    storage = _storage_graph()
+    acc1, g1, v1 = _export(storage)
+    assert pool.prepare(storage, g1, v1, "pagerank",
+                        ("p",)) == (None, None)
+    r1, _, _ = pagerank(g1, tol=TOL)
+    pool.store(storage, g1, v1, "pagerank", ("p",), np.asarray(r1))
+    # unchanged graph: the stored solution serves VERBATIM (result-
+    # cache semantics — identical CALLs return identical bytes)
+    hit, seed = pool.prepare(storage, g1, v1, "pagerank", ("p",))
+    assert seed is None
+    np.testing.assert_array_equal(hit, np.asarray(r1))
+    acc1.abort()
+
+    # commit: one new edge -> warm seed (not a hit) at the new version
+    acc = storage.access()
+    verts = list(storage._vertices.keys())
+    acc.create_edge(acc.find_vertex(verts[0]),
+                    acc.find_vertex(verts[1]),
+                    storage.edge_type_mapper.name_to_id("E"))
+    acc.commit()
+    acc2, g2, v2 = _export(storage)
+    assert v2 > v1
+    hit, x0 = pool.prepare(storage, g2, v2, "pagerank", ("p",))
+    assert hit is None and x0 is not None
+    np.testing.assert_array_equal(x0, np.asarray(r1))
+    acc2.abort()
+
+
+def test_local_warm_pool_wcc_cold_on_removal_and_wrap():
+    pool = D.LocalWarmPool()
+    storage = _storage_graph()
+    acc1, g1, v1 = _export(storage)
+    pool.store(storage, g1, v1, "wcc", ("wcc",), np.arange(g1.n_nodes))
+    acc1.abort()
+
+    # removal commit -> monotone-unsafe -> LOUD cold
+    acc = storage.access()
+    edge_gid = next(iter(storage._edges))
+    ea = acc.find_edge(edge_gid)
+    acc.delete_edge(ea)
+    acc.commit()
+    acc2, g2, v2 = _export(storage)
+    before = _metric("delta.cold_start_total")
+    assert pool.prepare(storage, g2, v2, "wcc",
+                        ("wcc",)) == (None, None)
+    assert _metric("delta.cold_start_total") == before + 1
+    acc2.abort()
+
+    # wrapped log -> unknowable -> cold for the monotone-gated algo
+    pool.store(storage, g2, v2, "wcc", ("wcc",), np.arange(g2.n_nodes))
+    for i in range(1100):
+        storage._bump_topology({0})
+    acc3, g3, v3 = _export(storage)
+    assert isinstance(storage.changes_between(v2, v3),
+                      ChangeLogUnknowable)
+    assert pool.prepare(storage, g3, v3, "wcc",
+                        ("wcc",)) == (None, None)
+    acc3.abort()
+
+
+# ==========================================================================
+# 5. kernel-server delta protocol
+# ==========================================================================
+
+
+@pytest.fixture(scope="module")
+def dserver(tmp_path_factory):
+    from memgraph_tpu.server.kernel_server import (KernelClient,
+                                                   KernelServer)
+    sock = str(tmp_path_factory.mktemp("ks") / "kernel.sock")
+    srv = KernelServer(sock, wedge_after_s=60)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    client = None
+    while time.monotonic() < deadline:
+        try:
+            client = KernelClient(sock, timeout=120)
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert client is not None
+    yield srv, client, sock
+    try:
+        client.shutdown()
+        client.close()
+    except OSError:
+        pass
+
+
+def _incident_payload(src, dst, changed, n):
+    bitmap = np.zeros(n, dtype=bool)
+    bitmap[np.asarray(changed, dtype=np.int64)] = True
+    sel = bitmap[src] | bitmap[dst]
+    return (src[sel].astype(np.int64), dst[sel].astype(np.int64),
+            np.ones(int(sel.sum()), dtype=np.float32))
+
+
+def test_kernel_server_delta_refresh_and_warm_start(dserver):
+    """Full import at v1; commit ships ONLY the delta payload at v2;
+    the server splices the resident generation and warm-starts — the
+    reply matches a cold run on the updated graph, residual-equivalent
+    at the same tol, with warm_started=True on the second call."""
+    _srv, client, _ = dserver
+    rng = np.random.default_rng(30)
+    n, e = 400, 3000
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    r1, _, _ = client.pagerank(src=src, dst=dst, n_nodes=n,
+                               graph_key="dg1", graph_version=1,
+                               tol=TOL)
+    add_src = rng.integers(0, n, 20)
+    add_dst = rng.integers(0, n, 20)
+    src2 = np.concatenate([src, add_src])
+    dst2 = np.concatenate([dst, add_dst])
+    changed = np.unique(np.concatenate([add_src,
+                                        add_dst])).astype(np.int32)
+    inc_src, inc_dst, inc_w = _incident_payload(src2, dst2, changed, n)
+    r2, err2, it2 = client.pagerank(
+        n_nodes=n, graph_key="dg1", graph_version=2, base_version=1,
+        changed=changed, inc_src=inc_src, inc_dst=inc_dst, inc_w=inc_w,
+        tol=TOL)
+    assert err2 <= TOL
+    from memgraph_tpu.parallel.analytics import pagerank_mesh
+    from memgraph_tpu.parallel.mesh import get_mesh_context
+    ref, _, it_ref = pagerank_mesh(from_coo(src2, dst2, n_nodes=n),
+                                   get_mesh_context(1), tol=TOL)
+    assert np.abs(np.asarray(ref)
+                  - np.asarray(r2)[:n]).max() < 10 * TOL
+    assert it2 <= it_ref                      # warm never slower
+    applied = _metric("delta.applied_total")
+    assert applied >= 1
+
+
+def test_kernel_server_wcc_monotone_gate(dserver):
+    """WCC over the resident generation: warm on repeat, typed LOUD
+    cold (warm_started=False) after a removal delta — and the results
+    always match the cold reference."""
+    from memgraph_tpu.ops.components import weakly_connected_components
+    _srv, client, _ = dserver
+    rng = np.random.default_rng(31)
+    n, e = 300, 1600
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    h1, out1 = client.semiring(algorithm="wcc", src=src, dst=dst,
+                               n_nodes=n, graph_key="dg2",
+                               graph_version=1)
+    assert h1["warm_started"] is False
+    h2, out2 = client.semiring(algorithm="wcc", graph_key="dg2",
+                               n_nodes=n, graph_version=1)
+    assert h2["warm_started"] is True
+    np.testing.assert_array_equal(out1["components"],
+                                  out2["components"])
+    # removal: drop two edges -> monotone-unsafe -> loud cold
+    src3, dst3 = np.delete(src, [0, 1]), np.delete(dst, [0, 1])
+    changed = np.unique(np.concatenate(
+        [src[:2], dst[:2]])).astype(np.int32)
+    inc_src, inc_dst, inc_w = _incident_payload(src3, dst3, changed, n)
+    before = _metric("delta.cold_start_total")
+    h3, out3 = client.semiring(
+        algorithm="wcc", graph_key="dg2", n_nodes=n, graph_version=2,
+        base_version=1, changed=changed, inc_src=inc_src,
+        inc_dst=inc_dst, inc_w=inc_w)
+    assert h3["warm_started"] is False
+    assert _metric("delta.cold_start_total") == before + 1
+    ref, _ = weakly_connected_components(from_coo(src3, dst3,
+                                                  n_nodes=n))
+    np.testing.assert_array_equal(np.asarray(ref),
+                                  out3["components"][:n])
+
+
+def test_kernel_server_stale_generation_is_never_served(dserver):
+    """A version bump with NO usable delta and NO edge arrays must fail
+    typed (invalid), never silently serve the old generation."""
+    from memgraph_tpu.server.kernel_server import KernelServerError
+    _srv, client, _ = dserver
+    rng = np.random.default_rng(32)
+    n, e = 100, 500
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    client.pagerank(src=src, dst=dst, n_nodes=n, graph_key="dg3",
+                    graph_version=1, tol=TOL)
+    with pytest.raises(KernelServerError):
+        client.pagerank(n_nodes=n, graph_key="dg3", graph_version=2,
+                        tol=TOL)
+
+
+def test_serving_meta_ships_delta_then_full_after_wrap():
+    """The route layer's envelope: delta payload (no edge re-ship)
+    while the change log covers the gap; full re-ship once it wrapped
+    (the typed ChangeLogUnknowable fallback)."""
+    from memgraph_tpu.procedures.graph_algorithms import (
+        _PPR_PUSHED, _PPR_PUSHED_LOCK, _note_ppr_pushed,
+        _serving_delta_meta)
+    from memgraph_tpu.procedures.mock import mock_context
+
+    storage = _storage_graph()
+    acc, g, v = _export(storage)
+
+    class _Ctx:
+        pass
+
+    ctx = _Ctx()
+    ctx.storage = storage
+    ctx.accessor = acc
+    key = "analytics:test"
+    meta = _serving_delta_meta(ctx, g, "sock", key)
+    assert meta["send_graph"]                  # never pushed
+    _note_ppr_pushed("sock", key, v, g.node_gids)
+    # same version: resident, nothing to ship
+    meta = _serving_delta_meta(ctx, g, "sock", key)
+    assert not meta["send_graph"] and meta["base_version"] == v
+    acc.abort()
+
+    # one commit -> delta payload, no full graph
+    acc2 = storage.access()
+    gids = list(storage._vertices.keys())
+    acc2.create_edge(acc2.find_vertex(gids[0]),
+                     acc2.find_vertex(gids[1]),
+                     storage.edge_type_mapper.name_to_id("E"))
+    acc2.commit()
+    acc3, g3, v3 = _export(storage)
+    ctx.accessor = acc3
+    meta = _serving_delta_meta(ctx, g3, "sock", key)
+    assert not meta["send_graph"]
+    assert meta["base_version"] == v and len(meta["inc_src"]) > 0
+    acc3.abort()
+
+    # wrap the log -> unknowable -> full re-ship
+    for _ in range(1100):
+        storage._bump_topology({0})
+    acc4, g4, v4 = _export(storage)
+    ctx.accessor = acc4
+    meta = _serving_delta_meta(ctx, g4, "sock", key)
+    assert meta["send_graph"] and meta["base_version"] is None
+    acc4.abort()
+    with _PPR_PUSHED_LOCK:
+        _PPR_PUSHED.pop(("sock", key), None)
+
+
+# ==========================================================================
+# 6. change-log wrap matrix
+# ==========================================================================
+
+
+def test_oldest_logged_version_monotone_and_wrap_typed():
+    storage = InMemoryStorage()
+    assert storage.oldest_logged_version == 1
+    lows = []
+    for i in range(1500):
+        storage._bump_topology({i})
+        lows.append(storage.oldest_logged_version)
+    assert all(b >= a for a, b in zip(lows, lows[1:]))
+    assert storage.oldest_logged_version == \
+        storage.topology_version - 1024 + 1
+    verdict = storage.changes_between(0, storage.topology_version)
+    assert isinstance(verdict, ChangeLogUnknowable) and not verdict
+    assert verdict.reason == "log_wrapped"
+    assert verdict.oldest_logged_version == \
+        storage.oldest_logged_version
+    # in-range queries still answer exactly
+    v = storage.topology_version
+    assert storage.changes_between(v - 3, v) == \
+        frozenset({1497, 1498, 1499})
+
+
+def test_graph_cache_full_export_on_wrapped_log():
+    """GraphCache's delta export consumer: a wrapped log must fall back
+    to the full export (counted fallback_rebuild) and still serve the
+    CORRECT fresh snapshot."""
+    from memgraph_tpu.ops.csr import GraphCache
+    storage = _storage_graph()
+    cache = GraphCache()
+    acc1, _, _ = _export(storage)
+    g1 = cache.get(acc1)
+    acc1.abort()
+    # wrap, then commit one more edge
+    for _ in range(1100):
+        storage._bump_topology(set())
+    acc = storage.access()
+    gids = list(storage._vertices.keys())
+    acc.create_edge(acc.find_vertex(gids[2]), acc.find_vertex(gids[3]),
+                    storage.edge_type_mapper.name_to_id("E"))
+    acc.commit()
+    before = _metric("delta.fallback_rebuild_total")
+    acc2 = storage.access()
+    g2 = cache.get(acc2)
+    assert g2.n_edges == g1.n_edges + 1
+    assert _metric("delta.fallback_rebuild_total") >= before
+    acc2.abort()
+
+
+def test_compile_edge_delta_typed_verdicts():
+    storage = _storage_graph()
+    acc1, g1, v1 = _export(storage)
+    acc1.abort()
+    acc = storage.access()
+    gids = list(storage._vertices.keys())
+    acc.create_edge(acc.find_vertex(gids[4]), acc.find_vertex(gids[5]),
+                    storage.edge_type_mapper.name_to_id("E"))
+    acc.commit()
+    acc2, g2, v2 = _export(storage)
+    d = D.compile_edge_delta(storage, g1, g2, v1, v2)
+    assert isinstance(d, D.EdgeDelta)
+    assert len(d.add_src) == 1 and d.adds_only
+    # same-version: the empty delta
+    d0 = D.compile_edge_delta(storage, g2, g2, v2, v2)
+    assert d0.n_delta == 0
+    # wrapped: the typed verdict rides through
+    for _ in range(1100):
+        storage._bump_topology({0})
+    acc3, g3, v3 = _export(storage)
+    verdict = D.compile_edge_delta(storage, g2, g3, v2, v3)
+    assert isinstance(verdict, ChangeLogUnknowable)
+    acc2.abort()
+    acc3.abort()
+
+
+# ==========================================================================
+# 7. device_chaos: fault mid-(delta apply -> dispatch)
+# ==========================================================================
+
+
+@pytest.mark.device_chaos
+def test_device_fault_after_delta_apply_resumes_consistent(dserver):
+    """A device fault on the FIRST chunk dispatch AFTER a delta apply
+    is absorbed by the checkpoint layer (resume from the iteration-0
+    checkpoint) and the reply must come from the CONSISTENT new
+    generation — never a half-applied or stale layout. A payload-free
+    follow-up must also serve generation v2."""
+    from memgraph_tpu.parallel.analytics import pagerank_mesh
+    from memgraph_tpu.parallel.mesh import get_mesh_context
+    _srv, client, _ = dserver
+    rng = np.random.default_rng(40)
+    n, e = 200, 1200
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    client.pagerank(src=src, dst=dst, n_nodes=n, graph_key="chaos",
+                    graph_version=1, tol=TOL)
+    add_src = rng.integers(0, n, 10)
+    add_dst = rng.integers(0, n, 10)
+    src2 = np.concatenate([src, add_src])
+    dst2 = np.concatenate([dst, add_dst])
+    changed = np.unique(np.concatenate([add_src,
+                                        add_dst])).astype(np.int32)
+    inc_src, inc_dst, inc_w = _incident_payload(src2, dst2, changed, n)
+    resumes0 = _metric("analytics.resume_total")
+    # hit 1 is the _supervised entry fault point (before the resolve);
+    # hit 2 is the first CHUNK dispatch — i.e. after the delta apply
+    FI.arm("device.call", "raise", at=2)
+    try:
+        r, err, _ = client.pagerank(
+            n_nodes=n, graph_key="chaos", graph_version=2,
+            base_version=1, changed=changed, inc_src=inc_src,
+            inc_dst=inc_dst, inc_w=inc_w, tol=TOL)
+    finally:
+        FI.reset()
+    assert _metric("analytics.resume_total") > resumes0  # fault FIRED
+    assert err <= TOL
+    ref, _, _ = pagerank_mesh(from_coo(src2, dst2, n_nodes=n),
+                              get_mesh_context(1), tol=TOL)
+    assert np.abs(np.asarray(ref) - np.asarray(r)[:n]).max() < 10 * TOL
+    # payload-free follow-up: the generation must already be at v2
+    # (the apply survived the dispatch fault exactly once)
+    r2, err2, _ = client.pagerank(n_nodes=n, graph_key="chaos",
+                                  graph_version=2, base_version=2,
+                                  tol=TOL)
+    assert err2 <= TOL
+    assert np.abs(np.asarray(ref)
+                  - np.asarray(r2)[:n]).max() < 10 * TOL

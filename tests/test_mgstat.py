@@ -543,12 +543,19 @@ def test_default_stats_overhead_under_two_percent(interp):
     text = "MATCH (p:P) WHERE p.v > 100 RETURN count(p)"
     reg.fingerprint(text)                     # memo warm (plan-cache analog)
 
+    # Deterministic clock (PR 13 review deflake): both micro-benchmarks
+    # are CPU-bound in THIS thread, and the 2% claim is about CPU cost
+    # per call, so measure with thread_time — scheduler preemption and
+    # leftover daemon threads from earlier tests inflated the
+    # wall-clock per_call batches under full-suite load (the test
+    # passed alone, flaked in-suite) while the per-query batches could
+    # land in a quiet window, flipping the ratio.
     def stat_batch():
-        t0 = time.perf_counter()
+        t0 = time.thread_time()
         for _ in range(2000):
             fp = reg.fingerprint(text)
             reg.record(fp, 0.001, rows=1, plan_cache_hit=True)
-        return (time.perf_counter() - t0) / 2000
+        return (time.thread_time() - t0) / 2000
 
     per_call = min(stat_batch() for _ in range(5))
     reg.reset()
@@ -556,10 +563,10 @@ def test_default_stats_overhead_under_two_percent(interp):
     interp.execute(text)                      # warm plan cache
 
     def query_batch():
-        t0 = time.perf_counter()
+        t0 = time.thread_time()
         for _ in range(30):
             interp.execute(text)
-        return (time.perf_counter() - t0) / 30
+        return (time.thread_time() - t0) / 30
 
     per_query = min(query_batch() for _ in range(3))
     budget_calls = 2                          # fingerprint + record

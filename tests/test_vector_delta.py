@@ -156,16 +156,22 @@ def test_changes_between_log_semantics():
     b = acc.create_vertex()
     acc.commit()
     v1 = storage.topology_version
+    from memgraph_tpu.storage.storage import ChangeLogUnknowable
     changed = storage.changes_between(v0, v1)
-    assert changed is not None and {a.gid, b.gid} <= set(changed)
-    # unknown ranges (beyond the log) report None
-    assert storage.changes_between(-10_000, v1) is None
+    assert isinstance(changed, frozenset) \
+        and {a.gid, b.gid} <= set(changed)
+    # unknown ranges (beyond the log) report the typed falsy verdict
+    wrapped = storage.changes_between(-10_000, v1)
+    assert isinstance(wrapped, ChangeLogUnknowable) and not wrapped
+    assert wrapped.reason == "log_wrapped"
     # empty range
     assert storage.changes_between(v1, v1) == frozenset()
     # full-invalidation bumps poison the covering range
     storage._bump_topology(None)
     v2 = storage.topology_version
-    assert storage.changes_between(v1, v2) is None
+    untracked = storage.changes_between(v1, v2)
+    assert isinstance(untracked, ChangeLogUnknowable)
+    assert untracked.reason == "untracked_bump"
 
 
 def test_read_your_own_writes_in_transaction(db):

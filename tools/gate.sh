@@ -84,6 +84,15 @@ stage "mgchaos device nemesis smoke (supervised kernel plane)" \
 stage "ppr-smoke (coalesced PPR serving plane)" \
     python -m tools.ppr_smoke
 
+# 4cc. mgdelta smoke: kernel server import at v1 → delta-only request
+#      at v2 (changed + incident edges, no full arrays) refreshing the
+#      resident generation O(delta) with a warm-started, residual-
+#      equivalent reply; WCC monotone gate (warm on adds-only, LOUD
+#      typed cold on removal); change-log-wrap typed fallback.
+#      Functional on every host; delta_speedup is the bench's job.
+stage "delta-smoke (incremental resident analytics plane)" \
+    python -m tools.delta_smoke
+
 # 4d. shard-plane smoke: spawn 4 shard workers (own storage + WAL per
 #     shard), routed point reads/writes, scatter-gather merge, a
 #     cross-shard 2PC transaction, one LIVE shard-move (epoch bump +

@@ -247,6 +247,34 @@ def _b_seg_pagerank(kernel):
         sorted=True)
 
 
+@builder("segment:pagerank_warm")
+def _b_seg_pagerank_warm(kernel):
+    # r19 mgdelta: the commit-then-CALL warm start — identical program
+    # modulo the donated x0 seed argument
+    from memgraph_tpu.ops.pagerank import (_pagerank_epilogue,
+                                           _pagerank_setup)
+    return _segment_fixpoint(
+        "plus_times", arrays=_edge_arrays(csr=True),
+        params={"n_nodes": _sds((), "int32"),
+                "damping": _sds((), "float32"),
+                "tol": _sds((), "float32")},
+        x0=_sds((N_PAD,), "float32"), setup=_pagerank_setup,
+        epilogue=_pagerank_epilogue, sorted=True)
+
+
+@builder("segment:katz_warm")
+def _b_seg_katz_warm(kernel):
+    from memgraph_tpu.ops.katz import _katz_epilogue, _katz_setup
+    return _segment_fixpoint(
+        "plus_times", arrays=_edge_arrays(),
+        params={"n_nodes": _sds((), "int32"),
+                "alpha": _sds((), "float32"),
+                "beta": _sds((), "float32"),
+                "tol": _sds((), "float32")},
+        x0=_sds((N_PAD,), "float32"), setup=_katz_setup,
+        epilogue=_katz_epilogue, sorted=True)
+
+
 @builder("segment:ppr")
 def _b_seg_ppr(kernel):
     from memgraph_tpu.ops.pagerank import _ppr_epilogue, _ppr_setup

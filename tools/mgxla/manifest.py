@@ -135,6 +135,19 @@ MANIFEST: dict[str, KernelContract] = {
     "segment:pagerank": _c(
         "segment:pagerank", "segment", ["pagerank"],
         note="fused damping update + L1 partial in the while body"),
+    "segment:pagerank_warm": _c(
+        "segment:pagerank_warm", "segment", ["pagerank"],
+        min_donated=1,
+        note="r19 mgdelta warm-start variant: the previous solution "
+             "rides in as x0 and is DONATED into the iterate; the loop "
+             "body must be structure-identical to the cold variant "
+             "(same zero-collective, no-f64, no-host-callback "
+             "contract — warm start is data, not structure)"),
+    "segment:katz_warm": _c(
+        "segment:katz_warm", "segment", ["katz"],
+        min_donated=1,
+        note="r19 mgdelta warm-start variant of segment:katz — "
+             "donated x0 seed, structure-identical body"),
     "segment:ppr": _c(
         "segment:ppr", "segment", ["personalized_pagerank"],
         note="restart-vector fixpoint (single query, in-process path)"),

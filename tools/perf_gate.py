@@ -240,6 +240,63 @@ def check_ppr(record: dict, envelopes: dict) -> int:
     return rc
 
 
+def check_delta(record: dict, envelopes: dict) -> int:
+    """r19 mgdelta envelope over the record's ``extra.delta`` stage:
+    commit-then-CALL pagerank after a ≤1% edge churn on the resident
+    graph must beat the cold full-rebuild path by the declared factor,
+    at the same tol (residual-equivalent, the stage records the Linf
+    gap), with warm iterations never exceeding cold. Same honesty
+    contract as the other sweeps: a CPU (degraded) sub-record can never
+    satisfy the on-device envelope, an untagged one FAILS."""
+    env = envelopes.get("delta_speedup")
+    if env is None:
+        return 0
+    delta = (record.get("extra") or {}).get("delta")
+    if delta is None:
+        log("FAIL: BASELINE.json declares a delta_speedup envelope but "
+            "the record carries no extra.delta stage — regenerate with "
+            "the current bench.py")
+        return 1
+    if "degraded" not in delta:
+        log("FAIL: delta stage carries no degraded tag — an untagged "
+            "number cannot be trusted")
+        return 1
+    if delta.get("backend") == "cpu" and not delta.get("degraded"):
+        log("FAIL: delta stage ran on cpu but is not tagged degraded")
+        return 1
+    if delta["degraded"]:
+        log(f"FAIL: delta stage is degraded (backend="
+            f"{delta.get('backend', '?')}) — a CPU commit-then-CALL "
+            "curve cannot stand in for the resident-graph headline")
+        return 1
+    rc = 0
+    got = float(delta.get("delta_speedup", 0.0))
+    need = float(env.get("min_speedup", 10.0))
+    if got < need:
+        log(f"FAIL: delta speedup {got:.2f}x < required {need:.1f}x — "
+            "the incremental path stopped paying for its bookkeeping")
+        rc = 1
+    else:
+        log(f"PASS: delta speedup {got:.2f}x (>= {need:.1f}x)")
+    max_churn = float(env.get("max_churn", 0.01))
+    if float(delta.get("churn", 1.0)) > max_churn:
+        log(f"FAIL: delta stage churn {delta.get('churn')} exceeds the "
+            f"envelope's ≤{max_churn:.0%} contract")
+        rc = 1
+    if int(delta.get("iters_warm", 1 << 30)) > int(
+            delta.get("iters_cold", 0)):
+        log("FAIL: warm-started fixpoint took MORE iterations than "
+            "cold — the seed is hurting, not helping")
+        rc = 1
+    tol_linf = float(env.get("max_residual_linf", 1e-5))
+    if float(delta.get("residual_linf", 1.0)) > tol_linf:
+        log(f"FAIL: warm result diverges from cold by Linf "
+            f"{delta.get('residual_linf')} > {tol_linf} — warm start "
+            "is not residual-equivalent")
+        rc = 1
+    return rc
+
+
 def check_sharding(record: dict | None, envelopes: dict) -> int:
     """r18 shard-scaling envelope over the newest OLTP_r*.json record:
     the sharded point-read group must beat the single-process aggregate
@@ -332,11 +389,13 @@ def main(argv=None) -> int:
         if record is None:
             log("FAIL: could not obtain a bench measurement")
             return 1
-        return check(record, baseline)
+        return (check(record, baseline)
+                or check_delta(record, baseline.get("envelopes") or {}))
 
     with open(path) as f:
         record = json.load(f)
     rc = check(record, baseline)
+    rc = rc or check_delta(record, baseline.get("envelopes") or {})
     if args.latest:
         # the serving-plane record rides the same --latest gate run
         ppr_path = latest_ppr_json()
