@@ -341,6 +341,76 @@ def main():
     if par and ser:
         par["speedup_vs_serial"] = round(ser["mean_ms"] / par["mean_ms"], 1)
 
+    # compiled read lane (r20 mglane): the two groups the lane exists
+    # for — a filtered aggregate tail and a set-oriented two-hop count —
+    # measured lane-ON (compiled device program) vs lane-OFF (the
+    # serial row-at-a-time interpreter). The env toggles change PLAN
+    # shape, so plans are invalidated between modes; this needs the
+    # in-process server (an external --port server keeps its own env).
+    lane_report = None
+    if not args.port:
+        import jax
+
+        from memgraph_tpu.ops import pipeline as lane_pl
+
+        LANE_AGG_Q = ("MATCH (n:User) WHERE n.age > 40 "
+                      "RETURN count(*), sum(n.age), min(n.age), "
+                      "max(n.age)")
+        LANE_HOP_Q = ("MATCH (a:User)-[:FRIEND]->(b)-[:FRIEND]->(m) "
+                      "WHERE a.age < 2 RETURN count(m)")
+
+        def _lane_mode(off: bool) -> None:
+            for k in ("MEMGRAPH_TPU_DISABLE_LANE",
+                      "MEMGRAPH_TPU_DISABLE_PARALLEL"):
+                if off:
+                    os.environ[k] = "1"
+                else:
+                    os.environ.pop(k, None)
+            server.ictx.invalidate_plans()
+
+        def _m(name):
+            from memgraph_tpu.observability.metrics import global_metrics
+            return {n: v for n, _k, v
+                    in global_metrics.snapshot()}.get(name, 0.0)
+
+        print("compiled-lane groups (lane on/off) ...", file=sys.stderr)
+        _lane_mode(False)
+        hits0 = _m("lane.hit_total")
+        groups.append(run_group(client, "aggregate_lane_on", LANE_AGG_Q,
+                                None, max(args.iterations // 10, 5),
+                                warmup=1))
+        groups.append(run_group(client, "two_hop_lane_on", LANE_HOP_Q,
+                                None, max(args.iterations // 30, 5),
+                                warmup=1))
+        lane_served = _m("lane.hit_total") > hits0
+        resident_after_on = lane_pl.resident_programs()
+        _lane_mode(True)
+        groups.append(run_group(client, "aggregate_lane_off",
+                                LANE_AGG_Q, None, 3))
+        groups.append(run_group(client, "two_hop_lane_off", LANE_HOP_Q,
+                                None, 3))
+        _lane_mode(False)
+        for on_name, off_name in (("aggregate_lane_on",
+                                   "aggregate_lane_off"),
+                                  ("two_hop_lane_on",
+                                   "two_hop_lane_off")):
+            on = next((g for g in groups if g["name"] == on_name
+                       and "p99_ms" in g), None)
+            off = next((g for g in groups if g["name"] == off_name
+                        and "p99_ms" in g), None)
+            if on and off:
+                on["p99_speedup_vs_serial"] = round(
+                    off["p99_ms"] / max(on["p99_ms"], 1e-9), 1)
+        backend = jax.default_backend()
+        lane_report = {
+            "backend": backend,
+            # honesty: a CPU-host lane number is a machinery proof, not
+            # the accelerator headline
+            "degraded": backend == "cpu",
+            "lane_served": bool(lane_served),
+            "resident_programs": resident_after_on,
+        }
+
     # multi-client scaling: N concurrent connections hammering point
     # reads. Clients run as separate PROCESSES so their encode/decode CPU
     # doesn't share the server's GIL; server-side execution runs on the
@@ -490,6 +560,8 @@ def main():
               "load_records_per_sec":
               round((args.nodes + args.edges) / load_s, 1),
               "groups": groups}
+    if lane_report is not None:
+        report["lane"] = lane_report
     if report["degraded"]:
         report["degraded_reason"] = (
             f"host has {cores} core(s) for {args.shards} shard "

@@ -24,6 +24,18 @@ from . import trace as mgtrace
 from .metrics import global_metrics
 
 
+def _lane_stats() -> dict:
+    """Compiled-read-lane residency table (import deferred: the lane
+    lives in ops/, which must not load just to serve /metrics)."""
+    try:
+        from ..ops.pipeline import lane_stats
+        return lane_stats()
+    except Exception as e:  # noqa: BLE001 — stats must never break /stats
+        import logging
+        logging.getLogger(__name__).debug("lane stats unavailable: %s", e)
+        return {"resident_programs": 0, "fingerprints": {}}
+
+
 async def start_monitoring_server(host: str, port: int, ictx):
     async def handle(reader, writer):
         try:
@@ -98,7 +110,14 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     # move durations, routing-table epoch
                     "sharding": {name: value for name, _k, value
                                  in global_metrics.snapshot()
-                                 if name.startswith("shard.")}},
+                                 if name.startswith("shard.")},
+                    # compiled Cypher read lane (r20, mglane):
+                    # compile/hit/typed-fallback counters plus the
+                    # per-fingerprint lane residency table
+                    "lane": dict(_lane_stats(), metrics={
+                        name: value for name, _k, value
+                        in global_metrics.snapshot()
+                        if name.startswith("lane.")})},
                     default=str)
                 ctype = "application/json"
             elif path.startswith("/health"):

@@ -116,6 +116,13 @@ STAT_NAMES = (
     # device compile plane (r17, mgxla): runtime witness for the static
     # compile budget — every XLA backend compile bumps it
     "jit.compile_total",
+    # compiled Cypher read lane (r20, mglane)
+    "lane.compiled_total",          # lane programs compiled (per shape)
+    "lane.hit_total",               # queries served from a compiled lane
+    "lane.fallback_total.*",        # typed per-reason loud fallbacks
+    "lane.compile_latency_sec",     # histogram: per-program compile cost
+    "lane.resident",                # resident compiled-programs gauge
+    "lane.remote_dispatch_total",   # hop programs routed via kernel srv
     # incremental analytics plane (r19, mgdelta): commit-to-fresh-result
     "delta.applied_total",          # EdgeDelta splices applied
     "delta.compacted_total",        # bounded-accumulation full rebuilds

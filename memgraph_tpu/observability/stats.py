@@ -68,9 +68,13 @@ ENV_MAX_PPR_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_PPR_QUEUE"  # pending (192)
 ENV_MAX_SHARD_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_SHARD_QUEUE"  # depth (16)
 
 #: every device stage the accumulator may carry — the attribution
-#: vocabulary PROFILE and BENCH records share
+#: vocabulary PROFILE and BENCH records share. The ``lane_*`` stages
+#: are the compiled read lane's split (r20 mglane): program build /
+#: host staging + upload / device execution, so PROFILE on a
+#: lane-served query shows where its milliseconds went.
 STAGE_NAMES = ("kernel_dispatch", "device_transfer", "device_compile",
-               "device_iterate")
+               "device_iterate", "lane_compile", "lane_dispatch",
+               "lane_iterate")
 
 
 def _env_int(name: str, default: int) -> int:

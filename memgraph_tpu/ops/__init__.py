@@ -109,6 +109,33 @@ SPMV_ALGORITHMS = {
                   "(parallel.mesh.make_mesh_2d), not the edge axis the "
                   "partition-centric kernels shard",
     },
+    # ---- mglane: compiled Cypher read pipelines (query/plan/lane.py) ----
+    "lane_agg": {
+        "entry": "memgraph_tpu.ops.pipeline:masked_aggregate",
+        "core": "blocks",
+        "exempt": "OLTP read-lane aggregate epilogue: a single fused "
+                  "masked-reduction program per plan-cache fingerprint; "
+                  "one query's columns are latency-bound and fit one "
+                  "device, so the mesh axis (concurrent queries) is the "
+                  "serving plane's batcher, not edge sharding",
+    },
+    "lane_hops": {
+        "entry": "memgraph_tpu.ops.pipeline:hop_counts",
+        "core": "plus_first",
+        "exempt": "1-2 hop masked frontier counts for the compiled read "
+                  "lane; a fixed-depth (non-iterating) spmv chain whose "
+                  "per-query latency budget is OLTP-scale — sharding "
+                  "one query's two matvecs across chips costs more in "
+                  "collectives than it saves",
+    },
+    "lane_topk": {
+        "entry": "memgraph_tpu.ops.pipeline:masked_topk",
+        "core": "blocks",
+        "exempt": "ORDER BY LIMIT k as one fused mask + stable argsort "
+                  "program; single-device by construction (the sort is "
+                  "over one query's filtered column, not the graph's "
+                  "edge axis the mesh kernels shard)",
+    },
 }
 
 __all__ = ["DeviceGraph", "ShardedCSR", "export_csr", "shard_csr",

@@ -44,6 +44,12 @@ exact; the selector only changes which formulation the device executes.
 
 Adding a new algorithm is a ~50-line (semiring, setup, epilogue)
 definition — see docs/architecture.md §Semiring kernel core.
+
+The seam also serves NON-iterating consumers: the compiled Cypher read
+lane (r20 mglane, ops/pipeline.py) lowers 1–2 hop expansions onto
+fixed-depth masked :func:`spmv` chains over the ``plus_first`` /
+``or_and`` rows of the table — same masks, same backends, same stage
+attribution, no while_loop.
 """
 
 from __future__ import annotations

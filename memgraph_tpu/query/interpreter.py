@@ -115,6 +115,11 @@ class InterpreterContext:
     def invalidate_plans(self) -> None:
         with self._plan_cache_lock:
             self._plan_cache.clear()
+        # schema changes invalidate compiled lanes too: a lane program
+        # compiled under dropped DDL / stale statistics must never
+        # serve again (query/plan/lane.py; regression: tests/test_lane)
+        from .plan.lane import invalidate_lanes
+        invalidate_lanes()
 
 
 @dataclass
@@ -890,6 +895,13 @@ class Interpreter:
             self._query_fingerprint = global_query_stats.fingerprint(strip)
         else:
             self._query_fingerprint = None
+        if getattr(plan, "_has_lane", False):
+            # compiled read lane: the mgstat fingerprint is the lane's
+            # compile-cache key and stats bucket (query/plan/lane.py)
+            from .plan.lane import bind_fingerprints
+            from ..observability.stats import fingerprint_text
+            bind_fingerprints(plan, self._query_fingerprint
+                              or fingerprint_text(strip))
         self._plan_cache_hit = cache_hit
         self._rows_emitted = 0
 
@@ -1269,6 +1281,11 @@ class Interpreter:
             _json.dumps([node.kind, node.label, list(node.properties)]),
             node.action == "create",
             value=(node.data_type or "").upper())
+        # constraint DDL must drop cached plans AND compiled lanes, same
+        # as index DDL: a unique constraint is also an index the planner
+        # keys scans on, and a lane compiled before the drop would keep
+        # serving a schema that no longer exists (bugfix, r20 mglane)
+        self.ctx.invalidate_plans()
         yield [f"Constraint {node.action}d."]
 
     # --- info / admin -------------------------------------------------------

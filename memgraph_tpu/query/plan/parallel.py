@@ -76,8 +76,11 @@ class ParallelScanAggregate(Op.LogicalOperator):
 
     # -- columnar path ----------------------------------------------------
 
-    def _snapshot_and_mask(self, ctx, extra_props=()):
-        """Shared preamble: columnar snapshot + predicate mask."""
+    def _snapshot_base(self, ctx, extra_props=()):
+        """Columnar snapshot + base validity mask (None = every row),
+        BEFORE predicates — the compiled lane (query/plan/lane.py)
+        shares this and fuses the predicate masks into its device
+        program instead of applying them host-side."""
         props = tuple(sorted(
             {p for p, _, _ in self.predicates}
             | {p for _, p, _ in self.aggregations if p is not None}
@@ -87,7 +90,13 @@ class ParallelScanAggregate(Op.LogicalOperator):
         ctx.check_abort()
         if snap.n < MIN_ROWS and not self.hinted:
             raise _Unsupported
-        mask = np.ones(snap.n, dtype=bool)
+        return snap, None
+
+    def _snapshot_and_mask(self, ctx, extra_props=()):
+        """Shared preamble: columnar snapshot + predicate mask."""
+        snap, base = self._snapshot_base(ctx, extra_props)
+        mask = np.ones(snap.n, dtype=bool) if base is None \
+            else base.copy()
         for prop, op, rhs_expr in self.predicates:
             mask &= _pred_mask(ctx, snap, prop, op, rhs_expr)
         return snap, mask
@@ -310,6 +319,15 @@ class ParallelExpandAggregate(ParallelScanAggregate):
     edge_types: Optional[list] = None
 
     def _snapshot_and_mask(self, ctx, extra_props=()):
+        snap, valid = self._snapshot_base(ctx, extra_props)
+        mask = valid.copy()
+        for key, op, rhs_expr in self.predicates:
+            mask &= _pred_mask(ctx, snap, key, op, rhs_expr)
+        return snap, mask
+
+    def _snapshot_base(self, ctx, extra_props=()):
+        """Edge-aligned columnar snapshot + orientation validity mask,
+        BEFORE predicates (shared with the compiled lane)."""
         from ...ops.columnar import ColumnarSnapshot
         role_props: dict = {"n0": set(), "n1": set(), "e": set()}
         for key, _, _ in self.predicates:
@@ -385,11 +403,7 @@ class ParallelExpandAggregate(ParallelScanAggregate):
         for prop in role_props["e"]:
             snap.columns[f"e.{prop}"] = _gather_column(
                 edges.columns[prop], erow, valid)
-
-        mask = valid.copy()
-        for key, op, rhs_expr in self.predicates:
-            mask &= _pred_mask(ctx, snap, key, op, rhs_expr)
-        return snap, mask
+        return snap, valid
 
 
 def _pred_mask(ctx, snap, prop, op, rhs_expr) -> np.ndarray:

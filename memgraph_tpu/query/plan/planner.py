@@ -331,6 +331,11 @@ class Planner:
             columns = []
         from .parallel import parallel_rewrite
         plan = parallel_rewrite(plan, hinted=parallel_hint)
+        # compiled read lane: lower the columnar tails (and the 1-2 hop
+        # count shapes the columnar collapse does not claim) onto the
+        # device programs in ops/pipeline.py (query/plan/lane.py)
+        from .lane import lane_rewrite
+        plan = lane_rewrite(plan, hinted=parallel_hint)
         return plan, columns
 
     def _call_fields(self, clause: A.CallProcedure) -> list[str]:

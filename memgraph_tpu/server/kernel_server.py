@@ -1008,7 +1008,7 @@ class KernelServer:
                         reply, out_arrays = self._ppr.submit(header,
                                                              arrays)
                         _send_msg(conn, reply, out_arrays)
-                    elif op in ("pagerank", "semiring", "probe"):
+                    elif op in ("pagerank", "semiring", "probe", "lane"):
                         # supervised: admission guard + worker thread +
                         # per-request deadline; the reply ships AFTER
                         # the dispatch lock is released — a slow client
@@ -1166,6 +1166,8 @@ class KernelServer:
                      "sum": checksum}, None)
         if op == "semiring":
             return self._op_semiring(header, arrays)
+        if op == "lane":
+            return self._op_lane(header, arrays)
         return self._op_pagerank(header, arrays)
 
     def _health_reply(self) -> dict:
@@ -1184,7 +1186,7 @@ class KernelServer:
         counters = {name: value for name, _kind, value
                     in global_metrics.snapshot()
                     if name.startswith(("kernel_server.", "analytics.",
-                                        "ppr.", "delta."))}
+                                        "ppr.", "delta.", "lane."))}
         return {"ok": True, "pid": os.getpid(),
                 "uptime_s": round(now - self._started, 3),
                 "in_flight": len(entries),
@@ -1485,6 +1487,35 @@ class KernelServer:
                  "error": f"unknown semiring algorithm {algorithm!r}"},
                 None)
 
+    def _op_lane(self, header, arrays):
+        """Compiled read-lane hop-count dispatch (r20 mglane): the same
+        masked plus_first SpMV chain the in-process lane runs
+        (ops/pipeline.py hop_counts), served from the resident device
+        plane so OLTP frontends can route their compiled expansions
+        like any analytics op. Runs under _dispatch_lock."""
+        from ..ops import pipeline as pl
+        for need in ("src", "dst", "emask", "smask", "midmask", "tmask"):
+            if need not in arrays:
+                return ({"ok": False,
+                         "error": f"lane op needs array {need!r}"}, None)
+        global_metrics.increment("lane.remote_dispatch_total")
+        try:
+            totals = pl.hop_counts(
+                arrays["src"], arrays["dst"], arrays["emask"],
+                arrays["smask"], arrays["midmask"], arrays["tmask"],
+                int(header.get("n_nodes", len(arrays["smask"]))),
+                hops=int(header.get("hops", 2)),
+                include_lower=bool(header.get("include_lower", False)),
+                edge_unique=bool(header.get("edge_unique", True)),
+                need_rows=bool(header.get("need_rows", True)),
+                need_distinct=bool(header.get("need_distinct", False)),
+                fingerprint=header.get("fingerprint"))
+        except pl.LaneRefused as e:
+            return ({"ok": False, "outcome": "invalid",
+                     "lane_refused": e.reason,
+                     "error": f"lane refused: {e.reason}"}, None)
+        return ({"ok": True, **totals}, None)
+
 
 # --------------------------------------------------------------------------
 # client
@@ -1659,6 +1690,43 @@ class KernelClient:
         if not h.get("ok"):
             _raise_for_reply(h)
         return h, out
+
+    def lane_hops(self, src, dst, emask, smask, midmask, tmask, *,
+                  n_nodes, hops=2, include_lower=False, edge_unique=True,
+                  need_rows=True, need_distinct=False, deadline_s=None,
+                  fingerprint=None) -> dict:
+        """Dispatch one compiled read-lane hop-count program (r20
+        mglane) on the resident daemon. The server refuses with a typed
+        reason exactly like the in-process lane; the caller's LOUD
+        fallback contract is identical. Returns {"rows": n,
+        "distinct": n} per request flags."""
+        from ..ops.pipeline import LaneRefused
+        arrays = {"src": np.asarray(src, dtype=np.int32),
+                  "dst": np.asarray(dst, dtype=np.int32),
+                  "emask": np.asarray(emask, dtype=bool),
+                  "smask": np.asarray(smask, dtype=bool),
+                  "midmask": np.asarray(midmask, dtype=np.float32),
+                  "tmask": np.asarray(tmask, dtype=np.float32)}
+        header = {"op": "lane", "n_nodes": int(n_nodes),
+                  "hops": int(hops),
+                  "include_lower": bool(include_lower),
+                  "edge_unique": bool(edge_unique),
+                  "need_rows": bool(need_rows),
+                  "need_distinct": bool(need_distinct),
+                  "fingerprint": fingerprint}
+        if deadline_s is not None:
+            header["deadline_s"] = deadline_s
+        carrier = mgtrace.inject()
+        if carrier is not None:
+            header["trace"] = carrier
+        h, _out = self.call(header, arrays)
+        if not h.get("ok"):
+            if h.get("lane_refused"):
+                raise LaneRefused(h["lane_refused"],
+                                  h.get("error", ""))
+            _raise_for_reply(h)
+        return {k: int(v) for k, v in h.items()
+                if k in ("rows", "distinct")}
 
     def shutdown(self) -> None:
         try:
@@ -1933,6 +2001,24 @@ class SupervisedKernelClient:
             lambda c: c.pagerank(src=src, dst=dst, weights=weights,
                                  n_nodes=n_nodes, graph_key=graph_key,
                                  deadline_s=deadline_s, **params),
+            idempotent)
+
+    def lane_hops(self, src, dst, emask, smask, midmask, tmask, *,
+                  n_nodes, idempotent: bool = True,
+                  deadline_s: float | None = None, **params):
+        """Compiled read-lane hop counts with supervised retries (r20
+        mglane). Pure computation ⇒ idempotent; LaneRefused passes
+        through untouched so the caller's typed fallback fires."""
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        # a typed LaneRefused from the reply propagates untouched (it
+        # is not one of the supervised retry classes), so the caller's
+        # loud fallback fires instead of a retry storm
+        return self._call_supervised(
+            "lane",
+            lambda c: c.lane_hops(src, dst, emask, smask, midmask,
+                                  tmask, n_nodes=n_nodes,
+                                  deadline_s=deadline_s, **params),
             idempotent)
 
     def ppr(self, sources, idempotent: bool = True,

@@ -93,6 +93,14 @@ stage "ppr-smoke (coalesced PPR serving plane)" \
 stage "delta-smoke (incremental resident analytics plane)" \
     python -m tools.delta_smoke
 
+# 4cd. mglane smoke: a lane-eligible read pipeline compiles ONCE and
+#      serves from the compiled program, refusal shapes fall back
+#      LOUDLY (typed reason) with identical answers, and index DDL
+#      drops every compiled lane with results bit-identical to the
+#      serial interpreter (the stale-lane regression).
+stage "lane-smoke (compiled Cypher read lane)" \
+    python -m tools.lane_smoke
+
 # 4d. shard-plane smoke: spawn 4 shard workers (own storage + WAL per
 #     shard), routed point reads/writes, scatter-gather merge, a
 #     cross-shard 2PC transaction, one LIVE shard-move (epoch bump +

@@ -462,6 +462,49 @@ def _b_ppr_warm(kernel):
     return _ppr_batch_text(8, warm=True)
 
 
+# ---- compiled Cypher read lane (r20, mglane) ------------------------------
+
+
+@builder("segment:lane_agg")
+def _b_lane_agg(kernel):
+    from memgraph_tpu.ops.pipeline import _build_agg_program
+    fn = _build_agg_program(
+        preds=((0, ">"), (1, "=")),
+        aggs=(("count", None), ("sum", 0), ("min", 0), ("max", 1)))
+    return _compiled(fn.lower(
+        _sds((2, N_PAD), "int32"), _sds((2, N_PAD), "bool_"),
+        _sds((N_PAD,), "bool_"), _sds((2,), "int32")))
+
+
+def _lane_hops_text(hops: int) -> str:
+    from memgraph_tpu.ops.pipeline import _build_hops_program
+    fn = _build_hops_program(hops, False, True, True, hops == 2, N_PAD)
+    return _compiled(fn.lower(
+        _sds((N_EDGES,), "int32"), _sds((N_EDGES,), "int32"),
+        _sds((N_EDGES,), "bool_"), _sds((N_PAD,), "bool_"),
+        _sds((N_PAD,), "float32"), _sds((N_PAD,), "float32")))
+
+
+@builder("segment:lane_hops:h1")
+def _b_lane_hops1(kernel):
+    return _lane_hops_text(1)
+
+
+@builder("segment:lane_hops:h2")
+def _b_lane_hops2(kernel):
+    return _lane_hops_text(2)
+
+
+@builder("segment:lane_topk")
+def _b_lane_topk(kernel):
+    from memgraph_tpu.ops.pipeline import _build_topk_program
+    fn = _build_topk_program(preds=((0, ">"),), ascending=False)
+    return _compiled(fn.lower(
+        _sds((1, N_PAD), "int32"), _sds((1, N_PAD), "bool_"),
+        _sds((N_PAD,), "int32"), _sds((N_PAD,), "bool_"),
+        _sds((1,), "int32")))
+
+
 # --------------------------------------------------------------------------
 # contract checks
 # --------------------------------------------------------------------------

@@ -190,6 +190,34 @@ MANIFEST: dict[str, KernelContract] = {
         "mxu:katz", "mxu", ["katz"],
         note="same machinery, katz epilogue, zeros start"),
 
+    # ---- compiled Cypher read lane (r20, mglane) ----------------------
+    # single-shot (non-iterating) programs; the contract here is the
+    # implicit one — zero collectives, zero f64, zero host callbacks —
+    # plus the structural note: predicate masks are FUSED into every
+    # reduction (where(mask, v, identity)), never a gather-then-filter
+    # materialization of the selected rows
+    "segment:lane_agg": _c(
+        "segment:lane_agg", "segment", ["lane_agg"], iterates=False,
+        note="scan/expand aggregate tail: stacked int32 columns -> "
+             "fused predicate masks -> count/sum/min/max epilogues "
+             "with int32 accumulation + f32 mass witnesses"),
+    "segment:lane_hops:h1": _c(
+        "segment:lane_hops:h1", "segment", ["lane_hops"],
+        iterates=False,
+        note="one-hop masked frontier count: plus_first spmv over the "
+             "semiring core, target mask folded into the epilogue"),
+    "segment:lane_hops:h2": _c(
+        "segment:lane_hops:h2", "segment", ["lane_hops"],
+        iterates=False,
+        note="two-hop path count: chained masked plus_first spmv with "
+             "the self-loop edge-uniqueness correction and the "
+             "distinct-target (reachability popcount) epilogue"),
+    "segment:lane_topk": _c(
+        "segment:lane_topk", "segment", ["lane_topk"], iterates=False,
+        note="ORDER BY <int key> LIMIT k: fused predicate mask + "
+             "stable argsort; nulls ranked per openCypher, excluded "
+             "rows sorted past every included row"),
+
     # ---- PPR serving-plane lane buckets -------------------------------
     **_ppr_bucket_contracts(),
 }

@@ -357,6 +357,65 @@ def check_sharding(record: dict | None, envelopes: dict) -> int:
     return rc
 
 
+def check_lane(record: dict | None, envelopes: dict) -> int:
+    """r20 mglane envelope over the newest OLTP_r*.json record: the
+    compiled read lane must serve the aggregate and two-hop groups with
+    the declared p99 reduction vs the serial interpreter path, on a
+    non-degraded lane sub-record (a CPU lane curve carries
+    ``lane.degraded: true`` and fails here exactly like every other
+    CPU stand-in — the CPU record still documents the machinery, the
+    gate defends the accelerator headline)."""
+    env = envelopes.get("columnar_lane")
+    if env is None:
+        return 0
+    if record is None:
+        log("FAIL: BASELINE.json declares a columnar_lane envelope but "
+            "no OLTP_r*.json record exists — run benchmarks/mgbench.py "
+            "--out OLTP_rN.json")
+        return 1
+    lane = record.get("lane")
+    if lane is None:
+        log("FAIL: OLTP record carries no lane sub-record — regenerate "
+            "with the current mgbench.py")
+        return 1
+    if "degraded" not in lane:
+        log("FAIL: lane sub-record carries no degraded tag — an "
+            "untagged number cannot be trusted")
+        return 1
+    if lane.get("backend") == "cpu" and not lane.get("degraded"):
+        log("FAIL: lane groups ran on cpu but are not tagged degraded")
+        return 1
+    if lane["degraded"]:
+        log(f"FAIL: lane sub-record is degraded (backend="
+            f"{lane.get('backend', '?')}); a CPU lane curve can never "
+            "stand in for the compiled-lane headline")
+        return 1
+    rc = 0
+    if not lane.get("lane_served"):
+        log("FAIL: lane groups did not actually serve from the "
+            "compiled lane (lane.hit_total never moved)")
+        rc = 1
+    need = float(env.get("min_p99_speedup", 10.0))
+    for group_name in env.get("groups", ("aggregate_lane_on",
+                                         "two_hop_lane_on")):
+        group = next((g for g in record.get("groups", [])
+                      if g.get("name") == group_name), None)
+        if group is None or "p99_speedup_vs_serial" not in group:
+            log(f"FAIL: record has no {group_name} group with a "
+                "p99_speedup_vs_serial measurement")
+            rc = 1
+            continue
+        got = float(group["p99_speedup_vs_serial"])
+        if got < need:
+            log(f"FAIL: {group_name} p99 speedup {got:.1f}x < required "
+                f"{need:.1f}x — the compiled lane stopped paying")
+            rc = 1
+        else:
+            log(f"PASS: {group_name} p99 speedup {got:.1f}x "
+                f"(>= {need:.1f}x)")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="perf_gate")
     ap.add_argument("--json", help="check an existing bench JSON record")
@@ -417,6 +476,8 @@ def main(argv=None) -> int:
                 oltp_record = json.load(f)
         rc = rc or check_sharding(oltp_record,
                                   baseline.get("envelopes") or {})
+        rc = rc or check_lane(oltp_record,
+                              baseline.get("envelopes") or {})
     return rc
 
 
