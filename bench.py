@@ -388,6 +388,58 @@ def stage_semiring(n_nodes, n_edges, seed, out_path):
              overlap=overlap, platform=platform, resident=resident)
 
 
+#: fixed sweep count for the tier stage — convergence is the smoke's
+#: and the test suite's territory; the bench wants a stable edges/s +
+#: overlap measurement over a known number of full-graph sweeps
+TIER_ITERATIONS = 20
+
+
+def stage_tier(n_nodes, n_edges, seed, out_path):
+    """Out-of-core streamed tier (r21 mgtier): PageRank over a
+    host-pinned TierCSR — compressed edge blocks stream H2D
+    double-buffered against the previous block's SpMV fold while the
+    rank vector stays device-resident. Records the measured serial
+    transfer/compute split (first iteration runs the blocks serially
+    to price both sides), the overlapped-iteration wall time and the
+    hidden-transfer fraction the BASELINE.json tier_overlap envelope
+    defends, plus the bf16/int8 wire-compression ratios vs raw COO."""
+    import jax
+    from memgraph_tpu.ops import tier as mgtier
+    from memgraph_tpu.parallel.distributed import pagerank_streamed
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_nodes, n_edges).astype(np.int64)
+    dst = rng.integers(0, n_nodes, n_edges).astype(np.int64)
+    w = (rng.random(n_edges) + 0.1).astype(np.float32)
+    # enough blocks that the double-buffer schedule has real work to
+    # hide even when the bench graph fits the default 32 MiB budget
+    n_blocks = max(8, mgtier.plan_blocks(n_nodes, n_edges, "f32",
+                                         mgtier.block_bytes_budget()))
+    tier = mgtier.plan_tier(src, dst, w, n_nodes, precision="f32",
+                            n_blocks=n_blocks)
+    pagerank_streamed(tier, max_iterations=2, tol=-1.0)       # warm
+    stats = {}
+    t0 = time.perf_counter()
+    ranks, _err, iters = pagerank_streamed(
+        tier, max_iterations=TIER_ITERATIONS, tol=-1.0, stats=stats)
+    elapsed = time.perf_counter() - t0
+    _ = float(np.asarray(ranks)[0])
+    ratios = {}
+    for prec in ("bf16", "int8"):
+        tp = mgtier.plan_tier(src, dst, w, n_nodes, precision=prec,
+                              n_blocks=n_blocks)
+        ratios[prec] = (sum(b.raw_nbytes for b in tp.blocks)
+                        / sum(b.nbytes for b in tp.blocks))
+    np.savez(out_path, platform=jax.devices()[0].platform,
+             elapsed=elapsed, iters=iters, n_blocks=tier.n_blocks,
+             serial_transfer_s=stats.get("serial_transfer_s") or 0.0,
+             serial_compute_s=stats.get("serial_compute_s") or 0.0,
+             hidden=stats.get("transfer_hidden_fraction") or 0.0,
+             overlap_iter_s=stats.get("overlap_iter_s_mean") or 0.0,
+             wire_bytes=stats.get("wire_bytes_per_sweep", 0),
+             raw_bytes=stats.get("raw_bytes_per_sweep", 0),
+             ratio_bf16=ratios["bf16"], ratio_int8=ratios["int8"])
+
+
 #: churn fraction for the delta stage — 0.5% of the edge set in ONE
 #: committed remove+add transaction (half the envelope's ≤1% ceiling;
 #: representative of a heavy OLTP burst between two CALLs)
@@ -1074,6 +1126,62 @@ def main():
         log(f"delta stage SKIPPED ({remaining:.0f}s left < 360s it "
             "needs); record carries no extra.delta")
 
+    # mgtier (r21): out-of-core streamed edge blocks — the
+    # double-buffered H2D-vs-SpMV overlap fraction plus the wire
+    # compression ratios; feeds the BASELINE.json tier_overlap
+    # envelope (perf_gate.check_tier)
+    tier_nodes = int(os.environ.get("BENCH_TIER_N_NODES", N_NODES // 10))
+    tier_edges = int(os.environ.get("BENCH_TIER_N_EDGES", N_EDGES // 10))
+    remaining = MASTER_TIMEOUT_SEC - (time.perf_counter() - t_bench) - 10
+    if remaining > 75:
+        with tempfile.NamedTemporaryFile(suffix=".npz") as tf:
+            tier_platform_env = "cpu" if result["platform"] == "cpu" \
+                else "axon"
+            rc, _ = _run_stage(
+                ["--stage", "tier", str(tier_nodes), str(tier_edges),
+                 "7", tf.name], _stage_env(tier_platform_env),
+                min(180, int(remaining)))
+            if rc == 0:
+                d = np.load(tf.name)
+                tier_platform = str(d["platform"])
+                hidden = float(d["hidden"])
+                PARTIAL["extra"]["tier"] = {
+                    "backend": tier_platform,
+                    # own honesty tag, same contract as the semiring /
+                    # delta sweeps: a CPU host has no real H2D lane —
+                    # its "overlap" is host-memcpy arithmetic and can
+                    # never satisfy the on-device envelope
+                    "degraded": tier_platform == "cpu",
+                    "n_nodes": tier_nodes,
+                    "n_edges": tier_edges,
+                    "n_blocks": int(d["n_blocks"]),
+                    "iterations": int(d["iters"]),
+                    "streamed_s": round(float(d["elapsed"]), 4),
+                    "eps": round(tier_edges * int(d["iters"])
+                                 / max(float(d["elapsed"]), 1e-9), 1),
+                    "serial_transfer_s": round(
+                        float(d["serial_transfer_s"]), 4),
+                    "serial_compute_s": round(
+                        float(d["serial_compute_s"]), 4),
+                    "overlap_iter_s_mean": round(
+                        float(d["overlap_iter_s"]), 4),
+                    "transfer_hidden_fraction": round(hidden, 4),
+                    "wire_bytes_per_sweep": int(d["wire_bytes"]),
+                    "raw_bytes_per_sweep": int(d["raw_bytes"]),
+                    "wire_ratio_bf16": round(float(d["ratio_bf16"]), 3),
+                    "wire_ratio_int8": round(float(d["ratio_int8"]), 3),
+                }
+                log(f"tier stage: {int(d['n_blocks'])} blocks, "
+                    f"{hidden:.0%} of transfer hidden, wire bf16 "
+                    f"{float(d['ratio_bf16']):.2f}x / int8 "
+                    f"{float(d['ratio_int8']):.2f}x on {tier_platform}")
+            else:
+                log(f"tier stage failed (rc={rc}); record carries no "
+                    "extra.tier")
+    else:
+        log(f"tier stage SKIPPED ({remaining:.0f}s left < 75s it "
+            "needs); record carries no extra.tier")
+
     # CALL-to-first-record latency (best-effort; never blocks the result)
     remaining = MASTER_TIMEOUT_SEC - (time.perf_counter() - t_bench) - 10
     if remaining > 45:
@@ -1116,6 +1224,9 @@ if __name__ == "__main__":
         elif stage == "delta":
             stage_delta(int(sys.argv[3]), int(sys.argv[4]),
                         int(sys.argv[5]), sys.argv[6])
+        elif stage == "tier":
+            stage_tier(int(sys.argv[3]), int(sys.argv[4]),
+                       int(sys.argv[5]), sys.argv[6])
         elif stage == "latency":
             stage_latency(sys.argv[3])
         else:

@@ -111,6 +111,16 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     "sharding": {name: value for name, _k, value
                                  in global_metrics.snapshot()
                                  if name.startswith("shard.")},
+
+                    # out-of-core streamed tier (r21, mgtier):
+                    # admission verdicts, blocks/bytes streamed,
+                    # compression + overlap histograms (local plus the
+                    # daemon's counters mirrored through health)
+                    "tier": {name: value for name, _k, value
+                             in global_metrics.snapshot()
+                             if name.startswith(
+                                 ("tier.",
+                                  "kernel_server.daemon.tier."))},
                     # compiled Cypher read lane (r20, mglane):
                     # compile/hit/typed-fallback counters plus the
                     # per-fingerprint lane residency table

@@ -132,6 +132,15 @@ STAT_NAMES = (
     "delta.cold_start_total",       # LOUD monotone-unsafe cold starts
     "delta.warm_start_iterations",  # histogram: iterations after warm
     "delta.resident_generations",   # resident graph generations gauge
+    # out-of-core streamed tier (r21, mgtier)
+    "tier.admission_*",             # resident/streamed/shed verdicts
+    "tier.blocks_streamed_total",   # edge blocks shipped host→device
+    "tier.bytes_streamed_total",    # int32+f32-equivalent volume swept
+    "tier.compressed_bytes_total",  # wire bytes actually shipped
+    "tier.blocks_repacked_total",   # delta-spliced rows re-encoded
+    "tier.blocks_reused_total",     # rows the splice left untouched
+    "tier.block_transfer_latency_sec",   # histogram: per-block H2D
+    "tier.transfer_hidden_fraction",     # histogram: overlap efficiency
     # analytics / checkpoint plane
     "analytics.checkpoint.saved_total",
     "analytics.checkpoint.restored_total",

@@ -331,6 +331,15 @@ def collecting_stages(acc: StageAccumulator | None = None) -> _StageScope:
     return _StageScope(acc if acc is not None else StageAccumulator())
 
 
+def stages_active() -> bool:
+    """True when a stage accumulator is collecting on this thread (a
+    PROFILE-d / accounted extent). Result caches use this to demote a
+    verbatim hit to a warm seed: a profiled CALL exists to measure the
+    device path, so serving stored bytes — attributing nothing — would
+    defeat the run's purpose."""
+    return getattr(_stage_tls, "acc", None) is not None
+
+
 def record_stage(stage: str, seconds: float, count: int = 1) -> None:
     """Attribute device seconds to the ACTIVE accumulator, if any.
 

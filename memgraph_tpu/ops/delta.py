@@ -627,7 +627,7 @@ class ResidentGraph:
     """
 
     __slots__ = ("graph_key", "version", "host_variants", "solutions",
-                 "delta_edges", "base_edges", "_graph", "_coo",
+                 "delta_edges", "base_edges", "tiers", "_graph", "_coo",
                  "_n_nodes", "_node_gids", "_gid_to_idx", "_placed")
 
     def __init__(self, graph_key, version: int,
@@ -645,6 +645,8 @@ class ResidentGraph:
         self._placed = not isinstance(graph.row_ptr, np.ndarray)
         #: (by, doubled) -> host-side ShardedCSR (the splice substrate)
         self.host_variants: dict = {}
+        #: (precision, block_bytes) -> TierCSR (out-of-core paging plan)
+        self.tiers: dict = {}
         #: algo -> _Solution (the warm-start seeds)
         self.solutions: dict = {}
         self.delta_edges = 0
@@ -719,6 +721,27 @@ class ResidentGraph:
                              _time.perf_counter() - t0)
         return dev
 
+    def ensure_tier(self, precision: str = "f32",
+                    block_bytes: int | None = None):
+        """Host-pinned streamed paging plan (``ops/tier.py``) for this
+        generation — the out-of-core analogue of :meth:`ensure_sharded`
+        for graphs whose edges exceed the HBM budget. Nothing places:
+        the plan's compressed wire blocks stay pinned host-side and the
+        execution plane streams them per sweep. Committed deltas splice
+        into the plan through :meth:`apply` (only touched rows
+        re-encode), so a churned beyond-HBM graph never re-ships cold."""
+        from . import tier as mgtier
+        key = (precision, block_bytes)
+        t = self.tiers.get(key)
+        if t is None:
+            src, dst, w = self._coo
+            t = mgtier.plan_tier(
+                src.astype(np.int64), dst.astype(np.int64),
+                np.asarray(w, dtype=np.float32), self._n_nodes,
+                precision=precision, block_bytes=block_bytes)
+            self.tiers[key] = t
+        return t
+
     def _install(self, ctx, by, doubled, host_scsr) -> ShardedCSR:
         # device placements ride the materialized-or-not snapshot? No:
         # they live on the HOST variant object itself (one placement per
@@ -789,6 +812,19 @@ class ResidentGraph:
                 nv = self._reshard(by, doubled, hv.n_shards)
             new_variants[(by, doubled)] = nv
         self.host_variants = new_variants
+        # streamed paging plans move by the same splice; a row overflow
+        # drops the plan (ensure_tier rebuilds it from the exact COO)
+        new_tiers = {}
+        for key, t in self.tiers.items():
+            nt = t.apply_delta(delta)
+            if nt is None:
+                global_metrics.increment("delta.compacted_total")
+                log.info("delta: tier %s of %s overflowed its row "
+                         "capacity — dropping for lazy rebuild", key,
+                         self.graph_key)
+            else:
+                new_tiers[key] = nt
+        self.tiers = new_tiers
         if ctx is not None:
             for (by, doubled), hv in new_variants.items():
                 self._install(ctx, by, doubled, hv)
@@ -828,6 +864,7 @@ class ResidentGraph:
         if ctx is not None:
             for (by, doubled), hv in self.host_variants.items():
                 self._install(ctx, by, doubled, hv)
+        self.tiers = {}                        # lazily rebuilt, exact
         self.delta_edges = 0
         self.base_edges = self.n_edges
         global_metrics.increment("delta.compacted_total")

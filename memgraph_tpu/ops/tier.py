@@ -1,0 +1,314 @@
+"""mgtier data plane: out-of-core streamed edge-block execution.
+
+Every device path in the repo assumes the whole edge set fits in HBM;
+this module is the half that makes beyond-HBM graphs executable at all.
+The edge set is blocked partition-centrically (PR 6's
+:class:`~.csr.ShardedCSR` — the SAME ``(P, per)`` + ``block_ptr`` layout
+the mesh kernels shard across devices) but the rows stay PINNED
+HOST-SIDE: a fixpoint iteration becomes a sweep that streams one
+compressed row at a time through a double-buffered device window
+(``parallel/distributed.py`` owns the execution loop), while the O(n)
+iterate vectors stay device-resident. The streaming-SpMV architecture of
+the reduced-precision FPGA PPR accelerator (PAPERS.md, arXiv:2009.10443)
+applied at the host→HBM boundary instead of BRAM.
+
+Block wire format (per ShardedCSR row ``p``):
+
+* indices — LOSSLESS compression whenever ``block`` ≤ 65536: ``src``
+  is local to shard ``p`` (``src_off`` uint16 + the shard base), and the
+  (dst, src) sort within the row makes ``dst`` a concatenation of
+  dst-shard runs bounded by ``block_ptr[p]``, so ``dst_off`` uint16 +
+  the run's shard base reconstructs it exactly. 8 bytes/edge of int32
+  indices become 4.
+* weights — per request precision: ``f32`` ships them verbatim (the
+  sweep stays bit-exact), ``bf16`` rounds them, ``int8`` symmetric
+  per-block quantization (``w ≈ q · scale``, the
+  :data:`~.semiring.PRECISION_BOUNDS` error budget); accumulation is
+  always f32 on device.
+
+Bytes per edge: 12 (f32, u16 off) → 8; bf16 → 6; int8 → 5 — a
+1.5×/2×/2.4× transfer-volume cut vs the raw int32+f32 triple.
+
+The admission story (``server/kernel_server.py``): a request whose
+RESIDENT footprint exceeds the HBM budget no longer sheds outright —
+:func:`admission_verdict` grows the third option, **streamed**, chosen
+automatically when the streamed working set (iterate vectors + two
+block buffers) still fits. ``ops/delta.py`` splices committed deltas
+into the host rows and :meth:`TierCSR.apply_delta` re-encodes ONLY the
+touched rows, so a churned beyond-HBM graph never re-ships cold.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from .csr import ShardedCSR, shard_edges
+from ..observability.metrics import global_metrics
+
+#: device-side byte budget for ONE streamed block buffer (two are live
+#: at once under double buffering). Env-tunable so tests can force many
+#: tiny blocks through the streaming path on small graphs.
+DEFAULT_BLOCK_BYTES = 32 << 20
+
+#: O(n) f32 iteration-state vectors the streamed fixpoints keep
+#: device-resident (iterate, accumulator, inv_wsum, masks + headroom) —
+#: kept in sync with the kernel server's resident-side estimate.
+VECTOR_SLOTS = 8
+
+#: largest vertex block the uint16 offset codec can address
+U16_MAX_BLOCK = 1 << 16
+
+#: wire bytes per edge WEIGHT at each precision
+_W_BYTES = {"f32": 4, "bf16": 2, "int8": 1}
+
+
+def block_bytes_budget() -> int:
+    """Per-buffer block budget: MEMGRAPH_TPU_TIER_BLOCK_BYTES override,
+    else :data:`DEFAULT_BLOCK_BYTES`."""
+    env = os.environ.get("MEMGRAPH_TPU_TIER_BLOCK_BYTES")
+    if env:
+        try:
+            return max(1 << 10, int(env))
+        except ValueError:
+            pass
+    return DEFAULT_BLOCK_BYTES
+
+
+def edge_wire_bytes(precision: str, u16: bool = True) -> int:
+    """Wire bytes one edge costs in a streamed block."""
+    idx = 4 if u16 else 8
+    return idx + _W_BYTES[precision]
+
+
+# --------------------------------------------------------------------------
+# block codec
+# --------------------------------------------------------------------------
+
+
+def _bf16(w: np.ndarray) -> np.ndarray:
+    import ml_dtypes  # jax dependency; host-side bfloat16 storage
+    return w.astype(ml_dtypes.bfloat16)
+
+
+@dataclass(frozen=True)
+class HostBlock:
+    """One compressed, host-pinned edge block (one ShardedCSR row).
+
+    ``payload`` ships to the device verbatim (one ``jax.device_put`` of
+    the dict); the decode runs INSIDE the jitted sweep kernel, so the
+    wire bytes are what actually crosses the host→HBM boundary.
+    """
+
+    payload: dict          # name -> np.ndarray
+    nbytes: int            # compressed wire bytes
+    raw_nbytes: int        # int32 + f32 equivalent bytes
+
+
+def _dst_runs(bounds: np.ndarray, per: int) -> np.ndarray:
+    """Per-edge dst-shard index from the row's block_ptr boundaries —
+    the HOST half of the codec; the device decode applies the identical
+    searchsorted, so offsets round-trip exactly."""
+    return np.searchsorted(bounds[1:], np.arange(per), side="right")
+
+
+def pack_block(scsr: ShardedCSR, p: int, precision: str) -> HostBlock:
+    """Encode ShardedCSR row ``p`` into its streamed wire format."""
+    src = np.asarray(scsr.src[p])
+    dst = np.asarray(scsr.dst[p])
+    w = np.asarray(scsr.weights[p])
+    raw = src.nbytes + dst.nbytes + w.nbytes
+    u16 = scsr.block <= U16_MAX_BLOCK
+    # real edges sort before the padding tail (padding dst = the sink
+    # row n_nodes ≥ every real dst); rc masks weightless reductions
+    rc = int(np.searchsorted(dst, scsr.n_nodes, side="left"))
+    payload: dict = {"rc": np.int32(rc)}
+    if u16:
+        bounds = scsr.block_ptr[p].astype(np.int32)
+        q = _dst_runs(bounds, scsr.per)
+        payload["src_off"] = (src - np.int32(p * scsr.block)
+                              ).astype(np.uint16)
+        payload["dst_off"] = (dst - (q * scsr.block)).astype(np.uint16)
+        payload["bounds"] = bounds
+        payload["base"] = np.int32(p * scsr.block)
+    else:
+        payload["src"] = src
+        payload["dst"] = dst
+    if precision == "f32":
+        payload["w"] = w
+    elif precision == "bf16":
+        payload["w"] = _bf16(w)
+    elif precision == "int8":
+        amax = float(np.max(np.abs(w))) if w.size else 0.0
+        scale = np.float32(max(amax / 127.0, 1e-30))
+        payload["w"] = np.clip(np.round(w / scale), -127, 127
+                               ).astype(np.int8)
+        payload["scale"] = scale
+    else:
+        raise ValueError(f"tier precision must be f32/bf16/int8, "
+                         f"got {precision!r}")
+    nbytes = sum(int(np.asarray(v).nbytes) for v in payload.values())
+    return HostBlock(payload=payload, nbytes=nbytes, raw_nbytes=raw)
+
+
+# --------------------------------------------------------------------------
+# the paging plan
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TierCSR:
+    """Host-pinned paging plan: a ShardedCSR whose rows never all go to
+    the device at once, plus their pre-encoded wire blocks."""
+
+    scsr: ShardedCSR       # HOST layout — the delta-splice substrate
+    blocks: tuple          # HostBlock per shard row
+    precision: str
+
+    @property
+    def n_blocks(self) -> int:
+        return self.scsr.n_shards
+
+    @property
+    def block(self) -> int:
+        return self.scsr.block
+
+    @property
+    def per(self) -> int:
+        return self.scsr.per
+
+    @property
+    def n_nodes(self) -> int:
+        return self.scsr.n_nodes
+
+    @property
+    def n_edges(self) -> int:
+        return self.scsr.n_edges
+
+    @property
+    def n_pad2(self) -> int:
+        return self.scsr.n_pad2
+
+    @property
+    def u16(self) -> bool:
+        return self.scsr.block <= U16_MAX_BLOCK
+
+    @property
+    def wire_bytes_per_sweep(self) -> int:
+        """Bytes one full-edge-set sweep actually ships."""
+        return sum(b.nbytes for b in self.blocks)
+
+    @property
+    def raw_bytes_per_sweep(self) -> int:
+        """int32+f32-equivalent bytes the sweep represents."""
+        return sum(b.raw_nbytes for b in self.blocks)
+
+    def apply_delta(self, delta) -> "TierCSR | None":
+        """Advance the plan by one EdgeDelta WITHOUT a cold re-encode.
+
+        The splice (:func:`~.delta.apply_edge_delta`) rewrites only the
+        shard rows the delta touches; this re-packs exactly those rows
+        and reuses every other wire block untouched — a churned
+        beyond-HBM graph keeps its encoded pages. Returns None when the
+        splice itself cannot preserve the layout (row overflow /
+        removal mismatch): the caller rebuilds via :func:`plan_tier`.
+        """
+        from .delta import apply_edge_delta
+        new_scsr = apply_edge_delta(self.scsr, delta)
+        if new_scsr is None:
+            return None
+        if new_scsr is self.scsr:      # empty delta
+            return self
+        block = self.scsr.block
+        key_add = delta.add_src if self.scsr.by == "src" else delta.add_dst
+        key_rem = delta.rem_src if self.scsr.by == "src" else delta.rem_dst
+        touched = np.union1d(np.unique(key_add // block),
+                             np.unique(key_rem // block)).astype(np.int64)
+        blocks = list(self.blocks)
+        for p in touched:
+            blocks[int(p)] = pack_block(new_scsr, int(p), self.precision)
+        global_metrics.increment("tier.blocks_repacked_total",
+                                 len(touched))
+        global_metrics.increment("tier.blocks_reused_total",
+                                 len(blocks) - len(touched))
+        return TierCSR(scsr=new_scsr, blocks=tuple(blocks),
+                       precision=self.precision)
+
+
+def tier_from_scsr(scsr: ShardedCSR, precision: str = "f32") -> TierCSR:
+    """Pack an existing HOST ShardedCSR into a paging plan (the
+    ``ops/delta.py`` path: the resident generation's host variant IS
+    the substrate — no re-sort, no re-blocking)."""
+    if not isinstance(scsr.src, np.ndarray):
+        raise ValueError("tier_from_scsr needs the HOST-side layout")
+    blocks = tuple(pack_block(scsr, p, precision)
+                   for p in range(scsr.n_shards))
+    return TierCSR(scsr=scsr, blocks=blocks, precision=precision)
+
+
+def plan_blocks(n_nodes: int, n_edges: int, precision: str = "f32",
+                block_bytes: int | None = None) -> int:
+    """Pick the block count P: enough that one row's wire payload fits
+    the per-buffer budget, enough that vertex blocks stay uint16-
+    addressable, and ≥ 2 so the double buffer actually alternates."""
+    bb = block_bytes or block_bytes_budget()
+    wire = max(n_edges, 1) * edge_wire_bytes(precision, u16=True)
+    p_budget = -(-wire // bb)
+    # margin for shard_edges' block_multiple rounding
+    p_u16 = -(-(n_nodes + 1) // (U16_MAX_BLOCK - 8))
+    return max(2, int(p_budget), int(p_u16))
+
+
+def plan_tier(src, dst, weights, n_nodes: int, *,
+              precision: str = "f32", n_blocks: int | None = None,
+              block_bytes: int | None = None) -> TierCSR:
+    """Block a COO edge set into a host-pinned streamed paging plan."""
+    if n_blocks is None:
+        n_blocks = plan_blocks(n_nodes, len(np.asarray(src)), precision,
+                               block_bytes)
+    scsr = shard_edges(src, dst, weights, n_nodes, int(n_blocks),
+                       by="src")
+    return tier_from_scsr(scsr, precision)
+
+
+# --------------------------------------------------------------------------
+# admission estimates (the kernel server's third verdict)
+# --------------------------------------------------------------------------
+
+#: requests whose graph-shaped op can degrade to the streamed path
+ADMISSION_VERDICTS = ("resident", "streamed", "shed")
+
+
+def streamed_request_bytes(n_nodes: int, n_edges: int,
+                           precision: str = "f32",
+                           block_bytes: int | None = None) -> int:
+    """Working-set estimate for a STREAMED run: the O(n) device-resident
+    iteration vectors plus two in-flight block buffers — the whole point
+    being that the O(E) term is bounded by the buffer budget, not the
+    edge count."""
+    bb = block_bytes or block_bytes_budget()
+    wire = max(n_edges, 1) * edge_wire_bytes(precision, u16=True)
+    per_buffer = min(wire, bb)
+    vectors = (n_nodes + 1) * 4 * VECTOR_SLOTS
+    return vectors + 2 * per_buffer
+
+
+def admission_verdict(est_resident: int, budget: int, *, n_nodes: int,
+                      n_edges: int, streamable: bool = True,
+                      precision: str = "f32") -> tuple[str, int]:
+    """resident / streamed / shed, from the estimated footprints.
+
+    Returns ``(verdict, est_bytes)`` where ``est_bytes`` is the
+    footprint of the CHOSEN execution mode (callers log/expose it).
+    Oversized-but-streamable requests degrade gracefully; shed remains
+    the honest answer when even the streamed working set (or the op)
+    cannot fit the budget.
+    """
+    if est_resident <= budget:
+        return "resident", int(est_resident)
+    est_streamed = streamed_request_bytes(n_nodes, n_edges, precision)
+    if streamable and est_streamed <= budget:
+        return "streamed", int(est_streamed)
+    return "shed", int(est_streamed)

@@ -101,6 +101,16 @@ def device_count() -> int:
     return len(jax.devices())
 
 
+def streaming_device():
+    """The device the out-of-core streamed tier targets: the first
+    visible accelerator. The streamed path is deliberately
+    single-device — its bottleneck is the host→HBM link, so spreading
+    blocks over a mesh would multiply transfer, not hide it; multi-chip
+    streaming belongs to a future vertex-sharded tier."""
+    import jax
+    return jax.devices()[0]
+
+
 @dataclass(frozen=True)
 class MeshContext:
     """A mesh plus its canonical shardings, built once and cached.

@@ -135,6 +135,13 @@ def _estimate_request_bytes(header: dict, arrays: dict) -> int:
     return 3 * edge_bytes + n_nodes * 4 * 8
 
 
+def _tier_precision(precision) -> str:
+    """Block-compression precision for a streamed run: the request's
+    precision when the tier codec supports it, f32 otherwise."""
+    p = str(precision)
+    return p if p in ("f32", "bf16", "int8") else "f32"
+
+
 def probe_device():
     """Tiny end-to-end device check: a compiled matmul with a host
     transfer forcing completion. Shared by the server warm-up, the
@@ -1055,8 +1062,50 @@ class KernelServer:
                                      float(len(self._active)))
 
     def _supervised(self, op: str, header: dict, arrays: dict):
-        """Admission guard → worker-thread dispatch → typed outcome."""
+        """Admission guard → worker-thread dispatch → typed outcome.
+
+        The admission guard has THREE verdicts (r21 mgtier): requests
+        whose resident footprint fits the HBM budget run resident;
+        graph-shaped requests that exceed it degrade to the STREAMED
+        out-of-core path when the streamed working set (O(n) vectors +
+        two block buffers) still fits; shed remains the honest answer
+        only past that."""
         est = _estimate_request_bytes(header, arrays)
+        if op in ("pagerank", "semiring"):
+            from ..ops import tier as mgtier
+            algorithm = str(header.get("algorithm", "pagerank"))
+            n_nodes = int(header.get("n_nodes") or 0)
+            n_edges = (int(arrays["src"].shape[0])
+                       if "src" in arrays else 0)
+            if "src" not in arrays:
+                # graph_key-only request: the wire carries no edges, so
+                # the request estimate misses the real footprint — size
+                # admission off the cached generation's CURRENT edge
+                # count or a cached oversized graph would silently ride
+                # the resident path past the budget
+                # unlocked read-only peek: admission must not queue
+                # behind a long dispatch holding _dispatch_lock, and a
+                # momentarily stale generation only skews the byte
+                # ESTIMATE (the verdict is re-derived next request)
+                gen = self._graphs.get(header.get("graph_key"))  # mglint: disable=MG006 — benign unlocked estimate read; blocking admission on _dispatch_lock would defeat the guard
+                if gen is not None:
+                    n_nodes = n_nodes or gen._n_nodes
+                    n_edges = int(np.asarray(gen._coo[0]).shape[0])
+                    est = max(est, 3 * 16 * n_edges + n_nodes * 4 * 8)
+            verdict, est_run = mgtier.admission_verdict(
+                est, self.hbm_budget_bytes,
+                n_nodes=n_nodes, n_edges=n_edges,
+                streamable=algorithm in ("pagerank", "katz", "wcc"),
+                precision=str(header.get("precision", "f32")))
+            global_metrics.increment(f"tier.admission_{verdict}_total")
+            if verdict == "streamed":
+                header["_tier_streamed"] = True
+                log.info(
+                    "kernel_server: STREAMED %s request — resident "
+                    "estimate %d bytes exceeds HBM budget %d, streamed "
+                    "working set %d bytes fits", op, est,
+                    self.hbm_budget_bytes, est_run)
+                est = est_run
         if est > self.hbm_budget_bytes:
             self._count("shed")
             global_metrics.increment(
@@ -1186,7 +1235,8 @@ class KernelServer:
         counters = {name: value for name, _kind, value
                     in global_metrics.snapshot()
                     if name.startswith(("kernel_server.", "analytics.",
-                                        "ppr.", "delta.", "lane."))}
+                                        "ppr.", "delta.", "lane.",
+                                        "tier."))}
         return {"ok": True, "pid": os.getpid(),
                 "uptime_s": round(now - self._started, 3),
                 "in_flight": len(entries),
@@ -1202,9 +1252,14 @@ class KernelServer:
     MAX_CACHED_GRAPHS = 8     # LRU cap: the daemon is long-lived and a
     #                           resident generation pins device HBM + host
 
-    def _resolve_generation(self, header, arrays):
+    def _resolve_generation(self, header, arrays, place: bool = True):
         """graph_key -> resident-generation lookup shared by every
         graph-shaped op. Runs under _dispatch_lock (see _op_pagerank).
+        ``place=False`` (the streamed admission verdict) keeps a fresh
+        generation HOST-side: the whole point of the out-of-core path
+        is that the edge set never lands on the device at once, so the
+        import must not place it either — the generation's lazy
+        snapshot and host COO are all the tier needs.
 
         The generation layer (ops/delta.py, r19 mgdelta): the LRU holds
         :class:`~..ops.delta.ResidentGraph` records keyed
@@ -1252,7 +1307,9 @@ class KernelServer:
             g = from_coo(arrays["src"].astype(np.int64),
                          arrays["dst"].astype(np.int64),
                          arrays.get("weights"),
-                         n_nodes=header.get("n_nodes")).to_device()
+                         n_nodes=header.get("n_nodes"))
+            if place:
+                g = g.to_device()
             gen = mgdelta.ResidentGraph(key, int(want or 0), g)
             if key:
                 # mglint: disable=MG006,MG007 — same _dispatch_lock contract as above: the LRU insert+evict runs under the dispatcher's lock
@@ -1288,7 +1345,9 @@ class KernelServer:
         from ..ops import delta as mgdelta
         from ..ops import semiring as S
         from ..parallel.mesh import analytics_mesh, get_mesh_context
-        gen = self._resolve_generation(header, arrays)
+        streamed = bool(header.pop("_tier_streamed", False))
+        gen = self._resolve_generation(header, arrays,
+                                       place=not streamed)
         if gen is None:
             return ({"ok": False, "error": "unknown graph_key "
                      "and no edge arrays supplied"}, None)
@@ -1309,19 +1368,31 @@ class KernelServer:
                      "graph_version": gen.version},
                     {"ranks": np.asarray(hit.x, dtype=np.float32)})
         x0, _reason = gen.warm_x0("pagerank", params_key)
-        ctx = analytics_mesh() or get_mesh_context(1)
-        # run straight off the resident partition-centric variant (the
-        # spliced layout) — the DeviceGraph snapshot stays lazy, so a
-        # commit costs O(delta), never a CSR rebuild, on this path
-        scsr = gen.ensure_sharded(ctx, by="src")
-        from ..parallel.distributed import pagerank_partition_centric
-        with S.backend_extent("mesh"):
-            ranks, err, iters = pagerank_partition_centric(
-                scsr, ctx, damping=damping,
-                max_iterations=max_iterations,
-                tol=tol, precision=precision, x0=x0,
+        if streamed:
+            # out-of-core: the edge set never places — blocks stream
+            # from the generation's host-pinned paging plan, the rank
+            # vector stays device-resident, chunks checkpoint as usual
+            from ..parallel.distributed import pagerank_streamed
+            t = gen.ensure_tier(precision=_tier_precision(precision))
+            ranks, err, iters = pagerank_streamed(
+                t, damping=damping, max_iterations=max_iterations,
+                tol=tol, x0=x0,
                 checkpoint_every=self.checkpoint_every,
                 job=f"kernel_server:pagerank:{key}" if key else None)
+        else:
+            ctx = analytics_mesh() or get_mesh_context(1)
+            # run straight off the resident partition-centric variant
+            # (the spliced layout) — the DeviceGraph snapshot stays
+            # lazy, so a commit costs O(delta), never a CSR rebuild
+            scsr = gen.ensure_sharded(ctx, by="src")
+            from ..parallel.distributed import pagerank_partition_centric
+            with S.backend_extent("mesh"):
+                ranks, err, iters = pagerank_partition_centric(
+                    scsr, ctx, damping=damping,
+                    max_iterations=max_iterations,
+                    tol=tol, precision=precision, x0=x0,
+                    checkpoint_every=self.checkpoint_every,
+                    job=f"kernel_server:pagerank:{key}" if key else None)
         ranks = np.asarray(ranks, dtype=np.float32)
         gen.note_solution("pagerank", params_key, ranks,
                           err=float(err), iters=int(iters),
@@ -1330,6 +1401,7 @@ class KernelServer:
             mgdelta.record_warm_start("pagerank", int(iters))
         return ({"ok": True, "err": float(err), "iters": int(iters),
                  "warm_started": x0 is not None,
+                 "tier": "streamed" if streamed else "resident",
                  "graph_version": gen.version},
                 {"ranks": ranks})
 
@@ -1346,11 +1418,15 @@ class KernelServer:
         from ..ops import semiring as S
         from ..parallel import analytics
         from ..parallel.mesh import analytics_mesh, get_mesh_context
-        gen = self._resolve_generation(header, arrays)
+        streamed = bool(header.pop("_tier_streamed", False))
+        gen = self._resolve_generation(header, arrays,
+                                       place=not streamed)
         if gen is None:
             return ({"ok": False, "error": "unknown graph_key "
                      "and no edge arrays supplied"}, None)
-        g = gen.graph
+        # streamed: never materialize the snapshot — the paging plan
+        # (gen.ensure_tier) is built straight off the host COO
+        g = None if streamed else gen.graph
         algorithm = header.get("algorithm", "pagerank")
         precision = header.get("precision", "f32")
         max_iterations = header.get("max_iterations", 100)
@@ -1371,11 +1447,20 @@ class KernelServer:
                         {"ranks": np.asarray(hit.x,
                                              dtype=np.float32)})
             x0, _reason = gen.warm_x0("pagerank", params_key)
-            # ops-level entry: route_backend picks mesh/mxu/segment and
-            # records the per-backend stage the PROFILE plane shows
-            ranks, err, iters = pagerank(
-                g, damping=damping, max_iterations=max_iterations,
-                tol=tol, precision=precision, x0=x0)
+            if streamed:
+                from ..parallel.distributed import pagerank_streamed
+                t = gen.ensure_tier(
+                    precision=_tier_precision(precision))
+                ranks, err, iters = pagerank_streamed(
+                    t, damping=damping,
+                    max_iterations=max_iterations, tol=tol, x0=x0,
+                    checkpoint_every=self.checkpoint_every)
+            else:
+                # ops-level entry: route_backend picks mesh/mxu/segment
+                # and records the per-backend stage PROFILE shows
+                ranks, err, iters = pagerank(
+                    g, damping=damping, max_iterations=max_iterations,
+                    tol=tol, precision=precision, x0=x0)
             ranks = np.asarray(ranks, dtype=np.float32)
             gen.note_solution("pagerank", params_key, ranks,
                               err=float(err), iters=int(iters),
@@ -1385,6 +1470,7 @@ class KernelServer:
             return ({"ok": True, "err": float(err), "iters": int(iters),
                      "algorithm": algorithm, "precision": precision,
                      "warm_started": x0 is not None,
+                     "tier": "streamed" if streamed else "resident",
                      "graph_version": gen.version},
                     {"ranks": ranks})
         if algorithm == "katz":
@@ -1404,10 +1490,19 @@ class KernelServer:
                         {"ranks": np.asarray(hit.x,
                                              dtype=np.float32)})
             x0, _reason = gen.warm_x0("katz", params_key)
-            xs, err, iters = katz_centrality(
-                g, alpha=alpha, beta=header.get("beta", 1.0),
-                max_iterations=max_iterations, tol=tol,
-                precision=precision, x0=x0)
+            if streamed:
+                from ..parallel.distributed import katz_streamed
+                t = gen.ensure_tier(
+                    precision=_tier_precision(precision))
+                xs, err, iters = katz_streamed(
+                    t, alpha=alpha, beta=header.get("beta", 1.0),
+                    max_iterations=max_iterations, tol=tol, x0=x0,
+                    checkpoint_every=self.checkpoint_every)
+            else:
+                xs, err, iters = katz_centrality(
+                    g, alpha=alpha, beta=header.get("beta", 1.0),
+                    max_iterations=max_iterations, tol=tol,
+                    precision=precision, x0=x0)
             xs = np.asarray(xs, dtype=np.float32)
             gen.note_solution("katz", params_key, xs, err=float(err),
                               iters=int(iters),
@@ -1417,6 +1512,7 @@ class KernelServer:
             return ({"ok": True, "err": float(err), "iters": int(iters),
                      "algorithm": algorithm, "precision": precision,
                      "warm_started": x0 is not None,
+                     "tier": "streamed" if streamed else "resident",
                      "graph_version": gen.version},
                     {"ranks": xs})
         if algorithm == "wcc":
@@ -1431,8 +1527,15 @@ class KernelServer:
                         {"components": np.asarray(hit.x,
                                                   dtype=np.int32)})
             comp0, _reason = gen.warm_x0("wcc", params_key)
-            comp, iters = weakly_connected_components(
-                g, max_iterations=max_iterations, comp0=comp0)
+            if streamed:
+                from ..parallel.distributed import wcc_streamed
+                t = gen.ensure_tier(precision="f32")
+                comp, _changed, iters = wcc_streamed(
+                    t, max_iterations=max_iterations, comp0=comp0,
+                    checkpoint_every=self.checkpoint_every)
+            else:
+                comp, iters = weakly_connected_components(
+                    g, max_iterations=max_iterations, comp0=comp0)
             comp = np.asarray(comp, dtype=np.int32)
             gen.note_solution("wcc", params_key, comp,
                               iters=int(iters),
@@ -1442,6 +1545,7 @@ class KernelServer:
             return ({"ok": True, "iters": int(iters),
                      "algorithm": algorithm,
                      "warm_started": comp0 is not None,
+                     "tier": "streamed" if streamed else "resident",
                      "graph_version": gen.version},
                     {"components": comp})
         if algorithm == "labelprop":

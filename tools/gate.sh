@@ -110,6 +110,14 @@ stage "lane-smoke (compiled Cypher read lane)" \
 stage "shard-smoke (sharded OLTP execution plane)" \
     python -m tools.shard_smoke
 
+# 4e. out-of-core tier smoke: an oversized graph under a tiny HBM
+#     budget must flip onto the STREAMED path automatically (admission
+#     third verdict), return a result bit-identical to the resident
+#     comparator, shed non-streamable algorithms with the typed
+#     verdict, and actually compress the wire (bf16/int8 >= 1.8x).
+stage "tier-smoke (out-of-core streamed edge blocks)" \
+    python -m tools.tier_smoke
+
 # 5. perf-regression gate: the newest BENCH_r*.json record must be
 #    non-degraded and within BASELINE.json's envelope (>15% regression
 #    fails). Hosts without an accelerator skip LOUDLY (exit 0): the

@@ -208,6 +208,96 @@ def _b_mesh_semiring(kernel):
         _sds((), "int32"), _sds((), "int32")))
 
 
+# ---- out-of-core streamed tier (r21, mgtier) -------------------------------
+
+
+def _tier_block(precision: str = "f32"):
+    """Abstract wire block: the u16-compressed payload pack_block ships
+    (ops/tier.py). P = N_SHARDS blocks of BLOCK rows, PER edges each."""
+    wdt = {"f32": "float32", "bf16": "bfloat16", "int8": "int8"}
+    out = {"rc": _sds((), "int32"),
+           "src_off": _sds((PER,), "uint16"),
+           "dst_off": _sds((PER,), "uint16"),
+           "bounds": _sds((N_SHARDS + 1,), "int32"),
+           "base": _sds((), "int32"),
+           "w": _sds((PER,), wdt[precision])}
+    if precision == "int8":
+        out["scale"] = _sds((), "float32")
+    return out
+
+
+def _tier_v(dtype: str = "float32"):
+    return _sds((N_PAD,), dtype)
+
+
+@builder("tier:wsum")
+def _b_tier_wsum(kernel):
+    from memgraph_tpu.parallel.distributed import _tier_wsum_build
+    fn = _tier_wsum_build(BLOCK, PER, N_PAD, "f32", True)
+    return _compiled(fn.lower(_tier_v(), _tier_block()))
+
+
+def _tier_pr_sweep(precision: str) -> str:
+    from memgraph_tpu.parallel.distributed import (
+        _tier_pagerank_sweep_build)
+    fn = _tier_pagerank_sweep_build(BLOCK, PER, N_PAD, precision, True)
+    return _compiled(fn.lower(
+        _tier_v(), _tier_v(), _tier_v(), _tier_block(precision)))
+
+
+@builder("tier:pagerank_sweep")
+def _b_tier_pr_sweep(kernel):
+    return _tier_pr_sweep("f32")
+
+
+@builder("tier:pagerank_sweep_int8")
+def _b_tier_pr_sweep_int8(kernel):
+    return _tier_pr_sweep("int8")
+
+
+@builder("tier:pagerank_epilogue")
+def _b_tier_pr_epi(kernel):
+    from memgraph_tpu.parallel.distributed import (
+        _tier_pagerank_epilogue_build)
+    fn = _tier_pagerank_epilogue_build(N_PAD)
+    return _compiled(fn.lower(
+        _tier_v(), _tier_v(), _tier_v(), _tier_v(),
+        _sds((), "float32"), _sds((), "float32")))
+
+
+@builder("tier:katz_sweep")
+def _b_tier_katz_sweep(kernel):
+    from memgraph_tpu.parallel.distributed import _tier_katz_sweep_build
+    fn = _tier_katz_sweep_build(BLOCK, PER, N_PAD, "f32", True)
+    return _compiled(fn.lower(_tier_v(), _tier_v(), _tier_block()))
+
+
+@builder("tier:katz_epilogue")
+def _b_tier_katz_epi(kernel):
+    from memgraph_tpu.parallel.distributed import (
+        _tier_katz_epilogue_build)
+    fn = _tier_katz_epilogue_build(N_PAD)
+    return _compiled(fn.lower(
+        _tier_v(), _tier_v(), _tier_v(),
+        _sds((), "float32"), _sds((), "float32")))
+
+
+@builder("tier:wcc_sweep")
+def _b_tier_wcc_sweep(kernel):
+    from memgraph_tpu.parallel.distributed import _tier_wcc_sweep_build
+    fn = _tier_wcc_sweep_build(BLOCK, PER, N_PAD, True)
+    return _compiled(fn.lower(
+        _tier_v("int32"), _tier_v("int32"), _tier_block()))
+
+
+@builder("tier:wcc_epilogue")
+def _b_tier_wcc_epi(kernel):
+    from memgraph_tpu.parallel.distributed import (
+        _tier_wcc_epilogue_build)
+    fn = _tier_wcc_epilogue_build(N_PAD)
+    return _compiled(fn.lower(_tier_v("int32"), _tier_v("int32")))
+
+
 # ---- segment backend -------------------------------------------------------
 
 

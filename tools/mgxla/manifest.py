@@ -218,6 +218,60 @@ MANIFEST: dict[str, KernelContract] = {
              "stable argsort; nulls ranked per openCypher, excluded "
              "rows sorted past every included row"),
 
+    # ---- out-of-core streamed tier (r21, mgtier) ----------------------
+    # Per-BLOCK step kernels: the HOST drives the block loop (that is
+    # the point — only one compressed edge block is device-resident at
+    # a time), so none of these iterate and none may hide a host
+    # callback inside: a single infeed in the sweep would serialize the
+    # double-buffered H2D schedule. The iterate/accumulator carries are
+    # donated — the device-resident vector budget is VECTOR_SLOTS
+    # (ops/tier.py), not 2x per fold.
+    "tier:wsum": _c(
+        "tier:wsum", "tier", ["pagerank"], min_donated=1,
+        iterates=False,
+        note="streamed out-weight accumulation: wire decode (uint16 "
+             "offsets + shard base, per-row dst runs) then segment_sum "
+             "into the donated f32 accumulator"),
+    "tier:pagerank_sweep": _c(
+        "tier:pagerank_sweep", "tier", ["pagerank"], min_donated=1,
+        iterates=False,
+        note="one edge-block fold of the streamed PageRank sweep: "
+             "decode, x[src]*(w*inv_wsum[src]), sorted segment_sum "
+             "into the donated accumulator; f32 accumulation"),
+    "tier:pagerank_sweep_int8": _c(
+        "tier:pagerank_sweep_int8", "tier", ["pagerank"],
+        min_donated=1, iterates=False,
+        note="int8 wire variant: symmetric per-block dequantize "
+             "(w * scale) inside the kernel, f32 accumulate — only "
+             "compressed bytes cross the host->device boundary"),
+    "tier:pagerank_epilogue": _c(
+        "tier:pagerank_epilogue", "tier", ["pagerank"], min_donated=1,
+        iterates=False,
+        note="end-of-sweep rank update: dangling mass, damping, L1 "
+             "err; x aliases into the new rank vector (acc is also "
+             "donated but the scalar err output cannot consume it)"),
+    "tier:katz_sweep": _c(
+        "tier:katz_sweep", "tier", ["katz"], min_donated=1,
+        iterates=False,
+        note="streamed Katz fold: decode + x[src]*w, sorted "
+             "segment_sum into the donated accumulator"),
+    "tier:katz_epilogue": _c(
+        "tier:katz_epilogue", "tier", ["katz"], min_donated=1,
+        iterates=False,
+        note="alpha*acc + beta on valid rows, Linf err; x aliases into "
+             "the new vector"),
+    "tier:wcc_sweep": _c(
+        "tier:wcc_sweep", "tier", ["components"], min_donated=1,
+        iterates=False,
+        note="streamed min-label fold, both directions; padding edges "
+             "masked via the block's real-edge count (rc) so the sink "
+             "row never merges unrelated components"),
+    "tier:wcc_epilogue": _c(
+        "tier:wcc_epilogue", "tier", ["components"], min_donated=1,
+        iterates=False,
+        note="min-merge + pointer jump + changed flag; comp aliases into "
+             "the new labels"),
+
     # ---- PPR serving-plane lane buckets -------------------------------
     **_ppr_bucket_contracts(),
 }

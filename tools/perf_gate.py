@@ -297,6 +297,68 @@ def check_delta(record: dict, envelopes: dict) -> int:
     return rc
 
 
+def check_tier(record: dict, envelopes: dict) -> int:
+    """r21 mgtier envelope over the record's ``extra.tier`` stage: the
+    double-buffered block schedule must actually HIDE the declared
+    fraction of the H2D transfer behind the SpMV folds (else streaming
+    degenerates to serial page-in and out-of-core stops paying), and
+    the compressed wire formats must keep their byte-reduction floor.
+    Same honesty contract as the other sweeps: a CPU host has no real
+    H2D lane, so its sub-record carries ``degraded: true`` and can
+    never stand in for the on-device overlap headline; untagged
+    records FAIL."""
+    env = envelopes.get("tier_overlap")
+    if env is None:
+        return 0
+    tier = (record.get("extra") or {}).get("tier")
+    if tier is None:
+        log("FAIL: BASELINE.json declares a tier_overlap envelope but "
+            "the record carries no extra.tier stage — regenerate with "
+            "the current bench.py")
+        return 1
+    if "degraded" not in tier:
+        log("FAIL: tier stage carries no degraded tag — an untagged "
+            "number cannot be trusted")
+        return 1
+    if tier.get("backend") == "cpu" and not tier.get("degraded"):
+        log("FAIL: tier stage ran on cpu but is not tagged degraded")
+        return 1
+    rc = 0
+    # the wire codec is host-side and deterministic: its compression
+    # floor holds on EVERY host, degraded or not
+    ratio_floor = float(env.get("min_wire_ratio", 1.8))
+    for prec in ("bf16", "int8"):
+        got = float(tier.get(f"wire_ratio_{prec}", 0.0))
+        if got < ratio_floor:
+            log(f"FAIL: {prec} wire compression {got:.2f}x < required "
+                f"{ratio_floor:.1f}x — the block codec stopped "
+                "shrinking the transfer")
+            rc = 1
+        else:
+            log(f"PASS: {prec} wire compression {got:.2f}x "
+                f"(>= {ratio_floor:.1f}x)")
+    if tier["degraded"]:
+        log(f"FAIL: tier stage is degraded (backend="
+            f"{tier.get('backend', '?')}) — a host-memcpy overlap "
+            "curve cannot stand in for the H2D-hiding headline")
+        return 1
+    got = float(tier.get("transfer_hidden_fraction", 0.0))
+    need = float(env.get("min_hidden_fraction", 0.6))
+    if int(tier.get("n_blocks", 0)) < 2:
+        log("FAIL: tier stage ran with fewer than 2 blocks — nothing "
+            "was actually streamed")
+        rc = 1
+    if got < need:
+        log(f"FAIL: hidden-transfer fraction {got:.0%} < required "
+            f"{need:.0%} — the double-buffer schedule stopped "
+            "overlapping")
+        rc = 1
+    else:
+        log(f"PASS: hidden-transfer fraction {got:.0%} "
+            f"(>= {need:.0%})")
+    return rc
+
+
 def check_sharding(record: dict | None, envelopes: dict) -> int:
     """r18 shard-scaling envelope over the newest OLTP_r*.json record:
     the sharded point-read group must beat the single-process aggregate
@@ -449,12 +511,14 @@ def main(argv=None) -> int:
             log("FAIL: could not obtain a bench measurement")
             return 1
         return (check(record, baseline)
-                or check_delta(record, baseline.get("envelopes") or {}))
+                or check_delta(record, baseline.get("envelopes") or {})
+                or check_tier(record, baseline.get("envelopes") or {}))
 
     with open(path) as f:
         record = json.load(f)
     rc = check(record, baseline)
     rc = rc or check_delta(record, baseline.get("envelopes") or {})
+    rc = rc or check_tier(record, baseline.get("envelopes") or {})
     if args.latest:
         # the serving-plane record rides the same --latest gate run
         ppr_path = latest_ppr_json()
