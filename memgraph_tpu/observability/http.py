@@ -121,6 +121,15 @@ async def start_monitoring_server(host: str, port: int, ictx):
                              if name.startswith(
                                  ("tier.",
                                   "kernel_server.daemon.tier."))},
+                    # streaming ingestion plane (r17, mgstream):
+                    # batch/record counters, redeliveries, dead-letter
+                    # quarantine, backpressure pauses, per-stream lag
+                    # gauges — plus the trigger firing/error counters
+                    # that ride the same ingest path
+                    "streams": {name: value for name, _k, value
+                                in global_metrics.snapshot()
+                                if name.startswith(
+                                    ("stream.", "trigger."))},
                     # compiled Cypher read lane (r20, mglane):
                     # compile/hit/typed-fallback counters plus the
                     # per-fingerprint lane residency table

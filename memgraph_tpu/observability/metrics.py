@@ -150,6 +150,22 @@ STAT_NAMES = (
     "analytics.device_fault.*",    # typed per-kind device-fault counters
     "analytics.kernel_routed_total",
     "analytics.kernel_route_fallback_total",
+    # streaming ingestion plane (r17, mgstream): supervised exactly-once
+    # consumers — transactional offsets, quarantine, backpressure
+    "stream.batches_total",         # batches durably committed
+    "stream.records_total",         # records durably committed
+    "stream.batch_latency_sec",     # histogram: poll→commit per batch
+    "stream.redeliveries_total",    # failed batches rolled back for retry
+    "stream.dead_letter_total",     # poison batches quarantined
+    "stream.reconnects_total",      # RetryPolicy-backed source reconnects
+    "stream.poll_errors_total",     # source poll failures (pre-reconnect)
+    "stream.ack_failures_total",    # post-commit consumer acks that failed
+    "stream.pauses_total",          # backpressure pause transitions
+    "stream.paused",                # gauge: 1 while polling is paused
+    "stream.lag.*",                 # per-stream source-backlog gauges
+    # triggers (fired on the committed delta)
+    "trigger.fired_total",
+    "trigger.errors_total",         # failing trigger statements (LOUD)
     # durability
     "wal.fsync_latency_sec",
     "wal.fsync_backlog_bytes",

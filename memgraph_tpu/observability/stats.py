@@ -66,6 +66,7 @@ ENV_MAX_LAG = "MEMGRAPH_TPU_HEALTH_MAX_REPL_LAG"        # txns (default 1000)
 ENV_MAX_BACKLOG = "MEMGRAPH_TPU_HEALTH_MAX_FSYNC_BACKLOG"  # bytes (64 MiB)
 ENV_MAX_PPR_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_PPR_QUEUE"  # pending (192)
 ENV_MAX_SHARD_QUEUE = "MEMGRAPH_TPU_HEALTH_MAX_SHARD_QUEUE"  # depth (16)
+ENV_MAX_STREAM_LAG = "MEMGRAPH_TPU_HEALTH_MAX_STREAM_LAG"  # units (100000)
 
 #: every device stage the accumulator may carry — the attribution
 #: vocabulary PROFILE and BENCH records share. The ``lane_*`` stages
@@ -403,6 +404,10 @@ class SaturationPlane:
         # queue on ONE shard means a hot key / skewed hash range, and
         # admission control should shed before latency collapses
         self.max_shard_queue = float(_env_int(ENV_MAX_SHARD_QUEUE, 16))
+        # streaming ingestion: source backlog (bytes behind the file
+        # tail / records behind the broker) — /health must flip before
+        # the consumer falls unboundedly behind the producers
+        self.max_stream_lag = float(_env_int(ENV_MAX_STREAM_LAG, 100_000))
 
     def evaluate(self, ictx=None) -> dict:
         """One readiness verdict from the current metrics snapshot.
@@ -528,11 +533,51 @@ class SaturationPlane:
         else:
             ok("wal_fsync_backlog")
 
+        # streaming ingestion lag (one gauge per stream): the consumer
+        # is falling behind its source faster than batches commit —
+        # flip /health before the backlog grows without bound
+        worst_stream = None
+        for name, value in snap.items():
+            if name.startswith("stream.lag."):
+                if worst_stream is None or value > worst_stream[1]:
+                    worst_stream = (name, value)
+        if worst_stream is not None and \
+                worst_stream[1] > self.max_stream_lag:
+            trip("stream_lag",
+                 f"stream {worst_stream[0].rsplit('.', 1)[1]} source "
+                 "backlog over budget", worst_stream[1],
+                 self.max_stream_lag)
+        else:
+            ok("stream_lag")
+
         ready = not reasons
         global_metrics.set_gauge("health.ready", 1.0 if ready else 0.0)
         if not ready:
             global_metrics.increment("health.not_ready_total")
         return {"ready": ready, "reasons": reasons, "checks": checks}
+
+    def ingest_pressure(self) -> str | None:
+        """Downstream-pressure probe for stream consumers: the check name
+        that says polling MORE data would amplify overload, or None.
+
+        Deliberately stateless (gauge thresholds only, no rate priming):
+        the consumer loop calls this far more often than /health calls
+        evaluate(), and must not perturb the shed-movement windows.
+        """
+        snap = {name: value for name, _kind, value
+                in global_metrics.snapshot()}
+        for name, value in snap.items():
+            if name.startswith("replication.replica_lag.") and \
+                    value > self.max_replica_lag:
+                return "replication_lag"
+        backlog = snap.get("wal.fsync_backlog_bytes")
+        if backlog is not None and backlog > self.max_fsync_backlog:
+            return "wal_fsync_backlog"
+        if snap.get("kernel_server.daemon.wedged"):
+            # the resident analytics plane (mgdelta warm refresh) is not
+            # keeping up — pausing ingest is the graceful degradation
+            return "kernel_server"
+        return None
 
 
 global_saturation = SaturationPlane()

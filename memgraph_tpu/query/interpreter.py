@@ -835,6 +835,17 @@ class Interpreter:
 
     # --- transactions -------------------------------------------------------
 
+    def stage_stream_offset(self, name: str, position) -> None:
+        """Stage a stream source position into the OPEN explicit
+        transaction: the offset becomes a WAL record in the same commit
+        frame as the batch's data (the exactly-once boundary the stream
+        consumer relies on)."""
+        if not self._in_explicit_txn or self._explicit_accessor is None:
+            raise TransactionException(
+                "stream offsets can only be staged inside an explicit "
+                "transaction")
+        self._explicit_accessor.stage_stream_offset(name, position)
+
     def _prepare_transaction(self, node: A.TransactionQuery) -> PreparedQuery:
         if node.action == "begin":
             if self._in_explicit_txn:

@@ -16,17 +16,33 @@ Transformations are Python callables registered with
 @mgp.transformation (procedures/mgp.py), receiving a list of Message and
 returning [{query, parameters}] — the same contract as the reference's
 transformation modules.
+
+Exactly-once (r17): each batch's source position is staged into the
+ingest transaction itself and WAL-framed as an OP_STREAM_OFFSET record
+inside the same commit — replayed on recovery and shipped over
+replication. The consumer-side ``source.commit()`` ack that follows is
+an optimization (it saves redundant redelivery work), NOT the
+correctness boundary: a crash anywhere between the data commit and the
+ack resumes from ``storage.stream_offsets`` with zero duplicates. The
+consumer loop is supervised (RetryPolicy-backed reconnect, typed
+per-batch outcomes, bounded poison-batch retries that end in a
+dead-letter buffer instead of a wedged loop) and backpressured (polling
+pauses while the saturation plane reports downstream pressure).
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 
 from ..exceptions import QueryException
+from ..utils import faultinject as FI
+from ..utils.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
 
@@ -119,6 +135,20 @@ class FileSource:
     def committed_offset(self) -> int:
         return self._committed
 
+    def pending_position(self) -> int:
+        """The byte offset that becomes durable with the current batch
+        (staged into the ingest transaction as its WAL offset record)."""
+        return self._pending
+
+    def lag(self) -> float:
+        """Bytes between the committed offset and the file tail — the
+        source backlog the ``stream.lag.*`` gauge / health check report."""
+        try:
+            return float(max(0, os.path.getsize(self.path)
+                             - self._committed))
+        except OSError:
+            return 0.0
+
     def close(self) -> None:
         pass
 
@@ -135,7 +165,7 @@ class KafkaSource:
     """
 
     def __init__(self, topics, bootstrap_servers, consumer_group,
-                 client_module=None):
+                 client_module=None, start_positions=None):
         if client_module is None:
             try:
                 import confluent_kafka as client_module
@@ -153,25 +183,47 @@ class KafkaSource:
             "enable.auto.commit": False})
         self._consumer.subscribe(list(topics))
         self._batch_start: dict = {}    # (topic, partition) -> first offset
+        # "topic:partition" -> next-offset-to-ingest, durably committed.
+        # Seeded from the WAL-recovered storage.stream_offsets table:
+        # messages below these broker offsets were already ingested in a
+        # committed transaction and are dropped on redelivery, which is
+        # what makes a crash between the data commit and the broker ack
+        # exactly-once instead of at-least-once.
+        self._positions: dict[str, int] = dict(start_positions or {})
+        self._batch_next: dict[str, int] = {}
 
     def poll(self, batch_size: int, timeout_sec: float) -> list[Message]:
         msgs = self._consumer.consume(batch_size, timeout=timeout_sec)
         out = []
         self._batch_start = {}
+        self._batch_next = {}
         for m in msgs or []:
             if m.error():
                 continue
+            key = f"{m.topic()}:{m.partition()}"
+            if m.offset() < self._positions.get(key, -1):
+                continue   # already durably ingested (recovered offset)
             tp = (m.topic(), m.partition())
             if tp not in self._batch_start:
                 self._batch_start[tp] = m.offset()
+            self._batch_next[key] = m.offset() + 1
             out.append(Message(m.value(), m.topic(), m.key(),
                                m.timestamp()[1], m.offset()))
         return out
 
+    def pending_position(self) -> dict | None:
+        """Per-partition next offsets that become durable with the
+        current batch (merged over everything already committed)."""
+        merged = dict(self._positions)
+        merged.update(self._batch_next)
+        return merged or None
+
     def commit(self) -> None:
         if self._batch_start:
             self._consumer.commit(asynchronous=False)
+            self._positions.update(self._batch_next)
             self._batch_start = {}
+            self._batch_next = {}
 
     def rollback(self) -> None:
         # seek back to each partition's batch start: the broker
@@ -183,6 +235,7 @@ class KafkaSource:
             except Exception:  # pragma: no cover - client-specific
                 log.exception("kafka seek-back failed")
         self._batch_start = {}
+        self._batch_next = {}
 
     def close(self) -> None:
         self._consumer.close()
@@ -242,6 +295,25 @@ class StreamSpec:
     bootstrap_servers: str = ""
     service_url: str = ""
     consumer_group: str = ""
+    # supervised-loop knobs (r17): a batch that keeps failing is retried
+    # this many times, then quarantined into the dead-letter buffer (its
+    # offset advances transactionally) instead of wedging the stream
+    max_batch_retries: int = 3
+    dead_letter_limit: int = 100
+
+
+class BatchOutcome:
+    """Typed per-batch outcomes of the supervised consumer loop."""
+    COMMITTED = "committed"
+    REDELIVERED = "redelivered"          # rolled back, will be re-polled
+    DEAD_LETTERED = "dead_lettered"      # quarantined, offset advanced
+    TRANSFORM_ERROR = "transform_error"
+    TXN_ERROR = "txn_error"
+    SERIALIZATION_EXHAUSTED = "serialization_exhausted"
+
+
+class _StreamStopped(Exception):
+    """Internal: the supervised loop must unwind and stop the stream."""
 
 
 class Stream:
@@ -251,9 +323,19 @@ class Stream:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.running = False
+        self.paused = False
         self.processed_batches = 0
         self.processed_messages = 0
         self.last_error: str | None = None
+        self.last_outcome: str | None = None
+        # poison-batch quarantine: (first-offset key, payloads, reason)
+        # tuples, bounded — inspectable via SHOW STREAMS / stream stats
+        self.dead_letter: collections.deque = collections.deque(
+            maxlen=max(1, spec.dead_letter_limit))
+        self._batch_failures = 0
+        self._failed_batch_key = None
+        self._last_pressure_check = 0.0
+        self._pressure_reason: str | None = None
 
     def _make_source(self):
         spec = self.spec
@@ -261,8 +343,11 @@ class Stream:
             return FileSource(spec.topics[0],
                               start_offset=self._restore_offset())
         if spec.kind == "kafka":
+            positions = self._recovered_position()
             return KafkaSource(spec.topics, spec.bootstrap_servers,
-                               spec.consumer_group)
+                               spec.consumer_group,
+                               start_positions=positions
+                               if isinstance(positions, dict) else None)
         if spec.kind == "pulsar":
             return PulsarSource(spec.topics, spec.service_url,
                                 spec.consumer_group)
@@ -277,6 +362,8 @@ class Stream:
                 f"unknown transformation {self.spec.transform!r}")
         source = self._make_source()
         self._stop.clear()
+        self._batch_failures = 0
+        self._failed_batch_key = None
         self._thread = threading.Thread(
             target=self._loop, args=(source, transform), daemon=True)
         self.running = True
@@ -288,73 +375,238 @@ class Stream:
             self._thread.join(timeout=5)
         self.running = False
 
+    def kill(self) -> None:
+        """Chaos hook: die like a SIGKILLed consumer — stop the loop
+        WITHOUT the graceful source ack/offset persistence. Everything
+        durably committed stays committed; everything else redelivers."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._thread = None
+        self.running = False
+
+    # --- supervised consumer loop -------------------------------------------
+
     def _loop(self, source, transform) -> None:
-        from .interpreter import Interpreter
-        from ..exceptions import SerializationError
-        consecutive_failures = 0
+        from ..observability.metrics import global_metrics
         try:
             while not self._stop.is_set():
-                batch = source.poll(self.spec.batch_size,
-                                    self.spec.batch_interval_sec)
-                if not batch:
+                if self._backpressured():
                     continue
                 try:
-                    actions = transform(batch)
-                except Exception as e:
-                    # a transformation error stops the stream (reference
-                    # semantics): skipping would silently drop data,
-                    # redelivering would loop on the poison batch
-                    source.rollback()
-                    self.last_error = f"transform failed: {e}"
-                    log.exception("stream %s transform failed; stopping",
-                                  self.spec.name)
-                    self.running = False
-                    return
-                # conflict-retried transaction (reference: retry interval
-                # config, memgraph.cpp:652)
-                committed = False
-                for attempt in range(10):
-                    interp = Interpreter(self.ictx, system=True)
-                    try:
-                        interp.execute("BEGIN")
-                        for action in actions:
-                            interp.execute(action["query"],
-                                           action.get("parameters"))
-                        interp.execute("COMMIT")
-                        committed = True
-                        break
-                    except SerializationError:
-                        interp.abort()
-                        self.last_error = ("batch exhausted serialization "
-                                           "retries")
-                        time.sleep(0.01 * (attempt + 1))
-                    except Exception as e:
-                        interp.abort()
-                        self.last_error = str(e)
-                        log.exception("stream %s batch failed",
-                                      self.spec.name)
-                        break
-                if committed:
-                    # offsets advance ONLY now: a crash between COMMIT
-                    # and commit() redelivers (at-least-once floor), a
-                    # failed txn never advances (no message loss)
-                    source.commit()
-                    self._persist_offset(source)
-                    consecutive_failures = 0
-                    self.last_error = None
-                    self.processed_batches += 1
-                    self.processed_messages += len(batch)
-                else:
-                    source.rollback()
-                    consecutive_failures += 1
-                    if consecutive_failures >= 3:
-                        log.error(
-                            "stream %s: batch failed %d times; stopping",
-                            self.spec.name, consecutive_failures)
-                        self.running = False
-                        return
+                    FI.fire("stream.poll")
+                    batch = source.poll(self.spec.batch_size,
+                                        self.spec.batch_interval_sec)
+                except Exception as e:   # broker/file gone: reconnect
+                    source = self._reconnect(source, e)
+                    continue
+                self._update_lag(source)
+                if not batch:
+                    continue
+                t0 = time.perf_counter()
+                outcome = self._process_batch(source, transform, batch)
+                global_metrics.observe("stream.batch_latency_sec",
+                                       time.perf_counter() - t0)
+                self.last_outcome = outcome
+        except _StreamStopped:
+            pass
         finally:
+            self.running = False
+            if self.paused:
+                self.paused = False
+                global_metrics.set_gauge("stream.paused", 0.0)
+            try:
+                source.close()
+            except Exception as e:  # noqa: BLE001 — best-effort close
+                log.warning("stream %s source close failed: %s",
+                            self.spec.name, e)
+
+    def _backpressured(self) -> bool:
+        """Pause polling while the saturation plane reports downstream
+        pressure (replication lag, WAL fsync backlog, wedged analytics
+        daemon): ingesting more would amplify the overload. Throttled —
+        the probe reads a metrics snapshot, not per-iteration free."""
+        from ..observability import stats as mgstats
+        from ..observability.metrics import global_metrics
+        now = time.monotonic()
+        if now - self._last_pressure_check >= 0.25:
+            self._last_pressure_check = now
+            self._pressure_reason = \
+                mgstats.global_saturation.ingest_pressure()
+        if self._pressure_reason is None:
+            if self.paused:
+                self.paused = False
+                global_metrics.set_gauge("stream.paused", 0.0)
+                log.info("stream %s: downstream pressure cleared — "
+                         "resuming polls", self.spec.name)
+            return False
+        if not self.paused:
+            self.paused = True
+            global_metrics.set_gauge("stream.paused", 1.0)
+            global_metrics.increment("stream.pauses_total")
+            log.warning("stream %s: pausing polls (downstream pressure: "
+                        "%s)", self.spec.name, self._pressure_reason)
+        self._stop.wait(0.05)
+        return True
+
+    def _reconnect(self, source, err):
+        """RetryPolicy-backed source reconnect with backoff; exhausting
+        the budget stops the stream with a loud typed error."""
+        from ..observability.metrics import global_metrics
+        global_metrics.increment("stream.poll_errors_total")
+        self.last_error = f"poll failed: {err}"
+        log.warning("stream %s: poll failed (%s) — reconnecting",
+                    self.spec.name, err)
+        try:
             source.close()
+        except Exception as e:  # noqa: BLE001 — the source is already bad
+            log.debug("stream %s: close of failed source: %s",
+                      self.spec.name, e)
+        last = err
+        for delay in RetryPolicy(base_delay=0.05, max_delay=2.0,
+                                 max_retries=6).delays():
+            if self._stop.wait(delay):
+                raise _StreamStopped
+            try:
+                fresh = self._make_source()
+                global_metrics.increment("stream.reconnects_total")
+                log.info("stream %s: reconnected", self.spec.name)
+                return fresh
+            except Exception as e:  # noqa: BLE001 — retried, then loud
+                last = e
+        self.last_error = f"reconnect budget exhausted: {last}"
+        log.error("stream %s: reconnect budget exhausted (%s); stopping",
+                  self.spec.name, last)
+        raise _StreamStopped
+
+    def _process_batch(self, source, transform, batch) -> str:
+        from ..exceptions import SerializationError
+        from ..observability.metrics import global_metrics
+        try:
+            FI.fire("stream.transform")
+            actions = transform(batch)
+        except Exception as e:
+            self.last_error = f"transform failed: {e}"
+            log.exception("stream %s transform failed", self.spec.name)
+            return self._handle_failure(source, batch,
+                                        BatchOutcome.TRANSFORM_ERROR)
+        # conflict-retried transaction (reference: retry interval
+        # config, memgraph.cpp:652)
+        failure = BatchOutcome.SERIALIZATION_EXHAUSTED
+        for attempt in range(10):
+            try:
+                self._commit_batch(source, actions)
+                self._ack(source)
+                self._batch_failures = 0
+                self._failed_batch_key = None
+                self.last_error = None
+                self.processed_batches += 1
+                self.processed_messages += len(batch)
+                global_metrics.increment("stream.batches_total")
+                global_metrics.increment("stream.records_total",
+                                         len(batch))
+                return BatchOutcome.COMMITTED
+            except SerializationError:
+                self.last_error = "batch exhausted serialization retries"
+                time.sleep(0.01 * (attempt + 1))
+            except _StreamStopped:
+                raise
+            except Exception as e:
+                self.last_error = str(e)
+                log.exception("stream %s batch failed", self.spec.name)
+                failure = BatchOutcome.TXN_ERROR
+                break
+        return self._handle_failure(source, batch, failure)
+
+    def _commit_batch(self, source, actions) -> None:
+        """One ingest transaction: BEGIN → actions → stage the source's
+        pending position (WAL OP_STREAM_OFFSET in the SAME commit) →
+        COMMIT. The offset is durable iff the data is."""
+        from .interpreter import Interpreter
+        interp = Interpreter(self.ictx, system=True)
+        try:
+            interp.execute("BEGIN")
+            for action in actions:
+                interp.execute(action["query"],
+                               action.get("parameters"))
+            position = self._pending_position(source)
+            if position is not None:
+                interp.stage_stream_offset(self.spec.name, position)
+            interp.execute("COMMIT")
+        except BaseException:
+            interp.abort()
+            raise
+
+    def _ack(self, source) -> None:
+        """Consumer-side ack AFTER the transactional commit: purely an
+        optimization (saves redelivery-dedup work on restart) — failure
+        here never loses or duplicates data."""
+        from ..observability.metrics import global_metrics
+        try:
+            FI.fire("stream.commit")
+            source.commit()
+            self._persist_offset(source)
+        except Exception as e:
+            global_metrics.increment("stream.ack_failures_total")
+            log.warning("stream %s: source ack failed after durable "
+                        "commit (%s) — the WAL offset record makes "
+                        "redelivery exactly-once", self.spec.name, e)
+
+    def _handle_failure(self, source, batch, outcome: str) -> str:
+        """Bounded retries, then quarantine: the poison batch goes to
+        the dead-letter buffer and its offset advances transactionally
+        (an offset-only commit) so the stream never wedges."""
+        from ..observability.metrics import global_metrics
+        key = (batch[0].topic, batch[0].offset, len(batch))
+        if key != self._failed_batch_key:
+            self._failed_batch_key = key
+            self._batch_failures = 0
+        self._batch_failures += 1
+        if self._batch_failures <= self.spec.max_batch_retries:
+            source.rollback()
+            global_metrics.increment("stream.redeliveries_total")
+            log.warning("stream %s: batch at %s failed (%s, attempt "
+                        "%d/%d) — rolled back for redelivery",
+                        self.spec.name, key[:2], outcome,
+                        self._batch_failures, self.spec.max_batch_retries)
+            self._stop.wait(0.05 * self._batch_failures)
+            return BatchOutcome.REDELIVERED
+        # quarantine: capture the batch's end position BEFORE any
+        # rollback, commit it as an offset-only transaction, then ack
+        position = self._pending_position(source)
+        try:
+            if position is not None:
+                self._commit_batch(source, [])
+            self._ack(source)
+        except Exception as e:  # noqa: BLE001 — quarantine must not wedge
+            log.exception("stream %s: dead-letter offset advance failed "
+                          "(%s) — batch will redeliver", self.spec.name, e)
+            source.rollback()
+            return BatchOutcome.REDELIVERED
+        self.dead_letter.append(
+            (key[:2], [m.payload for m in batch], outcome))
+        self._batch_failures = 0
+        self._failed_batch_key = None
+        global_metrics.increment("stream.dead_letter_total")
+        log.error("stream %s: batch at %s exhausted %d retries (%s) — "
+                  "QUARANTINED to the dead-letter buffer (%d entries); "
+                  "offset advanced past it", self.spec.name, key[:2],
+                  self.spec.max_batch_retries, outcome,
+                  len(self.dead_letter))
+        return BatchOutcome.DEAD_LETTERED
+
+    # --- offsets ------------------------------------------------------------
+
+    def _pending_position(self, source):
+        fn = getattr(source, "pending_position", None)
+        return fn() if fn is not None else None
+
+    def _update_lag(self, source) -> None:
+        from ..observability.metrics import global_metrics
+        fn = getattr(source, "lag", None)
+        if fn is not None:
+            global_metrics.set_gauge(f"stream.lag.{self.spec.name}",
+                                     float(fn()))
 
     def _persist_offset(self, source) -> None:
         committed = getattr(source, "committed_offset", None)
@@ -362,12 +614,28 @@ class Stream:
         if committed is not None and kv is not None:
             kv.put(f"streams:offset:{self.spec.name}", str(committed))
 
+    def _recovered_position(self):
+        """The WAL/snapshot-recovered durable position for this stream
+        (None when the storage has none — e.g. a fresh database)."""
+        storage = getattr(self.ictx, "storage", None)
+        offsets = getattr(storage, "stream_offsets", None)
+        if offsets is None:
+            return None
+        return offsets.get(self.spec.name)
+
     def _restore_offset(self) -> int:
+        """FILE streams: resume from the newest durable byte offset —
+        the WAL-recovered position (authoritative) vs the kvstore copy
+        (a lagging optimization that may miss the final pre-crash
+        batches), whichever is further."""
         kv = getattr(self.ictx, "kvstore", None)
-        if kv is None:
-            return 0
-        raw = kv.get_str(f"streams:offset:{self.spec.name}")
-        return int(raw) if raw else 0
+        raw = kv.get_str(f"streams:offset:{self.spec.name}") \
+            if kv is not None else None
+        kv_offset = int(raw) if raw else 0
+        recovered = self._recovered_position()
+        if isinstance(recovered, int):
+            return max(kv_offset, recovered)
+        return kv_offset
 
 
 class Streams:

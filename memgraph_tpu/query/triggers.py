@@ -89,6 +89,7 @@ class TriggerStore:
         if context is None:
             return
         from .interpreter import Interpreter
+        from ..observability.metrics import global_metrics
         self._firing.active = True
         try:
             for trig in triggers:
@@ -97,12 +98,18 @@ class TriggerStore:
                 interp = Interpreter(self.ictx, system=True)
                 try:
                     interp.execute(trig.statement, parameters=context)
+                    global_metrics.increment("trigger.fired_total")
                 except Exception:
                     # AFTER-commit trigger failures must not corrupt the
-                    # session; logged (reference behavior)
+                    # session, but they must never be silent either:
+                    # loud log with the trigger name + a counted error
+                    # (alerting surface — a broken trigger statement
+                    # otherwise drops every firing on the floor)
                     import logging
+                    global_metrics.increment("trigger.errors_total")
                     logging.getLogger(__name__).exception(
-                        "trigger %s failed", trig.name)
+                        "trigger %s failed (statement %r)",
+                        trig.name, trig.statement)
         finally:
             self._firing.active = False
 

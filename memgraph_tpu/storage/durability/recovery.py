@@ -161,6 +161,7 @@ def recover_latest_snapshot(storage) -> None:
 def _clear_storage(storage) -> None:
     storage._vertices.clear()
     storage._edges.clear()
+    storage.stream_offsets.clear()
     from ..indexes import Indices
     from ..constraints import Constraints
     storage.indices = Indices()
@@ -221,6 +222,10 @@ def _apply_snapshot(storage, data: dict) -> None:
         storage.create_unique_constraint(lid, pids)
     for (lid, pid, tname) in data.get("type_constraints", []):
         storage.create_type_constraint(lid, pid, tname)
+    # WAL segments older than the snapshot are pruned, so the snapshot
+    # must carry the stream-offset table itself
+    for name, position in (data.get("stream_offsets") or {}).items():
+        storage.stream_offsets[name] = position
 
 
 def _apply_batch_vertices(storage, vertices, changed) -> None:
@@ -407,6 +412,12 @@ def _apply_wal_txn(storage, ops):
                 storage.indices.edge_type.remove_entry(e)
                 changed.add(e.from_vertex.gid)
                 changed.add(e.to_vertex.gid)
+        elif kind == W.OP_STREAM_OFFSET:
+            # stream offsets ride the data commit: restoring them here is
+            # what makes recovery (and replica apply — replication shares
+            # this function) resume ingestion exactly once
+            name, position = W.decode_stream_offset(buf)
+            storage.stream_offsets[name] = position
         else:
             raise DurabilityError(f"unknown WAL op 0x{kind:02x}")
     for edges in batches:

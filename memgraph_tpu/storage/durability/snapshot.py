@@ -16,6 +16,7 @@ remain readable.
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import threading
@@ -69,6 +70,7 @@ SEC_VERTICES = 0x02
 SEC_EDGES = 0x03
 SEC_INDICES = 0x04
 SEC_CONSTRAINTS = 0x05
+SEC_STREAM_OFFSETS = 0x06
 SEC_END = 0xFF
 
 
@@ -263,6 +265,20 @@ def create_snapshot(storage) -> str:
             _write_varint(buf, len(raw))
             buf.write(raw)
 
+        # stream-offset table: the WAL segments holding OP_STREAM_OFFSET
+        # records are pruned once this snapshot covers them, so the
+        # snapshot must carry the offsets itself
+        offsets = dict(storage.stream_offsets)
+        buf.write(bytes((SEC_STREAM_OFFSETS,)))
+        _write_varint(buf, len(offsets))
+        for name in sorted(offsets):
+            raw = name.encode("utf-8")
+            _write_varint(buf, len(raw))
+            buf.write(raw)
+            pos = json.dumps(offsets[name], sort_keys=True).encode("utf-8")
+            _write_varint(buf, len(pos))
+            buf.write(pos)
+
         buf.write(bytes((SEC_END,)))
         data = buf.getvalue()
     finally:
@@ -391,6 +407,13 @@ def load_snapshot(path: str) -> dict:
                 tname = buf.read(_read_varint(buf)).decode("utf-8")
                 tc.append((lid, pid, tname))
             out["type_constraints"] = tc
+        elif marker == SEC_STREAM_OFFSETS:
+            offsets = {}
+            for _ in range(_read_varint(buf)):
+                name = buf.read(_read_varint(buf)).decode("utf-8")
+                offsets[name] = json.loads(
+                    buf.read(_read_varint(buf)).decode("utf-8"))
+            out["stream_offsets"] = offsets
         else:
             raise DurabilityError(f"{path}: unknown section 0x{marker:02x}")
     return out

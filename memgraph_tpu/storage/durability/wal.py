@@ -61,6 +61,7 @@ OP_EDGE_STATE = 0x21        # gid, props
 OP_DELETE_EDGE = 0x22       # gid
 OP_MAPPER_SYNC = 0x30       # label/property/edge-type name tables
 OP_BATCH_INSERT = 0x40      # one bulk-insert batch, columnar layout
+OP_STREAM_OFFSET = 0x50     # stream name + source position, in-txn
 
 
 def _crc(kind: int, payload: bytes) -> int:
@@ -135,6 +136,29 @@ def _encode_batch_insert(batch, deleted_v, deleted_e) -> bytes:
         _write_varint(p, e.to_vertex.gid)
     prop_columns(edges)
     return p.getvalue()
+
+
+def encode_stream_offset(name: str, position) -> bytes:
+    """OP_STREAM_OFFSET payload: varint-length-prefixed stream name +
+    varint-length-prefixed JSON position (FileSource byte offsets and
+    Kafka per-(topic, partition) offset maps both fit)."""
+    import json
+    p = BytesIO()
+    raw = name.encode("utf-8")
+    _write_varint(p, len(raw))
+    p.write(raw)
+    pos = json.dumps(position, sort_keys=True).encode("utf-8")
+    _write_varint(p, len(pos))
+    p.write(pos)
+    return p.getvalue()
+
+
+def decode_stream_offset(buf: BytesIO) -> tuple[str, object]:
+    """Decode one OP_STREAM_OFFSET payload into (name, position)."""
+    import json
+    name = buf.read(_read_varint(buf)).decode("utf-8")
+    position = json.loads(buf.read(_read_varint(buf)).decode("utf-8"))
+    return name, position
 
 
 def decode_batch_insert(buf: BytesIO):
@@ -287,6 +311,13 @@ def encode_txn_ops(storage, txn, commit_ts: int) -> bytes:
                 _write_varint(p, pid)
                 encode_value(p, e.properties[pid])
             frame(OP_EDGE_STATE, p.getvalue())
+
+    # stream offsets ride the same commit frame: replayed on recovery
+    # and shipped over replication, so consumer-side commit() is an
+    # optimization, not the exactly-once boundary
+    for name in sorted(getattr(txn, "stream_offsets", None) or {}):
+        frame(OP_STREAM_OFFSET,
+              encode_stream_offset(name, txn.stream_offsets[name]))
 
     p = BytesIO()
     _write_varint(p, commit_ts)

@@ -120,7 +120,8 @@ class _Namer:
 class Transaction:
     __slots__ = ("id", "start_ts", "commit_info", "deltas", "isolation",
                  "storage", "touched_vertices", "touched_edges", "commit_ts",
-                 "topology_snapshot", "batches", "edge_prop_endpoint_gids")
+                 "topology_snapshot", "batches", "edge_prop_endpoint_gids",
+                 "stream_offsets")
 
     def __init__(self, txn_id: int, start_ts: int, isolation: IsolationLevel,
                  storage: "InMemoryStorage") -> None:
@@ -139,6 +140,9 @@ class Transaction:
         # touched_vertices (only _edge_set_property) — lets the commit/abort
         # topology bump skip re-walking every touched edge's endpoints
         self.edge_prop_endpoint_gids = None
+        # stream name -> source position, WAL-framed inside THIS commit
+        # (exactly-once boundary for streaming ingestion)
+        self.stream_offsets = None
 
     def effective_start_ts(self) -> int:
         # Once committed, the transaction's snapshot ADVANCES to its commit
@@ -392,6 +396,17 @@ class Accessor:
             for e in self.txn.touched_edges.values():
                 if not idx.has(e.edge_type):
                     self.storage.create_edge_type_index(e.edge_type)
+
+    def stage_stream_offset(self, name: str, position) -> None:
+        """Stage a stream's source position into THIS transaction: the
+        offset becomes a WAL record inside the same commit frame as the
+        batch's data, making it the exactly-once boundary (replayed on
+        recovery, shipped over replication)."""
+        if self._finished:
+            raise StorageError("transaction already finished")
+        if self.txn.stream_offsets is None:
+            self.txn.stream_offsets = {}
+        self.txn.stream_offsets[name] = position
 
     def abort(self) -> None:
         if self._finished:
@@ -1206,6 +1221,10 @@ class InMemoryStorage:
         # succeeded (e.g. wal_sink raised) — lets replication send
         # finalize('abort') so replicas don't orphan prepared frames
         self.commit_abort_hooks: list[Callable] = []
+        # stream name -> last durably-committed source position; written
+        # by committing stream transactions, restored by recovery
+        # (snapshot section + OP_STREAM_OFFSET replay) and by replication
+        self.stream_offsets: dict[str, object] = {}
 
     # --- transactions -------------------------------------------------------
 
@@ -1279,7 +1298,8 @@ class InMemoryStorage:
 
     def _commit(self, txn: Transaction) -> int:
         storage_mode = self.config.storage_mode
-        if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or not txn.deltas:
+        if storage_mode is StorageMode.IN_MEMORY_ANALYTICAL or \
+                not (txn.deltas or txn.stream_offsets):
             with self._engine_lock:
                 self._active_txns.pop(txn.id, None)
                 mvcc_event("commit", txn=txn.id, commit_ts=None, ro=True)
@@ -1360,6 +1380,10 @@ class InMemoryStorage:
             if txn.edge_prop_endpoint_gids:
                 changed |= txn.edge_prop_endpoint_gids
             self._bump_topology(changed)
+            if txn.stream_offsets:
+                # the offsets are durable (WAL-framed above) — publish
+                # them atomically with the commit's visibility flip
+                self.stream_offsets.update(txn.stream_offsets)
             mvcc_event("commit", txn=txn.id, commit_ts=commit_ts)
         if ship_seq is not None:
             # strict shipping order across concurrent committers

@@ -67,6 +67,12 @@ KNOWN_POINTS = (
     "device.lost",     # backend gone: arm "raise" for an in-process
     #                    DeviceLostError, "kill" to take down the whole
     #                    process (the resident kernel-server daemon case)
+    # --- streaming ingestion (query/streams.py consumer loop) ---
+    "stream.poll",     # Stream._loop, before source.poll ("raise" =
+    #                    broker/file unreachable; reconnect path)
+    "stream.commit",   # Stream._loop, before source.commit — the window
+    #                    the transactional offset record closes
+    "stream.transform",# Stream._loop, around the user transform
 )
 
 #: device-plane nemesis ops (tools/mgchaos device schedules). Same
@@ -97,6 +103,12 @@ NEMESIS_OPS = (
     "partition_node",     # isolate one node from everybody (a "pause")
     "delay",              # fixed extra latency on a link
     "duplicate",          # every message on the link delivered twice
+    # streaming ingestion plane (r17, mgstream; cluster-harness op: the
+    # harness kills/restarts a stream consumer, not a net_* rule).
+    # Position matters: the tuple order feeds the seeded schedule's op
+    # draw, and the 10-seed sweep (tests/test_chaos.py) must exercise
+    # every op — appending at the end starves partition_oneway.
+    "stream_consumer_kill",  # kill a consumer mid-batch; heal restarts it
     "reorder",            # seeded jitter on the link (messages overtake)
     "kill_restart",       # node churn: hard-kill a node, later restart it
     # --- sharded OLTP plane (r18, mgshard; cluster-harness ops like

@@ -1,0 +1,93 @@
+"""Stream-ingest crash-harness child: a FILE stream killed mid-protocol.
+
+Invoked as a subprocess by tests/test_stream_recovery_matrix.py:
+
+    python tests/stream_crash_child.py run   <dur_dir> <input> <n>
+    python tests/stream_crash_child.py drain <dur_dir> <input> <n>
+
+``run`` ingests <input> (JSONL, one ``{"id": i}`` per line) through a
+FILE stream with a small batch size; faults armed via MEMGRAPH_TPU_FAULTS
+(``stream.commit=kill@1``, ``wal.write=torn:12+kill@2``,
+``kvstore.put=kill@1`` ...) exit(137) at an exact protocol step, like
+kill -9. ``drain`` runs AFTER the crash with no faults: it recovers the
+storage (WAL replay), records what survived, restarts the stream so the
+tail of the file re-ingests from the RECOVERED offset, and prints a JSON
+report the parent asserts exactly-once on::
+
+    {"recovered_ids": [...],   # graph contents straight after recovery
+     "recovered_offset": ...,  # storage.stream_offsets after replay
+     "final_ids": [...]}       # graph contents after the drain completes
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _ids(interp):
+    _cols, rows, _summary = interp.execute(
+        "MATCH (s:S) RETURN s.id ORDER BY s.id")
+    return [r[0] for r in rows]
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    mode, dur_dir, input_path, n = (sys.argv[1], sys.argv[2], sys.argv[3],
+                                    int(sys.argv[4]))
+
+    from memgraph_tpu.query import streams as S
+    from memgraph_tpu.query.interpreter import (Interpreter,
+                                                InterpreterContext)
+    from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+    from memgraph_tpu.storage.durability.recovery import (recover,
+                                                          wire_durability)
+    from memgraph_tpu.storage.kvstore import KVStore
+
+    storage = InMemoryStorage(StorageConfig(
+        durability_dir=dur_dir, wal_enabled=True))
+    recover(storage)
+    wal = wire_durability(storage)
+    ictx = InterpreterContext(storage)
+    ictx.kvstore = KVStore(os.path.join(dur_dir, "kv.db"))
+    interp = Interpreter(ictx, system=True)
+
+    def transform(batch):
+        return [{"query": "CREATE (:S {id: $id})",
+                 "parameters": {"id": json.loads(m.payload_str())["id"]}}
+                for m in batch]
+
+    S.TRANSFORMATIONS["crash_matrix"] = transform
+    spec = S.StreamSpec(name="cm", kind="file", topics=[input_path],
+                        transform="crash_matrix", batch_size=2,
+                        batch_interval_sec=0.05, max_batch_retries=2)
+
+    if mode == "drain":
+        report = {"recovered_ids": _ids(interp),
+                  "recovered_offset": storage.stream_offsets.get("cm")}
+
+    stream = S.Stream(spec, ictx)
+    stream.start()
+    deadline = time.time() + 60
+    want = n - len(report["recovered_ids"]) if mode == "drain" else n
+    while time.time() < deadline:
+        if mode == "run" and stream.processed_messages >= n:
+            break
+        if mode == "drain" and len(_ids(interp)) >= n:
+            break
+        if not stream.running:
+            break
+        time.sleep(0.05)
+    stream.stop()
+    wal.close()
+
+    if mode == "drain":
+        report["final_ids"] = _ids(interp)
+        print(json.dumps(report))
+        return 0
+    print("workload complete", stream.processed_messages, want)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -379,8 +379,18 @@ def test_matrix_asymmetric_link_fences_old_main():
                             ReplicaUnavailableException,
                             Exception)):
             cluster.write(old_main, gids["k0"], 1)
-        # new main acks at the new epoch
-        cluster.write(new_main, gids["k0"], 2)
+        # new main acks at the new epoch. A ReplicaUnavailable abort is
+        # the documented SAFE "definitely did not happen" (a strict
+        # replica can still be mid-catch-up right after promotion), so
+        # retry like a real chaos client would.
+        def _write_lands():
+            try:
+                cluster.write(new_main, gids["k0"], 2)
+                return True
+            except ReplicaUnavailableException:
+                return False
+        assert wait_for(_write_lands, timeout=20), \
+            "new MAIN never acked once its strict replicas caught up"
         repl = cluster.data[new_main].replication
         assert repl.current_epoch() == epoch
         FI.net_heal()
@@ -518,6 +528,48 @@ def test_seeded_nemesis_sweep(seed):
     links, link chaos and node churn — zero acked-write loss, never two
     acking mains in one epoch, convergence inside the heal window."""
     history, violations, stats = run_chaos(seed, rounds=4)
+    assert violations == [], \
+        f"seed {seed} UNSAFE: {violations}\nstats={stats}"
+    assert stats["converged"], f"seed {seed} never converged: {stats}"
+
+
+# --------------------------------------------------------------------------
+# stream-consumer chaos (r17): tier-1 smoke + the -m chaos sweep
+# --------------------------------------------------------------------------
+
+
+def test_stream_chaos_smoke():
+    from tools.mgchaos.stream import run_stream_chaos
+    _hist, violations, stats = run_stream_chaos(
+        0, rounds=2, n_streams=2,
+        dwell=(0.2, 0.4), recover_w=(0.2, 0.3))
+    assert violations == [], (violations, stats)
+    assert stats["converged"]
+    assert stats["kills"] >= 1
+    assert stats["ingested"] == stats["produced"] > 0
+
+
+def test_stream_nemesis_op_registered_and_scheduled():
+    assert "stream_consumer_kill" in FI.NEMESIS_OPS
+    seen = set()
+    for seed in SWEEP_SEEDS:
+        for op in schedule(seed, ["s0", "s1"], ["s0", "s1"], rounds=3,
+                           ops=("stream_consumer_kill",),
+                           streams=["s0", "s1"]):
+            seen.add(op.kind)
+            assert op.targets[0] in ("s0", "s1")
+    assert seen == {"stream_consumer_kill"}
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", SWEEP_SEEDS)
+def test_seeded_stream_chaos_sweep(seed):
+    """The acceptance sweep: 10 seeds of consumer SIGKILLs mid-ingest —
+    exactly-once (zero duplicates, zero loss), always-fresh monotone
+    reads, bounded post-heal drain of the backlog."""
+    from tools.mgchaos.stream import run_stream_chaos
+    _hist, violations, stats = run_stream_chaos(seed, rounds=4)
     assert violations == [], \
         f"seed {seed} UNSAFE: {violations}\nstats={stats}"
     assert stats["converged"], f"seed {seed} never converged: {stats}"

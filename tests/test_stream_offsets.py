@@ -142,10 +142,11 @@ def _wait(pred, timeout=10.0):
     return False
 
 
-def test_file_stream_exactly_once_with_failing_batch(tmp_path):
-    """A batch whose transaction fails is redelivered, not lost; after 3
-    failures the stream stops without advancing the offset; committed
-    batches advance it exactly once."""
+def test_file_stream_poison_batch_quarantined_exactly_once(tmp_path):
+    """A batch whose transaction fails is redelivered, not lost; after
+    max_batch_retries failures it is QUARANTINED into the dead-letter
+    buffer with its offset advanced transactionally — the stream keeps
+    running and later good batches still ingest exactly once."""
     ictx = InterpreterContext(InMemoryStorage())
     interp = Interpreter(ictx)
     path = str(tmp_path / "in.jsonl")
@@ -174,20 +175,26 @@ def test_file_stream_exactly_once_with_failing_batch(tmp_path):
         assert _wait(lambda: stream.processed_messages >= 2)
         _, rows, _ = interp.execute("MATCH (m:Msg) RETURN count(m)")
         assert rows == [[2]]
-        committed_after_good = stream._thread and True
-        good_offset = None
 
-        # failing batch: txn aborts 3x -> stream stops, offset NOT moved
+        # poison batch: txn aborts max_batch_retries times -> quarantined
+        # (offset advanced, loop alive), NOT a wedged/stopped stream
         _write_lines(path, [{"id": 3, "boom": True}])
-        assert _wait(lambda: not stream.running, timeout=15)
-        assert stream.last_error
+        assert _wait(lambda: len(stream.dead_letter) == 1, timeout=15)
+        assert stream.running
+        assert stream.last_outcome == S.BatchOutcome.DEAD_LETTERED
+        (_key, payloads, reason), = stream.dead_letter
+        assert b'"boom"' in payloads[0]
+        assert reason == S.BatchOutcome.TXN_ERROR
         _, rows, _ = interp.execute("MATCH (m:Msg) RETURN count(m)")
-        assert rows == [[2]]            # nothing from the failed batch
+        assert rows == [[2]]            # nothing from the poison batch
 
-        # no duplicates from the earlier committed batch either
+        # the offset moved PAST the quarantined batch: a later good line
+        # ingests exactly once and the poison line never replays
+        _write_lines(path, [{"id": 4}])
+        assert _wait(lambda: stream.processed_messages >= 3)
         _, rows, _ = interp.execute(
             "MATCH (m:Msg) RETURN m.id ORDER BY m.id")
-        assert rows == [[1], [2]]
+        assert rows == [[1], [2], [4]]
     finally:
         stream.stop()
         S.TRANSFORMATIONS.pop("test_exactly_once", None)
@@ -229,6 +236,116 @@ def test_file_stream_offset_survives_restart(tmp_path):
         assert rows == [[1], [2], [3]]  # 1,2 exactly once; 3 arrived
     finally:
         S.TRANSFORMATIONS.pop("test_restart", None)
+
+
+# --------------------------------------------------------------------------
+# r17 exactly-once: the offset is part of the ingest transaction (WAL
+# OP_STREAM_OFFSET), replayed on recovery — the consumer-side ack is an
+# optimization, not the correctness boundary
+# --------------------------------------------------------------------------
+
+def test_stream_offset_rides_the_ingest_commit_and_wal_replay(tmp_path):
+    """The batch's data and its source position commit ATOMICALLY: after
+    a crash-restart (WAL replay, kvstore copy lost) the recovered
+    storage.stream_offsets points past every committed batch, and a
+    fresh FILE stream resumes there — zero duplicates, zero loss."""
+    from memgraph_tpu.storage import StorageConfig
+    from memgraph_tpu.storage.durability.recovery import (recover,
+                                                          wire_durability)
+    d = str(tmp_path / "dur")
+    storage = InMemoryStorage(StorageConfig(durability_dir=d,
+                                            wal_enabled=True))
+    wal = wire_durability(storage)
+    ictx = InterpreterContext(storage)
+    interp = Interpreter(ictx)
+    path = str(tmp_path / "in.jsonl")
+
+    def transform(batch):
+        return [{"query": "CREATE (:W {id: $id})",
+                 "parameters": {"id": json.loads(m.payload_str())["id"]}}
+                for m in batch]
+
+    S.TRANSFORMATIONS["test_wal_offsets"] = transform
+    try:
+        spec = S.StreamSpec(name="sw", kind="file", topics=[path],
+                            transform="test_wal_offsets", batch_size=10,
+                            batch_interval_sec=0.05)
+        stream = S.Stream(spec, ictx)
+        _write_lines(path, [{"id": 1}, {"id": 2}])
+        stream.start()
+        assert _wait(lambda: stream.processed_messages >= 2)
+        stream.kill()                      # SIGKILL-style: no graceful ack
+        wal.close()
+        assert storage.stream_offsets.get("sw", 0) > 0
+
+        # crash-restart: fresh storage, WAL replay only (NO kvstore —
+        # the consumer-side persisted copy is gone)
+        restored = InMemoryStorage(StorageConfig(durability_dir=d,
+                                                 wal_enabled=True))
+        recover(restored)
+        assert restored.stream_offsets.get("sw") == \
+            storage.stream_offsets["sw"]
+        ictx2 = InterpreterContext(restored)
+        interp2 = Interpreter(ictx2)
+        _, rows, _ = interp2.execute("MATCH (w:W) RETURN count(w)")
+        assert rows == [[2]]
+
+        _write_lines(path, [{"id": 3}])
+        stream2 = S.Stream(spec, ictx2)
+        stream2.start()                    # resumes at the WAL offset
+        assert _wait(lambda: stream2.processed_messages >= 1)
+        stream2.stop()
+        _, rows, _ = interp2.execute(
+            "MATCH (w:W) RETURN w.id ORDER BY w.id")
+        assert rows == [[1], [2], [3]]     # 1,2 exactly once; 3 fresh
+    finally:
+        S.TRANSFORMATIONS.pop("test_wal_offsets", None)
+
+
+def test_kafka_recovered_positions_dedup_redelivery():
+    """A crash between the data commit and the broker ack makes the
+    broker redeliver the batch; the WAL-recovered per-partition position
+    drops the already-ingested messages client-side (exactly-once with
+    zero broker cooperation)."""
+    mod = _FakeKafkaModule()
+    src = S.KafkaSource(["t"], "broker:9092", "g", client_module=mod)
+    consumer = mod.consumers[0]
+    consumer.queue = [_FakeMsg(b"a", offset=0), _FakeMsg(b"b", offset=1)]
+    batch = src.poll(10, 0.01)
+    assert [m.payload for m in batch] == [b"a", b"b"]
+    # the position staged into the ingest txn (what lands in the WAL)
+    assert src.pending_position() == {"t:0": 2}
+    # CRASH before src.commit(): broker still has committed_offset 0.
+    # Restart seeds the source from the recovered WAL position:
+    src2 = S.KafkaSource(["t"], "broker:9092", "g", client_module=mod,
+                         start_positions={"t:0": 2})
+    consumer2 = mod.consumers[1]
+    consumer2.queue = [_FakeMsg(b"a", offset=0), _FakeMsg(b"b", offset=1),
+                       _FakeMsg(b"c", offset=2)]
+    batch = src2.poll(10, 0.01)
+    assert [m.payload for m in batch] == [b"c"]   # a,b deduped
+    assert src2.pending_position() == {"t:0": 3}
+    src2.rollback()
+    # rollback keeps the recovered floor: redelivered a,b still dedup
+    assert src2.pending_position() == {"t:0": 2}
+
+
+def test_failed_txn_stages_no_offset(tmp_path):
+    """An aborted ingest transaction publishes NEITHER its data NOR its
+    staged offset — the two are one atom."""
+    ictx = InterpreterContext(InMemoryStorage())
+    interp = Interpreter(ictx, system=True)
+    interp.execute("BEGIN")
+    interp.execute("CREATE (:A {id: 1})")
+    interp.stage_stream_offset("sx", 10)
+    interp.execute("ROLLBACK")
+    assert ictx.storage.stream_offsets == {}
+    _, rows, _ = interp.execute("MATCH (a:A) RETURN count(a)")
+    assert rows == [[0]]
+    # and staging outside an explicit txn is a typed error
+    from memgraph_tpu.exceptions import TransactionException
+    with pytest.raises(TransactionException):
+        interp.stage_stream_offset("sx", 11)
 
 
 def test_confluent_kafka_integration_if_available():

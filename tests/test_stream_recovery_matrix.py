@@ -1,0 +1,128 @@
+"""Offset-recovery crash matrix: a FILE stream consumer killed at exact
+protocol steps, then recovered + drained — exactly-once must hold at
+EVERY kill point because the offset rides the ingest commit (WAL
+OP_STREAM_OFFSET), not the consumer-side ack.
+
+Kill points (tests/stream_crash_child.py, faults via MEMGRAPH_TPU_FAULTS):
+
+* ``stream.commit=kill@K`` — after the Kth durable data+offset commit,
+  BEFORE the consumer ack (the classic at-least-once dup window: the
+  source would redeliver, but the recovered offset dedups it);
+* ``wal.write=torn:N+kill@K`` — mid-WAL-record torn write: the whole
+  txn (data AND offset, one atom) is dropped on replay and the batch
+  redelivers — no half-ingested batch, no phantom offset;
+* ``kvstore.put=kill@K`` — after the source ack, before the kvstore
+  offset copy persists (the kv copy is a lagging optimization; the WAL
+  position must win on restart).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+CHILD = REPO / "tests" / "stream_crash_child.py"
+
+N_LINES = 6
+
+
+def _run(tmp_path, mode, faults):
+    dur = tmp_path / "data"
+    dur.mkdir(exist_ok=True)
+    inp = tmp_path / "in.jsonl"
+    if not inp.exists():
+        inp.write_text("".join(json.dumps({"id": i}) + "\n"
+                               for i in range(N_LINES)))
+    env = os.environ.copy()
+    env["MEMGRAPH_TPU_FAULTS"] = faults
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("MG_TRACK_LOCKS", "1")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, str(CHILD), mode, str(dur), str(inp),
+         str(N_LINES)],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=300)
+
+
+def _crash_then_drain(tmp_path, faults):
+    proc = _run(tmp_path, "run", faults)
+    assert proc.returncode == 137, (
+        f"child should have been fault-killed, got rc={proc.returncode}\n"
+        f"{proc.stdout}{proc.stderr}")
+    drain = _run(tmp_path, "drain", "")
+    assert drain.returncode == 0, drain.stdout + drain.stderr
+    return json.loads(drain.stdout.strip().splitlines()[-1])
+
+
+def _assert_exactly_once(report):
+    recovered = report["recovered_ids"]
+    # recovery must never surface a duplicate (a redelivered batch whose
+    # first ingest already committed) ...
+    assert len(recovered) == len(set(recovered)), (
+        f"duplicate ids after recovery: {recovered}")
+    # ... and the drain must end with every line exactly once
+    assert report["final_ids"] == list(range(N_LINES)), report
+
+
+# the three protocol windows, each at an early and a later commit
+STREAM_CRASH_MATRIX = [
+    "stream.commit=kill@1",
+    "stream.commit=kill@2",
+    "wal.write=torn:12+kill@1",
+    "wal.write=torn:30+kill@2",
+    "kvstore.put=kill@1",
+    "kvstore.put=kill@2",
+]
+
+# tier-1 smoke: one kill per protocol window
+STREAM_CRASH_SMOKE = [
+    "stream.commit=kill@1",
+    "wal.write=torn:12+kill@2",
+    "kvstore.put=kill@1",
+]
+
+
+@pytest.mark.parametrize("faults", STREAM_CRASH_SMOKE)
+def test_stream_crash_smoke(tmp_path, faults):
+    _assert_exactly_once(_crash_then_drain(tmp_path, faults))
+
+
+@pytest.mark.slow
+@pytest.mark.crash
+@pytest.mark.parametrize("faults", STREAM_CRASH_MATRIX)
+def test_stream_crash_matrix(tmp_path, faults):
+    _assert_exactly_once(_crash_then_drain(tmp_path, faults))
+
+
+def test_stream_commit_kill_recovers_the_unacked_batch(tmp_path):
+    """The sharpest case spelled out: killed BETWEEN the durable commit
+    and the consumer ack, the batch's data AND offset must both be
+    there after WAL replay — redelivery dedups instead of duplicating."""
+    report = _crash_then_drain(tmp_path, "stream.commit=kill@1")
+    assert report["recovered_ids"] == [0, 1]      # batch_size=2, batch 1
+    assert report["recovered_offset"] is not None
+    assert report["recovered_offset"] > 0
+    _assert_exactly_once(report)
+
+
+def test_torn_offset_record_drops_the_whole_txn(tmp_path):
+    """A torn WAL write mid-record drops data+offset as one atom: either
+    the batch is fully there with its offset, or fully absent."""
+    report = _crash_then_drain(tmp_path, "wal.write=torn:12+kill@1")
+    assert report["recovered_ids"] == []          # txn 1 torn away
+    assert report["recovered_offset"] is None
+    _assert_exactly_once(report)
+
+
+def test_stream_child_completes_without_faults(tmp_path):
+    proc = _run(tmp_path, "run", "")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(_run(tmp_path, "drain", "").stdout
+                        .strip().splitlines()[-1])
+    assert report["recovered_ids"] == list(range(N_LINES))
+    _assert_exactly_once(report)

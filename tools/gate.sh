@@ -118,6 +118,16 @@ stage "shard-smoke (sharded OLTP execution plane)" \
 stage "tier-smoke (out-of-core streamed edge blocks)" \
     python -m tools.tier_smoke
 
+# 4f. streaming-ingestion smoke: a WAL-backed FILE stream through the
+#     Cypher surface — transactional-offset ingest, consumer kill +
+#     cold restart resuming exactly-once from the durable offset,
+#     poison-batch dead-letter quarantine with the loop alive, the
+#     AFTER-COMMIT trigger metered, backpressure probe + the
+#     stream_lag health flip. Functional on every host; sustained
+#     throughput is the bench's job (stream_ingest -> BENCH_r*.json).
+stage "stream-smoke (crash-safe exactly-once ingestion plane)" \
+    python -m tools.stream_smoke
+
 # 5. perf-regression gate: the newest BENCH_r*.json record must be
 #    non-degraded and within BASELINE.json's envelope (>15% regression
 #    fails). Hosts without an accelerator skip LOUDLY (exit 0): the

@@ -18,6 +18,8 @@ Each round picks one op from ``faultinject.NEMESIS_OPS``:
     kill_restart       hard-kill a DATA node, restart it after the dwell
     shard_move         live-rebalance a shard to a fresh worker (r18)
     shard_worker_kill  SIGKILL a shard owner; the heal respawns it
+    stream_consumer_kill  kill a stream consumer mid-batch; heal
+                       restarts it from the durably-committed offset (r17)
 
 then dwells, heals (or restarts), and lets the cluster recover before
 the next round. The ``Nemesis`` executor applies ops against a live
@@ -52,19 +54,23 @@ def schedule(seed: int, nodes: list[str], data_nodes: list[str],
              rounds: int = 6, dwell: tuple[float, float] = (1.5, 3.0),
              recover: tuple[float, float] = (1.5, 2.5),
              ops: tuple[str, ...] = FI.NEMESIS_OPS,
-             shards: list[str] | None = None) -> list[NemesisOp]:
+             shards: list[str] | None = None,
+             streams: list[str] | None = None) -> list[NemesisOp]:
     """Derive a deterministic fault schedule from ``seed``.
 
     ``nodes`` is every partitionable node (coordinators + data);
     ``data_nodes`` the subset eligible for kill/restart churn;
     ``shards`` the shard-id targets for the r18 shard-plane ops
     (defaults to ``data_nodes`` so a schedule stays derivable from any
-    node census). Lists are consumed in the given order, so pass them
-    in a canonical (sorted) order for cross-process replay."""
+    node census); ``streams`` the stream names the r17 consumer-kill op
+    targets (defaults to ``data_nodes`` likewise). Lists are consumed
+    in the given order, so pass them in a canonical (sorted) order for
+    cross-process replay."""
     for op in ops:
         if op not in FI.NEMESIS_OPS:
             raise ValueError(f"unknown nemesis op {op!r}")
     shard_targets = shards if shards else data_nodes
+    stream_targets = streams if streams else data_nodes
     rng = random.Random(seed)
     out: list[NemesisOp] = []
     for rnd in range(rounds):
@@ -72,6 +78,9 @@ def schedule(seed: int, nodes: list[str], data_nodes: list[str],
         arg = 0.0
         if kind in ("shard_move", "shard_worker_kill"):
             targets = (shard_targets[rng.randrange(len(shard_targets))],)
+        elif kind == "stream_consumer_kill":
+            targets = (
+                stream_targets[rng.randrange(len(stream_targets))],)
         elif kind == "kill_restart":
             targets = (data_nodes[rng.randrange(len(data_nodes))],)
         elif kind == "partition_node":
@@ -141,6 +150,8 @@ class Nemesis:
             self.cluster.shard_move(op.targets[0])
         elif op.kind == "shard_worker_kill":
             self.cluster.shard_kill(op.targets[0])
+        elif op.kind == "stream_consumer_kill":
+            self.cluster.stream_consumer_kill(op.targets[0])
         else:  # pragma: no cover - schedule() validates op kinds
             raise ValueError(f"unknown nemesis op {op.kind!r}")
 
@@ -149,6 +160,8 @@ class Nemesis:
             self.cluster.restart(op.targets[0])
         elif op.kind == "shard_worker_kill":
             self.cluster.shard_restart(op.targets[0])
+        elif op.kind == "stream_consumer_kill":
+            self.cluster.stream_consumer_restart(op.targets[0])
         elif op.kind == "shard_move":
             pass   # cutover already healed it; record the phase below
         elif op.kind == "partition_node":

@@ -26,9 +26,11 @@ HEAL_WINDOW_S = 30.0     # bounded liveness: new acking MAIN within this
 
 #: the replication-cluster subset of the nemesis registry: the r18
 #: shard-plane ops (shard_move / shard_worker_kill) drive a ShardPlane
-#: harness instead (tools/mgchaos/shard.py run_shard_chaos)
+#: harness instead (tools/mgchaos/shard.py run_shard_chaos), and the
+#: r17 stream-consumer op drives the StreamChaosHarness
+#: (tools/mgchaos/stream.py run_stream_chaos)
 CLUSTER_OPS = tuple(op for op in FI.NEMESIS_OPS
-                    if not op.startswith("shard_"))
+                    if not op.startswith(("shard_", "stream_")))
 
 
 def run_chaos(seed: int, rounds: int = 4, n_clients: int = 3,

@@ -1,0 +1,266 @@
+"""Stream-plane chaos: seeded stream_consumer_kill campaigns.
+
+The same Jepsen shape as ``runner.run_chaos`` / ``shard.run_shard_chaos``,
+pointed at the r17 streaming-ingestion plane: producers append JSONL
+records to FILE stream sources while ingestion runs through the
+supervised consumer loop into a WAL-enabled storage, and the nemesis
+SIGKILL-kills consumers mid-batch (``Stream.kill()`` — no graceful ack,
+no offset persistence) and restarts them cold. A concurrent reader
+polls analytics counts the whole time. The offline checker then proves:
+
+* EXACTLY-ONCE ingestion across kills — every produced record lands in
+  the graph exactly once (the transactional WAL offset record dedups
+  redelivery; zero duplicates, zero acked-batch loss);
+* ALWAYS-FRESH reads — the analytics count is monotone non-decreasing
+  and every read during the campaign succeeds (consumer churn never
+  makes committed ingest un-readable or rolls visible state back);
+* bounded post-heal liveness — the consumers drain the full backlog
+  inside the heal window.
+
+``run_stream_chaos(seed)`` is a pure function of the seed via the
+shared ``nemesis.schedule`` — a failing campaign replays exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import threading
+import time
+
+from memgraph_tpu.query import streams as S
+from memgraph_tpu.query.interpreter import Interpreter, InterpreterContext
+from memgraph_tpu.storage import InMemoryStorage, StorageConfig
+from memgraph_tpu.storage.durability.recovery import recover, wire_durability
+from memgraph_tpu.storage.kvstore import KVStore
+
+from .checker import HistoryLog
+from .cluster import wait_for
+from .nemesis import Nemesis, schedule
+
+STREAM_OPS = ("stream_consumer_kill",)
+
+_TRANSFORM = "mgchaos_stream_ingest"
+
+
+def _transform(batch):
+    return [{"query": "CREATE (:C {stream: $s, id: $id})",
+             "parameters": dict(json.loads(m.payload_str()))}
+            for m in batch]
+
+
+class StreamChaosHarness:
+    """Adapts a set of live Streams to the Nemesis cluster-hook
+    interface (targets are stream names from the seeded schedule).
+
+    A kill is ``Stream.kill()`` — the consumer dies like a SIGKILLed
+    process, mid-batch, with no graceful source ack. The restart builds
+    a FRESH ``Stream`` from the spec (crash-restart semantics: a new
+    source seeded only from the durably-recovered offsets), so every
+    kill round exercises the WAL-offset redelivery dedup for real."""
+
+    def __init__(self, ictx, specs: dict[str, S.StreamSpec],
+                 history: HistoryLog) -> None:
+        self.ictx = ictx
+        self.history = history
+        self.specs = specs
+        self.streams: dict[str, S.Stream] = {}
+        self.kills = 0
+
+    def start_all(self) -> None:
+        for name, spec in self.specs.items():
+            self.streams[name] = S.Stream(spec, self.ictx)
+            self.streams[name].start()
+
+    def stop_all(self) -> None:
+        for stream in self.streams.values():
+            stream.stop()
+
+    def stream_consumer_kill(self, target: str) -> None:
+        self.kills += 1
+        self.streams[target].kill()
+
+    def stream_consumer_restart(self, target: str) -> None:
+        fresh = S.Stream(self.specs[target], self.ictx)
+        fresh.start()
+        self.streams[target] = fresh
+
+
+class _Producer(threading.Thread):
+    """Appends JSONL records to one stream's source file, recording
+    every produced id into the history (the ground truth the checker
+    holds ingestion to)."""
+
+    def __init__(self, name: str, path: str, history: HistoryLog,
+                 interval: float = 0.03) -> None:
+        super().__init__(daemon=True)
+        self.name_ = name
+        self.path = path
+        self.history = history
+        self.interval = interval
+        self.produced = 0
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            rec = {"s": self.name_, "id": self.produced}
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+            self.history.record({"e": "produce", "stream": self.name_,
+                                 "id": self.produced})
+            self.produced += 1
+            self._halt.wait(self.interval)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+class _Reader(threading.Thread):
+    """Always-fresh probe: polls the ingested count throughout the
+    campaign. Reads must always succeed and never regress."""
+
+    def __init__(self, ictx, history: HistoryLog,
+                 interval: float = 0.1) -> None:
+        super().__init__(daemon=True)
+        self.ictx = ictx
+        self.history = history
+        self.interval = interval
+        self.reads = 0
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        interp = Interpreter(self.ictx, system=True)
+        while not self._halt.is_set():
+            try:
+                _c, rows, _s = interp.execute(
+                    "MATCH (c:C) RETURN count(c)")
+                self.history.record({"e": "read", "count": rows[0][0]})
+            except Exception as e:  # noqa: BLE001 — a failed read IS a finding
+                self.history.record({"e": "read_error",
+                                     "err": type(e).__name__})
+            self.reads += 1
+            self._halt.wait(self.interval)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def check_stream_history(history: HistoryLog, final_counts: dict,
+                         drained: bool) -> list[str]:
+    """Offline exactly-once + freshness checker over a campaign history."""
+    violations: list[str] = []
+    produced: dict[str, int] = {}
+    last_read = -1
+    for ev in history.snapshot():
+        kind = ev.get("e")
+        if kind == "produce":
+            produced[ev["stream"]] = produced.get(ev["stream"], 0) + 1
+        elif kind == "read":
+            if ev["count"] < last_read:
+                violations.append(
+                    f"stale read: count regressed {last_read} -> "
+                    f"{ev['count']} (committed ingest became invisible)")
+            last_read = ev["count"]
+        elif kind == "read_error":
+            violations.append(
+                f"read failed during consumer churn: {ev['err']}")
+    if not drained:
+        violations.append("consumers never drained the backlog "
+                          "inside the heal window")
+        return violations
+    for name, n in sorted(produced.items()):
+        got = final_counts.get(name, {})
+        dups = {i: c for i, c in got.items() if c > 1}
+        if dups:
+            violations.append(
+                f"stream {name}: DUPLICATE ingestion (exactly-once "
+                f"broken): {sorted(dups.items())[:5]}")
+        missing = [i for i in range(n) if i not in got]
+        if missing:
+            violations.append(
+                f"stream {name}: lost records after heal: "
+                f"{missing[:10]} ({len(missing)} of {n})")
+    return violations
+
+
+def run_stream_chaos(seed: int, rounds: int = 4, n_streams: int = 2,
+                     dwell: tuple[float, float] = (0.4, 0.9),
+                     recover_w: tuple[float, float] = (0.3, 0.6),
+                     heal_window: float = 30.0):
+    """One seeded stream-plane campaign; returns (history, violations,
+    stats) — the same contract as runner.run_chaos."""
+    history = HistoryLog()
+    workdir = tempfile.mkdtemp(prefix="mgchaos-stream-")
+    storage = InMemoryStorage(StorageConfig(
+        durability_dir=f"{workdir}/data", wal_enabled=True))
+    recover(storage)
+    wal = wire_durability(storage)
+    ictx = InterpreterContext(storage)
+    ictx.kvstore = KVStore(f"{workdir}/kv.db")
+    S.TRANSFORMATIONS[_TRANSFORM] = _transform
+    names = [f"s{i}" for i in range(n_streams)]
+    specs = {name: S.StreamSpec(
+        name=name, kind="file", topics=[f"{workdir}/{name}.jsonl"],
+        transform=_TRANSFORM, batch_size=4, batch_interval_sec=0.05)
+        for name in names}
+    harness = StreamChaosHarness(ictx, specs, history)
+    producers = [_Producer(name, specs[name].topics[0], history)
+                 for name in names]
+    reader = _Reader(ictx, history)
+    try:
+        harness.start_all()
+        for p in producers:
+            p.start()
+        reader.start()
+        sched = schedule(seed, names, names, rounds=rounds, dwell=dwell,
+                         recover=recover_w, ops=STREAM_OPS, streams=names)
+        Nemesis(harness, history).run(sched)
+
+        for p in producers:
+            p.stop()
+        for p in producers:
+            p.join(timeout=10)
+
+        # bounded liveness: the consumers must drain the whole backlog
+        interp = Interpreter(ictx, system=True)
+        total = sum(p.produced for p in producers)
+
+        def _ingested() -> int:
+            _c, rows, _s = interp.execute("MATCH (c:C) RETURN count(c)")
+            return rows[0][0]
+
+        heal_t0 = time.monotonic()
+        drained = wait_for(lambda: _ingested() >= total,
+                           timeout=heal_window, interval=0.2)
+        if drained:
+            history.record({"e": "converged",
+                            "seconds":
+                                round(time.monotonic() - heal_t0, 2)})
+        reader.stop()
+        reader.join(timeout=10)
+        harness.stop_all()
+
+        # final scatter: per-stream multiset of ingested ids
+        final_counts: dict[str, dict[int, int]] = {}
+        _c, rows, _s = interp.execute(
+            "MATCH (c:C) RETURN c.stream, c.id, count(*)")
+        for stream_name, rec_id, cnt in rows:
+            final_counts.setdefault(stream_name, {})[rec_id] = cnt
+        history.record({"e": "final",
+                        "counts": {k: len(v)
+                                   for k, v in final_counts.items()}})
+        violations = check_stream_history(history, final_counts, drained)
+        stats = {"seed": seed, "rounds": rounds, "produced": total,
+                 "ingested": _ingested(), "kills": harness.kills,
+                 "reads": reader.reads, "converged": drained,
+                 "violations": len(violations)}
+        return history, violations, stats
+    finally:
+        for p in producers:
+            p.stop()
+        reader.stop()
+        harness.stop_all()
+        S.TRANSFORMATIONS.pop(_TRANSFORM, None)
+        wal.close()
+        shutil.rmtree(workdir, ignore_errors=True)

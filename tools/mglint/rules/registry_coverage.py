@@ -155,9 +155,11 @@ def _check_wal_opcodes(project: Project):
 
 
 #: ops the cluster harness (not the network model) implements; they have
-#: no net_* installer by design (node churn and the r18 shard-plane
-#: ops drive ChaosCluster / ShardPlane hooks directly)
-_CLUSTER_LEVEL_OPS = {"kill_restart", "shard_move", "shard_worker_kill"}
+#: no net_* installer by design (node churn, the r18 shard-plane ops,
+#: and the r17 stream-consumer op drive ChaosCluster / ShardPlane /
+#: StreamChaosHarness hooks directly)
+_CLUSTER_LEVEL_OPS = {"kill_restart", "shard_move", "shard_worker_kill",
+                      "stream_consumer_kill"}
 
 
 def _nemesis_op_installer(op: str) -> str:

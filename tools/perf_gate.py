@@ -359,6 +359,62 @@ def check_tier(record: dict, envelopes: dict) -> int:
     return rc
 
 
+def check_stream(record: dict, envelopes: dict) -> int:
+    """r17 mgstream envelope over the record's ``extra.stream_ingest``
+    stage: the supervised FILE-stream consumer must sustain the
+    declared ingest rate, keep fresh analytics reads under the latency
+    ceiling while ingest runs, and — non-negotiably — survive the
+    mid-stream consumer kill with ZERO duplicates and zero loss
+    (``exactly_once``). The whole stage is host-side (the plane is the
+    Cypher/WAL path, not a kernel), so like the tier wire-ratio floor
+    it is deterministic and enforced on EVERY host — there is no
+    degraded escape hatch for a broken exactly-once guarantee."""
+    env = envelopes.get("stream_ingest")
+    if env is None:
+        return 0
+    stream = (record.get("extra") or {}).get("stream_ingest")
+    if stream is None:
+        log("FAIL: BASELINE.json declares a stream_ingest envelope but "
+            "the record carries no extra.stream_ingest stage — "
+            "regenerate with the current bench.py")
+        return 1
+    rc = 0
+    # correctness floors first: these are absolute, not envelopes
+    if not stream.get("exactly_once"):
+        log(f"FAIL: stream stage is not exactly-once across the "
+            f"consumer kill ({int(stream.get('duplicates', -1))} "
+            "duplicates) — the transactional-offset protocol is broken")
+        rc = 1
+    else:
+        log(f"PASS: kill+cold-restart exactly-once "
+            f"({int(stream.get('total_ingested', 0))} records, 0 dups)")
+    if not stream.get("reads_monotone", False):
+        log("FAIL: fresh reads regressed during live ingest — "
+            "committed ingestion became invisible")
+        rc = 1
+    rate_floor = float(env.get("min_records_per_sec", 500.0))
+    got = float(stream.get("records_per_sec", 0.0))
+    if got < rate_floor:
+        log(f"FAIL: sustained ingest {got:.0f} records/s < required "
+            f"{rate_floor:.0f} — the supervised consumer loop "
+            "stopped keeping up")
+        rc = 1
+    else:
+        log(f"PASS: sustained ingest {got:.0f} records/s "
+            f"(>= {rate_floor:.0f})")
+    p95_ceiling = float(env.get("max_fresh_read_p95_ms", 50.0))
+    got = float(stream.get("fresh_read_p95_ms", float("inf")))
+    if got > p95_ceiling:
+        log(f"FAIL: fresh-read p95 {got:.2f}ms under live ingest > "
+            f"ceiling {p95_ceiling:.0f}ms — analytics stopped being "
+            "always-fresh")
+        rc = 1
+    else:
+        log(f"PASS: fresh-read p95 {got:.2f}ms under live ingest "
+            f"(<= {p95_ceiling:.0f}ms)")
+    return rc
+
+
 def check_sharding(record: dict | None, envelopes: dict) -> int:
     """r18 shard-scaling envelope over the newest OLTP_r*.json record:
     the sharded point-read group must beat the single-process aggregate
@@ -512,13 +568,15 @@ def main(argv=None) -> int:
             return 1
         return (check(record, baseline)
                 or check_delta(record, baseline.get("envelopes") or {})
-                or check_tier(record, baseline.get("envelopes") or {}))
+                or check_tier(record, baseline.get("envelopes") or {})
+                or check_stream(record, baseline.get("envelopes") or {}))
 
     with open(path) as f:
         record = json.load(f)
     rc = check(record, baseline)
     rc = rc or check_delta(record, baseline.get("envelopes") or {})
     rc = rc or check_tier(record, baseline.get("envelopes") or {})
+    rc = rc or check_stream(record, baseline.get("envelopes") or {})
     if args.latest:
         # the serving-plane record rides the same --latest gate run
         ppr_path = latest_ppr_json()
