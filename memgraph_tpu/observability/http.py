@@ -130,6 +130,15 @@ async def start_monitoring_server(host: str, port: int, ictx):
                                 in global_metrics.snapshot()
                                 if name.startswith(
                                     ("stream.", "trigger."))},
+                    # device memory accounting plane (mgmem): the
+                    # admission budget vs the modeled resident peak —
+                    # the headroom capacity planning reads (local
+                    # gauges plus the daemon's mirror through health)
+                    "memory": {name: value for name, _k, value
+                               in global_metrics.snapshot()
+                               if name.startswith(
+                                   ("kernel_server.hbm_",
+                                    "kernel_server.daemon.hbm_"))},
                     # compiled Cypher read lane (r20, mglane):
                     # compile/hit/typed-fallback counters plus the
                     # per-fingerprint lane residency table

@@ -97,6 +97,7 @@ STAT_NAMES = (
     "kernel_server.dispatch_latency_sec",
     "kernel_server.in_flight",
     "kernel_server.hbm_budget_bytes",
+    "kernel_server.hbm_modeled_peak_bytes",
     "kernel_server.supervisor.health_checks_total",
     "kernel_server.supervisor.wedge_detected_total",
     "kernel_server.supervisor.restarts_total",
@@ -139,6 +140,7 @@ STAT_NAMES = (
     "tier.compressed_bytes_total",  # wire bytes actually shipped
     "tier.blocks_repacked_total",   # delta-spliced rows re-encoded
     "tier.blocks_reused_total",     # rows the splice left untouched
+    "tier.modeled_request_bytes",   # admission-estimator price of the run
     "tier.block_transfer_latency_sec",   # histogram: per-block H2D
     "tier.transfer_hidden_fraction",     # histogram: overlap efficiency
     # analytics / checkpoint plane

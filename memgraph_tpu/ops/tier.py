@@ -281,23 +281,51 @@ def plan_tier(src, dst, weights, n_nodes: int, *,
 ADMISSION_VERDICTS = ("resident", "streamed", "shed")
 
 
+#: device bytes ONE streamed edge costs at the sweep's compiled peak:
+#: the wire offsets PLUS the int32 index reconstruction and f32
+#: contribution temps the block decode materializes. The 2x-wire hand
+#: count this replaced undercounted exactly that decode expansion
+#: (pagerank sweep: 8 wire bytes, 32 at peak). wcc decodes weightless
+#: (need_w=False) and prices lower — a flat worst-case would shed wcc
+#: traffic that fits. Machine-checked within [1x, 2x] against the tier
+#: sweep kernels' footprint models by tools/mgmem.
+DECODED_EDGE_BYTES = {"pagerank": 36, "katz": 36, "wcc": 16}
+
+
 def streamed_request_bytes(n_nodes: int, n_edges: int,
                            precision: str = "f32",
-                           block_bytes: int | None = None) -> int:
+                           block_bytes: int | None = None,
+                           algorithm: str = "pagerank") -> int:
     """Working-set estimate for a STREAMED run: the O(n) device-resident
-    iteration vectors plus two in-flight block buffers — the whole point
-    being that the O(E) term is bounded by the buffer budget, not the
-    edge count."""
+    iteration vectors (over the PLAN's padded node count, not the raw
+    one) plus one resident block at its decoded sweep peak plus the next
+    block's wire payload in flight — the whole point being that the O(E)
+    term is bounded by the buffer budget, not the edge count.
+
+    Priced per the plan :func:`plan_blocks` would actually build; shard
+    skew can inflate a real plan's per-block capacity past the even
+    split priced here (documented residual, ROADMAP item 2)."""
     bb = block_bytes or block_bytes_budget()
-    wire = max(n_edges, 1) * edge_wire_bytes(precision, u16=True)
-    per_buffer = min(wire, bb)
-    vectors = (n_nodes + 1) * 4 * VECTOR_SLOTS
-    return vectors + 2 * per_buffer
+    p = plan_blocks(n_nodes, n_edges, precision, bb)
+    block = _ceil8(-(-(n_nodes + 1) // p))
+    n_pad2 = p * block
+    e_blk = _ceil8(-(-max(n_edges, 1) // p))
+    vectors = n_pad2 * 4 * VECTOR_SLOTS
+    decoded = e_blk * DECODED_EDGE_BYTES.get(str(algorithm),
+                                             DECODED_EDGE_BYTES["pagerank"])
+    wire_in_flight = e_blk * edge_wire_bytes(precision, u16=True)
+    return vectors + decoded + wire_in_flight
+
+
+def _ceil8(n: int) -> int:
+    """shard_edges' block_multiple=8 rounding, mirrored for pricing."""
+    return -(-int(n) // 8) * 8
 
 
 def admission_verdict(est_resident: int, budget: int, *, n_nodes: int,
                       n_edges: int, streamable: bool = True,
-                      precision: str = "f32") -> tuple[str, int]:
+                      precision: str = "f32",
+                      algorithm: str = "pagerank") -> tuple[str, int]:
     """resident / streamed / shed, from the estimated footprints.
 
     Returns ``(verdict, est_bytes)`` where ``est_bytes`` is the
@@ -308,7 +336,8 @@ def admission_verdict(est_resident: int, budget: int, *, n_nodes: int,
     """
     if est_resident <= budget:
         return "resident", int(est_resident)
-    est_streamed = streamed_request_bytes(n_nodes, n_edges, precision)
+    est_streamed = streamed_request_bytes(n_nodes, n_edges, precision,
+                                          algorithm=algorithm)
     if streamable and est_streamed <= budget:
         return "streamed", int(est_streamed)
     return "shed", int(est_streamed)

@@ -1087,7 +1087,11 @@ def _tier_pagerank_epilogue_build(n_pad2):
         new = pagerank_update(acc, dm, valid_f, n_f, damping)
         err = jnp.sum(jnp.abs(new - x))
         return new, err
-    return jax.jit(fin, donate_argnums=(0, 1))
+    # only ONE O(n) output exists to alias — donating both x and acc
+    # makes XLA silently COPY the second (a UserWarning at compile, a
+    # full extra iterate on a production device). tools/mgmem gates
+    # dropped donations; declare exactly the donation that lands.
+    return jax.jit(fin, donate_argnums=(0,))
 
 
 def _tier_katz_sweep_build(block, per, n_pad2, precision, u16):
@@ -1106,7 +1110,8 @@ def _tier_katz_epilogue_build(n_pad2):
         new = valid_f * (alpha * acc + beta)
         err = jnp.max(jnp.abs(new - x))
         return new, err
-    return jax.jit(fin, donate_argnums=(0, 1))
+    # one O(n) output slot: donate only the alias that lands (mgmem)
+    return jax.jit(fin, donate_argnums=(0,))
 
 
 def _tier_wcc_sweep_build(block, per, n_pad2, u16):
@@ -1134,7 +1139,8 @@ def _tier_wcc_epilogue_build(n_pad2):
         new = new[new]                        # pointer jump
         changed = jnp.any(new != comp)
         return new, changed
-    return jax.jit(fin, donate_argnums=(0, 1))
+    # one O(n) output slot: donate only the alias that lands (mgmem)
+    return jax.jit(fin, donate_argnums=(0,))
 
 
 def _put_block(hb, device):
@@ -1200,6 +1206,16 @@ def _tier_fixpoint(*, algo, tier, env_of, iterate, x0, metric0,
     """
     from .checkpoint import run_resumable
     device = streaming_device()
+    # price the run through the admission estimator the server's
+    # verdict used — every device materialization below (block H2D,
+    # carry re-place, accumulator/env vectors in the drivers) lives
+    # inside this modeled budget, which tools/mgmem machine-checks
+    # against XLA's buffer assignment per phase (MG011 accounting root)
+    global_metrics.set_gauge(
+        "tier.modeled_request_bytes",
+        float(mgtier.streamed_request_bytes(
+            tier.n_nodes, tier.n_edges, tier.precision,
+            algorithm=algo)))
     holder: dict = {}
     measured = {"serial": None, "iters": 0, "hidden_sum": 0.0,
                 "overlap_iters": 0, "overlap_wall": 0.0}

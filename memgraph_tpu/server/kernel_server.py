@@ -125,14 +125,125 @@ def _resolve_checkpoint_every() -> int:
         return 16
 
 
+# --------------------------------------------------------------------------
+# admission estimators — machine-checked by `python -m tools.mgmem check`
+# against XLA's buffer assignment for every manifest kernel
+# --------------------------------------------------------------------------
+
+def _pow2_bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two size class — mirrors ``ops.csr._bucket``, the
+    padding the placed device arrays ACTUALLY get (tools/mgmem verifies
+    the mirror stays exact)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _padded_graph_dims(n_nodes: int, n_edges: int) -> tuple[int, int]:
+    """(n_pad, e_pad) a ``from_coo`` device placement allocates for the
+    declared counts. Estimates priced on RAW counts undercount by up to
+    2x right past every bucket boundary — the compile pays for the
+    bucket, not the request."""
+    return (_pow2_bucket(int(n_nodes) + 1), _pow2_bucket(int(n_edges)))
+
+
+#: per-algorithm device footprint coefficients over the PADDED dims:
+#: ``node_bytes * n_pad + edge_bytes * e_pad`` bounds the compiled peak
+#: (XLA argument + output + temp - alias bytes) of every manifest
+#: kernel the algorithm can route to on the resident path (segment and
+#: mesh backends; the streamed tier path is priced by
+#: ``ops.tier.streamed_request_bytes``, and the MXU route is a
+#: justified mgmem baseline exclusion). The values come from the
+#: fitted footprint models and are enforced within [1x, 2x] of the
+#: modeled peak by ``python -m tools.mgmem check`` — edit under that
+#: gate, not by re-counting slots by hand.
+_ALGO_FOOTPRINT = {
+    "pagerank": (76, 36),
+    "katz": (132, 24),
+    "wcc": (132, 24),
+    "labelprop": (68, 48),
+    "bfs": (100, 20),
+    "ppr": (28, 36),
+}
+
+#: unknown algorithms are priced at the column-wise max (shed-safe)
+_ALGO_FOOTPRINT_DEFAULT = (max(n for n, _ in _ALGO_FOOTPRINT.values()),
+                           max(e for _, e in _ALGO_FOOTPRINT.values()))
+
+
+def _graph_footprint_bytes(algorithm, n_nodes: int, n_edges: int) -> int:
+    """Modeled device peak of one resident fixpoint over the padded
+    graph — the request estimate WITHOUT the wire-staging term. This is
+    the cached-generation sizing path (r16): a graph_key-only request
+    ships no bytes, but the fixpoint still pays the full padded-graph
+    footprint."""
+    node_b, edge_b = _ALGO_FOOTPRINT.get(str(algorithm),
+                                         _ALGO_FOOTPRINT_DEFAULT)
+    n_pad, e_pad = _padded_graph_dims(n_nodes, n_edges)
+    return n_pad * node_b + e_pad * edge_b
+
+
 def _estimate_request_bytes(header: dict, arrays: dict) -> int:
-    """Request HBM footprint estimate: the wire arrays land on device in
-    up to 3 forms (COO staging, CSC copy, per-edge multipliers) plus
-    ~8 O(n) float vectors of iteration state."""
-    edge_bytes = sum(int(np.prod(a.shape, dtype=np.int64))
+    """Request HBM footprint estimate: the padded-graph fixpoint peak
+    (per-algorithm coefficients from XLA's buffer assignment) plus one
+    copy of the wire arrays — the H2D staging form that briefly
+    coexists with the placed graph."""
+    wire_bytes = sum(int(np.prod(a.shape, dtype=np.int64))
                      * a.dtype.itemsize for a in arrays.values())
     n_nodes = int(header.get("n_nodes") or 0)
-    return 3 * edge_bytes + n_nodes * 4 * 8
+    src = arrays.get("src")
+    n_edges = int(src.shape[0]) if src is not None \
+        else int(header.get("n_edges") or 0)
+    return wire_bytes + _graph_footprint_bytes(
+        header.get("algorithm", "pagerank"), n_nodes, n_edges)
+
+
+def _generation_modeled_bytes(gen) -> int:
+    """Modeled device peak of one RESIDENT generation, priced at the
+    column-wise worst case across algorithms: the daemon cannot know
+    which fixpoint the next request will run over a cached graph, so
+    the capacity gauge must be shed-safe (an overestimate wastes
+    headroom; an underestimate lies to the planner)."""
+    return _graph_footprint_bytes("*", gen.n_nodes, gen.n_edges)
+
+
+#: f32 slots of per-lane, per-node iteration state the batched PPR
+#: fixpoint keeps live (x, new, acc, personalization + err scratch)
+_PPR_LANE_NODE_SLOTS = 6
+
+#: bytes per PADDED edge PER LANE: the batched SpMM gather materializes
+#: each edge's contribution once per personalization column
+_PPR_LANE_EDGE_BYTES = 6
+
+#: compile-time lane buckets — mirrors ops.pagerank._PPR_LANE_BUCKETS
+#: (tools/mgmem verifies the mirror stays exact)
+_PPR_LANE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _lane_state_bytes(n_nodes: int, n_edges: int,
+                      n_lanes: int = 1) -> int:
+    """Device bytes the batched PPR fixpoint pays for its lanes, priced
+    at the POWER-OF-TWO BUCKET the compile actually allocates: 33
+    requested lanes build the 64-wide kernel, and every lane column
+    carries O(n) state plus a per-edge gather slice."""
+    lanes = next((b for b in _PPR_LANE_BUCKETS
+                  if b >= max(1, int(n_lanes))), _PPR_LANE_BUCKETS[-1])
+    n_pad, e_pad = _padded_graph_dims(n_nodes, n_edges)
+    return lanes * (n_pad * 4 * _PPR_LANE_NODE_SLOTS
+                    + e_pad * _PPR_LANE_EDGE_BYTES)
+
+
+def _ppr_chunk_lanes(n_nodes: int, n_edges: int, budget: int) -> int:
+    """Widest lane bucket whose priced batch (graph footprint +
+    bucketed lane state) fits the budget — the chunk size the batch
+    drain admits. Falls back to single-lane chunks past the budget;
+    submit-side admission already bounded that case."""
+    graph = _graph_footprint_bytes("ppr", n_nodes, n_edges)
+    for b in reversed(_PPR_LANE_BUCKETS):
+        if graph + _lane_state_bytes(n_nodes, n_edges, b) <= budget:
+            return b
+    return 1
 
 
 def _tier_precision(precision) -> str:
@@ -508,8 +619,23 @@ class PprServingPlane:
                 warm_entry = entry
             global_metrics.increment("ppr.cache_miss_total")
 
-        est = _estimate_request_bytes(header, arrays) \
-            + self._lane_bytes(header)
+        n_nodes = int(header.get("n_nodes") or 0)
+        src = arrays.get("src")
+        n_edges = int(src.shape[0]) if src is not None else 0
+        if src is None and graph_key is not None:
+            # cached-generation sizing (r16): a graph_key-only request
+            # ships no edges, so the wire-driven estimate misses the
+            # real footprint — size admission off the resident
+            # generation's CURRENT counts (same benign unlocked peek as
+            # the supervised path)
+            gen = self.server._graphs.get(graph_key)  # mglint: disable=MG006 — benign unlocked estimate read; admission must not queue behind a dispatch holding _dispatch_lock
+            if gen is not None:
+                n_nodes = n_nodes or gen._n_nodes
+                n_edges = int(np.asarray(gen._coo[0]).shape[0])
+        est = _estimate_request_bytes(
+            {**header, "algorithm": "ppr", "n_nodes": n_nodes,
+             "n_edges": n_edges}, arrays) \
+            + _lane_state_bytes(n_nodes, n_edges, 1)
         if est > self.server.hbm_budget_bytes:
             return self._shed(
                 f"estimated footprint {est} bytes exceeds HBM budget "
@@ -548,12 +674,6 @@ class PprServingPlane:
         log.warning("ppr: SHED request — %s", why)
         return ({"ok": False, "outcome": "shed", "retryable": False,
                  "error": f"AdmissionRejected: {why}"}, None)
-
-    def _lane_bytes(self, header: dict) -> int:
-        """One personalization lane's iteration-state footprint (x, new,
-        acc, p + slack), from the declared node count."""
-        n = int(header.get("n_nodes") or 0)
-        return max(n, 1) * 4 * 6
 
     def _reply_from_vector(self, header, ranks, err, iters, *, cache,
                            batch_size, coalesced, stages=None,
@@ -785,12 +905,13 @@ class PprServingPlane:
         if not live:
             return [], []
 
-        # admission: chunk lanes so graph + B lanes fit the HBM budget
-        lane_bytes = g.n_pad * 4 * 6
-        graph_bytes = (g.e_pad * 12 + g.n_pad * 8) * 3
-        budget = max(self.server.hbm_budget_bytes - graph_bytes,
-                     lane_bytes)
-        max_lanes = max(1, min(int(budget // lane_bytes), 128))
+        # admission: chunk the batch at the widest LANE BUCKET whose
+        # priced footprint (graph + bucketed lane state) fits the HBM
+        # budget. The compile allocates the power-of-two bucket, so
+        # pricing requested lanes would undercount right past every
+        # bucket boundary (33 live members -> the 64-wide kernel)
+        max_lanes = _ppr_chunk_lanes(g.n_nodes, g.n_edges,
+                                     self.server.hbm_budget_bytes)
 
         results = []
         for lo in range(0, len(live), max_lanes):
@@ -898,15 +1019,19 @@ class KernelServer:
         self._active: dict[int, tuple[float, float | None]] = {}
         self._dispatch_seq = 0
         self._graphs_cached = 0
+        self._modeled_peaks: dict = {}  # graph_key -> modeled peak bytes
         self._started = time.monotonic()
         self._platform = "unknown"
         self._sock_ino = None        # inode of OUR bound socket path
         shared_field(self, "_graphs", "_last_activity", "_active",
-                     "_dispatch_seq", "_graphs_cached", "_platform")
+                     "_dispatch_seq", "_graphs_cached", "_platform",
+                     "_modeled_peaks")
         # saturation plane: the admission budget is a bounded resource —
         # export it so capacity planning can see utilization vs limit
         global_metrics.set_gauge("kernel_server.hbm_budget_bytes",
                                  float(self.hbm_budget_bytes))
+        global_metrics.set_gauge("kernel_server.hbm_modeled_peak_bytes",
+                                 0.0)
         # PPR serving plane: coalescing queue + result cache (r16)
         self._ppr = PprServingPlane(self)
 
@@ -1091,12 +1216,14 @@ class KernelServer:
                 if gen is not None:
                     n_nodes = n_nodes or gen._n_nodes
                     n_edges = int(np.asarray(gen._coo[0]).shape[0])
-                    est = max(est, 3 * 16 * n_edges + n_nodes * 4 * 8)
+                    est = max(est, _graph_footprint_bytes(
+                        algorithm, n_nodes, n_edges))
             verdict, est_run = mgtier.admission_verdict(
                 est, self.hbm_budget_bytes,
                 n_nodes=n_nodes, n_edges=n_edges,
                 streamable=algorithm in ("pagerank", "katz", "wcc"),
-                precision=str(header.get("precision", "f32")))
+                precision=str(header.get("precision", "f32")),
+                algorithm=algorithm)
             global_metrics.increment(f"tier.admission_{verdict}_total")
             if verdict == "streamed":
                 header["_tier_streamed"] = True
@@ -1229,6 +1356,8 @@ class KernelServer:
             entries = list(self._active.values())
             cached = self._graphs_cached
             platform = self._platform
+            shared_read(self, "_modeled_peaks")
+            peaks = dict(self._modeled_peaks)
         ages = [now - t0 for t0, _dl in entries]
         wedged = any(dl is not None and now - t0 > dl
                      for t0, dl in entries)
@@ -1244,6 +1373,18 @@ class KernelServer:
                 "wedged": wedged,
                 "graphs_cached": cached,
                 "hbm_budget_bytes": self.hbm_budget_bytes,
+                # device memory accounting (mgmem): modeled resident
+                # peak per generation (worst-case algorithm columns of
+                # the admission table, verified against XLA buffer
+                # assignment by tools/mgmem) + the headroom a new
+                # request's admission estimate competes for
+                "memory": {
+                    "hbm_budget_bytes": self.hbm_budget_bytes,
+                    "modeled_peak_bytes": sum(peaks.values()),
+                    "headroom_bytes": self.hbm_budget_bytes
+                    - sum(peaks.values()),
+                    "resident_generations": peaks,
+                },
                 "checkpoint_every": self.checkpoint_every,
                 "wedge_after_s": self.wedge_after_s,
                 "platform": platform,
@@ -1251,6 +1392,21 @@ class KernelServer:
 
     MAX_CACHED_GRAPHS = 8     # LRU cap: the daemon is long-lived and a
     #                           resident generation pins device HBM + host
+
+    def _update_memory_gauge(self) -> None:
+        """Recompute the modeled-peak gauge + the per-generation
+        snapshot _health_reply serves. Runs under the caller's
+        _dispatch_lock (the only _graphs writer); the snapshot is
+        handed over under _stats_lock so health never waits behind a
+        wedged dispatch."""
+        from ..utils.sanitize import shared_write
+        peaks = {str(key): _generation_modeled_bytes(g)
+                 for key, g in self._graphs.items()}  # mglint: disable=MG006 — under caller's _dispatch_lock (every _graphs mutation site calls this)
+        global_metrics.set_gauge("kernel_server.hbm_modeled_peak_bytes",
+                                 float(sum(peaks.values())))
+        with self._stats_lock:
+            shared_write(self, "_modeled_peaks")
+            self._modeled_peaks = peaks
 
     def _resolve_generation(self, header, arrays, place: bool = True):
         """graph_key -> resident-generation lookup shared by every
@@ -1295,12 +1451,17 @@ class KernelServer:
                     arrays.get("inc_w"), gen.n_nodes,
                     int(base), int(want))
                 applied = gen.apply(d)
+                if applied:
+                    # the spliced edge set resizes the generation's
+                    # modeled footprint even though the LRU is unchanged
+                    self._update_memory_gauge()
             if not applied:
                 # stale resident and no usable delta: a full re-import
                 # (below) is the only honest path — serving the old
                 # generation would return pre-commit results as fresh
                 self._graphs.pop(key, None)  # mglint: disable=MG006,MG007 — under caller's _dispatch_lock
                 gen = None
+                self._update_memory_gauge()
         if gen is None:
             if "src" not in arrays:
                 return None
@@ -1318,6 +1479,7 @@ class KernelServer:
                     self._graphs.pop(next(iter(self._graphs)))  # mglint: disable=MG006,MG007 — under caller's _dispatch_lock
                 global_metrics.set_gauge("delta.resident_generations",
                                          float(len(self._graphs)))  # mglint: disable=MG006 — len snapshot under caller's _dispatch_lock
+                self._update_memory_gauge()
                 with self._stats_lock:
                     shared_write(self, "_graphs_cached")
                     self._graphs_cached = len(self._graphs)  # mglint: disable=MG006 — len snapshot for health; insert path holds _dispatch_lock

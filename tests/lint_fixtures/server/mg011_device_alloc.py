@@ -1,0 +1,55 @@
+"""MG011 fixture: device allocations on the serving dispatch path.
+
+Never imported; scanned by tests/test_mglint.py. The class/method names
+mirror the real serving plane so the rule's root resolution treats this
+file exactly like server/kernel_server.py. The EXEMPTIONS table in the
+rule carries two entries keyed to this file: ``exempt_staging`` (must
+silence its allocation) and ``gone_function`` (deliberately dead — the
+unused-exemption detector must flag it at line 1).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _estimate_request_bytes(header, arrays):
+    return 64
+
+
+def admission_verdict(est, budget):
+    return est <= budget
+
+
+class KernelServer:
+    def _supervised(self, op, header, arrays):
+        est = _estimate_request_bytes(header, arrays)
+        if not admission_verdict(est, 1 << 30):
+            return None
+        return self._dispatch_op(op, header, arrays)
+
+    def _dispatch_op(self, op, header, arrays):
+        x = jax.device_put(arrays["x"])   # accounted: under the verdict
+        return _scratch(x)
+
+
+def _scratch(x):
+    return x + jnp.zeros(8, jnp.float32)  # accounted: forward closure
+
+
+class PprServingPlane:
+    def _compute(self, g, members):
+        mask = jnp.ones(16, jnp.float32)  # MG011: never estimated
+        buf = jax.device_put(np.zeros(4))  # MG011: never estimated
+        staged = exempt_staging(members)  # exemption table: silent
+        return mask, buf, staged
+
+    def _run(self, g):
+        return jax.device_put(g)  # mglint: disable=MG011 — fixture: the one deliberate unpriced placement
+
+    def cold_path(self, arr):
+        # not a serving root and not reachable from one: silent
+        return jax.device_put(arr)
+
+
+def exempt_staging(arr):
+    return jax.device_put(arr)            # silenced by EXEMPTIONS

@@ -204,14 +204,41 @@ def test_mg010_fires_on_missing_donation_only():
     assert result.suppressed_count == 1
 
 
+def test_mg011_fires_on_unaccounted_allocations_only():
+    result = _run(["tests/lint_fixtures"], only={"MG011"})
+    hits = _hits(result, "MG011")
+    assert ("mg011_device_alloc.py", 41) in hits  # jnp.ones, unpriced
+    assert ("mg011_device_alloc.py", 42) in hits  # device_put, unpriced
+    # the deliberately dead exemption entry is reported at line 1
+    assert ("mg011_device_alloc.py", 1) in hits
+    # the admission-guarded dispatch (device_put under the verdict, the
+    # forward-closure helper), the table-exempted staging, the non-root
+    # cold path and the suppressed placement all stay silent
+    assert len(hits) == 3, hits
+    assert result.suppressed_count == 1
+    dead = [f for f in result.findings
+            if f.fingerprint.startswith("unused-exemption:")]
+    assert len(dead) == 1 and "gone_function" in dead[0].fingerprint
+
+
+def test_mg011_package_serving_paths_are_accounted():
+    # the real tree must be MG011-clean WITHOUT baseline help: every
+    # serving-path allocation is either inside an estimator-routed
+    # scope or carries a justified EXEMPTIONS entry
+    result = _run(["memgraph_tpu"], only={"MG011"})
+    assert not result.findings, "\n".join(
+        f.render() for f in result.findings)
+
+
 def test_new_rules_are_registered_in_catalog():
     from tools.mglint import rules as _rules  # noqa: F401
     from tools.mglint.registry import RULES
-    for rule_id in ("MG008", "MG009", "MG010"):
+    for rule_id in ("MG008", "MG009", "MG010", "MG011"):
         assert rule_id in RULES
     assert RULES["MG008"].name == "recompile-hazard"
     assert RULES["MG009"].name == "host-sync-in-hot-path"
     assert RULES["MG010"].name == "missing-donation"
+    assert RULES["MG011"].name == "unaccounted-device-allocation"
 
 
 def test_suppression_comment_scopes_to_one_handler():

@@ -19,7 +19,7 @@ stage() {
     "$@" || { echo "gate: FAILED: $*" >&2; fail=1; }
 }
 
-# 1. static analysis: all mglint rules (MG001-MG010) over the package;
+# 1. static analysis: all mglint rules (MG001-MG011) over the package;
 #    unbaselined findings exit non-zero
 stage "mglint (static analysis)" \
     python -m tools.mglint memgraph_tpu
@@ -32,6 +32,29 @@ stage "mglint (static analysis)" \
 #     compile count. Unbaselined violations exit non-zero.
 stage "mgxla (device-plane contract checker)" \
     python -m tools.mgxla check
+
+# 1aa. mgmem: compiled-artifact HBM accounting — every manifest kernel
+#      lowered at 2-3 shape points, per-kernel linear footprint models
+#      fitted from XLA buffer assignment, donation effectiveness
+#      verified (dropped donations fail), and the kernel server's
+#      admission estimators machine-checked against the models
+#      (underestimate = hard failure, >2x overestimate needs a
+#      justified baseline entry). Exit 2 = lowering unavailable on
+#      this host: skip LOUDLY, never silently pass.
+stage_mgmem() {
+    echo
+    echo "=== gate: mgmem (compiled HBM accounting) ==="
+    python -m tools.mgmem check
+    rc=$?
+    if [ "$rc" = 2 ]; then
+        echo "gate: SKIPPED: mgmem — lowering unavailable on this host;" \
+             "NOTHING was memory-checked" >&2
+    elif [ "$rc" != 0 ]; then
+        echo "gate: FAILED: python -m tools.mgmem check" >&2
+        fail=1
+    fi
+}
+stage_mgmem
 
 # 1b. mgtrace smoke: one traced query end-to-end (parse → plan →
 #     execute → MVCC commit → mesh-routed device stages), single

@@ -10,3 +10,4 @@ from . import check_then_act  # noqa: F401
 from . import recompile_hazard  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import missing_donation  # noqa: F401
+from . import device_alloc  # noqa: F401

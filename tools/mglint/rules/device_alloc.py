@@ -1,0 +1,260 @@
+"""MG011 — unaccounted-device-allocation: device materialization on a
+serving path that never consulted an admission estimator.
+
+The kernel server admits work by ESTIMATE (`_estimate_request_bytes`,
+`_lane_state_bytes`, `ops.tier.streamed_request_bytes`, ...) and
+tools/mgmem machine-checks those estimators against XLA's buffer
+assignment. That contract only holds if every device allocation on a
+serving path actually sits inside an estimated scope: a stray
+``jax.device_put`` or eager ``jnp.zeros(...)`` in the dispatch layer is
+HBM the admission verdict never priced — exactly the drift mgmem's
+static model cannot see.
+
+Scope is the DISPATCH layer, not the compiled kernels: serving roots
+(below) plus their SAME-FILE call closure. Cross-module callees are the
+kernel layer whose footprint the mgmem per-kernel models already price;
+pulling them in would double-police accounted allocations.
+
+Within that hot set, a function is ACCOUNTED when an admission
+estimator call is reachable to or from it in the same-file call graph:
+
+  * it (or something it calls, transitively) consults an estimator —
+    the driver that prices its own run, e.g. ``_tier_fixpoint``; or
+  * it is reachable FROM an estimator-consulting function — the helpers
+    a priced dispatch invokes, e.g. ``_op_pagerank`` under
+    ``_supervised``'s verdict, ``_put_block`` under the tier driver.
+
+Allocations elsewhere fire. Deliberate exceptions go in the EXEMPTIONS
+table with a justification; an exemption whose file is in the scanned
+project but which matches no allocation is reported as UNUSED so the
+table can only shrink honestly (same discipline as the baselines).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Project, qualname_of
+from ..locking import dotted
+from ..registry import register
+
+#: (directory component, qualname suffix) serving roots — directory
+#: matching (not exact file) so the TP/TN fixtures under
+#: tests/lint_fixtures/{server,ops}/ exercise the same code path
+SERVING_ROOTS = (
+    ("server/", "KernelServer._supervised"),
+    ("server/", "KernelServer._dispatch_op"),
+    ("server/", "PprServingPlane.submit"),
+    ("server/", "PprServingPlane._run"),
+    ("server/", "PprServingPlane._execute_group"),
+    ("server/", "PprServingPlane._compute"),
+    ("parallel/", "_tier_fixpoint"),
+    ("parallel/", "pagerank_streamed"),
+    ("parallel/", "katz_streamed"),
+    ("parallel/", "wcc_streamed"),
+    ("ops/", "stage_edges"),
+)
+
+#: calls that ROUTE a scope through the admission accounting — the
+#: kernel server's estimators, the PPR lane pricer, and the tier plane's
+#: streamed estimate (tools/mgmem verifies each against the model)
+ESTIMATORS = {
+    "_estimate_request_bytes", "_graph_footprint_bytes",
+    "_lane_state_bytes", "_ppr_chunk_lanes",
+    "streamed_request_bytes", "admission_verdict",
+}
+
+#: eager device materializations: an explicit placement, or a jnp
+#: constructor outside a traced context (inside jit these fold into the
+#: compiled footprint the mgmem model already prices)
+_JNP_CTORS = {
+    "zeros", "ones", "full", "empty", "arange", "eye", "asarray",
+    "array", "zeros_like", "ones_like", "full_like", "linspace",
+}
+_JNP_MODULES = ("jnp", "jax.numpy")
+
+#: "<path suffix>::<qualname>" -> justification. Matched entries
+#: silence the allocation; entries whose file IS in the scanned project
+#: but match nothing produce an unused-exemption finding.
+EXEMPTIONS = {
+    "server/kernel_server.py::probe_device":
+        "the device probe is one fixed 128x128 warmup matmul (64 KiB + "
+        "compile scratch) that establishes platform identity BEFORE the "
+        "admission plane serves anything — a constant, not "
+        "request-scoped HBM, and freed when the probe returns",
+    "ops/pipeline.py::stage_edges":
+        "compiled-lane edge staging places the LOCAL in-process graph's "
+        "padded edge columns, bounded by the storage's own edge count — "
+        "the lane plane serves the embedded engine, not the daemon's "
+        "admission-guarded socket; residency is capped and observable "
+        "via resident_programs()/drop_programs()",
+    # fixture entries: only ever in scope when tests/lint_fixtures is
+    # the scanned project (tests/test_mglint.py), never in the gate run
+    "lint_fixtures/server/mg011_device_alloc.py::exempt_staging":
+        "fixture: exercises the exemption table match path",
+    "lint_fixtures/server/mg011_device_alloc.py::gone_function":
+        "fixture: deliberately dead entry — the unused-exemption "
+        "detector must flag it",
+}
+
+
+def _is_alloc(node: ast.Call) -> str | None:
+    """'device_put' / 'jnp.zeros' when the call materializes on device."""
+    full = dotted(node.func) or ""
+    parts = full.split(".")
+    if parts[-1] == "device_put":
+        return full or "device_put"
+    if len(parts) >= 2 and parts[-1] in _JNP_CTORS \
+            and ".".join(parts[:-1]) in _JNP_MODULES:
+        return full
+    return None
+
+
+def _file_functions(sf):
+    """Top-level-name -> fn node for one file (methods by bare name)."""
+    sf.ensure_parents()
+    out: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _callees(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = (dotted(node.func) or "").split(".")[-1]
+            if name:
+                out.add(name)
+    return out
+
+
+def _calls_estimator(fn: ast.AST) -> bool:
+    return bool(_callees(fn) & ESTIMATORS)
+
+
+def _accounted_names(index: dict[str, list[ast.AST]]) -> set[str]:
+    """Function names in this file that are routed through accounting:
+    estimator callers, everything that can REACH one through same-file
+    calls, and everything REACHABLE FROM one."""
+    edges = {name: set() for name in index}
+    for name, fns in index.items():
+        for fn in fns:
+            edges[name] |= {c for c in _callees(fn) if c in index}
+    seeded = {name for name, fns in index.items()
+              if any(_calls_estimator(fn) for fn in fns)}
+    # backward: callers of accounted functions price their dispatch
+    reach = set(seeded)
+    changed = True
+    while changed:
+        changed = False
+        for name, cs in edges.items():
+            if name not in reach and cs & reach:
+                reach.add(name)
+                changed = True
+    # forward: helpers a priced dispatch invokes run under its verdict
+    out = set(reach)
+    work = list(seeded)
+    while work:
+        for c in edges.get(work.pop(), ()):
+            if c not in out:
+                out.add(c)
+                work.append(c)
+    return out
+
+
+def _hot_set(project: Project):
+    """(rel, qualname) -> fn for roots + same-file call closure."""
+    hot: dict[tuple, ast.AST] = {}
+    for rel, sf in project.files.items():
+        index = _file_functions(sf)
+        work: list[ast.AST] = []
+        for dir_part, qn_suffix in SERVING_ROOTS:
+            if f"/{dir_part}" not in f"/{rel}":
+                continue
+            for fns in index.values():
+                for fn in fns:
+                    qn = qualname_of(fn)
+                    if qn == qn_suffix or qn.endswith("." + qn_suffix):
+                        if (rel, qn) not in hot:
+                            hot[(rel, qn)] = fn
+                            work.append(fn)
+        seen = {id(fn) for fn in work}
+        while work:
+            fn = work.pop()
+            for callee in _callees(fn):
+                for target in index.get(callee, ()):
+                    if id(target) not in seen:
+                        seen.add(id(target))
+                        hot[(rel, qualname_of(target))] = target
+                        work.append(target)
+    return hot
+
+
+def _exemption_for(rel: str, qn: str) -> str | None:
+    bare = qn.split(".")[-1]
+    for key in EXEMPTIONS:
+        path_part, _, fn_part = key.partition("::")
+        if rel.endswith(path_part) and fn_part in (qn, bare):
+            return key
+    return None
+
+
+@register("MG011", "unaccounted-device-allocation")
+def check(project: Project):
+    """Device allocations on serving paths outside estimated scopes."""
+    findings: list[Finding] = []
+    hot = _hot_set(project)
+    accounted_by_file: dict[str, set[str]] = {}
+    used_exemptions: set[str] = set()
+    for (rel, qn), fn in sorted(hot.items(),
+                                key=lambda kv: (kv[0][0], kv[0][1])):
+        acc = accounted_by_file.get(rel)
+        if acc is None:
+            acc = accounted_by_file[rel] = \
+                _accounted_names(_file_functions(project.files[rel]))
+        # nested defs are scanned inside their outer hot function and
+        # inherit ITS accounting status (env_of/iterate closures run
+        # under the driver's priced scope)
+        if qn.split(".")[-1] in acc:
+            continue
+        exempt = _exemption_for(rel, qn)
+        seen_lines: set[tuple] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            alloc = _is_alloc(node)
+            if alloc is None or (node.lineno, node.col_offset) \
+                    in seen_lines:
+                continue
+            seen_lines.add((node.lineno, node.col_offset))
+            if exempt is not None:
+                used_exemptions.add(exempt)
+                continue
+            findings.append(Finding(
+                rule="MG011", path=rel, line=node.lineno,
+                col=getattr(node, "col_offset", 0), symbol=qn,
+                message=f"{alloc}() materializes device memory inside "
+                        f"serving path {qn} without an admission "
+                        "estimate — route the scope through an "
+                        "estimator (price it, export the gauge) or "
+                        "register a justified EXEMPTIONS entry",
+                fingerprint=f"unaccounted-alloc:{alloc}@{qn}"))
+    # dead-entry detection: an exemption whose file is part of THIS
+    # scan but which silenced nothing is stale — delete it
+    for key in sorted(EXEMPTIONS):
+        if key in used_exemptions:
+            continue
+        path_part = key.partition("::")[0]
+        rel = next((r for r in project.files if r.endswith(path_part)),
+                   None)
+        if rel is None:
+            continue
+        findings.append(Finding(
+            rule="MG011", path=rel, line=1, col=0,
+            symbol=key.partition("::")[2],
+            message=f"unused MG011 exemption '{key}' — the allocation "
+                    "it justified is gone; delete the entry",
+            fingerprint=f"unused-exemption:{key}"))
+    return findings

@@ -1,0 +1,30 @@
+"""mgmem: compiled-artifact HBM accounting for the device plane.
+
+The admission guard (resident / streamed / shed, r21 mgtier) rests on
+hand-written byte estimators in ``server/kernel_server.py`` and
+``ops/tier.py``. Nobody verifies them: an underestimate OOMs a
+production device, an overestimate sheds traffic that would have fit.
+mgxla (r17) already abstractly lowers every manifest kernel — and
+XLA's post-compile buffer assignment (``compiled.memory_analysis()``:
+argument / output / temp / alias bytes) is the ground truth sitting
+one call away.
+
+mgmem closes the loop:
+
+  * :mod:`.facts` lowers every manifest kernel at 2–3 shape points
+    (reusing mgxla's builder registry via ``build_compiled``) and
+    extracts the per-kernel compiled memory facts, including donation
+    effectiveness — donated params XLA actually aliased vs silently
+    copied;
+  * :mod:`.model` fits a symbolic footprint model
+    ``peak(n_pad, n_edges)`` per kernel from those points;
+  * :mod:`.check` machine-checks the kernel server's admission
+    estimators against the model (underestimate = hard gate failure,
+    >2x overestimate = justified-baseline entry), verifies every
+    declared donation actually aliased, and enforces the per-kernel
+    peak-bytes envelopes in BASELINE.json.
+
+Run it as ``python -m tools.mgmem check`` (the dev-gate stage) — the
+same loader / justification discipline as mglint and mgxla applies to
+``tools/mgmem/baseline.json``.
+"""

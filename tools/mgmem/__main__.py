@@ -1,0 +1,26 @@
+"""``python -m tools.mgmem`` entry point.
+
+Memory facts come from the SAME forced 8-virtual-device CPU mesh the
+mgxla contract checker lowers on, so the env plumbing must happen
+BEFORE any import that could pull jax in.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# the axon site hook can pre-initialize jax onto the tunneled TPU
+# regardless of env; re-apply the cpu pin the same way the kernel-server
+# daemon does
+from memgraph_tpu.utils.jax_cache import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+from .cli import main  # noqa: E402
+
+sys.exit(main())

@@ -1,0 +1,281 @@
+"""Admission cross-checks: the serving estimators vs the fitted models.
+
+Every servable op's byte estimate must BOUND the modeled compiled peak
+of every kernel the op can route to (an underestimate OOMs a production
+device — hard gate failure) without exceeding 2x of it (an overestimate
+sheds traffic that would have fit — justified-baseline entry). The
+estimators live in ``server/kernel_server.py`` / ``ops/tier.py``; the
+models come from XLA's buffer assignment via :mod:`.model`.
+
+The checks run against an :class:`Estimators` namespace so the gate's
+own self-test can inject a deliberately-broken fixture (estimator
+halved) and assert the offending kernel + bytes surface in the report.
+
+Scope: the resident fixpoint family (segment + mesh backends), the PPR
+serving plane's bucketed lane pricing, and the streamed tier path. The
+MXU route (``route_backend``: sum-semiring, >= MXU_MIN_EDGES edges,
+non-CPU backend) compiles a Benes plan whose footprint is plan-shaped,
+not linear in (n, e) — those kernels are reported as
+``admission:unmodeled-mxu-route`` and carried as justified baseline
+entries until spmv_mxu grows a plan-size accounting hook. Lane kernels
+(``segment:lane_*``) serve the compiled Cypher lane, which stages its
+arrays at plan-build time, not per request — no admission estimator
+prices them yet (ROADMAP item 2 residual); they still get envelope +
+donation coverage like every manifest kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: (n_nodes, n_edges) raw request shapes the estimators are checked at:
+#: small serving, mid, just-past-a-power-of-two (worst padding), node-
+#: heavy, edge-heavy. Ratios are evaluated at these concrete shapes —
+#: coefficient asymptotes alone would miss constant-term effects.
+CHECK_SHAPES = ((10_000, 80_000),
+                (100_000, 1_500_000),
+                (1_048_577, 4_194_305),
+                (2_000_000, 2_000_000),
+                (500_000, 30_000_000))
+
+#: declared bound: estimate within [1x, OVERESTIMATE_FACTOR x] of the
+#: modeled peak at every check shape
+OVERESTIMATE_FACTOR = 2.0
+
+#: serving-path algorithm name -> manifest registry entry
+SERVABLE = {
+    "pagerank": "pagerank",
+    "katz": "katz",
+    "wcc": "components",
+    "labelprop": "labelprop",
+    "bfs": "bfs_layers",
+    "ppr": "personalized_pagerank",
+}
+
+#: minimal wire payload per edge a graph-shipping request carries
+#: (src int32 + dst int32 + weights f32) — the floor of the estimate's
+#: staging term, used for the overestimate bound
+WIRE_BYTES_PER_EDGE = 12
+
+#: streamed phase schedule per streamable algorithm (kernel, extra
+#: per-node f32 slots live across the phase but NOT args of its jit:
+#: sweeps keep dangling/valid/inv_wsum resident, epilogues inv_wsum)
+STREAMED_PHASES = {
+    "pagerank": (("tier:wsum", 3), ("tier:pagerank_sweep", 3),
+                 ("tier:pagerank_epilogue", 1)),
+    "katz": (("tier:katz_sweep", 3), ("tier:katz_epilogue", 1)),
+    "wcc": (("tier:wcc_sweep", 3), ("tier:wcc_epilogue", 1)),
+}
+
+#: int8 rides the pagerank sweep's quantized variant; bf16/int8 katz
+#: and wcc blocks decode through the same f32 sweep kernels
+STREAMED_INT8_PHASES = (("tier:wsum", 3), ("tier:pagerank_sweep_int8", 3),
+                        ("tier:pagerank_epilogue", 1))
+
+
+@dataclass(frozen=True)
+class Estimators:
+    """The serving estimators under check — injectable so the gate's
+    broken-fixture self-test can halve one and watch it get caught."""
+
+    graph_footprint_bytes: object    # (algorithm, n_nodes, n_edges)
+    lane_state_bytes: object         # (n_nodes, n_edges, n_lanes)
+    streamed_request_bytes: object   # (n_nodes, n_edges, precision)
+    padded_graph_dims: object        # (n_nodes, n_edges) -> (n_pad, e_pad)
+    lane_buckets: tuple              # compile-time PPR lane buckets
+
+
+def product_estimators() -> Estimators:
+    """The real serving-path estimators."""
+    from memgraph_tpu.ops import tier as T
+    from memgraph_tpu.server import kernel_server as ks
+    return Estimators(
+        graph_footprint_bytes=ks._graph_footprint_bytes,
+        lane_state_bytes=ks._lane_state_bytes,
+        streamed_request_bytes=T.streamed_request_bytes,
+        padded_graph_dims=ks._padded_graph_dims,
+        lane_buckets=ks._PPR_LANE_BUCKETS)
+
+
+def _mb(b: float) -> str:
+    return f"{b / 1e6:.1f}MB"
+
+
+def check_padding_mirror(est: Estimators, violation) -> list:
+    """The estimator's padding/bucket mirrors must track the placement
+    code exactly — a drifted mirror silently re-opens the boundary
+    underestimates this tool exists to close."""
+    from memgraph_tpu.ops.csr import _bucket
+    from memgraph_tpu.ops.pagerank import _PPR_LANE_BUCKETS
+    out = []
+    for n, e in ((0, 0), (7, 9), (63, 64), (65, 257), (10_000, 80_000),
+                 (1 << 20, (1 << 22) + 1)):
+        got = est.padded_graph_dims(n, e)
+        want = (_bucket(n + 1), _bucket(max(e, 1)))
+        if got != want:
+            out.append(violation(
+                "server:kernel_server", "padding-mirror",
+                f"_padded_graph_dims({n}, {e}) = {got} but from_coo "
+                f"places {want} — the estimator prices a different "
+                f"bucket than the device allocates"))
+    if tuple(est.lane_buckets) != tuple(_PPR_LANE_BUCKETS):
+        out.append(violation(
+            "server:kernel_server", "padding-mirror",
+            f"lane bucket mirror {tuple(est.lane_buckets)} != "
+            f"ops.pagerank._PPR_LANE_BUCKETS {tuple(_PPR_LANE_BUCKETS)}"))
+    return out
+
+
+def _resident_kernels(registry_name: str, manifest) -> tuple[list, list]:
+    """(modeled resident kernels, unmodeled mxu kernels) the resident
+    path can route a registry algorithm to."""
+    covered, mxu = [], []
+    for k, c in manifest.items():
+        if registry_name not in c.registry:
+            continue
+        if k.startswith("mxu:"):
+            mxu.append(k)
+        elif k.startswith(("segment:", "mesh:")) \
+                and ":ppr_batch:" not in k and ":lane_" not in k:
+            covered.append(k)
+    return covered, mxu
+
+
+def check_resident(models: dict, est: Estimators, violation) -> list:
+    """The per-algorithm footprint table must bound every resident
+    kernel's modeled peak within [1x, 2x] at every check shape."""
+    from tools.mgxla.manifest import MANIFEST
+    out = []
+    for algo, reg in SERVABLE.items():
+        if algo == "ppr":
+            continue                      # bucketed pricing, below
+        kernels, mxu = _resident_kernels(reg, MANIFEST)
+        for k in mxu:
+            out.append(violation(
+                k, "admission", "unmodeled-mxu-route"))
+        for n, e in CHECK_SHAPES:
+            n_pad, e_pad = est.padded_graph_dims(n, e)
+            floor = int(est.graph_footprint_bytes(algo, n, e))
+            ceiling = floor + e * WIRE_BYTES_PER_EDGE
+            peaks = {k: models[k].predict(n_pad, e_pad)
+                     for k in kernels if k in models}
+            for k, peak in peaks.items():
+                if floor < peak:
+                    out.append(violation(
+                        k, "admission-underestimate",
+                        f"{algo}@({n},{e})",
+                        f"estimate {_mb(floor)} < modeled peak "
+                        f"{_mb(peak)} at padded ({n_pad},{e_pad}) — "
+                        f"short {_mb(peak - floor)}; admitting this "
+                        f"request OOMs the device"))
+            if peaks:
+                worst = max(peaks.values())
+                if ceiling > OVERESTIMATE_FACTOR * worst:
+                    out.append(violation(
+                        max(peaks, key=peaks.get),
+                        "admission-overestimate",
+                        f"{algo}@({n},{e})",
+                        f"estimate {_mb(ceiling)} > "
+                        f"{OVERESTIMATE_FACTOR:.0f}x modeled peak "
+                        f"{_mb(worst)} — shedding traffic that fits"))
+    return out
+
+
+def check_ppr(models: dict, est: Estimators, violation) -> list:
+    """The PPR plane's price (graph footprint + bucketed lane state)
+    must bound every lane-bucket kernel's modeled peak within [1x, 2x]
+    — including the warm-start variant riding the 8-wide bucket."""
+    out = []
+    bucket_kernels = {b: f"segment:ppr_batch:b{b}"
+                      for b in est.lane_buckets}
+    extra = {8: ("segment:ppr_batch:warm8",), 1: ("segment:ppr",)}
+    for b, kernel in bucket_kernels.items():
+        targets = (kernel,) + extra.get(b, ())
+        for n, e in CHECK_SHAPES:
+            n_pad, e_pad = est.padded_graph_dims(n, e)
+            price = int(est.graph_footprint_bytes("ppr", n, e)
+                        + est.lane_state_bytes(n, e, b))
+            for t in targets:
+                if t not in models:
+                    continue
+                peak = models[t].predict(n_pad, e_pad)
+                if price < peak:
+                    out.append(violation(
+                        t, "admission-underestimate",
+                        f"ppr:b{b}@({n},{e})",
+                        f"priced chunk {_mb(price)} < modeled peak "
+                        f"{_mb(peak)} at padded ({n_pad},{e_pad}) x "
+                        f"{b} lanes — short {_mb(peak - price)}"))
+            peak = models.get(kernel)
+            if peak is not None:
+                worst = peak.predict(n_pad, e_pad)
+                if price > OVERESTIMATE_FACTOR * worst:
+                    out.append(violation(
+                        kernel, "admission-overestimate",
+                        f"ppr:b{b}@({n},{e})",
+                        f"priced chunk {_mb(price)} > "
+                        f"{OVERESTIMATE_FACTOR:.0f}x modeled peak "
+                        f"{_mb(worst)}"))
+    return out
+
+
+def check_streamed(models: dict, est: Estimators, violation) -> list:
+    """The streamed working-set estimate must bound every phase of the
+    block schedule: the active block at its DECODED sweep peak, the
+    next block's wire payload in flight, and the O(n) vectors over the
+    plan's padded node count."""
+    from memgraph_tpu.ops import tier as T
+    out = []
+    plans = []
+    for algo, phases in STREAMED_PHASES.items():
+        plans.append((algo, "f32", phases))
+    plans.append(("pagerank", "int8", STREAMED_INT8_PHASES))
+    for algo, precision, phases in plans:
+        ewb = T.edge_wire_bytes(precision, u16=True)
+        for n, e in CHECK_SHAPES:
+            p = T.plan_blocks(n, e, precision)
+            n_pad2 = p * T._ceil8(-(-(n + 1) // p))
+            e_blk = T._ceil8(-(-max(e, 1) // p))
+            est_bytes = int(est.streamed_request_bytes(
+                n, e, precision, algorithm=algo))
+            required = {}
+            for kernel, extra_slots in phases:
+                if kernel not in models:
+                    continue
+                # tier models take TOTAL edges (PER = n_edges/8 inside
+                # the builder); one block of e_blk edges prices as
+                # n_edges = 8 * e_blk
+                required[kernel] = (
+                    models[kernel].predict(n_pad2, 8 * e_blk)
+                    + extra_slots * 4 * n_pad2 + e_blk * ewb)
+            for kernel, need in required.items():
+                if est_bytes < need:
+                    out.append(violation(
+                        kernel, "admission-underestimate",
+                        f"streamed:{algo}:{precision}@({n},{e})",
+                        f"streamed estimate {_mb(est_bytes)} < phase "
+                        f"working set {_mb(need)} (plan P={p}, "
+                        f"block={e_blk} edges) — short "
+                        f"{_mb(need - est_bytes)}"))
+            if required:
+                worst = max(required.values())
+                if est_bytes > OVERESTIMATE_FACTOR * worst:
+                    out.append(violation(
+                        max(required, key=required.get),
+                        "admission-overestimate",
+                        f"streamed:{algo}:{precision}@({n},{e})",
+                        f"streamed estimate {_mb(est_bytes)} > "
+                        f"{OVERESTIMATE_FACTOR:.0f}x phase peak "
+                        f"{_mb(worst)}"))
+    return out
+
+
+def run_admission_checks(models: dict, violation,
+                         estimators: Estimators | None = None) -> list:
+    est = estimators or product_estimators()
+    out = []
+    out += check_padding_mirror(est, violation)
+    out += check_resident(models, est, violation)
+    out += check_ppr(models, est, violation)
+    out += check_streamed(models, est, violation)
+    return out

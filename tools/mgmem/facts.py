@@ -1,0 +1,154 @@
+"""Per-kernel compiled memory facts from XLA's buffer assignment.
+
+Every fact comes from the SAME artifact mgxla contract-checks: the
+abstract lowering of the real product builder, compiled on the forced
+8-virtual-device CPU mesh. ``compiled.memory_analysis()`` reports the
+buffer assignment XLA actually committed to — argument/output/temp
+bytes and the alias bytes donation actually saved — so the numbers are
+the compiler's, not a hand count.
+
+Donation effectiveness is machine-checkable here too: a donated param
+whose buffer XLA reuses shows up in ``alias_size_in_bytes`` (and as an
+``input_output_alias`` entry in the HLO); a donation XLA cannot honor
+(shape/dtype mismatch, no matching output slot) is SILENTLY dropped at
+compile time with only a UserWarning — the exact failure mode that
+turns "donated fixpoint carry" into a full extra copy of the iterate
+on a production device. We trap that warning per compile.
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass
+
+from tools.mgxla import hlo
+from tools.mgxla.checker import Dims, build_compiled
+from tools.mgxla.manifest import MANIFEST
+
+#: the forced mesh width (mesh:* stats are per-device of this many)
+N_SHARDS = 8
+
+#: canonical shape points every scalable kernel is lowered at: vary n
+#: at fixed e, then e at fixed n, so the (1, n, e) fit is exact.
+#: mxu:* kernels carry a fixed internal Benes plan — one point, and
+#: the model degrades to a constant at that shape.
+SHAPE_POINTS = (Dims(n_pad=64, n_edges=256),
+                Dims(n_pad=128, n_edges=256),
+                Dims(n_pad=128, n_edges=512))
+
+_DONATION_WARNING = "donated buffers were not usable"
+
+
+@dataclass(frozen=True)
+class MemFacts:
+    """One kernel's compiled memory facts at one shape point."""
+
+    kernel: str
+    n_pad: int
+    n_edges: int
+    lanes: int                # PPR bucket width (1 for everything else)
+    replicas: int             # mesh shards the stats are per-device of
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    alias_bytes: int          # donated bytes XLA actually aliased
+    generated_code_bytes: int  # 0 on the CPU backend; real on TPU
+    donated_aliased: int      # input_output_alias params in the HLO
+    donation_dropped: int     # declared donations XLA silently copied
+    dropped_bytes: int        # bytes of those silently-copied buffers
+
+    @property
+    def peak_bytes(self) -> int:
+        """Whole-request device high-water mark: arguments + outputs +
+        temps minus the output bytes aliased onto donated inputs,
+        times the mesh width for sharded kernels (each device holds
+        1/replicas; admission budgets the whole request)."""
+        per_device = (self.argument_bytes + self.output_bytes
+                      + self.temp_bytes - self.alias_bytes)
+        return int(per_device) * self.replicas
+
+    def as_dict(self) -> dict:
+        return {"kernel": self.kernel, "n_pad": self.n_pad,
+                "n_edges": self.n_edges, "lanes": self.lanes,
+                "replicas": self.replicas,
+                "argument_bytes": self.argument_bytes,
+                "output_bytes": self.output_bytes,
+                "temp_bytes": self.temp_bytes,
+                "alias_bytes": self.alias_bytes,
+                "generated_code_bytes": self.generated_code_bytes,
+                "donated_aliased": self.donated_aliased,
+                "donation_dropped": self.donation_dropped,
+                "dropped_bytes": self.dropped_bytes,
+                "peak_bytes": self.peak_bytes}
+
+
+def kernel_lanes(kernel: str) -> int:
+    """PPR bucket width baked into a ppr_batch kernel id (else 1)."""
+    if ":ppr_batch:" in kernel:
+        tag = kernel.rsplit(":", 1)[1]
+        return int(tag.lstrip("bwarm") or 8)
+    return 1
+
+
+def shape_points(kernel: str) -> tuple:
+    if kernel.startswith("mxu:"):
+        return (SHAPE_POINTS[0],)     # fixed plan; dims are ignored
+    return SHAPE_POINTS
+
+
+def _parse_dropped(message: str) -> tuple[int, int]:
+    """(count, bytes) of donated buffers XLA refused, from the jax
+    UserWarning text (``ShapedArray(float32[64])`` entries)."""
+    import re
+    count = 0
+    total = 0
+    sizes = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+             "uint32": 4, "bfloat16": 2, "float16": 2, "uint16": 2,
+             "int16": 2, "int8": 1, "uint8": 1, "bool": 1}
+    for dtype, shape in re.findall(r"ShapedArray\((\w+)\[([\d,\s]*)\]",
+                                   message):
+        count += 1
+        elems = 1
+        for d in shape.replace(" ", "").split(","):
+            if d:
+                elems *= int(d)
+        total += elems * sizes.get(dtype, 4)
+    return count, total
+
+
+def extract(kernel: str, dims: Dims) -> MemFacts:
+    """Lower + compile one manifest kernel at `dims`; read the buffer
+    assignment. Raises whatever the builder raises (the caller reports
+    build failures as typed violations)."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = build_compiled(kernel, dims)
+    dropped = 0
+    dropped_bytes = 0
+    for w in caught:
+        if _DONATION_WARNING in str(w.message):
+            c, b = _parse_dropped(str(w.message))
+            dropped += c
+            dropped_bytes += b
+    ma = compiled.memory_analysis()
+    donated = len(hlo.donated_params(compiled.as_text()))
+    return MemFacts(
+        kernel=kernel, n_pad=dims.n_pad, n_edges=dims.n_edges,
+        lanes=kernel_lanes(kernel),
+        replicas=N_SHARDS if kernel.startswith("mesh:") else 1,
+        argument_bytes=int(ma.argument_size_in_bytes),
+        output_bytes=int(ma.output_size_in_bytes),
+        temp_bytes=int(ma.temp_size_in_bytes),
+        alias_bytes=int(ma.alias_size_in_bytes),
+        generated_code_bytes=int(ma.generated_code_size_in_bytes),
+        donated_aliased=donated, donation_dropped=dropped,
+        dropped_bytes=dropped_bytes)
+
+
+def extract_all(kernel: str) -> list:
+    """All shape points for one kernel, in SHAPE_POINTS order."""
+    return [extract(kernel, d) for d in shape_points(kernel)]
+
+
+def manifest_kernels() -> list:
+    return sorted(MANIFEST)

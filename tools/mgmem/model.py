@@ -1,0 +1,94 @@
+"""The symbolic footprint model: peak bytes as a function of shape.
+
+Per kernel, the compiled peak is fitted as
+
+    peak(n_pad, n_edges) = const + per_node * n_pad + per_edge * n_edges
+
+from the 2–3 lowered shape points in :data:`tools.mgmem.facts
+.SHAPE_POINTS`. XLA's buffer assignment for these kernels is linear in
+the padded dims — every buffer is an O(n) vector, an O(e) edge array,
+or a scalar — so three independent points pin the coefficients
+exactly, and the fit residual doubles as a linearity check: a kernel
+whose assignment grows super-linearly (a materialized n x n
+intermediate, say) shows up as a negative/garbage coefficient or a fit
+residual and fails loudly instead of extrapolating nonsense.
+
+Lane-bucketed PPR kernels keep ``lanes`` in the KERNEL ID (one
+manifest row per bucket, exactly like the compile-budget table), so
+the per-bucket model stays linear in (n, e) and the lane dimension is
+never interpolated — the power-of-two bucket the compile actually
+allocates is priced, not the requested width.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .facts import MemFacts
+
+#: tolerated relative fit residual before a kernel is declared
+#: non-linear in its dims (violation "model-fit")
+FIT_TOLERANCE = 0.02
+
+
+@dataclass(frozen=True)
+class FootprintModel:
+    """peak(n_pad, n_edges) ~= const + per_node*n_pad + per_edge*e."""
+
+    kernel: str
+    lanes: int
+    replicas: int
+    const: float
+    per_node: float
+    per_edge: float
+    points: tuple              # ((n_pad, n_edges, peak_bytes), ...)
+    residual: float            # max relative error over the fit points
+
+    def predict(self, n_pad: int, n_edges: int) -> int:
+        return int(max(0.0, self.const + self.per_node * n_pad
+                       + self.per_edge * n_edges))
+
+    def as_dict(self) -> dict:
+        return {"kernel": self.kernel, "lanes": self.lanes,
+                "replicas": self.replicas, "const": self.const,
+                "per_node": self.per_node, "per_edge": self.per_edge,
+                "points": [list(p) for p in self.points],
+                "residual": self.residual}
+
+
+def fit(kernel: str, facts: list) -> FootprintModel:
+    """Exact linear solve from the shape points (least squares when
+    overdetermined). Negative coefficients from float noise are
+    clipped at zero; materially negative ones surface through the
+    residual and the check layer's model-fit violation."""
+    import numpy as np
+    pts = [(f.n_pad, f.n_edges, f.peak_bytes) for f in facts]
+    lanes = facts[0].lanes
+    replicas = facts[0].replicas
+    if len(pts) == 1:
+        n, e, peak = pts[0]
+        return FootprintModel(kernel, lanes, replicas, float(peak),
+                              0.0, 0.0, tuple(pts), 0.0)
+    a = np.array([[1.0, n, e] for n, e, _ in pts])
+    y = np.array([float(p) for _, _, p in pts])
+    coef, *_ = np.linalg.lstsq(a, y, rcond=None)
+    c0, cn, ce = (float(c) for c in coef)
+    # clip float-noise negatives; keep material ones for the residual
+    if -1.0 < c0 < 0.0:
+        c0 = 0.0
+    if -0.01 < cn < 0.0:
+        cn = 0.0
+    if -0.01 < ce < 0.0:
+        ce = 0.0
+    model = FootprintModel(kernel, lanes, replicas, c0, cn, ce,
+                           tuple(pts), 0.0)
+    resid = max(abs(model.predict(n, e) - p) / max(p, 1)
+                for n, e, p in pts)
+    return FootprintModel(kernel, lanes, replicas, c0, cn, ce,
+                          tuple(pts), float(resid))
+
+
+def fit_kernel(kernel: str) -> FootprintModel:
+    """Lower, extract, fit — one kernel end to end."""
+    from . import facts as F
+    return fit(kernel, F.extract_all(kernel))

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from . import hlo
@@ -40,11 +42,52 @@ from .manifest import (BACKENDS, MANIFEST, PPR_LANE_BUCKETS,
 
 #: the forced virtual mesh width every mesh contract lowers against
 N_SHARDS = 8
-#: abstract graph shapes (values never matter — nothing executes)
+#: abstract graph shapes (values never matter — nothing executes).
+#: These are module globals ON PURPOSE: builders read them at call
+#: time, so :func:`build_compiled` can rebind them per shape point and
+#: the same builder registry serves both the contract checker (one
+#: canonical point) and tools/mgmem's footprint-model fitter (several).
 N_PAD = 64
 N_EDGES = 256
 BLOCK = N_PAD // N_SHARDS
 PER = 32            # edges per shard in the partition-centric layout
+
+
+@dataclass(frozen=True)
+class Dims:
+    """One abstract lowering shape point. ``n_pad`` must be a multiple
+    of the forced mesh width (block = n_pad // N_SHARDS); ``per`` is
+    the per-shard edge capacity (defaults to n_edges / N_SHARDS)."""
+
+    n_pad: int = 64
+    n_edges: int = 256
+    per: int = 0
+
+    def __post_init__(self):
+        if self.n_pad % N_SHARDS:
+            raise ValueError(f"n_pad={self.n_pad} must be a multiple "
+                             f"of the {N_SHARDS}-wide mesh")
+        if not self.per:
+            object.__setattr__(self, "per",
+                               max(8, self.n_edges // N_SHARDS))
+
+
+DEFAULT_DIMS = Dims()
+
+_dims_lock = threading.Lock()
+
+
+@contextmanager
+def _shape_dims(dims: Dims):
+    """Rebind the module shape globals for one builder call."""
+    global N_PAD, N_EDGES, BLOCK, PER
+    old = (N_PAD, N_EDGES, BLOCK, PER)
+    N_PAD, N_EDGES, PER = dims.n_pad, dims.n_edges, dims.per
+    BLOCK = N_PAD // N_SHARDS
+    try:
+        yield
+    finally:
+        N_PAD, N_EDGES, BLOCK, PER = old
 
 
 class CheckerEnvironmentError(RuntimeError):
@@ -95,7 +138,7 @@ class CheckReport:
 
 
 # --------------------------------------------------------------------------
-# builders: kernel id -> compiled HLO text (abstract lowering only)
+# builders: kernel id -> compiled executable (abstract lowering only)
 # --------------------------------------------------------------------------
 
 BUILDERS: dict = {}
@@ -133,14 +176,30 @@ def _ctx():
     return get_mesh_context(N_SHARDS)
 
 
-def _compiled(lowered) -> str:
-    return lowered.compile().as_text()
+def _compiled(lowered):
+    """Compile an abstract lowering. Returns the COMPILED executable —
+    ``as_text()`` feeds the contract checks, ``memory_analysis()``
+    feeds tools/mgmem's footprint model; both read the same artifact."""
+    return lowered.compile()
+
+
+def build_compiled(kernel: str, dims: Dims | None = None):
+    """Compiled executable for one manifest kernel at abstract `dims`.
+
+    ``dims=None`` lowers at the canonical contract-checker shapes.
+    mxu:* kernels carry a fixed internal plan and ignore `dims`.
+    Raises KeyError for kernels without a registered builder."""
+    build = BUILDERS[kernel]
+    if dims is None or dims == DEFAULT_DIMS:
+        return build(kernel)
+    with _dims_lock, _shape_dims(dims):
+        return build(kernel)
 
 
 # ---- partition-centric mesh kernels ---------------------------------------
 
 
-def _mesh_pagerank(precision: str) -> str:
+def _mesh_pagerank(precision: str):
     from memgraph_tpu.parallel.distributed import _pc_pagerank_build
     fn = _pc_pagerank_build(_ctx(), BLOCK, N_SHARDS, precision)
     ep, vp = (N_SHARDS, PER), (N_SHARDS * BLOCK,)
@@ -237,7 +296,7 @@ def _b_tier_wsum(kernel):
     return _compiled(fn.lower(_tier_v(), _tier_block()))
 
 
-def _tier_pr_sweep(precision: str) -> str:
+def _tier_pr_sweep(precision: str):
     from memgraph_tpu.parallel.distributed import (
         _tier_pagerank_sweep_build)
     fn = _tier_pagerank_sweep_build(BLOCK, PER, N_PAD, precision, True)
@@ -303,7 +362,7 @@ def _b_tier_wcc_epi(kernel):
 
 def _segment_fixpoint(sr, *, arrays, params, x0, epilogue, setup=None,
                       step=None, metric="err", sorted=False,
-                      sorted_backward=False, direction="fwd") -> str:
+                      sorted_backward=False, direction="fwd"):
     from memgraph_tpu.ops import semiring as S
     fn = S._build_fixpoint(
         S.resolve_semiring(sr), epilogue=epilogue, setup=setup, step=step,
@@ -493,7 +552,7 @@ def _mxu_plan():
     return spmv_mxu.build_plan(src, dst, w, n)
 
 
-def _mxu_lower(run, params_sds) -> str:
+def _mxu_lower(run, params_sds):
     # make_semiring_kernel attaches the inner jitted program + the
     # device blob exactly so this checker can lower without executing
     jd, blob = run.jitted_default, run.blob
@@ -524,7 +583,7 @@ def _b_mxu_katz(kernel):
 # ---- PPR serving-plane lane buckets ---------------------------------------
 
 
-def _ppr_batch_text(bucket: int, warm: bool) -> str:
+def _ppr_batch_text(bucket: int, warm: bool):
     from memgraph_tpu.ops.pagerank import _build_ppr_batch
     fn = _build_ppr_batch(N_PAD, 8, "f32", warm)
     arrays = _edge_arrays(csr=True)
@@ -566,7 +625,7 @@ def _b_lane_agg(kernel):
         _sds((N_PAD,), "bool_"), _sds((2,), "int32")))
 
 
-def _lane_hops_text(hops: int) -> str:
+def _lane_hops_text(hops: int):
     from memgraph_tpu.ops.pipeline import _build_hops_program
     fn = _build_hops_program(hops, False, True, True, hops == 2, N_PAD)
     return _compiled(fn.lower(
@@ -639,11 +698,10 @@ def check_text(contract: KernelContract, text: str) -> list[Violation]:
 def check_kernel_by_id(kernel: str) -> list[Violation]:
     """Build + check one manifest kernel (library entry for tests)."""
     contract = MANIFEST[kernel]
-    build = BUILDERS.get(kernel)
-    if build is None:
+    if kernel not in BUILDERS:
         return [Violation(kernel, "build", "no registered builder")]
     try:
-        text = build(kernel)
+        text = build_compiled(kernel).as_text()
     except CheckerEnvironmentError:
         raise
     except Exception as e:  # noqa: BLE001 — reported as a typed violation

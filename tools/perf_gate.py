@@ -103,6 +103,67 @@ def latest_oltp_json() -> str | None:
     return records[-1] if records else None
 
 
+def latest_mem_json() -> str | None:
+    records = sorted(glob.glob(os.path.join(REPO, "MEM_r*.json")))
+    return records[-1] if records else None
+
+
+def check_memory(record: dict | None, envelopes: dict) -> int:
+    """mgmem memory-regression gate over the newest MEM_r*.json record:
+    per-kernel canonical-point peak bytes vs the BASELINE.json memory
+    envelope, plus the donation-effectiveness floor (zero silently
+    copied donations). Buffer assignment is DETERMINISTIC — the record
+    lowers on the forced CPU mesh — so unlike every perf envelope this
+    check runs with or without an accelerator: a refactor that doubles
+    a fixpoint's temp footprint or breaks a donated carry fails CI the
+    way a 15% perf regression already does."""
+    env = envelopes.get("memory")
+    if env is None:
+        return 0
+    if record is None:
+        log("FAIL: BASELINE.json declares a memory envelope but no "
+            "MEM_r*.json record exists — run `python -m tools.mgmem "
+            "check --record MEM_rN.json`")
+        return 1
+    kernels = env.get("kernels") or {}
+    max_growth = float(env.get("max_growth", 0.10))
+    got = record.get("kernels") or {}
+    rc = 0
+    worst = 1.0
+    for kernel, ref in sorted(kernels.items()):
+        entry = got.get(kernel)
+        if entry is None:
+            log(f"FAIL: memory record has no entry for {kernel} — "
+                "regenerate with the current manifest")
+            rc = 1
+            continue
+        peak = float(entry.get("peak_bytes", 0))
+        ceiling = ref * (1.0 + max_growth)
+        if peak > ceiling:
+            log(f"FAIL: {kernel} canonical peak {peak:,.0f}B grew "
+                f"{(peak / ref - 1) * 100:+.1f}% past the envelope "
+                f"{ref:,.0f}B (allowed +{max_growth * 100:.0f}%)")
+            rc = 1
+        if ref:
+            worst = max(worst, peak / ref)
+        if int(entry.get("donation_dropped", 0)) > 0:
+            log(f"FAIL: {kernel} has {entry['donation_dropped']} "
+                f"dropped donation(s) — "
+                f"{entry.get('dropped_bytes', '?')}B silently copied "
+                "instead of aliased")
+            rc = 1
+    unenveloped = sorted(set(got) - set(kernels))
+    if unenveloped:
+        log(f"FAIL: kernels without a memory envelope: {unenveloped} "
+            "— add them via `python -m tools.mgmem envelopes --write`")
+        rc = 1
+    if rc == 0:
+        log(f"PASS: memory — {len(kernels)} kernel peaks within "
+            f"+{max_growth * 100:.0f}% of envelope (worst "
+            f"{(worst - 1) * 100:+.1f}%), 0 dropped donations")
+    return rc
+
+
 def check(record: dict, baseline: dict) -> int:
     envelopes = baseline.get("envelopes") or {}
     metric = record.get("metric", "")
@@ -545,13 +606,25 @@ def main(argv=None) -> int:
     with open(args.baseline) as f:
         baseline = json.load(f)
 
+    # the memory gate is deterministic (forced CPU-mesh lowering), so
+    # it runs BEFORE the accelerator probe can skip anything
+    mem_path = latest_mem_json()
+    mem_record = None
+    if mem_path is not None:
+        log(f"checking newest memory record "
+            f"{os.path.basename(mem_path)}")
+        with open(mem_path) as f:
+            mem_record = json.load(f)
+    rc_mem = check_memory(mem_record, baseline.get("envelopes") or {})
+
     if not accelerator_present():
         log("=" * 62)
-        log("SKIPPED: no accelerator present — nothing was measured.")
+        log("SKIPPED: no accelerator present — nothing was measured")
+        log("(the deterministic memory gate above still ran).")
         log("This gate only defends the perf trajectory on real")
         log("hardware; do NOT read this skip as a pass.")
         log("=" * 62)
-        return 0
+        return rc_mem
 
     if args.json:
         path = args.json
@@ -566,14 +639,15 @@ def main(argv=None) -> int:
         if record is None:
             log("FAIL: could not obtain a bench measurement")
             return 1
-        return (check(record, baseline)
+        return (rc_mem
+                or check(record, baseline)
                 or check_delta(record, baseline.get("envelopes") or {})
                 or check_tier(record, baseline.get("envelopes") or {})
                 or check_stream(record, baseline.get("envelopes") or {}))
 
     with open(path) as f:
         record = json.load(f)
-    rc = check(record, baseline)
+    rc = rc_mem or check(record, baseline)
     rc = rc or check_delta(record, baseline.get("envelopes") or {})
     rc = rc or check_tier(record, baseline.get("envelopes") or {})
     rc = rc or check_stream(record, baseline.get("envelopes") or {})
