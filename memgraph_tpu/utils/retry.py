@@ -24,6 +24,61 @@ import random
 import time
 from typing import Callable, Iterator
 
+#: Machine-checked retry classification (mglint MG013 `unsafe-retry`).
+#:
+#: Every RetryPolicy region (an ``attempts()`` loop or a ``.call(fn)``)
+#: must be classified here, by the qualname of the operation it wraps
+#: or encloses — "retryable" means the op is idempotent so blind
+#: re-execution is safe; "unsafe" means it is not, and the region may
+#: only swallow-and-retry exception classes that are themselves
+#: registered "retryable" (pre-apply bounces). Exception-class entries
+#: marked "unsafe" are outcomes that are deterministic against the
+#: current state (oom/shed): retrying them is noise at best and a
+#: retry storm at worst, so swallowing one inside ANY retry region is
+#: a finding. An entry matched by nothing in the codebase is reported
+#: unused — the registry can only shrink honestly.
+IDEMPOTENCY = {
+    # --- operations (function qualname suffixes) -------------------------
+    # reads re-route freely: the worker bounces stale/fenced BEFORE
+    # applying anything, and a crashed read left no state behind
+    "ShardedClient.read": "retryable",
+    "ShardedClient.scatter_read": "retryable",
+    # schema DDL broadcast: CREATE INDEX / constraint DDL re-applies
+    # convergently, so a bounced shard can simply be re-driven
+    "ShardedClient.ddl": "retryable",
+    # a single-shard WRITE is not idempotent: a worker that dies after
+    # commit but before the ack leaves the outcome in doubt, and a
+    # blind re-send double-applies. Only pre-apply bounce classes
+    # (StaleShardEpoch) may be swallowed in its retry region.
+    "ShardedClient.write": "unsafe",
+    # 2PC prepare commits nothing (journal-before-vote); a fresh
+    # prepare on a respawned worker is safe by construction
+    "ShardedClient._prepare_one": "retryable",
+    # 2PC decide is idempotent via the durable pending journal: the
+    # whole point of the re-drive path
+    "ShardedClient._decide_one": "retryable",
+    # kernel requests are pure computations; the server's own
+    # idempotent flag gates the fail-fast variant inside the region
+    "SupervisedKernelClient._call_supervised": "retryable",
+    # routed Bolt writes are DELIBERATELY at-least-once across
+    # failovers (the chaos checker models duplicate acks); the mglint
+    # baseline carries the justified MG013 entries for this region
+    "RoutedClient.execute_write": "unsafe",
+    # snapshot fetch for RECOVER is a pure download + atomic rename
+    "recover_snapshot_from": "retryable",
+    # --- exception classes ----------------------------------------------
+    # pre-apply bounces: the owner refused BEFORE applying, so
+    # re-sending is safe even under non-idempotent ops
+    "StaleShardEpoch": "retryable",
+    # transient device-plane outcomes: pure ops may re-dispatch
+    "KernelDeviceError": "retryable",
+    "KernelDeadlineExceeded": "retryable",
+    # deterministic against this budget/graph — deliberately NOT
+    # retried anywhere (the "oom/shed" rule, now machine-checked)
+    "AdmissionRejected": "unsafe",
+    "KernelOom": "unsafe",
+}
+
 
 class RetryPolicy:
     """Exponential backoff: base_delay * factor^n, capped, jittered.

@@ -1,0 +1,23 @@
+"""mgflow: interprocedural exception-flow & typed-outcome contract
+checker for the serving planes.
+
+Three machine checks over the shared mglint AST/call-resolution infra:
+
+1. **Escape contracts** — per serving root declared in
+   ``memgraph_tpu/flowspec.py`` (``SERVING_ROOTS``), the escape set of
+   exception types reachable through the call graph must be covered by
+   the root's ``raises`` contract (subclasses covered by bases).
+2. **Outcome-protocol drift** — every typed outcome string a server
+   emits on a declared wire (``WIRES``) must have a client-side
+   decoder, and every decoder must decode something a server can emit.
+3. **Registry hygiene** — dead ``SERVING_ROOTS`` entries (the function
+   moved) and unused ``IDEMPOTENCY`` entries fail, so the registries
+   can only shrink honestly.
+
+Accepted violations live in ``tools/mgflow/baseline.json`` with the
+same justification-required discipline as mglint: unused entries fail.
+
+    python -m tools.mgflow check       # exit 0 clean / 1 violations /
+                                       # 2 bad invocation
+    python -m tools.mgflow list        # roots + contracts + wires
+"""

@@ -1,0 +1,155 @@
+"""mgflow command line.
+
+    python -m tools.mgflow check [paths...]   # gate: 0 clean /
+                                              # 1 violations / 2 bad
+                                              # invocation
+    python -m tools.mgflow list  [paths...]   # roots + contracts +
+                                              # wires + idempotency
+
+`check` runs the escape-contract, protocol-drift and registry-hygiene
+checks with the justification-required baseline discipline
+(tools/mgflow/baseline.json); `list` prints the declared surface so a
+reviewer can audit the contracts without reading the registry source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..mglint.core import Project, load_baseline
+from .contracts import check_contracts
+from .engine import get_escape_model
+from .protocol import check_wires
+from .retrycheck import check_retries
+from .spec import extract_specs
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mgflow",
+        description="exception-flow & typed-outcome contract checker")
+    p.add_argument("command", choices=("check", "list"))
+    p.add_argument("paths", nargs="*", default=["memgraph_tpu"],
+                   help="directories to analyze (default: memgraph_tpu)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (default: tools/mgflow/"
+                        "baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: show every finding")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON output")
+    return p
+
+
+def run_checks(project: Project):
+    """All mgflow findings for a project (MG012 + MG013 + MGF-PROTO),
+    suppression-comment filtered like run_rules."""
+    spec = extract_specs(project)
+    em = get_escape_model(project) if spec.roots else None
+    findings = []
+    findings.extend(check_contracts(project, spec, em))
+    findings.extend(check_retries(project, spec))
+    findings.extend(check_wires(project, spec))
+    kept, suppressed = [], 0
+    for f in findings:
+        sf = project.files.get(f.path)
+        if sf is not None and sf.is_suppressed(f.rule, f.line):
+            suppressed += 1
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return spec, kept, suppressed
+
+
+def _cmd_list(project: Project, as_json: bool) -> int:
+    spec = extract_specs(project)
+    if as_json:
+        doc = {
+            "roots": [{"root_id": r.root_id, "path": r.path,
+                       "qualname": r.qualname,
+                       "raises": list(r.raises), "why": r.why}
+                      for r in spec.roots],
+            "wires": [{"wire_id": w.wire_id,
+                       "declared": list(w.declared or ()),
+                       "handled_inline": list(w.handled_inline)}
+                      for w in spec.wires],
+            "idempotency": {e.name: e.classification
+                            for e in spec.idempotency},
+        }
+        print(json.dumps(doc, indent=2))
+        return 0
+    print(f"serving roots ({len(spec.roots)}):")
+    for r in spec.roots:
+        contract = ", ".join(r.raises) if r.raises else "(total)"
+        print(f"  {r.root_id:20s} {r.path}::{r.qualname}")
+        print(f"  {'':20s} raises: {contract}")
+        if r.why:
+            print(f"  {'':20s} why: {r.why}")
+    print(f"wires ({len(spec.wires)}):")
+    for w in spec.wires:
+        decl = "::".join(w.declared) if w.declared else "(emitted set)"
+        inline = ", ".join(w.handled_inline) or "-"
+        print(f"  {w.wire_id:20s} declared: {decl}  "
+              f"inline: {inline}")
+    print(f"idempotency ({len(spec.idempotency)}):")
+    for e in spec.idempotency:
+        print(f"  {e.classification:10s} {e.name}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    # intermixed: paths may follow options (`check --no-baseline dir`)
+    args = build_parser().parse_intermixed_args(argv)
+    project = Project(args.paths or ["memgraph_tpu"])
+    if not project.files:
+        print(f"mgflow: no Python files under {args.paths}",
+              file=sys.stderr)
+        return 2
+
+    if args.command == "list":
+        return _cmd_list(project, args.json)
+
+    try:
+        baseline = {} if args.no_baseline else \
+            load_baseline(args.baseline)
+    except (ValueError, OSError) as e:
+        print(f"mgflow: broken baseline: {e}", file=sys.stderr)
+        return 2
+
+    spec, findings, suppressed = run_checks(project)
+    unbaselined = [f for f in findings if f.key not in baseline]
+    baselined = [f for f in findings if f.key in baseline]
+    seen = {f.key for f in findings}
+    unused = sorted(k for k in baseline if k not in seen)
+
+    if args.json:
+        doc = {
+            "findings": [f.as_dict() for f in unbaselined],
+            "baselined": [f.as_dict() for f in baselined],
+            "suppressed": suppressed,
+            "unused_baseline": unused,
+            "parse_errors": project.errors,
+            "roots": len(spec.roots),
+            "wires": len(spec.wires),
+        }
+        print(json.dumps(doc, indent=2))
+        return 1 if (unbaselined or unused or project.errors) else 0
+
+    for err in project.errors:
+        print(f"PARSE ERROR: {err}")
+    for f in unbaselined:
+        print(f.render())
+    for key in unused:
+        print(f"unused baseline entry (remove it): {key}")
+    print(f"mgflow: {len(project.files)} files, {len(spec.roots)} "
+          f"roots, {len(spec.wires)} wires — {len(unbaselined)} "
+          f"finding(s), {len(baselined)} baselined, "
+          f"{suppressed} suppressed, {len(unused)} unused baseline "
+          "entr(ies)")
+    return 1 if (unbaselined or unused or project.errors) else 0

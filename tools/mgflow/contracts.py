@@ -1,0 +1,55 @@
+"""Escape-contract check (the MG012 core).
+
+For every ``SERVING_ROOTS`` entry declared in the scanned tree: resolve
+the root function, compute its interprocedural escape set, and report
+every token the ``raises=`` contract does not cover (subclass-aware) at
+the witness raise site. A registry entry whose function no longer
+exists is itself a finding — the registry can only shrink honestly.
+"""
+
+from __future__ import annotations
+
+from ..mglint.core import Finding, Project
+from .engine import EscapeModel, get_escape_model, resolve_root
+from .spec import FlowSpec, extract_specs
+
+
+def check_contracts(project: Project,
+                    spec: FlowSpec | None = None,
+                    em: EscapeModel | None = None) -> list[Finding]:
+    if spec is None:
+        spec = extract_specs(project)
+    if not spec.roots:
+        return []
+    if em is None:
+        em = get_escape_model(project)
+
+    findings = []
+    for root in spec.roots:
+        key = resolve_root(project, em.model, root.path, root.qualname)
+        if key is None:
+            findings.append(Finding(
+                rule="MG012", path=root.decl_rel, line=root.decl_line,
+                col=0, symbol=root.root_id,
+                message=f"serving root {root.root_id!r} "
+                        f"({root.path}::{root.qualname}) resolves to no "
+                        "function in the scanned tree — dead registry "
+                        "entry, its contract guards nothing",
+                fingerprint=f"dead-root:{root.root_id}"))
+            continue
+        rel = key.split("::", 1)[0]
+        for token, origin in sorted(em.escapes[key].items()):
+            if any(em.covered_by(token, c) for c in root.raises):
+                continue
+            contract = ", ".join(root.raises) if root.raises \
+                else "(empty: the root must be total)"
+            findings.append(Finding(
+                rule="MG012", path=origin.rel_path, line=origin.line,
+                col=0, symbol=root.root_id,
+                message=f"{token} can escape serving root "
+                        f"{root.root_id!r} ({rel}::{root.qualname}) "
+                        f"via {origin.desc} but the declared contract "
+                        f"is {contract} — handle it in the loop, add a "
+                        "typed reply, or extend the contract",
+                fingerprint=f"escape:{root.root_id}:{token}"))
+    return findings

@@ -1,0 +1,526 @@
+"""Interprocedural escape-set computation.
+
+For every function in the project, compute the set of exception *type
+tokens* that can propagate out of a call to it: explicit ``raise``
+sites plus a deliberately small curated list of known-raising stdlib
+calls, closed over the (conservative, mglint-shared) call graph, and
+narrowed by ``except`` clauses, re-raises, exception aliases
+(``except X as e: last = e`` … ``raise last``), dynamic dict-of-classes
+raises (the ``_OUTCOME_ERRORS`` pattern) and ``RetryPolicy.call(fn)``
+wrappers (treated as a call to ``fn`` — exhaustion re-raises, so no
+narrowing).
+
+Call resolution reuses ``tools.mglint.locking.LockModel`` — same-module
+functions, ``self.method``, imported symbols and project-unique method
+names; anything ambiguous contributes nothing. The result therefore
+*under*-approximates reachable raises but never invents one, while the
+except-narrowing *over*-approximates catches (a handler is assumed to
+handle unless it re-raises into scope we track). Both biases push the
+same direction: a reported escape is real enough to need a contract
+entry, and silence is not proof — which is exactly the right shape for
+a gate (no false alarms, honest about coverage).
+
+Tokens are class names ("FencedException"), dotted stdlib names that
+are not plain builtins ("struct.error"), or the sentinel "<unknown>"
+for raises we cannot resolve (dynamic, computed) — unknown escapes must
+be contracted or baselined explicitly, never ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from ..mglint.core import Project
+from ..mglint.locking import LockModel, dotted, get_model
+
+#: exceptions deriving from BaseException only — NOT caught by
+#: ``except Exception``
+BASE_ONLY = frozenset({"KeyboardInterrupt", "SystemExit", "GeneratorExit",
+                       "BaseException"})
+
+#: builtin exception hierarchy (child -> parent), enough to narrow the
+#: except clauses this codebase actually writes
+BUILTIN_BASES: dict[str, str] = {
+    "Exception": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+    "SystemExit": "BaseException",
+    "GeneratorExit": "BaseException",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "FloatingPointError": "ArithmeticError",
+    "AssertionError": "Exception",
+    "AttributeError": "Exception",
+    "BufferError": "Exception",
+    "EOFError": "Exception",
+    "ImportError": "Exception",
+    "ModuleNotFoundError": "ImportError",
+    "LookupError": "Exception",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "MemoryError": "Exception",
+    "NameError": "Exception",
+    "UnboundLocalError": "NameError",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "BlockingIOError": "OSError",
+    "ChildProcessError": "OSError",
+    "ConnectionError": "OSError",
+    "BrokenPipeError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "ConnectionResetError": "ConnectionError",
+    "FileExistsError": "OSError",
+    "FileNotFoundError": "OSError",
+    "InterruptedError": "OSError",
+    "IsADirectoryError": "OSError",
+    "NotADirectoryError": "OSError",
+    "PermissionError": "OSError",
+    "ProcessLookupError": "OSError",
+    "TimeoutError": "OSError",
+    "ReferenceError": "Exception",
+    "RuntimeError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "StopIteration": "Exception",
+    "StopAsyncIteration": "Exception",
+    "SyntaxError": "Exception",
+    "IndentationError": "SyntaxError",
+    "TypeError": "Exception",
+    "ValueError": "Exception",
+    "UnicodeError": "ValueError",
+    "UnicodeDecodeError": "UnicodeError",
+    "UnicodeEncodeError": "UnicodeError",
+    "Warning": "Exception",
+}
+
+#: curated known-raising calls. Deliberately SMALL: the point is the
+#: handful of syscall/codec entry points serving loops actually sit on,
+#: not a model of the stdlib — implicit raises (KeyError/TypeError/...)
+#: are out of scope by design or every escape set would be everything.
+KNOWN_RAISES_DOTTED: dict[str, tuple[str, ...]] = {
+    "open": ("OSError",),
+    "os.fsync": ("OSError",),
+    "os.replace": ("OSError",),
+    "os.rename": ("OSError",),
+    "os.unlink": ("OSError",),
+    "os.kill": ("OSError",),
+    "os.read": ("OSError",),
+    "os.write": ("OSError",),
+    "os.waitpid": ("ChildProcessError",),
+    "json.loads": ("ValueError",),
+    "json.dumps": ("ValueError",),
+    "pickle.loads": ("ValueError",),
+    "pickle.dumps": ("ValueError",),
+    "socket.create_connection": ("OSError",),
+    "struct.unpack": ("struct.error",),
+    "struct.pack": ("struct.error",),
+}
+KNOWN_RAISES_METHODS: dict[str, tuple[str, ...]] = {
+    "sendall": ("OSError",),
+    "recv": ("OSError",),
+    "recv_into": ("OSError",),
+    "accept": ("OSError",),
+    "makefile": ("OSError",),
+    "readexactly": ("asyncio.IncompleteReadError",
+                    "ConnectionResetError"),
+}
+
+UNKNOWN = "<unknown>"
+
+
+@dataclass(frozen=True)
+class Origin:
+    """Witness site for an escaping token: where it is raised (or which
+    known-raising call introduces it)."""
+
+    rel_path: str
+    line: int
+    desc: str
+
+
+class EscapeModel:
+    """Per-function escape summaries, computed to fixpoint."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.model: LockModel = get_model(project)
+        # class name -> base names (project classes; builtins separate)
+        self._bases: dict[str, set[str]] = {}
+        # (rel, dict name) -> exception-class tokens (module-level dicts
+        # whose values are names resolving to exception classes)
+        self._exc_dicts: dict[tuple[str, str], frozenset[str]] = {}
+        self._collect_classes()
+        self._collect_exc_dicts()
+        # func key -> {token: Origin}
+        self.escapes: dict[str, dict[str, Origin]] = {
+            key: {} for key in self.model.functions}
+        self._fixpoint()
+
+    # --- class hierarchy -------------------------------------------------
+
+    def _collect_classes(self) -> None:
+        for rel, sf in self.project.files.items():
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    bases = set()
+                    for b in node.bases:
+                        name = dotted(b)
+                        if name:
+                            bases.add(name.split(".")[-1])
+                    self._bases.setdefault(node.name, set()).update(bases)
+
+    def _ancestors(self, token: str) -> set[str]:
+        """Transitive base-class names of ``token`` (token included)."""
+        out: set[str] = set()
+        frontier = [token]
+        while frontier:
+            cur = frontier.pop()
+            if cur in out:
+                continue
+            out.add(cur)
+            frontier.extend(self._bases.get(cur, ()))
+            parent = BUILTIN_BASES.get(cur)
+            if parent:
+                frontier.append(parent)
+        return out
+
+    def is_exception_class(self, name: str) -> bool:
+        short = name.split(".")[-1]
+        if short in BUILTIN_BASES or short == "BaseException":
+            return True
+        return "BaseException" in self._ancestors(short) or \
+            "Exception" in self._ancestors(short)
+
+    def covered_by(self, token: str, catcher: str) -> bool:
+        """Does exception type ``token`` match catch/contract entry
+        ``catcher`` (i.e. is it ``catcher`` or a subclass)?"""
+        catcher = catcher.split(".")[-1] if "." not in token else catcher
+        if catcher == "BaseException":
+            return True
+        if catcher == "Exception":
+            return token not in BASE_ONLY
+        if token == UNKNOWN:
+            return False      # only broad handlers swallow the unknown
+        if token == catcher:
+            return True
+        short = token.split(".")[-1]
+        return catcher.split(".")[-1] in self._ancestors(short)
+
+    def catches(self, token: str, handler_tokens: tuple[str, ...]) -> bool:
+        if not handler_tokens:            # bare except:
+            return True
+        return any(self.covered_by(token, h) for h in handler_tokens)
+
+    # --- dynamic dict-of-classes raises ----------------------------------
+
+    def _collect_exc_dicts(self) -> None:
+        for rel, sf in self.project.files.items():
+            for stmt in sf.tree.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Dict)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                tokens = set()
+                ok = bool(stmt.value.values)
+                for v in stmt.value.values:
+                    name = dotted(v)
+                    if name and self.is_exception_class(name):
+                        tokens.add(name.split(".")[-1])
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    self._exc_dicts[(rel, stmt.targets[0].id)] = \
+                        frozenset(tokens)
+
+    # --- token extraction -------------------------------------------------
+
+    def _type_tokens(self, node: ast.AST | None) -> tuple[str, ...]:
+        """Tokens for an except-clause type expression (None = bare)."""
+        if node is None:
+            return ()
+        if isinstance(node, ast.Tuple):
+            out: list[str] = []
+            for elt in node.elts:
+                out.extend(self._type_tokens(elt))
+            return tuple(out)
+        name = dotted(node)
+        if not name:
+            return (UNKNOWN,)
+        short = name.split(".")[-1]
+        if short in BUILTIN_BASES or short == "BaseException" \
+                or short in self._bases:
+            return (short,)
+        return (name,)       # dotted non-builtin, e.g. struct.error
+
+    # --- per-function evaluation -----------------------------------------
+
+    def _eval_function(self, key: str,
+                       summaries: dict[str, dict[str, Origin]]
+                       ) -> dict[str, Origin]:
+        fi = self.model.functions[key]
+        node = fi.node
+        ctx = _EvalCtx(self, fi.rel_path, fi.class_name, summaries)
+        body = getattr(node, "body", [])
+        out = ctx.eval_body(body, caught=(), aliases={})
+        return out
+
+
+class _EvalCtx:
+    """One function-body evaluation: tracks exception aliases and the
+    caught-token stack for bare ``raise``."""
+
+    def __init__(self, em: EscapeModel, rel: str, cls: str | None,
+                 summaries: dict[str, dict[str, Origin]]):
+        self.em = em
+        self.rel = rel
+        self.cls = cls
+        self.summaries = summaries
+
+    # -- helpers ----------------------------------------------------------
+
+    def _merge(self, into: dict[str, Origin], token: str,
+               origin: Origin) -> None:
+        into.setdefault(token, origin)
+
+    def _call_escapes(self, call: ast.Call, out: dict[str, Origin]) -> None:
+        """Escapes contributed by one call expression."""
+        name = dotted(call.func)
+        line = call.lineno
+        if name in KNOWN_RAISES_DOTTED:
+            for tok in KNOWN_RAISES_DOTTED[name]:
+                self._merge(out, tok, Origin(self.rel, line,
+                                             f"call to {name}()"))
+            return
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in KNOWN_RAISES_METHODS:
+                for tok in KNOWN_RAISES_METHODS[attr]:
+                    self._merge(out, tok, Origin(self.rel, line,
+                                                 f"call to .{attr}()"))
+                return
+            # RetryPolicy.call(fn): exhaustion re-raises, so the wrapped
+            # function's escapes pass through untouched
+            if attr == "call" and call.args and \
+                    isinstance(call.args[0], (ast.Name, ast.Attribute)):
+                pseudo = ast.Call(func=call.args[0], args=[], keywords=[])
+                ast.copy_location(pseudo, call)
+                target = self.em.model._resolve_call(pseudo, self.rel,
+                                                     self.cls)
+                if target is not None:
+                    for tok, origin in self.summaries.get(
+                            target, {}).items():
+                        self._merge(out, tok, origin)
+                return
+        target = self.em.model._resolve_call(call, self.rel, self.cls)
+        if target is not None:
+            for tok, origin in self.summaries.get(target, {}).items():
+                self._merge(out, tok, origin)
+
+    def _scan_calls(self, expr: ast.AST, out: dict[str, Origin]) -> None:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue          # deferred execution
+            if isinstance(node, ast.Call):
+                self._call_escapes(node, out)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _raise_tokens(self, stmt: ast.Raise, caught: tuple,
+                      aliases: dict[str, frozenset[str]]
+                      ) -> tuple[str, ...]:
+        exc = stmt.exc
+        if exc is None:                       # bare re-raise
+            return tuple(caught[-1]) if caught else (UNKNOWN,)
+        if isinstance(exc, ast.Call):
+            fn = exc.func
+            if isinstance(fn, ast.Name) and fn.id in aliases:
+                return tuple(aliases[fn.id])  # raise cls(msg)
+            name = dotted(fn)
+            if name:
+                short = name.split(".")[-1]
+                if self.em.is_exception_class(name) or \
+                        short in self.em._bases or \
+                        short in BUILTIN_BASES:
+                    toks = self.em._type_tokens(fn)
+                    return toks
+            return (UNKNOWN,)
+        if isinstance(exc, ast.Name) and exc.id in aliases:
+            return tuple(aliases[exc.id])     # raise last
+        name = dotted(exc)
+        if name:
+            return self.em._type_tokens(exc)
+        return (UNKNOWN,)
+
+    # -- the walk ---------------------------------------------------------
+
+    def eval_body(self, body, caught: tuple,
+                  aliases: dict[str, frozenset[str]]) -> dict[str, Origin]:
+        out: dict[str, Origin] = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue          # separate scope
+            if isinstance(stmt, ast.Raise):
+                # `raise X from e`: X is what propagates
+                for tok in self._raise_tokens(stmt, caught, aliases):
+                    self._merge(out, tok, Origin(
+                        self.rel, stmt.lineno, "raise"))
+                if stmt.exc is not None:
+                    # args of X(...) may themselves call
+                    self._scan_calls(stmt.exc, out)
+                continue
+            if isinstance(stmt, ast.Try) or (
+                    hasattr(ast, "TryStar")
+                    and isinstance(stmt, getattr(ast, "TryStar"))):
+                self._eval_try(stmt, caught, aliases, out)
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._track_alias(stmt, aliases)
+            # every other statement: evaluate expressions for calls,
+            # then recurse into compound bodies with the same context
+            for _name, value in ast.iter_fields(stmt):
+                if isinstance(value, ast.expr):
+                    self._scan_calls(value, out)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._scan_calls(v, out)
+                        elif isinstance(v, ast.stmt):
+                            for tok, origin in self.eval_body(
+                                    [v], caught, aliases).items():
+                                self._merge(out, tok, origin)
+                        elif hasattr(v, "body") and \
+                                isinstance(getattr(v, "body"), list):
+                            # match_case, withitem-like carriers
+                            for tok, origin in self.eval_body(
+                                    v.body, caught, aliases).items():
+                                self._merge(out, tok, origin)
+        return out
+
+    def _track_alias(self, stmt: ast.Assign,
+                     aliases: dict[str, frozenset[str]]) -> None:
+        """`x = e` (e a known exception alias) and `cls = DICT.get(..)` /
+        `cls = DICT[..]` over a module-level dict of exception classes."""
+        if len(stmt.targets) != 1 or not isinstance(stmt.targets[0],
+                                                    ast.Name):
+            return
+        tgt = stmt.targets[0].id
+        v = stmt.value
+        if isinstance(v, ast.Name) and v.id in aliases:
+            aliases[tgt] = aliases[v.id]
+            return
+        dict_name = None
+        if isinstance(v, ast.Call) and \
+                isinstance(v.func, ast.Attribute) and \
+                v.func.attr == "get" and \
+                isinstance(v.func.value, ast.Name):
+            dict_name = v.func.value.id
+        elif isinstance(v, ast.Subscript) and \
+                isinstance(v.value, ast.Name):
+            dict_name = v.value.id
+        if dict_name is not None:
+            toks = self.em._exc_dicts.get((self.rel, dict_name))
+            if toks:
+                aliases[tgt] = toks
+
+    def _eval_try(self, stmt, caught: tuple,
+                  aliases: dict[str, frozenset[str]],
+                  out: dict[str, Origin]) -> None:
+        # `try: ... finally: os._exit(...)` is a process-exit barrier
+        # (the fork-child idiom): nothing propagates past it into the
+        # enclosing (parent-side) control flow.
+        if _finally_exits(stmt.finalbody):
+            for tok, origin in self.eval_body(stmt.finalbody, caught,
+                                              aliases).items():
+                self._merge(out, tok, origin)
+            return
+        body_esc = self.eval_body(stmt.body, caught, aliases)
+        remaining = dict(body_esc)
+        for handler in stmt.handlers:
+            h_tokens = self.em._type_tokens(handler.type)
+            matched = {tok: origin for tok, origin in remaining.items()
+                       if self.em.catches(tok, h_tokens)}
+            for tok in matched:
+                remaining.pop(tok, None)
+            # what a bare `raise` in this handler re-raises: the
+            # matched subset when we saw it, else the static spec
+            caught_now = frozenset(matched) if matched else \
+                frozenset(t for t in h_tokens if t != UNKNOWN)
+            h_aliases = dict(aliases)
+            if handler.name:
+                h_aliases[handler.name] = caught_now or \
+                    frozenset((UNKNOWN,))
+            h_esc = self.eval_body(handler.body,
+                                   caught + (caught_now,), h_aliases)
+            # alias bindings made in the handler (last = e) must
+            # survive for raises AFTER the try block
+            for k, v in h_aliases.items():
+                if k != handler.name:
+                    aliases.setdefault(k, v)
+            for tok, origin in h_esc.items():
+                self._merge(out, tok, origin)
+        for tok, origin in remaining.items():
+            self._merge(out, tok, origin)
+        # orelse runs only when the body did not raise; its escapes do
+        # NOT pass through the handlers. finally always runs.
+        for part in (stmt.orelse, stmt.finalbody):
+            for tok, origin in self.eval_body(part, caught,
+                                              aliases).items():
+                self._merge(out, tok, origin)
+
+
+def _finally_exits(finalbody) -> bool:
+    for stmt in finalbody:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    dotted(node.func) in ("os._exit", "_exit"):
+                return True
+    return False
+
+
+def _fixpoint_escapes(em: EscapeModel) -> None:
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for key in em.model.functions:
+            new = em._eval_function(key, em.escapes)
+            old = em.escapes[key]
+            if set(new) - set(old):
+                merged = dict(old)
+                for tok, origin in new.items():
+                    merged.setdefault(tok, origin)
+                em.escapes[key] = merged
+                changed = True
+
+
+# bind late so the class body stays readable
+EscapeModel._fixpoint = _fixpoint_escapes
+
+
+def get_escape_model(project: Project) -> EscapeModel:
+    """Escape model for a project, computed once and cached — the
+    mglint MG012 rule and the mgflow CLI share one fixpoint run."""
+    em = getattr(project, "_mgflow_escape_model", None)
+    if em is None:
+        em = EscapeModel(project)
+        project._mgflow_escape_model = em
+    return em
+
+
+def resolve_root(project: Project, model: LockModel, path_suffix: str,
+                 qualname: str) -> str | None:
+    """Function key for a (path suffix, qualname) registry entry, or
+    None when the entry is dead (file or function moved)."""
+    for rel in project.files:
+        if rel.endswith(path_suffix):
+            key = f"{rel}::{qualname}"
+            if key in model.functions:
+                return key
+    return None

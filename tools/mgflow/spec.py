@@ -1,0 +1,196 @@
+"""AST extraction of the flow registries from a linted project.
+
+The analyzers never import product code: ``SERVING_ROOTS`` / ``WIRES``
+(memgraph_tpu/flowspec.py) and ``IDEMPOTENCY`` (utils/retry.py) are
+read back out of the scanned ASTs, the same way MG005 reads
+``KNOWN_POINTS``. That keeps the tools runnable on a tree that does not
+import (the whole point of a lint gate) and lets lint fixtures declare
+their own miniature registries next to the code under test.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..mglint.core import Project
+
+
+@dataclass(frozen=True)
+class RootSpec:
+    root_id: str
+    path: str
+    qualname: str
+    raises: tuple
+    why: str
+    decl_rel: str
+    decl_line: int
+
+
+@dataclass(frozen=True)
+class WireSideSpec:
+    path: str
+    scope: tuple
+    extract: tuple
+
+
+@dataclass(frozen=True)
+class WireSpec:
+    wire_id: str
+    server: tuple
+    client: tuple
+    declared: tuple | None
+    handled_inline: tuple
+    decl_rel: str
+    decl_line: int
+
+
+@dataclass(frozen=True)
+class IdemEntry:
+    name: str
+    classification: str          # "retryable" | "unsafe"
+    decl_rel: str
+    decl_line: int
+
+
+@dataclass
+class FlowSpec:
+    roots: list = field(default_factory=list)       # [RootSpec]
+    wires: list = field(default_factory=list)       # [WireSpec]
+    idempotency: list = field(default_factory=list)  # [IdemEntry]
+
+    @property
+    def idem_by_name(self) -> dict:
+        return {e.name: e for e in self.idempotency}
+
+
+def _const(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _call_kwargs(call: ast.Call, fields: tuple) -> dict:
+    """Positional + keyword args of a dataclass-style literal call,
+    resolved against the declared field order. Non-literal values come
+    back as the raw AST node."""
+    out = {}
+    for i, arg in enumerate(call.args):
+        if i < len(fields):
+            out[fields[i]] = arg
+    for kw in call.keywords:
+        if kw.arg:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+_ROOT_FIELDS = ("root_id", "path", "qualname", "raises", "why")
+_SIDE_FIELDS = ("path", "scope", "extract")
+_WIRE_FIELDS = ("wire_id", "server", "client", "declared",
+                "handled_inline")
+
+
+def _extract_root(call: ast.Call, rel: str) -> RootSpec | None:
+    kw = _call_kwargs(call, _ROOT_FIELDS)
+    root_id = _const(kw.get("root_id"))
+    path = _const(kw.get("path"))
+    qualname = _const(kw.get("qualname"))
+    if not (isinstance(root_id, str) and isinstance(path, str)
+            and isinstance(qualname, str)):
+        return None
+    raises = _const(kw.get("raises")) if "raises" in kw else ()
+    why = _const(kw.get("why")) if "why" in kw else ""
+    return RootSpec(root_id=root_id, path=path, qualname=qualname,
+                    raises=tuple(raises or ()),
+                    why=why if isinstance(why, str) else "",
+                    decl_rel=rel, decl_line=call.lineno)
+
+
+def _extract_side(node) -> WireSideSpec | None:
+    if not isinstance(node, ast.Call) or \
+            _call_name(node) != "WireSide":
+        return None
+    kw = _call_kwargs(node, _SIDE_FIELDS)
+    path = _const(kw.get("path"))
+    if not isinstance(path, str):
+        return None
+    scope = _const(kw.get("scope")) if "scope" in kw else ()
+    extract = _const(kw.get("extract")) if "extract" in kw else ()
+    return WireSideSpec(path=path, scope=tuple(scope or ()),
+                        extract=tuple(tuple(d) for d in (extract or ())))
+
+
+def _extract_wire(call: ast.Call, rel: str) -> WireSpec | None:
+    kw = _call_kwargs(call, _WIRE_FIELDS)
+    wire_id = _const(kw.get("wire_id"))
+    if not isinstance(wire_id, str):
+        return None
+
+    def sides(node):
+        out = []
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                side = _extract_side(el)
+                if side is not None:
+                    out.append(side)
+        return tuple(out)
+
+    declared = _const(kw.get("declared")) if "declared" in kw else None
+    inline = _const(kw.get("handled_inline")) \
+        if "handled_inline" in kw else ()
+    return WireSpec(wire_id=wire_id,
+                    server=sides(kw.get("server")),
+                    client=sides(kw.get("client")),
+                    declared=tuple(declared) if declared else None,
+                    handled_inline=tuple(inline or ()),
+                    decl_rel=rel, decl_line=call.lineno)
+
+
+def extract_specs(project: Project) -> FlowSpec:
+    """Pull every registry declaration out of the scanned tree."""
+    spec = FlowSpec()
+    for rel, sf in sorted(project.files.items()):
+        for stmt in sf.tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            name = stmt.targets[0].id
+            if name == "SERVING_ROOTS" and \
+                    isinstance(stmt.value, (ast.Tuple, ast.List)):
+                for el in stmt.value.elts:
+                    if isinstance(el, ast.Call) and \
+                            _call_name(el) == "ServingRoot":
+                        root = _extract_root(el, rel)
+                        if root is not None:
+                            spec.roots.append(root)
+            elif name == "WIRES" and \
+                    isinstance(stmt.value, (ast.Tuple, ast.List)):
+                for el in stmt.value.elts:
+                    if isinstance(el, ast.Call) and \
+                            _call_name(el) == "Wire":
+                        wire = _extract_wire(el, rel)
+                        if wire is not None:
+                            spec.wires.append(wire)
+            elif name == "IDEMPOTENCY" and \
+                    isinstance(stmt.value, ast.Dict):
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    key = _const(k)
+                    val = _const(v)
+                    if isinstance(key, str) and isinstance(val, str):
+                        spec.idempotency.append(IdemEntry(
+                            name=key, classification=val,
+                            decl_rel=rel,
+                            decl_line=getattr(k, "lineno",
+                                              stmt.lineno)))
+    return spec
