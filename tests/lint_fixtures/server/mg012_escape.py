@@ -1,0 +1,63 @@
+"""MG012 fixture: a serving-loop with a partial escape contract.
+
+``serve_loop`` declares ``raises=("AppError",)`` but lets two other
+types escape: ``ValueError`` through the ``_decode`` helper (known-
+raising ``json.loads``) and the project class ``CrashError`` at an
+explicit raise — both must fire AT THOSE WITNESS LINES. The decoy loop
+catches broadly and stays silent, and the third registry entry names a
+function that does not exist (dead-root finding at the entry itself).
+"""
+
+import json
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class ServingRoot:
+    """Stand-in so the fixture parses without importing product code —
+    the analyzer reads the registry from the AST, never imports it."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+
+
+class AppError(Exception):
+    pass
+
+
+class CrashError(Exception):
+    pass
+
+
+SERVING_ROOTS = (
+    ServingRoot(root_id="fixture.serve", path="server/mg012_escape.py",
+                qualname="serve_loop", raises=("AppError",)),
+    ServingRoot(root_id="fixture.total", path="server/mg012_escape.py",
+                qualname="decoy_total_loop", raises=()),
+    ServingRoot(root_id="fixture.dead", path="server/mg012_escape.py",
+                qualname="gone_function", raises=()),
+)
+
+
+def _decode(payload):
+    return json.loads(payload)          # ValueError witness line
+
+
+def serve_loop(source):
+    while True:
+        payload = source.next_payload()
+        try:
+            msg = _decode(payload)
+        except AppError:
+            continue                    # declared: narrowing is fine
+        if msg is None:
+            raise CrashError("empty")   # undeclared-raise witness line
+
+
+def decoy_total_loop(source):
+    while True:
+        try:
+            _decode(source.next_payload())
+        except Exception as e:          # total loop: nothing escapes
+            log.warning("dropped: %s", e)

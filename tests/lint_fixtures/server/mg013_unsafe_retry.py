@@ -1,0 +1,68 @@
+"""MG013 fixture: retry regions against a miniature IDEMPOTENCY
+registry.
+
+``Client.send_write`` is registered ``unsafe`` yet its attempts-loop
+swallows ``TransportError`` (not registered retryable) — blind-retry
+finding at the handler. It also swallows ``ShedError``, registered
+``unsafe`` — retry-unsafe-class finding (the oom/shed rule).
+``Client.unregistered_spin`` matches no registry entry — unclassified
+finding at the loop. The registry's ``Client.ghost_op`` entry matches
+no region — dead-registration finding at the entry. The retryable
+``Client.fetch`` loop that swallows only the registered-retryable
+``BounceError`` stays silent.
+"""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+IDEMPOTENCY = {
+    "Client.send_write": "unsafe",
+    "Client.fetch": "retryable",
+    "Client.ghost_op": "retryable",
+    "ShedError": "unsafe",
+    "BounceError": "retryable",
+}
+
+
+class ShedError(Exception):
+    pass
+
+
+class BounceError(Exception):
+    pass
+
+
+class TransportError(Exception):
+    pass
+
+
+class Client:
+    def __init__(self, retry_policy):
+        self.retry_policy = retry_policy
+
+    def send_write(self, payload):          # registered 'unsafe'
+        for _attempt in self.retry_policy.attempts():
+            try:
+                return self._ship(payload)
+            except TransportError as e:     # blind-retry witness line
+                log.warning("resend after %s", e)
+            except ShedError as e:          # retry-unsafe-class witness
+                log.warning("resend after shed %s", e)
+
+    def fetch(self, key):                   # registered 'retryable'
+        for _attempt in self.retry_policy.attempts():
+            try:
+                return self._ship(key)
+            except BounceError as e:        # retryable class: silent
+                log.warning("bounced: %s", e)
+
+    def unregistered_spin(self, key):
+        for _attempt in self.retry_policy.attempts():   # unclassified
+            try:
+                return self._ship(key)
+            except BounceError as e:
+                log.warning("bounced: %s", e)
+
+    def _ship(self, payload):
+        raise TransportError(str(payload))

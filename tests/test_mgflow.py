@@ -1,0 +1,456 @@
+"""mgflow: escape-engine units on synthetic trees, both-direction
+protocol drift, retry classification, registry extraction from the real
+tree, and the CLI gate (exit codes + baseline discipline).
+
+The fixture-file TP/TN tests for the mglint rule surface (MG012/MG013
+at exact lines) live in tests/test_mglint.py; this file exercises the
+analysis engine itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools.mgflow.contracts import check_contracts  # noqa: E402
+from tools.mgflow.engine import (EscapeModel, UNKNOWN,  # noqa: E402
+                                 get_escape_model)
+from tools.mgflow.protocol import check_wires  # noqa: E402
+from tools.mgflow.retrycheck import check_retries  # noqa: E402
+from tools.mgflow.spec import extract_specs  # noqa: E402
+from tools.mglint.core import Project  # noqa: E402
+
+
+def _proj(tmp_path, **files):
+    for name, src in files.items():
+        p = tmp_path / name.replace("__", "/")
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Project([str(tmp_path)], cwd=str(tmp_path))
+
+
+def _escapes(tmp_path, src, qual):
+    em = EscapeModel(_proj(tmp_path, **{"m.py": src}))
+    return set(em.escapes[f"m.py::{qual}"])
+
+
+# --- escape engine units ----------------------------------------------------
+
+
+def test_direct_raise_escapes(tmp_path):
+    assert _escapes(tmp_path, """
+        def f():
+            raise ValueError("x")
+        """, "f") == {"ValueError"}
+
+
+def test_interprocedural_propagation(tmp_path):
+    assert _escapes(tmp_path, """
+        def helper():
+            raise KeyError("x")
+
+        def outer():
+            return helper()
+        """, "outer") == {"KeyError"}
+
+
+def test_except_narrows_and_subclasses_are_covered(tmp_path):
+    src = """
+        def helper():
+            raise ConnectionResetError("gone")
+
+        def caught():
+            try:
+                helper()
+            except OSError:
+                return None
+
+        def uncaught():
+            try:
+                helper()
+            except KeyError:
+                return None
+        """
+    assert _escapes(tmp_path, src, "caught") == set()
+    # ConnectionResetError is not a KeyError: it keeps escaping
+    assert _escapes(tmp_path, src, "uncaught") == \
+        {"ConnectionResetError"}
+
+
+def test_except_exception_misses_base_only(tmp_path):
+    assert _escapes(tmp_path, """
+        def f():
+            try:
+                raise KeyboardInterrupt()
+            except Exception:
+                pass
+        """, "f") == {"KeyboardInterrupt"}
+
+
+def test_bare_reraise_and_alias_survive(tmp_path):
+    src = """
+        def reraiser():
+            try:
+                open("x")
+            except OSError:
+                raise
+
+        def aliaser():
+            last = None
+            try:
+                open("x")
+            except OSError as e:
+                last = e
+            if last is not None:
+                raise last
+        """
+    assert _escapes(tmp_path, src, "reraiser") == {"OSError"}
+    assert _escapes(tmp_path, src, "aliaser") == {"OSError"}
+
+
+def test_known_raising_stdlib_calls(tmp_path):
+    assert _escapes(tmp_path, """
+        import json
+        import struct
+
+        def f(payload):
+            n = struct.unpack("<I", payload[:4])
+            return n, json.loads(payload[4:])
+        """, "f") == {"struct.error", "ValueError"}
+
+
+def test_retrypolicy_call_passes_wrapped_escapes_through(tmp_path):
+    assert _escapes(tmp_path, """
+        def do_io():
+            raise ValueError("bad frame")
+
+        def f(policy):
+            return policy.call(do_io)
+        """, "f") == {"ValueError"}
+
+
+def test_os_exit_finally_is_a_process_barrier(tmp_path):
+    # the fork-child idiom: nothing propagates past os._exit
+    assert _escapes(tmp_path, """
+        import os
+
+        def child_main():
+            raise ValueError("child-side only")
+
+        def spawn():
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    child_main()
+                finally:
+                    os._exit(0)
+            return pid
+        """, "spawn") == set()
+
+
+def test_dynamic_raise_is_unknown_not_silent(tmp_path):
+    esc = _escapes(tmp_path, """
+        def f(make_error):
+            raise make_error()
+        """, "f")
+    assert esc == {UNKNOWN}
+
+
+def test_dict_of_classes_raise_resolves_members(tmp_path):
+    assert _escapes(tmp_path, """
+        ERRORS = {"a": KeyError, "b": ValueError}
+
+        def f(kind):
+            cls = ERRORS.get(kind, ValueError)
+            raise cls(kind)
+        """, "f") == {"KeyError", "ValueError"}
+
+
+def test_covered_by_walks_project_hierarchy(tmp_path):
+    em = EscapeModel(_proj(tmp_path, **{"m.py": """
+        class Base(Exception):
+            pass
+
+        class Leaf(Base):
+            pass
+        """}))
+    assert em.covered_by("Leaf", "Base")
+    assert em.covered_by("Leaf", "Exception")
+    assert not em.covered_by("Base", "Leaf")
+
+
+# --- contract check on a synthetic registry ---------------------------------
+
+
+_CONTRACT_TREE = """
+    class ServingRoot:
+        def __init__(self, **kw):
+            pass
+
+    class Base(Exception):
+        pass
+
+    class Leaf(Base):
+        pass
+
+    SERVING_ROOTS = (
+        ServingRoot(root_id="t.ok", path="m.py", qualname="covered",
+                    raises=("Base",)),
+        ServingRoot(root_id="t.bad", path="m.py", qualname="leaky",
+                    raises=("Base",)),
+        ServingRoot(root_id="t.gone", path="m.py", qualname="missing",
+                    raises=()),
+    )
+
+    def covered(x):
+        raise Leaf(x)       # subclass of the contracted Base: fine
+
+    def leaky(x):
+        raise KeyError(x)   # outside the contract
+    """
+
+
+def test_contract_subclasses_covered_and_dead_roots_flagged(tmp_path):
+    proj = _proj(tmp_path, **{"m.py": _CONTRACT_TREE})
+    prints = {f.fingerprint for f in check_contracts(proj)}
+    assert prints == {"escape:t.bad:KeyError", "dead-root:t.gone"}
+
+
+# --- protocol drift (both directions) on a synthetic wire -------------------
+
+
+_WIRE_TREE = {
+    "flow.py": """
+        class Wire:
+            def __init__(self, **kw):
+                pass
+
+        class WireSide:
+            def __init__(self, **kw):
+                pass
+
+        WIRES = (
+            Wire(wire_id="t",
+                 server=(WireSide(path="srv.py", scope=("reply",),
+                                  extract=(("dict_value", "outcome"),)),),
+                 client=(WireSide(path="cli.py", scope=("decode",),
+                                  extract=(("compare", "outcome"),)),),
+                 declared=("srv.py", "OUTCOMES"),
+                 handled_inline=("done",)),
+        )
+        """,
+    "srv.py": """
+        OUTCOMES = ("done", "lost", "shed")
+
+        def reply(ok):
+            if ok:
+                return {"outcome": "done"}
+            return {"outcome": "bogus"}     # not declared -> drift
+        """,
+    "cli.py": """
+        def decode(reply):
+            outcome = reply["outcome"]
+            if outcome == "shed":
+                raise RuntimeError("shed")
+            if outcome == "ghost":          # no server emits this
+                raise RuntimeError("ghost")
+            return reply
+        """,
+}
+
+
+def test_wire_drift_fires_in_both_directions(tmp_path):
+    proj = _proj(tmp_path, **_WIRE_TREE)
+    prints = {f.fingerprint for f in check_wires(proj)}
+    # server -> client: undeclared emit, and a declared outcome with no
+    # decoder; client -> server: a decoder no server feeds
+    assert "undeclared-emit:t:bogus" in prints
+    assert "undecoded:t:lost" in prints
+    assert "dead-decoder:t:ghost" in prints
+    # declared+decoded ("shed") and inline ("done") stay silent
+    assert not any(p.endswith(":shed") or p.endswith(":done")
+                   for p in prints), prints
+
+
+def test_clean_wire_is_silent(tmp_path):
+    tree = dict(_WIRE_TREE)
+    tree["srv.py"] = """
+        OUTCOMES = ("done", "shed")
+
+        def reply(ok):
+            if ok:
+                return {"outcome": "done"}
+            return {"outcome": "shed"}
+        """
+    tree["cli.py"] = """
+        def decode(reply):
+            outcome = reply["outcome"]
+            if outcome == "shed":
+                raise RuntimeError("shed")
+            return reply
+        """
+    proj = _proj(tmp_path, **tree)
+    assert check_wires(proj) == []
+
+
+# --- retry classification (.call regions) -----------------------------------
+
+
+def test_call_region_retry_on_checked_against_registry(tmp_path):
+    proj = _proj(tmp_path, **{"m.py": """
+        IDEMPOTENCY = {
+            "send_once": "unsafe",
+            "Bounce": "retryable",
+        }
+
+        class Bounce(Exception):
+            pass
+
+        def send_once(policy, payload):
+            return policy.call(_ship, retry_on=(Bounce, OSError))
+
+        def _ship():
+            pass
+        """})
+    prints = {f.fingerprint for f in check_retries(proj)}
+    # retrying the registered-retryable Bounce is fine; blind-retrying
+    # OSError on an unsafe op is the finding
+    assert prints == {"blind-retry:send_once:OSError"}
+
+
+# --- the real tree ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def package_project():
+    return Project([os.path.join(REPO, "memgraph_tpu")], cwd=REPO)
+
+
+def test_registry_extraction_from_product(package_project):
+    spec = extract_specs(package_project)
+    roots = {r.root_id for r in spec.roots}
+    assert {"bolt.session", "kernel.dispatch", "mp.worker",
+            "shard.worker", "twopc.prepare", "twopc.decide",
+            "replication.apply", "raft.rpc", "stream.consumer",
+            "http.monitoring"} <= roots
+    assert {w.wire_id for w in spec.wires} == \
+        {"kernel", "mp_executor", "twopc"}
+    idem = {e.name: e.classification for e in spec.idempotency}
+    assert idem["ShardedClient.write"] == "unsafe"
+    assert idem["KernelOom"] == "unsafe"
+    assert idem["StaleShardEpoch"] == "retryable"
+
+
+def test_product_wires_are_live_in_both_directions(package_project):
+    """Every declared wire must extract a NON-EMPTY vocabulary on both
+    sides — an empty side means the extraction directives rotted and
+    the drift check is vacuously green."""
+    from tools.mgflow.protocol import _extract_side
+    spec = extract_specs(package_project)
+    for wire in spec.wires:
+        emitted = {}
+        for side in wire.server:
+            emitted.update(_extract_side(package_project, side))
+        decoded = {}
+        for side in wire.client:
+            decoded.update(_extract_side(package_project, side))
+        assert emitted, f"wire {wire.wire_id}: no emitted outcomes"
+        assert decoded, f"wire {wire.wire_id}: no decoded outcomes"
+
+
+def test_product_roots_resolve_and_contracts_hold(package_project):
+    from tools.mglint.core import load_baseline
+    spec = extract_specs(package_project)
+    findings = check_contracts(package_project, spec)
+    baseline = load_baseline(
+        os.path.join(REPO, "tools", "mgflow", "baseline.json"))
+    unbaselined = [f for f in findings if f.key not in baseline]
+    assert not unbaselined, "\n".join(f.render() for f in unbaselined)
+    # no dead roots hide behind the baseline either
+    assert not any(f.fingerprint.startswith("dead-root:")
+                   for f in findings)
+
+
+def test_flow_stats_shape():
+    from memgraph_tpu.flowspec import SERVING_ROOTS, flow_stats
+    doc = flow_stats()
+    assert doc["contract_roots"] == len(SERVING_ROOTS) >= 10
+    assert set(doc["wires"]) == {"kernel", "mp_executor", "twopc"}
+    assert doc["roots"]["twopc.prepare"] == ["MemgraphTpuError"]
+    assert doc["roots"]["kernel.dispatch"] == []
+
+
+# --- CLI gate ---------------------------------------------------------------
+
+
+def _cli(*args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mgflow", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_check_package_is_green():
+    proc = _cli("check", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == [] and doc["unused_baseline"] == []
+    assert doc["roots"] >= 10 and doc["wires"] == 3
+
+
+def test_cli_list_prints_contracts():
+    proc = _cli("list", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert {r["root_id"] for r in doc["roots"]} >= {"kernel.dispatch"}
+    assert doc["idempotency"]["ShardedClient.write"] == "unsafe"
+
+
+def test_cli_exit_1_on_unbaselined_findings():
+    proc = _cli("check", "--no-baseline", "tests/lint_fixtures")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "MG012" in proc.stdout and "MG013" in proc.stdout
+
+
+def test_cli_unused_baseline_entry_fails_the_gate(tmp_path):
+    tree = tmp_path / "t"
+    tree.mkdir()
+    (tree / "m.py").write_text("def quiet():\n    return 1\n")
+    stale = tmp_path / "baseline.json"
+    stale.write_text(json.dumps({"entries": [
+        {"key": "MG012:gone.py:x:escape:x:ValueError",
+         "justification": "this finding was fixed long ago and the "
+                          "entry should have been removed with it"}]}))
+    proc = _cli("check", "--baseline", str(stale), str(tree))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "unused baseline entry" in proc.stdout
+
+
+def test_cli_exit_2_on_broken_baseline(tmp_path):
+    tree = tmp_path / "t"
+    tree.mkdir()
+    (tree / "m.py").write_text("def quiet():\n    return 1\n")
+    broken = tmp_path / "baseline.json"
+    broken.write_text(json.dumps({"entries": [
+        {"key": "MG012:x:y:z"}]}))          # no justification
+    proc = _cli("check", "--baseline", str(broken), str(tree))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "broken baseline" in proc.stderr
+
+
+def test_cli_exit_2_on_empty_tree(tmp_path):
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    proc = _cli("check", str(empty))
+    assert proc.returncode == 2
+
+
+def test_escape_model_is_cached_per_project(package_project):
+    em1 = get_escape_model(package_project)
+    em2 = get_escape_model(package_project)
+    assert em1 is em2

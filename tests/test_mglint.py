@@ -230,15 +230,66 @@ def test_mg011_package_serving_paths_are_accounted():
         f.render() for f in result.findings)
 
 
+def test_mg012_fires_on_contract_escapes_only():
+    result = _run(["tests/lint_fixtures"], only={"MG012"})
+    hits = _hits(result, "MG012")
+    # witness lines: the known-raising json.loads in the helper and the
+    # undeclared raise — NOT the root function's def line
+    assert ("mg012_escape.py", 44) in hits
+    assert ("mg012_escape.py", 55) in hits
+    prints = {f.fingerprint for f in result.findings}
+    assert "escape:fixture.serve:ValueError" in prints
+    assert "escape:fixture.serve:CrashError" in prints
+    # dead registry entry reported at its own declaration
+    assert "dead-root:fixture.dead" in prints
+    # the declared AppError narrowing and the total decoy stay silent
+    assert len(hits) == 3, hits
+
+
+def test_mg012_package_roots_hold_their_contracts():
+    # the real tree's serving roots must be clean modulo the justified
+    # mgflow baseline (shared keys live in tools/mglint/baseline.json)
+    result = _run(["memgraph_tpu"], baseline=load_baseline(),
+                  only={"MG012"})
+    assert not result.findings, "\n".join(
+        f.render() for f in result.findings)
+
+
+def test_mg013_fires_on_unsafe_retries_only():
+    result = _run(["tests/lint_fixtures"], only={"MG013"})
+    hits = _hits(result, "MG013")
+    assert ("mg013_unsafe_retry.py", 48) in hits   # blind-retry
+    assert ("mg013_unsafe_retry.py", 50) in hits   # unsafe class
+    assert ("mg013_unsafe_retry.py", 61) in hits   # unclassified loop
+    assert ("mg013_unsafe_retry.py", 22) in hits   # dead registration
+    prints = {f.fingerprint for f in result.findings}
+    assert "blind-retry:Client.send_write:TransportError" in prints
+    assert "retry-unsafe-class:Client.send_write:ShedError" in prints
+    assert "unclassified:Client.unregistered_spin" in prints
+    assert "idem-unused:Client.ghost_op" in prints
+    # the retryable fetch loop swallowing a retryable class is silent
+    assert len(hits) == 4, hits
+
+
+def test_mg013_package_retries_respect_idempotency():
+    result = _run(["memgraph_tpu"], baseline=load_baseline(),
+                  only={"MG013"})
+    assert not result.findings, "\n".join(
+        f.render() for f in result.findings)
+
+
 def test_new_rules_are_registered_in_catalog():
     from tools.mglint import rules as _rules  # noqa: F401
     from tools.mglint.registry import RULES
-    for rule_id in ("MG008", "MG009", "MG010", "MG011"):
+    for rule_id in ("MG008", "MG009", "MG010", "MG011", "MG012",
+                    "MG013"):
         assert rule_id in RULES
     assert RULES["MG008"].name == "recompile-hazard"
     assert RULES["MG009"].name == "host-sync-in-hot-path"
     assert RULES["MG010"].name == "missing-donation"
     assert RULES["MG011"].name == "unaccounted-device-allocation"
+    assert RULES["MG012"].name == "undeclared-escape"
+    assert RULES["MG013"].name == "unsafe-retry"
 
 
 def test_suppression_comment_scopes_to_one_handler():

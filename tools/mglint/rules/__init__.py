@@ -11,3 +11,5 @@ from . import recompile_hazard  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import missing_donation  # noqa: F401
 from . import device_alloc  # noqa: F401
+from . import escape_contract  # noqa: F401
+from . import unsafe_retry  # noqa: F401

@@ -1,0 +1,34 @@
+"""MG012 — undeclared-escape: serving roots keep their exception-flow
+contracts.
+
+Every ``ServingRoot`` declared in a ``SERVING_ROOTS`` registry inside
+the scanned tree names a long-lived dispatch loop / RPC handler and the
+exception types it is allowed to let escape (``raises=``; subclasses
+covered by their bases, an empty contract means the root must be
+total). This rule computes each root's interprocedural escape set —
+explicit raise sites plus known-raising stdlib calls, closed over the
+call graph and narrowed by except clauses, re-raises, exception
+aliases and RetryPolicy wrappers (tools/mgflow/engine.py) — and
+reports every escaping type the contract does not cover, at its
+witness raise site. Dead registry entries (the named function no
+longer exists) are findings too: the registry can only shrink
+honestly.
+
+Trees with no ``SERVING_ROOTS`` registry (fixtures, tools) are out of
+scope and produce nothing.
+"""
+
+from __future__ import annotations
+
+from ...mgflow.contracts import check_contracts
+from ...mgflow.spec import extract_specs
+from ..registry import register
+
+
+@register("MG012", "undeclared-escape")
+def check(project):
+    """Exceptions escaping a serving root outside its raises= contract."""
+    spec = extract_specs(project)
+    if not spec.roots:
+        return []
+    return check_contracts(project, spec)

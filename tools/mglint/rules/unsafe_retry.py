@@ -1,0 +1,36 @@
+"""MG013 — unsafe-retry: retry regions honor the IDEMPOTENCY registry.
+
+A retry region is a ``for _ in <policy>.attempts():`` loop or a
+``<policy>.call(fn, ...)`` wrapper. Each region must be classified in
+``utils/retry.py``'s ``IDEMPOTENCY`` registry (by the qualname of the
+operation it implements), and the classification is enforced:
+
+  * an unclassified region is a finding — every retry loop states
+    whether blind re-send is safe;
+  * swallowing an exception class registered ``unsafe`` and retrying
+    is a finding wherever it happens (the oom/shed rule: outcomes that
+    are deterministic against current state are never retried);
+  * an operation registered ``unsafe`` may retry only classes
+    registered ``retryable`` (pre-apply bounces) — anything else it
+    swallows is a blind re-send of a non-idempotent op;
+  * a registry entry matched by no region/handled class is a dead
+    registration and a finding.
+
+Trees with no ``IDEMPOTENCY`` registry (fixtures, tools) are out of
+scope and produce nothing.
+"""
+
+from __future__ import annotations
+
+from ...mgflow.retrycheck import check_retries
+from ...mgflow.spec import extract_specs
+from ..registry import register
+
+
+@register("MG013", "unsafe-retry")
+def check(project):
+    """Retry regions violating the IDEMPOTENCY registry's classification."""
+    spec = extract_specs(project)
+    if not spec.idempotency:
+        return []
+    return check_retries(project, spec)
