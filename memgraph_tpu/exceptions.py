@@ -86,9 +86,28 @@ class ProcedureException(QueryException):
 
 class WorkerCrashedError(MemgraphTpuError, ConnectionError):
     """A pooled worker process died mid-request. The pool has already
-    respawned it, so the request is RETRYABLE — ConnectionError in the
-    MRO means RetryPolicy's default ``retry_on`` catches it without
-    special-casing (mp_executor and the shard plane both raise this)."""
+    respawned it, so reads are RETRYABLE — ConnectionError in the MRO
+    means RetryPolicy's default ``retry_on`` catches it without
+    special-casing (mp_executor and the shard plane both raise this).
+
+    ``in_doubt`` distinguishes the two crash windows for writers: False
+    means the request was never handed to the worker (replaced while
+    queued — safe to blindly re-send), True means it died after the
+    request was on the wire, so a non-idempotent op may or may not have
+    applied and must NOT be blindly retried (see WriteInDoubtError)."""
+
+    def __init__(self, message: str, *, in_doubt: bool = False) -> None:
+        super().__init__(message)
+        self.in_doubt = in_doubt
+
+
+class WriteInDoubtError(MemgraphTpuError):
+    """A non-idempotent write crashed in the in-doubt window: the owner
+    died after the request was sent but before the ack, so the write
+    may or may not be in the shard's WAL. Surfaced instead of retried —
+    a blind re-send could double-apply. Callers that can verify
+    (read-your-write, idempotency keys) may resolve the doubt
+    themselves; chaos checkers record it as indeterminate."""
 
 
 class ShardError(MemgraphTpuError):
@@ -113,3 +132,44 @@ class StaleShardEpoch(ShardError):
 
 class AuthException(MemgraphTpuError):
     pass
+
+
+#: Worker-shipped error envelopes carry ``(type_name, message)``
+#: strings; this is the decode table back into the typed taxonomy.
+#: Message-only constructors only — classes with structured payloads
+#: (StaleShardEpoch) or process-lifecycle semantics (WorkerCrashedError,
+#: WriteInDoubtError) are deliberately absent and fall through to the
+#: MemgraphTpuError catch-all.
+WIRE_ERRORS = {
+    "MemgraphTpuError": MemgraphTpuError,
+    "StorageError": StorageError,
+    "SerializationError": SerializationError,
+    "ConstraintViolation": ConstraintViolation,
+    "DurabilityError": DurabilityError,
+    "QueryException": QueryException,
+    "SyntaxException": SyntaxException,
+    "SemanticException": SemanticException,
+    "TypeException": TypeException,
+    "EntityNotFound": EntityNotFound,
+    "ArithmeticException": ArithmeticException,
+    "ProfileException": ProfileException,
+    "HintedAbortError": HintedAbortError,
+    "TransactionException": TransactionException,
+    "ReplicaUnavailableException": ReplicaUnavailableException,
+    "FencedException": FencedException,
+    "ProcedureException": ProcedureException,
+    "ShardError": ShardError,
+    "AuthException": AuthException,
+}
+
+
+def raise_wire_error(type_name: str, message: str):
+    """Rehydrate a worker error envelope into its taxonomy class, so
+    pool/plane clients surface SyntaxException as SyntaxException
+    instead of a stringly generic error. Unknown type names (builtin
+    exceptions, future classes crossing an old wire) degrade to
+    MemgraphTpuError with the name preserved in the message."""
+    cls = WIRE_ERRORS.get(type_name)
+    if cls is None:
+        raise MemgraphTpuError(f"{type_name}: {message}")
+    raise cls(message)

@@ -24,13 +24,15 @@ from __future__ import annotations
 
 import logging
 import os
+import pickle
 import signal
+import struct
 import tempfile
 import threading
 import time
 
 from ..exceptions import (MemgraphTpuError, StaleShardEpoch,
-                          WorkerCrashedError)
+                          WorkerCrashedError, raise_wire_error)
 from ..observability import trace as mgtrace
 from ..observability.metrics import global_metrics
 from ..server.mp_executor import _recv, _send
@@ -218,9 +220,12 @@ class ShardPlane:
                 raise_typed: bool = True):
         """One envelope round-trip to a shard's owner. Returns (status,
         payload). Typed raises: a dead worker respawns (with per-shard
-        WAL recovery) and raises WorkerCrashedError (retryable); a
-        stale-epoch/fenced bounce raises StaleShardEpoch carrying the
-        owner's epoch unless ``raise_typed`` is False."""
+        WAL recovery) and raises WorkerCrashedError — ``in_doubt=True``
+        when it died after the request was on the wire (writers must
+        not blindly re-send), False when it was replaced before the
+        send (safe to retry); a stale-epoch/fenced bounce raises
+        StaleShardEpoch carrying the owner's epoch unless
+        ``raise_typed`` is False."""
         worker = self.owner(shard_id)
         with self._lock:
             shared_write(self, "_inflight")
@@ -246,13 +251,17 @@ class ShardPlane:
                         _send(worker.req_fd,
                               (op, payload, mgtrace.inject()))
                         out = _recv(worker.resp_fd)
-                    except (OSError, EOFError) as e:
+                    except (OSError, EOFError, struct.error,
+                            ValueError, pickle.UnpicklingError) as e:
+                        # codec failure on the control wire (torn
+                        # frame from a dying worker) means the same
+                        # thing the pipe errors do: this owner is gone
                         self._handle_dead(shard_id, worker)
                         raise WorkerCrashedError(
                             f"shard {shard_id} worker {worker.name} "
                             f"(pid {worker.pid}) died mid-request; "
-                            "respawned with per-shard recovery — "
-                            "retry") from e
+                            "respawned with per-shard recovery",
+                            in_doubt=True) from e
         finally:
             with self._lock:
                 shared_write(self, "_inflight")
@@ -266,8 +275,7 @@ class ShardPlane:
         if spans:
             mgtrace.adopt_spans(spans)
         if status == "err":
-            raise MemgraphTpuError(f"shard {shard_id}: {body[0]}: "
-                                   f"{body[1]}")
+            raise_wire_error(body[0], f"shard {shard_id}: {body[1]}")
         if raise_typed and status in ("stale_epoch", "fenced"):
             raise StaleShardEpoch(shard_id, int(body.get("epoch") or 0),
                                   fenced=(status == "fenced"))
@@ -311,7 +319,8 @@ class ShardPlane:
                       ("grant", {"shard": shard_id, "epoch": epoch},
                        None))
                 _recv(worker.resp_fd)
-        except (OSError, EOFError):
+        except (OSError, EOFError, struct.error, ValueError,
+                pickle.UnpicklingError):
             # dead owner: the next routed request respawns + re-grants
             log.warning("grant(%d, epoch %d) found worker %s dead",
                         shard_id, epoch, worker.name)

@@ -30,7 +30,7 @@ import time
 import uuid
 
 from ..exceptions import (MemgraphTpuError, ShardError, StaleShardEpoch,
-                          WorkerCrashedError)
+                          WorkerCrashedError, WriteInDoubtError)
 from ..observability.metrics import global_metrics
 from ..query.frontend import ast as A
 from ..query.frontend.parser import parse
@@ -355,6 +355,17 @@ class ShardedClient:
                     "shard.stale_epoch_bounces_total")
                 self.refresh_map()
             except WorkerCrashedError as e:
+                if e.in_doubt:
+                    # the owner died AFTER the write was on the wire:
+                    # it may be in the shard's WAL already, so a blind
+                    # re-send can double-apply a non-idempotent write.
+                    # Surface the doubt typed instead of retrying.
+                    self._account(query, t0, rows=0, error=True)
+                    global_metrics.increment(
+                        "shard.write_in_doubt_total")
+                    raise WriteInDoubtError(
+                        f"sharded write to shard {shard} is in doubt "
+                        f"(owner died mid-request): {e}") from e
                 last = e
                 self.refresh_map()
         self._account(query, t0, rows=0, error=True)

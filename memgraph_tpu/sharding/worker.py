@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
+import struct
 import time
 
 from ..observability import trace as mgtrace
@@ -346,7 +348,11 @@ def shard_worker_main(shard_id: int, name: str, req_fd: int,
     while True:
         try:
             msg = _recv(req_fd)
-        except EOFError:
+        except (EOFError, OSError, struct.error, ValueError,
+                pickle.UnpicklingError):
+            # torn/garbage frame on the request pipe: the plane side
+            # is gone or corrupt — exit; the plane respawns this shard
+            # with per-shard WAL recovery
             return
         if msg is None:
             return
@@ -362,5 +368,9 @@ def shard_worker_main(shard_id: int, name: str, req_fd: int,
                             {"elapsed": time.perf_counter() - t0},
                             spans))
         except Exception as e:  # noqa: BLE001 — ship the error back
-            _send(resp_fd, ("err", (type(e).__name__, str(e)),
-                            {"elapsed": time.perf_counter() - t0}, []))
+            try:
+                _send(resp_fd, ("err", (type(e).__name__, str(e)),
+                                {"elapsed": time.perf_counter() - t0},
+                                []))
+            except (OSError, ValueError, struct.error):
+                return      # response pipe gone: die, get respawned

@@ -471,6 +471,86 @@ def test_worker_crash_typed_error_and_wal_recovery(plane):
     assert rows == [[3]], "per-shard WAL recovery lost a committed row"
 
 
+def test_write_in_doubt_surfaces_typed_instead_of_blind_resend(
+        plane, monkeypatch):
+    """An owner that dies AFTER the write hit the wire may already
+    have it in the shard WAL — the router must NOT re-send a
+    non-idempotent write; it surfaces WriteInDoubtError typed."""
+    from memgraph_tpu.exceptions import WriteInDoubtError
+    client = ShardedClient(plane)
+    client.write("CREATE (:User {id: 1})", key=1)
+
+    def died_mid_request(shard_id, op, payload, raise_typed=True):
+        raise WorkerCrashedError(
+            f"shard {shard_id} worker died mid-request", in_doubt=True)
+
+    monkeypatch.setattr(client.plane, "request", died_mid_request)
+    in_doubt0 = _metric("shard.write_in_doubt_total")
+    with pytest.raises(WriteInDoubtError):
+        client.write("CREATE (:User {id: 2})", key=2)
+    assert _metric("shard.write_in_doubt_total") == in_doubt0 + 1
+
+
+def test_pre_send_crash_still_retries_transparently(
+        plane, monkeypatch):
+    """The other crash window — the owner was replaced BEFORE the
+    request was sent (in_doubt=False) — is definitely-not-applied, so
+    the routed write keeps healing itself."""
+    client = ShardedClient(plane)
+    real_request = client.plane.request
+    calls = {"n": 0}
+
+    def replaced_once(shard_id, op, payload, raise_typed=True):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise WorkerCrashedError(
+                "replaced while this request queued", in_doubt=False)
+        return real_request(shard_id, op, payload,
+                            raise_typed=raise_typed)
+
+    monkeypatch.setattr(client.plane, "request", replaced_once)
+    _c, _r, ack = client.write("CREATE (:User {id: 9})", key=9)
+    assert ack["shard"] == client.shard_for(9)
+    assert calls["n"] >= 2
+    assert client.read(
+        "MATCH (n:User {id: 9}) RETURN n.id", key=9)[1] == [[9]]
+
+
+def test_worker_errors_decode_typed_across_the_shard_wire(plane):
+    """Worker-side taxonomy errors cross the process boundary TYPED:
+    the plane re-raises the class the worker named instead of a
+    stringly MemgraphTpuError."""
+    from memgraph_tpu.exceptions import SyntaxException
+    client = ShardedClient(plane)
+    client.write("CREATE (:User {id: 1})", key=1)
+    with pytest.raises(SyntaxException):
+        client.read("MATCH (n RETURN n", key=1)
+    # the worker survived the error and keeps serving
+    assert client.read(
+        "MATCH (n:User {id: 1}) RETURN n.id", key=1)[1] == [[1]]
+
+
+def test_garbage_frame_on_request_pipe_respawns_worker(plane):
+    """A corrupt frame on a shard's request pipe must not wedge the
+    plane: the worker drops it and exits, the next routed request
+    respawns the shard with per-shard WAL recovery."""
+    import struct as structlib
+
+    client = ShardedClient(plane)
+    for i in range(8):
+        client.write("CREATE (:User {id: $id})", {"id": i}, key=i)
+    victim = client.shard_for(5)
+    worker = plane.owner(victim)
+    respawns0 = _metric("shard.worker_respawn_total")
+    # a well-framed envelope whose body is not a pickle at all
+    os.write(worker.req_fd,
+             structlib.pack("<I", 4) + b"\xff\xff\xff\xff")
+    _c, rows = client.read(
+        "MATCH (n:User {id: 5}) RETURN n.id", key=5)
+    assert rows == [[5]], "WAL recovery lost a committed row"
+    assert _metric("shard.worker_respawn_total") > respawns0
+
+
 # --------------------------------------------------------------------------
 # coordinator-owned placement
 # --------------------------------------------------------------------------
