@@ -128,7 +128,11 @@ class MPReadExecutor:
         while True:
             try:
                 msg = _recv(req_fd)
-            except EOFError:
+            except (EOFError, OSError, struct.error, ValueError,
+                    pickle.UnpicklingError):
+                # torn/garbage frame on the request pipe: the parent
+                # side is gone or corrupt — exit so the parent's
+                # respawn path replaces this worker cleanly
                 return
             if msg is None:
                 return
@@ -158,7 +162,10 @@ class MPReadExecutor:
                     if carrier else []
                 _send(resp_fd, ("ok", prepared.columns, rows, spans))
             except Exception as e:  # noqa: BLE001 — ship the error back
-                _send(resp_fd, ("err", type(e).__name__, str(e)))
+                try:
+                    _send(resp_fd, ("err", type(e).__name__, str(e)))
+                except (OSError, ValueError, struct.error):
+                    return      # response pipe gone: die, get respawned
 
     def refresh(self) -> None:
         """Re-fork so workers see the current committed state."""
@@ -186,7 +193,9 @@ class MPReadExecutor:
 
     def execute(self, query: str, params: dict | None = None):
         """Round-robin a read-only query to a worker; returns
-        (columns, rows). Raises RuntimeError on worker-side errors."""
+        (columns, rows). Worker-side errors are rehydrated into the
+        typed taxonomy (SyntaxException stays SyntaxException across
+        the fork boundary)."""
         from ..observability.metrics import global_metrics
         from ..observability.stats import global_query_stats
         if not self._workers:
@@ -209,7 +218,8 @@ class MPReadExecutor:
                         _send(req_fd,
                               (query, params or {}, mgtrace.inject()))
                         out = _recv(resp_fd)
-                    except (OSError, EOFError) as e:
+                    except (OSError, EOFError, struct.error,
+                            ValueError, pickle.UnpicklingError) as e:
                         # dead worker: a wedged queue was the old
                         # failure mode — instead, respawn in place and
                         # fail THIS job with a typed retryable error
@@ -239,7 +249,8 @@ class MPReadExecutor:
             global_query_stats.record_text(
                 query, time.perf_counter() - t0, rows=0, error=True,
                 trace_id=mgtrace.current_trace_id())
-            raise RuntimeError(f"{out[1]}: {out[2]}")
+            from ..exceptions import raise_wire_error
+            raise_wire_error(out[1], out[2])
         if len(out) > 3:
             mgtrace.adopt_spans(out[3])
         global_query_stats.record_text(

@@ -71,9 +71,13 @@ def test_concurrent_dispatch(ictx):
 
 
 def test_worker_error_transport(ictx):
+    """Worker-side errors cross the fork boundary TYPED: the parent
+    re-raises the taxonomy class the worker named, not a stringly
+    RuntimeError."""
+    from memgraph_tpu.exceptions import SyntaxException
     ex = MPReadExecutor(ictx, n_workers=1)
     try:
-        with pytest.raises(RuntimeError, match="SyntaxException|Query"):
+        with pytest.raises(SyntaxException):
             ex.execute("MATCH (n RETURN n")
         # the worker survives the error
         _, rows = ex.execute("RETURN 1")
@@ -84,16 +88,17 @@ def test_worker_error_transport(ictx):
 
 def test_write_queries_rejected_loudly(ictx):
     """Misrouted writes must fail, not vanish into the forked snapshot."""
+    from memgraph_tpu.exceptions import QueryException
     ex = MPReadExecutor(ictx, n_workers=1)
     try:
-        with pytest.raises(RuntimeError, match="read-only"):
+        with pytest.raises(QueryException, match="read-only"):
             ex.execute("CREATE (:Ghost {id: 1})")
-        with pytest.raises(RuntimeError, match="read-only"):
+        with pytest.raises(QueryException, match="read-only"):
             ex.execute("MATCH (n:User {id: 1}) SET n.age = 99")
         # non-Cypher statements (auth/DDL) are refused before prepare
-        with pytest.raises(RuntimeError, match="read-only"):
+        with pytest.raises(QueryException, match="read-only"):
             ex.execute("CREATE INDEX ON :User(id)")
-        with pytest.raises(RuntimeError, match="read-only"):
+        with pytest.raises(QueryException, match="read-only"):
             ex.execute("CREATE USER ghost IDENTIFIED BY 'pw'")
         # worker still serves reads afterwards
         _, rows = ex.execute("MATCH (n:User) RETURN count(n)")
