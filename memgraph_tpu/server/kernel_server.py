@@ -1120,7 +1120,11 @@ class KernelServer:
             while not self._shutdown.is_set():
                 try:
                     header, arrays = _recv_msg(conn)
-                except (ConnectionError, struct.error, OSError):
+                except (ConnectionError, struct.error, OSError,
+                        ValueError):
+                    # ValueError: garbage JSON header / bad dtype from
+                    # a confused client — drop the connection, not the
+                    # serving thread
                     return
                 self._touch_activity()
                 op = header.get("op")
@@ -1151,11 +1155,22 @@ class KernelServer:
                     else:
                         _send_msg(conn, {"ok": False, "outcome": "invalid",
                                          "error": f"unknown op {op!r}"})
+                except KernelServerError as e:
+                    # typed dispatch failures keep their outcome on the
+                    # wire so clients rehydrate the taxonomy instead of
+                    # a generic "invalid"
+                    try:
+                        _send_msg(conn, {"ok": False,
+                                         "outcome": e.outcome,
+                                         "retryable": e.retryable,
+                                         "error": str(e)})
+                    except (OSError, ValueError, struct.error):
+                        return
                 except Exception as e:  # noqa: BLE001 — report, continue
                     try:
                         _send_msg(conn, {"ok": False, "outcome": "invalid",
                                          "error": str(e)})
-                    except OSError:
+                    except (OSError, ValueError, struct.error):
                         return
         finally:
             conn.close()

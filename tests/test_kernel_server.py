@@ -97,3 +97,67 @@ def test_error_does_not_kill_server(server):
     with pytest.raises(RuntimeError):
         client.pagerank(graph_key="nope")
     assert client.ping()
+
+
+# --- in-process wire tests (no daemon spawn) --------------------------------
+
+
+def _in_process_conn(tmp_path):
+    """A KernelServer serving ONE socketpair end on a thread — the
+    typed-outcome wire is testable without paying the daemon spawn."""
+    import socket
+    import threading
+
+    from memgraph_tpu.server.kernel_server import KernelServer
+    srv = KernelServer(socket_path=str(tmp_path / "ks.sock"))
+    ours, theirs = socket.socketpair()
+    t = threading.Thread(target=srv._serve_conn, args=(theirs,),
+                         daemon=True)
+    t.start()
+    return srv, ours, t
+
+
+def test_garbage_header_drops_connection_not_thread(tmp_path):
+    """A well-framed envelope whose header is not JSON must sever the
+    connection cleanly (no traceback reply, no wedged thread)."""
+    import struct
+
+    _srv, conn, t = _in_process_conn(tmp_path)
+    try:
+        conn.sendall(struct.pack("<I", 8) + b"\xff" * 8)
+        conn.settimeout(5)
+        assert conn.recv(4096) == b""      # dropped, nothing shipped
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        conn.close()
+
+
+def test_typed_outcome_crosses_the_wire(tmp_path):
+    """A KernelServerError raised inside dispatch ships its outcome +
+    retryable flag, and the client rehydrates the taxonomy class."""
+    from memgraph_tpu.server.kernel_server import (AdmissionRejected,
+                                                   _raise_for_reply,
+                                                   _recv_msg, _send_msg)
+
+    srv, conn, _t = _in_process_conn(tmp_path)
+
+    def shed(header, arrays):
+        raise AdmissionRejected("admission budget exhausted")
+
+    srv._ppr.submit = shed
+    try:
+        conn.settimeout(10)
+        _send_msg(conn, {"op": "ppr", "sources": [0]})
+        reply, _ = _recv_msg(conn)
+        assert reply["ok"] is False
+        assert reply["outcome"] == "shed"
+        assert reply["retryable"] is False   # shed is not retryable
+        with pytest.raises(AdmissionRejected):
+            _raise_for_reply(reply)
+        # the connection survived the typed failure
+        _send_msg(conn, {"op": "ping"})
+        reply, _ = _recv_msg(conn)
+        assert reply["ok"] is True
+    finally:
+        conn.close()
