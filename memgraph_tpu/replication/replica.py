@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import logging
 import socket
+import struct
 import threading
 
+from ..exceptions import MemgraphTpuError
 from ..observability import trace as mgtrace
 from ..storage.durability import wal as W
 from ..utils.locks import tracked_lock
@@ -170,6 +172,13 @@ class ReplicaServer:
                                 {"message": f"unknown message {msg_type}"})
         except (ConnectionError, OSError):
             pass
+        except (struct.error, ValueError, MemgraphTpuError) as e:
+            # corrupt frame (torn length prefix, garbage JSON) or a
+            # refused apply (DurabilityError/StorageError): sever THIS
+            # connection loudly instead of killing the serving thread
+            # silently — the MAIN heals via its retry/catch-up path
+            log.warning("replica connection dropped: %s: %s",
+                        type(e).__name__, e)
         finally:
             conn.close()
 

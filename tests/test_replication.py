@@ -88,6 +88,24 @@ def test_async_replication(cluster):
     assert rows == [[7]]
 
 
+def test_replica_survives_garbage_frame(cluster):
+    """A corrupt frame (well-framed envelope, garbage JSON body) must
+    sever only THAT connection — the replica keeps listening and a
+    real registration + sync write still lands afterwards."""
+    from memgraph_tpu.replication import protocol as P
+    with socket.create_connection(("127.0.0.1", cluster["port"]),
+                                  timeout=5) as s:
+        P.send_frame(s, P.MSG_REGISTER, b"\xff\xfenot-json")
+        # the replica drops the connection instead of acking
+        s.settimeout(5)
+        assert s.recv(4096) == b""
+    main, replica = cluster["main"], cluster["replica"]
+    main.execute(
+        f"REGISTER REPLICA r1 SYNC TO \"127.0.0.1:{cluster['port']}\"")
+    main.execute("CREATE (:Survivor {v: 1})")
+    assert _rows(replica, "MATCH (n:Survivor) RETURN n.v") == [[1]]
+
+
 def test_replica_rejects_writes(cluster):
     replica = cluster["replica"]
     with pytest.raises(QueryException):
