@@ -78,6 +78,15 @@ async def start_monitoring_server(host: str, port: int, ictx):
                         default=str)
                 ctype = "application/json"
             elif path.startswith("/stats"):
+                # exception-flow contract surface (mgflow): refresh the
+                # registry gauges on read — static by construction,
+                # they move only when flowspec.py itself changes
+                from ..flowspec import flow_stats
+                flow = flow_stats()
+                global_metrics.set_gauge("mgflow.contract_roots",
+                                         float(flow["contract_roots"]))
+                global_metrics.set_gauge("mgflow.escapes_total",
+                                         float(flow["escapes_total"]))
                 # mgstat workload statistics: bounded top-K fingerprints
                 # with latency quantiles, error/plan-cache-hit counts,
                 # and the retained trace_ids each shape links to
@@ -145,7 +154,11 @@ async def start_monitoring_server(host: str, port: int, ictx):
                     "lane": dict(_lane_stats(), metrics={
                         name: value for name, _k, value
                         in global_metrics.snapshot()
-                        if name.startswith("lane.")})},
+                        if name.startswith("lane.")}),
+                    # exception-flow contracts (mgflow, r24): the
+                    # declared serving-root contracts and wire ids —
+                    # the surface `python -m tools.mgflow check` gates
+                    "flow": flow},
                     default=str)
                 ctype = "application/json"
             elif path.startswith("/health"):
@@ -167,8 +180,11 @@ async def start_monitoring_server(host: str, port: int, ictx):
                 + f"Content-Length: {len(payload)}\r\n".encode()
                 + b"Connection: close\r\n\r\n" + payload)
             await writer.drain()
-        except OSError:
-            pass  # client went away mid-response; nothing to serve
+        except (OSError, ValueError):
+            # OSError: client went away mid-response. ValueError: a
+            # stats payload json.dumps refused (circular/oversized
+            # object) — drop this response, never the serving task
+            pass
         finally:
             writer.close()
 

@@ -87,6 +87,7 @@ STAT_NAMES = (
     "shard.move_duration_sec",
     "shard.map_epoch",              # routing-table fencing epoch gauge
     "shard.worker_respawn_total",
+    "shard.write_in_doubt_total",   # writes surfaced as WriteInDoubtError
     "shard.ops.*",                  # per-shard routed-op counters
     "shard.op_latency_sec.*",       # per-shard latency histograms
     "shard.queue_depth.*",          # per-shard in-flight gauges
@@ -189,6 +190,10 @@ STAT_NAMES = (
     # saturation plane
     "health.ready",
     "health.not_ready_total",
+    # exception-flow contracts (mgflow, r24): registry-shape gauges,
+    # refreshed on every GET /stats read
+    "mgflow.contract_roots",        # serving roots under contract
+    "mgflow.escapes_total",         # escape types the contracts admit
 )
 
 

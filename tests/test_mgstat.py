@@ -433,6 +433,22 @@ def test_get_stats_endpoint(monitoring):
     assert "latency_p99_ms" in fps[fp]
 
 
+def test_get_stats_flow_section_and_gauges(monitoring):
+    """The /stats body carries the exception-flow contract surface and
+    the GET itself refreshes the mgflow.* gauges from the registry."""
+    from memgraph_tpu.flowspec import SERVING_ROOTS
+    port, _interp = monitoring
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=5).read())
+    flow = doc["flow"]
+    assert flow["contract_roots"] == len(SERVING_ROOTS) >= 10
+    assert set(flow["wires"]) == {"kernel", "mp_executor", "twopc"}
+    assert flow["roots"]["twopc.prepare"] == ["MemgraphTpuError"]
+    gauges = {n: v for n, _k, v in global_metrics.snapshot()}
+    assert gauges["mgflow.contract_roots"] == float(len(SERVING_ROOTS))
+    assert gauges["mgflow.escapes_total"] == float(flow["escapes_total"])
+
+
 def test_get_health_flips_to_503_with_reason(monitoring):
     """Acceptance: /health goes not-ready with a machine-readable
     reason under an injected saturation fault, then recovers."""
