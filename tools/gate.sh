@@ -56,6 +56,28 @@ stage_mgmem() {
 }
 stage_mgmem
 
+# 1ab. mgflow: interprocedural exception-flow & typed-outcome contract
+#      checker — per-serving-root escape sets vs their raises=
+#      contracts, wire outcome vocabularies drift-checked BOTH
+#      directions, retry regions vs the IDEMPOTENCY registry; the
+#      justification-required baseline discipline means unused entries
+#      fail too. Exit 2 = bad invocation/no registry on this checkout:
+#      skip LOUDLY, never silently pass.
+stage_mgflow() {
+    echo
+    echo "=== gate: mgflow (exception-flow contracts) ==="
+    python -m tools.mgflow check
+    rc=$?
+    if [ "$rc" = 2 ]; then
+        echo "gate: SKIPPED: mgflow — registry/baseline unavailable on" \
+             "this checkout; NO contracts were flow-checked" >&2
+    elif [ "$rc" != 0 ]; then
+        echo "gate: FAILED: python -m tools.mgflow check" >&2
+        fail=1
+    fi
+}
+stage_mgflow
+
 # 1b. mgtrace smoke: one traced query end-to-end (parse → plan →
 #     execute → MVCC commit → mesh-routed device stages), single
 #     connected trace, Chrome-trace-event export validated structurally
