@@ -359,6 +359,7 @@ def test_matrix_asymmetric_link_fences_old_main():
     replica. The fencing chain (replica rejection → self-fence) must
     stop the perfectly-alive old MAIN from acking ever again."""
     from memgraph_tpu.exceptions import (FencedException,
+                                         MemgraphTpuError,
                                          ReplicaUnavailableException)
     cluster = ChaosCluster(seed=12, n_coords=3, n_data=3, fencing=True)
     try:
@@ -375,10 +376,14 @@ def test_matrix_asymmetric_link_fences_old_main():
         assert new_main != old_main
         # the old main is alive but must not produce a valid ack: its
         # strict replicas left it, and first contact with one fences it
-        with pytest.raises((FencedException,
-                            ReplicaUnavailableException,
-                            Exception)):
+        with pytest.raises(Exception) as ei:
             cluster.write(old_main, gids["k0"], 1)
+        # typed, not identity: any registry abort (FencedException /
+        # ReplicaUnavailableException / ...) or a transport error when
+        # the partition bites first — never a silent ack
+        assert isinstance(ei.value, (FencedException,
+                                     ReplicaUnavailableException,
+                                     MemgraphTpuError, OSError)), ei.value
         # new main acks at the new epoch. A ReplicaUnavailable abort is
         # the documented SAFE "definitely did not happen" (a strict
         # replica can still be mid-catch-up right after promotion), so
